@@ -1,0 +1,78 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capabilities of
+Apache MXNet 2.0 (reference: pu55yf3r/incubator-mxnet, read-only mount).
+
+Not a port: the compute path is JAX/XLA (+ Pallas kernels), distribution is
+jax.sharding meshes with XLA collectives over ICI/DCN, and hybridization is
+jit tracing — re-designs of the reference's C++ engine/executor/ps-lite
+stack for TPU hardware. See SURVEY.md at the repo root for the capability
+map and reference citations.
+
+Import layout mirrors ``import mxnet as mx``:
+    mx.np / mx.npx    numpy-compatible arrays (2.0-native surface)
+    mx.nd             legacy NDArray namespace
+    mx.autograd       tape-based autograd
+    mx.gluon          Block/HybridBlock/Trainer model API
+    mx.optimizer      optimizer zoo
+    mx.kv             KVStore (mesh-collective backends)
+    mx.context        cpu()/tpu() devices (gpu() aliases tpu())
+"""
+from __future__ import annotations
+
+__version__ = "2.0.0.tpu0"
+
+from .base import MXNetError  # noqa: F401
+from .context import (  # noqa: F401
+    Context,
+    Device,
+    cpu,
+    cpu_pinned,
+    current_context,
+    current_device,
+    device,
+    gpu,
+    num_gpus,
+    num_tpus,
+    tpu,
+)
+from . import engine  # noqa: F401
+from . import numpy as np  # noqa: F401
+from . import numpy_extension as npx  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import initializer  # noqa: F401
+from . import initializer as init  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import optimizer as opt  # noqa: F401
+from . import gluon  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import profiler  # noqa: F401
+from . import runtime  # noqa: F401
+from .util import is_np_array, set_np, use_np  # noqa: F401
+
+test_utils = None  # populated lazily to avoid import cost
+
+
+def __getattr__(name):
+    if name == "test_utils":
+        from . import test_utils as _tu
+
+        return _tu
+    if name == "random":
+        from .numpy import random as _r
+
+        return _r
+    if name == "sym" or name == "symbol":
+        from . import symbol as _s
+
+        return _s
+    if name == "image":
+        from . import image as _img
+
+        return _img
+    if name == "amp":
+        from . import amp as _amp
+
+        return _amp
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

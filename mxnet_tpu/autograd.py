@@ -1,0 +1,252 @@
+"""Autograd: tape control + functional grad.
+
+Parity: reference ``python/mxnet/autograd.py`` (``record :120``,
+``pause :144``, ``train_mode/predict_mode :168-200``, ``backward :244``,
+``grad :271``, custom ``Function :388``) over ``Imperative`` state
+(``include/mxnet/imperative.h``). The TPU-native mechanism is described in
+``mxnet_tpu/ops/dispatch.py``: recording captures jax.vjp pullbacks.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray.ndarray import ndarray, _wrap, _unwrap
+from .ops import dispatch
+from .ops.dispatch import Tape, autograd_state, apply_op
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "backward",
+    "grad",
+    "Function",
+    "get_symbol",
+]
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode_: Optional[bool]):
+        self._enter_record = is_record
+        self._enter_train = train_mode_
+        self._prev = None
+
+    def __enter__(self):
+        st = autograd_state
+        self._prev = (st.recording, st.training)
+        if self._enter_record is not None:
+            st.recording = self._enter_record
+            if self._enter_record and st.tape is None:
+                st.tape = Tape()
+        if self._enter_train is not None:
+            st.training = self._enter_train
+        return self
+
+    def __exit__(self, *exc):
+        # the tape survives scope exit — it lives until backward() consumes
+        # it (reference semantics: loss.backward() is called outside record)
+        st = autograd_state
+        st.recording, st.training = self._prev
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` — start taping ops."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def is_recording() -> bool:
+    return autograd_state.recording
+
+
+def is_training() -> bool:
+    return autograd_state.training
+
+
+def set_recording(is_record: bool) -> bool:
+    prev = autograd_state.recording
+    autograd_state.recording = is_record
+    if is_record and autograd_state.tape is None:
+        autograd_state.tape = Tape()
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    prev = autograd_state.training
+    autograd_state.training = train
+    return prev
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    heads = [heads] if isinstance(heads, ndarray) else list(heads)
+    if head_grads is not None:
+        head_grads = (
+            [head_grads] if isinstance(head_grads, ndarray) else list(head_grads)
+        )
+    dispatch.backward(heads, head_grads, retain_graph=retain_graph, train_mode=train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables instead of writing `.grad`
+    (reference autograd.py:271). ``create_graph=True`` records the gradient
+    computation so higher-order grads work."""
+    heads = [heads] if isinstance(heads, ndarray) else list(heads)
+    single = isinstance(variables, ndarray)
+    variables = [variables] if single else list(variables)
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    tape = autograd_state.tape
+    if tape is None:
+        raise MXNetError("autograd.grad called with no recorded graph")
+
+    if create_graph:
+        grads = _replay_grad(heads, variables, head_grads, tape)
+    else:
+        # temporary leaf attachment, run tape backward, collect
+        saved = [(v._grad_req, v._grad) for v in variables]
+        for v in variables:
+            v._grad_req, v._grad = "write", _wrap(jnp.zeros(v.shape, v.dtype))
+        try:
+            dispatch.backward(heads, head_grads, retain_graph=retain_graph, train_mode=train_mode)
+            grads = [v._grad for v in variables]
+        finally:
+            for v, (req, g) in zip(variables, saved):
+                v._grad_req, v._grad = req, g
+    return grads[0] if single else grads
+
+
+def _replay_grad(heads, variables, head_grads, tape):
+    """Differentiable backward: rebuild the forward as a pure function of the
+    variables and take jax.vjp under recording, so the produced grads are
+    themselves on the tape (higher-order autograd; reference
+    tests/python/unittest/test_higher_order_grad.py)."""
+    nodes = list(tape.nodes)
+    producer = dict(tape.producer)
+
+    var_ids = {id(v): i for i, v in enumerate(variables)}
+
+    def forward(var_vals):
+        produced = {}
+
+        def value_of(arr):
+            if id(arr) in var_ids:
+                return var_vals[var_ids[id(arr)]]
+            if id(arr) in producer:
+                n_idx, slot = producer[id(arr)]
+                return produced[(n_idx, slot)]
+            return _unwrap(arr)
+
+        for idx, node in enumerate(nodes):
+            if node.replay_fn is None:
+                raise MXNetError("graph already freed; use retain_graph=True")
+            in_vals = [value_of(a) for a in node.inputs]
+            outs = node.replay_fn(*in_vals)
+            if node.n_out == 1:
+                produced[(idx, 0)] = outs
+            else:
+                for s, o in enumerate(outs):
+                    produced[(idx, s)] = o
+        return [value_of(h) for h in heads]
+
+    def scalar_fn(*var_vals):
+        outs = forward(list(var_vals))
+        if head_grads is None:
+            return sum(jnp.sum(o) for o in outs)
+        return sum(jnp.sum(o * _unwrap(g)) for o, g in zip(outs, head_grads))
+
+    n_var = len(variables)
+    if n_var == 1:
+        return [apply_op(lambda v: jax.grad(scalar_fn)(v), variables, name="grad")]
+    return list(
+        apply_op(
+            lambda *vs: tuple(jax.grad(scalar_fn, argnums=tuple(range(n_var)))(*vs)),
+            variables,
+            n_out=n_var,
+            name="grad",
+        )
+    )
+
+
+def get_symbol(x):
+    raise NotImplementedError(
+        "get_symbol: use mxnet_tpu.symbol tracing instead (no nnvm graph on TPU)"
+    )
+
+
+class Function:
+    """User-defined differentiable function (reference autograd.py:388).
+
+    Subclass and implement ``forward`` / ``backward`` with ndarray ops::
+
+        class sigmoid(Function):
+            def forward(self, x): ...
+            def backward(self, dy): ...
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ops.dispatch import TapeNode
+
+        st = autograd_state
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, ndarray)
+        outs = (outputs,) if single else tuple(outputs)
+
+        if st.recording and st.tape is not None:
+            func = self
+
+            def vjp_fn(cotangents):
+                cts = (cotangents,) if single else cotangents
+                with pause():
+                    in_grads = func.backward(*[_wrap(c) for c in cts])
+                if isinstance(in_grads, ndarray):
+                    in_grads = (in_grads,)
+                return tuple(_unwrap(g) for g in in_grads)
+
+            nd_inputs = [a for a in inputs if isinstance(a, ndarray)]
+            node = TapeNode(
+                vjp_fn,
+                nd_inputs,
+                len(outs),
+                type(self).__name__,
+                out_avals=[(o.shape, o.dtype) for o in outs],
+            )
+            st.tape.add(node, outs)
+        return outputs

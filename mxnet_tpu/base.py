@@ -1,0 +1,139 @@
+"""Core shared plumbing: errors, dtype table, registries, env-var config.
+
+Capability parity notes (reference: Apache MXNet 2.0):
+- ``MXNetError`` mirrors the per-thread error surface of the C API
+  (reference ``src/c_api/c_api_error.cc``).
+- The dtype table mirrors mshadow's type enum (reference
+  ``3rdparty/mshadow/mshadow/base.h``) with bfloat16 promoted to a
+  first-class citizen because the MXU natively computes in bf16.
+- ``registry`` replicates the ``DMLC_REGISTRY``/``dmlc::Parameter``
+  pattern (reference ``3rdparty/dmlc-core``) used for optimizers,
+  initializers, kvstores and data iterators.
+- ``env_int``/``env_bool`` replicate the ~90 ``MXNET_*`` env vars read via
+  ``dmlc::GetEnv`` (reference ``docs/.../env_var.md``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as onp
+
+# int64/float64 tensors are first-class in the reference
+# (USE_INT64_TENSOR_SIZE, tests/nightly/test_large_array.py); enable the
+# wide types in XLA. Default dtype stays float32 — conversion handled in
+# ndarray.__init__ (mx.np's float64->float32 default-coercion semantics).
+jax.config.update("jax_enable_x64", True)
+
+# fp32 math must be fp32 (the reference computes fp32 on fp32 inputs; op
+# oracle tests compare against NumPy). Low-precision speed is an explicit
+# choice via bf16 dtypes / AMP, never an implicit downcast of f32 matmuls.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes
+
+    bfloat16 = onp.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    bfloat16 = onp.dtype("float32")
+
+__all__ = [
+    "MXNetError",
+    "bfloat16",
+    "DTYPE_MAP",
+    "dtype_from_any",
+    "registry",
+    "env_int",
+    "env_bool",
+    "env_str",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework-level error (parity with mxnet.base.MXNetError)."""
+
+
+# ---------------------------------------------------------------------------
+# dtype handling — mshadow's enum order kept for serialization parity
+# (reference 3rdparty/mshadow/mshadow/base.h kFloat32=0.. and
+#  python/mxnet/ndarray/ndarray.py _DTYPE_NP_TO_MX).
+# ---------------------------------------------------------------------------
+DTYPE_MAP: Dict[int, onp.dtype] = {
+    0: onp.dtype("float32"),
+    1: onp.dtype("float64"),
+    2: onp.dtype("float16"),
+    3: onp.dtype("uint8"),
+    4: onp.dtype("int32"),
+    5: onp.dtype("int8"),
+    6: onp.dtype("int64"),
+    7: onp.dtype("bool"),
+    8: onp.dtype("int16"),
+    9: onp.dtype("uint16"),
+    10: onp.dtype("uint32"),
+    11: onp.dtype("uint64"),
+    12: bfloat16,
+}
+DTYPE_TO_ID = {v: k for k, v in DTYPE_MAP.items()}
+
+
+def dtype_from_any(dtype: Any) -> onp.dtype:
+    if dtype is None:
+        return onp.dtype("float32")
+    if isinstance(dtype, int) and dtype in DTYPE_MAP:
+        return DTYPE_MAP[dtype]
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        return bfloat16
+    return onp.dtype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# generic string-keyed registry (the DMLC_REGISTRY equivalent)
+# ---------------------------------------------------------------------------
+class _Registry:
+    def __init__(self) -> None:
+        self._reg: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, kind: str, name: Optional[str] = None) -> Callable:
+        def _do(obj: Any) -> Any:
+            key = (name or getattr(obj, "__name__", str(obj))).lower()
+            with self._lock:
+                self._reg.setdefault(kind, {})[key] = obj
+            return obj
+
+        return _do
+
+    def get(self, kind: str, name: str) -> Any:
+        try:
+            return self._reg[kind][name.lower()]
+        except KeyError:
+            known = ", ".join(sorted(self._reg.get(kind, {})))
+            raise MXNetError(
+                f"Unknown {kind} {name!r}. Registered: {known}"
+            ) from None
+
+    def entries(self, kind: str) -> Dict[str, Any]:
+        return dict(self._reg.get(kind, {}))
+
+
+registry = _Registry()
+
+
+def env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int = 0) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() not in ("0", "false", "off", "")

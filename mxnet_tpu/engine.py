@@ -1,0 +1,72 @@
+"""Execution-engine contract.
+
+The reference dependency engine (``src/engine/``: ``ThreadedEngine``,
+``ThreadedEnginePerDevice``, ``NaiveEngine``) schedules every NDArray
+mutation asynchronously with read/write dependencies. On TPU, XLA's runtime
+*is* the async engine: jax dispatch enqueues work on per-device streams and
+returns immediately; data dependencies order execution; errors surface on
+``block_until_ready``. This module keeps the user-facing contract:
+
+- ``waitall()``  — reference ``Engine::WaitForAll`` / ``MXNDArrayWaitAll``
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` — synchronous deterministic mode for
+  debugging (reference ``src/engine/engine.cc:32`` factory), implemented by
+  blocking after every op.
+- ``set_bulk_size`` — op bulking (reference ``engine.h:315``); XLA fuses
+  within a jit trace so this is a tracing hint, kept for API parity.
+- async exception propagation — tested by
+  ``tests/python/unittest/test_exc_handling.py`` in the reference; jax
+  raises deferred XLA errors at the next sync point, same contract.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .base import env_str
+
+__all__ = ["waitall", "is_naive", "set_bulk_size", "bulk"]
+
+_bulk_size = 15  # reference default MXNET_ENGINE_BULK_SIZE
+
+
+def engine_type() -> str:
+    return env_str("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+
+def is_naive() -> bool:
+    return engine_type() == "NaiveEngine"
+
+
+def waitall() -> None:
+    """Block until all async device work is done; raises deferred errors."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    for d in jax.devices():
+        try:
+            jax.device_put(0, d).block_until_ready()
+        except Exception:
+            pass
+
+
+def maybe_sync(val) -> None:
+    """NaiveEngine mode: force synchronous execution after each op."""
+    if is_naive() and hasattr(val, "block_until_ready"):
+        val.block_until_ready()
+
+
+def set_bulk_size(size: int) -> int:
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,1 @@
+"""``mx.gluon`` — imperative-first model API (placeholder, filled in M3)."""

@@ -1,0 +1,239 @@
+"""Weight initializers (reference ``python/mxnet/initializer.py``).
+
+Registered by name like the reference's ``@register`` alias system, so
+``init='xavier'`` strings in user scripts resolve the same way. All draw
+from the functional PRNG via mx.np.random.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import registry, MXNetError, dtype_from_any
+from .ndarray.ndarray import ndarray, _wrap
+
+__all__ = [
+    "Initializer",
+    "Zero",
+    "One",
+    "Constant",
+    "Uniform",
+    "Normal",
+    "Orthogonal",
+    "Xavier",
+    "MSRAPrelu",
+    "Bilinear",
+    "LSTMBias",
+    "register",
+    "create",
+]
+
+
+def register(cls):
+    registry.register("initializer", cls.__name__)(cls)
+    return cls
+
+
+def create(init, **kwargs) -> "Initializer":
+    if init is None:
+        return Uniform(0.07)
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        return registry.get("initializer", init)(**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+class Initializer:
+    """Base initializer; subclasses implement ``_init_weight``."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr=None):
+        # legacy calling convention: init(name_or_desc, array)
+        if arr is None:
+            return
+        self.init_array(name if isinstance(name, str) else str(name), arr)
+
+    def init_array(self, name: str, arr: ndarray):
+        key = _next_key()
+        if name.endswith("bias") or "bias" in name:
+            arr._set_data(jnp.zeros(arr.shape, arr.dtype))
+        elif name.endswith("gamma"):
+            arr._set_data(jnp.ones(arr.shape, arr.dtype))
+        elif name.endswith("beta"):
+            arr._set_data(jnp.zeros(arr.shape, arr.dtype))
+        elif "running_mean" in name or "moving_mean" in name:
+            arr._set_data(jnp.zeros(arr.shape, arr.dtype))
+        elif "running_var" in name or "moving_var" in name:
+            arr._set_data(jnp.ones(arr.shape, arr.dtype))
+        else:
+            self._init_weight(name, arr, key)
+
+    def _init_weight(self, name, arr, key):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+def _next_key():
+    from .numpy import random as _random
+
+    return _random.new_key()
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr, key):
+        arr._set_data(jnp.zeros(arr.shape, arr.dtype))
+
+
+registry.register("initializer", "zeros")(Zero)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr, key):
+        arr._set_data(jnp.ones(arr.shape, arr.dtype))
+
+
+registry.register("initializer", "ones")(One)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr, key):
+        val = self.value
+        if isinstance(val, ndarray):
+            arr._set_data(val._data.astype(arr.dtype))
+        else:
+            arr._set_data(jnp.full(arr.shape, val, arr.dtype))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr, key):
+        arr._set_data(
+            jax.random.uniform(key, arr.shape, jnp.float32, -self.scale, self.scale).astype(arr.dtype)
+        )
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr, key):
+        arr._set_data((jax.random.normal(key, arr.shape, jnp.float32) * self.sigma).astype(arr.dtype))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr, key):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._set_data((self.scale * q.reshape(arr.shape)).astype(arr.dtype))
+
+
+@register
+class Xavier(Initializer):
+    """reference initializer.py Xavier (magnitude/factor_type semantics kept)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr, key):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(f"Xavier requires ndim>=2, got shape {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = float(onp.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("Incorrect factor type")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            val = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        elif self.rnd_type == "gaussian":
+            val = jax.random.normal(key, shape, jnp.float32) * scale
+        else:
+            raise MXNetError("Unknown random type")
+        arr._set_data(val.astype(arr.dtype))
+
+
+registry.register("initializer", "xavier")(Xavier)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He initialization (reference initializer.py MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        Xavier.__init__(self, "gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr, key):
+        shape = arr.shape
+        weight = onp.zeros(int(onp.prod(shape)), dtype="float32")
+        f = onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i / shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight.reshape(shape), arr.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr, key):
+        b = onp.zeros(arr.shape, dtype="float32")
+        num_hidden = int(arr.shape[0] / 4)
+        b[num_hidden : 2 * num_hidden] = self.forget_bias
+        arr._set_data(jnp.asarray(b, arr.dtype))

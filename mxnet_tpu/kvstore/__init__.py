@@ -1,0 +1,3 @@
+"""``mx.kv`` — KVStore (placeholder, filled in M8)."""
+def create(name="local"):
+    raise NotImplementedError("kvstore lands in a later milestone")

@@ -1,0 +1,114 @@
+"""``mx.np.linalg`` — linear algebra (reference ``python/mxnet/numpy/linalg.py``
+backed by ``src/operator/numpy/linalg/`` and the la_op family in
+``src/operator/tensor/la_op.cc``: potrf/gelqf/syrk/trmm/...).
+
+On TPU these lower to XLA's decomposition custom-calls; all remain
+autograd-recorded via apply_op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+from ..ops.dispatch import apply_op
+
+
+def _call(jfn, args, name, n_out=1):
+    def fn(*vals):
+        return jfn(*vals)
+
+    fn.__name__ = name
+    return apply_op(fn, args, name=name, n_out=n_out)
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _call(lambda v: jnp.linalg.norm(v, ord=ord, axis=axis, keepdims=keepdims), (x,), "norm")
+
+
+def inv(a):
+    return _call(jnp.linalg.inv, (a,), "inv")
+
+
+def pinv(a, rcond=1e-15):
+    return _call(lambda v: jnp.linalg.pinv(v, rcond=rcond), (a,), "pinv")
+
+
+def det(a):
+    return _call(jnp.linalg.det, (a,), "det")
+
+
+def slogdet(a):
+    return _call(lambda v: tuple(jnp.linalg.slogdet(v)), (a,), "slogdet", n_out=2)
+
+
+def matrix_rank(a, tol=None):
+    return _wrap(jnp.linalg.matrix_rank(_unwrap(a), tol=tol))
+
+
+def matrix_power(a, n):
+    return _call(lambda v: jnp.linalg.matrix_power(v, n), (a,), "matrix_power")
+
+
+def cholesky(a, upper=False):
+    if upper:
+        return _call(lambda v: jnp.swapaxes(jnp.linalg.cholesky(v), -1, -2), (a,), "cholesky")
+    return _call(jnp.linalg.cholesky, (a,), "cholesky")
+
+
+def qr(a, mode="reduced"):
+    return _call(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), (a,), "qr", n_out=2)
+
+
+def svd(a, full_matrices=False, compute_uv=True):
+    if not compute_uv:
+        return _call(lambda v: jnp.linalg.svd(v, compute_uv=False), (a,), "svdvals")
+    return _call(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), (a,), "svd", n_out=3
+    )
+
+
+def eig(a):
+    vals = jnp.linalg.eig(_unwrap(a))
+    return tuple(_wrap(v) for v in vals)
+
+
+def eigh(a, UPLO="L"):
+    return _call(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), (a,), "eigh", n_out=2)
+
+
+def eigvals(a):
+    return _wrap(jnp.linalg.eigvals(_unwrap(a)))
+
+
+def eigvalsh(a, UPLO="L"):
+    return _call(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), (a,), "eigvalsh")
+
+
+def solve(a, b):
+    return _call(jnp.linalg.solve, (a, b), "solve")
+
+
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond == "warn" else rcond
+    vals = jnp.linalg.lstsq(_unwrap(a), _unwrap(b), rcond=rc)
+    return tuple(_wrap(v) for v in vals)
+
+
+def tensorinv(a, ind=2):
+    return _call(lambda v: jnp.linalg.tensorinv(v, ind=ind), (a,), "tensorinv")
+
+
+def tensorsolve(a, b, axes=None):
+    return _call(lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes), (a, b), "tensorsolve")
+
+
+def multi_dot(arrays):
+    def fn(*vals):
+        return jnp.linalg.multi_dot(list(vals))
+
+    return apply_op(fn, list(arrays), name="multi_dot")
+
+
+def cond(x, p=None):
+    return _wrap(jnp.linalg.cond(_unwrap(x), p=p))

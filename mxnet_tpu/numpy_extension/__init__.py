@@ -1,0 +1,336 @@
+"""``mx.npx`` — operators beyond the NumPy standard (nn ops, control, util).
+
+Parity: reference ``python/mxnet/numpy_extension/`` which exposes the
+``src/operator/nn`` and indexing/sequence kernels to the np API. Every op
+dispatches through apply_op (autograd-recorded, trace-transparent) onto the
+pure jax implementations in :mod:`mxnet_tpu.ops.nn`.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import dtype_from_any
+from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+from ..ops import nn as _nn
+from ..ops.dispatch import apply_op, is_training
+from ..util import is_np_array, set_np, reset_np, use_np  # noqa: F401
+from ..context import cpu, gpu, tpu, num_gpus, num_tpus, current_context  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# RNG plumbing: eager ops draw fresh keys; traced (hybridized) code gets keys
+# from the enclosing trace scope so dropout is reproducible & functional.
+# ---------------------------------------------------------------------------
+class _KeyScope(threading.local):
+    def __init__(self):
+        self.supplier = None
+
+
+_key_scope = _KeyScope()
+
+
+@contextlib.contextmanager
+def rng_scope(supplier):
+    """Install a key supplier (callable -> PRNGKey) for the duration of a
+    trace; used by HybridBlock's cached-op tracing."""
+    prev = _key_scope.supplier
+    _key_scope.supplier = supplier
+    try:
+        yield
+    finally:
+        _key_scope.supplier = prev
+
+
+def _next_key():
+    if _key_scope.supplier is not None:
+        return _key_scope.supplier()
+    from ..numpy import random as _random
+
+    return _random.new_key()
+
+
+def _call(fn, arrays, static=None, name=None, n_out=1):
+    return apply_op(fn, arrays, static=static, n_out=n_out, name=name)
+
+
+# ---------------------------------------------------------------------------
+# nn ops
+# ---------------------------------------------------------------------------
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
+    args = (x, weight) if bias is None or no_bias else (x, weight, bias)
+    return _call(
+        lambda *a: _nn.fully_connected(*a, flatten=flatten),
+        args,
+        name="FullyConnected",
+    )
+
+
+def convolution(x, weight, bias=None, kernel=None, stride=1, dilate=1, pad=0,
+                num_filter=0, num_group=1, no_bias=False, layout="NCHW"):
+    static = dict(stride=stride, dilate=dilate, pad=pad, num_group=num_group, layout=layout)
+    if bias is None or no_bias:
+        return _call(lambda x_, w_: _nn.convolution(x_, w_, None, **static), (x, weight), name="Convolution")
+    return _call(lambda x_, w_, b_: _nn.convolution(x_, w_, b_, **static), (x, weight, bias), name="Convolution")
+
+
+def deconvolution(x, weight, bias=None, stride=1, dilate=1, pad=0, adj=0,
+                  num_filter=0, num_group=1, no_bias=False, layout="NCHW"):
+    static = dict(stride=stride, dilate=dilate, pad=pad, adj=adj, num_group=num_group, layout=layout)
+    if bias is None or no_bias:
+        return _call(lambda x_, w_: _nn.deconvolution(x_, w_, None, **static), (x, weight), name="Deconvolution")
+    return _call(lambda x_, w_, b_: _nn.deconvolution(x_, w_, b_, **static), (x, weight, bias), name="Deconvolution")
+
+
+def pooling(x, kernel=1, pool_type="max", stride=None, pad=0, global_pool=False,
+            count_include_pad=True, layout="NCHW", pooling_convention="valid"):
+    return _call(
+        lambda v: _nn.pooling(v, kernel, pool_type, stride, pad, global_pool, count_include_pad, layout),
+        (x,),
+        name="Pooling",
+    )
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5, momentum=0.9,
+               fix_gamma=False, use_global_stats=False, output_mean_var=False, axis=1):
+    """Functional batch_norm; updates running stats in-place on the passed
+    ndarrays when training (matching the reference's aux-state mutation)."""
+    training = is_training()
+    out, new_mean, new_var = _call(
+        lambda x_, g_, b_, m_, v_: _nn.batch_norm(
+            x_, g_, b_, m_, v_, eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+            use_global_stats=use_global_stats, training=training, axis=axis,
+        ),
+        (x, gamma, beta, running_mean, running_var),
+        name="BatchNorm",
+        n_out=3,
+    )
+    if training and not use_global_stats:
+        running_mean._set_data(_unwrap(new_mean))
+        running_var._set_data(_unwrap(new_var))
+    if output_mean_var:
+        return out, new_mean, new_var
+    return out
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    return _call(lambda x_, g_, b_: _nn.layer_norm(x_, g_, b_, axis=axis, eps=eps), (x, gamma, beta), name="LayerNorm")
+
+
+def group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
+    return _call(lambda x_, g_, b_: _nn.group_norm(x_, g_, b_, num_groups=num_groups, eps=eps), (x, gamma, beta), name="GroupNorm")
+
+
+def instance_norm(x, gamma, beta, eps=1e-5):
+    return _call(lambda x_, g_, b_: _nn.instance_norm(x_, g_, b_, eps=eps), (x, gamma, beta), name="InstanceNorm")
+
+
+def rms_norm(x, gamma, axis=-1, eps=1e-6):
+    return _call(lambda x_, g_: _nn.rms_norm(x_, g_, axis=axis, eps=eps), (x, gamma), name="RMSNorm")
+
+
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    return _call(lambda v: _nn.l2_normalization(v, eps=eps, mode=mode), (x,), name="L2Normalization")
+
+
+def activation(x, act_type="relu"):
+    return _call(lambda v: _nn.activation(v, act_type), (x,), name="Activation")
+
+
+def leaky_relu(x, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334):
+    key = _next_key() if act_type == "rrelu" and is_training() else None
+    training = is_training()
+    if act_type == "prelu":
+        return _call(
+            lambda v, g: _nn.leaky_relu(v, g, act_type=act_type, slope=slope),
+            (x, gamma),
+            name="LeakyReLU",
+        )
+    return _call(
+        lambda v: _nn.leaky_relu(v, None, act_type=act_type, slope=slope,
+                                 lower_bound=lower_bound, upper_bound=upper_bound,
+                                 key=key, training=training),
+        (x,),
+        name="LeakyReLU",
+    )
+
+
+def softmax(x, axis=-1, temperature=None, length=None):
+    if length is not None:
+        return _call(lambda v, l: _nn.softmax(v, axis=axis, temperature=temperature, length=l), (x, length), name="softmax")
+    return _call(lambda v: _nn.softmax(v, axis=axis, temperature=temperature), (x,), name="softmax")
+
+
+def log_softmax(x, axis=-1, temperature=None):
+    return _call(lambda v: _nn.log_softmax(v, axis=axis, temperature=temperature), (x,), name="log_softmax")
+
+
+def masked_softmax(x, mask, axis=-1, temperature=1.0):
+    return _call(lambda v, m: _nn.masked_softmax(v, m, axis=axis, temperature=temperature), (x, mask), name="masked_softmax")
+
+
+def masked_log_softmax(x, mask, axis=-1, temperature=1.0):
+    return _call(lambda v, m: _nn.masked_log_softmax(v, m, axis=axis, temperature=temperature), (x, mask), name="masked_log_softmax")
+
+
+def dropout(x, p=0.5, axes=None, mode="training"):
+    training = is_training() or mode == "always"
+    if not training or p <= 0:
+        return x
+    key = _next_key()
+    return _call(lambda v: _nn.dropout(v, p=p, key=key, training=True, axes=axes), (x,), name="Dropout")
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_grad=False):
+    return _call(lambda i, w: _nn.embedding(i, w), (data, weight), name="Embedding")
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _call(lambda i: _nn.one_hot(i, depth, on_value, off_value, dtype), (data,), name="one_hot")
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    return _call(lambda d, i: _nn.pick(d, i, axis=axis, keepdims=keepdims), (data, index), name="pick")
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    n_out = 2 if ret_typ == "both" else 1
+    return _call(
+        lambda d: _nn.topk(d, k=k, axis=axis, ret_typ=ret_typ, is_ascend=is_ascend, dtype=dtype),
+        (data,),
+        name="topk",
+        n_out=n_out,
+    )
+
+
+def gather_nd(data, indices):
+    return _call(lambda d, i: _nn.gather_nd(d, i), (data, indices), name="gather_nd")
+
+
+def scatter_nd(data, indices, shape):
+    return _call(lambda d, i: _nn.scatter_nd(d, i, shape), (data, indices), name="scatter_nd")
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if sequence_length is None:
+        return _call(lambda d: _nn.sequence_mask(d, None, use_sequence_length, value, axis), (data,), name="SequenceMask")
+    return _call(
+        lambda d, sl: _nn.sequence_mask(d, sl, use_sequence_length, value, axis),
+        (data, sequence_length),
+        name="SequenceMask",
+    )
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if sequence_length is None:
+        return _call(lambda d: _nn.sequence_last(d, None, use_sequence_length, axis), (data,), name="SequenceLast")
+    return _call(lambda d, sl: _nn.sequence_last(d, sl, use_sequence_length, axis), (data, sequence_length), name="SequenceLast")
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if sequence_length is None:
+        return _call(lambda d: _nn.sequence_reverse(d, None, use_sequence_length, axis), (data,), name="SequenceReverse")
+    return _call(lambda d, sl: _nn.sequence_reverse(d, sl, use_sequence_length, axis), (data, sequence_length), name="SequenceReverse")
+
+
+# ---------------------------------------------------------------------------
+# misc util ops
+# ---------------------------------------------------------------------------
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    def fn(d):
+        if axis is None:
+            n = 1
+            for s in d.shape:
+                n *= s
+            return (jnp.arange(n) * step + start).reshape(d.shape)
+        n = d.shape[axis]
+        return jnp.arange(n, dtype=jnp.float32) * step + start
+
+    return _call(fn, (data,), name="arange_like")
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    return _call(lambda a, b: jnp.broadcast_to(a, b.shape), (lhs, rhs), name="broadcast_like")
+
+
+def slice_like(data, shape_like, axes=()):
+    def fn(d, s):
+        slices = [slice(None)] * d.ndim
+        use = axes if axes else range(d.ndim)
+        for ax in use:
+            slices[ax] = slice(0, s.shape[ax])
+        return d[tuple(slices)]
+
+    return _call(fn, (data, shape_like), name="slice_like")
+
+
+def reshape_like(lhs, rhs):
+    return _call(lambda a, b: a.reshape(b.shape), (lhs, rhs), name="reshape_like")
+
+
+def shape_array(data):
+    return _wrap(jnp.asarray(onp.asarray(data.shape, onp.int64)))
+
+
+def waitall():
+    from .. import engine
+
+    engine.waitall()
+
+
+def load(fname):
+    from ..serialization import load as _load
+
+    return _load(fname)
+
+
+def save(fname, data):
+    from ..serialization import save as _save
+
+    return _save(fname, data)
+
+
+def sigmoid(x):
+    return _call(jax.nn.sigmoid, (x,), name="sigmoid")
+
+
+def relu(x):
+    return _call(jax.nn.relu, (x,), name="relu")
+
+
+def gelu(x, approximate=True):
+    return _call(lambda v: jax.nn.gelu(v, approximate=approximate), (x,), name="gelu")
+
+
+def erf(x):
+    return _call(jax.scipy.special.erf, (x,), name="erf")
+
+
+def erfinv(x):
+    return _call(jax.scipy.special.erfinv, (x,), name="erfinv")
+
+
+def gamma(x):
+    return _call(jax.scipy.special.gamma, (x,), name="gamma")
+
+
+def gammaln(x):
+    return _call(jax.scipy.special.gammaln, (x,), name="gammaln")
+
+
+def index_add(data, indices, values):
+    return _call(lambda d, i, v: d.at[tuple(i.astype(jnp.int32))].add(v), (data, indices, values), name="index_add")
+
+
+def index_update(data, indices, values):
+    return _call(lambda d, i, v: d.at[tuple(i.astype(jnp.int32))].set(v), (data, indices, values), name="index_update")
+
+
+# control-flow ops (reference src/operator/control_flow.cc foreach/while_loop/cond)
+from .control_flow import foreach, while_loop, cond  # noqa: E402,F401
+
+from . import random  # noqa: E402,F401

@@ -1,0 +1,128 @@
+"""Control-flow ops (reference ``src/operator/control_flow.cc``:
+``foreach :475``, ``while_loop :486``, ``cond``).
+
+On TPU these map to XLA structured control flow — ``lax.scan`` /
+``lax.while_loop`` / ``lax.cond`` — which is exactly what the reference's
+subgraph ops emulate in the interpreter. Bodies are traced once; they must
+be shape-stable (XLA semantics, same restriction the reference documents
+for hybridized control flow).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+from ..ops.dispatch import apply_op
+
+
+def _flatten(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan ``body(item, states) -> (out, new_states)`` over axis 0 of data
+    (reference foreach op). Lowers to lax.scan (one compiled loop body)."""
+    data_list = _flatten(data)
+    states_list = _flatten(init_states)
+    n_data = len(data_list)
+
+    def scan_fn(carry, xs):
+        state_nd = [_wrap(c) for c in carry]
+        xs_nd = [_wrap(x) for x in xs]
+        out, new_states = body(
+            xs_nd[0] if n_data == 1 else xs_nd,
+            state_nd[0] if len(state_nd) == 1 else state_nd,
+        )
+        outs = tuple(_unwrap(o) for o in _flatten(out))
+        news = tuple(_unwrap(s) for s in _flatten(new_states))
+        return news, outs
+
+    def fn(*vals):
+        d_vals = vals[:n_data]
+        s_vals = vals[n_data:]
+        final_states, stacked = lax.scan(scan_fn, tuple(s_vals), tuple(d_vals))
+        return tuple(stacked) + tuple(final_states)
+
+    all_inputs = data_list + states_list
+    n_outs_probe = None
+    # probe structure eagerly-free: run body once abstractly via jax.eval_shape
+    shapes = jax.eval_shape(fn, *[jnp.asarray(_unwrap(a)) for a in all_inputs])
+    n_total = len(shapes)
+    outs = apply_op(fn, all_inputs, n_out=n_total, name="foreach")
+    n_out = n_total - len(states_list)
+    out_arrays = list(outs[:n_out])
+    state_arrays = list(outs[n_out:])
+    return (
+        out_arrays[0] if n_out == 1 else out_arrays,
+        state_arrays[0] if len(state_arrays) == 1 else state_arrays,
+    )
+
+
+def while_loop(cond: Callable, func: Callable, loop_vars, max_iterations: int):
+    """reference while_loop op — bounded loop with stacked outputs.
+
+    Like the reference, outputs are padded to ``max_iterations`` (XLA needs
+    static shapes); returns (stacked_outputs, final_loop_vars)."""
+    vars_list = _flatten(loop_vars)
+    n_vars = len(vars_list)
+
+    def fn(*vals):
+        def body_fn(carry):
+            i, vs, acc = carry
+            vs_nd = [_wrap(v) for v in vs]
+            out, new_vars = func(*vs_nd)
+            outs = tuple(_unwrap(o) for o in _flatten(out))
+            new_vs = tuple(_unwrap(v) for v in _flatten(new_vars))
+            acc = tuple(a.at[i].set(o) for a, o in zip(acc, outs))
+            return (i + 1, new_vs, acc)
+
+        def cond_fn(carry):
+            i, vs, _ = carry
+            vs_nd = [_wrap(v) for v in vs]
+            c = cond(*vs_nd)
+            return jnp.logical_and(i < max_iterations, jnp.squeeze(_unwrap(c)).astype(bool))
+
+        out_shapes = jax.eval_shape(
+            lambda *vs: tuple(_unwrap(o) for o in _flatten(func(*[_wrap(v) for v in vs])[0])),
+            *vals,
+        )
+        acc0 = tuple(jnp.zeros((max_iterations,) + s.shape, s.dtype) for s in out_shapes)
+        n_iter, final_vars, acc = lax.while_loop(cond_fn, body_fn, (0, tuple(vals), acc0))
+        return tuple(acc) + tuple(final_vars)
+
+    shapes = jax.eval_shape(fn, *[jnp.asarray(_unwrap(a)) for a in vars_list])
+    outs = apply_op(fn, vars_list, n_out=len(shapes), name="while_loop")
+    n_out = len(shapes) - n_vars
+    out_arrays = list(outs[:n_out])
+    var_arrays = list(outs[n_out:])
+    return (
+        out_arrays[0] if n_out == 1 else out_arrays,
+        var_arrays[0] if n_vars == 1 else var_arrays,
+    )
+
+
+def cond(pred: Callable, then_func: Callable, else_func: Callable, inputs):
+    """reference cond op → lax.cond."""
+    inputs_list = _flatten(inputs)
+
+    def fn(*vals):
+        nd = [_wrap(v) for v in vals]
+        p = jnp.squeeze(_unwrap(pred(*nd))).astype(bool)
+
+        def then_branch(vs):
+            return tuple(_unwrap(o) for o in _flatten(then_func(*[_wrap(v) for v in vs])))
+
+        def else_branch(vs):
+            return tuple(_unwrap(o) for o in _flatten(else_func(*[_wrap(v) for v in vs])))
+
+        return lax.cond(p, then_branch, else_branch, tuple(vals))
+
+    shapes = jax.eval_shape(fn, *[jnp.asarray(_unwrap(a)) for a in inputs_list])
+    outs = apply_op(fn, inputs_list, n_out=len(shapes), name="cond")
+    return outs[0] if len(shapes) == 1 else list(outs)

@@ -1,0 +1,3 @@
+"""``mx.npx.random`` — re-export of the np RNG (reference parity alias)."""
+from ..numpy.random import *  # noqa: F401,F403
+from ..numpy.random import seed, new_key  # noqa: F401

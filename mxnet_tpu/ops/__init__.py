@@ -1,0 +1,2 @@
+"""Operator layer: dispatch/tape plus TPU kernels (Pallas) for hot ops."""
+from .dispatch import apply_op, autograd_state, is_recording, is_training  # noqa: F401

@@ -1,0 +1,275 @@
+"""Imperative op dispatch + autograd tape.
+
+This is the TPU-native re-design of the reference imperative runtime
+(``src/imperative/imperative.cc``: ``Imperative::Invoke :98``,
+``RecordOp :204``, ``Backward :376``) re-thought for XLA:
+
+- Every eager op is a *pure jax function* ``fn(*arrays, **static)``.
+  Dispatch unwraps ``ndarray`` inputs, calls the function (XLA executes it
+  asynchronously — jax's dispatch gives us the reference engine's
+  "frontend thread never blocks" contract for free), and wraps outputs.
+- Under ``autograd.record()`` we additionally compute ``jax.vjp`` at call
+  time, so the tape stores a ready-made pullback per node; ``Backward``
+  is then a single reverse sweep with no graph re-execution (the reference
+  builds a backward nnvm graph and re-runs it through the engine; on TPU
+  the pullback closure holding XLA residual buffers is the better design).
+- Ops stay trace-transparent: ``ndarray`` can hold jax tracers, so the same
+  eager op implementations are reused when a HybridBlock is jit-traced
+  (the CachedOp path) — one op library, two execution modes, exactly the
+  imperative/symbolic duality of the reference.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..base import MXNetError
+
+__all__ = ["apply_op", "Tape", "autograd_state", "is_recording", "is_training"]
+
+
+class _AutogradState(threading.local):
+    """Per-thread recording/training flags (Imperative::set_is_recording /
+    set_is_training, reference include/mxnet/imperative.h:150-170)."""
+
+    def __init__(self) -> None:
+        self.recording = False
+        self.training = False
+        self.tape: Optional["Tape"] = None
+
+
+autograd_state = _AutogradState()
+
+import os as _os
+
+_NAIVE = _os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+
+def is_recording() -> bool:
+    return autograd_state.recording
+
+
+def is_training() -> bool:
+    return autograd_state.training
+
+
+class TapeNode:
+    """One recorded op: pullback + graph edges (reference AGInfo,
+    include/mxnet/imperative.h:54)."""
+
+    __slots__ = (
+        "vjp_fn",
+        "replay_fn",
+        "inputs",
+        "n_out",
+        "out_ids",
+        "out_avals",
+        "name",
+        "req_grad",
+    )
+
+    def __init__(self, vjp_fn, inputs, n_out, name, out_avals=(), replay_fn=None):
+        self.vjp_fn = vjp_fn
+        self.replay_fn = replay_fn  # pure fn(*input_vals) for higher-order replay
+        self.inputs = inputs  # list of ndarray refs (keeps leaves alive)
+        self.n_out = n_out
+        self.out_ids: List[int] = []
+        self.out_avals = out_avals  # [(shape, dtype)] for zero cotangents
+        self.name = name
+        self.req_grad = True
+
+
+class Tape:
+    """The dynamic autograd graph built by recording (the RecordOp tape)."""
+
+    def __init__(self) -> None:
+        self.nodes: List[TapeNode] = []
+        # id(ndarray) -> (node_index, output_slot)
+        self.producer: dict = {}
+
+    def add(self, node: TapeNode, outputs: Sequence[Any]) -> None:
+        idx = len(self.nodes)
+        self.nodes.append(node)
+        for slot, out in enumerate(outputs):
+            node.out_ids.append(id(out))
+            self.producer[id(out)] = (idx, slot)
+            out._fresh_grad_node = (idx, slot)
+
+
+def _differentiable(arr) -> bool:
+    """Only float arrays participate in grad flow (XLA vjp requirement)."""
+    import numpy as onp
+
+    return onp.issubdtype(onp.dtype(arr.dtype), onp.floating) or str(
+        arr.dtype
+    ) == "bfloat16"
+
+
+def apply_op(
+    fn: Callable,
+    arrays: Sequence[Any],
+    static: Optional[dict] = None,
+    n_out: int = 1,
+    name: Optional[str] = None,
+):
+    """Invoke one eager op (the Imperative::Invoke equivalent).
+
+    ``arrays`` are ndarray/array-like positional inputs; ``static`` are
+    non-differentiable keyword attributes (the op's dmlc::Parameter set).
+    """
+    from .. import profiler as _profiler
+
+    if _profiler.is_running():
+        import time as _time
+
+        _t0 = _time.perf_counter()
+        try:
+            return _apply_op(fn, arrays, static, n_out, name)
+        finally:
+            _profiler.record_op(
+                name or getattr(fn, "__name__", "op"), _time.perf_counter() - _t0
+            )
+    return _apply_op(fn, arrays, static, n_out, name)
+
+
+def _apply_op(
+    fn: Callable,
+    arrays: Sequence[Any],
+    static: Optional[dict] = None,
+    n_out: int = 1,
+    name: Optional[str] = None,
+):
+    from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+
+    vals = [_unwrap(a) for a in arrays]
+    call = functools.partial(fn, **static) if static else fn
+
+    state = autograd_state
+    record = state.recording and state.tape is not None
+    if record:
+        grad_inputs = [
+            i
+            for i, a in enumerate(arrays)
+            if isinstance(a, ndarray) and _differentiable(a) and _tracks_grad(a, state.tape)
+        ]
+        record = bool(grad_inputs)
+
+    if not record:
+        out_vals = call(*vals)
+        if _NAIVE and hasattr(out_vals, "block_until_ready"):
+            out_vals.block_until_ready()  # MXNET_ENGINE_TYPE=NaiveEngine
+        if n_out == 1:
+            return _wrap(out_vals)
+        return tuple(_wrap(v) for v in out_vals)
+
+    # recording: single forward via jax.vjp; pullback closes over residuals
+    def fwd(*diff_vals):
+        full = list(vals)
+        for i, v in zip(grad_inputs, diff_vals):
+            full[i] = v
+        return call(*full)
+
+    out_vals, vjp_fn = jax.vjp(fwd, *[vals[i] for i in grad_inputs])
+    outs = (
+        (_wrap(out_vals),) if n_out == 1 else tuple(_wrap(v) for v in out_vals)
+    )
+    node = TapeNode(
+        vjp_fn,
+        [arrays[i] for i in grad_inputs],
+        n_out,
+        name or getattr(fn, "__name__", "op"),
+        out_avals=[(o.shape, o.dtype) for o in outs],
+        replay_fn=fwd,
+    )
+    state.tape.add(node, outs)
+    return outs[0] if n_out == 1 else outs
+
+
+def _tracks_grad(arr, tape: Tape) -> bool:
+    """True if ``arr`` is a grad leaf or was produced on the current tape."""
+    if getattr(arr, "_grad_req", "null") != "null" and arr._grad is not None:
+        return True
+    return id(arr) in tape.producer
+
+
+def backward(
+    heads: Sequence[Any],
+    head_grads: Optional[Sequence[Any]] = None,
+    retain_graph: bool = False,
+    train_mode: bool = True,
+):
+    """Reverse sweep over the tape (Imperative::Backward,
+    reference src/imperative/imperative.cc:376).
+
+    Accumulates into each leaf's ``.grad`` honoring its ``grad_req``
+    (write/add/null — reference OpReqType, include/mxnet/op_attr_types.h).
+    """
+    import jax.numpy as jnp
+
+    from ..ndarray.ndarray import ndarray, _unwrap
+
+    tape = autograd_state.tape
+    if tape is None:
+        raise MXNetError("backward called outside autograd.record scope with no tape")
+
+    # cotangent storage per (node_idx, slot)
+    cots: dict = {}
+    leaf_grads: dict = {}  # id(leaf ndarray) -> accumulated cotangent
+
+    def _route(arr, ct):
+        key = id(arr)
+        if key in tape.producer:
+            cots_key = tape.producer[key]
+            prev = cots.get(cots_key)
+            cots[cots_key] = ct if prev is None else prev + ct
+        if getattr(arr, "_grad_req", "null") != "null" and arr._grad is not None:
+            prev = leaf_grads.get(key)
+            leaf_grads[key] = ct if prev is None else prev + ct
+            leaf_grads.setdefault(("arr", key), arr)
+
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    pending_nodes = set()
+    for h, hg in zip(heads, head_grads):
+        if id(h) not in tape.producer and getattr(h, "_grad_req", "null") == "null":
+            raise MXNetError("cannot differentiate a head not on the tape")
+        ct = jnp.ones(h.shape, h.dtype) if hg is None else _unwrap(hg)
+        _route(h, ct)
+        if id(h) in tape.producer:
+            pending_nodes.add(tape.producer[id(h)][0])
+
+    # reverse topological sweep — tape order is already topological
+    for idx in range(len(tape.nodes) - 1, -1, -1):
+        node = tape.nodes[idx]
+        slots = [cots.get((idx, s)) for s in range(node.n_out)]
+        if all(s is None for s in slots):
+            continue
+        full = tuple(
+            s
+            if s is not None
+            else jnp.zeros(node.out_avals[i][0], node.out_avals[i][1])
+            for i, s in enumerate(slots)
+        )
+        in_cts = node.vjp_fn(full[0] if node.n_out == 1 else full)
+        for arr, ct in zip(node.inputs, in_cts):
+            _route(arr, ct)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals eagerly
+            node.replay_fn = None
+
+    # write leaf grads honoring grad_req
+    for key, ct in list(leaf_grads.items()):
+        if isinstance(key, tuple):
+            continue
+        arr = leaf_grads[("arr", key)]
+        if arr._grad_req == "add":
+            arr._grad._data = arr._grad._data + ct.astype(arr._grad.dtype)
+        else:  # write
+            arr._grad._data = ct.astype(arr._grad.dtype)
+
+    if not retain_graph:
+        tape.nodes.clear()
+        tape.producer.clear()

@@ -1,0 +1,510 @@
+"""Neural-net ops as pure jax functions.
+
+TPU-native re-design of the reference kernel library ``src/operator/nn/``
+(Convolution ``convolution.cc:402``, FullyConnected, BatchNorm, LayerNorm,
+Pooling, Softmax, Dropout, ...). Each function here is pure and
+trace-transparent: it is wrapped once by ``apply_op`` for the eager/autograd
+path (mxnet_tpu.numpy_extension) and reused verbatim inside jit traces (the
+hybridize path), so there is exactly one implementation per op — the
+reference needs 3 (CPU, cuDNN, MKLDNN); XLA is all three here.
+
+Layouts: the API default is NCHW for parity with the reference, but the
+convolution lowers through ``lax.conv_general_dilated`` with explicit
+dimension_numbers so XLA is free to pick MXU-friendly internal layouts.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IntOrTuple = Union[int, Tuple[int, ...]]
+
+
+def _tuple(v: IntOrTuple, n: int) -> Tuple[int, ...]:
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    return t if len(t) == n else t + (t[-1],) * (n - len(t))
+
+
+# ---------------------------------------------------------------------------
+# dense / matmul
+# ---------------------------------------------------------------------------
+def fully_connected(x, weight, bias=None, num_hidden=None, flatten=True, no_bias=False):
+    """y = x @ W^T + b (reference src/operator/nn/fully_connected.cc)."""
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+def convolution(
+    x,
+    weight,
+    bias=None,
+    kernel=None,
+    stride=1,
+    dilate=1,
+    pad=0,
+    num_group=1,
+    layout="NCHW",
+):
+    """N-D convolution (reference src/operator/nn/convolution.cc:402).
+
+    weight layout: OIHW (out_ch, in_ch/groups, *kernel) for NCHW input —
+    the reference's native layout; lax handles the MXU mapping.
+    """
+    ndim = x.ndim - 2
+    stride = _tuple(stride, ndim)
+    dilate = _tuple(dilate, ndim)
+    pad = _tuple(pad, ndim)
+    if layout in ("NCHW", "NCW", "NCDHW"):
+        spatial = "".join("WHD"[i] for i in range(ndim))[::-1] if ndim > 1 else "W"
+        spec = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    elif layout in ("NHWC", "NWC", "NDHWC"):
+        spatial = {1: "W", 2: "HW", 3: "DHW"}[ndim]
+        spec = ("N" + spatial + "C", "O" + spatial + "I", "N" + spatial + "C")
+    else:
+        raise ValueError(f"unsupported layout {layout}")
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, spec)
+    y = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    if y.dtype != x.dtype:
+        y = y.astype(x.dtype)
+    if bias is not None:
+        if layout.startswith("NC"):
+            y = y + bias.reshape((1, -1) + (1,) * ndim)
+        else:
+            y = y + bias
+    return y
+
+
+def deconvolution(
+    x, weight, bias=None, stride=1, dilate=1, pad=0, adj=0, num_group=1, layout="NCHW"
+):
+    """Transposed convolution (reference src/operator/nn/deconvolution.cc).
+    weight layout IOHW (in_ch, out_ch/groups, *kernel) like the reference."""
+    ndim = x.ndim - 2
+    stride = _tuple(stride, ndim)
+    pad = _tuple(pad, ndim)
+    adj = _tuple(adj, ndim)
+    dilate = _tuple(dilate, ndim)
+    if num_group != 1:
+        xs = jnp.split(x, num_group, axis=1)
+        ws = jnp.split(weight, num_group, axis=0)
+        outs = [
+            deconvolution(xg, wg, None, stride, dilate, pad, adj, 1, layout)
+            for xg, wg in zip(xs, ws)
+        ]
+        y = jnp.concatenate(outs, axis=1)
+    else:
+        kernel = weight.shape[2:]
+        # lax.conv_transpose with IOHW spec
+        dn = lax.conv_dimension_numbers(
+            x.shape, (weight.shape[1], weight.shape[0]) + kernel, ("NC" + "HW"[:ndim] if ndim == 2 else "NC" + "W", "OI" + ("HW" if ndim == 2 else "W"), "NC" + ("HW" if ndim == 2 else "W"))
+        )
+        # padding for transpose conv: effective = k - 1 - pad
+        pads = [
+            (d * (k - 1) - p, d * (k - 1) - p + a)
+            for k, p, a, d in zip(kernel, pad, adj, dilate)
+        ]
+        y = lax.conv_general_dilated(
+            x,
+            jnp.swapaxes(weight, 0, 1),
+            window_strides=(1,) * ndim,
+            padding=pads,
+            lhs_dilation=stride,
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            transpose_kernel=True,
+        )
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * ndim)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+def pooling(
+    x,
+    kernel=1,
+    pool_type="max",
+    stride=None,
+    pad=0,
+    global_pool=False,
+    count_include_pad=True,
+    layout="NCHW",
+):
+    """Pooling (reference src/operator/nn/pooling.cc)."""
+    ndim = x.ndim - 2
+    if layout.startswith("NC"):
+        sp_axes = tuple(range(2, 2 + ndim))
+    else:
+        sp_axes = tuple(range(1, 1 + ndim))
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(x, axis=sp_axes, keepdims=True)
+        return jnp.mean(x, axis=sp_axes, keepdims=True)
+    kernel = _tuple(kernel, ndim)
+    stride = _tuple(stride if stride is not None else kernel, ndim)
+    pad = _tuple(pad, ndim)
+
+    if layout.startswith("NC"):
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    else:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.array(init, x.dtype), lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(x, jnp.array(0, x.dtype), lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = functools.reduce(lambda a, b: a * b, kernel)
+            return summed / jnp.asarray(denom, x.dtype)
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, jnp.array(0, x.dtype), lax.add, window, strides, pads)
+        return summed / counts
+    if pool_type == "lp":
+        p2 = lax.reduce_window(jnp.abs(x) ** 2, jnp.array(0, x.dtype), lax.add, window, strides, pads)
+        return jnp.sqrt(p2)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def adaptive_avg_pool2d(x, output_size):
+    """reference src/operator/contrib/adaptive_avg_pooling.cc"""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    return x.mean(axis=(3, 5))
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def batch_norm(
+    x,
+    gamma,
+    beta,
+    moving_mean,
+    moving_var,
+    eps=1e-5,
+    momentum=0.9,
+    fix_gamma=False,
+    use_global_stats=False,
+    training=True,
+    axis=1,
+):
+    """BatchNorm (reference src/operator/nn/batch_norm.cc). Returns
+    (out, new_moving_mean, new_moving_var); the caller owns running-stat
+    state (functional design — no hidden mutation inside the op)."""
+    red_axes = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if training and not use_global_stats:
+        mean = jnp.mean(x.astype(jnp.float32), axis=red_axes)
+        var = jnp.var(x.astype(jnp.float32), axis=red_axes)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps).astype(x.dtype)
+    out = (x - mean.reshape(bshape).astype(x.dtype)) * inv.reshape(bshape)
+    out = out * gamma.reshape(bshape).astype(x.dtype) + beta.reshape(bshape).astype(x.dtype)
+    return out, new_mean, new_var
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    """LayerNorm (reference src/operator/nn/layer_norm.cc)."""
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+def group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
+    """GroupNorm over NCHW (reference src/operator/nn/group_norm.cc)."""
+    n, c = x.shape[:2]
+    orig = x.shape
+    xg = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    x = xg.reshape(orig)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+def instance_norm(x, gamma, beta, eps=1e-5):
+    """InstanceNorm (reference src/operator/instance_norm.cc)."""
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+def rms_norm(x, gamma, axis=-1, eps=1e-6):
+    """RMSNorm — modern-transformer extension (no reference counterpart)."""
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    out = x * lax.rsqrt(ms + eps).astype(x.dtype)
+    return out * gamma
+
+
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+# ---------------------------------------------------------------------------
+# activations / softmax
+# ---------------------------------------------------------------------------
+def activation(x, act_type="relu"):
+    """reference src/operator/nn/activation.cc"""
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    if act_type == "log_sigmoid":
+        return jax.nn.log_sigmoid(x)
+    if act_type == "mish":
+        return x * jnp.tanh(jax.nn.softplus(x))
+    if act_type in ("silu", "swish"):
+        return jax.nn.silu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown activation {act_type}")
+
+
+def leaky_relu(x, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334, key=None, training=True):
+    """reference src/operator/leaky_relu.cc (leaky/prelu/elu/selu/gelu/rrelu)."""
+    if act_type == "leaky":
+        return jnp.where(x >= 0, x, slope * x)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < x.ndim and g.ndim == 1:
+            g = g.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x >= 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x >= 0, x, slope * (jnp.exp(x) - 1))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "rrelu":
+        if training and key is not None:
+            u = jax.random.uniform(key, x.shape, jnp.float32, lower_bound, upper_bound).astype(x.dtype)
+        else:
+            u = jnp.asarray((lower_bound + upper_bound) / 2.0, x.dtype)
+        return jnp.where(x >= 0, x, u * x)
+    raise ValueError(f"unknown leaky_relu type {act_type}")
+
+
+def softmax(x, axis=-1, temperature=None, length=None):
+    """reference src/operator/nn/softmax.cc (with optional length masking)."""
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        mask = jnp.arange(x.shape[axis]) < jnp.expand_dims(length, -1)
+        shape = [1] * x.ndim
+        shape[0] = x.shape[0]
+        shape[axis] = x.shape[axis]
+        mask = mask.reshape(shape)
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def masked_softmax(x, mask, axis=-1, temperature=1.0):
+    x = x / temperature
+    neg = jnp.asarray(jnp.finfo(x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32).min, x.dtype)
+    masked = jnp.where(mask, x, neg)
+    out = jax.nn.softmax(masked, axis=axis)
+    return jnp.where(mask, out, 0.0)
+
+
+def masked_log_softmax(x, mask, axis=-1, temperature=1.0):
+    x = x / temperature
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, x.dtype)
+    masked = jnp.where(mask, x, neg)
+    out = jax.nn.log_softmax(masked, axis=axis)
+    return jnp.where(mask, out, -jnp.inf)
+
+
+def softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+def dropout(x, p=0.5, key=None, training=True, axes=None, mode="training"):
+    """reference src/operator/nn/dropout.cc"""
+    if not training or p <= 0 or key is None:
+        return x
+    shape = list(x.shape)
+    if axes:
+        for ax in range(len(shape)):
+            if ax not in axes:
+                shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+# ---------------------------------------------------------------------------
+# embedding / indexing ops
+# ---------------------------------------------------------------------------
+def embedding(indices, weight, sparse_grad=False):
+    """reference src/operator/tensor/indexing_op.cc (Embedding)."""
+    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype)) * (on_value - off_value) + off_value
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """reference src/operator/tensor/broadcast_reduce_op_index.cc pick"""
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """reference src/operator/tensor/ordering_op.cc"""
+    src = -data if not is_ascend else data
+    moved = jnp.moveaxis(src, axis, -1)
+    vals, idxs = lax.top_k(-moved if is_ascend else moved, k)
+    if is_ascend:
+        moved_v = jnp.moveaxis(data, axis, -1)
+        idxs = jnp.argsort(moved_v, axis=-1)[..., :k]
+        vals = jnp.take_along_axis(moved_v, idxs, axis=-1)
+    else:
+        vals = jnp.take_along_axis(jnp.moveaxis(data, axis, -1), idxs, axis=-1)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis)
+    if ret_typ == "indices":
+        return idxs.astype(jnp.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs.astype(jnp.dtype(dtype))
+    if ret_typ == "mask":
+        mask = jnp.zeros(jnp.moveaxis(data, axis, -1).shape, jnp.int32)
+        mask = mask.at[..., :1].set(0)  # placeholder; mask built below
+        oh = jax.nn.one_hot(jnp.moveaxis(idxs, axis, -1), data.shape[axis], dtype=jnp.int32).sum(-2)
+        return jnp.moveaxis(oh, -1, axis)
+    raise ValueError(ret_typ)
+
+
+def gather_nd(data, indices):
+    """reference src/operator/tensor/indexing_op.cc gather_nd"""
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+def scatter_nd(data, indices, shape):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[idx].add(data)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    if axis == 0:
+        mask = steps[:, None] < sequence_length[None, :]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:  # axis == 1
+        mask = steps[None, :] < sequence_length[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, -1, axis=axis)
+    idx = (sequence_length - 1).astype(jnp.int32)
+    if axis == 0:
+        batch = jnp.arange(data.shape[1])
+        return data[idx, batch]
+    batch = jnp.arange(data.shape[0])
+    return data[batch, idx]
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    # reverse only the first seq_len elements per batch (axis=0 layout TNC)
+    rev_idx = jnp.where(
+        steps[:, None] < sequence_length[None, :],
+        sequence_length[None, :] - 1 - steps[:, None],
+        steps[:, None],
+    ).astype(jnp.int32)
+    batch = jnp.arange(data.shape[1])[None, :]
+    return data[rev_idx, batch]

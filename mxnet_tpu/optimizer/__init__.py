@@ -1,0 +1,32 @@
+"""``mx.optimizer`` — optimizer registry and zoo."""
+from .optimizer import (  # noqa: F401
+    SGD,
+    NAG,
+    LAMB,
+    LARS,
+    FTML,
+    Ftrl,
+    Adam,
+    AdamW,
+    Adamax,
+    Nadam,
+    AdaGrad,
+    AdaDelta,
+    RMSProp,
+    Signum,
+    SGLD,
+    DCASGD,
+    Optimizer,
+    Updater,
+    create,
+    get_updater,
+    register,
+)
+from . import lr_scheduler  # noqa: F401
+from .lr_scheduler import (  # noqa: F401
+    CosineScheduler,
+    FactorScheduler,
+    LRScheduler,
+    MultiFactorScheduler,
+    PolyScheduler,
+)

@@ -1,0 +1,621 @@
+"""Optimizer zoo.
+
+Parity: reference ``python/mxnet/optimizer/`` (20 optimizers, registry at
+``optimizer.py:140``, ``create_state :208``, multi-precision ``:229``) whose
+hot paths are fused C++ update kernels (``src/operator/optimizer_op.cc``,
+``contrib/multi_lamb.cc``). TPU-native design: every update rule is a pure
+jax function ``(weight, grad, *state) -> (new_weight, *new_state)`` so the
+Trainer can jit the whole multi-tensor update as one XLA program (the
+equivalent of the reference's fused/aggregated update kernels, but fused by
+the compiler instead of hand-written CUDA).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError, registry
+from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+
+__all__ = ["Optimizer", "register", "create", "Updater", "get_updater"]
+
+
+def register(klass):
+    registry.register("optimizer", klass.__name__)(klass)
+    return klass
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    return registry.get("optimizer", name)(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference python/mxnet/optimizer/optimizer.py:29).
+
+    State is a tuple of jax arrays per parameter index. ``update_step`` is
+    the pure rule; ``update`` keeps the reference's imperative signature.
+    """
+
+    def __init__(
+        self,
+        rescale_grad=1.0,
+        param_idx2name=None,
+        wd=0.0,
+        clip_gradient=None,
+        learning_rate=None,
+        lr_scheduler=None,
+        multi_precision=False,
+        param_dict=None,
+        aggregate_num=None,
+        use_fused_step=None,
+        **kwargs,
+    ):
+        self.rescale_grad = rescale_grad
+        self.lr = 0.01 if learning_rate is None else learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = 0
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult: Dict[str, float] = {}
+        self.wd_mult: Dict[str, float] = {}
+        self._kwargs = kwargs
+
+    # -- scheduling --------------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("cannot set lr directly when lr_scheduler is set")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = 0
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update, self._index_update_count[index])
+
+    def _get_lr(self, index) -> float:
+        lr = self.learning_rate
+        param = self.param_dict.get(index)
+        if param is not None and getattr(param, "lr_mult", None) is not None:
+            lr *= param.lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        param = self.param_dict.get(index)
+        if param is not None and getattr(param, "wd_mult", None) is not None:
+            wd *= param.wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight) -> Tuple:
+        return ()
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for low-precision weights (reference :229)."""
+        if self.multi_precision and weight.dtype in (onp.float16, jnp.bfloat16):
+            master = _unwrap(weight).astype(jnp.float32)
+            return (master, self.create_state(index, _wrap(master)))
+        return self.create_state(index, weight)
+
+    # -- the pure rule (override me) ---------------------------------------
+    def update_step(self, weight, grad, state: Tuple, lr, wd, t: int) -> Tuple:
+        raise NotImplementedError
+
+    def _preprocess_grad(self, grad):
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = jnp.clip(grad, -self.clip_gradient, self.clip_gradient)
+        return grad
+
+    # -- imperative API (reference signature) ------------------------------
+    def update(self, index, weight, grad, state):
+        indices = index if isinstance(index, (list, tuple)) else [index]
+        weights = weight if isinstance(weight, (list, tuple)) else [weight]
+        grads = grad if isinstance(grad, (list, tuple)) else [grad]
+        states = state if isinstance(state, (list, tuple)) and isinstance(index, (list, tuple)) else [state]
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self._update_count(i)
+            lr, wd = self._get_lr(i), self._get_wd(i)
+            t = self._index_update_count[i]
+            self._apply_one(i, w, g, s, lr, wd, t)
+
+    def _apply_one(self, i, w, g, s, lr, wd, t):
+        g_val = self._preprocess_grad(_unwrap(g))
+        s = s if isinstance(s, tuple) else ((s,) if s is not None and s != () else ())
+        if (
+            self.multi_precision
+            and len(s) == 2
+            and isinstance(s[0], jax.Array)
+            and s[0].dtype == jnp.float32
+            and w.dtype in (onp.float16, jnp.bfloat16)
+        ):
+            master, inner = s
+            out = self.update_step(master, g_val.astype(jnp.float32), inner, lr, wd, t)
+            new_master, new_inner = out[0], tuple(out[1:])
+            w._set_data(new_master.astype(w.dtype))
+            self._store_state(i, (new_master, new_inner))
+        else:
+            s_vals = tuple(_unwrap(x) for x in s)
+            out = self.update_step(_unwrap(w), g_val, s_vals, lr, wd, t)
+            w._set_data(out[0])
+            self._store_state(i, tuple(out[1:]))
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def _store_state(self, index, new_state):
+        # Trainer-managed state: it re-reads from _latest_states
+        self._latest_states = getattr(self, "_latest_states", {})
+        self._latest_states[index] = new_state
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+# ---------------------------------------------------------------------------
+# SGD family
+# ---------------------------------------------------------------------------
+@register
+class SGD(Optimizer):
+    """SGD + momentum + wd (reference optimizer/sgd.py; fused kernel
+    src/operator/optimizer_op.cc sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (jnp.zeros(weight.shape, _unwrap(weight).dtype),)
+
+    def update_step(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.momentum == 0.0:
+            return (w - lr * g,)
+        (mom,) = state
+        mom = self.momentum * mom - lr * g
+        return (w + mom, mom)
+
+
+sgd = SGD
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference optimizer/nag.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, _unwrap(weight).dtype),)
+
+    def update_step(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        (mom,) = state
+        mom = self.momentum * mom + g
+        return (w - lr * (g + self.momentum * mom), mom)
+
+
+@register
+class Signum(Optimizer):
+    """signSGD / Signum (reference optimizer/sgd.py Signum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (jnp.zeros(weight.shape, _unwrap(weight).dtype),)
+
+    def update_step(self, w, g, state, lr, wd, t):
+        if self.momentum == 0.0:
+            return (w * (1 - lr * self.wd_lh) - lr * jnp.sign(g + wd * w),)
+        (mom,) = state
+        mom = self.momentum * mom - (1 - self.momentum) * (g + wd * w)
+        return (w * (1 - lr * self.wd_lh) + lr * jnp.sign(mom), mom)
+
+
+signsgd = Signum
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer/sgld.py)."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def update_step(self, w, g, state, lr, wd, t):
+        from ..numpy import random as _random
+
+        g = g + wd * w
+        noise = jax.random.normal(_random.new_key(), w.shape, jnp.float32).astype(w.dtype)
+        return (w - lr / 2 * g + jnp.sqrt(lr) * noise,)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer/dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        return (jnp.zeros(wv.shape, wv.dtype), jnp.array(wv))
+
+    def update_step(self, w, g, state, lr, wd, t):
+        mom, prev_w = state
+        g = g + wd * w
+        mom = self.momentum * mom - lr * (g + self.lamda * g * g * (w - prev_w))
+        return (w + mom, mom, jnp.array(w + mom))
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference optimizer/lars.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, _unwrap(weight).dtype),)
+
+    def update_step(self, w, g, state, lr, wd, t):
+        (mom,) = state
+        w_norm = jnp.linalg.norm(w.reshape(-1))
+        g_norm = jnp.linalg.norm(g.reshape(-1))
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            1.0,
+        )
+        g = g + wd * w
+        mom = self.momentum * mom + trust * lr * g
+        return (w - mom, mom)
+
+
+# ---------------------------------------------------------------------------
+# adaptive family
+# ---------------------------------------------------------------------------
+@register
+class Adam(Optimizer):
+    """reference optimizer/adam.py (fused adam_update kernel)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        return (jnp.zeros(wv.shape, wv.dtype), jnp.zeros(wv.shape, wv.dtype))
+
+    def update_step(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        if self.correct_bias:
+            coef1 = 1.0 - self.beta1 ** t
+            coef2 = 1.0 - self.beta2 ** t
+            lr = lr * math.sqrt(coef2) / coef1
+        return (w - lr * m / (jnp.sqrt(v) + self.epsilon), m, v)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (reference contrib adamw.py)."""
+
+    def update_step(self, w, g, state, lr, wd, t):
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        return (w - lr_t * m / (jnp.sqrt(v) + self.epsilon) - lr * wd * w, m, v)
+
+
+@register
+class Adamax(Optimizer):
+    """reference optimizer/adamax.py"""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        return (jnp.zeros(wv.shape, wv.dtype), jnp.zeros(wv.shape, wv.dtype))
+
+    def update_step(self, w, g, state, lr, wd, t):
+        m, u = state
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        lr_t = lr / (1 - self.beta1 ** t)
+        return (w - lr_t * m / (u + self.epsilon), m, u)
+
+
+@register
+class Nadam(Optimizer):
+    """reference optimizer/nadam.py"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        return (jnp.zeros(wv.shape, wv.dtype), jnp.zeros(wv.shape, wv.dtype))
+
+    def update_step(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g + wd * w
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t1
+        g_prime = g / (1.0 - self.m_schedule)
+        m = self.beta1 * m + (1.0 - self.beta1) * g
+        v = self.beta2 * v + (1.0 - self.beta2) * jnp.square(g)
+        m_prime = m / (1.0 - m_schedule_next)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t1 * m_prime
+        return (w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon), m, v)
+
+
+@register
+class AdaGrad(Optimizer):
+    """reference optimizer/adagrad.py"""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, initial_accumulator_value=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        return (jnp.full(wv.shape, self.initial_accumulator_value, wv.dtype),)
+
+    def update_step(self, w, g, state, lr, wd, t):
+        (hist,) = state
+        g = g + wd * w
+        hist = hist + jnp.square(g)
+        return (w - lr * g / (jnp.sqrt(hist) + self.epsilon), hist)
+
+
+adagrad = AdaGrad
+
+
+@register
+class AdaDelta(Optimizer):
+    """reference optimizer/adadelta.py"""
+
+    def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        return (jnp.zeros(wv.shape, wv.dtype), jnp.zeros(wv.shape, wv.dtype))
+
+    def update_step(self, w, g, state, lr, wd, t):
+        acc_g, acc_delta = state
+        g = g + wd * w
+        acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta + self.epsilon) / jnp.sqrt(acc_g + self.epsilon) * g
+        acc_delta = self.rho * acc_delta + (1 - self.rho) * jnp.square(delta)
+        return (w - lr * delta, acc_g, acc_delta)
+
+
+@register
+class RMSProp(Optimizer):
+    """reference optimizer/rmsprop.py (centered=Graves variant supported)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9, epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon = rho, momentum, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        z = jnp.zeros(wv.shape, wv.dtype)
+        if self.centered:
+            return (z, jnp.zeros_like(z), jnp.zeros_like(z))
+        return (z,)
+
+    def update_step(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.centered:
+            n, gm, delta = state
+            n = self.rho * n + (1 - self.rho) * jnp.square(g)
+            gm = self.rho * gm + (1 - self.rho) * g
+            delta = self.momentum * delta - lr * g / jnp.sqrt(n - jnp.square(gm) + self.epsilon)
+            w = w + delta
+            if self.clip_weights:
+                w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+            return (w, n, gm, delta)
+        (n,) = state
+        n = self.rho * n + (1 - self.rho) * jnp.square(g)
+        w = w - lr * g / jnp.sqrt(n + self.epsilon)
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        return (w, n)
+
+
+@register
+class Ftrl(Optimizer):
+    """reference optimizer/ftrl.py"""
+
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        return (jnp.zeros(wv.shape, wv.dtype), jnp.zeros(wv.shape, wv.dtype))
+
+    def update_step(self, w, g, state, lr, wd, t):
+        z, n = state
+        sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + jnp.square(g)
+        w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1) / ((self.beta + jnp.sqrt(n)) / lr + wd),
+            0.0,
+        ).astype(w.dtype)
+        return (w, z, n)
+
+
+@register
+class FTML(Optimizer):
+    """reference optimizer/ftml.py"""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        z = jnp.zeros(wv.shape, wv.dtype)
+        return (z, jnp.zeros_like(z), jnp.zeros_like(z))
+
+    def update_step(self, w, g, state, lr, wd, t):
+        prev_d, prev_v, prev_z = state
+        g = g + wd * w
+        v = self.beta2 * prev_v + (1 - self.beta2) * jnp.square(g)
+        d = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon
+        )
+        sigma = d - self.beta1 * prev_d
+        z = self.beta1 * prev_z + (1 - self.beta1) * g - sigma * w
+        return (-z / d, d, v, z)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for batch training (reference
+    optimizer/lamb.py; fused kernel src/operator/contrib/multi_lamb.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 lower_bound=None, upper_bound=None, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        return (jnp.zeros(wv.shape, wv.dtype), jnp.zeros(wv.shape, wv.dtype))
+
+    def update_step(self, w, g, state, lr, wd, t):
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        if self.bias_correction:
+            m_hat = m / (1 - self.beta1 ** t)
+            v_hat = v / (1 - self.beta2 ** t)
+        else:
+            m_hat, v_hat = m, v
+        r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * w
+        w_norm = jnp.linalg.norm(w.reshape(-1))
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        r_norm = jnp.linalg.norm(r.reshape(-1))
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (w - lr * ratio * r, m, v)
+
+
+lamb = LAMB
+
+
+# ---------------------------------------------------------------------------
+# legacy updater (kvstore server-side optimizer application)
+# ---------------------------------------------------------------------------
+class Updater:
+    """reference optimizer.py get_updater — callable (index, grad, weight)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[int, Any] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer._update_count(index)
+        lr = self.optimizer._get_lr(index)
+        wd = self.optimizer._get_wd(index)
+        t = self.optimizer._index_update_count[index]
+        self.optimizer._apply_one(index, weight, grad, self.states[index], lr, wd, t)
+        self.states[index] = self.optimizer._latest_states[index]
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        return pickle.dumps(
+            {k: tuple(onp.asarray(s) for s in v) if isinstance(v, tuple) else v for k, v in self.states.items()}
+        )
+
+    def set_states(self, states):
+        import pickle
+
+        loaded = pickle.loads(states)
+        self.states = {
+            k: tuple(jnp.asarray(s) for s in v) if isinstance(v, tuple) else v
+            for k, v in loaded.items()
+        }
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,218 @@
+"""Profiler (reference ``src/profiler/`` + ``python/mxnet/profiler.py``).
+
+Keeps the reference contract — ``set_config(filename=...)``,
+``set_state('run'/'stop')``, chrome://tracing JSON output (`profile.json`,
+reference ``profiler.h:451``), per-op aggregate stat table
+(``aggregate_stats.cc``) — implemented over jax.profiler (XPlane/Perfetto
+traces for device-side detail) plus our own host-side op timeline: the
+dispatch layer calls :func:`record_op` around every eager op when profiling
+is on, mirroring how the reference engine times every OprBlock
+(``threaded_engine.h:85``) without operator cooperation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax
+
+__all__ = [
+    "set_config",
+    "set_state",
+    "state",
+    "dump",
+    "dumps",
+    "pause",
+    "resume",
+    "Scope",
+    "Task",
+    "Frame",
+    "Counter",
+    "Marker",
+]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+}
+_state = "stop"
+_events: List[dict] = []
+_agg: Dict[str, List[float]] = defaultdict(list)
+_jax_tracing = False
+
+
+def set_config(**kwargs):
+    """reference python/mxnet/profiler.py:66"""
+    _config.update(kwargs)
+
+
+def set_state(state_: str = "stop", profile_process: str = "worker"):
+    global _state, _jax_tracing
+    if state_ not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    prev, _state = _state, state_
+    if state_ == "run" and prev == "stop":
+        trace_dir = os.environ.get("MXNET_PROFILER_TRACE_DIR")
+        if trace_dir:
+            try:
+                jax.profiler.start_trace(trace_dir)
+                _jax_tracing = True
+            except Exception:
+                pass
+    elif state_ == "stop" and prev == "run":
+        if _jax_tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _jax_tracing = False
+        if _config.get("filename"):
+            dump()
+
+
+def state() -> str:
+    return _state
+
+
+def is_running() -> bool:
+    return _state == "run"
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def record_op(name: str, dur_s: float, cat: str = "operator"):
+    """Called by the dispatch layer per eager op while profiling."""
+    ts = time.perf_counter() * 1e6
+    with _lock:
+        _events.append(
+            {
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "ts": ts - dur_s * 1e6,
+                "dur": dur_s * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 10000,
+            }
+        )
+        _agg[name].append(dur_s * 1e3)
+
+
+def dumps(reset: bool = False) -> str:
+    """Aggregate per-op stats table (reference aggregate_stats.cc)."""
+    lines = [f"{'Name':<30}{'Calls':>8}{'Total(ms)':>12}{'Mean(ms)':>12}{'Max(ms)':>12}"]
+    with _lock:
+        for name, times in sorted(_agg.items(), key=lambda kv: -sum(kv[1])):
+            lines.append(
+                f"{name:<30}{len(times):>8}{sum(times):>12.3f}"
+                f"{sum(times) / len(times):>12.3f}{max(times):>12.3f}"
+            )
+        if reset:
+            _agg.clear()
+    return "\n".join(lines)
+
+
+def dump(finished: bool = True, profile_process: str = "worker"):
+    """Write chrome://tracing JSON (reference profiler.h:432)."""
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(payload, f)
+
+
+class Scope:
+    """Context manager adding a named span to the trace (ProfileTask/Frame)."""
+
+    def __init__(self, name: str, cat: str = "user"):
+        self.name, self.cat = name, cat
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if is_running():
+            record_op(self.name, time.perf_counter() - self._t0, self.cat)
+
+
+class Task(Scope):
+    def __init__(self, domain=None, name="task"):
+        super().__init__(name, "task")
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if is_running():
+            record_op(self.name, time.perf_counter() - self._t0, self.cat)
+
+
+class Frame(Task):
+    pass
+
+
+class Counter:
+    """reference ProfileCounter profiler.h:557"""
+
+    def __init__(self, domain=None, name="counter", value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, v):
+        self.value = v
+        if is_running():
+            with _lock:
+                _events.append(
+                    {
+                        "name": self.name,
+                        "ph": "C",
+                        "ts": time.perf_counter() * 1e6,
+                        "pid": os.getpid(),
+                        "args": {"value": v},
+                    }
+                )
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+class Marker:
+    def __init__(self, domain=None, name="marker"):
+        self.name = name
+
+    def mark(self, scope="process"):
+        if is_running():
+            with _lock:
+                _events.append(
+                    {
+                        "name": self.name,
+                        "ph": "i",
+                        "ts": time.perf_counter() * 1e6,
+                        "pid": os.getpid(),
+                        "s": "p",
+                    }
+                )
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name

@@ -1,0 +1,65 @@
+"""Utility switches (reference ``python/mxnet/util.py``: np-shape/np-array
+semantics toggles). This framework is np-native, so the toggles are
+always-on no-ops kept for script compatibility."""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "use_np", "np_array", "np_shape", "use_np_array", "use_np_shape", "getenv", "setenv", "default_array"]
+
+
+def is_np_array() -> bool:
+    return True
+
+
+def is_np_shape() -> bool:
+    return True
+
+
+def set_np(shape=True, array=True, dtype=False):
+    return None
+
+
+def reset_np():
+    return None
+
+
+def use_np(func):
+    """Decorator kept for parity; semantics are always np."""
+    return func
+
+
+use_np_array = use_np
+use_np_shape = use_np
+
+
+class np_array:
+    def __init__(self, active=True):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+np_shape = np_array
+
+
+def getenv(name):
+    import os
+
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    import os
+
+    os.environ[name] = value
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from .numpy import array
+
+    return array(source_array, ctx=ctx, dtype=dtype)

@@ -1,0 +1,163 @@
+"""Autograd semantics (reference tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np
+
+
+def test_simple_backward():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_chain_and_broadcast():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = np.exp(x) + x * 2
+        z = y.mean()
+    z.backward()
+    expected = (onp.exp(x.asnumpy()) + 2) / 4
+    onp.testing.assert_allclose(x.grad.asnumpy(), expected, rtol=1e-5)
+
+
+def test_grad_req_add_and_null():
+    x = np.ones((3,))
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0, 6.0, 6.0])
+
+    z = np.ones((3,))
+    z.attach_grad(grad_req="null")
+    with autograd.record():
+        w = np.ones((3,))
+        w.attach_grad()
+        out = (z * w).sum()
+    out.backward()
+    assert z.grad is None
+    onp.testing.assert_allclose(w.grad.asnumpy(), [1.0, 1.0, 1.0])
+
+
+def test_head_gradient():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(np.array([10.0, 100.0]))
+    onp.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_retain_graph():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # write req overwrites
+
+
+def test_detach_stops_grad():
+    x = np.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_pause():
+    x = np.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            c = x * 100  # not recorded
+        z = y + c.detach()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record(train_mode=True):
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_autograd_grad_api():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        g = autograd.grad(y, x, retain_graph=True)
+    onp.testing.assert_allclose(g.asnumpy(), 3 * x.asnumpy() ** 2, rtol=1e-6)
+
+
+def test_higher_order_grad():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        g1 = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        g1_sum = g1.sum()
+    g1_sum.backward()
+    # d/dx 3x^2 = 6x = 12
+    onp.testing.assert_allclose(x.grad.asnumpy(), [12.0], rtol=1e-5)
+
+
+def test_custom_function():
+    class sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + np.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = sigmoid()
+    x = np.random.uniform(-3, 3, (5,))
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward(np.ones((5,)))
+    sig = 1 / (1 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(x.grad.asnumpy(), sig * (1 - sig), rtol=1e-5)
+
+
+def test_matmul_grad():
+    a = np.random.uniform(-1, 1, (3, 4))
+    b = np.random.uniform(-1, 1, (4, 5))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = np.dot(a, b).sum()
+    c.backward()
+    onp.testing.assert_allclose(
+        a.grad.asnumpy(), onp.ones((3, 5)) @ b.asnumpy().T, rtol=1e-5
+    )
+    onp.testing.assert_allclose(
+        b.grad.asnumpy(), a.asnumpy().T @ onp.ones((3, 5)), rtol=1e-5
+    )
+
+
+def test_exception_surfaces_at_wait(caplog):
+    # engine contract: async errors surface at sync points, not dispatch
+    x = np.array([1.0])
+    y = np.log(x - 2)  # nan, not an error
+    assert onp.isnan(y.asnumpy()).all()

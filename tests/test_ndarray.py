@@ -1,0 +1,140 @@
+"""Core ndarray semantics (reference tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+
+def test_creation_and_basic_math():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = np.ones((2, 2))
+    c = a + b * 2 - 1
+    onp.testing.assert_allclose(c.asnumpy(), onp.array([[2.0, 3.0], [4.0, 5.0]]))
+    assert c.shape == (2, 2)
+    assert c.dtype == onp.float32
+
+
+def test_dtypes_including_bf16():
+    a = np.ones((4,), dtype="bfloat16")
+    assert str(a.dtype) == "bfloat16"
+    b = a.astype("float32")
+    onp.testing.assert_allclose(b.asnumpy(), onp.ones(4))
+    for dt in ["float16", "float64", "int8", "int32", "int64", "uint8", "bool"]:
+        x = np.zeros((2,), dtype=dt)
+        assert x.dtype == onp.dtype(dt)
+
+
+def test_scalar_ops_and_broadcast():
+    a = np.arange(6).reshape(2, 3).astype("float32")
+    out = (2 * a + 1) / 2 - a
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), 0.5))
+    col = np.ones((2, 1))
+    onp.testing.assert_allclose((a + col).asnumpy(), a.asnumpy() + 1)
+
+
+def test_indexing_and_setitem():
+    a = np.arange(12).reshape(3, 4).astype("float32")
+    sl = a[1]
+    onp.testing.assert_allclose(sl.asnumpy(), [4, 5, 6, 7])
+    onp.testing.assert_allclose(a[0:2, 1].asnumpy(), [1, 5])
+    a[0, 0] = 42.0
+    assert a[0, 0].item() == 42.0
+    a[:] = 0
+    onp.testing.assert_allclose(a.asnumpy(), onp.zeros((3, 4)))
+    # boolean mask
+    b = np.array([1.0, -1.0, 2.0, -2.0])
+    m = b > 0
+    onp.testing.assert_allclose(b[m].asnumpy(), [1.0, 2.0])
+
+
+def test_reshape_transpose():
+    a = np.arange(24).reshape(2, 3, 4)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose(1, 0, 2).shape == (3, 2, 4)
+    assert a.reshape(-1).shape == (24,)
+    assert a.reshape(4, 6).shape == (4, 6)
+    assert a.flatten().shape == (24,)
+
+
+def test_reductions():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().item() == 10.0
+    onp.testing.assert_allclose(a.sum(axis=0).asnumpy(), [4.0, 6.0])
+    assert a.mean().item() == 2.5
+    assert a.max().item() == 4.0
+    assert a.min().item() == 1.0
+    assert a.argmax().item() == 3
+    assert np.std(a).item() == pytest.approx(onp.std(a.asnumpy()))
+
+
+def test_context_and_copy():
+    a = np.ones((2, 2), ctx=mx.cpu())
+    b = a.copyto(mx.cpu(0))
+    onp.testing.assert_allclose(b.asnumpy(), a.asnumpy())
+    c = a.as_in_ctx(mx.cpu(0))
+    assert c.ctx.device_type in ("cpu", "tpu")
+
+
+def test_wait_to_read_and_waitall():
+    a = np.ones((128, 128))
+    b = np.dot(a, a)
+    b.wait_to_read()
+    mx.engine.waitall()
+    assert b[0, 0].item() == 128.0
+
+
+def test_inplace_ops():
+    a = np.ones((3,))
+    a += 2
+    onp.testing.assert_allclose(a.asnumpy(), [3, 3, 3])
+    a *= 2
+    onp.testing.assert_allclose(a.asnumpy(), [6, 6, 6])
+
+
+def test_comparison_ops():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([3.0, 2.0, 1.0])
+    onp.testing.assert_array_equal((a < b).asnumpy(), [True, False, False])
+    onp.testing.assert_array_equal((a == b).asnumpy(), [False, True, False])
+
+
+def test_numpy_interop():
+    a = np.arange(4)
+    arr = onp.asarray(a)
+    onp.testing.assert_array_equal(arr, [0, 1, 2, 3])
+    assert isinstance(a.tolist(), list)
+
+
+def test_concat_stack_split():
+    a, b = np.ones((2, 3)), np.zeros((2, 3))
+    c = np.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    s = np.stack([a, b])
+    assert s.shape == (2, 2, 3)
+    parts = np.split(np.arange(9), 3)
+    assert len(parts) == 3
+    onp.testing.assert_array_equal(parts[1].asnumpy(), [3, 4, 5])
+
+
+def test_linalg():
+    a = np.array([[2.0, 0.0], [0.0, 3.0]])
+    inv = np.linalg.inv(a)
+    onp.testing.assert_allclose(inv.asnumpy(), [[0.5, 0], [0, 1 / 3]], rtol=1e-6)
+    assert np.linalg.det(a).item() == pytest.approx(6.0)
+    n = np.linalg.norm(np.array([3.0, 4.0]))
+    assert n.item() == pytest.approx(5.0)
+
+
+def test_random():
+    mx.np.random.seed(0)
+    a = np.random.uniform(0, 1, (100,))
+    b = np.random.uniform(0, 1, (100,))
+    assert not onp.allclose(a.asnumpy(), b.asnumpy())
+    mx.np.random.seed(0)
+    c = np.random.uniform(0, 1, (100,))
+    onp.testing.assert_allclose(a.asnumpy(), c.asnumpy())
+    n = np.random.normal(10.0, 0.1, (10000,))
+    assert abs(n.mean().item() - 10.0) < 0.1
+    r = np.random.randint(0, 5, (1000,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 5
