@@ -1,1 +1,32 @@
-"""``mx.gluon`` — imperative-first model API (placeholder, filled in M3)."""
+"""``mx.gluon`` — the imperative-first model API (reference
+``python/mxnet/gluon/``): Block/HybridBlock with jit hybridization,
+Parameter with deferred init, Trainer, losses, metrics, data pipeline,
+model zoo, RNN layers."""
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import Parameter, Constant, DeferredInitializationError  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import metric  # noqa: F401
+from . import utils  # noqa: F401
+
+
+def __getattr__(name):
+    # heavier subpackages load lazily
+    if name == "data":
+        from . import data as _d
+
+        return _d
+    if name == "model_zoo":
+        from . import model_zoo as _m
+
+        return _m
+    if name == "rnn":
+        from . import rnn as _r
+
+        return _r
+    if name == "contrib":
+        from . import contrib as _c
+
+        return _c
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

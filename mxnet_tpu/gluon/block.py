@@ -1,0 +1,524 @@
+"""Gluon Block / HybridBlock.
+
+Parity: reference ``python/mxnet/gluon/block.py`` (``Block :251``,
+``HybridBlock :854``, ``_build_cache :985``, ``_call_cached_op :1055``,
+``hybridize :1172``, ``export :1248``). TPU-native re-design of the
+CachedOp contract: ``hybridize()`` turns the block's forward into a
+jax.jit-compiled pure function of (params, inputs, rng-key), cached per
+input signature — the exact analogue of CachedOp's traced nnvm graph
+(``src/imperative/cached_op.cc:759``) with XLA doing the fusion/memory
+planning that SetForwardGraph/PlanMemory do in the reference. Mutable
+forward state (BatchNorm running stats) is captured functionally: traced
+as extra outputs and written back after execution, instead of the
+reference's aux-array mutation.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+from ..ops.dispatch import apply_op, autograd_state
+from .. import initializer as init_mod
+from .parameter import Parameter, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class Block:
+    """Base model component (reference block.py:251)."""
+
+    def __init__(self):
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List[Callable] = []
+        self._forward_pre_hooks: List[Callable] = []
+
+    # -- attribute registration -------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            params = self.__dict__.get("_reg_params")
+            if params is not None:
+                params[name] = value
+                if value._name in ("weight", "param", "") or value._name is None:
+                    value._name = name
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None):
+        self._children[name or str(len(self._children))] = block
+
+    # -- parameter collection ---------------------------------------------
+    def collect_params(self, select: Optional[str] = None) -> Dict[str, Parameter]:
+        """Dict of dotted-path name -> Parameter (reference collect_params)."""
+        out: Dict[str, Parameter] = {}
+        self._collect(out, "")
+        if select is not None:
+            pat = re.compile(select)
+            out = {k: v for k, v in out.items() if pat.search(k)}
+        return out
+
+    def _collect(self, out: Dict[str, Parameter], prefix: str):
+        for name, p in self._reg_params.items():
+            out[prefix + name] = p
+        for cname, child in self._children.items():
+            child._collect(out, prefix + cname + ".")
+
+    @property
+    def params(self) -> Dict[str, Parameter]:
+        return dict(self._reg_params)
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, init=None, device=None, ctx=None, verbose=False, force_reinit=False):
+        ctx = ctx or device or current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # one logical copy; the mesh handles replication
+        default = init or init_mod.Uniform(0.07)
+        for name, p in self.collect_params().items():
+            p._name = name  # fully-qualified for initializer pattern matching
+            p.initialize(init=p.init, ctx=ctx, default_init=default, force_reinit=force_reinit)
+        return self
+
+    def apply(self, fn: Callable):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        self._dtype = dtype
+
+    def zero_grad(self):
+        for p in self.collect_params().values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.collect_params().values():
+            p.reset_ctx(ctx)
+
+    reset_device = reset_ctx
+
+    # -- checkpointing (reference block.py:440 save_parameters /:496 load) -
+    def save_parameters(self, filename: str, deduplicate: bool = False):
+        from ..serialization import save_params
+
+        arrays = {}
+        for name, p in self.collect_params().items():
+            if p._data is not None:
+                arrays[name] = p.data().asnumpy()
+        save_params(filename, arrays)
+
+    def load_parameters(
+        self,
+        filename: str,
+        device=None,
+        ctx=None,
+        allow_missing: bool = False,
+        ignore_extra: bool = False,
+        cast_dtype: bool = False,
+        dtype_source: str = "current",
+    ):
+        from ..serialization import load_params
+
+        loaded = load_params(filename)
+        params = self.collect_params()
+        for name, p in params.items():
+            if name in loaded:
+                if cast_dtype:
+                    p.set_data(loaded[name].astype(onp.dtype(p.dtype)))
+                else:
+                    p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {name} missing in file {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"file {filename} has extra parameters {sorted(extra)}")
+
+    def load_dict(self, param_dict, device=None, allow_missing=False, ignore_extra=False):
+        params = self.collect_params()
+        for name, p in params.items():
+            if name in param_dict:
+                v = param_dict[name]
+                p.set_data(v if not isinstance(v, ndarray) else v)
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {name} missing in dict")
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return _HookHandle(self._forward_hooks, hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return _HookHandle(self._forward_pre_hooks, hook)
+
+    # -- call --------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def hybridize(self, active: bool = True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        rows = []
+        for name, p in self.collect_params().items():
+            rows.append((name, p.shape, int(onp.prod(p.shape)) if p.shape_known else 0))
+        total = sum(r[2] for r in rows)
+        lines = [f"{'Parameter':<40}{'Shape':<20}{'Count':>12}"]
+        for r in rows:
+            lines.append(f"{r[0]:<40}{str(r[1]):<20}{r[2]:>12}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        s = f"{type(self).__name__}("
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            s += f"\n  ({name}): {child_repr}"
+        return s + ("\n)" if self._children else ")")
+
+
+class _HookHandle:
+    def __init__(self, hook_list, hook):
+        self._list, self._hook = hook_list, hook
+
+    def detach(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+
+class _CachedGraph:
+    """One compiled trace (the CachedOp).
+
+    Two executables, mirroring CachedOp::Forward/Backward
+    (reference cached_op.cc:759/:1004):
+    - ``fwd_fn``: jit(pure_fn) — the forward program.
+    - ``bwd_fn``: jit of vjp(pure_fn) applied to cotangents — the backward
+      program, which rematerializes the forward inside one fused XLA
+      computation. (vjp *around* an already-jitted callable fails to
+      linearize on the TPU backend, and remat-in-backward is the better
+      TPU design anyway: no residual round-trips through HBM between two
+      dispatches.)
+    ``diff_idx`` are the positions (params + float inputs) the backward
+    differentiates; cotangents for untracked inputs are simply dropped by
+    the tape router.
+    """
+
+    __slots__ = (
+        "fwd_fn",
+        "bwd_fn",
+        "n_outputs",
+        "out_treedef",
+        "mutated_params",
+        "param_list",
+        "diff_idx",
+    )
+
+    def __init__(self, fwd_fn, bwd_fn, n_outputs, out_treedef, mutated_params, param_list, diff_idx):
+        self.fwd_fn = fwd_fn
+        self.bwd_fn = bwd_fn
+        self.n_outputs = n_outputs
+        self.out_treedef = out_treedef
+        self.mutated_params = mutated_params
+        self.param_list = param_list
+        self.diff_idx = diff_idx
+
+
+class HybridBlock(Block):
+    """Block whose forward can be traced to a single XLA executable
+    (reference block.py:854)."""
+
+    def __init__(self):
+        super().__init__()
+        self._active = False
+        self._cached_graphs: Dict[Any, _CachedGraph] = {}
+        self._flags: Dict[str, Any] = {}
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, inline_limit: int = 2,
+                  backend=None, backend_opts=None, **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc, static_shape=static_shape, **kwargs)
+        self._cached_graphs.clear()
+        super().hybridize(False)  # only the outermost hybridized block traces
+
+    def infer_shape(self, *args):
+        """Run a deferred-shape-completing pass (layers do it in forward)."""
+        with jax.ensure_compile_time_eval():
+            pass  # shapes complete lazily at first forward in this design
+
+    def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        self.hybridize(True, **kwargs)
+        return self(x, *args)
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d["_cached_graphs"] = {}  # jitted executables are rebuilt on load
+        d["_forward_hooks"] = []
+        d["_forward_pre_hooks"] = []
+        return d
+
+    def export(self, path: str, epoch: int = 0, remove_amp_cast: bool = True):
+        """Serialize params + model structure (reference block.py:1248).
+        No nnvm graph exists on TPU — the structure ships as a pickled block
+        (XLA executables rebuild at import); params use the .params format."""
+        import base64
+        import json
+        import pickle
+
+        pfile = f"{path}-{epoch:04d}.params"
+        self.save_parameters(pfile)
+        meta = {
+            "framework": "mxnet_tpu",
+            "class": type(self).__module__ + "." + type(self).__name__,
+            "flags": {k: v for k, v in self._flags.items() if isinstance(v, (int, bool, str, float))},
+            "block": base64.b64encode(pickle.dumps(self)).decode(),
+        }
+        jfile = f"{path}-symbol.json"
+        with open(jfile, "w") as f:
+            json.dump(meta, f)
+        return jfile, pfile
+
+    # -- the cached-op machinery ------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not self._active:
+            return super().__call__(*args, **kwargs)
+        return self._call_cached(*args, **kwargs)
+
+    def _signature(self, flat_vals, training: bool):
+        return (
+            tuple((tuple(v.shape), str(v.dtype)) for v in flat_vals),
+            training,
+        )
+
+    def _call_cached(self, *args):
+        from ..numpy import random as _random
+        from .. import numpy_extension as npx
+        from .. import autograd as ag
+
+        # ensure params exist (run one eager forward for deferred shapes)
+        plist = self._ensure_params_ready(args)
+
+        flat_vals, in_treedef = jax.tree_util.tree_flatten(args)
+        training = autograd_state.training
+        sig = (self._signature(flat_vals, training), in_treedef)
+        cg = self._cached_graphs.get(sig)
+        if cg is None:
+            cg = self._build_cache(args, flat_vals, in_treedef, training, plist)
+            self._cached_graphs[sig] = cg
+
+        key = _random.new_key()
+        arrays = [p._data for _, p in cg.param_list] + [_wrap(v) for v in flat_vals] + [_wrap(key)]
+        n_total = cg.n_outputs + len(cg.mutated_params)
+        outs = self._invoke_cached(cg, arrays, n_total)
+        user_outs = outs[: cg.n_outputs]
+        for (pname, p), new_val in zip(cg.mutated_params, outs[cg.n_outputs :]):
+            with_pause_set_data(p, new_val)
+        result = jax.tree_util.tree_unflatten(cg.out_treedef, [o._data for o in user_outs])
+        # rewrapped leaves must inherit the tape identity of the op outputs
+        tape = autograd_state.tape
+        if autograd_state.recording and tape is not None:
+            new_leaves = jax.tree_util.tree_leaves(
+                result, is_leaf=lambda v: isinstance(v, ndarray)
+            )
+            for old, new in zip(user_outs, new_leaves):
+                if isinstance(new, ndarray):
+                    tape.alias(old, new)
+        return result
+
+    def _invoke_cached(self, cg: _CachedGraph, arrays, n_total):
+        """Run the compiled forward; under autograd, record a tape node whose
+        pullback is the compiled backward (CachedOp::Backward)."""
+        from ..ops.dispatch import TapeNode, _differentiable
+
+        st = autograd_state
+        vals = [_unwrap(a) for a in arrays]
+        out_vals = cg.fwd_fn(*vals)
+        outs = tuple(_wrap(v) for v in out_vals)
+
+        record = st.recording and st.tape is not None
+        if record:
+            diff_arrays = [arrays[i] for i in cg.diff_idx]
+            record = any(
+                isinstance(a, ndarray)
+                and _differentiable(a)
+                and (
+                    (getattr(a, "_grad_req", "null") != "null" and a._grad is not None)
+                    or id(a) in st.tape.producer
+                )
+                for a in diff_arrays
+            )
+        if record:
+            bwd = cg.bwd_fn
+
+            def vjp_fn(cts):
+                full = cts if isinstance(cts, tuple) else (cts,)
+                return bwd(tuple(full), *vals)
+
+            node = TapeNode(
+                vjp_fn,
+                [arrays[i] for i in cg.diff_idx],
+                n_total,
+                type(self).__name__ + "_cached",
+                out_avals=[(o.shape, o.dtype) for o in outs],
+            )
+            st.tape.add(node, outs)
+        return outs
+
+    def _ensure_params_ready(self, args):
+        plist = sorted(self.collect_params().items())
+        needs_eager = any(p._data is None for _, p in plist)
+        if needs_eager:
+            # run the un-traced forward once: completes deferred shapes/init
+            from .. import autograd as ag
+
+            with ag.pause(train_mode=autograd_state.training):
+                super(HybridBlock, self).__call__(*args)
+            plist = sorted(self.collect_params().items())
+        return plist
+
+    def _build_cache(self, args, flat_vals, in_treedef, training, plist):
+        """Trace forward into a pure jitted function (the CachedOp build,
+        reference _build_cache block.py:985)."""
+        from .. import numpy_extension as npx
+
+        param_list = [(n, p) for n, p in plist if p._data is not None]
+        n_params = len(param_list)
+        out_info = {}
+
+        def pure_fn(*vals):
+            pvals = vals[:n_params]
+            key = vals[-1]
+            ivals = vals[n_params:-1]
+            key_state = {"key": key}
+
+            def supplier():
+                key_state["key"], sub = jax.random.split(key_state["key"])
+                return sub
+
+            originals = [p._data for _, p in param_list]
+            try:
+                for (_, p), v in zip(param_list, pvals):
+                    p._data = _wrap(v)
+                st = autograd_state
+                prev = (st.recording, st.training)
+                st.recording, st.training = False, training
+                try:
+                    with npx.rng_scope(supplier):
+                        inputs = jax.tree_util.tree_unflatten(in_treedef, list(ivals))
+                        out = Block.__call__(self, *_as_tuple(inputs))
+                finally:
+                    st.recording, st.training = prev
+                out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+                # a param whose traced value differs from its input tracer was
+                # written during forward (BatchNorm running stats et al.) —
+                # emit the new value as an extra output (functional aux state)
+                mutated = []
+                for (pname, p), v in zip(param_list, pvals):
+                    cur = p._data
+                    newv = cur._data if isinstance(cur, ndarray) else cur
+                    if newv is not v:
+                        mutated.append((pname, newv))
+                out_info["treedef"] = out_treedef
+                out_info["n_outputs"] = len(out_leaves)
+                out_info["mutated_names"] = [pn for pn, _ in mutated]
+                return tuple(out_leaves) + tuple(mv for _, mv in mutated)
+            finally:
+                for (_, p), orig in zip(param_list, originals):
+                    p._data = orig
+
+        # trace once abstractly to learn output structure, then jit
+        probe_vals = [p._data._data for _, p in param_list] + list(flat_vals) + [
+            jax.random.PRNGKey(0)
+        ]
+        jax.eval_shape(pure_fn, *probe_vals)
+        mutated_params = [(pn, dict(param_list)[pn]) for pn in out_info["mutated_names"]]
+
+        import numpy as _onp
+
+        def _is_float(v):
+            return _onp.issubdtype(_onp.dtype(v.dtype), _onp.floating) or str(v.dtype) == "bfloat16"
+
+        diff_idx = [i for i, v in enumerate(probe_vals[:-1]) if _is_float(v)]
+
+        fwd_fn = jax.jit(pure_fn)
+
+        def bwd(cts, *vals):
+            def for_diff(*dvals):
+                full = list(vals)
+                for i, dv in zip(diff_idx, dvals):
+                    full[i] = dv
+                return pure_fn(*full)
+
+            _, vjp = jax.vjp(for_diff, *[vals[i] for i in diff_idx])
+            return vjp(tuple(cts))
+
+        bwd_fn = jax.jit(bwd)
+        return _CachedGraph(
+            fwd_fn,
+            bwd_fn,
+            out_info["n_outputs"],
+            out_info["treedef"],
+            mutated_params,
+            param_list,
+            diff_idx,
+        )
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+def with_pause_set_data(p: Parameter, new_val: ndarray):
+    if p._data is not None:
+        p._data._set_data(_unwrap(new_val))
+    else:
+        p.set_data(new_val)
+
+
+def _as_tuple(x):
+    if isinstance(x, tuple):
+        return x
+    if isinstance(x, list):
+        return tuple(x)
+    return (x,)
+
+
+class SymbolBlock(HybridBlock):
+    """Load an exported model (reference block.py:1410). Since exports carry
+    class + params (no nnvm graph on TPU), imports reconstruct the class."""
+
+    @staticmethod
+    def imports(symbol_file: str, input_names=None, param_file: Optional[str] = None, ctx=None):
+        import base64
+        import json
+        import pickle
+
+        with open(symbol_file) as f:
+            meta = json.load(f)
+        net = pickle.loads(base64.b64decode(meta["block"]))
+        if param_file:
+            net.load_parameters(param_file, ctx=ctx)
+        net.hybridize()
+        return net

@@ -1,0 +1,13 @@
+"""``mx.gluon.data`` — datasets, samplers, batchify, DataLoader."""
+from . import vision  # noqa: F401
+from .batchify import Group, Pad, Stack, default_batchify_fn  # noqa: F401
+from .dataloader import DataLoader  # noqa: F401
+from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset  # noqa: F401
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    FilterSampler,
+    IntervalSampler,
+    RandomSampler,
+    Sampler,
+    SequentialSampler,
+)

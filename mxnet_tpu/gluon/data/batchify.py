@@ -1,0 +1,74 @@
+"""Batchify functions (reference ``python/mxnet/gluon/data/batchify.py``
+and the C++ batchify backends in ``src/io/batchify.cc``)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import ndarray
+from ... import numpy as np
+
+__all__ = ["Stack", "Pad", "Group", "default_batchify_fn"]
+
+
+def _as_numpy(x):
+    if isinstance(x, ndarray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+class Stack:
+    """Stack samples along a new batch axis."""
+
+    def __call__(self, data: Sequence):
+        arrs = [_as_numpy(d) for d in data]
+        return np.array(onp.stack(arrs))
+
+
+class Pad:
+    """Pad ragged samples to the max length, then stack (reference Pad)."""
+
+    def __init__(self, axis=0, val=0, dtype=None, round_to=None):
+        self._axis = axis
+        self._val = val
+        self._dtype = dtype
+        self._round_to = round_to
+
+    def __call__(self, data: Sequence):
+        arrs = [_as_numpy(d) for d in data]
+        max_len = max(a.shape[self._axis] for a in arrs)
+        if self._round_to:
+            max_len = -(-max_len // self._round_to) * self._round_to
+        padded = []
+        for a in arrs:
+            pad_width = [(0, 0)] * a.ndim
+            pad_width[self._axis] = (0, max_len - a.shape[self._axis])
+            padded.append(onp.pad(a, pad_width, constant_values=self._val))
+        out = onp.stack(padded)
+        if self._dtype:
+            out = out.astype(self._dtype)
+        return np.array(out)
+
+
+class Group:
+    """Apply one batchify fn per field of the sample tuple."""
+
+    def __init__(self, *fns):
+        if len(fns) == 1 and isinstance(fns[0], (list, tuple)):
+            fns = tuple(fns[0])
+        self._fns = fns
+
+    def __call__(self, data: Sequence):
+        if len(data[0]) != len(self._fns):
+            raise MXNetError("sample arity != number of batchify functions")
+        return tuple(fn([d[i] for d in data]) for i, fn in enumerate(self._fns))
+
+
+def default_batchify_fn(data: Sequence):
+    """reference dataloader.py default_batchify_fn"""
+    sample = data[0]
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_batchify_fn([d[i] for d in data]) for i in range(len(sample)))
+    return Stack()(data)

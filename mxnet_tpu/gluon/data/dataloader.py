@@ -1,0 +1,157 @@
+"""DataLoader (reference ``python/mxnet/gluon/data/dataloader.py``, 797
+lines: multi-process workers, NDArray-through-shared-memory pickling
+``:50-92``, ``_MultiWorkerIter``).
+
+TPU-native notes: host→device transfer is the seam that matters — the
+loader keeps samples as host numpy until the batch boundary, then uploads
+once (optionally double-buffered via ``prefetch`` like the reference's
+PrefetcherIter, ``src/io/iter_prefetcher.h:47``). Multi-process workers use
+a process pool with pickled numpy (jax buffers never cross processes).
+"""
+from __future__ import annotations
+
+import multiprocessing
+import queue
+import threading
+from typing import Callable, Optional
+
+from ...base import MXNetError
+from ...ndarray.ndarray import ndarray
+from .batchify import default_batchify_fn
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader"]
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        batch_size: Optional[int] = None,
+        shuffle: bool = False,
+        sampler=None,
+        last_batch: Optional[str] = None,
+        batch_sampler=None,
+        batchify_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        pin_memory: bool = False,
+        prefetch: Optional[int] = None,
+        thread_pool: bool = False,
+        timeout: int = 120,
+    ):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+        self._num_workers = max(0, num_workers)
+        self._thread_pool = thread_pool
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif any(p is not None for p in (batch_size, sampler, last_batch)) or shuffle:
+            raise MXNetError("batch_sampler is mutually exclusive with batch_size/shuffle/sampler/last_batch")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+
+                self._pool = ThreadPool(self._num_workers)
+            else:
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._pool is None:
+            if self._prefetch > 0:
+                return _PrefetchIter(self._gen(), self._prefetch)
+            return self._gen()
+        return _PoolIter(self)
+
+    def _gen(self):
+        for batch_idx in self._batch_sampler:
+            yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()
+
+
+def _worker_fn(dataset, batchify_fn, batch_idx):
+    return batchify_fn([dataset[i] for i in batch_idx])
+
+
+class _PoolIter:
+    """Out-of-order-safe multi-worker iterator (reference _MultiWorkerIter)."""
+
+    def __init__(self, loader: DataLoader):
+        self._loader = loader
+        self._batches = iter(loader._batch_sampler)
+        self._pending = {}
+        self._sent = 0
+        self._recv = 0
+        depth = max(2 * loader._num_workers, 2)
+        for _ in range(depth):
+            self._dispatch()
+
+    def _dispatch(self):
+        batch_idx = next(self._batches, None)
+        if batch_idx is None:
+            return
+        self._pending[self._sent] = self._loader._pool.apply_async(
+            _worker_fn, (self._loader._dataset, self._loader._batchify_fn, batch_idx)
+        )
+        self._sent += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._recv >= self._sent:
+            raise StopIteration
+        result = self._pending.pop(self._recv).get(self._loader._timeout)
+        self._recv += 1
+        self._dispatch()
+        return result
+
+
+class _PrefetchIter:
+    """Background-thread double buffering (the PrefetcherIter contract)."""
+
+    def __init__(self, gen, depth: int):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._sentinel = object()
+        self._error = None
+
+        def run():
+            try:
+                for item in gen:
+                    self._queue.put(item)
+            except BaseException as e:  # surfaced on next()
+                self._error = e
+            finally:
+                self._queue.put(self._sentinel)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._sentinel:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item

@@ -1,0 +1,9 @@
+"""``mx.gluon.data.vision`` — datasets + transforms."""
+from . import transforms  # noqa: F401
+from .datasets import (  # noqa: F401
+    CIFAR10,
+    CIFAR100,
+    FashionMNIST,
+    ImageFolderDataset,
+    MNIST,
+)

@@ -1,0 +1,188 @@
+"""Vision datasets (reference ``python/mxnet/gluon/data/vision/datasets.py``).
+
+MNIST/FashionMNIST/CIFAR read the standard on-disk formats from
+``root`` (no network egress in this environment — files must be present;
+``MXNET_HOME``/``~/.mxnet/datasets`` is searched like the reference). When
+the files are absent and ``synthetic_ok`` is set (or
+``MXNET_SYNTHETIC_DATA=1``), a deterministic synthetic stand-in of the same
+shape/dtype is generated so examples and benchmarks run anywhere.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Optional
+
+import numpy as onp
+
+from ....base import MXNetError, env_bool, env_str
+from .. import dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset"]
+
+
+def _data_root(root: Optional[str]) -> str:
+    if root:
+        return os.path.expanduser(root)
+    home = env_str("MXNET_HOME", os.path.join(os.path.expanduser("~"), ".mxnet"))
+    return os.path.join(home, "datasets")
+
+
+def _synthetic_allowed(explicit: Optional[bool]) -> bool:
+    if explicit is not None:
+        return explicit
+    return env_bool("MXNET_SYNTHETIC_DATA", True)
+
+
+class _DownloadedDataset(dataset.Dataset):
+    def __init__(self, root, train, transform):
+        self._root = root
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """reference datasets.py MNIST (idx-ubyte format)."""
+
+    _ns = "mnist"
+    _shape = (28, 28, 1)
+    _classes = 10
+
+    def __init__(self, root=None, train=True, transform=None, synthetic_ok=None):
+        self._synth = _synthetic_allowed(synthetic_ok)
+        super().__init__(os.path.join(_data_root(root), self._ns), train, transform)
+
+    def _files(self):
+        if self._train:
+            return "train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz", 60000
+        return "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz", 10000
+
+    def _get_data(self):
+        img_f, lbl_f, n = self._files()
+        img_path = os.path.join(self._root, img_f)
+        lbl_path = os.path.join(self._root, lbl_f)
+        if os.path.exists(img_path) and os.path.exists(lbl_path):
+            with gzip.open(lbl_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                self._label = onp.frombuffer(f.read(), dtype=onp.uint8).astype(onp.int32)
+            with gzip.open(img_path, "rb") as f:
+                _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                data = onp.frombuffer(f.read(), dtype=onp.uint8)
+                self._data = data.reshape(num, rows, cols, 1)
+        elif self._synth:
+            rng = onp.random.RandomState(42 if self._train else 43)
+            n = min(n, 8192)
+            self._label = rng.randint(0, self._classes, n).astype(onp.int32)
+            base = rng.randint(0, 255, (self._classes,) + self._shape)
+            noise = rng.randint(0, 64, (n,) + self._shape)
+            self._data = onp.clip(base[self._label] * 0.75 + noise, 0, 255).astype(onp.uint8)
+        else:
+            raise MXNetError(f"MNIST files not found under {self._root} (no egress to download)")
+
+
+class FashionMNIST(MNIST):
+    _ns = "fashion-mnist"
+
+
+class CIFAR10(_DownloadedDataset):
+    """reference datasets.py CIFAR10 (python pickled batches)."""
+
+    _classes = 10
+    _archive = "cifar-10-batches-py"
+
+    def __init__(self, root=None, train=True, transform=None, synthetic_ok=None):
+        self._synth = _synthetic_allowed(synthetic_ok)
+        super().__init__(os.path.join(_data_root(root), "cifar10"), train, transform)
+
+    def _get_data(self):
+        batch_dir = os.path.join(self._root, self._archive)
+        tar_path = os.path.join(self._root, "cifar-10-python.tar.gz")
+        if not os.path.isdir(batch_dir) and os.path.exists(tar_path):
+            with tarfile.open(tar_path) as t:
+                t.extractall(self._root)
+        if os.path.isdir(batch_dir):
+            files = (
+                [f"data_batch_{i}" for i in range(1, 6)] if self._train else ["test_batch"]
+            )
+            data, labels = [], []
+            for fname in files:
+                with open(os.path.join(batch_dir, fname), "rb") as f:
+                    batch = pickle.load(f, encoding="latin1")
+                data.append(batch["data"])
+                labels.extend(batch.get("labels", batch.get("fine_labels")))
+            raw = onp.concatenate(data).reshape(-1, 3, 32, 32)
+            self._data = raw.transpose(0, 2, 3, 1)  # HWC like the reference
+            self._label = onp.asarray(labels, dtype=onp.int32)
+        elif self._synth:
+            rng = onp.random.RandomState(7 if self._train else 8)
+            n = 8192 if self._train else 2048
+            self._label = rng.randint(0, self._classes, n).astype(onp.int32)
+            base = rng.randint(0, 255, (self._classes, 32, 32, 3))
+            noise = rng.randint(0, 80, (n, 32, 32, 3))
+            self._data = onp.clip(base[self._label] * 0.7 + noise, 0, 255).astype(onp.uint8)
+        else:
+            raise MXNetError(f"CIFAR-10 not found under {self._root} (no egress to download)")
+
+
+class CIFAR100(CIFAR10):
+    _classes = 100
+    _archive = "cifar-100-python"
+
+    def __init__(self, root=None, fine_label=True, train=True, transform=None, synthetic_ok=None):
+        self._fine = fine_label
+        self._synth = _synthetic_allowed(synthetic_ok)
+        _DownloadedDataset.__init__(
+            self, os.path.join(_data_root(root), "cifar100"), train, transform
+        )
+
+
+class ImageFolderDataset(dataset.Dataset):
+    """reference vision/datasets.py ImageFolderDataset: root/class/*.jpg"""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if fname.lower().endswith((".jpg", ".jpeg", ".png", ".npy")):
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        fname, label = self.items[idx]
+        if fname.endswith(".npy"):
+            img = onp.load(fname)
+        else:
+            from PIL import Image
+
+            img = onp.asarray(Image.open(fname).convert("RGB" if self._flag else "L"))
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

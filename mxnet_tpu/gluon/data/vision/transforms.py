@@ -1,0 +1,266 @@
+"""Vision transforms (reference ``python/mxnet/gluon/data/vision/transforms.py``
+over the image aug kernels in ``src/operator/image/``). Transforms operate on
+host numpy HWC uint8/float32 (the loader uploads at the batch boundary)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as onp
+
+from ....base import MXNetError
+from ....ndarray.ndarray import ndarray
+from .... import numpy as np
+
+__all__ = [
+    "Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+    "RandomResizedCrop", "RandomCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+    "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomLighting",
+    "RandomColorJitter", "Pad",
+]
+
+
+def _hwc(img):
+    if isinstance(img, ndarray):
+        img = img.asnumpy()
+    return onp.asarray(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self._transforms = list(transforms)
+
+    def __call__(self, img, label=None):
+        for t in self._transforms:
+            img = t(img)
+        if label is None:
+            return img
+        return img, label
+
+
+class Cast:
+    def __init__(self, dtype="float32"):
+        self._dtype = dtype
+
+    def __call__(self, img):
+        return _hwc(img).astype(self._dtype)
+
+
+class ToTensor:
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference ToTensor)."""
+
+    def __call__(self, img):
+        img = _hwc(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return (img.astype(onp.float32) / 255.0).transpose(2, 0, 1)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0):
+        self._mean = onp.asarray(mean, onp.float32).reshape(-1, 1, 1)
+        self._std = onp.asarray(std, onp.float32).reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        img = _hwc(img)
+        if img.ndim == 3 and img.shape[0] not in (1, 3):  # HWC -> error guard
+            raise MXNetError("Normalize expects CHW input (apply ToTensor first)")
+        return (img - self._mean) / self._std
+
+
+def _resize_hwc(img, size):
+    """Bilinear resize without cv2 (vectorized numpy)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        ow, oh = size, size
+    else:
+        ow, oh = size
+    if (h, w) == (oh, ow):
+        return img
+    ys = onp.linspace(0, h - 1, oh)
+    xs = onp.linspace(0, w - 1, ow)
+    y0 = onp.floor(ys).astype(int)
+    x0 = onp.floor(xs).astype(int)
+    y1 = onp.minimum(y0 + 1, h - 1)
+    x1 = onp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    img_f = img.astype(onp.float32)
+    if img_f.ndim == 2:
+        img_f = img_f[:, :, None]
+    top = img_f[y0][:, x0] * (1 - wx) + img_f[y0][:, x1] * wx
+    bot = img_f[y1][:, x0] * (1 - wx) + img_f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if img.dtype == onp.uint8:
+        out = onp.clip(onp.round(out), 0, 255).astype(onp.uint8)
+    return out
+
+
+class Resize:
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        self._size = size
+        self._keep = keep_ratio
+
+    def __call__(self, img):
+        img = _hwc(img)
+        if self._keep:
+            h, w = img.shape[:2]
+            short = self._size if isinstance(self._size, int) else min(self._size)
+            scale = short / min(h, w)
+            return _resize_hwc(img, (int(round(w * scale)), int(round(h * scale))))
+        return _resize_hwc(img, self._size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = _hwc(img)
+        h, w = img.shape[:2]
+        cw, ch = self._size
+        x0 = max(0, (w - cw) // 2)
+        y0 = max(0, (h - ch) // 2)
+        out = img[y0 : y0 + ch, x0 : x0 + cw]
+        if out.shape[:2] != (ch, cw):
+            out = _resize_hwc(img, self._size)
+        return out
+
+
+class RandomCrop:
+    def __init__(self, size, pad=None, pad_value=0):
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+        self._pad_value = pad_value
+
+    def __call__(self, img):
+        img = _hwc(img)
+        if self._pad:
+            p = self._pad
+            img = onp.pad(img, ((p, p), (p, p), (0, 0)), constant_values=self._pad_value)
+        h, w = img.shape[:2]
+        cw, ch = self._size
+        if h < ch or w < cw:
+            img = _resize_hwc(img, (max(cw, w), max(ch, h)))
+            h, w = img.shape[:2]
+        y0 = onp.random.randint(0, h - ch + 1)
+        x0 = onp.random.randint(0, w - cw + 1)
+        return img[y0 : y0 + ch, x0 : x0 + cw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def __call__(self, img):
+        img = _hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = onp.random.uniform(*self._scale) * area
+            aspect = onp.exp(onp.random.uniform(onp.log(self._ratio[0]), onp.log(self._ratio[1])))
+            cw = int(round(onp.sqrt(target_area * aspect)))
+            ch = int(round(onp.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                x0 = onp.random.randint(0, w - cw + 1)
+                y0 = onp.random.randint(0, h - ch + 1)
+                return _resize_hwc(img[y0 : y0 + ch, x0 : x0 + cw], self._size)
+        return _resize_hwc(img, self._size)
+
+
+class RandomFlipLeftRight:
+    def __call__(self, img):
+        img = _hwc(img)
+        if onp.random.rand() < 0.5:
+            return img[:, ::-1]
+        return img
+
+
+class RandomFlipTopBottom:
+    def __call__(self, img):
+        img = _hwc(img)
+        if onp.random.rand() < 0.5:
+            return img[::-1]
+        return img
+
+
+class RandomBrightness:
+    def __init__(self, brightness):
+        self._b = brightness
+
+    def __call__(self, img):
+        img = _hwc(img).astype(onp.float32)
+        alpha = 1.0 + onp.random.uniform(-self._b, self._b)
+        return img * alpha
+
+
+class RandomContrast:
+    def __init__(self, contrast):
+        self._c = contrast
+
+    def __call__(self, img):
+        img = _hwc(img).astype(onp.float32)
+        alpha = 1.0 + onp.random.uniform(-self._c, self._c)
+        gray = img.mean()
+        return img * alpha + gray * (1 - alpha)
+
+
+class RandomSaturation:
+    def __init__(self, saturation):
+        self._s = saturation
+
+    def __call__(self, img):
+        img = _hwc(img).astype(onp.float32)
+        alpha = 1.0 + onp.random.uniform(-self._s, self._s)
+        gray = img.mean(axis=2, keepdims=True)
+        return img * alpha + gray * (1 - alpha)
+
+
+class RandomLighting:
+    """AlexNet-style PCA lighting noise (reference RandomLighting)."""
+
+    _eigval = onp.array([55.46, 4.794, 1.148], onp.float32)
+    _eigvec = onp.array(
+        [[-0.5675, 0.7192, 0.4009], [-0.5808, -0.0045, -0.814], [-0.5836, -0.6948, 0.4203]],
+        onp.float32,
+    )
+
+    def __init__(self, alpha):
+        self._alpha = alpha
+
+    def __call__(self, img):
+        img = _hwc(img).astype(onp.float32)
+        alpha = onp.random.normal(0, self._alpha, 3).astype(onp.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return img + rgb
+
+
+class RandomColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def __call__(self, img):
+        ts = list(self._ts)
+        onp.random.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class Pad:
+    def __init__(self, padding, fill=0):
+        self._p = (padding,) * 4 if isinstance(padding, int) else tuple(padding)
+        self._fill = fill
+
+    def __call__(self, img):
+        img = _hwc(img)
+        l, t, r, b = self._p
+        pads = ((t, b), (l, r)) + (((0, 0),) if img.ndim == 3 else ())
+        return onp.pad(img, pads, constant_values=self._fill)

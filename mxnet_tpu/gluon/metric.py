@@ -1,0 +1,307 @@
+"""Evaluation metrics (reference ``python/mxnet/gluon/metric.py``)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError, registry
+from ..ndarray.ndarray import ndarray
+
+__all__ = [
+    "EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
+    "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
+    "Perplexity", "PearsonCorrelation", "Loss", "create",
+]
+
+
+def _to_np(x):
+    if isinstance(x, ndarray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def register(cls):
+    registry.register("metric", cls.__name__)(cls)
+    return cls
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m))
+        return composite
+    if callable(metric):
+        return _CustomMetric(metric)
+    return registry.get("metric", metric)(*args, **kwargs)
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+class _CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None):
+        super().__init__(name or feval.__name__)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for l, p in zip(_as_list(labels), _as_list(preds)):
+            self.sum_metric += self._feval(_to_np(l), _to_np(p))
+            self.num_inst += 1
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite"):
+        super().__init__(name)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.extend(n if isinstance(n, list) else [n])
+            values.extend(v if isinstance(v, list) else [v])
+        return names, values
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kw):
+        super().__init__(name, **kw)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _to_np(pred), _to_np(label)
+            if pred.ndim > label.ndim:
+                pred = onp.argmax(pred, axis=self.axis)
+            pred = pred.astype("int64").ravel()
+            label = label.astype("int64").ravel()
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+acc = Accuracy
+registry.register("metric", "acc")(Accuracy)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kw):
+        super().__init__(f"{name}_{top_k}", **kw)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _to_np(pred), _to_np(label).astype("int64")
+            topk = onp.argsort(-pred, axis=-1)[..., : self.top_k]
+            hits = (topk == label[..., None]).any(axis=-1)
+            self.sum_metric += float(hits.sum())
+            self.num_inst += hits.size
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kw):
+        self.average = average
+        super().__init__(name, **kw)
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _to_np(pred), _to_np(label).ravel()
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = onp.argmax(pred, axis=-1)
+            else:
+                pred = (pred.ravel() > 0.5).astype("int64")
+            pred = pred.ravel()
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += 1
+
+    def get(self):
+        prec = self._tp / max(self._tp + self._fp, 1e-12)
+        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return self.name, f1 if self.num_inst else float("nan")
+
+
+@register
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", **kw):
+        super().__init__(name, **kw)
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _to_np(pred), _to_np(label).ravel()
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = onp.argmax(pred, axis=-1)
+            else:
+                pred = (pred.ravel() > 0.5).astype("int64")
+            pred = pred.ravel()
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self._tn += float(((pred == 0) & (label == 0)).sum())
+            self.num_inst += 1
+
+    def get(self):
+        tp, fp, fn, tn = self._tp, self._fp, self._fn, self._tn
+        denom = onp.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        mcc = (tp * tn - fp * fn) / denom if denom else 0.0
+        return self.name, mcc if self.num_inst else float("nan")
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _to_np(label), _to_np(pred)
+            self.sum_metric += float(onp.abs(label - pred.reshape(label.shape)).mean())
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _to_np(label), _to_np(pred)
+            self.sum_metric += float(((label - pred.reshape(label.shape)) ** 2).mean())
+            self.num_inst += 1
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kw):
+        EvalMetric.__init__(self, name, **kw)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(onp.sqrt(self.sum_metric / self.num_inst))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kw):
+        super().__init__(name, **kw)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel().astype("int64")
+            pred = _to_np(pred)
+            prob = pred[onp.arange(label.shape[0]), label]
+            self.sum_metric += float((-onp.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kw):
+        CrossEntropy.__init__(self, eps, name, **kw)
+
+
+@register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kw):
+        CrossEntropy.__init__(self, 1e-12, name, **kw)
+        self.ignore_label = ignore_label
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(onp.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kw):
+        super().__init__(name, **kw)
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            self._labels.append(_to_np(label).ravel())
+            self._preds.append(_to_np(pred).ravel())
+            self.num_inst += 1
+
+    def get(self):
+        if not self._labels:
+            return self.name, float("nan")
+        l = onp.concatenate(self._labels)
+        p = onp.concatenate(self._preds)
+        return self.name, float(onp.corrcoef(l, p)[0, 1])
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, _, preds):
+        for pred in _as_list(preds):
+            loss = _to_np(pred)
+            self.sum_metric += float(loss.sum())
+            self.num_inst += loss.size

@@ -1,0 +1,5 @@
+"""``mx.gluon.nn`` — neural network layers."""
+from .basic_layers import *  # noqa: F401,F403
+from .conv_layers import *  # noqa: F401,F403
+from .norm_layers import *  # noqa: F401,F403
+from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401

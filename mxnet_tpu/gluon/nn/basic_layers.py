@@ -1,0 +1,284 @@
+"""Basic Gluon layers (reference ``python/mxnet/gluon/nn/basic_layers.py``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import ndarray
+from ... import numpy_extension as npx
+from ... import numpy as np
+from ... import initializer as init_mod
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = [
+    "Sequential",
+    "HybridSequential",
+    "Dense",
+    "Dropout",
+    "Flatten",
+    "Activation",
+    "LeakyReLU",
+    "PReLU",
+    "ELU",
+    "SELU",
+    "GELU",
+    "SiLU",
+    "Swish",
+    "Embedding",
+    "Lambda",
+    "HybridLambda",
+    "Identity",
+    "Concatenate",
+    "HybridConcatenate",
+]
+
+
+class Sequential(Block):
+    """Stack of blocks (reference basic_layers.py Sequential)."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)):
+                args = tuple(x[1:])
+                x = x[0]
+        return (x,) + args if args else x
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        items = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*items[key])
+            return net
+        return items[key]
+
+
+class HybridSequential(Sequential, HybridBlock):
+    def __init__(self, *blocks):
+        HybridBlock.__init__(self)
+        for b in blocks:
+            self.add(b)
+
+    forward = Sequential.forward
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (reference basic_layers.py Dense; kernel
+    src/operator/nn/fully_connected.cc). weight shape (units, in_units)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None, bias_initializer="zeros",
+                 in_units=0):
+        super().__init__()
+        self._units = units
+        self._flatten = flatten
+        self.act = activation
+        self.weight = Parameter(
+            "weight", shape=(units, in_units), dtype=dtype,
+            init=weight_initializer, allow_deferred_init=True,
+        )
+        self.bias = (
+            Parameter("bias", shape=(units,), dtype=dtype, init=bias_initializer)
+            if use_bias
+            else None
+        )
+
+    def forward(self, x):
+        if not self.weight.shape_known:
+            in_units = (
+                int(onp.prod(x.shape[1:])) if self._flatten else x.shape[-1]
+            )
+            self.weight.shape = (self._units, in_units)
+            self.weight.finalize()
+        out = npx.fully_connected(
+            x,
+            self.weight.data(),
+            self.bias.data() if self.bias is not None else None,
+            num_hidden=self._units,
+            flatten=self._flatten,
+            no_bias=self.bias is None,
+        )
+        if self.act is not None:
+            out = npx.activation(out, act_type=self.act)
+        return out
+
+    def __repr__(self):
+        return f"Dense({self._units}, {self.weight.shape})"
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return npx.dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation):
+        super().__init__()
+        self._act_type = activation
+
+    def forward(self, x):
+        return npx.activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=init_mod.Constant(0.25), in_channels=1):
+        super().__init__()
+        self.alpha = Parameter("alpha", shape=(in_channels,), init=alpha_initializer)
+
+    def forward(self, x):
+        return npx.leaky_relu(x, gamma=self.alpha.data(), act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf"):
+        super().__init__()
+        self._approx = approximation
+
+    def forward(self, x):
+        return npx.gelu(x, approximate=self._approx == "tanh")
+
+
+class SiLU(HybridBlock):
+    def forward(self, x):
+        return npx.activation(x, act_type="silu")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0):
+        super().__init__()
+        self._beta = beta
+
+    def forward(self, x):
+        from ... import numpy as mxnp
+
+        return x * mxnp.sigmoid(self._beta * x)
+
+
+class Embedding(HybridBlock):
+    """reference basic_layers.py Embedding (indexing_op.cc kernel)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False):
+        super().__init__()
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer, grad_stype="row_sparse" if sparse_grad else "default",
+        )
+
+    def forward(self, x):
+        return npx.embedding(x, self.weight.data(), self._input_dim, self._output_dim)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Lambda(Block):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            function = getattr(np, function, None) or getattr(npx, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            function = getattr(np, function, None) or getattr(npx, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Concatenate(Sequential):
+    """Run children on the same input, concat outputs (reference contrib)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        outs = [block(x) for block in self._children.values()]
+        return np.concatenate(outs, axis=self.axis)
+
+
+class HybridConcatenate(HybridSequential):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        outs = [block(x) for block in self._children.values()]
+        return np.concatenate(outs, axis=self.axis)

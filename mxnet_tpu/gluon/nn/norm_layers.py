@@ -1,0 +1,166 @@
+"""Normalization layers (reference ``gluon/nn/basic_layers.py`` BatchNorm/
+LayerNorm/GroupNorm/InstanceNorm over ``src/operator/nn/*_norm*.cc``).
+
+BatchNorm's running statistics are Parameters with grad_req='null'; in
+eager mode they are updated in place by npx.batch_norm, and under a
+hybridized trace the HybridBlock cached-op captures the updates as extra
+outputs (see gluon/block.py) — same observable behavior as the reference's
+aux states, functional underneath.
+"""
+from __future__ import annotations
+
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "RMSNorm", "SyncBatchNorm"]
+
+
+class BatchNorm(HybridBlock):
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, dtype="float32"):
+        super().__init__()
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, dtype=dtype,
+                               init=gamma_initializer, allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, dtype=dtype,
+                              init=beta_initializer, allow_deferred_init=True,
+                              differentiable=center)
+        self.running_mean = Parameter("running_mean", shape=shape, dtype="float32",
+                                      init=running_mean_initializer,
+                                      allow_deferred_init=True, differentiable=False)
+        self.running_var = Parameter("running_var", shape=shape, dtype="float32",
+                                     init=running_variance_initializer,
+                                     allow_deferred_init=True, differentiable=False)
+
+    def _finalize(self, x):
+        ch = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if not p.shape_known:
+                p.shape = (ch,)
+                p.finalize()
+
+    def forward(self, x):
+        self._finalize(x)
+        return npx.batch_norm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale, use_global_stats=self._use_global_stats,
+            axis=self._axis,
+        )
+
+    def __repr__(self):
+        return f"BatchNorm(axis={self._axis}, eps={self._epsilon}, momentum={self._momentum})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference src/operator/contrib/sync_batch_norm.cc).
+    Under pjit/shard_map the batch axis is already global — XLA computes
+    global statistics when the reduction spans the sharded axis — so inside
+    the mesh this is BatchNorm; kept as a distinct class for API parity."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, dtype="float32"):
+        super().__init__()
+        self._axis = axis
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, dtype=dtype,
+                               init=gamma_initializer, allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, dtype=dtype,
+                              init=beta_initializer, allow_deferred_init=True,
+                              differentiable=center)
+
+    def forward(self, x):
+        ch = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if not p.shape_known:
+                p.shape = (ch,)
+                p.finalize()
+        return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._epsilon)
+
+    def __repr__(self):
+        return f"LayerNorm(axis={self._axis}, eps={self._epsilon})"
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, dtype="float32"):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, dtype=dtype,
+                               init=gamma_initializer, allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape, dtype=dtype,
+                              init=beta_initializer, allow_deferred_init=True)
+
+    def forward(self, x):
+        ch = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if not p.shape_known:
+                p.shape = (ch,)
+                p.finalize()
+        return npx.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, dtype="float32"):
+        super().__init__()
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, dtype=dtype,
+                               init=gamma_initializer, allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape, dtype=dtype,
+                              init=beta_initializer, allow_deferred_init=True)
+
+    def forward(self, x):
+        ch = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if not p.shape_known:
+                p.shape = (ch,)
+                p.finalize()
+        return npx.instance_norm(x, self.gamma.data(), self.beta.data(), eps=self._epsilon)
+
+
+class RMSNorm(HybridBlock):
+    """Modern-transformer norm (no reference counterpart; TPU-era addition)."""
+
+    def __init__(self, axis=-1, epsilon=1e-6, gamma_initializer="ones",
+                 in_channels=0, dtype="float32"):
+        super().__init__()
+        self._axis = axis
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, dtype=dtype,
+                               init=gamma_initializer, allow_deferred_init=True)
+
+    def forward(self, x):
+        ch = x.shape[self._axis]
+        if not self.gamma.shape_known:
+            self.gamma.shape = (ch,)
+            self.gamma.finalize()
+        return npx.rms_norm(x, self.gamma.data(), axis=self._axis, eps=self._epsilon)

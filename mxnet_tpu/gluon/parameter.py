@@ -1,0 +1,225 @@
+"""Gluon Parameter (reference ``python/mxnet/gluon/parameter.py``, 1,081
+lines: lazy-shape Parameter, sharing, deferred init).
+
+TPU-native notes: a Parameter owns ONE logical array (a jax.Array that may
+itself be sharded over the mesh) instead of the reference's per-GPU replica
+list — replication is the mesh's job (pjit), not the Parameter's. The
+deferred-init contract (shape with 0/-1 entries completed at first forward)
+is kept exactly, since Gluon layers rely on it.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError, dtype_from_any
+from ..context import Context, current_context
+from ..ndarray.ndarray import ndarray, _wrap
+from .. import initializer as init_mod
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its deferred shape/init completed."""
+
+
+def _shape_known(shape) -> bool:
+    return shape is not None and all(int(s) > 0 for s in shape)
+
+
+class Parameter:
+    """A trainable tensor with init/grad/sharding metadata."""
+
+    def __init__(
+        self,
+        name: str = "weight",
+        grad_req: str = "write",
+        shape=None,
+        dtype="float32",
+        lr_mult: float = 1.0,
+        wd_mult: float = 1.0,
+        init=None,
+        allow_deferred_init: bool = False,
+        differentiable: bool = True,
+        stype: str = "default",
+        grad_stype: str = "default",
+    ):
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype_from_any(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.grad_req = grad_req if differentiable else "null"
+        self._differentiable = differentiable
+        self.stype = stype
+        self.grad_stype = grad_stype
+        self._data: Optional[ndarray] = None
+        self._deferred_init: Optional[tuple] = None  # (init, ctx)
+        # sharding annotation for the parallel layer (PartitionSpec-like)
+        self.sharding = None
+
+    # -- naming ------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @name.setter
+    def name(self, value):
+        self._name = value
+
+    # -- shape (deferred completion) --------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(
+            s1 == s2 or int(s1) <= 0 for s1, s2 in zip(self._shape, new_shape)
+        ) and len(self._shape) == len(new_shape)
+        if not unknown_ok:
+            raise MXNetError(
+                f"cannot update shape of {self.name} from {self._shape} to {new_shape}"
+            )
+        self._shape = tuple(new_shape)
+
+    @property
+    def shape_known(self) -> bool:
+        return _shape_known(self._shape)
+
+    # -- initialization ----------------------------------------------------
+    def initialize(self, init=None, device=None, ctx=None, default_init=None, force_reinit=False):
+        ctx = ctx or device
+        if self._data is not None and not force_reinit:
+            return
+        self._deferred_init = (
+            init or self.init or default_init or init_mod.Uniform(0.07),
+            ctx,
+        )
+        if self.shape_known:
+            self._finish_deferred_init()
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not self.shape_known:
+            if not self.allow_deferred_init:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has unknown shape {self._shape} and "
+                    "allow_deferred_init=False"
+                )
+            return
+        initializer, ctx = self._deferred_init
+        initializer = init_mod.create(initializer) if not isinstance(initializer, init_mod.Initializer) else initializer
+        arr = ndarray(onp.zeros(self._shape, self.dtype), ctx=ctx)
+        initializer.init_array(self.name, arr)
+        self._data = arr
+        self._deferred_init = None
+        if self.grad_req != "null":
+            self._data.attach_grad(self.grad_req)
+
+    def finalize(self):
+        """Complete deferred init once shape is known (called by layers)."""
+        if self._data is None and self._deferred_init is not None:
+            self._finish_deferred_init()
+
+    # -- access ------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} deferred; run a forward pass or set shape"
+                )
+            raise MXNetError(
+                f"Parameter {self.name} has not been initialized; call .initialize()"
+            )
+
+    def data(self, ctx=None) -> ndarray:
+        self._check_initialized()
+        return self._data
+
+    def list_data(self) -> List[ndarray]:
+        return [self.data()]
+
+    def set_data(self, data):
+        if isinstance(data, ndarray):
+            data = data._data
+        if self._data is None:
+            self._shape = tuple(data.shape)
+            self._data = _wrap(jnp.asarray(data, self.dtype))
+            if self.grad_req != "null":
+                self._data.attach_grad(self.grad_req)
+        else:
+            if tuple(data.shape) != tuple(self._shape):
+                raise MXNetError(
+                    f"shape mismatch setting {self.name}: {data.shape} vs {self._shape}"
+                )
+            self._data._set_data(jnp.asarray(data, self.dtype))
+
+    def grad(self, ctx=None) -> ndarray:
+        self._check_initialized()
+        if self._data._grad is None:
+            raise MXNetError(f"Parameter {self.name} has grad_req='null'")
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            g = self._data._grad
+            g._set_data(jnp.zeros(g.shape, g.dtype))
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_ctx(ctx if isinstance(ctx, Context) else ctx[0])
+
+    reset_device = reset_ctx
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.ctx]
+
+    list_device = list_ctx
+
+    def cast(self, dtype):
+        self.dtype = dtype_from_any(dtype)
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data = self._data.astype(self.dtype)
+            if had_grad:
+                self._data.attach_grad(self.grad_req)
+
+    def var(self):
+        raise NotImplementedError("symbol var() not supported; use hybridize tracing")
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={onp.dtype(self.dtype).name})"
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (reference gluon/parameter.py Constant)."""
+
+    def __init__(self, value, name="const"):
+        if not isinstance(value, ndarray):
+            value = ndarray(value)
+        super().__init__(
+            name=name,
+            grad_req="null",
+            shape=value.shape,
+            dtype=value.dtype,
+            differentiable=False,
+        )
+        self._value = value
+        self.init = init_mod.Constant(value)
+
+    def initialize(self, *a, **kw):
+        self._data = self._value.copy()

@@ -1,0 +1,228 @@
+"""Gluon Trainer (reference ``python/mxnet/gluon/trainer.py``, 531 lines:
+``_init_kvstore :183``, ``step :329``, ``_allreduce_grads :358``).
+
+TPU-native design: the per-parameter update loop becomes ONE jitted XLA
+program over the whole parameter pytree (weights, grads, states donated →
+in-place buffer reuse), which is what the reference's aggregated/fused
+optimizer kernels (multi_sgd_update, multi_lamb) hand-write in CUDA.
+Gradient allreduce goes through the kvstore seam: 'local'/'device' are
+identity on a single logical copy; 'dist_tpu_sync' runs jax.lax.psum over
+the mesh (see mxnet_tpu/kvstore/).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+from .. import optimizer as opt_mod
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(
+        self,
+        params,
+        optimizer,
+        optimizer_params: Optional[dict] = None,
+        kvstore: str = "device",
+        compression_params: Optional[dict] = None,
+        update_on_kvstore: Optional[bool] = None,
+    ):
+        if isinstance(params, dict):
+            self._param_names = list(params.keys())
+            self._params: List[Parameter] = list(params.values())
+        elif isinstance(params, (list, tuple)):
+            self._param_names = [p.name for p in params]
+            self._params = list(params)
+        else:
+            raise MXNetError("params must be a dict or list of Parameter")
+        for p in self._params:
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"not a Parameter: {p!r}")
+
+        optimizer_params = optimizer_params or {}
+        self._optimizer = (
+            optimizer
+            if isinstance(optimizer, opt_mod.Optimizer)
+            else opt_mod.create(optimizer, **optimizer_params)
+        )
+        self._optimizer.idx2name = dict(enumerate(self._param_names))
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._compression_params = compression_params
+        self._states: Dict[int, tuple] = {}
+        self._states_ready = False
+        self._jit_step = None
+        self._jit_safe = getattr(self._optimizer, "jit_safe", True)
+
+    # -- properties --------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- kvstore -----------------------------------------------------------
+    def _init_kvstore(self):
+        from .. import kvstore as kv_mod
+
+        if self._kvstore_type and self._kvstore_type not in ("none", "null"):
+            self._kvstore = kv_mod.create(self._kvstore_type)
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(self._compression_params)
+        self._kv_initialized = True
+
+    def _init_states(self):
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                self._states[i] = self._optimizer.create_state_multi_precision(i, p.data())
+        self._states_ready = True
+
+    # -- the public step contract -----------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + update (reference trainer.py:329)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if not self._states_ready:
+            self._init_states()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None:
+                # priority = -i: comm for late layers first, overlapping
+                # backward (reference trainer.py:402 P3 behavior)
+                self._kvstore.pushpull(i, p.grad(), out=p.grad(), priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if not self._states_ready:
+            self._init_states()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    # -- fused XLA update path --------------------------------------------
+    def _build_jit_step(self, idxs):
+        opt = self._optimizer
+        lr_mults = [opt._get_lr(i) / max(opt.learning_rate, 1e-30) for i in idxs]
+        wds = [opt._get_wd(i) for i in idxs]
+        rescale = None  # passed as arg
+
+        def fused(weights, grads, states, lr, rescale_grad, t):
+            new_w, new_s = [], []
+            for w, g, s, lm, wd in zip(weights, grads, states, lr_mults, wds):
+                g = g * rescale_grad
+                if opt.clip_gradient is not None:
+                    g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+                if (
+                    opt.multi_precision
+                    and len(s) == 2
+                    and isinstance(s[0], jax.Array)
+                    and s[0].dtype == jnp.float32
+                    and w.dtype in (jnp.float16, jnp.bfloat16)
+                ):
+                    master, inner = s
+                    out = opt.update_step(master, g.astype(jnp.float32), inner, lr * lm, wd, t)
+                    new_w.append(out[0].astype(w.dtype))
+                    new_s.append((out[0], tuple(out[1:])))
+                else:
+                    out = opt.update_step(w, g, s, lr * lm, wd, t)
+                    # dtype stability: under x64, scalar-promotion (e.g.
+                    # beta**t) can silently widen to f64 — pin to input dtypes
+                    new_w.append(out[0].astype(w.dtype))
+                    new_s.append(
+                        tuple(ns.astype(os_.dtype) for ns, os_ in zip(out[1:], s))
+                    )
+            return new_w, new_s
+
+        return jax.jit(fused, donate_argnums=(0, 2))
+
+    def _update(self, ignore_stale_grad=False):
+        opt = self._optimizer
+        idxs = [i for i, p in enumerate(self._params) if p.grad_req != "null" and p._data is not None]
+        if not idxs:
+            return
+        if not self._jit_safe:
+            for i in idxs:
+                p = self._params[i]
+                opt.update(i, p.data(), p.grad(), self._states[i])
+                self._states[i] = opt._latest_states[i]
+            return
+
+        if self._jit_step is None:
+            self._jit_step = self._build_jit_step(idxs)
+            self._jit_idxs = idxs
+        elif idxs != self._jit_idxs:
+            self._jit_step = self._build_jit_step(idxs)
+            self._jit_idxs = idxs
+
+        for i in idxs:
+            opt._update_count(i)
+        t = opt._index_update_count[idxs[0]]
+
+        weights = [_unwrap(self._params[i].data()) for i in idxs]
+        grads = [_unwrap(self._params[i].grad()) for i in idxs]
+        states = [self._states[i] for i in idxs]
+        new_w, new_s = self._jit_step(
+            weights,
+            grads,
+            states,
+            jnp.float32(opt.learning_rate),
+            jnp.float32(opt.rescale_grad),
+            jnp.int32(t),
+        )
+        for i, w, s in zip(idxs, new_w, new_s):
+            self._params[i].data()._set_data(w)
+            self._states[i] = s
+
+    # -- optimizer-state checkpoint (reference trainer.py:472/:501) --------
+    def save_states(self, fname):
+        import pickle
+
+        payload = {
+            "num_update": self._optimizer.num_update,
+            "index_update_count": self._optimizer._index_update_count,
+            "states": {
+                i: jax.tree_util.tree_map(lambda a: onp.asarray(a), s)
+                for i, s in self._states.items()
+            },
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        import pickle
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        self._optimizer.num_update = payload["num_update"]
+        self._optimizer._index_update_count = payload["index_update_count"]
+        self._states = {
+            i: jax.tree_util.tree_map(lambda a: jnp.asarray(a), s)
+            for i, s in payload["states"].items()
+        }
+        self._states_ready = True

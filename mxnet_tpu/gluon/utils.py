@@ -1,0 +1,97 @@
+"""Gluon utilities (reference ``python/mxnet/gluon/utils.py``):
+``split_and_load`` (the data-parallel batch splitter), ``clip_global_norm``,
+download helpers."""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import ndarray
+from .. import numpy as np
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1", "download"]
+
+
+def split_data(data: ndarray, num_slice: int, batch_axis: int = 0, even_split: bool = True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"batch size {size} not divisible by {num_slice} slices; "
+            "set even_split=False"
+        )
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list: Sequence[Context], batch_axis: int = 0, even_split: bool = True):
+    """Split a batch across contexts (reference utils.py split_and_load;
+    docs/.../distributed_training.md:88). On the TPU mesh the idiomatic
+    path is sharding, but the per-device list API is kept for script parity."""
+    if not isinstance(data, ndarray):
+        data = np.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_ctx(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_ctx(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[ndarray], max_norm: float, check_isfinite: bool = True):
+    """reference utils.py clip_global_norm"""
+    if not arrays:
+        raise MXNetError("arrays must not be empty")
+    total = 0.0
+    norms = [np.sum(np.square(a)) for a in arrays]
+    total_norm = float(np.sqrt(sum(n.item() for n in norms)))
+    if check_isfinite and not onp.isfinite(total_norm):
+        import warnings
+
+        warnings.warn("nan or inf in gradients, no clipping applied")
+        return total_norm
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a._data * scale)
+    return total_norm
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url: str, path: Optional[str] = None, overwrite: bool = False,
+             sha1_hash: Optional[str] = None, retries: int = 5, verify_ssl: bool = True):
+    """Kept for API parity; this environment has zero egress, so download
+    only succeeds for file:// URLs or already-present files."""
+    fname = path or url.split("/")[-1]
+    if os.path.isdir(fname):
+        fname = os.path.join(fname, url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    if url.startswith("file://"):
+        import shutil
+
+        shutil.copyfile(url[7:], fname)
+        return fname
+    raise MXNetError(
+        f"cannot download {url}: no network egress in this environment; "
+        "place the file at the target path instead"
+    )

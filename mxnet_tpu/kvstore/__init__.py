@@ -1,3 +1,32 @@
-"""``mx.kv`` — KVStore (placeholder, filled in M8)."""
-def create(name="local"):
-    raise NotImplementedError("kvstore lands in a later milestone")
+"""``mx.kv`` — key-value stores for parameter synchronization.
+
+Factory parity: reference ``src/kvstore/kvstore.cc:41`` ``KVStore::Create``
+with type strings local/device/nccl/dist_sync/dist_device_sync/dist_async/
+p3 — plus the TPU-native ``dist_tpu_sync`` mode (SURVEY.md §2.3): PushPull
+as in-graph allreduce over the ICI/DCN mesh instead of ps-lite RPC.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .base import KVStoreBase  # noqa: F401
+from .kvstore import KVStore, KVStoreLocal, KVStoreTPU  # noqa: F401
+from .gradient_compression import GradientCompression  # noqa: F401
+
+_LOCAL_TYPES = ("local", "device", "nccl", "local_allreduce_cpu", "local_allreduce_device")
+_DIST_TYPES = ("dist_tpu_sync", "dist_sync", "dist_device_sync", "dist_sync_device", "horovod", "byteps", "p3")
+
+
+def create(name: str = "local"):
+    name = (name or "local").lower()
+    if name in _LOCAL_TYPES:
+        return KVStoreLocal(name)
+    if name in _DIST_TYPES:
+        return KVStoreTPU(name)
+    if name == "dist_async":
+        raise MXNetError(
+            "dist_async (server-applied async updates) has no in-graph TPU "
+            "equivalent and is out of scope by design; use dist_tpu_sync"
+        )
+    if name in KVStoreBase.kv_registry:
+        return KVStoreBase.kv_registry[name]()
+    raise MXNetError(f"unknown kvstore type {name!r}")

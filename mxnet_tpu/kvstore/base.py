@@ -1,0 +1,51 @@
+"""KVStore plugin ABC + registry (reference ``python/mxnet/kvstore/base.py:74``
+``KVStoreBase`` with ``pushpull :98``, ``broadcast :77``, registry ``:245``).
+
+This seam is what let the reference swap ps-lite for Horovod/BytePS without
+touching Trainer; here it is what lets ``dist_tpu_sync`` swap the parameter
+server for in-graph mesh collectives.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from ..base import MXNetError
+
+__all__ = ["KVStoreBase"]
+
+
+class KVStoreBase:
+    """Abstract key-value store for parameter synchronization."""
+
+    kv_registry: Dict[str, Type["KVStoreBase"]] = {}
+
+    OPTIMIZER = "optimizer"
+
+    @staticmethod
+    def register(klass: Type["KVStoreBase"]) -> Type["KVStoreBase"]:
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return False
+
+    # -- required interface -------------------------------------------------
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    @property
+    def type(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def rank(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def num_workers(self) -> int:
+        raise NotImplementedError

@@ -1,0 +1,216 @@
+"""KVStore implementations.
+
+Parity map (reference ``src/kvstore/``):
+- ``local`` / ``device``: single-process aggregation (``kvstore_local.h:70``,
+  ``comm.h:104 CommCPU`` / ``comm.h:452 CommDevice``). On TPU there is one
+  logical copy of each parameter (possibly mesh-sharded), so aggregation
+  over a list of per-device replicas degenerates to a sum — XLA's
+  all-reduce replaces the hand-written reduce trees (``comm_tree.h:50``).
+- ``nccl``: alias of ``device`` (``kvstore_nccl.h:62`` — NCCL's job is done
+  by ICI collectives).
+- ``dist_tpu_sync`` (+ ``dist_sync``/``dist_device_sync`` aliases): the
+  multi-host mode. Cross-host reduction uses jax multi-process collectives
+  over DCN; with one process it is exact-local. ``dist_async`` and
+  server-side optimizers have no sane in-graph equivalent and raise
+  (scoped out by design — SURVEY.md §7 hard parts).
+- 2-bit gradient compression: wired like ``kvstore_dist.h:390-397``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+from .base import KVStoreBase
+from .gradient_compression import GradientCompression
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreTPU"]
+
+
+def _sum_values(vals):
+    out = _unwrap(vals[0])
+    for v in vals[1:]:
+        out = out + _unwrap(v)
+    return out
+
+
+@KVStoreBase.register
+class KVStoreLocal(KVStoreBase):
+    """Single-process store (types: local, device, nccl)."""
+
+    def __init__(self, type_: str = "local"):
+        self._type = type_
+        self._store: Dict[Any, ndarray] = {}
+        self._updater = None
+        self._compression: Optional[GradientCompression] = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    # -- config ------------------------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        self._compression = GradientCompression(**compression_params)
+
+    def set_optimizer(self, optimizer):
+        """Server-side optimizer (reference kvstore_dist.h:78 set_updater)."""
+        from .. import optimizer as opt_mod
+
+        self._updater = opt_mod.get_updater(
+            opt_mod.create(optimizer) if isinstance(optimizer, str) else optimizer
+        )
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    # -- core ops (reference include/mxnet/kvstore.h:105-251) --------------
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy() if isinstance(v, ndarray) else ndarray(v)
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize_grouped(key, value)
+        for k, vals in zip(keys, values):
+            agg = _sum_values(vals)
+            if self._compression is not None:
+                agg = self._compression.compress(k, agg)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            if self._updater is not None:
+                self._updater(_int_key(k), _wrap(agg), self._store[k])
+            else:
+                self._pending = getattr(self, "_pending", {})
+                self._pending[k] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize_grouped(key, out)
+        for k, out_list in zip(keys, outs):
+            if self._updater is None and getattr(self, "_pending", {}).get(k) is not None:
+                val = self._pending[k]
+            else:
+                val = _unwrap(self._store[k])
+            for o in out_list:
+                o._set_data(jnp.asarray(val, o.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull — the Trainer hot path (reference
+        kvstore_dist.h:381 PushPullImpl)."""
+        keys, values = _normalize_grouped(key, value)
+        for k, vals in zip(keys, values):
+            agg = _sum_values(vals)
+            if self._compression is not None:
+                agg = self._compression.compress(k, agg)
+            targets = out if out is not None else value
+            t_keys, t_outs = _normalize_grouped(key, targets)
+            for o in t_outs[t_keys.index(k)]:
+                o._set_data(jnp.asarray(agg, o.dtype))
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull → gather of requested rows (reference sparse kvstore).
+        XLA has no sparse NDArray; rows are gathered densely."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        keys, outs = _normalize_grouped(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, out_list in zip(keys, outs):
+            full = _unwrap(self._store[k])
+            for o, rid in zip(out_list, rids * len(out_list)):
+                rows = jnp.take(full, _unwrap(rid).astype(jnp.int32), axis=0)
+                o._set_data(jnp.zeros_like(o._data).at[_unwrap(rid).astype(jnp.int32)].set(rows))
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+KVStore = KVStoreLocal
+
+
+@KVStoreBase.register
+class KVStoreTPU(KVStoreLocal):
+    """Multi-host synchronous store (type: dist_tpu_sync / dist_sync).
+
+    Cross-host gradient reduction over DCN; single-host runs degenerate to
+    local (exactly how the reference behaves with 1 worker). Inside a pjit
+    train step the reduction is in-graph psum over the mesh — see
+    mxnet_tpu.parallel — this object carries rank/size and the API surface.
+    """
+
+    def __init__(self, type_: str = "dist_tpu_sync"):
+        super().__init__(type_)
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count()
+
+    def pushpull(self, key, value, out=None, priority=0):
+        keys, values = _normalize_grouped(key, value)
+        for k, vals in zip(keys, values):
+            agg = _sum_values(vals)
+            if self._compression is not None:
+                agg = self._compression.compress(k, agg)
+            if self.num_workers > 1:
+                # DCN all-reduce across processes (jax collective over hosts)
+                from jax.experimental import multihost_utils
+
+                agg = multihost_utils.process_allgather(agg).sum(axis=0)
+            targets = out if out is not None else value
+            t_keys, t_outs = _normalize_grouped(key, targets)
+            for o in t_outs[t_keys.index(k)]:
+                o._set_data(jnp.asarray(agg, o.dtype))
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _normalize_grouped(key, value):
+    """Returns (keys, list-of-value-lists): kvstore accepts one array or a
+    per-device list per key (the local-aggregation API)."""
+    if isinstance(key, (list, tuple)):
+        keys = list(key)
+        values = [v if isinstance(v, (list, tuple)) else [v] for v in value]
+        return keys, values
+    if isinstance(value, (list, tuple)) and value and isinstance(value[0], (list, tuple)):
+        return [key], [list(value[0])]
+    if isinstance(value, (list, tuple)) and not isinstance(value, ndarray):
+        return [key], [list(value)]
+    return [key], [[value]]
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k

@@ -348,6 +348,23 @@ class ndarray:
             body = f"<abstract {self.shape} {self.dtype}>"
         return f"{body}\n<ndarray {self.shape} @{self.ctx} {self.dtype}>"
 
+    # pickle support (DataLoader workers, block export): device buffers
+    # travel as host numpy and are re-uploaded on unpickle
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "grad_req": self._grad_req}
+
+    def __setstate__(self, state):
+        self._data = jnp.asarray(state["data"])
+        self._grad = None
+        self._grad_req = "null"
+        self._fresh_grad_node = None
+        self._version = 0
+        if state.get("grad_req", "null") != "null":
+            self.attach_grad(state["grad_req"])
+
+    def __reduce__(self):
+        return (_rebuild_ndarray, (self.__getstate__(),))
+
     # ------------------------------------------------------------------
     # arithmetic operators
     # ------------------------------------------------------------------
@@ -459,6 +476,12 @@ def _unwrap_index(key):
     if isinstance(key, tuple):
         return tuple(_unwrap_index(k) for k in key)
     return key
+
+
+def _rebuild_ndarray(state):
+    out = ndarray.__new__(ndarray)
+    out.__setstate__(state)
+    return out
 
 
 NDArray = ndarray

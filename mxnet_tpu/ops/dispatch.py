@@ -66,6 +66,7 @@ class TapeNode:
         "inputs",
         "n_out",
         "out_ids",
+        "out_refs",
         "out_avals",
         "name",
         "req_grad",
@@ -77,6 +78,9 @@ class TapeNode:
         self.inputs = inputs  # list of ndarray refs (keeps leaves alive)
         self.n_out = n_out
         self.out_ids: List[int] = []
+        # strong refs: producer-map keys are id()s, so output objects must
+        # stay alive for the tape's lifetime or ids could be recycled
+        self.out_refs: List[Any] = []
         self.out_avals = out_avals  # [(shape, dtype)] for zero cotangents
         self.name = name
         self.req_grad = True
@@ -95,8 +99,20 @@ class Tape:
         self.nodes.append(node)
         for slot, out in enumerate(outputs):
             node.out_ids.append(id(out))
+            node.out_refs.append(out)
             self.producer[id(out)] = (idx, slot)
             out._fresh_grad_node = (idx, slot)
+
+    def alias(self, original: Any, replacement: Any) -> None:
+        """Register ``replacement`` as another handle for ``original``'s
+        tape slot (re-wrapped cached-op outputs)."""
+        entry = self.producer.get(id(original))
+        if entry is None:
+            return
+        idx, slot = entry
+        self.producer[id(replacement)] = entry
+        self.nodes[idx].out_refs.append(replacement)
+        replacement._fresh_grad_node = entry
 
 
 def _differentiable(arr) -> bool:

@@ -153,46 +153,119 @@ def pooling(
     count_include_pad=True,
     layout="NCHW",
 ):
-    """Pooling (reference src/operator/nn/pooling.cc)."""
+    """Pooling (reference src/operator/nn/pooling.cc).
+
+    Deliberately avoids lax.reduce_window: its reverse-mode rule does not
+    lower under jit on this TPU backend. Two differentiable lowerings:
+    - non-overlapping windows (stride==kernel, no pad, divisible): a
+      reshape + reduce — the cheapest possible XLA program;
+    - general: patch extraction (conv_general_dilated_patches, exact under
+      the framework's fp32-highest matmul precision) + reduce over the
+      window axis.
+    """
     ndim = x.ndim - 2
-    if layout.startswith("NC"):
-        sp_axes = tuple(range(2, 2 + ndim))
-    else:
-        sp_axes = tuple(range(1, 1 + ndim))
+    channels_last = not layout.startswith("NC")
+    if channels_last:
+        x = jnp.moveaxis(x, -1, 1)
+    sp_axes = tuple(range(2, 2 + ndim))
     if global_pool:
         if pool_type == "max":
-            return jnp.max(x, axis=sp_axes, keepdims=True)
-        return jnp.mean(x, axis=sp_axes, keepdims=True)
+            out = jnp.max(x, axis=sp_axes, keepdims=True)
+        elif pool_type == "lp":
+            out = jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=sp_axes, keepdims=True))
+        else:
+            out = jnp.mean(x, axis=sp_axes, keepdims=True)
+        return jnp.moveaxis(out, 1, -1) if channels_last else out
+
     kernel = _tuple(kernel, ndim)
     stride = _tuple(stride if stride is not None else kernel, ndim)
     pad = _tuple(pad, ndim)
+    spatial = x.shape[2:]
+    n, c = x.shape[0], x.shape[1]
 
-    if layout.startswith("NC"):
-        window = (1, 1) + kernel
-        strides = (1, 1) + stride
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
-    else:
-        window = (1,) + kernel + (1,)
-        strides = (1,) + stride + (1,)
-        pads = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+    non_overlap = (
+        stride == kernel
+        and all(p == 0 for p in pad)
+        and all(s % k == 0 for s, k in zip(spatial, kernel))
+    )
+    if non_overlap:
+        # reshape (N,C,H,W) -> (N,C,H/k,k,W/k,k) and reduce the k axes
+        new_shape = [n, c]
+        red_axes = []
+        for i, (s, k) in enumerate(zip(spatial, kernel)):
+            new_shape += [s // k, k]
+            red_axes.append(3 + 2 * i)
+        xr = x.reshape(new_shape)
+        if pool_type == "max":
+            out = jnp.max(xr, axis=tuple(red_axes))
+        elif pool_type == "sum":
+            out = jnp.sum(xr, axis=tuple(red_axes))
+        elif pool_type == "lp":
+            out = jnp.sqrt(jnp.sum(jnp.square(jnp.abs(xr)), axis=tuple(red_axes)))
+        else:
+            out = jnp.mean(xr, axis=tuple(red_axes))
+        return jnp.moveaxis(out, 1, -1) if channels_last else out
 
+    # general path: extract windows as patches, reduce over the window axis
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.array(init, x.dtype), lax.max, window, strides, pads)
-    if pool_type in ("avg", "sum"):
-        summed = lax.reduce_window(x, jnp.array(0, x.dtype), lax.add, window, strides, pads)
-        if pool_type == "sum":
-            return summed
+        pad_val = (
+            jnp.finfo(x.dtype).min
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo(x.dtype).min
+        )
+    else:
+        pad_val = 0
+    xp = jnp.pad(
+        x,
+        ((0, 0), (0, 0)) + tuple((p, p) for p in pad),
+        constant_values=pad_val,
+    )
+    patches = lax.conv_general_dilated_patches(
+        xp,
+        kernel,
+        stride,
+        "VALID",
+        dimension_numbers=lax.conv_dimension_numbers(
+            xp.shape, (1, 1) + kernel, _patch_spec(ndim)
+        ),
+    )
+    ksize = functools.reduce(lambda a, b: a * b, kernel)
+    out_spatial = patches.shape[2:]
+    pk = patches.reshape((n, c, ksize) + out_spatial)
+    if pool_type == "max":
+        out = jnp.max(pk, axis=2)
+    elif pool_type == "sum":
+        out = jnp.sum(pk, axis=2)
+    elif pool_type == "lp":
+        out = jnp.sqrt(jnp.sum(jnp.square(jnp.abs(pk)), axis=2))
+    elif pool_type == "avg":
         if count_include_pad:
-            denom = functools.reduce(lambda a, b: a * b, kernel)
-            return summed / jnp.asarray(denom, x.dtype)
-        ones = jnp.ones_like(x)
-        counts = lax.reduce_window(ones, jnp.array(0, x.dtype), lax.add, window, strides, pads)
-        return summed / counts
-    if pool_type == "lp":
-        p2 = lax.reduce_window(jnp.abs(x) ** 2, jnp.array(0, x.dtype), lax.add, window, strides, pads)
-        return jnp.sqrt(p2)
-    raise ValueError(f"unknown pool_type {pool_type}")
+            out = jnp.sum(pk, axis=2) / jnp.asarray(ksize, x.dtype)
+        else:
+            ones = jnp.pad(
+                jnp.ones_like(x),
+                ((0, 0), (0, 0)) + tuple((p, p) for p in pad),
+                constant_values=0,
+            )
+            cpatches = lax.conv_general_dilated_patches(
+                ones,
+                kernel,
+                stride,
+                "VALID",
+                dimension_numbers=lax.conv_dimension_numbers(
+                    ones.shape, (1, 1) + kernel, _patch_spec(ndim)
+                ),
+            )
+            counts = cpatches.reshape((n, c, ksize) + out_spatial).sum(axis=2)
+            out = jnp.sum(pk, axis=2) / counts
+    else:
+        raise ValueError(f"unknown pool_type {pool_type}")
+    return jnp.moveaxis(out, 1, -1) if channels_last else out
+
+
+def _patch_spec(ndim):
+    sp = {1: "W", 2: "HW", 3: "DHW"}[ndim]
+    return ("NC" + sp, "OI" + sp, "NC" + sp)
 
 
 def adaptive_avg_pool2d(x, output_size):

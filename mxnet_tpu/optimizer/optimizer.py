@@ -169,8 +169,17 @@ class Optimizer:
         else:
             s_vals = tuple(_unwrap(x) for x in s)
             out = self.update_step(_unwrap(w), g_val, s_vals, lr, wd, t)
-            w._set_data(out[0])
-            self._store_state(i, tuple(out[1:]))
+            # pin dtypes: x64 scalar promotion must not widen weights/state
+            w._set_data(out[0].astype(_unwrap(w).dtype))
+            self._store_state(
+                i,
+                tuple(
+                    ns.astype(os_.dtype) if hasattr(ns, "astype") and hasattr(os_, "dtype") else ns
+                    for ns, os_ in zip(out[1:], s_vals)
+                )
+                if s_vals
+                else tuple(out[1:]),
+            )
 
     def update_multi_precision(self, index, weight, grad, state):
         self.update(index, weight, grad, state)
@@ -260,6 +269,8 @@ signsgd = Signum
 class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference optimizer/sgld.py)."""
 
+    jit_safe = False  # fresh host RNG key per step
+
     def __init__(self, learning_rate=0.01, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
 
@@ -342,7 +353,7 @@ class Adam(Optimizer):
         if self.correct_bias:
             coef1 = 1.0 - self.beta1 ** t
             coef2 = 1.0 - self.beta2 ** t
-            lr = lr * math.sqrt(coef2) / coef1
+            lr = lr * jnp.sqrt(coef2) / coef1  # jnp: t may be a tracer
         return (w - lr * m / (jnp.sqrt(v) + self.epsilon), m, v)
 
 
@@ -356,7 +367,7 @@ class AdamW(Adam):
         v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr_t = lr * math.sqrt(coef2) / coef1
+        lr_t = lr * jnp.sqrt(coef2) / coef1
         return (w - lr_t * m / (jnp.sqrt(v) + self.epsilon) - lr * wd * w, m, v)
 
 
@@ -384,6 +395,8 @@ class Adamax(Optimizer):
 @register
 class Nadam(Optimizer):
     """reference optimizer/nadam.py"""
+
+    jit_safe = False  # python-side m_schedule state
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

@@ -1,0 +1,83 @@
+"""Array serialization (.params files).
+
+Parity: reference NDArray save/load (``src/ndarray/ndarray.cc`` +
+``MXNDArraySave/Load`` C API) used by ``save_parameters`` /
+``load_parameters``. The container here is a zip-of-npy (numpy .npz) with a
+name manifest — a portable stand-in for the reference's dmlc binary format;
+bfloat16 tensors are stored as uint16 views with a dtype tag so round-trips
+are exact.
+"""
+from __future__ import annotations
+
+import json
+import zipfile
+from typing import Dict, List, Union
+
+import numpy as onp
+
+from .base import MXNetError, bfloat16
+
+_BF16_TAG = "__bf16__:"
+
+
+def _encode(arr: onp.ndarray):
+    if arr.dtype == bfloat16:
+        return arr.view(onp.uint16), "bfloat16"
+    return arr, str(arr.dtype)
+
+
+def _decode(arr: onp.ndarray, dtype: str):
+    if dtype == "bfloat16":
+        return arr.view(bfloat16)
+    return arr
+
+
+def save_params(fname: str, arrays: Dict[str, onp.ndarray]) -> None:
+    payload = {}
+    manifest = {}
+    for i, (name, arr) in enumerate(arrays.items()):
+        enc, dt = _encode(onp.asarray(arr))
+        payload[f"arr_{i}"] = enc
+        manifest[f"arr_{i}"] = {"name": name, "dtype": dt}
+    payload["__manifest__"] = onp.frombuffer(
+        json.dumps(manifest).encode(), dtype=onp.uint8
+    )
+    with open(fname, "wb") as f:
+        onp.savez(f, **payload)
+
+
+def load_params(fname: str) -> Dict[str, onp.ndarray]:
+    with onp.load(fname, allow_pickle=False) as z:
+        if "__manifest__" not in z:
+            raise MXNetError(f"{fname} is not a mxnet_tpu .params file")
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        out = {}
+        for key, meta in manifest.items():
+            out[meta["name"]] = _decode(z[key], meta["dtype"])
+        return out
+
+
+def save(fname: str, data) -> None:
+    """mx.nd.save parity: list or dict of ndarrays."""
+    from .ndarray.ndarray import ndarray
+
+    if isinstance(data, ndarray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        arrays = {f"__list__{i}": d.asnumpy() for i, d in enumerate(data)}
+    elif isinstance(data, dict):
+        arrays = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        raise MXNetError("save expects ndarray, list, or dict")
+    save_params(fname, arrays)
+
+
+def load(fname: str):
+    """mx.nd.load parity."""
+    from .numpy import array
+
+    raw = load_params(fname)
+    if all(k.startswith("__list__") for k in raw):
+        items = sorted(raw.items(), key=lambda kv: int(kv[0][8:]))
+        return [array(v, dtype=v.dtype) for _, v in items]
+    return {k: array(v, dtype=v.dtype) for k, v in raw.items()}

@@ -1,0 +1,86 @@
+"""KVStore semantics (reference tests/nightly/dist_sync_kvstore.py +
+tests/python/unittest/test_kvstore.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+
+def test_init_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, np.ones((2, 3)))
+    out = np.zeros((2, 3))
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.ones((2, 3)))
+
+    kv.push(3, np.ones((2, 3)) * 4)
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), 4))
+
+
+def test_aggregation_over_device_list():
+    kv = mx.kv.create("device")
+    kv.init("w", np.zeros((4,)))
+    vals = [np.ones((4,)), np.ones((4,)) * 2, np.ones((4,)) * 3]
+    kv.push("w", vals)
+    out = np.zeros((4,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), 6))
+
+
+def test_pushpull():
+    kv = mx.kv.create("local")
+    g = np.ones((3,)) * 5
+    kv.pushpull(0, g, out=g)
+    onp.testing.assert_allclose(g.asnumpy(), onp.full((3,), 5))
+
+
+def test_server_side_optimizer():
+    kv = mx.kv.create("local")
+    kv.init(0, np.ones((2,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.push(0, np.ones((2,)))  # grad = 1 -> w = 1 - 0.5*1
+    out = np.zeros((2,))
+    kv.pull(0, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2,), 0.5))
+
+
+def test_gradient_compression_2bit():
+    """reference tests/nightly/dist_sync_kvstore.py:35-60 semantics."""
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("g", np.zeros((4,)))
+    g = np.array([1.0, 0.2, -0.7, 0.0])
+    out = np.zeros((4,))
+    kv.pushpull("g", g, out=out)
+    # > 0.5 -> +0.5 ; < -0.5 -> -0.5 ; else 0
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, -0.5, 0.0])
+    # error feedback: residual (0.5, 0.2, -0.2, 0) added to next push
+    kv.pushpull("g", np.zeros((4,)), out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, 0.0, 0.0])
+
+
+def test_dist_tpu_sync_single_process():
+    kv = mx.kv.create("dist_tpu_sync")
+    assert kv.num_workers == 1
+    assert kv.rank == 0
+    g = np.ones((2,))
+    kv.pushpull(0, g, out=g)
+    onp.testing.assert_allclose(g.asnumpy(), onp.ones((2,)))
+
+
+def test_dist_async_rejected():
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("dist_async")
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    kv.init("emb", np.arange(12).reshape(4, 3).astype("float32"))
+    out = np.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=np.array([1, 3]))
+    expected = onp.zeros((4, 3))
+    expected[1] = [3, 4, 5]
+    expected[3] = [9, 10, 11]
+    onp.testing.assert_allclose(out.asnumpy(), expected)
