@@ -391,10 +391,12 @@ class HybridBlock(Block):
         plist = sorted(self.collect_params().items())
         needs_eager = any(p._data is None for _, p in plist)
         if needs_eager:
-            # run the un-traced forward once: completes deferred shapes/init
+            # run the un-traced forward once to complete deferred shapes/init
+            # — in predict mode, so stateful side effects (BatchNorm running
+            # stats, dropout draws) are not applied twice on the first batch
             from .. import autograd as ag
 
-            with ag.pause(train_mode=autograd_state.training):
+            with ag.pause(train_mode=False):
                 super(HybridBlock, self).__call__(*args)
             plist = sorted(self.collect_params().items())
         return plist

@@ -20,11 +20,16 @@ def _as_numpy(x):
 
 
 class Stack:
-    """Stack samples along a new batch axis."""
+    """Stack samples along a new batch axis.
+
+    Returns host numpy: batchify may run inside forked DataLoader workers
+    where touching the XLA runtime is unsafe — the parent-side DataLoader
+    uploads at the batch boundary (one transfer per batch).
+    """
 
     def __call__(self, data: Sequence):
         arrs = [_as_numpy(d) for d in data]
-        return np.array(onp.stack(arrs))
+        return onp.stack(arrs)
 
 
 class Pad:
@@ -49,7 +54,7 @@ class Pad:
         out = onp.stack(padded)
         if self._dtype:
             out = out.astype(self._dtype)
-        return np.array(out)
+        return out
 
 
 class Group:

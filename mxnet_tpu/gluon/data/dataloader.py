@@ -63,10 +63,22 @@ class DataLoader:
             if thread_pool:
                 from multiprocessing.pool import ThreadPool
 
-                self._pool = ThreadPool(self._num_workers)
+                self._pool = ThreadPool(
+                    self._num_workers,
+                    initializer=_worker_init,
+                    initargs=(dataset, self._batchify_fn),
+                )
             else:
+                # dataset + batchify ship ONCE via the pool initializer
+                # (fork inherits them copy-on-write); per-task payload is
+                # just the index list. Workers return host numpy only —
+                # forked children must never touch the XLA runtime.
                 ctx = multiprocessing.get_context("fork")
-                self._pool = ctx.Pool(self._num_workers)
+                self._pool = ctx.Pool(
+                    self._num_workers,
+                    initializer=_worker_init,
+                    initargs=(dataset, self._batchify_fn),
+                )
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -80,14 +92,37 @@ class DataLoader:
 
     def _gen(self):
         for batch_idx in self._batch_sampler:
-            yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+            yield _upload(self._batchify_fn([self._dataset[i] for i in batch_idx]))
 
     def __del__(self):
         if self._pool is not None:
             self._pool.terminate()
 
 
-def _worker_fn(dataset, batchify_fn, batch_idx):
+def _upload(batch):
+    """Host numpy -> device ndarray at the batch boundary (parent side)."""
+    import numpy as onp
+
+    from ... import numpy as mxnp
+
+    if isinstance(batch, onp.ndarray):
+        return mxnp.array(batch, dtype=batch.dtype)
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_upload(b) for b in batch)
+    return batch
+
+
+_WORKER_STATE = {}
+
+
+def _worker_init(dataset, batchify_fn):
+    _WORKER_STATE["dataset"] = dataset
+    _WORKER_STATE["batchify_fn"] = batchify_fn
+
+
+def _worker_fn(batch_idx):
+    dataset = _WORKER_STATE["dataset"]
+    batchify_fn = _WORKER_STATE["batchify_fn"]
     return batchify_fn([dataset[i] for i in batch_idx])
 
 
@@ -109,7 +144,7 @@ class _PoolIter:
         if batch_idx is None:
             return
         self._pending[self._sent] = self._loader._pool.apply_async(
-            _worker_fn, (self._loader._dataset, self._loader._batchify_fn, batch_idx)
+            _worker_fn, (batch_idx,)
         )
         self._sent += 1
 
@@ -122,7 +157,7 @@ class _PoolIter:
         result = self._pending.pop(self._recv).get(self._loader._timeout)
         self._recv += 1
         self._dispatch()
-        return result
+        return _upload(result)
 
 
 class _PrefetchIter:

@@ -152,6 +152,33 @@ class CIFAR100(CIFAR10):
             self, os.path.join(_data_root(root), "cifar100"), train, transform
         )
 
+    def _get_data(self):
+        # CIFAR-100 archive layout differs from CIFAR-10: single 'train' /
+        # 'test' pickles with fine_labels + coarse_labels
+        batch_dir = os.path.join(self._root, self._archive)
+        tar_path = os.path.join(self._root, "cifar-100-python.tar.gz")
+        if not os.path.isdir(batch_dir) and os.path.exists(tar_path):
+            with tarfile.open(tar_path) as t:
+                t.extractall(self._root)
+        if os.path.isdir(batch_dir):
+            fname = "train" if self._train else "test"
+            with open(os.path.join(batch_dir, fname), "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            raw = onp.asarray(batch["data"]).reshape(-1, 3, 32, 32)
+            self._data = raw.transpose(0, 2, 3, 1)
+            key = "fine_labels" if self._fine else "coarse_labels"
+            self._label = onp.asarray(batch[key], dtype=onp.int32)
+        elif self._synth:
+            rng = onp.random.RandomState(9 if self._train else 10)
+            n = 8192 if self._train else 2048
+            n_cls = self._classes if self._fine else 20
+            self._label = rng.randint(0, n_cls, n).astype(onp.int32)
+            base = rng.randint(0, 255, (n_cls, 32, 32, 3))
+            noise = rng.randint(0, 80, (n, 32, 32, 3))
+            self._data = onp.clip(base[self._label] * 0.7 + noise, 0, 255).astype(onp.uint8)
+        else:
+            raise MXNetError(f"CIFAR-100 not found under {self._root} (no egress to download)")
+
 
 class ImageFolderDataset(dataset.Dataset):
     """reference vision/datasets.py ImageFolderDataset: root/class/*.jpg"""

@@ -124,7 +124,12 @@ class LogisticLoss(Loss):
         label = _reshape_like(pred, label)
         if self._label_format == "signed":
             label = (label + 1.0) / 2.0
-        loss = np.log1p(np.exp(pred)) - pred * label
+        # stable softplus form: log(1+e^p) - p*l = max(p,0) - p*l + log1p(e^-|p|)
+        loss = (
+            np.maximum(pred, np.zeros_like(pred))
+            - pred * label
+            + np.log1p(np.exp(-np.abs(pred)))
+        )
         loss = _apply_weighting(loss, self._weight, sample_weight)
         return np.mean(loss, axis=tuple(range(1, loss.ndim)))
 
@@ -218,9 +223,7 @@ class CTCLoss(Loss):
 
         if self._layout == "TNC":
             pred = pred.swapaxes(0, 1)  # -> NTC
-        blank = pred.shape[-1] - 1  # blank = last class (mxnet: first? uses 0)
-        # mxnet uses blank=0 by default in ctc_loss; follow that
-        blank = 0
+        blank = 0  # the reference's ctc_loss blank-label convention
 
         def ctc(logits, labels, in_len, lab_len):
             # logits (N,T,C) log-probs; labels (N,L)

@@ -166,12 +166,14 @@ class _Pooling(HybridBlock):
         self._type = pool_type
         self._layout = layout
         self._count_include_pad = count_include_pad
+        self._ceil_mode = ceil_mode
 
     def forward(self, x):
         return npx.pooling(
             x, kernel=self._pool_size, pool_type=self._type,
             stride=self._strides, pad=self._padding, global_pool=self._global,
             count_include_pad=self._count_include_pad, layout=self._layout,
+            pooling_convention="full" if self._ceil_mode else "valid",
         )
 
     def __repr__(self):

@@ -107,16 +107,31 @@ class KVStoreLocal(KVStoreBase):
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused push+pull — the Trainer hot path (reference
-        kvstore_dist.h:381 PushPullImpl)."""
+        kvstore_dist.h:381 PushPullImpl). Semantics are push followed by
+        pull: the store (and a server-side updater, if set) observes the
+        aggregated value, then targets receive the pulled result."""
         keys, values = _normalize_grouped(key, value)
+        targets = out if out is not None else value
+        t_keys, t_outs = _normalize_grouped(key, targets)
         for k, vals in zip(keys, values):
-            agg = _sum_values(vals)
-            if self._compression is not None:
-                agg = self._compression.compress(k, agg)
-            targets = out if out is not None else value
-            t_keys, t_outs = _normalize_grouped(key, targets)
+            agg = self._reduce(k, vals)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized in kvstore")
+                self._updater(_int_key(k), _wrap(agg), self._store[k])
+                result = _unwrap(self._store[k])
+            else:
+                if k in self._store:
+                    self._store[k]._set_data(jnp.asarray(agg, self._store[k].dtype))
+                result = agg
             for o in t_outs[t_keys.index(k)]:
-                o._set_data(jnp.asarray(agg, o.dtype))
+                o._set_data(jnp.asarray(result, o.dtype))
+
+    def _reduce(self, k, vals):
+        agg = _sum_values(vals)
+        if self._compression is not None:
+            agg = self._compression.compress(k, agg)
+        return agg
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)
@@ -172,21 +187,14 @@ class KVStoreTPU(KVStoreLocal):
     def num_workers(self) -> int:
         return jax.process_count()
 
-    def pushpull(self, key, value, out=None, priority=0):
-        keys, values = _normalize_grouped(key, value)
-        for k, vals in zip(keys, values):
-            agg = _sum_values(vals)
-            if self._compression is not None:
-                agg = self._compression.compress(k, agg)
-            if self.num_workers > 1:
-                # DCN all-reduce across processes (jax collective over hosts)
-                from jax.experimental import multihost_utils
+    def _reduce(self, k, vals):
+        agg = super()._reduce(k, vals)
+        if self.num_workers > 1:
+            # DCN all-reduce across processes (jax collective over hosts)
+            from jax.experimental import multihost_utils
 
-                agg = multihost_utils.process_allgather(agg).sum(axis=0)
-            targets = out if out is not None else value
-            t_keys, t_outs = _normalize_grouped(key, targets)
-            for o in t_outs[t_keys.index(k)]:
-                o._set_data(jnp.asarray(agg, o.dtype))
+            agg = multihost_utils.process_allgather(agg).sum(axis=0)
+        return agg
 
 
 def _normalize(key, value):

@@ -88,8 +88,10 @@ def deconvolution(x, weight, bias=None, stride=1, dilate=1, pad=0, adj=0,
 
 def pooling(x, kernel=1, pool_type="max", stride=None, pad=0, global_pool=False,
             count_include_pad=True, layout="NCHW", pooling_convention="valid"):
+    ceil_mode = pooling_convention == "full"
     return _call(
-        lambda v: _nn.pooling(v, kernel, pool_type, stride, pad, global_pool, count_include_pad, layout),
+        lambda v: _nn.pooling(v, kernel, pool_type, stride, pad, global_pool,
+                              count_include_pad, layout, ceil_mode),
         (x,),
         name="Pooling",
     )

@@ -152,6 +152,7 @@ def pooling(
     global_pool=False,
     count_include_pad=True,
     layout="NCHW",
+    ceil_mode=False,
 ):
     """Pooling (reference src/operator/nn/pooling.cc).
 
@@ -183,9 +184,19 @@ def pooling(
     spatial = x.shape[2:]
     n, c = x.shape[0], x.shape[1]
 
+    # ceil_mode ('full' pooling convention): extend the high side so the
+    # last partial window is kept instead of dropped
+    extra = (0,) * ndim
+    if ceil_mode:
+        extra = tuple(
+            max(0, (-(-(S + 2 * p - k) // st)) * st + k - (S + 2 * p))
+            for S, k, st, p in zip(spatial, kernel, stride, pad)
+        )
+
     non_overlap = (
         stride == kernel
         and all(p == 0 for p in pad)
+        and all(e == 0 for e in extra)
         and all(s % k == 0 for s, k in zip(spatial, kernel))
     )
     if non_overlap:
@@ -217,7 +228,7 @@ def pooling(
         pad_val = 0
     xp = jnp.pad(
         x,
-        ((0, 0), (0, 0)) + tuple((p, p) for p in pad),
+        ((0, 0), (0, 0)) + tuple((p, p + e) for p, e in zip(pad, extra)),
         constant_values=pad_val,
     )
     patches = lax.conv_general_dilated_patches(
@@ -244,7 +255,7 @@ def pooling(
         else:
             ones = jnp.pad(
                 jnp.ones_like(x),
-                ((0, 0), (0, 0)) + tuple((p, p) for p in pad),
+                ((0, 0), (0, 0)) + tuple((p, p + e) for p, e in zip(pad, extra)),
                 constant_values=0,
             )
             cpatches = lax.conv_general_dilated_patches(

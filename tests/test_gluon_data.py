@@ -1,0 +1,140 @@
+"""Data pipeline tests (reference tests/python/unittest/test_gluon_data.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.gluon.data import (
+    ArrayDataset,
+    BatchSampler,
+    DataLoader,
+    RandomSampler,
+    SequentialSampler,
+    SimpleDataset,
+)
+from mxnet_tpu.gluon.data.vision import CIFAR10, MNIST, transforms
+
+
+def test_array_dataset_and_loader():
+    X = onp.random.randn(100, 5).astype("float32")
+    y = onp.arange(100).astype("int32")
+    ds = ArrayDataset(X, y)
+    assert len(ds) == 100
+    dl = DataLoader(ds, batch_size=32, last_batch="keep")
+    batches = list(dl)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (32, 5)
+    assert batches[-1][0].shape == (4, 5)
+    # discard mode
+    assert len(list(DataLoader(ds, batch_size=32, last_batch="discard"))) == 3
+
+
+def test_loader_shuffle_covers_all():
+    ds = SimpleDataset(list(range(50)))
+    dl = DataLoader(ds, batch_size=10, shuffle=True)
+    seen = sorted(int(v) for b in dl for v in b.asnumpy())
+    assert seen == list(range(50))
+
+
+def test_multiworker_loader():
+    X = onp.random.randn(64, 3).astype("float32")
+    y = onp.arange(64).astype("int32")
+    dl = DataLoader(ArrayDataset(X, y), batch_size=16, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    got = sorted(int(v) for _, yb in batches for v in yb.asnumpy())
+    assert got == list(range(64))
+
+
+def test_samplers():
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert sorted(RandomSampler(5)) == [0, 1, 2, 3, 4]
+    bs = BatchSampler(SequentialSampler(7), 3, "rollover")
+    assert [len(b) for b in bs] == [3, 3]
+    assert [len(b) for b in bs] == [3, 3]  # rollover carries the 1 leftover
+
+
+def test_dataset_transform_shard():
+    ds = SimpleDataset(list(range(20))).transform(lambda x: x * 2)
+    assert ds[3] == 6
+    sh = SimpleDataset(list(range(20))).shard(4, 1)
+    assert list(sh) == [1, 5, 9, 13, 17]
+
+
+def test_mnist_synthetic():
+    ds = MNIST(train=True)
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert img.dtype == onp.uint8
+    assert 0 <= int(label) < 10
+
+
+def test_cifar10_with_transform():
+    ds = CIFAR10(train=False).transform_first(
+        transforms.Compose([transforms.ToTensor(), transforms.Normalize(0.5, 0.5)])
+    )
+    img, label = ds[0]
+    assert img.shape == (3, 32, 32)
+    assert img.dtype == onp.float32
+
+
+def test_transforms():
+    img = onp.random.randint(0, 255, (40, 30, 3)).astype("uint8")
+    assert transforms.Resize((20, 10))(img).shape == (10, 20, 3)
+    assert transforms.CenterCrop(16)(img).shape == (16, 16, 3)
+    assert transforms.RandomResizedCrop(8)(img).shape == (8, 8, 3)
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 40, 30) and t.max() <= 1.0
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+
+    uri = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, uri, "w")
+    for i in range(5):
+        w.write_idx(i, f"record-{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, uri, "r")
+    assert r.read_idx(3) == b"record-3"
+    assert r.read_idx(0) == b"record-0"
+    assert len(r.keys) == 5
+
+    header = recordio.IRHeader(0, 7.0, 42, 0)
+    packed = recordio.pack_img(header, onp.ones((4, 4, 3), onp.uint8))
+    h2, img = recordio.unpack_img(packed)
+    assert h2.label == 7.0 and img.shape == (4, 4, 3)
+
+
+def test_ceil_mode_pooling():
+    from mxnet_tpu.gluon import nn
+
+    # reference semantics: 8x8 input, k=3 s=2: floor -> 3x3, ceil -> 4x4
+    x = np.random.uniform(0, 1, (1, 2, 8, 8))
+    assert nn.MaxPool2D(3, 2, ceil_mode=True)(x).shape == (1, 2, 4, 4)
+    assert nn.MaxPool2D(3, 2, ceil_mode=False)(x).shape == (1, 2, 3, 3)
+    # values of the full windows must be identical across modes
+    a = nn.MaxPool2D(3, 2, ceil_mode=True)(x).asnumpy()[:, :, :3, :3]
+    b = nn.MaxPool2D(3, 2, ceil_mode=False)(x).asnumpy()
+    onp.testing.assert_allclose(a, b)
+
+
+def test_kvstore_pushpull_updates_store():
+    kv = mx.kv.create("local")
+    kv.init(0, np.zeros((3,)))
+    g = np.ones((3,)) * 5
+    kv.pushpull(0, g, out=g)
+    out = np.zeros((3,))
+    kv.pull(0, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((3,), 5))
+
+
+def test_logistic_loss_stable():
+    from mxnet_tpu.gluon import loss as gloss
+
+    l = gloss.LogisticLoss()
+    big = np.array([[100.0]])
+    out = l(big, np.array([[1.0]]))
+    assert onp.isfinite(out.asnumpy()).all()
