@@ -59,15 +59,15 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
         self._pool = None
+        self._thread_pool = thread_pool
         if self._num_workers > 0:
             if thread_pool:
+                # threads share the parent's memory: no initializer globals
+                # (a second loader's init would clobber them) — _PoolIter
+                # dispatches a closure-free bound call instead
                 from multiprocessing.pool import ThreadPool
 
-                self._pool = ThreadPool(
-                    self._num_workers,
-                    initializer=_worker_init,
-                    initargs=(dataset, self._batchify_fn),
-                )
+                self._pool = ThreadPool(self._num_workers)
             else:
                 # dataset + batchify ship ONCE via the pool initializer
                 # (fork inherits them copy-on-write); per-task payload is
@@ -100,16 +100,23 @@ class DataLoader:
 
 
 def _upload(batch):
-    """Host numpy -> device ndarray at the batch boundary (parent side)."""
+    """Host numpy -> device ndarray at the batch boundary (parent side).
+    float64 narrows to float32 (the mx.np default-dtype coercion) — TPUs
+    have no fast f64 path and params default to f32."""
     import numpy as onp
 
     from ... import numpy as mxnp
 
     if isinstance(batch, onp.ndarray):
-        return mxnp.array(batch, dtype=batch.dtype)
+        dtype = "float32" if batch.dtype == onp.float64 else batch.dtype
+        return mxnp.array(batch, dtype=dtype)
     if isinstance(batch, (tuple, list)):
         return type(batch)(_upload(b) for b in batch)
     return batch
+
+
+def _worker_fn_direct(dataset, batchify_fn, batch_idx):
+    return batchify_fn([dataset[i] for i in batch_idx])
 
 
 _WORKER_STATE = {}
@@ -143,9 +150,15 @@ class _PoolIter:
         batch_idx = next(self._batches, None)
         if batch_idx is None:
             return
-        self._pending[self._sent] = self._loader._pool.apply_async(
-            _worker_fn, (batch_idx,)
-        )
+        if self._loader._thread_pool:
+            self._pending[self._sent] = self._loader._pool.apply_async(
+                _worker_fn_direct,
+                (self._loader._dataset, self._loader._batchify_fn, batch_idx),
+            )
+        else:
+            self._pending[self._sent] = self._loader._pool.apply_async(
+                _worker_fn, (batch_idx,)
+            )
         self._sent += 1
 
     def __iter__(self):

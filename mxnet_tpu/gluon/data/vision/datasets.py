@@ -101,6 +101,22 @@ class FashionMNIST(MNIST):
     _ns = "fashion-mnist"
 
 
+def _extract_if_tar(batch_dir, tar_path, root):
+    if not os.path.isdir(batch_dir) and os.path.exists(tar_path):
+        with tarfile.open(tar_path) as t:
+            t.extractall(root)
+    return os.path.isdir(batch_dir)
+
+
+def _synthetic_cifar(seed, n, n_cls):
+    rng = onp.random.RandomState(seed)
+    label = rng.randint(0, n_cls, n).astype(onp.int32)
+    base = rng.randint(0, 255, (n_cls, 32, 32, 3))
+    noise = rng.randint(0, 80, (n, 32, 32, 3))
+    data = onp.clip(base[label] * 0.7 + noise, 0, 255).astype(onp.uint8)
+    return data, label
+
+
 class CIFAR10(_DownloadedDataset):
     """reference datasets.py CIFAR10 (python pickled batches)."""
 
@@ -114,10 +130,7 @@ class CIFAR10(_DownloadedDataset):
     def _get_data(self):
         batch_dir = os.path.join(self._root, self._archive)
         tar_path = os.path.join(self._root, "cifar-10-python.tar.gz")
-        if not os.path.isdir(batch_dir) and os.path.exists(tar_path):
-            with tarfile.open(tar_path) as t:
-                t.extractall(self._root)
-        if os.path.isdir(batch_dir):
+        if _extract_if_tar(batch_dir, tar_path, self._root):
             files = (
                 [f"data_batch_{i}" for i in range(1, 6)] if self._train else ["test_batch"]
             )
@@ -131,12 +144,8 @@ class CIFAR10(_DownloadedDataset):
             self._data = raw.transpose(0, 2, 3, 1)  # HWC like the reference
             self._label = onp.asarray(labels, dtype=onp.int32)
         elif self._synth:
-            rng = onp.random.RandomState(7 if self._train else 8)
-            n = 8192 if self._train else 2048
-            self._label = rng.randint(0, self._classes, n).astype(onp.int32)
-            base = rng.randint(0, 255, (self._classes, 32, 32, 3))
-            noise = rng.randint(0, 80, (n, 32, 32, 3))
-            self._data = onp.clip(base[self._label] * 0.7 + noise, 0, 255).astype(onp.uint8)
+            self._data, self._label = _synthetic_cifar(
+                7 if self._train else 8, 8192 if self._train else 2048, self._classes)
         else:
             raise MXNetError(f"CIFAR-10 not found under {self._root} (no egress to download)")
 
@@ -157,10 +166,7 @@ class CIFAR100(CIFAR10):
         # 'test' pickles with fine_labels + coarse_labels
         batch_dir = os.path.join(self._root, self._archive)
         tar_path = os.path.join(self._root, "cifar-100-python.tar.gz")
-        if not os.path.isdir(batch_dir) and os.path.exists(tar_path):
-            with tarfile.open(tar_path) as t:
-                t.extractall(self._root)
-        if os.path.isdir(batch_dir):
+        if _extract_if_tar(batch_dir, tar_path, self._root):
             fname = "train" if self._train else "test"
             with open(os.path.join(batch_dir, fname), "rb") as f:
                 batch = pickle.load(f, encoding="latin1")
@@ -169,13 +175,9 @@ class CIFAR100(CIFAR10):
             key = "fine_labels" if self._fine else "coarse_labels"
             self._label = onp.asarray(batch[key], dtype=onp.int32)
         elif self._synth:
-            rng = onp.random.RandomState(9 if self._train else 10)
-            n = 8192 if self._train else 2048
-            n_cls = self._classes if self._fine else 20
-            self._label = rng.randint(0, n_cls, n).astype(onp.int32)
-            base = rng.randint(0, 255, (n_cls, 32, 32, 3))
-            noise = rng.randint(0, 80, (n, 32, 32, 3))
-            self._data = onp.clip(base[self._label] * 0.7 + noise, 0, 255).astype(onp.uint8)
+            self._data, self._label = _synthetic_cifar(
+                9 if self._train else 10, 8192 if self._train else 2048,
+                self._classes if self._fine else 20)
         else:
             raise MXNetError(f"CIFAR-100 not found under {self._root} (no egress to download)")
 

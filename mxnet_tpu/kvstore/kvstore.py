@@ -123,6 +123,9 @@ class KVStoreLocal(KVStoreBase):
             else:
                 if k in self._store:
                     self._store[k]._set_data(jnp.asarray(agg, self._store[k].dtype))
+                # drop any value staged by a bare push(): pushpull's
+                # aggregate supersedes it, and pull() checks _pending first
+                getattr(self, "_pending", {}).pop(k, None)
                 result = agg
             for o in t_outs[t_keys.index(k)]:
                 o._set_data(jnp.asarray(result, o.dtype))

@@ -310,6 +310,12 @@ class ndarray:
     def prod(self, axis=None, keepdims=False) -> "ndarray":
         return apply_op(lambda x: jnp.prod(x, axis=axis, keepdims=keepdims), (self,), name="prod")
 
+    def all(self, axis=None, keepdims=False) -> "ndarray":
+        return apply_op(lambda x: jnp.all(x, axis=axis, keepdims=keepdims), (self,), name="all")
+
+    def any(self, axis=None, keepdims=False) -> "ndarray":
+        return apply_op(lambda x: jnp.any(x, axis=axis, keepdims=keepdims), (self,), name="any")
+
     def argmax(self, axis=None) -> "ndarray":
         return apply_op(lambda x: jnp.argmax(x, axis=axis), (self,), name="argmax")
 

@@ -308,6 +308,7 @@ def batch_norm(
     """BatchNorm (reference src/operator/nn/batch_norm.cc). Returns
     (out, new_moving_mean, new_moving_var); the caller owns running-stat
     state (functional design — no hidden mutation inside the op)."""
+    axis = axis % x.ndim
     red_axes = tuple(i for i in range(x.ndim) if i != axis)
     bshape = [1] * x.ndim
     bshape[axis] = x.shape[axis]
