@@ -1,0 +1,62 @@
+"""Headline benchmark: ResNet-50 inference throughput, batch 32.
+
+Baseline (BASELINE.md / reference docs perf.md:186-198): 1076.81 img/s on
+V100 fp32, batch 32. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+BASELINE_IMG_S = 1076.81  # ResNet-50 fp32 inference bs32, V100 (perf.md:186-198)
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    batch = 32
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    x_np = onp.random.uniform(size=(batch, 3, 224, 224)).astype(onp.float32)
+    fn, params = net.functionalize(mx.np.array(x_np), training=False)
+
+    def fwd(params, x):
+        logits, _ = fn(params, x)
+        return logits
+
+    def step(params, x):
+        logits = fwd(params, x)
+        # fold the output back into the next input: forces a true serial
+        # dependency chain so no dispatch/caching layer can elide work
+        perturb = jnp.tanh(jnp.mean(logits)) * 1e-6
+        return logits, x * (1.0 + perturb)
+
+    jstep = jax.jit(step)
+    x = jnp.asarray(x_np)
+    # warmup / compile
+    _, xw = jstep(params, x)
+    jax.block_until_ready(xw)
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out, x = jstep(params, x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_v1_infer_bs32_fp32",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -491,6 +491,66 @@ class HybridBlock(Block):
     def forward(self, *args):
         raise NotImplementedError
 
+    def functionalize(self, *example_args, training: bool = False):
+        """Extract this block's forward as a pure, jittable function.
+
+        Returns ``(fn, params)`` where ``params`` is a dict of
+        ``name -> jax.Array`` and ``fn(params, *inputs, key=None)`` returns
+        ``(outputs, new_params)`` — ``new_params`` carries forward-mutated
+        state (BatchNorm running stats) functionally. ``fn`` closes over no
+        traced values, so it composes with jax.jit / pjit / shard_map /
+        jax.grad directly; this is the seam the parallel subsystem uses to
+        put gluon models under a device mesh (the reference reached the same
+        point via CachedOp + group2ctx, cached_op.cc:759 /
+        graph_executor.cc:2047).
+        """
+        from .. import numpy_extension as npx
+
+        plist = self._ensure_params_ready(example_args)
+        param_list = [(n, p) for n, p in plist if p._data is not None]
+
+        def fn(params, *ivals, key=None):
+            if key is None:
+                key = jax.random.PRNGKey(0)
+            key_state = {"key": key}
+
+            def supplier():
+                key_state["key"], sub = jax.random.split(key_state["key"])
+                return sub
+
+            originals = [p._data for _, p in param_list]
+            try:
+                for n, p in param_list:
+                    p._data = _wrap(params[n])
+                st = autograd_state
+                prev = (st.recording, st.training)
+                st.recording, st.training = False, training
+                try:
+                    with npx.rng_scope(supplier):
+                        wrapped = tuple(
+                            _wrap(v) if not isinstance(v, ndarray) else v
+                            for v in ivals
+                        )
+                        out = Block.__call__(self, *wrapped)
+                finally:
+                    st.recording, st.training = prev
+                new_params = {
+                    n: (p._data._data if isinstance(p._data, ndarray) else p._data)
+                    for n, p in param_list
+                }
+                out_j = jax.tree_util.tree_map(
+                    lambda v: v._data if isinstance(v, ndarray) else v,
+                    out,
+                    is_leaf=lambda v: isinstance(v, ndarray),
+                )
+                return out_j, new_params
+            finally:
+                for (_, p), orig in zip(param_list, originals):
+                    p._data = orig
+
+        params0 = {n: p._data._data for n, p in param_list}
+        return fn, params0
+
 
 def with_pause_set_data(p: Parameter, new_val: ndarray):
     if p._data is not None:

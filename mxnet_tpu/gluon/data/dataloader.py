@@ -59,7 +59,6 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
         self._pool = None
-        self._thread_pool = thread_pool
         if self._num_workers > 0:
             if thread_pool:
                 # threads share the parent's memory: no initializer globals

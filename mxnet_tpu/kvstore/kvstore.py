@@ -121,6 +121,10 @@ class KVStoreLocal(KVStoreBase):
                 self._updater(_int_key(k), _wrap(agg), self._store[k])
                 result = _unwrap(self._store[k])
             else:
+                # an uninitialized key is a pure allreduce: targets get the
+                # aggregate, no store state involved (the KVStoreBase plugin
+                # contract, reference python/mxnet/kvstore/base.py:98 — the
+                # Horovod/BytePS backends have no server-side state at all)
                 if k in self._store:
                     self._store[k]._set_data(jnp.asarray(agg, self._store[k].dtype))
                 # drop any value staged by a bare push(): pushpull's
