@@ -75,4 +75,8 @@ def __getattr__(name):
         from . import amp as _amp
 
         return _amp
+    if name == "parallel":
+        import importlib
+
+        return importlib.import_module(".parallel", __name__)
     raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,37 @@
+"""``mxnet_tpu.parallel`` — distributed training over TPU meshes.
+
+Replaces the reference's communication stack (kvstore comm trees, ps-lite
+parameter server, NCCL — ``src/kvstore/``) with named-axis XLA collectives,
+and adds the strategies the reference lacked: tensor, pipeline, sequence
+(ring attention) and expert parallelism (SURVEY.md §2.3 implication).
+"""
+from . import collectives, dist, mesh
+from .collectives import (
+    all_to_all,
+    allgather,
+    allreduce,
+    axis_index,
+    axis_size,
+    barrier,
+    broadcast,
+    ppermute,
+    reduce_scatter,
+    ring_shift,
+)
+from .mesh import (
+    MESH_AXES,
+    auto_shard_spec,
+    current_mesh,
+    make_mesh,
+    named_sharding,
+    shard_params,
+    use_mesh,
+)
+from .tensor_parallel import (
+    ColumnParallelDense,
+    RowParallelDense,
+    VocabParallelEmbedding,
+    param_shardings,
+    shard_module_params,
+    sharding_constraint,
+)

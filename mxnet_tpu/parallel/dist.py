@@ -1,0 +1,96 @@
+"""Multi-process bootstrap — the role the dmlc-tracker + ps-lite scheduler
+played in the reference (``tools/launch.py:29`` → tracker; env protocol
+``DMLC_ROLE`` / ``DMLC_PS_ROOT_URI`` / ``DMLC_PS_ROOT_PORT`` /
+``DMLC_NUM_WORKER``, consumed by ``python/mxnet/kvstore/kvstore_server.py``).
+
+On TPU there are no server/scheduler roles: every process is a worker, and
+``jax.distributed.initialize`` against a coordinator address replaces the
+tracker rendezvous. This module accepts BOTH the reference's DMLC_* env
+protocol and jax-native args, so ``tools/launch.py``-style launchers keep
+working unchanged.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "initialize",
+    "is_initialized",
+    "rank",
+    "size",
+    "local_device_count",
+    "device_count",
+    "shutdown",
+]
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> None:
+    """Join the cluster. No-op for single-process runs (exactly like the
+    reference, where kvstore 'local' never touches ps-lite)."""
+    global _initialized
+    if _initialized:
+        return
+    # DMLC env protocol compatibility (reference kvstore_server.py / launch.py)
+    if coordinator_address is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+        if uri:
+            coordinator_address = f"{uri}:{port}"
+    if num_processes is None:
+        nw = os.environ.get("DMLC_NUM_WORKER") or os.environ.get("MX_NUM_PROCESSES")
+        num_processes = int(nw) if nw else None
+    if process_id is None:
+        wid = os.environ.get("DMLC_WORKER_ID") or os.environ.get("MX_PROCESS_ID")
+        process_id = int(wid) if wid else None
+    if coordinator_address is None and num_processes in (None, 1):
+        _initialized = True  # single process: nothing to rendezvous
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    # Deliberately does NOT query jax.process_count(): that initializes the
+    # XLA backends, after which jax.distributed.initialize() can never run.
+    return _initialized
+
+
+def rank() -> int:
+    return jax.process_index()
+
+
+def size() -> int:
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def shutdown():
+    global _initialized
+    if jax.process_count() > 1:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+    _initialized = False

@@ -1,0 +1,144 @@
+"""Tensor (model) parallel layers — a capability the reference *lacked*
+(SURVEY.md §2.3: TP ❌; its only model parallelism was manual ``group2ctx``
+device placement, ``src/executor/graph_executor.cc:2047``).
+
+Design: GSPMD-first. A TP layer is an ordinary Gluon layer whose Parameters
+carry a ``PartitionSpec`` in ``Parameter.sharding`` and whose activations get
+``with_sharding_constraint`` hints; XLA inserts the all-reduce /
+reduce-scatter at the column→row seam. This keeps TP composable with
+``hybridize``/``functionalize`` and with dp/fsdp axes on the same mesh —
+the Megatron recipe expressed as shardings instead of hand-written NCCL.
+
+Usage::
+
+    with parallel.use_mesh(parallel.make_mesh({"dp": 2, "tp": 4})):
+        net = nn.HybridSequential()
+        net.add(ColumnParallelDense(4*H, activation="gelu", in_units=H))
+        net.add(RowParallelDense(H, in_units=4*H))
+        net.initialize()
+        fn, params = net.functionalize(x)
+        shardings = parallel.param_shardings(net, params)
+        step = jax.jit(fn, in_shardings=(shardings, batch_spec))
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+from .mesh import current_mesh, named_sharding
+
+__all__ = [
+    "sharding_constraint",
+    "param_shardings",
+    "shard_module_params",
+    "ColumnParallelDense",
+    "RowParallelDense",
+    "VocabParallelEmbedding",
+]
+
+
+def sharding_constraint(x, spec: P):
+    """``lax.with_sharding_constraint`` that degrades to identity when no
+    mesh is active or the spec names axes the mesh lacks (so TP layers run
+    unsharded in unit tests / single-chip mode)."""
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    try:
+        ns = named_sharding(spec, mesh)
+    except ValueError:
+        return x
+    data = _unwrap(x)
+    out = jax.lax.with_sharding_constraint(data, ns)
+    return _wrap(out) if isinstance(x, ndarray) else out
+
+
+def param_shardings(
+    net, params: Dict[str, jax.Array], mesh=None
+) -> Dict[str, NamedSharding]:
+    """NamedShardings for a functionalized net's param dict, read off each
+    ``Parameter.sharding`` annotation (replicated when unset)."""
+    mesh = mesh or current_mesh()
+    by_name = {}
+    for pname, p in net.collect_params().items():
+        spec = p.sharding if p.sharding is not None else P()
+        by_name[pname] = named_sharding(spec, mesh)
+    out = {}
+    for k in params:
+        out[k] = by_name.get(k, named_sharding(P(), mesh))
+    return out
+
+
+def shard_module_params(net, rules, mesh=None, default=P()):
+    """Stamp ``Parameter.sharding`` over a whole module via regex rules
+    (ordered, first match wins) — bulk FSDP/TP annotation."""
+    from .mesh import match_rule
+
+    for name, p in net.collect_params().items():
+        p.sharding = match_rule(name, rules, default)
+    return net
+
+
+def _last_dim_spec(ndim: int, axis_name: Optional[str]) -> P:
+    """Spec sharding only the trailing (feature) dim — correct for both 2-D
+    (batch, feature) and 3-D (batch, seq, feature) activations."""
+    return P(*([None] * (ndim - 1) + [axis_name]))
+
+
+class ColumnParallelDense(nn.Dense):
+    """Dense with output features split over ``tp`` (Megatron column
+    parallel). Weight is (units, in_units) → sharded ``P("tp", None)``;
+    output activations are sharded on the feature dim, so a following
+    :class:`RowParallelDense` consumes them without any gather."""
+
+    def __init__(self, units, axis_name: str = "tp", gather_output: bool = False, **kwargs):
+        super().__init__(units, **kwargs)
+        self._axis_name = axis_name
+        self._gather_output = gather_output
+        self.weight.sharding = P(axis_name, None)
+        if self.bias is not None:
+            self.bias.sharding = P(axis_name)
+
+    def forward(self, x):
+        out = super().forward(x)
+        axis = None if self._gather_output else self._axis_name
+        return sharding_constraint(out, _last_dim_spec(out.ndim, axis))
+
+
+class RowParallelDense(nn.Dense):
+    """Dense with input features split over ``tp`` (Megatron row parallel).
+    Weight sharded ``P(None, "tp")``; XLA emits the psum over ``tp`` to
+    produce the replicated output — the collective the reference would have
+    had to hand-write."""
+
+    def __init__(self, units, axis_name: str = "tp", **kwargs):
+        super().__init__(units, **kwargs)
+        self._axis_name = axis_name
+        self.weight.sharding = P(None, axis_name)
+        # bias is added after the reduction; replicated
+
+    def forward(self, x):
+        x = sharding_constraint(x, _last_dim_spec(x.ndim, self._axis_name))
+        out = super().forward(x)
+        return sharding_constraint(out, _last_dim_spec(out.ndim, None))
+
+
+class VocabParallelEmbedding(nn.Embedding):
+    """Embedding with the vocab dim split over ``tp`` — the standard cure
+    for embedding tables too big for one chip (the case the reference served
+    with row_sparse push/pull, ``kvstore row_sparse_pull``)."""
+
+    def __init__(self, input_dim, output_dim, axis_name: str = "tp", **kwargs):
+        super().__init__(input_dim, output_dim, **kwargs)
+        self._axis_name = axis_name
+        self.weight.sharding = P(axis_name, None)
+
+    def forward(self, x):
+        out = super().forward(x)
+        return sharding_constraint(out, _last_dim_spec(out.ndim, None))

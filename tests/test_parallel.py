@@ -1,0 +1,219 @@
+"""Tests for mxnet_tpu.parallel — run on the 8-virtual-device CPU mesh
+(conftest.py), the analogue of the reference's N-local-process kvstore tests
+(tests/nightly/dist_sync_kvstore.py via tools/launch.py --launcher local)."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.gluon import nn
+
+
+def test_make_mesh_fill_axis():
+    mesh = parallel.make_mesh({"dp": 2, "tp": -1})
+    assert mesh.shape["dp"] == 2
+    assert mesh.shape["tp"] == len(jax.devices()) // 2
+    assert parallel.current_mesh() is None
+    with parallel.use_mesh(mesh) as m:
+        assert parallel.current_mesh() is m
+
+
+def test_make_mesh_errors():
+    with pytest.raises(ValueError):
+        parallel.make_mesh({"dp": -1, "tp": -1})
+    with pytest.raises(ValueError):
+        parallel.make_mesh({"dp": 1000})
+
+
+def test_collectives_under_shard_map():
+    mesh = parallel.make_mesh({"dp": 8})
+    x = jnp.arange(8.0)
+
+    def body(x):
+        s = parallel.allreduce(x, "dp")
+        m = parallel.allreduce(x, "dp", op="max")
+        g = parallel.allgather(x, "dp")
+        idx = parallel.axis_index("dp")
+        return s, m, g, idx * jnp.ones_like(x)
+
+    f = shard_map(
+        body, mesh=mesh, in_specs=P("dp"), out_specs=(P("dp"), P("dp"), P("dp"), P("dp"))
+    )
+    s, m, g, idx = f(x)
+    onp.testing.assert_allclose(onp.asarray(s), onp.full(8, 28.0))
+    onp.testing.assert_allclose(onp.asarray(m), onp.full(8, 7.0))
+    onp.testing.assert_allclose(onp.asarray(idx), onp.arange(8.0))
+
+
+def test_ring_shift_and_broadcast():
+    mesh = parallel.make_mesh({"sp": 8})
+    x = jnp.arange(8.0)
+
+    def body(x):
+        shifted = parallel.ring_shift(x, "sp", shift=1)
+        bcast = parallel.broadcast(x, "sp", src=3)
+        return shifted, bcast
+
+    f = shard_map(body, mesh=mesh, in_specs=P("sp"), out_specs=(P("sp"), P("sp")))
+    shifted, bcast = f(x)
+    # shard i moves to position (i+1) % 8
+    onp.testing.assert_allclose(onp.asarray(shifted), onp.roll(onp.arange(8.0), 1))
+    onp.testing.assert_allclose(onp.asarray(bcast), onp.full(8, 3.0))
+
+
+def test_reduce_scatter_matches_allreduce_shard():
+    mesh = parallel.make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    x = jnp.arange(16.0).reshape(4, 4)
+
+    def body(x):
+        # x is (1, 4) per device; reduce over dp then scatter cols
+        return parallel.reduce_scatter(x[0], "dp")
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp", None), out_specs=P("dp"))
+    out = f(x)
+    full = onp.asarray(x).sum(axis=0)
+    onp.testing.assert_allclose(onp.asarray(out), full)
+
+
+def test_all_to_all():
+    mesh = parallel.make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    x = jnp.arange(16.0).reshape(4, 4)
+
+    def body(x):
+        # per-device (1, 4) → (4, 1): device i receives column block i of
+        # every peer's shard stacked along axis 0 (a distributed transpose
+        # of the block layout)
+        out = parallel.all_to_all(x, "ep", split_axis=1, concat_axis=0)
+        return out, out[:, 0] * 1.0
+
+    f = shard_map(body, mesh=mesh, in_specs=P("ep", None),
+                  out_specs=(P(None, "ep"), P("ep")))
+    out, col = f(x)
+    # reassembled under P(None, "ep") the exchange is the identity on the
+    # global view — but each device's local block is now a column
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(x))
+    # device i's local column = x[:, i]; under P("ep") they concatenate as
+    # the flattened transpose
+    onp.testing.assert_allclose(onp.asarray(col), onp.asarray(x).T.ravel())
+
+
+def test_shard_params_rules():
+    mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+    params = {"encoder.0.weight": jnp.zeros((8, 4)), "head.bias": jnp.zeros((4,))}
+    sh = parallel.shard_params(
+        params, [(r"encoder.*weight", P("tp", None))], mesh=mesh
+    )
+    assert sh["encoder.0.weight"].spec == P("tp", None)
+    assert sh["head.bias"].spec == P()
+
+
+def test_auto_shard_spec():
+    mesh = parallel.make_mesh({"fsdp": 8})
+    with parallel.use_mesh(mesh):
+        assert parallel.auto_shard_spec((64, 3)) == P("fsdp", None)
+        assert parallel.auto_shard_spec((3, 64)) == P(None, "fsdp")
+        # nothing divisible → replicated
+        assert parallel.auto_shard_spec((3, 5)) == P()
+
+
+def test_named_sharding_drops_unknown_axes():
+    mesh = parallel.make_mesh({"dp": 8})
+    ns = parallel.named_sharding(P("dp", "tp"), mesh)
+    assert ns.spec == P("dp", None)
+
+
+def _tp_mlp(hidden, classes, in_units):
+    net = nn.HybridSequential()
+    net.add(parallel.ColumnParallelDense(hidden, activation="relu", in_units=in_units))
+    net.add(parallel.RowParallelDense(classes, in_units=hidden))
+    return net
+
+
+@pytest.mark.integration
+def test_tensor_parallel_dense_parity():
+    """Sharded TP forward == unsharded forward (check_consistency pattern,
+    reference test_utils.py:1428, devices swapped for shardings)."""
+    in_units, hidden, classes, batch = 12, 16, 10, 8
+    mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+    with parallel.use_mesh(mesh):
+        net = _tp_mlp(hidden, classes, in_units)
+        net.initialize()
+        x = mx.np.array(onp.random.randn(batch, in_units).astype(onp.float32))
+        fn, params = net.functionalize(x, training=False)
+        shardings = parallel.param_shardings(net, params, mesh)
+        x_sh = NamedSharding(mesh, P("dp", None))
+        sharded_params = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+        xs = jax.device_put(x.asnumpy(), x_sh)
+
+        jfn = jax.jit(fn, in_shardings=(shardings, x_sh))
+        out_sharded, _ = jfn(sharded_params, xs)
+        out_ref, _ = fn(params, x.asnumpy())
+    onp.testing.assert_allclose(
+        onp.asarray(out_sharded), onp.asarray(out_ref), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.integration
+def test_tp_dp_train_step():
+    """One SGD step over a dp x tp mesh: grads psum over dp and the TP seam
+    psum both come from shardings alone — the in-graph replacement for the
+    whole push/pull round trip (SURVEY.md §3.5)."""
+    in_units, hidden, classes, batch = 8, 16, 4, 8
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    with parallel.use_mesh(mesh):
+        net = _tp_mlp(hidden, classes, in_units)
+        net.initialize()
+        x0 = mx.np.zeros((batch, in_units))
+        fn, params = net.functionalize(x0, training=True)
+        shardings = parallel.param_shardings(net, params, mesh)
+        x_sh = NamedSharding(mesh, P("dp", None))
+        y_sh = NamedSharding(mesh, P("dp"))
+
+        def loss_fn(p, x, y):
+            logits, state = fn(p, x)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1)), state
+
+        def step(p, x, y):
+            (loss, state), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, x, y)
+            return {k: p[k] - 0.1 * grads[k] for k in p}, loss
+
+        jstep = jax.jit(step, in_shardings=(shardings, x_sh, y_sh),
+                        out_shardings=(shardings, None))
+        p = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+        x = jax.device_put(onp.random.randn(batch, in_units).astype(onp.float32), x_sh)
+        y = jax.device_put((onp.arange(batch) % classes).astype(onp.int32), y_sh)
+        losses = []
+        for _ in range(5):
+            p, loss = jstep(p, x, y)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+
+def test_vocab_parallel_embedding():
+    mesh = parallel.make_mesh({"tp": 8})
+    vocab, dim = 32, 16
+    with parallel.use_mesh(mesh):
+        emb = parallel.VocabParallelEmbedding(vocab, dim)
+        emb.initialize()
+        idx = mx.np.array(onp.array([0, 5, 31, 7]), dtype="int32")
+        fn, params = emb.functionalize(idx, training=False)
+        shardings = parallel.param_shardings(emb, params, mesh)
+        p = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+        out, _ = jax.jit(fn, in_shardings=(shardings, None))(p, idx.asnumpy())
+        ref, _ = fn(params, idx.asnumpy())
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref), rtol=1e-6)
+
+
+def test_dist_single_process_noop():
+    from mxnet_tpu.parallel import dist
+
+    dist.initialize()
+    assert dist.rank() == 0
+    assert dist.size() == 1
+    assert dist.device_count() == len(jax.devices())
+    parallel.barrier()  # single-process: returns immediately
