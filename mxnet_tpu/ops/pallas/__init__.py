@@ -1,0 +1,7 @@
+"""Hand-written Pallas TPU kernels for the hot ops — the role CUDA/cuDNN
+kernels and the NVRTC pointwise-fusion JIT (``src/operator/fusion/``) played
+in the reference. Everything else rides XLA's own fusion.
+"""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

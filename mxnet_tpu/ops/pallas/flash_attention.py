@@ -1,0 +1,257 @@
+"""Pallas TPU flash attention — the fused kernel the reference could not
+have: its attention was materialized O(L²) interleaved matmuls
+(``src/operator/contrib/transformer.cc:650 interleaved_matmul_selfatt_qk``)
+plus a separate softmax op. Here the whole QKᵀ→softmax→PV chain runs in one
+kernel: K/V blocks stream HBM→VMEM, scores never leave VMEM, and the MXU
+sees back-to-back matmuls (the playbook in /opt/skills/guides/pallas_guide.md).
+
+Layout: (batch, heads, seq, head_dim). fp32 online-softmax accumulators
+regardless of input dtype (bf16-safe).
+
+Grid: (batch*heads, q_blocks, k_blocks) — the last axis runs sequentially
+on TPU, so VMEM scratch (acc, m, l) persists across K blocks of one Q block.
+
+Backward: ``jax.custom_vjp`` whose bwd recomputes attention blockwise
+(O(L) memory) — flash-style recompute instead of saving the O(L²) matrix.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _mha_reference(q, k, v, causal: bool, sm_scale: float):
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), k=lk - lq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vf).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  sm_scale, causal, block_q, block_k, seq_q, seq_k, n_k,
+                  precision):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, jnp.float32(_NEG_INF))
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal block skip: K blocks entirely in the future contribute nothing
+    # (the other half of the score matrix — this is where flash wins)
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + (block_q - 1) + (seq_k - seq_q)
+
+    @pl.when(run)
+    def _compute():
+        neg_inf = jnp.float32(_NEG_INF)
+        # bf16 inputs feed the MXU natively; accumulation is f32 via
+        # preferred_element_type (casting inputs up first would halve MXU rate)
+        q = q_ref[0]                                     # (bq, d)
+        kt = k_ref[0]                                    # (d, bk) — pre-transposed
+        v = v_ref[0]                                     # (bk, d)
+        # plain [1]x[0] contraction: Mosaic v5e rejects bf16 rhs-transpose
+        s = jax.lax.dot_general(
+            q, kt, (((1,), (0,)), ((), ())),
+            precision=precision,
+            preferred_element_type=jnp.float32) * jnp.float32(sm_scale)
+
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_k                             # K padding
+        mask &= q_pos < seq_q                            # Q padding (rows are discarded anyway)
+        if causal:
+            mask &= k_pos <= q_pos + (seq_k - seq_q)
+        s = jnp.where(mask, s, neg_inf)
+
+        m_prev = m_ref[:, :1]                            # (bq, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, jnp.float32(0.0))         # fully-masked rows
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            precision=precision,
+            preferred_element_type=jnp.float32)          # (bq, d)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, jnp.float32(1.0), l)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    n_q = -(-lq // bq)
+    n_k = -(-lk // bk)
+    pad_q = n_q * bq - lq
+    pad_k = n_k * bk - lk
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    qp = qp.reshape(b * h, n_q * bq, d)
+    kp = kp.reshape(b * h, n_k * bk, d).swapaxes(1, 2)  # (bh, d, Lk)
+    vp = vp.reshape(b * h, n_k * bk, d)
+
+    # the session-wide jax_default_matmul_precision="highest" (base.py)
+    # would stamp contract_precision<fp32> on bf16 matmuls, which Mosaic
+    # rejects — bf16 runs at native MXU precision (f32 accumulate comes from
+    # preferred_element_type); f32 keeps HIGHEST so oracle tests hold
+    precision = (jax.lax.Precision.DEFAULT if q.dtype == jnp.bfloat16
+                 else jax.lax.Precision.HIGHEST)
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+        block_k=bk, seq_q=lq, seq_k=lk, n_k=n_k, precision=precision)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, jnp.int32(0))),
+            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, jnp.int32(0), ki)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, jnp.int32(0))),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, jnp.int32(0))),
+        out_shape=jax.ShapeDtypeStruct((b * h, n_q * bq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    out = out.reshape(b, h, n_q * bq, d)
+    return out[:, :, :lq, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, out)
+
+
+def _causal_block_mask(q_pos, k_pos, causal, seq_q, seq_k):
+    mask = (k_pos < seq_k)[None, :]
+    if causal:
+        mask = mask & (k_pos[None, :] <= q_pos[:, None] + (seq_k - seq_q))
+    return mask  # (lq, bk)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    """True flash backward: two blockwise passes over K (lse recompute, then
+    dQ/dK/dV), never materializing more than one (Lq, block_k) score block.
+
+    Standard flash-attention-2 backward math: with lse from the forward,
+    p = exp(s - lse) reconstructs each probability block exactly;
+    ds = p * (dp - D) where D = rowsum(dO * O).
+    """
+    q, k, v, out = res
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bk = min(block_k, lk)
+    n_k = -(-lk // bk)
+    pad = n_k * bk - lk
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else v
+    # block-major: (n_k, b, h, bk, d)
+    kb = kp.reshape(b, h, n_k, bk, d).transpose(2, 0, 1, 3, 4)
+    vb = vp.reshape(b, h, n_k, bk, d).transpose(2, 0, 1, 3, 4)
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    q_pos = jnp.arange(lq)
+    scale = jnp.float32(sm_scale)
+
+    # pass 1: recompute lse blockwise (same online max/sum as the forward)
+    def lse_body(carry, blk):
+        m, l = carry
+        i, k_blk = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        mask = _causal_block_mask(q_pos, i * bk + jnp.arange(bk), causal, lq, lk)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+        l = l * jnp.exp(m - m_new) + p.sum(axis=-1)
+        return (m_new, l), None
+
+    m0 = jnp.full((b, h, lq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    (m, l), _ = jax.lax.scan(lse_body, (m0, l0), (jnp.arange(n_k), kb))
+    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))  # (b,h,lq)
+
+    # pass 2: accumulate dq; emit dk/dv per block
+    D = jnp.einsum("bhqd,bhqd->bhq", gf, out.astype(jnp.float32))  # rowsum(dO*O)
+
+    def grad_body(dq, blk):
+        i, k_blk, v_blk = blk
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+        mask = _causal_block_mask(q_pos, i * bk + jnp.arange(bk), causal, lq, lk)
+        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)  # (b,h,lq,bk)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+        ds = p * (dp - D[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    dq, (dkb, dvb) = jax.lax.scan(grad_body, dq0,
+                                  (jnp.arange(n_k), kb, vb))
+    dk = dkb.transpose(1, 2, 0, 3, 4).reshape(b, h, n_k * bk, d)[:, :, :lk]
+    dv = dvb.transpose(1, 2, 0, 3, 4).reshape(b, h, n_k * bk, d)[:, :, :lk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention over (batch, heads, seq, head_dim) tensors.
+
+    ``interpret=None`` auto-selects: the compiled Mosaic kernel on TPU, the
+    Pallas interpreter elsewhere (so CPU tests exercise the same kernel
+    logic the TPU runs).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (b, h, l, d), got {q.shape}")
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, float(sm_scale), block_q, block_k, interpret)

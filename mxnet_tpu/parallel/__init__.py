@@ -27,6 +27,13 @@ from .mesh import (
     shard_params,
     use_mesh,
 )
+from .ring_attention import (
+    blockwise_attention,
+    naive_attention,
+    ring_attention,
+    ring_self_attention,
+    ulysses_attention,
+)
 from .tensor_parallel import (
     ColumnParallelDense,
     RowParallelDense,

@@ -1,0 +1,214 @@
+"""Sequence/context parallelism: ring attention, blockwise attention, and
+Ulysses head-scatter attention.
+
+The reference has NO long-context support (SURVEY.md §5: attention is O(L²)
+materialized, single-device — ``src/operator/contrib/transformer.cc:650``
+interleaved matmuls). This module is designed from scratch for the TPU mesh:
+
+- :func:`blockwise_attention` — single-device online-softmax attention via
+  ``lax.scan`` over key blocks: O(L) activation memory instead of O(L²).
+- :func:`ring_attention` — the sp-axis distributed version: each device
+  holds a sequence shard of Q/K/V; K/V shards rotate around the ring via
+  ``lax.ppermute`` (neighbor ICI traffic) while every device folds each
+  visiting block into its online-softmax accumulators. Compute and the
+  next-hop transfer overlap (XLA latency-hiding scheduler).
+- :func:`ulysses_attention` — all_to_all alternative: re-shard sequence →
+  heads, run dense local attention, shard back. Cheaper for moderate L and
+  head counts divisible by the axis.
+
+All functions take ``(batch, seq, heads, head_dim)`` ("NLHD") and fp32
+accumulate regardless of input dtype (bf16-safe, the MXU-friendly layout).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .collectives import axis_index, axis_size
+from .mesh import current_mesh
+
+__all__ = [
+    "naive_attention",
+    "blockwise_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "ring_self_attention",
+]
+
+_NEG_INF = -1e30
+
+
+def naive_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None):
+    """O(L²) reference attention (the oracle; what transformer.cc computed).
+
+    Delegates to the single shared oracle in ops.pallas.flash_attention
+    (layout (b,h,l,d) there; (b,l,h,d) here)."""
+    from ..ops.pallas.flash_attention import _mha_reference
+
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    out = _mha_reference(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                         v.transpose(0, 2, 1, 3), causal, sm_scale)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _online_block(carry, kv_blk, q, mask, sm_scale):
+    """Fold one K/V block into (acc, m, l) online-softmax state.
+
+    ``mask``: (lq, lk_blk) bool, True = position attended (None = all)."""
+    acc, m, l = carry
+    k_blk, v_blk = kv_blk
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * sm_scale  # f32
+    if mask is None:
+        mask = jnp.ones(s.shape[-2:], dtype=bool)
+    s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))  # (b,h,q)
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(mask, p, 0.0)  # kill fully-masked rows (exp(-inf+inf)=1 bug)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+    acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + pv
+    return (acc_new, m_new, l_new)
+
+
+def _finalize(acc, l):
+    l_t = l.transpose(0, 2, 1)[..., None]  # (b,q,h,1)
+    return acc / jnp.where(l_t == 0.0, 1.0, l_t)
+
+
+def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
+                        sm_scale: Optional[float] = None):
+    """Memory-efficient attention: scan over key blocks with online softmax.
+
+    Activation memory O(Lq·block) instead of O(Lq·Lkv); the long-context
+    primitive on a single chip."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    block_size = min(block_size, lk)
+    n_blocks = -(-lk // block_size)
+    pad = n_blocks * block_size - lk
+    qf = q.astype(jnp.float32)
+    # keep K/V in input dtype (bf16 stays bf16); blocks are upcast one at a
+    # time inside the scan body so peak extra memory is one block, not 4x|K|
+    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = kp.reshape(b, n_blocks, block_size, h, d).transpose(1, 0, 2, 3, 4)
+    vb = vp.reshape(b, n_blocks, block_size, h, d).transpose(1, 0, 2, 3, 4)
+    q_pos = jnp.arange(lq) + (lk - lq)  # align ends for causal cross-length
+    acc = jnp.zeros((b, lq, h, d), jnp.float32)
+    m = jnp.full((b, h, lq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, lq), jnp.float32)
+
+    def body(carry, blk):
+        i, k_blk, v_blk = blk
+        k_pos = i * block_size + jnp.arange(block_size)
+        mask = (k_pos < lk)[None, :]  # padding mask
+        if causal:
+            mask = mask & (k_pos[None, :] <= q_pos[:, None])
+        else:
+            mask = jnp.broadcast_to(mask, (lq, block_size))
+        new = _online_block(
+            carry, (k_blk.astype(jnp.float32), v_blk.astype(jnp.float32)),
+            qf, mask, sm_scale)
+        return new, None
+
+    (acc, m, l), _ = lax.scan(body, (acc, m, l),
+                              (jnp.arange(n_blocks), kb, vb))
+    return _finalize(acc, l).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Ring attention over a sequence-sharded mesh axis.
+
+    Must be called inside ``shard_map`` (see :func:`ring_self_attention`):
+    ``q/k/v`` are this device's sequence shards ``(b, L/n, h, d)``. Each of
+    the ``n`` ring steps folds the currently-held K/V shard into the online
+    softmax, then rotates K/V one hop (``ppermute``) so only
+    neighbor-to-neighbor ICI bandwidth is used — never a full all-gather.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = axis_size(axis_name)
+    idx = axis_index(axis_name)
+    b, l_loc, h, d = q.shape
+    qf = q.astype(jnp.float32)
+    q_pos = idx * l_loc + jnp.arange(l_loc)
+    acc = jnp.zeros((b, l_loc, h, d), jnp.float32)
+    m = jnp.full((b, h, l_loc), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, l_loc), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        acc, m, l, k_cur, v_cur = carry
+        # rotate BEFORE folding (except step 0) so exactly n-1 ppermutes run;
+        # rotating after the fold would waste one full K/V ICI exchange on
+        # the last step (collectives in a fori_loop body are never DCE'd)
+        k_cur, v_cur = lax.cond(
+            s > 0,
+            lambda kv: tuple(lax.ppermute(x, axis_name, perm) for x in kv),
+            lambda kv: kv,
+            (k_cur, v_cur),
+        )
+        # at step s this device holds the shard originally on (idx - s) % n
+        src = (idx - s) % n
+        k_pos = src * l_loc + jnp.arange(l_loc)
+        mask = (k_pos[None, :] <= q_pos[:, None]) if causal else None
+        acc, m, l = _online_block(
+            (acc, m, l), (k_cur.astype(jnp.float32), v_cur.astype(jnp.float32)),
+            qf, mask, sm_scale)
+        return (acc, m, l, k_cur, v_cur)
+
+    acc, m, l, _, _ = lax.fori_loop(0, n, body, (acc, m, l, k, v))
+    return _finalize(acc, l).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                      sm_scale: Optional[float] = None):
+    """Ulysses/DeepSpeed-style SP: all_to_all seq-shards → head-shards, run
+    dense attention on full sequence with h/n local heads, all_to_all back.
+    Requires heads % axis_size == 0. Call inside ``shard_map``."""
+    n = axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses_attention needs heads ({q.shape[2]}) divisible by the "
+            f"'{axis_name}' axis size ({n}); use impl='ring' otherwise")
+    # (b, L/n, h, d) -> (b, L, h/n, d)
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = naive_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ring_self_attention(q, k, v, mesh=None, axis_name: str = "sp",
+                        causal: bool = False, sm_scale: Optional[float] = None,
+                        impl: str = "ring"):
+    """Driver: shard_map the chosen SP attention over ``axis_name``.
+
+    Inputs are global ``(b, L, h, d)`` arrays (sharded or not); output has
+    the same global shape, sequence-sharded over ``axis_name``.
+    """
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("ring_self_attention needs an active mesh")
+    fns = {"ring": ring_attention, "ulysses": ulysses_attention}
+    try:
+        fn = fns[impl]
+    except KeyError:
+        raise ValueError(f"impl must be one of {sorted(fns)}, got {impl!r}")
+    body = functools.partial(fn, axis_name=axis_name, causal=causal,
+                             sm_scale=sm_scale)
+    spec = P(None, axis_name, None, None)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,118 @@
+"""Long-context attention: blockwise / ring / Ulysses / Pallas flash.
+
+Oracle pattern per the reference test strategy (SURVEY.md §4): every
+implementation is checked against the O(L²) naive attention the way
+operator tests check against NumPy."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+from mxnet_tpu import parallel
+from mxnet_tpu.ops.pallas import flash_attention
+from mxnet_tpu.parallel.ring_attention import naive_attention
+
+
+def _rand_qkv(b, l, h, d, dtype=onp.float32, lk=None):
+    lk = lk or l
+    rng = onp.random.RandomState(0)
+    q = rng.randn(b, l, h, d).astype(dtype)
+    k = rng.randn(b, lk, h, d).astype(dtype)
+    v = rng.randn(b, lk, h, d).astype(dtype)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("l,block", [(64, 16), (50, 16)])  # odd length too
+def test_blockwise_matches_naive(causal, l, block):
+    q, k, v = _rand_qkv(2, l, 4, 8)
+    ref = naive_attention(q, k, v, causal=causal)
+    out = parallel.blockwise_attention(q, k, v, block_size=block, causal=causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_blockwise_cross_length():
+    q, k, v = _rand_qkv(1, 8, 2, 8, lk=24)
+    ref = naive_attention(q, k, v, causal=True)
+    out = parallel.blockwise_attention(q, k, v, block_size=7, causal=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sequence_parallel_matches_naive(causal, impl):
+    b, l, h, d = 2, 32, 8, 8  # h divisible by sp for ulysses
+    q, k, v = _rand_qkv(b, l, h, d)
+    mesh = parallel.make_mesh({"sp": 8})
+    ref = naive_attention(q, k, v, causal=causal)
+    with parallel.use_mesh(mesh):
+        out = parallel.ring_self_attention(q, k, v, causal=causal, impl=impl)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads():
+    """Ring attention is differentiable through shard_map + fori_loop —
+    what the training path needs."""
+    b, l, h, d = 1, 16, 2, 4
+    q, k, v = _rand_qkv(b, l, h, d)
+    mesh = parallel.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+
+    def loss_ring(q, k, v):
+        with parallel.use_mesh(mesh):
+            return parallel.ring_self_attention(q, k, v, causal=True).sum()
+
+    def loss_ref(q, k, v):
+        return naive_attention(q, k, v, causal=True).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b_),
+                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("l", [128, 100])  # exact and padded blocks
+def test_flash_attention_matches_naive(causal, l):
+    b, h, d = 2, 2, 16
+    q, k, v = _rand_qkv(b, l, h, d)
+    # flash layout is (b, h, l, d)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = flash_attention(qt, kt, vt, causal=causal, block_q=32, block_k=32)
+    ref = naive_attention(q, k, v, causal=causal).transpose(0, 2, 1, 3)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_bf16():
+    b, h, l, d = 1, 2, 64, 16
+    q, k, v = _rand_qkv(b, l, h, d)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3).astype(jnp.bfloat16)
+                  for x in (q, k, v))
+    out = flash_attention(qt, kt, vt, block_q=32, block_k=32)
+    assert out.dtype == jnp.bfloat16
+    ref = naive_attention(q, k, v).transpose(0, 2, 1, 3)
+    onp.testing.assert_allclose(onp.asarray(out, dtype=onp.float32),
+                                onp.asarray(ref), rtol=3e-2, atol=3e-2)
+
+
+def test_flash_attention_grad():
+    b, h, l, d = 1, 2, 64, 16
+    q, k, v = _rand_qkv(b, l, h, d)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=32, block_k=32).sum()
+
+    def loss_ref(q, k, v):
+        qn, kn, vn = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        return naive_attention(qn, kn, vn, causal=True).sum()
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(qt, kt, vt)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(qt, kt, vt)
+    for a, b_ in zip(g_f, g_r):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b_),
+                                    rtol=2e-4, atol=2e-4)
