@@ -27,6 +27,8 @@ from .mesh import (
     shard_params,
     use_mesh,
 )
+from .moe import MoE, moe_ffn, switch_routing
+from .pipeline import gpipe, pipeline_apply, stack_stage_params
 from .ring_attention import (
     blockwise_attention,
     naive_attention,

@@ -1,0 +1,151 @@
+"""Mixture-of-Experts with expert parallelism over an ``ep`` mesh axis.
+
+The reference has no MoE / expert parallelism (SURVEY.md §2.3: EP ❌).
+Design follows the Mesh-TensorFlow/GSPMD dense-dispatch formulation: routing
+produces dense ``dispatch``/``combine`` tensors and the expert FFN is one
+batched einsum over a stacked ``(E, ...)`` weight tensor sharded
+``P("ep", ...)`` — XLA turns the token shuffle into all_to_all over ICI.
+Top-1 (Switch) and top-2 routing with capacity dropping + the standard
+load-balancing auxiliary loss.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from ..ndarray.ndarray import ndarray, _unwrap, _wrap
+from .tensor_parallel import sharding_constraint
+
+__all__ = ["switch_routing", "moe_ffn", "MoE"]
+
+
+def switch_routing(gate_logits, capacity: int, num_selected: int = 1):
+    """Dense dispatch/combine from router logits.
+
+    ``gate_logits``: (tokens, E). Returns ``(dispatch (T,E,C) bool-ish,
+    combine (T,E,C) float, aux_loss scalar)``. Tokens beyond an expert's
+    capacity C are dropped (contribute zero — residual connections carry
+    them, the Switch-Transformer contract).
+    """
+    t, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    # load-balance aux loss (Switch eq. 4): E * sum_e mean_frac * mean_prob
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(frac_tokens * frac_probs)
+
+    # selection pass: pick top-k experts + gates per token
+    sel_idx, sel_gate = [], []
+    remaining = probs
+    for _ in range(num_selected):
+        idx = jnp.argmax(remaining, axis=-1)                  # (T,)
+        sel_idx.append(idx)
+        sel_gate.append(jnp.take_along_axis(remaining, idx[:, None], axis=-1)[:, 0])
+        remaining = remaining * (1.0 - jax.nn.one_hot(idx, e, dtype=jnp.float32))
+    gates = jnp.stack(sel_gate)                               # (k, T)
+    if num_selected > 1:
+        # GShard convention: normalize over the SELECTED gates BEFORE
+        # capacity dropping — a dropped primary must not inflate the
+        # secondary to weight 1.0 (the residual connection carries the gap)
+        gates = gates / jnp.where(gates.sum(0) == 0.0, 1.0, gates.sum(0))
+
+    # placement pass: sequential capacity fill, top-1 choices first
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    fill = jnp.zeros((e,), jnp.int32)  # per-expert slots used so far
+    for s in range(num_selected):
+        onehot = jax.nn.one_hot(sel_idx[s], e, dtype=jnp.float32)  # (T, E)
+        # position of each token within its expert's queue
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot) * onehot
+        pos = (pos_in_expert.sum(axis=-1) + fill[sel_idx[s]]).astype(jnp.int32)
+        keep = pos < capacity
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+        d = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+        dispatch = dispatch + d
+        combine = combine + d * gates[s][:, None, None]
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25,
+            num_selected: int = 1, axis_name: Optional[str] = "ep",
+            activation=jax.nn.gelu):
+    """Dense-dispatch MoE FFN over flattened tokens.
+
+    ``x``: (tokens, d). ``w1``: (E, d, d_ff), ``w2``: (E, d_ff, d).
+    Returns (out (tokens, d), aux_loss).
+    """
+    t, d = x.shape
+    e = w1.shape[0]
+    capacity = max(1, math.ceil(t / e * capacity_factor))
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # (T, E)
+    dispatch, combine, aux = switch_routing(logits, capacity, num_selected)
+    # token shuffle → (E, C, d); with w1 sharded P("ep",...) GSPMD lowers
+    # this to all_to_all over the ep axis
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    if axis_name:
+        xe = sharding_constraint(xe, P(axis_name, None, None))
+    h = activation(jnp.einsum("ecd,edf->ecf", xe, w1) + b1[:, None, :])
+    ye = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+    if axis_name:
+        ye = sharding_constraint(ye, P(axis_name, None, None))
+    out = jnp.einsum("tec,ecd->td", combine.astype(ye.dtype), ye)
+    return out, aux
+
+
+class MoE(HybridBlock):
+    """Switch/top-k MoE layer (gluon surface).
+
+    Expert weights are stacked ``(E, ...)`` and annotated ``P("ep", ...)``
+    so `param_shardings` places one expert group per ep-slice.
+
+    The load-balancing auxiliary loss is threaded the BatchNorm-running-stat
+    way: a ``grad_req='null'`` Parameter updated each forward, so in the
+    functionalized/jitted path it appears in the returned state dict under
+    the ``...moe_aux_loss`` key (read it INSIDE the traced loss fn and add it,
+    weighted ~1e-2); in eager mode read ``layer.aux_loss``.
+    """
+
+    def __init__(self, num_experts: int, hidden_size: int, ffn_hidden: int,
+                 capacity_factor: float = 1.25, num_selected: int = 1,
+                 axis_name: str = "ep", dtype="float32"):
+        super().__init__()
+        self._e = num_experts
+        self._cf = capacity_factor
+        self._k = num_selected
+        self._axis = axis_name
+        self.gate = Parameter("gate", shape=(hidden_size, num_experts), dtype=dtype)
+        self.w1 = Parameter("w1", shape=(num_experts, hidden_size, ffn_hidden), dtype=dtype)
+        self.b1 = Parameter("b1", shape=(num_experts, ffn_hidden), dtype=dtype, init="zeros")
+        self.w2 = Parameter("w2", shape=(num_experts, ffn_hidden, hidden_size), dtype=dtype)
+        self.b2 = Parameter("b2", shape=(num_experts, hidden_size), dtype=dtype, init="zeros")
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            p.sharding = P(axis_name)
+        self.moe_aux_loss = Parameter("aux_loss", shape=(1,), dtype="float32",
+                                      init="zeros", grad_req="null")
+
+    @property
+    def aux_loss(self):
+        return self.moe_aux_loss.data()
+
+    def forward(self, x):
+        from ..gluon.block import with_pause_set_data
+
+        shape = x.shape
+        xt = _unwrap(x).reshape(-1, shape[-1])
+        out, aux = moe_ffn(
+            xt, _unwrap(self.gate.data()), _unwrap(self.w1.data()),
+            _unwrap(self.b1.data()), _unwrap(self.w2.data()),
+            _unwrap(self.b2.data()), capacity_factor=self._cf,
+            num_selected=self._k, axis_name=self._axis)
+        with_pause_set_data(self.moe_aux_loss, _wrap(aux.reshape(1)))
+        out = out.reshape(shape)
+        return _wrap(out) if isinstance(x, ndarray) else out

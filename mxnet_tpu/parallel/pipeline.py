@@ -1,0 +1,124 @@
+"""Pipeline parallelism (GPipe-style microbatching over a ``pp`` mesh axis).
+
+The reference has NO pipeline parallelism (SURVEY.md §2.3: PP ❌ — its only
+model-splitting tool was manual ``group2ctx`` placement with cross-device
+copy nodes, ``src/operator/cross_device_copy.cc``). This is a from-scratch
+TPU design: every pipeline stage lives on one slice of the ``pp`` axis,
+activations hop stage→stage with ``lax.ppermute`` (neighbor ICI traffic),
+and the whole schedule is a single ``lax.scan`` inside ``shard_map`` — so
+it jits once, differentiates (scan is reverse-mode friendly), and composes
+with dp/tp axes on the same mesh.
+
+Schedule: classic GPipe fill-and-drain. With S stages and M microbatches
+the scan runs T = M + S - 1 ticks; stage s works on microbatch t - s at
+tick t (bubble ticks compute garbage that is masked out of the collect).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .collectives import axis_index, axis_size
+from .mesh import current_mesh
+
+__all__ = ["gpipe", "pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(param_dicts):
+    """Stack per-stage param dicts (same structure) along a new leading
+    stage axis — the layout ``gpipe`` shards over ``pp``."""
+    keys = param_dicts[0].keys()
+    for d in param_dicts[1:]:
+        if d.keys() != keys:
+            raise ValueError("all pipeline stages must share a param structure")
+    return {k: jnp.stack([d[k] for d in param_dicts]) for k in keys}
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, xs, axis_name: str = "pp"):
+    """The shard_map body: run the GPipe schedule for this device's stage.
+
+    ``stage_params``: this stage's params (leading stage axis of size 1,
+    squeezed here). ``xs``: all microbatches ``(M, mb, ...)`` (replicated).
+    Returns ``(M, mb, ...)`` outputs, valid on every device (broadcast from
+    the last stage).
+    """
+    n_stages = axis_size(axis_name)
+    stage = axis_index(axis_name)
+    leading = {jax.tree.leaves(stage_params)[0].shape[0]} if jax.tree.leaves(stage_params) else set()
+    if leading != {1}:
+        raise ValueError(
+            f"each device must hold exactly one stage; got a shard of "
+            f"{leading} stages — stack exactly mesh.shape['{axis_name}'] "
+            f"stage dicts")
+    params = jax.tree.map(lambda p: p[0], stage_params)
+    n_micro = xs.shape[0]
+    ticks = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    y0 = stage_fn(params, xs[0])
+    if y0.shape != xs[0].shape:
+        raise ValueError(
+            f"gpipe stages must preserve activation shape (got {xs[0].shape}"
+            f" -> {y0.shape}); fold projections into the first/last stage")
+
+    def tick(carry, t):
+        recv, outs = carry
+        x_in = jnp.where(stage == 0, xs[jnp.clip(t, 0, n_micro - 1)], recv)
+        y = stage_fn(params, x_in)
+        # last stage collects microbatch t - (S-1) when it is valid
+        out_idx = t - (n_stages - 1)
+        is_out = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
+        upd = lax.dynamic_update_index_in_dim(
+            outs, y, jnp.clip(out_idx, 0, n_micro - 1), 0)
+        outs = jnp.where(is_out, upd, outs)
+        recv = lax.ppermute(y, axis_name, perm)
+        return (recv, outs), None
+
+    outs0 = jnp.zeros_like(xs)
+    (_, outs), _ = lax.scan(tick, (jnp.zeros_like(xs[0]), outs0),
+                            jnp.arange(ticks))
+    # outputs live on the last stage; replicate them over the axis
+    src_mask = (stage == n_stages - 1).astype(outs.dtype)
+    return lax.psum(outs * src_mask, axis_name)
+
+
+def gpipe(
+    stage_fn: Callable,
+    stacked_params,
+    x,
+    n_micro: int,
+    mesh=None,
+    axis_name: str = "pp",
+):
+    """Run ``x`` through ``S = mesh.shape[axis_name]`` pipeline stages.
+
+    ``stage_fn(params, x) -> y`` is one stage's forward (shape-preserving);
+    ``stacked_params`` has a leading stage axis of size S (see
+    :func:`stack_stage_params`). ``x``: global batch ``(B, ...)`` with
+    ``B % n_micro == 0``.
+    """
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("gpipe needs an active mesh")
+    if x.shape[0] % n_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible into {n_micro} microbatches")
+    n_stages = mesh.shape[axis_name]
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stacked_params leading dim {leaf.shape[0]} != pp axis size "
+                f"{n_stages}; a larger multiple would silently drop stages")
+    xs = x.reshape(n_micro, x.shape[0] // n_micro, *x.shape[1:])
+
+    stage_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    body = lambda p, xs_: pipeline_apply(stage_fn, p, xs_, axis_name)
+    out = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(stage_spec, P()), out_specs=P(),
+        check_vma=False,
+    )(stacked_params, xs)
+    return out.reshape(x.shape[0], *out.shape[2:])
