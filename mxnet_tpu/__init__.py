@@ -51,32 +51,20 @@ from . import profiler  # noqa: F401
 from . import runtime  # noqa: F401
 from .util import is_np_array, set_np, use_np  # noqa: F401
 
-test_utils = None  # populated lazily to avoid import cost
-
-
 def __getattr__(name):
-    if name == "test_utils":
-        from . import test_utils as _tu
+    # lazy submodule loads go through importlib: `from . import x` here
+    # would re-enter __getattr__ via hasattr and recurse. A missing module
+    # must surface as AttributeError (the module-__getattr__ contract, so
+    # hasattr/getattr probes work), not ModuleNotFoundError.
+    import importlib
 
-        return _tu
-    if name == "random":
-        from .numpy import random as _r
-
-        return _r
-    if name == "sym" or name == "symbol":
-        from . import symbol as _s
-
-        return _s
-    if name == "image":
-        from . import image as _img
-
-        return _img
-    if name == "amp":
-        from . import amp as _amp
-
-        return _amp
-    if name == "parallel":
-        import importlib
-
-        return importlib.import_module(".parallel", __name__)
+    targets = {"test_utils": ".test_utils", "image": ".image", "amp": ".amp",
+               "parallel": ".parallel", "random": ".numpy.random",
+               "sym": ".symbol", "symbol": ".symbol"}
+    if name in targets:
+        try:
+            return importlib.import_module(targets[name], __name__)
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module 'mxnet_tpu' has no attribute {name!r} ({e})") from e
     raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -12,21 +12,14 @@ from . import utils  # noqa: F401
 
 
 def __getattr__(name):
-    # heavier subpackages load lazily
-    if name == "data":
-        from . import data as _d
+    # heavier subpackages load lazily (importlib, NOT `from . import`: the
+    # latter re-enters __getattr__ via hasattr and recurses)
+    if name in ("data", "model_zoo", "rnn", "contrib"):
+        import importlib
 
-        return _d
-    if name == "model_zoo":
-        from . import model_zoo as _m
-
-        return _m
-    if name == "rnn":
-        from . import rnn as _r
-
-        return _r
-    if name == "contrib":
-        from . import contrib as _c
-
-        return _c
+        try:
+            return importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module 'mxnet_tpu.gluon' has no attribute {name!r} ({e})") from e
     raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

@@ -1,0 +1,13 @@
+"""Recurrent layers (reference ``python/mxnet/gluon/rnn/``)."""
+from .rnn_cell import (
+    BidirectionalCell,
+    DropoutCell,
+    GRUCell,
+    LSTMCell,
+    RecurrentCell,
+    ResidualCell,
+    RNNCell,
+    SequentialRNNCell,
+    ZoneoutCell,
+)
+from .rnn_layer import GRU, LSTM, RNN

@@ -1,0 +1,365 @@
+"""Recurrent cells (reference ``python/mxnet/gluon/rnn/rnn_cell.py``).
+
+Gate orders match the reference/cuDNN convention so parameters port 1:1:
+LSTM: [i, f, c, o] slices of the 4H projection (rnn_cell.py LSTMCell);
+GRU:  [r, z, n] slices of the 3H projection (rnn_cell.py GRUCell, the
+linear-before-reset cuDNN variant).
+
+All step math lives in :func:`gates_to_state` / :func:`cell_step` — pure
+jnp functions shared with the fused layers (rnn_layer.py) and invoked
+through the ``npx`` dispatch (``_call``) so eager calls land on the
+autograd tape exactly like every other operator.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ... import numpy_extension as npx
+from ...numpy_extension import _call
+from ...ndarray.ndarray import ndarray, _unwrap, _wrap
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = [
+    "RecurrentCell",
+    "RNNCell",
+    "LSTMCell",
+    "GRUCell",
+    "SequentialRNNCell",
+    "DropoutCell",
+    "ResidualCell",
+    "BidirectionalCell",
+    "ZoneoutCell",
+]
+
+_GATE_MULT = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def gates_to_state(mode, hidden_size, ih, hh, h, c):
+    """Pure-jnp gate math: pre-projections → new state. THE single source
+    of truth for RNN/LSTM/GRU step semantics (cells and fused layers).
+
+    Returns ``(h_new, c_new)`` (``c_new`` is ``c`` passed through for
+    non-LSTM modes)."""
+    hs = hidden_size
+    if mode == "rnn_tanh":
+        h_new = jnp.tanh(ih + hh)
+        return h_new, c
+    if mode == "rnn_relu":
+        h_new = jnp.maximum(ih + hh, 0)
+        return h_new, c
+    if mode == "lstm":
+        g = ih + hh
+        i = jax.nn.sigmoid(g[..., 0 * hs:1 * hs])
+        f = jax.nn.sigmoid(g[..., 1 * hs:2 * hs])
+        gg = jnp.tanh(g[..., 2 * hs:3 * hs])
+        o = jax.nn.sigmoid(g[..., 3 * hs:4 * hs])
+        c_new = f * c + i * gg
+        return o * jnp.tanh(c_new), c_new
+    if mode == "gru":
+        r = jax.nn.sigmoid(ih[..., 0 * hs:1 * hs] + hh[..., 0 * hs:1 * hs])
+        z = jax.nn.sigmoid(ih[..., 1 * hs:2 * hs] + hh[..., 1 * hs:2 * hs])
+        n = jnp.tanh(ih[..., 2 * hs:3 * hs] + r * hh[..., 2 * hs:3 * hs])
+        return (1 - z) * n + z * h, c
+    raise ValueError(f"unknown RNN mode {mode!r}")
+
+
+def cell_step(mode, hidden_size, x, h, c, wi, wh, bi, bh):
+    """One full step from raw inputs (pure jnp)."""
+    ih = x @ wi.T + bi
+    hh = h @ wh.T + bh
+    return gates_to_state(mode, hidden_size, ih, hh, h, c)
+
+
+class RecurrentCell(HybridBlock):
+    """Base cell: ``cell(x_t, states) -> (out_t, new_states)`` plus
+    ``begin_state`` / ``unroll`` / ``reset`` (reference rnn_cell.py
+    RecurrentCell)."""
+
+    def __init__(self):
+        super().__init__()
+        self._modified = False
+
+    def reset(self):
+        """Reset per-sequence bookkeeping (reference rnn_cell.py:reset)."""
+        for child in self._children.values():
+            if isinstance(child, RecurrentCell):
+                child.reset()
+
+    def state_info(self, batch_size: int = 0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size: int = 0, func=None, **kwargs):
+        from ... import numpy as mxnp
+
+        func = func or mxnp.zeros
+        return [func(info["shape"], **kwargs) for info in self.state_info(batch_size)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Trace-time unroll (reference rnn_cell.py:unroll). Returns
+        (outputs, states); with ``valid_length`` the outputs are masked and
+        the returned states are the ones AT each sequence's last valid step
+        (reference uses SequenceLast for this)."""
+        from ... import numpy as mxnp
+
+        self.reset()
+        axis = layout.find("T")
+        if begin_state is None:
+            batch = inputs.shape[layout.find("N")]
+            begin_state = self.begin_state(batch)
+        states = begin_state
+        outputs = []
+        step_states = []  # per-step states for valid_length selection
+        for t in range(length):
+            x_t = _wrap(jnp.take(_unwrap(inputs), t, axis=axis))
+            out, states = self(x_t, states)
+            outputs.append(out)
+            if valid_length is not None:
+                step_states.append(states)
+        if valid_length is not None:
+            stacked = mxnp.stack(outputs, axis=axis)
+            outputs = npx.sequence_mask(
+                stacked, sequence_length=valid_length, use_sequence_length=True,
+                axis=axis)
+            # state at step valid_length-1 per batch element
+            vl = jnp.clip(_unwrap(valid_length).astype(jnp.int32) - 1, 0, length - 1)
+            new_states = []
+            for si in range(len(states)):
+                per_step = jnp.stack([_unwrap(s[si]) for s in step_states])  # (T,N,H)
+                sel = jnp.take_along_axis(
+                    per_step, vl[None, :, None].astype(jnp.int32), axis=0)[0]
+                new_states.append(_wrap(sel))
+            states = new_states
+        elif merge_outputs is None or merge_outputs:
+            outputs = mxnp.stack(outputs, axis=axis)
+        return outputs, states
+
+
+class _BaseGatedCell(RecurrentCell):
+    """Shared i2h/h2h parameter layout (reference rnn_cell.py: i2h_weight
+    (mult*H, C), h2h_weight (mult*H, H))."""
+
+    _mode = "rnn_tanh"
+
+    def __init__(self, hidden_size, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", dtype="float32"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        m = _GATE_MULT[self._mode]
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(m * hidden_size, input_size), dtype=dtype,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(m * hidden_size, hidden_size), dtype=dtype,
+            init=h2h_weight_initializer)
+        self.i2h_bias = Parameter(
+            "i2h_bias", shape=(m * hidden_size,), dtype=dtype,
+            init=i2h_bias_initializer)
+        self.h2h_bias = Parameter(
+            "h2h_bias", shape=(m * hidden_size,), dtype=dtype,
+            init=h2h_bias_initializer)
+
+    def _finalize(self, x):
+        if not self.i2h_weight.shape_known:
+            self.i2h_weight.shape = (_GATE_MULT[self._mode] * self._hidden_size,
+                                     x.shape[-1])
+            self.i2h_weight.finalize()
+            self._input_size = x.shape[-1]
+
+    def state_info(self, batch_size: int = 0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _step_args(self, x, states):
+        has_c = self._mode == "lstm"
+        c = states[1] if has_c else states[0]
+        return (x, states[0], c, self.i2h_weight.data(), self.h2h_weight.data(),
+                self.i2h_bias.data(), self.h2h_bias.data())
+
+    def forward(self, x, states):
+        self._finalize(x)
+        mode, hs = self._mode, self._hidden_size
+        # one tape node per step: the whole gate computation goes through
+        # the npx dispatch so eager autograd.record() sees it
+        h_new, c_new = _call(
+            lambda *a: cell_step(mode, hs, *a),
+            self._step_args(x, states), n_out=2, name=type(self).__name__)
+        if mode == "lstm":
+            return h_new, [h_new, c_new]
+        return h_new, [h_new]
+
+
+class RNNCell(_BaseGatedCell):
+    """Elman RNN cell: h' = act(W_ih x + b_ih + W_hh h + b_hh)."""
+
+    def __init__(self, hidden_size, activation="tanh", **kwargs):
+        self._mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, **kwargs)
+
+
+class LSTMCell(_BaseGatedCell):
+    """LSTM cell, gates sliced [i, f, c, o] (reference rnn_cell.py LSTMCell)."""
+
+    _mode = "lstm"
+
+    def state_info(self, batch_size: int = 0):
+        return [
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+        ]
+
+
+class GRUCell(_BaseGatedCell):
+    """GRU cell, gates sliced [r, z, n] (reference rnn_cell.py GRUCell)."""
+
+    _mode = "gru"
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells; state list is the concatenation of the children's."""
+
+    def __init__(self, *cells):
+        super().__init__()
+        for c in cells:
+            self.add(c)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def state_info(self, batch_size: int = 0):
+        out = []
+        for c in self._children.values():
+            out.extend(c.state_info(batch_size))
+        return out
+
+    def forward(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, s = cell(x, states[p:p + n])
+            next_states.extend(s)
+            p += n
+        return x, next_states
+
+
+class DropoutCell(RecurrentCell):
+    """Dropout on the cell output (reference rnn_cell.py DropoutCell)."""
+
+    def __init__(self, rate):
+        super().__init__()
+        self._rate = rate
+
+    def state_info(self, batch_size: int = 0):
+        return []
+
+    def forward(self, x, states):
+        if self._rate:
+            x = npx.dropout(x, p=self._rate)
+        return x, states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size: int = 0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, x, states):
+        out, states = self.base_cell(x, states)
+        return out + x, states
+
+
+class ZoneoutCell(RecurrentCell):
+    """Zoneout regularization: randomly keep previous outputs/states."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__()
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_out = None
+
+    def reset(self):
+        super().reset()
+        self._prev_out = None
+
+    def state_info(self, batch_size: int = 0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, x, states):
+        out, new_states = self.base_cell(x, states)
+        from ...autograd import is_training
+
+        if is_training():
+            def _mix(new, old, rate):
+                # dropout of ones is 0 with prob rate else 1/(1-rate); scale
+                # back to a {0,1} keep-mask, then blend with ndarray
+                # arithmetic so the tape sees the op chain
+                keep = npx.dropout(new * 0 + 1, p=rate) * (1 - rate)
+                return keep * new + (1 - keep) * old
+
+            if self._zo:
+                # keep previous output with prob zo (zeros on the first step)
+                prev = (self._prev_out if self._prev_out is not None
+                        else out * 0)
+                out = _mix(out, prev, self._zo)
+            if self._zs and states:
+                new_states = [_mix(new, old, self._zs)
+                              for old, new in zip(states, new_states)]
+        self._prev_out = out
+        return out, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Wrap two cells for forward/backward directions; only usable via
+    ``unroll`` (reference rnn_cell.py BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size: int = 0):
+        return (self.l_cell.state_info(batch_size)
+                + self.r_cell.state_info(batch_size))
+
+    def forward(self, x, states):
+        raise NotImplementedError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import numpy as mxnp
+
+        self.reset()
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        n_l = len(self.l_cell.state_info())
+
+        def _rev(d):
+            return npx.sequence_reverse(
+                d, sequence_length=valid_length,
+                use_sequence_length=valid_length is not None, axis=axis)
+
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:n_l], layout, True, valid_length)
+        r_out, r_states = self.r_cell.unroll(
+            length, _rev(inputs), begin_state[n_l:], layout, True, valid_length)
+        out = mxnp.concatenate([l_out, _rev(r_out)], axis=-1)
+        return out, l_states + r_states

@@ -1,0 +1,207 @@
+"""Fused multi-layer RNN/LSTM/GRU layers (reference
+``python/mxnet/gluon/rnn/rnn_layer.py`` → fused cuDNN op
+``src/operator/rnn.cc:291``).
+
+TPU design: the time loop is one ``lax.scan`` per layer/direction — traced
+once, fused by XLA, O(1) program size in sequence length (the property the
+reference needed cuDNN's hand-fused kernel for). Gate math is
+:func:`rnn_cell.gates_to_state` — the SAME function the cells use — so
+layer and cell weights are interchangeable. The whole fused forward is one
+``npx`` dispatch call, so eager ``autograd.record()`` training works."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ... import numpy_extension as npx
+from ...numpy_extension import _call, _next_key
+from ...ndarray.ndarray import ndarray, _unwrap, _wrap
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .rnn_cell import _GATE_MULT, gates_to_state
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+def _scan_direction(mode, hidden_size, x_tnc, h0, c0, wi, wh, bi, bh, reverse):
+    """Scan one layer/direction. x_tnc: (T, N, C). Returns (T, N, H), hT, cT."""
+    # input projection for ALL timesteps in one (T*N, C) @ (C, mH) matmul —
+    # keeps the MXU busy; only the recurrent h @ wh runs inside the scan
+    t, n, _ = x_tnc.shape
+    ih = x_tnc.reshape(t * n, -1) @ wi.T + bi
+    ih = ih.reshape(t, n, -1)
+    if reverse:
+        ih = ih[::-1]
+
+    def step(carry, ih_t):
+        h, c = carry
+        hh = h @ wh.T + bh
+        h_new, c_new = gates_to_state(mode, hidden_size, ih_t, hh, h, c)
+        return (h_new, c_new), h_new
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), ih)
+    if reverse:
+        ys = ys[::-1]
+    return ys, hT, cT
+
+
+def _fused_rnn(mode, hidden_size, num_layers, ndir, dropout, layout_ntc,
+               x, h0, c0, drop_keys, *weights):
+    """Pure-jnp multi-layer (bi)directional RNN — one tape op."""
+    if layout_ntc:
+        x = x.swapaxes(0, 1)
+    hT: List = []
+    cT: List = []
+    w = list(weights)
+    for layer in range(num_layers):
+        outs = []
+        for d in range(ndir):
+            idx = layer * ndir + d
+            wi, wh, bi, bh = w[idx * 4: idx * 4 + 4]
+            ys, h_f, c_f = _scan_direction(
+                mode, hidden_size, x, h0[idx], c0[idx], wi, wh, bi, bh,
+                reverse=(d == 1))
+            outs.append(ys)
+            hT.append(h_f)
+            cT.append(c_f)
+        x = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
+        if dropout and drop_keys is not None and layer != num_layers - 1:
+            keep = jax.random.bernoulli(drop_keys[layer], 1.0 - dropout, x.shape)
+            x = jnp.where(keep, x / (1.0 - dropout), 0.0)
+    if layout_ntc:
+        x = x.swapaxes(0, 1)
+    return x, jnp.stack(hT), jnp.stack(cT)
+
+
+class _RNNLayer(HybridBlock):
+    """Shared implementation of RNN/LSTM/GRU (reference rnn_layer.py:_RNNLayer)."""
+
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
+                 dropout=0.0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32"):
+        super().__init__()
+        if layout not in ("TNC", "NTC"):
+            raise ValueError(f"layout must be TNC or NTC, got {layout}")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        mult = _GATE_MULT[mode]
+        self._mult = mult
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                suffix = "l" if d == 0 else "r"
+                in_size = input_size if layer == 0 else hidden_size * self._dir
+                setattr(self, f"{suffix}{layer}_i2h_weight", Parameter(
+                    f"{suffix}{layer}_i2h_weight",
+                    shape=(mult * hidden_size, in_size), dtype=dtype,
+                    init=i2h_weight_initializer, allow_deferred_init=True))
+                setattr(self, f"{suffix}{layer}_h2h_weight", Parameter(
+                    f"{suffix}{layer}_h2h_weight",
+                    shape=(mult * hidden_size, hidden_size), dtype=dtype,
+                    init=h2h_weight_initializer))
+                setattr(self, f"{suffix}{layer}_i2h_bias", Parameter(
+                    f"{suffix}{layer}_i2h_bias", shape=(mult * hidden_size,),
+                    dtype=dtype, init=i2h_bias_initializer))
+                setattr(self, f"{suffix}{layer}_h2h_bias", Parameter(
+                    f"{suffix}{layer}_h2h_bias", shape=(mult * hidden_size,),
+                    dtype=dtype, init=h2h_bias_initializer))
+
+    def state_info(self, batch_size: int = 0):
+        num = self._num_layers * self._dir
+        shapes = [{"shape": (num, batch_size, self._hidden_size)}]
+        if self._mode == "lstm":
+            shapes.append({"shape": (num, batch_size, self._hidden_size)})
+        return shapes
+
+    def begin_state(self, batch_size: int = 0, func=None, **kwargs):
+        from ... import numpy as mxnp
+
+        func = func or mxnp.zeros
+        return [func(info["shape"], **kwargs) for info in self.state_info(batch_size)]
+
+    def _finalize(self, in_size):
+        for d in range(self._dir):
+            suffix = "l" if d == 0 else "r"
+            p = getattr(self, f"{suffix}0_i2h_weight")
+            if not p.shape_known:
+                p.shape = (self._mult * self._hidden_size, in_size)
+                p.finalize()
+
+    def _weight_list(self):
+        out = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = "l" if d == 0 else "r"
+                for name in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+                    out.append(getattr(self, f"{suffix}{layer}_{name}").data())
+        return out
+
+    def forward(self, inputs, states=None):
+        from ...autograd import is_training
+
+        self._finalize(inputs.shape[-1])
+        return_states = states is not None
+        n = inputs.shape[0 if self._layout == "NTC" else 1]
+        if states is None:
+            states = self.begin_state(n)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        h0 = states[0]
+        c0 = states[1] if self._mode == "lstm" else h0 * 0
+        training = is_training()
+        use_dropout = bool(self._dropout) and training and self._num_layers > 1
+        if use_dropout:
+            drop_keys = jnp.stack([_next_key() for _ in range(self._num_layers - 1)])
+        else:
+            drop_keys = jnp.zeros((max(self._num_layers - 1, 1), 2), jnp.uint32)
+
+        mode, hs = self._mode, self._hidden_size
+        nl, ndir = self._num_layers, self._dir
+        dropout = self._dropout if use_dropout else 0.0
+        ntc = self._layout == "NTC"
+        out, hT, cT = _call(
+            lambda x, h, c, keys, *w: _fused_rnn(
+                mode, hs, nl, ndir, dropout, ntc, x, h, c,
+                keys if dropout else None, *w),
+            (inputs, h0, c0, _wrap(drop_keys), *self._weight_list()),
+            n_out=3, name=type(self).__name__)
+        if not return_states:
+            return out
+        new_states = [hT]
+        if self._mode == "lstm":
+            new_states.append(cT)
+        return out, new_states
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"num_layers={self._num_layers}, layout='{self._layout}', "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    """Vanilla multi-layer RNN (reference rnn_layer.py RNN; rnn.cc modes
+    rnn_relu/rnn_tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="tanh", **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, **kwargs)

@@ -583,6 +583,11 @@ def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0)
 def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=axis)
+    if axis != 0:
+        # masked path is written for TNC (time on axis 0); transpose around
+        data = jnp.swapaxes(data, 0, axis)
+        out = sequence_reverse(data, sequence_length, True, axis=0)
+        return jnp.swapaxes(out, 0, axis)
     maxlen = data.shape[axis]
     steps = jnp.arange(maxlen)
     # reverse only the first seq_len elements per batch (axis=0 layout TNC)
