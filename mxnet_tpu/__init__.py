@@ -62,9 +62,12 @@ def __getattr__(name):
                "parallel": ".parallel", "random": ".numpy.random",
                "sym": ".symbol", "symbol": ".symbol"}
     if name in targets:
+        expected = importlib.util.resolve_name(targets[name], __name__)
         try:
             return importlib.import_module(targets[name], __name__)
         except ModuleNotFoundError as e:
+            if e.name != expected:
+                raise  # a real missing dependency inside the module
             raise AttributeError(
                 f"module 'mxnet_tpu' has no attribute {name!r} ({e})") from e
     raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

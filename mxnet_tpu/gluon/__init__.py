@@ -20,6 +20,8 @@ def __getattr__(name):
         try:
             return importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:
+            if e.name != f"{__name__}.{name}":
+                raise  # a real missing dependency inside the module
             raise AttributeError(
                 f"module 'mxnet_tpu.gluon' has no attribute {name!r} ({e})") from e
     raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

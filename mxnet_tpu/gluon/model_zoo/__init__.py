@@ -1,3 +1,4 @@
 """Model zoo (reference ``python/mxnet/gluon/model_zoo/``)."""
 from . import vision  # noqa: F401
 from .vision import get_model  # noqa: F401
+from . import bert  # noqa: F401

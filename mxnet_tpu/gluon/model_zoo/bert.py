@@ -1,0 +1,131 @@
+"""BERT model family — the SURVEY.md §7 stage-8 stretch target, built
+TPU-first: flash-attention encoder layers, bf16-ready, optional Megatron
+TP via ``tp_axis``. (The reference kept BERT in gluonnlp; the in-tree
+pieces were only the attention primitive ops, transformer.cc:650.)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ... import numpy_extension as npx
+from ...ndarray.ndarray import ndarray, _unwrap, _wrap
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .. import nn
+from ..nn.transformer import TransformerEncoder
+
+__all__ = ["BERTModel", "BERTForPretraining", "bert_base", "bert_large",
+           "gpt_like"]
+
+
+class BERTModel(HybridBlock):
+    """Embeddings (word + position + token-type) → transformer encoder →
+    (sequence output, pooled [CLS] output)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 token_types=2, dropout=0.1, tp_axis: Optional[str] = None,
+                 dtype="float32"):
+        super().__init__()
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units, dtype=dtype)
+        self.token_type_embed = nn.Embedding(token_types, units, dtype=dtype)
+        self.pos_embed = Parameter("pos_embed", shape=(max_length, units),
+                                   dtype=dtype)
+        self.embed_ln = nn.LayerNorm(in_channels=units)
+        self.embed_dropout = nn.Dropout(dropout) if dropout else None
+        self.encoder = TransformerEncoder(
+            num_layers, units, hidden_size, num_heads, dropout=dropout,
+            attention_dropout=dropout, pre_norm=False, tp_axis=tp_axis,
+            dtype=dtype)
+        self.pooler = nn.Dense(units, activation="tanh", flatten=False,
+                               in_units=units, dtype=dtype)
+
+    def forward(self, token_ids, token_types=None, valid_length=None):
+        b, l = token_ids.shape
+        emb = self.word_embed(token_ids)
+        if token_types is not None:
+            emb = emb + self.token_type_embed(token_types)
+        emb = emb + self.pos_embed.data()[:l]
+        emb = self.embed_ln(emb)
+        if self.embed_dropout is not None:
+            emb = self.embed_dropout(emb)
+        mask = None
+        if valid_length is not None:
+            vl = _unwrap(valid_length)
+            m = jnp.arange(l)[None, :] < vl[:, None]          # (B, Lk)
+            mask = _wrap(m[:, None, None, :])                  # (B,1,1,Lk) bool
+        seq = self.encoder(emb, mask=mask)
+        pooled = self.pooler(seq[:, 0])
+        return seq, pooled
+
+
+class BERTForPretraining(HybridBlock):
+    """MLM head (transform + tied decoder) + NSP head."""
+
+    def __init__(self, bert: BERTModel, vocab_size=30522, dtype="float32"):
+        super().__init__()
+        self.bert = bert
+        units = bert._units
+        self.mlm_transform = nn.Dense(units, activation="gelu", flatten=False,
+                                      in_units=units, dtype=dtype)
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        self.mlm_bias = Parameter("mlm_bias", shape=(vocab_size,), dtype=dtype,
+                                  init="zeros")
+        self.nsp = nn.Dense(2, flatten=False, in_units=units, dtype=dtype)
+
+    def forward(self, token_ids, token_types=None, valid_length=None):
+        seq, pooled = self.bert(token_ids, token_types, valid_length)
+        h = self.mlm_ln(self.mlm_transform(seq))
+        # decoder tied to the word embedding (standard BERT weight tying);
+        # taped ndarray ops so eager record()/backward() reaches everything
+        w = self.bert.word_embed.weight.data()
+        logits = h @ w.T + self.mlm_bias.data()
+        nsp_logits = self.nsp(pooled)
+        return logits, nsp_logits
+
+
+def bert_base(**kwargs):
+    """BERT-base: L12 H768 A12 (the BASELINE stretch-goal config)."""
+    cfg = dict(units=768, hidden_size=3072, num_layers=12, num_heads=12)
+    cfg.update(kwargs)
+    return BERTModel(**cfg)
+
+
+def bert_large(**kwargs):
+    cfg = dict(units=1024, hidden_size=4096, num_layers=24, num_heads=16)
+    cfg.update(kwargs)
+    return BERTModel(**cfg)
+
+
+class _CausalLM(HybridBlock):
+    """Decoder-only LM (GPT-style): causal flash-attention encoder stack +
+    tied LM head — exercises the causal kernel path end to end."""
+
+    def __init__(self, vocab_size=32000, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=2048,
+                 dropout=0.0, tp_axis: Optional[str] = None, dtype="float32"):
+        super().__init__()
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units, dtype=dtype)
+        self.pos_embed = Parameter("pos_embed", shape=(max_length, units),
+                                   dtype=dtype)
+        self.encoder = TransformerEncoder(
+            num_layers, units, hidden_size, num_heads, dropout=dropout,
+            attention_dropout=dropout, causal=True, pre_norm=True,
+            tp_axis=tp_axis, dtype=dtype)
+
+    def forward(self, token_ids):
+        b, l = token_ids.shape
+        emb = self.word_embed(token_ids)
+        emb = emb + self.pos_embed.data()[:l]
+        seq = self.encoder(emb)
+        w = self.word_embed.weight.data()
+        return seq @ w.T
+
+
+def gpt_like(**kwargs):
+    return _CausalLM(**kwargs)

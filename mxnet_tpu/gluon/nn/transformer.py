@@ -1,0 +1,199 @@
+"""Transformer building blocks.
+
+The reference ships only raw attention primitive *ops*
+(``src/operator/contrib/transformer.cc:650`` interleaved QK/valatt matmuls)
+— the layers lived in gluonnlp. Here the layers are first-class: designed
+for TPU (flash-attention Pallas kernel on the hot path, bf16-safe fp32
+softmax, optional Megatron tensor parallelism via ``tp_axis``).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ... import numpy_extension as npx
+from ...numpy_extension import _call
+from ...ndarray.ndarray import ndarray, _unwrap, _wrap
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .basic_layers import Dense, Dropout, HybridSequential
+from .norm_layers import LayerNorm
+
+__all__ = [
+    "MultiHeadAttention",
+    "PositionwiseFFN",
+    "TransformerEncoderLayer",
+    "TransformerEncoder",
+]
+
+
+from ...ops.nn import attend as _attend
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self/cross attention over (batch, seq, units) inputs.
+
+    ``mask``: optional (B, H|1, Lq, Lk) boolean (True = attend) or additive
+    float mask. ``tp_axis``: shard heads Megatron-style over that mesh axis
+    (qkv column-parallel, out row-parallel)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, causal=False,
+                 use_bias=True, tp_axis: Optional[str] = None, dtype="float32"):
+        super().__init__()
+        if units % num_heads:
+            raise ValueError(f"units {units} not divisible by heads {num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._dropout = dropout
+        self._causal = causal
+        if tp_axis:
+            from ...parallel.tensor_parallel import (
+                ColumnParallelDense, RowParallelDense)
+
+            self.qkv = ColumnParallelDense(3 * units, axis_name=tp_axis,
+                                           use_bias=use_bias, flatten=False,
+                                           in_units=units, dtype=dtype)
+            self.out_proj = RowParallelDense(units, axis_name=tp_axis,
+                                             use_bias=use_bias, flatten=False,
+                                             in_units=units, dtype=dtype)
+        else:
+            self.qkv = Dense(3 * units, use_bias=use_bias, flatten=False,
+                             in_units=units, dtype=dtype)
+            self.out_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                  in_units=units, dtype=dtype)
+
+    def forward(self, x, mask=None, kv=None):
+        units, heads = self._units, self._heads
+        if kv is None:
+            proj = self.qkv(x)
+            args = [proj]
+
+            def split(p):
+                return p[..., :units], p[..., units:2 * units], p[..., 2 * units:]
+        else:
+            # cross attention: q from x, k/v from kv through the same proj
+            proj_q = self.qkv(x)
+            proj_kv = self.qkv(kv)
+            args = [proj_q, proj_kv]
+
+            def split(pq, pkv):
+                return (pq[..., :units], pkv[..., units:2 * units],
+                        pkv[..., 2 * units:])
+
+        from ...autograd import is_training
+
+        training = is_training()
+        causal, dropout = self._causal, self._dropout
+        if mask is not None:
+            args.append(mask)
+
+        from ...numpy_extension import _next_key
+
+        key = _next_key() if (dropout and training) else jnp.zeros(2, jnp.uint32)
+
+        def fn(*arrs):
+            # unpack: [proj(s)..., mask?, key]
+            k_ = arrs[-1]
+            rest = arrs[:-1]
+            if mask is not None:
+                m = rest[-1]
+                rest = rest[:-1]
+            else:
+                m = None
+            q, k, v = split(*rest)
+            return _attend(q, k, v, heads, causal, m, dropout, k_, training)
+
+        args.append(_wrap(key))
+        return self.out_proj(_call(fn, tuple(args), name="MultiHeadAttention"))
+
+
+class PositionwiseFFN(HybridBlock):
+    """FFN(x) = W2 act(W1 x); optional TP sharding (column→row)."""
+
+    def __init__(self, units, hidden_size, activation="gelu", dropout=0.0,
+                 tp_axis: Optional[str] = None, dtype="float32"):
+        super().__init__()
+        if tp_axis:
+            from ...parallel.tensor_parallel import (
+                ColumnParallelDense, RowParallelDense)
+
+            self.ffn_1 = ColumnParallelDense(hidden_size, axis_name=tp_axis,
+                                             flatten=False, in_units=units,
+                                             activation=activation, dtype=dtype)
+            self.ffn_2 = RowParallelDense(units, axis_name=tp_axis,
+                                          flatten=False, in_units=hidden_size,
+                                          dtype=dtype)
+        else:
+            self.ffn_1 = Dense(hidden_size, flatten=False, in_units=units,
+                               activation=activation, dtype=dtype)
+            self.ffn_2 = Dense(units, flatten=False, in_units=hidden_size,
+                               dtype=dtype)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        h = self.ffn_1(x)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.ffn_2(h)
+
+
+class TransformerEncoderLayer(HybridBlock):
+    """Pre-LN transformer layer (the stable-training variant)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 attention_dropout=0.0, activation="gelu", causal=False,
+                 pre_norm=True, tp_axis: Optional[str] = None, dtype="float32"):
+        super().__init__()
+        self._pre_norm = pre_norm
+        self.attn = MultiHeadAttention(units, num_heads,
+                                       dropout=attention_dropout,
+                                       causal=causal, tp_axis=tp_axis,
+                                       dtype=dtype)
+        self.ffn = PositionwiseFFN(units, hidden_size, activation=activation,
+                                   dropout=dropout, tp_axis=tp_axis, dtype=dtype)
+        self.ln1 = LayerNorm(in_channels=units)
+        self.ln2 = LayerNorm(in_channels=units)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        if self._pre_norm:
+            h = self.attn(self.ln1(x), mask=mask)
+            if self.dropout is not None:
+                h = self.dropout(h)
+            x = x + h
+            h = self.ffn(self.ln2(x))
+            if self.dropout is not None:
+                h = self.dropout(h)
+            return x + h
+        h = self.attn(x, mask=mask)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        x = self.ln1(x + h)
+        h = self.ffn(x)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.ln2(x + h)
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
+                 attention_dropout=0.0, activation="gelu", causal=False,
+                 pre_norm=True, tp_axis: Optional[str] = None, dtype="float32"):
+        super().__init__()
+        self._num_layers = num_layers
+        for i in range(num_layers):
+            setattr(self, f"layer{i}", TransformerEncoderLayer(
+                units, hidden_size, num_heads, dropout=dropout,
+                attention_dropout=attention_dropout, activation=activation,
+                causal=causal, pre_norm=pre_norm, tp_axis=tp_axis, dtype=dtype))
+        self.final_ln = LayerNorm(in_channels=units) if pre_norm else None
+
+    def forward(self, x, mask=None):
+        for i in range(self._num_layers):
+            x = getattr(self, f"layer{i}")(x, mask=mask)
+        if self.final_ln is not None:
+            x = self.final_ln(x)
+        return x

@@ -116,7 +116,8 @@ class RecurrentCell(HybridBlock):
         outputs = []
         step_states = []  # per-step states for valid_length selection
         for t in range(length):
-            x_t = _wrap(jnp.take(_unwrap(inputs), t, axis=axis))
+            # taped slicing keeps upstream layers (embeddings) on the tape
+            x_t = inputs[t] if axis == 0 else inputs[:, t]
             out, states = self(x_t, states)
             outputs.append(out)
             if valid_length is not None:
@@ -126,15 +127,15 @@ class RecurrentCell(HybridBlock):
             outputs = npx.sequence_mask(
                 stacked, sequence_length=valid_length, use_sequence_length=True,
                 axis=axis)
-            # state at step valid_length-1 per batch element
-            vl = jnp.clip(_unwrap(valid_length).astype(jnp.int32) - 1, 0, length - 1)
-            new_states = []
-            for si in range(len(states)):
-                per_step = jnp.stack([_unwrap(s[si]) for s in step_states])  # (T,N,H)
-                sel = jnp.take_along_axis(
-                    per_step, vl[None, :, None].astype(jnp.int32), axis=0)[0]
-                new_states.append(_wrap(sel))
-            states = new_states
+            # state at step valid_length-1 per batch element (reference
+            # SequenceLast semantics; taped via npx)
+            states = [
+                npx.sequence_last(
+                    mxnp.stack([s[si] for s in step_states], axis=0),
+                    sequence_length=valid_length, use_sequence_length=True,
+                    axis=0)
+                for si in range(len(states))
+            ]
         elif merge_outputs is None or merge_outputs:
             outputs = mxnp.stack(outputs, axis=axis)
         return outputs, states

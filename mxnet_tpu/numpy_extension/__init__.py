@@ -336,3 +336,32 @@ def index_update(data, indices, values):
 from .control_flow import foreach, while_loop, cond  # noqa: E402,F401
 
 from . import random  # noqa: E402,F401
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    return _call(lambda x: _nn.interleaved_matmul_selfatt_qk(x, heads),
+                 (queries_keys_values,), name="interleaved_matmul_selfatt_qk")
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    return _call(lambda x, a: _nn.interleaved_matmul_selfatt_valatt(x, a, heads),
+                 (queries_keys_values, attention),
+                 name="interleaved_matmul_selfatt_valatt")
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads):
+    return _call(lambda q, kv: _nn.interleaved_matmul_encdec_qk(q, kv, heads),
+                 (queries, keys_values), name="interleaved_matmul_encdec_qk")
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
+    return _call(lambda kv, a: _nn.interleaved_matmul_encdec_valatt(kv, a, heads),
+                 (keys_values, attention), name="interleaved_matmul_encdec_valatt")
+
+
+def multi_head_attention(query, key, value, heads, causal=False):
+    """Fused multi-head attention over (B, L, H*D) projections — the Pallas
+    flash kernel on TPU (ops/pallas/flash_attention.py), the interpreter
+    elsewhere. Shares its core with nn.MultiHeadAttention (ops/nn.py:attend)."""
+    return _call(lambda q, k, v: _nn.attend(q, k, v, heads, causal=causal),
+                 (query, key, value), name="multi_head_attention")

@@ -598,3 +598,100 @@ def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis
     ).astype(jnp.int32)
     batch = jnp.arange(data.shape[1])[None, :]
     return data[rev_idx, batch]
+
+
+# ---------------------------------------------------------------------------
+# transformer attention primitives (reference src/operator/contrib/
+# transformer.cc:650 interleaved_matmul_selfatt_qk, :693 *_valatt, and the
+# encdec variants) — layout (seq, batch, heads * 3 * head_dim) with Q/K/V
+# interleaved per head, exactly the reference's memory layout so ported
+# code and weights work unchanged.
+# ---------------------------------------------------------------------------
+def _split_selfatt(qkv, heads):
+    l, b, hidden = qkv.shape
+    d = hidden // (3 * heads)
+    x = qkv.reshape(l, b, heads, 3, d)
+    return x[..., 0, :], x[..., 1, :], x[..., 2, :]  # (L, B, H, D) each
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """Scores (B*H, Lq, Lk) from interleaved QKV, scaled by 1/sqrt(D)."""
+    q, k, _ = _split_selfatt(queries_keys_values, heads)
+    l, b, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("qbhd,kbhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    return s.reshape(b * h, l, l).astype(queries_keys_values.dtype)
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    """(Lq, B, H*D) = attention @ V from interleaved QKV."""
+    _, _, v = _split_selfatt(queries_keys_values, heads)
+    l, b, h, d = v.shape
+    att = attention.reshape(b, h, l, l).astype(jnp.float32)
+    out = jnp.einsum("bhqk,kbhd->qbhd", att, v.astype(jnp.float32))
+    return out.reshape(l, b, h * d).astype(queries_keys_values.dtype)
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads):
+    """Scores (B*H, Lq, Lk): q (Lq, B, H*D); kv interleaved (Lk, B, H*2*D)."""
+    lq, b, hidden = queries.shape
+    d = hidden // heads
+    q = queries.reshape(lq, b, heads, d)
+    lk = keys_values.shape[0]
+    kv = keys_values.reshape(lk, b, heads, 2, d)
+    k = kv[..., 0, :]
+    scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("qbhd,kbhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    return s.reshape(b * heads, lq, lk).astype(queries.dtype)
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
+    lk, b, hidden = keys_values.shape
+    d = hidden // (2 * heads)
+    kv = keys_values.reshape(lk, b, heads, 2, d)
+    v = kv[..., 1, :]
+    lq = attention.shape[1]
+    att = attention.reshape(b, heads, lq, lk).astype(jnp.float32)
+    out = jnp.einsum("bhqk,kbhd->qbhd", att, v.astype(jnp.float32))
+    return out.reshape(lq, b, heads * d).astype(keys_values.dtype)
+
+
+def attend(q, k, v, heads, causal=False, mask=None, dropout=0.0, key=None,
+           training=False):
+    """Pure multi-head attention over (B, L, H*D) projections — the single
+    attention core behind nn.MultiHeadAttention and npx.multi_head_attention.
+
+    No mask and no dropout: the Pallas flash kernel (TPU; interpreter on
+    CPU). Otherwise: the masked jnp path with fp32 softmax (the flash
+    kernel takes only causal + length masks)."""
+    b, lq, hidden = q.shape
+    d = hidden // heads
+    qh = q.reshape(b, lq, heads, d).transpose(0, 2, 1, 3)
+    kh = k.reshape(b, k.shape[1], heads, d).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, v.shape[1], heads, d).transpose(0, 2, 1, 3)
+    if mask is None and not (dropout and training):
+        from .pallas.flash_attention import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal=causal)
+    else:
+        scale = d ** -0.5
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * scale
+        if causal:
+            cm = jnp.tril(jnp.ones((lq, kh.shape[2]), dtype=bool),
+                          k=kh.shape[2] - lq)
+            s = jnp.where(cm, s, -1e30)
+        if mask is not None:
+            if mask.dtype == jnp.bool_:
+                s = jnp.where(mask, s, -1e30)
+            else:
+                s = s + mask.astype(jnp.float32)
+        p = jax.nn.softmax(s, axis=-1)
+        if dropout and training:
+            keep = jax.random.bernoulli(key, 1.0 - dropout, p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+        out = out.astype(q.dtype)
+    return out.transpose(0, 2, 1, 3).reshape(b, lq, hidden)
