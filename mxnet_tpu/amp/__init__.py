@@ -1,0 +1,174 @@
+"""Automatic mixed precision (reference ``python/mxnet/contrib/amp/amp.py``).
+
+TPU redesign: instead of monkeypatching op namespaces (reference
+``amp.init :282`` rewrites mx.nd/mx.sym function tables), a *dtype policy*
+hooks the single op-dispatch chokepoint (``ops.dispatch.apply_op``): MXU
+ops (matmul/conv/attention — lists.py TARGET_DTYPE_OPS) get their float
+inputs cast to the target dtype, numerically-sensitive ops (softmax/norms/
+reductions — FP32_OPS) get fp32, everything else follows jax promotion.
+bf16 is the TPU-native default target (the reference's fp16 lists carry
+over; bf16 needs no loss scaling in practice but the scaler API is kept
+for fp16 parity).
+
+Usage (reference API preserved)::
+
+    amp.init()                      # bfloat16 policy, process-wide
+    amp.init_trainer(trainer)       # dynamic loss scaling on the trainer
+    with amp.scale_loss(loss, trainer) as scaled:
+        scaled.backward()
+    trainer.step(batch)             # unscales, skips on overflow
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops import dispatch as _dispatch
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "convert_hybrid_block",
+           "unscale", "LossScaler", "AMPPolicy"]
+
+_DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16}
+
+
+class AMPPolicy:
+    """The cast-insertion rule applied inside apply_op."""
+
+    def __init__(self, target_dtype="bfloat16",
+                 target_ops=None, fp32_ops=None):
+        if str(target_dtype) not in _DTYPES:
+            raise MXNetError(f"AMP target must be float16/bfloat16, got {target_dtype}")
+        self.target_dtype = _DTYPES[str(target_dtype)]
+        self.target_ops = set(target_ops or lists.TARGET_DTYPE_OPS)
+        self.fp32_ops = set(fp32_ops or lists.FP32_OPS)
+
+    def cast_inputs(self, name, vals):
+        if name in self.target_ops:
+            want = self.target_dtype
+        elif name in self.fp32_ops:
+            want = jnp.float32
+        else:
+            return vals
+        return [
+            v.astype(want)
+            if hasattr(v, "dtype") and v.dtype in (jnp.float32, jnp.float16,
+                                                   jnp.bfloat16)
+            and v.dtype != want
+            else v
+            for v in vals
+        ]
+
+
+def init(target_dtype="bfloat16", target_dtype_ops=None, fp32_ops=None):
+    """Enable the AMP dtype policy process-wide (reference amp.py:init:282)."""
+    _dispatch.amp_policy = AMPPolicy(target_dtype, target_dtype_ops, fp32_ops)
+
+
+def disable():
+    _dispatch.amp_policy = None
+
+
+def is_enabled() -> bool:
+    return _dispatch.amp_policy is not None
+
+
+def init_trainer(trainer, init_scale=2.0 ** 16):
+    """Attach dynamic loss scaling to a Trainer (reference amp.py:322).
+
+    Wraps ``trainer.step`` so each step divides grads by the live loss
+    scale, skips the update entirely on overflow, and adjusts the scale.
+    bf16 targets start at scale 1.0 (bf16 has fp32's exponent range)."""
+    policy = _dispatch.amp_policy
+    if policy is not None and policy.target_dtype == jnp.bfloat16:
+        init_scale = 1.0
+    scaler = LossScaler(init_scale=init_scale)
+    scaler._already_unscaled = False
+    trainer._amp_loss_scaler = scaler
+    orig_step = trainer.step
+    orig_update = trainer.update
+
+    def _amp_apply(orig, batch_size, ignore_stale_grad):
+        overflow = scaler.has_overflow(trainer._params)
+        if not overflow:
+            # grads were multiplied by loss_scale in scale_loss (unless the
+            # user already divided it out via amp.unscale)
+            eff = 1.0 if scaler._already_unscaled else scaler.loss_scale
+            orig(batch_size * eff, ignore_stale_grad=ignore_stale_grad)
+        else:
+            # clear the bad grads so they don't poison a later step
+            for p in trainer._params:
+                g = getattr(p.data(), "grad", None)
+                if g is not None:
+                    g._data = jnp.zeros_like(g._data)
+        scaler._already_unscaled = False
+        scaler.update_scale(overflow)
+
+    def amp_step(batch_size, ignore_stale_grad=False):
+        _amp_apply(orig_step, batch_size, ignore_stale_grad)
+
+    def amp_update(batch_size, ignore_stale_grad=False):
+        _amp_apply(orig_update, batch_size, ignore_stale_grad)
+
+    trainer.step = amp_step
+    trainer.update = amp_update
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Yield the scaled loss (reference amp.py:272 scale_loss). Grads end up
+    multiplied by the scale; the wrapped trainer.step divides it back."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) before scale_loss")
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Divide current grads by the loss scale (for manual clipping between
+    backward and step — reference amp.py:unscale). Marks this iteration as
+    already-unscaled so the wrapped step does not divide again; the live
+    loss scale itself is untouched."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        g = getattr(p.data(), "grad", None)
+        if g is not None:
+            g._data = g._data * inv
+    scaler._already_unscaled = True
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", cast_params: bool = True):
+    """Offline conversion (reference amp.py:633 convert_hybrid_block):
+    cast the block's float params to ``target_dtype`` and cast float inputs
+    on the way in via a forward pre-hook."""
+    if str(target_dtype) not in _DTYPES:
+        raise MXNetError(f"AMP target must be float16/bfloat16, got {target_dtype}")
+    if cast_params:
+        block.cast(target_dtype)
+
+    want = _DTYPES[str(target_dtype)]
+
+    def _cast_inputs(blk, args):
+        from ..ndarray.ndarray import ndarray
+
+        def cast_one(a):
+            if isinstance(a, ndarray) and a.dtype in (jnp.float32, jnp.float16,
+                                                      jnp.bfloat16):
+                return a.astype(want)
+            return a
+
+        return tuple(cast_one(a) for a in args)
+
+    block.register_forward_pre_hook(_cast_inputs)
+    return block

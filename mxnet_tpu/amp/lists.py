@@ -1,0 +1,54 @@
+"""AMP op classification lists (reference
+``python/mxnet/contrib/amp/lists/symbol_fp16.py`` — the per-dtype op
+classification that drives cast insertion; here keyed by dispatch op name).
+"""
+
+# ops that run in the low-precision target dtype (MXU-bound: matmul/conv)
+TARGET_DTYPE_OPS = {
+    "FullyConnected",
+    "Convolution",
+    "Deconvolution",
+    "dot",
+    "matmul",
+    "batch_dot",
+    "einsum",
+    "multi_head_attention",
+    "MultiHeadAttention",
+    "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt",
+    "RNN", "LSTM", "GRU",
+}
+
+# numerically-sensitive ops pinned to fp32 (reference FP32_FUNCS)
+FP32_OPS = {
+    "softmax",
+    "log_softmax",
+    "masked_softmax",
+    "masked_log_softmax",
+    "softmin",
+    "BatchNorm",
+    "batch_norm",
+    "LayerNorm",
+    "layer_norm",
+    "GroupNorm",
+    "group_norm",
+    "InstanceNorm",
+    "instance_norm",
+    "rms_norm",
+    "l2_normalization",
+    "norm",
+    "exp",
+    "log",
+    "log2",
+    "log10",
+    "mean",
+    "sum",
+    "prod",
+    "cumsum",
+    "var",
+    "std",
+}
+
+# everything else: widest-type rule (cast nothing; jax promotion applies)

@@ -1,0 +1,45 @@
+"""Dynamic loss scaling (reference
+``python/mxnet/contrib/amp/loss_scaler.py:26 LossScaler``): grow the scale
+every ``scale_window`` clean steps, halve it on overflow and skip the
+update. Required for fp16; harmless for bf16 (bf16 shares fp32's exponent
+range, so the default bf16 path usually runs scale=1)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_scale=1.0):
+        self.loss_scale = float(init_scale)
+        self._factor = scale_factor
+        self._window = scale_window
+        self._min = min_scale
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        """True if any gradient is non-finite (reference loss_scaler.py
+        has_overflow). All per-grad checks are fused into ONE scalar so
+        there is a single host sync per step, not one per parameter."""
+        flags = []
+        for p in params:
+            g = getattr(p.data(), "grad", None) if hasattr(p, "data") else None
+            if g is None:
+                continue
+            flags.append(jnp.isfinite(g._data).all())
+        if not flags:
+            return False
+        all_finite = flags[0]
+        for f in flags[1:]:
+            all_finite = all_finite & f
+        return not bool(all_finite)
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self._min, self.loss_scale / self._factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0

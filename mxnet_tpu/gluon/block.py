@@ -168,7 +168,9 @@ class Block:
     # -- call --------------------------------------------------------------
     def __call__(self, *args, **kwargs):
         for hook in self._forward_pre_hooks:
-            hook(self, args)
+            new_args = hook(self, args)
+            if new_args is not None:  # torch-style: hooks may replace args
+                args = new_args if isinstance(new_args, tuple) else (new_args,)
         out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks:
             hook(self, args, out)
@@ -305,12 +307,25 @@ class HybridBlock(Block):
     def __call__(self, *args, **kwargs):
         if not self._active:
             return super().__call__(*args, **kwargs)
-        return self._call_cached(*args, **kwargs)
+        # hooks run on the cached path too (convert_hybrid_block input casts)
+        for hook in self._forward_pre_hooks:
+            new_args = hook(self, args)
+            if new_args is not None:
+                args = new_args if isinstance(new_args, tuple) else (new_args,)
+        out = self._call_cached(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
 
     def _signature(self, flat_vals, training: bool):
+        from ..ops import dispatch as _dispatch
+
+        amp_key = (str(_dispatch.amp_policy.target_dtype)
+                   if _dispatch.amp_policy is not None else None)
         return (
             tuple((tuple(v.shape), str(v.dtype)) for v in flat_vals),
             training,
+            amp_key,  # amp.init()/disable() must invalidate cached traces
         )
 
     def _call_cached(self, *args):

@@ -43,6 +43,10 @@ class _AutogradState(threading.local):
 
 autograd_state = _AutogradState()
 
+# set by mxnet_tpu.amp.init(): an AMPPolicy whose cast_inputs(name, vals)
+# applies the mixed-precision cast rule at this single dispatch chokepoint
+amp_policy = None
+
 import os as _os
 
 _NAIVE = _os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
@@ -161,6 +165,8 @@ def _apply_op(
     from ..ndarray.ndarray import ndarray, _wrap, _unwrap
 
     vals = [_unwrap(a) for a in arrays]
+    if amp_policy is not None and name is not None:
+        vals = amp_policy.cast_inputs(name, vals)
     call = functools.partial(fn, **static) if static else fn
 
     state = autograd_state

@@ -1,0 +1,157 @@
+"""AMP tests (reference tests/python/gpu/test_contrib_amp.py patterns)."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp, autograd
+from mxnet_tpu import amp
+from mxnet_tpu.gluon import nn, Trainer
+
+
+@pytest.fixture(autouse=True)
+def _amp_off_after():
+    yield
+    amp.disable()
+
+
+def test_policy_casts_matmul_to_bf16():
+    amp.init("bfloat16")
+    a = mxnp.ones((8, 8))
+    b = mxnp.ones((8, 8))
+    out = mxnp.matmul(a, b)
+    assert str(out.dtype) == "bfloat16"
+    # fp32-pinned op stays fp32 even from bf16 inputs
+    sm = mx.npx.softmax(out)
+    assert str(sm.dtype) == "float32"
+
+
+def test_policy_leaves_other_ops_alone():
+    amp.init("bfloat16")
+    a = mxnp.ones((4,))
+    assert str((a + a).dtype) == "float32"
+
+
+def test_amp_dense_forward_runs_bf16():
+    amp.init("bfloat16")
+    net = nn.Dense(8, in_units=4)
+    net.initialize()
+    out = net(mxnp.ones((2, 4)))
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_amp_training_with_loss_scaler():
+    """Full reference recipe: init → init_trainer → scale_loss → step.
+    fp32 master weights keep updating; loss decreases."""
+    amp.init("bfloat16")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=4))
+    net.add(nn.Dense(1, in_units=16))
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    rng = onp.random.RandomState(0)
+    x = mxnp.array(rng.randn(32, 4).astype(onp.float32))
+    y = mxnp.array((rng.randn(32, 1) * 0.1).astype(onp.float32))
+    losses = []
+    for _ in range(20):
+        with autograd.record():
+            out = net(x)
+            loss = ((out.astype("float32") - y) ** 2).mean()
+            with amp.scale_loss(loss, trainer) as scaled:
+                autograd.backward([scaled])
+        trainer.step(1)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # master params stayed fp32
+    assert str(net[0].weight.data().dtype) == "float32"
+
+
+def test_loss_scaler_dynamics():
+    s = amp.LossScaler(init_scale=64.0, scale_factor=2.0, scale_window=2)
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 128.0
+    s.update_scale(True)
+    assert s.loss_scale == 64.0
+
+
+def test_overflow_skips_update():
+    amp.init("float16")
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(trainer, init_scale=4.0)
+    w_before = net.weight.data().asnumpy().copy()
+    x = mxnp.ones((2, 4))
+    with autograd.record():
+        loss = net(x).astype("float32").sum()
+    loss.backward()
+    # poison the grads with inf to simulate overflow
+    g = net.weight.data().grad
+    g._data = (jnp.zeros_like(g._data) + jnp.inf)
+    trainer.step(1)
+    onp.testing.assert_array_equal(net.weight.data().asnumpy(), w_before)
+    assert trainer._amp_loss_scaler.loss_scale == 2.0
+
+
+def test_convert_hybrid_block():
+    net = nn.Dense(8, in_units=4)
+    net.initialize()
+    amp.convert_hybrid_block(net, "bfloat16")
+    assert str(net.weight.data().dtype) == "bfloat16"
+    out = net(mxnp.ones((2, 4)))  # fp32 input auto-cast by the pre-hook
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_amp_invalidates_hybridized_cache():
+    """amp.init()/disable() after a block was traced must retrace, not
+    replay the stale-precision executable."""
+    net = nn.Dense(8, in_units=4)
+    net.initialize()
+    net.hybridize()
+    x = mxnp.ones((2, 4))
+    out_fp32 = net(x)
+    assert str(out_fp32.dtype) == "float32"
+    amp.init("bfloat16")
+    out_bf16 = net(x)
+    assert str(out_bf16.dtype) == "bfloat16"
+    amp.disable()
+    assert str(net(x).dtype) == "float32"
+
+
+def test_convert_hybrid_block_hybridized():
+    """The input-cast pre-hook must run on the cached-op path too."""
+    net = nn.Dense(8, in_units=4)
+    net.initialize()
+    net.hybridize()
+    amp.convert_hybrid_block(net, "bfloat16")
+    out = net(mxnp.ones((2, 4)))
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_unscale_keeps_dynamic_scaling():
+    """amp.unscale must not zero out the live loss scale (regression)."""
+    amp.init("float16")
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.01})
+    amp.init_trainer(trainer, init_scale=8.0)
+    x = mxnp.ones((2, 2))
+    with autograd.record():
+        loss = net(x).astype("float32").sum()
+        with amp.scale_loss(loss, trainer) as scaled:
+            autograd.backward([scaled])
+    g_scaled = net.weight.data().grad.asnumpy().copy()
+    amp.unscale(trainer)
+    g_unscaled = net.weight.data().grad.asnumpy()
+    onp.testing.assert_allclose(g_unscaled * 8.0, g_scaled, rtol=1e-5)
+    assert trainer._amp_loss_scaler.loss_scale == 8.0  # scale untouched
+    trainer.step(1)
+    assert trainer._amp_loss_scaler.loss_scale == 8.0
+
+
+def test_init_rejects_bad_dtype():
+    with pytest.raises(mx.MXNetError):
+        amp.init("int8")
