@@ -1,0 +1,94 @@
+"""ctypes loader for the native C++ runtime library (``src/`` →
+``mxnet_tpu/_lib/libmxtpu_io.so``).
+
+The reference ships its runtime as libmxnet.so behind a 262-function C ABI
+(``src/c_api/``); here the native surface is deliberately small (IO hot
+path: recordio + threaded prefetch) with jax/XLA owning compute. Binding is
+ctypes (no pybind11 in this image). Missing artifact → build once with g++
+if available → else ``lib() is None`` and pure-Python fallbacks take over.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LIB_NAME = "libmxtpu_io.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "_lib", _LIB_NAME)
+
+
+def _src_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "src")
+
+
+def _build() -> bool:
+    src = _src_dir()
+    if not os.path.isdir(src):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=src, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, timeout=120)
+        return os.path.exists(_lib_path())
+    except Exception:
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u64 = ctypes.c_uint64
+    p = ctypes.c_void_p
+    lib.MXTRecordIOReaderCreate.restype = p
+    lib.MXTRecordIOReaderCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTRecordIOReaderNext.restype = ctypes.c_int
+    lib.MXTRecordIOReaderNext.argtypes = [p, ctypes.POINTER(ctypes.c_char_p),
+                                          ctypes.POINTER(u64)]
+    lib.MXTRecordIOReaderSeek.argtypes = [p, u64]
+    lib.MXTRecordIOReaderTell.restype = u64
+    lib.MXTRecordIOReaderTell.argtypes = [p]
+    lib.MXTRecordIOReaderError.restype = ctypes.c_char_p
+    lib.MXTRecordIOReaderError.argtypes = [p]
+    lib.MXTRecordIOReaderFree.argtypes = [p]
+    lib.MXTRecordIOWriterCreate.restype = p
+    lib.MXTRecordIOWriterCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTRecordIOWriterWrite.restype = ctypes.c_int
+    lib.MXTRecordIOWriterWrite.argtypes = [p, ctypes.c_char_p, u64]
+    lib.MXTRecordIOWriterTell.restype = u64
+    lib.MXTRecordIOWriterTell.argtypes = [p]
+    lib.MXTRecordIOWriterFree.argtypes = [p]
+    lib.MXTPrefetcherCreate.restype = p
+    lib.MXTPrefetcherCreate.argtypes = [ctypes.c_char_p, u64]
+    lib.MXTPrefetcherNext.restype = ctypes.c_int
+    lib.MXTPrefetcherNext.argtypes = [p, ctypes.POINTER(ctypes.c_char_p),
+                                      ctypes.POINTER(u64)]
+    lib.MXTPrefetcherFree.argtypes = [p]
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable
+    (callers fall back to pure Python)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        path = _lib_path()
+        if not os.path.exists(path) and os.environ.get("MXNET_TPU_NO_NATIVE_BUILD") != "1":
+            _build()
+        if os.path.exists(path):
+            try:
+                cdll = ctypes.CDLL(path)
+                _declare(cdll)
+                _lib = cdll
+            except OSError:
+                _lib = None
+        _tried = True
+        return _lib

@@ -36,11 +36,17 @@ __all__ = ["init", "init_trainer", "scale_loss", "convert_hybrid_block",
 _DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16}
 
 
+import itertools as _itertools
+
+_policy_counter = _itertools.count()
+
+
 class AMPPolicy:
     """The cast-insertion rule applied inside apply_op."""
 
     def __init__(self, target_dtype="bfloat16",
                  target_ops=None, fp32_ops=None):
+        self.version = next(_policy_counter)  # hybridize cache key component
         if str(target_dtype) not in _DTYPES:
             raise MXNetError(f"AMP target must be float16/bfloat16, got {target_dtype}")
         self.target_dtype = _DTYPES[str(target_dtype)]
@@ -88,11 +94,17 @@ def init_trainer(trainer, init_scale=2.0 ** 16):
         init_scale = 1.0
     scaler = LossScaler(init_scale=init_scale)
     scaler._already_unscaled = False
+    if hasattr(trainer, "_amp_loss_scaler"):
+        # re-init replaces the scaler, never stacks a second wrapper (a
+        # stacked wrapper would divide by the loss scale twice)
+        trainer._amp_loss_scaler = scaler
+        return trainer
     trainer._amp_loss_scaler = scaler
     orig_step = trainer.step
     orig_update = trainer.update
 
     def _amp_apply(orig, batch_size, ignore_stale_grad):
+        scaler = trainer._amp_loss_scaler
         overflow = scaler.has_overflow(trainer._params)
         if not overflow:
             # grads were multiplied by loss_scale in scale_loss (unless the

@@ -320,7 +320,7 @@ class HybridBlock(Block):
     def _signature(self, flat_vals, training: bool):
         from ..ops import dispatch as _dispatch
 
-        amp_key = (str(_dispatch.amp_policy.target_dtype)
+        amp_key = (getattr(_dispatch.amp_policy, "version", None)
                    if _dispatch.amp_policy is not None else None)
         return (
             tuple((tuple(v.shape), str(v.dtype)) for v in flat_vals),

@@ -1,0 +1,6 @@
+"""Estimator API (reference ``python/mxnet/gluon/contrib/estimator/``)."""
+from .estimator import Estimator
+from .event_handler import (
+    BatchBegin, BatchEnd, CheckpointHandler, EarlyStoppingHandler, EpochBegin,
+    EpochEnd, LoggingHandler, MetricHandler, StoppingHandler, TrainBegin,
+    TrainEnd, ValidationHandler)

@@ -1,0 +1,137 @@
+"""Gluon Estimator — the fit() loop with events (reference
+``python/mxnet/gluon/contrib/estimator/estimator.py``)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .... import autograd
+from ....base import MXNetError
+from ...metric import EvalMetric, Loss as LossMetric, create as metric_create
+from ...trainer import Trainer
+from .event_handler import (
+    BatchBegin, BatchEnd, EpochBegin, EpochEnd, LoggingHandler, MetricHandler,
+    StoppingHandler, TrainBegin, TrainEnd, ValidationHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Train/evaluate a Gluon net with an event-handler pipeline
+    (reference estimator.py Estimator: ``fit``, ``evaluate``,
+    ``fit_batch``, ``evaluate_batch``)."""
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer: Optional[Trainer] = None, context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = self._as_metrics(train_metrics)
+        self.val_metrics = self._as_metrics(val_metrics)
+        self.train_loss_metric = LossMetric(name="train_loss")
+        self.val_loss_metric = LossMetric(name="val_loss")
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.001})
+        self.stop_training = False
+
+    @staticmethod
+    def _as_metrics(metrics):
+        if metrics is None:
+            return []
+        if isinstance(metrics, EvalMetric):
+            return [metrics]
+        return [m if isinstance(m, EvalMetric) else metric_create(m)
+                for m in metrics]
+
+    # -- single batch ------------------------------------------------------
+    def fit_batch(self, data, label, batch_axis=0):
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        self.trainer.step(data.shape[batch_axis])
+        self.train_loss_metric.update(0, loss)
+        # train_metrics are updated by the MetricHandler at batch_end (one
+        # update site; updating here too double-counted sum-style metrics)
+        return data, label, pred, loss
+
+    def evaluate_batch(self, data, label):
+        pred = self.net(data)
+        loss = self.loss(pred, label)
+        self.val_loss_metric.update(0, loss)
+        for m in self.val_metrics:
+            m.update(label, pred)
+        return data, label, pred, loss
+
+    # -- loops -------------------------------------------------------------
+    def evaluate(self, val_data):
+        self.val_loss_metric.reset()
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            self.evaluate_batch(data, label)
+        return [self.val_loss_metric] + self.val_metrics
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = self._prepare_handlers(val_data, epochs, batches,
+                                          event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, train_end = handlers
+
+        for h in train_begin:
+            h.train_begin(self)
+        self.stop_training = False
+        while not self.stop_training:
+            if hasattr(train_data, "reset"):
+                train_data.reset()  # DataIter epochs need an explicit rewind
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            self.train_loss_metric.reset()
+            n_batches = 0
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                n_batches += 1
+                _, _, pred, loss = self.fit_batch(data, label, batch_axis)
+                for h in batch_end:
+                    h.batch_end(self, batch=batch, pred=pred, label=label,
+                                loss=loss)
+                self.stop_training = self.stop_training or any(
+                    getattr(h, "stop_training", False) for h in batch_end)
+                if self.stop_training:
+                    break
+            for h in epoch_end:
+                h.epoch_end(self)
+            self.stop_training = self.stop_training or any(
+                getattr(h, "stop_training", False)
+                for h in epoch_end + batch_end)
+            if n_batches == 0:
+                # an exhausted/empty source can never satisfy max_batch;
+                # stop instead of spinning forever
+                self.stop_training = True
+        for h in train_end:
+            h.train_end(self)
+
+    def _prepare_handlers(self, val_data, epochs, batches, event_handlers):
+        handlers = list(event_handlers or [])
+        added_default = not any(
+            isinstance(h, StoppingHandler) for h in handlers)
+        if added_default:
+            handlers.append(StoppingHandler(max_epoch=epochs, max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=[self.train_loss_metric] + self.train_metrics))
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+
+        def pick(cls):
+            return [h for h in handlers if isinstance(h, cls)]
+
+        return (pick(TrainBegin), pick(EpochBegin), pick(BatchBegin),
+                pick(BatchEnd), pick(EpochEnd), pick(TrainEnd))

@@ -1,0 +1,264 @@
+"""Estimator event handlers (reference
+``python/mxnet/gluon/contrib/estimator/event_handler.py``: the TrainBegin/
+EpochEnd/BatchEnd mixin interfaces, ``CheckpointHandler :336``,
+``EarlyStoppingHandler :82``, StoppingHandler, LoggingHandler,
+MetricHandler, ValidationHandler)."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+import numpy as onp
+
+__all__ = [
+    "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+    "BatchEnd", "StoppingHandler", "MetricHandler", "ValidationHandler",
+    "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler",
+]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch / max_batch (reference event_handler.py StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics each epoch; update per batch."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, pred=None, label=None, loss=None, **kwargs):
+        for m in self.metrics:
+            if "loss" in m.name.lower() and loss is not None:
+                m.update(0, loss)
+            elif pred is not None and label is not None:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation every ``epoch_period`` epochs / ``batch_period`` batches."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Log training progress (reference LoggingHandler)."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=-3000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        t = time.time() - self.train_start
+        self.logger.info("Training finished in %.3fs", t)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msg = f"[Epoch {self.current_epoch}] finished in {time.time() - self.epoch_start:.3f}s: "
+        for m in self.metrics:
+            name, val = m.get()
+            msg += f"{name}: {val:.4f} "
+        self.logger.info(msg)
+        self.current_epoch += 1
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and self.batch_index % self.log_interval == 0:
+            msg = f"[Epoch {self.current_epoch}][Batch {self.batch_index}] "
+            for m in self.metrics:
+                name, val = m.get()
+                msg += f"{name}: {val:.4f} "
+            self.logger.info(msg)
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save model/trainer states periodically and optionally keep the best
+    (reference event_handler.py:336 CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5, resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.saved = []
+        if mode == "auto":
+            mode = "min" if monitor is not None and "loss" in monitor.name.lower() else "max"
+        self._cmp = (lambda a, b: a < b) if mode == "min" else (lambda a, b: a > b)
+        self.best = None
+        os.makedirs(model_dir, exist_ok=True)
+
+    def _save(self, estimator, tag):
+        path = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}.params")
+        estimator.net.save_parameters(path)
+        self.saved.append(path)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+        if estimator.trainer is not None:
+            try:
+                estimator.trainer.save_states(
+                    os.path.join(self.model_dir, f"{self.model_prefix}-{tag}.states"))
+            except Exception:
+                pass
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save(estimator, f"batch{self.current_batch}")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, f"epoch{self.current_epoch - 1}")
+        if self.save_best and self.monitor is not None:
+            _, val = self.monitor.get()
+            if self.best is None or self._cmp(val, self.best):
+                self.best = val
+                path = os.path.join(self.model_dir, f"{self.model_prefix}-best.params")
+                estimator.net.save_parameters(path)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when the monitored metric stops improving (reference
+    event_handler.py:82)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        if mode == "auto":
+            mode = "min" if "loss" in monitor.name.lower() else "max"
+        self.mode = mode
+        self.wait = 0
+        self.best = None
+        self.stop_training = False
+        self.stopped_epoch = None
+        self.current_epoch = 0
+
+    def _improved(self, val):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return val < self.best - self.min_delta
+        return val > self.best + self.min_delta
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, val = self.monitor.get()
+        if isinstance(val, str) or onp.isnan(val):
+            self.current_epoch += 1
+            return
+        if self.baseline is not None and self.best is None:
+            self.best = self.baseline
+        if self._improved(val):
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+                self.stopped_epoch = self.current_epoch
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch is not None:
+            logging.getLogger("mxnet_tpu.estimator").info(
+                "Early stopping at epoch %d", self.stopped_epoch)

@@ -1,0 +1,334 @@
+"""``mx.io`` data iterators (reference ``python/mxnet/io/`` +
+``src/io/``: NDArrayIter, the MXNET_REGISTER_IO_ITER chain parser →
+BatchLoader → PrefetcherIter).
+
+TPU design: iterators yield host-side numpy batches (device transfer is
+the training step's job — jit donates/shards inputs); the RecordIO path
+streams through the native C++ prefetcher (src/io/prefetcher.cc).
+"""
+from __future__ import annotations
+
+import struct
+from collections import namedtuple
+from typing import Dict, List, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray
+from .. import numpy as mxnp
+from ..recordio import IRHeader, ThreadedRecordReader, unpack, unpack_img
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
+           "ImageRecordIter", "ResizeIter", "PrefetchingIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """reference python/mxnet/io/io.py DataDesc."""
+
+    def __new__(cls, name, shape, dtype="float32", layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+
+class DataBatch:
+    """One batch (reference io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data if isinstance(data, (list, tuple)) else [data]
+        self.label = (label if isinstance(label, (list, tuple))
+                      else [label] if label is not None else [])
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (reference io.py DataIter)."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> DataBatch:
+        return self.next()
+
+    def next(self) -> DataBatch:
+        raise NotImplementedError
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        raise NotImplementedError
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        raise NotImplementedError
+
+
+def _to_numpy(v):
+    if isinstance(v, ndarray):
+        return v.asnumpy()
+    return onp.asarray(v)
+
+
+class NDArrayIter(DataIter):
+    """Batched iterator over in-memory arrays (reference io.py NDArrayIter;
+    last_batch_handle ∈ {'pad', 'discard', 'roll_over'})."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self._data = self._normalize(data, data_name)
+        self._label = self._normalize(label, label_name) if label is not None else []
+        self._shuffle = shuffle
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(f"bad last_batch_handle {last_batch_handle!r}")
+        self._lbh = last_batch_handle
+        self._n = self._data[0][1].shape[0]
+        for name, arr in self._data + self._label:
+            if arr.shape[0] != self._n:
+                raise MXNetError(f"array {name} length {arr.shape[0]} != {self._n}")
+        self._order = onp.arange(self._n)
+        self._cursor = 0
+        self._rolled = 0
+        self._leftover = None
+        self.reset()
+
+    @staticmethod
+    def _normalize(data, default_name):
+        if isinstance(data, dict):
+            return [(k, _to_numpy(v)) for k, v in data.items()]
+        if isinstance(data, (list, tuple)):
+            return [(f"{default_name}{i}" if i else default_name, _to_numpy(v))
+                    for i, v in enumerate(data)]
+        return [(default_name, _to_numpy(data))]
+
+    @property
+    def provide_data(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:], str(a.dtype))
+                for n, a in self._data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:], str(a.dtype))
+                for n, a in self._label]
+
+    def reset(self):
+        # roll_over: withheld tail samples lead the next epoch's first batch
+        if self._rolled:
+            self._leftover = self._order[self._n - self._rolled:].copy()
+        if self._shuffle:
+            onp.random.shuffle(self._order)
+        self._cursor = 0
+        self._rolled = 0
+
+    def next(self) -> DataBatch:
+        if self._leftover is not None:
+            # merge previous epoch's withheld tail into one FULL batch
+            take = self.batch_size - len(self._leftover)
+            idx = onp.concatenate([self._leftover, self._order[:take]])
+            self._leftover = None
+            self._cursor = take
+            pad = 0
+        else:
+            start = self._cursor
+            if start >= self._n:
+                raise StopIteration
+            end = start + self.batch_size
+            if end > self._n:
+                if self._lbh == "discard":
+                    raise StopIteration
+                if self._lbh == "roll_over":
+                    self._rolled = self._n - start
+                    raise StopIteration
+            pad = max(0, end - self._n)
+            idx = self._order[start:min(end, self._n)]
+            if pad:
+                idx = onp.concatenate([idx, self._order[:pad]])
+            self._cursor = end
+        data = [mxnp.array(a[idx]) for _, a in self._data]
+        label = [mxnp.array(a[idx]) for _, a in self._label]
+        return DataBatch(data, label, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ImageRecordIter(DataIter):
+    """Batched images from a RecordIO file (reference
+    ``src/io/iter_image_recordio_2.cc:887 ImageRecordIter``): records are
+    ``pack_img``-framed (IRHeader + image payload), streamed through the
+    native threaded prefetcher, decoded and batched host-side."""
+
+    def __init__(self, path_imgrec, batch_size, data_shape,
+                 label_width=1, shuffle_chunk=False, round_batch=True,
+                 prefetch_capacity=64, dtype="float32"):
+        super().__init__(batch_size)
+        self.path = path_imgrec
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._round = round_batch
+        self._dtype = dtype
+        self._cap = prefetch_capacity
+        self._reader = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape, self._dtype)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc("softmax_label", shape, "float32")]
+
+    def reset(self):
+        if self._reader is not None:
+            self._reader.close()
+        self._reader = ThreadedRecordReader(self.path, capacity=self._cap)
+
+    def next(self) -> DataBatch:
+        imgs, labels = [], []
+        pad = 0
+        for _ in range(self.batch_size):
+            rec = next(self._reader, None)
+            if rec is None:
+                break
+            header, img = unpack_img(rec)
+            if img.shape != self.data_shape:
+                if img.ndim == 3 and (img.shape[2],) + img.shape[:2] == self.data_shape:
+                    img = img.transpose(2, 0, 1)  # HWC -> CHW
+                else:
+                    raise MXNetError(
+                        f"record image shape {img.shape} incompatible with "
+                        f"data_shape {self.data_shape}")
+            imgs.append(onp.asarray(img, dtype=self._dtype))
+            labels.append(onp.asarray(header.label, dtype=onp.float32))
+        if not imgs:
+            raise StopIteration
+        while len(imgs) < self.batch_size:
+            if not self._round:
+                break
+            pad += 1
+            imgs.append(imgs[-1])
+            labels.append(labels[-1])
+        data = mxnp.array(onp.stack(imgs))
+        lab = onp.stack(labels)
+        if lab.ndim > 1 and lab.shape[1] == 1:
+            lab = lab[:, 0]  # label_width=1 stored as (N,1)
+        label = mxnp.array(lab)
+        return DataBatch([data], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to ``size`` batches (reference io.py
+    ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self._it = data_iter
+        self._size = size
+        self._reset_internal = reset_internal
+        self._count = 0
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+    def reset(self):
+        self._count = 0
+        if self._reset_internal:
+            self._it.reset()
+
+    def next(self):
+        if self._count >= self._size:
+            raise StopIteration
+        self._count += 1
+        try:
+            return self._it.next()
+        except StopIteration:
+            self._it.reset()
+            return self._it.next()
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch wrapper (reference io.py PrefetchingIter /
+    src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, capacity=2):
+        import queue
+        import threading
+
+        it = iters[0] if isinstance(iters, (list, tuple)) else iters
+        super().__init__(it.batch_size)
+        self._it = it
+        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._stop = threading.Event()
+        self._thread = None
+        self._done = False
+        self._start()
+
+    def _start(self):
+        import threading
+
+        def run():
+            try:
+                while not self._stop.is_set():
+                    try:
+                        batch = self._it.next()
+                    except StopIteration:
+                        self._q.put(None)
+                        return
+                    self._q.put(batch)
+            except Exception as e:  # surface async errors at next()
+                self._q.put(e)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+    def reset(self):
+        self._stop.set()
+        # drain so the producer can exit a blocking put
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join()
+        self._stop.clear()
+        self._done = False
+        self._it.reset()
+        self._start()
+
+    def next(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is None:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._done = True
+            raise item
+        return item

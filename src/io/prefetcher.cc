@@ -1,0 +1,103 @@
+// Threaded record prefetcher — the native equivalent of the reference's
+// PrefetcherIter double-buffering (src/io/iter_prefetcher.h:47) and the
+// ThreadedDataLoader backend (src/io/dataloader.cc:64): a producer thread
+// streams records off disk into a bounded queue while Python consumes.
+// C ABI for ctypes.
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* MXTRecordIOReaderCreate(const char* path);
+int MXTRecordIOReaderNext(void* handle, const char** data, uint64_t* size);
+void MXTRecordIOReaderFree(void* handle);
+void MXTRecordIOReaderSeek(void* handle, uint64_t offset);
+}
+
+namespace {
+
+struct Prefetcher {
+  void* reader = nullptr;
+  size_t capacity = 4;
+  bool shuffle_chunks = false;
+  std::thread producer;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::deque<std::vector<char>> queue;
+  bool done = false;     // producer hit EOF or error
+  bool stop = false;     // consumer asked to shut down
+  int status = 0;        // sticky producer status (-1 on corrupt stream)
+  std::vector<char> current;
+
+  void run() {
+    const char* data;
+    uint64_t size;
+    while (true) {
+      int rc = MXTRecordIOReaderNext(reader, &data, &size);
+      std::vector<char> rec;
+      if (rc == 0) rec.assign(data, data + size);
+      std::unique_lock<std::mutex> lk(mu);
+      if (rc != 0) {
+        done = true;
+        if (rc < 0) status = -1;
+        not_empty.notify_all();
+        return;
+      }
+      not_full.wait(lk, [&] { return queue.size() < capacity || stop; });
+      if (stop) return;
+      queue.emplace_back(std::move(rec));
+      not_empty.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// capacity: max records buffered ahead of the consumer.
+void* MXTPrefetcherCreate(const char* path, uint64_t capacity) {
+  void* reader = MXTRecordIOReaderCreate(path);
+  if (!reader) return nullptr;
+  Prefetcher* p = new Prefetcher();
+  p->reader = reader;
+  p->capacity = capacity ? (size_t)capacity : 4;
+  p->producer = std::thread([p] { p->run(); });
+  return p;
+}
+
+// 0 = record ready (data/size valid until next call), 1 = exhausted,
+// -1 = corrupt stream.
+int MXTPrefetcherNext(void* handle, const char** data, uint64_t* size) {
+  Prefetcher* p = static_cast<Prefetcher*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->not_empty.wait(lk, [&] { return !p->queue.empty() || p->done; });
+  if (p->queue.empty()) {
+    return p->status < 0 ? -1 : 1;
+  }
+  p->current = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->not_full.notify_one();
+  *data = p->current.data();
+  *size = p->current.size();
+  return 0;
+}
+
+void MXTPrefetcherFree(void* handle) {
+  Prefetcher* p = static_cast<Prefetcher*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+    p->not_full.notify_all();
+  }
+  if (p->producer.joinable()) p->producer.join();
+  MXTRecordIOReaderFree(p->reader);
+  delete p;
+}
+
+}  // extern "C"

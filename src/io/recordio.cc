@@ -1,0 +1,154 @@
+// Native RecordIO reader/writer (TPU-native equivalent of the reference's
+// dmlc-core RecordIO used by src/io/iter_image_recordio_2.cc and
+// python/mxnet/recordio.py). Wire format:
+//   [kMagic:u32][lrec:u32][payload][pad to 4-byte boundary]
+// lrec: upper 3 bits continuation flag, lower 29 bits payload length.
+// Exposed as a small C ABI consumed from Python via ctypes (the repo uses
+// ctypes instead of pybind11 by design — see project notes).
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLRecBits = 29;
+constexpr uint32_t kLRecMask = (1u << kLRecBits) - 1;
+
+struct Reader {
+  FILE* fp = nullptr;
+  std::vector<char> buf;      // last record payload
+  std::string error;
+};
+
+struct Writer {
+  FILE* fp = nullptr;
+  std::string error;
+};
+
+int read_u32(FILE* fp, uint32_t* out) {
+  unsigned char b[4];
+  size_t n = fread(b, 1, 4, fp);
+  if (n == 0) return 1;  // clean EOF
+  if (n != 4) return -1;
+  *out = (uint32_t)b[0] | ((uint32_t)b[1] << 8) | ((uint32_t)b[2] << 16) |
+         ((uint32_t)b[3] << 24);
+  return 0;
+}
+
+int write_u32(FILE* fp, uint32_t v) {
+  unsigned char b[4] = {(unsigned char)(v & 0xff),
+                        (unsigned char)((v >> 8) & 0xff),
+                        (unsigned char)((v >> 16) & 0xff),
+                        (unsigned char)((v >> 24) & 0xff)};
+  return fwrite(b, 1, 4, fp) == 4 ? 0 : -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* MXTRecordIOReaderCreate(const char* path) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  Reader* r = new Reader();
+  r->fp = fp;
+  return r;
+}
+
+// Returns 0 on success (data/size set; pointer valid until next call),
+// 1 on EOF, -1 on corrupt stream.
+int MXTRecordIOReaderNext(void* handle, const char** data, uint64_t* size) {
+  Reader* r = static_cast<Reader*>(handle);
+  r->buf.clear();
+  bool more = true;
+  bool first = true;
+  while (more) {
+    uint32_t magic = 0, lrec = 0;
+    int rc = read_u32(r->fp, &magic);
+    if (rc == 1 && first) return 1;
+    if (rc != 0 || magic != kMagic) {
+      r->error = "corrupt record: bad magic";
+      return -1;
+    }
+    if (read_u32(r->fp, &lrec) != 0) {
+      r->error = "corrupt record: truncated header";
+      return -1;
+    }
+    uint32_t cflag = lrec >> kLRecBits;
+    uint32_t len = lrec & kLRecMask;
+    size_t off = r->buf.size();
+    r->buf.resize(off + len);
+    if (len && fread(r->buf.data() + off, 1, len, r->fp) != len) {
+      r->error = "corrupt record: truncated payload";
+      return -1;
+    }
+    size_t pad = (4 - (len & 3)) & 3;
+    if (pad) fseek(r->fp, (long)pad, SEEK_CUR);
+    // dmlc continuation flags: 0 = whole record, 1 = begin, 2 = middle,
+    // 3 = end of a multi-part record
+    more = (cflag == 1 || cflag == 2);
+    first = false;
+  }
+  *data = r->buf.data();
+  *size = r->buf.size();
+  return 0;
+}
+
+void MXTRecordIOReaderSeek(void* handle, uint64_t offset) {
+  Reader* r = static_cast<Reader*>(handle);
+  fseek(r->fp, (long)offset, SEEK_SET);
+}
+
+uint64_t MXTRecordIOReaderTell(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  return (uint64_t)ftell(r->fp);
+}
+
+const char* MXTRecordIOReaderError(void* handle) {
+  return static_cast<Reader*>(handle)->error.c_str();
+}
+
+void MXTRecordIOReaderFree(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (r->fp) fclose(r->fp);
+  delete r;
+}
+
+void* MXTRecordIOWriterCreate(const char* path) {
+  FILE* fp = fopen(path, "wb");
+  if (!fp) return nullptr;
+  Writer* w = new Writer();
+  w->fp = fp;
+  return w;
+}
+
+uint64_t MXTRecordIOWriterTell(void* handle) {
+  return (uint64_t)ftell(static_cast<Writer*>(handle)->fp);
+}
+
+int MXTRecordIOWriterWrite(void* handle, const char* data, uint64_t size) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (size > kLRecMask) {
+    w->error = "record too large for single-part write";
+    return -1;
+  }
+  if (write_u32(w->fp, kMagic) != 0) return -1;
+  if (write_u32(w->fp, (uint32_t)size) != 0) return -1;
+  if (size && fwrite(data, 1, size, w->fp) != size) return -1;
+  static const char zeros[4] = {0, 0, 0, 0};
+  size_t pad = (4 - (size & 3)) & 3;
+  if (pad && fwrite(zeros, 1, pad, w->fp) != pad) return -1;
+  return 0;
+}
+
+void MXTRecordIOWriterFree(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (w->fp) fclose(w->fp);
+  delete w;
+}
+
+}  // extern "C"

@@ -1,0 +1,97 @@
+"""Estimator tests (reference tests/python/unittest/test_gluon_estimator.py
++ test_gluon_event_handler.py patterns)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mxnp
+from mxnet_tpu.gluon import nn, loss as gloss, metric, Trainer
+from mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, Estimator, LoggingHandler,
+    StoppingHandler)
+
+
+def _toy_data(n=32, d=4, classes=3, batch=8, seed=0):
+    rng = onp.random.RandomState(seed)
+    X = rng.randn(n, d).astype(onp.float32)
+    y = rng.randint(0, classes, n)
+    batches = []
+    for i in range(0, n, batch):
+        batches.append((mxnp.array(X[i:i + batch]),
+                        mxnp.array(y[i:i + batch], dtype="int32")))
+    return batches
+
+
+def _net(classes=3, d=4):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=d))
+    net.add(nn.Dense(classes, in_units=16))
+    net.initialize()
+    return net
+
+
+def test_fit_runs_and_loss_decreases():
+    net = _net()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=metric.Accuracy(),
+                    trainer=Trainer(net.collect_params(), "adam",
+                                    {"learning_rate": 0.05}))
+    data = _toy_data()
+    est.fit(data, epochs=1)
+    first = est.train_loss_metric.get()[1]
+    est.fit(data, epochs=5)
+    assert est.train_loss_metric.get()[1] < first
+
+
+def test_fit_max_batches():
+    net = _net()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss())
+    stop = StoppingHandler(max_batch=3)
+    est.fit(_toy_data(), event_handlers=[stop], batches=3)
+    assert stop.current_batch == 3
+
+
+def test_validation_and_metrics():
+    net = _net()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[metric.Accuracy()],
+                    val_metrics=[metric.Accuracy()])
+    est.fit(_toy_data(), val_data=_toy_data(seed=1), epochs=2)
+    name, val = est.val_metrics[0].get()
+    assert 0.0 <= val <= 1.0
+
+
+def test_checkpoint_handler(tmp_path):
+    net = _net()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                             monitor=est.train_loss_metric, save_best=True)
+    est.fit(_toy_data(), event_handlers=[ckpt], epochs=2)
+    files = os.listdir(tmp_path)
+    assert any(f.startswith("toy-epoch") for f in files)
+    assert "toy-best.params" in files
+    # saved params load back
+    net2 = _net()
+    net2.load_parameters(str(tmp_path / "toy-best.params"))
+
+
+def test_early_stopping():
+    net = _net()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss())
+
+    class _Frozen(metric.EvalMetric):
+        def __init__(self):
+            super().__init__("frozen_loss")
+
+        def update(self, labels, preds):
+            pass
+
+        def get(self):
+            return self.name, 1.0  # never improves
+
+    stopper = EarlyStoppingHandler(_Frozen(), patience=2)
+    est.fit(_toy_data(), event_handlers=[stopper], epochs=50)
+    assert stopper.stop_training
+    assert stopper.current_epoch < 50

@@ -1,0 +1,220 @@
+"""Native C++ recordio/prefetcher tests (reference tests/cpp/ +
+test_recordio.py patterns): the native reader must round-trip files written
+by the Python writer and vice versa."""
+import ctypes
+import os
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu._native import lib
+
+
+pytestmark = pytest.mark.skipif(lib() is None, reason="native lib unavailable")
+
+
+def _write_records(path, records):
+    w = recordio.MXRecordIO(str(path), "w")
+    for r in records:
+        w.write(r)
+    w.close()
+
+
+def test_native_reader_roundtrip(tmp_path):
+    path = tmp_path / "data.rec"
+    records = [b"hello", b"", b"x" * 1001, os.urandom(4096), b"tail"]
+    _write_records(path, records)
+    L = lib()
+    h = L.MXTRecordIOReaderCreate(str(path).encode())
+    assert h
+    got = []
+    data = ctypes.c_char_p()
+    size = ctypes.c_uint64()
+    while True:
+        rc = L.MXTRecordIOReaderNext(h, ctypes.byref(data), ctypes.byref(size))
+        if rc == 1:
+            break
+        assert rc == 0
+        got.append(ctypes.string_at(data, size.value))
+    L.MXTRecordIOReaderFree(h)
+    assert got == records
+
+
+def test_native_writer_python_reader(tmp_path):
+    path = tmp_path / "native.rec"
+    records = [b"alpha", b"beta" * 100, b"\x00\x01\x02"]
+    L = lib()
+    h = L.MXTRecordIOWriterCreate(str(path).encode())
+    for r in records:
+        assert L.MXTRecordIOWriterWrite(h, r, len(r)) == 0
+    L.MXTRecordIOWriterFree(h)
+    r = recordio.MXRecordIO(str(path), "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    r.close()
+    assert got == records
+
+
+def test_threaded_reader_matches_sync(tmp_path):
+    path = tmp_path / "big.rec"
+    rng = onp.random.RandomState(0)
+    records = [rng.bytes(rng.randint(1, 2000)) for _ in range(500)]
+    _write_records(path, records)
+    reader = recordio.ThreadedRecordReader(str(path), capacity=8)
+    assert reader.is_native
+    got = list(reader)
+    reader.close()
+    assert got == records
+
+
+def test_threaded_reader_corrupt_stream(tmp_path):
+    path = tmp_path / "corrupt.rec"
+    with open(path, "wb") as f:
+        f.write(struct.pack("<II", 0xDEADBEEF, 5))
+        f.write(b"xxxxx\x00\x00\x00")
+    reader = recordio.ThreadedRecordReader(str(path))
+    with pytest.raises(mx.MXNetError, match="corrupt"):
+        next(reader)
+    reader.close()
+
+
+def test_threaded_reader_fallback(tmp_path, monkeypatch):
+    """With the native lib unavailable the reader degrades to sync reads."""
+    import mxnet_tpu.recordio as rio
+
+    path = tmp_path / "fb.rec"
+    records = [b"a", b"bb", b"ccc"]
+    _write_records(path, records)
+    import mxnet_tpu._native as native
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    reader = rio.ThreadedRecordReader(str(path))
+    assert not reader.is_native
+    assert list(reader) == records
+    reader.close()
+
+
+def test_multipart_record_native(tmp_path):
+    """C++ reader reassembles dmlc multi-part records (cflag 1/2/3)."""
+    path = tmp_path / "multi.rec"
+    magic = 0xCED7230A
+    parts = [(1, b"abc"), (2, b"defg"), (3, b"hi")]
+    with open(path, "wb") as f:
+        for cflag, payload in parts:
+            f.write(struct.pack("<II", magic, (cflag << 29) | len(payload)))
+            f.write(payload)
+            pad = (4 - len(payload) % 4) % 4
+            f.write(b"\x00" * pad)
+    L = lib()
+    h = L.MXTRecordIOReaderCreate(str(path).encode())
+    data = ctypes.c_char_p()
+    size = ctypes.c_uint64()
+    assert L.MXTRecordIOReaderNext(h, ctypes.byref(data), ctypes.byref(size)) == 0
+    assert ctypes.string_at(data, size.value) == b"abcdefghi"
+    assert L.MXTRecordIOReaderNext(h, ctypes.byref(data), ctypes.byref(size)) == 1
+    L.MXTRecordIOReaderFree(h)
+
+
+# -- mx.io iterators -------------------------------------------------------
+
+def test_ndarray_iter_pad_and_discard():
+    from mxnet_tpu import io as mio
+
+    X = onp.arange(20, dtype=onp.float32).reshape(10, 2)
+    y = onp.arange(10, dtype=onp.float32)
+    it = mio.NDArrayIter(X, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it = mio.NDArrayIter(X, y, batch_size=4, last_batch_handle="discard")
+    assert len(list(it)) == 2
+    # reset re-iterates
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    from mxnet_tpu import io as mio
+
+    X = onp.arange(12, dtype=onp.float32).reshape(12, 1)
+    it = mio.NDArrayIter(X, X[:, 0], batch_size=3, shuffle=True)
+    seen = onp.concatenate([b.data[0].asnumpy()[:, 0] for b in it])
+    assert sorted(seen.tolist()) == list(range(12))
+
+
+def test_image_record_iter(tmp_path):
+    from mxnet_tpu import io as mio
+
+    path = str(tmp_path / "imgs.rec")
+    rng = onp.random.RandomState(0)
+    n, shape = 10, (3, 8, 8)
+    w = recordio.MXRecordIO(path, "w")
+    imgs = []
+    for i in range(n):
+        img = rng.randint(0, 255, size=shape).astype(onp.uint8)
+        imgs.append(img)
+        hdr = recordio.IRHeader(0, float(i % 4), i, 0)
+        w.write(recordio.pack_img(hdr, img))
+    w.close()
+    it = mio.ImageRecordIter(path, batch_size=4, data_shape=shape)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4,) + shape
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy()[0],
+                                imgs[0].astype(onp.float32))
+    onp.testing.assert_allclose(batches[0].label[0].asnumpy(),
+                                [0.0, 1.0, 2.0, 3.0])
+    # reset and stream again through the native prefetcher
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_prefetching_iter_matches(tmp_path):
+    from mxnet_tpu import io as mio
+
+    X = onp.arange(30, dtype=onp.float32).reshape(15, 2)
+    base = mio.NDArrayIter(X, X[:, 0], batch_size=5)
+    ref = [b.data[0].asnumpy() for b in base]
+    base.reset()
+    pre = mio.PrefetchingIter(base)
+    got = [b.data[0].asnumpy() for b in pre]
+    assert len(got) == len(ref)
+    for a, b in zip(got, ref):
+        onp.testing.assert_array_equal(a, b)
+    pre.reset()
+    assert len(list(pre)) == 3
+
+
+def test_ndarray_iter_roll_over_full_batch():
+    """roll_over leftovers must merge into a FULL first batch next epoch."""
+    from mxnet_tpu import io as mio
+
+    X = onp.arange(10, dtype=onp.float32).reshape(10, 1)
+    it = mio.NDArrayIter(X, X[:, 0], batch_size=4, last_batch_handle="roll_over")
+    epoch1 = list(it)
+    assert len(epoch1) == 2  # 8 consumed, 2 rolled over
+    it.reset()
+    epoch2 = list(it)
+    assert epoch2[0].data[0].shape == (4, 1)  # 2 leftover + 2 new
+    onp.testing.assert_array_equal(
+        epoch2[0].data[0].asnumpy()[:2, 0], [8.0, 9.0])
+
+
+def test_prefetching_iter_exhaustion_is_sticky():
+    from mxnet_tpu import io as mio
+
+    X = onp.arange(8, dtype=onp.float32).reshape(8, 1)
+    pre = mio.PrefetchingIter(mio.NDArrayIter(X, X[:, 0], batch_size=4))
+    assert len(list(pre)) == 2
+    # repeated next() after exhaustion keeps raising instead of hanging
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            pre.next()
