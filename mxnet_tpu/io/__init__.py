@@ -122,13 +122,20 @@ class NDArrayIter(DataIter):
     def reset(self):
         # roll_over: withheld tail samples lead the next epoch's first batch
         if self._rolled:
-            self._leftover = self._order[self._n - self._rolled:].copy()
+            self._leftover = self._order[len(self._order) - self._rolled:].copy()
+        order = onp.arange(self._n)
         if self._shuffle:
-            onp.random.shuffle(self._order)
+            onp.random.shuffle(order)
+        if self._leftover is not None and self._leftover.size:
+            # exclude leftover ids from the new order so the merged first
+            # batch never serves a sample twice in the same epoch
+            order = order[~onp.isin(order, self._leftover)]
+        self._order = order
         self._cursor = 0
         self._rolled = 0
 
     def next(self) -> DataBatch:
+        m = len(self._order)
         if self._leftover is not None:
             # merge previous epoch's withheld tail into one FULL batch
             take = self.batch_size - len(self._leftover)
@@ -138,17 +145,17 @@ class NDArrayIter(DataIter):
             pad = 0
         else:
             start = self._cursor
-            if start >= self._n:
+            if start >= m:
                 raise StopIteration
             end = start + self.batch_size
-            if end > self._n:
+            if end > m:
                 if self._lbh == "discard":
                     raise StopIteration
                 if self._lbh == "roll_over":
-                    self._rolled = self._n - start
+                    self._rolled = m - start
                     raise StopIteration
-            pad = max(0, end - self._n)
-            idx = self._order[start:min(end, self._n)]
+            pad = max(0, end - m)
+            idx = self._order[start:min(end, m)]
             if pad:
                 idx = onp.concatenate([idx, self._order[:pad]])
             self._cursor = end
@@ -268,20 +275,26 @@ class PrefetchingIter(DataIter):
     src/io/iter_prefetcher.h)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None, capacity=2):
-        import queue
         import threading
 
         it = iters[0] if isinstance(iters, (list, tuple)) else iters
         super().__init__(it.batch_size)
         self._it = it
-        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._capacity = capacity
+        self._q = None
         self._stop = threading.Event()
         self._thread = None
         self._done = False
         self._start()
 
     def _start(self):
+        import queue
         import threading
+
+        # a FRESH queue per producer generation: a producer unblocked from
+        # put() during reset()'s drain may enqueue one final stale item —
+        # it lands in the abandoned queue, not the next epoch's
+        self._q = q = queue.Queue(maxsize=self._capacity)
 
         def run():
             try:
@@ -289,11 +302,11 @@ class PrefetchingIter(DataIter):
                     try:
                         batch = self._it.next()
                     except StopIteration:
-                        self._q.put(None)
+                        q.put(None)
                         return
-                    self._q.put(batch)
+                    q.put(batch)
             except Exception as e:  # surface async errors at next()
-                self._q.put(e)
+                q.put(e)
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()

@@ -88,6 +88,10 @@ def device_count() -> int:
 
 def shutdown():
     global _initialized
+    if not _initialized:
+        # calling jax.process_count() would itself initialize the XLA
+        # backend — the exact side effect shutdown-before-init must avoid
+        return
     if jax.process_count() > 1:
         try:
             jax.distributed.shutdown()

@@ -76,30 +76,63 @@ class MXRecordIO:
     def write(self, buf: bytes):
         if self.flag != "w":
             raise MXNetError("not opened for writing")
-        # split payload at embedded magic words (the dmlc continuation
-        # scheme); we take the simple route: single part, escape not needed
-        # because length-prefix framing reads exactly `length` bytes.
-        self._fp.write(struct.pack("<II", _MAGIC, _make_lrec(0, len(buf))))
-        self._fp.write(buf)
-        pad = (4 - len(buf) % 4) % 4
+        if len(buf) > _LREC_MASK:
+            raise MXNetError("record too large")
+        # dmlc continuation scheme: split at every 4-byte-aligned embedded
+        # magic word, dropping those 4 bytes (readers re-insert them);
+        # cflag 1 = begin, 2 = middle, 3 = end, 0 = whole record.
+        magic_b = struct.pack("<I", _MAGIC)
+        n = len(buf)
+        lower = (n >> 2) << 2
+        dptr = 0
+        pos = 0
+        while True:
+            j = buf.find(magic_b, pos)
+            if j < 0 or j >= lower:
+                break
+            if j % 4 == 0:
+                self._fp.write(struct.pack(
+                    "<II", _MAGIC, _make_lrec(1 if dptr == 0 else 2, j - dptr)))
+                self._fp.write(buf[dptr:j])  # 4-aligned: no padding needed
+                dptr = j + 4
+                pos = j + 4
+            else:
+                pos = j + 1
+        self._fp.write(struct.pack(
+            "<II", _MAGIC, _make_lrec(3 if dptr else 0, n - dptr)))
+        self._fp.write(buf[dptr:])
+        pad = (4 - (n - dptr) % 4) % 4
         if pad:
             self._fp.write(b"\x00" * pad)
 
     def read(self) -> Optional[bytes]:
         if self.flag != "r":
             raise MXNetError("not opened for reading")
-        header = self._fp.read(8)
-        if len(header) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", header)
-        if magic != _MAGIC:
-            raise MXNetError("invalid RecordIO magic; corrupt file?")
-        length = lrec & _LREC_MASK
-        data = self._fp.read(length)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self._fp.read(pad)
-        return data
+        out = bytearray()
+        first = True
+        while True:
+            header = self._fp.read(8)
+            if len(header) < 8:
+                if first:
+                    return None  # clean EOF
+                raise MXNetError("corrupt record: truncated multi-part chain")
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                raise MXNetError("invalid RecordIO magic; corrupt file?")
+            cflag = lrec >> _LREC_BITS
+            length = lrec & _LREC_MASK
+            if cflag in (2, 3):  # re-insert the magic dropped at the split
+                out += struct.pack("<I", _MAGIC)
+            part = self._fp.read(length)
+            if len(part) != length:
+                raise MXNetError("corrupt record: truncated payload")
+            out += part
+            pad = (4 - length % 4) % 4
+            if pad:
+                self._fp.read(pad)
+            first = False
+            if cflag in (0, 3):
+                return bytes(out)
 
 
 class MXIndexedRecordIO(MXRecordIO):

@@ -1,0 +1,326 @@
+"""Reusable test harness (the ``mx.test_utils`` equivalent).
+
+TPU-native re-design of the reference's de-facto test framework
+(``python/mxnet/test_utils.py``):
+
+- ``assert_almost_equal``       (reference test_utils.py:561) — dtype-aware
+  default tolerances.
+- ``check_numeric_gradient``    (reference test_utils.py:987) — central
+  finite differences vs the autograd tape.
+- ``check_consistency``         (reference test_utils.py:1428) — the same
+  function executed across *execution modes* and dtypes, outputs
+  cross-checked.  The reference's modes were device contexts (CPU vs GPU
+  vs MKLDNN); on TPU the failure axes are different, so the native modes
+  are eager-vs-jit (trace consistency — the CachedOp contract) and
+  fp32-vs-bf16 (the MXU's native dtype), plus real multi-device contexts
+  when more than one backend is present.
+- ``check_symbolic_forward`` / ``check_symbolic_backward``
+  (reference test_utils.py:1130) — oracle checks of outputs / input grads.
+- ``rand_ndarray`` / ``random_arrays`` (reference test_utils.py:388).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as onp
+
+from . import autograd
+from . import numpy as mxnp
+from .base import MXNetError
+from .context import current_context
+from .ndarray.ndarray import ndarray
+
+__all__ = [
+    "default_context",
+    "default_device",
+    "default_rtol",
+    "default_atol",
+    "same",
+    "almost_equal",
+    "assert_almost_equal",
+    "rand_ndarray",
+    "random_arrays",
+    "rand_shape_nd",
+    "check_numeric_gradient",
+    "check_symbolic_forward",
+    "check_symbolic_backward",
+    "check_consistency",
+    "numeric_grad",
+]
+
+
+def default_context():
+    """The context tests run on (reference test_utils.py:57)."""
+    return current_context()
+
+
+default_device = default_context
+
+
+# dtype-aware default tolerances (reference test_utils.py:80-100 get_rtol /
+# get_atol; bf16 added — it is the TPU MXU's native dtype and has fewer
+# mantissa bits than fp16)
+_RTOL: Dict[str, float] = {
+    "float16": 1e-2,
+    "bfloat16": 4e-2,
+    "float32": 1e-4,
+    "float64": 1e-7,
+    "int8": 0.0,
+    "uint8": 0.0,
+    "int32": 0.0,
+    "int64": 0.0,
+    "bool": 0.0,
+}
+_ATOL: Dict[str, float] = {
+    "float16": 1e-3,
+    "bfloat16": 1e-2,
+    "float32": 1e-6,
+    "float64": 1e-9,
+    "int8": 0.0,
+    "uint8": 0.0,
+    "int32": 0.0,
+    "int64": 0.0,
+    "bool": 0.0,
+}
+
+
+def _dtype_name(a) -> str:
+    dt = getattr(a, "dtype", None)
+    if dt is None:
+        return "float64"
+    return str(onp.dtype(dt)) if str(dt) != "bfloat16" else "bfloat16"
+
+
+def default_rtol(*arrays) -> float:
+    return max((_RTOL.get(_dtype_name(a), 1e-5) for a in arrays), default=1e-5)
+
+
+def default_atol(*arrays) -> float:
+    return max((_ATOL.get(_dtype_name(a), 1e-8) for a in arrays), default=1e-8)
+
+
+def _to_numpy(a) -> onp.ndarray:
+    if isinstance(a, ndarray):
+        return a.asnumpy()
+    if hasattr(a, "__array__") or onp.isscalar(a) or isinstance(a, (list, tuple)):
+        return onp.asarray(a)
+    # jax array with bfloat16 etc.
+    return onp.asarray(a)
+
+
+def same(a, b) -> bool:
+    """Exact equality (reference test_utils.py:520)."""
+    return onp.array_equal(_to_numpy(a), _to_numpy(b))
+
+
+def almost_equal(a, b, rtol: Optional[float] = None, atol: Optional[float] = None,
+                 equal_nan: bool = False) -> bool:
+    rtol = default_rtol(a, b) if rtol is None else rtol
+    atol = default_atol(a, b) if atol is None else atol
+    an, bn = _to_numpy(a), _to_numpy(b)
+    return onp.allclose(an.astype(onp.float64) if an.dtype.kind == "f" else an,
+                        bn.astype(onp.float64) if bn.dtype.kind == "f" else bn,
+                        rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol: Optional[float] = None,
+                        atol: Optional[float] = None,
+                        names: Sequence[str] = ("a", "b"),
+                        equal_nan: bool = False):
+    """Dtype-aware closeness assertion (reference test_utils.py:561)."""
+    rtol = default_rtol(a, b) if rtol is None else rtol
+    atol = default_atol(a, b) if atol is None else atol
+    an = _to_numpy(a)
+    bn = _to_numpy(b)
+    if an.dtype.kind == "f":
+        an = an.astype(onp.float64)
+    if bn.dtype.kind == "f":
+        bn = bn.astype(onp.float64)
+    if an.shape != bn.shape:
+        raise AssertionError(
+            f"shape mismatch: {names[0]}.shape={an.shape} vs "
+            f"{names[1]}.shape={bn.shape}")
+    if onp.allclose(an, bn, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    err = onp.abs(an - bn)
+    denom = onp.maximum(onp.abs(bn), 1e-30)
+    rel = err / denom
+    idx = onp.unravel_index(onp.argmax(err - atol - rtol * onp.abs(bn)), an.shape)
+    raise AssertionError(
+        f"{names[0]} and {names[1]} differ beyond rtol={rtol} atol={atol}: "
+        f"max abs err {err.max():.6g}, max rel err {rel.max():.6g}, "
+        f"worst at {tuple(int(i) for i in idx)}: "
+        f"{names[0]}={an[idx]!r} {names[1]}={bn[idx]!r}")
+
+
+def rand_shape_nd(ndim: int, dim: int = 10, allow_zero_size: bool = False):
+    """Random shape with `ndim` dims each in [1, dim] (reference :243)."""
+    low = 0 if allow_zero_size else 1
+    return tuple(int(x) for x in onp.random.randint(low, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, dtype="float32", low: float = -1.0, high: float = 1.0,
+                 ctx=None):
+    """Uniform random mx.np array (reference test_utils.py:388 for dense)."""
+    data = onp.random.uniform(low, high, size=shape)
+    return mxnp.array(data.astype(onp.float32), dtype=dtype)
+
+
+def random_arrays(*shapes, dtype="float32") -> List[onp.ndarray]:
+    """Random numpy arrays, scalars for 0-d shapes (reference :270)."""
+    arrays = [onp.random.randn(*s).astype(dtype) if s else
+              onp.asarray(onp.random.randn(), dtype=dtype) for s in shapes]
+    return arrays
+
+
+def numeric_grad(fn: Callable, inputs: Sequence[onp.ndarray], eps: float = 1e-4,
+                 wrt: Optional[Sequence[int]] = None) -> List[onp.ndarray]:
+    """Central finite differences of a scalar-valued ``fn`` over numpy
+    inputs (the oracle inside reference test_utils.py:931 numeric_grad)."""
+    wrt = list(range(len(inputs))) if wrt is None else list(wrt)
+    inputs = [onp.asarray(x, dtype=onp.float64) for x in inputs]
+    grads = []
+    for i in wrt:
+        x = inputs[i]
+        g = onp.zeros_like(x)
+        flat = x.reshape(-1)
+        gflat = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            f_hi = float(fn(*inputs))
+            flat[j] = orig - eps
+            f_lo = float(fn(*inputs))
+            flat[j] = orig
+            gflat[j] = (f_hi - f_lo) / (2 * eps)
+        grads.append(g)
+    return grads
+
+
+def check_numeric_gradient(fn: Callable, inputs: Sequence,
+                           rtol: float = 1e-2, atol: float = 1e-4,
+                           numeric_eps: float = 1e-4,
+                           wrt: Optional[Sequence[int]] = None,
+                           aux: Optional[dict] = None):
+    """Verify the autograd tape against central finite differences
+    (reference test_utils.py:987).
+
+    ``fn(*mx_arrays) -> mx_array`` is an arbitrary differentiable op chain.
+    The output is projected to a scalar with a fixed random cotangent so a
+    single backward checks the full Jacobian action.
+    """
+    inputs_np = [_to_numpy(x).astype(onp.float64) for x in inputs]
+    wrt = list(range(len(inputs_np))) if wrt is None else list(wrt)
+    kwargs = aux or {}
+
+    # fixed projection => scalar loss
+    probe_out = fn(*[mxnp.array(x.astype(onp.float32)) for x in inputs_np],
+                   **kwargs)
+    proj = onp.random.uniform(-1.0, 1.0, size=probe_out.shape)
+
+    # analytic: tape backward
+    mx_in = [mxnp.array(x.astype(onp.float32)) for x in inputs_np]
+    for i in wrt:
+        mx_in[i].attach_grad()
+    with autograd.record():
+        out = fn(*mx_in, **kwargs)
+        loss = (out * mxnp.array(proj.astype(onp.float32))).sum()
+    loss.backward()
+    analytic = [mx_in[i].grad.asnumpy().astype(onp.float64) for i in wrt]
+
+    # numeric: float64 central differences of the same projected scalar
+    def scalar_fn(*xs):
+        return float((_to_numpy(fn(*[mxnp.array(x.astype(onp.float32))
+                                     for x in xs], **kwargs))
+                      .astype(onp.float64) * proj).sum())
+
+    numeric = numeric_grad(scalar_fn, inputs_np, eps=numeric_eps, wrt=wrt)
+
+    for i, (a, n) in enumerate(zip(analytic, numeric)):
+        assert_almost_equal(a, n, rtol=rtol, atol=atol,
+                            names=(f"autograd_grad[{wrt[i]}]",
+                                   f"numeric_grad[{wrt[i]}]"))
+
+
+def check_symbolic_forward(fn: Callable, inputs: Sequence, expected: Sequence,
+                           rtol: Optional[float] = None,
+                           atol: Optional[float] = None, aux: Optional[dict] = None):
+    """Outputs of ``fn`` match numpy oracles (reference test_utils.py:1130)."""
+    mx_in = [x if isinstance(x, ndarray) else mxnp.array(onp.asarray(x))
+             for x in inputs]
+    out = fn(*mx_in, **(aux or {}))
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    expected = expected if isinstance(expected, (list, tuple)) else [expected]
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol,
+                            names=(f"output[{i}]", f"expected[{i}]"))
+
+
+def check_symbolic_backward(fn: Callable, inputs: Sequence, out_grads: Sequence,
+                            expected_grads: Sequence,
+                            rtol: Optional[float] = None,
+                            atol: Optional[float] = None,
+                            aux: Optional[dict] = None):
+    """Input grads under a given head cotangent match oracles
+    (reference test_utils.py:1221)."""
+    mx_in = [x if isinstance(x, ndarray) else mxnp.array(onp.asarray(x))
+             for x in inputs]
+    for x in mx_in:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*mx_in, **(aux or {}))
+    og = out_grads[0] if isinstance(out_grads, (list, tuple)) else out_grads
+    og = og if isinstance(og, ndarray) else mxnp.array(onp.asarray(og))
+    out.backward(og)
+    for i, e in enumerate(expected_grads):
+        if e is None:
+            continue
+        assert_almost_equal(mx_in[i].grad, e, rtol=rtol, atol=atol,
+                            names=(f"grad[{i}]", f"expected_grad[{i}]"))
+
+
+def check_consistency(fn: Callable, inputs: Sequence,
+                      dtypes: Sequence[str] = ("float64", "float32", "bfloat16"),
+                      modes: Sequence[str] = ("eager", "jit"),
+                      rtol: Optional[float] = None,
+                      atol: Optional[float] = None,
+                      aux: Optional[dict] = None) -> Dict[str, onp.ndarray]:
+    """Run ``fn`` across execution modes x dtypes and cross-check all
+    results against the most-precise run (reference test_utils.py:1428,
+    whose axes were CPU-vs-GPU-vs-MKLDNN; ours are eager-vs-jit and
+    fp32-vs-bf16, the TPU failure axes).
+
+    Returns the dict of per-config outputs for further inspection.
+    """
+    import jax
+
+    inputs_np = [_to_numpy(x) for x in inputs]
+    kwargs = aux or {}
+    results: Dict[str, onp.ndarray] = {}
+    for dtype in dtypes:
+        cast = [x.astype(dtype) if onp.asarray(x).dtype.kind == "f" else x
+                for x in inputs_np]
+        mx_in = [mxnp.array(x) for x in cast]
+        for mode in modes:
+            if mode == "eager":
+                out = fn(*mx_in, **kwargs)
+            elif mode == "jit":
+                from .ndarray.ndarray import _unwrap, _wrap
+                jfn = jax.jit(lambda *vals: _unwrap(fn(
+                    *[_wrap(v) for v in vals], **kwargs)))
+                out = _wrap(jfn(*[_unwrap(m) for m in mx_in]))
+            else:
+                raise MXNetError(f"unknown consistency mode {mode!r}")
+            results[f"{mode}/{dtype}"] = _to_numpy(out).astype(onp.float64)
+
+    # cross-check everything against the highest-precision config
+    ref_key = f"{modes[0]}/{dtypes[0]}"
+    ref = results[ref_key]
+    for key, val in results.items():
+        if key == ref_key:
+            continue
+        dtype = key.split("/")[1]
+        r = _RTOL.get(dtype, 1e-5) if rtol is None else rtol
+        a = _ATOL.get(dtype, 1e-8) if atol is None else atol
+        assert_almost_equal(val, ref, rtol=r, atol=a, names=(key, ref_key))
+    return results

@@ -80,6 +80,15 @@ int MXTRecordIOReaderNext(void* handle, const char** data, uint64_t* size) {
     }
     uint32_t cflag = lrec >> kLRecBits;
     uint32_t len = lrec & kLRecMask;
+    // dmlc-core's writer splits payloads at embedded kMagic words and drops
+    // those 4 bytes; the reader re-inserts kMagic before each continuation
+    // part (cflag 2 = middle, 3 = end) to reconstruct the original payload.
+    if (cflag == 2 || cflag == 3) {
+      // explicit little-endian bytes, matching write_u32 / the writer's
+      // magic_b (a host-endian memcpy would corrupt on big-endian hosts)
+      static const char magic_le[4] = {0x0a, 0x23, (char)0xd7, (char)0xce};
+      r->buf.insert(r->buf.end(), magic_le, magic_le + 4);
+    }
     size_t off = r->buf.size();
     r->buf.resize(off + len);
     if (len && fread(r->buf.data() + off, 1, len, r->fp) != len) {
@@ -133,14 +142,33 @@ uint64_t MXTRecordIOWriterTell(void* handle) {
 int MXTRecordIOWriterWrite(void* handle, const char* data, uint64_t size) {
   Writer* w = static_cast<Writer*>(handle);
   if (size > kLRecMask) {
-    w->error = "record too large for single-part write";
+    w->error = "record too large";
     return -1;
   }
+  // dmlc-core wire semantics: split the payload at every 4-byte-aligned
+  // embedded kMagic occurrence, dropping those 4 bytes (the reader
+  // re-inserts them); cflag 1 = begin, 2 = middle, 3 = end, 0 = whole.
+  unsigned char magic_b[4] = {0x0a, 0x23, 0xd7, 0xce};  // kMagic little-endian
+  uint32_t len = (uint32_t)size;
+  uint32_t lower = (len >> 2) << 2;
+  uint32_t dptr = 0;
+  for (uint32_t i = 0; i < lower; i += 4) {
+    if (memcmp(data + i, magic_b, 4) == 0) {
+      uint32_t lrec = ((dptr == 0 ? 1u : 2u) << kLRecBits) | (i - dptr);
+      if (write_u32(w->fp, kMagic) != 0) return -1;
+      if (write_u32(w->fp, lrec) != 0) return -1;
+      uint32_t plen = i - dptr;  // 4-aligned: no padding needed
+      if (plen && fwrite(data + dptr, 1, plen, w->fp) != plen) return -1;
+      dptr = i + 4;
+    }
+  }
+  uint32_t lrec = ((dptr != 0 ? 3u : 0u) << kLRecBits) | (len - dptr);
   if (write_u32(w->fp, kMagic) != 0) return -1;
-  if (write_u32(w->fp, (uint32_t)size) != 0) return -1;
-  if (size && fwrite(data, 1, size, w->fp) != size) return -1;
+  if (write_u32(w->fp, lrec) != 0) return -1;
+  uint32_t plen = len - dptr;
+  if (plen && fwrite(data + dptr, 1, plen, w->fp) != plen) return -1;
   static const char zeros[4] = {0, 0, 0, 0};
-  size_t pad = (4 - (size & 3)) & 3;
+  size_t pad = (4 - (plen & 3)) & 3;
   if (pad && fwrite(zeros, 1, pad, w->fp) != pad) return -1;
   return 0;
 }

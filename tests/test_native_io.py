@@ -118,7 +118,11 @@ def test_multipart_record_native(tmp_path):
     data = ctypes.c_char_p()
     size = ctypes.c_uint64()
     assert L.MXTRecordIOReaderNext(h, ctypes.byref(data), ctypes.byref(size)) == 0
-    assert ctypes.string_at(data, size.value) == b"abcdefghi"
+    # dmlc semantics: the writer dropped a magic word at each split point, so
+    # reassembly re-inserts it before every cflag==2/3 part
+    magic_bytes = struct.pack("<I", magic)
+    assert (ctypes.string_at(data, size.value)
+            == b"abc" + magic_bytes + b"defg" + magic_bytes + b"hi")
     assert L.MXTRecordIOReaderNext(h, ctypes.byref(data), ctypes.byref(size)) == 1
     L.MXTRecordIOReaderFree(h)
 

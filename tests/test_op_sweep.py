@@ -1,0 +1,312 @@
+"""Sweep the public op surface through the test_utils harness.
+
+This is the parity mechanism of the reference's operator tests
+(tests/python/unittest/test_numpy_op.py + test_operator.py): every op is
+oracle-checked against NumPy, and differentiable ops additionally get a
+central-finite-difference gradient check via
+``test_utils.check_numeric_gradient`` (reference test_utils.py:987) and an
+eager-vs-jit / fp32-vs-bf16 consistency check via ``check_consistency``
+(reference test_utils.py:1428).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def _pos(shape):  # strictly positive inputs
+    return onp.random.uniform(0.5, 2.0, size=shape).astype(onp.float32)
+
+
+def _any(shape):
+    return onp.random.uniform(-2.0, 2.0, size=shape).astype(onp.float32)
+
+
+def _unit(shape):  # inside (-1, 1) for arc-functions
+    return onp.random.uniform(-0.9, 0.9, size=shape).astype(onp.float32)
+
+
+def _gt1(shape):  # > 1 for acosh
+    return onp.random.uniform(1.1, 3.0, size=shape).astype(onp.float32)
+
+
+def _nonzero(shape):
+    x = onp.random.uniform(0.5, 2.0, size=shape).astype(onp.float32)
+    return x * onp.where(onp.random.rand(*shape) < 0.5, -1, 1).astype(onp.float32)
+
+
+# (name, input generator, numpy oracle name or callable)
+UNARY_ORACLE = [
+    ("negative", _any, None), ("abs", _any, None), ("absolute", _any, None),
+    ("sign", _nonzero, None), ("rint", _any, None), ("floor", _any, None),
+    ("ceil", _any, None), ("trunc", _any, None), ("fix", _any, None),
+    ("sqrt", _pos, None), ("cbrt", _any, None), ("square", _any, None),
+    ("reciprocal", _nonzero, None), ("exp", _any, None), ("expm1", _any, None),
+    ("log", _pos, None), ("log2", _pos, None), ("log10", _pos, None),
+    ("log1p", _pos, None), ("sin", _any, None), ("cos", _any, None),
+    ("tan", _unit, None), ("arcsin", _unit, None), ("arccos", _unit, None),
+    ("arctan", _any, None), ("sinh", _any, None), ("cosh", _any, None),
+    ("tanh", _any, None), ("arcsinh", _any, None), ("arccosh", _gt1, None),
+    ("arctanh", _unit, None), ("degrees", _any, None), ("radians", _any, None),
+    ("isnan", _any, None), ("isinf", _any, None), ("isfinite", _any, None),
+    ("logical_not", _any, None),
+    ("sigmoid", _any, lambda x: 1.0 / (1.0 + onp.exp(-x))),
+    ("relu", _any, lambda x: onp.maximum(x, 0)),
+    ("erf", _any, None), ("erfinv", _unit, None),
+]
+
+
+@pytest.mark.parametrize("name,gen,oracle", UNARY_ORACLE,
+                         ids=[t[0] for t in UNARY_ORACLE])
+def test_unary_oracle(name, gen, oracle):
+    x = gen((3, 4))
+    fn = getattr(mx.np, name)
+    if oracle is None:
+        if name in ("erf", "erfinv"):
+            from scipy import special as sp  # scipy ships with the image
+            oracle = getattr(sp, name)
+        else:
+            oracle = getattr(onp, name)
+    tu.check_symbolic_forward(fn, [x], [oracle(x.astype(onp.float64))],
+                              rtol=1e-4, atol=1e-5)
+
+
+BINARY_ORACLE = [
+    ("add", _any, _any), ("subtract", _any, _any), ("multiply", _any, _any),
+    ("divide", _any, _nonzero), ("true_divide", _any, _nonzero),
+    ("floor_divide", _any, _nonzero), ("mod", _any, _nonzero),
+    ("remainder", _any, _nonzero), ("power", _pos, _any),
+    ("maximum", _any, _any), ("minimum", _any, _any),
+    ("fmax", _any, _any), ("fmin", _any, _any), ("fmod", _any, _nonzero),
+    ("hypot", _any, _any), ("arctan2", _any, _nonzero),
+    ("logaddexp", _any, _any), ("copysign", _any, _nonzero),
+    ("logical_and", _any, _any), ("logical_or", _any, _any),
+    ("logical_xor", _any, _any),
+    ("equal", _any, _any), ("not_equal", _any, _any),
+    ("greater", _any, _any), ("greater_equal", _any, _any),
+    ("less", _any, _any), ("less_equal", _any, _any),
+]
+
+
+@pytest.mark.parametrize("name,gen_a,gen_b", BINARY_ORACLE,
+                         ids=[t[0] for t in BINARY_ORACLE])
+def test_binary_oracle(name, gen_a, gen_b):
+    a, b = gen_a((3, 4)), gen_b((3, 4))
+    fn = getattr(mx.np, name)
+    oracle = getattr(onp, name)
+    tu.check_symbolic_forward(fn, [a, b],
+                              [oracle(a.astype(onp.float64), b.astype(onp.float64))],
+                              rtol=1e-4, atol=1e-5)
+    # broadcasting path
+    b1 = gen_b((1, 4))
+    tu.check_symbolic_forward(fn, [a, b1],
+                              [oracle(a.astype(onp.float64), b1.astype(onp.float64))],
+                              rtol=1e-4, atol=1e-5)
+
+
+REDUCTIONS = ["sum", "mean", "prod", "min", "max", "amin", "amax",
+              "nansum", "nanprod", "nanmin", "nanmax", "median", "all", "any"]
+
+
+@pytest.mark.parametrize("name", REDUCTIONS)
+@pytest.mark.parametrize("axis", [None, 0, 1, (0, 1)])
+def test_reduction_oracle(name, axis):
+    x = _pos((3, 4))
+    fn = getattr(mx.np, name)
+    oracle = getattr(onp, name)
+    kw = {"axis": axis}
+    expected = oracle(x.astype(onp.float64), axis=axis)
+    tu.check_symbolic_forward(lambda a: fn(a, axis=axis), [x],
+                              [onp.asarray(expected)], rtol=1e-4, atol=1e-5)
+    if name not in ("median", "all", "any"):
+        expected_k = oracle(x.astype(onp.float64), axis=axis, keepdims=True)
+        tu.check_symbolic_forward(lambda a: fn(a, axis=axis, keepdims=True),
+                                  [x], [onp.asarray(expected_k)],
+                                  rtol=1e-4, atol=1e-5)
+
+
+SHAPE_OPS = [
+    ("reshape", lambda x: mx.np.reshape(x, (4, 3)), lambda x: x.reshape(4, 3)),
+    ("transpose", lambda x: mx.np.transpose(x), lambda x: x.T),
+    ("swapaxes", lambda x: mx.np.swapaxes(x, 0, 1), lambda x: x.swapaxes(0, 1)),
+    ("expand_dims", lambda x: mx.np.expand_dims(x, 1),
+     lambda x: onp.expand_dims(x, 1)),
+    ("squeeze", lambda x: mx.np.squeeze(mx.np.expand_dims(x, 0)),
+     lambda x: x),
+    ("ravel", lambda x: mx.np.ravel(x), lambda x: x.ravel()),
+    ("flip", lambda x: mx.np.flip(x, 0), lambda x: onp.flip(x, 0)),
+    ("roll", lambda x: mx.np.roll(x, 2, 1), lambda x: onp.roll(x, 2, 1)),
+    ("rot90", lambda x: mx.np.rot90(x), lambda x: onp.rot90(x)),
+    ("tile", lambda x: mx.np.tile(x, (2, 1)), lambda x: onp.tile(x, (2, 1))),
+    ("repeat", lambda x: mx.np.repeat(x, 2, 0), lambda x: onp.repeat(x, 2, 0)),
+    ("tril", lambda x: mx.np.tril(x), lambda x: onp.tril(x)),
+    ("triu", lambda x: mx.np.triu(x), lambda x: onp.triu(x)),
+    ("cumsum", lambda x: mx.np.cumsum(x, 1), lambda x: onp.cumsum(x, 1)),
+    ("cumprod", lambda x: mx.np.cumprod(x, 1), lambda x: onp.cumprod(x, 1)),
+    ("sort", lambda x: mx.np.sort(x, 1), lambda x: onp.sort(x, 1)),
+    ("argsort", lambda x: mx.np.argsort(x, 1), lambda x: onp.argsort(x, 1)),
+    ("pad", lambda x: mx.np.pad(x, ((1, 1), (0, 2))),
+     lambda x: onp.pad(x, ((1, 1), (0, 2)))),
+    ("diff", lambda x: mx.np.diff(x, axis=1), lambda x: onp.diff(x, axis=1)),
+    ("clip", lambda x: mx.np.clip(x, -0.5, 0.5),
+     lambda x: onp.clip(x, -0.5, 0.5)),
+    ("broadcast_to", lambda x: mx.np.broadcast_to(mx.np.expand_dims(x, 0),
+                                                  (2, 3, 4)),
+     lambda x: onp.broadcast_to(x[None], (2, 3, 4))),
+]
+
+
+@pytest.mark.parametrize("name,fn,oracle", SHAPE_OPS,
+                         ids=[t[0] for t in SHAPE_OPS])
+def test_shape_op_oracle(name, fn, oracle):
+    x = _any((3, 4))
+    tu.check_symbolic_forward(fn, [x], [oracle(x)], rtol=1e-6, atol=1e-6)
+
+
+LINALG_LIKE = [
+    ("dot", lambda a, b: mx.np.dot(a, b), lambda a, b: onp.dot(a, b),
+     (3, 4), (4, 5)),
+    ("matmul", lambda a, b: mx.np.matmul(a, b), lambda a, b: a @ b,
+     (2, 3, 4), (2, 4, 5)),
+    ("inner", lambda a, b: mx.np.inner(a, b), lambda a, b: onp.inner(a, b),
+     (3, 4), (5, 4)),
+    ("outer", lambda a, b: mx.np.outer(a, b), lambda a, b: onp.outer(a, b),
+     (3,), (4,)),
+    ("tensordot", lambda a, b: mx.np.tensordot(a, b, axes=1),
+     lambda a, b: onp.tensordot(a, b, axes=1), (3, 4), (4, 5)),
+    ("kron", lambda a, b: mx.np.kron(a, b), lambda a, b: onp.kron(a, b),
+     (2, 2), (3, 3)),
+    ("vdot", lambda a, b: mx.np.vdot(a, b), lambda a, b: onp.vdot(a, b),
+     (3, 4), (3, 4)),
+]
+
+
+@pytest.mark.parametrize("name,fn,oracle,sa,sb", LINALG_LIKE,
+                         ids=[t[0] for t in LINALG_LIKE])
+def test_linalg_like_oracle(name, fn, oracle, sa, sb):
+    a, b = _any(sa), _any(sb)
+    tu.check_symbolic_forward(fn, [a, b], [oracle(a.astype(onp.float64),
+                                                  b.astype(onp.float64))],
+                              rtol=1e-4, atol=1e-5)
+
+
+# -- numeric gradient sweep (reference check_numeric_gradient :987) --------
+
+GRAD_UNARY = ["exp", "log", "sqrt", "square", "sin", "cos", "tanh",
+              "sigmoid", "relu", "arctan", "sinh", "cosh", "cbrt",
+              "log1p", "expm1", "erf", "reciprocal"]
+
+
+@pytest.mark.parametrize("name", GRAD_UNARY)
+def test_unary_numeric_grad(name):
+    gen = {"log": _pos, "sqrt": _pos, "log1p": _pos, "reciprocal": _pos,
+           "cbrt": _pos}.get(name, _any)
+    fn = getattr(mx.np, name)
+    tu.check_numeric_gradient(fn, [gen((3, 4))], rtol=1e-2, atol=1e-3)
+
+
+GRAD_BINARY = ["add", "subtract", "multiply", "divide", "power",
+               "maximum", "minimum", "hypot", "logaddexp", "arctan2"]
+
+
+@pytest.mark.parametrize("name", GRAD_BINARY)
+def test_binary_numeric_grad(name):
+    gen_b = _nonzero if name in ("divide", "arctan2") else _any
+    a = _pos((2, 3)) if name == "power" else _any((2, 3))
+    fn = getattr(mx.np, name)
+    tu.check_numeric_gradient(fn, [a, gen_b((2, 3))], rtol=1e-2, atol=1e-3)
+
+
+GRAD_COMPOSITE = [
+    ("mean", lambda x: mx.np.mean(x, axis=1)),
+    ("sum_axis", lambda x: mx.np.sum(x, axis=0)),
+    ("prod", lambda x: mx.np.prod(x, axis=1)),
+    ("std", lambda x: mx.np.std(x, axis=1)),
+    ("var", lambda x: mx.np.var(x, axis=1)),
+    ("max", lambda x: mx.np.max(x, axis=1)),
+    ("softmax", lambda x: mx.npx.softmax(x, axis=-1)),
+    ("log_softmax", lambda x: mx.npx.log_softmax(x, axis=-1)),
+    ("logsumexp_chain", lambda x: mx.np.log(mx.np.sum(mx.np.exp(x), axis=1))),
+    ("take", lambda x: mx.np.take(x, mx.np.array(onp.array([0, 2]),
+                                                 dtype="int32"), axis=0)),
+    ("where", lambda x: mx.np.where(x > 0, x * 2.0, x * 0.5)),
+    ("clip", lambda x: mx.np.clip(x, -0.5, 0.5)),
+    ("layer_norm", lambda x: mx.npx.layer_norm(
+        x, mx.np.ones((4,)), mx.np.zeros((4,)))),
+    ("rms_norm", lambda x: mx.npx.rms_norm(x, mx.np.ones((4,)))),
+]
+
+
+@pytest.mark.parametrize("name,fn", GRAD_COMPOSITE,
+                         ids=[t[0] for t in GRAD_COMPOSITE])
+def test_composite_numeric_grad(name, fn):
+    x = _pos((3, 4)) if name == "prod" else _any((3, 4))
+    if name in ("max", "clip", "where"):  # kink-sensitive: keep away from ties
+        x = onp.linspace(-1, 1, 12).reshape(3, 4).astype(onp.float32)
+        x += onp.random.uniform(0.01, 0.02, x.shape).astype(onp.float32)
+    tu.check_numeric_gradient(fn, [x], rtol=1.5e-2, atol=2e-3)
+
+
+def test_matmul_numeric_grad():
+    tu.check_numeric_gradient(lambda a, b: mx.np.matmul(a, b),
+                              [_any((2, 3)), _any((3, 2))],
+                              rtol=1e-2, atol=1e-3)
+
+
+def test_fully_connected_numeric_grad():
+    tu.check_numeric_gradient(
+        lambda x, w, b: mx.npx.fully_connected(x, w, b, num_hidden=4),
+        [_any((2, 3)), _any((4, 3)), _any((4,))], rtol=1e-2, atol=1e-3)
+
+
+def test_convolution_numeric_grad():
+    tu.check_numeric_gradient(
+        lambda x, w: mx.npx.convolution(x, w, kernel=(2, 2), num_filter=2),
+        [_any((1, 2, 4, 4)), _any((2, 2, 2, 2))], rtol=1.5e-2, atol=2e-3)
+
+
+# -- consistency sweep (reference check_consistency :1428) -----------------
+
+CONSISTENCY_OPS = [
+    ("exp", lambda x: mx.np.exp(x)),
+    ("matmul", lambda x: mx.np.matmul(x, mx.np.transpose(x))),
+    ("softmax", lambda x: mx.npx.softmax(x, axis=-1)),
+    ("mean", lambda x: mx.np.mean(x, axis=0)),
+    ("layer_norm", lambda x: mx.npx.layer_norm(
+        x, mx.np.ones((4,)), mx.np.zeros((4,)))),
+]
+
+
+@pytest.mark.parametrize("name,fn", CONSISTENCY_OPS,
+                         ids=[t[0] for t in CONSISTENCY_OPS])
+def test_consistency_eager_jit_bf16(name, fn):
+    x = _any((3, 4))
+    tu.check_consistency(fn, [x], dtypes=("float32", "bfloat16"),
+                         modes=("eager", "jit"))
+
+
+def test_check_numeric_gradient_catches_wrong_grad():
+    """The harness itself must fail on a wrong gradient."""
+    from mxnet_tpu import autograd
+
+    class BadSquare(autograd.Function):
+        def forward(self, x):
+            return x * x
+
+        def backward(self, dy):
+            return dy  # WRONG: should be 2*x*dy
+
+    def bad(x):
+        return BadSquare()(x)
+
+    with pytest.raises(AssertionError):
+        tu.check_numeric_gradient(bad, [_any((2, 2))])
+
+
+def test_assert_almost_equal_reports_location():
+    a = onp.zeros((2, 2), dtype=onp.float32)
+    b = a.copy()
+    b[1, 1] = 1.0
+    with pytest.raises(AssertionError, match=r"\(1, 1\)"):
+        tu.assert_almost_equal(a, b)
