@@ -1,22 +1,61 @@
 """Headline benchmark: ResNet-50 inference throughput, batch 32.
 
 Baseline (BASELINE.md / reference docs perf.md:186-198): 1076.81 img/s on
-V100 fp32, batch 32. Prints ONE JSON line:
+V100 fp32, batch 32. Prints exactly ONE JSON line on stdout:
     {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+Engineered to always produce that line (VERDICT.md round-1 item #1):
+the measurement runs in a child process (the TPU backend behind the axon
+tunnel can fail or hang at init — a child can be timed out and retried;
+in-process jax caches a failed backend forever). Two TPU attempts, then a
+CPU fallback so a number exists even with the chip unreachable, then an
+{"error": ...} record as the last resort. Diagnostics go to stderr only.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as onp
-
 BASELINE_IMG_S = 1076.81  # ResNet-50 fp32 inference bs32, V100 (perf.md:186-198)
+METRIC = "resnet50_v1_infer_bs32_fp32"
 
 
-def main():
+def log(*a):
+    print("[bench]", *a, file=sys.stderr, flush=True)
+
+
+def child(platform: str) -> None:
+    """Measure in-process and print one JSON line. May crash/hang — the
+    parent handles that."""
+    if platform == "cpu":
+        # the axon sitecustomize pins JAX_PLATFORMS=axon at interpreter
+        # startup; env vars are ignored, only jax.config works
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    # the axon tunnel can HANG at init (not just fail); a watchdog turns
+    # that into a quick clean exit so the parent moves to the next attempt
+    backend_up = threading.Event()
+
+    def _watchdog():
+        if not backend_up.wait(180):
+            log("backend init watchdog fired (180s) — aborting child")
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    t0 = time.time()
+    devs = jax.devices()
+    backend_up.set()
+    log(f"backend up in {time.time() - t0:.1f}s: {devs}")
+
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
 
@@ -26,12 +65,8 @@ def main():
     x_np = onp.random.uniform(size=(batch, 3, 224, 224)).astype(onp.float32)
     fn, params = net.functionalize(mx.np.array(x_np), training=False)
 
-    def fwd(params, x):
-        logits, _ = fn(params, x)
-        return logits
-
     def step(params, x):
-        logits = fwd(params, x)
+        logits, _ = fn(params, x)
         # fold the output back into the next input: forces a true serial
         # dependency chain so no dispatch/caching layer can elide work
         perturb = jnp.tanh(jnp.mean(logits)) * 1e-6
@@ -39,24 +74,78 @@ def main():
 
     jstep = jax.jit(step)
     x = jnp.asarray(x_np)
-    # warmup / compile
+    t0 = time.time()
     _, xw = jstep(params, x)
     jax.block_until_ready(xw)
+    log(f"compiled + warm in {time.time() - t0:.1f}s")
 
-    iters = 30
+    # calibrate iteration count to ~5s of steady-state measurement
+    t0 = time.perf_counter()
+    out, x = jstep(params, x)
+    jax.block_until_ready(out)
+    per_iter = max(time.perf_counter() - t0, 1e-4)
+    iters = max(10, min(200, int(5.0 / per_iter)))
+
     t0 = time.perf_counter()
     for _ in range(iters):
         out, x = jstep(params, x)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     img_s = batch * iters / dt
-    print(json.dumps({
-        "metric": "resnet50_v1_infer_bs32_fp32",
+    rec = {
+        "metric": METRIC,
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-    }))
+        "device": str(devs[0].platform),
+        "iters": iters,
+    }
+    if platform == "cpu":
+        rec["note"] = "cpu fallback (TPU backend unavailable)"
+    print(json.dumps(rec), flush=True)
+
+
+def parse_last_json(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def main() -> None:
+    last_err = "no attempts ran"
+    # (platform, timeout_s): two TPU tries (tunnel init is flaky and can
+    # hang), then CPU which always works
+    for attempt, (platform, tmo) in enumerate(
+            [("tpu", 420), ("tpu", 420), ("cpu", 900)]):
+        log(f"attempt {attempt}: platform={platform} timeout={tmo}s")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child", platform],
+                capture_output=True, text=True, timeout=tmo)
+            sys.stderr.write(proc.stderr[-4000:])
+            rec = parse_last_json(proc.stdout)
+            if rec is not None and rec.get("value", 0) > 0:
+                print(json.dumps(rec), flush=True)
+                return
+            last_err = (f"rc={proc.returncode}: "
+                        + (proc.stderr.strip().splitlines() or ["no stderr"])[-1])
+        except subprocess.TimeoutExpired:
+            last_err = f"timeout after {tmo}s on {platform}"
+        except Exception as e:  # noqa: BLE001
+            last_err = repr(e)
+        log(f"attempt {attempt} failed: {last_err}")
+    print(json.dumps({"metric": METRIC, "value": 0.0, "unit": "img/s",
+                      "vs_baseline": 0.0, "error": last_err}), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        child(sys.argv[2])
+    else:
+        main()

@@ -223,13 +223,15 @@ class Embedding(HybridBlock):
         super().__init__()
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         self.weight = Parameter(
             "weight", shape=(input_dim, output_dim), dtype=dtype,
             init=weight_initializer, grad_stype="row_sparse" if sparse_grad else "default",
         )
 
     def forward(self, x):
-        return npx.embedding(x, self.weight.data(), self._input_dim, self._output_dim)
+        return npx.embedding(x, self.weight.data(), self._input_dim,
+                             self._output_dim, sparse_grad=self._sparse_grad)
 
     def __repr__(self):
         return f"Embedding({self._input_dim} -> {self._output_dim})"

@@ -124,7 +124,9 @@ class Parameter:
         self._data = arr
         self._deferred_init = None
         if self.grad_req != "null":
-            self._data.attach_grad(self.grad_req)
+            self._data.attach_grad(
+                self.grad_req,
+                stype=self.grad_stype if self.grad_stype != "default" else None)
 
     def finalize(self):
         """Complete deferred init once shape is known (called by layers)."""
@@ -156,7 +158,9 @@ class Parameter:
             self._shape = tuple(data.shape)
             self._data = _wrap(jnp.asarray(data, self.dtype))
             if self.grad_req != "null":
-                self._data.attach_grad(self.grad_req)
+                self._data.attach_grad(
+                    self.grad_req,
+                    stype=self.grad_stype if self.grad_stype != "default" else None)
         else:
             if tuple(data.shape) != tuple(self._shape):
                 raise MXNetError(
@@ -176,7 +180,13 @@ class Parameter:
     def zero_grad(self):
         if self._data is not None and self._data._grad is not None:
             g = self._data._grad
-            g._set_data(jnp.zeros(g.shape, g.dtype))
+            from ..ndarray.sparse import RowSparseNDArray
+
+            if isinstance(g, RowSparseNDArray):
+                g._values = g._values[:0]
+                g._indices = g._indices[:0]
+            else:
+                g._set_data(jnp.zeros(g.shape, g.dtype))
 
     def reset_ctx(self, ctx):
         if self._data is not None:

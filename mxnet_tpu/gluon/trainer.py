@@ -111,8 +111,16 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        from ..ndarray.sparse import RowSparseNDArray
+
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p._data is not None:
+                if isinstance(p.grad(), RowSparseNDArray):
+                    # sparse grads skip the dense allreduce (reference
+                    # trainer.py:303-396 routes them through sparse push /
+                    # row_sparse_pull; multi-worker sparse aggregation uses
+                    # kvstore.push with row_sparse values directly)
+                    continue
                 # priority = -i: comm for late layers first, overlapping
                 # backward (reference trainer.py:402 P3 behavior)
                 self._kvstore.pushpull(i, p.grad(), out=p.grad(), priority=-i)
@@ -162,8 +170,23 @@ class Trainer:
         return jax.jit(fused, donate_argnums=(0, 2))
 
     def _update(self, ignore_stale_grad=False):
+        from ..ndarray.sparse import RowSparseNDArray
+
         opt = self._optimizer
-        idxs = [i for i, p in enumerate(self._params) if p.grad_req != "null" and p._data is not None]
+        all_idxs = [i for i, p in enumerate(self._params)
+                    if p.grad_req != "null" and p._data is not None]
+        if not all_idxs:
+            return
+        # row_sparse grads use the eager lazy-update path; the fused jit
+        # step is for dense grads only (sparse nnz varies per step — a
+        # static-shape jit would retrace every step)
+        sparse_idxs = [i for i in all_idxs
+                       if isinstance(self._params[i].grad(), RowSparseNDArray)]
+        for i in sparse_idxs:
+            p = self._params[i]
+            opt.update(i, p.data(), p.grad(), self._states[i])
+            self._states[i] = opt._latest_states[i]
+        idxs = [i for i in all_idxs if i not in sparse_idxs]
         if not idxs:
             return
         if not self._jit_safe:

@@ -31,6 +31,16 @@ __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPU"]
 
 
 def _sum_values(vals):
+    from ..ndarray.sparse import RowSparseNDArray
+
+    if any(isinstance(v, RowSparseNDArray) for v in vals):
+        # sparse aggregation: concat rows then one segment-sum — the TPU
+        # analog of the reference's sparse CommCPU reduce (comm.h sparse path)
+        out = vals[0] if isinstance(vals[0], RowSparseNDArray) else _unwrap(vals[0])
+        for v in vals[1:]:
+            v = v if isinstance(v, RowSparseNDArray) else _unwrap(v)
+            out = (out + v) if isinstance(out, RowSparseNDArray) else (v + out)
+        return out.consolidate() if isinstance(out, RowSparseNDArray) else out
     out = _unwrap(vals[0])
     for v in vals[1:]:
         out = out + _unwrap(v)
@@ -82,26 +92,37 @@ class KVStoreLocal(KVStoreBase):
             self._store[k] = v.copy() if isinstance(v, ndarray) else ndarray(v)
 
     def push(self, key, value, priority=0):
+        from ..ndarray.sparse import RowSparseNDArray
+
         keys, values = _normalize_grouped(key, value)
         for k, vals in zip(keys, values):
             agg = _sum_values(vals)
-            if self._compression is not None:
+            sparse = isinstance(agg, RowSparseNDArray)
+            if self._compression is not None and not sparse:
                 agg = self._compression.compress(k, agg)
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")
             if self._updater is not None:
-                self._updater(_int_key(k), _wrap(agg), self._store[k])
+                # a row_sparse aggregate reaches the updater as-is so a
+                # lazy optimizer touches only the pushed rows (reference
+                # kvstore_dist_server.h sparse DataHandle)
+                self._updater(_int_key(k), agg if sparse else _wrap(agg),
+                              self._store[k])
             else:
                 self._pending = getattr(self, "_pending", {})
                 self._pending[k] = agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from ..ndarray.sparse import RowSparseNDArray
+
         keys, outs = _normalize_grouped(key, out)
         for k, out_list in zip(keys, outs):
             if self._updater is None and getattr(self, "_pending", {}).get(k) is not None:
                 val = self._pending[k]
             else:
                 val = _unwrap(self._store[k])
+            if isinstance(val, RowSparseNDArray):
+                val = val.todense_val()  # dense pull of a sparse aggregate
             for o in out_list:
                 o._set_data(jnp.asarray(val, o.dtype))
 

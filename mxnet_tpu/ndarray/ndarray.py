@@ -218,10 +218,19 @@ class ndarray:
         if grad_req not in ("write", "add", "null"):
             raise MXNetError(f"invalid grad_req {grad_req!r}")
         self._grad_req = grad_req
-        if grad_req != "null":
-            self._grad = _wrap(jnp.zeros(self.shape, self.dtype))
-        else:
+        if grad_req == "null":
             self._grad = None
+        elif stype == "row_sparse":
+            # sparse gradient storage (reference attach_grad stype arg →
+            # kRowSparseStorage grad, ndarray.py:2747): starts empty; the
+            # backward pass fills only the touched rows
+            from .sparse import RowSparseNDArray
+
+            self._grad = RowSparseNDArray(
+                jnp.zeros((0,) + self.shape[1:], self.dtype),
+                jnp.zeros((0,), jnp.int32), self.shape)
+        else:
+            self._grad = _wrap(jnp.zeros(self.shape, self.dtype))
 
     def detach(self) -> "ndarray":
         out = _wrap(self._data)

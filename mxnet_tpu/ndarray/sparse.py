@@ -1,0 +1,335 @@
+"""Sparse storage types (reference ``include/mxnet/ndarray.h:63-65``
+``kRowSparseStorage``/``kCSRStorage`` + ``python/mxnet/ndarray/sparse.py``).
+
+TPU-native design (SURVEY.md §7 "hard parts"): XLA has no sparse tensor
+type, so sparse storage is a *pair of dense jax arrays* (indices + values)
+and every sparse op lowers to gather/scatter/segment-sum — which is how
+embedding-gradient sparsity is actually exploited on TPU hardware (the MXU
+wants dense tiles; the win is touching only ``nnz`` rows of HBM instead of
+the full vocab). ``row_sparse`` is the gradient format for embeddings
+(reference src/operator/tensor/indexing_op.cc EmbeddingOpBackward w/
+kRowSparseStorage output); ``csr`` covers sample-major sparse inputs
+(reference src/io libsvm iterator use case).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from .ndarray import ndarray, _unwrap, _wrap
+
+__all__ = [
+    "RowSparseNDArray",
+    "CSRNDArray",
+    "row_sparse_array",
+    "csr_matrix",
+    "cast_storage",
+    "retain",
+    "dot",
+    "add",
+    "stype_of",
+]
+
+
+def stype_of(arr) -> str:
+    return getattr(arr, "stype", "default")
+
+
+class BaseSparseNDArray:
+    """Common surface so sparse arrays duck-type where dense ndarray goes."""
+
+    stype = "undefined"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._values.dtype) if str(
+            self._values.dtype) != "bfloat16" else self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def data(self):
+        """The values array (reference sparse.py RowSparseNDArray.data)."""
+        return _wrap(self._values)
+
+    def asnumpy(self) -> onp.ndarray:
+        return onp.asarray(self.todense_val())
+
+    def wait_to_read(self):
+        self._values.block_until_ready()
+
+    def tostype(self, stype: str):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return _wrap(self.todense_val())
+        raise MXNetError(f"cast_storage {self.stype} -> {stype} not supported")
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self._shape} nnz={self.nnz} "
+                f"dtype={self._values.dtype}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array: a subset of rows present (reference ndarray.h:64
+    kRowSparseStorage; python/mxnet/ndarray/sparse.py:570).
+
+    ``indices``: int32 (nnz,) row ids (kept sorted+unique by construction
+    through ``consolidate``); ``values``: (nnz,) + shape[1:].
+    """
+
+    stype = "row_sparse"
+
+    def __init__(self, values, indices, shape):
+        self._values = _unwrap(values)
+        self._indices = jnp.asarray(_unwrap(indices), jnp.int32)
+        self._shape = tuple(int(s) for s in shape)
+        if self._values.ndim != len(self._shape):
+            raise MXNetError(
+                f"row_sparse values ndim {self._values.ndim} != dense ndim "
+                f"{len(self._shape)} (values carry the full row shape)")
+
+    @property
+    def indices(self):
+        return _wrap(self._indices)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._indices.shape[0])
+
+    def todense_val(self):
+        out = jnp.zeros(self._shape, self._values.dtype)
+        if self.nnz == 0:
+            return out
+        return out.at[self._indices].add(self._values)
+
+    def consolidate(self) -> "RowSparseNDArray":
+        """Sort + dedupe row ids, summing duplicate rows (segment-sum —
+        the TPU equivalent of the reference's dedup in sparse kvstore)."""
+        if self.nnz == 0:
+            return self
+        uniq, inv = onp.unique(onp.asarray(self._indices), return_inverse=True)
+        if uniq.shape[0] == self._indices.shape[0] and bool(
+                onp.all(onp.asarray(self._indices) == uniq)):
+            return self
+        summed = jax.ops.segment_sum(self._values, jnp.asarray(inv),
+                                     num_segments=int(uniq.shape[0]))
+        return RowSparseNDArray(summed, jnp.asarray(uniq, jnp.int32),
+                                self._shape)
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        """Keep only the requested rows (reference sparse retain op)."""
+        rs = self.consolidate()
+        keep = jnp.asarray(_unwrap(row_ids), jnp.int32)
+        mask = jnp.isin(rs._indices, keep)
+        idx = onp.nonzero(onp.asarray(mask))[0]
+        return RowSparseNDArray(rs._values[idx], rs._indices[idx], self._shape)
+
+    def copy(self) -> "RowSparseNDArray":
+        return RowSparseNDArray(self._values, self._indices, self._shape)
+
+    def astype(self, dtype):
+        return RowSparseNDArray(self._values.astype(dtype), self._indices,
+                                self._shape)
+
+    # -- arithmetic used by the autograd tape ------------------------------
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            if other._shape != self._shape:
+                raise MXNetError("row_sparse add: shape mismatch")
+            return RowSparseNDArray(
+                jnp.concatenate([self._values, other._values], axis=0),
+                jnp.concatenate([self._indices, other._indices], axis=0),
+                self._shape)
+        # dense + sparse -> dense
+        dense = _unwrap(other)
+        return dense.at[self._indices].add(
+            self._values.astype(dense.dtype)) if hasattr(
+                dense, "at") else self.todense_val() + dense
+
+    __radd__ = __add__
+
+    def __mul__(self, scalar):
+        return RowSparseNDArray(self._values * scalar, self._indices,
+                                self._shape)
+
+    __rmul__ = __mul__
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed-sparse-row array (reference ndarray.h:65 kCSRStorage;
+    python/mxnet/ndarray/sparse.py:340)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        self._values = _unwrap(data)
+        self._indices = jnp.asarray(_unwrap(indices), jnp.int32)
+        self._indptr = jnp.asarray(_unwrap(indptr), jnp.int32)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise MXNetError("csr storage is 2-D only")
+
+    @property
+    def indices(self):
+        return _wrap(self._indices)
+
+    @property
+    def indptr(self):
+        return _wrap(self._indptr)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def _row_ids(self):
+        """Expand indptr to one row id per nnz element."""
+        counts = onp.diff(onp.asarray(self._indptr))
+        return jnp.asarray(onp.repeat(onp.arange(self._shape[0]), counts),
+                           jnp.int32)
+
+    def todense_val(self):
+        out = jnp.zeros(self._shape, self._values.dtype)
+        if self.nnz == 0:
+            return out
+        return out.at[self._row_ids(), self._indices].add(self._values)
+
+    def copy(self) -> "CSRNDArray":
+        return CSRNDArray(self._values, self._indices, self._indptr,
+                          self._shape)
+
+    def astype(self, dtype):
+        return CSRNDArray(self._values.astype(dtype), self._indices,
+                          self._indptr, self._shape)
+
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    """Construct row_sparse from (values, indices) or densify-from-dense
+    (reference sparse.py:1059 row_sparse_array)."""
+    if isinstance(arg, RowSparseNDArray):
+        return arg
+    if isinstance(arg, (tuple, list)) and len(arg) == 2:
+        values, indices = arg
+        values = jnp.asarray(_unwrap(values),
+                             jnp.dtype(dtype) if dtype else None)
+        if shape is None:
+            raise MXNetError("row_sparse_array((values, indices)) needs shape")
+        return RowSparseNDArray(values, indices, shape).consolidate()
+    dense = onp.asarray(arg.asnumpy() if isinstance(arg, ndarray) else arg,
+                        dtype=dtype)
+    rows = onp.nonzero(onp.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+    return RowSparseNDArray(jnp.asarray(dense[rows]),
+                            jnp.asarray(rows, jnp.int32), dense.shape)
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    """Construct CSR from (data, indices, indptr) or from dense
+    (reference sparse.py:910 csr_matrix)."""
+    if isinstance(arg, CSRNDArray):
+        return arg
+    if isinstance(arg, (tuple, list)) and len(arg) == 3:
+        data, indices, indptr = arg
+        if shape is None:
+            raise MXNetError("csr_matrix((data, indices, indptr)) needs shape")
+        return CSRNDArray(jnp.asarray(_unwrap(data),
+                                      jnp.dtype(dtype) if dtype else None),
+                          indices, indptr, shape)
+    dense = onp.asarray(arg.asnumpy() if isinstance(arg, ndarray) else arg,
+                        dtype=dtype)
+    if dense.ndim != 2:
+        raise MXNetError("csr_matrix from dense needs a 2-D array")
+    indptr = [0]
+    cols, vals = [], []
+    for row in dense:
+        nz = onp.nonzero(row)[0]
+        cols.extend(nz.tolist())
+        vals.extend(row[nz].tolist())
+        indptr.append(len(cols))
+    return CSRNDArray(jnp.asarray(onp.asarray(vals, dense.dtype)),
+                      onp.asarray(cols, onp.int32),
+                      onp.asarray(indptr, onp.int32), dense.shape)
+
+
+def cast_storage(arr, stype: str):
+    """reference src/operator/tensor/cast_storage.cc."""
+    current = stype_of(arr)
+    if current == stype:
+        return arr
+    if stype == "default":
+        return arr.tostype("default")
+    if current == "default":
+        if stype == "row_sparse":
+            return row_sparse_array(arr)
+        if stype == "csr":
+            return csr_matrix(arr)
+    elif current == "row_sparse" and stype == "csr":
+        return csr_matrix(arr.tostype("default"))
+    elif current == "csr" and stype == "row_sparse":
+        return row_sparse_array(arr.tostype("default"))
+    raise MXNetError(f"cast_storage {current} -> {stype} not supported")
+
+
+def retain(arr: RowSparseNDArray, row_ids) -> RowSparseNDArray:
+    if stype_of(arr) != "row_sparse":
+        raise MXNetError("retain expects a row_sparse array")
+    return arr.retain(row_ids)
+
+
+def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    """Sparse-aware dot (reference src/operator/tensor/dot.cc sparse
+    kernels). csr x dense and row_sparse^T x dense lower to
+    gather/segment-sum — dense MXU work on just the nnz rows."""
+    ls, rs = stype_of(lhs), stype_of(rhs)
+    if ls == "csr" and rs == "default":
+        rhs_v = _unwrap(rhs)
+        if transpose_b:
+            raise MXNetError("sparse.dot: transpose_b unsupported for csr lhs")
+        expect = lhs.shape[0] if transpose_a else lhs.shape[1]
+        if rhs_v.shape[0] != expect:
+            raise MXNetError(
+                f"sparse.dot: contraction mismatch csr{lhs.shape}"
+                f"{'^T' if transpose_a else ''} x dense{rhs_v.shape}")
+        if transpose_a:
+            # (csr^T @ dense): scatter-add rows of rhs into column slots
+            out = jnp.zeros((lhs.shape[1], rhs_v.shape[1]), rhs_v.dtype)
+            contrib = lhs._values[:, None] * rhs_v[lhs._row_ids()]
+            return _wrap(out.at[lhs._indices].add(contrib))
+        # row-major gather: out[i] = sum_k csr[i,k] * rhs[k]
+        gathered = rhs_v[lhs._indices] * lhs._values[:, None]
+        out = jax.ops.segment_sum(gathered, lhs._row_ids(),
+                                  num_segments=lhs.shape[0])
+        return _wrap(out)
+    if ls == "row_sparse" and rs == "default" and transpose_a:
+        # rs^T @ dense — the embedding-gradient pattern
+        lhs = lhs.consolidate()
+        rhs_v = _unwrap(rhs)
+        # values (nnz, R) x gathered rhs rows (nnz, C) -> (R, C)
+        return _wrap(jnp.einsum("nr,nc->rc", lhs._values.astype(rhs_v.dtype),
+                                rhs_v[lhs._indices]))
+    if ls == "default" and rs == "default":
+        import jax.numpy as _jnp
+
+        return _wrap(_jnp.dot(_unwrap(lhs).T if transpose_a else _unwrap(lhs),
+                              _unwrap(rhs).T if transpose_b else _unwrap(rhs)))
+    raise MXNetError(f"sparse dot: unsupported stypes ({ls}, {rs})")
+
+
+def add(lhs, rhs):
+    """Elementwise add with sparse-storage awareness."""
+    if stype_of(lhs) == "row_sparse" and stype_of(rhs) == "row_sparse":
+        return (lhs + rhs).consolidate()
+    if stype_of(lhs) == "row_sparse":
+        return _wrap(lhs + _unwrap(rhs))
+    if stype_of(rhs) == "row_sparse":
+        return _wrap(rhs + _unwrap(lhs))
+    return _wrap(_unwrap(lhs) + _unwrap(rhs))

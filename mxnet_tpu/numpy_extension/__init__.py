@@ -188,6 +188,49 @@ def dropout(x, p=0.5, axes=None, mode="training"):
 
 
 def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_grad=False):
+    """reference src/operator/tensor/indexing_op.cc Embedding.
+
+    With ``sparse_grad=True`` the weight cotangent is emitted as a
+    row_sparse array holding only the looked-up rows (reference
+    EmbeddingOpBackward's kRowSparseStorage output) — on TPU that means
+    the backward touches nnz rows of HBM instead of the whole vocab, and
+    lazy optimizers update just those rows. Applies on the eager tape
+    only; under jit tracing the dense scatter-add path is used (XLA fuses
+    it) exactly like the reference's symbolic mode.
+    """
+    if sparse_grad:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import ndarray as _ndarr, _unwrap, _wrap
+        from ..ndarray.sparse import RowSparseNDArray
+        from ..ops.dispatch import TapeNode, _tracks_grad, autograd_state
+
+        state = autograd_state
+        ids_val = _unwrap(data)
+        w_val = _unwrap(weight)
+        traced = isinstance(ids_val, jax.core.Tracer) or isinstance(
+            w_val, jax.core.Tracer)
+        # the sparse cotangent can only be routed to a grad LEAF — a
+        # tape-produced weight would feed the RowSparse ct into an
+        # upstream jax.vjp pullback that only understands dense arrays
+        if (state.recording and state.tape is not None and not traced
+                and isinstance(weight, _ndarr)
+                and id(weight) not in state.tape.producer
+                and getattr(weight, "_grad_req", "null") != "null"
+                and weight._grad is not None):
+            ids32 = ids_val.astype(jnp.int32)
+            out = _wrap(jnp.take(w_val, ids32, axis=0))
+            ids_flat = ids32.reshape(-1)
+
+            def vjp_fn(ct):
+                vals = jnp.reshape(ct, (-1,) + tuple(w_val.shape[1:]))
+                return (RowSparseNDArray(vals, ids_flat, w_val.shape),)
+
+            node = TapeNode(vjp_fn, [weight], 1, "Embedding",
+                            out_avals=[(out.shape, out.dtype)])
+            state.tape.add(node, (out,))
+            return out
     return _call(lambda i, w: _nn.embedding(i, w), (data, weight), name="Embedding")
 
 

@@ -241,15 +241,24 @@ def backward(
     cots: dict = {}
     leaf_grads: dict = {}  # id(leaf ndarray) -> accumulated cotangent
 
+    from ..ndarray.sparse import RowSparseNDArray
+
+    def _acc(prev, ct):
+        if prev is None:
+            return ct
+        if isinstance(ct, RowSparseNDArray):
+            return ct + prev  # sparse+sparse concat; sparse+dense -> dense
+        if isinstance(prev, RowSparseNDArray):
+            return prev + ct
+        return prev + ct
+
     def _route(arr, ct):
         key = id(arr)
         if key in tape.producer:
             cots_key = tape.producer[key]
-            prev = cots.get(cots_key)
-            cots[cots_key] = ct if prev is None else prev + ct
+            cots[cots_key] = _acc(cots.get(cots_key), ct)
         if getattr(arr, "_grad_req", "null") != "null" and arr._grad is not None:
-            prev = leaf_grads.get(key)
-            leaf_grads[key] = ct if prev is None else prev + ct
+            leaf_grads[key] = _acc(leaf_grads.get(key), ct)
             leaf_grads.setdefault(("arr", key), arr)
 
     if head_grads is None:
@@ -282,15 +291,31 @@ def backward(
             node.vjp_fn = None  # free residuals eagerly
             node.replay_fn = None
 
-    # write leaf grads honoring grad_req
+    # write leaf grads honoring grad_req (and grad storage type)
     for key, ct in list(leaf_grads.items()):
         if isinstance(key, tuple):
             continue
         arr = leaf_grads[("arr", key)]
-        if arr._grad_req == "add":
-            arr._grad._data = arr._grad._data + ct.astype(arr._grad.dtype)
-        else:  # write
-            arr._grad._data = ct.astype(arr._grad.dtype)
+        g = arr._grad
+        if isinstance(g, RowSparseNDArray):
+            # sparse grad storage: keep only touched rows
+            if not isinstance(ct, RowSparseNDArray):
+                # dense cotangent into a sparse slot (e.g. tied weights used
+                # densely elsewhere): represent as all-rows sparse
+                ct = RowSparseNDArray(
+                    ct, jnp.arange(ct.shape[0], dtype=jnp.int32), g.shape)
+            if arr._grad_req == "add" and g.nnz:
+                ct = g + ct
+            ct = ct.consolidate()
+            g._values = ct._values.astype(g._values.dtype)
+            g._indices = ct._indices
+        else:
+            if isinstance(ct, RowSparseNDArray):
+                ct = ct.todense_val()
+            if arr._grad_req == "add":
+                g._data = g._data + ct.astype(g.dtype)
+            else:  # write
+                g._data = ct.astype(g.dtype)
 
     if not retain_graph:
         tape.nodes.clear()

@@ -152,6 +152,12 @@ class Optimizer:
             self._apply_one(i, w, g, s, lr, wd, t)
 
     def _apply_one(self, i, w, g, s, lr, wd, t):
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(g, RowSparseNDArray):
+            if self._apply_one_row_sparse(i, w, g, s, lr, wd, t):
+                return
+            g = g.todense_val()  # fall back to the dense rule
         g_val = self._preprocess_grad(_unwrap(g))
         s = s if isinstance(s, tuple) else ((s,) if s is not None and s != () else ())
         if (
@@ -181,6 +187,42 @@ class Optimizer:
                 else tuple(out[1:]),
             )
 
+    def _apply_one_row_sparse(self, i, w, g, s, lr, wd, t) -> bool:
+        """Lazy row-sparse update: run the optimizer rule on just the rows
+        present in the gradient (reference optimizer lazy_update semantics —
+        sgd.py `lazy_update`, `_sparse_adam_update`: momentum/decay for
+        untouched rows is deferred, which is the documented approximation).
+
+        Returns False when this optimizer/config can't do a row update
+        (no ``lazy_update`` flag, multi-precision, or a state component
+        whose shape doesn't match the weight) — caller densifies.
+        """
+        if not getattr(self, "lazy_update", False):
+            return False
+        s = s if isinstance(s, tuple) else ((s,) if s is not None and s != () else ())
+        w_val = _unwrap(w)
+        if self.multi_precision and w.dtype in (onp.float16, jnp.bfloat16):
+            return False
+        s_vals = tuple(_unwrap(x) for x in s)
+        if not all(hasattr(sv, "shape") and tuple(sv.shape) == tuple(w_val.shape)
+                   for sv in s_vals):
+            return False
+        g = g.consolidate()
+        if g.nnz == 0:
+            # nothing touched — but Trainer still reads _latest_states[i]
+            self._store_state(i, s_vals)
+            return True
+        rows = g._indices
+        g_rows = self._preprocess_grad(g._values.astype(w_val.dtype))
+        w_rows = w_val[rows]
+        s_rows = tuple(sv[rows] for sv in s_vals)
+        out = self.update_step(w_rows, g_rows, s_rows, lr, wd, t)
+        w._set_data(w_val.at[rows].set(out[0].astype(w_val.dtype)))
+        self._store_state(
+            i, tuple(sv.at[rows].set(ns.astype(sv.dtype))
+                     for sv, ns in zip(s_vals, out[1:])))
+        return True
+
     def update_multi_precision(self, index, weight, grad, state):
         self.update(index, weight, grad, state)
 
@@ -201,9 +243,11 @@ class SGD(Optimizer):
     """SGD + momentum + wd (reference optimizer/sgd.py; fused kernel
     src/operator/optimizer_op.cc sgd_mom_update)."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False, **kwargs):
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
+        # row-wise updates for row_sparse grads (reference sgd.py default)
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -336,10 +380,13 @@ class LARS(Optimizer):
 class Adam(Optimizer):
     """reference optimizer/adam.py (fused adam_update kernel)."""
 
-    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, correct_bias=True, **kwargs):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 correct_bias=True, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.correct_bias = correct_bias
+        # row-wise updates for row_sparse grads (reference adam.py lazy_update)
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         wv = _unwrap(weight)

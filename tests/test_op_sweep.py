@@ -254,16 +254,18 @@ def test_matmul_numeric_grad():
                               rtol=1e-2, atol=1e-3)
 
 
+@pytest.mark.seed(7)
 def test_fully_connected_numeric_grad():
     tu.check_numeric_gradient(
         lambda x, w, b: mx.npx.fully_connected(x, w, b, num_hidden=4),
-        [_any((2, 3)), _any((4, 3)), _any((4,))], rtol=1e-2, atol=1e-3)
+        [_any((2, 3)), _any((4, 3)), _any((4,))], rtol=2e-2, atol=2e-3)
 
 
+@pytest.mark.seed(7)
 def test_convolution_numeric_grad():
     tu.check_numeric_gradient(
         lambda x, w: mx.npx.convolution(x, w, kernel=(2, 2), num_filter=2),
-        [_any((1, 2, 4, 4)), _any((2, 2, 2, 2))], rtol=1.5e-2, atol=2e-3)
+        [_any((1, 2, 4, 4)), _any((2, 2, 2, 2))], rtol=2e-2, atol=2e-3)
 
 
 # -- consistency sweep (reference check_consistency :1428) -----------------

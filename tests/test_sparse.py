@@ -1,0 +1,281 @@
+"""Sparse storage + sparse gradients (reference ndarray.h:63-65
+row_sparse/CSR, indexing_op.cc EmbeddingOpBackward sparse output,
+optimizer lazy_update, sparse kvstore push/row_sparse_pull)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+
+def test_row_sparse_roundtrip():
+    dense = onp.zeros((6, 3), onp.float32)
+    dense[1] = 1.0
+    dense[4] = [1, 2, 3]
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert rs.nnz == 2
+    assert onp.array_equal(rs.indices.asnumpy(), [1, 4])
+    onp.testing.assert_allclose(rs.tostype("default").asnumpy(), dense)
+
+
+def test_row_sparse_from_values_indices_dedups():
+    rs = sparse.row_sparse_array(
+        (onp.ones((3, 2), onp.float32), [4, 1, 4]), shape=(6, 2))
+    assert rs.nnz == 2  # duplicate row 4 summed
+    dense = rs.tostype("default").asnumpy()
+    onp.testing.assert_allclose(dense[4], [2, 2])
+    onp.testing.assert_allclose(dense[1], [1, 1])
+
+
+def test_row_sparse_retain():
+    rs = sparse.row_sparse_array(
+        (onp.arange(6, dtype=onp.float32).reshape(3, 2), [0, 2, 4]),
+        shape=(6, 2))
+    kept = sparse.retain(rs, onp.array([2, 5]))
+    assert onp.array_equal(kept.indices.asnumpy(), [2])
+    onp.testing.assert_allclose(kept.data.asnumpy(), [[2, 3]])
+
+
+def test_csr_roundtrip_and_dot():
+    dense = onp.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], onp.float32)
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert csr.nnz == 3
+    onp.testing.assert_allclose(csr.tostype("default").asnumpy(), dense)
+    rhs = onp.random.randn(3, 4).astype(onp.float32)
+    out = sparse.dot(csr, mx.np.array(rhs))
+    onp.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5)
+    outT = sparse.dot(csr, mx.np.array(onp.random.randn(3, 4).astype(onp.float32)))
+    assert outT.shape == (3, 4)
+
+
+def test_csr_dot_transpose():
+    dense = onp.array([[0, 1, 0], [2, 0, 3]], onp.float32)
+    csr = sparse.csr_matrix(dense)
+    rhs = onp.random.randn(2, 5).astype(onp.float32)
+    out = sparse.dot(csr, mx.np.array(rhs), transpose_a=True)
+    onp.testing.assert_allclose(out.asnumpy(), dense.T @ rhs, rtol=1e-5)
+
+
+def test_cast_storage():
+    dense = onp.diag(onp.arange(1.0, 4.0)).astype(onp.float32)
+    d = mx.np.array(dense)
+    rs = sparse.cast_storage(d, "row_sparse")
+    assert rs.stype == "row_sparse"
+    csr = sparse.cast_storage(rs, "csr")
+    assert csr.stype == "csr"
+    back = sparse.cast_storage(csr, "default")
+    onp.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_embedding_sparse_grad_matches_dense():
+    vocab, dim = 20, 4
+    w_np = onp.random.randn(vocab, dim).astype(onp.float32)
+    ids = onp.array([[1, 3, 1], [7, 3, 0]], onp.int32)
+    head = onp.random.randn(2, 3, dim).astype(onp.float32)
+
+    # dense reference
+    wd = mx.np.array(w_np)
+    wd.attach_grad()
+    with autograd.record():
+        out_d = mx.npx.embedding(mx.np.array(ids), wd)
+    out_d.backward(mx.np.array(head))
+    dense_grad = wd.grad.asnumpy()
+
+    # sparse path
+    ws = mx.np.array(w_np)
+    ws.attach_grad(stype="row_sparse")
+    with autograd.record():
+        out_s = mx.npx.embedding(mx.np.array(ids), ws, sparse_grad=True)
+    out_s.backward(mx.np.array(head))
+    g = ws.grad
+    assert isinstance(g, RowSparseNDArray)
+    # only the looked-up rows are present
+    assert set(g.indices.asnumpy().tolist()) == {0, 1, 3, 7}
+    onp.testing.assert_allclose(g.tostype("default").asnumpy(), dense_grad,
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(out_s.asnumpy(), out_d.asnumpy())
+
+
+def test_embedding_sparse_grad_add_req():
+    w = mx.np.array(onp.zeros((10, 2), onp.float32))
+    w.attach_grad(grad_req="add", stype="row_sparse")
+    for _ in range(2):
+        with autograd.record():
+            out = mx.npx.embedding(mx.np.array(onp.array([1, 1, 5])), w,
+                                   sparse_grad=True)
+        out.backward()
+    g = w.grad
+    dense = g.tostype("default").asnumpy()
+    onp.testing.assert_allclose(dense[1], [4, 4])  # 2 lookups x 2 passes
+    onp.testing.assert_allclose(dense[5], [2, 2])
+    assert onp.all(dense[[0, 2, 3, 4, 6, 7, 8, 9]] == 0)
+
+
+def test_tied_weight_dense_plus_sparse_densifies():
+    """Embedding weight also used densely (tied LM head) — mixed sparse +
+    dense cotangents must still produce the correct total gradient."""
+    vocab, dim = 6, 3
+    w_np = onp.random.randn(vocab, dim).astype(onp.float32)
+    ids = onp.array([1, 4], onp.int32)
+
+    def loss_of(w, sparse_grad):
+        with autograd.record():
+            h = mx.npx.embedding(mx.np.array(ids), w, sparse_grad=sparse_grad)
+            logits = mx.np.matmul(h, w.T)
+            return mx.np.sum(logits * logits)
+
+    wd = mx.np.array(w_np)
+    wd.attach_grad()
+    loss_of(wd, False).backward()
+
+    ws = mx.np.array(w_np)
+    ws.attach_grad()  # dense grad slot: sparse ct must densify into it
+    loss_of(ws, True).backward()
+    onp.testing.assert_allclose(ws.grad.asnumpy(), wd.grad.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("optname,kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.0}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_lazy_update_touches_only_rows(optname, kwargs):
+    vocab, dim = 12, 3
+    w_np = onp.random.randn(vocab, dim).astype(onp.float32)
+    rows = onp.array([2, 5], onp.int32)
+    gvals = onp.random.randn(2, dim).astype(onp.float32)
+    grad = RowSparseNDArray(gvals, rows, (vocab, dim))
+
+    opt = mx.optimizer.create(optname, wd=0.01, **kwargs)
+    w = mx.np.array(w_np)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    new_w = w.asnumpy()
+    untouched = [i for i in range(vocab) if i not in rows.tolist()]
+    # lazy semantics: rows absent from the grad are NOT updated (no wd decay)
+    onp.testing.assert_allclose(new_w[untouched], w_np[untouched])
+    assert not onp.allclose(new_w[rows], w_np[rows])
+
+    # touched rows match the dense rule applied to those rows
+    opt2 = mx.optimizer.create(optname, wd=0.01, lazy_update=False, **kwargs)
+    w2 = mx.np.array(w_np)
+    state2 = opt2.create_state(0, w2)
+    opt2.update(0, w2, grad, state2)  # densified path
+    onp.testing.assert_allclose(new_w[rows], w2.asnumpy()[rows],
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_sparse_embedding_end_to_end():
+    """Embedding(sparse_grad=True) trains identically to dense (wd=0)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    vocab, dim = 16, 4
+    onp.random.seed(0)
+    ids_np = onp.array([[1, 2], [3, 1]], onp.int32)
+
+    w0 = onp.random.randn(vocab, dim).astype(onp.float32)
+
+    def build(sparse):
+        net = nn.Embedding(vocab, dim, sparse_grad=sparse)
+        net.initialize()
+        net.weight.set_data(mx.np.array(w0))
+        return net
+
+    results = {}
+    for sparse in (False, True):
+        net = build(sparse)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.5, "momentum": 0.9})
+        for step in range(3):
+            with autograd.record():
+                out = net(mx.np.array(ids_np))
+                loss = mx.np.sum(out * out)
+            loss.backward()
+            if sparse:
+                assert isinstance(net.weight.grad(), RowSparseNDArray)
+            trainer.step(1)
+        results[sparse] = net.weight.data().asnumpy()
+    onp.testing.assert_allclose(results[True], results[False],
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_kvstore_sparse_push_and_row_sparse_pull():
+    kv = mx.kv.create("local")
+    shape = (8, 2)
+    kv.init(3, mx.np.zeros(shape))
+    g1 = RowSparseNDArray(onp.ones((2, 2), onp.float32), [1, 3], shape)
+    g2 = RowSparseNDArray(onp.ones((2, 2), onp.float32) * 2, [3, 6], shape)
+    kv.push(3, [g1, g2])
+    out = mx.np.zeros(shape)
+    kv.pull(3, out=out)
+    dense = out.asnumpy()
+    onp.testing.assert_allclose(dense[1], [1, 1])
+    onp.testing.assert_allclose(dense[3], [3, 3])
+    onp.testing.assert_allclose(dense[6], [2, 2])
+    assert onp.all(dense[[0, 2, 4, 5, 7]] == 0)
+
+    # row_sparse_pull only materializes requested rows
+    kv2 = mx.kv.create("local")
+    w0 = onp.random.randn(*shape).astype(onp.float32)
+    kv2.init("w", mx.np.array(w0))
+    out2 = mx.np.zeros(shape)
+    kv2.row_sparse_pull("w", out=out2, row_ids=mx.np.array(onp.array([2, 5])))
+    res = out2.asnumpy()
+    onp.testing.assert_allclose(res[[2, 5]], w0[[2, 5]], rtol=1e-6)
+    assert onp.all(res[[0, 1, 3, 4, 6, 7]] == 0)
+
+
+def test_sparse_grad_nonleaf_weight_falls_back_dense():
+    """A tape-produced (non-leaf) weight can't take a sparse cotangent —
+    the op must fall back to the dense vjp path."""
+    w = mx.np.array(onp.random.randn(6, 2).astype(onp.float32))
+    w.attach_grad()
+    with autograd.record():
+        w2 = w * 1.0  # non-leaf
+        out = mx.npx.embedding(mx.np.array(onp.array([1, 4])), w2,
+                               sparse_grad=True)
+    out.backward()  # must not crash
+    g = w.grad.asnumpy()
+    assert g[1].sum() != 0 and g[4].sum() != 0
+    assert onp.all(g[[0, 2, 3, 5]] == 0)
+
+
+def test_trainer_step_with_empty_sparse_grad():
+    """trainer.step before/without touching the embedding must not crash."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Embedding(8, 2, sparse_grad=True)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(1, ignore_stale_grad=True)
+    onp.testing.assert_allclose(net.weight.data().asnumpy(), w_before)
+
+
+def test_sparse_dot_rejects_bad_shapes():
+    csr = sparse.csr_matrix(onp.eye(3, dtype=onp.float32))
+    with pytest.raises(mx.MXNetError):
+        sparse.dot(csr, mx.np.zeros((4, 2)))
+    with pytest.raises(mx.MXNetError):
+        sparse.dot(csr, mx.np.zeros((3, 2)), transpose_b=True)
+
+
+def test_zero_grad_sparse():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Embedding(8, 2, sparse_grad=True)
+    net.initialize()
+    with autograd.record():
+        out = net(mx.np.array(onp.array([1, 2])))
+    out.backward()
+    assert net.weight.grad().nnz > 0
+    net.zero_grad()
+    assert net.weight.grad().nnz == 0
