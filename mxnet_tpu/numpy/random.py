@@ -30,9 +30,14 @@ __all__ = [
 
 class _RNG(threading.local):
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        # LAZY: creating a PRNGKey initializes the XLA backend, and module
+        # import must not — jax.distributed.initialize() (multi-process
+        # bootstrap, parallel/dist.py) has to run before any backend init
+        self.key = None
 
     def next_key(self):
+        if self.key is None:
+            self.key = jax.random.PRNGKey(0)
         self.key, sub = jax.random.split(self.key)
         return sub
 

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Cluster launcher (reference ``tools/launch.py:29`` → dmlc-tracker).
+
+The reference delegated to the dmlc-tracker to start scheduler/server/
+worker processes over ssh/mpi/sge/yarn/local and export the DMLC_* env
+protocol. On TPU there are no server/scheduler roles — every process is a
+worker and ``jax.distributed.initialize`` replaces the tracker rendezvous
+(mxnet_tpu.parallel.dist consumes the same DMLC_* variables), so this
+launcher only needs to spawn N worker processes with:
+
+    DMLC_ROLE=worker  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT (coordinator)
+    DMLC_NUM_WORKER=N DMLC_WORKER_ID=i
+
+Usage (same CLI shape as the reference):
+    python tools/launch.py -n 4 [--launcher local] python train.py ...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_local(args, command) -> int:
+    port = args.port or find_free_port()
+    procs = []
+    for i in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": args.host,
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": str(args.num_servers),
+            "DMLC_WORKER_ID": str(i),
+        })
+        procs.append(subprocess.Popen(command, env=env))
+    rc = 0
+    try:
+        for p in procs:
+            p.wait(timeout=args.timeout)
+            rc = rc or p.returncode
+    except subprocess.TimeoutExpired:
+        rc = 124
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return rc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job (local launcher).")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-CLI parity; the TPU "
+                         "backend has no server role (in-graph allreduce)")
+    ap.add_argument("--launcher", default="local",
+                    choices=["local"],
+                    help="only 'local' is supported; multi-host pods use "
+                         "the cloud provider's pod launcher + "
+                         "mxnet_tpu.parallel.dist.initialize()")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="coordinator host for the rendezvous")
+    ap.add_argument("-p", "--port", type=int, default=None,
+                    help="coordinator port (default: pick a free one)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="per-process wait timeout in seconds")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="the training command to launch")
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    command = args.command
+    if command[0] == "--":
+        command = command[1:]
+    return launch_local(args, command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
