@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Per-operator latency harness (reference ``benchmark/opperf/opperf.py``).
+
+Measures forward and forward+backward wall time per op on the current
+device and emits the reference README's result schema:
+
+    {"op_name": [{"avg_time_forward_<op>": ms, "avg_time_backward_<op>": ms,
+                  "inputs": {...}}], ...}
+
+TPU-native notes: each op is timed as a jitted XLA executable (compile
+excluded via warmup) with a blocking fetch per iteration — the honest
+per-dispatch latency, matching how the reference timed engine-pushed
+kernels with MXNET_ENGINE_TYPE=NaiveEngine. Backward times jit(grad) of a
+sum-projected scalar.
+
+CLI:
+    python benchmark/opperf/opperf.py [--output out.json] [--ops add,dot]
+                                      [--warmup 5] [--runs 25] [--cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as onp
+
+
+def _op_specs():
+    """(name, fn(jnp, inputs)->out, input shapes, differentiable)."""
+    specs = []
+
+    def add(name, fn, shapes, diff=True):
+        specs.append((name, fn, shapes, diff))
+
+    L = (1024, 1024)
+    add("add", lambda jnp, a, b: a + b, [L, L])
+    add("multiply", lambda jnp, a, b: a * b, [L, L])
+    add("exp", lambda jnp, a: jnp.exp(a), [L])
+    add("tanh", lambda jnp, a: jnp.tanh(a), [L])
+    add("sigmoid", lambda jnp, a: 1 / (1 + jnp.exp(-a)), [L])
+    add("sum", lambda jnp, a: jnp.sum(a), [L])
+    add("mean_axis", lambda jnp, a: jnp.mean(a, axis=1), [L])
+    add("dot", lambda jnp, a, b: jnp.dot(a, b), [L, L])
+    add("batch_dot", lambda jnp, a, b: jnp.matmul(a, b),
+        [(32, 256, 256), (32, 256, 256)])
+    add("transpose", lambda jnp, a: jnp.transpose(a), [L])
+    add("softmax", lambda jnp, a: __import__("jax").nn.softmax(a, axis=-1), [L])
+    add("log_softmax",
+        lambda jnp, a: __import__("jax").nn.log_softmax(a, axis=-1), [L])
+    add("relu", lambda jnp, a: jnp.maximum(a, 0), [L])
+    add("layer_norm",
+        lambda jnp, a: (a - a.mean(-1, keepdims=True))
+        / jnp.sqrt(a.var(-1, keepdims=True) + 1e-5), [L])
+    add("conv2d",
+        lambda jnp, x, w: __import__("jax").lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW")),
+        [(32, 64, 56, 56), (64, 64, 3, 3)])
+    add("embedding_take", lambda jnp, w, i: jnp.take(w, i, axis=0),
+        [(50000, 512), None], diff=False)
+    add("argsort", lambda jnp, a: jnp.argsort(a, axis=-1), [(1024, 256)],
+        diff=False)
+    add("cumsum", lambda jnp, a: jnp.cumsum(a, axis=-1), [L])
+    return specs
+
+
+def bench_op(name, fn, shapes, diff, warmup, runs):
+    import jax
+    import jax.numpy as jnp
+
+    rng = onp.random.RandomState(0)
+    args = []
+    for s in shapes:
+        if s is None:  # integer index input (embedding)
+            args.append(jnp.asarray(
+                rng.randint(0, 50000, size=(32, 128)), jnp.int32))
+        else:
+            args.append(jnp.asarray(rng.randn(*s).astype(onp.float32)))
+
+    fwd = jax.jit(lambda *a: fn(jnp, *a))
+    out = fwd(*args)
+    jax.block_until_ready(out)  # compile
+    for _ in range(warmup):
+        out = fwd(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = fwd(*args)
+        jax.block_until_ready(out)
+    fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+
+    result = {f"avg_time_forward_{name}": round(fwd_ms, 4),
+              "inputs": {f"arg{i}": list(a.shape) for i, a in enumerate(args)}}
+
+    if diff:
+        float_idx = [i for i, a in enumerate(args)
+                     if jnp.issubdtype(a.dtype, jnp.floating)]
+
+        def loss(*fargs):
+            full = list(args)
+            for i, v in zip(float_idx, fargs):
+                full[i] = v
+            return jnp.sum(fn(jnp, *full))
+
+        bwd = jax.jit(jax.grad(loss, argnums=tuple(range(len(float_idx)))))
+        g = bwd(*[args[i] for i in float_idx])
+        jax.block_until_ready(g)
+        for _ in range(warmup):
+            g = bwd(*[args[i] for i in float_idx])
+        jax.block_until_ready(g)
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            g = bwd(*[args[i] for i in float_idx])
+            jax.block_until_ready(g)
+        result[f"avg_time_backward_{name}"] = round(
+            (time.perf_counter() - t0) / runs * 1e3, 4)
+    return result
+
+
+def run_benchmark(ops=None, warmup=5, runs=25, log=print):
+    import jax
+
+    results = {"_meta": {"device": str(jax.devices()[0]),
+                         "platform": jax.devices()[0].platform,
+                         "warmup": warmup, "runs": runs}}
+    for name, fn, shapes, diff in _op_specs():
+        if ops and name not in ops:
+            continue
+        try:
+            results[name] = [bench_op(name, fn, shapes, diff, warmup, runs)]
+            log(f"{name}: {results[name][0]}")
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            results[name] = [{"error": repr(e)}]
+            log(f"{name}: ERROR {e!r}")
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--output", default=None)
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset of op names")
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--runs", type=int, default=25)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU platform")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    ops = set(args.ops.split(",")) if args.ops else None
+    results = run_benchmark(ops, args.warmup, args.runs,
+                            log=lambda m: print(m, file=sys.stderr))
+    text = json.dumps(results, indent=1)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    print(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""opperf + bandwidth harness smoke tests (reference benchmark/opperf +
+tools/bandwidth README schemas)."""
+import numpy as onp
+
+
+def test_opperf_schema():
+    import sys
+    sys.path.insert(0, "benchmark/opperf")
+    from benchmark.opperf.opperf import run_benchmark
+
+    res = run_benchmark(ops={"add", "dot"}, warmup=1, runs=2,
+                        log=lambda m: None)
+    assert "_meta" in res and res["_meta"]["runs"] == 2
+    for op in ("add", "dot"):
+        row = res[op][0]
+        assert row[f"avg_time_forward_{op}"] > 0
+        assert row[f"avg_time_backward_{op}"] > 0
+        assert "inputs" in row
+
+
+def test_bandwidth_schema():
+    from tools.bandwidth.measure import measure
+
+    res = measure([0.5], runs=2, log=lambda m: None)
+    assert res["_meta"]["n_devices"] >= 1
+    ar = res["allreduce"][0]
+    assert ar["algbw_GBps"] > 0 and ar["busbw_GBps"] > 0
+    ag = res["all_gather"][0]
+    assert ag["algbw_GBps"] > 0
+    # allreduce must produce the true cross-device sum: spot-check
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    mesh = Mesh(onp.array(devs), ("dp",))
+    x = jax.device_put(jnp.arange(len(devs) * 4, dtype=jnp.float32),
+                       NamedSharding(mesh, P("dp")))
+    out = jax.jit(jax.shard_map(lambda s: jax.lax.psum(s, "dp"),
+                                mesh=mesh, in_specs=P("dp"),
+                                out_specs=P("dp")))(x)
+    expected = onp.arange(len(devs) * 4, dtype=onp.float32).reshape(
+        len(devs), 4).sum(0)
+    onp.testing.assert_allclose(onp.asarray(out)[:4], expected)

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Collective-bandwidth harness (reference ``tools/bandwidth/measure.py``,
+README schema: per-kvstore-type comm bandwidth per batch).
+
+The reference measured ps-lite/NCCL push-pull bandwidth between GPUs and
+servers. The TPU equivalent is XLA collective bandwidth over the device
+mesh (ICI on hardware, host memory on the virtual CPU mesh): for each
+payload size, time an in-graph ``psum`` (allreduce) and ``all_gather``
+across all devices and report algorithmic bandwidth
+
+    algbw  = payload_bytes / time
+    busbw  = algbw * 2 * (n-1) / n          (ring-allreduce bus bandwidth)
+
+CLI:
+    python tools/bandwidth/measure.py [--sizes-mb 1,4,16,64] [--runs 10]
+                                      [--cpu-devices 8] [--output out.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def measure(sizes_mb, runs=10, log=print):
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(onp.array(devs), ("dp",))
+    results = {"_meta": {"n_devices": n, "platform": devs[0].platform,
+                         "runs": runs}, "allreduce": [], "all_gather": []}
+
+    for mb in sizes_mb:
+        elems = int(mb * 1024 * 1024 // 4)
+        elems = max(n, elems - elems % n)
+        x = jnp.asarray(onp.random.randn(elems).astype(onp.float32))
+        x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+        @jax.jit
+        def allreduce(a):
+            return jax.shard_map(
+                lambda s: jax.lax.psum(s, "dp"),
+                mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(a)
+
+        @jax.jit
+        def allgather(a):
+            return jax.shard_map(
+                lambda s: jax.lax.all_gather(s, "dp", tiled=True),
+                mesh=mesh, in_specs=P("dp"), out_specs=P(),
+                check_vma=False)(a)
+
+        for name, fn, coll in (("allreduce", allreduce, "allreduce"),
+                               ("all_gather", allgather, "all_gather")):
+            out = fn(x)
+            jax.block_until_ready(out)  # compile
+            t0 = time.perf_counter()
+            for _ in range(runs):
+                out = fn(x)
+                jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / runs
+            payload = elems * 4
+            algbw = payload / dt / 1e9
+            row = {"size_mb": round(payload / 1e6, 2),
+                   "time_ms": round(dt * 1e3, 3),
+                   "algbw_GBps": round(algbw, 3)}
+            if coll == "allreduce":
+                row["busbw_GBps"] = round(algbw * 2 * (n - 1) / n, 3)
+            results[name].append(row)
+            log(f"{name} {mb}MB: {row}")
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-mb", default="1,4,16,64")
+    ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force a virtual CPU mesh with N devices")
+    ap.add_argument("--output", default=None)
+    args = ap.parse_args()
+    if args.cpu_devices:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count"
+                        f"={args.cpu_devices}").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    sizes = [float(s) for s in args.sizes_mb.split(",")]
+    results = measure(sizes, args.runs,
+                      log=lambda m: print(m, file=sys.stderr))
+    text = json.dumps(results, indent=1)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    print(text)
+
+
+if __name__ == "__main__":
+    main()
