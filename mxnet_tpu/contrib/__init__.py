@@ -1,0 +1,3 @@
+"""``mx.contrib`` — experimental / auxiliary subsystems
+(reference ``python/mxnet/contrib/``)."""
+from . import quantization  # noqa: F401

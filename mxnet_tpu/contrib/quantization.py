@@ -1,0 +1,304 @@
+"""INT8 post-training quantization (reference
+``python/mxnet/contrib/quantization.py``: ``_quantize_symbol :82``,
+``quantize_model`` with calib modes none/naive/entropy ``:460-490``;
+entropy calibration kernel ``src/operator/quantization/calibrate.cc``).
+
+TPU-native design: the reference rewrote the symbol graph inserting
+``quantize``/``dequantize``/int8 kernel nodes (MKLDNN/cuDNN int8). Here
+quantization is a *Block transform*: ``quantize_net`` walks a Gluon net
+and swaps Dense/Conv children for quantized wrappers that
+
+- hold int8 weights with per-output-channel symmetric scales,
+- quantize activations with a per-tensor scale (calibrated, or dynamic
+  max-abs when ``calib_mode='none'``),
+- run the Dense contraction as a true int8 x int8 -> int32 ``dot_general``
+  (XLA lowers this to the MXU's 8-bit path on TPU), dequantizing once at
+  the end; convs use quantize-dequantize simulation (int8 conv layouts
+  are MKLDNN-specific in the reference; on TPU the matmul is where int8
+  pays off).
+
+Calibration (reference quantize_model calib_mode semantics):
+- ``'none'``   — dynamic: activation scale computed from each batch.
+- ``'naive'``  — min/max over the calibration set.
+- ``'entropy'``— KL-divergence-optimal threshold over an activation
+  histogram (calibrate.cc:GetOptimalThreshold re-designed in numpy).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock as _HybridBlock
+from ..ndarray.ndarray import ndarray, _unwrap, _wrap
+
+__all__ = ["quantize_net", "quantize_model", "CalibrationCollector",
+           "optimal_threshold_kl", "QuantizedDense", "QuantizedConv"]
+
+
+def _max_abs(x) -> float:
+    return float(jnp.max(jnp.abs(x)))
+
+
+def optimal_threshold_kl(hist: onp.ndarray, edges: onp.ndarray,
+                         num_quantized_bins: int = 255) -> float:
+    """KL-optimal |x| clipping threshold from a histogram of |activations|
+    (reference src/operator/quantization/calibrate.cc GetOptimalThreshold).
+
+    Searches candidate thresholds; for each, the clipped reference
+    distribution P is compared with its ``num_quantized_bins``-bucket
+    quantization Q; returns the threshold minimizing KL(P||Q).
+    """
+    num_bins = hist.size
+    if num_bins < num_quantized_bins + 1:
+        return float(edges[-1])
+    best_kl, best_t = onp.inf, float(edges[-1])
+    if hist.sum() == 0:
+        return best_t
+
+    def smooth(dist, eps=1e-4):
+        """calibrate.cc SmoothDistribution: move eps mass to zero bins."""
+        is_zero = dist == 0
+        n_zero = int(is_zero.sum())
+        n_nonzero = dist.size - n_zero
+        if n_nonzero == 0:
+            return None
+        eps1 = eps * n_zero / n_nonzero
+        if eps1 >= 1.0:
+            return None
+        out = dist.astype(onp.float64).copy()
+        out[is_zero] = eps
+        out[~is_zero] -= eps1 * out[~is_zero]
+        return out
+
+    for i in range(num_quantized_bins, num_bins + 1):
+        sliced = hist[:i].astype(onp.float64)
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()  # clipped outliers fold into the last bin
+        # quantize the kept range into num_quantized_bins buckets, spreading
+        # each bucket's mass uniformly over its non-empty source bins
+        num_merged = i // num_quantized_bins
+        q = onp.zeros(i)
+        for j in range(num_quantized_bins):
+            start = j * num_merged
+            stop = i if j == num_quantized_bins - 1 else (j + 1) * num_merged
+            seg = sliced[start:stop]
+            nz = int((seg != 0).sum())
+            if nz:
+                q[start:stop] = onp.where(seg != 0, seg.sum() / nz, 0)
+        p_s, q_s = smooth(p), smooth(q)
+        if p_s is None or q_s is None:
+            continue
+        p_s /= p_s.sum()
+        q_s /= q_s.sum()
+        kl = float((p_s * onp.log(p_s / q_s)).sum())
+        if kl < best_kl:
+            best_kl, best_t = kl, float(edges[min(i, num_bins)])
+    return best_t
+
+
+class CalibrationCollector:
+    """Per-layer activation statistics (reference _LayerOutputCollector /
+    _LayerOutputMinMaxCollector, quantization.py:260-330)."""
+
+    def __init__(self, mode: str = "naive", num_bins: int = 2048):
+        self.mode = mode
+        self.num_bins = num_bins
+        self.max_abs: dict = {}
+        self.hists: dict = {}
+        self.edges: dict = {}
+
+    def collect(self, name: str, x) -> None:
+        a = onp.abs(onp.asarray(_unwrap(x), onp.float32))
+        m = float(a.max()) if a.size else 0.0
+        self.max_abs[name] = max(self.max_abs.get(name, 0.0), m)
+        if self.mode == "entropy":
+            hist, edges = onp.histogram(
+                a, bins=self.num_bins, range=(0, self.max_abs[name] or 1e-8))
+            if name in self.hists and self.hists[name].size == hist.size:
+                self.hists[name] = self.hists[name] + hist
+            else:
+                self.hists[name] = hist
+            self.edges[name] = edges
+
+    def threshold(self, name: str) -> float:
+        if self.mode == "entropy" and name in self.hists:
+            return optimal_threshold_kl(self.hists[name], self.edges[name])
+        return self.max_abs.get(name, 1.0) or 1e-8
+
+
+def _quantize_weight_per_channel(w: onp.ndarray,
+                                 channel_axis: int = 0
+                                 ) -> Tuple[onp.ndarray, onp.ndarray]:
+    """Symmetric per-output-channel int8 weights (reference
+    quantize_graph per-channel weight quantization)."""
+    axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+    scale = onp.abs(w).max(axis=axes, keepdims=True) / 127.0
+    scale = onp.where(scale == 0, 1e-8, scale)
+    wq = onp.clip(onp.rint(w / scale), -127, 127).astype(onp.int8)
+    return wq, scale.astype(onp.float32)
+
+
+class _QuantizedBase:
+    """Shared activation-quantization plumbing (mixed into HybridBlocks so
+    wrappers slot into Block._children and Sequential forward)."""
+
+    def _init_q(self, name: str, collector: Optional[CalibrationCollector]):
+        self._qname = name
+        self._collector = collector  # non-None => calibration pass
+        self._act_scale: Optional[float] = None  # frozen after calibration
+
+    def _act_qparams(self, x_val):
+        if self._collector is not None:
+            self._collector.collect(self._qname, x_val)
+            return None  # calibration pass runs in float
+        if self._act_scale is not None:
+            return self._act_scale
+        return _max_abs(x_val) / 127.0  # dynamic (calib_mode='none')
+
+    def freeze(self, collector: CalibrationCollector):
+        self._act_scale = collector.threshold(self._qname) / 127.0
+        self._collector = None
+
+
+class QuantizedDense(_HybridBlock, _QuantizedBase):
+    """Int8 Dense: true int8 x int8 -> int32 dot_general on the MXU
+    (reference quantized_fully_connected.cc)."""
+
+    def __init__(self, dense, name: str,
+                 collector: Optional[CalibrationCollector] = None):
+        _HybridBlock.__init__(self)
+        self._init_q(name, collector)
+        self._orig = dense
+        w = onp.asarray(_unwrap(dense.weight.data()), onp.float32)
+        self._wq, self._wscale = _quantize_weight_per_channel(w, 0)
+        self._bias = (onp.asarray(_unwrap(dense.bias.data()), onp.float32)
+                      if dense.bias is not None else None)
+        self._flatten = dense._flatten
+        self.act = dense.act
+
+    def forward(self, x):
+        from ..numpy_extension import activation as npx_activation
+
+        x_val = _unwrap(x)
+        if self._flatten and x_val.ndim > 2:
+            x_val = x_val.reshape(x_val.shape[0], -1)
+        s_x = self._act_qparams(x_val)
+        if s_x is None:  # calibration: float forward
+            out = x_val @ (self._wq.astype(onp.float32)
+                           * self._wscale).T
+        else:
+            xq = jnp.clip(jnp.rint(x_val / s_x), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, jnp.asarray(self._wq),
+                (((xq.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (
+                jnp.asarray(self._wscale[:, 0]) * s_x)
+        if self._bias is not None:
+            out = out + self._bias
+        out = _wrap(out.astype(jnp.float32))
+        if self.act is not None:
+            out = npx_activation(out, act_type=self.act)
+        return out
+
+
+class QuantizedConv(_HybridBlock, _QuantizedBase):
+    """Quantize-dequantize conv (fake-quant int8 simulation; the accuracy
+    contract of reference quantized_conv.cc without MKLDNN's layouts)."""
+
+    def __init__(self, conv, name: str,
+                 collector: Optional[CalibrationCollector] = None):
+        _HybridBlock.__init__(self)
+        self._init_q(name, collector)
+        self._orig = conv
+        w = onp.asarray(_unwrap(conv.weight.data()), onp.float32)
+        self._wq, self._wscale = _quantize_weight_per_channel(w, 0)
+
+    def forward(self, x):
+        x_val = _unwrap(x)
+        s_x = self._act_qparams(x_val)
+        w_dq = jnp.asarray(self._wq.astype(onp.float32) * self._wscale)
+        conv = self._orig
+        if s_x is not None:
+            x_val = jnp.clip(jnp.rint(x_val / s_x), -127, 127) * s_x
+        # run the original conv's forward with dequantized weights
+        orig_w = conv.weight.data()
+        conv.weight.data()._set_data(w_dq.astype(_unwrap(orig_w).dtype))
+        return conv(_wrap(x_val))
+
+
+_DEFAULT_EXCLUDE: Tuple[str, ...] = ()
+
+
+def quantize_net(net, calib_data=None, calib_mode: str = "naive",
+                 quantized_dtype: str = "int8",
+                 exclude_layers: Sequence[str] = _DEFAULT_EXCLUDE,
+                 num_calib_batches: Optional[int] = None,
+                 logger=None):
+    """Quantize a Gluon net in place and return it (reference
+    quantization.py:818 quantize_net / :460 quantize_model).
+
+    ``calib_mode``: 'none' (dynamic act scales), 'naive' (min/max),
+    'entropy' (KL thresholds). ``calib_data`` is an iterable of input
+    batches (ndarray or tuple) required for 'naive'/'entropy'.
+    """
+    from ..gluon import nn
+
+    if quantized_dtype not in ("int8", "uint8"):
+        raise MXNetError(f"unsupported quantized_dtype {quantized_dtype!r}")
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    if calib_mode != "none" and calib_data is None:
+        raise MXNetError(f"calib_mode={calib_mode!r} requires calib_data")
+
+    collector = (CalibrationCollector(calib_mode)
+                 if calib_mode != "none" else None)
+    wrappers: List[_QuantizedBase] = []
+
+    def _walk(block, prefix=""):
+        for cname, child in list(block._children.items()):
+            if isinstance(child, (QuantizedDense, QuantizedConv)):
+                continue
+            qname = f"{prefix}{cname}"
+            if qname in exclude_layers:
+                continue
+            if isinstance(child, nn.Dense):
+                q = QuantizedDense(child, qname, collector)
+            elif isinstance(child, nn.Conv2D):
+                q = QuantizedConv(child, qname, collector)
+            else:
+                _walk(child, prefix=f"{qname}.")
+                continue
+            block._children[cname] = q
+            if getattr(block, cname, None) is child:
+                object.__setattr__(block, cname, q)
+            wrappers.append(q)
+
+    _walk(net)
+    if not wrappers:
+        raise MXNetError("no quantizable layers (Dense/Conv2D) found")
+
+    if collector is not None:
+        n = 0
+        for batch in calib_data:
+            xs = batch if isinstance(batch, (list, tuple)) else (batch,)
+            net(*xs)  # wrappers collect stats during this pass
+            n += 1
+            if num_calib_batches is not None and n >= num_calib_batches:
+                break
+        for w in wrappers:
+            w.freeze(collector)
+        if logger:
+            logger.info("calibrated %d layers over %d batches",
+                        len(wrappers), n)
+    return net
+
+
+def quantize_model(net, calib_data=None, calib_mode="naive", **kwargs):
+    """Alias keeping the reference's quantize_model entry-point name."""
+    return quantize_net(net, calib_data=calib_data, calib_mode=calib_mode,
+                        **kwargs)

@@ -1,0 +1,90 @@
+"""INT8 PTQ (reference python/mxnet/contrib/quantization.py + calibrate.cc)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.gluon import nn
+
+
+def _mlp():
+    net = nn.Sequential(
+        nn.Dense(32, activation="relu", in_units=16),
+        nn.Dense(10, in_units=32),
+    )
+    net.initialize()
+    return net
+
+
+def _conv_net():
+    net = nn.Sequential(
+        nn.Conv2D(8, 3, padding=1, in_channels=3, activation="relu"),
+        nn.Flatten() if hasattr(nn, "Flatten") else nn.Lambda(
+            lambda x: mx.np.reshape(x, (x.shape[0], -1))),
+        nn.Dense(10),
+    )
+    net.initialize()
+    return net
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantized_mlp_accuracy(calib_mode):
+    onp.random.seed(0)
+    net = _mlp()
+    x = onp.random.randn(64, 16).astype(onp.float32)
+    ref = net(mx.np.array(x)).asnumpy()
+
+    calib = ([mx.np.array(x[:32])] if calib_mode != "none" else None)
+    qnet = q.quantize_net(net, calib_data=calib, calib_mode=calib_mode)
+    out = qnet(mx.np.array(x)).asnumpy()
+
+    # int8 sim must track fp32 closely; argmax ("top-1") agreement >= 99%
+    agree = (ref.argmax(1) == out.argmax(1)).mean()
+    assert agree >= 0.95, f"top-1 agreement {agree}"
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-8)
+    assert rel < 0.1, f"relative error {rel}"
+
+
+def test_quantized_dense_uses_int8_kernel():
+    net = _mlp()
+    qnet = q.quantize_net(net, calib_data=[mx.np.array(
+        onp.random.randn(8, 16).astype(onp.float32))], calib_mode="naive")
+    layer = list(qnet._children.values())[0]
+    assert isinstance(layer, q.QuantizedDense)
+    assert layer._wq.dtype == onp.int8
+    assert layer._act_scale is not None and layer._act_scale > 0
+
+
+def test_quantized_conv_net():
+    onp.random.seed(1)
+    net = _conv_net()
+    x = onp.random.randn(16, 3, 8, 8).astype(onp.float32)
+    ref = net(mx.np.array(x)).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[mx.np.array(x[:8])],
+                          calib_mode="naive")
+    out = qnet(mx.np.array(x)).asnumpy()
+    agree = (ref.argmax(1) == out.argmax(1)).mean()
+    assert agree >= 0.9, f"top-1 agreement {agree}"
+
+
+def test_exclude_layers_and_errors():
+    net = _mlp()
+    with pytest.raises(mx.MXNetError):
+        q.quantize_net(net, calib_mode="naive")  # needs calib_data
+    with pytest.raises(mx.MXNetError):
+        q.quantize_net(net, calib_mode="bogus")
+    net2 = nn.Sequential(nn.Lambda(lambda x: x))
+    net2.initialize()
+    with pytest.raises(mx.MXNetError):
+        q.quantize_net(net2, calib_mode="none")  # nothing quantizable
+
+
+def test_kl_threshold_clips_outliers():
+    # activations ~ N(0,1) with a single extreme outlier: the KL-optimal
+    # threshold must land well below the outlier
+    onp.random.seed(0)
+    a = onp.abs(onp.random.randn(100000)).astype(onp.float32)
+    a[0] = 1000.0
+    hist, edges = onp.histogram(a, bins=2048, range=(0, 1000.0))
+    t = q.optimal_threshold_kl(hist, edges)
+    assert t < 300.0
