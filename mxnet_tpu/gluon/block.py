@@ -171,10 +171,25 @@ class Block:
             new_args = hook(self, args)
             if new_args is not None:  # torch-style: hooks may replace args
                 args = new_args if isinstance(new_args, tuple) else (new_args,)
+        self._record_input_sig(args)
         out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks:
             hook(self, args, out)
         return out
+
+    def _record_input_sig(self, args) -> None:
+        """Remember the latest input structure so export() can re-trace
+        without user-provided example args (reference export required a
+        prior forward for the same reason)."""
+        try:
+            flat, treedef = jax.tree_util.tree_flatten(args)
+            if flat and all(hasattr(v, "shape") and hasattr(v, "dtype")
+                            for v in flat):
+                self._last_input_sig = (
+                    treedef,
+                    [(tuple(v.shape), str(v.dtype)) for v in flat])
+        except Exception:
+            pass
 
     def forward(self, *args):
         raise NotImplementedError
@@ -282,21 +297,65 @@ class HybridBlock(Block):
         d["_forward_pre_hooks"] = []
         return d
 
-    def export(self, path: str, epoch: int = 0, remove_amp_cast: bool = True):
-        """Serialize params + model structure (reference block.py:1248).
-        No nnvm graph exists on TPU — the structure ships as a pickled block
-        (XLA executables rebuild at import); params use the .params format."""
+    def export(self, path: str, epoch: int = 0, remove_amp_cast: bool = True,
+               example_args=None):
+        """Durable export (reference block.py:1248 wrote nnvm symbol-JSON +
+        params). The TPU-native symbol graph is a serialized **StableHLO**
+        module (``jax.export`` — versioned, loadable without the defining
+        Python class, the property the reference's symbol JSON had), wrapped
+        in a JSON envelope at ``{path}-symbol.json``; weights go to
+        ``{path}-{epoch:04d}.params``. Round 1's pickled-block export
+        (unsafe, version-fragile) is gone.
+        """
         import base64
         import json
-        import pickle
+
+        from jax import export as jexport
+
+        from ..base import dtype_from_any
 
         pfile = f"{path}-{epoch:04d}.params"
         self.save_parameters(pfile)
+
+        if example_args is None:
+            sig = getattr(self, "_last_input_sig", None)
+            if sig is None:
+                raise MXNetError(
+                    "export() needs a prior forward pass (to know input "
+                    "shapes) or explicit example_args")
+            treedef, leaves = sig
+            from .. import numpy as mxnp
+
+            flat = [mxnp.zeros(s, dtype=dtype_from_any(d)) for s, d in leaves]
+            example_args = jax.tree_util.tree_unflatten(treedef, flat)
+
+        fn, params = self.functionalize(*example_args, training=False)
+        param_names = sorted(params)
+
+        def infer(plist, *ivals):
+            out, _state = fn(dict(zip(param_names, plist)), *ivals)
+            return out
+
+        in_leaves = [
+            _unwrap(v) for v in jax.tree_util.tree_leaves(
+                example_args, is_leaf=lambda v: isinstance(v, ndarray))
+        ]
+        exported = jexport.export(jax.jit(infer))(
+            [jax.ShapeDtypeStruct(params[n].shape, params[n].dtype)
+             for n in param_names],
+            *[jax.ShapeDtypeStruct(v.shape, v.dtype) for v in in_leaves],
+        )
         meta = {
             "framework": "mxnet_tpu",
+            "format": "mxnet_tpu/stablehlo-v1",
             "class": type(self).__module__ + "." + type(self).__name__,
-            "flags": {k: v for k, v in self._flags.items() if isinstance(v, (int, bool, str, float))},
-            "block": base64.b64encode(pickle.dumps(self)).decode(),
+            "param_names": param_names,
+            "params": {n: {"shape": list(params[n].shape),
+                           "dtype": str(params[n].dtype)}
+                       for n in param_names},
+            "inputs": [{"shape": list(v.shape), "dtype": str(v.dtype)}
+                       for v in in_leaves],
+            "artifact": base64.b64encode(exported.serialize()).decode(),
         }
         jfile = f"{path}-symbol.json"
         with open(jfile, "w") as f:
@@ -583,19 +642,59 @@ def _as_tuple(x):
 
 
 class SymbolBlock(HybridBlock):
-    """Load an exported model (reference block.py:1410). Since exports carry
-    class + params (no nnvm graph on TPU), imports reconstruct the class."""
+    """A model loaded from a durable export (reference block.py:1410
+    SymbolBlock over symbol-JSON). Wraps a deserialized StableHLO module:
+    no Python class of the original model is needed — the artifact IS the
+    graph, exactly the property the reference's symbol JSON had. Forward
+    (inference) only, like the reference's typical use."""
+
+    def __init__(self, exported, meta: dict):
+        super().__init__()
+        from ..base import dtype_from_any
+
+        self._exported = exported
+        self._meta = meta
+        self._param_names = list(meta["param_names"])
+        self._sym_params: Dict[str, Parameter] = {}
+        for name in self._param_names:
+            info = meta["params"][name]
+            p = Parameter(name, shape=tuple(info["shape"]),
+                          dtype=dtype_from_any(info["dtype"]),
+                          grad_req="null")
+            p.set_data(jnp.zeros(tuple(info["shape"]),
+                                 dtype_from_any(info["dtype"])))
+            self._sym_params[name] = p
+
+    def collect_params(self, select: Optional[str] = None) -> Dict[str, Parameter]:
+        out = dict(self._sym_params)
+        if select is not None:
+            pat = re.compile(select)
+            out = {k: v for k, v in out.items() if pat.match(k)}
+        return out
+
+    def forward(self, *args):
+        plist = [self._sym_params[n].data()._data for n in self._param_names]
+        ivals = [_unwrap(a) for a in args]
+        out = self._exported.call(plist, *ivals)
+        return jax.tree_util.tree_map(_wrap, out)
 
     @staticmethod
     def imports(symbol_file: str, input_names=None, param_file: Optional[str] = None, ctx=None):
         import base64
         import json
-        import pickle
+
+        from jax import export as jexport
 
         with open(symbol_file) as f:
             meta = json.load(f)
-        net = pickle.loads(base64.b64decode(meta["block"]))
+        if meta.get("format") != "mxnet_tpu/stablehlo-v1":
+            raise MXNetError(
+                f"{symbol_file}: unsupported export format "
+                f"{meta.get('format')!r} (legacy pickled exports are not "
+                "loadable — re-export with HybridBlock.export)")
+        exported = jexport.deserialize(
+            bytearray(base64.b64decode(meta["artifact"])))
+        net = SymbolBlock(exported, meta)
         if param_file:
             net.load_parameters(param_file, ctx=ctx)
-        net.hybridize()
         return net

@@ -1,0 +1,104 @@
+"""Durable export (VERDICT round-1 item #7): exported models are
+StableHLO artifacts loadable WITHOUT the defining Python class —
+the property the reference's symbol-JSON had (block.py:1248/:1410)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.gluon import nn
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _net():
+    net = nn.HybridSequential(
+        nn.Dense(16, activation="relu", in_units=8),
+        nn.Dense(4, in_units=16),
+    )
+    net.initialize()
+    return net
+
+
+def test_export_is_not_pickle(tmp_path):
+    net = _net()
+    x = np.ones((2, 8))
+    net(x)
+    sym, params = net.export(str(tmp_path / "m"))
+    meta = json.load(open(sym))
+    assert meta["format"] == "mxnet_tpu/stablehlo-v1"
+    assert "block" not in meta  # no pickled code objects
+    assert meta["param_names"]
+
+
+def test_export_roundtrip_values_and_param_swap(tmp_path):
+    net = _net()
+    x_np = onp.random.randn(3, 8).astype(onp.float32)
+    y1 = net(np.array(x_np)).asnumpy()
+    sym, params = net.export(str(tmp_path / "m"))
+
+    net2 = mx.gluon.SymbolBlock.imports(sym, ["data"], params)
+    y2 = net2(np.array(x_np)).asnumpy()
+    onp.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+    # params are live: zeroing them changes the output
+    for p in net2.collect_params().values():
+        p.set_data(np.zeros(p.shape, dtype=p.dtype))
+    y3 = net2(np.array(x_np)).asnumpy()
+    assert not onp.allclose(y1, y3)
+
+
+def test_export_loadable_without_defining_class(tmp_path):
+    """Define the model class ONLY in a child process, export there, then
+    import the artifact here where that class never existed."""
+    script = f'''
+import sys
+sys.path.insert(0, {ROOT!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.block import HybridBlock
+
+class TotallyCustomNet(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Dense(5, in_units=7)
+    def forward(self, x):
+        return mx.np.tanh(self.fc(x)) * 2.0
+
+net = TotallyCustomNet()
+net.initialize()
+x = np.array(onp.arange(14, dtype=onp.float32).reshape(2, 7) / 10.0)
+y = net(x)
+net.export({str(tmp_path / "custom")!r})
+onp.save({str(tmp_path / "expected.npy")!r}, y.asnumpy())
+print("EXPORTED")
+'''
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=240)
+    assert "EXPORTED" in proc.stdout, proc.stderr[-2000:]
+
+    net = mx.gluon.SymbolBlock.imports(
+        str(tmp_path / "custom-symbol.json"), ["data"],
+        str(tmp_path / "custom-0000.params"))
+    x = np.array(onp.arange(14, dtype=onp.float32).reshape(2, 7) / 10.0)
+    y = net(x).asnumpy()
+    expected = onp.load(str(tmp_path / "expected.npy"))
+    onp.testing.assert_allclose(y, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_export_without_forward_raises(tmp_path):
+    net = _net()
+    with pytest.raises(mx.MXNetError, match="prior forward"):
+        net.export(str(tmp_path / "m"))
+    # but explicit example_args work
+    sym, params = net.export(str(tmp_path / "m2"),
+                             example_args=(np.ones((1, 8)),))
+    assert os.path.exists(sym) and os.path.exists(params)
