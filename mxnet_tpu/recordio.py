@@ -204,14 +204,26 @@ def unpack(s: bytes):
     return header, payload
 
 
-def pack_img(header: IRHeader, img: onp.ndarray, quality: int = 95, img_fmt: str = ".npy") -> bytes:
-    """Pack an image array. Without OpenCV in this environment, arrays are
-    stored as raw .npy bytes (shape+dtype preserved); JPEG payloads written
-    by external tools unpack fine via unpack_img's format sniffing."""
+def pack_img(header: IRHeader, img: onp.ndarray, quality: int = 95, img_fmt: str = ".jpg") -> bytes:
+    """Pack an image array (reference recordio.py pack_img: cv2.imencode).
+    JPEG/PNG via PIL (reference-compatible payloads); ``img_fmt='.npy'``
+    stores raw numpy bytes (lossless, shape+dtype preserved)."""
     import io as _io
 
     buf = _io.BytesIO()
-    onp.save(buf, img)
+    fmt = img_fmt.lower()
+    if fmt == ".npy":
+        onp.save(buf, img)
+    else:
+        from PIL import Image
+
+        im = Image.fromarray(onp.asarray(img, onp.uint8))
+        if fmt in (".jpg", ".jpeg"):
+            im.save(buf, format="JPEG", quality=quality)
+        elif fmt == ".png":
+            im.save(buf, format="PNG")
+        else:
+            raise MXNetError(f"unsupported img_fmt {img_fmt!r}")
     return pack(header, buf.getvalue())
 
 
