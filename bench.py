@@ -75,21 +75,29 @@ def child(platform: str) -> None:
     jstep = jax.jit(step)
     x = jnp.asarray(x_np)
     t0 = time.time()
-    _, xw = jstep(params, x)
-    jax.block_until_ready(xw)
+    out0, xw = jstep(params, x)
+    # measurement protocol: block_until_ready over the axon tunnel is NOT a
+    # reliable completion barrier (observed: 200 chained ResNet-50 steps
+    # "completing" in 94 ms, >peak-FLOPs impossible). A device->host scalar
+    # fetch of the chain's final value is the only honest barrier: the
+    # value cannot exist until every step in the serial chain ran.
+    # Warm the sum-fetch over BOTH output shapes so calibration pays no
+    # first-compile cost.
+    float(jnp.sum(xw))
+    float(jnp.sum(out0))
     log(f"compiled + warm in {time.time() - t0:.1f}s")
 
-    # calibrate iteration count to ~5s of steady-state measurement
+    # calibrate iteration count to ~10s of steady-state measurement
     t0 = time.perf_counter()
     out, x = jstep(params, x)
-    jax.block_until_ready(out)
+    float(jnp.sum(out))
     per_iter = max(time.perf_counter() - t0, 1e-4)
-    iters = max(10, min(200, int(5.0 / per_iter)))
+    iters = max(10, min(100, int(10.0 / per_iter)))
 
     t0 = time.perf_counter()
     for _ in range(iters):
         out, x = jstep(params, x)
-    jax.block_until_ready(out)
+    float(jnp.sum(out))  # forces the full serial chain (fetch amortized)
     dt = time.perf_counter() - t0
     img_s = batch * iters / dt
     rec = {

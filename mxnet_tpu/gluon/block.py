@@ -488,25 +488,13 @@ class HybridBlock(Block):
             pvals = vals[:n_params]
             key = vals[-1]
             ivals = vals[n_params:-1]
-            key_state = {"key": key}
-
-            def supplier():
-                key_state["key"], sub = jax.random.split(key_state["key"])
-                return sub
-
             originals = [p._data for _, p in param_list]
             try:
                 for (_, p), v in zip(param_list, pvals):
                     p._data = _wrap(v)
-                st = autograd_state
-                prev = (st.recording, st.training)
-                st.recording, st.training = False, training
-                try:
-                    with npx.rng_scope(supplier):
-                        inputs = jax.tree_util.tree_unflatten(in_treedef, list(ivals))
-                        out = Block.__call__(self, *_as_tuple(inputs))
-                finally:
-                    st.recording, st.training = prev
+                with npx.functional_mode(key, training):
+                    inputs = jax.tree_util.tree_unflatten(in_treedef, list(ivals))
+                    out = Block.__call__(self, *_as_tuple(inputs))
                 out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
                 # a param whose traced value differs from its input tracer was
                 # written during forward (BatchNorm running stats et al.) —
@@ -586,28 +574,16 @@ class HybridBlock(Block):
         def fn(params, *ivals, key=None):
             if key is None:
                 key = jax.random.PRNGKey(0)
-            key_state = {"key": key}
-
-            def supplier():
-                key_state["key"], sub = jax.random.split(key_state["key"])
-                return sub
-
             originals = [p._data for _, p in param_list]
             try:
                 for n, p in param_list:
                     p._data = _wrap(params[n])
-                st = autograd_state
-                prev = (st.recording, st.training)
-                st.recording, st.training = False, training
-                try:
-                    with npx.rng_scope(supplier):
-                        wrapped = tuple(
-                            _wrap(v) if not isinstance(v, ndarray) else v
-                            for v in ivals
-                        )
-                        out = Block.__call__(self, *wrapped)
-                finally:
-                    st.recording, st.training = prev
+                with npx.functional_mode(key, training):
+                    wrapped = tuple(
+                        _wrap(v) if not isinstance(v, ndarray) else v
+                        for v in ivals
+                    )
+                    out = Block.__call__(self, *wrapped)
                 new_params = {
                     n: (p._data._data if isinstance(p._data, ndarray) else p._data)
                     for n, p in param_list

@@ -54,6 +54,30 @@ def _next_key():
     return _random.new_key()
 
 
+@contextlib.contextmanager
+def functional_mode(key, training: bool):
+    """Run the body as a pure function of ``key``: autograd recording off,
+    the training flag pinned, and all RNG draws split deterministically
+    from ``key``. The shared preamble of every functionalization seam
+    (HybridBlock cached-op tracing, ``functionalize``, symbol executors).
+    """
+    from ..ops.dispatch import autograd_state as _st
+
+    key_state = {"key": key}
+
+    def supplier():
+        key_state["key"], sub = jax.random.split(key_state["key"])
+        return sub
+
+    prev = (_st.recording, _st.training)
+    _st.recording, _st.training = False, training
+    try:
+        with rng_scope(supplier):
+            yield
+    finally:
+        _st.recording, _st.training = prev
+
+
 def _call(fn, arrays, static=None, name=None, n_out=1):
     return apply_op(fn, arrays, static=static, n_out=n_out, name=name)
 

@@ -1,0 +1,42 @@
+"""``mx.sym`` / ``mx.symbol`` — symbolic graphs compiled by XLA.
+
+Reference surface: ``python/mxnet/symbol/`` (Symbol, var, Group, JSON
+save/load, bind/simple_bind). See :mod:`mxnet_tpu.symbol.symbol` for the
+TPU-first design notes. Deliberately np-first, like the 2.0 reference:
+ops live under ``mx.sym.np`` / ``mx.sym.npx``; a handful of classic
+CamelCase op aliases are kept for 1.x-style scripts.
+"""
+from .symbol import (  # noqa: F401
+    Executor,
+    Group,
+    Symbol,
+    Variable,
+    fromjson,
+    load,
+    np,
+    npx,
+    var,
+)
+from .symbol import _sym_op as _op
+
+
+def _alias(qual):
+    def build(*args, **kwargs):
+        return _op(qual, *args, **kwargs)
+    build.__name__ = qual.split(".")[-1]
+    return build
+
+
+# 1.x-style conveniences mapping to the npx op set
+FullyConnected = _alias("npx.fully_connected")
+Convolution = _alias("npx.convolution")
+Activation = _alias("npx.activation")
+Pooling = _alias("npx.pooling")
+BatchNorm = _alias("npx.batch_norm")
+Dropout = _alias("npx.dropout")
+Embedding = _alias("npx.embedding")
+softmax = _alias("npx.softmax")
+log_softmax = _alias("npx.log_softmax")
+relu = _alias("npx.relu")
+sigmoid = _alias("npx.sigmoid")
+dot = _alias("np.dot")

@@ -1,0 +1,647 @@
+"""``mx.sym`` — the symbolic graph API, re-designed for XLA.
+
+Parity target: reference ``python/mxnet/symbol/symbol.py`` (Symbol,
+``var``, ``Group``, compose, ``infer_shape``, ``tojson``/``load``,
+``bind``/``_simple_bind :1554``) and ``src/executor/graph_executor.cc``
+(``Executor::SimpleBind :2045``, ``Forward :80``/``Backward :93``).
+
+TPU-first design: a Symbol is a declarative DAG over the SAME op library
+the imperative path uses (every ``mx.np``/``mx.npx`` function — one op
+library, two execution modes, exactly the reference's imperative/symbolic
+duality). There is no nnvm IR and no hand-written graph passes: binding a
+symbol jit-compiles one pure function over the argument arrays, so shape
+inference is ``jax.eval_shape``, memory planning / fusion / scheduling are
+XLA's, and ``backward`` is ``jax.vjp`` of that same function. The
+executor is therefore a thin cache around two compiled XLA programs
+(fwd, fwd+bwd) instead of the reference's per-node engine scheduler.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray, _wrap, _unwrap
+
+__all__ = ["Symbol", "Executor", "var", "Variable", "Group", "load", "fromjson"]
+
+_name_counter = itertools.count()
+
+# op registry: qualified name ("np.dot", "npx.fully_connected") -> callable
+_OPS: Dict[str, Any] = {}
+
+
+def _registry() -> Dict[str, Any]:
+    if _OPS:
+        return _OPS
+    from .. import numpy as _np
+    from .. import numpy_extension as _npx
+
+    for mod, prefix in ((_np, "np"), (_npx, "npx")):
+        for attr in dir(mod):
+            if attr.startswith("_"):
+                continue
+            fn = getattr(mod, attr)
+            if callable(fn) and not isinstance(fn, type):
+                _OPS[f"{prefix}.{attr}"] = fn
+    from ..numpy import linalg as _linalg, random as _random
+
+    for mod, prefix in ((_linalg, "np.linalg"), (_random, "np.random")):
+        for attr in dir(mod):
+            if attr.startswith("_"):
+                continue
+            fn = getattr(mod, attr)
+            if callable(fn) and not isinstance(fn, type):
+                _OPS[f"{prefix}.{attr}"] = fn
+    return _OPS
+
+
+class _Node:
+    """One graph node. ``op is None`` marks a variable (reference "null" op).
+
+    ``pos_spec`` reconstructs the original call: a list whose entries are
+    either ``["sym", input_index]`` or ``["const", value]``; ``kw_sym``
+    maps keyword-argument names to input indices, ``kwargs`` holds the
+    non-symbol keyword attributes (the op's dmlc::Parameter set).
+    """
+
+    __slots__ = ("op", "name", "pos_spec", "kwargs", "kw_sym", "inputs",
+                 "n_out", "attrs")
+
+    def __init__(self, op, name, pos_spec=None, kwargs=None, kw_sym=None,
+                 inputs=None, n_out=1, attrs=None):
+        self.op = op
+        self.name = name
+        self.pos_spec = pos_spec or []
+        self.kwargs = kwargs or {}
+        self.kw_sym = kw_sym or {}
+        self.inputs: List[Tuple["_Node", int]] = inputs or []
+        self.n_out = n_out
+        self.attrs = attrs or {}  # user attrs: __shape__, __dtype__, ...
+
+
+def _topo(heads: Sequence[Tuple[_Node, int]]) -> List[_Node]:
+    order, seen = [], set()
+
+    def visit(node: _Node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp, _ in node.inputs:
+            visit(inp)
+        order.append(node)
+
+    for node, _ in heads:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """An immutable handle on one-or-more outputs of a symbolic graph."""
+
+    def __init__(self, heads: Sequence[Tuple[_Node, int]]):
+        self._heads = list(heads)
+
+    # -- graph introspection ------------------------------------------------
+    @property
+    def name(self) -> str:
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return "grouped"
+
+    def __len__(self) -> int:
+        return len(self._heads)
+
+    def __iter__(self):
+        return (Symbol([h]) for h in self._heads)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            # accept both the bare node name and the '_output'-suffixed
+            # form that list_outputs() returns (reference idiom:
+            # sym.get_internals()['fc1_output'])
+            for (node, slot), oname in zip(self._heads, self.list_outputs()):
+                if index in (node.name, oname):
+                    return Symbol([(node, slot)])
+            raise MXNetError(f"no output named {index!r}")
+        return Symbol([self._heads[index]])
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in _topo(self._heads) if n.op is None]
+
+    def list_outputs(self) -> List[str]:
+        outs = []
+        for node, slot in self._heads:
+            suffix = f"_output{slot}" if node.n_out > 1 else "_output"
+            outs.append(node.name + suffix)
+        return outs
+
+    def list_auxiliary_states(self) -> List[str]:
+        # the functional design has no hidden mutable aux state: running
+        # stats et al. are ordinary arguments (reference aux_states)
+        return []
+
+    def get_internals(self) -> "Symbol":
+        heads = []
+        for node in _topo(self._heads):
+            if node.op is None:
+                heads.append((node, 0))
+            else:
+                heads.extend((node, s) for s in range(node.n_out))
+        return Symbol(heads)
+
+    def attr(self, key: str) -> Optional[str]:
+        return self._heads[0][0].attrs.get(key)
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        return {n.name: dict(n.attrs) for n in _topo(self._heads) if n.attrs}
+
+    def _set_attr(self, **kwargs) -> None:
+        self._heads[0][0].attrs.update(kwargs)
+
+    def __repr__(self) -> str:
+        return f"<Symbol {self.name}>"
+
+    # -- composition --------------------------------------------------------
+    def __call__(self, **kwargs) -> "Symbol":
+        """Compose: substitute named variables with other symbols
+        (reference Symbol composition ``net(data=prev_layer)``)."""
+        for key, val in kwargs.items():
+            if not isinstance(val, Symbol):
+                raise MXNetError(f"compose expects Symbols, got {type(val)}")
+            if len(val._heads) != 1:
+                raise MXNetError(
+                    f"cannot substitute grouped symbol for {key!r}: "
+                    "a variable stands for exactly one output")
+        # memo: id(old node) -> (new node, slot translator base). Vars have
+        # a single slot, so a substituted var maps (var, 0) -> sub head.
+        memo: Dict[int, Tuple[_Node, Optional[int]]] = {}
+        for node in _topo(self._heads):
+            if node.op is None and node.name in kwargs:
+                memo[id(node)] = kwargs[node.name]._heads[0]
+                continue
+            new_inputs = []
+            changed = False
+            for i, s in node.inputs:
+                ni, ns = memo.get(id(i), (i, None))
+                slot = s if ns is None else ns
+                changed |= ni is not i or slot != s
+                new_inputs.append((ni, slot))
+            if not changed:
+                memo[id(node)] = (node, None)
+            else:
+                memo[id(node)] = (_Node(
+                    node.op, node.name, list(node.pos_spec),
+                    dict(node.kwargs), dict(node.kw_sym), new_inputs,
+                    node.n_out, dict(node.attrs)), None)
+        heads = []
+        for n, s in self._heads:
+            nn, ns = memo[id(n)]
+            heads.append((nn, s if ns is None else ns))
+        return Symbol(heads)
+
+    # -- arithmetic sugar (reference symbol.py operator overloads) ----------
+    def _binop(self, other, opname, swap=False):
+        reg = _registry()
+        a, b = (other, self) if swap else (self, other)
+        return _make_op_symbol(opname, reg[opname], (a, b), {})
+
+    def __add__(self, o): return self._binop(o, "np.add")
+    def __radd__(self, o): return self._binop(o, "np.add", True)
+    def __sub__(self, o): return self._binop(o, "np.subtract")
+    def __rsub__(self, o): return self._binop(o, "np.subtract", True)
+    def __mul__(self, o): return self._binop(o, "np.multiply")
+    def __rmul__(self, o): return self._binop(o, "np.multiply", True)
+    def __truediv__(self, o): return self._binop(o, "np.divide")
+    def __rtruediv__(self, o): return self._binop(o, "np.divide", True)
+    def __pow__(self, o): return self._binop(o, "np.power")
+    def __matmul__(self, o): return self._binop(o, "np.matmul")
+    def __neg__(self): return self._binop(-1.0, "np.multiply")
+
+    def reshape(self, shape): return _sym_op("np.reshape", self, shape)
+    def transpose(self, axes=None): return _sym_op("np.transpose", self, axes)
+    def sum(self, axis=None, keepdims=False):
+        return _sym_op("np.sum", self, axis=axis, keepdims=keepdims)
+    def mean(self, axis=None, keepdims=False):
+        return _sym_op("np.mean", self, axis=axis, keepdims=keepdims)
+
+    # -- evaluation ---------------------------------------------------------
+    def _evaluate(self, bindings: Dict[str, Any]) -> List[Any]:
+        """Run the graph eagerly (or under a jax trace — the ops are
+        trace-transparent) with ``bindings`` mapping var name -> ndarray."""
+        reg = _registry()
+        values: Dict[int, Tuple[Any, ...]] = {}
+        for node in _topo(self._heads):
+            if node.op is None:
+                if node.name not in bindings:
+                    raise MXNetError(f"unbound variable {node.name!r}")
+                values[id(node)] = (bindings[node.name],)
+                continue
+            ins = [values[id(i)][s] for i, s in node.inputs]
+            args, it = [], iter(ins)
+            for marker in node.pos_spec:
+                args.append(next(it) if marker[0] == "sym" else marker[1])
+            kwargs = dict(node.kwargs)
+            for kname in node.kw_sym:
+                kwargs[kname] = next(it)
+            out = reg[node.op](*args, **kwargs)
+            values[id(node)] = tuple(out) if isinstance(out, (tuple, list)) \
+                else (out,)
+        return [values[id(n)][s] for n, s in self._heads]
+
+    def eval(self, ctx=None, **kwargs) -> List[ndarray]:
+        """Eager evaluation with named argument arrays (reference
+        symbol.py ``eval``)."""
+        bindings = {k: v if isinstance(v, ndarray) else _wrap(jnp.asarray(v))
+                    for k, v in kwargs.items()}
+        return self._evaluate(bindings)
+
+    # -- shape / type inference --------------------------------------------
+    def _arg_structs(self, shapes: Dict[str, tuple], dtypes=None):
+        dtypes = dtypes or {}
+        structs = {}
+        for node in _topo(self._heads):
+            if node.op is not None:
+                continue
+            shape = shapes.get(node.name)
+            if shape is None and "__shape__" in node.attrs:
+                shape = tuple(node.attrs["__shape__"])
+            if shape is None:
+                raise MXNetError(
+                    f"infer_shape: no shape for argument {node.name!r} "
+                    "(forward propagation needs every leaf's shape — give "
+                    "it here or declare it on var(shape=...))")
+            dt = dtypes.get(node.name) or node.attrs.get("__dtype__", "float32")
+            structs[node.name] = jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dt))
+        return structs
+
+    def infer_shape(self, **shapes):
+        """Forward shape propagation via ``jax.eval_shape`` — no op ever
+        runs. Returns (arg_shapes, out_shapes, aux_shapes) in
+        ``list_arguments()`` / ``list_outputs()`` order.
+
+        Unlike the reference (``_simple_bind``-era backward inference,
+        e.g. deducing a weight's shape from the data shape), leaves are
+        not inferred backwards — the gluon deferred-init path covers that
+        use case; here every leaf shape must be known or declared.
+        """
+        structs = self._arg_structs(shapes)
+
+        def run(binds):
+            return tuple(_unwrap(v) for v in self._evaluate(
+                {k: _wrap(v) for k, v in binds.items()}))
+
+        outs = jax.eval_shape(run, structs)
+        arg_shapes = [structs[n].shape for n in self.list_arguments()]
+        return arg_shapes, [tuple(o.shape) for o in outs], []
+
+    def infer_type(self, **dtypes):
+        """Forward dtype propagation (reference ``infer_type``). Shapes
+        fall back to declared ``var(shape=...)`` attrs, else rank-0."""
+        shapes = {}
+        for node in _topo(self._heads):
+            if node.op is None:
+                shapes[node.name] = tuple(node.attrs.get("__shape__", ()))
+        structs = self._arg_structs(shapes, dtypes)
+
+        def run(binds):
+            return tuple(_unwrap(v) for v in self._evaluate(
+                {k: _wrap(v) for k, v in binds.items()}))
+
+        outs = jax.eval_shape(run, structs)
+        arg_types = [onp.dtype(structs[n].dtype)
+                     for n in self.list_arguments()]
+        return arg_types, [onp.dtype(o.dtype) for o in outs], []
+
+    # -- serialization (reference symbol JSON: nodes/arg_nodes/heads) -------
+    def tojson(self) -> str:
+        order = _topo(self._heads)
+        index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            entry = {
+                "op": "null" if n.op is None else n.op,
+                "name": n.name,
+                "inputs": [[index[id(i)], s, 0] for i, s in n.inputs],
+            }
+            attrs = {}
+            if n.op is not None:
+                attrs = {"__pos_spec__": n.pos_spec, "__kwargs__": n.kwargs,
+                         "__kw_sym__": list(n.kw_sym), "__n_out__": n.n_out}
+            attrs.update(n.attrs)
+            if attrs:
+                entry["attrs"] = json.loads(json.dumps(attrs, default=_jsonable))
+            nodes.append(entry)
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": [i for i, n in enumerate(order) if n.op is None],
+            "heads": [[index[id(n)], s, 0] for n, s in self._heads],
+            "attrs": {"mxnet_version": ["str", "2.0.0.tpu"]},
+        }, indent=2)
+
+    @staticmethod
+    def fromjson(text: str) -> "Symbol":
+        doc = json.loads(text)
+        nodes: List[_Node] = []
+        for entry in doc["nodes"]:
+            attrs = dict(entry.get("attrs", {}))
+            if entry["op"] == "null":
+                nodes.append(_Node(None, entry["name"], attrs=attrs))
+                continue
+            pos_spec = [list(m) for m in attrs.pop("__pos_spec__", [])]
+            kwargs = attrs.pop("__kwargs__", {})
+            kw_sym_names = attrs.pop("__kw_sym__", [])
+            n_out = attrs.pop("__n_out__", 1)
+            inputs = [(nodes[i], s) for i, s, _ in entry["inputs"]]
+            kw_sym = {name: None for name in kw_sym_names}
+            nodes.append(_Node(entry["op"], entry["name"], pos_spec, kwargs,
+                               kw_sym, inputs, n_out, attrs))
+        return Symbol([(nodes[i], s) for i, s, _ in doc["heads"]])
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding ------------------------------------------------------------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs) -> "Executor":
+        """Bind argument arrays -> Executor (reference ``Executor::Bind``)."""
+        arg_names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        return Executor(self, args or {}, args_grad, grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **shapes) -> "Executor":
+        """Infer every shape, allocate zeroed argument + gradient arrays,
+        return a ready Executor (reference ``_simple_bind`` symbol.py:1554
+        → ``Executor::SimpleBind`` graph_executor.cc:2045)."""
+        structs = self._arg_structs(shapes, type_dict)
+        args = {k: _wrap(jnp.zeros(s.shape, s.dtype))
+                for k, s in structs.items()}
+        return Executor(self, args, None, grad_req)
+
+    _simple_bind = simple_bind
+
+
+def _jsonable(v):
+    if isinstance(v, (onp.dtype, type)):
+        return onp.dtype(v).name
+    if isinstance(v, (onp.integer,)):
+        return int(v)
+    if isinstance(v, (onp.floating,)):
+        return float(v)
+    raise TypeError(f"symbol attr {v!r} is not serializable")
+
+
+class Executor:
+    """Compiled forward/backward over bound arguments.
+
+    The reference executor schedules per-node engine ops
+    (``GraphExecutor::RunOps`` graph_executor.cc:1517); here the whole
+    graph is ONE XLA program per (is_train,) variant — compiled lazily,
+    cached for the executor's lifetime. Gradients honor per-argument
+    ``grad_req`` in {write, add, null}.
+    """
+
+    def __init__(self, symbol: Symbol, args: Dict[str, ndarray],
+                 args_grad: Optional[Dict[str, ndarray]], grad_req):
+        self._symbol = symbol
+        self._arg_names = symbol.list_arguments()
+        missing = [n for n in self._arg_names if n not in args]
+        if missing:
+            raise MXNetError(f"bind: missing argument arrays for {missing}")
+        self.arg_dict: Dict[str, ndarray] = {
+            n: args[n] if isinstance(args[n], ndarray)
+            else _wrap(jnp.asarray(args[n])) for n in self._arg_names}
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._arg_names, grad_req))
+        self.grad_req = grad_req
+        self.grad_dict: Dict[str, ndarray] = {}
+        for n in self._arg_names:
+            if grad_req.get(n, "null") == "null":
+                continue
+            if args_grad and n in args_grad:
+                self.grad_dict[n] = args_grad[n]
+            else:
+                a = self.arg_dict[n]
+                self.grad_dict[n] = _wrap(jnp.zeros(a.shape, a.dtype))
+        self.aux_dict: Dict[str, ndarray] = {}
+        self.outputs: List[ndarray] = []
+        self._fwd_cache: Dict[bool, Any] = {}
+        self._bwd_cache: Dict[bool, Any] = {}
+        self._last_train = False
+        self._last_key = jax.random.PRNGKey(0)
+
+    # one pure function drives both directions
+    def _pure(self, training: bool):
+        from ..numpy_extension import functional_mode
+
+        sym = self._symbol
+        names = self._arg_names
+
+        def fn(vals, key):
+            with functional_mode(key, training):
+                outs = sym._evaluate(
+                    {n: _wrap(v) for n, v in zip(names, vals)})
+            return tuple(_unwrap(o) for o in outs)
+
+        return fn
+
+    def forward(self, is_train: bool = False, **kwargs) -> List[ndarray]:
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k!r}")
+            self.arg_dict[k] = v if isinstance(v, ndarray) \
+                else _wrap(jnp.asarray(v))
+        if is_train not in self._fwd_cache:
+            self._fwd_cache[is_train] = jax.jit(self._pure(is_train))
+        vals = [_unwrap(self.arg_dict[n]) for n in self._arg_names]
+        # remember the key: backward's vjp re-run must draw the SAME
+        # dropout masks / random values as the forward it differentiates
+        self._last_key = jax.random.PRNGKey(
+            int(onp.random.randint(0, 2 ** 31)))
+        outs = self._fwd_cache[is_train](vals, self._last_key)
+        self._last_train = is_train
+        self.outputs = [_wrap(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None) -> None:
+        """vjp sweep; accumulates into ``grad_dict`` honoring grad_req."""
+        training = self._last_train
+        diff = [n for n in self._arg_names
+                if self.grad_req.get(n, "null") != "null"
+                and onp.issubdtype(onp.dtype(self.arg_dict[n].dtype),
+                                   onp.floating)]
+        if not diff:
+            return
+        if training not in self._bwd_cache:
+            pure = self._pure(training)
+            names = self._arg_names
+
+            def bwd(vals, key, cts):
+                byname = dict(zip(names, vals))
+
+                def for_diff(*dvals):
+                    cur = dict(byname)
+                    cur.update(zip(diff, dvals))
+                    return pure([cur[n] for n in names], key)
+
+                _, vjp = jax.vjp(for_diff, *[byname[n] for n in diff])
+                return vjp(tuple(cts))
+
+            self._bwd_cache[training] = jax.jit(bwd)
+        outs = self.outputs
+        if out_grads is None:
+            cts = [jnp.ones(o.shape, o.dtype) for o in outs]
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cts = [_unwrap(g) for g in out_grads]
+        vals = [_unwrap(self.arg_dict[n]) for n in self._arg_names]
+        grads = self._bwd_cache[training](vals, self._last_key, cts)
+        for n, g in zip(diff, grads):
+            slot = self.grad_dict[n]
+            if self.grad_req[n] == "add":
+                slot._data = slot._data + g.astype(slot.dtype)
+            else:
+                slot._data = g.astype(slot.dtype)
+
+    @property
+    def arg_arrays(self) -> List[ndarray]:
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self) -> List[Optional[ndarray]]:
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    def copy_params_from(self, arg_params, aux_params=None) -> None:
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k] = v if isinstance(v, ndarray) \
+                    else _wrap(jnp.asarray(v))
+
+
+# ---------------------------------------------------------------------------
+# symbol construction
+# ---------------------------------------------------------------------------
+def var(name: str, shape=None, dtype=None, **attrs) -> Symbol:
+    """Declare a free variable (reference ``mx.sym.var`` / "null" op)."""
+    node_attrs = dict(attrs)
+    if shape is not None:
+        node_attrs["__shape__"] = list(shape)
+    if dtype is not None:
+        node_attrs["__dtype__"] = onp.dtype(dtype).name
+    return Symbol([(_Node(None, name, attrs=node_attrs), 0)])
+
+
+Variable = var
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return Symbol.fromjson(f.read())
+
+
+fromjson = Symbol.fromjson
+
+# ops whose output count depends on attrs
+def _n_out_split(args, kwargs):
+    spec = kwargs.get("indices_or_sections",
+                      args[1][1] if len(args) > 1 else None)
+    if isinstance(spec, int):
+        return spec
+    if isinstance(spec, (list, tuple)):
+        return len(spec) + 1
+    return 1
+
+
+_N_OUT = {
+    "np.split": _n_out_split,
+    "np.array_split": _n_out_split,
+    "np.hsplit": _n_out_split,
+    "np.vsplit": _n_out_split,
+    "npx.topk": lambda a, k: 2 if k.get("ret_typ") == "both" else 1,
+    "npx.batch_norm": lambda a, k: 1,
+}
+
+
+def _make_op_symbol(opname: str, fn, args, kwargs) -> Symbol:
+    name = kwargs.pop("name", None) or \
+        f"{opname.split('.')[-1]}{next(_name_counter)}"
+    pos_spec, inputs, kw_sym = [], [], {}
+    for a in args:
+        if isinstance(a, Symbol):
+            if len(a._heads) != 1:
+                raise MXNetError("cannot pass a grouped symbol as an op input")
+            pos_spec.append(["sym", len(inputs)])
+            inputs.append(a._heads[0])
+        else:
+            pos_spec.append(["const", a])
+    const_kwargs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Symbol):
+            kw_sym[k] = len(inputs)
+            inputs.append(v._heads[0])
+        else:
+            const_kwargs[k] = v
+    spec_args = [("sym", None) if m[0] == "sym" else ("const", m[1])
+                 for m in pos_spec]
+    n_out = 1
+    counter = _N_OUT.get(opname)
+    if counter is not None:
+        n_out = counter(spec_args, const_kwargs)
+    node = _Node(opname, name, pos_spec, const_kwargs, kw_sym, inputs, n_out)
+    return Symbol([(node, s) for s in range(n_out)])
+
+
+def _sym_op(opname: str, *args, **kwargs) -> Symbol:
+    reg = _registry()
+    if opname not in reg:
+        raise MXNetError(f"unknown symbolic op {opname!r}")
+    return _make_op_symbol(opname, reg[opname], args, kwargs)
+
+
+class _OpNamespace:
+    """``mx.sym.np`` / ``mx.sym.npx`` — symbol-building mirrors of the
+    eager namespaces (the autogenerated wrappers of reference
+    ``python/mxnet/symbol/numpy/``)."""
+
+    def __init__(self, prefix: str):
+        self._prefix = prefix
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        qual = f"{self._prefix}.{name}"
+        reg = _registry()
+        if qual not in reg:
+            raise AttributeError(
+                f"no symbolic op {qual!r} (not in the eager op registry)")
+
+        def build(*args, **kwargs):
+            return _make_op_symbol(qual, reg[qual], args, kwargs)
+
+        build.__name__ = name
+        build.__doc__ = getattr(reg[qual], "__doc__", None)
+        return build
+
+
+np = _OpNamespace("np")
+npx = _OpNamespace("npx")

@@ -36,6 +36,7 @@ def _accuracy(net, X, y, flatten):
 
 
 @pytest.mark.integration
+@pytest.mark.seed(7)  # convergence gates must be deterministic, not seed-lottery
 def test_mlp_digits_reaches_97pct():
     (Xtr, ytr), (Xte, yte) = _digits()
     net = nn.HybridSequential(
@@ -69,6 +70,7 @@ def test_mlp_digits_reaches_97pct():
 
 
 @pytest.mark.integration
+@pytest.mark.seed(7)  # convergence gates must be deterministic, not seed-lottery
 def test_cnn_digits_loss_collapses():
     (Xtr, ytr), _ = _digits()
     Xtr, ytr = Xtr[:512], ytr[:512]
@@ -82,7 +84,7 @@ def test_cnn_digits_loss_collapses():
     net.initialize()
     net.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": 2e-3})
+                            {"learning_rate": 3e-3})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 
     def epoch_loss():

@@ -1,0 +1,211 @@
+"""mx.sym symbolic API (reference python/mxnet/symbol/symbol.py,
+tests/python/unittest/test_symbol.py patterns: compose, infer_shape,
+JSON round-trip, bind/simple_bind forward/backward vs autograd oracle).
+"""
+import json
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.base import MXNetError
+
+sym = mx.sym
+
+
+def test_basic_compose_and_eval():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b * 2.0
+    assert sorted(c.list_arguments()) == ["a", "b"]
+    (out,) = c.eval(a=onp.ones((2, 3), onp.float32),
+                    b=onp.full((2, 3), 2.0, onp.float32))
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), 5.0))
+
+
+def test_mlp_forward_matches_numpy():
+    x = sym.var("data")
+    w1 = sym.var("w1")
+    b1 = sym.var("b1")
+    h = sym.npx.relu(sym.np.dot(x, w1) + b1)
+    w2 = sym.var("w2")
+    y = sym.npx.softmax(sym.np.dot(h, w2))
+    rng = onp.random.RandomState(0)
+    vals = {"data": rng.randn(4, 5).astype(onp.float32),
+            "w1": rng.randn(5, 8).astype(onp.float32),
+            "b1": rng.randn(8).astype(onp.float32),
+            "w2": rng.randn(8, 3).astype(onp.float32)}
+    (out,) = y.eval(**vals)
+    ref_h = onp.maximum(vals["data"] @ vals["w1"] + vals["b1"], 0)
+    ref_l = ref_h @ vals["w2"]
+    ref = onp.exp(ref_l - ref_l.max(-1, keepdims=True))
+    ref /= ref.sum(-1, keepdims=True)
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_infer_shape_and_type():
+    x = sym.var("data")
+    w = sym.var("w")
+    y = sym.npx.fully_connected(x, w, no_bias=True, num_hidden=16)
+    args, outs, aux = y.infer_shape(data=(32, 100), w=(16, 100))
+    assert outs == [(32, 16)]
+    assert aux == []
+    assert args[y.list_arguments().index("data")] == (32, 100)
+
+    x2 = sym.var("a", shape=(4, 4))  # declared shape used as fallback
+    y2 = sym.np.sum(x2 * x2)
+    _, outs2, _ = y2.infer_shape()
+    assert outs2 == [()]
+
+    types, otypes, _ = (x2 * x2).infer_type(a="float32")
+    assert otypes == [onp.dtype(onp.float32)]
+
+    with pytest.raises(MXNetError):
+        y.infer_shape(data=(32, 100))  # w unknown -> explicit error
+
+
+def test_compose_substitution():
+    data = sym.var("data")
+    stage1 = sym.npx.relu(data * 2.0)
+    inner = sym.var("inner")
+    stage2 = inner + 1.0
+    whole = stage2(inner=stage1)
+    assert "inner" not in whole.list_arguments()
+    (out,) = whole.eval(data=onp.array([[-1.0, 2.0]], onp.float32))
+    onp.testing.assert_allclose(out.asnumpy(), [[1.0, 5.0]])
+
+
+def test_multi_output_and_group_and_internals():
+    x = sym.var("x")
+    parts = sym.np.split(x, 2, axis=0)
+    assert len(parts) == 2
+    (p1,) = parts[1].eval(x=onp.arange(4.0, dtype=onp.float32))
+    onp.testing.assert_allclose(p1.asnumpy(), [2.0, 3.0])
+
+    g = sym.Group([parts[0], parts[1]])
+    outs = g.eval(x=onp.arange(4.0, dtype=onp.float32))
+    assert len(outs) == 2
+    assert len(g.list_outputs()) == 2
+
+    internals = (x * 2.0 + 1.0).get_internals()
+    assert len(internals.list_outputs()) >= 3  # x, mul, add
+
+
+def test_json_roundtrip():
+    x = sym.var("data", shape=(2, 4))
+    w = sym.var("w")
+    y = sym.npx.relu(sym.np.dot(x, w)) * 0.5
+    text = y.tojson()
+    doc = json.loads(text)
+    assert any(n["op"] == "null" for n in doc["nodes"])
+    y2 = sym.fromjson(text)
+    assert sorted(y2.list_arguments()) == sorted(y.list_arguments())
+    rng = onp.random.RandomState(1)
+    vals = {"data": rng.randn(2, 4).astype(onp.float32),
+            "w": rng.randn(4, 3).astype(onp.float32)}
+    (o1,) = y.eval(**vals)
+    (o2,) = y2.eval(**vals)
+    onp.testing.assert_allclose(o1.asnumpy(), o2.asnumpy())
+
+
+def test_save_load_file(tmp_path):
+    y = sym.var("a") + sym.var("b")
+    path = str(tmp_path / "sym.json")
+    y.save(path)
+    y2 = sym.load(path)
+    assert sorted(y2.list_arguments()) == ["a", "b"]
+
+
+def test_simple_bind_forward_backward_oracle():
+    """Executor grads must match the autograd tape on the same ops."""
+    x = sym.var("x")
+    w = sym.var("w")
+    loss = sym.np.sum(sym.npx.sigmoid(sym.np.dot(x, w)))
+    exe = loss.simple_bind(x=(3, 4), w=(4, 2), grad_req="write")
+    rng = onp.random.RandomState(2)
+    xv = rng.randn(3, 4).astype(onp.float32)
+    wv = rng.randn(4, 2).astype(onp.float32)
+    (out,) = exe.forward(is_train=True, x=xv, w=wv)
+    exe.backward()
+
+    # oracle: same computation through the eager tape
+    xa = mx.np.array(xv)
+    wa = mx.np.array(wv)
+    xa.attach_grad()
+    wa.attach_grad()
+    with autograd.record():
+        ref = mx.np.sum(mx.npx.sigmoid(mx.np.dot(xa, wa)))
+    ref.backward()
+    onp.testing.assert_allclose(float(out), float(ref), rtol=1e-5)
+    onp.testing.assert_allclose(exe.grad_dict["x"].asnumpy(),
+                                xa.grad.asnumpy(), rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(exe.grad_dict["w"].asnumpy(),
+                                wa.grad.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_grad_req_add_and_null():
+    x = sym.var("x")
+    w = sym.var("w")
+    y = sym.np.sum(x * w)
+    exe = y.simple_bind(x=(3,), w=(3,), grad_req={"x": "add", "w": "null"})
+    xv = onp.array([1.0, 2.0, 3.0], onp.float32)
+    wv = onp.array([4.0, 5.0, 6.0], onp.float32)
+    exe.forward(is_train=True, x=xv, w=wv)
+    exe.backward()
+    exe.forward(is_train=True, x=xv, w=wv)
+    exe.backward()
+    onp.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), 2 * wv)
+    assert "w" not in exe.grad_dict
+
+
+def test_bind_with_existing_arrays():
+    a = sym.var("a")
+    y = a * 3.0
+    arr = mx.np.array([1.0, 2.0])
+    exe = y.bind(args={"a": arr})
+    (out,) = exe.forward()
+    onp.testing.assert_allclose(out.asnumpy(), [3.0, 6.0])
+
+
+def test_legacy_aliases_and_arith():
+    data = sym.var("data")
+    w = sym.var("w")
+    fc = sym.FullyConnected(data, w, no_bias=True, num_hidden=8)
+    act = sym.Activation(fc, act_type="relu")
+    args, outs, _ = act.infer_shape(data=(2, 16), w=(8, 16))
+    assert outs == [(2, 8)]
+    neg = -sym.var("z")
+    (out,) = neg.eval(z=onp.array([1.0, -2.0], onp.float32))
+    onp.testing.assert_allclose(out.asnumpy(), [-1.0, 2.0])
+
+
+def test_backward_uses_forward_dropout_mask():
+    """The vjp re-run must draw the SAME mask the forward used: for
+    y = sum(dropout(x)), grad x is exactly y's elementwise mask/keep."""
+    x = sym.var("x")
+    y = sym.np.sum(sym.npx.dropout(x, p=0.5))
+    exe = y.simple_bind(x=(512,), grad_req="write")
+    xv = onp.ones(512, onp.float32)
+    (out,) = exe.forward(is_train=True, x=xv)
+    exe.backward()
+    g = exe.grad_dict["x"].asnumpy()
+    # grad of sum(dropout(x)) w.r.t. x is mask/keep_prob; entries are 0 or 2
+    assert set(onp.unique(g)).issubset({0.0, 2.0})
+    # same mask as forward <=> sum(grad) equals the forward's scalar output
+    onp.testing.assert_allclose(g.sum(), float(out), rtol=1e-6)
+    # backward twice in a row is stable (same stored key)
+    exe.backward()
+    onp.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), g)
+
+
+def test_dropout_train_vs_infer():
+    x = sym.var("x")
+    y = sym.npx.dropout(x, p=0.5)
+    exe = y.simple_bind(x=(1000,))
+    xv = onp.ones(1000, onp.float32)
+    (infer_out,) = exe.forward(is_train=False, x=xv)
+    onp.testing.assert_allclose(infer_out.asnumpy(), xv)  # identity at infer
+    (train_out,) = exe.forward(is_train=True, x=xv)
+    zeros = float((train_out.asnumpy() == 0).mean())
+    assert 0.3 < zeros < 0.7  # ~half dropped
