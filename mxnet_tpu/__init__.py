@@ -59,7 +59,7 @@ def __getattr__(name):
     import importlib
 
     targets = {"test_utils": ".test_utils", "image": ".image", "amp": ".amp",
-               "io": ".io",
+               "io": ".io", "monitor": ".monitor", "contrib": ".contrib",
                "parallel": ".parallel", "random": ".numpy.random",
                "sym": ".symbol", "symbol": ".symbol"}
     if name in targets:

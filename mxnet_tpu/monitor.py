@@ -1,0 +1,187 @@
+"""``mx.monitor`` — training observability taps.
+
+Parity targets:
+- ``python/mxnet/monitor.py`` ``Monitor``: periodically collect a statistic
+  over intermediate outputs (and optionally parameters) whose names match a
+  regex; ``install``/``tic``/``toc``/``toc_print`` lifecycle.
+- ``src/common/tensor_inspector.h`` ``TensorInspector``: interactive value
+  dumps + value checks (negative/nan/inf) on a single tensor.
+
+TPU-first notes: the reference installs a C++ callback on every executor op
+via ``MXExecutorSetMonitorCallback``; ops here are fused into one XLA
+program, so per-op taps are re-created at the two places user-visible
+values still exist — Block boundaries (forward hooks) and symbol-executor
+heads (``get_internals`` re-evaluation). Statistics are computed lazily on
+device and only fetched at ``toc`` time to keep taps off the hot path.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import ndarray
+
+__all__ = ["Monitor", "TensorInspector"]
+
+
+class Monitor:
+    """Collect statistics of intermediate outputs every ``interval`` batches.
+
+    Parameters follow the reference (monitor.py): ``stat_func`` maps an
+    ndarray to a scalar/small ndarray statistic (default: mean(|x|)),
+    ``pattern`` filters tap names, ``monitor_all`` additionally taps block
+    parameters (reference taps op *inputs* with the same flag).
+    """
+
+    def __init__(self, interval: int = 1,
+                 stat_func: Optional[Callable[[ndarray], Any]] = None,
+                 pattern: str = ".*", sort: bool = False,
+                 monitor_all: bool = False):
+        if stat_func is None:
+            def stat_func(x):
+                from . import numpy as np
+
+                return np.mean(np.abs(x))
+        self.stat_func = stat_func
+        self.interval = int(interval)
+        self.pattern = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+        self.activated = False
+        self.step = 0
+        self.queue: List[Tuple[int, str, Any]] = []
+        self._handles: List[Any] = []
+        self._exes: List[Any] = []
+        self._blocks: List[Any] = []
+
+    # -- installation -------------------------------------------------------
+    def install(self, target, name: Optional[str] = None) -> None:
+        """Attach to a :class:`~mxnet_tpu.symbol.Executor` or a gluon
+        ``Block`` (recursively taps every child block's output)."""
+        from .gluon.block import Block
+        from .symbol import Executor
+
+        if isinstance(target, Executor):
+            self._exes.append((name or "exe%d" % len(self._exes), target))
+        elif isinstance(target, Block):
+            self._install_block(target, name or type(target).__name__.lower())
+        else:
+            raise MXNetError(
+                f"Monitor.install expects an Executor or Block, got "
+                f"{type(target).__name__}")
+
+    def _install_block(self, block, prefix: str) -> None:
+        self._blocks.append((prefix, block))
+
+        def make_hook(tap_name):
+            def hook(blk, args, out):
+                if not self.activated:
+                    return
+                import jax
+
+                leaves = [v for v in jax.tree_util.tree_leaves(
+                    out, is_leaf=lambda v: isinstance(v, ndarray))
+                    if isinstance(v, ndarray)]
+                for i, leaf in enumerate(leaves):
+                    nm = tap_name if len(leaves) == 1 else f"{tap_name}_out{i}"
+                    if self.pattern.match(nm):
+                        self.queue.append((self.step, nm, self.stat_func(leaf)))
+            return hook
+
+        self._handles.append(
+            block.register_forward_hook(make_hook(prefix + "_output")))
+        for child_name, child in getattr(block, "_children", {}).items():
+            self._install_block(child, f"{prefix}.{child_name}")
+
+    # -- lifecycle ----------------------------------------------------------
+    def tic(self) -> None:
+        """Start collecting for this batch (if the interval says so)."""
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        """Stop collecting; return [(step, name, formatted stat), ...]."""
+        if not self.activated:
+            self.step += 1
+            return []
+        # executor taps: re-evaluate internals at toc time
+        for exe_name, exe in self._exes:
+            sym = exe._symbol.get_internals()
+            names = sym.list_outputs()
+            outs = sym._evaluate(dict(exe.arg_dict))
+            for nm, out in zip(names, outs):
+                if self.pattern.match(nm):
+                    self.queue.append((self.step, nm, self.stat_func(out)))
+        if self.monitor_all:
+            for prefix, block in self._blocks:
+                for pname, p in block.collect_params().items():
+                    if p._data is not None and self.pattern.match(pname):
+                        self.queue.append(
+                            (self.step, pname, self.stat_func(p.data())))
+        self.activated = False
+        res = []
+        queue = sorted(self.queue, key=lambda q: q[1]) if self.sort \
+            else self.queue
+        for step, name, stat in queue:
+            if isinstance(stat, ndarray):
+                val = onp.asarray(stat.asnumpy())
+            else:
+                val = onp.asarray(stat)
+            res.append((step, name, onp.array2string(val, precision=5)))
+        self.step += 1
+        self.queue = []
+        return res
+
+    def toc_print(self) -> None:
+        for step, name, stat in self.toc():
+            print(f"Batch: {step:7d} {name:30s} {stat}")
+
+    def uninstall(self) -> None:
+        for h in self._handles:
+            h.detach()
+        self._handles = []
+        self._exes = []
+        self._blocks = []
+
+
+class TensorInspector:
+    """Value inspection on one tensor (reference tensor_inspector.h:
+    ``print_string``, ``check_value`` with built-in negative/nan/inf
+    checkers, ``dump_to_file``)."""
+
+    NEGATIVE_CHECKER = staticmethod(lambda v: v < 0)
+    POSITIVE_CHECKER = staticmethod(lambda v: v > 0)
+    ZERO_CHECKER = staticmethod(lambda v: v == 0)
+    NAN_CHECKER = staticmethod(lambda v: onp.isnan(v))
+    INF_CHECKER = staticmethod(lambda v: onp.isinf(v))
+    FINITE_CHECKER = staticmethod(lambda v: ~onp.isfinite(v))
+
+    def __init__(self, data):
+        if isinstance(data, ndarray):
+            self._np = data.asnumpy()
+        else:
+            self._np = onp.asarray(data)
+
+    def print_string(self) -> str:
+        s = onp.array2string(self._np, threshold=64, precision=6)
+        return f"Tensor{list(self._np.shape)} {self._np.dtype}:\n{s}"
+
+    def check_value(self, checker, print_result: bool = True):
+        """Return coordinates where ``checker`` flags values (reference
+        returns the coordinate list and optionally prints it)."""
+        mask = checker(self._np)
+        coords = [tuple(int(c) for c in idx)
+                  for idx in onp.argwhere(mask)]
+        if print_result and coords:
+            print(f"TensorInspector: {len(coords)} flagged values; "
+                  f"first at {coords[0]}")
+        return coords
+
+    def dump_to_file(self, tag: str, step: int = 0) -> str:
+        fname = f"{tag}_{step}.npy"
+        onp.save(fname, self._np)
+        return fname

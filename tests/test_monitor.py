@@ -1,0 +1,109 @@
+"""mx.monitor Monitor + TensorInspector (reference python/mxnet/monitor.py,
+src/common/tensor_inspector.h)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.monitor import Monitor, TensorInspector
+
+
+def _net():
+    net = nn.HybridSequential(
+        nn.Dense(8, activation="relu", in_units=4),
+        nn.Dense(2, in_units=8),
+    )
+    net.initialize()
+    return net
+
+
+def test_monitor_taps_block_outputs():
+    net = _net()
+    mon = Monitor(interval=1)
+    mon.install(net, name="net")
+    x = mx.np.array(onp.ones((3, 4), onp.float32))
+    mon.tic()
+    net(x)
+    rows = mon.toc()
+    assert rows, "no stats collected"
+    names = [r[1] for r in rows]
+    assert any("net_output" in n for n in names)  # top-level tap
+    assert any("." in n for n in names)  # child taps
+    assert all(r[0] == 0 for r in rows)
+
+
+def test_monitor_interval_and_pattern():
+    net = _net()
+    mon = Monitor(interval=2, pattern=r".*net_output.*")
+    mon.install(net, name="net")
+    x = mx.np.array(onp.ones((3, 4), onp.float32))
+    collected = []
+    for _ in range(4):
+        mon.tic()
+        net(x)
+        collected.append(mon.toc())
+    assert collected[0] and collected[2]
+    assert not collected[1] and not collected[3]
+    for rows in (collected[0], collected[2]):
+        assert all("net_output" in r[1] for r in rows)
+
+
+def test_monitor_monitor_all_params_and_custom_stat():
+    net = _net()
+    mon = Monitor(interval=1, stat_func=lambda x: mx.np.max(mx.np.abs(x)),
+                  monitor_all=True, sort=True)
+    mon.install(net, name="net")
+    x = mx.np.array(onp.ones((3, 4), onp.float32))
+    mon.tic()
+    net(x)
+    rows = mon.toc()
+    names = [r[1] for r in rows]
+    assert any("weight" in n for n in names)  # params tapped
+    assert names == sorted(names)
+
+
+def test_monitor_uninstall_stops_taps():
+    net = _net()
+    mon = Monitor(interval=1)
+    mon.install(net)
+    mon.uninstall()
+    mon.tic()
+    net(mx.np.array(onp.ones((3, 4), onp.float32)))
+    assert mon.toc() == []
+
+
+def test_monitor_on_symbol_executor():
+    sym = mx.sym
+    x = sym.var("x")
+    y = sym.npx.relu(x * 2.0)
+    exe = y.simple_bind(x=(2, 2))
+    mon = Monitor(interval=1)
+    mon.install(exe, name="exe")
+    mon.tic()
+    exe.forward(x=onp.ones((2, 2), onp.float32))
+    rows = mon.toc()
+    names = [r[1] for r in rows]
+    assert any("relu" in n for n in names)
+    assert any(n == "x_output" for n in names)
+
+
+def test_tensor_inspector():
+    arr = mx.np.array(onp.array([[1.0, -2.0], [onp.nan, onp.inf]],
+                                onp.float32))
+    ti = TensorInspector(arr)
+    s = ti.print_string()
+    assert "Tensor[2, 2]" in s
+    assert ti.check_value(TensorInspector.NEGATIVE_CHECKER,
+                          print_result=False) == [(0, 1)]
+    assert ti.check_value(TensorInspector.NAN_CHECKER,
+                          print_result=False) == [(1, 0)]
+    flagged = ti.check_value(TensorInspector.FINITE_CHECKER,
+                             print_result=False)
+    assert set(flagged) == {(1, 0), (1, 1)}
+
+
+def test_tensor_inspector_dump(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    arr = mx.np.array(onp.arange(6.0, dtype=onp.float32).reshape(2, 3))
+    fname = TensorInspector(arr).dump_to_file("tap", step=3)
+    loaded = onp.load(fname)
+    onp.testing.assert_allclose(loaded, arr.asnumpy())
