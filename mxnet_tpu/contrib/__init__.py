@@ -1,3 +1,4 @@
 """``mx.contrib`` — experimental / auxiliary subsystems
 (reference ``python/mxnet/contrib/``)."""
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

@@ -1,0 +1,15 @@
+"""``mx.contrib.onnx`` — ONNX interchange without external dependencies.
+
+Reference surface: ``python/mxnet/contrib/onnx/`` (mx2onnx ``export_model``,
+onnx2mx ``import_model``). The environment ships no ``onnx``/``protobuf``
+package, so serialization is a built-in protobuf wire codec
+(:mod:`._proto`) against the official onnx.proto3 field numbers — the
+emitted files are standard ONNX, loadable by stock toolchains.
+
+- :func:`export_model` — HybridBlock -> .onnx via jaxpr translation
+- :func:`import_model` — .onnx -> (mx.sym Symbol, arg_params, aux_params)
+"""
+from ._export import export_model  # noqa: F401
+from ._import import import_model  # noqa: F401
+
+__all__ = ["export_model", "import_model"]

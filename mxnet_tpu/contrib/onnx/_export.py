@@ -1,0 +1,429 @@
+"""mx2onnx: export a HybridBlock's inference graph to ONNX.
+
+Parity target: reference ``python/mxnet/contrib/onnx/mx2onnx/export_model.py``
+(symbol+params -> ModelProto with per-op converter functions).
+
+TPU-first design: the reference converts nnvm symbol nodes; here the model
+is functionalized (``HybridBlock.functionalize``) and its **jaxpr** — the
+exact program XLA compiles — is translated primitive-by-primitive. That
+means anything expressible in the framework exports, not just blessed
+layer types: custom forwards, fused math, etc. Pipeline:
+
+1. trace -> closed jaxpr with params as constants
+2. dead-code elimination (drops the inference-dead RNG plumbing)
+3. inline call-like primitives (pjit/custom_jvp "relu", remat)
+4. constant-fold eqns whose inputs are all compile-time constants
+   (collapses iota/eq pooling masks into initializers)
+5. emit one-or-more ONNX ops per remaining primitive
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as onp
+from jax.extend import core as jcore
+
+from ...base import MXNetError
+from . import _proto as P
+
+# primitives that wrap an inner jaxpr to inline
+_CALL_PARAM = {
+    "jit": "jaxpr", "pjit": "jaxpr", "closed_call": "call_jaxpr",
+    "custom_jvp_call": "call_jaxpr", "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr", "remat": "jaxpr",
+    "checkpoint": "jaxpr", "remat2": "jaxpr",
+}
+
+_FOLDABLE = {
+    "iota", "eq", "ne", "lt", "le", "gt", "ge", "convert_element_type",
+    "broadcast_in_dim", "reshape", "transpose", "add", "sub", "mul", "div",
+    "max", "min", "pad", "concatenate", "select_n", "integer_pow", "pow",
+    "reduce_max", "reduce_sum", "reduce_min", "and", "or", "not", "neg",
+    "squeeze", "slice", "rev", "exp", "log", "rsqrt", "sqrt", "iota_32x2",
+}
+
+
+class _Emitter:
+    def __init__(self):
+        self.nodes: List[dict] = []
+        self.initializers: List[dict] = []
+        self._counter = 0
+        # id(jax Var) -> ("name", str) | ("const", np.ndarray)
+        self.env: Dict[int, tuple] = {}
+
+    def fresh(self, hint: str) -> str:
+        self._counter += 1
+        return f"{hint}_{self._counter}"
+
+    def add_node(self, op_type: str, inputs: List[str], n_out: int = 1,
+                 **attrs) -> List[str]:
+        outs = [self.fresh(op_type.lower()) for _ in range(n_out)]
+        self.nodes.append({
+            "op_type": op_type,
+            "name": self.fresh(op_type),
+            "input": inputs,
+            "output": outs,
+            "attribute": [_attr(k, v) for k, v in attrs.items()
+                          if v is not None],
+        })
+        return outs
+
+    def const_name(self, arr: onp.ndarray, hint: str = "const") -> str:
+        name = self.fresh(hint)
+        self.initializers.append(P.tensor_from_numpy(name, onp.asarray(arr)))
+        return name
+
+    # resolve an eqn input (Var or Literal) to (kind, payload)
+    def read(self, v) -> tuple:
+        if isinstance(v, jcore.Literal):
+            return ("const", onp.asarray(v.val))
+        return self.env[id(v)]
+
+    def input_name(self, v) -> str:
+        kind, payload = self.read(v)
+        if kind == "const":
+            return self.const_name(payload)
+        return payload
+
+
+def _attr(name: str, value) -> dict:
+    if isinstance(value, float):
+        return {"name": name, "f": value, "type": P.ATTR_FLOAT}
+    if isinstance(value, bool) or isinstance(value, int):
+        return {"name": name, "i": int(value), "type": P.ATTR_INT}
+    if isinstance(value, str):
+        return {"name": name, "s": value.encode(), "type": P.ATTR_STRING}
+    if isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, onp.integer)) for v in value):
+            return {"name": name, "ints": [int(v) for v in value],
+                    "type": P.ATTR_INTS}
+        return {"name": name, "floats": [float(v) for v in value],
+                "type": P.ATTR_FLOATS}
+    raise MXNetError(f"unsupported ONNX attribute {name}={value!r}")
+
+
+def _canonical_conv_spec(dn, lhs_rank):
+    """True iff dimension_numbers are the ONNX (N,C,spatial...) layout."""
+    canon = tuple(range(lhs_rank))
+    return (tuple(dn.lhs_spec) == canon and tuple(dn.rhs_spec) == canon
+            and tuple(dn.out_spec) == canon)
+
+
+# ---------------------------------------------------------------------------
+# per-primitive handlers: handler(em, eqn, in_names) -> list of output names
+# ---------------------------------------------------------------------------
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "exp": "Exp", "log": "Log",
+    "tanh": "Tanh", "logistic": "Sigmoid", "erf": "Erf", "neg": "Neg",
+    "abs": "Abs", "sqrt": "Sqrt", "sign": "Sign", "floor": "Floor",
+    "ceil": "Ceil", "pow": "Pow", "rem": "Mod",
+}
+
+
+def _h_simple(op_type):
+    def h(em, eqn, ins):
+        return em.add_node(op_type, ins)
+    return h
+
+
+def _h_rsqrt(em, eqn, ins):
+    (s,) = em.add_node("Sqrt", ins)
+    return em.add_node("Reciprocal", [s])
+
+
+def _h_integer_pow(em, eqn, ins):
+    y = em.const_name(onp.asarray(float(eqn.params["y"]), onp.float32), "exp")
+    return em.add_node("Pow", [ins[0], y])
+
+
+def _h_reshape(em, eqn, ins):
+    if eqn.params.get("dimensions") is not None:
+        perm = eqn.params["dimensions"]
+        (t,) = em.add_node("Transpose", [ins[0]], perm=list(perm))
+        ins = [t]
+    shape = em.const_name(
+        onp.asarray(eqn.params["new_sizes"], onp.int64), "shape")
+    return em.add_node("Reshape", [ins[0], shape])
+
+
+def _h_squeeze(em, eqn, ins):
+    out_shape = onp.asarray(eqn.outvars[0].aval.shape, onp.int64)
+    shape = em.const_name(out_shape, "shape")
+    return em.add_node("Reshape", [ins[0], shape])
+
+
+def _h_transpose(em, eqn, ins):
+    return em.add_node("Transpose", [ins[0]],
+                       perm=list(eqn.params["permutation"]))
+
+
+def _h_broadcast_in_dim(em, eqn, ins):
+    target = list(eqn.params["shape"])
+    bdims = list(eqn.params["broadcast_dimensions"])
+    # insert singleton axes so rank matches, then Expand
+    inter = [1] * len(target)
+    for src_axis, dst_axis in enumerate(bdims):
+        inter[dst_axis] = eqn.invars[0].aval.shape[src_axis]
+    shape1 = em.const_name(onp.asarray(inter, onp.int64), "shape")
+    (r,) = em.add_node("Reshape", [ins[0], shape1])
+    shape2 = em.const_name(onp.asarray(target, onp.int64), "shape")
+    return em.add_node("Expand", [r, shape2])
+
+
+def _h_reduce(op_type):
+    def h(em, eqn, ins):
+        axes = list(eqn.params["axes"])
+        if op_type == "ReduceSum":  # axes is an INPUT from opset 13 on
+            ax = em.const_name(onp.asarray(axes, onp.int64), "axes")
+            return em.add_node(op_type, [ins[0], ax], keepdims=0)
+        return em.add_node(op_type, ins, axes=axes, keepdims=0)
+    return h
+
+
+def _h_concatenate(em, eqn, ins):
+    return em.add_node("Concat", ins, axis=int(eqn.params["dimension"]))
+
+
+def _h_convert(em, eqn, ins):
+    to = P.DT[str(onp.dtype(eqn.params["new_dtype"]))
+              if str(eqn.params["new_dtype"]) != "bfloat16" else "bfloat16"]
+    return em.add_node("Cast", ins, to=to)
+
+
+def _h_pad(em, eqn, ins):
+    cfg = eqn.params["padding_config"]
+    pad_value = ins[1]
+    data = ins[0]
+    if any(i != 0 for _, _, i in cfg):
+        raise MXNetError("interior (dilation) padding not exportable to ONNX")
+    if any(lo < 0 or hi < 0 for lo, hi, _ in cfg):
+        # negative padding = crop -> Slice
+        rank = len(cfg)
+        starts = [max(0, -lo) for lo, _, _ in cfg]
+        in_shape = eqn.invars[0].aval.shape
+        ends = [in_shape[d] + min(0, cfg[d][1]) for d in range(rank)]
+        s = em.const_name(onp.asarray(starts, onp.int64), "starts")
+        e = em.const_name(onp.asarray(ends, onp.int64), "ends")
+        ax = em.const_name(onp.asarray(range(rank), onp.int64), "axes")
+        data = em.add_node("Slice", [data, s, e, ax])[0]
+        if all(max(0, lo) == 0 and max(0, hi) == 0 for lo, hi, _ in cfg):
+            return [data]
+        cfg = [(max(0, lo), max(0, hi), 0) for lo, hi, _ in cfg]
+    pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+    p = em.const_name(onp.asarray(pads, onp.int64), "pads")
+    return em.add_node("Pad", [data, p, pad_value])
+
+
+def _h_conv(em, eqn, ins):
+    dn = eqn.params["dimension_numbers"]
+    rank = len(eqn.invars[0].aval.shape)
+    if not _canonical_conv_spec(dn, rank):
+        raise MXNetError(
+            f"conv dimension_numbers {dn} are not NC-spatial; "
+            "only the framework's canonical layout is exportable")
+    if any(d != 1 for d in eqn.params["lhs_dilation"]):
+        raise MXNetError("transposed convolution (lhs_dilation) export "
+                         "is not supported yet")
+    padding = eqn.params["padding"]
+    pads = [lo for lo, _ in padding] + [hi for _, hi in padding]
+    return em.add_node(
+        "Conv", ins,
+        strides=list(eqn.params["window_strides"]),
+        pads=pads,
+        dilations=list(eqn.params["rhs_dilation"]),
+        group=int(eqn.params["feature_group_count"]),
+    )
+
+
+def _h_dot_general(em, eqn, ins):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs_rank = len(eqn.invars[0].aval.shape)
+    rhs_rank = len(eqn.invars[1].aval.shape)
+    # common case: plain matmul  (a @ b with last/first contraction)
+    if (not lb and not rb and list(lc) == [lhs_rank - 1]
+            and list(rc) == [max(rhs_rank - 2, 0)]):
+        return em.add_node("MatMul", ins)
+    # general contraction -> Einsum (opset 12+)
+    letters = "abcdefghijklmnopqrstuvwxyz"
+    it = iter(letters)
+    lhs_l = [next(it) for _ in range(lhs_rank)]
+    rhs_l = [None] * rhs_rank
+    for li, ri in zip(lb, rb):
+        rhs_l[ri] = lhs_l[li]
+    for li, ri in zip(lc, rc):
+        rhs_l[ri] = lhs_l[li]
+    for i in range(rhs_rank):
+        if rhs_l[i] is None:
+            rhs_l[i] = next(it)
+    out_l = ([lhs_l[i] for i in lb]
+             + [lhs_l[i] for i in range(lhs_rank) if i not in set(lb) | set(lc)]
+             + [rhs_l[i] for i in range(rhs_rank) if i not in set(rb) | set(rc)])
+    eq = f"{''.join(lhs_l)},{''.join(rhs_l)}->{''.join(out_l)}"
+    return em.add_node("Einsum", ins, equation=eq)
+
+
+def _h_select_n(em, eqn, ins):
+    if len(ins) != 3:
+        raise MXNetError("select_n with >2 cases not exportable")
+    # select_n(pred, on_false, on_true) -> Where(pred, on_true, on_false)
+    return em.add_node("Where", [ins[0], ins[2], ins[1]])
+
+
+def _h_slice(em, eqn, ins):
+    starts = list(eqn.params["start_indices"])
+    ends = list(eqn.params["limit_indices"])
+    strides = eqn.params.get("strides") or [1] * len(starts)
+    s = em.const_name(onp.asarray(starts, onp.int64), "starts")
+    e = em.const_name(onp.asarray(ends, onp.int64), "ends")
+    ax = em.const_name(onp.asarray(range(len(starts)), onp.int64), "axes")
+    st = em.const_name(onp.asarray(strides, onp.int64), "steps")
+    return em.add_node("Slice", [ins[0], s, e, ax, st])
+
+
+def _h_identity(em, eqn, ins):
+    return em.add_node("Identity", [ins[0]])
+
+
+_HANDLERS: Dict[str, Callable] = {
+    **{prim: _h_simple(op) for prim, op in _SIMPLE.items()},
+    "rsqrt": _h_rsqrt,
+    "integer_pow": _h_integer_pow,
+    "reshape": _h_reshape,
+    "squeeze": _h_squeeze,
+    "transpose": _h_transpose,
+    "broadcast_in_dim": _h_broadcast_in_dim,
+    "reduce_max": _h_reduce("ReduceMax"),
+    "reduce_min": _h_reduce("ReduceMin"),
+    "reduce_sum": _h_reduce("ReduceSum"),
+    "concatenate": _h_concatenate,
+    "convert_element_type": _h_convert,
+    "pad": _h_pad,
+    "conv_general_dilated": _h_conv,
+    "dot_general": _h_dot_general,
+    "select_n": _h_select_n,
+    "slice": _h_slice,
+    "stop_gradient": _h_identity,
+    "copy": _h_identity,
+}
+
+
+def _fold(eqn, const_ins):
+    """Evaluate a constant eqn eagerly on CPU via primitive.bind."""
+    with jax.default_device(jax.devices("cpu")[0]):
+        out = eqn.primitive.bind(*const_ins, **eqn.params)
+    outs = out if eqn.primitive.multiple_results else [out]
+    return [onp.asarray(o) for o in outs]
+
+
+def _emit_jaxpr(em: _Emitter, jaxpr, consts, in_entries):
+    """Walk one jaxpr; in_entries are env entries for jaxpr.invars."""
+    for cv, cval in zip(jaxpr.constvars, consts):
+        em.env[id(cv)] = ("const", onp.asarray(cval))
+    for iv, entry in zip(jaxpr.invars, in_entries):
+        em.env[id(iv)] = entry
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim in _CALL_PARAM:
+            inner = eqn.params[_CALL_PARAM[prim]]
+            if hasattr(inner, "jaxpr"):  # ClosedJaxpr
+                inner_jaxpr, inner_consts = inner.jaxpr, inner.consts
+            else:
+                inner_jaxpr, inner_consts = inner, []
+            entries = [em.read(v) for v in eqn.invars]
+            # custom_jvp passes the primal fn's args only; extra invars
+            # (e.g. jvp residuals) do not exist on the call path
+            outs = _emit_jaxpr(em, inner_jaxpr, inner_consts,
+                               entries[:len(inner_jaxpr.invars)])
+            for ov, entry in zip(eqn.outvars, outs):
+                em.env[id(ov)] = entry
+            continue
+
+        entries = [em.read(v) for v in eqn.invars]
+        if all(k == "const" for k, _ in entries) and prim in _FOLDABLE:
+            folded = _fold(eqn, [p for _, p in entries])
+            for ov, arr in zip(eqn.outvars, folded):
+                em.env[id(ov)] = ("const", arr)
+            continue
+
+        handler = _HANDLERS.get(prim)
+        if handler is None:
+            raise MXNetError(
+                f"primitive {prim!r} has no ONNX translation "
+                f"(shape {[v.aval.shape for v in eqn.invars]})")
+        ins = [em.input_name(v) for v in eqn.invars]
+        outs = handler(em, eqn, ins)
+        for ov, name in zip(eqn.outvars, outs):
+            em.env[id(ov)] = ("name", name)
+    return [em.read(v) for v in jaxpr.outvars]
+
+
+def export_model(net, example_input, path: str, producer: str = "mxnet_tpu",
+                 opset: int = 13) -> str:
+    """Export ``net``'s inference graph to ``path`` (.onnx).
+
+    ``net`` — an initialized HybridBlock (or any object with
+    ``functionalize``); ``example_input`` — one ndarray or a tuple fixing
+    input shapes/dtypes. Reference: mx2onnx ``export_model``.
+    """
+    import jax.numpy as jnp
+
+    from ...ndarray.ndarray import ndarray as _nd, _unwrap
+    from jax.interpreters.partial_eval import dce_jaxpr
+
+    inputs = example_input if isinstance(example_input, (tuple, list)) \
+        else (example_input,)
+    fn, params = net.functionalize(*inputs, training=False)
+    ivals = [_unwrap(v) for v in inputs]
+
+    def infer(*vals):
+        out, _state = fn(params, *vals)
+        leaves = jax.tree_util.tree_leaves(out)
+        return tuple(leaves)
+
+    closed = jax.make_jaxpr(infer)(*ivals)
+    jaxpr, jconsts = closed.jaxpr, closed.consts
+    jaxpr, used = dce_jaxpr(jaxpr, [True] * len(jaxpr.outvars))
+
+    em = _Emitter()
+    in_names, graph_inputs = [], []
+    live = [v for v, u in zip(ivals, used) if u]
+    for i, v in enumerate(onp.asarray(u) for u in live):
+        name = f"data{i}" if len(live) > 1 else "data"
+        in_names.append(("name", name))
+        graph_inputs.append(P.value_info(name, v.shape, v.dtype))
+
+    out_entries = _emit_jaxpr(em, jaxpr, jconsts, in_names)
+    graph_outputs = []
+    for i, (entry, ov) in enumerate(zip(out_entries, jaxpr.outvars)):
+        oname = f"output{i}" if len(out_entries) > 1 else "output"
+        kind, payload = entry
+        if kind == "const":
+            src = em.const_name(payload, "out_const")
+            em.nodes.append({"op_type": "Identity", "name": em.fresh("Identity"),
+                             "input": [src], "output": [oname],
+                             "attribute": []})
+        else:
+            em.nodes.append({"op_type": "Identity", "name": em.fresh("Identity"),
+                             "input": [payload], "output": [oname],
+                             "attribute": []})
+        graph_outputs.append(P.value_info(oname, ov.aval.shape, ov.aval.dtype))
+
+    model = {
+        "ir_version": 8,
+        "producer_name": producer,
+        "producer_version": "2.0.0.tpu",
+        "opset_import": [{"domain": "", "version": opset}],
+        "graph": {
+            "name": getattr(net, "name", type(net).__name__),
+            "node": em.nodes,
+            "initializer": em.initializers,
+            "input": graph_inputs,
+            "output": graph_outputs,
+        },
+    }
+    with open(path, "wb") as f:
+        f.write(P.encode("ModelProto", model))
+    return path

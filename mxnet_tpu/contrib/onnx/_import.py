@@ -1,0 +1,263 @@
+"""onnx2mx: import an ONNX model as an ``mx.sym`` Symbol + params.
+
+Parity target: reference ``python/mxnet/contrib/onnx/onnx2mx/import_model.py``
+(returns ``(sym, arg_params, aux_params)``). Same contract here: the graph
+becomes a Symbol over the framework's own op library, argument arrays come
+from the initializers, and inference runs through the symbol Executor (one
+jit-compiled XLA program).
+
+Covers the op subset our exporter emits plus the classic vision-model ops
+external exporters produce (Relu, Gemm, Flatten, BatchNormalization,
+MaxPool, AveragePool, GlobalAveragePool, Softmax, Clip...).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as onp
+
+from ...base import MXNetError
+from . import _proto as P
+
+
+def _attrs(node: dict) -> Dict[str, Any]:
+    out = {}
+    for a in node.get("attribute", []):
+        t = a.get("type")
+        if t == P.ATTR_FLOAT:
+            out[a["name"]] = a.get("f", 0.0)
+        elif t == P.ATTR_INT:
+            out[a["name"]] = a.get("i", 0)
+        elif t == P.ATTR_STRING:
+            out[a["name"]] = a.get("s", b"").decode()
+        elif t == P.ATTR_FLOATS:
+            out[a["name"]] = list(a.get("floats", []))
+        elif t == P.ATTR_INTS:
+            out[a["name"]] = list(a.get("ints", []))
+        elif t == P.ATTR_TENSOR:
+            out[a["name"]] = P.tensor_to_numpy(a["t"])
+        else:
+            raise MXNetError(f"unsupported attribute type {t}")
+    return out
+
+
+def _const_of(env, name):
+    """Return the compile-time numpy value a name is bound to, or None."""
+    return env.get("__consts__", {}).get(name)
+
+
+# each handler: (sym_mod, env, inputs(list of Symbol), attrs, node) -> Symbol
+# or list of Symbols for multi-output ops
+def _import_node(sym, env, node):
+    op = node["op_type"]
+    attrs = _attrs(node)
+    consts = env["__consts__"]
+
+    def sin(i):
+        name = node["input"][i]
+        if name == "":
+            return None
+        return env[name]
+
+    def cval(i):
+        name = node["input"][i] if i < len(node["input"]) else ""
+        return consts.get(name)
+
+    n_in = len(node["input"])
+
+    if op == "Identity":
+        return sin(0)
+    if op in ("Add", "Sub", "Mul", "Div", "Pow", "Max", "Min"):
+        fn = {"Add": sym.np.add, "Sub": sym.np.subtract,
+              "Mul": sym.np.multiply, "Div": sym.np.divide,
+              "Pow": sym.np.power, "Max": sym.np.maximum,
+              "Min": sym.np.minimum}[op]
+        return fn(sin(0), sin(1))
+    if op in ("Exp", "Log", "Tanh", "Sqrt", "Neg", "Abs", "Sign",
+              "Floor", "Ceil", "Erf", "Reciprocal"):
+        fn = {"Exp": sym.np.exp, "Log": sym.np.log, "Tanh": sym.np.tanh,
+              "Sqrt": sym.np.sqrt, "Neg": sym.np.negative,
+              "Abs": sym.np.abs, "Sign": sym.np.sign,
+              "Floor": sym.np.floor, "Ceil": sym.np.ceil,
+              "Erf": sym.npx.erf,
+              "Reciprocal": sym.np.reciprocal}[op]
+        return fn(sin(0))
+    if op == "Sigmoid":
+        return sym.npx.sigmoid(sin(0))
+    if op == "Relu":
+        return sym.npx.relu(sin(0))
+    if op == "Cast":
+        to = P.DT_REV[attrs["to"]]
+        return sym.np.astype(sin(0), to)
+    if op == "Clip":
+        lo = cval(1) if n_in > 1 else attrs.get("min")
+        hi = cval(2) if n_in > 2 else attrs.get("max")
+        return sym.np.clip(sin(0),
+                           None if lo is None else float(lo),
+                           None if hi is None else float(hi))
+    if op == "Reshape":
+        shape = cval(1)
+        if shape is None:
+            raise MXNetError("Reshape with runtime shape is unsupported")
+        return sym.np.reshape(sin(0), [int(s) for s in shape])
+    if op == "Flatten":
+        axis = attrs.get("axis", 1)
+        if axis != 1:
+            raise MXNetError("Flatten axis != 1 unsupported")
+        return sym.npx.batch_flatten(sin(0))
+    if op == "Transpose":
+        return sym.np.transpose(sin(0), attrs.get("perm"))
+    if op == "Expand":
+        shape = cval(1)
+        return sym.np.broadcast_to(sin(0), [int(s) for s in shape])
+    if op == "Concat":
+        parts = [sin(i) for i in range(n_in)]
+        return sym.np.concatenate(parts, axis=attrs.get("axis", 0))
+    if op in ("ReduceMax", "ReduceMin", "ReduceMean", "ReduceSum"):
+        axes = attrs.get("axes")
+        if axes is None and n_in > 1:
+            axes = [int(a) for a in cval(1)]
+        fn = {"ReduceMax": sym.np.max, "ReduceMin": sym.np.min,
+              "ReduceMean": sym.np.mean, "ReduceSum": sym.np.sum}[op]
+        return fn(sin(0), axis=tuple(axes) if axes else None,
+                  keepdims=bool(attrs.get("keepdims", 1)))
+    if op == "MatMul":
+        return sym.np.matmul(sin(0), sin(1))
+    if op == "Einsum":
+        parts = [sin(i) for i in range(n_in)]
+        return sym.np.einsum(attrs["equation"], *parts)
+    if op == "Gemm":
+        a, b = sin(0), sin(1)
+        if attrs.get("transA"):
+            a = sym.np.transpose(a)
+        if attrs.get("transB"):
+            b = sym.np.transpose(b)
+        y = sym.np.matmul(a, b) * attrs.get("alpha", 1.0)
+        if n_in > 2:
+            y = y + sin(2) * attrs.get("beta", 1.0)
+        return y
+    if op == "Where":
+        return sym.np.where(sin(0), sin(1), sin(2))
+    if op == "Slice":
+        starts = cval(1) if n_in > 1 else attrs["starts"]
+        ends = cval(2) if n_in > 2 else attrs["ends"]
+        axes = (cval(3) if n_in > 3 else attrs.get("axes")) \
+            or list(range(len(starts)))
+        steps = (cval(4) if n_in > 4 else None)
+        steps = steps if steps is not None else [1] * len(starts)
+        if any(int(a) < 0 for a in axes):
+            raise MXNetError(
+                "Slice with negative axes needs the data rank, which the "
+                "importer does not track; re-export with positive axes")
+        rank = max(int(a) for a in axes) + 1
+        begin = [None] * rank
+        end = [None] * rank
+        step = [1] * rank
+        for a, s, e, st in zip(axes, starts, ends, steps):
+            begin[int(a)], end[int(a)], step[int(a)] = int(s), int(e), int(st)
+        return sym.npx.slice(sin(0), begin, end, step)
+    if op == "Pad":
+        pads = cval(1) if n_in > 1 else attrs["pads"]
+        value = cval(2) if n_in > 2 else attrs.get("value", 0.0)
+        rank = len(pads) // 2
+        width = [(int(pads[i]), int(pads[i + rank])) for i in range(rank)]
+        return sym.np.pad(sin(0), width, constant_values=float(value))
+    if op == "Conv":
+        group = attrs.get("group", 1)
+        strides = attrs.get("strides")
+        dil = attrs.get("dilations")
+        pads = attrs.get("pads")
+        kernel_rank = None
+        w = cval(1)
+        if w is not None:
+            kernel_rank = w.ndim - 2
+        rank = kernel_rank or (len(strides) if strides else 2)
+        pads = pads or [0] * (2 * rank)
+        lo, hi = pads[:rank], pads[rank:]
+        if lo != hi:
+            raise MXNetError("asymmetric Conv pads unsupported")
+        return sym.npx.convolution(
+            sin(0), env[node["input"][1]],
+            sin(2) if n_in > 2 else None,
+            stride=tuple(strides) if strides else 1,
+            dilate=tuple(dil) if dil else 1,
+            pad=tuple(lo), num_group=group)
+    if op == "BatchNormalization":
+        return sym.npx.batch_norm(
+            sin(0), sin(1), sin(2), sin(3), sin(4),
+            eps=attrs.get("epsilon", 1e-5),
+            momentum=attrs.get("momentum", 0.9), use_global_stats=True)
+    if op in ("MaxPool", "AveragePool"):
+        kernel = attrs["kernel_shape"]
+        strides = attrs.get("strides") or [1] * len(kernel)
+        pads = attrs.get("pads") or [0] * (2 * len(kernel))
+        rank = len(kernel)
+        lo, hi = pads[:rank], pads[rank:]
+        if lo != hi:
+            raise MXNetError("asymmetric pool pads unsupported")
+        return sym.npx.pooling(
+            sin(0), kernel=tuple(kernel),
+            pool_type="max" if op == "MaxPool" else "avg",
+            stride=tuple(strides), pad=tuple(lo),
+            count_include_pad=bool(attrs.get("count_include_pad", 0)))
+    if op == "GlobalAveragePool":
+        return sym.npx.pooling(sin(0), pool_type="avg", global_pool=True)
+    if op == "GlobalMaxPool":
+        return sym.npx.pooling(sin(0), pool_type="max", global_pool=True)
+    if op == "Softmax":
+        return sym.npx.softmax(sin(0), axis=attrs.get("axis", -1))
+    if op == "LogSoftmax":
+        return sym.npx.log_softmax(sin(0), axis=attrs.get("axis", -1))
+    if op == "Dropout":
+        return sym.npx.dropout(sin(0), p=attrs.get("ratio", 0.5))
+    if op == "Constant":
+        val = attrs.get("value")
+        raise MXNetError("bare Constant nodes should be pre-resolved")
+    raise MXNetError(f"ONNX op {op!r} has no importer")
+
+
+def import_model(path: str):
+    """Load an .onnx file -> ``(sym, arg_params, aux_params)`` exactly like
+    the reference onnx2mx ``import_model``. ``arg_params`` maps initializer
+    names to ndarrays; graph inputs that are not initializers become free
+    symbol variables."""
+    from ... import numpy as mxnp
+    from ... import symbol as sym_mod
+
+    with open(path, "rb") as f:
+        model = P.decode("ModelProto", f.read())
+    graph = model["graph"]
+
+    arg_params: Dict[str, Any] = {}
+    consts: Dict[str, onp.ndarray] = {}
+    env: Dict[str, Any] = {"__consts__": consts}
+
+    for init in graph.get("initializer", []):
+        arr = P.tensor_to_numpy(init)
+        consts[init["name"]] = arr
+        arg_params[init["name"]] = mxnp.array(arr)
+        env[init["name"]] = sym_mod.var(init["name"])
+
+    for vi in graph.get("input", []):
+        if vi["name"] not in env:
+            env[vi["name"]] = sym_mod.var(vi["name"])
+
+    for node in graph.get("node", []):
+        if node["op_type"] == "Constant":
+            attrs = _attrs(node)
+            arr = attrs.get("value")
+            consts[node["output"][0]] = onp.asarray(arr)
+            arg_params[node["output"][0]] = mxnp.array(onp.asarray(arr))
+            env[node["output"][0]] = sym_mod.var(node["output"][0])
+            continue
+        out = _import_node(sym_mod, env, node)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for name, s in zip(node["output"], outs):
+            env[name] = s
+
+    heads = [env[vi["name"]] for vi in graph.get("output", [])]
+    sym = heads[0] if len(heads) == 1 else sym_mod.Group(heads)
+    # drop params the graph ended up not referencing
+    used = set(sym.list_arguments())
+    arg_params = {k: v for k, v in arg_params.items() if k in used}
+    return sym, arg_params, {}

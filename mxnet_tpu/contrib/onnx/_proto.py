@@ -1,0 +1,324 @@
+"""Minimal protobuf wire-format codec for the ONNX message subset.
+
+The environment has no ``onnx`` (or ``protobuf``) package, so the
+interchange bytes are produced/consumed directly against the protobuf
+wire format (varint / 64-bit / length-delimited / 32-bit records) using
+the field numbers of the official ``onnx.proto3``. Files written here
+load in stock ``onnx``/onnxruntime; files produced by stock exporters
+parse here (for the message subset we model).
+
+Schema source: onnx/onnx.proto3 (field numbers cited inline).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as onp
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+
+def _enc_varint(value: int) -> bytes:
+    if value < 0:
+        value += 1 << 64  # proto int64 negative -> 10-byte varint
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _dec_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed64(value: int) -> int:
+    return value - (1 << 64) if value >= (1 << 63) else value
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _enc_varint((field << 3) | wire)
+
+
+# ---------------------------------------------------------------------------
+# declarative schemas: field -> (name, kind[, submessage])
+# kind: int / float32 / str / bytes / msg / repeated variants (r*)
+# ---------------------------------------------------------------------------
+TENSOR = "TensorProto"
+SCHEMAS: Dict[str, Dict[int, tuple]] = {
+    "ModelProto": {
+        1: ("ir_version", "int"),
+        2: ("producer_name", "str"),
+        3: ("producer_version", "str"),
+        4: ("domain", "str"),
+        5: ("model_version", "int"),
+        6: ("doc_string", "str"),
+        7: ("graph", "msg", "GraphProto"),
+        8: ("opset_import", "rmsg", "OperatorSetIdProto"),
+    },
+    "OperatorSetIdProto": {
+        1: ("domain", "str"),
+        2: ("version", "int"),
+    },
+    "GraphProto": {
+        1: ("node", "rmsg", "NodeProto"),
+        2: ("name", "str"),
+        5: ("initializer", "rmsg", TENSOR),
+        10: ("doc_string", "str"),
+        11: ("input", "rmsg", "ValueInfoProto"),
+        12: ("output", "rmsg", "ValueInfoProto"),
+        13: ("value_info", "rmsg", "ValueInfoProto"),
+    },
+    "NodeProto": {
+        1: ("input", "rstr"),
+        2: ("output", "rstr"),
+        3: ("name", "str"),
+        4: ("op_type", "str"),
+        5: ("attribute", "rmsg", "AttributeProto"),
+        6: ("doc_string", "str"),
+        7: ("domain", "str"),
+    },
+    "AttributeProto": {
+        1: ("name", "str"),
+        2: ("f", "float32"),
+        3: ("i", "int"),
+        4: ("s", "bytes"),
+        5: ("t", "msg", TENSOR),
+        7: ("floats", "rfloat32"),
+        8: ("ints", "rint"),
+        9: ("strings", "rbytes"),
+        20: ("type", "int"),
+    },
+    TENSOR: {
+        1: ("dims", "rint"),
+        2: ("data_type", "int"),
+        4: ("float_data", "rfloat32"),
+        5: ("int32_data", "rint"),
+        7: ("int64_data", "rint"),
+        8: ("name", "str"),
+        9: ("raw_data", "bytes"),
+        10: ("double_data", "rdouble"),
+    },
+    "ValueInfoProto": {
+        1: ("name", "str"),
+        2: ("type", "msg", "TypeProto"),
+        3: ("doc_string", "str"),
+    },
+    "TypeProto": {
+        1: ("tensor_type", "msg", "TypeProto.Tensor"),
+    },
+    "TypeProto.Tensor": {
+        1: ("elem_type", "int"),
+        2: ("shape", "msg", "TensorShapeProto"),
+    },
+    "TensorShapeProto": {
+        1: ("dim", "rmsg", "TensorShapeProto.Dimension"),
+    },
+    "TensorShapeProto.Dimension": {
+        1: ("dim_value", "int"),
+        2: ("dim_param", "str"),
+    },
+}
+
+# AttributeProto.AttributeType (onnx.proto3)
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+# TensorProto.DataType
+DT = {
+    "float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+    "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+    "uint32": 12, "uint64": 13, "bfloat16": 16,
+}
+DT_REV = {v: k for k, v in DT.items()}
+
+
+def encode(msg_type: str, obj: Dict[str, Any]) -> bytes:
+    """Encode a plain dict against SCHEMAS[msg_type]."""
+    schema = SCHEMAS[msg_type]
+    byname = {entry[0]: (field, entry) for field, entry in schema.items()}
+    out = bytearray()
+    for name, value in obj.items():
+        if value is None:
+            continue
+        if name not in byname:
+            raise KeyError(f"{msg_type} has no field {name!r}")
+        field, entry = byname[name]
+        kind = entry[1]
+        if kind == "int":
+            out += _key(field, 0) + _enc_varint(int(value))
+        elif kind == "float32":
+            out += _key(field, 5) + struct.pack("<f", float(value))
+        elif kind == "str":
+            data = value.encode("utf-8")
+            out += _key(field, 2) + _enc_varint(len(data)) + data
+        elif kind == "bytes":
+            out += _key(field, 2) + _enc_varint(len(value)) + bytes(value)
+        elif kind == "msg":
+            data = encode(entry[2], value)
+            out += _key(field, 2) + _enc_varint(len(data)) + data
+        elif kind == "rmsg":
+            for item in value:
+                data = encode(entry[2], item)
+                out += _key(field, 2) + _enc_varint(len(data)) + data
+        elif kind == "rstr":
+            for item in value:
+                data = item.encode("utf-8")
+                out += _key(field, 2) + _enc_varint(len(data)) + data
+        elif kind == "rbytes":
+            for item in value:
+                out += _key(field, 2) + _enc_varint(len(item)) + bytes(item)
+        elif kind == "rint":  # packed (proto3 default)
+            data = b"".join(_enc_varint(int(v)) for v in value)
+            out += _key(field, 2) + _enc_varint(len(data)) + data
+        elif kind == "rfloat32":
+            data = struct.pack(f"<{len(value)}f", *[float(v) for v in value])
+            out += _key(field, 2) + _enc_varint(len(data)) + data
+        elif kind == "rdouble":
+            data = struct.pack(f"<{len(value)}d", *[float(v) for v in value])
+            out += _key(field, 2) + _enc_varint(len(data)) + data
+        else:
+            raise AssertionError(kind)
+    return bytes(out)
+
+
+def decode(msg_type: str, buf: bytes) -> Dict[str, Any]:
+    """Decode bytes into a plain dict; repeated fields become lists.
+    Unknown fields are skipped (forward compatibility)."""
+    schema = SCHEMAS[msg_type]
+    obj: Dict[str, Any] = {}
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _dec_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        entry = schema.get(field)
+        # read the payload per wire type
+        if wire == 0:
+            value, pos = _dec_varint(buf, pos)
+        elif wire == 1:
+            value = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            length, pos = _dec_varint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire == 5:
+            value = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        if entry is None:
+            continue  # unknown field
+        name, kind = entry[0], entry[1]
+        if kind == "int":
+            obj[name] = _signed64(value if wire == 0 else
+                                  int.from_bytes(value, "little"))
+        elif kind == "float32":
+            obj[name] = struct.unpack("<f", value)[0] if wire == 5 else value
+        elif kind == "str":
+            obj[name] = value.decode("utf-8")
+        elif kind == "bytes":
+            obj[name] = bytes(value)
+        elif kind == "msg":
+            obj[name] = decode(entry[2], value)
+        elif kind == "rmsg":
+            obj.setdefault(name, []).append(decode(entry[2], value))
+        elif kind == "rstr":
+            obj.setdefault(name, []).append(value.decode("utf-8"))
+        elif kind == "rbytes":
+            obj.setdefault(name, []).append(bytes(value))
+        elif kind == "rint":
+            lst = obj.setdefault(name, [])
+            if wire == 0:
+                lst.append(_signed64(value))
+            else:  # packed
+                p = 0
+                while p < len(value):
+                    v, p = _dec_varint(value, p)
+                    lst.append(_signed64(v))
+        elif kind == "rfloat32":
+            lst = obj.setdefault(name, [])
+            if wire == 5:
+                lst.append(struct.unpack("<f", value)[0])
+            else:
+                lst.extend(struct.unpack(f"<{len(value) // 4}f", value))
+        elif kind == "rdouble":
+            lst = obj.setdefault(name, [])
+            if wire == 1:
+                lst.append(struct.unpack("<d", value)[0])
+            else:
+                lst.extend(struct.unpack(f"<{len(value) // 8}d", value))
+        else:
+            raise AssertionError(kind)
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# tensor <-> numpy
+# ---------------------------------------------------------------------------
+def tensor_from_numpy(name: str, arr: onp.ndarray) -> Dict[str, Any]:
+    dtype = str(arr.dtype)
+    if dtype == "bfloat16":  # ml_dtypes name passes through
+        code = DT["bfloat16"]
+    elif dtype not in DT:
+        raise TypeError(f"unsupported ONNX tensor dtype {dtype}")
+    else:
+        code = DT[dtype]
+    return {
+        "name": name,
+        "dims": list(arr.shape),
+        "data_type": code,
+        "raw_data": onp.ascontiguousarray(arr).tobytes(),
+    }
+
+
+def tensor_to_numpy(t: Dict[str, Any]) -> onp.ndarray:
+    code = t.get("data_type", 1)
+    dtype_name = DT_REV[code]
+    if dtype_name == "bfloat16":
+        import ml_dtypes
+
+        dtype = ml_dtypes.bfloat16
+    else:
+        dtype = onp.dtype(dtype_name)
+    dims = t.get("dims", [])
+    if "raw_data" in t and t["raw_data"]:
+        return onp.frombuffer(t["raw_data"], dtype=dtype).reshape(dims).copy()
+    if t.get("float_data"):
+        return onp.asarray(t["float_data"], dtype=dtype).reshape(dims)
+    if t.get("int64_data"):
+        return onp.asarray(t["int64_data"], dtype=dtype).reshape(dims)
+    if t.get("int32_data"):
+        if dtype_name in ("float16", "bfloat16"):
+            # spec: fp16/bf16 live in int32_data as raw 16-bit patterns
+            bits = onp.asarray(t["int32_data"], dtype=onp.uint16)
+            return bits.view(dtype).reshape(dims)
+        return onp.asarray(t["int32_data"], dtype=dtype).reshape(dims)
+    if t.get("double_data"):
+        return onp.asarray(t["double_data"], dtype=dtype).reshape(dims)
+    return onp.zeros(dims, dtype=dtype)
+
+
+def value_info(name: str, shape, dtype) -> Dict[str, Any]:
+    return {
+        "name": name,
+        "type": {"tensor_type": {
+            "elem_type": DT[str(onp.dtype(dtype)) if str(dtype) != "bfloat16"
+                            else "bfloat16"],
+            "shape": {"dim": [{"dim_value": int(d)} for d in shape]},
+        }},
+    }

@@ -67,14 +67,25 @@ class Monitor:
         if isinstance(target, Executor):
             self._exes.append((name or "exe%d" % len(self._exes), target))
         elif isinstance(target, Block):
-            self._install_block(target, name or type(target).__name__.lower())
+            prefix = name or type(target).__name__.lower()
+            if getattr(target, "_active", False):
+                import warnings
+
+                warnings.warn(
+                    "Monitor installed on a hybridized block: child forward "
+                    "hooks do not run inside the cached XLA graph, so only "
+                    "the top-level output is tapped. Call hybridize(False) "
+                    "while monitoring for per-layer taps.", stacklevel=2)
+            # params are collected from install roots only (recursively via
+            # collect_params) — child blocks get hooks, not param taps
+            self._blocks.append((prefix, target))
+            self._install_block(target, prefix)
         else:
             raise MXNetError(
                 f"Monitor.install expects an Executor or Block, got "
                 f"{type(target).__name__}")
 
     def _install_block(self, block, prefix: str) -> None:
-        self._blocks.append((prefix, block))
 
         def make_hook(tap_name):
             def hook(blk, args, out):
@@ -119,9 +130,10 @@ class Monitor:
         if self.monitor_all:
             for prefix, block in self._blocks:
                 for pname, p in block.collect_params().items():
-                    if p._data is not None and self.pattern.match(pname):
+                    full = f"{prefix}.{pname}"
+                    if p._data is not None and self.pattern.match(full):
                         self.queue.append(
-                            (self.step, pname, self.stat_func(p.data())))
+                            (self.step, full, self.stat_func(p.data())))
         self.activated = False
         res = []
         queue = sorted(self.queue, key=lambda q: q[1]) if self.sort \

@@ -456,6 +456,12 @@ def convolve(a, v, mode="full"):
     return _call(lambda x, y: jnp.convolve(x, y, mode=mode), (_c(a), _c(v)), name="convolve")
 
 
+def astype(a, dtype):
+    """Functional dtype cast (array-API style; ndarray.astype's twin)."""
+    dt = dtype_from_any(dtype)
+    return _call(lambda x: x.astype(dt), (a,), name="astype")
+
+
 def clip(a, a_min=None, a_max=None, out=None):
     res = _call(lambda x: jnp.clip(x, a_min, a_max), (a,), name="clip")
     if out is not None:

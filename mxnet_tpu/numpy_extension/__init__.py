@@ -327,11 +327,14 @@ def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
 
 
 def slice_like(data, shape_like, axes=()):
+    import builtins
+
     def fn(d, s):
-        slices = [slice(None)] * d.ndim
+        # builtins.slice: the module-level `slice` is the npx op below
+        slices = [builtins.slice(None)] * d.ndim
         use = axes if axes else range(d.ndim)
         for ax in use:
-            slices[ax] = slice(0, s.shape[ax])
+            slices[ax] = builtins.slice(0, s.shape[ax])
         return d[tuple(slices)]
 
     return _call(fn, (data, shape_like), name="slice_like")
@@ -339,6 +342,23 @@ def slice_like(data, shape_like, axes=()):
 
 def reshape_like(lhs, rhs):
     return _call(lambda a, b: a.reshape(b.shape), (lhs, rhs), name="reshape_like")
+
+
+def batch_flatten(data):
+    """Collapse all non-batch dims (reference npx.batch_flatten)."""
+    return _call(lambda x: x.reshape(x.shape[0], -1), (data,),
+                 name="batch_flatten")
+
+
+def slice(data, begin, end, step=None):  # noqa: A001 - reference op name
+    """Strided crop (reference npx.slice / src/operator/tensor/slice).
+    ``begin``/``end`` entries may be None meaning from-start / to-end."""
+    import builtins
+
+    step = step or [1] * len(begin)
+    idx = tuple(builtins.slice(b, e, s)
+                for b, e, s in zip(begin, end, step))
+    return _call(lambda x: x[idx], (data,), name="slice")
 
 
 def shape_array(data):

@@ -245,7 +245,12 @@ class Symbol:
             ins = [values[id(i)][s] for i, s in node.inputs]
             args, it = [], iter(ins)
             for marker in node.pos_spec:
-                args.append(next(it) if marker[0] == "sym" else marker[1])
+                if marker[0] == "sym":
+                    args.append(next(it))
+                elif marker[0] == "seq":
+                    args.append([next(it) for _ in range(marker[1])])
+                else:
+                    args.append(marker[1])
             kwargs = dict(node.kwargs)
             for kname in node.kw_sym:
                 kwargs[kname] = next(it)
@@ -592,6 +597,15 @@ def _make_op_symbol(opname: str, fn, args, kwargs) -> Symbol:
                 raise MXNetError("cannot pass a grouped symbol as an op input")
             pos_spec.append(["sym", len(inputs)])
             inputs.append(a._heads[0])
+        elif (isinstance(a, (list, tuple))
+              and any(isinstance(s, Symbol) for s in a)):
+            # sequence-of-symbols argument (concatenate/stack/...)
+            if not all(isinstance(s, Symbol) and len(s._heads) == 1
+                       for s in a):
+                raise MXNetError(
+                    "sequence op inputs must be single-output Symbols")
+            pos_spec.append(["seq", len(a)])
+            inputs.extend(s._heads[0] for s in a)
         else:
             pos_spec.append(["const", a])
     const_kwargs = {}

@@ -159,21 +159,21 @@ def test_image_record_iter(tmp_path):
 
     path = str(tmp_path / "imgs.rec")
     rng = onp.random.RandomState(0)
-    n, shape = 10, (3, 8, 8)
+    n, shape = 10, (3, 8, 8)  # data_shape is CHW; stored images are HWC
     w = recordio.MXRecordIO(path, "w")
     imgs = []
     for i in range(n):
-        img = rng.randint(0, 255, size=shape).astype(onp.uint8)
+        img = rng.randint(0, 255, size=(8, 8, 3)).astype(onp.uint8)
         imgs.append(img)
         hdr = recordio.IRHeader(0, float(i % 4), i, 0)
-        w.write(recordio.pack_img(hdr, img))
+        w.write(recordio.pack_img(hdr, img, img_fmt=".png"))  # lossless
     w.close()
     it = mio.ImageRecordIter(path, batch_size=4, data_shape=shape)
     batches = list(it)
     assert len(batches) == 3
     assert batches[0].data[0].shape == (4,) + shape
     onp.testing.assert_allclose(batches[0].data[0].asnumpy()[0],
-                                imgs[0].astype(onp.float32))
+                                imgs[0].transpose(2, 0, 1).astype(onp.float32))
     onp.testing.assert_allclose(batches[0].label[0].asnumpy(),
                                 [0.0, 1.0, 2.0, 3.0])
     # reset and stream again through the native prefetcher
