@@ -60,6 +60,7 @@ def __getattr__(name):
 
     targets = {"test_utils": ".test_utils", "image": ".image", "amp": ".amp",
                "io": ".io", "monitor": ".monitor", "contrib": ".contrib",
+               "checkpoint": ".checkpoint",
                "parallel": ".parallel", "random": ".numpy.random",
                "sym": ".symbol", "symbol": ".symbol"}
     if name in targets:

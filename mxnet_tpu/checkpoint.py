@@ -1,0 +1,147 @@
+"""``mx.checkpoint`` — sharded, distributed-ready checkpointing.
+
+Reference baseline: single-file ``.params`` save/load owned by rank 0
+(``src/ndarray/ndarray.cc`` save/load, ``gluon/block.py:440
+save_parameters``). SURVEY.md §5 names orbax-style sharded checkpoint the
+required TPU upgrade: every host writes only its own shards, restore can
+re-shard onto a different mesh, and optimizer state rides along. This
+module provides that on top of orbax/tensorstore while keeping the
+``.params`` single-file format for model-zoo parity
+(:func:`mxnet_tpu.serialization.save_params`).
+
+- :func:`save_sharded` / :func:`load_sharded` — one pytree, one directory
+- :class:`CheckpointManager` — step-numbered checkpoints with retention,
+  the estimator ``CheckpointHandler``'s storage backend
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import ndarray, _unwrap
+
+__all__ = ["save_sharded", "load_sharded", "CheckpointManager"]
+
+
+def _to_jax_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: _unwrap(v) if isinstance(v, ndarray) else v, tree,
+        is_leaf=lambda v: isinstance(v, ndarray))
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    # synchronous Checkpointer: the async variant's background flush can
+    # outlive short-lived processes (interpreter-shutdown races)
+    return ocp.Checkpointer(ocp.StandardCheckpointHandler())
+
+
+def save_sharded(path: str, tree: Any) -> str:
+    """Write a pytree of (possibly mesh-sharded) arrays to ``path``.
+
+    Each process writes only the shards it owns (orbax/tensorstore OCDBT),
+    so pod-scale saves never gather to one host — the reference's rank-0
+    ``.params`` gather cannot scale past host memory.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    _checkpointer().save(path, args=ocp.args.StandardSave(_to_jax_tree(tree)),
+                         force=True)
+    return path
+
+
+def load_sharded(path: str, like: Optional[Any] = None,
+                 shardings: Optional[Any] = None) -> Any:
+    """Restore a pytree from ``path``.
+
+    ``like`` — optional pytree of arrays/ShapeDtypeStructs fixing dtype &
+    shape; ``shardings`` — optional matching pytree of
+    ``jax.sharding.Sharding`` to place shards directly onto a (possibly
+    different) device mesh as they load: restore-time resharding.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise MXNetError(f"no checkpoint at {path}")
+    args = None
+    if like is not None:
+        like = _to_jax_tree(like)
+        flat_sh = None
+        if shardings is not None:
+            flat_sh, _ = jax.tree_util.tree_flatten(shardings)
+        flat, treedef = jax.tree_util.tree_flatten(like)
+        structs = []
+        for i, v in enumerate(flat):
+            sh = flat_sh[i] if flat_sh is not None else None
+            structs.append(jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=sh))
+        args = ocp.args.StandardRestore(
+            jax.tree_util.tree_unflatten(treedef, structs))
+    if args is None:
+        return _checkpointer().restore(path)
+    return _checkpointer().restore(path, args=args)
+
+
+class CheckpointManager:
+    """Step-numbered sharded checkpoints with retention.
+
+    The TPU-native analog of the estimator ``CheckpointHandler``'s
+    ``max_checkpoints`` logic (reference
+    ``gluon/contrib/estimator/event_handler.py:336``): ``save(step, tree)``
+    writes ``<dir>/<step>``, keeps the newest ``max_to_keep``.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 5):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, tree: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(_to_jax_tree(tree)))
+        self._mgr.wait_until_finished()
+
+    def restore(self, step: Optional[int] = None, like: Optional[Any] = None,
+                shardings: Optional[Any] = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise MXNetError(f"no checkpoints in {self._dir}")
+        args = None
+        if like is not None:
+            like = _to_jax_tree(like)
+            if shardings is not None:
+                flat_sh, _ = jax.tree_util.tree_flatten(shardings)
+                flat, treedef = jax.tree_util.tree_flatten(like)
+                structs = [
+                    jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=s)
+                    for v, s in zip(flat, flat_sh)]
+                like = jax.tree_util.tree_unflatten(treedef, structs)
+            else:
+                like = jax.tree_util.tree_map(
+                    lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), like)
+            args = ocp.args.StandardRestore(like)
+        return self._mgr.restore(step, args=args)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def close(self):
+        self._mgr.close()

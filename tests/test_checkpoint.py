@@ -1,0 +1,88 @@
+"""Sharded checkpoint (SURVEY.md §5 required upgrade; reference baseline is
+rank-0 .params gather via src/ndarray/ndarray.cc save/load).
+
+Runs on the 8-virtual-device CPU mesh from conftest: saves mesh-sharded
+params, restores them onto a DIFFERENT sharding layout, and round-trips
+a full model + trainer state.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import parallel
+from mxnet_tpu.gluon import nn
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def test_save_load_plain_tree(tmp_path):
+    tree = {"w": mx.np.array(onp.arange(12.0, dtype=onp.float32).reshape(3, 4)),
+            "nested": {"b": mx.np.array(onp.ones(5, onp.float32))}}
+    path = ckpt.save_sharded(str(tmp_path / "ck"), tree)
+    back = ckpt.load_sharded(path)
+    onp.testing.assert_allclose(onp.asarray(back["w"]),
+                                tree["w"].asnumpy())
+    onp.testing.assert_allclose(onp.asarray(back["nested"]["b"]), 1.0)
+
+
+def test_sharded_save_and_reshard_restore(tmp_path):
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    rng = onp.random.RandomState(0)
+    w = rng.randn(8, 16).astype(onp.float32)
+    sh_row = NamedSharding(mesh, P("dp", "tp"))
+    sh_col = NamedSharding(mesh, P("tp", "dp"))
+    arr = jax.device_put(jnp.asarray(w), sh_row)
+    path = ckpt.save_sharded(str(tmp_path / "ck"), {"w": arr})
+
+    like = {"w": jax.ShapeDtypeStruct((8, 16), jnp.float32)}
+    back = ckpt.load_sharded(path, like=like, shardings={"w": sh_col})
+    assert back["w"].sharding == sh_col  # restored directly onto new layout
+    onp.testing.assert_allclose(onp.asarray(back["w"]), w)
+
+
+def test_checkpoint_manager_retention_and_resume(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": jnp.full((2,), float(step))})
+    assert mgr.latest_step() == 3
+    assert mgr.all_steps() == [2, 3]  # retention dropped step 1
+    back = mgr.restore()
+    onp.testing.assert_allclose(onp.asarray(back["w"]), 3.0)
+    back2 = mgr.restore(step=2, like={"w": jnp.zeros((2,), jnp.float32)})
+    onp.testing.assert_allclose(onp.asarray(back2["w"]), 2.0)
+    mgr.close()
+
+
+def test_model_and_trainer_roundtrip(tmp_path):
+    from mxnet_tpu import autograd, gluon
+
+    net = nn.HybridSequential(nn.Dense(8, activation="relu", in_units=4),
+                              nn.Dense(2, in_units=8))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    x = mx.np.array(onp.random.RandomState(1).randn(4, 4).astype(onp.float32))
+    with autograd.record():
+        loss = net(x).mean()
+    loss.backward()
+    trainer.step(4)
+
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    path = ckpt.save_sharded(str(tmp_path / "model"), params)
+
+    net2 = nn.HybridSequential(nn.Dense(8, activation="relu", in_units=4),
+                               nn.Dense(2, in_units=8))
+    net2.initialize()
+    restored = ckpt.load_sharded(path)
+    net2.load_dict({k: mx.np.array(onp.asarray(v))
+                    for k, v in restored.items()})
+    onp.testing.assert_allclose(net2(x).asnumpy(), net(x).asnumpy(),
+                                rtol=1e-6)
+
+
+def test_missing_checkpoint_raises(tmp_path):
+    with pytest.raises(mx.MXNetError):
+        ckpt.load_sharded(str(tmp_path / "nope"))
