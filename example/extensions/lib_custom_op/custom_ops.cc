@@ -1,0 +1,101 @@
+/*
+ * Example extension library (reference example/extensions/lib_custom_op/):
+ * compiles against include/mxtpu_ext.h ONLY.
+ *
+ *   g++ -O2 -std=c++17 -fPIC -shared -I include \
+ *       example/extensions/lib_custom_op/custom_ops.cc -o libcustom_ops.so
+ *
+ * Registers:
+ *   my_gelu(x)   — tanh-approx GELU, forward + analytic backward
+ *   my_clip01(x) — clamp to [0,1], forward only (non-differentiable)
+ */
+#include <cmath>
+#include <cstring>
+
+#include "../../../include/mxtpu_ext.h"
+
+namespace {
+
+int infer_same(int32_t n_in, const MXTpuTensor *inputs, int32_t n_out,
+               int64_t out_shapes[][MXTPU_EXT_MAX_NDIM], int32_t *out_ndims,
+               int32_t *out_dtypes) {
+  if (n_in < 1 || n_out < 1) return MXTPU_EXT_FAIL;
+  for (int j = 0; j < n_out; ++j) {
+    std::memcpy(out_shapes[j], inputs[0].shape,
+                sizeof(int64_t) * MXTPU_EXT_MAX_NDIM);
+    out_ndims[j] = inputs[0].ndim;
+    out_dtypes[j] = inputs[0].dtype;
+  }
+  return MXTPU_EXT_SUCCESS;
+}
+
+int64_t numel(const MXTpuTensor &t) {
+  int64_t n = 1;
+  for (int i = 0; i < t.ndim; ++i) n *= t.shape[i];
+  return n;
+}
+
+constexpr float kSqrt2OverPi = 0.7978845608028654f;
+
+float gelu(float x) {
+  float inner = kSqrt2OverPi * (x + 0.044715f * x * x * x);
+  return 0.5f * x * (1.0f + std::tanh(inner));
+}
+
+float gelu_grad(float x) {
+  float inner = kSqrt2OverPi * (x + 0.044715f * x * x * x);
+  float t = std::tanh(inner);
+  float dinner = kSqrt2OverPi * (1.0f + 3.0f * 0.044715f * x * x);
+  return 0.5f * (1.0f + t) + 0.5f * x * (1.0f - t * t) * dinner;
+}
+
+int my_gelu_forward(int32_t n_in, const MXTpuTensor *inputs, int32_t n_out,
+                    MXTpuTensor *outputs) {
+  if (n_in != 1 || n_out != 1 || inputs[0].dtype != kMXTpuFloat32)
+    return MXTPU_EXT_FAIL;
+  const float *x = static_cast<const float *>(inputs[0].data);
+  float *y = static_cast<float *>(outputs[0].data);
+  int64_t n = numel(inputs[0]);
+  for (int64_t i = 0; i < n; ++i) y[i] = gelu(x[i]);
+  return MXTPU_EXT_SUCCESS;
+}
+
+/* backward inputs: [dy, x]; outputs: [dx] */
+int my_gelu_backward(int32_t n_in, const MXTpuTensor *inputs, int32_t n_out,
+                     MXTpuTensor *outputs) {
+  if (n_in != 2 || n_out != 1) return MXTPU_EXT_FAIL;
+  const float *dy = static_cast<const float *>(inputs[0].data);
+  const float *x = static_cast<const float *>(inputs[1].data);
+  float *dx = static_cast<float *>(outputs[0].data);
+  int64_t n = numel(inputs[1]);
+  for (int64_t i = 0; i < n; ++i) dx[i] = dy[i] * gelu_grad(x[i]);
+  return MXTPU_EXT_SUCCESS;
+}
+
+int my_clip01_forward(int32_t n_in, const MXTpuTensor *inputs, int32_t n_out,
+                      MXTpuTensor *outputs) {
+  if (n_in != 1 || n_out != 1 || inputs[0].dtype != kMXTpuFloat32)
+    return MXTPU_EXT_FAIL;
+  const float *x = static_cast<const float *>(inputs[0].data);
+  float *y = static_cast<float *>(outputs[0].data);
+  int64_t n = numel(inputs[0]);
+  for (int64_t i = 0; i < n; ++i)
+    y[i] = x[i] < 0.0f ? 0.0f : (x[i] > 1.0f ? 1.0f : x[i]);
+  return MXTPU_EXT_SUCCESS;
+}
+
+}  // namespace
+
+extern "C" int mxtpu_ext_init(MXTpuExtRegistry *reg) {
+  if (reg == nullptr || reg->abi_version != MXTPU_EXT_ABI_VERSION) {
+    if (reg) reg->set_last_error(reg, "ABI version mismatch");
+    return MXTPU_EXT_FAIL;
+  }
+  if (reg->register_op(reg, "my_gelu", 1, 1, my_gelu_forward,
+                       my_gelu_backward, infer_same) != MXTPU_EXT_SUCCESS)
+    return MXTPU_EXT_FAIL;
+  if (reg->register_op(reg, "my_clip01", 1, 1, my_clip01_forward, nullptr,
+                       infer_same) != MXTPU_EXT_SUCCESS)
+    return MXTPU_EXT_FAIL;
+  return MXTPU_EXT_SUCCESS;
+}

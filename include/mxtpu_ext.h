@@ -1,0 +1,89 @@
+/*
+ * mxtpu_ext.h — stable C ABI for external operator libraries.
+ *
+ * The TPU-native equivalent of the reference extension API
+ * (include/mxnet/lib_api.h: CustomOp :903, versioned initialize :2008):
+ * compile a .so against ONLY this header — no framework headers, no
+ * recompilation of the framework — and load it at runtime with
+ *   mx.library.load("libmyops.so")
+ * Each registered op becomes an ordinary mx.npx op: autograd-recorded,
+ * usable inside jit traces (the framework bridges the host function into
+ * XLA programs via a host callback; for MXU-speed kernels write Pallas —
+ * this seam is for host-side custom logic, exactly like the reference's
+ * CPU CustomOp path).
+ *
+ * Contract:
+ *  - the extension exports  int mxtpu_ext_init(MXTpuExtRegistry*)
+ *    returning MXTPU_EXT_SUCCESS after registering its ops;
+ *  - ABI version is checked first: registry->abi_version must equal
+ *    MXTPU_EXT_ABI_VERSION at both compile and load time;
+ *  - all tensors are dense host buffers described by MXTpuTensor; the
+ *    framework allocates outputs using the op's infer_shape callback.
+ */
+#ifndef MXTPU_EXT_H_
+#define MXTPU_EXT_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXTPU_EXT_ABI_VERSION 1
+#define MXTPU_EXT_SUCCESS 0
+#define MXTPU_EXT_FAIL 1
+#define MXTPU_EXT_MAX_NDIM 8
+
+/* dtype codes (match numpy kind/size, fixed forever) */
+typedef enum {
+  kMXTpuFloat32 = 0,
+  kMXTpuFloat64 = 1,
+  kMXTpuInt32 = 4,
+  kMXTpuInt64 = 5,
+  kMXTpuUint8 = 6,
+  kMXTpuBool = 7,
+} MXTpuDType;
+
+typedef struct {
+  void *data;                        /* dense host buffer */
+  int64_t shape[MXTPU_EXT_MAX_NDIM]; /* row-major */
+  int32_t ndim;
+  int32_t dtype; /* MXTpuDType */
+} MXTpuTensor;
+
+/* Forward kernel: read inputs, write pre-allocated outputs.
+ * Return MXTPU_EXT_SUCCESS or MXTPU_EXT_FAIL (message via set_last_error). */
+typedef int (*MXTpuForwardFn)(int32_t n_in, const MXTpuTensor *inputs,
+                              int32_t n_out, MXTpuTensor *outputs);
+
+/* Backward kernel: inputs are [out_grads..., fwd_inputs...]; outputs are
+ * input gradients (same shapes as fwd inputs). NULL = op not differentiable. */
+typedef int (*MXTpuBackwardFn)(int32_t n_in, const MXTpuTensor *inputs,
+                               int32_t n_out, MXTpuTensor *outputs);
+
+/* Shape/dtype inference: fill out_shapes/out_ndims/out_dtypes given inputs.
+ * (reference FInferShape/FInferType attrs, op_attr_types.h) */
+typedef int (*MXTpuInferFn)(int32_t n_in, const MXTpuTensor *inputs,
+                            int32_t n_out,
+                            int64_t out_shapes[][MXTPU_EXT_MAX_NDIM],
+                            int32_t *out_ndims, int32_t *out_dtypes);
+
+typedef struct MXTpuExtRegistry {
+  int32_t abi_version; /* set by the framework; extensions must verify */
+  void *impl;          /* framework-owned */
+  /* register one op; n_in/n_out fixed per op (like reference num_inputs) */
+  int (*register_op)(struct MXTpuExtRegistry *reg, const char *name,
+                     int32_t n_in, int32_t n_out, MXTpuForwardFn forward,
+                     MXTpuBackwardFn backward, MXTpuInferFn infer);
+  void (*set_last_error)(struct MXTpuExtRegistry *reg, const char *msg);
+} MXTpuExtRegistry;
+
+/* The single symbol every extension library must export. */
+typedef int (*MXTpuExtInitFn)(MXTpuExtRegistry *reg);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* MXTPU_EXT_H_ */

@@ -1,0 +1,105 @@
+"""Backend helpers for the C ABI (``src/c_api/c_api.cc``).
+
+The reference exposes 262 ``MXNET_DLL`` functions whose bodies live in C++
+(``src/c_api/``); here the runtime is Python/JAX, so the stable C surface
+is a thin layer over these helpers (called via the CPython API from
+``libmxtpu_capi.so``). Other-language frontends (layer 11) link against
+the .so and never see Python.
+
+Every function takes/returns only simple types (bytes, tuples, ints,
+opaque object refs) so the C side stays mechanical.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as onp
+
+__version_number__ = 20000  # 2.0.0 — MXGetVersion parity
+
+_DTYPE_TO_CODE = {"float32": 0, "float64": 1, "int32": 4, "int64": 5,
+                  "uint8": 6, "bool": 7}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+
+
+def version() -> int:
+    return __version_number__
+
+
+def from_buffer(raw: bytes, shape: tuple, dtype_code: int):
+    from . import numpy as mxnp
+
+    arr = onp.frombuffer(raw, dtype=_CODE_TO_DTYPE[dtype_code]).reshape(shape)
+    return mxnp.array(arr)
+
+
+def to_bytes(arr) -> bytes:
+    return onp.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def shape(arr) -> tuple:
+    return tuple(int(s) for s in arr.shape)
+
+
+def dtype_code(arr) -> int:
+    return _DTYPE_TO_CODE[str(onp.dtype(arr.dtype))]
+
+
+def invoke(op_name: str, inputs: tuple, kwargs_json: str) -> tuple:
+    """Invoke an eager op by qualified name ("np.add", "npx.relu", or a
+    bare name searched in npx then np) — MXImperativeInvokeEx parity."""
+    from . import numpy as mxnp
+    from . import numpy_extension as npx
+    from .base import MXNetError
+    from .ndarray.ndarray import ndarray
+
+    if op_name.startswith("np."):
+        fn = getattr(mxnp, op_name[3:], None)
+    elif op_name.startswith("npx."):
+        fn = getattr(npx, op_name[4:], None)
+    else:
+        fn = getattr(npx, op_name, None) or getattr(mxnp, op_name, None)
+    if fn is None:
+        raise MXNetError(f"unknown operator {op_name!r}")
+    kwargs = json.loads(kwargs_json) if kwargs_json else {}
+    out = fn(*inputs, **kwargs)
+    if isinstance(out, tuple):
+        return out
+    return (out,)
+
+
+def waitall() -> None:
+    from . import engine
+
+    engine.waitall()
+
+
+def attach_grad(arr) -> None:
+    arr.attach_grad()
+
+
+def autograd_record(on: int) -> None:
+    from . import autograd
+    from .ops.dispatch import autograd_state, Tape
+
+    if on:
+        autograd_state.recording = True
+        autograd_state.training = True
+        if autograd_state.tape is None:
+            autograd_state.tape = Tape()
+    else:
+        autograd_state.recording = False
+        autograd_state.training = False
+
+
+def backward(loss) -> None:
+    from .ops.dispatch import backward as _backward
+
+    _backward([loss])
+
+
+def grad(arr):
+    g = arr.grad
+    if g is None:
+        raise ValueError("array has no gradient (attach_grad not called?)")
+    return g

@@ -11,8 +11,12 @@ returns immediately; data dependencies order execution; errors surface on
 - ``MXNET_ENGINE_TYPE=NaiveEngine`` — synchronous deterministic mode for
   debugging (reference ``src/engine/engine.cc:32`` factory), implemented by
   blocking after every op.
-- ``set_bulk_size`` — op bulking (reference ``engine.h:315``); XLA fuses
-  within a jit trace so this is a tracing hint, kept for API parity.
+- ``set_bulk_size`` / ``bulk`` — op bulking (reference ``engine.h:315``,
+  default ``MXNET_ENGINE_BULK_SIZE``). Inside jit traces XLA fuses
+  everything, so the knob governs the EAGER path: bulk size 0 forces a
+  block after every dispatched op (same execution as NaiveEngine), any
+  positive size keeps XLA's async pipelining. ``bulk(0)`` is therefore a
+  scoped synchronous-debug region.
 - async exception propagation — tested by
   ``tests/python/unittest/test_exc_handling.py`` in the reference; jax
   raises deferred XLA errors at the next sync point, same contract.
@@ -27,7 +31,12 @@ from .base import env_str
 
 __all__ = ["waitall", "is_naive", "set_bulk_size", "bulk"]
 
-_bulk_size = 15  # reference default MXNET_ENGINE_BULK_SIZE
+import os as _os
+
+try:
+    _bulk_size = int(_os.environ.get("MXNET_ENGINE_BULK_SIZE") or 15)
+except ValueError:
+    _bulk_size = 15  # malformed env must not break `import mxnet_tpu`
 
 
 def engine_type() -> str:
@@ -51,10 +60,24 @@ def waitall() -> None:
             pass
 
 
+def sync_each_op() -> bool:
+    """True when eager dispatch must block per op: NaiveEngine mode, or a
+    ``bulk(0)`` / ``set_bulk_size(0)`` scope. Called on the eager hot
+    path, so it is one global compare + one environ dict lookup — no
+    helper chain (the env read stays live so the knob can be flipped
+    mid-process, which the reference's engine factory cannot)."""
+    return (_bulk_size == 0
+            or _os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine")
+
+
 def maybe_sync(val) -> None:
-    """NaiveEngine mode: force synchronous execution after each op."""
-    if is_naive() and hasattr(val, "block_until_ready"):
-        val.block_until_ready()
+    """Force synchronous execution after one op when the engine mode asks."""
+    if not sync_each_op():
+        return
+    vals = val if isinstance(val, (tuple, list)) else (val,)
+    for v in vals:
+        if hasattr(v, "block_until_ready"):
+            v.block_until_ready()
 
 
 def set_bulk_size(size: int) -> int:

@@ -282,9 +282,32 @@ class HybridBlock(Block):
         super().hybridize(False)  # only the outermost hybridized block traces
 
     def infer_shape(self, *args):
-        """Run a deferred-shape-completing pass (layers do it in forward)."""
-        with jax.ensure_compile_time_eval():
-            pass  # shapes complete lazily at first forward in this design
+        """Complete every deferred parameter shape WITHOUT running the net.
+
+        The forward is abstractly evaluated (``jax.eval_shape``) on the
+        example inputs: layers see real static shapes and finalize their
+        deferred parameters, but no FLOP executes and no activation is
+        materialized (reference ``HybridBlock.infer_shape`` runs the nnvm
+        shape-inference pass for the same effect). Requires a traceable
+        forward — no ``.asnumpy()``/``float()`` on intermediate values.
+        """
+        from .. import autograd as ag
+
+        flat_vals, treedef = jax.tree_util.tree_flatten(
+            tuple(_wrap(a) if not isinstance(a, ndarray) else a
+                  for a in args))
+        structs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in flat_vals]
+
+        def abstract_forward(flat):
+            inputs = jax.tree_util.tree_unflatten(treedef, list(flat))
+            with ag.pause(train_mode=False):
+                out = self.forward(*_as_tuple(inputs))
+            return jax.tree_util.tree_map(
+                lambda v: v._data if isinstance(v, ndarray) else v, out,
+                is_leaf=lambda v: isinstance(v, ndarray))
+
+        out = jax.eval_shape(abstract_forward, structs)
+        return jax.tree_util.tree_map(lambda s: s.shape, out)
 
     def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
         self.hybridize(True, **kwargs)
@@ -465,13 +488,16 @@ class HybridBlock(Block):
         plist = sorted(self.collect_params().items())
         needs_eager = any(p._data is None for _, p in plist)
         if needs_eager:
-            # run the un-traced forward once to complete deferred shapes/init
-            # — in predict mode, so stateful side effects (BatchNorm running
-            # stats, dropout draws) are not applied twice on the first batch
+            # complete deferred shapes/init abstractly — zero FLOPs; fall
+            # back to one real predict-mode forward for forwards that are
+            # not abstractly traceable (host-side value inspection etc.)
             from .. import autograd as ag
 
-            with ag.pause(train_mode=False):
-                super(HybridBlock, self).__call__(*args)
+            try:
+                self.infer_shape(*args)
+            except Exception:
+                with ag.pause(train_mode=False):
+                    super(HybridBlock, self).__call__(*args)
             plist = sorted(self.collect_params().items())
         return plist
 

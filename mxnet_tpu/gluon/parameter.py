@@ -119,8 +119,14 @@ class Parameter:
             return
         initializer, ctx = self._deferred_init
         initializer = init_mod.create(initializer) if not isinstance(initializer, init_mod.Initializer) else initializer
-        arr = ndarray(onp.zeros(self._shape, self.dtype), ctx=ctx)
-        initializer.init_array(self.name, arr)
+        import jax as _jax
+
+        # ensure_compile_time_eval: finalize may run inside an abstract
+        # trace (HybridBlock.infer_shape / first traced forward); the
+        # parameter array must be CONCRETE or it escapes the trace
+        with _jax.ensure_compile_time_eval():
+            arr = ndarray(onp.zeros(self._shape, self.dtype), ctx=ctx)
+            initializer.init_array(self.name, arr)
         self._data = arr
         self._deferred_init = None
         if self.grad_req != "null":

@@ -1,0 +1,271 @@
+"""``mx.library`` — load external operator libraries at runtime.
+
+Parity target: reference ``python/mxnet/library.py`` (``load`` →
+``MXLoadLib``, ``src/c_api/c_api.cc:1268``) + the ``lib_api.h`` extension
+ABI (``include/mxnet/lib_api.h:903 CustomOp``). Extensions compile against
+``include/mxtpu_ext.h`` ONLY — no framework headers — and register ops via
+``mxtpu_ext_init``.
+
+TPU-first bridging: each registered C kernel becomes an ordinary framework
+op — dispatched through :func:`mxnet_tpu.ops.dispatch.apply_op` (so the
+autograd tape records it), and embedded into XLA programs with
+``jax.pure_callback`` so it works inside ``jit``/``vmap`` traces. When the
+extension provides a backward kernel the op carries a ``jax.custom_vjp``;
+otherwise it is non-differentiable. This mirrors the reference's CPU
+CustomOp path; write Pallas kernels for MXU-speed custom compute.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["load", "get_op", "loaded_ops"]
+
+ABI_VERSION = 1
+MAX_NDIM = 8
+
+_DTYPE_TO_CODE = {"float32": 0, "float64": 1, "int32": 4, "int64": 5,
+                  "uint8": 6, "bool": 7}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+
+
+class _Tensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("shape", ctypes.c_int64 * MAX_NDIM),
+        ("ndim", ctypes.c_int32),
+        ("dtype", ctypes.c_int32),
+    ]
+
+
+_REGFN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+    ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p)
+_ERRFN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p)
+
+
+class _Registry(ctypes.Structure):
+    _fields_ = [
+        ("abi_version", ctypes.c_int32),
+        ("impl", ctypes.c_void_p),
+        ("register_op", _REGFN),
+        ("set_last_error", _ERRFN),
+    ]
+
+
+_KERNFN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int32, ctypes.POINTER(_Tensor), ctypes.c_int32,
+    ctypes.POINTER(_Tensor))
+_INFERFN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int32, ctypes.POINTER(_Tensor), ctypes.c_int32,
+    ctypes.POINTER(ctypes.c_int64 * MAX_NDIM), ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32))
+
+
+class _ExtOp:
+    def __init__(self, name: str, n_in: int, n_out: int, forward, backward,
+                 infer):
+        self.name = name
+        self.n_in = n_in
+        self.n_out = n_out
+        self.forward = _KERNFN(forward)
+        self.backward = _KERNFN(backward) if backward else None
+        self.infer = _INFERFN(infer)
+
+
+_ops: Dict[str, Callable] = {}
+_libs: List[ctypes.CDLL] = []  # keep loaded libraries (and callbacks) alive
+_keepalive: List[object] = []
+
+
+def _as_tensor(arr: onp.ndarray, t: _Tensor) -> None:
+    if arr.ndim > MAX_NDIM:
+        raise MXNetError(f"extension tensors support ndim<={MAX_NDIM}")
+    dtype = str(arr.dtype)
+    if dtype not in _DTYPE_TO_CODE:
+        raise MXNetError(f"extension tensors do not support dtype {dtype}")
+    t.data = arr.ctypes.data_as(ctypes.c_void_p)
+    for i, s in enumerate(arr.shape):
+        t.shape[i] = s
+    t.ndim = arr.ndim
+    t.dtype = _DTYPE_TO_CODE[dtype]
+
+
+def _abstract_tensor(shape, dtype, t: _Tensor) -> None:
+    t.data = None
+    for i, s in enumerate(shape):
+        t.shape[i] = s
+    t.ndim = len(shape)
+    t.dtype = _DTYPE_TO_CODE[str(onp.dtype(dtype))]
+
+
+def _infer_out(op: _ExtOp, in_shapes, in_dtypes) -> List[Tuple[tuple, str]]:
+    ins = (_Tensor * max(op.n_in, 1))()
+    for i, (sh, dt) in enumerate(zip(in_shapes, in_dtypes)):
+        _abstract_tensor(sh, dt, ins[i])
+    out_shapes = ((ctypes.c_int64 * MAX_NDIM) * max(op.n_out, 1))()
+    out_ndims = (ctypes.c_int32 * max(op.n_out, 1))()
+    out_dtypes = (ctypes.c_int32 * max(op.n_out, 1))()
+    rc = op.infer(op.n_in, ins,
+                  op.n_out, out_shapes, out_ndims, out_dtypes)
+    if rc != 0:
+        raise MXNetError(f"extension op {op.name!r}: infer_shape failed")
+    outs = []
+    for j in range(op.n_out):
+        shape = tuple(out_shapes[j][k] for k in range(out_ndims[j]))
+        outs.append((shape, _CODE_TO_DTYPE[int(out_dtypes[j])]))
+    return outs
+
+
+def _run_kernel(kern, op_name: str, in_arrays, out_specs) -> List[onp.ndarray]:
+    ins = (_Tensor * max(len(in_arrays), 1))()
+    holders = [onp.ascontiguousarray(a) for a in in_arrays]
+    for i, a in enumerate(holders):
+        _as_tensor(a, ins[i])
+    outs_np = [onp.empty(sh, dtype=dt) for sh, dt in out_specs]
+    outs = (_Tensor * max(len(outs_np), 1))()
+    for j, a in enumerate(outs_np):
+        _as_tensor(a, outs[j])
+    rc = kern(len(holders), ins, len(outs_np), outs)
+    if rc != 0:
+        raise MXNetError(f"extension op {op_name!r}: kernel failed")
+    return outs_np
+
+
+def _make_op(op: _ExtOp) -> Callable:
+    """Build the jax-level function (pure_callback + optional custom_vjp)."""
+
+    def fwd_host(*in_arrays):
+        specs = _infer_out(op, [a.shape for a in in_arrays],
+                           [a.dtype for a in in_arrays])
+        outs = _run_kernel(op.forward, op.name, in_arrays, specs)
+        return tuple(outs) if op.n_out > 1 else outs[0]
+
+    def raw(*xs):
+        specs = _infer_out(op, [x.shape for x in xs], [x.dtype for x in xs])
+        result_shape = tuple(jax.ShapeDtypeStruct(sh, onp.dtype(dt))
+                             for sh, dt in specs)
+        if op.n_out == 1:
+            result_shape = result_shape[0]
+        return jax.pure_callback(fwd_host, result_shape, *xs)
+
+    if op.backward is None:
+        return raw
+
+    @jax.custom_vjp
+    def fn(*xs):
+        return raw(*xs)
+
+    def fn_fwd(*xs):
+        return raw(*xs), xs
+
+    def fn_bwd(residual_xs, cts):
+        cts = cts if isinstance(cts, tuple) else (cts,)
+
+        def bwd_host(*args):
+            n_ct = op.n_out
+            ct_arrays, in_arrays = args[:n_ct], args[n_ct:]
+            specs = [(a.shape, str(a.dtype)) for a in in_arrays]
+            outs = _run_kernel(op.backward, op.name,
+                               list(ct_arrays) + list(in_arrays), specs)
+            return tuple(outs)
+
+        result_shape = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                             for x in residual_xs)
+        return jax.pure_callback(bwd_host, result_shape, *cts, *residual_xs)
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    return fn
+
+
+def load(path: str, verbose: bool = True) -> List[str]:
+    """Load an extension library (reference ``mx.library.load`` →
+    ``MXLoadLib``). Returns the list of op names registered. Ops appear
+    under ``mx.npx.<name>`` and in the symbol registry."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise MXNetError(f"extension library not found: {path}")
+    lib = ctypes.CDLL(path, ctypes.RTLD_LOCAL)
+    try:
+        init = lib.mxtpu_ext_init
+    except AttributeError:
+        raise MXNetError(
+            f"{path} exports no mxtpu_ext_init — not an mxtpu extension")
+    init.restype = ctypes.c_int
+    init.argtypes = [ctypes.POINTER(_Registry)]
+
+    registered: List[str] = []
+    errors: List[str] = []
+
+    @_REGFN
+    def register_op(_reg, name, n_in, n_out, fwd, bwd, infer):
+        try:
+            if not fwd or not infer:
+                errors.append("register_op: forward and infer are required")
+                return 1
+            op = _ExtOp(name.decode(), int(n_in), int(n_out), fwd, bwd, infer)
+            jax_fn = _make_op(op)
+            _install(op, jax_fn)
+            registered.append(op.name)
+            _keepalive.append(op)
+            return 0
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+            return 1
+
+    @_ERRFN
+    def set_last_error(_reg, msg):
+        errors.append(msg.decode() if msg else "unknown extension error")
+
+    reg = _Registry(ABI_VERSION, None, register_op, set_last_error)
+    rc = init(ctypes.byref(reg))
+    if rc != 0:
+        raise MXNetError(
+            f"mxtpu_ext_init failed for {path}: {'; '.join(errors) or rc}")
+    _libs.append(lib)
+    _keepalive.extend([register_op, set_last_error])
+    if verbose and registered:
+        print(f"[mx.library] loaded {len(registered)} op(s) from "
+              f"{os.path.basename(path)}: {', '.join(registered)}")
+    return registered
+
+
+def _install(op: _ExtOp, jax_fn: Callable) -> None:
+    from . import numpy_extension as npx
+    from .ndarray.ndarray import ndarray
+    from .ops.dispatch import apply_op
+
+    def mx_op(*arrays):
+        return apply_op(jax_fn, arrays, n_out=op.n_out, name=op.name)
+
+    mx_op.__name__ = op.name
+    mx_op.__doc__ = (f"Custom extension op {op.name!r} "
+                     f"({op.n_in} inputs, {op.n_out} outputs; "
+                     f"{'differentiable' if op.backward else 'no gradient'})")
+    _ops[op.name] = mx_op
+    setattr(npx, op.name, mx_op)
+    # invalidate the symbol-op registry cache so mx.sym.npx picks it up
+    try:
+        from .symbol import symbol as _sym
+
+        if _sym._OPS:
+            _sym._OPS[f"npx.{op.name}"] = mx_op
+    except Exception:
+        pass
+
+
+def get_op(name: str) -> Callable:
+    if name not in _ops:
+        raise MXNetError(f"no loaded extension op {name!r}")
+    return _ops[name]
+
+
+def loaded_ops() -> List[str]:
+    return sorted(_ops)

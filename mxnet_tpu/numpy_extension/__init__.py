@@ -452,3 +452,93 @@ def multi_head_attention(query, key, value, heads, causal=False):
     elsewhere. Shares its core with nn.MultiHeadAttention (ops/nn.py:attend)."""
     return _call(lambda q, k, v: _nn.attend(q, k, v, heads, causal=causal),
                  (query, key, value), name="multi_head_attention")
+
+
+# ---------------------------------------------------------------------------
+# contrib op family (reference src/operator/contrib/; impls in ops/contrib.py)
+# ---------------------------------------------------------------------------
+from ..ops import contrib as _contrib  # noqa: E402
+
+
+def roi_pooling(data, rois, pooled_size, spatial_scale=1.0):
+    return _call(lambda d, r: _contrib.roi_pooling(
+        d, r, pooled_size, spatial_scale), (data, rois), name="roi_pooling")
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2,
+              aligned=False):
+    return _call(lambda d, r: _contrib.roi_align(
+        d, r, pooled_size, spatial_scale, sample_ratio, aligned),
+        (data, rois), name="roi_align")
+
+
+def boolean_mask(data, index, axis=0):
+    """EAGER-ONLY: output shape depends on the mask values."""
+    return _call(lambda d, i: _contrib.boolean_mask(d, i, axis),
+                 (data, index), name="boolean_mask")
+
+
+def count_sketch(data, h, s, out_dim):
+    return _call(lambda d, hh, ss: _contrib.count_sketch(d, hh, ss, out_dim),
+                 (data, h, s), name="count_sketch")
+
+
+def adaptive_avg_pool2d(data, output_size):
+    return _call(lambda d: _contrib.adaptive_avg_pool2d(d, output_size),
+                 (data,), name="adaptive_avg_pool2d")
+
+
+def sync_batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, axis_name=None):
+    """Cross-device batch norm; inside shard_map pass the mesh axis name.
+
+    Training mode (``autograd.record(train_mode=True)``) normalizes with
+    mesh-global batch stats and updates ``moving_mean``/``moving_var`` in
+    place (the reference's aux-state mutation); inference mode normalizes
+    with the moving stats. Returns (out, mean_used, var_used)."""
+    training = is_training()
+    out, mean, var, new_mm, new_mv = _call(
+        lambda xx, g, b, mm, mv: _contrib.sync_batch_norm(
+            xx, g, b, mm, mv, eps=eps, momentum=momentum,
+            axis_name=axis_name, training=training),
+        (x, gamma, beta, moving_mean, moving_var),
+        name="sync_batch_norm", n_out=5)
+    if training:
+        moving_mean._set_data(_unwrap(new_mm))
+        moving_var._set_data(_unwrap(new_mv))
+    return out, mean, var
+
+
+def box_iou(lhs, rhs, fmt="corner"):
+    return _call(lambda a, b: _contrib.box_iou(a, b, fmt), (lhs, rhs),
+                 name="box_iou")
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            score_index=1, coord_start=2):
+    return _call(lambda d: _contrib.box_nms(
+        d, overlap_thresh, valid_thresh, topk, score_index, coord_start),
+        (data,), name="box_nms")
+
+
+def bipartite_matching(score, threshold=1e-12, topk=-1, is_ascend=False):
+    return _call(lambda s: _contrib.bipartite_matching(
+        s, threshold, topk, is_ascend), (score,),
+        name="bipartite_matching", n_out=2)
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return _call(lambda x, y: _contrib.allclose(x, y, rtol, atol, equal_nan),
+                 (a, b), name="allclose")
+
+
+def index_array(data, axes=None):
+    return _call(lambda d: _contrib.index_array(d, axes), (data,),
+                 name="index_array")
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
+                   offsets=(0.5, 0.5), clip=False):
+    return _call(lambda d: _contrib.multibox_prior(
+        d, sizes, ratios, steps, offsets, clip), (data,),
+        name="multibox_prior")

@@ -1,0 +1,335 @@
+"""Contrib operator family (reference ``src/operator/contrib/`` ~30k LoC of
+CUDA/C++: ROI ops, count_sketch, boolean mask, adaptive pooling, NMS/IoU,
+bipartite matching, multibox priors, sync BN).
+
+TPU re-design notes: every op is expressed as dense masked arithmetic or a
+``vmap`` over fixed-size grids — no data-dependent shapes, no scalar
+loops — so everything except :func:`boolean_mask` (inherently dynamic
+output) jit-compiles onto the MXU/VPU. Oracle tests in
+``tests/test_contrib_ops.py`` pin the semantics against pure-numpy
+implementations, the reference test style (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = [
+    "roi_pooling", "roi_align", "boolean_mask", "count_sketch",
+    "adaptive_avg_pool2d", "sync_batch_norm", "box_iou", "box_nms",
+    "bipartite_matching", "allclose", "index_array", "multibox_prior",
+]
+
+
+# ---------------------------------------------------------------------------
+# ROI ops (reference contrib/roi_align.cc, operator/roi_pooling.cc)
+# ---------------------------------------------------------------------------
+def roi_pooling(data, rois, pooled_size, spatial_scale=1.0):
+    """Max-pool each ROI onto a fixed (ph, pw) grid.
+
+    data: (B, C, H, W); rois: (N, 5) of [batch_idx, x1, y1, x2, y2] in
+    image coords (multiplied by ``spatial_scale``, quantized like the
+    reference: round + inclusive end, bins split by floor/ceil).
+    """
+    ph, pw = pooled_size
+    B, C, H, W = data.shape
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        fmap = data[b]  # (C, H, W)
+
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        ystart = jnp.floor(y1 + iy * bin_h)          # (ph,)
+        yend = jnp.ceil(y1 + (iy + 1) * bin_h)
+        xstart = jnp.floor(x1 + ix * bin_w)          # (pw,)
+        xend = jnp.ceil(x1 + (ix + 1) * bin_w)
+        ymask = (ys[None, :] >= ystart[:, None]) & (ys[None, :] < yend[:, None])
+        xmask = (xs[None, :] >= xstart[:, None]) & (xs[None, :] < xend[:, None])
+        # (ph, pw, H, W) bin membership
+        mask = ymask[:, None, :, None] & xmask[None, :, None, :]
+        neg = jnp.finfo(data.dtype).min
+        vals = jnp.where(mask[None], fmap[:, None, None, :, :], neg)
+        out = vals.max(axis=(-1, -2))  # (C, ph, pw)
+        # empty bins (outside image) -> 0, reference zero-fills
+        any_px = mask.any(axis=(-1, -2))
+        return jnp.where(any_px[None], out, 0.0)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2,
+              aligned=False):
+    """Bilinear ROI align (Mask R-CNN; reference contrib/roi_align.cc).
+
+    Averages ``sample_ratio**2`` bilinear samples per output bin. With
+    ``aligned=True`` applies the half-pixel offset correction.
+    """
+    ph, pw = pooled_size
+    sr = int(sample_ratio) if sample_ratio > 0 else 2
+    B, C, H, W = data.shape
+    offset = 0.5 if aligned else 0.0
+
+    def bilinear(fmap, y, x):
+        y = jnp.clip(y, 0.0, H - 1.0)
+        x = jnp.clip(x, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        wy1 = y - y0
+        wx1 = x - x0
+        v00 = fmap[:, y0, x0]
+        v01 = fmap[:, y0, x1]
+        v10 = fmap[:, y1, x0]
+        v11 = fmap[:, y1, x1]
+        return (v00 * (1 - wy1) * (1 - wx1) + v01 * (1 - wy1) * wx1
+                + v10 * wy1 * (1 - wx1) + v11 * wy1 * wx1)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rh = y2 - y1
+        rw = x2 - x1
+        if not aligned:
+            rh = jnp.maximum(rh, 1.0)
+            rw = jnp.maximum(rw, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        fmap = data[b]
+        iy = jnp.arange(ph, dtype=jnp.float32)[:, None, None, None]
+        ix = jnp.arange(pw, dtype=jnp.float32)[None, :, None, None]
+        sy = jnp.arange(sr, dtype=jnp.float32)[None, None, :, None]
+        sx = jnp.arange(sr, dtype=jnp.float32)[None, None, None, :]
+        y = y1 + iy * bin_h + (sy + 0.5) * bin_h / sr  # (ph,pw,sr,sr)
+        x = x1 + ix * bin_w + (sx + 0.5) * bin_w / sr
+        samp = bilinear(fmap, y, x)  # (C, ph, pw, sr, sr) via broadcasting
+        return samp.mean(axis=(-1, -2))
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# masking / sketching (reference contrib/boolean_mask.cc, count_sketch.cc)
+# ---------------------------------------------------------------------------
+def boolean_mask(data, index, axis=0):
+    """Select entries where ``index`` is nonzero. Output shape is
+    data-dependent, so this op is EAGER-ONLY (cannot appear inside jit) —
+    the reference GPU kernel has the same dynamic-output nature."""
+    idx = onp.asarray(index).astype(bool)
+    return jnp.take(jnp.asarray(data), jnp.asarray(onp.nonzero(idx)[0]),
+                    axis=axis)
+
+
+def count_sketch(data, h, s, out_dim):
+    """Count-sketch projection (reference contrib/count_sketch.cc):
+    ``out[..., h[i]] += s[i] * data[..., i]`` — a scatter-add, which XLA
+    lowers natively."""
+    h = jnp.asarray(h).astype(jnp.int32).reshape(-1)
+    s = jnp.asarray(s).astype(data.dtype).reshape(-1)
+    signed = data * s
+    zeros = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return zeros.at[..., h].add(signed)
+
+
+# ---------------------------------------------------------------------------
+# adaptive pooling (reference contrib/adaptive_avg_pooling.cc)
+# ---------------------------------------------------------------------------
+def adaptive_avg_pool2d(data, output_size):
+    """Average-pool (B, C, H, W) onto an (oh, ow) grid with torch/reference
+    bin edges: start = floor(i*H/oh), end = ceil((i+1)*H/oh)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    B, C, H, W = data.shape
+
+    def pool_axis(x, size, out, axis):
+        idx = onp.arange(out)
+        starts = onp.floor(idx * size / out).astype(onp.int64)
+        ends = onp.ceil((idx + 1) * size / out).astype(onp.int64)
+        pieces = [
+            x.take(indices=jnp.arange(s, e), axis=axis).mean(axis=axis)
+            for s, e in zip(starts, ends)]
+        return jnp.stack(pieces, axis=axis)
+
+    out = pool_axis(data, H, oh, 2)
+    return pool_axis(out, W, ow, 3)
+
+
+# ---------------------------------------------------------------------------
+# sync batch norm (reference contrib/sync_batch_norm.cc)
+# ---------------------------------------------------------------------------
+def sync_batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, axis_name=None, training=True):
+    """BatchNorm whose batch statistics are averaged across the device
+    mesh axis ``axis_name`` (reference synchronizes via NCCL/engine; here
+    ``lax.pmean`` inside shard_map/pmap — the XLA-native form).
+
+    ``training=True``: normalize with (mesh-global) batch stats and return
+    momentum-updated moving stats. ``training=False``: normalize with the
+    provided moving stats (reference SyncBatchNorm inference path).
+    Returns (out, mean_used, var_used, new_moving_mean, new_moving_var).
+    """
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    if not training:
+        if moving_mean is None or moving_var is None:
+            raise MXNetError("sync_batch_norm inference needs moving stats")
+        mean, var = moving_mean, moving_var
+        xhat = (x - mean.reshape(shape)) * lax.rsqrt(
+            var.reshape(shape) + eps)
+        return (xhat * gamma.reshape(shape) + beta.reshape(shape),
+                mean, var, moving_mean, moving_var)
+    red = tuple(i for i in range(x.ndim) if i != 1)
+    mean = x.mean(red)
+    sq = (x * x).mean(red)
+    if axis_name is not None:
+        mean = lax.pmean(mean, axis_name)
+        sq = lax.pmean(sq, axis_name)
+    var = sq - mean * mean
+    if moving_mean is not None and moving_var is not None:
+        new_mm = momentum * moving_mean + (1.0 - momentum) * mean
+        new_mv = momentum * moving_var + (1.0 - momentum) * var
+    else:
+        new_mm, new_mv = mean, var
+    xhat = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+    return (xhat * gamma.reshape(shape) + beta.reshape(shape),
+            mean, var, new_mm, new_mv)
+
+
+# ---------------------------------------------------------------------------
+# detection utilities (reference contrib/bounding_box.cc, multibox_*.cc)
+# ---------------------------------------------------------------------------
+def box_iou(lhs, rhs, fmt="corner"):
+    """Pairwise IoU of (N,4) x (M,4) boxes (reference box_iou)."""
+    lhs = jnp.asarray(lhs)
+    rhs = jnp.asarray(rhs)
+    if fmt == "center":
+        def to_corner(b):
+            cx, cy, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([cx - w / 2, cy - h / 2,
+                              cx + w / 2, cy + h / 2], -1)
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    tl = jnp.maximum(lhs[:, None, :2], rhs[None, :, :2])
+    br = jnp.minimum(lhs[:, None, 2:], rhs[None, :, 2:])
+    wh = jnp.clip(br - tl, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = ((lhs[:, 2] - lhs[:, 0]) * (lhs[:, 3] - lhs[:, 1]))[:, None]
+    area_r = ((rhs[:, 2] - rhs[:, 0]) * (rhs[:, 3] - rhs[:, 1]))[None, :]
+    return inter / jnp.maximum(area_l + area_r - inter, 1e-12)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            score_index=1, coord_start=2):
+    """Greedy non-max suppression (reference box_nms): rows are
+    [class?, score, x1, y1, x2, y2, ...]; suppressed/invalid rows come
+    back as -1, survivors sorted by score — all static-shape, expressed
+    as an O(N^2) masked sweep under ``lax.fori_loop``."""
+    data = jnp.asarray(data)
+    n = data.shape[0]
+    scores = data[:, score_index]
+    boxes = data[:, coord_start:coord_start + 4]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    scores_s = scores[order]
+    iou = box_iou(boxes_s, boxes_s)
+    valid = scores_s > valid_thresh
+    if topk > 0:
+        valid = valid & (jnp.arange(n) < topk)
+
+    def body(i, keep):
+        # drop everything that overlaps an earlier KEPT box too much
+        sup = (iou[i] > overlap_thresh) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, n, body, valid)
+    out_sorted = jnp.where(keep[:, None], data[order], -1.0)
+    return out_sorted
+
+
+def bipartite_matching(score, threshold=1e-12, topk=-1, is_ascend=False):
+    """Greedy bipartite matching over an (N, M) score matrix (reference
+    contrib/bipartite_matching): repeatedly take the globally best pair,
+    retire its row and column. Returns (row->col, col->row) index vectors
+    with -1 for unmatched."""
+    score = jnp.asarray(score)
+    n, m = score.shape
+    k = min(n, m) if topk <= 0 else min(topk, min(n, m))
+
+    def body(_, state):
+        rowmatch, colmatch, s = state
+        flat = jnp.argmin(s.reshape(-1)) if is_ascend \
+            else jnp.argmax(s.reshape(-1))
+        r, c = flat // m, flat % m
+        good = (s[r, c] < threshold) if is_ascend \
+            else (s[r, c] > threshold)
+        rowmatch = jnp.where(good, rowmatch.at[r].set(c), rowmatch)
+        colmatch = jnp.where(good, colmatch.at[c].set(r), colmatch)
+        worst = -jnp.inf if not is_ascend else jnp.inf
+        s = jnp.where(good, s.at[r, :].set(worst).at[:, c].set(worst), s)
+        return rowmatch, colmatch, s
+
+    rowmatch = jnp.full((n,), -1, jnp.int32)
+    colmatch = jnp.full((m,), -1, jnp.int32)
+    rowmatch, colmatch, _ = lax.fori_loop(
+        0, k, body, (rowmatch, colmatch, score))
+    return rowmatch, colmatch
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
+                   offsets=(0.5, 0.5), clip=False):
+    """SSD anchor generation (reference contrib/multibox_prior.cc):
+    per feature-map cell, anchors for sizes[0]xratios plus extra sizes at
+    ratio 1 — ``len(sizes) + len(ratios) - 1`` anchors per cell."""
+    H, W = data.shape[-2:]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    whs = [(sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r)) for r in ratios]
+    whs += [(s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0]))
+            for s in sizes[1:]]
+    anchors = []
+    for w, h in whs:
+        anchors.append(jnp.stack(
+            [cxg - w / 2, cyg - h / 2, cxg + w / 2, cyg + h / 2], -1))
+    out = jnp.stack(anchors, axis=2).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# misc (reference contrib/allclose_op.cc, index_array.cc)
+# ---------------------------------------------------------------------------
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(jnp.asarray(a), jnp.asarray(b), rtol=rtol, atol=atol,
+                        equal_nan=equal_nan)
+
+
+def index_array(data, axes=None):
+    """Per-element coordinate array (reference contrib/index_array.cc):
+    out[i_0,...,i_k] = [i_0,...,i_k] (or the ``axes`` subset)."""
+    shape = jnp.asarray(data).shape
+    axes = tuple(range(len(shape))) if axes is None else tuple(axes)
+    grids = jnp.meshgrid(*[jnp.arange(s, dtype=jnp.int64) for s in shape],
+                         indexing="ij")
+    return jnp.stack([grids[a] for a in axes], axis=-1)

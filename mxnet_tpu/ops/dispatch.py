@@ -47,9 +47,6 @@ autograd_state = _AutogradState()
 # applies the mixed-precision cast rule at this single dispatch chokepoint
 amp_policy = None
 
-import os as _os
-
-_NAIVE = _os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
 
 
 def is_recording() -> bool:
@@ -179,10 +176,13 @@ def _apply_op(
         ]
         record = bool(grad_inputs)
 
+    from .. import engine as _engine
+
     if not record:
         out_vals = call(*vals)
-        if _NAIVE and hasattr(out_vals, "block_until_ready"):
-            out_vals.block_until_ready()  # MXNET_ENGINE_TYPE=NaiveEngine
+        # MXNET_ENGINE_TYPE=NaiveEngine or bulk(0): block per op (live
+        # knobs — the reference engine factory reads them per push)
+        _engine.maybe_sync(out_vals)
         if n_out == 1:
             return _wrap(out_vals)
         return tuple(_wrap(v) for v in out_vals)
@@ -195,6 +195,7 @@ def _apply_op(
         return call(*full)
 
     out_vals, vjp_fn = jax.vjp(fwd, *[vals[i] for i in grad_inputs])
+    _engine.maybe_sync(out_vals)  # per-op sync applies when recording too
     outs = (
         (_wrap(out_vals),) if n_out == 1 else tuple(_wrap(v) for v in out_vals)
     )

@@ -1,0 +1,285 @@
+/*
+ * c_api.cc — the stable C ABI (libmxtpu_capi.so).
+ *
+ * Reference: include/mxnet/c_api.h (262 MXNET_DLL functions) implemented
+ * by the src/c_api sources over the C++ runtime. In the TPU-native design the
+ * runtime is Python/JAX, so the C ABI embeds CPython and drives the thin
+ * marshalling helpers in mxnet_tpu/_capi.py. Other-language frontends
+ * (the reference's layer 11: cpp-package, R, Julia, ...) link this .so
+ * and never touch Python themselves.
+ *
+ * Conventions (identical to the reference):
+ *  - every function returns 0 on success, -1 on failure;
+ *  - MXGetLastError() returns the failing call's message (thread-local);
+ *  - handles are opaque pointers owned by the caller until *Free.
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#define MXTPU_DLL extern "C" __attribute__((visibility("default")))
+
+typedef void *NDArrayHandle;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const char *msg) { g_last_error = msg ? msg : "unknown"; }
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      set_error(PyUnicode_AsUTF8(s));
+      Py_DECREF(s);
+    }
+  } else {
+    set_error("unknown python error");
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+/* RAII GIL guard; also boots the interpreter for pure-C hosts. */
+class Gil {
+ public:
+  Gil() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+    }
+    state_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject *capi_module() {
+  static PyObject *mod = nullptr;  // leaked on purpose (process lifetime)
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu._capi");
+  }
+  return mod;
+}
+
+/* call mxnet_tpu._capi.<fn>(args...); returns new ref or null */
+PyObject *capi_call(const char *fn, PyObject *args) {
+  PyObject *mod = capi_module();
+  if (mod == nullptr) return nullptr;
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) return nullptr;
+  PyObject *out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return out;
+}
+
+}  // namespace
+
+MXTPU_DLL const char *MXGetLastError() { return g_last_error.c_str(); }
+
+MXTPU_DLL int MXGetVersion(int *out) {
+  Gil gil;
+  PyObject *r = capi_call("version", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayCreateFromBuffer(const void *data, size_t nbytes,
+                                        const int64_t *shape, int ndim,
+                                        int dtype_code, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *raw = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data), static_cast<Py_ssize_t>(nbytes));
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject *args = Py_BuildValue("(OOi)", raw, shp, dtype_code);
+  Py_DECREF(raw);
+  Py_DECREF(shp);
+  PyObject *r = capi_call("from_buffer", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = static_cast<NDArrayHandle>(r);  // ownership -> caller handle
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayFree(NDArrayHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayGetShape(NDArrayHandle handle, int max_ndim,
+                                int64_t *shape, int *ndim) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *r = capi_call("shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > max_ndim) {
+    Py_DECREF(r);
+    set_error("shape buffer too small");
+    return -1;
+  }
+  *ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    shape[i] = PyLong_AsLongLong(PyTuple_GetItem(r, i));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *dtype_code) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *r = capi_call("dtype_code", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *dtype_code = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t nbytes) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *r = capi_call("to_bytes", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t got = PyBytes_Size(r);
+  if (static_cast<size_t>(got) != nbytes) {
+    Py_DECREF(r);
+    set_error("size mismatch in MXNDArraySyncCopyToCPU");
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(r), nbytes);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXImperativeInvoke(const char *op_name, int n_in,
+                                 NDArrayHandle *inputs,
+                                 const char *kwargs_json, int max_out,
+                                 NDArrayHandle *outputs, int *n_out) {
+  Gil gil;
+  PyObject *ins = PyTuple_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    PyObject *o = static_cast<PyObject *>(inputs[i]);
+    Py_INCREF(o);
+    PyTuple_SetItem(ins, i, o);
+  }
+  PyObject *args = Py_BuildValue("(sOs)", op_name, ins,
+                                 kwargs_json ? kwargs_json : "");
+  Py_DECREF(ins);
+  PyObject *r = capi_call("invoke", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > max_out) {
+    Py_DECREF(r);
+    set_error("output buffer too small");
+    return -1;
+  }
+  *n_out = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyTuple_GetItem(r, i);
+    Py_INCREF(o);
+    outputs[i] = static_cast<NDArrayHandle>(o);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayWaitAll() {
+  Gil gil;
+  PyObject *r = capi_call("waitall", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- autograd (MXAutograd* parity subset) ---- */
+
+MXTPU_DLL int MXNDArrayAttachGrad(NDArrayHandle handle) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *r = capi_call("attach_grad", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXAutogradSetIsRecording(int on) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(i)", on);
+  PyObject *r = capi_call("autograd_record", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXAutogradBackward(NDArrayHandle loss) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(loss));
+  PyObject *r = capi_call("backward", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *r = capi_call("grad", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = static_cast<NDArrayHandle>(r);
+  return 0;
+}

@@ -1,0 +1,143 @@
+"""The stable C ABI (src/c_api/c_api.cc -> libmxtpu_capi.so; reference
+include/mxnet/c_api.h). Loads the .so with ctypes and drives it exactly as
+an external-language frontend would: create arrays from raw buffers,
+invoke ops by name, autograd round trip, copy results back, error paths.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as onp
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "mxnet_tpu", "_lib", "libmxtpu_capi.so")
+
+
+@pytest.fixture(scope="module")
+def capi():
+    if not os.path.exists(LIB):
+        if shutil.which("g++") is None:
+            pytest.skip("no g++ and no prebuilt libmxtpu_capi.so")
+        subprocess.run(["make", "capi"], cwd=os.path.join(ROOT, "src"),
+                       check=True, stdout=subprocess.DEVNULL)
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    # declare prototypes like a real C frontend's header would
+    p = ctypes.c_void_p
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    ip = ctypes.POINTER(ctypes.c_int)
+    pp = ctypes.POINTER(p)
+    lib.MXGetVersion.argtypes = [ip]
+    lib.MXNDArrayCreateFromBuffer.argtypes = [
+        p, ctypes.c_size_t, i64p, ctypes.c_int, ctypes.c_int, pp]
+    lib.MXNDArrayFree.argtypes = [p]
+    lib.MXNDArrayGetShape.argtypes = [p, ctypes.c_int, i64p, ip]
+    lib.MXNDArrayGetDType.argtypes = [p, ip]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [p, p, ctypes.c_size_t]
+    lib.MXImperativeInvoke.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, pp, ctypes.c_char_p,
+        ctypes.c_int, pp, ip]
+    lib.MXNDArrayAttachGrad.argtypes = [p]
+    lib.MXAutogradSetIsRecording.argtypes = [ctypes.c_int]
+    lib.MXAutogradBackward.argtypes = [p]
+    lib.MXNDArrayGetGrad.argtypes = [p, pp]
+    return lib
+
+
+def _make(capi, arr):
+    arr = onp.ascontiguousarray(arr)
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    code = {"float32": 0, "float64": 1, "int32": 4, "int64": 5,
+            "uint8": 6, "bool": 7}[str(arr.dtype)]
+    rc = capi.MXNDArrayCreateFromBuffer(
+        arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, shape, arr.ndim,
+        code, ctypes.byref(h))
+    assert rc == 0, capi.MXGetLastError()
+    return h
+
+
+def _fetch(capi, h, shape, dtype=onp.float32):
+    out = onp.empty(shape, dtype)
+    rc = capi.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+    assert rc == 0, capi.MXGetLastError()
+    return out
+
+
+def test_version(capi):
+    v = ctypes.c_int()
+    assert capi.MXGetVersion(ctypes.byref(v)) == 0
+    assert v.value == 20000
+
+
+def test_create_shape_dtype_copy(capi):
+    x = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    h = _make(capi, x)
+    shape = (ctypes.c_int64 * 8)()
+    ndim = ctypes.c_int()
+    assert capi.MXNDArrayGetShape(h, 8, shape, ctypes.byref(ndim)) == 0
+    assert list(shape[:ndim.value]) == [2, 3]
+    code = ctypes.c_int()
+    assert capi.MXNDArrayGetDType(h, ctypes.byref(code)) == 0
+    assert code.value == 0  # float32
+    onp.testing.assert_allclose(_fetch(capi, h, (2, 3)), x)
+    assert capi.MXNDArrayFree(h) == 0
+
+
+def test_imperative_invoke(capi):
+    a = _make(capi, onp.full((4,), 3.0, onp.float32))
+    b = _make(capi, onp.full((4,), 4.0, onp.float32))
+    ins = (ctypes.c_void_p * 2)(a, b)
+    outs = (ctypes.c_void_p * 8)()
+    n_out = ctypes.c_int()
+    rc = capi.MXImperativeInvoke(b"np.add", 2, ins, b"", 8, outs,
+                                 ctypes.byref(n_out))
+    assert rc == 0, capi.MXGetLastError()
+    assert n_out.value == 1
+    onp.testing.assert_allclose(
+        _fetch(capi, outs[0], (4,)), 7.0)
+    # kwargs via JSON: npx.softmax(axis=-1)
+    x = _make(capi, onp.array([[1.0, 2.0, 3.0]], onp.float32))
+    ins1 = (ctypes.c_void_p * 1)(x)
+    rc = capi.MXImperativeInvoke(b"npx.softmax", 1, ins1, b'{"axis": -1}',
+                                 8, outs, ctypes.byref(n_out))
+    assert rc == 0, capi.MXGetLastError()
+    got = _fetch(capi, outs[0], (1, 3))
+    e = onp.exp([1.0, 2.0, 3.0])
+    onp.testing.assert_allclose(got[0], e / e.sum(), rtol=1e-6)
+    assert capi.MXNDArrayWaitAll() == 0
+
+
+def test_autograd_roundtrip(capi):
+    x = _make(capi, onp.array([2.0, 3.0], onp.float32))
+    assert capi.MXNDArrayAttachGrad(x) == 0, capi.MXGetLastError()
+    assert capi.MXAutogradSetIsRecording(1) == 0
+    ins = (ctypes.c_void_p * 2)(x, x)
+    outs = (ctypes.c_void_p * 8)()
+    n_out = ctypes.c_int()
+    rc = capi.MXImperativeInvoke(b"np.multiply", 2, ins, b"", 8, outs,
+                                 ctypes.byref(n_out))  # y = x*x
+    assert rc == 0, capi.MXGetLastError()
+    y = outs[0]
+    ins1 = (ctypes.c_void_p * 1)(y)
+    rc = capi.MXImperativeInvoke(b"np.sum", 1, ins1, b"", 8, outs,
+                                 ctypes.byref(n_out))
+    assert rc == 0, capi.MXGetLastError()
+    loss = outs[0]
+    assert capi.MXAutogradSetIsRecording(0) == 0
+    assert capi.MXAutogradBackward(loss) == 0, capi.MXGetLastError()
+    g = ctypes.c_void_p()
+    assert capi.MXNDArrayGetGrad(x, ctypes.byref(g)) == 0
+    onp.testing.assert_allclose(_fetch(capi, g, (2,)), [4.0, 6.0])
+
+
+def test_error_paths(capi):
+    outs = (ctypes.c_void_p * 8)()
+    n_out = ctypes.c_int()
+    rc = capi.MXImperativeInvoke(b"np.definitely_not_an_op", 0, None, b"",
+                                 8, outs, ctypes.byref(n_out))
+    assert rc == -1
+    assert b"definitely_not_an_op" in capi.MXGetLastError()

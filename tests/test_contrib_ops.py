@@ -1,0 +1,231 @@
+"""Contrib op family vs pure-numpy oracles (reference src/operator/contrib/
+tested via tests/python/unittest/test_contrib_operator.py patterns)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+npx = mx.npx
+
+
+def test_roi_pooling_oracle():
+    rng = onp.random.RandomState(0)
+    data = rng.randn(2, 3, 8, 8).astype(onp.float32)
+    rois = onp.array([[0, 0, 0, 7, 7],
+                      [1, 2, 2, 6, 6],
+                      [0, 4, 4, 7, 5]], onp.float32)
+    out = npx.roi_pooling(mx.np.array(data), mx.np.array(rois),
+                          pooled_size=(2, 2)).asnumpy()
+
+    def oracle(roi):
+        b = int(roi[0])
+        x1, y1, x2, y2 = [int(round(v)) for v in roi[1:]]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        res = onp.zeros((3, 2, 2), onp.float32)
+        for ph in range(2):
+            for pw in range(2):
+                ys = int(onp.floor(y1 + ph * rh / 2))
+                ye = int(onp.ceil(y1 + (ph + 1) * rh / 2))
+                xs = int(onp.floor(x1 + pw * rw / 2))
+                xe = int(onp.ceil(x1 + (pw + 1) * rw / 2))
+                ys, ye = max(ys, 0), min(ye, 8)
+                xs, xe = max(xs, 0), min(xe, 8)
+                if ye > ys and xe > xs:
+                    res[:, ph, pw] = data[b, :, ys:ye, xs:xe].max((-1, -2))
+        return res
+
+    for i, roi in enumerate(rois):
+        onp.testing.assert_allclose(out[i], oracle(roi), rtol=1e-6)
+
+
+def test_roi_align_matches_manual_bilinear():
+    rng = onp.random.RandomState(1)
+    data = rng.randn(1, 2, 6, 6).astype(onp.float32)
+    rois = onp.array([[0, 1.0, 1.0, 4.0, 4.0]], onp.float32)
+    out = npx.roi_align(mx.np.array(data), mx.np.array(rois),
+                        pooled_size=(3, 3), sample_ratio=1).asnumpy()
+    # sample_ratio=1: one sample at each bin center
+    bin_size = 3.0 / 3  # roi is 3x3 after max(,1); bins are 1x1
+    for ph in range(3):
+        for pw in range(3):
+            y = 1.0 + (ph + 0.5) * bin_size
+            x = 1.0 + (pw + 0.5) * bin_size
+            y0, x0 = int(onp.floor(y)), int(onp.floor(x))
+            wy, wx = y - y0, x - x0
+            ref = (data[0, :, y0, x0] * (1 - wy) * (1 - wx)
+                   + data[0, :, y0, x0 + 1] * (1 - wy) * wx
+                   + data[0, :, y0 + 1, x0] * wy * (1 - wx)
+                   + data[0, :, y0 + 1, x0 + 1] * wy * wx)
+            onp.testing.assert_allclose(out[0, :, ph, pw], ref, rtol=1e-5)
+
+
+def test_roi_align_is_differentiable():
+    data = mx.np.array(onp.random.RandomState(2).randn(1, 2, 5, 5)
+                       .astype(onp.float32))
+    rois = mx.np.array(onp.array([[0, 0.5, 0.5, 3.5, 3.5]], onp.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = npx.roi_align(data, rois, pooled_size=(2, 2))
+        loss = out.sum()
+    loss.backward()
+    g = data.grad.asnumpy()
+    assert onp.abs(g).sum() > 0  # gradient flows through bilinear weights
+
+
+def test_boolean_mask():
+    data = onp.arange(12.0, dtype=onp.float32).reshape(4, 3)
+    mask = onp.array([1, 0, 1, 0])
+    out = npx.boolean_mask(mx.np.array(data), mx.np.array(mask)).asnumpy()
+    onp.testing.assert_allclose(out, data[[0, 2]])
+
+
+def test_count_sketch_oracle():
+    rng = onp.random.RandomState(3)
+    data = rng.randn(4, 6).astype(onp.float32)
+    h = rng.randint(0, 5, size=6)
+    s = rng.choice([-1.0, 1.0], size=6).astype(onp.float32)
+    out = npx.count_sketch(mx.np.array(data), mx.np.array(h),
+                           mx.np.array(s), out_dim=5).asnumpy()
+    ref = onp.zeros((4, 5), onp.float32)
+    for i in range(6):
+        ref[:, h[i]] += s[i] * data[:, i]
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_adaptive_avg_pool2d_oracle():
+    rng = onp.random.RandomState(4)
+    data = rng.randn(2, 3, 7, 5).astype(onp.float32)
+    out = npx.adaptive_avg_pool2d(mx.np.array(data), (3, 2)).asnumpy()
+    ref = onp.zeros((2, 3, 3, 2), onp.float32)
+    for i in range(3):
+        for j in range(2):
+            ys, ye = int(onp.floor(i * 7 / 3)), int(onp.ceil((i + 1) * 7 / 3))
+            xs, xe = int(onp.floor(j * 5 / 2)), int(onp.ceil((j + 1) * 5 / 2))
+            ref[:, :, i, j] = data[:, :, ys:ye, xs:xe].mean((-1, -2))
+    onp.testing.assert_allclose(out, ref, rtol=1e-5)
+    # identity when output size == input size
+    same = npx.adaptive_avg_pool2d(mx.np.array(data), (7, 5)).asnumpy()
+    onp.testing.assert_allclose(same, data, rtol=1e-6)
+
+
+def test_box_iou_oracle():
+    a = onp.array([[0, 0, 2, 2], [1, 1, 3, 3]], onp.float32)
+    b = onp.array([[0, 0, 2, 2], [2, 2, 4, 4]], onp.float32)
+    out = npx.box_iou(mx.np.array(a), mx.np.array(b)).asnumpy()
+    onp.testing.assert_allclose(out[0, 0], 1.0)
+    onp.testing.assert_allclose(out[0, 1], 0.0)
+    onp.testing.assert_allclose(out[1, 0], 1.0 / 7.0, rtol=1e-5)
+    onp.testing.assert_allclose(out[1, 1], 1.0 / 7.0, rtol=1e-5)
+
+
+def test_box_nms_suppresses_overlaps():
+    boxes = onp.array([
+        [0, 0.9, 0, 0, 2, 2],       # kept (highest score)
+        [0, 0.8, 0.1, 0.1, 2, 2],   # overlaps first -> suppressed
+        [0, 0.7, 5, 5, 7, 7],       # disjoint -> kept
+        [0, 0.05, 8, 8, 9, 9],      # below valid_thresh -> dropped
+    ], onp.float32)
+    out = npx.box_nms(mx.np.array(boxes), overlap_thresh=0.5,
+                      valid_thresh=0.1).asnumpy()
+    kept = out[out[:, 0] >= 0]
+    assert kept.shape[0] == 2
+    onp.testing.assert_allclose(sorted(kept[:, 1].tolist(), reverse=True),
+                                [0.9, 0.7])
+
+
+def test_bipartite_matching_greedy():
+    score = onp.array([[0.9, 0.1], [0.8, 0.7]], onp.float32)
+    rows, cols = npx.bipartite_matching(mx.np.array(score), threshold=0.05)
+    rows, cols = rows.asnumpy(), cols.asnumpy()
+    # greedy: (0,0)=0.9 first, then row1 must take col1 (0.7)
+    onp.testing.assert_array_equal(rows, [0, 1])
+    onp.testing.assert_array_equal(cols, [0, 1])
+    rows2, _ = npx.bipartite_matching(mx.np.array(score), threshold=0.75)
+    assert rows2.asnumpy().tolist() == [0, -1]  # 0.7 below threshold
+
+
+def test_multibox_prior_shapes_and_centers():
+    data = mx.np.zeros((1, 3, 4, 4))
+    anchors = npx.multibox_prior(data, sizes=(0.5, 0.25),
+                                 ratios=(1.0, 2.0)).asnumpy()
+    # len(sizes) + len(ratios) - 1 = 3 anchors per cell
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    first = anchors[0, 0]  # cell (0,0), size 0.5 ratio 1
+    cx, cy = 0.5 / 4, 0.5 / 4
+    onp.testing.assert_allclose(first, [cx - 0.25, cy - 0.25,
+                                        cx + 0.25, cy + 0.25], rtol=1e-5)
+
+
+def test_allclose_and_index_array():
+    a = mx.np.ones((3,))
+    b = mx.np.array(onp.array([1.0, 1.0, 1.0 + 1e-7], onp.float32))
+    assert bool(npx.allclose(a, b).asnumpy())
+    idx = npx.index_array(mx.np.zeros((2, 3))).asnumpy()
+    assert idx.shape == (2, 3, 2)
+    onp.testing.assert_array_equal(idx[1, 2], [1, 2])
+
+
+def test_sync_batch_norm_matches_local_bn_single_device():
+    rng = onp.random.RandomState(5)
+    x = rng.randn(4, 3, 5, 5).astype(onp.float32)
+    gamma = onp.ones(3, onp.float32)
+    beta = onp.zeros(3, onp.float32)
+    mm = mx.np.array(onp.zeros(3, onp.float32))
+    mv = mx.np.array(onp.ones(3, onp.float32))
+    with autograd.record():
+        out, mean, var = npx.sync_batch_norm(
+            mx.np.array(x), mx.np.array(gamma), mx.np.array(beta),
+            mm, mv, eps=1e-5, momentum=0.9)
+    ref_mean = x.mean((0, 2, 3))
+    ref_var = x.var((0, 2, 3))
+    onp.testing.assert_allclose(mean.asnumpy(), ref_mean, rtol=1e-5)
+    onp.testing.assert_allclose(var.asnumpy(), ref_var, rtol=1e-4, atol=1e-6)
+    ref = ((x - ref_mean[None, :, None, None])
+           / onp.sqrt(ref_var[None, :, None, None] + 1e-5))
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+    # training updated the moving stats in place (aux-state mutation)
+    onp.testing.assert_allclose(mm.asnumpy(), 0.1 * ref_mean, rtol=1e-4)
+    onp.testing.assert_allclose(mv.asnumpy(), 0.9 + 0.1 * ref_var, rtol=1e-4)
+
+    # inference path normalizes with the MOVING stats, not batch stats
+    out_inf, mean_inf, _ = npx.sync_batch_norm(
+        mx.np.array(x), mx.np.array(gamma), mx.np.array(beta),
+        mm, mv, eps=1e-5)
+    onp.testing.assert_allclose(mean_inf.asnumpy(), mm.asnumpy(), rtol=1e-6)
+    ref_inf = ((x - mm.asnumpy()[None, :, None, None])
+               / onp.sqrt(mv.asnumpy()[None, :, None, None] + 1e-5))
+    onp.testing.assert_allclose(out_inf.asnumpy(), ref_inf, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_sync_batch_norm_syncs_across_mesh_axis():
+    """Inside shard_map over a dp axis, stats must be MESH-GLOBAL: every
+    shard normalizes with the same mean/var as unsharded BN."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from mxnet_tpu import parallel
+    from mxnet_tpu.ops import contrib as C
+
+    mesh = parallel.make_mesh({"dp": 8})
+    rng = onp.random.RandomState(6)
+    x = rng.randn(16, 3, 4, 4).astype(onp.float32)
+    gamma = onp.ones(3, onp.float32)
+    beta = onp.zeros(3, onp.float32)
+
+    def local(xs):
+        out, m, v, _, _ = C.sync_batch_norm(
+            xs, jnp.asarray(gamma), jnp.asarray(beta),
+            None, None, eps=1e-5, axis_name="dp")
+        return out
+
+    f = shard_map(local, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    out = onp.asarray(f(jnp.asarray(x)))
+    ref_mean = x.mean((0, 2, 3))
+    ref_var = x.var((0, 2, 3))
+    ref = ((x - ref_mean[None, :, None, None])
+           / onp.sqrt(ref_var[None, :, None, None] + 1e-5))
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

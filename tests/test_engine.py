@@ -1,0 +1,98 @@
+"""Engine contract (reference tests/python/unittest/test_exc_handling.py +
+engine semantics from SURVEY.md §5): async exception surfacing at
+wait_to_read/waitall, NaiveEngine determinism, live bulk-size knob.
+"""
+import jax
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine
+from mxnet_tpu.ops.dispatch import apply_op
+
+
+def _async_failing_op(x):
+    """An op whose failure happens at EXECUTION time, not trace time —
+    the async boundary the reference engine test exercises."""
+    def boom(v):
+        raise ValueError("boom at execution")
+
+    def fn(v):
+        return jax.pure_callback(
+            boom, jax.ShapeDtypeStruct(v.shape, v.dtype), v)
+
+    return apply_op(fn, [x], name="failing_op")
+
+
+def test_execution_error_surfaces_no_later_than_wait():
+    """The reference contract (threaded_engine.cc:422): an op failing at
+    execution time surfaces to the caller at the latest on wait_to_read —
+    never silently lost. On async backends (TPU) the raise is deferred to
+    the wait; the CPU backend executes callbacks at dispatch, which also
+    satisfies the contract."""
+    with pytest.raises(Exception) as ei:
+        out = _async_failing_op(mx.np.ones((4,)))
+        out.wait_to_read()
+    assert "boom" in str(ei.value)
+
+
+def test_execution_error_surfaces_at_asnumpy():
+    with pytest.raises(Exception) as ei:
+        out = _async_failing_op(mx.np.ones((2, 2)))
+        out.asnumpy()
+    assert "boom" in str(ei.value)
+
+
+def test_waitall_after_failure_leaves_engine_usable():
+    with pytest.raises(Exception):
+        out = _async_failing_op(mx.np.ones((3,)))
+        out.wait_to_read()
+    engine.waitall()  # must not raise or deadlock after a failed op
+    y = (mx.np.ones((3,)) * 2).asnumpy()  # engine still serves new work
+    onp.testing.assert_allclose(y, 2.0)
+
+
+def test_naive_engine_env_is_live(monkeypatch):
+    assert not engine.is_naive()
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    assert engine.is_naive()
+    assert engine.sync_each_op()
+    # ops still compute correctly in synchronous mode
+    y = (mx.np.arange(4) + 1).asnumpy()
+    onp.testing.assert_allclose(y, [1, 2, 3, 4])
+    monkeypatch.delenv("MXNET_ENGINE_TYPE")
+    assert not engine.sync_each_op()
+
+
+def test_bulk_zero_is_synchronous_scope():
+    assert not engine.sync_each_op()
+    with engine.bulk(0):
+        assert engine.sync_each_op()
+        y = mx.np.ones((2,)) * 3  # dispatch blocks per op here
+        onp.testing.assert_allclose(y.asnumpy(), 3.0)
+    assert not engine.sync_each_op()
+    prev = engine.set_bulk_size(0)
+    assert engine.sync_each_op()
+    engine.set_bulk_size(prev)
+
+
+def test_trace_time_errors_are_synchronous():
+    """Shape errors are caught at dispatch (trace) time, not deferred —
+    the reference surfaces these synchronously too (imperative_utils.h
+    SetShapeType)."""
+    with pytest.raises(Exception):
+        mx.np.dot(mx.np.ones((2, 3)), mx.np.ones((2, 3)))
+
+
+def test_bulk_zero_syncs_under_record():
+    """Per-op sync must apply on the RECORDING path too (review finding:
+    the debug knob is most needed inside training steps)."""
+    from mxnet_tpu import autograd
+
+    with engine.bulk(0):
+        x = mx.np.ones((4,))
+        x.attach_grad()
+        with autograd.record():
+            y = (x * 2).sum()  # dispatches through the recording branch
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2.0)
