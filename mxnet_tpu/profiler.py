@@ -48,6 +48,7 @@ _config = {
 _state = "stop"
 _events: List[dict] = []
 _agg: Dict[str, List[float]] = defaultdict(list)
+_agg_mem: Dict[str, int] = {}
 _jax_tracing = False
 
 
@@ -96,35 +97,57 @@ def resume(profile_process="worker"):
     set_state("run")
 
 
+def device_memory(device=None) -> dict:
+    """Live device-memory counters (the storage_profiler.cc analog):
+    ``bytes_in_use`` / ``peak_bytes_in_use`` etc. from the XLA allocator.
+    Returns {} on backends that expose no stats (virtual CPU devices)."""
+    import jax
+
+    d = device or jax.devices()[0]
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
 def record_op(name: str, dur_s: float, cat: str = "operator"):
     """Called by the dispatch layer per eager op while profiling."""
     ts = time.perf_counter() * 1e6
+    mem = device_memory().get("bytes_in_use", 0)
     with _lock:
-        _events.append(
-            {
-                "name": name,
-                "cat": cat,
-                "ph": "X",
-                "ts": ts - dur_s * 1e6,
-                "dur": dur_s * 1e6,
-                "pid": os.getpid(),
-                "tid": threading.get_ident() % 10000,
-            }
-        )
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": ts - dur_s * 1e6,
+            "dur": dur_s * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 10000,
+        }
+        if mem:
+            ev["args"] = {"bytes_in_use": mem}
+        _events.append(ev)
         _agg[name].append(dur_s * 1e3)
+        if mem:
+            _agg_mem[name] = max(_agg_mem.get(name, 0), mem)
 
 
 def dumps(reset: bool = False) -> str:
-    """Aggregate per-op stats table (reference aggregate_stats.cc)."""
-    lines = [f"{'Name':<30}{'Calls':>8}{'Total(ms)':>12}{'Mean(ms)':>12}{'Max(ms)':>12}"]
+    """Aggregate per-op stats table (reference aggregate_stats.cc), with a
+    peak device-memory column when the backend reports allocator stats."""
+    lines = [f"{'Name':<30}{'Calls':>8}{'Total(ms)':>12}{'Mean(ms)':>12}"
+             f"{'Max(ms)':>12}{'PeakMem(MB)':>13}"]
     with _lock:
         for name, times in sorted(_agg.items(), key=lambda kv: -sum(kv[1])):
+            peak = _agg_mem.get(name, 0) / (1024 * 1024)
             lines.append(
                 f"{name:<30}{len(times):>8}{sum(times):>12.3f}"
                 f"{sum(times) / len(times):>12.3f}{max(times):>12.3f}"
+                f"{peak:>13.2f}"
             )
         if reset:
             _agg.clear()
+            _agg_mem.clear()
     return "\n".join(lines)
 
 
