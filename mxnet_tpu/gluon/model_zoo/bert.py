@@ -126,6 +126,38 @@ class _CausalLM(HybridBlock):
         w = self.word_embed.weight.data()
         return seq @ w.T
 
+    def decode_step(self, token_ids, cache_k, cache_v, pos):
+        """KV-cache forward of ``token_ids`` (B, T) at absolute positions
+        [pos, pos+T). Returns (logits (B, T, V), new_ck, new_cv). Used by
+        :func:`mxnet_tpu.gluon.model_zoo.generation.generate`."""
+        from ...numpy_extension import _call
+        import jax as _jax
+
+        emb = self.word_embed(token_ids)
+        pos_table = self.pos_embed.data()
+        t = token_ids.shape[1]
+
+        def add_pos(e, table, ps):
+            sl = _jax.lax.dynamic_slice(
+                table, (ps.astype(jnp.int32), jnp.zeros((), jnp.int32)),
+                (t, table.shape[1]))
+            return e + sl[None]
+
+        emb = _call(add_pos, (emb, pos_table, pos), name="add_pos_embed")
+        seq, ck, cv = self.encoder.forward_step(emb, cache_k, cache_v, pos)
+        w = self.word_embed.weight.data()
+        return seq @ w.T, ck, cv
+
+    def init_cache(self, batch_size, max_length, dtype="float32"):
+        """Zeroed (L, B, H, Lmax, D) key/value ring buffers."""
+        from ... import numpy as mxnp
+
+        enc = self.encoder
+        heads = enc.layer0.attn._heads
+        d = enc.layer0.attn._units // heads
+        shape = (enc._num_layers, batch_size, heads, max_length, d)
+        return mxnp.zeros(shape, dtype=dtype), mxnp.zeros(shape, dtype=dtype)
+
 
 def gpt_like(**kwargs):
     return _CausalLM(**kwargs)

@@ -1,0 +1,107 @@
+"""Autoregressive generation with a KV cache.
+
+The reference has no in-tree generation loop (gluonnlp's beam search ran
+eager per-step graphs). TPU-first design: prefill and decode are each ONE
+compiled XLA program — the decode step runs under ``lax.scan`` with a
+preallocated (L, B, H, Lmax, D) cache updated by ``dynamic_update_slice``,
+so generating N tokens costs one compile + one device program, not N
+dispatches. Sampling (greedy / temperature / top-k) happens on device
+inside the scan.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import ndarray, _unwrap, _wrap
+from ..block import HybridBlock
+
+__all__ = ["generate"]
+
+
+class _StepAdapter(HybridBlock):
+    """Exposes model.decode_step as a plain forward so ``functionalize``
+    can turn it into a pure jittable function."""
+
+    def __init__(self, model):
+        super().__init__()
+        self.model = model
+
+    def forward(self, tokens, cache_k, cache_v, pos):
+        return self.model.decode_step(tokens, cache_k, cache_v, pos)
+
+
+def _sample(logits, key, greedy, temperature, top_k):
+    """Pick next tokens from (B, V) logits, on device."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model, prompt_ids, max_new_tokens: int,
+             max_length: Optional[int] = None, greedy: bool = True,
+             temperature: float = 1.0, top_k: int = 0, eos_token: int = -1,
+             seed: int = 0):
+    """Generate ``max_new_tokens`` continuations of ``prompt_ids`` (B, P).
+
+    ``model`` must provide ``decode_step``/``init_cache`` (the causal LM
+    contract, :class:`~mxnet_tpu.gluon.model_zoo.bert._CausalLM`). Returns
+    an (B, max_new_tokens) int32 ndarray. ``eos_token``: once every
+    sequence has emitted it, remaining positions repeat it (the scan still
+    runs to length — static shapes — but the output is clean).
+    """
+    from ... import numpy as mxnp
+
+    prompt = prompt_ids if isinstance(prompt_ids, ndarray) \
+        else mxnp.array(onp.asarray(prompt_ids, onp.int32))
+    b, p = prompt.shape
+    lmax = max_length or (p + max_new_tokens)
+    if lmax < p + max_new_tokens:
+        raise MXNetError(
+            f"max_length {lmax} < prompt {p} + max_new_tokens "
+            f"{max_new_tokens}")
+    ck, cv = model.init_cache(b, lmax)
+
+    adapter = _StepAdapter(model)
+    pos0 = mxnp.array(onp.zeros((), onp.int32))
+    # two pure programs: prefill over (B, P), decode over (B, 1)
+    prefill_fn, params = adapter.functionalize(prompt, ck, cv, pos0)
+    tok1 = mxnp.array(onp.zeros((b, 1), onp.int32))
+    decode_fn, _ = adapter.functionalize(tok1, ck, cv, pos0)
+
+    def run(params, prompt_v, ck_v, cv_v, key):
+        (logits, ck_v, cv_v), _ = prefill_fn(
+            params, prompt_v, ck_v, cv_v, jnp.zeros((), jnp.int32))
+        key, sub = jax.random.split(key)
+        first = _sample(logits[:, -1], sub, greedy, temperature, top_k)
+        done = first == eos_token
+
+        def body(carry, _):
+            tok, ck_c, cv_c, pos, key_c, done_c = carry
+            (step_logits, ck_c, cv_c), _ = decode_fn(
+                params, tok[:, None], ck_c, cv_c, pos)
+            key_c, sub_c = jax.random.split(key_c)
+            nxt = _sample(step_logits[:, -1], sub_c, greedy, temperature,
+                          top_k)
+            nxt = jnp.where(done_c, eos_token, nxt)
+            done_c = done_c | (nxt == eos_token)
+            return (nxt, ck_c, cv_c, pos + 1, key_c, done_c), nxt
+
+        carry = (first, ck_v, cv_v, jnp.asarray(p, jnp.int32), key, done)
+        if max_new_tokens > 1:
+            _, rest = jax.lax.scan(body, carry, None,
+                                   length=max_new_tokens - 1)
+            return jnp.concatenate([first[:, None], rest.T], axis=1)
+        return first[:, None]
+
+    out = jax.jit(run)(params, _unwrap(prompt), _unwrap(ck), _unwrap(cv),
+                       jax.random.PRNGKey(seed))
+    return _wrap(out)

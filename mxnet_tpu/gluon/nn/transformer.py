@@ -109,6 +109,46 @@ class MultiHeadAttention(HybridBlock):
         args.append(_wrap(key))
         return self.out_proj(_call(fn, tuple(args), name="MultiHeadAttention"))
 
+    def forward_step(self, x, cache_k, cache_v, pos):
+        """Incremental (KV-cache) attention: ``x`` is (B, T, units) at
+        absolute positions [pos, pos+T); caches are (B, H, Lmax, D)
+        ring buffers written in place via ``dynamic_update_slice``.
+        T = prompt length for prefill, 1 for decode. Returns
+        (out, new_cache_k, new_cache_v). Static shapes throughout, so one
+        XLA program serves every step — the TPU-idiomatic decode loop."""
+        units, heads = self._units, self._heads
+        proj = self.qkv(x)
+
+        def fn(p, ck, cv, ps):
+            B, T, _ = p.shape
+            D = units // heads
+            ps = ps.astype(jnp.int32)
+
+            def split_heads(t):  # (B, T, U) -> (B, H, T, D)
+                return t.reshape(B, T, heads, D).transpose(0, 2, 1, 3)
+
+            q = split_heads(p[..., :units])
+            k = split_heads(p[..., units:2 * units])
+            v = split_heads(p[..., 2 * units:])
+            zero = jnp.zeros((), jnp.int32)
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (zero, zero, ps, zero))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (zero, zero, ps, zero))
+            lmax = ck.shape[2]
+            scores = jnp.einsum("bhtd,bhld->bhtl", q, ck).astype(jnp.float32)
+            scores = scores / onp.sqrt(D).astype(onp.float32)
+            col = jnp.arange(lmax)[None, None, None, :]
+            row = ps + jnp.arange(T)[None, None, :, None]
+            scores = jnp.where(col <= row, scores, -jnp.inf)
+            attn = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+            out = jnp.einsum("bhtl,bhld->bhtd", attn, cv)
+            return out.transpose(0, 2, 1, 3).reshape(B, T, units), ck, cv
+
+        out, new_ck, new_cv = _call(fn, (proj, cache_k, cache_v, pos),
+                                    name="MultiHeadAttentionStep", n_out=3)
+        return self.out_proj(out), new_ck, new_cv
+
 
 class PositionwiseFFN(HybridBlock):
     """FFN(x) = W2 act(W1 x); optional TP sharding (column→row)."""
@@ -177,6 +217,17 @@ class TransformerEncoderLayer(HybridBlock):
             h = self.dropout(h)
         return self.ln2(x + h)
 
+    def forward_step(self, x, cache_k, cache_v, pos):
+        """KV-cache variant of forward (no dropout: decode is inference)."""
+        if self._pre_norm:
+            h, ck, cv = self.attn.forward_step(self.ln1(x), cache_k,
+                                               cache_v, pos)
+            x = x + h
+            return x + self.ffn(self.ln2(x)), ck, cv
+        h, ck, cv = self.attn.forward_step(x, cache_k, cache_v, pos)
+        x = self.ln1(x + h)
+        return self.ln2(x + self.ffn(x)), ck, cv
+
 
 class TransformerEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
@@ -197,3 +248,18 @@ class TransformerEncoder(HybridBlock):
         if self.final_ln is not None:
             x = self.final_ln(x)
         return x
+
+    def forward_step(self, x, cache_k, cache_v, pos):
+        """KV-cache decode through the stack. ``cache_k``/``cache_v`` are
+        (num_layers, B, H, Lmax, D) stacked ring buffers."""
+        from ... import numpy as mxnp
+
+        new_ks, new_vs = [], []
+        for i in range(self._num_layers):
+            x, ck, cv = getattr(self, f"layer{i}").forward_step(
+                x, cache_k[i], cache_v[i], pos)
+            new_ks.append(ck)
+            new_vs.append(cv)
+        if self.final_ln is not None:
+            x = self.final_ln(x)
+        return x, mxnp.stack(new_ks), mxnp.stack(new_vs)
