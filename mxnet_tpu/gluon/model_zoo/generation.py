@@ -68,17 +68,25 @@ def generate(model, prompt_ids, max_new_tokens: int,
         raise MXNetError(
             f"max_length {lmax} < prompt {p} + max_new_tokens "
             f"{max_new_tokens}")
-    ck, cv = model.init_cache(b, lmax)
+    pos_table = getattr(model, "pos_embed", None)
+    if pos_table is not None and lmax > pos_table.shape[0]:
+        # jax dynamic_slice CLAMPS out-of-range starts — decoding past the
+        # position table would silently reuse the last embedding
+        raise MXNetError(
+            f"generation length {lmax} exceeds the model's context window "
+            f"(max_length={pos_table.shape[0]})")
+    cache_dtype = onp.dtype(model.word_embed.weight.dtype).name \
+        if hasattr(model, "word_embed") else "float32"
+    ck, cv = model.init_cache(b, lmax, dtype=cache_dtype)
 
     adapter = _StepAdapter(model)
     pos0 = mxnp.array(onp.zeros((), onp.int32))
-    # two pure programs: prefill over (B, P), decode over (B, 1)
-    prefill_fn, params = adapter.functionalize(prompt, ck, cv, pos0)
-    tok1 = mxnp.array(onp.zeros((b, 1), onp.int32))
-    decode_fn, _ = adapter.functionalize(tok1, ck, cv, pos0)
+    # functionalize is shape-generic: the SAME pure fn serves the (B, P)
+    # prefill and every (B, 1) decode step (two jit specializations)
+    step_fn, params = adapter.functionalize(prompt, ck, cv, pos0)
 
     def run(params, prompt_v, ck_v, cv_v, key):
-        (logits, ck_v, cv_v), _ = prefill_fn(
+        (logits, ck_v, cv_v), _ = step_fn(
             params, prompt_v, ck_v, cv_v, jnp.zeros((), jnp.int32))
         key, sub = jax.random.split(key)
         first = _sample(logits[:, -1], sub, greedy, temperature, top_k)
@@ -86,7 +94,7 @@ def generate(model, prompt_ids, max_new_tokens: int,
 
         def body(carry, _):
             tok, ck_c, cv_c, pos, key_c, done_c = carry
-            (step_logits, ck_c, cv_c), _ = decode_fn(
+            (step_logits, ck_c, cv_c), _ = step_fn(
                 params, tok[:, None], ck_c, cv_c, pos)
             key_c, sub_c = jax.random.split(key_c)
             nxt = _sample(step_logits[:, -1], sub_c, greedy, temperature,

@@ -97,6 +97,9 @@ def resume(profile_process="worker"):
     set_state("run")
 
 
+_mem_probe = None  # None = unprobed; False = backend has no stats
+
+
 def device_memory(device=None) -> dict:
     """Live device-memory counters (the storage_profiler.cc analog):
     ``bytes_in_use`` / ``peak_bytes_in_use`` etc. from the XLA allocator.
@@ -110,10 +113,34 @@ def device_memory(device=None) -> dict:
         return {}
 
 
+def _mem_in_use() -> int:
+    """Per-op memory probe with the no-stats case cached (record_op is on
+    the profiled hot path; don't pay device resolution per op for {})."""
+    global _mem_probe
+    if _mem_probe is False:
+        return 0
+    if _mem_probe is None:
+        import jax
+
+        try:
+            dev = jax.devices()[0]
+            if not (dev.memory_stats() or {}):
+                _mem_probe = False
+                return 0
+            _mem_probe = dev
+        except Exception:
+            _mem_probe = False
+            return 0
+    try:
+        return int((_mem_probe.memory_stats() or {}).get("bytes_in_use", 0))
+    except Exception:
+        return 0
+
+
 def record_op(name: str, dur_s: float, cat: str = "operator"):
     """Called by the dispatch layer per eager op while profiling."""
     ts = time.perf_counter() * 1e6
-    mem = device_memory().get("bytes_in_use", 0)
+    mem = _mem_in_use()
     with _lock:
         ev = {
             "name": name,
