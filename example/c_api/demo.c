@@ -1,0 +1,73 @@
+/*
+ * demo.c — drive the framework from plain C through the stable ABI
+ * (the other-language-frontend path; reference cpp-package/R/Julia bind
+ * the same way against libmxnet's c_api.h).
+ *
+ * Build & run (libmxtpu_capi.so built via `make -C src capi`):
+ *   gcc -O2 example/c_api/demo.c -o demo \
+ *       -L mxnet_tpu/_lib -lmxtpu_capi -Wl,-rpath,$PWD/mxnet_tpu/_lib
+ *   PYTHONPATH=$PWD ./demo
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void *NDArrayHandle;
+
+extern const char *MXGetLastError(void);
+extern int MXGetVersion(int *out);
+extern int MXNDArrayCreateFromBuffer(const void *data, size_t nbytes,
+                                     const int64_t *shape, int ndim,
+                                     int dtype_code, NDArrayHandle *out);
+extern int MXNDArrayFree(NDArrayHandle h);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data, size_t nbytes);
+extern int MXImperativeInvoke(const char *op, int n_in, NDArrayHandle *ins,
+                              const char *kwargs_json, int max_out,
+                              NDArrayHandle *outs, int *n_out);
+extern int MXNDArrayWaitAll(void);
+
+#define CHECK(call)                                                    \
+  do {                                                                 \
+    if ((call) != 0) {                                                 \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError());       \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+int main(void) {
+  int version = 0;
+  CHECK(MXGetVersion(&version));
+  printf("mxnet_tpu version %d\n", version);
+
+  float a_data[6] = {1, 2, 3, 4, 5, 6};
+  float b_data[6] = {10, 20, 30, 40, 50, 60};
+  int64_t shape[2] = {2, 3};
+  NDArrayHandle a, b;
+  CHECK(MXNDArrayCreateFromBuffer(a_data, sizeof a_data, shape, 2, 0, &a));
+  CHECK(MXNDArrayCreateFromBuffer(b_data, sizeof b_data, shape, 2, 0, &b));
+
+  NDArrayHandle ins[2] = {a, b};
+  NDArrayHandle outs[8];
+  int n_out = 0;
+  CHECK(MXImperativeInvoke("np.add", 2, ins, "", 8, outs, &n_out));
+  CHECK(MXNDArrayWaitAll());
+
+  float result[6];
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], result, sizeof result));
+  printf("np.add -> [%g %g %g %g %g %g]\n", result[0], result[1], result[2],
+         result[3], result[4], result[5]);
+
+  NDArrayHandle sm_ins[1] = {outs[0]};
+  NDArrayHandle sm_outs[8];
+  CHECK(MXImperativeInvoke("npx.softmax", 1, sm_ins, "{\"axis\": -1}", 8,
+                           sm_outs, &n_out));
+  CHECK(MXNDArraySyncCopyToCPU(sm_outs[0], result, sizeof result));
+  printf("npx.softmax row0 -> [%g %g %g]\n", result[0], result[1], result[2]);
+
+  MXNDArrayFree(a);
+  MXNDArrayFree(b);
+  MXNDArrayFree(outs[0]);
+  MXNDArrayFree(sm_outs[0]);
+  printf("OK\n");
+  return 0;
+}

@@ -60,7 +60,8 @@ def __getattr__(name):
 
     targets = {"test_utils": ".test_utils", "image": ".image", "amp": ".amp",
                "io": ".io", "monitor": ".monitor", "contrib": ".contrib",
-               "checkpoint": ".checkpoint",
+               "checkpoint": ".checkpoint", "rtc": ".rtc",
+               "library": ".library",
                "parallel": ".parallel", "random": ".numpy.random",
                "sym": ".symbol", "symbol": ".symbol"}
     if name in targets:

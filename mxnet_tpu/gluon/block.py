@@ -27,7 +27,8 @@ from ..context import Context, current_context
 from ..ndarray.ndarray import ndarray, _wrap, _unwrap
 from ..ops.dispatch import apply_op, autograd_state
 from .. import initializer as init_mod
-from .parameter import Parameter, DeferredInitializationError
+from .parameter import (Parameter, DeferredInitializationError,
+                        substitute_params)
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
@@ -251,6 +252,7 @@ class _CachedGraph:
         "mutated_params",
         "param_list",
         "diff_idx",
+        "warm",
     )
 
     def __init__(self, fwd_fn, bwd_fn, n_outputs, out_treedef, mutated_params, param_list, diff_idx):
@@ -261,6 +263,9 @@ class _CachedGraph:
         self.mutated_params = mutated_params
         self.param_list = param_list
         self.diff_idx = diff_idx
+        # False until the first invocation finishes: tracing swaps param
+        # data for tracers, so cold invocations hold the block trace lock
+        self.warm = False
 
 
 class HybridBlock(Block):
@@ -272,6 +277,9 @@ class HybridBlock(Block):
         self._active = False
         self._cached_graphs: Dict[Any, _CachedGraph] = {}
         self._flags: Dict[str, Any] = {}
+        import threading
+
+        self._trace_lock = threading.RLock()
 
     def hybridize(self, active: bool = True, static_alloc: bool = False,
                   static_shape: bool = False, inline_limit: int = 2,
@@ -318,7 +326,14 @@ class HybridBlock(Block):
         d["_cached_graphs"] = {}  # jitted executables are rebuilt on load
         d["_forward_hooks"] = []
         d["_forward_pre_hooks"] = []
+        d.pop("_trace_lock", None)  # locks don't pickle
         return d
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
+        self._trace_lock = threading.RLock()
 
     def export(self, path: str, epoch: int = 0, remove_amp_cast: bool = True,
                example_args=None):
@@ -422,14 +437,40 @@ class HybridBlock(Block):
         training = autograd_state.training
         sig = (self._signature(flat_vals, training), in_treedef)
         cg = self._cached_graphs.get(sig)
-        if cg is None:
-            cg = self._build_cache(args, flat_vals, in_treedef, training, plist)
-            self._cached_graphs[sig] = cg
+        if cg is None or not cg.warm:
+            # thread-safe first trace (CachedOpThreadSafe contract,
+            # reference cached_op_threadsafe.h:82). Correctness against
+            # concurrent traces comes from THREAD-LOCAL param
+            # substitution (parameter.substitute_params); this lock only
+            # serializes compilation so racing threads don't build the
+            # same executable twice.
+            with self._trace_lock:
+                cg = self._cached_graphs.get(sig)
+                if cg is None:
+                    cg = self._build_cache(args, flat_vals, in_treedef,
+                                           training, plist)
+                    self._cached_graphs[sig] = cg
+                outs = self._run_cached(cg, flat_vals)
+                cg.warm = True
+                return self._finish_cached(cg, outs)
+
+        return self._finish_cached(cg, self._run_cached(cg, flat_vals))
+
+    def _run_cached(self, cg: "_CachedGraph", flat_vals):
+        from ..numpy import random as _random
+        from .parameter import _tls_override
 
         key = _random.new_key()
-        arrays = [p._data for _, p in cg.param_list] + [_wrap(v) for v in flat_vals] + [_wrap(key)]
+        # override-aware param read: invoked inside ANOTHER block's trace,
+        # params must flow in as that trace's tracers, not be baked into
+        # the outer executable as constants
+        arrays = ([_tls_override(p) or p._data for _, p in cg.param_list]
+                  + [_wrap(v) for v in flat_vals] + [_wrap(key)])
         n_total = cg.n_outputs + len(cg.mutated_params)
-        outs = self._invoke_cached(cg, arrays, n_total)
+        return self._invoke_cached(cg, arrays, n_total)
+
+    def _finish_cached(self, cg: "_CachedGraph", outs):
+        from ..ops.dispatch import autograd_state
         user_outs = outs[: cg.n_outputs]
         for (pname, p), new_val in zip(cg.mutated_params, outs[cg.n_outputs :]):
             with_pause_set_data(p, new_val)
@@ -514,44 +555,42 @@ class HybridBlock(Block):
             pvals = vals[:n_params]
             key = vals[-1]
             ivals = vals[n_params:-1]
-            originals = [p._data for _, p in param_list]
-            try:
-                for (_, p), v in zip(param_list, pvals):
-                    p._data = _wrap(v)
+            # THREAD-LOCAL substitution (parameter.substitute_params): a
+            # concurrent warm invocation on another thread must never see
+            # this trace's tracers through the shared Parameter objects
+            wrapped = [_wrap(v) for v in pvals]
+            with substitute_params(
+                    zip((p for _, p in param_list), wrapped)):
                 with npx.functional_mode(key, training):
                     inputs = jax.tree_util.tree_unflatten(in_treedef, list(ivals))
                     out = Block.__call__(self, *_as_tuple(inputs))
                 out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
-                # a param whose traced value differs from its input tracer was
-                # written during forward (BatchNorm running stats et al.) —
-                # emit the new value as an extra output (functional aux state)
+                # a param whose traced wrapper was written during forward
+                # (BatchNorm running stats et al. call _set_data on it) —
+                # emit the new value as an extra output (functional aux)
                 mutated = []
-                for (pname, p), v in zip(param_list, pvals):
-                    cur = p._data
-                    newv = cur._data if isinstance(cur, ndarray) else cur
-                    if newv is not v:
-                        mutated.append((pname, newv))
+                for (pname, _p), w, v in zip(param_list, wrapped, pvals):
+                    if w._data is not v:
+                        mutated.append((pname, w._data))
                 out_info["treedef"] = out_treedef
                 out_info["n_outputs"] = len(out_leaves)
                 out_info["mutated_names"] = [pn for pn, _ in mutated]
                 return tuple(out_leaves) + tuple(mv for _, mv in mutated)
-            finally:
-                for (_, p), orig in zip(param_list, originals):
-                    p._data = orig
 
         # trace once abstractly to learn output structure, then jit
-        probe_vals = [p._data._data for _, p in param_list] + list(flat_vals) + [
+        from .parameter import _tls_override
+
+        probe_vals = [(_tls_override(p) or p._data)._data
+                      for _, p in param_list] + list(flat_vals) + [
             jax.random.PRNGKey(0)
         ]
         jax.eval_shape(pure_fn, *probe_vals)
         mutated_params = [(pn, dict(param_list)[pn]) for pn in out_info["mutated_names"]]
 
-        import numpy as _onp
+        from ..ops.dispatch import _differentiable
 
-        def _is_float(v):
-            return _onp.issubdtype(_onp.dtype(v.dtype), _onp.floating) or str(v.dtype) == "bfloat16"
-
-        diff_idx = [i for i, v in enumerate(probe_vals[:-1]) if _is_float(v)]
+        diff_idx = [i for i, v in enumerate(probe_vals[:-1])
+                    if _differentiable(v)]
 
         fwd_fn = jax.jit(pure_fn)
 
@@ -600,36 +639,35 @@ class HybridBlock(Block):
         def fn(params, *ivals, key=None):
             if key is None:
                 key = jax.random.PRNGKey(0)
-            originals = [p._data for _, p in param_list]
-            try:
-                for n, p in param_list:
-                    p._data = _wrap(params[n])
+            subst = [(n, p, _wrap(params[n])) for n, p in param_list]
+            with substitute_params((p, w) for _, p, w in subst):
                 with npx.functional_mode(key, training):
                     wrapped = tuple(
                         _wrap(v) if not isinstance(v, ndarray) else v
                         for v in ivals
                     )
                     out = Block.__call__(self, *wrapped)
-                new_params = {
-                    n: (p._data._data if isinstance(p._data, ndarray) else p._data)
-                    for n, p in param_list
-                }
+                new_params = {n: w._data for n, _p, w in subst}
                 out_j = jax.tree_util.tree_map(
                     lambda v: v._data if isinstance(v, ndarray) else v,
                     out,
                     is_leaf=lambda v: isinstance(v, ndarray),
                 )
                 return out_j, new_params
-            finally:
-                for (_, p), orig in zip(param_list, originals):
-                    p._data = orig
 
         params0 = {n: p._data._data for n, p in param_list}
         return fn, params0
 
 
 def with_pause_set_data(p: Parameter, new_val: ndarray):
-    if p._data is not None:
+    from .parameter import _tls_override
+
+    override = _tls_override(p)
+    if override is not None:
+        # inside a trace on this thread: write the traced wrapper so the
+        # mutation is detected and threaded out functionally
+        override._set_data(_unwrap(new_val))
+    elif p._data is not None:
         p._data._set_data(_unwrap(new_val))
     else:
         p.set_data(new_val)

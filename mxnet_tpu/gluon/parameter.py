@@ -21,6 +21,47 @@ from .. import initializer as init_mod
 
 __all__ = ["Parameter", "Constant", "DeferredInitializationError"]
 
+import contextlib
+import threading
+
+# thread-local parameter substitution used while tracing: maps
+# id(Parameter) -> ndarray (usually wrapping a jax tracer). Other threads
+# never observe these values.
+_tls = threading.local()
+
+
+def _tls_override(param) -> Optional[ndarray]:
+    overrides = getattr(_tls, "overrides", None)
+    if not overrides:
+        return None
+    return overrides.get(id(param))
+
+
+_MISSING = object()
+
+
+@contextlib.contextmanager
+def substitute_params(pairs):
+    """Thread-locally substitute parameter values for the duration of a
+    trace. ``pairs`` is an iterable of (Parameter, ndarray). The same
+    Parameter may appear multiple times (tied weights collected under two
+    names) — only its FIRST pre-existing state is restored on exit."""
+    overrides = getattr(_tls, "overrides", None)
+    if overrides is None:
+        overrides = _tls.overrides = {}
+    added = {}
+    for p, v in pairs:
+        added.setdefault(id(p), overrides.get(id(p), _MISSING))
+        overrides[id(p)] = v
+    try:
+        yield
+    finally:
+        for key, prev in added.items():
+            if prev is _MISSING:
+                overrides.pop(key, None)
+            else:
+                overrides[key] = prev
+
 
 class DeferredInitializationError(MXNetError):
     """Parameter accessed before its deferred shape/init completed."""
@@ -141,7 +182,7 @@ class Parameter:
 
     # -- access ------------------------------------------------------------
     def _check_initialized(self):
-        if self._data is None:
+        if self._data is None and _tls_override(self) is None:
             if self._deferred_init is not None:
                 raise DeferredInitializationError(
                     f"Parameter {self.name} deferred; run a forward pass or set shape"
@@ -151,6 +192,13 @@ class Parameter:
             )
 
     def data(self, ctx=None) -> ndarray:
+        # trace-time substitution is THREAD-LOCAL: a concurrent trace on
+        # another thread (hybridize first call, functionalize) must never
+        # leak its tracers into this thread's view of the parameter
+        # (CachedOpThreadSafe contract, cached_op_threadsafe.h:82)
+        override = _tls_override(self)
+        if override is not None:
+            return override
         self._check_initialized()
         return self._data
 

@@ -125,6 +125,25 @@ class ndarray:
         return self.transpose()
 
     @property
+    def real(self) -> "ndarray":
+        from ..ops.dispatch import apply_op
+
+        return apply_op(lambda v: v.real, [self], name="real")
+
+    @property
+    def imag(self) -> "ndarray":
+        from ..ops.dispatch import apply_op
+
+        return apply_op(lambda v: v.imag, [self], name="imag")
+
+    def conj(self) -> "ndarray":
+        from ..ops.dispatch import apply_op
+
+        return apply_op(lambda v: v.conj(), [self], name="conj")
+
+    conjugate = conj
+
+    @property
     def grad(self) -> Optional["ndarray"]:
         return self._grad
 

@@ -843,3 +843,141 @@ def shares_memory(a, b):
 
 def get_include():
     return onp.get_include()
+
+
+# ---------------------------------------------------------------------------
+# numpy parity tail: statistics, set ops, index builders, polynomials
+# (reference src/operator/numpy/ covers these via dedicated kernels; here
+# they lower through jnp/XLA like everything else)
+# ---------------------------------------------------------------------------
+def cov(m, y=None, rowvar=True, bias=False, ddof=None):
+    if y is None:
+        return _call(lambda a: jnp.cov(a, rowvar=rowvar, bias=bias,
+                                       ddof=ddof), (_c(m),), name="cov")
+    return _call(lambda a, b: jnp.cov(a, b, rowvar=rowvar, bias=bias,
+                                      ddof=ddof), (_c(m), _c(y)), name="cov")
+
+
+def corrcoef(x, y=None, rowvar=True):
+    if y is None:
+        return _call(lambda a: jnp.corrcoef(a, rowvar=rowvar), (_c(x),),
+                     name="corrcoef")
+    return _call(lambda a, b: jnp.corrcoef(a, b, rowvar=rowvar),
+                 (_c(x), _c(y)), name="corrcoef")
+
+
+def isin(element, test_elements, invert=False):
+    return _call(lambda a, b: jnp.isin(a, b, invert=invert),
+                 (_c(element), _c(test_elements)), name="isin")
+
+
+def in1d(ar1, ar2, assume_unique=False, invert=False):
+    # assume_unique accepted for numpy signature compat (no-op here)
+    return isin(_c(ar1), _c(ar2), invert=invert).reshape(-1)
+
+
+def union1d(ar1, ar2):
+    """EAGER-ONLY (data-dependent output size, like the reference's
+    dynamic-shape set kernels)."""
+    return _wrap(jnp.asarray(onp.union1d(
+        onp.asarray(_unwrap(_c(ar1))), onp.asarray(_unwrap(_c(ar2))))))
+
+
+def intersect1d(ar1, ar2, assume_unique=False, return_indices=False):
+    """EAGER-ONLY (data-dependent output size)."""
+    res = onp.intersect1d(onp.asarray(_unwrap(_c(ar1))),
+                          onp.asarray(_unwrap(_c(ar2))),
+                          assume_unique=assume_unique,
+                          return_indices=return_indices)
+    if return_indices:
+        return tuple(_wrap(jnp.asarray(r)) for r in res)
+    return _wrap(jnp.asarray(res))
+
+
+def setdiff1d(ar1, ar2, assume_unique=False):
+    """EAGER-ONLY (data-dependent output size)."""
+    return _wrap(jnp.asarray(onp.setdiff1d(
+        onp.asarray(_unwrap(_c(ar1))), onp.asarray(_unwrap(_c(ar2))),
+        assume_unique=assume_unique)))
+
+
+def select(condlist, choicelist, default=0):
+    n = len(condlist)
+
+    def fn(*vals):
+        return jnp.select(list(vals[:n]), list(vals[n:]), default)
+
+    fn.__name__ = "select"
+    return apply_op(fn, [_c(x) for x in condlist]
+                    + [_c(x) for x in choicelist], name="select")
+
+
+def piecewise(x, condlist, funclist):
+    def fn(xv, *conds):
+        return jnp.piecewise(xv, list(conds), funclist)
+
+    fn.__name__ = "piecewise"
+    return apply_op(fn, [_c(x)] + [_c(ci) for ci in condlist],
+                    name="piecewise")
+
+
+def polyval(p, x):
+    return _call(lambda pp, xx: jnp.polyval(pp, xx), (_c(p), _c(x)),
+                 name="polyval")
+
+
+def polyfit(x, y, deg):
+    return _call(lambda a, b: jnp.polyfit(a, b, deg), (_c(x), _c(y)),
+                 name="polyfit")
+
+
+def vander(x, N=None, increasing=False):
+    return _call(lambda v: jnp.vander(v, N=N, increasing=increasing),
+                 (_c(x),), name="vander")
+
+
+def row_stack(tup):
+    return vstack(tup)
+
+
+def tril_indices(n, k=0, m=None):
+    r, c = onp.tril_indices(n, k=k, m=m)
+    return _wrap(jnp.asarray(r)), _wrap(jnp.asarray(c))
+
+
+def triu_indices(n, k=0, m=None):
+    r, c = onp.triu_indices(n, k=k, m=m)
+    return _wrap(jnp.asarray(r)), _wrap(jnp.asarray(c))
+
+
+def tril_indices_from(arr, k=0):
+    return tril_indices(arr.shape[-2], k=k, m=arr.shape[-1])
+
+
+def triu_indices_from(arr, k=0):
+    return triu_indices(arr.shape[-2], k=k, m=arr.shape[-1])
+
+
+def ix_(*args):
+    return tuple(_wrap(jnp.asarray(g))
+                 for g in onp.ix_(*[onp.asarray(_unwrap(_c(a)))
+                                    for a in args]))
+
+
+def fromfunction(function, shape, dtype=float, **kwargs):
+    grids = onp.indices(shape).astype(dtype)
+    return _wrap(jnp.asarray(function(*grids, **kwargs)))
+
+
+def empty_like(prototype, dtype=None, order="K", device=None):
+    p = _c(prototype)
+    return _wrap(jnp.zeros(p.shape, dtype or p.dtype))
+
+
+def apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    return _call(
+        lambda a: jnp.apply_along_axis(func1d, axis, a, *args, **kwargs),
+        (_c(arr),), name="apply_along_axis")
+
+
+from . import fft  # noqa: E402  (needs _call, so imported last)

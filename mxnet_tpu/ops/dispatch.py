@@ -117,12 +117,14 @@ class Tape:
 
 
 def _differentiable(arr) -> bool:
-    """Only float arrays participate in grad flow (XLA vjp requirement)."""
+    """Float and complex arrays participate in grad flow (XLA vjp
+    requirement; complex supports spectral losses through np.fft)."""
     import numpy as onp
 
-    return onp.issubdtype(onp.dtype(arr.dtype), onp.floating) or str(
-        arr.dtype
-    ) == "bfloat16"
+    dt = onp.dtype(arr.dtype)
+    return (onp.issubdtype(dt, onp.floating)
+            or onp.issubdtype(dt, onp.complexfloating)
+            or str(arr.dtype) == "bfloat16")
 
 
 def apply_op(

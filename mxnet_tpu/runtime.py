@@ -36,7 +36,13 @@ class Features(dict):
             "SIGNAL_HANDLER": True,
             "PROFILER": True,
             "AMP": True,
-            "ONNX": False,
+            "ONNX": True,           # contrib.onnx export/import
+            "INT8_QUANTIZATION": True,  # contrib.quantization PTQ
+            "SYMBOLIC": True,       # mx.sym + Executor
+            "C_API": True,          # src/c_api -> libmxtpu_capi.so
+            "EXTENSION_LIBRARY": True,  # include/mxtpu_ext.h + mx.library
+            "SHARDED_CHECKPOINT": True,  # mx.checkpoint (orbax)
+            "KV_CACHE_GENERATION": True,  # model_zoo.generation
             "TENSORRT": False,
             "MKLDNN": False,
             "OPENCV": False,

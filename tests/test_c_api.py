@@ -141,3 +141,22 @@ def test_error_paths(capi):
                                  8, outs, ctypes.byref(n_out))
     assert rc == -1
     assert b"definitely_not_an_op" in capi.MXGetLastError()
+
+
+def test_c_demo_program(capi, tmp_path):
+    """Compile and run the example C frontend (example/c_api/demo.c) —
+    the other-language-binding path end to end, no Python in the client."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    exe = str(tmp_path / "demo")
+    libdir = os.path.join(ROOT, "mxnet_tpu", "_lib")
+    subprocess.run(
+        ["gcc", "-O2", os.path.join(ROOT, "example/c_api/demo.c"),
+         "-o", exe, "-L", libdir, "-lmxtpu_capi",
+         f"-Wl,-rpath,{libdir}"], check=True)
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "np.add -> [11 22 33 44 55 66]" in out.stdout
+    assert "OK" in out.stdout

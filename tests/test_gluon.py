@@ -293,3 +293,127 @@ def test_first_forward_uses_abstract_init():
     y = net(mx.np.ones((3, 7)))
     assert y.shape == (3, 2)
     assert net[0].weight.shape == (4, 7)
+
+
+def test_cached_op_thread_safe_inference():
+    """Concurrent inference through one hybridized block (the reference's
+    CachedOpThreadSafe contract, tests/cpp/thread_safety_test.cc): all
+    threads — including ones racing the first trace — get correct
+    outputs."""
+    import threading
+
+    import numpy as onp
+
+    net = nn.HybridSequential(
+        nn.Dense(32, activation="relu", in_units=16),
+        nn.Dense(8, in_units=32),
+    )
+    net.initialize()
+    net.hybridize()
+    rng = onp.random.RandomState(0)
+    xs = [rng.randn(4, 16).astype(onp.float32) for _ in range(16)]
+
+    results = [None] * len(xs)
+    errors = []
+
+    def worker(i):
+        try:
+            results[i] = net(mx.np.array(xs[i])).asnumpy()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(xs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for i, x in enumerate(xs):
+        ref = net(mx.np.array(x)).asnumpy()
+        onp.testing.assert_allclose(results[i], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_cached_op_thread_safe_across_signatures():
+    """Warm invocations racing a COLD trace of a different input shape
+    must not observe that trace's tracers through shared Parameters
+    (thread-local substitution; review-found race)."""
+    import threading
+
+    import numpy as onp
+
+    net = nn.HybridSequential(nn.Dense(16, activation="relu", in_units=8),
+                              nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    rng = onp.random.RandomState(1)
+    warm_x = rng.randn(2, 8).astype(onp.float32)
+    net(mx.np.array(warm_x))  # warm signature (2, 8)
+
+    errors = []
+    stop = threading.Event()
+
+    def warm_worker():
+        ref = net(mx.np.array(warm_x)).asnumpy()
+        while not stop.is_set():
+            try:
+                out = net(mx.np.array(warm_x)).asnumpy()
+                onp.testing.assert_allclose(out, ref, rtol=1e-5)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                return
+
+    def cold_worker():
+        try:
+            for bs in (3, 5, 7, 11, 13):  # each a fresh trace
+                net(mx.np.array(rng.randn(bs, 8).astype(onp.float32)))
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+        finally:
+            stop.set()
+
+    warms = [threading.Thread(target=warm_worker) for _ in range(4)]
+    cold = threading.Thread(target=cold_worker)
+    for t in warms:
+        t.start()
+    cold.start()
+    cold.join()
+    for t in warms:
+        t.join()
+    assert not errors, errors
+
+
+def test_substitute_params_tied_weight_no_leak():
+    """A Parameter registered under two names (weight tying) appears twice
+    in substitute_params pairs; exiting the scope must fully remove the
+    override (review-found leak)."""
+    from mxnet_tpu.gluon.parameter import (Parameter, substitute_params,
+                                           _tls_override)
+
+    p = Parameter("w", shape=(2,), dtype="float32")
+    p.initialize()
+    w1 = mx.np.ones((2,))
+    w2 = mx.np.zeros((2,))
+    with substitute_params([(p, w1), (p, w2)]):
+        assert _tls_override(p) is w2
+    assert _tls_override(p) is None  # fully restored, no stale tracer
+    # and tied-weight blocks still trace correctly end to end
+    class Tied(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(11, 4)
+
+        def forward(self, ids):
+            h = self.embed(ids)
+            return h @ self.embed.weight.data().T
+
+    net = Tied()
+    net.initialize()
+    net.hybridize()
+    import numpy as onp
+
+    ids = mx.np.array(onp.array([[1, 2]], onp.int32))
+    out1 = net(ids).asnumpy()
+    out2 = net(ids).asnumpy()  # warm path after trace exit
+    onp.testing.assert_allclose(out1, out2)
+    assert out1.shape == (1, 2, 11)

@@ -312,3 +312,86 @@ def test_assert_almost_equal_reports_location():
     b[1, 1] = 1.0
     with pytest.raises(AssertionError, match=r"\(1, 1\)"):
         tu.assert_almost_equal(a, b)
+
+
+def test_fft_namespace_oracle():
+    """np.fft vs numpy.fft (reference shipped FFT only as contrib cuFFT
+    ops; here the full namespace lowers to XLA's FFT HLO)."""
+    rng = onp.random.RandomState(0)
+    x = rng.randn(4, 16).astype(onp.float32)
+    a = mx.np.array(x)
+    onp.testing.assert_allclose(mx.np.fft.fft(a).asnumpy(),
+                                onp.fft.fft(x), rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(mx.np.fft.rfft(a).asnumpy(),
+                                onp.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(
+        mx.np.fft.irfft(mx.np.fft.rfft(a), n=16).asnumpy(), x,
+        rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(
+        mx.np.fft.fft2(a[None]).asnumpy(), onp.fft.fft2(x[None]),
+        rtol=1e-3, atol=1e-3)
+    onp.testing.assert_allclose(mx.np.fft.fftshift(a).asnumpy(),
+                                onp.fft.fftshift(x))
+    onp.testing.assert_allclose(mx.np.fft.fftfreq(16).asnumpy(),
+                                onp.fft.fftfreq(16), rtol=1e-6)
+
+
+def test_fft_is_differentiable():
+    from mxnet_tpu import autograd
+
+    x = mx.np.array(onp.random.RandomState(1).randn(8).astype(onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        # |rfft(x)|^2 summed — a real-valued spectral loss
+        spec = mx.np.fft.rfft(x)
+        loss = (spec * mx.np.conj(spec)).real.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    # Parseval: d/dx sum|X_k|^2 = 2*N*x for rfft of real input... check
+    # against numeric gradient instead of the closed form
+    eps = 1e-3
+    xv = x.asnumpy()
+    num = onp.zeros_like(xv)
+    for i in range(len(xv)):
+        xp, xm = xv.copy(), xv.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        num[i] = (onp.sum(onp.abs(onp.fft.rfft(xp)) ** 2)
+                  - onp.sum(onp.abs(onp.fft.rfft(xm)) ** 2)) / (2 * eps)
+    onp.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-2)
+
+
+def test_numpy_parity_tail_oracle():
+    rng = onp.random.RandomState(2)
+    m = rng.randn(3, 20).astype(onp.float32)
+    onp.testing.assert_allclose(mx.np.cov(mx.np.array(m)).asnumpy(),
+                                onp.cov(m), rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(mx.np.corrcoef(mx.np.array(m)).asnumpy(),
+                                onp.corrcoef(m), rtol=1e-4, atol=1e-5)
+    a = onp.array([1, 2, 3, 4, 5], onp.int32)
+    b = onp.array([2, 4, 9], onp.int32)
+    onp.testing.assert_array_equal(
+        mx.np.isin(mx.np.array(a), mx.np.array(b)).asnumpy(),
+        onp.isin(a, b))
+    onp.testing.assert_array_equal(
+        mx.np.union1d(mx.np.array(a), mx.np.array(b)).asnumpy(),
+        onp.union1d(a, b))
+    onp.testing.assert_array_equal(
+        mx.np.intersect1d(mx.np.array(a), mx.np.array(b)).asnumpy(),
+        onp.intersect1d(a, b))
+    onp.testing.assert_array_equal(
+        mx.np.setdiff1d(mx.np.array(a), mx.np.array(b)).asnumpy(),
+        onp.setdiff1d(a, b))
+    x = rng.randn(6).astype(onp.float32)
+    onp.testing.assert_allclose(
+        mx.np.vander(mx.np.array(x), 3).asnumpy(), onp.vander(x, 3),
+        rtol=1e-5)
+    r, c = mx.np.tril_indices(4, k=-1)
+    rr, cc = onp.tril_indices(4, k=-1)
+    onp.testing.assert_array_equal(r.asnumpy(), rr)
+    onp.testing.assert_array_equal(c.asnumpy(), cc)
+    sel = mx.np.select(
+        [mx.np.array(x) < 0, mx.np.array(x) >= 0],
+        [mx.np.array(x) * 0 - 1, mx.np.array(x) * 0 + 1])
+    onp.testing.assert_array_equal(sel.asnumpy(),
+                                   onp.where(x < 0, -1.0, 1.0))

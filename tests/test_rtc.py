@@ -1,0 +1,71 @@
+"""mx.rtc runtime kernel compilation (reference python/mxnet/rtc.py
+CudaModule/NVRTC; here runtime Pallas/XLA modules)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+AXPY_SRC = r"""
+def axpy_kernel(x_ref, y_ref, o_ref):
+    o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+"""
+
+
+def test_pallas_module_kernel_launch():
+    mod = mx.rtc.PallasModule(AXPY_SRC, exports=["axpy_kernel"])
+    k = mod.get_kernel("axpy_kernel", "const float *x, const float *y, float *o")
+    x = mx.np.array(onp.arange(8.0, dtype=onp.float32))
+    y = mx.np.array(onp.ones(8, onp.float32))
+    out = k.launch([x, y], out_shapes=[(8,)])
+    onp.testing.assert_allclose(out.asnumpy(), 2 * x.asnumpy() + 1)
+
+
+def test_pallas_module_with_grid():
+    src = r"""
+def scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 3.0
+"""
+    import jax.experimental.pallas as pl
+
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("scale_kernel")
+    x = mx.np.array(onp.arange(32.0, dtype=onp.float32).reshape(4, 8))
+    out = k.launch(
+        [x], out_shapes=[(4, 8)], grid=(4,),
+        in_specs=[pl.BlockSpec((1, 8), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, 8), lambda i: (i, 0)))
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy() * 3.0)
+
+
+def test_xla_module_is_differentiable():
+    src = r"""
+def gelu_ish(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+"""
+    mod = mx.rtc.XLAModule(src, exports=["gelu_ish"])
+    k = mod.get_kernel("gelu_ish")
+    x = mx.np.array(onp.linspace(-2, 2, 9).astype(onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        loss = k.launch([x], out_shapes=[(9,)]).sum()
+    loss.backward()
+    # numeric oracle
+    xv = x.asnumpy()
+    eps = 1e-3
+
+    def f(v):
+        return v / (1 + onp.exp(-1.702 * v))
+
+    num = (f(xv + eps).sum() - f(xv - eps).sum()) / (2 * eps) \
+        * onp.ones_like(xv) * 0 + (f(xv + eps) - f(xv - eps)) / (2 * eps)
+    onp.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-3, atol=1e-4)
+
+
+def test_rtc_error_paths():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.PallasModule("def broken(:\n")  # syntax error
+    mod = mx.rtc.PallasModule(AXPY_SRC, exports=["axpy_kernel"])
+    with pytest.raises(mx.MXNetError):
+        mod.get_kernel("missing_kernel")
