@@ -2,3 +2,4 @@
 (reference ``python/mxnet/contrib/``)."""
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import passes  # noqa: F401

@@ -1,0 +1,118 @@
+"""``mx.contrib.passes`` — model-level optimization passes behind
+``HybridBlock.optimize_for(backend=...)``.
+
+Parity target: the reference's subgraph/partitioning framework
+(``src/operator/subgraph/``: ``SubgraphProperty`` backends like MKLDNN
+fusion) and ``optimize_for``'s backend argument (``gluon/block.py:1095``).
+
+TPU notes: XLA already does elementwise/matmul fusion, so the passes worth
+keeping are the ones XLA cannot do — algebraic rewrites across parameter
+values. Passes registered here operate on Block trees (not on graph IR:
+XLA owns the IR); ``register_pass`` is the extension seam the reference
+exposed through ``SubgraphProperty``/lib_api custom passes.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["register_pass", "apply_pass", "list_passes", "fold_batch_norm"]
+
+_PASSES: Dict[str, Callable] = {}
+
+
+def register_pass(name: str, fn: Callable) -> None:
+    """Register a model pass: ``fn(block) -> block`` (may mutate)."""
+    _PASSES[name.lower()] = fn
+
+
+def apply_pass(block, name: str):
+    fn = _PASSES.get(name.lower())
+    if fn is None:
+        raise MXNetError(
+            f"unknown optimize_for backend {name!r}; registered: "
+            f"{sorted(_PASSES)}")
+    return fn(block)
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+# ---------------------------------------------------------------------------
+# conv/dense + batchnorm folding (the classic inference rewrite the
+# reference's MKLDNN subgraph property performed as graph fusion)
+# ---------------------------------------------------------------------------
+def _fold_pair(layer, bn) -> bool:
+    """Fold BatchNorm's affine transform into the preceding layer's
+    weight/bias. Valid when the layer has no activation of its own (the
+    activation would otherwise sit between the matmul and the BN)."""
+    from ..gluon import nn
+
+    if getattr(layer, "act", None) is not None:
+        return False
+    if bn._axis != 1:
+        # every foldable layer here is channels-first (conv NC*/dense
+        # (B, units)): a BN on any other axis is not a per-output-channel
+        # affine and cannot fold into the weights
+        return False
+    w = layer.weight
+    if w._data is None or bn.gamma._data is None:
+        return False  # uninitialized/deferred — nothing to fold yet
+    gamma = bn.gamma.data().asnumpy()
+    beta = bn.beta.data().asnumpy()
+    mean = bn.running_mean.data().asnumpy()
+    var = bn.running_var.data().asnumpy()
+    scale = gamma / onp.sqrt(var + bn._epsilon)
+
+    wv = w.data().asnumpy()
+    # conv: (O, I, ...) scale per output channel; dense: (units, in)
+    shape = (-1,) + (1,) * (wv.ndim - 1)
+    w.set_data(wv * scale.reshape(shape))
+    if layer.bias is not None:
+        bv = layer.bias.data().asnumpy()
+        layer.bias.set_data((bv - mean) * scale + beta)
+    else:
+        # layer had no bias: BN's shift needs one — graft it on
+        from ..gluon.parameter import Parameter
+
+        bias = Parameter("bias", shape=(wv.shape[0],), dtype=str(wv.dtype))
+        bias.set_data((0.0 - mean) * scale + beta)
+        layer.bias = bias  # __setattr__ registers it in _reg_params
+    return True
+
+
+def fold_batch_norm(block):
+    """Fold Conv/Dense + BatchNorm pairs inside HybridSequential chains:
+    BN becomes Identity, its affine transform moves into the weights.
+    Uses running statistics — an INFERENCE-ONLY rewrite. Returns the
+    (mutated) block; unfoldable pairs are left untouched."""
+    from ..gluon import nn
+
+    def walk(b):
+        children = list(b._children.items())
+        if isinstance(b, (nn.HybridSequential, nn.Sequential)):
+            for (_, cur), (cname, nxt) in zip(children, children[1:]):
+                if (isinstance(cur, (nn.Conv2D, nn.Conv1D, nn.Conv3D,
+                                     nn.Dense))
+                        and isinstance(nxt, nn.BatchNorm)):
+                    if _fold_pair(cur, nxt):
+                        ident = nn.Identity()
+                        b._children[cname] = ident
+                        setattr(b, cname, ident)
+        for _, child in b._children.items():
+            walk(child)
+        return b
+
+    out = walk(block)
+    # folded weights invalidate any cached executables
+    if hasattr(block, "_cached_graphs"):
+        block._cached_graphs.clear()
+    return out
+
+
+register_pass("fold_bn", fold_batch_norm)
+register_pass("default", lambda b: b)

@@ -318,6 +318,16 @@ class HybridBlock(Block):
         return jax.tree_util.tree_map(lambda s: s.shape, out)
 
     def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        """Apply a registered model pass then hybridize (reference
+        block.py:1095 optimize_for(backend=...), whose backends were
+        SubgraphProperty partitioners; here passes live in
+        mx.contrib.passes — e.g. backend="fold_bn")."""
+        if backend is not None:
+            from ..contrib.passes import apply_pass
+
+            # passes may need initialized params: run one forward first
+            self._ensure_params_ready((x,) + args)
+            apply_pass(self, backend)
         self.hybridize(True, **kwargs)
         return self(x, *args)
 
@@ -464,7 +474,11 @@ class HybridBlock(Block):
         # override-aware param read: invoked inside ANOTHER block's trace,
         # params must flow in as that trace's tracers, not be baked into
         # the outer executable as constants
-        arrays = ([_tls_override(p) or p._data for _, p in cg.param_list]
+        def pval(p):
+            ov = _tls_override(p)
+            return p._data if ov is None else ov  # NOT `or`: ndarray bool
+
+        arrays = ([pval(p) for _, p in cg.param_list]
                   + [_wrap(v) for v in flat_vals] + [_wrap(key)])
         n_total = cg.n_outputs + len(cg.mutated_params)
         return self._invoke_cached(cg, arrays, n_total)
@@ -580,8 +594,11 @@ class HybridBlock(Block):
         # trace once abstractly to learn output structure, then jit
         from .parameter import _tls_override
 
-        probe_vals = [(_tls_override(p) or p._data)._data
-                      for _, p in param_list] + list(flat_vals) + [
+        def _pdata(p):
+            ov = _tls_override(p)
+            return (p._data if ov is None else ov)._data
+
+        probe_vals = [_pdata(p) for _, p in param_list] + list(flat_vals) + [
             jax.random.PRNGKey(0)
         ]
         jax.eval_shape(pure_fn, *probe_vals)

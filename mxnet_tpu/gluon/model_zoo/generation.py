@@ -5,8 +5,8 @@ eager per-step graphs). TPU-first design: prefill and decode are each ONE
 compiled XLA program — the decode step runs under ``lax.scan`` with a
 preallocated (L, B, H, Lmax, D) cache updated by ``dynamic_update_slice``,
 so generating N tokens costs one compile + one device program, not N
-dispatches. Sampling (greedy / temperature / top-k) happens on device
-inside the scan.
+dispatches. Sampling (greedy / temperature / top-k) and beam reordering
+happen on device inside the scan.
 """
 from __future__ import annotations
 
@@ -20,7 +20,7 @@ from ...base import MXNetError
 from ...ndarray.ndarray import ndarray, _unwrap, _wrap
 from ..block import HybridBlock
 
-__all__ = ["generate"]
+__all__ = ["generate", "beam_search"]
 
 
 class _StepAdapter(HybridBlock):
@@ -46,18 +46,13 @@ def _sample(logits, key, greedy, temperature, top_k):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-def generate(model, prompt_ids, max_new_tokens: int,
-             max_length: Optional[int] = None, greedy: bool = True,
-             temperature: float = 1.0, top_k: int = 0, eos_token: int = -1,
-             seed: int = 0):
-    """Generate ``max_new_tokens`` continuations of ``prompt_ids`` (B, P).
-
-    ``model`` must provide ``decode_step``/``init_cache`` (the causal LM
-    contract, :class:`~mxnet_tpu.gluon.model_zoo.bert._CausalLM`). Returns
-    an (B, max_new_tokens) int32 ndarray. ``eos_token``: once every
-    sequence has emitted it, remaining positions repeat it (the scan still
-    runs to length — static shapes — but the output is clean).
-    """
+def _prep(model, prompt_ids, max_new_tokens, max_length):
+    """Shared decode setup: wrap the prompt, validate lengths against the
+    model's context window (jax dynamic_slice CLAMPS out-of-range starts,
+    so decoding past the position table would silently reuse the last
+    embedding — must be an error), allocate model-dtype caches, and
+    functionalize one shape-generic step fn (it serves both the (B, P)
+    prefill and every (B, 1) decode step)."""
     from ... import numpy as mxnp
 
     prompt = prompt_ids if isinstance(prompt_ids, ndarray) \
@@ -70,20 +65,32 @@ def generate(model, prompt_ids, max_new_tokens: int,
             f"{max_new_tokens}")
     pos_table = getattr(model, "pos_embed", None)
     if pos_table is not None and lmax > pos_table.shape[0]:
-        # jax dynamic_slice CLAMPS out-of-range starts — decoding past the
-        # position table would silently reuse the last embedding
         raise MXNetError(
             f"generation length {lmax} exceeds the model's context window "
             f"(max_length={pos_table.shape[0]})")
     cache_dtype = onp.dtype(model.word_embed.weight.dtype).name \
         if hasattr(model, "word_embed") else "float32"
     ck, cv = model.init_cache(b, lmax, dtype=cache_dtype)
-
     adapter = _StepAdapter(model)
     pos0 = mxnp.array(onp.zeros((), onp.int32))
-    # functionalize is shape-generic: the SAME pure fn serves the (B, P)
-    # prefill and every (B, 1) decode step (two jit specializations)
     step_fn, params = adapter.functionalize(prompt, ck, cv, pos0)
+    return prompt, b, p, ck, cv, step_fn, params
+
+
+def generate(model, prompt_ids, max_new_tokens: int,
+             max_length: Optional[int] = None, greedy: bool = True,
+             temperature: float = 1.0, top_k: int = 0, eos_token: int = -1,
+             seed: int = 0):
+    """Generate ``max_new_tokens`` continuations of ``prompt_ids`` (B, P).
+
+    ``model`` must provide ``decode_step``/``init_cache`` (the causal LM
+    contract, :class:`~mxnet_tpu.gluon.model_zoo.bert._CausalLM`). Returns
+    an (B, max_new_tokens) int32 ndarray. ``eos_token``: once a sequence
+    has emitted it, remaining positions repeat it (the scan still runs to
+    length — static shapes — but the output is clean).
+    """
+    prompt, b, p, ck, cv, step_fn, params = _prep(
+        model, prompt_ids, max_new_tokens, max_length)
 
     def run(params, prompt_v, ck_v, cv_v, key):
         (logits, ck_v, cv_v), _ = step_fn(
@@ -113,3 +120,95 @@ def generate(model, prompt_ids, max_new_tokens: int,
     out = jax.jit(run)(params, _unwrap(prompt), _unwrap(ck), _unwrap(cv),
                        jax.random.PRNGKey(seed))
     return _wrap(out)
+
+
+def beam_search(model, prompt_ids, max_new_tokens: int, beam_size: int = 4,
+                max_length: Optional[int] = None, alpha: float = 1.0,
+                eos_token: int = -1):
+    """Beam-search decoding (the gluonnlp-era capability, re-built
+    TPU-first): ONE ``lax.scan`` whose carry holds the (L, B*K, H, Lmax, D)
+    KV caches; beam reordering is a batched gather on the cache's beam
+    axis inside the compiled program — no host round trips.
+
+    Returns ``(sequences, scores)``: (B, K, max_new_tokens) int32 ordered
+    best-first, and (B, K) length-normalized log-probs
+    (``score = logp / len**alpha``; ``alpha=0`` gives raw joint log-prob).
+    """
+    k = beam_size
+    # caches allocated at batch B: prefill runs un-tiled, the K-fold tile
+    # happens on device from the prefill result (no B*K zero buffers ever
+    # cross host->device)
+    prompt, b, p, ck, cv, step_fn, params = _prep(
+        model, prompt_ids, max_new_tokens, max_length)
+
+    neg_inf = -1e9
+
+    def run(params, prompt_v, ck_v, cv_v):
+        (logits, ck_s, cv_s), _ = step_fn(
+            params, prompt_v, ck_v, cv_v, jnp.zeros((), jnp.int32))
+        logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+        vocab = logp0.shape[-1]
+        scores, first = jax.lax.top_k(logp0, k)          # (B, K)
+        first = first.astype(jnp.int32)
+
+        def tile(c):  # (L, B, ...) -> (L, B*K, ...)
+            reps = (1, 1, k) + (1,) * (c.ndim - 2)
+            return jnp.tile(c[:, :, None], reps).reshape(
+                c.shape[0], b * k, *c.shape[2:])
+
+        ck_t, cv_t = tile(ck_s), tile(cv_s)
+        done = first == eos_token
+        seqs = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+        seqs = seqs.at[:, :, 0].set(first)
+        lengths = jnp.ones((b, k), jnp.int32)
+
+        def body(carry, step):
+            tok, ck_c, cv_c, pos, scores_c, done_c, seqs_c, len_c = carry
+            (lg, ck_c, cv_c), _ = step_fn(
+                params, tok.reshape(b * k, 1), ck_c, cv_c, pos)
+            logp = jax.nn.log_softmax(
+                lg[:, -1].astype(jnp.float32)).reshape(b, k, vocab)
+            # finished beams: force eos continuation at zero added cost,
+            # everything else -inf so they never fork
+            eos_ix = jnp.clip(eos_token, 0, vocab - 1)
+            frozen = jnp.full((vocab,), neg_inf).at[eos_ix].set(0.0)
+            logp = jnp.where(done_c[:, :, None], frozen[None, None], logp)
+            total = scores_c[:, :, None] + logp          # (B, K, V)
+            flat = total.reshape(b, k * vocab)
+            new_scores, idx = jax.lax.top_k(flat, k)     # (B, K)
+            parent = (idx // vocab).astype(jnp.int32)    # which beam
+            new_tok = (idx % vocab).astype(jnp.int32)
+
+            def reorder_cache(c):
+                cs = c.reshape(c.shape[0], b, k, *c.shape[2:])
+                cs = jnp.take_along_axis(
+                    cs, parent[None, :, :, None, None, None], axis=2)
+                return cs.reshape(c.shape[0], b * k, *c.shape[2:])
+
+            ck_c = reorder_cache(ck_c)
+            cv_c = reorder_cache(cv_c)
+            done_c = jnp.take_along_axis(done_c, parent, axis=1)
+            len_c = jnp.take_along_axis(len_c, parent, axis=1)
+            seqs_c = jnp.take_along_axis(seqs_c, parent[:, :, None], axis=1)
+            seqs_c = seqs_c.at[:, :, step].set(
+                jnp.where(done_c, eos_token, new_tok))
+            len_c = len_c + (~done_c).astype(jnp.int32)
+            done_c = done_c | (new_tok == eos_token)
+            return (new_tok, ck_c, cv_c, pos + 1, new_scores, done_c,
+                    seqs_c, len_c), None
+
+        carry = (first, ck_t, cv_t, jnp.asarray(p, jnp.int32), scores,
+                 done, seqs, lengths)
+        if max_new_tokens > 1:
+            carry, _ = jax.lax.scan(
+                body, carry, jnp.arange(1, max_new_tokens))
+        _, _, _, _, scores_f, _, seqs_f, len_f = carry
+        norm = jnp.power(len_f.astype(jnp.float32), alpha)
+        final = scores_f / jnp.maximum(norm, 1.0)
+        order = jnp.argsort(-final, axis=1)
+        return (jnp.take_along_axis(seqs_f, order[:, :, None], axis=1),
+                jnp.take_along_axis(final, order, axis=1))
+
+    seqs, scores = jax.jit(run)(params, _unwrap(prompt), _unwrap(ck),
+                                _unwrap(cv))
+    return _wrap(seqs), _wrap(scores)

@@ -91,9 +91,9 @@ class Kernel:
         else:
             fn = self._fn
 
-        outs = apply_op(fn, list(args), n_out=n_out,
+        # apply_op returns one ndarray for n_out == 1, a tuple otherwise
+        return apply_op(fn, list(args), n_out=n_out,
                         name=f"rtc.{self._name}")
-        return outs if n_out > 1 else outs
 
 
 class PallasModule:

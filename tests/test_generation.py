@@ -82,3 +82,72 @@ def test_max_length_validation():
     with pytest.raises(mx.MXNetError):
         generate(net, onp.zeros((1, 4), onp.int32), max_new_tokens=10,
                  max_length=8)
+
+
+@pytest.mark.seed(14)
+def test_beam_size_one_equals_greedy():
+    from mxnet_tpu.gluon.model_zoo.generation import beam_search
+
+    net = _tiny_lm(seed=4)
+    prompt = onp.array([[2, 7, 1]], onp.int32)
+    greedy = generate(net, prompt, max_new_tokens=5, greedy=True).asnumpy()
+    seqs, scores = beam_search(net, prompt, max_new_tokens=5, beam_size=1,
+                               alpha=0.0)
+    onp.testing.assert_array_equal(seqs.asnumpy()[:, 0], greedy)
+    assert scores.shape == (1, 1)
+
+
+@pytest.mark.seed(15)
+def test_beam_search_beats_or_matches_greedy_joint_logprob():
+    """With alpha=0 the best beam's raw joint log-prob must be >= the
+    greedy sequence's — the defining property of beam search."""
+    from mxnet_tpu.gluon.model_zoo.generation import beam_search
+
+    net = _tiny_lm(seed=5)
+    prompt = onp.array([[3, 1, 4]], onp.int32)
+    n_new = 6
+
+    def joint_logp(continuation):
+        ids = onp.concatenate([prompt, continuation[None]], axis=1)
+        logits = net(mx.np.array(ids)).asnumpy().astype(onp.float64)
+        logp = logits - onp.log(onp.exp(
+            logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+            - logits.max(-1, keepdims=True)
+        total = 0.0
+        for t in range(n_new):
+            total += logp[0, prompt.shape[1] - 1 + t, continuation[t]]
+        return total
+
+    greedy = generate(net, prompt, max_new_tokens=n_new,
+                      greedy=True).asnumpy()[0]
+    seqs, scores = beam_search(net, prompt, max_new_tokens=n_new,
+                               beam_size=4, alpha=0.0)
+    best = seqs.asnumpy()[0, 0]
+    assert joint_logp(best) >= joint_logp(greedy) - 1e-4
+    # reported score matches an independent full-forward rescore
+    onp.testing.assert_allclose(float(scores.asnumpy()[0, 0]),
+                                joint_logp(best), rtol=1e-3, atol=1e-3)
+    # beams come back best-first
+    s = scores.asnumpy()[0]
+    assert all(s[i] >= s[i + 1] - 1e-6 for i in range(len(s) - 1))
+
+
+@pytest.mark.seed(16)
+def test_beam_search_batched_and_eos():
+    from mxnet_tpu.gluon.model_zoo.generation import beam_search
+
+    net = _tiny_lm(seed=6)
+    prompt = onp.array([[1, 2], [5, 6]], onp.int32)
+    seqs, scores = beam_search(net, prompt, max_new_tokens=4, beam_size=3)
+    assert seqs.shape == (2, 3, 4)
+    assert scores.shape == (2, 3)
+    assert ((0 <= seqs.asnumpy()) & (seqs.asnumpy() < 37)).all()
+    # eos freezing: force the first greedy token as eos for batch row 0
+    first = generate(net, prompt[:1], max_new_tokens=1).asnumpy()
+    eos = int(first[0, 0])
+    # alpha=0 (raw joint logp): the eos-frozen beam keeps the single best
+    # first-token score, so it must rank first; live beams only add
+    # negative logps. (With alpha=1 length-averaging may outrank it.)
+    seqs2, _ = beam_search(net, prompt[:1], max_new_tokens=4, beam_size=2,
+                           eos_token=eos, alpha=0.0)
+    assert (seqs2.asnumpy()[0, 0] == eos).all()

@@ -1,0 +1,103 @@
+"""Model optimization passes (mx.contrib.passes; reference subgraph
+SubgraphProperty backends + optimize_for(backend=...))."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.contrib import passes
+from mxnet_tpu.gluon import nn
+
+
+def _trained_conv_bn():
+    net = nn.HybridSequential(
+        nn.Conv2D(8, 3, padding=1, in_channels=3, use_bias=False),
+        nn.BatchNorm(in_channels=8),
+        nn.Activation("relu"),
+        nn.Conv2D(4, 3, in_channels=8),  # has bias
+        nn.BatchNorm(in_channels=4),
+        nn.Flatten(),
+        nn.Dense(5, in_units=4 * 6 * 6),
+    )
+    net.initialize()
+    # a few training steps so BN running stats are non-trivial
+    rng = onp.random.RandomState(0)
+    for _ in range(3):
+        with autograd.record():
+            out = net(mx.np.array(rng.randn(4, 3, 8, 8).astype(onp.float32)))
+            loss = out.sum()
+        loss.backward()
+    return net
+
+
+def test_fold_bn_preserves_inference_outputs():
+    net = _trained_conv_bn()
+    x = mx.np.array(onp.random.RandomState(1).randn(2, 3, 8, 8)
+                    .astype(onp.float32))
+    ref = net(x).asnumpy()
+    passes.fold_batch_norm(net)
+    # BNs replaced by Identity
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert "BatchNorm" not in kinds
+    assert kinds.count("Identity") == 2
+    got = net(x).asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # the bias grafted onto the use_bias=False conv carries BN's shift
+    assert list(net._children.values())[0].bias is not None
+
+
+def test_fold_bn_skips_conv_with_fused_activation():
+    net = nn.HybridSequential(
+        nn.Conv2D(4, 3, in_channels=2, activation="relu"),  # act before BN
+        nn.BatchNorm(in_channels=4),
+    )
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(2).randn(1, 2, 6, 6)
+                    .astype(onp.float32))
+    ref = net(x).asnumpy()
+    passes.fold_batch_norm(net)
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert "BatchNorm" in kinds  # not folded: fold would be wrong math
+    onp.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_optimize_for_backend():
+    net = _trained_conv_bn()
+    x = mx.np.array(onp.random.RandomState(3).randn(2, 3, 8, 8)
+                    .astype(onp.float32))
+    ref = net(x).asnumpy()
+    out = net.optimize_for(x, backend="fold_bn")
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+    assert net._active  # hybridized after the pass
+    with pytest.raises(mx.MXNetError):
+        net.optimize_for(x, backend="no_such_backend")
+    assert "fold_bn" in passes.list_passes()
+
+
+def test_fold_bn_in_nested_sequential():
+    inner = nn.HybridSequential(nn.Dense(6, in_units=4, use_bias=True),
+                                nn.BatchNorm(in_channels=6))
+    net = nn.HybridSequential(inner, nn.Dense(3, in_units=6))
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(4).randn(2, 4).astype(onp.float32))
+    with autograd.record():
+        net(x).sum().backward()
+    ref = net(x).asnumpy()
+    passes.fold_batch_norm(net)
+    inner_kinds = [type(c).__name__ for c in inner._children.values()]
+    assert "BatchNorm" not in inner_kinds
+    onp.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_register_custom_pass():
+    calls = []
+
+    def my_pass(block):
+        calls.append(type(block).__name__)
+        return block
+
+    passes.register_pass("my_test_pass", my_pass)
+    net = nn.HybridSequential(nn.Dense(2, in_units=2))
+    net.initialize()
+    net.optimize_for(mx.np.ones((1, 2)), backend="my_test_pass")
+    assert calls == ["HybridSequential"]
