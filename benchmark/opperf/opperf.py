@@ -21,10 +21,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as onp
+
+# runnable from any cwd: the repo root holds mxnet_tpu/
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
 
 
 def _op_specs():
@@ -62,6 +67,30 @@ def _op_specs():
     add("argsort", lambda jnp, a: jnp.argsort(a, axis=-1), [(1024, 256)],
         diff=False)
     add("cumsum", lambda jnp, a: jnp.cumsum(a, axis=-1), [L])
+    add("rfft", lambda jnp, a: jnp.fft.rfft(a, axis=-1), [L], diff=False)
+    add("roi_align",
+        lambda jnp, d, r: __import__(
+            "mxnet_tpu.ops.contrib", fromlist=["roi_align"]).roi_align(
+                d, r, (7, 7), spatial_scale=1.0 / 16),
+        [(4, 256, 56, 56), (64, 5)], diff=False)
+    add("box_iou",
+        lambda jnp, a, b: __import__(
+            "mxnet_tpu.ops.contrib", fromlist=["box_iou"]).box_iou(
+                jnp.abs(a), jnp.abs(b)),
+        [(1024, 4), (1024, 4)], diff=False)
+    add("count_sketch",
+        lambda jnp, d: __import__(
+            "mxnet_tpu.ops.contrib", fromlist=["count_sketch"]).count_sketch(
+                d, onp.arange(1024) % 256,
+                onp.where(onp.arange(1024) % 2 == 0, 1.0, -1.0)
+                .astype(onp.float32), 256),
+        [(512, 1024)], diff=False)
+    add("flash_attention",
+        lambda jnp, q, k, v: __import__(
+            "mxnet_tpu.ops.pallas.flash_attention",
+            fromlist=["flash_attention"]).flash_attention(
+                q, k, v, causal=True),
+        [(4, 8, 512, 64), (4, 8, 512, 64), (4, 8, 512, 64)], diff=False)
     return specs
 
 

@@ -106,3 +106,37 @@ def test_cnn_digits_loss_collapses():
         if last < first / 10:
             break
     assert last < first / 10, f"loss {first:.3f} -> {last:.3f}: no collapse"
+
+
+@pytest.mark.integration
+@pytest.mark.seed(7)
+def test_resnet18_cifar_loss_decreases():
+    """CIFAR-shaped ResNet-18 training: loss must fall monotonically-ish
+    over a short run (reference tests/python/train parity for conv nets;
+    the PR5/BASELINE config's model family)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = onp.random.RandomState(0)
+    # small fixed synthetic set so the net can overfit measurably
+    X = rng.uniform(0, 1, (64, 3, 32, 32)).astype(onp.float32)
+    y = rng.randint(0, 10, 64).astype(onp.float32)
+
+    losses = []
+    for _ in range(12):
+        total = 0.0
+        for i in range(0, 64, 16):
+            xb = mx.np.array(X[i:i + 16])
+            yb = mx.np.array(y[i:i + 16])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(16)
+            total += float(loss)
+        losses.append(total / 4)
+    assert losses[-1] < losses[0] / 2, f"loss curve {losses}"
