@@ -981,3 +981,305 @@ def apply_along_axis(func1d, axis, arr, *args, **kwargs):
 
 
 from . import fft  # noqa: E402  (needs _call, so imported last)
+
+
+# ---------------------------------------------------------------------------
+# numpy parity: generated delegations (aliases, windows, nan-reductions,
+# polynomials, dtype taxonomy, printing). Differentiable ops go through
+# _call (tape-recorded); meta/dtype utilities pass straight to numpy.
+# ---------------------------------------------------------------------------
+_SIMPLE_UNARY_TAIL = [
+    "sinc", "i0", "unwrap", "diagflat", "argwhere", "iscomplex", "isreal",
+    "nancumprod", "nancumsum", "nanmedian", "nanstd", "nanvar",
+    "sort_complex", "matrix_transpose", "spacing",
+]
+for _n in _SIMPLE_UNARY_TAIL:
+    def _mk_tail(name):
+        jfn = getattr(jnp, name)
+
+        def op(a, *args, **kwargs):
+            return _call(lambda x: jfn(x, *args, **kwargs), (_c(a),),
+                         name=name)
+
+        op.__name__ = name
+        return op
+    globals()[_n] = _mk_tail(_n)
+
+# trig aliases (array-api names)
+acos, acosh, asin = globals()["arccos"], globals()["arccosh"], globals()["arcsin"]
+asinh, atan, atanh = globals()["arcsinh"], globals()["arctan"], globals()["arctanh"]
+atan2 = globals()["arctan2"] if "arctan2" in globals() else None
+bitwise_invert = globals()["invert"]
+
+
+def vecdot(x1, x2, axis=-1):
+    return _call(lambda a, b: jnp.vecdot(a, b, axis=axis), (_c(x1), _c(x2)),
+                 name="vecdot")
+
+
+def correlate(a, v, mode="valid"):
+    return _call(lambda x, y: jnp.correlate(x, y, mode=mode),
+                 (_c(a), _c(v)), name="correlate")
+
+
+def nanpercentile(a, q, axis=None, keepdims=False):
+    return _call(lambda x: jnp.nanpercentile(x, q, axis=axis,
+                                             keepdims=keepdims), (_c(a),),
+                 name="nanpercentile")
+
+
+def nanquantile(a, q, axis=None, keepdims=False):
+    return _call(lambda x: jnp.nanquantile(x, q, axis=axis,
+                                           keepdims=keepdims), (_c(a),),
+                 name="nanquantile")
+
+
+def trapezoid(y, x=None, dx=1.0, axis=-1):
+    if x is None:
+        return _call(lambda v: jnp.trapezoid(v, dx=dx, axis=axis), (_c(y),),
+                     name="trapezoid")
+    return _call(lambda v, xv: jnp.trapezoid(v, xv, axis=axis),
+                 (_c(y), _c(x)), name="trapezoid")
+
+
+trapz = trapezoid
+
+
+def divmod(x1, x2):  # noqa: A001
+    return _call(lambda a, b: jnp.divmod(a, b), (_c(x1), _c(x2)),
+                 name="divmod", n_out=2)
+
+
+def modf(x):
+    return _call(lambda a: jnp.modf(a), (_c(x),), name="modf", n_out=2)
+
+
+def frexp(x):
+    return _call(lambda a: jnp.frexp(a), (_c(x),), name="frexp", n_out=2)
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None):
+    return _create(jnp.geomspace(start, stop, num, endpoint=endpoint,
+                                 dtype=dtype and dtype_from_any(dtype)), ctx)
+
+
+# window functions
+for _n in ("bartlett", "blackman", "hamming", "hanning", "kaiser"):
+    def _mk_window(name):
+        jfn = getattr(jnp, name)
+
+        def op(*args):
+            return _wrap(jfn(*args))
+
+        op.__name__ = name
+        return op
+    globals()[_n] = _mk_window(_n)
+
+
+# polynomial family (differentiable where coefficient arrays flow through)
+def polyadd(a1, a2):
+    return _call(lambda a, b: jnp.polyadd(a, b), (_c(a1), _c(a2)),
+                 name="polyadd")
+
+
+def polysub(a1, a2):
+    return _call(lambda a, b: jnp.polysub(a, b), (_c(a1), _c(a2)),
+                 name="polysub")
+
+
+def polymul(a1, a2):
+    return _call(lambda a, b: jnp.polymul(a, b), (_c(a1), _c(a2)),
+                 name="polymul")
+
+
+def polyder(p, m=1):
+    return _call(lambda a: jnp.polyder(a, m), (_c(p),), name="polyder")
+
+
+def polyint(p, m=1, k=None):
+    return _call(lambda a: jnp.polyint(a, m, k), (_c(p),), name="polyint")
+
+
+def polydiv(u, v):
+    return _call(lambda a, b: jnp.polydiv(a, b), (_c(u), _c(v)),
+                 name="polydiv", n_out=2)
+
+
+def poly(seq_of_zeros):
+    return _call(lambda a: jnp.poly(a), (_c(seq_of_zeros),), name="poly")
+
+
+def roots(p):
+    """EAGER-ONLY (eigenvalue solve on host for strip_zeros)."""
+    return _wrap(jnp.roots(_unwrap(_c(p)), strip_zeros=False))
+
+
+def block(arrays):
+    def conv(a):
+        if isinstance(a, list):
+            return [conv(x) for x in a]
+        return _unwrap(_c(a))
+
+    return _wrap(jnp.block(conv(arrays)))
+
+
+def choose(a, choices, mode="clip"):
+    seq = [_unwrap(_c(c)) for c in choices]
+    return _call(lambda idx: jnp.choose(idx, seq, mode=mode), (_c(a),),
+                 name="choose")
+
+
+def fill_diagonal(a, val, wrap=False):
+    return _call(lambda x: jnp.fill_diagonal(x, val, wrap=wrap,
+                                             inplace=False), (_c(a),),
+                 name="fill_diagonal")
+
+
+def setxor1d(ar1, ar2, assume_unique=False):
+    """EAGER-ONLY (data-dependent output size)."""
+    return _wrap(jnp.asarray(onp.setxor1d(
+        onp.asarray(_unwrap(_c(ar1))), onp.asarray(_unwrap(_c(ar2))),
+        assume_unique=assume_unique)))
+
+
+def histogram2d(x, y, bins=10, range=None, weights=None, density=None):
+    h, ex, ey = jnp.histogram2d(_unwrap(_c(x)), _unwrap(_c(y)), bins=bins,
+                                range=range, density=density,
+                                weights=None if weights is None
+                                else _unwrap(_c(weights)))
+    return _wrap(h), _wrap(ex), _wrap(ey)
+
+
+def histogram_bin_edges(a, bins=10, range=None, weights=None):
+    return _wrap(jnp.histogram_bin_edges(_unwrap(_c(a)), bins=bins,
+                                         range=range))
+
+
+def diag_indices(n, ndim=2):
+    return tuple(_wrap(g) for g in jnp.diag_indices(n, ndim))
+
+
+def diag_indices_from(arr):
+    return diag_indices(arr.shape[0], arr.ndim)
+
+
+def mask_indices(n, mask_func, k=0):
+    r, c = onp.mask_indices(n, mask_func, k)
+    return _wrap(jnp.asarray(r)), _wrap(jnp.asarray(c))
+
+
+def unique_values(x):
+    """EAGER-ONLY (data-dependent output size)."""
+    return _wrap(jnp.asarray(onp.unique(onp.asarray(_unwrap(_c(x))))))
+
+
+def unique_counts(x):
+    v, c = onp.unique(onp.asarray(_unwrap(_c(x))), return_counts=True)
+    return _wrap(jnp.asarray(v)), _wrap(jnp.asarray(c))
+
+
+def unique_inverse(x):
+    v, i = onp.unique(onp.asarray(_unwrap(_c(x))), return_inverse=True)
+    return _wrap(jnp.asarray(v)), _wrap(jnp.asarray(i))
+
+
+def unique_all(x):
+    v, idx, inv, cnt = onp.unique(onp.asarray(_unwrap(_c(x))),
+                                  return_index=True, return_inverse=True,
+                                  return_counts=True)
+    return tuple(_wrap(jnp.asarray(t)) for t in (v, idx, inv, cnt))
+
+
+def broadcast_shapes(*shapes):
+    return onp.broadcast_shapes(*shapes)
+
+
+def einsum_path(*operands, optimize="greedy"):
+    ops = [_unwrap(_c(o)) if not isinstance(o, str) else o for o in operands]
+    return jnp.einsum_path(*ops, optimize=optimize)
+
+
+def vectorize(pyfunc, excluded=None, signature=None):
+    vf = jnp.vectorize(pyfunc, excluded=excluded or frozenset(),
+                       signature=signature)
+
+    def wrapped(*args):
+        return _call(lambda *vals: vf(*vals),
+                     tuple(_c(a) for a in args), name="vectorize")
+
+    return wrapped
+
+
+# dtype taxonomy / inspection — straight numpy re-exports
+finfo = onp.finfo
+iinfo = onp.iinfo
+issubdtype = onp.issubdtype
+isdtype = jnp.isdtype
+iterable = onp.iterable
+complex64 = onp.complex64
+complex128 = onp.complex128
+csingle = onp.csingle
+cdouble = onp.cdouble
+single = onp.float32
+double = onp.float64
+int_ = onp.int64
+uint = onp.uint64
+floating = onp.floating
+integer = onp.integer
+signedinteger = onp.signedinteger
+unsignedinteger = onp.unsignedinteger
+inexact = onp.inexact
+complexfloating = onp.complexfloating
+number = onp.number
+generic = onp.generic
+character = onp.character
+flexible = onp.flexible
+object_ = onp.object_
+ufunc = onp.ufunc
+
+# printing / repr passthroughs
+set_printoptions = onp.set_printoptions
+get_printoptions = onp.get_printoptions
+printoptions = onp.printoptions
+
+
+def array_repr(arr, *args, **kwargs):
+    return onp.array_repr(onp.asarray(_unwrap(_c(arr))), *args, **kwargs)
+
+
+def array_str(arr, *args, **kwargs):
+    return onp.array_str(onp.asarray(_unwrap(_c(arr))), *args, **kwargs)
+
+
+# host IO (onp-backed; mx-level durable formats live in mx.serialization)
+def save(file, arr):
+    onp.save(file, onp.asarray(_unwrap(_c(arr))))
+
+
+def savez(file, *args, **kwargs):
+    onp.savez(file,
+              *[onp.asarray(_unwrap(_c(a))) for a in args],
+              **{k: onp.asarray(_unwrap(_c(v))) for k, v in kwargs.items()})
+
+
+def load(file, **kwargs):
+    out = onp.load(file, **kwargs)
+    if isinstance(out, onp.ndarray):
+        return _wrap(jnp.asarray(out))
+    return out  # npz archive: lazy dict of numpy arrays
+
+
+def fromfile(file, dtype=float32, count=-1, sep=""):
+    return _wrap(jnp.asarray(onp.fromfile(file, dtype, count, sep)))
+
+
+def fromiter(iterable, dtype, count=-1):
+    return _wrap(jnp.asarray(onp.fromiter(iterable, dtype, count)))
+
+
+def fromstring(string, dtype=float32, count=-1, sep=" "):
+    return _wrap(jnp.asarray(onp.fromstring(string, dtype, count, sep=sep)))
+
+
+def from_dlpack(x):
+    return _wrap(jnp.from_dlpack(x))

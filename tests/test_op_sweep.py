@@ -395,3 +395,54 @@ def test_numpy_parity_tail_oracle():
         [mx.np.array(x) * 0 - 1, mx.np.array(x) * 0 + 1])
     onp.testing.assert_array_equal(sel.asnumpy(),
                                    onp.where(x < 0, -1.0, 1.0))
+
+
+def test_numpy_delegation_tail_oracle():
+    """The generated delegation batch vs numpy oracles."""
+    rng = onp.random.RandomState(5)
+    x = rng.randn(32).astype(onp.float32)
+    a = mx.np.array(x)
+    onp.testing.assert_allclose(mx.np.sinc(a).asnumpy(), onp.sinc(x),
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(mx.np.nanvar(a).asnumpy(), onp.nanvar(x),
+                                rtol=1e-4)
+    q, r = mx.np.divmod(mx.np.array(onp.array([7.0, -7.0], onp.float32)),
+                        mx.np.array(onp.array([2.0, 2.0], onp.float32)))
+    onp.testing.assert_allclose(q.asnumpy(), [3.0, -4.0])
+    onp.testing.assert_allclose(r.asnumpy(), [1.0, 1.0])
+    frac, integ = mx.np.modf(mx.np.array(onp.array([2.5], onp.float32)))
+    onp.testing.assert_allclose([float(frac), float(integ)], [0.5, 2.0])
+    for w in ("bartlett", "blackman", "hamming", "hanning"):
+        onp.testing.assert_allclose(getattr(mx.np, w)(8).asnumpy(),
+                                    getattr(onp, w)(8), rtol=1e-5,
+                                    atol=1e-6)
+    p = mx.np.polyder(mx.np.array(onp.array([3.0, 2.0, 1.0], onp.float32)))
+    onp.testing.assert_allclose(p.asnumpy(), [6.0, 2.0])
+    v, c = mx.np.unique_counts(mx.np.array(onp.array([3, 1, 3, 2, 3])))
+    onp.testing.assert_array_equal(v.asnumpy(), [1, 2, 3])
+    onp.testing.assert_array_equal(c.asnumpy(), [1, 1, 3])
+    blk = mx.np.block([[mx.np.ones((2, 2)), mx.np.zeros((2, 2))]])
+    assert blk.shape == (2, 4)
+    onp.testing.assert_allclose(
+        mx.np.vecdot(a.reshape(4, 8), a.reshape(4, 8)).asnumpy(),
+        (x.reshape(4, 8) ** 2).sum(-1), rtol=1e-5)
+    assert mx.np.broadcast_shapes((2, 1), (1, 3)) == (2, 3)
+    # alias sanity
+    onp.testing.assert_allclose(mx.np.acos(mx.np.array(
+        onp.array([0.5], onp.float32))).asnumpy(), onp.arccos([0.5]),
+        rtol=1e-6)
+
+
+def test_numpy_tail_gradients():
+    """Differentiable delegations record on the tape."""
+    from mxnet_tpu import autograd
+
+    x = mx.np.array(onp.array([0.3, 0.7], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        loss = mx.np.sinc(x).sum()
+    loss.backward()
+    eps = 1e-3
+    xv = x.asnumpy()
+    num = (onp.sinc(xv + eps) - onp.sinc(xv - eps)) / (2 * eps)
+    onp.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-2, atol=1e-3)
