@@ -1034,15 +1034,7 @@ def nanquantile(a, q, axis=None, keepdims=False):
                  name="nanquantile")
 
 
-def trapezoid(y, x=None, dx=1.0, axis=-1):
-    if x is None:
-        return _call(lambda v: jnp.trapezoid(v, dx=dx, axis=axis), (_c(y),),
-                     name="trapezoid")
-    return _call(lambda v, xv: jnp.trapezoid(v, xv, axis=axis),
-                 (_c(y), _c(x)), name="trapezoid")
-
-
-trapz = trapezoid
+trapezoid = trapz  # array-api name for the pre-existing trapz
 
 
 def divmod(x1, x2):  # noqa: A001
@@ -1110,23 +1102,43 @@ def poly(seq_of_zeros):
 
 
 def roots(p):
-    """EAGER-ONLY (eigenvalue solve on host for strip_zeros)."""
-    return _wrap(jnp.roots(_unwrap(_c(p)), strip_zeros=False))
+    """EAGER-ONLY (leading-zero stripping is data-dependent)."""
+    return _wrap(jnp.roots(_unwrap(_c(p)), strip_zeros=True))
 
 
 def block(arrays):
-    def conv(a):
+    """Assemble an array from nested lists of blocks — differentiable:
+    the leaf arrays are tape inputs, the nesting is static structure."""
+    leaves = []
+
+    def template(a):
         if isinstance(a, list):
-            return [conv(x) for x in a]
-        return _unwrap(_c(a))
+            return [template(x) for x in a]
+        leaves.append(_c(a))
+        return len(leaves) - 1
 
-    return _wrap(jnp.block(conv(arrays)))
+    tmpl = template(arrays)
+
+    def fn(*vals):
+        def rebuild(t):
+            if isinstance(t, list):
+                return [rebuild(x) for x in t]
+            return vals[t]
+
+        return jnp.block(rebuild(tmpl))
+
+    return apply_op(fn, leaves, name="block")
 
 
-def choose(a, choices, mode="clip"):
-    seq = [_unwrap(_c(c)) for c in choices]
-    return _call(lambda idx: jnp.choose(idx, seq, mode=mode), (_c(a),),
-                 name="choose")
+def choose(a, choices, mode="raise"):
+    """numpy-default mode='raise' validates indices (works eagerly; use
+    mode='clip'/'wrap' inside traced code)."""
+    seq_leaves = [_c(c) for c in choices]
+
+    def fn(idx, *cs):
+        return jnp.choose(idx, list(cs), mode=mode)
+
+    return apply_op(fn, [_c(a)] + seq_leaves, name="choose")
 
 
 def fill_diagonal(a, val, wrap=False):

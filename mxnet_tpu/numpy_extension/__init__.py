@@ -542,3 +542,203 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
     return _call(lambda d: _contrib.multibox_prior(
         d, sizes, ratios, steps, offsets, clip), (data,),
         name="multibox_prior")
+
+
+# ---------------------------------------------------------------------------
+# activation / math tail (reference src/operator: *_activation, special fns)
+# ---------------------------------------------------------------------------
+def rsqrt(x):
+    return _call(lambda v: jax.lax.rsqrt(v), (x,), name="rsqrt")
+
+
+def rcbrt(x):
+    return _call(lambda v: 1.0 / jnp.cbrt(v), (x,), name="rcbrt")
+
+
+def digamma(x):
+    return _call(jax.scipy.special.digamma, (x,), name="digamma")
+
+
+def log_sigmoid(x):
+    return _call(jax.nn.log_sigmoid, (x,), name="log_sigmoid")
+
+
+def hard_sigmoid(x, alpha=0.2, beta=0.5):
+    return _call(lambda v: jnp.clip(alpha * v + beta, 0.0, 1.0), (x,),
+                 name="hard_sigmoid")
+
+
+def silu(x):
+    return _call(jax.nn.silu, (x,), name="silu")
+
+
+swish = silu
+
+
+def mish(x):
+    return _call(lambda v: v * jnp.tanh(jax.nn.softplus(v)), (x,),
+                 name="mish")
+
+
+def softplus(x):
+    return _call(jax.nn.softplus, (x,), name="softplus")
+
+
+def smooth_l1(data, scalar=1.0):
+    """reference src/operator/tensor/elemwise_binary_scalar_op_extended.cc
+    smooth_l1: 0.5(sx)^2 if |x|<1/s^2 else |x|-0.5/s^2."""
+    s2 = scalar * scalar
+
+    def fn(x):
+        absx = jnp.abs(x)
+        return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x,
+                         absx - 0.5 / s2)
+
+    return _call(fn, (data,), name="smooth_l1")
+
+
+def softmax_cross_entropy(data, label):
+    """reference src/operator/loss_binary_op.cc: summed cross entropy of
+    softmax(data) (B, C) against integer labels (B,). Returns a scalar."""
+
+    def fn(d, l):
+        logp = jax.nn.log_softmax(d.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logp, l.astype(jnp.int32)[:, None], axis=-1)
+        return -picked.sum()
+
+    return _call(fn, (data, label), name="softmax_cross_entropy")
+
+
+def reshape(data, newshape, reverse=False):
+    """MXNet reshape with the legacy magic codes (reference
+    src/operator/tensor/matrix_op.cc Reshape):
+      0   copy this dimension from the input
+      -1  infer from remaining elements (at most one)
+      -2  copy ALL remaining input dimensions
+      -3  merge two consecutive input dimensions
+      -4  split one input dimension by the next two values (one may be -1)
+    ``reverse=True`` applies the codes right-to-left.
+    """
+    in_shape = list(data.shape)
+    spec = list(newshape)
+    if reverse:
+        in_shape = in_shape[::-1]
+        spec = spec[::-1]
+    out, i = [], 0  # i: input dim cursor
+    j = 0
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            out.append(in_shape[i])
+            i += 1
+        elif s == -1:
+            out.append(-1)
+            i += 1
+        elif s == -2:
+            out.extend(in_shape[i:])
+            i = len(in_shape)
+        elif s == -3:
+            out.append(in_shape[i] * in_shape[i + 1])
+            i += 2
+        elif s == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if d1 == -1:
+                d1 = in_shape[i] // d2
+            if d2 == -1:
+                d2 = in_shape[i] // d1
+            out.extend([d1, d2])
+            i += 1
+            j += 2
+        else:
+            out.append(int(s))
+            i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return _call(lambda x: x.reshape(tuple(out)), (data,), name="reshape")
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             blank_label="first"):
+    """Connectionist Temporal Classification loss (reference
+    src/operator/nn/ctc_loss.cc; data (T, B, C) activations, label (B, L)
+    int classes with 1-based classes when blank is 'first').
+
+    TPU-native: the alpha recursion runs in log space under ``lax.scan``
+    over time — one compiled program, no per-step host work. Returns (B,)
+    losses. Simplification vs the warp-ctc kernel: blank index is 0
+    ('first'); 'last' maps labels accordingly.
+    """
+    def fn(d, lab, dlen, llen):
+        t_max, b, c = d.shape
+        logp = jax.nn.log_softmax(d.astype(jnp.float32), axis=-1)
+        lab = lab.astype(jnp.int32)
+        l_max = lab.shape[1]
+        if blank_label == "first":
+            blank = 0
+        else:
+            blank = c - 1
+        s_max = 2 * l_max + 1
+        # extended label sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((b, s_max), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = -1e30
+        # allow alpha(s-2) only when ext[s] != blank and ext[s] != ext[s-2]
+        ext_prev2 = jnp.concatenate(
+            [jnp.full((b, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+        can_skip = (ext != blank) & (ext != ext_prev2)
+
+        alpha0 = jnp.full((b, s_max), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(logp[0], ext[:, 1:2], axis=-1)[:, 0])
+
+        tl = (jnp.full((b,), t_max, jnp.int32) if dlen is None
+              else dlen.astype(jnp.int32))
+        ll = (jnp.full((b,), l_max, jnp.int32) if llen is None
+              else llen.astype(jnp.int32))
+
+        # O(B*S) memory: carry a running "alpha at t = tl-1" selection
+        # instead of stacking the full (T, B, S) alpha history
+        saved0 = jnp.where((tl == 1)[:, None], alpha0, neg_inf)
+
+        def step(carry, inp):
+            alpha, saved = carry
+            t, logp_t = inp
+            a1 = jnp.concatenate(
+                [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate(
+                [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(can_skip, a2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            emit = jnp.take_along_axis(logp_t, ext, axis=-1)
+            new_alpha = merged + emit
+            saved = jnp.where((t == tl - 1)[:, None], new_alpha, saved)
+            return (new_alpha, saved), None
+
+        (_, alpha_T), _ = jax.lax.scan(
+            step, (alpha0, saved0),
+            (jnp.arange(1, t_max), logp[1:]))
+        end1 = jnp.take_along_axis(alpha_T, (2 * ll)[:, None], axis=1)[:, 0]
+        # empty target (ll == 0): only the all-blank path at s=0 counts;
+        # 2*ll-1 would wrap to -1 and add a spurious alignment
+        end2_ix = jnp.maximum(2 * ll - 1, 0)[:, None]
+        end2 = jnp.take_along_axis(alpha_T, end2_ix, axis=1)[:, 0]
+        end2 = jnp.where(ll > 0, end2, neg_inf)
+        return -jnp.logaddexp(end1, end2)
+
+    arrays = [data, label]
+    if data_lengths is None and label_lengths is None:
+        return _call(lambda d, l: fn(d, l, None, None), arrays,
+                     name="ctc_loss")
+    extra = [a for a in (data_lengths, label_lengths) if a is not None]
+
+    def dispatch(*vals):
+        d, l = vals[0], vals[1]
+        rest = list(vals[2:])
+        dl = rest.pop(0) if data_lengths is not None else None
+        ll_ = rest.pop(0) if label_lengths is not None else None
+        return fn(d, l, dl, ll_)
+
+    return _call(dispatch, arrays + extra, name="ctc_loss")
