@@ -260,6 +260,9 @@ class CTCLoss(Loss):
             last2 = jnp.take_along_axis(
                 alpha, jnp.maximum(end - 1, 0)[:, None], axis=1
             )[:, 0]
+            # empty target: only the all-blank path counts once (end-1
+            # would clamp back onto s=0 and double-count it)
+            last2 = jnp.where(lab_len > 0, last2, neg_inf)
             return -jnp.logaddexp(last, last2)
 
         N, T, _ = pred.shape

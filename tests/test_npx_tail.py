@@ -153,3 +153,27 @@ def test_ctc_loss_empty_target():
     p = e / e.sum(-1, keepdims=True)
     ref = -onp.log(onp.prod(p[:, 0]))  # all-blank path
     onp.testing.assert_allclose(loss[0], ref, rtol=1e-4)
+
+
+def test_gluon_ctc_loss_matches_bruteforce():
+    """gluon.loss.CTCLoss (NTC layout) against the same path oracle,
+    including the empty-target guard."""
+    from mxnet_tpu import gluon
+
+    rng = onp.random.RandomState(5)
+    pred = rng.randn(2, 4, 3).astype(onp.float32)  # (N, T, C)
+    label = onp.array([[1, 2], [2, 0]], onp.int32)
+    loss_fn = gluon.loss.CTCLoss()
+    out = loss_fn(mx.np.array(pred), mx.np.array(label),
+                  None, mx.np.array(onp.array([2, 1], onp.int32))).asnumpy()
+    ref0 = _ctc_bruteforce(pred[0], [1, 2])
+    ref1 = _ctc_bruteforce(pred[1], [2])
+    onp.testing.assert_allclose(out[0], ref0, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(out[1], ref1, rtol=1e-4, atol=1e-4)
+    # empty target: all-blank path NLL exactly once
+    out0 = loss_fn(mx.np.array(pred[:1]), mx.np.array(label[:1]),
+                   None, mx.np.array(onp.array([0], onp.int32))).asnumpy()
+    e = onp.exp(pred[0] - pred[0].max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    onp.testing.assert_allclose(out0[0], -onp.log(onp.prod(p[:, 0])),
+                                rtol=1e-4)
