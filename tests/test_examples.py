@@ -1,0 +1,37 @@
+"""Entry-point scripts (reference example/gluon/image_classification.py and
+example/distributed_training/ — the BASELINE.json live entry points)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=900):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, script), "--cpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+@pytest.mark.integration
+def test_image_classification_entry_point():
+    out = _run("example/gluon/image_classification.py",
+               "--model", "resnet18_v1", "--dataset", "synthetic",
+               "--epochs", "1", "--batch-size", "16", "--num-batches", "3",
+               "--image-size", "32", "--fold-bn")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "epoch 0: loss=" in out.stdout
+    assert "fold_bn: val_acc=" in out.stdout
+
+
+@pytest.mark.integration
+def test_distributed_dp_entry_point():
+    out = _run("example/distributed_training/train_dp.py",
+               "--ndev", "8", "--steps", "4", "--batch-size", "16",
+               "--model", "resnet18_v1")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "mesh: 8 x cpu" in out.stdout
+    assert "throughput:" in out.stdout
