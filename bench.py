@@ -127,10 +127,11 @@ def parse_last_json(text: str):
 
 def main() -> None:
     last_err = "no attempts ran"
-    # (platform, timeout_s): two TPU tries (tunnel init is flaky and can
-    # hang), then CPU which always works
+    # (platform, timeout_s): three TPU tries (the tunnel flaps for hours
+    # at a time; a dead attempt exits in ~190s via the init watchdog, so
+    # retries are cheap), then CPU which always works
     for attempt, (platform, tmo) in enumerate(
-            [("tpu", 420), ("tpu", 420), ("cpu", 900)]):
+            [("tpu", 420), ("tpu", 420), ("tpu", 420), ("cpu", 900)]):
         log(f"attempt {attempt}: platform={platform} timeout={tmo}s")
         try:
             proc = subprocess.run(
