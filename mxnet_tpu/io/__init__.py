@@ -345,3 +345,128 @@ class PrefetchingIter(DataIter):
             self._done = True
             raise item
         return item
+
+
+class CSVIter(DataIter):
+    """Batches from CSV files (reference ``src/io/iter_csv.cc`` CSVIter):
+    ``data_csv`` rows are flattened records reshaped to ``data_shape``;
+    optional ``label_csv``. ``round_batch`` pads the tail batch by
+    wrapping to the file start, like the reference."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32"):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_shape = tuple(label_shape)
+        self._dtype = dtype
+        self._data = onp.loadtxt(data_csv, delimiter=",",
+                                 dtype=dtype, ndmin=2)
+        n = self._data.shape[0]
+        self._data = self._data.reshape((n,) + self.data_shape)
+        if label_csv is not None:
+            self._label = onp.loadtxt(label_csv, delimiter=",",
+                                      dtype="float32", ndmin=2)
+            self._label = self._label.reshape((n,) + self.label_shape)
+        else:
+            self._label = onp.zeros((n,) + self.label_shape, onp.float32)
+        self._round = round_batch
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape,
+                         self._dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label",
+                         (self.batch_size,) + self.label_shape, "float32")]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self) -> DataBatch:
+        n = self._data.shape[0]
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        idx = onp.arange(self._cursor, end)
+        pad = max(0, end - n)
+        if pad and not self._round:
+            idx = idx[: self.batch_size - pad]
+            pad = 0
+        idx = idx % n  # round_batch wraps to the start
+        self._cursor = end
+        return DataBatch(mxnp.array(self._data[idx]),
+                         mxnp.array(self._label[idx]), pad=pad)
+
+
+class LibSVMIter(DataIter):
+    """Batches from libsvm-format files (reference
+    ``src/io/iter_libsvm.cc``): each row ``label idx:val idx:val ...``.
+    Batches come back as CSR sparse ndarrays
+    (:class:`mxnet_tpu.ndarray.sparse.CSRNDArray`) — the reference's
+    sample-major sparse input path."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 round_batch=True, dtype="float32"):
+        super().__init__(batch_size)
+        if isinstance(data_shape, int):
+            data_shape = (data_shape,)
+        self.data_shape = tuple(data_shape)
+        self._dtype = dtype
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                rows.append([(int(kv.split(":")[0]),
+                              float(kv.split(":")[1]))
+                             for kv in parts[1:]])
+        self._rows = rows
+        self._labels = onp.asarray(labels, onp.float32)
+        self._round = round_batch
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape,
+                         self._dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,), "float32")]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self) -> DataBatch:
+        from ..ndarray import sparse as _sparse
+
+        n = len(self._rows)
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        idx = onp.arange(self._cursor, end)
+        pad = max(0, end - n)
+        if pad and not self._round:
+            idx = idx[: self.batch_size - pad]
+            pad = 0
+        idx = idx % n
+        self._cursor = end
+        ncols = self.data_shape[-1]
+        indptr = [0]
+        indices, values = [], []
+        for i in idx:
+            for col, val in self._rows[i]:
+                indices.append(col)
+                values.append(val)
+            indptr.append(len(indices))
+        data = _sparse.csr_matrix(
+            (onp.asarray(values, self._dtype),
+             onp.asarray(indices, onp.int64),
+             onp.asarray(indptr, onp.int64)),
+            shape=(len(idx), ncols))
+        return DataBatch(data, mxnp.array(self._labels[idx]), pad=pad)

@@ -222,3 +222,46 @@ def test_prefetching_iter_exhaustion_is_sticky():
     for _ in range(3):
         with pytest.raises(StopIteration):
             pre.next()
+
+
+def test_csv_iter(tmp_path):
+    from mxnet_tpu import io as mio
+
+    data = onp.arange(21, dtype=onp.float32).reshape(7, 3)
+    labels = onp.arange(7, dtype=onp.float32).reshape(7, 1)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    onp.savetxt(dpath, data, delimiter=",")
+    onp.savetxt(lpath, labels, delimiter=",")
+    it = mio.CSVIter(dpath, data_shape=(3,), label_csv=lpath,
+                     batch_size=3)
+    batches = list(it)
+    assert len(batches) == 3
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:3])
+    onp.testing.assert_allclose(batches[0].label[0].asnumpy()[:, 0],
+                                [0, 1, 2])
+    assert batches[2].pad == 2  # 7 rows, batch 3 -> tail wraps 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_libsvm_iter_produces_csr(tmp_path):
+    from mxnet_tpu import io as mio
+
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:4.0\n")
+        f.write("1 0:0.5 2:1.0 3:3.0\n")
+        f.write("0 3:7.0\n")
+    it = mio.LibSVMIter(path, data_shape=4, batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    first = batches[0].data[0]
+    from mxnet_tpu.ndarray.sparse import CSRNDArray
+
+    assert isinstance(first, CSRNDArray)
+    dense = first.todense().asnumpy() if hasattr(first, "todense") \
+        else first.asnumpy()
+    ref = onp.array([[1.5, 0, 0, 2.0], [0, 4.0, 0, 0]], onp.float32)
+    onp.testing.assert_allclose(dense, ref)
+    onp.testing.assert_allclose(batches[0].label[0].asnumpy(), [1.0, 0.0])
