@@ -20,7 +20,8 @@ from .. import numpy as mxnp
 from ..recordio import IRHeader, ThreadedRecordReader, unpack, unpack_img
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
-           "ImageRecordIter", "ResizeIter", "PrefetchingIter"]
+           "ImageRecordIter", "ResizeIter", "PrefetchingIter",
+           "CSVIter", "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -347,6 +348,22 @@ class PrefetchingIter(DataIter):
         return item
 
 
+def _file_iter_next_indices(cursor, batch_size, n, round_batch):
+    """Shared tail-batch cursor logic for the file-backed iterators
+    (CSVIter/LibSVMIter): returns ``(idx, pad, new_cursor)``. With
+    ``round_batch`` the tail batch wraps to the file start and reports
+    ``pad``; without it the tail batch is simply short."""
+    if cursor >= n:
+        raise StopIteration
+    end = cursor + batch_size
+    idx = onp.arange(cursor, end)
+    pad = max(0, end - n)
+    if pad and not round_batch:
+        idx = idx[: batch_size - pad]
+        pad = 0
+    return idx % n, pad, end
+
+
 class CSVIter(DataIter):
     """Batches from CSV files (reference ``src/io/iter_csv.cc`` CSVIter):
     ``data_csv`` rows are flattened records reshaped to ``data_shape``;
@@ -386,17 +403,8 @@ class CSVIter(DataIter):
         self._cursor = 0
 
     def next(self) -> DataBatch:
-        n = self._data.shape[0]
-        if self._cursor >= n:
-            raise StopIteration
-        end = self._cursor + self.batch_size
-        idx = onp.arange(self._cursor, end)
-        pad = max(0, end - n)
-        if pad and not self._round:
-            idx = idx[: self.batch_size - pad]
-            pad = 0
-        idx = idx % n  # round_batch wraps to the start
-        self._cursor = end
+        idx, pad, self._cursor = _file_iter_next_indices(
+            self._cursor, self.batch_size, self._data.shape[0], self._round)
         return DataBatch(mxnp.array(self._data[idx]),
                          mxnp.array(self._label[idx]), pad=pad)
 
@@ -414,17 +422,32 @@ class LibSVMIter(DataIter):
         if isinstance(data_shape, int):
             data_shape = (data_shape,)
         self.data_shape = tuple(data_shape)
-        self._dtype = dtype
+        if len(self.data_shape) != 1:
+            raise MXNetError(
+                "LibSVMIter data_shape must be 1-D (CSR batches are 2-D, "
+                f"reference src/io/iter_libsvm.cc); got {self.data_shape}")
         rows, labels = [], []
+        self._dtype = dtype
         with open(data_libsvm) as f:
-            for line in f:
+            for lineno, line in enumerate(f, 1):
                 parts = line.split()
                 if not parts:
                     continue
                 labels.append(float(parts[0]))
-                rows.append([(int(kv.split(":")[0]),
-                              float(kv.split(":")[1]))
-                             for kv in parts[1:]])
+                row = []
+                for kv in parts[1:]:
+                    col, sep, val = kv.partition(":")
+                    if not sep:
+                        raise MXNetError(
+                            f"{data_libsvm}:{lineno}: malformed libsvm "
+                            f"token {kv!r} (expected 'index:value')")
+                    col = int(col)
+                    if col >= self.data_shape[0]:
+                        raise MXNetError(
+                            f"{data_libsvm}:{lineno}: feature index {col} "
+                            f">= data_shape {self.data_shape[0]}")
+                    row.append((col, float(val)))
+                rows.append(row)
         self._rows = rows
         self._labels = onp.asarray(labels, onp.float32)
         self._round = round_batch
@@ -445,18 +468,9 @@ class LibSVMIter(DataIter):
     def next(self) -> DataBatch:
         from ..ndarray import sparse as _sparse
 
-        n = len(self._rows)
-        if self._cursor >= n:
-            raise StopIteration
-        end = self._cursor + self.batch_size
-        idx = onp.arange(self._cursor, end)
-        pad = max(0, end - n)
-        if pad and not self._round:
-            idx = idx[: self.batch_size - pad]
-            pad = 0
-        idx = idx % n
-        self._cursor = end
-        ncols = self.data_shape[-1]
+        idx, pad, self._cursor = _file_iter_next_indices(
+            self._cursor, self.batch_size, len(self._rows), self._round)
+        ncols = self.data_shape[0]
         indptr = [0]
         indices, values = [], []
         for i in idx:

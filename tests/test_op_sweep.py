@@ -238,6 +238,7 @@ GRAD_COMPOSITE = [
 ]
 
 
+@pytest.mark.seed(7)
 @pytest.mark.parametrize("name,fn", GRAD_COMPOSITE,
                          ids=[t[0] for t in GRAD_COMPOSITE])
 def test_composite_numeric_grad(name, fn):
