@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Model training-throughput benchmark (reference ``perf.md:246-257``
+training table: ResNet-50 298.51 img/s, Inception-v3 214.48 img/s,
+AlexNet 2585.61 img/s — V100 fp32 bs32, train_imagenet.py era).
+
+Measures img/s of a full training step (forward + backward + SGD-momentum
+update) on the current device, per model and precision. The step is the
+framework's idiomatic TPU training program: ``HybridBlock.functionalize``
+forward, ``jax.value_and_grad``, and the optimizer update fused into ONE
+jitted XLA executable with donated weights/states — the same design
+``gluon.Trainer`` compiles (mxnet_tpu/gluon/trainer.py:137). Steps
+serialize naturally (each consumes the previous step's weights), so
+throughput needs no artificial dependency chain; a scalar loss fetch at
+the end of each pass is the completion barrier.
+
+bf16 rows use the AMP pattern: bf16 compute with fp32 master weights
+(multi-precision, reference optimizer.py multi_precision semantics).
+
+CLI:
+    python benchmark/train_bench.py [--models resnet50_v1,...] [--batch 32]
+                                    [--output results.json] [--cpu]
+Emits one JSON object per (model, precision) with img/s and the matching
+reference-baseline ratio where one exists.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# V100 fp32 bs32 training rows (docs perf.md:246-257 via BASELINE.md)
+V100_FP32_TRAIN = {
+    "resnet50_v1": 298.51,
+    "inception_v3": 214.48,
+    "alexnet": 2585.61,
+}
+
+
+def build_step(net_name, batch, dtype_name):
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = getattr(vision, net_name)(classes=1000)
+    net.initialize()
+    size = 299 if "inception" in net_name else 224
+    x_np = onp.random.uniform(size=(batch, 3, size, size)).astype(onp.float32)
+    y_np = onp.random.randint(0, 1000, size=(batch,)).astype(onp.int32)
+    fn, params = net.functionalize(mx.np.array(x_np), training=True)
+
+    compute_dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+    momentum, lr = 0.9, 0.05
+    velocity = {k: jnp.zeros_like(v) for k, v in params.items()
+                if v.dtype == jnp.float32}
+
+    def loss_fn(p, x, y, key):
+        if compute_dtype != jnp.float32:
+            # AMP multi-precision: fp32 master weights, bf16 compute; the
+            # in-graph cast makes grads flow back to the fp32 masters
+            pc = {k: v.astype(compute_dtype) if v.dtype == jnp.float32 else v
+                  for k, v in p.items()}
+            x = x.astype(compute_dtype)
+        else:
+            pc = p
+        logits, state = fn(pc, x, key=key)
+        # forward-mutated state (BN running stats) back in master precision
+        state = {k: s.astype(p[k].dtype) for k, s in state.items()}
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+        return nll, state
+
+    def train_step(p, vel, x, y, key):
+        (loss, state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, x, y, key)
+        new_p, new_v = {}, {}
+        for k, s in state.items():
+            if k in vel:  # fp32 learnable (BN stats get zero grads anyway)
+                v = momentum * vel[k] + grads[k].astype(jnp.float32)
+                new_v[k] = v
+                new_p[k] = s - lr * v
+            else:
+                new_p[k] = s
+        return new_p, new_v, loss
+
+    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    return jstep, params, velocity, jnp.asarray(x_np), jnp.asarray(y_np)
+
+
+def measure(net_name, batch, dtype_name, log):
+    import jax
+    import jax.numpy as jnp
+
+    jstep, p, vel, x, y = build_step(net_name, batch, dtype_name)
+    key = jax.random.PRNGKey(0)
+    t0 = time.time()
+    p, vel, loss = jstep(p, vel, x, y, key)
+    float(loss)
+    log(f"{net_name}/{dtype_name}: compiled in {time.time() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    p, vel, loss = jstep(p, vel, x, y, key)
+    float(loss)
+    per = max(time.perf_counter() - t0, 1e-4)
+    pass_iters = max(5, min(100, int(5.0 / per)))
+
+    total_iters, total_dt = 0, 0.0
+    while total_dt < 5.0 and total_iters < 1500:
+        t0 = time.perf_counter()
+        for _ in range(pass_iters):
+            p, vel, loss = jstep(p, vel, x, y, key)
+        float(loss)  # barrier: loss of the last serially-chained step
+        total_dt += time.perf_counter() - t0
+        total_iters += pass_iters
+    img_s = batch * total_iters / total_dt
+    log(f"{net_name}/{dtype_name}: {img_s:.1f} img/s "
+        f"({total_iters} steps, {total_dt:.1f}s)")
+    rec = {"model": net_name, "precision": dtype_name, "batch": batch,
+           "train_img_s": round(img_s, 2), "steps": total_iters}
+    base = V100_FP32_TRAIN.get(net_name)
+    if base:
+        rec["v100_fp32_baseline"] = base
+        rec["vs_v100_fp32"] = round(img_s / base, 3)
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", default="resnet50_v1")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--precisions", default="fp32,bf16")
+    ap.add_argument("--output", default=None)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    def log(*a):
+        print("[train_bench]", *a, file=sys.stderr, flush=True)
+
+    log("devices:", jax.devices())
+    out = {"device": jax.devices()[0].platform,
+           "device_kind": jax.devices()[0].device_kind,
+           "results": []}
+    for name in args.models.split(","):
+        for prec in args.precisions.split(","):
+            out["results"].append(measure(name, args.batch, prec, log))
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()

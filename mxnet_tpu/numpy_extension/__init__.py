@@ -544,6 +544,42 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
         name="multibox_prior")
 
 
+def deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                           stride=1, dilate=1, pad=0, num_filter=None,
+                           num_group=1, num_deformable_group=1, no_bias=False):
+    args = ((data, offset, weight) if bias is None or no_bias
+            else (data, offset, weight, bias))
+    return _call(
+        lambda d, o, w, *b: _contrib.deformable_convolution(
+            d, o, w, b[0] if b else None, kernel=kernel, stride=stride,
+            dilate=dilate, pad=pad, num_filter=num_filter,
+            num_group=num_group, num_deformable_group=num_deformable_group,
+            no_bias=no_bias),
+        args, name="deformable_convolution")
+
+
+def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                     kernel=None, stride=1, dilate=1, pad=0,
+                                     num_filter=None, num_group=1,
+                                     num_deformable_group=1, no_bias=False):
+    args = ((data, offset, mask, weight) if bias is None or no_bias
+            else (data, offset, mask, weight, bias))
+    return _call(
+        lambda d, o, m, w, *b: _contrib.deformable_convolution(
+            d, o, w, b[0] if b else None, mask=m, kernel=kernel,
+            stride=stride, dilate=dilate, pad=pad, num_filter=num_filter,
+            num_group=num_group, num_deformable_group=num_deformable_group,
+            no_bias=no_bias),
+        args, name="modulated_deformable_convolution")
+
+
+def hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    return _call(
+        _contrib.hawkes_ll,
+        (mu, alpha, beta, state, lags, marks, valid_length, max_time),
+        name="hawkes_ll", n_out=2)
+
+
 # ---------------------------------------------------------------------------
 # activation / math tail (reference src/operator: *_activation, special fns)
 # ---------------------------------------------------------------------------

@@ -22,6 +22,8 @@ __all__ = [
     "roi_pooling", "roi_align", "boolean_mask", "count_sketch",
     "adaptive_avg_pool2d", "sync_batch_norm", "box_iou", "box_nms",
     "bipartite_matching", "allclose", "index_array", "multibox_prior",
+    "deformable_convolution", "modulated_deformable_convolution",
+    "hawkes_ll",
 ]
 
 
@@ -333,3 +335,163 @@ def index_array(data, axes=None):
     grids = jnp.meshgrid(*[jnp.arange(s, dtype=jnp.int64) for s in shape],
                          indexing="ij")
     return jnp.stack([grids[a] for a in axes], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (reference contrib/deformable_convolution.cc DCNv1,
+# contrib/modulated_deformable_convolution.cc DCNv2)
+# ---------------------------------------------------------------------------
+def _bilinear_sample(fmap, ys, xs):
+    """Sample fmap (C, H, W) at float coords ys/xs (...,) with zero
+    padding outside — vectorized gathers, no scalar loops (the reference
+    walks pixels in a CUDA kernel; on TPU the whole sample grid is one
+    batched gather feeding the MXU matmul)."""
+    C, H, W = fmap.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    out = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yi = y0 + dy
+            xi = x0 + dx
+            valid = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            v = fmap[:, yc, xc]                      # (C, ...)
+            out = out + v * (wy * wx * valid)[None]
+    return out
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                           stride=1, dilate=1, pad=0, num_filter=None,
+                           num_group=1, num_deformable_group=1, mask=None,
+                           no_bias=False):
+    """Deformable convolution v1/v2.
+
+    data (B, C, H, W); offset (B, 2*kh*kw*ndg, OH, OW) ordered
+    [y0, x0, y1, x1, ...] per deformable group (reference
+    deformable_im2col.h coordinate order); weight (O, C/g, kh, kw);
+    ``mask`` (B, kh*kw*ndg, OH, OW) enables the DCNv2 modulated variant
+    (contrib/modulated_deformable_convolution.cc).
+    """
+    kh, kw = kernel
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    dh, dw = (dilate, dilate) if isinstance(dilate, int) else dilate
+    ph, pw = (pad, pad) if isinstance(pad, int) else pad
+    B, C, H, W = data.shape
+    OH = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    OW = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    ndg = num_deformable_group
+    if C % ndg or (offset.shape[1] != 2 * kh * kw * ndg):
+        raise MXNetError(
+            f"deformable_convolution: offset channels {offset.shape[1]} != "
+            f"2*kh*kw*num_deformable_group = {2 * kh * kw * ndg}")
+
+    # base sampling grid: (kh*kw, OH, OW)
+    oy = jnp.arange(OH, dtype=jnp.float32) * sh - ph
+    ox = jnp.arange(OW, dtype=jnp.float32) * sw - pw
+    ky = jnp.arange(kh, dtype=jnp.float32) * dh
+    kx = jnp.arange(kw, dtype=jnp.float32) * dw
+    base_y = (ky[:, None, None, None] + oy[None, None, :, None])  # (kh,1,OH,1)
+    base_x = (kx[None, :, None, None] + ox[None, None, None, :])  # (1,kw,1,OW)
+    base_y = jnp.broadcast_to(base_y, (kh, kw, OH, OW)).reshape(kh * kw, OH, OW)
+    base_x = jnp.broadcast_to(base_x, (kh, kw, OH, OW)).reshape(kh * kw, OH, OW)
+
+    off = offset.reshape(B, ndg, kh * kw, 2, OH, OW)
+    ys = base_y[None, None] + off[:, :, :, 0]        # (B, ndg, kh*kw, OH, OW)
+    xs = base_x[None, None] + off[:, :, :, 1]
+
+    def sample_one(fmap_g, ys_g, xs_g):
+        # fmap_g (C/ndg, H, W); coords (kh*kw, OH, OW)
+        return _bilinear_sample(fmap_g, ys_g, xs_g)  # (C/ndg, kh*kw, OH, OW)
+
+    data_g = data.reshape(B, ndg, C // ndg, H, W)
+    cols = jax.vmap(jax.vmap(sample_one))(data_g, ys, xs)
+    # (B, ndg, C/ndg, kh*kw, OH, OW)
+    if mask is not None:
+        m = mask.reshape(B, ndg, 1, kh * kw, OH, OW)
+        cols = cols * m
+    cols = cols.reshape(B, C, kh * kw, OH, OW)
+
+    O = weight.shape[0]
+    g = num_group
+    cols = cols.reshape(B, g, C // g, kh * kw, OH, OW)
+    w = weight.reshape(g, O // g, C // g, kh, kw).reshape(
+        g, O // g, C // g, kh * kw)
+    y = jnp.einsum("bgcks,gock->bgos",
+                   cols.reshape(B, g, C // g, kh * kw, OH * OW), w)
+    y = y.reshape(B, O, OH, OW)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape(1, -1, 1, 1)
+    return y
+
+
+def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                     **kw):
+    """DCNv2 (reference contrib/modulated_deformable_convolution.cc):
+    deformable convolution with a learned per-sample modulation mask."""
+    return deformable_convolution(data, offset, weight, bias=bias, mask=mask,
+                                  **kw)
+
+
+# ---------------------------------------------------------------------------
+# Hawkes process log-likelihood (reference contrib/hawkes_ll-inl.h)
+# ---------------------------------------------------------------------------
+def hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log-likelihood of a marked self-exciting Hawkes process.
+
+    mu (N, K) background intensities; alpha/beta (K,) branching ratio and
+    decay; state (N, K) initial states; lags (N, T) interarrival times;
+    marks (N, T) int32; valid_length (N,); max_time (N,).
+    Returns (log_likelihood (N,), out_state (N, K)) with the same
+    recursion as the reference kernel (hawkes_ll-inl.h:113-160): a
+    lax.scan over events replaces the per-sample CUDA thread loop, with
+    one-hot mark updates so every step is dense K-vector math on the VPU.
+    """
+    mu = jnp.asarray(mu)
+    alpha = jnp.asarray(alpha)
+    beta = jnp.asarray(beta)
+    state0 = jnp.asarray(state)
+    lags = jnp.asarray(lags)
+    marks = jnp.asarray(marks).astype(jnp.int32)
+    valid_length = jnp.asarray(valid_length)
+    max_time = jnp.asarray(max_time)
+    N, K = mu.shape
+    T = lags.shape[1]
+
+    def one_seq(mu_i, s0, lag_i, mark_i, vl, mt):
+        def step(carry, inp):
+            ll, t, s, last = carry
+            lag, mark, j = inp
+            on = (j < vl)
+            t_new = t + lag
+            oh = jax.nn.one_hot(mark, K, dtype=mu_i.dtype)
+            d = t_new - jnp.sum(oh * last)
+            b = jnp.sum(oh * beta)
+            a = jnp.sum(oh * alpha)
+            m_ = jnp.sum(oh * mu_i)
+            sc = jnp.sum(oh * s)
+            ed = jnp.exp(-b * d)
+            lda = m_ + a * b * sc * ed
+            comp = m_ * d + a * sc * (1.0 - ed)
+            ll_new = ll + jnp.log(lda) - comp
+            s_new = s + oh * (1.0 + sc * ed - sc)
+            last_new = last + oh * (t_new - jnp.sum(oh * last))
+            carry = (jnp.where(on, ll_new, ll), jnp.where(on, t_new, t),
+                     jnp.where(on, s_new, s), jnp.where(on, last_new, last))
+            return carry, None
+
+        init = (jnp.zeros((), mu_i.dtype), jnp.zeros((), mu_i.dtype),
+                s0, jnp.zeros((K,), mu_i.dtype))
+        (ll, _t, s, last), _ = lax.scan(
+            step, init,
+            (lag_i, mark_i, jnp.arange(T, dtype=valid_length.dtype)))
+        # remaining compensators up to max_time (hawkesll compensator kernel)
+        d = mt - last
+        ed = jnp.exp(-beta * d)
+        rem = mu_i * d + alpha * s * (1.0 - ed)
+        return ll - jnp.sum(rem), s * ed
+
+    return jax.vmap(one_seq)(mu, state0, lags, marks, valid_length, max_time)

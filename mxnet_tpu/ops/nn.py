@@ -76,6 +76,10 @@ def convolution(
     else:
         raise ValueError(f"unsupported layout {layout}")
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, spec)
+    # no preferred_element_type: the MXU accumulates bf16 convs in fp32
+    # internally and rounds at the final store, so bf16-out == fp32-out +
+    # downcast — and requesting fp32 out breaks the conv transpose rule
+    # (jax's vjp feeds the fp32 cotangent into a bf16-weight grad conv)
     y = lax.conv_general_dilated(
         x,
         weight,
@@ -84,10 +88,7 @@ def convolution(
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
-    if y.dtype != x.dtype:
-        y = y.astype(x.dtype)
     if bias is not None:
         if layout.startswith("NC"):
             y = y + bias.reshape((1, -1) + (1,) * ndim)

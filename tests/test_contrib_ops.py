@@ -229,3 +229,184 @@ def test_sync_batch_norm_syncs_across_mesh_axis():
     ref = ((x - ref_mean[None, :, None, None])
            / onp.sqrt(ref_var[None, :, None, None] + 1e-5))
     onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (reference contrib/deformable_convolution.cc v1,
+# contrib/modulated_deformable_convolution.cc v2)
+# ---------------------------------------------------------------------------
+def _np_deform_conv(data, offset, weight, kernel, stride, pad, dilate,
+                    ndg=1, mask=None):
+    """Loop-based numpy oracle: bilinear sampling at offset kernel taps."""
+    kh, kw = kernel
+    B, C, H, W = data.shape
+    O = weight.shape[0]
+    sh = sw = stride
+    ph = pw = pad
+    dh = dw = dilate
+    OH = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    OW = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    out = onp.zeros((B, O, OH, OW), onp.float64)
+    off = offset.reshape(B, ndg, kh * kw, 2, OH, OW)
+    cpg = C // ndg
+
+    def sample(fm, y, x):
+        y0, x0 = int(onp.floor(y)), int(onp.floor(x))
+        val = 0.0
+        for dy2 in (0, 1):
+            for dx2 in (0, 1):
+                yy, xx = y0 + dy2, x0 + dx2
+                if 0 <= yy < H and 0 <= xx < W:
+                    wgt = ((1 - abs(y - yy)) * (1 - abs(x - xx)))
+                    val += fm[yy, xx] * wgt
+        return val
+
+    for b in range(B):
+        for oh in range(OH):
+            for ow in range(OW):
+                cols = onp.zeros((C, kh * kw))
+                for g in range(ndg):
+                    for k in range(kh * kw):
+                        i, j = divmod(k, kw)
+                        y = oh * sh - ph + i * dh + off[b, g, k, 0, oh, ow]
+                        x = ow * sw - pw + j * dw + off[b, g, k, 1, oh, ow]
+                        for c in range(cpg):
+                            v = sample(data[b, g * cpg + c], y, x)
+                            if mask is not None:
+                                v *= mask.reshape(
+                                    B, ndg, kh * kw, OH, OW)[b, g, k, oh, ow]
+                            cols[g * cpg + c, k] = v
+                for o in range(O):
+                    out[b, o, oh, ow] = onp.sum(
+                        weight[o].reshape(C, kh * kw) * cols)
+    return out.astype(onp.float32)
+
+
+@pytest.mark.seed(11)
+def test_deformable_conv_zero_offset_matches_regular_conv():
+    x = onp.random.randn(2, 3, 6, 6).astype(onp.float32)
+    w = onp.random.randn(4, 3, 3, 3).astype(onp.float32)
+    off = onp.zeros((2, 2 * 3 * 3, 4, 4), onp.float32)
+    out = mx.npx.deformable_convolution(
+        mx.np.array(x), mx.np.array(off), mx.np.array(w), kernel=(3, 3),
+        num_filter=4)
+    ref = mx.npx.convolution(mx.np.array(x), mx.np.array(w), kernel=(3, 3),
+                             num_filter=4)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.seed(12)
+def test_deformable_conv_random_offsets_vs_numpy_oracle():
+    x = onp.random.randn(1, 2, 5, 5).astype(onp.float32)
+    w = onp.random.randn(3, 2, 3, 3).astype(onp.float32)
+    off = (onp.random.randn(1, 2 * 3 * 3, 3, 3) * 0.7).astype(onp.float32)
+    out = mx.npx.deformable_convolution(
+        mx.np.array(x), mx.np.array(off), mx.np.array(w), kernel=(3, 3),
+        num_filter=3)
+    ref = _np_deform_conv(x, off, w, (3, 3), 1, 0, 1)
+    onp.testing.assert_allclose(onp.asarray(out), ref, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.seed(13)
+def test_modulated_deformable_conv_vs_numpy_oracle():
+    x = onp.random.randn(1, 2, 5, 5).astype(onp.float32)
+    w = onp.random.randn(2, 2, 3, 3).astype(onp.float32)
+    off = (onp.random.randn(1, 2 * 3 * 3, 5, 5) * 0.5).astype(onp.float32)
+    mask = onp.random.uniform(0, 1, (1, 3 * 3, 5, 5)).astype(onp.float32)
+    out = mx.npx.modulated_deformable_convolution(
+        mx.np.array(x), mx.np.array(off), mx.np.array(mask), mx.np.array(w),
+        kernel=(3, 3), num_filter=2, pad=1)
+    ref = _np_deform_conv(x, off, w, (3, 3), 1, 1, 1, mask=mask)
+    onp.testing.assert_allclose(onp.asarray(out), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_conv_grad_flows():
+    x = mx.np.array(onp.random.randn(1, 2, 4, 4).astype(onp.float32))
+    w = mx.np.array(onp.random.randn(2, 2, 3, 3).astype(onp.float32))
+    off = mx.np.array(onp.zeros((1, 18, 2, 2), onp.float32))
+    x.attach_grad(); w.attach_grad(); off.attach_grad()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        y = mx.npx.deformable_convolution(x, off, w, kernel=(3, 3),
+                                          num_filter=2)
+        loss = (y * y).sum()
+    loss.backward()
+    assert onp.isfinite(onp.asarray(x.grad)).all()
+    assert onp.isfinite(onp.asarray(w.grad)).all()
+    assert onp.isfinite(onp.asarray(off.grad)).all()
+    assert float(mx.np.abs(off.grad).sum()) > 0  # offsets get gradients
+
+
+# ---------------------------------------------------------------------------
+# hawkes_ll (reference contrib/hawkes_ll-inl.h:113-160 recursion)
+# ---------------------------------------------------------------------------
+def _np_hawkes_ll(mu, alpha, beta, state, lags, marks, vl, max_time):
+    N, K = mu.shape
+    T = lags.shape[1]
+    lls = onp.zeros(N)
+    out_state = state.astype(onp.float64).copy()
+    for i in range(N):
+        t = 0.0
+        last = onp.zeros(K)
+        s = out_state[i]
+        ll = 0.0
+        for j in range(int(vl[i])):
+            ci = int(marks[i, j])
+            t += lags[i, j]
+            d = t - last[ci]
+            ed = onp.exp(-beta[ci] * d)
+            lda = mu[i, ci] + alpha[ci] * beta[ci] * s[ci] * ed
+            comp = mu[i, ci] * d + alpha[ci] * s[ci] * (1 - ed)
+            ll += onp.log(lda) - comp
+            s[ci] = 1 + s[ci] * ed
+            last[ci] = t
+        d = max_time[i] - last
+        ed = onp.exp(-beta * d)
+        ll -= onp.sum(mu[i] * d + alpha * s * (1 - ed))
+        out_state[i] = s * ed
+        lls[i] = ll
+    return lls.astype(onp.float32), out_state.astype(onp.float32)
+
+
+@pytest.mark.seed(21)
+def test_hawkes_ll_vs_numpy_oracle():
+    N, T, K = 3, 7, 4
+    mu = onp.random.uniform(0.5, 1.5, (N, K)).astype(onp.float32)
+    alpha = onp.random.uniform(0.1, 0.5, (K,)).astype(onp.float32)
+    beta = onp.random.uniform(0.5, 2.0, (K,)).astype(onp.float32)
+    state = onp.random.uniform(0, 1, (N, K)).astype(onp.float32)
+    lags = onp.random.exponential(0.5, (N, T)).astype(onp.float32)
+    marks = onp.random.randint(0, K, (N, T)).astype(onp.int32)
+    vl = onp.array([7, 4, 0], onp.float32)
+    max_time = onp.array([5.0, 4.0, 3.0], onp.float32)
+    ll, out_state = mx.npx.hawkes_ll(
+        mx.np.array(mu), mx.np.array(alpha), mx.np.array(beta),
+        mx.np.array(state), mx.np.array(lags), mx.np.array(marks),
+        mx.np.array(vl), mx.np.array(max_time))
+    ref_ll, ref_state = _np_hawkes_ll(mu, alpha, beta, state, lags, marks,
+                                      vl, max_time)
+    onp.testing.assert_allclose(onp.asarray(ll), ref_ll, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(onp.asarray(out_state), ref_state,
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_hawkes_ll_grad_flows():
+    from mxnet_tpu import autograd
+    mu = mx.np.array(onp.full((2, 3), 1.0, onp.float32))
+    alpha = mx.np.array(onp.full((3,), 0.3, onp.float32))
+    beta = mx.np.array(onp.full((3,), 1.0, onp.float32))
+    mu.attach_grad(); alpha.attach_grad(); beta.attach_grad()
+    state = mx.np.zeros((2, 3))
+    lags = mx.np.array(onp.random.exponential(0.5, (2, 5)).astype(onp.float32))
+    marks = mx.np.array(onp.random.randint(0, 3, (2, 5)).astype(onp.int32))
+    vl = mx.np.array(onp.array([5, 3], onp.float32))
+    mt = mx.np.array(onp.array([4.0, 4.0], onp.float32))
+    with autograd.record():
+        ll, _ = mx.npx.hawkes_ll(mu, alpha, beta, state, lags, marks, vl, mt)
+        loss = -ll.sum()
+    loss.backward()
+    assert onp.isfinite(onp.asarray(mu.grad)).all()
+    assert float(mx.np.abs(mu.grad).sum()) > 0
+    assert float(mx.np.abs(alpha.grad).sum()) > 0
+    assert float(mx.np.abs(beta.grad).sum()) > 0
