@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -130,16 +131,14 @@ def measure(net_name, batch, dtype_name, log):
     return rec
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--models", default="resnet50_v1")
-    ap.add_argument("--batch", type=int, default=32)
-    ap.add_argument("--precisions", default="fp32,bf16")
-    ap.add_argument("--output", default=None)
-    ap.add_argument("--cpu", action="store_true")
-    args = ap.parse_args()
+def child_main(name, batch, prec, cpu):
+    """Measure ONE (model, precision) pair and print its JSON record.
+    Runs in a child process: the axon tunnel can hang mid-compile, and a
+    hung child can be timed out and retried (in-process jax caches a dead
+    backend forever) — same engineering as bench.py."""
+    import threading
 
-    if args.cpu:
+    if cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax
@@ -147,13 +146,77 @@ def main():
     def log(*a):
         print("[train_bench]", *a, file=sys.stderr, flush=True)
 
-    log("devices:", jax.devices())
-    out = {"device": jax.devices()[0].platform,
-           "device_kind": jax.devices()[0].device_kind,
-           "results": []}
+    up = threading.Event()
+
+    def _watchdog():
+        if not up.wait(180):
+            log("backend init watchdog fired — aborting child")
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    devs = jax.devices()
+    up.set()
+    log("devices:", devs)
+    rec = measure(name, batch, prec, log)
+    rec["device"] = devs[0].platform
+    rec["device_kind"] = devs[0].device_kind
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", default="resnet50_v1")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--precisions", default="fp32,bf16")
+    ap.add_argument("--output", default=None)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--child", nargs=2, metavar=("MODEL", "PREC"),
+                    default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--timeout", type=int, default=600,
+                    help="per-(model,precision) child timeout, seconds")
+    ap.add_argument("--retries", type=int, default=2)
+    args = ap.parse_args()
+
+    if args.child:
+        child_main(args.child[0], args.batch, args.child[1], args.cpu)
+        return
+
+    def log(*a):
+        print("[train_bench]", *a, file=sys.stderr, flush=True)
+
+    results = []
+    device = {}
     for name in args.models.split(","):
         for prec in args.precisions.split(","):
-            out["results"].append(measure(name, args.batch, prec, log))
+            rec = None
+            for attempt in range(args.retries + 1):
+                cmd = [sys.executable, os.path.abspath(__file__),
+                       "--child", name, prec, "--batch", str(args.batch)]
+                if args.cpu:
+                    cmd.append("--cpu")
+                try:
+                    proc = subprocess.run(cmd, capture_output=True,
+                                          text=True, timeout=args.timeout)
+                    sys.stderr.write(proc.stderr[-2000:])
+                    for line in reversed(proc.stdout.strip().splitlines()):
+                        if line.startswith("{"):
+                            rec = json.loads(line)
+                            break
+                except subprocess.TimeoutExpired:
+                    log(f"{name}/{prec} attempt {attempt}: "
+                        f"timeout {args.timeout}s")
+                except Exception as e:  # noqa: BLE001
+                    log(f"{name}/{prec} attempt {attempt}: {e!r}")
+                if rec:
+                    break
+            if rec:
+                device["device"] = rec.pop("device", None)
+                device["device_kind"] = rec.pop("device_kind", None)
+                results.append(rec)
+            else:
+                results.append({"model": name, "precision": prec,
+                                "batch": args.batch, "error": "no result"})
+    out = {**device, "results": results}
     text = json.dumps(out, indent=2)
     print(text)
     if args.output:

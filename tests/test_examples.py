@@ -35,3 +35,14 @@ def test_distributed_dp_entry_point():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "mesh: 8 x cpu" in out.stdout
     assert "throughput:" in out.stdout
+
+
+@pytest.mark.integration
+def test_word_language_model_entry_point():
+    out = _run("example/gluon/word_language_model.py",
+               "--epochs", "2", "--corpus-len", "6000",
+               "--batch-size", "8", "--bptt", "8")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "final: val_ppl=" in out.stdout
+    ppl = float(out.stdout.rsplit("val_ppl=", 1)[1].split()[0])
+    assert ppl < 64, f"LM learned nothing: ppl {ppl} vs uniform 64"
