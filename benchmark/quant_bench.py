@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""INT8 PTQ inference benchmark: quantized ResNet-50 throughput + top-1
+agreement vs the fp32 net.
+
+The reference's INT8 story (contrib/quantization.py + MKLDNN/TensorRT
+subgraph backends) targeted CPU/GPU; on TPU v5e the int8 MXU path has 2×
+the bf16 peak, so PTQ is a throughput feature, not just a size one. This
+measures the quantize_net (weights int8 per-channel, activations
+calibrated) inference path end to end, with the same serial-chain +
+scalar-fetch protocol as bench.py, and reports top-1 agreement so speed
+is never reported without an accuracy check.
+
+CLI:
+    python benchmark/quant_bench.py [--model resnet50_v1] [--batch 32]
+        [--calib-mode naive|entropy|none] [--output out.json] [--cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50_v1")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--calib-mode", default="naive",
+                    choices=["none", "naive", "entropy"])
+    ap.add_argument("--output", default=None)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.quantization import quantize_net
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    def log(*a):
+        print("[quant_bench]", *a, file=sys.stderr, flush=True)
+
+    log("devices:", jax.devices())
+    onp.random.seed(0)
+    net = getattr(vision, args.model)(classes=1000)
+    net.initialize()
+    x_np = onp.random.uniform(
+        size=(args.batch, 3, args.image_size, args.image_size)
+    ).astype(onp.float32)
+    x = mx.np.array(x_np)
+    ref_logits = net(x).asnumpy()  # materializes shapes + fp32 reference
+
+    fp_fn, fp_params = net.functionalize(x, training=False)
+    qnet = quantize_net(net, calib_data=[x], calib_mode=args.calib_mode)
+    q_fn, q_params = qnet.functionalize(x, training=False)
+    q_logits = onp.asarray(jax.jit(q_fn)(q_params, x._data)[0])
+    agreement = float(
+        (ref_logits.argmax(1) == q_logits.argmax(1)).mean())
+    log(f"top-1 agreement int8 vs fp32: {agreement:.3f}")
+
+    def throughput(fn, params, tag):
+        def step(params, xx):
+            logits, _ = fn(params, xx)
+            perturb = jnp.tanh(jnp.mean(logits)) * 1e-6
+            return logits, xx * (1.0 + perturb).astype(xx.dtype)
+
+        jstep = jax.jit(step)
+        xx = jnp.asarray(x_np)
+        t0 = time.time()
+        out, xw = jstep(params, xx)
+        float(jnp.sum(out)); float(jnp.sum(xw))
+        log(f"{tag}: compiled in {time.time() - t0:.1f}s")
+        t0 = time.perf_counter()
+        out, xx = jstep(params, xx)
+        float(jnp.sum(out))
+        per = max(time.perf_counter() - t0, 1e-4)
+        pass_iters = max(10, min(200, int(10.0 / per)))
+        total, dt = 0, 0.0
+        while dt < 5.0 and total < 3000:
+            t0 = time.perf_counter()
+            for _ in range(pass_iters):
+                out, xx = jstep(params, xx)
+            float(jnp.sum(out))
+            dt += time.perf_counter() - t0
+            total += pass_iters
+        img_s = args.batch * total / dt
+        log(f"{tag}: {img_s:.1f} img/s ({total} iters)")
+        return img_s
+
+    int8_img_s = throughput(q_fn, q_params, "int8")
+    fp32_img_s = throughput(fp_fn, fp_params, "fp32")
+    rec = {
+        "model": args.model,
+        "batch": args.batch,
+        "calib_mode": args.calib_mode,
+        "device": jax.devices()[0].platform,
+        "int8_img_s": round(int8_img_s, 2),
+        "fp32_img_s": round(fp32_img_s, 2),
+        "speedup_vs_fp32": round(int8_img_s / fp32_img_s, 3),
+        "top1_agreement": round(agreement, 4),
+    }
+    text = json.dumps(rec, indent=2)
+    print(text)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,18 +43,32 @@ V100_FP32_TRAIN = {
 }
 
 
-def build_step(net_name, batch, dtype_name):
+def build_step(net_name, batch, dtype_name, seq_len=128):
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
-    from mxnet_tpu.gluon.model_zoo import vision
 
-    net = getattr(vision, net_name)(classes=1000)
-    net.initialize()
-    size = 299 if "inception" in net_name else 224
-    x_np = onp.random.uniform(size=(batch, 3, size, size)).astype(onp.float32)
-    y_np = onp.random.randint(0, 1000, size=(batch,)).astype(onp.int32)
-    fn, params = net.functionalize(mx.np.array(x_np), training=True)
+    if net_name.startswith("bert"):
+        # BERT pretraining step (MLM over all positions + NSP), seq 128 —
+        # the BASELINE stretch-goal config (SURVEY §7.8)
+        from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+
+        core = getattr(bert_zoo, net_name)(dropout=0.0)
+        net = bert_zoo.BERTForPretraining(core)
+        net.initialize()
+        x_np = onp.random.randint(0, 30522, (batch, seq_len)).astype(onp.int32)
+        y_np = x_np.copy()  # MLM labels; throughput is label-agnostic
+        fn, params = net.functionalize(mx.np.array(x_np), training=True)
+    else:
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        net = getattr(vision, net_name)(classes=1000)
+        net.initialize()
+        size = 299 if "inception" in net_name else 224
+        x_np = onp.random.uniform(
+            size=(batch, 3, size, size)).astype(onp.float32)
+        y_np = onp.random.randint(0, 1000, size=(batch,)).astype(onp.int32)
+        fn, params = net.functionalize(mx.np.array(x_np), training=True)
 
     compute_dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
     momentum, lr = 0.9, 0.05
@@ -70,11 +84,12 @@ def build_step(net_name, batch, dtype_name):
             x = x.astype(compute_dtype)
         else:
             pc = p
-        logits, state = fn(pc, x, key=key)
+        out, state = fn(pc, x, key=key)
+        logits = out[0] if isinstance(out, tuple) else out  # BERT: (mlm, nsp)
         # forward-mutated state (BN running stats) back in master precision
         state = {k: s.astype(p[k].dtype) for k, s in state.items()}
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-        nll = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+        nll = -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
         return nll, state
 
     def train_step(p, vel, x, y, key):
@@ -120,10 +135,17 @@ def measure(net_name, batch, dtype_name, log):
         total_dt += time.perf_counter() - t0
         total_iters += pass_iters
     img_s = batch * total_iters / total_dt
-    log(f"{net_name}/{dtype_name}: {img_s:.1f} img/s "
-        f"({total_iters} steps, {total_dt:.1f}s)")
     rec = {"model": net_name, "precision": dtype_name, "batch": batch,
-           "train_img_s": round(img_s, 2), "steps": total_iters}
+           "steps": total_iters}
+    if net_name.startswith("bert"):
+        rec["train_seq_s"] = round(img_s, 2)
+        rec["train_tok_s"] = round(img_s * 128, 1)
+        log(f"{net_name}/{dtype_name}: {img_s:.1f} seq/s "
+            f"({total_iters} steps, {total_dt:.1f}s)")
+    else:
+        rec["train_img_s"] = round(img_s, 2)
+        log(f"{net_name}/{dtype_name}: {img_s:.1f} img/s "
+            f"({total_iters} steps, {total_dt:.1f}s)")
     base = V100_FP32_TRAIN.get(net_name)
     if base:
         rec["v100_fp32_baseline"] = base
