@@ -1,0 +1,120 @@
+"""``gluon.contrib.cnn`` (reference
+``python/mxnet/gluon/contrib/cnn/conv_layers.py``): DeformableConvolution
+and ModulatedDeformableConvolution layers.
+
+Layer contract matches the reference: the offsets (and DCNv2 mask) are
+produced by an internal regular convolution whose weights initialize to
+ZERO, so the layer starts exactly equal to a plain convolution and learns
+its deformation field. The deformable sampling itself is
+``npx.deformable_convolution`` (ops/contrib.py): batched bilinear gathers
+feeding one grouped einsum on the MXU.
+"""
+from __future__ import annotations
+
+from .... import numpy_extension as npx
+from ...block import HybridBlock
+from ...parameter import Parameter
+
+__all__ = ["DeformableConvolution", "ModulatedDeformableConvolution"]
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class DeformableConvolution(HybridBlock):
+    """DCNv1 layer (reference conv_layers.py:29)."""
+
+    _modulated = False
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, use_bias=True, in_channels=0,
+                 activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 dtype="float32"):
+        super().__init__()
+        self._channels = channels
+        self._kernel = _pair(kernel_size)
+        self._strides = _pair(strides)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._groups = groups
+        self._ndg = num_deformable_group
+        self._act = activation
+        kh, kw = self._kernel
+        per_point = 3 if self._modulated else 2
+        off_ch = per_point * kh * kw * num_deformable_group
+        self._off_ch = off_ch
+        self.offset_weight = Parameter(
+            "offset_weight", shape=(off_ch, in_channels, kh, kw),
+            dtype=dtype, init=offset_weight_initializer,
+            allow_deferred_init=True)
+        self.offset_bias = (
+            Parameter("offset_bias", shape=(off_ch,), dtype=dtype,
+                      init=offset_bias_initializer)
+            if offset_use_bias else None)
+        self.weight = Parameter(
+            "weight",
+            shape=(channels, in_channels // groups if in_channels else 0,
+                   kh, kw),
+            dtype=dtype, init=weight_initializer, allow_deferred_init=True)
+        self.bias = (Parameter("bias", shape=(channels,), dtype=dtype,
+                               init=bias_initializer) if use_bias else None)
+
+    def _finalize(self, x):
+        in_ch = x.shape[1]
+        kh, kw = self._kernel
+        if not self.offset_weight.shape_known:
+            self.offset_weight.shape = (self._off_ch, in_ch, kh, kw)
+            self.offset_weight.finalize()
+        if not self.weight.shape_known:
+            self.weight.shape = (self._channels, in_ch // self._groups, kh, kw)
+            self.weight.finalize()
+
+    def forward(self, x):
+        self._finalize(x)
+        off_bias = (self.offset_bias.data()
+                    if self.offset_bias is not None else None)
+        raw = npx.convolution(
+            x, self.offset_weight.data(), off_bias, kernel=self._kernel,
+            stride=self._strides, dilate=self._dilation, pad=self._padding,
+            num_filter=self._off_ch, no_bias=off_bias is None)
+        kh, kw = self._kernel
+        k = kh * kw * self._ndg
+        if self._modulated:
+            offset = raw[:, : 2 * k]
+            mask = npx.sigmoid(raw[:, 2 * k:])
+        else:
+            offset, mask = raw, None
+        bias = self.bias.data() if self.bias is not None else None
+        if mask is None:
+            out = npx.deformable_convolution(
+                x, offset, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._strides, dilate=self._dilation,
+                pad=self._padding, num_filter=self._channels,
+                num_group=self._groups, num_deformable_group=self._ndg,
+                no_bias=bias is None)
+        else:
+            out = npx.modulated_deformable_convolution(
+                x, offset, mask, self.weight.data(), bias,
+                kernel=self._kernel, stride=self._strides,
+                dilate=self._dilation, pad=self._padding,
+                num_filter=self._channels, num_group=self._groups,
+                num_deformable_group=self._ndg, no_bias=bias is None)
+        if self._act:
+            out = npx.activation(out, act_type=self._act)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel_size={self._kernel}, strides={self._strides})")
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """DCNv2 layer (reference conv_layers.py:224): the internal conv also
+    emits a per-sample modulation mask (sigmoid-squashed)."""
+
+    _modulated = True

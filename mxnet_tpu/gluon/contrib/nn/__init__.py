@@ -1,0 +1,128 @@
+"""``gluon.contrib.nn`` (reference
+``python/mxnet/gluon/contrib/nn/basic_layers.py``): Concurrent branches,
+Identity, SparseEmbedding, SyncBatchNorm, PixelShuffle1D/2D/3D.
+
+TPU notes: SyncBatchNorm's cross-device reduction is a mesh-axis psum
+(``npx.sync_batch_norm``) instead of the reference's NCCL-backed
+``sync_batch_norm`` op (contrib/sync_batch_norm.cc); PixelShuffle is pure
+reshape/transpose, which XLA folds into the surrounding program for free.
+"""
+from __future__ import annotations
+
+from .... import numpy as mxnp
+from .... import numpy_extension as npx
+from ...block import HybridBlock
+from ...nn import (BatchNorm, Concatenate, Embedding,
+                         HybridConcatenate, Identity)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Concatenate):
+    """Lay side-by-side branches over the same input and concatenate their
+    outputs (reference basic_layers.py:31)."""
+
+
+class HybridConcurrent(HybridConcatenate):
+    """Hybridizable :class:`Concurrent` (reference basic_layers.py:64)."""
+
+
+class SparseEmbedding(Embedding):
+    """Embedding whose weight gradient is row_sparse (reference
+    basic_layers.py:118) — only touched rows update, the vocab-scale
+    training path (gather forward, scatter-accumulated sparse grad)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference basic_layers.py:165 + the
+    contrib ``sync_batch_norm.cc`` NCCL kernel): statistics are reduced
+    over the ``axis_name`` mesh axis, so every shard normalizes with
+    global batch stats. Outside a shard_map/mesh scope it degrades to
+    plain BatchNorm (the reference behaves the same with one device).
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, axis_name="dp", **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._axis_name = axis_name
+        self._num_devices = num_devices  # accepted for API parity
+
+    def forward(self, x):
+        import jax
+
+        self._finalize(x)
+        axis_name = self._axis_name
+        try:
+            jax.lax.axis_index(axis_name)  # raises outside a binding scope
+        except Exception:  # noqa: BLE001 — not inside shard_map/pmap
+            axis_name = None
+        if axis_name is None:
+            return super().forward(x)
+        out, _mean, _var = npx.sync_batch_norm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._epsilon, momentum=self._momentum,
+            axis_name=axis_name)
+        return out
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim):
+        super().__init__()
+        self._factor = ((factor,) * ndim if isinstance(factor, int)
+                        else tuple(factor))
+        self._ndim = ndim
+
+    def forward(self, x):
+        # (N, C*prod(f), *spatial) -> (N, C, *(spatial*f)); the classic
+        # sub-pixel conv rearrangement (reference basic_layers.py:249+)
+        f = self._factor
+        nd = self._ndim
+        N, C = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        prod = 1
+        for v in f:
+            prod *= v
+        C_out = C // prod
+        # split channel into (C_out, f1, ..., fn)
+        x = x.reshape((N, C_out) + f + tuple(spatial))
+        # interleave: axes order (N, C_out, s1, f1, s2, f2, ...)
+        perm = [0, 1]
+        for i in range(nd):
+            perm += [2 + nd + i, 2 + i]
+        x = mxnp.transpose(x, perm)
+        out_spatial = tuple(s * fi for s, fi in zip(spatial, f))
+        return x.reshape((N, C_out) + out_spatial)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(factor={self._factor})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f) (reference basic_layers.py:249)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 1)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2) (reference :297)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 2)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3) (:359)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 3)

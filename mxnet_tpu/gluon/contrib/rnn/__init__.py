@@ -1,0 +1,286 @@
+"""``gluon.contrib.rnn`` (reference
+``python/mxnet/gluon/contrib/rnn/``): VariationalDropoutCell, LSTMPCell
+(projected LSTM), and convolutional RNN/LSTM/GRU cells.
+
+All cell math goes through the taped ``mx.np``/``npx`` ops, so eager
+``autograd.record()`` and hybridized traces both differentiate them; the
+conv cells reuse ``npx.convolution`` (one MXU conv per gate block, gates
+sliced along channels exactly like the reference conv_rnn_cell.py).
+"""
+from __future__ import annotations
+
+from .... import numpy as mxnp
+from .... import numpy_extension as npx
+from ...parameter import Parameter
+from ...rnn.rnn_cell import RecurrentCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell",
+           "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _act(x, name):
+    return npx.activation(x, act_type=name)
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Wraps a cell with variational (per-sequence, not per-step) dropout
+    masks on inputs/states/outputs (reference contrib rnn_cell.py:27,
+    Gal & Ghahramani 2015). Masks are drawn once after ``reset()`` and
+    reused at every step of the sequence."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__()
+        self.base_cell = base_cell
+        self._drop_inputs = drop_inputs
+        self._drop_states = drop_states
+        self._drop_outputs = drop_outputs
+        self._mask_in = None
+        self._mask_states = None
+        self._mask_out = None
+
+    def reset(self):
+        super().reset()
+        self._mask_in = self._mask_states = self._mask_out = None
+
+    def state_info(self, batch_size: int = 0):
+        return self.base_cell.state_info(batch_size)
+
+    @staticmethod
+    def _mask(like, p):
+        keep = 1.0 - p
+        u = mxnp.random.uniform(0, 1, like.shape)
+        return (u < keep).astype(like.dtype) / keep
+
+    def forward(self, x, states):
+        if self._drop_inputs:
+            if self._mask_in is None:
+                self._mask_in = self._mask(x, self._drop_inputs)
+            x = x * self._mask_in
+        if self._drop_states:
+            if self._mask_states is None:
+                self._mask_states = self._mask(states[0], self._drop_states)
+            states = [states[0] * self._mask_states] + list(states[1:])
+        out, new_states = self.base_cell(x, states)
+        if self._drop_outputs:
+            if self._mask_out is None:
+                self._mask_out = self._mask(out, self._drop_outputs)
+            out = out * self._mask_out
+        return out, new_states
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell({self.base_cell!r}, "
+                f"in={self._drop_inputs}, state={self._drop_states}, "
+                f"out={self._drop_outputs})")
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a hidden-state projection (reference contrib
+    rnn_cell.py:197, LSTMP of Sak et al. 2014): h' = (o * tanh(c')) @ Wr.
+    States: [h (B, projection_size), c (B, hidden_size)]."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", dtype="float32"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(4 * hidden_size, input_size), dtype=dtype,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            dtype=dtype, init=h2h_weight_initializer)
+        self.h2r_weight = Parameter(
+            "h2r_weight", shape=(projection_size, hidden_size), dtype=dtype,
+            init=h2r_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  dtype=dtype, init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  dtype=dtype, init=h2h_bias_initializer)
+
+    def state_info(self, batch_size: int = 0):
+        return [
+            {"shape": (batch_size, self._projection_size), "__layout__": "NC"},
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+        ]
+
+    def forward(self, x, states):
+        if not self.i2h_weight.shape_known:
+            self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+            self.i2h_weight.finalize()
+        h, c = states
+        gates = (npx.fully_connected(x, self.i2h_weight.data(),
+                                     self.i2h_bias.data(),
+                                     num_hidden=4 * self._hidden_size)
+                 + npx.fully_connected(h, self.h2h_weight.data(),
+                                       self.h2h_bias.data(),
+                                       num_hidden=4 * self._hidden_size))
+        hs = self._hidden_size
+        i = npx.sigmoid(gates[:, 0 * hs:1 * hs])
+        f = npx.sigmoid(gates[:, 1 * hs:2 * hs])
+        g = mxnp.tanh(gates[:, 2 * hs:3 * hs])
+        o = npx.sigmoid(gates[:, 3 * hs:4 * hs])
+        c_new = f * c + i * g
+        h_new = npx.fully_connected(
+            o * mxnp.tanh(c_new), self.h2r_weight.data(), None,
+            num_hidden=self._projection_size, no_bias=True)
+        return h_new, [h_new, c_new]
+
+
+class _ConvRNNCell(RecurrentCell):
+    """Shared conv-cell machinery (reference conv_rnn_cell.py
+    _BaseConvRNNCell): i2h and h2h are convolutions whose paddings keep
+    the spatial dims, gates are sliced along the channel axis."""
+
+    _mode = "rnn_tanh"
+    _gates = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1, ndim=2,
+                 activation="tanh", i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", dtype="float32"):
+        super().__init__()
+        self._ndim = ndim
+        self._input_shape = tuple(input_shape)  # (C_in, *spatial)
+        self._hc = hidden_channels
+        self._activation = activation
+
+        def tup(v):
+            return (v,) * ndim if isinstance(v, int) else tuple(v)
+
+        self._i2h_kernel = tup(i2h_kernel)
+        self._h2h_kernel = tup(h2h_kernel)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise ValueError(
+                    f"h2h_kernel must be odd to preserve spatial dims; "
+                    f"got {self._h2h_kernel}")
+        self._i2h_pad = tup(i2h_pad)
+        self._i2h_dilate = tup(i2h_dilate)
+        self._h2h_dilate = tup(h2h_dilate)
+        # SAME padding for the recurrent conv
+        self._h2h_pad = tuple(d * (k - 1) // 2 for k, d in
+                              zip(self._h2h_kernel, self._h2h_dilate))
+        c_in = self._input_shape[0]
+        g = self._gates
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(g * hidden_channels, c_in) + self._i2h_kernel,
+            dtype=dtype, init=i2h_weight_initializer)
+        self.h2h_weight = Parameter(
+            "h2h_weight",
+            shape=(g * hidden_channels, hidden_channels) + self._h2h_kernel,
+            dtype=dtype, init=h2h_weight_initializer)
+        self.i2h_bias = Parameter(
+            "i2h_bias", shape=(g * hidden_channels,), dtype=dtype,
+            init=i2h_bias_initializer)
+        self.h2h_bias = Parameter(
+            "h2h_bias", shape=(g * hidden_channels,), dtype=dtype,
+            init=h2h_bias_initializer)
+        # output spatial dims after the i2h conv (h2h preserves them)
+        spatial = self._input_shape[1:]
+        self._state_spatial = tuple(
+            (s + 2 * p - (d * (k - 1) + 1)) + 1
+            for s, p, d, k in zip(spatial, self._i2h_pad, self._i2h_dilate,
+                                  self._i2h_kernel))
+
+    def state_info(self, batch_size: int = 0):
+        shape = (batch_size, self._hc) + self._state_spatial
+        n = 2 if self._mode == "lstm" else 1
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._ndim:]}
+                for _ in range(n)]
+
+    def _convs(self, x, h):
+        g = self._gates
+        i2h = npx.convolution(
+            x, self.i2h_weight.data(), self.i2h_bias.data(),
+            kernel=self._i2h_kernel, pad=self._i2h_pad,
+            dilate=self._i2h_dilate, num_filter=g * self._hc)
+        h2h = npx.convolution(
+            h, self.h2h_weight.data(), self.h2h_bias.data(),
+            kernel=self._h2h_kernel, pad=self._h2h_pad,
+            dilate=self._h2h_dilate, num_filter=g * self._hc)
+        return i2h, h2h
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(input_shape={self._input_shape}, "
+                f"hidden={self._hc})")
+
+
+class _ConvVanillaCell(_ConvRNNCell):
+    _gates = 1
+
+    def forward(self, x, states):
+        i2h, h2h = self._convs(x, states[0])
+        h_new = _act(i2h + h2h, self._activation)
+        return h_new, [h_new]
+
+
+class _ConvLSTMCell(_ConvRNNCell):
+    _mode = "lstm"
+    _gates = 4
+
+    def forward(self, x, states):
+        h, c = states
+        i2h, h2h = self._convs(x, h)
+        gates = i2h + h2h
+        hc = self._hc
+        i = npx.sigmoid(gates[:, 0 * hc:1 * hc])
+        f = npx.sigmoid(gates[:, 1 * hc:2 * hc])
+        g = _act(gates[:, 2 * hc:3 * hc], self._activation)
+        o = npx.sigmoid(gates[:, 3 * hc:4 * hc])
+        c_new = f * c + i * g
+        h_new = o * _act(c_new, self._activation)
+        return h_new, [h_new, c_new]
+
+
+class _ConvGRUCell(_ConvRNNCell):
+    _mode = "gru"
+    _gates = 3
+
+    def forward(self, x, states):
+        h = states[0]
+        i2h, h2h = self._convs(x, h)
+        hc = self._hc
+        r = npx.sigmoid(i2h[:, 0 * hc:1 * hc] + h2h[:, 0 * hc:1 * hc])
+        z = npx.sigmoid(i2h[:, 1 * hc:2 * hc] + h2h[:, 1 * hc:2 * hc])
+        n = _act(i2h[:, 2 * hc:3 * hc] + r * h2h[:, 2 * hc:3 * hc],
+                 self._activation)
+        h_new = (1.0 - z) * n + z * h
+        return h_new, [h_new]
+
+
+def _make(name, base, ndim, doc):
+    cls = type(name, (base,), {
+        "__init__": (lambda self, input_shape, hidden_channels,
+                     i2h_kernel, h2h_kernel, **kw:
+                     base.__init__(self, input_shape, hidden_channels,
+                                   i2h_kernel, h2h_kernel,
+                                   ndim=ndim, **kw)),
+        "__doc__": doc,
+    })
+    return cls
+
+
+Conv1DRNNCell = _make("Conv1DRNNCell", _ConvVanillaCell, 1,
+                      "1-D convolutional Elman cell (reference conv_rnn_cell.py).")
+Conv2DRNNCell = _make("Conv2DRNNCell", _ConvVanillaCell, 2,
+                      "2-D convolutional Elman cell (reference conv_rnn_cell.py).")
+Conv3DRNNCell = _make("Conv3DRNNCell", _ConvVanillaCell, 3,
+                      "3-D convolutional Elman cell (reference conv_rnn_cell.py).")
+Conv1DLSTMCell = _make("Conv1DLSTMCell", _ConvLSTMCell, 1,
+                       "1-D ConvLSTM cell (Shi et al. 2015; reference conv_rnn_cell.py).")
+Conv2DLSTMCell = _make("Conv2DLSTMCell", _ConvLSTMCell, 2,
+                       "2-D ConvLSTM cell (Shi et al. 2015; reference conv_rnn_cell.py).")
+Conv3DLSTMCell = _make("Conv3DLSTMCell", _ConvLSTMCell, 3,
+                       "3-D ConvLSTM cell (Shi et al. 2015; reference conv_rnn_cell.py).")
+Conv1DGRUCell = _make("Conv1DGRUCell", _ConvGRUCell, 1,
+                      "1-D ConvGRU cell (reference conv_rnn_cell.py).")
+Conv2DGRUCell = _make("Conv2DGRUCell", _ConvGRUCell, 2,
+                      "2-D ConvGRU cell (reference conv_rnn_cell.py).")
+Conv3DGRUCell = _make("Conv3DGRUCell", _ConvGRUCell, 3,
+                      "3-D ConvGRU cell (reference conv_rnn_cell.py).")

@@ -1,0 +1,185 @@
+"""gluon.contrib layers/cells (reference
+python/mxnet/gluon/contrib/{nn,cnn,rnn} tested via
+tests/python/unittest/test_gluon_contrib.py patterns)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import contrib, nn
+
+
+def test_concurrent_concatenates_branches():
+    net = contrib.nn.HybridConcurrent(axis=1)
+    net.add(nn.Dense(4))
+    net.add(nn.Dense(6))
+    net.add(contrib.nn.Identity())
+    net.initialize()
+    x = mx.np.array(onp.random.randn(2, 5).astype(onp.float32))
+    out = net(x)
+    assert out.shape == (2, 4 + 6 + 5)
+
+
+def test_sparse_embedding_row_sparse_grad():
+    emb = contrib.nn.SparseEmbedding(50, 8)
+    emb.initialize()
+    idx = mx.np.array(onp.array([[1, 3], [3, 7]], onp.int32))
+    with autograd.record():
+        out = emb(idx)
+        loss = (out * out).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert g.stype == "row_sparse"
+    touched = set(onp.asarray(g.indices).tolist())
+    assert touched == {1, 3, 7}
+
+
+@pytest.mark.parametrize("cls,factor,cin,shape", [
+    (contrib.nn.PixelShuffle1D, 2, 6, (8,)),
+    (contrib.nn.PixelShuffle2D, (2, 3), 12, (4, 5)),
+    (contrib.nn.PixelShuffle3D, (1, 2, 2), 8, (3, 4, 4)),
+])
+def test_pixel_shuffle_shapes_and_values(cls, factor, cin, shape):
+    layer = cls(factor)
+    x = onp.arange(2 * cin * int(onp.prod(shape))).reshape(
+        (2, cin) + shape).astype(onp.float32)
+    out = layer(mx.np.array(x))
+    f = (factor,) * len(shape) if isinstance(factor, int) else factor
+    cout = cin // int(onp.prod(f))
+    assert out.shape == (2, cout) + tuple(s * fi for s, fi in zip(shape, f))
+    # torch pixel_shuffle oracle for the 2-D case
+    if len(shape) == 2:
+        import torch
+
+        ref = torch.nn.functional.pixel_shuffle(
+            torch.from_numpy(x[:, : cout * f[0] * f[0]]), f[0]).numpy() \
+            if f[0] == f[1] else None
+        if ref is not None:
+            onp.testing.assert_allclose(onp.asarray(out)[:, :ref.shape[1]],
+                                        ref, rtol=0, atol=0)
+
+
+def test_pixel_shuffle_2d_oracle_manual():
+    # exact semantics: out[n, c, h*f1+i, w*f2+j] = in[n, c*f1*f2 + i*f2 + j, h, w]
+    f1, f2 = 2, 3
+    x = onp.random.randn(1, f1 * f2, 2, 2).astype(onp.float32)
+    out = onp.asarray(contrib.nn.PixelShuffle2D((f1, f2))(mx.np.array(x)))
+    for h in range(2):
+        for w in range(2):
+            for i in range(f1):
+                for j in range(f2):
+                    assert out[0, 0, h * f1 + i, w * f2 + j] == \
+                        x[0, i * f2 + j, h, w]
+
+
+def test_sync_batch_norm_layer_degrades_to_bn_outside_mesh():
+    sbn = contrib.nn.SyncBatchNorm(in_channels=3)
+    bn = nn.BatchNorm(in_channels=3)
+    sbn.initialize()
+    bn.initialize()
+    x = mx.np.array(onp.random.randn(4, 3, 5, 5).astype(onp.float32))
+    onp.testing.assert_allclose(onp.asarray(sbn(x)), onp.asarray(bn(x)),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_deformable_convolution_layer_starts_as_regular_conv():
+    dcn = contrib.cnn.DeformableConvolution(
+        8, kernel_size=3, padding=1, in_channels=4)
+    conv = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=4)
+    dcn.initialize()
+    conv.initialize()
+    # same weights -> identical outputs while offsets are zero
+    conv.weight.set_data(dcn.weight.data())
+    conv.bias.set_data(dcn.bias.data())
+    x = mx.np.array(onp.random.randn(2, 4, 6, 6).astype(onp.float32))
+    onp.testing.assert_allclose(onp.asarray(dcn(x)), onp.asarray(conv(x)),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_modulated_deformable_convolution_trains():
+    net = contrib.cnn.ModulatedDeformableConvolution(
+        4, kernel_size=3, padding=1)
+    net.initialize()
+    x = mx.np.array(onp.random.randn(2, 3, 5, 5).astype(onp.float32))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    g = net.offset_weight.grad()
+    assert onp.isfinite(onp.asarray(g)).all()
+
+
+def test_lstmp_cell_shapes_and_grad():
+    cell = contrib.rnn.LSTMPCell(hidden_size=8, projection_size=5)
+    cell.initialize()
+    x = mx.np.array(onp.random.randn(3, 4).astype(onp.float32))
+    states = cell.begin_state(3)
+    assert states[0].shape == (3, 5) and states[1].shape == (3, 8)
+    with autograd.record():
+        out, new_states = cell(x, states)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (3, 5)
+    assert new_states[1].shape == (3, 8)
+    assert onp.isfinite(onp.asarray(cell.h2r_weight.grad())).all()
+
+
+def test_lstmp_unroll():
+    cell = contrib.rnn.LSTMPCell(hidden_size=6, projection_size=4)
+    cell.initialize()
+    x = mx.np.array(onp.random.randn(2, 5, 3).astype(onp.float32))
+    outs, states = cell.unroll(5, x, layout="NTC")
+    assert outs.shape == (2, 5, 4)
+
+
+def test_variational_dropout_mask_is_fixed_per_sequence():
+    from mxnet_tpu.gluon.rnn import RNNCell
+
+    base = RNNCell(6)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize()
+    x = mx.np.array(onp.ones((4, 3), onp.float32))
+    cell.reset()
+    out1, s = cell(x, cell.begin_state(4))
+    zeros1 = onp.asarray(out1) == 0
+    out2, _ = cell(x, s)
+    zeros2 = onp.asarray(out2) == 0
+    # same output units dropped at every step of the sequence
+    assert (zeros1 == zeros2).all()
+    cell.reset()
+    out3, _ = cell(x, cell.begin_state(4))
+    assert zeros1.any()  # dropout actually fired somewhere
+
+
+@pytest.mark.parametrize("cls,ndim,mode", [
+    (contrib.rnn.Conv1DRNNCell, 1, "rnn"),
+    (contrib.rnn.Conv2DLSTMCell, 2, "lstm"),
+    (contrib.rnn.Conv3DGRUCell, 3, "gru"),
+])
+def test_conv_rnn_cells_step_and_unroll(cls, ndim, mode):
+    spatial = (6,) * ndim
+    cell = cls(input_shape=(2,) + spatial, hidden_channels=4,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    B, T = 2, 3
+    x = mx.np.array(onp.random.randn(B, 2, *spatial).astype(onp.float32))
+    states = cell.begin_state(B)
+    assert states[0].shape == (B, 4) + spatial
+    out, new_states = cell(x, states)
+    assert out.shape == (B, 4) + spatial
+    seq = mx.np.array(onp.random.randn(B, T, 2, *spatial).astype(onp.float32))
+    outs, _ = cell.unroll(T, seq, layout="NTC")
+    assert outs.shape == (B, T, 4) + spatial
+
+
+def test_conv_lstm_grad_flows():
+    cell = contrib.rnn.Conv2DLSTMCell(
+        input_shape=(2, 5, 5), hidden_channels=3,
+        i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mx.np.array(onp.random.randn(2, 2, 5, 5).astype(onp.float32))
+    with autograd.record():
+        out, _ = cell(x, cell.begin_state(2))
+        loss = (out * out).mean()
+    loss.backward()
+    assert onp.isfinite(onp.asarray(cell.h2h_weight.grad())).all()
+    assert float(mx.np.abs(cell.i2h_weight.grad()).sum()) > 0
