@@ -63,7 +63,11 @@ def __getattr__(name):
                "checkpoint": ".checkpoint", "rtc": ".rtc",
                "library": ".library",
                "parallel": ".parallel", "random": ".numpy.random",
-               "sym": ".symbol", "symbol": ".symbol"}
+               "sym": ".symbol", "symbol": ".symbol",
+               "operator": ".operator", "callback": ".callback",
+               "model": ".model", "visualization": ".visualization",
+               "viz": ".visualization",
+               "lr_scheduler": ".optimizer.lr_scheduler"}
     if name in targets:
         expected = importlib.util.resolve_name(targets[name], __name__)
         try:

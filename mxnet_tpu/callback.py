@@ -1,0 +1,117 @@
+"""``mx.callback`` — training callbacks (reference
+``python/mxnet/callback.py``): ``Speedometer`` :91, ``do_checkpoint`` :26,
+``log_train_metric`` :64, ``ProgressBar`` :155,
+``LogValidationMetricsCallback`` :185.
+
+Callbacks receive the reference's ``BatchEndParam``-shaped object
+(``epoch``, ``nbatch``, ``eval_metric``); the Estimator's event handlers
+(gluon/contrib/estimator) are the 2.0-native mechanism — these exist for
+script parity with reference-era training loops.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from collections import namedtuple
+
+__all__ = ["BatchEndParam", "Speedometer", "do_checkpoint",
+           "log_train_metric", "ProgressBar", "LogValidationMetricsCallback"]
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def do_checkpoint(prefix, period: int = 1):
+    """Epoch-end callback saving ``prefix-symbol.json`` +
+    ``prefix-%04d.params`` every ``period`` epochs (reference :26)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        from . import model
+
+        if (iter_no + 1) % period == 0:
+            model.save_checkpoint(prefix, iter_no + 1, sym, arg or {},
+                                  aux or {})
+
+    return _callback
+
+
+def log_train_metric(period: int, auto_reset: bool = False):
+    """Log evaluation metrics every ``period`` batches (reference :64)."""
+
+    def _callback(param: BatchEndParam):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class Speedometer:
+    """Log samples/sec and metrics every ``frequent`` batches
+    (reference :91)."""
+
+    def __init__(self, batch_size, frequent: int = 50,
+                 auto_reset: bool = True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param: BatchEndParam):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / (
+                    time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                    msg += "\t%s=%f" * len(name_value)
+                    logging.info(msg, param.epoch, count, speed,
+                                 *sum(name_value, ()))
+                else:
+                    logging.info(
+                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                        param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar per batch (reference :155)."""
+
+    def __init__(self, total: int, length: int = 80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param: BatchEndParam):
+        count = param.nbatch
+        filled_len = int(round(self.bar_len * count / float(self.total)))
+        percents = int(round(100.0 * count / float(self.total)))
+        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
+        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+
+
+class LogValidationMetricsCallback:
+    """Log validation metrics at epoch end (reference :185)."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

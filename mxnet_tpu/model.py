@@ -1,0 +1,61 @@
+"""``mx.model`` — checkpoint helpers (reference
+``python/mxnet/model.py``: ``save_checkpoint`` :189, ``load_params`` :221,
+``load_checkpoint`` :238; the 1.x ``FeedForward`` trainer was removed in
+2.0 and is not reproduced here — Gluon ``Trainer``/``Estimator`` is the
+training API).
+
+File contract matches the reference: ``prefix-symbol.json`` holds the
+graph, ``prefix-%04d.params`` holds arg/aux arrays with ``arg:``/``aux:``
+name prefixes (ndarray.cc save format; here the `.params` container from
+``mxnet_tpu.serialization``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from . import serialization
+from .ndarray.ndarray import ndarray
+
+__all__ = ["save_checkpoint", "load_params", "load_checkpoint"]
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol=None,
+                    arg_params: Optional[Dict[str, ndarray]] = None,
+                    aux_params: Optional[Dict[str, ndarray]] = None,
+                    remove_amp_cast: bool = True) -> None:
+    """reference model.py:189."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    serialization.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_params(prefix: str, epoch: int
+                ) -> Tuple[Dict[str, ndarray], Dict[str, ndarray]]:
+    """reference model.py:221."""
+    save_dict = serialization.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """reference model.py:238 — returns (symbol, arg_params, aux_params);
+    symbol is None if no ``prefix-symbol.json`` exists."""
+    import os
+
+    from .symbol.symbol import Symbol
+
+    sym = None
+    path = f"{prefix}-symbol.json"
+    if os.path.exists(path):
+        with open(path) as f:
+            sym = Symbol.fromjson(f.read())
+    arg_params, aux_params = load_params(prefix, epoch)
+    return sym, arg_params, aux_params

@@ -1,0 +1,157 @@
+"""``mx.operator`` — Python custom operators (reference
+``python/mxnet/operator.py``: ``CustomOp`` :129, ``CustomOpProp`` :236,
+``register`` :786, executed by ``src/operator/custom/custom.cc``).
+
+TPU re-design: the reference runs CustomOps through a dedicated engine
+thread with GIL handoff (custom.cc's CustomOperator queue); here the op's
+``forward``/``backward`` are plain Python over taped ndarrays, glued into
+autograd as a tape node exactly like :class:`mxnet_tpu.autograd.Function`.
+The registry keys ``mx.nd.Custom(..., op_type=name)`` /
+``npx.custom(..., op_type=name)`` calls the same way the reference keys
+its C-callback table. Inside jit traces the op's Python runs at TRACE
+time (it must be expressible in taped ops); data-dependent Python is the
+same limitation the reference had for shape inference.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from .autograd import Function
+from .base import MXNetError
+from .ndarray.ndarray import ndarray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_properties"]
+
+_REGISTRY: Dict[str, Type["CustomOpProp"]] = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference operator.py:129). Implement
+    ``forward``/``backward`` and write results with :meth:`assign`."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst: List, index: int, req: str, src):
+        """reference operator.py:151 — honor the write/add/null req."""
+        if req in ("null", None):
+            return
+        if req == "add":
+            dst[index] = dst[index] + src
+        else:  # "write" / "inplace"
+            dst[index] = src
+
+
+class CustomOpProp:
+    """Describes a custom op (reference operator.py:236): argument lists,
+    shape/type inference, and instance creation."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        return list(out_grad) + list(in_data) + list(out_data)
+
+
+def register(reg_name: str):
+    """Decorator registering a ``CustomOpProp`` subclass under ``reg_name``
+    (reference operator.py:786)."""
+
+    def wrap(prop_cls: Type[CustomOpProp]):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                f"{prop_cls!r} must subclass mx.operator.CustomOpProp")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return wrap
+
+
+def get_properties(op_type: str) -> Type[CustomOpProp]:
+    if op_type not in _REGISTRY:
+        raise MXNetError(
+            f"custom op {op_type!r} is not registered "
+            f"(known: {sorted(_REGISTRY)})")
+    return _REGISTRY[op_type]
+
+
+class _CustomFunction(Function):
+    """Bridges a CustomOp instance into the autograd tape."""
+
+    def __init__(self, op: CustomOp, n_out: int, grad_reqs: List[str]):
+        super().__init__()
+        self._op = op
+        self._n_out = n_out
+        self._grad_reqs = grad_reqs
+
+    def forward(self, *inputs):
+        in_data = list(inputs)
+        # zero-filled outputs let forward() use req="add" semantics too
+        out_data = [None] * self._n_out
+        from .autograd import is_training
+
+        self._op.forward(is_training(), ["write"] * self._n_out,
+                         in_data, out_data, [])
+        self.save_for_backward(tuple(in_data), tuple(out_data))
+        outs = tuple(out_data)
+        return outs[0] if len(outs) == 1 else outs
+
+    def backward(self, *output_grads):
+        in_data, out_data = self.saved_tensors
+        in_grad = [None] * len(in_data)
+        self._op.backward(self._grad_reqs, list(output_grads),
+                          list(in_data), list(out_data), in_grad, [])
+        grads = tuple(
+            g if g is not None else in_data[i] * 0
+            for i, g in enumerate(in_grad))
+        return grads[0] if len(grads) == 1 else grads
+
+
+def invoke(op_type: str, *inputs, **params):
+    """Run a registered custom op eagerly (the ``mx.nd.Custom`` path:
+    reference _ctypes/ndarray.py Custom dispatch → custom.cc)."""
+    prop = get_properties(op_type)(**params)
+    arg_names = prop.list_arguments()
+    if len(inputs) != len(arg_names):
+        raise MXNetError(
+            f"custom op {op_type!r} expects {len(arg_names)} inputs "
+            f"{arg_names}, got {len(inputs)}")
+    in_shapes = [tuple(a.shape) for a in inputs]
+    in_types = [a.dtype for a in inputs]
+    _ins, out_shapes, _aux = prop.infer_shape(list(in_shapes))
+    op = prop.create_operator(None, in_shapes, in_types)
+    fn = _CustomFunction(op, len(out_shapes),
+                         ["write"] * len(arg_names))
+    return fn(*[a if isinstance(a, ndarray) else a for a in inputs])
+
+
+class Custom:
+    """``mx.nd.Custom(*data, op_type=...)`` compatibility callable."""
+
+    def __new__(cls, *inputs, op_type=None, **params):
+        if op_type is None:
+            raise MXNetError("Custom requires op_type=")
+        return invoke(op_type, *inputs, **params)

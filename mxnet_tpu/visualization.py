@@ -1,0 +1,100 @@
+"""``mx.viz`` — network summaries (reference
+``python/mxnet/visualization.py``: ``print_summary`` :46,
+``plot_network`` :210).
+
+``print_summary`` walks a :class:`mxnet_tpu.symbol.Symbol` graph in
+topological order and prints the reference's table (layer, output shape,
+params, previous layers) plus the total parameter count.
+``plot_network`` emits a graphviz Digraph when the optional ``graphviz``
+package is importable and raises a clear error otherwise (it is not in
+the baked image; the summary table is the supported path).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape: Optional[Dict[str, tuple]] = None,
+                  line_length: int = 98, positions=(.44, .64, .74, 1.)):
+    """Print a per-node summary table of a Symbol (reference :46)."""
+    from .symbol.symbol import Symbol, _topo
+
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("print_summary expects a Symbol; for Gluon blocks "
+                         "use block.summary()/collect_params()")
+    shape = shape or {}
+    shapes = {}
+    if shape:
+        arg_shapes, out_shapes, _ = symbol.infer_shape(**shape)
+        shapes = dict(zip(symbol.list_arguments(), arg_shapes))
+        for name, s in zip(symbol.list_outputs(), out_shapes):
+            shapes[name] = s
+
+    positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line = (line + str(f))[: pos - 1].ljust(pos)
+        print(line)
+
+    print("_" * line_length)
+    print_row(headers)
+    print("=" * line_length)
+
+    nodes = _topo(symbol._heads)
+    total_params = 0
+    arg_shape_by_name = shapes
+    for node in nodes:
+        prevs = [p.name for p, _ in getattr(node, "inputs", [])]
+        out_shape = ""
+        nparams = 0
+        if node.op is None:  # variable node
+            s = arg_shape_by_name.get(node.name)
+            out_shape = str(s) if s is not None else ""
+            if s is not None and not node.name.endswith(
+                    ("data", "label", "softmax_label")):
+                n = 1
+                for d in s:
+                    n *= d
+                nparams = n
+        total_params += nparams
+        print_row([f"{node.name} ({node.op or 'Variable'})",
+                   out_shape, nparams, ",".join(prevs)])
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz rendering (reference :210). Requires the optional
+    ``graphviz`` package; not available in this image — gate, don't stub
+    silently."""
+    try:
+        import graphviz  # noqa: F401
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network requires the optional 'graphviz' package; "
+            "use print_summary for a text rendering") from e
+    from graphviz import Digraph
+
+    from .symbol.symbol import Symbol, _topo
+
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("plot_network expects a Symbol")
+    dot = Digraph(name=title, format=save_format)
+    for node in _topo(symbol._heads):
+        label = f"{node.name}\n{node.op or 'Variable'}"
+        dot.node(node.name, label=label, **(node_attrs or {}))
+        for p, _ in getattr(node, "inputs", []):
+            if hide_weights and p.op is None and p.name != "data":
+                continue
+            dot.edge(p.name, node.name)
+    return dot

@@ -1,0 +1,154 @@
+"""mx.operator (CustomOp), mx.visualization, mx.callback, mx.model,
+mx.nd legacy delegation (reference python/mxnet/{operator,visualization,
+callback,model}.py)."""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+# -- mx.operator CustomOp ---------------------------------------------------
+
+@mx.operator.register("scaled_square")
+class ScaledSquareProp(mx.operator.CustomOpProp):
+    def __init__(self, scale=2.0):
+        super().__init__(need_top_grad=True)
+        self._scale = float(scale)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        scale = self._scale
+
+        class Op(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data, 0, req[0], scale * in_data[0] ** 2)
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad, 0, req[0],
+                            2.0 * scale * in_data[0] * out_grad[0])
+
+        return Op()
+
+
+def test_custom_op_forward_oracle():
+    x = mx.np.array(onp.array([1.0, -2.0, 3.0], onp.float32))
+    y = mx.nd.Custom(x, op_type="scaled_square", scale=3.0)
+    onp.testing.assert_allclose(onp.asarray(y), 3.0 * onp.array([1, 4, 9]),
+                                rtol=1e-6)
+
+
+def test_custom_op_backward_through_tape():
+    x = mx.np.array(onp.array([1.0, -2.0, 3.0], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="scaled_square")
+        loss = y.sum()
+    loss.backward()
+    onp.testing.assert_allclose(onp.asarray(x.grad),
+                                4.0 * onp.asarray(x), rtol=1e-6)
+
+
+def test_custom_op_unknown_name_raises():
+    with pytest.raises(mx.base.MXNetError, match="not registered"):
+        mx.nd.Custom(mx.np.ones((2,)), op_type="nope")
+
+
+def test_custom_op_composes_with_builtin_grad():
+    x = mx.np.array(onp.array([0.5, 1.5], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.np.exp(mx.nd.Custom(x, op_type="scaled_square"))
+        loss = y.sum()
+    loss.backward()
+    ref = onp.exp(2 * onp.asarray(x) ** 2) * 4 * onp.asarray(x)
+    onp.testing.assert_allclose(onp.asarray(x.grad), ref, rtol=1e-5)
+
+
+# -- mx.visualization -------------------------------------------------------
+
+def test_print_summary_counts_params(capsys):
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("fc_weight", shape=(8, 16))
+    b = mx.sym.Variable("fc_bias", shape=(8,))
+    out = mx.sym.FullyConnected(data, w, b, num_hidden=8)
+    total = mx.viz.print_summary(out, shape={"data": (4, 16)})
+    printed = capsys.readouterr().out
+    assert "Total params:" in printed
+    assert total == 16 * 8 + 8  # weight + bias
+
+
+def test_plot_network_gated_without_graphviz():
+    data = mx.sym.Variable("data")
+    out = data + 1.0
+    try:
+        import graphviz  # noqa: F401
+
+        dot = mx.viz.plot_network(out)
+        assert dot is not None
+    except ImportError:
+        with pytest.raises(mx.base.MXNetError, match="graphviz"):
+            mx.viz.plot_network(out)
+
+
+# -- mx.callback + mx.model -------------------------------------------------
+
+def test_speedometer_logs(caplog):
+    from mxnet_tpu.gluon import metric as metric_mod
+
+    m = metric_mod.Accuracy()
+    m.update(mx.np.array([0, 1]), mx.np.array([[0.9, 0.1], [0.2, 0.8]]))
+    speedo = mx.callback.Speedometer(batch_size=32, frequent=2)
+    with caplog.at_level(logging.INFO):
+        for nbatch in range(1, 5):
+            speedo(mx.callback.BatchEndParam(epoch=0, nbatch=nbatch,
+                                             eval_metric=m, locals=None))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "ck")
+    data = mx.sym.Variable("data")
+    out = data * 2.0
+    arg = {"w": mx.np.array(onp.arange(6, dtype=onp.float32).reshape(2, 3))}
+    aux = {"m": mx.np.zeros((3,))}
+    mx.model.save_checkpoint(prefix, 3, out, arg, aux)
+    sym, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    assert sym is not None
+    onp.testing.assert_allclose(onp.asarray(arg2["w"]),
+                                onp.asarray(arg["w"]))
+    assert set(aux2) == {"m"}
+
+
+def test_do_checkpoint_period(tmp_path):
+    prefix = str(tmp_path / "p")
+    cb = mx.callback.do_checkpoint(prefix, period=2)
+    arg = {"w": mx.np.ones((2,))}
+    for epoch in range(4):
+        cb(epoch, None, arg, {})
+    import os
+
+    files = sorted(os.listdir(tmp_path))
+    assert any("0002" in f for f in files)
+    assert any("0004" in f for f in files)
+    assert not any("0001" in f for f in files)
+
+
+# -- mx.nd legacy delegation ------------------------------------------------
+
+def test_nd_delegates_to_np():
+    a = mx.nd.arange(6).reshape(2, 3)
+    b = mx.nd.concatenate([a, a], axis=0) if hasattr(mx.nd, "concatenate") \
+        else mx.nd.concat(a, a, dim=0)
+    assert b.shape[0] == 4
+    s = mx.nd.sum(a)
+    assert float(s) == 15.0
+    with pytest.raises(AttributeError):
+        mx.nd.definitely_not_an_op  # noqa: B018
+
+
+def test_lr_scheduler_alias():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    assert sched(0) > sched(25)
