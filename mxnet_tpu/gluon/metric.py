@@ -305,3 +305,132 @@ class Loss(EvalMetric):
             loss = _to_np(pred)
             self.sum_metric += float(loss.sum())
             self.num_inst += loss.size
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """reference metric.py BinaryAccuracy: thresholded probability vs
+    binary label."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kw):
+        self.threshold = threshold
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = (_to_np(pred).ravel() > self.threshold).astype("int64")
+            label = _to_np(label).ravel().astype("int64")
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += label.size
+
+
+@register
+class Fbeta(F1):
+    """reference metric.py Fbeta: F-score with recall weighted beta^2."""
+
+    def __init__(self, name="fbeta", beta=1.0, **kw):
+        self.beta = float(beta)
+        super().__init__(name, **kw)
+
+    def get(self):
+        prec = self._tp / max(self._tp + self._fp, 1e-12)
+        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        b2 = self.beta * self.beta
+        fbeta = ((1 + b2) * prec * rec) / max(b2 * prec + rec, 1e-12)
+        return self.name, fbeta if self.num_inst else float("nan")
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """reference metric.py MeanCosineSimilarity along the last axis."""
+
+    def __init__(self, name="cos_sim", eps=1e-12, **kw):
+        self.eps = eps
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _to_np(label), _to_np(pred)
+            if label.ndim == 1:
+                label, pred = label[None], pred[None]
+            num = (label * pred).sum(axis=-1)
+            den = (onp.linalg.norm(label, axis=-1)
+                   * onp.linalg.norm(pred, axis=-1))
+            sim = num / onp.maximum(den, self.eps)
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """reference metric.py MeanPairwiseDistance: mean L-p distance along
+    the last axis."""
+
+    def __init__(self, name="mpd", p=2, **kw):
+        self.p = p
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _to_np(label), _to_np(pred)
+            if label.ndim == 1:
+                label, pred = label[None], pred[None]
+            d = (onp.abs(label - pred) ** self.p).sum(axis=-1) ** (1.0 / self.p)
+            self.sum_metric += float(d.sum())
+            self.num_inst += d.size
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation via a running confusion matrix
+    (reference metric.py PCC :1703; reduces to MCC for k=2)."""
+
+    def __init__(self, name="pcc", **kw):
+        self.k = 2
+        super().__init__(name, **kw)
+
+    def reset(self):
+        self.lcm = onp.zeros((getattr(self, "k", 2), getattr(self, "k", 2)),
+                             dtype="float64")
+        super().reset()
+
+    def _grow(self, inc):
+        self.lcm = onp.pad(self.lcm, ((0, inc), (0, inc)), "constant")
+        self.k += inc
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel().astype("int64")
+            pred = _to_np(pred)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = onp.argmax(pred, axis=-1)
+            else:
+                pred = (pred.ravel() > 0.5)
+            pred = pred.ravel().astype("int64")
+            n = int(max(pred.max(initial=0), label.max(initial=0)))
+            if n >= self.k:
+                self._grow(n + 1 - self.k)
+            bcm = onp.zeros((self.k, self.k), dtype="float64")
+            onp.add.at(bcm, (pred, label), 1.0)
+            self.lcm += bcm
+        self.num_inst += 1
+
+    def get(self):
+        cmat = self.lcm
+        n = cmat.sum()
+        if not n or not self.num_inst:
+            return self.name, float("nan")
+        x = cmat.sum(axis=1)
+        y = cmat.sum(axis=0)
+        cov_xx = onp.sum(x * (n - x))
+        cov_yy = onp.sum(y * (n - y))
+        if cov_xx == 0 or cov_yy == 0:
+            return self.name, float("nan")
+        i = cmat[onp.arange(self.k), onp.arange(self.k)]
+        cov_xy = onp.sum(i * n - x * y)
+        return self.name, float(cov_xy / (cov_xx * cov_yy) ** 0.5)
+
+
+# reference metric.py aliases: Torch/Caffe are Loss under other names
+Torch = Loss
+Caffe = Loss

@@ -152,3 +152,67 @@ def test_nd_delegates_to_np():
 def test_lr_scheduler_alias():
     sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
     assert sched(0) > sched(25)
+
+
+# -- new metrics (reference gluon/metric.py tail) ---------------------------
+
+def test_binary_accuracy():
+    from mxnet_tpu.gluon import metric as M
+
+    m = M.BinaryAccuracy(threshold=0.4)
+    m.update(mx.np.array([1, 0, 1, 0]), mx.np.array([0.9, 0.1, 0.3, 0.7]))
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_fbeta_matches_manual():
+    from mxnet_tpu.gluon import metric as M
+
+    m = M.Fbeta(beta=2.0)
+    labels = mx.np.array([1, 1, 0, 0, 1])
+    preds = mx.np.array([0.9, 0.2, 0.8, 0.1, 0.6])  # pred: 1,0,1,0,1
+    m.update(labels, preds)
+    tp, fp, fn = 2.0, 1.0, 1.0
+    prec, rec = tp / (tp + fp), tp / (tp + fn)
+    b2 = 4.0
+    ref = (1 + b2) * prec * rec / (b2 * prec + rec)
+    assert m.get()[1] == pytest.approx(ref)
+
+
+def test_mean_cosine_and_pairwise():
+    from mxnet_tpu.gluon import metric as M
+
+    a = onp.random.randn(4, 8).astype(onp.float32)
+    b = onp.random.randn(4, 8).astype(onp.float32)
+    cs = M.MeanCosineSimilarity()
+    cs.update(mx.np.array(a), mx.np.array(b))
+    ref = onp.mean([a[i] @ b[i] / (onp.linalg.norm(a[i]) * onp.linalg.norm(b[i]))
+                    for i in range(4)])
+    assert cs.get()[1] == pytest.approx(ref, rel=1e-5)
+    pd = M.MeanPairwiseDistance()
+    pd.update(mx.np.array(a), mx.np.array(b))
+    refd = onp.mean(onp.linalg.norm(a - b, axis=-1))
+    assert pd.get()[1] == pytest.approx(refd, rel=1e-5)
+
+
+def test_pcc_reduces_to_mcc_binary():
+    from mxnet_tpu.gluon import metric as M
+
+    rng = onp.random.RandomState(0)
+    labels = rng.randint(0, 2, 200)
+    preds = rng.uniform(0, 1, 200)
+    pcc = M.PCC()
+    mcc = M.MCC()
+    pcc.update(mx.np.array(labels), mx.np.array(preds))
+    mcc.update(mx.np.array(labels), mx.np.array(preds))
+    assert pcc.get()[1] == pytest.approx(mcc.get()[1], abs=1e-9)
+
+
+def test_pcc_multiclass_grows():
+    from mxnet_tpu.gluon import metric as M
+
+    pcc = M.PCC()
+    labels = mx.np.array([0, 1, 2, 3, 3])
+    preds = mx.np.array(onp.eye(4, dtype=onp.float32)[[0, 1, 2, 3, 2]])
+    pcc.update(labels, preds)
+    assert pcc.k == 4
+    assert 0.0 < pcc.get()[1] <= 1.0
