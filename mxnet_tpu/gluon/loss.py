@@ -325,3 +325,34 @@ class CosineEmbeddingLoss(Loss):
             label == 1, 1.0 - cos, np.maximum(np.zeros_like(cos), cos - self._margin)
         )
         return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class SDMLLoss(Loss):
+    """Smoothed Deep Metric Learning loss (reference loss.py:934,
+    Bonadiman et al. 2019): each row of ``x2`` is the positive for the
+    same row of ``x1``; the rest of the minibatch acts as negatives. KL
+    between the softmax of negative pairwise distances and a smoothed
+    identity label matrix."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self.kl_loss = KLDivLoss(from_logits=True)
+        self.smoothing_parameter = smoothing_parameter
+
+    def _compute_distances(self, x1, x2):
+        x1_ = np.expand_dims(x1, 1)
+        x2_ = np.expand_dims(x2, 0)
+        return np.sum((x1_ - x2_) ** 2, axis=2)
+
+    def _compute_labels(self, batch_size):
+        gold = np.eye(batch_size)
+        p = self.smoothing_parameter
+        return gold * (1 - p) + (1 - gold) * p / (batch_size - 1)
+
+    def forward(self, x1, x2):
+        batch_size = x1.shape[0]
+        labels = self._compute_labels(batch_size)
+        distances = self._compute_distances(x1, x2)
+        log_probabilities = npx.log_softmax(-distances, axis=1)
+        # kl_loss averages over the row; scale back (reference :1042)
+        return self.kl_loss(log_probabilities, labels) * batch_size

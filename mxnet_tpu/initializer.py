@@ -6,6 +6,7 @@ from the functional PRNG via mx.np.random.
 """
 from __future__ import annotations
 
+import logging
 import math
 from typing import Optional
 
@@ -237,3 +238,70 @@ class LSTMBias(Initializer):
         num_hidden = int(arr.shape[0] / 4)
         b[num_hidden : 2 * num_hidden] = self.forget_bias
         arr._set_data(jnp.asarray(b, arr.dtype))
+
+
+class Load(Initializer):
+    """Initialize from a ``.params`` file or name->array dict with a
+    fallback initializer (reference initializer.py:316); ``arg:``/``aux:``
+    prefixes are dropped like the reference."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        if isinstance(param, str):
+            from .serialization import load as _load
+
+            param = _load(param)
+        self.param = {}
+        for name, arr in param.items():
+            key = name[4:] if name.startswith(("arg:", "aux:")) else name
+            self.param[key] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def init_array(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"Load: parameter {name!r} has shape {tuple(arr.shape)} "
+                    f"but the source array is {tuple(src.shape)}")
+            arr._set_data(jnp.asarray(
+                src.asnumpy() if hasattr(src, "asnumpy") else src,
+                dtype=arr.dtype))
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise MXNetError(
+                    f"Load: no initialization for {name!r} and no "
+                    "default_init given")
+            if isinstance(self.default_init, Initializer):
+                self.default_init.init_array(name, arr)
+            else:
+                self.default_init(name, arr)
+
+
+class Mixed(Initializer):
+    """Route parameters to initializers by regex pattern (reference
+    initializer.py:363). Patterns are tried in order; first match wins."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise MXNetError("Mixed: len(patterns) != len(initializers)")
+        import re
+
+        self.map = [(re.compile(p), init)
+                    for p, init in zip(patterns, initializers)]
+
+    def init_array(self, name, arr):
+        for prog, init in self.map:
+            if prog.search(name):
+                if isinstance(init, Initializer):
+                    init.init_array(name, arr)
+                else:
+                    init(name, arr)
+                return
+        raise MXNetError(
+            f"Mixed: parameter {name!r} did not match any pattern; add a "
+            "'.*' catch-all as the last pattern")

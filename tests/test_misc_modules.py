@@ -216,3 +216,58 @@ def test_pcc_multiclass_grows():
     pcc.update(labels, preds)
     assert pcc.k == 4
     assert 0.0 < pcc.get()[1] <= 1.0
+
+
+# -- SDMLLoss + Load/Mixed initializers -------------------------------------
+
+def test_sdml_loss_decreases_for_aligned_batches():
+    from mxnet_tpu.gluon.loss import SDMLLoss
+
+    loss_fn = SDMLLoss(smoothing_parameter=0.1)
+    rng = onp.random.RandomState(0)
+    base = rng.randn(6, 16).astype(onp.float32)
+    aligned = mx.np.array(base), mx.np.array(
+        (base + 0.01 * rng.randn(6, 16)).astype(onp.float32))
+    shuffled = mx.np.array(base), mx.np.array(
+        base[::-1].copy())
+    l_aligned = float(loss_fn(*aligned).mean())
+    l_shuffled = float(loss_fn(*shuffled).mean())
+    assert l_aligned < l_shuffled
+
+
+def test_sdml_loss_grad_flows():
+    from mxnet_tpu.gluon.loss import SDMLLoss
+
+    x1 = mx.np.array(onp.random.randn(4, 8).astype(onp.float32))
+    x2 = mx.np.array(onp.random.randn(4, 8).astype(onp.float32))
+    x1.attach_grad()
+    with autograd.record():
+        loss = SDMLLoss()(x1, x2).mean()
+    loss.backward()
+    assert float(mx.np.abs(x1.grad).sum()) > 0
+
+
+def test_mixed_initializer_routes_by_pattern():
+    from mxnet_tpu.gluon import nn
+
+    # param-level initializers (Dense's bias_initializer) take precedence
+    # over the block-level init, as in the reference — route the weight,
+    # whose param-level init is unset
+    net = nn.Dense(4, in_units=3, use_bias=True)
+    net.initialize(mx.init.Mixed([".*weight.*", ".*"],
+                                 [mx.init.Constant(7.0),
+                                  mx.init.Uniform(0.1)]))
+    assert (onp.asarray(net.weight.data()) == 7.0).all()
+    assert (onp.asarray(net.bias.data()) == 0.0).all()
+
+
+def test_load_initializer_roundtrip(tmp_path):
+    from mxnet_tpu.gluon import nn
+
+    src = nn.Dense(4, in_units=3)
+    src.initialize(mx.init.Xavier())
+    params = {"arg:weight": src.weight.data(), "arg:bias": src.bias.data()}
+    dst = nn.Dense(4, in_units=3)
+    dst.initialize(mx.init.Load(params, default_init=mx.init.Zero()))
+    onp.testing.assert_allclose(onp.asarray(dst.weight.data()),
+                                onp.asarray(src.weight.data()))
