@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Single-image super-resolution (sub-pixel CNN) entry point.
+
+Parity target: reference ``example/gluon/super_resolution/`` — the
+ESPCN-style net (Shi et al. 2016): conv stack in low-resolution space,
+then ``PixelShuffle2D`` rearranges channels into the upscaled image. The
+shuffle is where TPU wins: it is pure reshape/transpose, so XLA fuses it
+with the final conv instead of launching a separate kernel.
+
+Offline-friendly: trains on procedurally generated band-limited images
+(smooth random Fourier mixtures), where bicubic-beating PSNR is
+achievable in a couple of epochs.
+
+Example:
+    python example/gluon/super_resolution.py --epochs 2 --upscale 3
+"""
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--upscale", type=int, default=3)
+    p.add_argument("--size", type=int, default=24,
+                   help="low-resolution patch size")
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--num-train", type=int, default=256)
+    p.add_argument("--num-val", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args()
+
+
+def band_limited_images(n, hr_size, seed):
+    """Smooth random images: sums of a few low-frequency 2-D cosines."""
+    rng = onp.random.RandomState(seed)
+    ys, xs = onp.mgrid[0:hr_size, 0:hr_size].astype(onp.float32) / hr_size
+    imgs = onp.zeros((n, 1, hr_size, hr_size), onp.float32)
+    for i in range(n):
+        img = onp.zeros((hr_size, hr_size), onp.float32)
+        for _ in range(6):
+            fy, fx = rng.randint(1, 9, 2)
+            phase = rng.uniform(0, 2 * onp.pi, 2)
+            img += rng.uniform(0.2, 1.0) * (
+                onp.cos(2 * onp.pi * fy * ys + phase[0])
+                * onp.cos(2 * onp.pi * fx * xs + phase[1]))
+        img = (img - img.min()) / (onp.ptp(img) + 1e-9)
+        imgs[i, 0] = img
+    return imgs
+
+
+def downsample(hr, factor):
+    """Box-filter downsample (the degradation model)."""
+    n, c, H, W = hr.shape
+    return hr.reshape(n, c, H // factor, factor,
+                      W // factor, factor).mean(axis=(3, 5))
+
+
+def psnr(a, b):
+    mse = float(onp.mean((a - b) ** 2))
+    return 10 * math.log10(1.0 / max(mse, 1e-12))
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import contrib, nn
+
+    f = args.upscale
+    hr_size = args.size * f
+    hr_train = band_limited_images(args.num_train, hr_size, seed=0)
+    hr_val = band_limited_images(args.num_val, hr_size, seed=1)
+    lr_train = downsample(hr_train, f)
+    lr_val = downsample(hr_val, f)
+
+    net = nn.HybridSequential(
+        nn.Conv2D(64, kernel_size=5, padding=2, activation="relu"),
+        nn.Conv2D(64, kernel_size=3, padding=1, activation="relu"),
+        nn.Conv2D(32, kernel_size=3, padding=1, activation="relu"),
+        nn.Conv2D(f * f, kernel_size=3, padding=1),
+        contrib.nn.PixelShuffle2D(f),
+    )
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.L2Loss()
+
+    n = len(lr_train)
+    for epoch in range(args.epochs):
+        perm = onp.random.RandomState(epoch).permutation(n)
+        tot, t0 = 0.0, time.time()
+        for i in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[i: i + args.batch_size]
+            x = mx.np.array(lr_train[idx])
+            y = mx.np.array(hr_train[idx])
+            with autograd.record():
+                loss = loss_fn(net(x), y).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss)
+        out = net(mx.np.array(lr_val)).asnumpy()
+        val_psnr = psnr(out, hr_val)
+        # baseline: nearest-neighbour upsampling of the LR input
+        nn_up = onp.repeat(onp.repeat(lr_val, f, axis=2), f, axis=3)
+        base_psnr = psnr(nn_up, hr_val)
+        print(f"epoch {epoch}: train_loss={tot:.4f} "
+              f"val_psnr={val_psnr:.2f}dB baseline_psnr={base_psnr:.2f}dB "
+              f"({time.time() - t0:.1f}s)", flush=True)
+    print(f"final: psnr={val_psnr:.2f} baseline={base_psnr:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,18 @@ jax.config.update("jax_enable_x64", True)
 # choice via bf16 dtypes / AMP, never an implicit downcast of f32 matmuls.
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Persistent XLA compilation cache (docs/env_var.md): first TPU compile of
+# a big model is tens of seconds; a cache dir survives process restarts
+# (the reference's analogous knob is the NVRTC fusion src->PTX cache,
+# fused_op.cu:599). Off by default — set MXNET_COMPILE_CACHE=/path.
+_cache_dir = os.environ.get("MXNET_COMPILE_CACHE", "")
+if _cache_dir:
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - older jax without the knob
+        pass
+
 try:  # ml_dtypes ships with jax
     import ml_dtypes
 

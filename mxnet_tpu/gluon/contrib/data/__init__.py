@@ -1,0 +1,9 @@
+"""``gluon.contrib.data`` (reference
+``python/mxnet/gluon/contrib/data/``): the contrib sampler lives in the
+main sampler module here; re-exported for reference import-path parity.
+Text datasets (WikiText2/WikiText103) require downloads and are not
+bundled — use ``gluon.data`` vision datasets or bring-your-own corpus
+(example/gluon/word_language_model.py shows the synthetic path)."""
+from ...data.sampler import IntervalSampler  # noqa: F401
+
+__all__ = ["IntervalSampler"]

@@ -46,3 +46,13 @@ def test_word_language_model_entry_point():
     assert "final: val_ppl=" in out.stdout
     ppl = float(out.stdout.rsplit("val_ppl=", 1)[1].split()[0])
     assert ppl < 64, f"LM learned nothing: ppl {ppl} vs uniform 64"
+
+
+@pytest.mark.integration
+def test_super_resolution_entry_point():
+    out = _run("example/gluon/super_resolution.py", "--epochs", "6")
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.rsplit("final:", 1)[1]
+    psnr = float(line.split("psnr=")[1].split()[0])
+    base = float(line.split("baseline=")[1].split()[0])
+    assert psnr > base, f"SR net ({psnr}dB) must beat NN upsampling ({base}dB)"
