@@ -5,5 +5,7 @@ from .datasets import (  # noqa: F401
     CIFAR100,
     FashionMNIST,
     ImageFolderDataset,
+    ImageListDataset,
+    ImageRecordDataset,
     MNIST,
 )

@@ -21,7 +21,8 @@ import numpy as onp
 from ....base import MXNetError, env_bool, env_str
 from .. import dataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset"]
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset",
+           "ImageRecordDataset", "ImageListDataset"]
 
 
 def _data_root(root: Optional[str]) -> str:
@@ -214,4 +215,69 @@ class ImageFolderDataset(dataset.Dataset):
             img = onp.asarray(Image.open(fname).convert("RGB" if self._flag else "L"))
         if self._transform is not None:
             return self._transform(img, label)
+        return img, label
+
+
+class ImageRecordDataset(dataset.RecordFileDataset):
+    """Dataset over an image ``.rec`` file (reference vision/datasets.py
+    ImageRecordDataset:238): each record unpacks to (image, label) via the
+    IRHeader wire format the C++ reader/im2rec produce."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        if transform is not None:
+            raise MXNetError(
+                "transform= is deprecated in the reference; use "
+                "dataset.transform() / transform_first()")
+        self._flag = flag
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack_img
+
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, iscolor=self._flag)
+        label = header.label
+        if hasattr(label, "__len__") and len(label) == 1:
+            label = label[0]
+        return img, label
+
+
+class ImageListDataset(dataset.Dataset):
+    """Dataset over an im2rec-style ``.lst`` list (reference
+    vision/datasets.py ImageListDataset:365): rows of
+    ``index\\tlabel(s)\\trelpath`` or an in-memory ``[label, path]`` list."""
+
+    def __init__(self, root=".", imglist=None, flag=1):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self.items = []
+        if isinstance(imglist, str):
+            with open(os.path.join(self._root, imglist)) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    label = ([float(v) for v in parts[1:-1]]
+                             if len(parts) > 3 else float(parts[1]))
+                    self.items.append((os.path.join(self._root, parts[-1]),
+                                       label))
+        elif imglist is not None:
+            for entry in imglist:
+                label, path = entry[0], entry[-1]
+                self.items.append((os.path.join(self._root, path), label))
+        else:
+            raise MXNetError("ImageListDataset requires imglist")
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        fname, label = self.items[idx]
+        if fname.endswith(".npy"):
+            img = onp.load(fname)
+        else:
+            from PIL import Image
+
+            img = onp.asarray(
+                Image.open(fname).convert("RGB" if self._flag else "L"))
         return img, label

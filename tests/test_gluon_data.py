@@ -1,4 +1,5 @@
 """Data pipeline tests (reference tests/python/unittest/test_gluon_data.py)."""
+import os
 import numpy as onp
 import pytest
 
@@ -138,3 +139,46 @@ def test_logistic_loss_stable():
     big = np.array([[100.0]])
     out = l(big, np.array([[1.0]]))
     assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_image_record_and_list_datasets(tmp_path):
+    """ImageRecordDataset over an im2rec-written .rec + ImageListDataset
+    over the matching .lst (reference vision/datasets.py:238/:365)."""
+    import subprocess
+    import sys
+
+    from PIL import Image
+
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = onp.random.RandomState(hash(cls) % 100 + i).randint(
+                0, 255, (8, 8, 3)).astype("uint8")
+            Image.fromarray(arr).save(root / cls / f"{i}.png")
+    prefix = tmp_path / "data"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+         str(prefix), str(root), "--list", "--recursive"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+         str(prefix), str(root), "--recursive"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+
+    from mxnet_tpu.gluon.data.vision import (ImageListDataset,
+                                             ImageRecordDataset)
+
+    rec_ds = ImageRecordDataset(str(prefix) + ".rec")
+    assert len(rec_ds) == 6
+    img, label = rec_ds[0]
+    assert img.shape[-1] == 3 and label in (0.0, 1.0)
+
+    lst_ds = ImageListDataset(root=str(root), imglist="../data.lst")
+    assert len(lst_ds) == 6
+    img2, label2 = lst_ds[0]
+    assert img2.shape[-1] == 3
