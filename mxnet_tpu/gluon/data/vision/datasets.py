@@ -183,6 +183,15 @@ class CIFAR100(CIFAR10):
             raise MXNetError(f"CIFAR-100 not found under {self._root} (no egress to download)")
 
 
+def _load_image(fname, flag):
+    """Load an image file as ndarray; flag=1 -> RGB, 0 -> grayscale."""
+    if fname.endswith(".npy"):
+        return onp.load(fname)
+    from PIL import Image
+
+    return onp.asarray(Image.open(fname).convert("RGB" if flag else "L"))
+
+
 class ImageFolderDataset(dataset.Dataset):
     """reference vision/datasets.py ImageFolderDataset: root/class/*.jpg"""
 
@@ -207,12 +216,7 @@ class ImageFolderDataset(dataset.Dataset):
 
     def __getitem__(self, idx):
         fname, label = self.items[idx]
-        if fname.endswith(".npy"):
-            img = onp.load(fname)
-        else:
-            from PIL import Image
-
-            img = onp.asarray(Image.open(fname).convert("RGB" if self._flag else "L"))
+        img = _load_image(fname, self._flag)
         if self._transform is not None:
             return self._transform(img, label)
         return img, label
@@ -273,11 +277,4 @@ class ImageListDataset(dataset.Dataset):
 
     def __getitem__(self, idx):
         fname, label = self.items[idx]
-        if fname.endswith(".npy"):
-            img = onp.load(fname)
-        else:
-            from PIL import Image
-
-            img = onp.asarray(
-                Image.open(fname).convert("RGB" if self._flag else "L"))
-        return img, label
+        return _load_image(fname, self._flag), label

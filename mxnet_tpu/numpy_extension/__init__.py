@@ -778,3 +778,13 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
         return fn(d, l, dl, ll_)
 
     return _call(dispatch, arrays + extra, name="ctc_loss")
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    return _call(_contrib.index_copy, (old_tensor, index_vector, new_tensor),
+                 name="index_copy")
+
+
+def gradientmultiplier(data, scalar=1.0):
+    return _call(lambda d: _contrib.gradientmultiplier(d, scalar), (data,),
+                 name="gradientmultiplier")

@@ -23,7 +23,7 @@ __all__ = [
     "adaptive_avg_pool2d", "sync_batch_norm", "box_iou", "box_nms",
     "bipartite_matching", "allclose", "index_array", "multibox_prior",
     "deformable_convolution", "modulated_deformable_convolution",
-    "hawkes_ll",
+    "hawkes_ll", "index_copy", "gradientmultiplier",
 ]
 
 
@@ -495,3 +495,49 @@ def hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
         return ll - jnp.sum(rem), s * ed
 
     return jax.vmap(one_seq)(mu, state0, lags, marks, valid_length, max_time)
+
+
+# ---------------------------------------------------------------------------
+# index_copy + gradient multiplier (reference contrib/index_copy.cc,
+# contrib/gradient_multiplier_op.cc)
+# ---------------------------------------------------------------------------
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Out-of-place copy of ``new_tensor`` rows into ``old_tensor`` at
+    ``index_vector`` positions (reference contrib/index_copy.cc); one XLA
+    scatter, differentiable w.r.t. both tensors. Out-of-range indices
+    error eagerly like the reference; inside a trace XLA's scatter OOB
+    rule (drop) applies, as concrete values are unavailable."""
+    old = jnp.asarray(old_tensor)
+    idx = jnp.asarray(index_vector).astype(jnp.int32)
+    new = jnp.asarray(new_tensor)
+    if not isinstance(idx, jax.core.Tracer):
+        idx_np = onp.asarray(idx)
+        n = old.shape[0]
+        if idx_np.size and (idx_np.min() < 0 or idx_np.max() >= n):
+            raise MXNetError(
+                f"index_copy: index out of range for first axis of size "
+                f"{n}: {idx_np[(idx_np < 0) | (idx_np >= n)][:5]}")
+    return old.at[idx].set(new)
+
+
+@jax.custom_vjp
+def _gradmul(data, scalar):
+    return data
+
+
+def _gradmul_fwd(data, scalar):
+    return data, scalar
+
+
+def _gradmul_bwd(scalar, g):
+    return (g * scalar, None)
+
+
+_gradmul.defvjp(_gradmul_fwd, _gradmul_bwd)
+
+
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward; backward scales the gradient by ``scalar``
+    (reference contrib/gradient_multiplier_op.cc:73-90 — negative scalar
+    gives the DANN gradient-reversal layer)."""
+    return _gradmul(jnp.asarray(data), jnp.asarray(scalar, jnp.float32))

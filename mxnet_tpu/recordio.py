@@ -228,20 +228,33 @@ def pack_img(header: IRHeader, img: onp.ndarray, quality: int = 95, img_fmt: str
 
 
 def unpack_img(s: bytes, iscolor=-1):
+    """Unpack a record into (header, image). ``iscolor`` follows the
+    reference/cv2 convention: 1 forces 3-channel RGB, 0 forces grayscale,
+    -1 decodes as stored."""
     header, payload = unpack(s)
-    img = _decode_image(payload)
+    img = _decode_image(payload, iscolor)
     return header, img
 
 
-def _decode_image(payload: bytes) -> onp.ndarray:
+def _decode_image(payload: bytes, iscolor: int = -1) -> onp.ndarray:
     import io as _io
 
     if payload[:6] == b"\x93NUMPY":
-        return onp.load(_io.BytesIO(payload))
+        img = onp.load(_io.BytesIO(payload))
+        if iscolor == 0 and img.ndim == 3:
+            img = img.mean(axis=-1).astype(img.dtype)
+        elif iscolor == 1 and img.ndim == 2:
+            img = onp.repeat(img[..., None], 3, axis=-1)
+        return img
     try:  # JPEG/PNG via PIL if available
         from PIL import Image
 
-        return onp.asarray(Image.open(_io.BytesIO(payload)))
+        im = Image.open(_io.BytesIO(payload))
+        if iscolor == 1:
+            im = im.convert("RGB")
+        elif iscolor == 0:
+            im = im.convert("L")
+        return onp.asarray(im)
     except Exception as e:
         raise MXNetError(
             "cannot decode image payload (not npy; PIL unavailable or failed)"

@@ -410,3 +410,41 @@ def test_hawkes_ll_grad_flows():
     assert float(mx.np.abs(mu.grad).sum()) > 0
     assert float(mx.np.abs(alpha.grad).sum()) > 0
     assert float(mx.np.abs(beta.grad).sum()) > 0
+
+
+def test_index_copy_oracle_and_grad():
+    old = mx.np.array(onp.zeros((5, 3), onp.float32))
+    new = mx.np.array(onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    idx = mx.np.array(onp.array([1, 3], onp.int32))
+    old.attach_grad(); new.attach_grad()
+    with autograd.record():
+        out = mx.npx.index_copy(old, idx, new)
+        loss = (out * out).sum()
+    loss.backward()
+    ref = onp.zeros((5, 3), onp.float32)
+    ref[[1, 3]] = onp.arange(6).reshape(2, 3)
+    onp.testing.assert_allclose(onp.asarray(out), ref)
+    # grad wrt old is zero at overwritten rows, identity elsewhere
+    g_old = onp.asarray(old.grad)
+    assert (g_old[[1, 3]] == 0).all()
+    assert float(onp.abs(onp.asarray(new.grad)).sum()) > 0
+
+
+def test_gradientmultiplier_reverses_gradient():
+    x = mx.np.array(onp.array([1.0, 2.0], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.npx.gradientmultiplier(x, -0.5)  # gradient reversal
+        loss = (y * y).sum()
+    loss.backward()
+    onp.testing.assert_allclose(onp.asarray(x.grad),
+                                -0.5 * 2 * onp.asarray(x), rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(y), onp.asarray(x))
+
+
+def test_index_copy_out_of_range_errors_eagerly():
+    old = mx.np.zeros((5, 3))
+    new = mx.np.ones((2, 3))
+    idx = mx.np.array(onp.array([1, 7], onp.int32))
+    with pytest.raises(mx.base.MXNetError, match="out of range"):
+        mx.npx.index_copy(old, idx, new)

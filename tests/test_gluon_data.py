@@ -182,3 +182,26 @@ def test_image_record_and_list_datasets(tmp_path):
     assert len(lst_ds) == 6
     img2, label2 = lst_ds[0]
     assert img2.shape[-1] == 3
+
+
+def test_image_record_dataset_flag_controls_channels(tmp_path):
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+
+    import io as _io
+
+    arr = onp.random.RandomState(0).randint(0, 255, (8, 8, 3)).astype("uint8")
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    rec_path = str(tmp_path / "one.rec")
+    w = recordio.IndexedRecordIO(str(tmp_path / "one.idx"), rec_path, "w")
+    header = recordio.IRHeader(0, 1.0, 0, 0)
+    w.write_idx(0, recordio.pack(header, buf.getvalue()))
+    w.close()
+
+    color = ImageRecordDataset(rec_path, flag=1)[0][0]
+    gray = ImageRecordDataset(rec_path, flag=0)[0][0]
+    assert color.ndim == 3 and color.shape[-1] == 3
+    assert gray.ndim == 2
