@@ -1295,3 +1295,16 @@ def fromstring(string, dtype=float32, count=-1, sep=" "):
 
 def from_dlpack(x):
     return _wrap(jnp.from_dlpack(x))
+
+
+def packbits(a, axis=None, bitorder="big"):
+    """numpy.packbits (jnp has it; non-differentiable int op)."""
+    return _call(lambda x: jnp.packbits(x, axis=axis, bitorder=bitorder),
+                 (_c(a),), name="packbits")
+
+
+def unpackbits(a, axis=None, count=None, bitorder="big"):
+    return _call(
+        lambda x: jnp.unpackbits(x, axis=axis, count=count,
+                                 bitorder=bitorder),
+        (_c(a),), name="unpackbits")
