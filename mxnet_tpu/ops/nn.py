@@ -126,15 +126,19 @@ def deconvolution(
             (d * (k - 1) - p, d * (k - 1) - p + a)
             for k, p, a, d in zip(kernel, pad, adj, dilate)
         ]
+        # deconv = grad-of-conv: I/O-swapped, spatially-flipped kernel with
+        # lhs_dilation=stride (conv_general_dilated has no transpose_kernel
+        # arg; the flip must be explicit)
+        w = jnp.swapaxes(weight, 0, 1)
+        w = w[(slice(None), slice(None)) + (slice(None, None, -1),) * ndim]
         y = lax.conv_general_dilated(
             x,
-            jnp.swapaxes(weight, 0, 1),
+            w,
             window_strides=(1,) * ndim,
             padding=pads,
             lhs_dilation=stride,
             rhs_dilation=dilate,
             dimension_numbers=dn,
-            transpose_kernel=True,
         )
     if bias is not None:
         y = y + bias.reshape((1, -1) + (1,) * ndim)

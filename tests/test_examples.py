@@ -56,3 +56,18 @@ def test_super_resolution_entry_point():
     psnr = float(line.split("psnr=")[1].split()[0])
     base = float(line.split("baseline=")[1].split()[0])
     assert psnr > base, f"SR net ({psnr}dB) must beat NN upsampling ({base}dB)"
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_dc_gan_entry_point():
+    out = _run("example/gluon/dc_gan.py", "--epochs", "12",
+               "--nimages", "128")
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.rsplit("final:", 1)[1]
+    real_mean = float(line.split("real_mean=")[1].split()[0])
+    fake_mean = float(line.split("fake_mean=")[1].split()[0])
+    # G starts at tanh(0)=0; adversarial training must pull its pixel
+    # mean toward the real data's (-0.6)
+    assert fake_mean < -0.05, f"generator did not move: {fake_mean}"
+    assert abs(fake_mean - real_mean) < abs(0.0 - real_mean)

@@ -447,3 +447,53 @@ def test_numpy_tail_gradients():
     xv = x.asnumpy()
     num = (onp.sinc(xv + eps) - onp.sinc(xv - eps)) / (2 * eps)
     onp.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-2, atol=1e-3)
+
+
+# -- deconvolution vs torch oracle (had zero coverage; the op was broken) ---
+
+@pytest.mark.seed(31)
+@pytest.mark.parametrize("stride,pad,adj,groups", [
+    (1, 0, 0, 1), (2, 1, 0, 1), (2, 1, 1, 1), (3, 2, 1, 1), (2, 1, 0, 2),
+])
+def test_deconvolution_vs_torch(stride, pad, adj, groups):
+    import torch
+
+    B, Cin, H, W = 2, 4, 5, 5
+    Cout_per_g, k = 3, 3
+    x = onp.random.randn(B, Cin, H, W).astype(onp.float32)
+    w = onp.random.randn(Cin, Cout_per_g, k, k).astype(onp.float32)
+    out = mx.npx.deconvolution(
+        mx.np.array(x), mx.np.array(w), stride=stride, pad=pad, adj=adj,
+        num_group=groups)
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x), torch.from_numpy(w), stride=stride,
+        padding=pad, output_padding=adj, groups=groups).numpy()
+    onp.testing.assert_allclose(onp.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.seed(32)
+def test_deconvolution_1d_and_grad():
+    import torch
+
+    x = onp.random.randn(2, 3, 7).astype(onp.float32)
+    w = onp.random.randn(3, 2, 4).astype(onp.float32)
+    xm, wm = mx.np.array(x), mx.np.array(w)
+    xm.attach_grad(); wm.attach_grad()
+    from mxnet_tpu import autograd
+
+    with autograd.record():
+        out = mx.npx.deconvolution(xm, wm, stride=2, pad=1)
+        loss = (out * out).sum()
+    loss.backward()
+    ref = torch.nn.functional.conv_transpose1d(
+        torch.from_numpy(x), torch.from_numpy(w), stride=2, padding=1)
+    onp.testing.assert_allclose(onp.asarray(out), ref.numpy(),
+                                rtol=1e-4, atol=1e-4)
+    xt = torch.from_numpy(x).requires_grad_(True)
+    wt = torch.from_numpy(w).requires_grad_(True)
+    (torch.nn.functional.conv_transpose1d(xt, wt, stride=2, padding=1)
+     ** 2).sum().backward()
+    onp.testing.assert_allclose(onp.asarray(xm.grad), xt.grad.numpy(),
+                                rtol=1e-3, atol=1e-3)
+    onp.testing.assert_allclose(onp.asarray(wm.grad), wt.grad.numpy(),
+                                rtol=1e-3, atol=1e-3)
