@@ -1,0 +1,304 @@
+"""Smoke-oracle sweep over ops with no other direct test coverage.
+
+The deconvolution op shipped broken because nothing called it
+(transpose_kernel TypeError, fixed alongside this file) — this module
+makes every remaining public op execute at least once against a numpy
+oracle so a signature/implementation break cannot hide.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np = mx.np
+npx = mx.npx
+
+A = onp.arange(12, dtype=onp.float32).reshape(3, 4) / 10.0
+B = onp.arange(12, dtype=onp.float32).reshape(3, 4)[::-1].copy() / 7.0
+V = onp.array([3.0, 1.0, 2.0, 5.0], onp.float32)
+
+
+def _chk(got, want, rtol=1e-5, atol=1e-6):
+    got = onp.asarray(got)
+    want = onp.asarray(want)
+    assert got.shape == want.shape, (got.shape, want.shape)
+    onp.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+
+
+NP_CASES = [
+    ("vstack", lambda: np.vstack([np.array(A), np.array(B)]),
+     lambda: onp.vstack([A, B])),
+    ("hstack", lambda: np.hstack([np.array(A), np.array(B)]),
+     lambda: onp.hstack([A, B])),
+    ("dstack", lambda: np.dstack([np.array(A), np.array(B)]),
+     lambda: onp.dstack([A, B])),
+    ("column_stack", lambda: np.column_stack([np.array(V), np.array(V)]),
+     lambda: onp.column_stack([V, V])),
+    ("row_stack", lambda: np.row_stack([np.array(A), np.array(B)]),
+     lambda: onp.vstack([A, B])),
+    ("moveaxis", lambda: np.moveaxis(np.array(A), 0, 1),
+     lambda: onp.moveaxis(A, 0, 1)),
+    ("rollaxis", lambda: np.rollaxis(np.array(A), 1),
+     lambda: onp.rollaxis(A, 1)),
+    ("fliplr", lambda: np.fliplr(np.array(A)), lambda: onp.fliplr(A)),
+    ("flipud", lambda: np.flipud(np.array(A)), lambda: onp.flipud(A)),
+    ("atleast_2d", lambda: np.atleast_2d(np.array(V)),
+     lambda: onp.atleast_2d(V)),
+    ("average", lambda: np.average(np.array(A), axis=0,
+                                   weights=np.array(V) if False else None),
+     lambda: onp.average(A, axis=0)),
+    ("nanmean", lambda: np.nanmean(np.array(A), axis=1),
+     lambda: onp.nanmean(A, axis=1)),
+    ("nan_to_num", lambda: np.nan_to_num(np.array(
+        onp.array([onp.nan, onp.inf, 1.0], onp.float32))),
+     lambda: onp.nan_to_num(onp.array([onp.nan, onp.inf, 1.0], onp.float32))),
+    ("bincount", lambda: np.bincount(np.array(
+        onp.array([0, 1, 1, 3], onp.int32))),
+     lambda: onp.bincount(onp.array([0, 1, 1, 3]))),
+    ("digitize", lambda: np.digitize(np.array(V), np.array(
+        onp.array([0.0, 2.0, 4.0], onp.float32))),
+     lambda: onp.digitize(V, onp.array([0.0, 2.0, 4.0]))),
+    ("interp", lambda: np.interp(np.array(V), np.array(
+        onp.array([0.0, 5.0], onp.float32)),
+        np.array(onp.array([0.0, 10.0], onp.float32))),
+     lambda: onp.interp(V, [0.0, 5.0], [0.0, 10.0])),
+    ("percentile", lambda: np.percentile(np.array(A), 50, axis=0),
+     lambda: onp.percentile(A, 50, axis=0)),
+    ("quantile", lambda: np.quantile(np.array(A), 0.25, axis=1),
+     lambda: onp.quantile(A, 0.25, axis=1)),
+    ("searchsorted", lambda: np.searchsorted(np.array(onp.sort(V)),
+                                             np.array(V)),
+     lambda: onp.searchsorted(onp.sort(V), V)),
+    ("unravel_index", lambda: np.stack(list(np.unravel_index(np.array(
+        onp.array([5, 7], onp.int32)), (3, 4)))),
+     lambda: onp.stack(list(onp.unravel_index(onp.array([5, 7]), (3, 4))))),
+    ("ravel_multi_index", lambda: np.ravel_multi_index(
+        (np.array(onp.array([1, 2], onp.int32)),
+         np.array(onp.array([2, 3], onp.int32))), (3, 4)),
+     lambda: onp.ravel_multi_index((onp.array([1, 2]), onp.array([2, 3])),
+                                   (3, 4))),
+    ("heaviside", lambda: np.heaviside(np.array(A - 0.5), 0.5),
+     lambda: onp.heaviside(A - 0.5, 0.5)),
+    ("exp2", lambda: np.exp2(np.array(A)), lambda: onp.exp2(A)),
+    ("gcd", lambda: np.gcd(np.array(onp.array([12, 18], onp.int32)),
+                           np.array(onp.array([8, 27], onp.int32))),
+     lambda: onp.gcd(onp.array([12, 18]), onp.array([8, 27]))),
+    ("lcm", lambda: np.lcm(np.array(onp.array([4, 6], onp.int32)),
+                           np.array(onp.array([6, 15], onp.int32))),
+     lambda: onp.lcm(onp.array([4, 6]), onp.array([6, 15]))),
+    ("ldexp", lambda: np.ldexp(np.array(V), np.array(
+        onp.array([1, 2, 3, 4], onp.int32))),
+     lambda: onp.ldexp(V, onp.array([1, 2, 3, 4]))),
+    ("nextafter", lambda: np.nextafter(np.array(V), np.array(V + 1)),
+     lambda: onp.nextafter(V, V + 1)),
+    ("signbit", lambda: np.signbit(np.array(A - 0.5)),
+     lambda: onp.signbit(A - 0.5)),
+    ("logaddexp2", lambda: np.logaddexp2(np.array(A), np.array(B)),
+     lambda: onp.logaddexp2(A, B)),
+    ("float_power", lambda: np.float_power(np.array(A + 1), 2.0),
+     lambda: onp.float_power(A + 1, 2.0)),
+    ("fabs", lambda: np.fabs(np.array(A - 0.5)), lambda: onp.fabs(A - 0.5)),
+    ("deg2rad", lambda: np.deg2rad(np.array(A)), lambda: onp.deg2rad(A)),
+    ("rad2deg", lambda: np.rad2deg(np.array(A)), lambda: onp.rad2deg(A)),
+    ("fill_diagonal", lambda: np.fill_diagonal(np.array(A.copy()), 9.0),
+     lambda: _fd(A.copy())),
+    ("diagonal", lambda: np.diagonal(np.array(A)), lambda: onp.diagonal(A)),
+    ("tri", lambda: np.tri(3, 4), lambda: onp.tri(3, 4)),
+    ("meshgrid", lambda: np.stack(list(np.meshgrid(np.array(V),
+                                                   np.array(V)))),
+     lambda: onp.stack(list(onp.meshgrid(V, V)))),
+    ("polyval", lambda: np.polyval(np.array(onp.array([1.0, -2.0, 1.0],
+                                                      onp.float32)),
+                                   np.array(V)),
+     lambda: onp.polyval(onp.array([1.0, -2.0, 1.0]), V)),
+    ("count_nonzero", lambda: np.count_nonzero(np.array(
+        onp.array([0, 1, 0, 3], onp.float32))),
+     lambda: onp.asarray(onp.count_nonzero(onp.array([0, 1, 0, 3])))),
+    ("flatnonzero", lambda: np.flatnonzero(np.array(
+        onp.array([0, 1, 0, 3], onp.float32))),
+     lambda: onp.flatnonzero(onp.array([0, 1, 0, 3]))),
+    ("isclose", lambda: np.isclose(np.array(V), np.array(V + 1e-9)),
+     lambda: onp.isclose(V, V + 1e-9)),
+    ("nanargmax", lambda: np.nanargmax(np.array(A)),
+     lambda: onp.asarray(onp.nanargmax(A))),
+    ("ptp", lambda: np.ptp(np.array(A), axis=0), lambda: onp.ptp(A, axis=0)),
+    ("trim_zeros", lambda: np.trim_zeros(np.array(
+        onp.array([0.0, 1.0, 2.0, 0.0], onp.float32))),
+     lambda: onp.trim_zeros(onp.array([0.0, 1.0, 2.0, 0.0]))),
+    ("put_along_axis", lambda: _paa_mx(), lambda: _paa_np()),
+    ("array_split", lambda: np.array_split(np.array(V), 3)[0],
+     lambda: onp.array_split(V, 3)[0]),
+    ("hsplit", lambda: np.hsplit(np.array(A), 2)[1],
+     lambda: onp.hsplit(A, 2)[1]),
+    ("vsplit", lambda: np.vsplit(np.array(A), 3)[2],
+     lambda: onp.vsplit(A, 3)[2]),
+    ("compress", lambda: np.compress(np.array(
+        onp.array([True, False, True], bool)), np.array(A), axis=0),
+     lambda: onp.compress([True, False, True], A, axis=0)),
+    ("extract", lambda: np.extract(np.array(A) > 0.5, np.array(A)),
+     lambda: onp.extract(A > 0.5, A)),
+    ("in1d", lambda: np.in1d(np.array(V), np.array(
+        onp.array([1.0, 5.0], onp.float32))),
+     lambda: onp.in1d(V, [1.0, 5.0])),
+    ("geomspace", lambda: np.geomspace(1.0, 8.0, 4),
+     lambda: onp.geomspace(1.0, 8.0, 4)),
+    ("logspace", lambda: np.logspace(0, 2, 5), lambda: onp.logspace(0, 2, 5)),
+]
+
+
+def _fd(a):
+    onp.fill_diagonal(a, 9.0)
+    return a
+
+
+def _paa_mx():
+    a = np.array(A.copy())
+    idx = np.array(onp.array([[0, 1, 2, 0]], onp.int64))
+    return np.put_along_axis(a, idx, 9.0, axis=0) if \
+        np.put_along_axis(a, idx, 9.0, axis=0) is not None else a
+
+
+def _paa_np():
+    a = A.copy()
+    onp.put_along_axis(a, onp.array([[0, 1, 2, 0]]), 9.0, axis=0)
+    return a
+
+
+@pytest.mark.parametrize("name,mk,oracle", NP_CASES,
+                         ids=[c[0] for c in NP_CASES])
+def test_np_smoke(name, mk, oracle):
+    _chk(mk(), oracle())
+
+
+# -- npx structured/indexing ops --------------------------------------------
+
+def test_gather_scatter_nd():
+    data = np.array(A)
+    indices = np.array(onp.array([[0, 2], [1, 3]], onp.int64))  # 2 points
+    got = npx.gather_nd(data, indices)
+    _chk(got, A[[0, 2], [1, 3]])
+    upd = npx.scatter_nd(np.array(onp.array([9.0, 8.0], onp.float32)),
+                         indices, (3, 4))
+    ref = onp.zeros((3, 4), onp.float32)
+    ref[0, 1] += 9.0
+    ref[2, 3] += 8.0
+    _chk(upd, ref)
+
+
+def test_index_add_update():
+    # reference contrib.index_add: ind is (K, N) coordinates, K leading
+    # axes indexed, N update sites
+    data = np.zeros((4, 2))
+    idx = np.array(onp.array([[1, 3]], onp.int64))  # K=1 -> row indices
+    val = np.array(onp.ones((2, 2), onp.float32))
+    got = npx.index_add(data, idx, val)
+    ref = onp.zeros((4, 2), onp.float32)
+    ref[[1, 3]] += 1
+    _chk(got, ref)
+    got2 = npx.index_update(data, idx, val * 5)
+    ref2 = onp.zeros((4, 2), onp.float32)
+    ref2[[1, 3]] = 5
+    _chk(got2, ref2)
+
+
+def test_masked_softmax_ops():
+    x = np.array(A)
+    mask = np.array(onp.array([[1, 1, 0, 0]] * 3, bool))
+    got = npx.masked_softmax(x, mask)
+    e = onp.exp(A[:, :2] - A[:, :2].max(axis=1, keepdims=True))
+    ref = onp.zeros_like(A)
+    ref[:, :2] = e / e.sum(axis=1, keepdims=True)
+    _chk(got, ref, rtol=1e-4)
+    got_log = npx.masked_log_softmax(x, mask)
+    assert onp.isneginf(onp.asarray(got_log)[:, 2:]).all()
+
+
+def test_sequence_ops():
+    x = np.array(onp.arange(24, dtype=onp.float32).reshape(4, 2, 3))  # TNC
+    vl = np.array(onp.array([2.0, 4.0], onp.float32))
+    masked = npx.sequence_mask(x, sequence_length=vl,
+                               use_sequence_length=True, value=-1.0)
+    m = onp.asarray(masked)
+    assert (m[2:, 0] == -1.0).all() and (m[:, 1] != -1.0).all()
+    last = npx.sequence_last(x, sequence_length=vl, use_sequence_length=True)
+    _chk(last, onp.stack([onp.arange(24).reshape(4, 2, 3)[1, 0],
+                          onp.arange(24).reshape(4, 2, 3)[3, 1]]).astype(
+                              onp.float32))
+
+
+def test_shape_like_family():
+    x = np.array(A)
+    y = np.zeros((2, 6))
+    _chk(npx.reshape_like(x, y), A.reshape(2, 6))
+    _chk(npx.batch_flatten(np.array(onp.ones((2, 3, 4), onp.float32))),
+         onp.ones((2, 12), onp.float32))
+    _chk(npx.shape_array(x), onp.array([3, 4], onp.int64))
+    z = npx.arange_like(x, axis=1)
+    _chk(z, onp.arange(4, dtype=onp.float32))
+    s = npx.slice_like(np.array(onp.ones((5, 5), onp.float32)), x)
+    assert s.shape == (3, 4)
+    b = npx.broadcast_like(np.array(onp.ones((1, 4), onp.float32)), x)
+    assert b.shape == (3, 4)
+
+
+def test_one_hot_and_softplus():
+    got = npx.one_hot(np.array(onp.array([0, 2], onp.int32)), 4)
+    _chk(got, onp.eye(4, dtype=onp.float32)[[0, 2]])
+    _chk(npx.softplus(np.array(A)), onp.log1p(onp.exp(A)), rtol=1e-4)
+
+
+def test_leaky_relu_modes():
+    x = np.array(A - 0.6)
+    _chk(npx.leaky_relu(x, slope=0.1),
+         onp.where(A - 0.6 > 0, A - 0.6, 0.1 * (A - 0.6)), rtol=1e-5)
+    gamma = np.array(onp.full((1,), 0.2, onp.float32))
+    _chk(npx.leaky_relu(x, gamma, act_type="prelu"),
+         onp.where(A - 0.6 > 0, A - 0.6, 0.2 * (A - 0.6)), rtol=1e-5)
+    _chk(npx.leaky_relu(x, act_type="elu", slope=1.0),
+         onp.where(A - 0.6 > 0, A - 0.6, onp.expm1(A - 0.6)), rtol=1e-4)
+
+
+def test_norm_layers_oracle():
+    x = onp.random.RandomState(0).randn(2, 4, 3).astype(onp.float32)
+    g = onp.ones(4, onp.float32)
+    b = onp.zeros(4, onp.float32)
+    out = npx.group_norm(np.array(x), np.array(g), np.array(b), num_groups=2)
+    xr = x.reshape(2, 2, 2, 3)
+    mean = xr.mean(axis=(2, 3), keepdims=True)
+    var = xr.var(axis=(2, 3), keepdims=True)
+    ref = ((xr - mean) / onp.sqrt(var + 1e-5)).reshape(2, 4, 3)
+    _chk(out, ref, rtol=1e-4, atol=1e-4)
+    out_in = npx.instance_norm(np.array(x), np.array(g), np.array(b))
+    mean = x.mean(axis=2, keepdims=True)
+    var = x.var(axis=2, keepdims=True)
+    _chk(out_in, (x - mean) / onp.sqrt(var + 1e-5), rtol=1e-4, atol=1e-4)
+    out_l2 = npx.l2_normalization(np.array(x))
+    norm = onp.sqrt((x ** 2).sum(axis=(1, 2), keepdims=True)) + 1e-10
+    _chk(out_l2, x / norm, rtol=1e-4, atol=1e-4)
+
+
+def test_control_flow_foreach_while():
+    def body(x, state):
+        return x + state, state + 1.0
+
+    xs = np.array(onp.ones((4, 2), onp.float32))
+    outs, final = npx.foreach(body, xs, np.zeros((2,)))
+    ref = onp.stack([onp.ones(2) + i for i in range(4)]).astype(onp.float32)
+    _chk(outs, ref)
+    _chk(final, onp.full(2, 4.0, onp.float32))
+
+    # reference while_loop: cond(*loop_vars) -> bool; func(*loop_vars) ->
+    # (step_output, new_loop_vars); outputs stacked/padded to
+    # max_iterations
+    def cond(s):
+        return s[0] < 5.0
+
+    def wbody(s):
+        return s * 2.0, (s + 1.0,)
+
+    stacked, final2 = npx.while_loop(cond, wbody, (np.zeros((3,)),),
+                                     max_iterations=8)
+    _chk(final2, onp.full(3, 5.0, onp.float32))
+    ref = onp.zeros((8, 3), onp.float32)
+    ref[:5] = onp.stack([onp.full(3, 2.0 * i) for i in range(5)])
+    _chk(stacked, ref)
