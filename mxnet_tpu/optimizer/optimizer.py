@@ -640,6 +640,39 @@ class LAMB(Optimizer):
 lamb = LAMB
 
 
+@register
+class GroupAdaGrad(Optimizer):
+    """Row-wise AdaGrad (reference optimizer/contrib.py:26): one adaptive
+    learning rate per ROW — the embedding-table optimizer (state is
+    (rows, 1), not the full weight shape). Supports the lazy row_sparse
+    path: only touched rows update their history."""
+
+    lazy_update = True
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-6, **kwargs):
+        kwargs.pop("use_fused_step", None)
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        if self.wd != 0.0:
+            raise MXNetError("GroupAdaGrad does not support weight decay "
+                             "(reference contrib.py:46)")
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        wv = _unwrap(weight)
+        if wv.ndim < 2:
+            raise MXNetError("GroupAdaGrad requires >=2-D weights (rows)")
+        return (jnp.zeros((wv.shape[0], 1), wv.dtype),)
+
+    def update_step(self, w, g, state, lr, wd, t):
+        (hist,) = state
+        hist = hist + jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)),
+                               keepdims=True).reshape(hist.shape)
+        return (w - lr * g / (jnp.sqrt(hist) + self.epsilon), hist)
+
+
+group_adagrad = GroupAdaGrad
+
+
 # ---------------------------------------------------------------------------
 # legacy updater (kvstore server-side optimizer application)
 # ---------------------------------------------------------------------------

@@ -271,3 +271,23 @@ def test_load_initializer_roundtrip(tmp_path):
     dst.initialize(mx.init.Load(params, default_init=mx.init.Zero()))
     onp.testing.assert_allclose(onp.asarray(dst.weight.data()),
                                 onp.asarray(src.weight.data()))
+
+
+def test_group_adagrad_rowwise_state():
+    import jax.numpy as jnp
+
+    opt = mx.optimizer.create("groupadagrad", learning_rate=0.1)
+    w = mx.np.array(onp.ones((4, 3), onp.float32))
+    g = mx.np.array(onp.zeros((4, 3), onp.float32))
+    gnp = onp.zeros((4, 3), onp.float32)
+    gnp[1] = 2.0  # only row 1 touched
+    g = mx.np.array(gnp)
+    state = opt.create_state(0, w)
+    assert state[0].shape == (4, 1)
+    opt.update(0, w, g, state)
+    w2 = onp.asarray(w)
+    # untouched rows unchanged; touched row moved by lr*g/sqrt(mean(g^2))
+    onp.testing.assert_allclose(w2[0], onp.ones(3))
+    hist = 4.0  # mean(square([2,2,2]))
+    expect = 1.0 - 0.1 * 2.0 / (onp.sqrt(hist) + 1e-6)
+    onp.testing.assert_allclose(w2[1], onp.full(3, expect), rtol=1e-5)
