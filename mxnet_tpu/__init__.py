@@ -65,6 +65,7 @@ def __getattr__(name):
                "parallel": ".parallel", "random": ".numpy.random",
                "sym": ".symbol", "symbol": ".symbol",
                "operator": ".operator", "callback": ".callback",
+               "name": ".name", "attribute": ".attribute",
                "model": ".model", "visualization": ".visualization",
                "viz": ".visualization",
                "lr_scheduler": ".optimizer.lr_scheduler"}

@@ -1,0 +1,54 @@
+"""``mx.attribute`` — symbol attribute scopes (reference
+``python/mxnet/attribute.py`` ``AttrScope``).
+
+``with mx.attribute.AttrScope(ctx_group="dev1"):`` attaches the given
+attributes to every symbol created inside the scope (the reference uses
+this for ``group2ctx`` model-parallel placement and ``__wd_mult__``-style
+per-symbol hints). Nested scopes merge, inner keys win.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["AttrScope", "current_attrs"]
+
+
+class _Stack(threading.local):
+    def __init__(self):
+        self.scopes = []
+
+
+_stack = _Stack()
+
+
+class AttrScope:
+    def __init__(self, **attrs):
+        for k, v in attrs.items():
+            if not isinstance(v, str):
+                raise ValueError(
+                    f"AttrScope values must be strings; got {k}={v!r} "
+                    "(reference attribute.py enforces the same)")
+        self._attrs = attrs
+
+    def get(self, attrs: Dict[str, str] | None = None) -> Dict[str, str]:
+        merged = dict(self._attrs)
+        if attrs:
+            merged.update(attrs)
+        return merged
+
+    def __enter__(self):
+        _stack.scopes.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack.scopes.pop()
+        return False
+
+
+def current_attrs() -> Dict[str, str]:
+    """Merged attributes of all active scopes, innermost last."""
+    merged: Dict[str, str] = {}
+    for scope in _stack.scopes:
+        merged.update(scope._attrs)
+    return merged

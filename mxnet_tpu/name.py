@@ -1,0 +1,61 @@
+"""``mx.name`` — symbol naming scopes (reference
+``python/mxnet/name.py``: ``NameManager`` :27, ``Prefix`` :74).
+
+``with mx.name.Prefix("layer1_"):`` prefixes every auto-generated symbol
+name created in the scope; a custom ``NameManager`` subclass can rename
+arbitrarily. Thread-local, nestable, innermost wins — the contract the
+reference implements with a global stack + __enter__/__exit__.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class _Stack(threading.local):
+    def __init__(self):
+        self.managers = []
+
+
+_stack = _Stack()
+
+
+class NameManager:
+    """Assigns names to ops created while the scope is active."""
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        """Return ``name`` if given, else generate from ``hint``
+        (reference name.py:44)."""
+        if name:
+            return name
+        self._counter.setdefault(hint, 0)
+        generated = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        return generated
+
+    def __enter__(self):
+        _stack.managers.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack.managers.pop()
+        return False
+
+
+class Prefix(NameManager):
+    """Prefix every auto-generated name (reference name.py:74)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current() -> NameManager | None:
+    return _stack.managers[-1] if _stack.managers else None

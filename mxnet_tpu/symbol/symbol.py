@@ -541,7 +541,10 @@ class Executor:
 # ---------------------------------------------------------------------------
 def var(name: str, shape=None, dtype=None, **attrs) -> Symbol:
     """Declare a free variable (reference ``mx.sym.var`` / "null" op)."""
-    node_attrs = dict(attrs)
+    from .. import attribute as _attribute
+
+    node_attrs = _attribute.current_attrs()
+    node_attrs.update(attrs)
     if shape is not None:
         node_attrs["__shape__"] = list(shape)
     if dtype is not None:
@@ -588,8 +591,14 @@ _N_OUT = {
 
 
 def _make_op_symbol(opname: str, fn, args, kwargs) -> Symbol:
-    name = kwargs.pop("name", None) or \
-        f"{opname.split('.')[-1]}{next(_name_counter)}"
+    from .. import name as _name_mod
+
+    name = kwargs.pop("name", None)
+    manager = _name_mod.current()
+    if manager is not None:
+        name = manager.get(name, opname.split(".")[-1])
+    if not name:
+        name = f"{opname.split('.')[-1]}{next(_name_counter)}"
     pos_spec, inputs, kw_sym = [], [], {}
     for a in args:
         if isinstance(a, Symbol):
@@ -621,7 +630,11 @@ def _make_op_symbol(opname: str, fn, args, kwargs) -> Symbol:
     counter = _N_OUT.get(opname)
     if counter is not None:
         n_out = counter(spec_args, const_kwargs)
-    node = _Node(opname, name, pos_spec, const_kwargs, kw_sym, inputs, n_out)
+    from .. import attribute as _attribute
+
+    scope_attrs = _attribute.current_attrs()
+    node = _Node(opname, name, pos_spec, const_kwargs, kw_sym, inputs, n_out,
+                 attrs=scope_attrs or None)
     return Symbol([(node, s) for s in range(n_out)])
 
 

@@ -209,3 +209,26 @@ def test_dropout_train_vs_infer():
     (train_out,) = exe.forward(is_train=True, x=xv)
     zeros = float((train_out.asnumpy() == 0).mean())
     assert 0.3 < zeros < 0.7  # ~half dropped
+
+
+def test_name_manager_prefix_and_attr_scope():
+    import mxnet_tpu as mx
+
+    with mx.name.Prefix("block1_"):
+        with mx.attribute.AttrScope(ctx_group="dev1", __wd_mult__="0.0"):
+            a = mx.sym.Variable("data")
+            out = a + 1.0
+    assert out.name.startswith("block1_")
+    node = out._heads[0][0]
+    assert node.attrs.get("ctx_group") == "dev1"
+    assert node.attrs.get("__wd_mult__") == "0.0"
+    # counter increments within one manager
+    with mx.name.Prefix("p_"):
+        s1 = mx.sym.Variable("x") * 2.0
+        s2 = mx.sym.Variable("y") * 2.0
+    assert s1.name != s2.name and s1.name.startswith("p_")
+    # non-string attr values rejected like the reference
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        mx.attribute.AttrScope(bad=1)
