@@ -15,7 +15,8 @@ __all__ = [
     "Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
     "RandomResizedCrop", "RandomCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
     "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomLighting",
-    "RandomColorJitter", "Pad",
+    "RandomColorJitter", "Pad", "RandomApply", "HybridRandomApply",
+    "RandomGray", "RandomHue", "Rotate", "RandomRotation", "CropResize",
 ]
 
 
@@ -264,3 +265,146 @@ class Pad:
         l, t, r, b = self._p
         pads = ((t, b), (l, r)) + (((0, 0),) if img.ndim == 3 else ())
         return onp.pad(img, pads, constant_values=self._fill)
+
+
+class RandomApply:
+    """Apply a transform with probability p (reference transforms
+    RandomApply)."""
+
+    def __init__(self, transform, p=0.5):
+        self._t = transform
+        self._p = p
+
+    def __call__(self, img):
+        if onp.random.uniform() < self._p:
+            return self._t(img)
+        return _hwc(img)
+
+
+HybridRandomApply = RandomApply  # hybrid variant is the same on host numpy
+
+
+class RandomGray:
+    """Convert to 3-channel grayscale with probability p (reference
+    transforms RandomGray)."""
+
+    def __init__(self, p=0.5):
+        self._p = p
+
+    def __call__(self, img):
+        img = _hwc(img)
+        if onp.random.uniform() < self._p:
+            gray = (img.astype(onp.float32)
+                    @ onp.array([0.299, 0.587, 0.114], onp.float32))
+            img = onp.repeat(gray[..., None], 3, axis=-1).astype(img.dtype)
+        return img
+
+
+class RandomHue:
+    """Jitter hue by a factor in [max(0,1-hue), 1+hue] using the
+    reference's YIQ rotation approximation (image.py RandomHueAug)."""
+
+    def __init__(self, hue):
+        self._h = hue
+
+    def __call__(self, img):
+        img = _hwc(img).astype(onp.float32)
+        alpha = onp.random.uniform(-self._h, self._h)
+        u = onp.cos(alpha * onp.pi)
+        w = onp.sin(alpha * onp.pi)
+        bt = onp.array([[0.299, 0.587, 0.114],
+                        [0.596, -0.274, -0.321],
+                        [0.211, -0.523, 0.311]], onp.float32)
+        ibt = onp.array([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], onp.float32)
+        t = onp.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], onp.float32)
+        m = ibt @ t @ bt
+        return img @ m.T
+
+
+def _rotate(img, deg, zoom_in=False, zoom_out=False):
+    """Bilinear rotation about the center, zero-filled (reference
+    transforms Rotate / image.imrotate)."""
+    img = _hwc(img).astype(onp.float32)
+    two_d = img.ndim == 2
+    if two_d:
+        img = img[:, :, None]
+    H, W, C = img.shape
+    rad = onp.deg2rad(deg)
+    c, s = onp.cos(rad), onp.sin(rad)
+    scale = 1.0
+    if zoom_in or zoom_out:
+        # zoom so the rotated frame fits (out) or fills (in) the canvas
+        fit_w = abs(c) * W + abs(s) * H
+        fit_h = abs(s) * W + abs(c) * H
+        if zoom_out:
+            scale = max(fit_w / W, fit_h / H)
+        else:
+            scale = min(W / fit_w, H / fit_h) ** -1
+    cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+    ys, xs = onp.mgrid[0:H, 0:W].astype(onp.float32)
+    # inverse mapping: output pixel -> source coordinate
+    dy, dx = (ys - cy) * scale, (xs - cx) * scale
+    sy = cy + (c * dy - s * dx)
+    sx = cx + (s * dy + c * dx)
+    y0 = onp.floor(sy).astype(onp.int64)
+    x0 = onp.floor(sx).astype(onp.int64)
+    wy, wx = sy - y0, sx - x0
+    out = onp.zeros_like(img)
+    for dy2 in (0, 1):
+        for dx2 in (0, 1):
+            yy, xx = y0 + dy2, x0 + dx2
+            valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = onp.clip(yy, 0, H - 1)
+            xc = onp.clip(xx, 0, W - 1)
+            wgt = ((wy if dy2 else 1 - wy) * (wx if dx2 else 1 - wx) * valid)
+            out += img[yc, xc] * wgt[..., None]
+    return out[:, :, 0] if two_d else out
+
+
+class Rotate:
+    """Rotate by a fixed angle in degrees (reference transforms Rotate)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        self._deg = rotation_degrees
+        self._zi, self._zo = zoom_in, zoom_out
+
+    def __call__(self, img):
+        return _rotate(img, self._deg, self._zi, self._zo)
+
+
+class RandomRotation:
+    """Rotate by a uniform random angle from [lo, hi] degrees (reference
+    transforms RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        lo, hi = angle_limits
+        self._lo, self._hi = lo, hi
+        self._zi, self._zo = zoom_in, zoom_out
+        self._p = rotate_with_proba
+
+    def __call__(self, img):
+        if onp.random.uniform() >= self._p:
+            return _hwc(img)
+        deg = onp.random.uniform(self._lo, self._hi)
+        return _rotate(img, deg, self._zi, self._zo)
+
+
+class CropResize:
+    """Crop a fixed box then resize (reference transforms CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        self._box = (x, y, width, height)
+        self._size = size
+
+    def __call__(self, img):
+        img = _hwc(img)
+        x, y, w, h = self._box
+        img = img[y: y + h, x: x + w]
+        if self._size is not None:
+            img = Resize(self._size)(img)
+        return img

@@ -205,3 +205,30 @@ def test_image_record_dataset_flag_controls_channels(tmp_path):
     gray = ImageRecordDataset(rec_path, flag=0)[0][0]
     assert color.ndim == 3 and color.shape[-1] == 3
     assert gray.ndim == 2
+
+
+def test_new_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    img = onp.random.RandomState(0).randint(0, 255, (10, 12, 3)).astype(
+        onp.uint8)
+    # Rotate 90deg == onp.rot90 up to bilinear exactness on the grid
+    sq = onp.arange(64, dtype=onp.float32).reshape(8, 8)
+    rot = T.Rotate(90)(sq)
+    onp.testing.assert_allclose(rot, onp.rot90(sq, k=-1), atol=1e-3)
+    # RandomRotation with p=0 is identity
+    out = T.RandomRotation((-30, 30), rotate_with_proba=0.0)(img)
+    onp.testing.assert_array_equal(out, img)
+    # RandomGray p=1 -> all channels equal
+    g = T.RandomGray(p=1.0)(img)
+    assert g.shape == img.shape
+    onp.testing.assert_array_equal(g[..., 0], g[..., 1])
+    # RandomHue preserves shape and roughly preserves luma
+    h = T.RandomHue(0.1)(img)
+    assert h.shape == img.shape
+    # CropResize crops the right box
+    c = T.CropResize(2, 1, 6, 5)(img)
+    onp.testing.assert_array_equal(c, img[1:6, 2:8])
+    # RandomApply p=0/p=1
+    out0 = T.RandomApply(T.RandomGray(1.0), p=0.0)(img)
+    onp.testing.assert_array_equal(out0, img)
