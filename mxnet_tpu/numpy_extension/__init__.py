@@ -788,3 +788,31 @@ def index_copy(old_tensor, index_vector, new_tensor):
 def gradientmultiplier(data, scalar=1.0):
     return _call(lambda d: _contrib.gradientmultiplier(d, scalar), (data,),
                  name="gradientmultiplier")
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Eager host-side SSD target assignment — not traceable (greedy
+    matching + sorting, reference multibox_target.cc CPU kernel)."""
+    out = _contrib.multibox_target(
+        _unwrap(anchor) if isinstance(anchor, ndarray) else anchor,
+        _unwrap(label) if isinstance(label, ndarray) else label,
+        _unwrap(cls_pred) if isinstance(cls_pred, ndarray) else cls_pred,
+        overlap_threshold, ignore_label, negative_mining_ratio,
+        negative_mining_thresh, minimum_negative_samples, variances)
+    return tuple(_wrap(o) for o in out)
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, threshold=0.01,
+                       clip=True, variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_threshold=0.5, force_suppress=False, nms_topk=-1):
+    """Eager host-side SSD decode + NMS (reference
+    multibox_detection.cc CPU kernel)."""
+    out = _contrib.multibox_detection(
+        _unwrap(cls_prob) if isinstance(cls_prob, ndarray) else cls_prob,
+        _unwrap(loc_pred) if isinstance(loc_pred, ndarray) else loc_pred,
+        _unwrap(anchor) if isinstance(anchor, ndarray) else anchor,
+        threshold, clip, variances, nms_threshold, force_suppress, nms_topk)
+    return _wrap(out)

@@ -24,6 +24,7 @@ __all__ = [
     "bipartite_matching", "allclose", "index_array", "multibox_prior",
     "deformable_convolution", "modulated_deformable_convolution",
     "hawkes_ll", "index_copy", "gradientmultiplier",
+    "multibox_target", "multibox_detection",
 ]
 
 
@@ -541,3 +542,177 @@ def gradientmultiplier(data, scalar=1.0):
     (reference contrib/gradient_multiplier_op.cc:73-90 — negative scalar
     gives the DANN gradient-reversal layer)."""
     return _gradmul(jnp.asarray(data), jnp.asarray(scalar, jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# SSD target assignment + detection decode (reference
+# contrib/multibox_target.cc, contrib/multibox_detection.cc)
+# ---------------------------------------------------------------------------
+def _iou_corner(a, b):
+    """IoU of [l,t,r,b] boxes a (N,4) vs b (M,4) -> (N, M), zero-safe."""
+    inter_w = onp.maximum(0.0, onp.minimum(a[:, None, 2], b[None, :, 2])
+                          - onp.maximum(a[:, None, 0], b[None, :, 0]))
+    inter_h = onp.maximum(0.0, onp.minimum(a[:, None, 3], b[None, :, 3])
+                          - onp.maximum(a[:, None, 1], b[None, :, 1]))
+    inter = inter_w * inter_h
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return onp.where(union > 0, inter / onp.where(union > 0, union, 1.0), 0.0)
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training-target assignment (reference multibox_target.cc:72).
+
+    anchor (1, A, 4) corner boxes shared over the batch; label
+    (B, L, 5+) rows ``[cls, l, t, r, b, ...]`` padded with -1; cls_pred
+    (B, C, A) raw class scores (used only by negative mining). Returns
+    (loc_target (B, A*4), loc_mask (B, A*4), cls_target (B, A)).
+
+    Greedy bipartite matching + thresholded residual matching + optional
+    hard-negative mining — inherently sequential/sorting, so this is a
+    host-side EAGER op like the reference's CPU kernel (the output feeds
+    jitted loss math; the op itself has zero gradient).
+    """
+    anchors = onp.asarray(anchor, onp.float32).reshape(-1, 4)
+    labels = onp.asarray(label, onp.float32)
+    cls_preds = onp.asarray(cls_pred, onp.float32)
+    B, A = labels.shape[0], anchors.shape[0]
+    vx, vy, vw, vh = variances
+    loc_target = onp.zeros((B, A * 4), onp.float32)
+    loc_mask = onp.zeros((B, A * 4), onp.float32)
+    cls_target = onp.full((B, A), ignore_label, onp.float32)
+
+    for n in range(B):
+        valid = labels[n][labels[n][:, 0] != -1.0]
+        if len(valid) == 0:
+            cls_target[n] = 0
+            continue
+        gt = valid[:, 1:5]
+        overlaps = _iou_corner(anchors, gt)  # (A, G)
+        G = len(gt)
+        matches = onp.full(A, -1, onp.int64)
+        match_iou = onp.full(A, -1.0, onp.float32)
+        anchor_flags = onp.full(A, -1, onp.int8)
+        gt_matched = onp.zeros(G, bool)
+        # greedy bipartite: repeatedly take the globally best (anchor, gt)
+        ov = overlaps.copy()
+        while not gt_matched.all():
+            ov_m = ov.copy()
+            ov_m[anchor_flags == 1] = -1.0
+            ov_m[:, gt_matched] = -1.0
+            j, k = onp.unravel_index(onp.argmax(ov_m), ov_m.shape)
+            if ov_m[j, k] <= 1e-6:
+                break
+            matches[j], match_iou[j] = k, ov_m[j, k]
+            anchor_flags[j] = 1
+            gt_matched[k] = True
+        if overlap_threshold > 0:
+            for j in range(A):
+                if anchor_flags[j] == 1:
+                    continue
+                k = int(onp.argmax(overlaps[j]))
+                matches[j], match_iou[j] = k, overlaps[j, k]
+                if overlaps[j, k] > overlap_threshold:
+                    anchor_flags[j] = 1
+                    gt_matched[k] = True
+        if negative_mining_ratio > 0:
+            num_pos = int((anchor_flags == 1).sum())
+            num_neg = min(int(num_pos * negative_mining_ratio),
+                          A - num_pos)
+            num_neg = max(num_neg, int(minimum_negative_samples))
+            if num_neg > 0:
+                # background probability of each unmatched anchor; the
+                # hardest negatives have the LOWEST background prob
+                scores = cls_preds[n]  # (C, A)
+                m = scores.max(axis=0)
+                p_bg = onp.exp(scores[0] - m) / onp.exp(scores - m).sum(0)
+                # hardest negatives = lowest background probability
+                # (reference sorts by -prob descending, :231)
+                order = sorted(
+                    (j for j in range(A)
+                     if anchor_flags[j] == -1
+                     and match_iou[j] < negative_mining_thresh),
+                    key=lambda j: p_bg[j])
+                for j in order[:num_neg]:
+                    anchor_flags[j] = 0
+        else:
+            anchor_flags[anchor_flags != 1] = 0
+
+        pos = anchor_flags == 1
+        neg = anchor_flags == 0
+        cls_target[n][neg] = 0
+        cls_target[n][pos] = valid[matches[pos], 0] + 1  # 0 = background
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+        ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+        g = gt[matches.clip(0)]
+        gw = g[:, 2] - g[:, 0]
+        gh = g[:, 3] - g[:, 1]
+        gx = (g[:, 0] + g[:, 2]) * 0.5
+        gy = (g[:, 1] + g[:, 3]) * 0.5
+        enc = onp.stack([(gx - ax) / aw / vx, (gy - ay) / ah / vy,
+                         onp.log(onp.maximum(gw / aw, 1e-12)) / vw,
+                         onp.log(onp.maximum(gh / ah, 1e-12)) / vh], axis=1)
+        lt = loc_target[n].reshape(A, 4)
+        lm = loc_mask[n].reshape(A, 4)
+        lt[pos] = enc[pos]
+        lm[pos] = 1.0
+    return (jnp.asarray(loc_target), jnp.asarray(loc_mask),
+            jnp.asarray(cls_target))
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, threshold=0.01,
+                       clip=True, variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_threshold=0.5, force_suppress=False,
+                       nms_topk=-1):
+    """SSD detection decode + per-class NMS (reference
+    multibox_detection.cc:83): cls_prob (B, C, A) softmax probabilities,
+    loc_pred (B, A*4) encoded offsets, anchor (1, A, 4). Returns
+    (B, A, 6) rows ``[class_id, score, l, t, r, b]`` with suppressed /
+    invalid rows marked class_id = -1. Host-side eager op (sorting NMS),
+    mirroring the reference CPU kernel."""
+    cls_prob = onp.asarray(cls_prob, onp.float32)
+    loc_pred = onp.asarray(loc_pred, onp.float32)
+    anchors = onp.asarray(anchor, onp.float32).reshape(-1, 4)
+    B, C, A = cls_prob.shape
+    vx, vy, vw, vh = variances
+    out = onp.full((B, A, 6), -1.0, onp.float32)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    for n in range(B):
+        p = loc_pred[n].reshape(A, 4)
+        ox = p[:, 0] * vx * aw + ax
+        oy = p[:, 1] * vy * ah + ay
+        ow = onp.exp(p[:, 2] * vw) * aw / 2
+        oh = onp.exp(p[:, 3] * vh) * ah / 2
+        boxes = onp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+        if clip:
+            boxes = boxes.clip(0.0, 1.0)
+        fg = cls_prob[n, 1:]  # (C-1, A)
+        ids = fg.argmax(axis=0)
+        scores = fg.max(axis=0) if C > 1 else onp.zeros(A, onp.float32)
+        keep = scores >= threshold
+        dets = onp.concatenate([
+            ids[keep, None].astype(onp.float32), scores[keep, None],
+            boxes[keep]], axis=1)
+        order = onp.argsort(-dets[:, 1], kind="stable")
+        dets = dets[order]
+        if nms_topk > 0:
+            dets = dets[:nms_topk]
+        for i in range(len(dets)):
+            if dets[i, 0] < 0:
+                continue
+            iou = _iou_corner(dets[i: i + 1, 2:6], dets[i + 1:, 2:6])[0]
+            same = (force_suppress
+                    | (dets[i + 1:, 0] == dets[i, 0]))
+            dets[i + 1:][(iou >= nms_threshold) & same
+                         & (dets[i + 1:, 0] >= 0), 0] = -1.0
+        out[n, :len(dets)] = dets
+    return jnp.asarray(out)

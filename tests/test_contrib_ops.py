@@ -448,3 +448,83 @@ def test_index_copy_out_of_range_errors_eagerly():
     idx = mx.np.array(onp.array([1, 7], onp.int32))
     with pytest.raises(mx.base.MXNetError, match="out of range"):
         mx.npx.index_copy(old, idx, new)
+
+
+# -- SSD multibox target/detection (reference multibox_target.cc /
+#    multibox_detection.cc) -------------------------------------------------
+
+def test_multibox_target_basic_assignment():
+    # anchors: one perfectly on the gt, one far away
+    anchors = onp.array([[[0.1, 0.1, 0.4, 0.4],
+                          [0.6, 0.6, 0.9, 0.9],
+                          [0.5, 0.1, 0.8, 0.35]]], onp.float32)
+    label = onp.array([[[2.0, 0.1, 0.1, 0.4, 0.4],
+                        [-1, -1, -1, -1, -1]]], onp.float32)
+    cls_pred = onp.zeros((1, 4, 3), onp.float32)
+    loc_t, loc_m, cls_t = mx.npx.multibox_target(
+        mx.np.array(anchors), mx.np.array(label), mx.np.array(cls_pred))
+    cls_t = onp.asarray(cls_t)
+    assert cls_t[0, 0] == 3.0  # class 2 -> target 3 (0 is background)
+    assert cls_t[0, 1] == 0.0 and cls_t[0, 2] == 0.0  # negatives
+    lm = onp.asarray(loc_m).reshape(3, 4)
+    assert (lm[0] == 1).all() and (lm[1:] == 0).all()
+    # exact-overlap anchor encodes to all-zero offsets
+    lt = onp.asarray(loc_t).reshape(3, 4)
+    onp.testing.assert_allclose(lt[0], 0.0, atol=1e-5)
+
+
+def test_multibox_target_threshold_match_and_encoding():
+    anchors = onp.array([[[0.0, 0.0, 0.5, 0.5]]], onp.float32)
+    gt = onp.array([0.1, 0.1, 0.5, 0.5], onp.float32)
+    label = onp.concatenate([[0.0], gt])[None, None].astype(onp.float32)
+    cls_pred = onp.zeros((1, 2, 1), onp.float32)
+    loc_t, loc_m, cls_t = mx.npx.multibox_target(
+        mx.np.array(anchors), mx.np.array(label), mx.np.array(cls_pred),
+        overlap_threshold=0.5)
+    lt = onp.asarray(loc_t).reshape(4)
+    aw = ah = 0.5
+    gx, gy = 0.3, 0.3
+    gw = gh = 0.4
+    exp = [(gx - 0.25) / aw / 0.1, (gy - 0.25) / ah / 0.1,
+           onp.log(gw / aw) / 0.2, onp.log(gh / ah) / 0.2]
+    onp.testing.assert_allclose(lt, exp, rtol=1e-4)
+    assert onp.asarray(cls_t)[0, 0] == 1.0
+
+
+def test_multibox_target_negative_mining():
+    rng = onp.random.RandomState(0)
+    anchors = rng.uniform(0, 0.4, (1, 8, 4)).astype(onp.float32)
+    anchors[..., 2:] += 0.5  # valid corner boxes
+    anchors[0, 0] = [0.1, 0.1, 0.3, 0.3]
+    label = onp.array([[[1.0, 0.1, 0.1, 0.3, 0.3]]], onp.float32)
+    cls_pred = rng.randn(1, 3, 8).astype(onp.float32)
+    _, _, cls_t = mx.npx.multibox_target(
+        mx.np.array(anchors), mx.np.array(label), mx.np.array(cls_pred),
+        negative_mining_ratio=2.0, negative_mining_thresh=0.5)
+    cls_t = onp.asarray(cls_t)[0]
+    # 1 positive -> at most 2 mined negatives; the rest stay ignore (-1)
+    assert (cls_t == 2.0).sum() == 1
+    assert (cls_t == 0.0).sum() <= 2
+    assert (cls_t == -1.0).sum() >= 5
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = onp.array([[[0.1, 0.1, 0.3, 0.3],
+                          [0.11, 0.11, 0.31, 0.31],
+                          [0.6, 0.6, 0.9, 0.9]]], onp.float32)
+    # zero offsets: predictions == anchors
+    loc_pred = onp.zeros((1, 12), onp.float32)
+    cls_prob = onp.array([[[0.1, 0.2, 0.2],    # background
+                           [0.8, 0.7, 0.1],    # class 0
+                           [0.1, 0.1, 0.7]]], onp.float32)  # class 1
+    out = onp.asarray(mx.npx.multibox_detection(
+        mx.np.array(cls_prob), mx.np.array(loc_pred), mx.np.array(anchors),
+        nms_threshold=0.5))
+    # anchor 0 (score .8, class 0) kept; overlapping anchor 1 suppressed;
+    # anchor 2 (class 1) kept
+    rows = out[0]
+    kept = rows[rows[:, 0] >= 0]
+    assert len(kept) == 2
+    assert set(kept[:, 0].tolist()) == {0.0, 1.0}
+    best = rows[0]
+    onp.testing.assert_allclose(best[2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
