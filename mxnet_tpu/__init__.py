@@ -66,6 +66,7 @@ def __getattr__(name):
                "sym": ".symbol", "symbol": ".symbol",
                "operator": ".operator", "callback": ".callback",
                "name": ".name", "attribute": ".attribute",
+               "error": ".error", "log": ".log", "libinfo": ".libinfo",
                "model": ".model", "visualization": ".visualization",
                "viz": ".visualization",
                "lr_scheduler": ".optimizer.lr_scheduler"}

@@ -1,0 +1,62 @@
+"""``mx.error`` — error taxonomy (reference ``python/mxnet/error.py``).
+
+The reference maps C++-side error kinds onto Python exception classes via
+``register_error``; here errors originate in Python/XLA, so the taxonomy
+is direct subclasses that ALSO inherit the matching builtin (an
+``mx.error.IndexError`` is catchable as either). ``register`` keeps the
+plugin seam: extension libraries can add their own kinds.
+"""
+from __future__ import annotations
+
+import builtins
+
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
+           "TypeError", "AttributeError", "NotImplementedForSymbol",
+           "register"]
+
+_REGISTRY = {}
+
+
+def register(cls=None, *, name=None):
+    """Register an MXNetError subclass under its name (reference
+    base.py register_error)."""
+
+    def do(c):
+        _REGISTRY[name or c.__name__] = c
+        return c
+
+    return do(cls) if cls is not None else do
+
+
+@register
+class InternalError(MXNetError):
+    """An error that should never happen; indicates a framework bug
+    (reference error.py:31)."""
+
+
+@register
+class IndexError(MXNetError, builtins.IndexError):
+    pass
+
+
+@register
+class ValueError(MXNetError, builtins.ValueError):
+    pass
+
+
+@register
+class TypeError(MXNetError, builtins.TypeError):
+    pass
+
+
+@register
+class AttributeError(MXNetError, builtins.AttributeError):
+    pass
+
+
+@register
+class NotImplementedForSymbol(MXNetError):
+    """Raised when an ndarray-only API is called on a Symbol (reference
+    base.py NotImplementedForSymbol)."""

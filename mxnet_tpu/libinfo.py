@@ -1,0 +1,31 @@
+"""``mx.libinfo`` — version + feature discovery (reference
+``python/mxnet/libinfo.py``). There is no ``libmxnet.so`` to locate: the
+"library" is the Python package itself plus the optional native IO/C-ABI
+shared objects under ``src/`` (see ``mxnet_tpu._native``); paths to those
+are what ``find_lib_path`` returns.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["__version__", "find_lib_path", "find_include_path"]
+
+from . import __version__  # noqa: F401  (single source of truth)
+
+
+def find_lib_path(prefix="libmxtpu"):
+    """Paths of the compiled native helper libraries, if built
+    (mxnet_tpu/_lib/, where ``_native.py`` builds them)."""
+    lib_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "_lib")
+    candidates = [
+        os.path.join(lib_dir, f"{prefix}_io.so"),
+        os.path.join(lib_dir, f"{prefix}_capi.so"),
+    ]
+    return [p for p in candidates if os.path.exists(p)]
+
+
+def find_include_path():
+    """Directory of the extension ABI header (include/mxtpu_ext.h)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "include")

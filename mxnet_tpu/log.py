@@ -1,0 +1,61 @@
+"""``mx.log`` — colored logging helper (reference ``python/mxnet/log.py``).
+
+``get_logger(name, filename, filemode, level)`` returns a configured
+logger with the reference's single-letter level prefix format
+(``I0701 12:00:00 message``-style).
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger",
+           "DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL", "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+CRITICAL = logging.CRITICAL
+NOTSET = logging.NOTSET
+
+_LEVEL_CHAR = {logging.DEBUG: "D", logging.INFO: "I", logging.WARNING: "W",
+               logging.ERROR: "E", logging.CRITICAL: "C"}
+
+
+class _Formatter(logging.Formatter):
+    """reference log.py:34 — level initial + timestamp prefix."""
+
+    def __init__(self, colored=True):
+        self._colored = colored and sys.stderr.isatty()
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def format(self, record):
+        char = _LEVEL_CHAR.get(record.levelno, "U")
+        date = self.formatTime(record, self.datefmt)
+        msg = f"{char}{date} {record.getMessage()}"
+        if self._colored and record.levelno >= logging.ERROR:
+            msg = f"\x1b[31m{msg}\x1b[0m"
+        elif self._colored and record.levelno == logging.WARNING:
+            msg = f"\x1b[33m{msg}\x1b[0m"
+        return msg
+
+
+def get_logger(name=None, filename=None, filemode=None,
+               level=logging.WARNING):
+    """reference log.py:84."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mx_init", False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler()
+    handler.setFormatter(_Formatter(colored=filename is None))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mx_init = True
+    return logger
+
+
+getLogger = get_logger  # reference alias (log.py:74)

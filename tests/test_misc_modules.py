@@ -291,3 +291,16 @@ def test_group_adagrad_rowwise_state():
     hist = 4.0  # mean(square([2,2,2]))
     expect = 1.0 - 0.1 * 2.0 / (onp.sqrt(hist) + 1e-6)
     onp.testing.assert_allclose(w2[1], onp.full(3, expect), rtol=1e-5)
+
+
+def test_error_log_libinfo_modules():
+    assert issubclass(mx.error.IndexError, IndexError)
+    assert issubclass(mx.error.InternalError, mx.base.MXNetError)
+    with pytest.raises(mx.base.MXNetError):
+        raise mx.error.NotImplementedForSymbol("nope")
+    lg = mx.log.get_logger("mx_test_logger", level=mx.log.INFO)
+    assert lg is mx.log.get_logger("mx_test_logger")  # idempotent
+    assert mx.libinfo.find_include_path().endswith("include")
+    libs = mx.libinfo.find_lib_path()
+    assert all(p.endswith(".so") for p in libs)
+    assert mx.libinfo.__version__ == mx.__version__
