@@ -71,3 +71,12 @@ def test_dc_gan_entry_point():
     # mean toward the real data's (-0.6)
     assert fake_mean < -0.05, f"generator did not move: {fake_mean}"
     assert abs(fake_mean - real_mean) < abs(0.0 - real_mean)
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_ssd_entry_point():
+    out = _run("example/gluon/ssd.py", "--epochs", "8")
+    assert out.returncode == 0, out.stderr[-2000:]
+    recall = float(out.stdout.rsplit("recall@0.5=", 1)[1].split()[0])
+    assert recall >= 0.7, f"SSD recall {recall} too low"
