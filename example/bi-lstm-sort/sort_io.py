@@ -1,0 +1,13 @@
+"""Data helpers for the bi-lstm-sort example (reference
+``example/bi-lstm-sort/``): random digit sequences in, sorted out."""
+from __future__ import annotations
+
+import numpy as onp
+
+
+def make_batches(n, seq_len, vocab, batch_size, seed=0):
+    rng = onp.random.RandomState(seed)
+    xs = rng.randint(0, vocab, (n, seq_len)).astype(onp.int32)
+    ys = onp.sort(xs, axis=1)
+    for i in range(0, n - batch_size + 1, batch_size):
+        yield xs[i: i + batch_size], ys[i: i + batch_size]

@@ -13,7 +13,7 @@ from ... import numpy_extension as npx
 from ..block import HybridBlock
 from ..parameter import Parameter
 
-__all__ = ["BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "RMSNorm", "SyncBatchNorm"]
+__all__ = ["BatchNorm", "BatchNormReLU", "LayerNorm", "GroupNorm", "InstanceNorm", "RMSNorm", "SyncBatchNorm"]
 
 
 class BatchNorm(HybridBlock):
@@ -164,3 +164,14 @@ class RMSNorm(HybridBlock):
             self.gamma.shape = (ch,)
             self.gamma.finalize()
         return npx.rms_norm(x, self.gamma.data(), axis=self._axis, eps=self._epsilon)
+
+
+class BatchNormReLU(BatchNorm):
+    """Fused BatchNorm + ReLU (reference basic_layers.py BatchNormReLU —
+    a cuDNN-fused kernel there; here XLA fuses the relu into the BN
+    elementwise chain for free, the class exists for API parity)."""
+
+    def forward(self, x):
+        from ... import numpy_extension as npx
+
+        return npx.activation(super().forward(x), act_type="relu")

@@ -80,3 +80,13 @@ def test_ssd_entry_point():
     assert out.returncode == 0, out.stderr[-2000:]
     recall = float(out.stdout.rsplit("recall@0.5=", 1)[1].split()[0])
     assert recall >= 0.7, f"SSD recall {recall} too low"
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_bi_lstm_sort_entry_point():
+    out = _run("example/bi-lstm-sort/lstm_sort.py",
+               "--epochs", "4", "--ntrain", "1536")
+    assert out.returncode == 0, out.stderr[-2000:]
+    tok = float(out.stdout.rsplit("token_acc=", 1)[1].split()[0])
+    assert tok >= 0.75, f"BiLSTM sort token accuracy too low: {tok}"
