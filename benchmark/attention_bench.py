@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Attention-kernel benchmark: Pallas flash attention vs naive XLA
+attention across sequence lengths.
+
+Long context is first-class in this framework (SURVEY §5: the reference
+materialized O(L²) attention single-device); this measures the fused
+blockwise kernel's throughput and memory headroom on the current device.
+Reports tokens/s for causal self-attention fwd (inference shape) and
+fwd+bwd (training), per sequence length.
+
+CLI:
+    python benchmark/attention_bench.py [--seqs 1024,2048,4096,8192]
+        [--heads 16] [--head-dim 64] [--batch 8] [--output out.json] [--cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(fn, args_, tag, log, min_s=3.0):
+    import jax
+    import jax.numpy as jnp
+
+    jfn = jax.jit(fn)
+    t0 = time.time()
+    out = jfn(*args_)
+    jax.block_until_ready(out)
+    first = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(first.astype(jnp.float32)))
+    log(f"{tag}: compiled in {time.time() - t0:.1f}s")
+    t0 = time.perf_counter()
+    out = jfn(*args_)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    per = max(time.perf_counter() - t0, 1e-4)
+    iters = max(3, min(200, int(min_s / per)))
+    total, dt = 0, 0.0
+    while dt < min_s and total < 2000:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jfn(*args_)
+        float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+        dt += time.perf_counter() - t0
+        total += iters
+    return total / dt  # steps/s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="1024,2048,4096,8192")
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--output", default=None)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import nn as opsnn
+
+    def log(*a):
+        print("[attention_bench]", *a, file=sys.stderr, flush=True)
+
+    log("devices:", jax.devices())
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    B, H, D = args.batch, args.heads, args.head_dim
+    results = []
+    for L in [int(s) for s in args.seqs.split(",")]:
+        rng = onp.random.RandomState(0)
+        qkv = jnp.asarray(
+            rng.randn(B, L, H * D).astype(onp.float32), dt)
+
+        def fwd(x):
+            return opsnn.attend(x, x, x, H, causal=True)
+
+        def train(x):
+            def loss(x_):
+                return jnp.sum(fwd(x_).astype(jnp.float32) ** 2)
+
+            return jax.grad(loss)(x)
+
+        try:
+            f_sps = measure(fwd, (qkv,), f"L={L} fwd", log)
+            t_sps = measure(train, (qkv,), f"L={L} fwd+bwd", log)
+            rec = {"seq_len": L, "batch": B, "heads": H, "head_dim": D,
+                   "dtype": args.dtype,
+                   "fwd_tok_s": round(f_sps * B * L, 1),
+                   "train_tok_s": round(t_sps * B * L, 1)}
+            log(rec)
+            results.append(rec)
+        except Exception as e:  # noqa: BLE001 — one OOM length shouldn't kill the run
+            log(f"L={L} failed: {e!r}")
+            results.append({"seq_len": L, "error": str(e)[:200]})
+    out = {"device": jax.devices()[0].platform,
+           "device_kind": jax.devices()[0].device_kind,
+           "results": results}
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()
