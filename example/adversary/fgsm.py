@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Fast Gradient Sign Method adversarial examples.
+
+Parity target: reference ``example/adversary/adversary_generation.ipynb``
+— train a small CNN, then perturb inputs along the sign of the input
+gradient and watch accuracy collapse. Exercises gradients w.r.t. DATA
+(``x.attach_grad()`` on a non-parameter), the other half of the autograd
+contract.
+
+Offline-friendly: sklearn's 8x8 digits (bundled with the image).
+
+Example:
+    python example/adversary/fgsm.py --epochs 3 --epsilon 0.15
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--epsilon", type=float, default=0.15,
+                   help="L-inf perturbation size (inputs are in [0,1])")
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    X = (digits.images / 16.0).astype(onp.float32)[:, None]  # (N,1,8,8)
+    y = digits.target.astype(onp.int32)
+    ntrain = 1400
+    Xtr, ytr, Xte, yte = X[:ntrain], y[:ntrain], X[ntrain:], y[ntrain:]
+
+    net = nn.HybridSequential(
+        nn.Conv2D(16, 3, padding=1, activation="relu"),
+        nn.MaxPool2D(2),
+        nn.Conv2D(32, 3, padding=1, activation="relu"),
+        nn.Flatten(),
+        nn.Dense(10),
+    )
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        perm = onp.random.RandomState(epoch).permutation(ntrain)
+        tot, t0 = 0.0, time.time()
+        for i in range(0, ntrain - args.batch_size + 1, args.batch_size):
+            idx = perm[i: i + args.batch_size]
+            xb, yb = mx.np.array(Xtr[idx]), mx.np.array(ytr[idx])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss)
+        print(f"epoch {epoch}: loss={tot:.3f} ({time.time() - t0:.1f}s)",
+              flush=True)
+
+    def accuracy(xs, ys):
+        pred = onp.asarray(net(mx.np.array(xs))).argmax(1)
+        return float((pred == ys).mean())
+
+    clean_acc = accuracy(Xte, yte)
+
+    # FGSM: x_adv = clip(x + eps * sign(dL/dx))
+    x = mx.np.array(Xte)
+    x.attach_grad()
+    with autograd.record():
+        loss = gluon.loss.SoftmaxCrossEntropyLoss()(
+            net(x), mx.np.array(yte)).sum()
+    loss.backward()
+    x_adv = onp.clip(
+        Xte + args.epsilon * onp.sign(onp.asarray(x.grad)), 0.0, 1.0)
+    adv_acc = accuracy(x_adv, yte)
+    print(f"final: clean_acc={clean_acc:.3f} adv_acc={adv_acc:.3f} "
+          f"eps={args.epsilon}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

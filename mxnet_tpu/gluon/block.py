@@ -444,6 +444,12 @@ class HybridBlock(Block):
         plist = self._ensure_params_ready(args)
 
         flat_vals, in_treedef = jax.tree_util.tree_flatten(args)
+        # the ORIGINAL ndarray leaves, 1:1 with flat_vals (each ndarray
+        # flattens to exactly its _data): the tape node must reference the
+        # caller's arrays or input gradients (x.attach_grad on data — the
+        # adversarial/style-transfer path) land on orphaned wrappers
+        leaf_arrays = jax.tree_util.tree_flatten(
+            args, is_leaf=lambda v: isinstance(v, ndarray))[0]
         training = autograd_state.training
         sig = (self._signature(flat_vals, training), in_treedef)
         cg = self._cached_graphs.get(sig)
@@ -460,13 +466,14 @@ class HybridBlock(Block):
                     cg = self._build_cache(args, flat_vals, in_treedef,
                                            training, plist)
                     self._cached_graphs[sig] = cg
-                outs = self._run_cached(cg, flat_vals)
+                outs = self._run_cached(cg, flat_vals, leaf_arrays)
                 cg.warm = True
                 return self._finish_cached(cg, outs)
 
-        return self._finish_cached(cg, self._run_cached(cg, flat_vals))
+        return self._finish_cached(
+            cg, self._run_cached(cg, flat_vals, leaf_arrays))
 
-    def _run_cached(self, cg: "_CachedGraph", flat_vals):
+    def _run_cached(self, cg: "_CachedGraph", flat_vals, leaf_arrays=None):
         from ..numpy import random as _random
         from .parameter import _tls_override
 
@@ -478,8 +485,12 @@ class HybridBlock(Block):
             ov = _tls_override(p)
             return p._data if ov is None else ov  # NOT `or`: ndarray bool
 
+        if leaf_arrays is None:
+            leaf_arrays = flat_vals
         arrays = ([pval(p) for _, p in cg.param_list]
-                  + [_wrap(v) for v in flat_vals] + [_wrap(key)])
+                  + [a if isinstance(a, ndarray) else _wrap(v)
+                     for a, v in zip(leaf_arrays, flat_vals)]
+                  + [_wrap(key)])
         n_total = cg.n_outputs + len(cg.mutated_params)
         return self._invoke_cached(cg, arrays, n_total)
 

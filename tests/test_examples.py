@@ -90,3 +90,15 @@ def test_bi_lstm_sort_entry_point():
     assert out.returncode == 0, out.stderr[-2000:]
     tok = float(out.stdout.rsplit("token_acc=", 1)[1].split()[0])
     assert tok >= 0.75, f"BiLSTM sort token accuracy too low: {tok}"
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_fgsm_adversary_entry_point():
+    out = _run("example/adversary/fgsm.py", "--epochs", "3")
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.rsplit("final:", 1)[1]
+    clean = float(line.split("clean_acc=")[1].split()[0])
+    adv = float(line.split("adv_acc=")[1].split()[0])
+    assert clean >= 0.8, f"model failed to train: {clean}"
+    assert adv <= clean - 0.3, f"FGSM had no effect: {clean} -> {adv}"

@@ -417,3 +417,26 @@ def test_substitute_params_tied_weight_no_leak():
     out2 = net(ids).asnumpy()  # warm path after trace exit
     onp.testing.assert_allclose(out1, out2)
     assert out1.shape == (1, 2, 11)
+
+
+def test_hybridized_input_gradients_match_eager():
+    """x.attach_grad() on DATA must flow through the cached op (the
+    adversarial/style-transfer path; was silently zero)."""
+    from mxnet_tpu.gluon import nn
+
+    rng = onp.random.RandomState(3)
+    xv = rng.randn(3, 5).astype(onp.float32)
+    net = nn.HybridSequential(nn.Dense(4, activation="tanh"), nn.Dense(2))
+    net.initialize()
+    grads = []
+    for hyb in (False, True):
+        if hyb:
+            net.hybridize()  # same net, same params
+        x = mx.np.array(xv)
+        x.attach_grad()
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        grads.append(onp.asarray(x.grad))
+    assert onp.abs(grads[0]).sum() > 0
+    onp.testing.assert_allclose(grads[0], grads[1], rtol=1e-4, atol=1e-6)
