@@ -102,3 +102,11 @@ def test_fgsm_adversary_entry_point():
     adv = float(line.split("adv_acc=")[1].split()[0])
     assert clean >= 0.8, f"model failed to train: {clean}"
     assert adv <= clean - 0.3, f"FGSM had no effect: {clean} -> {adv}"
+
+
+@pytest.mark.integration
+def test_multi_threaded_inference_entry_point():
+    out = _run("example/multi_threaded_inference/multi_threaded_inference.py",
+               "--threads", "8", "--requests", "32")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "mismatches=0" in out.stdout
