@@ -14,7 +14,7 @@ from .... import numpy_extension as npx
 from ...parameter import Parameter
 from ...rnn.rnn_cell import RecurrentCell
 
-__all__ = ["VariationalDropoutCell", "LSTMPCell",
+__all__ = ["VariationalDropoutCell", "LSTMPCell", "dynamic_unroll",
            "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
            "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
            "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
@@ -284,3 +284,22 @@ Conv2DGRUCell = _make("Conv2DGRUCell", _ConvGRUCell, 2,
                       "2-D ConvGRU cell (reference conv_rnn_cell.py).")
 Conv3DGRUCell = _make("Conv3DGRUCell", _ConvGRUCell, 3,
                       "3-D ConvGRU cell (reference conv_rnn_cell.py).")
+
+
+def dynamic_unroll(cell, inputs, begin_state, drop_inputs=0.0,
+                   drop_outputs=0.0, layout="TNC", valid_length=None):
+    """reference contrib rnn_cell.py:325 dynamic_unroll — unroll a cell
+    over a sequence with optional variational dropout and valid_length
+    masking. On TPU shapes are static per trace, so this delegates to the
+    cell's trace-time ``unroll`` (the reference used a while_loop to
+    avoid symbol duplication; XLA's rolled lax.scan path is the fused
+    RNN layer, gluon/rnn/rnn_layer.py)."""
+    if drop_inputs or drop_outputs:
+        cell = VariationalDropoutCell(cell, drop_inputs=drop_inputs,
+                                      drop_outputs=drop_outputs)
+    axis = layout.find("T")
+    length = inputs.shape[axis]
+    outputs, states = cell.unroll(length, inputs, begin_state=begin_state,
+                                  layout=layout, merge_outputs=True,
+                                  valid_length=valid_length)
+    return outputs, states

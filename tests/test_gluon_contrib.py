@@ -183,3 +183,18 @@ def test_conv_lstm_grad_flows():
     loss.backward()
     assert onp.isfinite(onp.asarray(cell.h2h_weight.grad())).all()
     assert float(mx.np.abs(cell.i2h_weight.grad()).sum()) > 0
+
+
+def test_dynamic_unroll():
+    from mxnet_tpu.gluon.rnn import LSTMCell
+
+    cell = LSTMCell(6)
+    cell.initialize()
+    x = mx.np.array(onp.random.randn(2, 5, 3).astype(onp.float32))  # NTC
+    vl = mx.np.array(onp.array([3.0, 5.0], onp.float32))
+    outs, states = contrib.rnn.dynamic_unroll(
+        cell, x, cell.begin_state(2), layout="NTC", valid_length=vl)
+    o = onp.asarray(outs)
+    assert o.shape == (2, 5, 6)
+    assert (o[0, 3:] == 0).all()  # masked beyond valid_length
+    assert (o[1] != 0).any()

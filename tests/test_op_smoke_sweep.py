@@ -302,3 +302,19 @@ def test_control_flow_foreach_while():
     ref = onp.zeros((8, 3), onp.float32)
     ref[:5] = onp.stack([onp.full(3, 2.0 * i) for i in range(5)])
     _chk(stacked, ref)
+
+
+def test_control_flow_cond():
+    def then_fn(a):
+        return a * 2.0
+
+    def else_fn(a):
+        return a - 1.0
+
+    x = np.array(onp.ones((3,), onp.float32))
+    out_t = npx.cond(lambda a: a.sum() > 0, then_fn, else_fn, (x,))
+    out_f = npx.cond(lambda a: a.sum() < 0, then_fn, else_fn, (x,))
+    _chk(out_t if not isinstance(out_t, list) else out_t[0],
+         onp.full(3, 2.0, onp.float32))
+    _chk(out_f if not isinstance(out_f, list) else out_f[0],
+         onp.zeros(3, onp.float32))
