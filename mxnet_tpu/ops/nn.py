@@ -117,10 +117,10 @@ def deconvolution(
         y = jnp.concatenate(outs, axis=1)
     else:
         kernel = weight.shape[2:]
-        # lax.conv_transpose with IOHW spec
+        spatial = {1: "W", 2: "HW", 3: "DHW"}[ndim]
         dn = lax.conv_dimension_numbers(
-            x.shape, (weight.shape[1], weight.shape[0]) + kernel, ("NC" + "HW"[:ndim] if ndim == 2 else "NC" + "W", "OI" + ("HW" if ndim == 2 else "W"), "NC" + ("HW" if ndim == 2 else "W"))
-        )
+            x.shape, (weight.shape[1], weight.shape[0]) + kernel,
+            ("NC" + spatial, "OI" + spatial, "NC" + spatial))
         # padding for transpose conv: effective = k - 1 - pad
         pads = [
             (d * (k - 1) - p, d * (k - 1) - p + a)

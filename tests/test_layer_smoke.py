@@ -1,0 +1,195 @@
+"""Smoke-oracle coverage for gluon layers with no other direct test —
+every layer constructs, runs forward (eager AND hybridized), and matches
+a torch/numpy oracle where one is cheap. (The deconvolution op hid a
+TypeError for a full round because nothing instantiated Conv2DTranspose;
+this module closes that class of gap for layers.)"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import nn, rnn
+
+
+def _run_both(layer, x):
+    """Forward eager + hybridized; assert identical."""
+    out1 = onp.asarray(layer(mx.np.array(x)))
+    layer.hybridize()
+    out2 = onp.asarray(layer(mx.np.array(x)))
+    onp.testing.assert_allclose(out1, out2, rtol=1e-5, atol=1e-6)
+    return out1
+
+
+@pytest.mark.seed(5)
+@pytest.mark.parametrize("cls,ndim", [
+    (nn.Conv1D, 1), (nn.Conv3D, 3),
+])
+def test_convs_vs_torch(cls, ndim):
+    import torch
+
+    layer = cls(4, kernel_size=3, padding=1)
+    layer.initialize()
+    spatial = (6,) * ndim
+    x = onp.random.randn(2, 3, *spatial).astype(onp.float32)
+    out = _run_both(layer, x)
+    w = torch.from_numpy(onp.asarray(layer.weight.data()))
+    b = torch.from_numpy(onp.asarray(layer.bias.data()))
+    tfn = {1: torch.nn.functional.conv1d,
+           3: torch.nn.functional.conv3d}[ndim]
+    ref = tfn(torch.from_numpy(x), w, b, padding=1).numpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.seed(6)
+@pytest.mark.parametrize("cls,ndim", [
+    (nn.Conv1DTranspose, 1), (nn.Conv2DTranspose, 2), (nn.Conv3DTranspose, 3),
+])
+def test_transposed_convs_vs_torch(cls, ndim):
+    import torch
+
+    layer = cls(4, kernel_size=3, strides=2, padding=1, output_padding=1)
+    layer.initialize()
+    spatial = (5,) * ndim
+    x = onp.random.randn(2, 3, *spatial).astype(onp.float32)
+    out = _run_both(layer, x)
+    w = torch.from_numpy(onp.asarray(layer.weight.data()))
+    b = torch.from_numpy(onp.asarray(layer.bias.data()))
+    tfn = {1: torch.nn.functional.conv_transpose1d,
+           2: torch.nn.functional.conv_transpose2d,
+           3: torch.nn.functional.conv_transpose3d}[ndim]
+    ref = tfn(torch.from_numpy(x), w, b, stride=2, padding=1,
+              output_padding=1).numpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.seed(7)
+@pytest.mark.parametrize("cls,tref", [
+    ("AvgPool1D", "avg_pool1d"), ("AvgPool2D", "avg_pool2d"),
+    ("AvgPool3D", "avg_pool3d"), ("MaxPool1D", "max_pool1d"),
+    ("MaxPool3D", "max_pool3d"),
+])
+def test_pools_vs_torch(cls, tref):
+    import torch
+
+    ndim = int(cls[-2])
+    layer = getattr(nn, cls)(pool_size=2, strides=2)
+    x = onp.random.randn(2, 3, *((8,) * ndim)).astype(onp.float32)
+    out = _run_both(layer, x)
+    ref = getattr(torch.nn.functional, tref)(
+        torch.from_numpy(x), kernel_size=2, stride=2).numpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("cls", ["GlobalAvgPool1D", "GlobalAvgPool3D",
+                                 "GlobalMaxPool1D", "GlobalMaxPool2D",
+                                 "GlobalMaxPool3D"])
+def test_global_pools(cls):
+    ndim = int(cls[-2])
+    layer = getattr(nn, cls)()
+    x = onp.random.randn(2, 3, *((5,) * ndim)).astype(onp.float32)
+    out = _run_both(layer, x)
+    red = x.mean(axis=tuple(range(2, 2 + ndim))) if "Avg" in cls else \
+        x.max(axis=tuple(range(2, 2 + ndim)))
+    onp.testing.assert_allclose(out.reshape(2, 3), red, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.seed(8)
+def test_activation_layers_oracle():
+    x = onp.random.randn(3, 4).astype(onp.float32)
+    import torch
+
+    tx = torch.from_numpy(x)
+    cases = [
+        (nn.ELU(), torch.nn.functional.elu(tx).numpy()),
+        (nn.GELU(), torch.nn.functional.gelu(tx).numpy()),
+        (nn.SELU(), torch.nn.functional.selu(tx).numpy()),
+        (nn.SiLU(), torch.nn.functional.silu(tx).numpy()),
+        (nn.Swish(), torch.nn.functional.silu(tx).numpy()),
+        (nn.LeakyReLU(0.1),
+         torch.nn.functional.leaky_relu(tx, 0.1).numpy()),
+    ]
+    for layer, ref in cases:
+        out = _run_both(layer, x)
+        onp.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.seed(9)
+def test_prelu_trains_slope():
+    layer = nn.PReLU()
+    layer.initialize()
+    x = mx.np.array(onp.random.randn(4, 5).astype(onp.float32))
+    with autograd.record():
+        loss = (layer(x) ** 2).sum()
+    loss.backward()
+    g = layer.alpha.grad() if hasattr(layer, "alpha") else \
+        list(layer.collect_params().values())[0].grad()
+    assert float(mx.np.abs(g).sum()) > 0
+
+
+@pytest.mark.seed(10)
+def test_norm_layers_vs_torch():
+    import torch
+
+    x = onp.random.randn(2, 6, 5).astype(onp.float32)
+    ln = nn.LayerNorm(in_channels=5)
+    ln.initialize()
+    out = _run_both(ln, x)
+    ref = torch.nn.functional.layer_norm(torch.from_numpy(x), (5,)).numpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    gn = nn.GroupNorm(num_groups=3, in_channels=6)
+    gn.initialize()
+    out = _run_both(gn, x)
+    ref = torch.nn.functional.group_norm(torch.from_numpy(x), 3).numpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    inorm = nn.InstanceNorm(in_channels=6)
+    inorm.initialize()
+    out = _run_both(inorm, x)
+    ref = torch.nn.functional.instance_norm(torch.from_numpy(x)).numpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    rms = nn.RMSNorm(in_channels=5)
+    rms.initialize()
+    out = _run_both(rms, x)
+    ref = x / onp.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_lambda_concatenate_ffn():
+    hl = nn.HybridLambda(lambda x: x * 2)
+    x = onp.ones((2, 3), onp.float32)
+    onp.testing.assert_allclose(onp.asarray(hl(mx.np.array(x))), x * 2)
+
+    cat = nn.HybridConcatenate(axis=-1)
+    cat.add(nn.HybridLambda(lambda x: x))
+    cat.add(nn.HybridLambda(lambda x: x + 1))
+    out = onp.asarray(cat(mx.np.array(x)))
+    assert out.shape == (2, 6)
+
+    ffn = nn.PositionwiseFFN(units=8, hidden_size=16)
+    ffn.initialize()
+    out = ffn(mx.np.array(onp.random.randn(2, 4, 8).astype(onp.float32)))
+    assert out.shape == (2, 4, 8)
+
+    bnr = nn.BatchNormReLU(in_channels=3)
+    bnr.initialize()
+    out = onp.asarray(bnr(mx.np.array(
+        onp.random.randn(2, 3, 4, 4).astype(onp.float32))))
+    assert (out >= 0).all()
+
+
+def test_dropout_zoneout_cells():
+    base = rnn.RNNCell(6)
+    cell = rnn.SequentialRNNCell(base, rnn.DropoutCell(0.5))
+    cell.initialize()
+    x = mx.np.array(onp.ones((3, 4), onp.float32))
+    with autograd.record(train_mode=True):
+        out, _ = cell(x, cell.begin_state(3))
+    assert out.shape == (3, 6)
+
+    z = rnn.ZoneoutCell(rnn.LSTMCell(5), zoneout_states=0.3)
+    z.initialize()
+    x2 = mx.np.array(onp.random.randn(2, 3).astype(onp.float32))
+    out, states = z(x2, z.begin_state(2))
+    assert out.shape == (2, 5) and len(states) == 2
