@@ -316,7 +316,9 @@ class CosineEmbeddingLoss(Loss):
         self._margin = margin
 
     def forward(self, input1, input2, label, sample_weight=None):
-        input1 = _reshape_like(input1, input2)
+        # _reshape_like returns the reshaped SECOND argument (label-side);
+        # assigning it to input1 made this loss compute cos(x2, x2) == 1
+        input2 = _reshape_like(input1, input2)
         cos = np.sum(input1 * input2, axis=-1) / (
             np.linalg.norm(input1, axis=-1) * np.linalg.norm(input2, axis=-1) + 1e-12
         )

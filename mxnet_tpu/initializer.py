@@ -220,7 +220,7 @@ class Bilinear(Initializer):
         c = (2 * f - 1 - f % 2) / (2.0 * f)
         for i in range(int(onp.prod(shape))):
             x = i % shape[3]
-            y = (i / shape[3]) % shape[2]
+            y = (i // shape[3]) % shape[2]  # integer row index
             weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
         arr._set_data(jnp.asarray(weight.reshape(shape), arr.dtype))
 
@@ -232,6 +232,12 @@ class LSTMBias(Initializer):
     def __init__(self, forget_bias=1.0):
         super().__init__(forget_bias=forget_bias)
         self.forget_bias = forget_bias
+
+    def init_array(self, name, arr):
+        # bypass the base-class bias-suffix zero heuristic: a param-level
+        # LSTMBias must reach its own rule (the reference routes explicit
+        # __init__ attrs straight to _init_weight, initializer.py:140)
+        self._init_weight(name, arr, None)
 
     def _init_weight(self, name, arr, key):
         b = onp.zeros(arr.shape, dtype="float32")

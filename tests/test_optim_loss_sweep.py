@@ -1,0 +1,172 @@
+"""Sweep every optimizer, loss, initializer and LR scheduler that had no
+direct test: optimizers must actually DESCEND a quadratic, losses match
+torch/numpy oracles, initializers produce their defining structure."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import loss as L
+
+ALL_OPTS = ["sgd", "nag", "adam", "adamw", "adamax", "nadam", "adagrad",
+            "adadelta", "rmsprop", "ftrl", "ftml", "lamb", "lars",
+            "dcasgd", "sgld", "signum", "groupadagrad"]
+
+
+# adadelta's effective step is eps/rho-driven (reference default lr=1.0);
+# sgld injects sqrt(lr) gaussian noise so it samples, not converges
+OPT_LR = {"adadelta": 1.0, "sgld": 0.002}
+
+
+@pytest.mark.seed(3)
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_optimizer_descends_quadratic(name):
+    """min ||w - t||^2: after 60 steps every optimizer must cut the loss."""
+    t = onp.linspace(-1, 1, 6).reshape(2, 3).astype(onp.float32)
+    opt = mx.optimizer.create(name, learning_rate=OPT_LR.get(name, 0.05))
+    w = mx.np.array(onp.zeros((2, 3), onp.float32))
+    w.attach_grad()
+    state = opt.create_state(0, w)
+    first = None
+    for _ in range(60):
+        with autograd.record():
+            loss = ((w - mx.np.array(t)) ** 2).sum()
+        loss.backward()
+        if first is None:
+            first = float(loss)
+        opt.update(0, w, w.grad, state)
+        state = opt._latest_states[0] if hasattr(opt, "_latest_states") \
+            and 0 in getattr(opt, "_latest_states", {}) else state
+    final = float(((w - mx.np.array(t)) ** 2).sum())
+    # sgld injects noise; signum is sign-based — allow looser cuts
+    factor = 0.9 if name in ("sgld", "signum", "dcasgd", "adadelta") else 0.2
+    assert final < first * factor, f"{name}: {first} -> {final}"
+
+
+@pytest.mark.seed(4)
+def test_losses_vs_torch():
+    import torch
+
+    p = onp.random.randn(8, 5).astype(onp.float32)
+    y = onp.random.randn(8, 5).astype(onp.float32)
+    tp, ty = torch.from_numpy(p), torch.from_numpy(y)
+
+    def close(got, want, rtol=1e-4):
+        onp.testing.assert_allclose(onp.asarray(got).mean(),
+                                    want, rtol=rtol, atol=1e-5)
+
+    close(L.L1Loss()(mx.np.array(p), mx.np.array(y)),
+          torch.nn.functional.l1_loss(tp, ty).item())
+    close(L.HuberLoss(rho=1.0)(mx.np.array(p), mx.np.array(y)),
+          torch.nn.functional.smooth_l1_loss(tp, ty).item())
+    # BCE with logits
+    yb = (onp.random.rand(8, 5) > 0.5).astype(onp.float32)
+    close(L.SigmoidBinaryCrossEntropyLoss()(mx.np.array(p),
+                                            mx.np.array(yb)),
+          torch.nn.functional.binary_cross_entropy_with_logits(
+              tp, torch.from_numpy(yb)).item())
+    # KLDiv (from_logits=True means inputs are log-probs)
+    logq = onp.log(onp.random.dirichlet(onp.ones(5), 8).astype(onp.float32))
+    prob = onp.random.dirichlet(onp.ones(5), 8).astype(onp.float32)
+    close(L.KLDivLoss(from_logits=True)(mx.np.array(logq),
+                                        mx.np.array(prob)),
+          (torch.nn.functional.kl_div(torch.from_numpy(logq),
+                                      torch.from_numpy(prob),
+                                      reduction="batchmean") / 5).item(),
+          rtol=1e-3)
+    # Poisson NLL
+    lam = onp.random.uniform(0.5, 2, (8,)).astype(onp.float32)
+    tgt = onp.random.poisson(1.0, (8,)).astype(onp.float32)
+    close(L.PoissonNLLLoss(from_logits=False)(mx.np.array(lam),
+                                              mx.np.array(tgt)),
+          torch.nn.functional.poisson_nll_loss(
+              torch.from_numpy(lam), torch.from_numpy(tgt),
+              log_input=False, full=False).item(), rtol=1e-3)
+    # Triplet
+    a = onp.random.randn(8, 5).astype(onp.float32)
+    pos = onp.random.randn(8, 5).astype(onp.float32)
+    neg = onp.random.randn(8, 5).astype(onp.float32)
+    ours = onp.asarray(L.TripletLoss(margin=1.0)(
+        mx.np.array(a), mx.np.array(pos), mx.np.array(neg))).mean()
+    ref = onp.maximum(
+        1.0 + ((a - pos) ** 2).sum(1) - ((a - neg) ** 2).sum(1), 0).mean()
+    onp.testing.assert_allclose(ours, ref, rtol=1e-4)
+    # Hinge family on +-1 labels
+    yl = onp.where(onp.random.rand(8, 5) > 0.5, 1.0, -1.0).astype(onp.float32)
+    ours = onp.asarray(L.HingeLoss()(mx.np.array(p), mx.np.array(yl))).mean()
+    onp.testing.assert_allclose(ours, onp.maximum(0, 1 - p * yl).mean(),
+                                rtol=1e-4)
+    ours = onp.asarray(L.SquaredHingeLoss()(mx.np.array(p),
+                                            mx.np.array(yl))).mean()
+    onp.testing.assert_allclose(ours,
+                                (onp.maximum(0, 1 - p * yl) ** 2).mean(),
+                                rtol=1e-4)
+    # Cosine embedding
+    ours = onp.asarray(L.CosineEmbeddingLoss()(
+        mx.np.array(a), mx.np.array(pos),
+        mx.np.array(onp.ones(8, onp.float32)))).mean()
+    cos = (a * pos).sum(1) / (onp.linalg.norm(a, axis=1)
+                              * onp.linalg.norm(pos, axis=1) + 1e-12)
+    onp.testing.assert_allclose(ours, (1 - cos).mean(), rtol=1e-3)
+
+
+@pytest.mark.seed(5)
+def test_initializer_structures():
+    from mxnet_tpu.gluon import nn
+
+    # Normal: std close to requested
+    d = nn.Dense(64, in_units=128)
+    d.initialize(mx.init.Normal(0.05))
+    w = onp.asarray(d.weight.data())
+    assert 0.03 < w.std() < 0.07 and abs(w.mean()) < 0.01
+
+    # Orthogonal: W @ W.T == I for square-ish
+    d2 = nn.Dense(32, in_units=32, use_bias=False)
+    d2.initialize(mx.init.Orthogonal(scale=1.0))
+    w2 = onp.asarray(d2.weight.data())
+    onp.testing.assert_allclose(w2 @ w2.T, onp.eye(32), atol=1e-4)
+
+    # MSRAPrelu: variance ~ 2/((1+a^2)*fan_in)
+    d3 = nn.Dense(64, in_units=256)
+    d3.initialize(mx.init.MSRAPrelu())
+    w3 = onp.asarray(d3.weight.data())
+    expect = onp.sqrt(2.0 / 256)
+    assert 0.5 * expect < w3.std() < 1.5 * expect
+
+    # Bilinear: separable upsampling kernel, symmetric, rows sum sensibly
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    p = Parameter("w", shape=(1, 1, 4, 4))
+    p.initialize(init=mx.init.Bilinear(), default_init=mx.init.Bilinear())
+    k = onp.asarray(p.data())[0, 0]
+    onp.testing.assert_allclose(k, k.T, atol=1e-6)
+    onp.testing.assert_allclose(k, k[::-1, ::-1], atol=1e-6)
+
+    # LSTMBias: forget-gate slice = 1, others 0 (4*H bias, [i,f,c,o])
+    H = 8
+    pb = Parameter("lstm_i2h_bias", shape=(4 * H,))
+    pb.initialize(init=mx.init.LSTMBias(forget_bias=1.0),
+                  default_init=mx.init.LSTMBias(forget_bias=1.0))
+    b = onp.asarray(pb.data())
+    assert (b[H:2 * H] == 1.0).all()
+    assert (b[:H] == 0).all() and (b[2 * H:] == 0).all()
+
+
+def test_lr_scheduler_curves():
+    from mxnet_tpu.optimizer import lr_scheduler as S
+
+    mf = S.MultiFactorScheduler(step=[10, 20], factor=0.1, base_lr=1.0)
+    assert mf(5) == pytest.approx(1.0)
+    assert mf(15) == pytest.approx(0.1)
+    assert mf(25) == pytest.approx(0.01)
+
+    poly = S.PolyScheduler(max_update=100, base_lr=1.0, pwr=2,
+                           final_lr=0.0)
+    assert poly(0) == pytest.approx(1.0)
+    assert poly(100) == pytest.approx(0.0, abs=1e-6)
+    assert poly(50) == pytest.approx(0.25, rel=1e-3)
+
+    cos = S.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert cos(0) == pytest.approx(1.0)
+    assert cos(50) == pytest.approx(0.5, rel=1e-3)
+    assert cos(100) == pytest.approx(0.0, abs=1e-6)
