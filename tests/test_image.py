@@ -115,3 +115,36 @@ def test_image_iter_from_lst(tmp_path):
                         path_imglist=prefix + ".lst", path_root=str(imgdir))
     b = next(it)
     assert b.data[0].shape == (2, 3, 20, 24)
+
+
+def test_image_tail_functions(tmp_path):
+    """Previously-uncovered mx.image functions: fixed_crop,
+    random_size_crop, imdecode, imsave, CenterCropAug."""
+    import io as _io
+
+    from PIL import Image
+
+    img = onp.random.RandomState(0).randint(
+        0, 255, (12, 16, 3)).astype(onp.uint8)
+
+    c = mx.image.fixed_crop(mx.np.array(img), 2, 1, 8, 6)
+    onp.testing.assert_array_equal(onp.asarray(c), img[1:7, 2:10])
+
+    out, (x, y, w, h) = mx.image.random_size_crop(
+        mx.np.array(img), (8, 6), area=(0.3, 0.9), ratio=(0.7, 1.4))
+    assert out.shape[:2] == (6, 8)
+    assert 0 <= x <= 16 - w and 0 <= y <= 12 - h
+
+    aug = mx.image.CenterCropAug((8, 6))
+    cc = aug(mx.np.array(img))
+    assert onp.asarray(cc).shape[:2] == (6, 8)
+
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    dec = mx.image.imdecode(buf.getvalue())
+    onp.testing.assert_array_equal(onp.asarray(dec), img)
+
+    path = str(tmp_path / "x.png")
+    mx.image.imsave(path, mx.np.array(img))
+    onp.testing.assert_array_equal(
+        onp.asarray(Image.open(path)), img)
