@@ -122,11 +122,18 @@ def child(platform: str) -> None:
 
     # headline: bf16, the TPU-native precision (the reference's headline
     # reduced-precision number is V100 fp16, perf.md:202-216); fp32 kept
-    # as a secondary field against the fp32 baseline (perf.md:186-198)
-    p_bf16 = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
-              for k, v in params.items()}
-    bf16_img_s, bf16_iters = measure(p_bf16, x_np, jnp.bfloat16)
-    fp32_img_s, fp32_iters = measure(params, x_np, jnp.float32)
+    # as a secondary field against the fp32 baseline (perf.md:186-198).
+    # CPU fallback: bf16 is EMULATED on CPU (several times slower than
+    # fp32) and could blow the attempt timeout — measure fp32 only and
+    # report it for both fields with the note making that explicit.
+    if platform == "cpu":
+        fp32_img_s, fp32_iters = measure(params, x_np, jnp.float32)
+        bf16_img_s, bf16_iters = fp32_img_s, fp32_iters
+    else:
+        p_bf16 = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
+                  for k, v in params.items()}
+        bf16_img_s, bf16_iters = measure(p_bf16, x_np, jnp.bfloat16)
+        fp32_img_s, fp32_iters = measure(params, x_np, jnp.float32)
     rec = {
         "metric": METRIC,
         "value": round(bf16_img_s, 2),
@@ -139,7 +146,8 @@ def child(platform: str) -> None:
         "fp32_iters": fp32_iters,
     }
     if platform == "cpu":
-        rec["note"] = "cpu fallback (TPU backend unavailable)"
+        rec["note"] = ("cpu fallback (TPU backend unavailable); fp32 "
+                       "measured, bf16 fields mirror fp32")
     print(json.dumps(rec), flush=True)
 
 
