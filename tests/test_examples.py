@@ -110,3 +110,14 @@ def test_multi_threaded_inference_entry_point():
                "--threads", "8", "--requests", "32")
     assert out.returncode == 0, out.stderr[-2000:]
     assert "mismatches=0" in out.stdout
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_matrix_fact_recommender_entry_point():
+    out = _run("example/recommenders/matrix_fact.py", "--epochs", "8")
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.rsplit("final:", 1)[1]
+    rmse = float(line.split("val_rmse=")[1].split()[0])
+    base = float(line.split("mean_baseline_rmse=")[1].split()[0])
+    assert rmse < 0.5 * base, f"MF failed to learn: {rmse} vs baseline {base}"
