@@ -1,5 +1,6 @@
 """opperf + bandwidth harness smoke tests (reference benchmark/opperf +
 tools/bandwidth README schemas)."""
+import os
 import numpy as onp
 
 
@@ -42,3 +43,41 @@ def test_bandwidth_schema():
     expected = onp.arange(len(devs) * 4, dtype=onp.float32).reshape(
         len(devs), 4).sum(0)
     onp.testing.assert_allclose(onp.asarray(out)[:4], expected)
+
+
+def test_rec2idx_roundtrip(tmp_path):
+    import subprocess
+    import sys
+
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(5):
+        w.write(bytes([65 + i]) * 10)
+    w.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable,
+                        os.path.join(repo, "tools", "rec2idx.py"), rec],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    ir = recordio.IndexedRecordIO(str(tmp_path / "a.idx"), rec, "r")
+    assert ir.read_idx(ir.keys[3]) == b"D" * 10
+
+
+def test_parse_log(tmp_path):
+    import subprocess
+    import sys
+
+    log = tmp_path / "t.log"
+    log.write_text("epoch 0: loss=1.5 acc=0.5\n"
+                   "Epoch[1] Validation-accuracy=0.9\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable,
+                        os.path.join(repo, "tools", "parse_log.py"),
+                        str(log), "--format", "csv"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0].startswith("epoch,")
+    assert lines[1].startswith("0,") and lines[2].startswith("1,")

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Run a test many times to measure flakiness (reference
+``tools/flakiness_checker.py``): repeats a pytest node N times with
+fresh random seeds and reports the failure rate.
+
+    python tools/flakiness_checker.py tests/test_op_sweep.py::test_matmul_numeric_grad -n 20
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("test", help="pytest node id (file[::test])")
+    ap.add_argument("-n", "--trials", type=int, default=10)
+    ap.add_argument("--stop-on-fail", action="store_true")
+    args = ap.parse_args()
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    failures = []
+    for trial in range(args.trials):
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", args.test, "-q", "-x"],
+            capture_output=True, text=True, cwd=root,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        status = "PASS" if proc.returncode == 0 else "FAIL"
+        print(f"trial {trial}: {status}", flush=True)
+        if proc.returncode != 0:
+            failures.append(trial)
+            seed_lines = [ln for ln in proc.stdout.splitlines()
+                          if "test seed" in ln]
+            if seed_lines:
+                print("  " + seed_lines[-1].strip())
+            if args.stop_on_fail:
+                break
+    rate = len(failures) / max(trial + 1, 1)
+    print(f"flakiness: {len(failures)}/{trial + 1} failed "
+          f"({100 * rate:.1f}%)")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Parse training logs into a metric table (reference
+``tools/parse_log.py``): extracts per-epoch train/validation metrics
+from Speedometer/epoch-logger output in either the reference's
+``Epoch[3] Validation-accuracy=0.92`` format or this repo's
+``epoch 3: loss=1.23 val_psnr=19.2`` example format; prints a markdown
+table and optionally CSV.
+
+    python tools/parse_log.py train.log [--format csv]
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+# Epoch[3] Validation-accuracy=0.92  /  Epoch[3] Train-accuracy=0.95
+_REF = re.compile(r"Epoch\[(\d+)\].*?([\w-]+)=([0-9.eE+-]+)")
+# epoch 3: loss=1.23 val_psnr=19.2dB (units stripped)
+_OURS = re.compile(r"epoch (\d+): (.*)")
+_KV = re.compile(r"([\w@.]+)=([0-9.eE+-]+)")
+
+
+def parse(lines):
+    """Return (sorted epoch list, {metric: {epoch: value}})."""
+    table = {}
+
+    def put(epoch, metric, value):
+        table.setdefault(metric, {})[epoch] = value
+
+    for line in lines:
+        m = _OURS.search(line)
+        if m:
+            epoch = int(m.group(1))
+            for k, v in _KV.findall(m.group(2)):
+                put(epoch, k, float(v))
+            continue
+        for epoch, metric, value in _REF.findall(line):
+            try:
+                put(int(epoch), metric, float(value))
+            except ValueError:
+                continue
+    epochs = sorted({e for col in table.values() for e in col})
+    return epochs, table
+
+
+def render(epochs, table, fmt):
+    metrics = sorted(table)
+    if fmt == "csv":
+        yield ",".join(["epoch"] + metrics)
+        for e in epochs:
+            yield ",".join([str(e)] + [
+                f"{table[m][e]:g}" if e in table[m] else ""
+                for m in metrics])
+    else:
+        yield "| epoch | " + " | ".join(metrics) + " |"
+        yield "|---" * (len(metrics) + 1) + "|"
+        for e in epochs:
+            cells = [f"{table[m][e]:g}" if e in table[m] else ""
+                     for m in metrics]
+            yield f"| {e} | " + " | ".join(cells) + " |"
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=["markdown", "csv"],
+                    default="markdown")
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        epochs, table = parse(f)
+    if not epochs:
+        print("no epoch metrics found", file=sys.stderr)
+        sys.exit(1)
+    for line in render(epochs, table, args.format):
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Rebuild the ``.idx`` file for an existing ``.rec`` RecordIO file
+(reference ``tools/rec2idx.py``): walks the records sequentially and
+writes ``key\\toffset`` lines.
+
+    python tools/rec2idx.py data.rec data.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def create_index(rec_path: str, idx_path: str, key_type=int) -> int:
+    reader = recordio.MXRecordIO(rec_path, "r")
+    counter = 0
+    with open(idx_path, "w") as f:
+        while True:
+            offset = reader.tell()
+            rec = reader.read()
+            if rec is None:
+                break
+            f.write(f"{key_type(counter)}\t{offset}\n")
+            counter += 1
+    reader.close()
+    return counter
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("record", help="path to .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx path (default: alongside .rec)")
+    args = ap.parse_args()
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = create_index(args.record, idx)
+    print(f"wrote {n} entries to {idx}")
+
+
+if __name__ == "__main__":
+    main()
