@@ -121,3 +121,14 @@ def test_matrix_fact_recommender_entry_point():
     rmse = float(line.split("val_rmse=")[1].split()[0])
     base = float(line.split("mean_baseline_rmse=")[1].split()[0])
     assert rmse < 0.5 * base, f"MF failed to learn: {rmse} vs baseline {base}"
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_lstm_crf_entry_point():
+    out = _run("example/gluon/lstm_crf.py", "--epochs", "3",
+               "--ntrain", "512")
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.rsplit("final:", 1)[1]
+    vit = float(line.split("viterbi_acc=")[1].split()[0])
+    assert vit >= 0.5, f"CRF tagging accuracy too low: {vit} (chance 0.2)"
