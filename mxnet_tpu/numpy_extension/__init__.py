@@ -412,11 +412,13 @@ def gammaln(x):
 
 
 def index_add(data, indices, values):
-    return _call(lambda d, i, v: d.at[tuple(i.astype(jnp.int32))].add(v), (data, indices, values), name="index_add")
+    # int64 indices: int32 overflows beyond 2^31 elements (the reference's
+    # USE_INT64_TENSOR_SIZE large-tensor support; jax_enable_x64 is on)
+    return _call(lambda d, i, v: d.at[tuple(i.astype(jnp.int64))].add(v), (data, indices, values), name="index_add")
 
 
 def index_update(data, indices, values):
-    return _call(lambda d, i, v: d.at[tuple(i.astype(jnp.int32))].set(v), (data, indices, values), name="index_update")
+    return _call(lambda d, i, v: d.at[tuple(i.astype(jnp.int64))].set(v), (data, indices, values), name="index_update")
 
 
 # control-flow ops (reference src/operator/control_flow.cc foreach/while_loop/cond)
