@@ -132,3 +132,14 @@ def test_lstm_crf_entry_point():
     line = out.stdout.rsplit("final:", 1)[1]
     vit = float(line.split("viterbi_acc=")[1].split()[0])
     assert vit >= 0.5, f"CRF tagging accuracy too low: {vit} (chance 0.2)"
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_vae_entry_point():
+    out = _run("example/autoencoder/vae.py", "--epochs", "10")
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.rsplit("final:", 1)[1]
+    mse = float(line.split("test_mse=")[1].split()[0])
+    base = float(line.split("mean_baseline_mse=")[1].split()[0])
+    assert mse < base, f"VAE reconstruction ({mse}) no better than mean ({base})"
