@@ -61,8 +61,11 @@ def main():
         def forward(self, x):
             h = self.enc(x)
             mu, logvar = h[:, : args.latent], h[:, args.latent:]
-            eps = np.random.normal(0, 1, mu.shape)
-            z = mu + np.exp(0.5 * logvar) * eps  # reparameterization
+            if autograd.is_training():
+                eps = np.random.normal(0, 1, mu.shape)
+                z = mu + np.exp(0.5 * logvar) * eps  # reparameterization
+            else:
+                z = mu  # eval: decode the posterior mean
             logits = self.dec(z)
             return logits, mu, logvar
 
