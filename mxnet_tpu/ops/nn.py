@@ -519,16 +519,14 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
 
 
 def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32"):
-    """reference src/operator/tensor/ordering_op.cc"""
-    src = -data if not is_ascend else data
-    moved = jnp.moveaxis(src, axis, -1)
-    vals, idxs = lax.top_k(-moved if is_ascend else moved, k)
+    """reference src/operator/tensor/ordering_op.cc: k LARGEST entries by
+    default, k smallest with ``is_ascend=True``."""
+    moved = jnp.moveaxis(data, axis, -1)
     if is_ascend:
-        moved_v = jnp.moveaxis(data, axis, -1)
-        idxs = jnp.argsort(moved_v, axis=-1)[..., :k]
-        vals = jnp.take_along_axis(moved_v, idxs, axis=-1)
+        idxs = jnp.argsort(moved, axis=-1)[..., :k]
+        vals = jnp.take_along_axis(moved, idxs, axis=-1)
     else:
-        vals = jnp.take_along_axis(jnp.moveaxis(data, axis, -1), idxs, axis=-1)
+        vals, idxs = lax.top_k(moved, k)
     vals = jnp.moveaxis(vals, -1, axis)
     idxs = jnp.moveaxis(idxs, -1, axis)
     if ret_typ == "indices":

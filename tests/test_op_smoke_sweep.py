@@ -318,3 +318,23 @@ def test_control_flow_cond():
          onp.full(3, 2.0, onp.float32))
     _chk(out_f if not isinstance(out_f, list) else out_f[0],
          onp.zeros(3, onp.float32))
+
+
+def test_topk_oracle():
+    """reference ordering_op.cc: default = k LARGEST (descending),
+    is_ascend=True = k smallest. Was returning smallest-k always."""
+    x = onp.array([[3.0, 1.0, 2.0], [5.0, 6.0, 4.0]], onp.float32)
+    idx = onp.asarray(npx.topk(np.array(x), k=2))
+    onp.testing.assert_array_equal(idx, [[0, 2], [1, 0]])
+    vals = onp.asarray(npx.topk(np.array(x), k=2, ret_typ="value"))
+    onp.testing.assert_array_equal(vals, [[3.0, 2.0], [6.0, 5.0]])
+    asc = onp.asarray(npx.topk(np.array(x), k=2, is_ascend=True,
+                               ret_typ="value"))
+    onp.testing.assert_array_equal(asc, [[1.0, 2.0], [4.0, 5.0]])
+    v, i = npx.topk(np.array(x), k=1, ret_typ="both")
+    onp.testing.assert_array_equal(onp.asarray(v), [[3.0], [6.0]])
+    mask = onp.asarray(npx.topk(np.array(x), k=2, ret_typ="mask"))
+    onp.testing.assert_array_equal(mask, [[1, 0, 1], [1, 1, 0]])
+    # axis=0
+    col = onp.asarray(npx.topk(np.array(x), k=1, axis=0, ret_typ="value"))
+    onp.testing.assert_array_equal(col, [[5.0, 6.0, 4.0]])
