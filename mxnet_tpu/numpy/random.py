@@ -178,8 +178,12 @@ def gumbel(loc=0.0, scale=1.0, size=None, dtype="float32"):
 
 
 def pareto(a, size=None, dtype="float32"):
+    # numpy convention (Lomax, support [0, inf)): jax.random.pareto
+    # returns the classical Pareto on [1, inf) — shift down by 1
     a_v = _unwrap(a) if isinstance(a, ndarray) else a
-    return _sample(lambda k: jax.random.pareto(k, a_v, shape=_shape(size) if size is not None else None), dtype)
+    return _sample(lambda k: jax.random.pareto(
+        k, a_v, shape=_shape(size) if size is not None else None) - 1.0,
+        dtype)
 
 
 def power(a, size=None, dtype="float32"):

@@ -1,0 +1,89 @@
+"""Moment-matching sweep over mx.np.random samplers: catches
+scale-vs-rate and shape-parameter mix-ups that elementwise oracles
+can't (each sampler's mean/var must match the distribution's)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+N = 200_000
+
+
+def _mv(name, kwargs, mean, var, rtol=0.05):
+    mx.np.random.seed(7)
+    fn = getattr(mx.np.random, name)
+    x = onp.asarray(fn(size=(N,), **kwargs)).astype(onp.float64)
+    assert x.shape == (N,)
+    onp.testing.assert_allclose(x.mean(), mean, rtol=rtol, atol=0.02)
+    onp.testing.assert_allclose(x.var(), var, rtol=max(rtol, 0.08),
+                                atol=0.03)
+
+
+CASES = [
+    ("uniform", dict(low=2.0, high=5.0), 3.5, 9.0 / 12),
+    ("normal", dict(loc=1.0, scale=2.0), 1.0, 4.0),
+    ("exponential", dict(scale=2.0), 2.0, 4.0),
+    ("gamma", dict(shape=3.0, scale=2.0), 6.0, 12.0),
+    ("beta", dict(a=2.0, b=5.0), 2 / 7, (2 * 5) / (49 * 8)),
+    ("poisson", dict(lam=4.0), 4.0, 4.0),
+    ("laplace", dict(loc=1.0, scale=2.0), 1.0, 8.0),
+    ("gumbel", dict(loc=0.0, scale=1.0), 0.5772, onp.pi ** 2 / 6),
+    ("logistic", dict(loc=1.0, scale=2.0), 1.0, (4 * onp.pi ** 2) / 3),
+    ("rayleigh", dict(scale=2.0), 2 * onp.sqrt(onp.pi / 2),
+     (4 - onp.pi) / 2 * 4),
+    ("weibull", dict(a=2.0), 0.8862, 1 - 0.8862 ** 2),
+    ("pareto", dict(a=5.0), 1 / 4, 5 / (16 * 3)),
+    ("chisquare", dict(df=4.0), 4.0, 8.0),
+    ("lognormal", dict(mean=0.0, sigma=0.5),
+     onp.exp(0.125), (onp.exp(0.25) - 1) * onp.exp(0.25)),
+    ("geometric", dict(p=0.25), 1 / 0.25, 0.75 / 0.25 ** 2),
+    ("negative_binomial", dict(n=5, p=0.5), 5.0, 10.0),
+    ("power", dict(a=3.0), 3 / 4, 3 / 80),
+    ("f", dict(dfnum=10.0, dfden=20.0), 20 / 18.0, None),
+    ("binomial", dict(n=10, p=0.3), 3.0, 2.1),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,mean,var", CASES,
+                         ids=[c[0] for c in CASES])
+def test_sampler_moments(name, kwargs, mean, var):
+    if var is None:
+        mx.np.random.seed(7)
+        x = onp.asarray(getattr(mx.np.random, name)(size=(N,),
+                                                    **kwargs))
+        onp.testing.assert_allclose(x.mean(), mean, rtol=0.08)
+        return
+    _mv(name, kwargs, mean, var)
+
+
+def test_randint_bernoulli_multinomial():
+    mx.np.random.seed(7)
+    r = onp.asarray(mx.np.random.randint(3, 9, size=(N,)))
+    assert r.min() == 3 and r.max() == 8
+    onp.testing.assert_allclose(r.mean(), 5.5, rtol=0.02)
+    b = onp.asarray(mx.np.random.bernoulli(prob=0.3, size=(N,)))
+    onp.testing.assert_allclose(b.mean(), 0.3, rtol=0.05)
+    m = onp.asarray(mx.np.random.multinomial(
+        1, [0.2, 0.3, 0.5], size=(N,)))
+    # one-hot draws: column means approximate the probabilities
+    onp.testing.assert_allclose(m.mean(0), [0.2, 0.3, 0.5], rtol=0.05)
+
+
+def test_choice_shuffle_permutation():
+    mx.np.random.seed(7)
+    c = onp.asarray(mx.np.random.choice(5, size=(N,)))
+    assert set(onp.unique(c)) <= set(range(5))
+    onp.testing.assert_allclose(
+        onp.bincount(c, minlength=5) / N, [0.2] * 5, rtol=0.05)
+    p = onp.asarray(mx.np.random.permutation(100))
+    assert sorted(p.tolist()) == list(range(100))
+
+
+def test_multivariate_normal_cov():
+    mx.np.random.seed(7)
+    mean = onp.array([1.0, -1.0], onp.float32)
+    cov = onp.array([[2.0, 0.6], [0.6, 1.0]], onp.float32)
+    x = onp.asarray(mx.np.random.multivariate_normal(
+        mx.np.array(mean), mx.np.array(cov), size=(N,)))
+    onp.testing.assert_allclose(x.mean(0), mean, atol=0.02)
+    onp.testing.assert_allclose(onp.cov(x.T), cov, rtol=0.08, atol=0.03)
