@@ -338,3 +338,69 @@ def test_topk_oracle():
     # axis=0
     col = onp.asarray(npx.topk(np.array(x), k=1, axis=0, ret_typ="value"))
     onp.testing.assert_array_equal(col, [[5.0, 6.0, 4.0]])
+
+
+# -- linalg vs numpy oracle (previously uncovered) --------------------------
+
+@pytest.mark.seed(12)
+def test_linalg_oracle_sweep():
+    la = np.linalg
+    rng = onp.random.RandomState(12)
+    a = rng.randn(4, 4).astype(onp.float32)
+    spd = (a @ a.T + 4 * onp.eye(4)).astype(onp.float32)
+    b = rng.randn(4, 2).astype(onp.float32)
+
+    _chk(la.solve(np.array(spd), np.array(b)),
+         onp.linalg.solve(spd, b), rtol=1e-3, atol=1e-4)
+    _chk(la.cholesky(np.array(spd)), onp.linalg.cholesky(spd),
+         rtol=1e-3, atol=1e-4)
+    _chk(la.pinv(np.array(a)), onp.linalg.pinv(a), rtol=1e-2, atol=1e-3)
+    _chk(la.matrix_power(np.array(a), 3),
+         onp.linalg.matrix_power(a, 3), rtol=1e-3, atol=1e-3)
+    assert int(la.matrix_rank(np.array(spd))) == 4
+    s, ld = la.slogdet(np.array(spd))
+    rs, rld = onp.linalg.slogdet(spd)
+    assert float(s) == rs
+    onp.testing.assert_allclose(float(ld), rld, rtol=1e-4)
+    # eigh on symmetric: eigenvalues match
+    w = onp.asarray(la.eigvalsh(np.array(spd)))
+    onp.testing.assert_allclose(onp.sort(w), onp.sort(
+        onp.linalg.eigvalsh(spd)), rtol=1e-3)
+    w2, v2 = la.eigh(np.array(spd))
+    recon = onp.asarray(v2) @ onp.diag(onp.asarray(w2)) @ onp.asarray(v2).T
+    onp.testing.assert_allclose(recon, spd, rtol=1e-3, atol=1e-3)
+    # svd reconstruction
+    u, s_, vt = la.svd(np.array(a))
+    recon = onp.asarray(u) @ onp.diag(onp.asarray(s_)) @ onp.asarray(vt)
+    onp.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-3)
+    # qr reconstruction
+    q, r = la.qr(np.array(a))
+    onp.testing.assert_allclose(onp.asarray(q) @ onp.asarray(r), a,
+                                rtol=1e-3, atol=1e-3)
+    # lstsq against numpy
+    sol = la.lstsq(np.array(a), np.array(b))
+    ref = onp.linalg.lstsq(a, b, rcond=None)[0]
+    onp.testing.assert_allclose(onp.asarray(sol[0] if isinstance(sol, (list, tuple)) else sol),
+                                ref, rtol=1e-2, atol=1e-3)
+    # multi_dot
+    c = rng.randn(4, 3).astype(onp.float32)
+    _chk(la.multi_dot([np.array(a), np.array(spd), np.array(c)]),
+         a @ spd @ c, rtol=1e-3, atol=1e-3)
+    # tensorsolve/tensorinv
+    t = rng.randn(2, 2, 2, 2).astype(onp.float32) + onp.eye(4).reshape(2, 2, 2, 2)
+    rhs = rng.randn(2, 2).astype(onp.float32)
+    _chk(la.tensorsolve(np.array(t), np.array(rhs)),
+         onp.linalg.tensorsolve(t, rhs), rtol=1e-2, atol=1e-3)
+
+
+def test_linalg_solve_grad_flows():
+    from mxnet_tpu import autograd
+
+    a = mx.np.array(onp.eye(3, dtype=onp.float32) * 2)
+    b = mx.np.array(onp.ones((3,), onp.float32))
+    a.attach_grad()
+    with autograd.record():
+        x = np.linalg.solve(a, b)
+        loss = (x * x).sum()
+    loss.backward()
+    assert float(np.abs(a.grad).sum()) > 0
