@@ -143,3 +143,26 @@ def test_vae_entry_point():
     mse = float(line.split("test_mse=")[1].split()[0])
     base = float(line.split("mean_baseline_mse=")[1].split()[0])
     assert mse < base, f"VAE reconstruction ({mse}) no better than mean ({base})"
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_multi_task_entry_point():
+    out = _run("example/multi-task/multi_task.py", "--epochs", "4")
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.rsplit("final:", 1)[1]
+    acc_d = float(line.split("digit_acc=")[1].split()[0])
+    acc_p = float(line.split("parity_acc=")[1].split()[0])
+    assert acc_d >= 0.75 and acc_p >= 0.8, (acc_d, acc_p)
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_rbm_entry_point():
+    out = _run("example/restricted-boltzmann-machine/rbm.py",
+               "--epochs", "6")
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.rsplit("final:", 1)[1]
+    err = float(line.split("test_recon_err=")[1].split()[0])
+    base = float(line.split("random_baseline=")[1].split()[0])
+    assert err < 0.7 * base, f"RBM reconstruction {err} vs baseline {base}"
