@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Actor-critic on CartPole.
+
+Parity target: reference ``example/actor_critic/`` (the classic REINFORCE
++ value-baseline demo). The environment is the standard CartPole
+dynamics implemented in numpy (no gym in the image); the agent is a
+shared trunk with policy and value heads trained from complete episodes:
+policy loss = -logpi * advantage, value loss = MSE to the return.
+
+Example:
+    python example/actor_critic/actor_critic.py --episodes 150
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp  # noqa: E402
+
+
+class CartPole:
+    """Standard CartPole-v0 dynamics (Barto et al.; gym constants)."""
+
+    def __init__(self, seed=0):
+        self.rng = onp.random.RandomState(seed)
+        self.g, self.mc, self.mp, self.l = 9.8, 1.0, 0.1, 0.5
+        self.dt, self.fmag = 0.02, 10.0
+        self.max_steps = 200
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype(onp.float32)
+        self.t = 0
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        f = self.fmag if action == 1 else -self.fmag
+        costh, sinth = onp.cos(th), onp.sin(th)
+        mtot = self.mc + self.mp
+        pml = self.mp * self.l
+        tmp = (f + pml * thd ** 2 * sinth) / mtot
+        thacc = (self.g * sinth - costh * tmp) / (
+            self.l * (4.0 / 3.0 - self.mp * costh ** 2 / mtot))
+        xacc = tmp - pml * thacc * costh / mtot
+        x, xd = x + self.dt * xd, xd + self.dt * xacc
+        th, thd = th + self.dt * thd, thd + self.dt * thacc
+        self.s = onp.array([x, xd, th, thd], onp.float32)
+        self.t += 1
+        done = (abs(x) > 2.4 or abs(th) > 0.2095
+                or self.t >= self.max_steps)
+        return self.s.copy(), 1.0, done
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--episodes", type=int, default=150)
+    p.add_argument("--gamma", type=float, default=0.99)
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, np, npx
+    from mxnet_tpu.gluon import nn
+
+    class ActorCritic(mx.gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.trunk = nn.Dense(args.hidden, activation="tanh")
+            self.policy = nn.Dense(2)
+            self.value = nn.Dense(1)
+
+        def forward(self, s):
+            h = self.trunk(s)
+            return self.policy(h), self.value(h)[:, 0]
+
+    net = ActorCritic()
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    env = CartPole(seed=0)
+    rng = onp.random.RandomState(1)
+    lengths = []
+    t0 = time.time()
+    for ep in range(args.episodes):
+        states, actions, rewards = [], [], []
+        s = env.reset()
+        done = False
+        while not done:
+            logits, _ = net(mx.np.array(s[None]))
+            p = onp.asarray(npx.softmax(logits))[0]
+            a = int(rng.choice(2, p=p / p.sum()))
+            states.append(s)
+            actions.append(a)
+            s, r, done = env.step(a)
+            rewards.append(r)
+        # discounted returns, normalized
+        G, ret = 0.0, onp.zeros(len(rewards), onp.float32)
+        for t in range(len(rewards) - 1, -1, -1):
+            G = rewards[t] + args.gamma * G
+            ret[t] = G
+        ret_n = (ret - ret.mean()) / (ret.std() + 1e-6)
+        S = mx.np.array(onp.stack(states))
+        A = mx.np.array(onp.array(actions, onp.int32))
+        R = mx.np.array(ret_n)
+        with autograd.record():
+            logits, values = net(S)
+            logp = npx.log_softmax(logits, axis=-1)
+            chosen = npx.pick(logp, A, axis=1)
+            adv = R - values
+            policy_loss = -(chosen * np.stop_gradient(adv) if hasattr(
+                np, "stop_gradient") else chosen * adv.detach()).mean()
+            value_loss = (adv ** 2).mean()
+            loss = policy_loss + 0.5 * value_loss
+        loss.backward()
+        trainer.step(1)
+        lengths.append(len(rewards))
+        if (ep + 1) % 25 == 0:
+            print(f"episode {ep + 1}: mean_len(last25)="
+                  f"{onp.mean(lengths[-25:]):.1f} "
+                  f"({time.time() - t0:.1f}s)", flush=True)
+    first = float(onp.mean(lengths[:25]))
+    last = float(onp.mean(lengths[-25:]))
+    print(f"final: first25={first:.1f} last25={last:.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -166,3 +166,15 @@ def test_rbm_entry_point():
     err = float(line.split("test_recon_err=")[1].split()[0])
     base = float(line.split("random_baseline=")[1].split()[0])
     assert err < 0.7 * base, f"RBM reconstruction {err} vs baseline {base}"
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
+def test_actor_critic_entry_point():
+    out = _run("example/actor_critic/actor_critic.py",
+               "--episodes", "100")
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.rsplit("final:", 1)[1]
+    first = float(line.split("first25=")[1].split()[0])
+    last = float(line.split("last25=")[1].split()[0])
+    assert last > 2 * first, f"policy did not improve: {first} -> {last}"
