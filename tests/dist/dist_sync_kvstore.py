@@ -87,6 +87,24 @@ def main():
     expected_w = onp.zeros(d, onp.float32) - lr * full
     check_diff(new_w, expected_w, "dp update")
 
+    # -- 2-bit gradient compression across processes ----------------------
+    # (reference dist_sync_kvstore.py:35-60 compression expectations:
+    # quantized pushpull with error feedback; values quantize to
+    # +threshold/0/-threshold per round)
+    kvc = mx.kv.create("dist_tpu_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    cshape = (4, 4)
+    kvc.init("c", mx.np.zeros(cshape))
+    outc = mx.np.zeros(cshape)
+    # every rank pushes +1: quantized to +0.5 each -> sum = 0.5 * size
+    kvc.pushpull("c", mx.np.ones(cshape), out=outc)
+    check_diff(outc, 0.5 * size, "2bit pushpull")
+    # residual (error feedback): leftover +0.5 per rank joins the next
+    # round's zero gradient -> quantizes to +0.5 again
+    outc2 = mx.np.zeros(cshape)
+    kvc.pushpull("c", mx.np.zeros(cshape), out=outc2)
+    check_diff(outc2, 0.5 * size, "2bit error feedback")
+
     print(f"DIST_OK rank={rank}/{size}", flush=True)
 
 
