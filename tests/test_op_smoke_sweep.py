@@ -404,3 +404,27 @@ def test_linalg_solve_grad_flows():
         loss = (x * x).sum()
     loss.backward()
     assert float(np.abs(a.grad).sum()) > 0
+
+
+def test_special_functions_vs_scipy():
+    from scipy import special as sp
+
+    x = onp.random.RandomState(1).uniform(0.5, 3.0, (3, 4)).astype(
+        onp.float32)
+    _chk(npx.gamma(np.array(x)), sp.gamma(x), rtol=1e-4)
+    _chk(npx.gammaln(np.array(x)), sp.gammaln(x), rtol=1e-4)
+    _chk(npx.digamma(np.array(x)), sp.digamma(x), rtol=1e-4)
+    _chk(npx.rcbrt(np.array(x)), 1.0 / onp.cbrt(x), rtol=1e-5)
+    y = onp.array([[-2.0, -0.5, 0.0], [0.5, 1.0, 2.0]], onp.float32)
+    ref = onp.where(onp.abs(y) < 1.0, 0.5 * y * y, onp.abs(y) - 0.5)
+    _chk(npx.smooth_l1(np.array(y)), ref, rtol=1e-5)
+
+
+def test_pick_oracle():
+    x = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    idx = onp.array([0, 3, 1], onp.int32)
+    got = npx.pick(np.array(x), np.array(idx), axis=1)
+    _chk(got, x[onp.arange(3), idx])
+    got0 = npx.pick(np.array(x), np.array(onp.array([2, 0, 1, 2],
+                                                    onp.int32)), axis=0)
+    _chk(got0, x[onp.array([2, 0, 1, 2]), onp.arange(4)])
