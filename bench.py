@@ -165,11 +165,12 @@ def parse_last_json(text: str):
 
 def main() -> None:
     last_err = "no attempts ran"
-    # (platform, timeout_s): three TPU tries (the tunnel flaps for hours
-    # at a time; a dead attempt exits in ~190s via the init watchdog, so
-    # retries are cheap), then CPU which always works
+    # (platform, timeout_s): two TPU tries (the tunnel flaps for hours at
+    # a time; a dead attempt exits in ~190s via the init watchdog), then
+    # CPU which always works — worst case ~11 min total, inside any
+    # sane driver timeout
     for attempt, (platform, tmo) in enumerate(
-            [("tpu", 420), ("tpu", 420), ("tpu", 420), ("cpu", 900)]):
+            [("tpu", 420), ("tpu", 420), ("cpu", 900)]):
         log(f"attempt {attempt}: platform={platform} timeout={tmo}s")
         try:
             proc = subprocess.run(
