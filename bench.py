@@ -12,9 +12,18 @@ as secondary fields:
 Engineered to always produce that line (VERDICT.md round-1 item #1):
 the measurement runs in a child process (the TPU backend behind the axon
 tunnel can fail or hang at init — a child can be timed out and retried;
-in-process jax caches a failed backend forever). Two TPU attempts, then a
-CPU fallback so a number exists even with the chip unreachable, then an
-{"error": ...} record as the last resort. Diagnostics go to stderr only.
+in-process jax caches a failed backend forever). Two TPU attempts, then
+the cached measurement banked by ``benchmark/tpu_daemon.py`` (which
+probes the flaky tunnel continuously and atomically writes
+``benchmark/results_bench_tpu.json`` whenever it is up — VERDICT.md
+round-2 item #1), then a CPU fallback so a number exists even with the
+chip unreachable, then an {"error": ...} record as the last resort.
+Diagnostics go to stderr only.
+
+MFU: TPU records carry ``model_gflops_per_img`` (XLA cost analysis of
+the compiled step), ``achieved_tflops``, and ``mfu`` (achieved vs the
+chip's bf16 peak — the per-chip-efficiency north star, VERDICT round-2
+weak #7).
 """
 from __future__ import annotations
 
@@ -27,6 +36,28 @@ import time
 BASELINE_FP16_IMG_S = 2085.51  # ResNet-50 fp16 inference bs32, V100 (perf.md:202-216)
 BASELINE_FP32_IMG_S = 1076.81  # ResNet-50 fp32 inference bs32, V100 (perf.md:186-198)
 METRIC = "resnet50_v1_infer_bs32_bf16"
+CACHED_RESULT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "benchmark", "results_bench_tpu.json")
+MAX_CACHE_AGE_S = 7 * 24 * 3600  # older banked results are not served
+
+# bf16 MXU peak TFLOP/s by device_kind substring (public TPU specs); used
+# for the MFU field. Unknown kinds report mfu=null rather than guessing.
+PEAK_BF16_TFLOPS = {
+    "v5 lite": 197.0, "v5e": 197.0,   # v5e
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 46.0,
+    "v6": 918.0,                       # trillium
+}
+
+
+def peak_bf16_tflops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in PEAK_BF16_TFLOPS.items():
+        if sub in kind:
+            return peak
+    return None
 
 
 def log(*a):
@@ -71,7 +102,7 @@ def child(platform: str) -> None:
     x_np = onp.random.uniform(size=(batch, 3, 224, 224)).astype(onp.float32)
     fn, params = net.functionalize(mx.np.array(x_np), training=False)
 
-    def measure(params, x_host, dtype):
+    def measure(params, x_host, dtype, want_flops=True):
         """Throughput of a serially-chained forward at the given dtype."""
 
         def step(params, x):
@@ -118,7 +149,29 @@ def child(platform: str) -> None:
         img_s = batch * total_iters / total_dt
         log(f"{dtype.__name__}: {img_s:.1f} img/s over {total_iters} iters "
             f"({total_dt:.1f}s)")
-        return img_s, total_iters
+
+        # XLA's FLOP count for one step — basis for the MFU field. Runs
+        # AFTER the timed loop: .lower().compile() does not share the jit
+        # call cache, so doing it up front would compile twice and could
+        # eat the TPU attempt budget before a number exists. The fallback
+        # compile is also why callers that don't need flops must skip
+        # this block entirely (want_flops=False).
+        step_flops = None
+        if not want_flops:
+            return img_s, total_iters, step_flops
+        try:
+            lowered = jstep.lower(params, x)
+            try:
+                ca = lowered.cost_analysis()  # no backend compile
+            except Exception:  # noqa: BLE001
+                ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            if ca and ca.get("flops"):
+                step_flops = float(ca["flops"])
+        except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
+            log(f"cost_analysis unavailable: {e!r}")
+        return img_s, total_iters, step_flops
 
     # headline: bf16, the TPU-native precision (the reference's headline
     # reduced-precision number is V100 fp16, perf.md:202-216); fp32 kept
@@ -127,13 +180,14 @@ def child(platform: str) -> None:
     # fp32) and could blow the attempt timeout — measure fp32 only and
     # report it for both fields with the note making that explicit.
     if platform == "cpu":
-        fp32_img_s, fp32_iters = measure(params, x_np, jnp.float32)
+        fp32_img_s, fp32_iters, flops = measure(params, x_np, jnp.float32)
         bf16_img_s, bf16_iters = fp32_img_s, fp32_iters
     else:
         p_bf16 = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
                   for k, v in params.items()}
-        bf16_img_s, bf16_iters = measure(p_bf16, x_np, jnp.bfloat16)
-        fp32_img_s, fp32_iters = measure(params, x_np, jnp.float32)
+        bf16_img_s, bf16_iters, flops = measure(p_bf16, x_np, jnp.bfloat16)
+        fp32_img_s, fp32_iters, _ = measure(params, x_np, jnp.float32,
+                                            want_flops=False)
     rec = {
         "metric": METRIC,
         "value": round(bf16_img_s, 2),
@@ -142,52 +196,138 @@ def child(platform: str) -> None:
         "fp32_img_s": round(fp32_img_s, 2),
         "fp32_vs_baseline": round(fp32_img_s / BASELINE_FP32_IMG_S, 3),
         "device": str(devs[0].platform),
+        "device_kind": getattr(devs[0], "device_kind", ""),
         "bf16_iters": bf16_iters,
         "fp32_iters": fp32_iters,
     }
+    if flops:
+        gflops_img = flops / batch / 1e9
+        achieved = bf16_img_s * gflops_img / 1e3  # TFLOP/s
+        rec["model_gflops_per_img"] = round(gflops_img, 2)
+        rec["achieved_tflops"] = round(achieved, 2)
+        peak = peak_bf16_tflops(rec["device_kind"])
+        if peak and platform != "cpu":
+            rec["peak_bf16_tflops"] = peak
+            rec["mfu"] = round(achieved / peak, 4)
     if platform == "cpu":
         rec["note"] = ("cpu fallback (TPU backend unavailable); fp32 "
                        "measured, bf16 fields mirror fp32")
     print(json.dumps(rec), flush=True)
 
 
-def parse_last_json(text: str):
-    for line in reversed(text.strip().splitlines()):
-        line = line.strip()
-        if not line.startswith("{"):
-            continue
+def parse_json_output(text: str):
+    """LAST parseable JSON object in ``text`` — single- or multi-line,
+    tolerating log noise around it. Shared child-output protocol parser:
+    benchmark/tpu_daemon.py imports this so both sides parse harness
+    output identically."""
+    dec = json.JSONDecoder()
+    obj = None
+    idx = text.find("{")
+    while idx != -1:
         try:
-            return json.loads(line)
+            obj, end = dec.raw_decode(text, idx)
+            idx = text.find("{", end)
         except json.JSONDecodeError:
-            continue
-    return None
+            idx = text.find("{", idx + 1)
+    return obj
+
+
+class live_lock:
+    """Cooperative marker telling the daemon a live bench owns the chip
+    (benchmark/.bench_live.lock, pid inside; stale-checked by readers)."""
+
+    PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmark", ".bench_live.lock")
+
+    def __enter__(self):
+        try:
+            with open(self.PATH, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            os.remove(self.PATH)
+        except OSError:
+            pass
+        return False
+
+    @staticmethod
+    def held_by_live_process() -> bool:
+        try:
+            with open(live_lock.PATH) as f:
+                pid = int(f.read().strip())
+            os.kill(pid, 0)
+            return True
+        except PermissionError:
+            return True  # process exists, signal not permitted
+        except (OSError, ValueError):
+            return False
+
+
+def serve_cached() -> bool:
+    """Serve the daemon-banked TPU measurement, if one exists.
+
+    benchmark/tpu_daemon.py probes the flaky axon tunnel continuously and
+    atomically banks a full measurement whenever the chip is reachable —
+    so a live-bench failure at capture time no longer erases the TPU
+    number (VERDICT round-2 weak #1)."""
+    try:
+        with open(CACHED_RESULT) as f:
+            cached = json.load(f)
+        rec = cached.get("record") or cached
+        if rec.get("value", 0) <= 0 or rec.get("device") != "tpu":
+            return False
+        age_s = time.time() - cached.get("captured_unix", 0)
+        if age_s > MAX_CACHE_AGE_S:
+            log(f"cached result too old ({age_s / 3600:.0f}h); not serving")
+            return False
+        rec = dict(rec)
+        rec["cache_age_hours"] = round(age_s / 3600.0, 2)
+        rec["note"] = (f"cached TPU measurement from benchmark/tpu_daemon.py, "
+                       f"captured {cached.get('captured_at', '?')}; live TPU "
+                       f"init failed at capture time")
+        print(json.dumps(rec), flush=True)
+        return True
+    except Exception as e:  # noqa: BLE001
+        log(f"no cached result: {e!r}")
+        return False
 
 
 def main() -> None:
     last_err = "no attempts ran"
     # (platform, timeout_s): two TPU tries (the tunnel flaps for hours at
     # a time; a dead attempt exits in ~190s via the init watchdog), then
-    # CPU which always works — worst case ~11 min total, inside any
-    # sane driver timeout
-    for attempt, (platform, tmo) in enumerate(
-            [("tpu", 420), ("tpu", 420), ("cpu", 900)]):
-        log(f"attempt {attempt}: platform={platform} timeout={tmo}s")
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child", platform],
-                capture_output=True, text=True, timeout=tmo)
-            sys.stderr.write(proc.stderr[-4000:])
-            rec = parse_last_json(proc.stdout)
-            if rec is not None and rec.get("value", 0) > 0:
-                print(json.dumps(rec), flush=True)
-                return
-            last_err = (f"rc={proc.returncode}: "
-                        + (proc.stderr.strip().splitlines() or ["no stderr"])[-1])
-        except subprocess.TimeoutExpired:
-            last_err = f"timeout after {tmo}s on {platform}"
-        except Exception as e:  # noqa: BLE001
-            last_err = repr(e)
-        log(f"attempt {attempt} failed: {last_err}")
+    # the daemon's cached TPU measurement, then CPU which always works —
+    # worst case ~11 min total, inside any sane driver timeout
+    with live_lock():
+        for attempt, (platform, tmo) in enumerate(
+                [("tpu", 420), ("tpu", 420), ("cached", 0), ("cpu", 900)]):
+            if platform == "cached":
+                if serve_cached():
+                    return
+                continue
+            log(f"attempt {attempt}: platform={platform} timeout={tmo}s")
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--child", platform],
+                    capture_output=True, text=True, timeout=tmo)
+                sys.stderr.write(proc.stderr[-4000:])
+                rec = parse_json_output(proc.stdout)
+                if rec is not None and rec.get("value", 0) > 0:
+                    print(json.dumps(rec), flush=True)
+                    return
+                last_err = (
+                    f"rc={proc.returncode}: "
+                    + (proc.stderr.strip().splitlines() or ["no stderr"])[-1])
+            except subprocess.TimeoutExpired:
+                last_err = f"timeout after {tmo}s on {platform}"
+            except Exception as e:  # noqa: BLE001
+                last_err = repr(e)
+            log(f"attempt {attempt} failed: {last_err}")
     print(json.dumps({"metric": METRIC, "value": 0.0, "unit": "img/s",
                       "vs_baseline": 0.0, "fp32_img_s": 0.0,
                       "fp32_vs_baseline": 0.0, "error": last_err}), flush=True)

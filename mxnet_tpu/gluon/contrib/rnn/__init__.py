@@ -55,6 +55,13 @@ class VariationalDropoutCell(RecurrentCell):
         return (u < keep).astype(like.dtype) / keep
 
     def forward(self, x, states):
+        from ....autograd import is_training
+
+        # dropout is a train-time regularizer: outside autograd training
+        # mode the cell is the identity wrapper (the reference builds its
+        # masks with the Dropout op, which is a no-op at inference)
+        if not is_training():
+            return self.base_cell(x, states)
         if self._drop_inputs:
             if self._mask_in is None:
                 self._mask_in = self._mask(x, self._drop_inputs)

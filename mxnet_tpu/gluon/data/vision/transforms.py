@@ -343,7 +343,10 @@ def _rotate(img, deg, zoom_in=False, zoom_out=False):
         if zoom_out:
             scale = max(fit_w / W, fit_h / H)
         else:
-            scale = min(W / fit_w, H / fit_h) ** -1
+            # magnify (< 1 in the inverse map) until the largest rectangle
+            # that fits inside the rotated image fills the canvas
+            # (reference image.py:708-710 uses the min ratio directly)
+            scale = min(W / fit_w, H / fit_h)
     cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
     ys, xs = onp.mgrid[0:H, 0:W].astype(onp.float32)
     # inverse mapping: output pixel -> source coordinate

@@ -101,19 +101,31 @@ def get_properties(op_type: str) -> Type[CustomOpProp]:
 class _CustomFunction(Function):
     """Bridges a CustomOp instance into the autograd tape."""
 
-    def __init__(self, op: CustomOp, n_out: int, grad_reqs: List[str]):
+    def __init__(self, op: CustomOp, n_out: int, grad_reqs: List[str],
+                 out_shapes, out_dtypes, is_train=False):
         super().__init__()
         self._op = op
         self._n_out = n_out
         self._grad_reqs = grad_reqs
+        self._out_shapes = out_shapes
+        self._out_dtypes = out_dtypes
+        # captured by the caller BEFORE Function.__call__ enters pause()
+        # (pause resets training mode, so is_training() in here is
+        # always False); the reference forwards the real flag in
+        # custom.cc's callback
+        self._is_train = is_train
 
     def forward(self, *inputs):
-        in_data = list(inputs)
-        # zero-filled outputs let forward() use req="add" semantics too
-        out_data = [None] * self._n_out
-        from .autograd import is_training
+        from . import numpy as mxnp
 
-        self._op.forward(is_training(), ["write"] * self._n_out,
+        in_data = list(inputs)
+        # zero-filled outputs (shaped from the prop's infer_shape/
+        # infer_type) so ops that write in place (out_data[0][:] = ...)
+        # or use req="add" against the preallocated array work, matching
+        # the reference's engine-allocated output buffers
+        out_data = [mxnp.zeros(tuple(s), dtype=dt)
+                    for s, dt in zip(self._out_shapes, self._out_dtypes)]
+        self._op.forward(self._is_train, ["write"] * self._n_out,
                          in_data, out_data, [])
         self.save_for_backward(tuple(in_data), tuple(out_data))
         outs = tuple(out_data)
@@ -142,9 +154,14 @@ def invoke(op_type: str, *inputs, **params):
     in_shapes = [tuple(a.shape) for a in inputs]
     in_types = [a.dtype for a in inputs]
     _ins, out_shapes, _aux = prop.infer_shape(list(in_shapes))
+    _int, out_types, _auxt = prop.infer_type(list(in_types))
     op = prop.create_operator(None, in_shapes, in_types)
+    from .autograd import is_training
+
     fn = _CustomFunction(op, len(out_shapes),
-                         ["write"] * len(arg_names))
+                         ["write"] * len(arg_names),
+                         out_shapes=out_shapes, out_dtypes=out_types,
+                         is_train=is_training())
     return fn(*[a if isinstance(a, ndarray) else a for a in inputs])
 
 

@@ -139,15 +139,31 @@ def test_variational_dropout_mask_is_fixed_per_sequence():
     cell.initialize()
     x = mx.np.array(onp.ones((4, 3), onp.float32))
     cell.reset()
-    out1, s = cell(x, cell.begin_state(4))
-    zeros1 = onp.asarray(out1) == 0
-    out2, _ = cell(x, s)
-    zeros2 = onp.asarray(out2) == 0
+    with autograd.record():
+        out1, s = cell(x, cell.begin_state(4))
+        zeros1 = onp.asarray(out1) == 0
+        out2, _ = cell(x, s)
+        zeros2 = onp.asarray(out2) == 0
     # same output units dropped at every step of the sequence
     assert (zeros1 == zeros2).all()
-    cell.reset()
-    out3, _ = cell(x, cell.begin_state(4))
     assert zeros1.any()  # dropout actually fired somewhere
+
+
+def test_variational_dropout_is_identity_at_inference():
+    # ADVICE r2: masks must only apply in autograd training mode — the
+    # reference builds them with the Dropout op, identity at inference
+    from mxnet_tpu.gluon.rnn import RNNCell
+
+    base = RNNCell(6)
+    cell = contrib.rnn.VariationalDropoutCell(
+        base, drop_inputs=0.5, drop_states=0.5, drop_outputs=0.5)
+    cell.initialize()
+    x = mx.np.array(onp.random.RandomState(0).randn(4, 3).astype(onp.float32))
+    cell.reset()
+    out, _ = cell(x, cell.begin_state(4))
+    ref, _ = base(x, base.begin_state(4))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-6)
 
 
 @pytest.mark.parametrize("cls,ndim,mode", [

@@ -216,6 +216,15 @@ def test_new_transforms():
     sq = onp.arange(64, dtype=onp.float32).reshape(8, 8)
     rot = T.Rotate(90)(sq)
     onp.testing.assert_allclose(rot, onp.rot90(sq, k=-1), atol=1e-3)
+    # ADVICE r2: zoom_in must magnify (no black corners — every output
+    # pixel sampled from inside the source), zoom_out must shrink
+    # (corners outside the rotated frame stay zero-filled)
+    ones = onp.ones((16, 16), onp.float32)
+    zi = T.Rotate(45, zoom_in=True)(ones)
+    assert zi.min() > 0.5, "zoom_in left black corners"
+    zo = T.Rotate(45, zoom_out=True)(ones)
+    assert zo[0, 0] == 0.0 and zo[-1, -1] == 0.0
+    assert zi.mean() > zo.mean()
     # RandomRotation with p=0 is identity
     out = T.RandomRotation((-30, 30), rotate_with_proba=0.0)(img)
     onp.testing.assert_array_equal(out, img)

@@ -56,6 +56,66 @@ def test_custom_op_unknown_name_raises():
         mx.nd.Custom(mx.np.ones((2,)), op_type="nope")
 
 
+@mx.operator.register("inplace_double")
+class InplaceDoubleProp(mx.operator.CustomOpProp):
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Op(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                # reference-style in-place write against the engine's
+                # preallocated (zero-filled) output buffer — no assign()
+                out_data[0][:] = in_data[0] * 2.0
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad, 0, req[0], 2.0 * out_grad[0])
+
+        return Op()
+
+
+@mx.operator.register("train_flag_probe")
+class TrainFlagProbeProp(mx.operator.CustomOpProp):
+    seen = []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Op(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                TrainFlagProbeProp.seen.append(is_train)
+                self.assign(out_data, 0, req[0], in_data[0])
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad, 0, req[0], out_grad[0])
+
+        return Op()
+
+
+def test_custom_op_receives_real_is_train_flag():
+    # the flag must be captured before Function.__call__'s pause() scope
+    # resets training mode (reference custom.cc forwards the real flag)
+    x = mx.np.ones((2,))
+    TrainFlagProbeProp.seen.clear()
+    mx.nd.Custom(x, op_type="train_flag_probe")
+    with autograd.record():
+        mx.nd.Custom(x, op_type="train_flag_probe")
+    assert TrainFlagProbeProp.seen == [False, True]
+
+
+def test_custom_op_inplace_write_to_preallocated_output():
+    # ADVICE r2: out_data must arrive as zero-filled arrays shaped by
+    # infer_shape/infer_type, not None
+    x = mx.np.array(onp.array([1.5, -2.0], onp.float32))
+    y = mx.nd.Custom(x, op_type="inplace_double")
+    onp.testing.assert_allclose(onp.asarray(y), [3.0, -4.0], rtol=1e-6)
+    x.attach_grad()
+    with autograd.record():
+        loss = mx.nd.Custom(x, op_type="inplace_double").sum()
+    loss.backward()
+    onp.testing.assert_allclose(onp.asarray(x.grad), [2.0, 2.0], rtol=1e-6)
+
+
 def test_custom_op_composes_with_builtin_grad():
     x = mx.np.array(onp.array([0.5, 1.5], onp.float32))
     x.attach_grad()
