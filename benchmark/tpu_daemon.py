@@ -65,17 +65,33 @@ def atomic_write(path: str, obj) -> None:
 
 
 def run_child(cmd, timeout):
+    """Run a measurement child, yielding the chip to a live bench: if
+    bench.py takes the live lock mid-capture, the child is terminated so
+    the driver's run doesn't contend with ours (a daemon capture can be
+    redone; a driver capture slot cannot)."""
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout, cwd=ROOT)
-        sys.stderr.write(proc.stderr[-3000:])
-        return proc.returncode, proc.stdout
-    except subprocess.TimeoutExpired:
-        log(f"timeout {timeout}s: {' '.join(cmd[:3])}...")
-        return -1, ""
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, cwd=ROOT)
     except Exception as e:  # noqa: BLE001
         log(f"spawn failed: {e!r}")
         return -1, ""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            out, err = proc.communicate(timeout=5)
+            sys.stderr.write(err[-3000:])
+            return proc.returncode, out
+        except subprocess.TimeoutExpired:
+            if live_lock.held_by_live_process():
+                log("live bench arrived; yielding the chip (killing child)")
+                proc.kill()
+                proc.communicate()
+                return -2, ""
+            if time.time() > deadline:
+                log(f"timeout {timeout}s: {' '.join(cmd[:3])}...")
+                proc.kill()
+                proc.communicate()
+                return -1, ""
 
 
 def capture_headline() -> str:
@@ -100,10 +116,20 @@ def capture_headline() -> str:
         log(f"keeping banked {banked['record']['value']} img/s "
             f"(new capture {rec['value']})")
         return "kept"
+    # displaced records are kept as history, not silently dropped
+    history = []
+    try:
+        history = list(banked.get("other_captures", []))
+        history.append({k: banked[k] for k in
+                        ("captured_at", "captured_unix", "record")
+                        if k in banked})
+    except NameError:
+        pass  # nothing banked yet
     atomic_write(HEADLINE, {
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "captured_unix": time.time(),
         "record": rec,
+        "other_captures": history[-10:],
     })
     log(f"banked headline: {rec['value']} img/s bf16, "
         f"mfu={rec.get('mfu')} -> {HEADLINE}")

@@ -1,0 +1,82 @@
+/*
+ * predict.c — pure-C image classification over the stable ABI.
+ *
+ * Loads a durable export (HybridBlock.export: {prefix}-symbol.json
+ * StableHLO envelope + {prefix}-0000.params), feeds a raw float32
+ * buffer, and prints the top-1 class — the reference's
+ * c_predict_api workflow (src/c_api/c_predict_api.cc, used by
+ * example/image-classification/predict-cpp) with no Python in the
+ * client: the predictor runs through libmxtpu_capi.so, which embeds
+ * the runtime internally.
+ *
+ * Build & run (libmxtpu_capi.so via `make -C src capi`; export the
+ * model first, e.g. tests/test_c_api.py::test_c_predict_program does
+ * both):
+ *   gcc -O2 example/c_api/predict.c -I include -o predict \
+ *       -L mxnet_tpu/_lib -lmxtpu_capi -Wl,-rpath,$PWD/mxnet_tpu/_lib
+ *   PYTHONPATH=$PWD ./predict model-symbol.json model-0000.params
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "mxtpu_c_api.h"
+
+#define CHECK(call)                                              \
+  do {                                                           \
+    if ((call) != 0) {                                           \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError()); \
+      return 1;                                                  \
+    }                                                            \
+  } while (0)
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model-symbol.json> <model.params>\n",
+            argv[0]);
+    return 2;
+  }
+
+  char platform[32];
+  int n_dev = 0;
+  CHECK(MXGetDeviceInfo(platform, sizeof platform, &n_dev));
+  printf("backend: %s x%d\n", platform, n_dev);
+
+  PredictorHandle pred = NULL;
+  CHECK(MXPredCreate(argv[1], argv[2], /*dev_type=*/1, /*dev_id=*/0,
+                     &pred));
+
+  /* deterministic pseudo-image, batch 1 (matching the export's input
+   * spec: NCHW float32) */
+  enum { C = 3, H = 32, W = 32 };
+  size_t n_in = (size_t)1 * C * H * W;
+  float *img = malloc(n_in * sizeof(float));
+  for (size_t i = 0; i < n_in; ++i)
+    img[i] = (float)((i * 2654435761u % 1000) / 1000.0 - 0.5);
+  CHECK(MXPredSetInput(pred, "data", img, n_in));
+  free(img);
+
+  CHECK(MXPredForward(pred));
+
+  int64_t shape[8];
+  int ndim = 0;
+  CHECK(MXPredGetOutputShape(pred, 0, shape, 8, &ndim));
+  printf("output shape: [");
+  size_t n_out = 1;
+  for (int i = 0; i < ndim; ++i) {
+    n_out *= (size_t)shape[i];
+    printf(i ? " %lld" : "%lld", (long long)shape[i]);
+  }
+  printf("]\n");
+
+  float *logits = malloc(n_out * sizeof(float));
+  CHECK(MXPredGetOutput(pred, 0, logits, n_out));
+  int best = 0;
+  for (size_t i = 1; i < n_out; ++i)
+    if (logits[i] > logits[best]) best = (int)i;
+  printf("top-1 class: %d (logit %.4f)\n", best, logits[best]);
+  free(logits);
+
+  CHECK(MXPredFree(pred));
+  printf("OK\n");
+  return 0;
+}

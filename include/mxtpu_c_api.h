@@ -1,0 +1,125 @@
+/*
+ * mxtpu_c_api.h — public declarations for the stable C ABI
+ * (libmxtpu_capi.so, built by `make -C src capi`).
+ *
+ * Reference contract: include/mxnet/c_api.h (262 MXNET_DLL functions)
+ * and src/c_api/c_predict_api.cc. This surface is the curated subset an
+ * external consumer needs to run a full inference workflow with no
+ * Python on the call path (the .so embeds CPython internally): NDArray
+ * create/copy/save/load, eager op invocation, autograd, Symbol DAG
+ * load/infer, CachedOp over durable StableHLO exports, and the
+ * MXPred* predict layer.
+ *
+ * Conventions (identical to the reference):
+ *  - every function returns 0 on success, -1 on failure;
+ *  - MXGetLastError() returns the failing call's message (thread-local);
+ *  - handles are opaque pointers owned by the caller until the matching
+ *    *Free; strings are copied into caller buffers (pass NULL to query
+ *    the needed size where a `needed` out-param exists).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+typedef void *ListHandle;      /* string list, or NDArray (names, arrays) */
+typedef void *SymbolHandle;
+typedef void *CachedOpHandle;
+typedef void *PredictorHandle;
+
+/* dtype codes (reference mshadow type codes) */
+#define MXTPU_DTYPE_FLOAT32 0
+#define MXTPU_DTYPE_FLOAT64 1
+#define MXTPU_DTYPE_INT32 4
+#define MXTPU_DTYPE_INT64 5
+#define MXTPU_DTYPE_UINT8 6
+#define MXTPU_DTYPE_BOOL 7
+
+/* ---- runtime ---- */
+const char *MXGetLastError(void);
+int MXGetVersion(int *out);
+int MXGetDeviceInfo(char *platform_buf, int buf_len, int *device_count);
+int MXRandomSeed(int seed);
+int MXNDArrayWaitAll(void);
+
+/* ---- NDArray ---- */
+int MXNDArrayCreateFromBuffer(const void *data, size_t nbytes,
+                              const int64_t *shape, int ndim, int dtype_code,
+                              NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle h);
+int MXNDArrayGetShape(NDArrayHandle h, int max_ndim, int64_t *shape,
+                      int *ndim);
+int MXNDArrayGetDType(NDArrayHandle h, int *dtype_code);
+int MXNDArrayGetContext(NDArrayHandle h, char *buf, int buf_len);
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data, size_t nbytes);
+
+/* save/load (.params container; keys==NULL saves a positional list) */
+int MXNDArraySave(const char *fname, int num, NDArrayHandle *handles,
+                  const char **keys);
+int MXNDArrayLoad(const char *fname, ListHandle *out);
+int MXNDArrayListSize(ListHandle h, int *out);
+int MXNDArrayListGetName(ListHandle h, int index, char *buf, int buf_len,
+                         int *needed);
+int MXNDArrayListGetArray(ListHandle h, int index, NDArrayHandle *out);
+
+/* ---- generic lists ---- */
+int MXListFree(ListHandle h);
+int MXListSize(ListHandle h, int *out);
+int MXListGetString(ListHandle h, int index, char *buf, int buf_len,
+                    int *needed);
+int MXListAllOpNames(ListHandle *out);
+
+/* ---- eager ops + autograd ---- */
+int MXImperativeInvoke(const char *op_name, int n_in, NDArrayHandle *inputs,
+                       const char *kwargs_json, int max_out,
+                       NDArrayHandle *outputs, int *n_out);
+int MXNDArrayAttachGrad(NDArrayHandle h);
+int MXAutogradSetIsRecording(int on);
+int MXAutogradIsRecording(int *out);
+int MXAutogradBackward(NDArrayHandle loss);
+int MXNDArrayGetGrad(NDArrayHandle h, NDArrayHandle *out);
+
+/* ---- Symbol (DAG JSON; reference MXSymbol*) ---- */
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolCreateFromJSON(const char *json_str, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname);
+int MXSymbolGetJSON(SymbolHandle sym, char *buf, int buf_len, int *needed);
+int MXSymbolListArguments(SymbolHandle sym, ListHandle *out);
+int MXSymbolListOutputs(SymbolHandle sym, ListHandle *out);
+/* shapes as JSON {name: [dims]} -> {"arg_shapes": {...},
+   "out_shapes": [...]} */
+int MXSymbolInferShape(SymbolHandle sym, const char *shapes_json, char *buf,
+                       int buf_len, int *needed);
+int MXSymbolFree(SymbolHandle sym);
+
+/* ---- CachedOp over durable exports (HybridBlock.export artifacts:
+   {prefix}-symbol.json StableHLO envelope + {prefix}-NNNN.params) ---- */
+int MXCachedOpCreateFromFile(const char *symbol_file, const char *param_file,
+                             CachedOpHandle *out);
+int MXInvokeCachedOp(CachedOpHandle op, int n_in, NDArrayHandle *inputs,
+                     int max_out, NDArrayHandle *outputs, int *n_out);
+int MXCachedOpFree(CachedOpHandle op);
+
+/* ---- predict API (c_predict_api-shaped; float32 wire buffers) ---- */
+int MXPredCreate(const char *symbol_file, const char *param_file,
+                 int dev_type, int dev_id, PredictorHandle *out);
+int MXPredSetInput(PredictorHandle pred, const char *key, const float *data,
+                   size_t size);
+int MXPredForward(PredictorHandle pred);
+int MXPredGetOutputShape(PredictorHandle pred, int index, int64_t *shape,
+                         int max_ndim, int *ndim);
+int MXPredGetOutput(PredictorHandle pred, int index, float *data,
+                    size_t size);
+int MXPredFree(PredictorHandle pred);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* MXTPU_C_API_H_ */

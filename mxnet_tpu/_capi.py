@@ -103,3 +103,209 @@ def grad(arr):
     if g is None:
         raise ValueError("array has no gradient (attach_grad not called?)")
     return g
+
+
+def autograd_is_recording() -> int:
+    from .ops.dispatch import autograd_state
+
+    return int(autograd_state.recording)
+
+
+def random_seed(seed: int) -> None:
+    from .numpy import random as mxrandom
+
+    mxrandom.seed(seed)
+
+
+def device_info() -> tuple:
+    """(platform, device_count) of the default backend."""
+    import jax
+
+    devs = jax.devices()
+    return devs[0].platform, len(devs)
+
+
+def ndarray_context(arr) -> str:
+    return str(getattr(arr, "ctx", "cpu(0)"))
+
+
+def list_ops() -> tuple:
+    """All invokable op names, 'np.'-/'npx.'-qualified
+    (MXListAllOpNames parity)."""
+    from . import numpy as mxnp
+    from . import numpy_extension as npx
+
+    names = []
+    for mod, prefix in ((mxnp, "np."), (npx, "npx.")):
+        for n in dir(mod):
+            if not n.startswith("_") and callable(getattr(mod, n, None)):
+                names.append(prefix + n)
+    return tuple(sorted(names))
+
+
+# ---- NDArray save/load (MXNDArraySave/Load; reference ndarray.cc) ---------
+
+def save_ndarrays(fname: str, names, arrays) -> None:
+    from . import serialization
+
+    if names:
+        serialization.save(fname, dict(zip(names, arrays)))
+    else:
+        serialization.save(fname, list(arrays))
+
+
+def load_ndarrays(fname: str) -> tuple:
+    """-> (names tuple (empty strings for list-saved), arrays tuple)."""
+    from . import serialization
+
+    out = serialization.load(fname)
+    if isinstance(out, dict):
+        return tuple(out.keys()), tuple(out.values())
+    return tuple("" for _ in out), tuple(out)
+
+
+# ---- Symbol (MXSymbol*; reference c_api_symbolic.cc) ----------------------
+
+def symbol_load(fname: str):
+    from .symbol import symbol as _sym
+
+    return _sym.load(fname)
+
+
+def symbol_fromjson(text: str):
+    from .symbol.symbol import Symbol
+
+    return Symbol.fromjson(text)
+
+
+def symbol_tojson(sym) -> str:
+    return sym.tojson()
+
+
+def symbol_save(sym, fname: str) -> None:
+    sym.save(fname)
+
+
+def symbol_arguments(sym) -> tuple:
+    return tuple(sym.list_arguments())
+
+
+def symbol_outputs(sym) -> tuple:
+    return tuple(sym.list_outputs())
+
+
+def symbol_infer_shape(sym, shapes_json: str) -> str:
+    """JSON {name: [dims...]} -> JSON {"arg_shapes": {...},
+    "out_shapes": [...]} (MXSymbolInferShape with a mechanical wire
+    format instead of the reference's pointer-array triple)."""
+    shapes = {k: tuple(v) for k, v in json.loads(shapes_json).items()}
+    arg_shapes, out_shapes, _aux = sym.infer_shape(**shapes)
+    return json.dumps({
+        "arg_shapes": {n: list(s) for n, s in
+                       zip(sym.list_arguments(), arg_shapes)},
+        "out_shapes": [list(s) for s in out_shapes],
+    })
+
+
+# ---- CachedOp over durable exports (MXCachedOp*; c_api_ndarray.cc) --------
+
+def cachedop_create(symbol_file: str, param_file):
+    """Load an exported model (StableHLO envelope + .params) as a
+    callable — the C-side CachedOp: reference MXCreateCachedOp over a
+    loaded symbol. Returns the SymbolBlock."""
+    from .gluon.block import SymbolBlock
+
+    return SymbolBlock.imports(symbol_file, param_file=param_file or None)
+
+
+def cachedop_invoke(block, inputs: tuple) -> tuple:
+    out = block(*inputs)
+    if isinstance(out, (list, tuple)):
+        return tuple(out)
+    return (out,)
+
+
+# ---- Predictor (c_predict_api.cc-shaped convenience layer) ----------------
+
+class _Predictor:
+    """Inference session over an exported model: set inputs by key or
+    position, forward once, read outputs — the reference's
+    MXPred* workflow (src/c_api/c_predict_api.cc) without a Python
+    caller."""
+
+    def __init__(self, symbol_file: str, param_file):
+        from .gluon.block import SymbolBlock
+
+        self.block = SymbolBlock.imports(symbol_file,
+                                         param_file=param_file or None)
+        self.meta = self.block._meta
+        self.in_specs = self.meta["inputs"]
+        self.inputs = [None] * len(self.in_specs)
+        self.outputs = None
+
+    def input_index(self, key: str) -> int:
+        if key in ("", "data") or not key:
+            return 0
+        if key.startswith("data") and key[4:].isdigit():
+            return int(key[4:])
+        raise ValueError(
+            f"unknown input key {key!r} (exports have positional inputs; "
+            f"use 'data' or 'dataN')")
+
+    def set_input(self, index: int, raw: bytes) -> None:
+        from . import numpy as mxnp
+
+        spec = self.in_specs[index]
+        # the C predict surface traffics in float32 buffers (reference
+        # mx_float); cast to the export's declared input dtype
+        arr = onp.frombuffer(raw, dtype="float32").astype(
+            spec["dtype"]).reshape(spec["shape"])
+        self.inputs[index] = mxnp.array(arr)
+
+    def forward(self) -> None:
+        missing = [i for i, v in enumerate(self.inputs) if v is None]
+        if missing:
+            raise ValueError(f"inputs not set: {missing}")
+        out = self.block(*self.inputs)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        self.outputs = [onp.asarray(o.asnumpy(), dtype=onp.float32)
+                        for o in out]
+
+    def output_shape(self, index: int) -> tuple:
+        if self.outputs is not None:
+            return tuple(self.outputs[index].shape)
+        avals = self.block._exported.out_avals
+        leaf = jax_tree_leaves(avals)[index]
+        return tuple(leaf.shape)
+
+    def get_output(self, index: int) -> bytes:
+        if self.outputs is None:
+            raise ValueError("call forward() before get_output()")
+        return onp.ascontiguousarray(self.outputs[index]).tobytes()
+
+
+def jax_tree_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def pred_create(symbol_file: str, param_file: str):
+    return _Predictor(symbol_file, param_file)
+
+
+def pred_set_input(pred, key: str, raw: bytes) -> None:
+    pred.set_input(pred.input_index(key), raw)
+
+
+def pred_forward(pred) -> None:
+    pred.forward()
+
+
+def pred_output_shape(pred, index: int) -> tuple:
+    return pred.output_shape(index)
+
+
+def pred_get_output(pred, index: int) -> bytes:
+    return pred.get_output(index)

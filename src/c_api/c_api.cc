@@ -283,3 +283,405 @@ MXTPU_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
   *out = static_cast<NDArrayHandle>(r);
   return 0;
 }
+
+/* ===================================================================== *
+ *  Widened inference surface (round 3): NDArray save/load, Symbol,
+ *  CachedOp over durable StableHLO exports, and the c_predict_api-shaped
+ *  convenience layer. Reference menu: include/mxnet/c_api.h (262 fns),
+ *  src/c_api/c_predict_api.cc. Strings are returned by copying into
+ *  caller buffers (no internal static storage to manage); lists are
+ *  opaque handles freed with MXListFree.
+ * ===================================================================== */
+
+typedef void *ListHandle;      /* (names_tuple, arrays_tuple) or str tuple */
+typedef void *SymbolHandle;    /* mxnet_tpu.symbol.Symbol */
+typedef void *CachedOpHandle;  /* SymbolBlock (loaded durable export) */
+typedef void *PredictorHandle; /* mxnet_tpu._capi._Predictor */
+
+namespace {
+
+/* call a _capi helper with pre-built args; returns new ref or null with
+   g_last_error set */
+PyObject *capi_call_checked(const char *fn, PyObject *args) {
+  PyObject *r = capi_call(fn, args);
+  Py_XDECREF(args);
+  if (r == nullptr) set_error_from_python();
+  return r;
+}
+
+int copy_str(PyObject *str, char *buf, int buf_len, int *needed) {
+  Py_ssize_t n = 0;
+  const char *s = PyUnicode_AsUTF8AndSize(str, &n);
+  if (s == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  if (needed != nullptr) *needed = static_cast<int>(n) + 1;
+  if (buf == nullptr) return 0; /* size query */
+  if (n + 1 > buf_len) {
+    set_error("string buffer too small");
+    return -1;
+  }
+  std::memcpy(buf, s, n + 1);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_DLL int MXListFree(ListHandle h) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(h));
+  return 0;
+}
+
+/* ---- generic string-list accessors (argument lists, op lists) ---- */
+
+MXTPU_DLL int MXListSize(ListHandle h, int *out) {
+  Gil gil;
+  Py_ssize_t n = PySequence_Size(static_cast<PyObject *>(h));
+  if (n < 0) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = static_cast<int>(n);
+  return 0;
+}
+
+MXTPU_DLL int MXListGetString(ListHandle h, int index, char *buf,
+                              int buf_len, int *needed) {
+  Gil gil;
+  PyObject *item = PySequence_GetItem(static_cast<PyObject *>(h), index);
+  if (item == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  int rc = copy_str(item, buf, buf_len, needed);
+  Py_DECREF(item);
+  return rc;
+}
+
+/* ---- NDArray save/load (MXNDArraySave / MXNDArrayLoad parity) ---- */
+
+MXTPU_DLL int MXNDArraySave(const char *fname, int num,
+                            NDArrayHandle *handles, const char **keys) {
+  Gil gil;
+  PyObject *arrays = PyTuple_New(num);
+  for (int i = 0; i < num; ++i) {
+    PyObject *o = static_cast<PyObject *>(handles[i]);
+    Py_INCREF(o);
+    PyTuple_SetItem(arrays, i, o);
+  }
+  PyObject *names;
+  if (keys != nullptr) {
+    names = PyTuple_New(num);
+    for (int i = 0; i < num; ++i)
+      PyTuple_SetItem(names, i, PyUnicode_FromString(keys[i]));
+  } else {
+    names = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *r = capi_call_checked(
+      "save_ndarrays", Py_BuildValue("(sNN)", fname, names, arrays));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayLoad(const char *fname, ListHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked("load_ndarrays",
+                                  Py_BuildValue("(s)", fname));
+  if (r == nullptr) return -1;
+  *out = static_cast<ListHandle>(r); /* (names, arrays) pair */
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayListSize(ListHandle h, int *out) {
+  Gil gil;
+  PyObject *names = PyTuple_GetItem(static_cast<PyObject *>(h), 0);
+  if (names == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = static_cast<int>(PyTuple_Size(names));
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayListGetName(ListHandle h, int index, char *buf,
+                                   int buf_len, int *needed) {
+  Gil gil;
+  PyObject *names = PyTuple_GetItem(static_cast<PyObject *>(h), 0);
+  if (names == nullptr || index < 0 || index >= PyTuple_Size(names)) {
+    set_error("MXNDArrayListGetName: bad handle or index");
+    return -1;
+  }
+  return copy_str(PyTuple_GetItem(names, index), buf, buf_len, needed);
+}
+
+MXTPU_DLL int MXNDArrayListGetArray(ListHandle h, int index,
+                                    NDArrayHandle *out) {
+  Gil gil;
+  PyObject *arrays = PyTuple_GetItem(static_cast<PyObject *>(h), 1);
+  if (arrays == nullptr || index < 0 || index >= PyTuple_Size(arrays)) {
+    set_error("MXNDArrayListGetArray: bad handle or index");
+    return -1;
+  }
+  PyObject *o = PyTuple_GetItem(arrays, index);
+  Py_INCREF(o);
+  *out = static_cast<NDArrayHandle>(o);
+  return 0;
+}
+
+/* ---- misc runtime parity ---- */
+
+MXTPU_DLL int MXAutogradIsRecording(int *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked("autograd_is_recording", nullptr);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject *r = capi_call_checked("random_seed", Py_BuildValue("(i)", seed));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXGetDeviceInfo(char *platform_buf, int buf_len,
+                              int *device_count) {
+  Gil gil;
+  PyObject *r = capi_call_checked("device_info", nullptr);
+  if (r == nullptr) return -1;
+  int rc = copy_str(PyTuple_GetItem(r, 0), platform_buf, buf_len, nullptr);
+  if (rc == 0 && device_count != nullptr)
+    *device_count = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXNDArrayGetContext(NDArrayHandle h, char *buf, int buf_len) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "ndarray_context", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (r == nullptr) return -1;
+  int rc = copy_str(r, buf, buf_len, nullptr);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXListAllOpNames(ListHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked("list_ops", nullptr);
+  if (r == nullptr) return -1;
+  *out = static_cast<ListHandle>(r);
+  return 0;
+}
+
+/* ---- Symbol (MXSymbol* parity over the Symbol DAG JSON) ---- */
+
+MXTPU_DLL int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked("symbol_load", Py_BuildValue("(s)", fname));
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolCreateFromJSON(const char *json_str,
+                                     SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked("symbol_fromjson",
+                                  Py_BuildValue("(s)", json_str));
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolSaveToFile(SymbolHandle sym, const char *fname) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_save",
+      Py_BuildValue("(Os)", static_cast<PyObject *>(sym), fname));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolGetJSON(SymbolHandle sym, char *buf, int buf_len,
+                              int *needed) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_tojson", Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  int rc = copy_str(r, buf, buf_len, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXSymbolListArguments(SymbolHandle sym, ListHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_arguments",
+      Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  *out = static_cast<ListHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolListOutputs(SymbolHandle sym, ListHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_outputs", Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  *out = static_cast<ListHandle>(r);
+  return 0;
+}
+
+/* shapes in/out as JSON — {name: [dims]} -> {"arg_shapes":..,
+   "out_shapes":..} — keeping the wire format mechanical instead of the
+   reference's pointer-array triple */
+MXTPU_DLL int MXSymbolInferShape(SymbolHandle sym, const char *shapes_json,
+                                 char *buf, int buf_len, int *needed) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_infer_shape",
+      Py_BuildValue("(Os)", static_cast<PyObject *>(sym), shapes_json));
+  if (r == nullptr) return -1;
+  int rc = copy_str(r, buf, buf_len, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXSymbolFree(SymbolHandle sym) { return MXListFree(sym); }
+
+/* ---- CachedOp over durable exports (MXCreateCachedOp / MXInvoke
+   CachedOp / MXFreeCachedOp parity; the artifact is the StableHLO
+   envelope written by HybridBlock.export) ---- */
+
+MXTPU_DLL int MXCachedOpCreateFromFile(const char *symbol_file,
+                                       const char *param_file,
+                                       CachedOpHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "cachedop_create",
+      Py_BuildValue("(ss)", symbol_file, param_file ? param_file : ""));
+  if (r == nullptr) return -1;
+  *out = static_cast<CachedOpHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXInvokeCachedOp(CachedOpHandle op, int n_in,
+                               NDArrayHandle *inputs, int max_out,
+                               NDArrayHandle *outputs, int *n_out) {
+  Gil gil;
+  PyObject *ins = PyTuple_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    PyObject *o = static_cast<PyObject *>(inputs[i]);
+    Py_INCREF(o);
+    PyTuple_SetItem(ins, i, o);
+  }
+  PyObject *r = capi_call_checked(
+      "cachedop_invoke",
+      Py_BuildValue("(ON)", static_cast<PyObject *>(op), ins));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > max_out) {
+    Py_DECREF(r);
+    set_error("output buffer too small");
+    return -1;
+  }
+  *n_out = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyTuple_GetItem(r, i);
+    Py_INCREF(o);
+    outputs[i] = static_cast<NDArrayHandle>(o);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXCachedOpFree(CachedOpHandle op) { return MXListFree(op); }
+
+/* ---- predict API (src/c_api/c_predict_api.cc-shaped) ---- */
+
+MXTPU_DLL int MXPredCreate(const char *symbol_file, const char *param_file,
+                           int dev_type, int dev_id, PredictorHandle *out) {
+  Gil gil;
+  (void)dev_type; /* single default device; XLA owns placement */
+  (void)dev_id;
+  PyObject *r = capi_call_checked(
+      "pred_create",
+      Py_BuildValue("(ss)", symbol_file, param_file ? param_file : ""));
+  if (r == nullptr) return -1;
+  *out = static_cast<PredictorHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXPredSetInput(PredictorHandle pred, const char *key,
+                             const float *data, size_t size) {
+  Gil gil;
+  PyObject *raw = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size * sizeof(float)));
+  PyObject *r = capi_call_checked(
+      "pred_set_input",
+      Py_BuildValue("(OsN)", static_cast<PyObject *>(pred),
+                    key ? key : "data", raw));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXPredForward(PredictorHandle pred) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "pred_forward", Py_BuildValue("(O)", static_cast<PyObject *>(pred)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXPredGetOutputShape(PredictorHandle pred, int index,
+                                   int64_t *shape, int max_ndim,
+                                   int *ndim) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "pred_output_shape",
+      Py_BuildValue("(Oi)", static_cast<PyObject *>(pred), index));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > max_ndim) {
+    Py_DECREF(r);
+    set_error("shape buffer too small");
+    return -1;
+  }
+  *ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    shape[i] = PyLong_AsLongLong(PyTuple_GetItem(r, i));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXPredGetOutput(PredictorHandle pred, int index, float *data,
+                              size_t size) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "pred_get_output",
+      Py_BuildValue("(Oi)", static_cast<PyObject *>(pred), index));
+  if (r == nullptr) return -1;
+  Py_ssize_t got = PyBytes_Size(r);
+  if (static_cast<size_t>(got) != size * sizeof(float)) {
+    Py_DECREF(r);
+    set_error("size mismatch in MXPredGetOutput");
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(r), got);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXPredFree(PredictorHandle pred) { return MXListFree(pred); }

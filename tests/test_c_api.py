@@ -43,6 +43,43 @@ def capi():
     lib.MXAutogradSetIsRecording.argtypes = [ctypes.c_int]
     lib.MXAutogradBackward.argtypes = [p]
     lib.MXNDArrayGetGrad.argtypes = [p, pp]
+    # round-3 widened surface (include/mxtpu_c_api.h)
+    cp = ctypes.c_char_p
+    lib.MXNDArraySave.argtypes = [cp, ctypes.c_int, pp, ctypes.POINTER(cp)]
+    lib.MXNDArrayLoad.argtypes = [cp, pp]
+    lib.MXNDArrayListSize.argtypes = [p, ip]
+    lib.MXNDArrayListGetName.argtypes = [p, ctypes.c_int, cp, ctypes.c_int, ip]
+    lib.MXNDArrayListGetArray.argtypes = [p, ctypes.c_int, pp]
+    lib.MXListFree.argtypes = [p]
+    lib.MXListSize.argtypes = [p, ip]
+    lib.MXListGetString.argtypes = [p, ctypes.c_int, cp, ctypes.c_int, ip]
+    lib.MXListAllOpNames.argtypes = [pp]
+    lib.MXAutogradIsRecording.argtypes = [ip]
+    lib.MXRandomSeed.argtypes = [ctypes.c_int]
+    lib.MXGetDeviceInfo.argtypes = [cp, ctypes.c_int, ip]
+    lib.MXNDArrayGetContext.argtypes = [p, cp, ctypes.c_int]
+    lib.MXSymbolCreateFromFile.argtypes = [cp, pp]
+    lib.MXSymbolCreateFromJSON.argtypes = [cp, pp]
+    lib.MXSymbolSaveToFile.argtypes = [p, cp]
+    lib.MXSymbolGetJSON.argtypes = [p, cp, ctypes.c_int, ip]
+    lib.MXSymbolListArguments.argtypes = [p, pp]
+    lib.MXSymbolListOutputs.argtypes = [p, pp]
+    lib.MXSymbolInferShape.argtypes = [p, cp, cp, ctypes.c_int, ip]
+    lib.MXSymbolFree.argtypes = [p]
+    lib.MXCachedOpCreateFromFile.argtypes = [cp, cp, pp]
+    lib.MXInvokeCachedOp.argtypes = [p, ctypes.c_int, pp, ctypes.c_int,
+                                     pp, ip]
+    lib.MXCachedOpFree.argtypes = [p]
+    lib.MXPredCreate.argtypes = [cp, cp, ctypes.c_int, ctypes.c_int, pp]
+    lib.MXPredSetInput.argtypes = [p, cp, ctypes.POINTER(ctypes.c_float),
+                                   ctypes.c_size_t]
+    lib.MXPredForward.argtypes = [p]
+    lib.MXPredGetOutputShape.argtypes = [p, ctypes.c_int, i64p,
+                                         ctypes.c_int, ip]
+    lib.MXPredGetOutput.argtypes = [p, ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_float),
+                                    ctypes.c_size_t]
+    lib.MXPredFree.argtypes = [p]
     return lib
 
 
@@ -141,6 +178,194 @@ def test_error_paths(capi):
                                  8, outs, ctypes.byref(n_out))
     assert rc == -1
     assert b"definitely_not_an_op" in capi.MXGetLastError()
+
+
+def _getstr(capi, fn, *args, size=4096):
+    buf = ctypes.create_string_buffer(size)
+    needed = ctypes.c_int()
+    rc = fn(*args, buf, size, ctypes.byref(needed))
+    assert rc == 0, capi.MXGetLastError()
+    return buf.value.decode()
+
+
+def test_ndarray_save_load_roundtrip(capi, tmp_path):
+    fname = str(tmp_path / "pair.params").encode()
+    a = _make(capi, onp.arange(4, dtype=onp.float32))
+    b = _make(capi, onp.full((2, 2), 7.0, onp.float32))
+    handles = (ctypes.c_void_p * 2)(a, b)
+    keys = (ctypes.c_char_p * 2)(b"alpha", b"beta")
+    assert capi.MXNDArraySave(fname, 2, handles, keys) == 0, \
+        capi.MXGetLastError()
+    lst = ctypes.c_void_p()
+    assert capi.MXNDArrayLoad(fname, ctypes.byref(lst)) == 0, \
+        capi.MXGetLastError()
+    n = ctypes.c_int()
+    assert capi.MXNDArrayListSize(lst, ctypes.byref(n)) == 0
+    assert n.value == 2
+    names = {_getstr(capi, capi.MXNDArrayListGetName, lst, i)
+             for i in range(2)}
+    assert names == {"alpha", "beta"}
+    for i in range(2):
+        name = _getstr(capi, capi.MXNDArrayListGetName, lst, i)
+        h = ctypes.c_void_p()
+        assert capi.MXNDArrayListGetArray(lst, i, ctypes.byref(h)) == 0
+        if name == "alpha":
+            onp.testing.assert_allclose(_fetch(capi, h, (4,)),
+                                        onp.arange(4, dtype=onp.float32))
+        else:
+            onp.testing.assert_allclose(_fetch(capi, h, (2, 2)), 7.0)
+        capi.MXNDArrayFree(h)
+    assert capi.MXListFree(lst) == 0
+
+
+def test_misc_runtime(capi):
+    assert capi.MXRandomSeed(42) == 0
+    rec = ctypes.c_int(-1)
+    assert capi.MXAutogradIsRecording(ctypes.byref(rec)) == 0
+    assert rec.value == 0
+    buf = ctypes.create_string_buffer(32)
+    ndev = ctypes.c_int()
+    assert capi.MXGetDeviceInfo(buf, 32, ctypes.byref(ndev)) == 0
+    assert buf.value.decode() in ("cpu", "tpu") and ndev.value >= 1
+    x = _make(capi, onp.ones((2,), onp.float32))
+    assert capi.MXNDArrayGetContext(x, buf, 32) == 0
+    assert buf.value  # e.g. "cpu(0)"
+    ops = ctypes.c_void_p()
+    assert capi.MXListAllOpNames(ctypes.byref(ops)) == 0
+    n = ctypes.c_int()
+    assert capi.MXListSize(ops, ctypes.byref(n)) == 0
+    assert n.value > 400  # 394 np + 100+ npx
+    some = _getstr(capi, capi.MXListGetString, ops, 0, size=256)
+    assert some.startswith(("np.", "npx."))
+    capi.MXListFree(ops)
+
+
+def test_symbol_load_infer_from_c(capi, tmp_path):
+    import mxnet_tpu as mx
+
+    d = mx.sym.var("data")
+    w = mx.sym.var("w")
+    net = mx.sym.dot(d, w)
+    sfile = str(tmp_path / "net-symbol.json")
+    net.save(sfile)
+
+    sym = ctypes.c_void_p()
+    assert capi.MXSymbolCreateFromFile(sfile.encode(),
+                                       ctypes.byref(sym)) == 0, \
+        capi.MXGetLastError()
+    args = ctypes.c_void_p()
+    assert capi.MXSymbolListArguments(sym, ctypes.byref(args)) == 0
+    n = ctypes.c_int()
+    assert capi.MXListSize(args, ctypes.byref(n)) == 0
+    got = {_getstr(capi, capi.MXListGetString, args, i, size=256)
+           for i in range(n.value)}
+    assert got == {"data", "w"}
+    capi.MXListFree(args)
+
+    shapes = ctypes.c_char_p(b'{"data": [2, 3], "w": [3, 5]}')
+    out = _getstr(capi, capi.MXSymbolInferShape, sym, shapes, size=8192)
+    import json as _json
+
+    inferred = _json.loads(out)
+    assert inferred["out_shapes"] == [[2, 5]]
+
+    # JSON roundtrip through the C surface
+    js = _getstr(capi, capi.MXSymbolGetJSON, sym, size=65536)
+    sym2 = ctypes.c_void_p()
+    assert capi.MXSymbolCreateFromJSON(js.encode(), ctypes.byref(sym2)) == 0
+    capi.MXSymbolFree(sym2)
+    assert capi.MXSymbolFree(sym) == 0
+
+
+@pytest.fixture(scope="module")
+def exported_mlp(tmp_path_factory):
+    """A small exported model (durable StableHLO envelope + params)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    d = tmp_path_factory.mktemp("export")
+    net = nn.HybridSequential(nn.Dense(8, activation="relu", in_units=4),
+                              nn.Dense(3, in_units=8))
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(onp.random.RandomState(0).randn(2, 4).astype(onp.float32))
+    ref = net(x).asnumpy()
+    prefix = str(d / "mlp")
+    jfile, pfile = net.export(prefix, example_args=(x,))
+    return jfile, pfile, onp.asarray(x.asnumpy()), ref
+
+
+def test_cachedop_from_export(capi, exported_mlp):
+    jfile, pfile, x, ref = exported_mlp
+    op = ctypes.c_void_p()
+    assert capi.MXCachedOpCreateFromFile(
+        jfile.encode(), pfile.encode(), ctypes.byref(op)) == 0, \
+        capi.MXGetLastError()
+    h = _make(capi, x)
+    ins = (ctypes.c_void_p * 1)(h)
+    outs = (ctypes.c_void_p * 8)()
+    n_out = ctypes.c_int()
+    assert capi.MXInvokeCachedOp(op, 1, ins, 8, outs,
+                                 ctypes.byref(n_out)) == 0, \
+        capi.MXGetLastError()
+    assert n_out.value == 1
+    onp.testing.assert_allclose(_fetch(capi, outs[0], ref.shape), ref,
+                                rtol=1e-5, atol=1e-6)
+    assert capi.MXCachedOpFree(op) == 0
+
+
+def test_predict_api(capi, exported_mlp):
+    jfile, pfile, x, ref = exported_mlp
+    pred = ctypes.c_void_p()
+    assert capi.MXPredCreate(jfile.encode(), pfile.encode(), 1, 0,
+                             ctypes.byref(pred)) == 0, capi.MXGetLastError()
+    flat = onp.ascontiguousarray(x, onp.float32)
+    assert capi.MXPredSetInput(
+        pred, b"data", flat.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)), flat.size) == 0, \
+        capi.MXGetLastError()
+    assert capi.MXPredForward(pred) == 0, capi.MXGetLastError()
+    shape = (ctypes.c_int64 * 8)()
+    ndim = ctypes.c_int()
+    assert capi.MXPredGetOutputShape(pred, 0, shape, 8,
+                                     ctypes.byref(ndim)) == 0
+    assert list(shape[:ndim.value]) == list(ref.shape)
+    out = onp.empty(ref.shape, onp.float32)
+    assert capi.MXPredGetOutput(
+        pred, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size) == 0, capi.MXGetLastError()
+    onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    assert capi.MXPredFree(pred) == 0
+
+
+def test_c_predict_program(capi, tmp_path):
+    """The VERDICT r2 'done' bar: a pure-C program loads an exported
+    ResNet-18 and classifies an input with no Python on the call path."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    net.hybridize()
+    x = mx.np.zeros((1, 3, 32, 32))
+    net(x)  # shape-priming forward
+    prefix = str(tmp_path / "resnet18")
+    jfile, pfile = net.export(prefix, example_args=(x,))
+
+    exe = str(tmp_path / "predict")
+    libdir = os.path.join(ROOT, "mxnet_tpu", "_lib")
+    subprocess.run(
+        ["gcc", "-O2", os.path.join(ROOT, "example/c_api/predict.c"),
+         "-I", os.path.join(ROOT, "include"), "-o", exe,
+         "-L", libdir, "-lmxtpu_capi", f"-Wl,-rpath,{libdir}"], check=True)
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe, jfile, pfile], env=env, capture_output=True,
+                         text=True, timeout=600)
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "top-1 class:" in out.stdout
+    assert "OK" in out.stdout
 
 
 def test_c_demo_program(capi, tmp_path):
