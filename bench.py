@@ -300,9 +300,10 @@ def serve_cached() -> bool:
         # preserve the record's own provenance note; only annotate that
         # it is being served from the cache
         rec["served_from_cache"] = (
-            f"benchmark/results_bench_tpu.json, captured "
-            f"{cached.get('captured_at', '?')}; live TPU init failed at "
-            f"capture time")
+            f"benchmark/results_bench_tpu.json, banked by the daemon "
+            f"while the chip was reachable ({cached.get('captured_at', '?')}"
+            f"); the live TPU attempts just now failed, so this cached "
+            f"measurement is served instead")
         print(json.dumps(rec), flush=True)
         return True
     except Exception as e:  # noqa: BLE001

@@ -104,27 +104,29 @@ def capture_headline() -> str:
     if not rec or rec.get("device") != "tpu" or rec.get("value", 0) <= 0:
         log(f"headline capture failed (rc={rc})")
         return ""
+    banked = None
     try:
         with open(HEADLINE) as f:
             banked = json.load(f)
         keep_banked = (
             banked["record"].get("value", 0) >= rec["value"]
             and time.time() - banked.get("captured_unix", 0) < STALE_AFTER_S)
-    except Exception:  # noqa: BLE001 — nothing banked yet
+    except Exception:  # noqa: BLE001 — nothing banked yet / malformed
         keep_banked = False
+    if not isinstance(banked, dict):
+        banked = None
     if keep_banked:
         log(f"keeping banked {banked['record']['value']} img/s "
             f"(new capture {rec['value']})")
         return "kept"
     # displaced records are kept as history, not silently dropped
     history = []
-    try:
-        history = list(banked.get("other_captures", []))
+    if banked is not None:
+        history = [c for c in banked.get("other_captures", [])
+                   if isinstance(c, dict)]
         history.append({k: banked[k] for k in
                         ("captured_at", "captured_unix", "record")
                         if k in banked})
-    except NameError:
-        pass  # nothing banked yet
     atomic_write(HEADLINE, {
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "captured_unix": time.time(),

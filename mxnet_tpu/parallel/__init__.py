@@ -27,6 +27,7 @@ from .mesh import (
     shard_params,
     use_mesh,
 )
+from .composed import composed_3d, make_composed_step
 from .moe import MoE, moe_ffn, switch_routing
 from .pipeline import gpipe, pipeline_apply, stack_stage_params
 from .ring_attention import (

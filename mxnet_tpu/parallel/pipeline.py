@@ -93,6 +93,8 @@ def gpipe(
     n_micro: int,
     mesh=None,
     axis_name: str = "pp",
+    param_specs=None,
+    data_spec=None,
 ):
     """Run ``x`` through ``S = mesh.shape[axis_name]`` pipeline stages.
 
@@ -100,6 +102,14 @@ def gpipe(
     ``stacked_params`` has a leading stage axis of size S (see
     :func:`stack_stage_params`). ``x``: global batch ``(B, ...)`` with
     ``B % n_micro == 0``.
+
+    Composition with other mesh axes (dp/tp/sp on the same mesh):
+    ``param_specs`` — per-leaf PartitionSpecs whose leading dim is
+    ``axis_name`` (e.g. ``P("pp", None, "tp")`` for a column-parallel
+    weight inside a stage); ``data_spec`` — spec for the microbatched
+    ``(M, mb, ...)`` layout (e.g. ``P(None, "dp")``). ``stage_fn`` may
+    then use collectives over the other axes (shard_map makes every mesh
+    axis manual). Defaults reproduce the plain pp-only behavior.
     """
     mesh = mesh or current_mesh()
     if mesh is None:
@@ -114,11 +124,21 @@ def gpipe(
                 f"{n_stages}; a larger multiple would silently drop stages")
     xs = x.reshape(n_micro, x.shape[0] // n_micro, *x.shape[1:])
 
-    stage_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    if param_specs is None:
+        stage_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    else:
+        stage_spec = param_specs
+        for spec in jax.tree.leaves(
+                stage_spec, is_leaf=lambda s: isinstance(s, P)):
+            if not spec or spec[0] != axis_name:
+                raise ValueError(
+                    f"param_specs leaves must lead with {axis_name!r} "
+                    f"(one stage per device); got {spec}")
+    dspec = data_spec if data_spec is not None else P()
     body = lambda p, xs_: pipeline_apply(stage_fn, p, xs_, axis_name)
     out = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(stage_spec, P()), out_specs=P(),
+        in_specs=(stage_spec, dspec), out_specs=dspec,
         check_vma=False,
     )(stacked_params, xs)
     return out.reshape(x.shape[0], *out.shape[2:])

@@ -350,6 +350,10 @@ MXTPU_DLL int MXListSize(ListHandle h, int *out) {
 MXTPU_DLL int MXListGetString(ListHandle h, int index, char *buf,
                               int buf_len, int *needed) {
   Gil gil;
+  if (index < 0) { /* no Python-style negative indexing across the ABI */
+    set_error("MXListGetString: negative index");
+    return -1;
+  }
   PyObject *item = PySequence_GetItem(static_cast<PyObject *>(h), index);
   if (item == nullptr) {
     set_error_from_python();
