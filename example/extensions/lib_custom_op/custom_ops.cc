@@ -5,12 +5,23 @@
  *   g++ -O2 -std=c++17 -fPIC -shared -I include \
  *       example/extensions/lib_custom_op/custom_ops.cc -o libcustom_ops.so
  *
- * Registers:
- *   my_gelu(x)   — tanh-approx GELU, forward + analytic backward
- *   my_clip01(x) — clamp to [0,1], forward only (non-differentiable)
+ * Registers (ABI v2):
+ *   my_gelu(x)       — tanh-approx GELU, forward + analytic backward
+ *   my_clip01(x)     — clamp to [0,1], forward only (non-differentiable)
+ *   my_add_relu(a,b) — fused relu(a+b), forward + backward (the target
+ *                      op of the fuse_add_relu graph pass)
+ *   pass fuse_add_relu   — graph pass rewriting relu(add(a,b)) subgraphs
+ *                          into my_add_relu(a,b) on the symbol JSON
+ *                          (reference lib_api.h custom graph passes)
+ *   partitioner myprop   — op selector claiming np.add / npx.relu nodes
+ *                          (reference lib_api.h:812 CustomOpSelector)
+ * plus the mxtpu_ext_abi_version handshake export.
  */
 #include <cmath>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <string>
 
 #include "../../../include/mxtpu_ext.h"
 
@@ -84,7 +95,195 @@ int my_clip01_forward(int32_t n_in, const MXTpuTensor *inputs, int32_t n_out,
   return MXTPU_EXT_SUCCESS;
 }
 
+/* ---- my_add_relu: fused relu(a+b) ---- */
+
+int my_add_relu_forward(int32_t n_in, const MXTpuTensor *inputs,
+                        int32_t n_out, MXTpuTensor *outputs) {
+  if (n_in != 2 || n_out != 1 || inputs[0].dtype != kMXTpuFloat32 ||
+      inputs[1].dtype != kMXTpuFloat32 ||
+      numel(inputs[0]) != numel(inputs[1]))  /* no broadcast: OOB guard */
+    return MXTPU_EXT_FAIL;
+  const float *a = static_cast<const float *>(inputs[0].data);
+  const float *b = static_cast<const float *>(inputs[1].data);
+  float *y = static_cast<float *>(outputs[0].data);
+  int64_t n = numel(inputs[0]);
+  for (int64_t i = 0; i < n; ++i) {
+    float s = a[i] + b[i];
+    y[i] = s > 0.0f ? s : 0.0f;
+  }
+  return MXTPU_EXT_SUCCESS;
+}
+
+/* backward inputs: [dy, a, b]; outputs: [da, db] */
+int my_add_relu_backward(int32_t n_in, const MXTpuTensor *inputs,
+                         int32_t n_out, MXTpuTensor *outputs) {
+  if (n_in != 3 || n_out != 2 ||
+      numel(inputs[1]) != numel(inputs[2]) ||
+      numel(inputs[0]) != numel(inputs[1]))
+    return MXTPU_EXT_FAIL;
+  const float *dy = static_cast<const float *>(inputs[0].data);
+  const float *a = static_cast<const float *>(inputs[1].data);
+  const float *b = static_cast<const float *>(inputs[2].data);
+  float *da = static_cast<float *>(outputs[0].data);
+  float *db = static_cast<float *>(outputs[1].data);
+  int64_t n = numel(inputs[1]);
+  for (int64_t i = 0; i < n; ++i) {
+    float g = (a[i] + b[i]) > 0.0f ? dy[i] : 0.0f;
+    da[i] = g;
+    db[i] = g;
+  }
+  return MXTPU_EXT_SUCCESS;
+}
+
+/* ---- fuse_add_relu graph pass (JSON -> JSON) ----
+ *
+ * The wire format is the framework's symbol JSON (nodes array where the
+ * k-th `"op":` occurrence belongs to node k; each op node carries
+ * balanced `"inputs": [...]` and `"__pos_spec__": [...]` regions).
+ * Rewrites every  npx.relu(np.add(x, y))  whose add has exactly one
+ * consumer into  npx.my_add_relu(x, y)  by retargeting the relu node;
+ * the dead add node is dropped by the next serialization.
+ */
+
+const char *balanced(const char *open) { /* open points at '[' */
+  int depth = 0;
+  const char *p = open;
+  do {
+    if (*p == '[') ++depth;
+    else if (*p == ']') --depth;
+    else if (*p == '\0') return nullptr;
+    ++p;
+  } while (depth > 0);
+  return p; /* one past the closing ']' */
+}
+
+/* region of the value of `"key": [...]` inside [seg, seg_end) */
+bool key_region(const char *seg, const char *seg_end, const char *key,
+                const char **out_beg, const char **out_end) {
+  std::string pat = std::string("\"") + key + "\":";
+  const char *k = strstr(seg, pat.c_str());
+  if (k == nullptr || k >= seg_end) return false;
+  const char *open = strchr(k, '[');
+  if (open == nullptr || open >= seg_end) return false;
+  const char *close = balanced(open);
+  if (close == nullptr) return false;
+  *out_beg = open;
+  *out_end = close;
+  return true;
+}
+
+/* parse the leading integer of each [i, j, k] triple in an inputs
+ * region; returns count, fills idx[] up to max */
+int parse_input_ids(const char *beg, const char *end, int *idx, int max) {
+  int count = 0;
+  for (const char *p = beg + 1; p < end; ++p) {
+    if (*p == '[') {
+      int v = 0;
+      if (sscanf(p + 1, " %d", &v) == 1) { /* triple: [ i, j, k ] */
+        if (count < max) idx[count] = v;
+        ++count;
+      }
+      const char *close = balanced(p);
+      if (close == nullptr) return count;
+      p = close - 1;
+    }
+  }
+  return count;
+}
+
+int fuse_add_relu_pass(const char *in_json, char *out_buf,
+                       size_t out_buf_len, size_t *out_needed) {
+  std::string doc(in_json);
+  const char *base = doc.c_str();
+  const char *nodes_end = strstr(base, "\"arg_nodes\"");
+  if (nodes_end == nullptr) return MXTPU_EXT_FAIL;
+
+  /* locate every node's `"op":` occurrence */
+  const int kMaxNodes = 4096;
+  const char *op_pos[kMaxNodes];
+  int n_nodes = 0;
+  for (const char *p = strstr(base, "\"op\":");
+       p != nullptr && p < nodes_end && n_nodes < kMaxNodes;
+       p = strstr(p + 1, "\"op\":"))
+    op_pos[n_nodes++] = p;
+
+  auto seg_begin = [&](int i) { return op_pos[i]; };
+  auto seg_end = [&](int i) {
+    return i + 1 < n_nodes ? op_pos[i + 1] : nodes_end;
+  };
+  auto op_is = [&](int i, const char *name) {
+    std::string pat = std::string("\"op\": \"") + name + "\"";
+    return strncmp(seg_begin(i), pat.c_str(), pat.size()) == 0;
+  };
+
+  /* count consumers of node j across all inputs regions + heads */
+  auto consumers = [&](int j) {
+    int total = 0;
+    int ids[64];
+    for (int k = 0; k < n_nodes; ++k) {
+      const char *ib, *ie;
+      if (!key_region(seg_begin(k), seg_end(k), "inputs", &ib, &ie))
+        continue;
+      int c = parse_input_ids(ib, ie, ids, 64);
+      for (int t = 0; t < c && t < 64; ++t)
+        if (ids[t] == j) ++total;
+    }
+    const char *hb, *he;
+    if (key_region(nodes_end, base + doc.size(), "heads", &hb, &he)) {
+      int c = parse_input_ids(hb, he, ids, 64);
+      for (int t = 0; t < c && t < 64; ++t)
+        if (ids[t] == j) ++total;
+    }
+    return total;
+  };
+
+  std::string out;
+  out.reserve(doc.size());
+  const char *copied_to = base;
+  for (int i = 0; i < n_nodes; ++i) {
+    if (!op_is(i, "npx.relu")) continue;
+    const char *rib, *rie, *rpb, *rpe;
+    if (!key_region(seg_begin(i), seg_end(i), "inputs", &rib, &rie) ||
+        !key_region(seg_begin(i), seg_end(i), "__pos_spec__", &rpb, &rpe))
+      continue;
+    int ids[4];
+    if (parse_input_ids(rib, rie, ids, 4) != 1) continue;
+    int j = ids[0];
+    if (j < 0 || j >= n_nodes || !op_is(j, "np.add")) continue;
+    if (consumers(j) != 1) continue; /* add feeds others: unsafe to fuse */
+    const char *aib, *aie, *apb, *ape;
+    if (!key_region(seg_begin(j), seg_end(j), "inputs", &aib, &aie) ||
+        !key_region(seg_begin(j), seg_end(j), "__pos_spec__", &apb, &ape))
+      continue;
+    /* emit: ...prefix, op name swap, add's inputs, add's pos_spec */
+    out.append(copied_to, seg_begin(i) - copied_to);
+    out.append("\"op\": \"npx.my_add_relu\"");
+    const char *after_op = strchr(seg_begin(i), ',');
+    if (after_op == nullptr) return MXTPU_EXT_FAIL;
+    out.append(after_op, rib - after_op);
+    out.append(aib, aie - aib);     /* relu.inputs <- add.inputs */
+    out.append(rie, rpb - rie);
+    out.append(apb, ape - apb);     /* relu.__pos_spec__ <- add's */
+    copied_to = rpe;
+  }
+  out.append(copied_to, base + doc.size() - copied_to);
+
+  size_t need = out.size() + 1;
+  if (out_needed != nullptr) *out_needed = need;
+  if (need > out_buf_len) return MXTPU_EXT_AGAIN;
+  memcpy(out_buf, out.c_str(), need);
+  return MXTPU_EXT_SUCCESS;
+}
+
+/* ---- myprop partitioner: claim add/relu nodes ---- */
+
+int myprop_select(const char *op_name) {
+  return strcmp(op_name, "np.add") == 0 || strcmp(op_name, "npx.relu") == 0;
+}
+
 }  // namespace
+
+extern "C" int mxtpu_ext_abi_version(void) { return MXTPU_EXT_ABI_VERSION; }
 
 extern "C" int mxtpu_ext_init(MXTpuExtRegistry *reg) {
   if (reg == nullptr || reg->abi_version != MXTPU_EXT_ABI_VERSION) {
@@ -96,6 +295,17 @@ extern "C" int mxtpu_ext_init(MXTpuExtRegistry *reg) {
     return MXTPU_EXT_FAIL;
   if (reg->register_op(reg, "my_clip01", 1, 1, my_clip01_forward, nullptr,
                        infer_same) != MXTPU_EXT_SUCCESS)
+    return MXTPU_EXT_FAIL;
+  if (reg->register_op(reg, "my_add_relu", 2, 1, my_add_relu_forward,
+                       my_add_relu_backward, infer_same) !=
+      MXTPU_EXT_SUCCESS)
+    return MXTPU_EXT_FAIL;
+  /* ABI v2 surface (guaranteed present: abi_version == 2 was verified) */
+  if (reg->register_pass(reg, "fuse_add_relu", fuse_add_relu_pass) !=
+      MXTPU_EXT_SUCCESS)
+    return MXTPU_EXT_FAIL;
+  if (reg->register_partitioner(reg, "myprop", myprop_select) !=
+      MXTPU_EXT_SUCCESS)
     return MXTPU_EXT_FAIL;
   return MXTPU_EXT_SUCCESS;
 }

@@ -15,10 +15,30 @@
  * Contract:
  *  - the extension exports  int mxtpu_ext_init(MXTpuExtRegistry*)
  *    returning MXTPU_EXT_SUCCESS after registering its ops;
- *  - ABI version is checked first: registry->abi_version must equal
- *    MXTPU_EXT_ABI_VERSION at both compile and load time;
+ *  - version handshake (reference lib_api.h:2008 initialize), BOTH ways:
+ *      framework -> extension: registry->abi_version is the framework's
+ *        ABI; the extension must verify it can speak it;
+ *      extension -> framework: the extension should export
+ *        int mxtpu_ext_abi_version(void) returning the
+ *        MXTPU_EXT_ABI_VERSION it was COMPILED against; the loader
+ *        refuses versions outside 1..MXTPU_EXT_ABI_VERSION before
+ *        calling init, and advertises the NEGOTIATED version in
+ *        registry->abi_version. (v1 libraries lack the symbol and
+ *        negotiate as v1: they see abi_version == 1 and never touch the
+ *        appended v2 fields.)
  *  - all tensors are dense host buffers described by MXTpuTensor; the
  *    framework allocates outputs using the op's infer_shape callback.
+ *
+ * ABI v2 adds (append-only, so v1 binaries remain layout-compatible):
+ *  - register_pass: named graph passes. A pass rewrites a serialized
+ *    symbol graph JSON -> JSON (the reference's custom graph-pass
+ *    contract, lib_api.h graphPass): applied with
+ *    mx.library.apply_graph_pass(sym, name).
+ *  - register_partitioner: named op selectors (reference lib_api.h:812
+ *    CustomOpSelector). The framework walks the graph, asks the selector
+ *    per op name, and groups maximal connected accepted subgraphs:
+ *    mx.library.partition(sym, name) annotates nodes with
+ *    __subgraph__ ids.
  */
 #ifndef MXTPU_EXT_H_
 #define MXTPU_EXT_H_
@@ -30,9 +50,12 @@
 extern "C" {
 #endif
 
-#define MXTPU_EXT_ABI_VERSION 1
+#define MXTPU_EXT_ABI_VERSION 2
 #define MXTPU_EXT_SUCCESS 0
 #define MXTPU_EXT_FAIL 1
+/* pass output buffer too small: set *out_needed and return this; the
+ * framework retries with a bigger buffer */
+#define MXTPU_EXT_AGAIN 2
 #define MXTPU_EXT_MAX_NDIM 8
 
 /* dtype codes (match numpy kind/size, fixed forever) */
@@ -69,6 +92,18 @@ typedef int (*MXTpuInferFn)(int32_t n_in, const MXTpuTensor *inputs,
                             int64_t out_shapes[][MXTPU_EXT_MAX_NDIM],
                             int32_t *out_ndims, int32_t *out_dtypes);
 
+/* Graph pass: rewrite the symbol-graph JSON. Write the transformed JSON
+ * (NUL-terminated) into out_buf if it fits in out_buf_len; otherwise set
+ * *out_needed to the required size (incl. NUL) and return
+ * MXTPU_EXT_AGAIN. (reference lib_api.h custom graph passes exchange the
+ * same serialized-graph wire format) */
+typedef int (*MXTpuPassFn)(const char *in_json, char *out_buf,
+                           size_t out_buf_len, size_t *out_needed);
+
+/* Partitioner op selector: return 1 to claim an op for the subgraph
+ * backend, 0 to leave it (reference CustomOpSelector::Select). */
+typedef int (*MXTpuSelectFn)(const char *op_name);
+
 typedef struct MXTpuExtRegistry {
   int32_t abi_version; /* set by the framework; extensions must verify */
   void *impl;          /* framework-owned */
@@ -77,10 +112,18 @@ typedef struct MXTpuExtRegistry {
                      int32_t n_in, int32_t n_out, MXTpuForwardFn forward,
                      MXTpuBackwardFn backward, MXTpuInferFn infer);
   void (*set_last_error)(struct MXTpuExtRegistry *reg, const char *msg);
+  /* -- ABI v2 (append-only) -- */
+  int (*register_pass)(struct MXTpuExtRegistry *reg, const char *name,
+                       MXTpuPassFn fn);
+  int (*register_partitioner)(struct MXTpuExtRegistry *reg, const char *name,
+                              MXTpuSelectFn fn);
 } MXTpuExtRegistry;
 
 /* The single symbol every extension library must export. */
 typedef int (*MXTpuExtInitFn)(MXTpuExtRegistry *reg);
+
+/* Version-handshake symbol extensions should export (see header docs). */
+typedef int (*MXTpuExtAbiVersionFn)(void);
 
 #ifdef __cplusplus
 } /* extern "C" */

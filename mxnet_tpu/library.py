@@ -26,9 +26,10 @@ import numpy as onp
 
 from .base import MXNetError
 
-__all__ = ["load", "get_op", "loaded_ops"]
+__all__ = ["load", "get_op", "loaded_ops", "apply_graph_pass",
+           "graph_passes", "partition", "partitioners"]
 
-ABI_VERSION = 1
+ABI_VERSION = 2
 MAX_NDIM = 8
 
 _DTYPE_TO_CODE = {"float32": 0, "float64": 1, "int32": 4, "int64": 5,
@@ -49,6 +50,13 @@ _REGFN = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
     ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p)
 _ERRFN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p)
+# v2: register_pass / register_partitioner take (reg, name, fn)
+_REGPASSFN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p)
+_PASSFN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+    ctypes.POINTER(ctypes.c_size_t))
+_SELECTFN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p)
 
 
 class _Registry(ctypes.Structure):
@@ -57,6 +65,8 @@ class _Registry(ctypes.Structure):
         ("impl", ctypes.c_void_p),
         ("register_op", _REGFN),
         ("set_last_error", _ERRFN),
+        ("register_pass", _REGPASSFN),
+        ("register_partitioner", _REGPASSFN),
     ]
 
 
@@ -81,6 +91,8 @@ class _ExtOp:
 
 
 _ops: Dict[str, Callable] = {}
+_graph_passes: Dict[str, object] = {}    # name -> ctypes MXTpuPassFn
+_partitioners: Dict[str, object] = {}    # name -> ctypes MXTpuSelectFn
 _libs: List[ctypes.CDLL] = []  # keep loaded libraries (and callbacks) alive
 _keepalive: List[object] = []
 
@@ -201,6 +213,24 @@ def load(path: str, verbose: bool = True) -> List[str]:
     init.restype = ctypes.c_int
     init.argtypes = [ctypes.POINTER(_Registry)]
 
+    # extension->framework half of the version handshake (reference
+    # lib_api.h:2008 initialize): refuse a library compiled against an
+    # ABI this framework cannot speak, BEFORE running any of its code.
+    # v1 libraries predate the symbol and are layout-compatible (v2 only
+    # appended registry fields), so they negotiate as v1 below.
+    try:
+        verfn = lib.mxtpu_ext_abi_version
+        verfn.restype = ctypes.c_int
+        verfn.argtypes = []
+        lib_abi = int(verfn())
+    except AttributeError:
+        lib_abi = 1
+    if not 1 <= lib_abi <= ABI_VERSION:
+        raise MXNetError(
+            f"{path}: extension ABI version mismatch — library built "
+            f"for v{lib_abi}, framework speaks v1..v{ABI_VERSION}; rebuild "
+            f"the extension against the current include/mxtpu_ext.h")
+
     registered: List[str] = []
     errors: List[str] = []
 
@@ -224,15 +254,45 @@ def load(path: str, verbose: bool = True) -> List[str]:
     def set_last_error(_reg, msg):
         errors.append(msg.decode() if msg else "unknown extension error")
 
-    reg = _Registry(ABI_VERSION, None, register_op, set_last_error)
+    @_REGPASSFN
+    def register_pass(_reg, name, fn):
+        try:
+            if not fn:
+                errors.append("register_pass: fn is required")
+                return 1
+            _graph_passes[name.decode()] = _PASSFN(fn)
+            registered.append(f"pass:{name.decode()}")
+            return 0
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+            return 1
+
+    @_REGPASSFN
+    def register_partitioner(_reg, name, fn):
+        try:
+            if not fn:
+                errors.append("register_partitioner: fn is required")
+                return 1
+            _partitioners[name.decode()] = _SELECTFN(fn)
+            registered.append(f"partitioner:{name.decode()}")
+            return 0
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+            return 1
+
+    # advertise the NEGOTIATED version: a v1 binary's init-side
+    # `abi_version != 1` check must keep passing (append-only contract)
+    reg = _Registry(lib_abi, None, register_op, set_last_error,
+                    register_pass, register_partitioner)
     rc = init(ctypes.byref(reg))
     if rc != 0:
         raise MXNetError(
             f"mxtpu_ext_init failed for {path}: {'; '.join(errors) or rc}")
     _libs.append(lib)
-    _keepalive.extend([register_op, set_last_error])
+    _keepalive.extend([register_op, set_last_error, register_pass,
+                       register_partitioner])
     if verbose and registered:
-        print(f"[mx.library] loaded {len(registered)} op(s) from "
+        print(f"[mx.library] loaded {len(registered)} item(s) from "
               f"{os.path.basename(path)}: {', '.join(registered)}")
     return registered
 
@@ -269,3 +329,85 @@ def get_op(name: str) -> Callable:
 
 def loaded_ops() -> List[str]:
     return sorted(_ops)
+
+
+def graph_passes() -> List[str]:
+    return sorted(_graph_passes)
+
+
+def partitioners() -> List[str]:
+    return sorted(_partitioners)
+
+
+def apply_graph_pass(sym, name: str):
+    """Run a loaded extension graph pass over a :class:`~mxnet_tpu.symbol.
+    Symbol` — the JSON->JSON contract of the reference's custom graph
+    passes (lib_api.h). Returns the rewritten Symbol."""
+    fn = _graph_passes.get(name)
+    if fn is None:
+        raise MXNetError(
+            f"no loaded extension graph pass {name!r} "
+            f"(loaded: {graph_passes()})")
+    from .symbol.symbol import Symbol
+
+    in_json = sym.tojson().encode()
+    size = 2 * len(in_json) + 4096
+    for _ in range(3):
+        buf = ctypes.create_string_buffer(size)
+        needed = ctypes.c_size_t(0)
+        rc = fn(in_json, buf, size, ctypes.byref(needed))
+        if rc == 0:
+            return Symbol.fromjson(buf.value.decode())
+        if rc == 2 and needed.value > size:  # MXTPU_EXT_AGAIN
+            size = needed.value
+            continue
+        raise MXNetError(f"extension graph pass {name!r} failed (rc={rc})")
+    raise MXNetError(
+        f"extension graph pass {name!r}: buffer renegotiation did not "
+        "converge")
+
+
+def partition(sym, name: str):
+    """Partition a Symbol with a loaded extension op selector (reference
+    lib_api.h:812 CustomOpSelector): maximal connected subgraphs of
+    accepted ops get a shared ``__subgraph__`` id in their node attrs.
+    Returns ``(annotated Symbol, n_subgraphs)``."""
+    sel = _partitioners.get(name)
+    if sel is None:
+        raise MXNetError(
+            f"no loaded extension partitioner {name!r} "
+            f"(loaded: {partitioners()})")
+    import json as _json
+
+    from .symbol.symbol import Symbol
+
+    doc = _json.loads(sym.tojson())
+    nodes = doc["nodes"]
+    accepted = [n["op"] != "null" and bool(sel(n["op"].encode()))
+                for n in nodes]
+    # union-find over edges whose BOTH endpoints are accepted
+    parent = list(range(len(nodes)))
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    for i, n in enumerate(nodes):
+        if not accepted[i]:
+            continue
+        for j, _s, _o in n.get("inputs", []):
+            if accepted[j]:
+                parent[find(i)] = find(j)
+    groups: Dict[int, int] = {}
+    count = 0
+    for i in range(len(nodes)):
+        if not accepted[i]:
+            continue
+        root = find(i)
+        if root not in groups:
+            groups[root] = count
+            count += 1
+        nodes[i].setdefault("attrs", {})["__subgraph__"] = groups[root]
+    return Symbol.fromjson(_json.dumps(doc)), count

@@ -26,7 +26,8 @@ def ext_lib(tmp_path_factory):
          "-I", os.path.join(ROOT, "include"), src, "-o", out],
         check=True)
     names = library.load(out, verbose=False)
-    assert sorted(names) == ["my_clip01", "my_gelu"]
+    assert sorted(names) == ["my_add_relu", "my_clip01", "my_gelu",
+                             "partitioner:myprop", "pass:fuse_add_relu"]
     return out
 
 
@@ -94,3 +95,148 @@ def test_bad_library_errors():
         library.load("/nonexistent/lib.so")
     with pytest.raises(mx.MXNetError):
         library.get_op("never_registered")
+
+
+# ---- ABI v2: passes, partitioners, version handshake ----------------------
+
+def test_fused_op_forward_and_grad(ext_lib):
+    a = mx.np.array(onp.array([-1.0, 2.0, 0.25], onp.float32))
+    b = mx.np.array(onp.array([0.5, -3.0, 0.25], onp.float32))
+    y = mx.npx.my_add_relu(a, b).asnumpy()
+    onp.testing.assert_allclose(
+        y, onp.maximum(a.asnumpy() + b.asnumpy(), 0.0))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        loss = mx.npx.my_add_relu(a, b).sum()
+    loss.backward()
+    mask = (a.asnumpy() + b.asnumpy()) > 0
+    onp.testing.assert_allclose(a.grad.asnumpy(), mask.astype(onp.float32))
+    onp.testing.assert_allclose(b.grad.asnumpy(), mask.astype(onp.float32))
+
+
+def test_graph_pass_fuses_add_relu(ext_lib):
+    """The C pass rewrites relu(add(a,b)) -> my_add_relu(a,b) on the
+    symbol JSON (reference lib_api.h custom graph-pass contract)."""
+    import json
+
+    sa, sb = mx.sym.var("a"), mx.sym.var("b")
+    s = mx.sym.npx.relu(sa + sb)
+    s2 = library.apply_graph_pass(s, "fuse_add_relu")
+    ops = [n["op"] for n in json.loads(s2.tojson())["nodes"]]
+    assert "npx.my_add_relu" in ops
+    assert "npx.relu" not in ops and "np.add" not in ops
+
+    a = onp.array([-1.0, 2.0], onp.float32)
+    b = onp.array([0.5, -3.0], onp.float32)
+    (r1,) = s.eval(a=a, b=b)
+    (r2,) = s2.eval(a=a, b=b)
+    onp.testing.assert_allclose(r1.asnumpy(), r2.asnumpy())
+
+
+def test_graph_pass_skips_multi_consumer_add(ext_lib):
+    """An add feeding anything besides the relu must NOT be fused away."""
+    import json
+
+    sa, sb = mx.sym.var("a"), mx.sym.var("b")
+    summed = sa + sb
+    s = mx.sym.npx.relu(summed) * summed  # add has two consumers
+    s2 = library.apply_graph_pass(s, "fuse_add_relu")
+    ops = [n["op"] for n in json.loads(s2.tojson())["nodes"]]
+    assert "np.add" in ops and "npx.relu" in ops
+    assert "npx.my_add_relu" not in ops
+    a = onp.array([0.5, -2.0], onp.float32)
+    b = onp.array([1.0, 1.0], onp.float32)
+    (r1,) = s.eval(a=a, b=b)
+    (r2,) = s2.eval(a=a, b=b)
+    onp.testing.assert_allclose(r1.asnumpy(), r2.asnumpy())
+
+
+def test_partitioner_groups_connected_accepted_ops(ext_lib):
+    """myprop claims add/relu; gelu splits them into two subgraphs
+    (reference CustomOpSelector semantics)."""
+    import json
+
+    sa, sb = mx.sym.var("a"), mx.sym.var("b")
+    s = mx.sym.npx.relu(mx.sym.npx.my_gelu(sa + sb))
+    annotated, n_groups = library.partition(s, "myprop")
+    assert n_groups == 2
+    marks = {nd["name"]: nd.get("attrs", {}).get("__subgraph__")
+             for nd in json.loads(annotated.tojson())["nodes"]}
+    group_ids = {v for v in marks.values() if v is not None}
+    assert len(group_ids) == 2
+    # connected accepted ops share a group: relu(add(x)) directly
+    s4 = mx.sym.npx.relu(sa + sb)
+    annotated4, n4 = library.partition(s4, "myprop")
+    assert n4 == 1
+
+
+def test_wrong_abi_version_library_rejected(tmp_path):
+    """A library compiled for a different ABI must be refused at load
+    time (reference lib_api.h:2008 version handshake)."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in image")
+    src = tmp_path / "wrong_ver.cc"
+    src.write_text(
+        '#include "mxtpu_ext.h"\n'
+        'extern "C" int mxtpu_ext_abi_version(void) { return 999; }\n'
+        'extern "C" int mxtpu_ext_init(MXTpuExtRegistry *reg) {\n'
+        '  (void)reg; return MXTPU_EXT_SUCCESS;\n'
+        '}\n')
+    out = str(tmp_path / "libwrong_ver.so")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+         "-I", os.path.join(ROOT, "include"), str(src), "-o", out],
+        check=True)
+    with pytest.raises(mx.MXNetError, match="ABI version mismatch"):
+        library.load(out)
+    with pytest.raises(mx.MXNetError, match="no loaded extension graph"):
+        library.apply_graph_pass(mx.sym.var("x"), "not_registered")
+
+
+def test_v1_library_still_loads(tmp_path):
+    """A v1 binary (no handshake symbol, init checks abi_version == 1)
+    must keep loading: v2 only appended registry fields (append-only
+    contract in mxtpu_ext.h)."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in image")
+    src = tmp_path / "v1_ext.cc"
+    src.write_text(
+        '#include <cstring>\n'
+        '#include "mxtpu_ext.h"\n'
+        'namespace {\n'
+        'int infer(int32_t, const MXTpuTensor *in, int32_t n_out,\n'
+        '          int64_t shp[][MXTPU_EXT_MAX_NDIM], int32_t *nd,\n'
+        '          int32_t *dt) {\n'
+        '  for (int j = 0; j < n_out; ++j) {\n'
+        '    std::memcpy(shp[j], in[0].shape, sizeof(int64_t) * 8);\n'
+        '    nd[j] = in[0].ndim; dt[j] = in[0].dtype;\n'
+        '  }\n'
+        '  return MXTPU_EXT_SUCCESS;\n'
+        '}\n'
+        'int fwd(int32_t, const MXTpuTensor *in, int32_t,\n'
+        '        MXTpuTensor *out) {\n'
+        '  const float *x = (const float *)in[0].data;\n'
+        '  float *y = (float *)out[0].data;\n'
+        '  int64_t n = 1;\n'
+        '  for (int i = 0; i < in[0].ndim; ++i) n *= in[0].shape[i];\n'
+        '  for (int64_t i = 0; i < n; ++i) y[i] = x[i] * 2.0f;\n'
+        '  return MXTPU_EXT_SUCCESS;\n'
+        '}\n'
+        '}\n'
+        '/* a v1 binary: no mxtpu_ext_abi_version export, init insists\n'
+        '   the framework talks v1 */\n'
+        'extern "C" int mxtpu_ext_init(MXTpuExtRegistry *reg) {\n'
+        '  if (!reg || reg->abi_version != 1) return MXTPU_EXT_FAIL;\n'
+        '  return reg->register_op(reg, "v1_double", 1, 1, fwd, nullptr,\n'
+        '                          infer);\n'
+        '}\n')
+    out = str(tmp_path / "libv1_ext.so")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+         "-I", os.path.join(ROOT, "include"), str(src), "-o", out],
+        check=True)
+    names = library.load(out, verbose=False)
+    assert names == ["v1_double"]
+    y = mx.npx.v1_double(mx.np.array(onp.array([1.5, -2.0], onp.float32)))
+    onp.testing.assert_allclose(y.asnumpy(), [3.0, -4.0])
