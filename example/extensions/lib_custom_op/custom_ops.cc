@@ -198,14 +198,17 @@ int fuse_add_relu_pass(const char *in_json, char *out_buf,
   const char *nodes_end = strstr(base, "\"arg_nodes\"");
   if (nodes_end == nullptr) return MXTPU_EXT_FAIL;
 
-  /* locate every node's `"op":` occurrence */
+  /* locate every node's `"op":` occurrence; a graph beyond the cap must
+   * FAIL loudly, never silently half-rewrite */
   const int kMaxNodes = 4096;
   const char *op_pos[kMaxNodes];
   int n_nodes = 0;
   for (const char *p = strstr(base, "\"op\":");
-       p != nullptr && p < nodes_end && n_nodes < kMaxNodes;
-       p = strstr(p + 1, "\"op\":"))
+       p != nullptr && p < nodes_end;
+       p = strstr(p + 1, "\"op\":")) {
+    if (n_nodes >= kMaxNodes) return MXTPU_EXT_FAIL;
     op_pos[n_nodes++] = p;
+  }
 
   auto seg_begin = [&](int i) { return op_pos[i]; };
   auto seg_end = [&](int i) {
@@ -216,22 +219,27 @@ int fuse_add_relu_pass(const char *in_json, char *out_buf,
     return strncmp(seg_begin(i), pat.c_str(), pat.size()) == 0;
   };
 
-  /* count consumers of node j across all inputs regions + heads */
+  /* count consumers of node j across all inputs regions + heads;
+   * returns -1 (treated as "unsafe, don't fuse") if any region exceeds
+   * the id buffer — a truncated view must never green-light a fuse */
   auto consumers = [&](int j) {
+    const int kMaxIds = 64;
     int total = 0;
-    int ids[64];
+    int ids[kMaxIds];
     for (int k = 0; k < n_nodes; ++k) {
       const char *ib, *ie;
       if (!key_region(seg_begin(k), seg_end(k), "inputs", &ib, &ie))
         continue;
-      int c = parse_input_ids(ib, ie, ids, 64);
-      for (int t = 0; t < c && t < 64; ++t)
+      int c = parse_input_ids(ib, ie, ids, kMaxIds);
+      if (c > kMaxIds) return -1;
+      for (int t = 0; t < c; ++t)
         if (ids[t] == j) ++total;
     }
     const char *hb, *he;
     if (key_region(nodes_end, base + doc.size(), "heads", &hb, &he)) {
-      int c = parse_input_ids(hb, he, ids, 64);
-      for (int t = 0; t < c && t < 64; ++t)
+      int c = parse_input_ids(hb, he, ids, kMaxIds);
+      if (c > kMaxIds) return -1;
+      for (int t = 0; t < c; ++t)
         if (ids[t] == j) ++total;
     }
     return total;

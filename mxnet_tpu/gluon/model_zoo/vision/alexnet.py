@@ -38,5 +38,7 @@ class AlexNet(HybridBlock):
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
-        raise MXNetError("Pretrained weights unavailable offline; use load_parameters.")
+        from ..model_store import _load_pretrained
+
+        _load_pretrained(net, "alexnet", root, ctx=ctx)
     return net

@@ -87,7 +87,9 @@ def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
-        raise MXNetError("Pretrained weights unavailable offline; use load_parameters.")
+        from ..model_store import _load_pretrained
+
+        _load_pretrained(net, f"densenet{num_layers}", root, ctx=ctx)
     return net
 
 

@@ -137,5 +137,7 @@ class Inception3(HybridBlock):
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
-        raise MXNetError("Pretrained weights unavailable offline; use load_parameters.")
+        from ..model_store import _load_pretrained
+
+        _load_pretrained(net, "inceptionv3", root, ctx=ctx)
     return net

@@ -118,14 +118,18 @@ class MobileNetV2(HybridBlock):
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("Pretrained weights unavailable offline; use load_parameters.")
+        from ..model_store import _load_pretrained
+
+        _load_pretrained(net, f"mobilenet{multiplier}", root, ctx=ctx)
     return net
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("Pretrained weights unavailable offline; use load_parameters.")
+        from ..model_store import _load_pretrained
+
+        _load_pretrained(net, f"mobilenetv2_{multiplier}", root, ctx=ctx)
     return net
 
 

@@ -253,9 +253,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None, **kwa
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise MXNetError(
-            "Pretrained weights are not bundled in this offline build; "
-            "use net.load_parameters(path) with a converted .params file.")
+        from ..model_store import _load_pretrained
+
+        _load_pretrained(net, f"resnet{num_layers}_v{version}", root, ctx=ctx)
     return net
 
 

@@ -78,7 +78,9 @@ class SqueezeNet(HybridBlock):
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
     net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise MXNetError("Pretrained weights unavailable offline; use load_parameters.")
+        from ..model_store import _load_pretrained
+
+        _load_pretrained(net, f"squeezenet{version}", root, ctx=ctx)
     return net
 
 

@@ -286,6 +286,16 @@ def load(path: str, verbose: bool = True) -> List[str]:
                     register_pass, register_partitioner)
     rc = init(ctypes.byref(reg))
     if rc != 0:
+        # a failed init must leave NO trace: ops registered before the
+        # failing call would otherwise stay installed (and outlive their
+        # keepalives) even though the library declared failure
+        for item in registered:
+            if item.startswith("pass:"):
+                _graph_passes.pop(item[5:], None)
+            elif item.startswith("partitioner:"):
+                _partitioners.pop(item[12:], None)
+            else:
+                _uninstall(item)
         raise MXNetError(
             f"mxtpu_ext_init failed for {path}: {'; '.join(errors) or rc}")
     _libs.append(lib)
@@ -318,6 +328,23 @@ def _install(op: _ExtOp, jax_fn: Callable) -> None:
         if _sym._OPS:
             _sym._OPS[f"npx.{op.name}"] = mx_op
     except Exception:
+        pass
+
+
+def _uninstall(name: str) -> None:
+    from . import numpy_extension as npx
+
+    _ops.pop(name, None)
+    if getattr(npx, name, None) is not None:
+        try:
+            delattr(npx, name)
+        except AttributeError:
+            pass
+    try:
+        from .symbol import symbol as _sym
+
+        _sym._OPS.pop(f"npx.{name}", None)
+    except Exception:  # noqa: BLE001
         pass
 
 

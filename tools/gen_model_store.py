@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Regenerate the model_store manifest + golden logits.
+
+Run whenever a supported model's architecture or the RNG stream changes
+(get_model_file will tell you: generated-hash != manifest). Rewrites
+the ``_MODEL_SHA256`` entries in
+``mxnet_tpu/gluon/model_zoo/model_store.py`` in place and refreshes
+``tests/golden/<name>_logits.npz`` — the two must always move together,
+which is why one script produces both.
+
+Usage:  python tools/gen_model_store.py
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+import tempfile
+
+import numpy as onp
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.gluon.model_zoo import model_store  # noqa: E402
+from mxnet_tpu.serialization import load_params  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+
+
+def golden_input():
+    return onp.random.RandomState(1234).uniform(
+        -1, 1, size=(2, 3, 224, 224)).astype(onp.float32)
+
+
+def main() -> None:
+    store_py = os.path.join(
+        ROOT, "mxnet_tpu", "gluon", "model_zoo", "model_store.py")
+    golden_dir = os.path.join(ROOT, "tests", "golden")
+    os.makedirs(golden_dir, exist_ok=True)
+    src = open(store_py).read()
+
+    x = golden_input()
+    with tempfile.TemporaryDirectory() as tmp:
+        for name in model_store.supported_models():
+            path = os.path.join(tmp, f"{name}.params")
+            model_store._generate(name, path)
+            sha = model_store._logical_sha256(load_params(path))
+            print(f"{name}: sha256 {sha}")
+            # pin the manifest (replace whatever hex/placeholder is there)
+            pat = re.compile(
+                r'("%s":\s*\n\s*")[^"]*(")' % re.escape(name))
+            src, n = pat.subn(r"\g<1>%s\g<2>" % sha, src)
+            assert n == 1, f"could not pin manifest entry for {name}"
+
+            net = model_store._build(name)
+            net.load_parameters(path)
+            # train-mode forward (BN batch stats): untrained running
+            # stats at eval collapse deep no-skip nets (mobilenetv2) to
+            # ~1e-16, which would make the golden vacuous
+            with mx.autograd.record():
+                logits = net(mx.np.array(x)).asnumpy()
+            assert logits.std() > 0.1, (
+                f"{name}: degenerate golden logits (std {logits.std()})")
+            out = os.path.join(golden_dir, f"{name}_logits.npz")
+            onp.savez_compressed(out, logits=logits.astype(onp.float32))
+            print(f"  golden logits -> {out}  "
+                  f"(mean {logits.mean():+.6f}, std {logits.std():.6f})")
+
+    open(store_py, "w").write(src)
+    print(f"manifest pinned in {store_py}")
+
+
+if __name__ == "__main__":
+    main()
