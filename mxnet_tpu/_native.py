@@ -22,6 +22,14 @@ _tried = False
 
 
 def _lib_path() -> str:
+    # MXNET_LIBRARY_PATH (reference env_var.md): override where the
+    # native runtime library is looked up — a file path to the .so
+    # itself, or a directory containing it
+    override = os.environ.get("MXNET_LIBRARY_PATH")
+    if override:
+        if os.path.isdir(override):
+            return os.path.join(override, _LIB_NAME)
+        return override
     return os.path.join(os.path.dirname(__file__), "_lib", _LIB_NAME)
 
 

@@ -20,11 +20,11 @@ These weights are NOT trained (impossible offline). They are stable
 reference weights for (a) wiring/serialization tests, (b) downstream
 fine-tuning from a reproducible init, (c) API parity: user code written
 against ``pretrained=True`` runs unchanged. To use real trained weights,
-save a converted ``.params`` file into the cache path printed by
-:func:`get_model_file` — an existing file with a matching name is
-preferred when ``allow_custom=True`` (load_parameters is format-checked
-either way). The rest of the zoo raises with guidance, listed in
-``supported_models()``.
+save a converted ``.params`` file over the cache path returned by
+:func:`get_model_file`: a READABLE file whose hash differs from the
+manifest is treated as user-supplied and returned as-is (with a
+warning); only unreadable/corrupted files are regenerated. The rest of
+the zoo raises with guidance, listed in ``supported_models()``.
 """
 from __future__ import annotations
 
@@ -38,7 +38,7 @@ from ...base import MXNetError
 
 __all__ = ["get_model_file", "purge", "supported_models"]
 
-# name -> (generation seed, logical sha256 of the generated params)
+# name -> generation seed (the logical sha256 lives in _MODEL_SHA256)
 _MODELS: Dict[str, int] = {
     "resnet18_v1": 1801,
     "mobilenetv2_1.0": 2010,
@@ -97,10 +97,12 @@ def _build(name: str):
 def _generate(name: str, path: str) -> str:
     """Deterministically (re)generate the named model's weights.
 
-    Returns the logical sha256 of what was written (computed in memory —
-    no reload). The caller's RNG streams (numpy AND the mx PRNG key) are
-    restored exactly, so a script's random draws do not depend on
-    whether the weight cache was warm or cold."""
+    Returns the logical sha256 of what was written, computed by
+    re-reading the file through the loader path — the manifest must pin
+    exactly what load_parameters will see, not the in-memory arrays.
+    The caller's RNG streams (numpy AND the mx PRNG key) are restored
+    exactly, so a script's random draws do not depend on whether the
+    weight cache was warm or cold."""
     from ...numpy import random as mxrandom
 
     seed = _MODELS[name]
@@ -116,8 +118,7 @@ def _generate(name: str, path: str) -> str:
 
         net(mxnp.zeros((1, 3, 224, 224)))
         net.save_parameters(path)
-        # hash what a loader will actually read (single deserialization;
-        # get_model_file trusts this instead of re-reading the file)
+        # get_model_file trusts this return instead of re-hashing
         return _file_sha256(path)
     finally:
         onp.random.set_state(np_state)
@@ -141,11 +142,17 @@ def get_model_file(name: str, root: Optional[str] = None) -> str:
         try:
             if _file_sha256(path) == want:
                 return path
-        except Exception:  # noqa: BLE001 — treat unreadable as corrupted
-            pass
-        # mismatch = corruption or drift; regenerate like the reference
-        # re-downloads on checksum failure
-        os.remove(path)
+            # readable but different: user-supplied weights (the
+            # documented converted-weights workflow) — NEVER delete
+            # user data; serve it as-is
+            import warnings
+
+            warnings.warn(
+                f"{path} differs from the generated-weights manifest; "
+                f"treating it as user-supplied weights for {name!r}")
+            return path
+        except Exception:  # noqa: BLE001 — unreadable = corrupted
+            os.remove(path)
     got = _generate(name, path)
     if got != want:
         raise MXNetError(

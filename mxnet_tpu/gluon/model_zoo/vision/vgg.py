@@ -58,7 +58,9 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     if pretrained:
         from ..model_store import _load_pretrained
 
-        _load_pretrained(net, f"vgg{num_layers}", root, ctx=ctx)
+        # the reference stores BN variants under a distinct name
+        suffix = "_bn" if kwargs.get("batch_norm") else ""
+        _load_pretrained(net, f"vgg{num_layers}{suffix}", root, ctx=ctx)
     return net
 
 

@@ -233,6 +233,8 @@ def load(path: str, verbose: bool = True) -> List[str]:
 
     registered: List[str] = []
     errors: List[str] = []
+    journal: List[tuple] = []       # (kind, name, previous value)
+    local_keep: List[object] = []   # promoted to _keepalive on success
 
     @_REGFN
     def register_op(_reg, name, n_in, n_out, fwd, bwd, infer):
@@ -242,9 +244,9 @@ def load(path: str, verbose: bool = True) -> List[str]:
                 return 1
             op = _ExtOp(name.decode(), int(n_in), int(n_out), fwd, bwd, infer)
             jax_fn = _make_op(op)
-            _install(op, jax_fn)
+            journal.append(("op", op.name, _install(op, jax_fn)))
             registered.append(op.name)
-            _keepalive.append(op)
+            local_keep.append(op)
             return 0
         except Exception as e:  # noqa: BLE001
             errors.append(repr(e))
@@ -260,8 +262,10 @@ def load(path: str, verbose: bool = True) -> List[str]:
             if not fn:
                 errors.append("register_pass: fn is required")
                 return 1
-            _graph_passes[name.decode()] = _PASSFN(fn)
-            registered.append(f"pass:{name.decode()}")
+            key = name.decode()
+            journal.append(("pass", key, _graph_passes.get(key)))
+            _graph_passes[key] = _PASSFN(fn)
+            registered.append(f"pass:{key}")
             return 0
         except Exception as e:  # noqa: BLE001
             errors.append(repr(e))
@@ -273,8 +277,10 @@ def load(path: str, verbose: bool = True) -> List[str]:
             if not fn:
                 errors.append("register_partitioner: fn is required")
                 return 1
-            _partitioners[name.decode()] = _SELECTFN(fn)
-            registered.append(f"partitioner:{name.decode()}")
+            key = name.decode()
+            journal.append(("partitioner", key, _partitioners.get(key)))
+            _partitioners[key] = _SELECTFN(fn)
+            registered.append(f"partitioner:{key}")
             return 0
         except Exception as e:  # noqa: BLE001
             errors.append(repr(e))
@@ -286,19 +292,28 @@ def load(path: str, verbose: bool = True) -> List[str]:
                     register_pass, register_partitioner)
     rc = init(ctypes.byref(reg))
     if rc != 0:
-        # a failed init must leave NO trace: ops registered before the
-        # failing call would otherwise stay installed (and outlive their
-        # keepalives) even though the library declared failure
-        for item in registered:
-            if item.startswith("pass:"):
-                _graph_passes.pop(item[5:], None)
-            elif item.startswith("partitioner:"):
-                _partitioners.pop(item[12:], None)
+        # a failed init must leave NO trace: RESTORE each registration
+        # site to its pre-load value (pop-style removal would take out
+        # same-named items from previously loaded libraries, or delete a
+        # shadowed npx builtin); reverse order handles duplicate names
+        # within this load
+        for kind, name_, prev in reversed(journal):
+            if kind == "pass":
+                if prev is None:
+                    _graph_passes.pop(name_, None)
+                else:
+                    _graph_passes[name_] = prev
+            elif kind == "partitioner":
+                if prev is None:
+                    _partitioners.pop(name_, None)
+                else:
+                    _partitioners[name_] = prev
             else:
-                _uninstall(item)
+                _restore(name_, prev)
         raise MXNetError(
             f"mxtpu_ext_init failed for {path}: {'; '.join(errors) or rc}")
     _libs.append(lib)
+    _keepalive.extend(local_keep)
     _keepalive.extend([register_op, set_last_error, register_pass,
                        register_partitioner])
     if verbose and registered:
@@ -307,7 +322,9 @@ def load(path: str, verbose: bool = True) -> List[str]:
     return registered
 
 
-def _install(op: _ExtOp, jax_fn: Callable) -> None:
+def _install(op: _ExtOp, jax_fn: Callable) -> dict:
+    """Install the op into every registry; returns the previous value at
+    each site so a failed load can restore rather than delete."""
     from . import numpy_extension as npx
     from .ndarray.ndarray import ndarray
     from .ops.dispatch import apply_op
@@ -319,6 +336,9 @@ def _install(op: _ExtOp, jax_fn: Callable) -> None:
     mx_op.__doc__ = (f"Custom extension op {op.name!r} "
                      f"({op.n_in} inputs, {op.n_out} outputs; "
                      f"{'differentiable' if op.backward else 'no gradient'})")
+    prev = {"ops": _ops.get(op.name),
+            "npx": getattr(npx, op.name, None),
+            "sym": None}
     _ops[op.name] = mx_op
     setattr(npx, op.name, mx_op)
     # invalidate the symbol-op registry cache so mx.sym.npx picks it up
@@ -326,24 +346,35 @@ def _install(op: _ExtOp, jax_fn: Callable) -> None:
         from .symbol import symbol as _sym
 
         if _sym._OPS:
+            prev["sym"] = _sym._OPS.get(f"npx.{op.name}")
             _sym._OPS[f"npx.{op.name}"] = mx_op
     except Exception:
         pass
+    return prev
 
 
-def _uninstall(name: str) -> None:
+def _restore(name: str, prev: dict) -> None:
+    """Put every registry site back to its pre-_install value."""
     from . import numpy_extension as npx
 
-    _ops.pop(name, None)
-    if getattr(npx, name, None) is not None:
+    if prev["ops"] is None:
+        _ops.pop(name, None)
+    else:
+        _ops[name] = prev["ops"]
+    if prev["npx"] is None:
         try:
             delattr(npx, name)
         except AttributeError:
             pass
+    else:
+        setattr(npx, name, prev["npx"])
     try:
         from .symbol import symbol as _sym
 
-        _sym._OPS.pop(f"npx.{name}", None)
+        if prev["sym"] is None:
+            _sym._OPS.pop(f"npx.{name}", None)
+        else:
+            _sym._OPS[f"npx.{name}"] = prev["sym"]
     except Exception:  # noqa: BLE001
         pass
 

@@ -56,6 +56,17 @@ class Optimizer:
         use_fused_step=None,
         **kwargs,
     ):
+        # reference optimizer.py aggregate_num / MXNET_OPTIMIZER_
+        # AGGREGATION_SIZE: how many weights one fused update covers.
+        # Kept as an attribute for API parity; the Trainer's jitted step
+        # already fuses the update across ALL parameters (a superset of
+        # any aggregation window), so the knob does not change execution.
+        if aggregate_num is None:
+            from ..base import env_int
+
+            aggregate_num = max(env_int("MXNET_OPTIMIZER_AGGREGATION_SIZE",
+                                        4), 1)
+        self.aggregate_num = aggregate_num
         self.rescale_grad = rescale_grad
         self.lr = 0.01 if learning_rate is None else learning_rate
         self.lr_scheduler = lr_scheduler

@@ -266,3 +266,12 @@ class Marker:
 class Domain:
     def __init__(self, name):
         self.name = name
+
+
+# reference env_var.md: MXNET_PROFILER_AUTOSTART starts the profiler at
+# import; MXNET_PROFILER_MODE selects whether only symbolic/compiled
+# execution (0, the reference default) or everything including
+# imperative ops (1) is profiled
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    set_config(profile_all=os.environ.get("MXNET_PROFILER_MODE", "0") == "1")
+    set_state("run")

@@ -122,3 +122,27 @@ def test_unsupported_pretrained_raises_with_guidance():
 
     assert model_store.supported_models() == [
         "mobilenetv2_1.0", "resnet18_v1"]
+
+
+def test_model_store_keeps_user_supplied_weights(tmp_path):
+    """A READABLE params file that differs from the manifest is treated
+    as user-converted weights and is never deleted (documented
+    workflow)."""
+    import warnings
+
+    from mxnet_tpu.gluon.model_zoo import model_store
+
+    p = model_store.get_model_file("resnet18_v1", root=str(tmp_path))
+    net = vision.resnet18_v1()
+    onp.random.seed(7)
+    net.initialize(force_reinit=True)
+    net(mx.np.zeros((1, 3, 224, 224)))
+    net.save_parameters(p)  # valid file, different values
+    sha_user = model_store._file_sha256(p)
+    assert sha_user != model_store._MODEL_SHA256["resnet18_v1"]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        p2 = model_store.get_model_file("resnet18_v1", root=str(tmp_path))
+    assert p2 == p
+    assert model_store._file_sha256(p2) == sha_user  # NOT regenerated
+    assert any("user-supplied" in str(x.message) for x in w)

@@ -265,3 +265,18 @@ def test_libsvm_iter_produces_csr(tmp_path):
     ref = onp.array([[1.5, 0, 0, 2.0], [0, 4.0, 0, 0]], onp.float32)
     onp.testing.assert_allclose(dense, ref)
     onp.testing.assert_allclose(batches[0].label[0].asnumpy(), [1.0, 0.0])
+
+
+def test_mxnet_library_path_override(tmp_path, monkeypatch):
+    """MXNET_LIBRARY_PATH (reference env_var.md) redirects the native
+    .so lookup — file path or containing directory."""
+    from mxnet_tpu import _native
+
+    monkeypatch.setenv("MXNET_LIBRARY_PATH", str(tmp_path))
+    assert _native._lib_path() == str(tmp_path / _native._LIB_NAME)
+    f = tmp_path / "custom.so"
+    monkeypatch.setenv("MXNET_LIBRARY_PATH", str(f))
+    assert _native._lib_path() == str(f)
+    monkeypatch.delenv("MXNET_LIBRARY_PATH")
+    assert _native._lib_path().endswith(
+        os.path.join("mxnet_tpu", "_lib", _native._LIB_NAME))

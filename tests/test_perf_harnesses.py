@@ -3,6 +3,8 @@ tools/bandwidth README schemas)."""
 import os
 import numpy as onp
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def test_opperf_schema():
     import sys
@@ -81,3 +83,19 @@ def test_parse_log(tmp_path):
     lines = r.stdout.strip().splitlines()
     assert lines[0].startswith("epoch,")
     assert lines[1].startswith("0,") and lines[2].startswith("1,")
+
+
+def test_profiler_autostart_env(tmp_path):
+    """MXNET_PROFILER_AUTOSTART=1 starts the profiler at import
+    (reference env_var.md)."""
+    import subprocess
+    import sys
+
+    code = ("import mxnet_tpu.profiler as p; "
+            "print(p.is_running())")
+    env = dict(os.environ, MXNET_PROFILER_AUTOSTART="1",
+               JAX_PLATFORMS="cpu", PYTHONPATH=ROOT)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip().endswith("True")

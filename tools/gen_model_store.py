@@ -28,7 +28,6 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 from mxnet_tpu.gluon.model_zoo import model_store  # noqa: E402
-from mxnet_tpu.serialization import load_params  # noqa: E402
 import mxnet_tpu as mx  # noqa: E402
 
 
@@ -48,8 +47,9 @@ def main() -> None:
     with tempfile.TemporaryDirectory() as tmp:
         for name in model_store.supported_models():
             path = os.path.join(tmp, f"{name}.params")
-            model_store._generate(name, path)
-            sha = model_store._logical_sha256(load_params(path))
+            # _generate's return IS the loader-path hash get_model_file
+            # verifies against — pin exactly that
+            sha = model_store._generate(name, path)
             print(f"{name}: sha256 {sha}")
             # pin the manifest (replace whatever hex/placeholder is there)
             pat = re.compile(
