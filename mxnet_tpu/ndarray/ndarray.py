@@ -521,6 +521,57 @@ def _rebuild_ndarray(state):
 NDArray = ndarray
 
 
+# -- fluent methods (reference numpy/multiarray.py) -------------------------
+# The reference ndarray keeps a small set of REAL fluent delegations
+# (multiarray.py:1733 sort, :1749 argsort, std/var/repeat/tile/nonzero,
+# reshape_view, slice_assign*) and deliberately raises AttributeError for
+# the legacy nd fluent surface (exp/log/relu/...) — absence here matches
+# that contract exactly.
+
+def _fluent(op_name):
+    def method(self, *args, **kwargs):
+        from .. import numpy as _np
+
+        return getattr(_np, op_name)(self, *args, **kwargs)
+
+    method.__name__ = op_name
+    method.__doc__ = (f"Convenience fluent method for mx.np.{op_name} "
+                      f"with this array as the first argument.")
+    return method
+
+
+for _name in ("sort", "argsort", "std", "var", "repeat", "tile", "nonzero"):
+    setattr(ndarray, _name, _fluent(_name))
+
+
+def _as_np_ndarray(self):
+    return self
+
+
+def _reshape_view(self, *shape):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return self.reshape(shape)
+
+
+def _slice_assign(self, rhs, begin, end, step=None):
+    """Eager in-place region assign, returns self. Like ``__setitem__``
+    (which it delegates to), this mutates and is therefore REJECTED on a
+    grad-attached array inside ``autograd.record()`` — use
+    ``npx.index_update`` for a functional, differentiable update."""
+    step = step or [1] * len(begin)
+    key = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    self[key] = rhs
+    return self
+
+
+ndarray.as_np_ndarray = _as_np_ndarray
+ndarray.as_nd_ndarray = _as_np_ndarray  # unified array type on TPU
+ndarray.reshape_view = _reshape_view
+ndarray.slice_assign = _slice_assign
+ndarray.slice_assign_scalar = _slice_assign
+
+
 def array(obj, dtype=None, ctx=None, device=None) -> ndarray:
     return ndarray(obj, ctx=ctx or device, dtype=dtype)
 
