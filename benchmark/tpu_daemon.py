@@ -14,6 +14,8 @@ atomically banks the results where ``bench.py`` can serve them later:
   benchmark/results_train_tpu.json    train_bench.py table (resnet50/
                                       inception_v3/alexnet + bert_base)
   benchmark/opperf/results_tpu.json   per-op latency table
+  benchmark/results_attention_tpu.json  flash-attention tokens/s per
+                                      sequence length (1k..8k)
   benchmark/results_hbm_tpu.json      single-chip HBM bandwidth probe
 
 Each child measurement runs via the existing harnesses' child modes, so
@@ -45,6 +47,7 @@ PIDFILE = os.path.join(HERE, ".tpu_daemon.pid")
 TRAIN = os.path.join(HERE, "results_train_tpu.json")
 OPPERF = os.path.join(HERE, "opperf", "results_tpu.json")
 HBM = os.path.join(HERE, "results_hbm_tpu.json")
+ATTENTION = os.path.join(HERE, "results_attention_tpu.json")
 
 PROBE_INTERVAL_S = 180       # while the tunnel is down
 REFRESH_INTERVAL_S = 3600    # after a full successful suite
@@ -138,19 +141,29 @@ def capture_headline() -> str:
     return "banked"
 
 
-def capture_train() -> None:
-    rc, out = run_child(
-        [sys.executable, os.path.join(HERE, "train_bench.py"),
-         "--models", "resnet50_v1,inception_v3,alexnet", "--batch", "32"],
-        timeout=3600)
-    rec = parse_json_output(out)
+def bank_if_tpu(path: str, rec, rc: int, label: str) -> bool:
+    """Shared banking tail: stamp + atomic-write a TPU-device record."""
     if rec and rec.get("device") == "tpu":
         rec["captured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-        atomic_write(TRAIN, rec)
-        log(f"banked train table -> {TRAIN}")
-    else:
-        log(f"train capture failed (rc={rc})")
+        rec["captured_unix"] = time.time()
+        atomic_write(path, rec)
+        log(f"banked {label} -> {path}")
+        return True
+    log(f"{label} capture failed (rc={rc})")
+    return False
+
+
+def capture_train() -> None:
+    # per-child bounds chosen so the worst case (every child burning its
+    # timeout twice across 8 model x precision combos) stays inside the
+    # daemon's own budget: 8 * 2 * 420s < 7200s
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "train_bench.py"),
+         "--models", "resnet50_v1,inception_v3,alexnet,bert_base",
+         "--batch", "32", "--timeout", "420", "--retries", "1"],
+        timeout=7200)
+    bank_if_tpu(TRAIN, parse_json_output(out), rc, "train table")
 
 
 def capture_opperf() -> None:
@@ -169,6 +182,32 @@ def capture_opperf() -> None:
     else:
         log(f"opperf ran on {rec.get('_meta', {}).get('platform')}, "
             "not banking")
+
+
+def capture_attention() -> None:
+    """Pallas flash attention across sequence lengths — the long-context
+    capability the reference lacked entirely (SURVEY §5). One child per
+    length so a hang at 8k cannot discard the 1k-4k results."""
+    merged = None
+    last_rc = 0
+    for seq in ("1024", "2048", "4096", "8192"):
+        rc, out = run_child(
+            [sys.executable, os.path.join(HERE, "attention_bench.py"),
+             "--seqs", seq],
+            timeout=900)
+        last_rc = rc
+        rec = parse_json_output(out)
+        if not rec or rec.get("device") != "tpu":
+            log(f"attention L={seq} capture failed (rc={rc})")
+            continue
+        if merged is None:
+            merged = rec
+        else:
+            merged["results"].extend(rec.get("results", []))
+    if merged is None:
+        log(f"attention capture failed entirely (last rc={last_rc})")
+        return
+    bank_if_tpu(ATTENTION, merged, last_rc, "attention table")
 
 
 def capture_hbm() -> None:
@@ -196,13 +235,8 @@ print(json.dumps({"hbm_gbps": round(gb / dt, 1), "bytes_per_iter": n * 8,
 """
     rc, out = run_child([sys.executable, "-c", code], timeout=600)
     rec = parse_json_output(out)
-    if rec and rec.get("device") == "tpu":
-        rec["captured_at"] = time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-        atomic_write(HBM, rec)
-        log(f"banked HBM probe: {rec['hbm_gbps']} GB/s -> {HBM}")
-    else:
-        log(f"hbm capture failed (rc={rc})")
+    if bank_if_tpu(HBM, rec, rc, "HBM probe") and rec:
+        log(f"HBM bandwidth: {rec['hbm_gbps']} GB/s")
 
 
 def acquire_pidfile() -> bool:
@@ -248,6 +282,7 @@ def main() -> None:
                 # live bench.py isn't starved by hourly re-measurement
                 for path, cap in ((TRAIN, capture_train),
                                   (OPPERF, capture_opperf),
+                                  (ATTENTION, capture_attention),
                                   (HBM, capture_hbm)):
                     if ok == "banked" or not fresh(path):
                         if live_lock.held_by_live_process():
