@@ -16,6 +16,9 @@ atomically banks the results where ``bench.py`` can serve them later:
   benchmark/opperf/results_tpu.json   per-op latency table
   benchmark/results_attention_tpu.json  flash-attention tokens/s per
                                       sequence length (1k..8k)
+  benchmark/results_parity_tpu.json   numpy-oracle correctness of the
+                                      curated op set on real TPU
+                                      (tools/device_parity.py)
   benchmark/results_hbm_tpu.json      single-chip HBM bandwidth probe
 
 Each child measurement runs via the existing harnesses' child modes, so
@@ -48,6 +51,7 @@ TRAIN = os.path.join(HERE, "results_train_tpu.json")
 OPPERF = os.path.join(HERE, "opperf", "results_tpu.json")
 HBM = os.path.join(HERE, "results_hbm_tpu.json")
 ATTENTION = os.path.join(HERE, "results_attention_tpu.json")
+PARITY = os.path.join(HERE, "results_parity_tpu.json")
 
 PROBE_INTERVAL_S = 180       # while the tunnel is down
 REFRESH_INTERVAL_S = 3600    # after a full successful suite
@@ -196,6 +200,8 @@ def capture_attention() -> None:
              "--seqs", seq],
             timeout=900)
         last_rc = rc
+        if rc == -2:  # yielded to a live bench: stop contending, NOW
+            break
         rec = parse_json_output(out)
         if not rec or rec.get("device") != "tpu":
             log(f"attention L={seq} capture failed (rc={rc})")
@@ -208,6 +214,21 @@ def capture_attention() -> None:
         log(f"attention capture failed entirely (last rc={last_rc})")
         return
     bank_if_tpu(ATTENTION, merged, last_rc, "attention table")
+
+
+def capture_parity() -> None:
+    """Numpy-oracle correctness of the curated op set ON THE TPU —
+    the check_consistency artifact latency tables cannot provide."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(ROOT, "tools", "device_parity.py")],
+        timeout=1800)
+    rec = parse_json_output(out)
+    if bank_if_tpu(PARITY, rec, rc, "device parity"):
+        # a failing sweep (rc=1, failed=[...]) is still banked — the
+        # miscompare IS the finding — but must be loud in the log
+        log(f"device parity: {rec.get('passed')}/{rec.get('total')} ok"
+            + (f", FAILED: {rec.get('failed')}" if rec.get("failed")
+               else ""))
 
 
 def capture_hbm() -> None:
@@ -280,7 +301,8 @@ def main() -> None:
                 # secondary captures keep the chip busy for a long time —
                 # only (re)run the stale/missing ones, so a driver-run
                 # live bench.py isn't starved by hourly re-measurement
-                for path, cap in ((TRAIN, capture_train),
+                for path, cap in ((PARITY, capture_parity),
+                                  (TRAIN, capture_train),
                                   (OPPERF, capture_opperf),
                                   (ATTENTION, capture_attention),
                                   (HBM, capture_hbm)):

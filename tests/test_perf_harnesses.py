@@ -126,3 +126,24 @@ def test_opperf_full_registry_walker():
     assert meta["mode"] == "full"
     assert meta["measured"] >= 300, meta
     assert meta["errored"] == 0 and meta["skipped"] == 0, meta
+
+
+def test_device_parity_sweep():
+    """tools/device_parity.py: every curated op matches its numpy
+    oracle on the current backend (the check_consistency artifact the
+    daemon banks from real TPU)."""
+    import subprocess
+    import sys
+
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    from bench import parse_json_output  # the shared child-output parser
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "device_parity.py"),
+         "--cpu"],
+        capture_output=True, text=True, timeout=900,
+        env=dict(os.environ, PYTHONPATH=ROOT))
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = parse_json_output(out.stdout)
+    assert rec["failed"] == [] and rec["passed"] == rec["total"] >= 30
