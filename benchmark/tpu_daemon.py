@@ -71,11 +71,16 @@ def atomic_write(path: str, obj) -> None:
     os.replace(tmp, path)
 
 
+YIELDED = object()  # run_child rc sentinel: distinct from any returncode
+
+
 def run_child(cmd, timeout):
     """Run a measurement child, yielding the chip to a live bench: if
     bench.py takes the live lock mid-capture, the child is terminated so
     the driver's run doesn't contend with ours (a daemon capture can be
-    redone; a driver capture slot cannot)."""
+    redone; a driver capture slot cannot). Returns (rc, stdout); rc is
+    the YIELDED sentinel when the child was killed for a live bench
+    (proc.returncode itself can legitimately be -2 on SIGINT)."""
     try:
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True, cwd=ROOT)
@@ -93,7 +98,7 @@ def run_child(cmd, timeout):
                 log("live bench arrived; yielding the chip (killing child)")
                 proc.kill()
                 proc.communicate()
-                return -2, ""
+                return YIELDED, ""
             if time.time() > deadline:
                 log(f"timeout {timeout}s: {' '.join(cmd[:3])}...")
                 proc.kill()
@@ -200,7 +205,7 @@ def capture_attention() -> None:
              "--seqs", seq],
             timeout=900)
         last_rc = rc
-        if rc == -2:  # yielded to a live bench: stop contending, NOW
+        if rc is YIELDED:  # yielded to a live bench: stop contending, NOW
             break
         rec = parse_json_output(out)
         if not rec or rec.get("device") != "tpu":

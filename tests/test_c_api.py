@@ -368,6 +368,40 @@ def test_c_predict_program(capi, tmp_path):
     assert "OK" in out.stdout
 
 
+def test_cpp_binding_program(capi, tmp_path):
+    """The cpp-package role: a C++17 client over the header-only RAII
+    binding (include/mxtpu_cpp.hpp) runs eager math + the predict
+    workflow with no Python on the call path."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    net.hybridize()
+    x = mx.np.zeros((1, 3, 32, 32))
+    net(x)
+    prefix = str(tmp_path / "resnet18")
+    jfile, pfile = net.export(prefix, example_args=(x,))
+
+    exe = str(tmp_path / "cpp_predict")
+    libdir = os.path.join(ROOT, "mxnet_tpu", "_lib")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         os.path.join(ROOT, "example/cpp-package/predict.cpp"),
+         "-I", os.path.join(ROOT, "include"), "-o", exe,
+         "-L", libdir, "-lmxtpu_capi", f"-Wl,-rpath,{libdir}"],
+        check=True)
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe, jfile, pfile], env=env, capture_output=True,
+                         text=True, timeout=600)
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "np.add total: 110" in out.stdout
+    assert "top-1 class:" in out.stdout
+    assert "OK" in out.stdout
+
+
 def test_c_demo_program(capi, tmp_path):
     """Compile and run the example C frontend (example/c_api/demo.c) —
     the other-language-binding path end to end, no Python in the client."""

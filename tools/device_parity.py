@@ -187,11 +187,10 @@ def main():
     ap.add_argument("--output", default=None)
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
-    if args.cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
     import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
 
