@@ -321,7 +321,19 @@ class HybridBlock(Block):
         """Apply a registered model pass then hybridize (reference
         block.py:1095 optimize_for(backend=...), whose backends were
         SubgraphProperty partitioners; here passes live in
-        mx.contrib.passes — e.g. backend="fold_bn")."""
+        mx.contrib.passes — e.g. backend="fold_bn").
+
+        ``backend=None`` falls back to the ``MXNET_SUBGRAPH_BACKEND``
+        env var, matching the reference's build_subgraph.cc behavior of
+        activating a partitioner backend globally from the environment
+        (env_var.md); set it to a registered pass name.
+        """
+        if backend is None:
+            import os as _os
+
+            backend = _os.environ.get("MXNET_SUBGRAPH_BACKEND") or None
+            if backend is not None and backend.upper() == "NONE":
+                backend = None  # the reference's documented disable value
         if backend is not None:
             from ..contrib.passes import apply_pass
 

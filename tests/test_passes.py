@@ -74,6 +74,24 @@ def test_optimize_for_backend():
     assert "fold_bn" in passes.list_passes()
 
 
+def test_optimize_for_env_backend(monkeypatch):
+    """backend=None falls back to MXNET_SUBGRAPH_BACKEND (reference
+    build_subgraph.cc env activation, env_var.md)."""
+    net = _trained_conv_bn()
+    x = mx.np.array(onp.random.RandomState(4).randn(2, 3, 8, 8)
+                    .astype(onp.float32))
+    ref = net(x).asnumpy()
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "NONE")
+    net.optimize_for(x)  # reference disable value: hybridize, no pass
+    kinds = [type(b).__name__ for b in net._children.values()]
+    assert "BatchNorm" in kinds
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "fold_bn")
+    out = net.optimize_for(x)  # no explicit backend
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+    kinds = [type(b).__name__ for b in net._children.values()]
+    assert "BatchNorm" not in kinds  # the env-selected pass really ran
+
+
 def test_fold_bn_in_nested_sequential():
     inner = nn.HybridSequential(nn.Dense(6, in_units=4, use_bias=True),
                                 nn.BatchNorm(in_channels=6))
