@@ -64,6 +64,60 @@ def log(*a):
     print("[bench]", *a, file=sys.stderr, flush=True)
 
 
+def jaxpr_flops(fn, *args) -> float:
+    """Model FLOPs of one call by walking the jaxpr: 2*MACs over every
+    dot_general and conv_general_dilated (the MFU convention — matmul/
+    conv work, elementwise excluded). Pure tracing: no compile, no
+    backend, so it works when the axon remote-compile server's
+    cost_analysis returns nothing."""
+    import jax
+    import math
+
+    def eqn_flops(eqn):
+        prim = eqn.primitive.name
+        if prim == "dot_general":
+            (lc, rc), (lb, _rb) = eqn.params["dimension_numbers"]
+            lhs = eqn.invars[0].aval.shape
+            rhs = eqn.invars[1].aval.shape
+            batch = math.prod(lhs[d] for d in lb)
+            contract = math.prod(lhs[d] for d in lc)
+            lhs_free = math.prod(
+                d for i, d in enumerate(lhs) if i not in set(lc) | set(lb))
+            rhs_free = math.prod(
+                d for i, d in enumerate(rhs)
+                if i not in set(rc) | set(_rb))
+            return 2.0 * batch * contract * lhs_free * rhs_free
+        if prim == "conv_general_dilated":
+            out = eqn.outvars[0].aval.shape
+            rhs = eqn.invars[1].aval.shape
+            dn = eqn.params["dimension_numbers"]
+            kernel_spatial = math.prod(rhs[d] for d in dn.rhs_spec[2:])
+            in_per_group = rhs[dn.rhs_spec[1]]
+            return 2.0 * math.prod(out) * kernel_spatial * in_per_group
+        return 0.0
+
+    def walk(jaxpr):
+        total = 0.0
+        for eqn in jaxpr.eqns:
+            total += eqn_flops(eqn)
+            # a scan body executes `length` times; everything else that
+            # carries a subjaxpr (pjit, cond branches, custom_vjp, while
+            # — trip count unknowable statically, counted once) runs it
+            # once per call
+            mult = (eqn.params.get("length", 1)
+                    if eqn.primitive.name == "scan" else 1)
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                for sub in vs:
+                    if hasattr(sub, "jaxpr"):      # ClosedJaxpr
+                        total += mult * walk(sub.jaxpr)
+                    elif hasattr(sub, "eqns"):     # raw Jaxpr
+                        total += mult * walk(sub)
+        return total
+
+    return walk(jax.make_jaxpr(fn)(*args).jaxpr)
+
+
 def child(platform: str) -> None:
     """Measure in-process and print one JSON line. May crash/hang — the
     parent handles that."""
@@ -171,6 +225,14 @@ def child(platform: str) -> None:
                 step_flops = float(ca["flops"])
         except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
             log(f"cost_analysis unavailable: {e!r}")
+        if not step_flops:
+            # axon's remote-compile cost_analysis can come back empty —
+            # fall back to counting matmul/conv MACs from the jaxpr
+            try:
+                step_flops = jaxpr_flops(step, params, x)
+                log(f"flops via jaxpr walk: {step_flops/1e9:.2f} GF/step")
+            except Exception as e:  # noqa: BLE001
+                log(f"jaxpr flop count failed: {e!r}")
         return img_s, total_iters, step_flops
 
     # headline: bf16, the TPU-native precision (the reference's headline

@@ -120,9 +120,19 @@ def capture_headline() -> str:
     try:
         with open(HEADLINE) as f:
             banked = json.load(f)
-        keep_banked = (
-            banked["record"].get("value", 0) >= rec["value"]
-            and time.time() - banked.get("captured_unix", 0) < STALE_AFTER_S)
+        # mfu presence outranks raw img/s in BOTH directions (VERDICT
+        # round-2 weak #7: img/s alone is not evidence): an mfu-bearing
+        # record is never displaced by an mfu-less one, and always
+        # displaces one. Within the same mfu class, higher img/s wins;
+        # stale (>24h) banked records always lose.
+        fresh = time.time() - banked.get("captured_unix", 0) < STALE_AFTER_S
+        banked_mfu = bool(banked["record"].get("mfu"))
+        rec_mfu = bool(rec.get("mfu"))
+        if banked_mfu != rec_mfu:
+            keep_banked = fresh and banked_mfu
+        else:
+            keep_banked = fresh and \
+                banked["record"].get("value", 0) >= rec["value"]
     except Exception:  # noqa: BLE001 — nothing banked yet / malformed
         keep_banked = False
     if not isinstance(banked, dict):
@@ -233,7 +243,9 @@ def capture_parity() -> None:
         # miscompare IS the finding — but must be loud in the log
         log(f"device parity: {rec.get('passed')}/{rec.get('total')} ok"
             + (f", FAILED: {rec.get('failed')}" if rec.get("failed")
-               else ""))
+               else "")
+            + (f", BACKEND ERRORS: {rec.get('backend_errors')}"
+               if rec.get("backend_errors") else ""))
 
 
 def capture_hbm() -> None:

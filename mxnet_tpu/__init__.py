@@ -69,7 +69,9 @@ def __getattr__(name):
                "error": ".error", "log": ".log", "libinfo": ".libinfo",
                "model": ".model", "visualization": ".visualization",
                "viz": ".visualization",
-               "lr_scheduler": ".optimizer.lr_scheduler"}
+               "lr_scheduler": ".optimizer.lr_scheduler",
+               "registry": ".registry", "executor": ".executor",
+               "recordio": ".recordio", "serialization": ".serialization"}
     if name in targets:
         expected = importlib.util.resolve_name(targets[name], __name__)
         try:

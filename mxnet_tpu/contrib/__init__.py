@@ -3,3 +3,10 @@
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import passes  # noqa: F401
+from . import text  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import io  # noqa: F401
+from . import autograd  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import symbol  # noqa: F401
+from . import ndarray as nd  # noqa: F401 — reference alias mx.contrib.nd

@@ -1,0 +1,89 @@
+"""Legacy experimental autograd API (reference ``contrib/autograd.py``).
+
+The 0.x-era names (``train_section``/``test_section``/``mark_variables``
+/``grad_and_loss``/``grad``) kept for source compatibility, delegating
+to the first-class ``mxnet_tpu.autograd`` tape.
+"""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Set training/predict status and return the previous one
+    (reference ``contrib/autograd.py:30``)."""
+    prev = _ag.is_training()
+    _ag.set_training(bool(is_train))
+    # the legacy API couples recording to training
+    _ag.set_recording(bool(is_train))
+    return prev
+
+
+def train_section():
+    """``with train_section():`` — record + training mode
+    (reference ``:72``; equals ``autograd.record()``)."""
+    return _ag.record(train_mode=True)
+
+
+def test_section():
+    """``with test_section():`` — stop recording inside a train section
+    (reference ``:86``; equals ``autograd.pause()``)."""
+    return _ag.pause(train_mode=False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference ``:100``)."""
+    if not isinstance(variables, (list, tuple)):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var.attach_grad(grad_req=req)
+        if g is not None and req != "null":
+            var.grad[...] = g
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Compute gradients of marked variables (reference ``:121``)."""
+    _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Deprecated alias of :func:`backward` (reference ``:156``)."""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient and loss
+    (reference ``:161``)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else argnum
+            variables = [args[i] for i in argnums]
+        for v in variables:
+            v.attach_grad()
+        with train_section():
+            outputs = func(*args)
+        heads = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        _ag.backward(list(heads))
+        grads = [v.grad for v in variables]
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Return a function computing the gradient only (reference ``:193``)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+    return wrapped

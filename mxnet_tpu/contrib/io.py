@@ -1,0 +1,91 @@
+"""Contrib data iterators (reference ``python/mxnet/contrib/io.py``).
+
+``DataLoaderIter`` adapts a ``gluon.data.DataLoader`` to the legacy
+``DataIter`` interface so loader pipelines can drive symbolic /
+Module-style training loops (reference ``io.py:24``).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import numpy as _np
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a gluon ``DataLoader`` as a ``DataIter``.
+
+    Each loader batch must be a (data, label) pair; descriptors come from
+    the first batch, and ``iter_next()`` ADVANCES the cursor — the legacy
+    ``while it.iter_next(): it.getdata()`` loop works (reference
+    ``contrib/io.py:67-73``). A short final batch is zero-padded up to
+    ``batch_size`` with ``getpad()`` reporting the pad rows (``:90``).
+    """
+
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label", dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        data, label = next(self._iter)
+        self.batch_size = data.shape[0]
+        self.dtype = dtype
+        self._provide_data = [DataDesc(data_name, data.shape, dtype)]
+        self._provide_label = [DataDesc(label_name, label.shape,
+                                        str(getattr(label, "dtype", dtype)))]
+        self._current_batch = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._current_batch = None
+
+    def iter_next(self):
+        try:
+            self._current_batch = next(self._iter)
+        except StopIteration:
+            self._current_batch = None
+        return self._current_batch is not None
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        return DataBatch(data=self.getdata(), label=self.getlabel(),
+                         pad=self.getpad(), provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def _padded(self, arr, dtype):
+        """Zero-pad a short (last) batch up to batch_size."""
+        arr = onp.asarray(arr.asnumpy() if hasattr(arr, "asnumpy") else arr,
+                          dtype=dtype)
+        if arr.shape[0] == self.batch_size:
+            return _np.array(arr, dtype=dtype)
+        out = onp.zeros((self.batch_size,) + arr.shape[1:], dtype=dtype)
+        out[: arr.shape[0]] = arr
+        return _np.array(out, dtype=dtype)
+
+    def getdata(self):
+        assert self._current_batch is not None
+        return [self._padded(self._current_batch[0], self.dtype)]
+
+    def getlabel(self):
+        assert self._current_batch is not None
+        return [self._padded(self._current_batch[1],
+                             str(self.provide_label[0].dtype))]
+
+    def getpad(self):
+        assert self._current_batch is not None
+        return self.batch_size - self._current_batch[0].shape[0]
+
+    def getindex(self):
+        return None

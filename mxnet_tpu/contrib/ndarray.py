@@ -1,0 +1,22 @@
+"""``mx.contrib.ndarray`` namespace (reference ``contrib/ndarray.py`` —
+the registration target for contrib ops, e.g. ``mx.contrib.nd.MultiBoxPrior``).
+Here contrib ops live on ``npx`` (the 2.0-native surface); this module
+aliases them, including the legacy CamelCase spellings."""
+from .. import numpy_extension as _npx
+
+multibox_prior = _npx.multibox_prior
+multibox_target = _npx.multibox_target
+multibox_detection = _npx.multibox_detection
+deformable_convolution = _npx.deformable_convolution
+modulated_deformable_convolution = _npx.modulated_deformable_convolution
+
+# legacy 1.x CamelCase op names
+MultiBoxPrior = multibox_prior
+MultiBoxTarget = multibox_target
+MultiBoxDetection = multibox_detection
+DeformableConvolution = deformable_convolution
+
+__all__ = ["multibox_prior", "multibox_target", "multibox_detection",
+           "deformable_convolution", "modulated_deformable_convolution",
+           "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+           "DeformableConvolution"]

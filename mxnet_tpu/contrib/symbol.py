@@ -1,0 +1,23 @@
+"""``mx.contrib.symbol`` namespace (reference ``contrib/symbol.py``).
+Symbolic spellings of the contrib ops: each builds a Symbol node that
+lowers through the same op registry as the ndarray versions."""
+from ..symbol.symbol import _sym_op as _op
+
+__all__ = ["multibox_prior", "multibox_target", "multibox_detection",
+           "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection"]
+
+
+def _alias(qual):
+    def build(*args, **kwargs):
+        return _op(qual, *args, **kwargs)
+    build.__name__ = qual.split(".")[-1]
+    return build
+
+
+multibox_prior = _alias("npx.multibox_prior")
+multibox_target = _alias("npx.multibox_target")
+multibox_detection = _alias("npx.multibox_detection")
+
+MultiBoxPrior = multibox_prior
+MultiBoxTarget = multibox_target
+MultiBoxDetection = multibox_detection

@@ -1,0 +1,50 @@
+"""TensorBoard callback (reference ``contrib/tensorboard.py``).
+
+``LogMetricsCallback`` logs eval-metric values per epoch through any
+writer with an ``add_scalar(name, value, global_step)`` method.  The
+reference hard-imports ``mxboard`` (``tensorboard.py:59``); mxboard is
+not in this image, so a ``summary_writer`` can be injected directly
+(e.g. ``torch.utils.tensorboard.SummaryWriter`` or a test double) and
+the mxboard import is only attempted as a fallback.
+"""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Log training speed and evaluation metrics to TensorBoard.
+
+    Use as an epoch/batch-end callback: the ``param`` object must carry
+    ``eval_metric`` (with ``get_name_value()``) and ``epoch``.
+    """
+
+    def __init__(self, logging_dir, prefix=None, summary_writer=None):
+        self.prefix = prefix
+        if summary_writer is not None:
+            self.summary_writer = summary_writer
+            return
+        try:
+            from mxboard import SummaryWriter  # type: ignore
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.summary_writer = SummaryWriter(logging_dir)
+            except Exception:  # noqa: BLE001 — no writer available
+                logging.error(
+                    "No tensorboard writer available; pass summary_writer= "
+                    "explicitly or install mxboard/tensorboard.")
+                self.summary_writer = None
+
+    def __call__(self, param):
+        """Callback to log metrics in TensorBoard."""
+        if param.eval_metric is None or self.summary_writer is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value,
+                                           global_step=param.epoch)

@@ -1,0 +1,5 @@
+"""``mx.contrib.text`` — text token indexing + embeddings
+(reference ``python/mxnet/contrib/text/``)."""
+from . import utils  # noqa: F401
+from . import vocab  # noqa: F401
+from . import embedding  # noqa: F401

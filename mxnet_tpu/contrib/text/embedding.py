@@ -1,0 +1,344 @@
+"""Token embeddings (reference ``contrib/text/embedding.py``).
+
+Same API surface: a registry (``register``/``create``/
+``get_pretrained_file_names``), a ``_TokenEmbedding`` base extending
+``Vocabulary`` with an ``idx_to_vec`` matrix, the ``GloVe`` / ``FastText``
+pretrained families, file-backed ``CustomEmbedding`` and
+``CompositeEmbedding``.  Differences from the reference, by design:
+
+- Vectors live as ``mx.np`` arrays (jax-backed) instead of legacy nd.
+- This environment has no egress, so ``GloVe``/``FastText`` never
+  download (reference ``embedding.py:200`` fetches from S3); they load
+  from ``embedding_root`` if the user has placed the file there and
+  raise a clear error otherwise.  ``CustomEmbedding`` is the first-class
+  offline path.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+import warnings
+
+from ... import numpy as _np
+from ...ndarray.ndarray import NDArray as _NDArray
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "GloVe", "FastText", "CustomEmbedding", "CompositeEmbedding"]
+
+UNKNOWN_IDX = _vocab.UNKNOWN_IDX
+
+
+class _TokenEmbedding(_vocab.Vocabulary):
+    """Base: a Vocabulary whose indices also map to embedding vectors."""
+
+    # subclasses list the pretrained files they understand
+    pretrained_file_name_sha1 = {}
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = None
+        self._idx_to_vec = None
+
+    # --- registry -------------------------------------------------------
+    @classmethod
+    def _cls_registry(cls):
+        return _REGISTRY
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        embedding_name = cls.__name__.lower()
+        if pretrained_file_name not in cls.pretrained_file_name_sha1:
+            raise KeyError(
+                f"Cannot find pretrained file {pretrained_file_name} for token "
+                f"embedding {embedding_name}. Valid pretrained files for "
+                f"embedding {embedding_name}: "
+                f"{', '.join(cls.pretrained_file_name_sha1.keys())}")
+
+    @classmethod
+    def _get_pretrained_file(cls, embedding_root, pretrained_file_name):
+        """Offline resolution: the file must already be on disk under
+        ``embedding_root/<embedding_name>/`` (no egress in this build;
+        the reference downloads here, ``embedding.py:200``)."""
+        embedding_name = cls.__name__.lower()
+        embedding_root = os.path.expanduser(embedding_root)
+        path = os.path.join(embedding_root, embedding_name,
+                            pretrained_file_name)
+        if not os.path.isfile(path):
+            raise RuntimeError(
+                f"Pretrained embedding file {path} not found. This build runs "
+                "offline: download is unavailable; place the file there "
+                "yourself or use CustomEmbedding with a local file.")
+        return path
+
+    # --- loading --------------------------------------------------------
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8"):
+        """Stream the ``token<d>v1<d>v2...`` text format.  Reference
+        semantics kept (``embedding.py:232-306``): first occurrence of a
+        duplicated token wins; a 1-element line is treated as a header
+        and skipped; the unknown token's vector comes from the file when
+        present, else ``init_unknown_vec``."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise ValueError(
+                "`pretrained_file_path` must be a valid path to the "
+                "pre-trained token embedding file.")
+
+        logging.info("Loading pre-trained token embedding vectors from %s",
+                     pretrained_file_path)
+        vec_len = None
+        rows = []           # python floats; one flat list per token row
+        # tokens already indexed before the file loads (the unknown token
+        # at 0 plus any reserved_tokens passed through to Vocabulary) each
+        # need a matrix row so row i always belongs to idx_to_token[i]
+        n_preindexed = len(self._idx_to_token)
+        seen = set()
+        loaded_unknown_vec = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f, 1):
+                elems = line.rstrip().split(elem_delim)
+                assert len(elems) > 1, (
+                    f"At line {line_num} of the pre-trained text embedding "
+                    f"file: unexpected data format in {pretrained_file_path}.")
+                token, vec = elems[0], [float(x) for x in elems[1:]]
+                if token == self.unknown_token and loaded_unknown_vec is None:
+                    loaded_unknown_vec = vec
+                    seen.add(token)
+                elif token in seen:
+                    warnings.warn(
+                        f"line {line_num}: duplicate embedding for token "
+                        f"{token} skipped.")
+                elif len(vec) == 1:
+                    warnings.warn(
+                        f"line {line_num}: token {token} with 1-dimensional "
+                        f"vector {vec} is likely a header and is skipped.")
+                else:
+                    if vec_len is None:
+                        vec_len = len(vec)
+                    else:
+                        assert len(vec) == vec_len, (
+                            f"line {line_num}: dimension of token {token} is "
+                            f"{len(vec)} but previous tokens have {vec_len}.")
+                    rows.append(vec)
+                    self._idx_to_token.append(token)
+                    self._token_to_idx[token] = len(self._idx_to_token) - 1
+                    seen.add(token)
+
+        self._vec_len = vec_len
+        unk = (loaded_unknown_vec if loaded_unknown_vec is not None
+               else init_unknown_vec(shape=self._vec_len).tolist())
+        reserved_rows = [init_unknown_vec(shape=self._vec_len).tolist()
+                         for _ in range(n_preindexed - 1)]
+        self._idx_to_vec = _np.array([unk] + reserved_rows + rows,
+                                     dtype="float32")
+
+    def _index_tokens_from_vocabulary(self, vocabulary):
+        self._token_to_idx = (vocabulary.token_to_idx.copy()
+                              if vocabulary.token_to_idx is not None else None)
+        self._idx_to_token = (vocabulary.idx_to_token[:]
+                              if vocabulary.idx_to_token is not None else None)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = (vocabulary.reserved_tokens[:]
+                                 if vocabulary.reserved_tokens is not None
+                                 else None)
+
+    def _set_idx_to_vec_by_embeddings(self, token_embeddings, vocab_len,
+                                      vocab_idx_to_token):
+        """Assemble this embedding's matrix by querying source embeddings
+        for every vocabulary token (reference ``embedding.py:317``)."""
+        new_vec_len = sum(e.vec_len for e in token_embeddings)
+        cols = []
+        for embed in token_embeddings:
+            cols.append(embed.get_vecs_by_tokens(vocab_idx_to_token))
+        self._vec_len = new_vec_len
+        self._idx_to_vec = _np.concatenate(cols, axis=1)
+        assert self._idx_to_vec.shape == (vocab_len, new_vec_len)
+
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        if vocabulary is not None:
+            assert isinstance(vocabulary, _vocab.Vocabulary), (
+                "`vocabulary` must be an instance of Vocabulary.")
+            # rebind the index space to the vocabulary, then regenerate
+            # vectors for exactly those tokens
+            vecs = self.get_vecs_by_tokens(vocabulary.idx_to_token)
+            self._index_tokens_from_vocabulary(vocabulary)
+            self._idx_to_vec = vecs
+
+    # --- public ---------------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        """mx.np array of shape (len(self), vec_len)."""
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Look up vectors; unknown tokens get the unknown vector.  With
+        ``lower_case_backup`` a miss retries the lowercased token
+        (reference ``embedding.py:370``)."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        if not lower_case_backup:
+            indices = [self.token_to_idx.get(t, UNKNOWN_IDX) for t in tokens]
+        else:
+            indices = [self.token_to_idx[t] if t in self.token_to_idx
+                       else self.token_to_idx.get(t.lower(), UNKNOWN_IDX)
+                       for t in tokens]
+        vecs = _np.take(self._idx_to_vec,
+                        _np.array(indices, dtype="int32"), axis=0)
+        return vecs[0] if to_reduce else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of indexed tokens (reference
+        ``embedding.py:415``); unknown-to-this-embedding tokens raise."""
+        assert self._idx_to_vec is not None, \
+            "The property `idx_to_vec` has not been properly set."
+        if not isinstance(tokens, list) or len(tokens) == 1:
+            assert isinstance(new_vectors, _NDArray) and \
+                len(new_vectors.shape) in (1, 2), \
+                "`new_vectors` must be a 1-D or 2-D NDArray if `tokens` is " \
+                "a singleton."
+            if not isinstance(tokens, list):
+                tokens = [tokens]
+            if len(new_vectors.shape) == 1:
+                new_vectors = new_vectors.reshape((1, -1))
+        else:
+            assert isinstance(new_vectors, _NDArray) and \
+                len(new_vectors.shape) == 2, \
+                "`new_vectors` must be a 2-D NDArray if `tokens` is a list " \
+                "of multiple strings."
+        assert new_vectors.shape == (len(tokens), self.vec_len), (
+            f"The length of `new_vectors` must be equal to the number of "
+            f"tokens and each vector must have {self.vec_len} elements.")
+
+        indices = []
+        for token in tokens:
+            if token in self.token_to_idx:
+                indices.append(self.token_to_idx[token])
+            else:
+                raise ValueError(
+                    f"Token {token} is unknown. To update the embedding "
+                    "vector for an unknown token, please specify it "
+                    "explicitly as the `unknown_token` "
+                    f"{self.unknown_token} in `tokens`.")
+        buf = self._idx_to_vec.asnumpy().copy()
+        buf[indices] = new_vectors.asnumpy()
+        self._idx_to_vec = _np.array(buf, dtype="float32")
+
+
+_REGISTRY: dict = {}
+
+
+def register(embedding_cls):
+    """Register a ``_TokenEmbedding`` subclass under its lowercase name
+    (reference ``embedding.py:40``)."""
+    assert isinstance(embedding_cls, type) and \
+        issubclass(embedding_cls, _TokenEmbedding), \
+        "Only subclasses of _TokenEmbedding can be registered."
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Create a registered embedding by name (reference ``embedding.py:63``)."""
+    key = embedding_name.lower()
+    if key not in _REGISTRY:
+        raise KeyError(
+            f"Cannot find registered token embedding {embedding_name}. Valid "
+            f"names: {', '.join(sorted(_REGISTRY))}")
+    return _REGISTRY[key](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Valid pretrained file names, per embedding or for all
+    (reference ``embedding.py:90``)."""
+    if embedding_name is not None:
+        key = embedding_name.lower()
+        if key not in _REGISTRY:
+            raise KeyError(
+                f"Cannot find registered token embedding {embedding_name}.")
+        return list(_REGISTRY[key].pretrained_file_name_sha1.keys())
+    return {name: list(cls.pretrained_file_name_sha1.keys())
+            for name, cls in _REGISTRY.items()}
+
+
+def _zeros_init(shape):
+    return _np.zeros(shape)
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe embeddings (reference ``embedding.py:481``).  Offline: the
+    named file must already exist under ``embedding_root/glove/``."""
+
+    pretrained_file_name_sha1 = {
+        name: "" for name in
+        ["glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+         "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+         "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+         "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt"]}
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=_zeros_init, vocabulary=None, **kwargs):
+        self._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText embeddings (reference ``embedding.py:553``).  Offline:
+    the named ``.vec`` file must exist under ``embedding_root/fasttext/``."""
+
+    pretrained_file_name_sha1 = {
+        name: "" for name in
+        ["wiki.simple.vec", "wiki.en.vec", "crawl-300d-2M.vec"]}
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=_zeros_init, vocabulary=None, **kwargs):
+        self._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """User-file embedding: ``token<delim>v1<delim>v2...`` per line
+    (reference ``embedding.py:635``)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=_zeros_init, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (reference ``embedding.py:677``)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        assert isinstance(vocabulary, _vocab.Vocabulary), \
+            "`vocabulary` must be an instance of Vocabulary."
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        for embed in token_embeddings:
+            assert isinstance(embed, _TokenEmbedding), \
+                "`token_embeddings` must be a _TokenEmbedding or a list of " \
+                "them."
+        super().__init__()
+        self._index_tokens_from_vocabulary(vocabulary)
+        self._set_idx_to_vec_by_embeddings(
+            token_embeddings, len(vocabulary), vocabulary.idx_to_token)

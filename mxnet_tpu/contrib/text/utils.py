@@ -1,0 +1,24 @@
+"""Text-processing utilities (reference ``contrib/text/utils.py``)."""
+from __future__ import annotations
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in ``source_str`` split by the ``token_delim`` /
+    ``seq_delim`` regular expressions (reference ``utils.py:26``:
+    delimiters are regexes, empty tokens are dropped, counts accumulate
+    into ``counter_to_update`` when given)."""
+    source_str = filter(
+        None, re.split(token_delim + "|" + seq_delim, source_str))
+    if to_lower:
+        source_str = (t.lower() for t in source_str)
+
+    if counter_to_update is None:
+        return collections.Counter(source_str)
+    counter_to_update.update(source_str)
+    return counter_to_update

@@ -1,0 +1,112 @@
+"""Text token indexing (reference ``contrib/text/vocab.py``).
+
+``Vocabulary`` maps hashable tokens to contiguous indices.  Semantics
+kept from the reference (``vocab.py:73-215``): index 0 is always the
+unknown token, reserved tokens follow, then counter keys ordered by
+descending frequency with ties broken by token sort order; tokens below
+``min_freq`` or beyond ``most_freq_count`` are left unindexed (they map
+to the unknown index on lookup).
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+UNKNOWN_IDX = 0
+
+
+class Vocabulary:
+    """Indexes text tokens from a ``collections.Counter``."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        assert min_freq > 0, "`min_freq` must be set to a positive value."
+
+        if reserved_tokens is not None:
+            reserved_set = set(reserved_tokens)
+            assert unknown_token not in reserved_set, \
+                "`reserved_tokens` cannot contain `unknown_token`."
+            assert len(reserved_set) == len(reserved_tokens), \
+                "`reserved_tokens` cannot contain duplicate reserved tokens."
+
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        self._reserved_tokens = (
+            None if reserved_tokens is None else list(reserved_tokens))
+        if reserved_tokens is not None:
+            self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {
+            token: idx for idx, token in enumerate(self._idx_to_token)}
+
+        if counter is not None:
+            self._index_counter_keys(counter, unknown_token, reserved_tokens,
+                                     most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, unknown_token, reserved_tokens,
+                            most_freq_count, min_freq):
+        assert isinstance(counter, collections.Counter), \
+            "`counter` must be an instance of collections.Counter."
+        excluded = set(reserved_tokens) if reserved_tokens else set()
+        excluded.add(unknown_token)
+
+        # frequency desc, then token order — deterministic tie-break, as
+        # the reference prescribes for equal-frequency keys
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        budget = (len(pairs) if most_freq_count is None
+                  else most_freq_count)
+        for token, freq in pairs:
+            if freq < min_freq or budget <= 0:
+                break
+            if token in excluded:
+                continue
+            self._idx_to_token.append(token)
+            self._token_to_idx[token] = len(self._idx_to_token) - 1
+            budget -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        """dict mapping str → int index."""
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        """list mapping int index → str."""
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) → index(es); unknown tokens map to index 0
+        (reference ``vocab.py:160``)."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        indices = [self._token_to_idx.get(t, UNKNOWN_IDX) for t in tokens]
+        return indices[0] if to_reduce else indices
+
+    def to_tokens(self, indices):
+        """Index(es) → token(s); out-of-range raises ValueError
+        (reference ``vocab.py:186``)."""
+        to_reduce = False
+        if not isinstance(indices, list):
+            indices = [indices]
+            to_reduce = True
+        max_idx = len(self._idx_to_token) - 1
+        tokens = []
+        for idx in indices:
+            if not isinstance(idx, int) or idx > max_idx or idx < 0:
+                raise ValueError(
+                    f"Token index {idx} in the provided `indices` is invalid.")
+            tokens.append(self._idx_to_token[idx])
+        return tokens[0] if to_reduce else tokens

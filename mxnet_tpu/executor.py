@@ -1,0 +1,11 @@
+"""Top-level ``mx.executor`` module (reference ``python/mxnet/executor.py``).
+
+The reference keeps ``Executor`` in its own module; here the executor
+lives with the Symbol machinery (``symbol/symbol.py`` — XLA-compiled
+``simple_bind`` product) and this module re-exports it so
+``mx.executor.Executor`` and ``from mxnet_tpu.executor import Executor``
+both resolve, matching the reference import surface.
+"""
+from .symbol.symbol import Executor
+
+__all__ = ["Executor"]

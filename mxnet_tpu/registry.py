@@ -1,0 +1,105 @@
+"""Generic class registry helpers (reference ``python/mxnet/registry.py``).
+
+The reference exposes three factory-factories used by ``optimizer``,
+``initializer`` and ``lr_scheduler`` to build string-keyed class
+registries (``registry.py:48 get_register_func``, ``:85 get_alias_func``,
+``:112 get_create_func``).  Here the same public API is provided over a
+plain per-base-class dict; ``create`` accepts an instance (passthrough),
+a name string, a ``{"name": ...}`` dict, or the two JSON spellings
+(``'["name", {...}]'`` / ``'{"nickname": "name", ...}'``) exactly like
+the reference so serialized optimizer configs round-trip.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import warnings
+from typing import Any, Callable, Dict, Type
+
+_REGISTRY: Dict[type, Dict[str, type]] = {}
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+
+def _registry_for(base_class: type) -> Dict[str, type]:
+    return _REGISTRY.setdefault(base_class, {})
+
+
+def get_register_func(base_class: type, nickname: str) -> Callable:
+    """Return a ``register(klass, name=None)`` function for ``base_class``."""
+    registry = _registry_for(base_class)
+
+    def register(klass: Type, name: str | None = None) -> Type:
+        assert issubclass(klass, base_class), (
+            f"Can only register subclass of {base_class.__name__}")
+        if name is None:
+            name = klass.__name__
+        name = name.lower()
+        if name in registry:
+            logging.warning(
+                "New %s %s.%s registered with name %s is overriding existing "
+                "%s %s.%s", nickname, klass.__module__, klass.__name__, name,
+                nickname, registry[name].__module__, registry[name].__name__)
+        registry[name] = klass
+        return klass
+
+    register.__doc__ = f"Register {nickname} to the {nickname} factory"
+    return register
+
+
+def get_alias_func(base_class: type, nickname: str) -> Callable:
+    """Return an ``alias(*names)`` decorator factory for ``base_class``."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases: str) -> Callable:
+        def reg(klass: Type) -> Type:
+            for name in aliases:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class: type, nickname: str) -> Callable:
+    """Return a ``create(name_or_instance, **kwargs)`` factory."""
+    registry = _registry_for(base_class)
+
+    def create(*args: Any, **kwargs: Any) -> Any:
+        if args:
+            name, args = args[0], args[1:]
+        else:
+            name = kwargs.pop(nickname)
+
+        if isinstance(name, base_class):
+            assert not args and not kwargs, (
+                f"{nickname} is already an instance. "
+                "Additional arguments are invalid")
+            return name
+
+        if isinstance(name, dict):
+            return create(**name)
+
+        assert isinstance(name, str), f"{nickname} must be of string type"
+
+        if name.startswith("["):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        if name.startswith("{"):
+            assert not args and not kwargs
+            kwargs = json.loads(name)
+            return create(**kwargs)
+
+        name = name.lower()
+        assert name in registry, (
+            f"{name} is not registered. "
+            f"Please register with {nickname}.register first")
+        return registry[name](*args, **kwargs)
+
+    create.__doc__ = (
+        f"Create a {nickname} instance from config.\n\n"
+        f"Accepts a registered name string, a {base_class.__name__} instance "
+        "(returned as-is), a config dict, or a JSON-encoded spec.")
+    return create

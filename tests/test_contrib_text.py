@@ -1,0 +1,270 @@
+"""mx.contrib.text (vocab/embedding/utils), mx.registry, mx.executor,
+mx.contrib.{tensorboard,io,autograd,ndarray,symbol} — the contrib tail
+(reference python/mxnet/contrib/text/, registry.py, contrib/*.py)."""
+import collections
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+
+
+# -- utils ------------------------------------------------------------------
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str("a b c\na b\nc C", to_lower=True)
+    assert c["a"] == 2 and c["b"] == 2 and c["c"] == 3
+    c2 = text.utils.count_tokens_from_str("x y", counter_to_update=c)
+    assert c2 is c and c["x"] == 1
+
+
+def test_count_tokens_custom_delims():
+    c = text.utils.count_tokens_from_str(
+        "tok1<td>tok2<sd>tok1", token_delim="<td>", seq_delim="<sd>")
+    assert c["tok1"] == 2 and c["tok2"] == 1
+
+
+# -- vocabulary -------------------------------------------------------------
+
+def test_vocabulary_indexing_order():
+    counter = collections.Counter(
+        ["c", "c", "c", "b", "b", "a", "rare"])
+    v = text.vocab.Vocabulary(counter, min_freq=2,
+                              reserved_tokens=["<pad>", "<bos>"])
+    # unk=0, reserved next, then frequency-desc
+    assert v.idx_to_token[:5] == ["<unk>", "<pad>", "<bos>", "c", "b"]
+    assert len(v) == 5  # 'a' and 'rare' below min_freq
+    assert v.to_indices("c") == 3
+    assert v.to_indices(["b", "nope"]) == [4, 0]
+    assert v.to_tokens([3, 4]) == ["c", "b"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+
+def test_vocabulary_most_freq_count():
+    counter = collections.Counter(dict(a=5, b=4, c=3, d=2))
+    v = text.vocab.Vocabulary(counter, most_freq_count=2)
+    assert len(v) == 3  # unk + a + b
+    assert set(v.token_to_idx) == {"<unk>", "a", "b"}
+
+
+def test_vocabulary_tie_break_deterministic():
+    counter = collections.Counter(dict(z=2, y=2, x=2))
+    v = text.vocab.Vocabulary(counter)
+    assert v.idx_to_token[1:] == ["x", "y", "z"]
+
+
+def test_vocabulary_reserved_validation():
+    with pytest.raises(AssertionError):
+        text.vocab.Vocabulary(reserved_tokens=["<unk>"])
+    with pytest.raises(AssertionError):
+        text.vocab.Vocabulary(reserved_tokens=["<pad>", "<pad>"])
+
+
+# -- embeddings -------------------------------------------------------------
+
+@pytest.fixture
+def embed_file(tmp_path):
+    p = tmp_path / "embed.txt"
+    p.write_text("tok1 1.0 2.0\ntok2 3.0 4.0\ntok1 9.0 9.0\n")
+    return str(p)
+
+
+def test_custom_embedding_load(embed_file):
+    with pytest.warns(UserWarning):  # duplicate tok1 line
+        e = text.embedding.CustomEmbedding(embed_file)
+    assert e.vec_len == 2
+    assert len(e) == 3  # unk + 2 tokens
+    v = e.get_vecs_by_tokens("tok2")
+    assert onp.allclose(v.asnumpy(), [3.0, 4.0])
+    # first occurrence wins for duplicates
+    assert onp.allclose(e.get_vecs_by_tokens("tok1").asnumpy(), [1.0, 2.0])
+    # unknown → zeros (default init_unknown_vec)
+    assert onp.allclose(e.get_vecs_by_tokens("missing").asnumpy(), [0, 0])
+
+
+def test_embedding_batch_and_lowercase_backup(embed_file):
+    with pytest.warns(UserWarning):
+        e = text.embedding.CustomEmbedding(embed_file)
+    vecs = e.get_vecs_by_tokens(["tok1", "tok2"])
+    assert vecs.shape == (2, 2)
+    assert onp.allclose(
+        e.get_vecs_by_tokens("TOK2", lower_case_backup=True).asnumpy(),
+        [3.0, 4.0])
+
+
+def test_update_token_vectors(embed_file):
+    with pytest.warns(UserWarning):
+        e = text.embedding.CustomEmbedding(embed_file)
+    e.update_token_vectors("tok1", mx.np.array([7.0, 8.0]))
+    assert onp.allclose(e.get_vecs_by_tokens("tok1").asnumpy(), [7.0, 8.0])
+    with pytest.raises(ValueError):
+        e.update_token_vectors("nope", mx.np.array([1.0, 1.0]))
+
+
+def test_composite_embedding(embed_file, tmp_path):
+    with pytest.warns(UserWarning):
+        e1 = text.embedding.CustomEmbedding(embed_file)
+    p2 = tmp_path / "e2.txt"
+    p2.write_text("tok1 10.0 11.0\ntok3 30.0 31.0\n")
+    e2 = text.embedding.CustomEmbedding(str(p2))
+    vocab = text.vocab.Vocabulary(collections.Counter(["tok1", "tok3"]))
+    ce = text.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert ce.vec_len == 4
+    assert ce.idx_to_vec.shape == (len(vocab), 4)
+    got = ce.get_vecs_by_tokens("tok1").asnumpy()
+    assert onp.allclose(got, [1.0, 2.0, 10.0, 11.0])
+    # tok3 unknown to e1 → zeros there, known to e2
+    got3 = ce.get_vecs_by_tokens("tok3").asnumpy()
+    assert onp.allclose(got3, [0.0, 0.0, 30.0, 31.0])
+
+
+def test_embedding_vocabulary_restriction(embed_file):
+    vocab = text.vocab.Vocabulary(collections.Counter(["tok2", "other"]))
+    with pytest.warns(UserWarning):
+        e = text.embedding.CustomEmbedding(embed_file, vocabulary=vocab)
+    assert len(e) == len(vocab)
+    assert onp.allclose(e.get_vecs_by_tokens("tok2").asnumpy(), [3.0, 4.0])
+    # tok1 was dropped by the vocabulary restriction
+    assert e.to_indices("tok1") == 0
+
+
+def test_embedding_registry():
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in \
+        text.embedding.get_pretrained_file_names("glove")
+    with pytest.raises(KeyError):
+        text.embedding.get_pretrained_file_names("nope")
+    # offline: pretrained families refuse cleanly when the file is absent
+    with pytest.raises(RuntimeError, match="offline"):
+        text.embedding.create("glove",
+                              pretrained_file_name="glove.6B.50d.txt",
+                              embedding_root="/nonexistent")
+    with pytest.raises(KeyError):
+        text.embedding.create("glove", pretrained_file_name="bad.txt")
+
+
+# -- mx.registry ------------------------------------------------------------
+
+def test_registry_roundtrip():
+    from mxnet_tpu.registry import (get_alias_func, get_create_func,
+                                    get_register_func)
+
+    class Sched:
+        pass
+
+    register = get_register_func(Sched, "sched")
+    alias = get_alias_func(Sched, "sched")
+    create = get_create_func(Sched, "sched")
+
+    @alias("warm", "warmup")
+    class Warm(Sched):
+        def __init__(self, steps=10):
+            self.steps = steps
+    register(Warm)
+
+    assert isinstance(create("warm"), Warm)
+    assert create("warmup", steps=3).steps == 3
+    assert create('{"sched": "warm", "steps": 5}').steps == 5
+    assert create('["warm", {"steps": 7}]').steps == 7
+    inst = Warm()
+    assert create(inst) is inst
+    with pytest.raises(AssertionError):
+        create("missing")
+
+
+# -- mx.executor / contrib shims -------------------------------------------
+
+def test_executor_module():
+    import mxnet_tpu.executor as ex
+    a = mx.sym.var("a")
+    b = a * 2
+    e = b.simple_bind(a=(2, 2)) if hasattr(b, "simple_bind") else None
+    assert ex.Executor is mx.symbol.symbol.Executor
+    if e is not None:
+        assert isinstance(e, ex.Executor)
+
+
+def test_contrib_tensorboard_callback():
+    records = []
+
+    class Writer:
+        def add_scalar(self, name, value, global_step):
+            records.append((name, value, global_step))
+
+    cb = mx.contrib.tensorboard.LogMetricsCallback(
+        None, prefix="train", summary_writer=Writer())
+
+    class Param:
+        epoch = 3
+
+        class eval_metric:  # noqa: N801 — mimics BatchEndParam shape
+            @staticmethod
+            def get_name_value():
+                return [("acc", 0.9)]
+
+    cb(Param)
+    assert records == [("train-acc", 0.9, 3)]
+
+
+def test_contrib_dataloader_iter():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    x = onp.random.rand(10, 4).astype("float32")
+    y = onp.arange(10).astype("float32")
+    loader = DataLoader(ArrayDataset(mx.np.array(x), mx.np.array(y)),
+                        batch_size=4)
+    it = mx.contrib.io.DataLoaderIter(loader)
+    # the legacy advancing iter_next() protocol: short last batch is
+    # zero-padded to batch_size with getpad() reporting the pad rows
+    pads = []
+    while it.iter_next():
+        assert it.getdata()[0].shape == (4, 4)
+        pads.append(it.getpad())
+    assert pads == [0, 0, 2]
+    assert it.provide_data[0].shape == (4, 4)
+    it.reset()
+    batches = list(it)
+    assert len(batches) == 3 and batches[-1].pad == 2
+    assert batches[0].data[0].shape == (4, 4)
+
+
+def test_custom_embedding_with_reserved_tokens(embed_file):
+    # rows must stay aligned with indices when the vocabulary already
+    # holds reserved tokens before the file loads
+    with pytest.warns(UserWarning):
+        e = text.embedding.CustomEmbedding(embed_file,
+                                           reserved_tokens=["<pad>"])
+    assert e.idx_to_vec.shape == (4, 2)
+    assert onp.allclose(e.get_vecs_by_tokens("tok1").asnumpy(), [1.0, 2.0])
+    assert onp.allclose(e.get_vecs_by_tokens("tok2").asnumpy(), [3.0, 4.0])
+    assert onp.allclose(e.get_vecs_by_tokens("<pad>").asnumpy(), [0.0, 0.0])
+
+
+def test_vocab_to_tokens_negative_raises():
+    v = text.vocab.Vocabulary(collections.Counter(["a"]))
+    with pytest.raises(ValueError):
+        v.to_tokens(-1)
+
+
+def test_contrib_autograd_legacy():
+    from mxnet_tpu.contrib import autograd as cag
+    g = cag.grad(lambda a: (a * a).sum())
+    out = g(mx.np.array([1.0, 2.0]))
+    assert onp.allclose(out[0].asnumpy(), [2.0, 4.0])
+    gl = cag.grad_and_loss(lambda a: (a * a).sum())
+    grads, loss = gl(mx.np.array([3.0]))
+    assert onp.allclose(grads[0].asnumpy(), [6.0])
+    assert float(loss.asnumpy()) == 9.0
+
+
+def test_contrib_nd_and_symbol_namespaces():
+    assert mx.contrib.nd.MultiBoxPrior is mx.contrib.ndarray.multibox_prior
+    out = mx.contrib.nd.multibox_prior(
+        mx.np.zeros((1, 3, 4, 4)), sizes=[0.5], ratios=[1.0])
+    assert out.shape[-1] == 4
+    s = mx.contrib.symbol.multibox_prior(
+        mx.sym.var("data"), sizes=[0.5], ratios=[1.0])
+    res = s.eval(data=mx.np.zeros((1, 3, 4, 4)))[0]
+    assert onp.allclose(res.asnumpy(), out.asnumpy())

