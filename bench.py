@@ -96,23 +96,32 @@ def jaxpr_flops(fn, *args) -> float:
             return 2.0 * math.prod(out) * kernel_spatial * in_per_group
         return 0.0
 
+    def sub_flops(sub):
+        if hasattr(sub, "jaxpr"):      # ClosedJaxpr
+            return walk(sub.jaxpr)
+        if hasattr(sub, "eqns"):       # raw Jaxpr
+            return walk(sub)
+        return 0.0
+
     def walk(jaxpr):
         total = 0.0
         for eqn in jaxpr.eqns:
             total += eqn_flops(eqn)
+            prim = eqn.primitive.name
+            if prim == "cond":
+                # one branch executes per call — charge the heaviest
+                total += max((sub_flops(b)
+                              for b in eqn.params.get("branches", ())),
+                             default=0.0)
+                continue
             # a scan body executes `length` times; everything else that
-            # carries a subjaxpr (pjit, cond branches, custom_vjp, while
-            # — trip count unknowable statically, counted once) runs it
-            # once per call
-            mult = (eqn.params.get("length", 1)
-                    if eqn.primitive.name == "scan" else 1)
+            # carries a subjaxpr (pjit, custom_vjp, while — trip count
+            # unknowable statically, counted once) runs it once per call
+            mult = eqn.params.get("length", 1) if prim == "scan" else 1
             for v in eqn.params.values():
                 vs = v if isinstance(v, (list, tuple)) else [v]
                 for sub in vs:
-                    if hasattr(sub, "jaxpr"):      # ClosedJaxpr
-                        total += mult * walk(sub.jaxpr)
-                    elif hasattr(sub, "eqns"):     # raw Jaxpr
-                        total += mult * walk(sub)
+                    total += mult * sub_flops(sub)
         return total
 
     return walk(jax.make_jaxpr(fn)(*args).jaxpr)

@@ -118,6 +118,81 @@ int MXPredGetOutput(PredictorHandle pred, int index, float *data,
                     size_t size);
 int MXPredFree(PredictorHandle pred);
 
+/* ---- NDArray manipulation (MXNDArrayReshape/Slice/At parity; each
+   returns a NEW handle, the source stays owned by the caller) ---- */
+int MXNDArrayReshape(NDArrayHandle h, int ndim, const int64_t *shape,
+                     NDArrayHandle *out);
+int MXNDArraySlice(NDArrayHandle h, int64_t begin, int64_t end,
+                   NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle h, int64_t idx, NDArrayHandle *out);
+int MXNDArrayAsType(NDArrayHandle h, int dtype_code, NDArrayHandle *out);
+/* in-place overwrite from host memory; nbytes must equal the array's
+   byte size (MXNDArraySyncCopyFromCPU parity) */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void *data,
+                             size_t nbytes);
+
+/* ---- autograd breadth (MXAutograd* parity) ---- */
+int MXAutogradSetIsTraining(int on, int *prev);
+int MXAutogradIsTraining(int *out);
+/* grad_reqs: per-array strings "write" | "add" | "null" */
+int MXAutogradMarkVariables(int num, NDArrayHandle *handles,
+                            const char **grad_reqs);
+/* multiple heads with optional head gradients (NULL for ones) */
+int MXAutogradBackwardEx(int n_heads, NDArrayHandle *heads,
+                         NDArrayHandle *head_grads, int retain_graph,
+                         int train_mode);
+
+/* ---- Executor (MXExecutorSimpleBindEx-shaped; shapes as JSON
+   {name: [dims]}; grad_req applies to every argument) ---- */
+typedef void *ExecutorHandle;
+int MXExecutorSimpleBind(SymbolHandle sym, const char *shapes_json,
+                         const char *grad_req, ExecutorHandle *out);
+int MXExecutorForward(ExecutorHandle ex, int is_train, int n_args,
+                      const char **arg_names, NDArrayHandle *args,
+                      int *n_outputs);
+int MXExecutorOutputs(ExecutorHandle ex, int max_out, NDArrayHandle *outputs,
+                      int *n_out);
+int MXExecutorBackward(ExecutorHandle ex, int n_grads,
+                       NDArrayHandle *out_grads);
+int MXExecutorArgGrad(ExecutorHandle ex, const char *arg_name,
+                      NDArrayHandle *out);
+int MXExecutorFree(ExecutorHandle ex);
+
+/* ---- KVStore (MXKVStore* parity; int keys) ---- */
+typedef void *KVStoreHandle;
+/* updater contract (reference MXKVStoreUpdater): called per key at push
+   when set; must read `recv` and write the merged result into `local`
+   (e.g. via MXNDArraySyncCopyFromCPU) */
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *user);
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle h);
+int MXKVStoreInit(KVStoreHandle h, int num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle h, int num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle h, int num, const int *keys,
+                  NDArrayHandle *outs, int priority);
+int MXKVStorePushPull(KVStoreHandle h, int num, const int *keys,
+                      NDArrayHandle *vals, NDArrayHandle *outs,
+                      int priority);
+int MXKVStoreBroadcast(KVStoreHandle h, int num, const int *keys,
+                       NDArrayHandle *vals, NDArrayHandle *outs,
+                       int priority);
+int MXKVStoreGetType(KVStoreHandle h, char *buf, int buf_len);
+int MXKVStoreGetRank(KVStoreHandle h, int *rank);
+int MXKVStoreGetGroupSize(KVStoreHandle h, int *size);
+int MXKVStoreSetUpdater(KVStoreHandle h, MXKVStoreUpdater updater,
+                        void *user);
+
+/* ---- runtime control ---- */
+int MXLoadLib(const char *path); /* extension .so via mx.library */
+int MXSetProfilerState(int state); /* 1 run, 0 stop */
+int MXDumpProfile(int finished);
+int MXLibInfoFeatures(ListHandle *out); /* "NAME=0|1" strings */
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, ListHandle *out);
+int MXEngineSetBulkSize(int size, int *prev);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

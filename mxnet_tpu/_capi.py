@@ -309,3 +309,204 @@ def pred_output_shape(pred, index: int) -> tuple:
 
 def pred_get_output(pred, index: int) -> bytes:
     return pred.get_output(index)
+
+
+# --------------------------------------------------------------------------
+# Round-3 widening #2: KVStore, Executor, NDArray manipulation, autograd
+# breadth, runtime control (reference c_api.h MXKVStore*/MXExecutor*/
+# MXNDArraySlice/At/Reshape, MXAutogradMarkVariables, MXSetProfilerState,
+# MXLoadLib, MXLibInfoFeatures).
+# --------------------------------------------------------------------------
+
+def kv_create(type_str: str):
+    from . import kvstore
+
+    return kvstore.create(type_str or "local")
+
+
+def _kv_keys(keys: tuple):
+    return [int(k) for k in keys]
+
+
+def kv_init(store, keys: tuple, vals: tuple) -> None:
+    store.init(_kv_keys(keys), list(vals))
+
+
+def kv_push(store, keys: tuple, vals: tuple, priority: int) -> None:
+    store.push(_kv_keys(keys), list(vals), priority=priority)
+
+
+def kv_pull(store, keys: tuple, priority: int) -> tuple:
+    from . import numpy as mxnp
+
+    keys = _kv_keys(keys)
+    # placeholders must mirror the stored dtype: pull casts into the
+    # out array's dtype, so a fixed-float32 placeholder would silently
+    # downcast int64/float64 values on the way to the C caller
+    outs = []
+    for k in keys:
+        stored = store._store.get(k)
+        if stored is None:
+            raise KeyError(f"kv_pull: key {k} was never init'ed")
+        outs.append(mxnp.zeros(stored.shape, dtype=stored.dtype))
+    store.pull(keys, out=outs, priority=priority)
+    return tuple(outs)
+
+
+def kv_pushpull(store, keys: tuple, vals: tuple, priority: int) -> tuple:
+    keys = _kv_keys(keys)
+    vals = list(vals)
+    outs = [v.copy() for v in vals]
+    store.pushpull(keys, vals, out=outs, priority=priority)
+    return tuple(outs)
+
+
+def kv_broadcast(store, keys: tuple, vals: tuple, priority: int) -> tuple:
+    keys = _kv_keys(keys)
+    vals = list(vals)
+    outs = [v.copy() for v in vals]
+    store.broadcast(keys, vals, outs, priority=priority)
+    return tuple(outs)
+
+
+def kv_type(store) -> str:
+    return store.type
+
+
+def kv_rank(store) -> int:
+    return int(store.rank)
+
+
+def kv_num_workers(store) -> int:
+    return int(store.num_workers)
+
+
+def kv_set_updater(store, trampoline) -> None:
+    """``trampoline(key:int, recv, local)`` is the C-side callback
+    (a PyCFunction wrapping the caller's function pointer); the store's
+    updater contract is updater(key, recv, local) mutating local."""
+    store.set_updater(lambda key, recv, local: trampoline(int(key), recv,
+                                                          local))
+
+
+# ---- Executor (MXExecutorSimpleBind / Forward / Backward / Outputs) ----
+
+def executor_simple_bind(sym, shapes_json: str, grad_req: str):
+    import json as _json
+
+    shapes = {k: tuple(v) for k, v in _json.loads(shapes_json).items()}
+    return sym.simple_bind(grad_req=grad_req, **shapes)
+
+
+def executor_forward(ex, is_train: int, names: tuple, arrays: tuple) -> int:
+    kwargs = dict(zip(names, arrays))
+    outs = ex.forward(is_train=bool(is_train), **kwargs)
+    return len(outs)
+
+
+def executor_outputs(ex) -> tuple:
+    return tuple(ex.outputs)
+
+
+def executor_backward(ex, out_grads: tuple) -> None:
+    ex.backward(list(out_grads) if out_grads else None)
+
+
+def executor_arg_grad(ex, name: str):
+    g = ex.grad_dict.get(name)
+    if g is None:
+        raise KeyError(f"no gradient for argument {name!r} "
+                       f"(grad_req null or unknown name)")
+    return g
+
+
+# ---- NDArray manipulation (MXNDArrayReshape / Slice / At / CopyFrom) ----
+
+def nd_reshape(arr, shape: tuple):
+    return arr.reshape(tuple(int(s) for s in shape))
+
+
+def nd_slice(arr, begin: int, end: int):
+    return arr[int(begin):int(end)]
+
+
+def nd_at(arr, idx: int):
+    return arr[int(idx)]
+
+
+def nd_copy_from_bytes(arr, raw: bytes) -> None:
+    """In-place overwrite from host memory (MXNDArraySyncCopyFromCPU):
+    the handle keeps identity, so views/graph references see new data."""
+    src = onp.frombuffer(raw, dtype=str(onp.dtype(arr.dtype)))
+    arr[...] = src.reshape(arr.shape)
+
+
+def nd_astype(arr, dtype_code: int):
+    return arr.astype(_CODE_TO_DTYPE[dtype_code])
+
+
+# ---- autograd breadth ----
+
+def autograd_set_training(on: int) -> int:
+    from . import autograd
+
+    return int(autograd.set_training(bool(on)))
+
+
+def autograd_is_training() -> int:
+    from . import autograd
+
+    return int(autograd.is_training())
+
+
+def autograd_mark_variables(arrays: tuple, grad_reqs: tuple) -> None:
+    for arr, req in zip(arrays, grad_reqs):
+        arr.attach_grad(grad_req=req)
+
+
+def autograd_backward_ex(heads: tuple, head_grads, retain_graph: int,
+                         train_mode: int) -> None:
+    from . import autograd
+
+    autograd.backward(list(heads),
+                      head_grads=list(head_grads) if head_grads else None,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+# ---- runtime control ----
+
+def load_lib(path: str) -> None:
+    from . import library
+
+    library.load(path)
+
+
+def profiler_set_state(state: int) -> None:
+    from . import profiler
+
+    profiler.set_state("run" if state else "stop")
+
+
+def profiler_dump(finished: int) -> None:
+    from . import profiler
+
+    profiler.dump(bool(finished))
+
+
+def libinfo_features() -> tuple:
+    from .runtime import Features
+
+    return tuple(f"{name}={int(feat.enabled)}"
+                 for name, feat in Features().items())
+
+
+def symbol_aux_states(sym) -> tuple:
+    return tuple(sym.list_auxiliary_states())
+
+
+def engine_set_bulk_size(size: int) -> int:
+    from . import engine
+
+    prev = engine.set_bulk_size(int(size))
+    return int(prev)

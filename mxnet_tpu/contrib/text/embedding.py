@@ -95,6 +95,10 @@ class _TokenEmbedding(_vocab.Vocabulary):
         # need a matrix row so row i always belongs to idx_to_token[i]
         n_preindexed = len(self._idx_to_token)
         seen = set()
+        # a file row for an already-indexed token (a reserved token, or a
+        # counter key when a Vocabulary seeded the index) must fill that
+        # token's existing row, not append a duplicate entry
+        pre_updates = {}
         loaded_unknown_vec = None
         with io.open(pretrained_file_path, "r", encoding=encoding) as f:
             for line_num, line in enumerate(f, 1):
@@ -110,6 +114,17 @@ class _TokenEmbedding(_vocab.Vocabulary):
                     warnings.warn(
                         f"line {line_num}: duplicate embedding for token "
                         f"{token} skipped.")
+                elif token in self._token_to_idx:
+                    if len(vec) > 1:
+                        if vec_len is None:
+                            vec_len = len(vec)
+                        else:
+                            assert len(vec) == vec_len, (
+                                f"line {line_num}: dimension of token "
+                                f"{token} is {len(vec)} but previous tokens "
+                                f"have {vec_len}.")
+                        pre_updates[self._token_to_idx[token]] = vec
+                        seen.add(token)
                 elif len(vec) == 1:
                     warnings.warn(
                         f"line {line_num}: token {token} with 1-dimensional "
@@ -129,9 +144,11 @@ class _TokenEmbedding(_vocab.Vocabulary):
         self._vec_len = vec_len
         unk = (loaded_unknown_vec if loaded_unknown_vec is not None
                else init_unknown_vec(shape=self._vec_len).tolist())
-        reserved_rows = [init_unknown_vec(shape=self._vec_len).tolist()
-                         for _ in range(n_preindexed - 1)]
-        self._idx_to_vec = _np.array([unk] + reserved_rows + rows,
+        pre_rows = [pre_updates.get(i,
+                                    init_unknown_vec(
+                                        shape=self._vec_len).tolist())
+                    for i in range(1, n_preindexed)]
+        self._idx_to_vec = _np.array([unk] + pre_rows + rows,
                                      dtype="float32")
 
     def _index_tokens_from_vocabulary(self, vocabulary):

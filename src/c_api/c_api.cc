@@ -689,3 +689,466 @@ MXTPU_DLL int MXPredGetOutput(PredictorHandle pred, int index, float *data,
 }
 
 MXTPU_DLL int MXPredFree(PredictorHandle pred) { return MXListFree(pred); }
+
+/* ===================================================================== *
+ *  Round-3 widening #2: NDArray manipulation, autograd breadth,
+ *  Executor, KVStore (with C updater callback), runtime control.
+ *  Reference menu: include/mxnet/c_api.h MXNDArrayReshape/Slice/At,
+ *  MXAutogradMarkVariables/BackwardEx, MXExecutor*, MXKVStore*,
+ *  MXLoadLib, MXSetProfilerState, MXLibInfoFeatures.
+ * ===================================================================== */
+
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *user);
+
+namespace {
+
+/* helper: wrap an existing handle array into a new python tuple (incref) */
+PyObject *handles_tuple(int num, NDArrayHandle *handles) {
+  PyObject *t = PyTuple_New(num);
+  for (int i = 0; i < num; ++i) {
+    PyObject *o = static_cast<PyObject *>(handles[i]);
+    Py_INCREF(o);
+    PyTuple_SetItem(t, i, o);
+  }
+  return t;
+}
+
+PyObject *int_tuple(int num, const int *vals) {
+  PyObject *t = PyTuple_New(num);
+  for (int i = 0; i < num; ++i)
+    PyTuple_SetItem(t, i, PyLong_FromLong(vals[i]));
+  return t;
+}
+
+/* copy a python tuple of arrays out through a handle buffer */
+int tuple_to_handles(PyObject *r, int max_out, NDArrayHandle *outputs,
+                     int *n_out) {
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > max_out) {
+    set_error("output buffer too small");
+    return -1;
+  }
+  if (n_out != nullptr) *n_out = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyTuple_GetItem(r, i);
+    Py_INCREF(o);
+    outputs[i] = static_cast<NDArrayHandle>(o);
+  }
+  return 0;
+}
+
+}  // namespace
+
+/* ---- NDArray manipulation ---- */
+
+MXTPU_DLL int MXNDArrayReshape(NDArrayHandle h, int ndim,
+                               const int64_t *shape, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject *r = capi_call_checked(
+      "nd_reshape",
+      Py_BuildValue("(ON)", static_cast<PyObject *>(h), shp));
+  if (r == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArraySlice(NDArrayHandle h, int64_t begin, int64_t end,
+                             NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "nd_slice", Py_BuildValue("(OLL)", static_cast<PyObject *>(h),
+                                static_cast<long long>(begin),
+                                static_cast<long long>(end)));
+  if (r == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayAt(NDArrayHandle h, int64_t idx, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "nd_at", Py_BuildValue("(OL)", static_cast<PyObject *>(h),
+                             static_cast<long long>(idx)));
+  if (r == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayAsType(NDArrayHandle h, int dtype_code,
+                              NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "nd_astype",
+      Py_BuildValue("(Oi)", static_cast<PyObject *>(h), dtype_code));
+  if (r == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void *data,
+                                       size_t nbytes) {
+  Gil gil;
+  PyObject *raw = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data), static_cast<Py_ssize_t>(nbytes));
+  PyObject *r = capi_call_checked(
+      "nd_copy_from_bytes",
+      Py_BuildValue("(ON)", static_cast<PyObject *>(h), raw));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- autograd breadth ---- */
+
+MXTPU_DLL int MXAutogradSetIsTraining(int on, int *prev) {
+  Gil gil;
+  PyObject *r = capi_call_checked("autograd_set_training",
+                                  Py_BuildValue("(i)", on));
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXAutogradIsTraining(int *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked("autograd_is_training", nullptr);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXAutogradMarkVariables(int num, NDArrayHandle *handles,
+                                      const char **grad_reqs) {
+  Gil gil;
+  PyObject *arrs = handles_tuple(num, handles);
+  PyObject *reqs = PyTuple_New(num);
+  for (int i = 0; i < num; ++i)
+    PyTuple_SetItem(reqs, i, PyUnicode_FromString(
+        grad_reqs != nullptr ? grad_reqs[i] : "write"));
+  PyObject *r = capi_call_checked("autograd_mark_variables",
+                                  Py_BuildValue("(NN)", arrs, reqs));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXAutogradBackwardEx(int n_heads, NDArrayHandle *heads,
+                                   NDArrayHandle *head_grads,
+                                   int retain_graph, int train_mode) {
+  Gil gil;
+  PyObject *hs = handles_tuple(n_heads, heads);
+  PyObject *gs;
+  if (head_grads != nullptr) {
+    gs = handles_tuple(n_heads, head_grads);
+  } else {
+    gs = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *r = capi_call_checked(
+      "autograd_backward_ex",
+      Py_BuildValue("(NNii)", hs, gs, retain_graph, train_mode));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Executor ---- */
+
+MXTPU_DLL int MXExecutorSimpleBind(SymbolHandle sym, const char *shapes_json,
+                                   const char *grad_req,
+                                   ExecutorHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "executor_simple_bind",
+      Py_BuildValue("(Oss)", static_cast<PyObject *>(sym), shapes_json,
+                    grad_req ? grad_req : "write"));
+  if (r == nullptr) return -1;
+  *out = static_cast<ExecutorHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXExecutorForward(ExecutorHandle ex, int is_train, int n_args,
+                                const char **arg_names, NDArrayHandle *args,
+                                int *n_outputs) {
+  Gil gil;
+  PyObject *names = PyTuple_New(n_args);
+  for (int i = 0; i < n_args; ++i)
+    PyTuple_SetItem(names, i, PyUnicode_FromString(arg_names[i]));
+  PyObject *arrs = handles_tuple(n_args, args);
+  PyObject *r = capi_call_checked(
+      "executor_forward",
+      Py_BuildValue("(OiNN)", static_cast<PyObject *>(ex), is_train, names,
+                    arrs));
+  if (r == nullptr) return -1;
+  if (n_outputs != nullptr)
+    *n_outputs = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXExecutorOutputs(ExecutorHandle ex, int max_out,
+                                NDArrayHandle *outputs, int *n_out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "executor_outputs",
+      Py_BuildValue("(O)", static_cast<PyObject *>(ex)));
+  if (r == nullptr) return -1;
+  int rc = tuple_to_handles(r, max_out, outputs, n_out);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXExecutorBackward(ExecutorHandle ex, int n_grads,
+                                 NDArrayHandle *out_grads) {
+  Gil gil;
+  PyObject *gs = handles_tuple(n_grads, out_grads);
+  PyObject *r = capi_call_checked(
+      "executor_backward",
+      Py_BuildValue("(ON)", static_cast<PyObject *>(ex), gs));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXExecutorArgGrad(ExecutorHandle ex, const char *arg_name,
+                                NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "executor_arg_grad",
+      Py_BuildValue("(Os)", static_cast<PyObject *>(ex), arg_name));
+  if (r == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXExecutorFree(ExecutorHandle ex) { return MXListFree(ex); }
+
+/* ---- KVStore ---- */
+
+MXTPU_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked("kv_create",
+                                  Py_BuildValue("(s)", type ? type : "local"));
+  if (r == nullptr) return -1;
+  *out = static_cast<KVStoreHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXKVStoreFree(KVStoreHandle h) { return MXListFree(h); }
+
+MXTPU_DLL int MXKVStoreInit(KVStoreHandle h, int num, const int *keys,
+                            NDArrayHandle *vals) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "kv_init", Py_BuildValue("(ONN)", static_cast<PyObject *>(h),
+                               int_tuple(num, keys),
+                               handles_tuple(num, vals)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXKVStorePush(KVStoreHandle h, int num, const int *keys,
+                            NDArrayHandle *vals, int priority) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "kv_push", Py_BuildValue("(ONNi)", static_cast<PyObject *>(h),
+                               int_tuple(num, keys),
+                               handles_tuple(num, vals), priority));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXKVStorePull(KVStoreHandle h, int num, const int *keys,
+                            NDArrayHandle *outs, int priority) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "kv_pull", Py_BuildValue("(ONi)", static_cast<PyObject *>(h),
+                               int_tuple(num, keys), priority));
+  if (r == nullptr) return -1;
+  int rc = tuple_to_handles(r, num, outs, nullptr);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXKVStorePushPull(KVStoreHandle h, int num, const int *keys,
+                                NDArrayHandle *vals, NDArrayHandle *outs,
+                                int priority) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "kv_pushpull", Py_BuildValue("(ONNi)", static_cast<PyObject *>(h),
+                                   int_tuple(num, keys),
+                                   handles_tuple(num, vals), priority));
+  if (r == nullptr) return -1;
+  int rc = tuple_to_handles(r, num, outs, nullptr);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXKVStoreBroadcast(KVStoreHandle h, int num, const int *keys,
+                                 NDArrayHandle *vals, NDArrayHandle *outs,
+                                 int priority) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "kv_broadcast", Py_BuildValue("(ONNi)", static_cast<PyObject *>(h),
+                                    int_tuple(num, keys),
+                                    handles_tuple(num, vals), priority));
+  if (r == nullptr) return -1;
+  int rc = tuple_to_handles(r, num, outs, nullptr);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXKVStoreGetType(KVStoreHandle h, char *buf, int buf_len) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "kv_type", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (r == nullptr) return -1;
+  int rc = copy_str(r, buf, buf_len, nullptr);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXKVStoreGetRank(KVStoreHandle h, int *rank) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "kv_rank", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (r == nullptr) return -1;
+  *rank = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXKVStoreGetGroupSize(KVStoreHandle h, int *size) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "kv_num_workers", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (r == nullptr) return -1;
+  *size = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+
+/* C updater trampoline: the store's python-side updater calls this
+   PyCFunction, which unpacks the capsule and invokes the caller's C
+   function pointer. The recv/local borrows live only for the call. */
+struct UpdaterClosure {
+  MXKVStoreUpdater fn;
+  void *user;
+};
+
+PyObject *updater_trampoline(PyObject *self, PyObject *args) {
+  UpdaterClosure *c = static_cast<UpdaterClosure *>(
+      PyCapsule_GetPointer(self, "mxtpu.updater"));
+  int key = 0;
+  PyObject *recv = nullptr, *local = nullptr;
+  if (c == nullptr ||
+      !PyArg_ParseTuple(args, "iOO", &key, &recv, &local)) {
+    return nullptr;
+  }
+  c->fn(key, static_cast<NDArrayHandle>(recv),
+        static_cast<NDArrayHandle>(local), c->user);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef updater_def = {
+    "_mxtpu_updater_trampoline", updater_trampoline, METH_VARARGS,
+    "bridges KVStore updates to a C function pointer"};
+
+void updater_capsule_free(PyObject *cap) {
+  delete static_cast<UpdaterClosure *>(
+      PyCapsule_GetPointer(cap, "mxtpu.updater"));
+}
+
+}  // namespace
+
+MXTPU_DLL int MXKVStoreSetUpdater(KVStoreHandle h, MXKVStoreUpdater updater,
+                                  void *user) {
+  Gil gil;
+  UpdaterClosure *c = new UpdaterClosure{updater, user};
+  PyObject *cap = PyCapsule_New(c, "mxtpu.updater", updater_capsule_free);
+  if (cap == nullptr) {
+    delete c;
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *fn = PyCFunction_New(&updater_def, cap);
+  Py_DECREF(cap); /* fn holds the reference now */
+  if (fn == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = capi_call_checked(
+      "kv_set_updater",
+      Py_BuildValue("(ON)", static_cast<PyObject *>(h), fn));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- runtime control ---- */
+
+MXTPU_DLL int MXLoadLib(const char *path) {
+  Gil gil;
+  PyObject *r = capi_call_checked("load_lib", Py_BuildValue("(s)", path));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSetProfilerState(int state) {
+  Gil gil;
+  PyObject *r = capi_call_checked("profiler_set_state",
+                                  Py_BuildValue("(i)", state));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXDumpProfile(int finished) {
+  Gil gil;
+  PyObject *r = capi_call_checked("profiler_dump",
+                                  Py_BuildValue("(i)", finished));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXLibInfoFeatures(ListHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked("libinfo_features", nullptr);
+  if (r == nullptr) return -1;
+  *out = static_cast<ListHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolListAuxiliaryStates(SymbolHandle sym, ListHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_aux_states",
+      Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  *out = static_cast<ListHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXEngineSetBulkSize(int size, int *prev) {
+  Gil gil;
+  PyObject *r = capi_call_checked("engine_set_bulk_size",
+                                  Py_BuildValue("(i)", size));
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}

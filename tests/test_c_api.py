@@ -80,6 +80,44 @@ def capi():
                                     ctypes.POINTER(ctypes.c_float),
                                     ctypes.c_size_t]
     lib.MXPredFree.argtypes = [p]
+    # round-3 widening #2: manipulation/executor/kvstore/runtime
+    lib.MXNDArrayReshape.argtypes = [p, ctypes.c_int, i64p, pp]
+    lib.MXNDArraySlice.argtypes = [p, ctypes.c_int64, ctypes.c_int64, pp]
+    lib.MXNDArrayAt.argtypes = [p, ctypes.c_int64, pp]
+    lib.MXNDArrayAsType.argtypes = [p, ctypes.c_int, pp]
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [p, p, ctypes.c_size_t]
+    lib.MXAutogradSetIsTraining.argtypes = [ctypes.c_int, ip]
+    lib.MXAutogradIsTraining.argtypes = [ip]
+    lib.MXAutogradMarkVariables.argtypes = [ctypes.c_int, pp,
+                                            ctypes.POINTER(cp)]
+    lib.MXAutogradBackwardEx.argtypes = [ctypes.c_int, pp, pp,
+                                         ctypes.c_int, ctypes.c_int]
+    lib.MXExecutorSimpleBind.argtypes = [p, cp, cp, pp]
+    lib.MXExecutorForward.argtypes = [p, ctypes.c_int, ctypes.c_int,
+                                      ctypes.POINTER(cp), pp, ip]
+    lib.MXExecutorOutputs.argtypes = [p, ctypes.c_int, pp, ip]
+    lib.MXExecutorBackward.argtypes = [p, ctypes.c_int, pp]
+    lib.MXExecutorArgGrad.argtypes = [p, cp, pp]
+    lib.MXExecutorFree.argtypes = [p]
+    lib.MXKVStoreCreate.argtypes = [cp, pp]
+    lib.MXKVStoreFree.argtypes = [p]
+    lib.MXKVStoreInit.argtypes = [p, ctypes.c_int, ip, pp]
+    lib.MXKVStorePush.argtypes = [p, ctypes.c_int, ip, pp, ctypes.c_int]
+    lib.MXKVStorePull.argtypes = [p, ctypes.c_int, ip, pp, ctypes.c_int]
+    lib.MXKVStorePushPull.argtypes = [p, ctypes.c_int, ip, pp, pp,
+                                      ctypes.c_int]
+    lib.MXKVStoreBroadcast.argtypes = [p, ctypes.c_int, ip, pp, pp,
+                                       ctypes.c_int]
+    lib.MXKVStoreGetType.argtypes = [p, cp, ctypes.c_int]
+    lib.MXKVStoreGetRank.argtypes = [p, ip]
+    lib.MXKVStoreGetGroupSize.argtypes = [p, ip]
+    lib.MXKVStoreSetUpdater.argtypes = [p, p, p]
+    lib.MXLoadLib.argtypes = [cp]
+    lib.MXSetProfilerState.argtypes = [ctypes.c_int]
+    lib.MXDumpProfile.argtypes = [ctypes.c_int]
+    lib.MXLibInfoFeatures.argtypes = [pp]
+    lib.MXSymbolListAuxiliaryStates.argtypes = [p, pp]
+    lib.MXEngineSetBulkSize.argtypes = [ctypes.c_int, ip]
     return lib
 
 
@@ -419,3 +457,221 @@ def test_c_demo_program(capi, tmp_path):
     assert out.returncode == 0, out.stderr
     assert "np.add -> [11 22 33 44 55 66]" in out.stdout
     assert "OK" in out.stdout
+
+
+# ---- round-3 widening #2: manipulation / executor / kvstore / runtime ----
+
+def test_ndarray_manipulation(capi):
+    x = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    h = _make(capi, x)
+    out = ctypes.c_void_p()
+    shp = (ctypes.c_int64 * 2)(4, 3)
+    assert capi.MXNDArrayReshape(h, 2, shp, ctypes.byref(out)) == 0
+    onp.testing.assert_allclose(_fetch(capi, out, (4, 3)), x.reshape(4, 3))
+    capi.MXNDArrayFree(out)
+    assert capi.MXNDArraySlice(h, 1, 3, ctypes.byref(out)) == 0
+    onp.testing.assert_allclose(_fetch(capi, out, (2, 4)), x[1:3])
+    capi.MXNDArrayFree(out)
+    assert capi.MXNDArrayAt(h, 2, ctypes.byref(out)) == 0
+    onp.testing.assert_allclose(_fetch(capi, out, (4,)), x[2])
+    capi.MXNDArrayFree(out)
+    assert capi.MXNDArrayAsType(h, 5, ctypes.byref(out)) == 0  # int64
+    code = ctypes.c_int()
+    assert capi.MXNDArrayGetDType(out, ctypes.byref(code)) == 0
+    assert code.value == 5
+    capi.MXNDArrayFree(out)
+    # in-place host overwrite keeps handle identity
+    new = onp.full((3, 4), 9.0, onp.float32)
+    assert capi.MXNDArraySyncCopyFromCPU(
+        h, new.ctypes.data_as(ctypes.c_void_p), new.nbytes) == 0
+    onp.testing.assert_allclose(_fetch(capi, h, (3, 4)), new)
+    # wrong size fails with error message
+    assert capi.MXNDArraySyncCopyFromCPU(
+        h, new.ctypes.data_as(ctypes.c_void_p), 4) == -1
+    assert b"reshape" in capi.MXGetLastError() or capi.MXGetLastError()
+    capi.MXNDArrayFree(h)
+
+
+def test_autograd_breadth(capi):
+    prev = ctypes.c_int()
+    assert capi.MXAutogradSetIsTraining(1, ctypes.byref(prev)) == 0
+    cur = ctypes.c_int()
+    assert capi.MXAutogradIsTraining(ctypes.byref(cur)) == 0
+    assert cur.value == 1
+    capi.MXAutogradSetIsTraining(prev.value, ctypes.byref(cur))
+
+    a = _make(capi, onp.array([2.0, 3.0], onp.float32))
+    b = _make(capi, onp.array([4.0, 5.0], onp.float32))
+    handles = (ctypes.c_void_p * 2)(a, b)
+    reqs = (ctypes.c_char_p * 2)(b"write", b"null")
+    assert capi.MXAutogradMarkVariables(2, handles, reqs) == 0
+
+    capi.MXAutogradSetIsRecording(1)
+    ins = (ctypes.c_void_p * 2)(a, b)
+    outs = (ctypes.c_void_p * 1)()
+    n = ctypes.c_int()
+    assert capi.MXImperativeInvoke(b"np.multiply", 2, ins, b"", 1, outs,
+                                   ctypes.byref(n)) == 0
+    capi.MXAutogradSetIsRecording(0)
+    heads = (ctypes.c_void_p * 1)(outs[0])
+    hg = _make(capi, onp.ones(2, onp.float32))
+    hgs = (ctypes.c_void_p * 1)(hg)
+    assert capi.MXAutogradBackwardEx(1, heads, hgs, 0, 1) == 0
+    g = ctypes.c_void_p()
+    assert capi.MXNDArrayGetGrad(a, ctypes.byref(g)) == 0
+    onp.testing.assert_allclose(_fetch(capi, g, (2,)), [4.0, 5.0])
+    for h in (a, b, outs[0], hg, g):
+        capi.MXNDArrayFree(h)
+
+
+def test_executor_from_c(capi):
+    import json
+
+    import mxnet_tpu as mx
+    s = mx.sym.var("x") * mx.sym.var("w")
+    sym = ctypes.c_void_p()
+    assert capi.MXSymbolCreateFromJSON(
+        s.tojson().encode(), ctypes.byref(sym)) == 0
+    ex = ctypes.c_void_p()
+    shapes = json.dumps({"x": [3], "w": [3]}).encode()
+    assert capi.MXExecutorSimpleBind(sym, shapes, b"write",
+                                     ctypes.byref(ex)) == 0, \
+        capi.MXGetLastError()
+    x = _make(capi, onp.array([1.0, 2.0, 3.0], onp.float32))
+    w = _make(capi, onp.array([4.0, 5.0, 6.0], onp.float32))
+    names = (ctypes.c_char_p * 2)(b"x", b"w")
+    args = (ctypes.c_void_p * 2)(x, w)
+    n_out = ctypes.c_int()
+    assert capi.MXExecutorForward(ex, 0, 2, names, args,
+                                  ctypes.byref(n_out)) == 0, \
+        capi.MXGetLastError()
+    assert n_out.value == 1
+    outs = (ctypes.c_void_p * 1)()
+    assert capi.MXExecutorOutputs(ex, 1, outs, ctypes.byref(n_out)) == 0
+    onp.testing.assert_allclose(_fetch(capi, outs[0], (3,)), [4, 10, 18])
+    assert capi.MXExecutorBackward(ex, 0, None) == 0, capi.MXGetLastError()
+    g = ctypes.c_void_p()
+    assert capi.MXExecutorArgGrad(ex, b"x", ctypes.byref(g)) == 0
+    onp.testing.assert_allclose(_fetch(capi, g, (3,)), [4.0, 5.0, 6.0])
+    # unknown arg errors cleanly
+    assert capi.MXExecutorArgGrad(ex, b"nope", ctypes.byref(g)) == -1
+    for h in (x, w, outs[0], g):
+        capi.MXNDArrayFree(h)
+    capi.MXExecutorFree(ex)
+    capi.MXSymbolFree(sym)
+
+
+def test_kvstore_from_c(capi):
+    kv = ctypes.c_void_p()
+    assert capi.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    buf = ctypes.create_string_buffer(32)
+    assert capi.MXKVStoreGetType(kv, buf, 32) == 0
+    assert buf.value == b"local"
+    rank = ctypes.c_int()
+    size = ctypes.c_int()
+    assert capi.MXKVStoreGetRank(kv, ctypes.byref(rank)) == 0
+    assert capi.MXKVStoreGetGroupSize(kv, ctypes.byref(size)) == 0
+    assert rank.value == 0 and size.value >= 1
+
+    keys = (ctypes.c_int * 1)(3)
+    v0 = _make(capi, onp.array([1.0, 1.0], onp.float32))
+    vals = (ctypes.c_void_p * 1)(v0)
+    assert capi.MXKVStoreInit(kv, 1, keys, vals) == 0
+    # pushpull: out = merged value
+    v1 = _make(capi, onp.array([2.0, 4.0], onp.float32))
+    vals = (ctypes.c_void_p * 1)(v1)
+    outs = (ctypes.c_void_p * 1)()
+    assert capi.MXKVStorePushPull(kv, 1, keys, vals, outs, 0) == 0
+    onp.testing.assert_allclose(_fetch(capi, outs[0], (2,)), [2.0, 4.0])
+    capi.MXNDArrayFree(outs[0])
+    # plain push then pull
+    v2 = _make(capi, onp.array([10.0, 20.0], onp.float32))
+    vals = (ctypes.c_void_p * 1)(v2)
+    assert capi.MXKVStorePush(kv, 1, keys, vals, 0) == 0
+    assert capi.MXKVStorePull(kv, 1, keys, outs, 0) == 0
+    onp.testing.assert_allclose(_fetch(capi, outs[0], (2,)), [10.0, 20.0])
+    for h in (v0, v1, v2, outs[0]):
+        capi.MXNDArrayFree(h)
+    # pull preserves the stored dtype (int64 survives, no float32 cast)
+    ikeys = (ctypes.c_int * 1)(11)
+    big = onp.array([2 ** 40, 7], onp.int64)
+    iv = _make(capi, big)
+    ivals = (ctypes.c_void_p * 1)(iv)
+    assert capi.MXKVStoreInit(kv, 1, ikeys, ivals) == 0
+    iouts = (ctypes.c_void_p * 1)()
+    assert capi.MXKVStorePull(kv, 1, ikeys, iouts, 0) == 0
+    code = ctypes.c_int()
+    assert capi.MXNDArrayGetDType(iouts[0], ctypes.byref(code)) == 0
+    assert code.value == 5  # int64
+    onp.testing.assert_array_equal(
+        _fetch(capi, iouts[0], (2,), onp.int64), big)
+    # pulling a never-init'ed key errors cleanly
+    bad = (ctypes.c_int * 1)(99)
+    assert capi.MXKVStorePull(kv, 1, bad, iouts, 0) == -1
+    for h in (iv, iouts[0]):
+        capi.MXNDArrayFree(h)
+    capi.MXKVStoreFree(kv)
+
+
+def test_kvstore_c_updater(capi):
+    """The reference's MXKVStoreSetUpdater contract: a C callback merges
+    pushed values into the stored one (kvstore.h set_updater)."""
+    UPDATER = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_void_p)
+    seen = []
+
+    @UPDATER
+    def updater(key, recv, local, user):
+        # local += 2 * recv, written back through the C ABI itself
+        r = _fetch(capi, recv, (2,))
+        cur = _fetch(capi, local, (2,))
+        new = (cur + 2.0 * r).astype(onp.float32)
+        rc = capi.MXNDArraySyncCopyFromCPU(
+            local, new.ctypes.data_as(ctypes.c_void_p), new.nbytes)
+        assert rc == 0
+        seen.append(int(key))
+
+    kv = ctypes.c_void_p()
+    assert capi.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    assert capi.MXKVStoreSetUpdater(
+        kv, ctypes.cast(updater, ctypes.c_void_p), None) == 0
+    keys = (ctypes.c_int * 1)(7)
+    v0 = _make(capi, onp.array([1.0, 1.0], onp.float32))
+    vals = (ctypes.c_void_p * 1)(v0)
+    assert capi.MXKVStoreInit(kv, 1, keys, vals) == 0
+    v1 = _make(capi, onp.array([3.0, 5.0], onp.float32))
+    vals = (ctypes.c_void_p * 1)(v1)
+    assert capi.MXKVStorePush(kv, 1, keys, vals, 0) == 0
+    outs = (ctypes.c_void_p * 1)()
+    assert capi.MXKVStorePull(kv, 1, keys, outs, 0) == 0
+    # init 1 + 2*push 3,5 = 7,11
+    onp.testing.assert_allclose(_fetch(capi, outs[0], (2,)), [7.0, 11.0])
+    assert seen == [7]
+    for h in (v0, v1, outs[0]):
+        capi.MXNDArrayFree(h)
+    capi.MXKVStoreFree(kv)
+
+
+def test_runtime_control_from_c(capi, tmp_path):
+    lst = ctypes.c_void_p()
+    assert capi.MXLibInfoFeatures(ctypes.byref(lst)) == 0
+    n = ctypes.c_int()
+    assert capi.MXListSize(lst, ctypes.byref(n)) == 0 and n.value > 5
+    buf = ctypes.create_string_buffer(64)
+    found = set()
+    for i in range(n.value):
+        assert capi.MXListGetString(lst, i, buf, 64, None) == 0
+        found.add(buf.value.decode().split("=")[0])
+    assert {"TPU", "XLA", "CPU"} <= found
+    capi.MXListFree(lst)
+
+    prev = ctypes.c_int()
+    assert capi.MXEngineSetBulkSize(0, ctypes.byref(prev)) == 0
+    restore = ctypes.c_int()
+    assert capi.MXEngineSetBulkSize(prev.value, ctypes.byref(restore)) == 0
+    assert restore.value == 0
+
+    assert capi.MXSetProfilerState(1) == 0
+    assert capi.MXSetProfilerState(0) == 0
+    assert capi.MXLoadLib(b"/nonexistent/lib.so") == -1  # clean error
+    assert capi.MXGetLastError() != b""

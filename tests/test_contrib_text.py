@@ -242,6 +242,18 @@ def test_custom_embedding_with_reserved_tokens(embed_file):
     assert onp.allclose(e.get_vecs_by_tokens("<pad>").asnumpy(), [0.0, 0.0])
 
 
+def test_reserved_token_vector_in_file(tmp_path):
+    # a file row for a pre-indexed (reserved) token fills its existing
+    # row instead of appending a duplicate vocabulary entry
+    p = tmp_path / "e.txt"
+    p.write_text("<pad> 5.0 6.0\ntok1 1.0 2.0\n")
+    e = text.embedding.CustomEmbedding(str(p), reserved_tokens=["<pad>"])
+    assert len(e) == 3
+    assert len(e.idx_to_token) == len(set(e.idx_to_token))
+    assert onp.allclose(e.get_vecs_by_tokens("<pad>").asnumpy(), [5.0, 6.0])
+    assert onp.allclose(e.get_vecs_by_tokens("tok1").asnumpy(), [1.0, 2.0])
+
+
 def test_vocab_to_tokens_negative_raises():
     v = text.vocab.Vocabulary(collections.Counter(["a"]))
     with pytest.raises(ValueError):
