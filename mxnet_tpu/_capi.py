@@ -342,10 +342,21 @@ def kv_pull(store, keys: tuple, priority: int) -> tuple:
     keys = _kv_keys(keys)
     # placeholders must mirror the stored dtype: pull casts into the
     # out array's dtype, so a fixed-float32 placeholder would silently
-    # downcast int64/float64 values on the way to the C caller
+    # downcast int64/float64 values on the way to the C caller. Sizing
+    # them needs the stored arrays, which only the local-family stores
+    # expose — plugin KVStoreBase backends get a clean refusal instead
+    # of an AttributeError deep inside.
+    from .base import MXNetError
+
+    backing = getattr(store, "_store", None)
+    if backing is None:
+        raise MXNetError(
+            f"MXKVStorePull: store type {type(store).__name__!r} does not "
+            "expose stored values for C-side output allocation; pull this "
+            "store from Python instead")
     outs = []
     for k in keys:
-        stored = store._store.get(k)
+        stored = backing.get(k)
         if stored is None:
             raise KeyError(f"kv_pull: key {k} was never init'ed")
         outs.append(mxnp.zeros(stored.shape, dtype=stored.dtype))
@@ -392,9 +403,7 @@ def kv_set_updater(store, trampoline) -> None:
 # ---- Executor (MXExecutorSimpleBind / Forward / Backward / Outputs) ----
 
 def executor_simple_bind(sym, shapes_json: str, grad_req: str):
-    import json as _json
-
-    shapes = {k: tuple(v) for k, v in _json.loads(shapes_json).items()}
+    shapes = {k: tuple(v) for k, v in json.loads(shapes_json).items()}
     return sym.simple_bind(grad_req=grad_req, **shapes)
 
 
@@ -468,8 +477,13 @@ def autograd_backward_ex(heads: tuple, head_grads, retain_graph: int,
                          train_mode: int) -> None:
     from . import autograd
 
-    autograd.backward(list(heads),
-                      head_grads=list(head_grads) if head_grads else None,
+    grads = None
+    if head_grads is not None:
+        # per-head None entries mean "ones" (reference per-head nullptr)
+        grads = list(head_grads)
+        if all(g is None for g in grads):
+            grads = None
+    autograd.backward(list(heads), head_grads=grads,
                       retain_graph=bool(retain_graph),
                       train_mode=bool(train_mode))
 

@@ -114,21 +114,20 @@ class _TokenEmbedding(_vocab.Vocabulary):
                     warnings.warn(
                         f"line {line_num}: duplicate embedding for token "
                         f"{token} skipped.")
-                elif token in self._token_to_idx:
-                    if len(vec) > 1:
-                        if vec_len is None:
-                            vec_len = len(vec)
-                        else:
-                            assert len(vec) == vec_len, (
-                                f"line {line_num}: dimension of token "
-                                f"{token} is {len(vec)} but previous tokens "
-                                f"have {vec_len}.")
-                        pre_updates[self._token_to_idx[token]] = vec
-                        seen.add(token)
                 elif len(vec) == 1:
                     warnings.warn(
                         f"line {line_num}: token {token} with 1-dimensional "
                         f"vector {vec} is likely a header and is skipped.")
+                elif token in self._token_to_idx:
+                    if vec_len is None:
+                        vec_len = len(vec)
+                    else:
+                        assert len(vec) == vec_len, (
+                            f"line {line_num}: dimension of token "
+                            f"{token} is {len(vec)} but previous tokens "
+                            f"have {vec_len}.")
+                    pre_updates[self._token_to_idx[token]] = vec
+                    seen.add(token)
                 else:
                     if vec_len is None:
                         vec_len = len(vec)

@@ -10,7 +10,7 @@ from ...base import MXNetError
 from ...ndarray.ndarray import ndarray
 from ... import numpy as np
 
-__all__ = ["Stack", "Pad", "Group", "default_batchify_fn"]
+__all__ = ["Stack", "Pad", "Group", "Append", "AsList", "default_batchify_fn"]
 
 
 def _as_numpy(x):
@@ -77,3 +77,32 @@ def default_batchify_fn(data: Sequence):
     if isinstance(sample, (tuple, list)):
         return tuple(default_batchify_fn([d[i] for d in data]) for i in range(len(sample)))
     return Stack()(data)
+
+
+class Append:
+    """Loosely batch samples: each sample becomes its own array (expanded
+    with a length-1 batch axis by default) so ragged shapes coexist
+    (reference batchify.py:279; use_shared_mem is a no-op here — the
+    multi-worker loader hands arrays over via pickled host buffers, not
+    the reference's shared-memory NDArray)."""
+
+    def __init__(self, expand=True, batch_axis=0, use_shared_mem=False):
+        self._expand = expand
+        self._batch_axis = batch_axis
+
+    def __call__(self, data):
+        out = []
+        for sample in data:
+            arr = np.array(_as_numpy(sample))
+            if self._expand:
+                arr = np.expand_dims(arr, axis=self._batch_axis)
+            out.append(arr)
+        return out
+
+
+class AsList:
+    """Forward samples untouched as a python list — the textual-data
+    companion to Group (reference batchify.py:391)."""
+
+    def __call__(self, data):
+        return list(data)

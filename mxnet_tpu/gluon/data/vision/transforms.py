@@ -17,6 +17,7 @@ __all__ = [
     "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomLighting",
     "RandomColorJitter", "Pad", "RandomApply", "HybridRandomApply",
     "RandomGray", "RandomHue", "Rotate", "RandomRotation", "CropResize",
+    "HybridCompose",
 ]
 
 
@@ -45,6 +46,12 @@ class Cast:
     def __call__(self, img):
         return _hwc(img).astype(self._dtype)
 
+    def _hybrid(self, x):
+        """mx.np formulation for HybridCompose tracing."""
+        if not isinstance(x, ndarray):
+            x = np.array(x)
+        return x.astype(self._dtype)
+
 
 class ToTensor:
     """HWC uint8 [0,255] -> CHW float32 [0,1] (reference ToTensor)."""
@@ -54,6 +61,16 @@ class ToTensor:
         if img.ndim == 2:
             img = img[:, :, None]
         return (img.astype(onp.float32) / 255.0).transpose(2, 0, 1)
+
+    def _hybrid(self, x):
+        """mx.np formulation for HybridCompose tracing."""
+        if not isinstance(x, ndarray):
+            x = np.array(_hwc(x))
+        if x.ndim == 2:
+            x = np.expand_dims(x, -1)
+        x = x.astype("float32") / 255.0
+        axes = (2, 0, 1) if x.ndim == 3 else (0, 3, 1, 2)
+        return np.transpose(x, axes)
 
 
 class Normalize:
@@ -66,6 +83,10 @@ class Normalize:
         if img.ndim == 3 and img.shape[0] not in (1, 3):  # HWC -> error guard
             raise MXNetError("Normalize expects CHW input (apply ToTensor first)")
         return (img - self._mean) / self._std
+
+    def _hybrid(self, x):
+        """mx.np formulation for HybridCompose tracing."""
+        return (x - np.array(self._mean)) / np.array(self._std)
 
 
 def _resize_hwc(img, size):
@@ -411,3 +432,32 @@ class CropResize:
         if self._size is not None:
             img = Resize(self._size)(img)
         return img
+
+
+from ...block import HybridBlock  # noqa: E402 — tail import keeps the
+# host-numpy transforms above free of block machinery
+
+
+class HybridCompose(HybridBlock):
+    """Sequentially compose transforms INSIDE a traceable forward
+    (reference transforms/__init__.py:80 HybridCompose(HybridSequential)).
+
+    Each transform is used via its ``_hybrid(x)`` method when it has one
+    (an mx.np/traceable formulation — ToTensor/Normalize/Cast below), and
+    called directly otherwise; hybridize()/jit therefore works exactly
+    when every stage is trace-safe, mirroring the reference's "all
+    transforms must be hybridizable" requirement."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        self._transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self._transforms:
+            fn = getattr(t, "_hybrid", None)
+            x = fn(x) if fn is not None else t(x)
+        return x
+
+    def __repr__(self):
+        inner = ", ".join(type(t).__name__ for t in self._transforms)
+        return f"HybridCompose([{inner}])"

@@ -15,6 +15,7 @@ __all__ = [
     "AvgPool1D", "AvgPool2D", "AvgPool3D",
     "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
     "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D",
+    "ReflectionPad2D",
 ]
 
 
@@ -247,3 +248,24 @@ class GlobalAvgPool2D(_Pooling):
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW"):
         super().__init__(1, 1, 0, True, "avg", layout)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection-pad an NCHW tensor on its spatial axes (reference
+    conv_layers.py:1202; torch-style symmetric-without-edge-repeat).
+    ``padding`` is the per-side size applied to both H and W."""
+
+    def __init__(self, padding=0):
+        super().__init__()
+        self._padding = int(padding)
+
+    def forward(self, x):
+        from ... import numpy as _np
+
+        p = self._padding
+        if p == 0:
+            return x
+        return _np.pad(x, ((0, 0), (0, 0), (p, p), (p, p)), mode="reflect")
+
+    def __repr__(self):
+        return f"{type(self).__name__}(padding={self._padding})"

@@ -818,3 +818,53 @@ def multibox_detection(cls_prob, loc_pred, anchor, threshold=0.01,
         _unwrap(anchor) if isinstance(anchor, ndarray) else anchor,
         threshold, clip, variances, nms_threshold, force_suppress, nms_topk)
     return _wrap(out)
+
+
+# ---- npx tail: seed alias, npx-only samplers, DLPack interop, nonzero,
+# constraint_check (reference numpy_extension/random.py + np_nonzero_op.cc
+# + np_constraint_check.cc + to/from_dlpack in c_api) ----
+from .random import seed, bernoulli, uniform_n, normal_n  # noqa: F401,E402
+
+
+def nonzero(x):
+    """Indices of nonzero elements as an (N, ndim) int64 array — the npx
+    layout, transposed vs np.nonzero's tuple (reference
+    np_nonzero_op.cc:115 _npx_nonzero). Eager-only: the output shape is
+    data-dependent, which XLA tracing cannot express (the reference
+    likewise restricts it to FComputeEx)."""
+    arr = _unwrap(x) if isinstance(x, ndarray) else jnp.asarray(x)
+    idx = onp.argwhere(onp.asarray(arr))
+    return _wrap(jnp.asarray(idx, jnp.int64))
+
+
+def constraint_check(x, msg="Constraint violated."):
+    """All-reduce a bool tensor; raise ``msg`` when any element is False
+    (reference np_constraint_check.cc:59 — the runtime guard behind the
+    distributions' parameter validation). Returns the scalar bool under
+    tracing, where a data-dependent raise cannot exist."""
+    arr = _unwrap(x) if isinstance(x, ndarray) else jnp.asarray(x)
+    ok = jnp.all(arr)
+    if not isinstance(ok, jax.core.Tracer) and not bool(ok):
+        from ..base import MXNetError
+        raise MXNetError(msg)
+    return _wrap(ok)
+
+
+def to_dlpack_for_read(data):
+    """DLPack capsule sharing the array's device buffer (reference
+    c_api.cc MXNDArrayToDLPack; jax arrays are immutable so read/write
+    variants coincide)."""
+    return _unwrap(data).__dlpack__()
+
+
+def to_dlpack_for_write(data):
+    """Alias of :func:`to_dlpack_for_read` — XLA buffers are immutable;
+    consumers mutate a copy (documented divergence from the reference's
+    in-place write contract)."""
+    return to_dlpack_for_read(data)
+
+
+def from_dlpack(dlpack):
+    """Wrap a DLPack capsule (or any object with ``__dlpack__``) as an
+    mx ndarray, zero-copy where the producer's device allows."""
+    return _wrap(jnp.asarray(jax.dlpack.from_dlpack(dlpack)))

@@ -1,3 +1,73 @@
 """``mx.npx.random`` — re-export of the np RNG (reference parity alias)."""
 from ..numpy.random import *  # noqa: F401,F403
 from ..numpy.random import seed, new_key  # noqa: F401
+
+
+# -------------------------------------------------------------------------
+# npx-only samplers (reference python/mxnet/numpy_extension/random.py):
+# bernoulli with prob XOR logit, and the *_n variants whose batch_shape is
+# PREPENDED to the broadcast shape of the distribution parameters.
+# -------------------------------------------------------------------------
+import jax as _jax
+import jax.numpy as _jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray as _ndarray, _wrap as __wrap, \
+    _unwrap as __unwrap
+from ..numpy.random import _rng as __rng
+
+from ..numpy.random import __all__ as _np_random_all
+
+__all__ = list(_np_random_all) + ["new_key", "bernoulli", "uniform_n",
+                                  "normal_n"]
+
+
+def _param(v):
+    return __unwrap(v) if isinstance(v, _ndarray) else v
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype="float32", ctx=None,
+              out=None):
+    """Bernoulli samples parameterized by ``prob`` XOR ``logit``
+    (reference ``numpy_extension/random.py:77``)."""
+    if (prob is None) == (logit is None):
+        raise MXNetError(
+            "Either `prob` or `logit` must be specified, but not both.")
+    if prob is not None:
+        p = _jnp.asarray(_param(prob))
+    else:
+        p = _jax.nn.sigmoid(_jnp.asarray(_param(logit)))
+    shape = (tuple(size) if isinstance(size, (tuple, list))
+             else (size,) if size is not None else p.shape)
+    u = _jax.random.uniform(__rng.next_key(), shape)
+    return __wrap((u < p).astype(dtype or "float32"))
+
+
+def _batched(sampler, batch_shape, broadcast_shape):
+    batch = (tuple(batch_shape) if isinstance(batch_shape, (tuple, list))
+             else (batch_shape,) if batch_shape is not None else ())
+    return sampler(batch + tuple(broadcast_shape))
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype="float32",
+              ctx=None):
+    """Uniform samples with ``batch_shape`` prepended to
+    ``broadcast(low, high).shape`` (reference ``random.py:130``)."""
+    lo, hi = _jnp.asarray(_param(low)), _jnp.asarray(_param(high))
+    bshape = _jnp.broadcast_shapes(lo.shape, hi.shape)
+    def sample(shape):
+        u = _jax.random.uniform(__rng.next_key(), shape, dtype=_jnp.float32)
+        return (lo + u * (hi - lo)).astype(dtype or "float32")
+    return __wrap(_batched(sample, batch_shape, bshape))
+
+
+def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype="float32",
+             ctx=None):
+    """Normal samples with ``batch_shape`` prepended to
+    ``broadcast(loc, scale).shape`` (reference ``random.py:187``)."""
+    mu, sd = _jnp.asarray(_param(loc)), _jnp.asarray(_param(scale))
+    bshape = _jnp.broadcast_shapes(mu.shape, sd.shape)
+    def sample(shape):
+        z = _jax.random.normal(__rng.next_key(), shape, dtype=_jnp.float32)
+        return (mu + z * sd).astype(dtype or "float32")
+    return __wrap(_batched(sample, batch_shape, bshape))

@@ -847,7 +847,16 @@ MXTPU_DLL int MXAutogradBackwardEx(int n_heads, NDArrayHandle *heads,
   PyObject *hs = handles_tuple(n_heads, heads);
   PyObject *gs;
   if (head_grads != nullptr) {
-    gs = handles_tuple(n_heads, head_grads);
+    /* a NULL element means "default ones-gradient" for that head (the
+       reference's per-head nullptr convention) — map it to None */
+    gs = PyTuple_New(n_heads);
+    for (int i = 0; i < n_heads; ++i) {
+      PyObject *o = head_grads[i] != nullptr
+                        ? static_cast<PyObject *>(head_grads[i])
+                        : Py_None;
+      Py_INCREF(o);
+      PyTuple_SetItem(gs, i, o);
+    }
   } else {
     gs = Py_None;
     Py_INCREF(Py_None);

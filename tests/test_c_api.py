@@ -675,3 +675,37 @@ def test_runtime_control_from_c(capi, tmp_path):
     assert capi.MXSetProfilerState(0) == 0
     assert capi.MXLoadLib(b"/nonexistent/lib.so") == -1  # clean error
     assert capi.MXGetLastError() != b""
+
+
+def test_backward_ex_null_head_grad_element(capi):
+    """Per-head NULL in head_grads means default ones (reference
+    per-head nullptr convention) — must not crash."""
+    a = _make(capi, onp.array([2.0], onp.float32))
+    b = _make(capi, onp.array([3.0], onp.float32))
+    for h in (a, b):
+        capi.MXNDArrayAttachGrad(h)
+    capi.MXAutogradSetIsRecording(1)
+    outs = (ctypes.c_void_p * 1)()
+    n = ctypes.c_int()
+    ins = (ctypes.c_void_p * 2)(a, a)
+    assert capi.MXImperativeInvoke(b"np.multiply", 2, ins, b"", 1, outs,
+                                   ctypes.byref(n)) == 0
+    h1 = outs[0]
+    ins2 = (ctypes.c_void_p * 2)(b, b)
+    assert capi.MXImperativeInvoke(b"np.multiply", 2, ins2, b"", 1, outs,
+                                   ctypes.byref(n)) == 0
+    h2 = outs[0]
+    capi.MXAutogradSetIsRecording(0)
+    heads = (ctypes.c_void_p * 2)(h1, h2)
+    hg = _make(capi, onp.array([10.0], onp.float32))
+    hgs = (ctypes.c_void_p * 2)(hg, None)  # second head: default ones
+    assert capi.MXAutogradBackwardEx(2, heads, hgs, 0, 1) == 0, \
+        capi.MXGetLastError()
+    g = ctypes.c_void_p()
+    assert capi.MXNDArrayGetGrad(a, ctypes.byref(g)) == 0
+    assert _fetch(capi, g, (1,))[0] == 40.0  # 2*a*10
+    g2 = ctypes.c_void_p()
+    assert capi.MXNDArrayGetGrad(b, ctypes.byref(g2)) == 0
+    assert _fetch(capi, g2, (1,))[0] == 6.0  # 2*b*1
+    for h in (a, b, h1, h2, hg, g, g2):
+        capi.MXNDArrayFree(h)

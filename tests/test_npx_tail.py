@@ -177,3 +177,91 @@ def test_gluon_ctc_loss_matches_bruteforce():
     p = e / e.sum(-1, keepdims=True)
     onp.testing.assert_allclose(out0[0], -onp.log(onp.prod(p[:, 0])),
                                 rtol=1e-4)
+
+
+# ---- round-3 surface-diff tail: npx samplers, dlpack, nonzero,
+# constraint_check, ReflectionPad2D, Append/AsList, HybridCompose ----
+
+def test_npx_bernoulli_prob_logit():
+    mx.npx.seed(3)
+    b = mx.npx.bernoulli(prob=0.25, size=(4000,))
+    assert 0.2 < float(b.asnumpy().mean()) < 0.3
+    bl = mx.npx.bernoulli(logit=mx.np.array([-20.0, 20.0]))
+    assert bl.asnumpy().tolist() == [0.0, 1.0]
+    with pytest.raises(mx.MXNetError):
+        mx.npx.bernoulli(prob=0.5, logit=0.0)
+    with pytest.raises(mx.MXNetError):
+        mx.npx.bernoulli()
+
+
+def test_npx_sampler_n_batch_shape():
+    u = mx.npx.uniform_n(low=mx.np.array([0.0, 100.0]),
+                         high=mx.np.array([1.0, 101.0]), batch_shape=(3,))
+    assert u.shape == (3, 2)
+    vals = u.asnumpy()
+    assert (vals[:, 0] < 2).all() and (vals[:, 1] > 99).all()
+    n = mx.npx.normal_n(loc=0.0, scale=1e-6, batch_shape=(4, 2))
+    assert n.shape == (4, 2) and abs(float(n.asnumpy().mean())) < 1e-3
+    # no batch_shape -> broadcast shape alone
+    assert mx.npx.normal_n(loc=mx.np.zeros((5,))).shape == (5,)
+
+
+def test_npx_nonzero_and_constraint_check():
+    nz = mx.npx.nonzero(mx.np.array([[1, 0], [0, 3]]))
+    assert nz.asnumpy().tolist() == [[0, 0], [1, 1]]
+    assert str(nz.dtype) == "int64"
+    ok = mx.npx.constraint_check(mx.np.array([True, True]), "nope")
+    assert bool(ok.asnumpy())
+    with pytest.raises(mx.MXNetError, match="sigma must be positive"):
+        mx.npx.constraint_check(mx.np.array([True, False]),
+                                "sigma must be positive")
+
+
+def test_dlpack_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    a = mx.npx.from_dlpack(t)
+    assert a.shape == (2, 3)
+    onp.testing.assert_allclose(a.asnumpy(), t.numpy())
+    cap = mx.npx.to_dlpack_for_read(mx.np.array([1.0, 2.0]))
+    back = torch.utils.dlpack.from_dlpack(cap)
+    onp.testing.assert_allclose(back.numpy(), [1.0, 2.0])
+    # write variant exists and matches (immutability documented)
+    cap2 = mx.npx.to_dlpack_for_write(mx.np.array([3.0]))
+    assert float(torch.utils.dlpack.from_dlpack(cap2)[0]) == 3.0
+
+
+def test_reflection_pad2d_torch_oracle():
+    torch = pytest.importorskip("torch")
+    x = onp.random.rand(2, 3, 5, 5).astype("float32")
+    out = mx.gluon.nn.ReflectionPad2D(2)(mx.np.array(x))
+    ref = torch.nn.ReflectionPad2d(2)(torch.tensor(x)).numpy()
+    onp.testing.assert_allclose(out.asnumpy(), ref)
+    assert mx.gluon.nn.ReflectionPad2D(0)(mx.np.array(x)).shape == x.shape
+
+
+def test_batchify_append_aslist():
+    from mxnet_tpu.gluon.data import batchify
+    out = batchify.Append()([[1, 2, 3, 4], [4, 5, 6], [8, 2]])
+    assert [o.shape for o in out] == [(1, 4), (1, 3), (1, 2)]
+    flat = batchify.Append(expand=False)([[1, 2]])
+    assert flat[0].shape == (2,)
+    g = batchify.Group(batchify.Stack(), batchify.AsList())
+    data, texts = g([([1, 2], "a"), ([3, 4], "b")])
+    assert data.shape == (2, 2) and texts == ["a", "b"]
+
+
+def test_hybrid_compose_traces():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = onp.random.randint(0, 255, (16, 16, 3)).astype("uint8")
+    stages = [T.ToTensor(), T.Normalize([0.5] * 3, [0.2] * 3),
+              T.Cast("float32")]
+    hc = T.HybridCompose(stages)
+    want = T.Compose(stages)(img)
+    got_eager = hc(mx.np.array(img))
+    hc.hybridize()
+    got_jit = hc(mx.np.array(img))
+    onp.testing.assert_allclose(got_eager.asnumpy(), onp.asarray(want),
+                                atol=1e-6)
+    onp.testing.assert_allclose(got_jit.asnumpy(), onp.asarray(want),
+                                atol=1e-6)
