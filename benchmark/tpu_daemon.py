@@ -173,16 +173,54 @@ def bank_if_tpu(path: str, rec, rc: int, label: str) -> bool:
     return False
 
 
+def tpu_alive(timeout_s: int = 90) -> bool:
+    """Quick dead-tunnel probe: a child that just inits the backend.
+    Run between captures so a tunnel that died mid-pass doesn't make
+    every remaining capture burn its full per-child watchdog budget
+    (observed: train_bench spinning ~50 min against a dead tunnel)."""
+    code = ("import jax, sys; "
+            "sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              timeout=timeout_s, capture_output=True)
+        return proc.returncode == 0
+    except Exception:  # noqa: BLE001 — timeout/spawn failure = dead
+        return False
+
+
 def capture_train() -> None:
     # per-child bounds chosen so the worst case (every child burning its
     # timeout twice across 8 model x precision combos) stays inside the
-    # daemon's own budget: 8 * 2 * 420s < 7200s
+    # daemon's own budget: 8 * 2 * 420s < 7200s; --bail-after stops the
+    # sweep early when the tunnel has died
     rc, out = run_child(
         [sys.executable, os.path.join(HERE, "train_bench.py"),
          "--models", "resnet50_v1,inception_v3,alexnet,bert_base",
-         "--batch", "32", "--timeout", "420", "--retries", "1"],
+         "--batch", "32", "--timeout", "420", "--retries", "1",
+         "--bail-after", "2"],
         timeout=7200)
-    bank_if_tpu(TRAIN, parse_json_output(out), rc, "train table")
+    rec = parse_json_output(out)
+    # MERGE per-model successes into the banked table: a tunnel flap at
+    # model 3 must not discard models 1-2 (all-or-nothing banking lost a
+    # full resnet50+inception capture once)
+    if rec and rec.get("device") == "tpu":
+        try:
+            with open(TRAIN) as f:
+                banked = json.load(f)
+        except Exception:  # noqa: BLE001
+            banked = None
+        if banked and banked.get("device") == "tpu":
+            by_key = {(r.get("model"), r.get("precision")): r
+                      for r in banked.get("results", [])
+                      if "error" not in r}
+            for idx, r in enumerate(rec.get("results", [])):
+                key = (r.get("model"), r.get("precision"))
+                if "error" in r and key in by_key:
+                    # keep the previously banked success for this combo
+                    rec["results"][idx] = by_key[key]
+        ok = sum(1 for r in rec["results"] if "error" not in r)
+        log(f"train table: {ok}/{len(rec['results'])} combos have results")
+    bank_if_tpu(TRAIN, rec, rc, "train table")
 
 
 def capture_opperf() -> None:
@@ -326,6 +364,10 @@ def main() -> None:
                     if ok == "banked" or not fresh(path):
                         if live_lock.held_by_live_process():
                             log("live bench arrived; pausing captures")
+                            break
+                        if not tpu_alive():
+                            log("tunnel down mid-pass; abandoning "
+                                "remaining captures until next probe")
                             break
                         cap()
                 log(f"suite pass done; refresh in {REFRESH_INTERVAL_S}s")

@@ -197,6 +197,11 @@ def main():
     ap.add_argument("--timeout", type=int, default=600,
                     help="per-(model,precision) child timeout, seconds")
     ap.add_argument("--retries", type=int, default=2)
+    ap.add_argument("--bail-after", type=int, default=2,
+                    help="stop the sweep after this many CONSECUTIVE "
+                         "no-result combos SPANNING 2+ models (one model "
+                         "failing both precisions is a model problem, not a "
+                         "dead tunnel); 0 disables early bail-out")
     args = ap.parse_args()
 
     if args.child:
@@ -208,36 +213,54 @@ def main():
 
     results = []
     device = {}
-    for name in args.models.split(","):
-        for prec in args.precisions.split(","):
-            rec = None
-            for attempt in range(args.retries + 1):
-                cmd = [sys.executable, os.path.abspath(__file__),
-                       "--child", name, prec, "--batch", str(args.batch)]
-                if args.cpu:
-                    cmd.append("--cpu")
-                try:
-                    proc = subprocess.run(cmd, capture_output=True,
-                                          text=True, timeout=args.timeout)
-                    sys.stderr.write(proc.stderr[-2000:])
-                    for line in reversed(proc.stdout.strip().splitlines()):
-                        if line.startswith("{"):
-                            rec = json.loads(line)
-                            break
-                except subprocess.TimeoutExpired:
-                    log(f"{name}/{prec} attempt {attempt}: "
-                        f"timeout {args.timeout}s")
-                except Exception as e:  # noqa: BLE001
-                    log(f"{name}/{prec} attempt {attempt}: {e!r}")
-                if rec:
-                    break
+    consecutive_failures = 0
+    failed_models = set()
+    combos = [(name, prec) for name in args.models.split(",")
+              for prec in args.precisions.split(",")]
+    for name, prec in combos:
+        rec = None
+        # bail only when the failures span MULTIPLE models: one model
+        # failing both its precisions (OOM, unsupported op) is a model
+        # problem, not a dead tunnel, and must not skip the rest
+        if args.bail_after > 0 and \
+                consecutive_failures >= args.bail_after and \
+                len(failed_models) >= 2:
+            log(f"bailing out: {consecutive_failures} consecutive "
+                "combos failed (backend likely unreachable)")
+            results.append({"model": name, "precision": prec,
+                            "batch": args.batch, "error": "skipped: bail"})
+            continue
+        for attempt in range(args.retries + 1):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--child", name, prec, "--batch", str(args.batch)]
+            if args.cpu:
+                cmd.append("--cpu")
+            try:
+                proc = subprocess.run(cmd, capture_output=True,
+                                      text=True, timeout=args.timeout)
+                sys.stderr.write(proc.stderr[-2000:])
+                for line in reversed(proc.stdout.strip().splitlines()):
+                    if line.startswith("{"):
+                        rec = json.loads(line)
+                        break
+            except subprocess.TimeoutExpired:
+                log(f"{name}/{prec} attempt {attempt}: "
+                    f"timeout {args.timeout}s")
+            except Exception as e:  # noqa: BLE001
+                log(f"{name}/{prec} attempt {attempt}: {e!r}")
             if rec:
-                device["device"] = rec.pop("device", None)
-                device["device_kind"] = rec.pop("device_kind", None)
-                results.append(rec)
-            else:
-                results.append({"model": name, "precision": prec,
-                                "batch": args.batch, "error": "no result"})
+                break
+        if rec:
+            consecutive_failures = 0
+            failed_models.clear()
+            device["device"] = rec.pop("device", None)
+            device["device_kind"] = rec.pop("device_kind", None)
+            results.append(rec)
+        else:
+            consecutive_failures += 1
+            failed_models.add(name)
+            results.append({"model": name, "precision": prec,
+                            "batch": args.batch, "error": "no result"})
     out = {**device, "results": results}
     text = json.dumps(out, indent=2)
     print(text)

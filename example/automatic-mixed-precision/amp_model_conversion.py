@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Offline AMP model conversion (reference
+``example/automatic-mixed-precision/amp_model_conversion.py``): take a
+trained fp32 model, convert it for reduced-precision inference with
+``amp.convert_hybrid_block``, check output agreement, compare latency,
+and export the converted model for deployment.
+
+On TPU the target dtype is bf16 — the MXU's native input precision — so
+conversion is the normal deployment path, not an optimization trick.
+
+Example:
+    python example/automatic-mixed-precision/amp_model_conversion.py \
+        --model resnet18_v1 --batch 8
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="resnet18_v1")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--target-dtype", default="bfloat16",
+                   choices=["bfloat16", "float16"])
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--export-prefix", default=None,
+                   help="write {prefix}-symbol.json/-0000.params")
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args()
+
+
+def bench(net, x, iters):
+    import mxnet_tpu as mx
+
+    net(x)  # warm/compile
+    mx.npx.waitall()
+    t0 = time.time()
+    for _ in range(iters):
+        out = net(x)
+    out_host = out.asnumpy()  # completion barrier
+    return (time.time() - t0) / iters, out_host
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = getattr(vision, args.model)(classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.np.array(onp.random.uniform(
+        size=(args.batch, 3, args.image_size, args.image_size)
+    ).astype(onp.float32))
+
+    fp32_lat, fp32_out = bench(net, x, args.iters)
+
+    converted = amp.convert_hybrid_block(net, args.target_dtype)
+    amp_lat, amp_out = bench(converted, x, args.iters)
+
+    # agreement gate: top-1 class must match on the vast majority of rows
+    agree = (fp32_out.argmax(1) == amp_out.argmax(1)).mean()
+    rel = onp.abs(amp_out.astype(onp.float32) - fp32_out).max() / (
+        onp.abs(fp32_out).max() + 1e-8)
+    print(f"fp32 latency:   {fp32_lat * 1e3:.2f} ms/batch")
+    print(f"{args.target_dtype} latency: {amp_lat * 1e3:.2f} ms/batch")
+    print(f"top1 agreement: {agree:.3f}  max rel err: {rel:.4f}")
+    assert agree >= 0.75, "converted model diverged from fp32"
+
+    if args.export_prefix:
+        converted.export(args.export_prefix)
+        print(f"exported {args.export_prefix}-symbol.json")
+    print("conversion ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Margin-based metric learning with distance-weighted sampling
+(reference ``example/gluon/embedding_learning/`` — Wu et al. 2017:
+learn an L2-normalized embedding where same-class pairs sit within a
+margin and negatives are sampled inversely to their distance
+distribution).
+
+Offline-friendly: synthetic class clusters in a high-dim ambient space;
+the gate is retrieval recall@1 improving over the untrained embedding.
+
+Example:
+    python example/gluon/embedding_learning.py --steps 200
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--classes", type=int, default=8)
+    p.add_argument("--ambient", type=int, default=64)
+    p.add_argument("--embed", type=int, default=16)
+    p.add_argument("--per-class", type=int, default=30)
+    p.add_argument("--batch-k", type=int, default=4,
+                   help="samples per class in a batch")
+    p.add_argument("--batch-classes", type=int, default=4)
+    p.add_argument("--steps", type=int, default=250)
+    p.add_argument("--margin", type=float, default=0.5)
+    p.add_argument("--beta", type=float, default=1.0)
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args()
+
+
+def make_data(args, rng):
+    """Class identity lives in a small informative subspace; the rest of
+    the ambient dims are pure noise — an UNTRAINED projection mixes the
+    noise in (poor retrieval), a learned metric suppresses it."""
+    info = max(args.ambient // 8, 4)
+    centers = onp.zeros((args.classes, args.ambient))
+    centers[:, :info] = rng.normal(size=(args.classes, info)) * 2.0
+    xs, ys = [], []
+    for c in range(args.classes):
+        pts = centers[c] + rng.normal(
+            size=(args.per_class, args.ambient))
+        xs.append(pts)
+        ys.extend([c] * args.per_class)
+    return (onp.concatenate(xs).astype(onp.float32),
+            onp.array(ys, onp.int32))
+
+
+def recall_at_1(emb, labels):
+    d = ((emb[:, None] - emb[None]) ** 2).sum(-1)
+    onp.fill_diagonal(d, onp.inf)
+    nn_idx = d.argmin(1)
+    return float((labels[nn_idx] == labels).mean())
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import Trainer, nn
+
+    rng = onp.random.RandomState(9)
+    x, y = make_data(args, rng)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(args.embed))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    def embed(xs):
+        e = net(mx.np.array(xs))
+        return e / mx.np.linalg.norm(e, axis=1, keepdims=True)
+
+    base = recall_at_1(embed(x).asnumpy(), y)
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": args.lr})
+
+    for step in range(args.steps):
+        # batch: batch_classes classes x batch_k samples
+        cls = rng.choice(args.classes, args.batch_classes, replace=False)
+        idx = onp.concatenate([
+            rng.choice(onp.where(y == c)[0], args.batch_k, replace=False)
+            for c in cls])
+        yb = y[idx]
+        with autograd.record():
+            e = embed(x[idx])
+            d = mx.np.sqrt(((e[:, None] - e[None]) ** 2).sum(-1) + 1e-8)
+            same = mx.np.array(
+                (yb[:, None] == yb[None]).astype(onp.float32))
+            eye = mx.np.array(onp.eye(len(idx), dtype=onp.float32))
+            # margin loss (Wu et al. eq. 5): positives pulled under
+            # beta-margin, negatives pushed past beta+margin; negatives
+            # weighted toward the distance distribution's hard band
+            pos = mx.npx.relu(d - (args.beta - args.margin)) * (same - eye)
+            neg_mask = 1.0 - same
+            w = mx.np.exp(-((d - args.beta) ** 2) / 0.1) * neg_mask
+            neg = mx.npx.relu((args.beta + args.margin) - d) * w
+            loss = (pos.sum() + neg.sum()) / len(idx)
+        loss.backward()
+        trainer.step(len(idx))
+        if step % 50 == 0:
+            print(f"step {step}: loss={float(loss):.4f}")
+
+    final = recall_at_1(embed(x).asnumpy(), y)
+    print(f"recall@1 untrained={base:.3f} trained={final:.3f}")
+    assert final > base, "metric learning did not improve retrieval"
+    return final
+
+
+if __name__ == "__main__":
+    main()

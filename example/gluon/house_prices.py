@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""House-price regression with k-fold cross-validation (reference
+``example/gluon/house_prices/kaggle_k_fold_cross_validation.py``: dense
+net on standardized tabular features, log-RMSE metric, k-fold splits,
+Adam).
+
+Offline-friendly: generates a synthetic tabular dataset with the same
+statistical shape as the Kaggle data (mixed informative/noise features,
+multiplicative price formation) when no CSV is given.
+
+Example:
+    python example/gluon/house_prices.py --folds 3 --epochs 20
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-samples", type=int, default=600)
+    p.add_argument("--num-features", type=int, default=30)
+    p.add_argument("--folds", type=int, default=5)
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--weight-decay", type=float, default=0.1)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args()
+
+
+def synthetic_houses(n, d, seed=11):
+    rng = onp.random.RandomState(seed)
+    x = rng.normal(size=(n, d)).astype(onp.float32)
+    w = onp.zeros(d, onp.float32)
+    w[: d // 3] = rng.uniform(0.2, 1.0, d // 3)  # informative third
+    log_price = x @ w + 0.05 * rng.normal(size=n) + 11.5
+    return x, onp.exp(log_price).astype(onp.float32)
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import Trainer, loss as gloss, nn
+
+    x, price = synthetic_houses(args.num_samples, args.num_features)
+    # standardize features exactly like the reference preprocesses Kaggle;
+    # the TARGET is standardized too (train in units of log-price std,
+    # un-scale for the reported log-rmse) — otherwise the optimizer spends
+    # hundreds of steps just learning the ~11.5 log-price offset
+    x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+    log_y = onp.log(price).reshape(-1, 1)
+    y_mean, y_std = log_y.mean(), log_y.std()
+    y = ((log_y - y_mean) / y_std).astype(onp.float32)
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(1))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        return net
+
+    def log_rmse(net, xs, ys):
+        pred = net(mx.np.array(xs)).asnumpy()
+        return float(onp.sqrt(onp.mean((pred - ys) ** 2))) * float(y_std)
+
+    def train_one(net, xs, ys):
+        trainer = Trainer(net.collect_params(), "adam",
+                          {"learning_rate": args.lr,
+                           "wd": args.weight_decay})
+        loss_fn = gloss.L2Loss()
+        n = len(xs)
+        for _ in range(args.epochs):
+            order = onp.random.permutation(n)
+            for i in range(0, n - args.batch_size + 1, args.batch_size):
+                idx = order[i:i + args.batch_size]
+                xb, yb = mx.np.array(xs[idx]), mx.np.array(ys[idx])
+                with autograd.record():
+                    loss = loss_fn(net(xb), yb)
+                loss.backward()
+                trainer.step(args.batch_size)
+
+    fold = len(x) // args.folds
+    scores = []
+    for k in range(args.folds):
+        lo, hi = k * fold, (k + 1) * fold
+        val_x, val_y = x[lo:hi], y[lo:hi]
+        tr_x = onp.concatenate([x[:lo], x[hi:]])
+        tr_y = onp.concatenate([y[:lo], y[hi:]])
+        net = build()
+        train_one(net, tr_x, tr_y)
+        rmse = log_rmse(net, val_x, val_y)
+        scores.append(rmse)
+        print(f"fold {k}: log-rmse={rmse:.4f}")
+    avg = sum(scores) / len(scores)
+    print(f"{args.folds}-fold avg log-rmse={avg:.4f}")
+    return avg
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Neural style transfer by input optimization (reference
+``example/gluon/style_transfer/`` — Gatys et al.: freeze a conv
+feature extractor, optimize the PIXELS so content features match one
+image and gram matrices match another).
+
+The distinctive mechanics exercised here: gradients flow to the INPUT
+(attach_grad on the image, net params frozen), gram-matrix style
+losses, and a raw-optimizer pixel update loop — none of which touch a
+Trainer. Offline note: the extractor uses the deterministic model_store
+weights, so outputs are not artistic; the measured contract is that
+both content and style losses fall.
+
+Example:
+    python example/gluon/style_transfer.py --iters 60
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--size", type=int, default=64)
+    p.add_argument("--iters", type=int, default=80)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--content-weight", type=float, default=1.0)
+    p.add_argument("--style-weight", type=float, default=30.0)
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args()
+
+
+def toy_images(size, rng):
+    """Content: centered square. Style: diagonal stripes."""
+    content = onp.full((size, size), 0.2, onp.float32)
+    q = size // 4
+    content[q:-q, q:-q] = 0.8
+    ys, xs = onp.mgrid[0:size, 0:size]
+    style = (0.5 + 0.5 * onp.sin((ys + xs) / 4.0)).astype(onp.float32)
+    mk = lambda img: onp.stack([img + 0.02 * rng.normal(size=img.shape)
+                                for _ in range(3)], 0)[None]
+    return mk(content).astype(onp.float32), mk(style).astype(onp.float32)
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    # compact VGG-style extractor; taps = relu outputs at two depths
+    class Extractor(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2D(16, 3, padding=1)
+            self.c2 = nn.Conv2D(32, 3, padding=1, strides=2)
+            self.c3 = nn.Conv2D(64, 3, padding=1, strides=2)
+
+        def forward(self, x):
+            f1 = mx.npx.relu(self.c1(x))
+            f2 = mx.npx.relu(self.c2(f1))
+            f3 = mx.npx.relu(self.c3(f2))
+            return f1, f3
+
+    def gram(feat):
+        n, c, h, w = feat.shape
+        flat = feat.reshape(n, c, h * w)
+        return mx.np.matmul(flat, flat.transpose(0, 2, 1)) / (c * h * w)
+
+    rng = onp.random.RandomState(3)
+    content_np, style_np = toy_images(args.size, rng)
+    net = Extractor()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    content = mx.np.array(content_np)
+    style = mx.np.array(style_np)
+    with autograd.pause():
+        content_feat = net(content)[0]
+        style_gram = gram(net(style)[1])
+
+    # start from a noisy blend so both losses are live from iter 0
+    start = (0.5 * content_np +
+             0.5 * rng.uniform(0, 1, content_np.shape)).astype(onp.float32)
+    img = mx.np.array(start)
+    img.attach_grad()
+    first = last = None
+    for it in range(args.iters):
+        with autograd.record():
+            f_c, f_s = net(img)
+            c_loss = ((f_c - content_feat) ** 2).mean()
+            s_loss = ((gram(f_s) - style_gram) ** 2).mean() * 1e4
+            loss = args.content_weight * c_loss + args.style_weight * s_loss
+        loss.backward()
+        # normalized gradient descent on the pixels: feature losses give
+        # ~1e-5-scale raw gradients, so the step is scaled by the grad's
+        # max magnitude (the usual trick for input optimization), then
+        # clamped to the image range
+        g = img.grad
+        g = g / (mx.np.abs(g).max() + 1e-12)
+        img = mx.np.clip(img - args.lr * g, 0.0, 1.0)
+        img.attach_grad()
+        val = float(loss)
+        if first is None:
+            first = val
+        last = val
+        if it % 20 == 0:
+            print(f"iter {it}: loss={val:.3e} "
+                  f"(content={float(c_loss):.3e} style={float(s_loss):.3e})")
+    print(f"loss {first:.3e} -> {last:.3e}")
+    assert last < first * 0.7, "style optimization failed to descend"
+    print("style transfer descent ok")
+    return last
+
+
+if __name__ == "__main__":
+    main()

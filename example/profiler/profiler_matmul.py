@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Profile a chain of matmuls (reference
+``example/profiler/profiler_matmul.py``): turn the profiler on around
+the hot loop, dump chrome-trace JSON, print the aggregate table.
+
+Open the dump at chrome://tracing or https://ui.perfetto.dev.
+
+Example:
+    python example/profiler/profiler_matmul.py --iters 50 --dim 1024
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dim", type=int, default=512)
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--file", default="profile_matmul.json")
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+
+    profiler.set_config(filename=args.file, aggregate_stats=True)
+    a = mx.np.random.uniform(size=(args.dim, args.dim))
+    b = mx.np.random.uniform(size=(args.dim, args.dim))
+    mx.npx.waitall()
+
+    profiler.set_state("run")
+    c = a
+    for _ in range(args.iters):
+        c = mx.np.dot(c, b)
+    mx.npx.waitall()
+    profiler.set_state("stop")
+
+    print(profiler.dumps())
+    profiler.dump()
+    print(f"chrome trace written to {args.file}")
+
+
+if __name__ == "__main__":
+    main()

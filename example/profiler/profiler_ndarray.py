@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Profile a mixed NDArray workload (reference
+``example/profiler/profiler_ndarray.py``): elementwise, reductions,
+indexing, and copies under the profiler, with the per-op aggregate
+table printed at the end — the contract is that EVERY dispatched op is
+timed with no operator cooperation (engine-integrated tracing).
+
+Example:
+    python example/profiler/profiler_ndarray.py --cpu
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--size", type=int, default=2048)
+    p.add_argument("--file", default="profile_ndarray.json")
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+
+    profiler.set_config(filename=args.file, aggregate_stats=True)
+    n = args.size
+    profiler.set_state("run")
+
+    a = mx.np.random.uniform(-1, 1, (n, n))
+    b = mx.np.random.uniform(-1, 1, (n, n))
+    c = a + b
+    c = c * 2 - a / 3
+    s = mx.np.sum(c, axis=1)
+    m = mx.np.max(c, axis=0)
+    sorted_ = mx.np.sort(s)
+    top = mx.npx.topk(m, k=8)
+    gathered = mx.np.take(c, mx.np.array([0, 5, 7]), axis=0)
+    cast = c.astype("bfloat16").astype("float32")
+    mx.npx.waitall()
+
+    profiler.set_state("stop")
+    print(profiler.dumps())
+    profiler.dump()
+    print(f"ops profiled: sort={sorted_.shape} topk={top.shape} "
+          f"take={gathered.shape} cast={cast.dtype}")
+    print(f"chrome trace written to {args.file}")
+
+
+if __name__ == "__main__":
+    main()
