@@ -204,6 +204,14 @@ def capture_train() -> None:
     # model 3 must not discard models 1-2 (all-or-nothing banking lost a
     # full resnet50+inception capture once)
     if rec and rec.get("device") == "tpu":
+        now = time.time()
+        # every fresh per-combo success carries its own capture stamp so
+        # merged-forward entries age out individually (STALE_AFTER_S),
+        # instead of being re-stamped fresh forever by the table-level
+        # captured_at
+        for r in rec.get("results", []):
+            if "error" not in r:
+                r["captured_unix"] = now
         try:
             with open(TRAIN) as f:
                 banked = json.load(f)
@@ -212,11 +220,12 @@ def capture_train() -> None:
         if banked and banked.get("device") == "tpu":
             by_key = {(r.get("model"), r.get("precision")): r
                       for r in banked.get("results", [])
-                      if "error" not in r}
+                      if "error" not in r
+                      and now - r.get("captured_unix", 0) < STALE_AFTER_S}
             for idx, r in enumerate(rec.get("results", [])):
                 key = (r.get("model"), r.get("precision"))
                 if "error" in r and key in by_key:
-                    # keep the previously banked success for this combo
+                    # keep the (still-fresh) previously banked success
                     rec["results"][idx] = by_key[key]
         ok = sum(1 for r in rec["results"] if "error" not in r)
         log(f"train table: {ok}/{len(rec['results'])} combos have results")

@@ -73,6 +73,7 @@ class Sequential(Block):
 
 
 class HybridSequential(Sequential, HybridBlock):
+    """Sequential container that traces to ONE XLA executable when hybridized (reference nn/basic_layers.py HybridSequential)."""
     def __init__(self, *blocks):
         HybridBlock.__init__(self)
         for b in blocks:
@@ -126,6 +127,7 @@ class Dense(HybridBlock):
 
 
 class Dropout(HybridBlock):
+    """Randomly zeroes activations with rate ``rate`` during training; identity at inference (reference nn/basic_layers.py Dropout -> npx.dropout, train-gated)."""
     def __init__(self, rate, axes=()):
         super().__init__()
         self._rate = rate
@@ -139,6 +141,7 @@ class Dropout(HybridBlock):
 
 
 class Flatten(HybridBlock):
+    """Collapses all but the batch axis (reference nn/basic_layers.py Flatten)."""
     def forward(self, x):
         return x.reshape(x.shape[0], -1)
 
@@ -147,6 +150,7 @@ class Flatten(HybridBlock):
 
 
 class Activation(HybridBlock):
+    """Elementwise activation by name: relu/sigmoid/tanh/softrelu/softsign (reference nn/basic_layers.py Activation -> npx.activation)."""
     def __init__(self, activation):
         super().__init__()
         self._act_type = activation
@@ -159,6 +163,7 @@ class Activation(HybridBlock):
 
 
 class LeakyReLU(HybridBlock):
+    """x if x>0 else alpha*x (reference nn/basic_layers.py LeakyReLU)."""
     def __init__(self, alpha=0.01):
         super().__init__()
         self._alpha = alpha
@@ -168,6 +173,7 @@ class LeakyReLU(HybridBlock):
 
 
 class PReLU(HybridBlock):
+    """LeakyReLU with a LEARNED per-channel slope (reference nn/basic_layers.py PReLU; He et al. 2015)."""
     def __init__(self, alpha_initializer=init_mod.Constant(0.25), in_channels=1):
         super().__init__()
         self.alpha = Parameter("alpha", shape=(in_channels,), init=alpha_initializer)
@@ -177,6 +183,7 @@ class PReLU(HybridBlock):
 
 
 class ELU(HybridBlock):
+    """Exponential linear unit: x if x>0 else alpha*(exp(x)-1) (reference ELU)."""
     def __init__(self, alpha=1.0):
         super().__init__()
         self._alpha = alpha
@@ -186,11 +193,13 @@ class ELU(HybridBlock):
 
 
 class SELU(HybridBlock):
+    """Self-normalizing ELU with fixed scale/alpha (Klambauer et al.; reference SELU)."""
     def forward(self, x):
         return npx.leaky_relu(x, act_type="selu")
 
 
 class GELU(HybridBlock):
+    """Gaussian error linear unit (reference GELU; erf form, approximation selectable)."""
     def __init__(self, approximation="erf"):
         super().__init__()
         self._approx = approximation
@@ -200,11 +209,13 @@ class GELU(HybridBlock):
 
 
 class SiLU(HybridBlock):
+    """x * sigmoid(x) (reference SiLU)."""
     def forward(self, x):
         return npx.activation(x, act_type="silu")
 
 
 class Swish(HybridBlock):
+    """x * sigmoid(beta*x) (reference Swish; SiLU with a beta knob)."""
     def __init__(self, beta=1.0):
         super().__init__()
         self._beta = beta
@@ -238,6 +249,7 @@ class Embedding(HybridBlock):
 
 
 class Lambda(Block):
+    """Wraps an arbitrary function as an (eager-only) Block (reference Lambda)."""
     def __init__(self, function):
         super().__init__()
         if isinstance(function, str):
@@ -249,6 +261,7 @@ class Lambda(Block):
 
 
 class HybridLambda(HybridBlock):
+    """Wraps a traceable function as a HybridBlock (reference HybridLambda)."""
     def __init__(self, function):
         super().__init__()
         if isinstance(function, str):
@@ -260,6 +273,7 @@ class HybridLambda(HybridBlock):
 
 
 class Identity(HybridBlock):
+    """Returns its input unchanged; placeholder in containers (reference Identity)."""
     def forward(self, x):
         return x
 
@@ -277,6 +291,7 @@ class Concatenate(Sequential):
 
 
 class HybridConcatenate(HybridSequential):
+    """Runs child blocks on the same input and concatenates their outputs along ``axis`` (reference HybridConcatenate)."""
     def __init__(self, axis=-1):
         super().__init__()
         self.axis = axis

@@ -24,6 +24,7 @@ def _pair(v, n):
 
 
 class _Conv(HybridBlock):
+    """Shared N-D convolution/transposed-convolution machinery: weight/bias parameters with deferred shape, layout handling, npx.convolution dispatch."""
     def __init__(self, channels, kernel_size, strides, padding, dilation,
                  groups, layout, in_channels=0, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
@@ -93,6 +94,7 @@ class _Conv(HybridBlock):
 
 
 class Conv1D(_Conv):
+    """1-D convolution over NCW input (reference nn/conv_layers.py Conv1D)."""
     def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
                  groups=1, layout="NCW", activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
@@ -103,6 +105,7 @@ class Conv1D(_Conv):
 
 
 class Conv2D(_Conv):
+    """2-D convolution over NCHW input (reference Conv2D). On TPU the conv lowers onto the MXU systolic array via XLA."""
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
                  dilation=(1, 1), groups=1, layout="NCHW", activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
@@ -113,6 +116,7 @@ class Conv2D(_Conv):
 
 
 class Conv3D(_Conv):
+    """3-D convolution over NCDHW input (reference Conv3D)."""
     def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
                  dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
@@ -123,6 +127,7 @@ class Conv3D(_Conv):
 
 
 class Conv1DTranspose(_Conv):
+    """1-D transposed (fractionally-strided) convolution (reference Conv1DTranspose)."""
     def __init__(self, channels, kernel_size, strides=1, padding=0, output_padding=0,
                  dilation=1, groups=1, layout="NCW", activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
@@ -134,6 +139,7 @@ class Conv1DTranspose(_Conv):
 
 
 class Conv2DTranspose(_Conv):
+    """2-D transposed convolution, the DCGAN/segmentation upsampler (reference Conv2DTranspose)."""
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
                  output_padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
                  activation=None, use_bias=True, weight_initializer=None,
@@ -145,6 +151,7 @@ class Conv2DTranspose(_Conv):
 
 
 class Conv3DTranspose(_Conv):
+    """3-D transposed convolution (reference Conv3DTranspose)."""
     def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
                  output_padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
                  layout="NCDHW", activation=None, use_bias=True,
@@ -157,6 +164,7 @@ class Conv3DTranspose(_Conv):
 
 
 class _Pooling(HybridBlock):
+    """Shared pooling machinery over npx.pooling (max/avg, global variants, ceil_mode, count_include_pad)."""
     def __init__(self, pool_size, strides, padding, global_pool, pool_type,
                  layout, count_include_pad=True, ceil_mode=False):
         super().__init__()
@@ -185,21 +193,25 @@ class _Pooling(HybridBlock):
 
 
 class MaxPool1D(_Pooling):
+    """1-D max pooling (reference MaxPool1D)."""
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW", ceil_mode=False):
         super().__init__(pool_size, strides, padding, False, "max", layout, ceil_mode=ceil_mode)
 
 
 class MaxPool2D(_Pooling):
+    """2-D max pooling (reference MaxPool2D)."""
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW", ceil_mode=False):
         super().__init__(pool_size, strides, padding, False, "max", layout, ceil_mode=ceil_mode)
 
 
 class MaxPool3D(_Pooling):
+    """3-D max pooling (reference MaxPool3D)."""
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW", ceil_mode=False):
         super().__init__(pool_size, strides, padding, False, "max", layout, ceil_mode=ceil_mode)
 
 
 class AvgPool1D(_Pooling):
+    """1-D average pooling (reference AvgPool1D)."""
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True):
         super().__init__(pool_size, strides, padding, False, "avg", layout,
@@ -207,6 +219,7 @@ class AvgPool1D(_Pooling):
 
 
 class AvgPool2D(_Pooling):
+    """2-D average pooling (reference AvgPool2D)."""
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
                  ceil_mode=False, count_include_pad=True):
         super().__init__(pool_size, strides, padding, False, "avg", layout,
@@ -214,6 +227,7 @@ class AvgPool2D(_Pooling):
 
 
 class AvgPool3D(_Pooling):
+    """3-D average pooling (reference AvgPool3D)."""
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW",
                  ceil_mode=False, count_include_pad=True):
         super().__init__(pool_size, strides, padding, False, "avg", layout,
@@ -221,31 +235,37 @@ class AvgPool3D(_Pooling):
 
 
 class GlobalMaxPool1D(_Pooling):
+    """Max over the full temporal axis -> NC1 (reference GlobalMaxPool1D)."""
     def __init__(self, layout="NCW"):
         super().__init__(1, 1, 0, True, "max", layout)
 
 
 class GlobalMaxPool2D(_Pooling):
+    """Max over all spatial positions -> NC11 (reference GlobalMaxPool2D)."""
     def __init__(self, layout="NCHW"):
         super().__init__(1, 1, 0, True, "max", layout)
 
 
 class GlobalMaxPool3D(_Pooling):
+    """Max over all spatio-temporal positions (reference GlobalMaxPool3D)."""
     def __init__(self, layout="NCDHW"):
         super().__init__(1, 1, 0, True, "max", layout)
 
 
 class GlobalAvgPool1D(_Pooling):
+    """Mean over the full temporal axis (reference GlobalAvgPool1D)."""
     def __init__(self, layout="NCW"):
         super().__init__(1, 1, 0, True, "avg", layout)
 
 
 class GlobalAvgPool2D(_Pooling):
+    """Mean over all spatial positions — the classifier-head pool (reference GlobalAvgPool2D)."""
     def __init__(self, layout="NCHW"):
         super().__init__(1, 1, 0, True, "avg", layout)
 
 
 class GlobalAvgPool3D(_Pooling):
+    """Mean over all spatio-temporal positions (reference GlobalAvgPool3D)."""
     def __init__(self, layout="NCDHW"):
         super().__init__(1, 1, 0, True, "avg", layout)
 

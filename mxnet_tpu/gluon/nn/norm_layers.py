@@ -17,6 +17,7 @@ __all__ = ["BatchNorm", "BatchNormReLU", "LayerNorm", "GroupNorm", "InstanceNorm
 
 
 class BatchNorm(HybridBlock):
+    """Batch normalization over the channel axis with running-stat tracking; functional stats update threads through the trace (reference nn/basic_layers.py BatchNorm / batch_norm op)."""
     def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True, scale=True,
                  use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones", running_mean_initializer="zeros",
@@ -75,6 +76,7 @@ class SyncBatchNorm(BatchNorm):
 
 
 class LayerNorm(HybridBlock):
+    """Normalizes over the last axis with learned gain/bias (reference LayerNorm; Ba et al. 2016)."""
     def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
                  beta_initializer="zeros", gamma_initializer="ones",
                  in_channels=0, dtype="float32"):
@@ -103,6 +105,7 @@ class LayerNorm(HybridBlock):
 
 
 class GroupNorm(HybridBlock):
+    """Normalizes channel groups independently of batch size (reference GroupNorm; Wu & He 2018)."""
     def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
                  beta_initializer="zeros", gamma_initializer="ones",
                  in_channels=0, dtype="float32"):
@@ -126,6 +129,7 @@ class GroupNorm(HybridBlock):
 
 
 class InstanceNorm(HybridBlock):
+    """Per-sample, per-channel spatial normalization (reference InstanceNorm; Ulyanov et al.)."""
     def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
                  beta_initializer="zeros", gamma_initializer="ones",
                  in_channels=0, dtype="float32"):

@@ -230,6 +230,7 @@ class TransformerEncoderLayer(HybridBlock):
 
 
 class TransformerEncoder(HybridBlock):
+    """Stack of pre/post-norm self-attention + FFN blocks over npx.multi_head_attention; the flash-attention Pallas kernel backs long sequences."""
     def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
                  attention_dropout=0.0, activation="gelu", causal=False,
                  pre_norm=True, tp_axis: Optional[str] = None, dtype="float32"):

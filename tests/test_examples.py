@@ -252,9 +252,8 @@ def test_amp_conversion_entry_point():
 
 
 @pytest.mark.integration
-def test_profiler_examples():
-    import tempfile
-    f1 = tempfile.mktemp(suffix=".json")
+def test_profiler_examples(tmp_path):
+    f1 = str(tmp_path / "matmul.json")
     out = _run("example/profiler/profiler_matmul.py", "--dim", "64",
                "--iters", "3", "--file", f1)
     assert out.returncode == 0, out.stderr[-2000:]
@@ -263,7 +262,7 @@ def test_profiler_examples():
     table = out.stdout.split("chrome trace written")[0]
     assert "Total(ms)" in table and "dot" in table
     assert os.path.exists(f1) and os.path.getsize(f1) > 2
-    f2 = tempfile.mktemp(suffix=".json")
+    f2 = str(tmp_path / "ndarray.json")
     out = _run("example/profiler/profiler_ndarray.py", "--size", "128",
                "--file", f2)
     assert out.returncode == 0, out.stderr[-2000:]

@@ -1,0 +1,38 @@
+"""tools/ entry points (reference tools/ — here: the API-docs generator;
+the other tools are covered in test_tools.py / test_perf_harnesses.py)."""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+
+def test_api_docs_generator(tmp_path):
+    """tools/gen_api_docs.py regenerates the full docs/api tree without
+    errors and every curated module yields a page with content."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "gen_api_docs.py"),
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr[-2000:]
+    pages = list(tmp_path.glob("*.md"))
+    assert len(pages) >= 40
+    nn = (tmp_path / "gluon_nn.md").read_text()
+    assert "Conv2D" in nn and "MXU systolic array" in nn
+    idx = (tmp_path / "index.md").read_text()
+    assert "mxnet_tpu.parallel" in idx
+    # the COMMITTED docs/api tree must match a fresh generation exactly
+    # (this is the "keeps it honest" contract): no drift, no orphans
+    committed = os.path.join(ROOT, "docs", "api")
+    fresh_names = sorted(p.name for p in pages)  # glob includes index.md
+    committed_names = sorted(os.listdir(committed))
+    assert sorted(fresh_names) == committed_names, (
+        "docs/api has drifted: regenerate with tools/gen_api_docs.py")
+    for name in committed_names:
+        got = (tmp_path / name).read_text()
+        want = open(os.path.join(committed, name)).read()
+        assert got == want, (
+            f"docs/api/{name} is stale: regenerate with "
+            "tools/gen_api_docs.py")
