@@ -98,6 +98,33 @@ int MXSymbolInferShape(SymbolHandle sym, const char *shapes_json, char *buf,
                        int buf_len, int *needed);
 int MXSymbolFree(SymbolHandle sym);
 
+/* ---- Symbol composition: BUILD a graph from C (reference
+   c_api_symbolic.cc). An atomic symbol holds op + string params with
+   inputs unbound; MXSymbolCompose binds them IN PLACE (positional when
+   keys is NULL, by parameter name otherwise). Composing an
+   already-composed symbol substitutes its free variables by name. ---- */
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateAtomicSymbol(const char *op_name, int num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+int MXSymbolCompose(SymbolHandle sym, const char *name, int num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCreateGroup(int num, SymbolHandle *symbols, SymbolHandle *out);
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetName(SymbolHandle sym, char *buf, int buf_len, int *needed);
+/* *success = 1 iff the attr exists (missing attr is not an error) */
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, char *buf, int buf_len,
+                    int *needed, int *success);
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value);
+/* JSON {node_name: {attr: value}} */
+int MXSymbolListAttr(SymbolHandle sym, char *buf, int buf_len, int *needed);
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetNumOutputs(SymbolHandle sym, int *out);
+int MXSymbolGetOutput(SymbolHandle sym, int index, SymbolHandle *out);
+/* JSON {name, description, args: [{name, default}]} */
+int MXSymbolGetAtomicSymbolInfo(const char *op_name, char *buf, int buf_len,
+                                int *needed);
+
 /* ---- CachedOp over durable exports (HybridBlock.export artifacts:
    {prefix}-symbol.json StableHLO envelope + {prefix}-NNNN.params) ---- */
 int MXCachedOpCreateFromFile(const char *symbol_file, const char *param_file,

@@ -524,3 +524,207 @@ def engine_set_bulk_size(size: int) -> int:
 
     prev = engine.set_bulk_size(int(size))
     return int(prev)
+
+
+# ---- Symbol composition from C (MXSymbolCreateVariable /
+#      CreateAtomicSymbol / Compose / Group / attrs / GetAtomicSymbolInfo;
+#      reference c_api_symbolic.cc: MXSymbolCreateAtomicSymbol,
+#      MXSymbolCompose mutate-in-place contract) ----
+
+def _parse_param(text: str):
+    """Reference atomic-symbol params arrive as strings ("64", "True",
+    "(2,)", "None"); decode to python values where the literal parses
+    (json first for "true"/"[2, 2]", then python literals for tuples,
+    None and friends), else keep the raw string."""
+    import ast
+
+    try:
+        return json.loads(text)
+    except Exception:
+        try:
+            return ast.literal_eval(text.strip())
+        except Exception:
+            return text
+
+
+def symbol_variable(name: str):
+    from .symbol import symbol as _sym
+
+    return _sym.var(name)
+
+
+def symbol_create_atomic(op_name: str, keys: tuple, vals: tuple, name: str):
+    """An atomic symbol is op + params with inputs still unbound; the
+    reference keeps it legal to pass around before MXSymbolCompose binds
+    inputs in place. Modeled as an empty-headed Symbol carrying the
+    pending call."""
+    from .symbol import symbol as _sym
+
+    if op_name not in _sym._registry():
+        raise KeyError(f"unknown op {op_name!r} "
+                       "(MXListAllOpNames lists the registry)")
+    s = _sym.Symbol([])
+    s._pending = (op_name,
+                  {k: _parse_param(v) for k, v in zip(keys, vals)},
+                  name or None)
+    s._pending_attrs = {}
+    return s
+
+
+def _pending_of(s):
+    return getattr(s, "_pending", None)
+
+
+def _require_composed(s, what: str):
+    if _pending_of(s) is not None:
+        raise ValueError(
+            f"{what}: atomic symbol {s._pending[0]!r} has unbound inputs "
+            "— call MXSymbolCompose first")
+
+
+def symbol_compose(s, name: str, keys: tuple, args: tuple) -> None:
+    """Mutates ``s`` in place (the reference contract: the handle passed
+    to MXSymbolCompose IS the composed symbol afterwards).
+
+    Two modes, as in the reference:
+      - atomic symbol: bind the op's inputs (positional when keys empty,
+        by parameter name otherwise);
+      - composed symbol: substitute free variables by name (keys
+        required); ``name`` renames the composite head.
+    """
+    from .symbol import symbol as _sym
+
+    pending = _pending_of(s)
+    if pending is not None:
+        op_name, params, at_name = pending
+        pos, kw = (), {}
+        if keys:
+            kw = dict(zip(keys, args))
+        else:
+            pos = tuple(args)
+        final = name or at_name
+        if final:
+            params = dict(params, name=final)
+        composed = _sym._sym_op(op_name, *pos, **kw, **params)
+        attrs = getattr(s, "_pending_attrs", None)
+        if attrs:
+            composed._set_attr(**attrs)
+        s._heads = composed._heads
+        del s._pending
+        if attrs is not None:
+            del s._pending_attrs
+    else:
+        if not keys:
+            raise ValueError(
+                "composing a non-atomic symbol substitutes variables: "
+                "keys (variable names) are required")
+        composed = s(**dict(zip(keys, args)))
+        if name and len(composed._heads) == 1:
+            # rename the composite head (reference MXSymbolCompose name
+            # argument); clone so an unchanged shared node isn't renamed
+            # out from under other symbols
+            node, slot = composed._heads[0]
+            renamed = _sym._Node(node.op, name, list(node.pos_spec),
+                                 dict(node.kwargs), dict(node.kw_sym),
+                                 list(node.inputs), node.n_out,
+                                 dict(node.attrs))
+            composed = _sym.Symbol([(renamed, slot)])
+        s._heads = composed._heads
+
+
+def symbol_copy(s):
+    """Independent deep copy via the JSON wire format (reference
+    __deepcopy__ -> MXSymbolCopy)."""
+    from .symbol import symbol as _sym
+
+    if _pending_of(s) is not None:
+        c = _sym.Symbol([])
+        op, params, nm = s._pending
+        c._pending = (op, dict(params), nm)
+        c._pending_attrs = dict(getattr(s, "_pending_attrs", {}))
+        return c
+    return _sym.fromjson(s.tojson())
+
+
+def symbol_get_name(s) -> str:
+    pending = _pending_of(s)
+    if pending is not None:
+        op_name, _, at_name = pending
+        return at_name or op_name.split(".")[-1]
+    return s.name
+
+
+def symbol_get_attr(s, key: str) -> tuple:
+    if _pending_of(s) is not None:
+        val = getattr(s, "_pending_attrs", {}).get(key)
+    else:
+        val = s.attr(key)
+    return (0, "") if val is None else (1, str(val))
+
+
+def symbol_set_attr(s, key: str, val: str) -> None:
+    if _pending_of(s) is not None:
+        # legal before compose in the reference; applied to the node at
+        # compose time
+        s._pending_attrs[key] = val
+        return
+    s._set_attr(**{key: val})
+
+
+def symbol_list_attr(s) -> str:
+    if _pending_of(s) is not None:
+        attrs = getattr(s, "_pending_attrs", {})
+        return json.dumps(
+            {symbol_get_name(s): dict(attrs)} if attrs else {})
+    return json.dumps(s.attr_dict())
+
+
+def symbol_group(syms: tuple):
+    from .symbol import symbol as _sym
+
+    for m in syms:
+        _require_composed(m, "MXSymbolCreateGroup")
+    return _sym.Group(list(syms))
+
+
+def symbol_get_internals(s):
+    _require_composed(s, "MXSymbolGetInternals")
+    return s.get_internals()
+
+
+def symbol_num_outputs(s) -> int:
+    _require_composed(s, "MXSymbolGetNumOutputs")
+    return len(s)
+
+
+def symbol_get_output(s, index: int):
+    _require_composed(s, "MXSymbolGetOutput")
+    return s[int(index)]
+
+
+def atomic_symbol_info(op_name: str) -> str:
+    """JSON {name, description, args: [{name, default}]} from the live
+    registry (the reference's MXSymbolGetAtomicSymbolInfo doc tuple,
+    sourced from dmlc parameter registration; here the op signature IS
+    the parameter registration)."""
+    import inspect
+
+    from .symbol import symbol as _sym
+
+    reg = _sym._registry()
+    if op_name not in reg:
+        raise KeyError(f"unknown op {op_name!r}")
+    fn = reg[op_name]
+    doc = inspect.getdoc(fn) or ""
+    args = []
+    try:
+        for p in inspect.signature(fn).parameters.values():
+            if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                continue
+            entry = {"name": p.name}
+            if p.default is not p.empty:
+                entry["default"] = repr(p.default)
+            args.append(entry)
+    except (TypeError, ValueError):
+        pass
+    return json.dumps({"name": op_name, "description": doc, "args": args})

@@ -1161,3 +1161,181 @@ MXTPU_DLL int MXEngineSetBulkSize(int size, int *prev) {
   Py_DECREF(r);
   return 0;
 }
+
+/* ---- Symbol composition (reference c_api_symbolic.cc:
+ * MXSymbolCreateVariable, MXSymbolCreateAtomicSymbol, MXSymbolCompose,
+ * MXSymbolCreateGroup, MXSymbolCopy, attr get/set, GetAtomicSymbolInfo).
+ * A C frontend can BUILD a graph, not just load one. ---- */
+
+MXTPU_DLL int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked("symbol_variable",
+                                  Py_BuildValue("(s)", name));
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+/* keys/vals: the op's non-input parameters as strings ("64", "(2, 2)");
+ * inputs are bound later by MXSymbolCompose. */
+MXTPU_DLL int MXSymbolCreateAtomicSymbol(const char *op_name, int num_param,
+                                         const char **keys, const char **vals,
+                                         SymbolHandle *out) {
+  Gil gil;
+  PyObject *k = PyTuple_New(num_param), *v = PyTuple_New(num_param);
+  for (int i = 0; i < num_param; ++i) {
+    PyTuple_SetItem(k, i, PyUnicode_FromString(keys[i]));
+    PyTuple_SetItem(v, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *r = capi_call_checked(
+      "symbol_create_atomic",
+      Py_BuildValue("(sNNs)", op_name, k, v, ""));
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+/* Mutates sym in place (the reference contract). For an atomic symbol the
+ * args are the op's inputs (positional when keys is NULL); for a composed
+ * symbol they substitute free variables by name (keys required). */
+MXTPU_DLL int MXSymbolCompose(SymbolHandle sym, const char *name,
+                              int num_args, const char **keys,
+                              SymbolHandle *args) {
+  Gil gil;
+  PyObject *k = PyTuple_New(keys != nullptr ? num_args : 0);
+  PyObject *a = PyTuple_New(num_args);
+  for (int i = 0; i < num_args; ++i) {
+    if (keys != nullptr)
+      PyTuple_SetItem(k, i, PyUnicode_FromString(keys[i]));
+    PyObject *s = static_cast<PyObject *>(args[i]);
+    Py_INCREF(s);
+    PyTuple_SetItem(a, i, s);
+  }
+  PyObject *r = capi_call_checked(
+      "symbol_compose",
+      Py_BuildValue("(OsNN)", static_cast<PyObject *>(sym),
+                    name != nullptr ? name : "", k, a));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolCreateGroup(int num, SymbolHandle *symbols,
+                                  SymbolHandle *out) {
+  Gil gil;
+  PyObject *t = PyTuple_New(num);
+  for (int i = 0; i < num; ++i) {
+    PyObject *s = static_cast<PyObject *>(symbols[i]);
+    Py_INCREF(s);
+    PyTuple_SetItem(t, i, s);
+  }
+  PyObject *r = capi_call_checked("symbol_group", Py_BuildValue("(N)", t));
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_copy", Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolGetName(SymbolHandle sym, char *buf, int buf_len,
+                              int *needed) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_get_name", Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  int rc = copy_str(r, buf, buf_len, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+/* *success = 1 when the attr exists (missing attr is NOT an error,
+ * matching the reference). */
+MXTPU_DLL int MXSymbolGetAttr(SymbolHandle sym, const char *key, char *buf,
+                              int buf_len, int *needed, int *success) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_get_attr",
+      Py_BuildValue("(Os)", static_cast<PyObject *>(sym), key));
+  if (r == nullptr) return -1;
+  int found = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  if (success != nullptr) *success = found;
+  int rc = 0;
+  if (found != 0) rc = copy_str(PyTuple_GetItem(r, 1), buf, buf_len, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXSymbolSetAttr(SymbolHandle sym, const char *key,
+                              const char *value) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_set_attr",
+      Py_BuildValue("(Oss)", static_cast<PyObject *>(sym), key, value));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* JSON {node_name: {attr: value}} (reference MXSymbolListAttr triple). */
+MXTPU_DLL int MXSymbolListAttr(SymbolHandle sym, char *buf, int buf_len,
+                               int *needed) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_list_attr", Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  int rc = copy_str(r, buf, buf_len, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_get_internals",
+      Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolGetNumOutputs(SymbolHandle sym, int *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_num_outputs",
+      Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXSymbolGetOutput(SymbolHandle sym, int index,
+                                SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_get_output",
+      Py_BuildValue("(Oi)", static_cast<PyObject *>(sym), index));
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+/* JSON {name, description, args:[{name, default}]} — the doc tuple of the
+ * reference MXSymbolGetAtomicSymbolInfo, sourced from the live registry. */
+MXTPU_DLL int MXSymbolGetAtomicSymbolInfo(const char *op_name, char *buf,
+                                          int buf_len, int *needed) {
+  Gil gil;
+  PyObject *r = capi_call_checked("atomic_symbol_info",
+                                  Py_BuildValue("(s)", op_name));
+  if (r == nullptr) return -1;
+  int rc = copy_str(r, buf, buf_len, needed);
+  Py_DECREF(r);
+  return rc;
+}

@@ -4,6 +4,7 @@ an external-language frontend would: create arrays from raw buffers,
 invoke ops by name, autograd round trip, copy results back, error paths.
 """
 import ctypes
+import json
 import os
 import shutil
 import subprocess
@@ -118,6 +119,21 @@ def capi():
     lib.MXLibInfoFeatures.argtypes = [pp]
     lib.MXSymbolListAuxiliaryStates.argtypes = [p, pp]
     lib.MXEngineSetBulkSize.argtypes = [ctypes.c_int, ip]
+    # symbol composition (build a graph from C)
+    cpp = ctypes.POINTER(cp)
+    lib.MXSymbolCreateVariable.argtypes = [cp, pp]
+    lib.MXSymbolCreateAtomicSymbol.argtypes = [cp, ctypes.c_int, cpp, cpp, pp]
+    lib.MXSymbolCompose.argtypes = [p, cp, ctypes.c_int, cpp, pp]
+    lib.MXSymbolCreateGroup.argtypes = [ctypes.c_int, pp, pp]
+    lib.MXSymbolCopy.argtypes = [p, pp]
+    lib.MXSymbolGetName.argtypes = [p, cp, ctypes.c_int, ip]
+    lib.MXSymbolGetAttr.argtypes = [p, cp, cp, ctypes.c_int, ip, ip]
+    lib.MXSymbolSetAttr.argtypes = [p, cp, cp]
+    lib.MXSymbolListAttr.argtypes = [p, cp, ctypes.c_int, ip]
+    lib.MXSymbolGetInternals.argtypes = [p, pp]
+    lib.MXSymbolGetNumOutputs.argtypes = [p, ip]
+    lib.MXSymbolGetOutput.argtypes = [p, ctypes.c_int, pp]
+    lib.MXSymbolGetAtomicSymbolInfo.argtypes = [cp, cp, ctypes.c_int, ip]
     return lib
 
 
@@ -709,3 +725,272 @@ def test_backward_ex_null_head_grad_element(capi):
     assert _fetch(capi, g2, (1,))[0] == 6.0  # 2*b*1
     for h in (a, b, h1, h2, hg, g, g2):
         capi.MXNDArrayFree(h)
+
+
+# ---- symbol composition from C (reference c_api_symbolic.cc:
+#      MXSymbolCreateVariable / CreateAtomicSymbol / Compose / Group) ----
+
+def _strs(*items):
+    arr = (ctypes.c_char_p * len(items))(*[s.encode() for s in items])
+    return arr
+
+
+def test_symbol_compose_atomic(capi):
+    """Build relu(dot(x, w)) entirely through the C surface and check
+    arguments, outputs and inferred shapes."""
+    x = ctypes.c_void_p()
+    w = ctypes.c_void_p()
+    assert capi.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+    assert capi.MXSymbolCreateVariable(b"w", ctypes.byref(w)) == 0
+
+    dot = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"np.dot", 0, None, None, ctypes.byref(dot)) == 0
+    ins = (ctypes.c_void_p * 2)(x, w)
+    assert capi.MXSymbolCompose(dot, b"proj", 2, None, ins) == 0
+
+    act = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"npx.relu", 0, None, None, ctypes.byref(act)) == 0
+    one = (ctypes.c_void_p * 1)(dot)
+    assert capi.MXSymbolCompose(act, b"act", 1, None, one) == 0
+
+    assert _getstr(capi, capi.MXSymbolGetName, act) == "act"
+    args = ctypes.c_void_p()
+    assert capi.MXSymbolListArguments(act, ctypes.byref(args)) == 0
+    n = ctypes.c_int()
+    assert capi.MXListSize(args, ctypes.byref(n)) == 0
+    assert {_getstr(capi, capi.MXListGetString, args, i)
+            for i in range(n.value)} == {"x", "w"}
+    capi.MXListFree(args)
+
+    out = _getstr(capi, capi.MXSymbolInferShape, act,
+                  ctypes.c_char_p(b'{"x": [4, 3], "w": [3, 5]}'), size=8192)
+    assert json.loads(out)["out_shapes"] == [[4, 5]]
+
+    # compose with an out-of-registry op fails cleanly
+    bad = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"np.not_an_op", 0, None, None, ctypes.byref(bad)) == -1
+    assert b"unknown op" in capi.MXGetLastError()
+
+    for h in (act, dot, x, w):
+        capi.MXSymbolFree(h)
+
+
+def test_symbol_compose_kwargs_and_params(capi):
+    """Atomic-symbol params arrive as strings and compose binds inputs by
+    parameter name."""
+    data = ctypes.c_void_p()
+    wt = ctypes.c_void_p()
+    assert capi.MXSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+    assert capi.MXSymbolCreateVariable(b"wt", ctypes.byref(wt)) == 0
+
+    fc = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"npx.fully_connected", 2, _strs("num_hidden", "no_bias"),
+        _strs("7", "true"), ctypes.byref(fc)) == 0
+    ins = (ctypes.c_void_p * 2)(data, wt)
+    assert capi.MXSymbolCompose(fc, b"fc", 2, _strs("x", "weight"),
+                                ins) == 0
+
+    out = _getstr(capi, capi.MXSymbolInferShape, fc,
+                  ctypes.c_char_p(b'{"data": [2, 4], "wt": [7, 4]}'),
+                  size=8192)
+    assert json.loads(out)["out_shapes"] == [[2, 7]]
+    for h in (fc, data, wt):
+        capi.MXSymbolFree(h)
+
+
+def test_symbol_variable_substitution_compose(capi):
+    """Composing a non-atomic symbol substitutes free variables by name
+    (the reference net(data=prev) idiom through C)."""
+    a = ctypes.c_void_p()
+    b = ctypes.c_void_p()
+    assert capi.MXSymbolCreateVariable(b"a", ctypes.byref(a)) == 0
+    assert capi.MXSymbolCreateVariable(b"b", ctypes.byref(b)) == 0
+    add = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"np.add", 0, None, None, ctypes.byref(add)) == 0
+    ins = (ctypes.c_void_p * 2)(a, b)
+    assert capi.MXSymbolCompose(add, b"add", 2, None, ins) == 0
+
+    # substitute b := relu(c)
+    c = ctypes.c_void_p()
+    assert capi.MXSymbolCreateVariable(b"c", ctypes.byref(c)) == 0
+    act = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"npx.relu", 0, None, None, ctypes.byref(act)) == 0
+    one = (ctypes.c_void_p * 1)(c)
+    assert capi.MXSymbolCompose(act, b"act", 1, None, one) == 0
+
+    sub = (ctypes.c_void_p * 1)(act)
+    assert capi.MXSymbolCompose(add, b"", 1, _strs("b"), sub) == 0
+    args = ctypes.c_void_p()
+    assert capi.MXSymbolListArguments(add, ctypes.byref(args)) == 0
+    n = ctypes.c_int()
+    capi.MXListSize(args, ctypes.byref(n))
+    got = {_getstr(capi, capi.MXListGetString, args, i)
+           for i in range(n.value)}
+    assert got == {"a", "c"}
+    capi.MXListFree(args)
+    # substituting without keys is an error, not a crash
+    assert capi.MXSymbolCompose(add, b"", 1, None, sub) == -1
+    for h in (add, act, a, b, c):
+        capi.MXSymbolFree(h)
+
+
+def test_symbol_group_copy_attrs_outputs(capi):
+    x = ctypes.c_void_p()
+    assert capi.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+    s1 = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"npx.relu", 0, None, None, ctypes.byref(s1)) == 0
+    one = (ctypes.c_void_p * 1)(x)
+    assert capi.MXSymbolCompose(s1, b"r1", 1, None, one) == 0
+    s2 = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"npx.sigmoid", 0, None, None, ctypes.byref(s2)) == 0
+    assert capi.MXSymbolCompose(s2, b"s2", 1, None, one) == 0
+
+    grp = ctypes.c_void_p()
+    pair = (ctypes.c_void_p * 2)(s1, s2)
+    assert capi.MXSymbolCreateGroup(2, pair, ctypes.byref(grp)) == 0
+    n = ctypes.c_int()
+    assert capi.MXSymbolGetNumOutputs(grp, ctypes.byref(n)) == 0
+    assert n.value == 2
+    head = ctypes.c_void_p()
+    assert capi.MXSymbolGetOutput(grp, 1, ctypes.byref(head)) == 0
+    assert _getstr(capi, capi.MXSymbolGetName, head) == "s2"
+
+    # attrs: set, get (found flag), list, missing-is-not-an-error
+    assert capi.MXSymbolSetAttr(s1, b"__layout__", b"NCHW") == 0
+    buf = ctypes.create_string_buffer(256)
+    needed = ctypes.c_int()
+    found = ctypes.c_int()
+    assert capi.MXSymbolGetAttr(s1, b"__layout__", buf, 256,
+                                ctypes.byref(needed),
+                                ctypes.byref(found)) == 0
+    assert found.value == 1 and buf.value == b"NCHW"
+    assert capi.MXSymbolGetAttr(s1, b"nope", buf, 256, ctypes.byref(needed),
+                                ctypes.byref(found)) == 0
+    assert found.value == 0
+    attrs = json.loads(_getstr(capi, capi.MXSymbolListAttr, s1, size=4096))
+    assert attrs["r1"]["__layout__"] == "NCHW"
+
+    # deep copy is independent
+    cp = ctypes.c_void_p()
+    assert capi.MXSymbolCopy(s1, ctypes.byref(cp)) == 0
+    assert capi.MXSymbolSetAttr(cp, b"__layout__", b"NHWC") == 0
+    assert capi.MXSymbolGetAttr(s1, b"__layout__", buf, 256,
+                                ctypes.byref(needed),
+                                ctypes.byref(found)) == 0
+    assert buf.value == b"NCHW"
+
+    # internals exposes every node
+    internals = ctypes.c_void_p()
+    assert capi.MXSymbolGetInternals(s1, ctypes.byref(internals)) == 0
+    outs = ctypes.c_void_p()
+    assert capi.MXSymbolListOutputs(internals, ctypes.byref(outs)) == 0
+    capi.MXListSize(outs, ctypes.byref(n))
+    assert n.value >= 2  # x + r1 at least
+    capi.MXListFree(outs)
+
+    for h in (internals, cp, head, grp, s2, s1, x):
+        capi.MXSymbolFree(h)
+
+
+def test_atomic_symbol_info(capi):
+    info = json.loads(_getstr(
+        capi, capi.MXSymbolGetAtomicSymbolInfo,
+        ctypes.c_char_p(b"npx.fully_connected"), size=16384))
+    assert info["name"] == "npx.fully_connected"
+    names = [a["name"] for a in info["args"]]
+    assert "weight" in names and "num_hidden" in names
+    assert capi.MXSymbolGetAtomicSymbolInfo(
+        ctypes.c_char_p(b"np.nope"), None, 0, None) == -1
+
+
+def test_c_train_mlp_program(capi, tmp_path):
+    """Pure-C symbolic model building + training: the cpp-package
+    mlp.cpp workflow (Variable + FullyConnected + SimpleBind + SGD) with
+    no Python on the call path; asserts the loss collapses."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    exe = str(tmp_path / "train_mlp")
+    libdir = os.path.join(ROOT, "mxnet_tpu", "_lib")
+    subprocess.run(
+        ["gcc", "-O2", os.path.join(ROOT, "example/c_api/train_mlp.c"),
+         "-I", os.path.join(ROOT, "include"), "-o", exe,
+         "-L", libdir, "-lmxtpu_capi", f"-Wl,-rpath,{libdir}"], check=True)
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "PASS" in out.stdout
+
+
+def test_symbol_precompose_attrs_and_params(capi):
+    """Review findings: attrs set BEFORE compose must stick (reference
+    allows it), GetName on an un-composed atomic must not say 'grouped',
+    and reference-style param strings '(2,)' / 'None' must decode."""
+    pool = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"npx.relu", 0, None, None, ctypes.byref(pool)) == 0
+    assert _getstr(capi, capi.MXSymbolGetName, pool) == "relu"
+    assert capi.MXSymbolSetAttr(pool, b"__layout__", b"NCHW") == 0
+    buf = ctypes.create_string_buffer(64)
+    needed = ctypes.c_int()
+    found = ctypes.c_int()
+    assert capi.MXSymbolGetAttr(pool, b"__layout__", buf, 64,
+                                ctypes.byref(needed),
+                                ctypes.byref(found)) == 0
+    assert found.value == 1 and buf.value == b"NCHW"
+    # group/num-outputs on an un-composed atomic: clean error, not junk
+    n = ctypes.c_int()
+    assert capi.MXSymbolGetNumOutputs(pool, ctypes.byref(n)) == -1
+    assert b"MXSymbolCompose" in capi.MXGetLastError()
+    x = ctypes.c_void_p()
+    assert capi.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+    one = (ctypes.c_void_p * 1)(x)
+    assert capi.MXSymbolCompose(pool, b"r", 1, None, one) == 0
+    # the pre-compose attr landed on the composed node
+    attrs = json.loads(_getstr(capi, capi.MXSymbolListAttr, pool,
+                               size=4096))
+    assert attrs["r"]["__layout__"] == "NCHW"
+
+    # one-element tuple param decodes as a tuple, not the string "(2,)"
+    import mxnet_tpu._capi as pycapi
+
+    assert pycapi._parse_param("(2,)") == (2,)
+    assert pycapi._parse_param("None") is None
+    assert pycapi._parse_param("(2, 2)") == (2, 2)
+    assert pycapi._parse_param("nearest") == "nearest"
+    for h in (pool, x):
+        capi.MXSymbolFree(h)
+
+
+def test_symbol_substitution_compose_renames(capi):
+    """Review finding: the name argument must rename the composite in the
+    variable-substitution branch too."""
+    a = ctypes.c_void_p()
+    assert capi.MXSymbolCreateVariable(b"a", ctypes.byref(a)) == 0
+    act = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"npx.relu", 0, None, None, ctypes.byref(act)) == 0
+    one = (ctypes.c_void_p * 1)(a)
+    assert capi.MXSymbolCompose(act, b"act", 1, None, one) == 0
+    b_ = ctypes.c_void_p()
+    assert capi.MXSymbolCreateVariable(b"b", ctypes.byref(b_)) == 0
+    sub = (ctypes.c_void_p * 1)(b_)
+    assert capi.MXSymbolCompose(act, b"block1", 1, _strs("a"), sub) == 0
+    assert _getstr(capi, capi.MXSymbolGetName, act) == "block1"
+    args = ctypes.c_void_p()
+    assert capi.MXSymbolListArguments(act, ctypes.byref(args)) == 0
+    n = ctypes.c_int()
+    capi.MXListSize(args, ctypes.byref(n))
+    assert {_getstr(capi, capi.MXListGetString, args, i)
+            for i in range(n.value)} == {"b"}
+    capi.MXListFree(args)
+    for h in (act, a, b_):
+        capi.MXSymbolFree(h)
