@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""LLM benchmark: GPT-style causal-LM training tokens/s + MFU, and
+KV-cache decode tokens/s.
+
+The reference's transformer coverage stops at example-level scripts
+(example/gluon/word_language_model, the BERT pretraining path measured
+by train_bench.py); a decoder-only LM is the workload TPUs are bought
+for, so it gets a first-class harness: one number for the training-step
+token throughput of a GPT-2-small-class model (12L/768/12H, flash
+attention, bf16 compute over fp32 masters) with MFU against the chip's
+bf16 peak, and one for autoregressive decode through the KV cache.
+
+CLI:
+    python benchmark/llm_bench.py [--seq 1024] [--batch 8]
+        [--layers 12] [--units 768] [--decode-tokens 64] [--cpu]
+        [--output out.json]
+
+Prints one JSON object (the daemon banks it when device == "tpu"):
+  {"metric": "gpt_small_train_bs8_seq1024_bf16", "value": <tok/s>,
+   "unit": "tok/s", "mfu": ..., "decode_tok_s": ..., ...}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from bench import jaxpr_flops, peak_bf16_tflops  # noqa: E402
+
+
+def log(*a):
+    print("[llm_bench]", *a, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--units", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--decode-tokens", type=int, default=64)
+    ap.add_argument("--decode-batch", type=int, default=8)
+    ap.add_argument("--output", default=None)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import gpt_like
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    log("devices:", devs)
+
+    B, L = args.batch, args.seq
+    net = gpt_like(vocab_size=args.vocab, units=args.units,
+                   hidden_size=4 * args.units, num_layers=args.layers,
+                   num_heads=args.heads, max_length=max(2048, L),
+                   dropout=0.0)
+    net.initialize()
+    rng = onp.random.RandomState(0)
+    x_np = rng.randint(0, args.vocab, (B, L)).astype(onp.int32)
+    fn, params = net.functionalize(mx.np.array(x_np), training=True)
+    n_params = sum(int(v.size) for v in params.values())
+    log(f"params: {n_params/1e6:.1f}M")
+
+    # ---- KV-cache decode (FIRST: the train step donates the param
+    # buffers the live net shares, so decode after it would read deleted
+    # arrays) ----
+    DB, DT = args.decode_batch, args.decode_tokens
+    prompt = mx.np.array(rng.randint(0, args.vocab, (DB, 8)).astype("int32"))
+    decode_tok_s = None
+    try:
+        from mxnet_tpu.gluon.model_zoo.generation import generate
+
+        t0 = time.time()
+        out = generate(net, prompt, max_new_tokens=DT, max_length=256)
+        out.asnumpy()
+        log(f"decode compiled+ran in {time.time() - t0:.1f}s")
+        t0 = time.perf_counter()
+        out = generate(net, prompt, max_new_tokens=DT, max_length=256)
+        out.asnumpy()
+        d_dt = time.perf_counter() - t0
+        decode_tok_s = DB * DT / d_dt
+        log(f"decode: {decode_tok_s:.1f} tok/s (bs {DB})")
+    except Exception as e:  # noqa: BLE001 — decode is a secondary number
+        log(f"decode bench failed: {e!r}")
+
+    momentum, lr = 0.9, 0.01
+    velocity = {k: jnp.zeros_like(v) for k, v in params.items()
+                if v.dtype == jnp.float32}
+
+    def loss_fn(p, x, key):
+        # bf16 compute over fp32 masters (cpu: fp32 straight through —
+        # bf16 is emulated there and would blow the watchdog)
+        if platform != "cpu":
+            pc = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
+                  for k, v in p.items()}
+        else:
+            pc = p
+        out, _ = fn(pc, x, key=key)
+        logits = out.astype(jnp.float32)
+        # next-token LM loss over L-1 positions
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        nll = -jnp.take_along_axis(logp, x[:, 1:, None], axis=-1).mean()
+        return nll
+
+    def train_step(p, vel, x, key):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, key)
+        new_p, new_v = dict(p), dict(vel)
+        for k in vel:
+            v2 = momentum * vel[k] + grads[k].astype(jnp.float32)
+            new_v[k] = v2
+            new_p[k] = p[k] - lr * v2
+        return loss, new_p, new_v
+
+    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    x = jnp.asarray(x_np)
+    key = jax.random.PRNGKey(0)
+
+    t0 = time.time()
+    loss, params2, velocity2 = jstep(params, velocity, x, key)
+    float(loss)
+    log(f"train step compiled in {time.time() - t0:.1f}s, "
+        f"loss {float(loss):.3f}")
+
+    # timed loop (serial chain through donated params)
+    t0 = time.perf_counter()
+    loss, params2, velocity2 = jstep(params2, velocity2, x, key)
+    float(loss)
+    per = max(time.perf_counter() - t0, 1e-4)
+    iters = max(3, min(100, int(8.0 / per)))
+    total, dt = 0, 0.0
+    while dt < 8.0 and total < 1000:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss, params2, velocity2 = jstep(params2, velocity2, x, key)
+        float(loss)
+        dt += time.perf_counter() - t0
+        total += iters
+    tok_s = B * L * total / dt
+    log(f"train: {tok_s:.0f} tok/s over {total} steps ({dt:.1f}s)")
+
+    # FLOPs for MFU: XLA cost analysis, else jaxpr MAC walk, else the
+    # 6*N*T analytic estimate (scaling-book rule; dense-only, no attn term)
+    step_flops = None
+    src = None
+    try:
+        lowered = jax.jit(train_step).lower(params2, velocity2, x, key)
+        try:
+            ca = lowered.cost_analysis()
+        except Exception:  # noqa: BLE001
+            ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        if ca and ca.get("flops"):
+            step_flops, src = float(ca["flops"]), "xla_cost_analysis"
+    except Exception as e:  # noqa: BLE001
+        log(f"cost_analysis unavailable: {e!r}")
+    if not step_flops:
+        try:
+            step_flops = jaxpr_flops(train_step, params2, velocity2, x, key)
+            src = "jaxpr_walk"
+        except Exception as e:  # noqa: BLE001
+            log(f"jaxpr flop walk failed: {e!r}")
+    if not step_flops:
+        step_flops, src = 6.0 * n_params * B * L, "analytic_6NT"
+    log(f"step flops {step_flops/1e12:.2f} TF ({src})")
+
+    dev_kind = getattr(devs[0], "device_kind", "")
+    rec = {
+        "metric": f"gpt_small_train_bs{B}_seq{L}_"
+                  + ("fp32" if platform == "cpu" else "bf16"),
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "params_m": round(n_params / 1e6, 1),
+        "train_steps": total,
+        "device": platform,
+        "device_kind": dev_kind,
+        "flops_per_step": step_flops,
+        "flops_source": src,
+    }
+    if decode_tok_s:
+        rec["decode_tok_s"] = round(decode_tok_s, 1)
+        rec["decode_batch"] = DB
+    achieved = tok_s / (B * L) * step_flops / 1e12
+    rec["achieved_tflops"] = round(achieved, 2)
+    peak = peak_bf16_tflops(dev_kind)
+    if peak and platform != "cpu":
+        rec["peak_bf16_tflops"] = peak
+        rec["mfu"] = round(achieved / peak, 4)
+    text = json.dumps(rec)
+    print(text, flush=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,9 @@ atomically banks the results where ``bench.py`` can serve them later:
   benchmark/results_parity_tpu.json   numpy-oracle correctness of the
                                       curated op set on real TPU
                                       (tools/device_parity.py)
+  benchmark/results_llm_tpu.json      GPT-2-small-class causal LM train
+                                      tokens/s + MFU and KV-cache decode
+                                      tokens/s (llm_bench.py)
   benchmark/results_hbm_tpu.json      single-chip HBM bandwidth probe
 
 Each child measurement runs via the existing harnesses' child modes, so
@@ -52,6 +55,7 @@ OPPERF = os.path.join(HERE, "opperf", "results_tpu.json")
 HBM = os.path.join(HERE, "results_hbm_tpu.json")
 ATTENTION = os.path.join(HERE, "results_attention_tpu.json")
 PARITY = os.path.join(HERE, "results_parity_tpu.json")
+LLM = os.path.join(HERE, "results_llm_tpu.json")
 
 PROBE_INTERVAL_S = 180       # while the tunnel is down
 REFRESH_INTERVAL_S = 3600    # after a full successful suite
@@ -295,6 +299,19 @@ def capture_parity() -> None:
                if rec.get("backend_errors") else ""))
 
 
+def capture_llm() -> None:
+    """GPT-2-small-class causal LM: training tokens/s + MFU and KV-cache
+    decode tokens/s (benchmark/llm_bench.py) — the transformer headline
+    next to the ResNet one."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "llm_bench.py")],
+        timeout=1800)
+    rec = parse_json_output(out)
+    if bank_if_tpu(LLM, rec, rc, "llm bench") and rec:
+        log(f"llm: {rec.get('value')} tok/s train, "
+            f"mfu={rec.get('mfu')}, decode {rec.get('decode_tok_s')} tok/s")
+
+
 def capture_hbm() -> None:
     """Single-chip HBM bandwidth probe (the one comm number measurable on
     one chip; ICI bandwidth needs >1 — tools/bandwidth covers the mesh
@@ -367,6 +384,7 @@ def main() -> None:
                 # live bench.py isn't starved by hourly re-measurement
                 for path, cap in ((PARITY, capture_parity),
                                   (TRAIN, capture_train),
+                                  (LLM, capture_llm),
                                   (OPPERF, capture_opperf),
                                   (ATTENTION, capture_attention),
                                   (HBM, capture_hbm)):

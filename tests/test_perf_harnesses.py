@@ -147,3 +147,27 @@ def test_device_parity_sweep():
     assert out.returncode == 0, out.stderr[-2000:]
     rec = parse_json_output(out.stdout)
     assert rec["failed"] == [] and rec["passed"] == rec["total"] >= 30
+
+
+def test_llm_bench_tiny(tmp_path):
+    """llm_bench end-to-end on a tiny config: schema contract the daemon
+    banks (value/unit/mfu fields, decode tokens/s)."""
+    import json
+    import subprocess
+    import sys
+
+    out_file = str(tmp_path / "llm.json")
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmark", "llm_bench.py"),
+         "--cpu", "--seq", "64", "--batch", "2", "--layers", "1",
+         "--units", "64", "--heads", "2", "--vocab", "256",
+         "--decode-tokens", "4", "--decode-batch", "1",
+         "--output", out_file],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(open(out_file).read())
+    assert rec["unit"] == "tok/s" and rec["value"] > 0
+    assert rec["params_m"] > 0 and rec["flops_per_step"] > 0
+    assert rec["device"] == "cpu"  # forced; daemon only banks tpu records
+    assert rec.get("decode_tok_s", 0) > 0
