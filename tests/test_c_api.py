@@ -994,3 +994,28 @@ def test_symbol_substitution_compose_renames(capi):
     capi.MXListFree(args)
     for h in (act, a, b_):
         capi.MXSymbolFree(h)
+
+
+def test_c_api_parity_doc():
+    """The generated C-API parity table (docs/c_api_parity.md) must stay
+    in sync: every reference function classified, every 'provided' row
+    actually present in include/mxtpu_c_api.h."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "gen_c_api_parity",
+        os.path.join(ROOT, "tools", "gen_c_api_parity.py"))
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+
+    ours = gen.our_functions()
+    doc = open(os.path.join(ROOT, "docs", "c_api_parity.md")).read()
+    ref = set(gen.REF_C_API) | set(gen.REF_PREDICT_API)
+    assert len(ref) == 273
+    for name in ref:
+        assert f"`{name}`" in doc, f"{name} missing from parity doc"
+        status, _ = gen.classify(name, ours)  # raises on unclassified
+        if status == "provided":
+            assert name in ours
+    # the doc's provided-count matches the real intersection
+    assert f"| provided | {len(ref & ours)} |" in doc
