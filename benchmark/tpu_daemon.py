@@ -382,6 +382,7 @@ def main() -> None:
                 # secondary captures keep the chip busy for a long time —
                 # only (re)run the stale/missing ones, so a driver-run
                 # live bench.py isn't starved by hourly re-measurement
+                aborted = False
                 for path, cap in ((PARITY, capture_parity),
                                   (TRAIN, capture_train),
                                   (LLM, capture_llm),
@@ -391,14 +392,20 @@ def main() -> None:
                     if ok == "banked" or not fresh(path):
                         if live_lock.held_by_live_process():
                             log("live bench arrived; pausing captures")
+                            aborted = True
                             break
                         if not tpu_alive():
                             log("tunnel down mid-pass; abandoning "
                                 "remaining captures until next probe")
+                            aborted = True
                             break
                         cap()
-                log(f"suite pass done; refresh in {REFRESH_INTERVAL_S}s")
-                time.sleep(REFRESH_INTERVAL_S)
+                # an aborted pass left artifacts unbanked — go back to
+                # fast probing instead of sleeping out the refresh hour
+                wait = PROBE_INTERVAL_S if aborted else REFRESH_INTERVAL_S
+                log(f"suite pass {'aborted' if aborted else 'done'}; "
+                    f"next probe in {wait}s")
+                time.sleep(wait)
             else:
                 time.sleep(PROBE_INTERVAL_S)
     finally:

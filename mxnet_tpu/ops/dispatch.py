@@ -281,12 +281,20 @@ def backward(
         slots = [cots.get((idx, s)) for s in range(node.n_out)]
         if all(s is None for s in slots):
             continue
-        full = tuple(
-            s
-            if s is not None
-            else jnp.zeros(node.out_avals[i][0], node.out_avals[i][1])
-            for i, s in enumerate(slots)
-        )
+        def _slot_ct(i, s):
+            if s is None:
+                return jnp.zeros(node.out_avals[i][0], node.out_avals[i][1])
+            # a downstream op may produce its input-cotangent in a wider
+            # dtype than this node's output (e.g. AMP: a bf16 matmul
+            # feeding an fp32-list reduction) — jax.vjp is strict about
+            # cotangent dtypes, so cast to the recorded output aval
+            want = node.out_avals[i][1]
+            if not isinstance(s, RowSparseNDArray) and \
+                    getattr(s, "dtype", want) != want:
+                s = s.astype(want)
+            return s
+
+        full = tuple(_slot_ct(i, s) for i, s in enumerate(slots))
         in_cts = node.vjp_fn(full[0] if node.n_out == 1 else full)
         for arr, ct in zip(node.inputs, in_cts):
             _route(arr, ct)

@@ -155,3 +155,28 @@ def test_unscale_keeps_dynamic_scaling():
 def test_init_rejects_bad_dtype():
     with pytest.raises(mx.MXNetError):
         amp.init("int8")
+
+
+def test_amp_backward_through_fp32_reduction():
+    """Regression: a bf16 op feeding an fp32-list op (e.g. dense -> sum)
+    produced a float32 cotangent for the bf16 producer's vjp; the tape
+    must cast slot cotangents to each node's recorded output dtype."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, np
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = np.random.uniform(size=(2, 8))
+    mx.amp.init()
+    try:
+        with autograd.record():
+            loss = net(x).sum()
+        assert loss.dtype == "float32"  # reductions run in fp32 under AMP
+        loss.backward()
+    finally:
+        mx.amp.disable()
+    g = net[0].weight.grad
+    g = g() if callable(g) else g
+    assert g.dtype == "float32"  # master-precision grads
+    assert bool(np.isfinite(g).all()) and float(np.abs(g).sum()) > 0

@@ -102,3 +102,68 @@ def test_export_without_forward_raises(tmp_path):
     sym, params = net.export(str(tmp_path / "m2"),
                              example_args=(np.ones((1, 8)),))
     assert os.path.exists(sym) and os.path.exists(params)
+
+
+# ---- backwards compatibility: the COMMITTED artifact must keep loading
+#      (reference tests/nightly/model_backwards_compatibility_check) ----
+
+COMPAT = os.path.join(os.path.dirname(__file__), "golden", "compat")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_committed_artifact_symbolblock():
+    """tests/golden/compat/ was exported once and committed; the durable
+    format (StableHLO envelope + .params) must load bit-compatibly in
+    every future version."""
+    import numpy as onp
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu import np as mxnp
+
+    x = mxnp.array(onp.load(os.path.join(COMPAT, "input.npy")))
+    golden = onp.load(os.path.join(COMPAT, "golden.npy"))
+    net = gluon.SymbolBlock.imports(
+        os.path.join(COMPAT, "mlp-symbol.json"),
+        param_file=os.path.join(COMPAT, "mlp-0000.params"))
+    out = onp.asarray(net(x))
+    onp.testing.assert_allclose(out, golden, rtol=1e-5, atol=1e-5)
+
+
+def test_committed_artifact_c_predict():
+    """The same committed artifact through the C ABI predict layer."""
+    import ctypes
+    import shutil
+
+    import numpy as onp
+
+    lib_path = os.path.join(ROOT, "mxnet_tpu", "_lib", "libmxtpu_capi.so")
+    if not os.path.exists(lib_path):
+        if shutil.which("g++") is None:
+            pytest.skip("no g++ and no prebuilt libmxtpu_capi.so")
+        import subprocess
+
+        subprocess.run(["make", "capi"], cwd=os.path.join(ROOT, "src"),
+                       check=True, stdout=subprocess.DEVNULL)
+    lib = ctypes.CDLL(lib_path)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    pred = ctypes.c_void_p()
+    rc = lib.MXPredCreate(
+        os.path.join(COMPAT, "mlp-symbol.json").encode(),
+        os.path.join(COMPAT, "mlp-0000.params").encode(),
+        1, 0, ctypes.byref(pred))
+    assert rc == 0, lib.MXGetLastError()
+    x = onp.load(os.path.join(COMPAT, "input.npy")).astype(onp.float32)
+    golden = onp.load(os.path.join(COMPAT, "golden.npy"))
+    rc = lib.MXPredSetInput(pred, b"data",
+                            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                            ctypes.c_size_t(x.size))
+    assert rc == 0, lib.MXGetLastError()
+    assert lib.MXPredForward(pred) == 0
+    out = onp.empty(golden.shape, onp.float32)
+    rc = lib.MXPredGetOutput(pred, 0,
+                             out.ctypes.data_as(
+                                 ctypes.POINTER(ctypes.c_float)),
+                             ctypes.c_size_t(out.size))
+    assert rc == 0, lib.MXGetLastError()
+    lib.MXPredFree(pred)
+    onp.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-4)

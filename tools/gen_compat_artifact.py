@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Generate tests/golden/compat/ — a committed durable export + golden
+logits that future versions must keep loading bit-exactly.
+
+The reference ran `model_backwards_compatibility_check/` nightly: models
+saved by OLD versions must load in the current one. Here the durable
+format is the StableHLO envelope + .params pair; this script freezes one
+small artifact in-tree. tests/test_export.py::test_committed_artifact_*
+loads it (python SymbolBlock AND the pure-C predict path) and checks the
+logits against golden.npy — if the loader or wire format drifts
+incompatibly, the suite fails.
+
+Run ONCE (artifact is committed; rerunning after a deliberate format
+break is the documented migration step):
+    python tools/gen_compat_artifact.py
+"""
+import json
+import os
+import sys
+
+import numpy as onp
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, "tests", "golden", "compat")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    os.makedirs(OUT, exist_ok=True)
+    rs = onp.random.RandomState(20260731)
+    net = nn.HybridSequential(nn.Dense(8, activation="relu", in_units=4),
+                              nn.Dense(3, in_units=8))
+    net.initialize()
+    # deterministic weights (initialize() seeds from test/ambient rng)
+    for i, layer in enumerate((net[0], net[1])):
+        layer.weight.set_data(mx.np.array(
+            rs.randn(*layer.weight.shape).astype(onp.float32) * 0.3))
+        layer.bias.set_data(mx.np.array(
+            rs.randn(*layer.bias.shape).astype(onp.float32) * 0.1))
+    net.hybridize()
+    x = mx.np.array(rs.randn(2, 4).astype(onp.float32))
+    logits = net(x)
+
+    prefix = os.path.join(OUT, "mlp")
+    net.export(prefix, example_args=(x,))
+    onp.save(os.path.join(OUT, "input.npy"), onp.asarray(x))
+    onp.save(os.path.join(OUT, "golden.npy"), onp.asarray(logits))
+    meta = {
+        "generated_by": "tools/gen_compat_artifact.py",
+        "format": "StableHLO envelope (mlp-symbol.json) + mlp-0000.params",
+        "contract": "load via gluon.SymbolBlock.imports OR MXPredCreate; "
+                    "logits on input.npy must match golden.npy to 1e-5",
+    }
+    with open(os.path.join(OUT, "META.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    print("wrote", OUT, "logits:", onp.asarray(logits).tolist())
+
+
+if __name__ == "__main__":
+    main()
