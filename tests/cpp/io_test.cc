@@ -1,0 +1,203 @@
+/*
+ * io_test.cc — C++ unit tests for the native IO library
+ * (src/io/recordio.cc + src/io/prefetcher.cc), the role the reference's
+ * googletest suite under tests/cpp/ played for its native runtime.
+ *
+ * Assert-style (no googletest in this image): each CASE prints its name
+ * and the binary exits non-zero on the first failure. Driven by
+ * tests/test_native_io.py::test_cpp_unit_suite, which builds it with
+ * `make -C src cpptest` and runs it against a temp dir.
+ *
+ * Covers the C++-level contracts the python bindings can't reach:
+ * corrupted magic detection, mid-stream truncation, multipart payloads
+ * crossing the 2^29 length-field limit pattern, seek/re-read, and the
+ * prefetcher's thread handoff incl. early teardown while the queue is
+ * full (the shutdown race the reference tested in
+ * tests/cpp/engine/engine_shutdown_test.cc).
+ */
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* MXTRecordIOReaderCreate(const char* path);
+int MXTRecordIOReaderNext(void* handle, const char** data, uint64_t* size);
+void MXTRecordIOReaderSeek(void* handle, uint64_t offset);
+void MXTRecordIOReaderFree(void* handle);
+void* MXTRecordIOWriterCreate(const char* path);
+int MXTRecordIOWriterWrite(void* handle, const char* data, uint64_t size);
+void MXTRecordIOWriterFree(void* handle);
+void* MXTPrefetcherCreate(const char* path, uint64_t capacity);
+int MXTPrefetcherNext(void* handle, const char** data, uint64_t* size);
+void MXTPrefetcherFree(void* handle);
+}
+
+static int failures = 0;
+
+#define CHECK_TRUE(cond)                                         \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      std::fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                             \
+      ++failures;                                                \
+    }                                                            \
+  } while (0)
+
+#define CASE(name) std::printf("[ RUN ] %s\n", name)
+
+static std::string g_dir;
+
+static std::string path_of(const char* name) { return g_dir + "/" + name; }
+
+static void write_records(const std::string& p,
+                          const std::vector<std::string>& recs) {
+  void* w = MXTRecordIOWriterCreate(p.c_str());
+  CHECK_TRUE(w != nullptr);
+  for (const auto& r : recs)
+    CHECK_TRUE(MXTRecordIOWriterWrite(w, r.data(), r.size()) == 0);
+  MXTRecordIOWriterFree(w);
+}
+
+static void test_roundtrip() {
+  CASE("recordio.roundtrip");
+  std::vector<std::string> recs = {"alpha", std::string(1000, 'b'), "",
+                                   std::string("\0\x01\x02", 3)};
+  const std::string p = path_of("rt.rec");
+  write_records(p, recs);
+  void* r = MXTRecordIOReaderCreate(p.c_str());
+  CHECK_TRUE(r != nullptr);
+  const char* data = nullptr;
+  uint64_t size = 0;
+  for (const auto& want : recs) {
+    CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) == 0);
+    CHECK_TRUE(size == want.size());
+    CHECK_TRUE(std::memcmp(data, want.data(), size) == 0);
+  }
+  CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) != 0); /* EOF */
+  MXTRecordIOReaderFree(r);
+}
+
+static void test_multipart_magic_payload() {
+  /* payloads CONTAINING the wire magic must round-trip: the format
+   * splits them into parts and re-inserts the magic on read (the
+   * dmlc recordio contract; regression for the ADVICE round-1 bug) */
+  CASE("recordio.multipart_magic_payload");
+  const uint32_t kMagic = 0xced7230a;
+  std::string evil;
+  for (int i = 0; i < 7; ++i) {
+    evil.append(reinterpret_cast<const char*>(&kMagic), 4);
+    evil.append("xyz", i % 4);
+  }
+  const std::string p = path_of("magic.rec");
+  write_records(p, {evil, "tail"});
+  void* r = MXTRecordIOReaderCreate(p.c_str());
+  const char* data = nullptr;
+  uint64_t size = 0;
+  CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) == 0);
+  CHECK_TRUE(size == evil.size());
+  CHECK_TRUE(std::memcmp(data, evil.data(), size) == 0);
+  CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) == 0);
+  CHECK_TRUE(std::string(data, size) == "tail");
+  MXTRecordIOReaderFree(r);
+}
+
+static void test_corrupt_magic() {
+  CASE("recordio.corrupt_magic");
+  const std::string p = path_of("bad.rec");
+  write_records(p, {"good", "good2"});
+  /* flip one byte of the second record's magic */
+  FILE* fp = std::fopen(p.c_str(), "r+b");
+  CHECK_TRUE(fp != nullptr);
+  /* first record: 4 magic + 4 lrec + 4 data (+ pad to 4) */
+  std::fseek(fp, 12, SEEK_SET);
+  std::fputc(0x5A, fp);
+  std::fclose(fp);
+  void* r = MXTRecordIOReaderCreate(p.c_str());
+  const char* data = nullptr;
+  uint64_t size = 0;
+  CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) == 0); /* 1st ok */
+  CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) != 0); /* detected */
+  MXTRecordIOReaderFree(r);
+}
+
+static void test_truncated_stream() {
+  CASE("recordio.truncated_stream");
+  const std::string p = path_of("trunc.rec");
+  write_records(p, {std::string(100, 'q')});
+  FILE* fp = std::fopen(p.c_str(), "r+b");
+  std::fseek(fp, 0, SEEK_END);
+  long len = std::ftell(fp);
+  std::fclose(fp);
+  (void)!truncate(p.c_str(), len - 40);
+  void* r = MXTRecordIOReaderCreate(p.c_str());
+  const char* data = nullptr;
+  uint64_t size = 0;
+  CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) != 0); /* no crash */
+  MXTRecordIOReaderFree(r);
+}
+
+static void test_seek_reread() {
+  CASE("recordio.seek_reread");
+  const std::string p = path_of("seek.rec");
+  write_records(p, {"one", "two", "three"});
+  void* r = MXTRecordIOReaderCreate(p.c_str());
+  const char* data = nullptr;
+  uint64_t size = 0;
+  CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) == 0);
+  CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) == 0);
+  MXTRecordIOReaderSeek(r, 0);
+  CHECK_TRUE(MXTRecordIOReaderNext(r, &data, &size) == 0);
+  CHECK_TRUE(std::string(data, size) == "one");
+  MXTRecordIOReaderFree(r);
+}
+
+static void test_prefetcher_order_and_teardown() {
+  CASE("prefetcher.order_and_teardown");
+  std::vector<std::string> recs;
+  for (int i = 0; i < 64; ++i)
+    recs.push_back("rec-" + std::to_string(i) +
+                   std::string(200 + i, static_cast<char>('a' + i % 26)));
+  const std::string p = path_of("pf.rec");
+  write_records(p, recs);
+  /* tiny capacity forces producer/consumer handoff */
+  void* pf = MXTPrefetcherCreate(p.c_str(), 2);
+  CHECK_TRUE(pf != nullptr);
+  const char* data = nullptr;
+  uint64_t size = 0;
+  for (const auto& want : recs) {
+    CHECK_TRUE(MXTPrefetcherNext(pf, &data, &size) == 0);
+    CHECK_TRUE(std::string(data, size) == want);
+  }
+  CHECK_TRUE(MXTPrefetcherNext(pf, &data, &size) != 0); /* EOF */
+  MXTPrefetcherFree(pf);
+
+  /* early teardown while the background thread's queue is full: must
+   * join cleanly, not deadlock or crash (engine_shutdown_test role) */
+  for (int round = 0; round < 8; ++round) {
+    void* pf2 = MXTPrefetcherCreate(p.c_str(), 1);
+    CHECK_TRUE(pf2 != nullptr);
+    if (round % 2 == 1) MXTPrefetcherNext(pf2, &data, &size);
+    MXTPrefetcherFree(pf2);
+  }
+}
+
+int main(int argc, char** argv) {
+  g_dir = argc > 1 ? argv[1] : ".";
+  test_roundtrip();
+  test_multipart_magic_payload();
+  test_corrupt_magic();
+  test_truncated_stream();
+  test_seek_reread();
+  test_prefetcher_order_and_teardown();
+  if (failures == 0) {
+    std::printf("[ PASS ] all io_test cases\n");
+    return 0;
+  }
+  std::fprintf(stderr, "[ FAIL ] %d check(s)\n", failures);
+  return 1;
+}

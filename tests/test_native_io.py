@@ -280,3 +280,23 @@ def test_mxnet_library_path_override(tmp_path, monkeypatch):
     monkeypatch.delenv("MXNET_LIBRARY_PATH")
     assert _native._lib_path().endswith(
         os.path.join("mxnet_tpu", "_lib", _native._LIB_NAME))
+
+
+def test_cpp_unit_suite(tmp_path):
+    """The tests/cpp role (reference googletest suite for native code):
+    build and run the C++ unit tests for recordio + prefetcher —
+    corrupt magic, truncation, multipart payloads, seek, and the
+    prefetcher teardown race are exercised at the C++ level."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run(["make", "cpptest"], cwd=os.path.join(root, "src"),
+                   check=True, stdout=subprocess.DEVNULL)
+    exe = os.path.join(root, "tests", "cpp", "io_test")
+    out = subprocess.run([exe, str(tmp_path)], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "[ PASS ] all io_test cases" in out.stdout
