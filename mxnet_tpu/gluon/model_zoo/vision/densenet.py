@@ -6,7 +6,8 @@ from .... import numpy as _np
 from ... import nn
 from ...block import HybridBlock
 
-__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201"]
+__all__ = ["DenseNet", "get_densenet", "densenet121", "densenet161",
+           "densenet169", "densenet201"]
 
 
 class _DenseBlockLayer(HybridBlock):

@@ -146,3 +146,24 @@ def test_model_store_keeps_user_supplied_weights(tmp_path):
     assert p2 == p
     assert model_store._file_sha256(p2) == sha_user  # NOT regenerated
     assert any("user-supplied" in str(x.message) for x in w)
+
+
+def test_vision_zoo_surface_complete():
+    """Every public builder the reference's gluon model_zoo.vision
+    exposes (42 names: all variants of the 7 families + the get_*
+    parameterized builders) must exist here."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    ref = """
+    alexnet densenet121 densenet161 densenet169 densenet201 get_densenet
+    get_mobilenet get_mobilenet_v2 get_model get_resnet get_squeezenet
+    get_vgg inception_v3 mobilenet0_25 mobilenet0_5 mobilenet0_75
+    mobilenet1_0 mobilenet_v2_0_25 mobilenet_v2_0_5 mobilenet_v2_0_75
+    mobilenet_v2_1_0 resnet101_v1 resnet101_v2 resnet152_v1 resnet152_v2
+    resnet18_v1 resnet18_v2 resnet34_v1 resnet34_v2 resnet50_v1
+    resnet50_v2 squeezenet1_0 squeezenet1_1 vgg11 vgg11_bn vgg13
+    vgg13_bn vgg16 vgg16_bn vgg19 vgg19_bn
+    """.split()
+    missing = [n for n in ref
+               if not callable(getattr(vision, n, None))]
+    assert not missing, f"missing zoo builders: {missing}"
