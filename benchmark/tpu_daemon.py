@@ -56,6 +56,7 @@ HBM = os.path.join(HERE, "results_hbm_tpu.json")
 ATTENTION = os.path.join(HERE, "results_attention_tpu.json")
 PARITY = os.path.join(HERE, "results_parity_tpu.json")
 LLM = os.path.join(HERE, "results_llm_tpu.json")
+QUANT = os.path.join(HERE, "results_quant_tpu.json")
 
 PROBE_INTERVAL_S = 180       # while the tunnel is down
 REFRESH_INTERVAL_S = 3600    # after a full successful suite
@@ -312,6 +313,18 @@ def capture_llm() -> None:
             f"mfu={rec.get('mfu')}, decode {rec.get('decode_tok_s')} tok/s")
 
 
+def capture_quant() -> None:
+    """INT8 PTQ ResNet-50: quantized throughput + top-1 agreement
+    (benchmark/quant_bench.py) — int8 MXU has 2x the bf16 peak."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "quant_bench.py")],
+        timeout=1800)
+    rec = parse_json_output(out)
+    if bank_if_tpu(QUANT, rec, rc, "quant bench") and rec:
+        log(f"quant: {rec.get('int8_img_s')} img/s int8, "
+            f"agreement {rec.get('top1_agreement')}")
+
+
 def capture_hbm() -> None:
     """Single-chip HBM bandwidth probe (the one comm number measurable on
     one chip; ICI bandwidth needs >1 — tools/bandwidth covers the mesh
@@ -386,6 +399,7 @@ def main() -> None:
                 for path, cap in ((PARITY, capture_parity),
                                   (TRAIN, capture_train),
                                   (LLM, capture_llm),
+                                  (QUANT, capture_quant),
                                   (OPPERF, capture_opperf),
                                   (ATTENTION, capture_attention),
                                   (HBM, capture_hbm)):
