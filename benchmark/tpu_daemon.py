@@ -57,6 +57,7 @@ ATTENTION = os.path.join(HERE, "results_attention_tpu.json")
 PARITY = os.path.join(HERE, "results_parity_tpu.json")
 LLM = os.path.join(HERE, "results_llm_tpu.json")
 QUANT = os.path.join(HERE, "results_quant_tpu.json")
+BS256 = os.path.join(HERE, "results_bench_tpu_bs256.json")
 
 PROBE_INTERVAL_S = 180       # while the tunnel is down
 REFRESH_INTERVAL_S = 3600    # after a full successful suite
@@ -313,6 +314,19 @@ def capture_llm() -> None:
             f"mfu={rec.get('mfu')}, decode {rec.get('decode_tok_s')} tok/s")
 
 
+def capture_bs256() -> None:
+    """Supplemental large-batch headline: bs256 inference, where the
+    serial-chain protocol is MXU-bound rather than launch-bound — the
+    'don't stop at parity' exhibit next to the bs32 contract number."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--child", "tpu",
+         "256"],
+        timeout=1200)
+    rec = parse_json_output(out)
+    if bank_if_tpu(BS256, rec, rc, "bs256 headline") and rec:
+        log(f"bs256: {rec.get('value')} img/s bf16, mfu={rec.get('mfu')}")
+
+
 def capture_quant() -> None:
     """INT8 PTQ ResNet-50: quantized throughput + top-1 agreement
     (benchmark/quant_bench.py) — int8 MXU has 2x the bf16 peak."""
@@ -399,6 +413,7 @@ def main() -> None:
                 for path, cap in ((PARITY, capture_parity),
                                   (TRAIN, capture_train),
                                   (LLM, capture_llm),
+                                  (BS256, capture_bs256),
                                   (QUANT, capture_quant),
                                   (OPPERF, capture_opperf),
                                   (ATTENTION, capture_attention),
