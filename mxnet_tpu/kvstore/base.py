@@ -49,3 +49,12 @@ class KVStoreBase:
     @property
     def num_workers(self) -> int:
         raise NotImplementedError
+
+    def barrier(self) -> None:
+        """Block until every worker reached this point (reference
+        ``KVStore.barrier`` → ps-lite Barrier). Single-process stores
+        return immediately; multi-process stores sync over the
+        jax.distributed control plane."""
+        from ..parallel.collectives import barrier as _host_barrier
+
+        _host_barrier("mx_kvstore_barrier")

@@ -255,6 +255,18 @@ class ndarray:
         out = _wrap(self._data)
         return out
 
+    @property
+    def stype(self) -> str:
+        """Storage type (reference ``NDArray.stype``): dense arrays are
+        ``"default"``; RowSparseNDArray/CSRNDArray override."""
+        return "default"
+
+    def check_format(self, full_check: bool = True) -> None:
+        """Validate storage-format integrity (reference
+        ``NDArray.check_format`` / ``MXNDArraySyncCheckFormat``). Dense
+        arrays are always well-formed; the sparse classes override with
+        real index checks."""
+
     def backward(self, out_grad=None, retain_graph=False, train_mode=True) -> None:
         from ..ops import dispatch
 

@@ -113,6 +113,20 @@ class RowSparseNDArray(BaseSparseNDArray):
             return out
         return out.at[self._indices].add(self._values)
 
+    def check_format(self, full_check: bool = True) -> None:
+        """Reference ``check_format``: row ids must be int, in-range,
+        sorted, and unique; values ndim must carry the full row shape."""
+        idx = onp.asarray(self._indices)
+        if idx.size == 0:
+            return
+        if idx.min() < 0 or idx.max() >= self._shape[0]:
+            raise MXNetError(
+                f"row_sparse indices out of range [0, {self._shape[0]})")
+        if full_check and (onp.any(onp.diff(idx) <= 0)):
+            raise MXNetError(
+                "row_sparse indices must be sorted and unique "
+                "(call consolidate())")
+
     def consolidate(self) -> "RowSparseNDArray":
         """Sort + dedupe row ids, summing duplicate rows (segment-sum —
         the TPU equivalent of the reference's dedup in sparse kvstore)."""
@@ -191,6 +205,26 @@ class CSRNDArray(BaseSparseNDArray):
     @property
     def nnz(self) -> int:
         return int(self._values.shape[0])
+
+    def check_format(self, full_check: bool = True) -> None:
+        """Reference ``check_format``: indptr must be monotone from 0 to
+        nnz with one entry per row boundary; column ids in range (and
+        sorted within each row under ``full_check``)."""
+        ptr = onp.asarray(self._indptr)
+        idx = onp.asarray(self._indices)
+        if ptr.shape[0] != self._shape[0] + 1:
+            raise MXNetError("csr indptr length must be rows+1")
+        if ptr[0] != 0 or ptr[-1] != self.nnz or onp.any(onp.diff(ptr) < 0):
+            raise MXNetError("csr indptr must rise monotonically 0 -> nnz")
+        if idx.size and (idx.min() < 0 or idx.max() >= self._shape[1]):
+            raise MXNetError(
+                f"csr indices out of range [0, {self._shape[1]})")
+        if full_check:
+            for r in range(self._shape[0]):
+                row = idx[ptr[r]:ptr[r + 1]]
+                if row.size > 1 and onp.any(onp.diff(row) <= 0):
+                    raise MXNetError(
+                        f"csr row {r} column ids must be sorted and unique")
 
     def _row_ids(self):
         """Expand indptr to one row id per nnz element."""

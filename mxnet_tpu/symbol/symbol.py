@@ -146,6 +146,15 @@ class Symbol:
         # stats et al. are ordinary arguments (reference aux_states)
         return []
 
+    def get_children(self) -> Optional["Symbol"]:
+        """Direct inputs of the head node as a grouped Symbol (reference
+        ``Symbol.get_children`` / ``MXSymbolGetChildren``); ``None`` for
+        a variable (leaf)."""
+        node = self._heads[0][0]
+        if node.op is None or not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
     def get_internals(self) -> "Symbol":
         heads = []
         for node in _topo(self._heads):

@@ -106,3 +106,13 @@ def test_horovod_byteps_refused_with_guidance():
         assert isinstance(mx.kv.create("horovod"), FakeHvd)
     finally:
         del KVStoreBase.kv_registry["horovod"]
+
+
+def test_barrier_single_process():
+    """kv.barrier() exists and returns immediately off-cluster
+    (reference KVStore.barrier; multiprocess behavior exercised by
+    tests/test_dist_multiproc.py's rendezvous)."""
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("local")
+    kv.barrier()  # no-op, must not raise

@@ -279,3 +279,33 @@ def test_zero_grad_sparse():
     assert net.weight.grad().nnz > 0
     net.zero_grad()
     assert net.weight.grad().nnz == 0
+
+
+def test_check_format_and_stype():
+    """Reference NDArray.check_format / .stype parity: dense no-op,
+    sparse classes validate index integrity."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import np
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+    d = np.ones((2, 2))
+    assert d.stype == "default"
+    d.check_format()  # no-op
+
+    rs = RowSparseNDArray(np.ones((2, 3)), [0, 4], (6, 3))
+    assert rs.stype == "row_sparse"
+    rs.check_format()
+    bad = RowSparseNDArray(np.ones((2, 3)), [4, 0], (6, 3))  # unsorted
+    with pytest.raises(MXNetError):
+        bad.check_format()
+    oob = RowSparseNDArray(np.ones((1, 3)), [9], (6, 3))
+    with pytest.raises(MXNetError):
+        oob.check_format()
+
+    csr = CSRNDArray(np.ones((3,)), [0, 2, 1], [0, 2, 2, 3], (3, 4))
+    assert csr.stype == "csr"
+    csr.check_format()
+    bad_ptr = CSRNDArray(np.ones((3,)), [0, 2, 1], [0, 3, 2, 3], (3, 4))
+    with pytest.raises(MXNetError):
+        bad_ptr.check_format()

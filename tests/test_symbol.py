@@ -232,3 +232,15 @@ def test_name_manager_prefix_and_attr_scope():
 
     with _pytest.raises(ValueError):
         mx.attribute.AttrScope(bad=1)
+
+
+def test_get_children():
+    import mxnet_tpu as mx
+
+    x = mx.sym.var("x")
+    w = mx.sym.var("w")
+    y = mx.sym.np.dot(x, w, name="proj")
+    kids = y.get_children()
+    assert kids is not None and len(kids) == 2
+    assert [s.name for s in kids] == ["x", "w"]
+    assert x.get_children() is None
