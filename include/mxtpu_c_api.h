@@ -124,6 +124,14 @@ int MXSymbolGetOutput(SymbolHandle sym, int index, SymbolHandle *out);
 /* JSON {name, description, args: [{name, default}]} */
 int MXSymbolGetAtomicSymbolInfo(const char *op_name, char *buf, int buf_len,
                                 int *needed);
+/* per-array waits (reference MXNDArrayWaitToRead/Write) */
+int MXNDArrayWaitToRead(NDArrayHandle h);
+int MXNDArrayWaitToWrite(NDArrayHandle h);
+/* dtypes as JSON {name: "float32"} -> {"arg_types": [...],
+   "out_types": [...], "aux_types": [...]} */
+int MXSymbolInferType(SymbolHandle sym, const char *dtypes_json, char *buf,
+                      int buf_len, int *needed);
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out);
 
 /* ---- CachedOp over durable exports (HybridBlock.export artifacts:
    {prefix}-symbol.json StableHLO envelope + {prefix}-NNNN.params) ---- */

@@ -728,3 +728,30 @@ def atomic_symbol_info(op_name: str) -> str:
     except (TypeError, ValueError):
         pass
     return json.dumps({"name": op_name, "description": doc, "args": args})
+
+
+def nd_wait_to_read(arr) -> None:
+    arr.wait_to_read()
+
+
+def nd_wait_to_write(arr) -> None:
+    # write-wait = read-wait in the XLA model (no pending writers beyond
+    # the async dispatch the read already drains)
+    arr.wait_to_read()
+
+
+def symbol_infer_type(sym, dtypes_json: str) -> str:
+    dtypes = json.loads(dtypes_json) if dtypes_json else {}
+    arg_types, out_types, aux_types = sym.infer_type(**dtypes)
+    return json.dumps({
+        "arg_types": [str(t) for t in arg_types],
+        "out_types": [str(t) for t in out_types],
+        "aux_types": [str(t) for t in aux_types],
+    })
+
+
+def symbol_get_children(sym):
+    kids = sym.get_children()
+    if kids is None:
+        raise ValueError("variable symbol has no children")
+    return kids

@@ -1339,3 +1339,49 @@ MXTPU_DLL int MXSymbolGetAtomicSymbolInfo(const char *op_name, char *buf,
   Py_DECREF(r);
   return rc;
 }
+
+/* ---- per-array waits + symbol type inference / children (upgrade of
+ * four parity-table rows from equivalent/python to provided) ---- */
+
+MXTPU_DLL int MXNDArrayWaitToRead(NDArrayHandle h) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "nd_wait_to_read", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_DLL int MXNDArrayWaitToWrite(NDArrayHandle h) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "nd_wait_to_write", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* dtypes in/out as JSON — {"name": "float32"} ->
+ * {"arg_types": [...], "out_types": [...], "aux_types": [...]} */
+MXTPU_DLL int MXSymbolInferType(SymbolHandle sym, const char *dtypes_json,
+                                char *buf, int buf_len, int *needed) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_infer_type",
+      Py_BuildValue("(Os)", static_cast<PyObject *>(sym),
+                    dtypes_json != nullptr ? dtypes_json : ""));
+  if (r == nullptr) return -1;
+  int rc = copy_str(r, buf, buf_len, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_DLL int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out) {
+  Gil gil;
+  PyObject *r = capi_call_checked(
+      "symbol_get_children",
+      Py_BuildValue("(O)", static_cast<PyObject *>(sym)));
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}

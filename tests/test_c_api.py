@@ -1019,3 +1019,51 @@ def test_c_api_parity_doc():
             assert name in ours
     # the doc's provided-count matches the real intersection
     assert f"| provided | {len(ref & ours)} |" in doc
+
+
+def test_wait_and_infer_type_and_children(capi):
+    """Round-3 upgrades: per-array waits, symbol type inference and
+    children through C."""
+    capi.MXNDArrayWaitToRead.argtypes = [ctypes.c_void_p]
+    capi.MXNDArrayWaitToWrite.argtypes = [ctypes.c_void_p]
+    capi.MXSymbolInferType.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int)]
+    capi.MXSymbolGetChildren.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+
+    a = _make(capi, onp.ones((2, 2), onp.float32))
+    assert capi.MXNDArrayWaitToRead(a) == 0
+    assert capi.MXNDArrayWaitToWrite(a) == 0
+    capi.MXNDArrayFree(a)
+
+    x = ctypes.c_void_p()
+    w = ctypes.c_void_p()
+    assert capi.MXSymbolCreateVariable(b"x", ctypes.byref(x)) == 0
+    assert capi.MXSymbolCreateVariable(b"w", ctypes.byref(w)) == 0
+    dot = ctypes.c_void_p()
+    assert capi.MXSymbolCreateAtomicSymbol(
+        b"np.dot", 0, None, None, ctypes.byref(dot)) == 0
+    ins = (ctypes.c_void_p * 2)(x, w)
+    assert capi.MXSymbolCompose(dot, b"proj", 2, None, ins) == 0
+
+    out = _getstr(capi, capi.MXSymbolInferType, dot,
+                  ctypes.c_char_p(b'{"x": "float32", "w": "float32"}'),
+                  size=4096)
+    info = json.loads(out)
+    assert info["out_types"] == ["float32"]
+    assert info["arg_types"] == ["float32", "float32"]
+
+    kids = ctypes.c_void_p()
+    assert capi.MXSymbolGetChildren(dot, ctypes.byref(kids)) == 0
+    args = ctypes.c_void_p()
+    assert capi.MXSymbolListOutputs(kids, ctypes.byref(args)) == 0
+    n = ctypes.c_int()
+    capi.MXListSize(args, ctypes.byref(n))
+    assert n.value == 2
+    capi.MXListFree(args)
+    # children of a variable is a clean error
+    bad = ctypes.c_void_p()
+    assert capi.MXSymbolGetChildren(x, ctypes.byref(bad)) == -1
+    for h in (kids, dot, x, w):
+        capi.MXSymbolFree(h)
