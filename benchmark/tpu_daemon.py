@@ -58,6 +58,7 @@ PARITY = os.path.join(HERE, "results_parity_tpu.json")
 LLM = os.path.join(HERE, "results_llm_tpu.json")
 QUANT = os.path.join(HERE, "results_quant_tpu.json")
 BS256 = os.path.join(HERE, "results_bench_tpu_bs256.json")
+INFER = os.path.join(HERE, "results_infer_tpu.json")
 
 PROBE_INTERVAL_S = 180       # while the tunnel is down
 REFRESH_INTERVAL_S = 3600    # after a full successful suite
@@ -314,6 +315,23 @@ def capture_llm() -> None:
             f"mfu={rec.get('mfu')}, decode {rec.get('decode_tok_s')} tok/s")
 
 
+def capture_infer_table() -> None:
+    """Per-model inference table over the reference's FULL published
+    perf.md rows (resnet50/resnet152/inception_v3/vgg16/alexnet, bf16 +
+    fp32) so every published inference number has a measured TPU peer."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "train_bench.py"), "--infer",
+         "--models", "resnet50_v1,resnet152_v1,inception_v3,vgg16,alexnet",
+         "--batch", "32", "--timeout", "420", "--retries", "1",
+         "--bail-after", "2"],
+        timeout=7200)
+    rec = parse_json_output(out)
+    if rec and rec.get("device") == "tpu":
+        ok = sum(1 for r in rec.get("results", []) if "error" not in r)
+        log(f"infer table: {ok}/{len(rec.get('results', []))} combos")
+    bank_if_tpu(INFER, rec, rc, "infer table")
+
+
 def capture_bs256() -> None:
     """Supplemental large-batch headline: bs256 inference, where the
     serial-chain protocol is MXU-bound rather than launch-bound — the
@@ -414,6 +432,7 @@ def main() -> None:
                                   (TRAIN, capture_train),
                                   (LLM, capture_llm),
                                   (BS256, capture_bs256),
+                                  (INFER, capture_infer_table),
                                   (QUANT, capture_quant),
                                   (OPPERF, capture_opperf),
                                   (ATTENTION, capture_attention),

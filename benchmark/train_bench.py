@@ -42,6 +42,20 @@ V100_FP32_TRAIN = {
     "alexnet": 2585.61,
 }
 
+# V100 bs32 inference rows (perf.md:186-198 fp32, :202-216 fp16) — the
+# reference's FULL published per-model inference table; --infer measures
+# the same models so every published row has a TPU peer
+V100_FP32_INFER = {
+    "resnet50_v1": 1076.81,
+    "inception_v3": 814.59,
+    "vgg16": 708.43,
+    "alexnet": 7906.09,
+}
+V100_FP16_INFER = {
+    "resnet50_v1": 2085.51,
+    "resnet152_v1": 887.34,
+}
+
 
 def build_step(net_name, batch, dtype_name, seq_len=128):
     import jax
@@ -109,6 +123,72 @@ def build_step(net_name, batch, dtype_name, seq_len=128):
     return jstep, params, velocity, jnp.asarray(x_np), jnp.asarray(y_np)
 
 
+def build_infer_step(net_name, batch, dtype_name):
+    """Serial-chained inference step (bench.py protocol: the output
+    perturbs the next input so no dispatch layer can elide work)."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = getattr(vision, net_name)(classes=1000)
+    net.initialize()
+    size = 299 if "inception" in net_name else 224
+    x_np = onp.random.uniform(size=(batch, 3, size, size)).astype(onp.float32)
+    fn, params = net.functionalize(mx.np.array(x_np), training=False)
+    dt = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+    if dt != jnp.float32:
+        params = {k: v.astype(dt) if v.dtype == jnp.float32 else v
+                  for k, v in params.items()}
+
+    def step(p, x):
+        logits, _ = fn(p, x)
+        perturb = jnp.tanh(jnp.mean(logits)) * 1e-6
+        return logits, x * (1.0 + perturb).astype(x.dtype)
+
+    return jax.jit(step), params, jnp.asarray(x_np, dt)
+
+
+def measure_infer(net_name, batch, dtype_name, log):
+    import jax.numpy as jnp
+
+    jstep, p, x = build_infer_step(net_name, batch, dtype_name)
+    t0 = time.time()
+    out, x = jstep(p, x)
+    float(jnp.sum(x))
+    float(jnp.sum(out))
+    log(f"{net_name}/{dtype_name}: compiled in {time.time() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    out, x = jstep(p, x)
+    float(jnp.sum(out))
+    per = max(time.perf_counter() - t0, 1e-4)
+    pass_iters = max(5, min(200, int(5.0 / per)))
+
+    total_iters, total_dt = 0, 0.0
+    while total_dt < 5.0 and total_iters < 3000:
+        t0 = time.perf_counter()
+        for _ in range(pass_iters):
+            out, x = jstep(p, x)
+        float(jnp.sum(out))  # barrier through the serial chain
+        total_dt += time.perf_counter() - t0
+        total_iters += pass_iters
+    img_s = batch * total_iters / total_dt
+    rec = {"model": net_name, "precision": dtype_name, "batch": batch,
+           "steps": total_iters, "infer_img_s": round(img_s, 2)}
+    log(f"{net_name}/{dtype_name}: {img_s:.1f} img/s inference "
+        f"({total_iters} steps, {total_dt:.1f}s)")
+    fp32_base = V100_FP32_INFER.get(net_name)
+    if fp32_base:
+        rec["v100_fp32_baseline"] = fp32_base
+        rec["vs_v100_fp32"] = round(img_s / fp32_base, 3)
+    fp16_base = V100_FP16_INFER.get(net_name)
+    if fp16_base and dtype_name == "bf16":
+        rec["v100_fp16_baseline"] = fp16_base
+        rec["vs_v100_fp16"] = round(img_s / fp16_base, 3)
+    return rec
+
+
 def measure(net_name, batch, dtype_name, log):
     import jax
     import jax.numpy as jnp
@@ -153,7 +233,7 @@ def measure(net_name, batch, dtype_name, log):
     return rec
 
 
-def child_main(name, batch, prec, cpu):
+def child_main(name, batch, prec, cpu, infer=False):
     """Measure ONE (model, precision) pair and print its JSON record.
     Runs in a child process: the axon tunnel can hang mid-compile, and a
     hung child can be timed out and retried (in-process jax caches a dead
@@ -179,7 +259,8 @@ def child_main(name, batch, prec, cpu):
     devs = jax.devices()
     up.set()
     log("devices:", devs)
-    rec = measure(name, batch, prec, log)
+    rec = measure_infer(name, batch, prec, log) if infer \
+        else measure(name, batch, prec, log)
     rec["device"] = devs[0].platform
     rec["device_kind"] = devs[0].device_kind
     print(json.dumps(rec), flush=True)
@@ -194,6 +275,9 @@ def main():
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--child", nargs=2, metavar=("MODEL", "PREC"),
                     default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--infer", action="store_true",
+                    help="measure the inference table (bench.py serial-"
+                         "chain protocol) instead of training steps")
     ap.add_argument("--timeout", type=int, default=600,
                     help="per-(model,precision) child timeout, seconds")
     ap.add_argument("--retries", type=int, default=2)
@@ -205,7 +289,8 @@ def main():
     args = ap.parse_args()
 
     if args.child:
-        child_main(args.child[0], args.batch, args.child[1], args.cpu)
+        child_main(args.child[0], args.batch, args.child[1], args.cpu,
+                   infer=args.infer)
         return
 
     def log(*a):
@@ -233,6 +318,8 @@ def main():
         for attempt in range(args.retries + 1):
             cmd = [sys.executable, os.path.abspath(__file__),
                    "--child", name, prec, "--batch", str(args.batch)]
+            if args.infer:
+                cmd.append("--infer")
             if args.cpu:
                 cmd.append("--cpu")
             try:
