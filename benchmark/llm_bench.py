@@ -161,7 +161,10 @@ def main():
     step_flops = None
     src = None
     try:
-        lowered = jax.jit(train_step).lower(params2, velocity2, x, key)
+        # lower the SAME jit object as the timed loop so the fallback
+        # compile() path hits its executable cache instead of paying a
+        # second full XLA compilation
+        lowered = jstep.lower(params2, velocity2, x, key)
         try:
             ca = lowered.cost_analysis()
         except Exception:  # noqa: BLE001

@@ -114,9 +114,13 @@ class RowSparseNDArray(BaseSparseNDArray):
         return out.at[self._indices].add(self._values)
 
     def check_format(self, full_check: bool = True) -> None:
-        """Reference ``check_format``: row ids must be int, in-range,
-        sorted, and unique; values ndim must carry the full row shape."""
+        """Reference ``check_format``: one index per value row; row ids
+        in-range, sorted, and unique."""
         idx = onp.asarray(self._indices)
+        if idx.shape[0] != self._values.shape[0]:
+            raise MXNetError(
+                f"row_sparse indices length {idx.shape[0]} != values rows "
+                f"{self._values.shape[0]}")
         if idx.size == 0:
             return
         if idx.min() < 0 or idx.max() >= self._shape[0]:
@@ -214,17 +218,25 @@ class CSRNDArray(BaseSparseNDArray):
         idx = onp.asarray(self._indices)
         if ptr.shape[0] != self._shape[0] + 1:
             raise MXNetError("csr indptr length must be rows+1")
+        if idx.shape[0] != self.nnz:
+            raise MXNetError(
+                f"csr indices length {idx.shape[0]} != nnz {self.nnz}")
         if ptr[0] != 0 or ptr[-1] != self.nnz or onp.any(onp.diff(ptr) < 0):
             raise MXNetError("csr indptr must rise monotonically 0 -> nnz")
         if idx.size and (idx.min() < 0 or idx.max() >= self._shape[1]):
             raise MXNetError(
                 f"csr indices out of range [0, {self._shape[1]})")
-        if full_check:
-            for r in range(self._shape[0]):
-                row = idx[ptr[r]:ptr[r + 1]]
-                if row.size > 1 and onp.any(onp.diff(row) <= 0):
-                    raise MXNetError(
-                        f"csr row {r} column ids must be sorted and unique")
+        if full_check and idx.size > 1:
+            # vectorized within-row sortedness: a decrease is legal only
+            # at a row boundary (positions where some ptr value == i+1)
+            d = onp.diff(idx)
+            boundary = onp.zeros(idx.size - 1, bool)
+            inner = ptr[(ptr > 0) & (ptr < idx.size)]
+            boundary[inner - 1] = True
+            if onp.any((d <= 0) & ~boundary):
+                raise MXNetError(
+                    "csr column ids must be sorted and unique within "
+                    "each row")
 
     def _row_ids(self):
         """Expand indptr to one row id per nnz element."""

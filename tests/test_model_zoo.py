@@ -150,7 +150,7 @@ def test_model_store_keeps_user_supplied_weights(tmp_path):
 
 def test_vision_zoo_surface_complete():
     """Every public builder the reference's gluon model_zoo.vision
-    exposes (42 names: all variants of the 7 families + the get_*
+    exposes (41 names: all variants of the 7 families + the get_*
     parameterized builders) must exist here."""
     from mxnet_tpu.gluon.model_zoo import vision
 

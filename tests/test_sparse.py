@@ -309,3 +309,24 @@ def test_check_format_and_stype():
     bad_ptr = CSRNDArray(np.ones((3,)), [0, 2, 1], [0, 3, 2, 3], (3, 4))
     with pytest.raises(MXNetError):
         bad_ptr.check_format()
+
+
+def test_check_format_length_mismatch():
+    """Review finding: aux-array length inconsistencies must fail the
+    integrity check, not surface later in todense()."""
+    from mxnet_tpu import np
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+    rs = RowSparseNDArray(np.ones((3, 3)), [0, 4], (6, 3))  # 3 rows, 2 ids
+    with pytest.raises(MXNetError):
+        rs.check_format()
+    csr = CSRNDArray(np.ones((3,)), [0, 2, 1, 3, 2], [0, 2, 2, 3], (3, 4))
+    with pytest.raises(MXNetError):
+        csr.check_format()
+    # vectorized within-row sortedness still catches a bad middle row
+    bad_row = CSRNDArray(np.ones((4,)), [0, 2, 3, 1], [0, 2, 4, 4], (3, 4))
+    with pytest.raises(MXNetError):
+        bad_row.check_format()
+    ok = CSRNDArray(np.ones((4,)), [0, 2, 0, 1], [0, 2, 4, 4], (3, 4))
+    ok.check_format()  # boundary decrease (2 -> 0) is legal

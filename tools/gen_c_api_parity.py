@@ -235,8 +235,6 @@ OVERRIDES = {
     "MXNDArrayReshape64": ("subsumed", "→ `MXNDArrayReshape`"),
     "MXNDArraySlice64": ("subsumed", "→ `MXNDArraySlice`"),
     "MXNDArraySyncCopyFromNDArray": ("equivalent", "`MXImperativeInvoke(\"np.copy\")` or copy through host"),
-    "MXNDArrayWaitToRead": ("equivalent", "per-array wait is python `wait_to_read`; C surface: `MXNDArrayWaitAll`"),
-    "MXNDArrayWaitToWrite": ("equivalent", "see MXNDArrayWaitToRead"),
     "MXNotifyShutdown": ("n/a", "process teardown is the embedded interpreter's; nothing to notify"),
     "MXOptimizeForBackend": ("equivalent", "python `block.optimize_for` / `apply_graph_pass`; compiled passes via `MXLoadLib`"),
     # profiler fine-grained
@@ -253,7 +251,6 @@ OVERRIDES = {
     # symbol tail
     "MXSymbolCutSubgraph": ("n/a", "nnvm-specific; subgraph seam = extension partitioners"),
     "MXSymbolGetAtomicSymbolName": ("equivalent", "part of `MXSymbolGetAtomicSymbolInfo` (JSON `name` field)"),
-    "MXSymbolGetChildren": ("python", "`sym.get_children()` — python surface"),
     "MXSymbolGetInputSymbols": ("equivalent", "→ `MXSymbolListArguments` + `MXSymbolGetInternals`"),
     "MXSymbolGrad": ("n/a", "deprecated in the reference; gradients via `MXExecutorBackward`/`MXAutogradBackward`"),
     "MXSymbolInferShapeEx": ("subsumed", "→ `MXSymbolInferShape` (JSON, int64-native)"),
@@ -261,7 +258,6 @@ OVERRIDES = {
     "MXSymbolInferShapePartial": ("n/a", "forward-only eval_shape needs every leaf; the deferred-init path (gluon) covers partial-shape workflows"),
     "MXSymbolInferShapePartialEx": ("n/a", "see MXSymbolInferShapePartial"),
     "MXSymbolInferShapePartialEx64": ("n/a", "see MXSymbolInferShapePartial"),
-    "MXSymbolInferType": ("python", "`sym.infer_type()` — python surface"),
     "MXSymbolInferTypePartial": ("n/a", "see MXSymbolInferShapePartial"),
     "MXSymbolListAtomicSymbolCreators": ("equivalent", "→ `MXListAllOpNames` (ops are addressed by name, not creator handle)"),
     "MXSymbolListAttrShallow": ("subsumed", "→ `MXSymbolListAttr` (head-node entry of the JSON)"),
