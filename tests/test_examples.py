@@ -171,8 +171,13 @@ def test_rbm_entry_point():
 @pytest.mark.integration
 @pytest.mark.seed(0)
 def test_actor_critic_entry_point():
+    # ~170s alone, but the episode loop is all-python RL interaction and
+    # degrades badly when xdist workers + other compiles contend for
+    # cores (observed: >900s in a loaded full-suite run) — give it the
+    # long timeout rather than fewer episodes (the improvement gate
+    # needs the full 100-episode curve)
     out = _run("example/actor_critic/actor_critic.py",
-               "--episodes", "100")
+               "--episodes", "100", timeout=2400)
     assert out.returncode == 0, out.stderr[-2000:]
     line = out.stdout.rsplit("final:", 1)[1]
     first = float(line.split("first25=")[1].split()[0])
