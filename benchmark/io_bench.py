@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput benchmark.
+
+The reference shipped the harness designs (ImageRecordIter, tools/
+bandwidth) but never committed data-pipeline numbers (SURVEY §6). This
+measures the stages that feed the chip, host-side, so regressions in
+the IO path are visible without TPU time:
+
+  1. RecordIO sequential read — native C++ reader (libmxtpu_io.so) vs
+     the pure-python reader, records/s and MB/s.
+  2. Threaded prefetcher gain — native reader behind the C++ prefetch
+     queue vs direct iteration, on a decode+augment consumer (the
+     overlap the reference's PrefetcherIter provided).
+  3. gluon DataLoader — samples/s over a JPEG dataset with the standard
+     train transform (RandomResizedCrop + flip + ToTensor + Normalize),
+     single-process vs multiworker.
+
+Prints one JSON object; `--output` also writes it to a file
+(results committed as benchmark/results_io_cpu.json).
+
+CLI: python benchmark/io_bench.py [--records 2000] [--jpegs 600]
+     [--workers 4] [--output out.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as onp
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def log(*a):
+    print("[io_bench]", *a, file=sys.stderr, flush=True)
+
+
+def bench_recordio(n_records: int, payload: int, tmp: str):
+    """Native vs python sequential read of the same .rec file."""
+    import ctypes
+
+    from mxnet_tpu import _native, recordio
+
+    path = os.path.join(tmp, "seq.rec")
+    rs = onp.random.RandomState(0)
+    payloads = [rs.bytes(payload) for _ in range(n_records)]
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    total_mb = n_records * payload / 1e6
+
+    def timed(read_all):
+        t0 = time.perf_counter()
+        count = read_all()
+        dt = time.perf_counter() - t0
+        assert count == n_records
+        return dt
+
+    def py_read():
+        r = recordio.MXRecordIO(path, "r")  # pure-python reader
+        n = 0
+        while r.read() is not None:
+            n += 1
+        r.close()
+        return n
+
+    nat = _native.lib()
+
+    def native_read():
+        h = nat.MXTRecordIOReaderCreate(path.encode())
+        assert h
+        data = ctypes.c_char_p()
+        size = ctypes.c_uint64()
+        n = 0
+        while nat.MXTRecordIOReaderNext(
+                h, ctypes.byref(data), ctypes.byref(size)) == 0:
+            ctypes.string_at(data, size.value)
+            n += 1
+        nat.MXTRecordIOReaderFree(h)
+        return n
+
+    if nat is None:
+        log("native io library unavailable; skipping native rows")
+        dt = min(timed(py_read) for _ in range(3))
+        return {"records": n_records, "payload_bytes": payload,
+                "python_rec_s": round(n_records / dt, 1),
+                "python_mb_s": round(total_mb / dt, 1)}, path
+
+    py_dt = min(timed(py_read) for _ in range(3))
+    nat_dt = min(timed(native_read) for _ in range(3))
+    rec = {
+        "records": n_records, "payload_bytes": payload,
+        "python_rec_s": round(n_records / py_dt, 1),
+        "python_mb_s": round(total_mb / py_dt, 1),
+        "native_rec_s": round(n_records / nat_dt, 1),
+        "native_mb_s": round(total_mb / nat_dt, 1),
+        "native_speedup": round(py_dt / nat_dt, 2),
+    }
+    log(f"recordio: native {rec['native_mb_s']} MB/s vs python "
+        f"{rec['python_mb_s']} MB/s ({rec['native_speedup']}x)")
+    return rec, path
+
+
+def bench_prefetcher(path: str, n_records: int):
+    """Prefetch overlap: consumer does real work per record (decode-ish
+    numpy crunch); the C++ prefetch thread should hide read latency."""
+    import ctypes
+
+    from mxnet_tpu import _native, recordio
+
+    def consume(buf):
+        a = onp.frombuffer(buf, onp.uint8)[:65536].astype(onp.float32)
+        return float(a.sum())
+
+    nat = _native.lib()
+    if nat is None:
+        return {"skipped": "native io library unavailable"}
+
+    def direct():
+        h = nat.MXTRecordIOReaderCreate(path.encode())
+        data = ctypes.c_char_p()
+        size = ctypes.c_uint64()
+        t0 = time.perf_counter()
+        n = 0
+        while nat.MXTRecordIOReaderNext(
+                h, ctypes.byref(data), ctypes.byref(size)) == 0:
+            consume(ctypes.string_at(data, size.value))
+            n += 1
+        dt = time.perf_counter() - t0
+        nat.MXTRecordIOReaderFree(h)
+        assert n == n_records
+        return dt
+
+    def prefetched():
+        pf = recordio.ThreadedRecordReader(path, capacity=64)
+        assert pf.is_native
+        t0 = time.perf_counter()
+        n = 0
+        for buf in pf:
+            consume(buf)
+            n += 1
+        dt = time.perf_counter() - t0
+        pf.close()
+        assert n == n_records
+        return dt
+
+    d_dt = min(direct() for _ in range(3))
+    p_dt = min(prefetched() for _ in range(3))
+    rec = {"direct_rec_s": round(n_records / d_dt, 1),
+           "prefetched_rec_s": round(n_records / p_dt, 1),
+           "overlap_gain": round(d_dt / p_dt, 2)}
+    log(f"prefetcher: {rec['prefetched_rec_s']} rec/s vs direct "
+        f"{rec['direct_rec_s']} rec/s (gain {rec['overlap_gain']}x)")
+    return rec
+
+
+def bench_dataloader(n_jpegs: int, workers: int, tmp: str):
+    """DataLoader samples/s with the standard train transform over real
+    JPEG files (PIL decode on the worker side)."""
+    from mxnet_tpu import image as mximage
+    from mxnet_tpu import np as mxnp
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import ImageListDataset, transforms
+
+    img_dir = os.path.join(tmp, "imgs")
+    os.makedirs(img_dir, exist_ok=True)
+    rs = onp.random.RandomState(0)
+    items = []
+    for i in range(n_jpegs):
+        arr = rs.randint(0, 255, (256, 256, 3), dtype=onp.uint8)
+        fname = os.path.join(img_dir, f"{i}.jpg")
+        mximage.imsave(fname, mxnp.array(arr))
+        items.append((fname, i % 10))
+    ds = ImageListDataset(img_dir, [(lab, os.path.basename(f))
+                                    for f, lab in items])
+    tf = transforms.Compose([
+        transforms.RandomResizedCrop(224),
+        transforms.RandomFlipLeftRight(),
+        transforms.ToTensor(),
+        transforms.Normalize(0.5, 0.25),
+    ])
+    ds_t = ds.transform_first(tf)
+
+    out = {}
+    for nw in (0, workers):
+        loader = DataLoader(ds_t, batch_size=32, shuffle=True,
+                            num_workers=nw)
+        # one warm epoch (worker startup, caches), one timed
+        for _ in loader:
+            pass
+        t0 = time.perf_counter()
+        n = 0
+        for x, y in loader:
+            n += x.shape[0]
+        dt = time.perf_counter() - t0
+        key = "loader0_sps" if nw == 0 else f"loader{nw}_sps"
+        out[key] = round(n / dt, 1)
+        log(f"dataloader workers={nw}: {out[key]} samples/s")
+    if workers:
+        out["worker_speedup"] = round(
+            out[f"loader{workers}_sps"] / out["loader0_sps"], 2)
+    out["jpegs"] = n_jpegs
+    out["transform"] = "RandomResizedCrop(224)+Flip+ToTensor+Normalize"
+    return out
+
+
+def main():
+    # host-side benchmark: never touch the accelerator backend (the axon
+    # tunnel can hang at init and ToTensor/np paths would trigger it)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=2000)
+    ap.add_argument("--payload", type=int, default=64 * 1024)
+    ap.add_argument("--jpegs", type=int, default=600)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--output", default=None)
+    args = ap.parse_args()
+
+    import platform
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rec_io, path = bench_recordio(args.records, args.payload, tmp)
+        rec_pf = bench_prefetcher(path, args.records)
+        rec_dl = bench_dataloader(args.jpegs, args.workers, tmp)
+    try:
+        cpus = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cpus = os.cpu_count()
+    out = {"recordio": rec_io, "prefetcher": rec_pf, "dataloader": rec_dl,
+           "host": platform.processor() or platform.machine(),
+           "cpus": cpus,
+           "note": ("thread/process overlap gains are meaningful only "
+                    "when cpus > 1; single-core containers show the "
+                    "coordination overhead instead")}
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -171,3 +171,26 @@ def test_llm_bench_tiny(tmp_path):
     assert rec["params_m"] > 0 and rec["flops_per_step"] > 0
     assert rec["device"] == "cpu"  # forced; daemon only banks tpu records
     assert rec.get("decode_tok_s", 0) > 0
+
+
+def test_io_bench_tiny(tmp_path):
+    """io_bench end-to-end on a tiny config: schema contract for the
+    committed input-pipeline results."""
+    import json
+    import subprocess
+    import sys
+
+    out_file = str(tmp_path / "io.json")
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmark", "io_bench.py"),
+         "--records", "100", "--payload", "8192", "--jpegs", "24",
+         "--workers", "2", "--output", out_file],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(open(out_file).read())
+    assert rec["recordio"]["python_rec_s"] > 0
+    assert rec["recordio"].get("native_rec_s", 1) > 0
+    assert rec["prefetcher"].get("prefetched_rec_s", 1) > 0
+    assert rec["dataloader"]["loader0_sps"] > 0
+    assert rec["cpus"] >= 1
