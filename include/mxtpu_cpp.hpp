@@ -175,6 +175,155 @@ class Predictor {
   PredictorHandle handle_ = nullptr;
 };
 
+/* move-only RAII Symbol: build graphs in C++ (the reference
+ * cpp-package Symbol::Variable / op factories / Compose workflow) */
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : handle_(h) {}
+  Symbol(Symbol &&o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  Symbol &operator=(Symbol &&o) noexcept {
+    if (this != &o) {
+      Free();
+      handle_ = o.handle_;
+      o.handle_ = nullptr;
+    }
+    return *this;
+  }
+  Symbol(const Symbol &) = delete;
+  Symbol &operator=(const Symbol &) = delete;
+  ~Symbol() { Free(); }
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h),
+          "MXSymbolCreateVariable");
+    return Symbol(h);
+  }
+
+  /* one-step CreateAtomicSymbol + Compose: the op's symbol inputs
+   * positionally, plus string params ({{"num_hidden", "64"}, ...}) */
+  static Symbol Op(
+      const std::string &op_name, const std::string &node_name,
+      const std::vector<const Symbol *> &inputs,
+      const std::vector<std::pair<std::string, std::string>> &params = {}) {
+    std::vector<const char *> keys, vals;
+    keys.reserve(params.size());
+    vals.reserve(params.size());
+    for (const auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(
+              op_name.c_str(), static_cast<int>(params.size()),
+              keys.data(), vals.data(), &h),
+          "MXSymbolCreateAtomicSymbol");
+    Symbol s(h);
+    std::vector<SymbolHandle> raw;
+    raw.reserve(inputs.size());
+    for (const Symbol *in : inputs) raw.push_back(in->get());
+    Check(MXSymbolCompose(s.get(), node_name.c_str(),
+                          static_cast<int>(raw.size()), nullptr,
+                          raw.data()),
+          "MXSymbolCompose");
+    return s;
+  }
+
+  std::string Name() const {
+    char buf[256];
+    Check(MXSymbolGetName(handle_, buf, sizeof buf, nullptr),
+          "MXSymbolGetName");
+    return buf;
+  }
+
+  std::vector<std::string> ListArguments() const {
+    ListHandle lst = nullptr;
+    Check(MXSymbolListArguments(handle_, &lst), "MXSymbolListArguments");
+    int n = 0;
+    Check(MXListSize(lst, &n), "MXListSize");
+    std::vector<std::string> out;
+    out.reserve(n);
+    char buf[256];
+    for (int i = 0; i < n; ++i) {
+      if (MXListGetString(lst, i, buf, sizeof buf, nullptr) == 0) {
+        out.emplace_back(buf);
+      }
+    }
+    MXListFree(lst);
+    return out;
+  }
+
+  SymbolHandle get() const { return handle_; }
+
+ private:
+  void Free() {
+    if (handle_ != nullptr) MXSymbolFree(handle_);
+    handle_ = nullptr;
+  }
+  SymbolHandle handle_ = nullptr;
+};
+
+/* RAII executor: bind a symbol, forward/backward, SGD from C++ —
+ * the reference cpp-package mlp.cpp workflow */
+class Executor {
+ public:
+  Executor(const Symbol &sym, const std::string &shapes_json,
+           const std::string &grad_req = "write") {
+    Check(MXExecutorSimpleBind(sym.get(), shapes_json.c_str(),
+                               grad_req.c_str(), &handle_),
+          "MXExecutorSimpleBind");
+  }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+  ~Executor() {
+    if (handle_ != nullptr) MXExecutorFree(handle_);
+  }
+
+  void Forward(bool is_train,
+               const std::vector<std::pair<std::string, const NDArray *>>
+                   &args) {
+    std::vector<const char *> names;
+    std::vector<NDArrayHandle> arrs;
+    names.reserve(args.size());
+    arrs.reserve(args.size());
+    for (const auto &kv : args) {
+      names.push_back(kv.first.c_str());
+      arrs.push_back(kv.second->get());
+    }
+    int n_out = 0;
+    Check(MXExecutorForward(handle_, is_train ? 1 : 0,
+                            static_cast<int>(args.size()), names.data(),
+                            arrs.data(), &n_out),
+          "MXExecutorForward");
+  }
+
+  std::vector<NDArray> Outputs(int max_out = 16) {
+    std::vector<NDArrayHandle> raw(static_cast<size_t>(max_out));
+    int n = 0;
+    Check(MXExecutorOutputs(handle_, max_out, raw.data(), &n),
+          "MXExecutorOutputs");
+    std::vector<NDArray> out;
+    out.reserve(n);
+    for (int i = 0; i < n; ++i) out.emplace_back(raw[i]);
+    return out;
+  }
+
+  void Backward() {
+    Check(MXExecutorBackward(handle_, 0, nullptr), "MXExecutorBackward");
+  }
+
+  NDArray ArgGrad(const std::string &name) {
+    NDArrayHandle g = nullptr;
+    Check(MXExecutorArgGrad(handle_, name.c_str(), &g),
+          "MXExecutorArgGrad");
+    return NDArray(g);
+  }
+
+ private:
+  ExecutorHandle handle_ = nullptr;
+};
+
 inline std::vector<std::string> ListOps() {
   ListHandle lst = nullptr;
   Check(MXListAllOpNames(&lst), "MXListAllOpNames");

@@ -1067,3 +1067,24 @@ def test_wait_and_infer_type_and_children(capi):
     assert capi.MXSymbolGetChildren(x, ctypes.byref(bad)) == -1
     for h in (kids, dot, x, w):
         capi.MXSymbolFree(h)
+
+
+def test_cpp_binding_train_program(capi, tmp_path):
+    """The cpp-package mlp.cpp workflow in idiomatic C++: RAII Symbol
+    composition + Executor + eager-Invoke SGD over the header-only
+    binding, trained to convergence with no Python on the call path."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    exe = str(tmp_path / "train_mlp_cpp")
+    libdir = os.path.join(ROOT, "mxnet_tpu", "_lib")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         os.path.join(ROOT, "example/cpp-package/train_mlp.cpp"),
+         "-I", os.path.join(ROOT, "include"), "-o", exe,
+         "-L", libdir, "-lmxtpu_capi", f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True)
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "PASS" in out.stdout
