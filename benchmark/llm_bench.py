@@ -114,11 +114,14 @@ def main():
         else:
             pc = p
         out, _ = fn(pc, x, key=key)
-        logits = out.astype(jnp.float32)
-        # next-token LM loss over L-1 positions
-        logp = jax.nn.log_softmax(logits[:, :-1])
-        nll = -jnp.take_along_axis(logp, x[:, 1:, None], axis=-1).mean()
-        return nll
+        # next-token LM loss over L-1 positions via the fused Pallas CE
+        # (single-pass lse; no fp32 (B*L, V) log_softmax materialization)
+        from mxnet_tpu.ops.nn import softmax_cross_entropy
+        v = out.shape[-1]
+        nll = softmax_cross_entropy(
+            out[:, :-1].reshape(-1, v), x[:, 1:].reshape(-1),
+            per_example=True)
+        return nll.mean()  # per-row NLL is already f32
 
     def train_step(p, vel, x, key):
         loss, grads = jax.value_and_grad(loss_fn)(p, x, key)

@@ -178,6 +178,19 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def forward(self, pred, label, sample_weight=None):
+        axis = self._axis if self._axis >= 0 else pred.ndim + self._axis
+        if (self._sparse_label and not self._from_logits
+                and axis == pred.ndim - 1):
+            # fused path: lse - picked in one pass (Pallas on TPU) instead
+            # of materializing log_softmax over the class axis; out-of-range
+            # labels clip, matching npx.pick's default mode on the old path
+            n_cls = pred.shape[-1]
+            nll = npx.softmax_cross_entropy(
+                pred.reshape(-1, n_cls),
+                np.clip(label.reshape(-1), 0, n_cls - 1), per_example=True)
+            loss = nll.reshape(label.shape)
+            loss = _apply_weighting(loss, self._weight, sample_weight)
+            return np.mean(loss, axis=tuple(range(1, loss.ndim))) if loss.ndim > 1 else loss
         if not self._from_logits:
             pred = npx.log_softmax(pred, axis=self._axis)
         if self._sparse_label:

@@ -195,6 +195,14 @@ def log_softmax(x, axis=-1, temperature=None):
     return _call(lambda v: _nn.log_softmax(v, axis=axis, temperature=temperature), (x,), name="log_softmax")
 
 
+def softmax_cross_entropy(data, label, per_example=False):
+    """Sparse-label CE over (N, V) logits — Pallas single-pass lse on TPU
+    (ops/pallas/cross_entropy.py); reference loss_binary_op.cc contract."""
+    return _call(
+        lambda d, l: _nn.softmax_cross_entropy(d, l, per_example=per_example),
+        (data, label), name="softmax_cross_entropy")
+
+
 def masked_softmax(x, mask, axis=-1, temperature=1.0):
     return _call(lambda v, m: _nn.masked_softmax(v, m, axis=axis, temperature=temperature), (x, mask), name="masked_softmax")
 
@@ -633,19 +641,6 @@ def smooth_l1(data, scalar=1.0):
                          absx - 0.5 / s2)
 
     return _call(fn, (data,), name="smooth_l1")
-
-
-def softmax_cross_entropy(data, label):
-    """reference src/operator/loss_binary_op.cc: summed cross entropy of
-    softmax(data) (B, C) against integer labels (B,). Returns a scalar."""
-
-    def fn(d, l):
-        logp = jax.nn.log_softmax(d.astype(jnp.float32), axis=-1)
-        picked = jnp.take_along_axis(
-            logp, l.astype(jnp.int32)[:, None], axis=-1)
-        return -picked.sum()
-
-    return _call(fn, (data, label), name="softmax_cross_entropy")
 
 
 def reshape(data, newshape, reverse=False):

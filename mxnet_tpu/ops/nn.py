@@ -460,6 +460,42 @@ def log_softmax(x, axis=-1, temperature=None):
     return jax.nn.log_softmax(x, axis=axis)
 
 
+def softmax_cross_entropy(data, label, per_example=False):
+    """Sparse-label softmax cross entropy (reference
+    src/operator/loss_binary_op.cc:30 ``softmax_cross_entropy``).
+
+    ``data`` (N, V) logits, ``label`` (N,) class indices. The default
+    matches the reference contract: a shape-(1,) SUM over rows of
+    ``-log(max(softmax(data)[i, label[i]], 1e-8))``
+    (loss_binary_op-inl.h:44-57). ``per_example=True`` returns the
+    unclamped per-row NLL instead (the gluon-loss building block).
+
+    On TPU the row reduction is the single-pass Pallas online-lse kernel
+    (ops/pallas/cross_entropy.py) — the logits stream HBM→VMEM once,
+    instead of the reference's materialized-softmax workspace or XLA's
+    two-pass max+sumexp lowering. Elsewhere: fused XLA lse. Rows with a
+    negative label contribute 0 (ignore-index).
+    """
+    if data.ndim != 2 or label.ndim != 1:
+        raise ValueError(
+            f"softmax_cross_entropy expects (N, V) data and (N,) label, "
+            f"got {data.shape} / {label.shape}")
+    lab = label.astype(jnp.int32)
+    if jax.default_backend() == "tpu":
+        from .pallas.cross_entropy import cross_entropy_with_logits
+        nll = cross_entropy_with_logits(data, lab)
+    else:
+        x = data.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(x, axis=-1)
+        picked = jnp.take_along_axis(x, jnp.clip(lab, 0, None)[:, None],
+                                     axis=-1)[:, 0]
+        nll = jnp.where(lab >= 0, lse - picked, 0.0)
+    if per_example:
+        return nll  # f32: per-row NLL keeps full precision for reductions
+    nll = jnp.minimum(nll, -jnp.log(jnp.float32(1e-8)))
+    return jnp.sum(nll, keepdims=True).astype(data.dtype)
+
+
 def masked_softmax(x, mask, axis=-1, temperature=1.0):
     x = x / temperature
     neg = jnp.asarray(jnp.finfo(x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32).min, x.dtype)

@@ -1,0 +1,159 @@
+"""Fused softmax cross-entropy: the Pallas single-pass lse kernel
+(mxnet_tpu/ops/pallas/cross_entropy.py), the reference-contract op
+(src/operator/loss_binary_op.cc softmax_cross_entropy), and the gluon
+loss fused path. The kernel itself runs in Pallas interpreter mode on
+CPU so the suite exercises the same logic the TPU compiles."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, npx
+from mxnet_tpu import numpy as np
+from mxnet_tpu.ops.pallas.cross_entropy import (cross_entropy_with_logits,
+                                                fused_lse)
+
+
+def _oracle_nll(x, lab):
+    lse = jax.scipy.special.logsumexp(x.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        x.astype(jnp.float32), jnp.clip(lab, 0, None)[:, None], -1)[:, 0]
+    return jnp.where(lab >= 0, lse - picked, 0.0)
+
+
+@pytest.mark.parametrize("n,v", [(7, 129), (64, 1000), (33, 4096)])
+def test_fused_lse_matches_scipy(n, v):
+    x = jnp.array(onp.random.randn(n, v).astype("float32") * 4)
+    got = fused_lse(x, interpret=True)
+    want = jax.scipy.special.logsumexp(x, axis=-1)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_forward_backward_oracle():
+    n, v = 45, 777
+    x = jnp.array(onp.random.randn(n, v).astype("float32") * 3)
+    lab = jnp.array(onp.random.randint(0, v, (n,)).astype("int32"))
+    lab = lab.at[3].set(-1)  # ignore-index row
+    got = cross_entropy_with_logits(x, lab)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(_oracle_nll(x, lab)),
+                                rtol=1e-5, atol=1e-5)
+    g = jax.grad(lambda z: cross_entropy_with_logits(z, lab).sum())(x)
+    gr = jax.grad(lambda z: _oracle_nll(z, lab).sum())(x)
+    onp.testing.assert_allclose(onp.asarray(g), onp.asarray(gr),
+                                rtol=1e-4, atol=1e-5)
+    # ignored row gets zero gradient
+    assert float(jnp.abs(g[3]).max()) == 0.0
+
+
+def test_kernel_bf16():
+    n, v = 16, 512
+    x32 = onp.random.randn(n, v).astype("float32")
+    x = jnp.array(x32).astype(jnp.bfloat16)
+    lab = jnp.array(onp.random.randint(0, v, (n,)).astype("int32"))
+    got = cross_entropy_with_logits(x, lab)
+    want = _oracle_nll(jnp.array(x32).astype(jnp.bfloat16), lab)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                rtol=2e-2, atol=2e-2)
+
+
+def test_npx_op_reference_contract():
+    """shape-(1,) sum with the 1e-8 clamp, loss_binary_op-inl.h:44-57."""
+    n, v = 12, 50
+    data = np.array(onp.random.randn(n, v).astype("float32"))
+    label = np.array(onp.random.randint(0, v, (n,)).astype("float32"))
+    out = npx.softmax_cross_entropy(data, label)
+    assert out.shape == (1,)
+    logits = onp.asarray(data)
+    lse = onp.log(onp.exp(logits).sum(-1))
+    nll = lse - logits[onp.arange(n), onp.asarray(label).astype(int)]
+    onp.testing.assert_allclose(onp.asarray(out)[0], nll.sum(), rtol=1e-4)
+    # clamp: a certain-wrong row contributes at most -log(1e-8)
+    data2 = np.array(onp.full((1, 3), 0.0, "float32"))
+    data2[0, 0] = 200.0
+    out2 = npx.softmax_cross_entropy(data2, np.array([2.0]))
+    onp.testing.assert_allclose(onp.asarray(out2)[0], -onp.log(1e-8),
+                                rtol=1e-5)
+
+
+def test_npx_op_autograd():
+    n, v = 9, 21
+    data = np.array(onp.random.randn(n, v).astype("float32"))
+    label = np.array(onp.random.randint(0, v, (n,)).astype("int32"))
+    data.attach_grad()
+    with autograd.record():
+        loss = npx.softmax_cross_entropy(data, label, per_example=True).sum()
+    loss.backward()
+    x = jnp.array(onp.asarray(data))
+    lab = jnp.array(onp.asarray(label))
+    want = jax.grad(lambda z: _oracle_nll(z, lab).sum())(x)
+    onp.testing.assert_allclose(onp.asarray(data.grad), onp.asarray(want),
+                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(8, 10), (4, 6, 10)])
+def test_gluon_loss_fused_path_parity(shape):
+    """The fused sparse path must equal the log_softmax+pick path."""
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    pred = np.array(onp.random.randn(*shape).astype("float32"))
+    label = np.array(onp.random.randint(0, shape[-1], shape[:-1]).astype("float32"))
+    fused = SoftmaxCrossEntropyLoss()(pred, label)
+    manual = -npx.pick(npx.log_softmax(pred, axis=-1), label, axis=-1)
+    if manual.ndim > 1:
+        manual = np.mean(manual, axis=tuple(range(1, manual.ndim)))
+    onp.testing.assert_allclose(onp.asarray(fused), onp.asarray(manual),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_loss_fused_path_grad_and_weighting():
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    n, v = 6, 11
+    pred = np.array(onp.random.randn(n, v).astype("float32"))
+    label = np.array(onp.random.randint(0, v, (n,)).astype("float32"))
+    sw = np.array(onp.random.rand(n).astype("float32"))
+    pred.attach_grad()
+    with autograd.record():
+        loss = SoftmaxCrossEntropyLoss(weight=0.5)(pred, label, sw).sum()
+    loss.backward()
+    x = jnp.array(onp.asarray(pred))
+    lab = jnp.array(onp.asarray(label)).astype(jnp.int32)
+    w = jnp.array(onp.asarray(sw)) * 0.5
+
+    def ref(z):
+        return (_oracle_nll(z, lab) * w).sum()
+
+    onp.testing.assert_allclose(onp.asarray(pred.grad),
+                                onp.asarray(jax.grad(ref)(x)),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_gluon_loss_nonlast_axis_still_works():
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    pred = np.array(onp.random.randn(5, 7, 3).astype("float32"))
+    label = np.array(onp.random.randint(0, 7, (5, 3)).astype("float32"))
+    got = SoftmaxCrossEntropyLoss(axis=1)(pred, label)
+    manual = -npx.pick(npx.log_softmax(pred, axis=1), label, axis=1)
+    manual = np.mean(manual, axis=tuple(range(1, manual.ndim)))
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(manual),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_hybridized_block_with_fused_loss():
+    """The fused op must be trace-transparent (jit inside hybridize)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(13)
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = np.array(onp.random.randn(4, 8).astype("float32"))
+    y = np.array(onp.random.randint(0, 13, (4,)).astype("float32"))
+    eager = loss_fn(net(x), y)
+    net.hybridize()
+    traced = loss_fn(net(x), y)
+    onp.testing.assert_allclose(onp.asarray(eager), onp.asarray(traced),
+                                rtol=1e-5, atol=1e-6)
