@@ -335,11 +335,13 @@ class ndarray:
     # ------------------------------------------------------------------
     # reductions / common methods
     # ------------------------------------------------------------------
-    def sum(self, axis=None, keepdims=False) -> "ndarray":
-        return apply_op(lambda x: jnp.sum(x, axis=axis, keepdims=keepdims), (self,), name="sum")
+    def sum(self, axis=None, dtype=None, keepdims=False) -> "ndarray":
+        dt = dtype_from_any(dtype) if dtype is not None else None
+        return apply_op(lambda x: jnp.sum(x, axis=axis, dtype=dt, keepdims=keepdims), (self,), name="sum")
 
-    def mean(self, axis=None, keepdims=False) -> "ndarray":
-        return apply_op(lambda x: jnp.mean(x, axis=axis, keepdims=keepdims), (self,), name="mean")
+    def mean(self, axis=None, dtype=None, keepdims=False) -> "ndarray":
+        dt = dtype_from_any(dtype) if dtype is not None else None
+        return apply_op(lambda x: jnp.mean(x, axis=axis, dtype=dt, keepdims=keepdims), (self,), name="mean")
 
     def max(self, axis=None, keepdims=False) -> "ndarray":
         return apply_op(lambda x: jnp.max(x, axis=axis, keepdims=keepdims), (self,), name="max")
@@ -347,8 +349,9 @@ class ndarray:
     def min(self, axis=None, keepdims=False) -> "ndarray":
         return apply_op(lambda x: jnp.min(x, axis=axis, keepdims=keepdims), (self,), name="min")
 
-    def prod(self, axis=None, keepdims=False) -> "ndarray":
-        return apply_op(lambda x: jnp.prod(x, axis=axis, keepdims=keepdims), (self,), name="prod")
+    def prod(self, axis=None, dtype=None, keepdims=False) -> "ndarray":
+        dt = dtype_from_any(dtype) if dtype is not None else None
+        return apply_op(lambda x: jnp.prod(x, axis=axis, dtype=dt, keepdims=keepdims), (self,), name="prod")
 
     def all(self, axis=None, keepdims=False) -> "ndarray":
         return apply_op(lambda x: jnp.all(x, axis=axis, keepdims=keepdims), (self,), name="all")

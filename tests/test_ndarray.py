@@ -141,22 +141,6 @@ def test_random():
     assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 5
 
 
-@pytest.mark.skipif(not os.environ.get("MXNET_TEST_LARGE"),
-                    reason="large-tensor test: set MXNET_TEST_LARGE=1 "
-                           "(allocates >2^31 elements, ~2.5 GB)")
-def test_large_array_int64_indexing():
-    """int64 tensor support (reference tests/nightly/test_large_array.py,
-    USE_INT64_TENSOR_SIZE): element count beyond int32 range must
-    index/reduce correctly (jax_enable_x64 is on at import)."""
-    n = 2 ** 31 + 16
-    a = mx.np.zeros((n,), dtype="int8")
-    assert a.size == n  # size itself overflows int32
-    b = mx.npx.index_update(a, mx.np.array([[n - 1]]),
-                            mx.np.array([7], dtype="int8"))
-    assert int(b[-1]) == 7
-    assert int(b[: n - 1].sum()) == 0
-
-
 def test_fluent_methods_match_reference_surface():
     """The reference keeps a small REAL fluent set on np ndarray
     (multiarray.py sort/argsort/std/var/repeat/tile/nonzero/
