@@ -35,6 +35,40 @@ class _StepAdapter(HybridBlock):
         return self.model.decode_step(tokens, cache_k, cache_v, pos)
 
 
+_DECODE_CACHE_MAX = 16
+
+
+def _decode_cache(model, ckey):
+    """LRU-bounded per-model cache of compiled decode programs, guarded by
+    the block's trace lock (same lifecycle as ``_cached_graphs``: stripped
+    on pickle in Block.__getstate__). Returns (store_fn, cached_or_None);
+    the lock covers check→insert so concurrent same-config callers share
+    one program instead of compiling twice."""
+    import threading
+
+    lock = getattr(model, "_trace_lock", None)
+    if lock is None:  # non-Block models still get a per-model lock
+        lock = model.__dict__.setdefault("_decode_cache_lock",
+                                         threading.RLock())
+    with lock:
+        cache = model.__dict__.setdefault("_decode_jit_cache", {})
+        fn = cache.get(ckey)
+        if fn is not None:
+            cache[ckey] = cache.pop(ckey)  # LRU bump
+
+    def store(jrun):
+        with lock:
+            got = cache.get(ckey)
+            if got is not None:  # another thread won the race
+                return got
+            cache[ckey] = jrun
+            while len(cache) > _DECODE_CACHE_MAX:
+                cache.pop(next(iter(cache)))
+            return jrun
+
+    return store, fn
+
+
 def _sample(logits, key, greedy, temperature, top_k):
     """Pick next tokens from (B, V) logits, on device."""
     if greedy:
@@ -92,6 +126,22 @@ def generate(model, prompt_ids, max_new_tokens: int,
     prompt, b, p, ck, cv, step_fn, params = _prep(
         model, prompt_ids, max_new_tokens, max_length)
 
+    # Memoize the compiled program on the model: a fresh closure every
+    # call would miss jax.jit's trace cache and recompile each generate()
+    # (observed as a ~20s "decode" on TPU). The cached trace is reusable
+    # because step_fn is pure — current weights enter through ``params``.
+    # Key on the RESOLVED length (max_length=None and max_length=p+new are
+    # the same program) and drop sampling knobs that are dead under greedy.
+    lmax = max_length or (p + max_new_tokens)
+    tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
+    ckey = ("generate", b, p, max_new_tokens, lmax, greedy, *tkey,
+            int(eos_token))
+    store, cached = _decode_cache(model, ckey)
+    if cached is not None:
+        out = cached(params, _unwrap(prompt), _unwrap(ck), _unwrap(cv),
+                     jax.random.PRNGKey(seed))
+        return _wrap(out)
+
     def run(params, prompt_v, ck_v, cv_v, key):
         (logits, ck_v, cv_v), _ = step_fn(
             params, prompt_v, ck_v, cv_v, jnp.zeros((), jnp.int32))
@@ -117,8 +167,9 @@ def generate(model, prompt_ids, max_new_tokens: int,
             return jnp.concatenate([first[:, None], rest.T], axis=1)
         return first[:, None]
 
-    out = jax.jit(run)(params, _unwrap(prompt), _unwrap(ck), _unwrap(cv),
-                       jax.random.PRNGKey(seed))
+    jrun = store(jax.jit(run))
+    out = jrun(params, _unwrap(prompt), _unwrap(ck), _unwrap(cv),
+               jax.random.PRNGKey(seed))
     return _wrap(out)
 
 
@@ -142,6 +193,17 @@ def beam_search(model, prompt_ids, max_new_tokens: int, beam_size: int = 4,
         model, prompt_ids, max_new_tokens, max_length)
 
     neg_inf = -1e9
+
+    # same memoization as generate(): one compiled program per static
+    # decode config, current weights flow through ``params``
+    ckey = ("beam", b, p, max_new_tokens,
+            max_length or (p + max_new_tokens), k, float(alpha),
+            int(eos_token))
+    store, cached = _decode_cache(model, ckey)
+    if cached is not None:
+        seqs, scores = cached(params, _unwrap(prompt), _unwrap(ck),
+                              _unwrap(cv))
+        return _wrap(seqs), _wrap(scores)
 
     def run(params, prompt_v, ck_v, cv_v):
         (logits, ck_s, cv_s), _ = step_fn(
@@ -209,6 +271,6 @@ def beam_search(model, prompt_ids, max_new_tokens: int, beam_size: int = 4,
         return (jnp.take_along_axis(seqs_f, order[:, :, None], axis=1),
                 jnp.take_along_axis(final, order, axis=1))
 
-    seqs, scores = jax.jit(run)(params, _unwrap(prompt), _unwrap(ck),
-                                _unwrap(cv))
+    jrun = store(jax.jit(run))
+    seqs, scores = jrun(params, _unwrap(prompt), _unwrap(ck), _unwrap(cv))
     return _wrap(seqs), _wrap(scores)

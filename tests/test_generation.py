@@ -151,3 +151,35 @@ def test_beam_search_batched_and_eos():
     seqs2, _ = beam_search(net, prompt[:1], max_new_tokens=4, beam_size=2,
                            eos_token=eos, alpha=0.0)
     assert (seqs2.asnumpy()[0, 0] == eos).all()
+
+
+@pytest.mark.seed(23)
+def test_generate_trace_cache_reused_and_weight_fresh():
+    """generate() memoizes its compiled program per static decode config
+    (a fresh jit per call recompiled every time); the cached program must
+    still see CURRENT weights, which flow through the params argument."""
+    net = _tiny_lm(seed=5)
+    prompt = onp.array([[2, 4, 6], [1, 3, 5]], onp.int32)
+    out1 = generate(net, prompt, max_new_tokens=4, max_length=32).asnumpy()
+    assert len(net._decode_jit_cache) == 1
+    out2 = generate(net, prompt, max_new_tokens=4, max_length=32).asnumpy()
+    assert len(net._decode_jit_cache) == 1  # same config -> cache hit
+    onp.testing.assert_array_equal(out1, out2)
+    # greedy ignores temperature/top_k: key normalizes them -> still 1
+    generate(net, prompt, max_new_tokens=4, max_length=32, temperature=0.7)
+    assert len(net._decode_jit_cache) == 1
+    # different static config -> second entry
+    generate(net, prompt, max_new_tokens=5, max_length=32)
+    assert len(net._decode_jit_cache) == 2
+    # the cache must not break pickling (Block.__getstate__ strips it)
+    import pickle
+    net2 = pickle.loads(pickle.dumps(net))
+    assert not getattr(net2, "_decode_jit_cache", {})
+    # mutate weights: the cached program must produce the NEW model's output
+    ref_net = _tiny_lm(seed=99)
+    for k, p in net.collect_params().items():
+        p.set_data(ref_net.collect_params()[k].data())
+    got = generate(net, prompt, max_new_tokens=4, max_length=32).asnumpy()
+    want = _greedy_recompute(ref_net, prompt, 4)
+    onp.testing.assert_array_equal(got, want)
+    assert len(net._decode_jit_cache) == 2  # no retrace for new weights
