@@ -71,7 +71,8 @@ def __getattr__(name):
                "viz": ".visualization",
                "lr_scheduler": ".optimizer.lr_scheduler",
                "registry": ".registry", "executor": ".executor",
-               "recordio": ".recordio", "serialization": ".serialization"}
+               "recordio": ".recordio", "serialization": ".serialization",
+               "misc": ".misc", "torch": ".torch"}
     if name in targets:
         expected = importlib.util.resolve_name(targets[name], __name__)
         try:

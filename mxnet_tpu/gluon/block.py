@@ -349,7 +349,6 @@ class HybridBlock(Block):
         d["_forward_hooks"] = []
         d["_forward_pre_hooks"] = []
         d.pop("_trace_lock", None)  # locks don't pickle
-        d.pop("_decode_jit_cache", None)  # generation.py decode programs
         return d
 
     def __setstate__(self, state):

@@ -10,6 +10,8 @@ happen on device inside the scan.
 """
 from __future__ import annotations
 
+import threading
+import weakref
 from typing import Optional
 
 import jax
@@ -36,28 +38,33 @@ class _StepAdapter(HybridBlock):
 
 
 _DECODE_CACHE_MAX = 16
+# model -> {ckey: jitted program}; a WeakKeyDictionary so cached programs
+# die with the model and NOTHING is stored on the model itself (pickling
+# any model type keeps working — no lock/jit objects in __dict__)
+_DECODE_CACHES = weakref.WeakKeyDictionary()
+_DECODE_CACHES_LOCK = threading.RLock()
+
+
+def _decode_jit_entries(model):
+    """Test/introspection hook: the live decode-program cache for a model."""
+    with _DECODE_CACHES_LOCK:
+        return dict(_DECODE_CACHES.get(model) or {})
 
 
 def _decode_cache(model, ckey):
-    """LRU-bounded per-model cache of compiled decode programs, guarded by
-    the block's trace lock (same lifecycle as ``_cached_graphs``: stripped
-    on pickle in Block.__getstate__). Returns (store_fn, cached_or_None);
-    the lock covers check→insert so concurrent same-config callers share
-    one program instead of compiling twice."""
-    import threading
-
-    lock = getattr(model, "_trace_lock", None)
-    if lock is None:  # non-Block models still get a per-model lock
-        lock = model.__dict__.setdefault("_decode_cache_lock",
-                                         threading.RLock())
-    with lock:
-        cache = model.__dict__.setdefault("_decode_jit_cache", {})
+    """LRU-bounded per-model cache of compiled decode programs. Returns
+    (store_fn, cached_or_None); the lock covers check→insert so concurrent
+    same-config callers share one program instead of compiling twice."""
+    with _DECODE_CACHES_LOCK:
+        cache = _DECODE_CACHES.get(model)
+        if cache is None:
+            cache = _DECODE_CACHES[model] = {}
         fn = cache.get(ckey)
         if fn is not None:
             cache[ckey] = cache.pop(ckey)  # LRU bump
 
     def store(jrun):
-        with lock:
+        with _DECODE_CACHES_LOCK:
             got = cache.get(ckey)
             if got is not None:  # another thread won the race
                 return got
@@ -108,7 +115,7 @@ def _prep(model, prompt_ids, max_new_tokens, max_length):
     adapter = _StepAdapter(model)
     pos0 = mxnp.array(onp.zeros((), onp.int32))
     step_fn, params = adapter.functionalize(prompt, ck, cv, pos0)
-    return prompt, b, p, ck, cv, step_fn, params
+    return prompt, b, p, lmax, ck, cv, step_fn, params
 
 
 def generate(model, prompt_ids, max_new_tokens: int,
@@ -123,16 +130,15 @@ def generate(model, prompt_ids, max_new_tokens: int,
     has emitted it, remaining positions repeat it (the scan still runs to
     length — static shapes — but the output is clean).
     """
-    prompt, b, p, ck, cv, step_fn, params = _prep(
+    prompt, b, p, lmax, ck, cv, step_fn, params = _prep(
         model, prompt_ids, max_new_tokens, max_length)
 
-    # Memoize the compiled program on the model: a fresh closure every
+    # Memoize the compiled program per model: a fresh closure every
     # call would miss jax.jit's trace cache and recompile each generate()
     # (observed as a ~20s "decode" on TPU). The cached trace is reusable
     # because step_fn is pure — current weights enter through ``params``.
     # Key on the RESOLVED length (max_length=None and max_length=p+new are
     # the same program) and drop sampling knobs that are dead under greedy.
-    lmax = max_length or (p + max_new_tokens)
     tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
     ckey = ("generate", b, p, max_new_tokens, lmax, greedy, *tkey,
             int(eos_token))
@@ -189,15 +195,14 @@ def beam_search(model, prompt_ids, max_new_tokens: int, beam_size: int = 4,
     # caches allocated at batch B: prefill runs un-tiled, the K-fold tile
     # happens on device from the prefill result (no B*K zero buffers ever
     # cross host->device)
-    prompt, b, p, ck, cv, step_fn, params = _prep(
+    prompt, b, p, lmax, ck, cv, step_fn, params = _prep(
         model, prompt_ids, max_new_tokens, max_length)
 
     neg_inf = -1e9
 
     # same memoization as generate(): one compiled program per static
     # decode config, current weights flow through ``params``
-    ckey = ("beam", b, p, max_new_tokens,
-            max_length or (p + max_new_tokens), k, float(alpha),
+    ckey = ("beam", b, p, max_new_tokens, lmax, k, float(alpha),
             int(eos_token))
     store, cached = _decode_cache(model, ckey)
     if cached is not None:

@@ -1,0 +1,48 @@
+"""Legacy learning-rate scheduler API (reference python/mxnet/misc.py).
+
+The reference keeps this pre-1.0 module around for backward
+compatibility: an iteration-indexed ``LearningRateScheduler`` base plus
+``FactorScheduler`` (misc.py:24-80), superseded by ``mx.lr_scheduler``.
+Kept here with the same call contract; new code should use
+:mod:`mxnet_tpu.lr_scheduler`.
+"""
+import logging
+import math
+
+__all__ = ["LearningRateScheduler", "FactorScheduler"]
+
+
+class LearningRateScheduler:
+    """Base: ``__call__(iteration) -> lr`` with a mutable ``base_lr``."""
+
+    def __init__(self):
+        self.base_lr = 0.01
+
+    def __call__(self, iteration):
+        raise NotImplementedError("must override this")
+
+
+class FactorScheduler(LearningRateScheduler):
+    """Multiply the lr by ``factor`` every ``step`` iterations."""
+
+    def __init__(self, step, factor=0.1):
+        super().__init__()
+        if step < 1:
+            raise ValueError("Schedule step must be greater or equal than 1 round")
+        if factor >= 1.0:
+            raise ValueError("Factor must be less than 1 to make lr reduce")
+        self.step = step
+        self.factor = factor
+        self.old_lr = self.base_lr
+        self.init = False
+
+    def __call__(self, iteration):
+        if not self.init:
+            self.init = True
+            self.old_lr = self.base_lr
+        lr = self.base_lr * math.pow(self.factor, int(iteration / self.step))
+        if lr != self.old_lr:
+            self.old_lr = lr
+            logging.info("At Iteration [%d]: Switch to new learning rate %.5f",
+                         iteration, lr)
+        return lr

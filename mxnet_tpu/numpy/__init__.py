@@ -1285,6 +1285,13 @@ def fromfile(file, dtype=float32, count=-1, sep=""):
     return _wrap(jnp.asarray(onp.fromfile(file, dtype, count, sep)))
 
 
+def genfromtxt(*args, **kwargs):
+    """numpy.genfromtxt onto a device array (reference numpy/io.py:28;
+    the ctx kwarg is accepted for API parity)."""
+    kwargs.pop("ctx", None)
+    return _wrap(jnp.asarray(onp.genfromtxt(*args, **kwargs)))
+
+
 def fromiter(iterable, dtype, count=-1):
     return _wrap(jnp.asarray(onp.fromiter(iterable, dtype, count)))
 

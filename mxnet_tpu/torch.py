@@ -1,0 +1,45 @@
+"""PyTorch interop (the reference's ``python/mxnet/torch.py`` slot).
+
+The reference module bridged to Lua Torch through luajit + a
+``USE_TORCH=1`` native build (torch.py:17-32) — an ecosystem that no
+longer exists. The TPU-native re-interpretation keeps the module's
+purpose (exchange tensors with the torch ecosystem) via the standard
+DLPack protocol, zero-copy where the backends share memory:
+
+    t  = mx.torch.to_torch(mx.np.ones((2, 3)))      # torch.Tensor
+    a  = mx.torch.from_torch(torch.ones(2, 3))      # mx ndarray
+
+Gated on torch being importable; raises a clear error otherwise.
+"""
+from .ndarray.ndarray import ndarray as _ndarray
+
+__all__ = ["to_torch", "from_torch"]
+
+
+def _require_torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is baked in here
+        raise ImportError(
+            "mxnet_tpu.torch needs PyTorch installed; the reference's "
+            "Lua-Torch bridge (USE_TORCH=1) is obsolete and unsupported"
+        ) from e
+    return torch
+
+
+def to_torch(arr):
+    """mx ndarray -> torch.Tensor via DLPack (zero-copy when possible)."""
+    torch = _require_torch()
+    from . import numpy_extension as npx
+
+    if not isinstance(arr, _ndarray):
+        raise TypeError(f"expected mx ndarray, got {type(arr)}")
+    return torch.from_dlpack(npx.to_dlpack_for_read(arr))
+
+
+def from_torch(tensor):
+    """torch.Tensor -> mx ndarray via DLPack (zero-copy when possible)."""
+    _require_torch()
+    from . import numpy_extension as npx
+
+    return npx.from_dlpack(tensor)

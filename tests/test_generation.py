@@ -8,7 +8,8 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.gluon.model_zoo import bert
-from mxnet_tpu.gluon.model_zoo.generation import generate
+from mxnet_tpu.gluon.model_zoo.generation import (_decode_jit_entries,
+                                                     generate)
 
 
 def _tiny_lm(seed=0, vocab=37, units=16, heads=4, layers=2, max_length=64):
@@ -161,20 +162,22 @@ def test_generate_trace_cache_reused_and_weight_fresh():
     net = _tiny_lm(seed=5)
     prompt = onp.array([[2, 4, 6], [1, 3, 5]], onp.int32)
     out1 = generate(net, prompt, max_new_tokens=4, max_length=32).asnumpy()
-    assert len(net._decode_jit_cache) == 1
+    assert len(_decode_jit_entries(net)) == 1
     out2 = generate(net, prompt, max_new_tokens=4, max_length=32).asnumpy()
-    assert len(net._decode_jit_cache) == 1  # same config -> cache hit
+    assert len(_decode_jit_entries(net)) == 1  # same config -> cache hit
     onp.testing.assert_array_equal(out1, out2)
     # greedy ignores temperature/top_k: key normalizes them -> still 1
     generate(net, prompt, max_new_tokens=4, max_length=32, temperature=0.7)
-    assert len(net._decode_jit_cache) == 1
+    assert len(_decode_jit_entries(net)) == 1
     # different static config -> second entry
     generate(net, prompt, max_new_tokens=5, max_length=32)
-    assert len(net._decode_jit_cache) == 2
-    # the cache must not break pickling (Block.__getstate__ strips it)
+    assert len(_decode_jit_entries(net)) == 2
+    # the cache lives OFF the model (weak-keyed): pickling keeps working
+    # for any model type and a restored copy starts with an empty cache
     import pickle
     net2 = pickle.loads(pickle.dumps(net))
-    assert not getattr(net2, "_decode_jit_cache", {})
+    assert not _decode_jit_entries(net2)
+    assert "_decode_jit_cache" not in net.__dict__
     # mutate weights: the cached program must produce the NEW model's output
     ref_net = _tiny_lm(seed=99)
     for k, p in net.collect_params().items():
@@ -182,4 +185,4 @@ def test_generate_trace_cache_reused_and_weight_fresh():
     got = generate(net, prompt, max_new_tokens=4, max_length=32).asnumpy()
     want = _greedy_recompute(ref_net, prompt, 4)
     onp.testing.assert_array_equal(got, want)
-    assert len(net._decode_jit_cache) == 2  # no retrace for new weights
+    assert len(_decode_jit_entries(net)) == 2  # no retrace for new weights

@@ -364,3 +364,49 @@ def test_error_log_libinfo_modules():
     libs = mx.libinfo.find_lib_path()
     assert all(p.endswith(".so") for p in libs)
     assert mx.libinfo.__version__ == mx.__version__
+
+
+def test_misc_legacy_factor_scheduler():
+    """reference python/mxnet/misc.py FactorScheduler contract."""
+    import mxnet_tpu as mx
+
+    s = mx.misc.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 0.8
+    assert abs(s(0) - 0.8) < 1e-12
+    assert abs(s(10) - 0.4) < 1e-12
+    assert abs(s(25) - 0.2) < 1e-12
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=0)
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=5, factor=1.5)
+    with pytest.raises(NotImplementedError):
+        mx.misc.LearningRateScheduler()(3)
+
+
+def test_torch_interop_roundtrip():
+    """mx.torch: the reference torch.py slot re-done over DLPack."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    a = mx.np.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    t = mx.torch.to_torch(a)
+    assert tuple(t.shape) == (3, 4)
+    back = mx.torch.from_torch(t * 2)
+    onp.testing.assert_allclose(back.asnumpy(), a.asnumpy() * 2)
+    with pytest.raises(TypeError):
+        mx.torch.to_torch(onp.zeros(3))
+
+
+def test_np_genfromtxt():
+    """reference numpy/io.py:28 genfromtxt wrapper (ctx accepted)."""
+    import io
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    buf = io.StringIO("1,2\n3,4\n")
+    a = mx.np.genfromtxt(buf, delimiter=",", ctx=mx.cpu())
+    assert isinstance(a, mx.np.ndarray)
+    onp.testing.assert_allclose(a.asnumpy(), [[1, 2], [3, 4]])
