@@ -165,10 +165,14 @@ def run_benchmark(ops=None, warmup=5, runs=25, log=print):
     return results
 
 
-def run_full_registry(warmup=2, runs=10, log=print):
+def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None):
     """Walk EVERY public op in the registry with auto-synthesized inputs
     (reference opperf auto-enumeration, VERDICT r3 item 8). Eager per-op
-    latency + autograd round trip where differentiable."""
+    latency + autograd round trip where differentiable.
+
+    ``checkpoint``: path that receives the partial table (atomic rewrite)
+    every few ops, so an outer-harness kill mid-sweep loses at most a few
+    measurements instead of the whole table."""
     import jax
 
     from benchmark.opperf.utils.op_registry_utils import (
@@ -189,9 +193,21 @@ def run_full_registry(warmup=2, runs=10, log=print):
     def _alarm(_sig, _frm):
         raise TimeoutError("op exceeded the per-op time budget")
 
+    def _write_checkpoint(partial=True):
+        if checkpoint is None:
+            return
+        results["_meta"].update(measured=measured, skipped=skipped,
+                                errored=errored, partial=partial)
+        tmp = checkpoint + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=1)
+        os.replace(tmp, checkpoint)
+
     old = signal.signal(signal.SIGALRM, _alarm)
     try:
-        for name, fn in sorted(list_all_ops().items()):
+        for i, (name, fn) in enumerate(sorted(list_all_ops().items())):
+            if checkpoint is not None and i % 20 == 0 and i:
+                _write_checkpoint()
             log(f"-> {name}")
             signal.alarm(45)
             try:
@@ -214,7 +230,8 @@ def run_full_registry(warmup=2, runs=10, log=print):
     finally:
         signal.signal(signal.SIGALRM, old)
     results["_meta"].update(measured=measured, skipped=skipped,
-                            errored=errored)
+                            errored=errored, partial=False)
+    _write_checkpoint(partial=False)
     log(f"full registry: {measured} measured, {skipped} skipped, "
         f"{errored} errored")
     return results
@@ -232,6 +249,10 @@ def main():
     ap.add_argument("--full", action="store_true",
                     help="walk the ENTIRE op registry with auto inputs "
                          "(reference opperf auto-enumeration)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="(--full only) atomically rewrite the partial "
+                         "table here every few ops, so a harness kill "
+                         "mid-sweep keeps what was measured")
     args = ap.parse_args()
     if args.cpu:
         import jax
@@ -245,7 +266,8 @@ def main():
             print(f"[opperf] --full clamps warmup/runs to {warmup}/{runs} "
                   "(one pass over ~480 ops)", file=sys.stderr)
         results = run_full_registry(
-            warmup, runs, log=lambda m: print(m, file=sys.stderr))
+            warmup, runs, log=lambda m: print(m, file=sys.stderr),
+            checkpoint=args.checkpoint)
     else:
         ops = set(args.ops.split(",")) if args.ops else None
         results = run_benchmark(ops, args.warmup, args.runs,

@@ -14,6 +14,7 @@ reference's aux-array mutation.
 """
 from __future__ import annotations
 
+import logging
 import re
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -573,7 +574,10 @@ class HybridBlock(Block):
 
             try:
                 self.infer_shape(*args)
-            except Exception:
+            except Exception as e:
+                logging.getLogger(__name__).info(
+                    "abstract infer_shape failed (%r); falling back to one "
+                    "eager predict-mode forward", e)
                 with ag.pause(train_mode=False):
                     super(HybridBlock, self).__call__(*args)
             plist = sorted(self.collect_params().items())

@@ -30,6 +30,17 @@ import threading
 _tls = threading.local()
 
 
+def _trace_state_clean() -> bool:
+    """True when no jax trace is active (safe to materialize a concrete
+    parameter). Falls back to False (= keep the loud DeferredInit error)
+    if the probe is unavailable, never to unsafe self-healing."""
+    try:
+        from jax._src.core import trace_state_clean
+        return bool(trace_state_clean())
+    except Exception:  # noqa: BLE001 — private API moved; stay conservative
+        return False
+
+
 def _tls_override(param) -> Optional[ndarray]:
     overrides = getattr(_tls, "overrides", None)
     if not overrides:
@@ -165,9 +176,27 @@ class Parameter:
         # ensure_compile_time_eval: finalize may run inside an abstract
         # trace (HybridBlock.infer_shape / first traced forward); the
         # parameter array must be CONCRETE or it escapes the trace
+        big = (int(onp.prod(self._shape)) >= (1 << 24)
+               and _jax.default_backend() != "cpu")
         with _jax.ensure_compile_time_eval():
-            arr = ndarray(onp.zeros(self._shape, self.dtype), ctx=ctx)
-            initializer.init_array(self.name, arr)
+            if big:
+                # Very large weights: generate placeholder AND random bits
+                # on the host CPU backend, then stream ONE buffer to the
+                # target device. The axon TPU tunnel's remote_compile
+                # endpoint rejects init programs at these sizes (HTTP 413,
+                # observed on vgg16's 4096x25088 fc weight); threefry bits
+                # are platform-invariant so weights are bit-identical.
+                cpu0 = _jax.devices("cpu")[0]
+                with _jax.default_device(cpu0):
+                    arr = ndarray(onp.zeros(self._shape, self.dtype))
+                    initializer.init_array(self.name, arr)
+                from ..context import Context
+                dev = (ctx.jax_device if isinstance(ctx, Context)
+                       else _jax.devices()[0])
+                arr._set_data(_jax.device_put(arr._data, dev))
+            else:
+                arr = ndarray(onp.zeros(self._shape, self.dtype), ctx=ctx)
+                initializer.init_array(self.name, arr)
         self._data = arr
         self._deferred_init = None
         if self.grad_req != "null":
@@ -184,6 +213,18 @@ class Parameter:
     def _check_initialized(self):
         if self._data is None and _tls_override(self) is None:
             if self._deferred_init is not None:
+                if self.shape_known and _trace_state_clean():
+                    # self-heal: shape became known after initialize()
+                    # (e.g. an infer_shape pass that set shapes but died
+                    # before finalizing, or user-assigned shape) — the
+                    # reference completes deferred init at this point too
+                    # (gluon block.py catches DeferredInitializationError
+                    # and finalizes once shapes are inferable). Inside an
+                    # ACTIVE trace we still raise: finalizing there would
+                    # bake the fresh weight into the cached graph as a
+                    # constant (it is not in the substitution set).
+                    self._finish_deferred_init()
+                    return
                 raise DeferredInitializationError(
                     f"Parameter {self.name} deferred; run a forward pass or set shape"
                 )

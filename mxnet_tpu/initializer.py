@@ -74,6 +74,14 @@ class Initializer:
         elif "running_var" in name or "moving_var" in name:
             arr._set_data(jnp.ones(arr.shape, arr.dtype))
         else:
+            # the key must live on the array's backend (large-weight init
+            # runs on the host CPU backend — parameter._finish_deferred_init
+            # — while the RNG state may be committed to the accelerator)
+            import jax as _jax
+
+            dev = next(iter(arr._data.devices()))
+            if next(iter(key.devices())) != dev:
+                key = _jax.device_put(key, dev)
             self._init_weight(name, arr, key)
 
     def _init_weight(self, name, arr, key):

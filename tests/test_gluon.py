@@ -440,3 +440,21 @@ def test_hybridized_input_gradients_match_eager():
         grads.append(onp.asarray(x.grad))
     assert onp.abs(grads[0]).sum() > 0
     onp.testing.assert_allclose(grads[0], grads[1], rtol=1e-4, atol=1e-6)
+
+
+def test_deferred_param_self_heals_once_shape_known():
+    """A deferred parameter whose shape becomes fully known must complete
+    initialization at first data() access instead of raising — the state
+    a partially-failed infer_shape pass leaves behind (observed: vgg16
+    infer on TPU dying mid-pass left features Dense shapes set but
+    uninitialized, and the eager fallback then crashed)."""
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    p = Parameter("w", shape=(4, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(Exception):
+        p.data()  # shape still unknown -> DeferredInitializationError
+    p.shape = (4, 7)  # shape resolved later (infer_shape / user)
+    d = p.data()  # previously raised; now self-heals
+    assert d.shape == (4, 7)
+    assert p._deferred_init is None
