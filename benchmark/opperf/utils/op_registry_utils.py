@@ -548,6 +548,24 @@ def build_call(name: str, fn: Callable) -> Optional[Tuple[tuple, dict, bool]]:
     return None
 
 
+def fetch_with_timeout(a, seconds: float = 45.0):
+    """Device->host fetch of one element, bounded by a worker-thread
+    timeout. A SIGALRM cannot interrupt a fetch blocked in native code
+    (observed: a mid-sweep tunnel death left the process wedged for
+    minutes past the per-op alarm), so the fetch runs on a daemon thread
+    and a TimeoutError is raised from the caller's thread instead."""
+    import concurrent.futures
+
+    ex = concurrent.futures.ThreadPoolExecutor(1)
+    try:
+        fut = ex.submit(
+            lambda: onp.asarray(a.ravel()[0] if getattr(a, "ndim", 0)
+                                else a))
+        return fut.result(timeout=seconds)
+    finally:
+        ex.shutdown(wait=False)  # never join a wedged fetch thread
+
+
 def _materialize(out) -> None:
     """Block until every array in a (possibly nested) result is real."""
     import jax
@@ -566,6 +584,13 @@ def _materialize(out) -> None:
     walk(out)
     if leaves:
         jax.block_until_ready(leaves)
+        # block_until_ready is not a reliable completion barrier over the
+        # axon TPU tunnel (and async errors surface only at fetch time):
+        # a one-element device->host fetch is — the device executes
+        # in order, so fetching from the LAST leaf covers the whole loop
+        last = leaves[-1]
+        if getattr(last, "size", 0):
+            fetch_with_timeout(last)
 
 
 def bench_registry_op(name: str, fn: Callable, args, kwargs, diff,

@@ -245,6 +245,10 @@ def capture_opperf() -> None:
     # a hang, the child timeout bounds the sweep, and the checkpoint file
     # keeps the partial table if the child is killed mid-sweep
     ckpt = OPPERF + ".ckpt"
+    try:  # a stale checkpoint from a prior sweep must never be re-banked
+        os.remove(ckpt)
+    except OSError:
+        pass
     rc, out = run_child(
         [sys.executable, os.path.join(HERE, "opperf", "opperf.py"),
          "--full", "--checkpoint", ckpt],
@@ -254,11 +258,30 @@ def capture_opperf() -> None:
         try:
             with open(ckpt) as f:
                 rec = json.load(f)
-            log(f"opperf child died (rc={rc}); banking its checkpoint "
+            log(f"opperf child died (rc={rc}); recovering its checkpoint "
                 f"({rec.get('_meta', {}).get('measured')} ops, partial)")
         except Exception:  # noqa: BLE001 — no checkpoint either
             log(f"opperf capture failed (rc={rc})")
             return
+    # MERGE into the banked table (capture_train policy): a partial sweep
+    # must never erase previously measured ops; fresh measurements win
+    try:
+        with open(OPPERF) as f:
+            banked = json.load(f)
+    except Exception:  # noqa: BLE001
+        banked = None
+    if (banked and banked.get("_meta", {}).get("platform") == "tpu"
+            and banked.get("_meta", {}).get("mode") == "full"
+            and rec.get("_meta", {}).get("mode") == "full"
+            and rec.get("_meta", {}).get("platform") == "tpu"):
+        merged = {k: v for k, v in banked.items() if not k.startswith("_")}
+        fresh = {k: v for k, v in rec.items() if not k.startswith("_")}
+        for k, v in fresh.items():
+            if (isinstance(v, list) and v and "error" not in v[0]
+                    and "skipped" not in v[0]) or k not in merged:
+                merged[k] = v
+        merged["_meta"] = rec["_meta"]
+        rec = merged
     if rec.get("_meta", {}).get("platform") == "tpu":
         rec["_meta"]["captured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
