@@ -142,14 +142,14 @@ def bench_op(name, fn, shapes, diff, warmup, runs):
 
         bwd = jax.jit(jax.grad(loss, argnums=tuple(range(len(float_idx)))))
         g = bwd(*[args[i] for i in float_idx])
-        jax.block_until_ready(g)
+        _fetch(g)
         for _ in range(warmup):
             g = bwd(*[args[i] for i in float_idx])
-        jax.block_until_ready(g)
+        _fetch(g)
         t0 = time.perf_counter()
         for _ in range(runs):
             g = bwd(*[args[i] for i in float_idx])
-            jax.block_until_ready(g)
+        _fetch(g)
         result[f"avg_time_backward_{name}"] = round(
             (time.perf_counter() - t0) / runs * 1e3, 4)
     return result
@@ -224,7 +224,7 @@ def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None):
             from benchmark.opperf.utils.op_registry_utils import \
                 fetch_with_timeout
             return float(fetch_with_timeout(_jnp.ones(()) + 1.0,
-                                            seconds=30.0)) == 2.0
+                                            seconds=120.0)) == 2.0
         except Exception:  # noqa: BLE001 — any failure = backend gone
             return False
 
@@ -257,6 +257,9 @@ def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None):
                 results[name] = [{"error": repr(e)}]
                 errored += 1
                 log(f"{name}: ERROR {e!r}")
+                signal.alarm(0)  # disarm BEFORE the canary: a sliver of
+                # leftover alarm budget must not interrupt it, and its
+                # generous timeout lets queued in-order device work drain
                 if not _canary_ok():
                     # the error wasn't the op's own — the backend died
                     # (observed: one async-UNIMPLEMENTED op breaks every

@@ -280,7 +280,18 @@ def capture_opperf() -> None:
             if (isinstance(v, list) and v and "error" not in v[0]
                     and "skipped" not in v[0]) or k not in merged:
                 merged[k] = v
-        merged["_meta"] = rec["_meta"]
+        meta = dict(rec["_meta"])
+        # _meta must describe the MERGED table, not just the fresh run
+        meta["measured"] = sum(
+            1 for v in merged.values()
+            if isinstance(v, list) and v and "avg_time" in str(v[0]))
+        meta["skipped"] = sum(
+            1 for v in merged.values()
+            if isinstance(v, list) and v and "skipped" in v[0])
+        meta["errored"] = sum(
+            1 for v in merged.values()
+            if isinstance(v, list) and v and "error" in v[0])
+        merged["_meta"] = meta
         rec = merged
     if rec.get("_meta", {}).get("platform") == "tpu":
         rec["_meta"]["captured_at"] = time.strftime(

@@ -333,8 +333,43 @@ def batch_norm(
     return out, new_mean, new_var
 
 
+_PALLAS_NORM_STATE = {"ok": None}
+
+
+def _pallas_norm_ok():
+    """One-time Mosaic compile probe for the fused norm kernels on this
+    backend; a failure permanently falls back to the jnp path."""
+    st = _PALLAS_NORM_STATE
+    if st["ok"] is None:
+        try:
+            from .pallas.layer_norm import fused_layer_norm
+            fused_layer_norm(jnp.zeros((8, 128)), jnp.ones((128,)),
+                             jnp.zeros((128,)), 1e-5)
+            st["ok"] = True
+        except Exception:  # noqa: BLE001 — Mosaic quirk: jnp path instead
+            st["ok"] = False
+    return st["ok"]
+
+
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
-    """LayerNorm (reference src/operator/nn/layer_norm.cc)."""
+    """LayerNorm (reference src/operator/nn/layer_norm.cc).
+
+    Last-axis rows ≤8k on TPU run the fused Pallas kernel
+    (ops/pallas/layer_norm.py): one HBM read per element instead of
+    re-reading the row for each reduction. Other axes/widths: jnp."""
+    ax = axis if axis >= 0 else x.ndim + axis
+    if (ax == x.ndim - 1 and x.shape[-1] <= 8192
+            and gamma.ndim == 1 and gamma.shape[0] == x.shape[-1]
+            and beta.ndim == 1 and beta.shape[0] == x.shape[-1]
+            and jax.default_backend() == "tpu" and _pallas_norm_ok()):
+        from .pallas.layer_norm import fused_layer_norm
+        shp = x.shape
+        try:
+            return fused_layer_norm(
+                x.reshape(-1, shp[-1]), gamma, beta,
+                float(eps)).reshape(shp)
+        except Exception:  # noqa: BLE001 — shape-specific Mosaic reject
+            pass  # fall through to the jnp path
     mean = jnp.mean(x, axis=axis, keepdims=True)
     var = jnp.var(x, axis=axis, keepdims=True)
     out = (x - mean) * lax.rsqrt(var + eps)
@@ -368,7 +403,20 @@ def instance_norm(x, gamma, beta, eps=1e-5):
 
 
 def rms_norm(x, gamma, axis=-1, eps=1e-6):
-    """RMSNorm — modern-transformer extension (no reference counterpart)."""
+    """RMSNorm — modern-transformer extension (no reference counterpart).
+    Fused Pallas kernel on TPU for last-axis rows ≤8k (see layer_norm)."""
+    ax = axis if axis >= 0 else x.ndim + axis
+    if (ax == x.ndim - 1 and x.shape[-1] <= 8192
+            and getattr(gamma, "ndim", 0) == 1
+            and gamma.shape[0] == x.shape[-1]
+            and jax.default_backend() == "tpu" and _pallas_norm_ok()):
+        from .pallas.layer_norm import fused_rms_norm
+        shp = x.shape
+        try:
+            return fused_rms_norm(
+                x.reshape(-1, shp[-1]), gamma, float(eps)).reshape(shp)
+        except Exception:  # noqa: BLE001 — shape-specific Mosaic reject
+            pass  # fall through to the jnp path
     ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
     out = x * lax.rsqrt(ms + eps).astype(x.dtype)
     return out * gamma

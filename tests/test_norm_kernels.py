@@ -1,0 +1,130 @@
+"""Fused Pallas LayerNorm/RMSNorm (mxnet_tpu/ops/pallas/layer_norm.py) —
+the third SURVEY §7 Pallas target (softmax/attention/norm). Kernels run
+in interpreter mode here so CPU tests exercise the same logic the TPU
+compiles; the npx wiring keeps its jnp path on CPU (gate tested)."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+from mxnet_tpu import npx
+from mxnet_tpu import numpy as np
+from mxnet_tpu.ops.pallas.layer_norm import fused_layer_norm, fused_rms_norm
+
+
+def _ln_ref(x, g, b, eps=1e-5):
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * g + b
+
+
+def _rms_ref(x, g, eps=1e-6):
+    return x / jnp.sqrt((x * x).mean(-1, keepdims=True) + eps) * g
+
+
+@pytest.mark.parametrize("n,d", [(7, 129), (64, 768), (33, 4000)])
+def test_fused_layer_norm_forward(n, d):
+    x = jnp.array(onp.random.randn(n, d).astype("float32") * 2)
+    g = jnp.array(onp.random.randn(d).astype("float32"))
+    b = jnp.array(onp.random.randn(d).astype("float32"))
+    got = fused_layer_norm(x, g, b, 1e-5, True)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(_ln_ref(x, g, b)),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_fused_layer_norm_grads():
+    n, d = 19, 257
+    x = jnp.array(onp.random.randn(n, d).astype("float32"))
+    g = jnp.array(onp.random.randn(d).astype("float32"))
+    b = jnp.array(onp.random.randn(d).astype("float32"))
+    w = jnp.cos(jnp.arange(d, dtype=jnp.float32))
+
+    def f(x, g, b):
+        return (fused_layer_norm(x, g, b, 1e-5, True) * w).sum()
+
+    def fr(x, g, b):
+        return (_ln_ref(x, g, b) * w).sum()
+
+    for i in range(3):
+        ga = jax.grad(f, i)(x, g, b)
+        gr = jax.grad(fr, i)(x, g, b)
+        onp.testing.assert_allclose(onp.asarray(ga), onp.asarray(gr),
+                                    rtol=1e-4, atol=1e-4)
+
+
+def test_fused_rms_norm_forward_and_grads():
+    n, d = 23, 512
+    x = jnp.array(onp.random.randn(n, d).astype("float32"))
+    g = jnp.array(onp.random.randn(d).astype("float32"))
+    got = fused_rms_norm(x, g, 1e-6, True)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(_rms_ref(x, g)),
+                                rtol=2e-5, atol=2e-5)
+    w = jnp.sin(jnp.arange(d, dtype=jnp.float32))
+
+    def f(x, g):
+        return (fused_rms_norm(x, g, 1e-6, True) * w).sum()
+
+    def fr(x, g):
+        return (_rms_ref(x, g) * w).sum()
+
+    for i in range(2):
+        ga = jax.grad(f, i)(x, g)
+        gr = jax.grad(fr, i)(x, g)
+        onp.testing.assert_allclose(onp.asarray(ga), onp.asarray(gr),
+                                    rtol=1e-4, atol=1e-4)
+
+
+def test_fused_layer_norm_bf16():
+    n, d = 16, 384
+    x32 = onp.random.randn(n, d).astype("float32")
+    x = jnp.array(x32).astype(jnp.bfloat16)
+    g = jnp.ones((d,), jnp.bfloat16)
+    b = jnp.zeros((d,), jnp.bfloat16)
+    got = fused_layer_norm(x, g, b, 1e-5, True).astype(jnp.float32)
+    want = _ln_ref(jnp.array(x32), jnp.ones(d), jnp.zeros(d))
+    assert float(jnp.abs(got - want).max()) < 0.05  # bf16 quantization
+
+
+def test_npx_layer_norm_unchanged_on_cpu():
+    """The npx op keeps its jnp path on CPU (kernel gate is TPU-only)
+    and stays correct for non-last axes."""
+    x = np.array(onp.random.randn(4, 6, 8).astype("float32"))
+    g = np.array(onp.random.randn(6).astype("float32"))
+    b = np.array(onp.random.randn(6).astype("float32"))
+    out = npx.layer_norm(x, g, b, axis=1)
+    xx = onp.asarray(x)
+    mean = xx.mean(1, keepdims=True)
+    var = xx.var(1, keepdims=True)
+    ref = (xx - mean) / onp.sqrt(var + 1e-5) * onp.asarray(g).reshape(1, 6, 1) \
+        + onp.asarray(b).reshape(1, 6, 1)
+    onp.testing.assert_allclose(onp.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_layer_norm_mixed_dtypes_match_jnp_path():
+    """bf16 x with fp32 gamma/beta must promote like the jnp path (fp32
+    out) and backward must return cotangents in each primal's dtype."""
+    n, d = 12, 256
+    x = jnp.array(onp.random.randn(n, d).astype("float32")).astype(jnp.bfloat16)
+    g = jnp.array(onp.random.randn(d).astype("float32"))
+    b = jnp.array(onp.random.randn(d).astype("float32"))
+    out = fused_layer_norm(x, g, b, 1e-5, True)
+    jnp_out = _ln_ref(x, g, b)
+    assert out.dtype == jnp_out.dtype == jnp.float32
+    grads = jax.grad(
+        lambda x, g, b: fused_layer_norm(x, g, b, 1e-5, True).sum(),
+        argnums=(0, 1, 2))(x, g, b)
+    assert grads[0].dtype == jnp.bfloat16
+    assert grads[1].dtype == jnp.float32
+    assert grads[2].dtype == jnp.float32
+
+
+def test_fused_norm_odd_row_counts():
+    """Row blocks round up to the 8-row tile; odd N must still be exact."""
+    for n in (1, 9, 33):
+        x = jnp.array(onp.random.randn(n, 200).astype("float32"))
+        g = jnp.ones((200,))
+        b = jnp.zeros((200,))
+        got = fused_layer_norm(x, g, b, 1e-5, True)
+        onp.testing.assert_allclose(
+            onp.asarray(got), onp.asarray(_ln_ref(x, g, b)),
+            rtol=2e-5, atol=2e-5)
