@@ -59,7 +59,20 @@ class _RecordingStateScope:
 
 
 def record(train_mode: bool = True):
-    """``with autograd.record():`` — start taping ops."""
+    """``with autograd.record():`` — start taping ops.
+
+    Examples
+    --------
+    >>> import mxnet_tpu as mx
+    >>> from mxnet_tpu import autograd
+    >>> x = mx.np.array([2.0, 3.0])
+    >>> x.attach_grad()
+    >>> with autograd.record():
+    ...     y = (x * x).sum()
+    >>> y.backward()
+    >>> [float(g) for g in x.grad]  # d(x^2)/dx = 2x
+    [4.0, 6.0]
+    """
     return _RecordingStateScope(True, train_mode)
 
 

@@ -110,6 +110,18 @@ class CompositeEvalMetric(EvalMetric):
 
 @register
 class Accuracy(EvalMetric):
+    """Top-1 classification accuracy.
+
+    Examples
+    --------
+    >>> import mxnet_tpu as mx
+    >>> m = mx.gluon.metric.Accuracy()
+    >>> preds = mx.np.array([[0.1, 0.9], [0.8, 0.2]])
+    >>> labels = mx.np.array([1, 1])
+    >>> m.update(labels, preds)
+    >>> m.get()
+    ('accuracy', 0.5)
+    """
     def __init__(self, axis=1, name="accuracy", **kw):
         super().__init__(name, **kw)
         self.axis = axis

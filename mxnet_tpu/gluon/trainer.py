@@ -26,6 +26,26 @@ __all__ = ["Trainer"]
 
 
 class Trainer:
+    """Applies an optimizer to a set of gluon parameters.
+
+    Examples
+    --------
+    >>> import numpy as onp
+    >>> import mxnet_tpu as mx
+    >>> from mxnet_tpu import autograd, gluon
+    >>> net = gluon.nn.Dense(1)
+    >>> _ = net.initialize()
+    >>> trainer = gluon.Trainer(net.collect_params(), "sgd",
+    ...                         {"learning_rate": 0.1})
+    >>> x = mx.np.array(onp.ones((4, 2), "float32"))
+    >>> with autograd.record():
+    ...     loss = (net(x) ** 2).mean()
+    >>> loss.backward()
+    >>> trainer.step(batch_size=4)
+    >>> isinstance(float(loss), float)
+    True
+    """
+
     def __init__(
         self,
         params,

@@ -17,6 +17,20 @@ _DIST_TYPES = ("dist_tpu_sync", "dist_sync", "dist_device_sync", "dist_sync_devi
 
 
 def create(name: str = "local"):
+    """Create a KVStore (reference python/mxnet/kvstore/kvstore.py).
+
+    Examples
+    --------
+    >>> import mxnet_tpu as mx
+    >>> kv = mx.kv.create("device")
+    >>> a = mx.np.array([1.0, 2.0])
+    >>> kv.init(3, a)
+    >>> out = mx.np.zeros((2,))
+    >>> kv.push(3, a * 2)
+    >>> kv.pull(3, out=out)
+    >>> [float(v) for v in out]
+    [2.0, 4.0]
+    """
     name = (name or "local").lower()
     if name in _LOCAL_TYPES:
         return KVStoreLocal(name)

@@ -295,12 +295,33 @@ class ndarray:
                 val = jnp.broadcast_to(jnp.asarray(val, self.dtype), self.shape)
             self._set_data(jnp.asarray(val, self.dtype) * jnp.ones(self.shape, self.dtype) if onp.isscalar(val) else jnp.asarray(val, self.dtype))
             return
+        self._check_int_index(key)  # jnp scatter silently drops OOB writes
         key = _unwrap_index(key)
         self._set_data(self._data.at[key].set(jnp.asarray(val, self.dtype) if not onp.isscalar(val) else val))
 
+    def _check_int_index(self, key) -> bool:
+        """numpy contract: out-of-range integer indexing raises IndexError
+        (jnp clamps gathers / drops scatters, which would also make the
+        legacy __getitem__ iteration protocol loop forever). bool is an
+        int subclass but means mask/newaxis indexing — excluded."""
+        if isinstance(key, (int, onp.integer)) and not isinstance(
+                key, (bool, onp.bool_)):
+            if self.ndim == 0:
+                raise IndexError("too many indices for 0-d array")
+            n = self.shape[0]
+            if not -n <= key < n:
+                raise IndexError(
+                    f"index {key} is out of bounds for axis 0 with size {n}")
+
     def __getitem__(self, key) -> "ndarray":
+        self._check_int_index(key)
         key = _unwrap_index(key)
         return apply_op(lambda x: x[key], (self,), name="getitem")
+
+    def __iter__(self):
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d array")
+        return (self[i] for i in range(self.shape[0]))
 
     # ------------------------------------------------------------------
     # shape ops

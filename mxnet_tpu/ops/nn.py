@@ -343,8 +343,15 @@ def _pallas_norm_ok():
     if st["ok"] is None:
         try:
             from .pallas.layer_norm import fused_layer_norm
+            # probe BOTH extremes, and under jit: inside a hybridized
+            # trace a Mosaic reject surfaces at outer-jit compile time
+            # where no fallback is possible, so the probe must cover the
+            # widest padded block the gate admits
             fused_layer_norm(jnp.zeros((8, 128)), jnp.ones((128,)),
                              jnp.zeros((128,)), 1e-5)
+            jax.jit(lambda x, g, b: fused_layer_norm(x, g, b, 1e-5))(
+                jnp.zeros((8, 8192)), jnp.ones((8192,)),
+                jnp.zeros((8192,))).block_until_ready()
             st["ok"] = True
         except Exception:  # noqa: BLE001 — Mosaic quirk: jnp path instead
             st["ok"] = False

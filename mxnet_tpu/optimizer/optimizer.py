@@ -30,6 +30,17 @@ def register(klass):
 
 
 def create(name, **kwargs) -> "Optimizer":
+    """Instantiate a registered optimizer by name.
+
+    Examples
+    --------
+    >>> import mxnet_tpu as mx
+    >>> opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    >>> type(opt).__name__
+    'SGD'
+    >>> opt.learning_rate
+    0.1
+    """
     if isinstance(name, Optimizer):
         return name
     return registry.get("optimizer", name)(**kwargs)

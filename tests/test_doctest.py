@@ -1,0 +1,31 @@
+"""Docstring-example suite (the reference's tests/python/doctest/ role,
+SURVEY §4): every ``>>>`` example in the covered modules is executed and
+its printed output checked. Examples double as the API's quick-start
+documentation, so breaking one means the docs lie."""
+import doctest
+
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.autograd
+import mxnet_tpu.gluon.metric
+import mxnet_tpu.gluon.trainer
+import mxnet_tpu.kvstore
+import mxnet_tpu.optimizer.optimizer
+
+MODULES = [
+    mxnet_tpu.autograd,
+    mxnet_tpu.gluon.metric,
+    mxnet_tpu.gluon.trainer,
+    mxnet_tpu.kvstore,
+    mxnet_tpu.optimizer.optimizer,
+]
+
+
+@pytest.mark.parametrize("mod", MODULES, ids=lambda m: m.__name__)
+def test_module_doctests(mod):
+    res = doctest.testmod(
+        mod, verbose=False,
+        optionflags=doctest.ELLIPSIS | doctest.NORMALIZE_WHITESPACE)
+    assert res.attempted > 0, f"{mod.__name__}: no doctests collected"
+    assert res.failed == 0, f"{mod.__name__}: {res.failed} doctest failures"

@@ -172,3 +172,32 @@ def test_fluent_methods_match_reference_surface():
     # AttributeError raisers (multiarray.py:1733 region)
     for legacy in ("relu", "softmax", "exp", "log", "sigmoid"):
         assert not hasattr(a, legacy)
+
+
+def test_iteration_and_index_bounds():
+    """numpy contract: iteration terminates (requires IndexError on
+    out-of-range ints — jnp clamps, which made `for v in arr` loop
+    forever before this was fixed) and 0-d iteration raises."""
+    a = mx.np.array([1.0, 2.0, 3.0])
+    assert [float(v) for v in a] == [1.0, 2.0, 3.0]
+    assert len(list(iter(a))) == 3
+    with pytest.raises(IndexError):
+        a[3]
+    with pytest.raises(IndexError):
+        a[-4]
+    assert float(a[-1]) == 3.0
+    m = mx.np.array(onp.arange(6.0).reshape(2, 3))
+    assert [v.shape for v in m] == [(3,), (3,)]
+    with pytest.raises(TypeError):
+        iter(mx.np.array(1.0))
+
+
+def test_bool_index_and_setitem_bounds():
+    """bool keys are masks/newaxis, not ints; OOB setitem raises too."""
+    a = mx.np.array([7.0])
+    assert a[True].shape == (1, 1)   # newaxis-style, numpy parity
+    with pytest.raises(IndexError):
+        a[5] = 1.0                   # jnp scatter would silently drop
+    b = mx.np.array([1.0, 2.0, 3.0])
+    b[-1] = 9.0
+    assert float(b[2]) == 9.0
