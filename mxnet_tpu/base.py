@@ -21,6 +21,26 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import numpy as onp
 
+# Honor JAX_PLATFORMS set in the environment even when a sitecustomize
+# imported jax before the env var could take effect (the axon setup pins
+# the platform at interpreter startup, and a dead TPU tunnel then makes
+# the first jax.devices() hang indefinitely — JAX_PLATFORMS=cpu must
+# reliably keep such a process off the tunnel).
+_env_platforms = os.environ.get("JAX_PLATFORMS")
+if (_env_platforms and _env_platforms.startswith("cpu")
+        and not (jax.config.jax_platforms
+                 or "").startswith(_env_platforms)):
+    # Only the CPU-forcing direction is honored: JAX_PLATFORMS=cpu must
+    # keep the process off the accelerator even when a sitecustomize
+    # imported jax (and pinned its own platform) before the env var
+    # could take effect. The reverse direction must NOT apply — test
+    # harnesses pin cpu programmatically while the ambient env still
+    # says the accelerator platform, and re-pinning would undo them.
+    try:
+        jax.config.update("jax_platforms", _env_platforms)
+    except Exception:  # noqa: BLE001 — backends already initialized
+        pass
+
 # int64/float64 tensors are first-class in the reference
 # (USE_INT64_TENSOR_SIZE, tests/nightly/test_large_array.py); enable the
 # wide types in XLA. Default dtype stays float32 — conversion handled in

@@ -299,19 +299,43 @@ class ndarray:
         key = _unwrap_index(key)
         self._set_data(self._data.at[key].set(jnp.asarray(val, self.dtype) if not onp.isscalar(val) else val))
 
-    def _check_int_index(self, key) -> bool:
+    @staticmethod
+    def _is_plain_int(k) -> bool:
+        return isinstance(k, (int, onp.integer)) and not isinstance(
+            k, (bool, onp.bool_))
+
+    def _check_int_index(self, key) -> None:
         """numpy contract: out-of-range integer indexing raises IndexError
         (jnp clamps gathers / drops scatters, which would also make the
         legacy __getitem__ iteration protocol loop forever). bool is an
-        int subclass but means mask/newaxis indexing — excluded."""
-        if isinstance(key, (int, onp.integer)) and not isinstance(
-                key, (bool, onp.bool_)):
-            if self.ndim == 0:
-                raise IndexError("too many indices for 0-d array")
-            n = self.shape[0]
-            if not -n <= key < n:
-                raise IndexError(
-                    f"index {key} is out of bounds for axis 0 with size {n}")
+        int subclass but means mask/newaxis indexing — excluded; array
+        keys are not checked (a bounds check would force a device sync)."""
+
+        def check(k, axis):
+            if self._is_plain_int(k):
+                if axis >= self.ndim:
+                    raise IndexError(
+                        f"too many indices for {self.ndim}-d array")
+                n = self.shape[axis]
+                if not -n <= k < n:
+                    raise IndexError(f"index {k} is out of bounds for "
+                                     f"axis {axis} with size {n}")
+
+        if isinstance(key, tuple):
+            entries = [k for k in key if k is not None]
+            if any(getattr(k, "ndim", 0) > 0 for k in entries):
+                return  # advanced indexing: axis mapping is nontrivial
+            if Ellipsis in [k for k in entries if not hasattr(k, "shape")]:
+                i = next(j for j, k in enumerate(entries) if k is Ellipsis)
+                before, after = entries[:i], entries[i + 1:]
+            else:
+                before, after = entries, []
+            for ax, k in enumerate(before):
+                check(k, ax)
+            for j, k in enumerate(after):
+                check(k, self.ndim - len(after) + j)
+        else:
+            check(key, 0)
 
     def __getitem__(self, key) -> "ndarray":
         self._check_int_index(key)

@@ -201,3 +201,25 @@ def test_bool_index_and_setitem_bounds():
     b = mx.np.array([1.0, 2.0, 3.0])
     b[-1] = 9.0
     assert float(b[2]) == 9.0
+
+
+def test_tuple_index_bounds():
+    """OOB integer components of tuple keys raise per-axis (numpy
+    contract; jnp would clamp reads / drop writes)."""
+    m = mx.np.array(onp.arange(4.0).reshape(2, 2))
+    with pytest.raises(IndexError):
+        m[5, 1]
+    with pytest.raises(IndexError):
+        m[(5,)]
+    with pytest.raises(IndexError):
+        m[5, 1] = 99.0
+    with pytest.raises(IndexError):
+        m[..., 7]
+    assert float(m[..., -1][0]) == 1.0
+    assert float(m[1, -2]) == 2.0
+    t = mx.np.array(onp.arange(8.0).reshape(2, 2, 2))
+    with pytest.raises(IndexError):
+        t[0, ..., 3]
+    # advanced indexing stays ungated
+    idx = mx.np.array([0, 1])
+    assert m[idx, idx].shape == (2,)
