@@ -310,6 +310,8 @@ class ndarray:
         legacy __getitem__ iteration protocol loop forever). bool is an
         int subclass but means mask/newaxis indexing — excluded; array
         keys are not checked (a bounds check would force a device sync)."""
+        if not hasattr(self._data, "ndim"):
+            return  # tuple-valued results (control-flow ops) index as-is
 
         def check(k, axis):
             if self._is_plain_int(k):
