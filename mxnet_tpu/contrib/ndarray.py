@@ -9,6 +9,11 @@ multibox_target = _npx.multibox_target
 multibox_detection = _npx.multibox_detection
 deformable_convolution = _npx.deformable_convolution
 modulated_deformable_convolution = _npx.modulated_deformable_convolution
+hawkesll = _npx.hawkes_ll  # reference spelling (contrib/hawkes_ll.cc)
+hawkes_ll = _npx.hawkes_ll
+round_ste = _npx.round_ste
+sign_ste = _npx.sign_ste
+khatri_rao = _npx.khatri_rao
 
 # legacy 1.x CamelCase op names
 MultiBoxPrior = multibox_prior
@@ -18,5 +23,6 @@ DeformableConvolution = deformable_convolution
 
 __all__ = ["multibox_prior", "multibox_target", "multibox_detection",
            "deformable_convolution", "modulated_deformable_convolution",
+           "hawkesll", "hawkes_ll", "round_ste", "sign_ste", "khatri_rao",
            "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
            "DeformableConvolution"]

@@ -590,6 +590,21 @@ def hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
         name="hawkes_ll", n_out=2)
 
 
+def round_ste(data):
+    """round fwd, straight-through grad (reference contrib/stes_op.cc)."""
+    return _call(_contrib.round_ste, (data,), name="round_ste")
+
+
+def sign_ste(data):
+    """sign fwd, straight-through grad (reference contrib/stes_op.cc)."""
+    return _call(_contrib.sign_ste, (data,), name="sign_ste")
+
+
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (reference contrib/krprod.cc)."""
+    return _call(_contrib.khatri_rao, matrices, name="khatri_rao")
+
+
 # ---------------------------------------------------------------------------
 # activation / math tail (reference src/operator: *_activation, special fns)
 # ---------------------------------------------------------------------------

@@ -25,6 +25,7 @@ __all__ = [
     "deformable_convolution", "modulated_deformable_convolution",
     "hawkes_ll", "index_copy", "gradientmultiplier",
     "multibox_target", "multibox_detection",
+    "round_ste", "sign_ste", "khatri_rao",
 ]
 
 
@@ -542,6 +543,61 @@ def gradientmultiplier(data, scalar=1.0):
     (reference contrib/gradient_multiplier_op.cc:73-90 — negative scalar
     gives the DANN gradient-reversal layer)."""
     return _gradmul(jnp.asarray(data), jnp.asarray(scalar, jnp.float32))
+
+
+def _ste(fwd_fn):
+    """Straight-through estimator: ``fwd_fn`` forward, identity backward
+    (reference contrib/stes_op.cc round_ste/sign_ste — the QAT
+    building block)."""
+
+    @jax.custom_vjp
+    def op(x):
+        return fwd_fn(x)
+
+    op.defvjp(lambda x: (fwd_fn(x), None), lambda _, g: (g,))
+    return op
+
+
+def _round_half_away(x):
+    # the reference rounds half AWAY from zero (mshadow_op round ->
+    # std::roundf), not numpy's half-to-even
+    return jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
+
+
+_round_ste = _ste(_round_half_away)
+_sign_ste = _ste(jnp.sign)
+
+
+def round_ste(data):
+    """round(x) forward (half away from zero, reference semantics),
+    straight-through identity gradient."""
+    return _round_ste(jnp.asarray(data))
+
+
+def sign_ste(data):
+    """sign(x) forward, straight-through identity gradient."""
+    return _sign_ste(jnp.asarray(data))
+
+
+def khatri_rao(*matrices):
+    """Column-wise Kronecker (Khatri-Rao) product (reference
+    contrib/krprod.cc): inputs (n_i, k) -> output (prod n_i, k); one
+    input returns it unchanged. Differentiable via the einsum lowering
+    (the reference needed a dedicated backward kernel, krprod.cc:98)."""
+    if not matrices:
+        raise MXNetError("khatri_rao needs at least one input")
+    mats = [jnp.asarray(m) for m in matrices]
+    k = None
+    for m in mats:
+        if m.ndim != 2 or (k is not None and m.shape[-1] != k):
+            raise MXNetError(
+                f"khatri_rao: all inputs must be 2-D with matching "
+                f"columns, got {[tuple(x.shape) for x in mats]}")
+        k = m.shape[-1]
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, k)
+    return out
 
 
 # ---------------------------------------------------------------------------

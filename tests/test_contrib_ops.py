@@ -528,3 +528,86 @@ def test_multibox_detection_decode_and_nms():
     assert set(kept[:, 0].tolist()) == {0.0, 1.0}
     best = rows[0]
     onp.testing.assert_allclose(best[2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+
+
+def test_khatri_rao_reference_values():
+    """reference tests/python/unittest/test_contrib_krprod.py contracts."""
+    A = mx.np.arange(1, 7).reshape(3, 2).astype("float32")
+    B = mx.np.arange(1, 3).reshape(1, 2).astype("float32")
+    # one input: unchanged
+    onp.testing.assert_allclose(npx.khatri_rao(A).asnumpy(), A.asnumpy())
+    out = npx.khatri_rao(A, B)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                [[1, 4], [3, 8], [5, 12]], rtol=1e-6)
+    B2 = mx.np.arange(1, 9).reshape(4, 2).astype("float32")
+    out2 = npx.khatri_rao(A, B2)
+    onp.testing.assert_allclose(
+        out2.asnumpy(),
+        [[1, 4], [3, 8], [5, 12], [7, 16], [3, 8], [9, 16], [15, 24],
+         [21, 32], [5, 12], [15, 24], [25, 36], [35, 48]], rtol=1e-6)
+    # associativity with three inputs (reference test_krprod_three_inputs)
+    C = mx.np.arange(1, 5).reshape(2, 2).astype("float32")
+    onp.testing.assert_allclose(
+        npx.khatri_rao(A, B, C).asnumpy(),
+        npx.khatri_rao(npx.khatri_rao(A, B), C).asnumpy(), rtol=1e-6)
+    # contrib namespace alias
+    from mxnet_tpu.contrib import ndarray as cnd
+    onp.testing.assert_allclose(cnd.khatri_rao(A, B).asnumpy(),
+                                out.asnumpy())
+
+
+def test_ste_ops_forward_and_straight_through_grad():
+    """reference contrib/stes_op.cc: round/sign forward, identity grad
+    (the test_contrib_stes_op.py w*x contract)."""
+    from mxnet_tpu import autograd
+
+    w = mx.np.array([0.5, 1.5, -0.6]); w.attach_grad()
+    x = mx.np.array([1.0, 2.0, 3.0])
+    with autograd.record():
+        out = (npx.round_ste(w * x) * w).sum()
+    out.backward()
+    # d/dw [round_ste(w*x)*w] = x*w (through STE) + round(w*x);
+    # oracle rounds half AWAY from zero (reference std::roundf, NOT
+    # numpy's half-to-even — w*x hits an exact .5 here by design)
+    wx = onp.asarray(w) * onp.asarray(x)
+    ref_round = onp.where(wx >= 0, onp.floor(wx + 0.5), onp.ceil(wx - 0.5))
+    want = onp.asarray(x) * onp.asarray(w) + ref_round
+    onp.testing.assert_allclose(onp.asarray(w.grad), want, rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(npx.round_ste(mx.np.array([1.4, -1.6]))),
+                                [1.0, -2.0])
+    # ties round half AWAY from zero (reference std::roundf), not to-even
+    onp.testing.assert_allclose(
+        onp.asarray(npx.round_ste(mx.np.array([0.5, 1.5, -0.5, -2.5]))),
+        [1.0, 2.0, -1.0, -3.0])
+    w2 = mx.np.array([0.3, -0.8]); w2.attach_grad()
+    with autograd.record():
+        out2 = (npx.sign_ste(w2 * x[:2]) * w2).sum()
+    out2.backward()
+    want2 = onp.asarray(x[:2]) * onp.asarray(w2) + onp.sign(
+        onp.asarray(w2) * onp.asarray(x[:2]))
+    onp.testing.assert_allclose(onp.asarray(w2.grad), want2, rtol=1e-6)
+
+
+def test_hawkesll_reference_oracle():
+    """reference tests/python/unittest/test_contrib_hawkesll.py values
+    + the reference contrib spelling alias."""
+    from mxnet_tpu.contrib import ndarray as cnd
+
+    T, N, K = 4, 4, 3
+    mu = mx.np.array(onp.tile([1.5, 2.0, 3.0], (N, 1)).astype("float32"))
+    alpha = mx.np.array([0.2, 0.3, 0.4])
+    beta = mx.np.array([1.0, 2.0, 3.0])
+    lags = mx.np.array(onp.array(
+        [[6, 7, 8, 9], [1, 2, 3, 4], [3, 4, 5, 6], [8, 9, 10, 11]],
+        "float32"))
+    marks = mx.np.zeros((N, T)).astype("int32")
+    states = mx.np.zeros((N, K))
+    valid_length = mx.np.array([1, 2, 3, 4])
+    max_time = mx.np.ones((N,)) * 100.0
+    ll, out_state = cnd.hawkesll(mu, alpha, beta, states, lags, marks,
+                                 valid_length, max_time)
+    onp.testing.assert_allclose(
+        onp.asarray(ll),
+        [-649.79453489, -649.57118596, -649.38025115, -649.17811484],
+        rtol=1e-5)
+    assert out_state.shape == (N, K)
