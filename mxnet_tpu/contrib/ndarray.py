@@ -14,6 +14,13 @@ hawkes_ll = _npx.hawkes_ll
 round_ste = _npx.round_ste
 sign_ste = _npx.sign_ste
 khatri_rao = _npx.khatri_rao
+quadratic = _npx.quadratic
+all_finite = _npx.all_finite
+multi_all_finite = _npx.multi_all_finite
+multi_sum_sq = _npx.multi_sum_sq
+getnnz = _npx.nnz  # reference op name (contrib/nnz.cc registers getnnz)
+BilinearResize2D = _npx.bilinear_resize_2d
+PSROIPooling = _npx.psroi_pooling
 
 # legacy 1.x CamelCase op names
 MultiBoxPrior = multibox_prior
@@ -24,5 +31,7 @@ DeformableConvolution = deformable_convolution
 __all__ = ["multibox_prior", "multibox_target", "multibox_detection",
            "deformable_convolution", "modulated_deformable_convolution",
            "hawkesll", "hawkes_ll", "round_ste", "sign_ste", "khatri_rao",
+           "quadratic", "all_finite", "multi_all_finite", "multi_sum_sq",
+           "getnnz", "BilinearResize2D", "PSROIPooling",
            "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
            "DeformableConvolution"]

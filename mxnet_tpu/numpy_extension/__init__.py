@@ -605,6 +605,57 @@ def khatri_rao(*matrices):
     return _call(_contrib.khatri_rao, matrices, name="khatri_rao")
 
 
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2+b*x+c (reference contrib/quadratic_op.cc)."""
+    return _call(lambda x: _contrib.quadratic(x, a=a, b=b, c=c), (data,),
+                 name="quadratic")
+
+
+def all_finite(data, init_output=True):
+    """AMP overflow probe, shape (1,) (reference contrib/all_finite.cc)."""
+    return _call(_contrib.all_finite, (data,), name="all_finite")
+
+
+def multi_all_finite(*arrays, num_arrays=None):
+    return _call(_contrib.multi_all_finite, arrays, name="multi_all_finite")
+
+
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares (reference contrib/multi_sum_sq.cc)."""
+    return _call(_contrib.multi_sum_sq, arrays, name="multi_sum_sq")
+
+
+def nnz(data):
+    """Count of non-zero entries (reference contrib/nnz.cc getnnz).
+    CSR input answers from stored-value metadata like the reference,
+    without densifying."""
+    from ..ndarray.sparse import CSRNDArray
+    if isinstance(data, CSRNDArray):
+        from ..numpy import array as _np_array
+        return _np_array(int(data.nnz))
+    return _call(_contrib.nnz, (data,), name="nnz")
+
+
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, align_corners=True):
+    """NCHW bilinear resize (reference contrib/bilinear_resize.cc)."""
+    return _call(
+        lambda x: _contrib.bilinear_resize_2d(
+            x, height=height, width=width, scale_height=scale_height,
+            scale_width=scale_width, align_corners=align_corners),
+        (data,), name="bilinear_resize_2d")
+
+
+def psroi_pooling(data, rois, output_dim, pooled_size, spatial_scale=1.0,
+                  group_size=None):
+    """Position-sensitive ROI pooling (reference contrib/psroi_pooling.cc)."""
+    return _call(
+        lambda d, r: _contrib.psroi_pooling(
+            d, r, output_dim=output_dim, pooled_size=pooled_size,
+            spatial_scale=spatial_scale, group_size=group_size),
+        (data, rois), name="psroi_pooling")
+
+
 # ---------------------------------------------------------------------------
 # activation / math tail (reference src/operator: *_activation, special fns)
 # ---------------------------------------------------------------------------

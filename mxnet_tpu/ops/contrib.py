@@ -26,6 +26,8 @@ __all__ = [
     "hawkes_ll", "index_copy", "gradientmultiplier",
     "multibox_target", "multibox_detection",
     "round_ste", "sign_ste", "khatri_rao",
+    "quadratic", "all_finite", "multi_all_finite", "multi_sum_sq", "nnz",
+    "bilinear_resize_2d", "psroi_pooling",
 ]
 
 
@@ -577,6 +579,132 @@ def round_ste(data):
 def sign_ste(data):
     """sign(x) forward, straight-through identity gradient."""
     return _sign_ste(jnp.asarray(data))
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c elementwise (reference contrib/quadratic_op.cc —
+    the 'implement an operator' tutorial op, kept for API parity)."""
+    x = jnp.asarray(data)
+    return a * x * x + b * x + c
+
+
+def all_finite(data, init_output=True):
+    """1.0 if every element is finite else 0.0, shape (1,) (reference
+    contrib/all_finite.cc — the AMP loss-scaler overflow probe)."""
+    x = jnp.asarray(data)
+    ok = jnp.isfinite(x).all()
+    return ok.astype(jnp.float32).reshape(1)
+
+
+def multi_all_finite(*arrays, num_arrays=None):
+    """all_finite over several arrays at once, shape (1,) (reference
+    contrib/all_finite.cc MultiAllFinite)."""
+    if not arrays:
+        raise MXNetError("multi_all_finite needs at least one input")
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = ok & jnp.isfinite(jnp.asarray(a)).all()
+    return ok.astype(jnp.float32).reshape(1)
+
+
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares, shape (num_arrays,) (reference
+    contrib/multi_sum_sq.cc — the LARS/global-clip building block)."""
+    if not arrays:
+        raise MXNetError("multi_sum_sq needs at least one input")
+    return jnp.stack([jnp.sum(jnp.square(jnp.asarray(a).astype(
+        jnp.float32))) for a in arrays])
+
+
+def nnz(data):
+    """Number of non-zero entries, shape () int64 (reference
+    contrib/nnz.cc; there it reads CSR metadata, here it counts — the
+    capability, not the storage hack)."""
+    x = jnp.asarray(data)
+    return jnp.count_nonzero(x)
+
+
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, align_corners=True):
+    """Bilinear resize over NCHW (reference contrib/bilinear_resize.cc,
+    mode='size'; align_corners default True like the reference). One
+    gather+lerp formulation so XLA fuses it into two matmul-free passes."""
+    x = jnp.asarray(data)
+    B, C, H, W = x.shape
+    # scale mode truncates like the reference kernel's int cast
+    out_h = int(H * scale_height) if scale_height else int(height)
+    out_w = int(W * scale_width) if scale_width else int(width)
+
+    def coords(n_in, n_out):
+        if align_corners:
+            # n_out == 1 -> [0.0]: the reference clamps to the first pixel
+            return jnp.linspace(0.0, n_in - 1.0, n_out)
+        scale = n_in / n_out
+        return jnp.clip((jnp.arange(n_out) + 0.5) * scale - 0.5, 0.0,
+                        n_in - 1.0)
+
+    yc = coords(H, out_h)
+    xc = coords(W, out_w)
+    y0 = jnp.floor(yc).astype(jnp.int32)
+    x0 = jnp.floor(xc).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = (yc - y0).astype(x.dtype)
+    wx = (xc - x0).astype(x.dtype)
+    top = x[:, :, y0][:, :, :, x0] * (1 - wx) + x[:, :, y0][:, :, :, x1] * wx
+    bot = x[:, :, y1][:, :, :, x0] * (1 - wx) + x[:, :, y1][:, :, :, x1] * wx
+    return top * (1 - wy)[None, None, :, None] + bot * wy[None, None, :, None]
+
+
+def psroi_pooling(data, rois, output_dim, pooled_size, spatial_scale=1.0,
+                  group_size=None):
+    """Position-sensitive ROI average pooling (reference
+    contrib/psroi_pooling.cc, the R-FCN head): output bin (i, j) of
+    output channel d averages input channel d*G*G + i*G + j over the
+    bin's region. data (B, C, H, W) with C == output_dim * G * G;
+    rois (N, 5) [batch_idx, x1, y1, x2, y2] scaled by spatial_scale."""
+    g = int(group_size or pooled_size)
+    p = int(pooled_size)
+    B, C, H, W = data.shape
+    if C != output_dim * g * g:
+        raise MXNetError(
+            f"psroi_pooling: channels {C} != output_dim*group_size^2 "
+            f"({output_dim}*{g}^2)")
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        # reference rounds the roi start and shifts end by +1, in
+        # feature-map units (psroi_pooling-inl.h roi quantization)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / p
+        bin_w = rw / p
+        iy = jnp.arange(p, dtype=jnp.float32)
+        ix = jnp.arange(p, dtype=jnp.float32)
+        ystart = jnp.floor(y1 + iy * bin_h)
+        yend = jnp.ceil(y1 + (iy + 1) * bin_h)
+        xstart = jnp.floor(x1 + ix * bin_w)
+        xend = jnp.ceil(x1 + (ix + 1) * bin_w)
+        ymask = (ys[None, :] >= ystart[:, None]) & (ys[None, :] < yend[:, None])
+        xmask = (xs[None, :] >= xstart[:, None]) & (xs[None, :] < xend[:, None])
+        mask = ymask[:, None, :, None] & xmask[None, :, None, :]  # (p,p,H,W)
+        fmap = data[b].reshape(output_dim, g, g, H, W)
+        # map each output bin (i, j) to sensitivity group (i*g//p, j*g//p)
+        gi = (iy.astype(jnp.int32) * g) // p
+        gj = (ix.astype(jnp.int32) * g) // p
+        grouped = fmap[:, gi][:, :, gj]              # (D, p, p, H, W)
+        msum = mask.sum(axis=(-1, -2)).astype(jnp.float32)  # (p, p)
+        total = jnp.where(mask[None], grouped, 0.0).sum(axis=(-1, -2))
+        return jnp.where(msum[None] > 0, total / jnp.maximum(msum[None], 1.0),
+                         0.0)  # (D, p, p)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
 
 
 def khatri_rao(*matrices):

@@ -611,3 +611,81 @@ def test_hawkesll_reference_oracle():
         [-649.79453489, -649.57118596, -649.38025115, -649.17811484],
         rtol=1e-5)
     assert out_state.shape == (N, K)
+
+
+def test_quadratic_all_finite_multi_sum_sq_nnz():
+    x = mx.np.array([[1.0, 2.0], [3.0, 0.0]])
+    onp.testing.assert_allclose(
+        npx.quadratic(x, a=2.0, b=-1.0, c=3.0).asnumpy(),
+        2 * onp.asarray(x) ** 2 - onp.asarray(x) + 3, rtol=1e-6)
+    assert float(npx.all_finite(x)[0]) == 1.0
+    bad = mx.np.array([1.0, onp.inf])
+    assert float(npx.all_finite(bad)[0]) == 0.0
+    assert float(npx.multi_all_finite(x, bad)[0]) == 0.0
+    ss = npx.multi_sum_sq(x, mx.np.array([2.0, 2.0]))
+    onp.testing.assert_allclose(ss.asnumpy(), [14.0, 8.0], rtol=1e-6)
+    assert int(npx.nnz(x)) == 3
+    from mxnet_tpu.contrib import ndarray as cnd
+    assert int(cnd.getnnz(x)) == 3
+    # quadratic gradient flows (2ax + b)
+    from mxnet_tpu import autograd
+    w = mx.np.array([1.0, -2.0]); w.attach_grad()
+    with autograd.record():
+        out = npx.quadratic(w, a=3.0, b=1.0, c=0.0).sum()
+    out.backward()
+    onp.testing.assert_allclose(onp.asarray(w.grad), 6 * onp.asarray(w) + 1,
+                                rtol=1e-6)
+
+
+def test_bilinear_resize_2d_oracle():
+    """align_corners=True (reference default): corners map exactly."""
+    x = mx.np.array(onp.arange(16.0, dtype="float32").reshape(1, 1, 4, 4))
+    out = npx.bilinear_resize_2d(x, height=7, width=7)
+    assert out.shape == (1, 1, 7, 7)
+    o = out.asnumpy()[0, 0]
+    xx = onp.asarray(x)[0, 0]
+    onp.testing.assert_allclose(
+        [o[0, 0], o[0, -1], o[-1, 0], o[-1, -1]],
+        [xx[0, 0], xx[0, -1], xx[-1, 0], xx[-1, -1]], rtol=1e-6)
+    # identity resize returns the input exactly
+    same = npx.bilinear_resize_2d(x, height=4, width=4)
+    onp.testing.assert_allclose(same.asnumpy(), onp.asarray(x), atol=1e-6)
+    # scale mode
+    up = npx.bilinear_resize_2d(x, scale_height=2.0, scale_width=2.0)
+    assert up.shape == (1, 1, 8, 8)
+    # oracle: 1-D linear interp along one axis
+    row = mx.np.array(onp.array([[[[0.0, 1.0, 2.0, 3.0]]]], "float32"))
+    out_row = npx.bilinear_resize_2d(row, height=1, width=7).asnumpy()[0, 0, 0]
+    onp.testing.assert_allclose(out_row, onp.linspace(0, 3, 7), rtol=1e-6)
+
+
+def test_psroi_pooling_position_sensitivity():
+    """Each output bin must read its own channel group (the R-FCN
+    contract, reference contrib/psroi_pooling.cc)."""
+    D, G = 2, 2
+    B, H, W = 1, 4, 4
+    C = D * G * G
+    # channel value = its flat index, constant over space: output bin
+    # (d, i, j) must equal channel d*G*G + i*G + j exactly
+    data = mx.np.array(
+        onp.arange(C, dtype="float32")[None, :, None, None]
+        * onp.ones((B, C, H, W), "float32"))
+    rois = mx.np.array([[0.0, 0.0, 0.0, 3.0, 3.0]])
+    out = npx.psroi_pooling(data, rois, output_dim=D, pooled_size=G,
+                            spatial_scale=1.0)
+    assert out.shape == (1, D, G, G)
+    want = onp.arange(C, dtype="float32").reshape(D, G, G)
+    onp.testing.assert_allclose(out.asnumpy()[0], want, rtol=1e-6)
+
+
+def test_contrib_tail_edge_cases():
+    """Review-found edges: size-1 align_corners resize clamps to pixel 0;
+    scale mode truncates; CSR nnz reads metadata without densifying."""
+    x = mx.np.array(onp.arange(16.0, dtype="float32").reshape(1, 1, 4, 4))
+    one = npx.bilinear_resize_2d(x, height=1, width=1)
+    assert float(one[0, 0, 0, 0]) == 0.0  # first pixel, not the center
+    tr = npx.bilinear_resize_2d(x, scale_height=1.9, scale_width=1.9)
+    assert tr.shape == (1, 1, 7, 7)  # int(4*1.9)=7, truncation not round
+    from mxnet_tpu.ndarray import sparse
+    csr = sparse.csr_matrix(mx.np.array([[0.0, 1.0], [2.0, 0.0]]))
+    assert int(npx.nnz(csr)) == 2
