@@ -194,3 +194,60 @@ def test_io_bench_tiny(tmp_path):
     assert rec["prefetcher"].get("prefetched_rec_s", 1) > 0
     assert rec["dataloader"]["loader0_sps"] > 0
     assert rec["cpus"] >= 1
+
+
+def test_daemon_merge_model_table_keeps_banked_rows(tmp_path):
+    """A partial capture (tunnel flap mid-table) must never erase
+    previously banked successes; unattempted combos merge forward."""
+    import json
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(ROOT, "benchmark"))
+    import tpu_daemon as d
+
+    path = tmp_path / "table.json"
+    now = time.time()
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "fp32", "img_s": 10,
+         "captured_unix": now},
+        {"model": "b", "precision": "bf16", "img_s": 20,
+         "captured_unix": now}]}, open(path, "w"))
+    fresh = {"device": "tpu", "results": [
+        {"model": "a", "precision": "fp32", "error": "died"},
+        {"model": "c", "precision": "fp32", "img_s": 5}]}
+    out = d.merge_model_table(str(path), fresh)
+    rows = {(r["model"], r["precision"]): r.get("img_s")
+            for r in out["results"]}
+    assert rows == {("a", "fp32"): 10, ("b", "bf16"): 20, ("c", "fp32"): 5}
+    # stale banked rows do NOT merge forward
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "fp32", "img_s": 10,
+         "captured_unix": now - 2 * d.STALE_AFTER_S}]}, open(path, "w"))
+    out2 = d.merge_model_table(
+        str(path), {"device": "tpu", "results": [
+            {"model": "a", "precision": "fp32", "error": "died"}]})
+    assert "error" in out2["results"][0]
+
+
+def test_daemon_merge_inherits_table_stamp_and_survives_null(tmp_path):
+    """Rows banked before per-row stamping inherit the table-level
+    captured_unix (migration); a null/garbage banked file is a no-op."""
+    import json
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(ROOT, "benchmark"))
+    import tpu_daemon as d
+
+    path = tmp_path / "t.json"
+    json.dump({"device": "tpu", "captured_unix": time.time(),
+               "results": [{"model": "a", "precision": "fp32",
+                            "img_s": 10}]}, open(path, "w"))
+    out = d.merge_model_table(str(path), {"device": "tpu", "results": [
+        {"model": "a", "precision": "fp32", "error": "died"}]})
+    assert out["results"][0].get("img_s") == 10
+    path.write_text("null")
+    out2 = d.merge_model_table(str(path), {"device": "tpu", "results": [
+        {"model": "a", "precision": "fp32", "img_s": 3}]})
+    assert out2["results"][0]["img_s"] == 3
