@@ -157,6 +157,14 @@ class ndarray:
             self._data.block_until_ready()
         except AttributeError:
             pass  # tracer
+        except Exception:
+            # error observed here → clear from the engine's pending set so
+            # waitall() does not rethrow it (reference clears the var's
+            # exception_ptr once thrown)
+            from .. import engine as _engine
+
+            _engine.observed(self._data)
+            raise
 
     def wait_to_write(self) -> None:
         self.wait_to_read()

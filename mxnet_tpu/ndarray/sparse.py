@@ -63,10 +63,22 @@ class BaseSparseNDArray:
         return _wrap(self._values)
 
     def asnumpy(self) -> onp.ndarray:
+        self.wait_to_read()  # surfaces (and marks observed) deferred errors
         return onp.asarray(self.todense_val())
 
     def wait_to_read(self):
-        self._values.block_until_ready()
+        try:
+            self._values.block_until_ready()
+        except AttributeError:
+            pass  # tracer
+        except Exception:
+            # error observed here → clear from the engine's pending set so
+            # waitall() does not rethrow it (same contract as dense
+            # ndarray.wait_to_read)
+            from .. import engine as _engine
+
+            _engine.observed(self._values)
+            raise
 
     def tostype(self, stype: str):
         if stype == self.stype:

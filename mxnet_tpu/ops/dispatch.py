@@ -183,8 +183,10 @@ def _apply_op(
     if not record:
         out_vals = call(*vals)
         # MXNET_ENGINE_TYPE=NaiveEngine or bulk(0): block per op (live
-        # knobs — the reference engine factory reads them per push)
-        _engine.maybe_sync(out_vals)
+        # knobs — the reference engine factory reads them per push);
+        # otherwise register for deferred-error surfacing at waitall()
+        if not _engine.maybe_sync(out_vals):
+            _engine._track(out_vals)
         if n_out == 1:
             return _wrap(out_vals)
         return tuple(_wrap(v) for v in out_vals)
@@ -197,7 +199,9 @@ def _apply_op(
         return call(*full)
 
     out_vals, vjp_fn = jax.vjp(fwd, *[vals[i] for i in grad_inputs])
-    _engine.maybe_sync(out_vals)  # per-op sync applies when recording too
+    # per-op sync applies when recording too; async outputs are tracked
+    if not _engine.maybe_sync(out_vals):
+        _engine._track(out_vals)
     outs = (
         (_wrap(out_vals),) if n_out == 1 else tuple(_wrap(v) for v in out_vals)
     )
@@ -234,6 +238,7 @@ def backward(
     """
     import jax.numpy as jnp
 
+    from .. import engine as _engine
     from ..ndarray.ndarray import ndarray, _unwrap
 
     tape = autograd_state.tape
@@ -327,6 +332,14 @@ def backward(
                 g._data = g._data + ct.astype(g.dtype)
             else:  # write
                 g._data = ct.astype(g.dtype)
+        # backward runs async too: in per-op sync mode block on the written
+        # grad (NaiveEngine debug must not swallow vjp failures); otherwise
+        # register it so waitall() surfaces a deferred vjp failure nobody
+        # reads (the reference routes backward ops through the same engine
+        # exception store)
+        gval = g._values if isinstance(g, RowSparseNDArray) else g._data
+        if not _engine.maybe_sync(gval):
+            _engine._track(gval)
 
     if not retain_graph:
         tape.nodes.clear()

@@ -96,3 +96,150 @@ def test_bulk_zero_syncs_under_record():
             y = (x * 2).sum()  # dispatches through the recording branch
         y.backward()
     onp.testing.assert_allclose(x.grad.asnumpy(), 2.0)
+
+
+class _FakeAsyncResult:
+    """Stand-in for a jax array whose execution failed asynchronously.
+
+    On the CPU test backend callbacks run at dispatch, so a REAL deferred
+    error (raise at block_until_ready, not at apply) cannot be produced;
+    this fake exercises the engine's pending-error registry the way the
+    TPU runtime would drive it."""
+
+    def __init__(self, exc=None):
+        self.exc = exc
+        self.waited = False
+
+    def block_until_ready(self):
+        self.waited = True
+        if self.exc is not None:
+            raise self.exc
+
+
+def test_waitall_reraises_unobserved_deferred_error():
+    """Reference contract (threaded_engine.cc:422-431): WaitForAll rethrows
+    the stored exception of an op whose output nobody waited on."""
+    fake = _FakeAsyncResult(RuntimeError("deferred boom"))  # strong ref held
+    engine.track(fake)
+    with pytest.raises(RuntimeError, match="deferred boom"):
+        engine.waitall()
+    # the pending set was cleared by the raise: second waitall is clean
+    engine.waitall()
+
+
+def test_waitall_raises_first_of_multiple_pending_errors():
+    fakes = [_FakeAsyncResult(RuntimeError("first failure")),
+             _FakeAsyncResult(RuntimeError("second failure"))]
+    for f in fakes:
+        engine.track(f)
+    with pytest.raises(RuntimeError, match="first failure"):
+        engine.waitall()
+    engine.waitall()
+
+
+def test_observed_error_not_rethrown_by_waitall():
+    """An error already raised at wait_to_read is cleared (the reference
+    clears the var's exception_ptr once thrown)."""
+    fake = _FakeAsyncResult(RuntimeError("seen at wait"))
+    engine.track(fake)
+    with pytest.raises(RuntimeError):
+        fake.block_until_ready()
+    engine.observed(fake)
+    engine.waitall()  # must not re-raise
+
+
+def test_pending_registry_is_bounded_and_weak():
+    import gc
+
+    from mxnet_tpu.engine import _pending
+
+    baseline = len(_pending)
+    ok = _FakeAsyncResult()
+    engine.track(ok)
+    assert len(_pending) == baseline + 1
+    # weak: dropping the only strong ref frees the entry's target
+    engine.track(_FakeAsyncResult())
+    gc.collect()
+    engine.waitall()  # dead refs skipped, live ok waited
+    assert ok.waited
+    # bounded: flooding never exceeds the cap
+    keep = [_FakeAsyncResult() for _ in range(engine._PENDING_CAP + 50)]
+    for f in keep:
+        engine.track(f)
+    assert len(_pending) <= engine._PENDING_CAP
+    engine.waitall()
+
+
+def test_observed_clears_whole_output_group():
+    """Siblings of a multi-output op share the failure: catching it via ONE
+    output must clear the whole op from the pending set (the reference
+    clears the op's exception, not one var's)."""
+    a = _FakeAsyncResult(RuntimeError("shared failure"))
+    b = _FakeAsyncResult(RuntimeError("shared failure"))
+    engine.track((a, b))
+    with pytest.raises(RuntimeError):
+        a.block_until_ready()
+    engine.observed(a)  # wait_to_read on `a` observed the error
+    engine.waitall()    # sibling `b` must NOT resurface it
+
+
+def test_track_skipped_in_sync_mode():
+    """NaiveEngine / bulk(0) block per op at dispatch — nothing can be
+    pending, so tracking there would only evict real async entries."""
+    from mxnet_tpu.engine import _pending
+
+    real = _FakeAsyncResult(RuntimeError("async failure"))
+    engine.track(real)
+    with engine.bulk(0):
+        for _ in range(engine._PENDING_CAP + 10):
+            engine.track(_FakeAsyncResult())  # must all be no-ops
+    with pytest.raises(RuntimeError, match="async failure"):
+        engine.waitall()
+
+
+def test_backward_grads_are_tracked():
+    """loss.backward() writes grads asynchronously; they must be visible to
+    waitall() (reference: backward ops share the engine exception store)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.engine import _pending
+
+    x = mx.np.ones((4,))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 3).sum()
+    before = len(_pending)
+    y.backward()
+    assert len(_pending) > before  # grad write registered
+    engine.waitall()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 3.0)
+
+
+def test_pending_cap_env_is_robust(monkeypatch):
+    """Malformed/negative cap must not break import; 0 disables tracking."""
+    import importlib
+
+    import mxnet_tpu.engine as eng
+
+    for bad, want in [("-5", 0), ("abc", 512), ("0", 0), ("7", 7)]:
+        monkeypatch.setenv("MXNET_ENGINE_PENDING_CAP", bad)
+        mod = importlib.reload(eng)
+        assert mod._PENDING_CAP == want, (bad, mod._PENDING_CAP)
+    monkeypatch.delenv("MXNET_ENGINE_PENDING_CAP")
+    importlib.reload(eng)
+
+
+def test_naive_mode_backward_blocks_on_grads(monkeypatch):
+    """NaiveEngine per-op sync must cover backward too — a vjp failure may
+    not be swallowed by the synchronous-debug mode (review finding)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.engine import _pending
+
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    x = mx.np.ones((4,))
+    x.attach_grad()
+    before = len(_pending)
+    with autograd.record():
+        y = (x * 5).sum()
+    y.backward()
+    assert len(_pending) == before  # synced, not tracked
+    onp.testing.assert_allclose(x.grad.asnumpy(), 5.0)
