@@ -554,16 +554,29 @@ def fetch_with_timeout(a, seconds: float = 45.0):
     (observed: a mid-sweep tunnel death left the process wedged for
     minutes past the per-op alarm), so the fetch runs on a daemon thread
     and a TimeoutError is raised from the caller's thread instead."""
-    import concurrent.futures
+    import queue
+    import threading
 
-    ex = concurrent.futures.ThreadPoolExecutor(1)
+    # plain daemon thread, NOT a ThreadPoolExecutor: concurrent.futures
+    # registers an atexit join of its (non-daemon) workers, so a fetch
+    # wedged in native code would still block interpreter exit
+    box: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def _fetch():
+        try:
+            box.put((True, onp.asarray(
+                a.ravel()[0] if getattr(a, "ndim", 0) else a)))
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            box.put((False, e))
+
+    threading.Thread(target=_fetch, daemon=True).start()
     try:
-        fut = ex.submit(
-            lambda: onp.asarray(a.ravel()[0] if getattr(a, "ndim", 0)
-                                else a))
-        return fut.result(timeout=seconds)
-    finally:
-        ex.shutdown(wait=False)  # never join a wedged fetch thread
+        ok, val = box.get(timeout=seconds)
+    except queue.Empty:
+        raise TimeoutError(f"device fetch exceeded {seconds}s")
+    if not ok:
+        raise val
+    return val
 
 
 def _materialize(out) -> None:

@@ -188,7 +188,9 @@ class SoftmaxCrossEntropyLoss(Loss):
             nll = npx.softmax_cross_entropy(
                 pred.reshape(-1, n_cls),
                 np.clip(label.reshape(-1), 0, n_cls - 1), per_example=True)
-            loss = nll.reshape(label.shape)
+            # per_example NLL is f32; the old log_softmax+pick path kept
+            # pred's dtype (e.g. bf16) — preserve that output contract
+            loss = nll.reshape(label.shape).astype(pred.dtype)
             loss = _apply_weighting(loss, self._weight, sample_weight)
             return np.mean(loss, axis=tuple(range(1, loss.ndim))) if loss.ndim > 1 else loss
         if not self._from_logits:

@@ -336,26 +336,34 @@ def batch_norm(
 _PALLAS_NORM_STATE = {"ok": None}
 
 
+def _probe_once(state: dict, probe) -> bool:
+    """Memoized Mosaic compile probe: run ``probe()`` once per process;
+    any failure permanently selects the jnp fallback path. Probes must
+    cover a jitted call too — inside a hybridized trace a Mosaic reject
+    surfaces at outer-jit compile time where no fallback is possible."""
+    if state["ok"] is None:
+        try:
+            probe()
+            state["ok"] = True
+        except Exception:  # noqa: BLE001 — Mosaic quirk: jnp path instead
+            state["ok"] = False
+    return state["ok"]
+
+
 def _pallas_norm_ok():
     """One-time Mosaic compile probe for the fused norm kernels on this
     backend; a failure permanently falls back to the jnp path."""
-    st = _PALLAS_NORM_STATE
-    if st["ok"] is None:
-        try:
-            from .pallas.layer_norm import fused_layer_norm
-            # probe BOTH extremes, and under jit: inside a hybridized
-            # trace a Mosaic reject surfaces at outer-jit compile time
-            # where no fallback is possible, so the probe must cover the
-            # widest padded block the gate admits
-            fused_layer_norm(jnp.zeros((8, 128)), jnp.ones((128,)),
-                             jnp.zeros((128,)), 1e-5)
-            jax.jit(lambda x, g, b: fused_layer_norm(x, g, b, 1e-5))(
-                jnp.zeros((8, 8192)), jnp.ones((8192,)),
-                jnp.zeros((8192,))).block_until_ready()
-            st["ok"] = True
-        except Exception:  # noqa: BLE001 — Mosaic quirk: jnp path instead
-            st["ok"] = False
-    return st["ok"]
+    def probe():
+        from .pallas.layer_norm import fused_layer_norm
+        # probe BOTH extremes: the widest padded block the gate admits,
+        # and the minimal tile
+        fused_layer_norm(jnp.zeros((8, 128)), jnp.ones((128,)),
+                         jnp.zeros((128,)), 1e-5)
+        jax.jit(lambda x, g, b: fused_layer_norm(x, g, b, 1e-5))(
+            jnp.zeros((8, 8192)), jnp.ones((8192,)),
+            jnp.zeros((8192,))).block_until_ready()
+
+    return _probe_once(_PALLAS_NORM_STATE, probe)
 
 
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
@@ -515,6 +523,24 @@ def log_softmax(x, axis=-1, temperature=None):
     return jax.nn.log_softmax(x, axis=axis)
 
 
+_PALLAS_CE_STATE = {"ok": None}
+
+
+def _pallas_ce_ok():
+    """One-time Mosaic compile probe for the fused online-lse CE kernel;
+    covers an UNALIGNED (N, V) — the historical reject case — and a
+    jitted call (see ``_probe_once``)."""
+    def probe():
+        from .pallas.cross_entropy import cross_entropy_with_logits
+        cross_entropy_with_logits(jnp.zeros((12, 1000)),
+                                  jnp.zeros((12,), jnp.int32))
+        jax.jit(cross_entropy_with_logits)(
+            jnp.zeros((8, 4096)),
+            jnp.zeros((8,), jnp.int32)).block_until_ready()
+
+    return _probe_once(_PALLAS_CE_STATE, probe)
+
+
 def softmax_cross_entropy(data, label, per_example=False):
     """Sparse-label softmax cross entropy (reference
     src/operator/loss_binary_op.cc:30 ``softmax_cross_entropy``).
@@ -536,10 +562,14 @@ def softmax_cross_entropy(data, label, per_example=False):
             f"softmax_cross_entropy expects (N, V) data and (N,) label, "
             f"got {data.shape} / {label.shape}")
     lab = label.astype(jnp.int32)
-    if jax.default_backend() == "tpu":
+    nll = None
+    if jax.default_backend() == "tpu" and _pallas_ce_ok():
         from .pallas.cross_entropy import cross_entropy_with_logits
-        nll = cross_entropy_with_logits(data, lab)
-    else:
+        try:
+            nll = cross_entropy_with_logits(data, lab)
+        except Exception:  # noqa: BLE001 — shape-specific Mosaic reject
+            pass  # fall through to the jnp path
+    if nll is None:
         x = data.astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(x, axis=-1)
         picked = jnp.take_along_axis(x, jnp.clip(lab, 0, None)[:, None],
@@ -547,8 +577,27 @@ def softmax_cross_entropy(data, label, per_example=False):
         nll = jnp.where(lab >= 0, lse - picked, 0.0)
     if per_example:
         return nll  # f32: per-row NLL keeps full precision for reductions
-    nll = jnp.minimum(nll, -jnp.log(jnp.float32(1e-8)))
+    # value-only clamp: the reference backward (loss_binary_op-inl.h:85-106)
+    # is softmax-onehot UNCONDITIONALLY — the forward's 1e-8 floor must not
+    # zero dlogits on confidently-wrong rows
+    nll = _clamp_value_only(nll)
     return jnp.sum(nll, keepdims=True).astype(data.dtype)
+
+
+@jax.custom_vjp
+def _clamp_value_only(nll):
+    """min(nll, -log(1e-8)) in the value, identity in the gradient.
+
+    A custom_vjp rather than a stop_gradient straight-through: a masked
+    label (softmax prob exactly 0, nll=+inf — the very case the 1e-8
+    floor exists for) would make ``nll + sg(min(nll, cap) - nll)``
+    evaluate inf-inf = NaN; here the forward is a plain minimum and the
+    backward never touches the forward value."""
+    return jnp.minimum(nll, -jnp.log(jnp.float32(1e-8)))
+
+
+_clamp_value_only.defvjp(
+    lambda nll: (_clamp_value_only(nll), None), lambda _, g: (g,))
 
 
 def masked_softmax(x, mask, axis=-1, temperature=1.0):

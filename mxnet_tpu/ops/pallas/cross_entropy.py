@@ -72,8 +72,14 @@ def fused_lse(x, block_n: int = 256, block_v: int = 2048,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n, v = x.shape
+    # round blocks to Mosaic fp32 tile multiples (8 sublanes × 128 lanes):
+    # an unaligned bn/bv (e.g. N=100 or V=1000) is a hard Mosaic reject on
+    # TPU. The jnp.pad below already supplies the extra rows/cols and the
+    # v_pos mask neutralizes padded columns.
     bn = min(block_n, max(8, n))
+    bn = -(-bn // 8) * 8
     bv = min(block_v, max(128, v))
+    bv = -(-bv // 128) * 128
     n_n = -(-n // bn)
     n_v = -(-v // bv)
     pad_n = n_n * bn - n

@@ -157,3 +157,98 @@ def test_hybridized_block_with_fused_loss():
     traced = loss_fn(net(x), y)
     onp.testing.assert_allclose(onp.asarray(eager), onp.asarray(traced),
                                 rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n,v", [(100, 1000), (12, 129), (9, 131)])
+def test_fused_lse_block_tile_alignment(n, v):
+    """Block sizes must round to Mosaic tile multiples (8 rows × 128
+    lanes): for 8<N<256 with N%8!=0 or 128<V<2048 with V%128!=0 the raw
+    min() block was unaligned — a hard Mosaic reject on TPU (advisor
+    finding). The rounding must also keep the result exact."""
+    x = jnp.array(onp.random.randn(n, v).astype("float32") * 4)
+    got = fused_lse(x, interpret=True)
+    want = jax.scipy.special.logsumexp(x, axis=-1)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_lse_chosen_blocks_are_tile_multiples():
+    """White-box: bn % 8 == 0 and bv % 128 == 0 for unaligned inputs."""
+    import jax.experimental.pallas as pl
+    from unittest import mock
+
+    from mxnet_tpu.ops.pallas import cross_entropy as ce
+
+    seen = {}
+    real_call = pl.pallas_call
+
+    def spy(kernel, *a, **kw):
+        spec = kw["in_specs"][0]
+        seen["block"] = tuple(spec.block_shape)
+        return real_call(kernel, *a, **kw)
+
+    with mock.patch.object(pl, "pallas_call", side_effect=spy):
+        ce.fused_lse(jnp.zeros((100, 1000)), interpret=True)
+    bn, bv = seen["block"]
+    assert bn % 8 == 0 and bv % 128 == 0, seen["block"]
+
+
+def test_sum_mode_clamp_is_value_only():
+    """Reference backward (loss_binary_op-inl.h:85-106) is softmax-onehot
+    unconditionally: the 1e-8 forward floor must NOT zero dlogits on
+    confidently-wrong rows (advisor finding — those rows need gradient
+    the most)."""
+    v = 5
+    data = np.array(onp.zeros((1, v), "float32"))
+    data[0, 0] = 200.0  # confidently wrong: NLL ≈ 200 >> -log(1e-8)
+    label = np.array([2.0])
+    data.attach_grad()
+    with autograd.record():
+        out = npx.softmax_cross_entropy(data, label)
+    out.backward()
+    g = onp.asarray(data.grad)
+    # softmax-onehot: ~ +1 at the argmax, -1 at the true label
+    assert g[0, 0] > 0.9 and g[0, 2] < -0.9, g
+    # forward still clamped
+    onp.testing.assert_allclose(onp.asarray(out)[0], -onp.log(1e-8),
+                                rtol=1e-5)
+
+
+def test_gluon_fused_loss_preserves_pred_dtype():
+    """bf16 pred → bf16 loss, as the old log_softmax+pick path returned
+    (advisor finding: user-visible dtype change in AMP loops)."""
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    pred = np.array(onp.random.randn(4, 9).astype("float32")).astype("bfloat16")
+    label = np.array(onp.random.randint(0, 9, (4,)).astype("float32"))
+    out = SoftmaxCrossEntropyLoss()(pred, label)
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_pallas_ce_probe_failure_falls_back(monkeypatch):
+    """If the Mosaic probe fails, npx.softmax_cross_entropy must serve the
+    jnp path, not crash (advisor: unconditional dispatch was a hard
+    failure on unaligned shapes)."""
+    from mxnet_tpu.ops import nn as nnops
+
+    monkeypatch.setitem(nnops._PALLAS_CE_STATE, "ok", False)
+    data = np.array(onp.random.randn(6, 33).astype("float32"))
+    label = np.array(onp.random.randint(0, 33, (6,)).astype("float32"))
+    out = npx.softmax_cross_entropy(data, label)
+    assert out.shape == (1,)
+
+
+def test_sum_mode_clamp_handles_masked_label_inf_nll():
+    """A label landing on a -inf (masked) logit makes nll=+inf — exactly
+    the p=0 case the 1e-8 floor exists for. The value-only clamp must
+    return the finite cap, not NaN (review finding: a straight-through
+    `nll + sg(min-nll)` form evaluates inf-inf=NaN)."""
+    data = np.array(onp.zeros((2, 4), "float32"))
+    data[0, 1] = -onp.inf  # masked vocab entry
+    label = np.array([1.0, 2.0])  # row 0's label IS the masked entry
+    out = npx.softmax_cross_entropy(data, label)
+    val = float(onp.asarray(out)[0])
+    assert onp.isfinite(val), val
+    # row0 contributes the cap, row1 the ordinary NLL over its 4 classes
+    expect = -onp.log(1e-8) + onp.log(4.0)
+    onp.testing.assert_allclose(val, expect, rtol=1e-5)
