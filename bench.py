@@ -253,15 +253,24 @@ def child(platform: str, batch: int = 32) -> None:
     # CPU fallback: bf16 is EMULATED on CPU (several times slower than
     # fp32) and could blow the attempt timeout — measure fp32 only and
     # report it for both fields with the note making that explicit.
+    # explicit fp32 matmul policy for the secondary fp32 row: "high"
+    # (bf16_3x — above-TF32 mantissa coverage, the accepted fp32-class on
+    # tensor hardware) unless overridden; recorded in the artifact. Set
+    # ONLY around the fp32 measurement — a process-wide HIGHEST would
+    # force f32 math into the bf16 headline convs too. The package
+    # default is the one-pass MXU precision (docs/precision.md).
+    fp32_prec = os.environ.get("MXNET_BENCH_FP32_PRECISION", "high")
     if platform == "cpu":
-        fp32_img_s, fp32_iters, flops = measure(params, x_np, jnp.float32)
+        with jax.default_matmul_precision(fp32_prec):
+            fp32_img_s, fp32_iters, flops = measure(params, x_np, jnp.float32)
         bf16_img_s, bf16_iters = fp32_img_s, fp32_iters
     else:
         p_bf16 = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
                   for k, v in params.items()}
         bf16_img_s, bf16_iters, flops = measure(p_bf16, x_np, jnp.bfloat16)
-        fp32_img_s, fp32_iters, _ = measure(params, x_np, jnp.float32,
-                                            want_flops=False)
+        with jax.default_matmul_precision(fp32_prec):
+            fp32_img_s, fp32_iters, _ = measure(params, x_np, jnp.float32,
+                                                want_flops=False)
     rec = {
         "metric": METRIC if batch == 32 else
                   f"resnet50_v1_infer_bs{batch}_bf16",
@@ -274,6 +283,7 @@ def child(platform: str, batch: int = 32) -> None:
         "device_kind": getattr(devs[0], "device_kind", ""),
         "bf16_iters": bf16_iters,
         "fp32_iters": fp32_iters,
+        "fp32_matmul_precision": fp32_prec,
     }
     if flops:
         gflops_img = flops / batch / 1e9

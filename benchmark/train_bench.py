@@ -256,11 +256,21 @@ def child_main(name, batch, prec, cpu, infer=False):
             os._exit(3)
 
     threading.Thread(target=_watchdog, daemon=True).start()
+    # explicit per-run fp32 matmul policy (docs/precision.md): "high"
+    # (bf16_3x, ≈21-bit mantissa — above TF32's 10, the Ampere-era
+    # accepted meaning of fp32 training) unless overridden. bf16 rows are
+    # native one-pass MXU regardless of this knob. The package no longer
+    # pins "highest" process-wide (VERDICT r3 weak #2: the 6-pass fp32
+    # emulation taxed every fp32 row).
+    fp32_prec = os.environ.get("MXNET_BENCH_FP32_PRECISION", "high")
+    if prec == "fp32":
+        jax.config.update("jax_default_matmul_precision", fp32_prec)
     devs = jax.devices()
     up.set()
     log("devices:", devs)
     rec = measure_infer(name, batch, prec, log) if infer \
         else measure(name, batch, prec, log)
+    rec["matmul_precision"] = fp32_prec if prec == "fp32" else "bf16-native"
     rec["device"] = devs[0].platform
     rec["device_kind"] = devs[0].device_kind
     print(json.dumps(rec), flush=True)

@@ -118,12 +118,18 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     kp = kp.reshape(b * h, n_k * bk, d).swapaxes(1, 2)  # (bh, d, Lk)
     vp = vp.reshape(b * h, n_k * bk, d)
 
-    # the session-wide jax_default_matmul_precision="highest" (base.py)
-    # would stamp contract_precision<fp32> on bf16 matmuls, which Mosaic
-    # rejects — bf16 runs at native MXU precision (f32 accumulate comes from
-    # preferred_element_type); f32 keeps HIGHEST so oracle tests hold
-    precision = (jax.lax.Precision.DEFAULT if q.dtype == jnp.bfloat16
-                 else jax.lax.Precision.HIGHEST)
+    # bf16 always runs at native MXU precision (a HIGHEST stamp on bf16
+    # matmuls is a Mosaic reject; f32 accumulate comes from
+    # preferred_element_type). f32 follows the ambient policy
+    # (docs/precision.md): HIGHEST only when the session asks for exact
+    # fp32 (oracle tests pin it via conftest), one-pass default otherwise.
+    if q.dtype == jnp.bfloat16:
+        precision = jax.lax.Precision.DEFAULT
+    else:
+        amb = jax.config.jax_default_matmul_precision
+        precision = {"highest": jax.lax.Precision.HIGHEST,
+                     "high": jax.lax.Precision.HIGH}.get(
+                         amb, jax.lax.Precision.DEFAULT)
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
         block_k=bk, seq_q=lq, seq_k=lk, n_k=n_k, precision=precision)
