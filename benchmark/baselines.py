@@ -1,0 +1,101 @@
+"""The reference's published V100 numbers, as ONE table every harness
+and the gate test share (VERDICT r3 weak #8: ratios must be computed on
+every row a baseline exists for, from one source of truth).
+
+Source: reference docs/static_site/src/pages/api/faq/perf.md (MXNet
+1.2.0.rc1, V100 p3.2xlarge, cuDNN 7.0.5) via BASELINE.md.
+"""
+from __future__ import annotations
+
+# (model, batch) -> img/s, perf.md:186-198 (fp32 scoring)
+V100_FP32_INFER = {
+    ("resnet50_v1", 32): 1076.81,
+    ("resnet50_v1", 256): 1155.07,
+    ("inception_v3", 32): 814.59,
+    ("vgg16", 32): 708.43,
+    ("alexnet", 32): 7906.09,
+}
+
+# (model, batch) -> img/s, perf.md:202-216 (fp16 scoring)
+V100_FP16_INFER = {
+    ("resnet50_v1", 32): 2085.51,
+    ("resnet50_v1", 128): 2355.04,
+    ("resnet152_v1", 32): 887.34,
+}
+
+# (model, batch) -> img/s, perf.md:246-257 (fp32 training)
+V100_FP32_TRAIN = {
+    ("resnet50_v1", 32): 298.51,
+    ("resnet50_v1", 128): 363.69,
+    ("inception_v3", 32): 214.48,
+    ("inception_v3", 128): 253.68,
+    ("alexnet", 32): 2585.61,
+}
+
+
+def nearest(table: dict, model: str, batch: int):
+    """Exact (model, batch) row if published, else the row at the CLOSEST
+    published batch for the model (ratio consumers must label it via the
+    returned batch). Returns (img_s, baseline_batch) or (None, None)."""
+    if (model, batch) in table:
+        return table[(model, batch)], batch
+    cands = [(b, v) for (m, b), v in table.items() if m == model]
+    if not cands:
+        return None, None
+    b, v = min(cands, key=lambda bv: abs(bv[0] - batch))
+    return v, b
+
+
+def attach_infer_ratios(rec: dict) -> dict:
+    """Add v100 ratio fields to one infer-table row in place."""
+    model, batch = rec.get("model"), rec.get("batch")
+    img_s = rec.get("infer_img_s")
+    if not (model and batch and img_s):
+        return rec
+    base, bb = nearest(V100_FP32_INFER, model, batch)
+    if base:
+        rec["v100_fp32_baseline"] = base
+        rec["vs_v100_fp32"] = round(img_s / base, 3)
+        if bb != batch:
+            rec["v100_fp32_baseline_batch"] = bb
+    if rec.get("precision") == "bf16":
+        base, bb = nearest(V100_FP16_INFER, model, batch)
+        if base:
+            rec["v100_fp16_baseline"] = base
+            rec["vs_v100_fp16"] = round(img_s / base, 3)
+            if bb != batch:
+                rec["v100_fp16_baseline_batch"] = bb
+    return rec
+
+
+def attach_headline_ratios(rec: dict, batch: int) -> dict:
+    """Add/refresh ratio fields on a bench.py-style single-line headline
+    record (metric resnet50_v1_infer_bsN_bf16: `value` is bf16 img/s,
+    `fp32_img_s` the fp32 secondary) against the batch-matched published
+    rows. Shared by bench.py and tools/add_baseline_ratios.py."""
+    f16, b16 = nearest(V100_FP16_INFER, "resnet50_v1", batch)
+    f32, b32 = nearest(V100_FP32_INFER, "resnet50_v1", batch)
+    if f16 and rec.get("value"):
+        rec["vs_baseline"] = round(rec["value"] / f16, 3)
+        if b16 != batch:
+            rec["baseline_batch_fp16"] = b16
+    if f32 and rec.get("fp32_img_s"):
+        rec["fp32_vs_baseline"] = round(rec["fp32_img_s"] / f32, 3)
+        if b32 != batch:
+            rec["baseline_batch_fp32"] = b32
+    return rec
+
+
+def attach_train_ratios(rec: dict) -> dict:
+    """Add v100 ratio fields to one train-table row in place."""
+    model, batch = rec.get("model"), rec.get("batch")
+    img_s = rec.get("train_img_s")
+    if not (model and batch and img_s):
+        return rec
+    base, bb = nearest(V100_FP32_TRAIN, model, batch)
+    if base:
+        rec["v100_fp32_baseline"] = base
+        rec["vs_v100_fp32"] = round(img_s / base, 3)
+        if bb != batch:
+            rec["v100_fp32_baseline_batch"] = bb
+    return rec

@@ -115,13 +115,19 @@ def main():
             pc = p
         out, _ = fn(pc, x, key=key)
         # next-token LM loss over L-1 positions via the fused Pallas CE
-        # (single-pass lse; no fp32 (B*L, V) log_softmax materialization)
+        # (single-pass lse; no fp32 (B*L, V) log_softmax materialization).
+        # The last position has no next token: an ignore-index (-1) label
+        # zeroes it INSIDE the kernel — slicing out[:, :-1] instead would
+        # copy the entire (B, L, V) logits tensor (~0.5 GB at this config)
+        # through HBM every step just to drop one column.
         from mxnet_tpu.ops.nn import softmax_cross_entropy
         v = out.shape[-1]
+        labels = jnp.concatenate(
+            [x[:, 1:], jnp.full((x.shape[0], 1), -1, jnp.int32)], axis=1)
         nll = softmax_cross_entropy(
-            out[:, :-1].reshape(-1, v), x[:, 1:].reshape(-1),
-            per_example=True)
-        return nll.mean()  # per-row NLL is already f32
+            out.reshape(-1, v), labels.reshape(-1), per_example=True)
+        # mean over the (B*(L-1)) real positions, not the padded rows
+        return nll.sum() / (x.shape[0] * (x.shape[1] - 1))
 
     def train_step(p, vel, x, key):
         loss, grads = jax.value_and_grad(loss_fn)(p, x, key)

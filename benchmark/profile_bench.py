@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""Ablation profiler: WHERE the training-step time goes on the chip.
+
+VERDICT r3 weak #1: the MFU story had no committed profile naming the
+costs. xprof-style per-op traces don't come back over the axon remote
+backend, so this measures by ABLATION instead — each variant of the step
+is timed with the serial-chain scalar-fetch barrier (bench.py protocol),
+and the deltas attribute time to components:
+
+  ResNet-50 (bf16, bs32 + bs256):   fwd | fwd+bwd | full step
+  GPT-small (bf16, bs8 seq1024):    fwd | fwd+loss | fwd+bwd | full step
+    + per-layer micro: flash-attention, MLP block, LM-head+fused-CE
+
+The artifact (results_profile_tpu.json) carries ms per component, the
+share of the full step, and a ranked `top_costs` list. The daemon banks
+it whenever the tunnel is up.
+
+CLI:
+    python benchmark/profile_bench.py [--cpu] [--output out.json]
+        [--resnet-batches 32,256] [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def log(*a):
+    print("[profile_bench]", *a, file=sys.stderr, flush=True)
+
+
+def timeit_chained(jfn, x, extra, budget_s=3.0, max_iters=600):
+    """Steady-state ms/iter of ``jfn(x, *extra) -> (scalar, next_x)``.
+
+    The serial-chain protocol (bench.py): each iteration's input depends
+    on the previous output, so no dispatch layer can elide or overlap
+    identical calls, and the final scalar fetch is the honest completion
+    barrier (block_until_ready lies over the axon tunnel)."""
+    s, x = jfn(x, *extra)
+    float(s)
+    t0 = time.perf_counter()
+    s, x = jfn(x, *extra)
+    float(s)
+    per = max(time.perf_counter() - t0, 1e-5)
+    iters = max(3, min(max_iters, int(budget_s / per)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s, x = jfn(x, *extra)
+    float(s)
+    dt = time.perf_counter() - t0
+    return dt / iters * 1e3, iters
+
+
+def profile_resnet(batch, quick):
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    x_np = onp.random.uniform(size=(batch, 3, 224, 224)).astype("float32")
+    y_np = onp.random.randint(0, 1000, (batch,)).astype("int32")
+    fn, params = net.functionalize(mx.np.array(x_np), training=True)
+    # the EXACT train_bench AMP pattern: fp32 master weights, in-graph
+    # bf16 cast (its HBM cost is part of what we're attributing)
+    x = jnp.asarray(x_np)
+    y = jnp.asarray(y_np)
+
+    def loss_of(p, x, y):
+        pc = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
+              for k, v in p.items()}
+        out, state = fn(pc, x.astype(jnp.bfloat16))
+        state = {k: s.astype(p[k].dtype) for k, s in state.items()}
+        logp = jax.nn.log_softmax(out.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], -1).mean(), state
+
+    # fwd: loss only, chained via input perturbation
+    def fwd(x, p, y):
+        loss, _ = loss_of(p, x, y)
+        return loss, x * (1 + jnp.tanh(loss) * 1e-7)
+
+    # fwd+bwd: all grads forced through a scalar reduction (cannot be
+    # DCE'd: the 1e-30 scale is not zero), no optimizer math; chained
+    def fwd_bwd(x, p, y):
+        (loss, _), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(p, x, y)
+        gsum = sum(jnp.sum(g.astype(jnp.float32)) for g in grads.values())
+        total = loss + 1e-30 * gsum
+        return total, x * (1 + jnp.tanh(total) * 1e-7)
+
+    # full: train_bench's step verbatim (momentum over fp32 masters,
+    # donated buffers); chains through the donated params
+    momentum, lr = 0.9, 0.05
+    vel = {k: jnp.zeros_like(v) for k, v in params.items()
+           if v.dtype == jnp.float32}
+
+    def full(p, v_, x, y):
+        (loss, state), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(p, x, y)
+        np_, nv = {}, {}
+        for k, s in state.items():
+            if k in v_:
+                vk = momentum * v_[k] + grads[k].astype(jnp.float32)
+                nv[k] = vk
+                np_[k] = s - lr * vk
+            else:
+                np_[k] = s
+        return loss, np_, nv
+
+    budget = 1.5 if quick else 3.0
+    r = {}
+    ms, it = timeit_chained(jax.jit(fwd), x, (params, y), budget)
+    r["fwd_ms"] = round(ms, 3)
+    log(f"resnet50 bs{batch} fwd: {ms:.2f} ms ({it} iters)")
+    ms, it = timeit_chained(jax.jit(fwd_bwd), x, (params, y), budget)
+    r["fwd_bwd_ms"] = round(ms, 3)
+    log(f"resnet50 bs{batch} fwd+bwd: {ms:.2f} ms ({it} iters)")
+    jfull = jax.jit(full, donate_argnums=(0, 1))
+    pp, vv = dict(params), dict(vel)
+    loss, pp, vv = jfull(pp, vv, x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    loss, pp, vv = jfull(pp, vv, x, y)
+    float(loss)
+    per = max(time.perf_counter() - t0, 1e-5)
+    iters = max(3, min(600, int(budget / per)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, pp, vv = jfull(pp, vv, x, y)
+    float(loss)
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    r["full_step_ms"] = round(ms, 3)
+    log(f"resnet50 bs{batch} full step: {ms:.2f} ms")
+    r["bwd_ms_derived"] = round(r["fwd_bwd_ms"] - r["fwd_ms"], 3)
+    r["optimizer_ms_derived"] = round(r["full_step_ms"] - r["fwd_bwd_ms"], 3)
+    r["img_s_full"] = round(batch / (r["full_step_ms"] / 1e3), 1)
+    return r
+
+
+def profile_gpt(quick, dims=None):
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import gpt_like
+    from mxnet_tpu.ops.nn import softmax_cross_entropy
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    # dims override exists for the CPU code-path test (tiny model); the
+    # banked artifact always uses the llm_bench headline config
+    B, L, U, H, V, NL = dims or (8, 1024, 768, 12, 32000, 12)
+    net = gpt_like(vocab_size=V, units=U, hidden_size=4 * U,
+                   num_layers=NL, num_heads=H, max_length=2048, dropout=0.0)
+    net.initialize()
+    rng = onp.random.RandomState(0)
+    x_np = rng.randint(0, V, (B, L)).astype("int32")
+    fn, params = net.functionalize(mx.np.array(x_np), training=True)
+    x = jnp.asarray(x_np)
+    budget = 1.5 if quick else 3.0
+    r = {}
+
+    def shift_tokens(x, scalar):
+        """Serial chain for integer inputs: shift every token id by a
+        value derived from the previous result — unpredictable to any
+        dispatch/caching layer, compute cost unchanged."""
+        s = (jnp.abs(scalar) * 1e9).astype(jnp.int32) % V
+        return (x + s) % V
+
+    def logits_of(p, x):
+        # llm_bench's AMP pattern: fp32 masters, in-graph bf16 cast
+        pc = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
+              for k, v in p.items()}
+        out, _ = fn(pc, x)
+        return out
+
+    def loss_of(p, x):
+        out = logits_of(p, x)
+        labels = jnp.concatenate(
+            [x[:, 1:], jnp.full((B, 1), -1, jnp.int32)], 1)
+        nll = softmax_cross_entropy(out.reshape(-1, V),
+                                    labels.reshape(-1), per_example=True)
+        return nll.sum() / (B * (L - 1))
+
+    # body fwd: scalar from the LAST position's logits only — the LM-head
+    # matmul for the other L-1 positions is DCE'd, so fwd_loss - body_fwd
+    # isolates the LM-head+CE cost
+    def body_fwd(x, p):
+        s = jnp.sum(logits_of(p, x)[:, -1, :].astype(jnp.float32)) * 1e-6
+        return s, shift_tokens(x, s)
+
+    ms, _ = timeit_chained(jax.jit(body_fwd), x, (params,), budget)
+    r["body_fwd_ms"] = round(ms, 3)
+    log(f"gpt body fwd: {ms:.2f} ms")
+
+    def fwd_loss(x, p):
+        s = loss_of(p, x)
+        return s, shift_tokens(x, s)
+
+    ms, _ = timeit_chained(jax.jit(fwd_loss), x, (params,), budget)
+    r["fwd_loss_ms"] = round(ms, 3)
+    log(f"gpt fwd+loss: {ms:.2f} ms")
+
+    def fwd_bwd(x, p):
+        loss, grads = jax.value_and_grad(loss_of)(p, x)
+        gsum = sum(jnp.sum(g.astype(jnp.float32)) for g in grads.values())
+        total = loss + 1e-30 * gsum
+        return total, shift_tokens(x, total)
+
+    ms, _ = timeit_chained(jax.jit(fwd_bwd), x, (params,), budget)
+    r["fwd_bwd_ms"] = round(ms, 3)
+    log(f"gpt fwd+bwd: {ms:.2f} ms")
+
+    # full: llm_bench's step verbatim (momentum over fp32 masters,
+    # donated); chains through the donated params
+    momentum, lr = 0.9, 0.01
+    vel = {k: jnp.zeros_like(v) for k, v in params.items()
+           if v.dtype == jnp.float32}
+
+    def full(p, v_, x):
+        loss, grads = jax.value_and_grad(loss_of)(p, x)
+        np_, nv = dict(p), dict(v_)
+        for k in v_:
+            vk = momentum * v_[k] + grads[k].astype(jnp.float32)
+            nv[k] = vk
+            np_[k] = p[k] - lr * vk
+        return loss, np_, nv
+
+    jfull = jax.jit(full, donate_argnums=(0, 1))
+    pp, vv = dict(params), dict(vel)
+    loss, pp, vv = jfull(pp, vv, x)
+    float(loss)
+    t0 = time.perf_counter()
+    loss, pp, vv = jfull(pp, vv, x)
+    float(loss)
+    per = max(time.perf_counter() - t0, 1e-5)
+    iters = max(3, min(400, int(budget / per)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, pp, vv = jfull(pp, vv, x)
+    float(loss)
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    r["full_step_ms"] = round(ms, 3)
+    log(f"gpt full step: {ms:.2f} ms")
+
+    # ---- per-layer micro components (fwd+bwd each, serial-chained via
+    # input perturbation from the previous scalar) ----
+    D = U // H
+    q = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.bfloat16)
+
+    def attn_fb(q):
+        def f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True)
+                           .astype(jnp.float32))
+        l, gs = jax.value_and_grad(f, argnums=(0, 1, 2))(q, q, q)
+        total = l + 1e-30 * sum(jnp.sum(g.astype(jnp.float32)) for g in gs)
+        return total, q * (1 + jnp.tanh(total) * 1e-7).astype(q.dtype)
+
+    ms, _ = timeit_chained(jax.jit(attn_fb), q, (), budget / 2)
+    r["attn_layer_fb_ms"] = round(ms, 3)
+
+    h_in = jnp.asarray(rng.standard_normal((B, L, U)), jnp.bfloat16)
+    w1 = jnp.asarray(rng.standard_normal((U, 4 * U)) * 0.02, jnp.bfloat16)
+    w2 = jnp.asarray(rng.standard_normal((4 * U, U)) * 0.02, jnp.bfloat16)
+
+    def mlp_fb(h, w1, w2):
+        def f(h, w1, w2):
+            z = jax.nn.gelu(h @ w1) @ w2
+            return jnp.sum(z.astype(jnp.float32))
+        l, gs = jax.value_and_grad(f, argnums=(0, 1, 2))(h, w1, w2)
+        total = l + 1e-30 * sum(jnp.sum(g.astype(jnp.float32)) for g in gs)
+        return total, h * (1 + jnp.tanh(total) * 1e-7).astype(h.dtype)
+
+    ms, _ = timeit_chained(jax.jit(mlp_fb), h_in, (w1, w2), budget / 2)
+    r["mlp_layer_fb_ms"] = round(ms, 3)
+
+    wv = jnp.asarray(rng.standard_normal((U, V)) * 0.02, jnp.bfloat16)
+    hh = h_in.reshape(-1, U)
+    lab = jnp.asarray(rng.randint(0, V, (B * L,)), jnp.int32)
+
+    def head_fb(h, w):
+        def f(h, w):
+            nll = softmax_cross_entropy(h @ w, lab, per_example=True)
+            return nll.mean()
+        l, gs = jax.value_and_grad(f, argnums=(0, 1))(h, w)
+        total = l + 1e-30 * sum(jnp.sum(g.astype(jnp.float32)) for g in gs)
+        return total, h * (1 + jnp.tanh(total) * 1e-7).astype(h.dtype)
+
+    ms, _ = timeit_chained(jax.jit(head_fb), hh, (wv,), budget / 2)
+    r["lm_head_ce_fb_ms"] = round(ms, 3)
+
+    r["bwd_ms_derived"] = round(r["fwd_bwd_ms"] - r["fwd_loss_ms"], 3)
+    r["head_ce_ms_derived"] = round(r["fwd_loss_ms"] - r["body_fwd_ms"], 3)
+    r["optimizer_ms_derived"] = round(
+        r["full_step_ms"] - r["fwd_bwd_ms"], 3)
+    r["attn_total_est_ms"] = round(r["attn_layer_fb_ms"] * NL, 3)
+    r["mlp_total_est_ms"] = round(r["mlp_layer_fb_ms"] * NL, 3)
+    accounted = (r["attn_total_est_ms"] + r["mlp_total_est_ms"]
+                 + r["lm_head_ce_fb_ms"] + r["optimizer_ms_derived"])
+    r["other_ms_residual"] = round(r["full_step_ms"] - accounted, 3)
+    r["tok_s_full"] = round(B * L / (r["full_step_ms"] / 1e3), 1)
+    return r
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--output", default=None)
+    ap.add_argument("--resnet-batches", default="32,256")
+    ap.add_argument("--quick", action="store_true",
+                    help="halved timing budgets (tunnel-friendly)")
+    ap.add_argument("--skip-gpt", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import threading
+
+    import jax
+
+    up = threading.Event()
+
+    def _watchdog():
+        if not up.wait(180):
+            log("backend init watchdog fired — aborting")
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    devs = jax.devices()
+    up.set()
+    log("devices:", devs)
+    rec = {"device": devs[0].platform,
+           "device_kind": getattr(devs[0], "device_kind", ""),
+           "protocol": "ablation deltas; serial-chain scalar-fetch barrier",
+           "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    for b in [int(s) for s in args.resnet_batches.split(",") if s]:
+        try:
+            rec[f"resnet50_bf16_bs{b}"] = profile_resnet(b, args.quick)
+        except Exception as e:  # noqa: BLE001 — partial profile still banks
+            log(f"resnet bs{b} failed: {e!r}")
+            rec[f"resnet50_bf16_bs{b}"] = {"error": repr(e)[:300]}
+    if not args.skip_gpt:
+        try:
+            rec["gpt_small_bf16_bs8_seq1024"] = profile_gpt(args.quick)
+        except Exception as e:  # noqa: BLE001
+            log(f"gpt profile failed: {e!r}")
+            rec["gpt_small_bf16_bs8_seq1024"] = {"error": repr(e)[:300]}
+
+    # ranked top costs across everything measured (component ms, largest
+    # first) — the "top-3 remaining costs" the VERDICT asks the artifact
+    # to name
+    component_keys = ("fwd_ms", "body_fwd_ms", "bwd_ms_derived",
+                      "optimizer_ms_derived", "head_ce_ms_derived",
+                      "attn_total_est_ms", "mlp_total_est_ms",
+                      "lm_head_ce_fb_ms", "other_ms_residual")
+    costs = []
+    for cfg, d in rec.items():
+        if not isinstance(d, dict) or "error" in d or "full_step_ms" not in d:
+            continue
+        for k in component_keys:
+            v = d.get(k)
+            if isinstance(v, (int, float)) and v > 0:
+                costs.append({"config": cfg, "component": k, "ms": v,
+                              "share_of_step": round(
+                                  v / d["full_step_ms"], 3)})
+    costs.sort(key=lambda c: -c["ms"])
+    rec["top_costs"] = costs[:8]
+    text = json.dumps(rec, indent=2)
+    print(json.dumps(rec), flush=True)
+    out = args.output or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results_profile_%s.json" % devs[0].platform)
+    with open(out, "w") as f:
+        f.write(text + "\n")
+    log(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

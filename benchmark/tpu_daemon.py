@@ -59,6 +59,8 @@ LLM = os.path.join(HERE, "results_llm_tpu.json")
 QUANT = os.path.join(HERE, "results_quant_tpu.json")
 BS256 = os.path.join(HERE, "results_bench_tpu_bs256.json")
 INFER = os.path.join(HERE, "results_infer_tpu.json")
+PROFILE = os.path.join(HERE, "results_profile_tpu.json")
+TRAIN256 = os.path.join(HERE, "results_train_tpu_bs256.json")
 
 PROBE_INTERVAL_S = 180       # while the tunnel is down
 REFRESH_INTERVAL_S = 3600    # after a full successful suite
@@ -421,6 +423,34 @@ def capture_bs256() -> None:
         log(f"bs256: {rec.get('value')} img/s bf16, mfu={rec.get('mfu')}")
 
 
+def capture_profile() -> None:
+    """Ablation profile of the headline training steps (profile_bench.py)
+    — the committed artifact naming where step time goes (VERDICT r4
+    item #1: 'a committed profile artifact naming the remaining top-3
+    costs')."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "profile_bench.py"),
+         "--quick", "--output", "/tmp/profile_bench_raw.json"],
+        timeout=2400)
+    rec = parse_json_output(out)
+    bank_if_tpu(PROFILE, rec, rc, "ablation profile")
+
+
+def capture_train_bs256() -> None:
+    """ResNet-50 bf16 train at bs256 — the MFU-optimal batch next to the
+    bs32 baseline-contract row (VERDICT r4 item #1 targets mfu>=0.35)."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "train_bench.py"),
+         "--models", "resnet50_v1", "--precisions", "bf16",
+         "--batch", "256", "--timeout", "600", "--retries", "1"],
+        timeout=1500)
+    rec = parse_json_output(out)
+    if bank_if_tpu(TRAIN256, rec, rc, "train bs256") and rec:
+        rows = rec.get("results") or [{}]
+        log(f"train bs256: {rows[0].get('train_img_s')} img/s, "
+            f"mfu={rows[0].get('mfu')}")
+
+
 def capture_quant() -> None:
     """INT8 PTQ ResNet-50: quantized throughput + top-1 agreement
     (benchmark/quant_bench.py) — int8 MXU has 2x the bf16 peak."""
@@ -510,7 +540,9 @@ def main() -> None:
                 aborted = False
                 for path, cap in ((PARITY, capture_parity),
                                   (TRAIN, capture_train),
+                                  (TRAIN256, capture_train_bs256),
                                   (LLM, capture_llm),
+                                  (PROFILE, capture_profile),
                                   (BS256, capture_bs256),
                                   (INFER, capture_infer_table),
                                   (QUANT, capture_quant),

@@ -35,26 +35,11 @@ import numpy as onp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# V100 fp32 bs32 training rows (docs perf.md:246-257 via BASELINE.md)
-V100_FP32_TRAIN = {
-    "resnet50_v1": 298.51,
-    "inception_v3": 214.48,
-    "alexnet": 2585.61,
-}
-
-# V100 bs32 inference rows (perf.md:186-198 fp32, :202-216 fp16) — the
-# reference's FULL published per-model inference table; --infer measures
-# the same models so every published row has a TPU peer
-V100_FP32_INFER = {
-    "resnet50_v1": 1076.81,
-    "inception_v3": 814.59,
-    "vgg16": 708.43,
-    "alexnet": 7906.09,
-}
-V100_FP16_INFER = {
-    "resnet50_v1": 2085.51,
-    "resnet152_v1": 887.34,
-}
+# The reference's published V100 rows (perf.md via BASELINE.md) live in
+# ONE shared table so ratios are computed identically everywhere and the
+# gate test can enforce coverage (benchmark/baselines.py).
+from benchmark.baselines import (attach_infer_ratios,  # noqa: E402
+                                 attach_train_ratios)
 
 
 def build_step(net_name, batch, dtype_name, seq_len=128):
@@ -178,14 +163,7 @@ def measure_infer(net_name, batch, dtype_name, log):
            "steps": total_iters, "infer_img_s": round(img_s, 2)}
     log(f"{net_name}/{dtype_name}: {img_s:.1f} img/s inference "
         f"({total_iters} steps, {total_dt:.1f}s)")
-    fp32_base = V100_FP32_INFER.get(net_name)
-    if fp32_base:
-        rec["v100_fp32_baseline"] = fp32_base
-        rec["vs_v100_fp32"] = round(img_s / fp32_base, 3)
-    fp16_base = V100_FP16_INFER.get(net_name)
-    if fp16_base and dtype_name == "bf16":
-        rec["v100_fp16_baseline"] = fp16_base
-        rec["vs_v100_fp16"] = round(img_s / fp16_base, 3)
+    attach_infer_ratios(rec)
     return rec
 
 
@@ -195,6 +173,16 @@ def measure(net_name, batch, dtype_name, log):
 
     jstep, p, vel, x, y = build_step(net_name, batch, dtype_name)
     key = jax.random.PRNGKey(0)
+    # FLOPs via the jaxpr MAC walk (bench.py convention: 2*MACs over
+    # dot/conv, elementwise excluded — keeps mfu comparable across
+    # artifacts). Pure tracing, no backend: works over the axon tunnel,
+    # where remote-compile cost_analysis returns nothing.
+    step_flops = None
+    try:
+        from bench import jaxpr_flops
+        step_flops = jaxpr_flops(jstep, p, vel, x, y, key)
+    except Exception as e:  # noqa: BLE001
+        log(f"jaxpr flop walk failed: {e!r}")
     t0 = time.time()
     p, vel, loss = jstep(p, vel, x, y, key)
     float(loss)
@@ -226,10 +214,18 @@ def measure(net_name, batch, dtype_name, log):
         rec["train_img_s"] = round(img_s, 2)
         log(f"{net_name}/{dtype_name}: {img_s:.1f} img/s "
             f"({total_iters} steps, {total_dt:.1f}s)")
-    base = V100_FP32_TRAIN.get(net_name)
-    if base:
-        rec["v100_fp32_baseline"] = base
-        rec["vs_v100_fp32"] = round(img_s / base, 3)
+    attach_train_ratios(rec)
+    if step_flops:
+        from bench import peak_bf16_tflops
+        achieved = img_s / batch * step_flops / 1e12
+        rec["flops_per_step"] = step_flops
+        rec["flops_source"] = "jaxpr_walk_2mac"
+        rec["achieved_tflops"] = round(achieved, 2)
+        dev = jax.devices()[0]
+        peak = peak_bf16_tflops(getattr(dev, "device_kind", ""))
+        if peak and dtype_name == "bf16" and dev.platform == "tpu":
+            rec["peak_bf16_tflops"] = peak
+            rec["mfu"] = round(achieved / peak, 4)
     return rec
 
 
