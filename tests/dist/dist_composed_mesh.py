@@ -1,0 +1,122 @@
+"""Worker script: multi-HOST x multi-DEVICE composed mesh (VERDICT r4
+item #6 — the real pod topology the dist tests didn't span).
+
+Run via:
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python tools/launch.py -n 2 python tests/dist/dist_composed_mesh.py
+
+2 processes x 4 virtual devices each -> ONE global 8-device mesh,
+dp=2 ACROSS processes (grad reduce rides DCN) x tp=4 WITHIN each process
+(activation collectives ride ICI) — the reference analog is
+``dist_device_sync`` (kvstore_dist.h:218: worker-side multi-GPU reduce
+under the PS), here expressed as shardings on one jitted train step.
+
+Asserts on every rank:
+- 8 global devices, 4 local, correct process layout
+- one Megatron-TP train step (column/row-sharded MLP, batch dp-sharded)
+  runs under jit over the global mesh
+- the updated weights match a single-process NumPy oracle to fp32
+  tolerance on every rank (loss AND parameter parity)
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as onp  # noqa: E402
+
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+
+def main():
+    dist.initialize()
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    assert nproc == 2, f"expected 2 processes, got {nproc}"
+    local = jax.local_devices()
+    assert len(local) == 4, f"expected 4 local devices, got {len(local)}"
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 global devices, got {len(devs)}"
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # dp spans processes, tp spans the 4 devices inside one process:
+    # rows of the mesh = processes (jax.devices() is grouped by process)
+    grid = onp.array(devs).reshape(nproc, 4)
+    assert all(d.process_index == i for i, row in enumerate(grid)
+               for d in row), "mesh rows must be per-process"
+    mesh = Mesh(grid, ("dp", "tp"))
+
+    B, D, H, O = 8, 16, 32, 4  # global batch, in, hidden (tp-sharded), out
+    rng = onp.random.RandomState(0)  # identical on every rank
+    w1 = rng.randn(D, H).astype(onp.float32) * 0.3   # column-parallel
+    w2 = rng.randn(H, O).astype(onp.float32) * 0.3   # row-parallel
+    X = rng.randn(B, D).astype(onp.float32)
+    Y = rng.randn(B, O).astype(onp.float32)
+    lr = 0.1
+
+    s_w1 = NamedSharding(mesh, P(None, "tp"))
+    s_w2 = NamedSharding(mesh, P("tp", None))
+    s_x = NamedSharding(mesh, P("dp", None))
+    s_repl = NamedSharding(mesh, P())
+
+    def loss_fn(p, x, y):
+        h = jnp.tanh(x @ p["w1"])       # activations sharded over tp
+        out = h @ p["w2"]               # partial sums -> psum (GSPMD)
+        return jnp.mean((out - y) ** 2)
+
+    def step(p, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        return loss, {k: v - lr * g[k] for k, v in p.items()}
+
+    jstep = jax.jit(step,
+                    in_shardings=({"w1": s_w1, "w2": s_w2}, s_x, s_x),
+                    out_shardings=(s_repl, {"w1": s_w1, "w2": s_w2}))
+
+    # global arrays from process-local shards (each process owns its
+    # dp slice of the batch — the multi-controller data path)
+    def global_batch(a, sharding):
+        return jax.make_array_from_process_local_data(
+            sharding, a[rank * (B // nproc): (rank + 1) * (B // nproc)])
+
+    p = {"w1": jax.device_put(jnp.asarray(w1), s_w1),
+         "w2": jax.device_put(jnp.asarray(w2), s_w2)}
+    x = global_batch(X, s_x)
+    y = global_batch(Y, s_x)
+
+    loss, p2 = jstep(p, x, y)
+    loss = float(loss)
+
+    # -- NumPy oracle: the same step, unsharded ---------------------------
+    h = onp.tanh(X @ w1)
+    out = h @ w2
+    o_loss = float(onp.mean((out - Y) ** 2))
+    g_out = 2.0 / (B * O) * (out - Y)
+    g_w2 = h.T @ g_out
+    g_h = g_out @ w2.T
+    g_pre = g_h * (1 - h ** 2)
+    g_w1 = X.T @ g_pre
+    o_w1, o_w2 = w1 - lr * g_w1, w2 - lr * g_w2
+
+    assert abs(loss - o_loss) < 1e-5 * max(1.0, abs(o_loss)), \
+        f"loss {loss} != oracle {o_loss}"
+    got_w1 = onp.asarray(jax.device_get(p2["w1"]))
+    got_w2 = onp.asarray(jax.device_get(p2["w2"]))
+    onp.testing.assert_allclose(got_w1, o_w1, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(got_w2, o_w2, rtol=1e-5, atol=1e-6)
+
+    # a second step keeps composing (state threads through correctly)
+    loss2, _ = jstep(p2, x, y)
+    assert float(loss2) < loss, "loss must decrease on step 2"
+
+    print(f"COMPOSED_MESH_OK rank={rank}/{nproc} local_devs=4 "
+          f"loss={loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

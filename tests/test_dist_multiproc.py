@@ -38,3 +38,25 @@ def test_launch_py_propagates_failure():
          "-n", "2", "--", sys.executable, "-c", "import sys; sys.exit(7)"],
         capture_output=True, text=True, timeout=120)
     assert proc.returncode != 0
+
+
+@pytest.mark.integration
+def test_multihost_multidevice_composed_mesh():
+    """2 processes x 4 virtual devices each -> one global dp(across
+    hosts) x tp(within host) mesh — the real pod topology (DCN between
+    processes, ICI inside), untested by the per-process=1-device rig
+    above. Reference analog: dist_device_sync worker-side multi-GPU
+    reduce (kvstore_dist.h:218). Oracle parity on every rank."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--timeout", "300", "--",
+         sys.executable, os.path.join(ROOT, "tests", "dist",
+                                      "dist_composed_mesh.py")],
+        capture_output=True, text=True, timeout=360, env=env, cwd=ROOT)
+    assert proc.returncode == 0, (
+        f"launch rc={proc.returncode}\nstdout:\n{proc.stdout[-3000:]}"
+        f"\nstderr:\n{proc.stderr[-3000:]}")
+    for r in range(2):
+        assert f"COMPOSED_MESH_OK rank={r}/2" in proc.stdout
