@@ -36,7 +36,54 @@ def parse_args():
     p.add_argument("--epochs", type=int, default=4)
     p.add_argument("--lr", type=float, default=2e-3)
     p.add_argument("--cpu", action="store_true")
+    p.add_argument("--recordio", action="store_true",
+                   help="train from a packed .rec through ImageDetIter "
+                        "(the reference's SSD data path: im2rec "
+                        "--pack-label -> iter_image_det_recordio) instead "
+                        "of in-memory arrays")
     return p.parse_args()
+
+
+def synth_detection_rgb(n, size, seed=0, max_objs=2):
+    """RGB uint8 rectangles + wire-format packed labels, for the
+    RecordIO path (same distribution as synth_detection_data)."""
+    rng = onp.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        im = onp.zeros((size, size, 3), onp.uint8)
+        boxes = []
+        for _ in range(rng.randint(1, max_objs + 1)):
+            w = rng.randint(size // 4, size // 2)
+            h = rng.randint(size // 4, size // 2)
+            x = rng.randint(0, size - w)
+            y = rng.randint(0, size - h)
+            cls = int(rng.randint(0, 2))
+            if cls == 0:
+                im[y: y + h, x: x + w] = (255, 255, 255)
+            else:
+                im[y: y + h, x: x + w] = (90, 90, 90)
+                im[y + 1: y + h - 1, x + 1: x + w - 1] = 0
+            boxes.append([cls, x / size, y / size,
+                          (x + w) / size, (y + h) / size])
+        label = [2.0, 5.0]
+        for b in boxes:
+            label.extend(b)
+        out.append((im, onp.asarray(label, onp.float32)))
+    return out
+
+
+def write_det_rec(samples, prefix):
+    """Pack (image, wire-label) pairs into an indexed .rec — what
+    tools/im2rec.py --pack-label produces (reference recordio contract)."""
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i, (im, label) in enumerate(samples):
+        payload = recordio.pack_img(recordio.IRHeader(0, label, i, 0),
+                                    im, img_fmt=".png")
+        rec.write_idx(i, payload)
+    rec.close()
+    return prefix + ".rec"
 
 
 def synth_detection_data(n, size, seed=0, max_objs=2):
@@ -72,8 +119,36 @@ def main():
     from mxnet_tpu.gluon import nn
 
     num_classes = 2  # + background
-    imgs, labels = synth_detection_data(args.nimages, args.size, seed=0)
-    val_imgs, val_labels = synth_detection_data(48, args.size, seed=1)
+    if args.recordio:
+        # the reference data path: packed labels in an indexed .rec,
+        # decoded + box-aware-augmented by ImageDetIter
+        import atexit
+        import shutil
+        import tempfile
+
+        tmpd = tempfile.mkdtemp(prefix="ssd_rec_")
+        atexit.register(shutil.rmtree, tmpd, True)
+        onp.random.seed(0)  # augmenters draw from onp.random
+        train_rec = write_det_rec(
+            synth_detection_rgb(args.nimages, args.size, seed=0),
+            os.path.join(tmpd, "train"))
+        val_rec = write_det_rec(
+            synth_detection_rgb(48, args.size, seed=1),
+            os.path.join(tmpd, "val"))
+        shape = (3, args.size, args.size)
+        train_it = mx.image.ImageDetIter(
+            args.batch_size, shape, path_imgrec=train_rec, shuffle=True,
+            rand_mirror=True)
+        val_it = mx.image.ImageDetIter(48, shape, path_imgrec=val_rec)
+        train_it.sync_label_shape(val_it)
+        vb = next(val_it)
+        val_imgs = vb.data[0].asnumpy() / 255.0
+        val_labels = vb.label[0].asnumpy()
+        print(f"recordio pipeline: {train_rec} "
+              f"(label_shape {train_it.label_shape})", flush=True)
+    else:
+        imgs, labels = synth_detection_data(args.nimages, args.size, seed=0)
+        val_imgs, val_labels = synth_detection_data(48, args.size, seed=1)
 
     # backbone downsamples 32 -> 8; one anchor grid at that stride
     backbone = nn.HybridSequential(
@@ -107,14 +182,23 @@ def main():
         box_pred = bp.transpose(0, 2, 3, 1).reshape(B, -1)  # (B, A*4)
         return anchors.reshape(1, -1, 4), cls_pred, box_pred
 
-    n = len(imgs)
+    def epoch_batches(epoch):
+        if args.recordio:
+            train_it.reset()
+            for batch in train_it:
+                if batch.pad:
+                    continue  # ragged tail: padded duplicates skew loss
+                yield batch.data[0] / 255.0, batch.label[0]
+        else:
+            n = len(imgs)
+            perm = onp.random.RandomState(epoch).permutation(n)
+            for i in range(0, n - args.batch_size + 1, args.batch_size):
+                idx = perm[i: i + args.batch_size]
+                yield mx.np.array(imgs[idx]), mx.np.array(labels[idx])
+
     for epoch in range(args.epochs):
-        perm = onp.random.RandomState(epoch).permutation(n)
         tot, t0 = 0.0, time.time()
-        for i in range(0, n - args.batch_size + 1, args.batch_size):
-            idx = perm[i: i + args.batch_size]
-            x = mx.np.array(imgs[idx])
-            y = mx.np.array(labels[idx])
+        for x, y in epoch_batches(epoch):
             with autograd.record():
                 anchors, cls_pred, box_pred = forward(x)
                 # target assignment is label prep: outside the grad path

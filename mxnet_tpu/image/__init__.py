@@ -330,3 +330,17 @@ class ImageIter:
         return DataBatch([data], [label], pad=pad)
 
     next = __next__
+
+
+# Detection pipeline (reference python/mxnet/image/detection.py) —
+# imported last: detection.py pulls the augmenter/iterator primitives
+# from this (by then fully initialized) module.
+from .detection import (CreateDetAugmenter, CreateMultiRandCropAugmenter,  # noqa: E402,F401
+                        DetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, DetRandomPadAug,
+                        DetRandomSelectAug, ForceResizeAug, ImageDetIter)
+
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+            "ForceResizeAug", "CreateMultiRandCropAugmenter",
+            "CreateDetAugmenter", "ImageDetIter"]

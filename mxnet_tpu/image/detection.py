@@ -1,0 +1,478 @@
+"""Detection data pipeline: box-aware augmenters + ``ImageDetIter``.
+
+Reference surface: ``python/mxnet/image/detection.py`` (DetAugmenter
+family, ``CreateDetAugmenter``, ``ImageDetIter``) and the native
+``src/io/iter_image_det_recordio.cc`` reader. Same label protocol, same
+augmenter semantics, re-written for this stack's split of labor: all
+augmentation is host-side numpy (the chip only ever sees fixed-shape
+``(B, C, H, W)`` batches and ``(B, max_objs, obj_width)`` labels, so
+XLA compiles the train step exactly once).
+
+Label wire format (reference ``ImageDetIter._parse_label``)::
+
+    [header_width, obj_width, ...extra header..., obj0..., obj1..., ...]
+
+where each object record is ``[id, xmin, ymin, xmax, ymax, ...extra]``
+with coordinates normalized to [0, 1]. Parsed labels are ``(N,
+obj_width)`` float32; batches pad object rows with ``-1`` (the padding
+convention ``npx.multibox_target`` already ignores).
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from . import (Augmenter, CastAug, ColorNormalizeAug, ImageIter, ResizeAug,
+               _to_np, imresize)
+
+__all__ = [
+    "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+    "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+    "ForceResizeAug", "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+    "ImageDetIter",
+]
+
+
+def _box_areas(boxes: onp.ndarray) -> onp.ndarray:
+    """Areas of ``(N, 4+)`` normalized [xmin ymin xmax ymax ...] rows."""
+    w = onp.maximum(0.0, boxes[:, 2] - boxes[:, 0])
+    h = onp.maximum(0.0, boxes[:, 3] - boxes[:, 1])
+    return w * h
+
+
+class ForceResizeAug(Augmenter):
+    """Resize to an exact (w, h) regardless of aspect ratio (reference
+    image.py ForceResizeAug) — the last geometric step of every
+    detection pipeline, since normalized boxes are scale-invariant."""
+
+    def __init__(self, size: Tuple[int, int], interp: int = 2):
+        self.size = size  # (w, h)
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class DetAugmenter:
+    """Base detection augmenter: ``(image, label) -> (image, label)``
+    where label is ``(N, obj_width)`` with normalized boxes in cols 1:5
+    (reference detection.py:40)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), self.__dict__]
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only Augmenter into the detection pipeline: the
+    wrapped aug must not change geometry-to-label mapping (color ops,
+    exact resize — normalized boxes survive both). Reference
+    detection.py:66."""
+
+    def __init__(self, augmenter: Augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Apply ONE randomly chosen member of ``aug_list`` (or none, with
+    probability ``skip_prob``) — the reference's mechanism for 'pick one
+    of several crop samplers per image' (detection.py:91)."""
+
+    def __init__(self, aug_list: Sequence[DetAugmenter], skip_prob: float = 0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or float(onp.random.random()) < self.skip_prob:
+            return src, label
+        return self.aug_list[onp.random.randint(len(self.aug_list))](src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image AND boxes with probability ``p`` (reference
+    detection.py:127): x' = 1 - x with min/max swapped."""
+
+    def __init__(self, p: float):
+        self.p = p
+
+    def __call__(self, src, label):
+        if float(onp.random.random()) < self.p:
+            src = _to_np(src)[:, ::-1]
+            label = label.copy()
+            label[:, 1], label[:, 3] = 1.0 - label[:, 3], 1.0 - label[:, 1].copy()
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Constrained random crop (reference detection.py:153): sample a
+    crop window whose aspect/area lie in range and that covers at least
+    ``min_object_covered`` of some box; boxes are re-expressed in crop
+    coordinates, clipped, and ejected when their surviving area drops
+    below ``min_eject_coverage`` of the original. After ``max_attempts``
+    failures the image passes through unchanged."""
+
+    def __init__(self, min_object_covered: float = 0.1,
+                 aspect_ratio_range: Tuple[float, float] = (0.75, 1.33),
+                 area_range: Tuple[float, float] = (0.05, 1.0),
+                 min_eject_coverage: float = 0.3, max_attempts: int = 50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = tuple(aspect_ratio_range)
+        self.area_range = tuple(area_range)
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 0 and area_range[0] <= area_range[1]
+                        and 0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+        if not self.enabled:
+            logging.warning("DetRandomCropAug disabled: invalid ranges %s %s",
+                            area_range, aspect_ratio_range)
+
+    def __call__(self, src, label):
+        src = _to_np(src)
+        h, w = src.shape[:2]
+        prop = self._propose(label, h, w)
+        if prop is not None:
+            x0, y0, cw, ch, label = prop
+            src = src[y0: y0 + ch, x0: x0 + cw]
+        return src, label
+
+    # -- geometry helpers (normalized coords) ------------------------------
+    def _covered_enough(self, label, x0, y0, x1, y1) -> bool:
+        boxes = label[:, 1:5]
+        areas = _box_areas(boxes)
+        valid = areas > 0
+        if not valid.any():
+            return False
+        ix0 = onp.maximum(boxes[valid, 0], x0)
+        iy0 = onp.maximum(boxes[valid, 1], y0)
+        ix1 = onp.minimum(boxes[valid, 2], x1)
+        iy1 = onp.minimum(boxes[valid, 3], y1)
+        inter = onp.maximum(0, ix1 - ix0) * onp.maximum(0, iy1 - iy0)
+        cov = inter / areas[valid]
+        cov = cov[cov > 0]
+        return cov.size > 0 and float(cov.min()) > self.min_object_covered
+
+    def _crop_labels(self, label, x0, y0, cw, ch) -> Optional[onp.ndarray]:
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] - x0) / cw
+        out[:, (2, 4)] = (out[:, (2, 4)] - y0) / ch
+        out[:, 1:5] = onp.clip(out[:, 1:5], 0.0, 1.0)
+        cov = (_box_areas(out[:, 1:5]) * cw * ch
+               / onp.maximum(_box_areas(label[:, 1:5]), 1e-12))
+        keep = ((out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+                & (cov > self.min_eject_coverage))
+        if not keep.any():
+            return None
+        return out[keep]
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        for _ in range(self.max_attempts):
+            ratio = onp.random.uniform(*self.aspect_ratio_range)
+            area_frac = onp.random.uniform(*self.area_range)
+            area = area_frac * height * width
+            ch = int(round((area / ratio) ** 0.5))
+            cw = int(round(ch * ratio))
+            if ch < 1 or cw < 1 or ch > height or cw > width or cw * ch < 2:
+                continue
+            y0 = int(onp.random.randint(0, height - ch + 1))
+            x0 = int(onp.random.randint(0, width - cw + 1))
+            nx0, ny0 = x0 / width, y0 / height
+            nx1, ny1 = (x0 + cw) / width, (y0 + ch) / height
+            if not self._covered_enough(label, nx0, ny0, nx1, ny1):
+                continue
+            new_label = self._crop_labels(label, nx0, ny0,
+                                          cw / width, ch / height)
+            if new_label is not None:
+                return x0, y0, cw, ch, new_label
+        return None
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand-and-pad (reference detection.py:324): place the
+    image on a larger canvas filled with ``pad_val``; boxes shrink into
+    the new canvas coordinates. 'Zoom out' augmentation for small-object
+    robustness."""
+
+    def __init__(self, aspect_ratio_range: Tuple[float, float] = (0.75, 1.33),
+                 area_range: Tuple[float, float] = (1.0, 3.0),
+                 max_attempts: int = 50,
+                 pad_val: Tuple[float, ...] = (128, 128, 128)):
+        if not isinstance(pad_val, (tuple, list)):
+            pad_val = (pad_val,)
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        self.pad_val = tuple(pad_val)
+        self.aspect_ratio_range = tuple(aspect_ratio_range)
+        self.area_range = tuple(area_range)
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 1.0 and area_range[0] <= area_range[1]
+                        and 0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+        if not self.enabled:
+            logging.warning("DetRandomPadAug disabled: invalid ranges %s %s",
+                            area_range, aspect_ratio_range)
+
+    def __call__(self, src, label):
+        src = _to_np(src)
+        h, w = src.shape[:2]
+        prop = self._propose(h, w)
+        if prop is not None:
+            x0, y0, pw, ph = prop
+            canvas = onp.empty((ph, pw) + src.shape[2:], src.dtype)
+            pv = onp.asarray(self.pad_val, src.dtype)
+            canvas[...] = pv if src.ndim == 3 and len(pv) == src.shape[2] \
+                else pv.ravel()[0]
+            canvas[y0: y0 + h, x0: x0 + w] = src
+            src = canvas
+            label = label.copy()
+            label[:, (1, 3)] = (label[:, (1, 3)] * w + x0) / pw
+            label[:, (2, 4)] = (label[:, (2, 4)] * h + y0) / ph
+        return src, label
+
+    def _propose(self, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        for _ in range(self.max_attempts):
+            ratio = onp.random.uniform(*self.aspect_ratio_range)
+            area_frac = onp.random.uniform(*self.area_range)
+            area = area_frac * height * width
+            ph = int(round((area / ratio) ** 0.5))
+            pw = int(round(ph * ratio))
+            if ph - height < 2 or pw - width < 2:
+                continue  # marginal padding buys nothing
+            y0 = int(onp.random.randint(0, ph - height + 1))
+            x0 = int(onp.random.randint(0, pw - width + 1))
+            return x0, y0, pw, ph
+        return None
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0) -> DetRandomSelectAug:
+    """One DetRandomCropAug per parameter combination, wrapped in a
+    random selector — pass lists to get the SSD-style multi-sampler
+    (reference detection.py:418). Scalar params broadcast."""
+    def as_list(x):
+        return list(x) if isinstance(x, (list, tuple)) and \
+            isinstance(x[0], (list, tuple)) else None
+
+    covered = (list(min_object_covered)
+               if isinstance(min_object_covered, (list, tuple))
+               else [min_object_covered])
+    aspects = as_list(aspect_ratio_range) or [aspect_ratio_range]
+    areas = as_list(area_range) or [area_range]
+    ejects = (list(min_eject_coverage)
+              if isinstance(min_eject_coverage, (list, tuple))
+              else [min_eject_coverage])
+    n = max(len(covered), len(aspects), len(areas), len(ejects))
+    for name, lst in (("min_object_covered", covered),
+                      ("aspect_ratio_range", aspects),
+                      ("area_range", areas),
+                      ("min_eject_coverage", ejects)):
+        if len(lst) not in (1, n):
+            raise MXNetError(
+                f"{name} has {len(lst)} entries; expected 1 or {n} "
+                "(the reference asserts equal lengths)")
+
+    def pick(lst, i):
+        return lst[i] if len(lst) == n else lst[0]
+
+    augs = [DetRandomCropAug(pick(covered, i), pick(aspects, i),
+                             pick(areas, i), pick(ejects, i), max_attempts)
+            for i in range(n)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50,
+                       pad_val=(127, 127, 127)) -> List[DetAugmenter]:
+    """The reference's standard detection pipeline (detection.py:483):
+    resize → (prob) constrained crop → mirror → (prob) pad → force-resize
+    to data_shape → cast → normalize. Color-jitter knobs are accepted by
+    the classification CreateAugmenter; compose via DetBorrowAug when
+    needed."""
+    augs: List[DetAugmenter] = []
+    if resize > 0:
+        augs.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        augs.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range, area_range,
+            min_eject_coverage, max_attempts, skip_prob=1 - rand_crop))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:  # late: pad last saves work on the cropped image
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, area_range[1]), max_attempts, pad_val)
+        augs.append(DetRandomSelectAug([pad], skip_prob=1 - rand_pad))
+    augs.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    augs.append(DetBorrowAug(CastAug()))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53], onp.float32)
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375], onp.float32)
+    if mean is not None or std is not None:
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator over .rec / .lst sources (reference
+    detection.py:625 + iter_image_det_recordio.cc).
+
+    Emits fixed-shape batches: data ``(B, C, H, W)`` float32 and labels
+    ``(B, max_objs, obj_width)`` with unused rows filled with ``-1`` —
+    static shapes so the jitted train step compiles once (the TPU
+    contract; the reference padded to ``label_shape`` for the same
+    reason)."""
+
+    def __init__(self, batch_size: int, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", shuffle: bool = False,
+                 aug_list: Optional[List[DetAugmenter]] = None,
+                 data_name: str = "data", label_name: str = "label",
+                 **kwargs):
+        super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         aug_list=[], shuffle=shuffle)
+        self.auglist = (aug_list if aug_list is not None
+                        else CreateDetAugmenter(data_shape, **kwargs))
+        self.data_name, self.label_name = data_name, label_name
+        self.label_shape = self._estimate_label_shape()
+        self.provide_data = [(data_name, (batch_size,) + tuple(data_shape))]
+        self.provide_label = [(label_name,
+                               (batch_size,) + self.label_shape)]
+
+    # -- label protocol ----------------------------------------------------
+    @staticmethod
+    def _parse_label(label) -> onp.ndarray:
+        """Wire header → (N, obj_width) float32 (reference
+        detection.py:717)."""
+        raw = onp.asarray(label, onp.float32).ravel()
+        if raw.size < 7:
+            raise MXNetError(f"detection label too short: {raw.size}")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5 or header_width < 2:
+            raise MXNetError(
+                f"label header invalid: header_width={header_width} "
+                f"obj_width={obj_width}")
+        if (raw.size - header_width) % obj_width:
+            raise MXNetError(
+                f"label size {raw.size} inconsistent with header "
+                f"{header_width}/{obj_width}")
+        out = raw[header_width:].reshape(-1, obj_width)
+        keep = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        out = out[keep]
+        if out.shape[0] < 1:
+            raise MXNetError("sample with no valid box")
+        return out
+
+    def _check_valid_label(self, label: onp.ndarray) -> None:
+        if label.ndim != 2 or label.shape[1] < 5:
+            raise MXNetError(f"label must be (1+, 5+), got {label.shape}")
+        ok = ((label[:, 0] >= 0) & (label[:, 3] > label[:, 1])
+              & (label[:, 4] > label[:, 2]))
+        if not ok.any():
+            raise MXNetError("no valid box in label")
+
+    def _estimate_label_shape(self) -> Tuple[int, int]:
+        max_objs, width = 0, 5
+        for rec in self._records:
+            parsed = self._parse_label(rec[0])
+            max_objs = max(max_objs, parsed.shape[0])
+            width = parsed.shape[1]
+        return (max_objs, width)
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.data_shape = tuple(data_shape)
+            self.provide_data = [(self.data_name,
+                                  (self.batch_size,) + self.data_shape)]
+        if label_shape is not None:
+            if any(int(n) < int(c) for n, c in
+                   zip(label_shape, self.label_shape)):
+                raise MXNetError(
+                    f"label_shape {tuple(label_shape)} smaller than "
+                    f"required {self.label_shape} (elementwise)")
+            self.label_shape = tuple(label_shape)
+            self.provide_label = [(self.label_name,
+                                   (self.batch_size,) + self.label_shape)]
+
+    def sync_label_shape(self, it: "ImageDetIter", verbose=False):
+        """Make train/val iterators agree on the padded label shape
+        (reference detection.py:1004)."""
+        shape = tuple(onp.maximum(self.label_shape, it.label_shape))
+        self.reshape(label_shape=shape)
+        it.reshape(label_shape=shape)
+        if verbose:
+            logging.info("label shape synced to %s", shape)
+        return it
+
+    # -- batching ----------------------------------------------------------
+    def _load_det(self, idx: int):
+        from . import imdecode, imread
+
+        label_raw, payload, path = self._records[idx]
+        img = imdecode(payload) if payload else imread(path)
+        label = self._parse_label(label_raw)
+        img = _to_np(img)
+        for aug in self.auglist:
+            img, label = aug(img, label)
+        self._check_valid_label(label)
+        arr = _to_np(img)
+        if arr.shape[:2] != self.data_shape[1:]:
+            arr = _to_np(imresize(arr, self.data_shape[2],
+                                  self.data_shape[1]))
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(2, 0, 1).astype(onp.float32), label
+
+    def __next__(self):
+        from .. import numpy as mxnp
+        from ..io import DataBatch
+
+        if self._cursor >= len(self._records):
+            raise StopIteration
+        max_objs, width = self.label_shape
+        imgs = onp.zeros((self.batch_size,) + tuple(self.data_shape),
+                         onp.float32)
+        labels = onp.full((self.batch_size, max_objs, width), -1.0,
+                          onp.float32)
+        pad = 0
+        for b in range(self.batch_size):
+            if self._cursor >= len(self._records):
+                # reference 'pad' handling: recycle row 0 (the entry
+                # StopIteration check guarantees row 0 was loaded)
+                imgs[b] = imgs[0]
+                labels[b] = labels[0]
+                pad += 1
+                continue
+            arr, label = self._load_det(int(self._order[self._cursor]))
+            self._cursor += 1
+            imgs[b] = arr
+            n = min(label.shape[0], max_objs)
+            w = min(label.shape[1], width)  # narrower source (e.g. after
+            labels[b, :n, :w] = label[:n, :w]  # sync_label_shape) pads -1
+        return DataBatch([mxnp.array(imgs)], [mxnp.array(labels)], pad=pad)
+
+    next = __next__

@@ -84,6 +84,20 @@ def test_ssd_entry_point():
 
 @pytest.mark.integration
 @pytest.mark.seed(0)
+def test_ssd_from_recordio():
+    """SSD training from a packed .rec through ImageDetIter — the
+    reference's detection data path (im2rec --pack-label ->
+    iter_image_det_recordio.cc), VERDICT r4 item #8 recall gate."""
+    out = _run("example/gluon/ssd.py", "--recordio", "--epochs", "8",
+               "--nimages", "96")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "recordio pipeline:" in out.stdout
+    recall = float(out.stdout.rsplit("recall@0.5=", 1)[1].split()[0])
+    assert recall >= 0.7, f"SSD-from-RecordIO recall {recall} too low"
+
+
+@pytest.mark.integration
+@pytest.mark.seed(0)
 def test_bi_lstm_sort_entry_point():
     out = _run("example/bi-lstm-sort/lstm_sort.py",
                "--epochs", "4", "--ntrain", "1536")

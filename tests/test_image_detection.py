@@ -1,0 +1,269 @@
+"""Detection data pipeline (reference python/mxnet/image/detection.py:
+DetAugmenter family + ImageDetIter; iter_image_det_recordio.cc for the
+.rec source). Label protocol, box-aware geometry, fixed-shape batching."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+from mxnet_tpu import recordio
+from mxnet_tpu.base import MXNetError
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def det_label(boxes, header_width=2, obj_width=5):
+    """Build a wire-format detection label: [hw, ow, (id x0 y0 x1 y1)*]."""
+    flat = [float(header_width), float(obj_width)]
+    for b in boxes:
+        flat.extend(float(v) for v in b)
+    return onp.asarray(flat, onp.float32)
+
+
+class TestLabelProtocol:
+    def test_parse_roundtrip(self):
+        lab = det_label([[1, 0.1, 0.2, 0.5, 0.6], [0, 0.3, 0.3, 0.9, 0.8]])
+        out = img.ImageDetIter._parse_label(lab)
+        assert out.shape == (2, 5)
+        onp.testing.assert_allclose(out[0], [1, 0.1, 0.2, 0.5, 0.6])
+
+    def test_parse_drops_degenerate_boxes(self):
+        lab = det_label([[1, 0.1, 0.2, 0.5, 0.6],
+                         [0, 0.5, 0.5, 0.5, 0.9]])  # zero width
+        out = img.ImageDetIter._parse_label(lab)
+        assert out.shape == (1, 5)
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(MXNetError):
+            img.ImageDetIter._parse_label(onp.zeros(3, onp.float32))
+        with pytest.raises(MXNetError):  # inconsistent width
+            img.ImageDetIter._parse_label(
+                onp.asarray([2, 5, 1, .1, .1, .5], onp.float32))
+        with pytest.raises(MXNetError):  # all boxes degenerate
+            img.ImageDetIter._parse_label(
+                det_label([[0, .5, .5, .4, .4]]))
+
+    def test_extra_header_and_obj_fields(self):
+        lab = det_label([[1, 0.1, 0.2, 0.5, 0.6, 7.0]],
+                        header_width=3, obj_width=6)
+        lab = onp.insert(lab, 2, 99.0)  # extra header slot
+        out = img.ImageDetIter._parse_label(lab)
+        assert out.shape == (1, 6)
+        assert out[0, 5] == 7.0  # extra per-object field preserved
+
+
+class TestAugmenters:
+    def _img(self, h=40, w=60):
+        return onp.arange(h * w * 3, dtype=onp.uint8).reshape(h, w, 3) % 255
+
+    def test_flip_mirrors_boxes(self):
+        src = self._img()
+        lab = onp.asarray([[0, 0.1, 0.2, 0.4, 0.7]], onp.float32)
+        out, lout = img.DetHorizontalFlipAug(p=1.1)(src, lab)
+        onp.testing.assert_allclose(out, src[:, ::-1])
+        onp.testing.assert_allclose(lout[0], [0, 0.6, 0.2, 0.9, 0.7],
+                                    atol=1e-6)
+        # involution: flipping twice restores
+        _, lback = img.DetHorizontalFlipAug(p=1.1)(out, lout)
+        onp.testing.assert_allclose(lback, lab, atol=1e-6)
+
+    @pytest.mark.seed(7)
+    def test_random_crop_keeps_box_geometry(self):
+        onp.random.seed(7)
+        src = self._img(80, 80)
+        lab = onp.asarray([[1, 0.25, 0.25, 0.75, 0.75]], onp.float32)
+        aug = img.DetRandomCropAug(min_object_covered=0.5,
+                                   area_range=(0.5, 1.0))
+        for _ in range(10):
+            out, lout = aug(src.copy(), lab.copy())
+            assert lout.shape[1] == 5
+            # updated boxes stay normalized and non-degenerate
+            assert (lout[:, 1:5] >= 0).all() and (lout[:, 1:5] <= 1).all()
+            assert (lout[:, 3] > lout[:, 1]).all()
+            assert (lout[:, 4] > lout[:, 2]).all()
+            # crop geometry: box center in pixels maps consistently —
+            # re-derive the crop from the image shape change
+            assert out.shape[0] <= 80 and out.shape[1] <= 80
+
+    def test_crop_label_math_exact(self):
+        """White-box: a known crop window produces exactly re-normalized
+        boxes (reference _update_labels semantics)."""
+        aug = img.DetRandomCropAug()
+        lab = onp.asarray([[2, 0.2, 0.2, 0.6, 0.6]], onp.float32)
+        out = aug._crop_labels(lab, 0.1, 0.1, 0.5, 0.5)
+        onp.testing.assert_allclose(out[0], [2, 0.2, 0.2, 1.0, 1.0],
+                                    atol=1e-6)
+
+    def test_crop_ejects_low_coverage(self):
+        aug = img.DetRandomCropAug(min_eject_coverage=0.5)
+        lab = onp.asarray([[0, 0.0, 0.0, 0.2, 0.2],   # outside the crop
+                           [1, 0.5, 0.5, 0.9, 0.9]], onp.float32)
+        out = aug._crop_labels(lab, 0.45, 0.45, 0.5, 0.5)
+        assert out.shape[0] == 1 and out[0, 0] == 1
+
+    @pytest.mark.seed(3)
+    def test_random_pad_shrinks_boxes_and_fills(self):
+        onp.random.seed(3)
+        src = onp.full((20, 20, 3), 9, onp.uint8)
+        lab = onp.asarray([[0, 0.0, 0.0, 1.0, 1.0]], onp.float32)
+        aug = img.DetRandomPadAug(area_range=(2.0, 3.0), pad_val=(1, 2, 3))
+        out, lout = aug(src, lab)
+        assert out.shape[0] > 20 and out.shape[1] > 20
+        # the original image's box now covers exactly the pasted region
+        x0, y0, x1, y1 = lout[0, 1:5]
+        ph, pw = out.shape[:2]
+        px0, py0 = int(round(x0 * pw)), int(round(y0 * ph))
+        assert (out[py0: py0 + 20, px0: px0 + 20] == 9).all()
+        # padding filled per channel
+        corner = out[0, 0] if py0 > 0 or px0 > 0 else out[-1, -1]
+        assert tuple(corner) == (1, 2, 3)
+
+    def test_select_aug_skip_prob_extremes(self):
+        marks = []
+
+        class Marker(img.DetAugmenter):
+            def __call__(self, s, l):
+                marks.append(1)
+                return s, l
+
+        s, l = self._img(), onp.zeros((1, 5), onp.float32)
+        img.DetRandomSelectAug([Marker()], skip_prob=1.1)(s, l)
+        assert not marks
+        img.DetRandomSelectAug([Marker()], skip_prob=0.0)(s, l)
+        assert marks
+
+    def test_create_det_augmenter_pipeline_shapes(self):
+        onp.random.seed(0)
+        augs = img.CreateDetAugmenter((3, 32, 32), rand_crop=0.5,
+                                      rand_pad=0.5, rand_mirror=True,
+                                      mean=True, std=True)
+        src = self._img(50, 70).astype(onp.float32)
+        lab = onp.asarray([[0, 0.3, 0.3, 0.8, 0.8]], onp.float32)
+        for _ in range(5):
+            im, lb = src.copy(), lab.copy()
+            for a in augs:
+                im, lb = a(im, lb)
+            arr = onp.asarray(im)
+            assert arr.shape[:2] == (32, 32)  # forced to data_shape
+            assert lb.shape[1] == 5 and lb.shape[0] >= 1
+
+
+def _write_det_fixture(tmp_path, n=8, size=24, max_objs=2):
+    """Synthetic detection .rec/.lst: rectangles with packed labels."""
+    rng = onp.random.RandomState(0)
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "det.idx"),
+                                     str(tmp_path / "det.rec"), "w")
+    for i in range(n):
+        im = onp.zeros((size, size, 3), onp.uint8)
+        boxes = []
+        for _ in range(rng.randint(1, max_objs + 1)):
+            w, h = rng.randint(6, 12, 2)
+            x, y = rng.randint(0, size - w), rng.randint(0, size - h)
+            cls = int(rng.randint(0, 2))
+            im[y: y + h, x: x + w] = (255, 128, 0) if cls else (0, 255, 64)
+            boxes.append([cls, x / size, y / size,
+                          (x + w) / size, (y + h) / size])
+        label = det_label(boxes)
+        payload = recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), im, img_fmt=".png")
+        rec.write_idx(i, payload)
+    rec.close()
+    return str(tmp_path / "det.rec")
+
+
+class TestImageDetIter:
+    def test_rec_batches_fixed_shape(self, tmp_path):
+        path = _write_det_fixture(tmp_path, n=8)
+        it = img.ImageDetIter(batch_size=3, data_shape=(3, 24, 24),
+                              path_imgrec=path)
+        max_objs, width = it.label_shape
+        assert width == 5 and 1 <= max_objs <= 2
+        batches = list(it)
+        assert len(batches) == 3  # 8 samples / bs3 -> 2 full + 1 padded
+        for b in batches:
+            assert b.data[0].shape == (3, 3, 24, 24)
+            assert b.label[0].shape == (3, max_objs, 5)
+        assert batches[-1].pad == 1
+        # padding rows are -1
+        lab = onp.asarray(batches[0].label[0].asnumpy())
+        assert ((lab[:, :, 0] >= 0) | (lab == -1).all(axis=2)).all()
+
+    def test_provide_data_label_and_reshape(self, tmp_path):
+        path = _write_det_fixture(tmp_path)
+        it = img.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                              path_imgrec=path)
+        assert it.provide_data[0][1] == (2, 3, 24, 24)
+        it.reshape(label_shape=(5, 5))
+        assert it.provide_label[0][1] == (2, 5, 5)
+        with pytest.raises(MXNetError):
+            it.reshape(label_shape=(0, 5))
+
+    def test_sync_label_shape(self, tmp_path):
+        p1 = _write_det_fixture(tmp_path, n=4, max_objs=1)
+        os.rename(tmp_path / "det.rec", tmp_path / "a.rec")
+        os.rename(tmp_path / "det.idx", tmp_path / "a.idx")
+        p2 = _write_det_fixture(tmp_path, n=4, max_objs=2)
+        a = img.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                             path_imgrec=str(tmp_path / "a.rec"))
+        b = img.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                             path_imgrec=p2)
+        a.sync_label_shape(b)
+        assert a.label_shape == b.label_shape
+
+    def test_augmented_iteration_stays_valid(self, tmp_path):
+        onp.random.seed(1)
+        path = _write_det_fixture(tmp_path, n=6, size=32)
+        it = img.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                              path_imgrec=path, rand_crop=0.5,
+                              rand_pad=0.5, rand_mirror=True)
+        for batch in it:
+            lab = batch.label[0].asnumpy()
+            live = lab[lab[:, :, 0] >= 0]
+            assert (live[:, 1:5] >= 0).all() and (live[:, 1:5] <= 1).all()
+
+    def test_multibox_target_consumes_batches(self, tmp_path):
+        """The emitted label layout feeds npx.multibox_target directly —
+        the SSD training contract."""
+        from mxnet_tpu import np, npx
+
+        path = _write_det_fixture(tmp_path)
+        it = img.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                              path_imgrec=path)
+        it.reshape(label_shape=(2, 5))
+        batch = next(it)
+        anchors = np.array(
+            onp.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]],
+                      onp.float32))
+        cls_preds = np.zeros((2, 3, 2))  # (B, classes+1, num_anchors)
+        out = npx.multibox_target(anchors, batch.label[0], cls_preds)
+        assert out[0].shape[0] == 2
+
+    def test_reshape_rejects_elementwise_smaller(self, tmp_path):
+        """(3, 4) is lexicographically > (2, 5) but narrower — must be
+        rejected elementwise (review finding)."""
+        path = _write_det_fixture(tmp_path)
+        it = img.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                              path_imgrec=path)
+        with pytest.raises(MXNetError):
+            it.reshape(label_shape=(it.label_shape[0] + 1,
+                                    it.label_shape[1] - 1))
+
+    def test_wider_label_shape_pads_columns(self, tmp_path):
+        """After sync to a wider obj_width, narrower sources fill the
+        extra columns with -1 instead of crashing (review finding)."""
+        path = _write_det_fixture(tmp_path)
+        it = img.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                              path_imgrec=path)
+        it.reshape(label_shape=(it.label_shape[0], 7))
+        batch = next(it)
+        lab = batch.label[0].asnumpy()
+        assert lab.shape[2] == 7
+        assert (lab[:, :, 5:] == -1).all()
+
+    def test_multi_crop_length_mismatch_raises(self):
+        with pytest.raises(MXNetError):
+            img.CreateMultiRandCropAugmenter(
+                min_object_covered=[0.1, 0.3],
+                area_range=[(0.05, 0.3), (0.3, 0.6), (0.6, 1.0)])
