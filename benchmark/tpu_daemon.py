@@ -61,6 +61,7 @@ BS256 = os.path.join(HERE, "results_bench_tpu_bs256.json")
 INFER = os.path.join(HERE, "results_infer_tpu.json")
 PROFILE = os.path.join(HERE, "results_profile_tpu.json")
 TRAIN256 = os.path.join(HERE, "results_train_tpu_bs256.json")
+TRAIN_IO = os.path.join(HERE, "results_train_io_tpu.json")
 
 PROBE_INTERVAL_S = 180       # while the tunnel is down
 REFRESH_INTERVAL_S = 3600    # after a full successful suite
@@ -451,6 +452,23 @@ def capture_train_bs256() -> None:
             f"mfu={rows[0].get('mfu')}")
 
 
+def capture_train_io() -> None:
+    """ResNet-50 bf16 train fed from REAL RecordIO JPEG bytes through the
+    C++ decode pipeline + device prefetch, vs the same step on synthetic
+    data — the input-pipeline-overhead row (VERDICT r4 item #4)."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "train_bench.py"),
+         "--models", "resnet50_v1", "--precisions", "bf16",
+         "--batch", "32", "--recordio-input", "--timeout", "600",
+         "--retries", "1"],
+        timeout=1500)
+    rec = parse_json_output(out)
+    if bank_if_tpu(TRAIN_IO, rec, rc, "train-from-recordio") and rec:
+        rows = rec.get("results") or [{}]
+        log(f"train io: {rows[0].get('recordio_img_s')} img/s from rec, "
+            f"overhead {rows[0].get('input_overhead_pct')}%")
+
+
 def capture_quant() -> None:
     """INT8 PTQ ResNet-50: quantized throughput + top-1 agreement
     (benchmark/quant_bench.py) — int8 MXU has 2x the bf16 peak."""
@@ -541,6 +559,7 @@ def main() -> None:
                 for path, cap in ((PARITY, capture_parity),
                                   (TRAIN, capture_train),
                                   (TRAIN256, capture_train_bs256),
+                                  (TRAIN_IO, capture_train_io),
                                   (LLM, capture_llm),
                                   (PROFILE, capture_profile),
                                   (BS256, capture_bs256),

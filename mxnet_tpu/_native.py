@@ -77,6 +77,29 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.MXTPrefetcherNext.argtypes = [p, ctypes.POINTER(ctypes.c_char_p),
                                       ctypes.POINTER(u64)]
     lib.MXTPrefetcherFree.argtypes = [p]
+    # image pipeline symbols exist only in libjpeg-enabled builds (the
+    # Makefile drops image_pipeline.cc when jpeglib.h is absent) — the
+    # rest of the native surface must keep working without them
+    if hasattr(lib, "MXTImagePipelineCreate"):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.MXTDecodeJpegBatch.restype = ctypes.c_int
+        lib.MXTDecodeJpegBatch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(u64),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXTImagePipelineCreate.restype = p
+        lib.MXTImagePipelineCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
+        lib.MXTImagePipelineNext.restype = ctypes.c_int
+        lib.MXTImagePipelineNext.argtypes = [
+            p, u8p, ctypes.POINTER(ctypes.c_float)]
+        lib.MXTImagePipelineReset.argtypes = [p]
+        lib.MXTImagePipelineError.restype = ctypes.c_char_p
+        lib.MXTImagePipelineError.argtypes = [p]
+        lib.MXTImagePipelineBadCount.restype = ctypes.c_long
+        lib.MXTImagePipelineBadCount.argtypes = [p]
+        lib.MXTImagePipelineFree.argtypes = [p]
 
 
 def lib() -> Optional[ctypes.CDLL]:

@@ -484,3 +484,12 @@ class LibSVMIter(DataIter):
              onp.asarray(indptr, onp.int64)),
             shape=(len(idx), ncols))
         return DataBatch(data, mxnp.array(self._labels[idx]), pad=pad)
+
+
+# Native C++ decode pipeline + device double-buffer (reference
+# iter_image_recordio_2.cc role) — imported last to avoid cycles.
+from .native_pipeline import (DevicePrefetch, NativeImagePipeline,  # noqa: E402,F401
+                              decode_jpeg_batch, native_available)
+
+__all__ += ["NativeImagePipeline", "DevicePrefetch", "decode_jpeg_batch",
+            "native_available"]

@@ -300,3 +300,178 @@ def test_cpp_unit_suite(tmp_path):
                          text=True, timeout=300)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "[ PASS ] all io_test cases" in out.stdout
+
+
+class TestNativeImagePipeline:
+    """C++ threaded JPEG decode pipeline (src/io/image_pipeline.cc — the
+    reference iter_image_recordio_2.cc role)."""
+
+    @pytest.fixture()
+    def jpeg_rec(self, tmp_path):
+        from mxnet_tpu import recordio
+
+        rng = onp.random.RandomState(0)
+        path = str(tmp_path / "imgs.rec")
+        rec = recordio.MXRecordIO(path, "w")
+        for i in range(11):
+            im = rng.randint(0, 255, (64, 96, 3)).astype(onp.uint8)
+            rec.write(recordio.pack_img(
+                recordio.IRHeader(0, float(i), i, 0), im, quality=90))
+        rec.close()
+        return path
+
+    def test_iteration_shapes_and_labels(self, jpeg_rec):
+        from mxnet_tpu.io import NativeImagePipeline, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        pipe = NativeImagePipeline(jpeg_rec, (3, 32, 32), batch_size=4,
+                                   n_threads=2)
+        seen, labels = 0, []
+        for data, label in pipe:
+            assert data.dtype == onp.uint8
+            assert data.shape[1:] == (32, 32, 3)
+            labels.extend(label[:, 0].tolist())
+            seen += data.shape[0]
+        assert seen == 11
+        assert labels == [float(i) for i in range(11)]
+        pipe.close()
+
+    def test_reset_restarts_epoch(self, jpeg_rec):
+        from mxnet_tpu.io import NativeImagePipeline, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        pipe = NativeImagePipeline(jpeg_rec, (3, 16, 16), batch_size=8)
+        n1 = sum(d.shape[0] for d, _ in pipe)
+        pipe.reset()
+        n2 = sum(d.shape[0] for d, _ in pipe)
+        assert n1 == n2 == 11
+        pipe.close()
+
+    def test_decode_jpeg_batch_matches_pil(self, jpeg_rec):
+        from mxnet_tpu import recordio
+        from mxnet_tpu.image import _to_np, imdecode
+        from mxnet_tpu.io import decode_jpeg_batch, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        r = recordio.MXRecordIO(jpeg_rec, "r")
+        _, payload = recordio.unpack(r.read())
+        r.close()
+        # same-size decode (no resize path): must match PIL's libjpeg
+        # output exactly at the same scale
+        native = decode_jpeg_batch([payload], 64, 96)
+        pil = _to_np(imdecode(payload))
+        diff = onp.abs(native[0].astype(int) - pil.astype(int))
+        assert diff.mean() < 1.0, diff.mean()  # same libjpeg underneath
+
+    def test_corrupt_jpeg_raises(self):
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.io import decode_jpeg_batch, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        with pytest.raises(MXNetError):
+            decode_jpeg_batch([b"not a jpeg at all"], 16, 16)
+
+    def test_bad_path_raises(self):
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.io import NativeImagePipeline, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        with pytest.raises(MXNetError):
+            NativeImagePipeline("/nonexistent/x.rec", (3, 8, 8), 2)
+
+    def test_device_prefetch_overlaps_and_relays(self, jpeg_rec):
+        from mxnet_tpu.io import (DevicePrefetch, NativeImagePipeline,
+                                  native_available)
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        pipe = NativeImagePipeline(jpeg_rec, (3, 16, 16), batch_size=4)
+        total = 0
+        for data, label in DevicePrefetch(pipe):
+            assert hasattr(data, "devices")  # on-device already
+            total += int(data.shape[0])
+        assert total == 11
+        pipe.close()
+
+        # exceptions from the feeder surface in the consumer
+        def boom_iter():
+            yield onp.zeros((1,)), onp.zeros((1,))
+            raise RuntimeError("feeder failure")
+
+        dp = DevicePrefetch(boom_iter())
+        next(dp)
+        with pytest.raises(RuntimeError, match="feeder failure"):
+            next(dp)
+
+    def test_multipart_record_reassembly(self, tmp_path):
+        """A record whose bytes contain the 4-aligned kMagic word is
+        split by the writer (cflag 1/2/3); the pipeline's reader must
+        reassemble it — a naive reader turns it into corrupt samples
+        (review finding). The magic is smuggled in via a label float
+        whose LE bytes equal the magic word."""
+        from mxnet_tpu.io import NativeImagePipeline, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        magic_float = struct.unpack("<f", struct.pack("<I", 0xced7230a))[0]
+        # packed label: [magic_float, 7.0] -> flag=2, floats at offset 24
+        # (4-aligned) => the writer MUST split this record
+        path = str(tmp_path / "mp.rec")
+        rec = recordio.MXRecordIO(path, "w")
+        good_img = onp.full((8, 8, 3), 200, onp.uint8)
+        payload = recordio.pack_img(
+            recordio.IRHeader(0, onp.asarray([magic_float, 7.0],
+                                             onp.float32), 0, 0),
+            good_img, quality=95)
+        # sanity: the writer really did split (raw file contains two
+        # header magics beyond the first)
+        rec.write(payload)
+        rec.close()
+        raw = open(path, "rb").read()
+        assert raw.count(struct.pack("<I", 0xced7230a)) >= 2, \
+            "fixture did not trigger a multi-part record"
+
+        pipe = NativeImagePipeline(path, (3, 8, 8), batch_size=1,
+                                   label_width=2)
+        data, label = next(pipe)
+        assert label[0, 1] == 7.0  # second label float survived
+        assert struct.pack("<f", label[0, 0]) == struct.pack(
+            "<I", 0xced7230a)  # the magic-valued float round-tripped
+        assert pipe.bad_decodes == 0  # the JPEG reassembled cleanly
+        assert abs(int(data.mean()) - 200) <= 2
+        pipe.close()
+
+    def test_corrupt_record_in_pipeline_warns_not_silent(self, tmp_path):
+        from mxnet_tpu.io import NativeImagePipeline, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        path = str(tmp_path / "bad.rec")
+        rec = recordio.MXRecordIO(path, "w")
+        rec.write(recordio.pack(recordio.IRHeader(0, 1.0, 0, 0),
+                                b"definitely not a jpeg"))
+        rec.close()
+        pipe = NativeImagePipeline(path, (3, 8, 8), batch_size=1)
+        with pytest.warns(UserWarning, match="corrupt JPEG"):
+            data, label = next(pipe)
+        assert pipe.bad_decodes == 1
+        assert (data == 0).all()  # zero-filled, and loudly so
+        pipe.close()
+
+    def test_device_prefetch_close_midstream_joins_feeder(self, jpeg_rec):
+        from mxnet_tpu.io import (DevicePrefetch, NativeImagePipeline,
+                                  native_available)
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        pipe = NativeImagePipeline(jpeg_rec, (3, 16, 16), batch_size=2)
+        dp = DevicePrefetch(pipe, depth=1)
+        next(dp)  # feeder is now blocked on a full queue mid-epoch
+        dp.close()
+        assert not dp._thread.is_alive()  # joined: freeing pipe is safe
+        pipe.close()
