@@ -85,6 +85,7 @@ def main():
     DB, DT = args.decode_batch, args.decode_tokens
     prompt = mx.np.array(rng.randint(0, args.vocab, (DB, 8)).astype("int32"))
     decode_tok_s = None
+    decode_int8_tok_s = None
     try:
         from mxnet_tpu.gluon.model_zoo.generation import generate
 
@@ -98,6 +99,17 @@ def main():
         d_dt = time.perf_counter() - t0
         decode_tok_s = DB * DT / d_dt
         log(f"decode: {decode_tok_s:.1f} tok/s (bs {DB})")
+        # int8 KV cache: half the cache bytes of bf16 on the
+        # bandwidth-bound read path (kv_cache_quantize)
+        out = generate(net, prompt, max_new_tokens=DT, max_length=256,
+                       kv_cache_dtype="int8")
+        out.asnumpy()  # warm/compile
+        t0 = time.perf_counter()
+        out = generate(net, prompt, max_new_tokens=DT, max_length=256,
+                       kv_cache_dtype="int8")
+        out.asnumpy()
+        decode_int8_tok_s = DB * DT / (time.perf_counter() - t0)
+        log(f"decode int8-kv: {decode_int8_tok_s:.1f} tok/s")
     except Exception as e:  # noqa: BLE001 — decode is a secondary number
         log(f"decode bench failed: {e!r}")
 
@@ -210,6 +222,10 @@ def main():
     if decode_tok_s:
         rec["decode_tok_s"] = round(decode_tok_s, 1)
         rec["decode_batch"] = DB
+        if decode_int8_tok_s:
+            rec["decode_int8kv_tok_s"] = round(decode_int8_tok_s, 1)
+            rec["decode_int8kv_speedup"] = round(
+                decode_int8_tok_s / decode_tok_s, 3)
         # decode is HBM-BANDWIDTH bound, not FLOPs bound: every generated
         # token reads all weights (+ the KV cache) once. The honest
         # utilization metric is achieved bytes/s vs peak HBM, with the

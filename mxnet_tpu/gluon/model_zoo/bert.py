@@ -149,12 +149,21 @@ class _CausalLM(HybridBlock):
         return seq @ w.T, ck, cv
 
     def init_cache(self, batch_size, max_length, dtype="float32"):
-        """Zeroed (L, B, H, Lmax, D) key/value ring buffers."""
+        """Zeroed (L, B, H, Lmax, D) key/value ring buffers.
+
+        ``dtype="int8"``: quantized cache — values int8 plus a
+        per-(batch, head, position) f32 scale bitcast into 4 extra
+        feature bytes (halved HBM traffic vs bf16 on the bandwidth-bound
+        decode path; see nn.transformer.kv_cache_quantize)."""
         from ... import numpy as mxnp
 
         enc = self.encoder
         heads = enc.layer0.attn._heads
         d = enc.layer0.attn._units // heads
+        if dtype == "int8":
+            from ..nn.transformer import _KV_SCALE_BYTES
+
+            d += _KV_SCALE_BYTES
         shape = (enc._num_layers, batch_size, heads, max_length, d)
         return mxnp.zeros(shape, dtype=dtype), mxnp.zeros(shape, dtype=dtype)
 

@@ -87,7 +87,8 @@ def _sample(logits, key, greedy, temperature, top_k):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-def _prep(model, prompt_ids, max_new_tokens, max_length):
+def _prep(model, prompt_ids, max_new_tokens, max_length,
+          kv_cache_dtype=None):
     """Shared decode setup: wrap the prompt, validate lengths against the
     model's context window (jax dynamic_slice CLAMPS out-of-range starts,
     so decoding past the position table would silently reuse the last
@@ -109,8 +110,16 @@ def _prep(model, prompt_ids, max_new_tokens, max_length):
         raise MXNetError(
             f"generation length {lmax} exceeds the model's context window "
             f"(max_length={pos_table.shape[0]})")
-    cache_dtype = onp.dtype(model.word_embed.weight.dtype).name \
-        if hasattr(model, "word_embed") else "float32"
+    if kv_cache_dtype not in (None, "int8", "float32", "bfloat16",
+                              "float16"):
+        # an unknown integer dtype would silently truncate K/V to garbage
+        # through the non-quantized astype path — must be loud
+        raise MXNetError(
+            f"kv_cache_dtype {kv_cache_dtype!r} not supported "
+            "(int8/float32/bfloat16/float16)")
+    cache_dtype = kv_cache_dtype or (
+        onp.dtype(model.word_embed.weight.dtype).name
+        if hasattr(model, "word_embed") else "float32")
     ck, cv = model.init_cache(b, lmax, dtype=cache_dtype)
     adapter = _StepAdapter(model)
     pos0 = mxnp.array(onp.zeros((), onp.int32))
@@ -121,7 +130,7 @@ def _prep(model, prompt_ids, max_new_tokens, max_length):
 def generate(model, prompt_ids, max_new_tokens: int,
              max_length: Optional[int] = None, greedy: bool = True,
              temperature: float = 1.0, top_k: int = 0, eos_token: int = -1,
-             seed: int = 0):
+             seed: int = 0, kv_cache_dtype: Optional[str] = None):
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` (B, P).
 
     ``model`` must provide ``decode_step``/``init_cache`` (the causal LM
@@ -129,9 +138,12 @@ def generate(model, prompt_ids, max_new_tokens: int,
     an (B, max_new_tokens) int32 ndarray. ``eos_token``: once a sequence
     has emitted it, remaining positions repeat it (the scan still runs to
     length — static shapes — but the output is clean).
+    ``kv_cache_dtype="int8"`` stores the KV cache quantized (per-token
+    per-head scales): half the HBM bytes of bf16 on the bandwidth-bound
+    decode read path, at ~0.4% rms dequant error.
     """
     prompt, b, p, lmax, ck, cv, step_fn, params = _prep(
-        model, prompt_ids, max_new_tokens, max_length)
+        model, prompt_ids, max_new_tokens, max_length, kv_cache_dtype)
 
     # Memoize the compiled program per model: a fresh closure every
     # call would miss jax.jit's trace cache and recompile each generate()
@@ -141,7 +153,7 @@ def generate(model, prompt_ids, max_new_tokens: int,
     # the same program) and drop sampling knobs that are dead under greedy.
     tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
     ckey = ("generate", b, p, max_new_tokens, lmax, greedy, *tkey,
-            int(eos_token))
+            int(eos_token), kv_cache_dtype)
     store, cached = _decode_cache(model, ckey)
     if cached is not None:
         out = cached(params, _unwrap(prompt), _unwrap(ck), _unwrap(cv),
@@ -181,7 +193,8 @@ def generate(model, prompt_ids, max_new_tokens: int,
 
 def beam_search(model, prompt_ids, max_new_tokens: int, beam_size: int = 4,
                 max_length: Optional[int] = None, alpha: float = 1.0,
-                eos_token: int = -1):
+                eos_token: int = -1,
+                kv_cache_dtype: Optional[str] = None):
     """Beam-search decoding (the gluonnlp-era capability, re-built
     TPU-first): ONE ``lax.scan`` whose carry holds the (L, B*K, H, Lmax, D)
     KV caches; beam reordering is a batched gather on the cache's beam
@@ -196,14 +209,14 @@ def beam_search(model, prompt_ids, max_new_tokens: int, beam_size: int = 4,
     # happens on device from the prefill result (no B*K zero buffers ever
     # cross host->device)
     prompt, b, p, lmax, ck, cv, step_fn, params = _prep(
-        model, prompt_ids, max_new_tokens, max_length)
+        model, prompt_ids, max_new_tokens, max_length, kv_cache_dtype)
 
     neg_inf = -1e9
 
     # same memoization as generate(): one compiled program per static
     # decode config, current weights flow through ``params``
     ckey = ("beam", b, p, max_new_tokens, lmax, k, float(alpha),
-            int(eos_token))
+            int(eos_token), kv_cache_dtype)
     store, cached = _decode_cache(model, ckey)
     if cached is not None:
         seqs, scores = cached(params, _unwrap(prompt), _unwrap(ck),
