@@ -355,3 +355,39 @@ def test_profile_bench_resnet_codepath_tiny():
               "optimizer_ms_derived", "img_s_full"):
         assert k in r, k
     assert r["fwd_bwd_ms"] >= r["fwd_ms"] * 0.8  # bwd can't be ~free
+
+
+def test_scaling_bench_weak_scaling_schema():
+    """scaling_bench's measurement core on a 2-point curve: schema +
+    sane efficiency bounds (the committed artifact's generator)."""
+    import sys
+
+    sys.path.insert(0, ROOT)
+    from benchmark.scaling_bench import _dp_step_time, model_mlp_block
+
+    t1 = _dp_step_time(model_mlp_block, 64, 1, 2, lambda *a: None)
+    t2 = _dp_step_time(model_mlp_block, 64, 2, 2, lambda *a: None)
+    assert t1 > 0 and t2 > 0
+    eff = 2 * t1 / t2
+    # shared-core weak scaling: efficiency is ~1 for a clean program;
+    # generous bounds reject only a broken harness (e.g. dp=2 not
+    # actually running 2x the work, or 10x sharding overhead)
+    assert 0.2 < eff < 3.0, eff
+
+
+def test_scaling_bench_pod_model():
+    import sys
+
+    sys.path.insert(0, ROOT)
+    from benchmark.scaling_bench import pod_model
+
+    m = pod_model(grad_mbytes=51.2, step_compute_ms=20.0)
+    chips = m["per_chips"]
+    assert set(chips) == {"8", "16", "32", "64", "128", "256"}
+    for n, row in chips.items():
+        assert 0 < row["efficiency_no_overlap"] <= 1
+        assert row["efficiency_no_overlap"] <= row["efficiency_overlapped"]
+    # efficiency degrades monotonically with chip count (ring allreduce
+    # bytes approach 2x grad bytes)
+    assert chips["256"]["efficiency_no_overlap"] <= \
+        chips["8"]["efficiency_no_overlap"]
