@@ -7,19 +7,41 @@ transform is differentiable + trace-transparent like any other op.
 """
 from __future__ import annotations
 
+import os as _os
+
 import jax.numpy as jnp
 
+from ..base import MXNetError
 from . import _call
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "rfft2",
            "irfft2", "fftn", "ifftn", "hfft", "ihfft", "fftfreq",
            "rfftfreq", "fftshift", "ifftshift"]
 
+# ops whose XLA lowering needs a complex-typed FFT HLO — UNIMPLEMENTED on
+# the axon TPU tunnel, and worse: the failure is STICKY (it poisons the
+# whole remote session, wedging every later op). A clear error beats a
+# dead backend; real (non-tunnel) TPU runtimes lower these fine.
+_COMPLEX_FFT = {"fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "hfft",
+                "ihfft"}
+
+
+def _guard_axon(name):
+    if name in _COMPLEX_FFT and "axon" in _os.environ.get(
+            "JAX_PLATFORMS", "").lower():
+        raise MXNetError(
+            f"mx.np.fft.{name} needs a complex FFT, which the axon TPU "
+            "tunnel cannot execute (UNIMPLEMENTED, and the failure "
+            "poisons the session). Run this op on CPU "
+            "(jax.config.update('jax_platforms', 'cpu')) or use the "
+            "real-valued rfft family.")
+
 
 def _make1(name):
     jfn = getattr(jnp.fft, name)
 
     def op(a, n=None, axis=-1, norm=None):
+        _guard_axon(name)
         return _call(lambda x: jfn(x, n=n, axis=axis, norm=norm), (a,),
                      name=f"fft.{name}")
 
@@ -31,6 +53,7 @@ def _make2(name):
     jfn = getattr(jnp.fft, name)
 
     def op(a, s=None, axes=(-2, -1), norm=None):
+        _guard_axon(name)
         return _call(lambda x: jfn(x, s=s, axes=axes, norm=norm), (a,),
                      name=f"fft.{name}")
 
@@ -42,6 +65,7 @@ def _maken(name):
     jfn = getattr(jnp.fft, name)
 
     def op(a, s=None, axes=None, norm=None):
+        _guard_axon(name)
         return _call(lambda x: jfn(x, s=s, axes=axes, norm=norm), (a,),
                      name=f"fft.{name}")
 

@@ -265,3 +265,20 @@ def test_hybrid_compose_traces():
                                 atol=1e-6)
     onp.testing.assert_allclose(got_jit.asnumpy(), onp.asarray(want),
                                 atol=1e-6)
+
+
+def test_complex_fft_guarded_on_axon_tunnel(monkeypatch):
+    """Complex FFTs are UNIMPLEMENTED over the axon tunnel and the
+    failure is sticky (poisons the remote session) — the op must raise a
+    clear error instead (round-3 handoff hazard). rfft family unaffected."""
+    from mxnet_tpu.base import MXNetError
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    with pytest.raises(MXNetError, match="axon"):
+        mx.np.fft.fft(mx.np.ones((8,)))
+    with pytest.raises(MXNetError, match="axon"):
+        mx.np.fft.ifftn(mx.np.ones((4, 4)))
+    out = mx.np.fft.rfft(mx.np.ones((8,)))  # real family still works
+    assert out.shape == (5,)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert mx.np.fft.fft(mx.np.ones((8,))).shape == (8,)
