@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Attach flops_per_step / achieved_tflops / mfu to banked train rows.
+
+FLOPs per step are a property of the traced program (jaxpr 2*MAC walk,
+bench.py convention), not of the measurement — so they can be derived
+OFFLINE on CPU for rows that were measured on the chip before
+train_bench started recording them. Idempotent; measured numbers are
+never touched.
+
+Usage: python tools/attach_flops.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from bench import jaxpr_flops, peak_bf16_tflops
+    from benchmark.train_bench import build_step
+
+    path = os.path.join(ROOT, "benchmark", "results_train_tpu.json")
+    with open(path) as f:
+        data = json.load(f)
+    changed = False
+    for row in data.get("results", []):
+        if "error" in row or not row.get("train_img_s") \
+                or row.get("flops_per_step"):
+            continue
+        model, prec, batch = row["model"], row["precision"], row["batch"]
+        print(f"tracing {model}/{prec}/bs{batch} ...", flush=True)
+        try:
+            jstep, p, vel, x, y = build_step(model, batch, prec)
+            key = jax.random.PRNGKey(0)
+            flops = jaxpr_flops(jstep, p, vel, x, y, key)
+        except Exception as e:  # noqa: BLE001 — skip untraceable rows
+            print(f"  skipped: {e!r}")
+            continue
+        img_s = row["train_img_s"] if not model.startswith("bert") \
+            else row.get("train_seq_s", 0)
+        achieved = img_s / batch * flops / 1e12
+        row["flops_per_step"] = flops
+        row["flops_source"] = "jaxpr_walk_2mac (derived offline)"
+        row["achieved_tflops"] = round(achieved, 2)
+        peak = peak_bf16_tflops(row.get("device_kind")
+                                or data.get("device_kind", ""))
+        if peak and prec == "bf16":
+            row["peak_bf16_tflops"] = peak
+            row["mfu"] = round(achieved / peak, 4)
+        changed = True
+        print(f"  {flops/1e12:.2f} TF/step, {achieved:.1f} TFLOP/s"
+              + (f", mfu {row.get('mfu')}" if "mfu" in row else ""))
+    if changed:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+        print(f"updated {path}")
+    else:
+        print("no change")
+
+
+if __name__ == "__main__":
+    main()
