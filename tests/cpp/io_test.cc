@@ -35,6 +35,14 @@ void MXTRecordIOWriterFree(void* handle);
 void* MXTPrefetcherCreate(const char* path, uint64_t capacity);
 int MXTPrefetcherNext(void* handle, const char** data, uint64_t* size);
 void MXTPrefetcherFree(void* handle);
+#ifdef MXT_HAS_JPEG
+void* MXTImagePipelineCreate(const char* path, int th, int tw, int batch,
+                             int n_threads, int label_width);
+int MXTImagePipelineNext(void* handle, uint8_t* data, float* labels);
+void MXTImagePipelineReset(void* handle);
+long MXTImagePipelineBadCount(void* handle);
+void MXTImagePipelineFree(void* handle);
+#endif
 }
 
 static int failures = 0;
@@ -186,6 +194,127 @@ static void test_prefetcher_order_and_teardown() {
   }
 }
 
+
+
+#ifdef MXT_HAS_JPEG
+#include <cstdio>  /* FILE for jpeglib */
+#include <jpeglib.h>
+
+/* encode a solid-color RGB image to JPEG bytes in memory */
+static std::string encode_jpeg(int h, int w, uint8_t r, uint8_t g,
+                               uint8_t b) {
+  jpeg_compress_struct cinfo;
+  jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr);
+  jpeg_create_compress(&cinfo);
+  unsigned char* buf = nullptr;
+  unsigned long len = 0;
+  jpeg_mem_dest(&cinfo, &buf, &len);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, 92, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  std::vector<uint8_t> row(static_cast<size_t>(w) * 3);
+  for (int x = 0; x < w; ++x) {
+    row[x * 3] = r; row[x * 3 + 1] = g; row[x * 3 + 2] = b;
+  }
+  JSAMPROW rp = row.data();
+  while (cinfo.next_scanline < cinfo.image_height)
+    jpeg_write_scanlines(&cinfo, &rp, 1);
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  std::string out(reinterpret_cast<char*>(buf), len);
+  free(buf);
+  return out;
+}
+
+/* IRHeader (<IfQQ) + payload, scalar-label form */
+static std::string make_image_record(float label, const std::string& jpeg) {
+  std::string rec(24, '\0');
+  uint32_t flag = 0;
+  std::memcpy(&rec[0], &flag, 4);
+  std::memcpy(&rec[4], &label, 4);
+  rec += jpeg;
+  return rec;
+}
+
+static void test_image_pipeline_decode_and_labels() {
+  CASE("image_pipeline.decode_and_labels");
+  std::vector<std::string> recs;
+  const uint8_t colors[3][3] = {{250, 10, 10}, {10, 250, 10}, {10, 10, 250}};
+  for (int i = 0; i < 3; ++i)
+    recs.push_back(make_image_record(
+        static_cast<float>(i) + 0.5f,
+        encode_jpeg(40, 40, colors[i][0], colors[i][1], colors[i][2])));
+  const std::string p = path_of("imgs.rec");
+  write_records(p, recs);
+
+  void* h = MXTImagePipelineCreate(p.c_str(), 16, 16, 2, 2, 1);
+  CHECK_TRUE(h != nullptr);
+  std::vector<uint8_t> data(2 * 16 * 16 * 3);
+  std::vector<float> labels(2);
+  int n = MXTImagePipelineNext(h, data.data(), labels.data());
+  CHECK_TRUE(n == 2);
+  CHECK_TRUE(labels[0] == 0.5f && labels[1] == 1.5f);
+  /* solid-color decode + resize stays near the color (JPEG loss ~few) */
+  CHECK_TRUE(data[0] > 200 && data[1] < 60 && data[2] < 60);
+  const uint8_t* img1 = data.data() + 16 * 16 * 3;
+  CHECK_TRUE(img1[0] < 60 && img1[1] > 200 && img1[2] < 60);
+  n = MXTImagePipelineNext(h, data.data(), labels.data());
+  CHECK_TRUE(n == 1 && labels[0] == 2.5f);
+  n = MXTImagePipelineNext(h, data.data(), labels.data());
+  CHECK_TRUE(n == 0); /* epoch end */
+  CHECK_TRUE(MXTImagePipelineBadCount(h) == 0);
+
+  /* reset -> same first batch again */
+  MXTImagePipelineReset(h);
+  n = MXTImagePipelineNext(h, data.data(), labels.data());
+  CHECK_TRUE(n == 2 && labels[0] == 0.5f);
+  MXTImagePipelineFree(h);
+}
+
+static void test_image_pipeline_corrupt_jpeg_counted() {
+  CASE("image_pipeline.corrupt_jpeg_counted");
+  std::vector<std::string> recs;
+  recs.push_back(make_image_record(1.0f, encode_jpeg(24, 24, 99, 99, 99)));
+  recs.push_back(make_image_record(2.0f, "definitely not a jpeg"));
+  const std::string p = path_of("bad_imgs.rec");
+  write_records(p, recs);
+  void* h = MXTImagePipelineCreate(p.c_str(), 8, 8, 2, 1, 1);
+  CHECK_TRUE(h != nullptr);
+  std::vector<uint8_t> data(2 * 8 * 8 * 3);
+  std::vector<float> labels(2);
+  int n = MXTImagePipelineNext(h, data.data(), labels.data());
+  CHECK_TRUE(n == 2);
+  CHECK_TRUE(MXTImagePipelineBadCount(h) == 1); /* loud, not silent */
+  /* bad slot zero-filled, its (real) label preserved */
+  const uint8_t* img1 = data.data() + 8 * 8 * 3;
+  bool all_zero = true;
+  for (int i = 0; i < 8 * 8 * 3; ++i) all_zero &= (img1[i] == 0);
+  CHECK_TRUE(all_zero && labels[1] == 2.0f);
+  MXTImagePipelineFree(h);
+}
+
+static void test_image_pipeline_early_teardown() {
+  CASE("image_pipeline.early_teardown");
+  /* free with the read-ahead thread mid-flight: must join, not crash */
+  const std::string p = path_of("imgs.rec");
+  for (int round = 0; round < 6; ++round) {
+    void* h = MXTImagePipelineCreate(p.c_str(), 16, 16, 2, 2, 1);
+    CHECK_TRUE(h != nullptr);
+    if (round % 2 == 1) {
+      std::vector<uint8_t> data(2 * 16 * 16 * 3);
+      std::vector<float> labels(2);
+      MXTImagePipelineNext(h, data.data(), labels.data());
+    }
+    MXTImagePipelineFree(h);
+  }
+}
+#endif /* MXT_HAS_JPEG */
+
 int main(int argc, char** argv) {
   g_dir = argc > 1 ? argv[1] : ".";
   test_roundtrip();
@@ -194,6 +323,11 @@ int main(int argc, char** argv) {
   test_truncated_stream();
   test_seek_reread();
   test_prefetcher_order_and_teardown();
+#ifdef MXT_HAS_JPEG
+  test_image_pipeline_decode_and_labels();
+  test_image_pipeline_corrupt_jpeg_counted();
+  test_image_pipeline_early_teardown();
+#endif
   if (failures == 0) {
     std::printf("[ PASS ] all io_test cases\n");
     return 0;
