@@ -25,28 +25,33 @@ import numpy as onp
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def measure(fn, args_, tag, log, min_s=3.0):
+def measure(fn, x0, tag, log, min_s=3.0):
+    """``fn(x) -> (result, next_x)`` — SERIAL-CHAINED: each iteration's
+    input derives from the previous result, so no dispatch/caching layer
+    can elide or overlap identical calls, and the final scalar fetch is
+    an honest completion barrier for the whole chain (the bench.py
+    protocol; the earlier repeat-same-args loop was exactly the pattern
+    the axon tunnel mis-times)."""
     import jax
     import jax.numpy as jnp
 
     jfn = jax.jit(fn)
     t0 = time.time()
-    out = jfn(*args_)
-    jax.block_until_ready(out)
-    first = jax.tree_util.tree_leaves(out)[0]
-    float(jnp.sum(first.astype(jnp.float32)))
+    out, x = jfn(x0)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    float(jnp.sum(x.astype(jnp.float32)))
     log(f"{tag}: compiled in {time.time() - t0:.1f}s")
     t0 = time.perf_counter()
-    out = jfn(*args_)
-    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    out, x = jfn(x)
+    float(jnp.sum(x.astype(jnp.float32)))
     per = max(time.perf_counter() - t0, 1e-4)
     iters = max(3, min(200, int(min_s / per)))
     total, dt = 0, 0.0
     while dt < min_s and total < 2000:
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = jfn(*args_)
-        float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+            out, x = jfn(x)
+        float(jnp.sum(x.astype(jnp.float32)))  # chain barrier
         dt += time.perf_counter() - t0
         total += iters
     return total / dt  # steps/s
@@ -83,22 +88,37 @@ def main():
         qkv = jnp.asarray(
             rng.randn(B, L, H * D).astype(onp.float32), dt)
 
+        def chain(x, scalar):
+            pert = (jnp.tanh(scalar) * 1e-6).astype(x.dtype)
+            return x * (1 + pert)
+
         def fwd(x):
-            return opsnn.attend(x, x, x, H, causal=True)
+            out = opsnn.attend(x, x, x, H, causal=True)
+            return out, chain(x, jnp.sum(out.astype(jnp.float32)) * 1e-6)
 
         def train(x):
             def loss(x_):
-                return jnp.sum(fwd(x_).astype(jnp.float32) ** 2)
+                out = opsnn.attend(x_, x_, x_, H, causal=True)
+                return jnp.sum(out.astype(jnp.float32) ** 2)
 
-            return jax.grad(loss)(x)
+            g = jax.grad(loss)(x)
+            return g, chain(x, jnp.sum(g.astype(jnp.float32)) * 1e-6)
 
+        # analytic attention FLOPs (causal ~halves the K range):
+        # QK^T + PV, 2 MACs each: 2 * 2 * B*H*L^2*D / 2
+        fwd_flops = 2.0 * B * H * L * L * D
         try:
-            f_sps = measure(fwd, (qkv,), f"L={L} fwd", log)
-            t_sps = measure(train, (qkv,), f"L={L} fwd+bwd", log)
+            f_sps = measure(fwd, qkv, f"L={L} fwd", log)
+            t_sps = measure(train, qkv, f"L={L} fwd+bwd", log)
             rec = {"seq_len": L, "batch": B, "heads": H, "head_dim": D,
                    "dtype": args.dtype,
                    "fwd_tok_s": round(f_sps * B * L, 1),
-                   "train_tok_s": round(t_sps * B * L, 1)}
+                   "train_tok_s": round(t_sps * B * L, 1),
+                   "fwd_achieved_tflops": round(f_sps * fwd_flops / 1e12, 2),
+                   # fwd (2 matmul units) + bwd (s recomputed in BOTH
+                   # passes + dv/dp/dq/dk = 6 units) = 4.0x fwd_flops
+                   "train_achieved_tflops": round(
+                       t_sps * 4.0 * fwd_flops / 1e12, 2)}
             log(rec)
             results.append(rec)
         except Exception as e:  # noqa: BLE001 — one OOM length shouldn't kill the run

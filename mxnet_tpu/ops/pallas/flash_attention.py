@@ -182,7 +182,11 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     q, k, v, out = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
-    bk = min(block_k, lk)
+    # the XLA-scan backward gets no launch-overhead win from big K blocks
+    # (that argument is the Pallas forward grid's); it only pays their
+    # memory — s/p/dp/ds transients scale with bk. Cap at 128 regardless
+    # of the probed forward default.
+    bk = min(block_k, 128, lk)
     n_k = -(-lk // bk)
     pad = n_k * bk - lk
     kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else k
@@ -190,16 +194,22 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     # block-major: (n_k, b, h, bk, d)
     kb = kp.reshape(b, h, n_k, bk, d).transpose(2, 0, 1, 3, 4)
     vb = vp.reshape(b, h, n_k, bk, d).transpose(2, 0, 1, 3, 4)
-    qf = q.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
+    # operands stay in the INPUT dtype (bf16 on the training path) with
+    # fp32 ACCUMULATION via preferred_element_type — the forward kernel's
+    # own numerics. Upcasting operands to f32 (the old code) doubled the
+    # HBM bytes of every backward matmul and, under a "highest" ambient
+    # precision, turned each one into 6-pass fp32 MXU emulation.
+    gq = g.astype(q.dtype)
+    f32 = jnp.float32
     q_pos = jnp.arange(lq)
-    scale = jnp.float32(sm_scale)
+    scale = f32(sm_scale)
 
     # pass 1: recompute lse blockwise (same online max/sum as the forward)
     def lse_body(carry, blk):
         m, l = carry
         i, k_blk = blk
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=f32) * scale
         mask = _causal_block_mask(q_pos, i * bk + jnp.arange(bk), causal, lq, lk)
         s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -207,29 +217,35 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
         l = l * jnp.exp(m - m_new) + p.sum(axis=-1)
         return (m_new, l), None
 
-    m0 = jnp.full((b, h, lq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    m0 = jnp.full((b, h, lq), _NEG_INF, f32)
+    l0 = jnp.zeros((b, h, lq), f32)
     (m, l), _ = jax.lax.scan(lse_body, (m0, l0), (jnp.arange(n_k), kb))
     lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))  # (b,h,lq)
 
     # pass 2: accumulate dq; emit dk/dv per block
-    D = jnp.einsum("bhqd,bhqd->bhq", gf, out.astype(jnp.float32))  # rowsum(dO*O)
+    D = jnp.einsum("bhqd,bhqd->bhq", gq, out.astype(q.dtype),
+                   preferred_element_type=f32)  # rowsum(dO*O)
 
     def grad_body(dq, blk):
         i, k_blk, v_blk = blk
-        kf = k_blk.astype(jnp.float32)
-        vf = v_blk.astype(jnp.float32)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=f32) * scale
         mask = _causal_block_mask(q_pos, i * bk + jnp.arange(bk), causal, lq, lk)
-        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)  # (b,h,lq,bk)
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)  # f32 (b,h,lq,bk)
+        pq = p.astype(q.dtype)  # bf16 operand, like the fwd kernel's PV
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", pq, gq,
+                            preferred_element_type=f32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gq, v_blk,
+                        preferred_element_type=f32)
         ds = p * (dp - D[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dsq = ds.astype(q.dtype)  # flash-2: ds in compute dtype
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", dsq, k_blk,
+                             preferred_element_type=f32)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", dsq, q,
+                            preferred_element_type=f32)
         return dq, (dk_blk, dv_blk)
 
-    dq0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    dq0 = jnp.zeros((b, h, lq, d), f32)
     dq, (dkb, dvb) = jax.lax.scan(grad_body, dq0,
                                   (jnp.arange(n_k), kb, vb))
     dk = dkb.transpose(1, 2, 0, 3, 4).reshape(b, h, n_k * bk, d)[:, :, :lk]
@@ -240,19 +256,51 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# Default block sizes, probed once per process. 128x128 blocks make each
+# grid program a tiny (128,64)x(64,128) matmul — launch-bound at scale
+# (8192 programs for B8/H16/L1024). 256x512 blocks lift arithmetic
+# intensity ~8x per program and use ~1.5 MB of the ~16 MB VMEM; if
+# Mosaic rejects them on some backend the probe falls back to the
+# always-valid 128x128.
+_BLOCK_CANDIDATES = ((256, 512), (128, 128))
+_BLOCKS_STATE = {"val": None}
+
+
+def _default_blocks():
+    st = _BLOCKS_STATE
+    if st["val"] is None:
+        if jax.default_backend() != "tpu":
+            st["val"] = _BLOCK_CANDIDATES[0]  # interpreter: size-agnostic
+        else:
+            for bq, bk in _BLOCK_CANDIDATES:
+                try:
+                    probe = jnp.zeros((1, 1, 1024, 64), jnp.bfloat16)
+                    jax.jit(lambda x: _flash(
+                        x, x, x, True, 0.125, bq, bk, False))(
+                            probe).block_until_ready()
+                    st["val"] = (bq, bk)
+                    break
+                except Exception:  # noqa: BLE001 — Mosaic reject: next
+                    continue
+            else:
+                st["val"] = (128, 128)
+    return st["val"]
+
+
 def flash_attention(
     q, k, v,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Fused attention over (batch, heads, seq, head_dim) tensors.
 
     ``interpret=None`` auto-selects: the compiled Mosaic kernel on TPU, the
     Pallas interpreter elsewhere (so CPU tests exercise the same kernel
-    logic the TPU runs).
+    logic the TPU runs). Block sizes default to the probed
+    ``_default_blocks()`` (256x512 where Mosaic accepts them).
     """
     if q.ndim != 4:
         raise ValueError(f"expected (b, h, l, d), got {q.shape}")
@@ -260,4 +308,8 @@ def flash_attention(
         sm_scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None or block_k is None:
+        dbq, dbk = _default_blocks()
+        block_q = block_q or dbq
+        block_k = block_k or dbk
     return _flash(q, k, v, causal, float(sm_scale), block_q, block_k, interpret)
