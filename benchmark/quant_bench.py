@@ -106,18 +106,29 @@ def main():
         import jax.lax as lax
         rng = onp.random.RandomState(0)
 
-        def bench_fn(jfn, fargs, flops):
-            out = jfn(*fargs)
-            float(jnp.sum(out.astype(jnp.float32)))
+        def bench_fn(op, a, b, flops):
+            """Serial-chained: each iteration's lhs depends on the
+            previous result (bench.py protocol — repeated identical
+            calls with one trailing fetch is the pattern the axon
+            tunnel mis-times)."""
+            def step(a, b):
+                out = op(a, b)
+                s = jnp.sum(out.astype(jnp.float32))
+                tweak = (s.astype(jnp.int32) & 1).astype(a.dtype)
+                return s, a + tweak  # data dependency, cost unchanged
+
+            jfn = jax.jit(step)
+            s, a = jfn(a, b)
+            float(s)
             t0 = time.perf_counter()
-            out = jfn(*fargs)
-            float(jnp.sum(out.astype(jnp.float32)))
+            s, a = jfn(a, b)
+            float(s)
             per = max(time.perf_counter() - t0, 1e-5)
             iters = max(5, min(400, int(2.0 / per)))
             t0 = time.perf_counter()
             for _ in range(iters):
-                out = jfn(*fargs)
-            float(jnp.sum(out.astype(jnp.float32)))
+                s, a = jfn(a, b)
+            float(s)  # chain barrier
             dt = time.perf_counter() - t0
             return flops * iters / dt / 1e12  # TFLOP(int: TOP)/s
 
@@ -125,20 +136,22 @@ def main():
         # matmul 4096^3: 2*4096^3 = 137 GFLOP
         a8 = jnp.asarray(rng.randint(-127, 127, (4096, 4096)), jnp.int8)
         b8 = jnp.asarray(rng.randint(-127, 127, (4096, 4096)), jnp.int8)
-        mm8 = jax.jit(lambda a, b: lax.dot_general(
-            a, b, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32))
+        def mm8(a, b):
+            return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+
         flops_mm = 2 * 4096 ** 3
         try:
-            m["matmul_int8_tops"] = round(bench_fn(mm8, (a8, b8), flops_mm), 2)
+            m["matmul_int8_tops"] = round(bench_fn(mm8, a8, b8, flops_mm), 2)
         except Exception as e:  # noqa: BLE001 — int8 dot may not lower
             m["matmul_int8_error"] = repr(e)[:200]
         abf = a8.astype(jnp.bfloat16)
         bbf = b8.astype(jnp.bfloat16)
-        mmb = jax.jit(lambda a, b: lax.dot_general(
-            a, b, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32))
-        m["matmul_bf16_tflops"] = round(bench_fn(mmb, (abf, bbf), flops_mm), 2)
+        def mmb(a, b):
+            return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+        m["matmul_bf16_tflops"] = round(bench_fn(mmb, abf, bbf, flops_mm), 2)
         if "matmul_int8_tops" in m:
             m["matmul_int8_vs_bf16"] = round(
                 m["matmul_int8_tops"] / m["matmul_bf16_tflops"], 3)
@@ -147,20 +160,24 @@ def main():
         w8 = jnp.asarray(rng.randint(-127, 127, (3, 3, 256, 256)), jnp.int8)
         dn = lax.conv_dimension_numbers(x8.shape, w8.shape,
                                         ("NHWC", "HWIO", "NHWC"))
-        conv8 = jax.jit(lambda x, w: lax.conv_general_dilated(
-            x, w, (1, 1), "SAME", dimension_numbers=dn,
-            preferred_element_type=jnp.int32))
+        def conv8(x, w):
+            return lax.conv_general_dilated(
+                x, w, (1, 1), "SAME", dimension_numbers=dn,
+                preferred_element_type=jnp.int32)
+
         flops_cv = 2 * 32 * 14 * 14 * 256 * 256 * 9
         try:
-            m["conv_int8_tops"] = round(bench_fn(conv8, (x8, w8), flops_cv), 2)
+            m["conv_int8_tops"] = round(bench_fn(conv8, x8, w8, flops_cv), 2)
         except Exception as e:  # noqa: BLE001
             m["conv_int8_error"] = repr(e)[:200]
-        convb = jax.jit(lambda x, w: lax.conv_general_dilated(
-            x, w, (1, 1), "SAME", dimension_numbers=dn,
-            preferred_element_type=jnp.float32))
+        def convb(x, w):
+            return lax.conv_general_dilated(
+                x, w, (1, 1), "SAME", dimension_numbers=dn,
+                preferred_element_type=jnp.float32)
+
         m["conv_bf16_tflops"] = round(
-            bench_fn(convb, (x8.astype(jnp.bfloat16),
-                             w8.astype(jnp.bfloat16)), flops_cv), 2)
+            bench_fn(convb, x8.astype(jnp.bfloat16),
+                     w8.astype(jnp.bfloat16), flops_cv), 2)
         if "conv_int8_tops" in m:
             m["conv_int8_vs_bf16"] = round(
                 m["conv_int8_tops"] / m["conv_bf16_tflops"], 3)
