@@ -273,7 +273,13 @@ def test_complex_fft_guarded_on_axon_tunnel(monkeypatch):
     clear error instead (round-3 handoff hazard). rfft family unaffected."""
     from mxnet_tpu.base import MXNetError
 
+    import jax
+
     monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    # the suite RUNS on cpu while the axon sitecustomize exports
+    # JAX_PLATFORMS=axon — the guard must key on the ACTIVE backend
+    assert mx.np.fft.fft(mx.np.ones((8,))).shape == (8,)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     with pytest.raises(MXNetError, match="axon"):
         mx.np.fft.fft(mx.np.ones((8,)))
     with pytest.raises(MXNetError, match="axon"):
@@ -281,4 +287,5 @@ def test_complex_fft_guarded_on_axon_tunnel(monkeypatch):
     out = mx.np.fft.rfft(mx.np.ones((8,)))  # real family still works
     assert out.shape == (5,)
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.undo()
     assert mx.np.fft.fft(mx.np.ones((8,))).shape == (8,)
