@@ -13,6 +13,7 @@ is never reported without an accuracy check.
 CLI:
     python benchmark/quant_bench.py [--model resnet50_v1] [--batch 32]
         [--calib-mode naive|entropy|none] [--output out.json] [--cpu]
+        [--micro-only]
 """
 from __future__ import annotations
 
@@ -27,6 +28,97 @@ import numpy as onp
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _micro_mxu_probe(jax, jnp, log):
+    """Decisive evidence for the int8 story (VERDICT r4 item #3): a
+    BARE int8xint8->int32 matmul and conv vs the same shapes in bf16.
+    If XLA lowers int8 to the MXU 8-bit path, these show ~2x bf16
+    throughput; if not, the end-to-end PTQ gap is architectural and
+    the docs must say so."""
+    import jax.lax as lax
+    rng = onp.random.RandomState(0)
+
+    def bench_fn(op, a, b, flops):
+        """Serial-chained: each iteration's lhs depends on the
+        previous result (bench.py protocol — repeated identical
+        calls with one trailing fetch is the pattern the axon
+        tunnel mis-times)."""
+        def step(a, b):
+            out = op(a, b)
+            s = jnp.sum(out.astype(jnp.float32))
+            tweak = (s.astype(jnp.int32) & 1).astype(a.dtype)
+            return s, a + tweak  # data dependency, cost unchanged
+
+        jfn = jax.jit(step)
+        s, a = jfn(a, b)
+        float(s)
+        t0 = time.perf_counter()
+        s, a = jfn(a, b)
+        float(s)
+        per = max(time.perf_counter() - t0, 1e-5)
+        iters = max(5, min(400, int(2.0 / per)))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s, a = jfn(a, b)
+        float(s)  # chain barrier
+        dt = time.perf_counter() - t0
+        return flops * iters / dt / 1e12  # TFLOP(int: TOP)/s
+
+    m = {}
+    # matmul 4096^3: 2*4096^3 = 137 GFLOP
+    a8 = jnp.asarray(rng.randint(-127, 127, (4096, 4096)), jnp.int8)
+    b8 = jnp.asarray(rng.randint(-127, 127, (4096, 4096)), jnp.int8)
+
+    def mm8(a, b):
+        return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+
+    flops_mm = 2 * 4096 ** 3
+    try:
+        m["matmul_int8_tops"] = round(bench_fn(mm8, a8, b8, flops_mm), 2)
+    except Exception as e:  # noqa: BLE001 — int8 dot may not lower
+        m["matmul_int8_error"] = repr(e)[:200]
+    abf = a8.astype(jnp.bfloat16)
+    bbf = b8.astype(jnp.bfloat16)
+
+    def mmb(a, b):
+        return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+    m["matmul_bf16_tflops"] = round(bench_fn(mmb, abf, bbf, flops_mm), 2)
+    if "matmul_int8_tops" in m:
+        m["matmul_int8_vs_bf16"] = round(
+            m["matmul_int8_tops"] / m["matmul_bf16_tflops"], 3)
+    # conv: ResNet mid-stage 3x3, 256ch 14x14, bs32
+    x8 = jnp.asarray(rng.randint(-127, 127, (32, 14, 14, 256)), jnp.int8)
+    w8 = jnp.asarray(rng.randint(-127, 127, (3, 3, 256, 256)), jnp.int8)
+    dn = lax.conv_dimension_numbers(x8.shape, w8.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+
+    def conv8(x, w):
+        return lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=dn,
+            preferred_element_type=jnp.int32)
+
+    flops_cv = 2 * 32 * 14 * 14 * 256 * 256 * 9
+    try:
+        m["conv_int8_tops"] = round(bench_fn(conv8, x8, w8, flops_cv), 2)
+    except Exception as e:  # noqa: BLE001
+        m["conv_int8_error"] = repr(e)[:200]
+
+    def convb(x, w):
+        return lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=dn,
+            preferred_element_type=jnp.float32)
+
+    m["conv_bf16_tflops"] = round(
+        bench_fn(convb, x8.astype(jnp.bfloat16),
+                 w8.astype(jnp.bfloat16), flops_cv), 2)
+    if "conv_int8_tops" in m:
+        m["conv_int8_vs_bf16"] = round(
+            m["conv_int8_tops"] / m["conv_bf16_tflops"], 3)
+    return m
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50_v1")
@@ -36,6 +128,9 @@ def main():
                     choices=["none", "naive", "entropy"])
     ap.add_argument("--output", default=None)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--micro-only", action="store_true",
+                    help="run only the bare int8-vs-bf16 MXU microbench "
+                         "(fits a short tunnel window)")
     args = ap.parse_args()
 
     if args.cpu:
@@ -51,6 +146,14 @@ def main():
         print("[quant_bench]", *a, file=sys.stderr, flush=True)
 
     log("devices:", jax.devices())
+    if args.micro_only:
+        # the decisive int8-MXU verdict without the model build/calib —
+        # sized for a short tunnel window (the full e2e needs ~15 min)
+        micro = _micro_mxu_probe(jax, jnp, log)
+        rec = {"device": jax.devices()[0].platform,
+               "micro_only": True, "micro_mxu": micro}
+        print(json.dumps(rec, indent=2))
+        return
     onp.random.seed(0)
     net = getattr(vision, args.model)(classes=1000)
     net.initialize()
@@ -97,94 +200,8 @@ def main():
         log(f"{tag}: {img_s:.1f} img/s ({total} iters)")
         return img_s
 
-    def micro_mxu_probe():
-        """Decisive evidence for the int8 story (VERDICT r4 item #3): a
-        BARE int8xint8->int32 matmul and conv vs the same shapes in bf16.
-        If XLA lowers int8 to the MXU 8-bit path, these show ~2x bf16
-        throughput; if not, the end-to-end PTQ gap is architectural and
-        the docs must say so."""
-        import jax.lax as lax
-        rng = onp.random.RandomState(0)
-
-        def bench_fn(op, a, b, flops):
-            """Serial-chained: each iteration's lhs depends on the
-            previous result (bench.py protocol — repeated identical
-            calls with one trailing fetch is the pattern the axon
-            tunnel mis-times)."""
-            def step(a, b):
-                out = op(a, b)
-                s = jnp.sum(out.astype(jnp.float32))
-                tweak = (s.astype(jnp.int32) & 1).astype(a.dtype)
-                return s, a + tweak  # data dependency, cost unchanged
-
-            jfn = jax.jit(step)
-            s, a = jfn(a, b)
-            float(s)
-            t0 = time.perf_counter()
-            s, a = jfn(a, b)
-            float(s)
-            per = max(time.perf_counter() - t0, 1e-5)
-            iters = max(5, min(400, int(2.0 / per)))
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                s, a = jfn(a, b)
-            float(s)  # chain barrier
-            dt = time.perf_counter() - t0
-            return flops * iters / dt / 1e12  # TFLOP(int: TOP)/s
-
-        m = {}
-        # matmul 4096^3: 2*4096^3 = 137 GFLOP
-        a8 = jnp.asarray(rng.randint(-127, 127, (4096, 4096)), jnp.int8)
-        b8 = jnp.asarray(rng.randint(-127, 127, (4096, 4096)), jnp.int8)
-        def mm8(a, b):
-            return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.int32)
-
-        flops_mm = 2 * 4096 ** 3
-        try:
-            m["matmul_int8_tops"] = round(bench_fn(mm8, a8, b8, flops_mm), 2)
-        except Exception as e:  # noqa: BLE001 — int8 dot may not lower
-            m["matmul_int8_error"] = repr(e)[:200]
-        abf = a8.astype(jnp.bfloat16)
-        bbf = b8.astype(jnp.bfloat16)
-        def mmb(a, b):
-            return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
-
-        m["matmul_bf16_tflops"] = round(bench_fn(mmb, abf, bbf, flops_mm), 2)
-        if "matmul_int8_tops" in m:
-            m["matmul_int8_vs_bf16"] = round(
-                m["matmul_int8_tops"] / m["matmul_bf16_tflops"], 3)
-        # conv: ResNet mid-stage 3x3, 256ch 14x14, bs32
-        x8 = jnp.asarray(rng.randint(-127, 127, (32, 14, 14, 256)), jnp.int8)
-        w8 = jnp.asarray(rng.randint(-127, 127, (3, 3, 256, 256)), jnp.int8)
-        dn = lax.conv_dimension_numbers(x8.shape, w8.shape,
-                                        ("NHWC", "HWIO", "NHWC"))
-        def conv8(x, w):
-            return lax.conv_general_dilated(
-                x, w, (1, 1), "SAME", dimension_numbers=dn,
-                preferred_element_type=jnp.int32)
-
-        flops_cv = 2 * 32 * 14 * 14 * 256 * 256 * 9
-        try:
-            m["conv_int8_tops"] = round(bench_fn(conv8, x8, w8, flops_cv), 2)
-        except Exception as e:  # noqa: BLE001
-            m["conv_int8_error"] = repr(e)[:200]
-        def convb(x, w):
-            return lax.conv_general_dilated(
-                x, w, (1, 1), "SAME", dimension_numbers=dn,
-                preferred_element_type=jnp.float32)
-
-        m["conv_bf16_tflops"] = round(
-            bench_fn(convb, x8.astype(jnp.bfloat16),
-                     w8.astype(jnp.bfloat16), flops_cv), 2)
-        if "conv_int8_tops" in m:
-            m["conv_int8_vs_bf16"] = round(
-                m["conv_int8_tops"] / m["conv_bf16_tflops"], 3)
-        return m
-
     try:
-        micro = micro_mxu_probe()
+        micro = _micro_mxu_probe(jax, jnp, log)
         log("micro:", json.dumps(micro))
     except Exception as e:  # noqa: BLE001 — micro is evidence, not a gate
         micro = {"error": repr(e)[:300]}

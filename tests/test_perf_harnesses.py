@@ -220,14 +220,26 @@ def test_daemon_merge_model_table_keeps_banked_rows(tmp_path):
     rows = {(r["model"], r["precision"]): r.get("img_s")
             for r in out["results"]}
     assert rows == {("a", "fp32"): 10, ("b", "bf16"): 20, ("c", "fp32"): 5}
-    # stale banked rows do NOT merge forward
+    # stale banked successes survive WITH their original stamp (an old
+    # measurement with visible age beats a hole in the table), but a
+    # stale row still counts as needing recapture in stale_combos
+    old = now - 2 * d.STALE_AFTER_S
     json.dump({"device": "tpu", "results": [
         {"model": "a", "precision": "fp32", "img_s": 10,
-         "captured_unix": now - 2 * d.STALE_AFTER_S}]}, open(path, "w"))
+         "captured_unix": old}]}, open(path, "w"))
     out2 = d.merge_model_table(
         str(path), {"device": "tpu", "results": [
             {"model": "a", "precision": "fp32", "error": "died"}]})
-    assert "error" in out2["results"][0]
+    assert out2["results"][0].get("img_s") == 10
+    assert out2["results"][0]["captured_unix"] == old
+    json.dump(out2, open(path, "w"))
+    assert d.stale_combos(str(path), [("a", "fp32"), ("b", "bf16")]) == \
+        [("a", "fp32"), ("b", "bf16")]
+    # a fresh success satisfies stale_combos
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "fp32", "img_s": 11,
+         "captured_unix": now}]}, open(path, "w"))
+    assert d.stale_combos(str(path), [("a", "fp32")]) == []
 
 
 def test_daemon_merge_inherits_table_stamp_and_survives_null(tmp_path):
