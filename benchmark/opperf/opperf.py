@@ -173,14 +173,20 @@ def run_benchmark(ops=None, warmup=5, runs=25, log=print):
     return results
 
 
-def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None):
+def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None,
+                      resume=None):
     """Walk EVERY public op in the registry with auto-synthesized inputs
     (reference opperf auto-enumeration, VERDICT r3 item 8). Eager per-op
     latency + autograd round trip where differentiable.
 
     ``checkpoint``: path that receives the partial table (atomic rewrite)
     every few ops, so an outer-harness kill mid-sweep loses at most a few
-    measurements instead of the whole table."""
+    measurements instead of the whole table.
+
+    ``resume``: path to a previously banked table (same platform, mode
+    full); its measured rows are carried forward and their ops skipped,
+    so repeated short tunnel windows make monotonic progress through the
+    registry instead of re-measuring the alphabetical head every time."""
     import jax
 
     from benchmark.opperf.utils.op_registry_utils import (
@@ -212,6 +218,20 @@ def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None):
         os.replace(tmp, checkpoint)
 
     platform = jax.devices()[0].platform
+    prior = {}
+    if resume:
+        try:
+            with open(resume) as f:
+                prev = json.load(f)
+            if (prev.get("_meta", {}).get("platform") == platform
+                    and prev.get("_meta", {}).get("mode") == "full"):
+                prior = {k: v for k, v in prev.items()
+                         if not k.startswith("_") and isinstance(v, list)
+                         and v and "avg_time" in str(v[0])}
+                log(f"resume: carrying forward {len(prior)} previously "
+                    "measured ops")
+        except Exception as e:  # noqa: BLE001 — no/bad resume file
+            log(f"resume file unusable ({e!r}); full sweep")
     # complex-valued FFTs dispatch fine over the axon tunnel but the
     # backend returns UNIMPLEMENTED asynchronously and then STAYS broken
     # — every subsequent op (even jnp.ones) errors. Pre-skip them on tpu;
@@ -233,6 +253,10 @@ def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None):
         for i, (name, fn) in enumerate(sorted(list_all_ops().items())):
             if checkpoint is not None and i % 20 == 0 and i:
                 _write_checkpoint()
+            if name in prior:
+                results[name] = prior[name]
+                measured += 1
+                continue
             if (platform == "tpu" and name.startswith("np.fft.")
                     and name.split(".")[-1] not in _REAL_FFT_OK):
                 results[name] = [{"skipped": "complex fft: axon tpu "
@@ -298,6 +322,9 @@ def main():
                     help="(--full only) atomically rewrite the partial "
                          "table here every few ops, so a harness kill "
                          "mid-sweep keeps what was measured")
+    ap.add_argument("--resume-from", default=None,
+                    help="(--full only) carry forward measured rows from "
+                         "this banked table and skip their ops")
     args = ap.parse_args()
     if args.cpu:
         import jax
@@ -312,7 +339,7 @@ def main():
                   "(one pass over ~480 ops)", file=sys.stderr)
         results = run_full_registry(
             warmup, runs, log=lambda m: print(m, file=sys.stderr),
-            checkpoint=args.checkpoint)
+            checkpoint=args.checkpoint, resume=args.resume_from)
     else:
         ops = set(args.ops.split(",")) if args.ops else None
         results = run_benchmark(ops, args.warmup, args.runs,

@@ -102,6 +102,34 @@ def atomic_write(path: str, obj) -> None:
 YIELDED = object()  # run_child rc sentinel: distinct from any returncode
 
 
+def stamp_checked(path: str) -> None:
+    """Record a completed best-of check that chose to KEEP the banked
+    record. needs-predicates read this stamp alongside captured_unix, so
+    a 'kept' outcome stops re-firing the (expensive) capture until the
+    next refresh interval instead of hot-looping it."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        if isinstance(obj, dict):
+            obj["last_checked_unix"] = time.time()
+            atomic_write(path, obj)
+    except Exception:  # noqa: BLE001 — stamping is best-effort
+        pass
+
+
+def record_age(path: str, *fields: str) -> float:
+    """Seconds since the newest of the given content stamps (not file
+    mtime: sibling writers — e.g. the quant micro patching micro_mxu
+    into the quant record — must not mask a stale capture)."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        stamp = max((obj.get(f) or 0) for f in fields)
+        return time.time() - stamp if stamp else float("inf")
+    except Exception:  # noqa: BLE001
+        return float("inf")
+
+
 def run_child(cmd, timeout):
     """Run a measurement child, yielding the chip to a live bench: if
     bench.py takes the live lock mid-capture, the child is terminated so
@@ -168,6 +196,7 @@ def capture_headline() -> str:
     if keep_banked:
         log(f"keeping banked {banked['record']['value']} img/s "
             f"(new capture {rec['value']})")
+        stamp_checked(HEADLINE)
         return "kept"
     # displaced records are kept as history, not silently dropped
     history = []
@@ -317,10 +346,13 @@ def capture_opperf() -> None:
         os.remove(ckpt)
     except OSError:
         pass
-    rc, out = run_child(
-        [sys.executable, os.path.join(HERE, "opperf", "opperf.py"),
-         "--full", "--checkpoint", ckpt],
-        timeout=5400)
+    cmd = [sys.executable, os.path.join(HERE, "opperf", "opperf.py"),
+           "--full", "--checkpoint", ckpt]
+    if os.path.exists(OPPERF):
+        # monotonic progress across short tunnel windows: already-banked
+        # measurements are carried forward, not re-measured
+        cmd += ["--resume-from", OPPERF]
+    rc, out = run_child(cmd, timeout=5400)
     rec = parse_json_output(out)
     if rec is None:
         try:
@@ -442,6 +474,7 @@ def capture_llm() -> None:
                     >= (rec.get("decode_tok_s") or 0)):
                 log(f"keeping banked llm {banked.get('value')} tok/s "
                     f"(new capture {rec.get('value')})")
+                stamp_checked(LLM)
                 return
     if bank_if_tpu(LLM, rec, rc, "llm bench") and rec:
         log(f"llm: {rec.get('value')} tok/s train, "
@@ -550,6 +583,7 @@ def capture_train_bs256() -> None:
                     < STALE_AFTER_S and old_mfu >= new_mfu):
                 log(f"keeping banked bs256 mfu={old_mfu} "
                     f"(new capture {new_mfu})")
+                stamp_checked(TRAIN256)
                 return
         except Exception:  # noqa: BLE001 — nothing banked yet
             pass
@@ -642,18 +676,22 @@ def acquire_pidfile() -> bool:
 
 
 def headline_needs() -> bool:
-    """Missing, stale (1h — keep hunting a better number), or mfu-less."""
+    """Missing, mfu-less, or neither captured nor best-of-checked within
+    the hourly refresh (keep hunting a better number, but never hot-loop
+    a 'kept' verdict)."""
     try:
         with open(HEADLINE) as f:
             b = json.load(f)
-        return (time.time() - (b.get("captured_unix") or 0)
-                > HEADLINE_REFRESH_S or not b["record"].get("mfu"))
+        if not b["record"].get("mfu"):
+            return True
     except Exception:  # noqa: BLE001
         return True
+    return record_age(HEADLINE, "captured_unix",
+                      "last_checked_unix") > HEADLINE_REFRESH_S
 
 
 def opperf_needs() -> bool:
-    """The table is 'done' at >=460/482 measured (VERDICT r4 item #7)."""
+    """The table is 'done' at >=460 measured (VERDICT r4 item #7)."""
     try:
         with open(OPPERF) as f:
             meta = json.load(f).get("_meta", {})
@@ -664,11 +702,11 @@ def opperf_needs() -> bool:
         return True
 
 
-def artifact_stale(path: str, max_age: float = STALE_AFTER_S) -> bool:
-    try:
-        return time.time() - os.path.getmtime(path) > max_age
-    except OSError:
-        return True
+def banked_stale(path: str, max_age: float = STALE_AFTER_S):
+    """needs-predicate on the record's CONTENT stamps — not file mtime,
+    which sibling writers (quant micro, keep-banked stamps) refresh."""
+    return lambda: record_age(path, "captured_unix",
+                              "last_checked_unix") > max_age
 
 
 # (label, needs-predicate, capture) in PRIORITY order: the tunnel gives
@@ -680,19 +718,19 @@ CAPTURES = (
     ("quant-micro", quant_micro_needs, capture_quant_micro),
     ("train-table", lambda: bool(stale_combos(TRAIN, TRAIN_COMBOS)),
      capture_train),
-    ("train-bs256", lambda: artifact_stale(TRAIN256, 4 * 3600),
+    ("train-bs256", banked_stale(TRAIN256, 4 * 3600),
      capture_train_bs256),
-    ("llm", lambda: artifact_stale(LLM, 4 * 3600), capture_llm),
-    ("profile", lambda: artifact_stale(PROFILE), capture_profile),
-    ("train-io", lambda: artifact_stale(TRAIN_IO), capture_train_io),
-    ("parity", lambda: artifact_stale(PARITY), capture_parity),
-    ("bs256-infer", lambda: artifact_stale(BS256), capture_bs256),
+    ("llm", banked_stale(LLM, 4 * 3600), capture_llm),
+    ("profile", banked_stale(PROFILE), capture_profile),
+    ("train-io", banked_stale(TRAIN_IO), capture_train_io),
+    ("parity", banked_stale(PARITY), capture_parity),
+    ("bs256-infer", banked_stale(BS256), capture_bs256),
     ("infer-table", lambda: bool(stale_combos(INFER, INFER_COMBOS)),
      capture_infer_table),
-    ("quant", lambda: artifact_stale(QUANT), capture_quant),
+    ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
-    ("attention", lambda: artifact_stale(ATTENTION), capture_attention),
-    ("hbm", lambda: artifact_stale(HBM), capture_hbm),
+    ("attention", banked_stale(ATTENTION), capture_attention),
+    ("hbm", banked_stale(HBM), capture_hbm),
 )
 
 
@@ -708,12 +746,12 @@ def main() -> None:
 
     def needed():
         out = []
-        for label, needs, _ in CAPTURES:
+        for label, needs, cap in CAPTURES:
             try:
                 if needs():
-                    out.append(label)
+                    out.append((label, cap))
             except Exception:  # noqa: BLE001 — malformed artifact = redo
-                out.append(label)
+                out.append((label, cap))
         return out
 
     try:
@@ -726,14 +764,14 @@ def main() -> None:
                 time.sleep(PROBE_INTERVAL_S)
                 continue
             todo = needed()
-            log(f"tunnel up; capture pass over: {todo}")
+            if not todo:
+                log(f"all artifacts satisfied; next check in "
+                    f"{REFRESH_INTERVAL_S}s")
+                time.sleep(REFRESH_INTERVAL_S)
+                continue
+            log(f"tunnel up; capture pass over: {[l for l, _ in todo]}")
             aborted = False
-            for label, needs, cap in CAPTURES:
-                try:
-                    if not needs():
-                        continue
-                except Exception:  # noqa: BLE001
-                    pass
+            for label, cap in todo:
                 if live_lock.held_by_live_process():
                     log("live bench arrived; pausing captures")
                     aborted = True
@@ -744,9 +782,13 @@ def main() -> None:
                     aborted = True
                     break
                 cap()
-            left = needed()
-            wait = PROBE_INTERVAL_S if (aborted or left) \
-                else REFRESH_INTERVAL_S
+            left = [l for l, _ in needed()]
+            # aborted pass -> fast probe to catch the next window; a
+            # COMPLETED pass always backs off a full refresh interval,
+            # even if some needs were not satisfied by their own capture
+            # (kept-banked verdicts, persistently erroring combos) — the
+            # old hot-spin re-ran expensive captures every 180s
+            wait = PROBE_INTERVAL_S if aborted else REFRESH_INTERVAL_S
             log(f"suite pass {'aborted' if aborted else 'done'}; "
                 f"still needed: {left or 'nothing'}; "
                 f"next probe in {wait}s")

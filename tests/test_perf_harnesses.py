@@ -128,6 +128,47 @@ def test_opperf_full_registry_walker():
     assert meta["errored"] == 0 and meta["skipped"] == 0, meta
 
 
+def test_opperf_resume_carries_measured_rows(tmp_path, monkeypatch):
+    """--resume-from: previously banked measurements are carried forward
+    and their ops skipped, so repeated short tunnel windows progress
+    monotonically through the registry instead of re-measuring the
+    alphabetical head every time."""
+    import json
+    import sys
+
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    import benchmark.opperf.utils.op_registry_utils as reg
+    from benchmark.opperf.opperf import run_full_registry
+
+    real_ops = reg.list_all_ops()
+    three = {k: real_ops[k] for k in sorted(real_ops)[:3]}
+    monkeypatch.setattr(reg, "list_all_ops", lambda: three)
+    first, *rest = sorted(three)
+    prior_row = [{"avg_time_ms": 123.0, "runs": 1}]
+    resume = tmp_path / "banked.json"
+    import jax
+    json.dump({"_meta": {"platform": jax.devices()[0].platform,
+                         "mode": "full"},
+               first: prior_row}, open(resume, "w"))
+    res = run_full_registry(warmup=0, runs=1, log=lambda *_: None,
+                            resume=str(resume))
+    # the prior row is copied verbatim (not re-measured) ...
+    assert res[first] == prior_row
+    # ... the other ops were actually measured this run ...
+    for name in rest:
+        assert res[name] != prior_row and "error" not in res[name][0], \
+            res[name]
+    # ... and the meta counts include the carried row
+    assert res["_meta"]["measured"] == 3
+    # wrong-platform resume files are ignored entirely
+    json.dump({"_meta": {"platform": "gpu", "mode": "full"},
+               first: prior_row}, open(resume, "w"))
+    res2 = run_full_registry(warmup=0, runs=1, log=lambda *_: None,
+                             resume=str(resume))
+    assert res2[first] != prior_row
+
+
 def test_device_parity_sweep():
     """tools/device_parity.py: every curated op matches its numpy
     oracle on the current backend (the check_consistency artifact the
