@@ -11,12 +11,18 @@ attention, bf16 compute over fp32 masters) with MFU against the chip's
 bf16 peak, and one for autoregressive decode through the KV cache.
 
 CLI:
-    python benchmark/llm_bench.py [--seq 1024] [--batch 8]
+    python benchmark/llm_bench.py [--seq 1024] [--batch 0=auto]
         [--layers 12] [--units 768] [--decode-tokens 64] [--cpu]
         [--output out.json]
 
+Batch auto mode (the default) probes 32 -> 16 -> 8 and keeps the largest
+that fits HBM — batch is the first MFU lever (VERDICT r4 item #1) — so
+the metric name records which one actually ran, e.g.
+"gpt_small_train_bs32_seq1024_bf16" (consumers should key off the
+value/unit/mfu fields, not a fixed metric string).
+
 Prints one JSON object (the daemon banks it when device == "tpu"):
-  {"metric": "gpt_small_train_bs8_seq1024_bf16", "value": <tok/s>,
+  {"metric": "gpt_small_train_bs<B>_seq1024_bf16", "value": <tok/s>,
    "unit": "tok/s", "mfu": ..., "decode_tok_s": ..., ...}
 """
 from __future__ import annotations
@@ -42,13 +48,19 @@ def log(*a):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=1024)
-    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=0,
+                    help="train batch; 0 = auto (largest of 32/16/8 that "
+                         "fits HBM — batch size is the first MFU lever, "
+                         "VERDICT r4 item #1)")
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--units", type=int, default=768)
     ap.add_argument("--heads", type=int, default=12)
     ap.add_argument("--vocab", type=int, default=32000)
     ap.add_argument("--decode-tokens", type=int, default=64)
-    ap.add_argument("--decode-batch", type=int, default=8)
+    ap.add_argument("--decode-batch", type=int, default=0,
+                    help="0 = auto (32, falling back to 8 on OOM); "
+                         "decode is HBM-bound, so batch amortizes the "
+                         "weight reads")
     ap.add_argument("--output", default=None)
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
@@ -67,55 +79,84 @@ def main():
     platform = devs[0].platform
     log("devices:", devs)
 
-    B, L = args.batch, args.seq
+    L = args.seq
+    # auto mode: largest batch that fits wins (throughput benchmark at
+    # the MFU-optimal batch; the metric name records which one ran).
+    # CPU keeps bs8 — the emulated-bf16 path is about correctness there.
+    if args.batch:
+        batch_candidates = [args.batch]
+    elif platform == "cpu":
+        batch_candidates = [8]
+    else:
+        batch_candidates = [32, 16, 8]
     net = gpt_like(vocab_size=args.vocab, units=args.units,
                    hidden_size=4 * args.units, num_layers=args.layers,
                    num_heads=args.heads, max_length=max(2048, L),
                    dropout=0.0)
     net.initialize()
     rng = onp.random.RandomState(0)
-    x_np = rng.randint(0, args.vocab, (B, L)).astype(onp.int32)
+    x_np = rng.randint(0, args.vocab,
+                       (batch_candidates[-1], L)).astype(onp.int32)
     fn, params = net.functionalize(mx.np.array(x_np), training=True)
     n_params = sum(int(v.size) for v in params.values())
     log(f"params: {n_params/1e6:.1f}M")
+    # the train attempts donate params/velocity into the step; a failed
+    # (OOM) attempt can leave donated buffers deleted, so keep a host
+    # copy to rebuild fresh device state per attempt
+    params_host = {k: onp.asarray(v) for k, v in params.items()}
 
     # ---- KV-cache decode (FIRST: the train step donates the param
     # buffers the live net shares, so decode after it would read deleted
     # arrays) ----
-    DB, DT = args.decode_batch, args.decode_tokens
-    prompt = mx.np.array(rng.randint(0, args.vocab, (DB, 8)).astype("int32"))
+    DT = args.decode_tokens
+    DB = None
     decode_tok_s = None
     decode_int8_tok_s = None
-    try:
-        from mxnet_tpu.gluon.model_zoo.generation import generate
+    if args.decode_batch:
+        decode_candidates = [args.decode_batch]
+    elif platform == "cpu":
+        decode_candidates = [8]  # same emulation-watchdog reason as train
+    else:
+        decode_candidates = [32, 8]
+    for db in decode_candidates:
+        prompt = mx.np.array(
+            rng.randint(0, args.vocab, (db, 8)).astype("int32"))
+        try:
+            from mxnet_tpu.gluon.model_zoo.generation import generate
 
-        t0 = time.time()
-        out = generate(net, prompt, max_new_tokens=DT, max_length=256)
-        out.asnumpy()
-        log(f"decode compiled+ran in {time.time() - t0:.1f}s")
-        t0 = time.perf_counter()
-        out = generate(net, prompt, max_new_tokens=DT, max_length=256)
-        out.asnumpy()
-        d_dt = time.perf_counter() - t0
-        decode_tok_s = DB * DT / d_dt
-        log(f"decode: {decode_tok_s:.1f} tok/s (bs {DB})")
+            t0 = time.time()
+            out = generate(net, prompt, max_new_tokens=DT, max_length=256)
+            out.asnumpy()
+            log(f"decode bs{db} compiled+ran in {time.time() - t0:.1f}s")
+            t0 = time.perf_counter()
+            out = generate(net, prompt, max_new_tokens=DT, max_length=256)
+            out.asnumpy()
+            d_dt = time.perf_counter() - t0
+            DB = db
+            decode_tok_s = db * DT / d_dt
+            log(f"decode: {decode_tok_s:.1f} tok/s (bs {db})")
+        except Exception as e:  # noqa: BLE001 — decode is secondary
+            log(f"decode bench bs{db} failed: {e!r}")
+            continue
         # int8 KV cache: half the cache bytes of bf16 on the
-        # bandwidth-bound read path (kv_cache_quantize)
-        out = generate(net, prompt, max_new_tokens=DT, max_length=256,
-                       kv_cache_dtype="int8")
-        out.asnumpy()  # warm/compile
-        t0 = time.perf_counter()
-        out = generate(net, prompt, max_new_tokens=DT, max_length=256,
-                       kv_cache_dtype="int8")
-        out.asnumpy()
-        decode_int8_tok_s = DB * DT / (time.perf_counter() - t0)
-        log(f"decode int8-kv: {decode_int8_tok_s:.1f} tok/s")
-    except Exception as e:  # noqa: BLE001 — decode is a secondary number
-        log(f"decode bench failed: {e!r}")
+        # bandwidth-bound read path (kv_cache_quantize). Its OWN try:
+        # an int8-path failure must not discard the measured bf16 row
+        # and restart decode at a smaller batch.
+        try:
+            out = generate(net, prompt, max_new_tokens=DT, max_length=256,
+                           kv_cache_dtype="int8")
+            out.asnumpy()  # warm/compile
+            t0 = time.perf_counter()
+            out = generate(net, prompt, max_new_tokens=DT, max_length=256,
+                           kv_cache_dtype="int8")
+            out.asnumpy()
+            decode_int8_tok_s = db * DT / (time.perf_counter() - t0)
+            log(f"decode int8-kv: {decode_int8_tok_s:.1f} tok/s")
+        except Exception as e:  # noqa: BLE001
+            log(f"decode int8-kv bs{db} failed: {e!r}")
+        break
 
     momentum, lr = 0.9, 0.01
-    velocity = {k: jnp.zeros_like(v) for k, v in params.items()
-                if v.dtype == jnp.float32}
 
     def loss_fn(p, x, key):
         # bf16 compute over fp32 masters (cpu: fp32 straight through —
@@ -151,31 +192,59 @@ def main():
         return loss, new_p, new_v
 
     jstep = jax.jit(train_step, donate_argnums=(0, 1))
-    x = jnp.asarray(x_np)
     key = jax.random.PRNGKey(0)
 
-    t0 = time.time()
-    loss, params2, velocity2 = jstep(params, velocity, x, key)
-    float(loss)
-    log(f"train step compiled in {time.time() - t0:.1f}s, "
-        f"loss {float(loss):.3f}")
+    # release the ORIGINAL device weights before the OOM probe: decode is
+    # done with them, params_host preserves the values, and ~4*n_params
+    # bytes of fp32 headroom can be the difference between bs32 fitting
+    # or not (review finding)
+    for v in params.values():
+        try:
+            v.delete()
+        except Exception:  # noqa: BLE001 — already deleted / cpu
+            pass
+    params = None
 
-    # timed loop (serial chain through donated params)
-    t0 = time.perf_counter()
-    loss, params2, velocity2 = jstep(params2, velocity2, x, key)
-    float(loss)
-    per = max(time.perf_counter() - t0, 1e-4)
-    iters = max(3, min(100, int(8.0 / per)))
-    total, dt = 0, 0.0
-    while dt < 8.0 and total < 1000:
+    B = tok_s = params2 = velocity2 = x = None
+    for b in batch_candidates:
+        # fresh device state per attempt: a failed donated call may have
+        # deleted the previous attempt's buffers
+        params_b = {k: jnp.asarray(v) for k, v in params_host.items()}
+        velocity_b = {k: jnp.zeros_like(v) for k, v in params_b.items()
+                      if v.dtype == jnp.float32}
+        x_b = jnp.asarray(
+            rng.randint(0, args.vocab, (b, L)).astype(onp.int32))
+        try:
+            t0 = time.time()
+            loss, params2, velocity2 = jstep(params_b, velocity_b, x_b, key)
+            float(loss)
+            log(f"train bs{b}: step compiled in {time.time() - t0:.1f}s, "
+                f"loss {float(loss):.3f}")
+        except Exception as e:  # noqa: BLE001 — OOM at this batch
+            log(f"train bs{b} failed ({repr(e)[:200]}); trying smaller")
+            continue
+        # timed loop (serial chain through donated params)
         t0 = time.perf_counter()
-        for _ in range(iters):
-            loss, params2, velocity2 = jstep(params2, velocity2, x, key)
+        loss, params2, velocity2 = jstep(params2, velocity2, x_b, key)
         float(loss)
-        dt += time.perf_counter() - t0
-        total += iters
-    tok_s = B * L * total / dt
-    log(f"train: {tok_s:.0f} tok/s over {total} steps ({dt:.1f}s)")
+        per = max(time.perf_counter() - t0, 1e-4)
+        iters = max(3, min(100, int(8.0 / per)))
+        total, dt = 0, 0.0
+        while dt < 8.0 and total < 1000:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss, params2, velocity2 = jstep(params2, velocity2, x_b,
+                                                 key)
+            float(loss)
+            dt += time.perf_counter() - t0
+            total += iters
+        B, x = b, x_b
+        tok_s = B * L * total / dt
+        log(f"train: {tok_s:.0f} tok/s over {total} steps ({dt:.1f}s)")
+        break
+    if B is None:
+        log("train failed at every candidate batch")
+        sys.exit(1)
 
     # FLOPs for MFU: XLA cost analysis, else jaxpr MAC walk, else the
     # 6*N*T analytic estimate (scaling-book rule; dense-only, no attn term)
