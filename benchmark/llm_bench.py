@@ -208,7 +208,10 @@ def main():
     B = tok_s = params2 = velocity2 = x = None
     for b in batch_candidates:
         # fresh device state per attempt: a failed donated call may have
-        # deleted the previous attempt's buffers
+        # deleted the previous attempt's buffers — and drop references to
+        # the failed attempt's copies BEFORE allocating the new ones, or
+        # the stale masters shrink headroom for the smaller batch
+        params_b = velocity_b = x_b = None
         params_b = {k: jnp.asarray(v) for k, v in params_host.items()}
         velocity_b = {k: jnp.zeros_like(v) for k, v in params_b.items()
                       if v.dtype == jnp.float32}

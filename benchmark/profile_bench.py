@@ -8,7 +8,8 @@ is timed with the serial-chain scalar-fetch barrier (bench.py protocol),
 and the deltas attribute time to components:
 
   ResNet-50 (bf16, bs32 + bs256):   fwd | fwd+bwd | full step
-  GPT-small (bf16, bs8 seq1024):    fwd | fwd+loss | fwd+bwd | full step
+  GPT-small (bf16, seq1024, llm_bench's 32->16->8 auto-batch ladder —
+    largest that fits): fwd | fwd+loss | fwd+bwd | full step
     + per-layer micro: flash-attention, MLP block, LM-head+fused-CE
 
 The artifact (results_profile_tpu.json) carries ms per component, the
@@ -363,10 +364,12 @@ def main():
                 break
             except Exception as e:  # noqa: BLE001
                 log(f"gpt profile bs{gb} failed: {e!r}")
-                last_err = e
+                # keep only the repr: the exception object's traceback
+                # pins the failed attempt's device buffers (params, x,
+                # executables) and would cascade the OOM down the ladder
+                last_err = repr(e)[:300]
         if last_err is not None:
-            rec["gpt_small_bf16_bs8_seq1024"] = {
-                "error": repr(last_err)[:300]}
+            rec["gpt_small_bf16_bs8_seq1024"] = {"error": last_err}
 
     # ranked top costs across everything measured (component ms, largest
     # first) — the "top-3 remaining costs" the VERDICT asks the artifact

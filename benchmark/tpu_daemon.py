@@ -564,12 +564,26 @@ def capture_profile() -> None:
 def capture_train_bs256() -> None:
     """ResNet-50 bf16 train at bs256 — the MFU-optimal batch next to the
     bs32 baseline-contract row (VERDICT r4 item #1 targets mfu>=0.35)."""
-    rc, out = run_child(
-        [sys.executable, os.path.join(HERE, "train_bench.py"),
-         "--models", "resnet50_v1", "--precisions", "bf16",
-         "--batch", "256", "--timeout", "600", "--retries", "1"],
-        timeout=1500)
-    rec = parse_json_output(out)
+    rec = None
+    for batch in ("256", "128"):  # bs256 train may not fit 16G HBM
+        rc, out = run_child(
+            [sys.executable, os.path.join(HERE, "train_bench.py"),
+             "--models", "resnet50_v1", "--precisions", "bf16",
+             "--batch", batch, "--timeout", "600", "--retries", "0"],
+            timeout=700)
+        if rc is YIELDED:
+            return
+        rec = parse_json_output(out)
+        if rec and rec.get("device") == "tpu" and \
+                all("error" not in r for r in rec.get("results", [])):
+            break
+        if not tpu_alive():
+            log("train bs256: tunnel died; not trying smaller batch")
+            break
+    if rec and rec.get("device") == "tpu" and \
+            all("error" in r for r in rec.get("results", []) or [{}]):
+        log("train bs256: every batch errored; keeping banked record")
+        return
     # best-of within freshness (headline policy): this row exists to
     # show peak MFU, so a throttled-tunnel capture must not displace a
     # better fresh one
