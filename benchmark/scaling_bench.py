@@ -192,6 +192,98 @@ def weak_scaling(name, make_model, per_dev_batch, iters):
             "efficiency_vs_serialized": effs}
 
 
+def fixed_work_scaling(name, build_step, iters):
+    """t(N) for a FIXED total problem sharded over N devices (tp/sp, the
+    strategies the reference lacked entirely — SURVEY §2.3 rows 56/58).
+    On the shared-core mesh total compute is constant as N grows, so
+
+        eff(N) = t(1) / t(N)
+
+    which is 1.0 iff partitioning + collectives (psum for Megatron-TP,
+    ppermute rings for SP) add nothing over the serialized compute."""
+    import jax
+
+    times = {}
+    log(f"{name}: fixed-work scaling over 1,2,4,8 devices")
+    for n in (1, 2, 4, 8):
+        jstep, step_args = build_step(n)
+        out = jstep(*step_args)
+        jax.block_until_ready(out)  # compile + settle
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = jstep(*step_args)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        times[n] = best
+        log(f"  {name} n={n}: {best * 1e3:.1f} ms (min of {iters})")
+    effs = {str(n): round(times[1] / times[n], 4) for n in times}
+    return {"protocol": "fixed-work: eff(N) = t(1)/t(N)",
+            "step_ms": {str(n): round(t * 1e3, 2) for n, t in times.items()},
+            "efficiency_vs_serialized": effs}
+
+
+def build_tp_mlp(n):
+    """Megatron-TP transformer MLP block (column-parallel W1, row-parallel
+    W2, ONE psum on the output) fwd+bwd at fixed (batch, d_model)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    d, h, b = 512, 2048, 256
+    rng = onp.random.RandomState(0)
+    mesh = Mesh(onp.array(jax.devices()[:n]), ("tp",))
+    w1 = jax.device_put(jnp.asarray(rng.normal(0, 0.02, (d, h)), jnp.float32),
+                        NamedSharding(mesh, P(None, "tp")))
+    w2 = jax.device_put(jnp.asarray(rng.normal(0, 0.02, (h, d)), jnp.float32),
+                        NamedSharding(mesh, P("tp", None)))
+    x = jax.device_put(jnp.asarray(rng.normal(0, 1, (b, d)), jnp.float32),
+                       NamedSharding(mesh, P()))
+
+    def local_loss(x, w1, w2):
+        y = jax.lax.psum(jax.nn.gelu(x @ w1) @ w2, "tp")
+        return jnp.mean(y * y)
+
+    def local_step(x, w1, w2):
+        loss, (g1, g2) = jax.value_and_grad(
+            local_loss, argnums=(1, 2))(x, w1, w2)
+        return loss, g1, g2
+
+    step = shard_map(local_step, mesh=mesh,
+                     in_specs=(P(), P(None, "tp"), P("tp", None)),
+                     out_specs=(P(), P(None, "tp"), P("tp", None)))
+    return jax.jit(step), (x, w1, w2)
+
+
+def build_sp_ring(n):
+    """Ring attention (sequence-parallel, ppermute ring) forward at fixed
+    (B, L, H, D) — the long-context strategy SURVEY §5 calls out as
+    absent from the reference."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu.parallel.ring_attention import ring_self_attention
+
+    B, L, H, D = 2, 2048, 4, 64
+    rng = onp.random.RandomState(0)
+    mesh = Mesh(onp.array(jax.devices()[:n]), ("sp",))
+    shard = NamedSharding(mesh, P(None, "sp"))
+    q, k, v = (jax.device_put(
+        jnp.asarray(rng.normal(0, 1, (B, L, H, D)), jnp.float32), shard)
+        for _ in range(3))
+
+    def fwd(q, k, v):
+        out = ring_self_attention(q, k, v, mesh=mesh, causal=True)
+        return jnp.sum(out)
+
+    return jax.jit(fwd), (q, k, v)
+
+
 def pod_model(grad_mbytes, step_compute_ms):
     """Predicted dp weak-scaling efficiency 8..256 chips from the ICI
     ring-all-reduce model, unoverlapped and fully-overlapped bounds."""
@@ -232,9 +324,12 @@ def main():
     jax.config.update("jax_platforms", "cpu")
     assert len(jax.devices()) >= 8, "need the 8-virtual-device mesh"
 
-    rec = {"protocol": ("weak scaling dp=1,2,4,8 on the shared-core "
-                        "virtual mesh; eff(N) = N*t(1)/t(N) — 1.0 iff "
-                        "sharding+collectives add nothing over the "
+    rec = {"protocol": ("shared-core virtual mesh, two row families: "
+                        "dp rows (mlp_block, resnet18) are WEAK scaling, "
+                        "eff(N) = N*t(1)/t(N); tp/sp rows (tp_mlp_block, "
+                        "sp_ring_attention) are FIXED-WORK scaling, "
+                        "eff(N) = t(1)/t(N). Both are 1.0 iff "
+                        "partitioning+collectives add nothing over the "
                         "serialized compute (see module docstring)"),
            "n_virtual_devices": 8,
            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
@@ -254,6 +349,19 @@ def main():
         # dominates the per-step sync cost
         rec["resnet18"] = weak_scaling(
             "resnet18", model_resnet18, per_dev_batch=16, iters=args.iters)
+    # fixed-work scaling of the strategies the reference lacked: TP
+    # (Megatron MLP, one psum) and SP (ring attention, ppermute ring) —
+    # eff(N) = t(1)/t(N) since total compute is constant
+    rec["tp_mlp_block"] = fixed_work_scaling(
+        "tp_mlp_block", build_tp_mlp, iters=max(10, args.iters))
+    rec["sp_ring_attention"] = fixed_work_scaling(
+        "sp_ring_attention", build_sp_ring, iters=max(10, args.iters))
+    rec["sp_ring_attention"]["note"] = (
+        "eff > 1 is a shared-core cache artifact: n=1 materializes one "
+        "(2048, 2048) f32 score block (16 MB, spills L2), n=8 works in "
+        "(256, 256) blocks; on a real pod the ring's ppermute wire time "
+        "replaces this win. The signal is that ring overhead does NOT "
+        "degrade t(N) as rounds grow 1 -> 8.")
 
     # pod model anchored on the banked single-chip ResNet-50 bf16 train
     # step (falls back to the r3 number if no artifact)

@@ -428,6 +428,30 @@ def test_scaling_bench_weak_scaling_schema():
     assert 0.2 < eff < 3.0, eff
 
 
+def test_scaling_bench_fixed_work_builders():
+    """TP/SP fixed-work scaling builders: the n=2 sharded program
+    computes the same loss as n=1 (partitioning changes nothing
+    numerically) and grads keep the global shapes."""
+    import sys
+
+    sys.path.insert(0, ROOT)
+    from benchmark.scaling_bench import build_sp_ring, build_tp_mlp
+
+    jstep1, a1 = build_tp_mlp(1)
+    loss1 = float(jstep1(*a1)[0])
+    jstep2, a2 = build_tp_mlp(2)
+    loss2, g1, g2 = jstep2(*a2)
+    assert onp.isfinite(loss1) and abs(loss1 - float(loss2)) < 1e-5 * (
+        1 + abs(loss1))
+    assert g1.shape == (512, 2048) and g2.shape == (2048, 512)
+
+    jfwd1, q1 = build_sp_ring(1)
+    s1 = float(jfwd1(*q1))
+    jfwd2, q2 = build_sp_ring(2)
+    s2 = float(jfwd2(*q2))
+    assert onp.isfinite(s1) and abs(s1 - s2) < 1e-3 * (1 + abs(s1))
+
+
 def test_scaling_bench_pod_model():
     import sys
 
