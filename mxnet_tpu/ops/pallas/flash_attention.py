@@ -36,9 +36,13 @@ def _mha_reference(q, k, v, causal: bool, sm_scale: float):
     return jnp.einsum("bhqk,bhkd->bhqd", p, vf).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  sm_scale, causal, block_q, block_k, seq_q, seq_k, n_k,
-                  precision):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, causal,
+                  block_q, block_k, seq_q, seq_k, n_k, precision):
+    # rest = (lse_ref?, acc_ref, m_ref, l_ref): the lse output exists
+    # only when the caller saves residuals for a backward — the
+    # inference primal skips its HBM writes entirely
+    lse_ref = rest[0] if len(rest) == 4 else None
+    acc_ref, m_ref, l_ref = rest[-3:]
     import jax.experimental.pallas as pl
 
     qi = pl.program_id(1)
@@ -97,9 +101,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finalize():
         l = l_ref[:, :1]
         o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, jnp.float32(1.0), l)).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # log-sum-exp per row, saved for the backward (lane-128
+            # layout, the same residual layout the official TPU kernel
+            # uses); the l==0 guard keeps fully-masked/padded rows at a
+            # finite value
+            lse_ref[0] = m_ref[:] + jnp.log(
+                jnp.where(l_ref[:] == 0.0, jnp.float32(1.0), l_ref[:]))
 
 
-def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                   save_residuals=False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -133,7 +145,16 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
         block_k=bk, seq_q=lq, seq_k=lk, n_k=n_k, precision=precision)
-    out = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, jnp.int32(0))),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((b * h, n_q * bq, d), q.dtype)]
+    if save_residuals:
+        out_specs.append(pl.BlockSpec(
+            (1, bq, 128), lambda bh, qi, ki: (bh, qi, jnp.int32(0))))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, n_q * bq, 128), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
         in_specs=[
@@ -141,8 +162,8 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, jnp.int32(0), ki)),
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, jnp.int32(0))),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, jnp.int32(0))),
-        out_shape=jax.ShapeDtypeStruct((b * h, n_q * bq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -150,18 +171,25 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    out = out.reshape(b, h, n_q * bq, d)
-    return out[:, :, :lq, :]
+    out = res[0].reshape(b, h, n_q * bq, d)[:, :, :lq, :]
+    if not save_residuals:
+        return out, None
+    # (bh, Lpad, 128) lane-broadcast -> (b, h, lq) row values
+    lse = res[1][:, :lq, 0].reshape(b, h, lq)
+    return out, lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v, out)
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                              interpret, save_residuals=True)
+    return out, (q, k, v, out, lse)
 
 
 def _causal_block_mask(q_pos, k_pos, causal, seq_q, seq_k):
@@ -172,14 +200,16 @@ def _causal_block_mask(q_pos, k_pos, causal, seq_q, seq_k):
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    """True flash backward: two blockwise passes over K (lse recompute, then
-    dQ/dK/dV), never materializing more than one (Lq, block_k) score block.
+    """Flash backward: ONE blockwise pass over K computing dQ/dK/dV, never
+    materializing more than one (Lq, block_k) score block.
 
-    Standard flash-attention-2 backward math: with lse from the forward,
-    p = exp(s - lse) reconstructs each probability block exactly;
-    ds = p * (dp - D) where D = rowsum(dO * O).
+    Standard flash-attention-2 backward math: with the lse SAVED by the
+    forward kernel (a (b,h,L) f32 residual — saving it deleted the whole
+    lse-recompute pass this backward used to run), p = exp(s - lse)
+    reconstructs each probability block exactly; ds = p * (dp - D) where
+    D = rowsum(dO * O).
     """
-    q, k, v, out = res
+    q, k, v, out, lse = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
     # the XLA-scan backward gets no launch-overhead win from big K blocks
@@ -204,50 +234,97 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     q_pos = jnp.arange(lq)
     scale = f32(sm_scale)
 
-    # pass 1: recompute lse blockwise (same online max/sum as the forward)
-    def lse_body(carry, blk):
-        m, l = carry
-        i, k_blk = blk
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
-                       preferred_element_type=f32) * scale
-        mask = _causal_block_mask(q_pos, i * bk + jnp.arange(bk), causal, lq, lk)
-        s = jnp.where(mask, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
-        l = l * jnp.exp(m - m_new) + p.sum(axis=-1)
-        return (m_new, l), None
-
-    m0 = jnp.full((b, h, lq), _NEG_INF, f32)
-    l0 = jnp.zeros((b, h, lq), f32)
-    (m, l), _ = jax.lax.scan(lse_body, (m0, l0), (jnp.arange(n_k), kb))
-    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))  # (b,h,lq)
-
-    # pass 2: accumulate dq; emit dk/dv per block
+    # single pass: accumulate dq; emit dk/dv per block (lse comes from
+    # the forward kernel's saved residual)
     D = jnp.einsum("bhqd,bhqd->bhq", gq, out.astype(q.dtype),
                    preferred_element_type=f32)  # rowsum(dO*O)
 
-    def grad_body(dq, blk):
-        i, k_blk, v_blk = blk
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+    def pair_grads(q_blk, g_blk, lse_blk, d_blk, k_blk, v_blk, mask):
+        """Gradients of one (q-block, k-block) pair; the flash-2 math."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_blk,
                        preferred_element_type=f32) * scale
-        mask = _causal_block_mask(q_pos, i * bk + jnp.arange(bk), causal, lq, lk)
-        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)  # f32 (b,h,lq,bk)
+        p = jnp.where(mask, jnp.exp(s - lse_blk[..., None]), 0.0)
         pq = p.astype(q.dtype)  # bf16 operand, like the fwd kernel's PV
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", pq, gq,
-                            preferred_element_type=f32)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", gq, v_blk,
+        dv_p = jnp.einsum("bhqk,bhqd->bhkd", pq, g_blk,
+                          preferred_element_type=f32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g_blk, v_blk,
                         preferred_element_type=f32)
-        ds = p * (dp - D[..., None]) * scale
+        ds = p * (dp - d_blk[..., None]) * scale
         dsq = ds.astype(q.dtype)  # flash-2: ds in compute dtype
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", dsq, k_blk,
-                             preferred_element_type=f32)
-        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", dsq, q,
-                            preferred_element_type=f32)
-        return dq, (dk_blk, dv_blk)
+        dq_p = jnp.einsum("bhqk,bhkd->bhqd", dsq, k_blk,
+                          preferred_element_type=f32)
+        dk_p = jnp.einsum("bhqk,bhqd->bhkd", dsq, q_blk,
+                          preferred_element_type=f32)
+        return dq_p, dk_p, dv_p
 
-    dq0 = jnp.zeros((b, h, lq, d), f32)
-    dq, (dkb, dvb) = jax.lax.scan(grad_body, dq0,
-                                  (jnp.arange(n_k), kb, vb))
+    if not causal:
+        # full-q path: biggest einsums, no skippable blocks exist
+        def grad_body(dq, blk):
+            i, k_blk, v_blk = blk
+            mask = _causal_block_mask(q_pos, i * bk + jnp.arange(bk),
+                                      causal, lq, lk)
+            dq_p, dk_blk, dv_blk = pair_grads(q, gq, lse, D, k_blk, v_blk,
+                                              mask)
+            return dq + dq_p, (dk_blk, dv_blk)
+
+        dq0 = jnp.zeros((b, h, lq, d), f32)
+        dq, (dkb, dvb) = jax.lax.scan(grad_body, dq0,
+                                      (jnp.arange(n_k), kb, vb))
+    else:
+        # causal: block the q axis too and SKIP dead (q, k) pairs via
+        # lax.cond — the forward kernel's causal block-skip, mirrored.
+        # Without this the backward does ~2x the necessary matmul FLOPs
+        # (every pair computed, half fully masked).
+        bq = min(128, lq)
+        n_q = -(-lq // bq)
+        pad_q = n_q * bq - lq
+        def qpad(a, fill=0.0):
+            return jnp.pad(a, ((0, 0), (0, 0), (0, pad_q)) + ((0, 0),) *
+                           (a.ndim - 3), constant_values=fill) if pad_q else a
+        # block-major over q: (n_q, b, h, bq, ...)
+        qb = qpad(q).reshape(b, h, n_q, bq, d).transpose(2, 0, 1, 3, 4)
+        gb = qpad(gq).reshape(b, h, n_q, bq, d).transpose(2, 0, 1, 3, 4)
+        # padded q rows: lse=+inf would still give p=0, but 0*inf NaNs in
+        # ds; a large finite fill keeps p exactly 0 and ds finite
+        lseb = qpad(lse, -_NEG_INF).reshape(b, h, n_q, bq).transpose(2, 0, 1, 3)
+        Db = qpad(D).reshape(b, h, n_q, bq).transpose(2, 0, 1, 3)
+
+        def k_body(dqb, blk):
+            i, k_blk, v_blk = blk
+
+            def q_body(carry, qblk):
+                dk_acc, dv_acc = carry
+                qi, q_blk, g_blk, lse_blk, d_blk, dq_prev = qblk
+                # pair is live iff its LAST q row can see the k block's
+                # first row: ki*bk <= qi*bq + bq-1 + (lk - lq)
+                live = i * bk <= qi * bq + (bq - 1) + (lk - lq)
+
+                def compute(_):
+                    k_pos = i * bk + jnp.arange(bk)
+                    mask = _causal_block_mask(
+                        qi * bq + jnp.arange(bq), k_pos, True, lq, lk)
+                    dq_p, dk_p, dv_p = pair_grads(
+                        q_blk, g_blk, lse_blk, d_blk, k_blk, v_blk, mask)
+                    return dq_prev + dq_p, dk_acc + dk_p, dv_acc + dv_p
+
+                def skip(_):
+                    return dq_prev, dk_acc, dv_acc
+
+                dq_new, dk_acc, dv_acc = jax.lax.cond(live, compute, skip,
+                                                      None)
+                return (dk_acc, dv_acc), dq_new
+
+            zero_kd = jnp.zeros((b, h, bk, d), f32)
+            (dk_blk, dv_blk), dqb = jax.lax.scan(
+                q_body, (zero_kd, zero_kd),
+                (jnp.arange(n_q), qb, gb, lseb, Db, dqb))
+            return dqb, (dk_blk, dv_blk)
+
+        dqb0 = jnp.zeros((n_q, b, h, bq, d), f32)
+        dqb, (dkb, dvb) = jax.lax.scan(k_body, dqb0,
+                                       (jnp.arange(n_k), kb, vb))
+        dq = dqb.transpose(1, 2, 0, 3, 4).reshape(b, h, n_q * bq, d)
+        dq = dq[:, :, :lq]
     dk = dkb.transpose(1, 2, 0, 3, 4).reshape(b, h, n_k * bk, d)[:, :, :lk]
     dv = dvb.transpose(1, 2, 0, 3, 4).reshape(b, h, n_k * bk, d)[:, :, :lk]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)

@@ -438,12 +438,19 @@ def test_scaling_bench_fixed_work_builders():
     from benchmark.scaling_bench import build_sp_ring, build_tp_mlp
 
     jstep1, a1 = build_tp_mlp(1)
-    loss1 = float(jstep1(*a1)[0])
+    loss1, g1_ref, g2_ref = jstep1(*a1)
     jstep2, a2 = build_tp_mlp(2)
     loss2, g1, g2 = jstep2(*a2)
-    assert onp.isfinite(loss1) and abs(loss1 - float(loss2)) < 1e-5 * (
-        1 + abs(loss1))
+    assert onp.isfinite(float(loss1)) and \
+        abs(float(loss1) - float(loss2)) < 1e-5 * (1 + abs(float(loss1)))
     assert g1.shape == (512, 2048) and g2.shape == (2048, 512)
+    # the sharded GRADIENTS must match n=1 too (a mis-specified psum
+    # transpose — the classic TP bug — keeps loss parity but scales
+    # gradients by the axis size)
+    onp.testing.assert_allclose(onp.asarray(g1), onp.asarray(g1_ref),
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(g2), onp.asarray(g2_ref),
+                                rtol=1e-5, atol=1e-6)
 
     jfwd1, q1 = build_sp_ring(1)
     s1 = float(jfwd1(*q1))
