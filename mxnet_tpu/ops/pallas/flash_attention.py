@@ -25,6 +25,19 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+def _matmul_precision(dtype):
+    """One policy for every kernel matmul, fwd and bwd: bf16 runs at
+    native MXU precision (HIGHEST on bf16 is a Mosaic reject; f32
+    accumulation comes from preferred_element_type); f32 follows the
+    ambient jax_default_matmul_precision (docs/precision.md)."""
+    if dtype == jnp.bfloat16:
+        return jax.lax.Precision.DEFAULT
+    amb = jax.config.jax_default_matmul_precision
+    return {"highest": jax.lax.Precision.HIGHEST,
+            "high": jax.lax.Precision.HIGH}.get(amb,
+                                                jax.lax.Precision.DEFAULT)
+
+
 def _mha_reference(q, k, v, causal: bool, sm_scale: float):
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
@@ -130,18 +143,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     kp = kp.reshape(b * h, n_k * bk, d).swapaxes(1, 2)  # (bh, d, Lk)
     vp = vp.reshape(b * h, n_k * bk, d)
 
-    # bf16 always runs at native MXU precision (a HIGHEST stamp on bf16
-    # matmuls is a Mosaic reject; f32 accumulate comes from
-    # preferred_element_type). f32 follows the ambient policy
-    # (docs/precision.md): HIGHEST only when the session asks for exact
-    # fp32 (oracle tests pin it via conftest), one-pass default otherwise.
-    if q.dtype == jnp.bfloat16:
-        precision = jax.lax.Precision.DEFAULT
-    else:
-        amb = jax.config.jax_default_matmul_precision
-        precision = {"highest": jax.lax.Precision.HIGHEST,
-                     "high": jax.lax.Precision.HIGH}.get(
-                         amb, jax.lax.Precision.DEFAULT)
+    precision = _matmul_precision(q.dtype)
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
         block_k=bk, seq_q=lq, seq_k=lk, n_k=n_k, precision=precision)
@@ -199,6 +201,242 @@ def _causal_block_mask(q_pos, k_pos, causal, seq_q, seq_k):
     return mask  # (lq, bk)
 
 
+def _bwd_dq_kernel(q_ref, kT_ref, k_ref, vT_ref, g_ref, o_ref, lse_ref,
+                   dq_ref, d_scr, dq_scr, *, sm_scale, causal, block_q,
+                   block_k, seq_q, seq_k, n_k, precision):
+    """dQ = sum_k ds @ K with everything transient in VMEM. Grid
+    (bh, q_blocks, k_blocks): K innermost, so dq/D scratch persist across
+    the K sweep of one Q block (the forward kernel's accumulator shape)."""
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    f32 = jnp.float32
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+        # D = rowsum(dO * O): recomputed here from the blocks already
+        # resident instead of shipping another lane-128 residual
+        g = g_ref[0].astype(f32)
+        o = o_ref[0].astype(f32)
+        d_scr[:] = jnp.broadcast_to(
+            jnp.sum(g * o, axis=-1, keepdims=True), d_scr.shape)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + (block_q - 1) + (seq_k - seq_q)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        s = jax.lax.dot_general(
+            q, kT_ref[0], (((1,), (0,)), ((), ())), precision=precision,
+            preferred_element_type=f32) * f32(sm_scale)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = (k_pos < seq_k) & (q_pos < seq_q)
+        if causal:
+            mask &= k_pos <= q_pos + (seq_k - seq_q)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, :1]), f32(0.0))
+        dp = jax.lax.dot_general(
+            g_ref[0], vT_ref[0], (((1,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=f32)
+        ds = p * (dp - d_scr[:, :1]) * f32(sm_scale)
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=f32)
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, g_ref, qT_ref, gT_ref, oT_ref,
+                    lseT_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale,
+                    causal, block_q, block_k, seq_q, seq_k, n_q, precision):
+    """dK = sum_q ds^T @ Q, dV = sum_q p^T @ dO. Grid (bh, k_blocks,
+    q_blocks): Q innermost, so dk/dv scratch persist across the Q sweep
+    of one K block. Scores are computed transposed (K rows, Q lanes) so
+    every contraction is a plain [1]x[0] — no Mosaic transposed-operand
+    patterns."""
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    f32 = jnp.float32
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + (block_q - 1) + (seq_k - seq_q)
+
+    @pl.when(run)
+    def _compute():
+        k = k_ref[0]
+        sT = jax.lax.dot_general(
+            k, qT_ref[0], (((1,), (0,)), ((), ())), precision=precision,
+            preferred_element_type=f32) * f32(sm_scale)      # (bk, bq)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        maskT = (k_pos < seq_k) & (q_pos < seq_q)
+        if causal:
+            maskT &= k_pos <= q_pos + (seq_k - seq_q)
+        lse_row = lseT_ref[0][:1, :]                          # (1, bq)
+        pT = jnp.where(maskT, jnp.exp(sT - lse_row), f32(0.0))
+        dv_scr[:] += jax.lax.dot_general(
+            pT.astype(k.dtype), g_ref[0], (((1,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=f32)
+        dpT = jax.lax.dot_general(
+            v_ref[0], gT_ref[0], (((1,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=f32)  # (bk, bq)
+        gT = gT_ref[0].astype(f32)
+        oT = oT_ref[0].astype(f32)
+        d_row = jnp.sum(gT * oT, axis=0, keepdims=True)       # (1, bq)
+        dsT = pT * (dpT - d_row) * f32(sm_scale)
+        dk_scr[:] += jax.lax.dot_general(
+            dsT.astype(k.dtype), q_ref[0], (((1,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=f32)
+
+    @pl.when(qi == n_q - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q,
+                      block_k, interpret):
+    """Pallas flash backward: dq/dk/dv with all score-sized transients in
+    VMEM. The scan fallback below keeps correctness everywhere; this
+    path removes its dominant cost — every (Lq, bk) s/p/dp/ds tensor
+    round-tripping HBM between XLA matmuls."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    n_q = -(-lq // bq)
+    n_k = -(-lk // bk)
+    pad_q = n_q * bq - lq
+    pad_k = n_k * bk - lk
+
+    def padq(a):
+        return jnp.pad(a, ((0, 0), (0, 0), (0, pad_q), (0, 0))) \
+            if pad_q else a
+
+    def padk(a):
+        return jnp.pad(a, ((0, 0), (0, 0), (0, pad_k), (0, 0))) \
+            if pad_k else a
+
+    bh = b * h
+    qp = padq(q).reshape(bh, n_q * bq, d)
+    gp = padq(g).reshape(bh, n_q * bq, d)
+    op = padq(out).reshape(bh, n_q * bq, d)
+    kp = padk(k).reshape(bh, n_k * bk, d)
+    vp = padk(v).reshape(bh, n_k * bk, d)
+    kT = kp.swapaxes(1, 2)
+    vT = vp.swapaxes(1, 2)
+    qT = qp.swapaxes(1, 2)
+    gT = gp.swapaxes(1, 2)
+    oT = op.swapaxes(1, 2)
+    # lane-128 lse for the dq kernel (the official kernel's residual
+    # layout); padded q rows get +1e30 so p = exp(s - 1e30) = 0. The dkv
+    # kernel reads lse along LANES, so its copy only needs the minimum 8
+    # sublanes — not a second full 128-wide broadcast.
+    lse_p = jnp.pad(lse.reshape(bh, lq), ((0, 0), (0, pad_q)),
+                    constant_values=-_NEG_INF) if pad_q \
+        else lse.reshape(bh, lq)
+    lse128 = jnp.broadcast_to(lse_p[:, :, None], (bh, n_q * bq, 128))
+    lseT = jnp.broadcast_to(lse_p[:, None, :], (bh, 8, n_q * bq))
+
+    precision = _matmul_precision(q.dtype)
+
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=bq,
+                  block_k=bk, seq_q=lq, seq_k=lk, precision=precision)
+    qspec = pl.BlockSpec((1, bq, d), lambda g0, a, b_: (g0, a, jnp.int32(0)))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda g0, a, b_: (g0, b_, jnp.int32(0)))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, n_k=n_k, **common),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            qspec,                                                   # q
+            pl.BlockSpec((1, d, bk), lambda g0, a, b_: (g0, jnp.int32(0), b_)),  # kT
+            kspec2,                                                  # k
+            pl.BlockSpec((1, d, bk), lambda g0, a, b_: (g0, jnp.int32(0), b_)),  # vT
+            qspec,                                                   # g
+            qspec,                                                   # o
+            pl.BlockSpec((1, bq, 128), lambda g0, a, b_: (g0, a, jnp.int32(0))),  # lse
+        ],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, n_q * bq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kT, kp, vT, gp, op, lse128)
+
+    kspec = pl.BlockSpec((1, bk, d), lambda g0, a, b_: (g0, a, jnp.int32(0)))
+    qspec2 = pl.BlockSpec((1, bq, d), lambda g0, a, b_: (g0, b_, jnp.int32(0)))
+    tspec2 = pl.BlockSpec((1, d, bq), lambda g0, a, b_: (g0, jnp.int32(0), b_))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, n_q=n_q, **common),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            kspec,                                                   # k
+            kspec,                                                   # v
+            qspec2,                                                  # q
+            qspec2,                                                  # g
+            tspec2,                                                  # qT
+            tspec2,                                                  # gT
+            tspec2,                                                  # oT
+            pl.BlockSpec((1, 8, bq), lambda g0, a, b_: (g0, jnp.int32(0), b_)),  # lseT
+        ],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, n_k * bk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, n_k * bk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(kp, vp, qp, gp, qT, gT, oT, lseT)
+
+    dq = dq.reshape(b, h, n_q * bq, d)[:, :, :lq]
+    dk = dk.reshape(b, h, n_k * bk, d)[:, :, :lk]
+    dv = dv.reshape(b, h, n_k * bk, d)[:, :, :lk]
+    return dq, dk, dv
+
+
+_BWD_PALLAS_STATE: dict = {}
+
+
+def _bwd_pallas_ok(d, dtype, causal):
+    """Probe once PER SIGNATURE (head_dim, dtype, causal): Mosaic
+    accepts or rejects based on block shapes/dtype alignment, so a d=64
+    probe must not green-light a d=80 workload. Any reject falls back to
+    the XLA-scan backward for that signature."""
+    key = (int(d), jnp.dtype(dtype).name, bool(causal))
+    if key not in _BWD_PALLAS_STATE:
+        try:
+            qkv = jnp.zeros((1, 1, 256, d), dtype)
+            lse = jnp.zeros((1, 1, 256), jnp.float32)
+            jax.block_until_ready(jax.jit(
+                lambda a, s: _flash_bwd_pallas(
+                    a, a, a, a, s, a, causal, 0.125, 128, 128, False)
+            )(qkv, lse))
+            _BWD_PALLAS_STATE[key] = True
+        except Exception:  # noqa: BLE001 — Mosaic reject / old pallas
+            _BWD_PALLAS_STATE[key] = False
+    return _BWD_PALLAS_STATE[key]
+
+
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     """Flash backward: ONE blockwise pass over K computing dQ/dK/dV, never
     materializing more than one (Lq, block_k) score block.
@@ -212,6 +450,16 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
+    # compiled Pallas backward on TPU (probe-gated: scan fallback keeps
+    # every backend correct). Interpret mode stays on the scan path —
+    # the Pallas interpreter's python grid loop is for the dedicated
+    # kernel unit tests, not every CPU-test backward.
+    if not interpret and jax.default_backend() == "tpu" \
+            and _bwd_pallas_ok(d, q.dtype, causal):
+        dq, dk, dv = _flash_bwd_pallas(
+            q, k, v, out, lse, g, causal, sm_scale,
+            min(block_q, 128), min(block_k, 128), False)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
     # the XLA-scan backward gets no launch-overhead win from big K blocks
     # (that argument is the Pallas forward grid's); it only pays their
     # memory — s/p/dp/ds transients scale with bk. Cap at 128 regardless
