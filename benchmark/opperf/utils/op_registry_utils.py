@@ -195,6 +195,15 @@ def _special_rules() -> Dict[str, Callable]:
                                          [[0, 1, 1, 20, 20]] * 8,
                                          onp.float32))), {
             "pooled_size": (7, 7), "spatial_scale": 0.5}, False),
+        # C must equal output_dim * group_size^2 (2 * 7^2 = 98)
+        "npx.psroi_pooling": lambda: ((t((4, 98, 32, 32)),
+                                       mx.np.array(onp.array(
+                                           [[0, 1, 1, 20, 20]] * 8,
+                                           onp.float32))), {
+            "output_dim": 2, "pooled_size": 7, "spatial_scale": 0.5,
+            "group_size": 7}, False),
+        "npx.bilinear_resize_2d": lambda: ((t(nchw),), {
+            "height": 48, "width": 48}, False),
         "npx.box_iou": lambda: ((t((64, 4)), t((64, 4))), {}, False),
         "npx.box_nms": lambda: (
             (mx.np.array(onp.concatenate([

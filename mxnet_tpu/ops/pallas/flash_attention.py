@@ -417,20 +417,25 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q,
 _BWD_PALLAS_STATE: dict = {}
 
 
-def _bwd_pallas_ok(d, dtype, causal):
-    """Probe once PER SIGNATURE (head_dim, dtype, causal): Mosaic
-    accepts or rejects based on block shapes/dtype alignment, so a d=64
-    probe must not green-light a d=80 workload. Any reject falls back to
-    the XLA-scan backward for that signature."""
-    key = (int(d), jnp.dtype(dtype).name, bool(causal))
+def _bwd_pallas_ok(d, dtype, causal, lq, lk, bq, bk):
+    """Probe once PER SIGNATURE — with the REAL sequence geometry, so
+    the probe compiles exactly the block shapes, padding and grid the
+    real call will (Mosaic accepts or rejects based on block shapes and
+    dtype alignment; a d=64/L=256 probe must not green-light a d=80 or
+    ragged-length workload). Any reject falls back to the XLA-scan
+    backward for that signature. Training shapes are static, so this is
+    one tiny b=h=1 compile per distinct shape."""
+    key = (int(d), jnp.dtype(dtype).name, bool(causal),
+           int(lq), int(lk), int(bq), int(bk))
     if key not in _BWD_PALLAS_STATE:
         try:
-            qkv = jnp.zeros((1, 1, 256, d), dtype)
-            lse = jnp.zeros((1, 1, 256), jnp.float32)
+            q = jnp.zeros((1, 1, lq, d), dtype)
+            kv = jnp.zeros((1, 1, lk, d), dtype)
+            lse = jnp.zeros((1, 1, lq), jnp.float32)
             jax.block_until_ready(jax.jit(
-                lambda a, s: _flash_bwd_pallas(
-                    a, a, a, a, s, a, causal, 0.125, 128, 128, False)
-            )(qkv, lse))
+                lambda q_, kv_, s: _flash_bwd_pallas(
+                    q_, kv_, kv_, q_, s, q_, causal, 0.125, bq, bk, False)
+            )(q, kv, lse))
             _BWD_PALLAS_STATE[key] = True
         except Exception:  # noqa: BLE001 — Mosaic reject / old pallas
             _BWD_PALLAS_STATE[key] = False
@@ -454,12 +459,16 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     # every backend correct). Interpret mode stays on the scan path —
     # the Pallas interpreter's python grid loop is for the dedicated
     # kernel unit tests, not every CPU-test backward.
+    pbq, pbk = min(block_q, 128, lq), min(block_k, 128, lk)
     if not interpret and jax.default_backend() == "tpu" \
-            and _bwd_pallas_ok(d, q.dtype, causal):
-        dq, dk, dv = _flash_bwd_pallas(
-            q, k, v, out, lse, g, causal, sm_scale,
-            min(block_q, 128), min(block_k, 128), False)
-        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+            and _bwd_pallas_ok(d, q.dtype, causal, lq, lk, pbq, pbk):
+        try:
+            dq, dk, dv = _flash_bwd_pallas(
+                q, k, v, out, lse, g, causal, sm_scale, pbq, pbk, False)
+            return (dq.astype(q.dtype), dk.astype(k.dtype),
+                    dv.astype(v.dtype))
+        except Exception:  # noqa: BLE001 — trace-time surprise: scan path
+            pass
     # the XLA-scan backward gets no launch-overhead win from big K blocks
     # (that argument is the Pallas forward grid's); it only pays their
     # memory — s/p/dp/ds transients scale with bk. Cap at 128 regardless
