@@ -291,6 +291,13 @@ def main():
         "flops_per_step": step_flops,
         "flops_source": src,
     }
+    try:
+        from mxnet_tpu.ops.pallas.flash_attention import bwd_pallas_report
+        probes = bwd_pallas_report()
+        if probes:
+            rec["flash_bwd_pallas_probes"] = probes
+    except Exception:  # noqa: BLE001 — provenance only
+        pass
     if decode_tok_s:
         rec["decode_tok_s"] = round(decode_tok_s, 1)
         rec["decode_batch"] = DB

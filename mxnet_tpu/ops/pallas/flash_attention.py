@@ -415,6 +415,19 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q,
 
 
 _BWD_PALLAS_STATE: dict = {}
+_BWD_PALLAS_FALLBACKS = {"count": 0}
+
+
+def bwd_pallas_report():
+    """JSON-ready provenance for benchmarks: per-signature probe
+    outcomes (True = compiled Pallas backward enabled, False = scan
+    fallback), plus how many real backward traces fell back DESPITE a
+    green probe (trace-time surprises) — a green probe alone does not
+    prove the compiled path ran."""
+    rep = {str(k): v for k, v in _BWD_PALLAS_STATE.items()}
+    if _BWD_PALLAS_FALLBACKS["count"]:
+        rep["trace_time_fallbacks"] = _BWD_PALLAS_FALLBACKS["count"]
+    return rep
 
 
 def _bwd_pallas_ok(d, dtype, causal, lq, lk, bq, bk):
@@ -468,7 +481,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
             return (dq.astype(q.dtype), dk.astype(k.dtype),
                     dv.astype(v.dtype))
         except Exception:  # noqa: BLE001 — trace-time surprise: scan path
-            pass
+            _BWD_PALLAS_FALLBACKS["count"] += 1
     # the XLA-scan backward gets no launch-overhead win from big K blocks
     # (that argument is the Pallas forward grid's); it only pays their
     # memory — s/p/dp/ds transients scale with bk. Cap at 128 regardless
