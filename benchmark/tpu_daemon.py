@@ -63,7 +63,9 @@ PROFILE = os.path.join(HERE, "results_profile_tpu.json")
 TRAIN256 = os.path.join(HERE, "results_train_tpu_bs256.json")
 TRAIN_IO = os.path.join(HERE, "results_train_io_tpu.json")
 
-PROBE_INTERVAL_S = 180       # while the tunnel is down
+PROBE_INTERVAL_S = 60        # while the tunnel is down (windows can be
+                             # ~4 min total; a slow probe cadence misses
+                             # them entirely)
 REFRESH_INTERVAL_S = 3600    # after a full successful suite
 STALE_AFTER_S = 24 * 3600    # banked headline older than this always loses
 HEADLINE_REFRESH_S = 3600    # re-hunt a better headline hourly once fresh
@@ -230,11 +232,14 @@ def bank_if_tpu(path: str, rec, rc: int, label: str) -> bool:
     return False
 
 
-def tpu_alive(timeout_s: int = 90) -> bool:
+def tpu_alive(timeout_s: int = 60) -> bool:
     """Quick dead-tunnel probe: a child that just inits the backend.
     Run between captures so a tunnel that died mid-pass doesn't make
     every remaining capture burn its full per-child watchdog budget
-    (observed: train_bench spinning ~50 min against a dead tunnel)."""
+    (observed: train_bench spinning ~50 min against a dead tunnel).
+    60s timeout: live-tunnel init is ~0.1-10s (observed), and a slow
+    cold init misclassified as dead only costs one PROBE_INTERVAL_S
+    sleep — the next probe hits a warmer init."""
     code = ("import jax, sys; "
             "sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)")
     try:
@@ -690,9 +695,8 @@ def acquire_pidfile() -> bool:
 
 
 def headline_needs() -> bool:
-    """Missing, mfu-less, or neither captured nor best-of-checked within
-    the hourly refresh (keep hunting a better number, but never hot-loop
-    a 'kept' verdict)."""
+    """TOP priority only when the headline is genuinely missing: no
+    banked record, mfu-less, or older than the 24h staleness bar."""
     try:
         with open(HEADLINE) as f:
             b = json.load(f)
@@ -700,8 +704,16 @@ def headline_needs() -> bool:
             return True
     except Exception:  # noqa: BLE001
         return True
-    return record_age(HEADLINE, "captured_unix",
-                      "last_checked_unix") > HEADLINE_REFRESH_S
+    return record_age(HEADLINE, "captured_unix") > STALE_AFTER_S
+
+
+def headline_rehunt_needs() -> bool:
+    """LOW priority best-of re-hunt: a fresh headline exists but is
+    >1h since last captured/checked — try for a better number only
+    after the round's missing rows are banked."""
+    return not headline_needs() and record_age(
+        HEADLINE, "captured_unix",
+        "last_checked_unix") > HEADLINE_REFRESH_S
 
 
 def opperf_needs() -> bool:
@@ -745,6 +757,9 @@ CAPTURES = (
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION), capture_attention),
     ("hbm", banked_stale(HBM), capture_hbm),
+    # dead last, matching its docstring: re-hunting a better headline
+    # must never starve a genuinely missing artifact of a short window
+    ("headline-rehunt", headline_rehunt_needs, capture_headline),
 )
 
 
