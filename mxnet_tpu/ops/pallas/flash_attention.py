@@ -472,16 +472,29 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     # every backend correct). Interpret mode stays on the scan path —
     # the Pallas interpreter's python grid loop is for the dedicated
     # kernel unit tests, not every CPU-test backward.
-    pbq, pbk = min(block_q, 128, lq), min(block_k, 128, lk)
-    if not interpret and jax.default_backend() == "tpu" \
-            and _bwd_pallas_ok(d, q.dtype, causal, lq, lk, pbq, pbk):
-        try:
-            dq, dk, dv = _flash_bwd_pallas(
-                q, k, v, out, lse, g, causal, sm_scale, pbq, pbk, False)
-            return (dq.astype(q.dtype), dk.astype(k.dtype),
-                    dv.astype(v.dtype))
-        except Exception:  # noqa: BLE001 — trace-time surprise: scan path
-            _BWD_PALLAS_FALLBACKS["count"] += 1
+    if not interpret and jax.default_backend() == "tpu":
+        # prefer fatter blocks (fewer grid programs, more arithmetic per
+        # MXU visit), capped by the caller's block args so explicit
+        # block_q/block_k still bound the backward kernel too; the
+        # per-signature probe decides what Mosaic takes
+        cands = []
+        for cap in (256, 128):
+            c = (min(block_q, cap, lq), min(block_k, cap, lk))
+            if c not in cands:
+                cands.append(c)
+        for pbq, pbk in cands:
+            if not _bwd_pallas_ok(d, q.dtype, causal, lq, lk, pbq, pbk):
+                continue
+            try:
+                dq, dk, dv = _flash_bwd_pallas(
+                    q, k, v, out, lse, g, causal, sm_scale, pbq, pbk,
+                    False)
+                return (dq.astype(q.dtype), dk.astype(k.dtype),
+                        dv.astype(v.dtype))
+            except Exception:  # noqa: BLE001 — trace-time surprise:
+                # count it and try the next (smaller) candidate before
+                # surrendering to the scan path
+                _BWD_PALLAS_FALLBACKS["count"] += 1
     # the XLA-scan backward gets no launch-overhead win from big K blocks
     # (that argument is the Pallas forward grid's); it only pays their
     # memory — s/p/dp/ds transients scale with bk. Cap at 128 regardless
