@@ -95,6 +95,11 @@ def _declare(lib: ctypes.CDLL) -> None:
         lib.MXTImagePipelineNext.argtypes = [
             p, u8p, ctypes.POINTER(ctypes.c_float)]
         lib.MXTImagePipelineReset.argtypes = [p]
+        if hasattr(lib, "MXTImagePipelineSetAugment"):
+            # absent from .so files built before decode-time augmentation
+            # existed — the rest of the pipeline must keep working
+            lib.MXTImagePipelineSetAugment.argtypes = [
+                p, ctypes.c_int, ctypes.c_int, ctypes.c_float, u64]
         lib.MXTImagePipelineError.restype = ctypes.c_char_p
         lib.MXTImagePipelineError.argtypes = [p]
         lib.MXTImagePipelineBadCount.restype = ctypes.c_long

@@ -72,7 +72,9 @@ class NativeImagePipeline:
     need static shapes drop or pad it)."""
 
     def __init__(self, path_imgrec: str, data_shape: Tuple[int, int, int],
-                 batch_size: int, n_threads: int = 2, label_width: int = 1):
+                 batch_size: int, n_threads: int = 2, label_width: int = 1,
+                 rand_crop: bool = False, rand_mirror: bool = False,
+                 min_area: float = 0.08, seed: int = 0):
         if len(data_shape) != 3 or data_shape[0] != 3:
             raise MXNetError("data_shape must be (3, H, W)")
         if not native_available():
@@ -88,6 +90,23 @@ class NativeImagePipeline:
             n_threads, label_width)
         if not self._handle:
             raise MXNetError(f"cannot open {path_imgrec}")
+        if rand_crop or rand_mirror:
+            if not 0.0 < float(min_area) <= 1.0:
+                self.close()
+                raise MXNetError(
+                    f"min_area must be in (0, 1], got {min_area}")
+            if not hasattr(self._lib, "MXTImagePipelineSetAugment"):
+                self.close()
+                raise MXNetError(
+                    "this libmxtpu_io.so predates decode-time "
+                    "augmentation — rebuild it (cd src && make)")
+            # decode-time training augmentation in the C++ workers
+            # (reference ImageRecordIter rand_crop/rand_mirror):
+            # Inception-style random resized crop + horizontal flip,
+            # deterministic per (seed, running sample index)
+            self._lib.MXTImagePipelineSetAugment(
+                self._handle, int(bool(rand_crop)), int(bool(rand_mirror)),
+                float(min_area), int(seed))
         self._data = onp.empty((batch_size, self.h, self.w, 3), onp.uint8)
         self._label = onp.empty((batch_size, label_width), onp.float32)
         self._bad_reported = 0

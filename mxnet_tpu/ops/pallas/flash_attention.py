@@ -482,6 +482,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
             c = (min(block_q, cap, lq), min(block_k, cap, lk))
             if c not in cands:
                 cands.append(c)
+        raised = False
         for pbq, pbk in cands:
             if not _bwd_pallas_ok(d, q.dtype, causal, lq, lk, pbq, pbk):
                 continue
@@ -492,9 +493,13 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
                 return (dq.astype(q.dtype), dk.astype(k.dtype),
                         dv.astype(v.dtype))
             except Exception:  # noqa: BLE001 — trace-time surprise:
-                # count it and try the next (smaller) candidate before
-                # surrendering to the scan path
-                _BWD_PALLAS_FALLBACKS["count"] += 1
+                # try the next (smaller) candidate before surrendering
+                raised = True
+        if raised:
+            # count TRACES that reached the scan path despite a green
+            # probe — not per-candidate misses (provenance contract of
+            # bwd_pallas_report)
+            _BWD_PALLAS_FALLBACKS["count"] += 1
     # the XLA-scan backward gets no launch-overhead win from big K blocks
     # (that argument is the Pallas forward grid's); it only pays their
     # memory — s/p/dp/ds transients scale with bk. Cap at 128 regardless

@@ -17,12 +17,14 @@
 #include <jpeglib.h>
 
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
 #include <csetjmp>
 #include <cstdint>
 #include <cstring>
 #include <functional>
 #include <mutex>
+#include <random>
 #include <string>
 #include <thread>
 #include <vector>
@@ -121,12 +123,104 @@ void resize_bilinear(const uint8_t* src, int sh, int sw, uint8_t* dst,
   }
 }
 
+// bilinear resize of a WINDOW (y0,x0,ch,cw) of src into dst, with
+// optional horizontal mirror folded into the x mapping (zero extra
+// cost) — the augmented sibling of resize_bilinear
+void resize_window(const uint8_t* src, int sw, int y0, int x0, int ch,
+                   int cw, bool mirror, uint8_t* dst, int dh, int dw) {
+  const float ry = dh > 1 ? static_cast<float>(ch - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? static_cast<float>(cw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    const float fy = y0 + y * ry;
+    const int iy0 = static_cast<int>(fy);
+    const int iy1 = iy0 + 1 < y0 + ch ? iy0 + 1 : iy0;
+    const float wy = fy - iy0;
+    const uint8_t* r0 = src + static_cast<size_t>(iy0) * sw * 3;
+    const uint8_t* r1 = src + static_cast<size_t>(iy1) * sw * 3;
+    uint8_t* drow = dst + static_cast<size_t>(y) * dw * 3;
+    for (int x = 0; x < dw; ++x) {
+      const int xm = mirror ? dw - 1 - x : x;
+      const float fx = x0 + xm * rx;
+      const int ix0 = static_cast<int>(fx);
+      const int ix1 = ix0 + 1 < x0 + cw ? ix0 + 1 : ix0;
+      const float wx = fx - ix0;
+      for (int c = 0; c < 3; ++c) {
+        const float top = r0[ix0 * 3 + c] * (1 - wx) + r0[ix1 * 3 + c] * wx;
+        const float bot = r1[ix0 * 3 + c] * (1 - wx) + r1[ix1 * 3 + c] * wx;
+        drow[x * 3 + c] =
+            static_cast<uint8_t>(top * (1 - wy) + bot * wy + 0.5f);
+      }
+    }
+  }
+}
+
+// decode-time training augmentation (reference iter_image_recordio_2's
+// per-worker DefaultImageAugmenter roles): Inception-style random
+// resized crop + horizontal mirror, all before the resize so augmented
+// decode costs the same as plain decode.
+struct AugmentParams {
+  bool rand_crop = false;
+  bool rand_mirror = false;
+  float min_area = 0.08f;
+  uint64_t seed = 0;
+};
+
 bool decode_one(const uint8_t* buf, size_t len, int th, int tw,
-                uint8_t* out /* th*tw*3 */) {
+                uint8_t* out /* th*tw*3 */, const AugmentParams* aug,
+                uint64_t sample_idx) {
   std::vector<uint8_t> px;
   int h = 0, w = 0;
-  if (!decode_jpeg(buf, len, th, tw, &px, &h, &w)) return false;
-  resize_bilinear(px.data(), h, w, out, th, tw);
+  // with random crop enabled the decode must keep enough resolution
+  // that the SMALLEST crop window still covers the target: a min_area
+  // crop of a dct-downscaled-to-target frame would be upscaled mush
+  // (the reference crops at full resolution)
+  int dec_th = th, dec_tw = tw;
+  if (aug != nullptr && aug->rand_crop) {
+    const float s = 1.f / std::sqrt(aug->min_area);
+    dec_th = static_cast<int>(th * s + 0.999f);
+    dec_tw = static_cast<int>(tw * s + 0.999f);
+  }
+  if (!decode_jpeg(buf, len, dec_th, dec_tw, &px, &h, &w)) return false;
+  bool mirror = false;
+  int y0 = 0, x0 = 0, ch = h, cw = w;
+  if (aug != nullptr && (aug->rand_crop || aug->rand_mirror)) {
+    // splitmix-seeded per-sample rng: deterministic given (seed, idx),
+    // independent of thread scheduling
+    std::mt19937_64 rng(aug->seed * 0x9E3779B97F4A7C15ull + sample_idx + 1);
+    if (aug->rand_mirror) {
+      mirror = (rng() & 1) != 0;
+    }
+    if (aug->rand_crop) {
+      std::uniform_real_distribution<float> u01(0.f, 1.f);
+      const float area = static_cast<float>(h) * w;
+      for (int attempt = 0; attempt < 10; ++attempt) {
+        const float frac =
+            aug->min_area + (1.f - aug->min_area) * u01(rng);
+        // log-uniform aspect in [3/4, 4/3] (reference RandomSizedCrop)
+        const float log_r = std::log(4.f / 3.f);
+        const float aspect = std::exp((2 * u01(rng) - 1) * log_r);
+        const int cw_try = static_cast<int>(
+            std::sqrt(frac * area * aspect) + 0.5f);
+        const int ch_try = static_cast<int>(
+            std::sqrt(frac * area / aspect) + 0.5f);
+        if (cw_try <= w && ch_try <= h && cw_try > 0 && ch_try > 0) {
+          cw = cw_try;
+          ch = ch_try;
+          y0 = static_cast<int>(u01(rng) * (h - ch + 1));
+          x0 = static_cast<int>(u01(rng) * (w - cw + 1));
+          if (y0 > h - ch) y0 = h - ch;
+          if (x0 > w - cw) x0 = w - cw;
+          break;
+        }
+        // 10 misses => keep the full frame (reference fallback)
+      }
+    }
+  }
+  if (!mirror && y0 == 0 && x0 == 0 && ch == h && cw == w) {
+    resize_bilinear(px.data(), h, w, out, th, tw);
+  } else {
+    resize_window(px.data(), w, y0, x0, ch, cw, mirror, out, th, tw);
+  }
   return true;
 }
 
@@ -163,7 +257,7 @@ int MXTDecodeJpegBatch(const char** bufs, const uint64_t* lens, int n,
   parallel_for(n, n_threads, [&](int i) {
     uint8_t* slot = out + static_cast<size_t>(i) * th * tw * 3;
     if (decode_one(reinterpret_cast<const uint8_t*>(bufs[i]), lens[i], th,
-                   tw, slot)) {
+                   tw, slot, nullptr, 0)) {
       ok.fetch_add(1);
     } else {
       std::memset(slot, 0, static_cast<size_t>(th) * tw * 3);
@@ -191,6 +285,9 @@ struct ImagePipeline {
   bool eof = false;
   std::string error;
   std::atomic<long> bad_decodes{0};
+  AugmentParams aug;
+  bool augment = false;
+  uint64_t next_sample_idx = 0;  // only touched under the decode call
 
   // read-ahead: one pending raw batch produced by the reader thread
   std::vector<RawRec> ready;
@@ -336,10 +433,13 @@ int MXTImagePipelineNext(void* handle, uint8_t* data, float* labels) {
   p->cv.notify_all();
   if (cur.empty()) return p->error.empty() ? 0 : -1;
   const int n = static_cast<int>(cur.size());
+  const uint64_t base_idx = p->next_sample_idx;
+  p->next_sample_idx += static_cast<uint64_t>(n);
+  const AugmentParams* aug = p->augment ? &p->aug : nullptr;
   parallel_for(n, p->n_threads, [&](int i) {
     uint8_t* slot = data + static_cast<size_t>(i) * p->th * p->tw * 3;
     if (!decode_one(cur[i].payload.data(), cur[i].payload.size(), p->th,
-                    p->tw, slot)) {
+                    p->tw, slot, aug, base_idx + i)) {
       // zero-fill keeps the batch shape but is NEVER silent: the count
       // is exported (MXTImagePipelineBadCount) and the Python wrapper
       // raises/warns on it
@@ -354,6 +454,20 @@ int MXTImagePipelineNext(void* handle, uint8_t* data, float* labels) {
     }
   });
   return n;
+}
+
+// Enable decode-time training augmentation (random resized crop +
+// horizontal mirror, the reference ImageRecordIter's rand_crop /
+// rand_mirror): deterministic per (seed, running sample index).
+void MXTImagePipelineSetAugment(void* handle, int rand_crop,
+                                int rand_mirror, float min_area,
+                                uint64_t seed) {
+  auto* p = static_cast<ImagePipeline*>(handle);
+  p->aug.rand_crop = rand_crop != 0;
+  p->aug.rand_mirror = rand_mirror != 0;
+  p->aug.min_area = min_area > 0.f && min_area <= 1.f ? min_area : 0.08f;
+  p->aug.seed = seed;
+  p->augment = p->aug.rand_crop || p->aug.rand_mirror;
 }
 
 void MXTImagePipelineReset(void* handle) {

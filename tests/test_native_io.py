@@ -349,6 +349,71 @@ class TestNativeImagePipeline:
         assert n1 == n2 == 11
         pipe.close()
 
+    def test_augment_deterministic_per_seed(self, jpeg_rec):
+        """Decode-time augmentation (rand crop + mirror in the C++
+        workers, reference ImageRecordIter rand_crop/rand_mirror):
+        same seed => identical epoch; different seed => different
+        pixels; augmented differs from plain resize."""
+        from mxnet_tpu.io import NativeImagePipeline, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+
+        def epoch(**kw):
+            pipe = NativeImagePipeline(jpeg_rec, (3, 32, 32),
+                                       batch_size=4, n_threads=2, **kw)
+            out = onp.concatenate([d.copy() for d, _ in pipe])
+            pipe.close()
+            return out
+
+        plain = epoch()
+        a1 = epoch(rand_crop=True, rand_mirror=True, seed=7)
+        a2 = epoch(rand_crop=True, rand_mirror=True, seed=7)
+        a3 = epoch(rand_crop=True, rand_mirror=True, seed=8)
+        onp.testing.assert_array_equal(a1, a2)
+        assert not onp.array_equal(a1, plain)
+        assert not onp.array_equal(a1, a3)
+
+    def test_augment_mirror_only_is_flip(self, jpeg_rec):
+        """With rand_mirror only, every sample is either the plain
+        resize or exactly its horizontal flip."""
+        from mxnet_tpu.io import NativeImagePipeline, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        plain = NativeImagePipeline(jpeg_rec, (3, 32, 32), batch_size=11)
+        base = onp.concatenate([d.copy() for d, _ in plain])
+        plain.close()
+        aug = NativeImagePipeline(jpeg_rec, (3, 32, 32), batch_size=11,
+                                  rand_mirror=True, seed=3)
+        got = onp.concatenate([d.copy() for d, _ in aug])
+        aug.close()
+        flipped = 0
+        for i in range(base.shape[0]):
+            if onp.array_equal(got[i], base[i]):
+                continue
+            onp.testing.assert_array_equal(got[i], base[i][:, ::-1])
+            flipped += 1
+        assert 0 < flipped < base.shape[0]  # both outcomes occurred
+
+    def test_augment_min_area_one_is_plain_resize(self, jpeg_rec):
+        """min_area=1.0 forces every crop attempt to the full frame
+        (aspect != 1 cannot fit), so rand_crop degenerates to the plain
+        resize — a deterministic equality check of the window-resize
+        path's full-frame case."""
+        from mxnet_tpu.io import NativeImagePipeline, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        plain = NativeImagePipeline(jpeg_rec, (3, 32, 32), batch_size=11)
+        base = onp.concatenate([d.copy() for d, _ in plain])
+        plain.close()
+        aug = NativeImagePipeline(jpeg_rec, (3, 32, 32), batch_size=11,
+                                  rand_crop=True, min_area=1.0, seed=5)
+        got = onp.concatenate([d.copy() for d, _ in aug])
+        aug.close()
+        onp.testing.assert_array_equal(got, base)
+
     def test_decode_jpeg_batch_matches_pil(self, jpeg_rec):
         from mxnet_tpu import recordio
         from mxnet_tpu.image import _to_np, imdecode
