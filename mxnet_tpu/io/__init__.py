@@ -171,11 +171,20 @@ class ImageRecordIter(DataIter):
     """Batched images from a RecordIO file (reference
     ``src/io/iter_image_recordio_2.cc:887 ImageRecordIter``): records are
     ``pack_img``-framed (IRHeader + image payload), streamed through the
-    native threaded prefetcher, decoded and batched host-side."""
+    native threaded prefetcher, decoded and batched host-side.
+
+    With ``rand_crop``/``rand_mirror`` (the reference's training
+    augmenters) or ``use_native=True``, decode + resize + augmentation
+    run in the C++ worker pool (``src/io/image_pipeline.cc``) exactly
+    like the reference's multithreaded decode loop; JPEG records are
+    decoded and resized to ``data_shape`` there, so records need not be
+    pre-shaped."""
 
     def __init__(self, path_imgrec, batch_size, data_shape,
                  label_width=1, shuffle_chunk=False, round_batch=True,
-                 prefetch_capacity=64, dtype="float32"):
+                 prefetch_capacity=64, dtype="float32",
+                 rand_crop=False, rand_mirror=False, min_area=0.08,
+                 seed=0, preprocess_threads=2, use_native=None):
         super().__init__(batch_size)
         self.path = path_imgrec
         self.data_shape = tuple(data_shape)
@@ -183,7 +192,26 @@ class ImageRecordIter(DataIter):
         self._round = round_batch
         self._dtype = dtype
         self._cap = prefetch_capacity
+        self._aug = dict(rand_crop=bool(rand_crop),
+                         rand_mirror=bool(rand_mirror),
+                         min_area=float(min_area), seed=int(seed))
+        self._threads = int(preprocess_threads)
+        from .native_pipeline import native_available
+        if use_native is None:
+            use_native = rand_crop or rand_mirror
+        elif not use_native and (rand_crop or rand_mirror):
+            raise MXNetError(
+                "rand_crop/rand_mirror run in the native C++ pipeline; "
+                "use_native=False would silently skip the requested "
+                "augmentation")
+        if use_native and not native_available():
+            raise MXNetError(
+                "ImageRecordIter augmentation/decode runs in the native "
+                "C++ pipeline, which is unavailable (libmxtpu_io.so "
+                "without jpeg support) — build it with `cd src && make`")
+        self._use_native = bool(use_native)
         self._reader = None
+        self._native = None
         self.reset()
 
     @property
@@ -197,37 +225,68 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape, "float32")]
 
     def reset(self):
-        if self._reader is not None:
-            self._reader.close()
-        self._reader = ThreadedRecordReader(self.path, capacity=self._cap)
+        if self._use_native:
+            if self._native is None:
+                from .native_pipeline import NativeImagePipeline
+                self._native = NativeImagePipeline(
+                    self.path, self.data_shape, self.batch_size,
+                    n_threads=self._threads, label_width=self.label_width,
+                    **self._aug)
+            else:
+                # REUSE the handle: the C++ pipeline's running sample
+                # index deliberately continues across resets, so each
+                # epoch draws fresh augmentations while staying
+                # deterministic from (seed, global sample index) — and
+                # the file/worker pool are not re-created per epoch
+                self._native.reset()
+        else:
+            if self._reader is not None:
+                self._reader.close()
+            self._reader = ThreadedRecordReader(self.path,
+                                                capacity=self._cap)
 
     def next(self) -> DataBatch:
-        imgs, labels = [], []
         pad = 0
-        for _ in range(self.batch_size):
-            rec = next(self._reader, None)
-            if rec is None:
-                break
-            header, img = unpack_img(rec)
-            if img.shape != self.data_shape:
-                if img.ndim == 3 and (img.shape[2],) + img.shape[:2] == self.data_shape:
-                    img = img.transpose(2, 0, 1)  # HWC -> CHW
-                else:
-                    raise MXNetError(
-                        f"record image shape {img.shape} incompatible with "
-                        f"data_shape {self.data_shape}")
-            imgs.append(onp.asarray(img, dtype=self._dtype))
-            labels.append(onp.asarray(header.label, dtype=onp.float32))
-        if not imgs:
-            raise StopIteration
-        while len(imgs) < self.batch_size:
-            if not self._round:
-                break
-            pad += 1
-            imgs.append(imgs[-1])
-            labels.append(labels[-1])
-        data = mxnp.array(onp.stack(imgs))
-        lab = onp.stack(labels)
+        if self._native is not None:
+            data_u8, lab_w = next(self._native)  # StopIteration = epoch end
+            # uint8 HWC -> dtype CHW in ONE vectorized copy
+            # (normalization stays on-device)
+            data_np = data_u8.transpose(0, 3, 1, 2).astype(self._dtype)
+            lab = onp.asarray(lab_w, dtype=onp.float32)
+            n = data_np.shape[0]
+            if n < self.batch_size and self._round:
+                pad = self.batch_size - n
+                data_np = onp.concatenate(
+                    [data_np] + [data_np[-1:]] * pad)
+                lab = onp.concatenate([lab] + [lab[-1:]] * pad)
+        else:
+            imgs, labels = [], []
+            for _ in range(self.batch_size):
+                rec = next(self._reader, None)
+                if rec is None:
+                    break
+                header, img = unpack_img(rec)
+                if img.shape != self.data_shape:
+                    if img.ndim == 3 and \
+                            (img.shape[2],) + img.shape[:2] == self.data_shape:
+                        img = img.transpose(2, 0, 1)  # HWC -> CHW
+                    else:
+                        raise MXNetError(
+                            f"record image shape {img.shape} incompatible "
+                            f"with data_shape {self.data_shape}")
+                imgs.append(onp.asarray(img, dtype=self._dtype))
+                labels.append(onp.asarray(header.label, dtype=onp.float32))
+            if not imgs:
+                raise StopIteration
+            while len(imgs) < self.batch_size:
+                if not self._round:
+                    break
+                pad += 1
+                imgs.append(imgs[-1])
+                labels.append(labels[-1])
+            data_np = onp.stack(imgs)
+            lab = onp.stack(labels)
+        data = mxnp.array(data_np)
         if lab.ndim > 1 and lab.shape[1] == 1:
             lab = lab[:, 0]  # label_width=1 stored as (N,1)
         label = mxnp.array(lab)

@@ -471,6 +471,10 @@ void MXTImagePipelineSetAugment(void* handle, int rand_crop,
 }
 
 void MXTImagePipelineReset(void* handle) {
+  // NOTE: next_sample_idx is deliberately NOT reset — the augmentation
+  // stream continues across epochs, so a reused pipeline draws fresh
+  // crops/flips every epoch while staying deterministic from
+  // (seed, global sample index). ImageRecordIter.reset() relies on this.
   auto* p = static_cast<ImagePipeline*>(handle);
   std::unique_lock<std::mutex> lk(p->mu);
   // a want is always pending after Create/Next: once the reader fulfils

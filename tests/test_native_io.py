@@ -181,6 +181,65 @@ def test_image_record_iter(tmp_path):
     assert len(list(it)) == 3
 
 
+def test_image_record_iter_native_augment(tmp_path):
+    """ImageRecordIter with rand_crop/rand_mirror routes decode+augment
+    through the C++ pipeline (the reference's multithreaded decode loop
+    semantics): jpeg records are decoded+resized there, augmentation is
+    deterministic per seed, and round_batch padding still applies."""
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.io import native_available
+
+    if not native_available():
+        pytest.skip("native lib unavailable")
+    path = str(tmp_path / "imgs.rec")
+    rng = onp.random.RandomState(1)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(10):
+        img = rng.randint(0, 255, size=(40, 56, 3)).astype(onp.uint8)
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95))
+    w.close()
+
+    def epoch(**kw):
+        it = mio.ImageRecordIter(path, batch_size=4, data_shape=(3, 16, 16),
+                                 **kw)
+        out = onp.concatenate([b.data[0].asnumpy() for b in it])
+        return out
+
+    # native decode+resize without augmentation: records are NOT
+    # pre-shaped (40x56 -> 16x16), which the python path cannot do
+    base = epoch(use_native=True)
+    assert base.shape == (12, 3, 16, 16)  # 10 rounded to 3 batches of 4
+    a1 = epoch(rand_crop=True, rand_mirror=True, seed=11)
+    a2 = epoch(rand_crop=True, rand_mirror=True, seed=11)
+    onp.testing.assert_array_equal(a1, a2)
+    assert not onp.array_equal(a1, base)
+    # reset() must draw FRESH augmentations (the C++ sample counter
+    # continues across epochs) — not replay epoch 1
+    it = mio.ImageRecordIter(path, batch_size=4, data_shape=(3, 16, 16),
+                             rand_crop=True, rand_mirror=True, seed=11)
+    e1 = onp.concatenate([b.data[0].asnumpy() for b in it])
+    it.reset()
+    e2 = onp.concatenate([b.data[0].asnumpy() for b in it])
+    onp.testing.assert_array_equal(e1, a1)  # epoch 1 is reproducible
+    assert not onp.array_equal(e1, e2)      # epoch 2 is different
+    # explicit use_native=False with augmentation must raise, not
+    # silently skip
+    with pytest.raises(Exception, match="use_native"):
+        mio.ImageRecordIter(path, batch_size=4, data_shape=(3, 16, 16),
+                            rand_crop=True, use_native=False)
+    # requesting augmentation must not silently fall back
+    import mxnet_tpu.io.native_pipeline as npl
+    real = npl.native_available
+    try:
+        npl.native_available = lambda: False
+        with pytest.raises(Exception, match="native"):
+            mio.ImageRecordIter(path, batch_size=4, data_shape=(3, 16, 16),
+                                rand_mirror=True)
+    finally:
+        npl.native_available = real
+
+
 def test_prefetching_iter_matches(tmp_path):
     from mxnet_tpu import io as mio
 
