@@ -178,7 +178,9 @@ class ImageRecordIter(DataIter):
     run in the C++ worker pool (``src/io/image_pipeline.cc``) exactly
     like the reference's multithreaded decode loop; JPEG records are
     decoded and resized to ``data_shape`` there, so records need not be
-    pre-shaped."""
+    pre-shaped. On the native path ``prefetch_capacity`` is ignored —
+    the C++ pipeline uses its own fixed one-batch read-ahead (decode,
+    not record IO, is the bottleneck it overlaps)."""
 
     def __init__(self, path_imgrec, batch_size, data_shape,
                  label_width=1, shuffle_chunk=False, round_batch=True,
@@ -245,14 +247,32 @@ class ImageRecordIter(DataIter):
             self._reader = ThreadedRecordReader(self.path,
                                                 capacity=self._cap)
 
+    def close(self):
+        """Release the native pipeline / reader thread deterministically
+        (GC timing is not a resource-management policy)."""
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
     def next(self) -> DataBatch:
         pad = 0
         if self._native is not None:
-            data_u8, lab_w = next(self._native)  # StopIteration = epoch end
+            # next_view: the astype below is the ONE copy on this path
+            data_u8, lab_w = self._native.next_view()  # StopIteration=end
             # uint8 HWC -> dtype CHW in ONE vectorized copy
             # (normalization stays on-device)
             data_np = data_u8.transpose(0, 3, 1, 2).astype(self._dtype)
-            lab = onp.asarray(lab_w, dtype=onp.float32)
+            # lab_w is a view of the pipeline's reused buffer: copy
+            lab = onp.array(lab_w, dtype=onp.float32)
             n = data_np.shape[0]
             if n < self.batch_size and self._round:
                 pad = self.batch_size - n

@@ -115,6 +115,15 @@ class NativeImagePipeline:
         return self
 
     def __next__(self):
+        data, label = self.next_view()
+        return data.copy(), label.copy()
+
+    def next_view(self):
+        """Like ``__next__`` but returns VIEWS of the internal decode
+        buffers — valid only until the next ``next_view``/``__next__``/
+        ``reset`` call. For callers that immediately convert (e.g.
+        ImageRecordIter's HWC->CHW dtype cast), this skips one
+        full-batch copy on the ingestion hot path."""
         n = self._lib.MXTImagePipelineNext(
             self._handle,
             self._data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -135,7 +144,7 @@ class NativeImagePipeline:
                 f"native pipeline: {bad - self._bad_reported} corrupt "
                 "JPEG record(s) decoded as zero images", stacklevel=2)
             self._bad_reported = bad
-        return self._data[:n].copy(), self._label[:n].copy()
+        return self._data[:n], self._label[:n]
 
     @property
     def bad_decodes(self) -> int:
