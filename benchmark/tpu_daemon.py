@@ -741,12 +741,18 @@ def banked_stale(path: str, max_age: float = STALE_AFTER_S):
 # artifact costs the window nothing.
 CAPTURES = (
     ("headline", headline_needs, capture_headline),
-    ("quant-micro", quant_micro_needs, capture_quant_micro),
-    ("train-table", lambda: bool(stale_combos(TRAIN, TRAIN_COMBOS)),
-     capture_train),
+    # the three VERDICT-target MFU rows lead: a short window must not be
+    # spent on the train table's tail combos before these are banked
+    ("train-resnet-bf16",
+     lambda: bool(stale_combos(TRAIN, TRAIN_COMBOS[:1])),
+     lambda: capture_model_table(TRAIN, TRAIN_COMBOS[:1],
+                                 "train headline row")),
     ("train-bs256", banked_stale(TRAIN256, 4 * 3600),
      capture_train_bs256),
+    ("quant-micro", quant_micro_needs, capture_quant_micro),
     ("llm", banked_stale(LLM, 4 * 3600), capture_llm),
+    ("train-table", lambda: bool(stale_combos(TRAIN, TRAIN_COMBOS)),
+     capture_train),
     ("profile", banked_stale(PROFILE), capture_profile),
     ("train-io", banked_stale(TRAIN_IO), capture_train_io),
     ("parity", banked_stale(PARITY), capture_parity),
