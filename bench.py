@@ -66,6 +66,23 @@ def log(*a):
     print("[bench]", *a, file=sys.stderr, flush=True)
 
 
+def code_rev() -> str:
+    """Short git HEAD of the repo at measurement time. Banked rows carry
+    this (VERDICT r4 item #10) so 'which code produced this number' is a
+    field, not an archaeology exercise."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=here,
+            capture_output=True, text=True, timeout=10).stdout.strip() or "?"
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=no"],
+            cwd=here, capture_output=True, text=True, timeout=10).stdout
+        return rev + ("+dirty" if dirty.strip() else "")
+    except Exception:  # noqa: BLE001 — provenance must never kill a bench
+        return "?"
+
+
 def jaxpr_flops(fn, *args) -> float:
     """Model FLOPs of one call by walking the jaxpr: 2*MACs over every
     dot_general and conv_general_dilated (the MFU convention — matmul/
@@ -286,6 +303,7 @@ def child(platform: str, batch: int = 32) -> None:
         "bf16_iters": bf16_iters,
         "fp32_iters": fp32_iters,
         "fp32_matmul_precision": fp32_prec,
+        "code_rev": code_rev(),
     }
     try:  # batch-matched published rows (shared table) override the
         from benchmark.baselines import attach_headline_ratios  # bs32 ones
@@ -389,6 +407,20 @@ def serve_cached() -> bool:
             return False
         rec = dict(rec)
         rec["cache_age_hours"] = round(age_s / 3600.0, 2)
+        # provenance contract (VERDICT r4 item #10): a served record must
+        # state that it is cached AND which code produced it vs which code
+        # is at HEAD now, so "does this capture postdate the fixes" is
+        # answerable from the artifact alone
+        rec["served"] = "cached"
+        rec.setdefault("code_rev", "unknown (capture predates code_rev "
+                                   "stamping, i.e. round <=4 code)")
+        rec["head_code_rev"] = code_rev()
+        # a '+dirty' or '?' rev identifies no unique code state — equality
+        # of two such strings proves nothing, so the answer is null
+        vague = any("+dirty" in str(r) or str(r).startswith(("?", "unknown"))
+                    for r in (rec.get("code_rev"), rec["head_code_rev"]))
+        rec["capture_at_head"] = (
+            None if vague else rec.get("code_rev") == rec["head_code_rev"])
         # preserve the record's own provenance note; only annotate that
         # it is being served from the cache
         rec["served_from_cache"] = (
@@ -425,6 +457,7 @@ def main() -> None:
                 sys.stderr.write(proc.stderr[-4000:])
                 rec = parse_json_output(proc.stdout)
                 if rec is not None and rec.get("value", 0) > 0:
+                    rec["served"] = "live"
                     print(json.dumps(rec), flush=True)
                     return
                 last_err = (

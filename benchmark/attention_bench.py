@@ -130,7 +130,9 @@ def main():
         probes = bwd_pallas_report()
     except Exception:  # noqa: BLE001
         probes = {}
+    from bench import code_rev
     out = {"device": jax.devices()[0].platform,
+           "code_rev": code_rev(),
            "device_kind": jax.devices()[0].device_kind,
            # which signatures the compiled Pallas backward was enabled
            # for (see bwd_pallas_report docstring); empty = non-TPU

@@ -38,7 +38,7 @@ import numpy as onp
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-from bench import jaxpr_flops, peak_bf16_tflops  # noqa: E402
+from bench import code_rev, jaxpr_flops, peak_bf16_tflops  # noqa: E402
 
 
 def log(*a):
@@ -290,6 +290,7 @@ def main():
         "device_kind": dev_kind,
         "flops_per_step": step_flops,
         "flops_source": src,
+        "code_rev": code_rev(),  # stamped at measurement time, child-side
     }
     try:
         from mxnet_tpu.ops.pallas.flash_attention import bwd_pallas_report

@@ -341,7 +341,9 @@ def main():
     devs = jax.devices()
     up.set()
     log("devices:", devs)
+    from bench import code_rev
     rec = {"device": devs[0].platform,
+           "code_rev": code_rev(),
            "device_kind": getattr(devs[0], "device_kind", ""),
            "protocol": "ablation deltas; serial-chain scalar-fetch barrier",
            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}

@@ -27,6 +27,8 @@ import numpy as onp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import code_rev  # noqa: E402 — measurement-time provenance stamp
+
 
 def _micro_mxu_probe(jax, jnp, log):
     """Decisive evidence for the int8 story (VERDICT r4 item #3): a
@@ -150,7 +152,7 @@ def main():
         # the decisive int8-MXU verdict without the model build/calib —
         # sized for a short tunnel window (the full e2e needs ~15 min)
         micro = _micro_mxu_probe(jax, jnp, log)
-        rec = {"device": jax.devices()[0].platform,
+        rec = {"device": jax.devices()[0].platform, "code_rev": code_rev(),
                "micro_only": True, "micro_mxu": micro}
         print(json.dumps(rec, indent=2))
         return
@@ -219,6 +221,7 @@ def main():
         "batch": args.batch,
         "calib_mode": args.calib_mode,
         "device": jax.devices()[0].platform,
+        "code_rev": code_rev(),
         "int8_img_s": round(int8_img_s, 2),
         "fp32_img_s": round(fp32_img_s, 2),
         "bf16_img_s": round(bf16_img_s, 2),

@@ -48,7 +48,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 sys.path.insert(0, ROOT)
 from bench import CACHED_RESULT as HEADLINE  # noqa: E402 — single writer/reader path
-from bench import live_lock, parse_json_output  # noqa: E402 — shared child-output protocol
+from bench import code_rev, live_lock, parse_json_output  # noqa: E402 — shared child-output protocol
 PIDFILE = os.path.join(HERE, ".tpu_daemon.pid")
 TRAIN = os.path.join(HERE, "results_train_tpu.json")
 OPPERF = os.path.join(HERE, "opperf", "results_tpu.json")
@@ -220,11 +220,14 @@ def capture_headline() -> str:
 
 
 def bank_if_tpu(path: str, rec, rc: int, label: str) -> bool:
-    """Shared banking tail: stamp + atomic-write a TPU-device record."""
+    """Shared banking tail: stamp + atomic-write a TPU-device record.
+    Every bank carries ``code_rev`` (VERDICT r4 item #10): the git HEAD
+    (+dirty marker) the measurement child actually ran under."""
     if rec and rec.get("device") == "tpu":
         rec["captured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         rec["captured_unix"] = time.time()
+        rec.setdefault("code_rev", code_rev())
         atomic_write(path, rec)
         log(f"banked {label} -> {path}")
         return True
@@ -262,9 +265,13 @@ def merge_model_table(path: str, rec, key_fields=("model", "precision")):
     if not (rec and rec.get("device") == "tpu"):
         return rec
     now = time.time()
+    rev = code_rev()
     for r in rec.get("results", []):
         if "error" not in r:
             r["captured_unix"] = now
+            # the measuring child stamps itself (train_bench); this is the
+            # fallback for rows from children that predate child stamping
+            r.setdefault("code_rev", rev)
     try:
         with open(path) as f:
             banked = json.load(f)
@@ -313,19 +320,81 @@ def stale_combos(path: str, combos, key_fields=("model", "precision")):
             if age.get(tuple(c), float("inf")) > STALE_AFTER_S]
 
 
+STATE_PATH = os.path.join(HERE, ".tpu_daemon_state.json")
+BACKOFF_AFTER_FAILS = 2      # consecutive live-tunnel failures before cooloff
+BACKOFF_COOL_S = 6 * 3600    # cooloff before the combo gets another try
+
+
+class combo_backoff:
+    """Persistent per-combo consecutive-failure tracker (ADVICE r4: a
+    combo that always exceeds the train_bench timeout — e.g. bert_base
+    train — must not burn its full child budget at the head of every
+    short tunnel window). Failures only count when the tunnel was alive
+    after the child died: a tunnel flap is never the combo's fault."""
+
+    @staticmethod
+    def _load() -> dict:
+        try:
+            with open(STATE_PATH) as f:
+                st = json.load(f)
+            return st if isinstance(st, dict) else {}
+        except Exception:  # noqa: BLE001
+            return {}
+
+    @staticmethod
+    def _save(st: dict) -> None:
+        try:
+            atomic_write(STATE_PATH, st)
+        except Exception:  # noqa: BLE001 — state is an optimization only
+            pass
+
+    @staticmethod
+    def skip(key: str) -> bool:
+        ent = combo_backoff._load().get(key) or {}
+        return (ent.get("fails", 0) >= BACKOFF_AFTER_FAILS
+                and time.time() - ent.get("last_fail_unix", 0)
+                < BACKOFF_COOL_S)
+
+    @staticmethod
+    def failure(key: str) -> int:
+        st = combo_backoff._load()
+        ent = st.setdefault(key, {})
+        ent["fails"] = ent.get("fails", 0) + 1
+        ent["last_fail_unix"] = time.time()
+        combo_backoff._save(st)
+        return ent["fails"]
+
+    @staticmethod
+    def success(key: str) -> None:
+        st = combo_backoff._load()
+        if st.pop(key, None) is not None:
+            combo_backoff._save(st)
+
+
 def capture_model_table(path: str, combos, label: str,
                         extra_args=()) -> None:
     """Per-combo capture loop: ONE train_bench child per (model,
     precision), merge-banked immediately, with a dead-tunnel check
     between combos — sized so a ~4-minute tunnel window still banks at
-    least one row, and a mid-loop death costs at most one child."""
-    for name, prec in stale_combos(path, combos):
+    least one row, and a mid-loop death costs at most one child.
+    Combos that keep failing on a live tunnel go into a cooloff
+    (combo_backoff) so they stop starving later combos of the window."""
+    alive_hint = None  # failure-attribution probe result, reused by the
+    for name, prec in stale_combos(path, combos):  # next loop-head check
+        # keyed on the TABLE, not the capture label: "train headline row"
+        # and "train table" are the same workload and must share one
+        # failure count/cooloff
+        key = f"{os.path.basename(path)}:{name}:{prec}"
+        if combo_backoff.skip(key):
+            log(f"{label}: {name}/{prec} in failure cooloff; skipping")
+            continue
         if live_lock.held_by_live_process():
             log(f"{label}: live bench arrived; stopping combo loop")
             return
-        if not tpu_alive():
+        if alive_hint is not True and not tpu_alive():
             log(f"{label}: tunnel down; stopping combo loop")
             return
+        alive_hint = None
         rc, out = run_child(
             [sys.executable, os.path.join(HERE, "train_bench.py"),
              "--models", name, "--precisions", prec, "--batch", "32",
@@ -333,8 +402,28 @@ def capture_model_table(path: str, combos, label: str,
             timeout=340)
         if rc is YIELDED:
             return
-        rec = merge_model_table(path, parse_json_output(out))
+        fresh = parse_json_output(out)
+        combo_ok = bool(
+            fresh and fresh.get("device") == "tpu"
+            and any(r.get("model") == name and r.get("precision") == prec
+                    and "error" not in r
+                    for r in fresh.get("results", [])))
+        if not combo_ok:
+            alive_hint = tpu_alive()
+            if alive_hint:
+                fails = combo_backoff.failure(key)
+                log(f"{label}: {name}/{prec} failed on a live tunnel "
+                    f"({fails} consecutive)")
+            else:
+                log(f"{label}: {name}/{prec} child died with the tunnel; "
+                    "not counting against the combo")
+        else:
+            combo_backoff.success(key)
+        rec = merge_model_table(path, fresh)
         bank_if_tpu(path, rec, rc, f"{label} {name}/{prec}")
+        if alive_hint is False:
+            log(f"{label}: tunnel down; stopping combo loop")
+            return
 
 
 def capture_train() -> None:
@@ -520,6 +609,7 @@ def capture_quant_micro() -> None:
     banked["micro_captured_at"] = time.strftime(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     banked["micro_captured_unix"] = time.time()
+    banked["micro_code_rev"] = code_rev()
     atomic_write(QUANT, banked)
     log(f"banked quant micro -> {QUANT}: "
         f"{json.dumps(rec['micro_mxu'])}")
@@ -569,7 +659,10 @@ def capture_profile() -> None:
 def capture_train_bs256() -> None:
     """ResNet-50 bf16 train at bs256 — the MFU-optimal batch next to the
     bs32 baseline-contract row (VERDICT r4 item #1 targets mfu>=0.35)."""
-    rec = None
+    if combo_backoff.skip("train-bs256"):
+        log("train bs256: in failure cooloff; skipping")
+        return
+    rec, succeeded, tunnel_died = None, False, False
     for batch in ("256", "128"):  # bs256 train may not fit 16G HBM
         rc, out = run_child(
             [sys.executable, os.path.join(HERE, "train_bench.py"),
@@ -581,14 +674,29 @@ def capture_train_bs256() -> None:
         rec = parse_json_output(out)
         if rec and rec.get("device") == "tpu" and \
                 all("error" not in r for r in rec.get("results", [])):
+            succeeded = True
+            combo_backoff.success("train-bs256")
             break
         if not tpu_alive():
+            tunnel_died = True
             log("train bs256: tunnel died; not trying smaller batch")
             break
-    if rec and rec.get("device") == "tpu" and \
-            all("error" in r for r in rec.get("results", []) or [{}]):
-        log("train bs256: every batch errored; keeping banked record")
-        return
+    if not succeeded:
+        # failure attribution covers BOTH shapes: error rows AND a child
+        # timeout (rec=None) — a persistently-timing-out bs256 train is
+        # exactly the case the cooloff exists for; a tunnel flap is
+        # never the combo's fault
+        if tunnel_died:
+            log("train bs256: child died with the tunnel; "
+                "not counting against the combo")
+        else:
+            fails = combo_backoff.failure("train-bs256")
+            log(f"train bs256: failed on a live tunnel "
+                f"({fails} consecutive); keeping banked record")
+        if not (rec and rec.get("device") == "tpu"
+                and any("error" not in r
+                        for r in rec.get("results", []) or [])):
+            return  # nothing bankable
     # best-of within freshness (headline policy): this row exists to
     # show peak MFU, so a throttled-tunnel capture must not displace a
     # better fresh one

@@ -368,6 +368,10 @@ def child_main(name, batch, prec, cpu, infer=False, recordio_input=False):
     rec["matmul_precision"] = fp32_prec if prec == "fp32" else "bf16-native"
     rec["device"] = devs[0].platform
     rec["device_kind"] = devs[0].device_kind
+    # provenance stamped by the MEASURING child at measurement time (a
+    # daemon-side stamp could misattribute if a commit lands mid-child)
+    from bench import code_rev
+    rec["code_rev"] = code_rev()
     print(json.dumps(rec), flush=True)
 
 
