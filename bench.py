@@ -187,6 +187,12 @@ def child(platform: str, batch: int = 32) -> None:
     x_np = onp.random.uniform(size=(batch, 3, 224, 224)).astype(onp.float32)
     fn, params = net.functionalize(mx.np.array(x_np), training=False)
 
+    # serially-chained steps per launch (see step_k). The CPU fallback
+    # stays at 1: there is no tunnel to amortize there, and XLA:CPU
+    # compiles the scanned ResNet body ~5x slower (observed 466s vs
+    # ~90s), which would eat the fallback's whole timeout budget.
+    SCAN_STEPS = 1 if platform == "cpu" else 16
+
     def measure(params, x_host, dtype, want_flops=True):
         """Throughput of a serially-chained forward at the given dtype."""
 
@@ -198,7 +204,23 @@ def child(platform: str, batch: int = 32) -> None:
             perturb = jnp.tanh(jnp.mean(logits)) * 1e-6
             return logits, x * (1.0 + perturb).astype(x.dtype)
 
-        jstep = jax.jit(step)
+        def step_k(params, x):
+            # the chain, run SCAN_STEPS at a time inside ONE executable:
+            # per-launch dispatch over the axon tunnel costs ~4-5 ms —
+            # several times the bs32 forward itself — so one-launch-per-
+            # step measured mostly the tunnel, not the chip (the 0.26-0.30
+            # infer MFU of rounds 3-4). Math and serial dependency are
+            # unchanged: each forward feeds the next input, and the
+            # returned last chained sum cannot exist until every step ran.
+            def body(cx, _):
+                logits, nx = step(params, cx)
+                return nx, jnp.sum(logits.astype(jnp.float32))
+            x, sums = jax.lax.scan(body, x, None, length=SCAN_STEPS)
+            return sums[-1], x
+
+        # plain per-launch step at SCAN_STEPS=1 (scan length 1 would still
+        # pay the scanned body's compile cost for nothing)
+        jstep = jax.jit(step if SCAN_STEPS == 1 else step_k)
         x = jnp.asarray(x_host, dtype)
         t0 = time.time()
         out0, xw = jstep(params, x)
@@ -213,27 +235,32 @@ def child(platform: str, batch: int = 32) -> None:
         float(jnp.sum(out0))
         log(f"{dtype.__name__}: compiled + warm in {time.time() - t0:.1f}s")
 
-        # calibrate pass size from one step (the timing includes a host
-        # round-trip, so it overestimates per-step cost — fine for sizing),
-        # then accumulate passes until >=5s of steady-state has elapsed so
-        # a single fetch round-trip can't dominate the window
+        # calibrate pass size from one launch (the timing includes a host
+        # round-trip, so it overestimates per-launch cost — fine for
+        # sizing), then accumulate passes until >=5s of steady-state has
+        # elapsed so a single fetch round-trip can't dominate the window
         t0 = time.perf_counter()
         out, x = jstep(params, x)
         float(jnp.sum(out))
-        per_iter = max(time.perf_counter() - t0, 1e-4)
-        pass_iters = max(10, min(200, int(10.0 / per_iter)))
+        per_launch = max(time.perf_counter() - t0, 1e-4)
+        # floor: at least ~8 chained steps per pass so a pass is never a
+        # 2-sample measurement, whatever SCAN_STEPS is
+        pass_iters = max(-(-8 // SCAN_STEPS),
+                         min(200, int(10.0 / per_launch)))
+        max_launches = max(1, 3000 // SCAN_STEPS)
 
-        total_iters, total_dt = 0, 0.0
-        while total_dt < 5.0 and total_iters < 3000:
+        total_launches, total_dt = 0, 0.0
+        while total_dt < 5.0 and total_launches < max_launches:
             t0 = time.perf_counter()
             for _ in range(pass_iters):
                 out, x = jstep(params, x)
             float(jnp.sum(out))  # forces the full serial chain per pass
             total_dt += time.perf_counter() - t0
-            total_iters += pass_iters
+            total_launches += pass_iters
+        total_iters = total_launches * SCAN_STEPS
         img_s = batch * total_iters / total_dt
-        log(f"{dtype.__name__}: {img_s:.1f} img/s over {total_iters} iters "
-            f"({total_dt:.1f}s)")
+        log(f"{dtype.__name__}: {img_s:.1f} img/s over {total_iters} steps "
+            f"({total_launches} launches, {total_dt:.1f}s)")
 
         # XLA's FLOP count for one step — basis for the MFU field. Runs
         # AFTER the timed loop: .lower().compile() does not share the jit
@@ -244,21 +271,27 @@ def child(platform: str, batch: int = 32) -> None:
         step_flops = None
         if not want_flops:
             return img_s, total_iters, step_flops
-        try:
-            lowered = jstep.lower(params, x)
+        if SCAN_STEPS == 1:
+            # cost_analysis is only consulted for the unscanned step:
+            # XLA counts a lax.scan (while-loop) body ONCE, not per trip
+            # (verified empirically), so no fixed division can make the
+            # scanned number a per-step count across backends
             try:
-                ca = lowered.cost_analysis()  # no backend compile
-            except Exception:  # noqa: BLE001
-                ca = lowered.compile().cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0]
-            if ca and ca.get("flops"):
-                step_flops = float(ca["flops"])
-        except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
-            log(f"cost_analysis unavailable: {e!r}")
+                lowered = jstep.lower(params, x)
+                try:
+                    ca = lowered.cost_analysis()  # no backend compile
+                except Exception:  # noqa: BLE001
+                    ca = lowered.compile().cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0]
+                if ca and ca.get("flops"):
+                    step_flops = float(ca["flops"])
+            except Exception as e:  # noqa: BLE001 — best-effort
+                log(f"cost_analysis unavailable: {e!r}")
         if not step_flops:
             # axon's remote-compile cost_analysis can come back empty —
             # fall back to counting matmul/conv MACs from the jaxpr
+            # (``step`` is the single forward, so this is per-step already)
             try:
                 step_flops = jaxpr_flops(step, params, x)
                 log(f"flops via jaxpr walk: {step_flops/1e9:.2f} GF/step")
@@ -302,6 +335,7 @@ def child(platform: str, batch: int = 32) -> None:
         "device_kind": getattr(devs[0], "device_kind", ""),
         "bf16_iters": bf16_iters,
         "fp32_iters": fp32_iters,
+        "steps_per_launch": SCAN_STEPS,  # lax.scan serial chain per launch
         "fp32_matmul_precision": fp32_prec,
         "code_rev": code_rev(),
     }

@@ -110,16 +110,38 @@ def main():
         try:
             f_sps = measure(fwd, qkv, f"L={L} fwd", log)
             t_sps = measure(train, qkv, f"L={L} fwd+bwd", log)
+            # FLOPs convention (stated in-record, ADVICE r4): achieved
+            # numbers use ALGORITHMIC FA2 accounting — fwd 2 matmul
+            # units, bwd 5 (s recomputed once) = 3.5x fwd — the standard
+            # flash-attention reporting basis, comparable across
+            # implementations. The two-kernel Pallas backward EXECUTES
+            # more: dq and dkv each recompute s and dO-derived terms
+            # (~9 units incl fwd = 4.5x); executed_est reports that
+            # when the per-signature probe says the Pallas backward is
+            # what actually ran.
+            pallas_bwd_ran = False
+            try:
+                from mxnet_tpu.ops.pallas.flash_attention import \
+                    bwd_pallas_enabled_for
+                pallas_bwd_ran = bwd_pallas_enabled_for(
+                    B, H, D, dt, True, L, L)
+            except Exception:  # noqa: BLE001
+                pass
+            exec_factor = 4.5 if pallas_bwd_ran else 3.5
             rec = {"seq_len": L, "batch": B, "heads": H, "head_dim": D,
                    "dtype": args.dtype,
                    "fwd_tok_s": round(f_sps * B * L, 1),
                    "train_tok_s": round(t_sps * B * L, 1),
                    "fwd_achieved_tflops": round(f_sps * fwd_flops / 1e12, 2),
-                   # fwd (2 matmul units) + bwd (s recompute + dv/dp/dq/
-                   # dk = 5 units; the lse residual is saved by the fwd
-                   # now, so no second recompute pass) = 3.5x fwd_flops
                    "train_achieved_tflops": round(
-                       t_sps * 3.5 * fwd_flops / 1e12, 2)}
+                       t_sps * 3.5 * fwd_flops / 1e12, 2),
+                   "flops_accounting": "algorithmic FA2 (fwd 2 units, "
+                                       "bwd 5, recompute counted once = "
+                                       "3.5x fwd)",
+                   "train_bwd_kernel": ("pallas dq+dkv"
+                                        if pallas_bwd_ran else "xla-scan"),
+                   "train_executed_tflops_est": round(
+                       t_sps * exec_factor * fwd_flops / 1e12, 2)}
             log(rec)
             results.append(rec)
         except Exception as e:  # noqa: BLE001 — one OOM length shouldn't kill the run

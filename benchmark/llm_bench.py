@@ -191,7 +191,25 @@ def main():
             new_p[k] = p[k] - lr * v2
         return loss, new_p, new_v
 
-    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    # K serially-chained steps per launch (lax.scan over the params/
+    # velocity carry; round-5 launch-amortization protocol, see
+    # train_bench.build_step): at bs8-32 a step is ~80-300 ms and a
+    # launch over the axon tunnel costs ~4-5 ms, so K=4 trims a 2-6%
+    # tax without changing the math or the OOM-probe granularity.
+    SCAN_STEPS = 1 if platform == "cpu" else 4
+    if SCAN_STEPS > 1:
+        def train_step_k(p, vel, x, key):
+            def body(carry, _):
+                cp, cv = carry
+                loss, cp, cv = train_step(cp, cv, x, key)
+                return (cp, cv), loss
+            (p, vel), losses = jax.lax.scan(
+                body, (p, vel), None, length=SCAN_STEPS)
+            return losses[-1], p, vel
+
+        jstep = jax.jit(train_step_k, donate_argnums=(0, 1))
+    else:
+        jstep = jax.jit(train_step, donate_argnums=(0, 1))
     key = jax.random.PRNGKey(0)
 
     # release the ORIGINAL device weights before the OOM probe: decode is
@@ -242,6 +260,7 @@ def main():
             dt += time.perf_counter() - t0
             total += iters
         B, x = b, x_b
+        total *= SCAN_STEPS  # launches -> steps
         tok_s = B * L * total / dt
         log(f"train: {tok_s:.0f} tok/s over {total} steps ({dt:.1f}s)")
         break
@@ -253,21 +272,27 @@ def main():
     # 6*N*T analytic estimate (scaling-book rule; dense-only, no attn term)
     step_flops = None
     src = None
-    try:
-        # lower the SAME jit object as the timed loop so the fallback
-        # compile() path hits its executable cache instead of paying a
-        # second full XLA compilation
-        lowered = jstep.lower(params2, velocity2, x, key)
+    if SCAN_STEPS == 1:
+        # cost_analysis only for the unscanned step: XLA counts a
+        # lax.scan body ONCE, not per trip (verified empirically), so
+        # the scanned jstep's number is neither K steps' worth nor
+        # reliably one step's — the jaxpr walk below is the per-step
+        # authority on the scan path
         try:
-            ca = lowered.cost_analysis()
-        except Exception:  # noqa: BLE001
-            ca = lowered.compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        if ca and ca.get("flops"):
-            step_flops, src = float(ca["flops"]), "xla_cost_analysis"
-    except Exception as e:  # noqa: BLE001
-        log(f"cost_analysis unavailable: {e!r}")
+            # lower the SAME jit object as the timed loop so the fallback
+            # compile() path hits its executable cache instead of paying a
+            # second full XLA compilation
+            lowered = jstep.lower(params2, velocity2, x, key)
+            try:
+                ca = lowered.cost_analysis()
+            except Exception:  # noqa: BLE001
+                ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            if ca and ca.get("flops"):
+                step_flops, src = float(ca["flops"]), "xla_cost_analysis"
+        except Exception as e:  # noqa: BLE001
+            log(f"cost_analysis unavailable: {e!r}")
     if not step_flops:
         try:
             step_flops = jaxpr_flops(train_step, params2, velocity2, x, key)
@@ -286,6 +311,7 @@ def main():
         "unit": "tok/s",
         "params_m": round(n_params / 1e6, 1),
         "train_steps": total,
+        "steps_per_launch": SCAN_STEPS,
         "device": platform,
         "device_kind": dev_kind,
         "flops_per_step": step_flops,

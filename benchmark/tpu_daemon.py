@@ -132,13 +132,33 @@ def record_age(path: str, *fields: str) -> float:
         return float("inf")
 
 
-def run_child(cmd, timeout):
+# set by run_child(sample_liveness=True): did any mid-run probe see the
+# tunnel dead? Failure attribution reads this so a flap that RECOVERS
+# before the child dies (the dominant failure mode: the child hangs on
+# the dead tunnel and burns to timeout, then the post-mortem probe hits
+# the recovered tunnel) is never counted against the combo.
+_CHILD_FLAP = {"observed": False}
+
+
+PROBE_CODE = ("import jax, sys; "
+              "sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)")
+
+
+def run_child(cmd, timeout, sample_liveness=False):
     """Run a measurement child, yielding the chip to a live bench: if
     bench.py takes the live lock mid-capture, the child is terminated so
     the driver's run doesn't contend with ours (a daemon capture can be
     redone; a driver capture slot cannot). Returns (rc, stdout); rc is
     the YIELDED sentinel when the child was killed for a live bench
-    (proc.returncode itself can legitimately be -2 on SIGINT)."""
+    (proc.returncode itself can legitimately be -2 on SIGINT).
+    With sample_liveness, the tunnel is probed every ~90s while the
+    child runs — NON-blocking (a probe Popen polled from the 5s
+    supervision loop, so live-bench yield and the deadline check never
+    wait on a hung probe) and _CHILD_FLAP is only set after TWO
+    consecutive dead samples: a single probe timing out under host
+    contention with the measurement child must not exempt a genuine
+    live-tunnel failure from the combo backoff."""
+    _CHILD_FLAP["observed"] = False
     try:
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True, cwd=ROOT)
@@ -146,22 +166,60 @@ def run_child(cmd, timeout):
         log(f"spawn failed: {e!r}")
         return -1, ""
     deadline = time.time() + timeout
-    while True:
-        try:
-            out, err = proc.communicate(timeout=5)
-            sys.stderr.write(err[-3000:])
-            return proc.returncode, out
-        except subprocess.TimeoutExpired:
-            if live_lock.held_by_live_process():
-                log("live bench arrived; yielding the chip (killing child)")
-                proc.kill()
-                proc.communicate()
-                return YIELDED, ""
-            if time.time() > deadline:
-                log(f"timeout {timeout}s: {' '.join(cmd[:3])}...")
-                proc.kill()
-                proc.communicate()
-                return -1, ""
+    next_probe = time.time() + 90
+    probe = None          # (Popen, started_at) of the in-flight sample
+    dead_streak = 0
+
+    def finish_probe(alive: bool):
+        nonlocal probe, dead_streak, next_probe
+        dead_streak = 0 if alive else dead_streak + 1
+        if dead_streak >= 2 and not _CHILD_FLAP["observed"]:
+            _CHILD_FLAP["observed"] = True
+            log("mid-child liveness: tunnel DOWN twice in a row "
+                "(failure will not count against the combo)")
+        probe = None
+        next_probe = time.time() + 90
+
+    try:
+        while True:
+            try:
+                out, err = proc.communicate(timeout=5)
+                sys.stderr.write(err[-3000:])
+                return proc.returncode, out
+            except subprocess.TimeoutExpired:
+                if live_lock.held_by_live_process():
+                    log("live bench arrived; yielding the chip "
+                        "(killing child)")
+                    proc.kill()
+                    proc.communicate()
+                    return YIELDED, ""
+                if sample_liveness:
+                    now = time.time()
+                    if probe is None and now >= next_probe:
+                        try:
+                            probe = (subprocess.Popen(
+                                [sys.executable, "-c", PROBE_CODE],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL), now)
+                        except Exception:  # noqa: BLE001
+                            next_probe = now + 90
+                    elif probe is not None:
+                        rc2 = probe[0].poll()
+                        if rc2 is not None:
+                            finish_probe(rc2 == 0)
+                        elif now - probe[1] > 60:
+                            probe[0].kill()
+                            probe[0].wait()
+                            finish_probe(False)
+                if time.time() > deadline:
+                    log(f"timeout {timeout}s: {' '.join(cmd[:3])}...")
+                    proc.kill()
+                    proc.communicate()
+                    return -1, ""
+    finally:
+        if probe is not None:
+            probe[0].kill()
+            probe[0].wait()
 
 
 def capture_headline() -> str:
@@ -243,10 +301,8 @@ def tpu_alive(timeout_s: int = 60) -> bool:
     60s timeout: live-tunnel init is ~0.1-10s (observed), and a slow
     cold init misclassified as dead only costs one PROBE_INTERVAL_S
     sleep — the next probe hits a warmer init."""
-    code = ("import jax, sys; "
-            "sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)")
     try:
-        proc = subprocess.run([sys.executable, "-c", code],
+        proc = subprocess.run([sys.executable, "-c", PROBE_CODE],
                               timeout=timeout_s, capture_output=True)
         return proc.returncode == 0
     except Exception:  # noqa: BLE001 — timeout/spawn failure = dead
@@ -395,11 +451,15 @@ def capture_model_table(path: str, combos, label: str,
             log(f"{label}: tunnel down; stopping combo loop")
             return
         alive_hint = None
+        # 420s: the round-5 scan-16 step body compiles slower than the
+        # single step did; with the persistent compile cache the cost is
+        # first-window-only, and a busted budget would otherwise feed the
+        # failure cooloff exactly on the verdict-target rows
         rc, out = run_child(
             [sys.executable, os.path.join(HERE, "train_bench.py"),
              "--models", name, "--precisions", prec, "--batch", "32",
-             "--timeout", "300", "--retries", "0", *extra_args],
-            timeout=340)
+             "--timeout", "420", "--retries", "0", *extra_args],
+            timeout=460, sample_liveness=True)
         if rc is YIELDED:
             return
         fresh = parse_json_output(out)
@@ -410,7 +470,10 @@ def capture_model_table(path: str, combos, label: str,
                     for r in fresh.get("results", [])))
         if not combo_ok:
             alive_hint = tpu_alive()
-            if alive_hint:
+            if alive_hint and _CHILD_FLAP["observed"]:
+                log(f"{label}: {name}/{prec} tunnel flapped mid-child; "
+                    "not counting against the combo")
+            elif alive_hint:
                 fails = combo_backoff.failure(key)
                 log(f"{label}: {name}/{prec} failed on a live tunnel "
                     f"({fails} consecutive)")
@@ -668,7 +731,7 @@ def capture_train_bs256() -> None:
             [sys.executable, os.path.join(HERE, "train_bench.py"),
              "--models", "resnet50_v1", "--precisions", "bf16",
              "--batch", batch, "--timeout", "600", "--retries", "0"],
-            timeout=700)
+            timeout=700, sample_liveness=True)
         if rc is YIELDED:
             return
         rec = parse_json_output(out)
@@ -676,6 +739,11 @@ def capture_train_bs256() -> None:
                 all("error" not in r for r in rec.get("results", [])):
             succeeded = True
             combo_backoff.success("train-bs256")
+            break
+        if _CHILD_FLAP["observed"]:
+            tunnel_died = True
+            log("train bs256: tunnel flapped mid-child; "
+                "not trying smaller batch")
             break
         if not tpu_alive():
             tunnel_died = True

@@ -42,7 +42,7 @@ from benchmark.baselines import (attach_infer_ratios,  # noqa: E402
                                  attach_train_ratios)
 
 
-def build_step(net_name, batch, dtype_name, seq_len=128):
+def build_step(net_name, batch, dtype_name, seq_len=128, scan_steps=1):
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
@@ -104,13 +104,39 @@ def build_step(net_name, batch, dtype_name, seq_len=128):
                 new_p[k] = s
         return new_p, new_v, loss
 
-    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    if scan_steps > 1:
+        # K serially-chained steps inside ONE executable (lax.scan over
+        # the params/velocity carry): the math is identical to K single
+        # launches — verified step-for-step on CPU — but per-launch
+        # dispatch cost is paid once per K steps. Over the axon tunnel
+        # a launch costs ~4-5 ms, which at bs32 train (~6 ms of MXU
+        # work) was nearly HALF of every banked step — the dominant
+        # non-compute cost behind the 0.19 MFU rows. The chain and the
+        # scalar-fetch barrier survive: the fetched loss is the last
+        # step's, which cannot exist until every prior step ran.
+        def train_step_k(p, vel, x, y, key):
+            def body(carry, _):
+                cp, cv = carry
+                cp, cv, loss = train_step(cp, cv, x, y, key)
+                return (cp, cv), loss
+            (p, vel), losses = jax.lax.scan(
+                body, (p, vel), None, length=scan_steps)
+            return p, vel, losses[-1]
+
+        jstep = jax.jit(train_step_k, donate_argnums=(0, 1))
+    else:
+        jstep = jax.jit(train_step, donate_argnums=(0, 1))
     return jstep, params, velocity, jnp.asarray(x_np), jnp.asarray(y_np)
 
 
-def build_infer_step(net_name, batch, dtype_name):
+def build_infer_step(net_name, batch, dtype_name, scan_steps=1):
     """Serial-chained inference step (bench.py protocol: the output
-    perturbs the next input so no dispatch layer can elide work)."""
+    perturbs the next input so no dispatch layer can elide work).
+    With scan_steps>1, the chain runs inside ONE executable (lax.scan
+    over the perturbed-input carry) so per-launch dispatch cost — ~4-5ms
+    over the axon tunnel, several times the bs32 forward itself — is
+    amortized K-fold; the returned chain value still depends on every
+    step."""
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
@@ -131,13 +157,25 @@ def build_infer_step(net_name, batch, dtype_name):
         perturb = jnp.tanh(jnp.mean(logits)) * 1e-6
         return logits, x * (1.0 + perturb).astype(x.dtype)
 
+    if scan_steps > 1:
+        def step_k(p, x):
+            def body(cx, _):
+                logits, nx = step(p, cx)
+                return nx, jnp.sum(logits.astype(jnp.float32))
+            x, sums = jax.lax.scan(body, x, None, length=scan_steps)
+            # the last chained sum is the barrier value: it cannot exist
+            # until all K forwards (each feeding the next input) ran
+            return sums[-1], x
+
+        return jax.jit(step_k), params, jnp.asarray(x_np, dt)
     return jax.jit(step), params, jnp.asarray(x_np, dt)
 
 
-def measure_infer(net_name, batch, dtype_name, log):
+def measure_infer(net_name, batch, dtype_name, log, scan_steps=1):
     import jax.numpy as jnp
 
-    jstep, p, x = build_infer_step(net_name, batch, dtype_name)
+    jstep, p, x = build_infer_step(net_name, batch, dtype_name,
+                                   scan_steps=scan_steps)
     t0 = time.time()
     out, x = jstep(p, x)
     float(jnp.sum(x))
@@ -148,39 +186,46 @@ def measure_infer(net_name, batch, dtype_name, log):
     out, x = jstep(p, x)
     float(jnp.sum(out))
     per = max(time.perf_counter() - t0, 1e-4)
-    pass_iters = max(5, min(200, int(5.0 / per)))
+    max_launches = max(1, 3000 // scan_steps)
+    # floor: >=8 chained steps per pass regardless of scan_steps
+    pass_iters = max(-(-8 // scan_steps), min(200, int(5.0 / per)))
 
-    total_iters, total_dt = 0, 0.0
-    while total_dt < 5.0 and total_iters < 3000:
+    total_launches, total_dt = 0, 0.0
+    while total_dt < 5.0 and total_launches < max_launches:
         t0 = time.perf_counter()
         for _ in range(pass_iters):
             out, x = jstep(p, x)
         float(jnp.sum(out))  # barrier through the serial chain
         total_dt += time.perf_counter() - t0
-        total_iters += pass_iters
+        total_launches += pass_iters
+    total_iters = total_launches * scan_steps
     img_s = batch * total_iters / total_dt
     rec = {"model": net_name, "precision": dtype_name, "batch": batch,
-           "steps": total_iters, "infer_img_s": round(img_s, 2)}
+           "steps": total_iters, "steps_per_launch": scan_steps,
+           "infer_img_s": round(img_s, 2)}
     log(f"{net_name}/{dtype_name}: {img_s:.1f} img/s inference "
         f"({total_iters} steps, {total_dt:.1f}s)")
     attach_infer_ratios(rec)
     return rec
 
 
-def measure(net_name, batch, dtype_name, log):
+def measure(net_name, batch, dtype_name, log, scan_steps=1):
     import jax
     import jax.numpy as jnp
 
-    jstep, p, vel, x, y = build_step(net_name, batch, dtype_name)
+    jstep, p, vel, x, y = build_step(net_name, batch, dtype_name,
+                                     scan_steps=scan_steps)
     key = jax.random.PRNGKey(0)
     # FLOPs via the jaxpr MAC walk (bench.py convention: 2*MACs over
     # dot/conv, elementwise excluded — keeps mfu comparable across
     # artifacts). Pure tracing, no backend: works over the axon tunnel,
-    # where remote-compile cost_analysis returns nothing.
-    step_flops = None
+    # where remote-compile cost_analysis returns nothing. The walk
+    # multiplies scan bodies by trip count, so this is K steps' worth
+    # when scan_steps>1 — divided back below.
+    launch_flops = None
     try:
         from bench import jaxpr_flops
-        step_flops = jaxpr_flops(jstep, p, vel, x, y, key)
+        launch_flops = jaxpr_flops(jstep, p, vel, x, y, key)
     except Exception as e:  # noqa: BLE001
         log(f"jaxpr flop walk failed: {e!r}")
     t0 = time.time()
@@ -192,19 +237,23 @@ def measure(net_name, batch, dtype_name, log):
     p, vel, loss = jstep(p, vel, x, y, key)
     float(loss)
     per = max(time.perf_counter() - t0, 1e-4)
-    pass_iters = max(5, min(100, int(5.0 / per)))
+    max_launches = max(1, 1500 // scan_steps)
+    # floor: >=8 chained steps per pass regardless of scan_steps
+    pass_iters = max(-(-8 // scan_steps), min(100, int(5.0 / per)))
 
-    total_iters, total_dt = 0, 0.0
-    while total_dt < 5.0 and total_iters < 1500:
+    total_launches, total_dt = 0, 0.0
+    while total_dt < 5.0 and total_launches < max_launches:
         t0 = time.perf_counter()
         for _ in range(pass_iters):
             p, vel, loss = jstep(p, vel, x, y, key)
         float(loss)  # barrier: loss of the last serially-chained step
         total_dt += time.perf_counter() - t0
-        total_iters += pass_iters
+        total_launches += pass_iters
+    total_iters = total_launches * scan_steps
     img_s = batch * total_iters / total_dt
+    step_flops = launch_flops / scan_steps if launch_flops else None
     rec = {"model": net_name, "precision": dtype_name, "batch": batch,
-           "steps": total_iters}
+           "steps": total_iters, "steps_per_launch": scan_steps}
     if net_name.startswith("bert"):
         rec["train_seq_s"] = round(img_s, 2)
         rec["train_tok_s"] = round(img_s * 128, 1)
@@ -324,7 +373,8 @@ def measure_recordio_train(net_name, batch, dtype_name, log, n_images=512):
     return rec_row
 
 
-def child_main(name, batch, prec, cpu, infer=False, recordio_input=False):
+def child_main(name, batch, prec, cpu, infer=False, recordio_input=False,
+               scan_steps=None):
     """Measure ONE (model, precision) pair and print its JSON record.
     Runs in a child process: the axon tunnel can hang mid-compile, and a
     hung child can be timed out and retried (in-process jax caches a dead
@@ -359,12 +409,14 @@ def child_main(name, batch, prec, cpu, infer=False, recordio_input=False):
     devs = jax.devices()
     up.set()
     log("devices:", devs)
+    if scan_steps is None:
+        scan_steps = 16 if devs[0].platform == "tpu" else 1
     if recordio_input:
         rec = measure_recordio_train(name, batch, prec, log)
     elif infer:
-        rec = measure_infer(name, batch, prec, log)
+        rec = measure_infer(name, batch, prec, log, scan_steps=scan_steps)
     else:
-        rec = measure(name, batch, prec, log)
+        rec = measure(name, batch, prec, log, scan_steps=scan_steps)
     rec["matmul_precision"] = fp32_prec if prec == "fp32" else "bf16-native"
     rec["device"] = devs[0].platform
     rec["device_kind"] = devs[0].device_kind
@@ -391,6 +443,12 @@ def main():
                     help="train from real RecordIO JPEG bytes through "
                          "the C++ decode pipeline + device prefetch and "
                          "report input-pipeline overhead vs synthetic")
+    ap.add_argument("--scan-steps", type=int, default=None,
+                    help="serially-chained steps per launch (lax.scan "
+                         "inside one executable). Default: 16 on TPU "
+                         "(amortizes the ~4-5ms tunnel launch), 1 on CPU "
+                         "(no tunnel; XLA:CPU compiles scanned conv "
+                         "bodies ~5x slower)")
     ap.add_argument("--timeout", type=int, default=600,
                     help="per-(model,precision) child timeout, seconds")
     ap.add_argument("--retries", type=int, default=2)
@@ -403,7 +461,8 @@ def main():
 
     if args.child:
         child_main(args.child[0], args.batch, args.child[1], args.cpu,
-                   infer=args.infer, recordio_input=args.recordio_input)
+                   infer=args.infer, recordio_input=args.recordio_input,
+                   scan_steps=args.scan_steps)
         return
 
     def log(*a):
@@ -431,6 +490,8 @@ def main():
         for attempt in range(args.retries + 1):
             cmd = [sys.executable, os.path.abspath(__file__),
                    "--child", name, prec, "--batch", str(args.batch)]
+            if args.scan_steps is not None:
+                cmd += ["--scan-steps", str(args.scan_steps)]
             if args.infer:
                 cmd.append("--infer")
             if args.recordio_input:

@@ -430,21 +430,39 @@ def bwd_pallas_report():
     return rep
 
 
-def _bwd_pallas_ok(d, dtype, causal, lq, lk, bq, bk):
-    """Probe once PER SIGNATURE — with the REAL sequence geometry, so
-    the probe compiles exactly the block shapes, padding and grid the
-    real call will (Mosaic accepts or rejects based on block shapes and
-    dtype alignment; a d=64/L=256 probe must not green-light a d=80 or
-    ragged-length workload). Any reject falls back to the XLA-scan
-    backward for that signature. Training shapes are static, so this is
-    one tiny b=h=1 compile per distinct shape."""
-    key = (int(d), jnp.dtype(dtype).name, bool(causal),
+def bwd_pallas_enabled_for(b, h, d, dtype, causal, lq, lk) -> bool:
+    """Structured query for bench provenance: True iff the per-signature
+    probe admitted the compiled Pallas backward for this exact geometry
+    (any probed block size) AND no trace-time fallback has occurred in
+    this process — a green probe plus a recorded fallback means at least
+    one trace ran the scan path instead, so the honest answer is False.
+    Callers must NOT parse bwd_pallas_report()'s stringified keys (they
+    change shape when the probe signature grows)."""
+    if _BWD_PALLAS_FALLBACKS["count"]:
+        return False
+    want = (int(b), int(h), int(d), jnp.dtype(dtype).name, bool(causal),
+            int(lq), int(lk))
+    return any(k[:7] == want and v for k, v in _BWD_PALLAS_STATE.items())
+
+
+def _bwd_pallas_ok(b, h, d, dtype, causal, lq, lk, bq, bk):
+    """Probe once PER SIGNATURE — with the REAL grid geometry, batch and
+    heads included, so the probe compiles exactly the block shapes,
+    padding and (b*h, n_q, n_k) grid the real call will (ADVICE r4: a
+    b=h=1 probe green-lights grids Mosaic could still reject at size,
+    and when the backward is traced under the enclosing train-step jit,
+    that reject would surface at outer-jit compile time where no handler
+    catches it — failing the whole step instead of falling back). Any
+    reject falls back to the XLA-scan backward for that signature.
+    Training shapes are static, so this is one compile per distinct
+    shape; the probe's zeros are freed as soon as it returns."""
+    key = (int(b), int(h), int(d), jnp.dtype(dtype).name, bool(causal),
            int(lq), int(lk), int(bq), int(bk))
     if key not in _BWD_PALLAS_STATE:
         try:
-            q = jnp.zeros((1, 1, lq, d), dtype)
-            kv = jnp.zeros((1, 1, lk, d), dtype)
-            lse = jnp.zeros((1, 1, lq), jnp.float32)
+            q = jnp.zeros((b, h, lq, d), dtype)
+            kv = jnp.zeros((b, h, lk, d), dtype)
+            lse = jnp.zeros((b, h, lq), jnp.float32)
             jax.block_until_ready(jax.jit(
                 lambda q_, kv_, s: _flash_bwd_pallas(
                     q_, kv_, kv_, q_, s, q_, causal, 0.125, bq, bk, False)
@@ -484,7 +502,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
                 cands.append(c)
         raised = False
         for pbq, pbk in cands:
-            if not _bwd_pallas_ok(d, q.dtype, causal, lq, lk, pbq, pbk):
+            if not _bwd_pallas_ok(b, h, d, q.dtype, causal, lq, lk,
+                                  pbq, pbk):
                 continue
             try:
                 dq, dk, dv = _flash_bwd_pallas(

@@ -190,7 +190,12 @@ bool decode_one(const uint8_t* buf, size_t len, int th, int tw,
     if (aug->rand_mirror) {
       mirror = (rng() & 1) != 0;
     }
-    if (aug->rand_crop) {
+    // min_area >= 1 admits only the full frame; the int(sqrt(...)+0.5)
+    // rounding could still accept a window 1px short of it for some
+    // aspect draws, so short-circuit to the exact full-frame crop
+    // (ADVICE r4: keeps "min_area=1.0 is a plain resize" a contract,
+    // not a fixture-dependent accident)
+    if (aug->rand_crop && aug->min_area < 1.f) {
       std::uniform_real_distribution<float> u01(0.f, 1.f);
       const float area = static_cast<float>(h) * w;
       for (int attempt = 0; attempt < 10; ++attempt) {

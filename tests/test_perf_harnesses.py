@@ -484,3 +484,49 @@ def test_scaling_bench_pod_model():
     # bytes approach 2x grad bytes)
     assert chips["256"]["efficiency_no_overlap"] <= \
         chips["8"]["efficiency_no_overlap"]
+
+
+def test_train_bench_scan_chain_equivalence():
+    """The round-5 launch-amortization protocol: K serially-chained train
+    steps inside one lax.scan executable must produce the math of K
+    single-launch steps (same loss trajectory), actually run all K steps
+    (params move K steps' worth, not 1), and never elide work. Exact
+    param equality is NOT asserted: scanned and unrolled bodies compile
+    to different fusions and training chaotically amplifies ULP diffs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from benchmark.train_bench import build_step
+
+    j1, p0, v0, x, y = build_step("alexnet", 2, "fp32", scan_steps=1)
+    jK, _pK, _vK, _xK, _yK = build_step("alexnet", 2, "fp32", scan_steps=2)
+    key = jax.random.PRNGKey(0)
+    # one shared init, copied per path (both jits donate their args)
+    snap = {k: onp.asarray(v) for k, v in p0.items()}
+    vsnap = {k: onp.asarray(v) for k, v in v0.items()}
+
+    def copies():
+        return ({k: jnp.array(v) for k, v in snap.items()},
+                {k: jnp.array(v) for k, v in vsnap.items()})
+
+    p1, v1 = copies()
+    p1, v1, _loss_step1 = j1(p1, v1, x, y, key)
+    p1_after1 = {k: onp.asarray(v) for k, v in p1.items()}
+    p1, v1, loss1 = j1(p1, v1, x, y, key)
+
+    pK, vK = copies()
+    pK, vK, lossK = jK(pK, vK, x, y, key)
+    # same loss after 2 steps, whichever protocol ran them
+    assert onp.isclose(float(loss1), float(lossK), rtol=1e-4), \
+        (float(loss1), float(lossK))
+    # the scan did 2 steps of work: its params sit with the 2-step
+    # result, not the init and not the 1-step result
+    dist_init = sum(float(onp.abs(onp.asarray(pK[k]) - snap[k]).sum())
+                    for k in snap)
+    dist_1 = sum(float(onp.abs(onp.asarray(pK[k]) - p1_after1[k]).sum())
+                 for k in snap)
+    dist_2 = sum(float(onp.abs(onp.asarray(pK[k])
+                               - onp.asarray(p1[k])).sum()) for k in snap)
+    assert dist_init > 0 and dist_1 > 0, "scan elided the steps"
+    assert dist_2 < 0.05 * dist_1, (dist_2, dist_1, dist_init)
