@@ -119,9 +119,8 @@ def random_seed(seed: int) -> None:
 
 def device_info() -> tuple:
     """(platform, device_count) of the default backend."""
-    import jax
-
-    devs = jax.devices()
+    from .base import safe_devices
+    devs = safe_devices()
     return devs[0].platform, len(devs)
 
 

@@ -104,6 +104,68 @@ class MXNetError(RuntimeError):
     """Framework-level error (parity with mxnet.base.MXNetError)."""
 
 
+_backend_fallback = {"active": False, "lock": threading.Lock()}
+
+
+def backend_init_fallback(e: BaseException) -> bool:
+    """Shared fail-soft policy (VERDICT r4 weak #7): if ``e`` is a JAX
+    backend-initialization failure — the observed case is
+    ``JAX_PLATFORMS=axon`` with the TPU tunnel down, where the first
+    backend touch raises a raw ``RuntimeError: Unable to initialize
+    backend 'axon'`` out of ``net.initialize()`` — warn ONCE naming the
+    knob, flip this process to the CPU backend, and return True so the
+    caller retries. Returns False (caller re-raises) for any other
+    error, or when the CPU fallback itself is what failed (the error
+    names the cpu backend after the flip — nothing left to try).
+    Thread-safe: concurrent first-touch threads retry without
+    re-warning or double-flipping."""
+    import warnings
+
+    if not (isinstance(e, RuntimeError)
+            and "nable to initialize backend" in str(e)):
+        return False
+    if "backend 'cpu'" in str(e):
+        return False  # the fallback target itself cannot initialize
+    with _backend_fallback["lock"]:
+        if _backend_fallback["active"]:
+            # another thread already flipped to CPU — this thread's
+            # pre-flip failure is stale; retry (on CPU), don't re-warn
+            return True
+        first_line = (str(e).splitlines() or ["?"])[0]
+        warnings.warn(
+            "mxnet_tpu: the configured JAX backend failed to initialize "
+            f"({first_line}). Falling back to the CPU backend for this "
+            "process — set JAX_PLATFORMS=cpu to choose this explicitly, "
+            "or restore the accelerator (TPU tunnel) and restart.",
+            RuntimeWarning, stacklevel=3)
+        jax.config.update("jax_platforms", "cpu")
+        _backend_fallback["active"] = True
+    return True
+
+
+def failsoft_call(fn, *args, **kwargs):
+    """Run ``fn`` retrying once through :func:`backend_init_fallback`.
+    Guard for the process's FIRST backend touch at the library's entry
+    chokepoints (eager-op dispatch, array creation, RNG key creation,
+    device enumeration): a backend-init failure there has executed
+    nothing yet, so the retry after the CPU flip is safe."""
+    try:
+        return fn(*args, **kwargs)
+    except RuntimeError as e:
+        if not backend_init_fallback(e):
+            raise
+        return fn(*args, **kwargs)
+
+
+def safe_devices(kind: Optional[str] = None):
+    """``jax.devices()`` with the fail-soft policy above. Every
+    in-package device enumeration routes through here so whichever
+    module touches the backend first gets the same behavior."""
+    if kind:
+        return failsoft_call(jax.devices, kind)
+    return failsoft_call(jax.devices)
+
+
 # ---------------------------------------------------------------------------
 # dtype handling — mshadow's enum order kept for serialization parity
 # (reference 3rdparty/mshadow/mshadow/base.h kFloat32=0.. and

@@ -14,7 +14,7 @@ from typing import Any, List, Optional
 
 import jax
 
-from .base import MXNetError
+from .base import MXNetError, safe_devices
 
 __all__ = [
     "Context",
@@ -77,16 +77,16 @@ class Context:
         """The concrete jax.Device backing this context."""
         kind, idx = self._canonical()
         if kind in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = [d for d in jax.devices() if d.platform == "cpu"]
+            devs = [d for d in safe_devices() if d.platform == "cpu"]
             if not devs:  # accelerator-only runtime: host staging via cpu backend
                 try:
-                    devs = jax.devices("cpu")
+                    devs = safe_devices("cpu")
                 except RuntimeError:
-                    devs = list(jax.devices())
+                    devs = list(safe_devices())
         else:
-            devs = [d for d in jax.devices() if d.platform != "cpu"]
+            devs = [d for d in safe_devices() if d.platform != "cpu"]
             if not devs:  # CPU-only test rig: tpu(i) maps onto virtual cpu devs
-                devs = list(jax.devices())
+                devs = list(safe_devices())
         if idx >= len(devs):
             raise MXNetError(f"context {self} out of range ({len(devs)} devices)")
         return devs[idx]
@@ -112,7 +112,7 @@ class Context:
 
 def _default_device() -> Context:
     """Accelerator if present, else cpu — eager arrays land there."""
-    if any(d.platform != "cpu" for d in jax.devices()):
+    if any(d.platform != "cpu" for d in safe_devices()):
         return Context("tpu", 0)
     return Context("cpu", 0)
 
@@ -135,13 +135,13 @@ def gpu(device_id: int = 0) -> Context:
 
 
 def num_tpus() -> int:
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
-    return len(devs) if devs else len(jax.devices())
+    devs = [d for d in safe_devices() if d.platform != "cpu"]
+    return len(devs) if devs else len(safe_devices())
 
 
 def num_gpus() -> int:
     """Parity alias (reference python/mxnet/context.py:261)."""
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    devs = [d for d in safe_devices() if d.platform != "cpu"]
     return len(devs)
 
 

@@ -14,7 +14,7 @@ from typing import Any, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as onp
 
-from ..base import MXNetError, dtype_from_any
+from ..base import MXNetError, dtype_from_any, safe_devices
 from ..context import Context, current_context
 from ..ndarray.ndarray import ndarray, _wrap
 from .. import initializer as init_mod
@@ -186,13 +186,13 @@ class Parameter:
                 # endpoint rejects init programs at these sizes (HTTP 413,
                 # observed on vgg16's 4096x25088 fc weight); threefry bits
                 # are platform-invariant so weights are bit-identical.
-                cpu0 = _jax.devices("cpu")[0]
+                cpu0 = safe_devices("cpu")[0]
                 with _jax.default_device(cpu0):
                     arr = ndarray(onp.zeros(self._shape, self.dtype))
                     initializer.init_array(self.name, arr)
                 from ..context import Context
                 dev = (ctx.jax_device if isinstance(ctx, Context)
-                       else _jax.devices()[0])
+                       else safe_devices()[0])
                 arr._set_data(_jax.device_put(arr._data, dev))
             else:
                 arr = ndarray(onp.zeros(self._shape, self.dtype), ctx=ctx)

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
-from ..base import dtype_from_any
+from ..base import dtype_from_any, failsoft_call
 from ..ndarray.ndarray import ndarray, _wrap, _unwrap
 
 __all__ = [
@@ -37,7 +37,9 @@ class _RNG(threading.local):
 
     def next_key(self):
         if self.key is None:
-            self.key = jax.random.PRNGKey(0)
+            # often the process's FIRST backend touch (net.initialize())
+            # — fail-soft if the configured backend is unreachable
+            self.key = failsoft_call(jax.random.PRNGKey, 0)
         self.key, sub = jax.random.split(self.key)
         return sub
 

@@ -22,6 +22,8 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+
+from ..base import safe_devices
 import numpy as onp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -58,7 +60,7 @@ def make_mesh(
     (SURVEY.md §2.3).
     """
     if devices is None:
-        devices = jax.devices()
+        devices = safe_devices()
     devices = list(devices)
     if axes is None:
         axes = {"dp": -1}

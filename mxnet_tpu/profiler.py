@@ -20,6 +20,8 @@ from typing import Dict, List, Optional
 
 import jax
 
+from .base import safe_devices
+
 __all__ = [
     "set_config",
     "set_state",
@@ -106,7 +108,7 @@ def device_memory(device=None) -> dict:
     Returns {} on backends that expose no stats (virtual CPU devices)."""
     import jax
 
-    d = device or jax.devices()[0]
+    d = device or safe_devices()[0]
     try:
         return dict(d.memory_stats() or {})
     except Exception:
@@ -123,7 +125,7 @@ def _mem_in_use() -> int:
         import jax
 
         try:
-            dev = jax.devices()[0]
+            dev = safe_devices()[0]
             if not (dev.memory_stats() or {}):
                 _mem_probe = False
                 return 0
