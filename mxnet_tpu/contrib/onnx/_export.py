@@ -128,6 +128,17 @@ def _h_simple(op_type):
     return h
 
 
+def _h_square(em, eqn, ins):
+    return em.add_node("Mul", [ins[0], ins[0]])
+
+
+def _h_erfc(em, eqn, ins):
+    (e,) = em.add_node("Erf", ins)
+    one = em.const_name(
+        onp.asarray(1.0, eqn.outvars[0].aval.dtype), "one")
+    return em.add_node("Sub", [one, e])
+
+
 def _h_rsqrt(em, eqn, ins):
     (s,) = em.add_node("Sqrt", ins)
     return em.add_node("Reciprocal", [s])
@@ -264,6 +275,71 @@ def _h_dot_general(em, eqn, ins):
     return em.add_node("Einsum", ins, equation=eq)
 
 
+def _h_compare(op_type, negate=False, bool_only=False):
+    """lax comparison/logical prims -> ONNX (bool outputs; downstream
+    convert_element_type becomes Cast as usual). ``bool_only`` guards
+    the prims jax shares between logical and BITWISE semantics
+    ('and'/'or'/'xor'/'not'): ONNX And/Or/Xor/Not constrain T to bool,
+    so integer operands must raise, not silently mis-export."""
+    def h(em, eqn, ins):
+        if bool_only and any(
+                onp.dtype(v.aval.dtype) != onp.dtype(bool)
+                for v in eqn.invars):
+            raise MXNetError(
+                f"bitwise {eqn.primitive.name!r} on non-bool operands "
+                "has no ONNX translation (ONNX And/Or/Xor/Not are "
+                "bool-only)")
+        outs = em.add_node(op_type, ins)
+        if negate:
+            outs = em.add_node("Not", outs)
+        return outs
+    return h
+
+
+def _h_iota(em, eqn, ins):
+    # iota is closed-form: materialize the index ramp as an initializer
+    dim = int(eqn.params["dimension"])
+    shape = tuple(eqn.params["shape"])
+    aval = eqn.outvars[0].aval
+    vec_shape = [shape[dim] if i == dim else 1 for i in range(len(shape))]
+    arr = onp.broadcast_to(
+        onp.arange(shape[dim]).reshape(vec_shape), shape)
+    return [em.const_name(onp.asarray(arr, aval.dtype), "iota")]
+
+
+def _h_gather(em, eqn, ins):
+    """The take-along-axis pattern (embedding lookup: one collapsed
+    slice dim indexed, every other dim taken whole, offset dims
+    trailing) -> ONNX Gather. General lax.gather stays unexportable."""
+    gd = eqn.params["dimension_numbers"]
+    op_shape = tuple(eqn.invars[0].aval.shape)
+    idx_shape = tuple(eqn.invars[1].aval.shape)
+    out_rank = len(eqn.outvars[0].aval.shape)
+    slice_sizes = tuple(eqn.params["slice_sizes"])
+    csd = tuple(gd.collapsed_slice_dims)
+    sim = tuple(gd.start_index_map)
+    rank = len(op_shape)
+    take_like = (
+        csd == (0,) and sim == csd  # axis 0 ONLY: ONNX Gather puts the
+        # index dims AT the axis, lax.gather puts batch dims FIRST —
+        # the layouts agree just for axis 0 with trailing offset_dims
+        and all(slice_sizes[d] == (1 if d in csd else op_shape[d])
+                for d in range(rank))
+        and tuple(gd.offset_dims) == tuple(
+            range(out_rank - (rank - 1), out_rank))
+        and idx_shape and idx_shape[-1] == 1)
+    if not take_like:
+        raise MXNetError(
+            "general lax.gather has no ONNX translation (only the "
+            f"take-along-one-axis pattern); dims={gd}")
+    axis = csd[0]
+    # drop the trailing index-vector dim, then Gather along the axis
+    flat_idx_shape = onp.asarray(idx_shape[:-1], onp.int64)
+    shape = em.const_name(flat_idx_shape, "shape")
+    (idx,) = em.add_node("Reshape", [ins[1], shape])
+    return em.add_node("Gather", [ins[0], idx], axis=int(axis))
+
+
 def _h_select_n(em, eqn, ins):
     if len(ins) != 3:
         raise MXNetError("select_n with >2 cases not exportable")
@@ -306,6 +382,20 @@ _HANDLERS: Dict[str, Callable] = {
     "slice": _h_slice,
     "stop_gradient": _h_identity,
     "copy": _h_identity,
+    "lt": _h_compare("Less"),
+    "le": _h_compare("LessOrEqual"),
+    "gt": _h_compare("Greater"),
+    "ge": _h_compare("GreaterOrEqual"),
+    "eq": _h_compare("Equal"),
+    "ne": _h_compare("Equal", negate=True),
+    "and": _h_compare("And", bool_only=True),
+    "or": _h_compare("Or", bool_only=True),
+    "xor": _h_compare("Xor", bool_only=True),
+    "not": _h_compare("Not", bool_only=True),
+    "iota": _h_iota,
+    "gather": _h_gather,
+    "square": _h_square,
+    "erfc": _h_erfc,
 }
 
 
@@ -375,15 +465,20 @@ def export_model(net, example_input, path: str, producer: str = "mxnet_tpu",
 
     inputs = example_input if isinstance(example_input, (tuple, list)) \
         else (example_input,)
-    fn, params = net.functionalize(*inputs, training=False)
-    ivals = [_unwrap(v) for v in inputs]
+    # trace with Pallas fused kernels disabled: pallas_call has no ONNX
+    # translation; the jnp fallback paths (same math) translate cleanly
+    from ...ops.nn import no_pallas
 
-    def infer(*vals):
-        out, _state = fn(params, *vals)
-        leaves = jax.tree_util.tree_leaves(out)
-        return tuple(leaves)
+    with no_pallas():
+        fn, params = net.functionalize(*inputs, training=False)
+        ivals = [_unwrap(v) for v in inputs]
 
-    closed = jax.make_jaxpr(infer)(*ivals)
+        def infer(*vals):
+            out, _state = fn(params, *vals)
+            leaves = jax.tree_util.tree_leaves(out)
+            return tuple(leaves)
+
+        closed = jax.make_jaxpr(infer)(*ivals)
     jaxpr, jconsts = closed.jaxpr, closed.consts
     jaxpr, used = dce_jaxpr(jaxpr, [True] * len(jaxpr.outvars))
 

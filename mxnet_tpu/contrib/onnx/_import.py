@@ -138,11 +138,26 @@ def _import_node(sym, env, node):
         return y
     if op == "Where":
         return sym.np.where(sin(0), sin(1), sin(2))
+    if op in ("Less", "Greater", "LessOrEqual", "GreaterOrEqual", "Equal"):
+        fn = {"Less": sym.np.less, "Greater": sym.np.greater,
+              "LessOrEqual": sym.np.less_equal,
+              "GreaterOrEqual": sym.np.greater_equal,
+              "Equal": sym.np.equal}[op]
+        return fn(sin(0), sin(1))
+    if op in ("And", "Or", "Xor"):
+        fn = {"And": sym.np.logical_and, "Or": sym.np.logical_or,
+              "Xor": sym.np.logical_xor}[op]
+        return fn(sin(0), sin(1))
+    if op == "Not":
+        return sym.np.logical_not(sin(0))
+    if op == "Gather":
+        return sym.np.take(sin(0), sin(1), axis=attrs.get("axis", 0))
     if op == "Slice":
         starts = cval(1) if n_in > 1 else attrs["starts"]
         ends = cval(2) if n_in > 2 else attrs["ends"]
-        axes = (cval(3) if n_in > 3 else attrs.get("axes")) \
-            or list(range(len(starts)))
+        axes = cval(3) if n_in > 3 else attrs.get("axes")
+        if axes is None or len(axes) == 0:
+            axes = list(range(len(starts)))
         steps = (cval(4) if n_in > 4 else None)
         steps = steps if steps is not None else [1] * len(starts)
         if any(int(a) < 0 for a in axes):

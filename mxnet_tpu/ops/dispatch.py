@@ -26,7 +26,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 
-from ..base import MXNetError
+from ..base import MXNetError, failsoft_call
 
 __all__ = ["apply_op", "Tape", "autograd_state", "is_recording", "is_training"]
 
@@ -168,8 +168,6 @@ def _apply_op(
     # that point (tape/engine mutations all happen after the first
     # backend touch), so the post-CPU-flip retry is safe. Every
     # mx.np/npx op routes through this chokepoint.
-    from ..base import failsoft_call
-
     return failsoft_call(_apply_op_impl, fn, arrays, static, n_out, name)
 
 

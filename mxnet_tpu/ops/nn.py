@@ -15,6 +15,7 @@ dimension_numbers so XLA is free to pick MXU-friendly internal layouts.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -350,6 +351,32 @@ def _probe_once(state: dict, probe) -> bool:
     return state["ok"]
 
 
+class _PallasDisabled(threading.local):
+    def __init__(self):
+        self.depth = 0
+
+
+_pallas_disabled = _PallasDisabled()  # per-thread depth; see no_pallas()
+
+
+class no_pallas:
+    """Disable every Pallas fused-kernel dispatch inside the context
+    (norms, fused CE, flash attention) so tracing produces a
+    backend-portable jaxpr of plain lax ops. Used by the ONNX exporter:
+    ``pallas_call`` has no ONNX translation, while the jnp fallback
+    paths these sites already maintain translate cleanly. Re-entrant,
+    and thread-LOCAL: an export in one thread must not knock another
+    thread's training step off the fused kernels."""
+
+    def __enter__(self):
+        _pallas_disabled.depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        _pallas_disabled.depth -= 1
+        return False
+
+
 def _pallas_norm_ok():
     """One-time Mosaic compile probe for the fused norm kernels on this
     backend; a failure permanently falls back to the jnp path."""
@@ -376,6 +403,7 @@ def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
     if (ax == x.ndim - 1 and x.shape[-1] <= 8192
             and gamma.ndim == 1 and gamma.shape[0] == x.shape[-1]
             and beta.ndim == 1 and beta.shape[0] == x.shape[-1]
+            and not _pallas_disabled.depth
             and jax.default_backend() == "tpu" and _pallas_norm_ok()):
         from .pallas.layer_norm import fused_layer_norm
         shp = x.shape
@@ -424,6 +452,7 @@ def rms_norm(x, gamma, axis=-1, eps=1e-6):
     if (ax == x.ndim - 1 and x.shape[-1] <= 8192
             and getattr(gamma, "ndim", 0) == 1
             and gamma.shape[0] == x.shape[-1]
+            and not _pallas_disabled.depth
             and jax.default_backend() == "tpu" and _pallas_norm_ok()):
         from .pallas.layer_norm import fused_rms_norm
         shp = x.shape
@@ -563,7 +592,8 @@ def softmax_cross_entropy(data, label, per_example=False):
             f"got {data.shape} / {label.shape}")
     lab = label.astype(jnp.int32)
     nll = None
-    if jax.default_backend() == "tpu" and _pallas_ce_ok():
+    if (not _pallas_disabled.depth
+            and jax.default_backend() == "tpu" and _pallas_ce_ok()):
         from .pallas.cross_entropy import cross_entropy_with_logits
         try:
             nll = cross_entropy_with_logits(data, lab)
@@ -814,7 +844,8 @@ def attend(q, k, v, heads, causal=False, mask=None, dropout=0.0, key=None,
     qh = q.reshape(b, lq, heads, d).transpose(0, 2, 1, 3)
     kh = k.reshape(b, k.shape[1], heads, d).transpose(0, 2, 1, 3)
     vh = v.reshape(b, v.shape[1], heads, d).transpose(0, 2, 1, 3)
-    if mask is None and not (dropout and training):
+    if mask is None and not (dropout and training) \
+            and not _pallas_disabled.depth:
         from .pallas.flash_attention import flash_attention
 
         out = flash_attention(qh, kh, vh, causal=causal)

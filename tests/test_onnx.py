@@ -5,6 +5,8 @@ No onnx package exists in the image, so correctness is pinned three ways:
 - export -> import -> numerically identical outputs (vision zoo nets)
 - structural checks of the emitted graph (ops, initializers, IO)
 """
+import os
+
 import numpy as onp
 import pytest
 
@@ -167,3 +169,56 @@ def test_import_external_style_graph():
     (out,) = exe.forward()
     ref = onp.maximum(x.reshape(2, 6) @ w.T + b, 0)
     onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Zoo-wide round-trip gate (VERDICT r4 item #7): every vision family +
+# BERT exports, imports, and reproduces logits. Small family members and
+# reduced input sizes keep the CPU cost bounded; the handler surface
+# exercised is the same as the full-size models'.
+# ---------------------------------------------------------------------------
+_ZOO_CASES = [
+    ("resnet18_v1", (1, 3, 32, 32)),
+    ("vgg11", (1, 3, 32, 32)),
+    ("alexnet", (1, 3, 224, 224)),       # hard mins: 224 input
+    ("squeezenet1_0", (1, 3, 64, 64)),
+    ("densenet121", (1, 3, 224, 224)),  # AvgPool2D(7) needs >=224
+    ("mobilenet0_25", (1, 3, 32, 32)),
+    ("mobilenet_v2_0_25", (1, 3, 32, 32)),
+    ("inception_v3", (1, 3, 299, 299)),  # fixed 299 input by design
+]
+
+
+@pytest.mark.integration
+@pytest.mark.parametrize("name,shape", _ZOO_CASES,
+                         ids=[c[0] for c in _ZOO_CASES])
+def test_zoo_roundtrip(name, shape):
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = getattr(vision, name)(classes=10)
+    _roundtrip(net, shape, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.integration
+def test_bert_roundtrip():
+    """BERT encoder logits through export+import (token inputs, so the
+    generic _roundtrip float-image helper does not apply)."""
+    import tempfile
+
+    from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+
+    core = bert_zoo.BERTModel(vocab_size=256, units=64, hidden_size=128,
+                              num_layers=2, num_heads=2, max_length=64,
+                              dropout=0.0)
+    core.initialize()
+    x = mx.np.array(onp.random.randint(0, 256, (2, 16)).astype(onp.int32))
+    ref = core(x)
+    ref = (ref[0] if isinstance(ref, tuple) else ref).asnumpy()
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bert.onnx")
+        export_model(core, x, path)
+        sym, arg_params, aux = import_model(path)
+    exe = sym.bind(args={**arg_params, "data": x})
+    out = exe.forward()[0]
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
