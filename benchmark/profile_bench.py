@@ -347,6 +347,24 @@ def main():
            "device_kind": getattr(devs[0], "device_kind", ""),
            "protocol": "ablation deltas; serial-chain scalar-fetch barrier",
            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    # per-launch dispatch overhead, measured directly: a serially-chained
+    # near-no-op program (one tiny add feeding the next input) isolates
+    # what ONE launch costs over the axon tunnel — the line item that
+    # explained ~45% of the bs32 train step and ~80% of the bs32 infer
+    # step before the round-5 scan-K protocol amortized it. Phase deltas
+    # below still run one-launch-per-step, so readers should subtract
+    # this from per-phase absolutes when projecting scan-K performance.
+    try:
+        import jax.numpy as jnp
+
+        tiny = jax.jit(lambda x: (jnp.sum(x), x + 1.0))
+        per_ms, n = timeit_chained(tiny, jnp.zeros((8, 8), jnp.float32), (),
+                                   budget_s=1.0 if args.quick else 2.0)
+        rec["launch_overhead_ms"] = round(per_ms, 3)
+        rec["launch_overhead_iters"] = n
+        log(f"per-launch overhead: {per_ms:.3f} ms ({n} chained launches)")
+    except Exception as e:  # noqa: BLE001 — diagnostic only
+        log(f"launch-overhead probe failed: {e!r}")
     for b in [int(s) for s in args.resnet_batches.split(",") if s]:
         try:
             rec[f"resnet50_bf16_bs{b}"] = profile_resnet(b, args.quick)

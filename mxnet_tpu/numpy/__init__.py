@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
-from ..base import dtype_from_any, bfloat16, failsoft_call, MXNetError
+from ..base import dtype_from_any, bfloat16, MXNetError
+from ..base import failsoft_call as _failsoft_call
 from ..context import Context, current_context
 from ..ndarray.ndarray import ndarray, _wrap, _unwrap
 from ..ops.dispatch import apply_op
@@ -85,7 +86,7 @@ def _create(val, ctx=None):
     # callables are evaluated here under the fail-soft guard: creation is
     # often the process's first backend touch (VERDICT r4 weak #7)
     if callable(val):
-        val = failsoft_call(val)
+        val = _failsoft_call(val)
     out = _wrap(val)
     if ctx is not None:
         out._data = jax.device_put(out._data, ctx.jax_device)
@@ -133,7 +134,7 @@ def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
 
 
 def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None, axis=0, ctx=None):
-    out = failsoft_call(jnp.linspace, start, stop, num, endpoint=endpoint, retstep=retstep, dtype=dtype and dtype_from_any(dtype), axis=axis)
+    out = _failsoft_call(jnp.linspace, start, stop, num, endpoint=endpoint, retstep=retstep, dtype=dtype and dtype_from_any(dtype), axis=axis)
     if retstep:
         return _create(out[0], ctx), out[1]
     return _create(out, ctx)
@@ -152,7 +153,7 @@ def identity(n, dtype=float32, ctx=None):
 
 
 def meshgrid(*xi, indexing="xy"):
-    outs = failsoft_call(
+    outs = _failsoft_call(
         lambda: jnp.meshgrid(*[_unwrap(x) for x in xi], indexing=indexing))
     return [_wrap(o) for o in outs]
 
