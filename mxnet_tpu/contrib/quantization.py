@@ -130,15 +130,31 @@ class CalibrationCollector:
         return self.max_abs.get(name, 1.0) or 1e-8
 
 
+def _sym_per_channel_int8(w, channel_axis=0, zero_scale=1e-8,
+                          scale_dtype=None, xp=onp):
+    """ONE symmetric per-channel int8 rule shared by the PTQ path
+    (numpy, host-side calibration) and the decode weight-only path
+    (jnp, on device) — so zero-channel handling and rounding can never
+    drift between them. The scale is cast to ``scale_dtype`` BEFORE the
+    codes are computed, so stored scale and int8 codes always agree
+    exactly (a post-hoc bf16 scale cast would rescale whole channels)."""
+    axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+    wf = w.astype(xp.float32)
+    scale = xp.abs(wf).max(axis=axes, keepdims=True) / 127.0
+    scale = xp.where(scale == 0, zero_scale, scale)
+    if scale_dtype is not None:
+        scale = scale.astype(scale_dtype)
+    wq = xp.clip(xp.rint(wf / scale.astype(xp.float32)),
+                 -127, 127).astype(xp.int8)
+    return wq, scale
+
+
 def _quantize_weight_per_channel(w: onp.ndarray,
                                  channel_axis: int = 0
                                  ) -> Tuple[onp.ndarray, onp.ndarray]:
     """Symmetric per-output-channel int8 weights (reference
     quantize_graph per-channel weight quantization)."""
-    axes = tuple(i for i in range(w.ndim) if i != channel_axis)
-    scale = onp.abs(w).max(axis=axes, keepdims=True) / 127.0
-    scale = onp.where(scale == 0, 1e-8, scale)
-    wq = onp.clip(onp.rint(w / scale), -127, 127).astype(onp.int8)
+    wq, scale = _sym_per_channel_int8(w, channel_axis)
     return wq, scale.astype(onp.float32)
 
 
@@ -329,3 +345,46 @@ def quantize_model(net, calib_data=None, calib_mode="naive", **kwargs):
     """Alias keeping the reference's quantize_model entry-point name."""
     return quantize_net(net, calib_data=calib_data, calib_mode=calib_mode,
                         **kwargs)
+
+
+def quantize_weights_int8(params):
+    """Weight-only int8 quantization for the HBM-bound decode path
+    (VERDICT r4 item #3 pivot: decode reads every weight once per token,
+    so int8 storage halves the weight bytes of bf16 — a bandwidth win
+    independent of whether the MXU's int8 matmul beats bf16).
+
+    Symmetric per-output-channel scales over every 2-D float parameter
+    (dense kernels, embeddings); everything else passes through
+    unchanged. Returns ``(qparams, scales)``: ``qparams`` has int8
+    arrays where quantized, and ``scales[k]`` is a ``(1, out)`` array in
+    the ORIGINAL float dtype — dequantization ``q.astype(s.dtype) * s``
+    restores the original dtype exactly, so downstream numerics match
+    the unquantized model up to the <=1/254-per-channel rounding step.
+
+    Reference seam: ``python/mxnet/contrib/quantization.py`` quantizes
+    whole networks offline; this is the decode-time sibling.
+    """
+    qparams, scales = {}, {}
+    for k, v in params.items():
+        val = _unwrap(v)
+        if getattr(val, "ndim", 0) == 2 and \
+                jnp.issubdtype(val.dtype, jnp.floating):
+            q, s = _sym_per_channel_int8(
+                val, channel_axis=1, zero_scale=1.0,
+                scale_dtype=val.dtype, xp=jnp)
+            qparams[k] = q
+            scales[k] = s
+        else:
+            qparams[k] = val
+    return qparams, scales
+
+
+def dequantize_weights_int8(qparams, scales):
+    """Inverse of :func:`quantize_weights_int8`: int8 entries with a
+    recorded scale come back in the scale's (original) dtype. Runs
+    inside jit on the decode path — XLA reads the int8 HBM bytes and
+    fuses the convert+scale into the consumer."""
+    out = dict(qparams)
+    for k, s in scales.items():
+        out[k] = qparams[k].astype(s.dtype) * s
+    return out

@@ -42,6 +42,9 @@ _DECODE_CACHE_MAX = 16
 # die with the model and NOTHING is stored on the model itself (pickling
 # any model type keeps working — no lock/jit objects in __dict__)
 _DECODE_CACHES = weakref.WeakKeyDictionary()
+# model -> (param-identity key, (qparams, scales), param refs): the
+# weight-only-int8 tree, re-quantized only when the weights change
+_INT8W_CACHES = weakref.WeakKeyDictionary()
 _DECODE_CACHES_LOCK = threading.RLock()
 
 
@@ -127,10 +130,56 @@ def _prep(model, prompt_ids, max_new_tokens, max_length,
     return prompt, b, p, lmax, ck, cv, step_fn, params
 
 
+def _apply_weight_dtype(model, step_fn, params, weight_dtype):
+    """Optional weight-only int8 for the decode program (VERDICT r4
+    item #3 pivot): weights are stored int8 + per-output-channel scales
+    in the params pytree and dequantized INSIDE the compiled step, so
+    every decode token reads half the weight HBM bytes of bf16. Scales
+    travel in the pytree (not closures): the memoized compiled program
+    stays correct when the model's weights change between calls.
+
+    The quantized tree is memoized on the model per weight VERSION
+    (keyed on the identity of every param buffer, with refs held so ids
+    stay valid): quantization is several full-precision passes over all
+    weights and must not run per generate() call — that would put the
+    quantizer inside every measured decode."""
+    if weight_dtype is None:
+        return step_fn, params
+    if weight_dtype != "int8":
+        raise MXNetError(
+            f"weight_dtype {weight_dtype!r} not supported (int8)")
+    from ...contrib.quantization import (dequantize_weights_int8,
+                                         quantize_weights_int8)
+
+    key = tuple((k, id(v)) for k, v in sorted(params.items()))
+    with _DECODE_CACHES_LOCK:
+        cached = _INT8W_CACHES.get(model)
+    if cached is not None and cached[0] == key:
+        q, scales = cached[1]
+    else:
+        q, scales = quantize_weights_int8(params)
+        with _DECODE_CACHES_LOCK:
+            # the params list ref keeps the keyed buffers alive, so a
+            # freed buffer's id can never be recycled into a false hit;
+            # weak-keyed off-model storage (the _DECODE_CACHES rule:
+            # nothing lands in model.__dict__, pickling keeps working)
+            _INT8W_CACHES[model] = (key, (q, scales),
+                                    list(params.values()))
+    wrapped = {"__int8_weights__": q, "__int8_scales__": scales}
+
+    def qstep(p, *rest):
+        deq = dequantize_weights_int8(p["__int8_weights__"],
+                                      p["__int8_scales__"])
+        return step_fn(deq, *rest)
+
+    return qstep, wrapped
+
+
 def generate(model, prompt_ids, max_new_tokens: int,
              max_length: Optional[int] = None, greedy: bool = True,
              temperature: float = 1.0, top_k: int = 0, eos_token: int = -1,
-             seed: int = 0, kv_cache_dtype: Optional[str] = None):
+             seed: int = 0, kv_cache_dtype: Optional[str] = None,
+             weight_dtype: Optional[str] = None):
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` (B, P).
 
     ``model`` must provide ``decode_step``/``init_cache`` (the causal LM
@@ -144,6 +193,8 @@ def generate(model, prompt_ids, max_new_tokens: int,
     """
     prompt, b, p, lmax, ck, cv, step_fn, params = _prep(
         model, prompt_ids, max_new_tokens, max_length, kv_cache_dtype)
+    step_fn, params = _apply_weight_dtype(model, step_fn, params,
+                                          weight_dtype)
 
     # Memoize the compiled program per model: a fresh closure every
     # call would miss jax.jit's trace cache and recompile each generate()
@@ -153,7 +204,7 @@ def generate(model, prompt_ids, max_new_tokens: int,
     # the same program) and drop sampling knobs that are dead under greedy.
     tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
     ckey = ("generate", b, p, max_new_tokens, lmax, greedy, *tkey,
-            int(eos_token), kv_cache_dtype)
+            int(eos_token), kv_cache_dtype, weight_dtype)
     store, cached = _decode_cache(model, ckey)
     if cached is not None:
         out = cached(params, _unwrap(prompt), _unwrap(ck), _unwrap(cv),
@@ -194,7 +245,8 @@ def generate(model, prompt_ids, max_new_tokens: int,
 def beam_search(model, prompt_ids, max_new_tokens: int, beam_size: int = 4,
                 max_length: Optional[int] = None, alpha: float = 1.0,
                 eos_token: int = -1,
-                kv_cache_dtype: Optional[str] = None):
+                kv_cache_dtype: Optional[str] = None,
+                weight_dtype: Optional[str] = None):
     """Beam-search decoding (the gluonnlp-era capability, re-built
     TPU-first): ONE ``lax.scan`` whose carry holds the (L, B*K, H, Lmax, D)
     KV caches; beam reordering is a batched gather on the cache's beam
@@ -210,13 +262,15 @@ def beam_search(model, prompt_ids, max_new_tokens: int, beam_size: int = 4,
     # cross host->device)
     prompt, b, p, lmax, ck, cv, step_fn, params = _prep(
         model, prompt_ids, max_new_tokens, max_length, kv_cache_dtype)
+    step_fn, params = _apply_weight_dtype(model, step_fn, params,
+                                          weight_dtype)
 
     neg_inf = -1e9
 
     # same memoization as generate(): one compiled program per static
     # decode config, current weights flow through ``params``
     ckey = ("beam", b, p, max_new_tokens, lmax, k, float(alpha),
-            int(eos_token), kv_cache_dtype)
+            int(eos_token), kv_cache_dtype, weight_dtype)
     store, cached = _decode_cache(model, ckey)
     if cached is not None:
         seqs, scores = cached(params, _unwrap(prompt), _unwrap(ck),
