@@ -29,13 +29,20 @@ def _matmul_precision(dtype):
     """One policy for every kernel matmul, fwd and bwd: bf16 runs at
     native MXU precision (HIGHEST on bf16 is a Mosaic reject; f32
     accumulation comes from preferred_element_type); f32 follows the
-    ambient jax_default_matmul_precision (docs/precision.md)."""
+    ambient jax_default_matmul_precision (docs/precision.md).
+
+    Mosaic's dot lowering accepts only DEFAULT and HIGHEST — an ambient
+    "high" (3-pass bf16) reaching a kernel dot is a compile-time
+    NotImplementedError that surfaces at the ENCLOSING jit (observed:
+    bert_base/fp32 train bench, 2026-08-02). For f32 inputs "high" maps
+    to HIGHEST: accuracy >= what the caller asked for, at 6-pass cost on
+    the attention dots only; callers who want the fast path run bf16."""
     if dtype == jnp.bfloat16:
         return jax.lax.Precision.DEFAULT
     amb = jax.config.jax_default_matmul_precision
     return {"highest": jax.lax.Precision.HIGHEST,
-            "high": jax.lax.Precision.HIGH}.get(amb,
-                                                jax.lax.Precision.DEFAULT)
+            "high": jax.lax.Precision.HIGHEST}.get(amb,
+                                                   jax.lax.Precision.DEFAULT)
 
 
 def _mha_reference(q, k, v, causal: bool, sm_scale: float):
@@ -123,6 +130,126 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, causal,
                 jnp.where(l_ref[:] == 0.0, jnp.float32(1.0), l_ref[:]))
 
 
+def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale,
+                           causal, block_q, block_k, seq_q, seq_k, n_k,
+                           precision):
+    """Resident-KV forward: one grid program per (bh, q-block), the
+    ENTIRE (transposed) K and V for that head delivered to VMEM by the
+    BlockSpec, and a STATIC python loop over K chunks inside the kernel.
+
+    Why (round 5, measured 2026-08-02): the streaming kernel's
+    (bh, n_q, n_k) grid puts ~0.5 us of math in each of 3072 programs at
+    GPT-small shapes (B32 H12 L1024 D64) — per-program overhead made the
+    attention op 18x slower than an MLP matmul of equal FLOPs in the
+    same window (42 ms vs 11.5 ms fwd+bwd per layer). At d=64 a whole
+    head's K is 128 KB — VMEM fits the full K/V up to L~16k, so the k
+    loop belongs INSIDE the program: no per-chunk grid overhead, online
+    softmax state in plain values (no scratch ref round-trips), and the
+    causal skip (pl.when per chunk) still saves the MXU work.
+    """
+    lse_ref = rest[0] if len(rest) == 4 else None
+    acc_ref, m_ref, l_ref = rest[-3:]
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0]                                         # (bq, d)
+    neg_inf = jnp.float32(_NEG_INF)
+
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, neg_inf)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+    # softmax in base-2: fold log2(e) into the score scale so
+    # p = exp2(s2 - m2) — Mosaic's exp2 is the cheap transcendental and
+    # the rescale costs zero extra VPU passes (it rides the existing
+    # sm_scale multiply). lse is converted back to natural log at the end.
+    LOG2E = 1.4426950408889634
+    scale2 = jnp.float32(sm_scale * LOG2E)
+
+    def chunk_body(j, masked):
+        """One (bq, bk) K chunk. ``masked`` is a trace-time flag: the
+        iota/compare/select mask stack (≈6 VPU passes over the score
+        block — HALF this kernel's runtime at d=64, where everything is
+        VPU-bound) is emitted only for chunks that can actually contain
+        masked lanes: the causal diagonal and the padded tail. Interior
+        chunks run mask-free."""
+        kt = k_ref[0, :, j * block_k:(j + 1) * block_k]   # (d, bk)
+        vj = v_ref[0, j * block_k:(j + 1) * block_k, :]   # (bk, d)
+        s2 = jax.lax.dot_general(
+            q, kt, (((1,), (0,)), ((), ())),
+            precision=precision,
+            preferred_element_type=jnp.float32) * scale2
+        if masked:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = (k_pos < seq_k) & (q_pos < seq_q)
+            if causal:
+                mask &= k_pos <= q_pos + (seq_k - seq_q)
+            s2 = jnp.where(mask, s2, neg_inf)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s2.max(axis=-1, keepdims=True))
+        p = jnp.exp2(s2 - m_new)
+        if masked:
+            p = jnp.where(mask, p, jnp.float32(0.0))
+        alpha = jnp.exp2(m_prev - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
+            precision=precision,
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    shift = seq_k - seq_q
+    for j in range(n_k):
+        lo = j * block_k                  # chunk's first k position
+        hi = (j + 1) * block_k - 1        # chunk's last k position
+        pad_chunk = hi >= seq_k           # trace-time: k padding present
+        if causal:
+            # runtime causal gate: wholly-future chunks are skipped
+            # (saves the MXU/VPU half above the diagonal; K/V are
+            # resident so the skip costs nothing)
+            run = lo <= qi * block_q + (block_q - 1) + shift
+            # runtime: does the diagonal cross this chunk for ANY row of
+            # this q block? below-diagonal chunks need no causal mask
+            diag = hi > qi * block_q + shift
+            if pad_chunk:
+                pl.when(run)(functools.partial(chunk_body, j, True))
+            else:
+                pl.when(jnp.logical_and(run, diag))(
+                    functools.partial(chunk_body, j, True))
+                pl.when(jnp.logical_and(run, jnp.logical_not(diag)))(
+                    functools.partial(chunk_body, j, False))
+        else:
+            # q-padding rows need no mask: their softmax is independent
+            # garbage on rows the caller slices away
+            chunk_body(j, pad_chunk)
+
+    l = l_ref[:, :1]
+    o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, jnp.float32(1.0), l)
+                ).astype(o_ref.dtype)
+    if lse_ref is not None:
+        # m/l are base-2; natural-log lse = (m2 + log2 l) / log2 e
+        lse_ref[0] = (m_ref[:] + jnp.log2(
+            jnp.where(l_ref[:] == 0.0, jnp.float32(1.0), l_ref[:]))
+        ) / jnp.float32(LOG2E)
+
+
+# VMEM budget for the resident-KV path: K + V (bf16, double-buffered by
+# the pipeline) + q/out blocks + the (bq, bk) f32 score chunk, with
+# headroom under the ~16 MB VMEM. Above it, the streaming grid kernel
+# keeps correctness at any length.
+_RESIDENT_KV_VMEM_BYTES = 8 * 1024 * 1024
+
+
+def _resident_fits(lk, d, itemsize):
+    return 4 * lk * d * itemsize <= _RESIDENT_KV_VMEM_BYTES
+
+
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                    save_residuals=False):
     import jax.experimental.pallas as pl
@@ -144,26 +271,62 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     vp = vp.reshape(b * h, n_k * bk, d)
 
     precision = _matmul_precision(q.dtype)
-    kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
-        block_k=bk, seq_q=lq, seq_k=lk, n_k=n_k, precision=precision)
-    out_specs = [
-        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, jnp.int32(0))),
-    ]
-    out_shape = [jax.ShapeDtypeStruct((b * h, n_q * bq, d), q.dtype)]
-    if save_residuals:
-        out_specs.append(pl.BlockSpec(
-            (1, bq, 128), lambda bh, qi, ki: (bh, qi, jnp.int32(0))))
-        out_shape.append(
-            jax.ShapeDtypeStruct((b * h, n_q * bq, 128), jnp.float32))
-    res = pl.pallas_call(
-        kernel,
-        grid=(b * h, n_q, n_k),
-        in_specs=[
+    resident = _resident_fits(n_k * bk, d, qp.dtype.itemsize)
+    if resident:
+        # one program per (bh, q-block); the k loop lives inside the
+        # kernel (see _flash_kernel_resident: ~4x fewer, fatter grid
+        # programs — the streaming grid was per-program-overhead-bound
+        # at moderate L)
+        kernel = functools.partial(
+            _flash_kernel_resident, sm_scale=sm_scale, causal=causal,
+            block_q=bq, block_k=bk, seq_q=lq, seq_k=lk, n_k=n_k,
+            precision=precision)
+        grid = (b * h, n_q)
+        in_specs = [
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, jnp.int32(0))),
+            pl.BlockSpec((1, d, n_k * bk),
+                         lambda bh, qi: (bh, jnp.int32(0), jnp.int32(0))),
+            pl.BlockSpec((1, n_k * bk, d),
+                         lambda bh, qi: (bh, jnp.int32(0), jnp.int32(0))),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, jnp.int32(0))),
+        ]
+        if save_residuals:
+            out_specs.append(pl.BlockSpec(
+                (1, bq, 128), lambda bh, qi: (bh, qi, jnp.int32(0))))
+    else:
+        kernel = functools.partial(
+            _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+            block_k=bk, seq_q=lq, seq_k=lk, n_k=n_k, precision=precision)
+        grid = (b * h, n_q, n_k)
+        in_specs = [
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, jnp.int32(0))),
             pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, jnp.int32(0), ki)),
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, jnp.int32(0))),
-        ],
+        ]
+        out_specs = [
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, jnp.int32(0))),
+        ]
+        if save_residuals:
+            out_specs.append(pl.BlockSpec(
+                (1, bq, 128), lambda bh, qi, ki: (bh, qi, jnp.int32(0))))
+    out_shape = [jax.ShapeDtypeStruct((b * h, n_q * bq, d), q.dtype)]
+    if save_residuals:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, n_q * bq, 128), jnp.float32))
+    # resident grid dims are independent programs (PARALLEL lets Mosaic
+    # pipeline/reorder them); the streaming grid NEEDS its last dim
+    # sequential — the scratch accumulators carry across k programs
+    compiler_params = None
+    if not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel") if resident
+            else ("parallel", "parallel", "arbitrary"))
+    res = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -171,6 +334,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
+        compiler_params=compiler_params,
         interpret=interpret,
     )(qp, kp, vp)
     out = res[0].reshape(b, h, n_q * bq, d)[:, :, :lq, :]
@@ -457,7 +621,13 @@ def _bwd_pallas_ok(b, h, d, dtype, causal, lq, lk, bq, bk):
     Training shapes are static, so this is one compile per distinct
     shape; the probe's zeros are freed as soon as it returns."""
     key = (int(b), int(h), int(d), jnp.dtype(dtype).name, bool(causal),
-           int(lq), int(lk), int(bq), int(bk))
+           int(lq), int(lk), int(bq), int(bk),
+           # the RESOLVED kernel precision participates in what the
+           # kernel compiles to, so it is part of the probe's identity;
+           # keying on the raw ambient string would recompile the probe
+           # for ambients that lower identically (f32 high==highest,
+           # bf16 always DEFAULT)
+           str(_matmul_precision(dtype)))
     if key not in _BWD_PALLAS_STATE:
         try:
             q = jnp.zeros((b, h, lq, d), dtype)

@@ -186,3 +186,24 @@ def test_flash_attention_grad():
     for a, b_ in zip(g_f, g_r):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b_),
                                     rtol=2e-4, atol=2e-4)
+
+
+def test_kernel_precision_is_mosaic_lowerable():
+    """Ambient matmul precision must never reach a kernel dot as HIGH:
+    Mosaic's dot lowering accepts only DEFAULT and HIGHEST, and the
+    reject surfaces at the ENCLOSING jit's compile (observed killing the
+    bert_base/fp32 train bench on TPU, 2026-08-02). f32 under ambient
+    "high" maps to HIGHEST (accuracy >= requested); bf16 always runs the
+    native one-pass path."""
+    from mxnet_tpu.ops.pallas.flash_attention import _matmul_precision
+    mosaic_ok = (jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST)
+    for ambient in ("default", "high", "highest", None):
+        with jax.default_matmul_precision(ambient):
+            for dt in (jnp.float32, jnp.bfloat16):
+                p = _matmul_precision(dt)
+                assert p in mosaic_ok, (ambient, dt, p)
+            assert _matmul_precision(jnp.bfloat16) is jax.lax.Precision.DEFAULT
+        # outside the ctx the config reads back as the string; cover the
+        # raw-config read path the kernels actually use too
+    with jax.default_matmul_precision("high"):
+        assert _matmul_precision(jnp.float32) is jax.lax.Precision.HIGHEST

@@ -530,3 +530,166 @@ def test_train_bench_scan_chain_equivalence():
                                - onp.asarray(p1[k])).sum()) for k in snap)
     assert dist_init > 0 and dist_1 > 0, "scan elided the steps"
     assert dist_2 < 0.05 * dist_1, (dist_2, dist_1, dist_init)
+
+
+def test_daemon_merge_model_table_best_of(tmp_path):
+    """Round-5 best-of: the tunnel chip is time-shared and window rates
+    swing 5-10x, so a worse fresh success must NOT displace a better
+    banked row — but the attempt is recorded (honest provenance), and a
+    better fresh success displaces with the old value kept."""
+    import json
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(ROOT, "benchmark"))
+    import tpu_daemon as d
+
+    path = tmp_path / "table.json"
+    now = time.time()
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 100,
+         "captured_unix": now - 7200}]}, open(path, "w"))
+    # worse fresh capture: banked row survives, attempt recorded
+    out = d.merge_model_table(str(path), {"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 60}]})
+    row = out["results"][0]
+    assert row["train_img_s"] == 100
+    assert row["best_of_attempts"] == 2
+    assert row["last_attempt_value"] == 60
+    assert row["last_attempt_unix"] >= now - 1
+    # the recorded attempt satisfies the rehunt worklist...
+    json.dump(out, open(path, "w"))
+    assert d.stale_combos(str(path), [("a", "bf16")],
+                          max_age=3600) == []
+    # ...until it ages out again (oldest_first ordering covered below)
+    row["last_attempt_unix"] = now - 7200
+    json.dump(out, open(path, "w"))
+    assert d.stale_combos(str(path), [("a", "bf16")],
+                          max_age=3600) == [("a", "bf16")]
+    # better fresh capture displaces and keeps the displaced value
+    out2 = d.merge_model_table(str(path), {"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 140}]})
+    row2 = out2["results"][0]
+    assert row2["train_img_s"] == 140
+    assert row2["best_of_attempts"] == 3
+    assert row2["displaced_value"] == 100
+
+
+def test_daemon_stale_combos_oldest_first(tmp_path):
+    import json
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(ROOT, "benchmark"))
+    import tpu_daemon as d
+
+    path = tmp_path / "t.json"
+    now = time.time()
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 1,
+         "captured_unix": now - 3000},
+        {"model": "b", "precision": "bf16", "train_img_s": 1,
+         "captured_unix": now - 9000}]}, open(path, "w"))
+    combos = [("a", "bf16"), ("b", "bf16"), ("c", "bf16")]
+    got = d.stale_combos(str(path), combos, max_age=1800,
+                         oldest_first=True)
+    assert got == [("c", "bf16"), ("b", "bf16"), ("a", "bf16")]
+
+
+def test_daemon_merge_rev_shadow_expiry(tmp_path):
+    """A banked row measured by obsolete code may out-shadow losing
+    fresh captures only for REV_SHADOW_S; after that the best
+    current-rev capture displaces it (code-review r5 finding: a kernel
+    change that legitimately lowers a row's throughput must not leave
+    the table serving a number no current code can reproduce)."""
+    import json
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(ROOT, "benchmark"))
+    import tpu_daemon as d
+
+    path = tmp_path / "t.json"
+    now = time.time()
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 100,
+         "code_rev": "oldrev", "captured_unix": now - 9000,
+         "rev_mismatch_since": now - d.REV_SHADOW_S - 60}]},
+        open(path, "w"))
+    out = d.merge_model_table(str(path), {"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 70,
+         "code_rev": "newrev"}]})
+    row = out["results"][0]
+    assert row["train_img_s"] == 70          # shadow expired: displaced
+    assert row["displaced_value"] == 100
+    # same-rev rows never expire; mismatch stamp starts the clock only
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 100,
+         "code_rev": "newrev", "captured_unix": now - 9000}]},
+        open(path, "w"))
+    out2 = d.merge_model_table(str(path), {"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 70,
+         "code_rev": "newrev"}]})
+    assert out2["results"][0]["train_img_s"] == 100
+    assert "rev_mismatch_since" not in out2["results"][0]
+
+
+def test_daemon_rehunt_skips_never_banked_combos(tmp_path):
+    """banked_only: a combo with no banked success (age inf — possibly a
+    permanently-failing model) must not occupy rehunt slots."""
+    import json
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(ROOT, "benchmark"))
+    import tpu_daemon as d
+
+    path = tmp_path / "t.json"
+    now = time.time()
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 1,
+         "captured_unix": now - 9000}]}, open(path, "w"))
+    combos = [("never", "bf16"), ("a", "bf16")]
+    got = d.stale_combos(str(path), combos, max_age=1800,
+                         oldest_first=True, banked_only=True)
+    assert got == [("a", "bf16")]
+
+
+def test_daemon_rev_shadow_restores_best_current_rev_sample(tmp_path):
+    """At shadow expiry the table must restore the BEST current-rev
+    sample seen during the shadow, not whatever the expiry-moment
+    window gave (code-review r5)."""
+    import json
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(ROOT, "benchmark"))
+    import tpu_daemon as d
+
+    path = tmp_path / "t.json"
+    now = time.time()
+    # banked old-rev row mid-shadow, with a stashed best current-rev 95
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 100,
+         "code_rev": "oldrev", "captured_unix": now - 9000,
+         "rev_mismatch_since": now - d.REV_SHADOW_S - 60,
+         "_shadow_best": {"model": "a", "precision": "bf16",
+                          "train_img_s": 95, "code_rev": "newrev"}}]},
+        open(path, "w"))
+    out = d.merge_model_table(str(path), {"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 40,
+         "code_rev": "newrev"}]})
+    row = out["results"][0]
+    assert row["train_img_s"] == 95       # stashed shadow best wins
+    assert row["displaced_value"] == 100
+    # during the shadow, losing current-rev attempts keep updating the stash
+    json.dump({"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 100,
+         "code_rev": "oldrev", "captured_unix": now - 9000,
+         "rev_mismatch_since": now - 60}]}, open(path, "w"))
+    out2 = d.merge_model_table(str(path), {"device": "tpu", "results": [
+        {"model": "a", "precision": "bf16", "train_img_s": 80,
+         "code_rev": "newrev"}]})
+    row2 = out2["results"][0]
+    assert row2["train_img_s"] == 100     # still shadowed
+    assert row2["_shadow_best"]["train_img_s"] == 80
