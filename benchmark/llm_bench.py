@@ -180,8 +180,9 @@ def main():
         # bf16 compute over fp32 masters (cpu: fp32 straight through —
         # bf16 is emulated there and would blow the watchdog)
         if platform != "cpu":
-            pc = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
-                  for k, v in p.items()}
+            from bench import cast_params_bf16
+
+            pc = cast_params_bf16(p)
         else:
             pc = p
         out, _ = fn(pc, x, key=key)
@@ -377,6 +378,10 @@ def main():
     if peak and platform != "cpu":
         rec["peak_bf16_tflops"] = peak
         rec["mfu"] = round(achieved / peak, 4)
+        # same-window effective-peak control (AFTER all measurements):
+        # mfu_effective separates model efficiency from window throttle
+        from bench import stamp_window_control
+        stamp_window_control(rec)
     text = json.dumps(rec)
     print(text, flush=True)
     if args.output:

@@ -35,6 +35,60 @@ def log(*a):
     print("[peak_probe]", *a, file=sys.stderr, flush=True)
 
 
+def chained_matmul_rate(n, k_steps, dtype=None, acc_dtype=None, runs=3):
+    """K serially-chained n^3 matmuls in ONE jitted executable.
+
+    The carry feeds each step's lhs (bench.py serial-chain rule:
+    repeated identical args is the pattern the tunnel mis-times), and
+    timing ends with a one-element fetch of a value the whole chain
+    feeds into. Module-level so bench children can reuse it as the
+    SAME-WINDOW control (bench.window_control_tflops) — the chip's
+    deliverable rate swings 5-10x between tunnel windows, and only a
+    control measured in the same process separates model efficiency
+    from window quality.
+
+    Returns (tflops, best_launch_seconds)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dtype = dtype or jnp.bfloat16
+    acc_dtype = acc_dtype or jnp.float32
+    rng = onp.random.RandomState(0)
+    if dtype == jnp.int8:
+        a = jnp.asarray(rng.randint(-127, 127, (n, n)), dtype)
+        b = jnp.asarray(rng.randint(-127, 127, (n, n)), dtype)
+    else:
+        a = jnp.asarray(rng.standard_normal((n, n)), dtype)
+        b = jnp.asarray(rng.standard_normal((n, n)), dtype)
+
+    def body(carry, _):
+        out = lax.dot_general(carry, b, (((1,), (0,)), ((), ())),
+                              preferred_element_type=acc_dtype)
+        # renormalise so the chain neither overflows nor denorms,
+        # and the next lhs depends on this step's output
+        nxt = (out - jnp.mean(out)).astype(dtype) if dtype != jnp.int8 \
+            else (out & 127).astype(dtype)
+        return nxt, jnp.sum(out.astype(jnp.float32))
+
+    def chain(a):
+        final, sums = lax.scan(body, a, None, length=k_steps)
+        return jnp.sum(sums)
+
+    jfn = jax.jit(chain)
+    s = jfn(a)
+    float(s)  # compile + warm
+    best = None
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        s = jfn(a)
+        float(s)  # fetch barrier through the full chain
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    flops = 2.0 * n ** 3 * k_steps
+    return flops / best / 1e12, best
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
@@ -63,48 +117,6 @@ def main():
 
     dev = jax.devices()[0]
     log("devices:", jax.devices())
-
-    def chained_matmul_rate(n, k_steps, dtype, acc_dtype):
-        """K serially-chained n^3 matmuls in ONE jitted executable.
-
-        The carry feeds each step's lhs (bench.py serial-chain rule:
-        repeated identical args is the pattern the tunnel mis-times),
-        and timing ends with a one-element fetch of a value the whole
-        chain feeds into.
-        """
-        rng = onp.random.RandomState(0)
-        if dtype == jnp.int8:
-            a = jnp.asarray(rng.randint(-127, 127, (n, n)), dtype)
-            b = jnp.asarray(rng.randint(-127, 127, (n, n)), dtype)
-        else:
-            a = jnp.asarray(rng.standard_normal((n, n)), dtype)
-            b = jnp.asarray(rng.standard_normal((n, n)), dtype)
-
-        def body(carry, _):
-            out = lax.dot_general(carry, b, (((1,), (0,)), ((), ())),
-                                  preferred_element_type=acc_dtype)
-            # renormalise so the chain neither overflows nor denorms,
-            # and the next lhs depends on this step's output
-            nxt = (out - jnp.mean(out)).astype(dtype) if dtype != jnp.int8 \
-                else (out & 127).astype(dtype)
-            return nxt, jnp.sum(out.astype(jnp.float32))
-
-        def chain(a):
-            final, sums = lax.scan(body, a, None, length=k_steps)
-            return jnp.sum(sums)
-
-        jfn = jax.jit(chain)
-        s = jfn(a)
-        float(s)  # compile + warm
-        best = None
-        for _ in range(3):
-            t0 = time.perf_counter()
-            s = jfn(a)
-            float(s)  # fetch barrier through the full chain
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
-        flops = 2.0 * n ** 3 * k_steps
-        return flops / best / 1e12, best
 
     out = {"device_kind": dev.device_kind, "platform": dev.platform,
            "code_rev": code_rev(), "captured_unix": time.time(),

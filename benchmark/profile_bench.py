@@ -60,16 +60,30 @@ def timeit_chained(jfn, x, extra, budget_s=3.0, max_iters=600):
     return dt / iters * 1e3, iters
 
 
-def profile_resnet(batch, quick):
+from bench import cast_params_bf16  # noqa: E402 — the ONE AMP-cast definition
+
+
+def profile_vision(name, batch, quick):
+    """Phase ablation (fwd | fwd+bwd | full step) for any zoo vision
+    model, with achieved-TFLOPs per phase from the jaxpr MAC walk and a
+    conv-stack vs dense-tail forward split where the model has a Flatten
+    boundary (alexnet). Purpose: NAME why a model's MFU is low — a dense
+    tail that is HBM-bound at small batch, conv shapes that can't fill
+    the MXU, or a backward that dominates — instead of guessing
+    (VERDICT r4 weak: alexnet 0.089 / inception_v3 0.083 bf16 train MFU
+    carried no attached cause)."""
     import jax
     import jax.numpy as jnp
 
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
+    from bench import jaxpr_flops, peak_bf16_tflops
 
-    net = vision.resnet50_v1(classes=1000)
+    net = getattr(vision, name)(classes=1000)
     net.initialize()
-    x_np = onp.random.uniform(size=(batch, 3, 224, 224)).astype("float32")
+    in_size = 299 if name.startswith("inception") else 224
+    x_np = onp.random.uniform(size=(batch, 3, in_size, in_size)).astype(
+        "float32")
     y_np = onp.random.randint(0, 1000, (batch,)).astype("int32")
     fn, params = net.functionalize(mx.np.array(x_np), training=True)
     # the EXACT train_bench AMP pattern: fp32 master weights, in-graph
@@ -78,8 +92,7 @@ def profile_resnet(batch, quick):
     y = jnp.asarray(y_np)
 
     def loss_of(p, x, y):
-        pc = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
-              for k, v in p.items()}
+        pc = cast_params_bf16(p)
         out, state = fn(pc, x.astype(jnp.bfloat16))
         state = {k: s.astype(p[k].dtype) for k, s in state.items()}
         logp = jax.nn.log_softmax(out.astype(jnp.float32))
@@ -120,12 +133,58 @@ def profile_resnet(batch, quick):
 
     budget = 1.5 if quick else 3.0
     r = {}
+    # model FLOPs per phase (2*MAC jaxpr walk — same convention as the
+    # banked train/infer MFU rows), so each phase ms maps to achieved
+    # TFLOPs and the artifact can say WHICH phase wastes the chip
+    try:
+        fwd_flops = jaxpr_flops(lambda p, xx: loss_of(p, xx, y)[0],
+                                params, x)
+        train_flops = jaxpr_flops(
+            lambda p, xx: jax.value_and_grad(loss_of, has_aux=True)(
+                p, xx, y)[0][0], params, x)
+        r["fwd_flops"] = fwd_flops
+        r["train_flops"] = train_flops
+    except Exception as e:  # noqa: BLE001 — attribution only
+        log(f"{name} flops walk failed: {e!r}")
+        fwd_flops = train_flops = None
     ms, it = timeit_chained(jax.jit(fwd), x, (params, y), budget)
     r["fwd_ms"] = round(ms, 3)
-    log(f"resnet50 bs{batch} fwd: {ms:.2f} ms ({it} iters)")
+    log(f"{name} bs{batch} fwd: {ms:.2f} ms ({it} iters)")
     ms, it = timeit_chained(jax.jit(fwd_bwd), x, (params, y), budget)
     r["fwd_bwd_ms"] = round(ms, 3)
-    log(f"resnet50 bs{batch} fwd+bwd: {ms:.2f} ms ({it} iters)")
+    log(f"{name} bs{batch} fwd+bwd: {ms:.2f} ms ({it} iters)")
+    # conv-stack vs dense-tail forward split: models whose features
+    # contain a Flatten (alexnet) run convs then big Dense layers; at
+    # small batch the Dense weights (59M for alexnet) are pure HBM reads
+    # with almost no MACs to amortize them, so the tail — not the convs
+    # — can own the step. Time the conv prefix alone to attribute it.
+    # MUST run before the full-step timing: that one donates the param
+    # buffers this prefix shares.
+    try:
+        flat_i = next((i for i, blk in enumerate(net.features)
+                       if type(blk).__name__ == "Flatten"), None)
+    except Exception:  # noqa: BLE001 — models without .features
+        flat_i = None
+    if flat_i is not None:
+        try:
+            conv_net = net.features[:flat_i]
+            cfn, cparams = conv_net.functionalize(
+                mx.np.array(x_np), training=True)
+
+            def conv_fwd(x, p):
+                pc = cast_params_bf16(p)
+                out, _ = cfn(pc, x.astype(jnp.bfloat16))
+                s = jnp.sum(out.astype(jnp.float32)) * 1e-6
+                return s, x * (1 + jnp.tanh(s) * 1e-7)
+
+            ms, _ = timeit_chained(jax.jit(conv_fwd), x, (cparams,),
+                                   budget / 2)
+            r["conv_stack_fwd_ms"] = round(ms, 3)
+            r["dense_tail_fwd_ms_derived"] = round(r["fwd_ms"] - ms, 3)
+            log(f"{name} bs{batch} conv stack fwd: {ms:.2f} ms "
+                f"(dense tail ~{r['dense_tail_fwd_ms_derived']:.2f} ms)")
+        except Exception as e:  # noqa: BLE001 — split is optional
+            log(f"{name} conv-split failed: {e!r}")
     jfull = jax.jit(full, donate_argnums=(0, 1))
     pp, vv = dict(params), dict(vel)
     loss, pp, vv = jfull(pp, vv, x, y)
@@ -141,11 +200,27 @@ def profile_resnet(batch, quick):
     float(loss)
     ms = (time.perf_counter() - t0) / iters * 1e3
     r["full_step_ms"] = round(ms, 3)
-    log(f"resnet50 bs{batch} full step: {ms:.2f} ms")
+    log(f"{name} bs{batch} full step: {ms:.2f} ms")
     r["bwd_ms_derived"] = round(r["fwd_bwd_ms"] - r["fwd_ms"], 3)
     r["optimizer_ms_derived"] = round(r["full_step_ms"] - r["fwd_bwd_ms"], 3)
     r["img_s_full"] = round(batch / (r["full_step_ms"] / 1e3), 1)
+    if fwd_flops and train_flops:
+        r["fwd_achieved_tflops"] = round(
+            fwd_flops / (r["fwd_ms"] * 1e-3) / 1e12, 2)
+        r["train_achieved_tflops"] = round(
+            train_flops / (r["full_step_ms"] * 1e-3) / 1e12, 2)
+        try:
+            peak = peak_bf16_tflops(getattr(jax.devices()[0],
+                                            "device_kind", ""))
+        except Exception:  # noqa: BLE001
+            peak = None
+        if peak:
+            r["train_mfu"] = round(r["train_achieved_tflops"] / peak, 4)
     return r
+
+
+def profile_resnet(batch, quick):
+    return profile_vision("resnet50_v1", batch, quick)
 
 
 def profile_gpt(quick, dims=None):
@@ -180,9 +255,8 @@ def profile_gpt(quick, dims=None):
         return (x + s) % V
 
     def logits_of(p, x):
-        # llm_bench's AMP pattern: fp32 masters, in-graph bf16 cast
-        pc = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
-              for k, v in p.items()}
+        # llm_bench's AMP pattern, via the shared helper
+        pc = cast_params_bf16(p)
         out, _ = fn(pc, x)
         return out
 
@@ -319,6 +393,11 @@ def main():
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--output", default=None)
     ap.add_argument("--resnet-batches", default="32,256")
+    ap.add_argument("--vision-extra",
+                    default="alexnet:32,alexnet:256,"
+                            "inception_v3:32,inception_v3:256",
+                    help="extra model:batch phase profiles (the VERDICT's "
+                         "low-MFU models)")
     ap.add_argument("--quick", action="store_true",
                     help="halved timing budgets (tunnel-friendly)")
     ap.add_argument("--skip-gpt", action="store_true")
@@ -371,6 +450,19 @@ def main():
         except Exception as e:  # noqa: BLE001 — partial profile still banks
             log(f"resnet bs{b} failed: {e!r}")
             rec[f"resnet50_bf16_bs{b}"] = {"error": repr(e)[:300]}
+    # the two low-MFU models the VERDICT asked to be profiled, each at
+    # the contract batch (32) and a fill-the-MXU batch (256): if MFU
+    # rises sharply with batch the cause is launch/fill shape, not the
+    # kernels themselves
+    for spec in [s for s in args.vision_extra.split(",") if s]:
+        vname, _, vb = spec.partition(":")
+        vb = int(vb or 32)
+        key = f"{vname}_bf16_bs{vb}"
+        try:
+            rec[key] = profile_vision(vname, vb, args.quick)
+        except Exception as e:  # noqa: BLE001 — partial profile still banks
+            log(f"{vname} bs{vb} failed: {e!r}")
+            rec[key] = {"error": repr(e)[:300]}
     if not args.skip_gpt:
         # llm_bench's auto-batch ladder: profile the SAME batch the
         # headline trains at (largest that fits), so the phase deltas

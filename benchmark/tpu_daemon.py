@@ -748,6 +748,16 @@ def capture_peak() -> None:
     if not (rec and rec.get("platform") == "tpu"):
         log(f"peak probe capture failed (rc={rc})")
         return
+    # per-row provenance BEFORE the best-of merge: a kept old row keeps
+    # its own captured_unix/code_rev, so the file-level stamp (refreshed
+    # every pass) can never mis-date a weeks-old best (same contract as
+    # the model tables' per-row stamps)
+    now = time.time()
+    rev = code_rev()
+    for sect in ("bf16", "int8"):
+        for r in rec.get(sect) or []:
+            r.setdefault("captured_unix", now)
+            r.setdefault("code_rev", rev)
     try:
         with open(PEAK) as f:
             banked = json.load(f)
@@ -755,6 +765,14 @@ def capture_peak() -> None:
             banked = {}
     except Exception:  # noqa: BLE001
         banked = {}
+    # legacy banked rows predate per-row stamping: inherit the banked
+    # file-level stamp so age is visible, if coarse
+    banked_stamp = banked.get("captured_unix")
+    for sect in ("bf16", "int8"):
+        for r in banked.get(sect) or []:
+            if banked_stamp:
+                r.setdefault("captured_unix", banked_stamp)
+            r.setdefault("code_rev", banked.get("code_rev", "?"))
     for sect, metric in (("bf16", "tflops"), ("int8", "tops")):
         by_nk = {}
         for r in banked.get(sect) or []:
@@ -1048,6 +1066,17 @@ def opperf_needs() -> bool:
         return True
 
 
+def opperf_measured_count() -> int:
+    """How many ops the sweep has banked — the main loop compares this
+    across a pass to verify the 'monotonic progress' claim before
+    fast-looping on a live window."""
+    try:
+        with open(OPPERF) as f:
+            return int(json.load(f).get("_meta", {}).get("measured") or 0)
+    except Exception:  # noqa: BLE001
+        return 0
+
+
 def banked_stale(path: str, max_age: float = STALE_AFTER_S):
     """needs-predicate on the record's CONTENT stamps — not file mtime,
     which sibling writers (quant micro, keep-banked stamps) refresh."""
@@ -1148,6 +1177,7 @@ def main() -> None:
                 time.sleep(REFRESH_INTERVAL_S)
                 continue
             log(f"tunnel up; capture pass over: {[l for l, _ in todo]}")
+            opperf_before = opperf_measured_count()
             aborted = False
             for label, cap in todo:
                 if live_lock.held_by_live_process():
@@ -1162,11 +1192,20 @@ def main() -> None:
                 cap()
             left = [l for l, _ in needed()]
             # aborted pass -> fast probe to catch the next window; a
-            # COMPLETED pass always backs off a full refresh interval,
-            # even if some needs were not satisfied by their own capture
-            # (kept-banked verdicts, persistently erroring combos) — the
-            # old hot-spin re-ran expensive captures every 180s
-            wait = PROBE_INTERVAL_S if aborted else REFRESH_INTERVAL_S
+            # COMPLETED pass backs off a full refresh interval — re-running
+            # expensive captures that yielded kept-banked verdicts or
+            # persistently erroring combos every 180s was the old hot-spin
+            # — UNLESS a remaining need made MONOTONIC progress THIS pass:
+            # the opperf sweep resumes from its checkpoint and never
+            # re-measures a banked op, so while each pass closes more of
+            # the 502-op table an hour's sleep just gambles the window
+            # away (round 4 got ~4 usable minutes ALL round). Progress is
+            # verified, not assumed — a sweep stuck on permanently-erroring
+            # ops (measured count flat) must NOT hot-spin the 5400s child.
+            opperf_progressing = ("opperf" in left
+                                  and opperf_measured_count() > opperf_before)
+            wait = (PROBE_INTERVAL_S if aborted or opperf_progressing
+                    else REFRESH_INTERVAL_S)
             log(f"suite pass {'aborted' if aborted else 'done'}; "
                 f"still needed: {left or 'nothing'}; "
                 f"next probe in {wait}s")

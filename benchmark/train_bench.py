@@ -39,7 +39,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # ONE shared table so ratios are computed identically everywhere and the
 # gate test can enforce coverage (benchmark/baselines.py).
 from benchmark.baselines import (attach_infer_ratios,  # noqa: E402
-                                 attach_train_ratios)
+                                 attach_row_analysis, attach_train_ratios)
 
 
 def build_step(net_name, batch, dtype_name, seq_len=128, scan_steps=1):
@@ -206,6 +206,7 @@ def measure_infer(net_name, batch, dtype_name, log, scan_steps=1):
     log(f"{net_name}/{dtype_name}: {img_s:.1f} img/s inference "
         f"({total_iters} steps, {total_dt:.1f}s)")
     attach_infer_ratios(rec)
+    attach_row_analysis(rec)
     return rec
 
 
@@ -275,6 +276,7 @@ def measure(net_name, batch, dtype_name, log, scan_steps=1):
         if peak and dtype_name == "bf16" and dev.platform == "tpu":
             rec["peak_bf16_tflops"] = peak
             rec["mfu"] = round(achieved / peak, 4)
+    attach_row_analysis(rec)
     return rec
 
 
@@ -422,8 +424,16 @@ def child_main(name, batch, prec, cpu, infer=False, recordio_input=False,
     rec["device_kind"] = devs[0].device_kind
     # provenance stamped by the MEASURING child at measurement time (a
     # daemon-side stamp could misattribute if a commit lands mid-child)
-    from bench import code_rev
+    from bench import code_rev, stamp_window_control
     rec["code_rev"] = code_rev()
+    # same-window effective-peak control AFTER the measurement: separates
+    # model/code efficiency (mfu_effective) from window throttle (mfu)
+    if devs[0].platform == "tpu":
+        stamp_window_control(rec)
+        if rec.get("window_control_tflops"):
+            log(f"window control: {rec['window_control_tflops']} TFLOPs"
+                + (f", mfu_effective={rec['mfu_effective']}"
+                   if "mfu_effective" in rec else ""))
     print(json.dumps(rec), flush=True)
 
 
