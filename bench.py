@@ -149,7 +149,7 @@ def jaxpr_flops(fn, *args) -> float:
 _WINDOW_CONTROL = {"tflops": None}
 
 
-def window_control_tflops():
+def window_control_tflops(refresh=False):
     """Same-window effective-peak control, memoized per process: TFLOPs
     of 16 serially-chained 8192^3 bf16 matmuls in ONE executable
     (peak_probe.chained_matmul_rate). The axon chip's deliverable rate
@@ -158,7 +158,11 @@ def window_control_tflops():
     nominal peak conflates model efficiency with window quality.
     Children stamp rows via stamp_window_control(); `mfu_effective` =
     achieved / same-window control is the window-independent number.
-    Returns None off-TPU or on failure."""
+    ``refresh=True`` re-measures (long multi-measurement runs where the
+    memo would go stale at window-drift timescales). Returns None
+    off-TPU or on failure."""
+    if refresh:
+        _WINDOW_CONTROL["tflops"] = None
     if _WINDOW_CONTROL["tflops"] is None:
         try:
             import jax
@@ -185,7 +189,8 @@ def stamp_window_control(rec):
         return rec
     rec["window_control_tflops"] = ctl
     ach = rec.get("achieved_tflops")
-    if ach and rec.get("precision", "bf16") == "bf16":
+    # 0.0 is a real (maximally broken) value, not missing
+    if ach is not None and rec.get("precision", "bf16") == "bf16":
         rec["mfu_effective"] = round(ach / ctl, 4)
     return rec
 

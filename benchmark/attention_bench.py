@@ -150,10 +150,9 @@ def main():
                 # of minutes stale by L=8192 (the timescale of 5-10x
                 # rate swings), so re-measure per length; the daemon
                 # path (one length per child) pays once either way.
-                import bench as _bench
-                if len(args.seqs.split(",")) > 1:
-                    _bench._WINDOW_CONTROL["tflops"] = None
-                ctl = _bench.window_control_tflops()
+                from bench import window_control_tflops
+                ctl = window_control_tflops(
+                    refresh=len(args.seqs.split(",")) > 1)
                 if ctl:
                     rec["window_control_tflops"] = ctl
                     rec["fwd_vs_window_control"] = round(

@@ -355,6 +355,65 @@ class TestBaselineRatios:
         assert rec["v100_fp16_baseline_batch"] == 128
         assert rec["vs_v100_fp16"] == round(9000.0 / 2355.04, 3)
 
+    def test_opperf_compare_ranks_by_excess(self):
+        """The CPU-vs-TPU comparison must rank by excess over the launch
+        floor (not raw ratio — every cheap op is launch-bound over the
+        tunnel) and attach a cause to flagged ops."""
+        from benchmark.opperf.compare import compare
+
+        def op(ms):
+            return [{"avg_time_forward_x": ms, "inputs": {}}]
+
+        # 20 cheap launch-bound ops (floor) + one genuinely slow one
+        cpu = {f"np.op{i}": op(0.01) for i in range(20)}
+        cpu["np.nonzero"] = op(0.5)
+        tpu = {f"np.op{i}": op(5.0) for i in range(20)}
+        tpu["np.nonzero"] = op(90.0)
+        cpu["_meta"] = {"measured": 21}
+        tpu["_meta"] = {"measured": 21, "partial": True}
+        rec = compare(cpu, tpu, top=3)
+        assert rec["_meta"]["ops_compared"] == 21
+        assert rec["_meta"]["tpu_partial"] is True
+        assert abs(rec["_meta"]["launch_floor_ms"] - 5.0) < 1e-6
+        worst = rec["worst"]
+        assert worst[0]["op"] == "np.nonzero"
+        assert abs(worst[0]["tpu_excess_ms"] - 85.0) < 1e-6
+        assert "dynamic output size" in worst[0]["cause"]
+        # launch-bound ops have ~zero excess despite a 500x raw ratio
+        assert worst[1]["tpu_excess_ms"] == 0.0
+
+    def test_opperf_compare_committed_artifact_fresh(self):
+        """The committed comparison must match a regeneration from the
+        committed tables (no drift) and carry a cause for every flagged
+        op. Skips the drift check if the daemon banked a newer opperf
+        table mid-suite (regen and bank are one daemon step, but a read
+        between them would be a false positive)."""
+        import json
+
+        from benchmark.opperf.compare import compare
+
+        cpu_p = os.path.join(ROOT, "benchmark", "opperf",
+                             "results_cpu_full.json")
+        tpu_p = os.path.join(ROOT, "benchmark", "opperf",
+                             "results_tpu.json")
+        out_p = os.path.join(ROOT, "benchmark", "opperf",
+                             "compare_cpu_tpu.json")
+        if not (os.path.exists(cpu_p) and os.path.exists(tpu_p)
+                and os.path.exists(out_p)):
+            pytest.skip("comparison artifacts not present")
+        committed = json.load(open(out_p))
+        for r in committed.get("worst", []):
+            assert r.get("cause"), r["op"]
+        cpu = json.load(open(cpu_p))
+        tpu = json.load(open(tpu_p))
+        if (tpu.get("_meta", {}).get("measured")
+                != committed.get("_meta", {}).get("tpu_measured")):
+            pytest.skip("opperf table advanced past the committed "
+                        "comparison (daemon mid-sweep)")
+        regen = compare(cpu, tpu, top=len(committed.get("worst", [])) or 10)
+        assert regen == committed, "committed comparison drifted from " \
+                                   "the tables — rerun opperf/compare.py"
+
     def test_stamp_window_control(self, monkeypatch):
         """Same-window control stamping: bf16 rows with achieved_tflops
         gain mfu_effective = achieved / control; fp32 rows get the
