@@ -34,10 +34,18 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--no-lock", action="store_true",
+                    help="don't take the live-bench lock (daemon "
+                         "children: the daemon kills any child the "
+                         "moment a live lock appears, so a lock-taking "
+                         "child would be killing itself)")
     args = ap.parse_args()
 
+    import contextlib
+
     from bench import code_rev, live_lock
-    lock = live_lock()
+
+    lock = contextlib.nullcontext() if args.no_lock else live_lock()
     lock.__enter__()
 
     import jax
@@ -88,7 +96,8 @@ def main():
         return round(ms, 3), round(flops_per_step / (best / k_steps) / 1e12, 2)
 
     K = 4 if args.quick else 8
-    out = {"device_kind": dev.device_kind, "code_rev": code_rev(),
+    out = {"device_kind": dev.device_kind, "platform": dev.platform,
+           "code_rev": code_rev(),
            "captured_unix": time.time(),
            "shape": {"b": B, "h": H, "l": L, "d": D, "causal": True},
            "flops_accounting": "FA2 algorithmic, causal x0.5; fwd 2 units, "
@@ -180,7 +189,12 @@ def main():
     lock.__exit__(None, None, None)
     line = json.dumps(out)
     print(line, flush=True)
-    if args.out:
+    # a CPU-fallback run (dead tunnel -> backend fail-soft) must never
+    # overwrite the TPU artifact: block-ladder evidence from the wrong
+    # backend is worse than a stale capture
+    if args.out and dev.platform != "tpu" and "_tpu" in args.out:
+        log(f"platform is {dev.platform}; refusing to write {args.out}")
+    elif args.out:
         tmp = args.out + ".tmp"
         with open(tmp, "w") as f:
             f.write(line + "\n")

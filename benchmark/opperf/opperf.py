@@ -219,17 +219,52 @@ def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None,
 
     platform = jax.devices()[0].platform
     prior = {}
+    poison_counts = {}  # op -> prior poison strikes (see resume below)
     if resume:
         try:
             with open(resume) as f:
                 prev = json.load(f)
             if (prev.get("_meta", {}).get("platform") == platform
                     and prev.get("_meta", {}).get("mode") == "full"):
-                prior = {k: v for k, v in prev.items()
-                         if not k.startswith("_") and isinstance(v, list)
-                         and v and "avg_time" in str(v[0])}
-                log(f"resume: carrying forward {len(prior)} previously "
-                    "measured ops")
+                # carry forward every DETERMINISTIC classification, not
+                # just measurements: a backend-poisoning op (e.g.
+                # np.sort_complex — async UNIMPLEMENTED kills every later
+                # dispatch) retried each sweep would abort the sweep at
+                # the same op forever, so the registry tail behind it
+                # could never be reached. Timeouts ARE retried — they can
+                # be window contention rather than the op's own nature.
+                n_meas = n_cls = n_retry = 0
+                for k, v in prev.items():
+                    if (k.startswith("_") or not isinstance(v, list)
+                            or not v or not isinstance(v[0], dict)):
+                        continue
+                    e0 = v[0]
+                    if "avg_time" in str(e0):
+                        prior[k] = v
+                        n_meas += 1
+                    elif "skipped" in e0:
+                        prior[k] = v
+                        n_cls += 1
+                    elif "error" in e0:
+                        if "TimeoutError" in str(e0.get("error")):
+                            n_retry += 1  # contention-shaped: retry
+                        elif (e0.get("backend_poisoned")
+                                and int(e0.get("poison_count") or 1) < 2):
+                            # a poisoned-abort can mean EITHER a
+                            # deterministic poisoner op (np.sort_complex
+                            # UNIMPLEMENTED) or the tunnel dying mid-op;
+                            # give the op ONE more window before the
+                            # classification sticks
+                            poison_counts[k] = int(
+                                e0.get("poison_count") or 1)
+                            n_retry += 1
+                        else:
+                            prior[k] = v
+                            n_cls += 1
+                log(f"resume: carrying forward {n_meas} measured + "
+                    f"{n_cls} classified (skip/deterministic-error) ops; "
+                    f"retrying {n_retry} (timeouts + first-strike "
+                    "poisons)")
         except Exception as e:  # noqa: BLE001 — no/bad resume file
             log(f"resume file unusable ({e!r}); full sweep")
     # complex-valued FFTs dispatch fine over the axon tunnel but the
@@ -255,7 +290,13 @@ def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None,
                 _write_checkpoint()
             if name in prior:
                 results[name] = prior[name]
-                measured += 1
+                e0 = prior[name][0]
+                if "avg_time" in str(e0):
+                    measured += 1
+                elif "skipped" in e0:
+                    skipped += 1
+                else:
+                    errored += 1
                 continue
             if (platform == "tpu" and name.startswith("np.fft.")
                     and name.split(".")[-1] not in _REAL_FFT_OK):
@@ -290,6 +331,12 @@ def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None,
                     # later dispatch). Stop; the checkpoint keeps what
                     # was honestly measured.
                     results[name][0]["backend_poisoned"] = True
+                    # strike count across sweeps: 2 poisoned aborts on
+                    # the same op = deterministic poisoner, carried
+                    # forward and never retried; 1 may be the tunnel
+                    # dying mid-op (see resume carry-forward)
+                    results[name][0]["poison_count"] = \
+                        poison_counts.get(name, 0) + 1
                     results["_meta"]["aborted_at"] = name
                     log(f"backend poisoned at {name}; aborting sweep")
                     break

@@ -99,16 +99,11 @@ def main():
                          "would be killing itself)")
     args = ap.parse_args()
 
+    import contextlib
+
     from bench import code_rev, live_lock  # shared provenance + chip yield
 
-    class _NoLock:
-        def __enter__(self):
-            return self
-
-        def __exit__(self, *exc):
-            return False
-
-    lock = _NoLock() if args.no_lock else live_lock()
+    lock = contextlib.nullcontext() if args.no_lock else live_lock()
     lock.__enter__()  # daemon yields the chip while this probe runs
 
     import jax

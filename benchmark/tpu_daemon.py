@@ -62,6 +62,7 @@ INFER = os.path.join(HERE, "results_infer_tpu.json")
 PROFILE = os.path.join(HERE, "results_profile_tpu.json")
 TRAIN256 = os.path.join(HERE, "results_train_tpu_bs256.json")
 TRAIN_IO = os.path.join(HERE, "results_train_io_tpu.json")
+ATTNPROBE = os.path.join(HERE, "results_attn_probe_tpu.json")
 
 PROBE_INTERVAL_S = 60        # while the tunnel is down (windows can be
                              # ~4 min total; a slow probe cadence misses
@@ -623,6 +624,14 @@ def capture_opperf() -> None:
             if (isinstance(v, list) and v and "error" not in v[0]
                     and "skipped" not in v[0]) or k not in merged:
                 merged[k] = v
+            elif (isinstance(v, list) and v and "error" in v[0]
+                    and isinstance(merged.get(k), list) and merged[k]
+                    and "error" in merged[k][0]):
+                # fresh error refines a banked error — a measurement is
+                # never displaced by an error, but the poison strike
+                # count (opperf.py resume policy) must advance or a
+                # deterministic poisoner would be retried every sweep
+                merged[k] = v
         meta = dict(rec["_meta"])
         # _meta must describe the MERGED table, not just the fresh run
         meta["measured"] = sum(
@@ -819,6 +828,24 @@ def capture_peak() -> None:
     log(f"banked peak probe -> {PEAK}: "
         f"bf16 {rec.get('effective_peak_bf16_tflops')} TFLOPs, "
         f"int8 {rec.get('effective_peak_int8_tops')} TOPs")
+
+
+def capture_attn_probe() -> None:
+    """Flash-kernel block-size sweep (attn_probe.py): fwd and fwd+bwd
+    per block config vs naive XLA and a control matmul in the SAME
+    window — the evidence behind the default block ladder
+    (_BLOCK_CANDIDATES); re-banked per staleness so a kernel-choice
+    regression shows against a dated control."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "attn_probe.py"),
+         "--quick", "--no-lock", "--out", ATTNPROBE],
+        timeout=1500)
+    rec = parse_json_output(out)
+    if rec and rec.get("platform") == "tpu":
+        log(f"banked attn block probe -> {ATTNPROBE}")
+    else:
+        log(f"attn probe capture failed (rc={rc}, platform="
+            f"{(rec or {}).get('platform')})")
 
 
 def capture_quant_micro() -> None:
@@ -1138,6 +1165,7 @@ CAPTURES = (
     ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION, 4 * 3600), capture_attention),
+    ("attn-probe", banked_stale(ATTNPROBE, 6 * 3600), capture_attn_probe),
     ("hbm", banked_stale(HBM), capture_hbm),
     # table re-hunts: the chip's deliverable rate swings 5-10x between
     # windows, so best-of needs SAMPLES — re-measure the stalest rows

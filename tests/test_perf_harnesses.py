@@ -178,6 +178,54 @@ def test_opperf_resume_carries_measured_rows(tmp_path, monkeypatch):
     assert res2[first] != prior_row
 
 
+def test_opperf_resume_carries_errors_retries_timeouts(tmp_path,
+                                                       monkeypatch):
+    """Deterministic error/skip classifications are carried forward on
+    resume (a backend-poisoning op retried each sweep would abort the
+    sweep at the same spot forever, walling off the registry tail);
+    TimeoutError entries ARE retried (they can be window contention)."""
+    import json
+    import sys
+
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    import benchmark.opperf.utils.op_registry_utils as reg
+    from benchmark.opperf.opperf import run_full_registry
+
+    real_ops = reg.list_all_ops()
+    names = sorted(real_ops)[:4]
+    four = {k: real_ops[k] for k in names}
+    monkeypatch.setattr(reg, "list_all_ops", lambda: four)
+    err_op, skip_op, to_op, poison1_op = names
+    import jax
+    resume = tmp_path / "banked.json"
+    json.dump({
+        "_meta": {"platform": jax.devices()[0].platform, "mode": "full"},
+        # two poison strikes = deterministic poisoner: carried, no retry
+        err_op: [{"error": "JaxRuntimeError('UNIMPLEMENTED')",
+                  "backend_poisoned": True, "poison_count": 2}],
+        skip_op: [{"skipped": "no input rule matched"}],
+        to_op: [{"error": "TimeoutError('op exceeded the per-op time "
+                          "budget')"}],
+        # one strike: could have been the tunnel dying mid-op — retried
+        poison1_op: [{"error": "JaxRuntimeError('socket closed')",
+                      "backend_poisoned": True, "poison_count": 1}],
+    }, open(resume, "w"))
+    res = run_full_registry(warmup=0, runs=1, log=lambda *_: None,
+                            resume=str(resume))
+    # the two-strike poisoner and the skip are carried verbatim
+    assert res[err_op][0].get("poison_count") == 2
+    assert res[skip_op][0] == {"skipped": "no input rule matched"}
+    # the timeout op and the one-strike poison were retried (fresh
+    # measurements on the healthy CPU backend, no carried error)
+    assert "TimeoutError" not in str(res[to_op][0])
+    assert "error" not in res[poison1_op][0]
+    # meta buckets count the carried classifications correctly
+    assert res["_meta"]["errored"] == 1
+    assert res["_meta"]["skipped"] == 1
+    assert res["_meta"]["measured"] == 2
+
+
 def test_device_parity_sweep():
     """tools/device_parity.py: every curated op matches its numpy
     oracle on the current backend (the check_consistency artifact the
