@@ -146,6 +146,26 @@ def jaxpr_flops(fn, *args) -> float:
     return walk(jax.make_jaxpr(fn)(*args).jaxpr)
 
 
+def finite_barrier(val, what="barrier value"):
+    """Fetch-barrier with a finiteness check: every bench ends its
+    timing with a host fetch of a scalar the serially-chained work feeds
+    into — asserting it is finite makes each banked number ALSO evidence
+    that the measured math worked. Added after the quant bench was found
+    timing an all-NaN forward at full speed without noticing (the padded
+    max-pool bf16 overflow, 2026-08-02): NaN propagates through the
+    chain silently, float() doesn't raise, and a throughput row banked
+    from NaN math is worse than no row."""
+    import math
+
+    f = float(val)
+    if not math.isfinite(f):
+        raise RuntimeError(
+            f"non-finite {what} ({f}): the measured computation is "
+            "producing NaN/inf — refusing to bank a throughput of "
+            "broken math")
+    return f
+
+
 _WINDOW_CONTROL = {"tflops": None}
 
 
@@ -316,7 +336,7 @@ def child(platform: str, batch: int = 32) -> None:
             t0 = time.perf_counter()
             for _ in range(pass_iters):
                 out, x = jstep(params, x)
-            float(jnp.sum(out))  # forces the full serial chain per pass
+            finite_barrier(jnp.sum(out), "headline chain output")
             total_dt += time.perf_counter() - t0
             total_launches += pass_iters
         total_iters = total_launches * SCAN_STEPS

@@ -38,7 +38,8 @@ import numpy as onp
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-from bench import code_rev, jaxpr_flops, peak_bf16_tflops  # noqa: E402
+from bench import (code_rev, finite_barrier, jaxpr_flops,  # noqa: E402
+                   peak_bf16_tflops)
 
 
 def log(*a):
@@ -275,7 +276,7 @@ def main():
             for _ in range(iters):
                 loss, params2, velocity2 = jstep(params2, velocity2, x_b,
                                                  key)
-            float(loss)
+            finite_barrier(loss, "llm train loss")
             dt += time.perf_counter() - t0
             total += iters
         B, x = b, x_b

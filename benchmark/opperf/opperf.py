@@ -246,10 +246,15 @@ def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None,
                         prior[k] = v
                         n_cls += 1
                     elif "error" in e0:
-                        if "TimeoutError" in str(e0.get("error")):
-                            n_retry += 1  # contention-shaped: retry
-                        elif (e0.get("backend_poisoned")
-                                and int(e0.get("poison_count") or 1) < 2):
+                        # poison strike rule FIRST: a hang-then-poison op
+                        # (alarm fires, then the canary finds the backend
+                        # dead) carries a TimeoutError STRING but is a
+                        # poisoner — routing it to the timeout-retry
+                        # branch would reset its strikes every sweep and
+                        # wall off the registry tail behind it forever
+                        poisoned = bool(e0.get("backend_poisoned"))
+                        if poisoned and int(e0.get("poison_count")
+                                            or 1) < 2:
                             # a poisoned-abort can mean EITHER a
                             # deterministic poisoner op (np.sort_complex
                             # UNIMPLEMENTED) or the tunnel dying mid-op;
@@ -258,6 +263,9 @@ def run_full_registry(warmup=2, runs=10, log=print, checkpoint=None,
                             poison_counts[k] = int(
                                 e0.get("poison_count") or 1)
                             n_retry += 1
+                        elif (not poisoned and "TimeoutError"
+                                in str(e0.get("error"))):
+                            n_retry += 1  # contention-shaped: retry
                         else:
                             prior[k] = v
                             n_cls += 1

@@ -27,7 +27,7 @@ import numpy as onp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import code_rev  # noqa: E402 — measurement-time provenance stamp
+from bench import code_rev, finite_barrier  # noqa: E402 — provenance + NaN-refusing barrier
 
 
 def _micro_mxu_probe(jax, jnp, log):
@@ -171,7 +171,26 @@ def main():
     q_logits = onp.asarray(jax.jit(q_fn)(q_params, x._data)[0])
     agreement = float(
         (ref_logits.argmax(1) == q_logits.argmax(1)).mean())
-    log(f"top-1 agreement int8 vs fp32: {agreement:.3f}")
+    # top-1 agreement is meaningless when the reference's own top-1
+    # margin is within the quantization noise — with seeded-random
+    # weights and 1000 near-tied classes, a 2% logit perturbation flips
+    # argmax on ~every sample even though the quantization is accurate.
+    # The robust accuracy metric is the relative logit error (verified
+    # ~2% on this framework's int8 path; with trained weights, whose
+    # margins are O(1), that error preserves argmax).
+    rel_err = float(onp.abs(q_logits - ref_logits).mean()
+                    / (onp.abs(ref_logits).mean() + 1e-9))
+    srt = onp.sort(ref_logits, 1)
+    top1_margin = float((srt[:, -1] - srt[:, -2]).mean())
+    noise = float(onp.abs(q_logits - ref_logits).mean())
+    margin_note = (
+        "top1_agreement is not informative here: the fp32 reference's "
+        f"own top-1 margin ({top1_margin:.4g}) is within the int8 logit "
+        f"noise ({noise:.4g}) because weights are seeded-random near-"
+        "ties; logit_rel_err is the accuracy metric"
+    ) if top1_margin < 3 * noise else None
+    log(f"top-1 agreement int8 vs fp32: {agreement:.3f} "
+        f"(logit rel err {rel_err:.4f}, ref top1 margin {top1_margin:.4g})")
 
     def throughput(fn, params, tag, dtype=jnp.float32):
         def step(params, xx):
@@ -195,7 +214,7 @@ def main():
             t0 = time.perf_counter()
             for _ in range(pass_iters):
                 out, xx = jstep(params, xx)
-            float(jnp.sum(out))
+            finite_barrier(jnp.sum(out), "quant chain output")
             dt += time.perf_counter() - t0
             total += pass_iters
         img_s = args.batch * total / dt
@@ -228,6 +247,9 @@ def main():
         "speedup_vs_fp32": round(int8_img_s / fp32_img_s, 3),
         "speedup_vs_bf16": round(int8_img_s / bf16_img_s, 3),
         "top1_agreement": round(agreement, 4),
+        "logit_rel_err": round(rel_err, 4),
+        "ref_top1_margin": round(top1_margin, 6),
+        **({"top1_agreement_note": margin_note} if margin_note else {}),
         "micro_mxu": micro,
     }
     text = json.dumps(rec, indent=2)

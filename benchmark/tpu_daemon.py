@@ -1115,19 +1115,29 @@ def opperf_needs() -> bool:
         return True
 
 
-def opperf_classified_count() -> int:
-    """measured + errored + skipped — the sweep-progress metric the
-    main loop compares across a drain pass. Errors count as progress:
-    classifying a backend-crashing op IS the sweep's answer for it,
-    and counting only `measured` would end the drain while the tail
-    of the registry is still being worked through."""
+def opperf_progress_sig():
+    """(classified_count, aborted_at, poison_strikes) — the sweep-
+    progress signature the main loop compares across a drain pass. Errors count as progress
+    (classifying a backend-crashing op IS the sweep's answer for it),
+    and the abort POSITION counts too: a pass that converts one timeout
+    to a measurement while advancing a poisoner to its final strike can
+    leave the count flat yet still unlock the registry tail for the
+    next pass."""
     try:
         with open(OPPERF) as f:
-            meta = json.load(f).get("_meta", {})
-        return int((meta.get("measured") or 0) + (meta.get("errored") or 0)
-                   + (meta.get("skipped") or 0))
+            table = json.load(f)
+        meta = table.get("_meta", {})
+        n = int((meta.get("measured") or 0) + (meta.get("errored") or 0)
+                + (meta.get("skipped") or 0))
+        # total poison strikes: a pass that only advances a poisoner
+        # from strike 1 to its final strike 2 changes neither the count
+        # nor the abort position, but it DOES unlock the tail next pass
+        strikes = sum(
+            int(v[0].get("poison_count") or 0) for v in table.values()
+            if isinstance(v, list) and v and isinstance(v[0], dict))
+        return (n, meta.get("aborted_at"), strikes)
     except Exception:  # noqa: BLE001
-        return 0
+        return (0, None, 0)
 
 
 def banked_stale(path: str, max_age: float = STALE_AFTER_S):
@@ -1257,12 +1267,13 @@ def main() -> None:
             while not aborted and "opperf" in left:
                 if live_lock.held_by_live_process() or not tpu_alive():
                     break
-                before = opperf_classified_count()
-                log(f"opperf drain: {before} ops banked, window live — "
-                    "continuing the sweep")
+                before = opperf_progress_sig()
+                log(f"opperf drain: {before[0]} ops classified "
+                    f"(aborted_at={before[1]}), window live — continuing "
+                    "the sweep")
                 capture_opperf()
                 left = [l for l, _ in needed()]
-                if opperf_classified_count() <= before:
+                if opperf_progress_sig() == before:
                     break
             # aborted pass -> fast probe to catch the next window; a
             # COMPLETED pass backs off a full refresh interval (the old

@@ -40,6 +40,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # gate test can enforce coverage (benchmark/baselines.py).
 from benchmark.baselines import (attach_infer_ratios,  # noqa: E402
                                  attach_row_analysis, attach_train_ratios)
+from bench import finite_barrier  # noqa: E402 — NaN-refusing fetch barrier
 
 
 def build_step(net_name, batch, dtype_name, seq_len=128, scan_steps=1):
@@ -195,7 +196,7 @@ def measure_infer(net_name, batch, dtype_name, log, scan_steps=1):
         t0 = time.perf_counter()
         for _ in range(pass_iters):
             out, x = jstep(p, x)
-        float(jnp.sum(out))  # barrier through the serial chain
+        finite_barrier(jnp.sum(out), "infer chain output")
         total_dt += time.perf_counter() - t0
         total_launches += pass_iters
     total_iters = total_launches * scan_steps
@@ -247,7 +248,7 @@ def measure(net_name, batch, dtype_name, log, scan_steps=1):
         t0 = time.perf_counter()
         for _ in range(pass_iters):
             p, vel, loss = jstep(p, vel, x, y, key)
-        float(loss)  # barrier: loss of the last serially-chained step
+        finite_barrier(loss, "train loss")
         total_dt += time.perf_counter() - t0
         total_launches += pass_iters
     total_iters = total_launches * scan_steps
@@ -333,7 +334,7 @@ def measure_recordio_train(net_name, batch, dtype_name, log, n_images=512):
                 pp, vv, loss = jstep_u8(pp, vv, data, y, key)
                 n += batch
             if loss is not None:
-                float(loss)  # barrier
+                finite_barrier(loss, "recordio train loss")
             dp.close()  # join the feeder BEFORE freeing the C++ handle
             pipe.close()
             return pp, vv, n

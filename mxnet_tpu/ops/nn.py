@@ -166,9 +166,17 @@ def pooling(
     lower under jit on this TPU backend. Two differentiable lowerings:
     - non-overlapping windows (stride==kernel, no pad, divisible): a
       reshape + reduce — the cheapest possible XLA program;
-    - general: patch extraction (conv_general_dilated_patches, exact under
-      the framework's fp32-highest matmul precision) + reduce over the
-      window axis.
+    - general: patch extraction (conv_general_dilated_patches) + reduce
+      over the window axis. The patch conv is pinned to HIGHEST
+      precision: it is a one-hot selection, not arithmetic, and under
+      the ambient one-pass bf16 default it would (a) quantize every
+      pooled fp32 value to bf16 and (b) turn the fp32 finfo.min padding
+      into -inf (|f32 min| exceeds bf16 max), whose 0-tap products are
+      0 * -inf = NaN — every padded max-pool window NaNs. Found on the
+      real chip 2026-08-02 after the round-4 precision un-pin; the
+      oracle suite pins 'highest' so only default-precision use hit it
+      (regression test: tests/test_layer_smoke.py
+      test_padded_pool_exact_under_default_precision).
     """
     ndim = x.ndim - 2
     channels_last = not layout.startswith("NC")
@@ -245,6 +253,7 @@ def pooling(
         dimension_numbers=lax.conv_dimension_numbers(
             xp.shape, (1, 1) + kernel, _patch_spec(ndim)
         ),
+        precision=lax.Precision.HIGHEST,
     )
     ksize = functools.reduce(lambda a, b: a * b, kernel)
     out_spatial = patches.shape[2:]
@@ -272,6 +281,7 @@ def pooling(
                 dimension_numbers=lax.conv_dimension_numbers(
                     ones.shape, (1, 1) + kernel, _patch_spec(ndim)
                 ),
+                precision=lax.Precision.HIGHEST,
             )
             counts = cpatches.reshape((n, c, ksize) + out_spatial).sum(axis=2)
             out = jnp.sum(pk, axis=2) / counts

@@ -80,6 +80,39 @@ def test_pools_vs_torch(cls, tref):
     onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.seed(7)
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_padded_pool_exact_under_default_precision(pool_type):
+    """Padded/strided pooling must be EXACT under the package's DEFAULT
+    matmul precision, not just the suite's 'highest' pin.
+
+    Regression: the general pooling path extracts windows via a one-hot
+    patch conv; under ambient one-pass bf16 it quantized every pooled
+    fp32 value to bf16 AND turned the fp32 finfo.min padding into -inf
+    (|f32 min| > bf16 max), whose zero-tap products are 0 * -inf = NaN —
+    on the real chip every padded max-pool window was NaN and a whole
+    ResNet-50 eager forward returned all-NaN logits (2026-08-02). The
+    patch conv is now pinned to HIGHEST internally; this test runs with
+    the suite's 'highest' default REMOVED so it exercises what a user's
+    process actually runs."""
+    import jax
+    import torch
+
+    from mxnet_tpu.ops.nn import pooling
+
+    x = onp.random.randn(2, 3, 11, 11).astype(onp.float32)
+    with jax.default_matmul_precision("default"):
+        out = onp.asarray(pooling(mx.np.array(x)._data, kernel=3,
+                                  pool_type=pool_type, stride=2, pad=1))
+    assert not onp.isnan(out).any(), "padded pool produced NaN"
+    tfn = (torch.nn.functional.max_pool2d if pool_type == "max"
+           else torch.nn.functional.avg_pool2d)
+    ref = tfn(torch.from_numpy(x), kernel_size=3, stride=2,
+              padding=1).numpy()
+    # exact: pooling selects/averages values, it is not matmul arithmetic
+    onp.testing.assert_allclose(out, ref, rtol=0, atol=1e-6)
+
+
 @pytest.mark.parametrize("cls", ["GlobalAvgPool1D", "GlobalAvgPool3D",
                                  "GlobalMaxPool1D", "GlobalMaxPool2D",
                                  "GlobalMaxPool3D"])

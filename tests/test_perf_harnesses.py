@@ -462,6 +462,20 @@ class TestBaselineRatios:
         assert regen == committed, "committed comparison drifted from " \
                                    "the tables — rerun opperf/compare.py"
 
+    def test_finite_barrier_refuses_nan(self):
+        """Benches must refuse to bank throughput of broken math: the
+        fetch barrier raises on NaN/inf instead of silently timing it
+        (the quant bench timed an all-NaN forward at full speed before
+        this guard existed)."""
+        import pytest
+
+        import bench
+
+        assert bench.finite_barrier(3.25) == 3.25
+        for bad in (float("nan"), float("inf"), float("-inf")):
+            with pytest.raises(RuntimeError, match="non-finite"):
+                bench.finite_barrier(bad, "test value")
+
     def test_stamp_window_control(self, monkeypatch):
         """Same-window control stamping: bf16 rows with achieved_tflops
         gain mfu_effective = achieved / control; fp32 rows get the
