@@ -88,7 +88,11 @@ def jaxpr_flops(fn, *args) -> float:
     dot_general and conv_general_dilated (the MFU convention — matmul/
     conv work, elementwise excluded). Pure tracing: no compile, no
     backend, so it works when the axon remote-compile server's
-    cost_analysis returns nothing."""
+    cost_analysis returns nothing.
+
+    Traced with the stem space-to-depth rewrite DISABLED: the rewrite
+    executes extra zero-taps (ops/nn.py:_stem_space_to_depth), and MFU
+    must charge the model's algorithmic FLOPs, not the lowering's."""
     import jax
     import math
 
@@ -143,7 +147,24 @@ def jaxpr_flops(fn, *args) -> float:
                     total += mult * sub_flops(sub)
         return total
 
-    return walk(jax.make_jaxpr(fn)(*args).jaxpr)
+    prev = os.environ.get("MXNET_TPU_STEM_S2D")
+    os.environ["MXNET_TPU_STEM_S2D"] = "0"
+    try:
+        # unwrap a jitted fn AND re-wrap in a fresh function object:
+        # jax's trace cache is keyed on (fn identity, avals) — not on the
+        # knob — so tracing the same object again would return a jaxpr
+        # traced under the other knob state (measured: it does)
+        inner = getattr(fn, "__wrapped__", fn)
+
+        def fresh(*a):
+            return inner(*a)
+
+        return walk(jax.make_jaxpr(fresh)(*args).jaxpr)
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_TPU_STEM_S2D", None)
+        else:
+            os.environ["MXNET_TPU_STEM_S2D"] = prev
 
 
 def finite_barrier(val, what="barrier value"):
@@ -353,11 +374,21 @@ def child(platform: str, batch: int = 32) -> None:
         step_flops = None
         if not want_flops:
             return img_s, total_iters, step_flops
-        if SCAN_STEPS == 1:
+        knob = os.environ.get("MXNET_TPU_STEM_S2D", "1")
+        s2d_can_fire = knob == "force" or (
+            knob != "0" and jax.default_backend() == "tpu")
+        if SCAN_STEPS == 1 and not s2d_can_fire:
             # cost_analysis is only consulted for the unscanned step:
             # XLA counts a lax.scan (while-loop) body ONCE, not per trip
             # (verified empirically), so no fixed division can make the
-            # scanned number a per-step count across backends
+            # scanned number a per-step count across backends. It is also
+            # skipped whenever the stem space-to-depth rewrite CAN be in
+            # the compiled graph (knob mirror of _stem_s2d_wanted):
+            # cost_analysis counts the rewrite's zero-taps, and MFU
+            # charges the model's algorithmic FLOPs — the knob-pinned
+            # jaxpr walk below is the one counter honoring that
+            # convention. CPU rows (where the rewrite never fires) keep
+            # their historical cost_analysis basis.
             try:
                 lowered = jstep.lower(params, x)
                 try:

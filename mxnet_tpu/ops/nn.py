@@ -15,6 +15,7 @@ dimension_numbers so XLA is free to pick MXU-friendly internal layouts.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 from typing import Optional, Sequence, Tuple, Union
 
@@ -48,6 +49,83 @@ def fully_connected(x, weight, bias=None, num_hidden=None, flatten=True, no_bias
 # ---------------------------------------------------------------------------
 # convolution
 # ---------------------------------------------------------------------------
+def _s2d_axis_plan(K, S, P):
+    """Per-spatial-dim tap algebra for the space-to-depth stem rewrite.
+
+    A stride-S conv tap reads position S*i + (u - P); splitting u - P into
+    S*du + a (a in [0, S)) maps it onto phase-a of the space-to-depth
+    tensor at spatial offset du. Returns (K2, pad_l, pad_r, lo): kernel
+    length in s2d space, the zero-padding that embeds the original kernel
+    into the (K2*S)-long phase-major layout, and the left lax-conv padding
+    of the rewritten stride-1 conv.
+    """
+    du_min = -((P + S - 1) // S)               # floor((0-P)/S)
+    du_max = (K - 1 - P) // S
+    K2 = du_max - du_min + 1
+    t = P + S * du_min                          # <= 0
+    pad_l, pad_r = -t, K2 * S - K + t
+    lo = -du_min
+    return K2, pad_l, pad_r, lo
+
+
+def _stem_space_to_depth(x, weight, stride, pad, out_sizes):
+    """MXU-friendly lowering of a lane-starved stem conv (NCHW, groups=1,
+    no dilation): the 7x7/s2 (ResNet), 11x11/s4 (AlexNet) and 3x3/s2
+    (Inception) first convs read 3 input channels, which occupy 3 of the
+    MXU's 128 contraction lanes. Folding each SxS spatial block into
+    channels (space-to-depth) multiplies the contraction depth by S*S and
+    turns the conv into an equivalent stride-1 conv whose weight is a pure
+    zero-pad + reshape + transpose of the original — numerically identical
+    taps, autodiff flows through the rearrangement. The standard TPU
+    ResNet trick (reference convs: src/operator/nn/convolution.cc:402
+    always lower the direct form; CUDNN picks algos instead).
+    """
+    N, C, H, W = x.shape
+    O = weight.shape[0]
+    (Sh, Sw), (Ph, Pw) = stride, pad
+    Kh, Kw = weight.shape[2], weight.shape[3]
+    K2h, plh, prh, loh = _s2d_axis_plan(Kh, Sh, Ph)
+    K2w, plw, prw, low = _s2d_axis_plan(Kw, Sw, Pw)
+    Hp, Wp = -(-H // Sh) * Sh, -(-W // Sw) * Sw
+    if Hp != H or Wp != W:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, Hp - H), (0, Wp - W)))
+    # x2: (N, C*Sh*Sw, Hp/Sh, Wp/Sw), channel order (c, row-phase, col-phase)
+    x2 = x.reshape(N, C, Hp // Sh, Sh, Wp // Sw, Sw)
+    x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * Sh * Sw,
+                                                Hp // Sh, Wp // Sw)
+    # w2: embed taps into phase-major layout with the same channel order
+    w2 = jnp.pad(weight, ((0, 0), (0, 0), (plh, prh), (plw, prw)))
+    w2 = w2.reshape(O, C, K2h, Sh, K2w, Sw)
+    w2 = w2.transpose(0, 1, 3, 5, 2, 4).reshape(O, C * Sh * Sw, K2h, K2w)
+    hi_h = out_sizes[0] - 1 + K2h - loh - Hp // Sh
+    hi_w = out_sizes[1] - 1 + K2w - low - Wp // Sw
+    dn = lax.conv_dimension_numbers(x2.shape, w2.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    return lax.conv_general_dilated(
+        x2, w2, window_strides=(1, 1),
+        padding=[(loh, hi_h), (low, hi_w)],
+        dimension_numbers=dn)
+
+
+def _stem_s2d_wanted(x, weight, ndim, stride, dilate, num_group, layout):
+    """Gate for the stem rewrite: 2D NCHW float conv, no groups/dilation,
+    <=4 input channels, strided — and a TPU backend (or forced via
+    MXNET_TPU_STEM_S2D=force for CPU equivalence tests; =0 disables)."""
+    knob = os.environ.get("MXNET_TPU_STEM_S2D", "1")
+    if knob == "0":
+        return False
+    if not (ndim == 2 and layout == "NCHW" and num_group == 1):
+        return False
+    if any(d != 1 for d in dilate) or max(stride) < 2:
+        return False
+    if weight.shape[1] > 4 or not jnp.issubdtype(x.dtype, jnp.floating):
+        return False
+    # rewrite only pays when the kernel spans multiple strides in some dim
+    if weight.shape[2] <= stride[0] and weight.shape[3] <= stride[1]:
+        return False
+    return knob == "force" or jax.default_backend() == "tpu"
+
+
 def convolution(
     x,
     weight,
@@ -76,20 +154,26 @@ def convolution(
         spec = ("N" + spatial + "C", "O" + spatial + "I", "N" + spatial + "C")
     else:
         raise ValueError(f"unsupported layout {layout}")
-    dn = lax.conv_dimension_numbers(x.shape, weight.shape, spec)
-    # no preferred_element_type: the MXU accumulates bf16 convs in fp32
-    # internally and rounds at the final store, so bf16-out == fp32-out +
-    # downcast — and requesting fp32 out breaks the conv transpose rule
-    # (jax's vjp feeds the fp32 cotangent into a bf16-weight grad conv)
-    y = lax.conv_general_dilated(
-        x,
-        weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=num_group,
-    )
+    if _stem_s2d_wanted(x, weight, ndim, stride, dilate, num_group, layout):
+        out_sizes = tuple(
+            (x.shape[2 + i] + 2 * pad[i] - weight.shape[2 + i]) // stride[i]
+            + 1 for i in range(2))
+        y = _stem_space_to_depth(x, weight, stride, pad, out_sizes)
+    else:
+        dn = lax.conv_dimension_numbers(x.shape, weight.shape, spec)
+        # no preferred_element_type: the MXU accumulates bf16 convs in fp32
+        # internally and rounds at the final store, so bf16-out == fp32-out +
+        # downcast — and requesting fp32 out breaks the conv transpose rule
+        # (jax's vjp feeds the fp32 cotangent into a bf16-weight grad conv)
+        y = lax.conv_general_dilated(
+            x,
+            weight,
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            feature_group_count=num_group,
+        )
     if bias is not None:
         if layout.startswith("NC"):
             y = y + bias.reshape((1, -1) + (1,) * ndim)

@@ -113,6 +113,30 @@ def test_padded_pool_exact_under_default_precision(pool_type):
     onp.testing.assert_allclose(out, ref, rtol=0, atol=1e-6)
 
 
+def test_padded_pool_patch_conv_pinned_highest_in_hlo():
+    """The NaN-value check above is vacuous on XLA:CPU (fp32 convs do
+    full fp32 math there regardless of precision config, so the bf16
+    -inf overflow can't reproduce off-chip). Guard the fix at the IR
+    level instead: under DEFAULT ambient precision, the lowered pooling
+    computation must carry the HIGHEST precision pin on its patch conv —
+    that pin is exactly what keeps the real chip from downcasting the
+    finfo(f32).min padding to bf16 -inf."""
+    import jax
+
+    from mxnet_tpu.ops.nn import pooling
+
+    with jax.default_matmul_precision("default"):
+        lowered = jax.jit(
+            lambda a: pooling(a, kernel=3, pool_type="max", stride=2,
+                              pad=1)
+        ).lower(jax.ShapeDtypeStruct((2, 3, 11, 11), "float32"))
+    hlo = lowered.as_text()
+    convs = [ln for ln in hlo.splitlines() if "convolution" in ln]
+    assert convs, "pooling lowering lost its patch conv"
+    assert any("HIGHEST" in ln for ln in convs), (
+        "patch conv lost its HIGHEST precision pin:\n" + "\n".join(convs))
+
+
 @pytest.mark.parametrize("cls", ["GlobalAvgPool1D", "GlobalAvgPool3D",
                                  "GlobalMaxPool1D", "GlobalMaxPool2D",
                                  "GlobalMaxPool3D"])

@@ -1,0 +1,105 @@
+"""Space-to-depth stem-conv rewrite: exact-math equivalence with the
+direct lowering.
+
+The rewrite (`ops/nn.py:_stem_space_to_depth`) turns a lane-starved
+strided stem conv (<=4 input channels) into a stride-1 conv over the
+space-to-depth transform of the input, with the weight rearranged by a
+pure pad/reshape/transpose. Every tap multiplies the same (x, w) pair as
+the direct conv (reference semantics: src/operator/nn/convolution.cc:402),
+so forward AND gradients must match to fp32 tolerance on any backend —
+these tests force the rewrite on CPU via MXNET_TPU_STEM_S2D=force.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops import nn as opsnn
+
+# the three zoo stems the gate targets: ResNet 7x7/s2/p3@224,
+# AlexNet 11x11/s4/p2@224, Inception-v3 3x3/s2/p0@299 (odd H/W)
+STEMS = [
+    (7, 2, 3, 224, 3, 64),
+    (11, 4, 2, 224, 3, 64),
+    (3, 2, 0, 299, 3, 32),
+    # non-square-friendly extras: odd size + pad crossing stride phases
+    (5, 3, 2, 65, 2, 8),
+    (7, 2, 1, 30, 4, 16),
+]
+
+
+def _run(K, S, P, HW, C, O, dtype, monkeypatch, force):
+    rng = onp.random.RandomState(hash((K, S, P)) % 2**31)
+    x = rng.standard_normal((2, C, HW, HW)).astype(dtype)
+    w = rng.standard_normal((O, C, K, K)).astype(dtype) / K
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "force" if force else "0")
+    return opsnn.convolution(jnp.asarray(x), jnp.asarray(w),
+                             stride=S, pad=P)
+
+
+@pytest.mark.parametrize("K,S,P,HW,C,O", STEMS)
+def test_forward_matches_direct(K, S, P, HW, C, O, monkeypatch):
+    y_direct = _run(K, S, P, HW, C, O, onp.float32, monkeypatch, False)
+    y_s2d = _run(K, S, P, HW, C, O, onp.float32, monkeypatch, True)
+    assert y_s2d.shape == y_direct.shape
+    onp.testing.assert_allclose(onp.asarray(y_s2d), onp.asarray(y_direct),
+                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("K,S,P,HW,C,O", STEMS[:3])
+def test_grads_match_direct(K, S, P, HW, C, O, monkeypatch):
+    rng = onp.random.RandomState(0)
+    x = jnp.asarray(rng.standard_normal((2, C, HW, HW)).astype(onp.float32))
+    w = jnp.asarray(rng.standard_normal((O, C, K, K)).astype(onp.float32) / K)
+
+    def loss(x_, w_):
+        return opsnn.convolution(x_, w_, stride=S, pad=P).sum()
+
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "0")
+    gx_d, gw_d = jax.grad(loss, argnums=(0, 1))(x, w)
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "force")
+    gx_s, gw_s = jax.grad(loss, argnums=(0, 1))(x, w)
+    onp.testing.assert_allclose(onp.asarray(gx_s), onp.asarray(gx_d),
+                                rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(onp.asarray(gw_s), onp.asarray(gw_d),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_gate_skips_nonstem(monkeypatch):
+    """Many-channel / unstrided / grouped convs keep the direct path
+    (the rewrite only pays at <=4 input channels)."""
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "force")
+    assert not opsnn._stem_s2d_wanted(
+        jnp.zeros((1, 64, 56, 56)), jnp.zeros((64, 64, 3, 3)),
+        2, (2, 2), (1, 1), 1, "NCHW")        # C=64: lane-healthy already
+    assert not opsnn._stem_s2d_wanted(
+        jnp.zeros((1, 3, 224, 224)), jnp.zeros((64, 3, 3, 3)),
+        2, (1, 1), (1, 1), 1, "NCHW")        # stride 1: nothing to fold
+    assert not opsnn._stem_s2d_wanted(
+        jnp.zeros((1, 3, 224, 224)), jnp.zeros((64, 1, 7, 7)),
+        2, (2, 2), (1, 1), 3, "NCHW")        # grouped
+    assert not opsnn._stem_s2d_wanted(
+        jnp.zeros((1, 3, 224, 224), jnp.int8),
+        jnp.zeros((64, 3, 7, 7), jnp.int8),
+        2, (2, 2), (1, 1), 1, "NCHW")        # int8: quant path untouched
+    assert opsnn._stem_s2d_wanted(
+        jnp.zeros((1, 3, 224, 224)), jnp.zeros((64, 3, 7, 7)),
+        2, (2, 2), (1, 1), 1, "NCHW")        # the ResNet stem
+
+
+def test_resnet_stem_through_model_zoo(monkeypatch):
+    """End-to-end: resnet18 forward is bitwise-insensitive to the knob at
+    fp32 tolerance (the stem is the only conv the gate touches)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1()
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(1).uniform(
+        size=(2, 3, 224, 224)).astype(onp.float32))
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "0")
+    y0 = net(x).asnumpy()
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "force")
+    y1 = net(x).asnumpy()
+    onp.testing.assert_allclose(y1, y0, rtol=1e-4, atol=1e-4)
