@@ -143,12 +143,66 @@ def backend_init_fallback(e: BaseException) -> bool:
     return True
 
 
+_preflight = {"done": False, "lock": threading.Lock()}
+
+
+def preflight_backend() -> None:
+    """Opt-in dead-tunnel HANG guard (``MXNET_TPU_PREFLIGHT=<seconds>``).
+
+    A half-dead accelerator tunnel can make the first backend touch
+    BLOCK indefinitely instead of raising — and once an in-process init
+    hangs, jax's global backend lock wedges every later call, so
+    :func:`backend_init_fallback` never gets an exception to act on
+    (observed 2026-08-02: ``jax.devices()`` under ``JAX_PLATFORMS=axon``
+    blocked >300 s with the tunnel half-down). The only recoverable
+    moment is BEFORE first touch: probe the backend in a killable
+    subprocess with a deadline; on timeout/failure, warn once and flip
+    this process to CPU pre-init. Off by default — a library spawning a
+    subprocess on import-adjacent paths is a policy the user opts into
+    (the bench harnesses keep their own in-child watchdogs)."""
+    budget = os.environ.get("MXNET_TPU_PREFLIGHT", "")
+    if not budget:
+        return
+    with _preflight["lock"]:
+        if _preflight["done"] or _backend_fallback["active"]:
+            return
+        _preflight["done"] = True
+        try:
+            timeout_s = max(1.0, float(budget))
+        except ValueError:
+            return
+        import subprocess
+        import sys
+        import warnings
+
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s, capture_output=True)
+            ok = proc.returncode == 0
+        except Exception:  # noqa: BLE001 — timeout/spawn failure = dead
+            ok = False
+        if not ok:
+            warnings.warn(
+                "mxnet_tpu: backend preflight probe failed or timed out "
+                f"after {timeout_s:.0f}s (MXNET_TPU_PREFLIGHT) — the "
+                "configured JAX backend looks down or hung. Falling back "
+                "to the CPU backend for this process; set "
+                "JAX_PLATFORMS=cpu to choose this explicitly, or restore "
+                "the accelerator (TPU tunnel) and restart.",
+                RuntimeWarning, stacklevel=3)
+            jax.config.update("jax_platforms", "cpu")
+            with _backend_fallback["lock"]:
+                _backend_fallback["active"] = True
+
+
 def failsoft_call(fn, *args, **kwargs):
     """Run ``fn`` retrying once through :func:`backend_init_fallback`.
     Guard for the process's FIRST backend touch at the library's entry
     chokepoints (eager-op dispatch, array creation, RNG key creation,
     device enumeration): a backend-init failure there has executed
     nothing yet, so the retry after the CPU flip is safe."""
+    preflight_backend()
     try:
         return fn(*args, **kwargs)
     except RuntimeError as e:
