@@ -72,7 +72,7 @@ def __getattr__(name):
                "lr_scheduler": ".optimizer.lr_scheduler",
                "registry": ".registry", "executor": ".executor",
                "recordio": ".recordio", "serialization": ".serialization",
-               "misc": ".misc", "torch": ".torch"}
+               "misc": ".misc", "torch": ".torch", "serving": ".serving"}
     if name in targets:
         expected = importlib.util.resolve_name(targets[name], __name__)
         try:

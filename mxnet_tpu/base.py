@@ -95,6 +95,7 @@ __all__ = [
     "dtype_from_any",
     "registry",
     "env_int",
+    "env_float",
     "env_bool",
     "env_str",
 ]
@@ -144,6 +145,7 @@ def backend_init_fallback(e: BaseException) -> bool:
 
 
 _preflight = {"done": False, "lock": threading.Lock()}
+_PREFLIGHT_DEFAULT_S = 60.0  # used when MXNET_TPU_PREFLIGHT is unparseable
 
 
 def preflight_backend() -> None:
@@ -160,40 +162,69 @@ def preflight_backend() -> None:
     this process to CPU pre-init. Off by default — a library spawning a
     subprocess on import-adjacent paths is a policy the user opts into
     (the bench harnesses keep their own in-child watchdogs)."""
+    # Lock-free fast path (ADVICE low #1): failsoft_call wraps EVERY
+    # eager op dispatch, so once the probe ran (or the fallback already
+    # fired) this must be a couple of dict reads, not a lock handoff
+    # that serializes multithreaded eager/serving workloads for the
+    # life of the process. Both flags only ever transition False->True,
+    # and "done" is set only AFTER the probe verdict (below) — so a
+    # thread seeing True can safely touch the backend, and a stale
+    # False just falls through to the locked re-check. While the probe
+    # is in flight, concurrent first-touch threads still block on the
+    # lock: letting them through early would hand them the very hang
+    # the guard exists to prevent.
+    if _preflight["done"] or _backend_fallback["active"]:
+        return
     budget = os.environ.get("MXNET_TPU_PREFLIGHT", "")
     if not budget:
         return
     with _preflight["lock"]:
         if _preflight["done"] or _backend_fallback["active"]:
             return
-        _preflight["done"] = True
-        try:
-            timeout_s = max(1.0, float(budget))
-        except ValueError:
-            return
+        # try/finally, not an up-front flag write: "done" must become
+        # True exactly once per process even if a warn below raises
+        # (warnings-as-errors runs) — otherwise every later dispatch
+        # re-pays the subprocess probe — while still only being visible
+        # to lock-free readers after the verdict/flip is applied.
         import subprocess
         import sys
         import warnings
 
         try:
-            proc = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout_s, capture_output=True)
-            ok = proc.returncode == 0
-        except Exception:  # noqa: BLE001 — timeout/spawn failure = dead
-            ok = False
-        if not ok:
-            warnings.warn(
-                "mxnet_tpu: backend preflight probe failed or timed out "
-                f"after {timeout_s:.0f}s (MXNET_TPU_PREFLIGHT) — the "
-                "configured JAX backend looks down or hung. Falling back "
-                "to the CPU backend for this process; set "
-                "JAX_PLATFORMS=cpu to choose this explicitly, or restore "
-                "the accelerator (TPU tunnel) and restart.",
-                RuntimeWarning, stacklevel=3)
-            jax.config.update("jax_platforms", "cpu")
-            with _backend_fallback["lock"]:
-                _backend_fallback["active"] = True
+            try:
+                timeout_s = max(1.0, float(budget))
+            except ValueError:
+                # an unparseable budget must not silently DISARM the
+                # hang guard the user asked for (ADVICE low #2) — warn
+                # naming the bad value and probe with the default
+                # deadline instead
+                warnings.warn(
+                    f"MXNET_TPU_PREFLIGHT={budget!r} is not a number of "
+                    "seconds; running the backend preflight probe with "
+                    f"the default {_PREFLIGHT_DEFAULT_S:.0f}s timeout "
+                    "instead", RuntimeWarning, stacklevel=3)
+                timeout_s = _PREFLIGHT_DEFAULT_S
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", "import jax; jax.devices()"],
+                    timeout=timeout_s, capture_output=True)
+                ok = proc.returncode == 0
+            except Exception:  # noqa: BLE001 — timeout/spawn fail = dead
+                ok = False
+            if not ok:
+                jax.config.update("jax_platforms", "cpu")
+                with _backend_fallback["lock"]:
+                    _backend_fallback["active"] = True
+                warnings.warn(
+                    "mxnet_tpu: backend preflight probe failed or timed "
+                    f"out after {timeout_s:.0f}s (MXNET_TPU_PREFLIGHT) — "
+                    "the configured JAX backend looks down or hung. "
+                    "Falling back to the CPU backend for this process; "
+                    "set JAX_PLATFORMS=cpu to choose this explicitly, or "
+                    "restore the accelerator (TPU tunnel) and restart.",
+                    RuntimeWarning, stacklevel=3)
+        finally:
+            _preflight["done"] = True
 
 
 def failsoft_call(fn, *args, **kwargs):
@@ -294,6 +325,26 @@ def env_int(name: str, default: int = 0) -> int:
     try:
         return int(os.environ.get(name, default))
     except ValueError:
+        return default
+
+
+def env_float(name: str, default: float = 0.0) -> float:
+    """Float-valued knob with a LOUD bad-value policy: unlike
+    :func:`env_int` (whose silent-default contract existing callers
+    rely on), a set-but-unparseable value warns naming the variable —
+    a typo'd knob must not be silently ignored (the
+    ``MXNET_TPU_PREFLIGHT='5s'`` lesson, ADVICE low #2)."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"{name}={val!r} is not a number; using the default "
+            f"{default!r}", RuntimeWarning, stacklevel=2)
         return default
 
 

@@ -439,6 +439,7 @@ class HybridBlock(Block):
 
     def _signature(self, flat_vals, training: bool):
         from ..ops import dispatch as _dispatch
+        from ..ops.nn import stem_s2d_cache_key
 
         amp_key = (getattr(_dispatch.amp_policy, "version", None)
                    if _dispatch.amp_policy is not None else None)
@@ -446,6 +447,10 @@ class HybridBlock(Block):
             tuple((tuple(v.shape), str(v.dtype)) for v in flat_vals),
             training,
             amp_key,  # amp.init()/disable() must invalidate cached traces
+            # conv-lowering environment: flipping MXNET_TPU_STEM_S2D (or
+            # landing on another backend mid-process) must re-trace, not
+            # serve a stale lowering from the warm cache
+            stem_s2d_cache_key(),
         )
 
     def _call_cached(self, *args):

@@ -107,6 +107,23 @@ def _stem_space_to_depth(x, weight, stride, pad, out_sizes):
         dimension_numbers=dn)
 
 
+def stem_s2d_cache_key():
+    """The trace-environment component of any jit-cache key whose graph
+    may contain a convolution: ``_stem_s2d_wanted`` reads the
+    ``MXNET_TPU_STEM_S2D`` knob and the active backend at TRACE time, so
+    a cached executable is only valid while both still hold. Long-lived
+    serving processes make mid-process knob flips (equivalence tests,
+    fail-soft CPU fallback after a TPU trace) a real hazard rather than
+    a cosmetic one — cache keys must include this (ADVICE low #3).
+    ``jax.default_backend()`` is touched lazily: cache keys are built on
+    paths where the backend is already initialized."""
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — backend down: keyed as unknown
+        backend = "?"
+    return (os.environ.get("MXNET_TPU_STEM_S2D", "1"), backend)
+
+
 def _stem_s2d_wanted(x, weight, ndim, stride, dilate, num_group, layout):
     """Gate for the stem rewrite: 2D NCHW float conv, no groups/dilation,
     <=4 input channels, strided — and a TPU backend (or forced via

@@ -1,0 +1,35 @@
+"""``mxnet_tpu.serving`` — dynamic-batching inference engine.
+
+The request-coalescing front-end between user traffic and the compiled
+model (ROADMAP: "serves heavy traffic from millions of users"):
+
+- :class:`InferenceEngine` (:mod:`.engine`) — shape-bucketed compiled
+  executable cache, pad-and-slice, buffer donation;
+- :class:`DynamicBatcher` (:mod:`.batcher`) — background micro-batching
+  (``max_batch_size`` / ``max_delay_ms``);
+- :class:`AdmissionQueue` (:mod:`.admission`) — bounded queue, deadlines,
+  typed load shedding (:class:`ServerOverload`, :class:`DeadlineExceeded`);
+- :class:`ServingMetrics` (:mod:`.metrics`) — counters + latency/occupancy
+  histograms, streamed through :mod:`mxnet_tpu.profiler`;
+- :mod:`.bench` — the N-concurrent-synthetic-clients harness behind
+  ``tools/serve_bench.py``.
+
+See ``docs/serving.md`` for architecture, bucketing policy and failure
+semantics.
+"""
+from .admission import (AdmissionQueue, DeadlineExceeded, Request,  # noqa: F401
+                        ServerOverload)
+from .batcher import DynamicBatcher  # noqa: F401
+from .engine import InferenceEngine  # noqa: F401
+from .metrics import Histogram, ServingMetrics  # noqa: F401
+
+__all__ = [
+    "InferenceEngine",
+    "DynamicBatcher",
+    "AdmissionQueue",
+    "Request",
+    "ServerOverload",
+    "DeadlineExceeded",
+    "ServingMetrics",
+    "Histogram",
+]

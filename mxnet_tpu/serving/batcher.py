@@ -1,0 +1,79 @@
+"""Background dynamic micro-batcher.
+
+One daemon thread per engine pulls coalesced micro-batches off the
+admission queue and hands them to the engine's execute callback. The
+coalescing policy is the standard serving tradeoff: fire as soon as
+``max_batch_size`` samples are waiting, or ``max_delay_ms`` after the
+first request of the batch arrived, whichever comes first — a lone
+request on an idle engine therefore pays at most ``max_delay_ms`` of
+added latency, while a busy engine runs full buckets back to back.
+
+Failure isolation: an exception out of one batch's execution fails the
+requests *in that batch* (each submitting thread sees the error re-raised
+by ``Request.wait``) and the loop keeps serving — a poison request must
+not wedge the queue for everyone behind it.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List
+
+from .admission import AdmissionQueue, Request, ServerOverload
+
+__all__ = ["DynamicBatcher"]
+
+log = logging.getLogger(__name__)
+
+
+class DynamicBatcher:
+    def __init__(self, queue: AdmissionQueue,
+                 execute: Callable[[List[Request]], None],
+                 max_batch_size: int, max_delay_ms: float,
+                 metrics=None, name: str = "mxnet_tpu-serving-batcher"):
+        self._queue = queue
+        self._execute = execute
+        self._metrics = metrics
+        self._max_batch = max_batch_size
+        self._max_delay_s = max_delay_ms / 1e3
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._started = False
+
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def join(self, timeout: float = None) -> None:
+        """Wait for the loop to exit (it exits once the queue is closed
+        AND drained — ``AdmissionQueue.take`` returns [] forever after
+        that, and the closed check below breaks out)."""
+        if self._started:
+            self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._started and self._thread.is_alive()
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._queue.take(self._max_batch, self._max_delay_s)
+            if not batch:
+                if self._queue.closed and len(self._queue) == 0:
+                    return
+                continue
+            try:
+                self._execute(batch)
+            except BaseException as e:  # noqa: BLE001 — isolate the batch
+                log.exception("serving batch execution failed; failing the "
+                              "%d request(s) in it", len(batch))
+                for req in batch:
+                    failed_here = req.fail(
+                        e if isinstance(e, Exception) else
+                        ServerOverload(f"batch execution aborted: {e!r}"))
+                    if failed_here and self._metrics is not None:
+                        # errors escaping the engine's own accounting
+                        # (e.g. staging allocation) must still be counted
+                        # or completed+failed silently undercounts
+                        self._metrics.observe_done(req.latency_s, ok=False)

@@ -1,0 +1,263 @@
+"""Serving bench harness: N concurrent synthetic clients vs sequential.
+
+Three phases against one :class:`~mxnet_tpu.serving.engine.InferenceEngine`:
+
+1. **sequential baseline** — the pre-serving status quo: one caller, one
+   request at a time, straight through the compiled batch-1 forward.
+2. **concurrent serving** — ``clients`` closed-loop threads submit
+   single-sample requests for ``duration_s``; throughput, latency
+   percentiles and batch occupancy come from the engine's metrics.
+3. **overload shed** — a burst beyond queue capacity with a tight
+   deadline; verifies typed shedding (``DeadlineExceeded`` /
+   ``ServerOverload``) keeps the process live and reports the shed rate.
+
+Emits ONE JSON row (benchmark/ result-format compatible: ``metric`` /
+``value`` / ``unit`` + supplemental fields) and returns it as a dict.
+Fully CPU-runnable; on CPU the win comes from batch-1 underutilization
+(an FC-heavy CNN is memory-bound on its weights at batch 1), on TPU from
+the same effect squared — the MXU batch dimension — plus dispatch
+amortization.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+__all__ = ["run_serving_bench", "main"]
+
+
+def _code_rev() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:  # same provenance stamp the headline bench banks (bench.py)
+        from bench import code_rev
+        return code_rev()
+    except Exception:  # noqa: BLE001
+        try:
+            return subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=here,
+                capture_output=True, text=True, timeout=10
+            ).stdout.strip() or "?"
+        except Exception:  # noqa: BLE001
+            return "?"
+
+
+def _build_model(model: str, classes: int, image_size: int):
+    """A model-zoo CNN by name, or the tiny synthetic CNN for smoke."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    if model == "synthetic-tiny":
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1),
+                nn.Activation("relu"),
+                nn.GlobalAvgPool2D(),
+                nn.Dense(classes))
+        net.initialize()
+        return net
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model(model, classes=classes)
+    net.initialize()
+    return net
+
+
+def run_serving_bench(model: str = "alexnet", image_size: int = 224,
+                      classes: int = 1000, clients: int = 8,
+                      max_batch: int = 8, max_delay_ms: float = 10.0,
+                      duration_s: float = 8.0, seq_requests: int = 5,
+                      queue_size: int = 64,
+                      shed_deadline_ms: float = 25.0,
+                      log=lambda m: print("[serve_bench]", m,
+                                          file=sys.stderr, flush=True)
+                      ) -> Dict:
+    import jax
+
+    from mxnet_tpu.serving import (DeadlineExceeded, InferenceEngine,
+                                   ServerOverload)
+
+    item_shape = (3, image_size, image_size)
+    net = _build_model(model, classes, image_size)
+    engine = InferenceEngine(
+        net, example_input=onp.zeros((1,) + item_shape, "float32"),
+        max_batch_size=max_batch, max_delay_ms=max_delay_ms,
+        max_queue_size=queue_size)
+    try:
+        rng = onp.random.RandomState(0)
+        sample = rng.uniform(size=(1,) + item_shape).astype("float32")
+
+        t0 = time.time()
+        engine.warmup(item_shape, buckets=[1, max_batch])
+        log(f"warm (buckets 1+{max_batch}) in {time.time() - t0:.1f}s "
+            f"on {jax.default_backend()}")
+
+        # -- phase 1: sequential single-request loop --------------------------
+        t0 = time.perf_counter()
+        for _ in range(seq_requests):
+            out = engine._execute_padded(sample, item_shape, "float32")
+        seq_dt = time.perf_counter() - t0
+        seq_rps = seq_requests / seq_dt
+        log(f"sequential: {seq_rps:.2f} req/s ({seq_requests} reqs)")
+
+        # -- phase 2: concurrent closed-loop clients --------------------------
+        stop = threading.Event()
+        done_counts = [0] * clients
+        errs: List[str] = []
+
+        def client(i: int) -> None:
+            r = onp.random.RandomState(100 + i)
+            x = r.uniform(size=(1,) + item_shape).astype("float32")
+            while not stop.is_set():
+                try:
+                    engine.infer(x)
+                    done_counts[i] += 1
+                except Exception as e:  # noqa: BLE001
+                    errs.append(f"client{i}: {e!r}")
+                    return
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        conc_dt = time.perf_counter() - t0
+        conc_done = sum(done_counts)
+        conc_rps = conc_done / conc_dt
+        snap = engine.stats()
+        log(f"concurrent x{clients}: {conc_rps:.2f} req/s ({conc_done} reqs), "
+            f"mean occupancy {snap['batch_occupancy']['mean']:.2f}")
+
+        # -- phase 3: overload + deadline shedding ----------------------------
+        burst = queue_size + 2 * max_batch
+        handles, shed_overload = [], 0
+        for _ in range(burst):
+            try:
+                handles.append(engine.infer_async(
+                    sample, timeout_ms=shed_deadline_ms))
+            except ServerOverload:
+                shed_overload += 1
+        shed_deadline = served = other = 0
+        for h in handles:
+            try:
+                h.wait()
+                served += 1
+            except DeadlineExceeded:
+                shed_deadline += 1
+            except Exception:  # noqa: BLE001
+                other += 1
+        # the engine must still serve fresh traffic after the storm
+        post = engine.infer(sample)
+        assert post is not None
+        shed_total = shed_overload + shed_deadline
+        shed_rate = shed_total / burst
+        log(f"overload burst {burst}: {served} served, {shed_deadline} "
+            f"deadline-shed, {shed_overload} admission-shed, {other} other")
+
+        final = engine.stats()
+    finally:
+        # idempotent; also reached on phase failures so the
+        # batcher daemon never outlives a crashed bench
+        engine.close()
+    speedup = conc_rps / seq_rps if seq_rps else 0.0
+    row = {
+        "metric": f"serving_dynbatch_{model}_c{clients}",
+        "value": round(conc_rps, 2),
+        "unit": "req/s",
+        "model": model,
+        "image_size": image_size,
+        "clients": clients,
+        "max_batch_size": max_batch,
+        "max_delay_ms": max_delay_ms,
+        "duration_s": round(conc_dt, 2),
+        "requests_completed": conc_done,
+        "sequential_req_s": round(seq_rps, 2),
+        "speedup_vs_sequential": round(speedup, 2),
+        "mean_batch_occupancy": round(final["batch_occupancy"]["mean"], 2),
+        "pad_waste_mean": round(final["pad_waste"]["mean"], 4),
+        "latency_p50_ms": final["latency_ms"]["p50"],
+        "latency_p99_ms": final["latency_ms"]["p99"],
+        "shed": {"burst": burst, "served": served,
+                 "deadline": shed_deadline, "overload": shed_overload,
+                 "rate": round(shed_rate, 3)},
+        "counters": final["counters"],
+        "warm_buckets": [b for (b, _s, _d) in final["warm_buckets"]],
+        "device": jax.default_backend(),
+        "client_errors": errs[:5],
+        "code_rev": _code_rev(),
+    }
+    return row
+
+
+def bank_row(row: Dict, out_path: str) -> None:
+    """Atomically write the banked result file (daemon convention:
+    captured_at + record)."""
+    payload = {
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "captured_unix": time.time(),
+        "record": row,
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, out_path)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="mxnet_tpu serving bench: dynamic batching vs "
+                    "sequential single-request inference")
+    ap.add_argument("--model", default="alexnet",
+                    help="model-zoo name, or synthetic-tiny (smoke)")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=10.0)
+    ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument("--seq-requests", type=int, default=5)
+    ap.add_argument("--out", default=None,
+                    help="bank the row to this JSON file "
+                         "(default benchmark/results_serving_<dev>.json)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + short run (tier-1 wiring)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.model = "synthetic-tiny"
+        args.image_size = 32
+        args.classes = 8
+        args.duration = min(args.duration, 1.5)
+        args.seq_requests = 3
+
+    row = run_serving_bench(
+        model=args.model, image_size=args.image_size, classes=args.classes,
+        clients=args.clients, max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms, duration_s=args.duration,
+        seq_requests=args.seq_requests)
+    if not args.smoke:
+        import jax
+
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "benchmark", f"results_serving_{jax.default_backend()}.json")
+        bank_row(row, out)
+    print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

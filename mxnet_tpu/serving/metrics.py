@@ -1,0 +1,142 @@
+"""Serving metrics: counters + bounded-reservoir histograms.
+
+Thread-safe, cheap on the hot path (one lock, fixed-size deques), and
+wired into the existing :mod:`mxnet_tpu.profiler` surface: while the
+profiler is running, every executed micro-batch emits a ``serving.batch``
+span (the per-op timeline the dispatch layer uses) and the queue-depth /
+occupancy counters stream as chrome://tracing counter events, so a
+serving process profiled with ``profiler.set_state('run')`` shows the
+batcher's behavior alongside the op timeline.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from .. import profiler
+
+__all__ = ["Histogram", "ServingMetrics"]
+
+
+class Histogram:
+    """Streaming summary: exact count/sum/min/max over all observations
+    plus a bounded reservoir (the most recent ``cap`` values) for
+    quantiles. Recency-biased quantiles are the serving-appropriate
+    choice — p99 should describe the current regime, not the warmup."""
+
+    __slots__ = ("count", "total", "min", "max", "_recent")
+
+    def __init__(self, cap: int = 4096):
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._recent: deque = deque(maxlen=cap)
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        self._recent.append(v)
+
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        if not self._recent:
+            return 0.0
+        vals = sorted(self._recent)
+        idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+        return vals[idx]
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "mean": round(self.mean(), 4),
+            "min": round(self.min, 4) if self.min is not None else 0.0,
+            "max": round(self.max, 4) if self.max is not None else 0.0,
+            "p50": round(self.quantile(0.50), 4),
+            "p90": round(self.quantile(0.90), 4),
+            "p99": round(self.quantile(0.99), 4),
+        }
+
+
+class ServingMetrics:
+    """All counters/histograms for one :class:`InferenceEngine`.
+
+    Counters: ``submitted``, ``completed``, ``failed``, ``shed_overload``
+    (rejected at admission), ``shed_deadline`` (expired in queue),
+    ``batches`` (executed micro-batches), ``compiles`` (cold buckets).
+    Histograms: request ``latency_ms``, per-batch ``occupancy`` (real
+    samples per executed batch), ``pad_waste`` (padded-but-dead fraction
+    of the bucket), ``queue_depth`` (at admission).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "failed": 0,
+            "shed_overload": 0, "shed_deadline": 0,
+            "batches": 0, "compiles": 0,
+        }
+        self.latency_ms = Histogram()
+        self.occupancy = Histogram()
+        self.pad_waste = Histogram()
+        self.queue_depth = Histogram()
+        # profiler counter streams (emit only while profiling runs)
+        self._prof_depth = profiler.Counter(name="serving.queue_depth")
+        self._prof_occ = profiler.Counter(name="serving.batch_occupancy")
+
+    # -- recording --------------------------------------------------------
+    def count(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    def observe_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth.observe(float(depth))
+        if profiler.is_running():
+            self._prof_depth.set_value(depth)
+
+    def observe_batch(self, n_real: int, bucket: int, exec_s: float) -> None:
+        """One executed micro-batch: occupancy + pad waste + profiler span."""
+        with self._lock:
+            self._counters["batches"] += 1
+            self.occupancy.observe(float(n_real))
+            self.pad_waste.observe((bucket - n_real) / float(bucket))
+        if profiler.is_running():
+            profiler.record_op(f"serving.batch[b{bucket}]", exec_s,
+                               cat="serving")
+            self._prof_occ.set_value(n_real)
+
+    def observe_done(self, latency_s: float, ok: bool, n: int = 1) -> None:
+        with self._lock:
+            self._counters["completed" if ok else "failed"] += n
+            if ok:
+                self.latency_ms.observe(latency_s * 1e3)
+
+    # -- reading ----------------------------------------------------------
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def snapshot(self) -> Dict:
+        """One JSON-friendly dict with everything — the shape the bench
+        harness banks and ``InferenceEngine.stats()`` returns."""
+        with self._lock:
+            snap = {
+                "counters": dict(self._counters),
+                "latency_ms": self.latency_ms.summary(),
+                "batch_occupancy": self.occupancy.summary(),
+                "pad_waste": self.pad_waste.summary(),
+                "queue_depth": self.queue_depth.summary(),
+                "ts_unix": time.time(),
+            }
+        c = snap["counters"]
+        shed = c["shed_overload"] + c["shed_deadline"]
+        denom = c["submitted"] + c["shed_overload"]
+        snap["shed_rate"] = round(shed / denom, 4) if denom else 0.0
+        return snap

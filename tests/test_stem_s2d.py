@@ -103,3 +103,35 @@ def test_resnet_stem_through_model_zoo(monkeypatch):
     monkeypatch.setenv("MXNET_TPU_STEM_S2D", "force")
     y1 = net(x).asnumpy()
     onp.testing.assert_allclose(y1, y0, rtol=1e-4, atol=1e-4)
+
+
+def test_knob_flip_invalidates_hybridized_cache(monkeypatch):
+    """The _CachedGraph signature includes the stem-rewrite trace
+    environment (ops/nn.py:stem_s2d_cache_key): flipping
+    MXNET_TPU_STEM_S2D mid-process must RE-TRACE a hybridized conv net,
+    not serve the stale lowering — long-lived serving processes make
+    this a real hazard (ADVICE low #3)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=7, strides=2, padding=3,
+                      in_channels=3))
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(onp.random.RandomState(2).uniform(
+        size=(1, 3, 32, 32)).astype(onp.float32))
+
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "0")
+    y0 = net(x).asnumpy()
+    assert len(net._cached_graphs) == 1
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "force")
+    y1 = net(x).asnumpy()
+    # a NEW trace was built for the new knob state (stale one retired
+    # by key, not overwritten), and the lowerings stay equivalent
+    assert len(net._cached_graphs) == 2
+    onp.testing.assert_allclose(y1, y0, rtol=1e-5, atol=1e-5)
+    # flipping BACK hits the first cached executable again (no growth)
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "0")
+    net(x)
+    assert len(net._cached_graphs) == 2

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""CLI for the serving bench (``mxnet_tpu.serving.bench``).
+
+Drives a model-zoo model behind the dynamic-batching
+:class:`~mxnet_tpu.serving.engine.InferenceEngine` with N concurrent
+synthetic clients, prints ONE benchmark-format JSON row on stdout and
+banks it to ``benchmark/results_serving_<backend>.json`` (atomic write,
+same captured_at/record envelope the TPU daemon uses).
+
+Examples::
+
+    # CPU: 8 clients on AlexNet (FC-heavy — the strongest CPU batching case)
+    JAX_PLATFORMS=cpu python tools/serve_bench.py
+
+    # quick smoke (tiny synthetic CNN, ~seconds; what tier-1 runs)
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
+
+    # custom load shape
+    python tools/serve_bench.py --model squeezenet1.1 --image-size 128 \
+        --clients 16 --max-batch 16 --max-delay-ms 5
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.serving.bench import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
