@@ -72,7 +72,8 @@ def __getattr__(name):
                "lr_scheduler": ".optimizer.lr_scheduler",
                "registry": ".registry", "executor": ".executor",
                "recordio": ".recordio", "serialization": ".serialization",
-               "misc": ".misc", "torch": ".torch", "serving": ".serving"}
+               "misc": ".misc", "torch": ".torch", "serving": ".serving",
+               "resilience": ".resilience"}
     if name in targets:
         expected = importlib.util.resolve_name(targets[name], __name__)
         try:

@@ -90,6 +90,10 @@ except ImportError:  # pragma: no cover
 
 __all__ = [
     "MXNetError",
+    "TransientError",
+    "FatalError",
+    "StallDetected",
+    "Preempted",
     "bfloat16",
     "DTYPE_MAP",
     "dtype_from_any",
@@ -103,6 +107,31 @@ __all__ = [
 
 class MXNetError(RuntimeError):
     """Framework-level error (parity with mxnet.base.MXNetError)."""
+
+
+class TransientError(MXNetError):
+    """An error expected to clear on retry: device preemption/unavailable,
+    resource exhaustion, flaky IO, overload shedding. The
+    :mod:`mxnet_tpu.resilience` classifier maps raw JAX/XLA/OS errors onto
+    this bucket; retry loops (``resilience.retry``) re-attempt these and
+    re-raise everything else."""
+
+
+class FatalError(MXNetError):
+    """An error retrying cannot fix: shape/dtype mismatches, tracing
+    errors, programming bugs. Retry loops fail fast on these."""
+
+
+class StallDetected(TransientError):
+    """A watchdog deadline expired on an operation that should have
+    completed (hung XLA compile, wedged device transfer, stuck infer).
+    Transient: a fresh attempt on a healthy backend can succeed."""
+
+
+class Preempted(TransientError):
+    """The process received a preemption notice (SIGTERM on TPU VMs).
+    Raised by ``resilience.Supervisor`` after its final synchronous
+    checkpoint so callers can exit cleanly and resume elsewhere."""
 
 
 _backend_fallback = {"active": False, "lock": threading.Lock()}

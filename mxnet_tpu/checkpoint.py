@@ -12,10 +12,21 @@ module provides that on top of orbax/tensorstore while keeping the
 - :func:`save_sharded` / :func:`load_sharded` — one pytree, one directory
 - :class:`CheckpointManager` — step-numbered checkpoints with retention,
   the estimator ``CheckpointHandler``'s storage backend
+
+Crash safety (``mxnet_tpu.resilience`` contract): every step is written
+to ``<step>.tmp`` and published with one ``os.replace`` — a process
+killed mid-save (pod preemption, OOM-kill, chaos ``kill``) can never
+leave a half-written directory that ``restore()`` picks as latest.
+Each step carries a ``manifest.json`` of per-leaf SHA256 checksums;
+``restore`` verifies them and falls back to the previous retained step
+with a loud warning instead of handing back silently corrupted weights.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import shutil
 from typing import Any, Dict, Optional
 
 import jax
@@ -23,8 +34,10 @@ import numpy as onp
 
 from .base import MXNetError
 from .ndarray.ndarray import ndarray, _unwrap
+from .resilience import chaos
 
-__all__ = ["save_sharded", "load_sharded", "CheckpointManager"]
+__all__ = ["save_sharded", "load_sharded", "CheckpointManager",
+           "CheckpointCorruption"]
 
 
 def _to_jax_tree(tree):
@@ -88,60 +101,220 @@ def load_sharded(path: str, like: Optional[Any] = None,
     return _checkpointer().restore(path, args=args)
 
 
+def _leaf_digest(v) -> Dict[str, Any]:
+    """Checksum record for one pytree leaf (host gather + SHA256)."""
+    arr = onp.ascontiguousarray(onp.asarray(v))
+    return {
+        "sha256": hashlib.sha256(arr.tobytes()).hexdigest(),
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+    }
+
+
+def _tree_digests(tree) -> Dict[str, Dict[str, Any]]:
+    """keypath-string -> digest record for every leaf of ``tree``."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {jax.tree_util.keystr(path): _leaf_digest(v) for path, v in flat}
+
+
+class CheckpointCorruption(MXNetError):
+    """A step failed to load or its manifest checksums did not match."""
+
+
 class CheckpointManager:
-    """Step-numbered sharded checkpoints with retention.
+    """Step-numbered sharded checkpoints with retention + crash safety.
 
     The TPU-native analog of the estimator ``CheckpointHandler``'s
     ``max_checkpoints`` logic (reference
     ``gluon/contrib/estimator/event_handler.py:336``): ``save(step, tree)``
     writes ``<dir>/<step>``, keeps the newest ``max_to_keep``.
+
+    Layout per step::
+
+        <dir>/<step>/arrays/         orbax/tensorstore payload
+        <dir>/<step>/manifest.json   per-leaf SHA256 + shape/dtype
+
+    ``save`` stages everything under ``<dir>/<step>.tmp`` and publishes
+    with a single ``os.replace`` (atomic on POSIX within one
+    filesystem), so a kill at ANY point leaves either the previous state
+    or the complete new step — never a torn directory ``restore()``
+    would pick up. Orphaned ``*.tmp`` staging dirs from killed
+    processes are swept on manager init.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 5):
-        import orbax.checkpoint as ocp
+    _MANIFEST = "manifest.json"
+    _ARRAYS = "arrays"
 
+    def __init__(self, directory: str, max_to_keep: int = 5):
+        if max_to_keep < 1:
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
         self._dir = os.path.abspath(directory)
-        self._mgr = ocp.CheckpointManager(
-            self._dir,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True),
-        )
+        self._max_to_keep = int(max_to_keep)
+        os.makedirs(self._dir, exist_ok=True)
+        self._clean_orphans()
+
+    def _clean_orphans(self) -> None:
+        orphans = [n for n in os.listdir(self._dir) if n.endswith(".tmp")]
+        for n in orphans:
+            shutil.rmtree(os.path.join(self._dir, n), ignore_errors=True)
+        if orphans:
+            import warnings
+
+            warnings.warn(
+                f"CheckpointManager({self._dir}): swept "
+                f"{len(orphans)} orphaned staging dir(s) from an "
+                f"interrupted save: {sorted(orphans)} — the last COMPLETE "
+                "step is intact and will be restored", RuntimeWarning,
+                stacklevel=3)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, str(int(step)))
 
     def save(self, step: int, tree: Any) -> None:
-        import orbax.checkpoint as ocp
+        """Write ``tree`` as step ``step``, atomically, then apply
+        retention. Chaos site ``checkpoint.write`` fires after the array
+        payload is staged and BEFORE publication — a kill there is the
+        torn-checkpoint drill the resilience tests run."""
+        step = int(step)
+        tree = _to_jax_tree(tree)
+        tmp = self._step_dir(step) + ".tmp"
+        final = self._step_dir(step)
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        save_sharded(os.path.join(tmp, self._ARRAYS), tree)
+        manifest = {
+            "step": step,
+            "format": 1,
+            "leaves": _tree_digests(tree),
+        }
+        chaos.site("checkpoint.write", step=step)
+        with open(os.path.join(tmp, self._MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+        if os.path.isdir(final):
+            # re-saving an existing step: drop the old payload first
+            # (os.replace cannot clobber a non-empty dir). Not atomic
+            # for THIS case only — step numbers in a training run are
+            # monotonic, so it never happens on the supervised path.
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        self._gc()
 
-        self._mgr.save(step, args=ocp.args.StandardSave(_to_jax_tree(tree)))
-        self._mgr.wait_until_finished()
+    def _gc(self) -> None:
+        steps = self.all_steps()
+        while len(steps) > self._max_to_keep:
+            victim = steps.pop(0)
+            shutil.rmtree(self._step_dir(victim), ignore_errors=True)
+
+    def _verify(self, step: int, tree: Any) -> None:
+        """Check the restored ``tree`` against the step's manifest;
+        raise :class:`CheckpointCorruption` on any mismatch."""
+        mpath = os.path.join(self._step_dir(step), self._MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruption(
+                f"step {step}: manifest unreadable ({e})") from e
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        loaded = {jax.tree_util.keystr(path): v for path, v in flat}
+        for key, rec in manifest.get("leaves", {}).items():
+            if key not in loaded:
+                raise CheckpointCorruption(
+                    f"step {step}: leaf {key} in manifest but missing "
+                    "from the restored tree")
+            got = _leaf_digest(loaded[key])
+            if got["shape"] != rec["shape"]:
+                raise CheckpointCorruption(
+                    f"step {step}: leaf {key} shape {got['shape']} != "
+                    f"manifest {rec['shape']}")
+            if got["dtype"] != rec["dtype"]:
+                # a `like=` restore may legitimately cast; shape already
+                # matched, and a checksum over different bytes cannot —
+                # skip the hash for cast leaves rather than false-alarm
+                continue
+            if got["sha256"] != rec["sha256"]:
+                raise CheckpointCorruption(
+                    f"step {step}: leaf {key} checksum mismatch "
+                    "(bit rot or torn write)")
 
     def restore(self, step: Optional[int] = None, like: Optional[Any] = None,
-                shardings: Optional[Any] = None) -> Any:
-        import orbax.checkpoint as ocp
+                shardings: Optional[Any] = None, verify: bool = True) -> Any:
+        """Restore ``step`` (default: latest). On the latest-step path a
+        step that fails to load or fails manifest verification falls
+        back to the previous retained step with a loud warning; only
+        when every retained step is bad does this raise. An EXPLICIT
+        ``step`` never substitutes silently — a pinned-step caller
+        (reproducibility) gets the corruption error instead of another
+        step's weights."""
+        steps = self.all_steps()
+        if not steps:
+            raise MXNetError(f"no checkpoints in {self._dir}")
+        if step is not None:
+            step = int(step)
+            if step not in steps:
+                raise MXNetError(
+                    f"no checkpoint for step {step} in {self._dir} "
+                    f"(retained: {steps})")
+            candidates = [step]
+        else:
+            candidates = list(reversed(steps))
+        errors = []
+        for s in candidates:
+            try:
+                arrays = os.path.join(self._step_dir(s), self._ARRAYS)
+                if os.path.isdir(arrays):
+                    tree = load_sharded(arrays, like=like,
+                                        shardings=shardings)
+                    if verify:
+                        self._verify(s, tree)
+                else:
+                    # legacy layout (orbax-managed manager, pre-manifest):
+                    # payload at <step>/default or <step> itself — stay
+                    # restorable across the upgrade, minus checksum verify
+                    legacy = os.path.join(self._step_dir(s), "default")
+                    if not os.path.isdir(legacy):
+                        legacy = self._step_dir(s)
+                    tree = load_sharded(legacy, like=like,
+                                        shardings=shardings)
+                    import warnings
 
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise MXNetError(f"no checkpoints in {self._dir}")
-        args = None
-        if like is not None:
-            like = _to_jax_tree(like)
-            if shardings is not None:
-                flat_sh, _ = jax.tree_util.tree_flatten(shardings)
-                flat, treedef = jax.tree_util.tree_flatten(like)
-                structs = [
-                    jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=s)
-                    for v, s in zip(flat, flat_sh)]
-                like = jax.tree_util.tree_unflatten(treedef, structs)
-            else:
-                like = jax.tree_util.tree_map(
-                    lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), like)
-            args = ocp.args.StandardRestore(like)
-        return self._mgr.restore(step, args=args)
+                    warnings.warn(
+                        f"CheckpointManager({self._dir}): step {s} uses "
+                        "the pre-manifest layout; restored WITHOUT "
+                        "checksum verification (re-save to upgrade)",
+                        RuntimeWarning, stacklevel=2)
+                return tree
+            except Exception as e:  # noqa: BLE001 — fall back, loudly
+                errors.append((s, e))
+                if step is None:
+                    import warnings
+
+                    warnings.warn(
+                        f"CheckpointManager({self._dir}): step {s} is "
+                        f"unusable ({e}); falling back to the previous "
+                        "retained step", RuntimeWarning, stacklevel=2)
+        if step is not None:
+            # one pinned candidate: propagate the ORIGINAL error so
+            # `except CheckpointCorruption` works as the docstring
+            # promises (and the traceback survives)
+            raise errors[0][1]
+        raise MXNetError(
+            f"every retained checkpoint in {self._dir} failed to "
+            f"restore: {[(s, repr(e)) for s, e in errors]}"
+        ) from errors[-1][1]
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        steps = self.all_steps()
+        return steps[-1] if steps else None
 
     def all_steps(self):
-        return sorted(self._mgr.all_steps())
+        if not os.path.isdir(self._dir):
+            return []
+        return sorted(
+            int(n) for n in os.listdir(self._dir)
+            if n.isdigit() and os.path.isdir(os.path.join(self._dir, n)))
 
     def close(self):
-        self._mgr.close()
+        """Kept for API parity with the orbax-backed manager; saves are
+        synchronous so there is nothing to flush."""
+

@@ -10,10 +10,12 @@ from __future__ import annotations
 
 import builtins
 
-from .base import MXNetError
+from .base import (FatalError, MXNetError, Preempted, StallDetected,
+                   TransientError)
 
 __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "TypeError", "AttributeError", "NotImplementedForSymbol",
+           "TransientError", "FatalError", "StallDetected", "Preempted",
            "register"]
 
 _REGISTRY = {}
@@ -54,6 +56,14 @@ class TypeError(MXNetError, builtins.TypeError):
 @register
 class AttributeError(MXNetError, builtins.AttributeError):
     pass
+
+
+# the resilience taxonomy (base.py) registered under the same seam so
+# extension code can look the kinds up by name like any other error
+register(TransientError)
+register(FatalError)
+register(StallDetected)
+register(Preempted)
 
 
 @register

@@ -623,7 +623,13 @@ class HybridBlock(Block):
                 out_info["mutated_names"] = [pn for pn, _ in mutated]
                 return tuple(out_leaves) + tuple(mv for _, mv in mutated)
 
-        # trace once abstractly to learn output structure, then jit
+        # trace once abstractly to learn output structure, then jit.
+        # chaos site on the cold path only: a warm cache hit never pays
+        # even the armed-lookup cost, matching real compile economics
+        from ..resilience import chaos
+
+        chaos.site("compile", block=type(self).__name__)
+
         from .parameter import _tls_override
 
         def _pdata(p):

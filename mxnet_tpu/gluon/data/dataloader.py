@@ -17,10 +17,47 @@ from typing import Callable, Optional
 
 from ...base import MXNetError
 from ...ndarray.ndarray import ndarray
+from ...resilience import chaos
+from ...resilience.retry import (RetriesExhausted, RetryPolicy,
+                                 call_with_retry)
 from .batchify import default_batchify_fn
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader"]
+
+# Transient IO (NFS hiccups, object-store resets, flaky decode) gets a
+# bounded in-place retry at the batch boundary instead of killing an
+# hours-long epoch: 3 attempts, short backoff — past that the fetch is
+# genuinely broken and fails with the dataset index in the message.
+_FETCH_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.02,
+                           max_delay_s=0.5)
+
+
+def _fetch_batch(dataset, batchify_fn, batch_idx):
+    """One guarded batch fetch: the ``dataloader.next`` chaos site plus
+    bounded retry around transient ``OSError``/``IOError``. Runs in the
+    parent (``num_workers=0``) and in pool workers alike."""
+    failing = {"i": None}
+
+    def once():
+        chaos.site("dataloader.next")
+        samples = []
+        for i in batch_idx:
+            failing["i"] = i
+            samples.append(dataset[i])
+        failing["i"] = None
+        return batchify_fn(samples)
+
+    try:
+        return call_with_retry(once, policy=_FETCH_RETRY)
+    except RetriesExhausted as e:
+        where = (f"at dataset index {failing['i']}"
+                 if failing["i"] is not None
+                 else "outside dataset access (injected fault or batchify)")
+        raise MXNetError(
+            f"DataLoader batch fetch failed after "
+            f"{_FETCH_RETRY.max_attempts} attempts {where} "
+            f"(batch {list(batch_idx)[:8]}): {e.__cause__!r}") from e
 
 
 class DataLoader:
@@ -91,7 +128,8 @@ class DataLoader:
 
     def _gen(self):
         for batch_idx in self._batch_sampler:
-            yield _upload(self._batchify_fn([self._dataset[i] for i in batch_idx]))
+            yield _upload(_fetch_batch(self._dataset, self._batchify_fn,
+                                       batch_idx))
 
     def __del__(self):
         if self._pool is not None:
@@ -115,7 +153,7 @@ def _upload(batch):
 
 
 def _worker_fn_direct(dataset, batchify_fn, batch_idx):
-    return batchify_fn([dataset[i] for i in batch_idx])
+    return _fetch_batch(dataset, batchify_fn, batch_idx)
 
 
 _WORKER_STATE = {}
@@ -129,7 +167,7 @@ def _worker_init(dataset, batchify_fn):
 def _worker_fn(batch_idx):
     dataset = _WORKER_STATE["dataset"]
     batchify_fn = _WORKER_STATE["batchify_fn"]
-    return batchify_fn([dataset[i] for i in batch_idx])
+    return _fetch_batch(dataset, batchify_fn, batch_idx)
 
 
 class _PoolIter:

@@ -243,29 +243,52 @@ class Trainer:
             self._states[i] = s
 
     # -- optimizer-state checkpoint (reference trainer.py:472/:501) --------
-    def save_states(self, fname):
-        import pickle
-
-        payload = {
-            "num_update": self._optimizer.num_update,
-            "index_update_count": self._optimizer._index_update_count,
+    def states_tree(self) -> dict:
+        """Optimizer state as a pure host-array pytree with STRING keys —
+        the one canonical payload behind both the ``.states`` pickle file
+        and sharded checkpoints (``resilience.Supervisor``); sharded
+        checkpoint trees cannot carry int-keyed dicts."""
+        return {
+            "num_update": int(self._optimizer.num_update),
+            "index_update_count": {
+                str(k): int(v)
+                for k, v in self._optimizer._index_update_count.items()},
             "states": {
-                i: jax.tree_util.tree_map(lambda a: onp.asarray(a), s)
+                str(i): jax.tree_util.tree_map(lambda a: onp.asarray(a), s)
                 for i, s in self._states.items()
             },
         }
+
+    def load_states_tree(self, tree: dict) -> None:
+        """Inverse of :meth:`states_tree`; accepts int or str keys (old
+        pickle payloads used ints)."""
+        self._optimizer.num_update = int(tree["num_update"])
+        self._optimizer._index_update_count = {
+            int(k): int(v) for k, v in tree["index_update_count"].items()}
+        self._states = {
+            int(i): jax.tree_util.tree_map(lambda a: jnp.asarray(a), s)
+            for i, s in tree["states"].items()
+        }
+        self._states_ready = True
+
+    def reset_states(self) -> None:
+        """Forget all optimizer state (momentum/variance buffers, update
+        counts) so the next ``step`` re-initializes from scratch — the
+        restore path for a checkpoint that predates the first update
+        (``resilience.Supervisor`` baseline snapshots)."""
+        self._states = {}
+        self._states_ready = False
+        self._optimizer.num_update = 0
+        self._optimizer._index_update_count = {}
+
+    def save_states(self, fname):
+        import pickle
+
         with open(fname, "wb") as f:
-            pickle.dump(payload, f)
+            pickle.dump(self.states_tree(), f)
 
     def load_states(self, fname):
         import pickle
 
         with open(fname, "rb") as f:
-            payload = pickle.load(f)
-        self._optimizer.num_update = payload["num_update"]
-        self._optimizer._index_update_count = payload["index_update_count"]
-        self._states = {
-            i: jax.tree_util.tree_map(lambda a: jnp.asarray(a), s)
-            for i, s in payload["states"].items()
-        }
-        self._states_ready = True
+            self.load_states_tree(pickle.load(f))

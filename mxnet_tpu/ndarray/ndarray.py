@@ -220,6 +220,9 @@ class ndarray:
 
     def copyto(self, other: Union["ndarray", Context]) -> "ndarray":
         """Cross-device copy (reference src/ndarray/ndarray.cc CopyFromTo)."""
+        from ..resilience import chaos
+
+        chaos.site("device.put")
         if isinstance(other, Context):
             out = _wrap(jax.device_put(self._data, other.jax_device))
             return out

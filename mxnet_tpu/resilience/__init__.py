@@ -1,0 +1,42 @@
+"""``mxnet_tpu.resilience`` — survive the failures TPU pods actually have.
+
+Three cooperating pieces (``docs/resilience.md``):
+
+- :mod:`.chaos` — env-controllable (``MXNET_TPU_CHAOS``) fault
+  injection: named sites on the hot paths (checkpoint write, dataloader
+  fetch, device transfer, serving infer, compile) that can raise typed
+  faults, inject latency, or kill the process after N calls, with a
+  deterministic seed;
+- :mod:`.retry` — exponential backoff + jitter + deadline, and the
+  transient-vs-fatal classifier for JAX/XLA/OS errors
+  (``RESOURCE_EXHAUSTED`` / ``UNAVAILABLE`` / preemption → transient;
+  shape/type errors → fatal); :mod:`.watchdog` converts hangs into a
+  typed :class:`~mxnet_tpu.base.StallDetected`;
+- :mod:`.supervisor` — :class:`Supervisor`, the retrying training loop:
+  checkpoints through the crash-safe
+  :class:`~mxnet_tpu.checkpoint.CheckpointManager`, restores the latest
+  *valid* step after transient faults, resumes at the exact
+  epoch/batch, and turns SIGTERM (preemption notice) into one final
+  synchronous save + :class:`~mxnet_tpu.base.Preempted`.
+
+The reference MXNet leaned on ps-lite server restarts for fault
+tolerance; on the jax_graft stack recovery is in-process and
+checkpoint-anchored instead.
+"""
+from ..base import (FatalError, Preempted, StallDetected,  # noqa: F401
+                    TransientError)
+from . import chaos  # noqa: F401
+from .retry import (FATAL, TRANSIENT, RetriesExhausted,  # noqa: F401
+                    RetryPolicy, call_with_retry, classify, is_transient,
+                    retry)
+from .watchdog import Watchdog, run_with_watchdog  # noqa: F401
+from .supervisor import Supervisor  # noqa: F401
+
+__all__ = [
+    "chaos",
+    "classify", "is_transient", "TRANSIENT", "FATAL",
+    "RetryPolicy", "RetriesExhausted", "retry", "call_with_retry",
+    "Watchdog", "run_with_watchdog",
+    "Supervisor",
+    "TransientError", "FatalError", "StallDetected", "Preempted",
+]

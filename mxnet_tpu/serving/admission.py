@@ -21,19 +21,24 @@ import time
 from collections import deque
 from typing import Any, Callable, List, Optional, Tuple
 
-from ..base import MXNetError
+from ..base import MXNetError, TransientError
 
 __all__ = ["ServerOverload", "DeadlineExceeded", "Request", "AdmissionQueue"]
 
 
-class ServerOverload(MXNetError):
+class ServerOverload(TransientError):
     """The serving queue is full (or closed) — request rejected at
-    admission so the caller can back off / retry elsewhere."""
+    admission so the caller can back off / retry elsewhere. Subclasses
+    :class:`~mxnet_tpu.base.TransientError`: the resilience classifier
+    marks it retryable, so a client's ``resilience.retry`` loop backs
+    off and resubmits without special-casing (the PR 1 shedding
+    contract, now machine-readable)."""
 
 
-class DeadlineExceeded(MXNetError):
+class DeadlineExceeded(TransientError):
     """The request's deadline passed before execution started — shed
-    without spending compute on it."""
+    without spending compute on it. Also transient: no work was done,
+    so a resubmission with a fresh deadline is always safe."""
 
 
 class Request:

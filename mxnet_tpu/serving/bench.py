@@ -107,16 +107,31 @@ def run_serving_bench(model: str = "alexnet", image_size: int = 224,
         log(f"sequential: {seq_rps:.2f} req/s ({seq_requests} reqs)")
 
         # -- phase 2: concurrent closed-loop clients --------------------------
+        # each client submits through the shared resilience retry loop:
+        # ServerOverload/DeadlineExceeded are TransientError (classifier
+        # contract), so a shed request backs off and resubmits instead of
+        # killing the client thread — the PR 1 shedding contract exercised
+        # end to end
+        from mxnet_tpu.resilience import RetryPolicy, call_with_retry
+
         stop = threading.Event()
         done_counts = [0] * clients
+        retry_counts = [0] * clients
         errs: List[str] = []
+        client_policy = RetryPolicy(max_attempts=3, base_delay_s=0.002,
+                                    max_delay_s=0.05)
 
         def client(i: int) -> None:
             r = onp.random.RandomState(100 + i)
             x = r.uniform(size=(1,) + item_shape).astype("float32")
+
+            def on_retry(attempt, exc, delay):
+                retry_counts[i] += 1
+
             while not stop.is_set():
                 try:
-                    engine.infer(x)
+                    call_with_retry(engine.infer, x, policy=client_policy,
+                                    on_retry=on_retry)
                     done_counts[i] += 1
                 except Exception as e:  # noqa: BLE001
                     errs.append(f"client{i}: {e!r}")
@@ -190,6 +205,7 @@ def run_serving_bench(model: str = "alexnet", image_size: int = 224,
         "shed": {"burst": burst, "served": served,
                  "deadline": shed_deadline, "overload": shed_overload,
                  "rate": round(shed_rate, 3)},
+        "client_retries": sum(retry_counts),
         "counters": final["counters"],
         "warm_buckets": [b for (b, _s, _d) in final["warm_buckets"]],
         "device": jax.default_backend(),

@@ -33,6 +33,7 @@ import numpy as onp
 
 from ..base import env_float, env_int, failsoft_call, preflight_backend
 from ..ndarray.ndarray import ndarray, _wrap
+from ..resilience import chaos
 from .admission import (AdmissionQueue, DeadlineExceeded, Request,
                         ServerOverload)
 from .batcher import DynamicBatcher
@@ -374,6 +375,11 @@ class InferenceEngine:
         the raw output pytree of jax arrays (leading axis = bucket)."""
         bucket = staged.shape[0]
         key = (bucket, item_shape, dtype)
+        # chaos site BEFORE the compute: injected latency here holds the
+        # batcher thread (queued requests blow their deadlines — the
+        # serving deadline drill), an injected fault fails the batch
+        # through the canonical DynamicBatcher fail path
+        chaos.site("serving.infer", bucket=bucket)
 
         def run():
             # everything that can be the process's first backend touch

@@ -55,3 +55,7 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "seed(n): fix the RNG seed for a test")
     config.addinivalue_line("markers", "serial: run test serially")
     config.addinivalue_line("markers", "integration: end-to-end test")
+    # chaos tests inject faults through mxnet_tpu.resilience.chaos; they
+    # are fast and hermetic (scoped rules / subprocess kills), so they
+    # run in tier-1 — the marker exists for `-m chaos` selection
+    config.addinivalue_line("markers", "chaos: fault-injection test")

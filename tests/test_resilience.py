@@ -1,0 +1,846 @@
+"""``mxnet_tpu.resilience`` — chaos injection, retry/classifier, watchdog,
+crash-safe checkpoints, and the kill-and-resume Supervisor contract
+(ISSUE 2 acceptance: a training run killed mid-checkpoint resumes from
+the last valid step and reaches the same final loss as an uninterrupted
+run)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import gluon, resilience
+from mxnet_tpu.base import (FatalError, Preempted, StallDetected,
+                            TransientError)
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.estimator import Estimator
+from mxnet_tpu.resilience import (RetriesExhausted, RetryPolicy, Supervisor,
+                                  call_with_retry, chaos, classify,
+                                  is_transient, retry, run_with_watchdog)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """Every test starts and ends disarmed (env rules included)."""
+    chaos.clear()
+    chaos.reset_stats()
+    yield
+    chaos.clear()
+    chaos.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# classifier
+# ---------------------------------------------------------------------------
+class _FakeXlaError(RuntimeError):
+    """Stands in for jaxlib XlaRuntimeError: status code in the text."""
+
+
+def test_classifier_taxonomy_first():
+    assert is_transient(TransientError("x"))
+    assert is_transient(StallDetected("hung"))
+    assert is_transient(Preempted("notice"))
+    assert not is_transient(FatalError("x"))
+    assert is_transient(chaos.ChaosTransient("x"))
+    assert not is_transient(chaos.ChaosFatal("x"))
+
+
+def test_classifier_xla_message_markers():
+    for msg in ("RESOURCE_EXHAUSTED: out of memory while allocating",
+                "UNAVAILABLE: socket closed on worker 3",
+                "ABORTED: coordination service shut down (preempted)"):
+        assert classify(_FakeXlaError(msg)) == resilience.TRANSIENT, msg
+    for msg in ("INVALID_ARGUMENT: Incompatible shapes (8,16) vs (8,32)",
+                "rank mismatch in dot_general"):
+        assert classify(_FakeXlaError(msg)) == resilience.FATAL, msg
+
+
+def test_classifier_wrappers_and_deterministic_io_are_fatal():
+    # a wrapper MXNetError embedding a transient repr must NOT flip back
+    # to retryable via message markers (retries were already spent)
+    assert classify(RetriesExhausted(
+        "failed; last transient error: XlaRuntimeError('UNAVAILABLE')",
+        3)) == resilience.FATAL
+    assert classify(mx.MXNetError("fetch failed: UNAVAILABLE")) \
+        == resilience.FATAL
+    # deterministic filesystem errors never clear on retry
+    for exc in (FileNotFoundError("no such dataset"),
+                PermissionError("denied"), IsADirectoryError("dir")):
+        assert classify(exc) == resilience.FATAL, exc
+
+
+def test_classifier_builtin_families():
+    assert classify(OSError("disk hiccup")) == resilience.TRANSIENT
+    assert classify(TimeoutError("slow")) == resilience.TRANSIENT
+    assert classify(ValueError("bad arg")) == resilience.FATAL
+    assert classify(TypeError("bad type")) == resilience.FATAL
+    # unknown errors default to fatal: never spin on a bug
+    assert classify(RuntimeError("who knows")) == resilience.FATAL
+
+
+def test_classifier_serving_shedding_is_transient():
+    from mxnet_tpu.serving import DeadlineExceeded, ServerOverload
+
+    assert isinstance(ServerOverload("full"), TransientError)
+    assert is_transient(ServerOverload("full"))
+    assert is_transient(DeadlineExceeded("late"))
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+def _flaky(n_failures, exc=OSError):
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= n_failures:
+            raise exc(f"transient #{calls['n']}")
+        return calls["n"]
+
+    fn.calls = calls
+    return fn
+
+
+def test_retry_recovers_from_transient():
+    pol = RetryPolicy(max_attempts=3, base_delay_s=0.001, jitter=0.0)
+    assert call_with_retry(_flaky(2), policy=pol) == 3
+
+
+def test_retry_fatal_propagates_immediately():
+    fn = _flaky(5, exc=ValueError)
+    with pytest.raises(ValueError):
+        call_with_retry(fn, policy=RetryPolicy(base_delay_s=0.001))
+    assert fn.calls["n"] == 1  # no second attempt on a fatal error
+
+
+def test_retry_exhaustion_is_typed_and_chained():
+    with pytest.raises(RetriesExhausted) as ei:
+        call_with_retry(_flaky(99), policy=RetryPolicy(
+            max_attempts=3, base_delay_s=0.001, jitter=0.0))
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_retry_deadline_bounds_total_time():
+    pol = RetryPolicy(max_attempts=50, base_delay_s=0.2, jitter=0.0,
+                      deadline_s=0.05)
+    t0 = time.monotonic()
+    with pytest.raises(RetriesExhausted):
+        call_with_retry(_flaky(99), policy=pol)
+    assert time.monotonic() - t0 < 1.0  # did not sleep 50 * 0.2s
+
+
+def test_retry_backoff_schedule_deterministic():
+    pol = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=1.0,
+                      jitter=0.5, seed=42)
+    a = [next(iter([d])) for d, _ in zip(pol.delays(), range(5))]
+    b = [next(iter([d])) for d, _ in zip(pol.delays(), range(5))]
+    assert a == b  # same seed -> same jittered schedule
+    assert all(d <= 1.0 for d in a)
+
+
+def test_retries_exhausted_pickles():
+    import pickle
+
+    e = RetriesExhausted("gave up", 4)
+    back = pickle.loads(pickle.dumps(e))  # fork-pool workers re-raise it
+    assert back.attempts == 4 and "gave up" in str(back)
+
+
+def test_retry_decorator():
+    state = {"n": 0}
+
+    @retry(max_attempts=4, base_delay_s=0.001)
+    def op(x):
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("flaky")
+        return x * 2
+
+    assert op(21) == 42
+    assert op.retry_policy.max_attempts == 4
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+def test_watchdog_passthrough_and_stall():
+    assert run_with_watchdog(lambda: 7, 5.0) == 7
+    with pytest.raises(ZeroDivisionError):
+        run_with_watchdog(lambda: 1 / 0, 5.0)
+    with pytest.raises(StallDetected) as ei:
+        run_with_watchdog(time.sleep, 0.05, 0.5, name="hung-compile")
+    assert "hung-compile" in str(ei.value)
+    assert is_transient(ei.value)  # retry loops re-attempt stalls
+
+
+# ---------------------------------------------------------------------------
+# chaos
+# ---------------------------------------------------------------------------
+def test_chaos_disarmed_is_noop():
+    assert not chaos.armed()
+    from mxnet_tpu import profiler
+
+    before = len(profiler._events)
+    for _ in range(1000):
+        chaos.site("serving.infer")
+        chaos.site("never.registered")
+    assert not chaos.stats()  # no counters accumulate while disarmed
+    assert len(profiler._events) == before  # zero profiler traffic
+
+
+def test_chaos_disarmed_overhead_is_one_dict_lookup():
+    # functional zero-overhead guard: 200k disarmed calls in well under a
+    # second (a generous bound — the point is no locks/IO/profiler work)
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        chaos.site("checkpoint.write")
+    assert time.perf_counter() - t0 < 2.0
+
+
+def test_chaos_scope_raise_and_stats():
+    with chaos.scope("dataloader.next", fail="transient", times=2):
+        with pytest.raises(chaos.ChaosTransient):
+            chaos.site("dataloader.next")
+        with pytest.raises(chaos.ChaosTransient):
+            chaos.site("dataloader.next")
+        chaos.site("dataloader.next")  # times budget spent -> no-op
+    chaos.site("dataloader.next")  # scope exited -> disarmed
+    st = chaos.stats()["dataloader.next"]
+    assert st["raise"] == 2 and st["calls"] == 3
+    assert not chaos.armed()
+
+
+def test_chaos_scope_exception_identity():
+    marker = OSError("exactly this one")
+    with chaos.scope("device.put", fail=marker):
+        with pytest.raises(OSError) as ei:
+            chaos.site("device.put")
+    assert ei.value is marker
+
+
+def test_chaos_scope_delay():
+    with chaos.scope("serving.infer", delay=0.05):
+        t0 = time.perf_counter()
+        chaos.site("serving.infer")
+        assert time.perf_counter() - t0 >= 0.045
+
+
+def test_chaos_probability_deterministic():
+    def fires(seed):
+        n = 0
+        with chaos.scope("compile", fail="transient", p=0.5, seed=seed):
+            for _ in range(200):
+                try:
+                    chaos.site("compile")
+                except chaos.ChaosTransient:
+                    n += 1
+        return n
+
+    a, b = fires(7), fires(7)
+    assert a == b  # deterministic seed -> replayable campaign
+    assert 50 < a < 150  # and it actually flips both ways
+
+
+def test_chaos_env_parsing(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_CHAOS",
+                       "serving.infer=delay:0.001;dataloader.next=raise:oserror")
+    assert chaos.refresh_from_env() == 2
+    assert chaos.armed()
+    with pytest.raises(OSError):
+        chaos.site("dataloader.next")
+    chaos.site("serving.infer")  # delay rule, no raise
+    monkeypatch.delenv("MXNET_TPU_CHAOS")
+    assert chaos.refresh_from_env() == 0
+    assert not chaos.armed()
+
+
+def test_chaos_env_malformed_warns_not_dies(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_CHAOS",
+                       "dataloader.next=explode;serving.infer=delay:0.001")
+    with pytest.warns(RuntimeWarning, match="malformed"):
+        n = chaos.refresh_from_env()
+    assert n == 1  # the good rule still armed
+    monkeypatch.setenv("MXNET_TPU_CHAOS", "typo.site=raise:transient")
+    with pytest.warns(RuntimeWarning, match="not one of the instrumented"):
+        chaos.refresh_from_env()
+
+
+def test_chaos_instrumented_sites_fire_in_real_paths():
+    # dataloader.next: fires inside DataLoader batch fetch
+    ds = gluon.data.ArrayDataset(onp.arange(8, dtype="float32"))
+    loader = gluon.data.DataLoader(ds, batch_size=4)
+    with chaos.scope("dataloader.next", fail="fatal"):
+        with pytest.raises(chaos.ChaosFatal):
+            list(loader)
+    # device.put: fires in ndarray.copyto
+    arr = mx.np.array([1.0, 2.0])
+    with chaos.scope("device.put", fail="transient"):
+        with pytest.raises(chaos.ChaosTransient):
+            arr.copyto(mx.cpu())
+    # compile: fires on the hybridize cold-trace path only
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(onp.ones((1, 2), "float32"))
+    with chaos.scope("compile", fail="fatal"):
+        with pytest.raises(chaos.ChaosFatal):
+            net(x)
+    net(x)  # disarmed: traces fine
+    with chaos.scope("compile", fail="fatal"):
+        net(x)  # warm cache hit never reaches the site
+
+
+# ---------------------------------------------------------------------------
+# dataloader bounded retry (satellite)
+# ---------------------------------------------------------------------------
+class _FlakyDataset:
+    """Raises OSError the first ``n_failures`` times index ``bad`` is hit."""
+
+    def __init__(self, n, bad=5, n_failures=2, forever=False):
+        self._data = onp.arange(n, dtype="float32")
+        self.bad = bad
+        self.remaining = n_failures
+        self.forever = forever
+        self.attempts = 0
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, i):
+        if i == self.bad:
+            self.attempts += 1
+            if self.forever or self.remaining > 0:
+                self.remaining -= 1
+                raise OSError(f"flaky read at {i}")
+        return self._data[i]
+
+
+def test_dataloader_retries_transient_io():
+    ds = _FlakyDataset(8, bad=5, n_failures=2)
+    loader = gluon.data.DataLoader(ds, batch_size=4)
+    batches = [b.asnumpy() for b in loader]
+    assert len(batches) == 2
+    onp.testing.assert_allclose(batches[1], [4, 5, 6, 7])
+    assert ds.attempts == 3  # 2 failures + 1 success, all in-place
+
+
+def test_dataloader_retry_exhaustion_names_the_index():
+    ds = _FlakyDataset(8, bad=5, forever=True)
+    loader = gluon.data.DataLoader(ds, batch_size=4)
+    with pytest.raises(mx.MXNetError, match="index 5"):
+        list(loader)
+    assert ds.attempts == 3  # bounded: exactly max_attempts
+
+
+# ---------------------------------------------------------------------------
+# crash-safe CheckpointManager (satellites: atomic save + manifest)
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_checkpoint_fault_mid_save_leaves_previous_step_valid(tmp_path):
+    d = str(tmp_path / "run")
+    mgr = ckpt.CheckpointManager(d, max_to_keep=3)
+    mgr.save(1, {"w": onp.full((4,), 1.0, "float32")})
+    with chaos.scope("checkpoint.write", fail="transient"):
+        with pytest.raises(chaos.ChaosTransient):
+            mgr.save(2, {"w": onp.full((4,), 2.0, "float32")})
+    # the torn attempt is a staging dir, never a visible step
+    assert os.path.isdir(os.path.join(d, "2.tmp"))
+    assert mgr.all_steps() == [1]
+    onp.testing.assert_allclose(onp.asarray(mgr.restore()["w"]), 1.0)
+    # a fresh manager (process restart) sweeps the orphan loudly
+    with pytest.warns(RuntimeWarning, match="orphaned staging"):
+        mgr2 = ckpt.CheckpointManager(d)
+    assert not os.path.isdir(os.path.join(d, "2.tmp"))
+    assert mgr2.latest_step() == 1
+
+
+def test_checkpoint_manifest_written_and_verified(tmp_path):
+    d = str(tmp_path / "run")
+    mgr = ckpt.CheckpointManager(d)
+    tree = {"w": onp.arange(6, dtype="float32").reshape(2, 3),
+            "nested": {"b": onp.ones(3, "float32")}}
+    mgr.save(1, tree)
+    mpath = os.path.join(d, "1", "manifest.json")
+    manifest = json.load(open(mpath))
+    assert manifest["step"] == 1
+    assert len(manifest["leaves"]) == 2
+    for rec in manifest["leaves"].values():
+        assert len(rec["sha256"]) == 64
+    back = mgr.restore()
+    onp.testing.assert_allclose(onp.asarray(back["nested"]["b"]), 1.0)
+
+
+def test_checkpoint_checksum_mismatch_falls_back_with_warning(tmp_path):
+    d = str(tmp_path / "run")
+    mgr = ckpt.CheckpointManager(d)
+    mgr.save(1, {"w": onp.full((4,), 1.0, "float32")})
+    mgr.save(2, {"w": onp.full((4,), 2.0, "float32")})
+    mpath = os.path.join(d, "2", "manifest.json")
+    manifest = json.load(open(mpath))
+    for rec in manifest["leaves"].values():
+        rec["sha256"] = "0" * 64  # simulated bit rot
+    json.dump(manifest, open(mpath, "w"))
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        back = mgr.restore()
+    onp.testing.assert_allclose(onp.asarray(back["w"]), 1.0)
+
+
+def test_checkpoint_corrupt_payload_falls_back(tmp_path):
+    d = str(tmp_path / "run")
+    mgr = ckpt.CheckpointManager(d)
+    mgr.save(1, {"w": onp.full((4,), 1.0, "float32")})
+    mgr.save(2, {"w": onp.full((4,), 2.0, "float32")})
+    arrays = os.path.join(d, "2", "arrays")
+    for root, _dirs, files in os.walk(arrays):
+        for f in files:
+            with open(os.path.join(root, f), "wb") as fh:
+                fh.write(b"\x00garbage\x00")
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        back = mgr.restore()
+    onp.testing.assert_allclose(onp.asarray(back["w"]), 1.0)
+
+
+def test_checkpoint_all_steps_bad_raises(tmp_path):
+    d = str(tmp_path / "run")
+    mgr = ckpt.CheckpointManager(d)
+    mgr.save(1, {"w": onp.ones(2, "float32")})
+    mpath = os.path.join(d, "1", "manifest.json")
+    manifest = json.load(open(mpath))
+    for rec in manifest["leaves"].values():
+        rec["sha256"] = "0" * 64
+    json.dump(manifest, open(mpath, "w"))
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(mx.MXNetError, match="every retained"):
+            mgr.restore()
+
+
+def test_checkpoint_legacy_layout_restores_with_warning(tmp_path):
+    """Steps written by the previous orbax-managed CheckpointManager
+    (payload at <step>/default, no manifest) stay restorable."""
+    import orbax.checkpoint as ocp
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "legacy")
+    old = ocp.CheckpointManager(
+        d, options=ocp.CheckpointManagerOptions(max_to_keep=5, create=True))
+    old.save(1, args=ocp.args.StandardSave({"w": jnp.full((2,), 4.0)}))
+    old.wait_until_finished()
+    old.close()
+    mgr = ckpt.CheckpointManager(d)
+    assert mgr.all_steps() == [1]
+    with pytest.warns(RuntimeWarning, match="pre-manifest"):
+        back = mgr.restore()
+    onp.testing.assert_allclose(onp.asarray(back["w"]), 4.0)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+def _training_setup(seed=3):
+    """Deterministic tiny regression problem: net + estimator + batches."""
+    from mxnet_tpu.numpy import random as mxrandom
+
+    onp.random.seed(seed)
+    mxrandom.seed(seed)
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    rng = onp.random.RandomState(11)
+    xs = rng.randn(24, 3).astype("float32")
+    ys = rng.randn(24, 2).astype("float32")
+    batches = [(mx.np.array(xs[i:i + 4]), mx.np.array(ys[i:i + 4]))
+               for i in range(0, 24, 4)]
+    est = Estimator(
+        net, gluon.loss.L2Loss(),
+        trainer=gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.05, "momentum": 0.9}))
+    return net, est, batches
+
+
+def _final_loss(net, batches):
+    return float(sum(
+        ((net(bx) - by) ** 2).mean().asnumpy() for bx, by in batches))
+
+
+@pytest.mark.chaos
+def test_supervisor_resumes_at_correct_batch_same_loss(tmp_path):
+    # reference: uninterrupted run
+    net_a, est_a, batches = _training_setup()
+    sup_a = Supervisor(str(tmp_path / "a"), handle_sigterm=False,
+                       save_every_n_batches=1)
+    out_a = sup_a.fit(est_a, batches, epochs=2)
+    assert out_a["global_batch"] == 12 and not out_a["resumed"]
+
+    # faulted run: identical init (same seeds), one transient fault
+    # fired deterministically before global batch 9 (epoch 2, batch 3)
+    net_b, est_b, batches_b = _training_setup()
+    fits = []
+    orig = est_b.fit_batch
+    sup_b = Supervisor(str(tmp_path / "b"), handle_sigterm=False,
+                       save_every_n_batches=1,
+                       policy=RetryPolicy(max_attempts=3, base_delay_s=0.001))
+    state = {"armed": True}
+
+    def faulting_fit_batch(d, l, ax=0):
+        if state["armed"] and len(fits) == 8:
+            state["armed"] = False
+            raise TransientError("injected: device preempted mid-step")
+        fits.append(1)
+        return orig(d, l, ax)
+
+    est_b.fit_batch = faulting_fit_batch
+    out_b = sup_b.fit(est_b, batches_b, epochs=2)
+
+    # resumed exactly at the failed batch: every batch trained once
+    assert len(fits) == 12
+    assert out_b["global_batch"] == 12
+    assert sup_b.stats()["recoveries"] == 1
+    assert sup_b.stats()["restores"] >= 1
+    # identical final weights and loss vs the uninterrupted run
+    for (ka, pa), (kb, pb) in zip(sorted(net_a.collect_params().items()),
+                                  sorted(net_b.collect_params().items())):
+        onp.testing.assert_allclose(pa.data().asnumpy(),
+                                    pb.data().asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(_final_loss(net_a, batches),
+                                _final_loss(net_b, batches_b), rtol=1e-6)
+
+
+def test_supervisor_fault_before_first_periodic_save(tmp_path):
+    """A transient fault BEFORE the first periodic save must restore the
+    baseline snapshot (initial params), not replay early batches onto
+    warm weights."""
+    net_a, est_a, batches = _training_setup()
+    Supervisor(str(tmp_path / "a"), handle_sigterm=False,
+               save_every_n_batches=100).fit(est_a, batches, epochs=1)
+
+    net_b, est_b, batches_b = _training_setup()
+    orig = est_b.fit_batch
+    state = {"n": 0}
+
+    def flaky(d, l, ax=0):
+        state["n"] += 1
+        if state["n"] == 3:  # batch 3 of epoch 1 — nothing saved yet
+            raise TransientError("preempted before first periodic save")
+        return orig(d, l, ax)
+
+    est_b.fit_batch = flaky
+    sup = Supervisor(str(tmp_path / "b"), handle_sigterm=False,
+                     save_every_n_batches=100,
+                     policy=RetryPolicy(max_attempts=3, base_delay_s=0.001))
+    sup.fit(est_b, batches_b, epochs=1)
+    for (ka, pa), (kb, pb) in zip(sorted(net_a.collect_params().items()),
+                                  sorted(net_b.collect_params().items())):
+        onp.testing.assert_allclose(pa.data().asnumpy(),
+                                    pb.data().asnumpy(), rtol=1e-6)
+
+
+def test_supervisor_baseline_save_with_deferred_params(tmp_path):
+    """A net with deferred (shape-unknown) params must not crash the
+    pre-loop baseline save: the Supervisor finalizes shapes with one
+    predict-mode forward on the first batch."""
+    net = nn.Dense(2)  # no in_units: the standard deferred-shape pattern
+    net.initialize()
+    est = Estimator(net, gluon.loss.L2Loss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.05}))
+    xs = onp.random.RandomState(0).randn(8, 3).astype("float32")
+    ys = onp.random.RandomState(1).randn(8, 2).astype("float32")
+    batches = [(mx.np.array(xs[i:i + 4]), mx.np.array(ys[i:i + 4]))
+               for i in (0, 4)]
+    sup = Supervisor(str(tmp_path / "run"), handle_sigterm=False)
+    out = sup.fit(est, batches, epochs=1)
+    assert out["global_batch"] == 2
+    assert sup.manager.latest_step() is not None  # baseline + final saved
+
+
+def test_supervisor_fatal_error_propagates(tmp_path):
+    net, est, batches = _training_setup()
+    sup = Supervisor(str(tmp_path / "run"), handle_sigterm=False)
+
+    def bad_fit_batch(d, l, ax=0):
+        raise ValueError("Incompatible shapes: this is a bug, not weather")
+
+    est.fit_batch = bad_fit_batch
+    with pytest.raises(ValueError):
+        sup.fit(est, batches, epochs=1)
+    assert sup.stats()["recoveries"] == 0
+
+
+def test_supervisor_exhaustion_is_typed(tmp_path):
+    net, est, batches = _training_setup()
+    sup = Supervisor(str(tmp_path / "run"), handle_sigterm=False,
+                     policy=RetryPolicy(max_attempts=2, base_delay_s=0.001))
+
+    def always_transient(d, l, ax=0):
+        raise TransientError("permanent weather")
+
+    est.fit_batch = always_transient
+    with pytest.raises(RetriesExhausted):
+        sup.fit(est, batches, epochs=1)
+
+
+def test_supervisor_all_corrupt_raises_instead_of_silent_restart(tmp_path):
+    """An all-corrupt checkpoint directory must fail LOUDLY — silently
+    restarting at epoch 0 on warm in-memory params would diverge from
+    both a fresh run and a resumed one."""
+    d = str(tmp_path / "run")
+    sup = Supervisor(d, handle_sigterm=False)
+    sup.run_steps(lambda s, i: {"w": s["w"] + 1}, {"w": onp.zeros(2)}, 2)
+    mpath = os.path.join(d, str(ckpt.CheckpointManager(d).latest_step()),
+                         "manifest.json")
+    manifest = json.load(open(mpath))
+    for rec in manifest["leaves"].values():
+        rec["sha256"] = "0" * 64
+    json.dump(manifest, open(mpath, "w"))
+    # corrupt every retained step the same way
+    mgr = ckpt.CheckpointManager(d)
+    for s in mgr.all_steps():
+        mp = os.path.join(d, str(s), "manifest.json")
+        m = json.load(open(mp))
+        for rec in m["leaves"].values():
+            rec["sha256"] = "0" * 64
+        json.dump(m, open(mp, "w"))
+    sup2 = Supervisor(d, handle_sigterm=False)
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(mx.MXNetError, match="every retained"):
+            sup2.run_steps(lambda s, i: s, {"w": onp.zeros(2)}, 4)
+
+
+def test_supervisor_budget_counts_consecutive_no_progress_faults(tmp_path):
+    """A recovery followed by checkpointed progress resets the retry
+    budget: many well-separated faults must not kill a long run."""
+    sup = Supervisor(str(tmp_path / "run"), handle_sigterm=False,
+                     save_every_n_batches=1,
+                     policy=RetryPolicy(max_attempts=2, base_delay_s=0.001))
+    seen = set()
+
+    def step(state, i):
+        if i in (2, 5, 8) and i not in seen:
+            seen.add(i)  # one fault per step, 3 faults total > max_attempts
+            raise TransientError(f"preempted before step {i}")
+        return {"w": state["w"] + 1}
+
+    out = sup.run_steps(step, {"w": onp.zeros(2)}, 10)
+    onp.testing.assert_allclose(onp.asarray(out["w"]), 10.0)
+    assert sup.stats()["recoveries"] == 3  # all survived: progress resets
+
+
+def test_supervisor_run_steps_resume_across_managers(tmp_path):
+    """Standalone step-fn mode + cross-'process' resume: a second
+    Supervisor over the same directory continues where the first one
+    stopped (the same path the kill-resume subprocess test exercises)."""
+    d = str(tmp_path / "steps")
+
+    def step(state, i):
+        return {"w": state["w"] * 0.9 + i}
+
+    ref = {"w": onp.full((3,), 1.0, "float64")}
+    for i in range(8):
+        ref = step(ref, i)
+
+    sup1 = Supervisor(d, save_every_n_batches=1, handle_sigterm=False)
+    calls = {"n": 0}
+
+    def step_then_die(state, i):
+        calls["n"] += 1
+        if i == 5:
+            raise SystemExit  # simulate abrupt stop AFTER 5 completed steps
+        return step(state, i)
+
+    with pytest.raises(SystemExit):
+        sup1.run_steps(step_then_die, {"w": onp.full((3,), 1.0, "float64")},
+                       8)
+    sup2 = Supervisor(d, save_every_n_batches=1, handle_sigterm=False)
+    done = []
+
+    def step_logged(state, i):
+        done.append(i)
+        return step(state, i)
+
+    out = sup2.run_steps(step_logged, {"w": onp.zeros(3)}, 8)
+    assert done == [5, 6, 7]  # resumed at the exact step
+    onp.testing.assert_allclose(onp.asarray(out["w"]), ref["w"])
+
+
+@pytest.mark.chaos
+def test_supervisor_sigterm_saves_and_raises_preempted(tmp_path):
+    """TPU preemption semantics: SIGTERM -> one final synchronous save,
+    then a typed Preempted so the process exits checkpointed."""
+    d = str(tmp_path / "steps")
+    sup = Supervisor(d, save_every_n_batches=100)  # periodic saves OFF
+
+    def step(state, i):
+        if i == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return {"w": state["w"] + 1}
+
+    with pytest.raises(Preempted):
+        sup.run_steps(step, {"w": onp.zeros(2)}, 10)
+    assert sup.stats()["preemptions"] == 1
+    # the final save landed, at the exact cursor (3 steps completed)
+    tree = ckpt.CheckpointManager(d).restore()
+    assert int(tree["progress"]["i"]) == 3
+    onp.testing.assert_allclose(onp.asarray(tree["state"]["w"]), 3.0)
+    # handler restored: SIGTERM no longer intercepted
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume, end to end (the acceptance drill)
+# ---------------------------------------------------------------------------
+_CHILD = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as onp
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.numpy import random as mxrandom
+    from mxnet_tpu.resilience import Supervisor
+
+    ckpt_dir = sys.argv[1]
+    onp.random.seed(3); mxrandom.seed(3)
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    rng = onp.random.RandomState(11)
+    xs = rng.randn(16, 3).astype("float32")
+    ys = rng.randn(16, 2).astype("float32")
+    batches = [(mx.np.array(xs[i:i+4]), mx.np.array(ys[i:i+4]))
+               for i in range(0, 16, 4)]
+    est = Estimator(net, gluon.loss.L2Loss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {{"learning_rate": 0.05,
+                                            "momentum": 0.9}}))
+    sup = Supervisor(ckpt_dir, save_every_n_batches=1)
+    out = sup.fit(est, batches, epochs=2)
+    loss = float(sum(((net(bx) - by) ** 2).mean().asnumpy()
+                     for bx, by in batches))
+    params = {{k: p.data().asnumpy().tolist()
+               for k, p in net.collect_params().items()}}
+    print(json.dumps({{"loss": loss, "resumed": bool(out["resumed"]),
+                       "global_batch": int(out["global_batch"]),
+                       "params": params}}))
+""")
+
+
+def _run_child(script, ckpt_dir, extra_env=None, timeout=240):
+    env = {k: v for k, v in os.environ.items() if k != "MXNET_TPU_CHAOS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
+    return subprocess.run([sys.executable, str(script), str(ckpt_dir)],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=env, cwd=REPO)
+
+
+@pytest.mark.chaos
+def test_kill_mid_checkpoint_then_resume_reaches_same_loss(tmp_path):
+    """The acceptance criterion, literally: chaos-kill the process in
+    the middle of a checkpoint write, restart it on the same directory,
+    and the resumed training run must reach the SAME final loss as an
+    uninterrupted run with the same seed."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD.format(repo=REPO))
+
+    # run 1: killed on the 5th checkpoint write (mid-epoch-1, arrays
+    # staged but the step not yet published) — pod-eviction exit code
+    r1 = _run_child(script, tmp_path / "run",
+                    extra_env={"MXNET_TPU_CHAOS": "checkpoint.write=kill:5"})
+    assert r1.returncode == 137, r1.stderr[-2000:]
+    torn = [n for n in os.listdir(tmp_path / "run") if n.endswith(".tmp")]
+    assert torn, "kill-during-save must leave a torn staging dir"
+
+    # run 2: same directory, chaos disarmed — sweeps the torn dir,
+    # restores the last VALID step, finishes the run
+    r2 = _run_child(script, tmp_path / "run")
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    resumed = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert resumed["resumed"] is True
+
+    # run 3: uninterrupted reference in a fresh directory
+    r3 = _run_child(script, tmp_path / "ref")
+    assert r3.returncode == 0, r3.stderr[-2000:]
+    ref = json.loads(r3.stdout.strip().splitlines()[-1])
+    assert ref["resumed"] is False
+
+    assert resumed["global_batch"] == ref["global_batch"] == 8
+    onp.testing.assert_allclose(resumed["loss"], ref["loss"], rtol=1e-6)
+    for k in ref["params"]:
+        onp.testing.assert_allclose(resumed["params"][k], ref["params"][k],
+                                    rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# serving under chaos (deadline + retry loop — PR 1 contract guard)
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_serving_deadline_shed_under_injected_latency():
+    from mxnet_tpu.serving import DeadlineExceeded, InferenceEngine
+
+    eng = InferenceEngine(lambda x: x * 2, jit=False, max_batch_size=4,
+                          max_delay_ms=1)
+    try:
+        x = onp.ones((1, 3), "float32")
+        eng.infer(x)  # warm the path, no chaos
+        with chaos.scope("serving.infer", delay=0.3, times=1):
+            slow = eng.infer_async(x, timeout_ms=None)
+            time.sleep(0.05)  # the delayed batch now holds the batcher
+            fast = eng.infer_async(x, timeout_ms=100)
+            out = slow.wait(timeout=10)  # delayed but completes
+            assert out is not None
+            with pytest.raises(DeadlineExceeded):
+                fast.wait(timeout=10)  # expired in queue -> typed shed
+        # shed is transient: one retry loop recovers once latency clears
+        out = call_with_retry(
+            eng.infer, x, policy=RetryPolicy(max_attempts=3,
+                                             base_delay_s=0.01))
+        onp.testing.assert_allclose(onp.asarray(out.asnumpy()), 2.0)
+    finally:
+        eng.close()
+
+
+@pytest.mark.chaos
+def test_serving_injected_fault_fails_batch_not_process():
+    from mxnet_tpu.serving import InferenceEngine
+
+    eng = InferenceEngine(lambda x: x + 1, jit=False, max_batch_size=4,
+                          max_delay_ms=1)
+    try:
+        x = onp.ones((1, 2), "float32")
+        with chaos.scope("serving.infer", fail="transient", times=1):
+            with pytest.raises(TransientError):
+                eng.infer(x)
+        # engine still live; a retried request succeeds
+        out = call_with_retry(eng.infer, x,
+                              policy=RetryPolicy(base_delay_s=0.01))
+        onp.testing.assert_allclose(onp.asarray(out.asnumpy()), 2.0)
+    finally:
+        eng.close()
+
+
+def test_chaos_bench_smoke(tmp_path):
+    """tools/chaos_bench.py --smoke runs end to end and banks rows."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_bench
+    finally:
+        sys.path.pop(0)
+    out = tmp_path / "rows.json"
+    rc = chaos_bench.main(["--smoke", "--out", str(out)])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    names = {r["metric"] for r in payload["records"]}
+    assert "chaos_site_disarmed_ns" in names
+    assert "chaos_recovery_overhead_pct" in names
