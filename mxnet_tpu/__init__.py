@@ -51,6 +51,14 @@ from . import profiler  # noqa: F401
 from . import runtime  # noqa: F401
 from .util import is_np_array, set_np, use_np  # noqa: F401
 
+# tpulint runtime sentinel: importing mx.analysis installs the
+# retrace/transfer observers when MXNET_TPU_LINT is set — eager here so
+# the env knob works without an explicit import (docs/static_analysis.md)
+import os as _os
+
+if _os.environ.get("MXNET_TPU_LINT"):
+    from . import analysis  # noqa: F401
+
 def __getattr__(name):
     # lazy submodule loads go through importlib: `from . import x` here
     # would re-enter __getattr__ via hasattr and recurse. A missing module
@@ -73,7 +81,7 @@ def __getattr__(name):
                "registry": ".registry", "executor": ".executor",
                "recordio": ".recordio", "serialization": ".serialization",
                "misc": ".misc", "torch": ".torch", "serving": ".serving",
-               "resilience": ".resilience"}
+               "resilience": ".resilience", "analysis": ".analysis"}
     if name in targets:
         expected = importlib.util.resolve_name(targets[name], __name__)
         try:

@@ -268,8 +268,12 @@ class _Predictor:
         out = self.block(*self.inputs)
         if not isinstance(out, (list, tuple)):
             out = (out,)
-        self.outputs = [onp.asarray(o.asnumpy(), dtype=onp.float32)
-                        for o in out]
+        # the C predict ABI hands host float32 buffers to the caller —
+        # this sync IS the contract (astype(copy=False) avoids the old
+        # double conversion when the output is already f32)
+        self.outputs = [
+            o.asnumpy().astype(onp.float32, copy=False)  # tpulint: disable=A001
+            for o in out]
 
     def output_shape(self, index: int) -> tuple:
         if self.outputs is not None:

@@ -1,0 +1,45 @@
+"""``mx.analysis`` — tpulint, the TPU anti-pattern analyzer.
+
+Three layers, one finding model (:class:`~.findings.Finding`):
+
+- :mod:`.jaxpr_rules` — trace a block/callable with ``jax.make_jaxpr``
+  and lint the IR: MXU tile alignment, float64 leakage, dtype churn,
+  scalar-reduce outputs, donation misses (J001–J005).
+- :mod:`.ast_rules` — lint Python source: host syncs in hot paths,
+  jit-cache-key hazards, f64 literals (A001–A003), with
+  ``# tpulint: disable=<rule>`` inline suppression.
+- :mod:`.sentinel` — opt-in runtime watch (``MXNET_TPU_LINT``):
+  counts jit cache misses and device->host transfers through
+  ``mx.profiler`` and warns/raises past a budget.
+
+``tools/tpulint.py`` is the CLI; the tier-1 suite self-lints the
+framework against ``tools/tpulint_baseline.json`` so new high-severity
+findings fail CI. Full catalog: ``docs/static_analysis.md``.
+"""
+from __future__ import annotations
+
+import os as _os
+
+from .findings import Finding, RULES, sort_findings, max_severity  # noqa: F401
+from .ast_rules import lint_source, lint_paths, cache_key_knobs  # noqa: F401
+from .jaxpr_rules import (  # noqa: F401
+    lint_jaxpr,
+    lint_callable,
+    lint_block,
+    find_donation_misses,
+    lint_trainer,
+)
+from . import baseline  # noqa: F401
+from . import sentinel  # noqa: F401
+from .sentinel import TpuLintWarning, LintBudgetExceeded  # noqa: F401
+
+__all__ = [
+    "Finding", "RULES", "sort_findings", "max_severity",
+    "lint_source", "lint_paths", "cache_key_knobs",
+    "lint_jaxpr", "lint_callable", "lint_block",
+    "find_donation_misses", "lint_trainer",
+    "baseline", "sentinel", "TpuLintWarning", "LintBudgetExceeded",
+]
+
+if _os.environ.get("MXNET_TPU_LINT"):
+    sentinel.activate_from_env()

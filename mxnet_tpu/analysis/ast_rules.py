@@ -1,0 +1,402 @@
+"""Python-source TPU anti-pattern rules (the AST half of tpulint).
+
+Hot-path model: device->host syncs are only findings where they repeat
+per step — inside ``hybrid_forward``/``forward`` methods (they also break
+jit tracing outright), metric/optimizer ``update`` methods, and training
+loops (any loop whose body calls ``.step(``/``.backward(`` or opens
+``autograd.record()``). A sync in ``get()``/``__init__``/a script prologue
+is free and never flagged.
+
+Rules:
+
+- **A001 tpu-host-sync-hot** — ``.asnumpy()``, ``.item()``,
+  ``np/onp/numpy.asarray|array(...)``, ``float()/int()/bool()`` over a
+  computed value, or iterating a tensor argument, inside a hot path.
+- **A002 tpu-cache-key-hazard** — an ``MXNET_*`` env knob read inside
+  traced code (``forward``/``hybrid_forward``, or a private lowering
+  helper in an ``ops/`` module) whose name appears in **no** jit cache
+  key. Cache keys are discovered, not declared: every function named
+  ``*cache_key*`` or ``_signature`` contributes its ``MXNET_*`` string
+  literals (``ops/nn.py:stem_s2d_cache_key`` and
+  ``gluon/block.py:_signature`` today). The bug class this catches was
+  fixed by hand once already (stem-s2d knob absent from the hybridize
+  key, PR 1).
+- **A003 tpu-f64-source** — ``float64`` dtype literals in ``gluon``/
+  ``ops`` modules (low severity; host-side bookkeeping in f64 is often
+  deliberate — suppress inline where it is).
+
+Suppression: ``# tpulint: disable=A001`` (comma-separated ids or
+``all``) on the finding's line or the line above banks an *intentional*
+occurrence at the source, with the rule id in the code for reviewers.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+
+HOT_METHODS = {"hybrid_forward", "forward", "update"}
+SYNC_ATTRS = {"asnumpy", "item", "asscalar"}
+NP_MODULE_NAMES = {"np", "onp", "numpy"}
+NP_SYNC_FUNCS = {"asarray", "array"}
+CAST_BUILTINS = {"float", "int", "bool"}
+LOOP_HOT_CALLS = {"step", "backward", "record"}
+
+_DISABLE_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+_ENV_KNOB_RE = re.compile(r"^MXNET_")
+
+
+_METADATA_ATTRS = {"shape", "ndim", "size", "itemsize", "dtype"}
+
+
+_HOST_FUNC_MODULES = NP_MODULE_NAMES | {"math"}
+
+
+def _is_metadata_expr(node: ast.AST) -> bool:
+    """True when the expression reads ONLY array *metadata* (shape math is
+    static and free — ``int(onp.prod(x.shape[1:]))`` is not a sync).
+
+    Every attribute access must be a metadata attr or a host-module
+    function (``onp.prod``/``math.prod``); one device access anywhere —
+    ``float(loss.sum() / batch.shape[0])`` — disqualifies the whole
+    expression, so mixing in ``.shape`` cannot launder a sync."""
+    saw_metadata = False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            if sub.attr in _METADATA_ATTRS:
+                saw_metadata = True
+            elif not (isinstance(sub.value, ast.Name)
+                      and sub.value.id in _HOST_FUNC_MODULES):
+                return False
+    return saw_metadata
+
+
+def _unparse(node, limit: int = 48) -> str:
+    try:
+        txt = ast.unparse(node)
+    except Exception:  # noqa: BLE001
+        txt = "<expr>"
+    return txt if len(txt) <= limit else txt[: limit - 1] + "…"
+
+
+def _suppressions(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _DISABLE_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out[lineno] = rules
+    return out
+
+
+def _suppressed(supp: Dict[int, Set[str]], rule: str, line: int) -> bool:
+    for ln in (line, line - 1):
+        rules = supp.get(ln)
+        if rules and ("all" in rules or rule in rules):
+            return True
+    return False
+
+
+def cache_key_knobs(source: str) -> Set[str]:
+    """All ``MXNET_*`` string literals inside cache-key functions
+    (``*cache_key*`` in the name, or ``_signature``)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return set()
+    return _knobs_from_tree(tree)
+
+
+def _knobs_from_tree(tree: ast.AST) -> Set[str]:
+    knobs: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+                "cache_key" in node.name or node.name == "_signature"):
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Constant)
+                        and isinstance(sub.value, str)
+                        and _ENV_KNOB_RE.match(sub.value)):
+                    knobs.add(sub.value)
+    return knobs
+
+
+def _is_env_read(node: ast.Call) -> Optional[str]:
+    """Return the knob name when ``node`` is os.environ.get/os.getenv/
+    environ.get with a literal MXNET_* first argument."""
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in ("get", "getenv"):
+            base = fn.value
+            if isinstance(base, ast.Attribute) and base.attr == "environ":
+                name = "env"
+            elif isinstance(base, ast.Name) and base.id in ("os", "environ"):
+                name = "env"
+        elif fn.attr == "environ":
+            return None
+    if name is None:
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+            node.args[0].value, str):
+        knob = node.args[0].value
+        if _ENV_KNOB_RE.match(knob):
+            return knob
+    return None
+
+
+def _is_env_subscript(node: ast.Subscript) -> Optional[str]:
+    """Return the knob name when ``node`` is ``os.environ["MXNET_*"]`` /
+    ``environ["MXNET_*"]`` with a literal key."""
+    base = node.value
+    is_environ = (isinstance(base, ast.Attribute) and base.attr == "environ"
+                  ) or (isinstance(base, ast.Name) and base.id == "environ")
+    if not is_environ:
+        return None
+    key = node.slice
+    if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+            and _ENV_KNOB_RE.match(key.value):
+        return key.value
+    return None
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str, cache_keys: Set[str]):
+        self.relpath = relpath
+        self.supp = _suppressions(source)
+        self.cache_keys = cache_keys
+        self.findings: List[Finding] = []
+        self.class_stack: List[str] = []
+        self.func_stack: List[ast.AST] = []
+        # (scope name, hot?, trace-path?, tensor params) per function
+        self.ctx_stack: List[dict] = []
+        self.loop_depth_hot = 0
+        self.in_ops_module = "/ops/" in relpath.replace(os.sep, "/") or \
+            relpath.replace(os.sep, "/").startswith("ops/")
+
+    # -- helpers -----------------------------------------------------------
+    def _scope(self) -> str:
+        parts = list(self.class_stack)
+        if self.ctx_stack:
+            parts.append(self.ctx_stack[-1]["name"])
+        return ".".join(parts) or "<module>"
+
+    def _emit(self, rule: str, node: ast.AST, message: str, detail: str,
+              hint: str = ""):
+        line = getattr(node, "lineno", 0)
+        if _suppressed(self.supp, rule, line):
+            return
+        self.findings.append(Finding(
+            rule, message, path=self.relpath, line=line,
+            scope=self._scope(), detail=detail, hint=hint))
+
+    def _hot(self) -> bool:
+        if self.loop_depth_hot > 0:
+            return True
+        return bool(self.ctx_stack and self.ctx_stack[-1]["hot"])
+
+    def _trace_path(self) -> bool:
+        return bool(self.ctx_stack and self.ctx_stack[-1]["trace"])
+
+    # -- scope bookkeeping -------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node):
+        in_class = bool(self.class_stack)
+        hot = in_class and node.name in HOT_METHODS
+        trace = node.name in ("forward", "hybrid_forward") or (
+            self.in_ops_module and node.name.startswith("_")
+            and "cache_key" not in node.name)
+        if "cache_key" in node.name or node.name == "_signature":
+            trace = False
+        tensor_params: Set[str] = set()
+        if node.name in ("forward", "hybrid_forward"):
+            argnames = [a.arg for a in node.args.args]
+            tensor_params = {a for a in argnames[1:] if a != "F"}
+        self.ctx_stack.append(
+            {"name": node.name, "hot": hot, "trace": trace,
+             "tensors": tensor_params})
+        # a def nested in a hot loop executes nothing per iteration — its
+        # body is not hot-loop code (it gets its own hotness from ctx)
+        saved_loop_depth, self.loop_depth_hot = self.loop_depth_hot, 0
+        self.generic_visit(node)
+        self.loop_depth_hot = saved_loop_depth
+        self.ctx_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- training loops ----------------------------------------------------
+    def _loop_is_hot(self, node) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                if isinstance(fn, ast.Attribute) and fn.attr in LOOP_HOT_CALLS:
+                    return True
+        return False
+
+    def _visit_loop(self, node):
+        hot = self._loop_is_hot(node)
+        # tensor-argument iteration inside forward (A001): `for row in x`
+        if (self.ctx_stack and self.ctx_stack[-1]["tensors"]
+                and isinstance(node, ast.For)
+                and isinstance(node.iter, ast.Name)
+                and node.iter.id in self.ctx_stack[-1]["tensors"]):
+            self._emit(
+                "A001", node,
+                f"iterating tensor argument `{node.iter.id}` in "
+                f"{self.ctx_stack[-1]['name']} syncs once per element and "
+                "breaks jit tracing",
+                detail=f"iter:{node.iter.id}",
+                hint="vectorize with jnp ops / lax.scan instead of a "
+                     "Python loop over rows")
+        if hot:
+            self.loop_depth_hot += 1
+        self.generic_visit(node)
+        if hot:
+            self.loop_depth_hot -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    # -- the sync / knob detectors -----------------------------------------
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if self._hot():
+            if isinstance(fn, ast.Attribute) and fn.attr in SYNC_ATTRS:
+                self._emit(
+                    "A001", node,
+                    f"`.{fn.attr}()` forces a device->host transfer in a "
+                    "hot path",
+                    detail=f"{fn.attr}:{_unparse(fn.value)}",
+                    hint="accumulate on device and fetch once per "
+                         "log-interval (one fused transfer per update)")
+            elif (isinstance(fn, ast.Attribute)
+                  and fn.attr in NP_SYNC_FUNCS
+                  and isinstance(fn.value, ast.Name)
+                  and fn.value.id in NP_MODULE_NAMES):
+                self._emit(
+                    "A001", node,
+                    f"`{fn.value.id}.{fn.attr}(...)` materializes a device "
+                    "array on host in a hot path",
+                    detail=f"{fn.value.id}.{fn.attr}:{_unparse(node.args[0]) if node.args else ''}",
+                    hint="keep the value in jnp; convert once at the "
+                         "epoch/log boundary")
+            elif (isinstance(fn, ast.Name) and fn.id in CAST_BUILTINS
+                  and len(node.args) == 1
+                  and isinstance(node.args[0], (ast.Call, ast.BinOp))
+                  and not _is_metadata_expr(node.args[0])):
+                self._emit(
+                    "A001", node,
+                    f"`{fn.id}({_unparse(node.args[0])})` blocks on the "
+                    "device and syncs a scalar in a hot path",
+                    detail=f"{fn.id}:{_unparse(node.args[0])}",
+                    hint="defer scalarization: log from a device "
+                         "accumulator at interval boundaries")
+        self._check_knob(_is_env_read(node), node)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        self._check_knob(_is_env_subscript(node), node)
+        self.generic_visit(node)
+
+    def _check_knob(self, knob: Optional[str], node: ast.AST):
+        if knob is not None and self._trace_path():
+            if knob not in self.cache_keys:
+                self._emit(
+                    "A002", node,
+                    f"env knob `{knob}` is read under trace but appears in "
+                    "no jit cache key: flipping it serves stale executables",
+                    detail=f"knob:{knob}",
+                    hint="add the knob to the hybridize cache key (see "
+                         "ops/nn.py:stem_s2d_cache_key wired into "
+                         "gluon/block.py:_signature) or read it outside "
+                         "traced code")
+
+    def visit_Constant(self, node: ast.Constant):
+        if (node.value == "float64"
+                and any(seg in self.relpath.replace(os.sep, "/")
+                        for seg in ("gluon/", "ops/"))):
+            self._emit(
+                "A003", node,
+                "float64 dtype literal in accelerator-adjacent source",
+                detail=f"f64:{self._scope()}",
+                hint="use float32/bfloat16 for device values; if this is "
+                     "deliberate host bookkeeping, suppress with "
+                     "`# tpulint: disable=A003`")
+
+
+def lint_source(source: str, relpath: str = "<string>",
+                extra_cache_keys: Iterable[str] = ()) -> List[Finding]:
+    """Lint one source text. Cache-key knobs are discovered from the same
+    text plus ``extra_cache_keys`` (the cross-file set)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [_syntax_finding(e, relpath)]
+    keys = _knobs_from_tree(tree) | set(extra_cache_keys)
+    return _lint_tree(tree, source, relpath, keys)
+
+
+def _syntax_finding(e: SyntaxError, relpath: str) -> Finding:
+    return Finding("A000", f"syntax error: {e}", path=relpath,
+                   line=e.lineno or 0, severity="high",
+                   detail="syntax-error")
+
+
+def _lint_tree(tree: ast.AST, source: str, relpath: str,
+               cache_keys: Set[str]) -> List[Finding]:
+    linter = _FileLinter(relpath, source, cache_keys)
+    linter.visit(tree)
+    return linter.findings
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path) and path.endswith(".py"):
+            yield path
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None
+               ) -> List[Finding]:
+    """Two-pass lint over files/directories: first collect every cache-key
+    knob in the corpus, then lint each file against the union — a knob
+    keyed in ``ops/nn.py`` must cover a read in ``gluon/``."""
+    root = root or os.getcwd()
+    # parse each file exactly once: knob collection and the lint walk
+    # share the tree
+    parsed: List[Tuple[str, str, object]] = []  # (rel, text, tree|SyntaxError)
+    all_keys: Set[str] = set()
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(path, root)
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            parsed.append((rel, text, e))
+            continue
+        parsed.append((rel, text, tree))
+        all_keys |= _knobs_from_tree(tree)
+    findings: List[Finding] = []
+    for rel, text, tree in parsed:
+        if isinstance(tree, SyntaxError):
+            findings.append(_syntax_finding(tree, rel))
+        else:
+            findings.extend(_lint_tree(tree, text, rel, all_keys))
+    return findings

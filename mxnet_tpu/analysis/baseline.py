@@ -1,0 +1,65 @@
+"""Baseline files: bank known findings so CI fails only on regressions.
+
+Format (``tools/tpulint_baseline.json``)::
+
+    {"version": 1, "tool": "tpulint",
+     "findings": {"<finding key>": <count>, ...}}
+
+Keys are :attr:`Finding.key` — rule|path|scope|detail, no line numbers —
+so editing unrelated lines in a banked file does not churn the baseline.
+A finding is *new* when its key is absent, or when the same key now
+occurs more often than banked (a second sync added next to a known one
+must not hide behind it).
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, List, Tuple
+
+from .findings import Finding
+
+VERSION = 1
+
+
+def counts(findings: List[Finding]) -> Dict[str, int]:
+    return dict(Counter(f.key for f in findings))
+
+
+def save(path: str, findings: List[Finding]) -> None:
+    payload = {
+        "version": VERSION,
+        "tool": "tpulint",
+        "findings": dict(sorted(counts(findings).items())),
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load(path: str) -> Dict[str, int]:
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("version") != VERSION:
+        raise ValueError(
+            f"{path}: unsupported tpulint baseline version "
+            f"{payload.get('version')!r}")
+    return dict(payload.get("findings", {}))
+
+
+def diff(findings: List[Finding],
+         banked: Dict[str, int]) -> Tuple[List[Finding], int]:
+    """Return (new findings not covered by the baseline, stale count).
+
+    Stale = banked occurrences that no longer fire; surfaced so a
+    baseline refresh can shrink the debt ledger as fixes land.
+    """
+    remaining = dict(banked)
+    new: List[Finding] = []
+    for f in findings:
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+        else:
+            new.append(f)
+    stale = sum(v for v in remaining.values() if v > 0)
+    return new, stale

@@ -1,0 +1,146 @@
+"""tpulint CLI (driven by ``tools/tpulint.py``).
+
+Usage::
+
+    python tools/tpulint.py [paths…] [--zoo] [--format text|json]
+        [--baseline tools/tpulint_baseline.json] [--write-baseline FILE]
+        [--fail-on high|any|none]
+
+Source paths get the AST pass; ``--zoo`` additionally traces a
+representative set of model-zoo networks through the jaxpr pass (pure
+tracing — no FLOP executes, so the whole run stays CPU-cheap). With
+``--baseline``, only *new* findings at or above ``--fail-on`` fail the
+run (exit 1); ``--write-baseline`` banks the current findings as the
+accepted debt ledger.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from . import ast_rules, baseline as baseline_mod
+from .findings import Finding, HIGH, RULES, _SEV_ORDER, sort_findings
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# small-but-representative zoo slice: a squeeze/expand topology (odd
+# channel counts — J001's bread and butter), a depthwise net, and a
+# plain residual convnet. Tracing only; kept < 60 s on CPU.
+ZOO_MODELS = (
+    ("squeezenet1.0", (1, 3, 224, 224)),
+    ("mobilenet0.25", (1, 3, 224, 224)),
+    ("resnet18_v1", (1, 3, 224, 224)),
+)
+
+
+def lint_zoo(models=ZOO_MODELS) -> List[Finding]:
+    import numpy as onp
+
+    from ..gluon.model_zoo import vision
+    from .jaxpr_rules import lint_block
+
+    findings: List[Finding] = []
+    for name, shape in models:
+        net = vision.get_model(name)
+        net.initialize()
+        x = onp.zeros(shape, dtype="float32")
+        findings.extend(lint_block(net, x, scope=f"zoo:{name}"))
+    return findings
+
+
+def run(paths, zoo: bool = False, baseline_path: Optional[str] = None,
+        write_baseline: Optional[str] = None, fail_on: str = "high",
+        fmt: str = "text", root: Optional[str] = None,
+        out=None) -> int:
+    out = out or sys.stdout
+    root = root or REPO_ROOT
+    t0 = time.perf_counter()
+    findings = ast_rules.lint_paths(paths, root=root)
+    if zoo:
+        findings.extend(lint_zoo())
+    findings = sort_findings(findings)
+
+    if write_baseline:
+        baseline_mod.save(write_baseline, findings)
+        print(f"tpulint: banked {len(findings)} finding(s) to "
+              f"{write_baseline}", file=out)
+        return 0
+
+    new, stale = findings, 0
+    if baseline_path:
+        banked = baseline_mod.load(baseline_path)
+        new, stale = baseline_mod.diff(findings, banked)
+
+    threshold = {"high": 0, "any": max(_SEV_ORDER.values()),
+                 "none": -1}[fail_on]
+    gating = [f for f in new
+              if _SEV_ORDER.get(f.severity, max(_SEV_ORDER.values()))
+              <= threshold]
+
+    elapsed = time.perf_counter() - t0
+    if fmt == "json":
+        payload = {
+            "tool": "tpulint",
+            "elapsed_s": round(elapsed, 3),
+            "total": len(findings),
+            "new": [f.to_dict() for f in new],
+            "stale_baseline_entries": stale,
+            "failed": bool(gating),
+        }
+        json.dump(payload, out, indent=1)
+        out.write("\n")
+    else:
+        shown = new if baseline_path else findings
+        for f in shown:
+            print(f.render(), file=out)
+        label = "new finding(s)" if baseline_path else "finding(s)"
+        print(f"tpulint: {len(shown)} {label} "
+              f"({len(findings)} total, {stale} stale baseline entr"
+              f"{'y' if stale == 1 else 'ies'}) in {elapsed:.1f}s",
+              file=out)
+        if gating:
+            print(f"tpulint: FAIL — {len(gating)} new finding(s) at "
+                  f"severity >= {fail_on}", file=out)
+    return 1 if gating else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint",
+        description="TPU anti-pattern analyzer over jaxprs and source")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO_ROOT, "mxnet_tpu")],
+                    help="files/directories to lint "
+                         "(default: the mxnet_tpu package)")
+    ap.add_argument("--zoo", action="store_true",
+                    help="also trace representative model-zoo networks "
+                         "through the jaxpr rules")
+    ap.add_argument("--format", dest="fmt", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON; only new findings gate")
+    ap.add_argument("--write-baseline", default=None,
+                    help="bank current findings and exit 0")
+    ap.add_argument("--fail-on", choices=("high", "any", "none"),
+                    default="high",
+                    help="minimum severity of NEW findings that fails the "
+                         "run (default: high)")
+    ap.add_argument("--root", default=None,
+                    help="root for repo-relative paths in finding keys "
+                         "(default: the repo root)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (sev, desc) in sorted(RULES.items()):
+            print(f"{rule} [{sev:6s}] {desc}")
+        return 0
+
+    return run(args.paths, zoo=args.zoo, baseline_path=args.baseline,
+               write_baseline=args.write_baseline, fail_on=args.fail_on,
+               fmt=args.fmt, root=args.root)

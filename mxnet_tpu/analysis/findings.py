@@ -1,0 +1,111 @@
+"""tpulint finding model + rule catalog.
+
+A finding is one detected TPU anti-pattern: a rule id, a severity, a
+location (``file:line`` for source findings, a jaxpr/model scope for IR
+findings), a human message and a fix hint. Findings are hashable into a
+*stable key* (no line numbers — line drift must not churn baselines) so a
+checked-in baseline can separate known debt from regressions.
+
+Severity contract (what the CI gate keys on):
+- ``high``   — falls off the TPU fast path or silently breaks the jit
+               cache; new ones fail the tier-1 self-lint gate.
+- ``medium`` — pays real padding/conversion cost; reported, not gating.
+- ``low``    — style-level dtype hygiene; informational.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+HIGH = "high"
+MEDIUM = "medium"
+LOW = "low"
+
+_SEV_ORDER = {HIGH: 0, MEDIUM: 1, LOW: 2}
+
+
+# rule id -> (severity, one-line description). The single source of truth:
+# docs/static_analysis.md and the CLI --list-rules output render from it.
+RULES: Dict[str, tuple] = {
+    # jaxpr-level (J*)
+    "J001": (MEDIUM, "tpu-dot-align: matmul/conv operand dim pads badly "
+                     "against the (8, 128) sublane/lane tiles"),
+    "J002": (HIGH, "tpu-f64-leak: float64 value inside a traced program "
+                   "(TPUs have no f64 ALU; XLA software-emulates it)"),
+    "J003": (MEDIUM, "tpu-convert-churn: dtype converted away and back "
+                     "(convert_element_type round-trip)"),
+    "J004": (MEDIUM, "tpu-scalar-reduce: full reduction to a scalar "
+                     "program output — a host-sync magnet"),
+    "J005": (HIGH, "tpu-donation-miss: buffer updated in place but not in "
+                   "donate_argnums — double HBM footprint per step"),
+    # source-level (A*)
+    "A001": (HIGH, "tpu-host-sync-hot: device->host sync "
+                   "(float()/.item()/.asnumpy()/np.asarray/iteration) "
+                   "inside a hot path"),
+    "A002": (HIGH, "tpu-cache-key-hazard: env knob read under trace but "
+                   "absent from every jit cache key"),
+    "A003": (LOW, "tpu-f64-source: float64 dtype literal in framework "
+                  "source"),
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    message: str
+    path: str = ""                 # repo-relative file, or "" for IR scopes
+    line: int = 0                  # 1-based; 0 = not line-anchored
+    scope: str = ""                # enclosing function / model name
+    detail: str = ""               # stable discriminator (dim sizes, knob…)
+    hint: str = ""
+    severity: str = field(default="")
+
+    def __post_init__(self):
+        if not self.severity:
+            self.severity = RULES.get(self.rule, (MEDIUM, ""))[0]
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: everything except the line number."""
+        return "|".join((self.rule, self.path, self.scope,
+                         self.detail or self.message))
+
+    @property
+    def location(self) -> str:
+        if self.path and self.line:
+            return f"{self.path}:{self.line}"
+        return self.path or self.scope or "<ir>"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "location": self.location,
+            "path": self.path,
+            "line": self.line,
+            "scope": self.scope,
+            "detail": self.detail,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+    def render(self) -> str:
+        loc = self.location
+        txt = f"{loc}: [{self.rule}/{self.severity}] {self.message}"
+        if self.scope and self.path:
+            txt += f" (in {self.scope})"
+        if self.hint:
+            txt += f"\n    hint: {self.hint}"
+        return txt
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (_SEV_ORDER.get(f.severity, 9),
+                                           f.path, f.line, f.rule))
+
+
+def max_severity(findings: List[Finding]) -> Optional[str]:
+    if not findings:
+        return None
+    return min((f.severity for f in findings),
+               key=lambda s: _SEV_ORDER.get(s, 9))

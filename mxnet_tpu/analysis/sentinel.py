@@ -1,0 +1,225 @@
+"""Runtime retrace / host-sync sentinel (``MXNET_TPU_LINT``).
+
+The static passes see what *would* fall off the fast path; this sentinel
+watches what actually does, in-process, with near-zero overhead when off:
+
+- **retraces** — every jit-cache miss in ``HybridBlock._call_cached``
+  (the observer global ``gluon.block._retrace_observer``). A block that
+  keeps tracing new signatures is a retrace storm: shapes that never
+  stabilize, or a knob read under trace that is missing from the cache
+  key (rule A002's runtime twin).
+- **transfers** — every ``ndarray.asnumpy()`` (which ``item()``,
+  ``float()``, ``int()``, ``bool()`` and ``__array__`` all funnel
+  through; observer global ``ndarray.ndarray._transfer_observer``).
+
+Counts are mirrored into ``mx.profiler`` counters
+(``tpulint_retraces`` / ``tpulint_transfers``) so they land on the same
+chrome-trace timeline as the ops that caused them.
+
+Activation::
+
+    MXNET_TPU_LINT=warn                      # budgets: retrace=8/block
+    MXNET_TPU_LINT=raise:retrace=2,transfer=100
+    MXNET_TPU_LINT=count                     # count only, never complain
+
+or programmatically ``sentinel.activate(mode="warn", retrace_budget=2)``.
+Past a budget the sentinel warns (:class:`TpuLintWarning`) or raises
+(:class:`LintBudgetExceeded`); ``report()`` returns the tallies either
+way.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Dict, Optional
+
+__all__ = [
+    "TpuLintWarning", "LintBudgetExceeded", "activate", "activate_from_env",
+    "deactivate", "active", "report", "reset_counts",
+    "DEFAULT_RETRACE_BUDGET",
+]
+
+DEFAULT_RETRACE_BUDGET = 8
+
+_lock = threading.Lock()
+_state: Optional[dict] = None
+
+
+class TpuLintWarning(UserWarning):
+    """A tpulint runtime budget was exceeded (warn mode)."""
+
+
+class LintBudgetExceeded(RuntimeError):
+    """A tpulint runtime budget was exceeded (raise mode)."""
+
+
+def _parse_env(value: str):
+    """``mode[:k=v,k=v]`` -> (mode, retrace_budget, transfer_budget)."""
+    mode, _, tail = value.partition(":")
+    mode = (mode or "warn").strip().lower()
+    if mode not in ("warn", "raise", "count"):
+        warnings.warn(
+            f"MXNET_TPU_LINT={value!r}: unknown mode {mode!r}, using "
+            "'warn'", stacklevel=3)
+        mode = "warn"
+    retrace, transfer = DEFAULT_RETRACE_BUDGET, None
+    for frag in filter(None, (f.strip() for f in tail.split(","))):
+        key, _, val = frag.partition("=")
+        try:
+            num = int(val)
+        except ValueError:
+            warnings.warn(
+                f"MXNET_TPU_LINT={value!r}: unparseable budget {frag!r} "
+                "ignored", stacklevel=3)
+            continue
+        if key.strip() in ("retrace", "retraces"):
+            retrace = num
+        elif key.strip() in ("transfer", "transfers"):
+            transfer = num
+        else:
+            warnings.warn(
+                f"MXNET_TPU_LINT={value!r}: unknown budget key {key!r} "
+                "ignored", stacklevel=3)
+    return mode, retrace, transfer
+
+
+def activate(mode: str = "warn",
+             retrace_budget: int = DEFAULT_RETRACE_BUDGET,
+             transfer_budget: Optional[int] = None) -> None:
+    """Install the observers and start counting."""
+    global _state
+    import importlib
+
+    from .. import profiler
+
+    # explicit module resolution: `from ..ndarray import ndarray` yields
+    # the CLASS (star-import shadows the submodule name)
+    block_mod = importlib.import_module("mxnet_tpu.gluon.block")
+    ndarray_mod = importlib.import_module("mxnet_tpu.ndarray.ndarray")
+
+    with _lock:
+        _state = {
+            "mode": mode,
+            "retrace_budget": retrace_budget,
+            "transfer_budget": transfer_budget,
+            "retraces": {},           # "<Block>@<id>" -> count
+            "total_retraces": 0,
+            "transfers": 0,
+            "transfer_bytes": 0,
+            "transfer_warned": False,
+            "retrace_counter": profiler.Counter(name="tpulint_retraces"),
+            "transfer_counter": profiler.Counter(name="tpulint_transfers"),
+        }
+    block_mod._retrace_observer = _on_retrace
+    ndarray_mod._transfer_observer = _on_transfer
+
+
+def activate_from_env() -> bool:
+    value = os.environ.get("MXNET_TPU_LINT")
+    if not value:
+        return False
+    mode, retrace, transfer = _parse_env(value)
+    activate(mode=mode, retrace_budget=retrace, transfer_budget=transfer)
+    return True
+
+
+def deactivate() -> None:
+    global _state
+    import importlib
+
+    block_mod = importlib.import_module("mxnet_tpu.gluon.block")
+    ndarray_mod = importlib.import_module("mxnet_tpu.ndarray.ndarray")
+
+    block_mod._retrace_observer = None
+    ndarray_mod._transfer_observer = None
+    with _lock:
+        _state = None
+
+
+def active() -> bool:
+    return _state is not None
+
+
+def reset_counts() -> None:
+    with _lock:
+        st = _state
+        if st is None:
+            return
+        st["retraces"] = {}
+        st["total_retraces"] = 0
+        st["transfers"] = 0
+        st["transfer_bytes"] = 0
+        st["transfer_warned"] = False
+
+
+def report() -> Dict:
+    with _lock:
+        st = _state
+        if st is None:
+            return {"active": False}
+        return {
+            "active": True,
+            "mode": st["mode"],
+            "retrace_budget": st["retrace_budget"],
+            "transfer_budget": st["transfer_budget"],
+            "retraces": dict(st["retraces"]),
+            "total_retraces": st["total_retraces"],
+            "transfers": st["transfers"],
+            "transfer_bytes": st["transfer_bytes"],
+        }
+
+
+def _complain(st: dict, message: str) -> None:
+    if st["mode"] == "raise":
+        raise LintBudgetExceeded(message)
+    if st["mode"] == "warn":
+        warnings.warn(message, TpuLintWarning, stacklevel=4)
+
+
+def _on_retrace(block, sig) -> None:
+    st = _state
+    if st is None:
+        return
+    key = f"{type(block).__name__}@{id(block):x}"
+    with _lock:
+        count = st["retraces"].get(key, 0) + 1
+        st["retraces"][key] = count
+        st["total_retraces"] += 1
+    st["retrace_counter"].increment()
+    budget = st["retrace_budget"]
+    if budget is not None and count > budget:
+        _complain(
+            st,
+            f"tpulint: {type(block).__name__} has traced {count} distinct "
+            f"signatures (budget {budget}) — retrace storm: unstable input "
+            "shapes/dtypes, or a knob flipping under trace (see "
+            "docs/static_analysis.md, rule A002)")
+
+
+def _on_transfer(arr) -> None:
+    st = _state
+    if st is None:
+        return
+    try:
+        nbytes = int(arr.size) * arr.dtype.itemsize
+    except Exception:  # noqa: BLE001 — abstract/tracer values carry no bytes
+        nbytes = 0
+    with _lock:
+        st["transfers"] += 1
+        st["transfer_bytes"] += nbytes
+        count = st["transfers"]
+        first_over = (st["transfer_budget"] is not None
+                      and count > st["transfer_budget"]
+                      and not st["transfer_warned"])
+        if first_over:
+            st["transfer_warned"] = True
+    st["transfer_counter"].increment()
+    if (st["transfer_budget"] is not None and count > st["transfer_budget"]
+            and (first_over or st["mode"] == "raise")):
+        _complain(
+            st,
+            f"tpulint: {count} device->host transfers "
+            f"({st['transfer_bytes'] / 1e6:.2f} MB) exceed the budget of "
+            f"{st['transfer_budget']} — hidden syncs on the hot path (see "
+            "docs/static_analysis.md, rule A001)")

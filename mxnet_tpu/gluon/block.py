@@ -33,6 +33,11 @@ from .parameter import (Parameter, DeferredInitializationError,
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
+# tpulint runtime sentinel seam (analysis.sentinel): called as
+# (block, sig) on every jit-cache miss in _call_cached. A module-global
+# None-check is the entire cost when the sentinel is off.
+_retrace_observer = None
+
 
 class Block:
     """Base model component (reference block.py:251)."""
@@ -481,6 +486,10 @@ class HybridBlock(Block):
             with self._trace_lock:
                 cg = self._cached_graphs.get(sig)
                 if cg is None:
+                    # observed here, under the lock, so a concurrent first
+                    # call with the same signature counts as ONE retrace
+                    if _retrace_observer is not None:
+                        _retrace_observer(self, sig)
                     cg = self._build_cache(args, flat_vals, in_treedef,
                                            training, plist)
                     self._cached_graphs[sig] = cg

@@ -154,11 +154,16 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     # -- fused XLA update path --------------------------------------------
-    def _build_jit_step(self, idxs):
+    def _fused_update_fn(self, idxs):
+        """The pure fused-update function plus its donation contract
+        ``(fused, donate_argnums)`` — the pre-jit seam
+        ``analysis.lint_trainer`` cross-checks (rule J005): weights (0)
+        and optimizer states (2) are overwritten every step and must be
+        donated; grads (1) are consumed but their buffers back the next
+        backward, so they are not."""
         opt = self._optimizer
         lr_mults = [opt._get_lr(i) / max(opt.learning_rate, 1e-30) for i in idxs]
         wds = [opt._get_wd(i) for i in idxs]
-        rescale = None  # passed as arg
 
         def fused(weights, grads, states, lr, rescale_grad, t):
             new_w, new_s = [], []
@@ -187,7 +192,11 @@ class Trainer:
                     )
             return new_w, new_s
 
-        return jax.jit(fused, donate_argnums=(0, 2))
+        return fused, (0, 2)
+
+    def _build_jit_step(self, idxs):
+        fused, donate = self._fused_update_fn(idxs)
+        return jax.jit(fused, donate_argnums=donate)
 
     def _update(self, ignore_stale_grad=False):
         from ..ndarray.sparse import RowSparseNDArray

@@ -139,8 +139,11 @@ class Monitor:
         queue = sorted(self.queue, key=lambda q: q[1]) if self.sort \
             else self.queue
         for step, name, stat in queue:
+            # exactly one conversion: asnumpy() is already a host array
+            # (no onp.asarray re-wrap), and host-side stats pass through
+            # onp.asarray without a copy
             if isinstance(stat, ndarray):
-                val = onp.asarray(stat.asnumpy())
+                val = stat.asnumpy()
             else:
                 val = onp.asarray(stat)
             res.append((step, name, onp.array2string(val, precision=5)))

@@ -30,6 +30,12 @@ from ..ops.dispatch import apply_op, autograd_state, is_recording
 
 __all__ = ["ndarray", "NDArray", "array", "_wrap", "_unwrap"]
 
+# tpulint runtime sentinel seam (analysis.sentinel): called with the
+# ndarray on every device->host transfer. item()/float()/int()/bool()/
+# __array__ all funnel through asnumpy, so one tap covers every implicit
+# sync; a module-global None-check is the entire cost when off.
+_transfer_observer = None
+
 
 def _unwrap(x: Any):
     if isinstance(x, ndarray):
@@ -175,6 +181,8 @@ class ndarray:
     # ------------------------------------------------------------------
     def asnumpy(self) -> onp.ndarray:
         self.wait_to_read()
+        if _transfer_observer is not None:
+            _transfer_observer(self)
         return onp.asarray(self._data)
 
     def item(self):

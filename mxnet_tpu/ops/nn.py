@@ -774,7 +774,9 @@ def dropout(x, p=0.5, key=None, training=True, axes=None, mode="training"):
             if ax not in axes:
                 shape[ax] = 1
     keep = 1.0 - p
-    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    # a Python-float threshold would make bernoulli draw its uniform in
+    # float64 under jax_enable_x64 (tpulint J002) — pin the draw to f32
+    mask = jax.random.bernoulli(key, jnp.float32(keep), tuple(shape))
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
 

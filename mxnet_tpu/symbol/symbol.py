@@ -482,7 +482,7 @@ class Executor:
         vals = [_unwrap(self.arg_dict[n]) for n in self._arg_names]
         # remember the key: backward's vjp re-run must draw the SAME
         # dropout masks / random values as the forward it differentiates
-        self._last_key = jax.random.PRNGKey(
+        self._last_key = jax.random.PRNGKey(  # tpulint: disable=A001 — host RNG, no device value involved
             int(onp.random.randint(0, 2 ** 31)))
         outs = self._fwd_cache[is_train](vals, self._last_key)
         self._last_train = is_train

@@ -1,0 +1,587 @@
+"""tpulint (`mxnet_tpu.analysis`): one known-bad fixture per rule with a
+clean twin, the runtime sentinel, the Trainer donation cross-check, the
+CLI, and the tier-1 self-lint gate over the framework source."""
+import io
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, autograd, gluon
+from mxnet_tpu.analysis import sentinel
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# jaxpr rules: seeded anti-pattern per rule, zero findings on the clean twin
+# ---------------------------------------------------------------------------
+
+def test_j001_dot_alignment():
+    import jax.numpy as jnp
+
+    bad = analysis.lint_callable(
+        lambda a, b: jnp.dot(a, b),
+        onp.zeros((16, 40), "float32"), onp.zeros((40, 16), "float32"))
+    assert rules_of(bad) == ["J001"]
+    assert "K=40->128" in bad[0].message
+
+    clean = analysis.lint_callable(
+        lambda a, b: jnp.dot(a, b),
+        onp.zeros((16, 128), "float32"), onp.zeros((128, 256), "float32"))
+    assert clean == []
+
+
+def test_j001_conv_channels():
+    import jax.lax as lax
+
+    def conv(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    bad = analysis.lint_callable(
+        conv, onp.zeros((1, 3, 8, 8), "float32"),
+        onp.zeros((48, 3, 3, 3), "float32"))
+    assert rules_of(bad) == ["J001"]
+
+    clean = analysis.lint_callable(
+        conv, onp.zeros((1, 8, 8, 8), "float32"),
+        onp.zeros((128, 8, 3, 3), "float32"))
+    assert clean == []
+
+
+def test_j002_f64_leak():
+    import jax.numpy as jnp
+
+    bad = analysis.lint_callable(
+        lambda x: x.astype(jnp.float64) * 2.0,
+        onp.zeros((8, 128), "float32"), enable_x64=True)
+    assert "J002" in rules_of(bad)
+    assert all(f.severity == "high" for f in bad if f.rule == "J002")
+
+    clean = analysis.lint_callable(
+        lambda x: x * 2.0,
+        onp.zeros((8, 128), "float32"), enable_x64=True)
+    assert clean == []
+
+
+def test_j003_convert_churn():
+    import jax.numpy as jnp
+
+    bad = analysis.lint_callable(
+        lambda x: x.astype(jnp.bfloat16).astype(jnp.float32) + 1.0,
+        onp.zeros((8, 128), "float32"))
+    assert rules_of(bad) == ["J003"]
+
+    clean = analysis.lint_callable(
+        lambda x: x.astype(jnp.bfloat16) + 1.0,
+        onp.zeros((8, 128), "float32"))
+    assert clean == []
+
+
+def test_j004_scalar_reduce_output():
+    import jax.numpy as jnp
+
+    bad = analysis.lint_callable(
+        lambda x: jnp.sum(x), onp.zeros((8, 128), "float32"))
+    assert rules_of(bad) == ["J004"]
+
+    # reduction kept on an axis (or internal scalar) is fine
+    clean = analysis.lint_callable(
+        lambda x: jnp.sum(x, axis=0), onp.zeros((8, 128), "float32"))
+    assert clean == []
+    internal = analysis.lint_callable(
+        lambda x: x / (jnp.sum(x) + 1.0), onp.zeros((8, 128), "float32"))
+    assert internal == []
+
+
+def test_j005_donation_miss():
+    import jax.numpy as jnp
+
+    def update(weights, grads):
+        return [w - 0.1 * g for w, g in zip(weights, grads)]
+
+    w = [jnp.zeros((32, 32)), jnp.zeros((32,))]
+    g = [jnp.zeros((32, 32)), jnp.zeros((32,))]
+    bad = analysis.find_donation_misses(update, (w, g), donate_argnums=())
+    assert rules_of(bad) == ["J005"]
+    assert bad[0].detail == "arg0"
+
+    clean = analysis.find_donation_misses(update, (w, g),
+                                          donate_argnums=(0,))
+    assert clean == []
+
+
+def test_j005_trainer_cross_check():
+    """The live Trainer fused step (trainer.py donate_argnums) donates
+    every update-in-place buffer — weights and optimizer states."""
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.np.array(onp.ones((2, 6), "float32"))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    trainer.step(2)
+    assert analysis.lint_trainer(trainer) == []
+
+    # an undonated twin of the same fused fn DOES flag weights + states
+    idxs = [i for i, p in enumerate(trainer._params)
+            if p.grad_req != "null"]
+    fused, _donate = trainer._fused_update_fn(idxs)
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct
+    weights = [sds(tuple(trainer._params[i].data().shape),
+                   trainer._params[i].data().dtype) for i in idxs]
+    states = [jax.tree_util.tree_map(
+        lambda a: sds(tuple(a.shape), a.dtype), trainer._states[i])
+        for i in idxs]
+    args = (weights, list(weights), states, sds((), jnp.float32),
+            sds((), jnp.float32), sds((), jnp.int32))
+    bad = analysis.find_donation_misses(fused, args, donate_argnums=())
+    # two undonated update-in-place buffers are flagged; weights (arg0)
+    # are unambiguous, grads/states are shape-twins so the second
+    # attribution may land on either
+    assert len(bad) == 2
+    assert "arg0" in {f.detail for f in bad}
+
+
+def test_lint_block_model_zoo_squeezenet():
+    """jaxpr lint over a real zoo model: the squeeze/expand channel
+    counts flag J001 (medium) and nothing high-severity."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model("squeezenet1.0")
+    net.initialize()
+    findings = analysis.lint_block(
+        net, onp.zeros((1, 3, 224, 224), "float32"),
+        scope="zoo:squeezenet1.0")
+    assert findings and rules_of(findings) == ["J001"]
+    assert all(f.severity != "high" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# AST rules
+# ---------------------------------------------------------------------------
+
+BAD_FORWARD_SYNC = """
+class Net:
+    def hybrid_forward(self, F, x):
+        s = float(x.sum())
+        return x * s
+"""
+
+CLEAN_FORWARD = """
+class Net:
+    def hybrid_forward(self, F, x):
+        return x * x.sum()
+"""
+
+
+def test_a001_sync_in_hybrid_forward():
+    bad = analysis.lint_source(BAD_FORWARD_SYNC, "mxnet_tpu/net.py")
+    assert rules_of(bad) == ["A001"]
+    assert bad[0].scope == "Net.hybrid_forward"
+    assert analysis.lint_source(CLEAN_FORWARD, "mxnet_tpu/net.py") == []
+
+
+def test_a001_asnumpy_in_metric_update():
+    src = """
+class M:
+    def update(self, labels, preds):
+        self.total += preds.asnumpy().sum()
+"""
+    bad = analysis.lint_source(src, "m.py")
+    assert rules_of(bad) == ["A001"]
+    clean = """
+class M:
+    def get(self):
+        return self.total.asnumpy()
+"""
+    assert analysis.lint_source(clean, "m.py") == []
+
+
+def test_a001_training_loop_sync():
+    src = """
+def fit(data, net, trainer, autograd):
+    for batch in data:
+        with autograd.record():
+            loss = net(batch)
+        loss.backward()
+        trainer.step(1)
+        print(float(loss.mean()))
+"""
+    bad = analysis.lint_source(src, "train.py")
+    assert rules_of(bad) == ["A001"]
+    clean = """
+def fit(data, net, trainer, autograd):
+    for batch in data:
+        with autograd.record():
+            loss = net(batch)
+        loss.backward()
+        trainer.step(1)
+    return loss
+"""
+    assert analysis.lint_source(clean, "train.py") == []
+
+
+def test_a001_tensor_iteration():
+    src = """
+class Net:
+    def hybrid_forward(self, F, x):
+        out = []
+        for row in x:
+            out.append(row * 2)
+        return out
+"""
+    bad = analysis.lint_source(src, "net.py")
+    assert rules_of(bad) == ["A001"]
+    assert "iterating tensor argument" in bad[0].message
+    # iterating non-tensor state (child blocks) is the normal idiom
+    clean = """
+class Net:
+    def hybrid_forward(self, F, x):
+        for blk in self.features:
+            x = blk(x)
+        return x
+"""
+    assert analysis.lint_source(clean, "net.py") == []
+
+
+def test_a001_metadata_cannot_launder_sync():
+    """`.shape` mixed into a device expression must not exempt the sync;
+    pure shape math stays exempt."""
+    laundered = """
+def fit(data, net, trainer, loss):
+    for batch in data:
+        trainer.step(1)
+        print(float(loss.sum() / batch.shape[0]))
+"""
+    assert rules_of(analysis.lint_source(laundered, "t.py")) == ["A001"]
+    shape_math = """
+import numpy as onp
+
+class Net:
+    def hybrid_forward(self, F, x):
+        n = int(onp.prod(x.shape[1:]))
+        return x.reshape((-1, n))
+"""
+    assert analysis.lint_source(shape_math, "net.py") == []
+
+
+def test_a001_nested_def_in_hot_loop_not_hot():
+    """Defining a function inside a training loop executes nothing per
+    iteration — its body is not hot-loop code."""
+    src = """
+def fit(data, net, trainer):
+    for batch in data:
+        trainer.step(1)
+        def debug_dump():
+            return float(net.weight.sum())
+"""
+    assert analysis.lint_source(src, "t.py") == []
+
+
+def test_a001_inline_suppression():
+    src = """
+class Net:
+    def hybrid_forward(self, F, x):
+        s = float(x.sum())  # tpulint: disable=A001
+        return x * s
+"""
+    assert analysis.lint_source(src, "net.py") == []
+
+
+def test_a002_cache_key_hazard():
+    bad_src = """
+import os
+
+class Net:
+    def forward(self, x):
+        if os.environ.get("MXNET_TPU_FANCY", "0") == "1":
+            return x * 2
+        return x
+"""
+    bad = analysis.lint_source(bad_src, "net.py")
+    assert rules_of(bad) == ["A002"]
+    assert "MXNET_TPU_FANCY" in bad[0].message
+
+    covered = bad_src + """
+
+def fancy_cache_key():
+    return os.environ.get("MXNET_TPU_FANCY", "0")
+"""
+    assert analysis.lint_source(covered, "net.py") == []
+
+
+def test_a002_environ_subscript():
+    src = """
+import os
+
+class Net:
+    def forward(self, x):
+        if os.environ["MXNET_TPU_FANCY"] == "1":
+            return x * 2
+        return x
+"""
+    bad = analysis.lint_source(src, "net.py")
+    assert rules_of(bad) == ["A002"]
+    assert "MXNET_TPU_FANCY" in bad[0].message
+
+
+def test_a002_cross_file_cache_key(tmp_path):
+    """lint_paths unions cache-key knobs across the corpus — the real
+    layout (knob keyed in ops/nn.py, read elsewhere)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "lowering.py").write_text("""
+import os
+
+class Net:
+    def forward(self, x):
+        if os.environ.get("MXNET_TPU_FANCY", "0") == "1":
+            return x * 2
+        return x
+""")
+    bad = analysis.lint_paths([str(pkg)], root=str(tmp_path))
+    assert rules_of(bad) == ["A002"]
+    (pkg / "keys.py").write_text("""
+import os
+
+def fancy_cache_key():
+    return os.environ.get("MXNET_TPU_FANCY", "0")
+""")
+    assert analysis.lint_paths([str(pkg)], root=str(tmp_path)) == []
+
+
+def test_a002_self_framework_is_covered():
+    """The stem-s2d knob is read under trace in ops/nn.py and IS in the
+    discovered cache-key set (the PR-1 bug class stays fixed)."""
+    nn_path = os.path.join(ROOT, "mxnet_tpu", "ops", "nn.py")
+    with open(nn_path) as f:
+        text = f.read()
+    assert "MXNET_TPU_STEM_S2D" in analysis.cache_key_knobs(text)
+    findings = analysis.lint_source(text, "mxnet_tpu/ops/nn.py")
+    assert [f for f in findings if f.rule == "A002"] == []
+
+
+def test_a003_f64_literal():
+    src = 'import numpy as onp\nx = onp.zeros((2, 2), dtype="float64")\n'
+    bad = analysis.lint_source(src, "mxnet_tpu/gluon/foo.py")
+    assert rules_of(bad) == ["A003"]
+    assert bad[0].severity == "low"
+    clean = src.replace("float64", "float32")
+    assert analysis.lint_source(clean, "mxnet_tpu/gluon/foo.py") == []
+
+
+# ---------------------------------------------------------------------------
+# runtime sentinel
+# ---------------------------------------------------------------------------
+
+def test_sentinel_retrace_knob_flip(monkeypatch):
+    """Flipping a knob that IS in the cache key retraces; the sentinel
+    counts the miss and trips the budget."""
+    monkeypatch.delenv("MXNET_TPU_STEM_S2D", raising=False)
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(onp.ones((2, 3), "float32"))
+    sentinel.activate(mode="warn", retrace_budget=1)
+    try:
+        net(x)  # trace 1: within budget
+        monkeypatch.setenv("MXNET_TPU_STEM_S2D", "0")
+        with pytest.warns(sentinel.TpuLintWarning, match="retrace storm"):
+            net(x)  # new cache key -> miss 2 > budget 1
+        rep = sentinel.report()
+        assert rep["total_retraces"] == 2
+        assert max(rep["retraces"].values()) == 2
+        net(x)  # warm hit: count must not move
+        assert sentinel.report()["total_retraces"] == 2
+    finally:
+        sentinel.deactivate()
+    assert sentinel.report() == {"active": False}
+
+
+def test_sentinel_raise_mode():
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(onp.ones((1, 2), "float32"))
+    sentinel.activate(mode="raise", retrace_budget=0)
+    try:
+        with pytest.raises(sentinel.LintBudgetExceeded):
+            net(x)
+    finally:
+        sentinel.deactivate()
+
+
+def test_sentinel_transfer_budget():
+    a = mx.np.array(onp.ones((4,), "float32"))
+    sentinel.activate(mode="warn", transfer_budget=2)
+    try:
+        a.asnumpy()
+        a.asnumpy()
+        with pytest.warns(sentinel.TpuLintWarning, match="transfers"):
+            a.asnumpy()
+        rep = sentinel.report()
+        assert rep["transfers"] == 3
+        assert rep["transfer_bytes"] == 3 * 16
+    finally:
+        sentinel.deactivate()
+
+
+def test_sentinel_env_parsing():
+    assert sentinel._parse_env("warn") == ("warn", 8, None)
+    assert sentinel._parse_env("raise:retrace=2,transfer=100") == \
+        ("raise", 2, 100)
+    assert sentinel._parse_env("count:transfers=5") == ("count", 8, 5)
+    with pytest.warns(UserWarning, match="unknown mode"):
+        mode, _, _ = sentinel._parse_env("explode")
+    assert mode == "warn"
+    with pytest.warns(UserWarning, match="unparseable"):
+        sentinel._parse_env("warn:retrace=lots")
+
+
+# ---------------------------------------------------------------------------
+# CLI + baseline + the tier-1 self-lint gate
+# ---------------------------------------------------------------------------
+
+def test_cli_json_and_baseline_roundtrip(tmp_path):
+    from mxnet_tpu.analysis import cli
+
+    pkg = tmp_path / "gluon"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text(BAD_FORWARD_SYNC)
+
+    buf = io.StringIO()
+    rc = cli.run([str(pkg)], root=str(tmp_path), fmt="json", out=buf)
+    payload = json.loads(buf.getvalue())
+    assert rc == 1 and payload["failed"]
+    assert [f["rule"] for f in payload["new"]] == ["A001"]
+    assert payload["new"][0]["location"].endswith("hot.py:4")
+
+    # bank it, then the same run gates clean; a NEW finding still fails
+    bl = tmp_path / "baseline.json"
+    assert cli.run([str(pkg)], root=str(tmp_path),
+                   write_baseline=str(bl), out=io.StringIO()) == 0
+    assert cli.run([str(pkg)], root=str(tmp_path), baseline_path=str(bl),
+                   out=io.StringIO()) == 0
+    (pkg / "hot2.py").write_text(BAD_FORWARD_SYNC.replace("Net", "Net2"))
+    buf = io.StringIO()
+    assert cli.run([str(pkg)], root=str(tmp_path), baseline_path=str(bl),
+                   out=buf) == 1
+    assert "Net2" in buf.getvalue() or "hot2" in buf.getvalue()
+
+
+def test_cli_fail_on_none(tmp_path):
+    from mxnet_tpu.analysis import cli
+
+    pkg = tmp_path / "gluon"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text(BAD_FORWARD_SYNC)
+    assert cli.run([str(pkg)], root=str(tmp_path), fail_on="none",
+                   out=io.StringIO()) == 0
+
+
+def test_self_lint_gate():
+    """Tier-1 gate: tpulint over mxnet_tpu/ + the model zoo with the
+    banked baseline — new high-severity findings fail this test (and so
+    fail CI). The zoo trace is the expensive half (~25 s on CPU, within
+    the < 60 s acceptance budget); without it the jaxpr rules never run
+    in CI and the banked zoo entries can only go stale."""
+    from mxnet_tpu.analysis import cli
+
+    buf = io.StringIO()
+    rc = cli.run(
+        [os.path.join(ROOT, "mxnet_tpu")], zoo=True,
+        baseline_path=os.path.join(ROOT, "tools", "tpulint_baseline.json"),
+        fail_on="high", fmt="json", out=buf)
+    payload = json.loads(buf.getvalue())
+    assert rc == 0, (
+        "new high-severity tpulint findings:\n"
+        + json.dumps(payload["new"], indent=1)
+        + "\nfix them or re-bank with tools/tpulint.py --zoo "
+          "--write-baseline tools/tpulint_baseline.json")
+    assert payload["stale_baseline_entries"] == 0, (
+        "baseline entries no longer produced — re-bank with "
+        "tools/tpulint.py mxnet_tpu --zoo --write-baseline "
+        "tools/tpulint_baseline.json")
+
+
+def test_baseline_diff_counts():
+    from mxnet_tpu.analysis import baseline as bl
+    from mxnet_tpu.analysis.findings import Finding
+
+    f1 = Finding("A001", "sync", path="a.py", line=3, scope="f",
+                 detail="float:x")
+    f2 = Finding("A001", "sync", path="a.py", line=9, scope="f",
+                 detail="float:x")
+    banked = bl.counts([f1])
+    new, stale = bl.diff([f1, f2], banked)
+    assert len(new) == 1 and stale == 0  # second occurrence is NEW
+    new, stale = bl.diff([], banked)
+    assert new == [] and stale == 1      # fixed finding shows as stale
+
+
+# ---------------------------------------------------------------------------
+# fused metric paths: device and numpy paths agree exactly
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("metric_ctor", [
+    lambda: gluon.metric.Accuracy(),
+    lambda: gluon.metric.TopKAccuracy(top_k=3),
+    lambda: gluon.metric.F1(),
+    lambda: gluon.metric.MCC(),
+])
+def test_fused_metric_equivalence(metric_ctor):
+    onp.random.seed(7)
+    pred = onp.random.uniform(size=(32, 4)).astype("float32")
+    label = onp.random.randint(0, 2, size=(32,)).astype("float32")
+
+    m_host, m_dev = metric_ctor(), metric_ctor()
+    m_host.update(label, pred)                     # numpy path
+    m_dev.update(mx.np.array(label), mx.np.array(pred))  # fused device path
+    name_h, val_h = m_host.get()
+    name_d, val_d = m_dev.get()
+    assert name_h == name_d
+    assert val_d == pytest.approx(val_h, rel=1e-6)
+    assert m_host.num_inst == m_dev.num_inst
+
+
+def test_topk_tie_break_parity():
+    """Tied scores must resolve identically on the host (stable
+    onp.argsort) and device (jnp.argsort) paths."""
+    pred = onp.array([[1., 0., 1., 0., 1., 0., 1., 0.]], dtype="float32")
+    label = onp.array([6.], dtype="float32")
+    m_host = gluon.metric.TopKAccuracy(top_k=3)
+    m_dev = gluon.metric.TopKAccuracy(top_k=3)
+    m_host.update(label, pred)
+    m_dev.update(mx.np.array(label), mx.np.array(pred))
+    assert m_host.get() == m_dev.get()
+
+
+def test_fused_metric_single_transfer_per_update():
+    """The satellite fix: F1.update must do exactly ONE device->host
+    transfer per batch (was 3+), measured by the sentinel."""
+    pred = mx.np.array(onp.random.uniform(size=(16, 2)).astype("float32"))
+    label = mx.np.array(onp.random.randint(0, 2, size=(16,))
+                        .astype("float32"))
+    for metric in (gluon.metric.F1(), gluon.metric.MCC(),
+                   gluon.metric.Accuracy()):
+        metric.update(label, pred)  # warm the jitted reduction
+        sentinel.activate(mode="count")
+        try:
+            metric.update(label, pred)
+            assert sentinel.report()["transfers"] == 1, type(metric).__name__
+        finally:
+            sentinel.deactivate()

@@ -68,6 +68,8 @@ MODULES = {
     "mxnet_tpu.runtime": "build-feature introspection",
     "mxnet_tpu.operator": "python CustomOp",
     "mxnet_tpu.monitor": "Monitor / TensorInspector taps",
+    "mxnet_tpu.analysis": "tpulint — TPU anti-pattern analyzer "
+                          "(jaxpr + AST rules, runtime sentinel)",
 }
 
 

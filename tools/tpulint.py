@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""tpulint — TPU anti-pattern analyzer over jaxprs and framework source.
+
+The CI self-lint gate runs::
+
+    python tools/tpulint.py mxnet_tpu --zoo \
+        --baseline tools/tpulint_baseline.json
+
+Refresh the banked debt ledger after fixing findings::
+
+    python tools/tpulint.py mxnet_tpu --zoo \
+        --write-baseline tools/tpulint_baseline.json
+
+Rule catalog and baseline workflow: ``docs/static_analysis.md``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
