@@ -91,6 +91,14 @@ def _declare(lib: ctypes.CDLL) -> None:
         lib.MXTImagePipelineCreate.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int]
+        if hasattr(lib, "MXTImagePipelineCreateEx"):
+            # absent from .so files that predate sharded ingestion —
+            # single-process pipelines must keep working without it
+            lib.MXTImagePipelineCreateEx.restype = p
+            lib.MXTImagePipelineCreateEx.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int]
         lib.MXTImagePipelineNext.restype = ctypes.c_int
         lib.MXTImagePipelineNext.argtypes = [
             p, u8p, ctypes.POINTER(ctypes.c_float)]

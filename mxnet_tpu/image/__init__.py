@@ -344,3 +344,11 @@ __all__ += ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
             "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
             "ForceResizeAug", "CreateMultiRandCropAugmenter",
             "CreateDetAugmenter", "ImageDetIter"]
+
+# On-device augmentation (random-resized-crop + flip inside the jitted
+# train step — the epoch-cache-compatible replacement for the host-side
+# rand_crop/rand_mirror augmenters).
+from .augment_device import (augment_key, canvas_for,  # noqa: E402,F401
+                             random_resized_crop_flip)
+
+__all__ += ["random_resized_crop_flip", "augment_key", "canvas_for"]

@@ -1,0 +1,152 @@
+"""On-device training augmentation: random-resized-crop + horizontal
+flip INSIDE the jitted train step.
+
+The host-side C++ augmenters (``NativeImagePipeline`` rand_crop /
+rand_mirror) burn decode-thread time and — worse — make the decode
+output non-deterministic, which forbids the epoch cache
+(:mod:`mxnet_tpu.io.cache`). Moving the randomness here keeps the host
+pipeline a pure deterministic decode+resize (cacheable, shardable) and
+fuses the augment into the training XLA program, where a crop+resize is
+one gather the TPU does for free next to the convs (the
+FusionStitching argument, PAPERS.md: fuse memory-bound work into the
+compute graph instead of round-tripping it).
+
+Randomness is **stateless**: every sample's crop/flip is a pure
+function of ``(seed, epoch, batch_index, position-in-batch)`` via
+``jax.random.fold_in`` chains — resuming a run at (epoch 7, batch 1234)
+replays exactly the augmentations the uninterrupted run would have
+drawn, with no RNG state to checkpoint.
+
+Mechanically the crop window is kept in continuous coordinates and the
+crop + bilinear resize + mirror collapse into ONE gather: for output
+pixel ``(y, x)`` the source coordinate is ``y0 + y*(ch-1)/(dh-1)``
+(mirror folds into the x map, the ``lax.rev`` of the coordinate
+vector), so there is no dynamic-shape intermediate for XLA to pad —
+the same trick as the C++ ``resize_window``, now batched on the MXU's
+neighbours. Output is float32 in [0, 255] (exactly one dtype
+conversion from the uint8 input — rule J003 stays quiet).
+"""
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+__all__ = ["random_resized_crop_flip", "augment_key", "canvas_for"]
+
+
+def canvas_for(out_hw: Tuple[int, int], min_area: float = 0.08,
+               align: int = 8) -> Tuple[int, int]:
+    """Decode/cache canvas size such that the SMALLEST random crop
+    (``min_area`` of the frame) still covers the train target at native
+    resolution — cropping a canvas sized to the target and upscaling
+    would train on mush (the same argument as the C++ decode-time
+    ``dec_th``/``dec_tw`` inflation). Rounded up to ``align`` px so the
+    cached rows keep friendly strides."""
+    if not 0.0 < float(min_area) <= 1.0:
+        raise ValueError(f"min_area must be in (0, 1], got {min_area}")
+    s = 1.0 / math.sqrt(float(min_area))
+
+    def up(v):
+        v = int(math.ceil(v * s))
+        return ((v + align - 1) // align) * align
+
+    return up(out_hw[0]), up(out_hw[1])
+
+
+def augment_key(seed: int, epoch, batch_index):
+    """The per-batch key of the stateless stream: fold (epoch,
+    batch_index) into a seed-rooted key. ``epoch``/``batch_index`` may
+    be tracers — safe inside jit."""
+    import jax
+
+    key = jax.random.PRNGKey(seed)
+    key = jax.random.fold_in(key, epoch)
+    return jax.random.fold_in(key, batch_index)
+
+
+def random_resized_crop_flip(batch, key, out_hw: Tuple[int, int],
+                             min_area: float = 0.08,
+                             ratio: Tuple[float, float] = (3.0 / 4.0,
+                                                           4.0 / 3.0),
+                             rand_mirror: bool = True,
+                             attempts: int = 10):
+    """Inception-style random resized crop + horizontal flip for a
+    ``(B, H, W, 3)`` uint8 (or float) batch, returning ``(B, dh, dw, 3)``
+    float32 in [0, 255]. Jit/vmap/grad-safe; sample ``i`` of the batch
+    draws from ``fold_in(key, i)``, so with ``key =
+    augment_key(seed, epoch, batch_idx)`` every pixel is reproducible
+    per (epoch, batch, sample).
+
+    Window selection matches the reference RandomSizedCrop: ``attempts``
+    draws of (area fraction in [min_area, 1], log-uniform aspect in
+    ``ratio``); the first draw that fits the frame wins, none fitting
+    falls back to the full frame — vectorized as a masked ``argmax``
+    instead of a rejection loop (no data-dependent control flow under
+    jit)."""
+    import jax
+    import jax.numpy as jnp
+
+    dh, dw = int(out_hw[0]), int(out_hw[1])
+    if not 0.0 < float(min_area) <= 1.0:
+        raise ValueError(f"min_area must be in (0, 1], got {min_area}")
+    b, h, w = batch.shape[0], batch.shape[1], batch.shape[2]
+    log_lo, log_hi = math.log(ratio[0]), math.log(ratio[1])
+
+    def window(k):
+        """One sample's crop window (y0, x0, ch, cw) in continuous
+        coords, plus its mirror bit."""
+        # dtypes pinned to f32: jax.random defaults follow the global
+        # x64 flag, and an f64 augment would poison the whole step (J002)
+        f32 = jnp.float32
+        k_frac, k_aspect, k_y, k_x, k_mirror = jax.random.split(k, 5)
+        frac = jax.random.uniform(k_frac, (attempts,), f32,
+                                  minval=min_area, maxval=1.0)
+        aspect = jnp.exp(jax.random.uniform(
+            k_aspect, (attempts,), f32, minval=log_lo, maxval=log_hi))
+        area = frac * (h * w)
+        cw_try = jnp.sqrt(area * aspect)
+        ch_try = jnp.sqrt(area / aspect)
+        fits = (cw_try <= w) & (ch_try <= h)
+        # first fitting attempt, else the full frame (reference fallback)
+        idx = jnp.argmax(fits)
+        any_fit = jnp.any(fits)
+        cw = jnp.where(any_fit, cw_try[idx], float(w))
+        ch = jnp.where(any_fit, ch_try[idx], float(h))
+        y0 = jax.random.uniform(k_y, dtype=f32) * (h - ch)
+        x0 = jax.random.uniform(k_x, dtype=f32) * (w - cw)
+        mirror = jax.random.bernoulli(k_mirror) if rand_mirror else False
+        return y0, x0, ch, cw, mirror
+
+    iota_y = jnp.arange(dh, dtype=jnp.float32)
+    iota_x = jnp.arange(dw, dtype=jnp.float32)
+
+    def one(img, k):
+        y0, x0, ch, cw, mirror = window(k)
+        fy = y0 + iota_y * ((ch - 1.0) / max(dh - 1, 1))
+        fx = x0 + iota_x * ((cw - 1.0) / max(dw - 1, 1))
+        if rand_mirror:
+            # the lax.rev of the coordinate map: flipping x coords flips
+            # the output at zero gather cost
+            fx = jnp.where(mirror, fx[::-1], fx)
+        # keep the clipped floor in f32 and derive both the gather
+        # indices and the lerp weights from it — converting the i32
+        # indices back to f32 for the weights is exactly the J003 churn
+        fy_base = jnp.clip(jnp.floor(fy), 0, h - 1)
+        fx_base = jnp.clip(jnp.floor(fx), 0, w - 1)
+        y_lo = fy_base.astype(jnp.int32)
+        x_lo = fx_base.astype(jnp.int32)
+        y_hi = jnp.minimum(y_lo + 1, h - 1)
+        x_hi = jnp.minimum(x_lo + 1, w - 1)
+        wy = (fy - fy_base)[:, None, None]
+        wx = (fx - fx_base)[None, :, None]
+        img_f = img.astype(jnp.float32)
+        v00 = img_f[y_lo[:, None], x_lo[None, :]]
+        v01 = img_f[y_lo[:, None], x_hi[None, :]]
+        v10 = img_f[y_hi[:, None], x_lo[None, :]]
+        v11 = img_f[y_hi[:, None], x_hi[None, :]]
+        top = v00 * (1.0 - wx) + v01 * wx
+        bot = v10 * (1.0 - wx) + v11 * wx
+        return top * (1.0 - wy) + bot * wy
+
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(b))
+    return jax.vmap(one)(batch, keys)

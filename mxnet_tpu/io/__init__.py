@@ -186,7 +186,8 @@ class ImageRecordIter(DataIter):
                  label_width=1, shuffle_chunk=False, round_batch=True,
                  prefetch_capacity=64, dtype="float32",
                  rand_crop=False, rand_mirror=False, min_area=0.08,
-                 seed=0, preprocess_threads=2, use_native=None):
+                 seed=0, preprocess_threads=2, use_native=None,
+                 num_workers=0, path_imgidx=None, cache_dir=None):
         super().__init__(batch_size)
         self.path = path_imgrec
         self.data_shape = tuple(data_shape)
@@ -198,14 +199,33 @@ class ImageRecordIter(DataIter):
                          rand_mirror=bool(rand_mirror),
                          min_area=float(min_area), seed=int(seed))
         self._threads = int(preprocess_threads)
+        self._workers = int(num_workers)
+        self._idx = path_imgidx
+        if cache_dir is None:
+            from .cache import cache_dir_from_env
+            cache_dir = cache_dir_from_env()
+        self._cache_dir = cache_dir
+        if self._cache_dir and (rand_crop or rand_mirror):
+            raise MXNetError(
+                "the epoch cache banks DETERMINISTIC decode output; "
+                "host-side rand_crop/rand_mirror would freeze epoch 1's "
+                "randomness into every epoch — augment on-device instead "
+                "(mxnet_tpu.image.random_resized_crop_flip inside the "
+                "jitted step; see docs/data.md)")
         from .native_pipeline import native_available
         if use_native is None:
-            use_native = rand_crop or rand_mirror
-        elif not use_native and (rand_crop or rand_mirror):
-            raise MXNetError(
-                "rand_crop/rand_mirror run in the native C++ pipeline; "
-                "use_native=False would silently skip the requested "
-                "augmentation")
+            use_native = (rand_crop or rand_mirror or self._workers > 0
+                          or bool(self._cache_dir))
+        elif not use_native:
+            if rand_crop or rand_mirror:
+                raise MXNetError(
+                    "rand_crop/rand_mirror run in the native C++ pipeline; "
+                    "use_native=False would silently skip the requested "
+                    "augmentation")
+            if self._workers > 0 or self._cache_dir:
+                raise MXNetError(
+                    "num_workers/cache_dir require the native engine; "
+                    "use_native=False would silently ignore them")
         if use_native and not native_available():
             raise MXNetError(
                 "ImageRecordIter augmentation/decode runs in the native "
@@ -215,6 +235,35 @@ class ImageRecordIter(DataIter):
         self._reader = None
         self._native = None
         self.reset()
+
+    def _make_decode_pipeline(self, pad_last):
+        """The decode half of the engine: multi-process sharded when
+        num_workers > 0, the in-process C++ pipeline otherwise."""
+        if self._workers > 0:
+            from .sharded import ShardedImagePipeline
+            return ShardedImagePipeline(
+                self.path, self.data_shape, self.batch_size,
+                num_workers=self._workers, n_threads=self._threads,
+                label_width=self.label_width, pad_last=pad_last,
+                path_imgidx=self._idx, **self._aug)
+        from .native_pipeline import NativeImagePipeline
+        return NativeImagePipeline(
+            self.path, self.data_shape, self.batch_size,
+            n_threads=self._threads, label_width=self.label_width,
+            path_imgidx=self._idx, pad_last=pad_last, **self._aug)
+
+    def _make_native(self):
+        # round_batch maps onto the engine's pad_last: the C++ buffer is
+        # already batch-sized, so padding is buffer reuse, not a
+        # concatenate copy per tail batch
+        if not self._cache_dir:
+            return self._make_decode_pipeline(self._round)
+        from .cache import CachedImagePipeline
+        return CachedImagePipeline(
+            lambda: self._make_decode_pipeline(False),
+            self._cache_dir, self.path, self.data_shape,
+            self.batch_size, label_width=self.label_width,
+            pad_last=self._round)
 
     @property
     def provide_data(self):
@@ -229,11 +278,7 @@ class ImageRecordIter(DataIter):
     def reset(self):
         if self._use_native:
             if self._native is None:
-                from .native_pipeline import NativeImagePipeline
-                self._native = NativeImagePipeline(
-                    self.path, self.data_shape, self.batch_size,
-                    n_threads=self._threads, label_width=self.label_width,
-                    **self._aug)
+                self._native = self._make_native()
             else:
                 # REUSE the handle: the C++ pipeline's running sample
                 # index deliberately continues across resets, so each
@@ -267,18 +312,21 @@ class ImageRecordIter(DataIter):
         pad = 0
         if self._native is not None:
             # next_view: the astype below is the ONE copy on this path
-            data_u8, lab_w = self._native.next_view()  # StopIteration=end
+            # (the engine pads tail batches in its own buffer when
+            # round_batch — static shapes with a valid count, no
+            # per-tail concatenate)
+            nv = getattr(self._native, "next_view", None)
+            out = nv() if nv is not None else next(self._native)
+            if len(out) == 3:  # pad_last engines report the valid count
+                data_u8, lab_w, valid = out
+                pad = self.batch_size - valid
+            else:
+                data_u8, lab_w = out
             # uint8 HWC -> dtype CHW in ONE vectorized copy
             # (normalization stays on-device)
             data_np = data_u8.transpose(0, 3, 1, 2).astype(self._dtype)
             # lab_w is a view of the pipeline's reused buffer: copy
             lab = onp.array(lab_w, dtype=onp.float32)
-            n = data_np.shape[0]
-            if n < self.batch_size and self._round:
-                pad = self.batch_size - n
-                data_np = onp.concatenate(
-                    [data_np] + [data_np[-1:]] * pad)
-                lab = onp.concatenate([lab] + [lab[-1:]] * pad)
         else:
             imgs, labels = [], []
             for _ in range(self.batch_size):
@@ -569,6 +617,11 @@ class LibSVMIter(DataIter):
 # iter_image_recordio_2.cc role) — imported last to avoid cycles.
 from .native_pipeline import (DevicePrefetch, NativeImagePipeline,  # noqa: E402,F401
                               decode_jpeg_batch, native_available)
+from .sharded import ShardedImagePipeline, default_num_workers  # noqa: E402,F401
+from .cache import (CachedImagePipeline, cache_dir_from_env,  # noqa: E402,F401
+                    cache_key)
 
 __all__ += ["NativeImagePipeline", "DevicePrefetch", "decode_jpeg_batch",
-            "native_available"]
+            "native_available", "ShardedImagePipeline",
+            "default_num_workers", "CachedImagePipeline",
+            "cache_dir_from_env", "cache_key"]

@@ -1,0 +1,276 @@
+"""Decoded-batch epoch cache (``MXNET_TPU_IO_CACHE``): bank the
+deterministic decode+resize output of epoch 1 into a memmapped slab and
+stream epochs 2+ at memory bandwidth, skipping RecordIO framing,
+libjpeg and the resize entirely.
+
+The trade the cache encodes: JPEG decode costs ~milliseconds/image and
+recompresses every epoch to the *same* pixels (decode+resize is
+deterministic once host-side random augmentation is off); a decoded
+224px canvas row costs ~150KB of disk that the OS page cache serves at
+GB/s. Randomness is not lost — it moves **on-device** into the jitted
+train step (:func:`mxnet_tpu.image.random_resized_crop_flip`), keyed
+statelessly on (epoch, batch, sample), which is why the cache stores a
+slightly larger canvas than the train crop: the on-device random
+resized crop needs headroom to cut from (``canvas_for``).
+
+Cache layout (``<dir>/<key>/``) — ``key`` fingerprints the source file
+(path, size, mtime) and the decode geometry, so a re-packed .rec or a
+different canvas never serves stale pixels:
+
+    data.u8     (N, H, W, 3) uint8 rows, C-order, append-written
+    label.f32   (N, label_width) float32 rows
+    meta.json   row count + geometry + source fingerprint, written
+                atomically LAST — its presence is the commit mark
+                (crash mid-write leaves no meta, next run rebuilds)
+
+Concurrent cold writers (e.g. data-parallel ranks sharing one cache
+root) are safe without locks: each banks into its own
+``data.u8.<pid>.<id>.tmp`` and publishes by ``os.replace``; because the
+key pins (source identity, geometry) and decode is deterministic, every
+writer's slab is bitwise identical, so whichever publish order the
+races produce, the committed files are consistent. A writer that finds
+``meta.json`` already published simply drops its temps and goes warm.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["CachedImagePipeline", "cache_dir_from_env", "cache_key"]
+
+_META = "meta.json"
+_VERSION = 1
+
+
+def cache_dir_from_env() -> Optional[str]:
+    """The opt-in cache root: ``MXNET_TPU_IO_CACHE=dir`` (empty/unset =
+    caching off)."""
+    return os.environ.get("MXNET_TPU_IO_CACHE") or None
+
+
+def cache_key(source_path: str, h: int, w: int, label_width: int) -> str:
+    """Fingerprint of (source file identity, decode geometry)."""
+    st = os.stat(source_path)
+    raw = json.dumps([os.path.abspath(source_path), st.st_size,
+                      st.st_mtime_ns, int(h), int(w), int(label_width),
+                      _VERSION])
+    return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+class CachedImagePipeline:
+    """Wrap an image pipeline factory with the epoch cache.
+
+    ``inner_factory`` must build a pipeline yielding deterministic
+    ``(data uint8 (B,H,W,3), label f32 (B,label_width))`` batches
+    (``pad_last=False``, **no host-side random augmentation** — a cached
+    random crop would freeze epoch 1's randomness into every epoch; use
+    the on-device augment instead). The factory is only invoked when the
+    cache is cold, so a complete cache costs zero decode workers.
+
+    Epoch 1 (cold): batches stream through while their rows are
+    append-written to the slab; the epoch's natural end commits the
+    cache. Epochs 2+ (warm): batches are memmap slices — no decode, no
+    copy, page-cache bandwidth. ``pad_last`` is applied uniformly by the
+    wrapper on both paths.
+    """
+
+    def __init__(self, inner_factory, cache_dir: str, source_path: str,
+                 data_shape: Tuple[int, int, int], batch_size: int,
+                 label_width: int = 1, pad_last: bool = False):
+        if len(data_shape) != 3 or data_shape[0] != 3:
+            raise MXNetError("data_shape must be (3, H, W)")
+        self._factory = inner_factory
+        self.batch_size = int(batch_size)
+        self.h, self.w = int(data_shape[1]), int(data_shape[2])
+        self.label_width = int(label_width)
+        self.pad_last = bool(pad_last)
+        self._source = source_path
+        key = cache_key(source_path, self.h, self.w, self.label_width)
+        self._dir = os.path.join(cache_dir, key)
+        os.makedirs(self._dir, exist_ok=True)
+        self._data_path = os.path.join(self._dir, "data.u8")
+        self._label_path = os.path.join(self._dir, "label.f32")
+        self._meta_path = os.path.join(self._dir, _META)
+        self._inner = None
+        self._write_files = None     # (data_f, label_f) while banking
+        self._rows_written = 0
+        self._n = None               # committed row count
+        self._mm_data = self._mm_label = None
+        self._pos = 0                # warm-path cursor
+        self._closed = False
+        if os.path.exists(self._meta_path):
+            self._open_warm()
+
+    # -- state ---------------------------------------------------------
+
+    @property
+    def complete(self) -> bool:
+        """True once the cache is committed and epochs stream from it."""
+        return self._n is not None
+
+    def _open_warm(self):
+        with open(self._meta_path) as f:
+            meta = json.load(f)
+        n = int(meta["n"])
+        if n == 0:  # never published by _commit; tolerate it anyway
+            self._mm_data = onp.zeros((0, self.h, self.w, 3), onp.uint8)
+            self._mm_label = onp.zeros((0, self.label_width), onp.float32)
+        else:
+            self._mm_data = onp.memmap(self._data_path, onp.uint8, "r",
+                                       shape=(n, self.h, self.w, 3))
+            self._mm_label = onp.memmap(self._label_path, onp.float32,
+                                        "r", shape=(n, self.label_width))
+        self._n = n
+        self._pos = 0
+
+    def _open_cold(self):
+        if self._inner is None:
+            self._inner = self._factory()
+        if self._write_files is None:
+            # a per-writer temp pair: concurrent cold writers sharing
+            # this key dir must never interleave rows into one file
+            self._tmp_suffix = ".%d.%x.tmp" % (os.getpid(), id(self))
+            self._write_files = (
+                open(self._data_path + self._tmp_suffix, "wb"),
+                open(self._label_path + self._tmp_suffix, "wb"))
+            self._rows_written = 0
+
+    def _remove_tmps(self):
+        for p in (self._data_path, self._label_path):
+            try:
+                os.remove(p + self._tmp_suffix)
+            except OSError:
+                pass
+
+    def _commit(self):
+        data_f, label_f = self._write_files
+        for f in (data_f, label_f):
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+        self._write_files = None
+        if self._rows_written == 0:
+            # an empty epoch must not publish a zero-row slab: the
+            # commit mark would poison the key dir (memmap of a
+            # zero-byte file fails) for every later run
+            self._remove_tmps()
+            return
+        if os.path.exists(self._meta_path):
+            # a concurrent writer published first — its slab is bitwise
+            # identical (the key pins source + geometry, decode is
+            # deterministic), so use it and drop ours
+            self._remove_tmps()
+        else:
+            os.replace(self._data_path + self._tmp_suffix,
+                       self._data_path)
+            os.replace(self._label_path + self._tmp_suffix,
+                       self._label_path)
+            st = os.stat(self._source)
+            meta = {"n": self._rows_written, "h": self.h, "w": self.w,
+                    "label_width": self.label_width, "version": _VERSION,
+                    "source": os.path.abspath(self._source),
+                    "source_size": st.st_size,
+                    "source_mtime_ns": st.st_mtime_ns}
+            tmp = self._meta_path + self._tmp_suffix
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, self._meta_path)  # atomic commit mark
+        # the decode engine is done for good: free its workers/threads
+        if self._inner is not None:
+            getattr(self._inner, "close", lambda: None)()
+            self._inner = None
+        self._open_warm()
+
+    def _discard_partial(self):
+        if self._write_files is not None:
+            for f in self._write_files:
+                f.close()
+            self._write_files = None
+            self._remove_tmps()
+        self._rows_written = 0
+
+    # -- iteration -----------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def _pad(self, data, label, valid):
+        if valid == self.batch_size:
+            return data, label, valid
+        pad = self.batch_size - valid
+        data = onp.concatenate([data, onp.repeat(data[-1:], pad, 0)])
+        label = onp.concatenate([label, onp.repeat(label[-1:], pad, 0)])
+        return data, label, valid
+
+    def _emit(self, data, label):
+        if self.pad_last:
+            return self._pad(data, label, data.shape[0])
+        return data, label
+
+    def __next__(self):
+        if self._closed:
+            raise MXNetError("CachedImagePipeline is closed")
+        if self._n is not None:  # warm: stream the slab
+            if self._pos >= self._n:
+                raise StopIteration
+            end = min(self._pos + self.batch_size, self._n)
+            data = self._mm_data[self._pos:end]
+            label = self._mm_label[self._pos:end]
+            self._pos = end
+            return self._emit(data, label)
+        if self._inner is None or self._write_files is None:
+            self._open_cold()
+        try:
+            nv = getattr(self._inner, "next_view", None)
+            data, label = nv() if nv is not None else next(self._inner)
+        except StopIteration:
+            self._commit()
+            raise
+        # bank the rows exactly as decoded (bitwise: epoch 2 streams
+        # what epoch 1 trained on); onp.array makes the ONE copy that
+        # both detaches the batch from the ring slot and backs the
+        # file write — no intermediate bytes object
+        data_c, label_c = onp.array(data), onp.array(label)
+        data_f, label_f = self._write_files
+        data_f.write(data_c)
+        label_f.write(label_c)
+        self._rows_written += data_c.shape[0]
+        return self._emit(data_c, label_c)
+
+    def reset(self):
+        if self._closed:
+            raise MXNetError("CachedImagePipeline is closed")
+        if self._n is not None:
+            self._pos = 0
+            return
+        # an aborted banking epoch is useless — a partial slab must
+        # never masquerade as the dataset
+        self._discard_partial()
+        if self._inner is not None:
+            reset = getattr(self._inner, "reset", None)
+            if reset is not None:
+                reset()
+            else:  # plain-iterator inner: a fresh factory build
+                self._inner = None
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._discard_partial()
+        if self._inner is not None:
+            getattr(self._inner, "close", lambda: None)()
+            self._inner = None
+        self._mm_data = self._mm_label = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -41,15 +41,25 @@ def native_available() -> bool:
 def decode_jpeg_batch(payloads, height: int, width: int,
                       n_threads: int = 1) -> onp.ndarray:
     """Decode a list of JPEG byte strings into (N, H, W, 3) uint8 with
-    the native thread pool. Raises on decode failure; falls back to PIL
-    when the native library is unavailable."""
+    the native thread pool. Raises on decode failure naming EVERY bad
+    index (a data-quality report, not just the first casualty); falls
+    back to PIL when the native library is unavailable."""
     n = len(payloads)
     out = onp.empty((n, height, width, 3), onp.uint8)
     lib = _native_lib()
     if lib is None or not hasattr(lib, "MXTDecodeJpegBatch"):
         from ..image import imdecode, imresize, _to_np
+        bad_py = []
         for i, buf in enumerate(payloads):
-            out[i] = _to_np(imresize(imdecode(buf), width, height))
+            try:
+                out[i] = _to_np(imresize(imdecode(buf), width, height))
+            except Exception:  # noqa: BLE001 — collect, then report all
+                out[i] = 0
+                bad_py.append(i)
+        if bad_py:
+            raise MXNetError(
+                f"JPEG decode failed for {len(bad_py)}/{n} buffers "
+                f"(bad indices {bad_py})")
         return out
     bufs = (ctypes.c_char_p * n)(*payloads)
     lens = (ctypes.c_uint64 * n)(*[len(b) for b in payloads])
@@ -58,9 +68,10 @@ def decode_jpeg_batch(payloads, height: int, width: int,
         bufs, lens, n, height, width, n_threads,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), bad)
     if ok != n:
+        bad_idx = sorted(bad[i] for i in range(n - ok))
         raise MXNetError(
             f"JPEG decode failed for {n - ok}/{n} buffers "
-            f"(first bad index {bad[0]})")
+            f"(bad indices {bad_idx})")
     return out
 
 
@@ -68,13 +79,25 @@ class NativeImagePipeline:
     """Iterator over an image RecordIO file through the C++ pipeline:
     read-ahead + threaded decode + resize, yielding fixed-shape
     ``(data uint8 (B,H,W,3), label f32 (B,label_width))`` numpy pairs.
-    The last partial batch is yielded with its true length (callers that
-    need static shapes drop or pad it)."""
+    The last partial batch is yielded with its true length; with
+    ``pad_last=True`` every yield instead keeps the full static batch
+    shape (tail rows repeat the last valid sample) and becomes a
+    3-tuple ``(data, label, valid)`` so jitted consumers never see a
+    ragged end-of-epoch shape (one trace, zero retraces).
+
+    ``shard_index``/``shard_count`` make this handle read only records
+    whose global index ``i`` has ``i % shard_count == shard_index`` —
+    the per-worker strided view behind :class:`ShardedImagePipeline`.
+    When ``path_imgidx`` names a ``.idx`` sidecar the C++ reader seeks
+    straight between owned records; otherwise it skips foreign payloads
+    header-by-header without reading them."""
 
     def __init__(self, path_imgrec: str, data_shape: Tuple[int, int, int],
                  batch_size: int, n_threads: int = 2, label_width: int = 1,
                  rand_crop: bool = False, rand_mirror: bool = False,
-                 min_area: float = 0.08, seed: int = 0):
+                 min_area: float = 0.08, seed: int = 0,
+                 shard_index: int = 0, shard_count: int = 1,
+                 path_imgidx: Optional[str] = None, pad_last: bool = False):
         if len(data_shape) != 3 or data_shape[0] != 3:
             raise MXNetError("data_shape must be (3, H, W)")
         if not native_available():
@@ -85,9 +108,25 @@ class NativeImagePipeline:
         self.batch_size = batch_size
         self.h, self.w = int(data_shape[1]), int(data_shape[2])
         self.label_width = label_width
-        self._handle = self._lib.MXTImagePipelineCreate(
-            path_imgrec.encode(), self.h, self.w, batch_size,
-            n_threads, label_width)
+        self.pad_last = bool(pad_last)
+        if not 0 <= int(shard_index) < int(shard_count):
+            raise MXNetError(
+                f"shard_index {shard_index} out of range for "
+                f"shard_count {shard_count}")
+        if shard_count > 1 or path_imgidx:
+            if not hasattr(self._lib, "MXTImagePipelineCreateEx"):
+                raise MXNetError(
+                    "this libmxtpu_io.so predates sharded ingestion — "
+                    "rebuild it (cd src && make)")
+            self._handle = self._lib.MXTImagePipelineCreateEx(
+                path_imgrec.encode(),
+                path_imgidx.encode() if path_imgidx else None,
+                self.h, self.w, batch_size, n_threads, label_width,
+                int(shard_index), int(shard_count))
+        else:
+            self._handle = self._lib.MXTImagePipelineCreate(
+                path_imgrec.encode(), self.h, self.w, batch_size,
+                n_threads, label_width)
         if not self._handle:
             raise MXNetError(f"cannot open {path_imgrec}")
         if rand_crop or rand_mirror:
@@ -115,8 +154,39 @@ class NativeImagePipeline:
         return self
 
     def __next__(self):
-        data, label = self.next_view()
+        out = self.next_view()
+        if self.pad_last:
+            data, label, valid = out
+            return data.copy(), label.copy(), valid
+        data, label = out
         return data.copy(), label.copy()
+
+    def next_into(self, data_out: onp.ndarray, label_out: onp.ndarray) -> int:
+        """Decode the next batch DIRECTLY into caller-owned buffers
+        (``data_out`` uint8 ``(B,H,W,3)`` C-contiguous, ``label_out``
+        f32 ``(B,label_width)``) and return the valid sample count
+        (0 = epoch end). This is the zero-copy seam the sharded engine's
+        workers use to decode straight into shared-memory ring slots."""
+        n = self._lib.MXTImagePipelineNext(
+            self._handle,
+            data_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            label_out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n < 0:
+            err = self._lib.MXTImagePipelineError(self._handle)
+            raise MXNetError(f"native pipeline: {err.decode()}")
+        if n:
+            bad = self._lib.MXTImagePipelineBadCount(self._handle)
+            if bad > self._bad_reported:
+                # corrupt JPEGs were zero-filled: loud, never silent
+                # (the reference ImageRecordIter logs and skips; a
+                # training run must know its data went dark)
+                import warnings
+
+                warnings.warn(
+                    f"native pipeline: {bad - self._bad_reported} corrupt "
+                    "JPEG record(s) decoded as zero images", stacklevel=2)
+                self._bad_reported = bad
+        return n
 
     def next_view(self):
         """Like ``__next__`` but returns VIEWS of the internal decode
@@ -124,26 +194,17 @@ class NativeImagePipeline:
         ``reset`` call. For callers that immediately convert (e.g.
         ImageRecordIter's HWC->CHW dtype cast), this skips one
         full-batch copy on the ingestion hot path."""
-        n = self._lib.MXTImagePipelineNext(
-            self._handle,
-            self._data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            self._label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        if n < 0:
-            err = self._lib.MXTImagePipelineError(self._handle)
-            raise MXNetError(f"native pipeline: {err.decode()}")
+        n = self.next_into(self._data, self._label)
         if n == 0:
             raise StopIteration
-        bad = self._lib.MXTImagePipelineBadCount(self._handle)
-        if bad > self._bad_reported:
-            # corrupt JPEGs were zero-filled: loud, never silent (the
-            # reference ImageRecordIter logs and skips; a training run
-            # must know its data went dark)
-            import warnings
-
-            warnings.warn(
-                f"native pipeline: {bad - self._bad_reported} corrupt "
-                "JPEG record(s) decoded as zero images", stacklevel=2)
-            self._bad_reported = bad
+        if self.pad_last:
+            if n < self.batch_size:
+                # repeat the last valid sample: static shapes for jitted
+                # consumers, sane pixel stats for unmasked ones; `valid`
+                # is the mask boundary
+                self._data[n:] = self._data[n - 1]
+                self._label[n:] = self._label[n - 1]
+            return self._data, self._label, n
         return self._data[:n], self._label[:n]
 
     @property
@@ -167,19 +228,50 @@ class NativeImagePipeline:
 
 
 class DevicePrefetch:
-    """Double-buffer host batches onto the device: a daemon thread calls
-    ``jax.device_put`` on the NEXT batch while the caller's train step
-    runs on the current one, hiding host→HBM latency behind compute
-    (the device-boundary half of the reference's PrefetcherIter)."""
+    """Depth-K multi-buffer host→device staging: a daemon thread calls
+    ``jax.device_put`` on up to ``depth`` upcoming batches while the
+    caller's train step runs on the current one, hiding host→HBM latency
+    behind compute (the device-boundary half of the reference's
+    PrefetcherIter). ``device_put`` is dispatch-async, so every batch
+    sitting in the queue is an in-flight transfer — ``depth=2`` is the
+    classic double buffer, deeper rides out decode jitter.
 
-    def __init__(self, host_iter, depth: int = 2, transform=None):
+    ``sharding`` (a ``jax.sharding.Sharding``) places each staged array
+    directly as per-device shards — feed a ``parallel.dist`` data-
+    parallel mesh without a gather-then-scatter hop. Rank-0 leaves are
+    replicated (a ``PartitionSpec`` cannot split a scalar).
+
+    Instrumentation (``.stats``, mirrored into ``mx.profiler`` counters
+    ``io_prefetch_depth`` / ``io_prefetch_starved_ms`` /
+    ``io_prefetch_bytes``): queue depth at each consume, cumulative time
+    the CONSUMER spent waiting on an empty queue (the starved-step
+    attribution io_bench/train_bench report), and bytes staged.
+
+    Feeder failures surface in the consumer typed through the resilience
+    classifier (:class:`~mxnet_tpu.base.TransientError` /
+    :class:`~mxnet_tpu.base.FatalError`, original exception chained as
+    ``__cause__`` with its traceback) — never as a bare hang: a feeder
+    that dies without relaying raises ``FatalError`` instead of
+    deadlocking the training loop."""
+
+    def __init__(self, host_iter, depth: int = 2, transform=None,
+                 sharding=None):
         import jax
 
+        if depth < 1:
+            raise MXNetError(f"DevicePrefetch depth must be >= 1, got {depth}")
         self._jax = jax
         self._src = host_iter
         self._transform = transform
+        self._sharding = sharding
+        self.depth = int(depth)
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._batches = 0
+        self._bytes_staged = 0
+        self._starved_s = 0.0
+        self._done = False
+        self._counters = None  # created lazily; profiler.Counter is cheap
         self._thread = threading.Thread(target=self._feed, daemon=True)
         self._thread.start()
 
@@ -194,6 +286,26 @@ class DevicePrefetch:
                 continue
         return False
 
+    def _stage(self, leaf):
+        if isinstance(leaf, (int, float)):
+            # host-side metadata (e.g. the pad_last valid count) stays a
+            # Python scalar: consumers read it without a device sync
+            return leaf
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            try:
+                nbytes = onp.asarray(leaf).nbytes
+            except Exception:  # noqa: BLE001 — exotic leaf, skip the gauge
+                nbytes = 0
+        self._bytes_staged += int(nbytes)
+        if self._sharding is None:
+            return self._jax.device_put(leaf)
+        if getattr(leaf, "ndim", onp.ndim(leaf)) == 0:
+            # scalars (e.g. the pad_last valid count) cannot take a
+            # batch-dim PartitionSpec: replicate them
+            return self._jax.device_put(leaf)
+        return self._jax.device_put(leaf, self._sharding)
+
     def _feed(self):
         try:
             for item in self._src:
@@ -203,29 +315,96 @@ class DevicePrefetch:
                     item = self._transform(item)
                 # device_put returns immediately; the transfer overlaps
                 # the consumer's compute, which is the whole point
-                item = self._jax.tree_util.tree_map(
-                    self._jax.device_put, item)
+                item = self._jax.tree_util.tree_map(self._stage, item)
                 if not self._put(item):
                     return
             self._put(StopIteration)
         except Exception as e:  # noqa: BLE001 — relay into the consumer
-            self._put(e)
+            self._put(self._typed(e))
+
+    @staticmethod
+    def _typed(e: Exception) -> Exception:
+        """Type a feeder failure through the resilience classifier so
+        retry loops (resilience.Supervisor) can tell a flaky-IO epoch
+        from a programming bug. The original exception rides along as
+        ``__cause__`` — its traceback (the feeder-thread frames) prints
+        in the consumer's error chain."""
+        from ..base import FatalError, TransientError
+        if isinstance(e, (TransientError, FatalError)):
+            return e  # already typed; relay untouched
+        from ..resilience import is_transient
+        cls = TransientError if is_transient(e) else FatalError
+        wrapped = cls(
+            f"DevicePrefetch feeder failed: {type(e).__name__}: {e}")
+        wrapped.__cause__ = e
+        return wrapped
+
+    def _record(self, waited_s: float):
+        self._starved_s += waited_s
+        from .. import profiler
+        if profiler.is_running():
+            if self._counters is None:
+                self._counters = (
+                    profiler.Counter(name="io_prefetch_depth"),
+                    profiler.Counter(name="io_prefetch_starved_ms"),
+                    profiler.Counter(name="io_prefetch_bytes"))
+            self._counters[0].set_value(self._q.qsize())
+            self._counters[1].set_value(round(self._starved_s * 1e3, 3))
+            self._counters[2].set_value(self._bytes_staged)
+
+    @property
+    def stats(self) -> dict:
+        """Live staging gauges: where a starved step actually waits."""
+        return {
+            "batches": self._batches,
+            "depth": self.depth,
+            "queue_depth": self._q.qsize(),
+            "bytes_staged": self._bytes_staged,
+            "starved_s": round(self._starved_s, 6),
+        }
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._q.get()
+        import time
+
+        from ..base import FatalError
+
+        # a legal next() on an exhausted/closed iterator is StopIteration,
+        # not a dead-feeder FatalError
+        if self._done:
+            raise StopIteration
+        t0 = time.perf_counter()
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+                break
+            except queue.Empty:
+                if self._done or self._stop.is_set():
+                    raise StopIteration
+                if not self._thread.is_alive():
+                    self._done = True
+                    raise FatalError(
+                        "DevicePrefetch feeder thread died without "
+                        "relaying an error (killed mid-epoch?)") from None
+        self._record(time.perf_counter() - t0)
         if item is StopIteration:
+            self._done = True
             raise StopIteration
         if isinstance(item, Exception):
+            # the feeder exits after relaying; further next() calls are
+            # exhaustion, not a second fault
+            self._done = True
             raise item
+        self._batches += 1
         return item
 
     def close(self):
         """Stop and JOIN the feeder before the caller frees the source
         (freeing a C++ pipeline handle under a live feeder thread is a
         use-after-free)."""
+        self._done = True
         self._stop.set()
         while self._thread.is_alive():
             try:

@@ -1,0 +1,443 @@
+"""Sharded multi-process ingestion: N worker processes, each running the
+C++ ``NativeImagePipeline`` over a strided record shard, returning
+batches through preallocated ``multiprocessing.shared_memory`` ring
+slabs — decode throughput scales with host cores instead of being
+pinned at one process.
+
+Why processes and not more decode threads: the C++ pool parallelizes
+libjpeg well, but record parsing, buffer assembly and the Python
+consumer all share one GIL'd process; on many-core hosts (a v5e host
+has 112 vCPU) the single process saturates long before the cores do.
+Each worker here owns a shard (records ``i`` with
+``i % num_workers == shard``, the reference's ``kv.num_workers``
+partition contract from ``iter_image_recordio_2.cc``), decodes straight
+into shared-memory ring slots (``NativeImagePipeline.next_into`` — no
+pickling of uint8 batches, no socket copies), and hands the parent a
+slot index over a queue.
+
+Ordering is deterministic: the parent round-robins workers
+(worker 0 batch 0, worker 1 batch 0, …), so the epoch order is a pure
+function of ``(file, num_workers, batch_size)`` and the union of all
+shards equals the sequential pipeline's sample set exactly.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as _queue
+import traceback
+from multiprocessing import shared_memory
+from typing import Optional, Tuple
+
+import numpy as onp
+
+from ..base import FatalError, MXNetError, env_int
+
+__all__ = ["ShardedImagePipeline", "default_num_workers"]
+
+# free-queue tokens: plain ints are ring slot ids; tuples are control
+_ABORT = "abort"   # ("abort", epoch) — parent wants the epoch ended now
+_STOP = "stop"     # ctrl verb; also accepted on the free queue
+
+
+def default_num_workers() -> int:
+    """``MXNET_TPU_IO_WORKERS`` if set, else the host's usable cores
+    (affinity-aware — a cgroup-limited container is not a 112-core
+    host)."""
+    env = env_int("MXNET_TPU_IO_WORKERS", 0)
+    if env > 0:
+        return env
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-Linux
+        return os.cpu_count() or 1
+
+
+def _idx_consistent(path_imgrec: str, path_imgidx: str) -> bool:
+    """Cheap staleness check before trusting a ``.idx`` sidecar against
+    its ``.rec``: offsets are written in increasing file order, so it is
+    enough that the LAST offset lands on a record magic inside the
+    current file. A sidecar left over from a re-packed .rec fails either
+    the bounds or the magic test instead of silently seeking workers to
+    wrong (or past-EOF) offsets."""
+    import struct
+
+    from ..recordio import _MAGIC
+    try:
+        with open(path_imgidx, "rb") as f:
+            tail = f.read()[-256:]
+        lines = [ln for ln in tail.splitlines() if b"\t" in ln]
+        if not lines:
+            return False
+        last_off = int(lines[-1].split(b"\t")[1])
+        if last_off < 0 or last_off + 8 > os.path.getsize(path_imgrec):
+            return False
+        with open(path_imgrec, "rb") as f:
+            f.seek(last_off)
+            return struct.unpack("<I", f.read(4))[0] == _MAGIC
+    except (OSError, ValueError, struct.error):
+        return False
+
+
+def _slot_views(buf, ring_depth: int, batch: int, h: int, w: int,
+                label_width: int):
+    """Carve the shared slab into per-slot (data, label) numpy views."""
+    data_bytes = batch * h * w * 3
+    label_bytes = batch * label_width * 4
+    slot_bytes = data_bytes + label_bytes
+    data_views, label_views = [], []
+    for s in range(ring_depth):
+        off = s * slot_bytes
+        data_views.append(onp.ndarray(
+            (batch, h, w, 3), onp.uint8, buffer=buf, offset=off))
+        label_views.append(onp.ndarray(
+            (batch, label_width), onp.float32, buffer=buf,
+            offset=off + data_bytes))
+    return data_views, label_views, slot_bytes
+
+
+def _worker_main(cfg: dict):
+    """Child entry: attach the shared slab, open this shard's C++
+    pipeline, and decode batches into whatever ring slot the parent
+    hands back on the free queue. Runs until ctrl says stop. Only
+    touches numpy + the ctypes pipeline — never jax (no device runtime
+    in decode workers)."""
+    ready = cfg["ready_q"]
+    try:
+        from .native_pipeline import NativeImagePipeline
+
+        # the PARENT owns the segment's lifetime — the child must only
+        # attach, never enroll it with its own resource tracker (which
+        # would unlink the slab when the child exits). Pre-3.13 attach
+        # never registers; 3.13+ needs track=False to say so.
+        try:
+            shm = shared_memory.SharedMemory(name=cfg["shm_name"],
+                                             track=False)
+        except TypeError:
+            shm = shared_memory.SharedMemory(name=cfg["shm_name"])
+        try:
+            data_views, label_views, _ = _slot_views(
+                shm.buf, cfg["ring_depth"], cfg["batch_size"], cfg["h"],
+                cfg["w"], cfg["label_width"])
+            pipe = NativeImagePipeline(
+                cfg["path"], (3, cfg["h"], cfg["w"]), cfg["batch_size"],
+                n_threads=cfg["n_threads"], label_width=cfg["label_width"],
+                rand_crop=cfg["rand_crop"], rand_mirror=cfg["rand_mirror"],
+                min_area=cfg["min_area"],
+                # decorrelate worker augment streams while staying
+                # deterministic per (seed, num_workers)
+                seed=cfg["seed"] + cfg["shard_index"],
+                shard_index=cfg["shard_index"],
+                shard_count=cfg["shard_count"],
+                path_imgidx=cfg["path_imgidx"])
+            try:
+                ctrl, free_q = cfg["ctrl_q"], cfg["free_q"]
+                epoch = 0
+                while True:
+                    cmd = ctrl.get()
+                    if cmd == _STOP:
+                        return
+                    new_epoch = cmd[1]  # ("epoch", e)
+                    if epoch:
+                        pipe.reset()
+                    epoch = new_epoch
+                    while True:
+                        tok = free_q.get()
+                        if tok == _STOP:
+                            return
+                        if isinstance(tok, tuple):  # ("abort", e)
+                            if tok[1] == epoch:
+                                ready.put(("end", epoch))
+                                break
+                            continue  # stale abort from a drained epoch
+                        n = pipe.next_into(data_views[tok],
+                                           label_views[tok])
+                        if n == 0:
+                            free_q.put(tok)  # took a slot, didn't use it
+                            ready.put(("end", epoch))
+                            break
+                        ready.put(("batch", tok, n, epoch))
+            finally:
+                pipe.close()
+        finally:
+            shm.close()
+    except Exception:  # noqa: BLE001 — relay the full child traceback
+        try:
+            ready.put(("error", traceback.format_exc()))
+        except Exception:  # noqa: BLE001 — parent gone; nothing to do
+            pass
+
+
+class ShardedImagePipeline:
+    """Multi-process strided-shard decode engine with the single-process
+    :class:`NativeImagePipeline` interface: iterate to get
+    ``(data uint8 (B,H,W,3), label f32 (B,label_width))`` batches (plus
+    a valid count with ``pad_last=True``), ``reset()`` per epoch,
+    ``close()`` when done.
+
+    Worker ``w`` of ``num_workers`` decodes records
+    ``w, w+N, w+2N, ...`` (seek-based when ``path_imgidx`` is given,
+    header-skip otherwise) into its own ring of ``ring_depth``
+    shared-memory slots; the parent hands out slots and round-robins
+    the ready batches, so iteration order is deterministic and the
+    shard union is exactly the sequential record set. Each worker
+    tails off its own shard, so an epoch has up to ``num_workers``
+    short/padded batches (``sum_w ceil(shard_w / B)`` total) where the
+    sequential pipeline has one.
+
+    ``start_method`` defaults to ``spawn`` (fork duplicates the parent's
+    jax/XLA threads into the child — a known deadlock source); set
+    ``MXNET_TPU_IO_START_METHOD=fork`` to trade that risk for faster
+    worker startup on hosts that never touch a device runtime.
+    """
+
+    def __init__(self, path_imgrec: str, data_shape: Tuple[int, int, int],
+                 batch_size: int, num_workers: Optional[int] = None,
+                 n_threads: int = 1, label_width: int = 1,
+                 ring_depth: int = 3, pad_last: bool = False,
+                 path_imgidx: Optional[str] = None,
+                 rand_crop: bool = False, rand_mirror: bool = False,
+                 min_area: float = 0.08, seed: int = 0,
+                 start_method: Optional[str] = None):
+        if len(data_shape) != 3 or data_shape[0] != 3:
+            raise MXNetError("data_shape must be (3, H, W)")
+        if not os.path.exists(path_imgrec):
+            raise MXNetError(f"cannot open {path_imgrec}")
+        if ring_depth < 2:
+            raise MXNetError(
+                f"ring_depth must be >= 2 (one slot decoding while one "
+                f"is consumed), got {ring_depth}")
+        self.batch_size = int(batch_size)
+        self.h, self.w = int(data_shape[1]), int(data_shape[2])
+        self.label_width = int(label_width)
+        self.pad_last = bool(pad_last)
+        self.num_workers = int(num_workers if num_workers is not None
+                               else default_num_workers())
+        if self.num_workers < 1:
+            raise MXNetError(f"num_workers must be >= 1, got {num_workers}")
+        if path_imgidx is None:
+            # use the .idx sidecar automatically when it already exists
+            # AND still matches the .rec — a stale sidecar from a
+            # re-packed file must not seek workers to wrong offsets
+            cand = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(cand):
+                if _idx_consistent(path_imgrec, cand):
+                    path_imgidx = cand
+                else:
+                    import warnings
+                    warnings.warn(
+                        f"ignoring stale index {cand}: its offsets do not "
+                        f"match {path_imgrec} (re-packed .rec? regenerate "
+                        f"with tools/rec2idx.py) — falling back to "
+                        f"stride-skip sharding", stacklevel=2)
+        elif not _idx_consistent(path_imgrec, path_imgidx):
+            raise MXNetError(
+                f"index {path_imgidx} is inconsistent with {path_imgrec} "
+                f"(offsets out of bounds or not on a record boundary) — "
+                f"regenerate it with tools/rec2idx.py")
+        self._ring_depth = int(ring_depth)
+        method = (start_method
+                  or os.environ.get("MXNET_TPU_IO_START_METHOD") or "spawn")
+        ctx = mp.get_context(method)
+        self._epoch = 1
+        self._workers, self._shms = [], []
+        self._free_qs, self._ready_qs, self._ctrl_qs = [], [], []
+        self._data_views, self._label_views = [], []
+        self._closed = False
+        try:
+            for wid in range(self.num_workers):
+                data_bytes = self.batch_size * self.h * self.w * 3
+                label_bytes = self.batch_size * self.label_width * 4
+                shm = shared_memory.SharedMemory(
+                    create=True,
+                    size=self._ring_depth * (data_bytes + label_bytes))
+                self._shms.append(shm)
+                dv, lv, _ = _slot_views(shm.buf, self._ring_depth,
+                                        self.batch_size, self.h, self.w,
+                                        self.label_width)
+                self._data_views.append(dv)
+                self._label_views.append(lv)
+                free_q, ready_q, ctrl_q = ctx.Queue(), ctx.Queue(), ctx.Queue()
+                for s in range(self._ring_depth):
+                    free_q.put(s)
+                ctrl_q.put(("epoch", self._epoch))
+                cfg = dict(
+                    path=path_imgrec, path_imgidx=path_imgidx,
+                    h=self.h, w=self.w, batch_size=self.batch_size,
+                    n_threads=int(n_threads), label_width=self.label_width,
+                    rand_crop=bool(rand_crop),
+                    rand_mirror=bool(rand_mirror),
+                    min_area=float(min_area), seed=int(seed),
+                    shard_index=wid, shard_count=self.num_workers,
+                    shm_name=shm.name, ring_depth=self._ring_depth,
+                    free_q=free_q, ready_q=ready_q, ctrl_q=ctrl_q)
+                proc = ctx.Process(target=_worker_main, args=(cfg,),
+                                   daemon=True)
+                proc.start()
+                self._workers.append(proc)
+                self._free_qs.append(free_q)
+                self._ready_qs.append(ready_q)
+                self._ctrl_qs.append(ctrl_q)
+        except Exception:
+            self.close()
+            raise
+        self._done = set()      # workers whose shard ended this epoch
+        self._rr = 0            # round-robin pointer
+        self._held = None       # (worker, slot) handed to the consumer
+
+    # -- iteration -----------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def _release_held(self):
+        if self._held is not None:
+            wid, slot = self._held
+            self._free_qs[wid].put(slot)
+            self._held = None
+
+    def _get_msg(self, wid: int):
+        """Blocking ready-queue read that notices a dead worker instead
+        of hanging the training loop forever."""
+        while True:
+            try:
+                return self._ready_qs[wid].get(timeout=1.0)
+            except _queue.Empty:
+                proc = self._workers[wid]
+                if not proc.is_alive():
+                    raise FatalError(
+                        f"sharded ingestion worker {wid} died "
+                        f"(exitcode {proc.exitcode}) without relaying an "
+                        "error — see stderr for the child traceback")
+
+    def next_view(self):
+        """Next batch as VIEWS of the worker's shared-memory slot —
+        valid only until the following ``next_view``/``__next__``/
+        ``reset``/``close`` call (the slot is recycled then)."""
+        self._release_held()
+        while True:
+            if len(self._done) == self.num_workers:
+                raise StopIteration
+            wid = self._rr % self.num_workers
+            self._rr += 1
+            if wid in self._done:
+                continue
+            msg = self._get_msg(wid)
+            kind = msg[0]
+            if kind == "end":
+                if msg[1] == self._epoch:
+                    self._done.add(wid)
+                else:
+                    self._rr -= 1  # stale: this worker still owes a batch
+                continue
+            if kind == "error":
+                self.close()
+                raise MXNetError(
+                    f"sharded ingestion worker {wid} failed:\n{msg[1]}")
+            _, slot, n, epoch = msg
+            if epoch != self._epoch:  # stale batch: recycle its slot
+                self._free_qs[wid].put(slot)
+                self._rr -= 1  # this worker still owes a current batch
+                continue
+            self._held = (wid, slot)
+            data, label = self._data_views[wid][slot], \
+                self._label_views[wid][slot]
+            if self.pad_last:
+                if n < self.batch_size:
+                    data[n:] = data[n - 1]
+                    label[n:] = label[n - 1]
+                return data, label, n
+            return data[:n], label[:n]
+
+    def __next__(self):
+        out = self.next_view()
+        if self.pad_last:
+            data, label, valid = out
+            return data.copy(), label.copy(), valid
+        data, label = out
+        return data.copy(), label.copy()
+
+    # -- epoch / lifecycle ---------------------------------------------
+
+    def reset(self):
+        """Start the next epoch. Safe mid-epoch: still-running workers
+        are aborted and their in-flight batches drained (slots return to
+        the ring) before the new epoch is announced."""
+        if self._closed:
+            raise MXNetError("ShardedImagePipeline is closed")
+        self._release_held()
+        pending = [w for w in range(self.num_workers)
+                   if w not in self._done]
+        for wid in pending:
+            self._free_qs[wid].put((_ABORT, self._epoch))
+        for wid in pending:
+            while True:  # drain until this epoch's end marker
+                msg = self._get_msg(wid)
+                if msg[0] == "batch":
+                    if msg[3] == self._epoch:
+                        self._free_qs[wid].put(msg[1])
+                elif msg[0] == "end":
+                    if msg[1] == self._epoch:
+                        break
+                elif msg[0] == "error":
+                    self.close()
+                    raise MXNetError(
+                        f"sharded ingestion worker {wid} failed:\n{msg[1]}")
+        self._epoch += 1
+        self._done = set()
+        self._rr = 0
+        for ctrl in self._ctrl_qs:
+            ctrl.put(("epoch", self._epoch))
+
+    def close(self):
+        """Stop workers, join them, release the shared slabs. Idempotent;
+        also runs from ``__del__`` so leaked pipelines do not leak
+        /dev/shm segments."""
+        if self._closed:
+            return
+        self._closed = True
+        for q in getattr(self, "_ctrl_qs", []):
+            try:
+                q.put(_STOP)
+            except Exception:  # noqa: BLE001
+                pass
+        for q in getattr(self, "_free_qs", []):
+            try:
+                q.put(_STOP)  # a worker blocked waiting for a slot
+            except Exception:  # noqa: BLE001
+                pass
+        for proc in getattr(self, "_workers", []):
+            proc.join(timeout=5.0)
+            if proc.is_alive():  # pragma: no cover - wedged child
+                proc.terminate()
+                proc.join(timeout=1.0)
+        for q in (getattr(self, "_free_qs", [])
+                  + getattr(self, "_ready_qs", [])
+                  + getattr(self, "_ctrl_qs", [])):
+            try:
+                q.close()
+                q.cancel_join_thread()
+            except Exception:  # noqa: BLE001
+                pass
+        # drop the numpy views BEFORE closing their backing buffers
+        self._data_views, self._label_views = [], []
+        self._held = None
+        for shm in getattr(self, "_shms", []):
+            # unlink FIRST and independently: a caller still holding a
+            # next_view() result makes mmap.close() raise BufferError,
+            # which must not leave the segment named in /dev/shm
+            try:
+                shm.unlink()
+            except Exception:  # noqa: BLE001 — already unlinked
+                pass
+            try:
+                shm.close()
+            except BufferError:  # exported view alive; freed with it
+                pass
+        self._shms = []
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

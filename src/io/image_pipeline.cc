@@ -21,6 +21,7 @@
 #include <condition_variable>
 #include <csetjmp>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <mutex>
@@ -294,6 +295,18 @@ struct ImagePipeline {
   bool augment = false;
   uint64_t next_sample_idx = 0;  // only touched under the decode call
 
+  // sharding (ShardedImagePipeline workers): this pipeline owns records
+  // whose global index i satisfies i % shard_count == shard_index. With
+  // a .idx sidecar the owned byte offsets are loaded up front and the
+  // reader SEEKS record to record (others' payloads are never read);
+  // without one it walks the stream but fseek()s over foreign payloads
+  // (header-only skip — no memcpy, no decode).
+  int shard_index = 0, shard_count = 1;
+  uint64_t rec_index = 0;      // global record counter (stride mode)
+  std::vector<long> offsets;   // owned record offsets (idx mode)
+  size_t offset_pos = 0;
+  bool use_idx = false;
+
   // read-ahead: one pending raw batch produced by the reader thread
   std::vector<RawRec> ready;
   bool ready_valid = false;
@@ -348,6 +361,55 @@ struct ImagePipeline {
     return true;
   }
 
+  // advance past one full record (all multi-part continuations) without
+  // copying its payload — the stride-mode shard skip. Mirrors
+  // read_record's framing exactly, minus the buffer.
+  bool skip_record() {
+    bool more = true, first = true;
+    while (more) {
+      uint32_t magic = 0, lrec = 0;
+      if (fread(&magic, 4, 1, f) != 1) {
+        if (!first) error = "truncated multi-part record";
+        return false;
+      }
+      if (magic != kMagic) {
+        error = "bad magic";
+        return false;
+      }
+      if (fread(&lrec, 4, 1, f) != 1) {
+        error = "truncated record header";
+        return false;
+      }
+      const uint32_t cflag = lrec >> 29;
+      const uint32_t len = lrec & ((1u << 29) - 1);
+      const size_t pad = (4 - (len & 3)) & 3;
+      if (len + pad) fseek(f, static_cast<long>(len + pad), SEEK_CUR);
+      more = (cflag == 1 || cflag == 2);
+      first = false;
+    }
+    return true;
+  }
+
+  // load the .idx sidecar ("key\toffset" lines, tools/rec2idx.py),
+  // keeping only this shard's offsets
+  bool load_index(const char* idx_path) {
+    FILE* fi = fopen(idx_path, "r");
+    if (!fi) return false;
+    char line[256];
+    uint64_t i = 0;
+    while (fgets(line, sizeof line, fi)) {
+      const char* tab = strchr(line, '\t');
+      if (!tab) continue;
+      if (i % static_cast<uint64_t>(shard_count)
+          == static_cast<uint64_t>(shard_index)) {
+        offsets.push_back(atol(tab + 1));
+      }
+      ++i;
+    }
+    fclose(fi);
+    return true;
+  }
+
   bool parse(const std::vector<uint8_t>& rec, RawRec* out) {
     // IRHeader wire layout (recordio.py _IR_FORMAT "<IfQQ"): flag f32
     // label u64 id u64 id2; flag>0 => flag floats follow the header
@@ -374,6 +436,22 @@ struct ImagePipeline {
     dst->clear();
     std::vector<uint8_t> rec;
     while (static_cast<int>(dst->size()) < batch && !eof) {
+      if (use_idx) {
+        if (offset_pos >= offsets.size()) {
+          eof = true;
+          break;
+        }
+        fseek(f, offsets[offset_pos++], SEEK_SET);
+      } else if (shard_count > 1) {
+        const bool mine =
+            rec_index % static_cast<uint64_t>(shard_count)
+            == static_cast<uint64_t>(shard_index);
+        ++rec_index;
+        if (!mine) {
+          if (!skip_record()) eof = true;
+          continue;
+        }
+      }
       if (!read_record(&rec)) {
         eof = true;
         break;
@@ -400,8 +478,18 @@ struct ImagePipeline {
   }
 };
 
-void* MXTImagePipelineCreate(const char* path, int th, int tw, int batch,
-                             int n_threads, int label_width) {
+// Sharded create (ShardedImagePipeline workers): this handle reads only
+// records whose global index i has i % shard_count == shard_index. When
+// idx_path names a readable .idx sidecar the owned offsets are loaded
+// and the reader seeks record to record; otherwise it strides the
+// stream, fseek()ing over foreign payloads.
+void* MXTImagePipelineCreateEx(const char* path, const char* idx_path,
+                               int th, int tw, int batch, int n_threads,
+                               int label_width, int shard_index,
+                               int shard_count) {
+  if (shard_count < 1 || shard_index < 0 || shard_index >= shard_count) {
+    return nullptr;
+  }
   auto* p = new ImagePipeline();
   p->path = path;
   p->th = th;
@@ -409,10 +497,17 @@ void* MXTImagePipelineCreate(const char* path, int th, int tw, int batch,
   p->batch = batch;
   p->n_threads = n_threads > 0 ? n_threads : 1;
   p->label_width = label_width > 0 ? label_width : 1;
+  p->shard_index = shard_index;
+  p->shard_count = shard_count;
   p->f = fopen(path, "rb");
   if (!p->f) {
     delete p;
     return nullptr;
+  }
+  // honored for ANY shard_count: with one shard the index holds every
+  // offset and the reader still seeks record to record as documented
+  if (idx_path != nullptr && idx_path[0] != '\0') {
+    p->use_idx = p->load_index(idx_path);
   }
   p->reader = std::thread([p] { p->reader_loop(); });
   {
@@ -421,6 +516,12 @@ void* MXTImagePipelineCreate(const char* path, int th, int tw, int batch,
   }
   p->cv.notify_all();
   return p;
+}
+
+void* MXTImagePipelineCreate(const char* path, int th, int tw, int batch,
+                             int n_threads, int label_width) {
+  return MXTImagePipelineCreateEx(path, nullptr, th, tw, batch, n_threads,
+                                  label_width, 0, 1);
 }
 
 // Fill data[batch, th, tw, 3] uint8 + labels[batch, label_width] f32.
@@ -487,6 +588,8 @@ void MXTImagePipelineReset(void* handle) {
   p->cv.wait(lk, [&] { return p->ready_valid; });
   fseek(p->f, 0, SEEK_SET);
   p->eof = false;
+  p->rec_index = 0;
+  p->offset_pos = 0;
   p->ready.clear();
   p->ready_valid = false;
   p->want = true;

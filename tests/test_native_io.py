@@ -4,6 +4,7 @@ by the Python writer and vice versa."""
 import ctypes
 import os
 import struct
+import sys
 
 import numpy as onp
 import pytest
@@ -587,6 +588,21 @@ class TestNativeImagePipeline:
         assert (data == 0).all()  # zero-filled, and loudly so
         pipe.close()
 
+    def test_decode_jpeg_batch_reports_all_bad_indices(self, jpeg_rec):
+        """A corrupt BATCH names every bad index — a data-quality
+        report, not just the first casualty."""
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.io import decode_jpeg_batch, native_available
+
+        if not native_available():
+            pytest.skip("native lib unavailable")
+        r = recordio.MXRecordIO(jpeg_rec, "r")
+        _, good = recordio.unpack(r.read())
+        r.close()
+        payloads = [good, b"not a jpeg", good, b"also bad", good]
+        with pytest.raises(MXNetError, match=r"2/5 buffers.*\[1, 3\]"):
+            decode_jpeg_batch(payloads, 16, 16)
+
     def test_device_prefetch_close_midstream_joins_feeder(self, jpeg_rec):
         from mxnet_tpu.io import (DevicePrefetch, NativeImagePipeline,
                                   native_available)
@@ -599,3 +615,546 @@ class TestNativeImagePipeline:
         dp.close()
         assert not dp._thread.is_alive()  # joined: freeing pipe is safe
         pipe.close()
+
+
+# -- sharded ingestion engine ---------------------------------------------
+
+def _write_jpeg_rec(path, n, hw=(40, 56), seed=0, label_width=1):
+    rng = onp.random.RandomState(seed)
+    w = recordio.MXRecordIO(str(path), "w")
+    for i in range(n):
+        im = rng.randint(0, 255, hw + (3,)).astype(onp.uint8)
+        lab = float(i) if label_width == 1 else \
+            onp.arange(i, i + label_width, dtype=onp.float32)
+        w.write(recordio.pack_img(recordio.IRHeader(0, lab, i, 0), im,
+                                  quality=95))
+    w.close()
+    return str(path)
+
+
+def _needs_native():
+    from mxnet_tpu.io import native_available
+
+    if not native_available():
+        pytest.skip("native lib unavailable")
+
+
+class TestShardedEngine:
+    """Sharded multi-process decode (mxnet_tpu/io/sharded.py + the C++
+    shard seam): the union of all shards must equal the sequential
+    pipeline exactly, deterministically."""
+
+    @pytest.fixture()
+    def rec23(self, tmp_path):
+        return _write_jpeg_rec(tmp_path / "r23.rec", 23)
+
+    def test_shard_stride_union_equals_sequential(self, rec23):
+        """In-process shard handles (the C++ seam itself): every record
+        lands in exactly one shard, pixels identical to sequential
+        decode, in both stride-skip and idx-seek modes."""
+        from mxnet_tpu.io import NativeImagePipeline
+
+        _needs_native()
+        seq = NativeImagePipeline(rec23, (3, 16, 16), 4)
+        seq_rows = {}
+        for d, lab in seq:
+            for i in range(d.shape[0]):
+                seq_rows[lab[i, 0]] = d[i].copy()
+        seq.close()
+        assert len(seq_rows) == 23
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        from rec2idx import create_index
+
+        idx = rec23 + ".idx"
+        assert create_index(rec23, idx) == 23
+        for kw in ({}, {"path_imgidx": idx}):
+            got = {}
+            for s in range(3):
+                pipe = NativeImagePipeline(rec23, (3, 16, 16), 4,
+                                           shard_index=s, shard_count=3,
+                                           **kw)
+                labs = []
+                for d, lab in pipe:
+                    for i in range(d.shape[0]):
+                        labs.append(lab[i, 0])
+                        got[lab[i, 0]] = d[i].copy()
+                pipe.close()
+                # shard s owns records s, s+3, s+6, ... in order
+                assert labs == [float(x) for x in range(s, 23, 3)], kw
+            assert sorted(got) == sorted(seq_rows)
+            for k, row in got.items():
+                onp.testing.assert_array_equal(row, seq_rows[k])
+
+    def test_multiprocess_determinism_and_reset(self, rec23):
+        """The full engine: spawn workers + shared-memory ring. Epoch 2
+        (reset) replays epoch 1 bit-for-bit, and the union matches the
+        sequential pipeline's pixels."""
+        from mxnet_tpu.io import NativeImagePipeline, ShardedImagePipeline
+
+        _needs_native()
+        seq = NativeImagePipeline(rec23, (3, 16, 16), 4)
+        seq_rows = {}
+        for d, lab in seq:
+            for i in range(d.shape[0]):
+                seq_rows[lab[i, 0]] = d[i].copy()
+        seq.close()
+
+        sp = ShardedImagePipeline(rec23, (3, 16, 16), 4, num_workers=2,
+                                  ring_depth=2)
+        try:
+            e1 = [(d.copy(), lab.copy()) for d, lab in sp]
+            sp.reset()
+            e2 = [(d.copy(), lab.copy()) for d, lab in sp]
+            assert len(e1) == len(e2)
+            for (d1, l1), (d2, l2) in zip(e1, e2):
+                onp.testing.assert_array_equal(d1, d2)
+                onp.testing.assert_array_equal(l1, l2)
+            got = {}
+            for d, lab in e1:
+                for i in range(d.shape[0]):
+                    got[lab[i, 0]] = d[i]
+            assert sorted(got) == sorted(seq_rows)
+            for k, row in got.items():
+                onp.testing.assert_array_equal(row, seq_rows[k])
+            # mid-epoch reset: abort + drain, then a full clean epoch
+            sp.reset()
+            next(sp)
+            sp.reset()
+            labs3 = sorted(x for _, lab in sp for x in lab[:, 0].tolist())
+            assert labs3 == sorted(float(i) for i in range(23))
+        finally:
+            sp.close()
+
+    def test_multiprocess_pad_last_static_shapes(self, rec23):
+        """pad_last through the engine: every batch keeps the full
+        static shape; valid counts sum to the record count; close() with
+        workers mid-ring joins cleanly (no leaked /dev/shm slabs)."""
+        from mxnet_tpu.io import ShardedImagePipeline
+
+        _needs_native()
+        sp = ShardedImagePipeline(rec23, (3, 16, 16), 4, num_workers=2,
+                                  pad_last=True)
+        shapes, valids = set(), []
+        for d, lab, v in sp:
+            shapes.add(d.shape)
+            valids.append(v)
+        assert shapes == {(4, 16, 16, 3)}
+        assert sum(valids) == 23
+        sp.close()
+        sp2 = ShardedImagePipeline(rec23, (3, 16, 16), 4, num_workers=2,
+                                   ring_depth=2)
+        next(sp2)  # workers now racing to fill the ring
+        sp2.close()  # must not hang or leak
+        assert all(not p.is_alive() for p in sp2._workers)
+
+    def test_stale_idx_sidecar_is_rejected(self, rec23, tmp_path):
+        """A .idx left over from a re-packed .rec must never seek
+        workers to wrong offsets: auto-adoption warns and falls back to
+        stride-skip (epoch still complete), an EXPLICIT stale index
+        raises instead of silently serving garbage."""
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.io import ShardedImagePipeline
+        from mxnet_tpu.io.sharded import _idx_consistent
+
+        _needs_native()
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        from rec2idx import create_index
+
+        idx = os.path.splitext(rec23)[0] + ".idx"
+        assert create_index(rec23, idx) == 23
+        assert _idx_consistent(rec23, idx)
+        # re-pack the .rec in place with fewer, larger records: the old
+        # offsets now point past EOF / mid-record
+        _write_jpeg_rec(rec23, 5, hw=(8, 8))
+        assert not _idx_consistent(rec23, idx)
+        with pytest.warns(UserWarning, match="stale index"):
+            sp = ShardedImagePipeline(rec23, (3, 16, 16), 4,
+                                      num_workers=2)
+        assert sum(d.shape[0] for d, _ in sp) == 5  # fell back, complete
+        sp.close()
+        with pytest.raises(MXNetError, match="inconsistent"):
+            ShardedImagePipeline(rec23, (3, 16, 16), 4, num_workers=2,
+                                 path_imgidx=idx)
+        # a regenerated index is adopted again
+        assert create_index(rec23, idx) == 5
+        sp = ShardedImagePipeline(rec23, (3, 16, 16), 4, num_workers=2)
+        assert sum(d.shape[0] for d, _ in sp) == 5
+        sp.close()
+
+    def test_image_record_iter_num_workers(self, rec23):
+        """ImageRecordIter(num_workers=N) routes through the sharded
+        engine and keeps the DataBatch contract (pad on shard tails)."""
+        from mxnet_tpu import io as mio
+
+        _needs_native()
+        it = mio.ImageRecordIter(rec23, batch_size=4,
+                                 data_shape=(3, 16, 16), num_workers=2)
+        seen = []
+        for b in it:
+            assert b.data[0].shape == (4, 3, 16, 16)
+            n = 4 - b.pad
+            seen.extend(b.label[0].asnumpy()[:n].tolist())
+        assert sorted(seen) == [float(i) for i in range(23)]
+        it.reset()
+        assert sum(4 - b.pad for b in it) == 23
+        it.close()
+
+
+class TestEpochCache:
+    """Decoded-batch epoch cache (mxnet_tpu/io/cache.py)."""
+
+    @pytest.fixture()
+    def rec11(self, tmp_path):
+        return _write_jpeg_rec(tmp_path / "r11.rec", 11, seed=3)
+
+    def test_bitwise_equivalence_to_live_decode(self, rec11, tmp_path):
+        from mxnet_tpu.io import CachedImagePipeline, NativeImagePipeline
+
+        _needs_native()
+        cdir = str(tmp_path / "cache")
+        cp = CachedImagePipeline(
+            lambda: NativeImagePipeline(rec11, (3, 24, 24), 4),
+            cdir, rec11, (3, 24, 24), 4)
+        live = [(d.copy(), lab.copy()) for d, lab in cp]  # banks epoch 1
+        assert cp.complete
+        cp.reset()
+        cached = [(d.copy(), lab.copy()) for d, lab in cp]
+        assert len(live) == len(cached) == 3
+        for (d1, l1), (d2, l2) in zip(live, cached):
+            onp.testing.assert_array_equal(d1, d2)  # bitwise, not close
+            onp.testing.assert_array_equal(l1, l2)
+        cp.close()
+
+    def test_warm_start_skips_decode_entirely(self, rec11, tmp_path):
+        from mxnet_tpu.io import CachedImagePipeline, NativeImagePipeline
+
+        _needs_native()
+        cdir = str(tmp_path / "cache")
+        cp = CachedImagePipeline(
+            lambda: NativeImagePipeline(rec11, (3, 16, 16), 4),
+            cdir, rec11, (3, 16, 16), 4)
+        for _ in cp:
+            pass
+        cp.close()
+
+        def boom():
+            raise AssertionError("decode factory called on a warm cache")
+
+        warm = CachedImagePipeline(boom, cdir, rec11, (3, 16, 16), 4,
+                                   pad_last=True)
+        assert warm.complete
+        shapes, valids = set(), []
+        for d, lab, v in warm:
+            shapes.add(d.shape)
+            valids.append(v)
+        assert shapes == {(4, 16, 16, 3)}
+        assert valids == [4, 4, 3]
+        warm.close()
+
+    def test_partial_epoch_never_commits(self, rec11, tmp_path):
+        from mxnet_tpu.io import CachedImagePipeline, NativeImagePipeline
+
+        _needs_native()
+        cdir = str(tmp_path / "cache")
+        cp = CachedImagePipeline(
+            lambda: NativeImagePipeline(rec11, (3, 16, 16), 4),
+            cdir, rec11, (3, 16, 16), 4)
+        next(cp)
+        assert not cp.complete
+        cp.reset()  # partial slab discarded, decode restarts
+        assert sum(d.shape[0] for d, _ in cp) == 11
+        assert cp.complete
+        cp.close()
+
+    def test_source_change_invalidates_key(self, rec11, tmp_path):
+        import time as _time
+
+        from mxnet_tpu.io import CachedImagePipeline, NativeImagePipeline
+
+        _needs_native()
+        cdir = str(tmp_path / "cache")
+        cp = CachedImagePipeline(
+            lambda: NativeImagePipeline(rec11, (3, 16, 16), 4),
+            cdir, rec11, (3, 16, 16), 4)
+        for _ in cp:
+            pass
+        cp.close()
+        _time.sleep(0.01)
+        _write_jpeg_rec(rec11, 5, seed=9)  # re-pack: new size/mtime
+        cp2 = CachedImagePipeline(
+            lambda: NativeImagePipeline(rec11, (3, 16, 16), 4),
+            cdir, rec11, (3, 16, 16), 4)
+        assert not cp2.complete  # stale pixels must never be served
+        assert sum(d.shape[0] for d, _ in cp2) == 5
+        cp2.close()
+
+    def test_concurrent_cold_writers_do_not_corrupt(self, rec11,
+                                                    tmp_path):
+        """Two cold writers over one key dir (data-parallel ranks
+        sharing MXNET_TPU_IO_CACHE): each banks into its own temp pair;
+        the loser of the publish race drops its temps and goes warm on
+        the winner's slab — never interleaved rows."""
+        from mxnet_tpu.io import CachedImagePipeline, NativeImagePipeline
+
+        _needs_native()
+        cdir = str(tmp_path / "cache")
+
+        def make():
+            return CachedImagePipeline(
+                lambda: NativeImagePipeline(rec11, (3, 16, 16), 4),
+                cdir, rec11, (3, 16, 16), 4)
+
+        a, b = make(), make()
+        assert not a.complete and not b.complete
+        next(a)  # both banking into DISTINCT temp files concurrently
+        next(b)
+        rows_a = [(d.copy(), lab.copy()) for d, lab in a]  # a commits
+        assert a.complete and not b.complete
+        rows_b = [(d.copy(), lab.copy()) for d, lab in b]  # b yields
+        assert b.complete                                  # to a's slab
+        b.reset()
+        rows_b2 = [(d.copy(), lab.copy()) for d, lab in b]
+        assert len(rows_b2) == len(rows_a) + 1 == len(rows_b) + 1 == 3
+        a.reset()
+        for (d1, _), (d2, _) in zip(list(a), rows_b2):
+            onp.testing.assert_array_equal(d1, d2)
+        a.close()
+        b.close()
+        # no stray temps left behind
+        leftovers = [f for f in os.listdir(os.path.dirname(a._data_path))
+                     if f.endswith(".tmp")]
+        assert leftovers == []
+
+    def test_empty_epoch_never_commits(self, tmp_path):
+        """An inner pipeline that yields nothing must not publish a
+        zero-row commit mark that poisons the key dir for later runs."""
+        from mxnet_tpu.io import CachedImagePipeline
+
+        empty = tmp_path / "empty.rec"
+        empty.write_bytes(b"")
+        cdir = str(tmp_path / "cache")
+        cp = CachedImagePipeline(lambda: iter([]), cdir, str(empty),
+                                 (3, 16, 16), 4)
+        with pytest.raises(StopIteration):
+            next(cp)
+        assert not cp.complete
+        cp.close()
+        cp2 = CachedImagePipeline(lambda: iter([]), cdir, str(empty),
+                                  (3, 16, 16), 4)  # must not crash warm
+        assert not cp2.complete
+        cp2.close()
+
+    def test_image_record_iter_cache_refuses_host_augment(self, rec11,
+                                                          tmp_path):
+        from mxnet_tpu import io as mio
+
+        _needs_native()
+        with pytest.raises(mx.MXNetError, match="on-device"):
+            mio.ImageRecordIter(rec11, batch_size=4,
+                                data_shape=(3, 16, 16),
+                                cache_dir=str(tmp_path / "c"),
+                                rand_crop=True)
+
+
+class TestDeviceAugment:
+    """On-device random-resized-crop + flip (image/augment_device.py):
+    the epoch-cache-compatible randomness."""
+
+    def test_deterministic_per_epoch_batch_sample(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mxnet_tpu.image import augment_key, random_resized_crop_flip
+
+        rng = onp.random.RandomState(0)
+        batch = rng.randint(0, 255, (6, 48, 64, 3)).astype(onp.uint8)
+        fn = jax.jit(lambda x, e, b: random_resized_crop_flip(
+            x, augment_key(7, e, b), (24, 24)))
+        a = onp.asarray(fn(batch, 1, 0))
+        assert a.shape == (6, 24, 24, 3)
+        assert fn(batch, 1, 0).dtype == jnp.float32  # no x64 leak (J002)
+        onp.testing.assert_array_equal(a, onp.asarray(fn(batch, 1, 0)))
+        assert not onp.array_equal(a, onp.asarray(fn(batch, 2, 0)))
+        assert not onp.array_equal(a, onp.asarray(fn(batch, 1, 1)))
+        # sample key = fold_in(batch key, position): a shorter batch
+        # with the same leading rows draws the same augmentations
+        onp.testing.assert_array_equal(
+            a[:3], onp.asarray(fn(batch[:3], 1, 0)))
+        assert a.min() >= 0.0 and a.max() <= 255.0
+
+    def test_full_frame_degenerates_to_plain_resize(self):
+        from mxnet_tpu.image import augment_key, random_resized_crop_flip
+
+        rng = onp.random.RandomState(1)
+        batch = rng.randint(0, 255, (2, 32, 32, 3)).astype(onp.uint8)
+        out = onp.asarray(random_resized_crop_flip(
+            batch, augment_key(0, 0, 0), (32, 32), min_area=1.0,
+            rand_mirror=False))
+        # min_area=1 + identity size => the gather is the identity map
+        onp.testing.assert_allclose(out, batch.astype(onp.float32),
+                                    atol=1e-3)
+
+    def test_canvas_for_headroom(self):
+        from mxnet_tpu.image import canvas_for
+
+        h, w = canvas_for((224, 224), min_area=0.25, align=8)
+        # smallest crop (25% area) of the canvas must still be >= 224px
+        assert h >= 448 and w >= 448 and h % 8 == 0
+        with pytest.raises(ValueError):
+            canvas_for((224, 224), min_area=0.0)
+
+
+class TestDevicePrefetchDepthK:
+    """Depth-K staging, instrumentation and typed feeder errors."""
+
+    def test_depth_k_preserves_order_and_counts(self):
+        from mxnet_tpu.io import DevicePrefetch
+
+        batches = [(onp.full((2, 4), i, onp.float32),
+                    onp.full((2,), i, onp.float32)) for i in range(12)]
+        dp = DevicePrefetch(iter(batches), depth=4)
+        got = [int(d[0, 0]) for d, _ in dp]
+        assert got == list(range(12))  # deeper queue, same order
+        st = dp.stats
+        assert st["batches"] == 12
+        assert st["depth"] == 4
+        assert st["bytes_staged"] == sum(
+            d.nbytes + lab.nbytes for d, lab in batches)
+        assert st["starved_s"] >= 0.0
+        dp.close()
+
+    def test_feeder_error_is_typed_with_original_traceback(self):
+        from mxnet_tpu.base import FatalError, TransientError
+        from mxnet_tpu.io import DevicePrefetch
+
+        def bad_iter(exc):
+            yield onp.zeros((1,)), onp.zeros((1,))
+            raise exc
+
+        dp = DevicePrefetch(bad_iter(ValueError("shape went sideways")))
+        next(dp)
+        with pytest.raises(FatalError) as ei:  # bugs must not be retried
+            next(dp)
+        assert isinstance(ei.value.__cause__, ValueError)
+        # the chained cause still carries the feeder-thread frames
+        assert ei.value.__cause__.__traceback__ is not None
+        dp.close()
+
+        dp = DevicePrefetch(bad_iter(ConnectionError("gcs flaked")))
+        next(dp)
+        with pytest.raises(TransientError):  # retry loops may re-attempt
+            next(dp)
+        dp.close()
+
+    def test_dead_feeder_surfaces_instead_of_hanging(self, monkeypatch):
+        from mxnet_tpu.base import FatalError
+        from mxnet_tpu.io import DevicePrefetch
+
+        dp = DevicePrefetch(iter([]), depth=1)
+        dp._thread.join()
+        dp._q.get()  # swallow the StopIteration sentinel
+        monkeypatch.setattr(
+            type(dp._q), "get",
+            lambda self, timeout=None: (_ for _ in ()).throw(
+                __import__("queue").Empty))
+        with pytest.raises(FatalError, match="died"):
+            next(dp)
+
+    def test_exhausted_or_closed_iterator_raises_stop_iteration(self):
+        """A legal next() after exhaustion or close() is StopIteration —
+        never a spurious dead-feeder FatalError (which Supervisor would
+        treat as non-retryable)."""
+        from mxnet_tpu.io import DevicePrefetch
+
+        dp = DevicePrefetch(iter([(onp.zeros((1,)), onp.zeros((1,)))]))
+        assert len(list(dp)) == 1
+        dp._thread.join()  # feeder long gone; protocol must still hold
+        with pytest.raises(StopIteration):
+            next(dp)
+        with pytest.raises(StopIteration):
+            next(dp)
+        dp.close()
+
+        dp = DevicePrefetch(iter([(onp.zeros((1,)), onp.zeros((1,)))]))
+        dp.close()
+        with pytest.raises(StopIteration):
+            next(dp)
+
+        # a relayed feeder error raises ONCE; afterwards the iterator
+        # is exhausted, not a second (misleading) fault
+        from mxnet_tpu.base import FatalError
+
+        def bad():
+            yield onp.zeros((1,)), onp.zeros((1,))
+            raise ValueError("boom")
+
+        dp = DevicePrefetch(bad())
+        next(dp)
+        with pytest.raises(FatalError):
+            next(dp)
+        dp._thread.join()
+        with pytest.raises(StopIteration):
+            next(dp)
+        dp.close()
+
+    def test_sharding_places_per_device_shards(self):
+        import jax
+
+        from mxnet_tpu.io import DevicePrefetch
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("single-device backend")
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(onp.array(devs[:2]), ("data",))
+        sharding = NamedSharding(mesh, PartitionSpec("data"))
+        batches = [(onp.zeros((4, 3), onp.float32),
+                    onp.zeros((4,), onp.float32), 4)]
+        dp = DevicePrefetch(iter(batches), sharding=sharding)
+        d, lab, v = next(dp)
+        assert len(d.sharding.device_set) == 2
+        assert len(lab.sharding.device_set) == 2
+        # host metadata (the valid count) passes through un-staged:
+        # reading it must never cost a device sync
+        assert v == 4 and isinstance(v, int)
+        dp.close()
+
+
+def test_pad_last_kills_end_of_epoch_retrace(tmp_path):
+    """The satellite acceptance: a jitted consumer over an epoch with a
+    ragged tail retraces once for the short batch; pad_last restores
+    one-trace epochs. Verified with the tpulint runtime sentinel
+    (MXNET_TPU_LINT=count:retrace=... semantics via activate())."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.analysis import sentinel
+    from mxnet_tpu.io import NativeImagePipeline, native_available
+
+    if not native_available():
+        pytest.skip("native lib unavailable")
+    rec = _write_jpeg_rec(tmp_path / "pad.rec", 10)
+
+    def run_epoch(pad_last):
+        net = gluon.nn.Dense(3)
+        net.initialize()
+        net.hybridize()
+        pipe = NativeImagePipeline(rec, (3, 8, 8), 4, pad_last=pad_last)
+        sentinel.activate(mode="count")
+        try:
+            for batch in pipe:
+                data = batch[0]
+                x = mx.np.array(
+                    data.reshape(data.shape[0], -1).astype(onp.float32))
+                net(x)
+            return sentinel.report()["total_retraces"]
+        finally:
+            sentinel.deactivate()
+            pipe.close()
+
+    assert run_epoch(pad_last=False) == 2  # full-batch trace + tail trace
+    assert run_epoch(pad_last=True) == 1   # static shapes: one trace

@@ -271,9 +271,12 @@ def test_llm_bench_tiny(tmp_path):
     assert rec.get("decode_tok_s", 0) > 0
 
 
-def test_io_bench_tiny(tmp_path):
-    """io_bench end-to-end on a tiny config: schema contract for the
-    committed input-pipeline results."""
+def test_io_bench_quick(tmp_path):
+    """io_bench --quick end-to-end: the smoke mode exercises EVERY
+    stage of the ingestion engine (sharded multi-process decode, epoch
+    cache, depth-K device prefetch with attribution counters) on tiny
+    synthetic data — the schema contract for the committed
+    input-pipeline results."""
     import json
     import subprocess
     import sys
@@ -282,16 +285,26 @@ def test_io_bench_tiny(tmp_path):
     env = dict(os.environ, PYTHONPATH=ROOT)
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "benchmark", "io_bench.py"),
-         "--records", "100", "--payload", "8192", "--jpegs", "24",
-         "--workers", "2", "--output", out_file],
+         "--quick", "--output", out_file],
         env=env, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr[-2000:]
     rec = json.loads(open(out_file).read())
+    assert rec["quick"] is True
     assert rec["recordio"]["python_rec_s"] > 0
     assert rec["recordio"].get("native_rec_s", 1) > 0
     assert rec["prefetcher"].get("prefetched_rec_s", 1) > 0
     assert rec["dataloader"]["loader0_sps"] > 0
     assert rec["cpus"] >= 1
+    if "skipped" not in rec["sharded_pipeline"]:
+        assert rec["sharded_pipeline"]["workers1_img_s"] > 0
+        assert rec["sharded_pipeline"]["workers2_img_s"] > 0
+        # epoch-cache streaming must beat live decode even in smoke
+        assert rec["epoch_cache"]["cached_vs_live"] > 1.0
+        # the starved-time attribution counters are part of the schema
+        dp = rec["device_prefetch"]
+        assert dp["bytes_staged"] > 0
+        assert dp["starved_s"] >= 0.0
+        assert "queue_depth_at_end" in dp
 
 
 def test_daemon_merge_model_table_keeps_banked_rows(tmp_path):
