@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""AOT warm-start bench: cold-compile vs store-warmed process startup.
+
+Measures the thing `mxnet_tpu.aot` exists to kill — cold-compile cost at
+process start — **across real process boundaries** (in-process jit
+caches cannot help a subprocess):
+
+1. **nocache** child: no store armed — today's baseline. Serving engine
+   warmup over the bucket ladder + a fresh ``gluon.Trainer`` first step.
+2. **cold** child: fresh empty store armed (``MXNET_TPU_AOT_CACHE``).
+   Same work; every executable is a miss that gets published, and the
+   serving engine saves its :class:`~mxnet_tpu.aot.WarmupManifest`.
+   The delta vs *nocache* is the honest publish overhead.
+3. **warmup tool** child: ``tools/aot_warmup.py --manifest`` replays the
+   manifest against the store with no model in sight (the deploy-time
+   cache bake).
+4. **warm** child: fresh process, same store. Engine warms **from the
+   manifest** and the Trainer ``prewarm()``s + steps. The acceptance
+   gate: ``aot_misses == 0`` — zero cold compiles for warmed keys.
+
+One JSON row on stdout; ``--output`` writes it to a file; non-``--quick``
+runs bank ``benchmark/results_aot_<backend>.json``. ``--quick`` is the
+tier-1 smoke (``tests/test_perf_harnesses.py::test_aot_bench_quick``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+
+def log(*a):
+    print("[aot_bench]", *a, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# the child measurement (one fresh process per phase)
+# ---------------------------------------------------------------------------
+def child_measure(phase: str, manifest_path: str, hidden: int,
+                  features: int, max_batch: int, layers: int) -> Dict:
+    """Serving warmup + fresh-Trainer first step, timed. Runs in a
+    subprocess whose env decides whether a store is armed."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import aot, autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serving import InferenceEngine
+    import jax
+
+    def build_net():
+        net = nn.HybridSequential()
+        for _ in range(layers):
+            net.add(nn.Dense(hidden, activation="relu"))
+        net.add(nn.Dense(8))
+        net.initialize()
+        return net
+
+    # -- serving: engine warmup over the frontier -------------------------
+    eng = InferenceEngine(
+        build_net(), example_input=onp.zeros((1, features), "float32"),
+        max_batch_size=max_batch, max_delay_ms=1.0)
+    try:
+        t0 = time.perf_counter()
+        if phase == "warm" and os.path.exists(manifest_path):
+            warmed = eng.warmup(manifest=manifest_path)
+        else:
+            warmed = eng.warmup((features,))
+        serve_warmup_ms = (time.perf_counter() - t0) * 1e3
+        # one real request through a warmed bucket (no novel shapes)
+        eng.infer(onp.zeros((1, features), "float32"))
+        if phase == "cold":
+            eng.save_warmup_manifest(manifest_path)
+        compiles = eng.stats()["counters"].get("compiles", 0)
+    finally:
+        eng.close()
+
+    # -- training: fresh Trainer, prewarm (warm phase) + first step -------
+    net = build_net()
+    x = mx.np.array(onp.ones((4, features), "float32"))
+    net(x)  # materialize params
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    t0 = time.perf_counter()
+    prewarmed = False
+    if phase == "warm":
+        # the Supervisor-resume path: states must exist to prewarm
+        trainer._init_states()
+        prewarmed = trainer.prewarm()
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    trainer.step(batch_size=4)
+    trainer_first_step_ms = (time.perf_counter() - t0) * 1e3
+
+    return {
+        "phase": phase,
+        "serve_warmup_ms": round(serve_warmup_ms, 1),
+        "trainer_first_step_ms": round(trainer_first_step_ms, 1),
+        "start_ms": round(serve_warmup_ms + trainer_first_step_ms, 1),
+        "warmed_buckets": warmed,
+        "engine_compiles": compiles,
+        "trainer_prewarmed": bool(prewarmed),
+        "aot": aot.stats(),
+        "device": jax.default_backend(),
+        "loss": float(loss),
+    }
+
+
+def run_child(phase: str, cache_dir: Optional[str], manifest_path: str,
+              hidden: int, features: int, max_batch: int, layers: int,
+              timeout: float) -> Dict:
+    env = _scrubbed_env()
+    if cache_dir:
+        env["MXNET_TPU_AOT_CACHE"] = cache_dir
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", phase,
+           "--manifest-path", manifest_path, "--hidden", str(hidden),
+           "--features", str(features), "--max-batch", str(max_batch),
+           "--layers", str(layers)]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout, cwd=ROOT)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"aot_bench child {phase!r} failed "
+            f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}")
+    # last stdout line is the JSON row (jax may chat above it)
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _scrubbed_env() -> Dict[str, str]:
+    """Child env with the knobs that would corrupt the measurement
+    removed: an ambient MXNET_TPU_AOT=ro/off would stop the cold child
+    publishing (a bogus ~1.0x row with a failed acceptance gate), and an
+    ambient chaos campaign would inject faults into every phase."""
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    for k in ("MXNET_TPU_AOT_CACHE", "MXNET_TPU_AOT", "MXNET_TPU_CHAOS"):
+        env.pop(k, None)
+    return env
+
+
+def run_warmup_tool(cache_dir: str, manifest_path: str,
+                    timeout: float) -> Dict:
+    env = _scrubbed_env()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "aot_warmup.py"),
+         "--cache", cache_dir, "--manifest", manifest_path],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=ROOT)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"aot_warmup failed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-2000:]}")
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    row.pop("results", None)  # per-key detail is child-log noise here
+    return row
+
+
+def _code_rev() -> str:
+    try:
+        from bench import code_rev
+
+        return code_rev()
+    except Exception:  # noqa: BLE001
+        try:
+            return subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=ROOT,
+                capture_output=True, text=True, timeout=10
+            ).stdout.strip() or "?"
+        except Exception:  # noqa: BLE001
+            return "?"
+
+
+def run_bench(quick: bool = False, hidden: int = 512, features: int = 64,
+              max_batch: int = 8, layers: int = 24,
+              child_timeout: float = 900.0) -> Dict:
+    if quick:
+        hidden, features, max_batch, layers = 32, 16, 4, 3
+    with tempfile.TemporaryDirectory(prefix="mxtpu_aot_bench_") as tmp:
+        cache_dir = os.path.join(tmp, "cache")
+        manifest = os.path.join(tmp, "serving_manifest.json")
+        log("phase nocache (baseline, no store)")
+        nocache = run_child("nocache", None, manifest, hidden, features,
+                            max_batch, layers, child_timeout)
+        log(f"  start {nocache['start_ms']} ms")
+        log("phase cold (fresh store, publish)")
+        cold = run_child("cold", cache_dir, manifest, hidden, features,
+                         max_batch, layers, child_timeout)
+        log(f"  start {cold['start_ms']} ms, "
+            f"misses {cold['aot']['aot_misses']}")
+        log("phase warmup-tool (manifest replay, no model)")
+        tool = run_warmup_tool(cache_dir, manifest, child_timeout)
+        log(f"  warmed {tool['entries_warmed']} entries "
+            f"in {tool['total_ms']} ms")
+        log("phase warm (fresh process, warmed store)")
+        warm = run_child("warm", cache_dir, manifest, hidden, features,
+                         max_batch, layers, child_timeout)
+        log(f"  start {warm['start_ms']} ms, "
+            f"hits {warm['aot']['aot_hits']}, "
+            f"misses {warm['aot']['aot_misses']}")
+
+    cold_ms = cold["start_ms"]
+    warm_ms = warm["start_ms"]
+    row = {
+        "metric": "aot_warm_start",
+        "value": round(cold_ms / warm_ms, 2) if warm_ms else 0.0,
+        "unit": "x",
+        "quick": bool(quick),
+        "cold_start_ms": cold_ms,
+        "warm_start_ms": warm_ms,
+        "nocache_start_ms": nocache["start_ms"],
+        "publish_overhead_vs_nocache": round(
+            cold_ms / nocache["start_ms"], 2) if nocache["start_ms"]
+            else 0.0,
+        "warm_misses": warm["aot"]["aot_misses"],
+        "warm_hits": warm["aot"]["aot_hits"],
+        "warm_trainer_prewarmed": warm["trainer_prewarmed"],
+        "aot_bytes": cold["aot"]["aot_bytes"],
+        "aot_cold_ms_saved": warm["aot"]["aot_cold_ms_saved"],
+        "model": {"hidden": hidden, "features": features,
+                  "max_batch": max_batch, "layers": layers},
+        "phases": {"nocache": nocache, "cold": cold, "warm": warm,
+                   "warmup_tool": tool},
+        "device": warm["device"],
+        "code_rev": _code_rev(),
+        "note": ("start_ms = serving bucket-ladder warmup + fresh "
+                 "Trainer first step, each in its own process. "
+                 "warm_misses==0 is the acceptance gate: a warmed "
+                 "process records zero cold compiles. The warm win is "
+                 "lowering/export-skip (jax.export payload) + backend-compile "
+                 "skip (persistent XLA cache under <cache>/xla); it "
+                 "grows with model size — CPU MLP compiles are "
+                 "hundreds of ms, real-model TPU compiles are tens of "
+                 "seconds."),
+    }
+    return row
+
+
+def bank_row(row: Dict, out_path: str) -> None:
+    payload = {
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "captured_unix": time.time(),
+        "record": row,
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, out_path)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="mxnet_tpu AOT warm-start bench (cross-process)")
+    ap.add_argument("--child", default=None,
+                    choices=("nocache", "cold", "warm"),
+                    help=argparse.SUPPRESS)  # internal: phase child
+    ap.add_argument("--manifest-path", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--features", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=24)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny model + fast children (the tier-1 gate)")
+    ap.add_argument("--output", default=None,
+                    help="also write the row to this file")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="per-child timeout, seconds")
+    ap.add_argument("--no-bank", action="store_true",
+                    help="print the row but skip the results_aot_<dev> "
+                         "bank (the TPU daemon banks with its own "
+                         "envelope)")
+    args = ap.parse_args(argv)
+
+    if args.child:
+        row = child_measure(args.child, args.manifest_path, args.hidden,
+                            args.features, args.max_batch, args.layers)
+        print(json.dumps(row), flush=True)
+        return 0
+
+    row = run_bench(quick=args.quick, hidden=args.hidden,
+                    features=args.features, max_batch=args.max_batch,
+                    layers=args.layers, child_timeout=args.timeout)
+    print(json.dumps(row, indent=2), flush=True)
+    if args.output:
+        tmp = args.output + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(row, f, indent=2)
+        os.replace(tmp, args.output)
+    if not args.quick and not args.no_bank:
+        bank_row(row, os.path.join(
+            HERE, f"results_aot_{row['device']}.json"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,9 @@ atomically banks the results where ``bench.py`` can serve them later:
                                       tokens/s + MFU and KV-cache decode
                                       tokens/s (llm_bench.py)
   benchmark/results_hbm_tpu.json      single-chip HBM bandwidth probe
+  benchmark/results_aot_tpu.json      AOT compile-cache warm start: cold
+                                      vs store-warmed process startup
+                                      (aot_bench.py, mxnet_tpu.aot)
 
 Each child measurement runs via the existing harnesses' child modes, so
 hangs are bounded by their watchdogs + our subprocess timeouts. "Best"
@@ -63,6 +66,7 @@ PROFILE = os.path.join(HERE, "results_profile_tpu.json")
 TRAIN256 = os.path.join(HERE, "results_train_tpu_bs256.json")
 TRAIN_IO = os.path.join(HERE, "results_train_io_tpu.json")
 ATTNPROBE = os.path.join(HERE, "results_attn_probe_tpu.json")
+AOT = os.path.join(HERE, "results_aot_tpu.json")
 
 PROBE_INTERVAL_S = 60        # while the tunnel is down (windows can be
                              # ~4 min total; a slow probe cadence misses
@@ -1010,6 +1014,22 @@ def capture_train_io() -> None:
             f"overhead {rows[0].get('input_overhead_pct')}%")
 
 
+def capture_aot() -> None:
+    """AOT warm-start row (benchmark/aot_bench.py): cold vs store-warmed
+    process startup across real subprocess boundaries — the number that
+    justifies mxnet_tpu.aot on real TPU compile times (tens of seconds
+    per executable vs the CPU row's hundreds of ms)."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "aot_bench.py"),
+         "--timeout", "600", "--no-bank"],
+        timeout=3000, sample_liveness=True)
+    rec = parse_json_output(out)
+    if bank_if_tpu(AOT, rec, rc, "aot-warm-start") and rec:
+        log(f"aot: cold {rec.get('cold_start_ms')} ms -> warm "
+            f"{rec.get('warm_start_ms')} ms "
+            f"({rec.get('value')}x, misses={rec.get('warm_misses')})")
+
+
 def capture_quant() -> None:
     """INT8 PTQ ResNet-50: quantized throughput + top-1 agreement
     (benchmark/quant_bench.py) — int8 MXU has 2x the bf16 peak."""
@@ -1175,6 +1195,7 @@ CAPTURES = (
     ("bs256-infer", banked_stale(BS256), capture_bs256),
     ("infer-table", lambda: bool(stale_combos(INFER, INFER_COMBOS)),
      capture_infer_table),
+    ("aot", banked_stale(AOT), capture_aot),
     ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION, 4 * 3600), capture_attention),

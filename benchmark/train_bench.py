@@ -486,6 +486,15 @@ def child_main(name, batch, prec, cpu, infer=False, recordio_input=False,
     rec["matmul_precision"] = fp32_prec if prec == "fp32" else "bf16-native"
     rec["device"] = devs[0].platform
     rec["device_kind"] = devs[0].device_kind
+    # AOT compile-cache counters (mxnet_tpu.aot): nonzero only when the
+    # child ran with MXNET_TPU_AOT_CACHE armed — then the row records
+    # how much cold-compile the store absorbed for this measurement
+    try:
+        from mxnet_tpu import aot as _aot
+        if any(_aot.stats().values()):
+            rec["aot"] = _aot.stats()
+    except Exception:  # noqa: BLE001 — observability must not fail a row
+        pass
     # provenance stamped by the MEASURING child at measurement time (a
     # daemon-side stamp could misattribute if a commit lands mid-child)
     from bench import code_rev, stamp_window_control

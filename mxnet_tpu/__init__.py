@@ -81,7 +81,8 @@ def __getattr__(name):
                "registry": ".registry", "executor": ".executor",
                "recordio": ".recordio", "serialization": ".serialization",
                "misc": ".misc", "torch": ".torch", "serving": ".serving",
-               "resilience": ".resilience", "analysis": ".analysis"}
+               "resilience": ".resilience", "analysis": ".analysis",
+               "aot": ".aot"}
     if name in targets:
         expected = importlib.util.resolve_name(targets[name], __name__)
         try:

@@ -339,9 +339,6 @@ def lint_trainer(trainer, scope: str = "gluon.Trainer._build_jit_step"
     (``Trainer._fused_update_fn``) and runs :func:`find_donation_misses`
     over it with the real parameter/state avals.
     """
-    import jax
-    import jax.numpy as jnp
-
     idxs = [i for i, p in enumerate(trainer._params)
             if p.grad_req != "null" and p._data is not None]
     if not idxs or not getattr(trainer, "_jit_safe", True):
@@ -349,15 +346,7 @@ def lint_trainer(trainer, scope: str = "gluon.Trainer._build_jit_step"
     if not trainer._states_ready:
         trainer._init_states()
     fused, donate = trainer._fused_update_fn(idxs)
-    sds = jax.ShapeDtypeStruct
-
-    def aval_of(a):
-        return sds(tuple(a.shape), a.dtype)
-
-    weights = [aval_of(trainer._params[i].data()) for i in idxs]
-    grads = list(weights)
-    states = [jax.tree_util.tree_map(aval_of, trainer._states[i])
-              for i in idxs]
-    args = (weights, grads, states, sds((), jnp.float32),
-            sds((), jnp.float32), sds((), jnp.int32))
+    # the aval construction lives on the Trainer (also the prewarm()
+    # path) so the linted signature can never drift from the jitted one
+    args = trainer._fused_update_avals(idxs)
     return find_donation_misses(fused, args, donate, scope=scope)

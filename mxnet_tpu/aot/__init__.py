@@ -1,0 +1,51 @@
+"""``mxnet_tpu.aot`` — persistent compile cache + ahead-of-time warmup.
+
+Every process used to start cold: the serving engine jit-compiled each
+bucket on first traffic, the Trainer re-traced its fused update on
+every restart, and a ``Supervisor`` resume recompiled everything it
+just lost. This subsystem makes compiled executables **durable,
+key-addressed artifacts** (the serialized-XLA-executable idea of
+arXiv:1810.09868, stored TVM-style by full fingerprint):
+
+- :class:`CompileCache` — a crash-safe on-disk store (tmp →
+  ``os.replace`` publish, SHA256 manifests) keyed by jaxpr hash +
+  avals + donation + backend + jax/jaxlib versions + the ``MXNET_*``
+  env-knob signature from tpulint's A002 corpus. Entries are
+  ``jax.export`` payloads; backends/programs that cannot serialize
+  degrade to live trace-and-jit, counted as misses, never errors.
+- :func:`cached_jit` — the drop-in seam the serving engine
+  (``serving/engine.py``), the fused Trainer update
+  (``gluon/trainer.py``) and ``Supervisor`` resume pre-warm all share.
+- :class:`WarmupManifest` — the bucket/shape frontier a server actually
+  compiled; ``engine.warmup(manifest=...)`` and ``tools/aot_warmup.py``
+  replay it so a fresh process never pays cold-compile on served
+  shapes.
+
+Enable with ``MXNET_TPU_AOT_CACHE=<dir>`` (mode via
+``MXNET_TPU_AOT=off|rw|ro``); counters (``aot_hits`` / ``aot_misses`` /
+``aot_bytes`` / ``aot_cold_ms_saved``) surface through
+:mod:`mxnet_tpu.profiler` and the serve/train/aot bench rows. See
+``docs/aot.md``.
+"""
+from __future__ import annotations
+
+from .cache import (  # noqa: F401
+    AOT_COUNTERS,
+    CachedJit,
+    CompileCache,
+    cached_jit,
+    fingerprint,
+    get_cache,
+    knob_signature,
+    reset_default_cache,
+    reset_stats,
+    set_cache,
+    stats,
+)
+from .manifest import WarmupManifest  # noqa: F401
+
+__all__ = [
+    "AOT_COUNTERS", "CachedJit", "CompileCache", "WarmupManifest",
+    "cached_jit", "fingerprint", "get_cache", "knob_signature",
+    "reset_default_cache", "reset_stats", "set_cache", "stats",
+]

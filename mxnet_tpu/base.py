@@ -73,11 +73,43 @@ if _matmul_prec:
 # a big model is tens of seconds; a cache dir survives process restarts
 # (the reference's analogous knob is the NVRTC fusion src->PTX cache,
 # fused_op.cu:599). Off by default — set MXNET_COMPILE_CACHE=/path.
+# MXNET_TPU_AOT_CACHE (the mxnet_tpu.aot executable store) arms the same
+# knob at <dir>/xla: it must happen HERE, at import, because jax
+# initializes the compilation cache once at its first compile — arming
+# the dir later in the process is a silent no-op (verified empirically;
+# aot.CompileCache also best-effort resets the cache for the
+# programmatic-construction path). MXNET_COMPILE_CACHE wins when both
+# are set — an explicit machine-wide choice outranks the AOT default.
 _cache_dir = os.environ.get("MXNET_COMPILE_CACHE", "")
+_aot_dir = os.environ.get("MXNET_TPU_AOT_CACHE", "")
+_aot_mode = os.environ.get("MXNET_TPU_AOT", "rw").strip().lower()
+# cache-everything thresholds apply ONLY when the AOT store actually
+# supplies the cache path — an explicit MXNET_COMPILE_CACHE keeps its
+# own 1.0 s threshold even with an AOT store armed, and MXNET_TPU_AOT=off
+# must not reconfigure anything. NOTE: aot/cache.py:get_cache() parses
+# the same mode knob (invalid values warn + coerce to "rw" there, which
+# agrees with the != "off" test here); keep the two in step — importing
+# aot at this point in base's import would be circular
+_aot_supplies_cache = (not _cache_dir
+                       and not os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                       and bool(_aot_dir) and _aot_mode != "off")
+if _aot_supplies_cache:
+    _cache_dir = os.path.join(_aot_dir, "xla")
 if _cache_dir:
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # cache-everything write thresholds are an rw-store policy: an
+        # ro consumer (fleet warming from a CI-baked cache) arms the
+        # dir for reads only and keeps jax's conservative default
+        _aot_rw = _aot_supplies_cache and _aot_mode != "ro"
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0 if _aot_rw else 1.0)
+        if _aot_rw:
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1)
+            except Exception:  # noqa: BLE001 — knob absent on older jax
+                pass
     except Exception:  # pragma: no cover - older jax without the knob
         pass
 

@@ -54,6 +54,9 @@ SITES = (
     "device.put",         # ndarray host<->device / cross-device transfer
     "serving.infer",      # InferenceEngine micro-batch execution
     "compile",            # HybridBlock trace/compile path
+    "aot.read",           # CompileCache entry lookup (before the read)
+    "aot.write",          # CompileCache publish, payload staged, pre-rename
+    "aot.deserialize",    # cached_jit payload deserialize on a store hit
 )
 
 

@@ -73,7 +73,7 @@ class Supervisor:
         self._sigterm = threading.Event()
         self._counters: Dict[str, int] = {
             "saves": 0, "restores": 0, "recoveries": 0, "faults": 0,
-            "preemptions": 0,
+            "preemptions": 0, "prewarms": 0,
         }
         self._prof = {
             name: profiler.Counter(name=f"resilience.{name}")
@@ -125,15 +125,27 @@ class Supervisor:
         run's lifetime total: a recovery that then checkpoints new work
         resets the budget (and the backoff schedule) — a 40-hour run
         must survive its 5th preemption at hour 30, not die because it
-        already recovered 4 times earlier."""
+        already recovered 4 times earlier.
+
+        ``restore_fn`` runs INSIDE the classified retry loop: a
+        transient fault during restore itself (flaky checkpoint IO, an
+        AOT compile-cache read that needs a retry, a chaos-injected
+        fault on the ``aot.read``/``aot.deserialize`` sites) consumes an
+        attempt and re-enters with backoff instead of killing the run —
+        only faults the classifier calls fatal propagate."""
         delays = self.policy.delays()
         attempt = 0
         last_fault_saves = -1
+        need_restore = False
         self._sigterm.clear()  # a prior run's latched SIGTERM must not
         prev = self._install_sigterm()  # preempt this one at batch 1
         try:
             while True:
                 try:
+                    if need_restore:
+                        restore_fn()
+                        need_restore = False
+                        self._count("recoveries")
                     return run_once()
                 except Preempted:
                     raise  # checkpointed exit — never retried in-process
@@ -152,8 +164,7 @@ class Supervisor:
                             f"{attempt} consecutive transient fault(s); "
                             f"last: {e!r}", attempt) from e
                     self.policy.sleep(next(delays))
-                    restore_fn()
-                    self._count("recoveries")
+                    need_restore = True
         finally:
             self._restore_sigterm(prev)
 
@@ -217,6 +228,16 @@ class Supervisor:
             state["resumed"] = True
             self._count("restores")
 
+        def restore_and_prewarm():
+            restore()
+            # AOT pre-warm: rebuild the fused-update executable from the
+            # persistent compile cache NOW, so recovery time is
+            # restore-IO + (store hit) deserialize — not a recompile on
+            # the first replayed batch. Runs inside the supervised retry
+            # loop, so transient deserialize/compile faults back off and
+            # retry via the classifier instead of killing the run.
+            self._prewarm_trainer(estimator.trainer)
+
         def run_once():
             start_epoch, start_batch = state["epoch"], state["batch"]
             for epoch in range(start_epoch, epochs):
@@ -237,6 +258,21 @@ class Supervisor:
             return dict(state, **self.stats())
 
         restore()  # fresh-process resume (no-op on an empty directory)
+        try:
+            # fresh-process pre-warm is best-effort: a transient cache
+            # problem here degrades to a live first-step compile (there
+            # is no retry loop around us yet); fatal faults are bugs
+            # the first step would hit anyway — propagate those
+            self._prewarm_trainer(estimator.trainer)
+        except BaseException as e:  # noqa: BLE001 — classified
+            if self.policy.classify(e) != TRANSIENT:
+                raise
+            import warnings
+
+            warnings.warn(
+                f"Supervisor: AOT pre-warm failed transiently ({e!r}); "
+                "the first step will compile live", RuntimeWarning,
+                stacklevel=2)
         if self.manager.latest_step() is None:
             # baseline snapshot BEFORE the first update: a transient
             # fault before the first periodic save must restore to the
@@ -254,7 +290,18 @@ class Supervisor:
                 save()
             except Exception:  # noqa: BLE001 — degrade, don't block fit
                 pass
-        return self._supervised(run_once, restore)
+        return self._supervised(run_once, restore_and_prewarm)
+
+    def _prewarm_trainer(self, trainer) -> None:
+        """``trainer.prewarm()`` with counter accounting. Exceptions
+        propagate to the caller — on the supervised path that is the
+        transient-vs-fatal classifier (a flaky cache read retries); the
+        initial fresh-process resume wraps this itself so a cache
+        problem degrades to a live first-step compile there."""
+        if trainer is None or not hasattr(trainer, "prewarm"):
+            return
+        if trainer.prewarm():
+            self._count("prewarms")
 
     @staticmethod
     def _capture_trainer(trainer) -> Optional[Dict]:

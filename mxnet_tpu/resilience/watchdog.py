@@ -55,8 +55,18 @@ def run_with_watchdog(fn: Callable, timeout_s: float, *args,
 class Watchdog:
     """Reusable deadline for a family of operations.
 
+    Guard each stage as a CALL under the deadline — the old example
+    (``wd.run(jax.jit(fn).lower(x).compile)``) evaluated ``.lower(x)``,
+    the stage that actually hangs on a wedged backend, *before*
+    ``wd.run`` ever started the clock:
+
     >>> wd = Watchdog(timeout_s=30, name="compile")
-    >>> exec_ = wd.run(jax.jit(fn).lower(x).compile)
+    >>> lowered = wd.run(jax.jit(fn).lower, x)      # doctest: +SKIP
+    >>> exec_ = wd.run(lowered.compile)             # doctest: +SKIP
+
+    The raised :class:`StallDetected` is a ``TransientError``, so the
+    ``resilience.retry`` classifier re-attempts a guarded compile or an
+    AOT cache deserialize instead of killing the run.
     """
 
     def __init__(self, timeout_s: float, name: Optional[str] = None):

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
+from .. import aot
 from ..base import env_float, env_int, failsoft_call, preflight_backend
 from ..ndarray.ndarray import ndarray, _wrap
 from ..resilience import chaos
@@ -158,6 +159,11 @@ class InferenceEngine:
         self._build_lock = threading.Lock()
         self._warm_lock = threading.Lock()
         self._warm_buckets: set = set()
+        # the shape frontier this process compiled — savable and
+        # replayable so the NEXT process warms exactly what was served
+        # (docs/aot.md); entries carry the AOT store key when the
+        # persistent compile cache (MXNET_TPU_AOT_CACHE) is armed
+        self._warmup_manifest = aot.WarmupManifest()
         if example_input is not None:
             self._build(example_input)
 
@@ -210,7 +216,11 @@ class InferenceEngine:
                     # XLA:CPU warns on every served batch
                     donate = ((1,) if self._donate
                               and key[1] not in ("cpu", "?") else ())
-                    ex = jax.jit(self._fn, donate_argnums=donate)
+                    # the AOT seam: consult the persistent compile cache
+                    # before compiling, publish after — a plain jax.jit
+                    # when no store is armed (aot.get_cache() is None)
+                    ex = aot.cached_jit(self._fn, label="serving.forward",
+                                        donate_argnums=donate)
                     self._execs[key] = ex
         return ex
 
@@ -219,11 +229,54 @@ class InferenceEngine:
             return _ladder_bucket(n, self._bucket_ladder)
         return _pow2_bucket(n, self.max_batch_size)
 
-    def warmup(self, item_shape: Tuple[int, ...], dtype="float32",
-               buckets: Optional[List[int]] = None) -> List[int]:
-        """Pre-compile the bucket executables for one item signature so
-        the first real traffic doesn't pay cold-compile latency. Returns
-        the list of buckets warmed."""
+    def warmup(self, item_shape: Optional[Tuple[int, ...]] = None,
+               dtype="float32", buckets: Optional[List[int]] = None,
+               manifest=None) -> List[int]:
+        """Pre-compile bucket executables so the first real traffic does
+        not pay cold-compile latency. Returns the buckets warmed.
+
+        Two modes:
+
+        - ``item_shape=`` (+ optional ``buckets=``) — warm one item
+          signature over the bucket ladder (all of it by default);
+        - ``manifest=`` (a :class:`~mxnet_tpu.aot.WarmupManifest` or a
+          path to one, recorded by a previous server via
+          :meth:`save_warmup_manifest`) — replay exactly the shape
+          frontier that server compiled, across every item signature it
+          served, instead of guessing.
+
+        With the persistent compile cache armed
+        (``MXNET_TPU_AOT_CACHE``), either mode resolves executables from
+        the store — warmup cost becomes deserialize + cached backend
+        compile, not cold XLA compiles.
+        """
+        if manifest is not None:
+            if item_shape is not None or buckets is not None:
+                raise ValueError(
+                    "pass either manifest= or item_shape=/buckets=, "
+                    "not both")
+            if not isinstance(manifest, aot.WarmupManifest):
+                manifest = aot.WarmupManifest.load(manifest)
+            out, seen = [], set()
+            for b, shape, dt in manifest.serving_signatures():
+                if b > self.max_batch_size:
+                    continue  # recorded by a larger-capped server
+                # map through THIS engine's ladder: a recorder with a
+                # different bucket_ladder logged sizes our dispatch
+                # would never select — warm the bucket b rows would
+                # actually land in, not the recorded literal
+                b = self._bucket(b)
+                sig = (b, tuple(shape), dt)
+                if sig in seen:
+                    continue
+                seen.add(sig)
+                x = onp.zeros((b,) + tuple(shape), dt)
+                self._execute_padded(x, tuple(shape),
+                                     str(onp.dtype(dt)))
+                out.append(b)
+            return sorted(set(out))
+        if item_shape is None:
+            raise ValueError("warmup needs item_shape= or manifest=")
         dtype = onp.dtype(dtype)
         if buckets is None and self._bucket_ladder is not None:
             buckets = list(self._bucket_ladder)
@@ -239,6 +292,17 @@ class InferenceEngine:
             self._execute_padded(x, tuple(item_shape), str(dtype))
             out.append(b)
         return out
+
+    def warmup_manifest(self) -> "aot.WarmupManifest":
+        """The live manifest of every bucket signature this engine has
+        compiled (shared object — it keeps growing as traffic arrives)."""
+        return self._warmup_manifest
+
+    def save_warmup_manifest(self, path: str) -> str:
+        """Snapshot the compiled-shape frontier to ``path`` for a future
+        process to replay (``engine.warmup(manifest=path)`` or
+        ``tools/aot_warmup.py --manifest path``)."""
+        return self._warmup_manifest.save(path)
 
     # -- client surface ---------------------------------------------------
     def infer(self, x, timeout_ms: Optional[float] = "default"):
@@ -296,6 +360,7 @@ class InferenceEngine:
         snap["queue_len"] = len(self._queue)
         snap["max_batch_size"] = self.max_batch_size
         snap["max_delay_ms"] = self.max_delay_ms
+        snap["aot"] = aot.stats()  # process-wide hit/miss/bytes counters
         try:
             # pure observability must never raise (or be the process's
             # unguarded first backend touch) — mirror stem_s2d_cache_key
@@ -400,4 +465,23 @@ class InferenceEngine:
             if key not in self._warm_buckets:  # counted on SUCCESS only:
                 self.metrics.count("compiles")  # retries don't inflate
                 self._warm_buckets.add(key)
+                self._record_warmup(bucket, item_shape, dtype, staged)
         return out
+
+    def _record_warmup(self, bucket: int, item_shape: Tuple[int, ...],
+                       dtype: str, staged: onp.ndarray) -> None:
+        """Append the just-compiled bucket signature to the warmup
+        manifest, with the AOT store key when one resolved (observability
+        only — must never fail a served batch)."""
+        entry = {"label": "serving.bucket", "bucket": int(bucket),
+                 "item_shape": list(item_shape), "dtype": str(dtype)}
+        try:
+            if self._jit:
+                ex = self._get_exec()
+                key = getattr(ex, "resolved_key", lambda *a: None)(
+                    self._params, staged)
+                if key:
+                    entry["key"] = key
+        except Exception:  # noqa: BLE001 — manifest is best-effort
+            pass
+        self._warmup_manifest.record(**entry)

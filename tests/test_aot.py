@@ -1,0 +1,695 @@
+"""``mxnet_tpu.aot`` — persistent compile cache + AOT warmup (ISSUE 5).
+
+Contract under test (docs/aot.md):
+- a SECOND process resolves executables from the store with zero cold
+  compiles (the acceptance criterion, measured cross-process);
+- the key is a full fingerprint: flipping an A002 env knob or the
+  jaxlib version invalidates an entry instead of serving it stale;
+- donation survives a cache hit (the J005 cross-check);
+- concurrent writers publish-by-rename: one valid entry, no torn state;
+- corrupt / truncated entries and chaos faults on the read/write/
+  deserialize sites degrade to a live compile with a warning — never a
+  crash, never a wrong result;
+- backends/programs that cannot serialize fall back to trace-and-jit,
+  counted as a miss, and no store configured means plain ``jax.jit``.
+
+All CPU, all tier-1-fast (two small subprocess drills).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import aot, autograd, gluon, resilience
+from mxnet_tpu.aot import cache as aot_cache
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _aot_clean():
+    """Every test starts with no process store, zeroed counters and a
+    disarmed chaos registry; the env-driven default is restored after."""
+    aot.set_cache(None)
+    aot.reset_stats()
+    chaos.clear()
+    yield
+    aot.reset_default_cache()
+    aot.reset_stats()
+    chaos.clear()
+
+
+def _store(tmp_path, **kw):
+    """A private store that does NOT touch the process-global XLA
+    compilation-cache config (unit tests must not redirect where the
+    rest of the suite's compiles land)."""
+    kw.setdefault("arm_xla_cache", False)
+    return aot.CompileCache(str(tmp_path / "store"), **kw)
+
+
+def _fn(x):
+    return jnp.sin(x) * 2.0 + 1.0
+
+
+X = onp.linspace(0.0, 1.0, 16).astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# store + cached_jit basics
+# ---------------------------------------------------------------------------
+def test_miss_publish_then_fresh_instance_hits(tmp_path):
+    store = _store(tmp_path)
+    cj1 = aot.cached_jit(_fn, label="basic", cache=store)
+    y1 = onp.asarray(cj1(X))
+    assert cj1.last_outcome == "miss"
+    st = aot.stats()
+    assert st["aot_misses"] == 1 and st["aot_puts"] == 1
+    assert st["aot_bytes"] > 0
+    assert len(store.keys()) == 1
+    man = store.entry_manifest(store.keys()[0])
+    assert man["label"] == "basic" and man["bytes"] > 0
+    assert man["components"]["jaxlib"] == aot_cache.jaxlib_version()
+
+    # a fresh CachedJit (new in-process memo — the restarted-process
+    # analog minus the process boundary) resolves from the store
+    aot.reset_stats()
+    cj2 = aot.cached_jit(_fn, label="basic", cache=store)
+    y2 = onp.asarray(cj2(X))
+    assert cj2.last_outcome == "hit"
+    st = aot.stats()
+    assert st["aot_hits"] == 1 and st["aot_misses"] == 0
+    assert st["aot_cold_ms_saved"] > 0  # banked compile_ms of the entry
+    onp.testing.assert_array_equal(y1, y2)
+    # the resolved key is observable (what WarmupManifest records)
+    assert cj2.resolved_key(X) == store.keys()[0]
+
+
+def test_no_store_is_plain_jit(tmp_path):
+    cj = aot.cached_jit(_fn, label="nostore", cache=None)
+    y = onp.asarray(cj(X))
+    assert cj.last_outcome == "jit"
+    onp.testing.assert_allclose(y, onp.sin(X) * 2.0 + 1.0, rtol=1e-6)
+    assert aot.stats() == {k: 0 for k in aot.AOT_COUNTERS}
+    assert cj.resolved_key(X) is None
+
+
+def test_no_store_prewarm_is_not_thrown_away():
+    """warm() without a store must bank its AOT-compiled executable:
+    jit's dispatch cache is NOT populated by lower().compile(), so
+    discarding it would make the first real call (e.g. a Supervisor
+    recovery's first step on an unarmed process) pay the compile
+    twice."""
+    cj = aot.cached_jit(_fn, label="nostore.warm", cache=None)
+    sds = jax.ShapeDtypeStruct(X.shape, X.dtype)
+    assert cj.warm(sds) == "jit"
+    assert cj.warm(sds) == "warm"  # idempotent
+
+    def exploding_plain(*a):
+        raise AssertionError("first call re-dispatched the plain jit "
+                             "instead of reusing the prewarmed "
+                             "executable")
+
+    cj._plain = exploding_plain
+    y = onp.asarray(cj(X))
+    onp.testing.assert_allclose(y, onp.sin(X) * 2.0 + 1.0, rtol=1e-6)
+
+
+def test_mode_off_and_ro(tmp_path):
+    off = _store(tmp_path, mode="off")
+    cj = aot.cached_jit(_fn, label="off", cache=off)
+    cj(X)
+    assert cj.last_outcome == "jit" and off.keys() == []
+
+    rw = aot.CompileCache(str(tmp_path / "rw"), arm_xla_cache=False)
+    aot.cached_jit(_fn, label="ro", cache=rw)(X)
+    assert len(rw.keys()) == 1
+    ro = aot.CompileCache(rw.directory, mode="ro", arm_xla_cache=False)
+    aot.reset_stats()
+    cj_hit = aot.cached_jit(_fn, label="ro", cache=ro)
+    cj_hit(X)
+    assert cj_hit.last_outcome == "hit"  # reads work
+    # a novel program is a miss that does NOT publish
+    cj_new = aot.cached_jit(lambda x: x - 7.0, label="ro.novel", cache=ro)
+    cj_new(X)
+    assert cj_new.last_outcome == "miss"
+    assert len(ro.keys()) == 1
+    assert aot.stats()["aot_puts"] == 0
+
+    with pytest.raises(ValueError):
+        aot.CompileCache(str(tmp_path / "bad"), mode="write-back")
+
+
+def test_get_cache_env_driven(tmp_path, monkeypatch):
+    # keep CompileCache from re-pointing the process-global XLA cache
+    monkeypatch.setenv("MXNET_COMPILE_CACHE", str(tmp_path / "xla"))
+    monkeypatch.setenv("MXNET_TPU_AOT_CACHE", str(tmp_path / "store"))
+    monkeypatch.setenv("MXNET_TPU_AOT", "ro")
+    aot.reset_default_cache()
+    c = aot.get_cache()
+    assert isinstance(c, aot.CompileCache) and c.mode == "ro"
+
+    monkeypatch.setenv("MXNET_TPU_AOT", "off")
+    aot.reset_default_cache()
+    assert aot.get_cache() is None
+
+    monkeypatch.setenv("MXNET_TPU_AOT", "turbo")
+    aot.reset_default_cache()
+    with pytest.warns(RuntimeWarning, match="off/rw/ro"):
+        c = aot.get_cache()
+    assert c is not None and c.mode == "rw"
+
+
+# ---------------------------------------------------------------------------
+# key fingerprint: what must invalidate, does
+# ---------------------------------------------------------------------------
+def test_knob_flip_invalidates(tmp_path, monkeypatch):
+    # the A002 corpus must actually discover the serving/nn cache-key
+    # knobs — the contract that ties tpulint's corpus to the AOT key
+    knobs = aot_cache._discover_knob_names()
+    assert "MXNET_TPU_STEM_S2D" in knobs
+    store = _store(tmp_path)
+    aot.cached_jit(_fn, label="knob", cache=store)(X)
+    assert len(store.keys()) == 1
+
+    monkeypatch.setenv("MXNET_TPU_STEM_S2D", "1")
+    assert dict(aot.knob_signature())["MXNET_TPU_STEM_S2D"] == "1"
+    cj = aot.cached_jit(_fn, label="knob", cache=store)
+    cj(X)
+    assert cj.last_outcome == "miss"  # NOT served stale
+    assert len(store.keys()) == 2
+
+
+def test_jaxlib_version_invalidates(tmp_path, monkeypatch):
+    store = _store(tmp_path)
+    aot.cached_jit(_fn, label="ver", cache=store)(X)
+    monkeypatch.setattr(aot_cache, "jaxlib_version",
+                        lambda: "999.0.fake")
+    cj = aot.cached_jit(_fn, label="ver", cache=store)
+    cj(X)
+    assert cj.last_outcome == "miss"
+    assert len(store.keys()) == 2
+    # and the new entry records the version it was keyed under
+    new = [k for k in store.keys()
+           if store.entry_manifest(k)["components"]["jaxlib"]
+           == "999.0.fake"]
+    assert len(new) == 1
+
+
+def test_avals_and_donation_in_key(tmp_path):
+    k1, _ = aot.fingerprint(_fn, (X,), label="f")
+    k2, _ = aot.fingerprint(_fn, (X[:8],), label="f")
+    assert k1 != k2  # shape
+    k3, _ = aot.fingerprint(_fn, (X.astype("float64"),), label="f")
+    assert k3 not in (k1, k2)  # dtype
+    k4, _ = aot.fingerprint(_fn, (X,), label="f", donate_argnums=(0,))
+    assert k4 != k1  # donation
+    # ShapeDtypeStructs (the prewarm path) key identically to arrays
+    k5, _ = aot.fingerprint(
+        _fn, (jax.ShapeDtypeStruct(X.shape, X.dtype),), label="f")
+    assert k5 == k1
+
+
+def test_donation_preserved_through_hit(tmp_path, monkeypatch):
+    """A hit re-applies donate_argnums when AOT-compiling the
+    deserialized payload — the J005 contract (donated buffers stay
+    donated; a cache hit must not silently double the update's
+    memory high-water mark)."""
+    store = _store(tmp_path)
+
+    def g(x):
+        return x * 2.0 + 1.0
+
+    aot.cached_jit(g, label="donate", donate_argnums=(0,),
+                   cache=store)(X)
+    assert len(store.keys()) == 1
+    assert store.entry_manifest(store.keys()[0])["donate"] == [0]
+
+    seen = []
+    real_jit = jax.jit
+
+    def spy(fn, **kw):
+        seen.append(tuple(kw.get("donate_argnums") or ()))
+        return real_jit(fn, **kw)
+
+    monkeypatch.setattr(jax, "jit", spy)
+    cj = aot.cached_jit(g, label="donate", donate_argnums=(0,),
+                        cache=store)
+    cj(X)
+    assert cj.last_outcome == "hit"
+    assert seen and all(d == (0,) for d in seen)
+
+
+def test_concurrent_writers_publish_by_rename(tmp_path):
+    """N racing writers on one key: exactly one published entry, valid
+    checksum, every put() reports success, zero staging leftovers."""
+    store = _store(tmp_path)
+    key = "f" * 64
+    payload = os.urandom(4096)
+    barrier = threading.Barrier(8)
+    results = []
+
+    def writer():
+        barrier.wait()
+        results.append(store.put(key, payload, {"label": "race"}))
+
+    threads = [threading.Thread(target=writer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [True] * 8
+    assert store.keys() == [key]
+    got = store.load(key)
+    assert got is not None and got[0] == payload
+    leftovers = [n for n in os.listdir(os.path.join(store.directory,
+                                                    "entries"))
+                 if ".tmp-" in n]
+    assert leftovers == []
+
+
+def test_unserializable_program_falls_back_to_jit(tmp_path, monkeypatch):
+    """Export failure = miss + fallback counter + one warning, correct
+    result via live trace-and-jit, nothing published."""
+    from jax import export as jax_export
+
+    def boom(*a, **k):
+        raise NotImplementedError("no serialization on this backend")
+
+    monkeypatch.setattr(jax_export, "export", boom)
+    store = _store(tmp_path)
+    cj = aot.cached_jit(_fn, label="fallback", cache=store)
+    with pytest.warns(RuntimeWarning, match="serialization is unavail"):
+        y = onp.asarray(cj(X))
+    assert cj.last_outcome == "fallback"
+    onp.testing.assert_allclose(y, onp.sin(X) * 2.0 + 1.0, rtol=1e-6)
+    st = aot.stats()
+    assert st["aot_misses"] == 1 and st["aot_fallbacks"] == 1
+    assert st["aot_puts"] == 0 and store.keys() == []
+
+
+# ---------------------------------------------------------------------------
+# corruption + chaos: degrade, never crash
+# ---------------------------------------------------------------------------
+def test_corrupt_payload_quarantined_then_republished(tmp_path):
+    store = _store(tmp_path)
+    y0 = onp.asarray(aot.cached_jit(_fn, label="rot", cache=store)(X))
+    key = store.keys()[0]
+    ppath = os.path.join(store.directory, "entries", key, "payload.bin")
+    with open(ppath, "wb") as f:
+        f.write(b"bit rot, allegedly")
+    # a read-only consumer reports the corruption as a miss but must
+    # NOT mutate the shared store — the owning rw writer quarantines
+    ro = aot.CompileCache(store.directory, mode="ro",
+                          arm_xla_cache=False)
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        assert ro.load(key) is None
+    assert os.path.exists(ppath)
+    aot.reset_stats()
+    cj = aot.cached_jit(_fn, label="rot", cache=store)
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        y = onp.asarray(cj(X))
+    onp.testing.assert_array_equal(y, y0)
+    assert cj.last_outcome == "miss"  # quarantined + recompiled live
+    assert aot.stats()["aot_hits"] == 0
+    # ...and the bad entry was replaced by a good one
+    assert store.keys() == [key]
+    assert store.load(key) is not None
+
+
+def test_truncated_manifest_is_a_miss(tmp_path):
+    store = _store(tmp_path)
+    aot.cached_jit(_fn, label="trunc", cache=store)(X)
+    key = store.keys()[0]
+    mpath = os.path.join(store.directory, "entries", key,
+                         "manifest.json")
+    text = open(mpath).read()
+    with open(mpath, "w") as f:
+        f.write(text[:len(text) // 2])  # the torn-write shape
+    cj = aot.cached_jit(_fn, label="trunc", cache=store)
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        y = onp.asarray(cj(X))
+    assert cj.last_outcome == "miss"
+    onp.testing.assert_allclose(y, onp.sin(X) * 2.0 + 1.0, rtol=1e-6)
+
+
+def test_xla_cache_rearm_follows_the_active_store(tmp_path, monkeypatch):
+    """A dir armed by a PREVIOUS store is ours to re-point when a new
+    store activates (entries and xla tier must live together); a dir
+    the user armed programmatically is respected."""
+    mod = aot_cache
+    orig = jax.config.jax_compilation_cache_dir
+    orig_armed = mod._xla_armed_dir
+    try:
+        for var in ("JAX_COMPILATION_CACHE_DIR", "MXNET_COMPILE_CACHE",
+                    "MXNET_TPU_AOT_CACHE"):
+            monkeypatch.delenv(var, raising=False)
+        mod._xla_armed_dir = None
+        user_dir = str(tmp_path / "user_xla")
+        jax.config.update("jax_compilation_cache_dir", user_dir)
+        aot.CompileCache(str(tmp_path / "s1"), arm_xla_cache=True)
+        assert jax.config.jax_compilation_cache_dir == user_dir
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        s2 = aot.CompileCache(str(tmp_path / "s2"))
+        assert (jax.config.jax_compilation_cache_dir
+                == os.path.join(s2.directory, "xla"))
+        # second store in the same process: the xla tier follows it
+        s3 = aot.CompileCache(str(tmp_path / "s3"))
+        assert (jax.config.jax_compilation_cache_dir
+                == os.path.join(s3.directory, "xla"))
+    finally:
+        jax.config.update("jax_compilation_cache_dir", orig)
+        mod._xla_armed_dir = orig_armed
+
+
+def test_orphaned_staging_dirs_swept_on_init(tmp_path):
+    store = _store(tmp_path)
+    orphan = os.path.join(store.directory, "entries",
+                          "a" * 64 + ".tmp-999-dead")
+    os.makedirs(orphan)
+    with open(os.path.join(orphan, "payload.bin"), "wb") as f:
+        f.write(b"half a payload")
+    # a FRESH staging dir may belong to a live concurrent writer in a
+    # shared cache — a peer's init must leave it alone
+    aot.CompileCache(store.directory, arm_xla_cache=False)
+    assert os.path.exists(orphan)
+    # past the TTL it is provably a killed writer's leftover
+    old = time.time() - aot.CompileCache.ORPHAN_TTL_S - 60
+    os.utime(orphan, (old, old))
+    with pytest.warns(RuntimeWarning, match="orphaned"):
+        again = aot.CompileCache(store.directory, arm_xla_cache=False)
+    assert not os.path.exists(orphan)
+    assert again.keys() == []
+
+
+@pytest.mark.chaos
+def test_chaos_read_and_deserialize_faults_are_transient(tmp_path):
+    """Injected faults on the aot.read / aot.deserialize sites surface
+    as TRANSIENT to the resilience classifier (the Supervisor retry
+    contract), and the seam recovers once disarmed."""
+    store = _store(tmp_path)
+    cj = aot.cached_jit(_fn, label="chaos.read", cache=store)
+    with chaos.scope("aot.read", fail="transient"):
+        with pytest.raises(chaos.ChaosTransient) as ei:
+            cj(X)
+    assert resilience.classify(ei.value) == resilience.TRANSIENT
+    y = onp.asarray(cj(X))  # disarmed: compiles + publishes fine
+    onp.testing.assert_allclose(y, onp.sin(X) * 2.0 + 1.0, rtol=1e-6)
+
+    fresh = aot.cached_jit(_fn, label="chaos.read", cache=store)
+    with chaos.scope("aot.deserialize", fail="transient"):
+        with pytest.raises(chaos.ChaosTransient) as ei:
+            fresh(X)
+    assert resilience.classify(ei.value) == resilience.TRANSIENT
+    fresh(X)
+    assert fresh.last_outcome == "hit"
+
+
+@pytest.mark.chaos
+def test_chaos_kill_mid_publish_leaves_no_torn_entry(tmp_path):
+    """A writer killed between payload staging and publish (the
+    aot.write site) leaves only an unpublished staging dir: readers
+    miss cleanly, the next init sweeps it, and a live compile
+    republishes."""
+    cache_dir = str(tmp_path / "store")
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import numpy as onp
+        import jax.numpy as jnp
+        from mxnet_tpu import aot
+        from mxnet_tpu.resilience import chaos
+
+        store = aot.CompileCache({cache_dir!r}, arm_xla_cache=False)
+        cj = aot.cached_jit(lambda x: x * 3.0, label="kill.drill",
+                            cache=store)
+        with chaos.scope("aot.write", kill_after=1):
+            cj(onp.ones((4,), "float32"))
+        print("UNREACHABLE")
+    """)
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=300,
+                          env=dict(os.environ, PYTHONPATH=REPO))
+    assert proc.returncode == 137, proc.stderr[-2000:]
+    assert "UNREACHABLE" not in proc.stdout
+
+    entries = os.path.join(cache_dir, "entries")
+    names = os.listdir(entries)
+    tmp_dirs = [n for n in names if ".tmp-" in n]
+    assert len(tmp_dirs) == 1 and len(names) == 1  # staged, unpublished
+    staged = os.listdir(os.path.join(entries, tmp_dirs[0]))
+    assert staged == ["payload.bin"]  # killed before the manifest
+
+    # age the leftover past the liveness TTL so init treats it as a
+    # killed writer's orphan rather than a live peer's in-flight publish
+    old = time.time() - aot.CompileCache.ORPHAN_TTL_S - 60
+    os.utime(os.path.join(entries, tmp_dirs[0]), (old, old))
+    with pytest.warns(RuntimeWarning, match="orphaned"):
+        store = aot.CompileCache(cache_dir, arm_xla_cache=False)
+    cj = aot.cached_jit(lambda x: x * 3.0, label="kill.drill",
+                        cache=store)
+    y = onp.asarray(cj(onp.ones((4,), "float32")))
+    assert cj.last_outcome == "miss"  # never a crash, never a hit on junk
+    onp.testing.assert_array_equal(y, onp.full((4,), 3.0, "float32"))
+    assert len(store.keys()) == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-process: the acceptance criterion
+# ---------------------------------------------------------------------------
+_CHILD = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, os.environ["MXTPU_REPO"])
+    import numpy as onp
+    import jax.numpy as jnp
+    from mxnet_tpu import aot
+
+    def fn(x):
+        return jnp.tanh(x) @ x.T
+
+    cache = aot.get_cache()           # env-driven (MXNET_TPU_AOT_CACHE)
+    assert cache is not None
+    cj = aot.cached_jit(fn, label="xproc")
+    x = onp.full((8, 8), 0.5, "float32")
+    y = cj(x)
+    print(json.dumps({"outcome": cj.last_outcome, "stats": aot.stats(),
+                      "y": float(onp.asarray(y)[0, 0])}))
+""")
+
+
+@pytest.mark.integration
+def test_cross_process_cache_hit(tmp_path):
+    """Process A compiles + publishes; fresh process B records ZERO
+    cold compiles for the same program (aot_misses == 0) and the same
+    numerics — the ISSUE 5 acceptance gate at unit scale."""
+    env = dict(os.environ, PYTHONPATH=REPO, MXTPU_REPO=REPO,
+               MXNET_TPU_AOT_CACHE=str(tmp_path / "store"))
+
+    def run():
+        proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                              capture_output=True, text=True,
+                              timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    first = run()
+    assert first["outcome"] == "miss"
+    assert first["stats"]["aot_puts"] == 1
+    second = run()
+    assert second["outcome"] == "hit"
+    assert second["stats"]["aot_misses"] == 0  # zero cold compiles
+    assert second["stats"]["aot_hits"] == 1
+    assert second["y"] == first["y"]
+
+
+# ---------------------------------------------------------------------------
+# WarmupManifest
+# ---------------------------------------------------------------------------
+def test_warmup_manifest_roundtrip(tmp_path):
+    m = aot.WarmupManifest()
+    assert m.record(label="serving.bucket", bucket=4,
+                    item_shape=(16,), dtype="float32", key="k1")
+    assert not m.record(label="serving.bucket", bucket=4,
+                        item_shape=[16], dtype="float32", key="k1")
+    assert m.record(label="serving.bucket", bucket=1,
+                    item_shape=(16,), dtype="float32")
+    assert m.record(label="trainer.fused_update", key="k2")
+    assert len(m) == 3
+    # smallest bucket first; the key-less trainer entry is not a
+    # serving signature
+    assert m.serving_signatures() == [(1, (16,), "float32"),
+                                      (4, (16,), "float32")]
+    assert m.keys() == ["k1", "k2"]
+
+    path = str(tmp_path / "manifest.json")
+    m.save(path)
+    m2 = aot.WarmupManifest.load(path)
+    assert m2.entries() == m.entries()
+    with pytest.raises(ValueError):
+        m.record(bucket=2)  # label is mandatory
+    with open(path, "w") as f:
+        json.dump({"nope": 1}, f)
+    with pytest.raises(ValueError, match="not a warmup manifest"):
+        aot.WarmupManifest.load(path)
+
+
+def test_engine_records_frontier_and_warms_from_manifest(tmp_path):
+    """The serving seam end-to-end, in-process: engine 1 compiles a
+    bucket, records it (with the resolved store key), and a fresh
+    engine warms from the saved manifest via store hits."""
+    from mxnet_tpu.serving import InferenceEngine
+
+    store = _store(tmp_path)
+    aot.set_cache(store)
+    path = str(tmp_path / "serving_manifest.json")
+
+    def mlp():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+        net.initialize()
+        return net
+
+    eng = InferenceEngine(mlp(), example_input=onp.zeros((1, 16),
+                                                         "float32"),
+                          max_batch_size=4, max_delay_ms=1.0)
+    try:
+        assert eng.warmup((16,), buckets=[1]) == [1]
+        with pytest.raises(ValueError, match="not both"):
+            eng.warmup((16,), manifest=path)
+        man = eng.warmup_manifest()
+        assert man.serving_signatures() == [(1, (16,), "float32")]
+        assert man.keys()  # the store key rode along
+        assert man.keys()[0] in store
+        eng.save_warmup_manifest(path)
+        assert eng.stats()["aot"]["aot_puts"] >= 1
+    finally:
+        eng.close()
+
+    aot.reset_stats()
+    eng2 = InferenceEngine(mlp(), example_input=onp.zeros((1, 16),
+                                                          "float32"),
+                           max_batch_size=4, max_delay_ms=1.0)
+    try:
+        assert eng2.warmup(manifest=path) == [1]
+        st = aot.stats()
+        assert st["aot_hits"] >= 1 and st["aot_misses"] == 0
+        # a real request through the warmed bucket compiles nothing new
+        y = eng2.infer(onp.ones((1, 16), "float32"))
+        assert onp.asarray(y).shape == (1, 4)
+        assert aot.stats()["aot_misses"] == 0
+    finally:
+        eng2.close()
+    with pytest.raises(ValueError, match="item_shape= or manifest="):
+        InferenceEngine(mlp(), jit=False).warmup()
+
+
+# ---------------------------------------------------------------------------
+# Trainer + Supervisor seams
+# ---------------------------------------------------------------------------
+def _tiny_trainer(store):
+    aot.set_cache(store)
+    net = nn.Dense(4)
+    net.initialize()
+    x = mx.np.array(onp.ones((2, 8), "float32"))
+    net(x)  # materialize params
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    return net, trainer, x
+
+
+def test_trainer_prewarm_hits_store(tmp_path):
+    """Trainer 1 publishes its fused update; a fresh Trainer with the
+    same shapes prewarm()s from the store (the Supervisor-resume path)
+    and its step needs no new executable — with donation intact per
+    the J005 linter."""
+    store = _store(tmp_path)
+    net, t1, x = _tiny_trainer(store)
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    t1.step(batch_size=2)
+    assert t1._jit_step is not None
+    assert t1._jit_step.last_outcome == "miss"  # published
+    assert any(store.entry_manifest(k)["label"] == "trainer.fused_update"
+               for k in store.keys())
+
+    aot.reset_stats()
+    net2, t2, x2 = _tiny_trainer(store)
+    t2._init_states()
+    assert t2.prewarm() is True
+    assert t2._jit_step.last_outcome == "hit"
+    assert aot.stats()["aot_misses"] == 0
+    assert t2.prewarm() is False  # idempotent: already resolved
+    with autograd.record():
+        loss = (net2(x2) ** 2).mean()
+    loss.backward()
+    t2.step(batch_size=2)  # runs through the prewarmed executable
+    assert aot.stats()["aot_misses"] == 0
+
+    # the donation contract survives the AOT seam (J005 cross-check)
+    from mxnet_tpu.analysis import lint_trainer
+
+    assert [f for f in lint_trainer(t2) if f.rule == "J005"] == []
+
+
+def test_trainer_prewarm_needs_materialized_state(tmp_path):
+    store = _store(tmp_path)
+    net = nn.Dense(4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    assert trainer.prewarm() is False  # no states, no shapes yet
+
+
+def test_supervisor_prewarms_on_resume(tmp_path):
+    """A Supervisor fit over a prewarmable trainer counts prewarms —
+    recovery cost is restore-IO + store hit, not a recompile."""
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    store = _store(tmp_path)
+    aot.set_cache(store)
+    net = nn.Dense(2)
+    net.initialize()
+    xs = mx.np.array(onp.random.RandomState(0)
+                     .uniform(size=(8, 4)).astype("float32"))
+    ys = mx.np.array(onp.zeros((8, 2), "float32"))
+    data = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(xs, ys), batch_size=4)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    est = Estimator(net=net, loss=gluon.loss.L2Loss(), trainer=trainer)
+    sup = resilience.Supervisor(
+        directory=str(tmp_path / "ckpt"),
+        policy=resilience.RetryPolicy(max_attempts=2, base_delay_s=0.01))
+    first = sup.fit(est, data, epochs=1)
+    assert first["epoch"] >= 0
+
+    # fresh-process analog: new net/trainer/supervisor, same directory —
+    # restore() then prewarm() resolves the fused update from the store
+    aot.reset_stats()
+    net2 = nn.Dense(2)
+    net2.initialize()
+    net2(xs[:4])
+    trainer2 = gluon.Trainer(net2.collect_params(), "adam",
+                             {"learning_rate": 1e-2})
+    est2 = Estimator(net=net2, loss=gluon.loss.L2Loss(),
+                     trainer=trainer2)
+    sup2 = resilience.Supervisor(
+        directory=str(tmp_path / "ckpt"),
+        policy=resilience.RetryPolicy(max_attempts=2,
+                                      base_delay_s=0.01))
+    sup2.fit(est2, data, epochs=1)
+    assert sup2.stats()["prewarms"] >= 1
+    assert aot.stats()["aot_hits"] >= 1

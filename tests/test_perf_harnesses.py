@@ -307,6 +307,42 @@ def test_io_bench_quick(tmp_path):
         assert "queue_depth_at_end" in dp
 
 
+def test_aot_bench_quick(tmp_path):
+    """aot_bench --quick end-to-end: nocache / cold-publish / warmup-tool
+    / warm phases on a tiny model, each in its own process — the schema
+    contract for the committed AOT warm-start results, plus the ISSUE 5
+    acceptance gate at smoke scale: the store-warmed process records
+    ZERO cold compiles (aot_misses == 0) for the warmed key set."""
+    import json
+    import subprocess
+    import sys
+
+    out_file = str(tmp_path / "aot.json")
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    # the children must measure the default (no ambient store / chaos)
+    for k in ("MXNET_TPU_AOT_CACHE", "MXNET_TPU_AOT", "MXNET_TPU_CHAOS"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmark", "aot_bench.py"),
+         "--quick", "--output", out_file],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(open(out_file).read())
+    assert rec["quick"] is True
+    assert rec["metric"] == "aot_warm_start"
+    assert rec["cold_start_ms"] > 0 and rec["warm_start_ms"] > 0
+    # the acceptance gate: zero cold compiles in the warmed process
+    # (fallback-counted misses would show up here — backends without
+    # serialization are allowed to miss, but CPU serializes)
+    assert rec["warm_misses"] == 0
+    assert rec["warm_hits"] > 0
+    assert rec["warm_trainer_prewarmed"] is True
+    assert rec["phases"]["cold"]["aot"]["aot_puts"] > 0
+    tool = rec["phases"]["warmup_tool"]
+    assert tool["entries_errored"] == 0
+    assert tool["entries_warmed"] == tool["entries_total"] > 0
+
+
 def test_daemon_merge_model_table_keeps_banked_rows(tmp_path):
     """A partial capture (tunnel flap mid-table) must never erase
     previously banked successes; unattempted combos merge forward."""

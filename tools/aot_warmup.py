@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Replay a warmup manifest (or a whole compile cache) ahead of traffic.
+
+A fresh process — or a pool node a deploy step prepares — should never
+pay cold XLA compiles for executables some other process already built.
+This tool walks the persistent :class:`mxnet_tpu.aot.CompileCache` and
+AOT-compiles entries **without needing the model**: each entry is a
+``jax.export`` payload that carries its own input avals, so deserialize
++ ``jit(exp.call).lower(avals).compile()`` (donation re-applied from the
+entry manifest, matching exactly what a serving/training process will
+compile on a store hit) populates the XLA persistent cache under
+``<cache>/xla``. The next server's ``engine.warmup(manifest=...)`` or
+Trainer ``prewarm()`` then costs disk reads, not compiles.
+
+Examples::
+
+    # warm everything a previous server recorded
+    python tools/aot_warmup.py --cache /var/cache/mxtpu_aot \
+        --manifest /var/cache/mxtpu_aot/serving_manifest.json
+
+    # warm every published entry (deploy-time cache bake)
+    python tools/aot_warmup.py --cache /var/cache/mxtpu_aot --all
+
+Prints one JSON summary row (``--output`` banks it to a file).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def warm_key(cache, key: str) -> Dict:
+    """Deserialize + AOT-compile one store entry; returns a status row."""
+    import jax
+
+    loaded = cache.load(key)
+    if loaded is None:
+        return {"key": key, "status": "missing"}
+    payload, manifest = loaded
+    t0 = time.perf_counter()
+    try:
+        from jax import export as jax_export
+
+        exp = jax_export.deserialize(payload)
+        flat = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for a in exp.in_avals]
+        args, kwargs = jax.tree_util.tree_unflatten(exp.in_tree, flat)
+        donate = tuple(int(i) for i in manifest.get("donate") or ())
+        jax.jit(exp.call, donate_argnums=donate
+                ).lower(*args, **kwargs).compile()
+    except Exception as e:  # noqa: BLE001 — report, keep warming the rest
+        return {"key": key, "status": "error", "error": repr(e),
+                "label": manifest.get("label")}
+    return {"key": key, "status": "warmed",
+            "ms": round((time.perf_counter() - t0) * 1e3, 1),
+            "bytes": len(payload), "label": manifest.get("label")}
+
+
+def run_warmup(cache_dir: str, manifest_path: Optional[str] = None,
+               warm_all: bool = False,
+               log=lambda m: print("[aot_warmup]", m, file=sys.stderr,
+                                   flush=True)) -> Dict:
+    import jax
+
+    from mxnet_tpu import aot
+
+    cache = aot.CompileCache(cache_dir, mode="ro")
+    if warm_all:
+        keys = cache.keys()
+    elif manifest_path:
+        keys = aot.WarmupManifest.load(manifest_path).keys()
+        if not keys:
+            log(f"{manifest_path} records no store keys (recorded "
+                "without an armed cache?) — use --all to warm the "
+                "whole cache dir")
+    else:
+        raise ValueError("pass --manifest or --all")
+    t0 = time.perf_counter()
+    results: List[Dict] = []
+    for key in keys:
+        row = warm_key(cache, key)
+        results.append(row)
+        log(f"{row['status']:>7} {key[:12]}… "
+            f"{row.get('label', '')} {row.get('ms', '')}")
+    warmed = sum(1 for r in results if r["status"] == "warmed")
+    return {
+        "metric": "aot_warmup",
+        "value": warmed,
+        "unit": "entries",
+        "cache": os.path.abspath(cache_dir),
+        "manifest": manifest_path,
+        "entries_total": len(keys),
+        "entries_warmed": warmed,
+        "entries_errored": sum(1 for r in results
+                               if r["status"] == "error"),
+        "entries_missing": sum(1 for r in results
+                               if r["status"] == "missing"),
+        "total_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        "device": jax.default_backend(),
+        "results": results,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="AOT-compile mxnet_tpu compile-cache entries ahead "
+                    "of traffic")
+    ap.add_argument("--cache", default=os.environ.get("MXNET_TPU_AOT_CACHE"),
+                    help="compile cache root (default: $MXNET_TPU_AOT_CACHE)")
+    ap.add_argument("--manifest", default=None,
+                    help="warmup manifest recorded by a previous server")
+    ap.add_argument("--all", action="store_true",
+                    help="warm every published entry in the cache")
+    ap.add_argument("--output", default=None,
+                    help="write the JSON summary row here too")
+    args = ap.parse_args(argv)
+    if not args.cache:
+        ap.error("--cache (or MXNET_TPU_AOT_CACHE) is required")
+    if not args.manifest and not args.all:
+        ap.error("pass --manifest <path> or --all")
+    row = run_warmup(args.cache, manifest_path=args.manifest,
+                     warm_all=args.all)
+    if args.output:
+        tmp = args.output + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(row, f, indent=1)
+        os.replace(tmp, args.output)
+    print(json.dumps(row), flush=True)
+    return 0 if row["entries_errored"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

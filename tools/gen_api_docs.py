@@ -70,6 +70,7 @@ MODULES = {
     "mxnet_tpu.monitor": "Monitor / TensorInspector taps",
     "mxnet_tpu.analysis": "tpulint — TPU anti-pattern analyzer "
                           "(jaxpr + AST rules, runtime sentinel)",
+    "mxnet_tpu.aot": "persistent compile cache + ahead-of-time warmup",
 }
 
 
