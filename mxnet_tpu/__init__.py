@@ -82,7 +82,7 @@ def __getattr__(name):
                "recordio": ".recordio", "serialization": ".serialization",
                "misc": ".misc", "torch": ".torch", "serving": ".serving",
                "resilience": ".resilience", "analysis": ".analysis",
-               "aot": ".aot"}
+               "aot": ".aot", "telemetry": ".telemetry"}
     if name in targets:
         expected = importlib.util.resolve_name(targets[name], __name__)
         try:

@@ -72,12 +72,15 @@ def _count(name: str, delta: float = 1) -> None:
 
     with _stats_lock:
         _counters[name] += delta
-        if profiler.is_running():
-            c = _prof_counters.get(name)
-            if c is None:
-                c = _prof_counters[name] = profiler.Counter(
-                    name=f"aot.{name}")
-            c.increment(delta)
+        # re-registered into the telemetry registry (gauge ``aot_<name>``
+        # via the registry-backed profiler.Counter): the exposition sees
+        # AOT traffic whether or not the profiler runs; the chrome
+        # counter-event stream still gates on profiler state inside
+        c = _prof_counters.get(name)
+        if c is None:
+            c = _prof_counters[name] = profiler.Counter(
+                name=f"aot.{name}")
+        c.increment(delta)
 
 
 def stats() -> Dict[str, float]:

@@ -241,11 +241,14 @@ class DevicePrefetch:
     parallel mesh without a gather-then-scatter hop. Rank-0 leaves are
     replicated (a ``PartitionSpec`` cannot split a scalar).
 
-    Instrumentation (``.stats``, mirrored into ``mx.profiler`` counters
-    ``io_prefetch_depth`` / ``io_prefetch_starved_ms`` /
+    Instrumentation (``.stats``, mirrored into the telemetry registry as
+    gauges ``io_prefetch_depth`` / ``io_prefetch_starved_ms`` /
     ``io_prefetch_bytes``): queue depth at each consume, cumulative time
     the CONSUMER spent waiting on an empty queue (the starved-step
-    attribution io_bench/train_bench report), and bytes staged.
+    attribution io_bench/train_bench report), and bytes staged. Each
+    empty-queue wait is also attributed to the enclosing
+    ``telemetry.step`` timeline's ``input_starved`` bucket, so a starved
+    step says WHERE it starved in the step trace itself.
 
     Feeder failures surface in the consumer typed through the resilience
     classifier (:class:`~mxnet_tpu.base.TransientError` /
@@ -341,16 +344,23 @@ class DevicePrefetch:
 
     def _record(self, waited_s: float):
         self._starved_s += waited_s
+        if waited_s > 0.0:
+            # attribute the consumer's empty-queue wait to the current
+            # step timeline's input-starved bucket (no-op when the loop
+            # isn't stepped — one thread-local read)
+            from ..telemetry import tracing
+            tracing.attribute("input_starved", waited_s)
         from .. import profiler
-        if profiler.is_running():
-            if self._counters is None:
-                self._counters = (
-                    profiler.Counter(name="io_prefetch_depth"),
-                    profiler.Counter(name="io_prefetch_starved_ms"),
-                    profiler.Counter(name="io_prefetch_bytes"))
-            self._counters[0].set_value(self._q.qsize())
-            self._counters[1].set_value(round(self._starved_s * 1e3, 3))
-            self._counters[2].set_value(self._bytes_staged)
+        if self._counters is None:
+            self._counters = (
+                profiler.Counter(name="io_prefetch_depth"),
+                profiler.Counter(name="io_prefetch_starved_ms"),
+                profiler.Counter(name="io_prefetch_bytes"))
+        # registry-backed gauges: live whether or not the profiler runs
+        # (the chrome counter stream still gates on profiler state)
+        self._counters[0].set_value(self._q.qsize())
+        self._counters[1].set_value(round(self._starved_s * 1e3, 3))
+        self._counters[2].set_value(self._bytes_staged)
 
     @property
     def stats(self) -> dict:

@@ -8,19 +8,29 @@ traces for device-side detail) plus our own host-side op timeline: the
 dispatch layer calls :func:`record_op` around every eager op when profiling
 is on, mirroring how the reference engine times every OprBlock
 (``threaded_engine.h:85``) without operator cooperation.
+
+The event store and counters are **no longer private**: op spans land in
+the process trace ring (:func:`mxnet_tpu.telemetry.tracing.buffer`) —
+one merged timeline with the telemetry step spans — and every
+:class:`Counter` re-registers as a gauge in the
+:mod:`mxnet_tpu.telemetry` metrics registry, so the Prometheus/JSON
+exposition sees ``serving.queue_depth`` / ``aot.aot_hits`` / the
+``resilience.*`` counters without the profiler running. ``dump()``
+therefore writes the merged timeline, atomically (tmp → ``os.replace``).
 """
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import jax
 
 from .base import safe_devices
+from .telemetry import registry as _registry
+from .telemetry import tracing as _tracing
 
 __all__ = [
     "set_config",
@@ -48,7 +58,10 @@ _config = {
     "aggregate_stats": False,
 }
 _state = "stop"
-_events: List[dict] = []
+# the process trace ring (shared with telemetry step spans; bounded —
+# the old private list grew without limit). len()/append keep working
+# for code that reaches in.
+_events = _tracing.buffer()
 _agg: Dict[str, List[float]] = defaultdict(list)
 _agg_mem: Dict[str, int] = {}
 _jax_tracing = False
@@ -56,7 +69,8 @@ _jax_tracing = False
 
 def set_config(**kwargs):
     """reference python/mxnet/profiler.py:66"""
-    _config.update(kwargs)
+    with _lock:
+        _config.update(kwargs)
 
 
 def set_state(state_: str = "stop", profile_process: str = "worker"):
@@ -143,19 +157,11 @@ def record_op(name: str, dur_s: float, cat: str = "operator"):
     """Called by the dispatch layer per eager op while profiling."""
     ts = time.perf_counter() * 1e6
     mem = _mem_in_use()
+    # span into the shared ring (its own lock); aggregates under ours
+    _tracing.emit_complete(
+        name, ts - dur_s * 1e6, dur_s * 1e6, cat=cat,
+        args={"bytes_in_use": mem} if mem else None)
     with _lock:
-        ev = {
-            "name": name,
-            "cat": cat,
-            "ph": "X",
-            "ts": ts - dur_s * 1e6,
-            "dur": dur_s * 1e6,
-            "pid": os.getpid(),
-            "tid": threading.get_ident() % 10000,
-        }
-        if mem:
-            ev["args"] = {"bytes_in_use": mem}
-        _events.append(ev)
         _agg[name].append(dur_s * 1e3)
         if mem:
             _agg_mem[name] = max(_agg_mem.get(name, 0), mem)
@@ -163,29 +169,35 @@ def record_op(name: str, dur_s: float, cat: str = "operator"):
 
 def dumps(reset: bool = False) -> str:
     """Aggregate per-op stats table (reference aggregate_stats.cc), with a
-    peak device-memory column when the backend reports allocator stats."""
-    lines = [f"{'Name':<30}{'Calls':>8}{'Total(ms)':>12}{'Mean(ms)':>12}"
-             f"{'Max(ms)':>12}{'PeakMem(MB)':>13}"]
+    peak device-memory column when the backend reports allocator stats.
+    Thread-safe against concurrent :func:`record_op` callers (serving
+    worker + feeder threads): the table renders from one consistent
+    snapshot, and ``reset=True`` clears exactly what was rendered."""
     with _lock:
-        for name, times in sorted(_agg.items(), key=lambda kv: -sum(kv[1])):
-            peak = _agg_mem.get(name, 0) / (1024 * 1024)
-            lines.append(
-                f"{name:<30}{len(times):>8}{sum(times):>12.3f}"
-                f"{sum(times) / len(times):>12.3f}{max(times):>12.3f}"
-                f"{peak:>13.2f}"
-            )
+        agg = {name: list(times) for name, times in _agg.items()}
+        agg_mem = dict(_agg_mem)
         if reset:
             _agg.clear()
             _agg_mem.clear()
+    lines = [f"{'Name':<30}{'Calls':>8}{'Total(ms)':>12}{'Mean(ms)':>12}"
+             f"{'Max(ms)':>12}{'PeakMem(MB)':>13}"]
+    for name, times in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
+        peak = agg_mem.get(name, 0) / (1024 * 1024)
+        lines.append(
+            f"{name:<30}{len(times):>8}{sum(times):>12.3f}"
+            f"{sum(times) / len(times):>12.3f}{max(times):>12.3f}"
+            f"{peak:>13.2f}"
+        )
     return "\n".join(lines)
 
 
 def dump(finished: bool = True, profile_process: str = "worker"):
-    """Write chrome://tracing JSON (reference profiler.h:432)."""
+    """Write chrome://tracing JSON (reference profiler.h:432) — the
+    merged ring (op spans + telemetry step/serving/resilience spans),
+    published atomically."""
     with _lock:
-        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
-    with open(_config["filename"], "w") as f:
-        json.dump(payload, f)
+        filename = _config["filename"]
+    _tracing.dump_chrome(filename)
 
 
 class Scope:
@@ -220,31 +232,44 @@ class Frame(Task):
 
 
 class Counter:
-    """reference ProfileCounter profiler.h:557"""
+    """reference ProfileCounter profiler.h:557 — re-registered as a
+    gauge in the telemetry registry (sanitized name: dots become
+    underscores), so the value is scrapeable whether or not the profiler
+    runs; the chrome counter-event stream still only flows while
+    profiling. Same-named counters share one registry series
+    (process-wide gauge semantics: last write wins).
+
+    Thread-safe: ``increment``/``decrement`` are atomic
+    read-modify-writes (concurrent serving worker + feeder threads used
+    to lose updates)."""
 
     def __init__(self, domain=None, name="counter", value=0):
         self.name = name
+        self._lock = threading.Lock()
+        self._gauge = _registry.get_registry().gauge(
+            _registry.sanitize_name(name),
+            "profiler counter (mx.profiler.Counter)")
         self.value = value
+        if value:
+            self._gauge.set(value)
+
+    def _set(self, v):
+        self.value = v
+        self._gauge.set(v)
+        if is_running():
+            _tracing.emit_counter(self.name, v)
 
     def set_value(self, v):
-        self.value = v
-        if is_running():
-            with _lock:
-                _events.append(
-                    {
-                        "name": self.name,
-                        "ph": "C",
-                        "ts": time.perf_counter() * 1e6,
-                        "pid": os.getpid(),
-                        "args": {"value": v},
-                    }
-                )
+        with self._lock:
+            self._set(v)
 
     def increment(self, delta=1):
-        self.set_value(self.value + delta)
+        with self._lock:
+            self._set(self.value + delta)
 
     def decrement(self, delta=1):
-        self.set_value(self.value - delta)
+        with self._lock:
+            self._set(self.value - delta)
 
 
 class Marker:
@@ -253,16 +278,7 @@ class Marker:
 
     def mark(self, scope="process"):
         if is_running():
-            with _lock:
-                _events.append(
-                    {
-                        "name": self.name,
-                        "ph": "i",
-                        "ts": time.perf_counter() * 1e6,
-                        "pid": os.getpid(),
-                        "s": "p",
-                    }
-                )
+            _tracing.emit_instant(self.name, cat="marker")
 
 
 class Domain:

@@ -57,6 +57,7 @@ SITES = (
     "aot.read",           # CompileCache entry lookup (before the read)
     "aot.write",          # CompileCache publish, payload staged, pre-rename
     "aot.deserialize",    # cached_jit payload deserialize on a store hit
+    "telemetry.export",   # telemetry exporter exposition (file write/HTTP)
 )
 
 
@@ -159,7 +160,17 @@ def _visit(name: str, rules: List[_Rule], ctx: dict) -> None:
             # pod-eviction semantics: no atexit, no buffers flushed. 137
             # = 128+SIGKILL, the exit code an OOM-killed / preempted
             # container reports, so harnesses can recognize chaos kills.
+            # The one exception to "no flushing": the flight recorder
+            # writes its post-mortem synchronously BEFORE the exit (a
+            # real eviction can't do this, but every chaos drill leaving
+            # an analyzable artifact is the point of the recorder).
             _emit_profiler(name, "kill", 0.0)
+            try:
+                from ..telemetry import flight
+
+                flight.try_dump(f"chaos_kill:{name}")
+            except Exception:  # noqa: BLE001 — the kill must proceed
+                pass
             os._exit(137)
         # 'raise'
         _emit_profiler(name, "raise", 0.0)

@@ -107,6 +107,25 @@ def is_transient(exc: BaseException) -> bool:
     return classify(exc) == TRANSIENT
 
 
+def _flight_dump(reason: str, exc: BaseException = None) -> None:
+    """Leave a post-mortem artifact for a classified-fatal fault (the
+    telemetry flight recorder; no-op while unarmed, never raises).
+    Control-flow exceptions are not faults and never dump — that means
+    both the BaseException-only family (KeyboardInterrupt, SystemExit,
+    GeneratorExit) and the Exception-subclass iteration protocol
+    (StopIteration leaking from a bare next() on exhaustion)."""
+    if exc is not None and (
+            not isinstance(exc, Exception)
+            or isinstance(exc, (StopIteration, StopAsyncIteration))):
+        return
+    try:
+        from ..telemetry import flight
+
+        flight.try_dump(reason)
+    except Exception:  # noqa: BLE001 — observability on a failure path
+        pass
+
+
 class RetriesExhausted(MXNetError):
     """All attempts failed with transient errors. ``__cause__`` carries
     the last one; ``attempts`` how many were made."""
@@ -177,6 +196,7 @@ def call_with_retry(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
             return fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001 — classified below
             if policy.classify(e) != TRANSIENT:
+                _flight_dump(f"fatal:{type(e).__name__}", e)
                 raise
             last = e
             if attempt >= policy.max_attempts:
@@ -188,6 +208,7 @@ def call_with_retry(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             policy.sleep(delay)
+    _flight_dump("retries_exhausted", last)
     raise RetriesExhausted(
         f"{getattr(fn, '__name__', 'call')} failed after {attempt} "
         f"attempt(s); last transient error: {last!r}", attempt) from last

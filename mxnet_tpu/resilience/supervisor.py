@@ -22,6 +22,7 @@ Estimator` via :meth:`Supervisor.fit`, or any pure step function via
 """
 from __future__ import annotations
 
+import os
 import signal
 import threading
 from typing import Any, Callable, Dict, Optional
@@ -30,7 +31,10 @@ import numpy as onp
 
 from .. import profiler
 from ..base import Preempted
-from .retry import RetriesExhausted, RetryPolicy, TRANSIENT
+from ..telemetry import flight as _flight
+from ..telemetry import tracing as _tracing
+from .retry import (RetriesExhausted, RetryPolicy, TRANSIENT,
+                    _flight_dump)
 
 __all__ = ["Supervisor"]
 
@@ -79,12 +83,19 @@ class Supervisor:
             name: profiler.Counter(name=f"resilience.{name}")
             for name in self._counters
         }
+        # every resilience drill leaves a post-mortem artifact: point
+        # the recorder's low-precedence default at THIS supervisor's
+        # <checkpoint_dir>/flight (latest constructed wins; an explicit
+        # arm or MXNET_TPU_FLIGHT_DIR always takes precedence)
+        _flight.recorder.arm_default(os.path.join(directory, "flight"))
 
     # -- counters ---------------------------------------------------------
     def _count(self, name: str) -> None:
         self._counters[name] += 1
-        if profiler.is_running():
-            self._prof[name].increment()
+        # registry-backed gauge: the telemetry exposition sees recovery
+        # traffic whether or not the profiler runs (the chrome counter
+        # stream still gates on profiler state inside)
+        self._prof[name].increment()
 
     def stats(self) -> Dict[str, int]:
         return dict(self._counters)
@@ -110,6 +121,7 @@ class Supervisor:
         if self._sigterm.is_set():
             self._count("preemptions")
             save_fn()
+            _flight.try_dump("sigterm")
             raise Preempted(
                 "SIGTERM received (preemption notice): final checkpoint "
                 "saved; resume from the same directory to continue")
@@ -151,6 +163,9 @@ class Supervisor:
                     raise  # checkpointed exit — never retried in-process
                 except BaseException as e:  # noqa: BLE001 — classified
                     if self.policy.classify(e) != TRANSIENT:
+                        # the shared filter: control-flow exceptions
+                        # (StopIteration included) never dump
+                        _flight_dump(f"fatal:{type(e).__name__}", e)
                         raise
                     self._count("faults")
                     if self._counters["saves"] > last_fault_saves >= 0:
@@ -159,6 +174,7 @@ class Supervisor:
                     last_fault_saves = self._counters["saves"]
                     attempt += 1
                     if attempt >= self.policy.max_attempts:
+                        _flight_dump("retries_exhausted", e)
                         raise RetriesExhausted(
                             f"training made no progress through "
                             f"{attempt} consecutive transient fault(s); "
@@ -212,19 +228,21 @@ class Supervisor:
                 return
             # steps exist: an all-corrupt directory must raise LOUDLY
             # here, not silently restart on warm in-memory params
-            tree = self.manager.restore()
-            estimator.net.load_dict(
-                {k: _as_mx(v) for k, v in tree["params"].items()})
-            if "opt" in tree:
-                self._restore_trainer(estimator.trainer, tree["opt"])
-            elif estimator.trainer is not None:
-                # checkpoint predates the first optimizer step (baseline
-                # snapshot): warm in-memory momentum/etc. must reset too,
-                # or the replayed batches diverge from a fresh run
-                estimator.trainer.reset_states()
-            prog = tree["progress"]
-            state.update({k: int(prog[k]) for k in
-                          ("epoch", "batch", "global_batch")})
+            with _tracing.span("supervisor.restore", cat="resilience"):
+                tree = self.manager.restore()
+                estimator.net.load_dict(
+                    {k: _as_mx(v) for k, v in tree["params"].items()})
+                if "opt" in tree:
+                    self._restore_trainer(estimator.trainer, tree["opt"])
+                elif estimator.trainer is not None:
+                    # checkpoint predates the first optimizer step
+                    # (baseline snapshot): warm in-memory momentum/etc.
+                    # must reset too, or the replayed batches diverge
+                    # from a fresh run
+                    estimator.trainer.reset_states()
+                prog = tree["progress"]
+                state.update({k: int(prog[k]) for k in
+                              ("epoch", "batch", "global_batch")})
             state["resumed"] = True
             self._count("restores")
 
@@ -238,16 +256,34 @@ class Supervisor:
             # retry via the classifier instead of killing the run.
             self._prewarm_trainer(estimator.trainer)
 
+        _end = object()  # iterator-exhaustion sentinel
+
         def run_once():
             start_epoch, start_batch = state["epoch"], state["batch"]
             for epoch in range(start_epoch, epochs):
                 state["epoch"] = epoch
-                for bi, batch in enumerate(train_data):
-                    if epoch == start_epoch and bi < start_batch:
-                        continue  # replayed data before the cursor
-                    data, label = batch[0], batch[1]
-                    estimator.fit_batch(data, label, batch_axis)
-                    state["batch"] = bi + 1
+                it = iter(train_data)
+                bi = 0
+                # replayed data before the cursor: skipped without steps
+                while epoch == start_epoch and bi < start_batch:
+                    if next(it, _end) is _end:
+                        break
+                    bi += 1
+                while True:
+                    # step timeline: compile/device/input-starved/host
+                    # attribution per supervised batch — the spans a
+                    # flight-recorder dump replays after a fault. The
+                    # step opens BEFORE the data pull so a prefetcher's
+                    # starved wait lands in its input_starved bucket.
+                    with _tracing.step("supervised_train", bi) as st:
+                        batch = next(it, _end)
+                        if batch is _end:
+                            st.cancel()  # the empty pull is not a step
+                            break
+                        data, label = batch[0], batch[1]
+                        estimator.fit_batch(data, label, batch_axis)
+                    bi += 1
+                    state["batch"] = bi
                     state["global_batch"] += 1
                     self._check_preempted(save)
                     if state["batch"] % self.save_every == 0:
@@ -337,15 +373,17 @@ class Supervisor:
             if self.manager.latest_step() is None:
                 cursor.update(i=0, state=init_state)  # nothing saved yet
                 return
-            tree = self.manager.restore()  # all-corrupt raises loudly
-            cursor.update(i=int(tree["progress"]["i"]),
-                          state=tree["state"])
+            with _tracing.span("supervisor.restore", cat="resilience"):
+                tree = self.manager.restore()  # all-corrupt raises loudly
+                cursor.update(i=int(tree["progress"]["i"]),
+                              state=tree["state"])
             self._count("restores")
 
         def run_once():
             while cursor["i"] < n_steps:
                 i = cursor["i"]
-                cursor["state"] = step_fn(cursor["state"], i)
+                with _tracing.step("supervised_steps", i):
+                    cursor["state"] = step_fn(cursor["state"], i)
                 cursor["i"] = i + 1
                 self._check_preempted(save)
                 if cursor["i"] % self.save_every == 0:

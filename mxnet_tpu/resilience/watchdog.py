@@ -43,6 +43,9 @@ def run_with_watchdog(fn: Callable, timeout_s: float, *args,
     t.start()
     t.join(timeout_s)
     if t.is_alive():
+        from .retry import _flight_dump
+
+        _flight_dump(f"stall:{label}")
         raise StallDetected(
             f"{label} did not complete within {timeout_s:g}s — backend "
             "hang suspected (the attempt is abandoned; a retry may "

@@ -229,6 +229,19 @@ def run_serving_bench(model: str = "alexnet", image_size: int = 224,
         finally:
             engine2.close()
     speedup = conc_rps / seq_rps if seq_rps else 0.0
+    # online efficiency gauges: the row's throughput also lands in the
+    # telemetry registry (telemetry_examples_per_s / telemetry_vs_banked
+    # against the banked row for this metric), so a scraper watching a
+    # serving process sees the same number the bench banks
+    try:
+        from mxnet_tpu import telemetry
+
+        efficiency = telemetry.mfu.observe_step(
+            f"serving_{model}", conc_done, conc_dt,
+            device_kind=getattr(jax.devices()[0], "device_kind", ""),
+            banked_metric=f"serving_dynbatch_{model}_c{clients}")
+    except Exception:  # noqa: BLE001 — observability must not fail a row
+        efficiency = None
     row = {
         "metric": f"serving_dynbatch_{model}_c{clients}",
         "value": round(conc_rps, 2),
@@ -256,6 +269,7 @@ def run_serving_bench(model: str = "alexnet", image_size: int = 224,
         "warm_start_ms": (round(warm_start_ms, 1)
                           if warm_start_ms is not None else None),
         "warm_source": warm_source,
+        "efficiency": efficiency,
         "aot": aot_snapshot,
         "device": jax.default_backend(),
         "client_errors": errs[:5],

@@ -1,67 +1,46 @@
-"""Serving metrics: counters + bounded-reservoir histograms.
+"""Serving metrics — a thin façade over the telemetry registry.
 
-Thread-safe, cheap on the hot path (one lock, fixed-size deques), and
-wired into the existing :mod:`mxnet_tpu.profiler` surface: while the
-profiler is running, every executed micro-batch emits a ``serving.batch``
-span (the per-op timeline the dispatch layer uses) and the queue-depth /
-occupancy counters stream as chrome://tracing counter events, so a
-serving process profiled with ``profiler.set_state('run')`` shows the
-batcher's behavior alongside the op timeline.
+The counters and histograms live in the process-wide
+:mod:`mxnet_tpu.telemetry` registry (labelled ``engine="<n>"`` so a
+process hosting several engines exposes them side by side); this module
+keeps the engine-local recording API and the exact ``snapshot()`` /
+``counters()`` shapes the serve_bench rows bank. The former private
+``Histogram`` here was deduplicated into
+:class:`mxnet_tpu.telemetry.registry.Histogram` — the class below is a
+back-compat alias with the old constructor signature.
+
+Timeline: every executed micro-batch lands a ``serving.batch[b<bucket>]``
+span in the shared trace ring (the step-timeline / flight-recorder
+stream); while the profiler runs it additionally feeds the per-op
+aggregate table, and the queue-depth / occupancy gauges stream as
+chrome counter events.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
-from collections import deque
-from typing import Dict, Optional
+from typing import Dict
 
 from .. import profiler
+from ..telemetry import get_registry
+from ..telemetry import tracing as _tracing
+from ..telemetry.registry import Histogram as _TelemetryHistogram
 
 __all__ = ["Histogram", "ServingMetrics"]
 
 
-class Histogram:
-    """Streaming summary: exact count/sum/min/max over all observations
-    plus a bounded reservoir (the most recent ``cap`` values) for
-    quantiles. Recency-biased quantiles are the serving-appropriate
-    choice — p99 should describe the current regime, not the warmup."""
-
-    __slots__ = ("count", "total", "min", "max", "_recent")
+class Histogram(_TelemetryHistogram):
+    """Back-compat: the pre-telemetry serving histogram (bounded
+    recency reservoir for quantiles). Now the shared telemetry
+    implementation; constructor keeps the old ``Histogram(cap)``
+    signature."""
 
     def __init__(self, cap: int = 4096):
-        self.count = 0
-        self.total = 0.0
-        self.min: Optional[float] = None
-        self.max: Optional[float] = None
-        self._recent: deque = deque(maxlen=cap)
+        super().__init__(cap=cap)
 
-    def observe(self, v: float) -> None:
-        self.count += 1
-        self.total += v
-        self.min = v if self.min is None else min(self.min, v)
-        self.max = v if self.max is None else max(self.max, v)
-        self._recent.append(v)
 
-    def mean(self) -> float:
-        return self.total / self.count if self.count else 0.0
-
-    def quantile(self, q: float) -> float:
-        if not self._recent:
-            return 0.0
-        vals = sorted(self._recent)
-        idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
-        return vals[idx]
-
-    def summary(self) -> Dict[str, float]:
-        return {
-            "count": self.count,
-            "mean": round(self.mean(), 4),
-            "min": round(self.min, 4) if self.min is not None else 0.0,
-            "max": round(self.max, 4) if self.max is not None else 0.0,
-            "p50": round(self.quantile(0.50), 4),
-            "p90": round(self.quantile(0.90), 4),
-            "p99": round(self.quantile(0.99), 4),
-        }
+_engine_seq = itertools.count()
 
 
 class ServingMetrics:
@@ -73,68 +52,105 @@ class ServingMetrics:
     Histograms: request ``latency_ms``, per-batch ``occupancy`` (real
     samples per executed batch), ``pad_waste`` (padded-but-dead fraction
     of the bucket), ``queue_depth`` (at admission).
+
+    Registry series (scrapeable via ``telemetry.prometheus_text()``):
+    ``serving_events_total{engine,event}``,
+    ``serving_latency_ms{engine}``, ``serving_occupancy{engine}``,
+    ``serving_pad_waste{engine}``, ``serving_queue_depth_hist{engine}``,
+    plus the live-level gauges ``serving_queue_depth`` /
+    ``serving_batch_occupancy`` (profiler counter stream).
     """
 
+    _EVENTS = ("submitted", "completed", "failed",
+               "shed_overload", "shed_deadline", "batches", "compiles")
+
     def __init__(self):
+        reg = get_registry()
+        self.engine_id = str(next(_engine_seq))
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {
-            "submitted": 0, "completed": 0, "failed": 0,
-            "shed_overload": 0, "shed_deadline": 0,
-            "batches": 0, "compiles": 0,
-        }
-        self.latency_ms = Histogram()
-        self.occupancy = Histogram()
-        self.pad_waste = Histogram()
-        self.queue_depth = Histogram()
-        # profiler counter streams (emit only while profiling runs)
+        self._events = reg.counter(
+            "serving_events_total",
+            "Serving request/batch lifecycle events",
+            ("engine", "event"))
+        self._counters = {
+            e: self._events.labels(engine=self.engine_id, event=e)
+            for e in self._EVENTS}
+        eng = {"engine": self.engine_id}
+        self.latency_ms = reg.histogram(
+            "serving_latency_ms", "Request latency, admission to result "
+            "(ms)", ("engine",)).labels(**eng)
+        self.occupancy = reg.histogram(
+            "serving_occupancy",
+            "Real samples per executed micro-batch",
+            ("engine",)).labels(**eng)
+        self.pad_waste = reg.histogram(
+            "serving_pad_waste",
+            "Padded-but-dead fraction of the bucket",
+            ("engine",)).labels(**eng)
+        self.queue_depth = reg.histogram(
+            "serving_queue_depth_hist", "Queue depth at admission",
+            ("engine",)).labels(**eng)
+        # live-level gauges (profiler.Counter is registry-backed and
+        # streams chrome counter events while the profiler runs)
         self._prof_depth = profiler.Counter(name="serving.queue_depth")
         self._prof_occ = profiler.Counter(name="serving.batch_occupancy")
 
     # -- recording --------------------------------------------------------
     def count(self, name: str, delta: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + delta
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.get(name)
+                if c is None:
+                    c = self._events.labels(engine=self.engine_id,
+                                            event=name)
+                    self._counters[name] = c
+        c.inc(delta)
 
     def observe_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self.queue_depth.observe(float(depth))
-        if profiler.is_running():
-            self._prof_depth.set_value(depth)
+        self.queue_depth.observe(float(depth))
+        self._prof_depth.set_value(depth)
 
     def observe_batch(self, n_real: int, bucket: int, exec_s: float) -> None:
-        """One executed micro-batch: occupancy + pad waste + profiler span."""
-        with self._lock:
-            self._counters["batches"] += 1
-            self.occupancy.observe(float(n_real))
-            self.pad_waste.observe((bucket - n_real) / float(bucket))
+        """One executed micro-batch: occupancy + pad waste + a span in
+        the shared timeline."""
+        self._counters["batches"].inc()
+        self.occupancy.observe(float(n_real))
+        self.pad_waste.observe((bucket - n_real) / float(bucket))
         if profiler.is_running():
+            # profiled runs additionally feed the per-op aggregate table
             profiler.record_op(f"serving.batch[b{bucket}]", exec_s,
                                cat="serving")
-            self._prof_occ.set_value(n_real)
+        else:
+            _tracing.emit_complete(
+                f"serving.batch[b{bucket}]",
+                _tracing.now_us() - exec_s * 1e6, exec_s * 1e6,
+                cat="serving", args={"occupancy": n_real,
+                                     "bucket": bucket})
+        self._prof_occ.set_value(n_real)
 
     def observe_done(self, latency_s: float, ok: bool, n: int = 1) -> None:
-        with self._lock:
-            self._counters["completed" if ok else "failed"] += n
-            if ok:
-                self.latency_ms.observe(latency_s * 1e3)
+        self._counters["completed" if ok else "failed"].inc(n)
+        if ok:
+            self.latency_ms.observe(latency_s * 1e3)
 
     # -- reading ----------------------------------------------------------
     def counters(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self._counters)
+            items = list(self._counters.items())
+        return {name: int(c.value) for name, c in items}
 
     def snapshot(self) -> Dict:
         """One JSON-friendly dict with everything — the shape the bench
         harness banks and ``InferenceEngine.stats()`` returns."""
-        with self._lock:
-            snap = {
-                "counters": dict(self._counters),
-                "latency_ms": self.latency_ms.summary(),
-                "batch_occupancy": self.occupancy.summary(),
-                "pad_waste": self.pad_waste.summary(),
-                "queue_depth": self.queue_depth.summary(),
-                "ts_unix": time.time(),
-            }
+        snap = {
+            "counters": self.counters(),
+            "latency_ms": self.latency_ms.summary(),
+            "batch_occupancy": self.occupancy.summary(),
+            "pad_waste": self.pad_waste.summary(),
+            "queue_depth": self.queue_depth.summary(),
+            "ts_unix": time.time(),
+        }
         c = snap["counters"]
         shed = c["shed_overload"] + c["shed_deadline"]
         denom = c["submitted"] + c["shed_overload"]

@@ -1,0 +1,71 @@
+"""``mxnet_tpu.telemetry`` — the unified observability layer.
+
+PRs 1–5 each grew their own telemetry: the profiler kept a private
+chrome-trace list, serving metrics reimplemented a histogram, and
+io/aot/resilience pushed ad-hoc counters with no shared export. This
+package is the one substrate they all re-register into:
+
+- :mod:`.registry` — process-wide Counter/Gauge/Histogram families with
+  labels; JSON snapshot + Prometheus text exposition
+  (:func:`snapshot` / :func:`prometheus_text`);
+- :mod:`.tracing` — one bounded trace ring (shared with
+  ``mx.profiler``), span API, and **step timelines** that attribute each
+  step's wall time into compile / device / input-starved / host buckets;
+  :func:`dump_chrome` writes a Perfetto-loadable ``trace_event`` JSON;
+- :mod:`.exporter` — optional background file/HTTP exposition behind
+  ``MXNET_TPU_TELEMETRY=`` (degrades to warn-once, never raises into
+  the training loop; chaos site ``telemetry.export``);
+- :mod:`.flight` — the flight recorder: recent spans + metric deltas
+  dumped atomically on stalls, fatal faults, SIGTERM and chaos kills;
+- :mod:`.mfu` — online efficiency gauges: per-step MFU, achieved vs the
+  banked ``benchmark/results_*.json`` roofline, HBM-utilization
+  estimate.
+
+See ``docs/observability.md`` for the metric catalog and trace how-to.
+"""
+from __future__ import annotations
+
+from . import exporter, flight, mfu, tracing  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    sanitize_name,
+)
+from .tracing import (  # noqa: F401
+    BUCKETS,
+    StepTimeline,
+    attribute,
+    buffer,
+    chrome_trace,
+    current_step,
+    dump_chrome,
+    phase_if_active,
+    span,
+    step,
+)
+
+__all__ = [
+    "BUCKETS", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "StepTimeline", "attribute", "buffer", "chrome_trace", "current_step",
+    "dump_chrome", "exporter", "flight", "get_registry", "mfu",
+    "phase_if_active", "prometheus_text", "sanitize_name", "snapshot",
+    "span", "step", "tracing",
+]
+
+
+def snapshot():
+    """JSON-friendly snapshot of every registered metric."""
+    return get_registry().snapshot()
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition of every registered metric."""
+    return get_registry().prometheus_text()
+
+
+# the env-armed background exporter starts with the package (idempotent,
+# None when MXNET_TPU_TELEMETRY is unset)
+exporter.start_from_env()

@@ -1,0 +1,243 @@
+"""Background metrics exporter behind ``MXNET_TPU_TELEMETRY=``.
+
+Grammar (unset ⇒ no exporter thread, zero cost):
+
+- ``<dir>``             — write ``metrics.prom`` (Prometheus text) and
+  ``metrics.json`` (registry snapshot) into ``<dir>`` every 10 s;
+- ``<dir>:<period_s>``  — same with an explicit period;
+- ``http:<port>``       — serve ``GET /metrics`` (Prometheus text) and
+  ``GET /metrics.json`` from a daemon thread (port ``0`` = ephemeral,
+  read back via ``Exporter.port``).
+
+Failure contract: exporting is observability, never control — every
+export attempt passes the ``telemetry.export`` chaos site and any
+fault (injected or real: full disk, dead port) degrades to ONE warning
+per process; the loop keeps trying next period and the training/serving
+loop never sees the error. File writes are atomic (tmp →
+``os.replace``) so a scraper never reads a torn exposition.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import warnings
+from typing import Dict, Optional
+
+from .registry import get_registry
+
+__all__ = ["Exporter", "parse_spec", "export_files", "start_from_env",
+           "get_exporter", "stop"]
+
+_DEFAULT_PERIOD_S = 10.0
+
+
+def parse_spec(spec: str) -> Optional[Dict]:
+    """Parse ``MXNET_TPU_TELEMETRY``. Returns ``{"mode": "file", "dir",
+    "period_s"}`` / ``{"mode": "http", "port"}`` / None (unset/off).
+    Malformed values warn and disable (a typo'd knob must not kill the
+    process at import)."""
+    spec = (spec or "").strip()
+    if not spec or spec.lower() == "off":
+        return None
+    if spec.startswith("http:"):
+        try:
+            return {"mode": "http", "port": int(spec[5:])}
+        except ValueError:
+            warnings.warn(
+                f"MXNET_TPU_TELEMETRY={spec!r}: http mode needs a port "
+                "(http:<port>); exporter disabled", RuntimeWarning,
+                stacklevel=2)
+            return None
+    d, sep, tail = spec.rpartition(":")
+    if sep and d:
+        try:
+            return {"mode": "file", "dir": d, "period_s": float(tail)}
+        except ValueError:
+            pass  # the ':' belongs to the path (e.g. C:\...) — fall through
+    return {"mode": "file", "dir": spec, "period_s": _DEFAULT_PERIOD_S}
+
+
+def _atomic_write(path: str, text: str) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def export_files(directory: str) -> None:
+    """One synchronous exposition into ``directory`` (the exporter
+    thread's body; benches call it for a final flush). Passes the
+    ``telemetry.export`` chaos site; raises on failure — callers that
+    must not fail go through :meth:`Exporter._export_guarded`."""
+    from ..resilience import chaos
+
+    chaos.site("telemetry.export", directory=directory)
+    reg = get_registry()
+    os.makedirs(directory, exist_ok=True)
+    _atomic_write(os.path.join(directory, "metrics.prom"),
+                  reg.prometheus_text())
+    _atomic_write(os.path.join(directory, "metrics.json"),
+                  json.dumps(reg.snapshot()))
+
+
+class Exporter:
+    """The background exporter (one per process, :func:`start_from_env`)."""
+
+    def __init__(self, config: Dict):
+        self.config = dict(config)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._server = None
+        self._warned = False
+        self.exports = 0          # successful expositions (tests)
+        self.failures = 0
+        self.port: Optional[int] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Exporter":
+        if self.config["mode"] == "http":
+            self._start_http()
+        else:
+            # first exposition NOW, not a full period from now — a
+            # process shorter than the period must still leave files
+            self._export_guarded()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="mxnet_tpu-telemetry-exporter")
+            self._thread.start()
+        return self
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_flush and self.config["mode"] == "file":
+            self._export_guarded()
+
+    # -- file mode --------------------------------------------------------
+    def _export_guarded(self) -> bool:
+        """One exposition that NEVER raises: a fault (chaos-injected or
+        real) warns once per process and the loop carries on — the
+        exporter must degrade, not kill anything."""
+        try:
+            export_files(self.config["dir"])
+            self.exports += 1
+            return True
+        except BaseException as e:  # noqa: BLE001 — degrade to warn-once
+            self.failures += 1
+            if not self._warned:
+                self._warned = True
+                warnings.warn(
+                    f"telemetry exporter: exposition failed ({e!r}); "
+                    "will keep retrying silently every period",
+                    RuntimeWarning, stacklevel=2)
+            return False
+
+    def _loop(self) -> None:
+        period = max(0.05, float(self.config.get("period_s",
+                                                 _DEFAULT_PERIOD_S)))
+        while not self._stop.wait(period):
+            self._export_guarded()
+
+    # -- http mode --------------------------------------------------------
+    def _start_http(self) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server contract
+                try:
+                    from ..resilience import chaos
+                    chaos.site("telemetry.export", endpoint=self.path)
+                    reg = get_registry()
+                    if self.path.startswith("/metrics.json"):
+                        body = json.dumps(reg.snapshot()).encode()
+                        ctype = "application/json"
+                    elif self.path.startswith("/metrics"):
+                        body = reg.prometheus_text().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    else:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    exporter.exports += 1
+                except BaseException as e:  # noqa: BLE001 — warn once
+                    exporter.failures += 1
+                    if not exporter._warned:
+                        exporter._warned = True
+                        warnings.warn(
+                            f"telemetry exporter: /metrics failed "
+                            f"({e!r})", RuntimeWarning, stacklevel=2)
+                    try:
+                        self.send_error(500)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        self._server = ThreadingHTTPServer(
+            ("127.0.0.1", int(self.config["port"])), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="mxnet_tpu-telemetry-http")
+        self._thread.start()
+
+
+_active: Optional[Exporter] = None
+_lock = threading.Lock()
+
+
+def get_exporter() -> Optional[Exporter]:
+    return _active
+
+
+def start_from_env() -> Optional[Exporter]:
+    """Start the process exporter from ``MXNET_TPU_TELEMETRY`` (idempotent;
+    called at ``mxnet_tpu.telemetry`` import)."""
+    global _active
+    with _lock:
+        if _active is not None:
+            return _active
+        cfg = parse_spec(os.environ.get("MXNET_TPU_TELEMETRY", ""))
+        if cfg is None:
+            return None
+        try:
+            _active = Exporter(cfg).start()
+            if cfg["mode"] == "file":
+                import atexit
+                # daemon thread dies with the process: flush the final
+                # window so the last expositions reflect the end state
+                atexit.register(stop)
+        except Exception as e:  # noqa: BLE001 — observability, not control
+            warnings.warn(
+                f"telemetry exporter failed to start ({e!r}); running "
+                "without exposition", RuntimeWarning, stacklevel=2)
+            _active = None
+        return _active
+
+
+def stop(final_flush: bool = True) -> None:
+    """Stop the process exporter (tests; atexit not required — the
+    thread is a daemon and file writes are atomic)."""
+    global _active
+    with _lock:
+        if _active is not None:
+            _active.stop(final_flush)
+            _active = None

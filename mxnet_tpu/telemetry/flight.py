@@ -1,0 +1,201 @@
+"""Flight recorder: a bounded black box dumped atomically on failure.
+
+The recorder itself is just a view over state that is already kept —
+the trace ring's tail (recent spans) and the metrics registry — plus a
+baseline snapshot for deltas. Arming it costs nothing on the hot path;
+a dump is one JSON write published ``tmp -> os.replace`` (the
+CheckpointManager discipline: a crash mid-dump leaves an invisible tmp
+file, never a torn artifact).
+
+Dump triggers (wired in ``mxnet_tpu.resilience``):
+
+- :class:`~mxnet_tpu.base.StallDetected` out of the watchdog,
+- a fault the transient-vs-fatal classifier calls **fatal** (and
+  ``RetriesExhausted``) inside ``Supervisor`` / ``call_with_retry``,
+- SIGTERM (preemption notice) at the Supervisor batch boundary,
+- a chaos ``kill`` fire (``os._exit(137)`` — the dump is written
+  synchronously first, so even the pod-eviction drill leaves a
+  post-mortem artifact).
+
+Armed via ``MXNET_TPU_FLIGHT_DIR=<dir>`` or :func:`arm`;
+``resilience.Supervisor`` arms ``<checkpoint_dir>/flight`` by default so
+every resilience drill leaves an artifact. :func:`try_dump` never
+raises and is a no-op while unarmed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .registry import MetricsRegistry, get_registry
+from .tracing import buffer
+
+__all__ = ["FlightRecorder", "recorder", "arm", "armed", "try_dump",
+           "dump", "SCHEMA"]
+
+SCHEMA = "mxnet_tpu.flight/1"
+
+_REASON_RE = re.compile(r"[^a-zA-Z0-9._-]+")
+
+
+class FlightRecorder:
+    """Bounded post-mortem recorder over the shared trace ring +
+    registry."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 span_tail: int = 512):
+        self._lock = threading.Lock()
+        self._dir = directory
+        self._default_dir: Optional[str] = None
+        self.span_tail = int(span_tail)
+        self._baseline: Optional[Dict] = None
+        self._warned = False
+        self._seq = 0
+
+    # -- arming -----------------------------------------------------------
+    def directory(self) -> Optional[str]:
+        """The dump directory, by precedence: explicit :meth:`arm`, the
+        ``MXNET_TPU_FLIGHT_DIR`` env var (re-read per call — a test or
+        launcher may set it after import), then the low-precedence
+        :meth:`arm_default` (the latest Supervisor's
+        ``<ckpt>/flight``)."""
+        return (self._dir or os.environ.get("MXNET_TPU_FLIGHT_DIR")
+                or self._default_dir or None)
+
+    def arm(self, directory: str, *, baseline: bool = True) -> None:
+        """Set the dump directory and (by default) take the metrics
+        baseline the next dump's deltas are computed against."""
+        with self._lock:
+            self._dir = str(directory)
+            if baseline:
+                self._baseline = get_registry().snapshot()
+
+    def arm_default(self, directory: str) -> None:
+        """Low-precedence arming (each ``Supervisor`` points it at its
+        own ``<checkpoint_dir>/flight``, latest wins): never overrides
+        an explicit :meth:`arm` or the env var, so two sequential
+        Supervisors each dump into their own directory instead of
+        first-writer-wins."""
+        with self._lock:
+            self._default_dir = str(directory)
+            if self._baseline is None:
+                self._baseline = get_registry().snapshot()
+
+    def armed(self) -> bool:
+        return self.directory() is not None
+
+    # -- dumping ----------------------------------------------------------
+    def payload(self, reason: str) -> Dict:
+        """Build (without side effects) one post-mortem payload. The
+        deltas baseline only advances in :meth:`dump` AFTER a
+        successful publish — a failed write (full disk, the very
+        environment the recorder exists for) must not consume the
+        delta window."""
+        reg = get_registry()
+        snap = reg.snapshot()
+        with self._lock:
+            base = self._baseline
+        spans = buffer().tail(self.span_tail)
+        out: Dict = {
+            "schema": SCHEMA,
+            "reason": str(reason),
+            "ts_unix": time.time(),
+            "pid": os.getpid(),
+            "spans": spans,
+            "dropped_spans": buffer().dropped,
+            "metrics": snap,
+            "metric_deltas": MetricsRegistry.deltas_since(base or {}, snap),
+        }
+        try:  # chaos campaign context rides along when armed
+            from ..resilience import chaos
+            if chaos.armed() or chaos.stats():
+                out["chaos"] = chaos.stats()
+        except Exception:  # noqa: BLE001 — context is best-effort
+            pass
+        return out
+
+    def dump(self, reason: str, directory: Optional[str] = None) -> str:
+        """Write one post-mortem artifact; returns its path. Atomic:
+        staged to ``.tmp.<pid>`` and published by ``os.replace``; the
+        stable name ``flight_latest.json`` is re-published alongside."""
+        d = directory or self.directory()
+        if d is None:
+            raise ValueError(
+                "flight recorder is not armed (set MXNET_TPU_FLIGHT_DIR "
+                "or call telemetry.flight.arm(dir))")
+        os.makedirs(d, exist_ok=True)
+        payload = self.payload(reason)
+        slug = _REASON_RE.sub("-", str(reason))[:80] or "dump"
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        # the sequence number keeps back-to-back dumps (same reason,
+        # same millisecond — e.g. a tight fatal-retry loop) from
+        # clobbering each other's artifact
+        name = f"flight_{int(payload['ts_unix'] * 1e3)}_{os.getpid()}_" \
+               f"{seq:03d}_{slug}.json"
+        final = os.path.join(d, name)
+        tmp = final + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        with self._lock:
+            # published: the next dump's delta window starts here
+            self._baseline = payload["metrics"]
+        latest = os.path.join(d, "flight_latest.json")
+        tmp2 = latest + f".tmp.{os.getpid()}"
+        try:
+            with open(tmp2, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp2, latest)
+        except OSError:
+            pass  # the unique artifact above already published
+        return final
+
+    def try_dump(self, reason: str,
+                 directory: Optional[str] = None) -> Optional[str]:
+        """:meth:`dump` that never raises and no-ops while unarmed —
+        the form every failure-path trigger calls (the recorder must
+        not add a second failure to the one being recorded)."""
+        try:
+            if directory is None and not self.armed():
+                return None
+            return self.dump(reason, directory)
+        except Exception as e:  # noqa: BLE001 — never kill the caller
+            if not self._warned:
+                self._warned = True
+                import warnings
+                warnings.warn(
+                    f"flight recorder dump failed ({e!r}); further "
+                    "failures will be silent", RuntimeWarning,
+                    stacklevel=2)
+            return None
+
+    @staticmethod
+    def list_dumps(directory: str) -> List[str]:
+        """Unique dump artifacts, oldest first (``flight_latest.json``
+        is a convenience copy of the newest one, not a second dump)."""
+        try:
+            return sorted(
+                os.path.join(directory, n) for n in os.listdir(directory)
+                if n.startswith("flight_") and n.endswith(".json")
+                and ".tmp." not in n and n != "flight_latest.json")
+        except OSError:
+            return []
+
+
+#: The process recorder the resilience triggers use.
+recorder = FlightRecorder()
+arm = recorder.arm
+arm_default = recorder.arm_default
+armed = recorder.armed
+dump = recorder.dump
+try_dump = recorder.try_dump

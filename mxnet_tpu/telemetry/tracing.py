@@ -1,0 +1,418 @@
+"""Step-timeline tracing: spans, a bounded trace ring, Chrome export.
+
+The process keeps ONE bounded ring of ``trace_event`` dicts
+(:func:`buffer`) that every instrumented subsystem appends into — the
+profiler's per-op timeline (``mx.profiler.record_op``), serving
+micro-batch spans, Supervisor restore spans, chaos fires, and the step
+timelines below. One ring means one merged timeline: :func:`dump_chrome`
+writes a Chrome ``trace_event`` JSON loadable in Perfetto / chrome://
+tracing, and the flight recorder dumps the ring's tail as the
+"what was happening" record.
+
+**Step timelines** (:func:`step`) attribute a training/serving step's
+wall time into four buckets:
+
+- ``compile``  — jaxpr trace + lowering + XLA backend compile, observed
+  via a ``jax.monitoring`` duration listener (fires on the caller's
+  thread, so attribution lands on the step that paid it);
+- ``device``   — time blocked in compiled executables
+  (``Trainer``'s fused update phase, or any explicit
+  ``st.phase('device')``), with compile time that occurred *inside* the
+  phase subtracted so the two buckets never double-count;
+- ``input_starved`` — time the consumer waited on an empty input queue
+  (``io.DevicePrefetch`` attributes its wait automatically);
+- ``host``     — the remainder: eager op dispatch, metric updates,
+  Python glue. Computed as ``wall - (compile + device + input_starved)``
+  so the buckets sum to the measured wall time by construction.
+
+All recording is host arithmetic + one bounded-deque append — no device
+syncs (tpulint A001) and cheap enough to leave on permanently at step
+granularity.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .registry import get_registry
+
+__all__ = [
+    "BUCKETS", "StepTimeline", "TraceBuffer", "buffer", "span", "step",
+    "current_step", "attribute", "phase_if_active", "chrome_trace",
+    "dump_chrome", "now_us", "emit_complete", "emit_counter",
+    "emit_instant",
+]
+
+#: Step attribution buckets (``host`` is the computed remainder).
+BUCKETS = ("compile", "device", "input_starved", "host")
+
+
+def _env_int(name: str, default: int) -> int:
+    """Malformed-knob contract: a typo'd value (unparseable OR negative
+    — deque(maxlen=-5) raises) must not kill `import mxnet_tpu`."""
+    try:
+        v = int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def now_us() -> float:
+    """The trace clock (µs). Same clock as ``profiler.record_op`` so
+    both streams merge into one consistent timeline."""
+    return time.perf_counter() * 1e6
+
+
+class TraceBuffer:
+    """Bounded, thread-safe ring of Chrome ``trace_event`` dicts."""
+
+    def __init__(self, maxlen: int):
+        self._dq: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def append(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._dq) == self._dq.maxlen:
+                self.dropped += 1
+            self._dq.append(ev)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._dq)
+
+    def tail(self, n: int) -> List[dict]:
+        with self._lock:
+            if n >= len(self._dq):
+                return list(self._dq)
+            return list(self._dq)[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._dq.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._dq)
+
+
+#: Ring capacity: ~260k events ≈ a few hundred MB of JSON at most; the
+#: ring bounds memory where the old profiler list grew without limit.
+_buffer = TraceBuffer(_env_int("MXNET_TPU_TRACE_EVENTS", 262144))
+
+
+def buffer() -> TraceBuffer:
+    """The process trace ring (shared with ``mx.profiler``)."""
+    return _buffer
+
+
+def emit_complete(name: str, ts_us: float, dur_us: float,
+                  cat: str = "telemetry",
+                  args: Optional[dict] = None,
+                  tid: Optional[int] = None) -> None:
+    ev = {"name": name, "cat": cat, "ph": "X", "ts": ts_us,
+          "dur": dur_us, "pid": os.getpid(),
+          "tid": tid if tid is not None
+          else threading.get_ident() % 10000}
+    if args:
+        ev["args"] = args
+    _buffer.append(ev)
+
+
+def emit_counter(name: str, value: float,
+                 ts_us: Optional[float] = None) -> None:
+    _buffer.append({"name": name, "ph": "C",
+                    "ts": now_us() if ts_us is None else ts_us,
+                    "pid": os.getpid(), "args": {"value": value}})
+
+
+def emit_instant(name: str, cat: str = "telemetry",
+                 args: Optional[dict] = None) -> None:
+    ev = {"name": name, "cat": cat, "ph": "i", "ts": now_us(),
+          "pid": os.getpid(), "tid": threading.get_ident() % 10000,
+          "s": "p"}
+    if args:
+        ev["args"] = args
+    _buffer.append(ev)
+
+
+class span:
+    """Context manager adding one named complete span to the ring."""
+
+    __slots__ = ("name", "cat", "args", "_t0")
+
+    def __init__(self, name: str, cat: str = "telemetry",
+                 args: Optional[dict] = None):
+        self.name, self.cat, self.args = name, cat, args
+
+    def __enter__(self) -> "span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        dur = time.perf_counter() - self._t0
+        emit_complete(self.name, now_us() - dur * 1e6, dur * 1e6,
+                      self.cat, self.args)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# step timelines
+# ---------------------------------------------------------------------------
+_tls = threading.local()
+
+# registry families (registered once at import; children created lazily)
+_reg = get_registry()
+_steps_total = _reg.counter(
+    "telemetry_steps_total", "Steps timed by telemetry.step", ("name",))
+_step_ms = _reg.histogram(
+    "telemetry_step_ms", "Step wall time (ms)", ("name",))
+_bucket_ms = _reg.histogram(
+    "telemetry_step_bucket_ms",
+    "Per-step wall-time attribution (ms) by bucket", ("name", "bucket"))
+
+_compile_listener_installed = False
+_compile_listener_lock = threading.Lock()
+
+#: jax.monitoring duration events counted as compile work: MLIR
+#: lowering + the XLA backend compile, the two sequential stages of one
+#: top-level compilation. Deliberately NOT jaxpr_trace_duration — it
+#: fires for nested sub-traces too (a hybridized block traces inner
+#: jaxprs inside the outer trace), which would double-count and let the
+#: compile bucket exceed the step's wall time.
+_COMPILE_EVENTS = (
+    "/jax/core/compile/jaxpr_to_mlir_module_duration",
+    "/jax/core/compile/backend_compile_duration",
+)
+
+
+def _ensure_compile_listener() -> None:
+    """Install the jax.monitoring listener that routes compile durations
+    into the current step's ``compile`` bucket. Installed lazily on the
+    first StepTimeline so processes that never use telemetry pay
+    nothing; once installed it costs one thread-local read per compile
+    event (compiles are rare by definition)."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    with _compile_listener_lock:
+        if _compile_listener_installed:
+            return
+        try:
+            import jax.monitoring as _mon
+
+            def _on_duration(event: str, duration_s: float, **kw) -> None:
+                if event in _COMPILE_EVENTS:
+                    st = current_step()
+                    if st is not None:
+                        st.add("compile", duration_s)
+
+            _mon.register_event_duration_secs_listener(_on_duration)
+            _compile_listener_installed = True
+        except Exception:  # noqa: BLE001 — no jax / exotic version:
+            _compile_listener_installed = True  # degrade to hook-less
+
+
+class _Phase:
+    __slots__ = ("_st", "_bucket", "_label", "_t0", "_noop")
+
+    def __init__(self, st: "StepTimeline", bucket: str, label: str):
+        self._st = st
+        self._bucket = bucket
+        self._label = label
+
+    def __enter__(self) -> "_Phase":
+        # a phase nested inside an open phase records nothing — the
+        # outer phase already owns this wall time (e.g. a bench wrapping
+        # trainer.step + barrier in phase('device') around the Trainer's
+        # own internal device phase must not double-count)
+        self._noop = self._st._open_phase is not None
+        if not self._noop:
+            self._st._open_phase = self._bucket
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._noop:
+            return False
+        dur = time.perf_counter() - self._t0
+        self._st._open_phase = None
+        self._st.add(self._bucket, dur)
+        emit_complete(self._label, now_us() - dur * 1e6, dur * 1e6,
+                      cat=f"step.{self._bucket}")
+        return False
+
+
+class StepTimeline:
+    """One step's wall-time attribution. Use via :func:`step`::
+
+        with telemetry.step("train", i) as st:
+            batch = next(prefetch)          # input_starved: automatic
+            loss = trainer_driven_step(...) # device/compile: automatic
+
+    or attribute manually with :meth:`phase` / :meth:`add`.
+    """
+
+    __slots__ = ("name", "index", "_t0", "_wall", "_buckets",
+                 "_open_phase", "_compile_in_device", "_prev",
+                 "_cancelled")
+
+    def __init__(self, name: str = "step", index: Optional[int] = None):
+        _ensure_compile_listener()
+        self.name = name
+        self.index = index
+        self._buckets: Dict[str, float] = {
+            "compile": 0.0, "device": 0.0, "input_starved": 0.0}
+        self._open_phase: Optional[str] = None
+        self._compile_in_device = 0.0
+        self._wall: Optional[float] = None
+        self._prev = None
+        self._cancelled = False
+
+    # -- recording --------------------------------------------------------
+    def phase(self, bucket: str, label: Optional[str] = None) -> _Phase:
+        if bucket not in self._buckets:
+            raise ValueError(
+                f"unknown bucket {bucket!r} (one of "
+                f"{tuple(self._buckets)}; 'host' is the remainder)")
+        return _Phase(self, bucket, label or f"{self.name}.{bucket}")
+
+    def add(self, bucket: str, dur_s: float) -> None:
+        """Attribute ``dur_s`` seconds to ``bucket`` (hook entry point:
+        the jax compile listener and ``DevicePrefetch`` call this)."""
+        if bucket not in self._buckets:
+            return  # hooks must never raise into the training loop
+        self._buckets[bucket] += dur_s
+        if bucket == "compile" and self._open_phase == "device":
+            # the compile happened inside a timed device phase (the
+            # first call of a jitted step): subtract at finish so the
+            # two buckets never double-count the same wall time
+            self._compile_in_device += dur_s
+
+    def cancel(self) -> None:
+        """Record nothing on exit — for a step opened around a data
+        pull that turned out to be the iterator's exhaustion (loops
+        open the step BEFORE ``next()`` so starved waits attribute;
+        the final empty pull is not a step)."""
+        self._cancelled = True
+
+    # -- context ----------------------------------------------------------
+    def __enter__(self) -> "StepTimeline":
+        self._prev = getattr(_tls, "step", None)
+        _tls.step = self
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._wall = time.perf_counter() - self._t0
+        _tls.step = self._prev
+        if not self._cancelled:
+            self._finish()
+        return False
+
+    def _finish(self) -> None:
+        att = self.attribution()
+        args = {k: round(v * 1e3, 3) for k, v in att.items()}
+        args["wall_ms"] = round(self._wall * 1e3, 3)
+        if self.index is not None:
+            args["step"] = self.index
+        emit_complete(f"step[{self.name}]",
+                      now_us() - self._wall * 1e6, self._wall * 1e6,
+                      cat="step", args=args)
+        _steps_total.labels(name=self.name).inc()
+        _step_ms.labels(name=self.name).observe(self._wall * 1e3)
+        for bucket, dur in att.items():
+            _bucket_ms.labels(name=self.name,
+                              bucket=bucket).observe(dur * 1e3)
+
+    # -- reading ----------------------------------------------------------
+    @property
+    def wall_s(self) -> Optional[float]:
+        return self._wall
+
+    def attribution(self) -> Dict[str, float]:
+        """Seconds per bucket. After the step closes, buckets sum to the
+        measured wall time exactly (``host`` is the remainder, and
+        compile observed inside a device phase is subtracted from
+        ``device``); while the step is open, the measured buckets so
+        far."""
+        compile_s = self._buckets["compile"]
+        device = max(0.0, self._buckets["device"] - self._compile_in_device)
+        inp = self._buckets["input_starved"]
+        out = {"compile": compile_s, "device": device,
+               "input_starved": inp}
+        if self._wall is not None:
+            out["host"] = max(0.0, self._wall - compile_s - device - inp)
+        return out
+
+
+def step(name: str = "step", index: Optional[int] = None) -> StepTimeline:
+    """A new :class:`StepTimeline` context for one step."""
+    return StepTimeline(name, index)
+
+
+def current_step() -> Optional[StepTimeline]:
+    """The innermost open step on this thread (hooks attribute into
+    it), or None."""
+    return getattr(_tls, "step", None)
+
+
+def attribute(bucket: str, dur_s: float) -> None:
+    """Attribute ``dur_s`` to ``bucket`` of the current step, if any —
+    the one-line hook instrumented code calls (never raises)."""
+    st = getattr(_tls, "step", None)
+    if st is not None:
+        st.add(bucket, dur_s)
+
+
+class _NullPhase:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_PHASE = _NullPhase()
+
+
+def phase_if_active(bucket: str, label: Optional[str] = None):
+    """``current_step().phase(...)`` when a step is open on this thread,
+    else a reusable no-op context — the cheap guard hot seams
+    (``Trainer._update``) use."""
+    st = getattr(_tls, "step", None)
+    if st is None:
+        return _NULL_PHASE
+    return st.phase(bucket, label)
+
+
+# ---------------------------------------------------------------------------
+# Chrome export
+# ---------------------------------------------------------------------------
+def chrome_trace(events: Optional[List[dict]] = None) -> dict:
+    """A Chrome ``trace_event`` JSON object (Perfetto/chrome://tracing
+    loadable) of ``events`` (default: the whole ring)."""
+    return {"traceEvents": _buffer.snapshot() if events is None
+            else list(events),
+            "displayTimeUnit": "ms"}
+
+
+def dump_chrome(path: str, events: Optional[List[dict]] = None) -> str:
+    """Write :func:`chrome_trace` to ``path`` atomically
+    (tmp → ``os.replace``). Returns ``path``."""
+    payload = chrome_trace(events)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path

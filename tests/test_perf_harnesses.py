@@ -343,6 +343,66 @@ def test_aot_bench_quick(tmp_path):
     assert tool["entries_warmed"] == tool["entries_total"] > 0
 
 
+def test_trace_quick(tmp_path):
+    """train_bench --quick end-to-end (the ISSUE 6 telemetry smoke): a
+    CPU training loop under step timelines must emit a Perfetto-loadable
+    Chrome trace whose per-step attribution buckets (compile / device /
+    input-starved / host) sum to the measured step wall time within 10%,
+    with instrumentation overhead bounded — the schema contract for the
+    committed ``results_telemetry_cpu.json``."""
+    import json
+    import subprocess
+    import sys
+
+    out_file = str(tmp_path / "telemetry.json")
+    trace_file = str(tmp_path / "trace.json")
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    for k in ("MXNET_TPU_CHAOS", "MXNET_TPU_TELEMETRY",
+              "MXNET_TPU_FLIGHT_DIR", "MXNET_TPU_TRACE_EVENTS",
+              "MXNET_TPU_ROOFLINE_DIR"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmark", "train_bench.py"),
+         "--quick", "--quick-steps", "30", "--output", out_file,
+         "--trace", trace_file],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(open(out_file).read())
+    assert rec["quick"] is True and rec["metric"] == "telemetry_quick"
+    assert rec["steps_s_armed"] > 0 and rec["steps_s_plain"] > 0
+    # the acceptance invariant: buckets reconstruct wall within 10%
+    assert 0.9 <= rec["attribution_sum_ratio_min"] <= 1.0 + 1e-6
+    assert rec["attribution_sum_ratio_max"] <= 1.1
+    # the ratio alone is satisfiable by the host remainder absorbing
+    # everything — also require the MEASURED buckets to carry real
+    # signal: the cold step's compile must dominate its own wall (the
+    # jax.monitoring hook actually fired), and the fused-update device
+    # phase must have recorded nonzero time on the mean step
+    first = rec["first_step_attribution_ms"]
+    assert first["compile"] > 0.3 * rec["first_step_wall_ms"]
+    assert rec["attribution_ms_mean"]["device"] > 0
+    # instrumentation must stay out of the way. The armed-vs-bare A/B
+    # (overhead_pct, the banked <2% acceptance number) swings tens of
+    # percent under shared-CI scheduler noise, so the hard gate is the
+    # deterministic microbench: timeline cost as a fraction of the
+    # measured step, with only a catastrophic-regression bound on A/B
+    assert rec["instrumentation_pct_of_step"] < 2.0
+    assert rec["overhead_pct"] < 30.0
+    assert rec["efficiency"]["examples_per_s"] > 0
+
+    # the emitted trace is schema-valid Chrome trace_event JSON with
+    # step spans carrying the attribution args
+    sys.path.insert(0, ROOT)
+    from tools.trace_view import summarize, validate_events
+
+    payload = json.loads(open(trace_file).read())
+    events = validate_events(payload, trace_file)
+    assert payload["displayTimeUnit"] == "ms"
+    sa = summarize(events)["step_attribution"]
+    assert sa["steps"] >= 30
+    assert abs(sa["attributed_ratio"] - 1.0) <= 0.1
+
+
 def test_daemon_merge_model_table_keeps_banked_rows(tmp_path):
     """A partial capture (tunnel flap mid-table) must never erase
     previously banked successes; unattempted combos merge forward."""

@@ -71,6 +71,8 @@ MODULES = {
     "mxnet_tpu.analysis": "tpulint — TPU anti-pattern analyzer "
                           "(jaxpr + AST rules, runtime sentinel)",
     "mxnet_tpu.aot": "persistent compile cache + ahead-of-time warmup",
+    "mxnet_tpu.telemetry": "unified telemetry: metrics registry, step "
+                           "tracing, MFU gauges, flight recorder",
 }
 
 

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Merge + summarize Chrome ``trace_event`` JSONs (the telemetry/
+profiler traces — ``mx.profiler.dump()``, ``telemetry.dump_chrome``,
+``train_bench --quick --trace``).
+
+Validates each input against the trace_event schema the tests pin
+(``traceEvents`` list; every event a dict with ``name``/``ph``/``ts``/
+``pid``; complete events additionally ``dur``), merges multiple files
+onto one timeline (distinct pids keep processes apart in Perfetto), and
+prints a summary: per-category wall time, the step-attribution table
+(compile / device / input-starved / host from ``step[...]`` spans), and
+the top-N spans by total duration.
+
+Usage:
+    python tools/trace_view.py trace1.json [trace2.json ...]
+        [--merge merged.json] [--top 15] [--json]
+
+The merged file loads in https://ui.perfetto.dev or chrome://tracing.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List
+
+REQUIRED_KEYS = ("name", "ph", "ts", "pid")
+
+
+def validate_events(payload: dict, path: str) -> List[dict]:
+    """Schema check; returns the event list or raises ValueError naming
+    the offending file/event."""
+    if not isinstance(payload, dict) or \
+            not isinstance(payload.get("traceEvents"), list):
+        raise ValueError(f"{path}: not a Chrome trace (object with a "
+                         "'traceEvents' list)")
+    events = payload["traceEvents"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"{path}: traceEvents[{i}] is not an object")
+        missing = [k for k in REQUIRED_KEYS if k not in ev]
+        if missing:
+            raise ValueError(
+                f"{path}: traceEvents[{i}] ({ev.get('name')!r}) missing "
+                f"required key(s) {missing}")
+        if ev["ph"] == "X" and "dur" not in ev:
+            raise ValueError(
+                f"{path}: complete event traceEvents[{i}] "
+                f"({ev['name']!r}) has no 'dur'")
+    return events
+
+
+def load(path: str) -> List[dict]:
+    with open(path) as f:
+        return validate_events(json.load(f), path)
+
+
+def summarize(events: List[dict]) -> Dict:
+    by_cat: Dict[str, float] = defaultdict(float)
+    by_name: Dict[str, List[float]] = defaultdict(list)
+    steps: List[dict] = []
+    counters = set()
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "X":
+            dur_ms = float(ev.get("dur", 0.0)) / 1e3
+            by_cat[ev.get("cat", "?")] += dur_ms
+            by_name[ev["name"]].append(dur_ms)
+            if ev.get("cat") == "step" and "args" in ev:
+                steps.append(ev["args"])
+        elif ph == "C":
+            counters.add(ev["name"])
+    summary: Dict = {
+        "events": len(events),
+        "categories_ms": {k: round(v, 3)
+                          for k, v in sorted(by_cat.items(),
+                                             key=lambda kv: -kv[1])},
+        "counters": sorted(counters),
+        "spans": {
+            name: {"calls": len(durs), "total_ms": round(sum(durs), 3),
+                   "mean_ms": round(sum(durs) / len(durs), 4),
+                   "max_ms": round(max(durs), 3)}
+            for name, durs in by_name.items()},
+    }
+    if steps:
+        buckets = ("compile", "device", "input_starved", "host")
+        total = {b: sum(float(s.get(b, 0.0)) for s in steps)
+                 for b in buckets}
+        wall = sum(float(s.get("wall_ms", 0.0)) for s in steps)
+        summary["step_attribution"] = {
+            "steps": len(steps),
+            "wall_ms": round(wall, 3),
+            "buckets_ms": {b: round(v, 3) for b, v in total.items()},
+            "buckets_pct": {
+                b: round(100.0 * v / wall, 2) if wall else 0.0
+                for b, v in total.items()},
+            "attributed_ratio": round(sum(total.values()) / wall, 4)
+            if wall else None,
+        }
+    return summary
+
+
+def render(summary: Dict, top: int) -> str:
+    lines = [f"events: {summary['events']}"]
+    lines.append("\nper-category wall time:")
+    for cat, ms in summary["categories_ms"].items():
+        lines.append(f"  {cat:<20}{ms:>12.3f} ms")
+    sa = summary.get("step_attribution")
+    if sa:
+        lines.append(f"\nstep attribution ({sa['steps']} steps, "
+                     f"{sa['wall_ms']:.1f} ms wall, "
+                     f"{sa['attributed_ratio']:.2%} attributed):")
+        for b, ms in sa["buckets_ms"].items():
+            lines.append(f"  {b:<16}{ms:>12.3f} ms "
+                         f"({sa['buckets_pct'][b]:>6.2f}%)")
+    spans = sorted(summary["spans"].items(),
+                   key=lambda kv: -kv[1]["total_ms"])[:top]
+    lines.append(f"\ntop {len(spans)} spans by total time:")
+    lines.append(f"  {'name':<40}{'calls':>7}{'total(ms)':>12}"
+                 f"{'mean(ms)':>11}{'max(ms)':>10}")
+    for name, s in spans:
+        lines.append(f"  {name[:40]:<40}{s['calls']:>7}"
+                     f"{s['total_ms']:>12.3f}{s['mean_ms']:>11.4f}"
+                     f"{s['max_ms']:>10.3f}")
+    if summary["counters"]:
+        lines.append("\ncounter streams: "
+                     + ", ".join(summary["counters"]))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge + summarize Chrome traces")
+    ap.add_argument("traces", nargs="+", help="trace_event JSON files")
+    ap.add_argument("--merge", default=None,
+                    help="write the merged trace here")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    merged: List[dict] = []
+    for path in args.traces:
+        merged.extend(load(path))
+    merged.sort(key=lambda ev: ev.get("ts", 0.0))
+    if args.merge:
+        with open(args.merge, "w") as f:
+            json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+        print(f"merged {len(args.traces)} trace(s), {len(merged)} events "
+              f"-> {args.merge}", file=sys.stderr)
+    summary = summarize(merged)
+    print(json.dumps(summary, indent=2) if args.json
+          else render(summary, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
