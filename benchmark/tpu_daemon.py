@@ -748,6 +748,51 @@ def capture_llm() -> None:
             f"mfu={rec.get('mfu')}, decode {rec.get('decode_tok_s')} tok/s")
 
 
+LLM_SERVING = os.path.join(HERE, "results_llm_serving_tpu.json")
+
+
+def capture_llm_serving() -> None:
+    """Continuous-batching serving bench (ISSUE 7,
+    benchmark/llm_serve_bench.py): banks the TPU serving row and appends
+    the decode hbm_utilization TRAJECTORY into ``results_llm_tpu.json``
+    — engine tok/s against the roofline ceiling llm_bench computed, so
+    the 4.7%-of-roofline gap's closure is a measured time series, not
+    one number."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "llm_serve_bench.py")],
+        timeout=2400)
+    rec = parse_json_output(out)
+    if not bank_if_tpu(LLM_SERVING, rec, rc, "llm serving bench") or not rec:
+        return
+    try:
+        with open(LLM) as f:
+            banked = json.load(f)
+        roof = float(banked.get("decode_roofline_tok_s") or 0)
+        if roof <= 0:
+            return  # llm_bench hasn't banked a roofline yet
+        point = {
+            "captured_unix": time.time(),
+            "engine_tok_s": rec.get("value"),
+            "speedup_vs_sequential": rec.get("speedup"),
+            "lane_occupancy": (rec.get("engine") or {}).get(
+                "lane_occupancy"),
+            "hbm_utilization": round(
+                float(rec.get("value") or 0) / roof, 4),
+            "code_rev": rec.get("code_rev"),
+        }
+        traj = [p for p in banked.get("serving_trajectory", [])
+                if isinstance(p, dict)][-19:]
+        traj.append(point)
+        banked["serving_trajectory"] = traj
+        banked["serving_hbm_utilization"] = point["hbm_utilization"]
+        atomic_write(LLM, banked)
+        log(f"llm serving: {rec.get('value')} tok/s = "
+            f"{point['hbm_utilization']:.1%} of decode roofline "
+            f"(trajectory {len(traj)} points)")
+    except Exception as e:  # noqa: BLE001 — trajectory is best-effort
+        log(f"llm serving trajectory merge failed: {e!r}")
+
+
 def capture_infer_table() -> None:
     """Per-model inference table over the reference's FULL published
     perf.md rows (resnet50/resnet152/inception_v3/vgg16/alexnet, bf16 +
@@ -1187,6 +1232,8 @@ CAPTURES = (
     ("quant-micro", quant_micro_needs, capture_quant_micro),
     ("peak", banked_stale(PEAK, 2 * 3600), capture_peak),
     ("llm", banked_stale(LLM, 4 * 3600), capture_llm),
+    ("llm-serving", banked_stale(LLM_SERVING, 4 * 3600),
+     capture_llm_serving),
     ("train-table", lambda: bool(stale_combos(TRAIN, TRAIN_COMBOS)),
      capture_train),
     ("profile", banked_stale(PROFILE, 6 * 3600), capture_profile),

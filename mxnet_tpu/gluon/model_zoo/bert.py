@@ -148,6 +148,54 @@ class _CausalLM(HybridBlock):
         w = self.word_embed.weight.data()
         return seq @ w.T, ck, cv
 
+    def decode_step_paged(self, token_ids, pool_k, pool_v, block_table,
+                          positions):
+        """Paged-KV decode of one token per lane: ``token_ids`` is
+        (R, 1) at per-lane absolute ``positions`` (R,), K/V land in the
+        shared block pools through ``block_table`` (R, MB). Returns
+        (logits (R, 1, V), new_pool_k, new_pool_v). The continuous-
+        batching decode program (:mod:`mxnet_tpu.serving.llm`) is one
+        jit of this — static pool/table shapes, so admission and
+        sequence growth never retrace."""
+        from ...numpy_extension import _call
+
+        emb = self.word_embed(token_ids)
+        pos_table = self.pos_embed.data()
+
+        def add_pos(e, table, ps):
+            # per-lane gather (dense decode_step slices ONE shared pos):
+            # jnp gather clamps out-of-range lanes — the serving engine
+            # bounds positions against the context window on the host
+            return e + jnp.take(table, ps.astype(jnp.int32), axis=0)[:, None]
+
+        emb = _call(add_pos, (emb, pos_table, positions),
+                    name="add_pos_embed_paged")
+        seq, pk, pv = self.encoder.forward_step_paged(
+            emb, pool_k, pool_v, block_table, positions)
+        w = self.word_embed.weight.data()
+        return seq @ w.T, pk, pv
+
+    def init_block_pool(self, num_blocks, block_size, dtype="float32"):
+        """Zeroed (L, NB, H, block_size, D) paged K/V block pools.
+
+        The paged analogue of :meth:`init_cache`: pool capacity — not
+        ``max_length x max_batch`` — bounds KV memory; a sequence owns
+        ``ceil(context / block_size)`` blocks via its block table and
+        returns them the moment it finishes. ``dtype="int8"`` stores
+        quantized blocks (+4 bitcast scale bytes on the feature axis,
+        see :func:`~mxnet_tpu.ops.nn.kv_cache_quantize`)."""
+        from ... import numpy as mxnp
+
+        enc = self.encoder
+        heads = enc.layer0.attn._heads
+        d = enc.layer0.attn._units // heads
+        if dtype == "int8":
+            from ..nn.transformer import _KV_SCALE_BYTES
+
+            d += _KV_SCALE_BYTES
+        shape = (enc._num_layers, num_blocks, heads, block_size, d)
+        return mxnp.zeros(shape, dtype=dtype), mxnp.zeros(shape, dtype=dtype)
+
     def init_cache(self, batch_size, max_length, dtype="float32"):
         """Zeroed (L, B, H, Lmax, D) key/value ring buffers.
 

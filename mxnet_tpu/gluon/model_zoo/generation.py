@@ -22,7 +22,8 @@ from ...base import MXNetError
 from ...ndarray.ndarray import ndarray, _unwrap, _wrap
 from ..block import HybridBlock
 
-__all__ = ["generate", "beam_search"]
+__all__ = ["generate", "beam_search", "paged_decode_program",
+           "paged_prefill_program"]
 
 
 class _StepAdapter(HybridBlock):
@@ -35,6 +36,18 @@ class _StepAdapter(HybridBlock):
 
     def forward(self, tokens, cache_k, cache_v, pos):
         return self.model.decode_step(tokens, cache_k, cache_v, pos)
+
+
+class _PagedStepAdapter(HybridBlock):
+    """Same, for model.decode_step_paged (block-pool decode)."""
+
+    def __init__(self, model):
+        super().__init__()
+        self.model = model
+
+    def forward(self, tokens, pool_k, pool_v, block_table, positions):
+        return self.model.decode_step_paged(tokens, pool_k, pool_v,
+                                            block_table, positions)
 
 
 _DECODE_CACHE_MAX = 16
@@ -90,6 +103,23 @@ def _sample(logits, key, greedy, temperature, top_k):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+_KV_CACHE_DTYPES = (None, "int8", "float32", "bfloat16", "float16")
+
+
+def _resolve_cache_dtype(model, kv_cache_dtype):
+    """Validate + default the KV cache dtype (shared by the dense
+    generate()/beam_search() path and the paged serving programs)."""
+    if kv_cache_dtype not in _KV_CACHE_DTYPES:
+        # an unknown integer dtype would silently truncate K/V to garbage
+        # through the non-quantized astype path — must be loud
+        raise MXNetError(
+            f"kv_cache_dtype {kv_cache_dtype!r} not supported "
+            "(int8/float32/bfloat16/float16)")
+    return kv_cache_dtype or (
+        onp.dtype(model.word_embed.weight.dtype).name
+        if hasattr(model, "word_embed") else "float32")
+
+
 def _prep(model, prompt_ids, max_new_tokens, max_length,
           kv_cache_dtype=None):
     """Shared decode setup: wrap the prompt, validate lengths against the
@@ -113,16 +143,7 @@ def _prep(model, prompt_ids, max_new_tokens, max_length,
         raise MXNetError(
             f"generation length {lmax} exceeds the model's context window "
             f"(max_length={pos_table.shape[0]})")
-    if kv_cache_dtype not in (None, "int8", "float32", "bfloat16",
-                              "float16"):
-        # an unknown integer dtype would silently truncate K/V to garbage
-        # through the non-quantized astype path — must be loud
-        raise MXNetError(
-            f"kv_cache_dtype {kv_cache_dtype!r} not supported "
-            "(int8/float32/bfloat16/float16)")
-    cache_dtype = kv_cache_dtype or (
-        onp.dtype(model.word_embed.weight.dtype).name
-        if hasattr(model, "word_embed") else "float32")
+    cache_dtype = _resolve_cache_dtype(model, kv_cache_dtype)
     ck, cv = model.init_cache(b, lmax, dtype=cache_dtype)
     adapter = _StepAdapter(model)
     pos0 = mxnp.array(onp.zeros((), onp.int32))
@@ -346,3 +367,139 @@ def beam_search(model, prompt_ids, max_new_tokens: int, beam_size: int = 4,
     jrun = store(jax.jit(run))
     seqs, scores = jrun(params, _unwrap(prompt), _unwrap(ck), _unwrap(cv))
     return _wrap(seqs), _wrap(scores)
+
+
+# --- paged (block-pool) decode programs ------------------------------------
+# The continuous-batching serving engine (mxnet_tpu.serving.llm) runs two
+# compiled programs built here: ONE decode step over the whole lane set
+# (fixed (max_running, 1) shape — admission/retirement/growth change array
+# CONTENT, never shapes, so the engine never retraces), and one prefill-
+# and-splice program per pow2 prompt bucket. Both are memoized through the
+# same per-model _decode_cache (and compiled through aot.cached_jit, so an
+# armed MXNET_TPU_AOT_CACHE store serves them to fresh replicas with zero
+# cold compiles).
+
+def _paged_jit(fn, label, donate, store):
+    """Compile ``fn`` at the AOT seam and memoize through the decode
+    cache: a plain jax.jit when no persistent store is armed."""
+    from ... import aot
+
+    return store(aot.cached_jit(fn, label=label,
+                                donate_argnums=donate))
+
+
+def paged_decode_program(model, *, max_running, num_blocks, block_size,
+                         max_blocks_per_seq, kv_cache_dtype=None,
+                         weight_dtype=None, greedy=True, temperature=1.0,
+                         top_k=0, donate=False):
+    """Build (or fetch memoized) the ONE fixed-shape continuous-batching
+    decode step for ``model``.
+
+    Returns ``(run, params)``: ``run(params, tokens (R,1) i32, pool_k,
+    pool_v, block_table (R,MB) i32, positions (R,) i32, key) ->
+    (next_tokens (R,) i32, new_pool_k, new_pool_v)``. Lane ``r``'s token
+    is written at ``positions[r]`` through its block table, attended
+    through the pool, and sampled (greedy argmax by default). Inactive
+    lanes must point at a trash block — their outputs are garbage the
+    scheduler ignores. With ``donate=True`` the pool buffers are donated
+    (decode reuses them in place — no double pool allocation per step).
+    """
+    cache_dtype = _resolve_cache_dtype(model, kv_cache_dtype)
+    r, mb = int(max_running), int(max_blocks_per_seq)
+    from ... import numpy as mxnp
+
+    # functionalize only finalizes PARAMETER shapes — the step fn is
+    # shape-generic and jit traces at first call with the engine's real
+    # pool, so a 2-block template avoids transiently holding a second
+    # full-size pool (which for an HBM-sized pool would double KV
+    # memory at engine startup)
+    pk, pv = model.init_block_pool(min(int(num_blocks), 2), block_size,
+                                   dtype=cache_dtype)
+    tokens0 = mxnp.array(onp.zeros((r, 1), onp.int32))
+    bt0 = mxnp.array(onp.zeros((r, mb), onp.int32))
+    pos0 = mxnp.array(onp.zeros((r,), onp.int32))
+    adapter = _PagedStepAdapter(model)
+    step_fn, params = adapter.functionalize(tokens0, pk, pv, bt0, pos0)
+    step_fn, params = _apply_weight_dtype(model, step_fn, params,
+                                          weight_dtype)
+    tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
+    ckey = ("paged_decode", r, int(num_blocks), int(block_size), mb,
+            bool(greedy), *tkey, cache_dtype, weight_dtype, bool(donate))
+    store, cached = _decode_cache(model, ckey)
+    if cached is not None:
+        return cached, params
+
+    def run(params, tokens, pool_k, pool_v, block_table, positions, key):
+        (logits, pool_k, pool_v), _ = step_fn(
+            params, tokens, pool_k, pool_v, block_table, positions)
+        nxt = _sample(logits[:, -1], key, greedy, temperature, top_k)
+        return nxt, pool_k, pool_v
+
+    jrun = _paged_jit(run, "llm.decode", (2, 3) if donate else (), store)
+    return jrun, params
+
+
+def paged_prefill_program(model, *, prefill_len, num_blocks, block_size,
+                          kv_cache_dtype=None, weight_dtype=None,
+                          greedy=True, temperature=1.0, top_k=0,
+                          donate=False):
+    """Build (or fetch memoized) the prefill-and-splice program for one
+    prompt-length bucket.
+
+    Returns ``(run, params)``: ``run(params, prompt (1, Pb) i32,
+    last_idx () i32, pool_k, pool_v, block_ids (Pb//bs,) i32, key) ->
+    (first_token () i32, new_pool_k, new_pool_v)``. The prompt (padded
+    to the ``Pb`` bucket) prefills a dense per-request cache allocated
+    INSIDE the program, the cache is resliced into ``Pb // block_size``
+    blocks and spliced into the running pool at ``block_ids``, and the
+    first generated token is sampled from the logits at ``last_idx``
+    (the last REAL prompt position — pad garbage beyond it never
+    matters: causal attention keeps it out of positions <= last_idx and
+    the decode-side length mask keeps it out of every later step).
+    Entries of ``block_ids`` past the prompt's real blocks should point
+    at a trash block."""
+    cache_dtype = _resolve_cache_dtype(model, kv_cache_dtype)
+    pb = int(prefill_len)
+    bs = int(block_size)
+    if pb % bs:
+        raise MXNetError(
+            f"prefill bucket {pb} must be a multiple of block_size {bs}")
+    nb = pb // bs
+    from ... import numpy as mxnp
+
+    ck, cv = model.init_cache(1, pb, dtype=cache_dtype)
+    cache_shape = tuple(ck.shape)       # (Lyr, 1, H, Pb, D')
+    cache_jdtype = _unwrap(ck).dtype
+    prompt0 = mxnp.array(onp.zeros((1, pb), onp.int32))
+    pos0 = mxnp.array(onp.zeros((), onp.int32))
+    adapter = _StepAdapter(model)
+    step_fn, params = adapter.functionalize(prompt0, ck, cv, pos0)
+    step_fn, params = _apply_weight_dtype(model, step_fn, params,
+                                          weight_dtype)
+    tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
+    ckey = ("paged_prefill", pb, int(num_blocks), bs, bool(greedy),
+            *tkey, cache_dtype, weight_dtype, bool(donate))
+    store, cached = _decode_cache(model, ckey)
+    if cached is not None:
+        return cached, params
+
+    lyr, _, heads, _, dp = cache_shape
+
+    def run(params, prompt, last_idx, pool_k, pool_v, block_ids, key):
+        ck0 = jnp.zeros(cache_shape, cache_jdtype)
+        cv0 = jnp.zeros(cache_shape, cache_jdtype)
+        (logits, ck_f, cv_f), _ = step_fn(
+            params, prompt, ck0, cv0, jnp.zeros((), jnp.int32))
+
+        def blocks(c):                  # (Lyr,1,H,Pb,D') -> (Lyr,nb,H,bs,D')
+            return c[:, 0].reshape(lyr, heads, nb, bs, dp) \
+                .transpose(0, 2, 1, 3, 4)
+
+        pool_k = pool_k.at[:, block_ids].set(blocks(ck_f))
+        pool_v = pool_v.at[:, block_ids].set(blocks(cv_f))
+        first = _sample(logits[:, last_idx], key, greedy, temperature,
+                        top_k)[0]
+        return first, pool_k, pool_v
+
+    jrun = _paged_jit(run, "llm.prefill", (3, 4) if donate else (), store)
+    return jrun, params

@@ -33,36 +33,12 @@ __all__ = [
 
 
 from ...ops.nn import attend as _attend
-
-
-# --- int8 KV cache ---------------------------------------------------------
-# Decode is HBM-bandwidth bound: every generated token re-reads the whole
-# cache. int8 storage halves those bytes vs bf16 (4x vs f32). Layout trick:
-# the per-(batch, head, position) f32 scale is bitcast into 4 extra int8
-# bytes on the feature axis — the cache stays ONE (L, B, H, Lmax, D+4)
-# int8 array, so every consumer (lax.scan carries, beam reordering
-# gathers, donation) works unchanged. Granularity: one scale per token
-# per head — the standard KV-quant setting; round-trip error ~0.4% rms.
-_KV_SCALE_BYTES = 4
-
-
-def kv_cache_quantize(t):
-    """(..., D) float -> (..., D+4) int8 [values | bitcast f32 scale]."""
-    amax = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1, keepdims=True)
-    scale = jnp.maximum(amax, 1e-6) / 127.0
-    q = jnp.clip(jnp.round(t.astype(jnp.float32) / scale), -127, 127)
-    sb = jax.lax.bitcast_convert_type(scale, jnp.int8)  # (..., 1, 4)
-    sb = sb.reshape(*t.shape[:-1], _KV_SCALE_BYTES)
-    return jnp.concatenate([q.astype(jnp.int8), sb], axis=-1)
-
-
-def kv_cache_dequantize(c, dtype):
-    """(..., D+4) int8 -> (..., D) ``dtype``."""
-    d = c.shape[-1] - _KV_SCALE_BYTES
-    vals = c[..., :d].astype(jnp.float32)
-    sb = c[..., d:].reshape(*c.shape[:-1], 1, _KV_SCALE_BYTES)
-    scale = jax.lax.bitcast_convert_type(sb, jnp.float32)  # (..., 1)
-    return (vals * scale.reshape(*c.shape[:-1], 1)).astype(dtype)
+# int8 KV cache helpers: the canonical implementations moved to
+# ``ops.nn`` alongside :func:`~mxnet_tpu.ops.nn.paged_attention` (the
+# block-pool decode path shares them); re-exported here unchanged for
+# the historical import path.
+from ...ops.nn import (_KV_SCALE_BYTES, kv_cache_dequantize,
+                       kv_cache_quantize, paged_attention as _paged_attend)
 
 
 class MultiHeadAttention(HybridBlock):
@@ -192,6 +168,50 @@ class MultiHeadAttention(HybridBlock):
                                     name="MultiHeadAttentionStep", n_out=3)
         return self.out_proj(out), new_ck, new_cv
 
+    def forward_step_paged(self, x, pool_k, pool_v, block_table, positions):
+        """Paged-KV decode attention: ``x`` is (R, 1, units) — one token
+        per decode lane — whose K/V are written into the shared block
+        pools at ``block_table[r, positions[r] // bs]`` slot
+        ``positions[r] % bs``, then attended through the table
+        (:func:`~mxnet_tpu.ops.nn.paged_attention`). Pools are
+        (NB, H, bs, D') for THIS layer; static shapes throughout, so one
+        XLA program serves every step at every mix of sequence lengths —
+        the continuous-batching decode loop's contract."""
+        units, heads = self._units, self._heads
+        proj = self.qkv(x)
+
+        def fn(p, pk, pv, bt, pos):
+            r = p.shape[0]
+            d = units // heads
+            bs = pk.shape[2]
+            pos = pos.astype(jnp.int32)
+            p = p.reshape(r, 3 * units)
+
+            def split(t):                       # (R, U) -> (R, H, D)
+                return t.reshape(r, heads, d)
+
+            q = split(p[:, :units])
+            k = split(p[:, units:2 * units])
+            v = split(p[:, 2 * units:])
+            if pk.dtype == jnp.int8:
+                k_store, v_store = kv_cache_quantize(k), kv_cache_quantize(v)
+            else:
+                k_store, v_store = k.astype(pk.dtype), v.astype(pv.dtype)
+            blk = jnp.take_along_axis(bt, (pos // bs)[:, None],
+                                      axis=1)[:, 0]
+            slot = pos % bs
+            # two advanced indices around a slice: the (R,) lane axis
+            # broadcasts to the front -> (R, H, D') matches k_store
+            pk = pk.at[blk, :, slot, :].set(k_store)
+            pv = pv.at[blk, :, slot, :].set(v_store)
+            out = _paged_attend(q, pk, pv, bt, pos + 1)   # (R, H, D)
+            return out.reshape(r, 1, units), pk, pv
+
+        out, new_pk, new_pv = _call(
+            fn, (proj, pool_k, pool_v, block_table, positions),
+            name="MultiHeadAttentionPagedStep", n_out=3)
+        return self.out_proj(out), new_pk, new_pv
+
 
 class PositionwiseFFN(HybridBlock):
     """FFN(x) = W2 act(W1 x); optional TP sharding (column→row)."""
@@ -271,6 +291,19 @@ class TransformerEncoderLayer(HybridBlock):
         x = self.ln1(x + h)
         return self.ln2(x + self.ffn(x)), ck, cv
 
+    def forward_step_paged(self, x, pool_k, pool_v, block_table, positions):
+        """Paged-pool variant of :meth:`forward_step` (no dropout:
+        decode is inference)."""
+        if self._pre_norm:
+            h, pk, pv = self.attn.forward_step_paged(
+                self.ln1(x), pool_k, pool_v, block_table, positions)
+            x = x + h
+            return x + self.ffn(self.ln2(x)), pk, pv
+        h, pk, pv = self.attn.forward_step_paged(
+            x, pool_k, pool_v, block_table, positions)
+        x = self.ln1(x + h)
+        return self.ln2(x + self.ffn(x)), pk, pv
+
 
 class TransformerEncoder(HybridBlock):
     """Stack of pre/post-norm self-attention + FFN blocks over npx.multi_head_attention; the flash-attention Pallas kernel backs long sequences."""
@@ -304,6 +337,24 @@ class TransformerEncoder(HybridBlock):
                 x, cache_k[i], cache_v[i], pos)
             new_ks.append(ck)
             new_vs.append(cv)
+        if self.final_ln is not None:
+            x = self.final_ln(x)
+        return x, mxnp.stack(new_ks), mxnp.stack(new_vs)
+
+    def forward_step_paged(self, x, pool_k, pool_v, block_table, positions):
+        """Paged-pool decode through the stack. ``pool_k``/``pool_v``
+        are (num_layers, NB, H, bs, D') stacked block pools sharing ONE
+        block table (a block holds one layer's slice; the same block id
+        addresses every layer's pool, so splice/free work per sequence,
+        not per layer)."""
+        from ... import numpy as mxnp
+
+        new_ks, new_vs = [], []
+        for i in range(self._num_layers):
+            x, pk, pv = getattr(self, f"layer{i}").forward_step_paged(
+                x, pool_k[i], pool_v[i], block_table, positions)
+            new_ks.append(pk)
+            new_vs.append(pv)
         if self.final_ln is not None:
             x = self.final_ln(x)
         return x, mxnp.stack(new_ks), mxnp.stack(new_vs)

@@ -1,0 +1,139 @@
+"""Paged-attention decode kernel (the vLLM idea, Pallas-TPU form).
+
+One query token per decode lane attends over its KV history, which
+lives in fixed-size blocks scattered across a shared pool and addressed
+through a per-lane block table. The table rides the scalar-prefetch
+channel (``pltpu.PrefetchScalarGridSpec``): each grid step's BlockSpec
+``index_map`` reads ``block_table[lane, j]`` to DMA exactly that pool
+block into VMEM — the gather never materializes a dense per-lane cache
+in HBM, which is the point: decode reads ``length`` real positions,
+not ``max_context``.
+
+Grid: ``(lanes * heads, max_blocks)`` — one (lane, head) pair per
+program row, online-softmax accumulation over the block axis (the
+flash-attention recurrence with block_q == 1). Correctness-first: the
+(1, D) query row underfills the MXU; the throughput win this kernel
+banks is the *bytes* win (paged gather + no dense cache), which is what
+the bandwidth-bound decode path is limited by.
+
+Oracle: the jnp gather path in :func:`mxnet_tpu.ops.nn.paged_attention`
+(itself token-identical to the dense cache); the kernel is checked
+against it in interpret mode on CPU (``tests/test_llm_serving.py``).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention_kernel"]
+
+_NEG_BIG = -1e30  # finite mask (−inf breaks the online-softmax carry)
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, bs, mb, heads, sm_scale,
+                  precision):
+    import jax.experimental.pallas as pl
+
+    rh = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[...].astype(jnp.float32)            # (1, D)
+    k = k_ref[0, 0].astype(jnp.float32)           # (bs, D)
+    v = v_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            precision=precision,
+                            preferred_element_type=jnp.float32)  # (1, bs)
+    s = s * sm_scale
+    length = len_ref[rh // heads]
+    pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+    s = jnp.where(pos < length, s, _NEG_BIG)
+    m_prev = m_ref[:, :1]                         # (1, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                        # (1, bs)
+    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                             precision=precision,
+                             preferred_element_type=jnp.float32)  # (1, D)
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == mb - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths,
+                           interpret=None):
+    """Block-table decode attention.
+
+    ``q``: (R, H, D) one token per lane; ``k_pool``/``v_pool``:
+    (NB, H, bs, D) float pools (int8 pools take the jnp dequant path in
+    :func:`~mxnet_tpu.ops.nn.paged_attention`); ``block_table``:
+    (R, MB) int32; ``lengths``: (R,) int32 valid positions per lane.
+    Returns (R, H, D) in the pool dtype. ``interpret=None``
+    auto-selects: compiled Mosaic on TPU, the Pallas interpreter
+    elsewhere."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from .flash_attention import _matmul_precision
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    r, h, d = q.shape
+    _, _, bs, _ = k_pool.shape
+    mb = block_table.shape[1]
+    sm_scale = float(d) ** -0.5
+    precision = _matmul_precision(q.dtype)
+    qf = q.reshape(r * h, d)
+    bt = block_table.astype(jnp.int32)
+    lens = lengths.astype(jnp.int32)
+
+    kernel = functools.partial(
+        _paged_kernel, bs=bs, mb=mb, heads=h, sm_scale=sm_scale,
+        precision=precision)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block_table, lengths
+        grid=(r * h, mb),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda rh, j, bt_, ln_: (rh, 0)),
+            pl.BlockSpec(
+                (1, 1, bs, k_pool.shape[-1]),
+                lambda rh, j, bt_, ln_: (bt_[rh // h, j], rh % h, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, bs, v_pool.shape[-1]),
+                lambda rh, j, bt_, ln_: (bt_[rh // h, j], rh % h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda rh, j, bt_, ln_: (rh, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 128), jnp.float32),   # running max
+            pltpu.VMEM((1, 128), jnp.float32),   # running denom
+            pltpu.VMEM((1, d), jnp.float32),     # output accumulator
+        ],
+    )
+    compiler_params = None
+    if not interpret:
+        # the block axis is a sequential reduction (the scratch
+        # accumulators carry across j); lane-head programs are free
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r * h, d), v_pool.dtype),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(bt, lens, qf, k_pool, v_pool)
+    return out.reshape(r, h, d)

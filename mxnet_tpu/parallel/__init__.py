@@ -17,6 +17,7 @@ from .collectives import (
     ppermute,
     reduce_scatter,
     ring_shift,
+    shard_map,
 )
 from .mesh import (
     MESH_AXES,

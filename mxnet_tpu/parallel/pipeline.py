@@ -136,9 +136,10 @@ def gpipe(
                     f"(one stage per device); got {spec}")
     dspec = data_spec if data_spec is not None else P()
     body = lambda p, xs_: pipeline_apply(stage_fn, p, xs_, axis_name)
-    out = jax.shard_map(
+    from .collectives import shard_map
+
+    out = shard_map(
         body, mesh=mesh,
         in_specs=(stage_spec, dspec), out_specs=dspec,
-        check_vma=False,
     )(stacked_params, xs)
     return out.reshape(x.shape[0], *out.shape[2:])

@@ -208,7 +208,8 @@ def ring_self_attention(q, k, v, mesh=None, axis_name: str = "sp",
     body = functools.partial(fn, axis_name=axis_name, causal=causal,
                              sm_scale=sm_scale)
     spec = P(None, axis_name, None, None)
-    return jax.shard_map(
+    from .collectives import shard_map
+
+    return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )(q, k, v)

@@ -53,6 +53,7 @@ SITES = (
     "dataloader.next",    # gluon DataLoader batch fetch
     "device.put",         # ndarray host<->device / cross-device transfer
     "serving.infer",      # InferenceEngine micro-batch execution
+    "serving.llm",        # LLMEngine prefill-splice (admission into lanes)
     "compile",            # HybridBlock trace/compile path
     "aot.read",           # CompileCache entry lookup (before the read)
     "aot.write",          # CompileCache publish, payload staged, pre-rename

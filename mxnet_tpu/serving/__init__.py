@@ -11,20 +11,26 @@ model (ROADMAP: "serves heavy traffic from millions of users"):
   typed load shedding (:class:`ServerOverload`, :class:`DeadlineExceeded`);
 - :class:`ServingMetrics` (:mod:`.metrics`) — counters + latency/occupancy
   histograms, streamed through :mod:`mxnet_tpu.profiler`;
+- :class:`LLMEngine` (:mod:`.llm`) — continuous-batching autoregressive
+  generation: paged KV-cache block pool, prefill/decode disaggregation,
+  in-flight admission into a running decode batch;
 - :mod:`.bench` — the N-concurrent-synthetic-clients harness behind
   ``tools/serve_bench.py``.
 
-See ``docs/serving.md`` for architecture, bucketing policy and failure
-semantics.
+See ``docs/serving.md`` / ``docs/llm_serving.md`` for architecture,
+bucketing policy and failure semantics.
 """
 from .admission import (AdmissionQueue, DeadlineExceeded, Request,  # noqa: F401
                         ServerOverload)
 from .batcher import DynamicBatcher  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
+from .llm import GenRequest, LLMEngine  # noqa: F401
 from .metrics import Histogram, ServingMetrics  # noqa: F401
 
 __all__ = [
     "InferenceEngine",
+    "LLMEngine",
+    "GenRequest",
     "DynamicBatcher",
     "AdmissionQueue",
     "Request",

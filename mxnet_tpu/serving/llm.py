@@ -1,0 +1,807 @@
+"""``LLMEngine`` — continuous-batching autoregressive generation.
+
+The PR-1 :class:`~mxnet_tpu.serving.engine.InferenceEngine` micro-batches
+fixed-shape forward passes; autoregressive decode needs its own engine,
+because the unit of scheduling is a *step*, not a request. Decode is
+HBM-bandwidth bound (``benchmark/results_llm_tpu.json``: 3.3k tok/s
+against a 70k tok/s roofline — 4.7% utilization): every generated token
+re-reads all weights plus the KV cache, so throughput is won by filling
+the batch dimension and shrinking bytes/token. Three mechanisms:
+
+- **Paged KV-cache block pool** — the cache is a pool of fixed-size
+  (block_size x heads x head_dim) blocks plus a per-lane block table;
+  ``decode_step_paged`` gathers K/V through the table INSIDE the jitted
+  step (:func:`~mxnet_tpu.ops.nn.paged_attention`), so the pool shape is
+  static and sequence growth never retraces. int8 KV is the default
+  (half the bytes of bf16 on the read path, the existing per-token
+  dequant layout). Blocks return to the free list the moment a sequence
+  finishes: pool capacity — not ``max_length x max_batch`` — bounds
+  memory.
+- **Prefill/decode disaggregation** — prompts prefill as their own
+  pow2-bucketed compiled programs (the engine ladder-bucket idea applied
+  to the sequence axis) whose resulting KV blocks are spliced into the
+  running pool; decode runs as ONE fixed-shape program over
+  ``(max_running, 1)`` with retired lanes pointed at a trash block.
+- **In-flight (continuous) batching** — the scheduler admits new
+  sequences into empty decode lanes every step without flushing the
+  batch, layered on :mod:`.admission` deadlines/shedding, with
+  EOS/length retirement and per-token streaming.
+
+Observability: ``llm_*`` gauges/counters in the telemetry registry
+(lane occupancy, pool levels, prefill-vs-decode split, tok/s — all in
+the flight-recorder dump), decode/prefill steps spanned in the step
+timeline (``tools/trace_view.py`` attributes them), chaos site
+``serving.llm`` on the prefill-splice path, and scheduler faults typed
+through the resilience transient-vs-fatal classifier.
+
+See ``docs/llm_serving.md`` for block-table anatomy and scheduler
+policy.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as onp
+
+from .. import telemetry
+from ..base import (FatalError, MXNetError, TransientError, env_float,
+                    failsoft_call, preflight_backend)
+from ..resilience import chaos
+from ..resilience.retry import classify, TRANSIENT
+from ..telemetry import get_registry
+from .admission import AdmissionQueue, DeadlineExceeded, Request, ServerOverload
+
+__all__ = ["LLMEngine", "GenRequest"]
+
+
+class GenRequest(Request):
+    """One in-flight generation request.
+
+    ``wait()`` returns the generated tokens as an int32 numpy array
+    (length <= ``max_new_tokens``; generation stops after the first
+    ``eos_token``, which is included). ``on_token`` (optional) streams
+    each token from the scheduler thread as it is decoded — it must be
+    cheap and must not raise (a raising callback fails the request).
+    """
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_token", "on_token",
+                 "tokens", "prefill_s", "first_token_s")
+
+    def __init__(self, prompt, max_new_tokens: int, eos_token: int,
+                 deadline: Optional[float],
+                 on_token: Optional[Callable[[int], None]] = None):
+        super().__init__(prompt, 1, ("llm",), deadline)
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token = int(eos_token)
+        self.on_token = on_token
+        self.tokens: List[int] = []
+        self.prefill_s: Optional[float] = None
+        self.first_token_s: Optional[float] = None
+
+
+class _Lane:
+    """One decode lane: the request it carries + its block reservation."""
+
+    __slots__ = ("req", "blocks", "pos", "last_token")
+
+    def __init__(self, req: GenRequest, blocks: List[int], pos: int,
+                 last_token: int):
+        self.req = req
+        self.blocks = blocks        # pool block ids owned by this lane
+        self.pos = pos              # absolute position of the NEXT write
+        self.last_token = last_token
+
+
+class LLMMetrics:
+    """Registry-backed metrics for one :class:`LLMEngine` (labelled
+    ``engine=`` so several engines expose side by side; everything here
+    lands in the flight-recorder snapshot automatically)."""
+
+    _EVENTS = ("submitted", "admitted", "completed", "failed",
+               "shed_overload", "shed_deadline", "prefills",
+               "decode_steps", "resets", "compiles")
+
+    def __init__(self, engine_id: str):
+        reg = get_registry()
+        self.engine_id = engine_id
+        eng = {"engine": engine_id}
+        self._events = reg.counter(
+            "llm_events_total", "LLM serving lifecycle events",
+            ("engine", "event"))
+        self._counters = {e: self._events.labels(engine=engine_id, event=e)
+                         for e in self._EVENTS}
+        self._tokens = reg.counter(
+            "llm_tokens_total", "Generated tokens", ("engine", "phase"))
+        self.tokens_prefill = self._tokens.labels(engine=engine_id,
+                                                  phase="prefill")
+        self.tokens_decode = self._tokens.labels(engine=engine_id,
+                                                 phase="decode")
+        self.lanes_active = reg.gauge(
+            "llm_lanes_active", "Decode lanes currently generating",
+            ("engine",)).labels(**eng)
+        self.lanes_total = reg.gauge(
+            "llm_lanes_total", "Configured decode lanes (max_running)",
+            ("engine",)).labels(**eng)
+        self.pool_free = reg.gauge(
+            "llm_pool_blocks_free", "KV pool blocks on the free list",
+            ("engine",)).labels(**eng)
+        self.pool_total = reg.gauge(
+            "llm_pool_blocks_total", "KV pool blocks (allocatable)",
+            ("engine",)).labels(**eng)
+        self.tok_s = reg.gauge(
+            "llm_tok_s", "Aggregate decode tokens/s (rolling)",
+            ("engine",)).labels(**eng)
+        self.step_ms = reg.histogram(
+            "llm_step_ms", "Wall ms per scheduler step",
+            ("engine", "phase"))
+        self.decode_ms = self.step_ms.labels(engine=engine_id,
+                                             phase="decode")
+        self.prefill_ms = self.step_ms.labels(engine=engine_id,
+                                              phase="prefill")
+        self.token_latency_ms = reg.histogram(
+            "llm_token_latency_ms",
+            "Per-token latency (decode step wall / tokens in step)",
+            ("engine",)).labels(**eng)
+        self.queue_depth = reg.histogram(
+            "llm_queue_depth", "Queue depth at admission",
+            ("engine",)).labels(**eng)
+
+    # AdmissionQueue calls these two (the ServingMetrics seam)
+    def count(self, name: str, delta: int = 1) -> None:
+        c = self._counters.get(name)
+        if c is None:
+            c = self._events.labels(engine=self.engine_id, event=name)
+            self._counters[name] = c
+        c.inc(delta)
+
+    def observe_queue_depth(self, depth: int) -> None:
+        self.queue_depth.observe(float(depth))
+
+    def counters(self) -> Dict[str, int]:
+        return {name: int(c.value) for name, c in self._counters.items()}
+
+
+_engine_seq = __import__("itertools").count()
+
+
+class LLMEngine:
+    """Continuous-batching generation over a paged KV block pool.
+
+    Parameters
+    ----------
+    model : causal LM with the paged decode contract
+        ``decode_step_paged`` / ``init_block_pool`` (+ the dense
+        ``decode_step`` / ``init_cache`` used by prefill) —
+        :class:`~mxnet_tpu.gluon.model_zoo.bert._CausalLM` provides all
+        four.
+    max_running : int
+        Decode lanes (the fixed batch axis of the ONE decode program).
+        Default ``MXNET_TPU_LLM_MAX_RUNNING`` (8).
+    block_size : int
+        Positions per KV block. Default ``MXNET_TPU_LLM_BLOCK_SIZE``
+        (16).
+    max_context : int
+        Longest prompt+generation a lane may hold. Defaults to the
+        model's context window (``pos_embed`` rows), capped at 2048.
+    num_blocks : int
+        Pool capacity in blocks (+1 trash block is added internally).
+        Default ``MXNET_TPU_LLM_POOL_BLOCKS``, else enough for every
+        lane at ``max_context`` (no admission ever waits on blocks).
+        Smaller pools admit lazily: a request is admitted only when its
+        worst-case ``ceil((prompt+max_new)/block_size)`` reservation
+        fits the free list, so an in-flight sequence can never hit pool
+        exhaustion mid-decode.
+    kv_cache_dtype : str
+        ``"int8"`` (default — the HBM-bound decode path reads half the
+        bytes of bf16), or ``"float32"/"bfloat16"/"float16"`` for exact
+        parity with the dense cache.
+    weight_dtype : None | "int8"
+        Weight-only int8 for the decode program (halves weight bytes
+        per token; see :func:`generation.generate`).
+    greedy / temperature / top_k / seed
+        Sampling policy (engine-wide: it is baked into the compiled
+        programs).
+    max_queue_size / timeout_ms
+        Admission bound and default deadline (admission -> prefill
+        start), exactly the :class:`.admission.AdmissionQueue` contract.
+    donate : bool, optional
+        Donate the pool buffers to the decode/prefill programs (in-place
+        pool update). Default: on for accelerator backends, off on CPU.
+    """
+
+    def __init__(self, model, *, max_running: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 max_context: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 kv_cache_dtype: Optional[str] = "int8",
+                 weight_dtype: Optional[str] = None,
+                 greedy: bool = True, temperature: float = 1.0,
+                 top_k: int = 0, seed: int = 0,
+                 eos_token: int = -1,
+                 max_queue_size: int = 256,
+                 timeout_ms: Optional[float] = None,
+                 donate: Optional[bool] = None,
+                 metrics: Optional[LLMMetrics] = None):
+        from ..gluon.model_zoo.generation import _resolve_cache_dtype
+
+        if max_running is None:
+            max_running = int(env_float("MXNET_TPU_LLM_MAX_RUNNING", 8))
+        if block_size is None:
+            block_size = int(env_float("MXNET_TPU_LLM_BLOCK_SIZE", 16))
+        if max_running < 1 or block_size < 1:
+            raise ValueError("max_running and block_size must be >= 1")
+        self.max_running = int(max_running)
+        self.block_size = int(block_size)
+        model_ctx = None
+        pos_table = getattr(model, "pos_embed", None)
+        if pos_table is not None:
+            model_ctx = int(pos_table.shape[0])
+        if max_context is None:
+            max_context = min(model_ctx or 2048, 2048)
+        if model_ctx is not None and max_context > model_ctx:
+            raise MXNetError(
+                f"max_context {max_context} exceeds the model's context "
+                f"window (pos_embed rows = {model_ctx})")
+        self.max_context = int(max_context)
+        self.max_blocks_per_seq = -(-self.max_context // self.block_size)
+        if num_blocks is None:
+            num_blocks = int(env_float("MXNET_TPU_LLM_POOL_BLOCKS", 0)) \
+                or self.max_running * self.max_blocks_per_seq
+        if num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self._kv_dtype = _resolve_cache_dtype(model, kv_cache_dtype)
+        self._weight_dtype = weight_dtype
+        self._greedy = bool(greedy)
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        self._eos = int(eos_token)
+        self._timeout_ms = timeout_ms
+        self._model = model
+        self._key = jax.random.PRNGKey(seed)
+        self._step_seq = 0
+
+        preflight_backend()
+        if donate is None:
+            donate = failsoft_call(jax.default_backend) not in ("cpu",)
+        self._donate = bool(donate)
+
+        self.metrics = metrics or LLMMetrics(str(next(_engine_seq)))
+        self.metrics.lanes_total.set(self.max_running)
+        self.metrics.pool_total.set(self.num_blocks)
+
+        # pool state: +1 trash block at index num_blocks — retired lanes
+        # and pad splices write there, never into a live sequence
+        self._trash = self.num_blocks
+        pk, pv = model.init_block_pool(self.num_blocks + 1,
+                                       self.block_size,
+                                       dtype=self._kv_dtype)
+        self._pool_k, self._pool_v = pk._data, pv._data
+        self._free: List[int] = list(range(self.num_blocks))
+        self.metrics.pool_free.set(len(self._free))
+
+        # lane state (host side; device arrays mirror it each step)
+        self._lanes: List[Optional[_Lane]] = [None] * self.max_running
+        self._bt = onp.full((self.max_running, self.max_blocks_per_seq),
+                            self._trash, onp.int32)
+        self._pos = onp.zeros((self.max_running,), onp.int32)
+        self._toks = onp.zeros((self.max_running, 1), onp.int32)
+
+        # compiled programs (memoized per model config in generation.py;
+        # compiled through aot.cached_jit, so MXNET_TPU_AOT_CACHE serves
+        # fresh replicas with zero cold compiles)
+        from .. import aot
+        from ..gluon.model_zoo.generation import (paged_decode_program,
+                                                  paged_prefill_program)
+
+        self._paged_prefill_program = paged_prefill_program
+        self._decode_run, self._params = paged_decode_program(
+            model, max_running=self.max_running,
+            num_blocks=self.num_blocks + 1, block_size=self.block_size,
+            max_blocks_per_seq=self.max_blocks_per_seq,
+            kv_cache_dtype=self._kv_dtype, weight_dtype=weight_dtype,
+            greedy=greedy, temperature=temperature, top_k=top_k,
+            donate=self._donate)
+        self._prefill_runs: Dict[int, Callable] = {}
+        self._warmup_manifest = aot.WarmupManifest()
+        self._warm: set = set()
+        self._manifest_keyed: set = set()
+
+        # scheduler; the state lock covers pool/lane mutation (the
+        # scheduler tick vs a caller-thread warmup())
+        self._state_lock = threading.RLock()
+        self._queue = AdmissionQueue(max_queue_size, self.metrics)
+        self._closed = False
+        self._drain = True
+        self._broken: Optional[BaseException] = None
+        self._close_lock = threading.Lock()
+        self._tok_window: List = []     # (t, n) for the rolling tok/s gauge
+        self._thread = threading.Thread(target=self._loop,
+                                        name="llm-scheduler", daemon=True)
+        self._thread.start()
+
+    # -- prompt bucketing --------------------------------------------------
+    def _prefill_bucket(self, p: int) -> int:
+        """Smallest pow2 multiple of block_size >= p, capped at the
+        block-covered context (one compiled prefill program per bucket
+        — the engine's pow2 ladder policy applied to the block axis)."""
+        from .engine import _pow2_bucket
+
+        return self.block_size * _pow2_bucket(
+            -(-p // self.block_size), self.max_blocks_per_seq)
+
+    def _prefill_run(self, bucket: int) -> Callable:
+        run = self._prefill_runs.get(bucket)
+        if run is None:
+            run, _ = self._paged_prefill_program(
+                self._model, prefill_len=bucket,
+                num_blocks=self.num_blocks + 1,
+                block_size=self.block_size,
+                kv_cache_dtype=self._kv_dtype,
+                weight_dtype=self._weight_dtype, greedy=self._greedy,
+                temperature=self._temperature, top_k=self._top_k,
+                donate=self._donate)
+            self._prefill_runs[bucket] = run
+        return run
+
+    # -- client surface ----------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int,
+               eos_token: Optional[int] = None,
+               timeout_ms="default",
+               on_token: Optional[Callable[[int], None]] = None
+               ) -> GenRequest:
+        """Enqueue one prompt (1-D int sequence). Returns the
+        :class:`GenRequest` handle; ``handle.wait()`` yields the
+        generated int32 tokens. Raises :class:`ServerOverload` when the
+        admission queue is full."""
+        if self._closed:
+            raise ServerOverload("LLM engine is closed")
+        if self._broken is not None:
+            raise ServerOverload(
+                f"LLM engine stopped on a fatal fault: {self._broken!r}")
+        prompt = onp.asarray(prompt_ids, onp.int32).reshape(-1)
+        p = int(prompt.shape[0])
+        if p < 1:
+            raise ValueError("prompt must have >= 1 token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if p + max_new_tokens > self.max_context:
+            raise ValueError(
+                f"prompt {p} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_context {self.max_context}")
+        if -(-(p + max_new_tokens) // self.block_size) > self.num_blocks:
+            raise ValueError(
+                f"request needs more KV blocks than the whole pool holds "
+                f"({self.num_blocks} x {self.block_size}) — it could "
+                "never be admitted")
+        if timeout_ms == "default":
+            timeout_ms = self._timeout_ms
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        req = GenRequest(prompt, max_new_tokens,
+                         self._eos if eos_token is None else eos_token,
+                         deadline, on_token)
+        self._queue.submit(req)         # may raise ServerOverload
+        self.metrics.count("submitted")
+        return req
+
+    def generate(self, prompt_ids, max_new_tokens: int, **kw):
+        """Blocking convenience: submit + wait."""
+        return self.submit(prompt_ids, max_new_tokens, **kw).wait()
+
+    # -- scheduler ---------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            try:
+                idle = self._tick()
+            except Exception as e:  # noqa: BLE001 — typed + contained
+                if not self._fault(e):
+                    return
+                continue
+            if idle is None:        # closed and drained
+                return
+            if idle:
+                time.sleep(0.001)
+
+    def _tick(self):
+        """One scheduler iteration: admit into free lanes, then run one
+        decode step. Returns True when there is nothing to do (caller
+        sleeps a tick), None when closed-and-drained."""
+        with self._state_lock:
+            return self._tick_locked()
+
+    def _tick_locked(self):
+        active = [i for i in range(self.max_running)
+                  if self._lanes[i] is not None]
+        free = [i for i in range(self.max_running)
+                if self._lanes[i] is None]
+        if free and (len(self._queue) or not active):
+            got = self._queue.take(
+                max_items=len(free), max_wait_s=0.0,
+                poll_s=0.02 if not active else 1e-4)
+            for req in got:
+                self._admit(req, free.pop(0))
+            active = [i for i in range(self.max_running)
+                      if self._lanes[i] is not None]
+            free = [i for i in range(self.max_running)
+                    if self._lanes[i] is None]
+        if not active:
+            if self._closed and not len(self._queue):
+                return None
+            return True
+        self._decode_step(active)
+        return False
+
+    def _admit(self, req: GenRequest, lane_idx: int) -> None:
+        """Prefill ``req`` into ``lane_idx`` (or shed it typed: expired
+        deadline, or a pool that cannot hold its worst-case block
+        reservation — the conservative no-preemption policy documented
+        in docs/llm_serving.md)."""
+        now = time.monotonic()
+        if req.expired(now):
+            self.metrics.count("shed_deadline")
+            req.fail(DeadlineExceeded(
+                f"deadline passed while queued ({req.latency_s * 1e3:.1f} "
+                "ms) — shed before prefill"))
+            return
+        p = int(req.prompt.shape[0])
+        need = -(-(p + req.max_new_tokens) // self.block_size)
+        if need > len(self._free):
+            # no free blocks: shed typed-transient so the client's retry
+            # loop backs off and resubmits (never blocks the decode batch)
+            self.metrics.count("shed_overload")
+            req.fail(ServerOverload(
+                f"KV pool exhausted ({len(self._free)} free blocks, "
+                f"need {need}) — back off and retry"))
+            return
+        blocks = [self._free.pop() for _ in range(need)]
+        self.metrics.pool_free.set(len(self._free))
+        bucket = self._prefill_bucket(p)
+        nb_bucket = bucket // self.block_size
+        nb_real = -(-p // self.block_size)
+        ids = onp.full((nb_bucket,), self._trash, onp.int32)
+        ids[:nb_real] = blocks[:nb_real]
+        padded = onp.zeros((1, bucket), onp.int32)
+        padded[0, :p] = req.prompt
+        t0 = time.perf_counter()
+        ran = False
+        try:
+            # the chaos injection point for the splice path: an injected
+            # fault fails THIS request (typed through the classifier),
+            # injected latency holds the scheduler (deadline drills)
+            chaos.site("serving.llm", phase="prefill_splice", bucket=bucket)
+            run = self._prefill_run(bucket)
+            with telemetry.step("llm_prefill") as st:
+                with st.phase("device", "llm.prefill"):
+                    ran = True
+                    first, self._pool_k, self._pool_v = run(
+                        self._params, padded, onp.int32(p - 1),
+                        self._pool_k, self._pool_v, ids, self._next_key())
+                    first = int(first)
+        except Exception as e:
+            # contained: the fault fails THIS request, typed through the
+            # classifier; the engine keeps serving
+            self._free.extend(blocks)
+            self.metrics.pool_free.set(len(self._free))
+            if isinstance(e, (TransientError, FatalError)):
+                typed = e
+            else:
+                cls = (TransientError if classify(e) == TRANSIENT
+                       else FatalError)
+                typed = cls(f"LLM prefill fault: {e!r}")
+                typed.__cause__ = e
+            req.fail(typed)
+            self.metrics.count("failed")
+            self.metrics.count("resets")
+            if ran and self._donate:
+                # the failed program call may have consumed the donated
+                # pool buffers — escalate to the full reset path (the
+                # request is already failed; lanes/pool rebuild there)
+                raise
+            return
+        dt = time.perf_counter() - t0
+        self.metrics.count("prefills")
+        self.metrics.prefill_ms.observe(dt * 1e3)
+        self.metrics.tokens_prefill.inc()
+        self._record_manifest(
+            "llm.prefill", bucket, run,
+            (self._params, padded, onp.int32(p - 1), self._pool_k,
+             self._pool_v, ids, self._key))
+        req.prefill_s = dt
+        req.first_token_s = req.latency_s
+        lane = _Lane(req, blocks, pos=p, last_token=first)
+        if not self._push_token(lane, first):
+            self._release(lane, None)
+            return
+        if self._retire_if_done(lane, lane_idx=None):
+            return
+        self._lanes[lane_idx] = lane
+        self._bt[lane_idx, :] = self._trash
+        self._bt[lane_idx, :len(blocks)] = blocks
+        self._pos[lane_idx] = lane.pos
+        self._toks[lane_idx, 0] = lane.last_token
+        self.metrics.count("admitted")
+        self.metrics.lanes_active.set(
+            sum(1 for ln in self._lanes if ln is not None))
+
+    def _decode_step(self, active: List[int]) -> None:
+        t0 = time.perf_counter()
+        self._step_seq += 1
+        with telemetry.step("llm_decode", self._step_seq) as st:
+            with st.phase("device", "llm.decode"):
+                nxt, self._pool_k, self._pool_v = self._decode_run(
+                    self._params, self._toks, self._pool_k, self._pool_v,
+                    self._bt, self._pos, self._next_key())
+                nxt = onp.asarray(nxt)
+        dt = time.perf_counter() - t0
+        self.metrics.count("decode_steps")
+        self.metrics.decode_ms.observe(dt * 1e3)
+        self.metrics.token_latency_ms.observe(dt * 1e3 / len(active))
+        self.metrics.tokens_decode.inc(len(active))
+        self._record_manifest(
+            "llm.decode", self.max_running, self._decode_run,
+            (self._params, self._toks, self._pool_k, self._pool_v,
+             self._bt, self._pos, self._key))
+        self._observe_tok_s(len(active))
+        for i in active:
+            lane = self._lanes[i]
+            tok = int(nxt[i])
+            lane.pos += 1
+            lane.last_token = tok
+            if not self._push_token(lane, tok):
+                self._release(lane, i)
+                continue
+            if self._retire_if_done(lane, lane_idx=i):
+                continue
+            self._pos[i] = lane.pos
+            self._toks[i, 0] = tok
+        self.metrics.lanes_active.set(
+            sum(1 for ln in self._lanes if ln is not None))
+
+    def _push_token(self, lane: _Lane, tok: int) -> bool:
+        """Record + stream one token. Returns False when the request's
+        ``on_token`` callback raised — the request is failed (typed
+        FATAL: a client bug, not a serving fault) and contained to its
+        own lane; other lanes keep decoding."""
+        lane.req.tokens.append(tok)
+        cb = lane.req.on_token
+        if cb is None:
+            return True
+        try:
+            cb(tok)
+            return True
+        except Exception as e:  # noqa: BLE001 — client code
+            err = FatalError(f"on_token callback raised: {e!r}")
+            err.__cause__ = e
+            lane.req.fail(err)
+            self.metrics.count("failed")
+            return False
+
+    def _retire_if_done(self, lane: _Lane, lane_idx: Optional[int]) -> bool:
+        req = lane.req
+        done = (len(req.tokens) >= req.max_new_tokens
+                or req.tokens[-1] == req.eos_token)
+        if not done:
+            return False
+        self._release(lane, lane_idx)
+        req.finish(onp.asarray(req.tokens, onp.int32))
+        self.metrics.count("completed")
+        return True
+
+    def _release(self, lane: _Lane, lane_idx: Optional[int]) -> None:
+        """Free the lane's blocks the moment its sequence finishes."""
+        self._free.extend(lane.blocks)
+        lane.blocks = []
+        self.metrics.pool_free.set(len(self._free))
+        if lane_idx is not None:
+            self._lanes[lane_idx] = None
+            self._bt[lane_idx, :] = self._trash
+            self._pos[lane_idx] = 0
+            self._toks[lane_idx, 0] = 0
+
+    # -- fault handling ----------------------------------------------------
+    def _fault(self, exc: Exception) -> bool:
+        """Type the fault through the resilience classifier, fail every
+        in-flight request with it, reset the pool (donated buffers may
+        be gone). Returns False (stop the scheduler) on FATAL."""
+        with self._state_lock:   # a caller-thread warmup() must not
+            return self._fault_locked(exc)  # interleave the pool rebuild
+
+    def _fault_locked(self, exc: Exception) -> bool:
+        kind = classify(exc)
+        if isinstance(exc, (TransientError, FatalError)):
+            typed = exc
+        else:
+            cls = TransientError if kind == TRANSIENT else FatalError
+            typed = cls(f"LLM scheduler fault ({kind}): {exc!r}")
+            typed.__cause__ = exc
+        self.metrics.count("resets")
+        fatal = kind != TRANSIENT
+        if fatal:
+            # flip to broken BEFORE any request observes its failure —
+            # a caller woken by req.fail must find submit() shedding
+            self._broken = typed
+            self._queue.close()
+        for i, lane in enumerate(self._lanes):
+            if lane is not None:
+                self._release(lane, i)
+                lane.req.fail(typed)
+                self.metrics.count("failed")
+        # the failed program call may have consumed donated pool
+        # buffers: rebuild them (zeroed — no live lanes remain)
+        pk, pv = self._model.init_block_pool(
+            self.num_blocks + 1, self.block_size, dtype=self._kv_dtype)
+        self._pool_k, self._pool_v = pk._data, pv._data
+        self._free = list(range(self.num_blocks))
+        self.metrics.pool_free.set(len(self._free))
+        self.metrics.lanes_active.set(0)
+        if not fatal:
+            return True                 # keep serving new requests
+        n = self._queue.fail_all(lambda: ServerOverload(
+            f"LLM engine stopped on a fatal fault: {typed!r}"))
+        self.metrics.count("failed", n)
+        # post-mortem with the lane/pool gauges in it (no-op unarmed)
+        telemetry.flight.try_dump("llm_fatal")
+        return False
+
+    # -- misc --------------------------------------------------------------
+    def _next_key(self):
+        if self._greedy:
+            return self._key
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _observe_tok_s(self, n: int) -> None:
+        now = time.monotonic()
+        w = self._tok_window
+        w.append((now, n))
+        while w and now - w[0][0] > 5.0:
+            w.pop(0)
+        span = now - w[0][0] if len(w) > 1 else 0.0
+        if span > 0:
+            self.metrics.tok_s.set(sum(x[1] for x in w[1:]) / span)
+
+    def _record_manifest(self, label: str, bucket: int, run=None,
+                         args=()) -> None:
+        """Decode-frontier warmup manifest: every compiled program's
+        signature (+ AOT store key when the persistent cache is armed)
+        so replicas replay exactly this frontier (``engine.warmup``,
+        ``tools/aot_warmup.py --manifest``). Best-effort: must never
+        fail a served step."""
+        ident = (label, bucket)
+        if ident in self._manifest_keyed:
+            return
+        self._manifest_keyed.add(ident)
+        entry = {"label": label, "bucket": int(bucket),
+                 "dtype": str(self._kv_dtype)}
+        try:
+            key = getattr(run, "resolved_key", lambda *a: None)(*args)
+            if key:
+                entry["key"] = key
+        except Exception:  # noqa: BLE001
+            pass
+        self._warmup_manifest.record(**entry)
+        self.metrics.count("compiles")
+
+    # -- warmup / manifests ------------------------------------------------
+    def warmup(self, prompt_lengths=None, manifest=None) -> List[int]:
+        """Pre-compile the decode program and the prefill buckets so the
+        first real traffic pays no cold compiles (with
+        ``MXNET_TPU_AOT_CACHE`` armed, compiles resolve from the
+        persistent store — the zero-cold-compile replica scale-up path).
+
+        ``prompt_lengths``: iterable of representative prompt lengths
+        (default: one, ``block_size``); ``manifest``: a
+        :class:`~mxnet_tpu.aot.WarmupManifest` (or path) recorded by a
+        previous engine — replays exactly its prefill-bucket frontier.
+        Returns the warmed prefill buckets."""
+        from .. import aot
+
+        if manifest is not None:
+            if not isinstance(manifest, aot.WarmupManifest):
+                manifest = aot.WarmupManifest.load(manifest)
+            buckets = sorted({int(e["bucket"])
+                              for e in manifest.entries()
+                              if e.get("label") == "llm.prefill"
+                              and e.get("bucket")})
+        else:
+            lens = (list(prompt_lengths) if prompt_lengths
+                    else [self.block_size])
+            buckets = sorted({self._prefill_bucket(int(p)) for p in lens})
+        # warming is running: one real (trash-table) call per program
+        self._warmup_buckets(buckets)
+        return buckets
+
+    def _warmup_buckets(self, buckets) -> None:
+        with self._state_lock:
+            self._warmup_buckets_locked(buckets)
+
+    def _warmup_buckets_locked(self, buckets) -> None:
+        for b in buckets:
+            if ("llm.prefill", b) in self._warm:
+                continue
+            run = self._prefill_run(b)
+            padded = onp.zeros((1, b), onp.int32)
+            ids = onp.full((b // self.block_size,), self._trash, onp.int32)
+            _, self._pool_k, self._pool_v = run(
+                self._params, padded, onp.int32(0), self._pool_k,
+                self._pool_v, ids, self._next_key())
+            self._warm.add(("llm.prefill", b))
+            self._record_manifest(
+                "llm.prefill", b, run,
+                (self._params, padded, onp.int32(0), self._pool_k,
+                 self._pool_v, ids, self._key))
+        if "decode" not in self._warm:
+            toks = onp.zeros((self.max_running, 1), onp.int32)
+            bt = onp.full((self.max_running, self.max_blocks_per_seq),
+                          self._trash, onp.int32)
+            pos = onp.zeros((self.max_running,), onp.int32)
+            _, self._pool_k, self._pool_v = self._decode_run(
+                self._params, toks, self._pool_k, self._pool_v, bt, pos,
+                self._next_key())
+            self._warm.add("decode")
+            self._record_manifest(
+                "llm.decode", self.max_running, self._decode_run,
+                (self._params, toks, self._pool_k, self._pool_v, bt, pos,
+                 self._key))
+
+    def warmup_manifest(self):
+        """The live decode-frontier manifest (keeps growing)."""
+        return self._warmup_manifest
+
+    def save_warmup_manifest(self, path: str) -> str:
+        return self._warmup_manifest.save(path)
+
+    # -- stats / lifecycle -------------------------------------------------
+    def stats(self) -> Dict:
+        from .. import aot
+
+        c = self.metrics.counters()
+        return {
+            "counters": c,
+            "lanes_active": int(self.metrics.lanes_active.get()),
+            "max_running": self.max_running,
+            "block_size": self.block_size,
+            "pool_blocks_total": self.num_blocks,
+            "pool_blocks_free": len(self._free),
+            "kv_cache_dtype": self._kv_dtype,
+            "tok_s": round(float(self.metrics.tok_s.get()), 2),
+            "decode_step_ms": self.metrics.decode_ms.summary(),
+            "prefill_ms": self.metrics.prefill_ms.summary(),
+            "token_latency_ms": self.metrics.token_latency_ms.summary(),
+            "queue_len": len(self._queue),
+            "aot": aot.stats(),
+        }
+
+    def close(self, drain: bool = True, timeout_s: float = 60.0) -> None:
+        """Stop admitting; finish in-flight + queued work
+        (``drain=True``) or fail it, then stop the scheduler."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._drain = drain
+            self._queue.close()
+            if not drain:
+                self._queue.fail_all(
+                    lambda: ServerOverload("engine closed without drain"))
+                # lane/pool teardown under the state lock: the scheduler
+                # may be mid-tick on these structures
+                with self._state_lock:
+                    for i, lane in enumerate(self._lanes):
+                        if lane is not None:
+                            self._release(lane, i)
+                            lane.req.fail(ServerOverload(
+                                "engine closed without drain"))
+        self._thread.join(timeout_s)
+
+    def __enter__(self) -> "LLMEngine":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -205,9 +205,9 @@ def test_sync_batch_norm_syncs_across_mesh_axis():
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
 
     from mxnet_tpu import parallel
+    from mxnet_tpu.parallel import shard_map
     from mxnet_tpu.ops import contrib as C
 
     mesh = parallel.make_mesh({"dp": 8})

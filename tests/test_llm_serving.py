@@ -1,0 +1,473 @@
+"""Continuous-batching LLM serving (serving.llm + the paged KV path).
+
+Correctness pins (ISSUE 7): paged decode must be token-identical to the
+dense cache on greedy decode; in-flight admission must produce exactly
+the tokens offline ``generate()`` produces per sequence; block churn
+must recycle the free list; sequence-length growth must never retrace;
+faults are typed through the resilience classifier; a chaos kill
+mid-decode leaves a flight dump carrying lane/pool state.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import bert
+from mxnet_tpu.gluon.model_zoo.generation import generate
+from mxnet_tpu.serving.llm import LLMEngine
+from mxnet_tpu.serving.admission import ServerOverload
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_lm(seed=0, vocab=37, units=16, heads=4, layers=2, max_length=64):
+    onp.random.seed(seed)
+    net = bert.gpt_like(vocab_size=vocab, units=units, hidden_size=2 * units,
+                        num_layers=layers, num_heads=heads,
+                        max_length=max_length, dropout=0.0)
+    net.initialize()
+    return net
+
+
+def _engine(net, **kw):
+    kw.setdefault("max_running", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_context", 32)
+    kw.setdefault("kv_cache_dtype", "float32")
+    return LLMEngine(net, **kw)
+
+
+# ---------------------------------------------------------------------------
+# op level
+# ---------------------------------------------------------------------------
+def test_paged_attention_matches_manual():
+    """The jnp gather path against a dense numpy oracle."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import paged_attention
+
+    rng = onp.random.RandomState(0)
+    r, h, d, bs, nb, mb = 3, 2, 8, 4, 7, 3
+    q = rng.randn(r, h, d).astype(onp.float32)
+    kp = rng.randn(nb, h, bs, d).astype(onp.float32)
+    vp = rng.randn(nb, h, bs, d).astype(onp.float32)
+    bt = rng.randint(0, nb, (r, mb)).astype(onp.int32)
+    lens = onp.array([3, 7, 12], onp.int32)
+    out = onp.asarray(paged_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(lens), use_kernel=False))
+    for i in range(r):
+        keys = kp[bt[i]].transpose(1, 0, 2, 3).reshape(h, mb * bs, d)
+        vals = vp[bt[i]].transpose(1, 0, 2, 3).reshape(h, mb * bs, d)
+        for hh in range(h):
+            s = keys[hh, :lens[i]] @ q[i, hh] / onp.sqrt(d)
+            p = onp.exp(s - s.max())
+            p /= p.sum()
+            want = p @ vals[hh, :lens[i]]
+            onp.testing.assert_allclose(out[i, hh], want, rtol=2e-5,
+                                        atol=2e-5)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_paged_kernel_matches_jnp(dtype):
+    """The Pallas kernel (interpret mode on CPU — the compiled Mosaic
+    path on TPU) against the jnp gather oracle."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import paged_attention
+    from mxnet_tpu.ops.pallas.paged_attention import paged_attention_kernel
+
+    rng = onp.random.RandomState(1)
+    r, h, d, bs, nb, mb = 3, 4, 16, 8, 10, 4
+    q = jnp.asarray(rng.randn(r, h, d), dtype)
+    kp = jnp.asarray(rng.randn(nb, h, bs, d), dtype)
+    vp = jnp.asarray(rng.randn(nb, h, bs, d), dtype)
+    bt = jnp.asarray(rng.randint(0, nb, (r, mb)).astype(onp.int32))
+    lens = jnp.asarray(onp.array([5, 17, 32], onp.int32))
+    ref = paged_attention(q, kp, vp, bt, lens, use_kernel=False)
+    got = paged_attention_kernel(q, kp, vp, bt, lens, interpret=True)
+    tol = 3e-2 if dtype == "bfloat16" else 2e-5
+    onp.testing.assert_allclose(onp.asarray(got, dtype=onp.float32),
+                                onp.asarray(ref, dtype=onp.float32),
+                                rtol=tol, atol=tol)
+
+
+# ---------------------------------------------------------------------------
+# paged vs dense decode
+# ---------------------------------------------------------------------------
+@pytest.mark.seed(31)
+def test_paged_decode_token_identical_to_dense():
+    """Greedy decode through the engine == offline generate() — prompt
+    lengths chosen to hit partial blocks and block-boundary crossings."""
+    net = _tiny_lm()
+    with _engine(net) as eng:
+        for p_len, n_new in ((4, 6), (5, 7), (3, 9), (8, 4)):
+            prompt = onp.arange(1, p_len + 1, dtype=onp.int32) % 37
+            ref = generate(net, prompt[None], max_new_tokens=n_new,
+                           greedy=True).asnumpy()[0]
+            got = eng.generate(prompt, n_new)
+            onp.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.seed(32)
+def test_inflight_admission_token_parity():
+    """Sequences admitted INTO a running decode batch still produce
+    exactly the offline tokens (the in-flight batching acceptance)."""
+    net = _tiny_lm(seed=1)
+    rng = onp.random.RandomState(2)
+    reqs = [(rng.randint(0, 37, (p,)).astype(onp.int32), n)
+            for p, n in ((4, 12), (7, 10), (3, 14), (9, 8), (5, 12),
+                         (6, 9))]
+    refs = [generate(net, p[None], max_new_tokens=n, greedy=True)
+            .asnumpy()[0] for p, n in reqs]
+    with _engine(net, max_running=2) as eng:  # 2 lanes, 6 requests:
+        # admissions necessarily land mid-decode of earlier sequences
+        handles = []
+        for i, (p, n) in enumerate(reqs):
+            handles.append(eng.submit(p, n))
+            if i == 1:
+                time.sleep(0.02)  # let the first pair start decoding
+        outs = [h.wait(timeout=120) for h in handles]
+    for got, ref in zip(outs, refs):
+        onp.testing.assert_array_equal(onp.asarray(got), ref)
+
+
+@pytest.mark.seed(33)
+def test_int8_kv_parity_bound():
+    """int8-KV engine (the default config) tokens mostly agree with the
+    fp32 path on a random tiny model (quantization may flip near-tie
+    argmaxes — same bound as the dense int8 test)."""
+    net = _tiny_lm(seed=3)
+    prompt = onp.array([1, 5, 9, 2], onp.int32)
+    ref = generate(net, prompt[None], max_new_tokens=8,
+                   greedy=True).asnumpy()[0]
+    with _engine(net, kv_cache_dtype="int8") as eng:
+        got = onp.asarray(eng.generate(prompt, 8))
+    assert got.shape == ref.shape
+    assert (got == ref).mean() >= 0.6, (got, ref)
+
+
+# ---------------------------------------------------------------------------
+# pool / scheduler behavior
+# ---------------------------------------------------------------------------
+@pytest.mark.seed(34)
+def test_block_freelist_reuse_under_churn():
+    """Waves of requests through a small pool: blocks recycle, the free
+    list returns to full, and every sequence is correct."""
+    net = _tiny_lm(seed=4)
+    with _engine(net, max_running=2, num_blocks=8) as eng:
+        for wave in range(4):
+            prompts = [onp.array([wave + 1, 2, 3], onp.int32),
+                       onp.array([5, wave + 1], onp.int32)]
+            handles = [eng.submit(p, 6) for p in prompts]
+            outs = [h.wait(timeout=120) for h in handles]
+            for p, o in zip(prompts, outs):
+                ref = generate(net, p[None], max_new_tokens=6,
+                               greedy=True).asnumpy()[0]
+                onp.testing.assert_array_equal(onp.asarray(o), ref)
+            assert eng.stats()["pool_blocks_free"] == 8
+        c = eng.stats()["counters"]
+        assert c["completed"] == 8 and c["failed"] == 0
+
+
+@pytest.mark.seed(35)
+def test_pool_exhaustion_sheds_typed():
+    """A pool that can hold one sequence: concurrent requests beyond it
+    shed with ServerOverload (a TransientError — the client retry loop
+    contract), never deadlock, and the pool recovers."""
+    from mxnet_tpu.base import TransientError
+
+    net = _tiny_lm(seed=5)
+    # 3 blocks of 4 = one (p=4 + n=8) sequence exactly
+    with _engine(net, max_running=4, num_blocks=3) as eng:
+        handles = [eng.submit(onp.array([1, 2, 3, 4], onp.int32), 8)
+                   for _ in range(3)]
+        done = shed = 0
+        for h in handles:
+            try:
+                h.wait(timeout=120)
+                done += 1
+            except ServerOverload as e:
+                assert isinstance(e, TransientError)
+                shed += 1
+        assert done >= 1 and done + shed == 3
+        assert eng.stats()["pool_blocks_free"] == 3
+
+
+@pytest.mark.seed(36)
+def test_no_retrace_across_sequence_lengths():
+    """The sentinel: ONE decode trace serves every mix of prompt
+    lengths, generation lengths, admissions and retirements (jit cache
+    size pinned), and the engine reports zero compiles during serving."""
+    net = _tiny_lm(seed=6)
+    with _engine(net) as eng:
+        eng.warmup(prompt_lengths=[3, 5, 9])
+        decode_jit = eng._decode_run._plain
+        assert decode_jit is not None and decode_jit._cache_size() == 1
+        compiles0 = eng.stats()["counters"]["compiles"]
+        rng = onp.random.RandomState(7)
+        handles = [eng.submit(rng.randint(0, 37, (p,)).astype(onp.int32), n)
+                   for p, n in ((3, 5), (5, 9), (9, 12), (4, 7), (8, 3))]
+        for h in handles:
+            h.wait(timeout=120)
+        assert decode_jit._cache_size() == 1  # no retrace, ever
+        assert eng.stats()["counters"]["compiles"] == compiles0
+
+
+@pytest.mark.seed(37)
+def test_streaming_and_eos_retirement():
+    net = _tiny_lm(seed=7)
+    prompt = onp.array([1, 2], onp.int32)
+    first = int(generate(net, prompt[None], max_new_tokens=1,
+                         greedy=True).asnumpy()[0, 0])
+    seen = []
+    with _engine(net) as eng:
+        out = onp.asarray(eng.submit(prompt, 6, on_token=seen.append)
+                          .wait(timeout=120))
+        # eos == the first greedy token -> retire after ONE token and
+        # free the blocks immediately
+        out_eos = onp.asarray(eng.submit(prompt, 6, eos_token=first)
+                              .wait(timeout=120))
+        assert eng.stats()["pool_blocks_free"] == \
+            eng.stats()["pool_blocks_total"]
+    assert seen == list(out)            # streamed == final, in order
+    assert list(out_eos) == [first]
+
+
+@pytest.mark.seed(41)
+def test_raising_stream_callback_contained_to_its_request():
+    """A client callback bug fails ITS request (typed FATAL) without
+    touching other lanes or the engine."""
+    from mxnet_tpu.base import FatalError
+
+    net = _tiny_lm(seed=12)
+    prompt = onp.array([1, 2, 3], onp.int32)
+    ref = generate(net, prompt[None], max_new_tokens=6,
+                   greedy=True).asnumpy()[0]
+
+    def bad_cb(tok):
+        raise RuntimeError("client bug")
+
+    with _engine(net) as eng:
+        h_bad = eng.submit(prompt, 6, on_token=bad_cb)
+        h_ok = eng.submit(prompt, 6)
+        with pytest.raises(FatalError):
+            h_bad.wait(timeout=120)
+        onp.testing.assert_array_equal(
+            onp.asarray(h_ok.wait(timeout=120)), ref)
+        st = eng.stats()
+        assert st["pool_blocks_free"] == st["pool_blocks_total"]
+        # the engine is NOT broken: serve again
+        onp.testing.assert_array_equal(
+            onp.asarray(eng.generate(prompt, 6)), ref)
+
+
+def test_deadline_shed_typed():
+    from mxnet_tpu.serving.admission import DeadlineExceeded
+
+    net = _tiny_lm(seed=8)
+    with _engine(net) as eng:
+        # expired before the scheduler can prefill: shed, typed, no
+        # compute spent
+        h = eng.submit(onp.array([1, 2, 3], onp.int32), 4,
+                       timeout_ms=0.0001)
+        with pytest.raises(DeadlineExceeded):
+            h.wait(timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# faults: chaos site, classifier typing, flight dump
+# ---------------------------------------------------------------------------
+@pytest.mark.seed(38)
+def test_chaos_prefill_fault_typed_and_contained():
+    """A chaos fault on the prefill-splice path fails THAT request with
+    the typed error; the engine keeps serving afterwards."""
+    from mxnet_tpu.resilience import chaos
+
+    net = _tiny_lm(seed=9)
+    prompt = onp.array([1, 2, 3], onp.int32)
+    with _engine(net) as eng:
+        with chaos.scope("serving.llm", fail="transient", times=1):
+            h = eng.submit(prompt, 4)
+            with pytest.raises(chaos.ChaosTransient):
+                h.wait(timeout=120)
+        # engine recovered: full pool, next request serves
+        ref = generate(net, prompt[None], max_new_tokens=4,
+                       greedy=True).asnumpy()[0]
+        onp.testing.assert_array_equal(
+            onp.asarray(eng.generate(prompt, 4)), ref)
+        st = eng.stats()
+        assert st["pool_blocks_free"] == st["pool_blocks_total"]
+        assert st["counters"]["resets"] == 1
+
+
+@pytest.mark.seed(39)
+def test_scheduler_fatal_typed_and_engine_stops():
+    """A non-chaos scheduler bug classifies FATAL: in-flight requests
+    fail with FatalError, later submits shed typed."""
+    from mxnet_tpu.base import FatalError
+
+    net = _tiny_lm(seed=10)
+    eng = _engine(net)
+    try:
+        def boom(*a, **k):
+            raise ValueError("scheduler bug")  # classifier: FATAL
+
+        eng._decode_run = boom
+        h = eng.submit(onp.array([1, 2, 3], onp.int32), 6)
+        with pytest.raises(FatalError):
+            h.wait(timeout=120)
+        with pytest.raises(ServerOverload):
+            eng.submit(onp.array([1], onp.int32), 2)
+    finally:
+        eng.close(drain=False)
+
+
+_KILL_DRILL = """
+import os
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import bert
+from mxnet_tpu.serving.llm import LLMEngine
+
+onp.random.seed(0)
+net = bert.gpt_like(vocab_size=37, units=16, hidden_size=32, num_layers=2,
+                    num_heads=4, max_length=64, dropout=0.0)
+net.initialize()
+eng = LLMEngine(net, max_running=2, block_size=4, max_context=32,
+                kv_cache_dtype="float32")
+# 1st prefill survives and starts decoding; the 2nd admission fires the
+# chaos kill MID-DECODE of lane 0
+h1 = eng.submit(onp.array([1, 2, 3, 4], onp.int32), 24)
+h2 = eng.submit(onp.array([5, 6], onp.int32), 24)
+h1.wait(timeout=120)
+h2.wait(timeout=120)
+print("UNREACHABLE")
+"""
+
+
+def test_chaos_kill_mid_decode_leaves_flight_dump(tmp_path):
+    """The ISSUE 7 drill: a chaos kill mid-decode must leave a
+    parseable post-mortem whose metrics carry the lane/pool state."""
+    flight = tmp_path / "flight"
+    script = tmp_path / "drill.py"
+    script.write_text(_KILL_DRILL)
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+               MXNET_TPU_FLIGHT_DIR=str(flight),
+               MXNET_TPU_CHAOS="serving.llm=kill:2")
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 137, (r.returncode, r.stderr[-2000:])
+    assert "UNREACHABLE" not in r.stdout
+    latest = flight / "flight_latest.json"
+    assert latest.exists(), "chaos kill must leave a post-mortem"
+    payload = json.loads(latest.read_text())
+    assert payload["reason"] == "chaos_kill:serving.llm"
+    # lane/pool state rode along in the registry snapshot
+    metrics = payload["metrics"]["metrics"]
+    assert "llm_lanes_active" in metrics
+    assert "llm_pool_blocks_free" in metrics
+    assert "llm_events_total" in metrics
+    free = metrics["llm_pool_blocks_free"]["series"][0]["value"]
+    total = metrics["llm_pool_blocks_total"]["series"][0]["value"]
+    assert 0 <= free < total    # lane 0 held blocks when the kill hit
+    # decode spans made it into the ring tail
+    span_names = {s.get("name") for s in payload["spans"]}
+    assert any(n and n.startswith("step[llm_") for n in span_names)
+
+
+# ---------------------------------------------------------------------------
+# telemetry + AOT
+# ---------------------------------------------------------------------------
+@pytest.mark.seed(40)
+def test_telemetry_gauges_and_step_spans():
+    from mxnet_tpu import telemetry
+
+    net = _tiny_lm(seed=11)
+    with _engine(net) as eng:
+        eng.generate(onp.array([1, 2, 3], onp.int32), 5)
+        eid = eng.metrics.engine_id
+        snap = telemetry.snapshot()
+        by_name = snap["metrics"]
+        assert "llm_lanes_active" in by_name
+        assert "llm_pool_blocks_free" in by_name
+        series = {tuple(sorted(s["labels"].items())): s
+                  for s in by_name["llm_tokens_total"]["series"]}
+        dec = series[(("engine", eid), ("phase", "decode"))]["value"]
+        pre = series[(("engine", eid), ("phase", "prefill"))]["value"]
+        assert pre == 1 and dec == 4       # 5 tokens = 1 prefill + 4 decode
+        prom = telemetry.prometheus_text()
+        assert "llm_tok_s" in prom and "llm_step_ms" in prom
+        # decode/prefill steps are step-timeline spans with attribution
+        # (what tools/trace_view.py consumes)
+        events = telemetry.tracing.buffer().snapshot()
+        steps = [e for e in events
+                 if e.get("name") in ("step[llm_decode]", "step[llm_prefill]")
+                 and e.get("cat") == "step"]
+        assert steps, "llm steps must land in the shared trace ring"
+        att = steps[-1]["args"]
+        assert "device" in att and "wall_ms" in att
+        assert att["device"] > 0
+
+
+_AOT_DRILL = """
+import os, sys, json
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import aot
+from mxnet_tpu.gluon.model_zoo import bert
+from mxnet_tpu.serving.llm import LLMEngine
+
+phase, store, manifest = sys.argv[1], sys.argv[2], sys.argv[3]
+onp.random.seed(0)
+net = bert.gpt_like(vocab_size=37, units=16, hidden_size=32, num_layers=2,
+                    num_heads=4, max_length=64, dropout=0.0)
+net.initialize()
+eng = LLMEngine(net, max_running=2, block_size=4, max_context=32,
+                kv_cache_dtype="float32")
+if phase == "cold":
+    eng.warmup(prompt_lengths=[3])
+    eng.save_warmup_manifest(manifest)
+else:
+    eng.warmup(manifest=manifest)
+out = eng.generate(onp.array([1, 2, 3], onp.int32), 4)
+eng.close()
+print(json.dumps({"aot": aot.stats(), "tokens": [int(t) for t in out]}))
+"""
+
+
+def test_aot_warm_start_zero_miss(tmp_path):
+    """The replica scale-up drill: a fresh process warming from the
+    manifest against the persistent store records ZERO cold compiles
+    for the decode-frontier programs — and generates the same tokens."""
+    store = tmp_path / "store"
+    manifest = tmp_path / "llm_manifest.json"
+    script = tmp_path / "drill.py"
+    script.write_text(_AOT_DRILL)
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+               MXNET_TPU_AOT_CACHE=str(store))
+    env.pop("MXNET_TPU_CHAOS", None)
+
+    def run(phase):
+        r = subprocess.run(
+            [sys.executable, str(script), phase, str(store), str(manifest)],
+            env=env, capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, r.stderr[-3000:]
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    cold = run("cold")
+    assert cold["aot"]["aot_puts"] > 0, cold
+    warm = run("warm")
+    assert warm["aot"]["aot_misses"] == 0, warm
+    assert warm["aot"]["aot_hits"] > 0, warm
+    assert warm["tokens"] == cold["tokens"]
+    # the manifest carries store keys for model-free replay
+    entries = json.loads(manifest.read_text())["entries"]
+    labels = {e["label"] for e in entries}
+    assert {"llm.prefill", "llm.decode"} <= labels
+    assert all(e.get("key") for e in entries)

@@ -5,11 +5,11 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 import pytest
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import mxnet_tpu as mx
 from mxnet_tpu import parallel
+from mxnet_tpu.parallel import shard_map
 from mxnet_tpu.gluon import nn
 
 

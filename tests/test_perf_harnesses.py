@@ -37,11 +37,13 @@ def test_bandwidth_schema():
 
     devs = jax.devices()
     mesh = Mesh(onp.array(devs), ("dp",))
+    from mxnet_tpu.parallel import shard_map
+
     x = jax.device_put(jnp.arange(len(devs) * 4, dtype=jnp.float32),
                        NamedSharding(mesh, P("dp")))
-    out = jax.jit(jax.shard_map(lambda s: jax.lax.psum(s, "dp"),
-                                mesh=mesh, in_specs=P("dp"),
-                                out_specs=P("dp")))(x)
+    out = jax.jit(shard_map(lambda s: jax.lax.psum(s, "dp"),
+                            mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp")))(x)
     expected = onp.arange(len(devs) * 4, dtype=onp.float32).reshape(
         len(devs), 4).sum(0)
     onp.testing.assert_allclose(onp.asarray(out)[:4], expected)
@@ -990,3 +992,48 @@ def test_daemon_rev_shadow_restores_best_current_rev_sample(tmp_path):
     row2 = out2["results"][0]
     assert row2["train_img_s"] == 100     # still shadowed
     assert row2["_shadow_best"]["train_img_s"] == 80
+
+
+def test_llm_serve_bench_quick(tmp_path):
+    """llm_serve_bench --quick end-to-end (the ISSUE 7 smoke): the
+    continuous-batching engine serves the mixed-length workload with
+    paged greedy decode TOKEN-IDENTICAL to the sequential generate()
+    baseline and ZERO compiles during the timed window (no retraces
+    across admission/retirement/sequence growth) — the schema contract
+    for the committed ``results_llm_serving_cpu.json``. The >=3x
+    speedup acceptance gate lives on the banked full run; the smoke
+    workload is too small for a stable ratio, so it only bounds the
+    regression."""
+    import json
+    import subprocess
+    import sys
+
+    out_file = str(tmp_path / "llm_serve.json")
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    for k in ("MXNET_TPU_CHAOS", "MXNET_TPU_AOT_CACHE", "MXNET_TPU_AOT",
+              "MXNET_TPU_LLM_MAX_RUNNING", "MXNET_TPU_LLM_BLOCK_SIZE",
+              "MXNET_TPU_LLM_POOL_BLOCKS"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "benchmark", "llm_serve_bench.py"),
+         "--quick", "--output", out_file],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(open(out_file).read())
+    assert rec["quick"] is True
+    assert rec["metric"] == "llm_continuous_batching"
+    assert rec["value"] > 0 and rec["sequential"]["tok_s"] > 0
+    # the correctness gates hold at any scale
+    assert rec["parity"]["token_identical"] is True
+    assert rec["parity"]["n_mismatched"] == 0
+    assert rec["zero_retraces"] is True
+    eng = rec["engine"]
+    assert eng["kv_cache_dtype"] == "int8"        # the default config
+    assert eng["compiles_during_serving"] == 0
+    assert rec["engine_fp32"]["compiles_during_serving"] == 0
+    assert 1 <= eng["lane_occupancy"] <= eng["lanes"]
+    assert eng["token_latency_p50_ms"] > 0
+    assert eng["token_latency_p99_ms"] >= eng["token_latency_p50_ms"]
+    # smoke-scale throughput bound only (full-run gate is >= 3x)
+    assert rec["speedup"] > 0.8, rec["speedup"]

@@ -41,18 +41,19 @@ def measure(sizes_mb, runs=10, log=print):
         x = jnp.asarray(onp.random.randn(elems).astype(onp.float32))
         x = jax.device_put(x, NamedSharding(mesh, P("dp")))
 
+        from mxnet_tpu.parallel import shard_map
+
         @jax.jit
         def allreduce(a):
-            return jax.shard_map(
+            return shard_map(
                 lambda s: jax.lax.psum(s, "dp"),
                 mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(a)
 
         @jax.jit
         def allgather(a):
-            return jax.shard_map(
+            return shard_map(
                 lambda s: jax.lax.all_gather(s, "dp", tiled=True),
-                mesh=mesh, in_specs=P("dp"), out_specs=P(),
-                check_vma=False)(a)
+                mesh=mesh, in_specs=P("dp"), out_specs=P())(a)
 
         for name, fn, coll in (("allreduce", allreduce, "allreduce"),
                                ("all_gather", allgather, "all_gather")):

@@ -71,6 +71,10 @@ MODULES = {
     "mxnet_tpu.analysis": "tpulint — TPU anti-pattern analyzer "
                           "(jaxpr + AST rules, runtime sentinel)",
     "mxnet_tpu.aot": "persistent compile cache + ahead-of-time warmup",
+    "mxnet_tpu.serving": "dynamic-batching inference serving engine",
+    "mxnet_tpu.serving.llm": "continuous-batching LLM serving: paged "
+                             "KV block pool, prefill/decode split, "
+                             "in-flight admission",
     "mxnet_tpu.telemetry": "unified telemetry: metrics registry, step "
                            "tracing, MFU gauges, flight recorder",
 }
