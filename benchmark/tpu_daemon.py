@@ -67,6 +67,7 @@ TRAIN256 = os.path.join(HERE, "results_train_tpu_bs256.json")
 TRAIN_IO = os.path.join(HERE, "results_train_io_tpu.json")
 ATTNPROBE = os.path.join(HERE, "results_attn_probe_tpu.json")
 AOT = os.path.join(HERE, "results_aot_tpu.json")
+OPT = os.path.join(HERE, "results_opt_tpu.json")
 
 PROBE_INTERVAL_S = 60        # while the tunnel is down (windows can be
                              # ~4 min total; a slow probe cadence misses
@@ -1075,6 +1076,27 @@ def capture_aot() -> None:
             f"({rec.get('value')}x, misses={rec.get('warm_misses')})")
 
 
+def capture_opt() -> None:
+    """Auto-optimization row (benchmark/opt_bench.py): default vs
+    rewritten vs autotuned on the TPU backend — where the J001 tile
+    pads actually APPLY (the CPU row records them refused) and
+    steps_per_launch amortizes the real ~4.5 ms tunnel launch. Banks
+    MFU-relevant before/after plus the rewrite report."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "opt_bench.py"),
+         "--duration", "5", "--no-bank"],
+        timeout=2400, sample_liveness=True)
+    rec = parse_json_output(out)
+    if bank_if_tpu(OPT, rec, rc, "opt-auto") and rec:
+        st = rec.get("stages", {})
+        log(f"opt: default {st.get('default_steps_s')} -> rewritten "
+            f"{st.get('rewritten_steps_s')} -> tuned "
+            f"{st.get('tuned_steps_s')} steps/s "
+            f"({st.get('speedup_tuned')}x; "
+            f"{len(rec.get('rewrites', {}).get('applied', []))} "
+            f"rewrites applied)")
+
+
 def capture_quant() -> None:
     """INT8 PTQ ResNet-50: quantized throughput + top-1 agreement
     (benchmark/quant_bench.py) — int8 MXU has 2x the bf16 peak."""
@@ -1243,6 +1265,7 @@ CAPTURES = (
     ("infer-table", lambda: bool(stale_combos(INFER, INFER_COMBOS)),
      capture_infer_table),
     ("aot", banked_stale(AOT), capture_aot),
+    ("opt", banked_stale(OPT), capture_opt),
     ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION, 4 * 3600), capture_attention),

@@ -631,7 +631,7 @@ def run_quick(output=None, trace=None, steps=60, batch=64, hidden=256,
 
 
 def child_main(name, batch, prec, cpu, infer=False, recordio_input=False,
-               scan_steps=None, io_engine="sharded"):
+               scan_steps=None, io_engine="sharded", tuned=None):
     """Measure ONE (model, precision) pair and print its JSON record.
     Runs in a child process: the axon tunnel can hang mid-compile, and a
     hung child can be timed out and retried (in-process jax caches a dead
@@ -666,6 +666,28 @@ def child_main(name, batch, prec, cpu, infer=False, recordio_input=False,
     devs = jax.devices()
     up.set()
     log("devices:", devs)
+    # mx.analysis.opt consumption: a persisted TunedConfig supplies the
+    # launch-chain depth (and any env-backed knobs like stem_s2d) where
+    # the caller left the defaults; explicit --scan-steps wins. Stale
+    # configs are dropped by the loader with a warning.
+    tuned_cfg = None
+    if tuned:
+        from mxnet_tpu.analysis.opt import load_tuned
+        cfg = load_tuned(tuned)
+        if cfg.is_current():
+            tuned_cfg = cfg
+            if scan_steps is None and cfg.knobs.get("steps_per_launch"):
+                scan_steps = int(cfg.knobs["steps_per_launch"])
+            if cfg.knobs.get("stem_s2d") is not None:
+                v = cfg.knobs["stem_s2d"]
+                # bools survive the JSON round-trip as true/false, but
+                # the knob parser treats only the literal "0" as off —
+                # normalize bools; string values ("force") pass through
+                os.environ["MXNET_TPU_STEM_S2D"] = \
+                    str(int(v)) if isinstance(v, bool) else str(v)
+            log(f"tuned config {cfg.label}: {cfg.knobs}")
+        else:
+            log(f"tuned config {cfg.label} is STALE — ignoring")
     if scan_steps is None:
         scan_steps = 16 if devs[0].platform == "tpu" else 1
     if recordio_input:
@@ -678,6 +700,8 @@ def child_main(name, batch, prec, cpu, infer=False, recordio_input=False,
     rec["matmul_precision"] = fp32_prec if prec == "fp32" else "bf16-native"
     rec["device"] = devs[0].platform
     rec["device_kind"] = devs[0].device_kind
+    if tuned_cfg is not None:
+        rec["tuned"] = tuned_cfg.provenance()
     # AOT compile-cache counters (mxnet_tpu.aot): nonzero only when the
     # child ran with MXNET_TPU_AOT_CACHE armed — then the row records
     # how much cold-compile the store absorbed for this measurement
@@ -724,6 +748,12 @@ def main():
                          "process decode + epoch cache + on-device "
                          "augment (the ingestion engine); 'legacy' = "
                          "single-process C++ pool + double buffer")
+    ap.add_argument("--tuned", default=None,
+                    help="path to a persisted mx.analysis.opt "
+                         "TunedConfig: supplies steps_per_launch / "
+                         "stem_s2d where flags are left default "
+                         "(provenance recorded in the row; stale "
+                         "configs ignored with a log line)")
     ap.add_argument("--scan-steps", type=int, default=None,
                     help="serially-chained steps per launch (lax.scan "
                          "inside one executable). Default: 16 on TPU "
@@ -760,7 +790,8 @@ def main():
     if args.child:
         child_main(args.child[0], args.batch, args.child[1], args.cpu,
                    infer=args.infer, recordio_input=args.recordio_input,
-                   scan_steps=args.scan_steps, io_engine=args.io_engine)
+                   scan_steps=args.scan_steps, io_engine=args.io_engine,
+                   tuned=args.tuned)
         return
 
     def log(*a):
@@ -790,6 +821,8 @@ def main():
                    "--child", name, prec, "--batch", str(args.batch)]
             if args.scan_steps is not None:
                 cmd += ["--scan-steps", str(args.scan_steps)]
+            if args.tuned:
+                cmd += ["--tuned", args.tuned]
             if args.infer:
                 cmd.append("--infer")
             if args.recordio_input:

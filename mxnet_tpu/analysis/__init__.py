@@ -11,6 +11,10 @@ Three layers, one finding model (:class:`~.findings.Finding`):
 - :mod:`.sentinel` — opt-in runtime watch (``MXNET_TPU_LINT``):
   counts jit cache misses and device->host transfers through
   ``mx.profiler`` and warns/raises past a budget.
+- :mod:`.opt` — the transform arm: cost-model-gated jaxpr rewrites
+  (J001 pad-to-tile, J003 churn elimination), an analytic TPU cost
+  model calibrated on the banked bench corpus, and a knob autotuner
+  emitting fingerprint-keyed ``TunedConfig``s (``MXNET_TPU_OPT``).
 
 ``tools/tpulint.py`` is the CLI; the tier-1 suite self-lints the
 framework against ``tools/tpulint_baseline.json`` so new high-severity
@@ -30,6 +34,7 @@ from .jaxpr_rules import (  # noqa: F401
     lint_trainer,
 )
 from . import baseline  # noqa: F401
+from . import opt  # noqa: F401
 from . import sentinel  # noqa: F401
 from .sentinel import TpuLintWarning, LintBudgetExceeded  # noqa: F401
 
@@ -38,7 +43,8 @@ __all__ = [
     "lint_source", "lint_paths", "cache_key_knobs",
     "lint_jaxpr", "lint_callable", "lint_block",
     "find_donation_misses", "lint_trainer",
-    "baseline", "sentinel", "TpuLintWarning", "LintBudgetExceeded",
+    "baseline", "opt", "sentinel", "TpuLintWarning",
+    "LintBudgetExceeded",
 ]
 
 if _os.environ.get("MXNET_TPU_LINT"):

@@ -38,32 +38,66 @@ ZOO_MODELS = (
 )
 
 
-def lint_zoo(models=ZOO_MODELS) -> List[Finding]:
+def lint_zoo(models=ZOO_MODELS, rewrite: bool = True,
+             reports: Optional[list] = None) -> List[Finding]:
+    """Trace the zoo slice through the jaxpr rules.
+
+    ``rewrite=True`` (the gate default) first runs the cost-model-gated
+    ``opt`` rewrite pass over each model and lints the **transformed**
+    program — the "baseline as work queue" semantics: a J001 the
+    rewriter retires (because the TPU cost model predicts a win)
+    disappears from the ledger, while refused rewrites (memory-bound
+    ops, grouped convs) keep their entries. Decisions are appended to
+    ``reports`` (one ``RewriteReport`` per model) for the CLI to
+    render, so every baseline removal carries its predicted-win
+    justification."""
     import numpy as onp
 
     from ..gluon.model_zoo import vision
-    from .jaxpr_rules import lint_block
+    from .jaxpr_rules import lint_block, lint_callable
 
     findings: List[Finding] = []
     for name, shape in models:
         net = vision.get_model(name)
         net.initialize()
         x = onp.zeros(shape, dtype="float32")
-        findings.extend(lint_block(net, x, scope=f"zoo:{name}"))
+        scope = f"zoo:{name}"
+        if rewrite and hasattr(net, "functionalize"):
+            from .opt import CostModel, rewrite_block
+
+            # gate for the TPU deployment target: these are TPU
+            # anti-patterns, and the zoo gate runs on CPU CI
+            fn, params0, report = rewrite_block(
+                net, x, model=CostModel.for_backend(
+                    "tpu", "TPU v5 lite"),
+                mode_override="rewrite", scope=scope)
+            if reports is not None:
+                reports.append(report)
+            import jax.numpy as jnp
+
+            findings.extend(lint_callable(
+                fn, params0, jnp.asarray(x), scope=scope))
+        else:
+            findings.extend(lint_block(net, x, scope=scope))
     return findings
 
 
 def run(paths, zoo: bool = False, baseline_path: Optional[str] = None,
         write_baseline: Optional[str] = None, fail_on: str = "high",
         fmt: str = "text", root: Optional[str] = None,
+        zoo_rewrite: bool = True, opt_report: bool = False,
         out=None) -> int:
     out = out or sys.stdout
     root = root or REPO_ROOT
     t0 = time.perf_counter()
     findings = ast_rules.lint_paths(paths, root=root)
+    reports: list = []
     if zoo:
-        findings.extend(lint_zoo())
+        findings.extend(lint_zoo(rewrite=zoo_rewrite, reports=reports))
     findings = sort_findings(findings)
+    if opt_report and reports and fmt != "json":
+        for rep in reports:
+            print(rep.render(), file=out)
 
     if write_baseline:
         baseline_mod.save(write_baseline, findings)
@@ -92,6 +126,8 @@ def run(paths, zoo: bool = False, baseline_path: Optional[str] = None,
             "stale_baseline_entries": stale,
             "failed": bool(gating),
         }
+        if opt_report and reports:
+            payload["opt"] = [r.to_dict() for r in reports]
         json.dump(payload, out, indent=1)
         out.write("\n")
     else:
@@ -119,7 +155,17 @@ def main(argv=None) -> int:
                          "(default: the mxnet_tpu package)")
     ap.add_argument("--zoo", action="store_true",
                     help="also trace representative model-zoo networks "
-                         "through the jaxpr rules")
+                         "through the jaxpr rules (post-rewrite: the "
+                         "opt pass runs first; see --no-zoo-rewrite)")
+    ap.add_argument("--no-zoo-rewrite", dest="zoo_rewrite",
+                    action="store_false",
+                    help="lint the zoo AS WRITTEN, without the cost-"
+                         "model-gated opt rewrite pass (shows the full "
+                         "pre-rewrite debt)")
+    ap.add_argument("--opt-report", action="store_true",
+                    help="with --zoo: print each model's rewrite "
+                         "decisions (applied + refused, with the cost-"
+                         "model predicted gain that justifies each)")
     ap.add_argument("--format", dest="fmt", choices=("text", "json"),
                     default="text")
     ap.add_argument("--baseline", default=None,
@@ -143,4 +189,6 @@ def main(argv=None) -> int:
 
     return run(args.paths, zoo=args.zoo, baseline_path=args.baseline,
                write_baseline=args.write_baseline, fail_on=args.fail_on,
-               fmt=args.fmt, root=args.root)
+               fmt=args.fmt, root=args.root,
+               zoo_rewrite=args.zoo_rewrite,
+               opt_report=args.opt_report)

@@ -1,0 +1,89 @@
+"""``mx.analysis.opt`` — cost-model-guided auto-optimization.
+
+tpulint's **transform arm**: where :mod:`mxnet_tpu.analysis` detects
+TPU anti-patterns, this subpackage fixes the mechanical ones and tunes
+the knobs around them, converting the lint baseline from a debt ledger
+into a work queue. Three layers (see ``docs/auto_opt.md``):
+
+- :mod:`.cost_model` — analytic roofline over padded-tile FLOPs,
+  dtype-aware HBM bytes and launch overhead (arXiv:2008.01040's
+  feature set, analytic instead of learned), calibrated against the
+  banked ``benchmark/results_*.json`` TPU corpus
+  (:mod:`.calibration`; rank fidelity is a tier-1 test).
+- :mod:`.rewrites` — jaxpr rewrite passes: J001 pad-to-MXU-tile and
+  J003 exact convert-churn cancellation, each gated by a cost-model
+  predicted win and verified by the interpret-mode equivalence oracle
+  (:func:`check_equivalence`).
+- :mod:`.autotune` — TVM-style search over the repo's discrete knob
+  space (``steps_per_launch``, serving buckets, remat, stem-s2d):
+  cost-model pruning + short timed probes, persisting a
+  fingerprint-keyed :class:`TunedConfig` that ``gluon.Trainer`` and
+  ``serving.InferenceEngine`` consume at build time.
+
+Mode knob: ``MXNET_TPU_OPT=off|advise|rewrite`` (default ``advise`` —
+plan and report, transform only when explicitly asked).
+"""
+from __future__ import annotations
+
+from .cost_model import (  # noqa: F401
+    CostEstimate,
+    CostModel,
+    OpCost,
+    OpFeatures,
+    extract_features,
+    spearman,
+)
+from .rewrites import (  # noqa: F401
+    RewriteDecision,
+    RewriteReport,
+    check_equivalence,
+    mode,
+    rewrite_block,
+    rewrite_callable,
+)
+from .autotune import (  # noqa: F401
+    DEFAULT_SPACE,
+    KnobSpace,
+    TunedConfig,
+    autotune,
+    load_tuned,
+    lookup,
+    store_dir,
+)
+from . import calibration  # noqa: F401
+
+
+def record_prediction(name: str, predicted_s, observed_s=None) -> dict:
+    """Land a predicted-vs-observed step time in the telemetry registry
+    (``opt_predicted_step_ms`` / ``opt_observed_step_ms`` gauges, plus
+    the ratio) — how a bench row or a tuned training loop exposes
+    whether the cost model still tracks reality. Returns the values as
+    a dict for embedding in bench rows."""
+    from ...telemetry import get_registry
+
+    reg = get_registry()
+    out = {}
+    if predicted_s is not None:
+        reg.gauge("opt_predicted_step_ms",
+                  "Cost-model predicted step time", ("name",)).labels(
+            name=name).set(predicted_s * 1e3)
+        out["predicted_ms"] = round(predicted_s * 1e3, 4)
+    if observed_s is not None:
+        reg.gauge("opt_observed_step_ms",
+                  "Measured step time next to its prediction",
+                  ("name",)).labels(name=name).set(observed_s * 1e3)
+        out["observed_ms"] = round(observed_s * 1e3, 4)
+    if predicted_s and observed_s:
+        out["predicted_over_observed"] = round(
+            predicted_s / observed_s, 3)
+    return out
+
+__all__ = [
+    "CostEstimate", "CostModel", "OpCost", "OpFeatures",
+    "extract_features", "spearman",
+    "RewriteDecision", "RewriteReport", "check_equivalence", "mode",
+    "rewrite_block", "rewrite_callable",
+    "DEFAULT_SPACE", "KnobSpace", "TunedConfig", "autotune",
+    "load_tuned", "lookup", "store_dir",
+    "calibration", "record_prediction",
+]

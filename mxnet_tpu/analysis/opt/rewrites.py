@@ -1,0 +1,699 @@
+"""Cost-model-gated jaxpr rewrite passes — tpulint's transform arm.
+
+tpulint (PR 3) can *see* the TPU anti-patterns in a traced program;
+this module *fixes* the two mechanical ones, producing a semantically
+equivalent callable:
+
+- **J001 pad-to-MXU-tile** — ``dot_general`` / ``conv_general_dilated``
+  operands whose M/K/N (or C_in/C_out) dims pad badly against the
+  (sublane=8, lane=128) register tiles are zero-padded up to tile
+  multiples and the result sliced back. Zero-padding a contraction is
+  *exact* (zero taps contribute zero) and the pad/slice live inside the
+  traced program, where XLA fuses them into the producing/consuming
+  loops instead of materializing relayouts at every op boundary.
+- **J003 convert-churn elimination** — ``A -> B -> A``
+  ``convert_element_type`` round-trips are cancelled **only when B can
+  exactly represent every value of A** (widening round-trips:
+  ``bf16 -> f32 -> bf16``, ``int8 -> int32 -> int8``…), which makes the
+  cancellation bit-exact. Lossy round-trips (``f32 -> bf16 -> f32``)
+  are *reported but kept* — removing them would change numerics, and
+  the equivalence oracle would rightly refuse the rewrite.
+
+Every candidate is **gated by the cost model** (:mod:`.cost_model`):
+a rewrite predicted as a loss on the target backend is refused and the
+refusal is part of the report (J001 on a CPU target is the canonical
+refusal: there is no tile relayout to save, only extra multiplies to
+pay). Applied rewrites are verified by :func:`check_equivalence` — the
+interpret-mode oracle ``benchmark/opt_bench.py`` and ``tests/test_opt``
+run on every transformed program (bitwise for integer/bool outputs,
+dtype-scaled tolerance for floats, where only the reduction *order*
+may differ).
+
+The transform itself is a jaxpr re-interpreter: the traced program is
+replayed primitive-by-primitive through live jax ops (so the rewritten
+callable jits, grads and vmaps like any other function), with planned
+equations replaced by their padded/cancelled forms and ``pjit`` bodies
+inlined (semantically a no-op under an enclosing jit).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..findings import Finding
+from ..jaxpr_rules import TILE_LANE, TILE_SUBLANE, _misaligned
+from .cost_model import CostModel, _pad_up, np_dtype
+
+__all__ = ["RewriteDecision", "RewriteReport", "rewrite_callable",
+           "rewrite_block", "check_equivalence", "mode"]
+
+_VALID_MODES = ("off", "advise", "rewrite")
+
+
+def mode(override: Optional[str] = None) -> str:
+    """The auto-opt mode: ``MXNET_TPU_OPT`` = ``off`` (plan nothing) |
+    ``advise`` (plan + report, transform only when explicitly asked) |
+    ``rewrite`` (integration points transform too). Default: advise."""
+    val = (override or os.environ.get("MXNET_TPU_OPT") or "advise")
+    val = val.strip().lower()
+    if val not in _VALID_MODES:
+        import warnings
+
+        warnings.warn(
+            f"MXNET_TPU_OPT={val!r} is not one of {_VALID_MODES}; "
+            "using 'advise'", RuntimeWarning, stacklevel=2)
+        return "advise"
+    return val
+
+
+# -- telemetry --------------------------------------------------------------
+def _counters():
+    from ...telemetry import get_registry
+
+    reg = get_registry()
+    return (
+        reg.counter("opt_rewrites_applied_total",
+                    "Rewrites applied by mxnet_tpu.analysis.opt",
+                    ("rule",)),
+        reg.counter("opt_rewrites_refused_total",
+                    "Rewrites planned but refused (cost model predicted "
+                    "a loss, or the transform would change numerics)",
+                    ("rule",)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# decisions
+# ---------------------------------------------------------------------------
+@dataclass
+class RewriteDecision:
+    """One planned (or refused) transformation of one equation."""
+    rule: str                      # "J001" | "J003"
+    path: Tuple[int, ...]          # eqn index path (nested via pjit)
+    kind: str                      # pad_dot | pad_conv | cancel_convert
+    detail: str
+    applied: bool
+    predicted_gain_s: float
+    note: str = ""
+    payload: Dict[str, Any] = field(default_factory=dict)
+
+    def render(self) -> str:
+        verdict = "apply " if self.applied else "refuse"
+        gain = self.predicted_gain_s * 1e6
+        return (f"{verdict} {self.rule}/{self.kind} @eqn{list(self.path)} "
+                f"{self.detail}: predicted {gain:+.1f} us/step"
+                + (f" ({self.note})" if self.note else ""))
+
+
+@dataclass
+class RewriteReport:
+    """What the pass did and why — every apply/refuse carries its
+    cost-model justification (`docs/auto_opt.md` anatomy)."""
+    mode: str
+    backend: str
+    applied: List[RewriteDecision] = field(default_factory=list)
+    refused: List[RewriteDecision] = field(default_factory=list)
+    predicted_gain_s: float = 0.0
+    scope: str = ""
+
+    @property
+    def n_applied(self) -> int:
+        return len(self.applied)
+
+    def decisions(self) -> List[RewriteDecision]:
+        return self.applied + self.refused
+
+    def render(self) -> str:
+        head = (f"opt.rewrite[{self.scope or 'callable'}] target="
+                f"{self.backend}: {len(self.applied)} applied, "
+                f"{len(self.refused)} refused, predicted "
+                f"{self.predicted_gain_s * 1e6:+.1f} us/step")
+        return "\n".join([head] + [
+            "  " + d.render() for d in self.decisions()])
+
+    def to_dict(self) -> dict:
+        return {
+            "mode": self.mode, "backend": self.backend,
+            "scope": self.scope,
+            "predicted_gain_us": round(self.predicted_gain_s * 1e6, 2),
+            "applied": [{"rule": d.rule, "kind": d.kind,
+                         "detail": d.detail,
+                         "predicted_gain_us":
+                             round(d.predicted_gain_s * 1e6, 2)}
+                        for d in self.applied],
+            "refused": [{"rule": d.rule, "kind": d.kind,
+                         "detail": d.detail, "note": d.note,
+                         "predicted_gain_us":
+                             round(d.predicted_gain_s * 1e6, 2)}
+                        for d in self.refused],
+        }
+
+
+# ---------------------------------------------------------------------------
+# exact-widening table for J003 cancellation
+# ---------------------------------------------------------------------------
+def _exactly_representable(a: str, b: str) -> bool:
+    """True iff every value of dtype ``a`` survives a round-trip through
+    dtype ``b`` bit-exactly — the precondition for cancelling
+    ``a -> b -> a`` convert churn."""
+    try:
+        da, db = np_dtype(a), np_dtype(b)
+    except (TypeError, AttributeError):
+        return False
+    if da == db:
+        return True
+
+    #: (mantissa bits incl. implicit lead, exponent bits) for the float
+    #: types; ml_dtypes smalls register as numpy kind 'V', so classify
+    #: by name
+    fl = {"bfloat16": (8, 8), "float16": (11, 5), "float32": (24, 8),
+          "float64": (53, 11)}
+
+    def kind(d):
+        if str(d) in fl:
+            return "f"
+        return d.kind
+
+    ka, kb = kind(da), kind(db)
+
+    def fbits(d):
+        return fl[str(d)]
+
+    if ka == "b":
+        return True  # bool round-trips through any numeric type
+    if ka in "iu" and kb in "iu":
+        ia, ib = onp.iinfo(da), onp.iinfo(db)
+        return ib.min <= ia.min and ia.max <= ib.max
+    if ka in "iu" and kb == "f":
+        bits = da.itemsize * 8 - (1 if ka == "i" else 0)
+        return fbits(db)[0] >= bits
+    if ka == "f" and kb == "f":
+        ma, ea = fbits(da)
+        mb, eb = fbits(db)
+        return mb >= ma and eb >= ea
+    return False
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+def _aval(var):
+    return getattr(var, "aval", None)
+
+
+def _tensor_bytes(aval) -> float:
+    import math
+
+    try:
+        return float(math.prod(aval.shape) or 1) * np_dtype(
+            str(aval.dtype)).itemsize
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
+def _padded_bytes(aval, pad_axes: Dict[int, int]) -> float:
+    import math
+
+    shape = list(aval.shape)
+    for ax, tile in pad_axes.items():
+        shape[ax] = _pad_up(shape[ax], tile)
+    try:
+        return float(math.prod(shape) or 1) * np_dtype(
+            str(aval.dtype)).itemsize
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
+def _plan_dot(eqn, model: CostModel) -> Optional[RewriteDecision]:
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = _aval(eqn.invars[0]), _aval(eqn.invars[1])
+    out = _aval(eqn.outvars[0])
+    if lhs is None or rhs is None or out is None:
+        return None
+    lhs_free = [i for i in range(len(lhs.shape))
+                if i not in lc and i not in lb]
+    rhs_free = [i for i in range(len(rhs.shape))
+                if i not in rc and i not in rb]
+    # innermost dim of each class is the one the register tiling bites
+    lhs_pads: Dict[int, int] = {}
+    rhs_pads: Dict[int, int] = {}
+    if lhs_free and _misaligned(lhs.shape[lhs_free[-1]], TILE_SUBLANE):
+        lhs_pads[lhs_free[-1]] = TILE_SUBLANE
+    if lc and _misaligned(lhs.shape[lc[-1]], TILE_LANE):
+        lhs_pads[lc[-1]] = TILE_LANE
+        rhs_pads[rc[-1]] = TILE_LANE       # contraction pads in lockstep
+    if rhs_free and _misaligned(rhs.shape[rhs_free[-1]], TILE_LANE):
+        rhs_pads[rhs_free[-1]] = TILE_LANE
+    if not lhs_pads and not rhs_pads:
+        return None
+    # output axis order: batch, lhs free, rhs free — padded wherever a
+    # free dim was padded (the contraction dims never reach the output)
+    out_pads: Dict[int, int] = {}
+    if lhs_free and lhs_free[-1] in lhs_pads:
+        out_pads[len(lb) + len(lhs_free) - 1] = TILE_SUBLANE
+    if rhs_free and rhs_free[-1] in rhs_pads:
+        out_pads[len(out.shape) - 1] = TILE_LANE
+    detail = (f"dot M{[lhs.shape[i] for i in lhs_free]}"
+              f"K{[lhs.shape[i] for i in lc]}"
+              f"N{[rhs.shape[i] for i in rhs_free]}")
+    return _gate_pad(eqn, model, "pad_dot", detail,
+                     {"lhs_pads": lhs_pads, "rhs_pads": rhs_pads,
+                      "out_pads": out_pads,
+                      "out_slice": bool(out_pads)},
+                     lhs, rhs, out)
+
+
+def _plan_conv(eqn, model: CostModel) -> Optional[RewriteDecision]:
+    dn = eqn.params["dimension_numbers"]
+    lhs, rhs = _aval(eqn.invars[0]), _aval(eqn.invars[1])
+    out = _aval(eqn.outvars[0])
+    if lhs is None or rhs is None or out is None:
+        return None
+    c_in = lhs.shape[dn.lhs_spec[1]]
+    c_out = rhs.shape[dn.rhs_spec[0]]
+    if int(eqn.params.get("feature_group_count", 1)) != 1 \
+            or int(eqn.params.get("batch_group_count", 1)) != 1:
+        # grouped/depthwise: zero-padding channels would re-partition
+        # the group->channel map — not an equivalence-preserving pad
+        if _misaligned(c_in, TILE_SUBLANE) \
+                or _misaligned(c_out, TILE_LANE):
+            return RewriteDecision(
+                "J001", (), "pad_conv", f"conv C{c_in}->{c_out}",
+                False, 0.0,
+                note="grouped/depthwise conv: padding would change the "
+                     "group->channel partition; baseline entry stays")
+        return None
+    lhs_pads: Dict[int, int] = {}
+    rhs_pads: Dict[int, int] = {}
+    out_slice = False
+    if _misaligned(c_in, TILE_SUBLANE):
+        lhs_pads[dn.lhs_spec[1]] = TILE_SUBLANE
+        rhs_pads[dn.rhs_spec[1]] = TILE_SUBLANE
+    out_pads: Dict[int, int] = {}
+    if _misaligned(c_out, TILE_LANE):
+        rhs_pads[dn.rhs_spec[0]] = TILE_LANE
+        out_pads[dn.out_spec[1]] = TILE_LANE
+        out_slice = True
+    if not lhs_pads and not rhs_pads:
+        return None
+    return _gate_pad(eqn, model, "pad_conv", f"conv C{c_in}->{c_out}",
+                     {"lhs_pads": lhs_pads, "rhs_pads": rhs_pads,
+                      "out_pads": out_pads, "out_slice": out_slice},
+                     lhs, rhs, out)
+
+
+def _gate_pad(eqn, model: CostModel, kind: str, detail: str,
+              payload: Dict[str, Any], lhs, rhs, out
+              ) -> RewriteDecision:
+    """The J001 cost gate. On a TPU target the padded-tile FLOPs are
+    identical either way (the MXU executes full (8, 128) tiles
+    regardless), and so — crucially — are the HBM bytes: XLA:TPU lays
+    tensors out tile-padded in HBM, so a 16-feature tensor streams
+    128-lane lines whether or not the program pads it explicitly. What
+    misalignment costs is the **boundary tax**: masking/relayout work
+    where a compact logical shape meets the padded physical one at
+    every MXU op. An in-graph zero-pad makes the padding explicit and
+    fusable (the pad folds into the producer, the slice into the
+    consumer), retiring the tax at the price of a bounded residual for
+    the copies that fail to fuse::
+
+        gain = sum(padded_bytes of misaligned tensors) / bw     (tax)
+        cost = 0.5 * sum(padded - compact bytes introduced) / bw
+
+    A **CPU target always refuses**: XLA:CPU computes compact shapes —
+    there is no tile relayout to save, and the padded program does
+    genuinely more multiplies (the predicted loss the no-regression
+    guard tests pin)."""
+    from .cost_model import _conv_features, _dot_features
+
+    feats = (_dot_features(eqn) if kind == "pad_dot"
+             else _conv_features(eqn))
+    bw = model.hbm_gbps * 1e9 * model.mem_eff
+    lhs_pads = payload["lhs_pads"]
+    rhs_pads = payload["rhs_pads"]
+    if model.backend == "cpu":
+        extra_flops = feats.flops_padded - feats.flops_raw
+        loss = -extra_flops / (model.peak_tflops * 1e12
+                               * model.compute_eff)
+        return RewriteDecision("J001", (), kind, detail, False, loss,
+                               note="cpu target: no tile relayout to "
+                                    "save, padding adds real FLOPs",
+                               payload=payload)
+    tax = 0.0
+    residual = 0.0
+    for aval, pads in ((lhs, lhs_pads), (rhs, rhs_pads)):
+        if pads:
+            tax += _padded_bytes(aval, pads) / bw
+            residual += (_padded_bytes(aval, pads)
+                         - _tensor_bytes(aval)) / bw
+    if payload.get("out_slice"):
+        out_pads = payload.get("out_pads", {})
+        tax += _padded_bytes(out, out_pads) / bw  # out boundary retired
+        residual += (_padded_bytes(out, out_pads)
+                     - _tensor_bytes(out)) / bw
+    residual *= 0.5  # pad/slice mostly fuse; charge half the delta
+    gain = tax - residual
+    return RewriteDecision("J001", (), kind, detail, gain > 0, gain,
+                           note="" if gain > 0 else
+                           "predicted loss after fusion residual",
+                           payload=payload)
+
+
+def _plan_convert(eqn, produced_by, model: CostModel
+                  ) -> Optional[RewriteDecision]:
+    src = eqn.invars[0]
+    out = _aval(eqn.outvars[0])
+    src_aval = _aval(src)
+    if out is None or src_aval is None:
+        return None
+    src_eqn = produced_by.get(id(src))
+    if src_eqn is None \
+            or src_eqn.primitive.name != "convert_element_type":
+        return None
+    origin_var = src_eqn.invars[0]
+    origin = _aval(origin_var)
+    if origin is None or origin.dtype != out.dtype:
+        return None
+    detail = (f"churn:{origin.dtype}->{src_aval.dtype}->{out.dtype}")
+    same_weak = bool(getattr(origin, "weak_type", False)) == bool(
+        getattr(out, "weak_type", False))
+    exact = _exactly_representable(str(origin.dtype), str(src_aval.dtype))
+    bw = model.hbm_gbps * 1e9 * model.mem_eff
+    gain = (_tensor_bytes(src_aval) + _tensor_bytes(out)) \
+        * model.fusion_discount / bw
+    if not (exact and same_weak):
+        return RewriteDecision(
+            "J003", (), "cancel_convert", detail, False, gain,
+            note="lossy round-trip: cancelling would change numerics "
+                 "(hoist the precision boundary in the model instead)")
+    return RewriteDecision("J003", (), "cancel_convert", detail, True,
+                           gain, payload={"origin_id": id(origin_var)})
+
+
+_INLINE_PRIMS = {"pjit", "closed_call", "core_call"}
+
+
+def plan(closed, model: CostModel,
+         rules: Sequence[str] = ("J001", "J003")
+         ) -> List[RewriteDecision]:
+    """Walk the jaxpr (inlining-eligible bodies included) and emit one
+    decision per candidate equation, each gated by the cost model."""
+    decisions: List[RewriteDecision] = []
+
+    def walk(jx, path: Tuple[int, ...]):
+        produced_by: Dict[int, Any] = {}
+        for i, eqn in enumerate(jx.eqns):
+            prim = eqn.primitive.name
+            d = None
+            if prim == "dot_general" and "J001" in rules:
+                d = _plan_dot(eqn, model)
+            elif prim == "conv_general_dilated" and "J001" in rules:
+                d = _plan_conv(eqn, model)
+            elif prim == "convert_element_type" and "J003" in rules:
+                d = _plan_convert(eqn, produced_by, model)
+            elif prim in _INLINE_PRIMS:
+                sub = eqn.params.get("jaxpr")
+                inner = getattr(sub, "jaxpr", sub)
+                if inner is not None and hasattr(inner, "eqns"):
+                    walk(inner, path + (i,))
+            if d is not None:
+                d.path = path + (i,)
+                decisions.append(d)
+            for ov in eqn.outvars:
+                produced_by[id(ov)] = eqn
+        return decisions
+
+    jaxpr = getattr(closed, "jaxpr", closed)
+    return walk(jaxpr, ())
+
+
+# ---------------------------------------------------------------------------
+# the re-interpreter
+# ---------------------------------------------------------------------------
+def _apply_pad_dot(eqn, invals, payload):
+    from ...ops.nn import pad_to_tile, unpad_slice
+
+    lhs, rhs = invals[0], invals[1]
+    lhs = pad_to_tile(lhs, payload["lhs_pads"])
+    rhs = pad_to_tile(rhs, payload["rhs_pads"])
+    out = eqn.primitive.bind(lhs, rhs, **eqn.params)
+    return [unpad_slice(out, _aval(eqn.outvars[0]).shape)]
+
+
+def _apply_pad_conv(eqn, invals, payload):
+    from ...ops.nn import pad_to_tile, unpad_slice
+
+    lhs, rhs = invals[0], invals[1]
+    lhs = pad_to_tile(lhs, payload["lhs_pads"])
+    rhs = pad_to_tile(rhs, payload["rhs_pads"])
+    out = eqn.primitive.bind(lhs, rhs, **eqn.params)
+    return [unpad_slice(out, _aval(eqn.outvars[0]).shape)]
+
+
+def eval_rewritten(closed, decisions: Sequence[RewriteDecision],
+                   consts, *flat_args):
+    """Replay a ClosedJaxpr through live jax ops with the planned
+    (applied) decisions substituted. Returns flat outputs."""
+    from jax.extend import core as jcore
+
+    by_path = {d.path: d for d in decisions if d.applied}
+
+    def run(jx, path: Tuple[int, ...], env: Dict[int, Any],
+            jconsts, args):
+        for v, val in zip(jx.constvars, jconsts):
+            env[id(v)] = val
+        for v, val in zip(jx.invars, args):
+            env[id(v)] = val
+
+        def read(v):
+            if isinstance(v, jcore.Literal):
+                return v.val
+            return env[id(v)]
+
+        for i, eqn in enumerate(jx.eqns):
+            prim = eqn.primitive.name
+            d = by_path.get(path + (i,))
+            invals = [read(v) for v in eqn.invars]
+            if d is not None and d.kind == "pad_dot":
+                outs = _apply_pad_dot(eqn, invals, d.payload)
+            elif d is not None and d.kind == "pad_conv":
+                outs = _apply_pad_conv(eqn, invals, d.payload)
+            elif d is not None and d.kind == "cancel_convert":
+                # bit-exact: route the origin value straight through
+                src_eqn_out = env.get(d.payload["origin_id"], None)
+                if src_eqn_out is None:   # origin was a literal/const
+                    outs = [eqn.primitive.bind(*invals, **eqn.params)]
+                else:
+                    outs = [src_eqn_out]
+            elif prim in _INLINE_PRIMS and "jaxpr" in eqn.params:
+                # inlining a nested jit body is semantically a no-op
+                # under the enclosing trace, and it is where nested
+                # rewrite decisions land
+                sub = eqn.params["jaxpr"]
+                inner = getattr(sub, "jaxpr", sub)
+                sub_consts = list(getattr(sub, "consts", ()))
+                outs = run(inner, path + (i,), env, sub_consts, invals)
+            else:
+                # the jax.core.eval_jaxpr idiom: get_bind_params turns
+                # stored eqn params back into bindable form (callable
+                # subfuns for custom_jvp/vjp_call, remat, …) — so
+                # custom gradient rules survive the replay intact
+                subfuns, bind_params = eqn.primitive.get_bind_params(
+                    eqn.params)
+                out = eqn.primitive.bind(*subfuns, *invals,
+                                         **bind_params)
+                outs = (out if eqn.primitive.multiple_results
+                        else [out])
+            for v, val in zip(eqn.outvars, outs):
+                env[id(v)] = val
+        return [read(v) for v in jx.outvars]
+
+    jaxpr = getattr(closed, "jaxpr", closed)
+    return run(jaxpr, (), {}, list(consts), list(flat_args))
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+def rewrite_callable(fn: Callable, *example_args,
+                     model: Optional[CostModel] = None,
+                     rules: Sequence[str] = ("J001", "J003"),
+                     mode_override: Optional[str] = None,
+                     scope: str = "callable",
+                     ) -> Tuple[Callable, RewriteReport]:
+    """Plan + (mode permitting) apply rewrites over ``fn``.
+
+    Returns ``(fn', report)``. Under ``MXNET_TPU_OPT=off`` nothing is
+    even planned; under ``advise`` (the default) the report carries the
+    plan but ``fn' is fn``; pass ``mode_override='rewrite'`` (or set the
+    env) to transform. ``model`` defaults to the **live** backend's cost
+    model — pass ``CostModel.for_backend('tpu', 'TPU v5 lite')`` to gate
+    for a TPU deployment from a CPU process."""
+    import jax
+
+    md = mode(mode_override)
+    model = model or CostModel.for_backend()
+    report = RewriteReport(mode=md, backend=model.backend, scope=scope)
+    if md == "off":
+        return fn, report
+
+    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(
+        *example_args)
+    decisions = plan(closed, model, rules)
+    applied_c, refused_c = _counters()
+    for d in decisions:
+        if d.applied and md == "rewrite":
+            report.applied.append(d)
+            report.predicted_gain_s += d.predicted_gain_s
+            applied_c.labels(rule=d.rule).inc()
+        else:
+            if d.applied:           # advise mode: a would-apply
+                d = RewriteDecision(d.rule, d.path, d.kind, d.detail,
+                                    False, d.predicted_gain_s,
+                                    note="advise mode (set MXNET_TPU_OPT"
+                                         "=rewrite to apply)",
+                                    payload=d.payload)
+            elif md == "rewrite":
+                # the refusal counter means "the gate said no", not
+                # "the mode was advise" — only live-transform runs
+                # count, so dashboards watching refusals see genuine
+                # predicted-loss/exactness verdicts
+                refused_c.labels(rule=d.rule).inc()
+            report.refused.append(d)
+    if not report.applied:
+        return fn, report
+
+    _, out_tree = jax.tree_util.tree_flatten(out_shape)
+    ex_flat, in_tree = jax.tree_util.tree_flatten(example_args)
+    ex_avals = [(tuple(getattr(a, "shape", ())),
+                 str(getattr(a, "dtype", type(a).__name__)))
+                for a in map(jax.api_util.shaped_abstractify, ex_flat)]
+    live = [d for d in report.applied]
+
+    def rewritten(*args):
+        flat, tree = jax.tree_util.tree_flatten(args)
+        if tree != in_tree:
+            raise TypeError(
+                f"rewritten callable expects the example structure "
+                f"{in_tree}, got {tree}")
+        # the replay (and its slice-back shapes) is SPECIALIZED to the
+        # traced avals — a different batch size must be a loud error,
+        # not rows silently sliced away
+        for i, (leaf, (shape, dtype)) in enumerate(zip(flat, ex_avals)):
+            aval = jax.api_util.shaped_abstractify(leaf)
+            if (tuple(aval.shape), str(aval.dtype)) != (shape, dtype):
+                raise TypeError(
+                    f"rewritten callable is specialized to the example "
+                    f"avals: leaf {i} expects {dtype}{list(shape)}, got "
+                    f"{aval.dtype}{list(aval.shape)} — re-run "
+                    "rewrite_callable with the new example")
+        outs = eval_rewritten(closed, live, closed.consts, *flat)
+        return jax.tree_util.tree_unflatten(out_tree, outs)
+
+    rewritten.__name__ = getattr(fn, "__name__", "fn") + "_opt"
+    rewritten.opt_report = report
+    return rewritten, report
+
+
+def rewrite_block(block, *example_inputs, training: bool = False,
+                  model: Optional[CostModel] = None,
+                  rules: Sequence[str] = ("J001", "J003"),
+                  mode_override: Optional[str] = None,
+                  scope: Optional[str] = None):
+    """Rewrite a gluon (Hybrid)Block's pure forward.
+
+    Returns ``(fn, params, report)`` where ``fn(params, *inputs)`` is
+    the (possibly) transformed pure function — the same seam
+    ``analysis.lint_block`` lints, so ``lint_callable(fn, params, *x)``
+    on the result shows exactly which findings the rewrite retired."""
+    import jax.numpy as jnp
+
+    from ...ndarray.ndarray import ndarray as _nd, _unwrap, _wrap
+
+    inputs = tuple(x if isinstance(x, _nd) else _wrap(jnp.asarray(x))
+                   for x in example_inputs)
+    if any(p._data is None for p in block.collect_params().values()):
+        try:
+            block.initialize()
+        except Exception:  # noqa: BLE001 — already/deferred initialized
+            pass
+    scope = scope or type(block).__name__
+    fn, params0 = block.functionalize(*inputs, training=training)
+
+    def user_outputs(params, *ivals):
+        out, _new_params = fn(params, *ivals)
+        return out
+
+    new_fn, report = rewrite_callable(
+        user_outputs, params0, *[_unwrap(x) for x in inputs],
+        model=model, rules=rules, mode_override=mode_override,
+        scope=scope)
+    return new_fn, params0, report
+
+
+# ---------------------------------------------------------------------------
+# the equivalence oracle
+# ---------------------------------------------------------------------------
+#: per-dtype relative tolerance for float comparisons: a tile pad only
+#: changes the *order* zeros enter a reduction, so the bound is a few
+#: ulps of the compute dtype, not a loose allclose
+_FLOAT_RTOL = {"float64": 1e-12, "float32": 2e-5, "float16": 2e-2,
+               "bfloat16": 2e-2}
+
+
+def check_equivalence(ref_fn: Callable, new_fn: Callable, *args,
+                      bitwise: Optional[bool] = None) -> Dict[str, Any]:
+    """Interpret-mode oracle: run both callables op-by-op (no XLA
+    fusion — ``jax.disable_jit``) on the same concrete inputs and
+    compare every output leaf. Integer/bool leaves must match
+    **bitwise**; float leaves within a few ulps of their dtype
+    (``bitwise=True`` forces exact everywhere). Returns a dict with
+    ``equal`` and per-leaf max errors; raises nothing — the caller
+    decides whether a mismatch is fatal."""
+    import jax
+
+    with jax.disable_jit():
+        ref = ref_fn(*args)
+        out = new_fn(*args)
+    ref_leaves = jax.tree_util.tree_leaves(ref)
+    out_leaves = jax.tree_util.tree_leaves(out)
+    result: Dict[str, Any] = {"equal": True, "leaves": [],
+                              "n_leaves": len(ref_leaves)}
+    if len(ref_leaves) != len(out_leaves):
+        result["equal"] = False
+        result["error"] = (f"leaf count {len(out_leaves)} != "
+                           f"{len(ref_leaves)}")
+        return result
+    for i, (a, b) in enumerate(zip(ref_leaves, out_leaves)):
+        a = onp.asarray(a)
+        b = onp.asarray(b)
+        row: Dict[str, Any] = {"leaf": i, "dtype": str(a.dtype),
+                               "shape": list(a.shape)}
+        if a.dtype != b.dtype or a.shape != b.shape:
+            row["mismatch"] = f"aval {b.dtype}{b.shape}"
+            result["equal"] = False
+            result["leaves"].append(row)
+            continue
+        exact = bitwise if bitwise is not None else (
+            a.dtype.kind not in "fc" and str(a.dtype) != "bfloat16")
+        if exact or a.dtype.kind in "biu":
+            ok = bool(onp.array_equal(a, b))
+            row["bitwise"] = ok
+        else:
+            af = a.astype(onp.float64)
+            bf = b.astype(onp.float64)
+            denom = onp.maximum(onp.abs(af), 1.0)
+            err = float(onp.max(onp.abs(af - bf) / denom)) \
+                if af.size else 0.0
+            tol = _FLOAT_RTOL.get(str(a.dtype), 1e-5)
+            ok = err <= tol
+            row["max_rel_err"] = err
+            row["rtol"] = tol
+        if not ok:
+            result["equal"] = False
+            row["mismatch"] = "value"
+        result["leaves"].append(row)
+    return result

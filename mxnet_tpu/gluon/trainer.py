@@ -55,6 +55,7 @@ class Trainer:
         kvstore: str = "device",
         compression_params: Optional[dict] = None,
         update_on_kvstore: Optional[bool] = None,
+        tuned=None,
     ):
         if isinstance(params, dict):
             self._param_names = list(params.keys())
@@ -84,8 +85,44 @@ class Trainer:
         self._states_ready = False
         self._jit_step = None
         self._jit_safe = getattr(self._optimizer, "jit_safe", True)
+        # mx.analysis.opt consumption (build time): a persisted
+        # TunedConfig — knobs the surrounding training loop reads
+        # (steps_per_launch via `tuned_steps_per_launch`) plus the
+        # config key folded into the fused-update AOT fingerprint so a
+        # cached executable tuned one way never serves a loop tuned
+        # another. A stale config (jaxlib/env-knob drift since tuning,
+        # TunedConfig.is_current) warns and is DROPPED — defaults beat
+        # a verdict tuned for a different world.
+        self.tuned = None
+        if tuned is not None:
+            from ..analysis.opt import TunedConfig, load_tuned
+
+            cfg = load_tuned(tuned) if isinstance(tuned, str) else tuned
+            if not isinstance(cfg, TunedConfig):
+                raise MXNetError(f"tuned= expects a TunedConfig or a "
+                                 f"path, got {type(tuned).__name__}")
+            if not cfg.is_current():
+                import warnings
+
+                warnings.warn(
+                    f"gluon.Trainer: tuned config {cfg.label!r} is "
+                    "stale (jax/jaxlib or env-knob signature changed "
+                    "since it was tuned) — ignoring it; re-run "
+                    "mx.analysis.opt.autotune", RuntimeWarning,
+                    stacklevel=2)
+            else:
+                self.tuned = cfg
 
     # -- properties --------------------------------------------------------
+    @property
+    def tuned_steps_per_launch(self) -> int:
+        """The autotuned serial-chain depth for the surrounding loop
+        (``lax.scan`` steps per launch — ``train_bench --scan-steps``
+        consumes this), 1 when untuned."""
+        if self.tuned is None:
+            return 1
+        return max(1, int(self.tuned.knobs.get("steps_per_launch", 1)))
+
     @property
     def learning_rate(self):
         return self._optimizer.learning_rate
@@ -204,7 +241,9 @@ class Trainer:
         # instead of re-tracing + recompiling the fused update; without
         # a store this is a plain jax.jit (bit-identical behavior)
         return aot.cached_jit(fused, label="trainer.fused_update",
-                              donate_argnums=donate)
+                              donate_argnums=donate,
+                              static_key=(("tuned", self.tuned.key),)
+                              if self.tuned else ())
 
     def prewarm(self) -> bool:
         """Resolve and compile the fused-update executable ahead of the

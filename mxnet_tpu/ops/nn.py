@@ -35,6 +35,46 @@ def _tuple(v: IntOrTuple, n: int) -> Tuple[int, ...]:
 
 
 # ---------------------------------------------------------------------------
+# MXU tile-pad helpers (the J001 rewrite's primitives, and a public
+# surface for model authors who want to pad feature dims at model edges
+# once instead of paying tile padding per op — docs/auto_opt.md)
+# ---------------------------------------------------------------------------
+def mxu_pad_amount(dim: int, tile: int) -> int:
+    """Zeros needed to round ``dim`` up to a multiple of ``tile``
+    (the float32 MXU register tiles are sublane=8 / lane=128)."""
+    return (-int(dim)) % int(tile)
+
+
+def pad_to_tile(x, axis_tiles):
+    """Zero-pad ``x`` so each ``axis -> tile`` in ``axis_tiles`` becomes
+    a tile multiple. Padding with zeros is exact for every contraction
+    (zero taps contribute zero) and for feature dims that are sliced
+    back afterwards (:func:`unpad_slice`). Differentiable: the vjp of a
+    zero-pad is the matching slice, so gradients flow to the original
+    (unpadded) operand untouched. A no-op (same ``x``) when every listed
+    axis is already aligned — safe to call unconditionally."""
+    pads = [(0, 0, 0)] * x.ndim
+    any_pad = False
+    for axis, tile in dict(axis_tiles).items():
+        amount = mxu_pad_amount(x.shape[axis], tile)
+        if amount:
+            pads[axis] = (0, amount, 0)
+            any_pad = True
+    if not any_pad:
+        return x
+    return lax.pad(x, jnp.zeros((), x.dtype), pads)
+
+
+def unpad_slice(x, shape):
+    """Slice a tile-padded result back to its logical ``shape`` (the
+    inverse of :func:`pad_to_tile` on the output side)."""
+    shape = tuple(int(d) for d in shape)
+    if tuple(x.shape) == shape:
+        return x
+    return lax.slice(x, (0,) * x.ndim, shape)
+
+
+# ---------------------------------------------------------------------------
 # dense / matmul
 # ---------------------------------------------------------------------------
 def fully_connected(x, weight, bias=None, num_hidden=None, flatten=True, no_bias=False):

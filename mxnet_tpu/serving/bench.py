@@ -76,6 +76,7 @@ def run_serving_bench(model: str = "alexnet", image_size: int = 224,
                       queue_size: int = 64,
                       shed_deadline_ms: float = 25.0,
                       manifest: Optional[str] = None,
+                      tuned: Optional[str] = None,
                       log=lambda m: print("[serve_bench]", m,
                                           file=sys.stderr, flush=True)
                       ) -> Dict:
@@ -90,7 +91,10 @@ def run_serving_bench(model: str = "alexnet", image_size: int = 224,
     engine = InferenceEngine(
         net, example_input=onp.zeros((1,) + item_shape, "float32"),
         max_batch_size=max_batch, max_delay_ms=max_delay_ms,
-        max_queue_size=queue_size)
+        max_queue_size=queue_size, tuned=tuned)
+    if engine.tuned:
+        log(f"tuned config {engine.tuned.label} -> "
+            f"{engine.tuned.knobs}")
     try:
         rng = onp.random.RandomState(0)
         sample = rng.uniform(size=(1,) + item_shape).astype("float32")
@@ -270,6 +274,7 @@ def run_serving_bench(model: str = "alexnet", image_size: int = 224,
                           if warm_start_ms is not None else None),
         "warm_source": warm_source,
         "efficiency": efficiency,
+        "tuned": engine.tuned.provenance() if engine.tuned else None,
         "aot": aot_snapshot,
         "device": jax.default_backend(),
         "client_errors": errs[:5],
@@ -312,6 +317,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "exists (warm from the recorded bucket frontier "
                          "instead of the 1+max guess), written at the "
                          "end for the next run (docs/aot.md)")
+    ap.add_argument("--tuned", default=None,
+                    help="path to a persisted mx.analysis.opt "
+                         "TunedConfig: its bucket_sizes knob shapes the "
+                         "engine ladder (stale configs are ignored with "
+                         "a warning); provenance lands in the row")
     ap.add_argument("--out", default=None,
                     help="bank the row to this JSON file "
                          "(default benchmark/results_serving_<dev>.json)")
@@ -330,7 +340,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         model=args.model, image_size=args.image_size, classes=args.classes,
         clients=args.clients, max_batch=args.max_batch,
         max_delay_ms=args.max_delay_ms, duration_s=args.duration,
-        seq_requests=args.seq_requests, manifest=args.manifest)
+        seq_requests=args.seq_requests, manifest=args.manifest,
+        tuned=args.tuned)
     if not args.smoke:
         import jax
 

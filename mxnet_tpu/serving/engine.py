@@ -101,6 +101,16 @@ class InferenceEngine:
         export compiles exactly its export batch: pass
         ``bucket_sizes=[export_batch]`` so every request pads up to it).
         The largest entry becomes ``max_batch_size``.
+    tuned : analysis.opt.TunedConfig or str (path), optional
+        A persisted autotune verdict (``mx.analysis.opt.autotune``)
+        consumed at build time: its ``bucket_sizes`` /
+        ``max_delay_ms`` knobs apply where the caller left the
+        defaults (explicit arguments always win). A **stale** config —
+        jax/jaxlib upgrade or env-knob flip since it was tuned
+        (``TunedConfig.is_current``) — warns once and is ignored; the
+        engine then serves on defaults rather than a verdict tuned for
+        a different world. Provenance surfaces in ``stats()`` and the
+        serve_bench row.
     """
 
     def __init__(self, model, example_input=None, *,
@@ -111,7 +121,33 @@ class InferenceEngine:
                  donate: Optional[bool] = None,
                  jit: bool = True,
                  bucket_sizes: Optional[List[int]] = None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 tuned=None):
+        self.tuned = None
+        if tuned is not None:
+            from ..analysis.opt import TunedConfig, load_tuned
+
+            cfg = load_tuned(tuned) if isinstance(tuned, str) else tuned
+            if not isinstance(cfg, TunedConfig):
+                raise ValueError(f"tuned= expects a TunedConfig or a "
+                                 f"path, got {type(tuned).__name__}")
+            if not cfg.is_current():
+                import warnings
+
+                warnings.warn(
+                    f"mxnet_tpu.serving: tuned config {cfg.label!r} "
+                    f"({cfg.filename()}) is stale (jax/jaxlib or env-"
+                    "knob signature changed since it was tuned) — "
+                    "ignoring it; re-run mx.analysis.opt.autotune",
+                    RuntimeWarning, stacklevel=2)
+            else:
+                self.tuned = cfg
+                if bucket_sizes is None \
+                        and cfg.knobs.get("bucket_sizes"):
+                    bucket_sizes = list(cfg.knobs["bucket_sizes"])
+                if max_delay_ms is None \
+                        and cfg.knobs.get("max_delay_ms") is not None:
+                    max_delay_ms = float(cfg.knobs["max_delay_ms"])
         if bucket_sizes is not None:
             if not bucket_sizes or any(int(b) < 1 for b in bucket_sizes):
                 raise ValueError(f"bucket_sizes must be a non-empty list "
@@ -361,6 +397,7 @@ class InferenceEngine:
         snap["max_batch_size"] = self.max_batch_size
         snap["max_delay_ms"] = self.max_delay_ms
         snap["aot"] = aot.stats()  # process-wide hit/miss/bytes counters
+        snap["tuned"] = self.tuned.provenance() if self.tuned else None
         try:
             # pure observability must never raise (or be the process's
             # unguarded first backend touch) — mirror stem_s2d_cache_key

@@ -68,6 +68,9 @@ MODULES = {
     "mxnet_tpu.runtime": "build-feature introspection",
     "mxnet_tpu.operator": "python CustomOp",
     "mxnet_tpu.monitor": "Monitor / TensorInspector taps",
+    "mxnet_tpu.analysis.opt": "cost-model-guided auto-optimization: "
+                              "jaxpr rewrites, analytic TPU cost "
+                              "model, knob autotuner",
     "mxnet_tpu.analysis": "tpulint — TPU anti-pattern analyzer "
                           "(jaxpr + AST rules, runtime sentinel)",
     "mxnet_tpu.aot": "persistent compile cache + ahead-of-time warmup",
