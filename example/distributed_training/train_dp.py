@@ -11,7 +11,15 @@ or on the virtual CPU mesh:
         python example/distributed_training/train_dp.py --cpu --ndev 8
 
 Multi-host: launch with tools/launch.py (DMLC env protocol →
-jax.distributed.initialize), same script, no code changes.
+jax.distributed.initialize), same script, no code changes. On a CPU
+cluster the collectives ride jaxlib's gloo implementation, armed
+automatically by ``parallel.dist.initialize``.
+
+Fault tolerance: for pods where preemption is routine, wrap the step
+loop in ``mx.resilience.elastic.ElasticSupervisor`` (see
+``tests/dist/elastic_drill.py`` for a complete worked example) — rank
+loss then degrades the dp mesh and resumes from the last coordinated
+checkpoint instead of hanging the job (``docs/resilience.md``).
 """
 from __future__ import annotations
 

@@ -126,6 +126,8 @@ __all__ = [
     "FatalError",
     "StallDetected",
     "Preempted",
+    "RankLost",
+    "ClusterDegraded",
     "bfloat16",
     "DTYPE_MAP",
     "dtype_from_any",
@@ -164,6 +166,39 @@ class Preempted(TransientError):
     """The process received a preemption notice (SIGTERM on TPU VMs).
     Raised by ``resilience.Supervisor`` after its final synchronous
     checkpoint so callers can exit cleanly and resume elsewhere."""
+
+
+class RankLost(TransientError):
+    """A peer process in the fault domain stopped heartbeating: its
+    collective slot stayed empty past the deadline AND its heartbeat is
+    stale. Transient — ``resilience.elastic`` survivors re-rendezvous at
+    the next generation and resume on a degraded mesh.
+
+    ``lost`` carries the original rank ids; ``ages`` the last observed
+    per-rank heartbeat age in seconds at detection time."""
+
+    def __init__(self, msg: str, lost=(), ages=None):
+        super().__init__(msg)
+        self.lost = tuple(lost)
+        self.ages = dict(ages or {})
+
+    def __reduce__(self):  # crosses process boundaries in drills
+        return (RankLost, (self.args[0], self.lost, self.ages))
+
+
+class ClusterDegraded(TransientError):
+    """A collective missed its deadline but every peer is still
+    heartbeating — a straggler or a network partition rather than a
+    death. Transient: the elastic layer treats it like a rank loss
+    (re-rendezvous; a live straggler that misses the new generation's
+    window becomes a spare) so a wedged peer cannot hang the pod."""
+
+    def __init__(self, msg: str, ages=None):
+        super().__init__(msg)
+        self.ages = dict(ages or {})
+
+    def __reduce__(self):
+        return (ClusterDegraded, (self.args[0], self.ages))
 
 
 _backend_fallback = {"active": False, "lock": threading.Lock()}

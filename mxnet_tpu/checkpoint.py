@@ -26,18 +26,21 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import shutil
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as onp
 
-from .base import MXNetError
+from .base import MXNetError, TransientError, env_float
 from .ndarray.ndarray import ndarray, _unwrap
 from .resilience import chaos
 
 __all__ = ["save_sharded", "load_sharded", "CheckpointManager",
-           "CheckpointCorruption"]
+           "CheckpointCorruption", "CoordinatedCheckpointManager",
+           "ShardCommitError", "shard_slice"]
 
 
 def _to_jax_tree(tree):
@@ -317,4 +320,403 @@ class CheckpointManager:
     def close(self):
         """Kept for API parity with the orbax-backed manager; saves are
         synchronous so there is nothing to flush."""
+
+
+# ---------------------------------------------------------------------------
+# coordinated multi-process checkpointing (the elastic fault domain)
+# ---------------------------------------------------------------------------
+
+class ShardCommitError(TransientError):
+    """A coordinated step could not be committed: one or more per-rank
+    shards never arrived (dead/slow peer) or failed SHA256 verification.
+    The step is NEVER published — restore falls back to the previous
+    valid coordinated step. Transient: the usual cause is a rank dying
+    mid-save, which the elastic layer answers with a re-rendezvous."""
+
+
+def shard_slice(length: int, world: int, index: int) -> slice:
+    """The ``numpy.array_split`` range rank ``index`` of ``world`` owns
+    along an axis of size ``length`` (uneven splits allowed — the first
+    ``length % world`` ranks get one extra row). One function so save,
+    restore and the optimizer agree on boundaries byte-for-byte."""
+    base, extra = divmod(int(length), int(world))
+    sizes = [base + (1 if r < extra else 0) for r in range(world)]
+    start = sum(sizes[:index])
+    return slice(start, start + sizes[index])
+
+
+def _match_shard_axis(key: str, rules: Sequence[Tuple[str, int]]):
+    """First regex rule matching leaf keypath ``key`` wins; None =
+    replicated (the :func:`mxnet_tpu.parallel.mesh.match_rule` idiom)."""
+    for pat, axis in rules:
+        if re.search(pat, key):
+            return int(axis)
+    return None
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class CoordinatedCheckpointManager:
+    """Step-numbered checkpoints where every process writes only its own
+    shard, committed in two phases so a torn multi-process save never
+    becomes a restorable step.
+
+    The multi-process extension of :class:`CheckpointManager`'s atomic
+    contract. ``rank`` is this process's **membership index** (0-based
+    within the current elastic generation) and ``world`` the active
+    process count; rank 0 is the commit leader.
+
+    Layout per step::
+
+        <dir>/<step>.staging/shard_r<k>.npz    phase 1: per-rank payload
+        <dir>/<step>.staging/shard_r<k>.json   per-rank manifest (SHA256)
+        <dir>/<step>/manifest.json             phase 2: leader-published
+
+    Phase 1: every rank stages ``shard_r<k>.npz`` (tmp → ``os.replace``)
+    and then its shard manifest claiming the payload's SHA256. Phase 2:
+    rank 0 waits (bounded) for all ``world`` shard manifests, re-hashes
+    every payload against its claim, writes the step ``manifest.json``
+    and publishes the staging dir with ONE ``os.replace``. A missing or
+    corrupt shard means the step is never published
+    (:class:`ShardCommitError`) — restore falls back to the previous
+    valid step exactly like the single-process corrupt-step fallback.
+
+    ``shard_rules`` (``[(regex, axis)]`` over leaf keypaths, first match
+    wins) declare which leaves are per-rank shards of a global array
+    (concatenated along ``axis`` in rank order at restore; uneven
+    ``array_split`` boundaries allowed) — everything else is replicated
+    and taken from rank 0. :meth:`restore` reassembles the global tree
+    and re-slices it for THIS manager's (rank, world), so a checkpoint
+    written by 4 processes restores into 3: reshard-on-load.
+    """
+
+    _MANIFEST = "manifest.json"
+
+    def __init__(self, directory: str, rank: int, world: int, *,
+                 max_to_keep: int = 5,
+                 commit_deadline_s: Optional[float] = None,
+                 poll_s: float = 0.02,
+                 token: Optional[str] = None):
+        if world < 1 or not 0 <= rank < world:
+            raise ValueError(f"bad shard coordinates rank={rank} "
+                             f"world={world}")
+        if max_to_keep < 1:
+            raise ValueError("max_to_keep must be >= 1")
+        self._dir = os.path.abspath(directory)
+        self.rank = int(rank)
+        self.world = int(world)
+        # commit token: stamped into every shard manifest and REQUIRED
+        # to match at commit, so shards left in a staging dir by an
+        # aborted earlier attempt (a leader killed pre-publish, then a
+        # degrade re-saving the same step number at a different
+        # world/membership) can never be mixed into a fresh step. The
+        # elastic layer passes its generation; the default binds the
+        # world size.
+        self._token = str(token) if token is not None else f"w{world}"
+        self._max_to_keep = int(max_to_keep)
+        self._deadline = float(
+            commit_deadline_s if commit_deadline_s is not None
+            else env_float("MXNET_TPU_COLLECTIVE_DEADLINE_S", 30.0))
+        self._poll = float(poll_s)
+        os.makedirs(self._dir, exist_ok=True)
+
+    # -- paths ------------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, str(int(step)))
+
+    def _staging(self, step: int) -> str:
+        return self._step_dir(step) + ".staging"
+
+    @staticmethod
+    def _shard_npz(rank: int) -> str:
+        return f"shard_r{rank}.npz"
+
+    @staticmethod
+    def _shard_manifest(rank: int) -> str:
+        return f"shard_r{rank}.json"
+
+    # -- phase 1: stage this rank's shard ---------------------------------
+    def _stage(self, step: int, tree: Any,
+               shard_rules: Sequence[Tuple[str, int]]) -> None:
+        tree = _to_jax_tree(tree)
+        staging = self._staging(step)
+        os.makedirs(staging, exist_ok=True)
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        payload, leaves = {}, {}
+        for path, v in flat:
+            key = jax.tree_util.keystr(path)
+            # NOT ascontiguousarray: that promotes 0-d scalars to 1-d,
+            # and the npz round-trip must preserve leaf shapes exactly
+            arr = onp.asarray(v, order="C")
+            payload[key] = arr
+            leaves[key] = {
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "axis": _match_shard_axis(key, shard_rules),
+            }
+        npz = os.path.join(staging, self._shard_npz(self.rank))
+        tmp = npz + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            onp.savez(f, **payload)
+        os.replace(tmp, npz)
+        # the drillable seam: a fault injected here leaves a payload
+        # with NO manifest — the commit leader must refuse the step
+        chaos.site("ckpt.shard", step=step, rank=self.rank)
+        manifest = {
+            "format": 1,
+            "step": int(step),
+            "rank": self.rank,
+            "world": self.world,
+            "token": self._token,
+            "file": self._shard_npz(self.rank),
+            "sha256": _sha256_file(npz),
+            "leaves": leaves,
+        }
+        mtmp = os.path.join(staging,
+                            self._shard_manifest(self.rank) + ".tmp")
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(mtmp, os.path.join(staging,
+                                      self._shard_manifest(self.rank)))
+
+    # -- phase 2: leader verifies every shard, then publishes -------------
+    def _commit(self, step: int, meta: Optional[Dict] = None) -> None:
+        staging = self._staging(step)
+        deadline = time.monotonic() + self._deadline
+        shards: List[Dict] = []
+        missing = list(range(self.world))
+
+        def _current(r: int) -> bool:
+            """A shard manifest counts only if it belongs to THIS save
+            attempt — matching step, world and commit token. A stale
+            manifest from an aborted earlier attempt (different
+            membership/generation at the same step number) is treated
+            as absent until the fresh rank overwrites it."""
+            mpath = os.path.join(staging, self._shard_manifest(r))
+            if not os.path.isfile(mpath):
+                return False
+            try:
+                with open(mpath) as f:
+                    m = json.load(f)
+            except (OSError, ValueError):
+                return False  # mid-replace glimpse: retry next poll
+            return (m.get("step") == int(step)
+                    and m.get("world") == self.world
+                    and m.get("token") == self._token)
+
+        while missing:
+            for r in [r for r in missing if _current(r)]:
+                missing.remove(r)
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                shutil.rmtree(staging, ignore_errors=True)
+                raise ShardCommitError(
+                    f"coordinated step {step}: shard manifest(s) from "
+                    f"rank(s) {missing} of {self.world} never arrived "
+                    f"within {self._deadline:g}s — step not published "
+                    "(dead or wedged peer?)")
+            time.sleep(self._poll)
+        bad = []
+        for r in range(self.world):
+            with open(os.path.join(staging, self._shard_manifest(r))) as f:
+                m = json.load(f)
+            npz = os.path.join(staging, m["file"])
+            if not os.path.isfile(npz) or _sha256_file(npz) != m["sha256"]:
+                bad.append(r)
+                continue
+            shards.append({"rank": r, "file": m["file"],
+                           "sha256": m["sha256"], "world": m["world"]})
+        if bad:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise ShardCommitError(
+                f"coordinated step {step}: shard payload(s) from rank(s) "
+                f"{bad} failed SHA256 verification — step not published "
+                "(torn write or bit rot)")
+        manifest = {
+            "format": 1,
+            "step": int(step),
+            "world": self.world,
+            "meta": dict(meta or {}),
+            "shards": shards,
+        }
+        mtmp = os.path.join(staging, self._MANIFEST + ".tmp")
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(mtmp, os.path.join(staging, self._MANIFEST))
+        final = self._step_dir(step)
+        if os.path.isdir(final):
+            shutil.rmtree(final)  # re-save of an existing step (tests)
+        os.replace(staging, final)
+        self._sweep_stale(step)
+        self._gc()
+
+    def _sweep_stale(self, newer_than: int) -> None:
+        """Drop staging dirs of steps older than the one just published
+        (leader only, after a successful publish — never races a
+        concurrent save, which is always for a NEWER step)."""
+        for n in os.listdir(self._dir):
+            if not n.endswith(".staging"):
+                continue
+            head = n[:-len(".staging")]
+            if head.isdigit() and int(head) < int(newer_than):
+                shutil.rmtree(os.path.join(self._dir, n),
+                              ignore_errors=True)
+
+    def _gc(self) -> None:
+        steps = self.all_steps()
+        while len(steps) > self._max_to_keep:
+            victim = steps.pop(0)
+            shutil.rmtree(self._step_dir(victim), ignore_errors=True)
+
+    def _wait_published(self, step: int) -> None:
+        """Non-leader ranks: block until the leader publishes ``step``
+        (or its staging dir is swept after a failed commit)."""
+        staging, final = self._staging(step), self._step_dir(step)
+        deadline = time.monotonic() + self._deadline
+        while True:
+            if os.path.isfile(os.path.join(final, self._MANIFEST)):
+                return
+            if not os.path.isdir(staging):
+                # published is checked first, so a vanished staging dir
+                # means the leader swept it after refusing the commit
+                raise ShardCommitError(
+                    f"coordinated step {step}: leader refused the "
+                    "commit (a shard was missing or corrupt)")
+            if time.monotonic() > deadline:
+                raise ShardCommitError(
+                    f"coordinated step {step}: leader did not publish "
+                    f"within {self._deadline:g}s (dead leader?)")
+            time.sleep(self._poll)
+
+    # -- public API -------------------------------------------------------
+    def save(self, step: int, tree: Any,
+             shard_rules: Sequence[Tuple[str, int]] = (), *,
+             meta: Optional[Dict] = None, wait: bool = True) -> int:
+        """Two-phase coordinated save of this rank's ``tree`` (its LOCAL
+        shard view). Returns ``step`` once the step is published; raises
+        :class:`ShardCommitError` when the step had to be refused."""
+        step = int(step)
+        self._stage(step, tree, shard_rules)
+        if self.rank == 0:
+            self._commit(step, meta=meta)
+        elif wait:
+            self._wait_published(step)
+        return step
+
+    def all_steps(self) -> List[int]:
+        if not os.path.isdir(self._dir):
+            return []
+        out = []
+        for n in os.listdir(self._dir):
+            if n.isdigit() and os.path.isfile(
+                    os.path.join(self._dir, n, self._MANIFEST)):
+                out.append(int(n))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _load_step(self, step: int, like: Optional[Any]) -> Tuple[Any, Dict]:
+        final = self._step_dir(step)
+        with open(os.path.join(final, self._MANIFEST)) as f:
+            manifest = json.load(f)
+        world_saved = int(manifest["world"])
+        shards: Dict[int, Dict[str, onp.ndarray]] = {}
+        axes: Dict[str, Optional[int]] = {}
+        for rec in manifest["shards"]:
+            npz = os.path.join(final, rec["file"])
+            if _sha256_file(npz) != rec["sha256"]:
+                raise CheckpointCorruption(
+                    f"coordinated step {step}: shard {rec['file']} "
+                    "checksum mismatch (bit rot or torn write)")
+            with onp.load(npz) as z:
+                shards[int(rec["rank"])] = {k: z[k] for k in z.files}
+            with open(os.path.join(
+                    final, self._shard_manifest(int(rec["rank"])))) as f:
+                sm = json.load(f)
+            for key, leaf in sm["leaves"].items():
+                axes[key] = leaf["axis"]
+        if len(shards) != world_saved:
+            raise CheckpointCorruption(
+                f"coordinated step {step}: manifest lists "
+                f"{len(shards)} shards for world {world_saved}")
+        # reassemble the GLOBAL tree, then reshard for (rank, world)
+        out: Dict[str, onp.ndarray] = {}
+        for key, axis in axes.items():
+            if axis is None:
+                out[key] = shards[0][key]
+            else:
+                parts = [shards[r][key] for r in range(world_saved)]
+                full = onp.concatenate(parts, axis=axis)
+                out[key] = full[tuple(
+                    shard_slice(full.shape[axis], self.world, self.rank)
+                    if d == axis else slice(None)
+                    for d in range(full.ndim))]
+        info = {"step": step, "world_saved": world_saved,
+                "meta": manifest.get("meta", {})}
+        if like is None:
+            return out, info
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            _to_jax_tree(like))
+        leaves = []
+        for path, _ in flat:
+            key = jax.tree_util.keystr(path)
+            if key not in out:
+                raise CheckpointCorruption(
+                    f"coordinated step {step}: leaf {key} in like= tree "
+                    "but missing from the checkpoint")
+            leaves.append(out[key])
+        return jax.tree_util.tree_unflatten(treedef, leaves), info
+
+    def restore(self, step: Optional[int] = None,
+                like: Optional[Any] = None) -> Tuple[Any, Dict]:
+        """Restore the latest published step (or a pinned ``step``),
+        resharded for THIS manager's (rank, world). Returns ``(tree,
+        info)`` with ``info = {step, world_saved, meta}``.
+
+        Latest-step path: a step that fails verification falls back to
+        the previous published step with a loud warning (the
+        single-process corrupt-step discipline); a pinned ``step`` never
+        substitutes silently. ``like=`` rebuilds the result into the
+        given pytree structure (leaves matched by keypath)."""
+        steps = self.all_steps()
+        if not steps:
+            raise MXNetError(f"no coordinated checkpoints in {self._dir}")
+        if step is not None:
+            step = int(step)
+            if step not in steps:
+                raise MXNetError(
+                    f"no coordinated checkpoint for step {step} in "
+                    f"{self._dir} (published: {steps})")
+            candidates = [step]
+        else:
+            candidates = list(reversed(steps))
+        errors = []
+        for s in candidates:
+            try:
+                return self._load_step(s, like)
+            except Exception as e:  # noqa: BLE001 — fall back, loudly
+                errors.append((s, e))
+                if step is None:
+                    import warnings
+
+                    warnings.warn(
+                        f"CoordinatedCheckpointManager({self._dir}): step "
+                        f"{s} is unusable ({e}); falling back to the "
+                        "previous published step", RuntimeWarning,
+                        stacklevel=2)
+        if step is not None:
+            raise errors[0][1]
+        raise MXNetError(
+            f"every published coordinated step in {self._dir} failed to "
+            f"restore: {[(s, repr(e)) for s, e in errors]}"
+        ) from errors[-1][1]
 

@@ -21,6 +21,8 @@ from .collectives import (
 )
 from .mesh import (
     MESH_AXES,
+    MeshDegradeError,
+    auto_degrade,
     auto_shard_spec,
     current_mesh,
     make_mesh,

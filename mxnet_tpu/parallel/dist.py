@@ -8,6 +8,15 @@ On TPU there are no server/scheduler roles: every process is a worker, and
 tracker rendezvous. This module accepts BOTH the reference's DMLC_* env
 protocol and jax-native args, so ``tools/launch.py``-style launchers keep
 working unchanged.
+
+CPU fault-domain note: XLA's default CPU client has **no cross-process
+collectives** ("Multiprocess computations aren't implemented on the CPU
+backend" — the root cause of the old dist tier-1 failures). jaxlib ships a
+gloo TCP implementation; :func:`initialize` arms it
+(``jax_cpu_collectives_implementation=gloo``) before the backend exists
+whenever the rendezvous targets the CPU platform, so the multi-process
+drills (and any CPU pod) run real collectives instead of failing at the
+first ``process_allgather``.
 """
 from __future__ import annotations
 
@@ -15,6 +24,8 @@ import os
 from typing import Optional
 
 import jax
+
+from ..base import FatalError
 
 __all__ = [
     "initialize",
@@ -24,23 +35,26 @@ __all__ = [
     "local_device_count",
     "device_count",
     "shutdown",
+    "cluster_spec",
+    "ClusterReinitError",
 ]
 
 _initialized = False
+_spec: Optional[dict] = None
 
 
-def initialize(
-    coordinator_address: Optional[str] = None,
-    num_processes: Optional[int] = None,
-    process_id: Optional[int] = None,
-    local_device_ids=None,
-) -> None:
-    """Join the cluster. No-op for single-process runs (exactly like the
-    reference, where kvstore 'local' never touches ps-lite)."""
-    global _initialized
-    if _initialized:
-        return
-    # DMLC env protocol compatibility (reference kvstore_server.py / launch.py)
+class ClusterReinitError(FatalError):
+    """``initialize()`` was called again with a *different* cluster spec.
+
+    Silently no-opping here (the old behavior) left the process thinking
+    it had joined cluster B while every collective still ran against
+    cluster A — call :func:`shutdown` first if a re-rendezvous with a new
+    spec is intended (the ``resilience.elastic`` degrade path does)."""
+
+
+def _resolve_spec(coordinator_address, num_processes, process_id,
+                  local_device_ids) -> dict:
+    """Fold the DMLC_* env protocol into explicit args (explicit wins)."""
     if coordinator_address is None:
         uri = os.environ.get("DMLC_PS_ROOT_URI")
         port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
@@ -52,22 +66,86 @@ def initialize(
     if process_id is None:
         wid = os.environ.get("DMLC_WORKER_ID") or os.environ.get("MX_PROCESS_ID")
         process_id = int(wid) if wid else None
-    if coordinator_address is None and num_processes in (None, 1):
-        _initialized = True  # single process: nothing to rendezvous
+    return {
+        "coordinator_address": coordinator_address,
+        "num_processes": num_processes,
+        "process_id": process_id,
+        "local_device_ids": local_device_ids,
+    }
+
+
+def _arm_cpu_collectives() -> None:
+    """Select gloo CPU collectives BEFORE the first backend touch.
+
+    Only effective before the CPU client exists (jax builds it once); a
+    jaxlib without the flag/gloo support degrades to the old behavior
+    with a warning rather than blocking the rendezvous."""
+    platforms = (os.environ.get("JAX_PLATFORMS", "")
+                 or str(jax.config.jax_platforms or "")).lower()
+    if platforms and "cpu" not in platforms:
+        return  # a real TPU/GPU pod: collectives ride ICI/NCCL
+    try:
+        if jax.config._read("jax_cpu_collectives_implementation") in (
+                None, "", "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - jaxlib without gloo
+        import warnings
+
+        warnings.warn(
+            "parallel.dist: could not arm gloo CPU collectives; "
+            "cross-process computations on the CPU backend will fail "
+            "(upgrade jaxlib)", RuntimeWarning, stacklevel=3)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> None:
+    """Join the cluster. No-op for single-process runs (exactly like the
+    reference, where kvstore 'local' never touches ps-lite).
+
+    Re-calling with the SAME spec is an idempotent no-op; re-calling
+    with a DIFFERENT spec raises :class:`ClusterReinitError` — call
+    :func:`shutdown` first for an intentional re-rendezvous.
+    """
+    global _initialized, _spec
+    spec = _resolve_spec(coordinator_address, num_processes, process_id,
+                         local_device_ids)
+    if _initialized:
+        if _spec is not None and spec != _spec:
+            raise ClusterReinitError(
+                f"parallel.dist already initialized with {_spec}; "
+                f"re-init requested with {spec}. shutdown() first to "
+                "change the cluster spec")
         return
+    if spec["coordinator_address"] is None and \
+            spec["num_processes"] in (None, 1):
+        _initialized = True  # single process: nothing to rendezvous
+        _spec = spec
+        return
+    _arm_cpu_collectives()
     jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
+        coordinator_address=spec["coordinator_address"],
+        num_processes=spec["num_processes"],
+        process_id=spec["process_id"],
+        local_device_ids=spec["local_device_ids"],
     )
     _initialized = True
+    _spec = spec
 
 
 def is_initialized() -> bool:
     # Deliberately does NOT query jax.process_count(): that initializes the
     # XLA backends, after which jax.distributed.initialize() can never run.
     return _initialized
+
+
+def cluster_spec() -> Optional[dict]:
+    """The spec the running cluster was initialized with (None before
+    :func:`initialize` / after :func:`shutdown`)."""
+    return dict(_spec) if _spec is not None else None
 
 
 def rank() -> int:
@@ -87,14 +165,16 @@ def device_count() -> int:
 
 
 def shutdown():
-    global _initialized
+    global _initialized, _spec
     if not _initialized:
         # calling jax.process_count() would itself initialize the XLA
         # backend — the exact side effect shutdown-before-init must avoid
         return
-    if jax.process_count() > 1:
+    multi = _spec is not None and _spec.get("coordinator_address") is not None
+    if multi:
         try:
             jax.distributed.shutdown()
         except Exception:
             pass
     _initialized = False
+    _spec = None

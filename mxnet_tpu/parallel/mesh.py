@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 
-from ..base import safe_devices
+from ..base import FatalError, safe_devices
 import numpy as onp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -35,6 +35,8 @@ __all__ = [
     "named_sharding",
     "shard_params",
     "auto_shard_spec",
+    "auto_degrade",
+    "MeshDegradeError",
 ]
 
 MESH_AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
@@ -155,6 +157,81 @@ def shard_params(
         name: named_sharding(match_rule(name, rules, default), mesh)
         for name in params
     }
+
+
+class MeshDegradeError(FatalError):
+    """No valid degraded mesh shape exists for the surviving device
+    count — e.g. the preserved tp×pp product no longer fits. Fatal by
+    design: resuming on a mesh that silently drops a model-parallel
+    axis would load nonsense shards."""
+
+
+def auto_degrade(
+    axes: Dict[str, int],
+    n_devices: int,
+    *,
+    power_of_two: bool = False,
+    preserve: Sequence[str] = ("tp", "pp"),
+) -> Tuple[Dict[str, int], int]:
+    """Shrink a mesh shape onto ``n_devices`` survivors after rank loss.
+
+    Degrade rule (the elastic fault-domain contract,
+    ``docs/resilience.md``): axes in ``preserve`` (default tensor- and
+    pipeline-parallel) keep their exact sizes — their sharded state
+    cannot be re-tiled without a resharding pass — while the remaining
+    axes (``dp`` first by convention, then ``fsdp``/``sp``/``ep`` in
+    declaration order) absorb the loss. ``power_of_two=True`` further
+    rounds the shrinkable budget down to a power of two (ring/butterfly
+    collective layouts); survivors beyond the returned device count
+    become spares.
+
+    Returns ``(new_axes, devices_used)``. Raises
+    :class:`MeshDegradeError` when no valid shape exists (preserved
+    product exceeds the survivors, or the budget rounds to zero).
+    """
+    n_devices = int(n_devices)
+    if n_devices < 1:
+        raise MeshDegradeError("auto_degrade: no surviving devices")
+    sizes = {a: int(s) for a, s in axes.items()}
+    for a, s in sizes.items():
+        if s < 1:
+            raise ValueError(f"auto_degrade: axis {a!r} has size {s}; "
+                             "resolve -1 axes before degrading")
+    preserved = {a: s for a, s in sizes.items() if a in preserve}
+    p = 1
+    for s in preserved.values():
+        p *= s
+    if p > n_devices:
+        raise MeshDegradeError(
+            f"auto_degrade: preserved axes {preserved} need {p} devices "
+            f"but only {n_devices} survive — no valid degraded shape "
+            "(restore onto a bigger slice or reshard the model axes)")
+    budget = n_devices // p
+    if power_of_two:
+        budget = 1 << (budget.bit_length() - 1)
+    shrink = [a for a in sizes if a not in preserve]
+    # first-listed shrink axis (dp by convention) absorbs the loss
+    # before later ones are touched
+    for i, a in enumerate(shrink):
+        rest = 1
+        for b in shrink[i + 1:]:
+            rest *= sizes[b]
+        if rest > budget:
+            sizes[a] = 1
+            continue
+        sizes[a] = max(1, min(sizes[a], budget // rest))
+    used = p
+    for a in shrink:
+        used *= sizes[a]
+    if used > n_devices:
+        # defensive only: the caps above guarantee the shrink product
+        # fits the budget (every non-preserved axis, sp/ep included, is
+        # shrunk — only `preserve` refuses), so this cannot fire unless
+        # the loop invariant is broken by a future edit
+        raise MeshDegradeError(
+            f"auto_degrade: internal invariant broken — shape {sizes} "
+            f"needs {used} devices with only {n_devices} surviving")
+    return sizes, used
 
 
 def auto_shard_spec(

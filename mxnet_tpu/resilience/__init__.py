@@ -23,20 +23,25 @@ The reference MXNet leaned on ps-lite server restarts for fault
 tolerance; on the jax_graft stack recovery is in-process and
 checkpoint-anchored instead.
 """
-from ..base import (FatalError, Preempted, StallDetected,  # noqa: F401
-                    TransientError)
+from ..base import (ClusterDegraded, FatalError, Preempted,  # noqa: F401
+                    RankLost, StallDetected, TransientError)
 from . import chaos  # noqa: F401
 from .retry import (FATAL, TRANSIENT, RetriesExhausted,  # noqa: F401
                     RetryPolicy, call_with_retry, classify, is_transient,
                     retry)
 from .watchdog import Watchdog, run_with_watchdog  # noqa: F401
 from .supervisor import Supervisor  # noqa: F401
+from . import elastic  # noqa: F401  (after Supervisor: subclasses it)
+from .elastic import (ElasticCluster, ElasticSupervisor,  # noqa: F401
+                      Heartbeat, guard_collective)
 
 __all__ = [
-    "chaos",
+    "chaos", "elastic",
     "classify", "is_transient", "TRANSIENT", "FATAL",
     "RetryPolicy", "RetriesExhausted", "retry", "call_with_retry",
     "Watchdog", "run_with_watchdog",
     "Supervisor",
+    "ElasticCluster", "ElasticSupervisor", "Heartbeat", "guard_collective",
     "TransientError", "FatalError", "StallDetected", "Preempted",
+    "RankLost", "ClusterDegraded",
 ]

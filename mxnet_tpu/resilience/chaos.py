@@ -59,6 +59,12 @@ SITES = (
     "aot.write",          # CompileCache publish, payload staged, pre-rename
     "aot.deserialize",    # cached_jit payload deserialize on a store hit
     "telemetry.export",   # telemetry exporter exposition (file write/HTTP)
+    "dist.heartbeat",     # elastic heartbeat beat loop (kill = dead rank,
+                          # delay = wedged host whose peers see it stale)
+    "dist.collective",    # elastic collective entry (kill:N = rank death
+                          # mid-train, delay = slow-rank straggler)
+    "ckpt.shard",         # coordinated save, between shard payload and
+                          # its manifest (a fault = commit must refuse)
 )
 
 

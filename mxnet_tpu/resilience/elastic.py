@@ -1,0 +1,787 @@
+"""``mxnet_tpu.resilience.elastic`` — the multi-process fault domain.
+
+On a TPU pod, preemption of ONE host is the *common case*, and the
+single-process resilience contract (``Supervisor`` + crash-safe
+``CheckpointManager``) does not survive it: a dead peer turns every
+collective into an indefinite NCCL-style hang, and a torn multi-process
+save has no single ``os.replace`` to hide behind. This module promotes
+the whole fault contract to the cluster:
+
+- **rank health**: every process beats a per-rank heartbeat file under a
+  shared root (:class:`Heartbeat`, period ``MXNET_TPU_HEARTBEAT_S``);
+  chaos site ``dist.heartbeat`` sits in the beat loop so drills can kill
+  or wedge a rank from the heartbeat side.
+- **bounded collectives**: :meth:`ElasticCluster.allreduce_sum` /
+  :meth:`ElasticCluster.barrier` are deadline-bounded
+  (``MXNET_TPU_COLLECTIVE_DEADLINE_S``); a missing peer surfaces as a
+  typed :class:`~mxnet_tpu.base.RankLost` (stale heartbeat — it died) or
+  :class:`~mxnet_tpu.base.ClusterDegraded` (fresh heartbeat — a
+  straggler or partition), both ``TransientError``, each preceded by a
+  flight-recorder dump carrying per-rank heartbeat ages.
+  :func:`guard_collective` wraps jax.distributed-backed collectives with
+  the same contract via the watchdog.
+- **generation-numbered re-rendezvous**: on rank loss survivors join
+  ``gen_<g+1>`` under the shared root; the lowest surviving rank leads,
+  publishes the membership (atomic tmp → ``os.replace``), and the mesh
+  shape degrades via :func:`mxnet_tpu.parallel.mesh.auto_degrade`
+  (dp shrinks first, tp/pp preserved; no valid shape ⇒ fatal).
+  Survivors beyond the degraded device count become **spares**.
+- **elastic supervision**: :class:`ElasticSupervisor` runs a per-rank
+  step loop checkpointed through the two-phase
+  :class:`~mxnet_tpu.checkpoint.CoordinatedCheckpointManager`; on rank
+  loss it degrades, reshards the last coordinated checkpoint onto the
+  new world size, and resumes at the exact step cursor — the
+  single-process restore-and-resume contract across a changing world.
+
+All coordination is filesystem-based (the shared checkpoint root every
+pod job already has), which is what makes the kill-one-of-four →
+degrade-to-three → converge story tier-1-testable on CPU with plain
+subprocesses — no pod required. ``MXNET_TPU_ELASTIC=off`` turns rank
+loss into a fatal error instead of a degrade (for jobs where a fixed
+world size is part of the experiment contract).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import (ClusterDegraded, FatalError, RankLost, StallDetected,
+                    env_float, env_str)
+from ..telemetry import flight as _flight
+from ..telemetry.registry import get_registry
+from . import chaos
+from .supervisor import Supervisor
+from .watchdog import run_with_watchdog
+
+__all__ = [
+    "RankLost", "ClusterDegraded", "Heartbeat", "ElasticCluster",
+    "ElasticSupervisor", "guard_collective", "current_generation",
+    "heartbeat_period_s", "collective_deadline_s", "elastic_mode",
+]
+
+
+def heartbeat_period_s() -> float:
+    """``MXNET_TPU_HEARTBEAT_S`` (default 1.0 s)."""
+    return env_float("MXNET_TPU_HEARTBEAT_S", 1.0)
+
+
+def collective_deadline_s() -> float:
+    """``MXNET_TPU_COLLECTIVE_DEADLINE_S`` (default 30 s)."""
+    return env_float("MXNET_TPU_COLLECTIVE_DEADLINE_S", 30.0)
+
+
+def elastic_mode() -> str:
+    """``MXNET_TPU_ELASTIC``: ``degrade`` (default) or ``off``."""
+    mode = env_str("MXNET_TPU_ELASTIC", "degrade").strip().lower()
+    if mode not in ("degrade", "off"):
+        import warnings
+
+        warnings.warn(
+            f"MXNET_TPU_ELASTIC={mode!r} is not off|degrade; using "
+            "'degrade'", RuntimeWarning, stacklevel=2)
+        return "degrade"
+    return mode
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def _metrics() -> Dict[str, Any]:
+    reg = get_registry()
+    return {
+        "generation": reg.gauge(
+            "elastic_generation", "current elastic membership generation"),
+        "world_size": reg.gauge(
+            "elastic_world_size", "active ranks in the current generation"),
+        "ranks_healthy": reg.gauge(
+            "elastic_ranks_healthy",
+            "ranks with a fresh heartbeat at the last health check"),
+        "spares": reg.gauge(
+            "elastic_spares", "surviving ranks idled by the mesh shape"),
+        "hb_age": reg.gauge(
+            "elastic_last_heartbeat_age_s",
+            "age of each rank's last heartbeat at the last health check",
+            labels=("rank",)),
+        "degrades": reg.counter(
+            "elastic_degrades_total", "mesh degrade events (re-rendezvous)"),
+        "recoveries": reg.counter(
+            "elastic_recoveries_total",
+            "successful degrade → reshard-restore → resume cycles"),
+        "rank_lost": reg.counter(
+            "elastic_rank_lost_total", "rank-loss detections, by lost rank",
+            labels=("rank",)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+class Heartbeat:
+    """Per-rank liveness file under ``<root>/heartbeats/`` beaten from a
+    daemon thread every ``period_s``. Age is file mtime — one shared
+    filesystem, one clock. Chaos site ``dist.heartbeat`` fires per beat
+    (``kill`` = sudden rank death; ``delay`` = a wedged host whose peers
+    see it go stale while its process is technically alive)."""
+
+    def __init__(self, root: str, rank: int,
+                 period_s: Optional[float] = None):
+        self.dir = os.path.join(os.path.abspath(root), "heartbeats")
+        self.rank = int(rank)
+        self.period = float(period_s if period_s is not None
+                            else heartbeat_period_s())
+        self.generation = 0
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _path(self) -> str:
+        return os.path.join(self.dir, f"rank_{self.rank}.json")
+
+    def beat(self) -> None:
+        chaos.site("dist.heartbeat", rank=self.rank)
+        self._seq += 1
+        payload = {"rank": self.rank, "pid": os.getpid(),
+                   "gen": self.generation, "seq": self._seq,
+                   "wall": time.time()}
+        tmp = self._path() + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._path())
+
+    def start(self) -> "Heartbeat":
+        os.makedirs(self.dir, exist_ok=True)
+        self.beat()  # peers must see us alive before the first collective
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"elastic-heartbeat:r{self.rank}")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period):
+            try:
+                self.beat()
+            except Exception:  # noqa: BLE001 — a missed beat, not a crash
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2 * self.period + 1.0)
+
+    @staticmethod
+    def ages(root: str) -> Dict[int, float]:
+        """rank → seconds since its last beat (missing file = absent)."""
+        d = os.path.join(os.path.abspath(root), "heartbeats")
+        out: Dict[int, float] = {}
+        if not os.path.isdir(d):
+            return out
+        now = time.time()
+        for n in os.listdir(d):
+            if not (n.startswith("rank_") and n.endswith(".json")):
+                continue
+            try:
+                r = int(n[len("rank_"):-len(".json")])
+                out[r] = max(0.0, now - os.stat(os.path.join(d, n)).st_mtime)
+            except (ValueError, OSError):
+                continue
+        return out
+
+
+# ---------------------------------------------------------------------------
+# generation rendezvous + bounded collectives
+# ---------------------------------------------------------------------------
+
+def current_generation(root: str) -> Optional[int]:
+    """Newest generation with a published membership, else None."""
+    root = os.path.abspath(root)
+    best = None
+    if not os.path.isdir(root):
+        return None
+    for n in os.listdir(root):
+        if n.startswith("gen_") and n[4:].isdigit() and os.path.isfile(
+                os.path.join(root, n, "membership.json")):
+            g = int(n[4:])
+            best = g if best is None else max(best, g)
+    return best
+
+
+def _read_membership(root: str, gen: int) -> Optional[Dict]:
+    p = os.path.join(root, f"gen_{gen}", "membership.json")
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class ElasticCluster:
+    """Per-process façade over heartbeats, rendezvous and the bounded
+    file-based collectives — the coordination substrate the elastic
+    drills (and any shared-filesystem CPU cluster) run on. ``rank`` is
+    the process's ORIGINAL, stable id; after a degrade the process keeps
+    its rank but its *membership index* (position in ``members``)
+    changes, and spares keep beating heartbeats without stepping.
+    """
+
+    def __init__(self, root: str, rank: int, world: int, *,
+                 axes: Optional[Dict[str, int]] = None,
+                 power_of_two: bool = False,
+                 heartbeat_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 stale_after_s: Optional[float] = None,
+                 start_deadline_s: float = 60.0,
+                 poll_s: float = 0.02,
+                 mode: Optional[str] = None):
+        if world < 1 or not 0 <= rank < world:
+            raise ValueError(f"bad cluster coordinates rank={rank} "
+                             f"world={world}")
+        self.root = os.path.abspath(root)
+        self.rank = int(rank)
+        self.world0 = int(world)
+        self.axes = dict(axes or {"dp": int(world)})
+        self.power_of_two = bool(power_of_two)
+        self.deadline = float(deadline_s if deadline_s is not None
+                              else collective_deadline_s())
+        self.hb = Heartbeat(root, rank, heartbeat_s)
+        self.stale_s = float(stale_after_s if stale_after_s is not None
+                             else max(3.0 * self.hb.period, 0.5))
+        self.start_deadline = float(start_deadline_s)
+        self.poll = float(poll_s)
+        self.mode = mode if mode is not None else elastic_mode()
+        self.gen = -1
+        self.members: List[int] = []
+        self.spares: List[int] = []
+        self._seq = 0
+        self._m = _metrics()
+
+    # -- membership -------------------------------------------------------
+    @property
+    def index(self) -> int:
+        """This rank's position in the active membership."""
+        return self.members.index(self.rank)
+
+    @property
+    def world(self) -> int:
+        return len(self.members)
+
+    @property
+    def is_active(self) -> bool:
+        return self.rank in self.members
+
+    def start(self) -> str:
+        """Beat, then rendezvous generation 0 (or ``max published + 1``
+        on a root that already has generations — a full-pod restart).
+        Returns the role: ``active`` or ``spare``."""
+        self.hb.start()
+        cur = current_generation(self.root)
+        target = 0 if cur is None else cur + 1
+        return self._join(target, expected=list(range(self.world0)),
+                          deadline=self.start_deadline)
+
+    def _fresh(self, candidates: Sequence[int]) -> List[int]:
+        ages = Heartbeat.ages(self.root)
+        self._observe_health(ages)
+        out = [r for r in candidates
+               if ages.get(r, float("inf")) <= self.stale_s]
+        if self.rank not in out:
+            out.append(self.rank)
+        return sorted(out)
+
+    def _observe_health(self, ages: Dict[int, float]) -> None:
+        for r, a in ages.items():
+            self._m["hb_age"].labels(rank=str(r)).set(round(a, 4))
+        healthy = sum(1 for a in ages.values() if a <= self.stale_s)
+        self._m["ranks_healthy"].set(healthy)
+
+    def _adopt(self, membership: Dict) -> str:
+        self.gen = int(membership["gen"])
+        self.members = [int(r) for r in membership["ranks"]]
+        self.spares = [int(r) for r in membership.get("spares", [])]
+        self.axes = dict(membership.get("axes", self.axes))
+        self._seq = 0
+        self.hb.generation = self.gen
+        self._m["generation"].set(self.gen)
+        self._m["world_size"].set(len(self.members))
+        self._m["spares"].set(len(self.spares))
+        return "active" if self.rank in self.members else "spare"
+
+    def _publish(self, gen: int, present: Sequence[int]) -> Dict:
+        from ..parallel import mesh as _mesh
+
+        fresh = self._fresh(present)
+        axes, used = _mesh.auto_degrade(self.axes, len(fresh),
+                                        power_of_two=self.power_of_two)
+        membership = {
+            "gen": int(gen),
+            "ranks": list(fresh[:used]),
+            "spares": list(fresh[used:]),
+            "axes": axes,
+            "published_by": self.rank,
+            "wall": time.time(),
+        }
+        gdir = os.path.join(self.root, f"gen_{gen}")
+        tmp = os.path.join(gdir, f"membership.json.tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(membership, f, indent=1)
+        os.replace(tmp, os.path.join(gdir, "membership.json"))
+        return membership
+
+    def _join(self, gen: int, expected: Sequence[int],
+              deadline: float) -> str:
+        """Rendezvous at ``gen``: register, then either lead (lowest
+        expected rank present) or follow. Convergence rule: whatever
+        ends up in ``membership.json`` wins — even a leader re-reads
+        after publishing, so racing publishers settle on one file."""
+        expected = sorted(set(int(r) for r in expected) | {self.rank})
+        gdir = os.path.join(self.root, f"gen_{gen}")
+        os.makedirs(gdir, exist_ok=True)
+        me = os.path.join(gdir, f"member_{self.rank}.json")
+        tmp = me + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"rank": self.rank, "pid": os.getpid(),
+                       "wall": time.time()}, f)
+        os.replace(tmp, me)
+        t0 = time.monotonic()
+        leader = min(expected)
+        takeover_after = t0 + max(0.5 * deadline, 4 * self.stale_s)
+        while True:
+            # a newer generation may appear while we rendezvous (e.g. a
+            # straggler arriving after survivors already moved on)
+            newest = current_generation(self.root)
+            if newest is not None and newest >= gen:
+                m = _read_membership(self.root, newest)
+                if m is not None:
+                    return self._adopt(m)
+            present = sorted(
+                int(n[len("member_"):-len(".json")])
+                for n in os.listdir(gdir)
+                if n.startswith("member_") and n.endswith(".json"))
+            if leader == self.rank or (
+                    time.monotonic() > takeover_after
+                    and leader not in present
+                    and present and min(self._fresh(present)) == self.rank):
+                if set(expected).issubset(present) \
+                        or time.monotonic() - t0 > deadline:
+                    self._publish(gen, present)
+                    m = _read_membership(self.root, gen)
+                    return self._adopt(m)
+            elif time.monotonic() - t0 > deadline:
+                # the expected leader never published: it died between
+                # detection and rendezvous — surface that as a loss
+                ages = Heartbeat.ages(self.root)
+                self._observe_health(ages)
+                _flight.try_dump(f"rank_lost:{leader}")
+                raise RankLost(
+                    f"elastic rendezvous gen {gen}: leader rank "
+                    f"{leader} never published membership within "
+                    f"{deadline:g}s", lost=[leader], ages=ages)
+            time.sleep(self.poll)
+
+    # -- degrade ----------------------------------------------------------
+    def degrade(self) -> str:
+        """Re-rendezvous the survivors at the next generation (after a
+        :class:`RankLost` / :class:`ClusterDegraded` /
+        :class:`~mxnet_tpu.checkpoint.ShardCommitError`). Returns the
+        new role (``active`` / ``spare``). ``MXNET_TPU_ELASTIC=off``
+        refuses with a :class:`~mxnet_tpu.base.FatalError`."""
+        if self.mode != "degrade":
+            raise FatalError(
+                "rank loss with MXNET_TPU_ELASTIC=off: elastic degrade "
+                "is disabled, the fixed world size is part of this "
+                "job's contract — restart the pod at full strength")
+        self._m["degrades"].inc()
+        cur = current_generation(self.root)
+        if cur is not None and cur > self.gen:
+            # the survivors already re-rendezvoused while we were busy
+            # (a straggler arriving late): adopt THEIR membership — if
+            # it does not include us we are evicted into a spare.
+            # Creating generation cur+1 here instead would fork a
+            # second cluster against the same checkpoint root.
+            m = _read_membership(self.root, cur)
+            if m is not None:
+                return self._adopt(m)
+        target = (self.gen if cur is None else max(cur, self.gen)) + 1
+        survivors = self._fresh(self.members or range(self.world0))
+        role = self._join(target, expected=survivors,
+                          deadline=self.deadline)
+        return role
+
+    # -- bounded collectives ---------------------------------------------
+    def _coll_dir(self, seq: int) -> str:
+        return os.path.join(self.root, "coll", f"g{self.gen}_{seq:06d}")
+
+    def _gc_collectives(self, seq: int) -> None:
+        """Leader-only, occasional: drop collective dirs everyone has
+        long moved past (and whole older-generation trails)."""
+        if not self.members or self.members[0] != self.rank or seq % 32:
+            return
+        base = os.path.join(self.root, "coll")
+        if not os.path.isdir(base):
+            return
+        import shutil
+
+        for n in os.listdir(base):
+            try:
+                g, s = n.lstrip("g").split("_", 1)
+                if int(g) < self.gen or (int(g) == self.gen
+                                         and int(s) < seq - 16):
+                    shutil.rmtree(os.path.join(base, n),
+                                  ignore_errors=True)
+            except (ValueError, OSError):
+                continue
+
+    def _wait_peers(self, d: str, suffix: str, name: str) -> None:
+        """Wait (bounded) for every active member's file in ``d``; on
+        timeout or a stale peer, diagnose via heartbeats and raise the
+        typed loss. Detection window ≈ min(deadline, stale_after)."""
+        deadline = time.monotonic() + self.deadline
+        next_health = time.monotonic() + max(self.stale_s / 2, 0.05)
+        while True:
+            waiting = [r for r in self.members if not os.path.isfile(
+                os.path.join(d, f"rank_{r}.{suffix}"))]
+            if not waiting:
+                return
+            now = time.monotonic()
+            stale_check = now >= next_health
+            if stale_check:
+                next_health = now + max(self.stale_s / 2, 0.05)
+            if now > deadline or stale_check:
+                ages = Heartbeat.ages(self.root)
+                self._observe_health(ages)
+                lost = [r for r in waiting
+                        if ages.get(r, float("inf")) > self.stale_s]
+                if lost:
+                    for r in lost:
+                        self._m["rank_lost"].labels(rank=str(r)).inc()
+                    _flight.try_dump(
+                        "rank_lost:" + "_".join(str(r) for r in lost))
+                    raise RankLost(
+                        f"collective {name!r} (gen {self.gen}): rank(s) "
+                        f"{lost} stopped heartbeating "
+                        f"(ages {dict((r, round(ages.get(r, -1), 2)) for r in lost)}) — "
+                        "lost", lost=lost, ages=ages)
+                if now > deadline:
+                    _flight.try_dump("cluster_degraded:" + "_".join(
+                        str(r) for r in waiting))
+                    raise ClusterDegraded(
+                        f"collective {name!r} (gen {self.gen}): rank(s) "
+                        f"{waiting} still heartbeating but absent after "
+                        f"{self.deadline:g}s — straggler or partition",
+                        ages=ages)
+            time.sleep(self.poll)
+
+    def barrier(self, name: str = "barrier") -> None:
+        """All active members reach this point, or a typed loss within
+        the deadline."""
+        self._seq += 1
+        chaos.site("dist.collective", label=name, seq=self._seq)
+        d = self._coll_dir(self._seq)
+        os.makedirs(d, exist_ok=True)
+        mine = os.path.join(d, f"rank_{self.rank}.done")
+        with open(mine + ".tmp", "w") as f:
+            f.write(str(time.time()))
+        os.replace(mine + ".tmp", mine)
+        self._wait_peers(d, "done", name)
+        self._gc_collectives(self._seq)
+
+    def allreduce_sum(self, arr, name: str = "allreduce") -> onp.ndarray:
+        """Sum ``arr`` across active members, deterministically (reduced
+        in rank order), or raise the typed loss within the deadline."""
+        self._seq += 1
+        chaos.site("dist.collective", label=name, seq=self._seq)
+        d = self._coll_dir(self._seq)
+        os.makedirs(d, exist_ok=True)
+        arr = onp.asarray(arr, order="C")
+        mine = os.path.join(d, f"rank_{self.rank}.npy")
+        tmp = mine + f".tmp{os.getpid()}.npy"
+        onp.save(tmp, arr)
+        os.replace(tmp, mine)
+        self._wait_peers(d, "npy", name)
+        out = None
+        for r in self.members:
+            part = self._load_part(os.path.join(d, f"rank_{r}.npy"))
+            out = part if out is None else out + part
+        self._gc_collectives(self._seq)
+        return out
+
+    def _load_part(self, path: str, attempts: int = 5) -> onp.ndarray:
+        # the marker is the atomically-replaced file itself, but a
+        # shared-fs reader can still glimpse a not-yet-visible rename;
+        # a couple of micro-retries make the read robust
+        for i in range(attempts):
+            try:
+                return onp.load(path)
+            except (OSError, ValueError):
+                if i == attempts - 1:
+                    raise
+                time.sleep(self.poll)
+
+    def stop(self) -> None:
+        self.hb.stop()
+
+
+def guard_collective(fn: Callable, *args,
+                     deadline_s: Optional[float] = None,
+                     heartbeat_root: Optional[str] = None,
+                     stale_after_s: Optional[float] = None,
+                     name: Optional[str] = None, **kwargs):
+    """Deadline wrapper for jax.distributed-backed collective entry
+    points (the watchdog integration): a wedged peer turns the call into
+    :class:`~mxnet_tpu.base.StallDetected`, which this re-types via the
+    heartbeat dir — stale peer ⇒ :class:`RankLost`, everyone fresh ⇒
+    :class:`ClusterDegraded` — instead of hanging the pod."""
+    label = name or getattr(fn, "__name__", "collective")
+    chaos.site("dist.collective", label=label)
+    deadline = float(deadline_s if deadline_s is not None
+                     else collective_deadline_s())
+    try:
+        return run_with_watchdog(fn, deadline, *args, name=label, **kwargs)
+    except StallDetected as e:
+        ages = Heartbeat.ages(heartbeat_root) if heartbeat_root else {}
+        stale = float(stale_after_s if stale_after_s is not None
+                      else max(3.0 * heartbeat_period_s(), 0.5))
+        lost = sorted(r for r, a in ages.items() if a > stale)
+        if lost:
+            m = _metrics()
+            for r in lost:
+                m["rank_lost"].labels(rank=str(r)).inc()
+            _flight.try_dump(
+                "rank_lost:" + "_".join(str(r) for r in lost))
+            raise RankLost(
+                f"collective {label!r} missed its {deadline:g}s deadline "
+                f"and rank(s) {lost} stopped heartbeating",
+                lost=lost, ages=ages) from e
+        raise ClusterDegraded(
+            f"collective {label!r} missed its {deadline:g}s deadline "
+            "with every peer still heartbeating — straggler or "
+            "partition", ages=ages) from e
+
+
+# ---------------------------------------------------------------------------
+# elastic supervision
+# ---------------------------------------------------------------------------
+
+class _SpareExit(BaseException):
+    """Control flow: this rank became a spare after a degrade.
+    BaseException so the classifier/flight filter never mistakes it for
+    a fault."""
+
+
+class ElasticSupervisor(Supervisor):
+    """:class:`~mxnet_tpu.resilience.Supervisor` for the multi-process
+    fault domain: N ranks step together, checkpoint through the
+    two-phase coordinated manager, and on rank loss re-rendezvous,
+    degrade the mesh, reshard the last coordinated step and resume at
+    the exact cursor.
+
+    ``step_fn(state, i, cluster)`` must be deterministic given the
+    membership (the drills' exact-resume oracle depends on it) and do
+    its cross-rank reductions through ``cluster`` (or another
+    deadline-bounded collective) so a dead peer surfaces typed.
+
+    ``shard_rules`` — ``[(regex, axis)]`` over checkpoint leaf keypaths
+    (state leaves live under ``['state']``): matching leaves are
+    per-rank shards concatenated in membership order and re-split on
+    restore (``checkpoint.shard_slice`` boundaries), everything else is
+    replicated. The drills use it for ZeRO-style optimizer state.
+    """
+
+    def __init__(self, root: str, rank: int, world: int, *,
+                 axes: Optional[Dict[str, int]] = None,
+                 power_of_two: bool = False,
+                 policy=None, save_every_n_steps: int = 10,
+                 max_to_keep: int = 5, handle_sigterm: bool = False,
+                 heartbeat_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 stale_after_s: Optional[float] = None,
+                 start_deadline_s: float = 60.0,
+                 shard_rules: Sequence[Tuple[str, int]] = (),
+                 mode: Optional[str] = None):
+        self.cluster = ElasticCluster(
+            root, rank, world, axes=axes, power_of_two=power_of_two,
+            heartbeat_s=heartbeat_s, deadline_s=deadline_s,
+            stale_after_s=stale_after_s,
+            start_deadline_s=start_deadline_s, mode=mode)
+        self.shard_rules = tuple(shard_rules)
+        self._root = os.path.abspath(root)
+        super().__init__(self._root, policy=policy,
+                         save_every_n_batches=save_every_n_steps,
+                         max_to_keep=max_to_keep,
+                         handle_sigterm=handle_sigterm,
+                         manager=_PENDING)
+        self._max_to_keep = int(max_to_keep)
+        self._counters["degrades"] = 0
+        from .. import profiler
+
+        self._prof["degrades"] = profiler.Counter(
+            name="resilience.degrades")
+        self._role: Optional[str] = None
+        self._need_degrade = False
+
+    # -- membership plumbing ---------------------------------------------
+    def _ckpt_dir(self) -> str:
+        return os.path.join(self._root, "ckpt")
+
+    def _rebuild_manager(self) -> None:
+        from ..checkpoint import CoordinatedCheckpointManager
+
+        self.manager = CoordinatedCheckpointManager(
+            self._ckpt_dir(), self.cluster.index, self.cluster.world,
+            max_to_keep=self._max_to_keep,
+            commit_deadline_s=self.cluster.deadline,
+            # generation-bound commit token: shards staged by an
+            # aborted pre-degrade attempt can never satisfy this
+            # generation's two-phase commit
+            token=f"g{self.cluster.gen}")
+
+    def start(self) -> str:
+        """Rendezvous generation 0 and build the coordinated manager.
+        Idempotent; returns the role."""
+        if self._role is None:
+            self._role = self.cluster.start()
+            if self._role == "active":
+                self._rebuild_manager()
+        return self._role
+
+    # -- the supervised elastic step loop ---------------------------------
+    def run_steps(self, step_fn: Callable[[Any, int, ElasticCluster], Any],
+                  init_state: Any, n_steps: int) -> Dict[str, Any]:
+        """Supervise ``state = step_fn(state, i, cluster)`` for ``i in
+        range(n_steps)`` across the fault domain. Returns
+        ``{"role", "state", "gen", "members", **stats}``; a rank idled
+        into a spare by a degrade returns ``role="spare"`` with
+        ``state=None`` (its shards live on in the survivors'
+        checkpoints)."""
+        role = self.start()
+        if role != "active":
+            return self._spare_result()
+        cursor = {"i": 0, "state": init_state}
+        last_saved = {"i": -1}
+        booted = {"done": False}
+
+        def save():
+            step = (self.manager.latest_step() or 0) + 1
+            self.manager.save(
+                step,
+                {"state": cursor["state"],
+                 "progress": {"i": int(cursor["i"])}},
+                self.shard_rules,
+                meta={"gen": self.cluster.gen,
+                      "members": self.cluster.members,
+                      "axes": self.cluster.axes,
+                      "cursor": int(cursor["i"])})
+            last_saved["i"] = cursor["i"]
+            self._count("saves")
+
+        def restore_state():
+            if self.manager.latest_step() is None:
+                cursor.update(i=0, state=init_state)
+                return
+            from ..telemetry import tracing as _tracing
+
+            with _tracing.span("supervisor.restore", cat="resilience"):
+                like = {"state": cursor["state"], "progress": {"i": 0}}
+                tree, info = self.manager.restore(like=like)
+                cursor.update(i=int(tree["progress"]["i"]),
+                              state=tree["state"])
+            self._count("restores")
+
+        def restore_fn():
+            if self._need_degrade:
+                self._need_degrade = False
+                self._count("degrades")
+                role = self.cluster.degrade()
+                if role != "active":
+                    raise _SpareExit()
+                self._rebuild_manager()
+                restore_state()
+                self._m_recoveries.inc()
+                return
+            restore_state()
+
+        def run_once():
+            # first entry (and only then): fresh-process resume, or the
+            # coordinated baseline BEFORE the first step so a fault
+            # before the first periodic save cannot replay onto warm
+            # state. Inside the supervised loop, so a peer dying during
+            # the very first save degrades instead of crashing the job.
+            if not booted["done"]:
+                if self.manager.latest_step() is None:
+                    self._coordinated_save(save)
+                else:
+                    restore_state()
+                booted["done"] = True
+            while cursor["i"] < n_steps:
+                i = cursor["i"]
+                try:
+                    cursor["state"] = step_fn(cursor["state"], i,
+                                              self.cluster)
+                except (RankLost, ClusterDegraded):
+                    self._need_degrade = True
+                    raise
+                cursor["i"] = i + 1
+                self._check_preempted(save)
+                if cursor["i"] % self.save_every == 0:
+                    self._coordinated_save(save)
+            if last_saved["i"] != cursor["i"]:
+                self._coordinated_save(save)
+            return dict(role="active", state=cursor["state"],
+                        i=cursor["i"], gen=self.cluster.gen,
+                        members=list(self.cluster.members),
+                        axes=dict(self.cluster.axes), **self.stats())
+
+        self._m_recoveries = _metrics()["recoveries"]
+        try:
+            return self._supervised(run_once, restore_fn)
+        except _SpareExit:
+            return self._spare_result()
+        finally:
+            self.cluster.stop()
+
+    def _coordinated_save(self, save: Callable[[], None]) -> None:
+        """A save where a dead peer surfaces as a degrade trigger, not a
+        fatal: ShardCommitError is transient and flips the degrade
+        flag exactly like a lost collective."""
+        from ..checkpoint import ShardCommitError
+
+        try:
+            save()
+        except ShardCommitError:
+            self._need_degrade = True
+            raise
+
+    def _spare_result(self) -> Dict[str, Any]:
+        self.cluster.stop()
+        return dict(role="spare", state=None, i=None,
+                    gen=self.cluster.gen,
+                    members=list(self.cluster.members),
+                    axes=dict(self.cluster.axes), **self.stats())
+
+    def fit(self, *args, **kwargs):
+        raise NotImplementedError(
+            "ElasticSupervisor supervises step functions (run_steps); "
+            "the estimator front-end lands with the GSPMD trainer "
+            "promotion")
+
+
+#: sentinel for Supervisor(manager=...) before the first rendezvous
+class _Pending:
+    def __getattr__(self, name):
+        raise RuntimeError(
+            "ElasticSupervisor: call start()/run_steps() first — the "
+            "coordinated checkpoint manager exists only after the "
+            "generation-0 rendezvous fixes this rank's membership index")
+
+
+_PENDING = _Pending()

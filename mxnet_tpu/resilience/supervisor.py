@@ -61,16 +61,23 @@ class Supervisor:
     handle_sigterm : bool
         Install the preemption handler around the loop (main thread
         only; restored on exit).
+    manager : optional
+        Inject a checkpoint-manager object instead of constructing a
+        :class:`~mxnet_tpu.checkpoint.CheckpointManager` over
+        ``directory`` — the seam ``resilience.elastic`` uses to swap in
+        the coordinated multi-process manager (whose shard coordinates
+        only exist after the rendezvous).
     """
 
     def __init__(self, directory: str, policy: Optional[RetryPolicy] = None,
                  save_every_n_batches: int = 100, max_to_keep: int = 5,
-                 handle_sigterm: bool = True):
+                 handle_sigterm: bool = True, manager=None):
         from ..checkpoint import CheckpointManager  # lazy: import cycle
 
         if save_every_n_batches < 1:
             raise ValueError("save_every_n_batches must be >= 1")
-        self.manager = CheckpointManager(directory, max_to_keep=max_to_keep)
+        self.manager = manager if manager is not None else \
+            CheckpointManager(directory, max_to_keep=max_to_keep)
         self.policy = policy or RetryPolicy()
         self.save_every = int(save_every_n_batches)
         self._handle_sigterm = handle_sigterm

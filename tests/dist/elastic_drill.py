@@ -1,0 +1,98 @@
+"""Worker script for the elastic fault-domain drills: N of these train a
+tiny data-parallel linear model through ``ElasticSupervisor`` over a
+shared filesystem root. One rank is armed (per-process env) with a chaos
+rule — ``dist.collective=kill:K`` (sudden death mid-train),
+``dist.collective=delay:S`` (slow-rank straggler), or
+``ckpt.shard=raise:oserror`` (shard corruption at save) — and the
+survivors must detect, degrade, reshard-restore and converge.
+
+Run via tests/test_elastic.py (which spawns the processes and checks the
+final weights against a NumPy oracle), or by hand::
+
+    python tests/dist/elastic_drill.py --root /tmp/el --rank 0 --world 4
+
+Prints ``ELASTIC_RESULT {json}`` as the last stdout line.
+
+Determinism contract (the oracle depends on it): each ORIGINAL rank owns
+a fixed data shard (seeded by rank id); the gradient is the mean of the
+active members' shard gradients, reduced in membership order; momentum
+is ZeRO-style sharded over members along axis 0 (``shard_slice``
+boundaries), so a degrade reshards optimizer state too.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+from mxnet_tpu.checkpoint import shard_slice  # noqa: E402
+from mxnet_tpu.resilience.elastic import ElasticSupervisor  # noqa: E402
+
+D = 10       # model dim (uneven splits at world 3 and 4: the point)
+N_PER = 6    # samples per rank shard
+LR, MU = 0.1, 0.9
+SHARD_RULES = [(r"\['m'\]", 0)]  # momentum is ZeRO-sharded
+
+
+def make_data(rank: int):
+    rng = onp.random.RandomState(100 + rank)
+    x = rng.randn(N_PER, D).astype("float32")
+    y = (x @ onp.arange(D, dtype="float32")).astype("float32")
+    return x, y
+
+
+def step_fn(state, i, cluster):
+    w = state["w"]
+    x, y = make_data(cluster.rank)
+    g_local = 2.0 / N_PER * x.T @ (x @ w - y)
+    g = cluster.allreduce_sum(g_local, name="grad") / cluster.world
+    sl = shard_slice(D, cluster.world, cluster.index)
+    m = MU * state["m"] + g[sl]
+    delta = onp.zeros(D, "float32")
+    delta[sl] = LR * m
+    delta = cluster.allreduce_sum(delta, name="delta")
+    return {"w": w - delta, "m": m}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--save-every", type=int, default=2)
+    ap.add_argument("--power-of-two", action="store_true")
+    ap.add_argument("--heartbeat-s", type=float, default=0.1)
+    ap.add_argument("--deadline-s", type=float, default=3.0)
+    ap.add_argument("--stale-after-s", type=float, default=0.8)
+    args = ap.parse_args()
+
+    sup = ElasticSupervisor(
+        args.root, args.rank, args.world,
+        power_of_two=args.power_of_two,
+        save_every_n_steps=args.save_every,
+        heartbeat_s=args.heartbeat_s,
+        deadline_s=args.deadline_s,
+        stale_after_s=args.stale_after_s,
+        start_deadline_s=90.0,
+        shard_rules=SHARD_RULES)
+    init = {
+        "w": onp.zeros(D, "float32"),
+        "m": onp.zeros(shard_slice(D, args.world, args.rank).stop
+                       - shard_slice(D, args.world, args.rank).start,
+                       "float32"),
+    }
+    result = sup.run_steps(step_fn, init, args.steps)
+    out = {k: v for k, v in result.items() if k != "state"}
+    if result.get("state") is not None:
+        out["w"] = [round(float(v), 8) for v in result["state"]["w"]]
+    out["rank"] = args.rank
+    print("ELASTIC_RESULT " + json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,597 @@
+"""Elastic fault domain (ISSUE 10): coordinated sharded checkpointing,
+rank-loss detection, mesh auto-degrade resume.
+
+The acceptance drill spawns 4 REAL processes over a shared filesystem
+root, chaos-kills rank 2 mid-train (``dist.collective=kill:5``), and
+asserts the survivors re-rendezvous at generation 1, degrade the mesh to
+3-wide, reshard the last coordinated checkpoint and converge to EXACTLY
+the weights of an uninterrupted degraded-membership run (NumPy oracle) —
+with the lost rank named in a flight-recorder dump that carries the
+``elastic_*`` gauges.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+DRILL = os.path.join(ROOT, "tests", "dist", "elastic_drill.py")
+
+# the drill script's training contract (kept in sync by the oracle test)
+D, N_PER, LR, MU = 10, 6, 0.1, 0.9
+
+
+# ---------------------------------------------------------------------------
+# units: mesh degrade rule
+# ---------------------------------------------------------------------------
+def test_auto_degrade_dp_shrinks_first():
+    from mxnet_tpu.parallel.mesh import auto_degrade
+
+    assert auto_degrade({"dp": 4}, 3) == ({"dp": 3}, 3)
+    assert auto_degrade({"dp": 8}, 8) == ({"dp": 8}, 8)
+    # tp preserved, dp absorbs the loss; one survivor idles (spare)
+    assert auto_degrade({"dp": 2, "tp": 2}, 3) == ({"dp": 1, "tp": 2}, 2)
+    assert auto_degrade({"dp": 4, "tp": 2}, 6) == ({"dp": 3, "tp": 2}, 6)
+    # non-preserved later axes shrink only after dp is exhausted
+    assert auto_degrade({"dp": 4, "sp": 2}, 3) == ({"dp": 1, "sp": 2}, 2)
+    assert auto_degrade({"dp": 1, "sp": 4}, 2) == ({"dp": 1, "sp": 2}, 2)
+
+
+def test_auto_degrade_power_of_two():
+    from mxnet_tpu.parallel.mesh import auto_degrade
+
+    assert auto_degrade({"dp": 4}, 3, power_of_two=True) == ({"dp": 2}, 2)
+    assert auto_degrade({"dp": 6}, 5, power_of_two=True) == ({"dp": 4}, 4)
+
+
+def test_auto_degrade_refuses_impossible_shape():
+    from mxnet_tpu.parallel.mesh import MeshDegradeError, auto_degrade
+
+    with pytest.raises(MeshDegradeError):
+        auto_degrade({"dp": 2, "tp": 4}, 3)  # tp=4 cannot fit 3 devices
+    with pytest.raises(MeshDegradeError):
+        auto_degrade({"dp": 2}, 0)
+
+
+# ---------------------------------------------------------------------------
+# units: dist bootstrap satellite (spec tracking, typed re-init, shutdown)
+# ---------------------------------------------------------------------------
+def test_dist_reinit_different_spec_is_typed_and_shutdown_resets():
+    from mxnet_tpu.base import FatalError
+    from mxnet_tpu.parallel import dist
+
+    was = dist.is_initialized()
+    try:
+        dist.initialize()  # single-process fast path
+        assert dist.is_initialized()
+        assert dist.cluster_spec() is not None
+        dist.initialize()  # same spec: idempotent no-op
+        with pytest.raises(dist.ClusterReinitError) as ei:
+            dist.initialize(coordinator_address="127.0.0.1:1",
+                            num_processes=2, process_id=0)
+        assert isinstance(ei.value, FatalError)
+        dist.shutdown()
+        assert not dist.is_initialized()
+        assert dist.cluster_spec() is None
+        dist.initialize()  # re-init after shutdown is allowed
+        assert dist.is_initialized()
+    finally:
+        dist.shutdown()
+        if was:  # restore whatever state the session had
+            dist.initialize()
+
+
+# ---------------------------------------------------------------------------
+# units: coordinated sharded checkpointing
+# ---------------------------------------------------------------------------
+def test_shard_slice_boundaries_cover_exactly():
+    from mxnet_tpu.checkpoint import shard_slice
+
+    for length in (1, 7, 10, 16):
+        for world in (1, 2, 3, 4, 5):
+            spans = [shard_slice(length, world, r) for r in range(world)]
+            assert spans[0].start == 0 and spans[-1].stop == length
+            for a, b in zip(spans, spans[1:]):
+                assert a.stop == b.start
+
+
+def _stage_all(d, step, world, m_full, w_rep, rules, scale=1.0, prog=0):
+    """Stage every non-leader rank's shard for ``step`` (phase 1)."""
+    from mxnet_tpu.checkpoint import (CoordinatedCheckpointManager,
+                                      shard_slice)
+
+    mgrs = [CoordinatedCheckpointManager(d, r, world, commit_deadline_s=10)
+            for r in range(world)]
+    for r in range(1, world):
+        mgrs[r]._stage(step, {
+            "state": {"w": w_rep * scale,
+                      "m": m_full[shard_slice(len(m_full), world, r)] * scale},
+            "progress": {"i": prog}}, rules)
+    return mgrs
+
+
+def test_coordinated_two_phase_save_and_reshard_on_load(tmp_path):
+    from mxnet_tpu.checkpoint import (CoordinatedCheckpointManager,
+                                      shard_slice)
+
+    rules = [(r"\['m'\]", 0)]
+    m_full = onp.arange(10, dtype="float32")
+    w = onp.ones(3, "float32") * 7
+    d = str(tmp_path)
+    mgrs = _stage_all(d, 5, 4, m_full, w, rules, prog=3)
+    step = mgrs[0].save(5, {"state": {"w": w, "m": m_full[shard_slice(10, 4, 0)]},
+                            "progress": {"i": 3}}, rules, meta={"gen": 0})
+    assert step == 5 and mgrs[0].all_steps() == [5]
+    # restore into a DIFFERENT world size (4 -> 3): reshard-on-load
+    for r in range(3):
+        m2 = CoordinatedCheckpointManager(d, r, 3)
+        like = {"state": {"w": w, "m": m_full}, "progress": {"i": 0}}
+        tree, info = m2.restore(like=like)
+        assert info["step"] == 5 and info["world_saved"] == 4
+        assert info["meta"] == {"gen": 0}
+        onp.testing.assert_array_equal(tree["state"]["w"], w)
+        onp.testing.assert_array_equal(tree["state"]["m"],
+                                       m_full[shard_slice(10, 3, r)])
+        assert int(tree["progress"]["i"]) == 3
+
+
+def test_corrupt_shard_never_publishes_and_restore_falls_back(tmp_path):
+    """A corrupt shard fails the leader's SHA256 verify: the step is
+    refused (never published) and restore falls back to the previous
+    valid coordinated step."""
+    from mxnet_tpu.checkpoint import (CoordinatedCheckpointManager,
+                                      ShardCommitError, shard_slice)
+
+    rules = [(r"\['m'\]", 0)]
+    m_full = onp.arange(10, dtype="float32")
+    w = onp.ones(3, "float32")
+    d = str(tmp_path)
+    # step 1: clean
+    mgrs = _stage_all(d, 1, 2, m_full, w, rules, prog=1)
+    mgrs[0].save(1, {"state": {"w": w, "m": m_full[shard_slice(10, 2, 0)]},
+                     "progress": {"i": 1}}, rules)
+    # step 2: rank 1's payload corrupted AFTER its manifest claimed a hash
+    _stage_all(d, 2, 2, m_full, w, rules, scale=2.0, prog=9)
+    with open(os.path.join(d, "2.staging", "shard_r1.npz"), "r+b") as f:
+        f.seek(12)
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(ShardCommitError, match="SHA256"):
+        mgrs[0].save(2, {"state": {"w": w * 2,
+                                   "m": m_full[shard_slice(10, 2, 0)] * 2},
+                         "progress": {"i": 9}}, rules)
+    assert mgrs[0].all_steps() == [1]          # step 2 never existed
+    assert not os.path.isdir(os.path.join(d, "2.staging"))
+    tree, info = CoordinatedCheckpointManager(d, 0, 2).restore()
+    assert info["step"] == 1
+    # like=None returns the flat keypath->array view
+    assert int(tree["['progress']['i']"]) == 1
+
+
+def test_chaos_shard_fault_refuses_commit(tmp_path):
+    """Chaos site ``ckpt.shard`` (between payload and shard manifest):
+    the injected fault leaves a manifest-less shard, so the leader's
+    commit deadline refuses the step — chaos-verified two-phase
+    discipline."""
+    from mxnet_tpu.checkpoint import (CoordinatedCheckpointManager,
+                                      ShardCommitError, shard_slice)
+    from mxnet_tpu.resilience import chaos
+
+    rules = [(r"\['m'\]", 0)]
+    m_full = onp.arange(10, dtype="float32")
+    w = onp.ones(3, "float32")
+    d = str(tmp_path)
+    mgrs = _stage_all(d, 1, 2, m_full, w, rules)
+    mgrs[0].save(1, {"state": {"w": w, "m": m_full[shard_slice(10, 2, 0)]},
+                     "progress": {"i": 0}}, rules)
+    m0 = CoordinatedCheckpointManager(d, 0, 2, commit_deadline_s=0.5)
+    m1 = CoordinatedCheckpointManager(d, 1, 2, commit_deadline_s=0.5)
+    # sequential staging makes the fire deterministic: rank 1 stages
+    # first inside the scope, so the single fire hits ITS shard
+    with chaos.scope("ckpt.shard", fail="oserror", times=1):
+        with pytest.raises(OSError):
+            m1._stage(2, {"state": {"w": w, "m": m_full[shard_slice(10, 2, 1)]},
+                          "progress": {"i": 0}}, rules)
+        with pytest.raises(ShardCommitError, match="never arrived"):
+            m0.save(2, {"state": {"w": w, "m": m_full[shard_slice(10, 2, 0)]},
+                        "progress": {"i": 0}}, rules)
+    assert m0.all_steps() == [1]
+    _, info = m0.restore()
+    assert info["step"] == 1
+
+
+def test_stale_staging_from_aborted_save_never_mixes_into_commit(tmp_path):
+    """A leader killed pre-publish leaves a fully-populated staging dir;
+    a later save of the SAME step number at a different world/membership
+    must not satisfy its commit with those stale shards (commit-token
+    validation), and a matching-token re-stage overwrites cleanly."""
+    from mxnet_tpu.checkpoint import (CoordinatedCheckpointManager,
+                                      ShardCommitError, shard_slice)
+
+    rules = [(r"\['m'\]", 0)]
+    m_full = onp.arange(10, dtype="float32")
+    w = onp.ones(3, "float32")
+    d = str(tmp_path)
+    # aborted generation-0 attempt: ALL 4 ranks staged step 1, leader
+    # died before publishing
+    for r in range(4):
+        CoordinatedCheckpointManager(d, r, 4, token="g0")._stage(
+            1, {"state": {"w": w, "m": m_full[shard_slice(10, 4, r)]},
+                "progress": {"i": 0}}, rules)
+    # post-degrade world 3, generation 1: only the leader stages —
+    # the stale world-4/g0 manifests must NOT satisfy the commit
+    m0 = CoordinatedCheckpointManager(d, 0, 3, token="g1",
+                                      commit_deadline_s=0.5)
+    with pytest.raises(ShardCommitError, match="never arrived"):
+        m0.save(1, {"state": {"w": w, "m": m_full[shard_slice(10, 3, 0)]},
+                    "progress": {"i": 0}}, rules)
+    assert m0.all_steps() == []
+    # a full matching-token attempt commits fine (fresh ranks overwrite)
+    mgrs = [CoordinatedCheckpointManager(d, r, 3, token="g1",
+                                         commit_deadline_s=5)
+            for r in range(3)]
+    for r in (1, 2):
+        mgrs[r]._stage(1, {"state": {"w": w,
+                                     "m": m_full[shard_slice(10, 3, r)]},
+                           "progress": {"i": 0}}, rules)
+    assert mgrs[0].save(
+        1, {"state": {"w": w, "m": m_full[shard_slice(10, 3, 0)]},
+            "progress": {"i": 0}}, rules) == 1
+    like = {"state": {"w": w, "m": m_full}, "progress": {"i": 0}}
+    tree, info = mgrs[1].restore(like=like)
+    assert info["world_saved"] == 3
+    onp.testing.assert_array_equal(tree["state"]["m"],
+                                   m_full[shard_slice(10, 3, 1)])
+
+
+# ---------------------------------------------------------------------------
+# rank health: stragglers, watchdog integration, heartbeat chaos
+# ---------------------------------------------------------------------------
+def _mk_cluster(root, rank, world, **kw):
+    from mxnet_tpu.resilience.elastic import ElasticCluster
+
+    kw.setdefault("heartbeat_s", 0.1)
+    kw.setdefault("deadline_s", 1.0)
+    kw.setdefault("stale_after_s", 0.5)
+    kw.setdefault("start_deadline_s", 30.0)
+    kw.setdefault("mode", "degrade")
+    return ElasticCluster(str(root), rank, world, **kw)
+
+
+def test_straggler_surfaces_cluster_degraded_within_deadline(tmp_path):
+    """A live-but-slow peer (fresh heartbeat, absent from the
+    collective) surfaces as typed ClusterDegraded within the deadline
+    window instead of an indefinite hang."""
+    from mxnet_tpu.base import ClusterDegraded
+
+    clusters = [_mk_cluster(tmp_path, r, 2) for r in range(2)]
+    roles, errs = {}, {}
+
+    def run(r):
+        try:
+            roles[r] = clusters[r].start()
+            if r == 1:
+                time.sleep(3.5)  # the straggler: misses the collective
+                return
+            t0 = time.monotonic()
+            try:
+                clusters[r].allreduce_sum(onp.ones(4, "float32"))
+            except BaseException as e:  # noqa: BLE001
+                errs[r] = (e, time.monotonic() - t0)
+        finally:
+            if r == 0:
+                clusters[r].stop()
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    [t.start() for t in ts]
+    ts[0].join(30)
+    e, elapsed = errs[0]
+    assert isinstance(e, ClusterDegraded), e
+    assert 1 in e.ages and e.ages[1] <= 0.5  # straggler was heartbeating
+    assert elapsed < 4.0                      # bounded, not a hang
+    clusters[1].stop()
+    ts[1].join(10)
+
+
+def test_dead_rank_surfaces_rank_lost_with_ages(tmp_path):
+    """A rank whose heartbeat goes stale surfaces as RankLost naming it,
+    within ~stale_after even when the collective deadline is longer."""
+    from mxnet_tpu.base import RankLost
+
+    clusters = [_mk_cluster(tmp_path, r, 2, deadline_s=10.0)
+                for r in range(2)]
+    errs = {}
+
+    def run(r):
+        clusters[r].start()
+        if r == 1:
+            clusters[r].stop()  # dies right after the rendezvous
+            return
+        t0 = time.monotonic()
+        try:
+            clusters[r].allreduce_sum(onp.ones(3, "float32"))
+        except BaseException as e:  # noqa: BLE001
+            errs[r] = (e, time.monotonic() - t0)
+        clusters[r].stop()
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    [t.start() for t in ts]
+    [t.join(30) for t in ts]
+    e, elapsed = errs[0]
+    assert isinstance(e, RankLost), e
+    assert e.lost == (1,)
+    assert e.ages.get(1, 0) > 0.5
+    assert elapsed < 5.0  # detected by staleness, not the 10 s deadline
+
+
+def test_guard_collective_retypes_stall(tmp_path):
+    """Watchdog integration: a wedged jax-style collective becomes
+    ClusterDegraded (peers fresh) / RankLost (peer stale) instead of a
+    hang."""
+    from mxnet_tpu.base import ClusterDegraded, RankLost
+    from mxnet_tpu.resilience.elastic import Heartbeat, guard_collective
+
+    def wedged():
+        time.sleep(10)
+
+    with pytest.raises(ClusterDegraded):
+        guard_collective(wedged, deadline_s=0.3, name="psum")
+
+    hb = Heartbeat(str(tmp_path), rank=3, period_s=0.05).start()
+    hb.stop()
+    time.sleep(0.4)  # rank 3's heartbeat goes stale
+    with pytest.raises(RankLost) as ei:
+        guard_collective(wedged, deadline_s=0.3, name="psum",
+                         heartbeat_root=str(tmp_path), stale_after_s=0.2)
+    assert ei.value.lost == (3,)
+
+
+@pytest.mark.chaos
+def test_heartbeat_chaos_delay_surfaces_typed_loss(tmp_path):
+    """Chaos site ``dist.heartbeat`` with injected latency: the wedged
+    rank's beats slow past the stale threshold and its missing
+    collective contribution surfaces typed (RankLost or, if a beat
+    lands inside the check window, ClusterDegraded) — bounded either
+    way."""
+    from mxnet_tpu.base import ClusterDegraded, RankLost
+    from mxnet_tpu.resilience import chaos
+
+    clusters = [_mk_cluster(tmp_path, r, 2, stale_after_s=0.4,
+                            deadline_s=1.2) for r in range(2)]
+    errs = {}
+
+    def run0():
+        t0 = time.monotonic()
+        try:
+            clusters[0].allreduce_sum(onp.ones(2, "float32"))
+        except BaseException as e:  # noqa: BLE001
+            errs[0] = (e, time.monotonic() - t0)
+
+    # both ranks rendezvous concurrently (start() blocks on the peer)
+    starts = [threading.Thread(target=c.start) for c in clusters]
+    [t.start() for t in starts]
+    [t.join(30) for t in starts]
+    # rank 1 stops collectives; every subsequent beat (both ranks) is
+    # delayed past the stale threshold
+    with chaos.scope("dist.heartbeat", delay=0.6):
+        t = threading.Thread(target=run0)
+        t.start()
+        t.join(30)
+    for c in clusters:
+        c.stop()
+    e, elapsed = errs[0]
+    assert isinstance(e, (RankLost, ClusterDegraded)), e
+    assert elapsed < 6.0
+    assert chaos.stats().get("dist.heartbeat", {}).get("delay", 0) >= 1
+
+
+def test_elastic_off_mode_refuses_degrade(tmp_path):
+    from mxnet_tpu.base import FatalError
+
+    c = _mk_cluster(tmp_path, 0, 1, mode="off")
+    c.start()
+    try:
+        with pytest.raises(FatalError, match="MXNET_TPU_ELASTIC=off"):
+            c.degrade()
+    finally:
+        c.stop()
+
+
+def test_env_knobs_feed_defaults(monkeypatch):
+    from mxnet_tpu.resilience import elastic
+
+    monkeypatch.setenv("MXNET_TPU_HEARTBEAT_S", "2.5")
+    monkeypatch.setenv("MXNET_TPU_COLLECTIVE_DEADLINE_S", "7.5")
+    monkeypatch.setenv("MXNET_TPU_ELASTIC", "off")
+    assert elastic.heartbeat_period_s() == 2.5
+    assert elastic.collective_deadline_s() == 7.5
+    assert elastic.elastic_mode() == "off"
+    monkeypatch.setenv("MXNET_TPU_ELASTIC", "bogus")
+    with pytest.warns(RuntimeWarning):
+        assert elastic.elastic_mode() == "degrade"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drills (real processes over a shared root)
+# ---------------------------------------------------------------------------
+def _data(rank):
+    rng = onp.random.RandomState(100 + rank)
+    x = rng.randn(N_PER, D).astype("float32")
+    y = (x @ onp.arange(D, dtype="float32")).astype("float32")
+    return x, y
+
+
+def _oracle(phases):
+    """Uninterrupted replay of the drill math: ``phases`` is a list of
+    (members, first_step, last_step_exclusive). Momentum is kept as the
+    full vector — exactly what the sharded slices concatenate to."""
+    w = onp.zeros(D, "float32")
+    m = onp.zeros(D, "float32")
+    for members, lo, hi in phases:
+        for _ in range(lo, hi):
+            g = onp.zeros(D, "float32")
+            for r in members:  # membership order = reduction order
+                x, y = _data(r)
+                g = g + 2.0 / N_PER * x.T @ (x @ w - y)
+            g = g / len(members)
+            m = MU * m + g
+            w = w - LR * m
+    return w
+
+
+def _spawn_drill(root, rank, world, *, steps=8, save_every=2,
+                 power_of_two=False, chaos_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MXNET_TPU_CHAOS", None)
+    env.pop("MXNET_TPU_FLIGHT_DIR", None)
+    if chaos_env:
+        env["MXNET_TPU_CHAOS"] = chaos_env
+    cmd = [sys.executable, DRILL, "--root", str(root), "--rank", str(rank),
+           "--world", str(world), "--steps", str(steps),
+           "--save-every", str(save_every)]
+    if power_of_two:
+        cmd.append("--power-of-two")
+    return subprocess.Popen(cmd, env=env, cwd=ROOT, text=True,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def _collect(procs, timeout=150):
+    out = {}
+    for rank, p in procs.items():
+        stdout, stderr = p.communicate(timeout=timeout)
+        res = None
+        for line in stdout.splitlines():
+            if line.startswith("ELASTIC_RESULT "):
+                res = json.loads(line[len("ELASTIC_RESULT "):])
+        out[rank] = (p.returncode, res, stderr)
+    return out
+
+
+@pytest.mark.integration
+def test_elastic_drill_kill_one_of_four_degrades_and_converges(tmp_path):
+    """THE acceptance drill: 4 ranks train, chaos kills rank 2
+    mid-epoch, survivors degrade to a 3-wide mesh, reshard-restore the
+    last coordinated checkpoint and resume at the exact cursor — final
+    weights equal an uninterrupted degraded-membership run, and the
+    flight dump names the lost rank with the elastic gauges aboard."""
+    root = tmp_path / "drill"
+    procs = {
+        r: _spawn_drill(root, r, 4,
+                        chaos_env=("dist.collective=kill:5" if r == 2
+                                   else None))
+        for r in range(4)
+    }
+    results = _collect(procs)
+    rc2, res2, _ = results[2]
+    assert rc2 == 137, f"rank 2 must die by chaos kill, got rc={rc2}"
+    for r in (0, 1, 3):
+        rc, res, err = results[r]
+        assert rc == 0 and res is not None, f"rank {r}: rc={rc}\n{err[-2000:]}"
+        assert res["role"] == "active"
+        assert res["gen"] == 1
+        assert res["members"] == [0, 1, 3]
+        assert res["axes"] == {"dp": 3}
+        assert res["i"] == 8
+        assert res["degrades"] == 1 and res["restores"] == 1
+    # every survivor converged to the SAME weights...
+    w0 = onp.asarray(results[0][1]["w"], "float32")
+    for r in (1, 3):
+        onp.testing.assert_allclose(
+            onp.asarray(results[r][1]["w"], "float32"), w0, rtol=1e-6)
+    # ...equal to the uninterrupted degraded run resumed from the last
+    # coordinated checkpoint: steps 0-1 at full strength (kill call #5 =
+    # step 2's first collective; last coordinated save at cursor 2),
+    # steps 2-7 on the degraded membership
+    w_oracle = _oracle([([0, 1, 2, 3], 0, 2), ([0, 1, 3], 2, 8)])
+    onp.testing.assert_allclose(w0, w_oracle, rtol=1e-5, atol=1e-6)
+
+    # flight dump: a survivor's post-mortem names the lost rank and
+    # carries the elastic gauges
+    flight_dir = root / "flight"
+    dumps = [n for n in os.listdir(flight_dir)
+             if n.startswith("flight_") and "rank_lost-2" in n]
+    assert dumps, f"no rank_lost flight dump in {os.listdir(flight_dir)}"
+    with open(flight_dir / dumps[0]) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "rank_lost:2"
+    fams = payload["metrics"]["metrics"]
+    for name in ("elastic_generation", "elastic_world_size",
+                 "elastic_ranks_healthy", "elastic_last_heartbeat_age_s",
+                 "elastic_rank_lost_total"):
+        assert name in fams, f"{name} missing from flight metrics"
+    lost_series = fams["elastic_rank_lost_total"]["series"]
+    assert any(s["labels"].get("rank") == "2" for s in lost_series)
+
+
+@pytest.mark.integration
+def test_elastic_drill_power_of_two_degrade_leaves_a_spare(tmp_path):
+    """Power-of-two mesh rule: killing 1 of 4 degrades to 2-wide (not
+    3) and the third survivor becomes a spare that exits cleanly."""
+    root = tmp_path / "drill"
+    procs = {
+        r: _spawn_drill(root, r, 4, steps=4, power_of_two=True,
+                        chaos_env=("dist.collective=kill:1" if r == 3
+                                   else None))
+        for r in range(4)
+    }
+    results = _collect(procs)
+    assert results[3][0] == 137
+    roles = {r: results[r][1]["role"] for r in (0, 1, 2)}
+    assert sorted(roles.values()) == ["active", "active", "spare"]
+    actives = [r for r, role in roles.items() if role == "active"]
+    assert actives == [0, 1]  # lowest survivors stay active
+    for r in actives:
+        res = results[r][1]
+        assert res["members"] == [0, 1] and res["axes"] == {"dp": 2}
+        assert res["i"] == 4
+    spare = results[2][1]
+    assert spare["members"] == [0, 1] and results[2][0] == 0
+    w0 = onp.asarray(results[0][1]["w"], "float32")
+    onp.testing.assert_allclose(
+        onp.asarray(results[1][1]["w"], "float32"), w0, rtol=1e-6)
+    # rank 3 died on its very first collective: every completed step ran
+    # on the degraded [0, 1] membership from the baseline checkpoint
+    onp.testing.assert_allclose(w0, _oracle([([0, 1], 0, 4)]),
+                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# telemetry + tooling wiring
+# ---------------------------------------------------------------------------
+def test_elastic_gauges_visible_in_snapshot_and_prometheus():
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience.elastic import _metrics
+
+    m = _metrics()
+    m["generation"].set(3)
+    m["world_size"].set(2)
+    m["hb_age"].labels(rank="7").set(0.25)
+    snap = telemetry.snapshot()["metrics"]
+    assert snap["elastic_generation"]["series"][0]["value"] == 3
+    text = telemetry.prometheus_text()
+    assert "elastic_generation 3" in text
+    assert 'elastic_last_heartbeat_age_s{rank="7"} 0.25' in text
+
+
+def test_chaos_bench_elastic_quick(tmp_path):
+    """tools/chaos_bench.py --elastic --quick banks the elastic rows."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import chaos_bench
+    finally:
+        sys.path.pop(0)
+    out = tmp_path / "results_elastic_cpu.json"
+    rc = chaos_bench.main(["--elastic", "--quick", "--out", str(out)])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    metrics = {r["metric"] for r in payload["records"]}
+    assert "elastic_shard_commit_overhead_pct" in metrics
+    assert "elastic_recovery_wall_s" in metrics
+    worlds = {r.get("world") for r in payload["records"]
+              if r["metric"] == "elastic_shard_commit_overhead_pct"}
+    assert worlds == {1, 2, 4}

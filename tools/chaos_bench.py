@@ -1,7 +1,22 @@
 #!/usr/bin/env python
 """Chaos bench: zero-overhead proof + recovery-overhead measurement.
 
-Three row families, banked to ``benchmark/results_chaos_cpu.json``:
+``--elastic`` switches to the elastic fault-domain rows, banked to
+``benchmark/results_elastic_cpu.json`` (``--quick`` for the tier-1
+wiring check):
+
+- ``elastic_shard_commit_overhead_pct`` — two-phase coordinated save
+  (per-rank npz shards + SHA256 verify + leader publish) vs the
+  monolithic single-process ``CheckpointManager.save`` of the same
+  payload, at world 1/2/4 (ranks staged sequentially in-process, so the
+  coordinated number is an upper bound).
+- ``elastic_recovery_wall_s`` — a 2-rank in-process elastic run where
+  rank 1 dies mid-train: the survivor's largest inter-step gap =
+  detection + re-rendezvous + reshard-restore + replay, measured at
+  checkpoint periods 1 and 4 (the period is the replay knob).
+
+Default mode: three row families, banked to
+``benchmark/results_chaos_cpu.json``:
 
 - ``chaos_site_disarmed_ns`` — ns/call of a **disarmed** chaos site vs a
   bare loop: the acceptance criterion's "one dict lookup, no profiler
@@ -162,29 +177,188 @@ def bench_recovery(tmpdir: str, n_steps: int, fault_every: int) -> List[Dict]:
     }]
 
 
+def bench_shard_commit(tmpdir: str, kib: int,
+                       worlds=(1, 2, 4)) -> List[Dict]:
+    """Two-phase coordinated save vs monolithic save, same payload."""
+    import numpy as onp
+
+    from mxnet_tpu import checkpoint as ckpt
+
+    rules = [(r"\['w\d+'\]", 0)]  # every leaf sharded along axis 0
+    tree = {"w%d" % i: onp.random.RandomState(i).randn(
+        64, kib).astype("float32") for i in range(4)}
+    nbytes = sum(v.nbytes for v in tree.values())
+    # untimed warmup (first orbax save pays one-off init)
+    warm = ckpt.CheckpointManager(os.path.join(tmpdir, "warm_mono"))
+    warm.save(1, {"w": onp.ones(8, "float32")})
+    mono = ckpt.CheckpointManager(os.path.join(tmpdir, "mono"),
+                                  max_to_keep=2)
+    t0 = time.perf_counter()
+    mono.save(1, tree)
+    mono_s = time.perf_counter() - t0
+    rows = []
+    for world in worlds:
+        d = os.path.join(tmpdir, f"coord_w{world}")
+        mgrs = [ckpt.CoordinatedCheckpointManager(
+            d, r, world, commit_deadline_s=60) for r in range(world)]
+
+        def local(r):
+            return {k: v[ckpt.shard_slice(v.shape[0], world, r)]
+                    for k, v in tree.items()}
+
+        t0 = time.perf_counter()
+        for r in range(1, world):
+            mgrs[r]._stage(1, local(r), rules)
+        mgrs[0].save(1, local(0), rules)
+        coord_s = time.perf_counter() - t0
+        rows.append({
+            "metric": "elastic_shard_commit_overhead_pct",
+            "value": round((coord_s - mono_s) / mono_s * 100, 1),
+            "unit": "%", "world": world,
+            "coordinated_ms": round(coord_s * 1e3, 2),
+            "monolithic_ms": round(mono_s * 1e3, 2),
+            "payload_mb": round(nbytes / 2**20, 2),
+            "note": "per-rank shard stage + SHA256 verify + leader "
+                    "publish vs single-process CheckpointManager.save; "
+                    "ranks staged sequentially in-process (upper bound)",
+        })
+    return rows
+
+
+def bench_elastic_recovery(tmpdir: str, save_every: int,
+                           n_steps: int, die_at: int) -> Dict:
+    """2-rank in-process elastic run; rank 1 dies at ``die_at``. The
+    survivor's largest inter-step wall gap is the recovery cost."""
+    import threading
+
+    import numpy as onp
+
+    from mxnet_tpu.checkpoint import shard_slice
+    from mxnet_tpu.resilience.elastic import ElasticSupervisor
+
+    root = os.path.join(tmpdir, f"recovery_se{save_every}")
+    dim = 16
+    step_times: List[float] = []
+
+    def make_step(rank):
+        rng = onp.random.RandomState(rank)
+        x = rng.randn(8, dim).astype("float32")
+        y = rng.randn(8).astype("float32")
+
+        def step_fn(state, i, cluster):
+            if rank == 0:
+                step_times.append(time.monotonic())
+            w = state["w"]
+            g = cluster.allreduce_sum(
+                2.0 / 8 * x.T @ (x @ w - y)) / cluster.world
+            sl = shard_slice(dim, cluster.world, cluster.index)
+            m = 0.9 * state["m"] + g[sl]
+            delta = onp.zeros(dim, "float32")
+            delta[sl] = 0.05 * m
+            return {"w": w - cluster.allreduce_sum(delta), "m": m}
+
+        return step_fn
+
+    results = {}
+
+    def run(rank):
+        sup = ElasticSupervisor(
+            root, rank, 2, heartbeat_s=0.05, deadline_s=1.5,
+            stale_after_s=0.3, save_every_n_steps=save_every,
+            start_deadline_s=30, shard_rules=[(r"\['m'\]", 0)],
+            mode="degrade")
+        init = {"w": onp.zeros(dim, "float32"),
+                "m": onp.zeros(shard_slice(dim, 2, rank).stop
+                               - shard_slice(dim, 2, rank).start,
+                               "float32")}
+        inner = make_step(rank)
+
+        def wrapped(state, i, cluster):
+            if rank == 1 and i >= die_at:
+                cluster.stop()
+                raise SystemExit
+            return inner(state, i, cluster)
+
+        try:
+            results[rank] = sup.run_steps(wrapped, init, n_steps)
+        except SystemExit:
+            results[rank] = None
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            results[rank] = e
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    res = results.get(0)
+    if not isinstance(res, dict):
+        raise RuntimeError(
+            f"elastic recovery bench: surviving rank 0 did not finish "
+            f"(save_every={save_every}): {res!r}")
+    gaps = [b - a for a, b in zip(step_times, step_times[1:])]
+    recovery = max(gaps) if gaps else 0.0
+    typical = sorted(gaps)[len(gaps) // 2] if gaps else 0.0
+    return {
+        "metric": "elastic_recovery_wall_s",
+        "value": round(recovery, 3), "unit": "s",
+        "save_every": save_every, "n_steps": n_steps, "die_at": die_at,
+        "typical_step_s": round(typical, 4),
+        "degrades": res["degrades"], "restores": res["restores"],
+        "replayed_steps": die_at - (die_at // save_every) * save_every,
+        "note": "survivor's largest inter-step gap = stale-detection + "
+                "re-rendezvous + reshard-restore + replay-to-cursor; "
+                "checkpoint period trades save cost vs replay on "
+                "recovery",
+    }
+
+
+def run_elastic(args) -> List[Dict]:
+    records: List[Dict] = []
+    with tempfile.TemporaryDirectory(prefix="elastic_bench_") as tmpdir:
+        records += bench_shard_commit(tmpdir, args.ckpt_kib)
+        for save_every in (1, 4):
+            records.append(bench_elastic_recovery(
+                tmpdir, save_every, n_steps=args.steps,
+                die_at=max(2, args.steps // 2 + 1)))
+    return records
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--out", default=os.path.join(
-        REPO, "benchmark", "results_chaos_cpu.json"))
+    ap.add_argument("--out", default=None)
     ap.add_argument("--site-calls", type=int, default=1_000_000)
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--fault-every", type=int, default=15)
     ap.add_argument("--ckpt-kib", type=int, default=1024)
+    ap.add_argument("--elastic", action="store_true",
+                    help="bench the elastic fault-domain rows instead "
+                         "(banked to results_elastic_cpu.json)")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny elastic sizes (tier-1 wiring check)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny sizes (tier-1 wiring check)")
     args = ap.parse_args(argv)
-    if args.smoke:
+    if args.out is None:
+        args.out = os.path.join(
+            REPO, "benchmark",
+            "results_elastic_cpu.json" if args.elastic
+            else "results_chaos_cpu.json")
+    if args.smoke or (args.quick and args.elastic):
         args.site_calls = 50_000
         args.steps = 10
         args.fault_every = 4
         args.ckpt_kib = 16
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    records: List[Dict] = []
-    with tempfile.TemporaryDirectory(prefix="chaos_bench_") as tmpdir:
-        records += bench_site_overhead(args.site_calls)
-        records += bench_checkpoint(tmpdir, args.ckpt_kib)
-        records += bench_recovery(tmpdir, args.steps, args.fault_every)
+    if args.elastic:
+        records = run_elastic(args)
+    else:
+        records = []
+        with tempfile.TemporaryDirectory(prefix="chaos_bench_") as tmpdir:
+            records += bench_site_overhead(args.site_calls)
+            records += bench_checkpoint(tmpdir, args.ckpt_kib)
+            records += bench_recovery(tmpdir, args.steps, args.fault_every)
 
     import jax
 
@@ -193,6 +367,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "captured_unix": time.time(),
         "device": jax.default_backend(),
         "smoke": bool(args.smoke),
+        "quick": bool(args.quick),
         "records": records,
     }
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)

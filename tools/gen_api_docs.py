@@ -74,6 +74,11 @@ MODULES = {
     "mxnet_tpu.analysis": "tpulint — TPU anti-pattern analyzer "
                           "(jaxpr + AST rules, runtime sentinel)",
     "mxnet_tpu.aot": "persistent compile cache + ahead-of-time warmup",
+    "mxnet_tpu.resilience": "chaos injection, retry + transient-vs-fatal "
+                            "classifier, watchdog, supervised training",
+    "mxnet_tpu.resilience.elastic": "elastic fault domain: heartbeats, "
+                                    "rank-loss detection, mesh "
+                                    "auto-degrade resume",
     "mxnet_tpu.serving": "dynamic-batching inference serving engine",
     "mxnet_tpu.serving.llm": "continuous-batching LLM serving: paged "
                              "KV block pool, prefill/decode split, "
