@@ -760,7 +760,8 @@ def capture_llm_serving() -> None:
     the 4.7%-of-roofline gap's closure is a measured time series, not
     one number."""
     rc, out = run_child(
-        [sys.executable, os.path.join(HERE, "llm_serve_bench.py")],
+        [sys.executable, os.path.join(HERE, "llm_serve_bench.py"),
+         "--spec", "--prefix"],
         timeout=2400)
     rec = parse_json_output(out)
     if not bank_if_tpu(LLM_SERVING, rec, rc, "llm serving bench") or not rec:
@@ -771,6 +772,8 @@ def capture_llm_serving() -> None:
         roof = float(banked.get("decode_roofline_tok_s") or 0)
         if roof <= 0:
             return  # llm_bench hasn't banked a roofline yet
+        sp = rec.get("spec_prefix") or {}
+        sp_row = sp.get("engine_spec_prefix") or {}
         point = {
             "captured_unix": time.time(),
             "engine_tok_s": rec.get("value"),
@@ -779,6 +782,11 @@ def capture_llm_serving() -> None:
                 "lane_occupancy"),
             "hbm_utilization": round(
                 float(rec.get("value") or 0) / roof, 4),
+            # ISSUE 11: the spec+prefix attack on the same roofline
+            "spec_prefix_tok_s": sp_row.get("tok_s"),
+            "spec_prefix_speedup_vs_plain": sp.get("speedup_vs_plain"),
+            "draft_acceptance_rate": sp_row.get("draft_acceptance_rate"),
+            "prefix_hit_rate": sp_row.get("prefix_hit_rate"),
             "code_rev": rec.get("code_rev"),
         }
         traj = [p for p in banked.get("serving_trajectory", [])

@@ -150,23 +150,28 @@ class _CausalLM(HybridBlock):
 
     def decode_step_paged(self, token_ids, pool_k, pool_v, block_table,
                           positions):
-        """Paged-KV decode of one token per lane: ``token_ids`` is
-        (R, 1) at per-lane absolute ``positions`` (R,), K/V land in the
-        shared block pools through ``block_table`` (R, MB). Returns
-        (logits (R, 1, V), new_pool_k, new_pool_v). The continuous-
-        batching decode program (:mod:`mxnet_tpu.serving.llm`) is one
-        jit of this — static pool/table shapes, so admission and
-        sequence growth never retrace."""
+        """Paged-KV decode of T tokens per lane: ``token_ids`` is
+        (R, T) — lane ``r``'s token ``t`` at absolute position
+        ``positions[r] + t`` — K/V land in the shared block pools
+        through ``block_table`` (R, MB). Returns (logits (R, T, V),
+        new_pool_k, new_pool_v). T=1 is the continuous-batching decode
+        program (:mod:`mxnet_tpu.serving.llm`); T=K+1 is the speculative
+        verify forward; T=suffix-bucket is shared-prefix suffix prefill
+        — all static pool/table shapes, so admission and sequence
+        growth never retrace."""
         from ...numpy_extension import _call
 
         emb = self.word_embed(token_ids)
         pos_table = self.pos_embed.data()
+        t = token_ids.shape[1]
 
         def add_pos(e, table, ps):
-            # per-lane gather (dense decode_step slices ONE shared pos):
-            # jnp gather clamps out-of-range lanes — the serving engine
-            # bounds positions against the context window on the host
-            return e + jnp.take(table, ps.astype(jnp.int32), axis=0)[:, None]
+            # per-lane, per-offset gather (dense decode_step slices ONE
+            # shared pos): jnp gather clamps out-of-range lanes — the
+            # serving engine bounds positions against the context
+            # window on the host
+            idx = ps.astype(jnp.int32)[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+            return e + jnp.take(table, idx, axis=0)
 
         emb = _call(add_pos, (emb, pos_table, positions),
                     name="add_pos_embed_paged")

@@ -23,7 +23,8 @@ from ...ndarray.ndarray import ndarray, _unwrap, _wrap
 from ..block import HybridBlock
 
 __all__ = ["generate", "beam_search", "paged_decode_program",
-           "paged_prefill_program"]
+           "paged_prefill_program", "paged_suffix_prefill_program",
+           "paged_spec_draft_program", "paged_spec_verify_program"]
 
 
 class _StepAdapter(HybridBlock):
@@ -50,7 +51,7 @@ class _PagedStepAdapter(HybridBlock):
                                             block_table, positions)
 
 
-_DECODE_CACHE_MAX = 16
+_DECODE_CACHE_MAX = 32
 # model -> {ckey: jitted program}; a WeakKeyDictionary so cached programs
 # die with the model and NOTHING is stored on the model itself (pickling
 # any model type keeps working — no lock/jit objects in __dict__)
@@ -104,6 +105,16 @@ def _sample(logits, key, greedy, temperature, top_k):
 
 
 _KV_CACHE_DTYPES = (None, "int8", "float32", "bfloat16", "float16")
+
+
+def _fused_state(cache_dtype) -> bool:
+    """The fused-Pallas-decode arm state at program-build time — part of
+    every paged program's cache key, so toggling
+    ``MXNET_TPU_LLM_FUSED_DECODE`` between engines on one model never
+    resurrects a program traced the other way."""
+    from ...ops.pallas.fused_decode import fused_decode_armed
+
+    return bool(fused_decode_armed(kv_dtype=str(cache_dtype)))
 
 
 def _resolve_cache_dtype(model, kv_cache_dtype):
@@ -424,7 +435,8 @@ def paged_decode_program(model, *, max_running, num_blocks, block_size,
                                           weight_dtype)
     tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
     ckey = ("paged_decode", r, int(num_blocks), int(block_size), mb,
-            bool(greedy), *tkey, cache_dtype, weight_dtype, bool(donate))
+            bool(greedy), *tkey, cache_dtype, weight_dtype, bool(donate),
+            _fused_state(cache_dtype))
     store, cached = _decode_cache(model, ckey)
     if cached is not None:
         return cached, params
@@ -502,4 +514,270 @@ def paged_prefill_program(model, *, prefill_len, num_blocks, block_size,
         return first, pool_k, pool_v
 
     jrun = _paged_jit(run, "llm.prefill", (3, 4) if donate else (), store)
+    return jrun, params
+
+
+def paged_suffix_prefill_program(model, *, suffix_len, num_blocks,
+                                 block_size, max_blocks_per_seq,
+                                 kv_cache_dtype=None, weight_dtype=None,
+                                 greedy=True, temperature=1.0, top_k=0,
+                                 donate=False):
+    """Build (or fetch memoized) the shared-prefix *suffix* prefill
+    program for one suffix-length bucket.
+
+    When a prompt's leading full blocks are resident in the engine's
+    prefix cache, only the uncached suffix needs compute. The suffix is
+    fed as ONE multi-token paged step (``decode_step_paged`` with
+    ``T = Sb``): every suffix token's K/V is written through the lane's
+    block table at absolute positions ``start_pos + t``, and each token
+    attends over the pool with length ``start_pos + t + 1`` — the
+    cached prefix blocks feed the attention without ever being
+    recomputed, and the per-position length mask IS the causal mask.
+
+    Returns ``(run, params)``: ``run(params, suffix (1, Sb) i32,
+    start_pos () i32, last_idx () i32, pool_k, pool_v, block_table
+    (1, MB) i32, key) -> (first_token () i32, new_pool_k, new_pool_v)``.
+    ``last_idx`` is the index WITHIN the suffix of the last real prompt
+    token; pad tokens beyond it write length-masked garbage into
+    lane-owned slots that real decode overwrites later."""
+    cache_dtype = _resolve_cache_dtype(model, kv_cache_dtype)
+    sb = int(suffix_len)
+    bs = int(block_size)
+    mb = int(max_blocks_per_seq)
+    if sb % bs:
+        raise MXNetError(
+            f"suffix bucket {sb} must be a multiple of block_size {bs}")
+    from ... import numpy as mxnp
+
+    pk, pv = model.init_block_pool(min(int(num_blocks), 2), bs,
+                                   dtype=cache_dtype)
+    tokens0 = mxnp.array(onp.zeros((1, sb), onp.int32))
+    bt0 = mxnp.array(onp.zeros((1, mb), onp.int32))
+    pos0 = mxnp.array(onp.zeros((1,), onp.int32))
+    adapter = _PagedStepAdapter(model)
+    step_fn, params = adapter.functionalize(tokens0, pk, pv, bt0, pos0)
+    step_fn, params = _apply_weight_dtype(model, step_fn, params,
+                                          weight_dtype)
+    tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
+    ckey = ("paged_suffix", sb, int(num_blocks), bs, mb, bool(greedy),
+            *tkey, cache_dtype, weight_dtype, bool(donate),
+            _fused_state(cache_dtype))
+    store, cached = _decode_cache(model, ckey)
+    if cached is not None:
+        return cached, params
+
+    def run(params, suffix, start_pos, last_idx, pool_k, pool_v, bt, key):
+        pos = jnp.reshape(start_pos, (1,)).astype(jnp.int32)
+        (logits, pool_k, pool_v), _ = step_fn(
+            params, suffix, pool_k, pool_v, bt, pos)
+        first = _sample(logits[:, last_idx], key, greedy, temperature,
+                        top_k)[0]
+        return first, pool_k, pool_v
+
+    jrun = _paged_jit(run, "llm.prefill_suffix",
+                      (4, 5) if donate else (), store)
+    return jrun, params
+
+
+# --- speculative decoding (draft-propose / verify-in-one-forward) ----------
+def _policy_probs(logits, greedy, temperature, top_k):
+    """The :func:`_sample` policy as explicit probabilities (..., V) —
+    exact rejection sampling needs p and q, not just samples. Greedy is
+    the argmax one-hot (so the verify math degenerates to exact token
+    matching and spec decode stays token-identical)."""
+    logits = logits.astype(jnp.float32)
+    if greedy:
+        best = jnp.argmax(logits, axis=-1)
+        return jax.nn.one_hot(best, logits.shape[-1], dtype=jnp.float32)
+    logits = logits / max(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def _spec_accept(target_logits, draft_logits, draft_toks, key, greedy,
+                 temperature, top_k):
+    """Exact rejection sampling over one verified draft window.
+
+    ``target_logits``: (R, K+1, V) — the target forward over
+    ``[last_token, d_0..d_{K-1}]``, so row ``i`` is the target's
+    distribution for the token AFTER the first ``i`` draft tokens;
+    ``draft_logits``: (R, K, V) the draft's proposal distributions;
+    ``draft_toks``: (R, K). Returns ``(out_tokens (R, K+1), n_acc
+    (R,))``: per lane, ``out[:n_acc]`` are the accepted draft tokens and
+    ``out[n_acc]`` is the corrected/bonus token — so a verify step
+    always emits ``n_acc + 1`` tokens.
+
+    Greedy: accept while the draft matches the target argmax; the
+    correction is the target argmax after the accepted prefix —
+    emitted tokens are exactly the plain greedy stream. Sampled: accept
+    ``d_i`` with prob ``min(1, p_i(d_i)/q_i(d_i))``; on first rejection
+    sample from ``norm(max(p - q, 0))``; after K acceptances sample the
+    bonus from ``p_K`` (the zero-padded q row makes that the same
+    gather) — the emitted distribution equals plain sampling exactly
+    (Leviathan et al.)."""
+    r, kp1, v = target_logits.shape
+    k = kp1 - 1
+    if greedy:
+        tgt = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)
+        match = (tgt[:, :k] == draft_toks).astype(jnp.int32)
+        acc = jnp.cumprod(match, axis=1)
+        n_acc = jnp.sum(acc, axis=1).astype(jnp.int32)
+        correction = jnp.take_along_axis(tgt, n_acc[:, None], axis=1)
+    else:
+        p = _policy_probs(target_logits, greedy, temperature, top_k)
+        q = _policy_probs(draft_logits, greedy, temperature, top_k)
+        key, ku, kr = jax.random.split(key, 3)
+        u = jax.random.uniform(ku, (r, k))
+        p_d = jnp.take_along_axis(p[:, :k], draft_toks[:, :, None],
+                                  axis=2)[..., 0]
+        q_d = jnp.take_along_axis(q, draft_toks[:, :, None],
+                                  axis=2)[..., 0]
+        # u < p/q without the divide (q > 0 wherever the draft sampled)
+        acc = jnp.cumprod((u * q_d < p_d).astype(jnp.int32), axis=1)
+        n_acc = jnp.sum(acc, axis=1).astype(jnp.int32)
+        # residual at the first rejection; a zero-padded q row turns the
+        # all-accepted bonus draw into the same gather (residual = p_K)
+        qz = jnp.concatenate([q, jnp.zeros((r, 1, v), q.dtype)], axis=1)
+        sel = jnp.broadcast_to(n_acc[:, None, None], (r, 1, v))
+        p_sel = jnp.take_along_axis(p, sel, axis=1)[:, 0]
+        q_sel = jnp.take_along_axis(qz, sel, axis=1)[:, 0]
+        resid = jnp.maximum(p_sel - q_sel, 0.0)
+        tot = jnp.sum(resid, axis=-1, keepdims=True)
+        # p == q exactly -> the residual underflows; any draw from p is
+        # then distribution-correct
+        resid = jnp.where(tot > 1e-20, resid / jnp.maximum(tot, 1e-20),
+                          p_sel)
+        correction = jax.random.categorical(
+            kr, jnp.log(jnp.maximum(resid, 1e-30)),
+            axis=-1).astype(jnp.int32)[:, None]
+    cols = jnp.arange(kp1, dtype=jnp.int32)[None]
+    padded = jnp.concatenate(
+        [draft_toks.astype(jnp.int32), jnp.zeros((r, 1), jnp.int32)],
+        axis=1)
+    out = jnp.where(cols < n_acc[:, None], padded,
+                    jnp.broadcast_to(correction, (r, kp1)))
+    return out.astype(jnp.int32), n_acc
+
+
+def paged_spec_draft_program(model, *, max_running, draft_k, num_blocks,
+                             block_size, max_blocks_per_seq,
+                             kv_cache_dtype=None, weight_dtype=None,
+                             greedy=True, temperature=1.0, top_k=0,
+                             donate=False):
+    """Build (or fetch memoized) the draft-proposal program: K
+    sequential single-token steps of the (small) draft model inside ONE
+    compiled program.
+
+    Returns ``(run, params)``: ``run(params, prev_tok (R,1), last_tok
+    (R,1), pool_k, pool_v, block_table (R,MB), positions (R,), key) ->
+    (draft_toks (R,K) i32, draft_logits (R,K,V) f32, new_pool_k,
+    new_pool_v)``. ``positions[r]`` is the write position of
+    ``last_tok`` (= the lane's current length); ``prev_tok`` (the token
+    at ``positions-1``) is re-forwarded first to heal the one-position
+    draft-cache gap a fully-accepted round leaves — idempotent when the
+    position is already resident. Draft-pool content only ever affects
+    ACCEPTANCE RATE, never output correctness: every proposal is
+    verified exactly by the target."""
+    cache_dtype = _resolve_cache_dtype(model, kv_cache_dtype)
+    r, mb, kk = int(max_running), int(max_blocks_per_seq), int(draft_k)
+    if kk < 1:
+        raise MXNetError(f"draft_k must be >= 1, got {kk}")
+    from ... import numpy as mxnp
+
+    pk, pv = model.init_block_pool(min(int(num_blocks), 2), block_size,
+                                   dtype=cache_dtype)
+    tokens0 = mxnp.array(onp.zeros((r, 1), onp.int32))
+    bt0 = mxnp.array(onp.zeros((r, mb), onp.int32))
+    pos0 = mxnp.array(onp.zeros((r,), onp.int32))
+    adapter = _PagedStepAdapter(model)
+    step_fn, params = adapter.functionalize(tokens0, pk, pv, bt0, pos0)
+    step_fn, params = _apply_weight_dtype(model, step_fn, params,
+                                          weight_dtype)
+    tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
+    ckey = ("spec_draft", r, kk, int(num_blocks), int(block_size), mb,
+            bool(greedy), *tkey, cache_dtype, weight_dtype, bool(donate),
+            _fused_state(cache_dtype))
+    store, cached = _decode_cache(model, ckey)
+    if cached is not None:
+        return cached, params
+
+    def run(params, prev_tok, last_tok, pool_k, pool_v, bt, pos, key):
+        pos = pos.astype(jnp.int32)
+        (_, pool_k, pool_v), _ = step_fn(
+            params, prev_tok, pool_k, pool_v, bt,
+            jnp.maximum(pos - 1, 0))
+        tok = last_tok
+        toks, lgs = [], []
+        for i in range(kk):
+            (lg, pool_k, pool_v), _ = step_fn(
+                params, tok, pool_k, pool_v, bt, pos + i)
+            lg = lg[:, -1].astype(jnp.float32)
+            key, sub = jax.random.split(key)
+            nxt = _sample(lg, sub, greedy, temperature, top_k)
+            toks.append(nxt)
+            lgs.append(lg)
+            tok = nxt[:, None]
+        return (jnp.stack(toks, axis=1), jnp.stack(lgs, axis=1),
+                pool_k, pool_v)
+
+    jrun = _paged_jit(run, "llm.draft", (3, 4) if donate else (), store)
+    return jrun, params
+
+
+def paged_spec_verify_program(model, *, max_running, draft_k, num_blocks,
+                              block_size, max_blocks_per_seq,
+                              kv_cache_dtype=None, weight_dtype=None,
+                              greedy=True, temperature=1.0, top_k=0,
+                              donate=False):
+    """Build (or fetch memoized) the verify program: the TARGET model
+    scores ``[last_token, d_0..d_{K-1}]`` in ONE batched (R, K+1)
+    forward through the paged pool (amortizing the whole layer stack's
+    launches over K+1 tokens), then runs :func:`_spec_accept`.
+
+    Returns ``(run, params)``: ``run(params, last_tok (R,1), draft_toks
+    (R,K), draft_logits (R,K,V), pool_k, pool_v, block_table (R,MB),
+    positions (R,), key) -> (out_toks (R,K+1), n_acc (R,), new_pool_k,
+    new_pool_v)``. The forward writes K+1 KV rows per lane at
+    ``positions + [0..K]``; rows past the accepted prefix are
+    length-masked garbage the next round overwrites — rollback is just
+    not advancing ``positions``."""
+    cache_dtype = _resolve_cache_dtype(model, kv_cache_dtype)
+    r, mb, kk = int(max_running), int(max_blocks_per_seq), int(draft_k)
+    if kk < 1:
+        raise MXNetError(f"draft_k must be >= 1, got {kk}")
+    from ... import numpy as mxnp
+
+    pk, pv = model.init_block_pool(min(int(num_blocks), 2), block_size,
+                                   dtype=cache_dtype)
+    tokens0 = mxnp.array(onp.zeros((r, kk + 1), onp.int32))
+    bt0 = mxnp.array(onp.zeros((r, mb), onp.int32))
+    pos0 = mxnp.array(onp.zeros((r,), onp.int32))
+    adapter = _PagedStepAdapter(model)
+    step_fn, params = adapter.functionalize(tokens0, pk, pv, bt0, pos0)
+    step_fn, params = _apply_weight_dtype(model, step_fn, params,
+                                          weight_dtype)
+    tkey = (0.0, 0) if greedy else (float(temperature), int(top_k))
+    ckey = ("spec_verify", r, kk, int(num_blocks), int(block_size), mb,
+            bool(greedy), *tkey, cache_dtype, weight_dtype, bool(donate),
+            _fused_state(cache_dtype))
+    store, cached = _decode_cache(model, ckey)
+    if cached is not None:
+        return cached, params
+
+    def run(params, last_tok, draft_toks, draft_logits, pool_k, pool_v,
+            bt, pos, key):
+        tokens = jnp.concatenate(
+            [last_tok.astype(jnp.int32), draft_toks.astype(jnp.int32)],
+            axis=1)
+        (logits, pool_k, pool_v), _ = step_fn(
+            params, tokens, pool_k, pool_v, bt, pos.astype(jnp.int32))
+        out, n_acc = _spec_accept(
+            logits.astype(jnp.float32), draft_logits,
+            draft_toks.astype(jnp.int32), key, greedy, temperature,
+            top_k)
+        return out, n_acc, pool_k, pool_v
+
+    jrun = _paged_jit(run, "llm.verify", (4, 5) if donate else (), store)
     return jrun, params

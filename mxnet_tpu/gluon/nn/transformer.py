@@ -38,7 +38,8 @@ from ...ops.nn import attend as _attend
 # block-pool decode path shares them); re-exported here unchanged for
 # the historical import path.
 from ...ops.nn import (_KV_SCALE_BYTES, kv_cache_dequantize,
-                       kv_cache_quantize, paged_attention as _paged_attend)
+                       kv_cache_quantize, paged_attention as _paged_attend,
+                       paged_attention_multi as _paged_attend_multi)
 
 
 class MultiHeadAttention(HybridBlock):
@@ -169,48 +170,115 @@ class MultiHeadAttention(HybridBlock):
         return self.out_proj(out), new_ck, new_cv
 
     def forward_step_paged(self, x, pool_k, pool_v, block_table, positions):
-        """Paged-KV decode attention: ``x`` is (R, 1, units) — one token
-        per decode lane — whose K/V are written into the shared block
-        pools at ``block_table[r, positions[r] // bs]`` slot
-        ``positions[r] % bs``, then attended through the table
-        (:func:`~mxnet_tpu.ops.nn.paged_attention`). Pools are
+        """Paged-KV decode attention: ``x`` is (R, T, units) — lane
+        ``r``'s token ``t`` sits at absolute position
+        ``positions[r] + t`` — whose K/V are written into the shared
+        block pools at ``block_table[r, p // bs]`` slot ``p % bs``, then
+        attended through the table
+        (:func:`~mxnet_tpu.ops.nn.paged_attention`) as ``R*T`` virtual
+        lanes with per-position lengths (the length mask IS the causal
+        mask). ``T == 1`` is the continuous-batching decode step;
+        ``T > 1`` serves speculative verify (K+1 draft tokens per lane
+        in ONE forward) and shared-prefix suffix prefill. Pools are
         (NB, H, bs, D') for THIS layer; static shapes throughout, so one
-        XLA program serves every step at every mix of sequence lengths —
-        the continuous-batching decode loop's contract."""
+        XLA program serves every step at every mix of sequence lengths.
+
+        When the fused Pallas decode path is armed
+        (:func:`~mxnet_tpu.ops.pallas.fused_decode.fused_decode_armed`),
+        the QKV projection (+ int8 KV quantization) and the output
+        projection run as Pallas kernels around the scalar-prefetch
+        paged-attend kernel instead of separate XLA ops."""
         units, heads = self._units, self._heads
+        from ...ops.pallas import fused_decode as _fused
+
+        if self._fused_eligible() and _fused.fused_decode_armed(
+                kv_dtype=str(pool_k.dtype)):
+            return self._forward_step_paged_fused(
+                x, pool_k, pool_v, block_table, positions)
         proj = self.qkv(x)
 
         def fn(p, pk, pv, bt, pos):
-            r = p.shape[0]
+            r, t = p.shape[0], p.shape[1]
             d = units // heads
             bs = pk.shape[2]
             pos = pos.astype(jnp.int32)
-            p = p.reshape(r, 3 * units)
 
-            def split(t):                       # (R, U) -> (R, H, D)
-                return t.reshape(r, heads, d)
+            def split(c):                       # (R, T, U) -> (R*T, H, D)
+                return c.reshape(r * t, heads, d)
 
-            q = split(p[:, :units])
-            k = split(p[:, units:2 * units])
-            v = split(p[:, 2 * units:])
+            q = split(p[..., :units])
+            k = split(p[..., units:2 * units])
+            v = split(p[..., 2 * units:])
             if pk.dtype == jnp.int8:
                 k_store, v_store = kv_cache_quantize(k), kv_cache_quantize(v)
             else:
                 k_store, v_store = k.astype(pk.dtype), v.astype(pv.dtype)
-            blk = jnp.take_along_axis(bt, (pos // bs)[:, None],
-                                      axis=1)[:, 0]
-            slot = pos % bs
-            # two advanced indices around a slice: the (R,) lane axis
-            # broadcasts to the front -> (R, H, D') matches k_store
+            # (R, T) absolute position of every written token
+            abs_pos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+            blk = jnp.take_along_axis(bt, abs_pos // bs, axis=1).reshape(-1)
+            slot = (abs_pos % bs).reshape(-1)
+            # two advanced indices around a slice: the (R*T,) token axis
+            # broadcasts to the front -> (R*T, H, D') matches k_store
             pk = pk.at[blk, :, slot, :].set(k_store)
             pv = pv.at[blk, :, slot, :].set(v_store)
-            out = _paged_attend(q, pk, pv, bt, pos + 1)   # (R, H, D)
-            return out.reshape(r, 1, units), pk, pv
+            if t == 1:
+                # the ONE continuous-batching decode step (unchanged op
+                # stream: greedy token-identity with the dense cache)
+                out = _paged_attend(q, pk, pv, bt,
+                                    (abs_pos + 1).reshape(-1))
+                return out.reshape(r, 1, units), pk, pv
+            # T > 1 (speculative verify / suffix prefill): gather each
+            # lane's blocks ONCE and attend all T queries against the
+            # dense view — the cache read amortizes over the chunk,
+            # which is the whole roofline win; the per-(lane, t) length
+            # mask IS the causal mask
+            out = _paged_attend_multi(q.reshape(r, t, heads, d),
+                                      pk, pv, bt, pos)     # (R, T, H, D)
+            return out.reshape(r, t, units), pk, pv
 
         out, new_pk, new_pv = _call(
             fn, (proj, pool_k, pool_v, block_table, positions),
             name="MultiHeadAttentionPagedStep", n_out=3)
         return self.out_proj(out), new_pk, new_pv
+
+    def _fused_eligible(self) -> bool:
+        """Fused Pallas decode only covers the plain (non-TP) Dense
+        projections — TP shards heads across a mesh axis the kernels do
+        not model."""
+        return isinstance(self.qkv, Dense) and isinstance(
+            self.out_proj, Dense)
+
+    def _forward_step_paged_fused(self, x, pool_k, pool_v, block_table,
+                                  positions):
+        """Fused-kernel variant of :meth:`forward_step_paged`: one
+        Pallas kernel per (QKV projection + int8 quantize), the
+        scalar-prefetch paged-attend kernel, and one fused out-proj
+        kernel; the KV write lands in place on the donated pool
+        buffers. Oracle: the jnp path above (interpret mode on CPU)."""
+        from ...ops.pallas.fused_decode import fused_decode_step
+
+        units, heads = self._units, self._heads
+        w_qkv = self.qkv.weight.data()
+        b_qkv = self.qkv.bias.data() if self.qkv.bias is not None else None
+        w_out = self.out_proj.weight.data()
+        b_out = (self.out_proj.bias.data()
+                 if self.out_proj.bias is not None else None)
+
+        def fn(xv, wq, pk, pv, bt, pos, wo, *biases):
+            bq = biases[0] if b_qkv is not None else None
+            bo = biases[-1] if b_out is not None else None
+            return fused_decode_step(
+                xv, wq, bq, wo, bo, pk, pv, bt, pos, heads=heads,
+                units=units)
+
+        args = [x, w_qkv, pool_k, pool_v, block_table, positions, w_out]
+        if b_qkv is not None:
+            args.append(b_qkv)
+        if b_out is not None:
+            args.append(b_out)
+        out, new_pk, new_pv = _call(
+            fn, tuple(args), name="FusedPagedDecodeStep", n_out=3)
+        return out, new_pk, new_pv
 
 
 class PositionwiseFFN(HybridBlock):

@@ -1039,18 +1039,21 @@ def paged_attention(q, k_pool, v_pool, block_table, lengths,
     lengths : (R,) int32 — valid positions per lane (current token
         included, written by the caller before attending).
     use_kernel : None | bool — None auto-selects the Pallas TPU kernel
-        for float pools on the TPU backend; the jnp gather path (exactly
-        the dense ``forward_step`` arithmetic, so greedy decode is
+        on the TPU backend for float AND int8 pools (int8 — the engine
+        default — dequantizes the bitcast-scale layout inside the
+        kernel after the block DMA); the jnp gather path (exactly the
+        dense ``forward_step`` arithmetic, so greedy decode is
         token-identical to the dense cache) everywhere else.
 
-    Returns (R, H, D) in the pool's value dtype.
+    Returns (R, H, D) in the pool's value dtype (float pools) or ``q``'s
+    dtype (int8 pools).
     """
     r, h, d = q.shape
     nb, _, bs, _ = k_pool.shape
     mb = block_table.shape[1]
     quantized = k_pool.dtype == jnp.int8
     if use_kernel is None:
-        use_kernel = (not quantized and not _pallas_disabled.depth
+        use_kernel = (not _pallas_disabled.depth
                       and jax.default_backend() == "tpu")
     if use_kernel:
         from .pallas.paged_attention import paged_attention_kernel
@@ -1079,6 +1082,64 @@ def paged_attention(q, k_pool, v_pool, block_table, lengths,
     scores = jnp.where(live[:, None, :], scores, -jnp.inf)
     attn = jax.nn.softmax(scores, axis=-1).astype(vals.dtype)
     return jnp.einsum("rhl,rhld->rhd", attn, vals)
+
+
+def paged_attention_multi(q, k_pool, v_pool, block_table, positions,
+                          use_kernel=None):
+    """Multi-token paged decode attention: ``q`` is (R, T, H, D), lane
+    ``r``'s query ``t`` at absolute position ``positions[r] + t``.
+
+    The speculative-verify / suffix-prefill hot path. The point over
+    calling :func:`paged_attention` on R*T virtual lanes is the READ
+    amortization: each lane's blocks are gathered (and int8-dequantized)
+    ONCE, and all T queries attend against that one dense view with
+    per-(lane, t) length masks — the length mask IS the causal mask.
+    Single-token decode re-reads the whole cache per token; a verify
+    chunk reads it once per K+1 tokens, which is the roofline win the
+    ISSUE 11 tentpole banks (HBM bytes on TPU, gather+dequant cost on
+    CPU). On TPU the scalar-prefetch Pallas kernel path is used instead
+    (block DMAs from HBM, no dense per-lane cache materialized).
+
+    Row arithmetic is operation-for-operation :func:`paged_attention`'s,
+    so greedy verify stays token-identical to single-token decode.
+
+    Returns (R, T, H, D) in the pool's value dtype (float pools) or
+    ``q``'s dtype (int8 pools).
+    """
+    r, t, h, d = q.shape
+    nb, _, bs, _ = k_pool.shape
+    mb = block_table.shape[1]
+    pos = positions.astype(jnp.int32)
+    abs_pos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+    quantized = k_pool.dtype == jnp.int8
+    if use_kernel is None:
+        use_kernel = (not _pallas_disabled.depth
+                      and jax.default_backend() == "tpu")
+    if use_kernel:
+        from .pallas.paged_attention import paged_attention_kernel
+
+        out = paged_attention_kernel(
+            q.reshape(r * t, h, d), k_pool, v_pool,
+            jnp.repeat(block_table, t, axis=0),
+            (abs_pos + 1).reshape(-1))
+        return out.reshape(r, t, h, d)
+    keys = k_pool[block_table]          # (R, MB, H, bs, D') — ONCE
+    vals = v_pool[block_table]
+
+    def flat(c):                        # -> (R, H, MB*bs, D')
+        return c.transpose(0, 2, 1, 3, 4).reshape(r, h, mb * bs,
+                                                  c.shape[-1])
+
+    keys, vals = flat(keys), flat(vals)
+    if quantized:
+        keys = kv_cache_dequantize(keys, q.dtype)
+        vals = kv_cache_dequantize(vals, q.dtype)
+    scores = jnp.einsum("rthd,rhld->rthl", q, keys).astype(jnp.float32)
+    scores = scores / onp.sqrt(d).astype(onp.float32)
+    live = jnp.arange(mb * bs)[None, None, :] < (abs_pos + 1)[:, :, None]
+    scores = jnp.where(live[:, :, None, :], scores, -jnp.inf)
+    attn = jax.nn.softmax(scores, axis=-1).astype(vals.dtype)
+    return jnp.einsum("rthl,rhld->rthd", attn, vals)
 
 
 def attend(q, k, v, heads, causal=False, mask=None, dropout=0.0, key=None,

@@ -25,6 +25,17 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+def _tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` across the jax rename — older jaxlibs
+    (including the pinned one) expose it as ``TPUCompilerParams``; the
+    compiled (non-interpret) arm must not crash on either."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 def _matmul_precision(dtype):
     """One policy for every kernel matmul, fwd and bwd: bf16 runs at
     native MXU precision (HIGHEST on bf16 is a Mosaic reject; f32
@@ -320,7 +331,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     # sequential — the scratch accumulators carry across k programs
     compiler_params = None
     if not interpret:
-        compiler_params = pltpu.CompilerParams(
+        compiler_params = _tpu_compiler_params(
             dimension_semantics=("parallel", "parallel") if resident
             else ("parallel", "parallel", "arbitrary"))
     res = pl.pallas_call(

@@ -1,0 +1,242 @@
+"""Fused Pallas decode-step kernels (the FusionStitching direction).
+
+One paged decode step used to launch the whole per-layer kernel zoo:
+qkv Dense, quantize, pool scatter, paged-attend, out-proj Dense — each
+its own XLA op reading activations back through HBM. Decode is
+bytes-bound (4.7% of the HBM roofline on the banked TPU row), so the
+launches and intermediate round-trips are pure tax. This module
+collapses the per-layer decode hot path into three Pallas launches:
+
+- :func:`fused_qkv_project` — QKV projection + bias + (for int8 pools)
+  the per-(token, head) KV quantization fused into ONE kernel; the
+  quantized rows come out in the 4-byte bitcast-scale layout
+  (:func:`~mxnet_tpu.ops.nn.kv_cache_quantize`) ready to scatter into
+  the pool, so K/V never exist unquantized in HBM.
+- :func:`~.paged_attention.paged_attention_kernel` — the existing
+  scalar-prefetch block-table attend (now int8-capable), with the KV
+  write landing in place on the donated pool buffers immediately
+  before it.
+- :func:`fused_out_project` — out projection + bias in one kernel.
+
+Gate: :func:`fused_decode_armed` — an env knob
+(``MXNET_TPU_LLM_FUSED_DECODE``: ``auto``/``1``/``0``) whose ``auto``
+arm requires the TPU backend AND the :mod:`mxnet_tpu.analysis.opt` cost
+model scoring the decode projection memory-bound (it always is; the
+gate records *why* fusion pays — the "A Learned Performance Model for
+TPUs" discipline of never rewriting on vibes). Oracle: the unfused jnp
+path in ``MultiHeadAttention.forward_step_paged``, checked in interpret
+mode on CPU (``tests/test_llm_serving.py``).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...base import env_str
+
+__all__ = ["fused_decode_armed", "fused_decode_step",
+           "fused_qkv_project", "fused_out_project"]
+
+
+# --- gating ----------------------------------------------------------------
+@functools.lru_cache(maxsize=8)
+def _cost_model_gate(kv_dtype: str, backend: str) -> bool:
+    """Arm fusion only when the cost model scores the per-token decode
+    projection memory-bound (weights re-read every token dwarf the
+    rank-1 matmul's flops)."""
+    try:
+        from ...analysis.opt.cost_model import CostModel, OpFeatures
+
+        model = CostModel.for_backend(backend=backend)
+        u = 1024.0            # representative decode width; the verdict
+        w_bytes = 1.0 if kv_dtype == "int8" else 2.0   # is scale-free
+        f = OpFeatures(
+            prim="dot_general", flops_raw=2 * u * 3 * u,
+            flops_padded=2 * 8 * u * 3 * u,
+            bytes=(3 * u * u + 6 * u) * w_bytes, major=True,
+            dtype="bfloat16", detail="fused_decode_gate")
+        return model.op_cost(f).bound == "memory"
+    except Exception:  # noqa: BLE001 — cost model down: fuse on TPU
+        return True
+
+
+def fused_decode_armed(kv_dtype: str = "float32",
+                       backend=None) -> bool:
+    """Should the paged decode step run the fused Pallas kernels?
+
+    ``MXNET_TPU_LLM_FUSED_DECODE``: ``0``/``off`` never, ``1``/``on``
+    always (tests force it on CPU — the kernels run interpreted there),
+    ``auto`` (default) = TPU backend + cost-model memory-bound verdict.
+    Always off inside :func:`~mxnet_tpu.ops.nn.no_pallas` scopes."""
+    from ..nn import _pallas_disabled
+
+    if _pallas_disabled.depth:
+        return False
+    mode = env_str("MXNET_TPU_LLM_FUSED_DECODE", "auto").strip().lower()
+    if mode in ("0", "off", "false", "no", ""):
+        return False
+    if mode in ("1", "on", "true", "yes", "force"):
+        return True
+    if backend is None:
+        from ...base import failsoft_call
+
+        backend = failsoft_call(jax.default_backend)
+    if backend != "tpu":
+        return False
+    return _cost_model_gate(str(kv_dtype), str(backend))
+
+
+# --- kernel bodies ---------------------------------------------------------
+def _qkv_kernel(x_ref, wq_ref, wk_ref, wv_ref, bq_ref, bk_ref, bv_ref,
+                q_ref, k_ref, v_ref, *, quantized, precision):
+    # the ONE definition of the int8 [values | bitcast f32 scale]
+    # layout — fusing the oracle's own quantizer into the kernel keeps
+    # the interpret-mode parity promise by construction
+    from ..nn import kv_cache_quantize
+
+    x = x_ref[...].astype(jnp.float32)            # (N, U)
+
+    def proj(w_ref, b_ref):                       # -> (N, D) f32
+        w = w_ref[:, 0, :].astype(jnp.float32)    # (U, D)
+        y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                                precision=precision,
+                                preferred_element_type=jnp.float32)
+        return y + b_ref[...].astype(jnp.float32)
+
+    q = proj(wq_ref, bq_ref)
+    q_ref[...] = q[:, None, :].astype(q_ref.dtype)
+    k = proj(wk_ref, bk_ref)
+    v = proj(wv_ref, bv_ref)
+    if quantized:
+        k_ref[...] = kv_cache_quantize(k)[:, None, :]
+        v_ref[...] = kv_cache_quantize(v)[:, None, :]
+    else:
+        k_ref[...] = k[:, None, :].astype(k_ref.dtype)
+        v_ref[...] = v[:, None, :].astype(v_ref.dtype)
+
+
+def _out_kernel(a_ref, w_ref, b_ref, o_ref, *, precision):
+    a = a_ref[...].astype(jnp.float32)            # (N, U)
+    w = w_ref[...].astype(jnp.float32)            # (U_out, U_in)
+    y = jax.lax.dot_general(a, w, (((1,), (1,)), ((), ())),
+                            precision=precision,
+                            preferred_element_type=jnp.float32)
+    o_ref[...] = (y + b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+# --- host wrappers ---------------------------------------------------------
+def fused_qkv_project(x, w_qkv, b_qkv, *, heads, store_dtype,
+                      interpret=None):
+    """QKV projection + bias + KV-store conversion in one Pallas kernel.
+
+    ``x``: (N, U) decode activations; ``w_qkv``: (3U, U) Dense weight
+    (out, in); ``b_qkv``: (3U,) or None. Returns ``(q, k_store,
+    v_store)``: q (N, H, D) in ``x``'s dtype; k/v (N, H, D') already in
+    the pool layout — int8 + bitcast scale when ``store_dtype`` is
+    int8, a plain cast otherwise. Grid: one program per head."""
+    import jax.experimental.pallas as pl
+
+    from .flash_attention import _matmul_precision
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, u = x.shape
+    d = u // heads
+    quantized = jnp.dtype(store_dtype) == jnp.int8
+    from ..nn import _KV_SCALE_BYTES
+
+    dp = d + _KV_SCALE_BYTES if quantized else d
+    if b_qkv is None:
+        b_qkv = jnp.zeros((3 * u,), x.dtype)
+
+    def slab(w):                                  # (U, U) -> (U, H, D)
+        return w.T.reshape(u, heads, d)
+
+    wq, wk, wv = (slab(w_qkv[:u]), slab(w_qkv[u:2 * u]),
+                  slab(w_qkv[2 * u:]))
+    bq, bk, bv = (b_qkv[:u].reshape(heads, d),
+                  b_qkv[u:2 * u].reshape(heads, d),
+                  b_qkv[2 * u:].reshape(heads, d))
+    kernel = functools.partial(
+        _qkv_kernel, quantized=quantized,
+        precision=_matmul_precision(x.dtype))
+    w_spec = pl.BlockSpec((u, 1, d), lambda h: (0, h, 0))
+    b_spec = pl.BlockSpec((1, d), lambda h: (h, 0))
+    kv_spec = pl.BlockSpec((n, 1, dp), lambda h: (0, h, 0))
+    q, ks, vs = pl.pallas_call(
+        kernel,
+        grid=(heads,),
+        in_specs=[pl.BlockSpec((n, u), lambda h: (0, 0)),
+                  w_spec, w_spec, w_spec, b_spec, b_spec, b_spec],
+        out_specs=[pl.BlockSpec((n, 1, d), lambda h: (0, h, 0)),
+                   kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((n, heads, d), x.dtype),
+                   jax.ShapeDtypeStruct((n, heads, dp), store_dtype),
+                   jax.ShapeDtypeStruct((n, heads, dp), store_dtype)],
+        interpret=interpret,
+    )(x, wq, wk, wv, bq, bk, bv)
+    return q, ks, vs
+
+
+def fused_out_project(attn, w_out, b_out, *, interpret=None):
+    """Out projection + bias in one Pallas kernel. ``attn``: (N, U);
+    ``w_out``: (U, U) Dense weight (out, in); ``b_out``: (U,) or None.
+    Returns (N, U) in ``attn``'s dtype."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from .flash_attention import _matmul_precision
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, u = attn.shape
+    if b_out is None:
+        b_out = jnp.zeros((u,), attn.dtype)
+    kernel = functools.partial(_out_kernel,
+                               precision=_matmul_precision(attn.dtype))
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[vmem, vmem, vmem],
+        out_specs=vmem,
+        out_shape=jax.ShapeDtypeStruct((n, u), attn.dtype),
+        interpret=interpret,
+    )(attn, w_out, b_out.reshape(1, u))
+
+
+def fused_decode_step(x, w_qkv, b_qkv, w_out, b_out, pool_k, pool_v,
+                      block_table, positions, *, heads, units,
+                      interpret=None):
+    """One attention sublayer's paged decode step through the fused
+    kernels: QKV+quantize kernel -> in-place pool scatter (donated
+    buffers) -> scalar-prefetch paged-attend kernel -> out-proj kernel.
+
+    ``x``: (R, T, U) at per-lane absolute positions ``positions[r]+t``;
+    pools (NB, H, bs, D'); ``block_table`` (R, MB). Returns
+    ``(out (R, T, U), new_pool_k, new_pool_v)`` — arithmetic matches
+    the unfused jnp path (the interpret-mode oracle)."""
+    r, t, u = x.shape
+    n = r * t
+    bs = pool_k.shape[2]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    q, ks, vs = fused_qkv_project(
+        x.reshape(n, u), w_qkv, b_qkv, heads=heads,
+        store_dtype=pool_k.dtype, interpret=interpret)
+    pos = positions.astype(jnp.int32)
+    bt = block_table.astype(jnp.int32)
+    abs_pos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+    blk = jnp.take_along_axis(bt, abs_pos // bs, axis=1).reshape(-1)
+    slot = (abs_pos % bs).reshape(-1)
+    pool_k = pool_k.at[blk, :, slot, :].set(ks)
+    pool_v = pool_v.at[blk, :, slot, :].set(vs)
+    from .paged_attention import paged_attention_kernel
+
+    out = paged_attention_kernel(
+        q, pool_k, pool_v, jnp.repeat(bt, t, axis=0),
+        (abs_pos + 1).reshape(-1), interpret=interpret)   # (N, H, D)
+    o = fused_out_project(out.reshape(n, u).astype(x.dtype), w_out,
+                          b_out, interpret=interpret)
+    return o.reshape(r, t, u), pool_k, pool_v

@@ -16,6 +16,13 @@ flash-attention recurrence with block_q == 1). Correctness-first: the
 banks is the *bytes* win (paged gather + no dense cache), which is what
 the bandwidth-bound decode path is limited by.
 
+int8 pools (the engine default) take the same kernel: a pool row is
+``[D int8 values | 4 bitcast f32-scale bytes]``
+(:func:`~mxnet_tpu.ops.nn.kv_cache_quantize`), and the kernel
+dequantizes INSIDE the block after the DMA — the bandwidth-bound read
+moves half the bytes of bf16 and the fast path finally arms for the
+default config.
+
 Oracle: the jnp gather path in :func:`mxnet_tpu.ops.nn.paged_attention`
 (itself token-identical to the dense cache); the kernel is checked
 against it in interpret mode on CPU (``tests/test_llm_serving.py``).
@@ -32,9 +39,16 @@ __all__ = ["paged_attention_kernel"]
 _NEG_BIG = -1e30  # finite mask (−inf breaks the online-softmax carry)
 
 
+def _dequant_block(c, d):
+    """(bs, D+4) int8 [values | bitcast f32 scale] -> (bs, D) f32."""
+    vals = c[:, :d].astype(jnp.float32)
+    scale = jax.lax.bitcast_convert_type(c[:, d:], jnp.float32)  # (bs,)
+    return vals * scale[:, None]
+
+
 def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *, bs, mb, heads, sm_scale,
-                  precision):
+                  m_ref, l_ref, acc_ref, *, bs, mb, heads, d, quantized,
+                  sm_scale, precision):
     import jax.experimental.pallas as pl
 
     rh = pl.program_id(0)
@@ -47,8 +61,12 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     q = q_ref[...].astype(jnp.float32)            # (1, D)
-    k = k_ref[0, 0].astype(jnp.float32)           # (bs, D)
-    v = v_ref[0, 0].astype(jnp.float32)
+    if quantized:
+        k = _dequant_block(k_ref[0, 0], d)        # (bs, D)
+        v = _dequant_block(v_ref[0, 0], d)
+    else:
+        k = k_ref[0, 0].astype(jnp.float32)       # (bs, D)
+        v = v_ref[0, 0].astype(jnp.float32)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             precision=precision,
                             preferred_element_type=jnp.float32)  # (1, bs)
@@ -79,41 +97,45 @@ def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths,
     """Block-table decode attention.
 
     ``q``: (R, H, D) one token per lane; ``k_pool``/``v_pool``:
-    (NB, H, bs, D) float pools (int8 pools take the jnp dequant path in
-    :func:`~mxnet_tpu.ops.nn.paged_attention`); ``block_table``:
-    (R, MB) int32; ``lengths``: (R,) int32 valid positions per lane.
-    Returns (R, H, D) in the pool dtype. ``interpret=None``
+    (NB, H, bs, D') pools — float pools carry ``D' = D``; int8 pools
+    carry ``D' = D + 4`` (the :func:`~mxnet_tpu.ops.nn.kv_cache_quantize`
+    bitcast-scale layout) and are dequantized inside the kernel after
+    the block DMA; ``block_table``: (R, MB) int32; ``lengths``: (R,)
+    int32 valid positions per lane. Returns (R, H, D) in the pool dtype
+    (float pools) or ``q``'s dtype (int8 pools). ``interpret=None``
     auto-selects: compiled Mosaic on TPU, the Pallas interpreter
     elsewhere."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    from .flash_attention import _matmul_precision
+    from .flash_attention import _matmul_precision, _tpu_compiler_params
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     r, h, d = q.shape
-    _, _, bs, _ = k_pool.shape
+    _, _, bs, dp = k_pool.shape
+    quantized = k_pool.dtype == jnp.int8
     mb = block_table.shape[1]
     sm_scale = float(d) ** -0.5
     precision = _matmul_precision(q.dtype)
+    out_dtype = q.dtype if quantized else v_pool.dtype
     qf = q.reshape(r * h, d)
     bt = block_table.astype(jnp.int32)
     lens = lengths.astype(jnp.int32)
 
     kernel = functools.partial(
-        _paged_kernel, bs=bs, mb=mb, heads=h, sm_scale=sm_scale,
-        precision=precision)
+        _paged_kernel, bs=bs, mb=mb, heads=h, d=d, quantized=quantized,
+        sm_scale=sm_scale, precision=precision)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # block_table, lengths
         grid=(r * h, mb),
         in_specs=[
             pl.BlockSpec((1, d), lambda rh, j, bt_, ln_: (rh, 0)),
             pl.BlockSpec(
-                (1, 1, bs, k_pool.shape[-1]),
+                (1, 1, bs, dp),
                 lambda rh, j, bt_, ln_: (bt_[rh // h, j], rh % h, 0, 0)),
             pl.BlockSpec(
-                (1, 1, bs, v_pool.shape[-1]),
+                (1, 1, bs, dp),
                 lambda rh, j, bt_, ln_: (bt_[rh // h, j], rh % h, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, d), lambda rh, j, bt_, ln_: (rh, 0)),
@@ -127,12 +149,12 @@ def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths,
     if not interpret:
         # the block axis is a sequential reduction (the scratch
         # accumulators carry across j); lane-head programs are free
-        compiler_params = pltpu.CompilerParams(
+        compiler_params = _tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"))
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((r * h, d), v_pool.dtype),
+        out_shape=jax.ShapeDtypeStruct((r * h, d), out_dtype),
         compiler_params=compiler_params,
         interpret=interpret,
     )(bt, lens, qf, k_pool, v_pool)

@@ -54,6 +54,7 @@ SITES = (
     "device.put",         # ndarray host<->device / cross-device transfer
     "serving.infer",      # InferenceEngine micro-batch execution
     "serving.llm",        # LLMEngine prefill-splice (admission into lanes)
+    "serving.llm.verify", # LLMEngine speculative draft-verify splice
     "compile",            # HybridBlock trace/compile path
     "aot.read",           # CompileCache entry lookup (before the read)
     "aot.write",          # CompileCache publish, payload staged, pre-rename

@@ -103,7 +103,7 @@ class LLMMetrics:
 
     _EVENTS = ("submitted", "admitted", "completed", "failed",
                "shed_overload", "shed_deadline", "prefills",
-               "decode_steps", "resets", "compiles")
+               "decode_steps", "spec_steps", "resets", "compiles")
 
     def __init__(self, engine_id: str):
         reg = get_registry()
@@ -142,6 +142,38 @@ class LLMMetrics:
                                              phase="decode")
         self.prefill_ms = self.step_ms.labels(engine=engine_id,
                                               phase="prefill")
+        self.spec_ms = self.step_ms.labels(engine=engine_id,
+                                           phase="draft_verify")
+        # speculative decoding: proposed vs accepted draft tokens (the
+        # acceptance-rate numerator/denominator, cumulative) + the gauge
+        self._spec_tokens = reg.counter(
+            "llm_spec_tokens_total",
+            "Speculative-decode draft tokens", ("engine", "result"))
+        self.spec_proposed = self._spec_tokens.labels(engine=engine_id,
+                                                      result="proposed")
+        self.spec_accepted = self._spec_tokens.labels(engine=engine_id,
+                                                      result="accepted")
+        self.draft_acceptance_rate = reg.gauge(
+            "llm_draft_acceptance_rate",
+            "Cumulative accepted/proposed draft-token ratio",
+            ("engine",)).labels(**eng)
+        # prefix cache: prompt tokens served from resident blocks vs
+        # prefilled, + the cumulative hit-rate gauge
+        self._prefix_tokens = reg.counter(
+            "llm_prefix_tokens_total",
+            "Prompt tokens by prefix-cache outcome", ("engine", "result"))
+        self.prefix_hit_tokens = self._prefix_tokens.labels(
+            engine=engine_id, result="hit")
+        self.prefix_miss_tokens = self._prefix_tokens.labels(
+            engine=engine_id, result="miss")
+        self.prefix_hit_rate = reg.gauge(
+            "llm_prefix_hit_rate",
+            "Cumulative prefix-cache hit ratio over prompt tokens",
+            ("engine",)).labels(**eng)
+        self.prefix_cached_blocks = reg.gauge(
+            "llm_prefix_cached_blocks",
+            "Pool blocks resident in the prefix cache",
+            ("engine",)).labels(**eng)
         self.token_latency_ms = reg.histogram(
             "llm_token_latency_ms",
             "Per-token latency (decode step wall / tokens in step)",
@@ -149,6 +181,23 @@ class LLMMetrics:
         self.queue_depth = reg.histogram(
             "llm_queue_depth", "Queue depth at admission",
             ("engine",)).labels(**eng)
+
+    def observe_spec(self, proposed: int, accepted: int) -> None:
+        self.spec_proposed.inc(proposed)
+        self.spec_accepted.inc(accepted)
+        tot = float(self.spec_proposed.value)
+        if tot > 0:
+            self.draft_acceptance_rate.set(
+                float(self.spec_accepted.value) / tot)
+
+    def observe_prefix(self, hit: int, miss: int) -> None:
+        self.prefix_hit_tokens.inc(hit)
+        self.prefix_miss_tokens.inc(miss)
+        tot = (float(self.prefix_hit_tokens.value)
+               + float(self.prefix_miss_tokens.value))
+        if tot > 0:
+            self.prefix_hit_rate.set(
+                float(self.prefix_hit_tokens.value) / tot)
 
     # AdmissionQueue calls these two (the ServingMetrics seam)
     def count(self, name: str, delta: int = 1) -> None:
@@ -211,6 +260,26 @@ class LLMEngine:
     donate : bool, optional
         Donate the pool buffers to the decode/prefill programs (in-place
         pool update). Default: on for accelerator backends, off on CPU.
+    draft_model : causal LM, optional
+        Arms **speculative decoding**: a (small) draft model with the
+        same paged contract proposes ``draft_k`` tokens per step; the
+        target model verifies all of them in ONE batched (R, K+1)
+        forward with exact rejection sampling — greedy output stays
+        token-identical, sampled output distribution-exact. The draft
+        runs its own block pools addressed by the SAME block tables, so
+        admission/free/prefix-sharing govern both caches at once.
+    draft_k : int, optional
+        Draft tokens proposed per verify step. Default
+        ``MXNET_TPU_LLM_DRAFT_K`` (4). The engine reserves ``draft_k``
+        extra positions of block capacity per lane (verify writes up to
+        K positions past the accepted length; rollback is just not
+        advancing the position).
+    prefix_cache : bool, optional
+        Arms **shared-prefix block caching**: full prompt blocks are
+        chain-hashed at admission; a request whose leading blocks are
+        resident reuses them copy-on-write (per-block refcounts; a
+        block is freed only at refcount zero) and prefills ONLY its
+        uncached suffix. Default ``MXNET_TPU_LLM_PREFIX_CACHE`` (off).
     """
 
     def __init__(self, model, *, max_running: Optional[int] = None,
@@ -225,6 +294,8 @@ class LLMEngine:
                  max_queue_size: int = 256,
                  timeout_ms: Optional[float] = None,
                  donate: Optional[bool] = None,
+                 draft_model=None, draft_k: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
                  metrics: Optional[LLMMetrics] = None):
         from ..gluon.model_zoo.generation import _resolve_cache_dtype
 
@@ -265,6 +336,21 @@ class LLMEngine:
         self._key = jax.random.PRNGKey(seed)
         self._step_seq = 0
 
+        # speculative decoding (armed by a draft model)
+        self._draft = draft_model
+        if draft_k is None:
+            draft_k = int(env_float("MXNET_TPU_LLM_DRAFT_K", 4))
+        self._draft_k = max(int(draft_k), 1)
+        self._spec = draft_model is not None
+        # verify writes up to draft_k positions past the accepted
+        # length; the block reservation carries that slack
+        self._slack = self._draft_k if self._spec else 0
+        # shared-prefix block cache (off unless armed: callers that pin
+        # "free list returns to full" keep that invariant)
+        if prefix_cache is None:
+            prefix_cache = bool(env_float("MXNET_TPU_LLM_PREFIX_CACHE", 0))
+        self._prefix_on = bool(prefix_cache)
+
         preflight_backend()
         if donate is None:
             donate = failsoft_call(jax.default_backend) not in ("cpu",)
@@ -283,6 +369,26 @@ class LLMEngine:
         self._pool_k, self._pool_v = pk._data, pv._data
         self._free: List[int] = list(range(self.num_blocks))
         self.metrics.pool_free.set(len(self._free))
+        # per-block refcounts (lane ownership + prefix-cache residency;
+        # a block returns to the free list only at refcount zero — the
+        # copy-on-write discipline: shared prompt blocks are read-only
+        # by construction, divergence starts at the first uncached
+        # block, so "copy" never actually copies)
+        self._ref: Dict[int, int] = {}
+        # chain-hash -> resident block id, LRU-ordered (a radix lookup
+        # flattened: the chain hash of block j commits to blocks 0..j,
+        # so longest-prefix match is consecutive dict hits)
+        from collections import OrderedDict
+
+        self._prefix: "OrderedDict[bytes, int]" = OrderedDict()
+        self._prefix_hits = 0
+        # the draft model's block pools, addressed by the SAME block
+        # tables/ids as the target's (one allocation governs both)
+        if self._spec:
+            dk, dv = draft_model.init_block_pool(
+                self.num_blocks + 1, self.block_size,
+                dtype=self._kv_dtype)
+            self._dpool_k, self._dpool_v = dk._data, dv._data
 
         # lane state (host side; device arrays mirror it each step)
         self._lanes: List[Optional[_Lane]] = [None] * self.max_running
@@ -290,15 +396,20 @@ class LLMEngine:
                             self._trash, onp.int32)
         self._pos = onp.zeros((self.max_running,), onp.int32)
         self._toks = onp.zeros((self.max_running, 1), onp.int32)
+        # the token at positions-1 per lane (the draft catch-up input)
+        self._prev = onp.zeros((self.max_running, 1), onp.int32)
 
         # compiled programs (memoized per model config in generation.py;
         # compiled through aot.cached_jit, so MXNET_TPU_AOT_CACHE serves
         # fresh replicas with zero cold compiles)
         from .. import aot
-        from ..gluon.model_zoo.generation import (paged_decode_program,
-                                                  paged_prefill_program)
+        from ..gluon.model_zoo.generation import (
+            paged_decode_program, paged_prefill_program,
+            paged_spec_draft_program, paged_spec_verify_program,
+            paged_suffix_prefill_program)
 
         self._paged_prefill_program = paged_prefill_program
+        self._paged_suffix_program = paged_suffix_prefill_program
         self._decode_run, self._params = paged_decode_program(
             model, max_running=self.max_running,
             num_blocks=self.num_blocks + 1, block_size=self.block_size,
@@ -306,7 +417,27 @@ class LLMEngine:
             kv_cache_dtype=self._kv_dtype, weight_dtype=weight_dtype,
             greedy=greedy, temperature=temperature, top_k=top_k,
             donate=self._donate)
+        if self._spec:
+            self._draft_run, self._draft_params = paged_spec_draft_program(
+                draft_model, max_running=self.max_running,
+                draft_k=self._draft_k, num_blocks=self.num_blocks + 1,
+                block_size=self.block_size,
+                max_blocks_per_seq=self.max_blocks_per_seq,
+                kv_cache_dtype=self._kv_dtype, weight_dtype=None,
+                greedy=greedy, temperature=temperature, top_k=top_k,
+                donate=self._donate)
+            self._verify_run, _ = paged_spec_verify_program(
+                model, max_running=self.max_running,
+                draft_k=self._draft_k, num_blocks=self.num_blocks + 1,
+                block_size=self.block_size,
+                max_blocks_per_seq=self.max_blocks_per_seq,
+                kv_cache_dtype=self._kv_dtype, weight_dtype=weight_dtype,
+                greedy=greedy, temperature=temperature, top_k=top_k,
+                donate=self._donate)
         self._prefill_runs: Dict[int, Callable] = {}
+        self._draft_prefill_runs: Dict[int, Callable] = {}
+        self._suffix_runs: Dict[int, Callable] = {}
+        self._draft_suffix_runs: Dict[int, Callable] = {}
         self._warmup_manifest = aot.WarmupManifest()
         self._warm: set = set()
         self._manifest_keyed: set = set()
@@ -348,6 +479,88 @@ class LLMEngine:
             self._prefill_runs[bucket] = run
         return run
 
+    def _draft_prefill_run(self, bucket: int) -> Callable:
+        run = self._draft_prefill_runs.get(bucket)
+        if run is None:
+            run, _ = self._paged_prefill_program(
+                self._draft, prefill_len=bucket,
+                num_blocks=self.num_blocks + 1,
+                block_size=self.block_size,
+                kv_cache_dtype=self._kv_dtype,
+                weight_dtype=None, greedy=self._greedy,
+                temperature=self._temperature, top_k=self._top_k,
+                donate=self._donate)
+            self._draft_prefill_runs[bucket] = run
+        return run
+
+    def _suffix_run(self, bucket: int, draft: bool = False) -> Callable:
+        cache = self._draft_suffix_runs if draft else self._suffix_runs
+        run = cache.get(bucket)
+        if run is None:
+            run, _ = self._paged_suffix_program(
+                self._draft if draft else self._model,
+                suffix_len=bucket, num_blocks=self.num_blocks + 1,
+                block_size=self.block_size,
+                max_blocks_per_seq=self.max_blocks_per_seq,
+                kv_cache_dtype=self._kv_dtype,
+                weight_dtype=None if draft else self._weight_dtype,
+                greedy=self._greedy, temperature=self._temperature,
+                top_k=self._top_k, donate=self._donate)
+            cache[bucket] = run
+        return run
+
+    # -- block accounting (refcounts + prefix cache) -----------------------
+    def _prefix_hashes(self, prompt) -> List[bytes]:
+        """Chain hashes of the prompt's FULL blocks: hash j commits to
+        tokens [0, (j+1)*block_size) — equal hash <=> equal prefix, the
+        radix-trie lookup flattened into consecutive dict hits."""
+        import hashlib
+
+        out: List[bytes] = []
+        chain = b""
+        bs = self.block_size
+        for j in range(int(prompt.shape[0]) // bs):
+            h = hashlib.blake2b(
+                chain + prompt[j * bs:(j + 1) * bs].tobytes(),
+                digest_size=16)
+            chain = h.digest()
+            out.append(chain)
+        return out
+
+    def _incref(self, blk: int) -> None:
+        self._ref[blk] = self._ref.get(blk, 0) + 1
+
+    def _decref(self, blk: int) -> None:
+        n = self._ref.get(blk, 0) - 1
+        if n > 0:
+            self._ref[blk] = n
+            return
+        self._ref.pop(blk, None)
+        self._free.append(blk)
+
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` blocks off the free list (refcount 1 each),
+        evicting LRU prefix-cache entries that nothing else references
+        when the list runs short. None when even a drained cache cannot
+        cover the reservation."""
+        while len(self._free) < n and self._prefix:
+            for hsh, blk in self._prefix.items():   # LRU order
+                if self._ref.get(blk, 0) == 1:      # cache-only resident
+                    del self._prefix[hsh]
+                    self._decref(blk)
+                    break
+            else:
+                break                               # all cached blocks live
+        # gauge tracks evictions even when the allocation still fails —
+        # free + cached must reconcile during the overload window too
+        self.metrics.prefix_cached_blocks.set(len(self._prefix))
+        if len(self._free) < n:
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        for b in got:
+            self._ref[b] = 1
+        return got
+
     # -- client surface ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
                eos_token: Optional[int] = None,
@@ -369,15 +582,18 @@ class LLMEngine:
             raise ValueError("prompt must have >= 1 token")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if p + max_new_tokens > self.max_context:
+        slack_note = (f" (+ draft_k {self._slack} speculative slack)"
+                      if self._slack else "")
+        if p + max_new_tokens + self._slack > self.max_context:
             raise ValueError(
-                f"prompt {p} + max_new_tokens {max_new_tokens} exceeds "
-                f"max_context {self.max_context}")
-        if -(-(p + max_new_tokens) // self.block_size) > self.num_blocks:
+                f"prompt {p} + max_new_tokens {max_new_tokens}"
+                f"{slack_note} exceeds max_context {self.max_context}")
+        if -(-(p + max_new_tokens + self._slack) // self.block_size) \
+                > self.num_blocks:
             raise ValueError(
                 f"request needs more KV blocks than the whole pool holds "
-                f"({self.num_blocks} x {self.block_size}) — it could "
-                "never be admitted")
+                f"({self.num_blocks} x {self.block_size}){slack_note} — "
+                "it could never be admitted")
         if timeout_ms == "default":
             timeout_ms = self._timeout_ms
         deadline = (time.monotonic() + timeout_ms / 1e3
@@ -423,8 +639,21 @@ class LLMEngine:
             got = self._queue.take(
                 max_items=len(free), max_wait_s=0.0,
                 poll_s=0.02 if not active else 1e-4)
-            for req in got:
-                self._admit(req, free.pop(0))
+            try:
+                while got:
+                    self._admit(got.pop(0), free.pop(0))
+            except Exception as e:
+                # an admission escalation (donated-buffer reset) aborts
+                # the tick: _admit already failed ITS request, but
+                # siblings popped from the queue in the same take() are
+                # in neither a lane nor the queue — fail them typed
+                # (transient: the client retry loop resubmits) instead
+                # of orphaning their wait() forever
+                for req in got:
+                    req.fail(ServerOverload(
+                        f"engine resetting mid-admission: {e!r}"))
+                    self.metrics.count("failed")
+                raise
             active = [i for i in range(self.max_running)
                       if self._lanes[i] is not None]
             free = [i for i in range(self.max_running)
@@ -433,14 +662,43 @@ class LLMEngine:
             if self._closed and not len(self._queue):
                 return None
             return True
-        self._decode_step(active)
+        if self._spec:
+            self._spec_step(active)
+        else:
+            self._decode_step(active)
         return False
 
     def _admit(self, req: GenRequest, lane_idx: int) -> None:
         """Prefill ``req`` into ``lane_idx`` (or shed it typed: expired
         deadline, or a pool that cannot hold its worst-case block
         reservation — the conservative no-preemption policy documented
-        in docs/llm_serving.md)."""
+        in docs/llm_serving.md). With the prefix cache armed, resident
+        leading full blocks are shared (refcounted, read-only) and only
+        the uncached suffix prefills.
+
+        Containment: a fault anywhere in admission must never orphan
+        ``req`` — a request popped from the queue but failed by nobody
+        hangs its client's ``wait()`` forever. Program faults are
+        contained inside :meth:`_admit_locked` (fail THIS request, keep
+        serving); anything escaping it (a pre-containment bookkeeping
+        bug, or the donated-buffer escalation) fails the request typed
+        here first-wins, then propagates to :meth:`_fault` so pool /
+        cache / refcount state rebuilds consistently."""
+        try:
+            self._admit_locked(req, lane_idx)
+        except Exception as e:  # noqa: BLE001 — typed + escalated
+            if isinstance(e, (TransientError, FatalError)):
+                typed = e
+            else:
+                cls = (TransientError if classify(e) == TRANSIENT
+                       else FatalError)
+                typed = cls(f"LLM admission fault: {e!r}")
+                typed.__cause__ = e
+            if req.fail(typed):     # no-op when already failed inside
+                self.metrics.count("failed")
+            raise
+
+    def _admit_locked(self, req: GenRequest, lane_idx: int) -> None:
         now = time.monotonic()
         if req.expired(now):
             self.metrics.count("shed_deadline")
@@ -449,43 +707,78 @@ class LLMEngine:
                 "ms) — shed before prefill"))
             return
         p = int(req.prompt.shape[0])
-        need = -(-(p + req.max_new_tokens) // self.block_size)
-        if need > len(self._free):
+        bs = self.block_size
+        need = -(-(p + req.max_new_tokens + self._slack) // bs)
+        # prefix-cache lookup: the longest run of resident chain hashes
+        # (consecutive dict hits == the radix descent, since hash j
+        # commits to the whole prefix through block j)
+        hashes: List[bytes] = []
+        hit_hashes: List[bytes] = []
+        hit_blocks: List[int] = []
+        if self._prefix_on:
+            hashes = self._prefix_hashes(req.prompt)
+            for hsh in hashes:
+                blk = self._prefix.get(hsh)
+                if blk is None:
+                    break
+                hit_hashes.append(hsh)
+                hit_blocks.append(blk)
+            if hit_blocks and len(hit_blocks) * bs == p:
+                # the last real token must still run (its logits sample
+                # the first generated token): never consume it from cache
+                hit_blocks.pop()
+                hit_hashes.pop()
+            if hit_blocks:
+                sb = self._prefill_bucket(p - len(hit_blocks) * bs)
+                if len(hit_blocks) + sb // bs > self.max_blocks_per_seq:
+                    # suffix bucket would spill past the block-covered
+                    # context window: fall back to a full prefill
+                    hit_blocks, hit_hashes = [], []
+        n_hit = len(hit_blocks)
+        # pin the hits BEFORE allocating: _alloc's LRU eviction must
+        # never evict (and re-issue) the very blocks this admission is
+        # about to share — a pinned block (refcount >= 2) is not
+        # evictable
+        for blk, hsh in zip(hit_blocks, hit_hashes):
+            self._incref(blk)
+            self._prefix.move_to_end(hsh)          # LRU bump
+        fresh = self._alloc(need - n_hit)
+        if fresh is None:
             # no free blocks: shed typed-transient so the client's retry
             # loop backs off and resubmits (never blocks the decode batch)
+            for blk in hit_blocks:
+                self._decref(blk)
             self.metrics.count("shed_overload")
             req.fail(ServerOverload(
                 f"KV pool exhausted ({len(self._free)} free blocks, "
-                f"need {need}) — back off and retry"))
+                f"need {need - n_hit}) — back off and retry"))
             return
-        blocks = [self._free.pop() for _ in range(need)]
+        blocks = hit_blocks + fresh
         self.metrics.pool_free.set(len(self._free))
-        bucket = self._prefill_bucket(p)
-        nb_bucket = bucket // self.block_size
-        nb_real = -(-p // self.block_size)
-        ids = onp.full((nb_bucket,), self._trash, onp.int32)
-        ids[:nb_real] = blocks[:nb_real]
-        padded = onp.zeros((1, bucket), onp.int32)
-        padded[0, :p] = req.prompt
+        if self._prefix_on:
+            self.metrics.observe_prefix(n_hit * bs, p - n_hit * bs)
+            if n_hit:
+                self._prefix_hits += 1
         t0 = time.perf_counter()
         ran = False
         try:
             # the chaos injection point for the splice path: an injected
             # fault fails THIS request (typed through the classifier),
             # injected latency holds the scheduler (deadline drills)
-            chaos.site("serving.llm", phase="prefill_splice", bucket=bucket)
-            run = self._prefill_run(bucket)
+            chaos.site("serving.llm", phase="prefill_splice",
+                       prefix_hit_blocks=n_hit)
             with telemetry.step("llm_prefill") as st:
                 with st.phase("device", "llm.prefill"):
                     ran = True
-                    first, self._pool_k, self._pool_v = run(
-                        self._params, padded, onp.int32(p - 1),
-                        self._pool_k, self._pool_v, ids, self._next_key())
-                    first = int(first)
+                    if n_hit:
+                        first = self._suffix_prefill(req, blocks, n_hit)
+                    else:
+                        first = self._full_prefill(req, blocks)
         except Exception as e:
             # contained: the fault fails THIS request, typed through the
             # classifier; the engine keeps serving
-            self._free.extend(blocks)
+            for b in blocks:
+                self._decref(b)
             self.metrics.pool_free.set(len(self._free))
             if isinstance(e, (TransientError, FatalError)):
                 typed = e
@@ -507,10 +800,16 @@ class LLMEngine:
         self.metrics.count("prefills")
         self.metrics.prefill_ms.observe(dt * 1e3)
         self.metrics.tokens_prefill.inc()
-        self._record_manifest(
-            "llm.prefill", bucket, run,
-            (self._params, padded, onp.int32(p - 1), self._pool_k,
-             self._pool_v, ids, self._key))
+        # admit this prompt's freshly-computed full blocks into the
+        # cache (+1 cache ref each; they are never written again —
+        # decode writes land at positions >= p, past every full block)
+        if self._prefix_on:
+            for j in range(n_hit, min(p // bs, len(hashes))):
+                hsh = hashes[j]
+                if hsh not in self._prefix:
+                    self._prefix[hsh] = blocks[j]
+                    self._incref(blocks[j])
+            self.metrics.prefix_cached_blocks.set(len(self._prefix))
         req.prefill_s = dt
         req.first_token_s = req.latency_s
         lane = _Lane(req, blocks, pos=p, last_token=first)
@@ -524,9 +823,77 @@ class LLMEngine:
         self._bt[lane_idx, :len(blocks)] = blocks
         self._pos[lane_idx] = lane.pos
         self._toks[lane_idx, 0] = lane.last_token
+        self._prev[lane_idx, 0] = int(req.prompt[-1])
         self.metrics.count("admitted")
         self.metrics.lanes_active.set(
             sum(1 for ln in self._lanes if ln is not None))
+
+    def _full_prefill(self, req: GenRequest, blocks: List[int]) -> int:
+        """Bucketed whole-prompt prefill (+ the draft model's, writing
+        the SAME block ids into its own pools, when spec is armed)."""
+        p = int(req.prompt.shape[0])
+        bucket = self._prefill_bucket(p)
+        nb_bucket = bucket // self.block_size
+        nb_real = -(-p // self.block_size)
+        ids = onp.full((nb_bucket,), self._trash, onp.int32)
+        ids[:nb_real] = blocks[:nb_real]
+        padded = onp.zeros((1, bucket), onp.int32)
+        padded[0, :p] = req.prompt
+        run = self._prefill_run(bucket)
+        first, self._pool_k, self._pool_v = run(
+            self._params, padded, onp.int32(p - 1), self._pool_k,
+            self._pool_v, ids, self._next_key())
+        self._record_manifest(
+            "llm.prefill", bucket, run,
+            (self._params, padded, onp.int32(p - 1), self._pool_k,
+             self._pool_v, ids, self._key))
+        if self._spec:
+            drun = self._draft_prefill_run(bucket)
+            _, self._dpool_k, self._dpool_v = drun(
+                self._draft_params, padded, onp.int32(p - 1),
+                self._dpool_k, self._dpool_v, ids, self._next_key())
+            self._record_manifest(
+                "llm.draft_prefill", bucket, drun,
+                (self._draft_params, padded, onp.int32(p - 1),
+                 self._dpool_k, self._dpool_v, ids, self._key))
+        return int(first)
+
+    def _suffix_prefill(self, req: GenRequest, blocks: List[int],
+                        n_hit: int) -> int:
+        """Prefill ONLY the uncached suffix: one multi-token paged step
+        attending over the resident prefix blocks through the lane's
+        table — the cached prefix's prefill compute is skipped
+        entirely."""
+        p = int(req.prompt.shape[0])
+        bs = self.block_size
+        start = n_hit * bs
+        s = p - start
+        bucket = self._prefill_bucket(s)
+        padded = onp.zeros((1, bucket), onp.int32)
+        padded[0, :s] = req.prompt[start:]
+        table = onp.full((1, self.max_blocks_per_seq), self._trash,
+                         onp.int32)
+        table[0, :len(blocks)] = blocks
+        run = self._suffix_run(bucket)
+        first, self._pool_k, self._pool_v = run(
+            self._params, padded, onp.int32(start), onp.int32(s - 1),
+            self._pool_k, self._pool_v, table, self._next_key())
+        self._record_manifest(
+            "llm.prefill_suffix", bucket, run,
+            (self._params, padded, onp.int32(start), onp.int32(s - 1),
+             self._pool_k, self._pool_v, table, self._key))
+        if self._spec:
+            drun = self._suffix_run(bucket, draft=True)
+            _, self._dpool_k, self._dpool_v = drun(
+                self._draft_params, padded, onp.int32(start),
+                onp.int32(s - 1), self._dpool_k, self._dpool_v, table,
+                self._next_key())
+            self._record_manifest(
+                "llm.draft_suffix", bucket, drun,
+                (self._draft_params, padded, onp.int32(start),
+                 onp.int32(s - 1), self._dpool_k, self._dpool_v, table,
+                 self._key))
+        return int(first)
 
     def _decode_step(self, active: List[int]) -> None:
         t0 = time.perf_counter()
@@ -562,6 +929,88 @@ class LLMEngine:
         self.metrics.lanes_active.set(
             sum(1 for ln in self._lanes if ln is not None))
 
+    def _spec_step(self, active: List[int]) -> None:
+        """One speculative round over the whole lane set: the draft
+        proposes K tokens per lane (K+1 small-model steps in one
+        program), the target verifies ALL of them in one batched
+        (R, K+1) forward with exact rejection sampling — each live lane
+        advances by ``n_acc + 1`` tokens per round instead of 1.
+        Inactive lanes ride along pointed at the trash block (their
+        outputs are garbage the loop below never reads)."""
+        t0 = time.perf_counter()
+        self._step_seq += 1
+        with telemetry.step("llm_spec", self._step_seq) as st:
+            with st.phase("device", "llm.spec"):
+                # the draft-verify splice chaos site: an injected fault
+                # propagates to _fault(), which fails the in-flight
+                # requests typed-transient and keeps the engine serving
+                chaos.site("serving.llm.verify", lanes=len(active))
+                d_toks, d_lgs, self._dpool_k, self._dpool_v = \
+                    self._draft_run(
+                        self._draft_params, self._prev, self._toks,
+                        self._dpool_k, self._dpool_v, self._bt,
+                        self._pos, self._next_key())
+                out, n_acc, self._pool_k, self._pool_v = \
+                    self._verify_run(
+                        self._params, self._toks, d_toks, d_lgs,
+                        self._pool_k, self._pool_v, self._bt, self._pos,
+                        self._next_key())
+                out = onp.asarray(out)
+                n_acc = onp.asarray(n_acc)
+        dt = time.perf_counter() - t0
+        self.metrics.count("spec_steps")
+        self.metrics.count("decode_steps")
+        self.metrics.decode_ms.observe(dt * 1e3)
+        self.metrics.spec_ms.observe(dt * 1e3)
+        self._record_manifest(
+            "llm.draft", self._draft_k, self._draft_run,
+            (self._draft_params, self._prev, self._toks, self._dpool_k,
+             self._dpool_v, self._bt, self._pos, self._key))
+        self._record_manifest(
+            "llm.verify", self._draft_k, self._verify_run,
+            (self._params, self._toks, d_toks, d_lgs, self._pool_k,
+             self._pool_v, self._bt, self._pos, self._key))
+        emitted_total = 0
+        accepted_total = 0
+        for i in active:
+            lane = self._lanes[i]
+            n_take = int(n_acc[i]) + 1
+            accepted_total += int(n_acc[i])
+            prev_last = lane.last_token
+            gone = False
+            emitted = 0
+            for j in range(n_take):
+                tok = int(out[i, j])
+                emitted += 1
+                lane.last_token = tok
+                if not self._push_token(lane, tok):
+                    self._release(lane, i)
+                    gone = True
+                    break
+                if self._retire_if_done(lane, lane_idx=i):
+                    gone = True
+                    break
+            emitted_total += emitted
+            if gone:
+                continue
+            # full window emitted: KV for [last, d_0..d_{n_acc-1}] is
+            # resident at pos..pos+n_acc; the corrected/bonus token is
+            # the new last (written next round); the token at the new
+            # pos-1 (the draft catch-up input) is the last ACCEPTED one
+            lane.pos += n_take
+            self._pos[i] = lane.pos
+            self._toks[i, 0] = lane.last_token
+            self._prev[i, 0] = (int(out[i, n_take - 2]) if n_take >= 2
+                                else prev_last)
+        self.metrics.observe_spec(self._draft_k * len(active),
+                                  accepted_total)
+        if emitted_total:
+            self.metrics.token_latency_ms.observe(dt * 1e3 / emitted_total)
+            self.metrics.tokens_decode.inc(emitted_total)
+            self._observe_tok_s(emitted_total)
+        self.metrics.lanes_active.set(
+            sum(1 for ln in self._lanes if ln is not None))
+
     def _push_token(self, lane: _Lane, tok: int) -> bool:
         """Record + stream one token. Returns False when the request's
         ``on_token`` callback raised — the request is failed (typed
@@ -593,8 +1042,12 @@ class LLMEngine:
         return True
 
     def _release(self, lane: _Lane, lane_idx: Optional[int]) -> None:
-        """Free the lane's blocks the moment its sequence finishes."""
-        self._free.extend(lane.blocks)
+        """Drop the lane's block references the moment its sequence
+        finishes; a block returns to the free list only when its
+        refcount hits zero (prefix-cache residents and other lanes
+        sharing a prompt prefix keep theirs alive)."""
+        for b in lane.blocks:
+            self._decref(b)
         lane.blocks = []
         self.metrics.pool_free.set(len(self._free))
         if lane_idx is not None:
@@ -602,6 +1055,7 @@ class LLMEngine:
             self._bt[lane_idx, :] = self._trash
             self._pos[lane_idx] = 0
             self._toks[lane_idx, 0] = 0
+            self._prev[lane_idx, 0] = 0
 
     # -- fault handling ----------------------------------------------------
     def _fault(self, exc: Exception) -> bool:
@@ -632,11 +1086,20 @@ class LLMEngine:
                 lane.req.fail(typed)
                 self.metrics.count("failed")
         # the failed program call may have consumed donated pool
-        # buffers: rebuild them (zeroed — no live lanes remain)
+        # buffers: rebuild them (zeroed — no live lanes remain). The
+        # prefix cache indexes pool CONTENT, so it resets with the pool.
         pk, pv = self._model.init_block_pool(
             self.num_blocks + 1, self.block_size, dtype=self._kv_dtype)
         self._pool_k, self._pool_v = pk._data, pv._data
+        if self._spec:
+            dk, dv = self._draft.init_block_pool(
+                self.num_blocks + 1, self.block_size,
+                dtype=self._kv_dtype)
+            self._dpool_k, self._dpool_v = dk._data, dv._data
         self._free = list(range(self.num_blocks))
+        self._ref.clear()
+        self._prefix.clear()
+        self.metrics.prefix_cached_blocks.set(0)
         self.metrics.pool_free.set(len(self._free))
         self.metrics.lanes_active.set(0)
         if not fatal:
@@ -735,11 +1198,20 @@ class LLMEngine:
                 "llm.prefill", b, run,
                 (self._params, padded, onp.int32(0), self._pool_k,
                  self._pool_v, ids, self._key))
+            if self._spec:
+                drun = self._draft_prefill_run(b)
+                _, self._dpool_k, self._dpool_v = drun(
+                    self._draft_params, padded, onp.int32(0),
+                    self._dpool_k, self._dpool_v, ids, self._next_key())
+                self._record_manifest(
+                    "llm.draft_prefill", b, drun,
+                    (self._draft_params, padded, onp.int32(0),
+                     self._dpool_k, self._dpool_v, ids, self._key))
+        toks = onp.zeros((self.max_running, 1), onp.int32)
+        bt = onp.full((self.max_running, self.max_blocks_per_seq),
+                      self._trash, onp.int32)
+        pos = onp.zeros((self.max_running,), onp.int32)
         if "decode" not in self._warm:
-            toks = onp.zeros((self.max_running, 1), onp.int32)
-            bt = onp.full((self.max_running, self.max_blocks_per_seq),
-                          self._trash, onp.int32)
-            pos = onp.zeros((self.max_running,), onp.int32)
             _, self._pool_k, self._pool_v = self._decode_run(
                 self._params, toks, self._pool_k, self._pool_v, bt, pos,
                 self._next_key())
@@ -748,6 +1220,22 @@ class LLMEngine:
                 "llm.decode", self.max_running, self._decode_run,
                 (self._params, toks, self._pool_k, self._pool_v, bt, pos,
                  self._key))
+        if self._spec and "spec" not in self._warm:
+            d_toks, d_lgs, self._dpool_k, self._dpool_v = self._draft_run(
+                self._draft_params, toks, toks, self._dpool_k,
+                self._dpool_v, bt, pos, self._next_key())
+            _, _, self._pool_k, self._pool_v = self._verify_run(
+                self._params, toks, d_toks, d_lgs, self._pool_k,
+                self._pool_v, bt, pos, self._next_key())
+            self._warm.add("spec")
+            self._record_manifest(
+                "llm.draft", self._draft_k, self._draft_run,
+                (self._draft_params, toks, toks, self._dpool_k,
+                 self._dpool_v, bt, pos, self._key))
+            self._record_manifest(
+                "llm.verify", self._draft_k, self._verify_run,
+                (self._params, toks, d_toks, d_lgs, self._pool_k,
+                 self._pool_v, bt, pos, self._key))
 
     def warmup_manifest(self):
         """The live decode-frontier manifest (keeps growing)."""
@@ -761,7 +1249,7 @@ class LLMEngine:
         from .. import aot
 
         c = self.metrics.counters()
-        return {
+        out = {
             "counters": c,
             "lanes_active": int(self.metrics.lanes_active.get()),
             "max_running": self.max_running,
@@ -776,6 +1264,24 @@ class LLMEngine:
             "queue_len": len(self._queue),
             "aot": aot.stats(),
         }
+        if self._spec:
+            out["speculative"] = {
+                "draft_k": self._draft_k,
+                "proposed": int(self.metrics.spec_proposed.value),
+                "accepted": int(self.metrics.spec_accepted.value),
+                "draft_acceptance_rate": round(
+                    float(self.metrics.draft_acceptance_rate.get()), 4),
+            }
+        if self._prefix_on:
+            out["prefix_cache"] = {
+                "cached_blocks": len(self._prefix),
+                "hit_requests": self._prefix_hits,
+                "hit_tokens": int(self.metrics.prefix_hit_tokens.value),
+                "miss_tokens": int(self.metrics.prefix_miss_tokens.value),
+                "prefix_hit_rate": round(
+                    float(self.metrics.prefix_hit_rate.get()), 4),
+            }
+        return out
 
     def close(self, drain: bool = True, timeout_s: float = 60.0) -> None:
         """Stop admitting; finish in-flight + queued work

@@ -42,6 +42,18 @@ def _engine(net, **kw):
     return LLMEngine(net, **kw)
 
 
+def _tiny_draft(seed=99, vocab=37, units=16, heads=4, max_length=64):
+    """A 1-layer draft for the 2-layer target — small enough that a
+    verify step is cheaper than K plain decode steps, uncorrelated
+    enough (random init) that rejections actually happen."""
+    onp.random.seed(seed)
+    net = bert.gpt_like(vocab_size=vocab, units=units, hidden_size=2 * units,
+                        num_layers=1, num_heads=heads,
+                        max_length=max_length, dropout=0.0)
+    net.initialize()
+    return net
+
+
 # ---------------------------------------------------------------------------
 # op level
 # ---------------------------------------------------------------------------
@@ -71,6 +83,32 @@ def test_paged_attention_matches_manual():
             want = p @ vals[hh, :lens[i]]
             onp.testing.assert_allclose(out[i, hh], want, rtol=2e-5,
                                         atol=2e-5)
+
+
+def test_paged_kernel_matches_jnp_int8():
+    """ISSUE 11 satellite: the kernel arms for int8 pools (the engine
+    DEFAULT) — the bitcast-scale layout dequantizes inside the kernel
+    and must match the jnp dequant-gather oracle."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import kv_cache_quantize, paged_attention
+    from mxnet_tpu.ops.pallas.paged_attention import paged_attention_kernel
+
+    rng = onp.random.RandomState(2)
+    r, h, d, bs, nb, mb = 3, 4, 16, 8, 10, 4
+    q = jnp.asarray(rng.randn(r, h, d), jnp.float32)
+    kp = kv_cache_quantize(jnp.asarray(rng.randn(nb, h, bs, d),
+                                       jnp.float32))
+    vp = kv_cache_quantize(jnp.asarray(rng.randn(nb, h, bs, d),
+                                       jnp.float32))
+    assert kp.dtype == jnp.int8 and kp.shape[-1] == d + 4
+    bt = jnp.asarray(rng.randint(0, nb, (r, mb)).astype(onp.int32))
+    lens = jnp.asarray(onp.array([5, 17, 32], onp.int32))
+    ref = paged_attention(q, kp, vp, bt, lens, use_kernel=False)
+    got = paged_attention_kernel(q, kp, vp, bt, lens, interpret=True)
+    assert got.dtype == q.dtype
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
 
 
 @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
@@ -439,6 +477,374 @@ out = eng.generate(onp.array([1, 2, 3], onp.int32), 4)
 eng.close()
 print(json.dumps({"aot": aot.stats(), "tokens": [int(t) for t in out]}))
 """
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: speculative decoding
+# ---------------------------------------------------------------------------
+@pytest.mark.seed(50)
+def test_spec_greedy_token_identical():
+    """The spec-decode oracle: greedy decode through the draft-verify
+    engine emits EXACTLY the plain paged engine's tokens (which are
+    themselves pinned to offline generate()) — draft quality affects
+    only the acceptance rate, never the output."""
+    net = _tiny_lm(seed=20)
+    draft = _tiny_draft(seed=21)
+    with _engine(net, draft_model=draft, draft_k=3) as eng:
+        for p_len, n_new in ((4, 6), (5, 7), (3, 9), (8, 4), (1, 11)):
+            prompt = onp.arange(1, p_len + 1, dtype=onp.int32) % 37
+            ref = generate(net, prompt[None], max_new_tokens=n_new,
+                           greedy=True).asnumpy()[0]
+            got = eng.generate(prompt, n_new)
+            onp.testing.assert_array_equal(onp.asarray(got), ref)
+        st = eng.stats()
+        spec = st["speculative"]
+        assert spec["proposed"] > 0
+        assert 0.0 <= spec["draft_acceptance_rate"] <= 1.0
+        assert st["counters"]["spec_steps"] > 0
+        # all blocks home after retirement (spec slack included)
+        assert st["pool_blocks_free"] == st["pool_blocks_total"]
+
+
+@pytest.mark.seed(51)
+def test_spec_inflight_admission_token_parity():
+    """Spec decode + continuous batching: sequences admitted INTO a
+    running draft-verify batch still emit exactly the offline tokens."""
+    net = _tiny_lm(seed=22)
+    draft = _tiny_draft(seed=23)
+    rng = onp.random.RandomState(24)
+    reqs = [(rng.randint(0, 37, (p,)).astype(onp.int32), n)
+            for p, n in ((4, 10), (7, 8), (3, 12), (5, 9))]
+    refs = [generate(net, p[None], max_new_tokens=n, greedy=True)
+            .asnumpy()[0] for p, n in reqs]
+    with _engine(net, max_running=2, draft_model=draft, draft_k=4) as eng:
+        handles = []
+        for i, (p, n) in enumerate(reqs):
+            handles.append(eng.submit(p, n))
+            if i == 1:
+                time.sleep(0.02)
+        outs = [h.wait(timeout=120) for h in handles]
+    for got, ref in zip(outs, refs):
+        onp.testing.assert_array_equal(onp.asarray(got), ref)
+
+
+def test_spec_rejection_sampling_distribution():
+    """Exact rejection sampling: over many seeds at fixed logits, the
+    marginal of the FIRST emitted token from _spec_accept must match
+    the target policy's distribution (the Leviathan guarantee), even
+    though the draft proposes from a very different distribution."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gluon.model_zoo.generation import (_policy_probs,
+                                                      _spec_accept)
+
+    rng = onp.random.RandomState(3)
+    v, k = 8, 2
+    t_logits = jnp.asarray(rng.randn(1, k + 1, v) * 1.5, jnp.float32)
+    d_logits = jnp.asarray(rng.randn(1, k, v) * 1.5, jnp.float32)
+    p_target = onp.asarray(
+        _policy_probs(t_logits, False, 1.0, 0))[0, 0]      # (V,)
+    q_draft = _policy_probs(d_logits, False, 1.0, 0)
+
+    n = 4000
+    counts = onp.zeros(v)
+
+    @jax.jit
+    def one(key):
+        kd, kv_ = jax.random.split(key)
+        # the draft proposes from ITS policy (as the draft program does)
+        d0 = jax.random.categorical(kd, jnp.log(q_draft[0, 0]))
+        d1 = jax.random.categorical(kd, jnp.log(q_draft[0, 1]))
+        toks = jnp.stack([d0, d1]).astype(jnp.int32)[None]
+        out, n_acc = _spec_accept(t_logits, d_logits, toks, kv_,
+                                  False, 1.0, 0)
+        return out[0, 0], n_acc[0]
+
+    for i in range(n):
+        tok, _ = one(jax.random.PRNGKey(i))
+        counts[int(tok)] += 1
+    emp = counts / n
+    # 4k samples: the empirical marginal tracks the target within a few
+    # standard errors per bucket (~3.5 sigma; sigma <= 0.5/sqrt(n))
+    assert onp.abs(emp - p_target).max() < 0.03, (emp, p_target)
+
+
+@pytest.mark.seed(52)
+def test_spec_sampled_engine_serves_and_records_acceptance():
+    """A temperature-sampling spec engine must serve correctly-shaped
+    output (distribution-exactness is pinned by the unit test above)
+    and record its acceptance telemetry."""
+    net = _tiny_lm(seed=25)
+    draft = _tiny_draft(seed=26)
+    with _engine(net, draft_model=draft, draft_k=3, greedy=False,
+                 temperature=1.0, seed=7) as eng:
+        out = onp.asarray(eng.generate(onp.array([1, 2, 3], onp.int32), 8))
+        assert out.shape[0] <= 8 and out.dtype == onp.int32
+        assert (0 <= out).all() and (out < 37).all()
+        assert eng.stats()["speculative"]["proposed"] > 0
+
+
+@pytest.mark.seed(53)
+def test_chaos_draft_verify_fault_typed_and_contained():
+    """ISSUE 11 satellite: a chaos fault on the draft-verify splice
+    fails the in-flight request typed-transient; the engine keeps
+    serving (pool rebuilt, next request exact)."""
+    from mxnet_tpu.base import TransientError
+    from mxnet_tpu.resilience import chaos
+
+    net = _tiny_lm(seed=27)
+    draft = _tiny_draft(seed=28)
+    prompt = onp.array([1, 2, 3], onp.int32)
+    with _engine(net, draft_model=draft, draft_k=3) as eng:
+        with chaos.scope("serving.llm.verify", fail="transient", times=1):
+            h = eng.submit(prompt, 6)
+            with pytest.raises(chaos.ChaosTransient) as ei:
+                h.wait(timeout=120)
+            assert isinstance(ei.value, TransientError)
+        ref = generate(net, prompt[None], max_new_tokens=6,
+                       greedy=True).asnumpy()[0]
+        onp.testing.assert_array_equal(
+            onp.asarray(eng.generate(prompt, 6)), ref)
+        st = eng.stats()
+        assert st["pool_blocks_free"] == st["pool_blocks_total"]
+        assert st["counters"]["resets"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: shared-prefix block caching (COW block tables, refcounts)
+# ---------------------------------------------------------------------------
+@pytest.mark.seed(54)
+def test_prefix_cache_hits_and_token_parity():
+    """Shared-system-prompt requests reuse resident prefix blocks (hit
+    rate > 0, fewer blocks recomputed) and stay token-identical to
+    offline generate() for every divergent suffix."""
+    net = _tiny_lm(seed=30)
+    shared = (onp.arange(1, 13, dtype=onp.int32) * 3) % 37  # 3 full blocks
+    tails = ([5, 1], [9, 2, 4], [7], [2, 8, 6, 3])
+    with _engine(net, prefix_cache=True, num_blocks=24) as eng:
+        for tail in tails:
+            prompt = onp.concatenate([shared,
+                                      onp.array(tail, onp.int32)])
+            ref = generate(net, prompt[None], max_new_tokens=6,
+                           greedy=True).asnumpy()[0]
+            got = eng.generate(prompt, 6)
+            onp.testing.assert_array_equal(onp.asarray(got), ref)
+        st = eng.stats()["prefix_cache"]
+        assert st["cached_blocks"] >= 3
+        assert st["hit_requests"] == len(tails) - 1   # all but the first
+        assert st["prefix_hit_rate"] > 0.4
+
+
+@pytest.mark.seed(55)
+def test_prefix_cow_refcounts_under_churn():
+    """The COW acceptance: two lanes share prefix blocks concurrently;
+    one finishing must NOT free blocks the other still reads (refcount
+    > 0), divergent suffixes never alias (outputs exact), and after
+    everything retires only cache-resident blocks stay off the free
+    list."""
+    net = _tiny_lm(seed=31)
+    shared = (onp.arange(1, 9, dtype=onp.int32) * 5) % 37   # 2 full blocks
+    with _engine(net, max_running=2, prefix_cache=True,
+                 num_blocks=20) as eng:
+        pa = onp.concatenate([shared, onp.array([3, 1], onp.int32)])
+        pb = onp.concatenate([shared, onp.array([9, 4, 2], onp.int32)])
+        # a finishes several tokens before b: its shared blocks are
+        # decref'd while b's lane still attends through them
+        ref_a = generate(net, pa[None], max_new_tokens=2,
+                         greedy=True).asnumpy()[0]
+        ref_b = generate(net, pb[None], max_new_tokens=14,
+                         greedy=True).asnumpy()[0]
+        # prime the cache so BOTH requests share resident blocks
+        eng.generate(onp.concatenate([shared,
+                                      onp.array([6], onp.int32)]), 2)
+        ha = eng.submit(pa, 2)
+        hb = eng.submit(pb, 14)
+        onp.testing.assert_array_equal(onp.asarray(ha.wait(timeout=120)),
+                                       ref_a)
+        onp.testing.assert_array_equal(onp.asarray(hb.wait(timeout=120)),
+                                       ref_b)
+        st = eng.stats()
+        pc = st["prefix_cache"]
+        assert pc["hit_requests"] >= 2
+        # free + cache-resident accounts for the whole pool: nothing
+        # leaked, nothing double-freed
+        assert st["pool_blocks_free"] + pc["cached_blocks"] == \
+            st["pool_blocks_total"]
+        # waves of churn: recycled blocks keep every sequence exact
+        for wave in range(3):
+            tail = onp.array([wave + 1, 11 - wave], onp.int32)
+            prompt = onp.concatenate([shared, tail])
+            ref = generate(net, prompt[None], max_new_tokens=5,
+                           greedy=True).asnumpy()[0]
+            onp.testing.assert_array_equal(
+                onp.asarray(eng.generate(prompt, 5)), ref)
+
+
+@pytest.mark.seed(56)
+def test_prefix_cache_eviction_under_pool_pressure():
+    """Cache-only residents are evicted LRU when an admission needs
+    their blocks; live (lane-referenced) blocks never are."""
+    net = _tiny_lm(seed=32)
+    # pool of 4 blocks of 4: a (p=8 + n=4 -> 3 blocks) sequence leaves
+    # 2 cached + 2 free, so the next 3-block reservation MUST evict
+    with _engine(net, max_running=1, prefix_cache=True,
+                 num_blocks=4) as eng:
+        a = (onp.arange(1, 9, dtype=onp.int32) * 7) % 37
+        eng.generate(a, 4)                       # caches 2 blocks of a
+        st = eng.stats()
+        assert st["prefix_cache"]["cached_blocks"] == 2
+        assert st["pool_blocks_free"] == 2
+        b = (onp.arange(1, 9, dtype=onp.int32) * 11) % 37
+        ref = generate(net, b[None], max_new_tokens=4,
+                       greedy=True).asnumpy()[0]
+        got = eng.generate(b, 4)                 # evicts a's LRU block
+        onp.testing.assert_array_equal(onp.asarray(got), ref)
+        st = eng.stats()
+        # 1 surviving block of a + 2 of b cached; accounting exact
+        assert st["prefix_cache"]["cached_blocks"] == 3
+        assert st["pool_blocks_free"] + \
+            st["prefix_cache"]["cached_blocks"] == st["pool_blocks_total"]
+
+
+@pytest.mark.seed(61)
+def test_prefix_readmission_under_pressure_pins_hits():
+    """Regression: re-admitting a prompt whose OWN hit blocks are the
+    LRU eviction candidates must pin them first — eviction re-issuing a
+    block this admission is about to share aliased live data and killed
+    the scheduler (orphaning the request). The tightest pool that can
+    serve the request at all must keep serving it forever."""
+    net = _tiny_lm(seed=40)
+    with _engine(net, max_running=1, prefix_cache=True,
+                 num_blocks=4) as eng:
+        prompt = (onp.arange(1, 13, dtype=onp.int32) * 7) % 37  # 3 blocks
+        ref = generate(net, prompt[None], max_new_tokens=4,
+                       greedy=True).asnumpy()[0]
+        for _ in range(3):      # hit path + eviction pressure each time
+            got = eng.generate(prompt, 4)
+            onp.testing.assert_array_equal(onp.asarray(got), ref)
+        st = eng.stats()
+        assert st["counters"]["failed"] == 0
+        assert st["pool_blocks_free"] + \
+            st["prefix_cache"]["cached_blocks"] == st["pool_blocks_total"]
+
+
+@pytest.mark.seed(57)
+def test_spec_plus_prefix_combined_token_identity():
+    """Both tentpole features at once: shared-prefix admission feeding
+    the draft-verify decode loop stays token-identical."""
+    net = _tiny_lm(seed=33)
+    draft = _tiny_draft(seed=34)
+    shared = (onp.arange(1, 13, dtype=onp.int32) * 2) % 37
+    with _engine(net, draft_model=draft, draft_k=3, prefix_cache=True,
+                 num_blocks=32) as eng:
+        for tail in ([5, 1], [9, 2, 4], [7]):
+            prompt = onp.concatenate([shared,
+                                      onp.array(tail, onp.int32)])
+            ref = generate(net, prompt[None], max_new_tokens=6,
+                           greedy=True).asnumpy()[0]
+            onp.testing.assert_array_equal(
+                onp.asarray(eng.generate(prompt, 6)), ref)
+        st = eng.stats()
+        assert st["prefix_cache"]["prefix_hit_rate"] > 0
+        assert st["speculative"]["proposed"] > 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: fused Pallas decode step
+# ---------------------------------------------------------------------------
+@pytest.mark.seed(58)
+def test_fused_decode_engine_token_identical(monkeypatch):
+    """The fused QKV/attend/out-proj kernel path (forced on; interpret
+    mode on CPU) serves greedy tokens identical to offline generate()
+    — the interpret-mode oracle the cost-model gate relies on."""
+    monkeypatch.setenv("MXNET_TPU_LLM_FUSED_DECODE", "1")
+    net = _tiny_lm(seed=35)
+    with _engine(net) as eng:
+        from mxnet_tpu.ops.pallas.fused_decode import fused_decode_armed
+
+        assert fused_decode_armed(kv_dtype="float32")
+        for p_len, n_new in ((4, 5), (3, 6)):
+            prompt = onp.arange(1, p_len + 1, dtype=onp.int32) % 37
+            ref = generate(net, prompt[None], max_new_tokens=n_new,
+                           greedy=True).asnumpy()[0]
+            onp.testing.assert_array_equal(
+                onp.asarray(eng.generate(prompt, n_new)), ref)
+
+
+@pytest.mark.seed(59)
+def test_fused_decode_int8_pool_close_to_unfused(monkeypatch):
+    """Fused int8: the in-kernel quantize + in-kernel dequant round
+    trip must match the unfused int8 path numerically (same layout,
+    same math) on one decode step."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import numpy as mxnp
+
+    net = _tiny_lm(seed=36)
+    pk, pv = net.init_block_pool(9, 4, dtype="int8")
+    toks = mxnp.array(onp.array([[7], [11]], onp.int32))
+    bt = mxnp.array(onp.array([[0, 1, 8, 8], [2, 3, 8, 8]], onp.int32))
+    pos = mxnp.array(onp.array([2, 5], onp.int32))
+    from mxnet_tpu.ops.nn import kv_cache_dequantize
+
+    monkeypatch.setenv("MXNET_TPU_LLM_FUSED_DECODE", "0")
+    ref_lg, ref_pk, _ = net.decode_step_paged(toks, pk, pv, bt, pos)
+    monkeypatch.setenv("MXNET_TPU_LLM_FUSED_DECODE", "1")
+    got_lg, got_pk, _ = net.decode_step_paged(toks, pk, pv, bt, pos)
+    onp.testing.assert_allclose(got_lg.asnumpy(), ref_lg.asnumpy(),
+                                rtol=2e-4, atol=2e-4)
+    # same bitcast-scale layout, same quantizer math: the DEQUANTIZED
+    # pools agree to quantization-step tolerance (bit-identity is not
+    # guaranteed — the fused projection's fp association can flip
+    # near-tie roundings)
+    ref_vals = onp.asarray(kv_cache_dequantize(
+        jnp.asarray(ref_pk.asnumpy()), jnp.float32))
+    got_vals = onp.asarray(kv_cache_dequantize(
+        jnp.asarray(got_pk.asnumpy()), jnp.float32))
+    onp.testing.assert_allclose(got_vals, ref_vals, rtol=0.1, atol=0.05)
+
+
+def test_fused_gate_cost_model_and_env(monkeypatch):
+    """The auto gate: off on CPU backends, on for TPU (memory-bound
+    verdict from the analysis.opt cost model); env overrides win."""
+    from mxnet_tpu.ops.pallas.fused_decode import (_cost_model_gate,
+                                                   fused_decode_armed)
+
+    monkeypatch.setenv("MXNET_TPU_LLM_FUSED_DECODE", "auto")
+    assert fused_decode_armed(kv_dtype="int8", backend="cpu") is False
+    assert _cost_model_gate("int8", "tpu") is True
+    assert fused_decode_armed(kv_dtype="int8", backend="tpu") is True
+    monkeypatch.setenv("MXNET_TPU_LLM_FUSED_DECODE", "0")
+    assert fused_decode_armed(kv_dtype="int8", backend="tpu") is False
+
+
+@pytest.mark.seed(60)
+def test_spec_prefix_telemetry_gauges():
+    """ISSUE 11 satellite: llm_draft_acceptance_rate and
+    llm_prefix_hit_rate ride the registry — visible in snapshots and
+    Prometheus text (the flight recorder dumps the same snapshot)."""
+    from mxnet_tpu import telemetry
+
+    net = _tiny_lm(seed=37)
+    draft = _tiny_draft(seed=38)
+    shared = (onp.arange(1, 9, dtype=onp.int32) * 3) % 37
+    with _engine(net, draft_model=draft, draft_k=3, prefix_cache=True,
+                 num_blocks=32) as eng:
+        for tail in ([1, 2], [4, 5]):
+            eng.generate(onp.concatenate([shared,
+                                          onp.array(tail, onp.int32)]), 5)
+        eid = eng.metrics.engine_id
+        snap = telemetry.snapshot()["metrics"]
+        for name in ("llm_draft_acceptance_rate", "llm_prefix_hit_rate",
+                     "llm_spec_tokens_total", "llm_prefix_tokens_total"):
+            assert name in snap, name
+        series = {tuple(sorted(s["labels"].items())): s["value"]
+                  for s in snap["llm_prefix_tokens_total"]["series"]}
+        assert series[(("engine", eid), ("result", "hit"))] > 0
+        prom = telemetry.prometheus_text()
+        assert "llm_draft_acceptance_rate" in prom
+        assert "llm_prefix_hit_rate" in prom
 
 
 def test_aot_warm_start_zero_miss(tmp_path):

@@ -1012,12 +1012,14 @@ def test_llm_serve_bench_quick(tmp_path):
     env = dict(os.environ, PYTHONPATH=ROOT)
     for k in ("MXNET_TPU_CHAOS", "MXNET_TPU_AOT_CACHE", "MXNET_TPU_AOT",
               "MXNET_TPU_LLM_MAX_RUNNING", "MXNET_TPU_LLM_BLOCK_SIZE",
-              "MXNET_TPU_LLM_POOL_BLOCKS"):
+              "MXNET_TPU_LLM_POOL_BLOCKS", "MXNET_TPU_LLM_DRAFT_K",
+              "MXNET_TPU_LLM_PREFIX_CACHE",
+              "MXNET_TPU_LLM_FUSED_DECODE"):
         env.pop(k, None)
     proc = subprocess.run(
         [sys.executable,
          os.path.join(ROOT, "benchmark", "llm_serve_bench.py"),
-         "--quick", "--output", out_file],
+         "--quick", "--spec", "--prefix", "--output", out_file],
         env=env, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr[-2000:]
     rec = json.loads(open(out_file).read())
@@ -1037,3 +1039,17 @@ def test_llm_serve_bench_quick(tmp_path):
     assert eng["token_latency_p99_ms"] >= eng["token_latency_p50_ms"]
     # smoke-scale throughput bound only (full-run gate is >= 3x)
     assert rec["speedup"] > 0.8, rec["speedup"]
+    # ISSUE 11: the speculative + prefix-cached rows (smoke asserts the
+    # CORRECTNESS invariants at any scale; the >= 2x-vs-plain gate
+    # lives on the banked full run — results_llm_serving_cpu.json)
+    sp = rec["spec_prefix"]
+    assert sp["spec"] is True and sp["prefix"] is True
+    assert sp["parity_vs_plain"]["token_identical"] is True
+    assert sp["parity_vs_plain"]["n_mismatched"] == 0
+    assert sp["zero_retraces"] is True
+    row = sp["engine_spec_prefix"]
+    assert row["prefix_hit_rate"] > 0
+    assert 0.0 <= row["draft_acceptance_rate"] <= 1.0
+    assert row["speculative"]["proposed"] > 0
+    assert row["compiles_during_serving"] == 0
+    assert sp["speedup_vs_plain"] > 0.3, sp["speedup_vs_plain"]

@@ -82,7 +82,16 @@ MODULES = {
     "mxnet_tpu.serving": "dynamic-batching inference serving engine",
     "mxnet_tpu.serving.llm": "continuous-batching LLM serving: paged "
                              "KV block pool, prefill/decode split, "
-                             "in-flight admission",
+                             "in-flight admission, speculative decode, "
+                             "shared-prefix block caching",
+    "mxnet_tpu.gluon.model_zoo.generation": "autoregressive generation: "
+                                            "compiled decode/beam "
+                                            "programs, paged serving "
+                                            "programs, speculative "
+                                            "draft/verify",
+    "mxnet_tpu.ops.pallas": "hand-written Pallas TPU kernels: flash "
+                            "attention, paged attention, fused decode "
+                            "step",
     "mxnet_tpu.telemetry": "unified telemetry: metrics registry, step "
                            "tracing, MFU gauges, flight recorder",
 }
