@@ -802,6 +802,29 @@ def capture_llm_serving() -> None:
         log(f"llm serving trajectory merge failed: {e!r}")
 
 
+FLEET = os.path.join(HERE, "results_fleet_tpu.json")
+
+
+def capture_fleet() -> None:
+    """Serving-fleet fault-domain row (ISSUE 12,
+    benchmark/fleet_bench.py): the chaos-kill drill + tenant-isolation
+    + infer-fleet phases on the TPU backend — the CPU row
+    (results_fleet_cpu.json) proved zero-loss mechanics; this banks the
+    TPU aggregate tok/s + img/s and the p99-through-recovery numbers
+    that the ROADMAP fleet milestone quotes."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "fleet_bench.py")],
+        timeout=2400)
+    rec = parse_json_output(out)
+    if bank_if_tpu(FLEET, rec, rc, "fleet bench") and rec:
+        d = rec.get("drill", {})
+        log(f"fleet: {rec.get('value')} tok/s aggregate, "
+            f"lost={d.get('lost_request_count')}, "
+            f"p99 {d.get('p99_steady_ms')} -> "
+            f"{d.get('p99_recovery_ms')} ms through the kill, "
+            f"{rec.get('img_s')} img/s infer fleet")
+
+
 def capture_infer_table() -> None:
     """Per-model inference table over the reference's FULL published
     perf.md rows (resnet50/resnet152/inception_v3/vgg16/alexnet, bf16 +
@@ -1274,6 +1297,7 @@ CAPTURES = (
      capture_infer_table),
     ("aot", banked_stale(AOT), capture_aot),
     ("opt", banked_stale(OPT), capture_opt),
+    ("fleet", banked_stale(FLEET), capture_fleet),
     ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION, 4 * 3600), capture_attention),

@@ -55,6 +55,10 @@ SITES = (
     "serving.infer",      # InferenceEngine micro-batch execution
     "serving.llm",        # LLMEngine prefill-splice (admission into lanes)
     "serving.llm.verify", # LLMEngine speculative draft-verify splice
+    "serving.fleet.replica",  # fleet replica step loop / dispatch (kill or
+                          # fatal = dead replica, delay = wedged replica;
+                          # per-replica variants fire as
+                          # serving.fleet.replica.<name>)
     "compile",            # HybridBlock trace/compile path
     "aot.read",           # CompileCache entry lookup (before the read)
     "aot.write",          # CompileCache publish, payload staged, pre-rename

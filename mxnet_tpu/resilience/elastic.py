@@ -62,6 +62,7 @@ __all__ = [
     "RankLost", "ClusterDegraded", "Heartbeat", "ElasticCluster",
     "ElasticSupervisor", "guard_collective", "current_generation",
     "heartbeat_period_s", "collective_deadline_s", "elastic_mode",
+    "sweep_rendezvous_root",
 ]
 
 
@@ -202,6 +203,85 @@ class Heartbeat:
 # generation rendezvous + bounded collectives
 # ---------------------------------------------------------------------------
 
+def sweep_rendezvous_root(root: str, *, keep_generations: int = 4,
+                          heartbeat_ttl_s: Optional[float] = None) -> Dict[str, int]:
+    """Bounded-retention sweep of a rendezvous root's litter from
+    crashed prior runs (the CheckpointManager orphan-sweep discipline
+    applied to the coordination substrate): without it every crash
+    leaves its ``gen_*`` trail, collective scratch dirs and heartbeat
+    files behind **forever**.
+
+    Kept: the ``keep_generations`` newest ``gen_*`` dirs (the newest
+    published membership must survive — a full-pod restart rendezvouses
+    at ``max published + 1``), heartbeat files younger than
+    ``heartbeat_ttl_s`` (default ``max(60 s, 30 x heartbeat period)`` —
+    a *live* sibling cohort's files are always far younger). Removed:
+    older generation dirs (their ``member_*``/``membership.json``
+    litter goes with them), collective scratch (``coll/g<g>_*``) of
+    swept generations, dead heartbeat files and their orphaned
+    ``.tmp*`` staging twins.
+
+    Race-tolerant (several ranks sweep the same root at init; deletions
+    never error on a concurrent winner) and warns once per sweep that
+    removed anything. Returns ``{"generations": n, "heartbeats": n,
+    "collectives": n}``.
+    """
+    import shutil
+    import warnings
+
+    root = os.path.abspath(root)
+    swept = {"generations": 0, "heartbeats": 0, "collectives": 0}
+    if not os.path.isdir(root):
+        return swept
+    if keep_generations < 1:
+        raise ValueError("keep_generations must be >= 1")
+    gens = sorted(int(n[4:]) for n in os.listdir(root)
+                  if n.startswith("gen_") and n[4:].isdigit())
+    cutoff = gens[-keep_generations] if len(gens) > keep_generations \
+        else (gens[0] if gens else 0)
+    for g in gens:
+        if g < cutoff:
+            shutil.rmtree(os.path.join(root, f"gen_{g}"),
+                          ignore_errors=True)
+            swept["generations"] += 1
+    coll = os.path.join(root, "coll")
+    if os.path.isdir(coll):
+        for n in os.listdir(coll):
+            try:
+                g = int(n.lstrip("g").split("_", 1)[0])
+            except ValueError:
+                continue
+            if g < cutoff:
+                shutil.rmtree(os.path.join(coll, n), ignore_errors=True)
+                swept["collectives"] += 1
+    ttl = float(heartbeat_ttl_s if heartbeat_ttl_s is not None
+                else max(60.0, 30.0 * heartbeat_period_s()))
+    hb = os.path.join(root, "heartbeats")
+    if os.path.isdir(hb):
+        now = time.time()
+        for n in os.listdir(hb):
+            if not n.startswith("rank_"):
+                continue
+            p = os.path.join(hb, n)
+            try:
+                # orphaned .tmp staging twins (a rank killed mid-beat)
+                # age out on the same clock as the files they staged
+                if now - os.stat(p).st_mtime > ttl:
+                    os.unlink(p)
+                    swept["heartbeats"] += 1
+            except OSError:
+                continue  # a concurrent sweeper won the race
+    if any(swept.values()):
+        warnings.warn(
+            f"resilience.elastic: swept rendezvous-root litter from "
+            f"prior runs under {root!r}: {swept['generations']} stale "
+            f"generation dir(s), {swept['heartbeats']} dead heartbeat "
+            f"file(s), {swept['collectives']} collective scratch "
+            "dir(s) — the newest generations and every live heartbeat "
+            "were kept", RuntimeWarning, stacklevel=2)
+    return swept
+
+
 def current_generation(root: str) -> Optional[int]:
     """Newest generation with a published membership, else None."""
     root = os.path.abspath(root)
@@ -283,6 +363,12 @@ class ElasticCluster:
         """Beat, then rendezvous generation 0 (or ``max published + 1``
         on a root that already has generations — a full-pod restart).
         Returns the role: ``active`` or ``spare``."""
+        # bounded-retention sweep of crashed prior runs' gen_*/heartbeat
+        # litter BEFORE beating (our own fresh heartbeat is never stale;
+        # the newest published generation survives, so the max+1 restart
+        # rendezvous below is unchanged)
+        sweep_rendezvous_root(
+            self.root, heartbeat_ttl_s=max(60.0, 30.0 * self.hb.period))
         self.hb.start()
         cur = current_generation(self.root)
         target = 0 if cur is None else cur + 1

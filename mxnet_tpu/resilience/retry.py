@@ -162,16 +162,30 @@ class RetryPolicy:
     #: clients sharing one policy DE-correlate (jitter's whole purpose);
     #: an explicit int makes the schedule reproducible for tests.
     seed: Optional[int] = None
+    #: Injectable jitter source: a callable returning uniform floats in
+    #: [0, 1), consulted once per computed delay. Wins over ``seed``.
+    #: This is the seam drills/tests use for fully deterministic backoff
+    #: SCHEDULES across every loop sharing one policy — ``seed`` alone
+    #: reseeds per :meth:`delays` call, which de-correlates concurrent
+    #: clients but still interleaves nondeterministically when several
+    #: loops share a policy object (timing assertions were
+    #: flaky-by-construction); ``rng=lambda: 0.0`` pins the schedule to
+    #: its exact upper envelope, ``itertools.cycle(...).__next__`` to
+    #: any fixed sequence.
+    rng: Optional[Callable[[], float]] = None
     classify: Callable[[BaseException], str] = field(default=classify)
     sleep: Callable[[float], None] = field(default=time.sleep)
 
     def delays(self):
         """The backoff schedule (attempt 2, 3, ...) as a generator."""
-        rng = random.Random(self.seed) if self.seed is not None \
-            else random.Random()
+        if self.rng is not None:
+            draw = self.rng
+        else:
+            draw = (random.Random(self.seed) if self.seed is not None
+                    else random.Random()).random
         d = self.base_delay_s
         while True:
-            factor = 1.0 - self.jitter * rng.random() if self.jitter else 1.0
+            factor = 1.0 - self.jitter * draw() if self.jitter else 1.0
             yield min(d, self.max_delay_s) * factor
             d *= self.multiplier
 

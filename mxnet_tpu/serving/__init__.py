@@ -14,6 +14,11 @@ model (ROADMAP: "serves heavy traffic from millions of users"):
 - :class:`LLMEngine` (:mod:`.llm`) — continuous-batching autoregressive
   generation: paged KV-cache block pool, prefill/decode disaggregation,
   in-flight admission into a running decode batch;
+- :class:`Router` / :class:`ReplicaPool` (:mod:`.fleet`) — the serving
+  fleet fault domain: health-checked replicas (``healthy → draining →
+  dead``), least-loaded dispatch, hedged sends with first-wins
+  cancellation, per-replica circuit breakers, weighted-fair tenant
+  quotas with deadline-class shedding, drain/restart lifecycle;
 - :mod:`.bench` — the N-concurrent-synthetic-clients harness behind
   ``tools/serve_bench.py``.
 
@@ -21,9 +26,11 @@ See ``docs/serving.md`` / ``docs/llm_serving.md`` for architecture,
 bucketing policy and failure semantics.
 """
 from .admission import (AdmissionQueue, DeadlineExceeded, Request,  # noqa: F401
-                        ServerOverload)
+                        RequestCancelled, ServerOverload)
 from .batcher import DynamicBatcher  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
+from .fleet import (CircuitBreaker, FleetRequest, Replica,  # noqa: F401
+                    ReplicaPool, ReplicaUnavailable, Router, TenantConfig)
 from .llm import GenRequest, LLMEngine  # noqa: F401
 from .metrics import Histogram, ServingMetrics  # noqa: F401
 
@@ -36,6 +43,14 @@ __all__ = [
     "Request",
     "ServerOverload",
     "DeadlineExceeded",
+    "RequestCancelled",
     "ServingMetrics",
     "Histogram",
+    "Router",
+    "ReplicaPool",
+    "Replica",
+    "TenantConfig",
+    "FleetRequest",
+    "CircuitBreaker",
+    "ReplicaUnavailable",
 ]

@@ -23,7 +23,8 @@ from typing import Any, Callable, List, Optional, Tuple
 
 from ..base import MXNetError, TransientError
 
-__all__ = ["ServerOverload", "DeadlineExceeded", "Request", "AdmissionQueue"]
+__all__ = ["ServerOverload", "DeadlineExceeded", "RequestCancelled",
+           "Request", "AdmissionQueue"]
 
 
 class ServerOverload(TransientError):
@@ -36,9 +37,35 @@ class ServerOverload(TransientError):
 
 
 class DeadlineExceeded(TransientError):
-    """The request's deadline passed before execution started — shed
-    without spending compute on it. Also transient: no work was done,
-    so a resubmission with a fresh deadline is always safe."""
+    """The request's deadline budget ran out — at admission, at dequeue,
+    or (for generation lanes) mid-execution, where the expired work is
+    retired instead of streamed to a client that already gave up.
+    Transient: a resubmission with a fresh deadline is always safe.
+
+    ``elapsed_s`` / ``budget_s`` carry how long the request actually ran
+    against how much it was given (None when unknown), so a client's
+    retry loop can tell "shed instantly under load" from "my budget is
+    simply too small for this request"."""
+
+    def __init__(self, msg: str, elapsed_s: Optional[float] = None,
+                 budget_s: Optional[float] = None):
+        super().__init__(msg)
+        self.elapsed_s = elapsed_s
+        self.budget_s = budget_s
+
+    def __reduce__(self):
+        # args holds only msg; the extra attrs must survive pickling
+        # across drill process boundaries like the rest of the taxonomy
+        return (DeadlineExceeded,
+                (self.args[0], self.elapsed_s, self.budget_s))
+
+
+class RequestCancelled(TransientError):
+    """The request was cancelled by its submitter (or by a fleet router
+    whose hedged twin of this request already won) before it finished.
+    Transient: cancellation says nothing about the server's health, and
+    re-submission is always safe — though the canceller, by definition,
+    no longer wants the result."""
 
 
 class Request:
@@ -52,7 +79,7 @@ class Request:
     """
 
     __slots__ = ("payload", "n", "signature", "deadline", "enqueue_t",
-                 "_event", "_result", "_error")
+                 "_event", "_result", "_error", "_cancelled")
 
     def __init__(self, payload: Any, n: int, signature: Tuple,
                  deadline: Optional[float]):
@@ -64,11 +91,41 @@ class Request:
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
+        self._cancelled = False
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
                 and (now if now is not None else time.monotonic())
                 > self.deadline)
+
+    def cancel(self) -> None:
+        """Ask the server to stop working on this request. Advisory and
+        asynchronous: the serving loop retires the request (failing it
+        with :class:`RequestCancelled`) at its next scheduling point —
+        a request that completes first keeps its result (first
+        completion wins). Safe from any thread, idempotent."""
+        self._cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
+    def done(self) -> bool:
+        """True once exactly one of finish/fail has fired (non-blocking
+        — the poll the fleet router's relay loop runs instead of parking
+        a waiter thread per request)."""
+        return self._event.is_set()
+
+    def exception(self) -> Optional[BaseException]:
+        """The failure, if this request is done and failed; None while
+        pending or on success. Non-blocking."""
+        return self._error if self._event.is_set() else None
+
+    def result(self) -> Any:
+        """The result, if done and successful (None otherwise) —
+        non-blocking peek; use :meth:`wait` to block."""
+        return self._result if self._event.is_set() else None
 
     def finish(self, result: Any) -> bool:
         """First completion wins; returns whether THIS call completed it
@@ -166,14 +223,25 @@ class AdmissionQueue:
 
     # -- batcher side -----------------------------------------------------
     def _shed_expired_head(self, now: float) -> None:
-        """Fail-and-drop expired requests at the queue head (under lock)."""
-        while self._q and self._q[0].expired(now):
+        """Fail-and-drop expired/cancelled requests at the queue head
+        (under lock)."""
+        while self._q and (self._q[0].expired(now)
+                           or self._q[0].cancelled):
             req = self._q.popleft()
+            if req.cancelled and not req.expired(now):
+                req.fail(RequestCancelled(
+                    "request cancelled while queued — dropped before "
+                    "execution"))
+                continue
             if self._metrics is not None:
                 self._metrics.count("shed_deadline")
+            budget = (req.deadline - req.enqueue_t
+                      if req.deadline is not None else None)
             req.fail(DeadlineExceeded(
                 f"deadline passed while queued ({req.latency_s * 1e3:.1f} "
-                "ms in queue) — shed before execution"))
+                f"ms in queue vs a "
+                f"{budget * 1e3:.1f} ms budget) — shed before execution",
+                elapsed_s=req.latency_s, budget_s=budget))
 
     def take(self, max_items: int, max_wait_s: float,
              poll_s: float = 0.05) -> List[Request]:

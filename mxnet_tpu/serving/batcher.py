@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, List
 
 from .admission import AdmissionQueue, Request, ServerOverload
@@ -39,6 +40,17 @@ class DynamicBatcher:
         self._thread = threading.Thread(target=self._loop, name=name,
                                         daemon=True)
         self._started = False
+        # monotonic stamp of the last completed loop iteration — the
+        # fleet health monitor's wedged-batcher signal (take() bounds
+        # each iteration, so a live loop always advances this)
+        self.last_tick = time.monotonic()
+        # optional per-iteration hook (the fleet layer's per-replica
+        # chaos/liveness seam, mirroring LLMEngine's step_hook). It
+        # runs UNCONTAINED by the per-batch isolation: an injected
+        # fatal kills this loop — i.e. the replica, which is exactly
+        # the fleet drill's dead-replica semantics — and an injected
+        # delay wedges it (last_tick goes stale).
+        self._step_hook: Callable[[], None] = None
 
     def start(self) -> None:
         if not self._started:
@@ -58,6 +70,9 @@ class DynamicBatcher:
 
     def _loop(self) -> None:
         while True:
+            self.last_tick = time.monotonic()
+            if self._step_hook is not None:
+                self._step_hook()
             batch = self._queue.take(self._max_batch, self._max_delay_s)
             if not batch:
                 if self._queue.closed and len(self._queue) == 0:

@@ -406,6 +406,17 @@ class InferenceEngine:
             snap["backend"] = "?"
         return snap
 
+    @property
+    def alive(self) -> bool:
+        """The serving loop is live: batcher thread running, engine not
+        closed (the fleet health monitor's liveness probe)."""
+        return not self._closed and self._batcher.alive
+
+    @property
+    def last_tick(self) -> float:
+        """Monotonic stamp of the batcher loop's last iteration."""
+        return self._batcher.last_tick
+
     def close(self, drain: bool = True, timeout_s: float = 60.0) -> None:
         """Shut down: stop admitting, then either finish everything
         queued (``drain=True``) or fail it with :class:`ServerOverload`.

@@ -52,7 +52,8 @@ from ..base import (FatalError, MXNetError, TransientError, env_float,
 from ..resilience import chaos
 from ..resilience.retry import classify, TRANSIENT
 from ..telemetry import get_registry
-from .admission import AdmissionQueue, DeadlineExceeded, Request, ServerOverload
+from .admission import (AdmissionQueue, DeadlineExceeded, Request,
+                        RequestCancelled, ServerOverload)
 
 __all__ = ["LLMEngine", "GenRequest"]
 
@@ -102,7 +103,8 @@ class LLMMetrics:
     lands in the flight-recorder snapshot automatically)."""
 
     _EVENTS = ("submitted", "admitted", "completed", "failed",
-               "shed_overload", "shed_deadline", "prefills",
+               "shed_overload", "shed_deadline", "retired_deadline",
+               "cancelled", "prefills",
                "decode_steps", "spec_steps", "resets", "compiles")
 
     def __init__(self, engine_id: str):
@@ -280,6 +282,23 @@ class LLMEngine:
         resident reuses them copy-on-write (per-block refcounts; a
         block is freed only at refcount zero) and prefills ONLY its
         uncached suffix. Default ``MXNET_TPU_LLM_PREFIX_CACHE`` (off).
+    step_hook : callable, optional
+        Called at the top of every scheduler tick, inside the fault
+        containment (an exception it raises is typed through the
+        resilience classifier exactly like a program fault). The fleet
+        layer (:mod:`.fleet`) uses it as the per-replica chaos
+        injection point; anything it does must be cheap.
+
+    Notes
+    -----
+    A request's ``timeout_ms`` deadline is an **end-to-end budget**:
+    admission wait + queue + prefill + decode. A lane whose deadline
+    passes mid-decode is retired at the next scheduler tick — blocks
+    freed, request failed :class:`~.admission.DeadlineExceeded`
+    carrying ``elapsed_s`` vs ``budget_s`` — instead of streaming
+    tokens to a client that already gave up. ``GenRequest.cancel()``
+    retires a lane the same way (:class:`~.admission.RequestCancelled`)
+    — the fleet router's first-wins hedge cancellation.
     """
 
     def __init__(self, model, *, max_running: Optional[int] = None,
@@ -296,6 +315,7 @@ class LLMEngine:
                  donate: Optional[bool] = None,
                  draft_model=None, draft_k: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
+                 step_hook: Optional[Callable[[], None]] = None,
                  metrics: Optional[LLMMetrics] = None):
         from ..gluon.model_zoo.generation import _resolve_cache_dtype
 
@@ -445,6 +465,11 @@ class LLMEngine:
         # scheduler; the state lock covers pool/lane mutation (the
         # scheduler tick vs a caller-thread warmup())
         self._state_lock = threading.RLock()
+        self._step_hook = step_hook
+        # scheduler-loop liveness: monotonic stamp of the last completed
+        # tick. A wedged scheduler (stuck inside a step) stops advancing
+        # it, which is what the fleet health monitor keys "wedged" off.
+        self.last_tick = time.monotonic()
         self._queue = AdmissionQueue(max_queue_size, self.metrics)
         self._closed = False
         self._drain = True
@@ -615,9 +640,11 @@ class LLMEngine:
             try:
                 idle = self._tick()
             except Exception as e:  # noqa: BLE001 — typed + contained
+                self.last_tick = time.monotonic()
                 if not self._fault(e):
                     return
                 continue
+            self.last_tick = time.monotonic()
             if idle is None:        # closed and drained
                 return
             if idle:
@@ -631,6 +658,11 @@ class LLMEngine:
             return self._tick_locked()
 
     def _tick_locked(self):
+        if self._step_hook is not None:
+            # inside the containment: a hook fault (e.g. an armed
+            # serving.fleet.replica chaos rule) routes through _fault
+            self._step_hook()
+        self._sweep_lanes()
         active = [i for i in range(self.max_running)
                   if self._lanes[i] is not None]
         free = [i for i in range(self.max_running)
@@ -667,6 +699,44 @@ class LLMEngine:
         else:
             self._decode_step(active)
         return False
+
+    def _sweep_lanes(self) -> None:
+        """Retire lanes whose request no longer wants to run: cancelled
+        (a submitter gave up, or a fleet hedge twin already won —
+        first-wins cancellation) or past its end-to-end deadline budget
+        mid-decode (the work would stream to a client that already gave
+        up; retire it and free the blocks instead). Runs at the top of
+        every tick, so a freed lane is admittable the same tick."""
+        now = time.monotonic()
+        retired = False
+        for i in range(self.max_running):
+            lane = self._lanes[i]
+            if lane is None:
+                continue
+            req = lane.req
+            if req.cancelled:
+                retired = True
+                self._release(lane, i)
+                if req.fail(RequestCancelled(
+                        "request cancelled mid-generation — lane "
+                        f"retired after {len(req.tokens)} token(s)")):
+                    self.metrics.count("cancelled")
+                continue
+            if req.deadline is not None and now > req.deadline:
+                elapsed = now - req.enqueue_t
+                budget = req.deadline - req.enqueue_t
+                retired = True
+                self._release(lane, i)
+                if req.fail(DeadlineExceeded(
+                        f"deadline passed mid-decode ({elapsed * 1e3:.1f} "
+                        f"ms elapsed vs a {budget * 1e3:.1f} ms budget, "
+                        f"{len(req.tokens)} token(s) generated) — lane "
+                        "retired, remaining work not spent",
+                        elapsed_s=elapsed, budget_s=budget)):
+                    self.metrics.count("retired_deadline")
+        if retired:
+            self.metrics.lanes_active.set(
+                sum(1 for ln in self._lanes if ln is not None))
 
     def _admit(self, req: GenRequest, lane_idx: int) -> None:
         """Prefill ``req`` into ``lane_idx`` (or shed it typed: expired
@@ -1283,9 +1353,24 @@ class LLMEngine:
             }
         return out
 
+    @property
+    def alive(self) -> bool:
+        """The scheduler step loop is live: thread running, not stopped
+        on a fatal fault, not closed. What the fleet health monitor
+        gates the per-replica heartbeat on (a dead loop must go stale,
+        a wedged one is caught by :attr:`last_tick` age)."""
+        return (self._thread.is_alive() and self._broken is None
+                and not self._closed)
+
     def close(self, drain: bool = True, timeout_s: float = 60.0) -> None:
         """Stop admitting; finish in-flight + queued work
-        (``drain=True``) or fail it, then stop the scheduler."""
+        (``drain=True``) or fail it, then stop the scheduler.
+
+        Never leaves a queued request hanging: if the scheduler cannot
+        drain the queue — its thread already exited, or it is wedged
+        past ``timeout_s`` — whatever still sits in the admission queue
+        is failed typed (:class:`ServerOverload`) so every ``wait()``
+        returns."""
         with self._close_lock:
             if self._closed:
                 return
@@ -1304,6 +1389,25 @@ class LLMEngine:
                             lane.req.fail(ServerOverload(
                                 "engine closed without drain"))
         self._thread.join(timeout_s)
+        if len(self._queue) and not self._thread.is_alive():
+            # the scheduler died (fatal stop raced the close, or a
+            # bookkeeping bug killed the thread) with requests still
+            # queued: nobody will ever drain them — fail them typed
+            # instead of hanging their wait() forever
+            n = self._queue.fail_all(lambda: ServerOverload(
+                "engine closed with the scheduler already stopped — "
+                "queued request failed, resubmit elsewhere"))
+            self.metrics.count("failed", n)
+        elif len(self._queue) and self._thread.is_alive():
+            # drain timed out with the scheduler wedged: the caller is
+            # leaving — fail what is still *queued* (in-flight lanes
+            # keep their first-completion-wins semantics if the
+            # scheduler ever unwedges)
+            n = self._queue.fail_all(lambda: ServerOverload(
+                f"engine close(drain=True) timed out after "
+                f"{timeout_s:g}s with the scheduler wedged — queued "
+                "request failed, resubmit elsewhere"))
+            self.metrics.count("failed", n)
 
     def __enter__(self) -> "LLMEngine":
         return self

@@ -595,3 +595,75 @@ def test_chaos_bench_elastic_quick(tmp_path):
     worlds = {r.get("world") for r in payload["records"]
               if r["metric"] == "elastic_shard_commit_overhead_pct"}
     assert worlds == {1, 2, 4}
+
+
+def test_sweep_rendezvous_root_bounded_retention(tmp_path):
+    """ISSUE 12 satellite: a crashed prior run's gen_*/heartbeat/coll
+    litter is swept at init with bounded retention — newest
+    generations and live heartbeats survive."""
+    import warnings
+
+    from mxnet_tpu.resilience.elastic import (current_generation,
+                                              sweep_rendezvous_root)
+
+    root = str(tmp_path)
+    for g in range(7):
+        d = os.path.join(root, f"gen_{g}")
+        os.makedirs(d)
+        with open(os.path.join(d, "membership.json"), "w") as f:
+            json.dump({"gen": g, "ranks": [0]}, f)
+        open(os.path.join(d, "member_0.json"), "w").write("{}")
+    for g in (0, 5):
+        os.makedirs(os.path.join(root, "coll", f"g{g}_000001"))
+    hb = os.path.join(root, "heartbeats")
+    os.makedirs(hb)
+    for rank, age in ((0, 3600.0), (1, 1.0)):
+        p = os.path.join(hb, f"rank_{rank}.json")
+        open(p, "w").write("{}")
+        t = time.time() - age
+        os.utime(p, (t, t))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        swept = sweep_rendezvous_root(root, keep_generations=4,
+                                      heartbeat_ttl_s=60.0)
+    assert swept == {"generations": 3, "heartbeats": 1, "collectives": 1}
+    kept = sorted(n for n in os.listdir(root) if n.startswith("gen_"))
+    assert kept == ["gen_3", "gen_4", "gen_5", "gen_6"]
+    assert not os.path.isdir(os.path.join(root, "coll", "g0_000001"))
+    assert os.path.isdir(os.path.join(root, "coll", "g5_000001"))
+    assert sorted(os.listdir(hb)) == ["rank_1.json"]
+    # the newest published generation survived: a full-pod restart
+    # still rendezvouses at max + 1
+    assert current_generation(root) == 6
+
+
+def test_cluster_start_sweeps_prior_run_litter(tmp_path):
+    import warnings
+
+    from mxnet_tpu.resilience.elastic import ElasticCluster
+
+    root = str(tmp_path)
+    for g in range(6):
+        d = os.path.join(root, f"gen_{g}")
+        os.makedirs(d)
+        with open(os.path.join(d, "membership.json"), "w") as f:
+            json.dump({"gen": g, "ranks": [0]}, f)
+    hb = os.path.join(root, "heartbeats")
+    os.makedirs(hb)
+    stale = os.path.join(hb, "rank_7.json")
+    open(stale, "w").write("{}")
+    t = time.time() - 7200
+    os.utime(stale, (t, t))
+    cluster = ElasticCluster(root, 0, 1, heartbeat_s=0.2,
+                             start_deadline_s=30.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        role = cluster.start()
+    try:
+        assert role == "active"
+        assert cluster.gen == 6            # max published (5) + 1
+        assert not os.path.isdir(os.path.join(root, "gen_0"))
+        assert os.path.isdir(os.path.join(root, "gen_5"))
+        assert not os.path.exists(stale)   # dead heartbeat swept
+    finally:
+        cluster.stop()

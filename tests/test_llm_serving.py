@@ -877,3 +877,92 @@ def test_aot_warm_start_zero_miss(tmp_path):
     labels = {e["label"] for e in entries}
     assert {"llm.prefill", "llm.decode"} <= labels
     assert all(e.get("key") for e in entries)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12 satellites: end-to-end deadlines, cancellation, close-with-queued
+# ---------------------------------------------------------------------------
+def test_deadline_retires_expired_lane_mid_decode():
+    """A request whose deadline passes *inside* the running decode
+    window is retired (blocks freed, lane reused) instead of streamed
+    to a client that already gave up — and the typed error carries
+    elapsed vs budget."""
+    from mxnet_tpu.resilience import chaos
+    from mxnet_tpu.serving.admission import DeadlineExceeded
+
+    net = _tiny_lm()
+    eng = _engine(net, step_hook=lambda: chaos.site("test.llm.tick"))
+    try:
+        eng.warmup(prompt_lengths=[4])
+        # ~60 ms per scheduler tick: 25 tokens needs ~1.5 s, far past
+        # the 400 ms budget — but admission + prefill fit inside it
+        with chaos.scope("test.llm.tick", delay=0.06):
+            h = eng.submit([1, 2, 3, 4], 25, timeout_ms=400)
+            with pytest.raises(DeadlineExceeded) as ei:
+                h.wait(timeout=120)
+        e = ei.value
+        assert e.budget_s is not None and abs(e.budget_s - 0.4) < 0.01
+        assert e.elapsed_s is not None and e.elapsed_s >= e.budget_s
+        assert "mid-decode" in str(e)
+        assert 0 < len(h.tokens) < 25          # partial work, retired
+        assert eng.metrics.counters()["retired_deadline"] == 1
+        # the lane and its blocks came back: the engine keeps serving
+        assert len(eng.generate([5, 6], 3, timeout_ms=None)) == 3
+        assert len(eng._free) == eng.num_blocks
+    finally:
+        eng.close()
+
+
+def test_cancel_retires_lane_and_frees_blocks():
+    from mxnet_tpu.resilience import chaos
+    from mxnet_tpu.serving.admission import RequestCancelled
+
+    net = _tiny_lm()
+    eng = _engine(net, step_hook=lambda: chaos.site("test.llm.tick2"))
+    try:
+        eng.warmup(prompt_lengths=[4])
+        with chaos.scope("test.llm.tick2", delay=0.05):
+            h = eng.submit([1, 2, 3, 4], 25, timeout_ms=None)
+            time.sleep(0.3)                    # provably mid-decode
+            h.cancel()
+            with pytest.raises(RequestCancelled):
+                h.wait(timeout=120)
+        assert eng.metrics.counters()["cancelled"] == 1
+        assert len(eng._free) == eng.num_blocks
+        assert len(eng.generate([5, 6], 3, timeout_ms=None)) == 3
+    finally:
+        eng.close()
+
+
+def test_close_with_queued_requests_fails_typed_not_hangs():
+    """ISSUE 12 satellite: ``close()`` with requests still sitting in
+    the admission queue must fail them typed — a queued ``wait()``
+    must never hang, whether the close drains, the scheduler is
+    wedged past the close timeout, or drain is refused."""
+    from mxnet_tpu.resilience import chaos
+
+    # (1) drain=False: queued requests fail typed immediately
+    net = _tiny_lm()
+    eng = _engine(net, step_hook=lambda: chaos.site("test.llm.wedge"))
+    eng.warmup(prompt_lengths=[4])
+    with chaos.scope("test.llm.wedge", delay=2.0, times=1):
+        time.sleep(0.1)                  # the scheduler enters the wedge
+        hs = [eng.submit([1, 2, 3], 4) for _ in range(3)]
+        eng.close(drain=False, timeout_s=0.2)
+    for h in hs:
+        with pytest.raises(ServerOverload):
+            h.wait(timeout=10)
+
+    # (2) drain=True with the scheduler wedged past the close budget:
+    # whatever is still queued fails typed instead of hanging
+    eng2 = _engine(net, step_hook=lambda: chaos.site("test.llm.wedge2"))
+    eng2.warmup(prompt_lengths=[4])
+    with chaos.scope("test.llm.wedge2", delay=3.0, times=1):
+        time.sleep(0.1)
+        hs2 = [eng2.submit([1, 2, 3], 4) for _ in range(3)]
+        t0 = time.monotonic()
+        eng2.close(drain=True, timeout_s=0.3)
+        assert time.monotonic() - t0 < 2.0
+        for h in hs2:
+            with pytest.raises(ServerOverload):
+                h.wait(timeout=10)

@@ -844,3 +844,34 @@ def test_chaos_bench_smoke(tmp_path):
     names = {r["metric"] for r in payload["records"]}
     assert "chaos_site_disarmed_ns" in names
     assert "chaos_recovery_overhead_pct" in names
+
+
+def test_retry_policy_injectable_rng_pins_exact_schedule():
+    """ISSUE 12 satellite: the jitter source is injectable, so drills
+    pin backoff SEQUENCES exactly (seed= reseeds per delays() call,
+    which still interleaves nondeterministically when several loops
+    share one policy object)."""
+    import itertools
+
+    # rng=lambda: 0.0 -> jitter factor exactly 1.0 -> the pure envelope
+    sleeps = []
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 4:
+            raise TransientError("flap")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=4, base_delay_s=0.1, multiplier=2.0,
+                      max_delay_s=10.0, jitter=0.5, rng=lambda: 0.0,
+                      sleep=sleeps.append)
+    assert call_with_retry(flaky, policy=pol) == "ok"
+    assert sleeps == [0.1, 0.2, 0.4]     # exact, no jitter noise
+
+    # any fixed sequence works too, and wins over seed=
+    seq = itertools.cycle([0.0, 1.0]).__next__
+    pol2 = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=10.0,
+                       jitter=0.5, rng=seq, seed=123)
+    got = [d for d, _ in zip(pol2.delays(), range(3))]
+    assert got == [0.1, 0.1, 0.4]        # factors 1.0, 0.5, 1.0

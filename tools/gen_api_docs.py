@@ -80,6 +80,10 @@ MODULES = {
                                     "rank-loss detection, mesh "
                                     "auto-degrade resume",
     "mxnet_tpu.serving": "dynamic-batching inference serving engine",
+    "mxnet_tpu.serving.fleet": "serving fleet fault domain: "
+                               "health-checked replica router, hedged "
+                               "retries, circuit breakers, tenant-fair "
+                               "shedding, drain/restart lifecycle",
     "mxnet_tpu.serving.llm": "continuous-batching LLM serving: paged "
                              "KV block pool, prefill/decode split, "
                              "in-flight admission, speculative decode, "
