@@ -825,6 +825,33 @@ def capture_fleet() -> None:
             f"{rec.get('img_s')} img/s infer fleet")
 
 
+GSPMD = os.path.join(HERE, "results_gspmd_tpu.json")
+
+
+def capture_gspmd() -> None:
+    """Pod-scale GSPMD mesh-runtime row (ISSUE 13,
+    benchmark/gspmd_bench.py): rule-tree-sharded train-step scaling
+    efficiency + global-array shard-save/reshard-restore walls on the
+    real TPU mesh. The CPU proxy (results_gspmd_cpu.json, virtual-8
+    mesh) banked ≥0.90 weak-scaling; this is the SNIPPETS PR-1 brief's
+    hardware row — on a single-chip window the mesh is 1 device and
+    the scaling stage degenerates, so the row is only banked when the
+    tunnel hands us ≥2 chips (the bench asserts its mesh width)."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "gspmd_bench.py"),
+         "--device", "tpu"],
+        timeout=1800)
+    rec = parse_json_output(out)
+    if bank_if_tpu(GSPMD, rec, rc, "gspmd bench") and rec:
+        s = rec.get("scaling", {})
+        c = rec.get("ckpt", {})
+        log(f"gspmd: efficiency {rec.get('value')} "
+            f"(t1 {s.get('t1_ms')} ms -> tN {s.get('t8_ms')} ms), "
+            f"shard save {c.get('shard_save_wall_ms')} ms vs mono "
+            f"{c.get('monolithic_save_wall_ms')} ms, reshard-restore "
+            f"{c.get('reshard_restore_wall_ms')} ms")
+
+
 def capture_infer_table() -> None:
     """Per-model inference table over the reference's FULL published
     perf.md rows (resnet50/resnet152/inception_v3/vgg16/alexnet, bf16 +
@@ -1298,6 +1325,7 @@ CAPTURES = (
     ("aot", banked_stale(AOT), capture_aot),
     ("opt", banked_stale(OPT), capture_opt),
     ("fleet", banked_stale(FLEET), capture_fleet),
+    ("gspmd", banked_stale(GSPMD), capture_gspmd),
     ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION, 4 * 3600), capture_attention),

@@ -80,6 +80,11 @@ class TunedConfig:
     knob_signature: List = field(default_factory=list)
     created_unix: float = 0.0
     candidates: List[Dict] = field(default_factory=list)
+    #: resolved mesh axis map at tune time ({"dp": 8, ...}; None =
+    #: tuned off-mesh). Part of the config identity: a knob verdict
+    #: probed at dp=8 says nothing about dp=256 — collective shapes,
+    #: per-device batch and launch overheads all change with the mesh.
+    mesh_axes: Optional[Dict[str, int]] = None
 
     # -- persistence ------------------------------------------------------
     def filename(self) -> str:
@@ -104,7 +109,8 @@ class TunedConfig:
             "label", "key", "knobs", "predicted_ms", "measured_ms",
             "baseline_ms", "probes", "tune_spend_s", "backend",
             "device_kind", "jax_version", "jaxlib_version",
-            "knob_signature", "created_unix", "candidates")}
+            "knob_signature", "created_unix", "candidates",
+            "mesh_axes")}
 
     @classmethod
     def from_dict(cls, d: dict) -> "TunedConfig":
@@ -112,7 +118,8 @@ class TunedConfig:
             "label", "key", "knobs", "predicted_ms", "measured_ms",
             "baseline_ms", "probes", "tune_spend_s", "backend",
             "device_kind", "jax_version", "jaxlib_version",
-            "knob_signature", "created_unix", "candidates")
+            "knob_signature", "created_unix", "candidates",
+            "mesh_axes")
             if d.get(k) is not None} | {"label": d["label"],
                                         "key": d["key"],
                                         "knobs": d["knobs"]})
@@ -135,7 +142,23 @@ class TunedConfig:
             live = [[k, v] for k, v in knob_signature()]
             if [list(p) for p in self.knob_signature] != live:
                 return False
+        if self._live_mesh_axes() != (
+                dict(self.mesh_axes) if self.mesh_axes else None):
+            # tuned under one mesh, consumed under another (or tuned
+            # off-mesh, consumed on one): stale — a dp=8 verdict must
+            # never be applied at dp=256
+            return False
         return True
+
+    @staticmethod
+    def _live_mesh_axes() -> Optional[Dict[str, int]]:
+        try:
+            from ...parallel.sharding import mesh_topology
+
+            topo = mesh_topology()
+        except Exception:  # noqa: BLE001
+            return None
+        return dict(topo["axes"]) if topo else None
 
     def provenance(self) -> dict:
         """The compact dict bench rows embed (tuned-config provenance
@@ -384,6 +407,7 @@ def autotune(builder: Callable[..., Tuple[Callable, tuple]], *,
         knob_signature=[list(p) for p in knob_signature()],
         created_unix=time.time(),
         candidates=results,
+        mesh_axes=TunedConfig._live_mesh_axes(),
     )
     if log:
         log(f"autotune[{label}]: chose {_knob_id(cfg.knobs)} "

@@ -160,6 +160,15 @@ def jaxlib_version() -> str:
 _backend_memo: Optional[Dict[str, Any]] = None
 
 
+def reset_backend_memo() -> None:
+    """Forget the memoized backend probe — required after anything that
+    rebuilds the XLA client (``parallel.dist`` re-initialization with a
+    changed world size clears the backends; the stale memo would keep
+    fingerprinting against the old device count)."""
+    global _backend_memo
+    _backend_memo = None
+
+
 def _backend_components() -> Dict[str, Any]:
     # the device probe (jax.devices + per-device attrs) is memoized —
     # this runs on the per-call dispatch path (CachedJit._sig) and a
@@ -197,6 +206,35 @@ def _aval_of(x):
         import jax.numpy as jnp
 
         return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+def _mesh_sig():
+    """Cheap per-dispatch mesh identity for :meth:`CachedJit._sig` —
+    axis names + sizes of the active mesh (no device iteration; this
+    runs per served batch / train step). A mid-process mesh change must
+    re-resolve, exactly like a knob flip."""
+    try:
+        from ..parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+    except Exception:  # noqa: BLE001
+        return None
+    if mesh is None:
+        return None
+    return tuple(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def _mesh_component() -> Optional[Dict[str, Any]]:
+    """Topology of the active mesh (axis names/sizes, device kinds),
+    or None off-mesh. Part of every fingerprint: an executable compiled
+    for one GSPMD mesh must never be served to another — same jaxpr,
+    same avals, completely different partitioning and collectives."""
+    try:
+        from ..parallel.sharding import mesh_topology
+
+        return mesh_topology()
+    except Exception:  # noqa: BLE001 — fingerprinting must never fail
+        return None
 
 
 def _avals_components(args) -> Dict[str, Any]:
@@ -239,6 +277,7 @@ def fingerprint(fn: Callable, args, *, label: str,
         "matmul_precision": str(getattr(
             jax.config, "jax_default_matmul_precision", None)),
         "knobs": dict(knob_signature()),
+        "mesh": _mesh_component(),
         "extra": list(extra),
     }
     components.update(_backend_components())
@@ -610,12 +649,22 @@ class CachedJit:
 
     def __init__(self, fn: Callable, *, label: str,
                  donate_argnums=(), cache: Any = "default",
-                 static_key=()):
+                 static_key=(), in_shardings=None, out_shardings=None):
         self._fn = fn
         self._label = label
         self._donate = tuple(sorted(int(i) for i in donate_argnums))
         self._cache_arg = cache
         self._static = tuple(static_key)
+        # GSPMD seam: sharding trees ride every jax.jit call AND the
+        # fingerprint (their string form names mesh axes + specs), so a
+        # rule-tree change — like a mesh change — lands on a new key
+        self._jit_kwargs: Dict[str, Any] = {}
+        if in_shardings is not None:
+            self._jit_kwargs["in_shardings"] = in_shardings
+            self._static += (("in_shardings", str(in_shardings)),)
+        if out_shardings is not None:
+            self._jit_kwargs["out_shardings"] = out_shardings
+            self._static += (("out_shardings", str(out_shardings)),)
         self._execs: Dict[Tuple, Callable] = {}
         self._keys: Dict[Tuple, Optional[str]] = {}
         self._plain: Optional[Callable] = None
@@ -649,7 +698,7 @@ class CachedJit:
             avals.append((tuple(shape), str(dtype),
                           bool(getattr(a, "weak_type", False))))
         return (tuple(avals), treedef, knob_signature(),
-                _backend_components()["backend"])
+                _backend_components()["backend"], _mesh_sig())
 
     def resolved_key(self, *args) -> Optional[str]:
         """The store key the given signature resolved to (None before
@@ -678,7 +727,8 @@ class CachedJit:
                 with self._lock:
                     if self._plain is None:
                         self._plain = jax.jit(
-                            self._fn, donate_argnums=self._donate)
+                            self._fn, donate_argnums=self._donate,
+                            **self._jit_kwargs)
                     ex = self._plain
             return ex(*args)
         sig = self._sig(args)
@@ -704,7 +754,8 @@ class CachedJit:
                     return "warm"
                 if self._plain is None:
                     self._plain = jax.jit(
-                        self._fn, donate_argnums=self._donate)
+                        self._fn, donate_argnums=self._donate,
+                        **self._jit_kwargs)
                 # compile eagerly AND keep the Compiled: lower().compile()
                 # does not populate jit's dispatch cache, so discarding
                 # it would make the first real call pay the whole
@@ -757,7 +808,8 @@ class CachedJit:
 
     def _compile_and_publish(self, cache: CompileCache, key: str,
                              components: Dict, args) -> Callable:
-        jitted = jax.jit(self._fn, donate_argnums=self._donate)
+        jitted = jax.jit(self._fn, donate_argnums=self._donate,
+                         **self._jit_kwargs)
         try:
             from jax import export as jax_export
 
@@ -811,7 +863,8 @@ class CachedJit:
 
 
 def cached_jit(fn: Callable, *, label: str, donate_argnums=(),
-               cache: Any = "default", static_key=()) -> CachedJit:
+               cache: Any = "default", static_key=(),
+               in_shardings=None, out_shardings=None) -> CachedJit:
     """``jax.jit`` with the persistent AOT store behind it.
 
     Drop-in at a compile seam: ``cached_jit(fn, label="trainer.step",
@@ -820,6 +873,12 @@ def cached_jit(fn: Callable, *, label: str, donate_argnums=(),
     after — or behaves exactly like ``jax.jit`` when no store is
     configured. ``static_key`` folds extra caller context into the
     fingerprint; ``cache=`` pins an explicit :class:`CompileCache`.
+    ``in_shardings``/``out_shardings`` (GSPMD sharding trees) ride
+    every underlying ``jax.jit`` and are folded into the fingerprint
+    alongside the active mesh topology, so a mesh or rule-tree change
+    never serves a stale executable.
     """
     return CachedJit(fn, label=label, donate_argnums=donate_argnums,
-                     cache=cache, static_key=static_key)
+                     cache=cache, static_key=static_key,
+                     in_shardings=in_shardings,
+                     out_shardings=out_shardings)

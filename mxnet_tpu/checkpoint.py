@@ -354,6 +354,81 @@ def _match_shard_axis(key: str, rules: Sequence[Tuple[str, int]]):
     return None
 
 
+def _place_tree(tree: Any, shardings: Any) -> Any:
+    """device_put ``tree``'s leaves per a congruent ``shardings`` pytree
+    (leaf = ``jax.sharding.Sharding``; ``None`` at any position leaves
+    that leaf/subtree on the host). Shardings lead the traversal so a
+    ``None`` can stand in for whole subtrees."""
+
+    def place(s, sub):
+        if s is None:
+            return sub
+        return jax.device_put(sub, s)
+
+    return jax.tree_util.tree_map(place, shardings, tree,
+                                  is_leaf=lambda x: x is None)
+
+
+def _is_global_sharded(v) -> bool:
+    """A GSPMD-sharded global ``jax.Array``: device-sharded (not fully
+    replicated) over >1 device. These leaves cannot be staged with one
+    host ``asarray`` on a pod — a rank only holds its addressable
+    shards — so they take the index-based shard-manifest path."""
+    try:
+        sharding = getattr(v, "sharding", None)
+        if sharding is None or not hasattr(v, "addressable_shards"):
+            return False
+        if getattr(sharding, "is_fully_replicated", True):
+            return False
+        return len(getattr(sharding, "device_set", ())) > 1
+    except Exception:  # noqa: BLE001 — non-jax leaf
+        return False
+
+
+def _index_to_json(index, shape) -> List[List[int]]:
+    """A shard's global index (tuple of slices) as ``[[start, stop],
+    ...]`` per dim — the manifest form (json-stable, mesh-agnostic)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _boxes_cover(shape, boxes) -> bool:
+    """Exact test: does the union of half-open boxes ``[[a, b], …]``
+    (one pair per dim) cover the full index space of ``shape``?
+    Coordinate compression over the boundaries actually present — a
+    volume SUM would both reject valid overlapping tilings
+    (heterogeneous local meshes writing e.g. ``[0,4]``/``[4,8]`` next
+    to ``[0,8]``) and accept an overlap that happens to equal a hole.
+    Shards tile one or two axes in practice, so the cell grid stays
+    tiny even on a heterogeneous pod."""
+    if not shape:
+        return bool(boxes)  # 0-d: any shard covers the one element
+    import bisect
+
+    ndim = len(shape)
+    bounds = []
+    for d in range(ndim):
+        bs = {0, int(shape[d])}
+        for box in boxes:
+            bs.add(min(int(shape[d]), max(0, int(box[d][0]))))
+            bs.add(min(int(shape[d]), max(0, int(box[d][1]))))
+        bounds.append(sorted(bs))
+    covered = onp.zeros(tuple(len(b) - 1 for b in bounds), dtype=bool)
+    for box in boxes:
+        sl = tuple(slice(
+            bisect.bisect_left(bounds[d],
+                               min(int(shape[d]), max(0, int(box[d][0])))),
+            bisect.bisect_left(bounds[d],
+                               min(int(shape[d]), max(0, int(box[d][1])))))
+            for d in range(ndim))
+        covered[sl] = True
+    return bool(covered.all())
+
+
 def _sha256_file(path: str) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -451,6 +526,34 @@ class CoordinatedCheckpointManager:
         payload, leaves = {}, {}
         for path, v in flat:
             key = jax.tree_util.keystr(path)
+            if _is_global_sharded(v):
+                # GSPMD global-array leaf: this rank stages only the
+                # addressable shards it owns (deduped by global index —
+                # replication over some mesh axes puts the same index
+                # on several devices), each as its own npz entry; the
+                # shard manifest records index → entry so restore can
+                # reassemble the global value from EVERY rank's shards
+                # and re-shard it for the current mesh. A host gather
+                # here would be wrong twice on a pod: it cannot see
+                # non-addressable shards, and it would concentrate the
+                # whole array on one host.
+                shards, seen = [], set()
+                for j, s in enumerate(v.addressable_shards):
+                    idx = _index_to_json(s.index, v.shape)
+                    tkey = tuple(map(tuple, idx))
+                    if tkey in seen:
+                        continue
+                    seen.add(tkey)
+                    entry = f"{key}#g{len(shards)}"
+                    payload[entry] = onp.asarray(s.data, order="C")
+                    shards.append({"entry": entry, "index": idx})
+                leaves[key] = {
+                    "shape": list(v.shape),
+                    "dtype": str(v.dtype),
+                    "axis": None,
+                    "global": {"shards": shards},
+                }
+                continue
             # NOT ascontiguousarray: that promotes 0-d scalars to 1-d,
             # and the npz round-trip must preserve leaf shapes exactly
             arr = onp.asarray(v, order="C")
@@ -624,13 +727,16 @@ class CoordinatedCheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def _load_step(self, step: int, like: Optional[Any]) -> Tuple[Any, Dict]:
+    def _load_step(self, step: int, like: Optional[Any],
+                   shardings: Optional[Any] = None) -> Tuple[Any, Dict]:
         final = self._step_dir(step)
         with open(os.path.join(final, self._MANIFEST)) as f:
             manifest = json.load(f)
         world_saved = int(manifest["world"])
         shards: Dict[int, Dict[str, onp.ndarray]] = {}
         axes: Dict[str, Optional[int]] = {}
+        global_recs: Dict[str, Dict] = {}
+        global_parts: Dict[str, List[Tuple[int, Tuple, str]]] = {}
         for rec in manifest["shards"]:
             npz = os.path.join(final, rec["file"])
             if _sha256_file(npz) != rec["sha256"]:
@@ -643,7 +749,15 @@ class CoordinatedCheckpointManager:
                     final, self._shard_manifest(int(rec["rank"])))) as f:
                 sm = json.load(f)
             for key, leaf in sm["leaves"].items():
-                axes[key] = leaf["axis"]
+                if leaf.get("global"):
+                    global_recs[key] = leaf
+                    for srec in leaf["global"]["shards"]:
+                        global_parts.setdefault(key, []).append(
+                            (int(rec["rank"]),
+                             tuple(map(tuple, srec["index"])),
+                             srec["entry"]))
+                else:
+                    axes[key] = leaf["axis"]
         if len(shards) != world_saved:
             raise CheckpointCorruption(
                 f"coordinated step {step}: manifest lists "
@@ -660,33 +774,80 @@ class CoordinatedCheckpointManager:
                     shard_slice(full.shape[axis], self.world, self.rank)
                     if d == axis else slice(None)
                     for d in range(full.ndim))]
-        info = {"step": step, "world_saved": world_saved,
-                "meta": manifest.get("meta", {})}
-        if like is None:
-            return out, info
-        flat, treedef = jax.tree_util.tree_flatten_with_path(
-            _to_jax_tree(like))
-        leaves = []
-        for path, _ in flat:
-            key = jax.tree_util.keystr(path)
-            if key not in out:
+        # GSPMD global-array leaves: index-addressed reassembly from
+        # the union of every rank's addressable shards (ranks holding
+        # the same index — replication over mesh axes or overlapping
+        # local meshes — dedupe; full coverage is REQUIRED, a hole
+        # means a rank's view of the mesh never owned those rows)
+        for key, leaf in global_recs.items():
+            shape = tuple(int(d) for d in leaf["shape"])
+            full = onp.empty(shape, dtype=leaf["dtype"])
+            seen: Dict[Tuple, int] = {}
+            for rank_id, idx, entry in global_parts[key]:
+                if idx in seen:
+                    continue
+                seen[idx] = rank_id
+                sl = tuple(slice(a, b) for a, b in idx)
+                try:
+                    part = shards[rank_id][entry]
+                except KeyError:
+                    raise CheckpointCorruption(
+                        f"coordinated step {step}: global leaf {key} "
+                        f"shard entry {entry!r} missing from rank "
+                        f"{rank_id}'s payload") from None
+                full[sl] = part
+            # exact union coverage (NOT a volume sum: ranks saved under
+            # different local tilings may write overlapping,
+            # non-identical boxes — still complete; and an overlap can
+            # mask a same-size hole, which would hand back onp.empty
+            # garbage as weights)
+            if not _boxes_cover(shape, list(seen)):
                 raise CheckpointCorruption(
-                    f"coordinated step {step}: leaf {key} in like= tree "
-                    "but missing from the checkpoint")
-            leaves.append(out[key])
-        return jax.tree_util.tree_unflatten(treedef, leaves), info
+                    f"coordinated step {step}: global leaf {key} has "
+                    f"incomplete shard coverage ({len(seen)} shard "
+                    f"boxes over shape {shape}) — a rank's shards are "
+                    "missing from the manifest")
+            out[key] = full
+        info = {"step": step, "world_saved": world_saved,
+                "meta": manifest.get("meta", {}),
+                "global_leaves": sorted(global_recs)}
+        if like is None:
+            tree = out
+        else:
+            flat, treedef = jax.tree_util.tree_flatten_with_path(
+                _to_jax_tree(like))
+            leaves = []
+            for path, _ in flat:
+                key = jax.tree_util.keystr(path)
+                if key not in out:
+                    raise CheckpointCorruption(
+                        f"coordinated step {step}: leaf {key} in like= "
+                        "tree but missing from the checkpoint")
+                leaves.append(out[key])
+            tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        if shardings is not None:
+            tree = _place_tree(tree, shardings)
+        return tree, info
 
     def restore(self, step: Optional[int] = None,
-                like: Optional[Any] = None) -> Tuple[Any, Dict]:
+                like: Optional[Any] = None,
+                shardings: Optional[Any] = None) -> Tuple[Any, Dict]:
         """Restore the latest published step (or a pinned ``step``),
         resharded for THIS manager's (rank, world). Returns ``(tree,
-        info)`` with ``info = {step, world_saved, meta}``.
+        info)`` with ``info = {step, world_saved, meta,
+        global_leaves}``.
 
         Latest-step path: a step that fails verification falls back to
         the previous published step with a loud warning (the
         single-process corrupt-step discipline); a pinned ``step`` never
         substitutes silently. ``like=`` rebuilds the result into the
-        given pytree structure (leaves matched by keypath)."""
+        given pytree structure (leaves matched by keypath).
+        ``shardings=`` — an optional pytree congruent to the result
+        (leaves: ``jax.sharding.Sharding`` or None) that device_puts
+        each restored leaf onto the CURRENT mesh as it loads:
+        reshard-on-load for GSPMD global-array leaves, which are
+        reassembled from every saved rank's index-addressed shards
+        regardless of what mesh (or world size) wrote them."""
         steps = self.all_steps()
         if not steps:
             raise MXNetError(f"no coordinated checkpoints in {self._dir}")
@@ -702,7 +863,7 @@ class CoordinatedCheckpointManager:
         errors = []
         for s in candidates:
             try:
-                return self._load_step(s, like)
+                return self._load_step(s, like, shardings)
             except Exception as e:  # noqa: BLE001 — fall back, loudly
                 errors.append((s, e))
                 if step is None:

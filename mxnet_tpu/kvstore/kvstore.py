@@ -30,6 +30,16 @@ from .gradient_compression import GradientCompression
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPU"]
 
 
+def _guard_root() -> Optional[str]:
+    """``MXNET_TPU_MESH_GUARD``: heartbeat root arming
+    :func:`~mxnet_tpu.resilience.elastic.guard_collective` around the
+    multi-host kvstore reduction (and ``parallel.composed`` steps).
+    Unset = unguarded (single-host default; zero overhead)."""
+    import os
+
+    return os.environ.get("MXNET_TPU_MESH_GUARD") or None
+
+
 def _sum_values(vals):
     from ..ndarray.sparse import RowSparseNDArray
 
@@ -221,7 +231,23 @@ class KVStoreTPU(KVStoreLocal):
             # DCN all-reduce across processes (jax collective over hosts)
             from jax.experimental import multihost_utils
 
-            agg = multihost_utils.process_allgather(agg).sum(axis=0)
+            def _dcn_reduce():
+                return multihost_utils.process_allgather(agg).sum(axis=0)
+
+            root = _guard_root()
+            if root:
+                # MXNET_TPU_MESH_GUARD armed: a dead peer turns this
+                # call into typed RankLost (stale heartbeat) or
+                # ClusterDegraded (straggler) within the collective
+                # deadline, instead of an indefinite DCN hang the
+                # elastic layer can never see
+                from ..resilience.elastic import guard_collective
+
+                agg = guard_collective(
+                    _dcn_reduce, heartbeat_root=root,
+                    name=f"kvstore.pushpull:{k}")
+            else:
+                agg = _dcn_reduce()
         return agg
 
 

@@ -5,7 +5,7 @@ parameter server, NCCL — ``src/kvstore/``) with named-axis XLA collectives,
 and adds the strategies the reference lacked: tensor, pipeline, sequence
 (ring attention) and expert parallelism (SURVEY.md §2.3 implication).
 """
-from . import collectives, dist, mesh
+from . import collectives, dist, mesh, sharding
 from .collectives import (
     all_to_all,
     allgather,
@@ -31,6 +31,22 @@ from .mesh import (
     use_mesh,
 )
 from .composed import composed_3d, make_composed_step
+from .sharding import (
+    DATA_PARALLEL_RULES,
+    PartitionRuleError,
+    RESNET_RULES,
+    TRANSFORMER_RULES,
+    gather_tree,
+    make_gather_fns,
+    make_shard_fns,
+    match_partition_rules,
+    mesh_from_env,
+    mesh_topology,
+    shard_constraint,
+    shard_tree,
+    state_partition_specs,
+    tree_shardings,
+)
 from .moe import MoE, moe_ffn, switch_routing
 from .pipeline import gpipe, pipeline_apply, stack_stage_params
 from .ring_attention import (

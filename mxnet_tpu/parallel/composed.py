@@ -88,13 +88,20 @@ def _init_stages(n_stages, units, hidden, rng):
 
 
 def make_composed_step(mesh, batch=4, seqlen=8, units=8, heads=2,
-                       hidden=16, n_micro=2, lr=0.1, seed=0):
+                       hidden=16, n_micro=2, lr=0.1, seed=0,
+                       guard_root=None):
     """Build the composed train step over ``mesh`` (axes dp/pp/tp).
 
     Returns ``(step, stacked, x, y, oracle_loss)``: ``step(stacked, x, y)
     -> (new_stacked, loss)`` is jitted over the mesh with the full 3-axis
     shardings; ``oracle_loss`` is the same loss from an unsharded
     sequential forward — the parity target.
+
+    ``guard_root`` (or ambient ``MXNET_TPU_MESH_GUARD``) arms
+    :func:`~mxnet_tpu.resilience.elastic.guard_collective` around every
+    step call: on a multi-host mesh a dead peer inside the step's
+    collectives surfaces as typed ``RankLost``/``ClusterDegraded``
+    within the collective deadline instead of hanging the pod.
     """
     dp, pp, tp = (mesh.shape[a] for a in ("dp", "pp", "tp"))
     if batch % (n_micro * dp) or seqlen % tp or hidden % tp:
@@ -125,6 +132,20 @@ def make_composed_step(mesh, batch=4, seqlen=8, units=8, heads=2,
         return {k: stacked_p[k] - lr * grads[k] for k in stacked_p}, loss
 
     step = jax.jit(train_step, donate_argnums=(0,))
+
+    if guard_root is None:
+        import os
+
+        guard_root = os.environ.get("MXNET_TPU_MESH_GUARD") or None
+    if guard_root is not None:
+        from ..resilience.elastic import guard_collective
+
+        jitted = step
+
+        def step(stacked_p, xb, yb):  # noqa: F811 — the guarded entry
+            return guard_collective(
+                jitted, stacked_p, xb, yb, heartbeat_root=guard_root,
+                name="parallel.composed.step")
 
     def oracle_loss():
         h = jnp.asarray(x)

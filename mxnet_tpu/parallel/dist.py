@@ -165,6 +165,17 @@ def device_count() -> int:
 
 
 def shutdown():
+    """Leave the cluster and reset the spec tracking so a following
+    :func:`initialize` may join a DIFFERENT cluster shape.
+
+    For a multi-process cluster this also tears the XLA backends down
+    (``jax.clear_backends``): the CPU/TPU clients bake the process
+    count and the global device list in at construction, so a
+    re-rendezvous at a changed world size against the old client would
+    see the old cluster's devices — the stale-mesh bug the elastic
+    rejoin path would otherwise hit. The AOT fingerprint's memoized
+    backend probe is reset on the same edge (device counts are part of
+    every cache key)."""
     global _initialized, _spec
     if not _initialized:
         # calling jax.process_count() would itself initialize the XLA
@@ -176,5 +187,31 @@ def shutdown():
             jax.distributed.shutdown()
         except Exception:
             pass
+        _clear_backends()
     _initialized = False
     _spec = None
+
+
+def _clear_backends() -> None:
+    """Drop the live XLA clients (best-effort across jax versions) and
+    the AOT backend memo, so the next backend touch rebuilds against
+    the CURRENT cluster spec."""
+    for attr in ("clear_backends",):
+        fn = getattr(jax, attr, None)
+        if fn is None:
+            continue
+        try:
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # deprecated in new jax
+                fn()
+            break
+        except Exception:  # pragma: no cover — newer jax layouts
+            continue
+    try:
+        from ..aot.cache import reset_backend_memo
+
+        reset_backend_memo()
+    except Exception:  # pragma: no cover
+        pass

@@ -62,7 +62,7 @@ __all__ = [
     "RankLost", "ClusterDegraded", "Heartbeat", "ElasticCluster",
     "ElasticSupervisor", "guard_collective", "current_generation",
     "heartbeat_period_s", "collective_deadline_s", "elastic_mode",
-    "sweep_rendezvous_root",
+    "sweep_rendezvous_root", "rejoin_enabled", "rejoin_poll_s",
 ]
 
 
@@ -74,6 +74,24 @@ def heartbeat_period_s() -> float:
 def collective_deadline_s() -> float:
     """``MXNET_TPU_COLLECTIVE_DEADLINE_S`` (default 30 s)."""
     return env_float("MXNET_TPU_COLLECTIVE_DEADLINE_S", 30.0)
+
+
+def rejoin_enabled() -> bool:
+    """``MXNET_TPU_MESH_REJOIN`` (default off): arm spare
+    re-activation — the degrade path's inverse. When on, a spare (or a
+    restarted rank) signals capacity via a rejoin file, active ranks
+    agree at the next coordinated-save boundary (one extra bounded
+    collective per save) and re-rendezvous at the next generation with
+    the rejoiner aboard; the mesh grows back toward its original shape
+    and the global arrays reshard onto the wider membership."""
+    return env_str("MXNET_TPU_MESH_REJOIN", "0").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def rejoin_poll_s() -> float:
+    """``MXNET_TPU_MESH_REJOIN_POLL_S`` (default 0.1 s): how often a
+    waiting spare re-checks for a membership that includes it."""
+    return env_float("MXNET_TPU_MESH_REJOIN_POLL_S", 0.1)
 
 
 def elastic_mode() -> str:
@@ -117,6 +135,13 @@ def _metrics() -> Dict[str, Any]:
         "rank_lost": reg.counter(
             "elastic_rank_lost_total", "rank-loss detections, by lost rank",
             labels=("rank",)),
+        "grows": reg.counter(
+            "elastic_grows_total",
+            "mesh grow events (spare/rejoiner re-activated into the "
+            "membership — the degrade inverse)"),
+        "rejoins": reg.counter(
+            "elastic_rejoins_total",
+            "this rank's successful re-activations from spare"),
     }
 
 
@@ -322,7 +347,8 @@ class ElasticCluster:
                  stale_after_s: Optional[float] = None,
                  start_deadline_s: float = 60.0,
                  poll_s: float = 0.02,
-                 mode: Optional[str] = None):
+                 mode: Optional[str] = None,
+                 rejoin: Optional[bool] = None):
         if world < 1 or not 0 <= rank < world:
             raise ValueError(f"bad cluster coordinates rank={rank} "
                              f"world={world}")
@@ -330,6 +356,13 @@ class ElasticCluster:
         self.rank = int(rank)
         self.world0 = int(world)
         self.axes = dict(axes or {"dp": int(world)})
+        #: the ORIGINAL mesh shape — memberships are always derived by
+        #: degrading from here, never from the current (possibly
+        #: already-degraded) shape, so a grow back toward full capacity
+        #: is just auto_degrade(axes0, more_survivors)
+        self.axes0 = dict(self.axes)
+        self.rejoin = bool(rejoin if rejoin is not None
+                           else rejoin_enabled())
         self.power_of_two = bool(power_of_two)
         self.deadline = float(deadline_s if deadline_s is not None
                               else collective_deadline_s())
@@ -362,7 +395,16 @@ class ElasticCluster:
     def start(self) -> str:
         """Beat, then rendezvous generation 0 (or ``max published + 1``
         on a root that already has generations — a full-pod restart).
-        Returns the role: ``active`` or ``spare``."""
+        Returns the role: ``active`` or ``spare``.
+
+        With rejoin armed (``MXNET_TPU_MESH_REJOIN`` / ``rejoin=``), a
+        start against a root whose newest membership belongs to a LIVE
+        cohort (other members still heartbeating) that does not include
+        this rank becomes a **rejoin**, not a rendezvous: the rank
+        adopts the membership as a spare and signals capacity — the
+        actives fold it in at their next grow/degrade boundary. Without
+        this, a restarted rank would fork a one-rank cluster at the
+        next generation against the same checkpoint root."""
         # bounded-retention sweep of crashed prior runs' gen_*/heartbeat
         # litter BEFORE beating (our own fresh heartbeat is never stale;
         # the newest published generation survives, so the max+1 restart
@@ -371,6 +413,18 @@ class ElasticCluster:
             self.root, heartbeat_ttl_s=max(60.0, 30.0 * self.hb.period))
         self.hb.start()
         cur = current_generation(self.root)
+        if self.rejoin and cur is not None:
+            m = _read_membership(self.root, cur)
+            if m is not None:
+                members = [int(r) for r in m.get("ranks", [])]
+                ages = Heartbeat.ages(self.root)
+                live = [r for r in members if r != self.rank
+                        and ages.get(r, float("inf")) <= self.stale_s]
+                if live:
+                    role = self._adopt(m)
+                    if role != "active":
+                        self.signal_rejoin()
+                    return role
         target = 0 if cur is None else cur + 1
         return self._join(target, expected=list(range(self.world0)),
                           deadline=self.start_deadline)
@@ -406,7 +460,10 @@ class ElasticCluster:
         from ..parallel import mesh as _mesh
 
         fresh = self._fresh(present)
-        axes, used = _mesh.auto_degrade(self.axes, len(fresh),
+        # degrade from the ORIGINAL shape: when more ranks are present
+        # than the current membership (a rejoiner), the mesh grows back
+        # toward axes0 instead of being capped at the degraded size
+        axes, used = _mesh.auto_degrade(self.axes0, len(fresh),
                                         power_of_two=self.power_of_two)
         membership = {
             "gen": int(gen),
@@ -423,13 +480,9 @@ class ElasticCluster:
         os.replace(tmp, os.path.join(gdir, "membership.json"))
         return membership
 
-    def _join(self, gen: int, expected: Sequence[int],
-              deadline: float) -> str:
-        """Rendezvous at ``gen``: register, then either lead (lowest
-        expected rank present) or follow. Convergence rule: whatever
-        ends up in ``membership.json`` wins — even a leader re-reads
-        after publishing, so racing publishers settle on one file."""
-        expected = sorted(set(int(r) for r in expected) | {self.rank})
+    def _register(self, gen: int) -> str:
+        """Write this rank's member file under ``gen_<gen>/`` (atomic;
+        idempotent). Returns the generation dir."""
         gdir = os.path.join(self.root, f"gen_{gen}")
         os.makedirs(gdir, exist_ok=True)
         me = os.path.join(gdir, f"member_{self.rank}.json")
@@ -438,6 +491,16 @@ class ElasticCluster:
             json.dump({"rank": self.rank, "pid": os.getpid(),
                        "wall": time.time()}, f)
         os.replace(tmp, me)
+        return gdir
+
+    def _join(self, gen: int, expected: Sequence[int],
+              deadline: float) -> str:
+        """Rendezvous at ``gen``: register, then either lead (lowest
+        expected rank present) or follow. Convergence rule: whatever
+        ends up in ``membership.json`` wins — even a leader re-reads
+        after publishing, so racing publishers settle on one file."""
+        expected = sorted(set(int(r) for r in expected) | {self.rank})
+        gdir = self._register(gen)
         t0 = time.monotonic()
         leader = min(expected)
         takeover_after = t0 + max(0.5 * deadline, 4 * self.stale_s)
@@ -499,9 +562,127 @@ class ElasticCluster:
                 return self._adopt(m)
         target = (self.gen if cur is None else max(cur, self.gen)) + 1
         survivors = self._fresh(self.members or range(self.world0))
+        # a pending rejoiner boards any membership change — capacity
+        # returning during a degrade should not wait another generation
+        if self.rejoin:
+            survivors = sorted(set(survivors) | set(self.pending_rejoins()))
         role = self._join(target, expected=survivors,
                           deadline=self.deadline)
         return role
+
+    # -- spare re-activation (the degrade inverse) ------------------------
+    def _rejoin_dir(self) -> str:
+        return os.path.join(self.root, "rejoin")
+
+    def signal_rejoin(self) -> None:
+        """Announce returned capacity: this rank wants (back) into the
+        mesh. Consumed by the actives' next :meth:`grow` vote (or any
+        degrade re-rendezvous); cleared once the rank is a member."""
+        d = self._rejoin_dir()
+        os.makedirs(d, exist_ok=True)
+        p = os.path.join(d, f"rank_{self.rank}.json")
+        tmp = p + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"rank": self.rank, "pid": os.getpid(),
+                       "wall": time.time()}, f)
+        os.replace(tmp, p)
+
+    def pending_rejoins(self) -> List[int]:
+        """Ranks with a rejoin file, a FRESH heartbeat, and no seat in
+        the current membership — the candidates a grow folds in."""
+        d = self._rejoin_dir()
+        if not os.path.isdir(d):
+            return []
+        ages = Heartbeat.ages(self.root)
+        out = []
+        for n in os.listdir(d):
+            if not (n.startswith("rank_") and n.endswith(".json")):
+                continue
+            try:
+                r = int(n[len("rank_"):-len(".json")])
+            except ValueError:
+                continue
+            if r in self.members:
+                self._clear_rejoin(r)  # already seated: stale signal
+                continue
+            if ages.get(r, float("inf")) <= self.stale_s:
+                out.append(r)
+        return sorted(out)
+
+    def _clear_rejoin(self, rank: int) -> None:
+        try:
+            os.unlink(os.path.join(self._rejoin_dir(),
+                                   f"rank_{rank}.json"))
+        except OSError:
+            pass  # a concurrent winner, or never signaled
+
+    def grow(self, pending: Optional[Sequence[int]] = None) -> str:
+        """Re-rendezvous at the next generation with every pending
+        rejoiner aboard — the inverse of :meth:`degrade`. The mesh
+        shape is re-derived from the ORIGINAL axes (``axes0``), so a
+        4→3 degrade followed by the lost rank's return lands back on
+        the 4-wide mesh. All active members must call this at the same
+        logical point with the SAME ``pending`` set (the
+        :class:`ElasticSupervisor` votes one — a union over every
+        active's view — at coordinated-save boundaries; a rank that
+        trusted only its own filesystem view could see an empty rejoin
+        dir its peers already see populated, skip the rendezvous, and
+        be dropped from the membership as if dead); returns the new
+        role."""
+        if pending is None:
+            pending = self.pending_rejoins()
+        pending = [int(r) for r in pending if int(r) not in self.members]
+        if not pending:
+            return "active" if self.is_active else "spare"
+        self._m["grows"].inc()
+        cur = current_generation(self.root)
+        target = (self.gen if cur is None else max(cur, self.gen)) + 1
+        expected = sorted(set(self.members) | set(pending) | {self.rank})
+        role = self._join(target, expected=expected,
+                          deadline=self.deadline)
+        for r in list(self.members):
+            self._clear_rejoin(r)
+        return role
+
+    def await_reactivation(self, deadline_s: float,
+                           poll_s: Optional[float] = None) -> str:
+        """Spare side of :meth:`grow`: signal capacity, then wait
+        (bounded) for a membership that includes this rank —
+        registering a member file in any newer rendezvous the actives
+        open, but NEVER leading or publishing (a spare that published
+        would fork a one-rank cluster). Returns ``active`` once seated,
+        ``spare`` on deadline."""
+        poll = float(poll_s if poll_s is not None else rejoin_poll_s())
+        self.signal_rejoin()
+        t0 = time.monotonic()
+        registered = set()
+        while True:
+            newest = current_generation(self.root)
+            if newest is not None and newest > self.gen:
+                m = _read_membership(self.root, newest)
+                if m is not None:
+                    role = self._adopt(m)
+                    if role == "active":
+                        self._clear_rejoin(self.rank)
+                        self._m["rejoins"].inc()
+                        return "active"
+                    self.signal_rejoin()  # evicted again: keep waiting
+            # an open (unpublished) rendezvous newer than our adopted
+            # generation: register so the leader's expected-set check
+            # can include us
+            try:
+                for n in os.listdir(self.root):
+                    if not (n.startswith("gen_") and n[4:].isdigit()):
+                        continue
+                    g = int(n[4:])
+                    if g > self.gen and g not in registered:
+                        self._register(g)
+                        registered.add(g)
+            except OSError:
+                pass
+            if time.monotonic() - t0 > deadline_s:
+                return "spare"
+            time.sleep(poll)
 
     # -- bounded collectives ---------------------------------------------
     def _coll_dir(self, seq: int) -> str:
@@ -693,12 +874,21 @@ class ElasticSupervisor(Supervisor):
                  stale_after_s: Optional[float] = None,
                  start_deadline_s: float = 60.0,
                  shard_rules: Sequence[Tuple[str, int]] = (),
-                 mode: Optional[str] = None):
+                 mode: Optional[str] = None,
+                 rejoin: Optional[bool] = None,
+                 spare_reactivate_s: Optional[float] = None):
         self.cluster = ElasticCluster(
             root, rank, world, axes=axes, power_of_two=power_of_two,
             heartbeat_s=heartbeat_s, deadline_s=deadline_s,
             stale_after_s=stale_after_s,
-            start_deadline_s=start_deadline_s, mode=mode)
+            start_deadline_s=start_deadline_s, mode=mode,
+            rejoin=rejoin)
+        #: how long a rank idled into a spare waits for re-activation
+        #: before returning role="spare" (None = exit immediately, the
+        #: pre-rejoin behavior; requires the cluster's rejoin arm)
+        self.spare_reactivate_s = (
+            float(spare_reactivate_s) if spare_reactivate_s is not None
+            else None)
         self.shard_rules = tuple(shard_rules)
         self._root = os.path.abspath(root)
         super().__init__(self._root, policy=policy,
@@ -708,12 +898,19 @@ class ElasticSupervisor(Supervisor):
                          manager=_PENDING)
         self._max_to_keep = int(max_to_keep)
         self._counters["degrades"] = 0
+        self._counters["grows"] = 0
         from .. import profiler
 
         self._prof["degrades"] = profiler.Counter(
             name="resilience.degrades")
+        self._prof["grows"] = profiler.Counter(
+            name="resilience.grows")
         self._role: Optional[str] = None
         self._need_degrade = False
+        #: membership phases this rank stepped under, for drill oracles:
+        #: [{"gen", "members", "cursor"}] — appended at boot and at
+        #: every degrade/grow resume
+        self.history: List[Dict[str, Any]] = []
 
     # -- membership plumbing ---------------------------------------------
     def _ckpt_dir(self) -> str:
@@ -751,10 +948,17 @@ class ElasticSupervisor(Supervisor):
         checkpoints)."""
         role = self.start()
         if role != "active":
-            return self._spare_result()
+            role = self._await_reactivation()
+            if role != "active":
+                return self._spare_result()
         cursor = {"i": 0, "state": init_state}
         last_saved = {"i": -1}
         booted = {"done": False}
+
+        def mark_phase():
+            self.history.append({"gen": self.cluster.gen,
+                                 "members": list(self.cluster.members),
+                                 "cursor": int(cursor["i"])})
 
         def save():
             step = (self.manager.latest_step() or 0) + 1
@@ -793,8 +997,45 @@ class ElasticSupervisor(Supervisor):
                 self._rebuild_manager()
                 restore_state()
                 self._m_recoveries.inc()
+                mark_phase()
                 return
             restore_state()
+
+        def maybe_grow():
+            # the rejoin vote (one bounded collective at each
+            # coordinated-save boundary, armed ranks only): every
+            # active contributes a BITMASK of the rejoin signals IT can
+            # see, and the allreduced union is what every rank hands to
+            # grow() — so the pending set (not just the go/no-go) is
+            # identical across the membership even when the rejoin file
+            # is mid-flight to some ranks' view of the fs. A rank that
+            # passed its own (possibly empty) local view instead would
+            # skip the grow rendezvous and be dropped as if dead.
+            if not self.cluster.rejoin or not self.cluster.is_active:
+                return
+            try:
+                mask = onp.zeros(self.cluster.world0, dtype="int64")
+                for r in self.cluster.pending_rejoins():
+                    if 0 <= r < self.cluster.world0:
+                        mask[r] = 1
+                votes = self.cluster.allreduce_sum(mask,
+                                                   name="rejoin_vote")
+                pending = [r for r in range(self.cluster.world0)
+                           if int(votes[r]) > 0]
+                if not pending:
+                    return
+                self._count("grows")
+                role = self.cluster.grow(pending=pending)
+            except (RankLost, ClusterDegraded):
+                # a peer died inside the vote/grow: same answer as a
+                # lost training collective — degrade at the retry seam
+                self._need_degrade = True
+                raise
+            if role != "active":
+                raise _SpareExit()
+            self._rebuild_manager()
+            restore_state()
+            mark_phase()
 
         def run_once():
             # first entry (and only then): fresh-process resume, or the
@@ -808,6 +1049,7 @@ class ElasticSupervisor(Supervisor):
                 else:
                     restore_state()
                 booted["done"] = True
+                mark_phase()
             while cursor["i"] < n_steps:
                 i = cursor["i"]
                 try:
@@ -820,18 +1062,28 @@ class ElasticSupervisor(Supervisor):
                 self._check_preempted(save)
                 if cursor["i"] % self.save_every == 0:
                     self._coordinated_save(save)
+                    maybe_grow()
             if last_saved["i"] != cursor["i"]:
                 self._coordinated_save(save)
             return dict(role="active", state=cursor["state"],
                         i=cursor["i"], gen=self.cluster.gen,
                         members=list(self.cluster.members),
-                        axes=dict(self.cluster.axes), **self.stats())
+                        axes=dict(self.cluster.axes),
+                        history=[dict(h) for h in self.history],
+                        **self.stats())
 
         self._m_recoveries = _metrics()["recoveries"]
         try:
-            return self._supervised(run_once, restore_fn)
-        except _SpareExit:
-            return self._spare_result()
+            while True:
+                try:
+                    return self._supervised(run_once, restore_fn)
+                except _SpareExit:
+                    role = self._await_reactivation()
+                    if role != "active":
+                        return self._spare_result()
+                    # re-seated: restore at the published cursor and
+                    # rejoin the supervised loop as a fresh resume
+                    booted["done"] = False
         finally:
             self.cluster.stop()
 
@@ -847,12 +1099,26 @@ class ElasticSupervisor(Supervisor):
             self._need_degrade = True
             raise
 
+    def _await_reactivation(self) -> str:
+        """Block (bounded) until this spare is re-seated by a grow, or
+        give up. Returns the role; on ``active`` the coordinated
+        manager is rebuilt for the new membership index."""
+        if self.spare_reactivate_s is None or not self.cluster.rejoin:
+            return "spare"
+        role = self.cluster.await_reactivation(self.spare_reactivate_s)
+        if role == "active":
+            self._role = "active"
+            self._rebuild_manager()
+        return role
+
     def _spare_result(self) -> Dict[str, Any]:
         self.cluster.stop()
         return dict(role="spare", state=None, i=None,
                     gen=self.cluster.gen,
                     members=list(self.cluster.members),
-                    axes=dict(self.cluster.axes), **self.stats())
+                    axes=dict(self.cluster.axes),
+                    history=[dict(h) for h in self.history],
+                    **self.stats())
 
     def fit(self, *args, **kwargs):
         raise NotImplementedError(

@@ -6,8 +6,9 @@ rule — ``dist.collective=kill:K`` (sudden death mid-train),
 ``ckpt.shard=raise:oserror`` (shard corruption at save) — and the
 survivors must detect, degrade, reshard-restore and converge.
 
-Run via tests/test_elastic.py (which spawns the processes and checks the
-final weights against a NumPy oracle), or by hand::
+Run via tests/test_elastic.py / tests/test_gspmd.py (which spawn the
+processes and check the final weights against a NumPy oracle), or by
+hand::
 
     python tests/dist/elastic_drill.py --root /tmp/el --rank 0 --world 4
 
@@ -18,6 +19,19 @@ a fixed data shard (seeded by rank id); the gradient is the mean of the
 active members' shard gradients, reduced in membership order; momentum
 is ZeRO-style sharded over members along axis 0 (``shard_slice``
 boundaries), so a degrade reshards optimizer state too.
+
+``--gspmd`` mode (the pod-scale sharding drill): each rank runs the
+SAME math as a jitted rule-tree-sharded GSPMD step over a local
+virtual device mesh (``--local-devices``, armed via XLA_FLAGS before
+jax imports): weights live as GSPMD-sharded global ``jax.Array``
+leaves (partition-rule tree over the local ``dp`` axis), the jitted
+step consumes/produces them with ``in_shardings``/``out_shardings``,
+and the coordinated checkpoint saves them through the index-based
+shard-manifest path — a kill therefore drills degrade + GLOBAL-ARRAY
+reshard-on-load, not just host-shard concat. ``--step-sleep`` and
+``--rejoin``/``--rejoin-wait`` drive the spare-re-activation drill
+(a killed rank's replacement signals capacity and re-enters the mesh
+at the next generation; membership phases come back in ``history``).
 """
 import argparse
 import json
@@ -25,6 +39,22 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _arm_local_devices(n: int) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+# --gspmd needs the virtual-device flag BEFORE any jax import
+if "--gspmd" in sys.argv:
+    n_local = 2
+    if "--local-devices" in sys.argv:
+        n_local = int(sys.argv[sys.argv.index("--local-devices") + 1])
+    _arm_local_devices(n_local)
 
 import numpy as onp  # noqa: E402
 
@@ -57,6 +87,72 @@ def step_fn(state, i, cluster):
     return {"w": w - delta, "m": m}
 
 
+def make_gspmd_step(step_sleep: float = 0.0):
+    """The SAME drill math as :func:`step_fn`, but with ``w`` living as
+    a rule-tree-sharded global ``jax.Array`` over this process's local
+    virtual mesh and the per-shard compute jitted with
+    ``in_shardings``/``out_shardings`` from the rule tree — so the
+    coordinated checkpoint exercises the index-based global-array shard
+    manifests and a degrade drills reshard-on-load of GSPMD leaves.
+    Cross-rank reduction stays on the deadline-bounded file collectives
+    (a dead peer must surface typed, which is the drill's point).
+
+    Returns ``(gspmd_step_fn, to_global)``.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu import parallel
+    from mxnet_tpu.parallel import sharding as psh
+
+    jax.config.update("jax_default_matmul_precision", "highest")
+    mesh = parallel.make_mesh({"dp": -1})
+    specs = psh.match_partition_rules([(r"(^|/)w$", P("dp"))],
+                                      {"w": onp.zeros(D, "float32")})
+    ns_w = psh.tree_shardings(specs["w"], mesh)
+    repl = psh.tree_shardings(P(), mesh)
+
+    def _grad(w, x, y):
+        return 2.0 / N_PER * x.T @ (x @ w - y)
+
+    def _apply(w, delta):
+        return w - delta
+
+    grad_jit = jax.jit(_grad, in_shardings=(ns_w, repl, repl),
+                       out_shardings=repl)
+    apply_jit = jax.jit(_apply, in_shardings=(ns_w, repl),
+                        out_shardings=ns_w)
+
+    def to_global(w_host):
+        return jax.device_put(jnp.asarray(w_host), ns_w)
+
+    def gspmd_step(state, i, cluster):
+        if step_sleep > 0.0:
+            _time.sleep(step_sleep)
+        # a restored state hands w back as a host array (the manifest
+        # reassembly); re-place it onto the CURRENT mesh — this IS
+        # reshard-on-load for the global leaf
+        w = state["w"]
+        if not (hasattr(w, "sharding") and hasattr(w, "addressable_shards")):
+            w = to_global(w)
+        x, y = make_data(cluster.rank)
+        g_local = onp.asarray(
+            grad_jit(w, jnp.asarray(x), jnp.asarray(y)), "float32")
+        g = cluster.allreduce_sum(g_local, name="grad") / cluster.world
+        sl = shard_slice(D, cluster.world, cluster.index)
+        m = MU * state["m"] + g[sl].astype("float32")
+        delta = onp.zeros(D, "float32")
+        delta[sl] = LR * m
+        delta = cluster.allreduce_sum(delta, name="delta")
+        w_new = apply_jit(w, jnp.asarray(delta))
+        return {"w": w_new, "m": m.astype("float32")}
+
+    return gspmd_step, to_global
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", required=True)
@@ -68,7 +164,22 @@ def main() -> int:
     ap.add_argument("--heartbeat-s", type=float, default=0.1)
     ap.add_argument("--deadline-s", type=float, default=3.0)
     ap.add_argument("--stale-after-s", type=float, default=0.8)
+    ap.add_argument("--gspmd", action="store_true",
+                    help="rule-tree-sharded global-array step over a "
+                         "local virtual mesh")
+    ap.add_argument("--local-devices", type=int, default=2)
+    ap.add_argument("--step-sleep", type=float, default=0.0)
+    ap.add_argument("--rejoin", action="store_true",
+                    help="arm spare re-activation (rejoin files + "
+                         "grow votes at save boundaries)")
+    ap.add_argument("--rejoin-wait", type=float, default=None,
+                    help="how long a spare waits to be re-seated")
     args = ap.parse_args()
+
+    fn = step_fn
+    to_global = None
+    if args.gspmd:
+        fn, to_global = make_gspmd_step(args.step_sleep)
 
     sup = ElasticSupervisor(
         args.root, args.rank, args.world,
@@ -78,17 +189,22 @@ def main() -> int:
         deadline_s=args.deadline_s,
         stale_after_s=args.stale_after_s,
         start_deadline_s=90.0,
-        shard_rules=SHARD_RULES)
+        shard_rules=SHARD_RULES,
+        rejoin=args.rejoin or None,
+        spare_reactivate_s=args.rejoin_wait)
     init = {
         "w": onp.zeros(D, "float32"),
         "m": onp.zeros(shard_slice(D, args.world, args.rank).stop
                        - shard_slice(D, args.world, args.rank).start,
                        "float32"),
     }
-    result = sup.run_steps(step_fn, init, args.steps)
+    if to_global is not None:
+        init["w"] = to_global(init["w"])
+    result = sup.run_steps(fn, init, args.steps)
     out = {k: v for k, v in result.items() if k != "state"}
     if result.get("state") is not None:
-        out["w"] = [round(float(v), 8) for v in result["state"]["w"]]
+        out["w"] = [round(float(v), 8)
+                    for v in onp.asarray(result["state"]["w"])]
     out["rank"] = args.rank
     print("ELASTIC_RESULT " + json.dumps(out), flush=True)
     return 0

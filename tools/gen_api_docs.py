@@ -50,6 +50,9 @@ MODULES = {
     "mxnet_tpu.parallel": "mesh parallelism: dp/tp/pp/sp/ep",
     "mxnet_tpu.parallel.ring_attention": "ring / Ulysses / blockwise "
                                          "sequence parallelism",
+    "mxnet_tpu.parallel.sharding": "partition-rule sharding trees: "
+                                   "regex rules → PartitionSpec pytrees, "
+                                   "shard/gather closures, zoo catalog",
     "mxnet_tpu.symbol": "mx.sym — symbolic graphs + Executor",
     "mxnet_tpu.amp": "automatic mixed precision",
     "mxnet_tpu.profiler": "profiler — chrome-trace + aggregates",
