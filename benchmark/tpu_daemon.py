@@ -852,6 +852,31 @@ def capture_gspmd() -> None:
             f"{c.get('reshard_restore_wall_ms')} ms")
 
 
+IO_SERVICE = os.path.join(HERE, "results_io_service_tpu.json")
+
+
+def capture_io_service() -> None:
+    """Dataset-service input-plane row (ISSUE 14,
+    benchmark/io_service_bench.py): world-4 input_starved% before/after
+    the service, worker-kill re-dispatch recovery wall, shared-cache
+    bank-once ratio — measured on the TPU host, where the decode
+    workers share cores with the real XLA runtime instead of a quiet
+    CI container (the CPU proxy is results_io_service_cpu.json)."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "io_service_bench.py"),
+         "--device", "tpu"],
+        timeout=1200)
+    rec = parse_json_output(out)
+    if bank_if_tpu(IO_SERVICE, rec, rc, "io service bench") and rec:
+        p = rec.get("input_plane", {})
+        log(f"io-service: starved {p.get('starved_before_pct')}% -> "
+            f"{p.get('starved_after_pct')}% at world {p.get('world')}, "
+            f"recovery "
+            f"{rec.get('redispatch', {}).get('recovery_wall_s')}s, "
+            f"bank-once "
+            f"{rec.get('shared_cache', {}).get('bank_once_ratio')}")
+
+
 def capture_infer_table() -> None:
     """Per-model inference table over the reference's FULL published
     perf.md rows (resnet50/resnet152/inception_v3/vgg16/alexnet, bf16 +
@@ -1326,6 +1351,7 @@ CAPTURES = (
     ("opt", banked_stale(OPT), capture_opt),
     ("fleet", banked_stale(FLEET), capture_fleet),
     ("gspmd", banked_stale(GSPMD), capture_gspmd),
+    ("io-service", banked_stale(IO_SERVICE), capture_io_service),
     ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION, 4 * 3600), capture_attention),

@@ -619,9 +619,17 @@ from .native_pipeline import (DevicePrefetch, NativeImagePipeline,  # noqa: E402
                               decode_jpeg_batch, native_available)
 from .sharded import ShardedImagePipeline, default_num_workers  # noqa: E402,F401
 from .cache import (CachedImagePipeline, cache_dir_from_env,  # noqa: E402,F401
-                    cache_key)
+                    cache_key, sweep_cache_root)
+from .service import (DatasetService, RecordIOSource,  # noqa: E402,F401
+                      ServiceDown, ServiceStream, StreamCursor,
+                      StreamStalled, SyntheticSource, WorkerLost,
+                      load_cursor, save_cursor, service_root_from_env)
 
 __all__ += ["NativeImagePipeline", "DevicePrefetch", "decode_jpeg_batch",
             "native_available", "ShardedImagePipeline",
             "default_num_workers", "CachedImagePipeline",
-            "cache_dir_from_env", "cache_key"]
+            "cache_dir_from_env", "cache_key", "sweep_cache_root",
+            "DatasetService", "ServiceStream", "StreamCursor",
+            "SyntheticSource", "RecordIOSource", "WorkerLost",
+            "StreamStalled", "ServiceDown", "load_cursor", "save_cursor",
+            "service_root_from_env"]

@@ -15,36 +15,53 @@ resized crop needs headroom to cut from (``canvas_for``).
 
 Cache layout (``<dir>/<key>/``) — ``key`` fingerprints the source file
 (path, size, mtime) and the decode geometry, so a re-packed .rec or a
-different canvas never serves stale pixels:
+different canvas never serves stale pixels. The root is therefore a
+**content-addressed store**: any number of jobs (or data-parallel
+ranks) sharing one root resolve the same (source, geometry) to the same
+slab.
 
     data.u8     (N, H, W, 3) uint8 rows, C-order, append-written
     label.f32   (N, label_width) float32 rows
     meta.json   row count + geometry + source fingerprint, written
                 atomically LAST — its presence is the commit mark
                 (crash mid-write leaves no meta, next run rebuilds)
+    writer.lock the single-writer election token (below)
 
-Concurrent cold writers (e.g. data-parallel ranks sharing one cache
-root) are safe without locks: each banks into its own
-``data.u8.<pid>.<id>.tmp`` and publishes by ``os.replace``; because the
-key pins (source identity, geometry) and decode is deterministic, every
-writer's slab is bitwise identical, so whichever publish order the
-races produce, the committed files are consistent. A writer that finds
-``meta.json`` already published simply drops its temps and goes warm.
+**Single-writer election**: concurrent cold openers of one key elect
+ONE banking writer through an ``O_EXCL`` ``writer.lock`` (mtime
+refreshed per banked batch); everyone else streams **live decode
+without writing** while banking is in flight and flips to the slab at
+the next epoch boundary once ``meta.json`` is published. N
+data-parallel ranks therefore bank ONE epoch instead of N — the
+decode-once contract the dataset service's shared root depends on. A
+writer that crashes leaves a lock whose mtime goes stale
+(``writer_ttl_s``); the next cold opener breaks it and re-elects.
+
+**Shared-root hygiene**: crashed writers also leave per-writer
+``*.tmp`` slabs behind, forever, on a root many jobs share.
+:func:`sweep_cache_root` (called at every open — bounded,
+race-tolerant, warn-once, the ``elastic.sweep_rendezvous_root``
+discipline) removes stale tmp litter and dead uncommitted key dirs, and
+optionally applies newest-N retention over committed slabs
+(``MXNET_TPU_IO_CACHE_KEEP``).
 """
 from __future__ import annotations
 
 import hashlib
 import json
 import os
-from typing import Optional, Tuple
+import time
+from typing import Dict, Optional, Tuple
 
 import numpy as onp
 
-from ..base import MXNetError
+from ..base import MXNetError, env_float, env_int
 
-__all__ = ["CachedImagePipeline", "cache_dir_from_env", "cache_key"]
+__all__ = ["CachedImagePipeline", "cache_dir_from_env", "cache_key",
+           "sweep_cache_root"]
 
 _META = "meta.json"
+_LOCK = "writer.lock"
 _VERSION = 1
 
 
@@ -63,6 +80,112 @@ def cache_key(source_path: str, h: int, w: int, label_width: int) -> str:
     return hashlib.sha1(raw.encode()).hexdigest()[:16]
 
 
+def _cache_metrics():
+    from ..telemetry.registry import get_registry
+
+    reg = get_registry()
+    return {
+        "hit": reg.gauge(
+            "io_service_cache_hit",
+            "last shared-cache open: 1 = warm (served from the slab), "
+            "0 = cold"),
+        "opens": reg.counter(
+            "io_cache_opens_total", "cache opens by outcome",
+            labels=("result",)),
+        "elections": reg.counter(
+            "io_cache_writer_elections_total",
+            "single-writer elections by outcome", labels=("result",)),
+    }
+
+
+def sweep_cache_root(root: str, *, keep_complete: Optional[int] = None,
+                     ttl_s: Optional[float] = None,
+                     lock_ttl_s: Optional[float] = None) -> Dict[str, int]:
+    """Bounded, race-tolerant sweep of a shared cache root's litter
+    (the ``elastic.sweep_rendezvous_root`` discipline): without it every
+    crashed writer leaves its per-writer ``*.tmp`` slabs and half-built
+    key dirs behind **forever** on a root many jobs share.
+
+    Removed: ``*.tmp*`` staging files older than ``ttl_s`` (default
+    ``MXNET_TPU_IO_CACHE_TTL_S``, 3600 s), stale ``writer.lock`` tokens
+    older than ``lock_ttl_s`` (default ``max(60 s, ttl/60)``),
+    uncommitted key dirs (no ``meta.json``) whose newest entry is older
+    than ``ttl_s``, and — only when ``keep_complete`` > 0 (default
+    ``MXNET_TPU_IO_CACHE_KEEP``, 0 = unlimited) — committed slabs
+    beyond the newest N. Deletions never error on a concurrent winner;
+    warns once per sweep that removed anything. Returns the removal
+    counts."""
+    import shutil
+    import warnings
+
+    ttl = float(ttl_s if ttl_s is not None
+                else env_float("MXNET_TPU_IO_CACHE_TTL_S", 3600.0))
+    lock_ttl = float(lock_ttl_s if lock_ttl_s is not None
+                     else max(60.0, ttl / 60.0))
+    keep = int(keep_complete if keep_complete is not None
+               else env_int("MXNET_TPU_IO_CACHE_KEEP", 0))
+    swept = {"tmps": 0, "locks": 0, "partials": 0, "complete": 0}
+    root = os.path.abspath(root)
+    if not os.path.isdir(root):
+        return swept
+    now = time.time()
+    committed = []  # (meta mtime, key dir)
+    for name in sorted(os.listdir(root)):
+        kdir = os.path.join(root, name)
+        if not os.path.isdir(kdir):
+            continue
+        try:
+            entries = os.listdir(kdir)
+        except OSError:
+            continue  # a concurrent sweeper won the race
+        newest = 0.0
+        for n in entries:
+            p = os.path.join(kdir, n)
+            try:
+                mt = os.stat(p).st_mtime
+            except OSError:
+                continue
+            newest = max(newest, mt)
+            if ".tmp" in n and now - mt > ttl:
+                try:
+                    os.unlink(p)
+                    swept["tmps"] += 1
+                except OSError:
+                    pass
+            elif n == _LOCK and now - mt > lock_ttl:
+                try:
+                    os.unlink(p)
+                    swept["locks"] += 1
+                except OSError:
+                    pass
+        meta = os.path.join(kdir, _META)
+        if os.path.isfile(meta):
+            try:
+                committed.append((os.stat(meta).st_mtime, kdir))
+            except OSError:
+                pass
+        elif newest and now - newest > ttl:
+            # a key dir abandoned cold (crashed writer, no commit mark):
+            # nothing in it can ever be served
+            shutil.rmtree(kdir, ignore_errors=True)
+            swept["partials"] += 1
+    if keep > 0 and len(committed) > keep:
+        committed.sort()  # oldest first
+        for _, kdir in committed[:-keep]:
+            shutil.rmtree(kdir, ignore_errors=True)
+            swept["complete"] += 1
+    if any(swept.values()):
+        warnings.warn(
+            f"io.cache: swept shared-cache litter under {root!r}: "
+            f"{swept['tmps']} stale tmp slab(s), {swept['locks']} dead "
+            f"writer lock(s), {swept['partials']} abandoned partial key "
+            f"dir(s), {swept['complete']} committed slab(s) beyond the "
+            f"newest-{keep} retention — fresh writers and every "
+            "committed slab inside retention were kept",
+            RuntimeWarning, stacklevel=2)
+    return swept
+
+
 class CachedImagePipeline:
     """Wrap an image pipeline factory with the epoch cache.
 
@@ -73,16 +196,19 @@ class CachedImagePipeline:
     the on-device augment instead). The factory is only invoked when the
     cache is cold, so a complete cache costs zero decode workers.
 
-    Epoch 1 (cold): batches stream through while their rows are
-    append-written to the slab; the epoch's natural end commits the
-    cache. Epochs 2+ (warm): batches are memmap slices — no decode, no
-    copy, page-cache bandwidth. ``pad_last`` is applied uniformly by the
-    wrapper on both paths.
+    Epoch 1 (cold): the elected single writer streams batches through
+    while banking their rows; non-writers stream the same live decode
+    **without writing** (reader fallback while banking is in flight).
+    The epoch's natural end commits the cache (writer) or flips to the
+    published slab (readers). Epochs 2+ (warm): batches are memmap
+    slices — no decode, no copy, page-cache bandwidth. ``pad_last`` is
+    applied uniformly by the wrapper on both paths.
     """
 
     def __init__(self, inner_factory, cache_dir: str, source_path: str,
                  data_shape: Tuple[int, int, int], batch_size: int,
-                 label_width: int = 1, pad_last: bool = False):
+                 label_width: int = 1, pad_last: bool = False,
+                 writer_ttl_s: float = 30.0):
         if len(data_shape) != 3 or data_shape[0] != 3:
             raise MXNetError("data_shape must be (3, H, W)")
         self._factory = inner_factory
@@ -91,21 +217,29 @@ class CachedImagePipeline:
         self.label_width = int(label_width)
         self.pad_last = bool(pad_last)
         self._source = source_path
+        self._writer_ttl = float(writer_ttl_s)
+        sweep_cache_root(cache_dir)
         key = cache_key(source_path, self.h, self.w, self.label_width)
         self._dir = os.path.join(cache_dir, key)
         os.makedirs(self._dir, exist_ok=True)
         self._data_path = os.path.join(self._dir, "data.u8")
         self._label_path = os.path.join(self._dir, "label.f32")
         self._meta_path = os.path.join(self._dir, _META)
+        self._lock_path = os.path.join(self._dir, _LOCK)
         self._inner = None
+        self._writer: Optional[bool] = None  # None = not yet elected
         self._write_files = None     # (data_f, label_f) while banking
         self._rows_written = 0
         self._n = None               # committed row count
         self._mm_data = self._mm_label = None
         self._pos = 0                # warm-path cursor
         self._closed = False
+        self._m = _cache_metrics()
         if os.path.exists(self._meta_path):
             self._open_warm()
+        self._m["hit"].set(1 if self._n is not None else 0)
+        self._m["opens"].labels(
+            result="hit" if self._n is not None else "miss").inc()
 
     # -- state ---------------------------------------------------------
 
@@ -113,6 +247,12 @@ class CachedImagePipeline:
     def complete(self) -> bool:
         """True once the cache is committed and epochs stream from it."""
         return self._n is not None
+
+    @property
+    def is_writer(self) -> bool:
+        """True when this instance won the single-writer election and
+        is (or was) the one banking the slab."""
+        return bool(self._writer)
 
     def _open_warm(self):
         with open(self._meta_path) as f:
@@ -129,12 +269,64 @@ class CachedImagePipeline:
         self._n = n
         self._pos = 0
 
+    # -- single-writer election ----------------------------------------
+
+    def _try_lock(self) -> bool:
+        try:
+            fd = os.open(self._lock_path,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            json.dump({"pid": os.getpid(), "wall": time.time()}, f)
+        return True
+
+    def _elect(self) -> bool:
+        """One writer per key dir: O_EXCL on ``writer.lock``; a lock
+        whose mtime stopped moving for ``writer_ttl_s`` belongs to a
+        crashed writer and is broken (whoever wins the re-create is the
+        new writer — racers lose the O_EXCL, not the data)."""
+        if self._try_lock():
+            self._m["elections"].labels(result="writer").inc()
+            return True
+        try:
+            age = time.time() - os.stat(self._lock_path).st_mtime
+        except OSError:
+            age = float("inf")  # vanished: the holder just released it
+        if age > self._writer_ttl:
+            try:
+                os.unlink(self._lock_path)
+            except OSError:
+                pass  # a concurrent breaker won
+            if self._try_lock():
+                self._m["elections"].labels(result="writer").inc()
+                return True
+        self._m["elections"].labels(result="reader").inc()
+        return False
+
+    def _refresh_lock(self):
+        try:
+            os.utime(self._lock_path)
+        except OSError:
+            pass  # swept by an aggressive TTL: the commit still decides
+
+    def _release_lock(self):
+        try:
+            os.unlink(self._lock_path)
+        except OSError:
+            pass
+
+    # -- cold path -----------------------------------------------------
+
     def _open_cold(self):
         if self._inner is None:
             self._inner = self._factory()
-        if self._write_files is None:
-            # a per-writer temp pair: concurrent cold writers sharing
-            # this key dir must never interleave rows into one file
+        if self._writer is None:
+            self._writer = self._elect()
+        if self._writer and self._write_files is None:
+            # a per-writer temp pair: even with the election, a broken
+            # lock can briefly leave two writers — distinct temps mean
+            # they can never interleave rows into one file
             self._tmp_suffix = ".%d.%x.tmp" % (os.getpid(), id(self))
             self._write_files = (
                 open(self._data_path + self._tmp_suffix, "wb"),
@@ -160,6 +352,8 @@ class CachedImagePipeline:
             # commit mark would poison the key dir (memmap of a
             # zero-byte file fails) for every later run
             self._remove_tmps()
+            self._release_lock()
+            self._writer = None
             return
         if os.path.exists(self._meta_path):
             # a concurrent writer published first — its slab is bitwise
@@ -181,11 +375,27 @@ class CachedImagePipeline:
             with open(tmp, "w") as f:
                 json.dump(meta, f)
             os.replace(tmp, self._meta_path)  # atomic commit mark
+        self._release_lock()
         # the decode engine is done for good: free its workers/threads
+        self._close_inner()
+        self._open_warm()
+        self._m["hit"].set(1)
+
+    def _finish_reader_epoch(self):
+        """A non-writer's epoch ended: flip to the slab if the elected
+        writer has published; otherwise stay on live decode (the next
+        reset re-runs the election — the writer may have crashed)."""
+        if os.path.exists(self._meta_path):
+            self._close_inner()
+            self._open_warm()
+            self._m["hit"].set(1)
+        else:
+            self._writer = None  # re-elect at the next epoch
+
+    def _close_inner(self):
         if self._inner is not None:
             getattr(self._inner, "close", lambda: None)()
             self._inner = None
-        self._open_warm()
 
     def _discard_partial(self):
         if self._write_files is not None:
@@ -224,14 +434,24 @@ class CachedImagePipeline:
             label = self._mm_label[self._pos:end]
             self._pos = end
             return self._emit(data, label)
-        if self._inner is None or self._write_files is None:
+        if self._inner is None or (self._writer is None) or (
+                self._writer and self._write_files is None):
             self._open_cold()
         try:
             nv = getattr(self._inner, "next_view", None)
             data, label = nv() if nv is not None else next(self._inner)
         except StopIteration:
-            self._commit()
+            if self._writer:
+                self._commit()
+            else:
+                self._finish_reader_epoch()
             raise
+        if not self._writer:
+            # reader fallback while banking is in flight: serve live
+            # decode, write nothing (the elected writer banks ONCE)
+            data_c, label_c = onp.array(data), onp.array(label)
+            return self._emit(data_c, label_c)
+        self._refresh_lock()
         # bank the rows exactly as decoded (bitwise: epoch 2 streams
         # what epoch 1 trained on); onp.array makes the ONE copy that
         # both detaches the batch from the ring slot and backs the
@@ -250,7 +470,8 @@ class CachedImagePipeline:
             self._pos = 0
             return
         # an aborted banking epoch is useless — a partial slab must
-        # never masquerade as the dataset
+        # never masquerade as the dataset (the writer keeps its lock:
+        # it is still the banker for the epoch about to start)
         self._discard_partial()
         if self._inner is not None:
             reset = getattr(self._inner, "reset", None)
@@ -264,9 +485,9 @@ class CachedImagePipeline:
             return
         self._closed = True
         self._discard_partial()
-        if self._inner is not None:
-            getattr(self._inner, "close", lambda: None)()
-            self._inner = None
+        if self._writer:
+            self._release_lock()
+        self._close_inner()
         self._mm_data = self._mm_label = None
 
     def __del__(self):  # pragma: no cover - GC timing

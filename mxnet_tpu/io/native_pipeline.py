@@ -270,6 +270,7 @@ class DevicePrefetch:
         self.depth = int(depth)
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._detached = False
         self._batches = 0
         self._bytes_staged = 0
         self._starved_s = 0.0
@@ -394,6 +395,12 @@ class DevicePrefetch:
                 if self._done or self._stop.is_set():
                     raise StopIteration
                 if not self._thread.is_alive():
+                    if self._detached:
+                        # a planned teardown raced the flag checks: the
+                        # feeder exiting is the asked-for outcome, not a
+                        # death
+                        self._done = True
+                        raise StopIteration
                     self._done = True
                     raise FatalError(
                         "DevicePrefetch feeder thread died without "
@@ -409,6 +416,20 @@ class DevicePrefetch:
             raise item
         self._batches += 1
         return item
+
+    def detach(self):
+        """Planned teardown — the seam an elastic re-rendezvous uses to
+        stop the input plane without faulting it: the feeder stops
+        pulling from the source, already-staged batches remain
+        consumable, and the stream then ends in a clean
+        ``StopIteration`` — never the dead-feeder ``FatalError`` (that
+        one is for *unplanned* feeder deaths). Idempotent; composes
+        with natural exhaustion in either order (a detach after the
+        epoch ended changes nothing — further ``next()`` calls stay
+        ``StopIteration``). The source is untouched: re-attach a fresh
+        ``DevicePrefetch`` at the re-split cursor to resume."""
+        self._detached = True  # set BEFORE stop: the consumer must
+        self._stop.set()       # never observe stop without the intent
 
     def close(self):
         """Stop and JOIN the feeder before the caller frees the source

@@ -70,6 +70,13 @@ SITES = (
                           # mid-train, delay = slow-rank straggler)
     "ckpt.shard",         # coordinated save, between shard payload and
                           # its manifest (a fault = commit must refuse)
+    "io.worker",          # dataset-service decode worker, per batch
+                          # (kill = dead decoder mid-epoch, delay = a
+                          # wedged decode whose progress-gated beats go
+                          # stale and trigger range re-dispatch)
+    "io.stream",          # dataset-service consumer fetch (a batch
+                          # faulted in transit — the bounded retry loop
+                          # must absorb it; delay = slow shared fs)
 )
 
 

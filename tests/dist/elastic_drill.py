@@ -20,6 +20,15 @@ active members' shard gradients, reduced in membership order; momentum
 is ZeRO-style sharded over members along axis 0 (``shard_slice``
 boundaries), so a degrade reshards optimizer state too.
 
+``--io-root`` arms the dataset-service named-cursor re-split drill on
+top of either mode: every member consumes one ``io.service
+.ServiceStream`` batch per training step (local decode over the
+deterministic ``SyntheticSource`` oracle), the group's named cursor is
+persisted at every coordinated-save boundary, and a membership change
+re-splits the stream for the new world at the persisted cursor — the
+reported per-step consumption lets the test assert the resumed union
+equals an uninterrupted oracle exactly (no drop, no duplicate).
+
 ``--gspmd`` mode (the pod-scale sharding drill): each rank runs the
 SAME math as a jitted rule-tree-sharded GSPMD step over a local
 virtual device mesh (``--local-devices``, armed via XLA_FLAGS before
@@ -153,6 +162,51 @@ def make_gspmd_step(step_sleep: float = 0.0):
     return gspmd_step, to_global
 
 
+IO_BATCH, IO_DIM, IO_SEED = 2, 4, 7   # the stream drill's source shape
+
+
+def make_io_step(inner, io_root: str, n_batches: int, save_every: int,
+                 io_log: list):
+    """Wrap a drill step with the dataset-service stream contract:
+    consume one assigned batch per step, persist the named cursor at
+    the coordinated-save cadence, and re-split at the persisted cursor
+    whenever the membership generation changes (the elastic
+    re-rendezvous seam). Consumption is recorded as
+    ``{gen, step, idx, ok}`` rows for the union-vs-oracle assertion."""
+    from mxnet_tpu.io.service import ServiceStream, SyntheticSource
+
+    source = SyntheticSource(n_batches, batch_size=IO_BATCH, dim=IO_DIM,
+                             seed=IO_SEED)
+    held = {"stream": None, "gen": None}
+
+    def io_step(state, i, cluster):
+        s = held["stream"]
+        if s is None or held["gen"] != cluster.gen:
+            # membership changed (or first boot): re-split the stream
+            # for the new world at the PERSISTED named cursor — members
+            # of the new membership resume the strided assignment from
+            # the exact committed frontier
+            s = ServiceStream(io_root, cursor="drill",
+                              member_index=cluster.index,
+                              world=cluster.world,
+                              local=True, source=source)
+            held["stream"] = s
+            held["gen"] = cluster.gen
+        data, _label = next(s)
+        idx = s.last_index
+        io_log.append({"gen": cluster.gen, "step": i, "idx": idx,
+                       "ok": bool((data == source.read(idx)[0]).all())})
+        state = inner(state, i, cluster)
+        if (i + 1) % save_every == 0:
+            # the group cursor commits at the same boundary as the
+            # coordinated checkpoint, so a restore rewinds training and
+            # stream to the SAME point
+            s.save_cursor()
+        return state
+
+    return io_step
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", required=True)
@@ -174,12 +228,22 @@ def main() -> int:
                          "grow votes at save boundaries)")
     ap.add_argument("--rejoin-wait", type=float, default=None,
                     help="how long a spare waits to be re-seated")
+    ap.add_argument("--io-root", default=None,
+                    help="arm the dataset-service named-cursor re-split "
+                         "drill: a ServiceStream batch per step, cursor "
+                         "saved at save boundaries, re-split on "
+                         "membership change")
+    ap.add_argument("--io-batches", type=int, default=40)
     args = ap.parse_args()
 
     fn = step_fn
     to_global = None
     if args.gspmd:
         fn, to_global = make_gspmd_step(args.step_sleep)
+    io_log: list = []
+    if args.io_root:
+        fn = make_io_step(fn, args.io_root, args.io_batches,
+                          args.save_every, io_log)
 
     sup = ElasticSupervisor(
         args.root, args.rank, args.world,
@@ -206,6 +270,13 @@ def main() -> int:
         out["w"] = [round(float(v), 8)
                     for v in onp.asarray(result["state"]["w"])]
     out["rank"] = args.rank
+    if args.io_root:
+        from mxnet_tpu.io.service import load_cursor
+
+        cur = load_cursor(args.io_root, "drill")
+        out["io"] = {"consumed": io_log,
+                     "cursor_frontier": (cur.frontier if cur else None),
+                     "cursor_world": (cur.world if cur else None)}
     print("ELASTIC_RESULT " + json.dumps(out), flush=True)
     return 0
 

@@ -1,0 +1,55 @@
+"""io_service_bench --quick wired into tier-1 (ISSUE 14 satellite): the
+schema contract for the banked ``results_io_service_cpu.json`` plus the
+gates that hold at any scale — the world-4 input plane really starves
+less behind the service than decoding in-step, the worker-kill epoch
+re-dispatches and stays exactly-once, and the shared cache banks ONE
+slab for four concurrent cold ranks.
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_io_service_bench_quick(tmp_path):
+    out_file = str(tmp_path / "io_service.json")
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    for k in ("MXNET_TPU_CHAOS", "MXNET_TPU_FLIGHT_DIR",
+              "MXNET_TPU_IO_SERVICE", "MXNET_TPU_IO_CACHE"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "benchmark", "io_service_bench.py"),
+         "--quick", "--output", out_file],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(open(out_file).read())
+    assert rec["quick"] is True
+    assert rec["metric"] == "io_service_starved_reduction"
+    p = rec["input_plane"]
+    assert p["world"] == 4
+    assert p["starved_after_pct"] < p["starved_before_pct"]
+    r = rec["redispatch"]
+    assert r["ranges_redispatched"] >= 1
+    assert r["lost_batches"] == 0 and r["duplicated_batches"] == 0
+    c = rec["shared_cache"]
+    assert c["writers_elected"] == 1 and c["slabs_banked"] == 1
+    assert c["bank_once_ratio"] == 4.0
+    assert rec["acceptance"]["pass"] is True
+
+
+def test_io_service_banked_artifact_passes_acceptance():
+    """The committed full-run artifact is the acceptance evidence:
+    before/after input_starved% at world 4 and the bank-once ratio."""
+    path = os.path.join(ROOT, "benchmark", "results_io_service_cpu.json")
+    rec = json.loads(open(path).read())
+    assert rec["metric"] == "io_service_starved_reduction"
+    assert rec["quick"] is False
+    p = rec["input_plane"]
+    assert p["world"] == 4
+    assert p["starved_after_pct"] < p["starved_before_pct"]
+    assert rec["redispatch"]["recovery_wall_s"] > 0
+    assert rec["shared_cache"]["bank_once_ratio"] == 4.0
+    assert rec["acceptance"]["pass"] is True

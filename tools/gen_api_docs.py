@@ -66,6 +66,9 @@ MODULES = {
     "mxnet_tpu.test_utils": "testing utilities (oracle asserts)",
     "mxnet_tpu.image": "legacy image augmentation pipeline",
     "mxnet_tpu.io": "legacy DataIter pipeline",
+    "mxnet_tpu.io.service": "fault-tolerant dataset service: decode-"
+                            "worker fault domain, exactly-once range "
+                            "re-dispatch, named resumable cursors",
     "mxnet_tpu.recordio": "RecordIO containers",
     "mxnet_tpu.library": "extension-library loading (mxtpu_ext ABI)",
     "mxnet_tpu.runtime": "build-feature introspection",
