@@ -14,7 +14,11 @@ Four phases over :mod:`mxnet_tpu.serving.fleet`:
 3. **noisy neighbor** — a bronze tenant floods the fleet while gold
    serves its paced load; banks gold's p99 alone vs under the flood
    (``isolation_ratio``) and the bronze shed counts (weighted-fair
-   quota + deadline-class pressure doing their job).
+   quota + deadline-class pressure doing their job). The **SLO
+   sentinel** (ISSUE 15) runs through this overload ramp: a p99
+   ceiling declared off the measured steady phase must stay silent
+   before the flood and fire a typed ``SloViolation`` during it
+   (banked as the ``slo`` row).
 4. **infer fleet** — a 2-replica fixed-shape (InferenceEngine) fleet
    under concurrent clients; banks aggregate img/s (the fleet hosts
    both engine kinds).
@@ -220,18 +224,36 @@ def llm_phases(args, quick):
         f"tok/s={drill['aggregate_tok_s']} "
         f"p99 {drill['p99_steady_ms']} -> {drill['p99_recovery_ms']} ms")
 
-    # ---- phase 3: noisy neighbor isolation --------------------------
+    # ---- phase 3: noisy neighbor isolation + the SLO sentinel -------
+    from mxnet_tpu.telemetry import SloRule, SloSentinel
+
     router, pool = build_fleet(net, replicas, lanes, tenants)
     solo = LoadGen(router, "gold", vocab, tok_new, 0.01, 20).start()
     time.sleep(steady_s)
     solo.stop()
+    # declare the p99 ceiling off the measured steady phase, scoped to
+    # THIS fleet's gold series (the sentinel evaluates the local
+    # in-process registry as a single-process cluster); the overload
+    # ramp below must breach it, the steady phase must not
+    steady_p99 = solo.row()["p99_ms"] or 100.0
+    slo_ceiling = round(max(1.5 * steady_p99, steady_p99 + 10.0), 3)
+    sentinel = SloSentinel(
+        [SloRule("gold_p99", "p99_ms_max", slo_ceiling,
+                 metric="fleet_request_ms",
+                 labels={"fleet": pool.name, "tenant": "gold"})],
+        bundle=False)
+    steady_fired = sentinel.evaluate()       # the steady-phase verdict
     gold = LoadGen(router, "gold", vocab, tok_new, 0.01, 21).start()
     # the flood is genuinely concurrent: enough bronze clients that the
     # tenant's weighted-fair quota BINDS (shed_at_admission > 0 is the
     # isolation mechanism working, not a failure)
     flood = [LoadGen(router, "bronze", vocab, tok_new, 0.0, 22 + i).start()
              for i in range(8 if quick else 16)]
-    time.sleep(steady_s)
+    flood_fired = []
+    flood_deadline = time.monotonic() + steady_s
+    while time.monotonic() < flood_deadline:
+        flood_fired.extend(sentinel.evaluate())
+        time.sleep(0.1)
     gold.stop()
     for g in flood:
         g.stop()
@@ -249,11 +271,22 @@ def llm_phases(args, quick):
         "isolation_ratio_p99": iso,
         "neighbor_shed_total": noisy_shed,
     }
+    slo = {
+        "rule": "gold_p99",
+        "p99_ceiling_ms": slo_ceiling,
+        "steady_violations": len(steady_fired),
+        "flood_violations": len(flood_fired),
+        "first_violation": (flood_fired[0].to_dict()
+                            if flood_fired else None),
+    }
     router.close()
     log(f"isolation: gold p99 {solo_row['p99_ms']} -> "
         f"{gold_row['p99_ms']} ms (ratio {iso}), neighbor shed "
         f"{noisy_shed}")
-    return drill, isolation
+    log(f"slo: ceiling {slo_ceiling} ms, steady violations "
+        f"{slo['steady_violations']}, flood violations "
+        f"{slo['flood_violations']}")
+    return drill, isolation, slo
 
 
 def infer_phase(args, quick):
@@ -324,7 +357,7 @@ def main():
 
     quick = bool(args.quick)
     platform = jax.devices()[0].platform
-    drill, isolation = llm_phases(args, quick)
+    drill, isolation, slo = llm_phases(args, quick)
     infer = infer_phase(args, quick)
 
     rec = {
@@ -336,6 +369,7 @@ def main():
         "device_kind": getattr(jax.devices()[0], "device_kind", ""),
         "drill": drill,
         "isolation": isolation,
+        "slo": slo,
         "infer_fleet": infer,
         "img_s": infer["img_s"],
         "code_rev": code_rev(),

@@ -588,6 +588,65 @@ def run_quick(output=None, trace=None, steps=60, batch=64, hidden=256,
     log(f"instrumentation: {probe_us:.1f} us/step = "
         f"{instr_pct:.3f}% of a {1e3 / sps_armed:.1f} ms step")
 
+    # cluster observability cost (ISSUE 15): the same loop with the
+    # whole cluster plane armed — file exporter into a shared root +
+    # ClusterScraper + SLO sentinel scraping it — plus a deterministic
+    # microbench of one scrape+evaluate pass. The scraper runs on its
+    # own thread at MXNET_TPU_TELEMETRY_SCRAPE_S cadence, so its
+    # steady-state cost to the serving/training loop is the scrape
+    # wall amortized over the period (fraction of one core) — that is
+    # the banked <2% gate; the A/B row rides along loosely (scheduler
+    # noise, same caveat as overhead_pct).
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from mxnet_tpu.telemetry import (ClusterScraper, SloRule,
+                                     SloSentinel)
+    from mxnet_tpu.telemetry import cluster as _tcluster
+    from mxnet_tpu.telemetry import exporter as _texp
+
+    croot = _tempfile.mkdtemp(prefix="mxt_cluster_probe_")
+    cluster_row = None
+    try:
+        cexp = _texp.Exporter({"mode": "file", "dir": croot,
+                               "period_s": 0.2}).start()
+        scraper = ClusterScraper(croot)
+        sentinel = SloSentinel(
+            [SloRule("p99_gate", "p99_ms_max", 1e12,
+                     metric="telemetry_step_ms")],
+            scraper, bundle=False)
+        snap = scraper.scrape()
+        n_probe = 50
+        t0 = time.perf_counter()
+        for _ in range(n_probe):
+            sentinel.evaluate()
+        scrape_ms = (time.perf_counter() - t0) / n_probe * 1e3
+        period = _tcluster.scrape_period_s()
+        cluster_pct = scrape_ms / (period * 1e3) * 100.0
+        scraper.start(period_s=0.2)
+        sentinel.start(period_s=0.2)
+        sps_cluster, _, _ = run_loop(steps, True)
+        sentinel.stop()
+        scraper.stop()
+        cexp.stop(final_flush=False)
+        cluster_overhead_pct = max(
+            0.0, (sps_armed / sps_cluster - 1.0) * 100.0)
+        cluster_row = {
+            "scrape_eval_ms": round(scrape_ms, 3),
+            "scrape_period_s": period,
+            "scrape_pct_of_core": round(cluster_pct, 4),
+            "steps_s_cluster_armed": round(sps_cluster, 2),
+            "cluster_overhead_pct": round(cluster_overhead_pct, 2),
+            "processes_seen": snap["cluster"]["processes"],
+            "slo_rules": 1,
+        }
+        log(f"cluster plane: scrape+evaluate {scrape_ms:.2f} ms "
+            f"(={cluster_pct:.3f}% of a core at the {period:g}s "
+            f"period); armed loop {sps_cluster:.1f} steps/s -> "
+            f"overhead {cluster_overhead_pct:.2f}%")
+    finally:
+        _shutil.rmtree(croot, ignore_errors=True)
+
     n_params = sum(int(onp.prod(p.data().shape))
                    for p in net.collect_params().values())
     dev = jax.devices()[0]
@@ -617,6 +676,7 @@ def run_quick(output=None, trace=None, steps=60, batch=64, hidden=256,
         "attribution_sum_ratio_min": round(min(ratios), 4),
         "attribution_sum_ratio_max": round(max(ratios), 4),
         "trace_events": len(telemetry.buffer()),
+        "cluster": cluster_row,
         "efficiency": efficiency,
         "device": dev.platform,
         "device_kind": getattr(dev, "device_kind", ""),

@@ -394,6 +394,10 @@ def _worker_main(cfg: dict) -> None:
 
     root = cfg["root"]
     wid = int(cfg["worker"])
+    # cluster telemetry identity (normally inherited from the parent's
+    # env at spawn; the setdefault covers exec paths that dropped it)
+    os.environ.setdefault("MXNET_TPU_TELEMETRY_ROLE",
+                          f"io_worker:{wid}")
     n_batches = int(cfg["n_batches"])
     range_size = int(cfg["range_size"])
     poll = float(cfg["poll_s"])
@@ -402,6 +406,13 @@ def _worker_main(cfg: dict) -> None:
     os.makedirs(hb.dir, exist_ok=True)
     stop_path = os.path.join(root, _STOP)
     reader = None
+    # bind the service-level trace context for this worker's spans
+    # (io.range complete events carry it into the merged timeline)
+    from ..telemetry import tracing as _tracing
+
+    _tracing.bind_trace(_tracing.TraceContext(
+        trace_id=cfg.get("trace_id") or _tracing.new_trace_id("io"),
+        role="io_worker", rank=wid))
     try:
         hb.beat()
         reader = cfg["source"].open()
@@ -509,7 +520,10 @@ def _serve_epoch(root: str, epoch: int, wid: int, reader, n_ranges: int,
 
 def _serve_range(root: str, epoch: int, k: int, attempt: int, wid: int,
                  reader, range_size: int, n_batches: int, hb) -> None:
+    from ..telemetry import tracing as _tracing
+
     lo, hi = k * range_size, min((k + 1) * range_size, n_batches)
+    t_range0 = time.perf_counter()
     for i in range(lo, hi):
         # the beat is issued FROM the loop: liveness is gated on decode
         # progress, so a wedged read() goes stale like a dead process
@@ -526,6 +540,17 @@ def _serve_range(root: str, epoch: int, k: int, attempt: int, wid: int,
         data, label = reader.read(i)
         _publish_batch(root, epoch, i, data, label)
     hb.beat()
+    # the decode-worker span: one io.range complete event per served
+    # range, stamped with the service trace id — the worker's lane in
+    # the merged cluster timeline
+    ctx = _tracing.current_trace()
+    dur_s = time.perf_counter() - t_range0
+    _tracing.emit_complete(
+        f"io.range[{k}]", _tracing.now_us() - dur_s * 1e6, dur_s * 1e6,
+        cat="io.service",
+        args={"epoch": epoch, "range": k, "attempt": attempt,
+              "worker": wid, "lo": lo, "hi": hi,
+              **({"trace_id": ctx.trace_id} if ctx else {})})
     if not os.path.exists(_reclaim_path(root, epoch, k, attempt)):
         _atomic_json(_done_path(root, epoch, k),
                      {"worker": wid, "attempt": attempt, "lo": lo,
@@ -619,6 +644,7 @@ class DatasetService:
                         or "spawn")
         self._procs: List[Any] = []
         self._closed = False
+        self.trace_id: Optional[str] = None   # minted at start()
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "DatasetService":
@@ -636,17 +662,37 @@ class DatasetService:
                       "stale_s": self.stale_s,
                       "workers": self.num_workers, "wall": time.time()})
         ctx = mp.get_context(self._method)
-        for wid in range(self.num_workers):
-            cfg = dict(root=self.root, worker=wid, source=self.source,
-                       n_batches=self.n_batches,
-                       range_size=self.range_size,
-                       heartbeat_s=self.heartbeat_s,
-                       stale_s=self.stale_s, poll_s=self.poll_s)
-            proc = ctx.Process(target=_worker_main, args=(cfg,),
-                               daemon=True,
-                               name=f"io-service-worker:{wid}")
-            proc.start()
-            self._procs.append(proc)
+        # the service-level trace context: minted at dispatch (here),
+        # carried into every worker's io.range spans — the io half of
+        # the request-scoped tracing the Router mints for serving
+        from ..telemetry import tracing as _tracing
+
+        self.trace_id = _tracing.new_trace_id("io")
+        prev_role = os.environ.get("MXNET_TPU_TELEMETRY_ROLE")
+        try:
+            for wid in range(self.num_workers):
+                cfg = dict(root=self.root, worker=wid,
+                           source=self.source,
+                           n_batches=self.n_batches,
+                           range_size=self.range_size,
+                           heartbeat_s=self.heartbeat_s,
+                           stale_s=self.stale_s, poll_s=self.poll_s,
+                           trace_id=self.trace_id)
+                # the child inherits os.environ at spawn/fork: with a
+                # shared MXNET_TPU_TELEMETRY root armed, each decode
+                # worker exports into its own io_worker subdir
+                os.environ["MXNET_TPU_TELEMETRY_ROLE"] = \
+                    f"io_worker:{wid}"
+                proc = ctx.Process(target=_worker_main, args=(cfg,),
+                                   daemon=True,
+                                   name=f"io-service-worker:{wid}")
+                proc.start()
+                self._procs.append(proc)
+        finally:
+            if prev_role is None:
+                os.environ.pop("MXNET_TPU_TELEMETRY_ROLE", None)
+            else:
+                os.environ["MXNET_TPU_TELEMETRY_ROLE"] = prev_role
         return self
 
     def start_epoch(self, epoch: int = 0) -> None:

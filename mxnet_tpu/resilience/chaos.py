@@ -64,6 +64,9 @@ SITES = (
     "aot.write",          # CompileCache publish, payload staged, pre-rename
     "aot.deserialize",    # cached_jit payload deserialize on a store hit
     "telemetry.export",   # telemetry exporter exposition (file write/HTTP)
+    "telemetry.scrape",   # ClusterScraper shared-root scrape (a faulting
+                          # scraper degrades warn-once and never reaches
+                          # the serving/training loop)
     "dist.heartbeat",     # elastic heartbeat beat loop (kill = dead rank,
                           # delay = wedged host whose peers see it stale)
     "dist.collective",    # elastic collective entry (kill:N = rank death

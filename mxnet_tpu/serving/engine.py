@@ -208,6 +208,13 @@ class InferenceEngine:
             self._queue, self._run_batch, self.max_batch_size,
             self.max_delay_ms, metrics=self.metrics)
         self._batcher.start()
+        # external /healthz answers from the same batcher-loop liveness
+        # seam the fleet heartbeats gate on (unregistered at close)
+        from ..telemetry import exporter as _texporter
+
+        _texporter.register_liveness(
+            f"infer:{id(self):x}",
+            lambda: {"alive": self.alive, "last_tick": self.last_tick})
 
     # -- model plumbing ---------------------------------------------------
     def _build(self, example_input) -> None:
@@ -421,6 +428,9 @@ class InferenceEngine:
         """Shut down: stop admitting, then either finish everything
         queued (``drain=True``) or fail it with :class:`ServerOverload`.
         Idempotent; the batcher thread exits either way."""
+        from ..telemetry import exporter as _texporter
+
+        _texporter.unregister_liveness(f"infer:{id(self):x}")
         with self._close_lock:
             if self._closed:
                 return

@@ -69,6 +69,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..base import FatalError, TransientError, env_float
 from ..resilience import chaos
 from ..telemetry import flight as _flight
+from ..telemetry import tracing as _tracing
 from ..telemetry.registry import get_registry
 from .admission import (DeadlineExceeded, Request, RequestCancelled,
                         ServerOverload)
@@ -169,7 +170,8 @@ class FleetRequest(Request):
     retry after replica death can never double-deliver."""
 
     __slots__ = ("tenant", "key", "max_new_tokens", "eos_token",
-                 "on_token", "units", "readmits", "hedges", "attempt_n")
+                 "on_token", "units", "readmits", "hedges", "attempt_n",
+                 "trace")
 
     def __init__(self, prompt, max_new_tokens: int, tenant: str,
                  deadline: Optional[float], units: int,
@@ -177,6 +179,13 @@ class FleetRequest(Request):
         super().__init__(prompt, 1, ("fleet",), deadline)
         self.tenant = tenant
         self.key = f"{tenant}-{next(_req_seq)}"
+        # request-scoped distributed trace, minted HERE (the cluster's
+        # front door): every attempt — original, hedge twin,
+        # re-admission, across the subprocess pipe — carries the same
+        # trace id into the serving engine's step spans
+        self.trace = _tracing.TraceContext(
+            trace_id=_tracing.new_trace_id("req"),
+            parent_span="fleet.submit")
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token = eos_token
         self.on_token = on_token
@@ -298,6 +307,15 @@ class FleetMetrics:
         self.request_ms = reg.histogram(
             "fleet_request_ms", "End-to-end fleet request latency",
             ("fleet", "tenant"))
+        # the hedge threshold's latency window: ONE registry histogram
+        # (rolling p50/p95/p99 exported as gauge series) instead of the
+        # router's former private deque — the same p99 definition the
+        # exposition, the SLO sentinel and fleet_bench read
+        self.attempt_ms = reg.histogram(
+            "fleet_attempt_ms",
+            "Completed fleet request latency across tenants (the "
+            "hedge-threshold window)", ("fleet",),
+            cap=512).labels(fleet=fleet)
 
     def count(self, event: str, n: int = 1) -> None:
         self._events.labels(fleet=self.fleet, event=event).inc(n)
@@ -396,7 +414,7 @@ class _LocalHost:
             return self.engine.submit(
                 req.payload, req.max_new_tokens,
                 eos_token=req.eos_token, timeout_ms=timeout_ms,
-                on_token=req.on_token)
+                on_token=req.on_token, trace_id=req.trace.trace_id)
         return self.engine.infer_async(req.payload, timeout_ms=timeout_ms)
 
     # -- lifecycle --------------------------------------------------------
@@ -477,6 +495,17 @@ class _ProcHost:
                     for k, v in self._spec.get("env", {}).items()})
         env.update({k: str(v) for k, v in self._spec.get(
             "env_by_index", {}).get(str(self._index), {}).items()})
+        # cluster telemetry identity: with a shared MXNET_TPU_TELEMETRY
+        # root armed (inherited from the parent env) each worker
+        # exports into its own proc_fleet_replica_r<i>_p<pid> subdir.
+        # An explicit spec env wins; the PARENT's inherited role must
+        # not (the worker is a replica regardless of who launched it)
+        if not any("MXNET_TPU_TELEMETRY_ROLE" in d for d in (
+                self._spec.get("env", {}),
+                self._spec.get("env_by_index", {}).get(
+                    str(self._index), {}))):
+            env["MXNET_TPU_TELEMETRY_ROLE"] = \
+                f"fleet_replica:{self._index}"
         env["MXT_FLEET_WORKER_SPEC"] = json.dumps({
             **{k: v for k, v in self._spec.items()
                if k not in ("env", "env_by_index")},
@@ -589,6 +618,11 @@ class _ProcHost:
                 "max_new": req.max_new_tokens,
                 "eos": req.eos_token,
                 "timeout_ms": timeout_ms,
+                # trace context rides the JSON-lines pipe: the worker's
+                # engine stamps it into its step[llm_*] spans, so the
+                # merged cluster timeline follows the request across
+                # the process boundary
+                "trace": req.trace.to_dict(),
             })
         except (OSError, ValueError) as e:
             with self._plock:
@@ -1102,7 +1136,7 @@ class Router:
         self._lock = threading.RLock()
         self._inflight: Dict[FleetRequest, List[_Attempt]] = {}
         self._t_inflight: Dict[str, int] = {}
-        self._latencies: deque = deque(maxlen=512)
+        self._observed_n = 0     # completions THIS router observed
         # idempotence keys already delivered (exactly-once proof);
         # bounded — the one-shot FleetRequest event is the real guard,
         # this set just makes double-delivery *observable*
@@ -1204,6 +1238,12 @@ class Router:
             self.metrics.tenant_inflight.labels(
                 fleet=self.pool.name, tenant=tenant).set(
                     self._t_inflight[tenant])
+        # the trace's birth certificate on the router's own timeline
+        # (the dispatching process is one lane of the merged trace)
+        _tracing.emit_instant(
+            "fleet.submit", cat="fleet",
+            args={"trace_id": freq.trace.trace_id, "tenant": tenant,
+                  "fleet": self.pool.name, "units": units})
         try:
             self._dispatch(freq, exclude=(), is_hedge=False)
         except BaseException:
@@ -1336,13 +1376,19 @@ class Router:
     def _hedge_threshold(self) -> float:
         if self._hedge_s <= 0:
             return float("inf")
-        lat = list(self._latencies)
-        if len(lat) < 20:
+        # the registry histogram IS the latency window (recency
+        # reservoir, cap 512): one p-percentile definition shared with
+        # the exposition's fleet_attempt_ms_p* gauge series. The
+        # warmup gate counts THIS router's own completions — the
+        # registry series outlives a closed router, and a fresh
+        # incarnation over the same fleet name must not compute its
+        # threshold purely from its predecessor's (e.g. death-spike)
+        # window before re-observing 20 of its own.
+        if self._observed_n < 20:
             return self._hedge_s
-        lat.sort()
-        idx = min(len(lat) - 1,
-                  int(len(lat) * self._hedge_pct / 100.0))
-        return max(self._hedge_s, lat[idx])
+        return max(self._hedge_s,
+                   self.metrics.attempt_ms.quantile(
+                       self._hedge_pct / 100.0) / 1e3)
 
     def _tick(self) -> None:
         now = time.monotonic()
@@ -1461,7 +1507,9 @@ class Router:
             if duplicate or not freq.finish(att.handle.result()):
                 self.metrics.count("hedge_losses")
                 return
-            self._latencies.append(time.monotonic() - freq.enqueue_t)
+            self.metrics.attempt_ms.observe(
+                (time.monotonic() - freq.enqueue_t) * 1e3)
+            self._observed_n += 1
             self.metrics.count("completed")
             self.metrics.count_tenant(freq.tenant, "completed")
             if att.is_hedge:
@@ -1731,12 +1779,14 @@ def _worker_main() -> None:  # pragma: no cover — subprocess entry
         if op != "submit":
             continue
         rid = msg.get("id")
+        trace = msg.get("trace") or {}
         try:
             handle = eng.submit(
                 onp.asarray(msg["prompt"], onp.int32),
                 int(msg["max_new"]),
                 eos_token=msg.get("eos"),
-                timeout_ms=msg.get("timeout_ms"))
+                timeout_ms=msg.get("timeout_ms"),
+                trace_id=trace.get("trace_id"))
         except Exception as e:  # noqa: BLE001 — typed shed
             from ..resilience.retry import TRANSIENT, classify
 

@@ -69,11 +69,12 @@ class GenRequest(Request):
     """
 
     __slots__ = ("prompt", "max_new_tokens", "eos_token", "on_token",
-                 "tokens", "prefill_s", "first_token_s")
+                 "tokens", "prefill_s", "first_token_s", "trace_id")
 
     def __init__(self, prompt, max_new_tokens: int, eos_token: int,
                  deadline: Optional[float],
-                 on_token: Optional[Callable[[int], None]] = None):
+                 on_token: Optional[Callable[[int], None]] = None,
+                 trace_id: Optional[str] = None):
         super().__init__(prompt, 1, ("llm",), deadline)
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
@@ -82,6 +83,12 @@ class GenRequest(Request):
         self.tokens: List[int] = []
         self.prefill_s: Optional[float] = None
         self.first_token_s: Optional[float] = None
+        # distributed-trace identity: minted at the cluster's front
+        # door (Router admission) and propagated — the scheduler stamps
+        # it into the step[llm_*] spans of every step that served this
+        # request, so the merged cluster timeline is filterable per
+        # request
+        self.trace_id = trace_id
 
 
 class _Lane:
@@ -479,6 +486,14 @@ class LLMEngine:
         self._thread = threading.Thread(target=self._loop,
                                         name="llm-scheduler", daemon=True)
         self._thread.start()
+        # /healthz answers from the SAME seam the fleet heartbeats gate
+        # on: an external probe sees a wedged scheduler exactly when
+        # the in-cluster health monitor does (unregistered at close)
+        from ..telemetry import exporter as _texporter
+
+        _texporter.register_liveness(
+            f"llm:{self.metrics.engine_id}",
+            lambda: {"alive": self.alive, "last_tick": self.last_tick})
 
     # -- prompt bucketing --------------------------------------------------
     def _prefill_bucket(self, p: int) -> int:
@@ -590,8 +605,8 @@ class LLMEngine:
     def submit(self, prompt_ids, max_new_tokens: int,
                eos_token: Optional[int] = None,
                timeout_ms="default",
-               on_token: Optional[Callable[[int], None]] = None
-               ) -> GenRequest:
+               on_token: Optional[Callable[[int], None]] = None,
+               trace_id: Optional[str] = None) -> GenRequest:
         """Enqueue one prompt (1-D int sequence). Returns the
         :class:`GenRequest` handle; ``handle.wait()`` yields the
         generated int32 tokens. Raises :class:`ServerOverload` when the
@@ -623,9 +638,12 @@ class LLMEngine:
             timeout_ms = self._timeout_ms
         deadline = (time.monotonic() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
+        if trace_id is None:
+            ctx = telemetry.current_trace()
+            trace_id = ctx.trace_id if ctx is not None else None
         req = GenRequest(prompt, max_new_tokens,
                          self._eos if eos_token is None else eos_token,
-                         deadline, on_token)
+                         deadline, on_token, trace_id=trace_id)
         self._queue.submit(req)         # may raise ServerOverload
         self.metrics.count("submitted")
         return req
@@ -838,6 +856,8 @@ class LLMEngine:
             chaos.site("serving.llm", phase="prefill_splice",
                        prefix_hit_blocks=n_hit)
             with telemetry.step("llm_prefill") as st:
+                if req.trace_id is not None:
+                    st.annotate("trace_id", req.trace_id)
                 with st.phase("device", "llm.prefill"):
                     ran = True
                     if n_hit:
@@ -965,10 +985,26 @@ class LLMEngine:
                  self._key))
         return int(first)
 
+    def _lane_trace_ids(self, active: List[int]) -> List[str]:
+        """The distributed-trace ids of the requests the active lanes
+        carry (annotated onto every decode/spec step span so the
+        merged cluster timeline shows WHICH requests each step
+        served)."""
+        out: List[str] = []
+        for i in active:
+            lane = self._lanes[i]
+            tid = getattr(lane.req, "trace_id", None) if lane else None
+            if tid is not None:
+                out.append(tid)
+        return out
+
     def _decode_step(self, active: List[int]) -> None:
         t0 = time.perf_counter()
         self._step_seq += 1
         with telemetry.step("llm_decode", self._step_seq) as st:
+            tids = self._lane_trace_ids(active)
+            if tids:
+                st.annotate("trace_ids", tids)
             with st.phase("device", "llm.decode"):
                 nxt, self._pool_k, self._pool_v = self._decode_run(
                     self._params, self._toks, self._pool_k, self._pool_v,
@@ -1010,6 +1046,9 @@ class LLMEngine:
         t0 = time.perf_counter()
         self._step_seq += 1
         with telemetry.step("llm_spec", self._step_seq) as st:
+            tids = self._lane_trace_ids(active)
+            if tids:
+                st.annotate("trace_ids", tids)
             with st.phase("device", "llm.spec"):
                 # the draft-verify splice chaos site: an injected fault
                 # propagates to _fault(), which fails the in-flight
@@ -1371,6 +1410,9 @@ class LLMEngine:
         past ``timeout_s`` — whatever still sits in the admission queue
         is failed typed (:class:`ServerOverload`) so every ``wait()``
         returns."""
+        from ..telemetry import exporter as _texporter
+
+        _texporter.unregister_liveness(f"llm:{self.metrics.engine_id}")
         with self._close_lock:
             if self._closed:
                 return

@@ -19,13 +19,25 @@ package is the one substrate they all re-register into:
   dumped atomically on stalls, fatal faults, SIGTERM and chaos kills;
 - :mod:`.mfu` — online efficiency gauges: per-step MFU, achieved vs the
   banked ``benchmark/results_*.json`` roofline, HBM-utilization
-  estimate.
+  estimate;
+- :mod:`.cluster` — the cluster half: :class:`ClusterScraper` merges
+  every process's exposition on a shared telemetry root into one
+  snapshot + Prometheus text with ``process``/``role``/``rank`` labels,
+  derives the autoscaler gauges (``cluster_*``), and packages
+  cross-process **incident bundles** when any process dumps a
+  ``rank_lost`` / ``fleet_replica_dead`` / ``io_worker_lost``
+  post-mortem;
+- :mod:`.slo` — declarative :class:`SloRule`\\ s (p99 ceiling, tok/s
+  floor, starved ceiling, MFU-vs-roofline floor) evaluated over the
+  cluster snapshot stream; breaches emit typed :class:`SloViolation`
+  events, ``slo_*`` counters and an incident bundle.
 
-See ``docs/observability.md`` for the metric catalog and trace how-to.
+See ``docs/observability.md`` for the metric catalog, the shared-root
+cluster layout and trace how-to.
 """
 from __future__ import annotations
 
-from . import exporter, flight, mfu, tracing  # noqa: F401
+from . import cluster, exporter, flight, mfu, slo, tracing  # noqa: F401
 from .registry import (  # noqa: F401
     Counter,
     Gauge,
@@ -34,25 +46,34 @@ from .registry import (  # noqa: F401
     get_registry,
     sanitize_name,
 )
+from .cluster import ClusterScraper  # noqa: F401
+from .slo import SloRule, SloSentinel, SloViolation  # noqa: F401
 from .tracing import (  # noqa: F401
     BUCKETS,
     StepTimeline,
+    TraceContext,
     attribute,
     buffer,
     chrome_trace,
     current_step,
+    current_trace,
     dump_chrome,
+    new_trace_id,
     phase_if_active,
     span,
     step,
+    trace_scope,
 )
 
 __all__ = [
-    "BUCKETS", "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "StepTimeline", "attribute", "buffer", "chrome_trace", "current_step",
+    "BUCKETS", "ClusterScraper", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "SloRule", "SloSentinel", "SloViolation",
+    "StepTimeline", "TraceContext", "attribute", "buffer",
+    "chrome_trace", "cluster", "current_step", "current_trace",
     "dump_chrome", "exporter", "flight", "get_registry", "mfu",
-    "phase_if_active", "prometheus_text", "sanitize_name", "snapshot",
-    "span", "step", "tracing",
+    "new_trace_id", "phase_if_active", "prometheus_text",
+    "sanitize_name", "slo", "snapshot", "span", "step", "trace_scope",
+    "tracing",
 ]
 
 

@@ -1,0 +1,682 @@
+"""Fleet-wide telemetry: cluster scraping, merged exposition, incident
+bundles.
+
+PR 6 gave one process eyes; PRs 9–14 made the system a *cluster* — N
+fleet replicas (some subprocess-backed), M io.service decode workers, W
+elastic ranks, each exporting into its own per-process subdir under one
+shared ``MXNET_TPU_TELEMETRY=<root>`` (see :mod:`.exporter`). This
+module is the cluster half of the observability layer:
+
+- :class:`ClusterScraper` walks the shared root, merges every process's
+  exposition into ONE cluster snapshot (:meth:`ClusterScraper.scrape`)
+  and one Prometheus text with ``process``/``role``/``rank`` labels
+  (:meth:`ClusterScraper.prometheus_text`), and derives the cluster
+  gauges the fleet autoscaler needs — aggregate tok/s, total free KV
+  blocks, ``fleet_free_units``, the min/max export heartbeat age, the
+  world input-starved fraction — published back into the local registry
+  as ``cluster_*`` series. With ``root=None`` it scrapes the local
+  in-process registry as a single-process cluster (how a router-side
+  SLO sentinel or autoscaler runs without a shared filesystem).
+  Scraping passes the ``telemetry.scrape`` chaos site and
+  :meth:`ClusterScraper.scrape_guarded` degrades warn-once — a faulting
+  scraper never reaches the serving/training loop.
+- **Incident bundles** — when any process publishes a flight
+  post-mortem for a cross-process failure (``rank_lost``,
+  ``fleet_replica_dead``, ``io_worker_lost``, ``slo_violation``), the
+  flight recorder triggers :func:`maybe_build_incident`: one sweep of
+  the shared root packages EVERY process's flight dumps + last
+  snapshots into ``<root>/incidents/incident_<seq>/`` with a causality
+  summary (events ordered by wall clock, the suspect named by the first
+  dump, the stalest heartbeat) — the cross-process post-mortem the kill
+  drills used to leave scattered over N private dirs.
+
+``tools/trace_view.py --merge-root <root>`` is the timeline twin: it
+stitches the per-process ``trace.json`` dumps into one clock-aligned
+Perfetto timeline using each process's ``anchor.json``.
+
+See ``docs/observability.md`` (cluster section) for the shared-root
+layout and the incident bundle format.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import warnings
+from typing import Dict, List, Optional
+
+from .registry import get_registry
+from . import exporter as _exporter
+
+__all__ = [
+    "ClusterScraper", "discover_processes", "scrape_period_s",
+    "build_incident", "maybe_build_incident", "list_incidents",
+    "INCIDENT_REASON_PREFIXES", "SNAPSHOT_SCHEMA", "INCIDENT_SCHEMA",
+]
+
+log = logging.getLogger(__name__)
+
+SNAPSHOT_SCHEMA = "mxnet_tpu.cluster/1"
+INCIDENT_SCHEMA = "mxnet_tpu.incident/1"
+
+#: Flight-dump reasons that describe a CROSS-PROCESS failure — the ones
+#: worth sweeping the whole root for. Matched as prefixes (the reason
+#: tail carries the suspect, e.g. ``fleet_replica_dead:fleet0.r1``).
+INCIDENT_REASON_PREFIXES = (
+    "rank_lost", "fleet_replica_dead", "io_worker_lost",
+    "cluster_degraded", "slo_violation",
+)
+
+
+def scrape_period_s() -> float:
+    """``MXNET_TPU_TELEMETRY_SCRAPE_S`` (default 5 s) — the background
+    scrape cadence of :meth:`ClusterScraper.start`."""
+    try:
+        v = float(os.environ.get("MXNET_TPU_TELEMETRY_SCRAPE_S", "") or 5.0)
+    except ValueError:
+        return 5.0
+    return max(0.05, v)
+
+
+# ---------------------------------------------------------------------------
+# shared-root discovery
+# ---------------------------------------------------------------------------
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # torn/missing — the writer is mid-replace or dead
+
+
+def discover_processes(root: str) -> List[Dict]:
+    """Every process exporting under ``root``: the ``proc_*`` subdirs
+    (cluster mode) plus the root itself when it carries a flat
+    exposition (a single role-less process). Each entry:
+    ``{key, dir, role, rank, pid, age_s, anchor}`` — ``age_s`` is the
+    seconds since the process's last exposition (its export heartbeat;
+    a dead process's age grows without bound), ``anchor`` the clock
+    anchor payload (None until its first exposition lands)."""
+    out: List[Dict] = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return out
+    now = time.time()
+    candidates: List[tuple] = []
+    if os.path.exists(os.path.join(root, "metrics.json")):
+        candidates.append(("main", root, None))
+    for n in names:
+        m = _exporter.PROC_DIR_RE.match(n)
+        if m:
+            candidates.append((n, os.path.join(root, n), m))
+    for key, d, m in candidates:
+        anchor = _read_json(os.path.join(d, "anchor.json"))
+        try:
+            age = now - os.stat(os.path.join(d, "metrics.json")).st_mtime
+        except OSError:
+            age = None
+        role = (m.group("role") if m is not None
+                else (anchor or {}).get("role") or "main")
+        rank = (int(m.group("rank")) if m is not None
+                else int((anchor or {}).get("rank") or 0))
+        pid = (int(m.group("pid")) if m is not None
+               else (anchor or {}).get("pid"))
+        out.append({"key": key, "dir": d, "role": role, "rank": rank,
+                    "pid": pid, "age_s": age, "anchor": anchor})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# derivation: the autoscaler gauges
+# ---------------------------------------------------------------------------
+
+def _series_sum(metrics: Dict, name: str) -> float:
+    total = 0.0
+    for s in metrics.get(name, {}).get("series", ()):
+        v = s.get("value")
+        if isinstance(v, (int, float)):
+            total += float(v)
+    return total
+
+
+def _series_max(metrics: Dict, name: str) -> Optional[float]:
+    best = None
+    for s in metrics.get(name, {}).get("series", ()):
+        v = s.get("value")
+        if isinstance(v, (int, float)):
+            best = float(v) if best is None else max(best, float(v))
+    return best
+
+
+def _hist_totals(metrics: Dict, name: str,
+                 want_labels: Optional[Dict[str, str]] = None
+                 ) -> tuple:
+    """``(sum, count)`` over a histogram family's series (summaries
+    carry mean+count; ``sum = mean*count``), optionally filtered to
+    series matching ``want_labels``."""
+    total, count = 0.0, 0
+    for s in metrics.get(name, {}).get("series", ()):
+        if want_labels and any(s.get("labels", {}).get(k) != v
+                               for k, v in want_labels.items()):
+            continue
+        summ = s.get("summary") or {}
+        c = int(summ.get("count", 0))
+        total += float(summ.get("mean", 0.0)) * c
+        count += c
+    return total, count
+
+
+def derive(processes: Dict[str, Dict]) -> Dict:
+    """The cluster-level gauges from the per-process snapshots — the
+    exact quantities the ROADMAP's fleet autoscaler is blocked on
+    (they existed only per-process before this module)."""
+    tok_s = pool_free = pool_total = 0.0
+    fleet_free = fleet_cap = 0.0
+    lanes_active = 0.0
+    starved_ms = wall_ms = 0.0
+    stale_n = 0
+    ages: List[float] = []
+    roles: Dict[str, int] = {}
+    for p in processes.values():
+        roles[p.get("role") or "main"] = \
+            roles.get(p.get("role") or "main", 0) + 1
+        if p.get("age_s") is not None:
+            ages.append(float(p["age_s"]))
+        if p.get("stale"):
+            # a dead/wedged process's LAST exposition must not keep
+            # feeding the autoscaler gauges forever — a killed
+            # replica's final tok_s would read as phantom capacity.
+            # Stale entries still count in processes_by_role and ages
+            # (the staleness itself is the signal).
+            stale_n += 1
+            continue
+        m = (p.get("metrics") or {}).get("metrics", {})
+        tok_s += _series_sum(m, "llm_tok_s")
+        pool_free += _series_sum(m, "llm_pool_blocks_free")
+        pool_total += _series_sum(m, "llm_pool_blocks_total")
+        lanes_active += _series_sum(m, "llm_lanes_active")
+        fleet_free += _series_sum(m, "fleet_free_units")
+        fleet_cap += _series_sum(m, "fleet_capacity_units")
+        s_ms, _ = _hist_totals(m, "telemetry_step_bucket_ms",
+                               {"bucket": "input_starved"})
+        w_ms, _ = _hist_totals(m, "telemetry_step_ms")
+        starved_ms += s_ms
+        wall_ms += w_ms
+    return {
+        "processes": len(processes),
+        "processes_stale": stale_n,
+        "processes_by_role": roles,
+        "tok_s_total": round(tok_s, 3),
+        "llm_pool_blocks_free_total": pool_free,
+        "llm_pool_blocks_total": pool_total,
+        "llm_lanes_active_total": lanes_active,
+        "fleet_free_units": fleet_free,
+        "fleet_capacity_units": fleet_cap,
+        "export_age_min_s": round(min(ages), 3) if ages else None,
+        "export_age_max_s": round(max(ages), 3) if ages else None,
+        "input_starved_frac":
+            round(starved_ms / wall_ms, 5) if wall_ms > 0 else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# merged Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def _escape(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _relabel_line(line: str, extra: str) -> str:
+    """Inject pre-rendered ``extra`` labels into one exposition sample
+    line (``name 3`` / ``name{a="b"} 3`` — label values may contain
+    escaped braces-free text; the FIRST ``{`` and LAST ``}`` delimit
+    the label set in the 0.0.4 grammar)."""
+    brace = line.find("{")
+    if brace < 0:
+        sp = line.find(" ")
+        if sp < 0:
+            return line
+        return f"{line[:sp]}{{{extra}}}{line[sp:]}"
+    close = line.rfind("}")
+    if close < 0:
+        return line
+    inner = line[brace + 1:close]
+    merged = f"{extra},{inner}" if inner else extra
+    return f"{line[:brace]}{{{merged}}}{line[close + 1:]}"
+
+
+def merge_prometheus(texts: Dict[str, tuple]) -> str:
+    """Merge per-process expositions into one cluster text:
+    ``texts`` maps process key -> ``(role, rank, prom_text)``. Every
+    sample line gains ``process``/``role``/``rank`` labels; ``# HELP``/
+    ``# TYPE`` metadata is kept once per family (first writer wins —
+    the families are shared definitions, identical across
+    processes)."""
+    seen_meta: set = set()
+    out: List[str] = []
+    for key, (role, rank, text) in sorted(texts.items()):
+        extra = (f'process="{_escape(key)}",role="{_escape(role)}",'
+                 f'rank="{_escape(rank)}"')
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                if line not in seen_meta:
+                    seen_meta.add(line)
+                    out.append(line)
+                continue
+            out.append(_relabel_line(line, extra))
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# the scraper
+# ---------------------------------------------------------------------------
+
+class ClusterScraper:
+    """Merge a shared telemetry root into cluster snapshots + one
+    labelled exposition, deriving the autoscaler gauges.
+
+    Parameters
+    ----------
+    root : str, optional
+        The shared telemetry root N processes export into. ``None`` ⇒
+        scrape the local in-process registry as a single-process
+        cluster (an in-router sentinel/autoscaler needs no shared
+        filesystem).
+    stale_s : float, optional
+        Export age beyond which a process is counted stale in the
+        snapshot (default ``max(3 x scrape period, 15 s)``).
+    """
+
+    def __init__(self, root: Optional[str] = None,
+                 stale_s: Optional[float] = None):
+        self.root = os.path.abspath(root) if root else None
+        period = scrape_period_s()
+        self.stale_s = float(stale_s if stale_s is not None
+                             else max(3.0 * period, 15.0))
+        self._lock = threading.Lock()
+        self._warned = False
+        self.last: Optional[Dict] = None        # last good snapshot
+        self._texts: Dict[str, tuple] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = get_registry()
+        self._g_scrapes = reg.counter(
+            "cluster_scrapes_total", "Cluster scrapes attempted",
+            ("result",))
+        self._g = {
+            "tok_s_total": reg.gauge(
+                "cluster_tok_s",
+                "Aggregate decode tokens/s over every process"),
+            "llm_pool_blocks_free_total": reg.gauge(
+                "cluster_pool_blocks_free",
+                "Total free KV blocks over every engine in the cluster"),
+            "llm_pool_blocks_total": reg.gauge(
+                "cluster_pool_blocks_total",
+                "Total KV blocks over every engine in the cluster"),
+            "llm_lanes_active_total": reg.gauge(
+                "cluster_lanes_active",
+                "Decode lanes active over every engine in the cluster"),
+            "fleet_free_units": reg.gauge(
+                "cluster_fleet_free_units",
+                "Free fleet capacity units summed over routers"),
+            "fleet_capacity_units": reg.gauge(
+                "cluster_fleet_capacity_units",
+                "Live fleet capacity units summed over routers"),
+            "processes": reg.gauge(
+                "cluster_processes",
+                "Processes exporting into the shared telemetry root"),
+            "processes_stale": reg.gauge(
+                "cluster_processes_stale",
+                "Processes whose exposition is older than stale_s "
+                "(dead/wedged; excluded from the derived sums)"),
+            "export_age_min_s": reg.gauge(
+                "cluster_export_age_min_s",
+                "Freshest process exposition age (the export "
+                "heartbeat)"),
+            "export_age_max_s": reg.gauge(
+                "cluster_export_age_max_s",
+                "Stalest process exposition age"),
+            "input_starved_frac": reg.gauge(
+                "cluster_input_starved_frac",
+                "World fraction of step wall time attributed "
+                "input_starved"),
+        }
+
+    # -- one scrape --------------------------------------------------------
+    def scrape(self) -> Dict:
+        """One cluster snapshot (raises on fault — looped callers go
+        through :meth:`scrape_guarded`): per-process registry snapshots
+        keyed by process, plus the derived ``cluster`` block. Passes
+        the ``telemetry.scrape`` chaos site."""
+        from ..resilience import chaos
+
+        chaos.site("telemetry.scrape", root=self.root or "<local>")
+        processes: Dict[str, Dict] = {}
+        texts: Dict[str, tuple] = {}
+        if self.root is None:
+            role, rank = _exporter.process_identity()
+            role = role or "main"
+            reg = get_registry()
+            processes[f"local_{role}_r{rank}"] = {
+                "role": role, "rank": rank, "pid": os.getpid(),
+                "age_s": 0.0, "metrics": reg.snapshot(),
+            }
+            texts[f"local_{role}_r{rank}"] = (role, rank,
+                                              reg.prometheus_text())
+        else:
+            for p in discover_processes(self.root):
+                snap = _read_json(os.path.join(p["dir"], "metrics.json"))
+                if snap is None:
+                    continue  # torn mid-replace or never exported
+                entry = {"role": p["role"], "rank": p["rank"],
+                         "pid": p["pid"], "age_s": p["age_s"],
+                         "stale": (p["age_s"] is not None
+                                   and p["age_s"] > self.stale_s),
+                         "metrics": snap}
+                processes[p["key"]] = entry
+                try:
+                    with open(os.path.join(p["dir"],
+                                           "metrics.prom")) as f:
+                        texts[p["key"]] = (p["role"], p["rank"],
+                                           f.read())
+                except OSError:
+                    pass
+        derived = derive(processes)
+        snap = {"schema": SNAPSHOT_SCHEMA, "ts_unix": time.time(),
+                "root": self.root, "processes": processes,
+                "cluster": derived}
+        for k, fam in self._g.items():
+            v = derived.get(k)
+            if isinstance(v, (int, float)):
+                fam.set(float(v))
+        self._g_scrapes.labels(result="ok").inc()
+        with self._lock:
+            self.last = snap
+            self._texts = texts
+        return snap
+
+    def scrape_guarded(self) -> Optional[Dict]:
+        """A scrape that NEVER raises: any fault (chaos-injected via
+        ``telemetry.scrape``, or real — unreadable root, torn files)
+        counts a failure, warns ONCE per process and returns the last
+        good snapshot (or None) — scraping is observability, and a
+        broken scraper must degrade, not take a control loop with
+        it."""
+        try:
+            return self.scrape()
+        except BaseException as e:  # noqa: BLE001 — degrade warn-once
+            self._g_scrapes.labels(result="error").inc()
+            if not self._warned:
+                self._warned = True
+                warnings.warn(
+                    f"cluster scraper: scrape failed ({e!r}); serving "
+                    "the last good snapshot and retrying next period",
+                    RuntimeWarning, stacklevel=2)
+            with self._lock:
+                return self.last
+
+    def prometheus_text(self, refresh: bool = False) -> str:
+        """The merged cluster exposition (``process``/``role``/``rank``
+        labels on every series) from the newest scrape
+        (``refresh=True`` scrapes first, guarded)."""
+        if refresh or self.last is None:
+            self.scrape_guarded()
+        with self._lock:
+            texts = dict(self._texts)
+        return merge_prometheus(texts)
+
+    # -- background loop ---------------------------------------------------
+    def start(self, period_s: Optional[float] = None) -> "ClusterScraper":
+        """Scrape on a cadence (``MXNET_TPU_TELEMETRY_SCRAPE_S``) from
+        a daemon thread — what keeps the ``cluster_*`` gauges fresh for
+        an in-process subscriber (SLO sentinel, autoscaler)."""
+        if self._thread is not None:
+            return self
+        period = float(period_s if period_s is not None
+                       else scrape_period_s())
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(period):
+                self.scrape_guarded()
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="mxnet_tpu-cluster-scraper")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def __enter__(self) -> "ClusterScraper":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# incident bundles
+# ---------------------------------------------------------------------------
+
+_incident_lock = threading.Lock()
+_incident_last: Dict[str, float] = {}
+_incident_window_s = 30.0
+
+_reg = get_registry()
+_g_incidents = _reg.counter(
+    "cluster_incidents_total", "Incident bundles built", ("reason",))
+
+
+def _reason_prefix(reason: str) -> str:
+    return str(reason).split(":", 1)[0]
+
+
+def list_incidents(root: str) -> List[str]:
+    d = os.path.join(os.path.abspath(root), "incidents")
+    try:
+        return sorted(os.path.join(d, n) for n in os.listdir(d)
+                      if n.startswith("incident_"))
+    except OSError:
+        return []
+
+
+def _collect_flight_events(proc_key: str, dump_dir: str) -> List[Dict]:
+    from .flight import FlightRecorder
+
+    events: List[Dict] = []
+    for path in FlightRecorder.list_dumps(dump_dir):
+        payload = _read_json(path)
+        if not payload:
+            continue
+        events.append({
+            "ts_unix": payload.get("ts_unix"),
+            "process": proc_key,
+            "pid": payload.get("pid"),
+            "reason": payload.get("reason"),
+            "file": os.path.basename(path),
+        })
+    return events
+
+
+def build_incident(root: str, reason: str,
+                   trigger: Optional[Dict] = None) -> str:
+    """Sweep the shared root and package one incident bundle:
+    ``incident_<seq>/`` holding every process's flight dumps + last
+    ``metrics.json``/``anchor.json``, and a ``summary.json`` causality
+    record — dumps ordered by wall clock (the first names the suspect:
+    on a replica kill, the victim's own pre-exit dump precedes the
+    detector's), suspects extracted from the typed reason tails, and
+    the stalest export heartbeat at sweep time. Returns the bundle
+    directory."""
+    root = os.path.abspath(root)
+    inc_root = os.path.join(root, "incidents")
+    os.makedirs(inc_root, exist_ok=True)
+    bundle = None
+    for seq in range(1, 10000):
+        cand = os.path.join(inc_root, f"incident_{seq:04d}")
+        try:
+            os.makedirs(cand)          # exist_ok=False: the seq claim
+            bundle = cand
+            break
+        except FileExistsError:
+            continue
+    if bundle is None:  # pragma: no cover — 10k incidents in one root
+        raise OSError(f"no free incident slot under {inc_root}")
+
+    events: List[Dict] = []
+    proc_meta: Dict[str, Dict] = {}
+    for p in discover_processes(root):
+        key = p["key"]
+        dst = os.path.join(bundle, key)
+        os.makedirs(dst, exist_ok=True)
+        for name in ("metrics.json", "anchor.json"):
+            src = os.path.join(p["dir"], name)
+            if os.path.exists(src):
+                try:
+                    shutil.copy2(src, os.path.join(dst, name))
+                except OSError:
+                    pass
+        fdir = os.path.join(p["dir"], "flight")
+        proc_events = _collect_flight_events(key, fdir)
+        for ev in proc_events:
+            try:
+                shutil.copy2(os.path.join(fdir, ev["file"]),
+                             os.path.join(dst, ev["file"]))
+            except OSError:
+                pass
+        events.extend(proc_events)
+        # heartbeat ages from the last snapshot (elastic ranks publish
+        # per-rank ages; every process has its export age)
+        snap = _read_json(os.path.join(p["dir"], "metrics.json")) or {}
+        hb = {}
+        for s in snap.get("metrics", {}).get(
+                "elastic_last_heartbeat_age_s", {}).get("series", ()):
+            hb[",".join(f"{k}={v}" for k, v in
+                        sorted(s.get("labels", {}).items()))] = \
+                s.get("value")
+        proc_meta[key] = {"role": p["role"], "rank": p["rank"],
+                          "pid": p["pid"],
+                          "export_age_s": p["age_s"],
+                          "heartbeat_ages_s": hb or None}
+
+    events.sort(key=lambda e: (e.get("ts_unix") or 0.0))
+    suspects: List[str] = []
+    for ev in events:
+        r = str(ev.get("reason") or "")
+        # only typed cross-process reasons name a suspect in their
+        # tail (fleet_replica_dead:<name>, rank_lost:<k>, ...) — a
+        # chaos_kill:<site> tail is a site name, not an identity
+        if ":" in r and _reason_prefix(r) in INCIDENT_REASON_PREFIXES:
+            tail = r.split(":", 1)[1]
+            if tail and tail not in suspects:
+                suspects.append(tail)
+    # the triggering reason's suspect counts even when its dump has not
+    # landed on the shared root (the builder may run before its own
+    # process's mirror write becomes visible)
+    if ":" in str(reason) \
+            and _reason_prefix(reason) in INCIDENT_REASON_PREFIXES:
+        tail = str(reason).split(":", 1)[1]
+        if tail and tail not in suspects:
+            suspects.append(tail)
+    stalest = None
+    for key, meta in proc_meta.items():
+        a = meta.get("export_age_s")
+        if a is not None and (stalest is None
+                              or a > stalest["export_age_s"]):
+            stalest = {"process": key, "export_age_s": round(a, 3)}
+    summary = {
+        "schema": INCIDENT_SCHEMA,
+        "reason": str(reason),
+        "ts_unix": time.time(),
+        "built_by_pid": os.getpid(),
+        "trigger": {"reason": (trigger or {}).get("reason"),
+                    "pid": (trigger or {}).get("pid"),
+                    "ts_unix": (trigger or {}).get("ts_unix")}
+        if trigger else None,
+        "processes": proc_meta,
+        "events": events,
+        "first_event": events[0] if events else None,
+        "suspects": suspects,
+        "first_stale": stalest,
+    }
+    tmp = os.path.join(bundle, f"summary.json.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(summary, f, indent=1)
+    os.replace(tmp, os.path.join(bundle, "summary.json"))
+    _g_incidents.labels(reason=_reason_prefix(reason)).inc()
+    log.warning("incident bundle %s built for %r (%d flight dumps, "
+                "%d processes)", bundle, reason, len(events),
+                len(proc_meta))
+    return bundle
+
+
+def maybe_build_incident(reason: str,
+                         trigger: Optional[Dict] = None
+                         ) -> Optional[str]:
+    """The flight recorder's hook: build a bundle when ``reason`` names
+    a cross-process failure AND this process exports into a shared
+    root. Deduped per reason-class inside a 30 s window (a kill drill's
+    detector and its victims all dump within one incident — one bundle,
+    not one per dump). Never raises."""
+    try:
+        prefix = _reason_prefix(reason)
+        if prefix not in INCIDENT_REASON_PREFIXES:
+            return None
+        root = _exporter.active_file_root()
+        if root is None:
+            return None
+        now = time.monotonic()
+        with _incident_lock:
+            last = _incident_last.get(prefix, -1e18)
+            if now - last < _incident_window_s:
+                return None
+            _incident_last[prefix] = now
+        if not _claim_incident(root, prefix):
+            return None
+        return build_incident(root, reason, trigger)
+    except Exception as e:  # noqa: BLE001 — correlation is best-effort
+        log.debug("incident correlation for %r failed: %r", reason, e)
+        return None
+
+
+def _claim_incident(root: str, prefix: str) -> bool:
+    """CROSS-process dedupe: N survivors of one failure all detect it
+    within the same window (every elastic rank dumps ``rank_lost``) —
+    an O_EXCL claim file under ``incidents/`` arbitrates so the cluster
+    gets ONE bundle per reason class per window, not one per detector.
+    The claim is keyed by the wall-clock window bucket, so the
+    arbitration is a single atomic O_EXCL create — no stat-then-retake
+    race on stale claims (a burst straddling a bucket boundary can at
+    worst yield two bundles, never one per detector)."""
+    inc_root = os.path.join(os.path.abspath(root), "incidents")
+    os.makedirs(inc_root, exist_ok=True)
+    bucket = int(time.time() / _incident_window_s)
+    claim = os.path.join(inc_root, f"claim_{prefix}_{bucket}")
+    try:  # a burst straddling the boundary: honor the previous
+        prev = os.path.join(inc_root, f"claim_{prefix}_{bucket - 1}")
+        if time.time() - os.stat(prev).st_mtime < _incident_window_s:
+            return False
+    except OSError:
+        pass
+    try:
+        fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(fd, json.dumps({"pid": os.getpid(),
+                                 "wall": time.time()}).encode())
+        os.close(fd)
+        return True
+    except OSError:
+        return False                      # claimed (or unwritable root)

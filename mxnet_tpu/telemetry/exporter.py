@@ -5,9 +5,21 @@ Grammar (unset ⇒ no exporter thread, zero cost):
 - ``<dir>``             — write ``metrics.prom`` (Prometheus text) and
   ``metrics.json`` (registry snapshot) into ``<dir>`` every 10 s;
 - ``<dir>:<period_s>``  — same with an explicit period;
-- ``http:<port>``       — serve ``GET /metrics`` (Prometheus text) and
-  ``GET /metrics.json`` from a daemon thread (port ``0`` = ephemeral,
-  read back via ``Exporter.port``).
+- ``http:<port>``       — serve ``GET /metrics`` (Prometheus text),
+  ``GET /metrics.json`` and ``GET /healthz`` (engine/step-loop
+  liveness, :func:`register_liveness`) from a daemon thread (port
+  ``0`` = ephemeral, read back via ``Exporter.port``).
+
+**Cluster mode** — when ``MXNET_TPU_TELEMETRY_ROLE=<role>[:<rank>]``
+names this process's position in a cluster (``fleet_replica:1``,
+``io_worker:0``, ``rank:2``), the file exporter writes into a
+per-process subdir ``<dir>/proc_<role>_r<rank>_p<pid>/`` instead of
+``<dir>`` itself, so N processes share ONE telemetry root without
+clobbering each other — the layout :class:`~.cluster.ClusterScraper`
+walks. Every exposition also includes ``anchor.json`` (the
+monotonic↔epoch clock anchor ``tools/trace_view.py --merge-root``
+aligns per-process traces with) and ``trace.json`` (a bounded tail of
+the process trace ring, ``MXNET_TPU_TRACE_EXPORT_EVENTS``).
 
 Failure contract: exporting is observability, never control — every
 export attempt passes the ``telemetry.export`` chaos site and any
@@ -20,16 +32,55 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
+import time
 import warnings
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from .registry import get_registry
 
 __all__ = ["Exporter", "parse_spec", "export_files", "start_from_env",
-           "get_exporter", "stop"]
+           "get_exporter", "stop", "process_identity", "process_dir",
+           "active_file_root", "register_liveness",
+           "unregister_liveness", "liveness_report"]
 
 _DEFAULT_PERIOD_S = 10.0
+
+_ROLE_SAN_RE = re.compile(r"[^a-zA-Z0-9_.-]+")
+
+#: Subdir name grammar the cluster scraper discovers processes by.
+PROC_DIR_RE = re.compile(r"\Aproc_(?P<role>.+)_r(?P<rank>-?\d+)"
+                         r"_p(?P<pid>\d+)\Z")
+
+
+def process_identity() -> Tuple[Optional[str], int]:
+    """This process's cluster identity ``(role, rank)`` from
+    ``MXNET_TPU_TELEMETRY_ROLE=<role>[:<rank>]`` (re-read per call — a
+    launcher sets it per worker, possibly after import). ``(None, 0)``
+    when unset: the exporter then writes flat into the telemetry dir,
+    the single-process layout every pre-cluster consumer expects."""
+    spec = (os.environ.get("MXNET_TPU_TELEMETRY_ROLE") or "").strip()
+    if not spec:
+        return None, 0
+    role, sep, tail = spec.partition(":")
+    rank = 0
+    if sep:
+        try:
+            rank = int(tail)
+        except ValueError:
+            pass  # a non-numeric tail is part of the role name
+    return _ROLE_SAN_RE.sub("_", role) or "proc", rank
+
+
+def process_dir(root: str) -> str:
+    """The directory this process's expositions land in under a shared
+    telemetry ``root`` (``root`` itself without a role; the
+    ``proc_<role>_r<rank>_p<pid>`` subdir with one)."""
+    role, rank = process_identity()
+    if role is None:
+        return root
+    return os.path.join(root, f"proc_{role}_r{rank}_p{os.getpid()}")
 
 
 def parse_spec(spec: str) -> Optional[Dict]:
@@ -67,20 +118,64 @@ def _atomic_write(path: str, text: str) -> None:
     os.replace(tmp, path)
 
 
-def export_files(directory: str) -> None:
+#: directory -> ring seq at its last trace.json write (change detector)
+_trace_seq_written: Dict[str, int] = {}
+
+
+def _trace_export_events() -> int:
+    """``MXNET_TPU_TRACE_EXPORT_EVENTS`` — how many trailing trace-ring
+    events each exposition writes into ``trace.json`` (0 disables the
+    trace file; malformed values fall back to the default)."""
+    try:
+        v = int(os.environ.get("MXNET_TPU_TRACE_EXPORT_EVENTS", "")
+                or 65536)
+    except ValueError:
+        return 65536
+    return max(0, v)
+
+
+def export_files(directory: str, *, root: Optional[str] = None) -> None:
     """One synchronous exposition into ``directory`` (the exporter
-    thread's body; benches call it for a final flush). Passes the
-    ``telemetry.export`` chaos site; raises on failure — callers that
-    must not fail go through :meth:`Exporter._export_guarded`."""
+    thread's body; benches call it for a final flush): ``metrics.prom``
+    + ``metrics.json`` + the process ``anchor.json`` (clock anchor +
+    identity, written once) + ``trace.json`` (bounded trace-ring tail).
+    Passes the ``telemetry.export`` chaos site; raises on failure —
+    callers that must not fail go through
+    :meth:`Exporter._export_guarded`."""
     from ..resilience import chaos
+
+    from . import tracing
 
     chaos.site("telemetry.export", directory=directory)
     reg = get_registry()
     os.makedirs(directory, exist_ok=True)
+    anchor_path = os.path.join(directory, "anchor.json")
+    if not os.path.exists(anchor_path):
+        role, rank = process_identity()
+        _atomic_write(anchor_path, json.dumps({
+            "schema": "mxnet_tpu.anchor/1",
+            "pid": os.getpid(),
+            "role": role or "main",
+            "rank": rank,
+            "root": os.path.abspath(root) if root else None,
+            "anchor": tracing.clock_anchor(),
+            "wall": time.time(),
+        }))
     _atomic_write(os.path.join(directory, "metrics.prom"),
                   reg.prometheus_text())
     _atomic_write(os.path.join(directory, "metrics.json"),
                   json.dumps(reg.snapshot()))
+    n_trace = _trace_export_events()
+    if n_trace:
+        # skip the (potentially multi-MB) re-serialization when the
+        # ring hasn't moved since this directory's last exposition
+        seq = tracing.buffer().seq
+        if _trace_seq_written.get(directory) != seq:
+            _atomic_write(
+                os.path.join(directory, "trace.json"),
+                json.dumps(tracing.chrome_trace(
+                    tracing.buffer().tail(n_trace))))
+            _trace_seq_written[directory] = seq
 
 
 class Exporter:
@@ -92,6 +187,7 @@ class Exporter:
         self._thread: Optional[threading.Thread] = None
         self._server = None
         self._warned = False
+        self._pinned_dir: Optional[str] = None
         self.exports = 0          # successful expositions (tests)
         self.failures = 0
         self.port: Optional[int] = None
@@ -101,6 +197,8 @@ class Exporter:
         if self.config["mode"] == "http":
             self._start_http()
         else:
+            global _last_file_root
+            _last_file_root = os.path.abspath(self.config["dir"])
             # first exposition NOW, not a full period from now — a
             # process shorter than the period must still leave files
             self._export_guarded()
@@ -111,7 +209,14 @@ class Exporter:
         return self
 
     def stop(self, final_flush: bool = True) -> None:
+        global _last_file_root
         self._stop.set()
+        if self.config.get("mode") == "file" and _last_file_root == \
+                os.path.abspath(self.config["dir"]):
+            # this exporter owned the advertised shared root: stop
+            # advertising it (flight fallbacks and incident sweeps must
+            # not target a root nobody exports into anymore)
+            _last_file_root = None
         if self._server is not None:
             try:
                 self._server.shutdown()
@@ -125,12 +230,27 @@ class Exporter:
             self._export_guarded()
 
     # -- file mode --------------------------------------------------------
+    def current_dir(self) -> Optional[str]:
+        """Where this exporter's file-mode expositions land. The
+        identity is resolved ONCE (first exposition) and pinned: a
+        process's cluster identity must not flap mid-life, and pinning
+        keeps the exporter thread from racing launchers that briefly
+        rewrite ``MXNET_TPU_TELEMETRY_ROLE`` around a child spawn
+        (``DatasetService.start``) — without the pin one unlucky
+        periodic exposition would write the PARENT's metrics into a
+        worker's subdir and stick its anchor there."""
+        if self.config.get("mode") != "file":
+            return None
+        if self._pinned_dir is None:
+            self._pinned_dir = process_dir(self.config["dir"])
+        return self._pinned_dir
+
     def _export_guarded(self) -> bool:
         """One exposition that NEVER raises: a fault (chaos-injected or
         real) warns once per process and the loop carries on — the
         exporter must degrade, not kill anything."""
         try:
-            export_files(self.config["dir"])
+            export_files(self.current_dir(), root=self.config["dir"])
             self.exports += 1
             return True
         except BaseException as e:  # noqa: BLE001 — degrade to warn-once
@@ -142,6 +262,16 @@ class Exporter:
                     "will keep retrying silently every period",
                     RuntimeWarning, stacklevel=2)
             return False
+
+    def export_now(self) -> bool:
+        """One guarded exposition on the caller's thread (file mode
+        only; no-op True otherwise). The flight recorder calls this at
+        dump time so the process's LAST exposition — metrics and the
+        trace ring holding its final spans — is on the shared root even
+        when the process dies right after (chaos kill, ``os._exit``)."""
+        if self.config.get("mode") != "file":
+            return True
+        return self._export_guarded()
 
     def _loop(self) -> None:
         period = max(0.05, float(self.config.get("period_s",
@@ -161,7 +291,15 @@ class Exporter:
                     from ..resilience import chaos
                     chaos.site("telemetry.export", endpoint=self.path)
                     reg = get_registry()
-                    if self.path.startswith("/metrics.json"):
+                    status = 200
+                    if self.path.startswith("/healthz"):
+                        # the same wedge signal the fleet heartbeats
+                        # gate on: engine alive + step-loop tick age
+                        report = liveness_report()
+                        body = json.dumps(report).encode()
+                        ctype = "application/json"
+                        status = 200 if report["ok"] else 503
+                    elif self.path.startswith("/metrics.json"):
                         body = json.dumps(reg.snapshot()).encode()
                         ctype = "application/json"
                     elif self.path.startswith("/metrics"):
@@ -170,7 +308,7 @@ class Exporter:
                     else:
                         self.send_error(404)
                         return
-                    self.send_response(200)
+                    self.send_response(status)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
@@ -203,9 +341,81 @@ class Exporter:
 _active: Optional[Exporter] = None
 _lock = threading.Lock()
 
+#: Newest file-mode telemetry root any Exporter in this process started
+#: against — how the flight recorder and the incident correlator find
+#: "the shared root" without re-parsing env (a drill may construct an
+#: Exporter directly rather than via start_from_env).
+_last_file_root: Optional[str] = None
+
+
+def active_file_root() -> Optional[str]:
+    """The shared telemetry root this process exports files into (the
+    env exporter's dir, or the newest explicitly-constructed file
+    Exporter's), or None when file exposition never started."""
+    a = _active
+    if a is not None and a.config.get("mode") == "file":
+        return os.path.abspath(a.config["dir"])
+    return _last_file_root
+
 
 def get_exporter() -> Optional[Exporter]:
     return _active
+
+
+# ---------------------------------------------------------------------------
+# step-loop liveness probes (the /healthz seam)
+# ---------------------------------------------------------------------------
+_liveness_lock = threading.Lock()
+_liveness: Dict[str, Callable[[], Dict]] = {}
+
+
+def register_liveness(name: str, probe: Callable[[], Dict]) -> None:
+    """Register a step-loop liveness probe under ``name`` (idempotent:
+    latest wins). ``probe()`` must be host-cheap and return
+    ``{"alive": bool, "last_tick": <monotonic s>, "stale_s": <window>}``
+    (``stale_s`` optional) — the exact seam fleet heartbeats gate on
+    (``LLMEngine.alive``/``last_tick``), so an external ``GET /healthz``
+    sees the same wedge signal the in-cluster health monitor does.
+    Engines register at start and unregister at close."""
+    with _liveness_lock:
+        _liveness[str(name)] = probe
+
+
+def unregister_liveness(name: str) -> None:
+    with _liveness_lock:
+        _liveness.pop(str(name), None)
+
+
+def liveness_report(default_stale_s: float = 10.0) -> Dict:
+    """Evaluate every registered probe: the payload ``/healthz``
+    serves. ``ok`` is True only while every probe is alive with a fresh
+    tick (no probes registered ⇒ trivially ok: the process is up and
+    serving HTTP). A probe that raises reads as dead — a broken engine
+    must fail the health check, not crash the endpoint."""
+    now = time.monotonic()
+    with _liveness_lock:
+        probes = dict(_liveness)
+    out: Dict = {"ok": True, "ts_unix": time.time(), "pid": os.getpid(),
+                 "probes": {}}
+    for name, probe in probes.items():
+        try:
+            st = dict(probe() or {})
+            alive = bool(st.get("alive", False))
+            tick = st.get("last_tick")
+            age = (now - float(tick)) if tick is not None else None
+            stale = float(st.get("stale_s") or default_stale_s)
+            ok = alive and (age is None or age <= stale)
+            verdict = ("ok" if ok
+                       else "wedged" if alive else "dead")
+        except Exception as e:  # noqa: BLE001 — broken probe = dead
+            ok, verdict, age, stale = False, f"error: {e!r}", None, None
+        out["probes"][name] = {
+            "verdict": verdict,
+            "tick_age_s": round(age, 3) if age is not None else None,
+            "stale_s": stale,
+        }
+        out["ok"] = out["ok"] and ok
+    return out
 
 
 def start_from_env() -> Optional[Exporter]:

@@ -60,11 +60,37 @@ class FlightRecorder:
     def directory(self) -> Optional[str]:
         """The dump directory, by precedence: explicit :meth:`arm`, the
         ``MXNET_TPU_FLIGHT_DIR`` env var (re-read per call — a test or
-        launcher may set it after import), then the low-precedence
-        :meth:`arm_default` (the latest Supervisor's
-        ``<ckpt>/flight``)."""
-        return (self._dir or os.environ.get("MXNET_TPU_FLIGHT_DIR")
-                or self._default_dir or None)
+        launcher may set it after import), the low-precedence
+        :meth:`arm_default` (the latest Supervisor's ``<ckpt>/flight``),
+        and finally ``<process telemetry dir>/flight`` when a file
+        exporter is running — a process on a shared telemetry root
+        leaves post-mortems there with ZERO extra wiring, which is what
+        the cluster incident correlator sweeps."""
+        explicit = (self._dir or os.environ.get("MXNET_TPU_FLIGHT_DIR")
+                    or self._default_dir or None)
+        if explicit is not None:
+            return explicit
+        d = self._cluster_dir()
+        return os.path.join(d, "flight") if d else None
+
+    @staticmethod
+    def _cluster_dir() -> Optional[str]:
+        """This process's subdir under the shared telemetry root (None
+        without a running file exporter). Prefers the active exporter's
+        PINNED directory so dumps land exactly where the expositions
+        do."""
+        try:
+            from . import exporter as _exporter
+
+            exp = _exporter.get_exporter()
+            if exp is not None and exp.current_dir() is not None:
+                return exp.current_dir()
+            root = _exporter.active_file_root()
+            if root is None:
+                return None
+            return _exporter.process_dir(root)
+        except Exception:  # noqa: BLE001 — fallback only
+            return None
 
     def arm(self, directory: str, *, baseline: bool = True) -> None:
         """Set the dump directory and (by default) take the metrics
@@ -158,7 +184,50 @@ class FlightRecorder:
             os.replace(tmp2, latest)
         except OSError:
             pass  # the unique artifact above already published
+        self._cluster_publish(reason, name, payload, d)
         return final
+
+    def _cluster_publish(self, reason: str, name: str, payload: Dict,
+                         dumped_dir: str) -> None:
+        """Best-effort cluster-side effects of a published dump: mirror
+        the artifact into this process's shared-root subdir (so the
+        incident correlator sees EVERY process's post-mortems in one
+        sweep), flush one final exposition (metrics + the trace ring
+        holding the final spans — the process may be about to
+        ``os._exit``), and trigger the incident correlator for the
+        cross-process failure reasons. Never raises: these are
+        observability side effects of a dump that already succeeded."""
+        try:
+            proc_dir = self._cluster_dir()
+            if proc_dir is not None:
+                mirror_dir = os.path.join(proc_dir, "flight")
+                if os.path.abspath(mirror_dir) != \
+                        os.path.abspath(dumped_dir):
+                    os.makedirs(mirror_dir, exist_ok=True)
+                    mpath = os.path.join(mirror_dir, name)
+                    mtmp = mpath + f".tmp.{os.getpid()}"
+                    with open(mtmp, "w") as f:
+                        json.dump(payload, f)
+                    os.replace(mtmp, mpath)
+            from . import exporter as _exporter
+
+            exp = _exporter.get_exporter()
+            if exp is not None:
+                exp.export_now()
+            elif _exporter.active_file_root() is not None:
+                # a drill-constructed (non-global) exporter: flush the
+                # files directly so death leaves a final exposition
+                _exporter.export_files(
+                    _exporter.process_dir(_exporter.active_file_root()),
+                    root=_exporter.active_file_root())
+        except Exception:  # noqa: BLE001 — best-effort
+            pass
+        try:
+            from . import cluster as _cluster
+
+            _cluster.maybe_build_incident(str(reason), payload)
+        except Exception:  # noqa: BLE001 — correlation is best-effort
+            pass
 
     def try_dump(self, reason: str,
                  directory: Optional[str] = None) -> Optional[str]:

@@ -35,6 +35,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "sanitize_name", "DEFAULT_BUCKETS",
+    "QUANTILE_GAUGES",
 ]
 
 #: Default histogram buckets (upper bounds), tuned for millisecond-scale
@@ -58,6 +59,20 @@ def sanitize_name(name: str) -> str:
 def _escape_label(v: str) -> str:
     return (str(v).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """``# HELP`` text escaping (exposition format 0.0.4: only ``\\``
+    and ``\\n`` — a newline in help text would otherwise truncate the
+    line and make the next fragment unparseable to real scrapers)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+#: The rolling quantiles every histogram additionally exports as gauge
+#: series (``<name>_p50`` / ``_p95`` / ``_p99``) — ONE definition of
+#: "p99" shared by the exposition, the Router's hedge threshold and the
+#: bench rows, instead of each computing its own over private lists.
+QUANTILE_GAUGES = ((0.50, "p50"), (0.95, "p95"), (0.99, "p99"))
 
 
 def _fmt(v: float) -> str:
@@ -183,6 +198,14 @@ class Histogram:
             vals = sorted(self._recent)
         return self._q(vals, q)
 
+    def quantiles(self) -> Dict[str, float]:
+        """The rolling :data:`QUANTILE_GAUGES` (p50/p95/p99) in ONE
+        consistent sort pass — what the Prometheus exposition exports
+        as ``<name>_p50``/``_p95``/``_p99`` gauge series."""
+        with self._lock:
+            vals = sorted(self._recent)
+        return {label: self._q(vals, q) for q, label in QUANTILE_GAUGES}
+
     def summary(self) -> Dict[str, float]:
         """The serving-bench summary shape (count/mean/min/max/p50/90/99)
         — unchanged from the pre-telemetry ``serving.metrics.Histogram``
@@ -200,6 +223,7 @@ class Histogram:
             "max": round(mx, 4) if mx is not None else 0.0,
             "p50": round(self._q(vals, 0.50), 4),
             "p90": round(self._q(vals, 0.90), 4),
+            "p95": round(self._q(vals, 0.95), 4),
             "p99": round(self._q(vals, 0.99), 4),
         }
 
@@ -395,8 +419,10 @@ class MetricsRegistry:
         lines: List[str] = []
         for fam in sorted(fams, key=lambda f: f.name):
             if fam.help:
-                lines.append(f"# HELP {fam.name} {fam.help}")
+                lines.append(
+                    f"# HELP {fam.name} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
+            quantile_lines: Dict[str, List[str]] = {}
             for labels, child in fam.series():
                 lab = ",".join(f'{k}="{_escape_label(v)}"'
                                for k, v in labels.items())
@@ -412,10 +438,20 @@ class MetricsRegistry:
                         f"{fam.name}_sum{suffix} {_fmt(total)}")
                     lines.append(
                         f"{fam.name}_count{suffix} {count}")
+                    for q_label, v in child.quantiles().items():
+                        quantile_lines.setdefault(q_label, []).append(
+                            f"{fam.name}_{q_label}{suffix} {_fmt(v)}")
                 else:
                     suffix = f"{{{lab}}}" if lab else ""
                     lines.append(
                         f"{fam.name}{suffix} {_fmt(child.get())}")
+            # rolling-reservoir quantiles ride along as gauge families
+            # (<name>_p50/_p95/_p99) — one shared p99 definition
+            # instead of private sorted lists
+            for _, q_label in QUANTILE_GAUGES:
+                if quantile_lines.get(q_label):
+                    lines.append(f"# TYPE {fam.name}_{q_label} gauge")
+                    lines.extend(quantile_lines[q_label])
         return "\n".join(lines) + "\n"
 
     @staticmethod

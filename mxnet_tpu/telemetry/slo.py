@@ -1,0 +1,383 @@
+"""Declarative SLOs evaluated over the cluster snapshot stream.
+
+The trip-wire between observability and control: :class:`SloRule`\\ s
+declare what "healthy" means (a p99 ceiling, a tok/s floor, a
+starved-fraction ceiling, an MFU floor vs the
+:class:`~.mfu.RooflineBank` banked rows) and :class:`SloSentinel`
+evaluates them against every :class:`~.cluster.ClusterScraper`
+snapshot. A breach emits a typed :class:`SloViolation` event to every
+subscriber (the fleet autoscaler's input), increments ``slo_*``
+counters, logs ONCE per rule per breach episode, and — through the
+flight recorder (reason ``slo_violation:<rule>``) — leaves an incident
+bundle on the shared root.
+
+Rules come from code or from ``MXNET_TPU_SLO``::
+
+    MXNET_TPU_SLO="p99:fleet_request_ms<=250;tok_s>=100;starved<=0.1;mfu>=0.2"
+
+Grammar: rules split on ``;``, each ``kind[:metric]<op><value>`` with
+the op direction fixed by the kind (``p99``/``starved`` are ceilings,
+``tok_s``/``mfu`` floors). ``mfu>=bank:<metric>*<frac>`` floors MFU at
+a fraction of a banked row's achieved MFU. Malformed rules warn and
+are skipped — a typo'd SLO must not kill the process.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .cluster import ClusterScraper
+from .registry import get_registry
+from . import flight as _flight
+
+__all__ = ["SloRule", "SloViolation", "SloSentinel", "parse_slo_spec",
+           "start_from_env", "KINDS"]
+
+log = logging.getLogger(__name__)
+
+#: Rule kinds: ceiling kinds breach when observed > threshold, floor
+#: kinds when observed < threshold.
+KINDS = {
+    "p99_ms_max": "ceiling",
+    "tok_s_min": "floor",
+    "starved_frac_max": "ceiling",
+    "mfu_min": "floor",
+}
+
+_KIND_ALIASES = {
+    "p99": "p99_ms_max",
+    "tok_s": "tok_s_min",
+    "starved": "starved_frac_max",
+    "starved_pct": "starved_frac_max",
+    "mfu": "mfu_min",
+}
+
+
+@dataclass
+class SloRule:
+    """One declarative objective.
+
+    ``kind`` picks the observable (see :data:`KINDS`):
+
+    - ``p99_ms_max`` — max across the cluster of histogram ``metric``'s
+      rolling p99 (default metric ``fleet_request_ms``) must stay under
+      ``threshold`` ms;
+    - ``tok_s_min`` — the derived cluster aggregate tok/s must stay
+      over ``threshold`` (note: an *idle* cluster reads 0 and breaches
+      a floor — pair with ``for_count`` or arm during load);
+    - ``starved_frac_max`` — the world input-starved fraction of step
+      wall time must stay under ``threshold``;
+    - ``mfu_min`` — the max ``telemetry_mfu`` gauge must stay over
+      ``threshold``; with ``banked_metric`` the floor is
+      ``threshold x <banked row's mfu>`` (the RooflineBank row), i.e.
+      "stay within ``threshold`` of yesterday's roofline".
+
+    ``for_count`` (default 1) is how many CONSECUTIVE breached
+    evaluations arm the violation — the debounce against one noisy
+    scrape. ``labels`` (optional) restricts series-scanning kinds
+    (p99/mfu) to series carrying those label values — how a bench or a
+    per-fleet autoscaler scopes a rule to ONE fleet/tenant when the
+    registry holds several.
+    """
+
+    name: str
+    kind: str
+    threshold: float
+    metric: Optional[str] = None
+    banked_metric: Optional[str] = None
+    for_count: int = 1
+    labels: Optional[Dict[str, str]] = None
+
+    def __post_init__(self):
+        kind = _KIND_ALIASES.get(self.kind, self.kind)
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown SLO kind {self.kind!r} (one of "
+                f"{sorted(KINDS)} or aliases {sorted(_KIND_ALIASES)})")
+        self.kind = kind
+        if self.kind == "p99_ms_max" and self.metric is None:
+            self.metric = "fleet_request_ms"
+
+
+@dataclass
+class SloViolation:
+    """One typed violation event (what subscribers — the autoscaler
+    control loop, tests, the violations ring — receive)."""
+
+    rule: str
+    kind: str
+    observed: float
+    threshold: float
+    ts_unix: float = field(default_factory=time.time)
+    details: str = ""
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "kind": self.kind,
+                "observed": self.observed, "threshold": self.threshold,
+                "ts_unix": self.ts_unix, "details": self.details}
+
+
+def parse_slo_spec(spec: str) -> List[SloRule]:
+    """Parse the ``MXNET_TPU_SLO`` grammar (module docstring) into
+    rules; malformed fragments warn and are skipped."""
+    rules: List[SloRule] = []
+    for i, frag in enumerate(x.strip() for x in (spec or "").split(";")):
+        if not frag:
+            continue
+        op = "<=" if "<=" in frag else ">=" if ">=" in frag else None
+        if op is None:
+            warnings.warn(f"MXNET_TPU_SLO fragment {frag!r}: no <= or "
+                          ">= — skipped", RuntimeWarning, stacklevel=2)
+            continue
+        lhs, _, rhs = frag.partition(op)
+        kind_part, _, metric = lhs.strip().partition(":")
+        kind = _KIND_ALIASES.get(kind_part.strip(), kind_part.strip())
+        banked = None
+        rhs = rhs.strip()
+        try:
+            if rhs.startswith("bank:"):
+                banked_part, _, frac = rhs[5:].partition("*")
+                banked = banked_part.strip()
+                threshold = float(frac) if frac else 1.0
+            else:
+                threshold = float(rhs)
+            rule = SloRule(name=f"{kind_part.strip()}"
+                           + (f"_{metric.strip()}" if metric else ""),
+                           kind=kind, threshold=threshold,
+                           metric=metric.strip() or None,
+                           banked_metric=banked)
+        except ValueError as e:
+            warnings.warn(f"MXNET_TPU_SLO fragment {frag!r}: {e} — "
+                          "skipped", RuntimeWarning, stacklevel=2)
+            continue
+        expected = "<=" if KINDS[rule.kind] == "ceiling" else ">="
+        if op != expected:
+            warnings.warn(
+                f"MXNET_TPU_SLO fragment {frag!r}: {rule.kind} takes "
+                f"{expected} — skipped", RuntimeWarning, stacklevel=2)
+            continue
+        rules.append(rule)
+    return rules
+
+
+class SloSentinel:
+    """Evaluate :class:`SloRule`\\ s over cluster snapshots.
+
+    One :meth:`evaluate` pass per snapshot: each rule's observable is
+    extracted, compared, debounced (``for_count``), and on the
+    *transition into breach* a :class:`SloViolation` fires — delivered
+    to every ``on_violation`` subscriber, appended to
+    :attr:`violations`, counted in ``slo_violations_total{rule}``,
+    logged once per episode, and (``bundle=True``) dumped through the
+    flight recorder as ``slo_violation:<rule>`` so the shared root gets
+    an incident bundle. While a rule STAYS breached the
+    ``slo_breached{rule}`` gauge holds 1 (no re-fire until it clears —
+    an episode is one violation, not one per scrape).
+
+    ``scraper=None`` builds one over ``root`` (``root=None`` ⇒ the
+    local in-process registry — how fleet_bench and an in-router
+    autoscaler run it).
+    """
+
+    def __init__(self, rules: List[SloRule],
+                 scraper: Optional[ClusterScraper] = None, *,
+                 root: Optional[str] = None,
+                 on_violation: Optional[List[Callable]] = None,
+                 bundle: bool = True, max_events: int = 256):
+        self.rules = list(rules)
+        self.scraper = scraper or ClusterScraper(root)
+        self._subs: List[Callable] = list(on_violation or [])
+        self._bundle = bool(bundle)
+        self.violations: List[SloViolation] = []
+        self._max_events = int(max_events)
+        self._breach_counts: Dict[str, int] = {}
+        self._breached: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = get_registry()
+        self._c_evals = reg.counter(
+            "slo_evaluations_total", "SLO sentinel evaluation passes")
+        self._c_viol = reg.counter(
+            "slo_violations_total", "SLO violations fired", ("rule",))
+        self._g_breached = reg.gauge(
+            "slo_breached", "1 while the rule is currently breached",
+            ("rule",))
+        self._g_observed = reg.gauge(
+            "slo_observed", "Last observed value per rule", ("rule",))
+
+    def subscribe(self, fn: Callable[[SloViolation], None]) -> None:
+        """Add a violation subscriber (the autoscaler's entry point)."""
+        self._subs.append(fn)
+
+    # -- observation extraction -------------------------------------------
+    @staticmethod
+    def _label_match(rule: SloRule, series: Dict) -> bool:
+        if not rule.labels:
+            return True
+        have = series.get("labels", {})
+        return all(have.get(k) == v for k, v in rule.labels.items())
+
+    def _observe(self, rule: SloRule, snap: Dict) -> Optional[float]:
+        cluster = snap.get("cluster", {})
+        if rule.kind == "p99_ms_max":
+            best = None
+            for proc in snap.get("processes", {}).values():
+                fam = (proc.get("metrics") or {}).get(
+                    "metrics", {}).get(rule.metric, {})
+                for s in fam.get("series", ()):
+                    if not self._label_match(rule, s):
+                        continue
+                    summ = s.get("summary") or {}
+                    if int(summ.get("count", 0)) < 1:
+                        continue
+                    p99 = float(summ.get("p99", 0.0))
+                    best = p99 if best is None else max(best, p99)
+            return best
+        if rule.kind == "tok_s_min":
+            v = cluster.get("tok_s_total")
+            return float(v) if v is not None else None
+        if rule.kind == "starved_frac_max":
+            v = cluster.get("input_starved_frac")
+            return float(v) if v is not None else None
+        if rule.kind == "mfu_min":
+            best = None
+            name = rule.metric or "telemetry_mfu"
+            for proc in snap.get("processes", {}).values():
+                fam = (proc.get("metrics") or {}).get(
+                    "metrics", {}).get(name, {})
+                for s in fam.get("series", ()):
+                    if not self._label_match(rule, s):
+                        continue
+                    v = s.get("value")
+                    if isinstance(v, (int, float)):
+                        best = (float(v) if best is None
+                                else max(best, float(v)))
+            return best
+        return None  # pragma: no cover — __post_init__ validates kinds
+
+    def _threshold(self, rule: SloRule) -> Optional[float]:
+        if rule.banked_metric is None:
+            return rule.threshold
+        from .mfu import bank
+
+        row = bank().anchor(rule.banked_metric)
+        banked_mfu = (row or {}).get("mfu")
+        if not isinstance(banked_mfu, (int, float)):
+            return None  # no banked anchor: the rule cannot evaluate
+        return rule.threshold * float(banked_mfu)
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self, snap: Optional[Dict] = None) -> List[SloViolation]:
+        """One pass over every rule; returns the violations that FIRED
+        this pass (breach-episode transitions only). ``snap=None``
+        scrapes first (guarded — a scrape fault evaluates nothing
+        rather than raising into the caller's loop)."""
+        if snap is None:
+            snap = self.scraper.scrape_guarded()
+            if snap is None:
+                return []
+        self._c_evals.inc()
+        fired: List[SloViolation] = []
+        for rule in self.rules:
+            observed = self._observe(rule, snap)
+            threshold = self._threshold(rule)
+            if observed is None or threshold is None:
+                continue  # no signal yet (idle histogram, no bank row)
+            self._g_observed.labels(rule=rule.name).set(observed)
+            ceiling = KINDS[rule.kind] == "ceiling"
+            breached = (observed > threshold if ceiling
+                        else observed < threshold)
+            with self._lock:
+                n = self._breach_counts.get(rule.name, 0)
+                n = n + 1 if breached else 0
+                self._breach_counts[rule.name] = n
+                was = self._breached.get(rule.name, False)
+                now_breached = breached and n >= max(1, rule.for_count)
+                self._breached[rule.name] = now_breached
+            self._g_breached.labels(rule=rule.name).set(
+                1 if now_breached else 0)
+            if now_breached and not was:
+                v = SloViolation(
+                    rule=rule.name, kind=rule.kind,
+                    observed=round(float(observed), 4),
+                    threshold=round(float(threshold), 4),
+                    details=(f"{rule.kind}"
+                             + (f" on {rule.metric}" if rule.metric
+                                else "")
+                             + f": observed {observed:.4g} vs "
+                             f"{'ceiling' if ceiling else 'floor'} "
+                             f"{threshold:.4g}"))
+                fired.append(v)
+                self._c_viol.labels(rule=rule.name).inc()
+                log.warning("SLO violation %s: %s", rule.name, v.details)
+                with self._lock:
+                    self.violations.append(v)
+                    del self.violations[:-self._max_events]
+                for fn in list(self._subs):
+                    try:
+                        fn(v)
+                    except Exception:  # noqa: BLE001 — a broken
+                        pass           # subscriber must not stop others
+                if self._bundle:
+                    # the flight hook sweeps the shared root into an
+                    # incident bundle (no-op while nothing is armed)
+                    _flight.try_dump(f"slo_violation:{rule.name}")
+        return fired
+
+    # -- background loop ---------------------------------------------------
+    def start(self, period_s: Optional[float] = None) -> "SloSentinel":
+        if self._thread is not None:
+            return self
+        from .cluster import scrape_period_s
+
+        period = float(period_s if period_s is not None
+                       else scrape_period_s())
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(period):
+                try:
+                    self.evaluate()
+                except Exception:  # noqa: BLE001 — the sentinel is
+                    pass           # observability; it must not die loud
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="mxnet_tpu-slo-sentinel")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def __enter__(self) -> "SloSentinel":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+
+def start_from_env(scraper: Optional[ClusterScraper] = None
+                   ) -> Optional[SloSentinel]:
+    """Build + start a sentinel from ``MXNET_TPU_SLO`` (None when the
+    env is unset or parses to zero rules). The scraper defaults to the
+    shared telemetry root when one is armed, else the local
+    registry."""
+    spec = os.environ.get("MXNET_TPU_SLO", "")
+    rules = parse_slo_spec(spec)
+    if not rules:
+        return None
+    if scraper is None:
+        from . import exporter as _exporter
+
+        scraper = ClusterScraper(_exporter.active_file_root())
+    return SloSentinel(rules, scraper).start()

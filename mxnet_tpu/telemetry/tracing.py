@@ -41,10 +41,11 @@ from typing import Dict, List, Optional
 from .registry import get_registry
 
 __all__ = [
-    "BUCKETS", "StepTimeline", "TraceBuffer", "buffer", "span", "step",
-    "current_step", "attribute", "phase_if_active", "chrome_trace",
-    "dump_chrome", "now_us", "emit_complete", "emit_counter",
-    "emit_instant",
+    "BUCKETS", "StepTimeline", "TraceBuffer", "TraceContext", "buffer",
+    "span", "step", "current_step", "attribute", "phase_if_active",
+    "chrome_trace", "dump_chrome", "now_us", "emit_complete",
+    "emit_counter", "emit_instant", "new_trace_id", "current_trace",
+    "trace_scope", "bind_trace", "clock_anchor",
 ]
 
 #: Step attribution buckets (``host`` is the computed remainder).
@@ -67,6 +68,119 @@ def now_us() -> float:
     return time.perf_counter() * 1e6
 
 
+def clock_anchor() -> Dict[str, float]:
+    """One ``(trace clock, wall clock)`` sample — the monotonic-epoch
+    anchor every process exports so ``tools/trace_view.py
+    --merge-root`` can shift each per-process trace onto ONE shared
+    (unix-epoch µs) timeline. ``perf_counter`` has an arbitrary,
+    per-process zero; the pair below is the bridge:
+    ``ts_unix_us = ts + (anchor_unix_us - anchor_mono_us)``."""
+    # read the two clocks back-to-back; the instruction gap between
+    # them (sub-µs) is the alignment error floor
+    mono_us = time.perf_counter() * 1e6
+    unix_us = time.time() * 1e6
+    return {"mono_us": mono_us, "unix_us": unix_us}
+
+
+# ---------------------------------------------------------------------------
+# request-scoped trace context
+# ---------------------------------------------------------------------------
+_trace_seq_lock = threading.Lock()
+_trace_seq = 0
+
+
+def new_trace_id(prefix: str = "t") -> str:
+    """Mint a cluster-unique trace id (``<prefix>-<pid>-<seq>`` — the
+    pid namespaces concurrent minters across processes sharing one
+    telemetry root). Minted at the request's FIRST entry point (Router
+    admission, ``io.service`` dispatch) and propagated — never re-mint
+    for a request that already carries one."""
+    global _trace_seq
+    with _trace_seq_lock:
+        _trace_seq += 1
+        seq = _trace_seq
+    return f"{prefix}-{os.getpid()}-{seq}"
+
+
+class TraceContext:
+    """One request's distributed-trace identity: the ``trace_id``
+    minted at admission plus the identity of the process/component
+    currently serving it. Carried across process boundaries as a plain
+    dict (:meth:`to_dict` / :meth:`from_dict` — the ``_ProcHost``
+    JSON-lines pipe and the io.service worker cfg both ride it), and
+    stamped into span/step args so the merged cluster timeline can be
+    filtered down to ONE request's path through N processes."""
+
+    __slots__ = ("trace_id", "parent_span", "role", "rank", "replica")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 parent_span: Optional[str] = None,
+                 role: Optional[str] = None, rank: Optional[int] = None,
+                 replica: Optional[str] = None):
+        self.trace_id = trace_id or new_trace_id()
+        self.parent_span = parent_span
+        self.role = role
+        self.rank = rank
+        self.replica = replica
+
+    def to_dict(self) -> Dict:
+        out: Dict = {"trace_id": self.trace_id}
+        for k in ("parent_span", "role", "rank", "replica"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict]) -> Optional["TraceContext"]:
+        if not isinstance(d, dict) or not d.get("trace_id"):
+            return None
+        return cls(trace_id=str(d["trace_id"]),
+                   parent_span=d.get("parent_span"),
+                   role=d.get("role"), rank=d.get("rank"),
+                   replica=d.get("replica"))
+
+    def child(self, parent_span: str) -> "TraceContext":
+        """The same trace, one hop deeper (new parent span label)."""
+        return TraceContext(self.trace_id, parent_span, self.role,
+                            self.rank, self.replica)
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"TraceContext({self.to_dict()!r})"
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The trace context bound to this thread (or None)."""
+    return getattr(_tls, "trace", None)
+
+
+def bind_trace(ctx: Optional[TraceContext]) -> None:
+    """Bind ``ctx`` to this thread un-scoped — for worker processes
+    whose whole lifetime serves one trace (io.service decode workers);
+    request-scoped callers use :class:`trace_scope`."""
+    _tls.trace = ctx
+
+
+class trace_scope:
+    """Bind a :class:`TraceContext` to the current thread for the
+    duration of a ``with`` block — spans/steps recorded inside pick it
+    up (``StepTimeline`` stamps the ambient trace id into its args)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self._ctx = ctx
+
+    def __enter__(self) -> Optional[TraceContext]:
+        self._prev = getattr(_tls, "trace", None)
+        _tls.trace = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc) -> bool:
+        _tls.trace = self._prev
+        return False
+
+
 class TraceBuffer:
     """Bounded, thread-safe ring of Chrome ``trace_event`` dicts."""
 
@@ -74,12 +188,18 @@ class TraceBuffer:
         self._dq: deque = deque(maxlen=maxlen)
         self._lock = threading.Lock()
         self.dropped = 0
+        #: total events ever appended — a cheap change detector (the
+        #: exporter skips rewriting trace.json when the ring hasn't
+        #: moved since the last exposition; length alone can't tell,
+        #: a full ring keeps the same length forever)
+        self.seq = 0
 
     def append(self, ev: dict) -> None:
         with self._lock:
             if len(self._dq) == self._dq.maxlen:
                 self.dropped += 1
             self._dq.append(ev)
+            self.seq += 1
 
     def snapshot(self) -> List[dict]:
         with self._lock:
@@ -259,7 +379,7 @@ class StepTimeline:
 
     __slots__ = ("name", "index", "_t0", "_wall", "_buckets",
                  "_open_phase", "_compile_in_device", "_prev",
-                 "_cancelled")
+                 "_cancelled", "_annotations")
 
     def __init__(self, name: str = "step", index: Optional[int] = None):
         _ensure_compile_listener()
@@ -272,6 +392,7 @@ class StepTimeline:
         self._wall: Optional[float] = None
         self._prev = None
         self._cancelled = False
+        self._annotations: Optional[Dict] = None
 
     # -- recording --------------------------------------------------------
     def phase(self, bucket: str, label: Optional[str] = None) -> _Phase:
@@ -292,6 +413,19 @@ class StepTimeline:
             # first call of a jitted step): subtract at finish so the
             # two buckets never double-count the same wall time
             self._compile_in_device += dur_s
+
+    def annotate(self, key: str, value) -> None:
+        """Attach a JSON-friendly key/value to the step's span args —
+        how the LLM scheduler stamps the ``trace_ids`` of the lanes a
+        ``step[llm_decode]`` served, so the merged cluster timeline can
+        be filtered to one request's path. Never raises (hook
+        discipline: instrumentation must not fault the loop)."""
+        try:
+            if self._annotations is None:
+                self._annotations = {}
+            self._annotations[str(key)] = value
+        except Exception:  # noqa: BLE001 — annotation is best-effort
+            pass
 
     def cancel(self) -> None:
         """Record nothing on exit — for a step opened around a data
@@ -320,6 +454,11 @@ class StepTimeline:
         args["wall_ms"] = round(self._wall * 1e3, 3)
         if self.index is not None:
             args["step"] = self.index
+        if self._annotations:
+            args.update(self._annotations)
+        ctx = getattr(_tls, "trace", None)
+        if ctx is not None and "trace_id" not in args:
+            args["trace_id"] = ctx.trace_id
         emit_complete(f"step[{self.name}]",
                       now_us() - self._wall * 1e6, self._wall * 1e6,
                       cat="step", args=args)

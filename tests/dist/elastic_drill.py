@@ -58,6 +58,16 @@ def _arm_local_devices(n: int) -> None:
         ).strip()
 
 
+# cluster telemetry identity BEFORE mxnet_tpu imports: the env-armed
+# exporter's first exposition fires at import, and it must land in
+# this rank's proc_rank_r<k> subdir of a shared MXNET_TPU_TELEMETRY
+# root, not clobber the flat root (ISSUE 15)
+if "--rank" in sys.argv:
+    os.environ.setdefault(
+        "MXNET_TPU_TELEMETRY_ROLE",
+        f"rank:{sys.argv[sys.argv.index('--rank') + 1]}")
+
+
 # --gspmd needs the virtual-device flag BEFORE any jax import
 if "--gspmd" in sys.argv:
     n_local = 2

@@ -1,0 +1,649 @@
+"""Cluster observability plane (telemetry.cluster + telemetry.slo).
+
+Correctness pins (ISSUE 15): the Prometheus exposition carries
+``# HELP``/``# TYPE`` metadata with escaped label/help text and rolling
+p50/p95/p99 gauge series per histogram; ``/healthz`` answers from the
+engine step-loop liveness seams; a faulting cluster scrape degrades
+warn-once (chaos site ``telemetry.scrape``); the scraper merges a
+shared telemetry root into one snapshot + a ``process``/``role``/
+``rank``-labelled exposition and derives the autoscaler gauges; flight
+post-mortems for cross-process failures produce ONE incident bundle
+whose causality summary names the dead process first; SLO rules fire
+typed ``SloViolation`` events on breach and stay silent otherwise; and
+THE mini-cluster drill — fleet kill-1-of-3 with the shared root armed —
+yields a clock-aligned merged timeline spanning every process with the
+victim's final spans visible.
+"""
+import json
+import os
+import time
+import types
+import warnings
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.telemetry import cluster as tcluster
+from mxnet_tpu.telemetry import exporter as texporter
+from mxnet_tpu.telemetry import flight as tflight
+from mxnet_tpu.telemetry import slo as tslo
+from mxnet_tpu.telemetry.registry import MetricsRegistry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate_cluster_state(monkeypatch):
+    """Every test gets a clean shared-root/incident module state (the
+    exporter root and incident dedupe window are process globals)."""
+    monkeypatch.setattr(texporter, "_last_file_root", None)
+    monkeypatch.setattr(tcluster, "_incident_last", {})
+    yield
+
+
+# ---------------------------------------------------------------------------
+# satellite: exposition metadata + escaping + quantile gauges
+# ---------------------------------------------------------------------------
+def test_prometheus_metadata_and_label_escaping():
+    """Labels carrying paths/newlines/quotes and multi-line help text
+    must scrape clean — # HELP/# TYPE on every family, values
+    escaped."""
+    reg = MetricsRegistry()
+    reg.gauge("io_path_bytes", 'bytes per "path"\nsecond line',
+              ("path",)).labels(
+                  path='C:\\data\n"spool"').set(3)
+    text = reg.prometheus_text()
+    assert '# HELP io_path_bytes bytes per "path"\\nsecond line' in text
+    assert "# TYPE io_path_bytes gauge" in text
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("io_path_bytes{")][0]
+    assert '\\\\' in line and '\\n' in line and '\\"' in line
+    # no raw newline survives inside any sample line
+    for ln in text.splitlines():
+        assert "\n" not in ln
+
+
+def test_histogram_exports_rolling_quantile_gauges():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms", "t", ("e",))
+    child = h.labels(e="0")
+    for v in range(1, 101):
+        child.observe(float(v))
+    q = child.quantiles()
+    assert set(q) == {"p50", "p95", "p99"}
+    assert q["p50"] <= q["p95"] <= q["p99"]
+    text = reg.prometheus_text()
+    for name in ("lat_ms_p50", "lat_ms_p95", "lat_ms_p99"):
+        assert f"# TYPE {name} gauge" in text
+        assert f'{name}{{e="0"}}' in text
+    assert child.summary()["p95"] == q["p95"]
+
+
+def test_router_hedge_threshold_reads_registry_histogram():
+    """One p99 definition: the Router's hedge threshold reads the
+    fleet_attempt_ms registry histogram, not a private deque."""
+    from mxnet_tpu.serving.fleet import FleetMetrics, Router
+
+    m = FleetMetrics("hedgetest")
+    ns = types.SimpleNamespace(metrics=m, _hedge_s=0.05,
+                               _hedge_pct=95.0, _observed_n=0)
+    # under 20 SELF-observed completions: the floor applies (the
+    # registry series outlives router incarnations; a fresh router
+    # must re-observe its own warmup before trusting the window)
+    assert Router._hedge_threshold(ns) == 0.05
+    for v in range(100):
+        m.attempt_ms.observe(float(v))   # ms
+        ns._observed_n += 1
+    expect = m.attempt_ms.quantile(0.95) / 1e3
+    assert Router._hedge_threshold(ns) == pytest.approx(
+        max(0.05, expect))
+    assert "fleet_attempt_ms_p99" in \
+        telemetry.get_registry().prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# satellite: /healthz from the step-loop liveness seams
+# ---------------------------------------------------------------------------
+def test_healthz_answers_from_liveness_probes():
+    import urllib.error
+    import urllib.request
+
+    exp = texporter.Exporter({"mode": "http", "port": 0}).start()
+    try:
+        url = f"http://127.0.0.1:{exp.port}/healthz"
+        # no probes: the process is up — healthy
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["ok"] is True
+        # a live engine-like probe
+        texporter.register_liveness(
+            "llm:t", lambda: {"alive": True,
+                              "last_tick": time.monotonic()})
+        with urllib.request.urlopen(url, timeout=10) as r:
+            body = json.loads(r.read())
+            assert body["ok"] is True
+            assert body["probes"]["llm:t"]["verdict"] == "ok"
+        # the same probe wedged (stale tick) -> 503, same wedge signal
+        # the fleet heartbeats gate on
+        texporter.register_liveness(
+            "llm:t", lambda: {"alive": True,
+                              "last_tick": time.monotonic() - 99,
+                              "stale_s": 1.0})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["probes"]["llm:t"][
+            "verdict"] == "wedged"
+        # dead engine -> 503 dead
+        texporter.register_liveness(
+            "llm:t", lambda: {"alive": False, "last_tick": None})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=10)
+        assert ei.value.code == 503
+    finally:
+        texporter.unregister_liveness("llm:t")
+        exp.stop(final_flush=False)
+
+
+def test_llm_engine_registers_liveness_probe():
+    from mxnet_tpu.gluon.model_zoo import bert
+    from mxnet_tpu.serving import LLMEngine
+
+    onp.random.seed(0)
+    net = bert.gpt_like(vocab_size=17, units=8, hidden_size=16,
+                        num_layers=1, num_heads=2, max_length=32,
+                        dropout=0.0)
+    net.initialize()
+    eng = LLMEngine(net, max_running=2, block_size=4, max_context=16,
+                    kv_cache_dtype="float32")
+    name = f"llm:{eng.metrics.engine_id}"
+    rep = texporter.liveness_report()
+    assert name in rep["probes"] and rep["probes"][name][
+        "verdict"] == "ok"
+    eng.close()
+    assert name not in texporter.liveness_report()["probes"]
+
+
+# ---------------------------------------------------------------------------
+# helpers: fabricate a shared root
+# ---------------------------------------------------------------------------
+def _write_proc(root, role, rank, pid, metrics_reg, *, ts_shift=0.0,
+                events=None):
+    d = os.path.join(root, f"proc_{role}_r{rank}_p{pid}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "metrics.json"), "w") as f:
+        json.dump(metrics_reg.snapshot(), f)
+    with open(os.path.join(d, "metrics.prom"), "w") as f:
+        f.write(metrics_reg.prometheus_text())
+    with open(os.path.join(d, "anchor.json"), "w") as f:
+        json.dump({"schema": "mxnet_tpu.anchor/1", "pid": pid,
+                   "role": role, "rank": rank,
+                   "anchor": {"mono_us": 1e6 + ts_shift,
+                              "unix_us": 2e6}}, f)
+    if events is not None:
+        with open(os.path.join(d, "trace.json"), "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+    return d
+
+
+def _reg_with_tok_s(v, free=10, total=16):
+    reg = MetricsRegistry()
+    reg.gauge("llm_tok_s", "tok/s", ("engine",)).labels(
+        engine="e0").set(v)
+    reg.gauge("llm_pool_blocks_free", "free", ("engine",)).labels(
+        engine="e0").set(free)
+    reg.gauge("llm_pool_blocks_total", "total", ("engine",)).labels(
+        engine="e0").set(total)
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# tentpole: cluster scraper merge + derived gauges
+# ---------------------------------------------------------------------------
+def test_cluster_scraper_merges_and_derives(tmp_path):
+    root = str(tmp_path / "tele")
+    _write_proc(root, "fleet_replica", 0, 100, _reg_with_tok_s(100.0))
+    _write_proc(root, "fleet_replica", 1, 101, _reg_with_tok_s(150.0))
+    router_reg = MetricsRegistry()
+    router_reg.gauge("fleet_free_units", "free", ("fleet",)).labels(
+        fleet="f0").set(22)
+    router_reg.gauge("fleet_capacity_units", "cap", ("fleet",)).labels(
+        fleet="f0").set(32)
+    _write_proc(root, "router", 0, 102, router_reg)
+
+    s = tcluster.ClusterScraper(root)
+    snap = s.scrape()
+    c = snap["cluster"]
+    assert c["processes"] == 3
+    assert c["processes_by_role"] == {"fleet_replica": 2, "router": 1}
+    assert c["tok_s_total"] == 250.0
+    assert c["llm_pool_blocks_free_total"] == 20.0
+    assert c["fleet_free_units"] == 22.0
+    assert c["export_age_min_s"] is not None
+    # derived gauges land in the LOCAL registry for the autoscaler
+    local = telemetry.snapshot()["metrics"]
+    assert local["cluster_tok_s"]["series"][0]["value"] == 250.0
+    assert local["cluster_fleet_free_units"]["series"][0]["value"] == 22
+    # the merged exposition labels every series with its process
+    text = s.prometheus_text()
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("llm_tok_s{")]
+    assert len(lines) == 2
+    for ln in lines:
+        assert 'role="fleet_replica"' in ln and 'process="' in ln
+    assert len([ln for ln in text.splitlines()
+                if ln == "# TYPE llm_tok_s gauge"]) == 1
+    ranks = {ln.split('rank="')[1].split('"')[0] for ln in lines}
+    assert ranks == {"0", "1"}
+
+
+def test_scrape_chaos_degrades_warn_once(tmp_path):
+    """Satellite: chaos site ``telemetry.scrape`` — a faulting scraper
+    warns ONCE, serves the last good snapshot, and never raises into
+    the caller's loop."""
+    root = str(tmp_path / "tele")
+    _write_proc(root, "main", 0, 100, _reg_with_tok_s(10.0))
+    s = tcluster.ClusterScraper(root)
+    good = s.scrape()
+    with chaos.scope("telemetry.scrape", fail="transient"):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            first = s.scrape_guarded()
+            second = s.scrape_guarded()
+        assert first is good and second is good  # last good served
+        assert len([x for x in w
+                    if "cluster scraper" in str(x.message)]) == 1
+    assert s.scrape_guarded() is not good        # healed: fresh scrape
+
+
+# ---------------------------------------------------------------------------
+# tentpole: clock-aligned trace stitching
+# ---------------------------------------------------------------------------
+def test_trace_merge_root_clock_alignment(tmp_path):
+    """Two processes with different perf_counter zeros: the anchors
+    must put their events in true wall-clock order on one timeline."""
+    import sys
+
+    sys.path.insert(0, ROOT)
+    from tools.trace_view import merge_root, summarize, validate_events
+
+    root = str(tmp_path / "tele")
+    # process A: its span at local ts 1e6 (anchor mono 1e6 -> unix 2e6)
+    ev_a = [{"name": "a_span", "cat": "step", "ph": "X",
+             "ts": 1e6, "dur": 100.0, "pid": 100}]
+    # process B: local clock shifted +5e5; its span happens LATER on
+    # the wall clock (local 1.6e6, anchor mono 1.5e6 -> unix 2e6
+    # => wall 2.1e6) even though raw ts ordering would interleave
+    ev_b = [{"name": "b_span", "cat": "step", "ph": "X",
+             "ts": 1.6e6, "dur": 100.0, "pid": 101}]
+    _write_proc(root, "w", 0, 100, _reg_with_tok_s(1), events=ev_a)
+    d = _write_proc(root, "w", 1, 101, _reg_with_tok_s(2), events=ev_b)
+    with open(os.path.join(d, "anchor.json"), "w") as f:
+        json.dump({"pid": 101, "role": "w", "rank": 1,
+                   "anchor": {"mono_us": 1.5e6, "unix_us": 2e6}}, f)
+
+    merged = merge_root(root)
+    validate_events({"traceEvents": merged}, "merged")
+    spans = {e["name"]: e for e in merged if e.get("ph") == "X"}
+    assert spans["a_span"]["ts"] == 0.0          # rebased to 0
+    assert spans["b_span"]["ts"] == pytest.approx(1e5)  # +100 ms wall
+    lanes = [e for e in merged if e.get("ph") == "M"]
+    assert {m["args"]["name"] for m in lanes} == {"w:r0", "w:r1"}
+    assert {e["pid"] for e in spans.values()} == {100, 101}
+    assert summarize(merged)["events"] == len(merged)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: incident bundles
+# ---------------------------------------------------------------------------
+def _write_flight(proc_dir, reason, ts_unix, pid):
+    d = os.path.join(proc_dir, "flight")
+    os.makedirs(d, exist_ok=True)
+    name = f"flight_{int(ts_unix * 1e3)}_{pid}_001_x.json"
+    with open(os.path.join(d, name), "w") as f:
+        json.dump({"schema": "mxnet_tpu.flight/1", "reason": reason,
+                   "ts_unix": ts_unix, "pid": pid, "spans": [],
+                   "metrics": {"metrics": {}}, "metric_deltas": {}}, f)
+
+
+def test_incident_bundle_names_dead_process_first(tmp_path):
+    root = str(tmp_path / "tele")
+    t0 = time.time()
+    victim = _write_proc(root, "fleet_replica", 1, 101,
+                         _reg_with_tok_s(1.0))
+    parent = _write_proc(root, "router", 0, 100, _reg_with_tok_s(0.0))
+    # the victim's own pre-exit dump precedes the detector's
+    _write_flight(victim, "chaos_kill:serving.fleet.replica", t0, 101)
+    _write_flight(parent, "fleet_replica_dead:fleet0.r1", t0 + 0.5, 100)
+
+    bundle = tcluster.build_incident(root, "fleet_replica_dead:fleet0.r1")
+    assert bundle == tcluster.list_incidents(root)[0]
+    summary = json.load(open(os.path.join(bundle, "summary.json")))
+    assert summary["schema"] == tcluster.INCIDENT_SCHEMA
+    assert len(summary["events"]) == 2
+    # causality: the killed process's dump is FIRST, and the suspect
+    # extracted from the typed reason names the dead replica
+    assert "_r1_" in summary["first_event"]["process"]
+    assert summary["suspects"] == ["fleet0.r1"]
+    # every process's artifacts are packaged
+    for proc in (os.path.basename(victim), os.path.basename(parent)):
+        assert os.path.exists(os.path.join(bundle, proc,
+                                           "metrics.json"))
+    assert any(n.startswith("flight_") for n in
+               os.listdir(os.path.join(bundle,
+                                       os.path.basename(victim))))
+    # dedupe window: an immediate second trigger builds NO second bundle
+    assert tcluster.maybe_build_incident(
+        "fleet_replica_dead:fleet0.r1") is None
+
+
+def test_maybe_build_incident_gating(tmp_path, monkeypatch):
+    # no shared root -> no bundle, never raises
+    assert tcluster.maybe_build_incident("fleet_replica_dead:x") is None
+    root = str(tmp_path / "tele")
+    _write_proc(root, "main", 0, 100, _reg_with_tok_s(1.0))
+    monkeypatch.setattr(texporter, "_last_file_root", root)
+    # a non-incident reason is ignored
+    assert tcluster.maybe_build_incident("llm_fatal") is None
+    b = tcluster.maybe_build_incident("io_worker_lost:w2")
+    assert b is not None
+    assert json.load(open(os.path.join(b, "summary.json")))[
+        "suspects"] == ["w2"]
+
+
+# ---------------------------------------------------------------------------
+# tentpole: SLO sentinel
+# ---------------------------------------------------------------------------
+def _snap(processes=None, cluster=None):
+    return {"schema": tcluster.SNAPSHOT_SCHEMA, "ts_unix": time.time(),
+            "processes": processes or {}, "cluster": cluster or {}}
+
+
+def test_slo_spec_parses_and_validates():
+    rules = tslo.parse_slo_spec(
+        "p99:fleet_request_ms<=250; tok_s>=100;starved<=0.1;mfu>=0.2")
+    assert [r.kind for r in rules] == [
+        "p99_ms_max", "tok_s_min", "starved_frac_max", "mfu_min"]
+    assert rules[0].metric == "fleet_request_ms"
+    assert rules[0].threshold == 250.0
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        # wrong op direction + garbage both skip with a warning
+        bad = tslo.parse_slo_spec("p99:x>=5;wat;tok_s<=1;mfu>=abc")
+    assert bad == [] and len(w) >= 3
+    banked = tslo.parse_slo_spec("mfu>=bank:gpt_train*0.8")[0]
+    assert banked.banked_metric == "gpt_train"
+    assert banked.threshold == 0.8
+
+
+def test_slo_sentinel_fires_typed_and_stays_silent():
+    reg = MetricsRegistry()
+    h = reg.histogram("fleet_request_ms", "lat", ("fleet", "tenant"))
+    child = h.labels(fleet="f", tenant="t")
+    for _ in range(50):
+        child.observe(50.0)                      # steady: p99 = 50
+    steady = _snap({"p0": {"metrics": reg.snapshot()}},
+                   {"tok_s_total": 500.0, "input_starved_frac": 0.01})
+    rules = [tslo.SloRule("p99", "p99_ms_max", 200.0),
+             tslo.SloRule("toks", "tok_s_min", 100.0),
+             tslo.SloRule("starved", "starved_frac_max", 0.10)]
+    got = []
+    sent = tslo.SloSentinel(rules, scraper=object.__new__(
+        tcluster.ClusterScraper), bundle=False, on_violation=[got.append])
+    # silent through the steady phase
+    assert sent.evaluate(steady) == []
+    assert got == []
+    # the overload ramp breaches the p99 ceiling
+    for _ in range(200):
+        child.observe(900.0)
+    ramp = _snap({"p0": {"metrics": reg.snapshot()}},
+                 {"tok_s_total": 500.0, "input_starved_frac": 0.01})
+    fired = sent.evaluate(ramp)
+    assert len(fired) == 1 and isinstance(fired[0], tslo.SloViolation)
+    assert fired[0].rule == "p99" and fired[0].observed > 200.0
+    assert got == fired
+    # an episode fires ONCE while it stays breached...
+    assert sent.evaluate(ramp) == []
+    # ...and re-arms after it clears
+    assert sent.evaluate(steady) == []
+    assert len(sent.evaluate(ramp)) == 1
+    snap = telemetry.snapshot()["metrics"]
+    viols = {tuple(sorted(s["labels"].items())): s["value"]
+             for s in snap["slo_violations_total"]["series"]}
+    assert viols[(("rule", "p99"),)] == 2.0
+
+
+def test_slo_violation_builds_incident_bundle(tmp_path, monkeypatch):
+    root = str(tmp_path / "tele")
+    _write_proc(root, "main", 0, os.getpid(), _reg_with_tok_s(1.0))
+    monkeypatch.setattr(texporter, "_last_file_root", root)
+    reg = MetricsRegistry()
+    h = reg.histogram("fleet_request_ms", "lat", ("fleet",))
+    for _ in range(30):
+        h.labels(fleet="f").observe(999.0)
+    snap = _snap({"p0": {"metrics": reg.snapshot()}})
+    sent = tslo.SloSentinel([tslo.SloRule("p99_gate", "p99_ms_max",
+                                          100.0)],
+                            scraper=object.__new__(
+                                tcluster.ClusterScraper))
+    fired = sent.evaluate(snap)
+    assert len(fired) == 1
+    incidents = tcluster.list_incidents(root)
+    assert len(incidents) == 1
+    summary = json.load(open(os.path.join(incidents[0],
+                                          "summary.json")))
+    assert summary["reason"].startswith("slo_violation:p99_gate")
+
+
+def test_slo_mfu_floor_vs_roofline_bank(monkeypatch):
+    reg = MetricsRegistry()
+    reg.gauge("telemetry_mfu", "mfu", ("name",)).labels(
+        name="train").set(0.10)
+    snap = _snap({"p0": {"metrics": reg.snapshot()}})
+    rule = tslo.SloRule("mfu_vs_bank", "mfu_min", 0.8,
+                        banked_metric="gpt_like_train_tok_s")
+    sent = tslo.SloSentinel([rule], scraper=object.__new__(
+        tcluster.ClusterScraper), bundle=False)
+
+    class _Bank:
+        def anchor(self, m):
+            return {"metric": m, "value": 1.0, "mfu": 0.17}
+
+    monkeypatch.setattr(tslo, "SloSentinel", tslo.SloSentinel)
+    from mxnet_tpu.telemetry import mfu as tmfu
+
+    monkeypatch.setattr(tmfu, "_bank", _Bank())
+    fired = sent.evaluate(snap)
+    # floor = 0.8 * 0.17 = 0.136 > observed 0.10 -> breach
+    assert len(fired) == 1
+    assert fired[0].threshold == pytest.approx(0.136)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill: 3-process mini-cluster, fleet kill-1-of-3
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_cluster_drill_fleet_kill_one_of_three(tmp_path):
+    """Fleet kill-1-of-3 with the shared telemetry root armed: the
+    merged timeline must load schema-valid with >= 3 process lanes
+    (including the victim's final spans), the cluster snapshot must sum
+    replica tok/s, and the incident bundle's causality summary must
+    name the actually-killed replica first."""
+    import sys
+
+    from mxnet_tpu.serving import ReplicaPool, Router
+    from mxnet_tpu.base import TransientError
+
+    sys.path.insert(0, ROOT)
+    from tools.trace_view import merge_root, validate_events
+
+    root = str(tmp_path / "tele")
+    os.makedirs(root)
+    spec = {
+        "model": "mxnet_tpu.gluon.model_zoo.bert:gpt_like",
+        "model_kwargs": dict(vocab_size=37, units=16, hidden_size=32,
+                             num_layers=1, num_heads=4, max_length=64,
+                             dropout=0.0),
+        "seed": 0,
+        "engine_kwargs": dict(max_running=4, block_size=4,
+                              max_context=32, kv_cache_dtype="float32"),
+        # every replica exports into the shared root at a drill-fast
+        # period; a REAL kill lands in replica 1 — late enough
+        # (~1.5 s of ticking) that the victim provably SERVED first,
+        # so its final decode spans are on the shared root
+        "env": {"MXNET_TPU_TELEMETRY": f"{root}:0.2"},
+        "env_by_index": {"1": {"MXNET_TPU_CHAOS":
+                               "serving.fleet.replica=kill:1500"}},
+    }
+    # the router process exports into the same root (flat: it is the
+    # role-less "main" lane of the cluster)
+    exp = texporter.Exporter({"mode": "file", "dir": root,
+                              "period_s": 0.2}).start()
+    pool = ReplicaPool(subprocess_spec=spec, n_replicas=3,
+                       heartbeat_s=0.1, stale_s=0.8)
+    router = Router(pool, hedge_ms=0)
+    mid_load_snap = None
+    try:
+        victim = pool.replicas[1]
+        rng = onp.random.RandomState(7)
+        scraper = tcluster.ClusterScraper(root, stale_s=30.0)
+        ok = 0
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                out = router.submit(
+                    rng.randint(0, 37, (5,)).astype(onp.int32), 8,
+                    timeout_ms=None).wait(timeout=120)
+                assert len(out) == 8
+                ok += 1
+            except TransientError:
+                pass
+            if ok >= 8 and mid_load_snap is None:
+                # mid-load: replicas are serving — keep scraping until
+                # a snapshot with live tok/s lands (the 0.2 s export
+                # cadence lags the first completions)
+                cand = scraper.scrape()
+                cprocs = cand["processes"]
+                live = sum(
+                    s["value"]
+                    for k in cprocs if "fleet_replica" in k
+                    for s in cprocs[k]["metrics"]["metrics"].get(
+                        "llm_tok_s", {}).get("series", ()))
+                if live > 0:
+                    mid_load_snap = cand
+            if victim.state == "dead" and ok >= 12:
+                break
+        assert victim.state == "dead"
+        assert victim.host._proc.poll() == 137
+        assert ok >= 12
+
+        # -- cluster snapshot sums replica tok/s ------------------------
+        assert mid_load_snap is not None
+        procs = mid_load_snap["processes"]
+        replica_keys = [k for k in procs if "fleet_replica" in k]
+        assert len(replica_keys) == 3
+        per_proc = 0.0
+        for k in replica_keys:
+            m = procs[k]["metrics"]["metrics"]
+            for s in m.get("llm_tok_s", {}).get("series", ()):
+                per_proc += s["value"]
+        assert per_proc > 0
+        assert mid_load_snap["cluster"]["tok_s_total"] == \
+            pytest.approx(per_proc)
+
+        # -- incident bundle names the killed replica ------------------
+        incidents = []
+        t1 = time.monotonic() + 30
+        while time.monotonic() < t1:
+            incidents = tcluster.list_incidents(root)
+            if incidents:
+                break
+            time.sleep(0.2)
+        assert incidents, "no incident bundle after the kill"
+        summary = json.load(open(os.path.join(incidents[0],
+                                              "summary.json")))
+        assert summary["reason"].startswith("fleet_replica_dead:")
+        assert summary["suspects"][0] == victim.name
+        # the victim's own pre-exit dump (chaos_kill) is the earliest
+        # event — causality starts at the death, not its detection
+        assert "fleet_replica_r1" in summary["first_event"]["process"]
+
+        # -- merged clock-aligned timeline ----------------------------
+        exp.export_now()     # the router lane's final exposition
+        merged = merge_root(root)
+        validate_events({"traceEvents": merged}, "merged")
+        span_pids = {e["pid"] for e in merged if e.get("ph") == "X"}
+        assert len(span_pids) >= 3, f"only {len(span_pids)} lanes"
+        # the victim's final spans are visible: decode steps recorded
+        # by ITS process (exported by the pre-exit flight flush)
+        victim_pid = victim.host._proc.pid
+        victim_spans = [e for e in merged
+                        if e.get("pid") == victim_pid
+                        and e.get("ph") == "X"]
+        assert any(e["name"].startswith("step[llm_")
+                   for e in victim_spans)
+        # request-scoped tracing: decode spans carry the trace ids the
+        # Router minted at admission
+        traced = [e for e in merged if e.get("ph") == "X"
+                  and e.get("args", {}).get("trace_ids")]
+        assert traced, "no step span carries trace_ids"
+        assert any(t.startswith("req-") for e in traced
+                   for t in e["args"]["trace_ids"])
+    finally:
+        router.close()
+        exp.stop(final_flush=False)
+
+
+# ---------------------------------------------------------------------------
+# io.service: worker lanes + dispatch trace ids on the shared root
+# ---------------------------------------------------------------------------
+def test_io_service_workers_export_and_trace(tmp_path):
+    import sys
+
+    from mxnet_tpu.io.service import DatasetService, SyntheticSource
+
+    sys.path.insert(0, ROOT)
+    from tools.trace_view import merge_root
+
+    root = str(tmp_path / "io")
+    tele = str(tmp_path / "tele")
+    src = SyntheticSource(n_batches=6, batch_size=2, dim=4)
+    env_prev = os.environ.get("MXNET_TPU_TELEMETRY")
+    os.environ["MXNET_TPU_TELEMETRY"] = f"{tele}:0.2"
+    try:
+        svc = DatasetService(root, src, num_workers=1, range_size=3,
+                             heartbeat_s=0.1)
+        svc.start()
+        try:
+            svc.start_epoch(0)
+            assert svc.trace_id and svc.trace_id.startswith("io-")
+            # wait for the worker to decode the epoch and export
+            deadline = time.monotonic() + 60
+            merged = []
+            while time.monotonic() < deadline:
+                try:
+                    merged = merge_root(tele)
+                except ValueError:
+                    merged = []
+                if any(e.get("name", "").startswith("io.range")
+                       for e in merged):
+                    break
+                time.sleep(0.3)
+        finally:
+            svc.close()
+        ranges = [e for e in merged
+                  if e.get("name", "").startswith("io.range")]
+        assert ranges, "no io.range span exported by the worker"
+        assert all(e["args"]["trace_id"] == svc.trace_id
+                   for e in ranges)
+        lanes = [e["args"]["name"] for e in merged
+                 if e.get("ph") == "M"]
+        assert any(x.startswith("io_worker:") for x in lanes)
+    finally:
+        if env_prev is None:
+            os.environ.pop("MXNET_TPU_TELEMETRY", None)
+        else:
+            os.environ["MXNET_TPU_TELEMETRY"] = env_prev

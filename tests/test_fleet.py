@@ -573,6 +573,17 @@ def test_fleet_bench_quick(tmp_path):
     assert iso["isolation_ratio_p99"] is not None
     assert iso["gold_with_noisy_neighbor"]["ok"] > 0
     assert iso["noisy_neighbor_lost"] == 0
+    # the SLO sentinel: silent through the steady phase, and IF the
+    # overload ramp breached the declared p99 ceiling a typed
+    # violation fired (the full-run bank pins the fire itself; quick
+    # on a noisy CI box pins consistency both ways)
+    slo = rec["slo"]
+    assert slo["steady_violations"] == 0
+    assert slo["p99_ceiling_ms"] > 0
+    flood_p99 = iso["gold_with_noisy_neighbor"]["p99_ms"]
+    if flood_p99 and flood_p99 > 1.5 * slo["p99_ceiling_ms"]:
+        assert slo["flood_violations"] >= 1
+        assert slo["first_violation"]["rule"] == "gold_p99"
     assert rec["infer_fleet"]["img_s"] > 0
 
 

@@ -114,6 +114,12 @@ def test_prometheus_exposition_golden():
         lat_ms_bucket{e="0",le="+Inf"} 2
         lat_ms_sum{e="0"} 5.5
         lat_ms_count{e="0"} 2
+        # TYPE lat_ms_p50 gauge
+        lat_ms_p50{e="0"} 0.5
+        # TYPE lat_ms_p95 gauge
+        lat_ms_p95{e="0"} 5
+        # TYPE lat_ms_p99 gauge
+        lat_ms_p99{e="0"} 5
         # HELP req_total requests served
         # TYPE req_total counter
         req_total{kind="a"} 3
@@ -146,7 +152,7 @@ def test_serving_histogram_is_telemetry_histogram():
         h.observe(float(v))
     assert h.count == 20 and len(h._recent) == 16  # bounded reservoir
     assert set(h.summary()) == {"count", "mean", "min", "max",
-                                "p50", "p90", "p99"}
+                                "p50", "p90", "p95", "p99"}
 
     m = ServingMetrics()
     m.count("submitted", 3)

@@ -104,6 +104,11 @@ MODULES = {
                             "step",
     "mxnet_tpu.telemetry": "unified telemetry: metrics registry, step "
                            "tracing, MFU gauges, flight recorder",
+    "mxnet_tpu.telemetry.cluster": "cluster observability: shared-root "
+                                   "scraping, merged exposition, "
+                                   "incident bundles",
+    "mxnet_tpu.telemetry.slo": "declarative SLO rules + sentinel over "
+                               "cluster snapshots",
 }
 
 

@@ -14,6 +14,18 @@ the top-N spans by total duration.
 Usage:
     python tools/trace_view.py trace1.json [trace2.json ...]
         [--merge merged.json] [--top 15] [--json]
+    python tools/trace_view.py --merge-root <telemetry_root>
+        [--merge merged.json] [--top 15] [--json]
+
+``--merge-root`` stitches a CLUSTER: it walks a shared telemetry root
+(``MXNET_TPU_TELEMETRY=<root>`` with per-process ``proc_*`` subdirs —
+see ``mxnet_tpu.telemetry.exporter``), loads every process's
+``trace.json``, and uses each process's ``anchor.json`` monotonic↔epoch
+clock anchor to shift its events onto ONE shared timeline — the
+per-process ``perf_counter`` µs clocks have arbitrary zeros, so without
+the anchors N processes' traces cannot be ordered against each other.
+Each process keeps its own pid lane (named ``<role>:r<rank>`` via
+``process_name`` metadata) in Perfetto.
 
 The merged file loads in https://ui.perfetto.dev or chrome://tracing.
 """
@@ -21,11 +33,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import re
 import sys
 from collections import defaultdict
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 REQUIRED_KEYS = ("name", "ph", "ts", "pid")
+
+PROC_DIR_RE = re.compile(r"\Aproc_(?P<role>.+)_r(?P<rank>-?\d+)"
+                         r"_p(?P<pid>\d+)\Z")
 
 
 def validate_events(payload: dict, path: str) -> List[dict]:
@@ -54,6 +71,79 @@ def validate_events(payload: dict, path: str) -> List[dict]:
 def load(path: str) -> List[dict]:
     with open(path) as f:
         return validate_events(json.load(f), path)
+
+
+def discover_root(root: str) -> List[Tuple[str, str, Optional[dict]]]:
+    """``(key, trace_path, anchor_payload)`` per process exporting
+    under ``root`` — the ``proc_*`` subdirs plus a flat root-level
+    dump. Processes without a trace dump are skipped; a missing anchor
+    keeps the trace with a warning (its clock cannot be aligned)."""
+    out: List[Tuple[str, str, Optional[dict]]] = []
+    entries = [("main", root)]
+    try:
+        entries += [(n, os.path.join(root, n))
+                    for n in sorted(os.listdir(root))
+                    if PROC_DIR_RE.match(n)]
+    except OSError:
+        pass
+    for key, d in entries:
+        tpath = os.path.join(d, "trace.json")
+        if not os.path.exists(tpath):
+            continue
+        anchor = None
+        try:
+            with open(os.path.join(d, "anchor.json")) as f:
+                anchor = json.load(f)
+        except (OSError, ValueError):
+            print(f"warning: {key}: no readable anchor.json — its "
+                  "events stay on the process-local clock",
+                  file=sys.stderr)
+        out.append((key, tpath, anchor))
+    return out
+
+
+def merge_root(root: str) -> List[dict]:
+    """Stitch every per-process trace under a shared telemetry root
+    onto ONE clock-aligned timeline: each process's events shift by its
+    anchor's ``unix_us - mono_us`` (mapping the process-local
+    ``perf_counter`` µs clock onto the epoch), then the whole merged
+    timeline rebases to start at 0. Every process keeps its own pid
+    lane, named ``<role>:r<rank>`` through ``process_name`` metadata
+    events."""
+    shifted: List[dict] = []
+    metas: List[dict] = []
+    procs = discover_root(root)
+    if not procs:
+        raise ValueError(f"{root}: no per-process trace.json found "
+                         "(is MXNET_TPU_TELEMETRY exporting here?)")
+    for i, (key, tpath, anchor) in enumerate(procs):
+        events = load(tpath)
+        a = (anchor or {}).get("anchor") or {}
+        offset = (float(a["unix_us"]) - float(a["mono_us"])
+                  if "unix_us" in a and "mono_us" in a else 0.0)
+        m = PROC_DIR_RE.match(key)
+        role = (m.group("role") if m
+                else (anchor or {}).get("role") or key)
+        rank = (m.group("rank") if m
+                else (anchor or {}).get("rank") or 0)
+        pid = (anchor or {}).get("pid")
+        for ev in events:
+            ev = dict(ev)
+            ev["ts"] = float(ev.get("ts", 0.0)) + offset
+            if pid is not None:
+                ev["pid"] = pid
+            shifted.append(ev)
+            if pid is None:
+                pid = ev.get("pid")     # adopt the events' own pid
+        metas.append({"name": "process_name", "ph": "M", "ts": 0.0,
+                      "pid": pid if pid is not None else -(i + 1),
+                      "args": {"name": f"{role}:r{rank}"}})
+    if shifted:
+        base = min(ev["ts"] for ev in shifted)
+        for ev in shifted:
+            ev["ts"] -= base
+    shifted.sort(key=lambda ev: ev.get("ts", 0.0))
+    return metas + shifted
 
 
 def summarize(events: List[dict]) -> Dict:
@@ -132,15 +222,23 @@ def render(summary: Dict, top: int) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="merge + summarize Chrome traces")
-    ap.add_argument("traces", nargs="+", help="trace_event JSON files")
+    ap.add_argument("traces", nargs="*", help="trace_event JSON files")
+    ap.add_argument("--merge-root", default=None,
+                    help="stitch every per-process trace under a "
+                         "shared telemetry root (clock-aligned via "
+                         "each process's anchor.json)")
     ap.add_argument("--merge", default=None,
                     help="write the merged trace here")
     ap.add_argument("--top", type=int, default=15)
     ap.add_argument("--json", action="store_true",
                     help="print the summary as JSON instead of a table")
     args = ap.parse_args(argv)
+    if not args.traces and not args.merge_root:
+        ap.error("pass trace files and/or --merge-root <dir>")
 
     merged: List[dict] = []
+    if args.merge_root:
+        merged.extend(merge_root(args.merge_root))
     for path in args.traces:
         merged.extend(load(path))
     merged.sort(key=lambda ev: ev.get("ts", 0.0))
