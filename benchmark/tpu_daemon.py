@@ -825,6 +825,32 @@ def capture_fleet() -> None:
             f"{rec.get('img_s')} img/s infer fleet")
 
 
+AUTOSCALE = os.path.join(HERE, "results_autoscale_tpu.json")
+
+
+def capture_autoscale() -> None:
+    """Fleet autoscaler row (ISSUE 16, benchmark/autoscale_bench.py):
+    warm-vs-cold scale-up first-token latency, overload-ramp p99 with
+    the autoscaler on vs off, and the multi-model consolidation ratio
+    on the TPU backend — the CPU row (results_autoscale_cpu.json)
+    proved the zero-loss mechanics; the TPU row is where the second
+    replica adds real compute, not just lanes."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "autoscale_bench.py")],
+        timeout=2400)
+    rec = parse_json_output(out)
+    if bank_if_tpu(AUTOSCALE, rec, rc, "autoscale bench") and rec:
+        m = {r.get("metric"): r.get("value")
+             for r in rec.get("metrics", ())}
+        log(f"autoscale: first-token warm "
+            f"{m.get('scale_up_first_token_warm_ms')} ms vs cold "
+            f"{m.get('scale_up_first_token_cold_ms')} ms, ramp p99 "
+            f"{m.get('ramp_p99_autoscaler_on_ms')} (on) vs "
+            f"{m.get('ramp_p99_autoscaler_off_ms')} ms (off), "
+            f"consolidation {m.get('consolidation_ratio')}x, "
+            f"lost={rec.get('lost_requests')}")
+
+
 GSPMD = os.path.join(HERE, "results_gspmd_tpu.json")
 
 
@@ -1350,6 +1376,7 @@ CAPTURES = (
     ("aot", banked_stale(AOT), capture_aot),
     ("opt", banked_stale(OPT), capture_opt),
     ("fleet", banked_stale(FLEET), capture_fleet),
+    ("autoscale", banked_stale(AUTOSCALE), capture_autoscale),
     ("gspmd", banked_stale(GSPMD), capture_gspmd),
     ("io-service", banked_stale(IO_SERVICE), capture_io_service),
     ("quant", banked_stale(QUANT), capture_quant),

@@ -16,9 +16,15 @@ model (ROADMAP: "serves heavy traffic from millions of users"):
   in-flight admission into a running decode batch;
 - :class:`Router` / :class:`ReplicaPool` (:mod:`.fleet`) — the serving
   fleet fault domain: health-checked replicas (``healthy → draining →
-  dead``), least-loaded dispatch, hedged sends with first-wins
-  cancellation, per-replica circuit breakers, weighted-fair tenant
-  quotas with deadline-class shedding, drain/restart lifecycle;
+  dead``, plus pre-warmed ``spare``), least-loaded dispatch, hedged
+  sends with first-wins cancellation, per-replica circuit breakers,
+  multi-model tenancy (:class:`ModelSpec` — N model factories over one
+  shared replica set), weighted-fair tenant quotas with deadline-class
+  shedding, drain/restart/activate lifecycle;
+- :class:`Autoscaler` / :class:`AutoscalePolicy` (:mod:`.autoscale`) —
+  the closed sense→decide→actuate control loop: SLO violations +
+  derived cluster gauges in, hysteresis (up-fast/down-slow) decisions,
+  warm-pool scale-up (AOT manifest replay, not cold compile) out;
 - :mod:`.bench` — the N-concurrent-synthetic-clients harness behind
   ``tools/serve_bench.py``.
 
@@ -27,10 +33,12 @@ bucketing policy and failure semantics.
 """
 from .admission import (AdmissionQueue, DeadlineExceeded, Request,  # noqa: F401
                         RequestCancelled, ServerOverload)
+from .autoscale import AutoscalePolicy, Autoscaler  # noqa: F401
 from .batcher import DynamicBatcher  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
-from .fleet import (CircuitBreaker, FleetRequest, Replica,  # noqa: F401
-                    ReplicaPool, ReplicaUnavailable, Router, TenantConfig)
+from .fleet import (CircuitBreaker, FleetRequest, ModelSpec,  # noqa: F401
+                    Replica, ReplicaPool, ReplicaUnavailable, Router,
+                    TenantConfig)
 from .llm import GenRequest, LLMEngine  # noqa: F401
 from .metrics import Histogram, ServingMetrics  # noqa: F401
 
@@ -50,7 +58,10 @@ __all__ = [
     "ReplicaPool",
     "Replica",
     "TenantConfig",
+    "ModelSpec",
     "FleetRequest",
     "CircuitBreaker",
     "ReplicaUnavailable",
+    "Autoscaler",
+    "AutoscalePolicy",
 ]

@@ -1,0 +1,449 @@
+"""``mxnet_tpu.serving.autoscale`` — the fleet's closed control loop.
+
+The fleet (:mod:`.fleet`) has the actuators (``ReplicaPool.activate``
+/ ``add_replica`` / ``drain``, each warming from the pool's AOT
+manifest frontier) and the cluster telemetry plane has the sensors
+(:class:`~mxnet_tpu.telemetry.cluster.ClusterScraper` derived gauges)
+and the trip-wire (:class:`~mxnet_tpu.telemetry.slo.SloSentinel`).
+This module closes sense → decide → actuate:
+
+- **Sense** — subscribe to typed :class:`~mxnet_tpu.telemetry.slo.SloViolation`
+  events (the up trip-wire; a violation wakes the loop immediately
+  instead of waiting out the poll) and
+  :class:`~mxnet_tpu.telemetry.slo.SloCleared` events (the down edge —
+  scale-down is forbidden while any rule is breached), and poll the
+  derived cluster gauges each period (``cluster_fleet_free_units`` /
+  ``cluster_fleet_capacity_units`` → the free-capacity fraction, plus
+  ``cluster_tok_s``, ``cluster_pool_blocks_free``,
+  ``cluster_input_starved_frac`` for the decision record). Without a
+  scraper the pool's own live gauges are read directly — an in-router
+  autoscaler needs no shared filesystem.
+- **Decide** — hysteresis, up-fast / down-slow: scale UP on the first
+  breach edge or a free-fraction trip (``free < free_frac_up``),
+  bounded by ``up_cooldown_s`` and ``max_replicas``; scale DOWN only
+  after ``idle_s`` of SUSTAINED idle (free fraction above
+  ``free_frac_down``, zero breached rules, and the idle clock resets
+  on any contrary sample), bounded by ``down_cooldown_s`` and
+  ``min_replicas``. The asymmetric cooldowns + the sustained-idle
+  requirement are what keep a noisy gauge from flapping the fleet.
+- **Actuate** — the **warm-pool policy**: scale-up prefers
+  :meth:`~.fleet.ReplicaPool.activate` on a pre-warmed ``SPARE``
+  (manifest replay happened at spare-build time, so admission is a
+  state flip — no compile on the scale-up critical path), then
+  immediately re-warms the next spare in the background; only with no
+  spare parked does it fall back to the cold
+  :meth:`~.fleet.ReplicaPool.add_replica`. Scale-down leaves through
+  :meth:`~.fleet.ReplicaPool.drain` (finish or re-home in-flight
+  lanes — never lose a request to a scale event).
+
+Every decision lands in :attr:`Autoscaler.events` (the no-flapping
+assertion in the tier-1 drill counts them) and in ``autoscale_*``
+registry series. Knobs: ``MXNET_TPU_AUTOSCALE_MIN`` / ``_MAX`` /
+``_SPARES`` / ``_UP_COOLDOWN_S`` / ``_DOWN_COOLDOWN_S`` / ``_IDLE_S``
+/ ``_FREE_FRAC_UP`` / ``_FREE_FRAC_DOWN`` / ``_POLL_S``.
+
+See ``docs/serving.md`` (autoscaler section) for the policy table and
+the warm-pool lifecycle.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..base import env_float
+from ..telemetry.registry import get_registry
+from .fleet import DEAD, HEALTHY, SPARE, ReplicaPool
+
+__all__ = ["AutoscalePolicy", "Autoscaler"]
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class AutoscalePolicy:
+    """The hysteresis contract (every field has a ``MXNET_TPU_AUTOSCALE*``
+    twin, see :meth:`from_env`).
+
+    ``min_replicas`` / ``max_replicas`` bound the HEALTHY set (spares
+    ride outside the bounds — a parked spare serves nothing).
+    ``warm_spares`` is the warm-pool depth: how many pre-warmed SPARE
+    replicas the autoscaler keeps parked for instant activation.
+    ``up_cooldown_s`` < ``down_cooldown_s`` is the up-fast/down-slow
+    asymmetry; ``idle_s`` is how long the idle condition must hold
+    UNINTERRUPTED before a scale-down is even considered.
+    ``free_frac_up`` / ``free_frac_down`` are the gauge trip points on
+    free capacity fraction — the gap between them is the hysteresis
+    band where the fleet holds steady.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    warm_spares: int = 1
+    up_cooldown_s: float = 2.0
+    down_cooldown_s: float = 10.0
+    idle_s: float = 5.0
+    free_frac_up: float = 0.10
+    free_frac_down: float = 0.90
+    poll_s: float = 0.5
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if not (0.0 <= self.free_frac_up
+                <= self.free_frac_down <= 1.0):
+            raise ValueError(
+                "need 0 <= free_frac_up <= free_frac_down <= 1 (the "
+                "gap is the hysteresis band)")
+
+    @classmethod
+    def from_env(cls) -> "AutoscalePolicy":
+        """Build from ``MXNET_TPU_AUTOSCALE_*`` (defaults above)."""
+        return cls(
+            min_replicas=int(env_float("MXNET_TPU_AUTOSCALE_MIN", 1)),
+            max_replicas=int(env_float("MXNET_TPU_AUTOSCALE_MAX", 4)),
+            warm_spares=int(env_float("MXNET_TPU_AUTOSCALE_SPARES", 1)),
+            up_cooldown_s=env_float(
+                "MXNET_TPU_AUTOSCALE_UP_COOLDOWN_S", 2.0),
+            down_cooldown_s=env_float(
+                "MXNET_TPU_AUTOSCALE_DOWN_COOLDOWN_S", 10.0),
+            idle_s=env_float("MXNET_TPU_AUTOSCALE_IDLE_S", 5.0),
+            free_frac_up=env_float(
+                "MXNET_TPU_AUTOSCALE_FREE_FRAC_UP", 0.10),
+            free_frac_down=env_float(
+                "MXNET_TPU_AUTOSCALE_FREE_FRAC_DOWN", 0.90),
+            poll_s=env_float("MXNET_TPU_AUTOSCALE_POLL_S", 0.5),
+        )
+
+
+@dataclass
+class ScaleEvent:
+    """One actuation, as logged in :attr:`Autoscaler.events`."""
+
+    direction: str                      # "up" | "down"
+    replica: str
+    mode: str                           # "warm" | "cold" | "drain"
+    reason: str
+    ts_unix: float = field(default_factory=time.time)
+
+    def to_dict(self) -> Dict:
+        return {"direction": self.direction, "replica": self.replica,
+                "mode": self.mode, "reason": self.reason,
+                "ts_unix": self.ts_unix}
+
+
+class Autoscaler:
+    """Drive one :class:`~.fleet.ReplicaPool` from SLO events + derived
+    cluster gauges.
+
+    Parameters
+    ----------
+    pool : ReplicaPool
+        The fleet to scale (its ``activate``/``add_replica``/``drain``
+        are the actuators).
+    scraper : ClusterScraper, optional
+        Gauge source. With one, each :meth:`step` reads the derived
+        ``cluster`` block of a guarded scrape (the multi-process
+        cluster view — stale processes already excluded); without one,
+        the pool's own live ``free_units``/``capacity_units`` are read
+        directly (the in-router single-process deployment).
+    sentinel : SloSentinel, optional
+        Subscribes ``self`` to its violation AND clear streams: a
+        violation requests an immediate scale-up evaluation (and wakes
+        the background loop); scale-down is vetoed while any rule is
+        breached, and re-enabled by the rule's ``SloCleared`` edge.
+    policy : AutoscalePolicy, optional
+        Default :meth:`AutoscalePolicy.from_env`.
+
+    The control loop is :meth:`step` (one sense→decide→actuate pass —
+    tests and benches drive it synchronously); :meth:`start` runs it on
+    ``policy.poll_s`` cadence from a daemon thread. Call
+    :meth:`ensure_warm` after construction to pre-fill the warm pool.
+    """
+
+    def __init__(self, pool: ReplicaPool, scraper=None, sentinel=None,
+                 policy: Optional[AutoscalePolicy] = None):
+        self.pool = pool
+        self.scraper = scraper
+        self.sentinel = sentinel
+        self.policy = policy or AutoscalePolicy.from_env()
+        self.events: List[ScaleEvent] = []
+        self._breached: set = set()
+        self._pending_up: Optional[str] = None   # reason, consumed on up
+        self._idle_since: Optional[float] = None
+        self._last_up = float("-inf")
+        self._last_down = float("-inf")
+        self._lock = threading.Lock()
+        self._warm_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if sentinel is not None:
+            sentinel.subscribe(self._on_violation)
+            sentinel.subscribe(self._on_cleared, clears=True)
+        reg = get_registry()
+        self._g_healthy = reg.gauge(
+            "autoscale_replicas_healthy",
+            "Replicas in rotation under autoscaler control",
+            ("fleet",)).labels(fleet=pool.name)
+        self._g_spares = reg.gauge(
+            "autoscale_spares", "Pre-warmed SPARE replicas parked",
+            ("fleet",)).labels(fleet=pool.name)
+        self._g_breached = reg.gauge(
+            "autoscale_breach_active",
+            "1 while any subscribed SLO rule is breached (scale-down "
+            "vetoed)", ("fleet",)).labels(fleet=pool.name)
+        self._c_events = reg.counter(
+            "autoscale_events_total", "Autoscaler actuations",
+            ("fleet", "direction", "mode"))
+        self._c_steps = reg.counter(
+            "autoscale_steps_total", "Autoscaler decide passes",
+            ("fleet",)).labels(fleet=pool.name)
+
+    # -- sense -------------------------------------------------------------
+    def _on_violation(self, v) -> None:
+        with self._lock:
+            self._breached.add(v.rule)
+            self._pending_up = f"slo_violation:{v.rule}"
+        self._g_breached.set(1)
+        self._wake.set()
+
+    def _on_cleared(self, c) -> None:
+        with self._lock:
+            self._breached.discard(c.rule)
+            breached = bool(self._breached)
+            if not breached:
+                # an up edge that cleared before it could actuate
+                # (cooldown/bound held it) is stale — acting on it now
+                # would be the flap hysteresis exists to prevent
+                self._pending_up = None
+        self._g_breached.set(1 if breached else 0)
+
+    def observe(self) -> Dict[str, Any]:
+        """One gauge sample: the derived cluster block when a scraper
+        is wired (``cluster_*`` quantities — stale processes already
+        excluded by the scraper), else the pool's live gauges."""
+        free = cap = None
+        tok_s = blocks_free = starved = None
+        if self.scraper is not None:
+            snap = self.scraper.scrape_guarded()
+            c = (snap or {}).get("cluster") or {}
+            cap = c.get("fleet_capacity_units")
+            free = c.get("fleet_free_units")
+            tok_s = c.get("tok_s_total")
+            blocks_free = c.get("llm_pool_blocks_free_total")
+            starved = c.get("input_starved_frac")
+        if not cap:
+            # no cluster signal (no scraper, or the root has no router
+            # exposition yet): the pool's own live gauges
+            cap = self.pool.capacity_units()
+            free = self.pool.free_units()
+        free_frac = (float(free) / float(cap)
+                     if cap and float(cap) > 0 else None)
+        return {"free_units": free, "capacity_units": cap,
+                "free_frac": free_frac, "tok_s": tok_s,
+                "pool_blocks_free": blocks_free,
+                "input_starved_frac": starved}
+
+    # -- decide + actuate --------------------------------------------------
+    def step(self) -> Optional[str]:
+        """One sense→decide→actuate pass; returns ``"up"`` / ``"down"``
+        / None (held). Safe to call from any thread."""
+        self._c_steps.inc()
+        now = time.monotonic()
+        g = self.observe()
+        with self._lock:
+            breached = bool(self._breached)
+            pending = self._pending_up
+        n = len(self.pool.healthy())
+        p = self.policy
+        self._publish(n)
+        gauge_trip = (g["free_frac"] is not None
+                      and g["free_frac"] < p.free_frac_up)
+        if pending or breached or gauge_trip:
+            self._idle_since = None       # contrary sample: idle resets
+            if n >= p.max_replicas or now - self._last_up < p.up_cooldown_s:
+                return None               # trip held by bound/cooldown
+            reason = (pending or
+                      (f"free_frac {g['free_frac']:.3f} < "
+                       f"{p.free_frac_up:g}" if gauge_trip
+                       else "slo breach sustained"))
+            return self._scale_up(reason)
+        idle = (g["free_frac"] is None
+                or g["free_frac"] >= p.free_frac_down)
+        if not idle or n <= p.min_replicas:
+            self._idle_since = None
+            return None
+        if self._idle_since is None:
+            self._idle_since = now
+            return None
+        if (now - self._idle_since >= p.idle_s
+                and now - self._last_down >= p.down_cooldown_s):
+            return self._scale_down(
+                f"idle {now - self._idle_since:.1f}s "
+                f"(free_frac {g['free_frac']:.3f})"
+                if g["free_frac"] is not None else "idle (no traffic)")
+        return None
+
+    def _scale_up(self, reason: str) -> Optional[str]:
+        r = self.pool.activate()          # the warm-pool fast path
+        mode = "warm"
+        if r is None:
+            try:
+                r = self.pool.add_replica()
+            except Exception:  # noqa: BLE001 — a failed cold add must
+                log.exception(  # not kill the control loop
+                    "autoscaler %s: cold scale-up failed",
+                    self.pool.name)
+                return None
+            mode = "cold"
+        self._last_up = time.monotonic()
+        with self._lock:
+            self._pending_up = None       # the edge is consumed
+        self._record("up", r.name, mode, reason)
+        # warm-pool policy: the spare just spent (or the cold add that
+        # proved none was parked) re-warms in the background so the
+        # NEXT scale-up is manifest-replay too
+        self.ensure_warm(wait=False)
+        return "up"
+
+    def _scale_down(self, reason: str) -> Optional[str]:
+        healthy = self.pool.healthy()
+        if len(healthy) <= self.policy.min_replicas:
+            return None
+        victim = min(healthy, key=lambda r: r.host.inflight())
+        self.pool.drain(victim.name)
+        self._last_down = time.monotonic()
+        self._idle_since = None           # the next episode starts fresh
+        self._record("down", victim.name, "drain", reason)
+        return "down"
+
+    def _record(self, direction: str, replica: str, mode: str,
+                reason: str) -> None:
+        ev = ScaleEvent(direction, replica, mode, reason)
+        with self._lock:
+            self.events.append(ev)
+        self._c_events.labels(fleet=self.pool.name,
+                              direction=direction, mode=mode).inc()
+        self._publish(len(self.pool.healthy()))
+        log.info("autoscaler %s: scale-%s %s (%s, %s)", self.pool.name,
+                 direction, replica, mode, reason)
+
+    def _publish(self, n_healthy: int) -> None:
+        self._g_healthy.set(n_healthy)
+        self._g_spares.set(len(self.pool.spares()))
+        with self._lock:
+            self._g_breached.set(1 if self._breached else 0)
+
+    # -- warm pool ---------------------------------------------------------
+    def ensure_warm(self, wait: bool = True) -> None:
+        """Fill the warm pool to ``policy.warm_spares`` pre-warmed
+        SPARE replicas (each built + AOT-manifest-warmed OFF the
+        serving path). ``wait=False`` fills from a background thread —
+        the post-scale-up re-warm that keeps the next scale-up warm
+        without stalling the decision loop."""
+        def fill() -> None:
+            with self._warm_lock:        # one filler at a time
+                while not self._stop_ev.is_set():
+                    with self.pool._lock:
+                        spares = sum(1 for r in self.pool.replicas
+                                     if r.state == SPARE)
+                        healthy = sum(1 for r in self.pool.replicas
+                                      if r.state == HEALTHY)
+                        live = sum(1 for r in self.pool.replicas
+                                   if r.state != DEAD)
+                    if spares >= self.policy.warm_spares:
+                        break
+                    if healthy >= self.policy.max_replicas:
+                        break             # no scale-up headroom left —
+                        # a spare built now could never be activated
+                    if live >= (self.policy.max_replicas
+                                + self.policy.warm_spares):
+                        break             # never build past the bound
+                    try:
+                        self.pool.add_spare()
+                    except Exception:  # noqa: BLE001 — a failed spare
+                        log.exception(  # build must not loop hot
+                            "autoscaler %s: spare build failed",
+                            self.pool.name)
+                        break
+            self._publish(len(self.pool.healthy()))
+
+        if wait:
+            fill()
+        else:
+            threading.Thread(target=fill, daemon=True,
+                             name=f"autoscale-warm:{self.pool.name}"
+                             ).start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Autoscaler":
+        """Run :meth:`step` every ``policy.poll_s`` from a daemon
+        thread; an incoming ``SloViolation`` wakes it immediately."""
+        if self._thread is not None:
+            return self
+        self._stop_ev.clear()
+
+        def loop() -> None:
+            while not self._stop_ev.is_set():
+                self._wake.wait(self.policy.poll_s)
+                self._wake.clear()
+                if self._stop_ev.is_set():
+                    break
+                try:
+                    self.step()
+                except Exception:  # noqa: BLE001 — the control loop
+                    log.exception(  # survives a bad pass
+                        "autoscaler %s: step failed", self.pool.name)
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True,
+            name=f"autoscaler:{self.pool.name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        self._wake.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+        with self._warm_lock:
+            pass                          # a background fill finishes
+
+    def stats(self) -> Dict:
+        with self._lock:
+            events = [e.to_dict() for e in self.events]
+            breached = sorted(self._breached)
+        return {
+            "fleet": self.pool.name,
+            "healthy": len(self.pool.healthy()),
+            "spares": [r.name for r in self.pool.spares()],
+            "breached_rules": breached,
+            "events": events,
+            "scale_ups": sum(1 for e in events
+                             if e["direction"] == "up"),
+            "scale_downs": sum(1 for e in events
+                               if e["direction"] == "down"),
+            "policy": {
+                "min_replicas": self.policy.min_replicas,
+                "max_replicas": self.policy.max_replicas,
+                "warm_spares": self.policy.warm_spares,
+                "up_cooldown_s": self.policy.up_cooldown_s,
+                "down_cooldown_s": self.policy.down_cooldown_s,
+                "idle_s": self.policy.idle_s,
+                "free_frac_up": self.policy.free_frac_up,
+                "free_frac_down": self.policy.free_frac_down,
+            },
+        }
+
+    def __enter__(self) -> "Autoscaler":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False

@@ -75,14 +75,18 @@ from .admission import (DeadlineExceeded, Request, RequestCancelled,
                         ServerOverload)
 
 __all__ = [
-    "HEALTHY", "DRAINING", "DEAD",
-    "ReplicaUnavailable", "TenantConfig", "FleetRequest",
+    "HEALTHY", "DRAINING", "DEAD", "SPARE",
+    "ReplicaUnavailable", "TenantConfig", "ModelSpec", "FleetRequest",
     "CircuitBreaker", "Replica", "ReplicaPool", "Router",
 ]
 
 HEALTHY = "healthy"
 DRAINING = "draining"
 DEAD = "dead"
+#: A pre-warmed replica parked OUT of rotation (the autoscaler's warm
+#: pool): engine built, AOT-manifest warmed, heartbeat beating — but
+#: never routed to until :meth:`ReplicaPool.activate` flips it healthy.
+SPARE = "spare"
 
 log = logging.getLogger(__name__)
 
@@ -138,26 +142,55 @@ class ReplicaUnavailable(TransientError):
 
 
 @dataclass
+class ModelSpec:
+    """One hosted model family in a multi-model pool: a named factory
+    whose engines every replica carries side by side.
+
+    Each replica builds ONE engine per spec, so a model's KV block pool
+    is a hard per-model budget — the engine the factory configures
+    (``max_running``/``max_context``/``block_size``) IS the model's
+    block-pool budget on every replica, and a flood of long prompts on
+    one model can never evict another model's KV blocks. The pool keeps
+    a per-model AOT warmup-manifest frontier, so spares and restarts
+    replay every model's compiled shapes.
+
+    All specs in one pool must build the same engine *kind*
+    (:class:`~.llm.LLMEngine` or :class:`~.engine.InferenceEngine`).
+    """
+
+    name: str
+    factory: Callable[[], Any]
+
+
+@dataclass
 class TenantConfig:
     """One tenant's isolation contract.
 
     ``weight`` sizes the tenant's fair share of live fleet capacity
     (KV blocks for LLM fleets, queue slots for fixed-shape ones):
     ``quota = weight / sum(weights) * live_capacity``, recomputed as
-    replicas die/rejoin — losing a replica throttles every tenant
-    proportionally, and a noisy neighbor saturates only its own share.
-    An explicit ``quota_units`` overrides the weight share.
+    replicas die/rejoin *and on every autoscaler scale event* — losing
+    a replica throttles every tenant proportionally, activating one
+    grows every share, and a noisy neighbor saturates only its own
+    share. An explicit ``quota_units`` overrides the weight share.
 
     ``deadline_class`` orders shedding under pressure (higher = kept
     longer): when fleet free capacity drops below the pressure
     threshold, class 0 (best-effort) is shed first, then class 1, so a
     capacity loss degrades the *right* tenants first.
+
+    ``model`` pins the tenant to one hosted :class:`ModelSpec` in a
+    multi-model pool: its requests route to that model's engines and
+    its weight-share quota is computed against that MODEL's capacity,
+    normalized over the tenants pinned to the same model (unpinned
+    tenants share the pool-wide total).
     """
 
     name: str
     weight: float = 1.0
     deadline_class: int = 1
     quota_units: Optional[int] = None
+    model: Optional[str] = None
 
 
 _req_seq = itertools.count()
@@ -171,13 +204,15 @@ class FleetRequest(Request):
 
     __slots__ = ("tenant", "key", "max_new_tokens", "eos_token",
                  "on_token", "units", "readmits", "hedges", "attempt_n",
-                 "trace")
+                 "trace", "model")
 
     def __init__(self, prompt, max_new_tokens: int, tenant: str,
                  deadline: Optional[float], units: int,
-                 eos_token: Optional[int], on_token: Optional[Callable]):
+                 eos_token: Optional[int], on_token: Optional[Callable],
+                 model: Optional[str] = None):
         super().__init__(prompt, 1, ("fleet",), deadline)
         self.tenant = tenant
+        self.model = model
         self.key = f"{tenant}-{next(_req_seq)}"
         # request-scoped distributed trace, minted HERE (the cluster's
         # front door): every attempt — original, hedge twin,
@@ -325,7 +360,7 @@ class FleetMetrics:
                                    event=event).inc(n)
 
     def set_states(self, counts: Dict[str, int]) -> None:
-        for state in (HEALTHY, DRAINING, DEAD):
+        for state in (HEALTHY, DRAINING, DEAD, SPARE):
             self._replicas.labels(fleet=self.fleet, state=state).set(
                 counts.get(state, 0))
 
@@ -339,98 +374,169 @@ class FleetMetrics:
 # ---------------------------------------------------------------------------
 
 class _LocalHost:
-    """In-process engine host: wraps an :class:`~.llm.LLMEngine` or
-    :class:`~.engine.InferenceEngine` built by ``factory()``."""
+    """In-process engine host: one engine per hosted model family
+    (:class:`ModelSpec`), all built by their factories inside this
+    replica. The single-model pool is the N=1 case — ``self.engine``
+    stays the primary (first) model's engine for back-compat. Every
+    ``model=None`` query aggregates across the hosted engines; a named
+    model scopes it to that engine (the model's hard KV budget)."""
 
-    def __init__(self, factory: Callable[[], Any], hook: Callable[[], None]):
-        self._factory = factory
+    def __init__(self, factories: Dict[str, Callable[[], Any]],
+                 hook: Callable[[], None]):
+        if not factories:
+            raise ValueError("at least one model factory is required")
+        self._factories = dict(factories)
+        self._primary = next(iter(self._factories))
         self._hook = hook
-        self.engine = None
+        self.engines: Dict[str, Any] = {}
+        self.engine = None               # primary engine (back-compat)
         self.kind = None
 
     def start(self) -> None:
         from .engine import InferenceEngine
         from .llm import LLMEngine
 
-        eng = self._factory()
-        if isinstance(eng, LLMEngine):
-            self.kind = "llm"
-            # the per-replica chaos/liveness hook rides the scheduler
-            # tick (respect a hook the factory installed itself)
-            if eng._step_hook is None:
-                eng._step_hook = self._hook
-        elif isinstance(eng, InferenceEngine):
-            self.kind = "infer"
-            # same seam on the batcher loop: the chaos site fires in
-            # the REPLICA's thread (a delay wedges it, a fatal kills
-            # it), never in the router's or a caller's
-            if eng._batcher._step_hook is None:
-                eng._batcher._step_hook = self._hook
-        else:
-            raise TypeError(
-                f"fleet replica factory must build an LLMEngine or "
-                f"InferenceEngine, got {type(eng).__name__}")
-        self.engine = eng
+        for model, factory in self._factories.items():
+            eng = factory()
+            if isinstance(eng, LLMEngine):
+                kind = "llm"
+                # the per-replica chaos/liveness hook rides the
+                # scheduler tick (respect a hook the factory installed
+                # itself)
+                if eng._step_hook is None:
+                    eng._step_hook = self._hook
+            elif isinstance(eng, InferenceEngine):
+                kind = "infer"
+                # same seam on the batcher loop: the chaos site fires
+                # in the REPLICA's thread (a delay wedges it, a fatal
+                # kills it), never in the router's or a caller's
+                if eng._batcher._step_hook is None:
+                    eng._batcher._step_hook = self._hook
+            else:
+                raise TypeError(
+                    f"fleet replica factory must build an LLMEngine or "
+                    f"InferenceEngine, got {type(eng).__name__}")
+            if self.kind is None:
+                self.kind = kind
+            elif kind != self.kind:
+                eng.close(drain=False, timeout_s=1.0)
+                raise TypeError(
+                    f"model {model!r} builds a {kind} engine but the "
+                    f"pool hosts {self.kind} engines — one kind per "
+                    "pool")
+            self.engines[model] = eng
+        self.engine = self.engines[self._primary]
+
+    def _eng(self, model: Optional[str]):
+        if model is None:
+            return self.engine
+        try:
+            return self.engines[model]
+        except KeyError:
+            raise ValueError(
+                f"unknown model {model!r} (hosted: "
+                f"{sorted(self.engines)})") from None
 
     # -- liveness ---------------------------------------------------------
     @property
     def alive(self) -> bool:
-        e = self.engine
-        return e is not None and bool(getattr(e, "alive", False))
+        # one dead engine kills the replica: its requests (BOTH
+        # models') re-home, and the restart path rebuilds all engines
+        return bool(self.engines) and all(
+            bool(getattr(e, "alive", False))
+            for e in self.engines.values())
 
     def tick_age(self) -> float:
-        e = self.engine
-        if e is None:
+        if not self.engines:
             return float("inf")
-        return time.monotonic() - float(e.last_tick)
+        return max(time.monotonic() - float(e.last_tick)
+                   for e in self.engines.values())
 
     # -- load / capacity --------------------------------------------------
-    def inflight(self) -> int:
-        e = self.engine
+    def _eng_inflight(self, e) -> int:
         if self.kind == "llm":
             return int(e.metrics.lanes_active.get()) + len(e._queue)
         return len(e._queue)
 
-    def capacity_units(self) -> int:
-        if self.kind == "llm":
-            return int(self.engine.num_blocks)
-        return int(self.engine._queue._max)
+    def inflight(self, model: Optional[str] = None) -> int:
+        if model is not None:
+            return self._eng_inflight(self._eng(model))
+        return sum(self._eng_inflight(e) for e in self.engines.values())
 
-    def free_units(self) -> int:
+    def _eng_capacity(self, e) -> int:
         if self.kind == "llm":
-            return int(self.engine.metrics.pool_free.get())
-        return max(0, self.capacity_units() - len(self.engine._queue))
+            return int(e.num_blocks)
+        return int(e._queue._max)
 
-    def cost_units(self, prompt_len: int, max_new: int) -> int:
+    def capacity_units(self, model: Optional[str] = None) -> int:
+        if model is not None:
+            return self._eng_capacity(self._eng(model))
+        return sum(self._eng_capacity(e) for e in self.engines.values())
+
+    def _eng_free(self, e) -> int:
         if self.kind == "llm":
-            e = self.engine
+            return int(e.metrics.pool_free.get())
+        return max(0, self._eng_capacity(e) - len(e._queue))
+
+    def free_units(self, model: Optional[str] = None) -> int:
+        if model is not None:
+            return self._eng_free(self._eng(model))
+        return sum(self._eng_free(e) for e in self.engines.values())
+
+    def cost_units(self, prompt_len: int, max_new: int,
+                   model: Optional[str] = None) -> int:
+        if self.kind == "llm":
+            e = self._eng(model)
             return -(-(prompt_len + max_new + e._slack) // e.block_size)
         return 1
 
     # -- dispatch ---------------------------------------------------------
     def submit(self, req: FleetRequest,
                timeout_ms: Optional[float]) -> Request:
+        eng = self._eng(req.model)
         if self.kind == "llm":
-            return self.engine.submit(
+            return eng.submit(
                 req.payload, req.max_new_tokens,
                 eos_token=req.eos_token, timeout_ms=timeout_ms,
                 on_token=req.on_token, trace_id=req.trace.trace_id)
-        return self.engine.infer_async(req.payload, timeout_ms=timeout_ms)
+        return eng.infer_async(req.payload, timeout_ms=timeout_ms)
 
     # -- lifecycle --------------------------------------------------------
     def snapshot_manifest(self):
-        try:
-            return self.engine.warmup_manifest()
-        except Exception:  # noqa: BLE001 — observability only
-            return None
+        """Per-model AOT warmup frontier: ``{model: manifest}`` (models
+        whose engine cannot report one are absent)."""
+        out = {}
+        for model, e in self.engines.items():
+            try:
+                out[model] = e.warmup_manifest()
+            except Exception:  # noqa: BLE001 — observability only
+                pass
+        return out or None
 
     def warm(self, manifest) -> None:
-        if manifest is not None and list(manifest.entries()):
-            self.engine.warmup(manifest=manifest)
+        """Replay AOT warmup manifests: a ``{model: manifest}`` dict
+        warms each hosted engine from its model's frontier; a bare
+        manifest (pre-multi-model snapshot) warms the primary."""
+        if manifest is None:
+            return
+        per_model = (manifest if isinstance(manifest, dict)
+                     else {self._primary: manifest})
+        for model, m in per_model.items():
+            eng = self.engines.get(model)
+            if eng is None or m is None:
+                continue
+            try:
+                if list(m.entries()):
+                    eng.warmup(manifest=m)
+            except Exception:  # noqa: BLE001 — warmup is an
+                pass           # optimization, not a correctness gate
 
     def close(self, drain: bool, timeout_s: float) -> None:
-        if self.engine is not None:
-            self.engine.close(drain=drain, timeout_s=timeout_s)
+        for e in self.engines.values():
+            try:
+                e.close(drain=drain, timeout_s=timeout_s)
+            except Exception:  # noqa: BLE001 — close the rest anyway
+                pass
 
 
 class _ProcRequest(Request):
@@ -579,20 +685,21 @@ class _ProcHost:
         ages = Heartbeat.ages(self._root)
         return ages.get(self._index, float("inf"))
 
-    def inflight(self) -> int:
+    def inflight(self, model: Optional[str] = None) -> int:
         # the worker's reported load already counts every admitted
         # request; _pending holds the same requests until their reply
         # lands. max() covers the stats lag (just-submitted, not yet in
         # the worker's 0.25 s-cadence stats) without double-counting.
         return max(int(self._stats.get("load", 0)), len(self._pending))
 
-    def capacity_units(self) -> int:
+    def capacity_units(self, model: Optional[str] = None) -> int:
         return int(self._stats.get("cap", 1))
 
-    def free_units(self) -> int:
+    def free_units(self, model: Optional[str] = None) -> int:
         return int(self._stats.get("free", 0))
 
-    def cost_units(self, prompt_len: int, max_new: int) -> int:
+    def cost_units(self, prompt_len: int, max_new: int,
+                   model: Optional[str] = None) -> int:
         bs = int(self._stats.get("block_size", 16))
         return -(-(prompt_len + max_new
                    + int(self._stats.get("slack", 0))) // bs)
@@ -602,6 +709,10 @@ class _ProcHost:
         if not self.alive:
             raise ReplicaUnavailable(
                 f"fleet replica {self._name!r} process is gone")
+        if req.model is not None:
+            raise ValueError(
+                "subprocess replicas host one model (the worker spec) "
+                "— model= routing needs in-process multi-model pools")
         if req.on_token is not None:
             raise ValueError("subprocess replicas do not stream "
                              "(on_token=) — use in-process replicas")
@@ -705,8 +816,8 @@ class Replica:
         self.host.start()
         if self._manifest is not None:
             self.host.warm(self._manifest)
-        eng = getattr(self.host, "engine", None)
-        if eng is not None:
+        for eng in (getattr(self.host, "engines", None)
+                    or {}).values():
             try:
                 # factory-side warmup holds the scheduler's state lock
                 # for seconds (compiles): the loop could not tick, but
@@ -796,8 +907,15 @@ class ReplicaPool:
         replica (and per restart). Replicas sharing one model object
         share its compiled programs (the generation-module memoization),
         so an in-process fleet pays ONE compile per program shape.
+        Shorthand for ``models=[ModelSpec("default", factory)]``.
     n_replicas : int
         Fleet width. Default ``MXNET_TPU_FLEET_REPLICAS`` (2).
+    models : list of ModelSpec, optional
+        Multi-model tenancy: EVERY replica hosts one engine per spec
+        over the one shared replica set (consolidation — N models on
+        one pool, not N dedicated pools), each with its own hard KV
+        block-pool budget and AOT manifest frontier. Mutually
+        exclusive with ``factory`` and ``subprocess_spec``.
     subprocess_spec : dict, optional
         Build subprocess-backed replicas instead (see
         :class:`_ProcHost`): each replica is a real OS process with its
@@ -811,15 +929,25 @@ class ReplicaPool:
 
     def __init__(self, factory: Optional[Callable[[], Any]] = None,
                  n_replicas: Optional[int] = None, *,
+                 models: Optional[List[ModelSpec]] = None,
                  subprocess_spec: Optional[Dict] = None,
                  root: Optional[str] = None,
                  heartbeat_s: Optional[float] = None,
                  stale_s: Optional[float] = None,
                  name: Optional[str] = None):
-        if (factory is None) == (subprocess_spec is None):
+        n_sources = sum(x is not None
+                        for x in (factory, models, subprocess_spec))
+        if n_sources != 1:
             raise ValueError(
-                "pass exactly one of factory= (in-process replicas) or "
-                "subprocess_spec= (subprocess-backed replicas)")
+                "pass exactly one of factory= / models= (in-process "
+                "replicas) or subprocess_spec= (subprocess-backed "
+                "replicas)")
+        if factory is not None:
+            models = [ModelSpec("default", factory)]
+        if models is not None:
+            names = [m.name for m in models]
+            if len(set(names)) != len(names):
+                raise ValueError(f"duplicate model names: {names}")
         if n_replicas is None:
             n_replicas = fleet_replicas_default()
         if n_replicas < 1:
@@ -832,10 +960,19 @@ class ReplicaPool:
                            else fleet_heartbeat_s())
         self._stale_s = float(stale_s if stale_s is not None
                               else fleet_stale_s(self._hb_s))
-        self._factory = factory
+        self.models: List[ModelSpec] = list(models or [])
+        self._factories = {m.name: m.factory for m in self.models}
         self._spec = subprocess_spec
         self.metrics = FleetMetrics(self.name)
         self._lock = threading.RLock()
+        self._next_index = int(n_replicas)
+        # the pool-level AOT warmup frontier, per model: refreshed from
+        # live replicas and absorbed from dying ones, so a NEW spare
+        # warms by manifest replay instead of cold compile
+        self._manifests: Dict[str, Any] = {}
+        # scale-event subscribers (router quota rebalance, autoscaler
+        # bookkeeping) — called OUTSIDE the pool lock
+        self._scale_subs: List[Callable[[str, str], None]] = []
         self.replicas: List[Replica] = []
         for i in range(int(n_replicas)):
             self.replicas.append(self._build(i))
@@ -853,8 +990,8 @@ class ReplicaPool:
 
     def _build(self, index: int) -> Replica:
         rname = f"{self.name}.r{index}"
-        if self._factory is not None:
-            host = _LocalHost(self._factory, hook=lambda: None)
+        if self._factories:
+            host = _LocalHost(self._factories, hook=lambda: None)
         else:
             host = _ProcHost(self._spec, self.root, index, rname,
                              self._hb_s)
@@ -876,14 +1013,16 @@ class ReplicaPool:
     def kind(self) -> str:
         return self.replicas[0].host.kind or "llm"
 
-    def capacity_units(self) -> int:
-        return sum(r.host.capacity_units() for r in self.healthy())
+    def capacity_units(self, model: Optional[str] = None) -> int:
+        return sum(r.host.capacity_units(model) for r in self.healthy())
 
-    def free_units(self) -> int:
-        return sum(r.host.free_units() for r in self.healthy())
+    def free_units(self, model: Optional[str] = None) -> int:
+        return sum(r.host.free_units(model) for r in self.healthy())
 
-    def cost_units(self, prompt_len: int, max_new: int) -> int:
-        return self.replicas[0].host.cost_units(prompt_len, max_new)
+    def cost_units(self, prompt_len: int, max_new: int,
+                   model: Optional[str] = None) -> int:
+        return self.replicas[0].host.cost_units(prompt_len, max_new,
+                                                model)
 
     def _publish_states(self) -> None:
         counts: Dict[str, int] = {}
@@ -951,6 +1090,7 @@ class ReplicaPool:
         r.state_reason = reason
         r.generation += 1
         r.snapshot_manifest()
+        self._absorb_manifest(r._manifest)
         self.metrics.count("replica_dead")
         # free pool state best-effort in the background: a wedged
         # engine's close() join must not stall the health loop. The
@@ -1002,6 +1142,7 @@ class ReplicaPool:
                 break
             time.sleep(0.01)
         r.snapshot_manifest()
+        self._absorb_manifest(r._manifest)
         try:
             r.host.close(drain=False, timeout_s=5.0)
         except Exception:  # noqa: BLE001
@@ -1013,6 +1154,7 @@ class ReplicaPool:
                 r.generation += 1
                 self.metrics.count("replica_drained")
             self._publish_states()
+        self._notify_scale("drained", r.name)
         return r
 
     def restart(self, name: str) -> Replica:
@@ -1036,8 +1178,8 @@ class ReplicaPool:
             r._restarting = True
         try:
             r.stop_beating()
-            if self._factory is not None:
-                host = _LocalHost(self._factory, hook=r._hook)
+            if self._factories:
+                host = _LocalHost(self._factories, hook=r._hook)
             else:
                 host = _ProcHost(self._spec, self.root, r.index,
                                  r.name, self._hb_s)
@@ -1050,6 +1192,108 @@ class ReplicaPool:
                 self._publish_states()
         finally:
             r._restarting = False
+        return r
+
+    # -- scale events (the autoscaler's actuators) -------------------------
+    def on_scale(self, fn: Callable[[str, str], None]) -> None:
+        """Subscribe to membership scale events: ``fn(event, replica)``
+        fires (outside the pool lock) on ``spare_added`` /
+        ``activated`` / ``added`` / ``drained`` — the router rebalances
+        tenant quotas on this edge, the autoscaler logs it."""
+        self._scale_subs.append(fn)
+
+    def _notify_scale(self, event: str, replica: str) -> None:
+        for fn in list(self._scale_subs):
+            try:
+                fn(event, replica)
+            except Exception:  # noqa: BLE001 — a broken subscriber
+                pass           # must not stop the scale event
+
+    def _absorb_manifest(self, m) -> None:
+        """Merge a replica's per-model manifest snapshot into the
+        pool-level frontier (what new spares warm from)."""
+        if not isinstance(m, dict):
+            return
+        with self._lock:
+            self._manifests.update(
+                {k: v for k, v in m.items() if v is not None})
+
+    def snapshot_manifests(self) -> Dict[str, Any]:
+        """Refresh the pool's per-model AOT warmup frontier from the
+        first live replica (spares warm from this — manifest replay,
+        not cold compile)."""
+        for r in self.healthy():
+            self._absorb_manifest(r.host.snapshot_manifest())
+            break
+        with self._lock:
+            return dict(self._manifests)
+
+    def spares(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self.replicas if r.state == SPARE]
+
+    def add_spare(self) -> Replica:
+        """Warm-pool policy: build + start a NEW replica pre-warmed
+        from the pool's AOT manifest frontier, parked in ``SPARE``
+        state (beating, out of rotation, zero routed traffic) so the
+        next scale-up is :meth:`activate` — a state flip, not a
+        compile. The build runs outside the pool lock; the rest of the
+        fleet keeps serving."""
+        with self._lock:
+            index = self._next_index
+            self._next_index += 1
+        manifests = self.snapshot_manifests()
+        r = self._build(index)
+        r._manifest = manifests or None
+        r.start()                        # build + warm, no pool lock
+        with self._lock:
+            r.state = SPARE
+            r.state_reason = "warm spare (pre-warmed, out of rotation)"
+            self.replicas.append(r)
+            self._publish_states()
+        self.metrics.count("spare_added")
+        self._notify_scale("spare_added", r.name)
+        return r
+
+    def activate(self, name: Optional[str] = None) -> Optional[Replica]:
+        """Fast scale-up: flip a pre-warmed ``SPARE`` into rotation
+        (the warmed replica starts taking traffic immediately — no
+        build, no compile). ``name=None`` activates any spare; returns
+        None when there is none to activate (the caller falls back to
+        the cold :meth:`add_replica` path)."""
+        with self._lock:
+            if name is None:
+                r = next((x for x in self.replicas
+                          if x.state == SPARE), None)
+            else:
+                r = self.get(name)
+            if r is None or r.state != SPARE:
+                return None
+            r.state = HEALTHY
+            r.state_reason = "activated (scale-up)"
+            self._publish_states()
+        self.metrics.count("replica_activated")
+        self._notify_scale("activated", r.name)
+        return r
+
+    def add_replica(self) -> Replica:
+        """Cold scale-up: build + start a new replica straight into
+        rotation. Pays the engine build (and any compile the AOT
+        manifest frontier / persistent cache cannot replay) on the
+        scale-up critical path — the warm-pool's :meth:`activate` is
+        the fast path; this is the fallback when no spare is parked."""
+        with self._lock:
+            index = self._next_index
+            self._next_index += 1
+        manifests = self.snapshot_manifests()
+        r = self._build(index)
+        r._manifest = manifests or None
+        r.start()                        # build + warm, no pool lock
+        with self._lock:
+            self.replicas.append(r)
+            self._publish_states()
+        self.metrics.count("replica_added")
+        self._notify_scale("added", r.name)
         return r
 
     def close(self) -> None:
@@ -1150,9 +1394,19 @@ class Router:
         # between beats
         self._health_every = max(pool._hb_s / 2, 0.05)
         self._next_health = 0.0
+        self._quota_gauge = get_registry().gauge(
+            "fleet_tenant_quota_units",
+            "Weighted-fair tenant quota against live capacity "
+            "(rebalanced on every scale event)", ("fleet", "tenant"))
+        # quota rebalance on every scale event: _quota() reads LIVE
+        # capacity so admission is always current, but the published
+        # gauges (what the autoscaler/bench/operator read) refresh on
+        # the membership edge, not lazily on the next submit
+        pool.on_scale(lambda event, replica: self._publish_quotas())
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"fleet-router:{pool.name}")
         self._thread.start()
+        self._publish_quotas()
 
     # -- admission ---------------------------------------------------------
     def _tenant(self, name: str) -> TenantConfig:
@@ -1161,8 +1415,22 @@ class Router:
     def _quota(self, t: TenantConfig) -> int:
         if t.quota_units is not None:
             return int(t.quota_units)
-        total_w = sum(c.weight for c in self._tenants.values()) or 1.0
-        return max(1, int(t.weight / total_w * self.pool.capacity_units()))
+        # weights normalize within the tenant's capacity group: tenants
+        # pinned to the same model share THAT model's capacity;
+        # unpinned tenants share the pool-wide total
+        group = [c for c in self._tenants.values()
+                 if (c.model or None) == (t.model or None)]
+        total_w = sum(c.weight for c in group) or 1.0
+        return max(1, int(t.weight / total_w
+                          * self.pool.capacity_units(t.model)))
+
+    def _publish_quotas(self) -> None:
+        """Recompute + publish every tenant's weighted-fair quota (the
+        scale-event rebalance edge)."""
+        for t, cfg in list(self._tenants.items()):
+            self._quota_gauge.labels(
+                fleet=self.pool.name, tenant=t).set(self._quota(cfg))
+        self.metrics.count("quota_rebalanced")
 
     def _required_class(self) -> int:
         cap = self.pool.capacity_units()
@@ -1178,24 +1446,30 @@ class Router:
     def submit(self, prompt, max_new_tokens: int = 0, *,
                tenant: str = "default", timeout_ms="default",
                eos_token: Optional[int] = None,
-               on_token: Optional[Callable[[int], None]] = None
-               ) -> FleetRequest:
+               on_token: Optional[Callable[[int], None]] = None,
+               model: Optional[str] = None) -> FleetRequest:
         """Admit one request into the fleet. Typed shedding:
         :class:`~.admission.ServerOverload` on tenant quota /
         deadline-class pressure / no capacity,
         :class:`ReplicaUnavailable` when no healthy replica can take
-        it. Streaming requests (``on_token``) are pinned to one replica
-        — never hedged or re-admitted (a replayed stream would emit
-        duplicate tokens); replica death fails them typed-transient for
-        the client's retry loop."""
+        it. ``model=`` routes to one hosted :class:`ModelSpec`'s
+        engines in a multi-model pool (default: the tenant's pinned
+        model, else the primary). Streaming requests (``on_token``)
+        are pinned to one replica — never hedged or re-admitted (a
+        replayed stream would emit duplicate tokens); replica death
+        fails them typed-transient for the client's retry loop."""
         if self._closed:
             raise ServerOverload("fleet router is closed")
         import numpy as onp
 
+        cfg = self._tenant(tenant)
+        if model is None:
+            model = cfg.model
         if self.pool.kind == "llm":
             prompt = onp.asarray(prompt, onp.int32).reshape(-1)
             plen = int(prompt.shape[0])
-            units = self.pool.cost_units(plen, int(max_new_tokens))
+            units = self.pool.cost_units(plen, int(max_new_tokens),
+                                         model)
         else:
             if on_token is not None:
                 raise ValueError(
@@ -1204,7 +1478,6 @@ class Router:
                     "would silently never fire")
             prompt = onp.asarray(prompt)
             units = 1
-        cfg = self._tenant(tenant)
         if timeout_ms == "default":
             timeout_ms = self._timeout_ms
         deadline = (time.monotonic() + timeout_ms / 1e3
@@ -1233,7 +1506,7 @@ class Router:
                     f"class {cfg.deadline_class} < required {need} — "
                     "shed, retry with backoff")
             freq = FleetRequest(prompt, max_new_tokens, tenant, deadline,
-                                units, eos_token, on_token)
+                                units, eos_token, on_token, model=model)
             self._t_inflight[tenant] = held + units
             self.metrics.tenant_inflight.labels(
                 fleet=self.pool.name, tenant=tenant).set(
@@ -1263,10 +1536,14 @@ class Router:
 
     # -- dispatch ----------------------------------------------------------
     @staticmethod
-    def _load(r: Replica) -> float:
-        return r.host.inflight() / max(1, r.host.capacity_units())
+    def _load(r: Replica, model: Optional[str] = None) -> float:
+        # least-loaded is judged per MODEL in a multi-model pool: the
+        # other model's lanes don't contend for this model's KV blocks
+        return (r.host.inflight(model)
+                / max(1, r.host.capacity_units(model)))
 
-    def _pick(self, exclude: Tuple[str, ...]
+    def _pick(self, exclude: Tuple[str, ...],
+              model: Optional[str] = None
               ) -> Optional[Tuple[Replica, bool]]:
         """Least-loaded healthy replica with a willing breaker; returns
         ``(replica, probed)`` — ``probed`` marks a claimed half-open
@@ -1282,14 +1559,18 @@ class Router:
         request per cooldown window is at risk."""
         healthy = [r for r in self.pool.healthy()
                    if r.name not in exclude]
-        for r in sorted(healthy, key=self._load):
+
+        def load(r: Replica) -> float:
+            return self._load(r, model)
+
+        for r in sorted(healthy, key=load):
             if r.breaker.state != CircuitBreaker.CLOSED \
                     and r.breaker.allow():
                 return r, True            # this dispatch owns the probe
         closed = [r for r in healthy
                   if r.breaker.state == CircuitBreaker.CLOSED]
         if closed:
-            return min(closed, key=self._load), False
+            return min(closed, key=load), False
         return None
 
     def _remaining_ms(self, freq: FleetRequest) -> Optional[float]:
@@ -1313,7 +1594,7 @@ class Router:
         exclude = tuple(exclude)
         last: Optional[BaseException] = None
         for _ in range(len(self.pool.replicas)):
-            picked = self._pick(exclude)
+            picked = self._pick(exclude, freq.model)
             if picked is None:
                 break
             r, probed = picked
@@ -1618,12 +1899,14 @@ class Router:
             tenants = {t: dict(inflight_units=self._t_inflight.get(t, 0),
                                quota_units=self._quota(cfg),
                                weight=cfg.weight,
-                               deadline_class=cfg.deadline_class)
+                               deadline_class=cfg.deadline_class,
+                               model=cfg.model)
                        for t, cfg in self._tenants.items()}
         return {
             "fleet": self.pool.name,
             "kind": self.pool.kind,
             "replicas": reps,
+            "models": [s.name for s in self.pool.models] or ["default"],
             "capacity_units": self.pool.capacity_units(),
             "free_units": self.pool.free_units(),
             "tenants": tenants,
@@ -1632,7 +1915,8 @@ class Router:
                 "hedged", "hedge_wins", "hedge_losses", "shed_quota",
                 "shed_class", "shed_deadline", "replica_dead",
                 "replica_wedged", "replica_restarts",
-                "replica_drained")},
+                "replica_drained", "replica_activated",
+                "replica_added", "spare_added", "quota_rebalanced")},
         }
 
     def close(self, drain: bool = True, timeout_s: float = 60.0) -> None:

@@ -1450,6 +1450,14 @@ class LLMEngine:
                 f"{timeout_s:g}s with the scheduler wedged — queued "
                 "request failed, resubmit elsewhere"))
             self.metrics.count("failed", n)
+        # a closed engine carries no load: zero the live-load gauges so
+        # a cluster scraper summing this process's exposition does not
+        # count ghost throughput/capacity from engines that no longer
+        # exist (counters and histograms stay — they are cumulative)
+        for g in (self.metrics.tok_s, self.metrics.lanes_active,
+                  self.metrics.lanes_total, self.metrics.pool_free,
+                  self.metrics.pool_total):
+            g.set(0)
 
     def __enter__(self) -> "LLMEngine":
         return self

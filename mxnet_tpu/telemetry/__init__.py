@@ -47,7 +47,8 @@ from .registry import (  # noqa: F401
     sanitize_name,
 )
 from .cluster import ClusterScraper  # noqa: F401
-from .slo import SloRule, SloSentinel, SloViolation  # noqa: F401
+from .slo import (SloCleared, SloRule, SloSentinel,  # noqa: F401
+                  SloViolation)
 from .tracing import (  # noqa: F401
     BUCKETS,
     StepTimeline,
@@ -68,6 +69,7 @@ from .tracing import (  # noqa: F401
 __all__ = [
     "BUCKETS", "ClusterScraper", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "SloRule", "SloSentinel", "SloViolation",
+    "SloCleared",
     "StepTimeline", "TraceContext", "attribute", "buffer",
     "chrome_trace", "cluster", "current_step", "current_trace",
     "dump_chrome", "exporter", "flight", "get_registry", "mfu",

@@ -292,8 +292,13 @@ class ClusterScraper:
         cluster (an in-router sentinel/autoscaler needs no shared
         filesystem).
     stale_s : float, optional
-        Export age beyond which a process is counted stale in the
-        snapshot (default ``max(3 x scrape period, 15 s)``).
+        Export age beyond which a process is counted stale — excluded
+        from the derived sums, surfaced in
+        ``cluster_processes_stale`` (default ``2 x scrape period``).
+        The old ``max(3 x period, 15 s)`` default let a dead replica's
+        frozen ``tok_s`` feed ``cluster_tok_s`` for up to 15 s — long
+        enough to mask the very starvation that should trip the
+        autoscaler's scale-up.
     """
 
     def __init__(self, root: Optional[str] = None,
@@ -301,9 +306,10 @@ class ClusterScraper:
         self.root = os.path.abspath(root) if root else None
         period = scrape_period_s()
         self.stale_s = float(stale_s if stale_s is not None
-                             else max(3.0 * period, 15.0))
+                             else 2.0 * period)
         self._lock = threading.Lock()
         self._warned = False
+        self._stale_warned = False
         self.last: Optional[Dict] = None        # last good snapshot
         self._texts: Dict[str, tuple] = {}
         self._stop = threading.Event()
@@ -391,6 +397,21 @@ class ClusterScraper:
                 except OSError:
                     pass
         derived = derive(processes)
+        if derived.get("processes_stale", 0) and not self._stale_warned:
+            # warn ONCE when staleness first excludes a process: the
+            # cluster_processes_stale gauge carries the ongoing signal,
+            # the warning names the suspects at the onset
+            self._stale_warned = True
+            stale_keys = sorted(k for k, p in processes.items()
+                                if p.get("stale"))
+            warnings.warn(
+                f"cluster scraper: {len(stale_keys)} process(es) "
+                f"stale past {self.stale_s:g}s excluded from derived "
+                f"gauges: {stale_keys} (watch "
+                "cluster_processes_stale)", RuntimeWarning,
+                stacklevel=2)
+        elif not derived.get("processes_stale", 0):
+            self._stale_warned = False   # healed: re-arm the warning
         snap = {"schema": SNAPSHOT_SCHEMA, "ts_unix": time.time(),
                 "root": self.root, "processes": processes,
                 "cluster": derived}

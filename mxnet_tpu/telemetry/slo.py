@@ -35,8 +35,8 @@ from .cluster import ClusterScraper
 from .registry import get_registry
 from . import flight as _flight
 
-__all__ = ["SloRule", "SloViolation", "SloSentinel", "parse_slo_spec",
-           "start_from_env", "KINDS"]
+__all__ = ["SloRule", "SloViolation", "SloCleared", "SloSentinel",
+           "parse_slo_spec", "start_from_env", "KINDS"]
 
 log = logging.getLogger(__name__)
 
@@ -122,6 +122,29 @@ class SloViolation:
                 "ts_unix": self.ts_unix, "details": self.details}
 
 
+@dataclass
+class SloCleared:
+    """The breach episode's CLOSE edge: fired once when a rule that was
+    breached evaluates back inside its threshold (the sentinel re-arms
+    at the same instant). The autoscaler's scale-down path keys off
+    this edge — gauge polling alone can't distinguish "cleared" from
+    "no signal". Delivered only to subscribers that opted in via
+    ``subscribe(fn, clears=True)``; the ``slo_breached`` gauge
+    semantics (1 while breached, 0 otherwise) are unchanged."""
+
+    rule: str
+    kind: str
+    observed: float
+    threshold: float
+    ts_unix: float = field(default_factory=time.time)
+    details: str = ""
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "kind": self.kind,
+                "observed": self.observed, "threshold": self.threshold,
+                "ts_unix": self.ts_unix, "details": self.details}
+
+
 def parse_slo_spec(spec: str) -> List[SloRule]:
     """Parse the ``MXNET_TPU_SLO`` grammar (module docstring) into
     rules; malformed fragments warn and are skipped."""
@@ -192,8 +215,10 @@ class SloSentinel:
         self.rules = list(rules)
         self.scraper = scraper or ClusterScraper(root)
         self._subs: List[Callable] = list(on_violation or [])
+        self._clear_subs: List[Callable] = []
         self._bundle = bool(bundle)
         self.violations: List[SloViolation] = []
+        self.cleared: List[SloCleared] = []
         self._max_events = int(max_events)
         self._breach_counts: Dict[str, int] = {}
         self._breached: Dict[str, bool] = {}
@@ -205,15 +230,21 @@ class SloSentinel:
             "slo_evaluations_total", "SLO sentinel evaluation passes")
         self._c_viol = reg.counter(
             "slo_violations_total", "SLO violations fired", ("rule",))
+        self._c_clear = reg.counter(
+            "slo_clears_total", "SLO breach episodes cleared (re-arm "
+            "edges)", ("rule",))
         self._g_breached = reg.gauge(
             "slo_breached", "1 while the rule is currently breached",
             ("rule",))
         self._g_observed = reg.gauge(
             "slo_observed", "Last observed value per rule", ("rule",))
 
-    def subscribe(self, fn: Callable[[SloViolation], None]) -> None:
-        """Add a violation subscriber (the autoscaler's entry point)."""
-        self._subs.append(fn)
+    def subscribe(self, fn: Callable, clears: bool = False) -> None:
+        """Add a violation subscriber (the autoscaler's entry point).
+        ``clears=True`` subscribes ``fn`` to :class:`SloCleared` events
+        INSTEAD — the breach-episode close edge (opt-in, so existing
+        violation-only subscribers never see an unexpected type)."""
+        (self._clear_subs if clears else self._subs).append(fn)
 
     # -- observation extraction -------------------------------------------
     @staticmethod
@@ -328,6 +359,29 @@ class SloSentinel:
                     # the flight hook sweeps the shared root into an
                     # incident bundle (no-op while nothing is armed)
                     _flight.try_dump(f"slo_violation:{rule.name}")
+            elif was and not now_breached:
+                # the breach episode's CLOSE edge: the sentinel re-arms
+                # (next breach fires a fresh violation) and tells the
+                # opted-in subscribers — the autoscaler's scale-down
+                # path needs this edge, not a gauge poll
+                c = SloCleared(
+                    rule=rule.name, kind=rule.kind,
+                    observed=round(float(observed), 4),
+                    threshold=round(float(threshold), 4),
+                    details=(f"{rule.kind} cleared: observed "
+                             f"{observed:.4g} back inside "
+                             f"{'ceiling' if ceiling else 'floor'} "
+                             f"{threshold:.4g}"))
+                self._c_clear.labels(rule=rule.name).inc()
+                log.info("SLO cleared %s: %s", rule.name, c.details)
+                with self._lock:
+                    self.cleared.append(c)
+                    del self.cleared[:-self._max_events]
+                for fn in list(self._clear_subs):
+                    try:
+                        fn(c)
+                    except Exception:  # noqa: BLE001 — a broken
+                        pass           # subscriber must not stop others
         return fired
 
     # -- background loop ---------------------------------------------------

@@ -90,6 +90,10 @@ MODULES = {
                                "health-checked replica router, hedged "
                                "retries, circuit breakers, tenant-fair "
                                "shedding, drain/restart lifecycle",
+    "mxnet_tpu.serving.autoscale": "fleet autoscaler: SLO-edge + "
+                                   "gauge-trip scale-up, hysteresis "
+                                   "scale-down, warm-pool spare "
+                                   "activation",
     "mxnet_tpu.serving.llm": "continuous-batching LLM serving: paged "
                              "KV block pool, prefill/decode split, "
                              "in-flight admission, speculative decode, "
