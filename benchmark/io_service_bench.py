@@ -23,11 +23,27 @@ capture re-runs this next to a real TPU for the hardware row):
      four (the ratio is slabs, i.e. storage + bank-write amplification),
      with the warm-epoch speedup over live decode alongside.
 
-Prints one JSON object; ``--output`` also writes it to a file (full
-runs committed as ``benchmark/results_io_service_cpu.json``;
-``--quick`` is the tier-1 gate via ``tests/test_io_service_bench.py``).
+The consumer "compute" phase is a REAL jitted train step (tiny MLP,
+SGD-on-MSE through ``jax.value_and_grad`` under ``lax.fori_loop``) —
+not a sleep — so starved% is attributed against genuine XLA execution
+with the same scheduler/GIL interactions a training loop has. The same
+step feeds every phase (before/after, shared-fs/net).
 
-CLI: python benchmark/io_service_bench.py [--quick] [--output out.json]
+``--net`` (ISSUE 17) measures the **network block-transfer plane**
+instead: a loopback world-4 run where consumers hold ONLY ``host:port``
+endpoints (``root=None`` — no shared mount), reporting net-path
+starved%, the net-vs-shared-fs epoch-wall ratio, and the server-kill
+recovery wall (one worker SIGKILLed mid-epoch while provably holding
+unserved batches; survivors absorb the fetches over TCP,
+``io_net_failovers_total >= 1``, zero lost / zero duplicated asserted).
+
+Prints one JSON object; ``--output`` also writes it to a file (full
+runs committed as ``benchmark/results_io_service_cpu.json`` and
+``benchmark/results_io_net_cpu.json``; ``--quick`` is the tier-1 gate
+via ``tests/test_io_service_bench.py``).
+
+CLI: python benchmark/io_service_bench.py [--quick] [--net]
+                                          [--output out.json]
 """
 from __future__ import annotations
 
@@ -52,26 +68,85 @@ def log(*a):
 
 
 # ---------------------------------------------------------------------------
+# the real train step every consumer phase feeds
+# ---------------------------------------------------------------------------
+
+class _TinyTrainStep:
+    """A jitted tiny-MLP SGD step (``inner`` iterations of
+    value_and_grad under ``lax.fori_loop`` per call): real XLA compute
+    for the stepped loop's non-input phase, sized by (hidden, inner)
+    rather than a sleep. Threads share the jitted callable (compiled
+    once) but each rank carries its own params."""
+
+    def __init__(self, dim: int, hidden: int = 1024, inner: int = 64,
+                 lr: float = 1e-3):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self.dim, self.hidden, self.inner = int(dim), int(hidden), int(inner)
+        rng = onp.random.RandomState(0)
+        self._init = {
+            "w1": jnp.asarray(rng.randn(dim, hidden) * 0.05, jnp.float32),
+            "b1": jnp.zeros((hidden,), jnp.float32),
+            "w2": jnp.asarray(rng.randn(hidden, 1) * 0.05, jnp.float32),
+            "b2": jnp.zeros((1,), jnp.float32),
+        }
+
+        def loss_fn(p, data, label):
+            h = jnp.tanh(data @ p["w1"] + p["b1"])
+            pred = h @ p["w2"] + p["b2"]
+            return jnp.mean((pred - label[:, :1]) ** 2)
+
+        def step(p, data, label):
+            def body(_, carry):
+                q, _ = carry
+                loss, g = jax.value_and_grad(loss_fn)(q, data, label)
+                return ({k: v - lr * g[k] for k, v in q.items()}, loss)
+
+            return jax.lax.fori_loop(
+                0, self.inner, body, (p, jnp.asarray(0.0, jnp.float32)))
+
+        self._step = jax.jit(step)
+
+    def init_params(self) -> dict:
+        return dict(self._init)
+
+    def warmup(self, batch_size: int) -> None:
+        """Compile outside the timed loop (one trace serves all ranks)."""
+        d = onp.zeros((batch_size, self.dim), onp.float32)
+        lab = onp.zeros((batch_size, 2), onp.float32)
+        _, loss = self._step(self._init, d, lab)
+        self._jax.block_until_ready(loss)
+
+    def __call__(self, params, data, label):
+        params, loss = self._step(params, data, label)
+        self._jax.block_until_ready(loss)
+        return params
+
+
+# ---------------------------------------------------------------------------
 # 1. input_starved% at world 4, before/after the service
 # ---------------------------------------------------------------------------
 
-def _consumer_loop(stream, compute_s: float, totals: dict, lock):
+def _consumer_loop(stream, trainer: _TinyTrainStep, totals: dict, lock):
     """One rank's stepped epoch: fetch (attributed input_starved) then
-    simulated device compute; per-step timelines aggregate into
+    the real jitted train step; per-step timelines aggregate into
     ``totals``."""
     from mxnet_tpu import telemetry
 
+    params = trainer.init_params()
     starved = wall = 0.0
     steps = 0
     while True:
         with telemetry.step("io_service_bench") as st:
             try:
                 with st.phase("input_starved"):
-                    next(stream)
+                    data, label = next(stream)
             except StopIteration:
                 st.cancel()
                 break
-            time.sleep(compute_s)
+            params = trainer(params, data, label)
         starved += st.attribution()["input_starved"]
         wall += st.wall_s
         steps += 1
@@ -81,11 +156,11 @@ def _consumer_loop(stream, compute_s: float, totals: dict, lock):
         totals["steps"] += steps
 
 
-def _run_world(streams, compute_s: float) -> dict:
+def _run_world(streams, trainer: _TinyTrainStep) -> dict:
     totals = {"starved_s": 0.0, "wall_s": 0.0, "steps": 0}
     lock = threading.Lock()
     threads = [threading.Thread(target=_consumer_loop,
-                                args=(s, compute_s, totals, lock))
+                                args=(s, trainer, totals, lock))
                for s in streams]
     t0 = time.perf_counter()
     for t in threads:
@@ -95,16 +170,21 @@ def _run_world(streams, compute_s: float) -> dict:
     totals["epoch_wall_s"] = time.perf_counter() - t0
     totals["starved_pct"] = round(
         100.0 * totals["starved_s"] / max(totals["wall_s"], 1e-9), 2)
+    # what the real train step actually cost under world-N contention
+    totals["compute_ms_per_step"] = round(
+        1e3 * (totals["wall_s"] - totals["starved_s"])
+        / max(totals["steps"], 1), 2)
     return totals
 
 
 def bench_input_plane(n_batches: int, decode_cost_s: float,
-                      compute_s: float, num_workers: int) -> dict:
+                      trainer: _TinyTrainStep, num_workers: int) -> dict:
     from mxnet_tpu.io.service import (DatasetService, ServiceStream,
                                       SyntheticSource)
 
     src = SyntheticSource(n_batches, batch_size=8, dim=64,
                           decode_cost_s=decode_cost_s)
+    trainer.warmup(src.batch_size)
 
     def members(root, **kw):
         return [ServiceStream(root, cursor=f"bench{j}",
@@ -115,7 +195,7 @@ def bench_input_plane(n_batches: int, decode_cost_s: float,
         log("input plane: BEFORE (in-process local decode per rank)")
         before = _run_world(
             members(root=os.path.join(tmp, "local"), local=True,
-                    source=src), compute_s)
+                    source=src), trainer)
         log(f"  starved {before['starved_pct']}% over {before['steps']} "
             f"steps, epoch {before['epoch_wall_s']:.2f}s")
 
@@ -138,7 +218,7 @@ def bench_input_plane(n_batches: int, decode_cost_s: float,
             warmup_s = time.perf_counter() - t0
             after = _run_world(
                 members(root=svc.root, source=src, local_fallback=False,
-                        fetch_deadline_s=300.0, poll_s=0.001), compute_s)
+                        fetch_deadline_s=300.0, poll_s=0.001), trainer)
         log(f"  starved {after['starved_pct']}% over {after['steps']} "
             f"steps, epoch {after['epoch_wall_s']:.2f}s "
             f"(warmup {warmup_s:.2f}s)")
@@ -148,7 +228,9 @@ def bench_input_plane(n_batches: int, decode_cost_s: float,
         "world": WORLD,
         "n_batches": n_batches,
         "decode_cost_s": decode_cost_s,
-        "compute_s": compute_s,
+        "train_step": {"hidden": trainer.hidden, "inner": trainer.inner},
+        "compute_ms_per_step_before": before["compute_ms_per_step"],
+        "compute_ms_per_step_after": after["compute_ms_per_step"],
         "service_workers": num_workers,
         "service_warmup_s": round(warmup_s, 3),
         "starved_before_pct": before["starved_pct"],
@@ -164,9 +246,41 @@ def bench_input_plane(n_batches: int, decode_cost_s: float,
 # 2. worker-kill re-dispatch recovery wall
 # ---------------------------------------------------------------------------
 
-def _epoch(svc, src, kill_worker: bool) -> dict:
+def _kill_when_holding(svc, wid: int = 0, min_unpublished: int = 2,
+                       timeout_s: float = 60.0):
+    """SIGKILL worker ``wid`` once it PROVABLY holds an unserved range
+    claim with >= ``min_unpublished`` unpublished batches (so the kill
+    demonstrably strands work). Returns the ``perf_counter()`` kill
+    instant, or None on timeout."""
     from mxnet_tpu.io import service as _svc
 
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        rdir = _svc._ranges_dir(svc.root, 0)
+        try:
+            names = os.listdir(rdir)
+        except OSError:
+            names = []
+        for name in names:
+            if ".claim" not in name or not name.endswith(".json"):
+                continue
+            k = int(name.split(".")[0][1:])
+            if os.path.exists(_svc._done_path(svc.root, 0, k)):
+                continue
+            claim = _svc._read_json(os.path.join(rdir, name))
+            if not claim or claim.get("worker") != wid:
+                continue
+            lo = k * svc.range_size
+            hi = min(lo + svc.range_size, svc.n_batches)
+            if sum(not os.path.exists(_svc._batch_path(svc.root, 0, i))
+                   for i in range(lo, hi)) >= min_unpublished:
+                svc.kill_worker(wid)
+                return time.perf_counter()
+        time.sleep(0.005)
+    return None
+
+
+def _epoch(svc, src, kill_worker: bool) -> dict:
     svc.start()
     svc.start_epoch(0)
     stream = svc.stream(local_fallback=False, fetch_deadline_s=300.0)
@@ -174,27 +288,7 @@ def _epoch(svc, src, kill_worker: bool) -> dict:
     out = [next(stream) for _ in range(2)]
     killed_at = None
     if kill_worker:
-        deadline = time.monotonic() + 60.0
-        while killed_at is None and time.monotonic() < deadline:
-            rdir = _svc._ranges_dir(svc.root, 0)
-            for name in os.listdir(rdir):
-                if ".claim" not in name or not name.endswith(".json"):
-                    continue
-                k = int(name.split(".")[0][1:])
-                if os.path.exists(_svc._done_path(svc.root, 0, k)):
-                    continue
-                claim = _svc._read_json(os.path.join(rdir, name))
-                if not claim or claim.get("worker") != 0:
-                    continue
-                lo = k * svc.range_size
-                hi = min(lo + svc.range_size, svc.n_batches)
-                if sum(not os.path.exists(_svc._batch_path(svc.root, 0, i))
-                       for i in range(lo, hi)) >= 2:
-                    svc.kill_worker(0)
-                    killed_at = time.perf_counter()
-                    break
-            else:
-                time.sleep(0.005)
+        killed_at = _kill_when_holding(svc, wid=0)
     out += list(stream)
     wall = time.perf_counter() - t0
     ids = []
@@ -343,11 +437,178 @@ def bench_shared_cache(n_batches: int, decode_cost_s: float) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 4. --net: the network block-transfer plane (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _net_members(endpoints, **kw):
+    """World-4 mount-less consumers: ONLY host:port strings, root=None."""
+    from mxnet_tpu.io.service import ServiceStream
+
+    return [ServiceStream(None, endpoints=list(endpoints), member_index=j,
+                          world=WORLD, local_fallback=False, **kw)
+            for j in range(WORLD)]
+
+
+def _counter_total(name: str) -> float:
+    from mxnet_tpu.telemetry.registry import get_registry
+
+    fam = get_registry().snapshot()["metrics"].get(name)
+    return sum(s["value"] for s in fam["series"]) if fam else 0.0
+
+
+def bench_net_plane(n_batches: int, decode_cost_s: float,
+                    trainer: _TinyTrainStep, num_workers: int) -> dict:
+    """Starved% + epoch wall at world 4 consuming the SAME decode fleet
+    two ways: over the shared filesystem (the PR-14 path) and over TCP
+    with no mount at all (root=None, endpoints only) — the ratio is the
+    mount-less tax."""
+    from mxnet_tpu.io.service import (DatasetService, ServiceStream,
+                                      SyntheticSource)
+
+    src = SyntheticSource(n_batches, batch_size=8, dim=64,
+                          decode_cost_s=decode_cost_s)
+    trainer.warmup(src.batch_size)
+
+    def run(net: bool) -> dict:
+        with tempfile.TemporaryDirectory() as tmp:
+            svc = DatasetService(os.path.join(tmp, "root"), src,
+                                 num_workers=num_workers, range_size=4,
+                                 heartbeat_s=0.2, net=True)
+            with svc:
+                svc.start()
+                svc.start_epoch(0)
+                eps = svc.endpoints()
+                # steady-state: wait for a small spool lead (fleet
+                # spawn/import wall is warmup, not transfer-plane cost)
+                spool = os.path.join(svc.root, "epochs", "e0", "spool")
+                deadline = time.monotonic() + 120.0
+                while (len(os.listdir(spool)) < min(2 * WORLD, n_batches)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+                if net:
+                    streams = _net_members(eps, fetch_deadline_s=300.0,
+                                           poll_s=0.001)
+                else:
+                    streams = [ServiceStream(svc.root, cursor=f"netfs{j}",
+                                             member_index=j, world=WORLD,
+                                             source=src,
+                                             local_fallback=False,
+                                             fetch_deadline_s=300.0,
+                                             poll_s=0.001)
+                               for j in range(WORLD)]
+                return _run_world(streams, trainer)
+
+    log(f"net plane: shared-fs consumption ({num_workers} workers)")
+    fs = run(net=False)
+    log(f"  starved {fs['starved_pct']}%, epoch {fs['epoch_wall_s']:.2f}s")
+    log("net plane: TCP consumption (root=None, endpoints only)")
+    net = run(net=True)
+    log(f"  starved {net['starved_pct']}%, epoch {net['epoch_wall_s']:.2f}s")
+    assert fs["steps"] == net["steps"] == n_batches
+    return {
+        "world": WORLD,
+        "n_batches": n_batches,
+        "decode_cost_s": decode_cost_s,
+        "service_workers": num_workers,
+        "train_step": {"hidden": trainer.hidden, "inner": trainer.inner},
+        "starved_fs_pct": fs["starved_pct"],
+        "starved_net_pct": net["starved_pct"],
+        "epoch_wall_fs_s": round(fs["epoch_wall_s"], 3),
+        "epoch_wall_net_s": round(net["epoch_wall_s"], 3),
+        "net_vs_fs_wall_ratio": round(
+            net["epoch_wall_s"] / max(fs["epoch_wall_s"], 1e-9), 3),
+        "net_bytes_rx": _counter_total("io_net_bytes_total"),
+    }
+
+
+def bench_net_kill(n_batches: int, decode_cost_s: float) -> dict:
+    """The mount-less failover drill as a measurement: worker 0's
+    server SIGKILLed while provably holding >= 2 unserved batches; the
+    extra epoch wall over an unkilled baseline is the TCP-side
+    detection + failover + re-decode cost. Bitwise exactness and
+    ``io_net_failovers_total >= 1`` are asserted, not assumed."""
+    from mxnet_tpu.io.service import DatasetService, SyntheticSource
+
+    src = SyntheticSource(n_batches, batch_size=4, dim=16, seed=11,
+                          decode_cost_s=decode_cost_s)
+
+    def run(kill: bool) -> dict:
+        with tempfile.TemporaryDirectory() as tmp:
+            svc = DatasetService(os.path.join(tmp, "root"), src,
+                                 num_workers=2, range_size=5,
+                                 heartbeat_s=0.1, stale_after_s=0.6,
+                                 net=True)
+            with svc:
+                svc.start()
+                svc.start_epoch(0)
+                streams = _net_members(svc.endpoints(),
+                                       fetch_deadline_s=300.0,
+                                       stale_after_s=0.6)
+                got, errs = {}, []
+                lock = threading.Lock()
+
+                def consume(s):
+                    try:
+                        for data, label in s:
+                            i = int(label[0, 1])
+                            with lock:
+                                assert i not in got, f"duplicated batch {i}"
+                                got[i] = (data, label)
+                    except Exception as e:  # noqa: BLE001 — re-raised below
+                        errs.append(e)
+
+                t0 = time.perf_counter()
+                threads = [threading.Thread(target=consume, args=(s,))
+                           for s in streams]
+                for t in threads:
+                    t.start()
+                killed_at = _kill_when_holding(svc, wid=0) if kill else None
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                assert not errs, errs
+            assert sorted(got) == list(range(n_batches)), "lost batches"
+            for i in range(n_batches):
+                ref_d, ref_l = src.read(i)
+                assert (got[i][0] == ref_d).all(), f"batch {i} not bitwise"
+                assert (got[i][1] == ref_l).all(), f"label {i} not bitwise"
+            return {"wall_s": wall,
+                    "killed_at_s": killed_at and killed_at - t0}
+
+    f0 = _counter_total("io_net_failovers_total")
+    log("net kill: baseline mount-less epoch (no kill)")
+    base = run(kill=False)
+    log(f"  epoch {base['wall_s']:.2f}s")
+    log("net kill: SIGKILL server 0 while holding an unserved claim")
+    killed = run(kill=True)
+    log(f"  epoch {killed['wall_s']:.2f}s "
+        f"(killed at +{killed['killed_at_s']:.2f}s)")
+    failovers = _counter_total("io_net_failovers_total") - f0
+    assert failovers >= 1, "kill drill produced no endpoint failover"
+    return {
+        "n_batches": n_batches,
+        "decode_cost_s": decode_cost_s,
+        "world": WORLD,
+        "baseline_epoch_wall_s": round(base["wall_s"], 3),
+        "killed_epoch_wall_s": round(killed["wall_s"], 3),
+        "recovery_wall_s": round(killed["wall_s"] - base["wall_s"], 3),
+        "failovers": failovers,
+        "checksum_failures": _counter_total(
+            "io_net_checksum_failures_total"),
+        "lost_batches": 0,
+        "duplicated_batches": 0,
+    }
+
+
+# ---------------------------------------------------------------------------
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="tier-1 scale: small epoch, short decode costs")
+    ap.add_argument("--net", action="store_true",
+                    help="measure the network block-transfer plane "
+                         "(mount-less TCP consumers) instead")
     ap.add_argument("--device", default="cpu",
                     help="recorded in the artifact (the daemon's TPU "
                          "capture passes tpu)")
@@ -357,15 +618,50 @@ def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     # decode_cost is a sleep (how a 2-vCPU CI container stands in for a
     # decode-bound host), so the service fleet can out-parallelize the
-    # world's in-step decode without needing real cores
+    # world's in-step decode without needing real cores; the consumer
+    # compute is a REAL jitted train step (sized by hidden/inner)
+    trainer = _TinyTrainStep(dim=64)
+
+    if args.net:
+        if args.quick:
+            plane = bench_net_plane(n_batches=32, decode_cost_s=0.01,
+                                    trainer=trainer, num_workers=4)
+            kill = bench_net_kill(n_batches=20, decode_cost_s=0.03)
+        else:
+            plane = bench_net_plane(n_batches=160, decode_cost_s=0.02,
+                                    trainer=trainer, num_workers=8)
+            kill = bench_net_kill(n_batches=60, decode_cost_s=0.04)
+        rec = {
+            "bench": "io_net",
+            "metric": "io_net_vs_fs_wall_ratio",
+            "value": plane["net_vs_fs_wall_ratio"],
+            "quick": bool(args.quick),
+            "device": args.device,
+            "net_plane": plane,
+            "net_kill": kill,
+            "acceptance": {
+                "zero_lost_zero_duplicated": True,  # asserted in-run
+                "failover_observed": kill["failovers"] >= 1,
+                "pass": (kill["failovers"] >= 1
+                         and plane["net_vs_fs_wall_ratio"] > 0),
+            },
+            "wall": time.time(),
+        }
+        out = json.dumps(rec, indent=1)
+        print(out)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(out + "\n")
+        return 0
+
     if args.quick:
         plane = bench_input_plane(n_batches=48, decode_cost_s=0.01,
-                                  compute_s=0.008, num_workers=6)
+                                  trainer=trainer, num_workers=6)
         red = bench_redispatch(n_batches=20, decode_cost_s=0.03)
         cache = bench_shared_cache(n_batches=12, decode_cost_s=0.01)
     else:
         plane = bench_input_plane(n_batches=240, decode_cost_s=0.02,
-                                  compute_s=0.012, num_workers=8)
+                                  trainer=trainer, num_workers=8)
         red = bench_redispatch(n_batches=60, decode_cost_s=0.04)
         cache = bench_shared_cache(n_batches=60, decode_cost_s=0.02)
 

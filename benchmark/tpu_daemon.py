@@ -903,6 +903,30 @@ def capture_io_service() -> None:
             f"{rec.get('shared_cache', {}).get('bank_once_ratio')}")
 
 
+IO_NET = os.path.join(HERE, "results_io_net_tpu.json")
+
+
+def capture_io_net() -> None:
+    """Network block-transfer plane row (ISSUE 17,
+    benchmark/io_service_bench.py --net): mount-less world-4 TCP
+    consumption vs shared-fs, plus the server-kill failover recovery
+    wall — on the TPU host the transfer threads contend with the real
+    XLA runtime and the NIC replaces loopback (the CPU proxy is
+    results_io_net_cpu.json)."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "io_service_bench.py"),
+         "--net", "--device", "tpu"],
+        timeout=1200)
+    rec = parse_json_output(out)
+    if bank_if_tpu(IO_NET, rec, rc, "io net bench") and rec:
+        p = rec.get("net_plane", {})
+        log(f"io-net: net/fs wall ratio {rec.get('value')} "
+            f"(starved fs {p.get('starved_fs_pct')}% vs net "
+            f"{p.get('starved_net_pct')}%), failover recovery "
+            f"{rec.get('net_kill', {}).get('recovery_wall_s')}s, "
+            f"failovers {rec.get('net_kill', {}).get('failovers')}")
+
+
 def capture_infer_table() -> None:
     """Per-model inference table over the reference's FULL published
     perf.md rows (resnet50/resnet152/inception_v3/vgg16/alexnet, bf16 +
@@ -1379,6 +1403,7 @@ CAPTURES = (
     ("autoscale", banked_stale(AUTOSCALE), capture_autoscale),
     ("gspmd", banked_stale(GSPMD), capture_gspmd),
     ("io-service", banked_stale(IO_SERVICE), capture_io_service),
+    ("io-net", banked_stale(IO_NET), capture_io_net),
     ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION, 4 * 3600), capture_attention),

@@ -75,12 +75,18 @@ class DataLoader:
         prefetch: Optional[int] = None,
         thread_pool: bool = False,
         timeout: int = 120,
+        use_service: Optional[bool] = None,
     ):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._timeout = timeout
         self._num_workers = max(0, num_workers)
         self._thread_pool = thread_pool
+        # ambient dataset service: with MXNET_TPU_IO_SERVICE (shared-fs)
+        # or MXNET_TPU_IO_SERVICE_NET (mount-less TCP) set, iteration
+        # consumes the decode fleet's ServiceStream instead of fetching
+        # from the dataset. use_service=False opts out; True requires it.
+        self._use_service = use_service
 
         if batch_sampler is None:
             if batch_size is None:
@@ -120,11 +126,33 @@ class DataLoader:
         return len(self._batch_sampler)
 
     def __iter__(self):
+        stream = self._ambient_stream()
+        if stream is not None:
+            gen = self._service_gen(stream)
+            if self._prefetch > 0:
+                return _PrefetchIter(gen, self._prefetch)
+            return gen
         if self._pool is None:
             if self._prefetch > 0:
                 return _PrefetchIter(self._gen(), self._prefetch)
             return self._gen()
         return _PoolIter(self)
+
+    def _ambient_stream(self):
+        """A fresh ambient ServiceStream per epoch, or None when the
+        service is opted out / not configured / unreachable."""
+        if self._use_service is False:
+            return None
+        from ...io.service import ambient_service_stream
+
+        return ambient_service_stream(require=self._use_service is True)
+
+    def _service_gen(self, stream):
+        try:
+            for data, label in stream:
+                yield _upload((data, label))
+        finally:
+            stream.close()
 
     def _gen(self):
         for batch_idx in self._batch_sampler:

@@ -180,20 +180,50 @@ class ImageRecordIter(DataIter):
     decoded and resized to ``data_shape`` there, so records need not be
     pre-shaped. On the native path ``prefetch_capacity`` is ignored —
     the C++ pipeline uses its own fixed one-batch read-ahead (decode,
-    not record IO, is the bottleneck it overlaps)."""
+    not record IO, is the bottleneck it overlaps).
+
+    With ``MXNET_TPU_IO_SERVICE`` (shared-fs) or
+    ``MXNET_TPU_IO_SERVICE_NET`` (mount-less TCP) set, batches come
+    **ambiently** from the dataset-service fleet through a
+    :class:`~mxnet_tpu.io.service.ServiceStream` instead of any local
+    decode path — ``use_service=False`` opts out, ``use_service=True``
+    requires the service (raises when unreachable)."""
 
     def __init__(self, path_imgrec, batch_size, data_shape,
                  label_width=1, shuffle_chunk=False, round_batch=True,
                  prefetch_capacity=64, dtype="float32",
                  rand_crop=False, rand_mirror=False, min_area=0.08,
                  seed=0, preprocess_threads=2, use_native=None,
-                 num_workers=0, path_imgidx=None, cache_dir=None):
+                 num_workers=0, path_imgidx=None, cache_dir=None,
+                 use_service=None):
         super().__init__(batch_size)
         self.path = path_imgrec
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self._round = round_batch
         self._dtype = dtype
+        self._service = None
+        self._reader = None
+        self._native = None
+        from .service import (ambient_service_stream, service_net_from_env,
+                              service_root_from_env)
+        want_service = (bool(use_service) if use_service is not None
+                        else (service_root_from_env() is not None
+                              or service_net_from_env()[0]))
+        if want_service:
+            src = None
+            from .native_pipeline import native_available
+            if native_available():
+                try:
+                    src = RecordIOSource(path_imgrec, self.data_shape,
+                                         batch_size,
+                                         label_width=label_width)
+                except Exception:  # noqa: BLE001 — fallback source only
+                    src = None
+            self._service = ambient_service_stream(
+                source=src, require=use_service is True)
+            if self._service is not None:
+                return  # the fleet decodes; native/cache knobs don't apply
         self._cap = prefetch_capacity
         self._aug = dict(rand_crop=bool(rand_crop),
                          rand_mirror=bool(rand_mirror),
@@ -276,6 +306,12 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape, "float32")]
 
     def reset(self):
+        if self._service is not None:
+            # rewind THIS member's stride within the epoch: spool
+            # batches are persistent + idempotent, so a replay re-reads
+            # the same published content
+            self._service.rounds = 0
+            return
         if self._use_native:
             if self._native is None:
                 self._native = self._make_native()
@@ -301,6 +337,9 @@ class ImageRecordIter(DataIter):
         if self._reader is not None:
             self._reader.close()
             self._reader = None
+        if self._service is not None:
+            self._service.close()
+            self._service = None
 
     def __del__(self):  # pragma: no cover - GC timing
         try:
@@ -310,6 +349,24 @@ class ImageRecordIter(DataIter):
 
     def next(self) -> DataBatch:
         pad = 0
+        if self._service is not None:
+            data_np, lab = next(self._service)  # StopIteration = epoch end
+            # service workers publish decode output as stored: uint8
+            # HWC from the image pipeline becomes dtype CHW here (the
+            # same ONE copy the native path pays)
+            if (data_np.ndim == 4
+                    and data_np.shape[1:] != self.data_shape
+                    and data_np.shape[3] == self.data_shape[0]):
+                data_np = data_np.transpose(0, 3, 1, 2)
+            data_np = data_np.astype(self._dtype, copy=False)
+            lab = onp.asarray(lab, dtype=onp.float32)
+            data = mxnp.array(data_np)
+            if lab.ndim > 1 and lab.shape[1] == 1:
+                lab = lab[:, 0]
+            label = mxnp.array(lab)
+            return DataBatch([data], [label], pad=0,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
         if self._native is not None:
             # next_view: the astype below is the ONE copy on this path
             # (the engine pads tail batches in its own buffer when
@@ -623,7 +680,11 @@ from .cache import (CachedImagePipeline, cache_dir_from_env,  # noqa: E402,F401
 from .service import (DatasetService, RecordIOSource,  # noqa: E402,F401
                       ServiceDown, ServiceStream, StreamCursor,
                       StreamStalled, SyntheticSource, WorkerLost,
-                      load_cursor, save_cursor, service_root_from_env)
+                      ambient_service_stream, load_cursor, save_cursor,
+                      service_net_from_env, service_root_from_env)
+from .transport import (BlockClient, BlockNotFound,  # noqa: E402,F401
+                        BlockServer, FrameError, PeerLost,
+                        TransportError)
 
 __all__ += ["NativeImagePipeline", "DevicePrefetch", "decode_jpeg_batch",
             "native_available", "ShardedImagePipeline",
@@ -632,4 +693,6 @@ __all__ += ["NativeImagePipeline", "DevicePrefetch", "decode_jpeg_batch",
             "DatasetService", "ServiceStream", "StreamCursor",
             "SyntheticSource", "RecordIOSource", "WorkerLost",
             "StreamStalled", "ServiceDown", "load_cursor", "save_cursor",
-            "service_root_from_env"]
+            "service_root_from_env", "service_net_from_env",
+            "ambient_service_stream", "BlockServer", "BlockClient",
+            "BlockNotFound", "TransportError", "PeerLost", "FrameError"]

@@ -54,6 +54,20 @@ loss dumps ``io_worker_lost:w<id>`` through the flight recorder.
 All coordination is filesystem-based (the shared root every pod job
 already has) — which is what makes the kill-a-real-decode-worker drill
 tier-1-testable on CPU with plain processes.
+
+**The network mile** (``MXNET_TPU_IO_SERVICE_NET``): each worker also
+hosts a :class:`~mxnet_tpu.io.transport.BlockServer` over the shared
+spool and publishes its ``host:port`` under ``<root>/net/``; a
+:class:`ServiceStream` built with ``endpoints=`` (or ``net=True``)
+fetches batches over TCP instead of the filesystem — consumers need
+**no shared mount at all** (``root=None``). The degradation chain is
+network-fetch → surviving-peer failover (any worker serves any
+published batch; the client's breaker rotates off dead endpoints) →
+local decode (warn-once). In net mode consumers cannot write re-dispatch
+markers; a killed worker's unserved range is recovered by the surviving
+workers' own 2x-stale self-heal, so the exactly-once contract holds
+end to end. ``io_net_*`` counters/gauges (bytes, fetches, retries,
+failovers, checksum rejects, open conns) ride the same registry.
 """
 from __future__ import annotations
 
@@ -76,9 +90,10 @@ __all__ = [
     "WorkerLost", "StreamStalled", "ServiceDown",
     "SyntheticSource", "RecordIOSource",
     "StreamCursor", "load_cursor", "save_cursor",
-    "DatasetService", "ServiceStream",
+    "DatasetService", "ServiceStream", "ambient_service_stream",
     "service_root_from_env", "default_service_workers",
     "service_range_size", "service_heartbeat_s", "service_stale_s",
+    "service_net_from_env", "service_net_host",
 ]
 
 _PLAN = "plan.json"
@@ -118,6 +133,30 @@ def service_stale_s(heartbeat_s: Optional[float] = None) -> float:
     hb = float(heartbeat_s if heartbeat_s is not None
                else service_heartbeat_s())
     return env_float("MXNET_TPU_IO_SERVICE_STALE_S", max(4.0 * hb, 1.0))
+
+
+def service_net_from_env() -> Tuple[bool, Optional[List[str]]]:
+    """``MXNET_TPU_IO_SERVICE_NET`` parsed as ``(armed, endpoints)``.
+
+    Unset / ``0`` / ``off`` / ``false`` / ``no`` → ``(False, None)``;
+    a comma-separated ``host:port`` list → ``(True, [endpoints])``
+    (consumers need no shared root at all); any other truthy value
+    (``1``, ``on``) → ``(True, None)`` — net armed, endpoints discovered
+    under ``<root>/net/``."""
+    v = os.environ.get("MXNET_TPU_IO_SERVICE_NET", "").strip()
+    if not v or v.lower() in ("0", "off", "false", "no"):
+        return False, None
+    if ":" in v:
+        eps = [e.strip() for e in v.split(",") if e.strip()]
+        return True, (eps or None)
+    return True, None
+
+
+def service_net_host() -> str:
+    """``MXNET_TPU_IO_SERVICE_NET_HOST`` (default ``127.0.0.1``): the
+    interface each worker's :class:`BlockServer` binds — ``0.0.0.0``
+    for cross-host serving."""
+    return os.environ.get("MXNET_TPU_IO_SERVICE_NET_HOST") or "127.0.0.1"
 
 
 # ---------------------------------------------------------------------------
@@ -374,6 +413,90 @@ def _worker_ages(root: str) -> Dict[int, float]:
     return Heartbeat.ages(root)
 
 
+# ---------------------------------------------------------------------------
+# the network mile: spool serving + endpoint discovery
+# ---------------------------------------------------------------------------
+
+_NET_DIR = "net"
+_BLOCK_RE = None  # compiled lazily (re import stays off the hot path)
+
+
+def _endpoint_path(root: str, wid: int) -> str:
+    return os.path.join(root, _NET_DIR, f"w{int(wid)}.json")
+
+
+def _spool_resolver(root: str):
+    """The blob namespace a worker's :class:`BlockServer` serves:
+    ``plan`` (the epoch plan), ``ages`` (worker heartbeat ages — the
+    health blob net consumers poll in place of reading beat files), and
+    ``e<epoch>/b<i>`` (published spool batches — ANY worker serves any
+    published batch, which is what makes peer failover work)."""
+    import re
+
+    global _BLOCK_RE
+    if _BLOCK_RE is None:
+        _BLOCK_RE = re.compile(r"^e(\d+)/b(\d+)$")
+
+    def resolve(name: str) -> Optional[bytes]:
+        if name == "plan":
+            try:
+                with open(os.path.join(root, _PLAN), "rb") as f:
+                    return f.read()
+            except OSError:
+                return None
+        if name == "ages":
+            return json.dumps({str(w): a for w, a
+                               in _worker_ages(root).items()}).encode()
+        m = _BLOCK_RE.match(name)
+        if m is None:
+            return None
+        path = _batch_path(root, int(m.group(1)), int(m.group(2)))
+        for j in range(3):
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                return None  # not published yet — NOT_FOUND, not an error
+            except OSError:
+                if j == 2:
+                    raise
+                time.sleep(0.01)
+        return None
+
+    return resolve
+
+
+def _decode_npz(payload: bytes) -> Tuple[onp.ndarray, onp.ndarray]:
+    import io as _io
+
+    with onp.load(_io.BytesIO(payload)) as z:
+        return onp.array(z["data"]), onp.array(z["label"])
+
+
+def _discover_endpoints(root: str, wait_s: float = 10.0,
+                        expect: Optional[int] = None) -> List[str]:
+    """Endpoints published under ``<root>/net/`` — polls up to ``wait_s``
+    for at least one (or ``expect``) server to come up; returns whatever
+    is there at the deadline."""
+    nd = os.path.join(root, _NET_DIR)
+    deadline = time.monotonic() + float(wait_s)
+    while True:
+        eps: List[str] = []
+        try:
+            for n in sorted(os.listdir(nd)):
+                if n.startswith("w") and n.endswith(".json"):
+                    d = _read_json(os.path.join(nd, n))
+                    if d and d.get("endpoint"):
+                        eps.append(str(d["endpoint"]))
+        except OSError:
+            pass
+        if eps and (expect is None or len(eps) >= expect):
+            return eps
+        if time.monotonic() >= deadline:
+            return eps
+        time.sleep(0.05)
+
+
 def _live_workers(root: str, stale_s: float) -> List[int]:
     return sorted(w for w, age in _worker_ages(root).items()
                   if age <= stale_s)
@@ -413,8 +536,24 @@ def _worker_main(cfg: dict) -> None:
     _tracing.bind_trace(_tracing.TraceContext(
         trace_id=cfg.get("trace_id") or _tracing.new_trace_id("io"),
         role="io_worker", rank=wid))
+    server = None
     try:
         hb.beat()
+        net_cfg = cfg.get("net")
+        if net_cfg:
+            # the network mile: serve the shared spool over TCP and
+            # publish the endpoint BEFORE the (possibly slow) reader
+            # open, so consumers can discover and fetch the plan early
+            from .transport import BlockServer
+
+            server = BlockServer(
+                _spool_resolver(root),
+                host=net_cfg.get("host") or "127.0.0.1",
+                name=f"io-w{wid}").start()
+            os.makedirs(os.path.join(root, _NET_DIR), exist_ok=True)
+            _atomic_json(_endpoint_path(root, wid),
+                         {"worker": wid, "endpoint": server.endpoint,
+                          "pid": os.getpid(), "wall": time.time()})
         reader = cfg["source"].open()
         served_done: set = set()
         while not os.path.exists(stop_path):
@@ -435,6 +574,11 @@ def _worker_main(cfg: dict) -> None:
         except Exception:  # noqa: BLE001 — nothing left to do
             pass
     finally:
+        if server is not None:
+            try:
+                server.close()
+            except Exception:  # noqa: BLE001
+                pass
         if reader is not None:
             try:
                 reader.close()
@@ -623,8 +767,11 @@ class DatasetService:
                  range_size: Optional[int] = None,
                  heartbeat_s: Optional[float] = None,
                  stale_after_s: Optional[float] = None,
-                 poll_s: float = 0.02, start_method: Optional[str] = None):
+                 poll_s: float = 0.02, start_method: Optional[str] = None,
+                 net: Optional[bool] = None, net_host: Optional[str] = None):
         self.root = os.path.abspath(root)
+        self.net = bool(net) if net is not None else service_net_from_env()[0]
+        self.net_host = net_host or service_net_host()
         self.source = source
         self.n_batches = int(source.n_batches)
         self.num_workers = int(num_workers if num_workers is not None
@@ -677,7 +824,9 @@ class DatasetService:
                            range_size=self.range_size,
                            heartbeat_s=self.heartbeat_s,
                            stale_s=self.stale_s, poll_s=self.poll_s,
-                           trace_id=self.trace_id)
+                           trace_id=self.trace_id,
+                           net={"host": self.net_host} if self.net
+                           else None)
                 # the child inherits os.environ at spawn/fork: with a
                 # shared MXNET_TPU_TELEMETRY root armed, each decode
                 # worker exports into its own io_worker subdir
@@ -720,11 +869,28 @@ class DatasetService:
 
         os.kill(self._procs[wid].pid, signal.SIGKILL)
 
+    def endpoints(self, wait_s: float = 30.0) -> List[str]:
+        """The worker fleet's published ``host:port`` endpoints — polls
+        up to ``wait_s`` for every worker's :class:`BlockServer` to come
+        up. Raises when none appears (net not armed, or the fleet died
+        before binding)."""
+        eps = _discover_endpoints(self.root, wait_s=wait_s,
+                                  expect=self.num_workers)
+        if not eps:
+            raise MXNetError(
+                f"no BlockServer endpoints under {self.root!r}/net "
+                f"within {wait_s:g}s (net={self.net})")
+        return eps
+
     def stream(self, **kwargs) -> "ServiceStream":
         """A consumer over this service's root; the source rides along
-        for the local-decode degradation path."""
+        for the local-decode degradation path. With ``net`` armed the
+        stream fetches over TCP from the fleet's endpoints."""
         kwargs.setdefault("source", self.source)
         kwargs.setdefault("stale_after_s", self.stale_s)
+        if self.net and "net" not in kwargs and "endpoints" not in kwargs:
+            kwargs["net"] = True
+            kwargs["endpoints"] = self.endpoints()
         return ServiceStream(self.root, **kwargs)
 
     def close(self) -> None:
@@ -779,17 +945,28 @@ class ServiceStream:
     batches in-process from the source — the same cursor/re-split
     machinery with no worker fleet (what the elastic drill uses, and
     what a single-host job without a service root gets).
+
+    ``endpoints=`` (or ``net=True``, or ``MXNET_TPU_IO_SERVICE_NET``)
+    arms the **network fetch path**: batches come over TCP from the
+    worker fleet's :class:`~mxnet_tpu.io.transport.BlockServer`
+    endpoints instead of the shared filesystem — ``root`` may then be
+    ``None`` (no shared mount at all; cursors stay in-memory). The
+    degradation chain is network-fetch → surviving-peer failover →
+    local decode (warn-once).
     """
 
-    def __init__(self, root: str, *, cursor: str = "default",
+    def __init__(self, root: Optional[str] = None, *,
+                 cursor: str = "default",
                  member_index: int = 0, world: int = 1,
                  epoch: int = 0, start: Optional[int] = None,
                  source=None, local: bool = False,
                  stale_after_s: Optional[float] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  local_fallback: bool = True, poll_s: float = 0.02,
-                 fetch_deadline_s: Optional[float] = None):
-        self.root = os.path.abspath(root)
+                 fetch_deadline_s: Optional[float] = None,
+                 endpoints: Optional[List[str]] = None,
+                 net: Optional[bool] = None):
+        self.root = os.path.abspath(root) if root is not None else None
         self.cursor_name = str(cursor)
         if not 0 <= int(member_index) < int(world):
             raise MXNetError(
@@ -808,9 +985,39 @@ class ServiceStream:
         self._fetch_deadline = float(
             fetch_deadline_s if fetch_deadline_s is not None
             else max(4.0 * self.stale_s, 2.0))
+        # -- the network fetch path -----------------------------------
+        env_net, env_eps = service_net_from_env()
+        if endpoints is None and env_eps:
+            endpoints = list(env_eps)
+        self._net = (bool(net) if net is not None
+                     else bool(endpoints) or env_net)
+        self._client = None
+        if self._net and not self.local:
+            if endpoints is None:
+                if self.root is None:
+                    raise MXNetError(
+                        "a net ServiceStream without a root needs "
+                        "endpoints= (or MXNET_TPU_IO_SERVICE_NET="
+                        "host:port,...)")
+                endpoints = _discover_endpoints(
+                    self.root, wait_s=self._fetch_deadline)
+            if endpoints:
+                from .transport import BlockClient
+
+                self._client = BlockClient(endpoints)
+            else:
+                self._net = False  # net asked for, nobody serving — the
+                # shared-fs / local ladder below still applies
+        if self.root is None and self._client is None and not self.local:
+            raise MXNetError(
+                "ServiceStream needs a root, net endpoints, or "
+                "local=True with a source")
         plan = None
         if not self.local:
-            plan = self._load_plan()
+            if self.root is not None:
+                plan = self._load_plan()
+            if plan is None and self._client is not None:
+                plan = self._net_plan(self._fetch_deadline)
         if plan is not None:
             self.n_batches = int(plan["n_batches"])
             self.range_size = int(plan["range_size"])
@@ -822,7 +1029,8 @@ class ServiceStream:
             self.n_batches = int(source.n_batches)
             self.range_size = service_range_size()
             self.local = True
-        cur = load_cursor(self.root, self.cursor_name)
+        cur = (load_cursor(self.root, self.cursor_name)
+               if self.root is not None else None)
         if start is not None:
             self.frontier = int(start)
             self.epoch = int(epoch)
@@ -854,6 +1062,10 @@ class ServiceStream:
     def save_cursor(self, frontier: Optional[int] = None) -> StreamCursor:
         """Persist the named cursor at ``frontier`` (default: this
         member's :meth:`group_frontier`)."""
+        if self.root is None:
+            raise MXNetError(
+                "cursor persistence needs a shared root — this is a "
+                "net-only ServiceStream (root=None)")
         cur = StreamCursor(self.cursor_name, self.epoch,
                            int(frontier if frontier is not None
                                else self.group_frontier()), self.world)
@@ -867,7 +1079,8 @@ class ServiceStream:
         resumes the strided assignment from ``frontier`` (default: the
         persisted named cursor). Returns self."""
         if frontier is None:
-            cur = load_cursor(self.root, self.cursor_name)
+            cur = (load_cursor(self.root, self.cursor_name)
+                   if self.root is not None else None)
             frontier = cur.frontier if cur is not None else self.frontier
         if not 0 <= int(member_index) < int(world):
             raise MXNetError(
@@ -887,6 +1100,45 @@ class ServiceStream:
     # -- fetch ------------------------------------------------------------
     def _load_plan(self) -> Optional[dict]:
         return _read_json(os.path.join(self.root, _PLAN))
+
+    def _net_plan(self, timeout_s: float) -> Optional[dict]:
+        """Fetch the epoch plan over the wire — the bounded poll absorbs
+        the multi-second import a spawned worker pays before its
+        BlockServer binds."""
+        from ..resilience.retry import RetriesExhausted as _RE
+
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            try:
+                payload = self._client.try_fetch("plan", deadline_s=2.0)
+            except _RE:
+                payload = None
+            if payload is not None:
+                try:
+                    return json.loads(payload.decode("utf-8"))
+                except (UnicodeDecodeError, ValueError):
+                    pass
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.1)
+
+    def _net_ages(self) -> Optional[Dict[int, float]]:
+        """Worker heartbeat ages through the ``ages`` blob — the health
+        model a mount-less consumer gets. ``None`` when no endpoint
+        answered (distinct from an empty fleet)."""
+        from ..resilience.retry import RetriesExhausted as _RE
+
+        try:
+            payload = self._client.try_fetch("ages", deadline_s=1.0)
+        except _RE:
+            return None
+        if payload is None:
+            return None
+        try:
+            d = json.loads(payload.decode("utf-8"))
+            return {int(w): float(a) for w, a in d.items()}
+        except (UnicodeDecodeError, ValueError):
+            return None
 
     def _open_reader(self):
         if self._reader is None:
@@ -977,8 +1229,52 @@ class ServiceStream:
                     "straggler or backpressure")
             time.sleep(self.poll_s)
 
+    def _fetch_net(self, i: int) -> Tuple[onp.ndarray, onp.ndarray]:
+        """One bounded attempt to fetch batch ``i`` over the wire. The
+        BlockClient inside already retries transport faults and fails
+        over across endpoints; NOT_FOUND means not-published-yet and is
+        polled. Typed raises mirror :meth:`_fetch`: every endpoint dead
+        or the whole fleet stale → :class:`ServiceDown`; deadline with a
+        live fleet → :class:`StreamStalled`."""
+        from ..resilience.retry import RetriesExhausted as _RE
+
+        chaos.site("io.stream", batch=i)
+        name = f"e{self.epoch}/b{i}"
+        deadline = time.monotonic() + self._fetch_deadline
+        next_health = 0.0
+        while True:
+            try:
+                payload = self._client.try_fetch(
+                    name, deadline_s=min(2.0, self._fetch_deadline))
+            except _RE as e:
+                raise ServiceDown(
+                    f"io service: no endpoint answered fetching batch "
+                    f"{i} (endpoints {self._client.endpoints})") from e
+            if payload is not None:
+                return _decode_npz(payload)
+            now = time.monotonic()
+            if now >= next_health:
+                next_health = now + max(self.stale_s / 4, 0.05)
+                ages = self._net_ages()
+                if ages is not None:
+                    live = [w for w, a in ages.items()
+                            if a <= self.stale_s]
+                    self._m["workers_live"].set(len(live))
+                    if ages and not live:
+                        raise ServiceDown(
+                            f"io service: every worker heartbeat is "
+                            f"stale while batch {i} is unserved "
+                            f"(ages {ages})")
+            if now > deadline:
+                raise StreamStalled(
+                    f"batch {i} not served over the wire within "
+                    f"{self._fetch_deadline:g}s — straggler, "
+                    "backpressure, or a killed worker's range awaiting "
+                    "peer self-heal")
+            time.sleep(self.poll_s)
+
     def _observe_lag(self, i: int) -> None:
-        if i % 16:
+        if i % 16 or self.root is None:
             return
         try:
             names = os.listdir(_spool_dir(self.root, self.epoch))
@@ -1015,8 +1311,10 @@ class ServiceStream:
             return self._local_read(i)
         if self._service_dead:
             return self._degrade_local(i, ServiceDown("service marked dead"))
+        use_net = self._client is not None
+        fetch = self._fetch_net if use_net else self._fetch
         try:
-            data, label = call_with_retry(self._fetch, i,
+            data, label = call_with_retry(fetch, i,
                                           policy=self.retry_policy)
         except (RetriesExhausted, ServiceDown) as e:
             # ServiceDown is transient (the service may be restarting),
@@ -1028,7 +1326,8 @@ class ServiceStream:
                     and isinstance(e.__cause__, ServiceDown)):
                 cause = e.__cause__
             return self._degrade_local(i, cause)
-        self._m["batches"].labels(path="service").inc()
+        self._m["batches"].labels(path="net" if use_net
+                                  else "service").inc()
         self._observe_lag(i)
         return data, label
 
@@ -1052,9 +1351,58 @@ class ServiceStream:
             except Exception:  # noqa: BLE001
                 pass
             self._reader = None
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001
+                pass
 
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self.close()
         except Exception:  # noqa: BLE001
             pass
+
+
+# ---------------------------------------------------------------------------
+# ambient wiring
+# ---------------------------------------------------------------------------
+
+_WARNED_AMBIENT = False
+
+
+def ambient_service_stream(*, require: bool = False, source=None,
+                           **kwargs) -> Optional["ServiceStream"]:
+    """A :class:`ServiceStream` from the ambient environment, or
+    ``None`` when no service is configured (or configured but
+    unreachable, warn-once) — the hook ``gluon.data.DataLoader`` and
+    ``ImageRecordIter`` call so any input pipeline consumes the service
+    automatically when ``MXNET_TPU_IO_SERVICE`` (shared-fs) or
+    ``MXNET_TPU_IO_SERVICE_NET=host:port,...`` (mount-less) is set.
+    ``require=True`` raises instead of returning ``None``."""
+    global _WARNED_AMBIENT
+
+    root = service_root_from_env()
+    net, eps = service_net_from_env()
+    if root is None and not eps:
+        if require:
+            raise MXNetError(
+                "no ambient io service: set MXNET_TPU_IO_SERVICE "
+                "(shared root) or MXNET_TPU_IO_SERVICE_NET=host:port,...")
+        return None
+    try:
+        return ServiceStream(root, source=source,
+                             endpoints=list(eps) if eps else None,
+                             net=net or None, **kwargs)
+    except MXNetError as e:
+        if require:
+            raise
+        if not _WARNED_AMBIENT:
+            _WARNED_AMBIENT = True
+            warnings.warn(
+                f"MXNET_TPU_IO_SERVICE{'_NET' if net else ''} is set "
+                f"but no service stream could be built "
+                f"({type(e).__name__}: {e}); falling back to the "
+                "in-process input pipeline", RuntimeWarning,
+                stacklevel=3)
+        return None

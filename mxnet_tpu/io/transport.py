@@ -1,0 +1,652 @@
+"""Network block-transfer plane: framed socket serving of named blobs.
+
+The dataset service (PR 13) moves batches through a shared filesystem —
+that's a rack, not a cluster. This module is the network mile: a
+stdlib-socket, length-prefixed framed protocol with a :class:`BlockServer`
+serving **named blobs** (spool batches today; cache slabs and KV blocks
+are the same seam) and a :class:`BlockClient` that fetches them with
+
+- **CRC32-checksummed frames** verified on receive — a garbled frame is
+  rejected (``FrameError``), never silently consumed, and the fetch is
+  idempotently retried;
+- **per-request deadlines** riding the shared
+  :class:`~mxnet_tpu.resilience.retry.RetryPolicy` backoff;
+- **connection pooling** per endpoint (LIFO idle sockets, bounded);
+- **breaker-style failover** across server replicas: an endpoint that
+  keeps failing is opened for a cooldown and the client rotates to the
+  survivors — ``io_net_failovers_total`` counts every fetch served by a
+  non-preferred endpoint.
+
+Every wire fault is **typed**: :class:`TransportError` (a
+``TransientError`` — the retry classifier backs off and re-fetches),
+:class:`PeerLost` (endpoint refused/closed — failover), and
+:class:`FrameError` (bad magic / checksum mismatch). A missing blob is
+:class:`BlockNotFound` (non-transient; ``try_fetch`` returns ``None``
+instead, which is how stream consumers poll for not-yet-published
+batches without burning retry budget).
+
+Frame anatomy (network byte order)::
+
+    0      2      3      4          8         12
+    | MAGIC | type | flag | payload_len | crc32 | payload ... |
+
+``MAGIC = 0xB10C``; types ``REQ=1 OK=2 NOT_FOUND=3 ERR=4``. Requests are
+a small JSON payload (``{"op": "get", "name": ...}``) so the protocol
+extends without a version dance. CRC32 is over the payload bytes.
+
+Chaos sites: ``io.net.accept`` (a raise drops the just-accepted
+connection — the client sees a peer reset and fails over) and
+``io.net.frame`` (fires in the server send path; the ``garble`` action
+flips payload bytes *after* the checksum is computed, so the client's
+verify-on-receive must catch it).
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, TransientError, env_float, env_int
+from ..log import get_logger
+from ..resilience import chaos
+from ..resilience.retry import RetriesExhausted, RetryPolicy, call_with_retry
+
+__all__ = [
+    "MAGIC", "T_REQ", "T_OK", "T_NOT_FOUND", "T_ERR",
+    "TransportError", "PeerLost", "FrameError", "BlockNotFound",
+    "pack_frame", "read_frame", "BlockServer", "BlockClient",
+]
+
+logger = get_logger("io.transport")
+
+MAGIC = 0xB10C
+#: Frame types.
+T_REQ, T_OK, T_NOT_FOUND, T_ERR = 1, 2, 3, 4
+
+_HEADER = struct.Struct("!HBBII")  # magic, type, flags, payload_len, crc32
+#: Refuse frames claiming more than this — a corrupt length prefix must
+#: not make the receiver try to allocate gigabytes.
+MAX_PAYLOAD = 256 * 1024 * 1024
+
+
+class TransportError(TransientError):
+    """A wire-level fault (timeout, short read, reset). Retryable: block
+    fetches are idempotent, so the caller re-fetches under backoff."""
+
+
+class PeerLost(TransportError):
+    """The peer is gone: connect refused, connection closed mid-frame, or
+    every configured endpoint failed. Transient — peers restart and
+    survivors absorb the load."""
+
+
+class FrameError(TransportError):
+    """A frame failed validation (bad magic or CRC32 mismatch). The
+    socket is poisoned and closed; the fetch is retried on a fresh one."""
+
+
+class BlockNotFound(MXNetError):
+    """The server answered: no blob by that name. Not transient — use
+    :meth:`BlockClient.try_fetch` to poll for late-published blocks."""
+
+
+# --------------------------------------------------------------------------
+# framing
+# --------------------------------------------------------------------------
+
+def pack_frame(ftype: int, payload: bytes, *, flags: int = 0) -> bytes:
+    """Serialize one frame: 12-byte header + payload, CRC32 over payload."""
+    if len(payload) > MAX_PAYLOAD:
+        raise FrameError(f"payload {len(payload)} exceeds {MAX_PAYLOAD}")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return _HEADER.pack(MAGIC, ftype, flags, len(payload), crc) + payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(min(n - len(buf), 1 << 20))
+        except socket.timeout as e:
+            raise TransportError(f"recv timed out after {n - len(buf)} "
+                                 f"bytes short") from e
+        except OSError as e:
+            raise PeerLost(f"recv failed: {e}") from e
+        if not chunk:
+            raise PeerLost(f"peer closed mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    """Read and validate one frame. Returns ``(type, payload)``.
+
+    Raises :class:`FrameError` on bad magic, oversized length, or CRC32
+    mismatch — the caller must treat the socket as poisoned.
+    """
+    hdr = _recv_exact(sock, _HEADER.size)
+    magic, ftype, _flags, plen, crc = _HEADER.unpack(hdr)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic 0x{magic:04X} (expected 0x{MAGIC:04X})")
+    if plen > MAX_PAYLOAD:
+        raise FrameError(f"frame claims {plen} bytes (cap {MAX_PAYLOAD})")
+    payload = _recv_exact(sock, plen)
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise FrameError(
+            f"checksum mismatch on {plen}-byte payload "
+            f"(got 0x{zlib.crc32(payload) & 0xFFFFFFFF:08X}, "
+            f"frame said 0x{crc:08X})")
+    return ftype, payload
+
+
+# --------------------------------------------------------------------------
+# metrics
+# --------------------------------------------------------------------------
+
+def _metrics():
+    from ..telemetry.registry import get_registry
+    reg = get_registry()
+    return {
+        "bytes": reg.counter(
+            "io_net_bytes_total",
+            "Bytes moved over the block-transfer plane.", labels=("dir",)),
+        "fetches": reg.counter(
+            "io_net_fetches_total",
+            "Block fetches by outcome.", labels=("result",)),
+        "retries": reg.counter(
+            "io_net_retries_total",
+            "Fetch attempts retried after a transport fault."),
+        "failovers": reg.counter(
+            "io_net_failovers_total",
+            "Fetches served by a non-preferred endpoint after failover."),
+        "checksum": reg.counter(
+            "io_net_checksum_failures_total",
+            "Frames rejected by CRC32 verify-on-receive."),
+        "open_conns": reg.gauge(
+            "io_net_open_conns",
+            "Pooled + in-flight client connections currently open."),
+        "server_conns": reg.gauge(
+            "io_net_server_conns",
+            "Connections currently accepted by the local BlockServer."),
+    }
+
+
+# --------------------------------------------------------------------------
+# server
+# --------------------------------------------------------------------------
+
+class BlockServer:
+    """Serve named blobs over TCP from a resolver callable.
+
+    ``resolver(name) -> bytes | None`` — ``None`` answers ``NOT_FOUND``
+    (the polite "not published yet"), an exception answers ``ERR`` with
+    the message (the connection survives). One accept thread, one
+    handler thread per connection; connections are request/response and
+    long-lived (the client pools them).
+    """
+
+    def __init__(self, resolver: Callable[[str], Optional[bytes]], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 backlog: int = 32, name: str = "block-server"):
+        self._resolver = resolver
+        self._name = name
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._host, self._port = self._sock.getsockname()[:2]
+        self._backlog = backlog
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._conns: Dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._m = _metrics()
+        #: total connections ever accepted (pool-reuse observability)
+        self.accepted = 0
+
+    @property
+    def endpoint(self) -> str:
+        """``host:port`` as published for discovery."""
+        return f"{self._host}:{self._port}"
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> "BlockServer":
+        self._sock.listen(self._backlog)
+        self._sock.settimeout(0.2)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{self._name}-accept",
+            daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        cid = 0
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                chaos.site("io.net.accept", endpoint=self.endpoint)
+            except chaos.ChaosFault:
+                # Injected accept fault: drop the connection on the
+                # floor — the client sees a reset and fails over.
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            cid += 1
+            self.accepted = cid
+            with self._lock:
+                self._conns[cid] = conn
+            self._m["server_conns"].set(len(self._conns))
+            t = threading.Thread(target=self._serve_conn,
+                                 args=(cid, conn, addr),
+                                 name=f"{self._name}-conn{cid}", daemon=True)
+            t.start()
+
+    def _serve_conn(self, cid: int, conn: socket.socket, addr) -> None:
+        conn.settimeout(30.0)
+        try:
+            while not self._stop.is_set():
+                try:
+                    ftype, payload = read_frame(conn)
+                except (PeerLost, TransportError):
+                    return
+                if ftype != T_REQ:
+                    self._send(conn, T_ERR,
+                               b'{"error": "expected REQ frame"}', "")
+                    continue
+                try:
+                    req = json.loads(payload.decode("utf-8"))
+                except (UnicodeDecodeError, ValueError):
+                    self._send(conn, T_ERR, b'{"error": "bad request"}', "")
+                    continue
+                op = req.get("op")
+                if op == "ping":
+                    self._send(conn, T_OK, b"pong", "ping")
+                    continue
+                if op != "get":
+                    self._send(
+                        conn, T_ERR,
+                        json.dumps({"error": f"unknown op {op!r}"}).encode(),
+                        "")
+                    continue
+                name = str(req.get("name", ""))
+                try:
+                    blob = self._resolver(name)
+                except Exception as e:  # noqa: BLE001 — answered, not fatal
+                    self._send(
+                        conn, T_ERR,
+                        json.dumps({"error": f"{type(e).__name__}: {e}"}
+                                   ).encode(), name)
+                    continue
+                if blob is None:
+                    self._send(conn, T_NOT_FOUND, name.encode(), name)
+                else:
+                    self._send(conn, T_OK, blob, name)
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                self._conns.pop(cid, None)
+            self._m["server_conns"].set(len(self._conns))
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send(self, conn: socket.socket, ftype: int, payload: bytes,
+              name: str) -> None:
+        frame = pack_frame(ftype, payload)
+        try:
+            chaos.site("io.net.frame", block=name, bytes=len(payload))
+        except chaos.ChaosGarble:
+            # Garble: checksum already covers the ORIGINAL payload, so
+            # flipping payload bytes on the wire makes verify-on-receive
+            # fail at the client — exactly the corruption being drilled.
+            body = bytearray(frame)
+            for i in range(_HEADER.size,
+                           min(len(body), _HEADER.size + 64)):
+                body[i] ^= 0xFF
+            frame = bytes(body)
+        conn.sendall(frame)
+        self._m["bytes"].labels(dir="tx").inc(len(frame))
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+        self._m["server_conns"].set(0)
+
+    def __enter__(self) -> "BlockServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# --------------------------------------------------------------------------
+# client
+# --------------------------------------------------------------------------
+
+def _parse_endpoint(ep: str) -> Tuple[str, int]:
+    host, _, port = ep.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad endpoint {ep!r} (expected host:port)")
+    return host, int(port)
+
+
+class _Endpoint:
+    """Per-endpoint state: idle-socket pool + breaker."""
+
+    __slots__ = ("addr", "host", "port", "idle", "fails", "open_until",
+                 "lock")
+
+    def __init__(self, ep: str):
+        self.addr = ep
+        self.host, self.port = _parse_endpoint(ep)
+        self.idle: List[socket.socket] = []
+        self.fails = 0
+        self.open_until = 0.0
+        self.lock = threading.Lock()
+
+    def closed(self, now: float) -> bool:
+        """Breaker closed = endpoint is believed healthy."""
+        return now >= self.open_until
+
+
+class BlockClient:
+    """Fetch named blobs from a set of :class:`BlockServer` endpoints.
+
+    Thread-safe. Each fetch walks the endpoint list in breaker-aware
+    round-robin order; per-endpoint failures trip a breaker (``fail_threshold``
+    consecutive) that opens the endpoint for ``cooldown_s`` — opened
+    endpoints are only tried after every closed one failed. Fetches
+    served by any endpoint other than the round-robin first choice count
+    as failovers.
+    """
+
+    def __init__(self, endpoints: Sequence[str], *,
+                 deadline_s: Optional[float] = None,
+                 pool_size: Optional[int] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 fail_threshold: int = 3,
+                 cooldown_s: Optional[float] = None,
+                 connect_timeout_s: float = 2.0):
+        if not endpoints:
+            raise ValueError("BlockClient needs at least one endpoint")
+        self._eps = [_Endpoint(e) for e in endpoints]
+        self._deadline_s = (deadline_s if deadline_s is not None
+                            else env_float("MXNET_TPU_IO_NET_DEADLINE_S", 5.0))
+        self._pool_size = (pool_size if pool_size is not None
+                           else env_int("MXNET_TPU_IO_NET_POOL", 2))
+        self._policy = retry_policy or RetryPolicy(
+            max_attempts=4, base_delay_s=0.02, max_delay_s=0.5)
+        self._fail_threshold = max(1, int(fail_threshold))
+        self._cooldown_s = (cooldown_s if cooldown_s is not None
+                            else env_float("MXNET_TPU_IO_NET_COOLDOWN_S", 2.0))
+        self._connect_timeout_s = connect_timeout_s
+        self._rr = 0
+        self._open = 0          # sockets currently open (pooled + in-flight)
+        self._lock = threading.Lock()
+        self._m = _metrics()
+
+    @property
+    def endpoints(self) -> List[str]:
+        return [e.addr for e in self._eps]
+
+    # -- endpoint ordering / breaker ------------------------------------
+
+    def _endpoint_order(self) -> List[_Endpoint]:
+        now = time.monotonic()
+        with self._lock:
+            start = self._rr % len(self._eps)
+            self._rr += 1
+        rotated = self._eps[start:] + self._eps[:start]
+        closed = [e for e in rotated if e.closed(now)]
+        opened = [e for e in rotated if not e.closed(now)]
+        return closed + opened
+
+    def _mark_fail(self, ep: _Endpoint) -> None:
+        with ep.lock:
+            ep.fails += 1
+            if ep.fails >= self._fail_threshold:
+                ep.open_until = time.monotonic() + self._cooldown_s
+                ep.fails = 0
+                logger.warning(
+                    "io.transport: endpoint %s breaker opened for %.1fs",
+                    ep.addr, self._cooldown_s)
+
+    def _mark_ok(self, ep: _Endpoint) -> None:
+        with ep.lock:
+            ep.fails = 0
+            ep.open_until = 0.0
+
+    # -- socket lifecycle ------------------------------------------------
+
+    def _checkout(self, ep: _Endpoint,
+                  deadline: float) -> Tuple[socket.socket, bool]:
+        """Return ``(sock, pooled)`` — pooled=True means it may be stale."""
+        with ep.lock:
+            if ep.idle:
+                return ep.idle.pop(), True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TransportError(f"deadline expired before connect to "
+                                 f"{ep.addr}")
+        try:
+            sock = socket.create_connection(
+                (ep.host, ep.port),
+                timeout=min(self._connect_timeout_s, remaining))
+        except socket.timeout as e:
+            raise TransportError(f"connect to {ep.addr} timed out") from e
+        except OSError as e:
+            raise PeerLost(f"connect to {ep.addr} failed: {e}") from e
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._lock:
+            self._open += 1
+        self._m["open_conns"].set(self._open)
+        return sock, False
+
+    def _checkin(self, ep: _Endpoint, sock: socket.socket) -> None:
+        with ep.lock:
+            if len(ep.idle) < self._pool_size:
+                ep.idle.append(sock)
+                return
+        self._discard(sock)
+
+    def _discard(self, sock: socket.socket) -> None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            self._open = max(0, self._open - 1)
+        self._m["open_conns"].set(self._open)
+
+    # -- fetch -----------------------------------------------------------
+
+    def _roundtrip(self, ep: _Endpoint, name: str,
+                   deadline: float) -> Tuple[int, bytes]:
+        """One request/response on one endpoint, pooled-then-fresh."""
+        req = pack_frame(T_REQ, json.dumps({"op": "get", "name": name}
+                                           ).encode("utf-8"))
+        last: Optional[Exception] = None
+        for attempt in range(2):
+            sock, pooled = self._checkout(ep, deadline)
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TransportError(
+                        f"deadline expired fetching {name!r} from {ep.addr}")
+                sock.settimeout(remaining)
+                sock.sendall(req)
+                self._m["bytes"].labels(dir="tx").inc(len(req))
+                ftype, payload = read_frame(sock)
+                self._m["bytes"].labels(dir="rx").inc(
+                    _HEADER.size + len(payload))
+                self._checkin(ep, sock)
+                return ftype, payload
+            except FrameError:
+                self._m["checksum"].inc()
+                self._discard(sock)
+                raise
+            except (TransportError, OSError) as e:
+                self._discard(sock)
+                last = e if isinstance(e, TransportError) else PeerLost(
+                    f"i/o with {ep.addr} failed: {e}")
+                # A stale pooled socket earns one immediate fresh-socket
+                # retry before the endpoint is charged with a failure.
+                if not pooled:
+                    break
+        assert last is not None
+        raise last
+
+    # NOT_FOUND comes back as this sentinel, not BlockNotFound, so the
+    # retry classifier (MXNetError = fatal) never sees it — a poll miss
+    # is an answer, not a fault, and must not flight-dump.
+    _NOT_FOUND = object()
+
+    def _fetch_attempt(self, name: str, deadline: float):
+        order = self._endpoint_order()
+        preferred = order[0] if order else None
+        last: Optional[Exception] = None
+        for ep in order:
+            if time.monotonic() >= deadline:
+                raise TransportError(
+                    f"deadline expired fetching {name!r} "
+                    f"(tried {[e.addr for e in order]})")
+            try:
+                ftype, payload = self._roundtrip(ep, name, deadline)
+            except TransportError as e:
+                self._mark_fail(ep)
+                last = e
+                continue
+            self._mark_ok(ep)
+            if ep is not preferred:
+                self._m["failovers"].inc()
+            if ftype == T_OK:
+                return payload
+            if ftype == T_NOT_FOUND:
+                return self._NOT_FOUND
+            raise TransportError(
+                f"server error for {name!r} from {ep.addr}: "
+                f"{payload[:200].decode('utf-8', 'replace')}")
+        raise PeerLost(
+            f"all {len(order)} endpoint(s) failed fetching {name!r}"
+        ) from last
+
+    def fetch(self, name: str, *, deadline_s: Optional[float] = None) -> bytes:
+        """Fetch one blob, retrying transport faults under backoff.
+
+        Raises :class:`BlockNotFound` if the server answers "no such
+        blob", :class:`RetriesExhausted` (cause :class:`PeerLost` /
+        :class:`TransportError`) when the wire never yields.
+        """
+        t0 = time.monotonic()
+        budget = deadline_s if deadline_s is not None else self._deadline_s
+        deadline = t0 + budget
+
+        def _on_retry(attempt, exc, delay):
+            self._m["retries"].inc()
+
+        policy = self._policy
+        if policy.deadline_s is None:
+            policy = RetryPolicy(
+                max_attempts=policy.max_attempts,
+                base_delay_s=policy.base_delay_s,
+                max_delay_s=policy.max_delay_s,
+                multiplier=policy.multiplier, jitter=policy.jitter,
+                deadline_s=budget, seed=policy.seed)
+        try:
+            payload = call_with_retry(self._fetch_attempt, name, deadline,
+                                      policy=policy, on_retry=_on_retry)
+        except RetriesExhausted:
+            self._m["fetches"].labels(result="error").inc()
+            raise
+        if payload is self._NOT_FOUND:
+            self._m["fetches"].labels(result="not_found").inc()
+            raise BlockNotFound(name)
+        self._m["fetches"].labels(result="ok").inc()
+        self._emit_span(name, t0, len(payload))
+        return payload
+
+    def try_fetch(self, name: str, *,
+                  deadline_s: Optional[float] = None) -> Optional[bytes]:
+        """Like :meth:`fetch` but ``None`` on :class:`BlockNotFound` —
+        the poll-for-late-publish shape stream consumers want."""
+        try:
+            return self.fetch(name, deadline_s=deadline_s)
+        except BlockNotFound:
+            return None
+
+    def ping(self, *, deadline_s: float = 1.0) -> bool:
+        """True if any endpoint answers a ping within the deadline."""
+        deadline = time.monotonic() + deadline_s
+        req = pack_frame(T_REQ, b'{"op": "ping"}')
+        for ep in self._endpoint_order():
+            if time.monotonic() >= deadline:
+                break
+            try:
+                sock, _pooled = self._checkout(ep, deadline)
+            except TransportError:
+                continue
+            try:
+                sock.settimeout(max(0.05, deadline - time.monotonic()))
+                sock.sendall(req)
+                ftype, _ = read_frame(sock)
+                self._checkin(ep, sock)
+                if ftype == T_OK:
+                    self._mark_ok(ep)
+                    return True
+            except (TransportError, OSError):
+                self._discard(sock)
+                self._mark_fail(ep)
+        return False
+
+    def _emit_span(self, name: str, t0: float, nbytes: int) -> None:
+        from ..telemetry import tracing as _tracing
+        dur_s = time.monotonic() - t0
+        args = {"bytes": nbytes}
+        ctx = _tracing.current_trace()
+        if ctx is not None:
+            args["trace_id"] = ctx.trace_id
+        _tracing.emit_complete(
+            f"io.net.fetch[{name}]", _tracing.now_us() - dur_s * 1e6,
+            dur_s * 1e6, cat="io.net", args=args)
+
+    def close(self) -> None:
+        for ep in self._eps:
+            with ep.lock:
+                idle, ep.idle = ep.idle, []
+            for sock in idle:
+                self._discard(sock)
+
+    def __enter__(self) -> "BlockClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -40,7 +40,7 @@ from typing import Dict, List, Optional
 from ..base import FatalError, MXNetError, TransientError
 
 __all__ = [
-    "ChaosFault", "ChaosTransient", "ChaosFatal", "SITES",
+    "ChaosFault", "ChaosTransient", "ChaosFatal", "ChaosGarble", "SITES",
     "site", "scope", "armed", "clear", "stats", "reset_stats",
     "refresh_from_env",
 ]
@@ -80,6 +80,15 @@ SITES = (
     "io.stream",          # dataset-service consumer fetch (a batch
                           # faulted in transit — the bounded retry loop
                           # must absorb it; delay = slow shared fs)
+    "io.net.accept",      # BlockServer connection accept (raise = the
+                          # just-accepted connection is dropped — the
+                          # client sees a peer reset and fails over;
+                          # delay = slow accept path)
+    "io.net.frame",       # BlockServer response send (garble = payload
+                          # bytes flipped on the wire AFTER the checksum
+                          # is computed, so the client's verify-on-
+                          # receive must reject the frame; raise/delay
+                          # as usual)
 )
 
 
@@ -95,6 +104,14 @@ class ChaosFatal(ChaosFault, FatalError):
     """Injected fault the classifier must treat as non-retryable."""
 
 
+class ChaosGarble(ChaosFault):
+    """Corruption marker: the instrumented site must CATCH this and
+    corrupt its payload in place of raising (``BlockServer`` flips
+    payload bytes after computing the checksum). Escaping to a caller
+    means a site was armed with ``garble`` that doesn't implement it —
+    loud by design."""
+
+
 _FAULT_KINDS = {
     "transient": lambda site_: ChaosTransient(
         f"chaos: injected transient fault at {site_!r}"),
@@ -102,6 +119,8 @@ _FAULT_KINDS = {
         f"chaos: injected fatal fault at {site_!r}"),
     "oserror": lambda site_: OSError(
         f"chaos: injected OSError at {site_!r}"),
+    "garble": lambda site_: ChaosGarble(
+        f"chaos: injected frame corruption at {site_!r}"),
 }
 
 
@@ -320,8 +339,13 @@ def _parse_rule(site_name: str, spec: str, seed: int) -> _Rule:
         if after_n < 1:
             raise ValueError("kill:<n> needs n >= 1 (1-based call count)")
         return _Rule("kill", None, after=after_n - 1, seed=seed)
+    if action == "garble":
+        # sugar for raise:garble — same rule shape scope(fail="garble")
+        # arms, so env-armed campaigns reach subprocess BlockServers
+        p = float(parts[1]) if len(parts) > 1 else 1.0
+        return _Rule("raise", "garble", p=p, seed=seed)
     raise ValueError(f"unknown chaos action {action!r} "
-                     "(expected raise/delay/kill)")
+                     "(expected raise/delay/kill/garble)")
 
 
 def refresh_from_env() -> int:

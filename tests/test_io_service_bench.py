@@ -4,6 +4,11 @@ gates that hold at any scale — the world-4 input plane really starves
 less behind the service than decoding in-step, the worker-kill epoch
 re-dispatches and stays exactly-once, and the shared cache banks ONE
 slab for four concurrent cold ranks.
+
+``--net`` (ISSUE 17) gets the same treatment: the quick gate runs the
+mount-less TCP plane end to end (world-4 consumers holding ONLY
+endpoints, server SIGKILLed mid-epoch, ``io_net_failovers_total >= 1``)
+and the banked ``results_io_net_cpu.json`` is the full-run evidence.
 """
 import json
 import os
@@ -13,17 +18,22 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_io_service_bench_quick(tmp_path):
-    out_file = str(tmp_path / "io_service.json")
+def _scrubbed_env():
     env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
     for k in ("MXNET_TPU_CHAOS", "MXNET_TPU_FLIGHT_DIR",
-              "MXNET_TPU_IO_SERVICE", "MXNET_TPU_IO_CACHE"):
+              "MXNET_TPU_IO_SERVICE", "MXNET_TPU_IO_SERVICE_NET",
+              "MXNET_TPU_IO_CACHE"):
         env.pop(k, None)
+    return env
+
+
+def test_io_service_bench_quick(tmp_path):
+    out_file = str(tmp_path / "io_service.json")
     proc = subprocess.run(
         [sys.executable,
          os.path.join(ROOT, "benchmark", "io_service_bench.py"),
          "--quick", "--output", out_file],
-        env=env, capture_output=True, text=True, timeout=560)
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=560)
     assert proc.returncode == 0, proc.stderr[-2000:]
     rec = json.loads(open(out_file).read())
     assert rec["quick"] is True
@@ -52,4 +62,38 @@ def test_io_service_banked_artifact_passes_acceptance():
     assert p["starved_after_pct"] < p["starved_before_pct"]
     assert rec["redispatch"]["recovery_wall_s"] > 0
     assert rec["shared_cache"]["bank_once_ratio"] == 4.0
+    assert rec["acceptance"]["pass"] is True
+
+
+def test_io_net_bench_quick(tmp_path):
+    out_file = str(tmp_path / "io_net.json")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "benchmark", "io_service_bench.py"),
+         "--net", "--quick", "--output", out_file],
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(open(out_file).read())
+    assert rec["bench"] == "io_net" and rec["quick"] is True
+    assert rec["metric"] == "io_net_vs_fs_wall_ratio"
+    p = rec["net_plane"]
+    assert p["world"] == 4
+    assert p["net_bytes_rx"] > 0  # batches really crossed the wire
+    k = rec["net_kill"]
+    assert k["failovers"] >= 1
+    assert k["lost_batches"] == 0 and k["duplicated_batches"] == 0
+    assert rec["acceptance"]["pass"] is True
+
+
+def test_io_net_banked_artifact_passes_acceptance():
+    """The committed full-run artifact for the network plane: the
+    mount-less epoch is wall-competitive with shared-fs and the kill
+    drill failed over with zero lost / zero duplicated batches."""
+    path = os.path.join(ROOT, "benchmark", "results_io_net_cpu.json")
+    rec = json.loads(open(path).read())
+    assert rec["bench"] == "io_net" and rec["quick"] is False
+    assert rec["metric"] == "io_net_vs_fs_wall_ratio"
+    assert rec["value"] > 0
+    assert rec["net_kill"]["failovers"] >= 1
+    assert rec["net_kill"]["recovery_wall_s"] > 0
     assert rec["acceptance"]["pass"] is True

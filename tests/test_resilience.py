@@ -265,6 +265,21 @@ def test_chaos_env_parsing(monkeypatch):
     assert not chaos.armed()
 
 
+def test_chaos_env_garble_action(monkeypatch):
+    """``garble[:p]`` must be env-armable — that is how a cross-process
+    drill reaches a worker subprocess's BlockServer (scope() cannot)."""
+    monkeypatch.setenv("MXNET_TPU_CHAOS", "io.net.frame=garble:1.0")
+    assert chaos.refresh_from_env() == 1
+    with pytest.raises(chaos.ChaosGarble):
+        chaos.site("io.net.frame")
+    monkeypatch.setenv("MXNET_TPU_CHAOS", "io.net.frame=garble")
+    assert chaos.refresh_from_env() == 1  # probability defaults to 1.0
+    with pytest.raises(chaos.ChaosGarble):
+        chaos.site("io.net.frame")
+    monkeypatch.delenv("MXNET_TPU_CHAOS")
+    chaos.refresh_from_env()
+
+
 def test_chaos_env_malformed_warns_not_dies(monkeypatch):
     monkeypatch.setenv("MXNET_TPU_CHAOS",
                        "dataloader.next=explode;serving.infer=delay:0.001")

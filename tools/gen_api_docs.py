@@ -69,6 +69,10 @@ MODULES = {
     "mxnet_tpu.io.service": "fault-tolerant dataset service: decode-"
                             "worker fault domain, exactly-once range "
                             "re-dispatch, named resumable cursors",
+    "mxnet_tpu.io.transport": "network block-transfer plane: checksum-"
+                              "verified framed socket protocol, pooled "
+                              "BlockClient with deadlines + endpoint "
+                              "failover",
     "mxnet_tpu.recordio": "RecordIO containers",
     "mxnet_tpu.library": "extension-library loading (mxtpu_ext ABI)",
     "mxnet_tpu.runtime": "build-feature introspection",
