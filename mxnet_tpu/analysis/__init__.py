@@ -15,6 +15,17 @@ Three layers, one finding model (:class:`~.findings.Finding`):
   (J001 pad-to-tile, J003 churn elimination), an analytic TPU cost
   model calibrated on the banked bench corpus, and a knob autotuner
   emitting fingerprint-keyed ``TunedConfig``s (``MXNET_TPU_OPT``).
+- :mod:`.concurrency` — the C-rules: interprocedural lock-order graph
+  with cycle detection (C001), blocking-under-lock (C002), thread-
+  lifecycle leaks (C003) — the bug classes the cluster PRs kept
+  finding by hand.
+- :mod:`.lockwatch` — runtime witness for the C-rules
+  (``MXNET_TPU_LOCKWATCH``): wraps lock factories to record the
+  observed acquisition order and assert acyclicity inside drills.
+- :mod:`.contracts` — the R-rules: swallowed faults in retry paths
+  (R001), untyped raises under the TransientError/FatalError taxonomy
+  (R002), and three-way code↔docs drift gates for chaos sites,
+  ``MXNET_TPU_*`` env vars and telemetry series (R003).
 
 ``tools/tpulint.py`` is the CLI; the tier-1 suite self-lints the
 framework against ``tools/tpulint_baseline.json`` so new high-severity
@@ -34,6 +45,9 @@ from .jaxpr_rules import (  # noqa: F401
     lint_trainer,
 )
 from . import baseline  # noqa: F401
+from . import concurrency  # noqa: F401
+from . import contracts  # noqa: F401
+from . import lockwatch  # noqa: F401
 from . import opt  # noqa: F401
 from . import sentinel  # noqa: F401
 from .sentinel import TpuLintWarning, LintBudgetExceeded  # noqa: F401
@@ -43,7 +57,8 @@ __all__ = [
     "lint_source", "lint_paths", "cache_key_knobs",
     "lint_jaxpr", "lint_callable", "lint_block",
     "find_donation_misses", "lint_trainer",
-    "baseline", "opt", "sentinel", "TpuLintWarning",
+    "baseline", "concurrency", "contracts", "lockwatch",
+    "opt", "sentinel", "TpuLintWarning",
     "LintBudgetExceeded",
 ]
 

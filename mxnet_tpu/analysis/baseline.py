@@ -3,19 +3,27 @@
 Format (``tools/tpulint_baseline.json``)::
 
     {"version": 1, "tool": "tpulint",
-     "findings": {"<finding key>": <count>, ...}}
+     "findings": {"<finding key>": <count>,
+                  "<finding key>": {"count": <n>,
+                                    "justification": "<why kept>"},
+                  ...}}
 
 Keys are :attr:`Finding.key` — rule|path|scope|detail, no line numbers —
 so editing unrelated lines in a banked file does not churn the baseline.
 A finding is *new* when its key is absent, or when the same key now
 occurs more often than banked (a second sync added next to a known one
 must not hide behind it).
+
+A plain integer value is unjustified debt (a work queue entry); the
+object form records *why* the finding is accepted — required for
+survivors that are exact by design (e.g. a metric series whose name is
+built dynamically and is therefore invisible to the static R003 pass).
 """
 from __future__ import annotations
 
 import json
 from collections import Counter
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .findings import Finding
 
@@ -26,25 +34,53 @@ def counts(findings: List[Finding]) -> Dict[str, int]:
     return dict(Counter(f.key for f in findings))
 
 
-def save(path: str, findings: List[Finding]) -> None:
+def save(path: str, findings: List[Finding],
+         justifications: Optional[Dict[str, str]] = None) -> None:
+    """Bank findings; keys present in ``justifications`` are written in
+    the object form so a refresh does not drop the recorded reasons."""
+    justifications = justifications or {}
+    entries: Dict[str, object] = {}
+    for key, n in sorted(counts(findings).items()):
+        why = justifications.get(key)
+        entries[key] = {"count": n, "justification": why} if why else n
     payload = {
         "version": VERSION,
         "tool": "tpulint",
-        "findings": dict(sorted(counts(findings).items())),
+        "findings": entries,
     }
     with open(path, "w") as f:
         json.dump(payload, f, indent=1, sort_keys=True)
         f.write("\n")
 
 
-def load(path: str) -> Dict[str, int]:
+def _load_payload(path: str) -> dict:
     with open(path) as f:
         payload = json.load(f)
     if payload.get("version") != VERSION:
         raise ValueError(
             f"{path}: unsupported tpulint baseline version "
             f"{payload.get('version')!r}")
-    return dict(payload.get("findings", {}))
+    return payload
+
+
+def load(path: str) -> Dict[str, int]:
+    """Key -> banked count, normalizing both value forms."""
+    out: Dict[str, int] = {}
+    for key, val in _load_payload(path).get("findings", {}).items():
+        if isinstance(val, dict):
+            out[key] = int(val.get("count", 1))
+        else:
+            out[key] = int(val)
+    return out
+
+
+def load_justifications(path: str) -> Dict[str, str]:
+    """Key -> recorded justification, for entries that carry one."""
+    out: Dict[str, str] = {}
+    for key, val in _load_payload(path).get("findings", {}).items():
+        if isinstance(val, dict) and val.get("justification"):
+            out[key] = str(val["justification"])
+    return out
 
 
 def diff(findings: List[Finding],

@@ -2,16 +2,22 @@
 
 Usage::
 
-    python tools/tpulint.py [paths…] [--zoo] [--format text|json]
+    python tools/tpulint.py [paths…] [--zoo] [--concurrency]
+        [--contracts] [--format text|json]
         [--baseline tools/tpulint_baseline.json] [--write-baseline FILE]
         [--fail-on high|any|none]
 
 Source paths get the AST pass; ``--zoo`` additionally traces a
 representative set of model-zoo networks through the jaxpr pass (pure
-tracing — no FLOP executes, so the whole run stays CPU-cheap). With
-``--baseline``, only *new* findings at or above ``--fail-on`` fail the
-run (exit 1); ``--write-baseline`` banks the current findings as the
-accepted debt ledger.
+tracing — no FLOP executes, so the whole run stays CPU-cheap);
+``--concurrency`` runs the interprocedural lock-order / blocking-under-
+lock / thread-lifecycle C-rules; ``--contracts`` runs the R-rules
+(swallowed faults, untyped raises, and the code↔docs drift gates for
+chaos sites, env vars and metric series). With ``--baseline``, only
+*new* findings at or above ``--fail-on`` fail the run (exit 1);
+``--write-baseline`` banks the current findings as the accepted debt
+ledger (carrying forward any justification strings recorded in
+``--baseline``).
 """
 from __future__ import annotations
 
@@ -86,11 +92,20 @@ def run(paths, zoo: bool = False, baseline_path: Optional[str] = None,
         write_baseline: Optional[str] = None, fail_on: str = "high",
         fmt: str = "text", root: Optional[str] = None,
         zoo_rewrite: bool = True, opt_report: bool = False,
+        concurrency: bool = False, contracts: bool = False,
         out=None) -> int:
     out = out or sys.stdout
     root = root or REPO_ROOT
     t0 = time.perf_counter()
     findings = ast_rules.lint_paths(paths, root=root)
+    if concurrency:
+        from . import concurrency as concurrency_mod
+
+        findings.extend(concurrency_mod.lint_paths(paths, root=root))
+    if contracts:
+        from . import contracts as contracts_mod
+
+        findings.extend(contracts_mod.lint_paths(paths, root=root))
     reports: list = []
     if zoo:
         findings.extend(lint_zoo(rewrite=zoo_rewrite, reports=reports))
@@ -100,7 +115,10 @@ def run(paths, zoo: bool = False, baseline_path: Optional[str] = None,
             print(rep.render(), file=out)
 
     if write_baseline:
-        baseline_mod.save(write_baseline, findings)
+        just = (baseline_mod.load_justifications(baseline_path)
+                if baseline_path and os.path.exists(baseline_path)
+                else None)
+        baseline_mod.save(write_baseline, findings, justifications=just)
         print(f"tpulint: banked {len(findings)} finding(s) to "
               f"{write_baseline}", file=out)
         return 0
@@ -162,6 +180,15 @@ def main(argv=None) -> int:
                     help="lint the zoo AS WRITTEN, without the cost-"
                          "model-gated opt rewrite pass (shows the full "
                          "pre-rewrite debt)")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the C-rules: interprocedural lock-order "
+                         "cycles (C001), blocking-under-lock (C002), "
+                         "thread-lifecycle leaks (C003)")
+    ap.add_argument("--contracts", action="store_true",
+                    help="run the R-rules: swallowed faults (R001), "
+                         "untyped raises (R002), and the code<->docs "
+                         "drift gates for chaos sites, MXNET_TPU_* env "
+                         "vars and metric series (R003)")
     ap.add_argument("--opt-report", action="store_true",
                     help="with --zoo: print each model's rewrite "
                          "decisions (applied + refused, with the cost-"
@@ -191,4 +218,5 @@ def main(argv=None) -> int:
                write_baseline=args.write_baseline, fail_on=args.fail_on,
                fmt=args.fmt, root=args.root,
                zoo_rewrite=args.zoo_rewrite,
-               opt_report=args.opt_report)
+               opt_report=args.opt_report,
+               concurrency=args.concurrency, contracts=args.contracts)

@@ -1,0 +1,743 @@
+"""Concurrency rules (the C* half of tpulint) — AST passes over the
+framework source that make lock discipline *statically visible*.
+
+PRs 9–17 turned the single-process runtime into a cluster of threads:
+Router control loops, replica reapers, heartbeat beaters, autoscaler
+loops, BlockServer accept threads, decode workers. Every hardening pass
+found the same bug classes by hand — ``restart()`` building a replica
+while holding the pool lock (a fleet-wide routing outage), a heartbeat
+thread leaked per restart, a reaper closing the wrong engine. These
+passes catch those classes before runtime:
+
+- **C001 tpu-lock-cycle** — build the interprocedural lock-order graph
+  (every ``threading.Lock``/``RLock``/``Condition`` acquired via
+  ``with`` or ``.acquire()``; an edge A→B when B is taken while A is
+  held, including through direct intra-package calls) and flag every
+  cycle as a potential deadlock.
+- **C002 tpu-blocking-under-lock** — a blocking call under a held lock:
+  socket ``recv``/``accept``/``connect``, ``subprocess`` waits,
+  ``time.sleep``, ``Event.wait``/``Thread.join`` without a timeout, and
+  jit/AOT compile entry points (the exact shape of the PR-11
+  ``restart()`` outage). ``Condition.wait`` on the *held* condition is
+  exempt — it releases the lock by contract.
+- **C003 tpu-thread-leak** — a ``threading.Thread`` started without
+  ``daemon=True`` and without a reachable ``join()`` on the stored
+  handle (the per-restart heartbeat-beater leak class).
+
+Lock identity is structural — ``module.Class.attr`` for instance locks,
+``module.attr`` for module globals — so the graph is stable across line
+edits (baseline keys never carry line numbers). The static graph is
+validated against real executions by :mod:`.lockwatch`, the runtime
+witness armed inside the fleet/io kill drills.
+
+Suppression: the shared ``# tpulint: disable=C002`` inline comment
+grammar from :mod:`.ast_rules` applies to every C rule.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .ast_rules import _suppressions, _suppressed, _unparse, iter_py_files
+from .findings import Finding
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+#: attribute / function names whose call blocks the calling thread.
+#: value = the human label rendered into the finding.
+BLOCKING_ATTRS = {
+    "sleep": "time.sleep",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "accept": "socket accept",
+    "connect": "socket connect",
+    "create_connection": "socket connect",
+    "communicate": "subprocess wait",
+    "check_output": "subprocess wait",
+    "check_call": "subprocess wait",
+    "select": "select wait",
+}
+#: names that block only when called WITHOUT a timeout argument.
+BLOCKING_NO_TIMEOUT_ATTRS = {
+    "wait": "Event/Condition wait",
+    "join": "thread join",
+    "get": "queue get",
+}
+#: compile entry points — a cold build/warm under a lock is the PR-11
+#: fleet outage shape (every router tick blocked behind the build).
+#: ``lower`` only counts when called with arguments (``str.lower()``
+#: takes none); ``re.compile`` is exempt by receiver.
+COMPILE_ATTRS = {
+    "warmup": "AOT warmup",
+    "warm_from_manifest": "AOT manifest warm",
+    "cached_jit": "AOT cached_jit",
+    "lower": "jit lower",
+    "compile": "jit compile",
+}
+#: bare-name calls (module-level function calls) that block.
+BLOCKING_NAMES = {
+    "sleep": "time.sleep",
+    "create_connection": "socket connect",
+    "run": None,  # only blocking as subprocess.run — resolved via module
+}
+
+_MAX_DEPTH = 6  # interprocedural propagation bound (fixpoint iterations)
+
+
+# ---------------------------------------------------------------------------
+# per-function facts collected in one AST walk
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Acquire:
+    lock: str                 # canonical lock id
+    held: Tuple[str, ...]     # locks already held at this point
+    line: int
+    expr: str
+
+
+@dataclass
+class _Call:
+    callees: Tuple[str, ...]  # candidate resolved qualnames
+    held: Tuple[str, ...]
+    line: int
+    expr: str
+    blocking: Optional[str] = None   # human label when the call blocks
+    held_receiver: bool = False      # .wait() ON the held condition
+
+
+@dataclass
+class _FuncFacts:
+    qualname: str             # module.Class.method or module.func
+    relpath: str
+    acquires: List[_Acquire] = field(default_factory=list)
+    calls: List[_Call] = field(default_factory=list)
+    # effects, filled by the fixpoint:
+    may_acquire: Set[str] = field(default_factory=set)
+    may_block: Dict[str, str] = field(default_factory=dict)  # label -> where
+
+
+@dataclass
+class _ThreadStart:
+    relpath: str
+    line: int
+    scope: str
+    target: str               # thread target expr (for the message)
+    attr: Optional[str]       # stored attribute name (self.X = Thread(...))
+    daemon: bool
+    cls: Optional[str]        # owning class qualname, if a method
+
+
+def _module_name(relpath: str) -> str:
+    mod = relpath.replace(os.sep, "/")
+    if mod.endswith(".py"):
+        mod = mod[:-3]
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+class _FileScan(ast.NodeVisitor):
+    """One pass over a file: lock definitions, per-function acquisition /
+    call facts, thread constructions, join/daemon evidence."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.AST):
+        self.relpath = relpath
+        self.module = _module_name(relpath)
+        self.supp = _suppressions(source)
+        # lock ids defined here: attr name -> {owning class or module}
+        # (prescanned so a use may precede the definition in source order
+        # — `step()` above `__init__` in the class body)
+        self.class_locks: Dict[str, Set[str]] = {}   # class -> attr names
+        self.module_locks: Set[str] = set()
+        self._prescan_locks(tree)
+        self.funcs: Dict[str, _FuncFacts] = {}
+        self.threads: List[_ThreadStart] = []
+        # join/daemon evidence: (class qualname or "", attr name)
+        self.joined_attrs: Set[Tuple[str, str]] = set()
+        self.daemon_attrs: Set[Tuple[str, str]] = set()
+        self.imports: Dict[str, str] = {}  # alias -> dotted module
+        self._class_stack: List[str] = []
+        self._func_stack: List[_FuncFacts] = []
+        self._held: List[str] = []
+
+    # -- plumbing ----------------------------------------------------------
+    def _prescan_locks(self, tree: ast.AST) -> None:
+        def walk(node, class_path: List[str], in_func: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, class_path + [child.name], in_func)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    walk(child, class_path, True)
+                else:
+                    if isinstance(child, ast.Assign) and \
+                            self._is_lock_ctor(child.value):
+                        for tgt in child.targets:
+                            if (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"
+                                    and class_path):
+                                self.class_locks.setdefault(
+                                    ".".join(class_path), set()).add(
+                                        tgt.attr)
+                            elif isinstance(tgt, ast.Name) and not in_func:
+                                self.module_locks.add(tgt.id)
+                    walk(child, class_path, in_func)
+
+        walk(tree, [], False)
+
+    def _cls(self) -> str:
+        return ".".join(self._class_stack)
+
+    def _qual(self, name: str) -> str:
+        parts = [self.module] + self._class_stack + [name]
+        return ".".join(parts)
+
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            self.imports[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module or node.level:
+            base = node.module or ""
+            for a in node.names:
+                self.imports[a.asname or a.name] = (
+                    ("." * node.level) + base + "." + a.name
+                    if base else ("." * node.level) + a.name)
+        self.generic_visit(node)
+
+    # -- lock definitions --------------------------------------------------
+    @staticmethod
+    def _is_lock_ctor(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in LOCK_FACTORIES:
+            return True
+        if isinstance(fn, ast.Name) and fn.id in LOCK_FACTORIES:
+            return True
+        return False
+
+    def visit_Assign(self, node: ast.Assign):
+        if self._is_lock_ctor(node.value):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self" and self._class_stack):
+                    self.class_locks.setdefault(
+                        self._cls(), set()).add(tgt.attr)
+                elif isinstance(tgt, ast.Name) and not self._func_stack:
+                    self.module_locks.add(tgt.id)
+        # daemon evidence: self.X.daemon = True
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Attribute) and tgt.attr == "daemon"
+                    and isinstance(tgt.value, ast.Attribute)
+                    and isinstance(tgt.value.value, ast.Name)
+                    and tgt.value.value.id == "self"):
+                self.daemon_attrs.add((self._cls(), tgt.value.attr))
+        self._maybe_thread_assign(node)
+        self.generic_visit(node)
+
+    # -- lock identity at a use site ---------------------------------------
+    def _lock_id(self, node: ast.AST) -> Optional[str]:
+        """Canonical id when ``node`` names a known lock, else None."""
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            cls = self._cls()
+            if node.attr in self.class_locks.get(cls, ()):  # same class
+                return f"{self.module}.{cls}.{node.attr}"
+            return None
+        if isinstance(node, ast.Name) and node.id in self.module_locks:
+            return f"{self.module}.{node.id}"
+        return None
+
+    # -- function facts ----------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node):
+        facts = _FuncFacts(self._qual(node.name), self.relpath)
+        self.funcs[facts.qualname] = facts
+        self._func_stack.append(facts)
+        saved_held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = saved_held
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_With(self, node: ast.With):
+        pushed = []
+        for item in node.items:
+            lock = self._lock_id(item.context_expr)
+            if lock is not None and self._func_stack:
+                self._func_stack[-1].acquires.append(_Acquire(
+                    lock, tuple(self._held), item.context_expr.lineno,
+                    _unparse(item.context_expr)))
+                self._held.append(lock)
+                pushed.append(lock)
+            else:
+                # still walk the context expr for calls/locks inside it
+                self.visit(item.context_expr)
+                if item.optional_vars is not None:
+                    self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in pushed:
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- calls: acquire()/release(), blocking, thread ctor, callees --------
+    def _callee_candidates(self, fn: ast.AST) -> Tuple[str, ...]:
+        # self.m() -> same-class method
+        if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)):
+            base, attr = fn.value.id, fn.attr
+            if base == "self" and self._class_stack:
+                return (f"{self.module}.{self._cls()}.{attr}",)
+            target = self.imports.get(base)
+            if target is not None:
+                return (_resolve_import(self.module, target) + "." + attr,)
+            return ()
+        if isinstance(fn, ast.Name):
+            # bare function in the same module, or imported symbol
+            target = self.imports.get(fn.id)
+            if target is not None:
+                return (_resolve_import(self.module, target),)
+            return (f"{self.module}.{fn.id}",
+                    f"{self.module}.{fn.id}.__init__")
+        return ()
+
+    def _blocking_label(self, node: ast.Call) -> Tuple[Optional[str], bool]:
+        """(label, is-held-receiver-wait) when this call blocks."""
+        fn = node.func
+        timeout_kw = any(
+            kw.arg in ("timeout", "deadline", "timeout_s") or kw.arg is None
+            for kw in node.keywords)
+        if timeout_kw:
+            # a bounded wait (subprocess.run(timeout=), wait(timeout=),
+            # …) cannot wedge the lock holder indefinitely
+            return None, False
+        has_timeout = bool(node.args) or timeout_kw
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in BLOCKING_ATTRS:
+                # socket.recv(n) carries a size arg — args alone don't
+                # make it non-blocking; only wait/join/get use timeouts
+                return BLOCKING_ATTRS[fn.attr], False
+            if fn.attr in COMPILE_ATTRS:
+                recv_is_re = (isinstance(fn.value, ast.Name)
+                              and fn.value.id in ("re", "regex"))
+                str_lower = fn.attr == "lower" and not node.args \
+                    and not node.keywords
+                if not recv_is_re and not str_lower:
+                    return COMPILE_ATTRS[fn.attr], False
+            if fn.attr in BLOCKING_NO_TIMEOUT_ATTRS and not has_timeout:
+                held_recv = self._lock_id(fn.value) in self._held \
+                    if self._held else False
+                return BLOCKING_NO_TIMEOUT_ATTRS[fn.attr], held_recv
+            if fn.attr == "run" and isinstance(fn.value, ast.Name) \
+                    and self.imports.get(fn.value.id, "") == "subprocess":
+                return "subprocess wait", False
+        elif isinstance(fn, ast.Name):
+            target = self.imports.get(fn.id)
+            if fn.id in BLOCKING_NAMES and BLOCKING_NAMES[fn.id]:
+                if target in ("time.sleep", "socket.create_connection") \
+                        or target is None:
+                    return BLOCKING_NAMES[fn.id], False
+        return None, False
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        facts = self._func_stack[-1] if self._func_stack else None
+        # explicit .acquire() — treat as held to end of function scope
+        # (the with-statement is the idiom; bare acquire is approximated)
+        if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+            lock = self._lock_id(fn.value)
+            if lock is not None and facts is not None:
+                facts.acquires.append(_Acquire(
+                    lock, tuple(self._held), node.lineno, _unparse(fn)))
+                if lock not in self._held:
+                    self._held.append(lock)
+        if isinstance(fn, ast.Attribute) and fn.attr == "release":
+            lock = self._lock_id(fn.value)
+            if lock is not None and lock in self._held:
+                self._held.remove(lock)
+        self._maybe_thread_call(node)
+        if facts is not None:
+            label, held_recv = self._blocking_label(node)
+            if label and _suppressed(self.supp, "C002", node.lineno):
+                # origin-site suppression: an annotated deliberate
+                # block (e.g. the chaos delay action) must not taint
+                # every lock-held caller through the fixpoint either
+                label, held_recv = None, False
+            facts.calls.append(_Call(
+                self._callee_candidates(fn), tuple(self._held),
+                node.lineno, _unparse(node), blocking=label,
+                held_receiver=held_recv))
+        self.generic_visit(node)
+
+    # -- thread lifecycle --------------------------------------------------
+    @staticmethod
+    def _is_thread_ctor(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        fn = node.func
+        return (isinstance(fn, ast.Attribute) and fn.attr == "Thread") or (
+            isinstance(fn, ast.Name) and fn.id == "Thread")
+
+    @staticmethod
+    def _thread_kwargs(node: ast.Call) -> Tuple[bool, str]:
+        daemon = False
+        target = ""
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            if kw.arg == "target":
+                target = _unparse(kw.value)
+        return daemon, target
+
+    def _maybe_thread_assign(self, node: ast.Assign):
+        if not self._is_thread_ctor(node.value):
+            return
+        daemon, target = self._thread_kwargs(node.value)
+        attr = None
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                attr = tgt.attr
+            elif isinstance(tgt, ast.Name):
+                attr = tgt.id
+        self.threads.append(_ThreadStart(
+            self.relpath, node.value.lineno, self._scope_name(), target,
+            attr, daemon, self._cls() or None))
+
+    def _maybe_thread_call(self, node: ast.Call):
+        # anonymous start: threading.Thread(...).start() or a bare ctor
+        # call used as an expression / argument
+        if self._is_thread_ctor(node):
+            parent_handled = False
+            # assignment-target ctors are handled in visit_Assign
+            # (ast gives no parent pointer; detect by recording the line)
+            for t in self.threads:
+                if t.line == node.lineno and t.relpath == self.relpath:
+                    parent_handled = True
+            if not parent_handled:
+                daemon, target = self._thread_kwargs(node)
+                self.threads.append(_ThreadStart(
+                    self.relpath, node.lineno, self._scope_name(), target,
+                    None, daemon, self._cls() or None))
+        fn = node.func
+        # join evidence: self.X.join(...) / X.join(...)
+        if isinstance(fn, ast.Attribute) and fn.attr == "join":
+            obj = fn.value
+            if (isinstance(obj, ast.Attribute)
+                    and isinstance(obj.value, ast.Name)
+                    and obj.value.id == "self"):
+                self.joined_attrs.add((self._cls(), obj.attr))
+            elif isinstance(obj, ast.Name):
+                # a bare local only counts as joined within its own
+                # function — `t.join()` elsewhere must not absolve
+                # every thread that happens to be named `t`
+                self.joined_attrs.add((f"scope:{self._scope_name()}",
+                                       obj.id))
+
+    def _scope_name(self) -> str:
+        parts = list(self._class_stack)
+        if self._func_stack:
+            parts.append(self._func_stack[-1].qualname.split(".")[-1])
+        return ".".join(parts) or "<module>"
+
+
+def _resolve_import(module: str, target: str) -> str:
+    """Resolve a (possibly relative) import target against ``module``."""
+    if not target.startswith("."):
+        return target
+    level = len(target) - len(target.lstrip("."))
+    base = module.split(".")
+    base = base[: len(base) - level] if level <= len(base) else []
+    rest = target.lstrip(".")
+    return ".".join(base + ([rest] if rest else []))
+
+
+# ---------------------------------------------------------------------------
+# corpus analysis: fixpoint over call graph, lock-order graph, findings
+# ---------------------------------------------------------------------------
+
+class Analysis:
+    """The whole-corpus concurrency model tpulint queries."""
+
+    def __init__(self):
+        self.funcs: Dict[str, _FuncFacts] = {}
+        self.threads: List[_ThreadStart] = []
+        self.joined: Set[Tuple[str, str]] = set()
+        self.daemon: Set[Tuple[str, str]] = set()
+        self.supp: Dict[str, Dict[int, Set[str]]] = {}
+        # lock-order graph: (a, b) -> list of (relpath, line, via)
+        self.edges: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+
+    # -- interprocedural effects ------------------------------------------
+    def _fixpoint(self):
+        for _ in range(_MAX_DEPTH):
+            changed = False
+            for facts in self.funcs.values():
+                acq = {a.lock for a in facts.acquires}
+                blk = {}
+                for c in facts.calls:
+                    if c.blocking and not c.held_receiver:
+                        blk.setdefault(
+                            c.blocking, f"{facts.relpath}:{c.line}")
+                    for callee in c.callees:
+                        callee_facts = self._lookup(callee)
+                        if callee_facts is None:
+                            continue
+                        acq |= callee_facts.may_acquire
+                        for label, where in callee_facts.may_block.items():
+                            blk.setdefault(label, where)
+                if acq - facts.may_acquire:
+                    facts.may_acquire |= acq
+                    changed = True
+                for label, where in blk.items():
+                    if label not in facts.may_block:
+                        facts.may_block[label] = where
+                        changed = True
+            if not changed:
+                break
+
+    def _lookup(self, qualname: str) -> Optional[_FuncFacts]:
+        facts = self.funcs.get(qualname)
+        if facts is not None:
+            return facts
+        # Class(...) resolves to Class.__init__
+        return self.funcs.get(qualname + ".__init__")
+
+    def _add_edge(self, a: str, b: str, relpath: str, line: int, via: str):
+        if a == b:
+            return  # RLock re-entry / same-lock nesting is not an order
+        self.edges.setdefault((a, b), []).append((relpath, line, via))
+
+    def build(self):
+        self._fixpoint()
+        for facts in self.funcs.values():
+            for acq in facts.acquires:
+                for held in acq.held:
+                    self._add_edge(held, acq.lock, facts.relpath, acq.line,
+                                   f"direct in {facts.qualname}")
+            for call in facts.calls:
+                if not call.held:
+                    continue
+                for callee in call.callees:
+                    callee_facts = self._lookup(callee)
+                    if callee_facts is None:
+                        continue
+                    for lock in callee_facts.may_acquire:
+                        for held in call.held:
+                            self._add_edge(
+                                held, lock, facts.relpath, call.line,
+                                f"via {callee_facts.qualname}")
+
+    # -- cycles ------------------------------------------------------------
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles in the lock-order graph (deduped by the
+        cycle's canonical rotation)."""
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        seen: Set[Tuple[str, ...]] = set()
+        out: List[List[str]] = []
+
+        def dfs(start: str, node: str, path: List[str],
+                visited: Set[str]):
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    canon = _canonical(path)
+                    if canon not in seen:
+                        seen.add(canon)
+                        out.append(list(path))
+                elif nxt not in visited and len(path) < 8:
+                    visited.add(nxt)
+                    path.append(nxt)
+                    dfs(start, nxt, path, visited)
+                    path.pop()
+                    visited.discard(nxt)
+
+        for start in sorted(graph):
+            dfs(start, start, [start], {start})
+        return out
+
+
+def _canonical(cycle: Sequence[str]) -> Tuple[str, ...]:
+    i = cycle.index(min(cycle))
+    return tuple(cycle[i:]) + tuple(cycle[:i])
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def scan_paths(paths: Sequence[str], root: Optional[str] = None
+               ) -> Analysis:
+    root = root or os.getcwd()
+    ana = Analysis()
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(path, root)
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue  # ast_rules reports A000 for this file
+        scan = _FileScan(rel, text, tree)
+        scan.visit(tree)
+        ana.funcs.update(scan.funcs)
+        ana.threads.extend(scan.threads)
+        ana.joined |= scan.joined_attrs
+        ana.daemon |= scan.daemon_attrs
+        ana.supp[rel] = scan.supp
+    ana.build()
+    return ana
+
+
+def _sup(ana: Analysis, rule: str, relpath: str, line: int) -> bool:
+    return _suppressed(ana.supp.get(relpath, {}), rule, line)
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None
+               ) -> List[Finding]:
+    """Run C001/C002/C003 over files/directories."""
+    ana = scan_paths(paths, root=root)
+    findings: List[Finding] = []
+    findings.extend(_c001(ana))
+    findings.extend(_c002(ana))
+    findings.extend(_c003(ana))
+    return findings
+
+
+def _c001(ana: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    for cycle in ana.cycles():
+        ring = cycle + [cycle[0]]
+        sites = []
+        suppressed = False
+        for a, b in zip(ring, ring[1:]):
+            occ = ana.edges.get((a, b))
+            if occ:
+                rel, line, via = occ[0]
+                sites.append(f"{a}->{b} ({rel}:{line} {via})")
+                if _sup(ana, "C001", rel, line):
+                    suppressed = True
+        if suppressed:
+            continue
+        rel, line = "", 0
+        first = ana.edges.get((ring[0], ring[1]))
+        if first:
+            rel, line, _ = first[0]
+        out.append(Finding(
+            "C001",
+            "lock-order cycle (potential deadlock): "
+            + " -> ".join(ring),
+            path=rel, line=line, scope="lock-graph",
+            detail="cycle:" + "->".join(_canonical(cycle)),
+            hint="pick one global order for these locks, or release the "
+                 "outer lock before taking the inner one; edges: "
+                 + "; ".join(sites)))
+    return out
+
+
+def _c002(ana: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    for facts in ana.funcs.values():
+        for call in facts.calls:
+            if not call.held:
+                continue
+            label = call.blocking
+            if label and not call.held_receiver:
+                if _sup(ana, "C002", facts.relpath, call.line):
+                    continue
+                out.append(Finding(
+                    "C002",
+                    f"blocking call ({label}) while holding "
+                    f"{_short(call.held[-1])}: `{call.expr}`",
+                    path=facts.relpath, line=call.line,
+                    scope=_scope_of(facts.qualname),
+                    detail=f"block:{label}:{_short(call.held[-1])}"
+                           f":{call.expr[:40]}",
+                    hint="move the blocking work outside the lock "
+                         "(snapshot state under the lock, then block), "
+                         "or bound it with a timeout"))
+                continue
+            # interprocedural: callee blocks while we hold a lock
+            for callee in call.callees:
+                cf = ana._lookup(callee)
+                if cf is None or not cf.may_block:
+                    continue
+                if _sup(ana, "C002", facts.relpath, call.line):
+                    continue
+                blabel, where = next(iter(sorted(cf.may_block.items())))
+                out.append(Finding(
+                    "C002",
+                    f"call into `{_short(callee)}` which blocks "
+                    f"({blabel}, {where}) while holding "
+                    f"{_short(call.held[-1])}",
+                    path=facts.relpath, line=call.line,
+                    scope=_scope_of(facts.qualname),
+                    detail=f"block-via:{_short(callee)}:{blabel}"
+                           f":{_short(call.held[-1])}",
+                    hint="hoist the call out of the locked region or "
+                         "split the callee so its blocking half runs "
+                         "lock-free"))
+                break
+    return out
+
+
+def _c003(ana: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    for t in ana.threads:
+        if t.daemon:
+            continue
+        owner = t.cls or ""
+        if t.attr is not None:
+            if (owner, t.attr) in ana.joined \
+                    or (f"scope:{t.scope}", t.attr) in ana.joined:
+                continue
+            if (owner, t.attr) in ana.daemon:
+                continue
+        if _sup(ana, "C003", t.relpath, t.line):
+            continue
+        what = f"target={t.target}" if t.target else "thread"
+        handle = f"self.{t.attr}" if t.attr and t.cls else (t.attr or
+                                                           "<anonymous>")
+        out.append(Finding(
+            "C003",
+            f"non-daemon Thread ({what}) stored as {handle} is never "
+            "joined — leaks one thread per start and can hang "
+            "interpreter shutdown",
+            path=t.relpath, line=t.line, scope=t.scope,
+            detail=f"thread:{handle}:{t.target[:40]}",
+            hint="pass daemon=True, or keep a stop event + join() the "
+                 "handle in the owner's close()/stop() path"))
+    return out
+
+
+def _short(qual: str) -> str:
+    parts = qual.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 2 else qual
+
+
+def _scope_of(qualname: str) -> str:
+    parts = qualname.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else qualname
+
+
+__all__ = ["lint_paths", "scan_paths", "Analysis"]

@@ -1,0 +1,462 @@
+"""Contract-drift rules (the R* half of tpulint) — source lint plus a
+code↔docs cross-check over the resilience and observability contracts.
+
+The docs are load-bearing here: ``docs/resilience.md`` is the chaos-site
+catalog operators arm campaigns from, ``docs/env_var.md`` is the knob
+contract, ``docs/observability.md`` is the metric catalog dashboards and
+SLO rules are written against. Eight PRs of cluster growth added sites,
+knobs and series by hand in both places — these rules make the two
+halves provably agree.
+
+- **R001 tpu-swallowed-except** — a bare / ``except Exception`` handler
+  in a retry/collective path whose body neither re-raises nor calls
+  anything (no logging, no counter, no cleanup) — a fault silently
+  eaten where the typed-taxonomy retry loops need to see it.
+- **R002 tpu-untyped-raise** — ``raise RuntimeError/Exception`` in a
+  module bound to the typed taxonomy (it imports ``TransientError`` /
+  ``FatalError`` from ``base``). Operational faults must be typed so
+  retry classifiers and drills can route them; ``ValueError`` /
+  ``TypeError`` stay exempt (API misuse is the caller's bug by
+  contract).
+- **R003 tpu-contract-drift** — three-way drift gates, each direction a
+  distinct finding:
+
+  - chaos sites instrumented via ``chaos.site("…")`` / declared in
+    ``chaos.SITES`` vs the ``docs/resilience.md`` site table;
+  - ``MXNET_TPU_*`` env vars read in code (``os.environ`` or the
+    ``base.env_*`` helpers, literal names) vs ``docs/env_var.md`` rows;
+  - telemetry series registered with literal names
+    (``registry.counter/gauge/histogram`` and ``profiler.Counter``,
+    dot-sanitized) vs the ``docs/observability.md`` metric catalog
+    (tables whose first header cell is ``Series``).
+
+  Dynamically-named series (``f"aot.{name}"``) are statically
+  invisible; their doc rows are banked in the baseline with a recorded
+  justification instead of being deleted.
+
+Suppression: the shared ``# tpulint: disable=R001`` grammar from
+:mod:`.ast_rules` applies to R001/R002 (R003 findings live between
+files — bank them in the baseline instead).
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .ast_rules import _suppressions, _suppressed, _unparse, iter_py_files
+from .findings import Finding
+
+#: modules whose except-handlers are retry/collective paths (R001).
+R001_PATH_PREFIXES = (
+    "mxnet_tpu/resilience/", "mxnet_tpu/parallel/", "mxnet_tpu/kvstore/",
+    "mxnet_tpu/io/", "mxnet_tpu/serving/", "mxnet_tpu/checkpoint.py",
+)
+
+#: untyped builtins whose raise is an operational fault (R002). API
+#: misuse types (ValueError/TypeError/KeyError/NotImplementedError)
+#: are exempt by the fleet contract: client/config errors propagate.
+R002_UNTYPED = {"RuntimeError", "Exception", "BaseException"}
+
+#: scopes where best-effort swallowing is the teardown contract: a
+#: close/reaper path must make progress past a half-dead peer, so an
+#: empty ``except Exception: pass`` there is by design, not drift.
+_TEARDOWN_RE = re.compile(
+    r"^_*(safe_)?(close|shutdown|stop|abort|teardown|cancel|drain|"
+    r"reset|clear|del|exit)(_|$)")
+
+_ENV_RE = re.compile(r"^MXNET_TPU_[A-Z0-9_]+$")
+_ENV_HELPER_RE = re.compile(r"^_?env_[a-z]+$")
+_NAME_TOKEN_RE = re.compile(r"^[a-z][a-z0-9_.]*\*?$")
+_DOC_TOKEN_RE = re.compile(r"`([^`]+)`")
+
+
+# ---------------------------------------------------------------------------
+# code inventory
+# ---------------------------------------------------------------------------
+
+class CodeInventory:
+    def __init__(self):
+        # name -> (relpath, line) of the first occurrence
+        self.env_reads: Dict[str, Tuple[str, int]] = {}
+        self.sites: Dict[str, Tuple[str, int]] = {}
+        self.metrics: Dict[str, Tuple[str, int]] = {}
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _scan_file(rel: str, tree: ast.AST, inv: CodeInventory) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            arg0 = _const_str(node.args[0]) if node.args else None
+            # env reads: os.environ.get / os.getenv / environ.setdefault
+            # and the base.env_* typed helpers
+            is_env_call = False
+            if isinstance(fn, ast.Attribute) and fn.attr in (
+                    "get", "getenv", "setdefault"):
+                base = fn.value
+                if (isinstance(base, ast.Attribute)
+                        and base.attr == "environ") or (
+                        isinstance(base, ast.Name)
+                        and base.id in ("os", "environ")):
+                    is_env_call = True
+            elif isinstance(fn, ast.Name) and _ENV_HELPER_RE.match(fn.id):
+                is_env_call = True
+            elif isinstance(fn, ast.Attribute) and _ENV_HELPER_RE.match(
+                    fn.attr):
+                is_env_call = True
+            if is_env_call and arg0 and _ENV_RE.match(arg0):
+                inv.env_reads.setdefault(arg0, (rel, node.lineno))
+            # chaos sites: chaos.site("…") / site("…")
+            if ((isinstance(fn, ast.Attribute) and fn.attr == "site")
+                    or (isinstance(fn, ast.Name) and fn.id == "site")):
+                if arg0:
+                    inv.sites.setdefault(arg0, (rel, node.lineno))
+            # metric series: registry counter/gauge/histogram literals
+            if isinstance(fn, ast.Attribute) and fn.attr in (
+                    "counter", "gauge", "histogram") and arg0:
+                inv.metrics.setdefault(arg0, (rel, node.lineno))
+            # profiler.Counter(name="a.b") — re-registered as a gauge
+            # with dots sanitized to underscores
+            if ((isinstance(fn, ast.Attribute) and fn.attr == "Counter")
+                    or (isinstance(fn, ast.Name) and fn.id == "Counter")):
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name = _const_str(kw.value)
+                        if name:
+                            inv.metrics.setdefault(
+                                name.replace(".", "_"),
+                                (rel, node.lineno))
+        # a knob bound to an UPPERCASE constant (read indirectly, e.g.
+        # lockwatch.ENV_KNOB) still names a live env-var contract
+        if isinstance(node, ast.Assign):
+            name = _const_str(node.value)
+            if name and _ENV_RE.match(name):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id.isupper():
+                        inv.env_reads.setdefault(name, (rel, node.lineno))
+        # the declared SITES tuple in resilience/chaos.py
+        if (isinstance(node, ast.Assign) and rel.replace(os.sep, "/")
+                .endswith("resilience/chaos.py")):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "SITES" and \
+                        isinstance(node.value, ast.Tuple):
+                    for elt in node.value.elts:
+                        name = _const_str(elt)
+                        if name:
+                            inv.sites.setdefault(name, (rel, elt.lineno))
+
+
+def scan_code(paths: Sequence[str], root: str) -> CodeInventory:
+    inv = CodeInventory()
+    scan_paths = list(paths)
+    # tools/ and benchmark/ participate in the env-var contract (bench
+    # knobs are documented too) but tests do not — a test-only var is
+    # not a product contract
+    for extra in ("tools", "benchmark"):
+        d = os.path.join(root, extra)
+        if os.path.isdir(d):
+            scan_paths.append(d)
+    for path in iter_py_files(scan_paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        _scan_file(os.path.relpath(path, root), tree, inv)
+    return inv
+
+
+# ---------------------------------------------------------------------------
+# doc-table parsing
+# ---------------------------------------------------------------------------
+
+def _doc_rows(text: str) -> List[Tuple[str, str, int]]:
+    """Yield ``(header_first_cell, row_first_cell, lineno)`` for every
+    data row of every pipe table in a markdown text."""
+    rows: List[Tuple[str, str, int]] = []
+    lines = text.splitlines()
+    header: Optional[str] = None
+    for i, line in enumerate(lines):
+        if not line.lstrip().startswith("|"):
+            header = None
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if not cells:
+            continue
+        if all(re.fullmatch(r":?-{2,}:?", c) for c in cells if c):
+            continue  # the |---| separator
+        nxt = lines[i + 1].strip() if i + 1 < len(lines) else ""
+        if nxt.startswith("|") and re.fullmatch(
+                r"\|?[\s:|-]+\|?", nxt) and "-" in nxt:
+            header = cells[0]
+            continue
+        if header is not None:
+            rows.append((header, cells[0], i + 1))
+    return rows
+
+
+def _tokens(cell: str) -> List[str]:
+    return _DOC_TOKEN_RE.findall(cell)
+
+
+def doc_env_vars(text: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for header, cell, line in _doc_rows(text):
+        if header.lower() != "variable":
+            continue
+        for tok in _tokens(cell):
+            if _ENV_RE.match(tok) or (tok.startswith("MXNET_TPU_")
+                                      and tok.endswith("*")):
+                out.setdefault(tok, line)
+    return out
+
+
+def doc_sites(text: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for header, cell, line in _doc_rows(text):
+        if header.lower() != "site":
+            continue
+        for tok in _tokens(cell):
+            if _NAME_TOKEN_RE.match(tok):
+                out.setdefault(tok, line)
+    return out
+
+
+def doc_metrics(text: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for header, cell, line in _doc_rows(text):
+        if header.lower() != "series":
+            continue
+        for tok in _tokens(cell):
+            tok = tok.split("{", 1)[0].strip()
+            if tok and _NAME_TOKEN_RE.match(tok):
+                out.setdefault(tok, line)
+    return out
+
+
+def _read_doc(docs_dir: str, name: str) -> Tuple[str, str]:
+    path = os.path.join(docs_dir, name)
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read(), path
+    except OSError:
+        return "", path
+
+
+# ---------------------------------------------------------------------------
+# R003: the three drift gates
+# ---------------------------------------------------------------------------
+
+def _covered(name: str, documented: Dict[str, int]) -> bool:
+    if name in documented:
+        return True
+    return any(fnmatch.fnmatchcase(name, pat)
+               for pat in documented if pat.endswith("*"))
+
+
+def _emitted(name: str, emitted: Dict[str, Tuple[str, int]]) -> bool:
+    if name.endswith("*"):
+        return any(fnmatch.fnmatchcase(e, name) for e in emitted)
+    return name in emitted
+
+
+def lint_drift(inv: CodeInventory, docs_dir: str,
+               root: str) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def drift(kind: str, code: Dict[str, Tuple[str, int]],
+              documented: Dict[str, int], doc_rel: str,
+              undoc_hint: str, stale_hint: str):
+        for name, (rel, line) in sorted(code.items()):
+            if not _covered(name, documented):
+                findings.append(Finding(
+                    "R003",
+                    f"{kind} `{name}` exists in code but has no "
+                    f"{doc_rel} row",
+                    path=rel, line=line, scope=f"drift:{kind}",
+                    detail=f"{kind}-undoc:{name}", hint=undoc_hint))
+        for name, line in sorted(documented.items()):
+            if name.endswith("*"):
+                continue
+            if not _emitted(name, code):
+                findings.append(Finding(
+                    "R003",
+                    f"{doc_rel} documents {kind} `{name}` but nothing "
+                    "in code produces it",
+                    path=doc_rel, line=line, scope=f"drift:{kind}",
+                    detail=f"{kind}-stale:{name}", hint=stale_hint))
+
+    env_text, _ = _read_doc(docs_dir, "env_var.md")
+    drift("env-var", inv.env_reads, doc_env_vars(env_text),
+          "docs/env_var.md",
+          "add a row to the docs/env_var.md knob table (Variable / "
+          "Default / Effect)",
+          "the knob is gone or renamed — delete the row, or bank with "
+          "a justification if it is read dynamically")
+
+    res_text, _ = _read_doc(docs_dir, "resilience.md")
+    drift("chaos-site", inv.sites, doc_sites(res_text),
+          "docs/resilience.md",
+          "add a row to the docs/resilience.md chaos-site table "
+          "(Site / Location) describing what each action simulates",
+          "no chaos.site() call or SITES entry carries this name — "
+          "delete the row or re-instrument the site")
+
+    obs_text, _ = _read_doc(docs_dir, "observability.md")
+    drift("metric", inv.metrics, doc_metrics(obs_text),
+          "docs/observability.md",
+          "add the series to the docs/observability.md metric catalog "
+          "(Series / Kind / Source)",
+          "no literal registration produces this series — delete the "
+          "row, or bank with a justification when the name is built "
+          "dynamically (f-string counter families)")
+
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R001 / R002
+# ---------------------------------------------------------------------------
+
+class _ContractLinter(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str, taxonomy_bound: bool):
+        self.relpath = relpath
+        self.supp = _suppressions(source)
+        self.taxonomy_bound = taxonomy_bound
+        self.findings: List[Finding] = []
+        self.scope_stack: List[str] = []
+
+    def _scope(self) -> str:
+        return ".".join(self.scope_stack) or "<module>"
+
+    def _emit(self, rule: str, node: ast.AST, message: str, detail: str,
+              hint: str):
+        line = getattr(node, "lineno", 0)
+        if _suppressed(self.supp, rule, line):
+            return
+        self.findings.append(Finding(
+            rule, message, path=self.relpath, line=line,
+            scope=self._scope(), detail=detail, hint=hint))
+
+    def _push(self, node):
+        self.scope_stack.append(node.name)
+        self.generic_visit(node)
+        self.scope_stack.pop()
+
+    visit_ClassDef = _push
+    visit_FunctionDef = _push
+    visit_AsyncFunctionDef = _push
+
+    # R001 ------------------------------------------------------------------
+    @staticmethod
+    def _overbroad(handler: ast.ExceptHandler) -> Optional[str]:
+        if handler.type is None:
+            return "bare except"
+        if isinstance(handler.type, ast.Name) and handler.type.id in (
+                "Exception", "BaseException"):
+            return f"except {handler.type.id}"
+        return None
+
+    @staticmethod
+    def _swallows(handler: ast.ExceptHandler) -> bool:
+        """True when the handler body has no raise and calls nothing —
+        the fault vanishes without a log line, a counter, or cleanup."""
+        for sub in ast.walk(handler):
+            if isinstance(sub, (ast.Raise, ast.Call)):
+                return False
+        return True
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        in_scope = any(
+            self.relpath.replace(os.sep, "/").startswith(pfx)
+            for pfx in R001_PATH_PREFIXES)
+        teardown = any(_TEARDOWN_RE.match(part)
+                       for part in self.scope_stack)
+        kind = self._overbroad(node)
+        if in_scope and not teardown and kind and self._swallows(node):
+            self._emit(
+                "R001", node,
+                f"{kind} swallows the fault silently in a "
+                "retry/collective path",
+                detail=f"swallow:{self._scope()}",
+                hint="re-raise, classify into the typed taxonomy "
+                     "(TransientError/FatalError), or at least log/count "
+                     "the fault so drills can see it")
+        self.generic_visit(node)
+
+    # R002 ------------------------------------------------------------------
+    def visit_Raise(self, node: ast.Raise):
+        if self.taxonomy_bound and node.exc is not None:
+            target = node.exc
+            if isinstance(target, ast.Call):
+                target = target.func
+            if isinstance(target, ast.Name) and target.id in R002_UNTYPED:
+                self._emit(
+                    "R002", node,
+                    f"raise of untyped `{target.id}` in a module bound "
+                    "to the typed error taxonomy",
+                    detail=f"untyped:{target.id}:{self._scope()}",
+                    hint="raise TransientError (retryable) or FatalError "
+                         "(not) from mxnet_tpu.base so retry loops and "
+                         "drills can classify it")
+        self.generic_visit(node)
+
+
+def _taxonomy_bound(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            names = {a.name for a in node.names}
+            if names & {"TransientError", "FatalError"}:
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               docs_dir: Optional[str] = None) -> List[Finding]:
+    """Run R001/R002 over files and R003 against the docs contract
+    tables (``docs_dir`` defaults to ``<root>/docs``; pass ``""`` to
+    skip the drift gates)."""
+    root = root or os.getcwd()
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(path, root)
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue  # ast_rules reports A000
+        linter = _ContractLinter(rel, text, _taxonomy_bound(tree))
+        linter.visit(tree)
+        findings.extend(linter.findings)
+    if docs_dir is None:
+        docs_dir = os.path.join(root, "docs")
+    if docs_dir and os.path.isdir(docs_dir):
+        inv = scan_code(paths, root)
+        findings.extend(lint_drift(inv, docs_dir, root))
+    return findings
+
+
+__all__ = [
+    "lint_paths", "scan_code", "lint_drift", "CodeInventory",
+    "doc_env_vars", "doc_sites", "doc_metrics",
+]

@@ -46,6 +46,24 @@ RULES: Dict[str, tuple] = {
                    "absent from every jit cache key"),
     "A003": (LOW, "tpu-f64-source: float64 dtype literal in framework "
                   "source"),
+    # concurrency (C*) — AST + the lockwatch runtime witness
+    "C001": (HIGH, "tpu-lock-cycle: cycle in the interprocedural "
+                   "lock-order graph — a potential deadlock"),
+    "C002": (HIGH, "tpu-blocking-under-lock: blocking call (socket/"
+                   "subprocess/sleep/untimed wait/compile) while a lock "
+                   "is held — the PR-11 restart() outage shape"),
+    "C003": (HIGH, "tpu-thread-leak: non-daemon Thread started without "
+                   "a reachable join — leaks one thread per start"),
+    # contract drift (R*) — AST + docs cross-check
+    "R001": (MEDIUM, "tpu-swallowed-except: bare/overbroad except that "
+                     "swallows without re-raising or logging in a "
+                     "retry/collective path"),
+    "R002": (MEDIUM, "tpu-untyped-raise: raise of an untyped builtin "
+                     "operational exception in a module bound to the "
+                     "TransientError/FatalError taxonomy"),
+    "R003": (HIGH, "tpu-contract-drift: chaos sites / MXNET_TPU_* env "
+                   "vars / telemetry series out of sync between code "
+                   "and the docs contract tables"),
 }
 
 

@@ -1,0 +1,255 @@
+"""Runtime lock-order witness — the dynamic half of the C-rules.
+
+:mod:`.concurrency` builds the *static* lock-order graph; this module
+records the *observed* one. :func:`install` patches the
+``threading.Lock`` / ``RLock`` / ``Condition`` factories so every lock
+subsequently created **by mxnet_tpu code** (caller-frame filter — the
+stdlib's own locks stay untouched) is wrapped in a thin proxy that
+notes, per thread, which locks were already held at each acquisition.
+Each (held → acquired) pair becomes an edge in a global order graph;
+:func:`assert_acyclic` then proves no execution interleaving witnessed
+an order inversion — the same property C001 checks statically, now
+validated against real drills.
+
+Lock identity is the *creation site* (``file:line``), so every replica's
+``ReplicaPool._lock`` instance aggregates into one node, mirroring the
+static analysis' structural naming.
+
+Usage — armed opt-in inside tier-1 kill drills::
+
+    from mxnet_tpu.analysis import lockwatch
+    lockwatch.install()            # or MXNET_TPU_LOCKWATCH=1 + install_if_env()
+    try:
+        ...run the drill...
+        lockwatch.assert_acyclic()
+    finally:
+        lockwatch.uninstall()
+
+The proxy only observes: acquisition semantics (blocking, timeout,
+``with``) pass straight through, and ``Condition.wait()``'s internal
+release/re-acquire happens below the proxy — per-thread stacks stay
+consistent because a waiting thread acquires nothing else meanwhile.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "install", "uninstall", "installed", "install_if_env", "reset",
+    "edges", "cycles", "assert_acyclic", "report", "ENV_KNOB",
+]
+
+#: opt-in knob: set to 1/true to arm the witness via install_if_env().
+ENV_KNOB = "MXNET_TPU_LOCKWATCH"
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_state_guard = threading.Lock()      # created before install(): raw lock
+_tls = threading.local()
+
+_installed = False
+_orig: Dict[str, object] = {}
+#: (held_site, acquired_site) -> observation count
+_edges: Dict[Tuple[str, str], int] = {}
+#: site -> number of proxied locks created there
+_sites: Dict[str, int] = {}
+
+
+def _caller_site() -> Optional[str]:
+    """Creation site of the lock being constructed, or None when the
+    caller is not mxnet_tpu code (stdlib, site-packages, tests)."""
+    f = sys._getframe(2)
+    fn = f.f_code.co_filename
+    try:
+        if os.path.commonpath([os.path.abspath(fn), _PKG_DIR]) != _PKG_DIR:
+            return None
+    except ValueError:
+        return None
+    rel = os.path.relpath(fn, os.path.dirname(_PKG_DIR))
+    if rel.replace(os.sep, "/").startswith("mxnet_tpu/analysis/"):
+        return None  # never watch the watcher
+    return f"{rel.replace(os.sep, '/')}:{f.f_lineno}"
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _note_acquire(site: str) -> None:
+    stack = _held_stack()
+    if stack:
+        with _state_guard:
+            for held in stack:
+                if held != site:  # RLock re-entry is not an inversion
+                    key = (held, site)
+                    _edges[key] = _edges.get(key, 0) + 1
+    stack.append(site)
+
+
+def _note_release(site: str) -> None:
+    stack = _held_stack()
+    # locks may release out of LIFO order — drop the innermost match
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] == site:
+            del stack[i]
+            break
+
+
+class _LockProxy:
+    """Order-recording wrapper over a Lock/RLock/Condition instance."""
+
+    __slots__ = ("_inner", "_site")
+
+    def __init__(self, inner, site: str):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_site", site)
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            _note_acquire(self._site)
+        return got
+
+    def release(self, *args, **kwargs):
+        self._inner.release(*args, **kwargs)
+        _note_release(self._site)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        # wait/notify/locked/_is_owned/… delegate to the real object
+        return getattr(object.__getattribute__(self, "_inner"), name)
+
+    def __repr__(self):
+        return f"<lockwatch {self._site} wrapping {self._inner!r}>"
+
+
+def _wrap_factory(name: str):
+    orig = _orig[name]
+
+    def factory(*args, **kwargs):
+        inner = orig(*args, **kwargs)
+        site = _caller_site()
+        if site is None:
+            return inner
+        with _state_guard:
+            _sites[site] = _sites.get(site, 0) + 1
+        return _LockProxy(inner, site)
+
+    factory.__name__ = f"lockwatch_{name}"
+    return factory
+
+
+def install() -> None:
+    """Patch the threading lock factories. Idempotent."""
+    global _installed
+    if _installed:
+        return
+    for name in ("Lock", "RLock", "Condition"):
+        _orig[name] = getattr(threading, name)
+    for name in ("Lock", "RLock", "Condition"):
+        setattr(threading, name, _wrap_factory(name))
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the original factories (already-wrapped locks keep
+    recording until they are garbage collected — harmless)."""
+    global _installed
+    if not _installed:
+        return
+    for name, orig in _orig.items():
+        setattr(threading, name, orig)
+    _orig.clear()
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def install_if_env(env: str = ENV_KNOB) -> bool:
+    """Arm the witness when ``MXNET_TPU_LOCKWATCH`` is truthy — the
+    opt-in path tier-1 drills use."""
+    val = os.environ.get(env, "").strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        install()
+        return True
+    return False
+
+
+def reset() -> None:
+    """Forget all observed edges and sites (keeps the patch armed)."""
+    with _state_guard:
+        _edges.clear()
+        _sites.clear()
+
+
+def edges() -> Dict[Tuple[str, str], int]:
+    with _state_guard:
+        return dict(_edges)
+
+
+def cycles() -> List[List[str]]:
+    """Elementary cycles in the observed order graph (canonical
+    rotation, deduplicated) — each is a witnessed deadlock candidate."""
+    graph: Dict[str, List[str]] = {}
+    with _state_guard:
+        for a, b in _edges:
+            graph.setdefault(a, []).append(b)
+    out: List[List[str]] = []
+    seen = set()
+
+    def canonical(path: List[str]) -> Tuple[str, ...]:
+        i = path.index(min(path))
+        return tuple(path[i:] + path[:i])
+
+    def dfs(start: str, node: str, path: List[str], visited: set):
+        for nxt in graph.get(node, ()):
+            if nxt == start:
+                key = canonical(path)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(list(key))
+            elif nxt not in visited and len(path) < 8:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for node in sorted(graph):
+        dfs(node, node, [node], {node})
+    return out
+
+
+def assert_acyclic() -> None:
+    """Raise ``AssertionError`` when any lock-order cycle was observed."""
+    cyc = cycles()
+    if cyc:
+        lines = [" -> ".join(c + [c[0]]) for c in cyc]
+        raise AssertionError(
+            "lockwatch observed lock-order cycle(s) — a real execution "
+            "acquired these locks in inverted orders:\n  "
+            + "\n  ".join(lines))
+
+
+def report() -> dict:
+    with _state_guard:
+        rep = {
+            "installed": _installed,
+            "sites": dict(_sites),
+            "edges": {f"{a} -> {b}": n for (a, b), n in _edges.items()},
+        }
+    rep["cycles"] = cycles()
+    return rep

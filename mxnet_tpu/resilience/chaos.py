@@ -195,7 +195,9 @@ def _visit(name: str, rules: List[_Rule], ctx: dict) -> None:
         if rule.action == "delay":
             dur = float(rule.arg)
             _emit_profiler(name, "delay", dur)
-            time.sleep(dur)
+            # the sleep IS the injected fault — callers holding locks
+            # through a chaos site are exercising, not leaking, latency
+            time.sleep(dur)  # tpulint: disable=C002
             continue  # latency composes with later rules
         if rule.action == "kill":
             # pod-eviction semantics: no atexit, no buffers flushed. 137

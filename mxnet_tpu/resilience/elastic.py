@@ -1130,7 +1130,7 @@ class ElasticSupervisor(Supervisor):
 #: sentinel for Supervisor(manager=...) before the first rendezvous
 class _Pending:
     def __getattr__(self, name):
-        raise RuntimeError(
+        raise FatalError(
             "ElasticSupervisor: call start()/run_steps() first — the "
             "coordinated checkpoint manager exists only after the "
             "generation-0 rendezvous fixes this rank's membership index")

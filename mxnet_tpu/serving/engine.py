@@ -520,10 +520,15 @@ class InferenceEngine:
             lambda a: a.block_until_ready()
             if hasattr(a, "block_until_ready") else a, out)
         with self._warm_lock:
-            if key not in self._warm_buckets:  # counted on SUCCESS only:
+            record = key not in self._warm_buckets
+            if record:  # counted on SUCCESS only:
                 self.metrics.count("compiles")  # retries don't inflate
                 self._warm_buckets.add(key)
-                self._record_warmup(bucket, item_shape, dtype, staged)
+        if record:
+            # outside _warm_lock: the manifest append re-enters
+            # _get_exec and may COMPILE — holding the lock through a
+            # compile wedges every concurrent first-bucket request (C002)
+            self._record_warmup(bucket, item_shape, dtype, staged)
         return out
 
     def _record_warmup(self, bucket: int, item_shape: Tuple[int, ...],

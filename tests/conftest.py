@@ -51,6 +51,25 @@ def _seed_rng(request):
     print(f"[test seed: {seed}]")
 
 
+@pytest.fixture
+def lockwatch_armed(monkeypatch):
+    """Opt-in runtime lock-order witness (the C001 property checked
+    against a real execution): arms ``analysis.lockwatch`` through its
+    env knob for the drill, yields the module, and asserts on teardown
+    that no lock-order cycle was observed."""
+    from mxnet_tpu.analysis import lockwatch
+
+    monkeypatch.setenv(lockwatch.ENV_KNOB, "1")
+    assert lockwatch.install_if_env()
+    lockwatch.reset()
+    try:
+        yield lockwatch
+        lockwatch.assert_acyclic()
+    finally:
+        lockwatch.uninstall()
+        lockwatch.reset()
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "seed(n): fix the RNG seed for a test")
     config.addinivalue_line("markers", "serial: run test serially")

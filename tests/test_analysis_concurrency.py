@@ -1,0 +1,448 @@
+"""tpulint C-/R-rule analyzers: one seeded anti-pattern fixture per
+rule with a clean twin (each rule must fire exactly at the seeded site
+and stay quiet everywhere else), the contract drift gates against
+synthetic docs tables, and the lockwatch runtime witness detecting a
+deliberately inverted acquisition order."""
+import os
+import textwrap
+import threading
+
+import pytest
+
+import mxnet_tpu
+from mxnet_tpu.analysis import concurrency, contracts, lockwatch
+
+PKG_DIR = os.path.dirname(os.path.abspath(mxnet_tpu.__file__))
+
+
+def write_tree(root, files):
+    for rel, src in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return root
+
+
+def keys(findings, rule):
+    return sorted((f.path, f.scope) for f in findings if f.rule == rule)
+
+
+# ---------------------------------------------------------------------------
+# C001: lock-order cycles
+# ---------------------------------------------------------------------------
+
+def test_c001_cycle_fires_and_clean_twin_quiet(tmp_path):
+    write_tree(tmp_path, {
+        "cyc.py": """\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def ab():
+                with A:
+                    with B:
+                        pass
+
+            def ba():
+                with B:
+                    with A:
+                        pass
+            """,
+        "clean.py": """\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def ab():
+                with A:
+                    with B:
+                        pass
+
+            def ab_again():
+                with A:
+                    with B:
+                        pass
+            """,
+    })
+    fs = concurrency.lint_paths([str(tmp_path)], root=str(tmp_path))
+    c001 = [f for f in fs if f.rule == "C001"]
+    assert c001, "seeded lock-order cycle not detected"
+    assert all(f.path == "cyc.py" for f in c001)
+    assert any("cyc.A" in f.detail and "cyc.B" in f.detail for f in c001)
+
+
+def test_c001_interprocedural_cycle(tmp_path):
+    """The PR-11 class: each function takes only one lock directly —
+    the inversion exists only through the call graph."""
+    write_tree(tmp_path, {
+        "ipc.py": """\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def take_b():
+                with B:
+                    pass
+
+            def take_a():
+                with A:
+                    pass
+
+            def outer_ab():
+                with A:
+                    take_b()
+
+            def outer_ba():
+                with B:
+                    take_a()
+            """,
+    })
+    fs = concurrency.lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert any(f.rule == "C001" for f in fs), (
+        "cycle through intra-module calls missed")
+
+
+# ---------------------------------------------------------------------------
+# C002: blocking under a held lock
+# ---------------------------------------------------------------------------
+
+def test_c002_blocking_under_lock_exact_site(tmp_path):
+    write_tree(tmp_path, {
+        "blk.py": """\
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def bad():
+                with L:
+                    time.sleep(0.5)
+
+            def good_outside():
+                time.sleep(0.5)
+                with L:
+                    x = 1
+
+            def good_bounded(ev):
+                with L:
+                    ev.wait(timeout=1.0)
+
+            def good_suppressed():
+                with L:
+                    time.sleep(0.5)  # tpulint: disable=C002
+            """,
+    })
+    fs = concurrency.lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert keys(fs, "C002") == [("blk.py", "blk.bad")]
+
+
+def test_c002_interprocedural_and_compile_entry(tmp_path):
+    write_tree(tmp_path, {
+        "via.py": """\
+            import threading
+            import socket
+
+            L = threading.Lock()
+
+            def fetch(sock):
+                return sock.recv(1024)
+
+            def bad_via():
+                with L:
+                    fetch(None)
+
+            def bad_compile(fn, args):
+                with L:
+                    return fn.lower(*args).compile()
+            """,
+    })
+    fs = concurrency.lint_paths([str(tmp_path)], root=str(tmp_path))
+    scopes = {f.scope for f in fs if f.rule == "C002"}
+    assert "via.bad_via" in scopes, "blocking callee under lock missed"
+    assert "via.bad_compile" in scopes, "jit compile under lock missed"
+
+
+# ---------------------------------------------------------------------------
+# C003: thread-lifecycle leaks
+# ---------------------------------------------------------------------------
+
+def test_c003_leaked_thread_fires_twins_quiet(tmp_path):
+    write_tree(tmp_path, {
+        "thr.py": """\
+            import threading
+
+            def leak():
+                t = threading.Thread(target=print)
+                t.start()
+
+            def ok_daemon():
+                t = threading.Thread(target=print, daemon=True)
+                t.start()
+
+            def ok_joined():
+                t = threading.Thread(target=print)
+                t.start()
+                t.join()
+            """,
+    })
+    fs = concurrency.lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert keys(fs, "C003") == [("thr.py", "leak")]
+
+
+# ---------------------------------------------------------------------------
+# R001 / R002
+# ---------------------------------------------------------------------------
+
+def test_r001_swallowed_except_in_retry_path(tmp_path):
+    # R001 is scoped to retry/collective paths — mirror the package
+    # layout so the path prefix matches
+    write_tree(tmp_path, {
+        "mxnet_tpu/resilience/fx.py": """\
+            def retry_step():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+            def logged_step():
+                try:
+                    work()
+                except Exception:
+                    log_fault()
+
+            def close():
+                try:
+                    work()
+                except Exception:
+                    pass
+            """,
+        "mxnet_tpu/gluon/fx.py": """\
+            def out_of_scope():
+                try:
+                    work()
+                except Exception:
+                    pass
+            """,
+    })
+    fs = contracts.lint_paths([str(tmp_path / "mxnet_tpu")],
+                              root=str(tmp_path))
+    assert keys(fs, "R001") == [
+        ("mxnet_tpu/resilience/fx.py", "retry_step")]
+
+
+def test_r002_untyped_raise_in_taxonomy_module(tmp_path):
+    write_tree(tmp_path, {
+        "typed.py": """\
+            from mxnet_tpu.base import TransientError
+
+            def fault():
+                raise RuntimeError("boom")
+
+            def api_misuse(x):
+                raise ValueError(x)
+
+            def typed_fault():
+                raise TransientError("retryable")
+            """,
+        "unbound.py": """\
+            def fault():
+                raise RuntimeError("not taxonomy-bound: allowed")
+            """,
+    })
+    fs = contracts.lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert keys(fs, "R002") == [("typed.py", "fault")]
+
+
+# ---------------------------------------------------------------------------
+# R003: drift gates against synthetic docs
+# ---------------------------------------------------------------------------
+
+def test_r003_env_var_drift_both_directions(tmp_path):
+    write_tree(tmp_path, {
+        "code/knobs.py": """\
+            import os
+
+            def read():
+                os.environ.get("MXNET_TPU_FAKE_KNOB")
+                os.environ.get("MXNET_TPU_DOCUMENTED")
+            """,
+        "docs/env_var.md": """\
+            | Variable | Default | Effect |
+            |---|---|---|
+            | `MXNET_TPU_DOCUMENTED` | unset | in sync |
+            | `MXNET_TPU_GHOST` | unset | nothing reads this anymore |
+            """,
+    })
+    fs = contracts.lint_paths([str(tmp_path / "code")],
+                              root=str(tmp_path),
+                              docs_dir=str(tmp_path / "docs"))
+    details = {f.detail for f in fs if f.rule == "R003"}
+    assert "env-var-undoc:MXNET_TPU_FAKE_KNOB" in details
+    assert "env-var-stale:MXNET_TPU_GHOST" in details
+    assert not any("MXNET_TPU_DOCUMENTED" in d for d in details)
+    # undoc anchors on the reading code, stale on the doc row
+    by_detail = {f.detail: f for f in fs if f.rule == "R003"}
+    assert by_detail["env-var-undoc:MXNET_TPU_FAKE_KNOB"].path \
+        == "code/knobs.py"
+    assert by_detail["env-var-stale:MXNET_TPU_GHOST"].path \
+        == "docs/env_var.md"
+
+
+def test_r003_metric_drift_with_wildcard_and_labels(tmp_path):
+    write_tree(tmp_path, {
+        "code/m.py": """\
+            def register(reg):
+                reg.counter("fx_ok_total", "in sync", ("label",))
+                reg.gauge("fx_undoc", "missing from the catalog")
+                reg.gauge("fx_fam_depth", "covered by the wildcard row")
+            """,
+        "docs/observability.md": """\
+            | Series | Kind | Source |
+            |---|---|---|
+            | `fx_ok_total{label}` | counter | in sync |
+            | `fx_fam_*` | gauge | family row |
+            | `fx_ghost` | gauge | nothing emits this |
+            """,
+    })
+    fs = contracts.lint_paths([str(tmp_path / "code")],
+                              root=str(tmp_path),
+                              docs_dir=str(tmp_path / "docs"))
+    details = {f.detail for f in fs if f.rule == "R003"}
+    assert details == {"metric-undoc:fx_undoc", "metric-stale:fx_ghost"}
+
+
+def test_r003_chaos_site_drift(tmp_path):
+    write_tree(tmp_path, {
+        "code/sites.py": """\
+            from resilience import chaos
+
+            def step():
+                chaos.site("fx.documented")
+                chaos.site("fx.undocumented")
+            """,
+        "docs/resilience.md": """\
+            | Site | Location |
+            |---|---|
+            | `fx.documented` | sites.py |
+            | `fx.ghost` | deleted module |
+            """,
+    })
+    fs = contracts.lint_paths([str(tmp_path / "code")],
+                              root=str(tmp_path),
+                              docs_dir=str(tmp_path / "docs"))
+    details = {f.detail for f in fs if f.rule == "R003"}
+    assert "chaos-site-undoc:fx.undocumented" in details
+    assert "chaos-site-stale:fx.ghost" in details
+    assert not any("fx.documented" in d for d in details)
+
+
+# ---------------------------------------------------------------------------
+# lockwatch: the runtime witness
+# ---------------------------------------------------------------------------
+
+def _package_frame_locks(count):
+    """Create `count` locks from a code object whose filename lies
+    inside the package tree, so the caller-site filter wraps them —
+    without writing a file into the installed package."""
+    lines = ["import threading"] + [
+        f"l{i} = threading.Lock()" for i in range(count)]
+    code = compile("\n".join(lines),
+                   os.path.join(PKG_DIR, "virtual_lockwatch_fixture.py"),
+                   "exec")
+    ns = {}
+    exec(code, ns)
+    return [ns[f"l{i}"] for i in range(count)]
+
+
+@pytest.fixture
+def armed_lockwatch():
+    lockwatch.install()
+    lockwatch.reset()
+    yield lockwatch
+    lockwatch.uninstall()
+    lockwatch.reset()
+
+
+def test_lockwatch_detects_inverted_order(armed_lockwatch):
+    a, b = _package_frame_locks(2)
+    assert isinstance(a, lockwatch._LockProxy), (
+        "package-created lock was not wrapped")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:   # the inversion
+            pass
+    assert lockwatch.cycles(), "inverted acquisition order not observed"
+    with pytest.raises(AssertionError, match="lock-order cycle"):
+        lockwatch.assert_acyclic()
+
+
+def test_lockwatch_consistent_order_stays_clean(armed_lockwatch):
+    a, b = _package_frame_locks(2)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockwatch.cycles() == []
+    lockwatch.assert_acyclic()
+    # the edge itself was recorded (witness actually watched)
+    assert len(lockwatch.edges()) == 1
+
+
+def test_lockwatch_ignores_foreign_locks(armed_lockwatch):
+    lk = threading.Lock()  # created from test code, not the package
+    assert not isinstance(lk, lockwatch._LockProxy)
+
+
+def test_lockwatch_env_arming(monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV_KNOB, "0")
+    assert lockwatch.install_if_env() is False
+    assert not lockwatch.installed()
+    monkeypatch.setenv(lockwatch.ENV_KNOB, "1")
+    try:
+        assert lockwatch.install_if_env() is True
+        assert lockwatch.installed()
+    finally:
+        lockwatch.uninstall()
+        lockwatch.reset()
+
+
+def test_lockwatch_uninstall_restores_factories():
+    before = (threading.Lock, threading.RLock, threading.Condition)
+    lockwatch.install()
+    try:
+        assert threading.Lock is not before[0]
+    finally:
+        lockwatch.uninstall()
+    assert (threading.Lock, threading.RLock,
+            threading.Condition) == before
+
+
+def test_lockwatch_condition_wait_under_proxy(armed_lockwatch):
+    """A proxied Condition must keep its wait/notify contract (the
+    internal release/re-acquire happens below the proxy)."""
+    src = "import threading\ncond = threading.Condition()"
+    code = compile(src, os.path.join(PKG_DIR, "virtual_cond_fixture.py"),
+                   "exec")
+    ns = {}
+    exec(code, ns)
+    cond = ns["cond"]
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=1.0)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    with cond:
+        hits.append(1)
+        cond.notify()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    lockwatch.assert_acyclic()

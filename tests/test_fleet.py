@@ -316,7 +316,8 @@ def test_drain_then_restart_rejoins_rotation():
 # THE acceptance drill: chaos-kill 1 of 3 replicas mid-load
 # ---------------------------------------------------------------------------
 @pytest.mark.chaos
-def test_fleet_drill_kill_one_of_three_under_load(tmp_path):
+def test_fleet_drill_kill_one_of_three_under_load(tmp_path,
+                                                  lockwatch_armed):
     """The ISSUE 12 acceptance drill (the serving twin of the elastic
     kill-1-of-4): 3 replicas under sustained mixed-tenant load, chaos
     kills one mid-flight (``serving.fleet.replica`` fatal) ->
@@ -326,7 +327,9 @@ def test_fleet_drill_kill_one_of_three_under_load(tmp_path):
     - in-flight work on the dead replica is re-admitted elsewhere;
     - p99 during kill/recovery stays bounded vs steady state;
     - the survivor fleet converges to steady serving;
-    - the fleet gauges and the flight dump name the dead replica.
+    - the fleet gauges and the flight dump name the dead replica;
+    - lockwatch (armed via ``MXNET_TPU_LOCKWATCH``) observes zero
+      lock-order cycles through kill + recovery (fixture teardown).
     """
     flight_dir = str(tmp_path / "flight")
     telemetry.flight.arm(flight_dir)

@@ -212,12 +212,15 @@ def _kill_while_holding_unserved_claim(svc, wid, timeout_s=60.0):
 
 
 @pytest.mark.integration
-def test_kill_decode_worker_mid_epoch_exactly_once(tmp_path):
+def test_kill_decode_worker_mid_epoch_exactly_once(tmp_path,
+                                                   lockwatch_armed):
     """Acceptance: a real worker process SIGKILLed mid-epoch; the
     survivor absorbs its unserved range via the exactly-once re-dispatch
     marker, the epoch output is bitwise-identical to the sequential
     shard union with zero lost / zero duplicated batches, and the dead
-    worker is named in a flight dump carrying the io_service gauges."""
+    worker is named in a flight dump carrying the io_service gauges.
+    Lockwatch rides along (``MXNET_TPU_LOCKWATCH``) and asserts zero
+    observed lock-order cycles through the kill + re-dispatch."""
     from mxnet_tpu.io.service import DatasetService, SyntheticSource
     from mxnet_tpu.telemetry import flight
     from mxnet_tpu.telemetry.registry import get_registry

@@ -494,16 +494,18 @@ def test_cli_fail_on_none(tmp_path):
 
 
 def test_self_lint_gate():
-    """Tier-1 gate: tpulint over mxnet_tpu/ + the model zoo with the
-    banked baseline — new high-severity findings fail this test (and so
-    fail CI). The zoo trace is the expensive half (~25 s on CPU, within
-    the < 60 s acceptance budget); without it the jaxpr rules never run
-    in CI and the banked zoo entries can only go stale."""
+    """Tier-1 gate: tpulint over mxnet_tpu/ + the model zoo + the
+    concurrency and contract rule families, against the banked
+    baseline — new high-severity findings fail this test (and so fail
+    CI). The zoo trace is the expensive half (~25 s on CPU, within the
+    < 60 s acceptance budget); without it the jaxpr rules never run in
+    CI and the banked zoo entries can only go stale."""
     from mxnet_tpu.analysis import cli
 
     buf = io.StringIO()
     rc = cli.run(
         [os.path.join(ROOT, "mxnet_tpu")], zoo=True,
+        concurrency=True, contracts=True,
         baseline_path=os.path.join(ROOT, "tools", "tpulint_baseline.json"),
         fail_on="high", fmt="json", out=buf)
     payload = json.loads(buf.getvalue())
@@ -511,11 +513,12 @@ def test_self_lint_gate():
         "new high-severity tpulint findings:\n"
         + json.dumps(payload["new"], indent=1)
         + "\nfix them or re-bank with tools/tpulint.py --zoo "
+          "--concurrency --contracts "
           "--write-baseline tools/tpulint_baseline.json")
     assert payload["stale_baseline_entries"] == 0, (
         "baseline entries no longer produced — re-bank with "
-        "tools/tpulint.py mxnet_tpu --zoo --write-baseline "
-        "tools/tpulint_baseline.json")
+        "tools/tpulint.py mxnet_tpu --zoo --concurrency --contracts "
+        "--write-baseline tools/tpulint_baseline.json")
 
 
 def test_baseline_diff_counts():
@@ -531,6 +534,45 @@ def test_baseline_diff_counts():
     assert len(new) == 1 and stale == 0  # second occurrence is NEW
     new, stale = bl.diff([], banked)
     assert new == [] and stale == 1      # fixed finding shows as stale
+
+
+def test_baseline_justification_roundtrip(tmp_path):
+    """Justified survivors keep their recorded reason through
+    save -> load, the object form and the bare-count form coexist, and
+    a justified entry that stops firing still shows as stale."""
+    from mxnet_tpu.analysis import baseline as bl
+    from mxnet_tpu.analysis.findings import Finding
+
+    f1 = Finding("C002", "block", path="a.py", scope="f", detail="block:x")
+    f2 = Finding("R001", "swallow", path="b.py", scope="g",
+                 detail="swallow:g")
+    path = str(tmp_path / "baseline.json")
+    bl.save(path, [f1, f2],
+            justifications={f1.key: "single-flight compile by design"})
+
+    raw = json.load(open(path))["findings"]
+    assert raw[f1.key] == {"count": 1,
+                           "justification":
+                               "single-flight compile by design"}
+    assert raw[f2.key] == 1              # unjustified debt stays bare
+
+    assert bl.load(path) == {f1.key: 1, f2.key: 1}
+    assert bl.load_justifications(path) == {
+        f1.key: "single-flight compile by design"}
+
+    new, stale = bl.diff([f2], bl.load(path))
+    assert new == [] and stale == 1      # justified-but-gone is stale too
+
+
+def test_cli_lists_new_rule_families(capsys):
+    """--list-rules renders the C- and R-families from the one RULES
+    catalog (what docs/static_analysis.md is generated against)."""
+    from mxnet_tpu.analysis import cli
+
+    assert cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("C001", "C002", "C003", "R001", "R002", "R003"):
+        assert rule in out
 
 
 # ---------------------------------------------------------------------------

@@ -83,6 +83,16 @@ MODULES = {
                               "model, knob autotuner",
     "mxnet_tpu.analysis": "tpulint — TPU anti-pattern analyzer "
                           "(jaxpr + AST rules, runtime sentinel)",
+    "mxnet_tpu.analysis.concurrency": "concurrency lint: interprocedural "
+                                      "lock-order cycles, blocking-under-"
+                                      "lock, thread-lifecycle leaks",
+    "mxnet_tpu.analysis.contracts": "contract lint: swallowed/untyped "
+                                    "fault handling, code-vs-docs drift "
+                                    "gates (chaos sites, env vars, "
+                                    "metrics)",
+    "mxnet_tpu.analysis.lockwatch": "runtime lock-order witness: "
+                                    "threading factory wrap, per-thread "
+                                    "held-stack edges, cycle assertion",
     "mxnet_tpu.aot": "persistent compile cache + ahead-of-time warmup",
     "mxnet_tpu.resilience": "chaos injection, retry + transient-vs-fatal "
                             "classifier, watchdog, supervised training",

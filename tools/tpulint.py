@@ -3,12 +3,19 @@
 
 The CI self-lint gate runs::
 
-    python tools/tpulint.py mxnet_tpu --zoo \
+    python tools/tpulint.py mxnet_tpu --zoo --concurrency --contracts \
         --baseline tools/tpulint_baseline.json
 
-Refresh the banked debt ledger after fixing findings::
+``--concurrency`` adds the C-rules (lock-order cycles, blocking under a
+held lock, thread-lifecycle leaks); ``--contracts`` adds the R-rules
+(swallowed faults, untyped raises, and the code<->docs drift gates for
+chaos sites, MXNET_TPU_* env vars and metric series).
 
-    python tools/tpulint.py mxnet_tpu --zoo \
+Refresh the banked debt ledger after fixing findings (justification
+strings recorded in ``--baseline`` are carried forward)::
+
+    python tools/tpulint.py mxnet_tpu --zoo --concurrency --contracts \
+        --baseline tools/tpulint_baseline.json \
         --write-baseline tools/tpulint_baseline.json
 
 Rule catalog and baseline workflow: ``docs/static_analysis.md``.
