@@ -851,6 +851,36 @@ def capture_autoscale() -> None:
             f"lost={rec.get('lost_requests')}")
 
 
+KV_ECONOMY = os.path.join(HERE, "results_kv_economy_tpu.json")
+
+
+def capture_kv_economy() -> None:
+    """Cluster-wide KV economy row (ISSUE 19,
+    benchmark/kv_economy_bench.py): fleet prefix hit rate with
+    prefix-affinity routing on vs off, resumed-session TTFT via host-RAM
+    spill re-attach vs re-prefill, and effective context capacity with
+    the spill tier armed — the CPU row
+    (results_kv_economy_cpu.json) proved the mechanics and the
+    zero-loss drills; the TPU row is where re-attach is a real
+    HBM DMA against a real prefill matmul."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "kv_economy_bench.py")],
+        timeout=2400)
+    rec = parse_json_output(out)
+    if bank_if_tpu(KV_ECONOMY, rec, rc, "kv economy bench") and rec:
+        m = {r.get("metric"): r.get("value")
+             for r in rec.get("metrics", ())}
+        log(f"kv-economy: cluster prefix hit rate "
+            f"{m.get('cluster_prefix_hit_rate_affinity_on')} (affinity) vs "
+            f"{m.get('cluster_prefix_hit_rate_affinity_off')} (off), "
+            f"resumed TTFT {m.get('resumed_ttft_reattach_ms')} ms "
+            f"(re-attach) vs {m.get('resumed_ttft_reprefill_ms')} ms "
+            f"(re-prefill), effective context "
+            f"{m.get('effective_context_blocks_spill')} vs "
+            f"{m.get('effective_context_blocks_hbm')} blocks, "
+            f"lost={rec.get('lost_requests')}")
+
+
 GSPMD = os.path.join(HERE, "results_gspmd_tpu.json")
 
 
@@ -1404,6 +1434,7 @@ CAPTURES = (
     ("gspmd", banked_stale(GSPMD), capture_gspmd),
     ("io-service", banked_stale(IO_SERVICE), capture_io_service),
     ("io-net", banked_stale(IO_NET), capture_io_net),
+    ("kv-economy", banked_stale(KV_ECONOMY), capture_kv_economy),
     ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION, 4 * 3600), capture_attention),

@@ -285,6 +285,12 @@ class BlockServer:
                 name = str(req.get("name", ""))
                 try:
                     blob = self._resolver(name)
+                except KeyError:
+                    # a dict-backed resolver's natural miss: a lookup
+                    # that isn't there is NOT_FOUND (the client's
+                    # try_fetch -> None path), not a server fault that
+                    # should feed endpoint failover and breakers
+                    blob = None
                 except Exception as e:  # noqa: BLE001 — answered, not fatal
                     self._send(
                         conn, T_ERR,

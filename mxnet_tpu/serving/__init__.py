@@ -25,6 +25,11 @@ model (ROADMAP: "serves heavy traffic from millions of users"):
   the closed sense→decide→actuate control loop: SLO violations +
   derived cluster gauges in, hysteresis (up-fast/down-slow) decisions,
   warm-pool scale-up (AOT manifest replay, not cold compile) out;
+- :mod:`.kv_hash` / :class:`KVSpillTier` (:mod:`.kv_spill`) — the
+  cluster-wide KV economy: ONE chain-hash discipline shared by the
+  engine prefix cache, the router's prefix-affinity dispatch and the
+  tiered spill hierarchy (HBM → pinned host RAM → content-addressed
+  disk → remote peer over the block-transfer plane);
 - :mod:`.bench` — the N-concurrent-synthetic-clients harness behind
   ``tools/serve_bench.py``.
 
@@ -39,6 +44,8 @@ from .engine import InferenceEngine  # noqa: F401
 from .fleet import (CircuitBreaker, FleetRequest, ModelSpec,  # noqa: F401
                     Replica, ReplicaPool, ReplicaUnavailable, Router,
                     TenantConfig)
+from .kv_hash import chain_hashes, hash_hex, prefix_key  # noqa: F401
+from .kv_spill import KVSpillTier  # noqa: F401
 from .llm import GenRequest, LLMEngine  # noqa: F401
 from .metrics import Histogram, ServingMetrics  # noqa: F401
 
@@ -64,4 +71,8 @@ __all__ = [
     "ReplicaUnavailable",
     "Autoscaler",
     "AutoscalePolicy",
+    "chain_hashes",
+    "prefix_key",
+    "hash_hex",
+    "KVSpillTier",
 ]

@@ -228,7 +228,7 @@ class Autoscaler:
         is wired (``cluster_*`` quantities — stale processes already
         excluded by the scraper), else the pool's live gauges."""
         free = cap = None
-        tok_s = blocks_free = starved = None
+        tok_s = blocks_free = starved = prefix_hit = None
         if self.scraper is not None:
             snap = self.scraper.scrape_guarded()
             c = (snap or {}).get("cluster") or {}
@@ -237,6 +237,11 @@ class Autoscaler:
             tok_s = c.get("tok_s_total")
             blocks_free = c.get("llm_pool_blocks_free_total")
             starved = c.get("input_starved_frac")
+            # observability only — the decide loop keys on capacity/
+            # free_frac exactly as before; KV spill parks blocks in
+            # HOST RAM, so it changes neither fleet_capacity_units nor
+            # any quota, and must never read as extra HBM headroom
+            prefix_hit = c.get("prefix_hit_rate")
         if not cap:
             # no cluster signal (no scraper, or the root has no router
             # exposition yet): the pool's own live gauges
@@ -247,7 +252,8 @@ class Autoscaler:
         return {"free_units": free, "capacity_units": cap,
                 "free_frac": free_frac, "tok_s": tok_s,
                 "pool_blocks_free": blocks_free,
-                "input_starved_frac": starved}
+                "input_starved_frac": starved,
+                "prefix_hit_rate": prefix_hit}
 
     # -- decide + actuate --------------------------------------------------
     def step(self) -> Optional[str]:

@@ -57,6 +57,7 @@ import json
 import logging
 import os
 import shutil
+import hashlib
 import subprocess
 import sys
 import tempfile
@@ -134,6 +135,34 @@ def fleet_breaker_cooldown_s() -> float:
     return env_float("MXNET_TPU_FLEET_BREAKER_COOLDOWN_S", 2.0)
 
 
+def fleet_affinity_on() -> bool:
+    """``MXNET_TPU_FLEET_AFFINITY`` (default 1 — prefix-affinity
+    routing on LLM fleets; 0 = pure least-loaded)."""
+    return env_float("MXNET_TPU_FLEET_AFFINITY", 1) != 0
+
+
+def fleet_affinity_blocks() -> int:
+    """``MXNET_TPU_FLEET_AFFINITY_BLOCKS`` (default 4 leading blocks
+    hashed into the affinity key)."""
+    return int(env_float("MXNET_TPU_FLEET_AFFINITY_BLOCKS", 4))
+
+
+def fleet_affinity_block_size() -> int:
+    """``MXNET_TPU_FLEET_AFFINITY_BLOCK_SIZE`` — MUST match the
+    engines' KV block size or affinity keys drift from cache keys
+    (default: the engine default, ``MXNET_TPU_LLM_BLOCK_SIZE`` / 16)."""
+    return int(env_float("MXNET_TPU_FLEET_AFFINITY_BLOCK_SIZE",
+                         env_float("MXNET_TPU_LLM_BLOCK_SIZE", 16)))
+
+
+def fleet_affinity_max_load() -> float:
+    """``MXNET_TPU_FLEET_AFFINITY_MAX_LOAD`` (default 0.85): the
+    affinity target's load fraction above which dispatch falls back to
+    least-loaded — cache locality must never queue behind a saturated
+    replica."""
+    return env_float("MXNET_TPU_FLEET_AFFINITY_MAX_LOAD", 0.85)
+
+
 class ReplicaUnavailable(TransientError):
     """No healthy replica could take (or keep) this request. Transient:
     the fleet may heal (breaker closes, replica restarts, capacity
@@ -204,15 +233,17 @@ class FleetRequest(Request):
 
     __slots__ = ("tenant", "key", "max_new_tokens", "eos_token",
                  "on_token", "units", "readmits", "hedges", "attempt_n",
-                 "trace", "model")
+                 "trace", "model", "akey")
 
     def __init__(self, prompt, max_new_tokens: int, tenant: str,
                  deadline: Optional[float], units: int,
                  eos_token: Optional[int], on_token: Optional[Callable],
-                 model: Optional[str] = None):
+                 model: Optional[str] = None,
+                 akey: Optional[bytes] = None):
         super().__init__(prompt, 1, ("fleet",), deadline)
         self.tenant = tenant
         self.model = model
+        self.akey = akey   # prefix-affinity key (kv_hash.prefix_key)
         self.key = f"{tenant}-{next(_req_seq)}"
         # request-scoped distributed trace, minted HERE (the cluster's
         # front door): every attempt — original, hedge twin,
@@ -1083,6 +1114,11 @@ class ReplicaPool:
                     r.state = HEALTHY     # recovered straggler rejoins
                     r.state_reason = "recovered"
             self._publish_states()
+        # membership edge, outside the lock like every scale event: the
+        # router's prefix-affinity map must drop a dead member NOW, not
+        # on the next activate/drain
+        for r in newly_dead:
+            self._notify_scale("dead", r.name)
         return newly_dead
 
     def _mark_dead(self, r: Replica, reason: str) -> None:
@@ -1118,10 +1154,14 @@ class ReplicaPool:
         fail typed and re-home through the router; pool state is freed
         by the background reaper)."""
         r = self.get(name)
+        killed = False
         with self._lock:
             if r.state != DEAD:
                 self._mark_dead(r, "killed (drill)")
                 self._publish_states()
+                killed = True
+        if killed:
+            self._notify_scale("dead", r.name)
         return r
 
     def drain(self, name: str, timeout_s: float = 30.0) -> Replica:
@@ -1198,8 +1238,9 @@ class ReplicaPool:
     def on_scale(self, fn: Callable[[str, str], None]) -> None:
         """Subscribe to membership scale events: ``fn(event, replica)``
         fires (outside the pool lock) on ``spare_added`` /
-        ``activated`` / ``added`` / ``drained`` — the router rebalances
-        tenant quotas on this edge, the autoscaler logs it."""
+        ``activated`` / ``added`` / ``drained`` / ``dead`` — the router
+        rebalances tenant quotas and rebuilds its prefix-affinity map
+        on this edge, the autoscaler logs it."""
         self._scale_subs.append(fn)
 
     def _notify_scale(self, event: str, replica: str) -> None:
@@ -1362,9 +1403,31 @@ class Router:
                  readmit_limit: int = 1, hedge_limit: int = 1,
                  pressure_free_frac: float = 0.25,
                  default_timeout_ms: Optional[float] = None,
-                 poll_s: float = 0.002):
+                 poll_s: float = 0.002,
+                 affinity: Optional[bool] = None,
+                 affinity_blocks: Optional[int] = None,
+                 affinity_block_size: Optional[int] = None,
+                 affinity_max_load: Optional[float] = None):
         self.pool = pool
         self.metrics = pool.metrics
+        # prefix-affinity routing (LLM fleets only — fixed-shape
+        # engines have no KV to be affine to): requests sharing their
+        # leading prompt blocks dispatch to the same replica, so the
+        # fleet's prefix caches specialize instead of each holding a
+        # diluted copy of every prefix
+        self._aff_on = ((bool(affinity) if affinity is not None
+                         else fleet_affinity_on())
+                        and pool.kind == "llm")
+        self._aff_blocks = int(affinity_blocks
+                               if affinity_blocks is not None
+                               else fleet_affinity_blocks())
+        self._aff_bs = int(affinity_block_size
+                           if affinity_block_size is not None
+                           else fleet_affinity_block_size())
+        self._aff_max_load = float(affinity_max_load
+                                   if affinity_max_load is not None
+                                   else fleet_affinity_max_load())
+        self._affinity_members: Tuple[str, ...] = ()
         self._tenants: Dict[str, TenantConfig] = {
             t.name: t for t in (tenants or [])}
         self._tenants.setdefault("default", TenantConfig("default"))
@@ -1398,15 +1461,17 @@ class Router:
             "fleet_tenant_quota_units",
             "Weighted-fair tenant quota against live capacity "
             "(rebalanced on every scale event)", ("fleet", "tenant"))
-        # quota rebalance on every scale event: _quota() reads LIVE
-        # capacity so admission is always current, but the published
-        # gauges (what the autoscaler/bench/operator read) refresh on
-        # the membership edge, not lazily on the next submit
-        pool.on_scale(lambda event, replica: self._publish_quotas())
+        # quota rebalance + affinity-map rebuild on every scale event:
+        # _quota() reads LIVE capacity so admission is always current,
+        # but the published gauges (what the autoscaler/bench/operator
+        # read) and the prefix->replica membership refresh on the
+        # membership edge, not lazily on the next submit
+        pool.on_scale(self._on_scale_event)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"fleet-router:{pool.name}")
         self._thread.start()
         self._publish_quotas()
+        self._rebuild_affinity()
 
     # -- admission ---------------------------------------------------------
     def _tenant(self, name: str) -> TenantConfig:
@@ -1431,6 +1496,31 @@ class Router:
             self._quota_gauge.labels(
                 fleet=self.pool.name, tenant=t).set(self._quota(cfg))
         self.metrics.count("quota_rebalanced")
+
+    def _on_scale_event(self, event: str, replica: str) -> None:
+        self._publish_quotas()
+        self._rebuild_affinity()
+
+    # -- prefix affinity ---------------------------------------------------
+    def _rebuild_affinity(self) -> None:
+        """Recompute the consistent prefix->replica membership on a
+        scale/death edge. The member set (not an explicit key map) IS
+        the routing table: rendezvous hashing over it means a member's
+        death remaps only the keys that member owned — every other
+        session keeps its replica and its warm KV."""
+        members = tuple(sorted(r.name for r in self.pool.healthy()))
+        if members != self._affinity_members:
+            self._affinity_members = members
+            self.metrics.count("affinity_rebuilds")
+
+    def _affinity_target(self, akey: bytes) -> Optional[str]:
+        """Rendezvous (highest-random-weight) hash of the affinity key
+        over the healthy member set."""
+        members = self._affinity_members
+        if not members:
+            return None
+        return max(members, key=lambda name: hashlib.blake2b(
+            akey + name.encode(), digest_size=8).digest())
 
     def _required_class(self) -> int:
         cap = self.pool.capacity_units()
@@ -1465,11 +1555,19 @@ class Router:
         cfg = self._tenant(tenant)
         if model is None:
             model = cfg.model
+        akey = None
         if self.pool.kind == "llm":
             prompt = onp.asarray(prompt, onp.int32).reshape(-1)
             plen = int(prompt.shape[0])
             units = self.pool.cost_units(plen, int(max_new_tokens),
                                          model)
+            if self._aff_on:
+                from . import kv_hash
+
+                # the SAME chain-hash discipline the engines' prefix
+                # caches key on (the drift guarantee lives in kv_hash)
+                akey = kv_hash.prefix_key(prompt, self._aff_bs,
+                                          depth=self._aff_blocks)
         else:
             if on_token is not None:
                 raise ValueError(
@@ -1506,7 +1604,8 @@ class Router:
                     f"class {cfg.deadline_class} < required {need} — "
                     "shed, retry with backoff")
             freq = FleetRequest(prompt, max_new_tokens, tenant, deadline,
-                                units, eos_token, on_token, model=model)
+                                units, eos_token, on_token, model=model,
+                                akey=akey)
             self._t_inflight[tenant] = held + units
             self.metrics.tenant_inflight.labels(
                 fleet=self.pool.name, tenant=tenant).set(
@@ -1543,11 +1642,20 @@ class Router:
                 / max(1, r.host.capacity_units(model)))
 
     def _pick(self, exclude: Tuple[str, ...],
-              model: Optional[str] = None
+              model: Optional[str] = None,
+              akey: Optional[bytes] = None
               ) -> Optional[Tuple[Replica, bool]]:
-        """Least-loaded healthy replica with a willing breaker; returns
-        ``(replica, probed)`` — ``probed`` marks a claimed half-open
-        breaker probe the caller must eventually resolve or release.
+        """Affinity-first / least-loaded-second healthy replica with a
+        willing breaker; returns ``(replica, probed)`` — ``probed``
+        marks a claimed half-open breaker probe the caller must
+        eventually resolve or release.
+
+        ``akey`` (the prompt's leading-block chain hash) prefers the
+        rendezvous-hash owner of that prefix — where the KV blocks are
+        already hot — unless the owner is excluded, unhealthy, breaker
+        open/half-open, or loaded past the affinity ceiling; then the
+        pick falls back to least-loaded (counted
+        ``affinity_fallback``).
 
         Recovery probes come first: a tripped replica past its cooldown
         claims exactly ONE live request (``allow()`` is the side-
@@ -1570,6 +1678,16 @@ class Router:
         closed = [r for r in healthy
                   if r.breaker.state == CircuitBreaker.CLOSED]
         if closed:
+            if akey is not None:
+                target = self._affinity_target(akey)
+                if target is not None:
+                    for r in closed:
+                        if r.name == target:
+                            if load(r) <= self._aff_max_load:
+                                self.metrics.count("affinity_hit")
+                                return r, False
+                            break   # saturated owner: least-loaded
+                    self.metrics.count("affinity_fallback")
             return min(closed, key=load), False
         return None
 
@@ -1594,7 +1712,7 @@ class Router:
         exclude = tuple(exclude)
         last: Optional[BaseException] = None
         for _ in range(len(self.pool.replicas)):
-            picked = self._pick(exclude, freq.model)
+            picked = self._pick(exclude, freq.model, freq.akey)
             if picked is None:
                 break
             r, probed = picked
@@ -1916,7 +2034,9 @@ class Router:
                 "shed_class", "shed_deadline", "replica_dead",
                 "replica_wedged", "replica_restarts",
                 "replica_drained", "replica_activated",
-                "replica_added", "spare_added", "quota_rebalanced")},
+                "replica_added", "spare_added", "quota_rebalanced",
+                "affinity_hit", "affinity_fallback",
+                "affinity_rebuilds")},
         }
 
     def close(self, drain: bool = True, timeout_s: float = 60.0) -> None:

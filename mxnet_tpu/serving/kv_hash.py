@@ -1,0 +1,72 @@
+"""Shared KV block chain-hash discipline.
+
+ONE hash definition for every consumer of "which prefix is this":
+the :class:`~mxnet_tpu.serving.llm.LLMEngine` prefix cache (block
+residency), the :class:`~mxnet_tpu.serving.kv_spill.KVSpillTier`
+(spilled-block identity across host RAM / disk / remote tiers) and the
+:class:`~mxnet_tpu.serving.fleet.Router` prefix-affinity dispatch all
+key on these digests. Factoring it here is the drift guarantee: a
+router that hashed prompts even slightly differently from the engine
+would silently route every request to the wrong replica's cache.
+
+The discipline: hash ``j`` is ``blake2b(chain_{j-1} || tokens[j*bs :
+(j+1)*bs].tobytes(), digest_size=16)`` over int32 token bytes — so hash
+``j`` commits to the WHOLE prefix ``[0, (j+1)*bs)``, equal hash <=>
+equal prefix, and a radix-trie longest-prefix match flattens into
+consecutive dict hits. Only FULL blocks are hashed; a trailing partial
+block has no identity (its KV is never shared).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional
+
+import numpy as onp
+
+__all__ = ["chain_hashes", "prefix_key", "hash_hex"]
+
+DIGEST_SIZE = 16
+
+
+def chain_hashes(prompt, block_size: int,
+                 limit: Optional[int] = None) -> List[bytes]:
+    """Chain hashes of the prompt's full ``block_size``-token blocks.
+
+    ``prompt`` is any 1-D int sequence (normalized to int32 — the
+    engine's prompt dtype — so identical tokens give identical bytes
+    regardless of the caller's dtype). ``limit`` caps the number of
+    leading blocks hashed (the router only needs the first few)."""
+    prompt = onp.asarray(prompt, onp.int32).reshape(-1)
+    bs = int(block_size)
+    if bs < 1:
+        raise ValueError("block_size must be >= 1")
+    n = int(prompt.shape[0]) // bs
+    if limit is not None:
+        n = min(n, max(int(limit), 0))
+    out: List[bytes] = []
+    chain = b""
+    for j in range(n):
+        h = hashlib.blake2b(
+            chain + prompt[j * bs:(j + 1) * bs].tobytes(),
+            digest_size=DIGEST_SIZE)
+        chain = h.digest()
+        out.append(chain)
+    return out
+
+
+def prefix_key(prompt, block_size: int, depth: int = 4) -> Optional[bytes]:
+    """The affinity key: the chain hash of the prompt's leading
+    ``min(depth, full_blocks)`` blocks — what the fleet router hashes
+    to a replica. Because hash ``j`` commits to the whole prefix,
+    prompts sharing their first ``depth`` blocks (a shared system
+    prompt) map to the same key and therefore the same replica. None
+    when the prompt has no full block (nothing shareable to route on).
+    """
+    hs = chain_hashes(prompt, block_size, limit=depth)
+    return hs[-1] if hs else None
+
+
+def hash_hex(h: bytes) -> str:
+    """Wire/file name of a chain hash (``BlockServer`` block names and
+    spill-tier file stems are this hex form)."""
+    return h.hex()

@@ -183,6 +183,26 @@ class LLMMetrics:
             "llm_prefix_cached_blocks",
             "Pool blocks resident in the prefix cache",
             ("engine",)).labels(**eng)
+        # tiered KV spill: eviction no longer means re-prefill — count
+        # what left HBM, what is parked in the host tier, and what came
+        # back by DMA instead of compute (per source tier)
+        self.prefix_evictions = reg.counter(
+            "llm_prefix_evictions_total",
+            "Prefix-cache blocks evicted from the HBM pool (spilled "
+            "when the spill tier is armed, dropped otherwise)",
+            ("engine",)).labels(**eng)
+        self.kv_spill_blocks = reg.gauge(
+            "llm_kv_spill_blocks",
+            "KV blocks resident in the host-RAM spill tier",
+            ("engine",)).labels(**eng)
+        self.kv_spill_bytes = reg.gauge(
+            "llm_kv_spill_bytes",
+            "Bytes held by the host-RAM spill tier",
+            ("engine",)).labels(**eng)
+        self._kv_reattach = reg.counter(
+            "llm_kv_reattach_total",
+            "Spilled KV blocks re-attached into the pool by source tier",
+            ("engine", "tier"))
         self.token_latency_ms = reg.histogram(
             "llm_token_latency_ms",
             "Per-token latency (decode step wall / tokens in step)",
@@ -198,6 +218,9 @@ class LLMMetrics:
         if tot > 0:
             self.draft_acceptance_rate.set(
                 float(self.spec_accepted.value) / tot)
+
+    def count_reattach(self, tier: str, n: int = 1) -> None:
+        self._kv_reattach.labels(engine=self.engine_id, tier=tier).inc(n)
 
     def observe_prefix(self, hit: int, miss: int) -> None:
         self.prefix_hit_tokens.inc(hit)
@@ -224,6 +247,17 @@ class LLMMetrics:
 
 
 _engine_seq = __import__("itertools").count()
+
+
+# donate the pool buffer: the scatter updates HBM in place (a DMA of
+# the restored rows), never a functional copy of the whole pool
+_pool_scatter = jax.jit(
+    lambda pool, idx, rows: pool.at[:, idx].set(rows),
+    donate_argnums=(0,))
+
+# batched block-row gather for spill demotion (one D2H per pool per
+# eviction wave, not one per block)
+_pool_gather = jax.jit(lambda pool, idx: pool[:, idx])
 
 
 class LLMEngine:
@@ -285,10 +319,32 @@ class LLMEngine:
         advancing the position).
     prefix_cache : bool, optional
         Arms **shared-prefix block caching**: full prompt blocks are
-        chain-hashed at admission; a request whose leading blocks are
-        resident reuses them copy-on-write (per-block refcounts; a
-        block is freed only at refcount zero) and prefills ONLY its
-        uncached suffix. Default ``MXNET_TPU_LLM_PREFIX_CACHE`` (off).
+        chain-hashed at admission (:mod:`.kv_hash` — the same
+        discipline the fleet router's prefix-affinity dispatch keys
+        on); a request whose leading blocks are resident reuses them
+        copy-on-write (per-block refcounts; a block is freed only at
+        refcount zero) and prefills ONLY its uncached suffix. Default
+        ``MXNET_TPU_LLM_PREFIX_CACHE`` (off).
+    kv_spill : bool, optional
+        Arms **tiered KV block storage** (requires ``prefix_cache``):
+        a refcount-0 LRU block evicted from the pool spills its exact
+        rows to a bounded host-RAM tier
+        (:class:`~mxnet_tpu.serving.kv_spill.KVSpillTier`) instead of
+        being dropped — optionally demoting to a content-addressed
+        disk tier (``kv_spill_dir``) — and a later admission whose
+        prefix misses HBM but hits a spill tier re-attaches by DMA
+        instead of re-prefilling (token-identical: the payload is the
+        raw pool rows). Default ``MXNET_TPU_LLM_KV_SPILL`` (off).
+    kv_spill_bytes / kv_spill_dir / kv_spill_serve / kv_spill_peers :
+        Spill-tier shape: host-RAM byte bound
+        (``MXNET_TPU_LLM_KV_SPILL_BYTES``, 256 MiB), disk tier root
+        (``MXNET_TPU_LLM_KV_SPILL_DIR``), expose spilled blocks to
+        remote replicas over a
+        :class:`~mxnet_tpu.io.transport.BlockServer`
+        (``MXNET_TPU_LLM_KV_SPILL_SERVE``; endpoint at
+        :attr:`kv_spill_endpoint`), and peer endpoints to fetch from
+        (``MXNET_TPU_LLM_KV_SPILL_PEERS``) — a session resuming on a
+        *different* replica re-attaches over the transport plane.
     step_hook : callable, optional
         Called at the top of every scheduler tick, inside the fault
         containment (an exception it raises is typed through the
@@ -322,6 +378,11 @@ class LLMEngine:
                  donate: Optional[bool] = None,
                  draft_model=None, draft_k: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
+                 kv_spill: Optional[bool] = None,
+                 kv_spill_bytes: Optional[int] = None,
+                 kv_spill_dir: Optional[str] = None,
+                 kv_spill_serve: Optional[bool] = None,
+                 kv_spill_peers: Optional[List[str]] = None,
                  step_hook: Optional[Callable[[], None]] = None,
                  metrics: Optional[LLMMetrics] = None):
         from ..gluon.model_zoo.generation import _resolve_cache_dtype
@@ -377,6 +438,30 @@ class LLMEngine:
         if prefix_cache is None:
             prefix_cache = bool(env_float("MXNET_TPU_LLM_PREFIX_CACHE", 0))
         self._prefix_on = bool(prefix_cache)
+
+        # tiered KV spill under the pool (host RAM / disk / remote) —
+        # indexed by the SAME chain hashes as the prefix cache
+        if kv_spill is None:
+            kv_spill = bool(env_float("MXNET_TPU_LLM_KV_SPILL", 0))
+        self._spill = None
+        if kv_spill:
+            if not self._prefix_on:
+                raise ValueError(
+                    "kv_spill requires prefix_cache: spilled blocks are "
+                    "indexed by the prefix cache's chain hashes")
+            from .kv_spill import (KVSpillTier, spill_dir_from_env,
+                                   spill_peers_from_env)
+
+            if kv_spill_serve is None:
+                kv_spill_serve = bool(
+                    env_float("MXNET_TPU_LLM_KV_SPILL_SERVE", 0))
+            self._spill = KVSpillTier(
+                bytes_limit=kv_spill_bytes,
+                root=(kv_spill_dir if kv_spill_dir is not None
+                      else spill_dir_from_env()),
+                peers=(list(kv_spill_peers) if kv_spill_peers is not None
+                       else spill_peers_from_env()),
+                serve=bool(kv_spill_serve))
 
         preflight_backend()
         if donate is None:
@@ -553,19 +638,13 @@ class LLMEngine:
     def _prefix_hashes(self, prompt) -> List[bytes]:
         """Chain hashes of the prompt's FULL blocks: hash j commits to
         tokens [0, (j+1)*block_size) — equal hash <=> equal prefix, the
-        radix-trie lookup flattened into consecutive dict hits."""
-        import hashlib
+        radix-trie lookup flattened into consecutive dict hits. The
+        discipline lives in :mod:`.kv_hash` — ONE definition shared
+        with the fleet router's prefix-affinity dispatch and the spill
+        tiers, so they can never drift."""
+        from . import kv_hash
 
-        out: List[bytes] = []
-        chain = b""
-        bs = self.block_size
-        for j in range(int(prompt.shape[0]) // bs):
-            h = hashlib.blake2b(
-                chain + prompt[j * bs:(j + 1) * bs].tobytes(),
-                digest_size=16)
-            chain = h.digest()
-            out.append(chain)
-        return out
+        return kv_hash.chain_hashes(prompt, self.block_size)
 
     def _incref(self, blk: int) -> None:
         self._ref[blk] = self._ref.get(blk, 0) + 1
@@ -583,14 +662,27 @@ class LLMEngine:
         evicting LRU prefix-cache entries that nothing else references
         when the list runs short. None when even a drained cache cannot
         cover the reservation."""
+        evicted: List[tuple] = []
         while len(self._free) < n and self._prefix:
             for hsh, blk in self._prefix.items():   # LRU order
                 if self._ref.get(blk, 0) == 1:      # cache-only resident
                     del self._prefix[hsh]
+                    if self._spill is not None:
+                        evicted.append((hsh, blk))
+                    self.metrics.prefix_evictions.inc()
                     self._decref(blk)
                     break
             else:
                 break                               # all cached blocks live
+        if evicted:
+            # demote instead of drop: the blocks' exact rows park in
+            # the host-RAM tier, re-attachable by DMA on the prefix's
+            # next admission. Batched on purpose — a freed block's rows
+            # stay intact until this _alloc hands it back out below, and
+            # eviction runs inside admission, so every per-block D2H
+            # dispatch saved here is TTFT shaved off the incoming
+            # request.
+            self._spill_save(evicted)
         # gauge tracks evictions even when the allocation still fails —
         # free + cached must reconcile during the overload window too
         self.metrics.prefix_cached_blocks.set(len(self._prefix))
@@ -600,6 +692,66 @@ class LLMEngine:
         for b in got:
             self._ref[b] = 1
         return got
+
+    # -- tiered KV spill (host RAM / disk / remote) ------------------------
+    @property
+    def kv_spill_endpoint(self) -> Optional[str]:
+        """``host:port`` of this engine's spill BlockServer (None
+        unless ``kv_spill_serve`` armed it) — what a peer engine puts
+        in its ``kv_spill_peers`` list."""
+        return self._spill.endpoint if self._spill is not None else None
+
+    def _spill_save(self, evicted: List[tuple]) -> None:
+        """Copy the evicted blocks' exact pool rows (and the draft
+        pools' when speculative decoding shares the block ids) into
+        the spill tier — ONE batched gather + D2H per pool, not a
+        dispatch per block. Byte-exact rows are the token-identity
+        guarantee: re-attach restores precisely the KV the prefill
+        wrote, int8 bitcast-scale layout included."""
+        arr = onp.asarray([blk for _, blk in evicted], onp.int32)
+        cols = {"k": onp.asarray(_pool_gather(self._pool_k, arr)),
+                "v": onp.asarray(_pool_gather(self._pool_v, arr))}
+        if self._spec:
+            cols["dk"] = onp.asarray(_pool_gather(self._dpool_k, arr))
+            cols["dv"] = onp.asarray(_pool_gather(self._dpool_v, arr))
+        for i, (hsh, _) in enumerate(evicted):
+            self._spill.put(
+                hsh, {kk: vv[:, i].copy() for kk, vv in cols.items()})
+        blocks, nbytes = self._spill.level()
+        self.metrics.kv_spill_blocks.set(blocks)
+        self.metrics.kv_spill_bytes.set(nbytes)
+
+    def _reattach(self, ids: List[int], payloads: List[Dict],
+                  tiers: List[str], hashes: List[bytes]) -> None:
+        """Write re-attached payload rows back into freshly allocated
+        pool blocks (ONE donated scatter per pool — the donation lets
+        XLA update the pool buffer in place, so the cost is the DMA of
+        the restored rows, not a functional copy of the whole pool) and
+        admit them into the prefix cache as residents."""
+        arr = onp.asarray(ids, onp.int32)
+        self._pool_k = _pool_scatter(
+            self._pool_k, arr,
+            onp.stack([pl["k"] for pl in payloads], axis=1))
+        self._pool_v = _pool_scatter(
+            self._pool_v, arr,
+            onp.stack([pl["v"] for pl in payloads], axis=1))
+        if self._spec:
+            self._dpool_k = _pool_scatter(
+                self._dpool_k, arr,
+                onp.stack([pl["dk"] for pl in payloads], axis=1))
+            self._dpool_v = _pool_scatter(
+                self._dpool_v, arr,
+                onp.stack([pl["dv"] for pl in payloads], axis=1))
+        for blk, hsh in zip(ids, hashes):
+            if hsh not in self._prefix:
+                self._prefix[hsh] = blk
+                self._incref(blk)       # cache residency over the lane ref
+        for t in tiers:
+            self.metrics.count_reattach(t)
+        self.metrics.prefix_cached_blocks.set(len(self._prefix))
+        blocks, nbytes = self._spill.level()
+        self.metrics.kv_spill_blocks.set(blocks)
+        self.metrics.kv_spill_bytes.set(nbytes)
 
     # -- client surface ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
@@ -803,6 +955,8 @@ class LLMEngine:
         hashes: List[bytes] = []
         hit_hashes: List[bytes] = []
         hit_blocks: List[int] = []
+        spill_payloads: List[Dict] = []
+        spill_tiers: List[str] = []
         if self._prefix_on:
             hashes = self._prefix_hashes(req.prompt)
             for hsh in hashes:
@@ -811,18 +965,42 @@ class LLMEngine:
                     break
                 hit_hashes.append(hsh)
                 hit_blocks.append(blk)
-            if hit_blocks and len(hit_blocks) * bs == p:
+            if self._spill is not None and len(hit_blocks) < len(hashes):
+                # extend the resident run from the spill tiers: blocks
+                # whose content parks in host RAM / disk / a peer
+                # re-attach by DMA instead of re-prefilling. Probed in
+                # chain order — the hit run must stay consecutive.
+                # Remote probes are deadline-bounded and contained
+                # (any transport fault reads as a miss).
+                for j in range(len(hit_blocks), len(hashes)):
+                    payload, tier = self._spill.get(hashes[j])
+                    if payload is None:
+                        break
+                    if self._spec and ("dk" not in payload
+                                       or "dv" not in payload):
+                        break   # a draft-less peer payload cannot
+                    spill_payloads.append(payload)  # feed draft pools
+                    spill_tiers.append(tier)
+            run = len(hit_blocks) + len(spill_payloads)
+            if run and run * bs == p:
                 # the last real token must still run (its logits sample
                 # the first generated token): never consume it from cache
-                hit_blocks.pop()
-                hit_hashes.pop()
-            if hit_blocks:
-                sb = self._prefill_bucket(p - len(hit_blocks) * bs)
-                if len(hit_blocks) + sb // bs > self.max_blocks_per_seq:
+                if spill_payloads:
+                    spill_payloads.pop()
+                    spill_tiers.pop()
+                else:
+                    hit_blocks.pop()
+                    hit_hashes.pop()
+                run -= 1
+            if run:
+                sb = self._prefill_bucket(p - run * bs)
+                if run + sb // bs > self.max_blocks_per_seq:
                     # suffix bucket would spill past the block-covered
                     # context window: fall back to a full prefill
                     hit_blocks, hit_hashes = [], []
-        n_hit = len(hit_blocks)
+                    spill_payloads, spill_tiers = [], []
+        n_res = len(hit_blocks)             # HBM-resident shared blocks
+        n_hit = n_res + len(spill_payloads)  # prefill skipped for these
         # pin the hits BEFORE allocating: _alloc's LRU eviction must
         # never evict (and re-issue) the very blocks this admission is
         # about to share — a pinned block (refcount >= 2) is not
@@ -830,7 +1008,7 @@ class LLMEngine:
         for blk, hsh in zip(hit_blocks, hit_hashes):
             self._incref(blk)
             self._prefix.move_to_end(hsh)          # LRU bump
-        fresh = self._alloc(need - n_hit)
+        fresh = self._alloc(need - n_res)
         if fresh is None:
             # no free blocks: shed typed-transient so the client's retry
             # loop backs off and resubmits (never blocks the decode batch)
@@ -839,8 +1017,14 @@ class LLMEngine:
             self.metrics.count("shed_overload")
             req.fail(ServerOverload(
                 f"KV pool exhausted ({len(self._free)} free blocks, "
-                f"need {need - n_hit}) — back off and retry"))
+                f"need {need - n_res}) — back off and retry"))
             return
+        if spill_payloads:
+            # re-attach: the first len(spill_payloads) fresh blocks
+            # receive the spilled rows and become cache residents
+            self._reattach(fresh[:len(spill_payloads)], spill_payloads,
+                           spill_tiers,
+                           hashes[n_res:n_res + len(spill_payloads)])
         blocks = hit_blocks + fresh
         self.metrics.pool_free.set(len(self._free))
         if self._prefix_on:
@@ -1208,6 +1392,11 @@ class LLMEngine:
         self._free = list(range(self.num_blocks))
         self._ref.clear()
         self._prefix.clear()
+        # the spill tier SURVIVES the rebuild on purpose: it is
+        # content-addressed (chain hash -> exact payload copy), so its
+        # entries stay valid after the pool's block ids are reissued —
+        # the first post-fault admissions re-attach instead of paying a
+        # cold re-prefill
         self.metrics.prefix_cached_blocks.set(0)
         self.metrics.pool_free.set(len(self._free))
         self.metrics.lanes_active.set(0)
@@ -1390,6 +1579,8 @@ class LLMEngine:
                 "prefix_hit_rate": round(
                     float(self.metrics.prefix_hit_rate.get()), 4),
             }
+        if self._spill is not None:
+            out["kv_spill"] = self._spill.stats()
         return out
 
     @property
@@ -1456,8 +1647,11 @@ class LLMEngine:
         # exist (counters and histograms stay — they are cumulative)
         for g in (self.metrics.tok_s, self.metrics.lanes_active,
                   self.metrics.lanes_total, self.metrics.pool_free,
-                  self.metrics.pool_total):
+                  self.metrics.pool_total, self.metrics.kv_spill_blocks,
+                  self.metrics.kv_spill_bytes):
             g.set(0)
+        if self._spill is not None:
+            self._spill.close()
 
     def __enter__(self) -> "LLMEngine":
         return self

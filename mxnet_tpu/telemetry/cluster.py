@@ -135,9 +135,13 @@ def discover_processes(root: str) -> List[Dict]:
 # derivation: the autoscaler gauges
 # ---------------------------------------------------------------------------
 
-def _series_sum(metrics: Dict, name: str) -> float:
+def _series_sum(metrics: Dict, name: str,
+                want_labels: Optional[Dict[str, str]] = None) -> float:
     total = 0.0
     for s in metrics.get(name, {}).get("series", ()):
+        if want_labels and any(s.get("labels", {}).get(k) != v
+                               for k, v in want_labels.items()):
+            continue
         v = s.get("value")
         if isinstance(v, (int, float)):
             total += float(v)
@@ -178,6 +182,8 @@ def derive(processes: Dict[str, Dict]) -> Dict:
     tok_s = pool_free = pool_total = 0.0
     fleet_free = fleet_cap = 0.0
     lanes_active = 0.0
+    prefix_hit = prefix_miss = 0.0
+    spill_blocks = 0.0
     starved_ms = wall_ms = 0.0
     stale_n = 0
     ages: List[float] = []
@@ -202,6 +208,15 @@ def derive(processes: Dict[str, Dict]) -> Dict:
         lanes_active += _series_sum(m, "llm_lanes_active")
         fleet_free += _series_sum(m, "fleet_free_units")
         fleet_cap += _series_sum(m, "fleet_capacity_units")
+        # the fleet-wide KV economy: hit/miss token counters summed
+        # over every engine give THE number prefix-affinity routing
+        # moves (per-replica hit rates can all look fine while the
+        # cluster still re-prefills the same preamble N ways)
+        prefix_hit += _series_sum(m, "llm_prefix_tokens_total",
+                                  {"result": "hit"})
+        prefix_miss += _series_sum(m, "llm_prefix_tokens_total",
+                                   {"result": "miss"})
+        spill_blocks += _series_sum(m, "llm_kv_spill_blocks")
         s_ms, _ = _hist_totals(m, "telemetry_step_bucket_ms",
                                {"bucket": "input_starved"})
         w_ms, _ = _hist_totals(m, "telemetry_step_ms")
@@ -217,6 +232,10 @@ def derive(processes: Dict[str, Dict]) -> Dict:
         "llm_lanes_active_total": lanes_active,
         "fleet_free_units": fleet_free,
         "fleet_capacity_units": fleet_cap,
+        "prefix_hit_rate":
+            round(prefix_hit / (prefix_hit + prefix_miss), 5)
+            if (prefix_hit + prefix_miss) > 0 else 0.0,
+        "llm_kv_spill_blocks_total": spill_blocks,
         "export_age_min_s": round(min(ages), 3) if ages else None,
         "export_age_max_s": round(max(ages), 3) if ages else None,
         "input_starved_frac":
@@ -337,6 +356,14 @@ class ClusterScraper:
             "fleet_capacity_units": reg.gauge(
                 "cluster_fleet_capacity_units",
                 "Live fleet capacity units summed over routers"),
+            "prefix_hit_rate": reg.gauge(
+                "cluster_prefix_hit_rate",
+                "Fleet-wide prefix-cache hit ratio over prompt tokens "
+                "(hit/(hit+miss) summed over every engine)"),
+            "llm_kv_spill_blocks_total": reg.gauge(
+                "cluster_kv_spill_blocks",
+                "KV blocks parked in host-RAM spill tiers over every "
+                "engine in the cluster"),
             "processes": reg.gauge(
                 "cluster_processes",
                 "Processes exporting into the shared telemetry root"),

@@ -128,6 +128,26 @@ def test_fetch_not_found_and_try_fetch(served_blobs):
             c.fetch("nope")
 
 
+def test_resolver_keyerror_is_not_found_not_server_error():
+    """A dict-backed resolver that raises KeyError on a miss (e.g.
+    ``blobs.__getitem__``) must answer NOT_FOUND — a lookup miss fed
+    through the T_ERR path would trip client breakers and endpoint
+    failover on perfectly healthy servers."""
+    from mxnet_tpu.io.transport import (BlockClient, BlockNotFound,
+                                        BlockServer)
+
+    blobs = {"hot": b"\xcd" * 128}
+    srv = BlockServer(blobs.__getitem__, name="t-keyerr").start()
+    try:
+        with BlockClient([srv.endpoint]) as c:
+            assert c.fetch("hot") == blobs["hot"]
+            assert c.try_fetch("nope") is None
+            with pytest.raises(BlockNotFound):
+                c.fetch("nope")
+    finally:
+        srv.close()
+
+
 def test_pool_reuse_many_fetches_one_connection(served_blobs):
     from mxnet_tpu.io.transport import BlockClient
 

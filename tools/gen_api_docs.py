@@ -112,6 +112,12 @@ MODULES = {
                              "KV block pool, prefill/decode split, "
                              "in-flight admission, speculative decode, "
                              "shared-prefix block caching",
+    "mxnet_tpu.serving.kv_hash": "the one chain-hash discipline shared "
+                                 "by the prefix cache, prefix-affinity "
+                                 "routing and the KV spill tiers",
+    "mxnet_tpu.serving.kv_spill": "tiered KV block storage: host-RAM / "
+                                  "disk / remote-peer spill under the "
+                                  "paged pool, re-attach over re-prefill",
     "mxnet_tpu.gluon.model_zoo.generation": "autoregressive generation: "
                                             "compiled decode/beam "
                                             "programs, paged serving "
