@@ -881,6 +881,33 @@ def capture_kv_economy() -> None:
             f"lost={rec.get('lost_requests')}")
 
 
+DISAGG = os.path.join(HERE, "results_disagg_tpu.json")
+
+
+def capture_disagg() -> None:
+    """Pod-scale disaggregated serving row (ISSUE 20,
+    benchmark/disagg_bench.py): mixed-load decode p99 with separate
+    prefill/decode fleets + KV-block handoff vs a colocated fleet, the
+    sharded-engine token-identity oracle and the per-device KV pool
+    shrink (the largest-servable-model headroom). The CPU row
+    (results_disagg_cpu.json) proved the mechanics and the zero-loss
+    kill-prefill drill; the TPU row is where prefill compute actually
+    saturates the MXU and the handoff rides real HBM DMA."""
+    rc, out = run_child(
+        [sys.executable, os.path.join(HERE, "disagg_bench.py")],
+        timeout=2400)
+    rec = parse_json_output(out)
+    if bank_if_tpu(DISAGG, rec, rc, "disagg bench") and rec:
+        m = {r.get("metric"): r.get("value")
+             for r in rec.get("metrics", ())}
+        log(f"disagg: decode p99 {m.get('decode_p99_disagg_ms')} ms "
+            f"(disagg) vs {m.get('decode_p99_colocated_ms')} ms "
+            f"(colocated), sharded token identity "
+            f"{bool(m.get('sharded_token_identical'))}, per-device "
+            f"pool shrink x{m.get('shard_pool_shrink_factor')}, "
+            f"lost={rec.get('lost_requests')}")
+
+
 GSPMD = os.path.join(HERE, "results_gspmd_tpu.json")
 
 
@@ -1435,6 +1462,7 @@ CAPTURES = (
     ("io-service", banked_stale(IO_SERVICE), capture_io_service),
     ("io-net", banked_stale(IO_NET), capture_io_net),
     ("kv-economy", banked_stale(KV_ECONOMY), capture_kv_economy),
+    ("disagg", banked_stale(DISAGG), capture_disagg),
     ("quant", banked_stale(QUANT), capture_quant),
     ("opperf", opperf_needs, capture_opperf),
     ("attention", banked_stale(ATTENTION, 4 * 3600), capture_attention),

@@ -314,6 +314,9 @@ TRANSFORMER_RULES: List[Tuple[str, P]] = [
     (r"(ffn|mlp|output|fc2|dense1).*weight", P(("fsdp",), "tp")),
     # embeddings / tied softmax: vocab over tp, model dim over fsdp
     (r"(embed|embedding|tok|pos|word).*weight", P("tp", ("fsdp",))),
+    # positional tables (zoo ``pos_embed`` params carry no trailing
+    # ``.weight``): replicate — small, read per position, never matmul'd
+    (r".*(pos_embed|position_embed|pos_table)$", P()),
     # norms, biases, scalars: replicate
     (r"(norm|ln|layernorm).*", P()),
     (r".*(bias|beta|gamma)$", P()),

@@ -29,7 +29,13 @@ model (ROADMAP: "serves heavy traffic from millions of users"):
   cluster-wide KV economy: ONE chain-hash discipline shared by the
   engine prefix cache, the router's prefix-affinity dispatch and the
   tiered spill hierarchy (HBM → pinned host RAM → content-addressed
-  disk → remote peer over the block-transfer plane);
+  disk → remote peer over the block-transfer plane), serialized by
+  the ONE byte-exact row codec (:mod:`.kv_codec`);
+- :class:`DisaggRouter` (:mod:`.disagg`) — pod-scale disaggregated
+  serving: separate ``role="prefill"`` / ``role="decode"`` fleets,
+  prefill-side KV block export over the block-transfer plane, decode
+  re-attach through the spill hierarchy — every handoff failure
+  degrades to a local re-prefill, never a lost request;
 - :mod:`.bench` — the N-concurrent-synthetic-clients harness behind
   ``tools/serve_bench.py``.
 
@@ -40,10 +46,12 @@ from .admission import (AdmissionQueue, DeadlineExceeded, Request,  # noqa: F401
                         RequestCancelled, ServerOverload)
 from .autoscale import AutoscalePolicy, Autoscaler  # noqa: F401
 from .batcher import DynamicBatcher  # noqa: F401
+from .disagg import DisaggRequest, DisaggRouter  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
 from .fleet import (CircuitBreaker, FleetRequest, ModelSpec,  # noqa: F401
                     Replica, ReplicaPool, ReplicaUnavailable, Router,
                     TenantConfig)
+from .kv_codec import decode_blocks, encode_blocks  # noqa: F401
 from .kv_hash import chain_hashes, hash_hex, prefix_key  # noqa: F401
 from .kv_spill import KVSpillTier  # noqa: F401
 from .llm import GenRequest, LLMEngine  # noqa: F401
@@ -75,4 +83,8 @@ __all__ = [
     "prefix_key",
     "hash_hex",
     "KVSpillTier",
+    "encode_blocks",
+    "decode_blocks",
+    "DisaggRouter",
+    "DisaggRequest",
 ]

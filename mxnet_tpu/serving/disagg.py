@@ -1,0 +1,369 @@
+"""Disaggregated serving: separate prefill and decode fleets with
+KV-block handoff.
+
+Prefill and decode want different machines. Prefill is compute-bound —
+one long arithmetic-dense pass over the prompt that saturates the MXU —
+while decode is memory-bound — thousands of single-token steps that
+stream the KV cache through HBM at batch-1 arithmetic intensity. A
+colocated engine time-slices both on the same chips, so a burst of long
+prompts stalls every interactive decode behind prefill compute
+(head-of-line blocking), and neither phase can be scaled to its own
+bottleneck. Disaggregation (DistServe/Splitwise) splits the fleet into
+two replica classes and ships the prefill's product — the KV block
+rows — across:
+
+1. **Admission** — :meth:`DisaggRouter.submit` stages the prompt on the
+   *prefill* fleet (a normal :class:`~.fleet.Router` over a
+   ``ReplicaPool(role="prefill")``). The prefill engine runs the
+   prompt, caches the full blocks in its prefix cache, and — because
+   ``LLMEngine(role="prefill")`` — exports each fresh block's rows
+   into its serving spill tier, keyed by the same
+   :mod:`~mxnet_tpu.serving.kv_hash` chain hashes every prefix cache
+   in the cluster keys on.
+2. **Handoff** — the rows travel over the PR-17 block transport plane:
+   each prefill engine's spill tier runs a
+   :class:`~mxnet_tpu.io.transport.BlockServer`; the router wires
+   every decode engine's spill tier to the live set of those endpoints
+   (:meth:`~.fleet.ReplicaPool.kv_export_endpoints` →
+   :meth:`~.llm.LLMEngine.set_kv_spill_peers`), re-wired on every
+   scale/death event of either fleet. The wire format is the ONE
+   byte-exact codec (:mod:`~mxnet_tpu.serving.kv_codec`) the spill
+   tiers already use, so a shipped row re-attaches byte-identical.
+3. **Decode** — the request is then submitted to the *decode* fleet's
+   router (prefix-affinity on, so repeat prefixes land where their
+   blocks already live). The decode engine's admission path probes its
+   spill hierarchy, fetches the shipped rows from the prefill peer,
+   and re-attaches them through the donated-scatter DMA path — decode
+   starts without re-running prefill.
+
+**Failure is a miss, never a loss.** Every handoff stage degrades to
+the colocated behavior: a dead/overloaded prefill fleet, a handoff
+deadline expiry, a CRC-rejected garbled frame or a killed prefill
+replica mid-fetch all count a ``miss`` and the decode engine simply
+re-prefills locally. The decode router keeps its own hedging,
+circuit-breaker and exactly-once re-admission machinery, so the
+kill-a-prefill-replica drill pins ``lost_requests == 0``.
+
+Knobs: ``MXNET_TPU_DISAGG_HANDOFF_DEADLINE_S`` bounds the prefill
+stage, ``MXNET_TPU_DISAGG_MIN_PREFILL_BLOCKS`` gates short prompts out
+of the handoff (a sub-block prompt exports nothing — skip the hop),
+``MXNET_TPU_DISAGG_WORKERS`` sizes the stage pipeline. See
+``docs/llm_serving.md`` (disaggregation section) and
+``benchmark/disagg_bench.py`` for the measured decode-p99 win.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+import numpy as onp
+
+from ..base import env_float
+from ..telemetry.registry import get_registry
+from .admission import Request, RequestCancelled, ServerOverload
+from .fleet import ReplicaPool, Router, TenantConfig, fleet_affinity_block_size
+
+__all__ = ["DisaggRouter", "DisaggRequest", "handoff_deadline_default",
+           "min_prefill_blocks_default", "disagg_workers_default"]
+
+_router_seq = itertools.count()
+
+
+def handoff_deadline_default() -> float:
+    """``MXNET_TPU_DISAGG_HANDOFF_DEADLINE_S`` (default 30 s) — budget
+    for the prefill stage; expiry is a counted miss, the decode fleet
+    re-prefills locally."""
+    return float(env_float("MXNET_TPU_DISAGG_HANDOFF_DEADLINE_S", 30.0))
+
+
+def min_prefill_blocks_default() -> int:
+    """``MXNET_TPU_DISAGG_MIN_PREFILL_BLOCKS`` (default 1) — prompts
+    shorter than this many full KV blocks skip the prefill fleet (they
+    export nothing; the hop would be pure latency)."""
+    return max(1, int(env_float("MXNET_TPU_DISAGG_MIN_PREFILL_BLOCKS", 1)))
+
+
+def disagg_workers_default() -> int:
+    """``MXNET_TPU_DISAGG_WORKERS`` (default 16) — stage-pipeline
+    width: each in-flight disagg request holds one worker through
+    prefill-stage + decode relay."""
+    return max(1, int(env_float("MXNET_TPU_DISAGG_WORKERS", 16)))
+
+
+class DisaggRequest(Request):
+    """The fronting handle for one disaggregated request: a one-shot
+    completion slot the stage pipeline resolves with the decode fleet's
+    tokens (or its typed error). ``handoff`` records what the prefill
+    stage did — ``"exported"`` (prefill ran, rows are served),
+    ``"skipped"`` (short prompt / no prefill capacity — went straight
+    to decode) or ``"miss"`` (prefill failed or blew its deadline; the
+    decode engine re-prefilled locally)."""
+
+    __slots__ = ("tenant", "handoff", "_decode_req")
+
+    def __init__(self, prompt, tenant: str, deadline: Optional[float]):
+        super().__init__(prompt, 1, ("disagg",), deadline)
+        self.tenant = tenant
+        self.handoff: Optional[str] = None
+        self._decode_req = None
+
+    def cancel(self) -> None:
+        """Cancel both this handle and (when already dispatched) its
+        decode-fleet attempt. Advisory, idempotent, first-completion
+        wins — exactly the :class:`~.admission.Request` contract."""
+        super().cancel()
+        d = self._decode_req
+        if d is not None:
+            d.cancel()
+
+
+class DisaggRouter:
+    """The disaggregated front door: one prefill fleet + one decode
+    fleet behind a single ``submit``/``generate`` surface (see module
+    docstring for the three-stage flow).
+
+    Parameters
+    ----------
+    prefill_pool / decode_pool : ReplicaPool
+        Must carry ``role="prefill"`` / ``role="decode"`` — and their
+        in-process engines must have been built with the matching
+        ``LLMEngine(role=)`` (checked here; a wrong-role engine would
+        silently never export / never probe).
+    tenants : list of TenantConfig, optional
+        Tenant policy for the *decode* router (where the long-lived
+        capacity lives). The prefill router runs a single implicit
+        tenant: its requests are short staging passes.
+    min_prefill_blocks / handoff_deadline_s / max_workers :
+        Override the env defaults above.
+    prefill_router_kw / decode_router_kw : dict, optional
+        Extra :class:`~.fleet.Router` kwargs per side (hedge budgets,
+        timeouts, affinity tuning).
+    """
+
+    def __init__(self, prefill_pool: ReplicaPool,
+                 decode_pool: ReplicaPool, *,
+                 tenants: Optional[List[TenantConfig]] = None,
+                 min_prefill_blocks: Optional[int] = None,
+                 handoff_deadline_s: Optional[float] = None,
+                 max_workers: Optional[int] = None,
+                 name: Optional[str] = None,
+                 prefill_router_kw: Optional[Dict] = None,
+                 decode_router_kw: Optional[Dict] = None):
+        if prefill_pool.role != "prefill":
+            raise ValueError(
+                f"prefill_pool must be ReplicaPool(role='prefill'), "
+                f"got role={prefill_pool.role!r}")
+        if decode_pool.role != "decode":
+            raise ValueError(
+                f"decode_pool must be ReplicaPool(role='decode'), "
+                f"got role={decode_pool.role!r}")
+        self.name = name or f"disagg{next(_router_seq)}"
+        self.prefill_pool = prefill_pool
+        self.decode_pool = decode_pool
+        self._check_engine_roles()
+        self._min_blocks = int(
+            min_prefill_blocks if min_prefill_blocks is not None
+            else min_prefill_blocks_default())
+        self._deadline_s = float(
+            handoff_deadline_s if handoff_deadline_s is not None
+            else handoff_deadline_default())
+        # the eligibility unit is the ENGINE's KV block (what the
+        # chain hashes are computed over), read off a live prefill
+        # engine; the affinity default only backstops subprocess pools
+        # whose engines are unreachable from here
+        bs_box: List[int] = []
+        prefill_pool.each_engine(
+            lambda e: bs_box.append(int(getattr(e, "block_size", 0))))
+        self._bs = (bs_box[0] if bs_box and bs_box[0] > 0
+                    else fleet_affinity_block_size())
+        reg = get_registry()
+        self._handoff = reg.counter(
+            "fleet_handoff_requests_total",
+            "Disagg prefill-stage outcomes by result "
+            "(exported/skipped/miss)", ("fleet", "result"))
+        self._handoff_ms = reg.histogram(
+            "fleet_handoff_ms",
+            "Prefill-stage latency (admission -> rows served) per "
+            "disagg request", ("fleet",)).labels(fleet=self.name)
+        self._peers_gauge = reg.gauge(
+            "fleet_handoff_peers",
+            "Live prefill export endpoints wired into the decode "
+            "engines' spill peer lists", ("fleet",)).labels(
+                fleet=self.name)
+        self._rewires = reg.counter(
+            "fleet_handoff_peer_rewires_total",
+            "Decode-side peer-list rewires (one per scale/death event "
+            "of either fleet)", ("fleet",)).labels(fleet=self.name)
+        self._closed = False
+        self._lock = threading.Lock()
+        # the two inner routers own ALL routing policy: hedging,
+        # breakers, exactly-once re-admission, prefix affinity. The
+        # prefill side hedges too — a wedged prefill replica must not
+        # eat the whole handoff deadline before the miss is counted.
+        self.prefill = Router(prefill_pool, **(prefill_router_kw or {}))
+        self.decode = Router(decode_pool, tenants,
+                             **(decode_router_kw or {}))
+        # decode engines probe the LIVE prefill exporters: rewire on
+        # every membership edge of either pool (a dead prefill replica
+        # leaves the peer list; a new decode replica joins wired)
+        self._rewire_peers()
+        prefill_pool.on_scale(lambda ev, rep: self._rewire_peers())
+        decode_pool.on_scale(lambda ev, rep: self._rewire_peers())
+        self._exec = ThreadPoolExecutor(
+            max_workers=int(max_workers if max_workers is not None
+                            else disagg_workers_default()),
+            thread_name_prefix=f"disagg:{self.name}")
+
+    def _check_engine_roles(self) -> None:
+        bad: List[str] = []
+
+        def chk(pool: ReplicaPool, want: str) -> None:
+            def f(eng) -> None:
+                r = getattr(eng, "role", None)
+                if r != want:
+                    bad.append(f"{pool.name} (role={want!r}) hosts an "
+                               f"engine with role={r!r}")
+            pool.each_engine(f)
+
+        chk(self.prefill_pool, "prefill")
+        chk(self.decode_pool, "decode")
+        if bad:
+            raise ValueError(
+                "pool/engine role mismatch — build engines with the "
+                "matching LLMEngine(role=): " + "; ".join(sorted(set(bad))))
+
+    # -- handoff plumbing --------------------------------------------------
+    def _rewire_peers(self) -> None:
+        """Point every decode engine's remote spill tier at the healthy
+        prefill exporters. Runs outside any pool lock (the on_scale
+        contract); an unreachable engine is contained per engine by
+        :meth:`~.fleet.ReplicaPool.each_engine`."""
+        eps = self.prefill_pool.kv_export_endpoints()
+        self.decode_pool.each_engine(
+            lambda e: e.set_kv_spill_peers(eps))
+        self._peers_gauge.set(len(eps))
+        self._rewires.inc()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 0, *,
+               tenant: str = "default", timeout_ms="default",
+               eos_token: Optional[int] = None,
+               on_token: Optional[Callable[[int], None]] = None,
+               model: Optional[str] = None) -> DisaggRequest:
+        """Admit one request into the disaggregated fleet. Returns a
+        :class:`DisaggRequest` immediately; the stage pipeline runs
+        prefill-stage-then-decode off-thread and resolves it with the
+        decode fleet's tokens. Shedding is typed and happens at the
+        decode router (the capacity owner) — a shed raises out of
+        ``wait()``, not out of ``submit``."""
+        if self._closed:
+            raise ServerOverload("disagg router is closed")
+        prompt = onp.asarray(prompt, onp.int32).reshape(-1)
+        deadline = None
+        if timeout_ms != "default" and timeout_ms is not None:
+            deadline = time.monotonic() + float(timeout_ms) / 1e3
+        dreq = DisaggRequest(prompt, tenant, deadline)
+        self._exec.submit(self._run, dreq, prompt,
+                          int(max_new_tokens), tenant, timeout_ms,
+                          eos_token, on_token, model)
+        return dreq
+
+    def generate(self, prompt, max_new_tokens: int, **kw):
+        """Blocking convenience: submit + wait."""
+        return self.submit(prompt, max_new_tokens, **kw).wait()
+
+    def _run(self, dreq: DisaggRequest, prompt, max_new: int,
+             tenant: str, timeout_ms, eos_token, on_token,
+             model) -> None:
+        """One request's stage pipeline (worker thread): prefill-stage
+        (bounded, miss-tolerant) then decode relay. EVERY exit resolves
+        ``dreq`` exactly once — the decode router's own exactly-once
+        machinery guards the attempts underneath."""
+        try:
+            self._stage_prefill(dreq, prompt)
+            if dreq.cancelled:
+                raise RequestCancelled("cancelled before decode dispatch")
+            freq = self.decode.submit(
+                prompt, max_new, tenant=tenant, timeout_ms=timeout_ms,
+                eos_token=eos_token, on_token=on_token, model=model)
+            dreq._decode_req = freq
+            if dreq.cancelled:
+                freq.cancel()
+            dreq.finish(freq.wait())
+        except BaseException as e:  # noqa: BLE001 — relay typed errors
+            dreq.fail(e)
+
+    def _stage_prefill(self, dreq: DisaggRequest, prompt) -> None:
+        """Stage the prompt on the prefill fleet. The engine's
+        ``role="prefill"`` export runs inside its admission/prefill
+        pass, so the staging request completing means the fresh blocks
+        are already resolvable from its BlockServer — the prefill
+        ``wait()`` doubles as the export-complete barrier. Any failure
+        (shed, dead fleet, deadline) is a counted miss."""
+        plen = int(prompt.shape[0])
+        if (plen // self._bs < self._min_blocks
+                or not self.prefill_pool.healthy()):
+            dreq.handoff = "skipped"
+            self._handoff.labels(fleet=self.name,
+                                 result="skipped").inc()
+            return
+        t0 = time.monotonic()
+        try:
+            # max_new_tokens=1: the cheapest request that runs the full
+            # prompt prefill (the export trigger); the token itself is
+            # discarded — decode re-derives it from the shipped KV
+            self.prefill.generate(prompt, 1, tenant="default",
+                                  timeout_ms=self._deadline_s * 1e3)
+            dreq.handoff = "exported"
+        except BaseException:  # noqa: BLE001 — miss, never a loss
+            dreq.handoff = "miss"
+        self._handoff.labels(fleet=self.name,
+                             result=dreq.handoff).inc()
+        self._handoff_ms.observe((time.monotonic() - t0) * 1e3)
+
+    # -- introspection / lifecycle -----------------------------------------
+    def handoff_counts(self) -> Dict[str, int]:
+        return {r: int(self._handoff.labels(fleet=self.name,
+                                            result=r).value)
+                for r in ("exported", "skipped", "miss")}
+
+    def stats(self) -> Dict:
+        return {
+            "name": self.name,
+            "min_prefill_blocks": self._min_blocks,
+            "handoff_deadline_s": self._deadline_s,
+            "block_size": self._bs,
+            "handoff": self.handoff_counts(),
+            "export_endpoints": self.prefill_pool.kv_export_endpoints(),
+            "prefill": self.prefill.stats(),
+            "decode": self.decode.stats(),
+        }
+
+    def close(self, drain: bool = True, timeout_s: float = 60.0) -> None:
+        """Stop admitting, settle the stage pipeline, close both
+        routers (each closes its pool)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if not drain:
+            # fail-fast: closing the decode router first fails its
+            # in-flight attempts typed, which unblocks any pipeline
+            # worker parked in freq.wait()
+            self.decode.close(drain=False, timeout_s=timeout_s)
+            self.prefill.close(drain=False, timeout_s=timeout_s)
+            self._exec.shutdown(wait=False)
+            return
+        self._exec.shutdown(wait=True)
+        self.decode.close(drain=True, timeout_s=timeout_s)
+        self.prefill.close(drain=True, timeout_s=timeout_s)
+
+    def __enter__(self) -> "DisaggRouter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -506,7 +506,17 @@ class _LocalHost:
 
     def _eng_free(self, e) -> int:
         if self.kind == "llm":
-            return int(e.metrics.pool_free.get())
+            # free list + evictable prefix-cache residents: a cached
+            # block nothing references is reclaimable on the next
+            # admission, so it IS free capacity — counting only the
+            # free list makes an idle prefix-cache engine look
+            # permanently saturated (pressure-shedding every request
+            # and pinning the autoscaler's free fraction at 0)
+            free = int(e.metrics.pool_free.get())
+            ev = getattr(e, "evictable_blocks", None)
+            if ev is not None:
+                free += int(ev())
+            return free
         return max(0, self._eng_capacity(e) - len(e._queue))
 
     def free_units(self, model: Optional[str] = None) -> int:
@@ -956,6 +966,14 @@ class ReplicaPool:
         Fleet coordination root (heartbeat files live under
         ``<root>/heartbeats``). Default: a private temp dir, removed at
         close.
+    role : None | "prefill" | "decode"
+        Disaggregated-serving replica class (see :mod:`.disagg`): a
+        ``"prefill"`` pool's engines run prompt prefill and EXPORT the
+        resulting KV block rows; a ``"decode"`` pool's engines
+        re-attach shipped rows and decode. The role is the pool's
+        identity only — engines must be built with the matching
+        ``LLMEngine(role=)`` by the factory (checked at first use by
+        :class:`~mxnet_tpu.serving.disagg.DisaggRouter`).
     """
 
     def __init__(self, factory: Optional[Callable[[], Any]] = None,
@@ -965,7 +983,12 @@ class ReplicaPool:
                  root: Optional[str] = None,
                  heartbeat_s: Optional[float] = None,
                  stale_s: Optional[float] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 role: Optional[str] = None):
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"role {role!r} not supported (None/'prefill'/'decode')")
+        self.role = role
         n_sources = sum(x is not None
                         for x in (factory, models, subprocess_spec))
         if n_sources != 1:
@@ -1054,6 +1077,40 @@ class ReplicaPool:
                    model: Optional[str] = None) -> int:
         return self.replicas[0].host.cost_units(prompt_len, max_new,
                                                 model)
+
+    def each_engine(self, fn: Callable[[Any], None],
+                    healthy_only: bool = False) -> int:
+        """Apply ``fn(engine)`` to every engine of every in-process
+        replica (subprocess hosts have no reachable engine object and
+        are skipped). A raising ``fn`` is contained per engine. Returns
+        the number of engines visited — the disagg router's decode-side
+        peer-rewiring seam."""
+        with self._lock:
+            reps = ([r for r in self.replicas if r.routable]
+                    if healthy_only else list(self.replicas))
+        n = 0
+        for r in reps:
+            for eng in list(
+                    (getattr(r.host, "engines", None) or {}).values()):
+                try:
+                    fn(eng)
+                    n += 1
+                except Exception:  # noqa: BLE001 — contained per engine
+                    pass
+        return n
+
+    def kv_export_endpoints(self) -> List[str]:
+        """``host:port`` endpoints of every healthy replica engine's
+        serving spill tier (the prefill fleet's handoff export plane —
+        what the disagg router wires into decode engines' peer
+        lists)."""
+        eps: List[str] = []
+        for r in self.healthy():
+            for eng in (getattr(r.host, "engines", None) or {}).values():
+                ep = getattr(eng, "kv_spill_endpoint", None)
+                if ep:
+                    eps.append(ep)
+        return eps
 
     def _publish_states(self) -> None:
         counts: Dict[str, int] = {}

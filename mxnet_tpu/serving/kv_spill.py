@@ -40,7 +40,6 @@ request.
 """
 from __future__ import annotations
 
-import io
 import os
 import threading
 from collections import OrderedDict
@@ -49,6 +48,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as onp
 
 from ..base import env_float
+from .kv_codec import decode_blocks, encode_blocks, payload_nbytes
 from .kv_hash import hash_hex
 
 __all__ = ["KVSpillTier", "spill_bytes_default", "spill_dir_from_env",
@@ -74,22 +74,12 @@ def spill_peers_from_env() -> List[str]:
     return [p.strip() for p in raw.split(",") if p.strip()]
 
 
-def _pack(arrays: Dict[str, onp.ndarray]) -> bytes:
-    buf = io.BytesIO()
-    onp.savez(buf, **arrays)
-    return buf.getvalue()
-
-
-def _unpack(blob: bytes) -> Optional[Dict[str, onp.ndarray]]:
-    try:
-        with onp.load(io.BytesIO(blob)) as z:
-            return {k: z[k] for k in z.files}
-    except Exception:  # noqa: BLE001 — a torn/corrupt blob reads as a miss
-        return None
-
-
-def _nbytes(arrays: Dict[str, onp.ndarray]) -> int:
-    return sum(int(a.nbytes) for a in arrays.values())
+# the (de)serialization lives in kv_codec — ONE wire format shared with
+# the prefill→decode handoff, so spill blobs and handoff frames can
+# never drift apart (kv_codec module docstring has the layout contract)
+_pack = encode_blocks
+_unpack = decode_blocks
+_nbytes = payload_nbytes
 
 
 class KVSpillTier:
@@ -124,27 +114,40 @@ class KVSpillTier:
         self._dropped = 0
         self._remote_errors = 0
         self._sweep_every = 64
+        self._remote_deadline_s = float(remote_deadline_s)
         self._server = None
         self._client = None
-        if serve or peers:
-            from ..io.transport import BlockClient, BlockServer
+        if serve:
+            from ..io.transport import BlockServer
 
-            if serve:
-                self._server = BlockServer(self._resolve, host=host,
-                                           name=f"kvspill-{name}")
-                self._server.start()
-            if peers:
-                # the fetch budget is short on purpose: the engine
-                # probes remote tiers from its admission path, and a
-                # dead peer must cost a bounded miss, not a stall
-                self._client = BlockClient(
-                    list(peers), deadline_s=float(remote_deadline_s))
+            self._server = BlockServer(self._resolve, host=host,
+                                       name=f"kvspill-{name}")
+            self._server.start()
+        if peers:
+            self.set_peers(peers)
 
     # -- identity ----------------------------------------------------------
     @property
     def endpoint(self) -> Optional[str]:
         """``host:port`` of the serving side (None when not serving)."""
         return self._server.endpoint if self._server is not None else None
+
+    def set_peers(self, peers: List[str]) -> None:
+        """(Re)wire the remote tier's peer set. The disagg router calls
+        this on every prefill-fleet scale/death event so decode engines
+        always probe the *live* prefill exporters; an in-flight fetch
+        on the old client is contained to a counted miss."""
+        old, self._client = self._client, None
+        if peers:
+            from ..io.transport import BlockClient
+
+            # the fetch budget is short on purpose: the engine probes
+            # remote tiers from its admission path, and a dead peer
+            # must cost a bounded miss, not a stall
+            self._client = BlockClient(
+                list(peers), deadline_s=self._remote_deadline_s)
+        if old is not None:
+            old.close()
 
     # -- the tiers ---------------------------------------------------------
     def put(self, hsh: bytes, arrays: Dict[str, onp.ndarray]) -> None:
@@ -201,9 +204,10 @@ class KVSpillTier:
                 if a is not None:
                     self._promote(hsh, a)
                     return a, "disk"
-        if self._client is not None:
+        client = self._client  # set_peers may swap it mid-probe
+        if client is not None:
             try:
-                blob = self._client.try_fetch("kv/" + hash_hex(hsh))
+                blob = client.try_fetch("kv/" + hash_hex(hsh))
             except Exception:  # noqa: BLE001 — typed transport faults
                 # retries exhausted / CRC-rejected garble / dead peer:
                 # a remote miss, the engine re-prefills locally
@@ -275,8 +279,9 @@ class KVSpillTier:
             "disk_root": self.root,
             "endpoint": self.endpoint,
         }
-        if self._client is not None:
-            out["peers"] = list(self._client.endpoints)
+        client = self._client
+        if client is not None:
+            out["peers"] = list(client.endpoints)
         return out
 
     def close(self) -> None:

@@ -203,6 +203,23 @@ class LLMMetrics:
             "llm_kv_reattach_total",
             "Spilled KV blocks re-attached into the pool by source tier",
             ("engine", "tier"))
+        # GSPMD sharding: mesh width + per-device KV footprint (the
+        # largest-servable-model evidence: a pool whose TOTAL exceeds
+        # one chip serves when the per-device share fits)
+        self.shard_devices = reg.gauge(
+            "llm_shard_devices",
+            "Devices in the serving mesh (1 = unsharded)",
+            ("engine",)).labels(**eng)
+        self.shard_pool_bytes = reg.gauge(
+            "llm_shard_pool_bytes_per_device",
+            "KV pool bytes resident per device (head-sharded over tp)",
+            ("engine",)).labels(**eng)
+        # disaggregated serving: blocks a prefill-role engine exported
+        # into its serving spill tier for the prefill->decode handoff
+        self.handoff_exported = reg.counter(
+            "llm_handoff_exported_blocks_total",
+            "KV blocks exported by a prefill-role engine for handoff",
+            ("engine",)).labels(**eng)
         self.token_latency_ms = reg.histogram(
             "llm_token_latency_ms",
             "Per-token latency (decode step wall / tokens in step)",
@@ -351,6 +368,31 @@ class LLMEngine:
         resilience classifier exactly like a program fault). The fleet
         layer (:mod:`.fleet`) uses it as the per-replica chaos
         injection point; anything it does must be cheap.
+    mesh : jax.sharding.Mesh, optional
+        Arms **GSPMD-sharded serving**: params are partitioned by
+        ``rules`` (default the
+        :data:`~mxnet_tpu.parallel.sharding.TRANSFORMER_RULES`
+        megatron tp column/row catalog), the KV block pools become
+        global arrays sharded on the head axis
+        (``P(None, None, "tp")`` — heads must divide the ``tp`` axis),
+        and every paged program runs as a global-array program over the
+        mesh by input-sharding propagation. Token-identical to the
+        unsharded engine; donation and the ``_decode_cache``/AOT
+        fingerprint discipline are preserved (the mesh topology already
+        folds into both). This is how a model whose KV/param bytes
+        exceed one chip serves: per-device share = total / tp.
+    rules : list of (regex, PartitionSpec), optional
+        Partition-rule tree for ``mesh=`` (see above).
+    role : None | "prefill" | "decode"
+        Arms **disaggregated serving** (:mod:`.disagg`). A
+        ``"prefill"`` engine exports every freshly prefilled full
+        block's exact rows into its (serving) spill tier, keyed by the
+        shared chain hashes; a ``"decode"`` engine probes the prefill
+        fleet's export endpoints (wired via
+        :meth:`set_kv_spill_peers`) as its remote spill tier, so
+        admission re-attaches shipped blocks by DMA and decodes
+        without re-prefilling. Both roles force ``prefix_cache`` +
+        ``kv_spill`` on.
 
     Notes
     -----
@@ -384,8 +426,42 @@ class LLMEngine:
                  kv_spill_serve: Optional[bool] = None,
                  kv_spill_peers: Optional[List[str]] = None,
                  step_hook: Optional[Callable[[], None]] = None,
-                 metrics: Optional[LLMMetrics] = None):
+                 metrics: Optional[LLMMetrics] = None,
+                 mesh=None, rules=None, role: Optional[str] = None):
         from ..gluon.model_zoo.generation import _resolve_cache_dtype
+
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"role {role!r} not supported (None/'prefill'/'decode')")
+        self.role = role
+        if role is not None:
+            # disaggregated serving (docs/llm_serving.md): both halves
+            # speak the chain-hash + shared-codec handoff protocol, so
+            # both need the prefix cache and a spill tier. The prefill
+            # side SERVES its exported rows; the decode side probes
+            # peers (wired later via set_kv_spill_peers).
+            if prefix_cache is False or kv_spill is False:
+                raise ValueError(
+                    f"role={role!r} requires prefix_cache and kv_spill "
+                    "(the handoff is keyed by chain hashes and carried "
+                    "by the spill tier)")
+            prefix_cache = True
+            kv_spill = True
+            if role == "prefill" and kv_spill_serve is None:
+                kv_spill_serve = True
+        self._mesh = mesh
+        if mesh is not None:
+            if weight_dtype is not None:
+                raise MXNetError(
+                    "mesh= with weight_dtype is not supported: the "
+                    "int8-weight wrapper re-keys the param tree out from "
+                    "under the partition rules")
+            from ..parallel.sharding import TRANSFORMER_RULES
+
+            self._rules = list(rules) if rules is not None \
+                else list(TRANSFORMER_RULES)
+        else:
+            self._rules = None
 
         if max_running is None:
             max_running = int(env_float("MXNET_TPU_LLM_MAX_RUNNING", 8))
@@ -478,7 +554,8 @@ class LLMEngine:
         pk, pv = model.init_block_pool(self.num_blocks + 1,
                                        self.block_size,
                                        dtype=self._kv_dtype)
-        self._pool_k, self._pool_v = pk._data, pv._data
+        self._pool_k = self._shard_pool(pk._data)
+        self._pool_v = self._shard_pool(pv._data)
         self._free: List[int] = list(range(self.num_blocks))
         self.metrics.pool_free.set(len(self._free))
         # per-block refcounts (lane ownership + prefix-cache residency;
@@ -500,7 +577,8 @@ class LLMEngine:
             dk, dv = draft_model.init_block_pool(
                 self.num_blocks + 1, self.block_size,
                 dtype=self._kv_dtype)
-            self._dpool_k, self._dpool_v = dk._data, dv._data
+            self._dpool_k = self._shard_pool(dk._data)
+            self._dpool_v = self._shard_pool(dv._data)
 
         # lane state (host side; device arrays mirror it each step)
         self._lanes: List[Optional[_Lane]] = [None] * self.max_running
@@ -529,6 +607,10 @@ class LLMEngine:
             kv_cache_dtype=self._kv_dtype, weight_dtype=weight_dtype,
             greedy=greedy, temperature=temperature, top_k=top_k,
             donate=self._donate)
+        # GSPMD serving: committed NamedSharding params/pools make the
+        # existing plain-jit programs global-array programs — sharding
+        # propagates from the inputs, no per-program in_shardings
+        self._params = self._shard_params(self._params)
         if self._spec:
             self._draft_run, self._draft_params = paged_spec_draft_program(
                 draft_model, max_running=self.max_running,
@@ -538,6 +620,7 @@ class LLMEngine:
                 kv_cache_dtype=self._kv_dtype, weight_dtype=None,
                 greedy=greedy, temperature=temperature, top_k=top_k,
                 donate=self._donate)
+            self._draft_params = self._shard_params(self._draft_params)
             self._verify_run, _ = paged_spec_verify_program(
                 model, max_running=self.max_running,
                 draft_k=self._draft_k, num_blocks=self.num_blocks + 1,
@@ -553,6 +636,9 @@ class LLMEngine:
         self._warmup_manifest = aot.WarmupManifest()
         self._warm: set = set()
         self._manifest_keyed: set = set()
+        self.metrics.shard_devices.set(
+            int(mesh.devices.size) if mesh is not None else 1)
+        self.metrics.shard_pool_bytes.set(self._pool_bytes_per_device())
 
         # scheduler; the state lock covers pool/lane mutation (the
         # scheduler tick vs a caller-thread warmup())
@@ -579,6 +665,68 @@ class LLMEngine:
         _texporter.register_liveness(
             f"llm:{self.metrics.engine_id}",
             lambda: {"alive": self.alive, "last_tick": self.last_tick})
+
+    # -- GSPMD sharding (mesh=) --------------------------------------------
+    def _mesh_ctx(self):
+        """The mesh scope every device-dispatch seam runs under. The
+        mesh stack is thread-local, so the scheduler thread must enter
+        it itself; entering it is also what folds the topology into the
+        AOT dispatch signature / persistent fingerprint
+        (``aot.cache._mesh_sig`` / ``_mesh_component``) — the
+        ``_decode_cache`` discipline needs no per-mesh cache keys."""
+        if self._mesh is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        from ..parallel.mesh import use_mesh
+
+        return use_mesh(self._mesh)
+
+    def _shard_pool(self, arr):
+        """Commit one KV block pool to the mesh as a global array,
+        sharded on the HEAD axis (pool layout ``(L, NB+1, H, bs, D)`` —
+        heads are embarrassingly parallel under paged attention, while
+        D carries the int8 bitcast-scale tail and must stay whole, and
+        the block axis must stay whole so block ids keep addressing the
+        global pool). On a mesh without a ``tp`` axis the spec
+        collapses to replication (the ``named_sharding`` contract)."""
+        if self._mesh is None:
+            return arr
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import named_sharding
+
+        return jax.device_put(
+            arr, named_sharding(P(None, None, "tp"), self._mesh))
+
+    def _shard_params(self, params):
+        """Partition the flat param dict by the rule catalog
+        (megatron tp column/row via ``TRANSFORMER_RULES`` unless the
+        caller brought its own tree) and commit it to the mesh. With
+        committed inputs, GSPMD propagates the layout through the
+        plain-jit paged programs — decode/prefill/suffix/spec all
+        become global-array programs without per-program shardings."""
+        if self._mesh is None:
+            return params
+        from ..parallel.sharding import match_partition_rules, shard_tree
+
+        specs = match_partition_rules(self._rules, params)
+        return shard_tree(params, specs, self._mesh)
+
+    def _pool_bytes_per_device(self) -> int:
+        """Bytes of KV pool resident PER DEVICE — the number that
+        decides whether a model fits a chip. Sharded pools divide the
+        head axis across the mesh, so this is the largest-servable
+        -model lever: per-device share = total / tp."""
+        pools = [self._pool_k, self._pool_v]
+        if self._spec:
+            pools += [self._dpool_k, self._dpool_v]
+        total = 0
+        for arr in pools:
+            shards = getattr(arr, "addressable_shards", None)
+            total += (int(shards[0].data.nbytes) if shards
+                      else int(arr.nbytes))
+        return total
 
     # -- prompt bucketing --------------------------------------------------
     def _prefill_bucket(self, p: int) -> int:
@@ -693,6 +841,21 @@ class LLMEngine:
             self._ref[b] = 1
         return got
 
+    def evictable_blocks(self) -> int:
+        """Prefix-cache residents nothing else references (refcount 1)
+        — blocks ``_alloc`` reclaims on demand. Advisory racy read on
+        purpose (no scheduler lock): the fleet's free-capacity gauge
+        adds this to the free list so an idle prefix-cache engine —
+        which keeps served blocks resident instead of returning them —
+        doesn't read as permanently saturated to the router's
+        quota/deadline-class pressure shed or the autoscaler's
+        free-fraction trigger."""
+        try:
+            return sum(1 for b in list(self._prefix.values())
+                       if self._ref.get(b, 0) == 1)
+        except RuntimeError:
+            return 0            # snapshot raced a resize — next read wins
+
     # -- tiered KV spill (host RAM / disk / remote) ------------------------
     @property
     def kv_spill_endpoint(self) -> Optional[str]:
@@ -700,6 +863,14 @@ class LLMEngine:
         unless ``kv_spill_serve`` armed it) — what a peer engine puts
         in its ``kv_spill_peers`` list."""
         return self._spill.endpoint if self._spill is not None else None
+
+    def set_kv_spill_peers(self, peers: List[str]) -> None:
+        """(Re)wire the spill tier's remote peers. The disagg router
+        points every decode-role engine at the live prefill fleet's
+        export endpoints through this, re-calling it on each scale or
+        death event; a no-spill engine ignores it."""
+        if self._spill is not None:
+            self._spill.set_peers(list(peers))
 
     def _spill_save(self, evicted: List[tuple]) -> None:
         """Copy the evicted blocks' exact pool rows (and the draft
@@ -824,7 +995,7 @@ class LLMEngine:
         """One scheduler iteration: admit into free lanes, then run one
         decode step. Returns True when there is nothing to do (caller
         sleeps a tick), None when closed-and-drained."""
-        with self._state_lock:
+        with self._state_lock, self._mesh_ctx():
             return self._tick_locked()
 
     def _tick_locked(self):
@@ -1078,12 +1249,26 @@ class LLMEngine:
         # cache (+1 cache ref each; they are never written again —
         # decode writes land at positions >= p, past every full block)
         if self._prefix_on:
+            fresh_cached: List[tuple] = []
             for j in range(n_hit, min(p // bs, len(hashes))):
                 hsh = hashes[j]
                 if hsh not in self._prefix:
                     self._prefix[hsh] = blocks[j]
                     self._incref(blocks[j])
+                    fresh_cached.append((hsh, blocks[j]))
             self.metrics.prefix_cached_blocks.set(len(self._prefix))
+            if self.role == "prefill" and fresh_cached:
+                # disaggregated handoff: a prefill-role engine EXPORTS
+                # every freshly computed full block's rows into its
+                # serving spill tier the moment prefill lands — the
+                # decode replica fetches them as its "remote" tier and
+                # re-attaches by DMA. Export precedes req.finish(), so
+                # the router's prefill wait() doubles as the
+                # export-complete barrier. (Same batched D2H gather as
+                # eviction demotion; an evicted export later reads as a
+                # contained miss and the decode side re-prefills.)
+                self._spill_save(fresh_cached)
+                self.metrics.handoff_exported.inc(len(fresh_cached))
         req.prefill_s = dt
         req.first_token_s = req.latency_s
         lane = _Lane(req, blocks, pos=p, last_token=first)
@@ -1355,8 +1540,9 @@ class LLMEngine:
         """Type the fault through the resilience classifier, fail every
         in-flight request with it, reset the pool (donated buffers may
         be gone). Returns False (stop the scheduler) on FATAL."""
-        with self._state_lock:   # a caller-thread warmup() must not
-            return self._fault_locked(exc)  # interleave the pool rebuild
+        with self._state_lock, self._mesh_ctx():
+            # a caller-thread warmup() must not interleave the rebuild
+            return self._fault_locked(exc)
 
     def _fault_locked(self, exc: Exception) -> bool:
         kind = classify(exc)
@@ -1383,12 +1569,14 @@ class LLMEngine:
         # prefix cache indexes pool CONTENT, so it resets with the pool.
         pk, pv = self._model.init_block_pool(
             self.num_blocks + 1, self.block_size, dtype=self._kv_dtype)
-        self._pool_k, self._pool_v = pk._data, pv._data
+        self._pool_k = self._shard_pool(pk._data)
+        self._pool_v = self._shard_pool(pv._data)
         if self._spec:
             dk, dv = self._draft.init_block_pool(
                 self.num_blocks + 1, self.block_size,
                 dtype=self._kv_dtype)
-            self._dpool_k, self._dpool_v = dk._data, dv._data
+            self._dpool_k = self._shard_pool(dk._data)
+            self._dpool_v = self._shard_pool(dv._data)
         self._free = list(range(self.num_blocks))
         self._ref.clear()
         self._prefix.clear()
@@ -1478,7 +1666,7 @@ class LLMEngine:
         return buckets
 
     def _warmup_buckets(self, buckets) -> None:
-        with self._state_lock:
+        with self._state_lock, self._mesh_ctx():
             self._warmup_buckets_locked(buckets)
 
     def _warmup_buckets_locked(self, buckets) -> None:
@@ -1562,6 +1750,18 @@ class LLMEngine:
             "queue_len": len(self._queue),
             "aot": aot.stats(),
         }
+        if self.role is not None:
+            out["role"] = self.role
+            out["handoff_exported_blocks"] = int(
+                self.metrics.handoff_exported.value)
+        if self._mesh is not None:
+            from ..parallel.sharding import mesh_topology
+
+            out["sharding"] = {
+                "devices": int(self._mesh.devices.size),
+                "topology": mesh_topology(self._mesh),
+                "pool_bytes_per_device": self._pool_bytes_per_device(),
+            }
         if self._spec:
             out["speculative"] = {
                 "draft_k": self._draft_k,
@@ -1648,7 +1848,8 @@ class LLMEngine:
         for g in (self.metrics.tok_s, self.metrics.lanes_active,
                   self.metrics.lanes_total, self.metrics.pool_free,
                   self.metrics.pool_total, self.metrics.kv_spill_blocks,
-                  self.metrics.kv_spill_bytes):
+                  self.metrics.kv_spill_bytes, self.metrics.shard_devices,
+                  self.metrics.shard_pool_bytes):
             g.set(0)
         if self._spill is not None:
             self._spill.close()

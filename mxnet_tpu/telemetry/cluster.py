@@ -184,6 +184,9 @@ def derive(processes: Dict[str, Dict]) -> Dict:
     lanes_active = 0.0
     prefix_hit = prefix_miss = 0.0
     spill_blocks = 0.0
+    handoff_exported = handoff_miss = 0.0
+    handoff_blocks = 0.0
+    shard_devices = 0.0
     starved_ms = wall_ms = 0.0
     stale_n = 0
     ages: List[float] = []
@@ -217,6 +220,17 @@ def derive(processes: Dict[str, Dict]) -> Dict:
         prefix_miss += _series_sum(m, "llm_prefix_tokens_total",
                                    {"result": "miss"})
         spill_blocks += _series_sum(m, "llm_kv_spill_blocks")
+        # disaggregated serving: the handoff economy (how many
+        # requests rode the prefill fleet's export vs fell back to a
+        # local re-prefill) and the sharding footprint — per-router
+        # counters only tell one pod's story
+        handoff_exported += _series_sum(m, "fleet_handoff_requests_total",
+                                        {"result": "exported"})
+        handoff_miss += _series_sum(m, "fleet_handoff_requests_total",
+                                    {"result": "miss"})
+        handoff_blocks += _series_sum(m, "llm_handoff_exported_blocks_total")
+        shard_devices = max(shard_devices,
+                            _series_max(m, "llm_shard_devices") or 0.0)
         s_ms, _ = _hist_totals(m, "telemetry_step_bucket_ms",
                                {"bucket": "input_starved"})
         w_ms, _ = _hist_totals(m, "telemetry_step_ms")
@@ -236,6 +250,10 @@ def derive(processes: Dict[str, Dict]) -> Dict:
             round(prefix_hit / (prefix_hit + prefix_miss), 5)
             if (prefix_hit + prefix_miss) > 0 else 0.0,
         "llm_kv_spill_blocks_total": spill_blocks,
+        "handoff_exported_total": handoff_exported,
+        "handoff_miss_total": handoff_miss,
+        "handoff_exported_blocks_total": handoff_blocks,
+        "shard_devices_max": shard_devices,
         "export_age_min_s": round(min(ages), 3) if ages else None,
         "export_age_max_s": round(max(ages), 3) if ages else None,
         "input_starved_frac":
@@ -364,6 +382,22 @@ class ClusterScraper:
                 "cluster_kv_spill_blocks",
                 "KV blocks parked in host-RAM spill tiers over every "
                 "engine in the cluster"),
+            "handoff_exported_total": reg.gauge(
+                "cluster_handoff_exported",
+                "Disagg requests whose prefill-stage export completed, "
+                "summed over every router in the cluster"),
+            "handoff_miss_total": reg.gauge(
+                "cluster_handoff_miss",
+                "Disagg requests whose handoff failed (decode engines "
+                "re-prefilled locally), summed over every router"),
+            "handoff_exported_blocks_total": reg.gauge(
+                "cluster_handoff_exported_blocks",
+                "KV block rows exported by prefill-role engines over "
+                "the cluster"),
+            "shard_devices_max": reg.gauge(
+                "cluster_shard_devices_max",
+                "Widest device mesh any sharded engine in the cluster "
+                "spans"),
             "processes": reg.gauge(
                 "cluster_processes",
                 "Processes exporting into the shared telemetry root"),

@@ -617,3 +617,40 @@ def test_fleet_request_cancel_settles_and_releases_quota():
                                  timeout_ms=None).wait(timeout=120)) == 3
     finally:
         router.close()
+
+
+def test_prefix_cache_residents_count_as_free_capacity():
+    """A prefix-cache engine keeps served blocks resident instead of
+    returning them to the free list — but a refcount-0 resident is
+    reclaimable on the next admission, so it must count as free fleet
+    capacity. Regression: free_units() read only the free-list gauge,
+    so an idle cache-warm fleet looked permanently saturated and the
+    deadline-class pressure shed turned away every default-class
+    request forever (and the autoscaler's free fraction pinned at 0)."""
+    # stale_s pinned high: with one replica and the default max(4*hb, 1s)
+    # window, a >1s compile/scheduler stall under full-suite load empties
+    # healthy(), the quota collapses to max(1, 0) and the submit sheds —
+    # which is not the accounting path this test is about
+    pool = ReplicaPool(_factory(prefix_cache=True), n_replicas=1,
+                       heartbeat_s=0.1, stale_s=30.0)
+    router = Router(pool, hedge_ms=0)
+    try:
+        rng = onp.random.RandomState(77)
+        # distinct 12-token prompts -> 3 full cached blocks each: run
+        # enough of them to drain the 32-block free list into residency
+        for _ in range(12):
+            router.generate(_prompt(rng, 12), 2, timeout_ms=None)
+        eng = next(iter(pool.replicas[0].host.engines.values()))
+        cap = pool.capacity_units()
+        free_list = int(eng.metrics.pool_free.get())
+        assert free_list < cap // 2          # the cache really is warm
+        assert eng.evictable_blocks() > 0
+        # reclaimable residents restore the fleet's free capacity...
+        assert pool.free_units() >= int(0.8 * cap)
+        # ...so an idle cache-warm fleet must not pressure-shed the
+        # default (class 0) tenant
+        assert len(router.generate(_prompt(rng, 12), 2,
+                                   timeout_ms=None)) == 2
+        assert router.stats()["counters"].get("shed_class", 0) == 0
+    finally:
+        router.close()

@@ -118,6 +118,12 @@ MODULES = {
     "mxnet_tpu.serving.kv_spill": "tiered KV block storage: host-RAM / "
                                   "disk / remote-peer spill under the "
                                   "paged pool, re-attach over re-prefill",
+    "mxnet_tpu.serving.kv_codec": "byte-exact KV block row wire codec "
+                                  "shared by the spill tiers and the "
+                                  "prefill/decode handoff",
+    "mxnet_tpu.serving.disagg": "disaggregated serving: prefill/decode "
+                                "role fleets, KV-block handoff over the "
+                                "transport, miss-never-loss staging",
     "mxnet_tpu.gluon.model_zoo.generation": "autoregressive generation: "
                                             "compiled decode/beam "
                                             "programs, paged serving "
